@@ -1,0 +1,48 @@
+"""cuda_mpi_parallel_tpu: a TPU-native sparse linear-solver framework.
+
+A ground-up rebuild of the capabilities of the reference
+``Yan12345678/CUDA-MPI-parallel`` (a single-file cuSPARSE/cuBLAS conjugate-
+gradient solver, ``CUDACG.cu``) designed for TPU hardware: Pallas/XLA SpMV
+over HBM-resident operators, a ``lax.while_loop``-jitted solver body with
+on-device convergence checks, and row-partitioned multi-chip execution where
+per-iteration inner products become ``lax.psum`` over the ICI mesh and the
+distributed SpMV halo exchange uses ``lax.ppermute``.
+
+Public API surface::
+
+    from cuda_mpi_parallel_tpu import cg, solve, CGStatus
+    from cuda_mpi_parallel_tpu import (CSRMatrix, ELLMatrix, DenseOperator,
+                                       Stencil2D, Stencil3D,
+                                       JacobiPreconditioner)
+    from cuda_mpi_parallel_tpu.models import poisson, random_spd
+"""
+
+from .models.operators import (
+    CSRMatrix,
+    DenseOperator,
+    ELLMatrix,
+    IdentityOperator,
+    JacobiPreconditioner,
+    LinearOperator,
+    Stencil2D,
+    Stencil3D,
+)
+from .solver.cg import CGResult, cg, solve
+from .solver.status import CGStatus
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CGResult",
+    "CGStatus",
+    "CSRMatrix",
+    "DenseOperator",
+    "ELLMatrix",
+    "IdentityOperator",
+    "JacobiPreconditioner",
+    "LinearOperator",
+    "Stencil2D",
+    "Stencil3D",
+    "cg",
+    "solve",
+]

@@ -1,0 +1,27 @@
+"""Problem/operator families: the data layer (reference: hardcoded system at
+``CUDACG.cu:74-117``; here: operator types + generators + loaders)."""
+
+from . import poisson, random_spd
+from .operators import (
+    CSRMatrix,
+    DenseOperator,
+    ELLMatrix,
+    IdentityOperator,
+    JacobiPreconditioner,
+    LinearOperator,
+    Stencil2D,
+    Stencil3D,
+)
+
+__all__ = [
+    "CSRMatrix",
+    "DenseOperator",
+    "ELLMatrix",
+    "IdentityOperator",
+    "JacobiPreconditioner",
+    "LinearOperator",
+    "Stencil2D",
+    "Stencil3D",
+    "poisson",
+    "random_spd",
+]

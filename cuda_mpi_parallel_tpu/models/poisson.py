@@ -1,0 +1,118 @@
+"""Poisson problem generators (the framework's benchmark model family).
+
+The reference ships exactly one hardcoded 3x3 system (``CUDACG.cu:74-117``);
+``oracle_system()`` reproduces it bit-for-bit as the regression oracle.  The
+BASELINE configs add 2D 5-point (config #2/#3) and 3D 7-point (config #4,
+N=256^3) Poisson Laplacians; those are generated here both as assembled CSR
+(for the generic-sparse code path) and consumed matrix-free via
+``Stencil2D/3D`` (the TPU-preferred path).
+
+All generation is host-side numpy (vectorized - no Python per-row loops, so
+building the N=1M 2D system takes milliseconds).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .operators import CSRMatrix, Stencil2D, Stencil3D
+
+
+def oracle_system(dtype=jnp.float64) -> Tuple[CSRMatrix, jnp.ndarray, np.ndarray]:
+    """The reference's hardcoded system and its documented solution.
+
+    A (symmetric, *indefinite* - eigenvalues {-0.236, 2, 4.236}, SURVEY
+    quirk Q1), b, and the expected x = [0.50, 0.75, 1.00] from the comment
+    at ``CUDACG.cu:74-82``.  CSR arrays match ``h_valA`` / ``h_csrRowPtrA`` /
+    ``h_csrColIndA`` / ``h_b`` (``CUDACG.cu:94-117,136-140``): n=3, nnz=5,
+    0-based int32 indices.
+    """
+    val = np.array([3.0, 2.0, 2.0, 2.0, 1.0], dtype=np.float64)
+    indptr = np.array([0, 2, 3, 5], dtype=np.int32)
+    indices = np.array([0, 2, 1, 0, 2], dtype=np.int32)
+    b = np.array([3.5, 1.5, 2.0], dtype=np.float64)
+    x_expected = np.array([0.5, 0.75, 1.0], dtype=np.float64)
+    a = CSRMatrix.from_arrays(val.astype(np.dtype(dtype)), indices, indptr,
+                              (3, 3))
+    return a, jnp.asarray(b, dtype=dtype), x_expected
+
+
+def poisson_1d_csr(n: int, scale: float = 1.0, dtype=np.float64) -> CSRMatrix:
+    """Tridiagonal [-1, 2, -1] * scale (Dirichlet)."""
+    rows, cols, vals = [], [], []
+    idx = np.arange(n)
+    rows.append(idx); cols.append(idx); vals.append(np.full(n, 2.0))
+    rows.append(idx[:-1]); cols.append(idx[1:]); vals.append(np.full(n - 1, -1.0))
+    rows.append(idx[1:]); cols.append(idx[:-1]); vals.append(np.full(n - 1, -1.0))
+    return _coo_to_csr(np.concatenate(rows), np.concatenate(cols),
+                       np.concatenate(vals) * scale, n, dtype)
+
+
+def poisson_2d_csr(nx: int, ny: int, scale: float = 1.0,
+                   dtype=np.float64) -> CSRMatrix:
+    """Assembled 2D 5-point Laplacian (Dirichlet), row-major grid order.
+
+    Identical matrix to ``Stencil2D(nx, ny, scale)`` - asserted by tests.
+    """
+    n = nx * ny
+    i, j = np.meshgrid(np.arange(nx), np.arange(ny), indexing="ij")
+    lin = (i * ny + j).ravel()
+    i, j = i.ravel(), j.ravel()
+    rows = [lin]
+    cols = [lin]
+    vals = [np.full(n, 4.0)]
+    for di, dj in ((-1, 0), (1, 0), (0, -1), (0, 1)):
+        ni, nj = i + di, j + dj
+        ok = (ni >= 0) & (ni < nx) & (nj >= 0) & (nj < ny)
+        rows.append(lin[ok])
+        cols.append((ni * ny + nj)[ok])
+        vals.append(np.full(int(ok.sum()), -1.0))
+    return _coo_to_csr(np.concatenate(rows), np.concatenate(cols),
+                       np.concatenate(vals) * scale, n, dtype)
+
+
+def poisson_3d_csr(nx: int, ny: int, nz: int, scale: float = 1.0,
+                   dtype=np.float64) -> CSRMatrix:
+    """Assembled 3D 7-point Laplacian (Dirichlet), row-major grid order."""
+    n = nx * ny * nz
+    i, j, k = np.meshgrid(np.arange(nx), np.arange(ny), np.arange(nz),
+                          indexing="ij")
+    lin = ((i * ny + j) * nz + k).ravel()
+    i, j, k = i.ravel(), j.ravel(), k.ravel()
+    rows = [lin]
+    cols = [lin]
+    vals = [np.full(n, 6.0)]
+    for di, dj, dk in ((-1, 0, 0), (1, 0, 0), (0, -1, 0), (0, 1, 0),
+                       (0, 0, -1), (0, 0, 1)):
+        ni, nj, nk = i + di, j + dj, k + dk
+        ok = ((ni >= 0) & (ni < nx) & (nj >= 0) & (nj < ny)
+              & (nk >= 0) & (nk < nz))
+        rows.append(lin[ok])
+        cols.append(((ni * ny + nj) * nz + nk)[ok])
+        vals.append(np.full(int(ok.sum()), -1.0))
+    return _coo_to_csr(np.concatenate(rows), np.concatenate(cols),
+                       np.concatenate(vals) * scale, n, dtype)
+
+
+def poisson_2d_operator(nx: int, ny: int, scale: float = 1.0,
+                        dtype=jnp.float32) -> Stencil2D:
+    return Stencil2D.create(nx, ny, scale=scale, dtype=dtype)
+
+
+def poisson_3d_operator(nx: int, ny: int, nz: int, scale: float = 1.0,
+                        dtype=jnp.float32) -> Stencil3D:
+    return Stencil3D.create(nx, ny, nz, scale=scale, dtype=dtype)
+
+
+def _coo_to_csr(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                n: int, dtype) -> CSRMatrix:
+    """Sort COO triplets into canonical CSR (row-major, columns ascending)."""
+    order = np.lexsort((cols, rows))
+    rows, cols, vals = rows[order], cols[order], vals[order]
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    indptr = np.cumsum(indptr).astype(np.int32)
+    return CSRMatrix.from_arrays(vals.astype(np.dtype(dtype)),
+                                 cols.astype(np.int32), indptr, (n, n))

@@ -1,0 +1,50 @@
+"""Random SPD test/benchmark matrices (BASELINE config #1).
+
+The reference cannot generate problems at all - its only system is hardcoded
+(``CUDACG.cu:94-117``).  These generators produce well-conditioned SPD
+matrices with a controllable spectrum so CG iteration counts are predictable
+in tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .operators import CSRMatrix, DenseOperator
+
+
+def random_spd_dense(n: int, *, cond: float = 100.0, seed: int = 0,
+                     dtype=np.float64) -> DenseOperator:
+    """Dense SPD matrix with condition number ~``cond``: A = Q diag(s) Q^T."""
+    rng = np.random.default_rng(seed)
+    q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    s = np.geomspace(1.0, cond, n)
+    a = (q * s) @ q.T
+    a = 0.5 * (a + a.T)  # exact symmetry
+    return DenseOperator(a=_to_jax(a, dtype))
+
+
+def random_spd_sparse(n: int, *, density: float = 0.01, seed: int = 0,
+                      dtype=np.float64) -> CSRMatrix:
+    """Sparse SPD via B + B^T + diagonal dominance shift."""
+    rng = np.random.default_rng(seed)
+    nnz_target = max(n, int(density * n * n))
+    rows = rng.integers(0, n, nnz_target)
+    cols = rng.integers(0, n, nnz_target)
+    vals = rng.standard_normal(nnz_target)
+    import scipy.sparse as sp
+
+    b = sp.coo_matrix((vals, (rows, cols)), shape=(n, n)).tocsr()
+    a = b + b.T
+    # Diagonal dominance => SPD (Gershgorin).
+    row_abs = np.asarray(np.abs(a).sum(axis=1)).ravel()
+    a = a + sp.diags(row_abs + 1.0)
+    a.sort_indices()
+    return CSRMatrix.from_arrays(a.data.astype(np.dtype(dtype)),
+                                 a.indices.astype(np.int32),
+                                 a.indptr.astype(np.int32), a.shape)
+
+
+def _to_jax(a: np.ndarray, dtype):
+    import jax.numpy as jnp
+
+    return jnp.asarray(a.astype(np.dtype(dtype)))

@@ -1,0 +1,6 @@
+"""Compute primitives: SpMV variants and fused BLAS-1 (TPU replacements for
+the reference's cuSPARSE/cuBLAS calls, ``CUDACG.cu:248-347``)."""
+
+from . import blas1, spmv
+
+__all__ = ["blas1", "spmv"]

@@ -1,0 +1,3 @@
+"""Distributed execution: mesh construction, row partitioning, psum/ppermute
+collectives - the TPU-native communication backend the reference's repo name
+(MPI) promises but never implements (SURVEY SS5)."""

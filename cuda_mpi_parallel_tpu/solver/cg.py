@@ -1,0 +1,265 @@
+"""Conjugate-gradient solver: one jitted function, zero host round-trips.
+
+This is the framework's core, rebuilt TPU-first from the reference's hot loop
+(``CUDACG.cu:269-352``).  The reference's structure - a host-side ``for`` that
+per iteration issues 8 library launches, 1 ``cudaMalloc``, and 2 *blocking*
+device->host scalar reductions (``cublasDdot`` ``:304``, ``cublasDnrm2``
+``:328``), with alpha/beta computed in host arithmetic (``:311,336-339``) -
+is exactly what a TPU design must eliminate.  Here the entire solve is a
+single ``lax.while_loop`` inside ``jit``:
+
+* the convergence predicate evaluates **on device** every iteration (same
+  check-every-iteration semantics as ``CUDACG.cu:333``, for free);
+* all BLAS-1 work fuses into a few XLA fusions per iteration;
+* recurrence scalars (rho, alpha, beta) are 0-d device arrays that never
+  leave HBM;
+* under ``shard_map`` the same body runs row-partitioned with the two inner
+  products becoming ``lax.psum`` over ICI (``axis_name`` parameter) - the
+  TPU-native stand-in for the MPI_Allreduce the reference's name promises.
+
+Reference-parity semantics preserved deliberately:
+
+* default ``tol=1e-7`` **absolute** on ||r||_2 (``CUDACG.cu:245,333`` - the
+  comment at ``:238`` says "relative" but the code is absolute, quirk Q3);
+  a relative tolerance is available via ``rtol``;
+* default ``maxiter=2000`` (``:244``);
+* x0 = 0 fast path: r0 = b, p0 = b as plain copies, no initial SpMV
+  (``:247-259``); nonzero x0 takes the general r0 = b - A@x0 path the
+  reference lacks;
+* iteration-2 p.Ap < 0 on the 3x3 oracle system (indefinite matrix, quirk
+  Q1) is *recorded* (``indefinite`` flag) but does not abort, so the oracle
+  trajectory (3 iterations to ||r|| ~ 8e-15) is reproduced exactly.
+
+Divergences from the reference (all improvements, see SURVEY quirks):
+
+* no per-iteration workspace allocation (Q2 - XLA plans buffers once);
+* non-finite scalars stop the loop with ``CGStatus.BREAKDOWN`` instead of
+  iterating on NaNs (Q4);
+* iteration count, final residual, and an optional per-iteration residual
+  history are returned (Q7 - the reference reports neither);
+* optional Jacobi (or any SPD) preconditioner M ~ A^-1 (BASELINE config #3).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.operators import IdentityOperator, LinearOperator
+from ..ops import blas1
+from .status import CGStatus
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("x", "iterations", "residual_norm", "converged", "status",
+                 "indefinite", "residual_history"),
+    meta_fields=(),
+)
+@dataclasses.dataclass(frozen=True)
+class CGResult:
+    """Everything the reference never reported (SURVEY quirk Q7)."""
+
+    x: jax.Array                # solution estimate
+    iterations: jax.Array       # number of CG iterations performed
+    residual_norm: jax.Array    # final ||r||_2
+    converged: jax.Array        # bool: residual_norm < threshold
+    status: jax.Array           # CGStatus int code
+    indefinite: jax.Array       # bool: p.Ap <= 0 was observed (quirk Q1)
+    residual_history: Optional[jax.Array]  # (maxiter+1,) ||r|| trace or None
+
+    def status_enum(self) -> CGStatus:
+        return CGStatus(int(self.status))
+
+
+class _CGState(NamedTuple):
+    k: jax.Array
+    x: jax.Array
+    r: jax.Array
+    p: jax.Array
+    rho: jax.Array        # r . z   (== ||r||^2 when unpreconditioned)
+    rr: jax.Array         # ||r||^2 (convergence is checked on r, not z)
+    indefinite: jax.Array
+    history: jax.Array    # (maxiter+1,) or (0,) when not recording
+
+
+def cg(
+    a: LinearOperator,
+    b: jax.Array,
+    x0: Optional[jax.Array] = None,
+    *,
+    tol: float = 1e-7,
+    rtol: float = 0.0,
+    maxiter: int = 2000,
+    m: Optional[LinearOperator] = None,
+    record_history: bool = False,
+    axis_name: Optional[str] = None,
+) -> CGResult:
+    """Solve A x = b by (preconditioned) conjugate gradients.
+
+    Args:
+      a: SPD linear operator (any ``LinearOperator``; also accepts a raw
+        2-D array, wrapped as dense).
+      b: right-hand side, shape ``(n,)`` (local shard inside ``shard_map``).
+      x0: initial guess; ``None`` means x0 = 0 and takes the reference's
+        copy-only init fast path (``CUDACG.cu:247-259``).
+      tol: absolute tolerance on ||r||_2 (reference semantics, quirk Q3).
+      rtol: additional relative tolerance; convergence threshold is
+        ``max(tol, rtol * ||r0||)``.
+      maxiter: iteration cap (static - sizes the history buffer).
+      m: optional preconditioner applying M^-1 (e.g.
+        ``JacobiPreconditioner``); ``None`` = unpreconditioned.
+      record_history: if True, return the per-iteration ||r|| trace.
+      axis_name: mesh axis for row-partitioned execution; inner products
+        become ``lax.psum`` over this axis.  ``None`` = single device.
+
+    The function is pure and traceable: call it under ``jit`` (or use
+    ``solve()`` which jits for you).
+    """
+    if not isinstance(a, LinearOperator):
+        a = _as_operator(a)
+    b = jnp.asarray(b)
+    if not jnp.issubdtype(b.dtype, jnp.floating):
+        b = b.astype(jnp.result_type(float))
+    if axis_name is None and a.shape[1] != b.shape[0]:
+        raise ValueError(f"operator shape {a.shape} does not match rhs "
+                         f"shape {b.shape}")
+    preconditioned = m is not None
+    if m is None:
+        m = IdentityOperator(dim=b.shape[0],
+                             _dtype_name=jnp.dtype(b.dtype).name)
+
+    dot = partial(blas1.dot, axis_name=axis_name)
+
+    if x0 is None:
+        x = jnp.zeros_like(b)
+        r = b  # r0 = b - A@0 = b: the reference's copy-only init (:248)
+    else:
+        x = jnp.asarray(x0, b.dtype)
+        r = b - a @ x
+
+    # Unpreconditioned: z == r, so rho == rr and one reduction (one psum over
+    # ICI in the distributed case) suffices per iteration.
+    rr0 = dot(r, r)
+    if preconditioned:
+        z = m @ r
+        rho0 = dot(r, z)
+    else:
+        z, rho0 = r, rr0
+    nrm0 = jnp.sqrt(rr0)
+    threshold = jnp.maximum(jnp.asarray(tol, b.dtype),
+                            jnp.asarray(rtol, b.dtype) * nrm0)
+    thresh_sq = threshold * threshold
+
+    if record_history:
+        history = jnp.full((maxiter + 1,), jnp.nan, dtype=b.dtype)
+        history = history.at[0].set(nrm0)
+    else:
+        history = jnp.zeros((0,), dtype=b.dtype)
+
+    state = _CGState(
+        k=jnp.zeros((), jnp.int32),
+        x=x, r=r, p=z,
+        rho=rho0, rr=rr0,
+        indefinite=jnp.zeros((), jnp.bool_),
+        history=history,
+    )
+
+    def cond(s: _CGState) -> jax.Array:
+        unconverged = s.rr >= thresh_sq
+        # rr == 0 means the system is solved exactly; iterating further
+        # would divide 0/0 (p = 0 => p.Ap = 0).
+        nontrivial = s.rr > 0
+        healthy = jnp.isfinite(s.rr) & jnp.isfinite(s.rho)
+        return (s.k < maxiter) & unconverged & nontrivial & healthy
+
+    def body(s: _CGState) -> _CGState:
+        ap = a @ s.p
+        p_ap = dot(s.p, ap)                       # cublasDdot :304 -> psum
+        alpha = s.rho / p_ap                      # host arithmetic :311 -> device
+        x = blas1.axpy(alpha, s.p, s.x)           # :314
+        r = blas1.axpy(-alpha, ap, s.r)           # :320-321
+        rr = dot(r, r)                            # cublasDnrm2 :328 -> psum
+        if preconditioned:
+            z = m @ r
+            rho = dot(r, z)
+        else:
+            z, rho = r, rr
+        beta = rho / s.rho                        # :336-339
+        p = blas1.xpby(z, beta, s.p)              # Dscal :342 + Daxpy :347, fused
+        k = s.k + 1
+        history = s.history
+        if record_history:
+            history = history.at[k].set(jnp.sqrt(rr))
+        return _CGState(
+            k=k, x=x, r=r, p=p, rho=rho, rr=rr,
+            indefinite=s.indefinite | (p_ap <= 0),
+            history=history,
+        )
+
+    final = lax.while_loop(cond, body, state)
+
+    nrm = jnp.sqrt(final.rr)
+    converged = (final.rr < thresh_sq) | (final.rr == 0)
+    breakdown = ~(jnp.isfinite(final.rr) & jnp.isfinite(final.rho))
+    status = jnp.where(
+        converged,
+        jnp.int32(CGStatus.CONVERGED),
+        jnp.where(breakdown, jnp.int32(CGStatus.BREAKDOWN),
+                  jnp.int32(CGStatus.MAXITER)),
+    )
+    return CGResult(
+        x=final.x,
+        iterations=final.k,
+        residual_norm=nrm,
+        converged=converged,
+        status=status,
+        indefinite=final.indefinite,
+        residual_history=final.history if record_history else None,
+    )
+
+
+def _as_operator(a) -> LinearOperator:
+    from ..models.operators import DenseOperator
+
+    arr = jnp.asarray(a)
+    if arr.ndim != 2:
+        raise ValueError(f"expected a 2-D matrix or LinearOperator, got "
+                         f"ndim={arr.ndim}")
+    return DenseOperator(a=arr)
+
+
+@partial(jax.jit, static_argnames=("maxiter", "record_history", "axis_name"))
+def _solve_jit(a, b, x0, tol, rtol, maxiter, m, record_history, axis_name):
+    return cg(a, b, x0, tol=tol, rtol=rtol, maxiter=maxiter, m=m,
+              record_history=record_history, axis_name=axis_name)
+
+
+def solve(
+    a,
+    b: jax.Array,
+    x0: Optional[jax.Array] = None,
+    *,
+    tol: float = 1e-7,
+    rtol: float = 0.0,
+    maxiter: int = 2000,
+    m: Optional[LinearOperator] = None,
+    record_history: bool = False,
+) -> CGResult:
+    """Jitted single-call entry point: compile once per (operator-structure,
+    shape, maxiter) and reuse - the whole solve is one XLA executable.
+
+    ``tol``/``rtol`` are passed as device scalars so sweeping tolerances does
+    not recompile.
+    """
+    if not isinstance(a, LinearOperator):
+        a = _as_operator(a)
+    b = jnp.asarray(b)
+    tol_a = jnp.asarray(tol, b.dtype)
+    rtol_a = jnp.asarray(rtol, b.dtype)
+    return _solve_jit(a, b, x0, tol_a, rtol_a, maxiter, m, record_history,
+                      None)

@@ -1,0 +1,27 @@
+"""Typed solver statuses.
+
+The reference has no notion of solver status: it prints "Success"
+(``CUDACG.cu:365``) whether CG converged or silently hit maxit, and divides
+by p.Ap with no breakdown check (``:311``, SURVEY quirks Q4/Q7).  The new
+framework surfaces these as a typed status carried through the jitted solve
+as a device scalar (an IntEnum value, so it can live inside ``lax.while_loop``
+state and cross ``jit`` boundaries).
+"""
+from __future__ import annotations
+
+import enum
+
+
+class CGStatus(enum.IntEnum):
+    """Outcome of a CG solve (device-scalar friendly int codes)."""
+
+    CONVERGED = 0     # ||r|| dropped below the tolerance
+    MAXITER = 1       # iteration budget exhausted (reference: silent "Success")
+    BREAKDOWN = 2     # non-finite recurrence scalar (e.g. p.Ap == 0 division)
+
+    def describe(self) -> str:
+        return {
+            CGStatus.CONVERGED: "converged",
+            CGStatus.MAXITER: "maximum iterations reached without convergence",
+            CGStatus.BREAKDOWN: "numerical breakdown (non-finite scalar)",
+        }[self]

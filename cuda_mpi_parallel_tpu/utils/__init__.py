@@ -1,0 +1,2 @@
+"""Utilities: timing, logging, checkpointing, configuration (reference: the
+dead ``cpuSecond`` helper at ``CUDACG.cu:35-39`` and nothing else)."""

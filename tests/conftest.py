@@ -1,0 +1,29 @@
+"""Test harness configuration.
+
+Tests run on CPU with 8 virtual XLA devices (SURVEY SS4 "Distributed without
+a cluster"): every psum/ppermute/shard_map path is exercised without TPU
+hardware, and 1-device vs 8-device runs of the same system are compared.
+float64 is enabled so the reference's f64 semantics (``CUDA_R_64F``,
+``CUDACG.cu:216``) can be matched exactly in oracles.
+
+Environment must be set before jax is imported, hence the module-top code.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,118 @@
+"""Operator-layer unit tests: CSR/ELL/dense/stencil construction and SpMV
+against scipy oracles (SURVEY SS4 'Unit' tier)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from cuda_mpi_parallel_tpu import CSRMatrix, DenseOperator, Stencil2D, Stencil3D
+from cuda_mpi_parallel_tpu.models import poisson
+
+
+def random_csr(rng, n=50, density=0.1):
+    m = sp.random(n, n, density=density, random_state=np.random.RandomState(7),
+                  format="csr")
+    m.sort_indices()
+    return m
+
+
+class TestCSR:
+    def test_matvec_matches_scipy(self, rng):
+        m = random_csr(rng)
+        a = CSRMatrix.from_scipy(m)
+        x = rng.standard_normal(m.shape[1])
+        np.testing.assert_allclose(np.asarray(a @ jnp.asarray(x)), m @ x,
+                                   rtol=1e-12)
+
+    def test_matvec_under_jit(self, rng):
+        m = random_csr(rng)
+        a = CSRMatrix.from_scipy(m)
+        x = jnp.asarray(rng.standard_normal(m.shape[1]))
+        eager = a @ x
+        jitted = jax.jit(lambda op, v: op @ v)(a, x)
+        np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager),
+                                   rtol=1e-14)
+
+    def test_from_dense_roundtrip(self, rng):
+        d = rng.standard_normal((12, 12))
+        d[np.abs(d) < 0.8] = 0.0
+        a = CSRMatrix.from_dense(d)
+        np.testing.assert_allclose(np.asarray(a.to_dense()), d)
+
+    def test_diagonal(self, rng):
+        m = random_csr(rng) + sp.eye(50) * 3.0
+        m = m.tocsr()
+        a = CSRMatrix.from_scipy(m)
+        np.testing.assert_allclose(np.asarray(a.diagonal()),
+                                   m.diagonal(), rtol=1e-14)
+
+    def test_oracle_matrix_layout(self):
+        """CSR arrays must match the reference's hardcoded system
+        (CUDACG.cu:94-117): n=3, nnz=5."""
+        a, b, x_expected = poisson.oracle_system()
+        assert a.shape == (3, 3)
+        assert a.nnz == 5
+        np.testing.assert_array_equal(np.asarray(a.indptr), [0, 2, 3, 5])
+        np.testing.assert_array_equal(np.asarray(a.indices), [0, 2, 1, 0, 2])
+        np.testing.assert_allclose(np.asarray(a.data), [3, 2, 2, 2, 1])
+        # A @ x_expected == b (the documented solution, CUDACG.cu:79-82)
+        np.testing.assert_allclose(np.asarray(a @ jnp.asarray(x_expected)),
+                                   np.asarray(b), rtol=1e-15)
+
+
+class TestELL:
+    def test_ell_matches_csr(self, rng):
+        m = random_csr(rng)
+        a = CSRMatrix.from_scipy(m)
+        e = a.to_ell()
+        x = jnp.asarray(rng.standard_normal(m.shape[1]))
+        np.testing.assert_allclose(np.asarray(e @ x), np.asarray(a @ x),
+                                   rtol=1e-12, atol=1e-13)
+
+    def test_ell_width_too_small_raises(self, rng):
+        a = CSRMatrix.from_scipy(random_csr(rng))
+        with pytest.raises(ValueError):
+            a.to_ell(width=1)
+
+    def test_ell_diagonal(self, rng):
+        m = random_csr(rng) + sp.eye(50) * 2.0
+        a = CSRMatrix.from_scipy(m.tocsr())
+        np.testing.assert_allclose(np.asarray(a.to_ell().diagonal()),
+                                   m.tocsr().diagonal(), rtol=1e-14)
+
+
+class TestStencil:
+    def test_2d_matches_assembled(self, rng):
+        nx, ny = 7, 9
+        s = Stencil2D.create(nx, ny, scale=2.5, dtype=jnp.float64)
+        a = poisson.poisson_2d_csr(nx, ny, scale=2.5)
+        x = jnp.asarray(rng.standard_normal(nx * ny))
+        np.testing.assert_allclose(np.asarray(s @ x), np.asarray(a @ x),
+                                   rtol=1e-12, atol=1e-13)
+
+    def test_3d_matches_assembled(self, rng):
+        nx, ny, nz = 5, 4, 6
+        s = Stencil3D.create(nx, ny, nz, dtype=jnp.float64)
+        a = poisson.poisson_3d_csr(nx, ny, nz)
+        x = jnp.asarray(rng.standard_normal(nx * ny * nz))
+        np.testing.assert_allclose(np.asarray(s @ x), np.asarray(a @ x),
+                                   rtol=1e-12, atol=1e-13)
+
+    def test_stencil_diagonal(self):
+        s = Stencil2D.create(4, 4, dtype=jnp.float64)
+        np.testing.assert_allclose(np.asarray(s.diagonal()), np.full(16, 4.0))
+
+    def test_poisson_csr_is_symmetric(self):
+        a = poisson.poisson_2d_csr(6, 5)
+        d = np.asarray(a.to_dense())
+        np.testing.assert_allclose(d, d.T)
+
+
+class TestDense:
+    def test_matvec(self, rng):
+        d = rng.standard_normal((16, 16))
+        a = DenseOperator(a=jnp.asarray(d))
+        x = rng.standard_normal(16)
+        np.testing.assert_allclose(np.asarray(a @ jnp.asarray(x)), d @ x,
+                                   rtol=1e-13)
