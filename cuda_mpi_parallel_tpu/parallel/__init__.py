@@ -1,3 +1,24 @@
 """Distributed execution: mesh construction, row partitioning, psum/ppermute
 collectives - the TPU-native communication backend the reference's repo name
 (MPI) promises but never implements (SURVEY SS5)."""
+
+from .dist_cg import solve_distributed
+from .halo import exchange_halo, neighbor_shift_perms
+from .mesh import ROWS_AXIS, make_mesh, row_sharding, shard_vector
+from .operators import DistCSR, DistStencil2D, DistStencil3D
+from .partition import PartitionedCSR, partition_csr
+
+__all__ = [
+    "ROWS_AXIS",
+    "DistCSR",
+    "DistStencil2D",
+    "DistStencil3D",
+    "PartitionedCSR",
+    "exchange_halo",
+    "make_mesh",
+    "neighbor_shift_perms",
+    "partition_csr",
+    "row_sharding",
+    "shard_vector",
+    "solve_distributed",
+]

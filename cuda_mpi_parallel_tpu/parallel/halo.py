@@ -1,0 +1,55 @@
+"""Halo exchange over the mesh: ``lax.ppermute`` neighbor shifts.
+
+The TPU-native replacement for the neighbor MPI_Sendrecv a row-partitioned
+distributed SpMV needs (absent from the reference, which is single-GPU -
+SURVEY SS2 components #11/#12).  Each device owns a contiguous block of grid
+planes; applying a 5/7-point stencil at the block boundary needs one plane
+from each neighbor.  ``lax.ppermute`` delivers exactly that over ICI, and its
+fill-with-zeros semantics for unmatched sources/destinations implements the
+Dirichlet zero boundary at the global domain edges for free.
+
+The communication schedule is a ring-neighbor shift - structurally the same
+pattern ring attention uses for KV blocks (SURVEY SS5 "long-context"), here
+exchanging stencil halos.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+from jax import lax
+
+
+def neighbor_shift_perms(n_shards: int):
+    """(forward, backward) permutation lists for a 1-D non-periodic chain.
+
+    forward: shard i -> i+1 (so a device *receives* its lower neighbor's
+    boundary); backward: shard i -> i-1.  Edge devices receive zeros.
+    """
+    fwd = [(i, i + 1) for i in range(n_shards - 1)]
+    bwd = [(i, i - 1) for i in range(1, n_shards)]
+    return fwd, bwd
+
+
+def exchange_halo(
+    u: jax.Array, axis_name: str, n_shards: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Exchange boundary slabs of a block partitioned on its leading axis.
+
+    Args:
+      u: local block, shape ``(local_n, ...)``.
+      axis_name: mesh axis the blocks are partitioned over.
+      n_shards: static number of shards along the axis.
+
+    Returns:
+      ``(lo, hi)``: the neighbor-provided halo slabs of shape ``(1, ...)`` -
+      ``lo`` is the previous shard's last plane (zeros on shard 0), ``hi``
+      the next shard's first plane (zeros on the last shard).
+    """
+    if n_shards == 1:
+        zero = jax.numpy.zeros_like(u[:1])
+        return zero, zero
+    fwd, bwd = neighbor_shift_perms(n_shards)
+    lo = lax.ppermute(u[-1:], axis_name, perm=fwd)
+    hi = lax.ppermute(u[:1], axis_name, perm=bwd)
+    return lo, hi

@@ -1,0 +1,92 @@
+"""Row partitioning of linear systems across the mesh.
+
+Splits a global CSR system into per-shard row blocks, padded to identical
+local shapes (XLA needs static, uniform shapes per device - unlike MPI ranks,
+which may hold ragged partitions).  Padding rows carry a unit diagonal and a
+zero right-hand side, so the padded system is still SPD, the padded solution
+components stay exactly zero, and Jacobi preconditioning never divides by a
+zero diagonal.
+
+All of this runs host-side in numpy, once, before the solve - layout work is
+setup cost, exactly like the reference's H2D staging (``CUDACG.cu:119-186``),
+not per-iteration work.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+from ..models.operators import CSRMatrix
+
+
+class PartitionedCSR(NamedTuple):
+    """Stacked per-shard CSR blocks (leading axis = shard index).
+
+    ``data``/``cols``/``local_rows`` have shape ``(n_shards, max_local_nnz)``;
+    padding entries have ``data == 0`` and in-range indices.  ``cols`` are
+    *global* column ids (the distributed matvec gathers from an all-gathered
+    x); ``local_rows`` are local row ids in ``[0, n_local)``.
+    """
+
+    data: np.ndarray
+    cols: np.ndarray
+    local_rows: np.ndarray
+    n_local: int
+    n_global_padded: int
+    n_global: int
+    n_shards: int
+
+
+def padded_size(n: int, n_shards: int) -> int:
+    return ((n + n_shards - 1) // n_shards) * n_shards
+
+
+def partition_csr(a: CSRMatrix, n_shards: int) -> PartitionedCSR:
+    """Split a global CSR matrix into ``n_shards`` row blocks."""
+    n = a.shape[0]
+    n_pad = padded_size(n, n_shards)
+    n_local = n_pad // n_shards
+
+    data = np.asarray(a.data)
+    indices = np.asarray(a.indices)
+    indptr = np.asarray(a.indptr).astype(np.int64)
+
+    # Entries per shard; padding rows contribute their unit diagonal.
+    counts = np.empty(n_shards, dtype=np.int64)
+    for s in range(n_shards):
+        lo, hi = s * n_local, min((s + 1) * n_local, n)
+        pad_rows = n_local - max(0, hi - lo)
+        counts[s] = (indptr[hi] - indptr[lo] if hi > lo else 0) + pad_rows
+    m = int(counts.max())
+
+    out_data = np.zeros((n_shards, m), dtype=data.dtype)
+    out_cols = np.zeros((n_shards, m), dtype=np.int32)
+    out_rows = np.zeros((n_shards, m), dtype=np.int32)
+    entry_rows = np.repeat(np.arange(n), np.diff(indptr))
+    for s in range(n_shards):
+        lo, hi = s * n_local, min((s + 1) * n_local, n)
+        k = 0
+        if hi > lo:
+            e0, e1 = indptr[lo], indptr[hi]
+            k = int(e1 - e0)
+            out_data[s, :k] = data[e0:e1]
+            out_cols[s, :k] = indices[e0:e1]
+            out_rows[s, :k] = entry_rows[e0:e1] - lo
+        # Unit-diagonal padding rows (keep the padded system SPD).
+        for r in range(max(hi, lo), (s + 1) * n_local):
+            out_data[s, k] = 1.0
+            out_cols[s, k] = r  # global id of the padding row
+            out_rows[s, k] = r - lo
+            k += 1
+    return PartitionedCSR(
+        data=out_data, cols=out_cols, local_rows=out_rows,
+        n_local=n_local, n_global_padded=n_pad, n_global=n,
+        n_shards=n_shards,
+    )
+
+
+def pad_vector(b: np.ndarray, n_padded: int) -> np.ndarray:
+    out = np.zeros(n_padded, dtype=b.dtype)
+    out[: b.shape[0]] = b
+    return out

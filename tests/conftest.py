@@ -18,6 +18,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import jax  # noqa: E402
 
+# sitecustomize may have imported jax (capturing JAX_PLATFORMS=axon) before
+# this conftest ran; the config update still wins as long as no backend has
+# been initialized yet.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

@@ -1,0 +1,161 @@
+"""Distributed-path tests on 8 virtual CPU devices (SURVEY SS4 'Distributed
+without a cluster'): psum dots, ppermute halo exchange, shard_map CG.
+
+The load-bearing property: an N-device run is the *same algorithm* as the
+1-device run - trajectories (iteration counts, residuals, solutions) must
+match to rounding.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from cuda_mpi_parallel_tpu import solve
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.models.operators import Stencil2D, Stencil3D
+from cuda_mpi_parallel_tpu.parallel import (
+    DistStencil3D,
+    exchange_halo,
+    make_mesh,
+    partition_csr,
+    solve_distributed,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+
+class TestHalo:
+    def test_exchange_matches_neighbor_planes(self):
+        mesh = make_mesh(8)
+        n_per = 4
+        u = jnp.arange(8 * n_per * 3, dtype=jnp.float64).reshape(8 * n_per, 3)
+
+        def body(u_local):
+            lo, hi = exchange_halo(u_local, "rows", 8)
+            return lo, hi
+
+        lo, hi = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P("rows"),
+            out_specs=(P("rows"), P("rows"))))(u)
+        lo = np.asarray(lo).reshape(8, 3)
+        hi = np.asarray(hi).reshape(8, 3)
+        un = np.asarray(u).reshape(8, n_per, 3)
+        # shard 0 has no lower neighbor -> zeros (Dirichlet for free)
+        np.testing.assert_array_equal(lo[0], np.zeros(3))
+        np.testing.assert_array_equal(hi[7], np.zeros(3))
+        for s in range(1, 8):
+            np.testing.assert_array_equal(lo[s], un[s - 1, -1])
+        for s in range(7):
+            np.testing.assert_array_equal(hi[s], un[s + 1, 0])
+
+
+class TestDistStencilSpMV:
+    def test_3d_sharded_matvec_equals_global(self):
+        """Property: sharded SpMV == unsharded SpMV (SURVEY SS4)."""
+        nx, ny, nz = 16, 5, 7
+        mesh = make_mesh(8)
+        op_global = Stencil3D.create(nx, ny, nz, scale=1.7, dtype=jnp.float64)
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(nx * ny * nz))
+        want = op_global @ x
+
+        local = DistStencil3D.create((nx, ny, nz), 8, scale=1.7,
+                                     dtype=jnp.float64)
+        got = jax.jit(jax.shard_map(
+            lambda v: local @ v, mesh=mesh, in_specs=P("rows"),
+            out_specs=P("rows")))(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_2d_solve_matches_single_device(self):
+        nx = ny = 16
+        a = Stencil2D.create(nx, ny, dtype=jnp.float64)
+        b = jnp.asarray(np.random.default_rng(1).standard_normal(nx * ny))
+        single = solve(a, b, tol=1e-10, maxiter=600)
+        dist = solve_distributed(a, b, mesh=make_mesh(8), tol=1e-10,
+                                 maxiter=600)
+        assert bool(dist.converged)
+        assert int(dist.iterations) == int(single.iterations)
+        np.testing.assert_allclose(np.asarray(dist.x), np.asarray(single.x),
+                                   atol=1e-8)
+
+    def test_3d_solve_converges(self):
+        a = Stencil3D.create(16, 6, 6, dtype=jnp.float64)
+        x_true = np.random.default_rng(2).standard_normal(16 * 36)
+        b = a @ jnp.asarray(x_true)
+        res = solve_distributed(a, b, mesh=make_mesh(8), tol=1e-9,
+                                maxiter=600)
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x), x_true, atol=1e-6)
+
+    def test_short_rhs_raises(self):
+        """A wrong-length b must be rejected, not silently zero-padded."""
+        a = poisson.poisson_2d_csr(6, 7)  # n=42
+        with pytest.raises(ValueError, match="does not match"):
+            solve_distributed(a, jnp.ones(30), mesh=make_mesh(8))
+
+    def test_indivisible_grid_raises(self):
+        a = Stencil2D.create(10, 10, dtype=jnp.float64)
+        with pytest.raises(ValueError, match="not divisible"):
+            solve_distributed(a, jnp.ones(100), mesh=make_mesh(8))
+
+
+class TestDistCSR:
+    def test_partition_reassembles(self):
+        a = poisson.poisson_2d_csr(6, 7)  # n=42, not divisible by 8
+        parts = partition_csr(a, 8)
+        assert parts.n_global == 42
+        assert parts.n_global_padded == 48
+        dense = np.zeros((48, 48))
+        for s in range(8):
+            for e in range(parts.data.shape[1]):
+                r = parts.local_rows[s, e] + s * parts.n_local
+                dense[r, parts.cols[s, e]] += parts.data[s, e]
+        want = np.zeros((48, 48))
+        want[:42, :42] = np.asarray(a.to_dense())
+        want[42:, 42:] = np.eye(6)  # unit-diagonal padding rows
+        np.testing.assert_allclose(dense, want)
+
+    def test_csr_solve_matches_single_device(self):
+        a = poisson.poisson_2d_csr(9, 6)  # n=54, padded to 56
+        b = jnp.asarray(np.random.default_rng(3).standard_normal(54))
+        single = solve(a, b, tol=1e-10, maxiter=400)
+        dist = solve_distributed(a, b, mesh=make_mesh(8), tol=1e-10,
+                                 maxiter=400)
+        assert bool(dist.converged)
+        assert dist.x.shape == (54,)
+        np.testing.assert_allclose(np.asarray(dist.x), np.asarray(single.x),
+                                   atol=1e-8)
+        assert abs(int(dist.iterations) - int(single.iterations)) <= 1
+
+    def test_csr_jacobi_distributed(self):
+        a = poisson.poisson_2d_csr(8, 8)
+        b = jnp.asarray(np.random.default_rng(4).standard_normal(64))
+        dist = solve_distributed(a, b, mesh=make_mesh(8), tol=1e-10,
+                                 maxiter=400, preconditioner="jacobi")
+        assert bool(dist.converged)
+        np.testing.assert_allclose(
+            np.asarray(a @ dist.x), np.asarray(b), atol=1e-8)
+
+    def test_oracle_distributed(self):
+        """The 3x3 reference system, row-partitioned over 8 devices (5 of
+        which hold only padding rows) - must still converge to the
+        documented solution."""
+        a, b, x_expected = poisson.oracle_system()
+        res = solve_distributed(a, b, mesh=make_mesh(8), tol=1e-7,
+                                maxiter=2000)
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x), x_expected, atol=1e-8)
+
+
+class TestMeshSizes:
+    @pytest.mark.parametrize("ndev", [1, 2, 4, 8])
+    def test_solution_invariant_across_mesh_sizes(self, ndev):
+        a = Stencil2D.create(16, 12, dtype=jnp.float64)
+        b = jnp.asarray(np.random.default_rng(5).standard_normal(192))
+        res = solve_distributed(a, b, mesh=make_mesh(ndev), tol=1e-10,
+                                maxiter=500)
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(a @ res.x), np.asarray(b),
+                                   atol=1e-8)
