@@ -1,0 +1,149 @@
+"""Benchmark harness - prints ONE JSON line with the headline metric.
+
+Headline (BASELINE.json north star family): steady-state CG iterations/sec
+on the 2D 5-point Poisson system with N ~ 1M unknowns (config #2), run
+matrix-free in float32 on the default device.  The solve is one jitted
+``lax.while_loop``: zero host round-trips per iteration, versus the
+reference's 8 launches + 2 blocking D2H syncs + 1 cudaMalloc per iteration
+(``CUDACG.cu:269-352``).
+
+The reference publishes no numbers (SURVEY SS6), so ``vs_baseline`` is
+measured against BASELINE.md's stand-in: an estimated 5000 CG iters/sec for
+the reference's host-synchronous loop on an A100-class part at this problem
+size (~100us/iter memory-bound library work + ~100us/iter launch/sync
+overhead).  The north-star target is vs_baseline >= 1.5.
+
+Usage::
+
+    python bench.py            # headline metric, one JSON line
+    python bench.py --all      # all BASELINE configs -> bench_results.json,
+                               # headline line still printed last
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# Estimated reference throughput (see module docstring); the reference
+# itself publishes no numbers (SURVEY SS6, BASELINE.md).
+BASELINE_ITERS_PER_SEC = 5000.0
+
+HEADLINE_GRID = 1024          # 1024x1024 -> N = 1,048,576 unknowns
+ITERS_LO, ITERS_HI = 100, 2100
+
+
+def bench_headline(device=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cuda_mpi_parallel_tpu import solve
+    from cuda_mpi_parallel_tpu.models import poisson
+    from cuda_mpi_parallel_tpu.utils.timing import time_fn
+
+    n = HEADLINE_GRID
+    op = poisson.poisson_2d_operator(n, n, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    b = jnp.asarray(rng.standard_normal(n * n).astype(np.float32))
+
+    # tol=0 forces exactly maxiter iterations.  Per-iteration throughput is
+    # measured as a delta between two iteration counts, cancelling the fixed
+    # per-call dispatch overhead (substantial on tunneled devices).
+    def run(it):
+        return jax.jit(lambda v: solve(op, v, tol=0.0, maxiter=it).x)
+
+    f_lo, f_hi = run(ITERS_LO), run(ITERS_HI)
+    t_lo, _ = time_fn(f_lo, b, warmup=1, repeats=5, reduce="median")
+    t_hi, _ = time_fn(f_hi, b, warmup=1, repeats=5, reduce="median")
+    value = (ITERS_HI - ITERS_LO) / max(t_hi - t_lo, 1e-9)
+    return {
+        "metric": "cg_iters_per_sec_poisson2d_1M_f32",
+        "value": round(value, 1),
+        "unit": "iters/s",
+        "vs_baseline": round(value / BASELINE_ITERS_PER_SEC, 3),
+    }
+
+
+def bench_all():
+    """All five BASELINE.json configs (side data for BENCH records)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cuda_mpi_parallel_tpu import solve
+    from cuda_mpi_parallel_tpu.models import poisson, random_spd
+    from cuda_mpi_parallel_tpu.parallel import make_mesh, solve_distributed
+    from cuda_mpi_parallel_tpu.utils.timing import time_fn
+
+    results = {}
+    rng = np.random.default_rng(0)
+
+    # 1: dense CG, 1024x1024 random SPD
+    op = random_spd.random_spd_dense(1024, cond=100.0, dtype=np.float32)
+    b = jnp.asarray(rng.standard_normal(1024).astype(np.float32))
+    el, res = time_fn(lambda: solve(op, b, tol=0.0, maxiter=200),
+                      warmup=1, repeats=3)
+    results["dense_spd_1024"] = {"iters_per_sec": 200 / el,
+                                 "elapsed_s": el}
+
+    # 2: sparse 2D Poisson N=1M (the headline, matrix-free) + CSR variant
+    results["poisson2d_1M_stencil"] = bench_headline()
+    n = HEADLINE_GRID
+    a_csr = poisson.poisson_2d_csr(n, n, dtype=np.float32)
+    b2 = jnp.asarray(rng.standard_normal(n * n).astype(np.float32))
+    el, res = time_fn(lambda: solve(a_csr, b2, tol=0.0, maxiter=100),
+                      warmup=1, repeats=2)
+    results["poisson2d_1M_csr"] = {"iters_per_sec": 100 / el, "elapsed_s": el}
+
+    # 3: Jacobi-PCG on 2D Poisson: time-to-tolerance
+    from cuda_mpi_parallel_tpu.models.operators import JacobiPreconditioner
+    op2 = poisson.poisson_2d_operator(512, 512, dtype=jnp.float32)
+    x_true = rng.standard_normal(512 * 512).astype(np.float32)
+    b3 = op2 @ jnp.asarray(x_true)
+    m = JacobiPreconditioner.from_operator(op2)
+    el, res = time_fn(
+        lambda: solve(op2, b3, tol=0.0, rtol=1e-6, maxiter=3000, m=m),
+        warmup=1, repeats=2)
+    results["poisson2d_jacobi_rtol1e-6"] = {
+        "time_to_tol_s": el, "iterations": int(res.iterations),
+        "converged": bool(res.converged)}
+
+    # 4: distributed 3D Poisson over all local devices (N scaled to fit)
+    ndev = len(jax.devices())
+    grid = (64 * ndev if 64 * ndev <= 256 else 256, 128, 128)
+    if grid[0] % ndev == 0:
+        from cuda_mpi_parallel_tpu.models.operators import Stencil3D
+        a3 = Stencil3D.create(*grid, dtype=jnp.float32)
+        b4 = jnp.asarray(
+            rng.standard_normal(a3.shape[0]).astype(np.float32))
+        mesh = make_mesh(ndev)
+        el, res = time_fn(
+            lambda: solve_distributed(a3, b4, mesh=mesh, tol=0.0,
+                                      maxiter=100),
+            warmup=1, repeats=2)
+        results[f"poisson3d_{grid[0]}x{grid[1]}x{grid[2]}_mesh{ndev}"] = {
+            "iters_per_sec": 100 / el, "elapsed_s": el, "n_devices": ndev}
+
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--all", action="store_true",
+                    help="run every BASELINE config, write bench_results.json")
+    args = ap.parse_args(argv)
+
+    if args.all:
+        results = bench_all()
+        with open("bench_results.json", "w") as f:
+            json.dump(results, f, indent=2)
+        headline = results["poisson2d_1M_stencil"]
+    else:
+        headline = bench_headline()
+    print(json.dumps(headline))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,201 @@
+"""Command-line driver.
+
+The reference's ``main()`` takes no arguments: problem, tolerance, maxit and
+device id are all hardcoded (``CUDACG.cu:87,244-245``, SURVEY SS5 "Config").
+This CLI exposes them all - ``--problem/--n/--tol/--maxiter/--precond/
+--mesh/--device/--dtype`` per the north star - and reports what the
+reference never does (iterations, residual, timing, optional history).
+
+Examples::
+
+    python -m cuda_mpi_parallel_tpu.cli --problem oracle
+    python -m cuda_mpi_parallel_tpu.cli --problem poisson2d --n 1024 \
+        --dtype float32 --tol 1e-5 --history
+    python -m cuda_mpi_parallel_tpu.cli --problem poisson3d --n 64 --mesh 4
+    python -m cuda_mpi_parallel_tpu.cli --problem mm --file thermal2.mtx \
+        --precond jacobi --json
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cuda_mpi_parallel_tpu",
+        description="TPU-native conjugate-gradient solver framework")
+    p.add_argument("--problem", default="oracle",
+                   choices=["oracle", "poisson2d", "poisson3d", "random-spd",
+                            "random-sparse", "mm"],
+                   help="problem family (oracle = the reference's hardcoded "
+                        "3x3 system, CUDACG.cu:74-117)")
+    p.add_argument("--n", type=int, default=64,
+                   help="grid extent per axis (poisson*) or matrix size "
+                        "(random-*)")
+    p.add_argument("--file", default=None,
+                   help="Matrix Market path (--problem mm)")
+    p.add_argument("--tol", type=float, default=1e-7,
+                   help="absolute ||r|| tolerance (reference default 1e-7, "
+                        "CUDACG.cu:245)")
+    p.add_argument("--rtol", type=float, default=0.0,
+                   help="relative tolerance (0 = reference-style absolute "
+                        "only)")
+    p.add_argument("--maxiter", type=int, default=2000,
+                   help="iteration cap (reference default 2000, "
+                        "CUDACG.cu:244)")
+    p.add_argument("--precond", default=None, choices=[None, "jacobi"],
+                   help="preconditioner")
+    p.add_argument("--mesh", type=int, default=1,
+                   help="number of devices for row-partitioned execution "
+                        "(1 = single device)")
+    p.add_argument("--device", default=None,
+                   choices=[None, "tpu", "cpu"],
+                   help="force a JAX platform (default: auto)")
+    p.add_argument("--dtype", default="float64",
+                   choices=["float32", "float64", "bfloat16"],
+                   help="solve dtype (float64 needs x64 mode; TPUs prefer "
+                        "float32)")
+    p.add_argument("--matrix-free", action="store_true",
+                   help="use the matrix-free stencil operator for poisson* "
+                        "(default: assembled CSR)")
+    p.add_argument("--history", action="store_true",
+                   help="print per-iteration residual trace")
+    p.add_argument("--json", action="store_true",
+                   help="emit a single JSON record instead of text")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace to DIR")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def _configure_backend(args) -> None:
+    import jax
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elif args.device == "tpu":
+        pass  # default platform on TPU hosts
+    if args.dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+
+
+def _build_problem(args):
+    """Returns (operator, b, x_expected_or_None, description)."""
+    import jax.numpy as jnp
+
+    from .models import mmio, poisson, random_spd
+
+    dtype = jnp.dtype(args.dtype)
+    rng = np.random.default_rng(args.seed)
+    if args.problem == "oracle":
+        a, b, x_exp = poisson.oracle_system(dtype=dtype)
+        return a, b, x_exp, "reference 3x3 system (CUDACG.cu:74-117)"
+    if args.problem == "poisson2d":
+        n = args.n
+        if args.matrix_free:
+            a = poisson.poisson_2d_operator(n, n, dtype=dtype)
+        else:
+            a = poisson.poisson_2d_csr(n, n, dtype=dtype)
+        x_true = rng.standard_normal(n * n).astype(dtype)
+        return a, a @ jnp.asarray(x_true), x_true, f"2D Poisson {n}x{n}"
+    if args.problem == "poisson3d":
+        n = args.n
+        if args.matrix_free:
+            a = poisson.poisson_3d_operator(n, n, n, dtype=dtype)
+        else:
+            a = poisson.poisson_3d_csr(n, n, n, dtype=dtype)
+        x_true = rng.standard_normal(n ** 3).astype(dtype)
+        return a, a @ jnp.asarray(x_true), x_true, f"3D Poisson {n}^3"
+    if args.problem == "random-spd":
+        a = random_spd.random_spd_dense(args.n, seed=args.seed, dtype=dtype)
+        b = rng.standard_normal(args.n).astype(dtype)
+        return a, jnp.asarray(b), None, f"dense random SPD n={args.n}"
+    if args.problem == "random-sparse":
+        a = random_spd.random_spd_sparse(args.n, seed=args.seed, dtype=dtype)
+        b = rng.standard_normal(args.n).astype(dtype)
+        return a, jnp.asarray(b), None, f"sparse random SPD n={args.n}"
+    if args.problem == "mm":
+        if not args.file:
+            raise SystemExit("--problem mm requires --file")
+        a = mmio.load_matrix_market(args.file, dtype=dtype)
+        b = rng.standard_normal(a.shape[0]).astype(dtype)
+        return a, jnp.asarray(b), None, f"MatrixMarket {args.file}"
+    raise AssertionError(args.problem)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    _configure_backend(args)
+
+    import jax
+
+    from .utils import logging as ulog
+    from .utils.timing import profile_trace, time_fn
+
+    a, b, x_expected, desc = _build_problem(args)
+
+    def run():
+        if args.mesh > 1:
+            from .parallel import make_mesh, solve_distributed
+            from .models.operators import CSRMatrix, Stencil2D, Stencil3D
+
+            if not isinstance(a, (CSRMatrix, Stencil2D, Stencil3D)):
+                raise SystemExit(
+                    "--mesh > 1 supports CSR and stencil problems only")
+            return solve_distributed(
+                a, b, mesh=make_mesh(args.mesh), tol=args.tol,
+                rtol=args.rtol, maxiter=args.maxiter,
+                preconditioner=args.precond,
+                record_history=args.history)
+        from . import solve
+        from .models.operators import JacobiPreconditioner
+
+        m = (JacobiPreconditioner.from_operator(a)
+             if args.precond == "jacobi" else None)
+        return solve(a, b, tol=args.tol, rtol=args.rtol,
+                     maxiter=args.maxiter, m=m,
+                     record_history=args.history)
+
+    with profile_trace(args.profile):
+        elapsed, result = time_fn(run, warmup=1, repeats=1)
+
+    record = ulog.solve_record(
+        result, elapsed_s=elapsed, problem=desc, n=int(a.shape[0]),
+        dtype=args.dtype, mesh=args.mesh,
+        device=jax.devices()[0].platform,
+        precond=args.precond or "none")
+    if x_expected is not None:
+        err = float(np.max(np.abs(np.asarray(result.x)
+                                  - np.asarray(x_expected))))
+        record["max_abs_error"] = err
+
+    if args.json:
+        ulog.emit_json(record)
+    else:
+        print(f"problem : {desc}")
+        print(f"device  : {record['device']} (mesh={args.mesh}), "
+              f"dtype={args.dtype}")
+        print(f"status  : {record['status']} "
+              f"({result.status_enum().describe()})")
+        print(f"iters   : {record['iterations']}")
+        print(f"||r||   : {record['residual_norm']:.6e}")
+        print(f"time    : {elapsed * 1e3:.3f} ms "
+              f"({record['iters_per_sec']:.1f} iters/s)")
+        if "max_abs_error" in record:
+            print(f"max err : {record['max_abs_error']:.3e}")
+        # The reference prints the full solution vector (CUDACG.cu:361-364);
+        # keep that behavior for small systems.
+        if a.shape[0] <= 10:
+            for v in np.asarray(result.x):
+                print(f"{v:f}")
+        if args.history:
+            print(ulog.format_history(
+                result, every=max(1, int(result.iterations) // 20)))
+    return 0 if bool(result.converged) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

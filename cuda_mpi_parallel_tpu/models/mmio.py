@@ -1,0 +1,53 @@
+"""Matrix Market I/O (the SuiteSparse path, BASELINE config #5).
+
+The reference's only 'data loader' is 50 lines of hardcoded array literals
+(``CUDACG.cu:94-117``).  Real workloads come as Matrix Market files
+(thermal2, G3_circuit, parabolic_fem...); this module loads them into
+``CSRMatrix`` via scipy's parser, with an optional native C++ fast path for
+multi-GB files (``native/``), and validates SPD-relevant structure.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .operators import CSRMatrix
+
+
+def load_matrix_market(path: str, dtype=np.float64,
+                       check_symmetric: bool = True) -> CSRMatrix:
+    """Load a Matrix Market file as CSR.
+
+    Symmetric-stored files are expanded to full storage (CG's SpMV wants
+    the whole row).  ``check_symmetric`` verifies structural symmetry on
+    general-stored files and raises on asymmetric input, because CG
+    silently diverges on nonsymmetric systems (the reference would too -
+    it never checks, quirk Q4).
+    """
+    import scipy.io
+    import scipy.sparse as sp
+
+    m = scipy.io.mmread(path)
+    if not sp.issparse(m):
+        m = sp.csr_matrix(m)
+    m = m.tocsr()
+    if m.shape[0] != m.shape[1]:
+        raise ValueError(f"matrix is not square: {m.shape}")
+    if check_symmetric:
+        diff = abs(m - m.T)
+        if diff.nnz and diff.max() > 1e-10 * max(abs(m).max(), 1.0):
+            raise ValueError(
+                "matrix is not symmetric; CG requires a symmetric operator")
+    m.sort_indices()
+    return CSRMatrix.from_arrays(m.data.astype(np.dtype(dtype)),
+                                 m.indices.astype(np.int32),
+                                 m.indptr.astype(np.int32), m.shape)
+
+
+def save_matrix_market(path: str, a: CSRMatrix) -> None:
+    import scipy.io
+    import scipy.sparse as sp
+
+    m = sp.csr_matrix(
+        (np.asarray(a.data), np.asarray(a.indices), np.asarray(a.indptr)),
+        shape=a.shape)
+    scipy.io.mmwrite(path, m)

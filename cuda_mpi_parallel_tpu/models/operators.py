@@ -15,7 +15,7 @@ Operator taxonomy (all expose ``matvec``/``__matmul__``/``diagonal``):
 * ``CSRMatrix``        - general sparsity, gather + segment-sum (the layout
   of the reference's hardcoded system, ``CUDACG.cu:94-117``).
 * ``ELLMatrix``        - padded rectangular layout, the TPU-preferred device
-  format; consumed by the Pallas kernel.
+  format.
 * ``Stencil2D/3D``     - matrix-free 5-point / 7-point Poisson application:
   on TPU the idiomatic way to apply a stencil is shifted adds on the grid,
   not a sparse gather (BASELINE configs #2 and #4).

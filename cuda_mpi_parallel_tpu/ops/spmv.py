@@ -12,9 +12,7 @@ O(nnz) work to an opaque vendor kernel over CSR, we provide:
 * ``ell_matvec``  - SpMV over a padded ELL layout ``(n_rows, k)``.  TPU vector
   units want dense (8, 128) tiles; ELL turns the ragged CSR gather into a
   rectangular gather + row-sum that XLA can tile onto the VPU.  This is the
-  preferred device layout (the Pallas kernel in ``ops/pallas`` consumes it).
-* ``bell_matvec`` - blocked-ELL: rows grouped into blocks sharing a column
-  structure, trading padding for locality.
+  preferred device layout for irregular sparsity.
 
 All functions are shape-polymorphic in the Python sense but trace to static
 shapes under ``jit`` (no data-dependent shapes - an XLA requirement the

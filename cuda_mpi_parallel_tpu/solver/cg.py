@@ -259,6 +259,8 @@ def solve(
     if not isinstance(a, LinearOperator):
         a = _as_operator(a)
     b = jnp.asarray(b)
+    if not jnp.issubdtype(b.dtype, jnp.floating):
+        b = b.astype(jnp.result_type(float))
     tol_a = jnp.asarray(tol, b.dtype)
     rtol_a = jnp.asarray(rtol, b.dtype)
     return _solve_jit(a, b, x0, tol_a, rtol_a, maxiter, m, record_history,

@@ -1,0 +1,64 @@
+"""Structured logging for solves.
+
+The reference's entire observability story is ``printf`` of the solution
+vector plus error strings in ``CLEANUP`` calls - no residual history, no
+iteration count, no timing (``CUDACG.cu:361-365``, SURVEY quirk Q7).  Here
+every solve can be summarized as a structured record, and convergence
+histories print as compact traces.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+LOGGER_NAME = "cuda_mpi_parallel_tpu"
+
+
+def get_logger(level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(LOGGER_NAME)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(level)
+    return logger
+
+
+def solve_record(result, elapsed_s: Optional[float] = None,
+                 **extra: Any) -> Dict[str, Any]:
+    """Flatten a CGResult into a JSON-serializable record."""
+    rec: Dict[str, Any] = {
+        "iterations": int(result.iterations),
+        "residual_norm": float(result.residual_norm),
+        "converged": bool(result.converged),
+        "status": result.status_enum().name,
+        "indefinite": bool(result.indefinite),
+    }
+    if elapsed_s is not None:
+        rec["elapsed_s"] = elapsed_s
+        iters = max(int(result.iterations), 1)
+        rec["iters_per_sec"] = iters / elapsed_s
+    rec.update(extra)
+    return rec
+
+
+def format_history(result, every: int = 1) -> str:
+    """Compact per-iteration residual trace (absent from the reference)."""
+    if result.residual_history is None:
+        return "(history not recorded)"
+    hist = np.asarray(result.residual_history)
+    k = int(result.iterations)
+    lines = [f"  iter {i:5d}  ||r|| = {hist[i]:.6e}"
+             for i in range(0, k + 1, every)]
+    return "\n".join(lines)
+
+
+def emit_json(record: Dict[str, Any], stream=None) -> None:
+    stream = sys.stdout if stream is None else stream
+    stream.write(json.dumps(record) + "\n")
+    stream.flush()

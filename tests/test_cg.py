@@ -195,6 +195,17 @@ class TestRobustness:
         assert int(res.iterations) == 0
         np.testing.assert_array_equal(np.asarray(res.x), np.zeros(25))
 
+    def test_int_rhs_keeps_tolerance(self):
+        """Integer b must not zero out the tolerance via dtype casting:
+        the oracle still converges in 3 iterations for a float-equivalent
+        rhs."""
+        a, b, _ = poisson.oracle_system()
+        res_f = solve(a, b * 2)
+        res_i = solve(a, jnp.asarray([7, 3, 4]))  # 2*b as ints
+        assert int(res_i.iterations) == int(res_f.iterations)
+        np.testing.assert_allclose(np.asarray(res_i.x), np.asarray(res_f.x),
+                                   atol=1e-10)
+
     def test_float32(self):
         """TPU-default dtype path: f32 solve with looser tolerance."""
         a = poisson.poisson_2d_csr(8, 8, dtype=np.float32)

@@ -1,0 +1,126 @@
+"""CLI, Matrix Market I/O, and utility-layer tests."""
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import cli, solve
+from cuda_mpi_parallel_tpu.models import mmio, poisson
+from cuda_mpi_parallel_tpu.utils import logging as ulog
+from cuda_mpi_parallel_tpu.utils.timing import Timer, time_fn
+
+
+class TestCLI:
+    def test_oracle_text(self, capsys):
+        rc = cli.main(["--problem", "oracle", "--device", "cpu"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "CONVERGED" in out
+        # reference prints the solution vector (CUDACG.cu:361-364)
+        assert "0.500000" in out and "0.750000" in out and "1.000000" in out
+
+    def test_poisson2d_json(self, capsys):
+        rc = cli.main(["--problem", "poisson2d", "--n", "12", "--device",
+                       "cpu", "--tol", "1e-9", "--json"])
+        rec = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert rec["converged"] is True
+        assert rec["n"] == 144
+        assert rec["max_abs_error"] < 1e-6
+
+    def test_jacobi_flag(self, capsys):
+        rc = cli.main(["--problem", "poisson2d", "--n", "10", "--device",
+                       "cpu", "--precond", "jacobi", "--json"])
+        rec = json.loads(capsys.readouterr().out)
+        assert rc == 0 and rec["precond"] == "jacobi"
+
+    def test_mesh_flag_distributed(self, capsys):
+        rc = cli.main(["--problem", "poisson2d", "--n", "16", "--device",
+                       "cpu", "--mesh", "8", "--matrix-free", "--tol",
+                       "1e-8", "--json"])
+        rec = json.loads(capsys.readouterr().out)
+        assert rc == 0 and rec["mesh"] == 8 and rec["converged"]
+
+    def test_history_flag(self, capsys):
+        rc = cli.main(["--problem", "oracle", "--device", "cpu",
+                       "--history"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "||r||" in out
+
+    def test_nonconverged_exit_code(self, capsys):
+        rc = cli.main(["--problem", "poisson2d", "--n", "16", "--device",
+                       "cpu", "--maxiter", "2", "--json"])
+        rec = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert rec["status"] == "MAXITER"
+
+    def test_mm_requires_file(self):
+        with pytest.raises(SystemExit):
+            cli.main(["--problem", "mm", "--device", "cpu"])
+
+
+class TestMMIO:
+    def test_roundtrip(self, tmp_path):
+        a = poisson.poisson_2d_csr(6, 6)
+        path = str(tmp_path / "m.mtx")
+        mmio.save_matrix_market(path, a)
+        a2 = mmio.load_matrix_market(path)
+        np.testing.assert_allclose(np.asarray(a2.to_dense()),
+                                   np.asarray(a.to_dense()), rtol=1e-12)
+
+    def test_solve_loaded_matrix(self, tmp_path):
+        a = poisson.poisson_2d_csr(8, 8)
+        path = str(tmp_path / "p.mtx")
+        mmio.save_matrix_market(path, a)
+        a2 = mmio.load_matrix_market(path)
+        b = jnp.asarray(np.random.default_rng(0).standard_normal(64))
+        res = solve(a2, b, tol=1e-9, maxiter=300)
+        assert bool(res.converged)
+
+    def test_rejects_nonsymmetric(self, tmp_path):
+        import scipy.io
+        import scipy.sparse as sp
+
+        m = sp.csr_matrix(np.triu(np.ones((4, 4))))
+        path = str(tmp_path / "ns.mtx")
+        scipy.io.mmwrite(path, m)
+        with pytest.raises(ValueError, match="not symmetric"):
+            mmio.load_matrix_market(path)
+
+    def test_rejects_rectangular(self, tmp_path):
+        import scipy.io
+        import scipy.sparse as sp
+
+        m = sp.csr_matrix(np.ones((3, 5)))
+        path = str(tmp_path / "rect.mtx")
+        scipy.io.mmwrite(path, m)
+        with pytest.raises(ValueError, match="not square"):
+            mmio.load_matrix_market(path)
+
+
+class TestUtils:
+    def test_time_fn_returns_result(self):
+        a, b, _ = poisson.oracle_system()
+        el, res = time_fn(lambda: solve(a, b), warmup=1, repeats=2)
+        assert el > 0
+        assert bool(res.converged)
+
+    def test_timer_sections(self):
+        t = Timer()
+        with t.section("a"):
+            pass
+        with t.section("b"):
+            pass
+        assert [name for name, _ in t.sections] == ["a", "b"]
+        assert "a" in t.report()
+
+    def test_solve_record(self):
+        a, b, _ = poisson.oracle_system()
+        res = solve(a, b, record_history=True)
+        rec = ulog.solve_record(res, elapsed_s=0.5, problem="oracle")
+        assert rec["iterations"] == 3
+        assert rec["status"] == "CONVERGED"
+        assert rec["iters_per_sec"] == pytest.approx(6.0)
+        assert "iter " in ulog.format_history(res)
