@@ -109,6 +109,25 @@ def bench_all():
         "time_to_tol_s": el, "iterations": int(res.iterations),
         "converged": bool(res.converged)}
 
+    # 3b: HBM-bound regime (4096^2 = 16.8M unknowns, ~4x VMEM): pallas
+    # slab-DMA kernel vs XLA fused stencil, full CG iteration cost.
+    from cuda_mpi_parallel_tpu.models.operators import Stencil2D
+    b_b = jnp.asarray(rng.standard_normal(4096 * 4096).astype(np.float32))
+    for backend in ("xla", "pallas"):
+        try:
+            a_b = Stencil2D.create(4096, 4096, dtype=jnp.float32,
+                                   backend=backend)
+        except ValueError:
+            continue
+        el_lo, _ = time_fn(
+            lambda a_b=a_b, b_b=b_b: solve(a_b, b_b, tol=0.0, maxiter=10),
+            warmup=1, repeats=3, reduce="median")
+        el_hi, _ = time_fn(
+            lambda a_b=a_b, b_b=b_b: solve(a_b, b_b, tol=0.0, maxiter=60),
+            warmup=1, repeats=3, reduce="median")
+        results[f"poisson2d_16M_{backend}"] = {
+            "us_per_iter": (el_hi - el_lo) / 50 * 1e6}
+
     # 4: distributed 3D Poisson over all local devices (N scaled to fit)
     ndev = len(jax.devices())
     grid = (64 * ndev if 64 * ndev <= 256 else 256, 128, 128)

@@ -61,6 +61,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--matrix-free", action="store_true",
                    help="use the matrix-free stencil operator for poisson* "
                         "(default: assembled CSR)")
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "xla", "pallas"],
+                   help="stencil matvec backend for --matrix-free problems: "
+                        "XLA fused adds or the pallas slab-DMA kernel "
+                        "(auto picks by grid size)")
     p.add_argument("--history", action="store_true",
                    help="print per-iteration residual trace")
     p.add_argument("--json", action="store_true",
@@ -96,7 +101,7 @@ def _build_problem(args):
     if args.problem == "poisson2d":
         n = args.n
         if args.matrix_free:
-            a = poisson.poisson_2d_operator(n, n, dtype=dtype)
+            a = poisson.poisson_2d_operator(n, n, dtype=dtype, backend=args.backend)
         else:
             a = poisson.poisson_2d_csr(n, n, dtype=dtype)
         x_true = rng.standard_normal(n * n).astype(dtype)
@@ -104,7 +109,7 @@ def _build_problem(args):
     if args.problem == "poisson3d":
         n = args.n
         if args.matrix_free:
-            a = poisson.poisson_3d_operator(n, n, n, dtype=dtype)
+            a = poisson.poisson_3d_operator(n, n, n, dtype=dtype, backend=args.backend)
         else:
             a = poisson.poisson_3d_csr(n, n, n, dtype=dtype)
         x_true = rng.standard_normal(n ** 3).astype(dtype)

@@ -220,10 +220,33 @@ class ELLMatrix(LinearOperator):
         return jnp.sum(jnp.where(self.cols == row_ids, self.vals, 0), axis=1)
 
 
+def _pallas_interpret() -> bool:
+    """Pallas kernels run compiled on TPU, interpreted elsewhere (tests)."""
+    return jax.default_backend() != "tpu"
+
+
+# Above ~3 VMEM's worth of grid the CG state cannot stay resident on-chip
+# and the slab-DMA pallas kernels win (measured: 1210 vs 1612 us/CG-iter at
+# 4096^2 f32 on v5e); below it XLA's fused while_loop is optimal.
+_PALLAS_BYTES_THRESHOLD = 48 * 2 ** 20
+
+
+def _resolve_backend(backend: str, grid, itemsize: int, supported: bool) -> str:
+    if backend not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown backend: {backend!r}")
+    if backend != "auto":
+        return backend
+    n_bytes = itemsize
+    for g in grid:
+        n_bytes *= g
+    return "pallas" if (supported and n_bytes >= _PALLAS_BYTES_THRESHOLD) \
+        else "xla"
+
+
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=("scale",),
-    meta_fields=("grid", "_dtype_name"),
+    meta_fields=("grid", "backend", "_dtype_name"),
 )
 @dataclasses.dataclass(frozen=True)
 class Stencil2D(LinearOperator):
@@ -234,17 +257,32 @@ class Stencil2D(LinearOperator):
     matrix of BASELINE config #2, applied as shifted adds on the grid rather
     than a sparse gather (the TPU-idiomatic formulation: pure VPU work,
     no indices in HBM at all).
+
+    ``backend``: "xla" (default - fused shifted adds; optimal when the CG
+    state fits in VMEM) or "pallas" (double-buffered slab-DMA kernel,
+    ``ops/pallas/stencil.py``; wins in the HBM-bound regime - measured
+    757 vs 702 GB/s at 4096^2 f32 on v5e).
     """
 
-    scale: jax.Array  # scalar, e.g. 1/h^2
+    scale: jax.Array  # traced scalar (scale sweeps reuse one executable)
     grid: Tuple[int, int]
+    backend: str = "xla"
     _dtype_name: str = "float32"
 
     @classmethod
-    def create(cls, nx: int, ny: int, scale: float = 1.0, dtype=jnp.float32):
+    def create(cls, nx: int, ny: int, scale: float = 1.0, dtype=jnp.float32,
+               backend: str = "xla"):
         dtype = jnp.dtype(dtype)
-        return cls(scale=jnp.asarray(scale, dtype=dtype), grid=(nx, ny),
-                   _dtype_name=dtype.name)
+        from ..ops.pallas import stencil as pk
+
+        backend = _resolve_backend(backend, (nx, ny), dtype.itemsize,
+                                   pk.supports_2d(nx, ny))
+        if backend == "pallas" and not pk.supports_2d(nx, ny):
+            raise ValueError(
+                f"pallas 2D stencil needs nx % 8 == 0 and ny % 128 == 0,"
+                f" got ({nx}, {ny})")
+        return cls(scale=jnp.asarray(scale, dtype), grid=(nx, ny),
+                   backend=backend, _dtype_name=dtype.name)
 
     @property
     def shape(self):
@@ -258,6 +296,13 @@ class Stencil2D(LinearOperator):
     def matvec(self, x):
         nx, ny = self.grid
         u = x.reshape(nx, ny)
+        if self.backend == "pallas":
+            from ..ops.pallas import stencil as pk
+
+            bm = pk.pick_block_rows_2d(nx, ny, self.dtype.itemsize)
+            y = pk.stencil2d_apply(u, self.scale, bm=bm,
+                                   interpret=_pallas_interpret())
+            return y.reshape(-1)
         up = jnp.pad(u, 1)
         y = (4.0 * u
              - up[:-2, 1:-1] - up[2:, 1:-1]
@@ -271,7 +316,7 @@ class Stencil2D(LinearOperator):
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=("scale",),
-    meta_fields=("grid", "_dtype_name"),
+    meta_fields=("grid", "backend", "_dtype_name"),
 )
 @dataclasses.dataclass(frozen=True)
 class Stencil3D(LinearOperator):
@@ -281,18 +326,30 @@ class Stencil3D(LinearOperator):
     formulation as ``Stencil2D``; the distributed version partitions the
     leading grid axis across the mesh and exchanges boundary planes with
     ``lax.ppermute`` (see the ``parallel`` package).
+
+    ``backend``: "xla" or "pallas" (+-1-plane slab-DMA kernel; 683 vs
+    664 GB/s at 256^3 f32 on v5e).
     """
 
     scale: jax.Array
     grid: Tuple[int, int, int]
+    backend: str = "xla"
     _dtype_name: str = "float32"
 
     @classmethod
     def create(cls, nx: int, ny: int, nz: int, scale: float = 1.0,
-               dtype=jnp.float32):
+               dtype=jnp.float32, backend: str = "xla"):
         dtype = jnp.dtype(dtype)
-        return cls(scale=jnp.asarray(scale, dtype=dtype), grid=(nx, ny, nz),
-                   _dtype_name=dtype.name)
+        from ..ops.pallas import stencil as pk
+
+        backend = _resolve_backend(backend, (nx, ny, nz), dtype.itemsize,
+                                   pk.supports_3d(nx, ny, nz))
+        if backend == "pallas" and not pk.supports_3d(nx, ny, nz):
+            raise ValueError(
+                f"pallas 3D stencil needs nx % 2 == 0, ny % 8 == 0 and "
+                f"nz % 128 == 0, got ({nx}, {ny}, {nz})")
+        return cls(scale=jnp.asarray(scale, dtype), grid=(nx, ny, nz),
+                   backend=backend, _dtype_name=dtype.name)
 
     @property
     def shape(self):
@@ -306,6 +363,13 @@ class Stencil3D(LinearOperator):
     def matvec(self, x):
         nx, ny, nz = self.grid
         u = x.reshape(nx, ny, nz)
+        if self.backend == "pallas":
+            from ..ops.pallas import stencil as pk
+
+            bm = pk.pick_block_planes_3d(nx, ny, nz, self.dtype.itemsize)
+            y = pk.stencil3d_apply(u, self.scale, bm=bm,
+                                   interpret=_pallas_interpret())
+            return y.reshape(-1)
         up = jnp.pad(u, 1)
         y = (6.0 * u
              - up[:-2, 1:-1, 1:-1] - up[2:, 1:-1, 1:-1]

@@ -97,13 +97,15 @@ def poisson_3d_csr(nx: int, ny: int, nz: int, scale: float = 1.0,
 
 
 def poisson_2d_operator(nx: int, ny: int, scale: float = 1.0,
-                        dtype=jnp.float32) -> Stencil2D:
-    return Stencil2D.create(nx, ny, scale=scale, dtype=dtype)
+                        dtype=jnp.float32, backend: str = "xla") -> Stencil2D:
+    return Stencil2D.create(nx, ny, scale=scale, dtype=dtype,
+                            backend=backend)
 
 
 def poisson_3d_operator(nx: int, ny: int, nz: int, scale: float = 1.0,
-                        dtype=jnp.float32) -> Stencil3D:
-    return Stencil3D.create(nx, ny, nz, scale=scale, dtype=dtype)
+                        dtype=jnp.float32, backend: str = "xla") -> Stencil3D:
+    return Stencil3D.create(nx, ny, nz, scale=scale, dtype=dtype,
+                            backend=backend)
 
 
 def _coo_to_csr(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,

@@ -1,0 +1,22 @@
+"""Pallas TPU kernels: the framework's hand-written native-kernel layer
+(the role ``cusparseSpMV``/cuBLAS kernels play in the reference,
+``CUDACG.cu:288,248-347`` - here written in Pallas/Mosaic, not called from a
+vendor library)."""
+
+from .stencil import (
+    pick_block_planes_3d,
+    pick_block_rows_2d,
+    stencil2d_apply,
+    stencil3d_apply,
+    supports_2d,
+    supports_3d,
+)
+
+__all__ = [
+    "pick_block_planes_3d",
+    "pick_block_rows_2d",
+    "stencil2d_apply",
+    "stencil3d_apply",
+    "supports_2d",
+    "supports_3d",
+]

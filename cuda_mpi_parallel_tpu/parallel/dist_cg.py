@@ -101,10 +101,12 @@ def _solve_stencil(a, b, mesh, axis, n_shards, tol, rtol, maxiter, jacobi,
                    record_history) -> CGResult:
     if isinstance(a, Stencil2D):
         local = DistStencil2D.create(a.grid, n_shards, axis_name=axis,
-                                     scale=float(a.scale), dtype=a.dtype)
+                                     scale=a.scale, dtype=a.dtype,
+                                     backend=a.backend)
     else:
         local = DistStencil3D.create(a.grid, n_shards, axis_name=axis,
-                                     scale=float(a.scale), dtype=a.dtype)
+                                     scale=a.scale, dtype=a.dtype,
+                                     backend=a.backend)
 
     b = shard_vector(jnp.asarray(b, a.dtype), mesh, axis)
 

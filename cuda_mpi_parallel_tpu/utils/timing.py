@@ -23,9 +23,26 @@ def wall_seconds() -> float:
 
 
 def _block(tree) -> None:
-    for leaf in jax.tree_util.tree_leaves(tree):
-        if hasattr(leaf, "block_until_ready"):
-            leaf.block_until_ready()
+    """Force completion of all device work producing ``tree``.
+
+    ``block_until_ready`` alone is not sufficient on tunneled/remote device
+    transports (observed on axon: it can return before execution finishes);
+    fetching one scalar element to the host is the reliable barrier - the
+    same role ``cudaDeviceSynchronize`` would play around the reference's
+    (dead) ``cpuSecond`` timer.
+    """
+    leaves = [leaf for leaf in jax.tree_util.tree_leaves(tree)
+              if hasattr(leaf, "block_until_ready")]
+    for leaf in leaves:
+        leaf.block_until_ready()
+    if leaves:
+        # All leaves of one jitted call come from one XLA executable, so a
+        # single element fetch is a complete barrier; probe the largest leaf
+        # so the barrier covers the main output even if the timed function
+        # returned results from several dispatches.
+        probe = max(leaves, key=lambda a: getattr(a, "size", 0))
+        if probe.size:
+            float(probe.reshape(-1)[0])
 
 
 def time_fn(

@@ -1,0 +1,164 @@
+"""Pallas kernel tests, run in interpret mode on CPU (SURVEY SS5: interpret
+mode is the framework's sanitizer - it catches OOB indexing the way compute-
+sanitizer would for the reference's CUDA kernels, if it had any).
+
+On real TPU hardware the same kernels compile through Mosaic; bench.py
+compares them against the XLA formulation there.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from cuda_mpi_parallel_tpu import Stencil2D, Stencil3D, solve
+from cuda_mpi_parallel_tpu.ops.pallas import stencil as pk
+from cuda_mpi_parallel_tpu.parallel import (
+    DistStencil3D,
+    make_mesh,
+    solve_distributed,
+)
+
+
+def ref_stencil2d(x, scale=1.0):
+    u = np.pad(x, 1)
+    return scale * (4.0 * x - u[:-2, 1:-1] - u[2:, 1:-1]
+                    - u[1:-1, :-2] - u[1:-1, 2:])
+
+
+def ref_stencil3d(x, scale=1.0):
+    u = np.pad(x, 1)
+    return scale * (6.0 * x - u[:-2, 1:-1, 1:-1] - u[2:, 1:-1, 1:-1]
+                    - u[1:-1, :-2, 1:-1] - u[1:-1, 2:, 1:-1]
+                    - u[1:-1, 1:-1, :-2] - u[1:-1, 1:-1, 2:])
+
+
+class TestStencil2DKernel:
+    @pytest.mark.parametrize("shape,bm", [((64, 128), 16), ((64, 128), 64),
+                                          ((128, 256), 32)])
+    def test_matches_reference(self, rng, shape, bm):
+        x = rng.standard_normal(shape).astype(np.float32)
+        y = pk.stencil2d_apply(jnp.asarray(x), 1.5, bm=bm, interpret=True)
+        np.testing.assert_allclose(np.asarray(y), ref_stencil2d(x, 1.5),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_single_block_grid(self, rng):
+        """first == last block: both boundary fills active."""
+        x = rng.standard_normal((32, 128)).astype(np.float32)
+        y = pk.stencil2d_apply(jnp.asarray(x), 1.0, bm=32, interpret=True)
+        np.testing.assert_allclose(np.asarray(y), ref_stencil2d(x),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_indivisible_raises(self, rng):
+        x = jnp.zeros((60, 128), dtype=jnp.float32)
+        with pytest.raises(ValueError, match="not divisible"):
+            pk.stencil2d_apply(x, 1.0, bm=32, interpret=True)
+
+
+class TestStencil3DKernel:
+    @pytest.mark.parametrize("shape,bm", [((16, 16, 128), 4),
+                                          ((16, 16, 128), 16),
+                                          ((32, 8, 256), 8)])
+    def test_matches_reference(self, rng, shape, bm):
+        x = rng.standard_normal(shape).astype(np.float32)
+        y = pk.stencil3d_apply(jnp.asarray(x), 2.0, bm=bm, interpret=True)
+        np.testing.assert_allclose(np.asarray(y), ref_stencil3d(x, 2.0),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestOperatorBackend:
+    def test_stencil2d_backends_agree(self, rng):
+        a_x = Stencil2D.create(64, 128, scale=1.3, dtype=jnp.float32)
+        a_p = Stencil2D.create(64, 128, scale=1.3, dtype=jnp.float32,
+                               backend="pallas")
+        x = jnp.asarray(rng.standard_normal(64 * 128).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(a_p @ x), np.asarray(a_x @ x),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_stencil3d_backends_agree(self, rng):
+        a_x = Stencil3D.create(16, 16, 128, dtype=jnp.float32)
+        a_p = Stencil3D.create(16, 16, 128, dtype=jnp.float32,
+                               backend="pallas")
+        x = jnp.asarray(rng.standard_normal(a_x.shape[0]).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(a_p @ x), np.asarray(a_x @ x),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_unsupported_shape_rejected(self):
+        with pytest.raises(ValueError, match="pallas 2D stencil needs"):
+            Stencil2D.create(64, 100, backend="pallas")
+        with pytest.raises(ValueError, match="pallas 3D stencil needs"):
+            Stencil3D.create(16, 16, 100, backend="pallas")
+
+    def test_bogus_backend_rejected(self):
+        with pytest.raises(ValueError, match="unknown backend"):
+            Stencil2D.create(64, 128, backend="cuda")
+
+    def test_scale_sweep_reuses_executable(self, rng):
+        """scale is a traced leaf (and an SMEM operand in the pallas
+        kernels): sweeping it must not recompile the jitted solve."""
+        from cuda_mpi_parallel_tpu.solver.cg import _solve_jit
+
+        b = jnp.asarray(rng.standard_normal(64 * 128).astype(np.float32))
+        solve(Stencil2D.create(64, 128, scale=1.0, dtype=jnp.float32), b,
+              tol=1e-3, maxiter=5)
+        n0 = _solve_jit._cache_size()
+        solve(Stencil2D.create(64, 128, scale=2.5, dtype=jnp.float32), b,
+              tol=1e-3, maxiter=5)
+        assert _solve_jit._cache_size() == n0
+
+    def test_dist_backend_validated(self):
+        with pytest.raises(ValueError, match="unknown backend"):
+            DistStencil3D.create((32, 8, 128), 8, backend="Pallas")
+
+    def test_auto_backend_resolution(self):
+        """auto -> xla for VMEM-resident grids, pallas for HBM-bound ones
+        with supported shapes, xla when shapes are unsupported."""
+        assert Stencil2D.create(64, 128, backend="auto").backend == "xla"
+        assert Stencil2D.create(4096, 4096,
+                                backend="auto").backend == "pallas"
+        assert Stencil2D.create(4096, 4100,
+                                backend="auto").backend == "xla"
+        assert Stencil3D.create(256, 256, 256,
+                                backend="auto").backend == "pallas"
+
+    def test_solve_with_pallas_backend(self, rng):
+        """End-to-end: CG over the pallas matvec reproduces the XLA solve."""
+        a_x = Stencil2D.create(32, 128, dtype=jnp.float32)
+        a_p = Stencil2D.create(32, 128, dtype=jnp.float32, backend="pallas")
+        x_true = rng.standard_normal(32 * 128).astype(np.float32)
+        b = a_x @ jnp.asarray(x_true)
+        r_x = solve(a_x, b, tol=1e-3, maxiter=400)
+        r_p = solve(a_p, b, tol=1e-3, maxiter=400)
+        assert bool(r_p.converged)
+        assert int(r_p.iterations) == int(r_x.iterations)
+        np.testing.assert_allclose(np.asarray(r_p.x), np.asarray(r_x.x),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+class TestDistributedPallas:
+    def test_dist_3d_pallas_matvec_equals_global(self, rng):
+        """Sharded pallas matvec (interior kernel + halo correction) must
+        equal the global XLA stencil."""
+        nx, ny, nz = 32, 8, 128
+        mesh = make_mesh(8)
+        x = jnp.asarray(
+            rng.standard_normal(nx * ny * nz).astype(np.float32))
+        want = Stencil3D.create(nx, ny, nz, dtype=jnp.float32) @ x
+        local = DistStencil3D.create((nx, ny, nz), 8, dtype=jnp.float32,
+                                     backend="pallas")
+        got = jax.jit(jax.shard_map(
+            lambda v: local @ v, mesh=mesh, in_specs=P("rows"),
+            out_specs=P("rows")))(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_dist_solve_pallas(self, rng):
+        a = Stencil3D.create(32, 8, 128, dtype=jnp.float32,
+                             backend="pallas")
+        x_true = rng.standard_normal(a.shape[0]).astype(np.float32)
+        b = Stencil3D.create(32, 8, 128, dtype=jnp.float32) @ jnp.asarray(
+            x_true)
+        res = solve_distributed(a, b, mesh=make_mesh(8), tol=1e-3,
+                                maxiter=500)
+        assert bool(res.converged)
