@@ -14,7 +14,8 @@ from .operators import CSRMatrix
 
 
 def load_matrix_market(path: str, dtype=np.float64,
-                       check_symmetric: bool = True) -> CSRMatrix:
+                       check_symmetric: bool = True,
+                       native: bool = True) -> CSRMatrix:
     """Load a Matrix Market file as CSR.
 
     Symmetric-stored files are expanded to full storage (CG's SpMV wants
@@ -22,9 +23,30 @@ def load_matrix_market(path: str, dtype=np.float64,
     general-stored files and raises on asymmetric input, because CG
     silently diverges on nonsymmetric systems (the reference would too -
     it never checks, quirk Q4).
+
+    ``native=True`` uses the C++ parser (``native/csrtools.cpp``) when the
+    library is available and the file is coordinate-format; scipy handles
+    everything else.
     """
     import scipy.io
     import scipy.sparse as sp
+
+    if native:
+        from ..native import bindings
+
+        if bindings.available():
+            try:
+                vals, indices, indptr, shape = bindings.mm_read(path)
+            except bindings.NativeUnsupported:
+                vals = None  # unsupported variant/size -> scipy fallback
+            if vals is not None:
+                if shape[0] != shape[1]:
+                    raise ValueError(f"matrix is not square: {shape}")
+                if check_symmetric:
+                    _check_symmetric(
+                        sp.csr_matrix((vals, indices, indptr), shape=shape))
+                return CSRMatrix.from_arrays(
+                    vals.astype(np.dtype(dtype)), indices, indptr, shape)
 
     m = scipy.io.mmread(path)
     if not sp.issparse(m):
@@ -33,14 +55,18 @@ def load_matrix_market(path: str, dtype=np.float64,
     if m.shape[0] != m.shape[1]:
         raise ValueError(f"matrix is not square: {m.shape}")
     if check_symmetric:
-        diff = abs(m - m.T)
-        if diff.nnz and diff.max() > 1e-10 * max(abs(m).max(), 1.0):
-            raise ValueError(
-                "matrix is not symmetric; CG requires a symmetric operator")
+        _check_symmetric(m)
     m.sort_indices()
     return CSRMatrix.from_arrays(m.data.astype(np.dtype(dtype)),
                                  m.indices.astype(np.int32),
                                  m.indptr.astype(np.int32), m.shape)
+
+
+def _check_symmetric(m) -> None:
+    diff = abs(m - m.T)
+    if diff.nnz and diff.max() > 1e-10 * max(abs(m).max(), 1.0):
+        raise ValueError(
+            "matrix is not symmetric; CG requires a symmetric operator")
 
 
 def save_matrix_market(path: str, a: CSRMatrix) -> None:

@@ -165,10 +165,19 @@ class CSRMatrix(LinearOperator):
         return out.at[self.rows, self.indices].add(self.data)
 
     def to_ell(self, width: int | None = None) -> "ELLMatrix":
-        """Convert to padded ELL (host-side; use the native path for speed)."""
+        """Convert to padded ELL (host-side; C++ fast path when built)."""
         indptr = np.asarray(self.indptr)
         data = np.asarray(self.data)
         indices = np.asarray(self.indices)
+
+        from ..native import bindings
+
+        if bindings.available():
+            vals, cols = bindings.csr_to_ell(indptr, indices, data,
+                                             width=width)
+            return ELLMatrix(vals=jnp.asarray(vals), cols=jnp.asarray(cols),
+                             shape=self.shape)
+
         counts = np.diff(indptr)
         k = int(counts.max()) if width is None else int(width)
         if width is not None and counts.max() > width:

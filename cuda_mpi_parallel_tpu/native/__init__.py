@@ -1,0 +1,8 @@
+"""Native (C++) data layer: fast MatrixMarket parsing and sparse-format
+conversion behind a ctypes ABI, with pure-Python fallbacks everywhere
+(reference analogue: the native host-side data layer at
+``CUDACG.cu:94-186``)."""
+
+from . import bindings
+
+__all__ = ["bindings"]

@@ -1,0 +1,179 @@
+"""ctypes bindings for the native data-layer library (``csrtools.cpp``).
+
+pybind11 is not in this toolchain, so the boundary is a plain extern "C"
+ABI: numpy arrays are passed as raw pointers, all buffers caller-allocated.
+The library is built on first use with g++ (cached as ``libcsrtools.so``
+next to the source); if no compiler is available every entry point reports
+``available() == False`` and callers fall back to their pure-Python paths -
+the native layer is an accelerator, never a requirement.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "csrtools.cpp")
+_LIB = os.path.join(_DIR, "libcsrtools.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+_ERRORS = {
+    -1: "could not open file",
+    -2: "malformed MatrixMarket header",
+    -3: "unsupported MatrixMarket format (need coordinate real/integer/"
+        "pattern, general or symmetric)",
+    -4: "index out of bounds",
+}
+
+# The native CSR routines use int32 offsets; larger problems go to the
+# scipy/Python fallbacks (which use int64).
+_MAX_NNZ = 2 ** 31 - 1
+
+
+class NativeUnsupported(ValueError):
+    """The native path cannot handle this input, but a fallback can
+    (unsupported MatrixMarket variant, or nnz beyond int32).  Distinct from
+    plain ValueError, which signals genuinely bad input that a fallback
+    would merely re-discover."""
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    try:
+        if os.path.exists(_LIB) and (os.path.getmtime(_LIB)
+                                     >= os.path.getmtime(_SRC)):
+            return ctypes.CDLL(_LIB)
+        # Compile to a temp path and rename: os.rename is atomic on POSIX,
+        # so a concurrent process never dlopens a half-written library.
+        tmp = f"{_LIB}.{os.getpid()}.tmp"
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", "-o", tmp,
+             _SRC],
+            check=True, capture_output=True, timeout=120)
+        os.rename(tmp, _LIB)
+        return ctypes.CDLL(_LIB)
+    except (OSError, subprocess.SubprocessError):
+        _build_failed = True
+        return None
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lock:
+        if _lib is None and not _build_failed:
+            lib = _build()
+            if lib is not None:
+                _declare(lib)
+            _lib = lib
+    return _lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    i64 = ctypes.c_int64
+    p_i64 = ctypes.POINTER(ctypes.c_int64)
+    p_i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    p_f64 = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+
+    lib.mm_read_sizes.restype = ctypes.c_int
+    lib.mm_read_sizes.argtypes = [ctypes.c_char_p, p_i64, p_i64, p_i64]
+    lib.mm_read_csr.restype = ctypes.c_int
+    lib.mm_read_csr.argtypes = [ctypes.c_char_p, i64, i64, p_i32, p_i32,
+                                p_f64]
+    lib.coo_to_csr.restype = i64
+    lib.coo_to_csr.argtypes = [i64, i64, p_i32, p_i32, p_f64, p_i32, p_i32,
+                               p_f64]
+    lib.csr_max_row_nnz.restype = ctypes.c_int32
+    lib.csr_max_row_nnz.argtypes = [i64, p_i32]
+    lib.csr_to_ell.restype = ctypes.c_int
+    lib.csr_to_ell.argtypes = [i64, ctypes.c_int32, p_i32, p_i32, p_f64,
+                               p_i32, p_f64]
+
+
+def available() -> bool:
+    """True when the native library is built and loadable."""
+    return _get() is not None
+
+
+def _check(rc: int, what: str) -> None:
+    if rc < 0:
+        msg = f"{what}: {_ERRORS.get(rc, f'error {rc}')}"
+        if rc == -3:
+            raise NativeUnsupported(msg)
+        raise ValueError(msg)
+
+
+def mm_read(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Tuple[int, int]]:
+    """Parse a MatrixMarket coordinate file into CSR (symmetric expanded).
+
+    Returns (vals f64, indices i32, indptr i32, shape).
+    """
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    nnz = ctypes.c_int64()
+    _check(lib.mm_read_sizes(path.encode(), ctypes.byref(rows),
+                             ctypes.byref(cols), ctypes.byref(nnz)),
+           f"mm_read_sizes({path})")
+    n, m, k = rows.value, cols.value, nnz.value
+    if k > _MAX_NNZ or n + 1 > _MAX_NNZ:
+        raise NativeUnsupported(
+            f"mm_read({path}): {k} nonzeros exceeds the native int32 "
+            f"offset range; use the scipy loader")
+    indptr = np.zeros(n + 1, dtype=np.int32)
+    indices = np.zeros(k, dtype=np.int32)
+    vals = np.zeros(k, dtype=np.float64)
+    _check(lib.mm_read_csr(path.encode(), n, k, indptr, indices, vals),
+           f"mm_read_csr({path})")
+    return vals, indices, indptr, (n, m)
+
+
+def coo_to_csr(n: int, rows: np.ndarray, cols: np.ndarray,
+               vals: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """COO triplets -> canonical CSR (sorted columns, duplicates summed)."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    cols = np.ascontiguousarray(cols, dtype=np.int32)
+    vals = np.ascontiguousarray(vals, dtype=np.float64)
+    nnz = rows.shape[0]
+    indptr = np.zeros(n + 1, dtype=np.int32)
+    out_cols = np.zeros(nnz, dtype=np.int32)
+    out_vals = np.zeros(nnz, dtype=np.float64)
+    written = lib.coo_to_csr(n, nnz, rows, cols, vals, indptr, out_cols,
+                             out_vals)
+    _check(int(written), "coo_to_csr")
+    return out_vals[:written].copy(), out_cols[:written].copy(), indptr
+
+
+def csr_to_ell(indptr: np.ndarray, indices: np.ndarray, vals: np.ndarray,
+               width: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """CSR -> padded ELL ((n, width) vals f64 + cols i32)."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    indptr = np.ascontiguousarray(indptr, dtype=np.int32)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    vals64 = np.ascontiguousarray(vals, dtype=np.float64)
+    n = indptr.shape[0] - 1
+    max_w = int(lib.csr_max_row_nnz(n, indptr))
+    if width is None:
+        width = max_w
+    elif width < max_w:
+        raise ValueError(f"ELL width {width} < max row nnz {max_w}")
+    ell_cols = np.zeros((n, width), dtype=np.int32)
+    ell_vals = np.zeros((n, width), dtype=np.float64)
+    _check(lib.csr_to_ell(n, width, indptr, indices, vals64, ell_cols,
+                          ell_vals), "csr_to_ell")
+    return ell_vals.astype(vals.dtype, copy=False), ell_cols
