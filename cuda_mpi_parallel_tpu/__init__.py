@@ -27,12 +27,13 @@ from .models.operators import (
     Stencil2D,
     Stencil3D,
 )
-from .solver.cg import CGResult, cg, solve
+from .solver.cg import CGCheckpoint, CGResult, cg, solve
 from .solver.status import CGStatus
 
 __version__ = "0.1.0"
 
 __all__ = [
+    "CGCheckpoint",
     "CGResult",
     "CGStatus",
     "CSRMatrix",

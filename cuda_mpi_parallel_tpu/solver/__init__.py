@@ -1,7 +1,7 @@
 """Solvers: jitted Krylov methods (reference: the inlined CG loop at
 ``CUDACG.cu:269-352``)."""
 
-from .cg import CGResult, cg, solve
+from .cg import CGCheckpoint, CGResult, cg, solve
 from .status import CGStatus
 
-__all__ = ["CGResult", "CGStatus", "cg", "solve"]
+__all__ = ["CGCheckpoint", "CGResult", "CGStatus", "cg", "solve"]

@@ -56,8 +56,33 @@ from .status import CGStatus
 
 @partial(
     jax.tree_util.register_dataclass,
+    data_fields=("x", "r", "p", "rho", "rr", "nrm0", "k", "indefinite"),
+    meta_fields=(),
+)
+@dataclasses.dataclass(frozen=True)
+class CGCheckpoint:
+    """Complete CG recurrence state: resuming from it continues the *exact*
+    trajectory (same iterates bit-for-bit), unlike a restart from x alone.
+
+    The reference has no checkpointing - its solver state lives only in
+    device memory for the life of the process (SURVEY SS5); long N=256^3
+    runs need save/resume (see ``utils/checkpoint.py``).
+    """
+
+    x: jax.Array
+    r: jax.Array
+    p: jax.Array
+    rho: jax.Array
+    rr: jax.Array
+    nrm0: jax.Array        # ||r0|| of the ORIGINAL solve (rtol threshold)
+    k: jax.Array           # iterations completed so far
+    indefinite: jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
     data_fields=("x", "iterations", "residual_norm", "converged", "status",
-                 "indefinite", "residual_history"),
+                 "indefinite", "residual_history", "checkpoint"),
     meta_fields=(),
 )
 @dataclasses.dataclass(frozen=True)
@@ -71,6 +96,7 @@ class CGResult:
     status: jax.Array           # CGStatus int code
     indefinite: jax.Array       # bool: p.Ap <= 0 was observed (quirk Q1)
     residual_history: Optional[jax.Array]  # (maxiter+1,) ||r|| trace or None
+    checkpoint: Optional[CGCheckpoint] = None  # set when return_checkpoint
 
     def status_enum(self) -> CGStatus:
         return CGStatus(int(self.status))
@@ -98,6 +124,9 @@ def cg(
     m: Optional[LinearOperator] = None,
     record_history: bool = False,
     axis_name: Optional[str] = None,
+    resume_from: Optional[CGCheckpoint] = None,
+    return_checkpoint: bool = False,
+    iter_cap=None,
 ) -> CGResult:
     """Solve A x = b by (preconditioned) conjugate gradients.
 
@@ -116,6 +145,14 @@ def cg(
       record_history: if True, return the per-iteration ||r|| trace.
       axis_name: mesh axis for row-partitioned execution; inner products
         become ``lax.psum`` over this axis.  ``None`` = single device.
+      resume_from: a ``CGCheckpoint`` from a previous (partial) solve;
+        continues the exact trajectory.  ``maxiter`` remains the TOTAL
+        iteration cap (checkpoint ``k`` counts against it).
+      return_checkpoint: if True, ``result.checkpoint`` carries the full
+        recurrence state for later resumption.
+      iter_cap: optional *traced* iteration bound <= maxiter.  Segmented
+        runs vary this instead of ``maxiter`` (which is static and would
+        recompile); see ``utils/checkpoint.solve_resumable``.
 
     The function is pure and traceable: call it under ``jit`` (or use
     ``solve()`` which jits for you).
@@ -135,37 +172,53 @@ def cg(
 
     dot = partial(blas1.dot, axis_name=axis_name)
 
-    if x0 is None:
-        x = jnp.zeros_like(b)
-        r = b  # r0 = b - A@0 = b: the reference's copy-only init (:248)
-    else:
-        x = jnp.asarray(x0, b.dtype)
-        r = b - a @ x
+    if resume_from is not None and x0 is not None:
+        raise ValueError("pass either x0 or resume_from, not both: a "
+                         "checkpoint carries its own iterate")
+    cap = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
 
-    # Unpreconditioned: z == r, so rho == rr and one reduction (one psum over
-    # ICI in the distributed case) suffices per iteration.
-    rr0 = dot(r, r)
-    if preconditioned:
-        z = m @ r
-        rho0 = dot(r, z)
+    if resume_from is not None:
+        x, r, p0 = resume_from.x, resume_from.r, resume_from.p
+        rho0, rr0 = resume_from.rho, resume_from.rr
+        nrm0 = resume_from.nrm0
+        k0 = resume_from.k
+        indef0 = resume_from.indefinite
     else:
-        z, rho0 = r, rr0
-    nrm0 = jnp.sqrt(rr0)
+        if x0 is None:
+            x = jnp.zeros_like(b)
+            r = b  # r0 = b - A@0 = b: the reference's copy-only init (:248)
+        else:
+            x = jnp.asarray(x0, b.dtype)
+            r = b - a @ x
+
+        # Unpreconditioned: z == r, so rho == rr and one reduction (one psum
+        # over ICI in the distributed case) suffices per iteration.
+        rr0 = dot(r, r)
+        if preconditioned:
+            z = m @ r
+            rho0 = dot(r, z)
+        else:
+            z, rho0 = r, rr0
+        p0 = z
+        nrm0 = jnp.sqrt(rr0)
+        k0 = jnp.zeros((), jnp.int32)
+        indef0 = jnp.zeros((), jnp.bool_)
+
     threshold = jnp.maximum(jnp.asarray(tol, b.dtype),
                             jnp.asarray(rtol, b.dtype) * nrm0)
     thresh_sq = threshold * threshold
 
     if record_history:
         history = jnp.full((maxiter + 1,), jnp.nan, dtype=b.dtype)
-        history = history.at[0].set(nrm0)
+        history = history.at[k0].set(jnp.sqrt(rr0))
     else:
         history = jnp.zeros((0,), dtype=b.dtype)
 
     state = _CGState(
-        k=jnp.zeros((), jnp.int32),
-        x=x, r=r, p=z,
+        k=k0,
+        x=x, r=r, p=p0,
         rho=rho0, rr=rr0,
-        indefinite=jnp.zeros((), jnp.bool_),
+        indefinite=indef0,
         history=history,
     )
 
@@ -175,7 +228,8 @@ def cg(
         # would divide 0/0 (p = 0 => p.Ap = 0).
         nontrivial = s.rr > 0
         healthy = jnp.isfinite(s.rr) & jnp.isfinite(s.rho)
-        return (s.k < maxiter) & unconverged & nontrivial & healthy
+        return (s.k < maxiter) & (s.k < cap) & unconverged & nontrivial \
+            & healthy
 
     def body(s: _CGState) -> _CGState:
         ap = a @ s.p
@@ -212,6 +266,11 @@ def cg(
         jnp.where(breakdown, jnp.int32(CGStatus.BREAKDOWN),
                   jnp.int32(CGStatus.MAXITER)),
     )
+    checkpoint = None
+    if return_checkpoint:
+        checkpoint = CGCheckpoint(
+            x=final.x, r=final.r, p=final.p, rho=final.rho, rr=final.rr,
+            nrm0=nrm0, k=final.k, indefinite=final.indefinite)
     return CGResult(
         x=final.x,
         iterations=final.k,
@@ -220,6 +279,7 @@ def cg(
         status=status,
         indefinite=final.indefinite,
         residual_history=final.history if record_history else None,
+        checkpoint=checkpoint,
     )
 
 
@@ -233,10 +293,14 @@ def _as_operator(a) -> LinearOperator:
     return DenseOperator(a=arr)
 
 
-@partial(jax.jit, static_argnames=("maxiter", "record_history", "axis_name"))
-def _solve_jit(a, b, x0, tol, rtol, maxiter, m, record_history, axis_name):
+@partial(jax.jit, static_argnames=("maxiter", "record_history", "axis_name",
+                                   "return_checkpoint"))
+def _solve_jit(a, b, x0, tol, rtol, maxiter, m, record_history, axis_name,
+               resume_from, return_checkpoint, iter_cap):
     return cg(a, b, x0, tol=tol, rtol=rtol, maxiter=maxiter, m=m,
-              record_history=record_history, axis_name=axis_name)
+              record_history=record_history, axis_name=axis_name,
+              resume_from=resume_from, return_checkpoint=return_checkpoint,
+              iter_cap=iter_cap)
 
 
 def solve(
@@ -249,12 +313,15 @@ def solve(
     maxiter: int = 2000,
     m: Optional[LinearOperator] = None,
     record_history: bool = False,
+    resume_from: Optional[CGCheckpoint] = None,
+    return_checkpoint: bool = False,
+    iter_cap: Optional[int] = None,
 ) -> CGResult:
     """Jitted single-call entry point: compile once per (operator-structure,
     shape, maxiter) and reuse - the whole solve is one XLA executable.
 
-    ``tol``/``rtol`` are passed as device scalars so sweeping tolerances does
-    not recompile.
+    ``tol``/``rtol``/``iter_cap`` are passed as device scalars so sweeping
+    them does not recompile.
     """
     if not isinstance(a, LinearOperator):
         a = _as_operator(a)
@@ -263,5 +330,6 @@ def solve(
         b = b.astype(jnp.result_type(float))
     tol_a = jnp.asarray(tol, b.dtype)
     rtol_a = jnp.asarray(rtol, b.dtype)
+    cap_a = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
     return _solve_jit(a, b, x0, tol_a, rtol_a, maxiter, m, record_history,
-                      None)
+                      None, resume_from, return_checkpoint, cap_a)

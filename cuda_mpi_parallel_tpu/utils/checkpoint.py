@@ -1,0 +1,138 @@
+"""Solver-state checkpoint / resume.
+
+The reference's solver state (x, r, p, rho) lives only in device memory for
+the life of the process (SURVEY SS5 "Checkpoint / resume": none) - a killed
+run restarts from zero.  Here the full CG recurrence state
+(``solver.cg.CGCheckpoint``) round-trips through ``numpy.savez``, and
+``solve_resumable`` runs a solve in segments, persisting after each, so a
+long N=256^3 run continues from where it stopped with the *exact* iterate
+trajectory (resuming p and rho, not restarting from x).
+
+Format: a plain .npz with the checkpoint leaves plus a format version -
+readable anywhere, no framework needed.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..solver.cg import CGCheckpoint, CGResult, solve
+
+_FORMAT_VERSION = 1
+
+
+def problem_fingerprint(a, b) -> str:
+    """Identify the (operator, rhs) a checkpoint belongs to.
+
+    On resume the recurrence never re-reads b (r comes from the state), so
+    resuming against the wrong problem would silently 'converge' to the old
+    system's solution - the fingerprint turns that into a loud error.
+    """
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(np.asarray(b)).tobytes())
+    ident = f"{type(a).__name__}:{a.shape}"
+    h.update(ident.encode())
+    return h.hexdigest()[:16]
+
+
+def save_checkpoint(path: str, ckpt: CGCheckpoint,
+                    fingerprint: str = "") -> None:
+    """Persist a CG checkpoint (atomically: write temp + rename)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    np.savez(
+        tmp,
+        version=_FORMAT_VERSION,
+        fingerprint=fingerprint,
+        x=np.asarray(ckpt.x),
+        r=np.asarray(ckpt.r),
+        p=np.asarray(ckpt.p),
+        rho=np.asarray(ckpt.rho),
+        rr=np.asarray(ckpt.rr),
+        nrm0=np.asarray(ckpt.nrm0),
+        k=np.asarray(ckpt.k),
+        indefinite=np.asarray(ckpt.indefinite),
+    )
+    # np.savez appends .npz to the temp name
+    os.replace(tmp + ".npz", path)
+
+
+def load_checkpoint(path: str,
+                    expect_fingerprint: str = "") -> CGCheckpoint:
+    with np.load(path) as z:
+        version = int(z["version"])
+        if version != _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint {path} has format version {version}, "
+                f"expected {_FORMAT_VERSION}")
+        stored = str(z["fingerprint"]) if "fingerprint" in z else ""
+        if expect_fingerprint and stored and stored != expect_fingerprint:
+            raise ValueError(
+                f"checkpoint {path} belongs to a different problem "
+                f"(fingerprint {stored} != {expect_fingerprint}); refusing "
+                f"to resume - delete it to start fresh")
+        return CGCheckpoint(
+            x=jnp.asarray(z["x"]),
+            r=jnp.asarray(z["r"]),
+            p=jnp.asarray(z["p"]),
+            rho=jnp.asarray(z["rho"]),
+            rr=jnp.asarray(z["rr"]),
+            nrm0=jnp.asarray(z["nrm0"]),
+            k=jnp.asarray(z["k"]),
+            indefinite=jnp.asarray(z["indefinite"]),
+        )
+
+
+def solve_resumable(
+    a,
+    b,
+    path: str,
+    *,
+    segment_iters: int = 500,
+    tol: float = 1e-7,
+    rtol: float = 0.0,
+    maxiter: int = 2000,
+    m=None,
+    keep_checkpoint: bool = False,
+) -> CGResult:
+    """Solve A x = b, checkpointing to ``path`` every ``segment_iters``.
+
+    If ``path`` exists the solve resumes from it (exact trajectory).  On
+    convergence the checkpoint is removed unless ``keep_checkpoint``.
+
+    The per-segment host round-trip costs one dispatch per
+    ``segment_iters`` iterations - amortized to nothing for realistic
+    segment sizes, and the price of being able to survive preemption
+    (which the reference cannot, SURVEY SS5).
+    """
+    if segment_iters < 1:
+        raise ValueError(f"segment_iters must be >= 1, got {segment_iters}")
+    fp = problem_fingerprint(a, b)
+    state: Optional[CGCheckpoint] = None
+    if os.path.exists(path):
+        state = load_checkpoint(path, expect_fingerprint=fp)
+
+    while True:
+        done_k = int(state.k) if state is not None else 0
+        cap = min(done_k + segment_iters, maxiter)
+        # maxiter stays constant (it is a static arg sizing the compiled
+        # solve); only the traced iter_cap varies per segment, so every
+        # segment reuses one executable.
+        res = solve(a, b, tol=tol, rtol=rtol, maxiter=maxiter, m=m,
+                    resume_from=state, return_checkpoint=True,
+                    iter_cap=cap)
+        state = res.checkpoint
+        save_checkpoint(path, state, fingerprint=fp)
+        finished = bool(res.converged) or int(res.iterations) >= maxiter \
+            or res.status_enum().name == "BREAKDOWN"
+        if finished:
+            if bool(res.converged) and not keep_checkpoint:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            return res

@@ -1,0 +1,158 @@
+"""Checkpoint/resume tests (SURVEY SS5: absent from the reference).
+
+The load-bearing property: a solve split into segments (with a disk
+round-trip between them) follows the SAME iterate trajectory as an
+uninterrupted solve.
+"""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import solve
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.utils import checkpoint as ckpt
+
+
+class TestResume:
+    def test_segmented_equals_uninterrupted(self):
+        a = poisson.poisson_2d_csr(12, 12)
+        b = jnp.asarray(np.random.default_rng(0).standard_normal(144))
+
+        full = solve(a, b, tol=1e-10, maxiter=400, record_history=True)
+
+        part1 = solve(a, b, tol=1e-10, maxiter=20, return_checkpoint=True)
+        assert not bool(part1.converged)
+        part2 = solve(a, b, tol=1e-10, maxiter=400,
+                      resume_from=part1.checkpoint, record_history=True)
+
+        assert bool(part2.converged)
+        assert int(part2.iterations) == int(full.iterations)
+        np.testing.assert_allclose(np.asarray(part2.x), np.asarray(full.x),
+                                   rtol=1e-14, atol=1e-14)
+        # residual trace continues seamlessly past the seam
+        h_full = np.asarray(full.residual_history)
+        h_part = np.asarray(part2.residual_history)
+        k = int(full.iterations)
+        np.testing.assert_allclose(h_part[20:k + 1], h_full[20:k + 1],
+                                   rtol=1e-12)
+
+    def test_checkpoint_counts_toward_total_maxiter(self):
+        a = poisson.poisson_2d_csr(10, 10)
+        b = jnp.asarray(np.random.default_rng(1).standard_normal(100))
+        part = solve(a, b, tol=1e-12, maxiter=15, return_checkpoint=True)
+        res = solve(a, b, tol=1e-12, maxiter=25,
+                    resume_from=part.checkpoint)
+        assert int(res.iterations) <= 25
+
+    def test_rtol_uses_original_nrm0(self):
+        """The relative-tolerance threshold must be anchored at the
+        ORIGINAL ||r0||, not the residual at the resume point."""
+        a = poisson.poisson_2d_csr(12, 12)
+        b = jnp.asarray(np.random.default_rng(2).standard_normal(144)) * 1e3
+        full = solve(a, b, tol=0.0, rtol=1e-9, maxiter=400)
+        part = solve(a, b, tol=0.0, rtol=1e-9, maxiter=30,
+                     return_checkpoint=True)
+        res = solve(a, b, tol=0.0, rtol=1e-9, maxiter=400,
+                    resume_from=part.checkpoint)
+        assert int(res.iterations) == int(full.iterations)
+
+
+class TestDiskRoundtrip:
+    def test_save_load(self, tmp_path):
+        a = poisson.poisson_2d_csr(8, 8)
+        b = jnp.asarray(np.random.default_rng(3).standard_normal(64))
+        part = solve(a, b, tol=1e-12, maxiter=10, return_checkpoint=True)
+        path = str(tmp_path / "state.npz")
+        ckpt.save_checkpoint(path, part.checkpoint)
+        loaded = ckpt.load_checkpoint(path)
+        for field in ("x", "r", "p", "rho", "rr", "nrm0", "k",
+                      "indefinite"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(loaded, field)),
+                np.asarray(getattr(part.checkpoint, field)))
+
+    def test_version_mismatch(self, tmp_path):
+        path = str(tmp_path / "bad.npz")
+        np.savez(path[:-4] + ".tmp", version=999, x=np.zeros(3))
+        os.replace(path[:-4] + ".tmp.npz", path)
+        with pytest.raises(ValueError, match="format version"):
+            ckpt.load_checkpoint(path)
+
+    def test_solve_resumable_end_to_end(self, tmp_path):
+        a = poisson.poisson_2d_csr(14, 14)
+        b = jnp.asarray(np.random.default_rng(4).standard_normal(196))
+        path = str(tmp_path / "run.npz")
+
+        full = solve(a, b, tol=1e-10, maxiter=600)
+        res = ckpt.solve_resumable(a, b, path, segment_iters=25, tol=1e-10,
+                                   maxiter=600)
+        assert bool(res.converged)
+        assert int(res.iterations) == int(full.iterations)
+        np.testing.assert_allclose(np.asarray(res.x), np.asarray(full.x),
+                                   rtol=1e-13, atol=1e-13)
+        assert not os.path.exists(path)  # removed on convergence
+
+    def test_segments_reuse_one_executable(self, tmp_path):
+        """Per-segment caps are traced (iter_cap), so many segments must
+        not trigger per-segment recompilation of the solve."""
+        from cuda_mpi_parallel_tpu.solver.cg import _solve_jit
+
+        a = poisson.poisson_2d_csr(12, 12)
+        b = jnp.asarray(np.random.default_rng(8).standard_normal(144))
+        path = str(tmp_path / "seg.npz")
+        ckpt.solve_resumable(a, b, path, segment_iters=10, tol=1e-10,
+                             maxiter=400)
+        n0 = _solve_jit._cache_size()
+        b2 = jnp.asarray(np.random.default_rng(9).standard_normal(144))
+        ckpt.solve_resumable(a, b2, str(tmp_path / "seg2.npz"),
+                             segment_iters=7, tol=1e-10, maxiter=400)
+        # same structures -> zero new compilations for the second run
+        assert _solve_jit._cache_size() == n0
+
+    def test_wrong_problem_rejected(self, tmp_path):
+        a = poisson.poisson_2d_csr(10, 10)
+        b1 = jnp.asarray(np.random.default_rng(10).standard_normal(100))
+        b2 = jnp.asarray(np.random.default_rng(11).standard_normal(100))
+        path = str(tmp_path / "fp.npz")
+        ckpt.solve_resumable(a, b1, path, segment_iters=5, tol=1e-12,
+                             maxiter=10)  # leaves a checkpoint
+        with pytest.raises(ValueError, match="different problem"):
+            ckpt.solve_resumable(a, b2, path, segment_iters=5, tol=1e-10,
+                                 maxiter=100)
+
+    def test_bad_segment_iters(self, tmp_path):
+        a = poisson.poisson_2d_csr(4, 4)
+        with pytest.raises(ValueError, match="segment_iters"):
+            ckpt.solve_resumable(a, jnp.ones(16), str(tmp_path / "x.npz"),
+                                 segment_iters=0)
+
+    def test_x0_and_resume_conflict(self):
+        a = poisson.poisson_2d_csr(6, 6)
+        b = jnp.ones(36)
+        part = solve(a, b, maxiter=3, return_checkpoint=True)
+        with pytest.raises(ValueError, match="not both"):
+            solve(a, b, x0=jnp.zeros(36), resume_from=part.checkpoint)
+
+    def test_solve_resumable_survives_interruption(self, tmp_path):
+        """Simulate preemption: run a few segments, 'crash', start over -
+        the resumed run must finish with the same trajectory."""
+        a = poisson.poisson_2d_csr(14, 14)
+        b = jnp.asarray(np.random.default_rng(5).standard_normal(196))
+        path = str(tmp_path / "run.npz")
+        full = solve(a, b, tol=1e-10, maxiter=600)
+
+        # first attempt: artificially cap total iterations (simulated kill)
+        res1 = ckpt.solve_resumable(a, b, path, segment_iters=20,
+                                    tol=1e-10, maxiter=40)
+        assert not bool(res1.converged)
+        assert os.path.exists(path)
+
+        # "new process": resumes from disk, runs to convergence
+        res2 = ckpt.solve_resumable(a, b, path, segment_iters=50,
+                                    tol=1e-10, maxiter=600)
+        assert bool(res2.converged)
+        assert int(res2.iterations) == int(full.iterations)
+        np.testing.assert_allclose(np.asarray(res2.x), np.asarray(full.x),
+                                   rtol=1e-13, atol=1e-13)
