@@ -52,6 +52,9 @@ def solve_distributed(
     maxiter: int = 2000,
     preconditioner: Optional[str] = None,
     record_history: bool = False,
+    method: str = "cg",
+    check_every: int = 1,
+    compensated: bool = False,
 ) -> CGResult:
     """Solve the global system A x = b row-partitioned over a device mesh.
 
@@ -60,7 +63,11 @@ def solve_distributed(
       b: global right-hand side (host or device array, length n).
       mesh: 1-D ``jax.sharding.Mesh``; default spans all local devices.
       preconditioner: ``None`` or ``"jacobi"`` (BASELINE config #3).
-      (tol/rtol/maxiter/record_history as in ``solver.cg``.)
+      method: ``"cg"`` or ``"cg1"`` - on a mesh, ``"cg1"`` fuses each
+        iteration's inner products into ONE ``psum`` (half the collective
+        latency of the textbook recurrence; see ``solver.cg``).
+      (tol/rtol/maxiter/record_history/check_every/compensated as in
+      ``solver.cg``.)
 
     Returns:
       ``CGResult`` whose ``x`` is the *global* solution (sharded over the
@@ -78,12 +85,14 @@ def solve_distributed(
         raise ValueError(f"operator shape {a.shape} does not match rhs "
                          f"shape {b.shape}")
 
+    kw = dict(tol=tol, rtol=rtol, maxiter=maxiter, method=method,
+              check_every=check_every, compensated=compensated)
     if isinstance(a, (Stencil2D, Stencil3D)):
-        return _solve_stencil(a, b, mesh, axis, n_shards, tol, rtol, maxiter,
-                              jacobi, record_history)
+        return _solve_stencil(a, b, mesh, axis, n_shards, jacobi,
+                              record_history, kw)
     if isinstance(a, CSRMatrix):
-        return _solve_csr(a, b, mesh, axis, n_shards, tol, rtol, maxiter,
-                          jacobi, record_history)
+        return _solve_csr(a, b, mesh, axis, n_shards, jacobi,
+                          record_history, kw)
     raise TypeError(f"solve_distributed supports CSRMatrix/Stencil2D/"
                     f"Stencil3D, got {type(a).__name__}")
 
@@ -97,8 +106,8 @@ def _result_specs(axis: str, record_history: bool) -> CGResult:
     )
 
 
-def _solve_stencil(a, b, mesh, axis, n_shards, tol, rtol, maxiter, jacobi,
-                   record_history) -> CGResult:
+def _solve_stencil(a, b, mesh, axis, n_shards, jacobi, record_history,
+                   kw) -> CGResult:
     if isinstance(a, Stencil2D):
         local = DistStencil2D.create(a.grid, n_shards, axis_name=axis,
                                      scale=a.scale, dtype=a.dtype,
@@ -114,14 +123,14 @@ def _solve_stencil(a, b, mesh, axis, n_shards, tol, rtol, maxiter, jacobi,
              out_specs=_result_specs(axis, record_history))
     def run(b_local):
         m = JacobiPreconditioner.from_operator(local) if jacobi else None
-        return cg(local, b_local, tol=tol, rtol=rtol, maxiter=maxiter,
-                  m=m, record_history=record_history, axis_name=axis)
+        return cg(local, b_local, m=m, record_history=record_history,
+                  axis_name=axis, **kw)
 
     return jax.jit(run)(b)
 
 
-def _solve_csr(a, b, mesh, axis, n_shards, tol, rtol, maxiter, jacobi,
-               record_history) -> CGResult:
+def _solve_csr(a, b, mesh, axis, n_shards, jacobi, record_history,
+               kw) -> CGResult:
     parts = part.partition_csr(a, n_shards)
     b_np = np.asarray(b)
     b_pad = part.pad_vector(b_np, parts.n_global_padded)
@@ -139,8 +148,8 @@ def _solve_csr(a, b, mesh, axis, n_shards, tol, rtol, maxiter, jacobi,
                      n_local=parts.n_local, axis_name=axis,
                      n_shards=n_shards)
         m = JacobiPreconditioner.from_operator(op) if jacobi else None
-        return cg(op, b_local, tol=tol, rtol=rtol, maxiter=maxiter,
-                  m=m, record_history=record_history, axis_name=axis)
+        return cg(op, b_local, m=m, record_history=record_history,
+                  axis_name=axis, **kw)
 
     res = jax.jit(run)(b_dev, data, cols, rows)
     if parts.n_global != parts.n_global_padded:

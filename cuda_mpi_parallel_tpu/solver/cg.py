@@ -127,6 +127,9 @@ def cg(
     resume_from: Optional[CGCheckpoint] = None,
     return_checkpoint: bool = False,
     iter_cap=None,
+    check_every: int = 1,
+    method: str = "cg",
+    compensated: bool = False,
 ) -> CGResult:
     """Solve A x = b by (preconditioned) conjugate gradients.
 
@@ -153,6 +156,25 @@ def cg(
       iter_cap: optional *traced* iteration bound <= maxiter.  Segmented
         runs vary this instead of ``maxiter`` (which is static and would
         recompile); see ``utils/checkpoint.solve_resumable``.
+      check_every: evaluate the ``while_loop`` convergence predicate only
+        every k iterations (SURVEY SS7 "hard parts": the exact
+        check-every-iteration semantics of ``CUDACG.cu:333`` serializes on
+        the residual reduction each trip; a k-deep inner ``fori_loop``
+        gives XLA k predicate-free iterations to pipeline).  The solve
+        proceeds in blocks of k: iterates are identical to
+        ``check_every=1``, but up to k-1 extra iterations may run past
+        convergence (they further reduce the residual) and the reported
+        iteration count lands on the block boundary.
+      method: ``"cg"`` (textbook recurrence, the reference's algorithm,
+        two reductions per iteration) or ``"cg1"`` (Chronopoulos-Gear
+        single-reduction CG: algebraically the same iterates, but all
+        per-iteration inner products are evaluated at one point and fused
+        into ONE collective - halves the per-iteration ICI latency on a
+        mesh, at the cost of one extra vector recurrence).
+      compensated: use double-float (two-prod / two-sum) inner products
+        (``blas1.dot_compensated``) - the f32-storage answer to the
+        reference's all-f64 arithmetic (``CUDA_R_64F``, ``CUDACG.cu:216``)
+        on hardware with no native f64.
 
     The function is pure and traceable: call it under ``jit`` (or use
     ``solve()`` which jits for you).
@@ -170,12 +192,26 @@ def cg(
         m = IdentityOperator(dim=b.shape[0],
                              _dtype_name=jnp.dtype(b.dtype).name)
 
-    dot = partial(blas1.dot, axis_name=axis_name)
-
     if resume_from is not None and x0 is not None:
         raise ValueError("pass either x0 or resume_from, not both: a "
                          "checkpoint carries its own iterate")
     cap = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
+
+    if method not in ("cg", "cg1"):
+        raise ValueError(f"unknown method {method!r}; expected 'cg' or 'cg1'")
+    if method == "cg1":
+        if resume_from is not None or return_checkpoint:
+            raise ValueError(
+                "checkpoint/resume requires method='cg': CGCheckpoint "
+                "carries the standard recurrence state, not cg1's extra "
+                "vectors")
+        return _cg1(a, b, x0, m=m, preconditioned=preconditioned,
+                    tol=tol, rtol=rtol, maxiter=maxiter, cap=cap,
+                    record_history=record_history, axis_name=axis_name,
+                    check_every=check_every, compensated=compensated)
+
+    dot = partial(blas1.dot_compensated if compensated else blas1.dot,
+                  axis_name=axis_name)
 
     if resume_from is not None:
         x, r, p0 = resume_from.x, resume_from.r, resume_from.p
@@ -204,15 +240,9 @@ def cg(
         k0 = jnp.zeros((), jnp.int32)
         indef0 = jnp.zeros((), jnp.bool_)
 
-    threshold = jnp.maximum(jnp.asarray(tol, b.dtype),
-                            jnp.asarray(rtol, b.dtype) * nrm0)
-    thresh_sq = threshold * threshold
-
-    if record_history:
-        history = jnp.full((maxiter + 1,), jnp.nan, dtype=b.dtype)
-        history = history.at[k0].set(jnp.sqrt(rr0))
-    else:
-        history = jnp.zeros((0,), dtype=b.dtype)
+    thresh_sq = _threshold_sq(tol, rtol, nrm0, b.dtype)
+    history = _history_init(record_history, maxiter, b.dtype, k0,
+                            jnp.sqrt(rr0))
 
     state = _CGState(
         k=k0,
@@ -227,14 +257,17 @@ def cg(
         # rr == 0 means the system is solved exactly; iterating further
         # would divide 0/0 (p = 0 => p.Ap = 0).
         nontrivial = s.rr > 0
-        healthy = jnp.isfinite(s.rr) & jnp.isfinite(s.rho)
+        # rho = r.M^-1 r <= 0 with r != 0 is a preconditioner breakdown
+        # (M not SPD): stop now - _safe_div would otherwise freeze the
+        # iterate and spin to maxiter.
+        healthy = jnp.isfinite(s.rr) & jnp.isfinite(s.rho) & (s.rho > 0)
         return (s.k < maxiter) & (s.k < cap) & unconverged & nontrivial \
             & healthy
 
-    def body(s: _CGState) -> _CGState:
+    def step(s: _CGState) -> _CGState:
         ap = a @ s.p
         p_ap = dot(s.p, ap)                       # cublasDdot :304 -> psum
-        alpha = s.rho / p_ap                      # host arithmetic :311 -> device
+        alpha = _safe_div(s.rho, p_ap)            # host arithmetic :311 -> device
         x = blas1.axpy(alpha, s.p, s.x)           # :314
         r = blas1.axpy(-alpha, ap, s.r)           # :320-321
         rr = dot(r, r)                            # cublasDnrm2 :328 -> psum
@@ -243,7 +276,7 @@ def cg(
             rho = dot(r, z)
         else:
             z, rho = r, rr
-        beta = rho / s.rho                        # :336-339
+        beta = _safe_div(rho, s.rho)              # :336-339
         p = blas1.xpby(z, beta, s.p)              # Dscal :342 + Daxpy :347, fused
         k = s.k + 1
         history = s.history
@@ -251,26 +284,97 @@ def cg(
             history = history.at[k].set(jnp.sqrt(rr))
         return _CGState(
             k=k, x=x, r=r, p=p, rho=rho, rr=rr,
-            indefinite=s.indefinite | (p_ap <= 0),
+            # s.rr > 0 excludes frozen post-exact-solve steps (p = 0 gives
+            # p.Ap = 0, which is not evidence of indefiniteness)
+            indefinite=s.indefinite | ((p_ap <= 0) & (s.rr > 0)),
             history=history,
         )
 
-    final = lax.while_loop(cond, body, state)
+    final = _blocked_while(cond, step, state, check_every,
+                           _block_fits(maxiter, cap, check_every))
 
-    nrm = jnp.sqrt(final.rr)
-    converged = (final.rr < thresh_sq) | (final.rr == 0)
-    breakdown = ~(jnp.isfinite(final.rr) & jnp.isfinite(final.rho))
-    status = jnp.where(
-        converged,
-        jnp.int32(CGStatus.CONVERGED),
-        jnp.where(breakdown, jnp.int32(CGStatus.BREAKDOWN),
-                  jnp.int32(CGStatus.MAXITER)),
-    )
     checkpoint = None
     if return_checkpoint:
         checkpoint = CGCheckpoint(
             x=final.x, r=final.r, p=final.p, rho=final.rho, rr=final.rr,
             nrm0=nrm0, k=final.k, indefinite=final.indefinite)
+    healthy = jnp.isfinite(final.rr) & jnp.isfinite(final.rho) \
+        & ((final.rho > 0) | (final.rr == 0))
+    return _package(final, healthy, thresh_sq, record_history, checkpoint)
+
+
+def _blocked_while(cond, step, state, check_every: int, block_fits=None):
+    """``while cond: step`` with the predicate evaluated every k steps.
+
+    ``check_every > 1`` wraps ``step`` in a k-deep ``fori_loop``, so the
+    loop proceeds in blocks of k iterations with one convergence check
+    per block (SURVEY SS7: the early-exit ``while_loop`` serializes on
+    the residual reduction every trip; on a mesh that is a full ICI
+    round-trip before the next iteration may start).  Iterates are
+    IDENTICAL to ``check_every=1``; the only difference is that up to
+    k-1 extra iterations run past convergence (they keep improving the
+    residual; ``step`` must guard its divisions so an exactly-zero
+    residual freezes rather than NaNs - see ``_safe_div``).  Masking the
+    extra steps instead would need a full-state vector select per inner
+    step, which costs more than it saves (measured 3x on v5e).
+
+    ``block_fits(s)`` says whether a whole k-block stays within the
+    iteration budget; once it goes false, a per-iteration tail loop
+    finishes the remainder so the cap (maxiter / iter_cap) is never
+    overshot - only convergence may be.
+    """
+    if check_every < 1:
+        raise ValueError(f"check_every must be >= 1, got {check_every}")
+    if check_every == 1:
+        return lax.while_loop(cond, step, state)
+
+    def block_body(s):
+        return lax.fori_loop(0, check_every, lambda _, t: step(t), s)
+
+    def block_cond(s):
+        ok = cond(s)
+        if block_fits is not None:
+            ok = ok & block_fits(s)
+        return ok
+
+    state = lax.while_loop(block_cond, block_body, state)
+    return lax.while_loop(cond, step, state)   # tail: < k iterations
+
+
+def _block_fits(maxiter: int, cap: jax.Array, check_every: int):
+    """Predicate: a full check_every block stays within maxiter AND cap."""
+    def fits(s):
+        return (s.k + check_every <= maxiter) & (s.k + check_every <= cap)
+    return fits
+
+
+def _threshold_sq(tol, rtol, nrm0: jax.Array, dtype) -> jax.Array:
+    """Squared convergence threshold: max(tol, rtol*||r0||)^2 (quirk Q3:
+    absolute by default, matching ``CUDACG.cu:333``)."""
+    threshold = jnp.maximum(jnp.asarray(tol, dtype),
+                            jnp.asarray(rtol, dtype) * nrm0)
+    return threshold * threshold
+
+
+def _history_init(record_history: bool, maxiter: int, dtype, k0, nrm0):
+    if record_history:
+        history = jnp.full((maxiter + 1,), jnp.nan, dtype=dtype)
+        return history.at[k0].set(nrm0)
+    return jnp.zeros((0,), dtype=dtype)
+
+
+def _package(final, healthy: jax.Array, thresh_sq: jax.Array,
+             record_history: bool, checkpoint) -> CGResult:
+    """Shared epilogue: convergence/breakdown status + CGResult assembly
+    (everything the reference never reported, quirks Q4/Q7)."""
+    nrm = jnp.sqrt(final.rr)
+    converged = (final.rr < thresh_sq) | (final.rr == 0)
+    status = jnp.where(
+        converged,
+        jnp.int32(CGStatus.CONVERGED),
+        jnp.where(~healthy, jnp.int32(CGStatus.BREAKDOWN),
+                  jnp.int32(CGStatus.MAXITER)),
+    )
     return CGResult(
         x=final.x,
         iterations=final.k,
@@ -281,6 +385,130 @@ def cg(
         residual_history=final.history if record_history else None,
         checkpoint=checkpoint,
     )
+
+
+def _safe_div(num: jax.Array, den: jax.Array) -> jax.Array:
+    """num / den, but a freeze (0) when both are exactly zero.
+
+    Inside a ``check_every`` block, iterations past an exact solve have
+    rho = p.Ap = 0; 0/0 would inject NaN into a state the predicate can
+    no longer veto.  A genuine breakdown (den = 0 with num != 0) still
+    produces inf -> caught by the health check.
+    """
+    zero = (num == 0) & (den == 0)
+    return jnp.where(zero, jnp.zeros_like(num),
+                     num / jnp.where(zero, jnp.ones_like(den), den))
+
+
+class _CG1State(NamedTuple):
+    k: jax.Array
+    x: jax.Array
+    r: jax.Array
+    p: jax.Array
+    s: jax.Array          # A @ p, maintained by recurrence
+    gamma: jax.Array      # r . u  (u = M^-1 r; == ||r||^2 unpreconditioned)
+    rr: jax.Array         # ||r||^2
+    alpha: jax.Array      # step length for the NEXT x/r update
+    indefinite: jax.Array
+    history: jax.Array
+
+
+def _cg1(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
+         record_history, axis_name, check_every, compensated) -> CGResult:
+    """Chronopoulos-Gear single-reduction CG.
+
+    Algebraically the textbook recurrence (same alpha_k / beta_k in exact
+    arithmetic - tests check trajectory parity against ``method="cg"``),
+    rearranged so every per-iteration inner product is evaluated at one
+    point and fused into ONE reduction (``blas1.fused_dots`` - one psum
+    over ICI where the reference pays two blocking host syncs,
+    ``CUDACG.cu:304,328``).  Price: one extra vector recurrence
+    ``s = A p`` (an axpy) and +2 vectors of state.
+    """
+    if compensated:
+        def fdots(pairs):
+            return blas1.fused_dots_compensated(pairs, axis_name=axis_name)
+    elif axis_name is None:
+        # Single device: nothing to fuse into one collective, and a
+        # stack would only hinder XLA's fusion of the reductions.
+        def fdots(pairs):
+            return [jnp.vdot(x, y) for x, y in pairs]
+    else:
+        def fdots(pairs):
+            return list(blas1.fused_dots(pairs, axis_name=axis_name))
+
+    if x0 is None:
+        x = jnp.zeros_like(b)
+        r = b
+    else:
+        x = jnp.asarray(x0, b.dtype)
+        r = b - a @ x
+
+    u0 = m @ r if preconditioned else r
+    w0 = a @ u0
+    if preconditioned:
+        rr0, gamma0, delta0 = fdots([(r, r), (r, u0), (w0, u0)])
+    else:
+        rr0, delta0 = fdots([(r, r), (w0, r)])
+        gamma0 = rr0
+    alpha0 = _safe_div(gamma0, delta0)
+    nrm0 = jnp.sqrt(rr0)
+
+    thresh_sq = _threshold_sq(tol, rtol, nrm0, b.dtype)
+    k0 = jnp.zeros((), jnp.int32)
+    history = _history_init(record_history, maxiter, b.dtype, k0, nrm0)
+
+    state = _CG1State(
+        k=k0,
+        x=x, r=r, p=u0, s=w0,
+        gamma=gamma0, rr=rr0, alpha=alpha0,
+        indefinite=(delta0 <= 0) & (rr0 > 0),
+        history=history,
+    )
+
+    def cond(s: _CG1State) -> jax.Array:
+        unconverged = s.rr >= thresh_sq
+        nontrivial = s.rr > 0
+        # gamma = r.M^-1 r <= 0 with r != 0: preconditioner breakdown
+        # (see the cond in cg()).
+        healthy = jnp.isfinite(s.rr) & jnp.isfinite(s.gamma) \
+            & jnp.isfinite(s.alpha) & (s.gamma > 0)
+        return (s.k < maxiter) & (s.k < cap) & unconverged & nontrivial \
+            & healthy
+
+    def step(st: _CG1State) -> _CG1State:
+        x = blas1.axpy(st.alpha, st.p, st.x)
+        r = blas1.axpy(-st.alpha, st.s, st.r)
+        u = m @ r if preconditioned else r
+        w = a @ u
+        if preconditioned:
+            rr, gamma, delta = fdots([(r, r), (r, u), (w, u)])
+        else:
+            rr, delta = fdots([(r, r), (w, r)])
+            gamma = rr
+        beta = _safe_div(gamma, st.gamma)
+        denom = delta - beta * _safe_div(gamma, st.alpha)  # == p_new . A p_new
+        alpha = _safe_div(gamma, denom)
+        p = blas1.xpby(u, beta, st.p)
+        s_vec = blas1.xpby(w, beta, st.s)
+        k = st.k + 1
+        history = st.history
+        if record_history:
+            history = history.at[k].set(jnp.sqrt(rr))
+        return _CG1State(
+            k=k, x=x, r=r, p=p, s=s_vec,
+            gamma=gamma, rr=rr, alpha=alpha,
+            # rr > 0 excludes frozen post-exact-solve steps (see _CGState)
+            indefinite=st.indefinite | ((denom <= 0) & (rr > 0)),
+            history=history,
+        )
+
+    final = _blocked_while(cond, step, state, check_every,
+                           _block_fits(maxiter, cap, check_every))
+
+    healthy = jnp.isfinite(final.rr) & jnp.isfinite(final.gamma) \
+        & jnp.isfinite(final.alpha) & ((final.gamma > 0) | (final.rr == 0))
+    return _package(final, healthy, thresh_sq, record_history, None)
 
 
 def _as_operator(a) -> LinearOperator:
@@ -294,13 +522,16 @@ def _as_operator(a) -> LinearOperator:
 
 
 @partial(jax.jit, static_argnames=("maxiter", "record_history", "axis_name",
-                                   "return_checkpoint"))
+                                   "return_checkpoint", "check_every",
+                                   "method", "compensated"))
 def _solve_jit(a, b, x0, tol, rtol, maxiter, m, record_history, axis_name,
-               resume_from, return_checkpoint, iter_cap):
+               resume_from, return_checkpoint, iter_cap, check_every,
+               method, compensated):
     return cg(a, b, x0, tol=tol, rtol=rtol, maxiter=maxiter, m=m,
               record_history=record_history, axis_name=axis_name,
               resume_from=resume_from, return_checkpoint=return_checkpoint,
-              iter_cap=iter_cap)
+              iter_cap=iter_cap, check_every=check_every, method=method,
+              compensated=compensated)
 
 
 def solve(
@@ -316,6 +547,9 @@ def solve(
     resume_from: Optional[CGCheckpoint] = None,
     return_checkpoint: bool = False,
     iter_cap: Optional[int] = None,
+    check_every: int = 1,
+    method: str = "cg",
+    compensated: bool = False,
 ) -> CGResult:
     """Jitted single-call entry point: compile once per (operator-structure,
     shape, maxiter) and reuse - the whole solve is one XLA executable.
@@ -332,4 +566,5 @@ def solve(
     rtol_a = jnp.asarray(rtol, b.dtype)
     cap_a = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
     return _solve_jit(a, b, x0, tol_a, rtol_a, maxiter, m, record_history,
-                      None, resume_from, return_checkpoint, cap_a)
+                      None, resume_from, return_checkpoint, cap_a,
+                      check_every, method, compensated)
