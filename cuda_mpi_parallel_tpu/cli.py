@@ -46,8 +46,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--maxiter", type=int, default=2000,
                    help="iteration cap (reference default 2000, "
                         "CUDACG.cu:244)")
-    p.add_argument("--precond", default=None, choices=[None, "jacobi"],
-                   help="preconditioner")
+    p.add_argument("--precond", default=None,
+                   choices=[None, "jacobi", "chebyshev", "bjacobi"],
+                   help="preconditioner (chebyshev = polynomial in A, "
+                        "bjacobi = dense block diagonal; both absent from "
+                        "the reference, which has no preconditioning)")
+    p.add_argument("--precond-degree", type=int, default=4,
+                   help="Chebyshev term count, costing degree-1 matvecs per "
+                        "application (--precond chebyshev)")
+    p.add_argument("--block-size", type=int, default=8,
+                   help="block-Jacobi block size (--precond bjacobi)")
     p.add_argument("--mesh", type=int, default=1,
                    help="number of devices for row-partitioned execution "
                         "(1 = single device)")
@@ -133,6 +141,11 @@ def _build_problem(args):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.precond_degree < 1:
+        raise SystemExit(
+            f"--precond-degree must be >= 1, got {args.precond_degree}")
+    if args.block_size < 1:
+        raise SystemExit(f"--block-size must be >= 1, got {args.block_size}")
     _configure_backend(args)
 
     import jax
@@ -150,16 +163,32 @@ def main(argv=None) -> int:
             if not isinstance(a, (CSRMatrix, Stencil2D, Stencil3D)):
                 raise SystemExit(
                     "--mesh > 1 supports CSR and stencil problems only")
+            if args.precond == "bjacobi":
+                raise SystemExit(
+                    "--precond bjacobi is single-device only (use jacobi "
+                    "or chebyshev with --mesh)")
             return solve_distributed(
                 a, b, mesh=make_mesh(args.mesh), tol=args.tol,
                 rtol=args.rtol, maxiter=args.maxiter,
                 preconditioner=args.precond,
+                precond_degree=args.precond_degree,
                 record_history=args.history)
         from . import solve
         from .models.operators import JacobiPreconditioner
+        from .models.precond import (
+            BlockJacobiPreconditioner,
+            ChebyshevPreconditioner,
+        )
 
-        m = (JacobiPreconditioner.from_operator(a)
-             if args.precond == "jacobi" else None)
+        m = None
+        if args.precond == "jacobi":
+            m = JacobiPreconditioner.from_operator(a)
+        elif args.precond == "chebyshev":
+            m = ChebyshevPreconditioner.from_operator(
+                a, degree=args.precond_degree)
+        elif args.precond == "bjacobi":
+            m = BlockJacobiPreconditioner.from_operator(
+                a, block_size=args.block_size)
         return solve(a, b, tol=args.tol, rtol=args.rtol,
                      maxiter=args.maxiter, m=m,
                      record_history=args.history)

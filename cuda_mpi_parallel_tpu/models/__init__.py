@@ -12,9 +12,16 @@ from .operators import (
     Stencil2D,
     Stencil3D,
 )
+from .precond import (
+    BlockJacobiPreconditioner,
+    ChebyshevPreconditioner,
+    estimate_lmax,
+)
 
 __all__ = [
+    "BlockJacobiPreconditioner",
     "CSRMatrix",
+    "ChebyshevPreconditioner",
     "DenseOperator",
     "ELLMatrix",
     "IdentityOperator",
@@ -22,6 +29,7 @@ __all__ = [
     "LinearOperator",
     "Stencil2D",
     "Stencil3D",
+    "estimate_lmax",
     "poisson",
     "random_spd",
 ]

@@ -1,0 +1,262 @@
+"""Preconditioners beyond Jacobi: Chebyshev polynomial and block-Jacobi.
+
+The reference has **no preconditioning at all** - its CG is the plain
+textbook recurrence (``CUDACG.cu:269-352``) and its only robustness device
+is a hard exit (SURVEY SS5).  ``JacobiPreconditioner`` (models/operators.py)
+is the first rung above it; this module adds the two next rungs that are
+actually TPU-idiomatic:
+
+* ``ChebyshevPreconditioner`` - a fixed-degree Chebyshev polynomial in A
+  applied to the residual.  Matrix-polynomial preconditioning is the
+  TPU-native choice: its only ingredient is the operator's own matvec
+  (stencil shifted-adds / ELL rows - all VPU work, zero data-dependent
+  control flow), it inherits the distributed operator's halo exchange
+  untouched, and it adds NO extra collectives per application (contrast
+  ILU/SSOR triangular solves, which serialize along the sparsity structure
+  and are hostile to both the VPU and ``jit``).
+* ``BlockJacobiPreconditioner`` - M^-1 = blockdiag(A)^-1 with dense blocks:
+  the application is one batched (n_blocks, bs, bs) x (n_blocks, bs)
+  matmul, which XLA maps straight onto the MXU.
+
+Both are symmetric positive definite by construction (tests check this),
+so CG's theory applies to the preconditioned system.
+
+Spectral bounds for Chebyshev come from ``estimate_lmax`` - on-device
+power iteration, jittable, psum-reducing under ``axis_name`` so the same
+code serves the ``shard_map`` path.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops import blas1
+from .operators import CSRMatrix, LinearOperator
+
+
+def estimate_lmax(
+    a: LinearOperator,
+    *,
+    iters: int = 30,
+    axis_name: Optional[str] = None,
+    safety: float = 1.05,
+) -> jax.Array:
+    """Largest-eigenvalue estimate of SPD ``a`` by on-device power iteration.
+
+    Returns ``safety *`` (final Rayleigh quotient) as a 0-d device scalar -
+    jittable, no host sync.  Under ``axis_name`` the operator is the *local*
+    block of a row-partitioned global operator and the reductions psum over
+    the mesh, so the estimate is of the GLOBAL spectrum.
+
+    The deterministic start vector has nonzero overlap with the dominant
+    eigenvector for any symmetric A that is not specially aligned with it;
+    ``iters=30`` gives ~1% accuracy on the Poisson operators (tests check
+    against the analytic 2D/3D Laplacian spectrum).  ``safety`` inflates
+    the estimate so Chebyshev's interval truly covers the spectrum - an
+    eigenvalue outside [lmin, lmax] could flip the polynomial's sign and
+    destroy positive definiteness.
+    """
+    n_local = a.shape[0]
+    dtype = a.dtype
+    # Deterministic pseudo-random start: device-unique via axis_index so
+    # shards do not mirror each other (a mirrored start can be orthogonal
+    # to non-symmetric eigenvectors of the global operator).
+    idx = jnp.arange(n_local, dtype=dtype)
+    if axis_name is not None:
+        idx = idx + lax.axis_index(axis_name).astype(dtype) * n_local
+    v0 = jnp.sin(idx * 12.9898 + 78.233) + 1.5
+
+    def body(_, v):
+        w = a @ v
+        nrm = jnp.sqrt(blas1.dot(w, w, axis_name=axis_name))
+        return w / jnp.maximum(nrm, jnp.asarray(1e-30, dtype))
+
+    v = lax.fori_loop(0, iters, body, v0 / jnp.sqrt(
+        blas1.dot(v0, v0, axis_name=axis_name)))
+    rayleigh = blas1.dot(v, a @ v, axis_name=axis_name)
+    return rayleigh * jnp.asarray(safety, dtype)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("a", "lmin", "lmax"),
+    meta_fields=("degree",),
+)
+@dataclasses.dataclass(frozen=True)
+class ChebyshevPreconditioner(LinearOperator):
+    """M^-1 r = p(A) r with p the ``degree``-term Chebyshev approximation
+    of A^-1 on [lmin, lmax] (p has polynomial degree ``degree - 1``).
+
+    Classic three-term Chebyshev semi-iteration for ``A z = r`` from z0 = 0
+    (Saad, *Iterative Methods for Sparse Linear Systems*, Alg. 12.1), run
+    for ``degree`` steps, with the iterate z a fixed polynomial in A times
+    r - hence symmetric, and positive definite when [lmin, lmax] covers
+    the spectrum.  ``degree=1`` is the single-term p(A) = I/theta
+    (Richardson scaling); each application costs ``degree - 1`` matvecs
+    and no reductions: on a mesh it adds halo ppermutes but NO extra
+    psums per CG iteration.
+
+    Use ``from_operator`` for automatic bounds: lmax by power iteration,
+    ``lmin = lmax / ratio``.  The smaller the ratio, the stronger (and
+    costlier per application) the preconditioner; 30 is the common
+    smoother convention and a good CG default.
+    """
+
+    a: LinearOperator      # the operator being preconditioned (pytree)
+    lmin: jax.Array        # 0-d device scalars: traced, sweeps don't
+    lmax: jax.Array        # recompile
+    degree: int = 4
+
+    @classmethod
+    def from_operator(
+        cls,
+        a: LinearOperator,
+        *,
+        degree: int = 4,
+        ratio: float = 30.0,
+        lmax: Optional[float] = None,
+        lmin: Optional[float] = None,
+        axis_name: Optional[str] = None,
+        power_iters: int = 30,
+    ) -> "ChebyshevPreconditioner":
+        if degree < 1:
+            raise ValueError(f"degree must be >= 1, got {degree}")
+        dtype = a.dtype
+        lmax_v = (estimate_lmax(a, iters=power_iters, axis_name=axis_name)
+                  if lmax is None else jnp.asarray(lmax, dtype))
+        lmin_v = (lmax_v / ratio if lmin is None
+                  else jnp.asarray(lmin, dtype))
+        return cls(a=a, lmin=lmin_v, lmax=lmax_v, degree=degree)
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return self.a.shape
+
+    @property
+    def dtype(self):
+        return self.a.dtype
+
+    def matvec(self, r):
+        theta = (self.lmax + self.lmin) / 2    # interval center
+        delta = (self.lmax - self.lmin) / 2    # interval half-width
+        sigma = theta / delta
+        rho = 1.0 / sigma
+        d = r / theta
+        z = d
+        # degree is static and small: a Python loop unrolls into the jitted
+        # body and XLA fuses each step's vector work around its matvec.
+        for _ in range(self.degree - 1):
+            rho_new = 1.0 / (2.0 * sigma - rho)
+            d = rho_new * rho * d + (2.0 * rho_new / delta) * (r - self.a @ z)
+            z = z + d
+            rho = rho_new
+        return z
+
+    def diagonal(self):
+        raise NotImplementedError(
+            "polynomial preconditioner has no cheap explicit diagonal")
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("inv_blocks",),
+    meta_fields=("dim",),
+)
+@dataclasses.dataclass(frozen=True)
+class BlockJacobiPreconditioner(LinearOperator):
+    """M^-1 = blockdiag(A)^-1 with dense ``(bs, bs)`` blocks.
+
+    Application is a single batched matmul ``(n_blocks, bs, bs) @
+    (n_blocks, bs)`` - MXU work, no gather, no control flow.  Block size 1
+    degenerates to ``JacobiPreconditioner`` exactly (tested).
+
+    Construction happens on host (numpy): the block diagonal of a CSR /
+    dense matrix is extracted, symmetrized within each block, and each
+    block is inverted by dense LU.  Trailing rows when ``bs`` does not
+    divide n are handled by padding with identity.
+    """
+
+    inv_blocks: jax.Array  # (n_blocks, bs, bs)
+    dim: int               # unpadded dimension
+
+    @classmethod
+    def from_operator(cls, a, block_size: int = 8) -> "BlockJacobiPreconditioner":
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        n = a.shape[0]
+        blocks = _extract_diag_blocks(a, block_size)
+        inv = np.linalg.inv(blocks)
+        # Inverting each symmetrized block keeps M^-1 symmetric; SPD of the
+        # global matrix implies SPD of its principal submatrices, so the
+        # inverses are SPD too.
+        return cls(inv_blocks=jnp.asarray(inv), dim=n)
+
+    @property
+    def block_size(self) -> int:
+        return self.inv_blocks.shape[1]
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.dim, self.dim)
+
+    @property
+    def dtype(self):
+        return self.inv_blocks.dtype
+
+    def matvec(self, x):
+        bs = self.block_size
+        n_blocks = self.inv_blocks.shape[0]
+        pad = n_blocks * bs - self.dim
+        xb = jnp.pad(x, (0, pad)).reshape(n_blocks, bs)
+        yb = jnp.einsum("bij,bj->bi", self.inv_blocks, xb)
+        return yb.reshape(-1)[: self.dim]
+
+    def diagonal(self):
+        d = jnp.diagonal(self.inv_blocks, axis1=1, axis2=2).reshape(-1)
+        return d[: self.dim]
+
+
+def _extract_diag_blocks(a, bs: int) -> np.ndarray:
+    """Host-side (n_blocks, bs, bs) block diagonal of ``a``, symmetrized,
+    identity-padded past row n."""
+    n = a.shape[0]
+    n_blocks = -(-n // bs)
+    blocks = np.tile(np.eye(bs), (n_blocks, 1, 1))
+
+    if isinstance(a, CSRMatrix):
+        data = np.asarray(a.data, dtype=np.float64)
+        indices = np.asarray(a.indices)
+        rows = np.asarray(a.rows)
+        cols = indices
+        in_block = rows // bs == cols // bs
+        br = rows[in_block]
+        blocks[br // bs, br % bs, cols[in_block] % bs] = 0.0
+        np.add.at(blocks, (br // bs, br % bs, cols[in_block] % bs),
+                  data[in_block])
+        # restore identity on padded tail rows (cleared only if touched -
+        # they never are, since rows < n <= n_blocks*bs)
+    elif hasattr(a, "to_dense") or isinstance(a, np.ndarray):
+        if n > 8192 and not isinstance(a, np.ndarray):
+            raise ValueError(
+                f"block-Jacobi extraction from a non-CSR operator "
+                f"materializes the dense matrix; n={n} is too large - "
+                f"assemble a CSRMatrix instead")
+        dense = np.asarray(a if isinstance(a, np.ndarray) else a.to_dense(),
+                           dtype=np.float64)
+        for k in range(n_blocks):
+            lo, hi = k * bs, min((k + 1) * bs, n)
+            blocks[k, : hi - lo, : hi - lo] = dense[lo:hi, lo:hi]
+    else:
+        raise TypeError(
+            f"block-Jacobi extraction supports CSRMatrix or dense, got "
+            f"{type(a).__name__}")
+
+    blocks = 0.5 * (blocks + np.transpose(blocks, (0, 2, 1)))
+    return blocks.astype(np.dtype(a.dtype) if hasattr(a, "dtype")
+                         else np.float64)

@@ -35,6 +35,7 @@ from ..models.operators import (
     Stencil2D,
     Stencil3D,
 )
+from ..models.precond import ChebyshevPreconditioner
 from ..solver.cg import CGResult, cg
 from . import partition as part
 from .mesh import make_mesh, shard_vector
@@ -51,6 +52,7 @@ def solve_distributed(
     rtol: float = 0.0,
     maxiter: int = 2000,
     preconditioner: Optional[str] = None,
+    precond_degree: int = 4,
     record_history: bool = False,
     method: str = "cg",
     check_every: int = 1,
@@ -62,7 +64,11 @@ def solve_distributed(
       a: global operator - ``CSRMatrix``, ``Stencil2D`` or ``Stencil3D``.
       b: global right-hand side (host or device array, length n).
       mesh: 1-D ``jax.sharding.Mesh``; default spans all local devices.
-      preconditioner: ``None`` or ``"jacobi"`` (BASELINE config #3).
+      preconditioner: ``None``, ``"jacobi"`` (BASELINE config #3) or
+        ``"chebyshev"`` (polynomial preconditioner of ``precond_degree``;
+        its power-iteration spectral estimate and every application run
+        *inside* the shard_map body, psum/ppermute-reducing over the mesh
+        - see ``models.precond``).
       method: ``"cg"`` or ``"cg1"`` - on a mesh, ``"cg1"`` fuses each
         iteration's inner products into ONE ``psum`` (half the collective
         latency of the textbook recurrence; see ``solver.cg``).
@@ -77,8 +83,7 @@ def solve_distributed(
         mesh = make_mesh(n_devices)
     axis = mesh.axis_names[0]
     n_shards = mesh.devices.size
-    jacobi = preconditioner == "jacobi"
-    if preconditioner not in (None, "jacobi"):
+    if preconditioner not in (None, "jacobi", "chebyshev"):
         raise ValueError(f"unknown preconditioner: {preconditioner!r}")
     b = jnp.asarray(b)
     if a.shape[1] != b.shape[0]:
@@ -87,14 +92,27 @@ def solve_distributed(
 
     kw = dict(tol=tol, rtol=rtol, maxiter=maxiter, method=method,
               check_every=check_every, compensated=compensated)
+    precond = (preconditioner, precond_degree)
     if isinstance(a, (Stencil2D, Stencil3D)):
-        return _solve_stencil(a, b, mesh, axis, n_shards, jacobi,
+        return _solve_stencil(a, b, mesh, axis, n_shards, precond,
                               record_history, kw)
     if isinstance(a, CSRMatrix):
-        return _solve_csr(a, b, mesh, axis, n_shards, jacobi,
+        return _solve_csr(a, b, mesh, axis, n_shards, precond,
                           record_history, kw)
     raise TypeError(f"solve_distributed supports CSRMatrix/Stencil2D/"
                     f"Stencil3D, got {type(a).__name__}")
+
+
+def _make_precond(precond, local, axis: str):
+    """Build the preconditioner INSIDE the shard_map body: reductions in
+    the spectral estimate and applications psum over ``axis``."""
+    name, degree = precond
+    if name == "jacobi":
+        return JacobiPreconditioner.from_operator(local)
+    if name == "chebyshev":
+        return ChebyshevPreconditioner.from_operator(
+            local, degree=degree, axis_name=axis)
+    return None
 
 
 def _result_specs(axis: str, record_history: bool) -> CGResult:
@@ -106,7 +124,7 @@ def _result_specs(axis: str, record_history: bool) -> CGResult:
     )
 
 
-def _solve_stencil(a, b, mesh, axis, n_shards, jacobi, record_history,
+def _solve_stencil(a, b, mesh, axis, n_shards, precond, record_history,
                    kw) -> CGResult:
     if isinstance(a, Stencil2D):
         local = DistStencil2D.create(a.grid, n_shards, axis_name=axis,
@@ -122,14 +140,14 @@ def _solve_stencil(a, b, mesh, axis, n_shards, jacobi, record_history,
     @partial(jax.shard_map, mesh=mesh, in_specs=P(axis),
              out_specs=_result_specs(axis, record_history))
     def run(b_local):
-        m = JacobiPreconditioner.from_operator(local) if jacobi else None
+        m = _make_precond(precond, local, axis)
         return cg(local, b_local, m=m, record_history=record_history,
                   axis_name=axis, **kw)
 
     return jax.jit(run)(b)
 
 
-def _solve_csr(a, b, mesh, axis, n_shards, jacobi, record_history,
+def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
                kw) -> CGResult:
     parts = part.partition_csr(a, n_shards)
     b_np = np.asarray(b)
@@ -147,7 +165,7 @@ def _solve_csr(a, b, mesh, axis, n_shards, jacobi, record_history,
         op = DistCSR(data=data_s[0], cols=cols_s[0], local_rows=rows_s[0],
                      n_local=parts.n_local, axis_name=axis,
                      n_shards=n_shards)
-        m = JacobiPreconditioner.from_operator(op) if jacobi else None
+        m = _make_precond(precond, op, axis)
         return cg(op, b_local, m=m, record_history=record_history,
                   axis_name=axis, **kw)
 
