@@ -47,10 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="iteration cap (reference default 2000, "
                         "CUDACG.cu:244)")
     p.add_argument("--precond", default=None,
-                   choices=[None, "jacobi", "chebyshev", "bjacobi"],
+                   choices=[None, "jacobi", "chebyshev", "bjacobi", "mg"],
                    help="preconditioner (chebyshev = polynomial in A, "
-                        "bjacobi = dense block diagonal; both absent from "
-                        "the reference, which has no preconditioning)")
+                        "bjacobi = dense block diagonal, mg = geometric "
+                        "multigrid V-cycle for --matrix-free stencils; all "
+                        "absent from the reference, which has no "
+                        "preconditioning)")
     p.add_argument("--precond-degree", type=int, default=4,
                    help="Chebyshev term count, costing degree-1 matvecs per "
                         "application (--precond chebyshev)")
@@ -189,6 +191,15 @@ def main(argv=None) -> int:
         elif args.precond == "bjacobi":
             m = BlockJacobiPreconditioner.from_operator(
                 a, block_size=args.block_size)
+        elif args.precond == "mg":
+            from .models.multigrid import MultigridPreconditioner
+            from .models.operators import Stencil2D, Stencil3D
+
+            if not isinstance(a, (Stencil2D, Stencil3D)):
+                raise SystemExit(
+                    "--precond mg needs a stencil operator: use a poisson* "
+                    "problem with --matrix-free")
+            m = MultigridPreconditioner.from_operator(a)
         return solve(a, b, tol=args.tol, rtol=args.rtol,
                      maxiter=args.maxiter, m=m,
                      record_history=args.history)

@@ -12,6 +12,7 @@ from .operators import (
     Stencil2D,
     Stencil3D,
 )
+from .multigrid import MultigridPreconditioner
 from .precond import (
     BlockJacobiPreconditioner,
     ChebyshevPreconditioner,
@@ -27,6 +28,7 @@ __all__ = [
     "IdentityOperator",
     "JacobiPreconditioner",
     "LinearOperator",
+    "MultigridPreconditioner",
     "Stencil2D",
     "Stencil3D",
     "estimate_lmax",

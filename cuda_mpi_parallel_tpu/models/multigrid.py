@@ -1,0 +1,299 @@
+"""Geometric multigrid V-cycle preconditioner for the stencil operators.
+
+The reference solves its system with bare CG (``CUDACG.cu:269-352``); for
+the Poisson-family problems that dominate the BASELINE configs, multigrid
+preconditioning changes the *algorithmic* complexity: CG alone needs
+O(sqrt(cond)) ~ O(n_grid) iterations on the Laplacian, MG-preconditioned
+CG needs O(1) - measured 12 -> 16 iterations from 64^2 to 512^2 at rtol
+1e-8 (versus 199 -> 1500+ unpreconditioned); tests assert the
+grid-independence.
+
+TPU-first construction - every ingredient maps onto the VPU with static
+shapes and no gather:
+
+* **Hierarchy**: cell-centered 2x-per-axis coarsening.  Every level is the
+  SAME matrix-free unit stencil at scale/4 per level - no assembled coarse
+  matrices, no setup beyond a tuple of scales.  (Consistency: the
+  transfers below have unit row sums, so on smooth fields
+  ``R A_h P ~ s h^2 (-Lap) = (s/4) h_c^2 (-Lap)`` - the unit stencil at a
+  quarter the scale.  Piecewise-constant transfers with the exact-Galerkin
+  s/2 scaling were measured NOT grid-independent - 28/40/55 iterations at
+  64/128/256 - and are not used.)
+* **Transfers**: separable cell-centered bilinear interpolation
+  (per-axis weights 3/4, 1/4) and its adjoint-over-2 full-weighting
+  restriction (per-axis weights 1/8, 3/8, 3/8, 1/8).  Both are
+  pad + reshape + fused multiply-adds: no gathers, no strided slices
+  (interleaving is a stack+reshape, which XLA lowers to a relayout).
+* **Smoother**: weighted Jacobi - the stencil diagonal is constant, so a
+  sweep is ``z += omega/diag * (r - A z)``, one fused elementwise pass
+  around the stencil matvec.  Pre- and post-sweep counts are equal, making
+  the V-cycle a symmetric operator; it is positive definite because
+  ``omega * lmax(D^-1 A) < 2`` (the Laplacian has lmax(D^-1 A) < 2, so
+  the default omega=0.8 is safe).  Symmetry needs only R = c P^T with a
+  symmetric coarse solve - it does NOT need exact Galerkin coarse
+  operators - so the rediscretized hierarchy above is legitimate inside
+  plain (non-flexible) CG.  Tests check SPD-ness explicitly.
+* **Distributed**: the same V-cycle runs on ``DistStencil2D/3D`` local
+  blocks - coarsening halves the *local* leading extent (2-cell aggregates
+  never straddle a shard boundary when the local extent is even), each
+  level's smoother matvec does its own ppermute halo exchange, and the
+  transfers exchange one boundary plane along the partitioned axis (their
+  3/4 + 1/4 stencils reach one cell across the shard edge).  When the
+  local extent can no longer halve, the (tiny) residual is ``all_gather``-
+  ed once and the remaining levels continue on the replicated global
+  coarse grid, identically on every shard - so the distributed hierarchy
+  is EXACTLY the single-device hierarchy (tests assert iteration parity),
+  at the cost of one small collective per cycle at the gather level.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .operators import LinearOperator, Stencil2D, Stencil3D
+
+#: per-level scale factor for the rediscretized coarse operator (see
+#: module docstring: unit-row-sum transfers make RAP ~ scale/4)
+_COARSE_SCALE = 0.25
+
+
+def _can_halve(grid, min_extent: int) -> bool:
+    return not any(g % 2 or g // 2 < min_extent for g in grid)
+
+
+def _level_ops(a, min_extent: int, max_levels: int):
+    """Operator hierarchies by halving grid extents, finest first.
+
+    Returns ``(ops, global_ops)``.  For single-device Stencil2D/3D,
+    ``global_ops`` is empty and ``ops`` halves until an extent goes odd or
+    would drop below ``min_extent``.  For DistStencil2D/3D, ``ops`` halves
+    the LOCAL leading extent as far as it can; if the *global* grid can
+    still coarsen past that point, ``global_ops`` continues the hierarchy
+    with replicated single-device stencils (applied identically on every
+    shard after one ``all_gather`` - see ``_vcycle``), so the combined
+    hierarchy has exactly the single-device depth.
+
+    Coarse levels always use ``backend="xla"``: they are far below the
+    pallas HBM threshold, and the pallas kernels' tile-divisibility
+    constraints do not generally survive halving.
+    """
+    from ..parallel.operators import DistStencil2D, DistStencil3D
+
+    ops = [a]
+    global_ops = []
+    while len(ops) + len(global_ops) < max_levels:
+        op = ops[-1]
+        if isinstance(op, (Stencil2D, Stencil3D)):
+            if not _can_halve(op.grid, min_extent):
+                break
+            coarse = dataclasses.replace(
+                op, scale=op.scale * _COARSE_SCALE,
+                grid=tuple(g // 2 for g in op.grid), backend="xla")
+        elif isinstance(op, (DistStencil2D, DistStencil3D)):
+            lg = op.local_grid
+            if _can_halve(lg, min_extent):
+                coarse = dataclasses.replace(
+                    op, scale=op.scale * _COARSE_SCALE,
+                    local_grid=tuple(g // 2 for g in lg), backend="xla")
+            else:
+                # local extent exhausted: continue on the replicated
+                # global grid if it can still coarsen
+                ggrid = (lg[0] * op.n_shards,) + tuple(lg[1:])
+                if not _can_halve(ggrid, min_extent):
+                    break
+                cls2 = Stencil2D if len(ggrid) == 2 else Stencil3D
+                g_first = cls2(scale=op.scale * _COARSE_SCALE,
+                               grid=tuple(g // 2 for g in ggrid),
+                               backend="xla", _dtype_name=op._dtype_name)
+                global_ops.append(g_first)
+                while (len(ops) + len(global_ops) < max_levels
+                       and _can_halve(global_ops[-1].grid, min_extent)):
+                    prev = global_ops[-1]
+                    global_ops.append(dataclasses.replace(
+                        prev, scale=prev.scale * _COARSE_SCALE,
+                        grid=tuple(g // 2 for g in prev.grid)))
+                break
+        else:
+            raise TypeError(
+                f"multigrid supports Stencil2D/3D and DistStencil2D/3D, "
+                f"got {type(op).__name__}")
+        ops.append(coarse)
+    return tuple(ops), tuple(global_ops)
+
+
+def _op_grid(op) -> Tuple[int, ...]:
+    return op.grid if hasattr(op, "grid") else op.local_grid
+
+
+def _op_dist(op):
+    """(axis_name, n_shards) for distributed stencil blocks, else None."""
+    if hasattr(op, "axis_name") and getattr(op, "n_shards", 1) > 1:
+        return op.axis_name, op.n_shards
+    return None
+
+
+def _pad_axis0(u: jax.Array, dist) -> jax.Array:
+    """Pad axis 0 with one plane per side: neighbor halos when partitioned
+    (``lax.ppermute``), zeros (Dirichlet) at global domain edges."""
+    if dist is None:
+        return jnp.pad(u, [(1, 1)] + [(0, 0)] * (u.ndim - 1))
+    from ..parallel.halo import exchange_halo
+
+    axis_name, n_shards = dist
+    lo, hi = exchange_halo(u, axis_name, n_shards)
+    return jnp.concatenate([lo, u, hi], axis=0)
+
+
+def _p1d(c: jax.Array, axis: int, dist=None) -> jax.Array:
+    """Cell-centered bilinear prolongation along ``axis``: nc -> 2nc.
+
+    Fine cell 2I gets 3/4 c(I) + 1/4 c(I-1); fine cell 2I+1 gets
+    3/4 c(I) + 1/4 c(I+1); out-of-range neighbors are zero (Dirichlet)
+    or the neighbor shard's plane (distributed leading axis).
+    """
+    cm = jnp.moveaxis(c, axis, 0)
+    pad = _pad_axis0(cm, dist if axis == 0 else None)
+    even = 0.75 * cm + 0.25 * pad[:-2]
+    odd = 0.75 * cm + 0.25 * pad[2:]
+    out = jnp.stack([even, odd], axis=1).reshape((-1,) + cm.shape[1:])
+    return jnp.moveaxis(out, 0, axis)
+
+
+def _r1d(f: jax.Array, axis: int, dist=None) -> jax.Array:
+    """Full-weighting restriction along ``axis`` (adjoint of ``_p1d``
+    over 2): coarse I gets 3/8 (f(2I) + f(2I+1)) + 1/8 (f(2I-1) + f(2I+2)).
+    """
+    fm = jnp.moveaxis(f, axis, 0)
+    n2 = fm.shape[0]
+    pad = _pad_axis0(fm, dist if axis == 0 else None)
+    pairs = fm.reshape((n2 // 2, 2) + fm.shape[1:])
+    left = pad[:-2].reshape((n2 // 2, 2) + fm.shape[1:])[:, 0]   # f(2I-1)
+    right = pad[2:].reshape((n2 // 2, 2) + fm.shape[1:])[:, 1]   # f(2I+2)
+    out = 0.375 * (pairs[:, 0] + pairs[:, 1]) + 0.125 * (left + right)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def _restrict(r: jax.Array, fine_grid, dist=None) -> jax.Array:
+    f = r.reshape(fine_grid)
+    for ax in range(len(fine_grid)):
+        f = _r1d(f, ax, dist)
+    return f.reshape(-1)
+
+
+def _prolong(e: jax.Array, fine_grid, dist=None) -> jax.Array:
+    c = e.reshape(tuple(g // 2 for g in fine_grid))
+    for ax in range(len(fine_grid)):
+        c = _p1d(c, ax, dist)
+    return c.reshape(-1)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("ops", "global_ops"),
+    meta_fields=("omega", "pre_sweeps", "post_sweeps", "coarse_sweeps"),
+)
+@dataclasses.dataclass(frozen=True)
+class MultigridPreconditioner(LinearOperator):
+    """One symmetric V(nu, nu) cycle of geometric multigrid as M^-1."""
+
+    ops: Tuple  # level operators, finest first (pytree of stencils)
+    global_ops: Tuple = ()  # replicated coarse continuation (distributed)
+    omega: float = 0.8
+    pre_sweeps: int = 1
+    post_sweeps: int = 1
+    coarse_sweeps: int = 16
+
+    @classmethod
+    def from_operator(
+        cls,
+        a,
+        *,
+        omega: float = 0.8,
+        sweeps: int = 1,
+        coarse_sweeps: int = 16,
+        min_extent: int = 2,
+        max_levels: int = 16,
+    ) -> "MultigridPreconditioner":
+        """Build the hierarchy from a (Dist)Stencil2D/3D operator.
+
+        ``sweeps`` sets BOTH pre- and post-smoothing counts (they must be
+        equal for symmetry, so only one knob is exposed).
+        """
+        ops, global_ops = _level_ops(a, min_extent, max_levels)
+        return cls(ops=ops, global_ops=global_ops, omega=omega,
+                   pre_sweeps=sweeps, post_sweeps=sweeps,
+                   coarse_sweeps=coarse_sweeps)
+
+    @property
+    def n_levels(self) -> int:
+        return len(self.ops) + len(self.global_ops)
+
+    @property
+    def shape(self):
+        return self.ops[0].shape
+
+    @property
+    def dtype(self):
+        return self.ops[0].dtype
+
+    def matvec(self, r):
+        return self._vcycle(0, r)
+
+    def _smooth(self, op, z, r, sweeps: int):
+        inv_diag = 1.0 / op.diagonal()[0]  # constant-diagonal stencils
+        w = jnp.asarray(self.omega, r.dtype) * inv_diag
+        for _ in range(sweeps):
+            z = z + w * (r - op @ z)
+        return z
+
+    def _vcycle(self, level: int, r, ops=None):
+        ops = self.ops if ops is None else ops
+        op = ops[level]
+        last = level == len(ops) - 1
+        if last and ops is self.ops and self.global_ops:
+            # Distributed gather level: the local extent cannot halve
+            # further, but the global grid can.  Smooth locally, then
+            # all_gather the residual (the grid here is tiny - this is
+            # O(coarse n) over ICI once per cycle) and continue the exact
+            # single-device hierarchy, replicated on every shard.
+            return self._gather_level(op, r)
+        if last:
+            # Coarsest level: omega-Jacobi iterations from z0 = 0 - a fixed
+            # symmetric polynomial in A (keeps the whole cycle symmetric,
+            # unlike an inner CG solve which would vary with r).
+            return self._smooth(op, jnp.zeros_like(r), r,
+                                self.coarse_sweeps)
+        grid = _op_grid(op)
+        dist = _op_dist(op)
+        # pre-smooth from zero initial guess
+        z = self._smooth(op, jnp.zeros_like(r), r, self.pre_sweeps)
+        # coarse-grid correction on the residual
+        rc = _restrict(r - op @ z, grid, dist)
+        ec = self._vcycle(level + 1, rc, ops)
+        z = z + _prolong(ec, grid, dist)
+        # post-smooth
+        return self._smooth(op, z, r, self.post_sweeps)
+
+    def _gather_level(self, op, r):
+        from jax import lax
+
+        axis_name, n_shards = op.axis_name, op.n_shards
+        lg = op.local_grid
+        ggrid = (lg[0] * n_shards,) + tuple(lg[1:])
+        z = self._smooth(op, jnp.zeros_like(r), r, self.pre_sweeps)
+        resid_g = lax.all_gather(r - op @ z, axis_name, tiled=True)
+        rc_g = _restrict(resid_g, ggrid)
+        ec_g = self._vcycle(0, rc_g, self.global_ops)
+        e_fine = _prolong(ec_g, ggrid).reshape(ggrid)
+        i = lax.axis_index(axis_name)
+        e_local = lax.dynamic_slice_in_dim(e_fine, i * lg[0], lg[0], axis=0)
+        z = z + e_local.reshape(-1)
+        return self._smooth(op, z, r, self.post_sweeps)
+
+    def diagonal(self):
+        raise NotImplementedError(
+            "multigrid preconditioner has no cheap explicit diagonal")

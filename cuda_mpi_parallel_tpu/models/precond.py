@@ -10,10 +10,11 @@ actually TPU-idiomatic:
   applied to the residual.  Matrix-polynomial preconditioning is the
   TPU-native choice: its only ingredient is the operator's own matvec
   (stencil shifted-adds / ELL rows - all VPU work, zero data-dependent
-  control flow), it inherits the distributed operator's halo exchange
-  untouched, and it adds NO extra collectives per application (contrast
-  ILU/SSOR triangular solves, which serialize along the sparsity structure
-  and are hostile to both the VPU and ``jit``).
+  control flow), it inherits the distributed operator's communication
+  untouched, and for the halo-exchange stencil operators it adds no
+  collectives beyond those ppermutes (contrast ILU/SSOR triangular
+  solves, which serialize along the sparsity structure and are hostile
+  to both the VPU and ``jit``).
 * ``BlockJacobiPreconditioner`` - M^-1 = blockdiag(A)^-1 with dense blocks:
   the application is one batched (n_blocks, bs, bs) x (n_blocks, bs)
   matmul, which XLA maps straight onto the MXU.
@@ -99,8 +100,12 @@ class ChebyshevPreconditioner(LinearOperator):
     r - hence symmetric, and positive definite when [lmin, lmax] covers
     the spectrum.  ``degree=1`` is the single-term p(A) = I/theta
     (Richardson scaling); each application costs ``degree - 1`` matvecs
-    and no reductions: on a mesh it adds halo ppermutes but NO extra
-    psums per CG iteration.
+    and no reductions.  On a mesh the application inherits whatever
+    communication the operator's matvec does: for the halo-exchange
+    stencil operators that is ppermutes only - NO extra psums per CG
+    iteration - but for ``DistCSR`` each matvec all-gathers x, so the
+    polynomial repeats that O(n)-volume collective degree - 1 times;
+    prefer low degrees (or jacobi) for distributed general CSR.
 
     Use ``from_operator`` for automatic bounds: lmax by power iteration,
     ``lmin = lmax / ratio``.  The smaller the ratio, the stronger (and

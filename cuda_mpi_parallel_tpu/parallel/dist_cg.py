@@ -35,6 +35,7 @@ from ..models.operators import (
     Stencil2D,
     Stencil3D,
 )
+from ..models.multigrid import MultigridPreconditioner
 from ..models.precond import ChebyshevPreconditioner
 from ..solver.cg import CGResult, cg
 from . import partition as part
@@ -83,8 +84,16 @@ def solve_distributed(
         mesh = make_mesh(n_devices)
     axis = mesh.axis_names[0]
     n_shards = mesh.devices.size
-    if preconditioner not in (None, "jacobi", "chebyshev"):
+    if preconditioner == "bjacobi":
+        raise ValueError(
+            "preconditioner='bjacobi' is single-device only (its dense "
+            "block extraction is host-side); use 'jacobi', 'chebyshev' "
+            "or 'mg' on a mesh")
+    if preconditioner not in (None, "jacobi", "chebyshev", "mg"):
         raise ValueError(f"unknown preconditioner: {preconditioner!r}")
+    if preconditioner == "mg" and not isinstance(a, (Stencil2D, Stencil3D)):
+        raise ValueError("preconditioner='mg' needs a stencil operator "
+                         "(geometric multigrid has no CSR hierarchy)")
     b = jnp.asarray(b)
     if a.shape[1] != b.shape[0]:
         raise ValueError(f"operator shape {a.shape} does not match rhs "
@@ -112,6 +121,8 @@ def _make_precond(precond, local, axis: str):
     if name == "chebyshev":
         return ChebyshevPreconditioner.from_operator(
             local, degree=degree, axis_name=axis)
+    if name == "mg":
+        return MultigridPreconditioner.from_operator(local)
     return None
 
 
