@@ -50,8 +50,13 @@ def bench_headline(device=None):
     # tol=0 forces exactly maxiter iterations.  Per-iteration throughput is
     # measured as a delta between two iteration counts, cancelling the fixed
     # per-call dispatch overhead (substantial on tunneled devices).
+    # check_every=32 evaluates the while_loop convergence predicate once per
+    # 32-iteration block: iterates are IDENTICAL (solver.cg docstring), but
+    # the loop trips lose the per-iteration predicate serialization -
+    # measured ~30% faster per iteration on v5e at this size.
     def run(it):
-        return jax.jit(lambda v: solve(op, v, tol=0.0, maxiter=it).x)
+        return jax.jit(
+            lambda v: solve(op, v, tol=0.0, maxiter=it, check_every=32).x)
 
     f_lo, f_hi = run(ITERS_LO), run(ITERS_HI)
     t_lo, _ = time_fn(f_lo, b, warmup=1, repeats=5, reduce="median")

@@ -76,6 +76,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stencil matvec backend for --matrix-free problems: "
                         "XLA fused adds or the pallas slab-DMA kernel "
                         "(auto picks by grid size)")
+    p.add_argument("--method", default="cg",
+                   choices=["cg", "cg1", "pipecg"],
+                   help="CG recurrence: textbook (the reference's, two "
+                        "reductions/iter), Chronopoulos-Gear single-"
+                        "reduction, or Ghysels-Vanroose pipelined "
+                        "(reduction overlaps the matvec)")
+    p.add_argument("--check-every", type=int, default=1,
+                   help="evaluate convergence every k iterations (identical "
+                        "iterates; ~30%% faster per iteration at k=32 on "
+                        "v5e, up to k-1 extra iterations past convergence)")
     p.add_argument("--history", action="store_true",
                    help="print per-iteration residual trace")
     p.add_argument("--json", action="store_true",
@@ -174,7 +184,8 @@ def main(argv=None) -> int:
                 rtol=args.rtol, maxiter=args.maxiter,
                 preconditioner=args.precond,
                 precond_degree=args.precond_degree,
-                record_history=args.history)
+                record_history=args.history, method=args.method,
+                check_every=args.check_every)
         from . import solve
         from .models.operators import JacobiPreconditioner
         from .models.precond import (
@@ -202,7 +213,8 @@ def main(argv=None) -> int:
             m = MultigridPreconditioner.from_operator(a)
         return solve(a, b, tol=args.tol, rtol=args.rtol,
                      maxiter=args.maxiter, m=m,
-                     record_history=args.history)
+                     record_history=args.history, method=args.method,
+                     check_every=args.check_every)
 
     with profile_trace(args.profile):
         elapsed, result = time_fn(run, warmup=1, repeats=1)

@@ -70,9 +70,11 @@ def solve_distributed(
         its power-iteration spectral estimate and every application run
         *inside* the shard_map body, psum/ppermute-reducing over the mesh
         - see ``models.precond``).
-      method: ``"cg"`` or ``"cg1"`` - on a mesh, ``"cg1"`` fuses each
-        iteration's inner products into ONE ``psum`` (half the collective
-        latency of the textbook recurrence; see ``solver.cg``).
+      method: ``"cg"``, ``"cg1"`` or ``"pipecg"`` - on a mesh, ``"cg1"``
+        fuses each iteration's inner products into ONE ``psum`` (half the
+        collective latency of the textbook recurrence) and ``"pipecg"``
+        additionally overlaps that psum with the iteration's local
+        matvec+preconditioner compute (see ``solver.cg``).
       (tol/rtol/maxiter/record_history/check_every/compensated as in
       ``solver.cg``.)
 

@@ -166,11 +166,16 @@ def cg(
         convergence (they further reduce the residual) and the reported
         iteration count lands on the block boundary.
       method: ``"cg"`` (textbook recurrence, the reference's algorithm,
-        two reductions per iteration) or ``"cg1"`` (Chronopoulos-Gear
+        two reductions per iteration), ``"cg1"`` (Chronopoulos-Gear
         single-reduction CG: algebraically the same iterates, but all
         per-iteration inner products are evaluated at one point and fused
         into ONE collective - halves the per-iteration ICI latency on a
-        mesh, at the cost of one extra vector recurrence).
+        mesh, at the cost of one extra vector recurrence), or ``"pipecg"``
+        (Ghysels-Vanroose pipelined CG: one fused reduction per iteration
+        whose inputs are ready BEFORE the iteration's matvec+precond, so
+        XLA can overlap the psum with local compute - the strongest
+        latency-hiding variant on a mesh, at the cost of three extra
+        vector recurrences and mild finite-precision residual drift).
       compensated: use double-float (two-prod / two-sum) inner products
         (``blas1.dot_compensated``) - the f32-storage answer to the
         reference's all-f64 arithmetic (``CUDA_R_64F``, ``CUDACG.cu:216``)
@@ -197,15 +202,17 @@ def cg(
                          "checkpoint carries its own iterate")
     cap = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
 
-    if method not in ("cg", "cg1"):
-        raise ValueError(f"unknown method {method!r}; expected 'cg' or 'cg1'")
-    if method == "cg1":
+    if method not in ("cg", "cg1", "pipecg"):
+        raise ValueError(f"unknown method {method!r}; expected 'cg', 'cg1' "
+                         f"or 'pipecg'")
+    if method != "cg":
         if resume_from is not None or return_checkpoint:
             raise ValueError(
                 "checkpoint/resume requires method='cg': CGCheckpoint "
-                "carries the standard recurrence state, not cg1's extra "
-                "vectors")
-        return _cg1(a, b, x0, m=m, preconditioned=preconditioned,
+                "carries the standard recurrence state, not the variants' "
+                "extra vectors")
+        impl = _cg1 if method == "cg1" else _pipecg
+        return impl(a, b, x0, m=m, preconditioned=preconditioned,
                     tol=tol, rtol=rtol, maxiter=maxiter, cap=cap,
                     record_history=record_history, axis_name=axis_name,
                     check_every=check_every, compensated=compensated)
@@ -400,6 +407,45 @@ def _safe_div(num: jax.Array, den: jax.Array) -> jax.Array:
                      num / jnp.where(zero, jnp.ones_like(den), den))
 
 
+def _make_fdots(compensated: bool, axis_name):
+    """Fused-inner-products strategy shared by the cg1/pipecg variants:
+    compensated double-float, plain stacked-psum (mesh), or plain vdots
+    (single device, where stacking would only hinder XLA fusion)."""
+    if compensated:
+        def fdots(pairs):
+            return blas1.fused_dots_compensated(pairs, axis_name=axis_name)
+    elif axis_name is None:
+        def fdots(pairs):
+            return [jnp.vdot(x, y) for x, y in pairs]
+    else:
+        def fdots(pairs):
+            return list(blas1.fused_dots(pairs, axis_name=axis_name))
+    return fdots
+
+
+def _init_xr(a, b, x0):
+    """x0/r0 init shared by all variants (x0=None takes the reference's
+    copy-only fast path, CUDACG.cu:247-259)."""
+    if x0 is None:
+        return jnp.zeros_like(b), b
+    x = jnp.asarray(x0, b.dtype)
+    return x, b - a @ x
+
+
+def _variant_cond(maxiter, cap, thresh_sq):
+    """Loop predicate shared by cg1/pipecg: unconverged, nontrivial, and
+    healthy (gamma = r . M^-1 r <= 0 with r != 0 is a preconditioner
+    breakdown - stop now rather than spin to maxiter)."""
+    def cond(st) -> jax.Array:
+        unconverged = st.rr >= thresh_sq
+        nontrivial = st.rr > 0
+        healthy = jnp.isfinite(st.rr) & jnp.isfinite(st.gamma) \
+            & jnp.isfinite(st.alpha) & (st.gamma > 0)
+        return (st.k < maxiter) & (st.k < cap) & unconverged & nontrivial \
+            & healthy
+    return cond
+
+
 class _CG1State(NamedTuple):
     k: jax.Array
     x: jax.Array
@@ -425,24 +471,8 @@ def _cg1(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
     ``CUDACG.cu:304,328``).  Price: one extra vector recurrence
     ``s = A p`` (an axpy) and +2 vectors of state.
     """
-    if compensated:
-        def fdots(pairs):
-            return blas1.fused_dots_compensated(pairs, axis_name=axis_name)
-    elif axis_name is None:
-        # Single device: nothing to fuse into one collective, and a
-        # stack would only hinder XLA's fusion of the reductions.
-        def fdots(pairs):
-            return [jnp.vdot(x, y) for x, y in pairs]
-    else:
-        def fdots(pairs):
-            return list(blas1.fused_dots(pairs, axis_name=axis_name))
-
-    if x0 is None:
-        x = jnp.zeros_like(b)
-        r = b
-    else:
-        x = jnp.asarray(x0, b.dtype)
-        r = b - a @ x
+    fdots = _make_fdots(compensated, axis_name)
+    x, r = _init_xr(a, b, x0)
 
     u0 = m @ r if preconditioned else r
     w0 = a @ u0
@@ -466,15 +496,7 @@ def _cg1(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
         history=history,
     )
 
-    def cond(s: _CG1State) -> jax.Array:
-        unconverged = s.rr >= thresh_sq
-        nontrivial = s.rr > 0
-        # gamma = r.M^-1 r <= 0 with r != 0: preconditioner breakdown
-        # (see the cond in cg()).
-        healthy = jnp.isfinite(s.rr) & jnp.isfinite(s.gamma) \
-            & jnp.isfinite(s.alpha) & (s.gamma > 0)
-        return (s.k < maxiter) & (s.k < cap) & unconverged & nontrivial \
-            & healthy
+    cond = _variant_cond(maxiter, cap, thresh_sq)
 
     def step(st: _CG1State) -> _CG1State:
         x = blas1.axpy(st.alpha, st.p, st.x)
@@ -499,6 +521,151 @@ def _cg1(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
             k=k, x=x, r=r, p=p, s=s_vec,
             gamma=gamma, rr=rr, alpha=alpha,
             # rr > 0 excludes frozen post-exact-solve steps (see _CGState)
+            indefinite=st.indefinite | ((denom <= 0) & (rr > 0)),
+            history=history,
+        )
+
+    final = _blocked_while(cond, step, state, check_every,
+                           _block_fits(maxiter, cap, check_every))
+
+    healthy = jnp.isfinite(final.rr) & jnp.isfinite(final.gamma) \
+        & jnp.isfinite(final.alpha) & ((final.gamma > 0) | (final.rr == 0))
+    return _package(final, healthy, thresh_sq, record_history, None)
+
+
+def _replace_cadence(dtype) -> int:
+    """Pipelined-CG residual-replacement cadence.
+
+    The recurrence drift grows fast enough in f32 that replacement must
+    fire BEFORE the drift is large - once the recurrence residual and the
+    true residual have separated, replacing no longer rescues the solve
+    (measured on 128^2 Poisson f32: no replacement stalls at true
+    ||r||/||r0|| ~ 2e-3, cadence 64 also stalls ~2e-3, cadence 16
+    converges to ~3e-6).  The ~3-matvec recompute is ~19% extra matvec
+    work at cadence 16 - the price of f32 pipelining.  In f64 drift is
+    slow and a long cadence keeps the overhead negligible.
+    """
+    return 16 if jnp.dtype(dtype).itemsize <= 4 else 512
+
+
+class _PipeCGState(NamedTuple):
+    k: jax.Array
+    x: jax.Array
+    r: jax.Array
+    u: jax.Array          # M^-1 r
+    w: jax.Array          # A u
+    p: jax.Array
+    s: jax.Array          # A p
+    q: jax.Array          # M^-1 s
+    z: jax.Array          # A q
+    gamma: jax.Array      # r . u
+    rr: jax.Array         # ||r||^2
+    alpha: jax.Array
+    indefinite: jax.Array
+    history: jax.Array
+
+
+def _pipecg(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
+            record_history, axis_name, check_every, compensated) -> CGResult:
+    """Ghysels-Vanroose pipelined CG (same iterates as ``"cg"`` in exact
+    arithmetic; tests check trajectory parity).
+
+    The defining property: each iteration's ONE fused reduction consumes
+    only vectors from the previous iteration's updates (r, u, w), while
+    the iteration's matvec ``n = A(M^-1 w)`` has no data dependence on the
+    reduction - so on a mesh, XLA can overlap the psum's ICI latency with
+    the local stencil/SpMV compute.  The reference, for contrast, pays two
+    *blocking host* syncs per iteration with nothing overlapped
+    (``CUDACG.cu:304,328``).  Cost: three extra vector recurrences
+    (s = A p, q = M^-1 p-analog, z = A q) and the usual pipelined-CG
+    finite-precision residual drift (the recurrence r drifts from
+    b - A x over many iterations; tightest-tolerance solves should prefer
+    method='cg').
+
+    Drift is bounded by periodic RESIDUAL REPLACEMENT (Ghysels-Vanroose
+    SS4): every ``_replace_cadence(dtype)`` iterations the derived vectors are
+    recomputed from definition (r = b - A x, u = M r, w = A u, s = A p,
+    q = M s, z = A q) under a ``lax.cond``.  Without it, f32 pipecg
+    stagnates far above the tolerance on ill-conditioned systems
+    (measured: 512^2 Poisson f32 never reached rtol 1e-5; with
+    replacement it matches cg's iteration count).
+    """
+    fdots = _make_fdots(compensated, axis_name)
+    x, r = _init_xr(a, b, x0)
+
+    u0 = m @ r if preconditioned else r
+    w0 = a @ u0
+    if preconditioned:
+        rr0, gamma0, delta0 = fdots([(r, r), (r, u0), (w0, u0)])
+    else:
+        rr0, delta0 = fdots([(r, r), (w0, r)])
+        gamma0 = rr0
+    m0 = m @ w0 if preconditioned else w0
+    n0 = a @ m0
+    alpha0 = _safe_div(gamma0, delta0)
+    nrm0 = jnp.sqrt(rr0)
+
+    thresh_sq = _threshold_sq(tol, rtol, nrm0, b.dtype)
+    k0 = jnp.zeros((), jnp.int32)
+    history = _history_init(record_history, maxiter, b.dtype, k0, nrm0)
+
+    state = _PipeCGState(
+        k=k0, x=x, r=r, u=u0, w=w0,
+        p=u0, s=w0, q=m0, z=n0,
+        gamma=gamma0, rr=rr0, alpha=alpha0,
+        indefinite=(delta0 <= 0) & (rr0 > 0),
+        history=history,
+    )
+
+    cond = _variant_cond(maxiter, cap, thresh_sq)
+
+    def replace(x, p):
+        """Recompute every derived vector from definition (drift reset)."""
+        r = b - a @ x
+        u = m @ r if preconditioned else r
+        w = a @ u
+        s = a @ p
+        q = m @ s if preconditioned else s
+        z = a @ q
+        return r, u, w, s, q, z
+
+    def step(st: _PipeCGState) -> _PipeCGState:
+        x = blas1.axpy(st.alpha, st.p, st.x)
+        r = blas1.axpy(-st.alpha, st.s, st.r)
+        u = blas1.axpy(-st.alpha, st.q, st.u)
+        w = blas1.axpy(-st.alpha, st.z, st.w)
+        k = st.k + 1
+        # periodic residual replacement bounds the recurrence drift; the
+        # replaced (s, q, z) feed this step's beta-updates below so the
+        # direction-vector recurrences are reset too
+        r, u, w, s_old, q_old, z_old = lax.cond(
+            (k % _replace_cadence(b.dtype)) == 0,
+            lambda: replace(x, st.p),
+            lambda: (r, u, w, st.s, st.q, st.z))
+        # The fused reduction below depends only on (r, u, w); mv/precond
+        # depend only on w - no dependence either way, so the collective
+        # overlaps with the matvec on a mesh.
+        if preconditioned:
+            rr, gamma, delta = fdots([(r, r), (r, u), (w, u)])
+            mm = m @ w
+        else:
+            rr, delta = fdots([(r, r), (w, r)])
+            gamma = rr
+            mm = w
+        n = a @ mm
+        beta = _safe_div(gamma, st.gamma)
+        denom = delta - beta * _safe_div(gamma, st.alpha)
+        alpha = _safe_div(gamma, denom)
+        p = blas1.xpby(u, beta, st.p)
+        s = blas1.xpby(w, beta, s_old)
+        q = blas1.xpby(mm, beta, q_old)
+        z = blas1.xpby(n, beta, z_old)
+        history = st.history
+        if record_history:
+            history = history.at[k].set(jnp.sqrt(rr))
+        return _PipeCGState(
+            k=k, x=x, r=r, u=u, w=w, p=p, s=s, q=q, z=z,
+            gamma=gamma, rr=rr, alpha=alpha,
             indefinite=st.indefinite | ((denom <= 0) & (rr > 0)),
             history=history,
         )
