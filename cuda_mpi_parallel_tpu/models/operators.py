@@ -136,6 +136,25 @@ class CSRMatrix(LinearOperator):
         return cls.from_arrays(data, csr.indices, csr.indptr, csr.shape)
 
     @classmethod
+    def from_coo(cls, rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                 n: int, dtype=None) -> "CSRMatrix":
+        """Sort COO triplets into canonical CSR (row-major, ascending
+        columns).  Duplicates are kept (CSR semantics sum them in matvec);
+        the shared assembly used by the stencil generators and
+        ``permuted``'s fallback."""
+        rows = np.asarray(rows)
+        cols = np.asarray(cols)
+        vals = np.asarray(vals)
+        order = np.lexsort((cols, rows))
+        rows, cols, vals = rows[order], cols[order], vals[order]
+        indptr = np.zeros(n + 1, dtype=np.int64)
+        np.add.at(indptr, rows + 1, 1)
+        indptr = np.cumsum(indptr).astype(np.int32)
+        if dtype is not None:
+            vals = vals.astype(np.dtype(dtype))
+        return cls.from_arrays(vals, cols.astype(np.int32), indptr, (n, n))
+
+    @classmethod
     def from_dense(cls, a, tol: float = 0.0) -> "CSRMatrix":
         a = np.asarray(a)
         mask = np.abs(a) > tol
@@ -163,6 +182,63 @@ class CSRMatrix(LinearOperator):
     def to_dense(self):
         out = jnp.zeros(self.shape, dtype=self.dtype)
         return out.at[self.rows, self.indices].add(self.data)
+
+    def bandwidth(self) -> int:
+        """max |i - j| over stored entries (host-side; C++ fast path)."""
+        from ..native import bindings
+
+        if bindings.available():
+            return bindings.csr_bandwidth(np.asarray(self.indptr),
+                                          np.asarray(self.indices))
+        rows = np.asarray(self.rows, dtype=np.int64)
+        cols = np.asarray(self.indices, dtype=np.int64)
+        return int(np.abs(rows - cols).max()) if rows.size else 0
+
+    def rcm_permutation(self) -> np.ndarray:
+        """Reverse Cuthill-McKee ordering (perm[new] = old) minimizing the
+        bandwidth of ``P A P^T`` - the locality lever for the gather-based
+        SpMV formats (the x-gather becomes near-sequential).  Assumes a
+        symmetric sparsity pattern (SPD matrices always have one).  Native
+        C++ path when built; scipy.sparse.csgraph fallback.
+        """
+        from ..native import bindings
+
+        if bindings.available():
+            return bindings.rcm_order(np.asarray(self.indptr),
+                                      np.asarray(self.indices))
+        import scipy.sparse as sp
+        from scipy.sparse.csgraph import reverse_cuthill_mckee
+
+        m = sp.csr_matrix(
+            (np.asarray(self.data), np.asarray(self.indices),
+             np.asarray(self.indptr)), shape=self.shape)
+        return np.asarray(reverse_cuthill_mckee(m, symmetric_mode=True),
+                          dtype=np.int32)
+
+    def permuted(self, perm: np.ndarray) -> "CSRMatrix":
+        """Symmetric permutation ``P A P^T`` (row/column reorder).
+
+        Solving the permuted system: ``A' x' = b'`` with ``b' = b[perm]``
+        gives ``x = scatter(x', perm)`` i.e. ``x[perm] = x'``.
+        """
+        perm = np.asarray(perm)
+        n = self.shape[0]
+        if perm.shape != (n,):
+            raise ValueError(f"permutation shape {perm.shape} != ({n},)")
+        if not np.array_equal(np.sort(perm), np.arange(n)):
+            raise ValueError("perm is not a permutation of range(n)")
+        from ..native import bindings
+
+        if bindings.available():
+            vals, indices, indptr = bindings.csr_permute_sym(
+                np.asarray(self.indptr), np.asarray(self.indices),
+                np.asarray(self.data), perm)
+            return CSRMatrix.from_arrays(vals, indices, indptr, self.shape)
+        inv = np.empty(n, dtype=np.int64)
+        inv[perm] = np.arange(n)
+        return CSRMatrix.from_coo(inv[np.asarray(self.rows)],
+                                  inv[np.asarray(self.indices)],
+                                  np.asarray(self.data), n)
 
     def to_ell(self, width: int | None = None) -> "ELLMatrix":
         """Convert to padded ELL (host-side; C++ fast path when built)."""

@@ -110,11 +110,5 @@ def poisson_3d_operator(nx: int, ny: int, nz: int, scale: float = 1.0,
 
 def _coo_to_csr(rows: np.ndarray, cols: np.ndarray, vals: np.ndarray,
                 n: int, dtype) -> CSRMatrix:
-    """Sort COO triplets into canonical CSR (row-major, columns ascending)."""
-    order = np.lexsort((cols, rows))
-    rows, cols, vals = rows[order], cols[order], vals[order]
-    indptr = np.zeros(n + 1, dtype=np.int64)
-    np.add.at(indptr, rows + 1, 1)
-    indptr = np.cumsum(indptr).astype(np.int32)
-    return CSRMatrix.from_arrays(vals.astype(np.dtype(dtype)),
-                                 cols.astype(np.int32), indptr, (n, n))
+    """Canonical-CSR assembly (delegates to the shared CSRMatrix.from_coo)."""
+    return CSRMatrix.from_coo(rows, cols, vals, n, dtype=dtype)

@@ -95,6 +95,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.csr_to_ell.restype = ctypes.c_int
     lib.csr_to_ell.argtypes = [i64, ctypes.c_int32, p_i32, p_i32, p_f64,
                                p_i32, p_f64]
+    lib.rcm_order.restype = ctypes.c_int
+    lib.rcm_order.argtypes = [i64, p_i32, p_i32, p_i32]
+    lib.csr_permute_sym.restype = ctypes.c_int
+    lib.csr_permute_sym.argtypes = [i64, p_i32, p_i32, p_f64, p_i32, p_i32,
+                                    p_i32, p_f64]
+    lib.csr_bandwidth.restype = i64
+    lib.csr_bandwidth.argtypes = [i64, p_i32, p_i32]
 
 
 def available() -> bool:
@@ -154,6 +161,51 @@ def coo_to_csr(n: int, rows: np.ndarray, cols: np.ndarray,
                              out_vals)
     _check(int(written), "coo_to_csr")
     return out_vals[:written].copy(), out_cols[:written].copy(), indptr
+
+
+def rcm_order(indptr: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """Reverse Cuthill-McKee permutation (perm[new] = old) of a
+    symmetric-pattern CSR graph."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    indptr = np.ascontiguousarray(indptr, dtype=np.int32)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    n = indptr.shape[0] - 1
+    perm = np.zeros(n, dtype=np.int32)
+    _check(int(lib.rcm_order(n, indptr, indices, perm)), "rcm_order")
+    return perm
+
+
+def csr_permute_sym(indptr: np.ndarray, indices: np.ndarray,
+                    vals: np.ndarray, perm: np.ndarray
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Symmetric permutation P A P^T: returns (vals, indices, indptr)."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    indptr = np.ascontiguousarray(indptr, dtype=np.int32)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    vals64 = np.ascontiguousarray(vals, dtype=np.float64)
+    perm = np.ascontiguousarray(perm, dtype=np.int32)
+    n = indptr.shape[0] - 1
+    out_indptr = np.zeros(n + 1, dtype=np.int32)
+    out_indices = np.zeros_like(indices)
+    out_vals = np.zeros_like(vals64)
+    _check(int(lib.csr_permute_sym(n, indptr, indices, vals64, perm,
+                                   out_indptr, out_indices, out_vals)),
+           "csr_permute_sym")
+    return out_vals.astype(vals.dtype, copy=False), out_indices, out_indptr
+
+
+def csr_bandwidth(indptr: np.ndarray, indices: np.ndarray) -> int:
+    """max |i - j| over stored entries."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    indptr = np.ascontiguousarray(indptr, dtype=np.int32)
+    indices = np.ascontiguousarray(indices, dtype=np.int32)
+    return int(lib.csr_bandwidth(indptr.shape[0] - 1, indptr, indices))
 
 
 def csr_to_ell(indptr: np.ndarray, indices: np.ndarray, vals: np.ndarray,
