@@ -70,7 +70,12 @@ def estimate_lmax(
     # to non-symmetric eigenvectors of the global operator).
     idx = jnp.arange(n_local, dtype=dtype)
     if axis_name is not None:
-        idx = idx + lax.axis_index(axis_name).astype(dtype) * n_local
+        names = (axis_name if isinstance(axis_name, (tuple, list))
+                 else (axis_name,))
+        shard = jnp.zeros((), jnp.int32)
+        for nm in names:  # linearized multi-axis shard index
+            shard = shard * lax.psum(jnp.int32(1), nm) + lax.axis_index(nm)
+        idx = idx + shard.astype(dtype) * n_local
     v0 = jnp.sin(idx * 12.9898 + 78.233) + 1.5
 
     def body(_, v):

@@ -3,19 +3,35 @@ collectives - the TPU-native communication backend the reference's repo name
 (MPI) promises but never implements (SURVEY SS5)."""
 
 from .dist_cg import solve_distributed
-from .halo import exchange_halo, neighbor_shift_perms
-from .mesh import ROWS_AXIS, make_mesh, row_sharding, shard_vector
-from .operators import DistCSR, DistStencil2D, DistStencil3D
+from .halo import exchange_halo, exchange_halo_axis, neighbor_shift_perms
+from .mesh import (
+    COLS_AXIS,
+    ROWS_AXIS,
+    make_mesh,
+    make_mesh_2d,
+    row_sharding,
+    shard_vector,
+)
+from .operators import (
+    DistCSR,
+    DistStencil2D,
+    DistStencil3D,
+    DistStencil3DPencil,
+)
 from .partition import PartitionedCSR, partition_csr
 
 __all__ = [
+    "COLS_AXIS",
     "ROWS_AXIS",
     "DistCSR",
     "DistStencil2D",
     "DistStencil3D",
+    "DistStencil3DPencil",
     "PartitionedCSR",
     "exchange_halo",
+    "exchange_halo_axis",
     "make_mesh",
+    "make_mesh_2d",
     "neighbor_shift_perms",
     "partition_csr",
     "row_sharding",

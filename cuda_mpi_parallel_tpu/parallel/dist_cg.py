@@ -40,7 +40,12 @@ from ..models.precond import ChebyshevPreconditioner
 from ..solver.cg import CGResult, cg
 from . import partition as part
 from .mesh import make_mesh, shard_vector
-from .operators import DistCSR, DistStencil2D, DistStencil3D
+from .operators import (
+    DistCSR,
+    DistStencil2D,
+    DistStencil3D,
+    DistStencil3DPencil,
+)
 
 
 def solve_distributed(
@@ -64,12 +69,19 @@ def solve_distributed(
     Args:
       a: global operator - ``CSRMatrix``, ``Stencil2D`` or ``Stencil3D``.
       b: global right-hand side (host or device array, length n).
-      mesh: 1-D ``jax.sharding.Mesh``; default spans all local devices.
-      preconditioner: ``None``, ``"jacobi"`` (BASELINE config #3) or
+      mesh: ``jax.sharding.Mesh``; default spans all local devices (1-D).
+        A 1-D mesh row-partitions the leading grid axis (slab); a 2-D
+        mesh (e.g. ``make_mesh_2d((4, 2))``) pencil-decomposes a
+        ``Stencil3D``'s x and y axes, with one halo exchange per
+        partitioned axis per matvec and inner products psum-ed over both
+        axes.
+      preconditioner: ``None``, ``"jacobi"`` (BASELINE config #3),
         ``"chebyshev"`` (polynomial preconditioner of ``precond_degree``;
         its power-iteration spectral estimate and every application run
         *inside* the shard_map body, psum/ppermute-reducing over the mesh
-        - see ``models.precond``).
+        - see ``models.precond``) or ``"mg"`` (geometric multigrid
+        V-cycle; stencil operators on 1-D meshes only).  ``"bjacobi"``
+        is single-device only.
       method: ``"cg"``, ``"cg1"`` or ``"pipecg"`` - on a mesh, ``"cg1"``
         fuses each iteration's inner products into ONE ``psum`` (half the
         collective latency of the textbook recurrence) and ``"pipecg"``
@@ -84,8 +96,6 @@ def solve_distributed(
     """
     if mesh is None:
         mesh = make_mesh(n_devices)
-    axis = mesh.axis_names[0]
-    n_shards = mesh.devices.size
     if preconditioner == "bjacobi":
         raise ValueError(
             "preconditioner='bjacobi' is single-device only (its dense "
@@ -93,17 +103,35 @@ def solve_distributed(
             "or 'mg' on a mesh")
     if preconditioner not in (None, "jacobi", "chebyshev", "mg"):
         raise ValueError(f"unknown preconditioner: {preconditioner!r}")
-    if preconditioner == "mg" and not isinstance(a, (Stencil2D, Stencil3D)):
-        raise ValueError("preconditioner='mg' needs a stencil operator "
-                         "(geometric multigrid has no CSR hierarchy)")
     b = jnp.asarray(b)
     if a.shape[1] != b.shape[0]:
         raise ValueError(f"operator shape {a.shape} does not match rhs "
                          f"shape {b.shape}")
-
     kw = dict(tol=tol, rtol=rtol, maxiter=maxiter, method=method,
               check_every=check_every, compensated=compensated)
     precond = (preconditioner, precond_degree)
+
+    if len(mesh.axis_names) == 2:
+        # pencil decomposition: two partitioned grid axes
+        if not isinstance(a, Stencil3D):
+            raise TypeError(
+                "a 2-D mesh (pencil decomposition) supports Stencil3D "
+                f"only, got {type(a).__name__}")
+        if preconditioner == "mg":
+            raise ValueError(
+                "preconditioner='mg' supports 1-D meshes only; use "
+                "'jacobi'/'chebyshev' on a pencil mesh")
+        if a.backend == "pallas":
+            raise ValueError(
+                "the pencil path has no pallas matvec; re-create the "
+                "operator with backend='xla' for a 2-D mesh")
+        return _solve_pencil(a, b, mesh, precond, record_history, kw)
+
+    axis = mesh.axis_names[0]
+    n_shards = mesh.devices.size
+    if preconditioner == "mg" and not isinstance(a, (Stencil2D, Stencil3D)):
+        raise ValueError("preconditioner='mg' needs a stencil operator "
+                         "(geometric multigrid has no CSR hierarchy)")
     if isinstance(a, (Stencil2D, Stencil3D)):
         return _solve_stencil(a, b, mesh, axis, n_shards, precond,
                               record_history, kw)
@@ -114,9 +142,10 @@ def solve_distributed(
                     f"Stencil3D, got {type(a).__name__}")
 
 
-def _make_precond(precond, local, axis: str):
+def _make_precond(precond, local, axis):
     """Build the preconditioner INSIDE the shard_map body: reductions in
-    the spectral estimate and applications psum over ``axis``."""
+    the spectral estimate and applications psum over ``axis`` (a mesh
+    axis name, or a tuple of names on a pencil mesh)."""
     name, degree = precond
     if name == "jacobi":
         return JacobiPreconditioner.from_operator(local)
@@ -135,6 +164,35 @@ def _result_specs(axis: str, record_history: bool) -> CGResult:
         status=P(), indefinite=P(),
         residual_history=P() if record_history else None,
     )
+
+
+def _solve_pencil(a, b, mesh, precond, record_history, kw) -> CGResult:
+    """Stencil3D over a 2-D mesh: x- and y-axes partitioned, four halo
+    ppermutes per matvec, inner products psum over BOTH mesh axes."""
+    ax_x, ax_y = mesh.axis_names
+    sx, sy = mesh.devices.shape
+    local = DistStencil3DPencil.create(a.grid, (sx, sy),
+                                       axis_names=(ax_x, ax_y),
+                                       scale=a.scale, dtype=a.dtype)
+    nx, ny, nz = a.grid
+    b3 = jax.device_put(jnp.asarray(b, a.dtype).reshape(nx, ny, nz),
+                        jax.sharding.NamedSharding(mesh, P(ax_x, ax_y)))
+
+    out = dataclasses.replace(_result_specs(None, record_history),
+                              x=P(ax_x, ax_y))
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P(ax_x, ax_y),
+             out_specs=out)
+    def run(b_local):
+        m = _make_precond(precond, local, (ax_x, ax_y))
+        res = cg(local, b_local.reshape(-1), m=m,
+                 record_history=record_history, axis_name=(ax_x, ax_y),
+                 **kw)
+        return dataclasses.replace(
+            res, x=res.x.reshape(local.local_grid))
+
+    res = jax.jit(run)(b3)
+    return dataclasses.replace(res, x=res.x.reshape(-1))
 
 
 def _solve_stencil(a, b, mesh, axis, n_shards, precond, record_history,

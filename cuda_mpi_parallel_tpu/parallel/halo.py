@@ -53,3 +53,21 @@ def exchange_halo(
     lo = lax.ppermute(u[-1:], axis_name, perm=fwd)
     hi = lax.ppermute(u[:1], axis_name, perm=bwd)
     return lo, hi
+
+
+def exchange_halo_axis(
+    u: jax.Array, axis_name: str, n_shards: int, dim: int
+) -> Tuple[jax.Array, jax.Array]:
+    """``exchange_halo`` generalized to any local dimension ``dim``.
+
+    Returns ``(lo, hi)`` shaped like ``u`` with extent 1 along ``dim`` -
+    the building block of pencil (multi-axis) decompositions, where each
+    partitioned grid axis has its own mesh axis and its own plane
+    exchange.
+    """
+    if dim == 0:
+        return exchange_halo(u, axis_name, n_shards)
+    um = jax.numpy.moveaxis(u, dim, 0)
+    lo, hi = exchange_halo(um, axis_name, n_shards)
+    return (jax.numpy.moveaxis(lo, 0, dim),
+            jax.numpy.moveaxis(hi, 0, dim))

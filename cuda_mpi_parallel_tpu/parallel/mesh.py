@@ -19,6 +19,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 ROWS_AXIS = "rows"
+COLS_AXIS = "cols"
 
 
 def make_mesh(
@@ -35,6 +36,27 @@ def make_mesh(
         raise ValueError(
             f"requested {n_devices} devices, only {len(devices)} available")
     return Mesh(np.asarray(devices[:n_devices]), (axis_name,))
+
+
+def make_mesh_2d(
+    shape: Sequence[int],
+    axis_names: Sequence[str] = (ROWS_AXIS, COLS_AXIS),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a 2-D mesh (pencil decomposition: two partitioned grid axes).
+
+    ``shape = (sx, sy)`` needs ``sx * sy`` devices.  Lay the faster-varying
+    axis over physically adjacent devices so both halo directions ride ICI
+    neighbors where the topology allows.
+    """
+    if devices is None:
+        devices = jax.devices()
+    sx, sy = shape
+    if sx * sy > len(devices):
+        raise ValueError(
+            f"requested {sx}x{sy} devices, only {len(devices)} available")
+    grid = np.asarray(devices[: sx * sy]).reshape(sx, sy)
+    return Mesh(grid, tuple(axis_names))
 
 
 def row_sharding(mesh: Mesh, axis_name: str = ROWS_AXIS) -> NamedSharding:

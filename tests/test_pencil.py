@@ -1,0 +1,143 @@
+"""Pencil (2-axis) decomposition tests on a 4x2 virtual mesh.
+
+The 1-D slab partition stops scaling at n_shards == nx and moves a full
+ny*nz plane per neighbor; the pencil partitions two grid axes over a 2-D
+mesh.  Oracles: matvec equality against the single-device stencil,
+solve parity against the 1-D mesh and the single device, and the
+preconditioned (psum over BOTH axes) path.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cuda_mpi_parallel_tpu import solve
+from cuda_mpi_parallel_tpu.models.operators import Stencil3D
+from cuda_mpi_parallel_tpu.parallel import (
+    DistStencil3DPencil,
+    make_mesh,
+    make_mesh_2d,
+    solve_distributed,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+GRID = (16, 8, 8)
+
+
+def _mesh42():
+    return make_mesh_2d((4, 2))
+
+
+class TestPencilMatvec:
+    def test_matches_single_device(self, rng):
+        mesh = _mesh42()
+        nx, ny, nz = GRID
+        a_global = Stencil3D.create(*GRID, dtype=jnp.float64)
+        local = DistStencil3DPencil.create(GRID, (4, 2),
+                                           dtype=jnp.float64)
+        x = rng.standard_normal(nx * ny * nz)
+        want = np.asarray(a_global @ jnp.asarray(x))
+
+        x3 = jax.device_put(jnp.asarray(x).reshape(GRID),
+                            NamedSharding(mesh, P("rows", "cols")))
+
+        @jax.jit
+        @jax.shard_map(mesh=mesh, in_specs=P("rows", "cols"),
+                       out_specs=P("rows", "cols"))
+        def apply(u):
+            return (local @ u.reshape(-1)).reshape(local.local_grid)
+
+        got = np.asarray(apply(x3)).reshape(-1)
+        np.testing.assert_allclose(got, want, rtol=1e-13, atol=1e-13)
+
+    def test_indivisible_grid_rejected(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            DistStencil3DPencil.create((10, 8, 8), (4, 2))
+
+
+class TestPencilSolve:
+    def test_matches_single_and_slab(self):
+        a = Stencil3D.create(*GRID, dtype=jnp.float64)
+        rng = np.random.default_rng(31)
+        x_true = rng.standard_normal(a.shape[0])
+        b = a @ jnp.asarray(x_true)
+
+        single = solve(a, b, tol=0.0, rtol=1e-9, maxiter=500)
+        slab = solve_distributed(a, b, mesh=make_mesh(8), tol=0.0,
+                                 rtol=1e-9, maxiter=500)
+        pencil = solve_distributed(a, b, mesh=_mesh42(), tol=0.0,
+                                   rtol=1e-9, maxiter=500)
+        assert bool(pencil.converged)
+        assert int(pencil.iterations) == int(slab.iterations)
+        assert abs(int(pencil.iterations) - int(single.iterations)) <= 1
+        np.testing.assert_allclose(np.asarray(pencil.x), x_true, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(pencil.x),
+                                   np.asarray(slab.x), rtol=1e-9,
+                                   atol=1e-11)
+
+    def test_chebyshev_on_pencil(self):
+        """Chebyshev's power iteration and application psum over BOTH
+        mesh axes."""
+        a = Stencil3D.create(*GRID, dtype=jnp.float64)
+        rng = np.random.default_rng(32)
+        x_true = rng.standard_normal(a.shape[0])
+        b = a @ jnp.asarray(x_true)
+        from cuda_mpi_parallel_tpu.models.precond import (
+            ChebyshevPreconditioner,
+        )
+
+        single = solve(a, b, tol=0.0, rtol=1e-9, maxiter=500,
+                       m=ChebyshevPreconditioner.from_operator(a, degree=3))
+        pencil = solve_distributed(a, b, mesh=_mesh42(), tol=0.0,
+                                   rtol=1e-9, maxiter=500,
+                                   preconditioner="chebyshev",
+                                   precond_degree=3)
+        assert bool(pencil.converged)
+        assert abs(int(pencil.iterations) - int(single.iterations)) <= 2
+        np.testing.assert_allclose(np.asarray(pencil.x), x_true, atol=1e-7)
+
+    def test_pipecg_on_pencil(self):
+        a = Stencil3D.create(*GRID, dtype=jnp.float64)
+        rng = np.random.default_rng(33)
+        b = jnp.asarray(rng.standard_normal(a.shape[0]))
+        res = solve_distributed(a, b, mesh=_mesh42(), tol=0.0, rtol=1e-8,
+                                maxiter=500, method="pipecg")
+        assert bool(res.converged)
+
+    def test_mg_rejected_on_pencil(self):
+        a = Stencil3D.create(*GRID, dtype=jnp.float64)
+        b = jnp.ones(a.shape[0])
+        with pytest.raises(ValueError, match="1-D meshes"):
+            solve_distributed(a, b, mesh=_mesh42(), preconditioner="mg")
+
+    def test_unknown_preconditioner_rejected_on_pencil(self):
+        a = Stencil3D.create(*GRID, dtype=jnp.float64)
+        b = jnp.ones(a.shape[0])
+        with pytest.raises(ValueError, match="unknown preconditioner"):
+            solve_distributed(a, b, mesh=_mesh42(), preconditioner="jacob")
+        with pytest.raises(ValueError, match="single-device"):
+            solve_distributed(a, b, mesh=_mesh42(),
+                              preconditioner="bjacobi")
+
+    def test_pallas_backend_rejected_on_pencil(self):
+        a = Stencil3D.create(128, 128, 128, dtype=jnp.float32,
+                             backend="pallas")
+        b = jnp.ones(a.shape[0], jnp.float32)
+        with pytest.raises(ValueError, match="pallas"):
+            solve_distributed(a, b, mesh=_mesh42())
+
+    def test_wrong_rhs_length_clear_error(self):
+        a = Stencil3D.create(*GRID, dtype=jnp.float64)
+        with pytest.raises(ValueError, match="does not match rhs"):
+            solve_distributed(a, jnp.ones(17), mesh=_mesh42())
+
+    def test_2d_mesh_rejects_non_stencil3d(self):
+        from cuda_mpi_parallel_tpu.models import poisson
+
+        a = poisson.poisson_2d_csr(8, 8)
+        b = jnp.ones(64)
+        with pytest.raises(TypeError, match="Stencil3D"):
+            solve_distributed(a, b, mesh=_mesh42())
