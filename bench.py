@@ -120,26 +120,41 @@ def bench_all():
     from cuda_mpi_parallel_tpu.models.operators import JacobiPreconditioner
     from cuda_mpi_parallel_tpu.models.precond import ChebyshevPreconditioner
 
+    from functools import partial as _partial
+
+    from jax import lax
+
+    from cuda_mpi_parallel_tpu.solver.cg import cg as _cg
+
     op2 = poisson.poisson_2d_operator(512, 512, dtype=jnp.float32)
     x_true = rng.standard_normal(512 * 512).astype(np.float32)
     b3 = op2 @ jnp.asarray(x_true)
-    # per-call dispatch floor (substantial on tunneled devices, ~0.5s):
-    # a maxiter=0 solve measures it so the net compute time is honest
-    disp, _ = time_fn(lambda: solve(op2, b3, tol=0.0, maxiter=0),
-                      warmup=1, repeats=5, reduce="median")
+    # The per-call dispatch floor on a tunneled device (~0.5s) swamps a
+    # single ~5ms solve, so time-to-tolerance is measured as the delta
+    # between 21 and 1 back-to-back solves inside ONE jitted call (each
+    # with a slightly perturbed rhs so XLA cannot collapse them).
     for name, m in [
         ("none", None),
         ("jacobi", JacobiPreconditioner.from_operator(op2)),
         ("chebyshev4", ChebyshevPreconditioner.from_operator(op2, degree=4)),
         ("mg", MultigridPreconditioner.from_operator(op2)),
     ]:
-        el, res = time_fn(
-            lambda m=m: solve(op2, b3, tol=0.0, rtol=1e-6, maxiter=5000,
-                              m=m),
-            warmup=1, repeats=3, reduce="median")
+        @_partial(jax.jit, static_argnames=("reps",))
+        def many(b, mm, reps):
+            def body(i, acc):
+                scale = 1.0 + i.astype(b.dtype) * jnp.asarray(1e-6, b.dtype)
+                r = _cg(op2, b * scale, tol=0.0, rtol=1e-6, maxiter=5000,
+                        m=mm)
+                return acc + r.x[0]
+            return lax.fori_loop(0, reps, body, jnp.zeros((), b.dtype))
+
+        t1, _ = time_fn(lambda m=m: many(b3, m, 1),
+                        warmup=1, repeats=3, reduce="median")
+        t21, _ = time_fn(lambda m=m: many(b3, m, 21),
+                         warmup=1, repeats=3, reduce="median")
+        res = solve(op2, b3, tol=0.0, rtol=1e-6, maxiter=5000, m=m)
         results[f"poisson2d_512_{name}_rtol1e-6"] = {
-            "time_to_tol_net_s": max(el - disp, 0.0),
-            "dispatch_floor_s": disp,
+            "time_to_tol_s": max(t21 - t1, 0.0) / 20,
             "iterations": int(res.iterations),
             "converged": bool(res.converged)}
 

@@ -2,6 +2,7 @@
 collectives - the TPU-native communication backend the reference's repo name
 (MPI) promises but never implements (SURVEY SS5)."""
 
+from . import multihost
 from .dist_cg import solve_distributed
 from .halo import exchange_halo, exchange_halo_axis, neighbor_shift_perms
 from .mesh import (
@@ -32,6 +33,7 @@ __all__ = [
     "exchange_halo_axis",
     "make_mesh",
     "make_mesh_2d",
+    "multihost",
     "neighbor_shift_perms",
     "partition_csr",
     "row_sharding",

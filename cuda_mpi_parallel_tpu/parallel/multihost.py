@@ -1,0 +1,129 @@
+"""Multi-host execution: the DCN tier of the communication backend.
+
+The reference is a single process on a single GPU (``cudaSetDevice(0)``,
+``CUDACG.cu:87``); the MPI its repo name promises would have been the
+multi-node story.  Here that role is played by JAX's multi-controller
+runtime: one Python process per host, every process running the SAME
+program, with XLA routing collectives over ICI within a slice and DCN
+across slices.  Nothing in the solver changes - ``solve_distributed``'s
+``shard_map`` body is identical; only mesh construction and array
+ingestion are process-aware:
+
+* ``initialize()`` wraps ``jax.distributed.initialize`` (coordinator
+  rendezvous).  Call it FIRST, before any other jax API.
+* ``global_mesh()`` builds the mesh over ``jax.devices()`` - which after
+  initialization enumerates every device of every process.
+* ``shard_vector_global()`` assembles a global array when each process
+  holds only its slice of the data (``jax.make_array_from_callback`` -
+  no host ever materializes the full vector, which at N=256^3 f32 is
+  67 MB but at larger N would not fit one host).
+
+Single-process behavior is unchanged: each helper degrades to its
+single-host equivalent, so the same script runs on a laptop, one TPU
+host, or a multi-host pod.  (CI covers the single-process degradation;
+multi-host runs need real pod slices, which tests cannot provision.)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import ROWS_AXIS
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-controller runtime (no-op if already initialized).
+
+    On TPU pods the arguments are discovered from the environment and may
+    all be ``None``; elsewhere pass the coordinator's ``host:port``, the
+    process count, and this process's id - the role MPI_Init plays in the
+    MPI programs the reference's name alludes to.
+
+    Degradations: a second call is a no-op (jax raises "should only be
+    called once" - swallowed), and on a plain single-host machine where
+    no coordinator can be auto-detected (jax raises ValueError) the call
+    is a no-op too, so the same script runs unchanged on a laptop.
+    """
+    try:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "only be called once" in msg or "already initialized" in msg:
+            return
+        if ("must be called before" in msg and coordinator_address is None
+                and num_processes in (None, 1)):
+            # backend already up in a single-process program: there is no
+            # rendezvous to perform, so this is the laptop no-op path
+            return
+        raise
+    except ValueError:
+        if coordinator_address is None and num_processes in (None, 1):
+            return  # single host, nothing to rendezvous with
+        raise
+
+
+def process_info() -> tuple:
+    """(process_index, process_count) of this controller."""
+    return jax.process_index(), jax.process_count()
+
+
+def global_mesh(axis_name: str = ROWS_AXIS) -> Mesh:
+    """1-D mesh over EVERY device of every process (ICI + DCN)."""
+    from .mesh import make_mesh
+
+    return make_mesh(axis_name=axis_name)
+
+
+def shard_vector_global(
+    local_data: np.ndarray,
+    global_length: int,
+    mesh: Mesh,
+    axis_name: str = ROWS_AXIS,
+) -> jax.Array:
+    """Assemble a row-sharded global vector from per-process slices.
+
+    Each process passes the contiguous slice of the global vector its
+    devices own (``global_length / process_count`` rows, in process-index
+    order).  Devices receive their blocks without any host gathering the
+    whole vector.  With one process this reduces to ``device_put`` of
+    ``local_data`` (which is then the entire vector).
+    """
+    sharding = NamedSharding(mesh, P(axis_name))
+    n_dev = mesh.devices.size
+    if global_length % n_dev:
+        # NamedSharding would use ceil-sized shards, disagreeing with the
+        # contiguous per-process blocks assembled below
+        raise ValueError(
+            f"global_length {global_length} must divide evenly over "
+            f"{n_dev} devices (pad the system first)")
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        if local_data.shape[0] != global_length:
+            raise ValueError(
+                f"single-process shard_vector_global needs the full "
+                f"vector: got {local_data.shape[0]} of {global_length}")
+        return jax.device_put(local_data, sharding)
+    per_proc = global_length // n_proc
+    if local_data.shape[0] != per_proc:
+        raise ValueError(
+            f"process {jax.process_index()} holds {local_data.shape[0]} "
+            f"rows, expected {per_proc} (= {global_length} / {n_proc})")
+    offset = jax.process_index() * per_proc
+
+    def cb(index):
+        # index is the global slice for one local device; translate into
+        # this process's local slice
+        (sl,) = index
+        start = (sl.start or 0) - offset
+        stop = (sl.stop if sl.stop is not None else global_length) - offset
+        return local_data[start:stop]
+
+    return jax.make_array_from_callback((global_length,), sharding, cb)

@@ -1,0 +1,66 @@
+"""Multi-host layer tests (single-process degradation paths; real
+multi-host needs pod slices CI cannot provision - SURVEY SS4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import solve
+from cuda_mpi_parallel_tpu.parallel import multihost
+from cuda_mpi_parallel_tpu.parallel.dist_cg import solve_distributed
+from cuda_mpi_parallel_tpu.models.operators import Stencil3D
+
+
+class TestSingleProcessDegradation:
+    def test_process_info(self):
+        idx, count = multihost.process_info()
+        assert idx == 0
+        assert count == 1
+
+    def test_global_mesh_spans_all_devices(self):
+        mesh = multihost.global_mesh()
+        assert mesh.devices.size == len(jax.devices())
+        assert mesh.axis_names == ("rows",)
+
+    @pytest.mark.skipif(len(jax.devices()) < 8,
+                        reason="needs 8 virtual devices")
+    def test_shard_vector_global_roundtrip(self, rng):
+        mesh = multihost.global_mesh()
+        v = rng.standard_normal(64)
+        arr = multihost.shard_vector_global(v, 64, mesh)
+        np.testing.assert_array_equal(np.asarray(arr), v)
+        # sharded over all devices
+        assert len(arr.sharding.device_set) == len(jax.devices())
+
+    def test_shard_vector_global_length_check(self, rng):
+        mesh = multihost.global_mesh()
+        with pytest.raises(ValueError, match="full vector"):
+            multihost.shard_vector_global(rng.standard_normal(8), 64, mesh)
+
+    @pytest.mark.skipif(len(jax.devices()) < 8,
+                        reason="needs 8 virtual devices")
+    def test_solve_on_global_mesh(self):
+        """The multihost mesh feeds the same solve_distributed path."""
+        mesh = multihost.global_mesh()
+        a = Stencil3D.create(16, 8, 8, dtype=jnp.float64)
+        x_true = np.random.default_rng(41).standard_normal(a.shape[0])
+        b = a @ jnp.asarray(x_true)
+        res = solve_distributed(a, b, mesh=mesh, tol=0.0, rtol=1e-9,
+                                maxiter=500)
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x), x_true, atol=1e-7)
+
+    def test_initialize_noop_on_single_host(self):
+        """No coordinator on a plain machine: must be a silent no-op, and
+        a repeated call must stay one."""
+        multihost.initialize()
+        multihost.initialize()
+
+    def test_shard_vector_global_divisibility(self, rng):
+        mesh = multihost.global_mesh()
+        n_dev = mesh.devices.size
+        if n_dev == 1:
+            pytest.skip("indivisibility needs > 1 device")
+        with pytest.raises(ValueError, match="divide evenly"):
+            multihost.shard_vector_global(
+                rng.standard_normal(n_dev * 8 + 1), n_dev * 8 + 1, mesh)
