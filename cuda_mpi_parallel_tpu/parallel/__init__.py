@@ -15,20 +15,28 @@ from .mesh import (
 )
 from .operators import (
     DistCSR,
+    DistCSRRing,
     DistStencil2D,
     DistStencil3D,
     DistStencil3DPencil,
 )
-from .partition import PartitionedCSR, partition_csr
+from .partition import (
+    PartitionedCSR,
+    RingPartitionedCSR,
+    partition_csr,
+    ring_partition_csr,
+)
 
 __all__ = [
     "COLS_AXIS",
     "ROWS_AXIS",
     "DistCSR",
+    "DistCSRRing",
     "DistStencil2D",
     "DistStencil3D",
     "DistStencil3DPencil",
     "PartitionedCSR",
+    "RingPartitionedCSR",
     "exchange_halo",
     "exchange_halo_axis",
     "make_mesh",
@@ -36,6 +44,7 @@ __all__ = [
     "multihost",
     "neighbor_shift_perms",
     "partition_csr",
+    "ring_partition_csr",
     "row_sharding",
     "shard_vector",
     "solve_distributed",

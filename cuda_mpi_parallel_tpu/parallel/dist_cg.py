@@ -42,6 +42,7 @@ from . import partition as part
 from .mesh import make_mesh, shard_vector
 from .operators import (
     DistCSR,
+    DistCSRRing,
     DistStencil2D,
     DistStencil3D,
     DistStencil3DPencil,
@@ -63,6 +64,7 @@ def solve_distributed(
     method: str = "cg",
     check_every: int = 1,
     compensated: bool = False,
+    csr_comm: str = "allgather",
 ) -> CGResult:
     """Solve the global system A x = b row-partitioned over a device mesh.
 
@@ -87,6 +89,12 @@ def solve_distributed(
         collective latency of the textbook recurrence) and ``"pipecg"``
         additionally overlaps that psum with the iteration's local
         matvec+preconditioner compute (see ``solver.cg``).
+      csr_comm: general-CSR communication schedule - ``"allgather"``
+        (every device materializes the full x per matvec: one big
+        collective, O(n) memory) or ``"ring"`` (x-blocks rotate around
+        the mesh via ``lax.ppermute`` in n_shards steps: O(n/P) memory,
+        compute overlaps communication - the ring-attention schedule
+        applied to SpMV).  Ignored for stencil operators.
       (tol/rtol/maxiter/record_history/check_every/compensated as in
       ``solver.cg``.)
 
@@ -107,6 +115,8 @@ def solve_distributed(
     if a.shape[1] != b.shape[0]:
         raise ValueError(f"operator shape {a.shape} does not match rhs "
                          f"shape {b.shape}")
+    if csr_comm not in ("allgather", "ring"):
+        raise ValueError(f"unknown csr_comm: {csr_comm!r}")
     kw = dict(tol=tol, rtol=rtol, maxiter=maxiter, method=method,
               check_every=check_every, compensated=compensated)
     precond = (preconditioner, precond_degree)
@@ -137,7 +147,7 @@ def solve_distributed(
                               record_history, kw)
     if isinstance(a, CSRMatrix):
         return _solve_csr(a, b, mesh, axis, n_shards, precond,
-                          record_history, kw)
+                          record_history, kw, csr_comm=csr_comm)
     raise TypeError(f"solve_distributed supports CSRMatrix/Stencil2D/"
                     f"Stencil3D, got {type(a).__name__}")
 
@@ -219,23 +229,31 @@ def _solve_stencil(a, b, mesh, axis, n_shards, precond, record_history,
 
 
 def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
-               kw) -> CGResult:
-    parts = part.partition_csr(a, n_shards)
+               kw, csr_comm: str = "allgather") -> CGResult:
+    ring = csr_comm == "ring"
+    parts = (part.ring_partition_csr(a, n_shards) if ring
+             else part.partition_csr(a, n_shards))
     b_np = np.asarray(b)
     b_pad = part.pad_vector(b_np, parts.n_global_padded)
 
+    def _shard(x):
+        return jax.tree.map(
+            lambda v: shard_vector(jnp.asarray(v), mesh, axis), x)
+
     b_dev = shard_vector(jnp.asarray(b_pad), mesh, axis)
-    data = shard_vector(jnp.asarray(parts.data), mesh, axis)
-    cols = shard_vector(jnp.asarray(parts.cols), mesh, axis)
-    rows = shard_vector(jnp.asarray(parts.local_rows), mesh, axis)
+    data = _shard(parts.data)      # array, or per-step tuple (ring)
+    cols = _shard(parts.cols)
+    rows = _shard(parts.local_rows)
 
     @partial(jax.shard_map, mesh=mesh,
              in_specs=(P(axis), P(axis), P(axis), P(axis)),
              out_specs=_result_specs(axis, record_history))
     def run(b_local, data_s, cols_s, rows_s):
-        op = DistCSR(data=data_s[0], cols=cols_s[0], local_rows=rows_s[0],
-                     n_local=parts.n_local, axis_name=axis,
-                     n_shards=n_shards)
+        strip = partial(jax.tree.map, lambda v: v[0])
+        op_cls = DistCSRRing if ring else DistCSR
+        op = op_cls(data=strip(data_s), cols=strip(cols_s),
+                    local_rows=strip(rows_s), n_local=parts.n_local,
+                    axis_name=axis, n_shards=n_shards)
         m = _make_precond(precond, op, axis)
         return cg(op, b_local, m=m, record_history=record_history,
                   axis_name=axis, **kw)

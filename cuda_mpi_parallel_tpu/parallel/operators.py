@@ -314,3 +314,68 @@ class DistCSR(LinearOperator):
         return jax.ops.segment_sum(
             jnp.where(on_diag, self.data, jnp.zeros_like(self.data)),
             self.local_rows, num_segments=self.n_local)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("data", "cols", "local_rows"),
+    meta_fields=("n_local", "axis_name", "n_shards"),
+)
+@dataclasses.dataclass(frozen=True)
+class DistCSRRing(LinearOperator):
+    """Ring-scheduled distributed CSR: ``lax.ppermute`` instead of
+    ``all_gather``.
+
+    ``DistCSR`` materializes the FULL x on every device each matvec
+    (O(n) memory and one big collective); this operator instead rotates
+    the x-blocks around the ring in ``n_shards`` steps, multiplying its
+    per-column-block slab against whichever block is resident - O(n/P)
+    memory, and each step's ppermute overlaps with the previous step's
+    local compute.  Structurally the same schedule ring attention uses
+    for KV blocks (SURVEY SS5 "long-context"), here carrying x-blocks.
+
+    Slabs come from ``partition.ring_partition_csr`` pre-arranged in ring
+    order (owner i's slab t couples to column block (i + t) % P), so the
+    device loop indexes slabs with a STATIC step index - no dynamic
+    gather of index arrays.  Each step's slab is padded to its own max
+    across owners only (per-step tuples, not one global-max array), so a
+    diagonally-dominant sparsity pattern does not inflate every step's
+    work to the own-block slab's size.
+    """
+
+    data: Tuple[jax.Array, ...]        # per step: (m_t,) slab values
+    cols: Tuple[jax.Array, ...]        # per step: block-relative columns
+    local_rows: Tuple[jax.Array, ...]  # per step: in [0, n_local)
+    n_local: int
+    axis_name: str
+    n_shards: int
+
+    @property
+    def shape(self):
+        return (self.n_local, self.n_local * self.n_shards)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def matvec(self, x):
+        n = self.n_shards
+        # receive from the next shard: after one shift, shard i holds
+        # block i+1; at step t it holds block (i + t) % n, matching the
+        # pre-arranged slab order
+        ring = [(j, (j - 1) % n) for j in range(n)]
+        y = jnp.zeros_like(x)
+        xb = x
+        for t in range(n):  # static unroll: n is a mesh constant
+            y = y + spmv.csr_matvec(self.data[t], self.cols[t],
+                                    self.local_rows[t], xb, self.n_local)
+            if t + 1 < n:
+                xb = lax.ppermute(xb, self.axis_name, perm=ring)
+        return y
+
+    def diagonal(self):
+        # the diagonal lives in the own-block slab (step 0)
+        on_diag = self.cols[0] == self.local_rows[0]
+        return jax.ops.segment_sum(
+            jnp.where(on_diag, self.data[0], jnp.zeros_like(self.data[0])),
+            self.local_rows[0], num_segments=self.n_local)

@@ -90,3 +90,71 @@ def pad_vector(b: np.ndarray, n_padded: int) -> np.ndarray:
     out = np.zeros(n_padded, dtype=b.dtype)
     out[: b.shape[0]] = b
     return out
+
+
+class RingPartitionedCSR(NamedTuple):
+    """Per-shard CSR blocks split by COLUMN block, in ring-schedule order.
+
+    ``data``/``cols``/``local_rows`` are LENGTH-``n_shards`` tuples, one
+    entry per ring STEP, each of shape ``(n_shards, m_t)``: axis 0 = owner
+    shard, and owner ``i``'s step-``t`` slab holds its coupling to column
+    block ``(i + t) % n_shards`` - pre-arranged host-side so the device
+    loop indexes slabs statically.  Each step is padded only to ITS OWN
+    max across owners (``m_t``): for PDE-like matrices the own-block slab
+    (step 0) carries most of the nnz, and padding every step to the
+    global max would inflate per-matvec work by up to n_shards x.
+    ``cols`` are relative to the column block's start; padding entries
+    have ``data == 0``.
+    """
+
+    data: Tuple[np.ndarray, ...]
+    cols: Tuple[np.ndarray, ...]
+    local_rows: Tuple[np.ndarray, ...]
+    n_local: int
+    n_global_padded: int
+    n_global: int
+    n_shards: int
+
+
+def ring_partition_csr(a: CSRMatrix, n_shards: int) -> RingPartitionedCSR:
+    """Split a global CSR matrix for the ring SpMV schedule.
+
+    Starts from ``partition_csr``'s row blocks, then splits each owner's
+    entries by column block, padding uniformly across owners per step
+    (shapes must match across devices; they may differ between steps).
+    """
+    rows_part = partition_csr(a, n_shards)
+    n_local = rows_part.n_local
+    slabs = []
+    for s in range(n_shards):
+        d, c, r = (rows_part.data[s], rows_part.cols[s],
+                   rows_part.local_rows[s])
+        live = d != 0
+        blk = c // n_local
+        per_step = []
+        for t in range(n_shards):
+            b = (s + t) % n_shards
+            sel = live & (blk == b)
+            per_step.append((d[sel], c[sel] - b * n_local, r[sel]))
+        slabs.append(per_step)
+
+    data, cols, lrows = [], [], []
+    for t in range(n_shards):
+        m_t = max(1, max(slabs[s][t][0].shape[0] for s in range(n_shards)))
+        dt = np.zeros((n_shards, m_t), dtype=rows_part.data.dtype)
+        ct = np.zeros((n_shards, m_t), dtype=np.int32)
+        rt = np.zeros((n_shards, m_t), dtype=np.int32)
+        for s in range(n_shards):
+            d, c, r = slabs[s][t]
+            k = d.shape[0]
+            dt[s, :k] = d
+            ct[s, :k] = c
+            rt[s, :k] = r
+        data.append(dt)
+        cols.append(ct)
+        lrows.append(rt)
+    return RingPartitionedCSR(
+        data=tuple(data), cols=tuple(cols), local_rows=tuple(lrows),
+        n_local=n_local, n_global_padded=rows_part.n_global_padded,
+        n_global=rows_part.n_global, n_shards=n_shards,
+    )
