@@ -61,6 +61,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", type=int, default=1,
                    help="number of devices for row-partitioned execution "
                         "(1 = single device)")
+    p.add_argument("--csr-comm", default="allgather",
+                   choices=["allgather", "ring"],
+                   help="distributed general-CSR schedule: all-gather x "
+                        "every matvec, or rotate x-blocks around the mesh "
+                        "via ppermute (O(n/P) memory, overlapped compute)")
     p.add_argument("--device", default=None,
                    choices=[None, "tpu", "cpu"],
                    help="force a JAX platform (default: auto)")
@@ -190,6 +195,16 @@ def main(argv=None) -> int:
         b = np.asarray(b)[rcm_perm]
         desc += f" [rcm: bandwidth {bw_before} -> {a.bandwidth()}]"
 
+    if args.csr_comm != "allgather":
+        from .models.operators import CSRMatrix
+
+        if args.mesh <= 1:
+            raise SystemExit("--csr-comm ring needs --mesh > 1")
+        if not isinstance(a, CSRMatrix):
+            raise SystemExit(
+                "--csr-comm applies to assembled-CSR problems only "
+                "(stencils use halo exchange)")
+
     if args.fmt != "csr":
         from .models.operators import CSRMatrix
 
@@ -224,7 +239,7 @@ def main(argv=None) -> int:
                 preconditioner=args.precond,
                 precond_degree=args.precond_degree,
                 record_history=args.history, method=args.method,
-                check_every=args.check_every)
+                check_every=args.check_every, csr_comm=args.csr_comm)
         from . import solve
         from .models.operators import JacobiPreconditioner
         from .models.precond import (

@@ -8,8 +8,11 @@ run restarts from zero.  Here the full CG recurrence state
 long N=256^3 run continues from where it stopped with the *exact* iterate
 trajectory (resuming p and rho, not restarting from x).
 
-Format: a plain .npz with the checkpoint leaves plus a format version -
-readable anywhere, no framework needed.
+Formats: a plain .npz with the checkpoint leaves plus a format version -
+readable anywhere, no framework needed - or orbax
+(``solve_resumable(..., backend="orbax")`` / ``save_checkpoint_orbax``),
+which understands sharded arrays (each host writes only its shards; the
+right choice for multi-host N=256^3 runs where no host holds the vectors).
 """
 from __future__ import annotations
 
@@ -61,30 +64,63 @@ def save_checkpoint(path: str, ckpt: CGCheckpoint,
     os.replace(tmp + ".npz", path)
 
 
+def _checkpoint_from_mapping(z, path: str,
+                             expect_fingerprint: str) -> CGCheckpoint:
+    """Shared validation + deserialization for both backends (the
+    save-side schema lives in ``_ckpt_tree``)."""
+    version = int(np.asarray(z["version"]))
+    if version != _FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint {path} has format version {version}, "
+            f"expected {_FORMAT_VERSION}")
+    stored = str(z["fingerprint"]) if "fingerprint" in z else ""
+    if expect_fingerprint and stored and stored != expect_fingerprint:
+        raise ValueError(
+            f"checkpoint {path} belongs to a different problem "
+            f"(fingerprint {stored} != {expect_fingerprint}); refusing "
+            f"to resume - delete it to start fresh")
+    return CGCheckpoint(
+        x=jnp.asarray(z["x"]), r=jnp.asarray(z["r"]), p=jnp.asarray(z["p"]),
+        rho=jnp.asarray(z["rho"]), rr=jnp.asarray(z["rr"]),
+        nrm0=jnp.asarray(z["nrm0"]), k=jnp.asarray(z["k"]),
+        indefinite=jnp.asarray(z["indefinite"]))
+
+
 def load_checkpoint(path: str,
                     expect_fingerprint: str = "") -> CGCheckpoint:
     with np.load(path) as z:
-        version = int(z["version"])
-        if version != _FORMAT_VERSION:
-            raise ValueError(
-                f"checkpoint {path} has format version {version}, "
-                f"expected {_FORMAT_VERSION}")
-        stored = str(z["fingerprint"]) if "fingerprint" in z else ""
-        if expect_fingerprint and stored and stored != expect_fingerprint:
-            raise ValueError(
-                f"checkpoint {path} belongs to a different problem "
-                f"(fingerprint {stored} != {expect_fingerprint}); refusing "
-                f"to resume - delete it to start fresh")
-        return CGCheckpoint(
-            x=jnp.asarray(z["x"]),
-            r=jnp.asarray(z["r"]),
-            p=jnp.asarray(z["p"]),
-            rho=jnp.asarray(z["rho"]),
-            rr=jnp.asarray(z["rr"]),
-            nrm0=jnp.asarray(z["nrm0"]),
-            k=jnp.asarray(z["k"]),
-            indefinite=jnp.asarray(z["indefinite"]),
-        )
+        return _checkpoint_from_mapping(z, path, expect_fingerprint)
+
+
+def _ckpt_tree(ckpt: CGCheckpoint, fingerprint: str) -> dict:
+    return {
+        "version": _FORMAT_VERSION,
+        "fingerprint": fingerprint,
+        "x": ckpt.x, "r": ckpt.r, "p": ckpt.p,
+        "rho": ckpt.rho, "rr": ckpt.rr, "nrm0": ckpt.nrm0,
+        "k": ckpt.k, "indefinite": ckpt.indefinite,
+    }
+
+
+def save_checkpoint_orbax(path: str, ckpt: CGCheckpoint,
+                          fingerprint: str = "") -> None:
+    """Persist via orbax: sharded arrays are written shard-by-shard (each
+    host saves only what it owns), unlike the .npz path which gathers to
+    one host.  ``path`` becomes a directory."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(os.path.abspath(path), _ckpt_tree(ckpt, fingerprint),
+               force=True)
+
+
+def load_checkpoint_orbax(path: str,
+                          expect_fingerprint: str = "") -> CGCheckpoint:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    z = ckptr.restore(os.path.abspath(path))
+    return _checkpoint_from_mapping(z, path, expect_fingerprint)
 
 
 def solve_resumable(
@@ -98,11 +134,15 @@ def solve_resumable(
     maxiter: int = 2000,
     m=None,
     keep_checkpoint: bool = False,
+    backend: str = "npz",
 ) -> CGResult:
     """Solve A x = b, checkpointing to ``path`` every ``segment_iters``.
 
     If ``path`` exists the solve resumes from it (exact trajectory).  On
     convergence the checkpoint is removed unless ``keep_checkpoint``.
+    ``backend``: ``"npz"`` (single-file, framework-free) or ``"orbax"``
+    (directory; sharded arrays saved shard-by-shard - the multi-host
+    choice).
 
     The per-segment host round-trip costs one dispatch per
     ``segment_iters`` iterations - amortized to nothing for realistic
@@ -111,10 +151,14 @@ def solve_resumable(
     """
     if segment_iters < 1:
         raise ValueError(f"segment_iters must be >= 1, got {segment_iters}")
+    if backend not in ("npz", "orbax"):
+        raise ValueError(f"unknown checkpoint backend: {backend!r}")
+    save = save_checkpoint_orbax if backend == "orbax" else save_checkpoint
+    load = load_checkpoint_orbax if backend == "orbax" else load_checkpoint
     fp = problem_fingerprint(a, b)
     state: Optional[CGCheckpoint] = None
     if os.path.exists(path):
-        state = load_checkpoint(path, expect_fingerprint=fp)
+        state = load(path, expect_fingerprint=fp)
 
     while True:
         done_k = int(state.k) if state is not None else 0
@@ -126,13 +170,18 @@ def solve_resumable(
                     resume_from=state, return_checkpoint=True,
                     iter_cap=cap)
         state = res.checkpoint
-        save_checkpoint(path, state, fingerprint=fp)
+        save(path, state, fingerprint=fp)
         finished = bool(res.converged) or int(res.iterations) >= maxiter \
             or res.status_enum().name == "BREAKDOWN"
         if finished:
             if bool(res.converged) and not keep_checkpoint:
+                import shutil
+
                 try:
-                    os.remove(path)
+                    if os.path.isdir(path):
+                        shutil.rmtree(path)  # orbax writes a directory
+                    else:
+                        os.remove(path)
                 except OSError:
                     pass
             return res

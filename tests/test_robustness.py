@@ -1,0 +1,174 @@
+"""Robustness tiers from SURVEY SS4/SS5: jax_debug_nans runs (the 'race
+detection / sanitizer' analogue - any NaN produced inside the jitted solve
+raises immediately), property-style sharded-vs-unsharded equivalence over
+random shard counts, and the orbax checkpoint backend."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import solve
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.models.operators import Stencil2D
+
+
+class TestDebugNans:
+    """The solver's guarded arithmetic (_safe_div, breakdown predicates)
+    must never produce NaN on healthy paths - verified by running under
+    jax_debug_nans, which raises on any NaN appearing in any primitive
+    output."""
+
+    def _with_debug_nans(self, fn):
+        jax.config.update("jax_debug_nans", True)
+        try:
+            return fn()
+        finally:
+            jax.config.update("jax_debug_nans", False)
+
+    def test_oracle_solve(self):
+        a, b, x_exp = poisson.oracle_system()
+        res = self._with_debug_nans(lambda: solve(a, b))
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x), x_exp, atol=1e-9)
+
+    @pytest.mark.parametrize("method", ["cg", "cg1", "pipecg"])
+    def test_methods_past_exact_convergence(self, method):
+        """check_every blocks run iterations past an exact solve; the
+        0/0 cases must freeze, not NaN (quirk-Q4 divergence)."""
+        a, b, _ = poisson.oracle_system()
+        res = self._with_debug_nans(
+            lambda: solve(a, b, check_every=8, method=method))
+        assert bool(res.converged)
+
+    def test_multigrid_solve(self):
+        op = poisson.poisson_2d_operator(16, 16, dtype=jnp.float64)
+        from cuda_mpi_parallel_tpu.models.multigrid import (
+            MultigridPreconditioner,
+        )
+
+        m = MultigridPreconditioner.from_operator(op)
+        res = self._with_debug_nans(
+            lambda: solve(op, jnp.ones(256), rtol=1e-8, tol=0.0,
+                          maxiter=100, m=m))
+        assert bool(res.converged)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+class TestShardCountInvariance:
+    """Property tier (SURVEY SS4): the SAME system solved over 1, 2, 4 and
+    8 shards must produce the same trajectory to rounding."""
+
+    @pytest.mark.parametrize("n_shards", [2, 4, 8])
+    def test_stencil_2d(self, n_shards):
+        from cuda_mpi_parallel_tpu.parallel import make_mesh, solve_distributed
+
+        n = 32
+        a = Stencil2D.create(n, n, dtype=jnp.float64)
+        x_true = np.random.default_rng(51).standard_normal(n * n)
+        b = a @ jnp.asarray(x_true)
+        single = solve(a, b, tol=0.0, rtol=1e-9, maxiter=400)
+        dist = solve_distributed(a, b, mesh=make_mesh(n_shards), tol=0.0,
+                                 rtol=1e-9, maxiter=400)
+        assert bool(dist.converged)
+        assert abs(int(dist.iterations) - int(single.iterations)) <= 1
+        np.testing.assert_allclose(np.asarray(dist.x),
+                                   np.asarray(single.x),
+                                   rtol=1e-9, atol=1e-11)
+
+    @pytest.mark.parametrize("n_shards", [2, 8])
+    def test_csr_ring(self, n_shards):
+        import scipy.sparse as sp
+
+        from cuda_mpi_parallel_tpu.models.operators import CSRMatrix
+        from cuda_mpi_parallel_tpu.parallel import make_mesh, solve_distributed
+
+        n = 72
+        m = sp.random(n, n, density=0.08,
+                      random_state=np.random.RandomState(13), format="csr")
+        m = m + m.T + sp.eye(n) * (np.abs(m).sum(axis=1).max() + 1.0)
+        m = m.tocsr()
+        m.sort_indices()
+        a = CSRMatrix.from_scipy(m)
+        x_true = np.random.default_rng(52).standard_normal(n)
+        b = jnp.asarray(m @ x_true)
+        single = solve(a, b, tol=0.0, rtol=1e-10, maxiter=400)
+        dist = solve_distributed(a, b, mesh=make_mesh(n_shards), tol=0.0,
+                                 rtol=1e-10, maxiter=400, csr_comm="ring")
+        assert bool(dist.converged)
+        assert abs(int(dist.iterations) - int(single.iterations)) <= 1
+        np.testing.assert_allclose(np.asarray(dist.x), x_true, atol=1e-7)
+
+
+class TestOrbaxCheckpoint:
+    def test_roundtrip(self, tmp_path, rng):
+        from cuda_mpi_parallel_tpu.utils import checkpoint as ckpt
+
+        a = poisson.poisson_2d_operator(8, 8, dtype=jnp.float64)
+        b = jnp.asarray(rng.standard_normal(64))
+        res = solve(a, b, tol=0.0, rtol=1e-3, maxiter=50,
+                    return_checkpoint=True)
+        path = str(tmp_path / "orbax_ckpt")
+        fp = ckpt.problem_fingerprint(a, b)
+        ckpt.save_checkpoint_orbax(path, res.checkpoint, fingerprint=fp)
+        loaded = ckpt.load_checkpoint_orbax(path, expect_fingerprint=fp)
+        for field in ("x", "r", "p", "rho", "rr", "nrm0", "k",
+                      "indefinite"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(loaded, field)),
+                np.asarray(getattr(res.checkpoint, field)))
+
+    def test_fingerprint_mismatch(self, tmp_path, rng):
+        from cuda_mpi_parallel_tpu.utils import checkpoint as ckpt
+
+        a = poisson.poisson_2d_operator(8, 8, dtype=jnp.float64)
+        b = jnp.asarray(rng.standard_normal(64))
+        res = solve(a, b, tol=0.0, rtol=1e-3, maxiter=50,
+                    return_checkpoint=True)
+        path = str(tmp_path / "orbax_ckpt")
+        ckpt.save_checkpoint_orbax(path, res.checkpoint, fingerprint="aaaa")
+        with pytest.raises(ValueError, match="different problem"):
+            ckpt.load_checkpoint_orbax(path, expect_fingerprint="bbbb")
+
+    def test_resume_continues_exact_trajectory(self, tmp_path, rng):
+        """Orbax round-trip feeds resume_from and reproduces the
+        uninterrupted trajectory bit-for-bit."""
+        from cuda_mpi_parallel_tpu.utils import checkpoint as ckpt
+
+        a = poisson.poisson_2d_operator(12, 12, dtype=jnp.float64)
+        b = jnp.asarray(rng.standard_normal(144))
+        full = solve(a, b, tol=0.0, rtol=1e-10, maxiter=400)
+        part = solve(a, b, tol=0.0, rtol=1e-10, maxiter=400,
+                     iter_cap=20, return_checkpoint=True)
+        path = str(tmp_path / "orbax_ckpt")
+        ckpt.save_checkpoint_orbax(path, part.checkpoint)
+        loaded = ckpt.load_checkpoint_orbax(path)
+        resumed = solve(a, b, tol=0.0, rtol=1e-10, maxiter=400,
+                        resume_from=loaded)
+        assert int(resumed.iterations) == int(full.iterations)
+        np.testing.assert_array_equal(np.asarray(resumed.x),
+                                      np.asarray(full.x))
+
+    def test_solve_resumable_orbax_backend(self, tmp_path, rng):
+        from cuda_mpi_parallel_tpu.utils import checkpoint as ckpt
+
+        a = poisson.poisson_2d_operator(12, 12, dtype=jnp.float64)
+        b = jnp.asarray(rng.standard_normal(144))
+        path = str(tmp_path / "resume_dir")
+        res = ckpt.solve_resumable(a, b, path, segment_iters=25,
+                                   tol=0.0, rtol=1e-9, maxiter=500,
+                                   backend="orbax")
+        assert bool(res.converged)
+        full = solve(a, b, tol=0.0, rtol=1e-9, maxiter=500)
+        assert int(res.iterations) == int(full.iterations)
+        assert not jnp.any(jnp.isnan(res.x))
+        import os
+
+        assert not os.path.exists(path)  # removed on convergence
+
+    def test_solve_resumable_unknown_backend(self, tmp_path):
+        from cuda_mpi_parallel_tpu.utils import checkpoint as ckpt
+
+        a = poisson.poisson_2d_operator(4, 4, dtype=jnp.float64)
+        with pytest.raises(ValueError, match="backend"):
+            ckpt.solve_resumable(a, jnp.ones(16), str(tmp_path / "x"),
+                                 backend="pickle")
