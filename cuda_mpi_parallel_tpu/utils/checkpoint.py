@@ -114,12 +114,27 @@ def save_checkpoint_orbax(path: str, ckpt: CGCheckpoint,
                force=True)
 
 
-def load_checkpoint_orbax(path: str,
-                          expect_fingerprint: str = "") -> CGCheckpoint:
+def load_checkpoint_orbax(path: str, expect_fingerprint: str = "",
+                          like: Optional[CGCheckpoint] = None
+                          ) -> CGCheckpoint:
+    """Restore an orbax checkpoint.
+
+    ``like``: optional template checkpoint whose array shapes/shardings
+    describe the LIVE topology (e.g. a zero-filled state built on the
+    current mesh).  Without it the arrays come back with the sharding
+    recorded at save time - fine when resuming on the same topology, a
+    hazard across topologies (orbax warns); with it the restore places
+    shards directly onto the current mesh.
+    """
     import orbax.checkpoint as ocp
 
     ckptr = ocp.PyTreeCheckpointer()
-    z = ckptr.restore(os.path.abspath(path))
+    if like is not None:
+        target = _ckpt_tree(like, fingerprint="")
+        restore_args = ocp.checkpoint_utils.construct_restore_args(target)
+        z = ckptr.restore(os.path.abspath(path), restore_args=restore_args)
+    else:
+        z = ckptr.restore(os.path.abspath(path))
     return _checkpoint_from_mapping(z, path, expect_fingerprint)
 
 
@@ -158,6 +173,12 @@ def solve_resumable(
     fp = problem_fingerprint(a, b)
     state: Optional[CGCheckpoint] = None
     if os.path.exists(path):
+        on_disk = "orbax" if os.path.isdir(path) else "npz"
+        if on_disk != backend:
+            raise ValueError(
+                f"checkpoint at {path} is in {on_disk} format but "
+                f"backend={backend!r} was requested; pass "
+                f"backend={on_disk!r} to resume it (or delete it)")
         state = load(path, expect_fingerprint=fp)
 
     while True:

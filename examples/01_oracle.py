@@ -1,0 +1,22 @@
+"""The reference's hardcoded 3x3 system, end to end.
+
+Reproduces CUDACG.cu's entire behavior (solve + print x) in four lines,
+plus everything it never reported: iteration count, residual, status.
+Run: python examples/01_oracle.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from cuda_mpi_parallel_tpu import solve
+from cuda_mpi_parallel_tpu.models import poisson
+
+a, b, x_expected = poisson.oracle_system()
+res = solve(a, b)  # defaults = reference semantics (tol 1e-7 abs, maxit 2000)
+print(f"x          = {res.x}")
+print(f"expected   = {x_expected}")
+print(f"iterations = {int(res.iterations)} (reference: 3)")
+print(f"||r||      = {float(res.residual_norm):.3e}")
+print(f"status     = {res.status_enum().name}")
+print(f"indefinite = {bool(res.indefinite)}  (quirk Q1: p.Ap < 0 at iter 2)")

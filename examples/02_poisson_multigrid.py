@@ -1,0 +1,34 @@
+"""Large 2D Poisson with the preconditioner ladder.
+
+Compares unpreconditioned / Chebyshev / multigrid CG on a 1M-unknown
+system - multigrid's iteration count is flat in grid size.
+Run: python examples/02_poisson_multigrid.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_mpi_parallel_tpu import solve
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.models.multigrid import MultigridPreconditioner
+from cuda_mpi_parallel_tpu.models.precond import ChebyshevPreconditioner
+
+n = 1024
+op = poisson.poisson_2d_operator(n, n, dtype=jnp.float32)
+rng = np.random.default_rng(0)
+x_true = rng.standard_normal(n * n).astype(np.float32)
+b = op @ jnp.asarray(x_true)
+
+for name, m in [
+    ("plain", None),
+    ("chebyshev(4)", ChebyshevPreconditioner.from_operator(op, degree=4)),
+    ("multigrid", MultigridPreconditioner.from_operator(op)),
+]:
+    res = solve(op, b, tol=0.0, rtol=1e-5, maxiter=5000, m=m)
+    err = float(jnp.max(jnp.abs(res.x - jnp.asarray(x_true))))
+    print(f"{name:14s} iters={int(res.iterations):5d} "
+          f"converged={bool(res.converged)} max_err={err:.2e}")

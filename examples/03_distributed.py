@@ -1,0 +1,40 @@
+"""Distributed 3D Poisson: slab and pencil partitions.
+
+On a multi-chip host this spans real devices; on CPU set
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+(or just run tests/, whose conftest does it for you).
+Run: python examples/03_distributed.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_mpi_parallel_tpu.models.operators import Stencil3D
+from cuda_mpi_parallel_tpu.parallel import (
+    make_mesh,
+    make_mesh_2d,
+    solve_distributed,
+)
+
+ndev = len(jax.devices())
+nx = 8 * ndev
+op = Stencil3D.create(nx, 16, 16, dtype=jnp.float32)
+rng = np.random.default_rng(0)
+x_true = rng.standard_normal(op.shape[0]).astype(np.float32)
+b = op @ jnp.asarray(x_true)
+
+res = solve_distributed(op, b, mesh=make_mesh(ndev), tol=1e-3,
+                        preconditioner="mg")
+print(f"slab   mesh={ndev}: iters={int(res.iterations)} "
+      f"converged={bool(res.converged)}")
+
+if ndev >= 4 and ndev % 2 == 0:
+    res = solve_distributed(op, b, mesh=make_mesh_2d((ndev // 2, 2)),
+                            tol=1e-3)
+    print(f"pencil mesh=({ndev // 2},2): iters={int(res.iterations)} "
+          f"converged={bool(res.converged)}")

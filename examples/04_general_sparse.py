@@ -1,0 +1,41 @@
+"""General sparse matrices on TPU: RCM reordering + the DIA format.
+
+TPU vector memory has no efficient random access, so the gather-based
+CSR path is slow; the RCM -> DIA pipeline turns a banded-able matrix
+into gather-free shifted FMAs (~340x faster at 1M rows).
+Run: python examples/04_general_sparse.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from cuda_mpi_parallel_tpu import solve
+from cuda_mpi_parallel_tpu.models.operators import CSRMatrix
+
+# a banded SPD system, scrambled (as if numbered badly by a mesh tool)
+n = 5000
+m = sp.diags([np.ones(n - 1), 4 * np.ones(n), np.ones(n - 1)],
+             [-1, 0, 1], format="csr")
+rng = np.random.default_rng(0)
+scramble = rng.permutation(n).astype(np.int32)
+a = CSRMatrix.from_scipy(m.tocsr()).permuted(scramble)
+print(f"scrambled bandwidth: {a.bandwidth()}")
+
+perm = a.rcm_permutation()          # native C++ RCM
+banded = a.permuted(perm)
+print(f"after RCM:           {banded.bandwidth()}")
+
+dia = banded.to_dia()               # gather-free layout
+print(f"DIA diagonals:       {dia.n_diags}")
+
+b = rng.standard_normal(n)          # rhs of the (scrambled) system A x = b
+res = solve(dia, jnp.asarray(b[perm]), tol=0.0, rtol=1e-8, maxiter=5000)
+x = np.empty(n)
+x[perm] = np.asarray(res.x)         # scatter back to the original ordering
+print(f"solve: iters={int(res.iterations)} converged={bool(res.converged)}")
+print(f"residual check: {np.linalg.norm(b - np.asarray(a.to_dense()) @ x):.2e}")

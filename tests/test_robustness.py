@@ -172,3 +172,44 @@ class TestOrbaxCheckpoint:
         with pytest.raises(ValueError, match="backend"):
             ckpt.solve_resumable(a, jnp.ones(16), str(tmp_path / "x"),
                                  backend="pickle")
+
+    def test_backend_mismatch_clear_error(self, tmp_path, rng):
+        from cuda_mpi_parallel_tpu.utils import checkpoint as ckpt
+
+        a = poisson.poisson_2d_operator(8, 8, dtype=jnp.float64)
+        b = jnp.asarray(rng.standard_normal(64))
+        path = str(tmp_path / "ck")
+        ckpt.solve_resumable(a, b, path, segment_iters=10, maxiter=20,
+                             backend="orbax", keep_checkpoint=True)
+        with pytest.raises(ValueError, match="orbax format"):
+            ckpt.solve_resumable(a, b, path, segment_iters=10, maxiter=40)
+
+    def test_restore_with_live_template(self, tmp_path, rng):
+        """like= restores shards onto the current topology (no stale
+        file shardings, no orbax warning)."""
+        import warnings
+
+        import jax as _jax
+
+        from cuda_mpi_parallel_tpu.solver.cg import CGCheckpoint
+        from cuda_mpi_parallel_tpu.utils import checkpoint as ckpt
+
+        a = poisson.poisson_2d_operator(8, 8, dtype=jnp.float64)
+        b = jnp.asarray(rng.standard_normal(64))
+        res = solve(a, b, tol=0.0, rtol=1e-3, maxiter=50,
+                    return_checkpoint=True)
+        path = str(tmp_path / "ck_like")
+        ckpt.save_checkpoint_orbax(path, res.checkpoint)
+        z = jnp.zeros(64, jnp.float64)
+        s = jnp.zeros((), jnp.float64)
+        template = CGCheckpoint(x=z, r=z, p=z, rho=s, rr=s, nrm0=s,
+                                k=jnp.zeros((), jnp.int32),
+                                indefinite=jnp.zeros((), bool))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            loaded = ckpt.load_checkpoint_orbax(path, like=template)
+            sharding_warns = [x for x in w
+                              if "harding" in str(x.message)]
+        assert not sharding_warns
+        np.testing.assert_array_equal(np.asarray(loaded.x),
+                                      np.asarray(res.checkpoint.x))
