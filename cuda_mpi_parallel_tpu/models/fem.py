@@ -1,0 +1,61 @@
+"""Unstructured-FEM-like SPD generators: SuiteSparse stand-ins.
+
+BASELINE config #5 names SuiteSparse matrices (thermal2 / G3_circuit /
+parabolic_fem) that a zero-egress image cannot download.  This module
+generates matrices with the same *character* - unstructured finite-element
+Laplacians over random planar triangulations: symmetric positive definite,
+irregular sparsity (5-9 nnz/row, no bandable structure until RCM), the
+workload class where TPU SpMV is gather-bound and the RCM pipeline
+matters.  Real .mtx files dropped into ``matrices/`` still take precedence
+in ``bench.py --all``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .operators import CSRMatrix
+
+
+def random_fem_2d(n_points: int, *, seed: int = 0,
+                  dtype=np.float64) -> CSRMatrix:
+    """SPD stiffness-like matrix over a random Delaunay triangulation.
+
+    Builds the graph Laplacian of the triangulation's edge graph with
+    random positive edge weights (conductances), plus a small positive
+    diagonal shift - the same structure as a P1 FEM stiffness matrix for
+    a heterogeneous diffusion problem with a mass/reaction term, and the
+    same irregular sparsity (average degree ~6 in 2D).
+    """
+    from scipy.spatial import Delaunay
+
+    rng = np.random.default_rng(seed)
+    pts = rng.random((n_points, 2))
+    tri = Delaunay(pts)
+
+    # unique undirected edges of the triangulation
+    s = tri.simplices
+    edges = np.concatenate([s[:, [0, 1]], s[:, [1, 2]], s[:, [0, 2]]])
+    edges = np.sort(edges, axis=1)
+    edges = np.unique(edges, axis=0)
+    i, j = edges[:, 0], edges[:, 1]
+
+    # conductances ~ lognormal (heterogeneous medium)
+    w = np.exp(rng.standard_normal(edges.shape[0]) * 0.5).astype(np.float64)
+
+    # Laplacian: A[i,j] = -w_ij, A[i,i] = sum_j w_ij + shift
+    rows = np.concatenate([i, j, i, j])
+    cols = np.concatenate([j, i, i, j])
+    vals = np.concatenate([-w, -w, w, w])
+    shift = 1e-3
+    rows = np.concatenate([rows, np.arange(n_points)])
+    cols = np.concatenate([cols, np.arange(n_points)])
+    vals = np.concatenate([vals, np.full(n_points, shift)])
+
+    import scipy.sparse as sp
+
+    m = sp.coo_matrix((vals, (rows, cols)),
+                      shape=(n_points, n_points)).tocsr()
+    m.sum_duplicates()
+    m.sort_indices()
+    m = m.astype(np.dtype(dtype))
+    return CSRMatrix.from_scipy(m)

@@ -1,0 +1,132 @@
+"""Empirical configuration autotuner.
+
+The framework exposes performance knobs whose best setting is
+hardware/problem dependent: ``check_every`` (predicate cadence),
+``method`` (cg / cg1 / pipecg recurrences), and the stencil ``backend``
+(fused-XLA vs pallas slab-DMA, which crosses over at the VMEM boundary).
+The reference has no equivalent - its one configuration is hardcoded
+(SURVEY SS5 "Config").  ``autotune`` measures each candidate's marginal
+per-iteration cost on the actual device with the actual operator
+(iteration-count deltas, so the ~0.5 s tunneled-dispatch floor cancels)
+and returns the fastest configuration as ready-to-splat solver kwargs.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from .timing import time_fn
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneResult:
+    """Outcome of an autotune sweep."""
+
+    best: Dict            # kwargs for solve()/solve_distributed()
+    us_per_iter: float    # measured marginal cost of the best config
+    table: Dict[str, float]  # config label -> us/iter (nan = failed)
+
+    def __str__(self) -> str:
+        lines = [f"autotune: best = {self.best} "
+                 f"({self.us_per_iter:.1f} us/iter)"]
+        for label, us in sorted(self.table.items(), key=lambda kv: kv[1]):
+            lines.append(f"  {label:40s} {us:10.1f} us/iter")
+        return "\n".join(lines)
+
+
+def _candidate_ops(a):
+    """(label, operator) variants: stencils try both matvec backends."""
+    from ..models.operators import Stencil2D, Stencil3D
+
+    ops = [("", a)]
+    if isinstance(a, (Stencil2D, Stencil3D)):
+        for backend in ("xla", "pallas"):
+            if backend == a.backend:
+                continue
+            try:
+                alt = dataclasses.replace(a, backend=backend)
+                # validate the pallas tile constraints via create
+                from ..ops.pallas import stencil as pk
+
+                grid = a.grid
+                ok = (pk.supports_2d(*grid) if len(grid) == 2
+                      else pk.supports_3d(*grid))
+                if backend == "pallas" and not ok:
+                    continue
+                ops.append((f"backend={backend} ", alt))
+            except (ValueError, ImportError):
+                continue
+    return ops
+
+
+def autotune(
+    a,
+    b,
+    *,
+    m=None,
+    methods: Tuple[str, ...] = ("cg", "cg1"),
+    check_everys: Tuple[int, ...] = (1, 32),
+    iters_lo: int = 32,
+    iters_hi: int = 160,
+    repeats: int = 3,
+) -> TuneResult:
+    """Measure candidate solver configurations and return the fastest.
+
+    Each candidate runs ``tol=0`` solves of ``iters_lo`` and ``iters_hi``
+    iterations; the cost is the delta divided by the iteration gap, which
+    cancels fixed dispatch overhead.  Keep ``iters_hi`` below the point
+    where a strong preconditioner drives the residual to exact zero (the
+    loop would exit early and corrupt the delta).
+
+    Returns a ``TuneResult``; splat ``result.best`` into ``solve``:
+
+        cfg = autotune(op, b)
+        res = solve(op, b, rtol=1e-6, **cfg.best)
+    """
+    from ..solver.cg import solve
+
+    table: Dict[str, float] = {}
+    results: List[Tuple[float, Dict]] = []
+    for op_label, op in _candidate_ops(a):
+        for method in methods:
+            for ce in check_everys:
+                label = f"{op_label}method={method} check_every={ce}"
+                kwargs = {"method": method, "check_every": ce}
+                try:
+                    t_lo, _ = time_fn(
+                        lambda: solve(op, b, tol=0.0, maxiter=iters_lo,
+                                      m=m, **kwargs),
+                        warmup=1, repeats=repeats, reduce="median")
+                    t_hi, _ = time_fn(
+                        lambda: solve(op, b, tol=0.0, maxiter=iters_hi,
+                                      m=m, **kwargs),
+                        warmup=1, repeats=repeats, reduce="median")
+                    us = max(t_hi - t_lo, 0.0) / (iters_hi - iters_lo) * 1e6
+                except Exception:
+                    table[label] = float("nan")
+                    continue
+                table[label] = us
+                best_kwargs = dict(kwargs)
+                if op_label:
+                    best_kwargs["_operator"] = op
+                results.append((us, best_kwargs))
+
+    if not results:
+        raise RuntimeError("autotune: every candidate configuration failed")
+    results.sort(key=lambda kv: kv[0])
+    us, best = results[0]
+    return TuneResult(best=best, us_per_iter=us, table=table)
+
+
+def solve_tuned(a, b, *, m=None, tune_kwargs=None, **solve_kwargs):
+    """Autotune, then solve with the winning configuration.
+
+    The measured sweep costs ~(2 * candidates * repeats) short solves -
+    worth it for long or repeated solves, not for one-shot small systems.
+    """
+    from ..solver.cg import solve
+
+    cfg = autotune(a, b, m=m, **(tune_kwargs or {}))
+    best = dict(cfg.best)
+    op = best.pop("_operator", a)
+    return solve(op, b, m=m, **best, **solve_kwargs), cfg

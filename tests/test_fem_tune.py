@@ -1,0 +1,67 @@
+"""FEM-like generator (SuiteSparse stand-in) + autotuner tests."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import solve
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.models.fem import random_fem_2d
+
+
+class TestRandomFEM:
+    def test_spd_and_solvable(self, rng):
+        a = random_fem_2d(400, seed=3)
+        dense = np.asarray(a.to_dense())
+        np.testing.assert_allclose(dense, dense.T, atol=1e-12)
+        w = np.linalg.eigvalsh(dense)
+        assert w.min() > 0  # SPD (Laplacian + positive shift)
+        x_true = rng.standard_normal(400)
+        b = a @ jnp.asarray(x_true)
+        res = solve(a, b, tol=0.0, rtol=1e-10, maxiter=5000)
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x), x_true, atol=1e-5)
+
+    def test_unstructured_character(self):
+        """FEM-like degree distribution (~6 avg in 2D) and large
+        bandwidth until RCM reorders it."""
+        a = random_fem_2d(1000, seed=4)
+        avg_nnz = a.nnz / a.shape[0]
+        assert 5.0 < avg_nnz < 9.0
+        bw = a.bandwidth()
+        rcm_bw = a.permuted(a.rcm_permutation()).bandwidth()
+        assert rcm_bw < bw / 3  # RCM concentrates the band
+
+    def test_deterministic(self):
+        a1 = random_fem_2d(200, seed=7)
+        a2 = random_fem_2d(200, seed=7)
+        np.testing.assert_array_equal(np.asarray(a1.data),
+                                      np.asarray(a2.data))
+
+
+class TestAutotune:
+    def test_returns_valid_config(self, rng):
+        from cuda_mpi_parallel_tpu.utils.tune import autotune
+
+        op = poisson.poisson_2d_operator(32, 32, dtype=jnp.float64)
+        b = jnp.asarray(rng.standard_normal(1024))
+        cfg = autotune(op, b, iters_lo=8, iters_hi=24, repeats=1)
+        assert cfg.best["method"] in ("cg", "cg1")
+        assert cfg.best["check_every"] in (1, 32)
+        assert np.isfinite(cfg.us_per_iter)
+        assert len(cfg.table) >= 4
+        # best must be the minimum of the measured table
+        finite = [v for v in cfg.table.values() if np.isfinite(v)]
+        assert cfg.us_per_iter == pytest.approx(min(finite))
+
+    def test_solve_tuned_converges(self, rng):
+        from cuda_mpi_parallel_tpu.utils.tune import solve_tuned
+
+        op = poisson.poisson_2d_operator(24, 24, dtype=jnp.float64)
+        x_true = rng.standard_normal(576)
+        b = op @ jnp.asarray(x_true)
+        res, cfg = solve_tuned(op, b, tol=0.0, rtol=1e-9, maxiter=2000,
+                               tune_kwargs=dict(iters_lo=8, iters_hi=24,
+                                                repeats=1))
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x), x_true, atol=1e-6)
+        print(cfg)
