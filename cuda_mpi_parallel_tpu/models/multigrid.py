@@ -44,6 +44,9 @@ shapes and no gather:
   coarse grid, identically on every shard - so the distributed hierarchy
   is EXACTLY the single-device hierarchy (tests assert iteration parity),
   at the cost of one small collective per cycle at the gather level.
+  ``DistStencil3DPencil`` blocks work the same way with TWO partitioned
+  grid axes: transfers halo-exchange over both mesh axes and the gather
+  level all_gathers over both.
 """
 from __future__ import annotations
 
@@ -81,10 +84,30 @@ def _level_ops(a, min_extent: int, max_levels: int):
     pallas HBM threshold, and the pallas kernels' tile-divisibility
     constraints do not generally survive halving.
     """
-    from ..parallel.operators import DistStencil2D, DistStencil3D
+    from ..parallel.operators import (
+        DistStencil2D,
+        DistStencil3D,
+        DistStencil3DPencil,
+    )
+
+    def _replicated(scale, ggrid, dtype_name, budget):
+        """Replicated single-device continuation of a distributed
+        hierarchy, starting one level BELOW the global grid ``ggrid``."""
+        if budget <= 0 or not _can_halve(ggrid, min_extent):
+            return ()
+        cls2 = Stencil2D if len(ggrid) == 2 else Stencil3D
+        out = [cls2(scale=scale * _COARSE_SCALE,
+                    grid=tuple(g // 2 for g in ggrid),
+                    backend="xla", _dtype_name=dtype_name)]
+        while len(out) < budget and _can_halve(out[-1].grid, min_extent):
+            prev = out[-1]
+            out.append(dataclasses.replace(
+                prev, scale=prev.scale * _COARSE_SCALE,
+                grid=tuple(g // 2 for g in prev.grid)))
+        return tuple(out)
 
     ops = [a]
-    global_ops = []
+    global_ops = ()
     while len(ops) + len(global_ops) < max_levels:
         op = ops[-1]
         if isinstance(op, (Stencil2D, Stencil3D)):
@@ -103,24 +126,24 @@ def _level_ops(a, min_extent: int, max_levels: int):
                 # local extent exhausted: continue on the replicated
                 # global grid if it can still coarsen
                 ggrid = (lg[0] * op.n_shards,) + tuple(lg[1:])
-                if not _can_halve(ggrid, min_extent):
-                    break
-                cls2 = Stencil2D if len(ggrid) == 2 else Stencil3D
-                g_first = cls2(scale=op.scale * _COARSE_SCALE,
-                               grid=tuple(g // 2 for g in ggrid),
-                               backend="xla", _dtype_name=op._dtype_name)
-                global_ops.append(g_first)
-                while (len(ops) + len(global_ops) < max_levels
-                       and _can_halve(global_ops[-1].grid, min_extent)):
-                    prev = global_ops[-1]
-                    global_ops.append(dataclasses.replace(
-                        prev, scale=prev.scale * _COARSE_SCALE,
-                        grid=tuple(g // 2 for g in prev.grid)))
+                global_ops = _replicated(op.scale, ggrid, op._dtype_name,
+                                         max_levels - len(ops))
+                break
+        elif isinstance(op, DistStencil3DPencil):
+            lg = op.local_grid
+            if _can_halve(lg, min_extent):
+                coarse = dataclasses.replace(
+                    op, scale=op.scale * _COARSE_SCALE,
+                    local_grid=tuple(g // 2 for g in lg))
+            else:
+                ggrid = (lg[0] * op.shards[0], lg[1] * op.shards[1], lg[2])
+                global_ops = _replicated(op.scale, ggrid, op._dtype_name,
+                                         max_levels - len(ops))
                 break
         else:
             raise TypeError(
-                f"multigrid supports Stencil2D/3D and DistStencil2D/3D, "
-                f"got {type(op).__name__}")
+                f"multigrid supports Stencil2D/3D, DistStencil2D/3D and "
+                f"DistStencil3DPencil, got {type(op).__name__}")
         ops.append(coarse)
     return tuple(ops), tuple(global_ops)
 
@@ -134,6 +157,17 @@ def _op_dist(op):
     if hasattr(op, "axis_name") and getattr(op, "n_shards", 1) > 1:
         return op.axis_name, op.n_shards
     return None
+
+
+def _axis_dists(op) -> Tuple:
+    """Per-grid-axis ``(mesh_axis_name, n_shards) | None``: which local
+    grid axes are partitioned, and over what.  Slabs partition axis 0
+    only; pencils partition axes 0 and 1, each over its own mesh axis."""
+    ndim = len(_op_grid(op))
+    if hasattr(op, "axis_names"):  # DistStencil3DPencil
+        return ((op.axis_names[0], op.shards[0]),
+                (op.axis_names[1], op.shards[1])) + (None,) * (ndim - 2)
+    return (_op_dist(op),) + (None,) * (ndim - 1)
 
 
 def _pad_axis0(u: jax.Array, dist) -> jax.Array:
@@ -153,10 +187,11 @@ def _p1d(c: jax.Array, axis: int, dist=None) -> jax.Array:
 
     Fine cell 2I gets 3/4 c(I) + 1/4 c(I-1); fine cell 2I+1 gets
     3/4 c(I) + 1/4 c(I+1); out-of-range neighbors are zero (Dirichlet)
-    or the neighbor shard's plane (distributed leading axis).
+    or the neighbor shard's plane (when ``dist`` names the mesh axis
+    this grid axis is partitioned over).
     """
     cm = jnp.moveaxis(c, axis, 0)
-    pad = _pad_axis0(cm, dist if axis == 0 else None)
+    pad = _pad_axis0(cm, dist)
     even = 0.75 * cm + 0.25 * pad[:-2]
     odd = 0.75 * cm + 0.25 * pad[2:]
     out = jnp.stack([even, odd], axis=1).reshape((-1,) + cm.shape[1:])
@@ -169,7 +204,7 @@ def _r1d(f: jax.Array, axis: int, dist=None) -> jax.Array:
     """
     fm = jnp.moveaxis(f, axis, 0)
     n2 = fm.shape[0]
-    pad = _pad_axis0(fm, dist if axis == 0 else None)
+    pad = _pad_axis0(fm, dist)
     pairs = fm.reshape((n2 // 2, 2) + fm.shape[1:])
     left = pad[:-2].reshape((n2 // 2, 2) + fm.shape[1:])[:, 0]   # f(2I-1)
     right = pad[2:].reshape((n2 // 2, 2) + fm.shape[1:])[:, 1]   # f(2I+2)
@@ -177,17 +212,17 @@ def _r1d(f: jax.Array, axis: int, dist=None) -> jax.Array:
     return jnp.moveaxis(out, 0, axis)
 
 
-def _restrict(r: jax.Array, fine_grid, dist=None) -> jax.Array:
+def _restrict(r: jax.Array, fine_grid, dists=None) -> jax.Array:
     f = r.reshape(fine_grid)
     for ax in range(len(fine_grid)):
-        f = _r1d(f, ax, dist)
+        f = _r1d(f, ax, dists[ax] if dists else None)
     return f.reshape(-1)
 
 
-def _prolong(e: jax.Array, fine_grid, dist=None) -> jax.Array:
+def _prolong(e: jax.Array, fine_grid, dists=None) -> jax.Array:
     c = e.reshape(tuple(g // 2 for g in fine_grid))
     for ax in range(len(fine_grid)):
-        c = _p1d(c, ax, dist)
+        c = _p1d(c, ax, dists[ax] if dists else None)
     return c.reshape(-1)
 
 
@@ -268,29 +303,42 @@ class MultigridPreconditioner(LinearOperator):
             return self._smooth(op, jnp.zeros_like(r), r,
                                 self.coarse_sweeps)
         grid = _op_grid(op)
-        dist = _op_dist(op)
+        dists = _axis_dists(op)
         # pre-smooth from zero initial guess
         z = self._smooth(op, jnp.zeros_like(r), r, self.pre_sweeps)
         # coarse-grid correction on the residual
-        rc = _restrict(r - op @ z, grid, dist)
+        rc = _restrict(r - op @ z, grid, dists)
         ec = self._vcycle(level + 1, rc, ops)
-        z = z + _prolong(ec, grid, dist)
+        z = z + _prolong(ec, grid, dists)
         # post-smooth
         return self._smooth(op, z, r, self.post_sweeps)
 
     def _gather_level(self, op, r):
+        """Gather level, generic over the partitioned grid axes: one
+        tiled ``all_gather`` per partitioned axis reassembles the (tiny)
+        global residual, the replicated hierarchy continues identically
+        on every shard, and each shard slices its own block back out of
+        the prolonged correction.  Covers slabs (one axis) and pencils
+        (two) with the same code path."""
         from jax import lax
 
-        axis_name, n_shards = op.axis_name, op.n_shards
-        lg = op.local_grid
-        ggrid = (lg[0] * n_shards,) + tuple(lg[1:])
+        lg = _op_grid(op)
+        dists = _axis_dists(op)
+        ggrid = tuple(g * (d[1] if d else 1) for g, d in zip(lg, dists))
         z = self._smooth(op, jnp.zeros_like(r), r, self.pre_sweeps)
-        resid_g = lax.all_gather(r - op @ z, axis_name, tiled=True)
-        rc_g = _restrict(resid_g, ggrid)
+        resid = (r - op @ z).reshape(lg)
+        for ax, d in enumerate(dists):
+            if d:
+                resid = lax.all_gather(resid, d[0], axis=ax, tiled=True)
+        rc_g = _restrict(resid.reshape(-1), ggrid)
         ec_g = self._vcycle(0, rc_g, self.global_ops)
         e_fine = _prolong(ec_g, ggrid).reshape(ggrid)
-        i = lax.axis_index(axis_name)
-        e_local = lax.dynamic_slice_in_dim(e_fine, i * lg[0], lg[0], axis=0)
+        itype = lax.axis_index(
+            next(d[0] for d in dists if d)).dtype
+        starts = tuple(
+            lax.axis_index(d[0]) * g if d else jnp.zeros((), itype)
+            for g, d in zip(lg, dists))
+        e_local = lax.dynamic_slice(e_fine, starts, lg)
         z = z + e_local.reshape(-1)
         return self._smooth(op, z, r, self.post_sweeps)
 

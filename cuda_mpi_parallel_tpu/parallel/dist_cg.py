@@ -82,8 +82,10 @@ def solve_distributed(
         its power-iteration spectral estimate and every application run
         *inside* the shard_map body, psum/ppermute-reducing over the mesh
         - see ``models.precond``) or ``"mg"`` (geometric multigrid
-        V-cycle; stencil operators on 1-D meshes only).  ``"bjacobi"``
-        is single-device only.
+        V-cycle; stencil operators, on 1-D slab and 2-D pencil meshes -
+        on a pencil the V-cycle halo-exchanges over both mesh axes and
+        its gather level all_gathers over both).  ``"bjacobi"`` is
+        single-device only.
       method: ``"cg"``, ``"cg1"`` or ``"pipecg"`` - on a mesh, ``"cg1"``
         fuses each iteration's inner products into ONE ``psum`` (half the
         collective latency of the textbook recurrence) and ``"pipecg"``
@@ -127,10 +129,6 @@ def solve_distributed(
             raise TypeError(
                 "a 2-D mesh (pencil decomposition) supports Stencil3D "
                 f"only, got {type(a).__name__}")
-        if preconditioner == "mg":
-            raise ValueError(
-                "preconditioner='mg' supports 1-D meshes only; use "
-                "'jacobi'/'chebyshev' on a pencil mesh")
         if a.backend == "pallas":
             raise ValueError(
                 "the pencil path has no pallas matvec; re-create the "
@@ -150,6 +148,33 @@ def solve_distributed(
                           record_history, kw, csr_comm=csr_comm)
     raise TypeError(f"solve_distributed supports CSRMatrix/Stencil2D/"
                     f"Stencil3D, got {type(a).__name__}")
+
+
+#: compiled-solver cache: (problem structure, mesh, static config) ->
+#: jitted shard_map solve.  Round-1 weakness: every solve_distributed call
+#: built and jitted a fresh closure, so repeated identical solves paid
+#: full retrace+compile each time.  Array leaves (b, operator data, the
+#: stencil scale) are ARGUMENTS of the cached function, so jit's own
+#: signature cache handles shape/dtype changes; everything static lives in
+#: the key.  Unbounded, but one entry per distinct (operator structure,
+#: mesh, config) - a handful in any real process.
+_SOLVER_CACHE: dict = {}
+
+#: incremented every time a cached solver body is TRACED (the body runs as
+#: Python only during tracing) - lets tests assert zero-retrace on public
+#: surface instead of poking jit internals
+_TRACE_COUNT = [0]
+
+
+def clear_solver_cache() -> None:
+    _SOLVER_CACHE.clear()
+
+
+def _cached_solver(key, build):
+    fn = _SOLVER_CACHE.get(key)
+    if fn is None:
+        fn = _SOLVER_CACHE[key] = jax.jit(build())
+    return fn
 
 
 def _make_precond(precond, local, axis):
@@ -190,18 +215,25 @@ def _solve_pencil(a, b, mesh, precond, record_history, kw) -> CGResult:
 
     out = dataclasses.replace(_result_specs(None, record_history),
                               x=P(ax_x, ax_y))
+    key = ("pencil", local.local_grid, local.shards, local._dtype_name,
+           (ax_x, ax_y), mesh, precond, record_history,
+           tuple(sorted(kw.items())))
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P(ax_x, ax_y),
-             out_specs=out)
-    def run(b_local):
-        m = _make_precond(precond, local, (ax_x, ax_y))
-        res = cg(local, b_local.reshape(-1), m=m,
-                 record_history=record_history, axis_name=(ax_x, ax_y),
-                 **kw)
-        return dataclasses.replace(
-            res, x=res.x.reshape(local.local_grid))
+    def build():
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(ax_x, ax_y), P()),
+                 out_specs=out)
+        def run(b_local, scale):
+            _TRACE_COUNT[0] += 1
+            loc = dataclasses.replace(local, scale=scale)
+            m = _make_precond(precond, loc, (ax_x, ax_y))
+            res = cg(loc, b_local.reshape(-1), m=m,
+                     record_history=record_history, axis_name=(ax_x, ax_y),
+                     **kw)
+            return dataclasses.replace(
+                res, x=res.x.reshape(loc.local_grid))
+        return run
 
-    res = jax.jit(run)(b3)
+    res = _cached_solver(key, build)(b3, local.scale)
     return dataclasses.replace(res, x=res.x.reshape(-1))
 
 
@@ -217,15 +249,22 @@ def _solve_stencil(a, b, mesh, axis, n_shards, precond, record_history,
                                      backend=a.backend)
 
     b = shard_vector(jnp.asarray(b, a.dtype), mesh, axis)
+    key = ("stencil", type(local).__name__, local.local_grid,
+           local.backend, local._dtype_name, axis, mesh, precond,
+           record_history, tuple(sorted(kw.items())))
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P(axis),
-             out_specs=_result_specs(axis, record_history))
-    def run(b_local):
-        m = _make_precond(precond, local, axis)
-        return cg(local, b_local, m=m, record_history=record_history,
-                  axis_name=axis, **kw)
+    def build():
+        @partial(jax.shard_map, mesh=mesh, in_specs=(P(axis), P()),
+                 out_specs=_result_specs(axis, record_history))
+        def run(b_local, scale):
+            _TRACE_COUNT[0] += 1
+            loc = dataclasses.replace(local, scale=scale)
+            m = _make_precond(precond, loc, axis)
+            return cg(loc, b_local, m=m, record_history=record_history,
+                      axis_name=axis, **kw)
+        return run
 
-    return jax.jit(run)(b)
+    return _cached_solver(key, build)(b, local.scale)
 
 
 def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
@@ -245,20 +284,27 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
     cols = _shard(parts.cols)
     rows = _shard(parts.local_rows)
 
-    @partial(jax.shard_map, mesh=mesh,
-             in_specs=(P(axis), P(axis), P(axis), P(axis)),
-             out_specs=_result_specs(axis, record_history))
-    def run(b_local, data_s, cols_s, rows_s):
-        strip = partial(jax.tree.map, lambda v: v[0])
-        op_cls = DistCSRRing if ring else DistCSR
-        op = op_cls(data=strip(data_s), cols=strip(cols_s),
-                    local_rows=strip(rows_s), n_local=parts.n_local,
-                    axis_name=axis, n_shards=n_shards)
-        m = _make_precond(precond, op, axis)
-        return cg(op, b_local, m=m, record_history=record_history,
-                  axis_name=axis, **kw)
+    n_local = parts.n_local
+    key = ("csr", ring, n_local, n_shards, axis, mesh, precond,
+           record_history, tuple(sorted(kw.items())))
 
-    res = jax.jit(run)(b_dev, data, cols, rows)
+    def build():
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                 out_specs=_result_specs(axis, record_history))
+        def run(b_local, data_s, cols_s, rows_s):
+            _TRACE_COUNT[0] += 1
+            strip = partial(jax.tree.map, lambda v: v[0])
+            op_cls = DistCSRRing if ring else DistCSR
+            op = op_cls(data=strip(data_s), cols=strip(cols_s),
+                        local_rows=strip(rows_s), n_local=n_local,
+                        axis_name=axis, n_shards=n_shards)
+            m = _make_precond(precond, op, axis)
+            return cg(op, b_local, m=m, record_history=record_history,
+                      axis_name=axis, **kw)
+        return run
+
+    res = _cached_solver(key, build)(b_dev, data, cols, rows)
     if parts.n_global != parts.n_global_padded:
         res = dataclasses.replace(res, x=res.x[: parts.n_global])
     return res

@@ -356,7 +356,7 @@ class DistCSRRing(LinearOperator):
 
     @property
     def dtype(self):
-        return self.data.dtype
+        return self.data[0].dtype  # data is a per-step tuple of slabs
 
     def matvec(self, x):
         n = self.n_shards

@@ -1,0 +1,91 @@
+"""Compiled-solver reuse in ``solve_distributed`` (8 virtual devices).
+
+Round-1 weakness: each call built and jitted a fresh shard_map closure,
+so every solve - identical or not - paid full retrace + recompile.  Now
+the jitted solver is cached on (problem structure, mesh, static config)
+and array leaves are arguments, so a second identical call must trigger
+ZERO new traces (asserted via the jitted function's signature-cache
+size).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from cuda_mpi_parallel_tpu.models.operators import CSRMatrix, Stencil2D
+from cuda_mpi_parallel_tpu.parallel import dist_cg, make_mesh
+from cuda_mpi_parallel_tpu.parallel.dist_cg import solve_distributed
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    dist_cg.clear_solver_cache()
+    yield
+    dist_cg.clear_solver_cache()
+
+
+def _spd_csr(n=48, seed=41):
+    m = sp.random(n, n, density=0.12,
+                  random_state=np.random.RandomState(seed), format="csr")
+    m = m + m.T + sp.eye(n) * (np.abs(m).sum(axis=1).max() + 1.0)
+    m = m.tocsr()
+    m.sort_indices()
+    return CSRMatrix.from_scipy(m)
+
+
+def test_stencil_second_call_reuses_compilation():
+    a = Stencil2D.create(16, 16, dtype=jnp.float64)
+    b = jnp.ones(a.shape[0])
+    mesh = make_mesh(8)
+    r1 = solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=200)
+    assert len(dist_cg._SOLVER_CACHE) == 1
+    traces = dist_cg._TRACE_COUNT[0]
+    r2 = solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=200)
+    assert len(dist_cg._SOLVER_CACHE) == 1
+    assert dist_cg._TRACE_COUNT[0] == traces  # zero new traces
+    assert int(r1.iterations) == int(r2.iterations)
+
+
+def test_csr_second_call_reuses_compilation():
+    a = _spd_csr()
+    b = jnp.ones(a.shape[0])
+    mesh = make_mesh(8)
+    solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=200)
+    traces = dist_cg._TRACE_COUNT[0]
+    solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=200)
+    assert len(dist_cg._SOLVER_CACHE) == 1
+    assert dist_cg._TRACE_COUNT[0] == traces
+
+
+def test_different_config_gets_new_entry_same_scale_does_not():
+    a = Stencil2D.create(16, 16, dtype=jnp.float64)
+    b = jnp.ones(a.shape[0])
+    mesh = make_mesh(8)
+    solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=200)
+    solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=200,
+                      preconditioner="jacobi")
+    assert len(dist_cg._SOLVER_CACHE) == 2
+    # a different SCALE is an array argument, not a new compilation
+    a2 = Stencil2D.create(16, 16, dtype=jnp.float64, scale=2.0)
+    solve_distributed(a2, b, mesh=mesh, tol=1e-8, maxiter=200)
+    assert len(dist_cg._SOLVER_CACHE) == 2
+
+
+def test_scale_is_data_not_baked_in():
+    """The cached solver must honor a changed stencil scale (it is passed
+    as an argument, not closed over)."""
+    rng = np.random.default_rng(7)
+    x_true = rng.standard_normal(16 * 16)
+    mesh = make_mesh(8)
+    for s in (1.0, 3.0):
+        a = Stencil2D.create(16, 16, dtype=jnp.float64, scale=s)
+        b = a @ jnp.asarray(x_true)
+        res = solve_distributed(a, b, mesh=mesh, tol=0.0, rtol=1e-10,
+                                maxiter=500)
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x), x_true, atol=1e-7)
+    assert len(dist_cg._SOLVER_CACHE) == 1

@@ -107,11 +107,30 @@ class TestPencilSolve:
                                 maxiter=500, method="pipecg")
         assert bool(res.converged)
 
-    def test_mg_rejected_on_pencil(self):
+    def test_mg_on_pencil_iteration_parity(self):
+        """The V-cycle's transfers halo-exchange over BOTH mesh axes and
+        its gather level all_gathers over both; the combined hierarchy is
+        exactly the single-device hierarchy, so iteration counts match."""
         a = Stencil3D.create(*GRID, dtype=jnp.float64)
-        b = jnp.ones(a.shape[0])
-        with pytest.raises(ValueError, match="1-D meshes"):
-            solve_distributed(a, b, mesh=_mesh42(), preconditioner="mg")
+        rng = np.random.default_rng(34)
+        x_true = rng.standard_normal(a.shape[0])
+        b = a @ jnp.asarray(x_true)
+        from cuda_mpi_parallel_tpu.models.multigrid import (
+            MultigridPreconditioner,
+        )
+
+        single = solve(a, b, tol=0.0, rtol=1e-9, maxiter=200,
+                       m=MultigridPreconditioner.from_operator(a))
+        pencil = solve_distributed(a, b, mesh=_mesh42(), tol=0.0,
+                                   rtol=1e-9, maxiter=200,
+                                   preconditioner="mg")
+        slab = solve_distributed(a, b, mesh=make_mesh(8), tol=0.0,
+                                 rtol=1e-9, maxiter=200,
+                                 preconditioner="mg")
+        assert bool(pencil.converged)
+        assert int(pencil.iterations) == int(single.iterations)
+        assert int(slab.iterations) == int(single.iterations)
+        np.testing.assert_allclose(np.asarray(pencil.x), x_true, atol=1e-7)
 
     def test_unknown_preconditioner_rejected_on_pencil(self):
         a = Stencil3D.create(*GRID, dtype=jnp.float64)
