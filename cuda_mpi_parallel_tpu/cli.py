@@ -69,10 +69,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device", default=None,
                    choices=[None, "tpu", "cpu"],
                    help="force a JAX platform (default: auto)")
-    p.add_argument("--dtype", default="float64",
-                   choices=["float32", "float64", "bfloat16"],
-                   help="solve dtype (float64 needs x64 mode; TPUs prefer "
-                        "float32)")
+    p.add_argument("--dtype", default="auto",
+                   choices=["auto", "float32", "float64", "bfloat16"],
+                   help="solve dtype; auto resolves per platform: float32 "
+                        "on TPU (the MXU/VPU-native width - float64 runs "
+                        "in slow software emulation), float64 on CPU hosts "
+                        "(matching the all-f64 reference, CUDACG.cu:216)")
     p.add_argument("--matrix-free", action="store_true",
                    help="use the matrix-free stencil operator for poisson* "
                         "(default: assembled CSR)")
@@ -119,6 +121,9 @@ def _configure_backend(args) -> None:
         jax.config.update("jax_platforms", "cpu")
     elif args.device == "tpu":
         pass  # default platform on TPU hosts
+    if args.dtype == "auto":
+        platform = jax.devices()[0].platform
+        args.dtype = "float32" if platform == "tpu" else "float64"
     if args.dtype == "float64":
         jax.config.update("jax_enable_x64", True)
 
@@ -174,6 +179,19 @@ def main(argv=None) -> int:
             f"--precond-degree must be >= 1, got {args.precond_degree}")
     if args.block_size < 1:
         raise SystemExit(f"--block-size must be >= 1, got {args.block_size}")
+    if args.backend != "auto" and not args.matrix_free:
+        raise SystemExit(
+            f"--backend {args.backend} applies to --matrix-free stencil "
+            f"problems only (assembled formats pick their own matvec)")
+    # The solver converges on max(tol, rtol*||r0||); bf16 is unreachable
+    # only when NEITHER term is loose enough.
+    if args.dtype == "bfloat16" and not (args.tol >= 1e-3
+                                         or args.rtol >= 1e-2):
+        raise SystemExit(
+            f"--dtype bfloat16 carries ~3 significant digits; a tolerance "
+            f"of tol={args.tol:g}/rtol={args.rtol:g} is unreachable and "
+            f"would always hit MAXITER. Use --tol >= 1e-3 (or --rtol >= "
+            f"1e-2), or --dtype float32.")
     _configure_backend(args)
 
     import jax

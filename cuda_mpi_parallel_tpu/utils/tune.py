@@ -22,12 +22,15 @@ from .timing import time_fn
 class TuneResult:
     """Outcome of an autotune sweep."""
 
-    best: Dict            # kwargs for solve()/solve_distributed()
+    best: Dict            # pure kwargs for solve()/solve_distributed()
     us_per_iter: float    # measured marginal cost of the best config
-    table: Dict[str, float]  # config label -> us/iter (nan = failed)
+    table: Dict[str, float]  # config label -> us/iter (nan = failed/noisy)
+    operator: Optional[object] = None  # winning operator variant, if any
 
     def __str__(self) -> str:
-        lines = [f"autotune: best = {self.best} "
+        op = f" operator={type(self.operator).__name__}" if (
+            self.operator is not None) else ""
+        lines = [f"autotune: best = {self.best}{op} "
                  f"({self.us_per_iter:.1f} us/iter)"]
         for label, us in sorted(self.table.items(), key=lambda kv: kv[1]):
             lines.append(f"  {label:40s} {us:10.1f} us/iter")
@@ -86,7 +89,7 @@ def autotune(
     from ..solver.cg import solve
 
     table: Dict[str, float] = {}
-    results: List[Tuple[float, Dict]] = []
+    results: List[Tuple[float, Dict, Optional[object]]] = []
     for op_label, op in _candidate_ops(a):
         for method in methods:
             for ce in check_everys:
@@ -101,21 +104,27 @@ def autotune(
                         lambda: solve(op, b, tol=0.0, maxiter=iters_hi,
                                       m=m, **kwargs),
                         warmup=1, repeats=repeats, reduce="median")
-                    us = max(t_hi - t_lo, 0.0) / (iters_hi - iters_lo) * 1e6
+                    us = (t_hi - t_lo) / (iters_hi - iters_lo) * 1e6
                 except Exception:
                     table[label] = float("nan")
                     continue
+                if us <= 0.0:
+                    # Timer noise swamped the iteration delta; a zero (or
+                    # negative) marginal cost would wrongly win the sweep.
+                    # Discard the sample instead of clamping it.
+                    table[label] = float("nan")
+                    continue
                 table[label] = us
-                best_kwargs = dict(kwargs)
-                if op_label:
-                    best_kwargs["_operator"] = op
-                results.append((us, best_kwargs))
+                win_op = op if op_label else None
+                results.append((us, dict(kwargs), win_op))
 
     if not results:
-        raise RuntimeError("autotune: every candidate configuration failed")
+        raise RuntimeError("autotune: every candidate configuration failed "
+                           "or measured a non-positive iteration delta")
     results.sort(key=lambda kv: kv[0])
-    us, best = results[0]
-    return TuneResult(best=best, us_per_iter=us, table=table)
+    us, best, win_op = results[0]
+    return TuneResult(best=best, us_per_iter=us, table=table,
+                      operator=win_op)
 
 
 def solve_tuned(a, b, *, m=None, tune_kwargs=None, **solve_kwargs):
@@ -127,6 +136,5 @@ def solve_tuned(a, b, *, m=None, tune_kwargs=None, **solve_kwargs):
     from ..solver.cg import solve
 
     cfg = autotune(a, b, m=m, **(tune_kwargs or {}))
-    best = dict(cfg.best)
-    op = best.pop("_operator", a)
-    return solve(op, b, m=m, **best, **solve_kwargs), cfg
+    op = cfg.operator if cfg.operator is not None else a
+    return solve(op, b, m=m, **cfg.best, **solve_kwargs), cfg

@@ -60,6 +60,33 @@ class TestCLI:
         with pytest.raises(SystemExit):
             cli.main(["--problem", "mm", "--device", "cpu"])
 
+    def test_dtype_auto_resolves_per_platform(self, capsys):
+        """auto -> float64 on CPU hosts (this test process); the record
+        reports the resolved dtype, not the sentinel."""
+        rc = cli.main(["--problem", "oracle", "--device", "cpu", "--json"])
+        rec = json.loads(capsys.readouterr().out)
+        assert rc == 0 and rec["dtype"] == "float64"
+
+    def test_backend_without_matrix_free_rejected(self):
+        with pytest.raises(SystemExit, match="matrix-free"):
+            cli.main(["--problem", "poisson2d", "--n", "8", "--device",
+                      "cpu", "--backend", "pallas"])
+
+    def test_bfloat16_unreachable_tol_rejected(self):
+        with pytest.raises(SystemExit, match="bfloat16"):
+            cli.main(["--problem", "poisson2d", "--n", "8", "--device",
+                      "cpu", "--dtype", "bfloat16", "--tol", "1e-7"])
+
+    def test_bfloat16_loose_rtol_accepted(self, capsys):
+        """A loose rtol alone makes the threshold reachable (convergence
+        is max(tol, rtol*||r0||)); the guard must not trip."""
+        rc = cli.main(["--problem", "poisson2d", "--n", "8", "--device",
+                      "cpu", "--dtype", "bfloat16", "--rtol", "1e-1",
+                       "--json"])
+        rec = json.loads(capsys.readouterr().out)
+        assert rec["dtype"] == "bfloat16"
+        assert rc in (0, 1)  # reachable: guard passed; convergence may vary
+
 
 class TestMMIO:
     def test_roundtrip(self, tmp_path):

@@ -53,6 +53,48 @@ class TestAutotune:
         finite = [v for v in cfg.table.values() if np.isfinite(v)]
         assert cfg.us_per_iter == pytest.approx(min(finite))
 
+    def test_best_is_pure_kwargs(self, rng):
+        """best must splat into solve() directly; operator variants ride
+        the separate .operator field, never a private key."""
+        from cuda_mpi_parallel_tpu.utils.tune import autotune
+
+        op = poisson.poisson_2d_operator(16, 16, dtype=jnp.float64)
+        b = jnp.asarray(rng.standard_normal(256))
+        cfg = autotune(op, b, iters_lo=8, iters_hi=24, repeats=1)
+        assert all(not k.startswith("_") for k in cfg.best)
+        res = solve(op, b, rtol=1e-8, maxiter=500, **cfg.best)
+        assert bool(res.converged)
+
+    def test_noisy_negative_delta_discarded(self, monkeypatch, rng):
+        """A candidate whose hi-lo timing delta is non-positive (timer
+        noise) must be discarded as nan, not clamped to a winning 0."""
+        from cuda_mpi_parallel_tpu.utils import tune as tmod
+
+        times = iter([1.0, 0.5,    # candidate 1: negative delta -> discard
+                      1.0, 2.0])   # candidate 2: clean 1.0 s delta
+
+        def fake_time_fn(fn, **kwargs):
+            return next(times), None
+
+        monkeypatch.setattr(tmod, "time_fn", fake_time_fn)
+        op = poisson.poisson_2d_csr(4, 4)
+        b = jnp.asarray(rng.standard_normal(16))
+        cfg = tmod.autotune(op, b, methods=("cg",), check_everys=(1, 32),
+                            iters_lo=8, iters_hi=24, repeats=1)
+        assert np.isnan(cfg.table["method=cg check_every=1"])
+        assert cfg.best == {"method": "cg", "check_every": 32}
+        assert cfg.us_per_iter > 0
+
+    def test_all_noisy_raises(self, monkeypatch, rng):
+        from cuda_mpi_parallel_tpu.utils import tune as tmod
+
+        monkeypatch.setattr(tmod, "time_fn", lambda fn, **kw: (1.0, None))
+        op = poisson.poisson_2d_csr(4, 4)
+        b = jnp.asarray(rng.standard_normal(16))
+        with pytest.raises(RuntimeError, match="non-positive"):
+            tmod.autotune(op, b, methods=("cg",), check_everys=(1,),
+                          iters_lo=8, iters_hi=24, repeats=1)
+
     def test_solve_tuned_converges(self, rng):
         from cuda_mpi_parallel_tpu.utils.tune import solve_tuned
 
