@@ -24,6 +24,7 @@ from .models.operators import (
     IdentityOperator,
     JacobiPreconditioner,
     LinearOperator,
+    ShiftELLMatrix,
     Stencil2D,
     Stencil3D,
 )
@@ -42,6 +43,7 @@ __all__ = [
     "IdentityOperator",
     "JacobiPreconditioner",
     "LinearOperator",
+    "ShiftELLMatrix",
     "Stencil2D",
     "Stencil3D",
     "cg",

@@ -10,6 +10,7 @@ from .operators import (
     IdentityOperator,
     JacobiPreconditioner,
     LinearOperator,
+    ShiftELLMatrix,
     Stencil2D,
     Stencil3D,
 )
@@ -31,6 +32,7 @@ __all__ = [
     "JacobiPreconditioner",
     "LinearOperator",
     "MultigridPreconditioner",
+    "ShiftELLMatrix",
     "Stencil2D",
     "Stencil3D",
     "estimate_lmax",

@@ -1,0 +1,260 @@
+"""Pallas TPU SpMV for assembled sparse matrices: the shift-ELL kernel.
+
+This is the framework's answer to the reference's single native sparse
+primitive, ``cusparseSpMV`` over CSR (``CUDACG.cu:288``).  A literal CSR
+(or ELL) SpMV is gather-bound on TPU: XLA lowers ``x[cols]`` to a scalar
+gather at ~8.5 ns/element, which costs ~42 ms per matvec on a 1M-row
+5-point Poisson matrix - three orders of magnitude off the HBM roofline.
+The TPU's one fast gather primitive is ``tpu.dynamic_gather`` (exposed as
+``jnp.take_along_axis`` on a 2D array with same-shape indices): a *lane*
+gather that, for each sublane row, picks elements within that row's 128
+lanes.  Measured ~5-9 G gathered elements/s on v5e - ~20x the XLA gather.
+
+The shift-ELL layout restructures the matrix so one lane gather per
+"sheet" performs 128 x-loads per sublane row:
+
+* ``x`` is laid out 2D as ``x2[t, l] = x[128 t + l]`` (chunk-row t, lane
+  l) and kept **fully VMEM-resident** (4 MB at 1M rows f32).
+* Rows are processed in blocks of ``128 h`` (h chunk-rows).  A **sheet**
+  holds at most one nonzero per row of its block, at the row's own
+  position ``(i, j) = (r//128 - block_start, r % 128)``, and carries one
+  scalar ``ws`` (window start) such that every nonzero in the sheet has
+  its column in chunk-row ``ws + i``.  Since a slot's position is pinned
+  by its row and its source chunk must align with its sublane, a nonzero
+  ``(r, c)`` can join exactly the sheets whose
+  ``ws = c//128 - r//128 + block_start``: nonzeros bucket by *chunk
+  distance* ``d = c//128 - r//128``.
+* The kernel, per sheet: dynamic-slice ``vsrc = x2[ws : ws+h]`` (a
+  sublane shift), one lane gather
+  ``g = take_along_axis(vsrc, lane_idx, axis=1)``, then
+  ``acc += vals * g`` - accumulated straight into the output block via
+  the revisiting-output pattern.
+
+Cost is ``sheet_count * 128h / gather_rate``: optimal (sheets == max
+nnz/row) for banded matrices in natural or RCM order, and degrading with
+the number of distinct chunk distances per block - the locality lever
+RCM provides for unstructured matrices (SURVEY SS7 step 2: "block
+columns after RCM").
+
+Performance-critical structure (measured on v5e):
+
+* Grid steps must be *fat*: one grid step per (block, KC-sheet-chunk)
+  with an unrolled KC-deep loop in the kernel.  A grid step per sheet
+  pays ~1 us/step of grid overhead - 2-3x the whole matvec.
+* No ``PrefetchScalarGridSpec``: per-sheet scalars ride in an extra
+  metadata sublane row of the ``lane_idx`` block (``meta[0] = ws``,
+  ``ws < 0`` = padding sheet, skipped), read with static indices from
+  VMEM.  Scalar-prefetch operands passed as jit arguments measurably
+  stall the call.
+* Sheets are padded per block to a uniform ``KG*KC`` so the grid is
+  regular; padded sheets cost DMA but no gather (skipped via
+  ``pl.when``).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+LANES = 128
+
+# x must stay VMEM-resident; reserve room for sheet blocks, accumulator
+# and double buffering.  ~10 MB of f32 x caps n at ~2.6M rows; beyond
+# that shard over a mesh (each shard's local x is what must fit).
+_MAX_X_BYTES = 10 * 2 ** 20
+
+
+class ShiftELLData(NamedTuple):
+    """Device-ready arrays + static geometry from :func:`pack_shift_ell`.
+
+    ``vals``/``lane_meta`` are regularized to ``NB * KG * KC`` sheets
+    (per-block real sheets first, then ``ws = -1`` padding).
+    ``lane_meta[:, :h]`` is the lane index plane; ``lane_meta[:, h]`` is
+    the metadata row (lane 0: window start, or -1 for padding).
+    """
+
+    vals: np.ndarray       # (NB*KG*KC, h, 128) dtype; 0 = empty slot
+    lane_meta: np.ndarray  # (NB*KG*KC, h+1, 128) int32
+    h: int                 # chunk-rows per block
+    kc: int                # sheets per grid step (kernel unroll)
+    kg: int                # grid steps per block along the sheet dim
+    n_sheets: int          # real (pre-padding) sheet count
+    n: int                 # logical matrix dimension
+    nch: int               # ceil(n / 128)
+    nch_pad: int           # nch rounded up to a multiple of h
+    pad: int               # zero chunk-rows added on each side of x
+
+
+def pack_shift_ell(indptr: np.ndarray, indices: np.ndarray,
+                   data: np.ndarray, n: int, *, h: int = 16,
+                   kc: int = 8) -> ShiftELLData:
+    """Host-side packer: CSR -> shift-ELL sheets (vectorized numpy).
+
+    Slots bucket by ``(block, ws)``; a row contributing ``m`` nonzeros
+    with the same chunk distance needs ``m`` sheet copies, so each
+    block's sheet list is ``{(ws, copy) : copy < max multiplicity(ws)}``.
+    """
+    if h < 1 or kc < 1:
+        raise ValueError(f"h and kc must be >= 1, got h={h} kc={kc}")
+    nnz = int(indices.shape[0])
+    nch = -(-n // LANES)
+    nch_pad = -(-nch // h) * h
+    pad = h  # window reach beyond either end of x
+    nb = nch_pad // h
+    x_bytes = (nch_pad + 2 * pad) * LANES * data.dtype.itemsize
+    if x_bytes > _MAX_X_BYTES:
+        raise ValueError(
+            f"shift-ELL needs x VMEM-resident: {x_bytes/2**20:.1f} MB > "
+            f"{_MAX_X_BYTES/2**20:.0f} MB budget (n={n}, "
+            f"dtype={data.dtype}); shard the solve over a mesh or use the "
+            f"csr/ell formats")
+
+    rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
+    cols = indices.astype(np.int64)
+    i_chunk = rows // LANES
+    block = i_chunk // h
+    i_loc = i_chunk - block * h
+    # window start such that vsrc[i_loc] covers the slot's column chunk,
+    # in padded-x coordinates (pad zero chunk-rows prepended)
+    ws_req = cols // LANES + pad - i_loc
+
+    # copy index: occurrence rank of this slot within its (row, ws) group
+    order = np.lexsort((cols, ws_req, rows))
+    key_r, key_w = rows[order], ws_req[order]
+    new_grp = np.empty(nnz, dtype=bool)
+    new_grp[:1] = True
+    new_grp[1:] = (key_r[1:] != key_r[:-1]) | (key_w[1:] != key_w[:-1])
+    grp_start = np.maximum.accumulate(np.where(new_grp, np.arange(nnz), 0))
+    copy = np.empty(nnz, dtype=np.int64)
+    copy[order] = np.arange(nnz) - grp_start
+
+    # sheet identity: unique (block, ws, copy), lexicographically sorted
+    max_ws = int(ws_req.max()) + 1 if nnz else 1
+    max_copy = int(copy.max()) + 1 if nnz else 1
+    sheet_key = (block * max_ws + ws_req) * max_copy + copy
+    uniq_keys, g_of_slot = np.unique(sheet_key, return_inverse=True)
+    g_block = (uniq_keys // max_copy // max_ws).astype(np.int64)
+    g_ws = (uniq_keys // max_copy % max_ws).astype(np.int64)
+    n_sheets = int(uniq_keys.size)
+
+    # regularize: kg grid steps of kc sheets per block; kg set by the
+    # fullest block.  Padding sheets carry ws = -1 (kernel skips them);
+    # blocks with no nonzeros (padded tails) get only padding sheets, so
+    # ensure kg >= 1 and make each block's first sheet initialize the
+    # output: a padding FIRST sheet must still zero the block, handled in
+    # the kernel by treating (kc_step == 0, k == 0) as init regardless.
+    per_block = np.bincount(g_block, minlength=nb)
+    kg = max(1, -(-int(per_block.max()) // kc))
+    slots_per_block = kg * kc
+    g_new = slots_per_block * g_block + (
+        np.arange(n_sheets) - np.concatenate(
+            [[0], np.cumsum(per_block)[:-1]])[g_block])
+    total = nb * slots_per_block
+
+    vals = np.zeros((total, h, LANES), dtype=data.dtype)
+    lane_meta = np.zeros((total, h + 1, LANES), dtype=np.int32)
+    lane_meta[:, h, 0] = -1
+    lane_meta[g_new, h, 0] = g_ws.astype(np.int32)
+    gs = g_new[g_of_slot]
+    j_pos = rows % LANES
+    vals[gs, i_loc, j_pos] = data
+    lane_meta[gs, i_loc, j_pos] = (cols % LANES).astype(np.int32)
+
+    return ShiftELLData(
+        vals=vals, lane_meta=lane_meta, h=h, kc=kc, kg=kg,
+        n_sheets=n_sheets, n=n, nch=nch, nch_pad=nch_pad, pad=pad)
+
+
+def _make_kernel(h: int, kc: int):
+    def kernel(x_ref, v_ref, l_ref, o_ref):
+        kc_step = pl.program_id(1)
+        for k in range(kc):
+            ws = l_ref[k, h, 0]
+            is_first = jnp.logical_and(kc_step == 0, k == 0)
+
+            @pl.when(jnp.logical_and(ws >= 0, jnp.logical_not(is_first)))
+            def _():
+                vsrc = x_ref[pl.ds(ws, h), :]
+                g = jnp.take_along_axis(vsrc, l_ref[k, :h, :], axis=1)
+                o_ref[:] = o_ref[:] + v_ref[k] * g
+
+            @pl.when(is_first)
+            def _():
+                # first sheet of the block: initialize the output (real
+                # first sheets always exist except for all-padding blocks,
+                # whose vals are zero - the multiply still yields zeros)
+                vsrc = x_ref[pl.ds(jnp.maximum(ws, 0), h), :]
+                g = jnp.take_along_axis(vsrc, l_ref[k, :h, :], axis=1)
+                o_ref[:] = v_ref[k] * g
+
+    return kernel
+
+
+def shift_ell_matvec(
+    x: jax.Array,
+    vals: jax.Array,
+    lane_meta: jax.Array,
+    *,
+    h: int,
+    kc: int,
+    kg: int,
+    n: int,
+    nch: int,
+    nch_pad: int,
+    pad: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """y = A @ x with A in shift-ELL form (see module docstring)."""
+    x_bytes = (nch_pad + 2 * pad) * LANES * x.dtype.itemsize
+    if x_bytes > _MAX_X_BYTES:
+        raise ValueError(
+            f"shift-ELL needs x VMEM-resident: {x_bytes/2**20:.1f} MB > "
+            f"{_MAX_X_BYTES/2**20:.0f} MB budget (n={n}); shard the solve "
+            f"over a mesh or use the csr/ell formats")
+    nb = nch_pad // h
+    total_rows = nch_pad + 2 * pad
+    xp = jnp.zeros((total_rows * LANES,), x.dtype)
+    xp = jax.lax.dynamic_update_slice(xp, x, (pad * LANES,))
+    x2 = xp.reshape(total_rows, LANES)
+
+    y2 = pl.pallas_call(
+        _make_kernel(h, kc),
+        grid=(nb, kg),
+        in_specs=[
+            pl.BlockSpec((total_rows, LANES), lambda i, c: (0, 0)),
+            pl.BlockSpec((kc, h, LANES), lambda i, c: (i * kg + c, 0, 0)),
+            pl.BlockSpec((kc, h + 1, LANES),
+                         lambda i, c: (i * kg + c, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((h, LANES), lambda i, c: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nch_pad, LANES), x.dtype),
+        interpret=interpret,
+    )(x2, vals, lane_meta)
+    return y2.reshape(-1)[:n]
+
+
+def sheet_count(indptr: np.ndarray, indices: np.ndarray, n: int,
+                *, h: int = 16) -> Tuple[int, float]:
+    """(total real sheets, average per block) a packing would produce -
+    the shift-ELL cost model, for format selection without building the
+    arrays.  Sheets per block = sum over window starts of the maximum
+    per-row multiplicity, mirroring :func:`pack_shift_ell`.
+    """
+    nch = -(-n // LANES)
+    nch_pad = -(-nch // h) * h
+    nb = nch_pad // h
+    rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
+    i_chunk = rows // LANES
+    block = i_chunk // h
+    ws = indices.astype(np.int64) // LANES - i_chunk + block * h + nch
+    span = 2 * nch + 2 * h + 1
+    key_rw, counts = np.unique(rows * span + ws, return_counts=True)
+    key_bw = (key_rw // span) // (LANES * h) * span + key_rw % span
+    uniq_bw, inv = np.unique(key_bw, return_inverse=True)
+    max_mult = np.zeros(uniq_bw.size, dtype=np.int64)
+    np.maximum.at(max_mult, inv, counts)
+    total = max(int(max_mult.sum()), nb)
+    return total, total / nb

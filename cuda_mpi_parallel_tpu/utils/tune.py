@@ -13,7 +13,7 @@ and returns the fastest configuration as ready-to-splat solver kwargs.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from .timing import time_fn
 
@@ -38,10 +38,13 @@ class TuneResult:
 
 
 def _candidate_ops(a):
-    """(label, operator) variants: stencils try both matvec backends."""
-    from ..models.operators import Stencil2D, Stencil3D
+    """Yield (label, operator) variants lazily: stencils try both matvec
+    backends; CSR matrices try the alternative assembled formats (ELL
+    rectangular gather, DIA shifted FMAs, shift-ELL pallas lane gather).
+    Lazy so at most one converted copy is alive during the sweep."""
+    from ..models.operators import CSRMatrix, Stencil2D, Stencil3D
 
-    ops = [("", a)]
+    yield "", a
     if isinstance(a, (Stencil2D, Stencil3D)):
         for backend in ("xla", "pallas"):
             if backend == a.backend:
@@ -56,10 +59,16 @@ def _candidate_ops(a):
                       else pk.supports_3d(*grid))
                 if backend == "pallas" and not ok:
                     continue
-                ops.append((f"backend={backend} ", alt))
+                yield f"backend={backend} ", alt
             except (ValueError, ImportError):
                 continue
-    return ops
+    if isinstance(a, CSRMatrix):
+        for fmt, conv in (("ell", a.to_ell), ("dia", a.to_dia),
+                          ("shiftell", a.to_shiftell)):
+            try:
+                yield f"format={fmt} ", conv()
+            except ValueError:
+                continue  # e.g. too many diagonals for DIA, VMEM budget
 
 
 def autotune(
@@ -89,7 +98,7 @@ def autotune(
     from ..solver.cg import solve
 
     table: Dict[str, float] = {}
-    results: List[Tuple[float, Dict, Optional[object]]] = []
+    best: Optional[Tuple[float, Dict, Optional[object]]] = None
     for op_label, op in _candidate_ops(a):
         for method in methods:
             for ce in check_everys:
@@ -115,15 +124,16 @@ def autotune(
                     table[label] = float("nan")
                     continue
                 table[label] = us
-                win_op = op if op_label else None
-                results.append((us, dict(kwargs), win_op))
+                if best is None or us < best[0]:
+                    # keep only the incumbent so losing operator variants
+                    # are freed as the sweep moves on
+                    best = (us, dict(kwargs), op if op_label else None)
 
-    if not results:
+    if best is None:
         raise RuntimeError("autotune: every candidate configuration failed "
                            "or measured a non-positive iteration delta")
-    results.sort(key=lambda kv: kv[0])
-    us, best, win_op = results[0]
-    return TuneResult(best=best, us_per_iter=us, table=table,
+    us, kwargs, win_op = best
+    return TuneResult(best=kwargs, us_per_iter=us, table=table,
                       operator=win_op)
 
 

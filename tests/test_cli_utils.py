@@ -77,6 +77,13 @@ class TestCLI:
             cli.main(["--problem", "poisson2d", "--n", "8", "--device",
                       "cpu", "--dtype", "bfloat16", "--tol", "1e-7"])
 
+    def test_format_shiftell(self, capsys):
+        rc = cli.main(["--problem", "poisson2d", "--n", "16", "--device",
+                       "cpu", "--format", "shiftell", "--tol", "1e-8",
+                       "--json"])
+        rec = json.loads(capsys.readouterr().out)
+        assert rc == 0 and rec["converged"] and rec["max_abs_error"] < 1e-5
+
     def test_bfloat16_loose_rtol_accepted(self, capsys):
         """A loose rtol alone makes the threshold reachable (convergence
         is max(tol, rtol*||r0||)); the guard must not trip."""

@@ -53,6 +53,18 @@ class TestAutotune:
         finite = [v for v in cfg.table.values() if np.isfinite(v)]
         assert cfg.us_per_iter == pytest.approx(min(finite))
 
+    def test_csr_format_candidates(self, rng):
+        """CSR autotune sweeps the assembled formats; the winner rides
+        TuneResult.operator."""
+        from cuda_mpi_parallel_tpu.utils.tune import autotune
+
+        a = poisson.poisson_2d_csr(24, 24)
+        b = jnp.asarray(rng.standard_normal(576))
+        cfg = autotune(a, b, methods=("cg",), check_everys=(1,),
+                       iters_lo=8, iters_hi=24, repeats=1)
+        labels = " ".join(cfg.table)
+        assert "format=ell" in labels and "format=shiftell" in labels
+
     def test_best_is_pure_kwargs(self, rng):
         """best must splat into solve() directly; operator variants ride
         the separate .operator field, never a private key."""
@@ -77,7 +89,11 @@ class TestAutotune:
             return next(times), None
 
         monkeypatch.setattr(tmod, "time_fn", fake_time_fn)
-        op = poisson.poisson_2d_csr(4, 4)
+        from cuda_mpi_parallel_tpu.models import random_spd
+
+        # dense operator: exactly one candidate op, so the fake timing
+        # sequence maps deterministically onto the two configs
+        op = random_spd.random_spd_dense(16, seed=0)
         b = jnp.asarray(rng.standard_normal(16))
         cfg = tmod.autotune(op, b, methods=("cg",), check_everys=(1, 32),
                             iters_lo=8, iters_hi=24, repeats=1)
@@ -89,7 +105,9 @@ class TestAutotune:
         from cuda_mpi_parallel_tpu.utils import tune as tmod
 
         monkeypatch.setattr(tmod, "time_fn", lambda fn, **kw: (1.0, None))
-        op = poisson.poisson_2d_csr(4, 4)
+        from cuda_mpi_parallel_tpu.models import random_spd
+
+        op = random_spd.random_spd_dense(16, seed=0)
         b = jnp.asarray(rng.standard_normal(16))
         with pytest.raises(RuntimeError, match="non-positive"):
             tmod.autotune(op, b, methods=("cg",), check_everys=(1,),
