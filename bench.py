@@ -102,17 +102,20 @@ def bench_all():
     el, res = time_fn(lambda: solve(a_csr, b2, tol=0.0, maxiter=100),
                       warmup=1, repeats=2)
     results["poisson2d_1M_csr"] = {"iters_per_sec": 100 / el, "elapsed_s": el}
-    a_dia = a_csr.to_dia()
-    lo, hi = 100, 1100
-    tl, _ = time_fn(lambda: solve(a_dia, b2, tol=0.0, maxiter=lo,
-                                  check_every=32),
-                    warmup=1, repeats=5, reduce="median")
-    th, _ = time_fn(lambda: solve(a_dia, b2, tol=0.0, maxiter=hi,
-                                  check_every=32),
-                    warmup=1, repeats=5, reduce="median")
-    results["poisson2d_1M_dia"] = {
-        "us_per_iter": (th - tl) / (hi - lo) * 1e6,
-        "iters_per_sec": (hi - lo) / max(th - tl, 1e-9)}
+    def iter_delta(op, rhs, lo, hi, repeats=5, **kw):
+        tl, _ = time_fn(lambda: solve(op, rhs, tol=0.0, maxiter=lo,
+                                      check_every=32, **kw),
+                        warmup=1, repeats=repeats, reduce="median")
+        th, _ = time_fn(lambda: solve(op, rhs, tol=0.0, maxiter=hi,
+                                      check_every=32, **kw),
+                        warmup=1, repeats=repeats, reduce="median")
+        return {"us_per_iter": (th - tl) / (hi - lo) * 1e6,
+                "iters_per_sec": (hi - lo) / max(th - tl, 1e-9)}
+
+    results["poisson2d_1M_dia"] = iter_delta(a_csr.to_dia(), b2, 100, 1100)
+    # shift-ELL: the pallas lane-gather kernel (~180x over the csr row)
+    results["poisson2d_1M_shiftell"] = iter_delta(
+        a_csr.to_shiftell(h=32), b2, 100, 1100)
 
     # 3: preconditioned CG on 2D Poisson: time-to-tolerance across the
     # preconditioner ladder (the reference has none at all)
@@ -177,7 +180,43 @@ def bench_all():
         results[f"poisson2d_16M_{backend}"] = {
             "us_per_iter": (el_hi - el_lo) / 50 * 1e6}
 
-    # 4: distributed 3D Poisson over all local devices (N scaled to fit)
+    # 4: the north star - 3D Poisson 256^3 f32 on a single chip
+    # (BASELINE config #4's problem; 16.8M unknowns, 67 MB/vector).
+    # Plain-CG iteration throughput plus time-to-rtol-1e-6 with the
+    # chebyshev and mg preconditioners (reference: unpreconditioned,
+    # single GPU, and never measured - SURVEY SS6).
+    from cuda_mpi_parallel_tpu.models.operators import Stencil3D
+
+    a256 = Stencil3D.create(256, 256, 256, dtype=jnp.float32)
+    b256 = jnp.asarray(
+        rng.standard_normal(a256.shape[0]).astype(np.float32))
+    results["poisson3d_256_stencil"] = iter_delta(a256, b256, 32, 160,
+                                                  repeats=3)
+    for name, m256 in [
+        ("chebyshev4",
+         ChebyshevPreconditioner.from_operator(a256, degree=4)),
+        ("mg", MultigridPreconditioner.from_operator(a256)),
+    ]:
+        @_partial(jax.jit, static_argnames=("reps",))
+        def many256(b, mm, reps):
+            def body(i, acc):
+                scale = 1.0 + i.astype(b.dtype) * jnp.asarray(1e-6, b.dtype)
+                r = _cg(a256, b * scale, tol=0.0, rtol=1e-6, maxiter=2000,
+                        m=mm)
+                return acc + r.x[0]
+            return lax.fori_loop(0, reps, body, jnp.zeros((), b.dtype))
+
+        t1, _ = time_fn(lambda m256=m256: many256(b256, m256, 1),
+                        warmup=1, repeats=3, reduce="median")
+        t5, _ = time_fn(lambda m256=m256: many256(b256, m256, 5),
+                        warmup=1, repeats=3, reduce="median")
+        res = solve(a256, b256, tol=0.0, rtol=1e-6, maxiter=2000, m=m256)
+        results[f"poisson3d_256_{name}_rtol1e-6"] = {
+            "time_to_tol_s": max(t5 - t1, 0.0) / 4,
+            "iterations": int(res.iterations),
+            "converged": bool(res.converged)}
+
+    # 4b: distributed 3D Poisson over all local devices (N scaled to fit)
     ndev = len(jax.devices())
     grid = (64 * ndev if 64 * ndev <= 256 else 256, 128, 128)
     if grid[0] % ndev == 0:
@@ -208,32 +247,59 @@ def bench_all():
         results[f"poisson3d_pencil_{sx}x{sy}"] = {
             "iters_per_sec": 100 / el, "elapsed_s": el}
 
-    # 5: SuiteSparse SPD set (BASELINE config #5) - gated on local files
-    # (zero-egress image: drop thermal2.mtx / G3_circuit.mtx /
-    # parabolic_fem.mtx into ./matrices to enable)
+    # 5: unstructured SPD set (BASELINE config #5).  Real SuiteSparse
+    # .mtx files in ./matrices take precedence (zero-egress image: drop
+    # thermal2.mtx / G3_circuit.mtx / parabolic_fem.mtx there); without
+    # them the random-Delaunay FEM stand-in (models.fem) is measured by
+    # default through the production pipeline: RCM reorder -> shift-ELL.
     import glob
     import os
 
     from cuda_mpi_parallel_tpu.models import mmio
 
-    for path in sorted(glob.glob("matrices/*.mtx")):
+    def bench_unstructured(key, a_mm):
+        perm = a_mm.rcm_permutation()
+        a_rcm = a_mm.permuted(perm)
+        b_mm = jnp.asarray(
+            rng.standard_normal(a_mm.shape[0]).astype(np.float32))
+        try:
+            a_fast = a_rcm.to_shiftell(h=32)
+            fmt = "shiftell"
+        except ValueError:  # beyond the VMEM budget: keep the gather path
+            a_fast, fmt = a_rcm, "csr"
+        entry = {"n": int(a_mm.shape[0]), "nnz": int(a_mm.nnz),
+                 "format": fmt, "rcm_bandwidth": int(a_rcm.bandwidth())}
+        entry.update(iter_delta(a_fast, b_mm, 16, 80, repeats=3))
+        m_mm = JacobiPreconditioner.from_operator(a_fast)
+        el, res = time_fn(
+            lambda: solve(a_fast, b_mm, tol=0.0, rtol=1e-6, maxiter=10000,
+                          m=m_mm),
+            warmup=1, repeats=2)
+        entry.update({"time_to_tol_s": el,
+                      "iterations": int(res.iterations),
+                      "converged": bool(res.converged)})
+        results[key] = entry
+
+    mtx_files = sorted(glob.glob("matrices/*.mtx"))
+    for path in mtx_files:
         key = f"mm_{os.path.basename(path)}"
         try:
             a_mm = mmio.load_matrix_market(path, dtype=np.float32)
         except Exception as e:  # unreadable file: record and continue
             results[key] = {"error": str(e)}
             continue
-        b_mm = jnp.asarray(
-            rng.standard_normal(a_mm.shape[0]).astype(np.float32))
-        m_mm = JacobiPreconditioner.from_operator(a_mm)
-        el, res = time_fn(
-            lambda a_mm=a_mm, b_mm=b_mm, m_mm=m_mm: solve(
-                a_mm, b_mm, tol=0.0, rtol=1e-6, maxiter=10000, m=m_mm),
-            warmup=1, repeats=2)
-        results[key] = {
-            "n": int(a_mm.shape[0]), "nnz": int(a_mm.nnz),
-            "time_to_tol_s": el, "iterations": int(res.iterations),
-            "converged": bool(res.converged)}
+        bench_unstructured(key, a_mm)
+    if not mtx_files:
+        from cuda_mpi_parallel_tpu.models.fem import random_fem_2d
+
+        a_fem = random_fem_2d(1_000_000, seed=1, dtype=np.float32)
+        bench_unstructured("fem2d_1M_standin", a_fem)
+        # the gather path the shift-ELL kernel replaces, for the ratio
+        a_ell = a_fem.permuted(a_fem.rcm_permutation()).to_ell()
+        b_f = jnp.asarray(
+            rng.standard_normal(a_fem.shape[0]).astype(np.float32))
+        results["fem2d_1M_standin_ell"] = iter_delta(a_ell, b_f, 4, 12,
+                                                     repeats=2)
 
     return results
 
