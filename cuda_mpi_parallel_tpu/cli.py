@@ -62,10 +62,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="number of devices for row-partitioned execution "
                         "(1 = single device)")
     p.add_argument("--csr-comm", default="allgather",
-                   choices=["allgather", "ring"],
+                   choices=["allgather", "ring", "ring-shiftell"],
                    help="distributed general-CSR schedule: all-gather x "
-                        "every matvec, or rotate x-blocks around the mesh "
-                        "via ppermute (O(n/P) memory, overlapped compute)")
+                        "every matvec; ring (rotate x-blocks around the "
+                        "mesh via ppermute: O(n/P) memory, overlapped "
+                        "compute); or ring-shiftell (same ring with the "
+                        "pallas shift-ELL slab kernel for each local "
+                        "multiply)")
     p.add_argument("--device", default=None,
                    choices=[None, "tpu", "cpu"],
                    help="force a JAX platform (default: auto)")

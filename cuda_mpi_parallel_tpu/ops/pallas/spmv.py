@@ -90,12 +90,17 @@ class ShiftELLData(NamedTuple):
 
 def pack_shift_ell(indptr: np.ndarray, indices: np.ndarray,
                    data: np.ndarray, n: int, *, h: int = 16,
-                   kc: int = 8) -> ShiftELLData:
+                   kc: int = 8, kg: int | None = None) -> ShiftELLData:
     """Host-side packer: CSR -> shift-ELL sheets (vectorized numpy).
 
     Slots bucket by ``(block, ws)``; a row contributing ``m`` nonzeros
     with the same chunk distance needs ``m`` sheet copies, so each
     block's sheet list is ``{(ws, copy) : copy < max multiplicity(ws)}``.
+
+    ``kg`` forces the grid-steps-per-block (must be >= the computed
+    minimum) so independently packed matrices can share one kernel shape
+    - the distributed ring schedule stacks one slab per (shard, step)
+    and shard_map needs uniform shapes across shards.
     """
     if h < 1 or kc < 1:
         raise ValueError(f"h and kc must be >= 1, got h={h} kc={kc}")
@@ -147,7 +152,11 @@ def pack_shift_ell(indptr: np.ndarray, indices: np.ndarray,
     # output: a padding FIRST sheet must still zero the block, handled in
     # the kernel by treating (kc_step == 0, k == 0) as init regardless.
     per_block = np.bincount(g_block, minlength=nb)
-    kg = max(1, -(-int(per_block.max()) // kc))
+    kg_min = max(1, -(-int(per_block.max()) // kc))
+    if kg is None:
+        kg = kg_min
+    elif kg < kg_min:
+        raise ValueError(f"kg={kg} < required minimum {kg_min}")
     slots_per_block = kg * kc
     g_new = slots_per_block * g_block + (
         np.arange(n_sheets) - np.concatenate(
@@ -207,7 +216,13 @@ def shift_ell_matvec(
     pad: int,
     interpret: bool = False,
 ) -> jax.Array:
-    """y = A @ x with A in shift-ELL form (see module docstring)."""
+    """y = A @ x with A in shift-ELL form (see module docstring).
+
+    Inside a ``jax.shard_map`` body (the distributed ring schedule) the
+    enclosing shard_map must pass ``check_vma=False``: pallas outputs
+    cannot express their varying mesh axes through the interpret-mode
+    ref discharge (dynamic_slice vma propagation rejects the mix).
+    """
     x_bytes = (nch_pad + 2 * pad) * LANES * x.dtype.itemsize
     if x_bytes > _MAX_X_BYTES:
         raise ValueError(

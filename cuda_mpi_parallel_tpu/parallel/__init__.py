@@ -16,6 +16,7 @@ from .mesh import (
 from .operators import (
     DistCSR,
     DistCSRRing,
+    DistShiftELLRing,
     DistStencil2D,
     DistStencil3D,
     DistStencil3DPencil,
@@ -32,6 +33,7 @@ __all__ = [
     "ROWS_AXIS",
     "DistCSR",
     "DistCSRRing",
+    "DistShiftELLRing",
     "DistStencil2D",
     "DistStencil3D",
     "DistStencil3DPencil",

@@ -43,6 +43,7 @@ from .mesh import make_mesh, shard_vector
 from .operators import (
     DistCSR,
     DistCSRRing,
+    DistShiftELLRing,
     DistStencil2D,
     DistStencil3D,
     DistStencil3DPencil,
@@ -117,7 +118,7 @@ def solve_distributed(
     if a.shape[1] != b.shape[0]:
         raise ValueError(f"operator shape {a.shape} does not match rhs "
                          f"shape {b.shape}")
-    if csr_comm not in ("allgather", "ring"):
+    if csr_comm not in ("allgather", "ring", "ring-shiftell"):
         raise ValueError(f"unknown csr_comm: {csr_comm!r}")
     kw = dict(tol=tol, rtol=rtol, maxiter=maxiter, method=method,
               check_every=check_every, compensated=compensated)
@@ -267,22 +268,35 @@ def _solve_stencil(a, b, mesh, axis, n_shards, precond, record_history,
     return _cached_solver(key, build)(b, local.scale)
 
 
+def _shard_tree(tree, mesh, axis):
+    """Row-shard every array leaf (leading axis = shard index)."""
+    return jax.tree.map(
+        lambda v: shard_vector(jnp.asarray(v), mesh, axis), tree)
+
+
+def _shard_padded_rhs(b, parts, mesh, axis):
+    b_pad = part.pad_vector(np.asarray(b), parts.n_global_padded)
+    return shard_vector(jnp.asarray(b_pad), mesh, axis)
+
+
+def _strip_row_padding(res: CGResult, parts) -> CGResult:
+    if parts.n_global != parts.n_global_padded:
+        res = dataclasses.replace(res, x=res.x[: parts.n_global])
+    return res
+
+
 def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
                kw, csr_comm: str = "allgather") -> CGResult:
+    if csr_comm == "ring-shiftell":
+        return _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
+                                   record_history, kw)
     ring = csr_comm == "ring"
     parts = (part.ring_partition_csr(a, n_shards) if ring
              else part.partition_csr(a, n_shards))
-    b_np = np.asarray(b)
-    b_pad = part.pad_vector(b_np, parts.n_global_padded)
-
-    def _shard(x):
-        return jax.tree.map(
-            lambda v: shard_vector(jnp.asarray(v), mesh, axis), x)
-
-    b_dev = shard_vector(jnp.asarray(b_pad), mesh, axis)
-    data = _shard(parts.data)      # array, or per-step tuple (ring)
-    cols = _shard(parts.cols)
-    rows = _shard(parts.local_rows)
+    b_dev = _shard_padded_rhs(b, parts, mesh, axis)
+    data = _shard_tree(parts.data, mesh, axis)  # array, or per-step tuple
+    cols = _shard_tree(parts.cols, mesh, axis)
+    rows = _shard_tree(parts.local_rows, mesh, axis)
 
     n_local = parts.n_local
     key = ("csr", ring, n_local, n_shards, axis, mesh, precond,
@@ -305,6 +319,39 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
         return run
 
     res = _cached_solver(key, build)(b_dev, data, cols, rows)
-    if parts.n_global != parts.n_global_padded:
-        res = dataclasses.replace(res, x=res.x[: parts.n_global])
-    return res
+    return _strip_row_padding(res, parts)
+
+
+def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
+                        record_history, kw) -> CGResult:
+    """Ring schedule with pallas shift-ELL slabs (``DistShiftELLRing``)."""
+    parts = part.ring_partition_shiftell(a, n_shards)
+    b_dev = _shard_padded_rhs(b, parts, mesh, axis)
+    vals = _shard_tree(parts.vals, mesh, axis)  # per-step (n_shards, G, ..)
+    meta = _shard_tree(parts.lane_meta, mesh, axis)
+    diag = shard_vector(jnp.asarray(parts.diag.reshape(-1)), mesh, axis)
+
+    n_local = parts.n_local
+    key = ("csr-shiftell", n_local, n_shards, parts.h, parts.kc, parts.kg,
+           axis, mesh, precond, record_history, tuple(sorted(kw.items())))
+
+    def build():
+        # check_vma=False: the pallas slab kernel cannot declare varying
+        # mesh axes on its outputs (see shift_ell_matvec docstring)
+        @partial(jax.shard_map, mesh=mesh, check_vma=False,
+                 in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                 out_specs=_result_specs(axis, record_history))
+        def run(b_local, vals_s, meta_s, diag_s):
+            _TRACE_COUNT[0] += 1
+            strip = partial(jax.tree.map, lambda v: v[0])
+            op = DistShiftELLRing(
+                vals=strip(vals_s), lane_meta=strip(meta_s), diag=diag_s,
+                h=parts.h, kc=parts.kc, kg=parts.kg, n_local=n_local,
+                axis_name=axis, n_shards=n_shards)
+            m = _make_precond(precond, op, axis)
+            return cg(op, b_local, m=m, record_history=record_history,
+                      axis_name=axis, **kw)
+        return run
+
+    res = _cached_solver(key, build)(b_dev, vals, meta, diag)
+    return _strip_row_padding(res, parts)

@@ -379,3 +379,63 @@ class DistCSRRing(LinearOperator):
         return jax.ops.segment_sum(
             jnp.where(on_diag, self.data[0], jnp.zeros_like(self.data[0])),
             self.local_rows[0], num_segments=self.n_local)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("vals", "lane_meta", "diag"),
+    meta_fields=("h", "kc", "kg", "n_local", "axis_name", "n_shards"),
+)
+@dataclasses.dataclass(frozen=True)
+class DistShiftELLRing(LinearOperator):
+    """Ring-scheduled distributed SpMV with pallas shift-ELL slabs.
+
+    Same ``lax.ppermute`` x-block rotation as ``DistCSRRing``, but each
+    step's local slab multiply is the ``ops.pallas.spmv`` lane-gather
+    kernel instead of the XLA scalar gather (~20x per gathered element,
+    see that module's docstring).  This also lifts the single-device
+    shift-ELL size cap: only the shard-local x block (n/P rows) must be
+    VMEM-resident, so systems far beyond ~2.6M rows shard across the
+    mesh.  Built by ``partition.ring_partition_shiftell``.
+    """
+
+    vals: Tuple[jax.Array, ...]       # per step: (G_t, h, 128)
+    lane_meta: Tuple[jax.Array, ...]  # per step: (G_t, h+1, 128) int32
+    diag: jax.Array                   # (n_local,)
+    h: int
+    kc: int
+    kg: Tuple[int, ...]               # per step
+    n_local: int
+    axis_name: str
+    n_shards: int
+
+    @property
+    def shape(self):
+        return (self.n_local, self.n_local * self.n_shards)
+
+    @property
+    def dtype(self):
+        return self.vals[0].dtype
+
+    def matvec(self, x):
+        from ..models.operators import _pallas_interpret
+        from ..ops.pallas import spmv as pk
+
+        n = self.n_shards
+        nch = -(-self.n_local // pk.LANES)
+        nch_pad = -(-nch // self.h) * self.h
+        ring = [(j, (j - 1) % n) for j in range(n)]
+        interpret = _pallas_interpret()
+        y = jnp.zeros_like(x)
+        xb = x
+        for t in range(n):  # static unroll: n is a mesh constant
+            y = y + pk.shift_ell_matvec(
+                xb, self.vals[t], self.lane_meta[t], h=self.h, kc=self.kc,
+                kg=self.kg[t], n=self.n_local, nch=nch, nch_pad=nch_pad,
+                pad=self.h, interpret=interpret)
+            if t + 1 < n:
+                xb = lax.ppermute(xb, self.axis_name, perm=ring)
+        return y
+
+    def diagonal(self):
+        return self.diag

@@ -158,3 +158,77 @@ def ring_partition_csr(a: CSRMatrix, n_shards: int) -> RingPartitionedCSR:
         n_local=n_local, n_global_padded=rows_part.n_global_padded,
         n_global=rows_part.n_global, n_shards=n_shards,
     )
+
+class RingPartitionedShiftELL(NamedTuple):
+    """Ring-schedule slabs packed into the pallas shift-ELL format.
+
+    Same communication structure as ``RingPartitionedCSR`` (one slab per
+    (owner, step), owner ``i``'s step-``t`` slab couples to column block
+    ``(i + t) % n_shards``), but each slab's local SpMV is the
+    ``ops.pallas.spmv`` lane-gather kernel instead of the XLA gather:
+    ``vals[t]``/``lane_meta[t]`` have shape ``(n_shards, G_t, h(+1), 128)``
+    with per-step-uniform sheet counts across owners (shard_map needs
+    identical shapes per device; ``pack_shift_ell(kg=...)`` forces the
+    shared grid geometry).
+    """
+
+    vals: Tuple[np.ndarray, ...]
+    lane_meta: Tuple[np.ndarray, ...]
+    diag: np.ndarray            # (n_shards, n_local) - Jacobi's input
+    h: int
+    kc: int
+    kg: Tuple[int, ...]         # per step
+    n_local: int
+    n_global_padded: int
+    n_global: int
+    n_shards: int
+
+
+def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *, h: int = 16,
+                            kc: int = 8) -> RingPartitionedShiftELL:
+    """Ring-split ``a`` and pack every (owner, step) slab to shift-ELL.
+
+    Each slab is an ``n_local x n_local`` sparse block; slabs are packed
+    independently, then repacked with the per-step maximum grid depth so
+    all owners share one kernel shape per step.
+    """
+    from ..ops.pallas import spmv as pk
+
+    ring = ring_partition_csr(a, n_shards)
+    n_local = ring.n_local
+
+    def slab_csr(t, s):
+        d = ring.data[t][s]
+        c = ring.cols[t][s]
+        r = ring.local_rows[t][s]
+        live = d != 0
+        d, c, r = d[live], c[live], r[live]
+        order = np.argsort(r, kind="stable")
+        d, c, r = d[order], c[order], r[order]
+        indptr = np.zeros(n_local + 1, dtype=np.int64)
+        np.add.at(indptr, r + 1, 1)
+        return np.cumsum(indptr), c.astype(np.int32), d
+
+    vals_steps, meta_steps, kg_steps = [], [], []
+    for t in range(n_shards):
+        slabs = [slab_csr(t, s) for s in range(n_shards)]
+        packed = [pk.pack_shift_ell(*slab, n_local, h=h, kc=kc)
+                  for slab in slabs]
+        kg_t = max(p.kg for p in packed)
+        packed = [p if p.kg == kg_t
+                  else pk.pack_shift_ell(*slab, n_local, h=h, kc=kc,
+                                         kg=kg_t)
+                  for slab, p in zip(slabs, packed)]
+        vals_steps.append(np.stack([p.vals for p in packed]))
+        meta_steps.append(np.stack([p.lane_meta for p in packed]))
+        kg_steps.append(kg_t)
+
+    diag = np.zeros(ring.n_global_padded, dtype=np.asarray(a.data).dtype)
+    diag[: ring.n_global] = np.asarray(a.diagonal())
+    diag[ring.n_global:] = 1.0  # unit-diagonal padding rows
+    return RingPartitionedShiftELL(
+        vals=tuple(vals_steps), lane_meta=tuple(meta_steps),
+        diag=diag.reshape(n_shards, n_local), h=h, kc=kc,
+        kg=tuple(kg_steps), n_local=n_local,
+        n_global_padded=ring.n_global_padded, n_global=ring.n_global,
+        n_shards=n_shards)
