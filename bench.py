@@ -115,7 +115,7 @@ def bench_all():
     results["poisson2d_1M_dia"] = iter_delta(a_csr.to_dia(), b2, 100, 1100)
     # shift-ELL: the pallas lane-gather kernel (~180x over the csr row)
     results["poisson2d_1M_shiftell"] = iter_delta(
-        a_csr.to_shiftell(h=32), b2, 100, 1100)
+        a_csr.to_shiftell(), b2, 100, 1100)
 
     # 3: preconditioned CG on 2D Poisson: time-to-tolerance across the
     # preconditioner ladder (the reference has none at all)
@@ -263,7 +263,7 @@ def bench_all():
         b_mm = jnp.asarray(
             rng.standard_normal(a_mm.shape[0]).astype(np.float32))
         try:
-            a_fast = a_rcm.to_shiftell(h=32)
+            a_fast = a_rcm.to_shiftell()
             fmt = "shiftell"
         except ValueError:  # beyond the VMEM budget: keep the gather path
             a_fast, fmt = a_rcm, "csr"

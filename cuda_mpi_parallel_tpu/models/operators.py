@@ -244,11 +244,14 @@ class CSRMatrix(LinearOperator):
         """Convert to the gather-free DIA format (see ``DIAMatrix``)."""
         return DIAMatrix.from_csr(self, max_diags=max_diags)
 
-    def to_shiftell(self, h: int = 16, kc: int = 8) -> "ShiftELLMatrix":
+    def to_shiftell(self, h: int | None = None,
+                    kc: int = 8) -> "ShiftELLMatrix":
         """Convert to the pallas shift-ELL format (see ``ShiftELLMatrix``).
-        Combine with ``rcm_permutation``/``permuted`` first for
-        unstructured matrices - sheet count tracks chunk-distance
-        diversity, which RCM concentrates."""
+        ``h=None`` picks the block height by the packing cost model
+        (``ops.pallas.spmv.choose_h``).  Combine with
+        ``rcm_permutation``/``permuted`` first for unstructured matrices -
+        sheet count tracks chunk-distance diversity, which RCM
+        concentrates."""
         return ShiftELLMatrix.from_csr(self, h=h, kc=kc)
 
     def to_ell(self, width: int | None = None) -> "ELLMatrix":
@@ -386,7 +389,7 @@ def _pallas_interpret() -> bool:
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=("vals", "lane_meta", "diag"),
+    data_fields=("vals", "lane_idx", "diag"),
     meta_fields=("shape", "h", "kc", "kg", "n_sheets", "nch", "nch_pad",
                  "pad"),
 )
@@ -405,8 +408,8 @@ class ShiftELLMatrix(LinearOperator):
     (n <= ~2.5M f32 rows per device; shard larger systems).
     """
 
-    vals: jax.Array       # (NB*KG*KC, h, 128)
-    lane_meta: jax.Array  # (NB*KG*KC, h+1, 128) int32
+    vals: jax.Array      # (NB*KG*KC, h+1, 128); row h = window starts
+    lane_idx: jax.Array  # (NB*KG*KC, h, 128) int16 (h%16==0) or int32
     diag: jax.Array       # (n,) - stored; the sheet layout loses O(1) access
     shape: Tuple[int, int]
     h: int
@@ -418,17 +421,20 @@ class ShiftELLMatrix(LinearOperator):
     pad: int
 
     @classmethod
-    def from_csr(cls, a: "CSRMatrix", h: int = 16,
+    def from_csr(cls, a: "CSRMatrix", h: int | None = None,
                  kc: int = 8) -> "ShiftELLMatrix":
         from ..ops.pallas import spmv as pk
 
         n = a.shape[0]
+        if h is None:
+            h = pk.choose_h(np.asarray(a.indptr), np.asarray(a.indices),
+                            n, kc=kc, itemsize=np.dtype(a.dtype).itemsize)
         packed = pk.pack_shift_ell(
             np.asarray(a.indptr), np.asarray(a.indices),
             np.asarray(a.data), n, h=h, kc=kc)
         return cls(
             vals=jnp.asarray(packed.vals),
-            lane_meta=jnp.asarray(packed.lane_meta),
+            lane_idx=jnp.asarray(packed.lane_idx),
             diag=a.diagonal(),
             shape=a.shape, h=packed.h, kc=packed.kc, kg=packed.kg,
             n_sheets=packed.n_sheets, nch=packed.nch,
@@ -442,7 +448,7 @@ class ShiftELLMatrix(LinearOperator):
         from ..ops.pallas import spmv as pk
 
         return pk.shift_ell_matvec(
-            x, self.vals, self.lane_meta,
+            x, self.vals, self.lane_idx,
             h=self.h, kc=self.kc, kg=self.kg, n=self.shape[0],
             nch=self.nch, nch_pad=self.nch_pad, pad=self.pad,
             interpret=_pallas_interpret())

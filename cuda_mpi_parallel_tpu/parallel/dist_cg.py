@@ -328,7 +328,7 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
     parts = part.ring_partition_shiftell(a, n_shards)
     b_dev = _shard_padded_rhs(b, parts, mesh, axis)
     vals = _shard_tree(parts.vals, mesh, axis)  # per-step (n_shards, G, ..)
-    meta = _shard_tree(parts.lane_meta, mesh, axis)
+    meta = _shard_tree(parts.lane_idx, mesh, axis)
     diag = shard_vector(jnp.asarray(parts.diag.reshape(-1)), mesh, axis)
 
     n_local = parts.n_local
@@ -345,7 +345,7 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
             _TRACE_COUNT[0] += 1
             strip = partial(jax.tree.map, lambda v: v[0])
             op = DistShiftELLRing(
-                vals=strip(vals_s), lane_meta=strip(meta_s), diag=diag_s,
+                vals=strip(vals_s), lane_idx=strip(meta_s), diag=diag_s,
                 h=parts.h, kc=parts.kc, kg=parts.kg, n_local=n_local,
                 axis_name=axis, n_shards=n_shards)
             m = _make_precond(precond, op, axis)

@@ -383,7 +383,7 @@ class DistCSRRing(LinearOperator):
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=("vals", "lane_meta", "diag"),
+    data_fields=("vals", "lane_idx", "diag"),
     meta_fields=("h", "kc", "kg", "n_local", "axis_name", "n_shards"),
 )
 @dataclasses.dataclass(frozen=True)
@@ -399,8 +399,8 @@ class DistShiftELLRing(LinearOperator):
     mesh.  Built by ``partition.ring_partition_shiftell``.
     """
 
-    vals: Tuple[jax.Array, ...]       # per step: (G_t, h, 128)
-    lane_meta: Tuple[jax.Array, ...]  # per step: (G_t, h+1, 128) int32
+    vals: Tuple[jax.Array, ...]      # per step: (G_t, h+1, 128)
+    lane_idx: Tuple[jax.Array, ...]  # per step: (G_t, h, 128) i16/i32
     diag: jax.Array                   # (n_local,)
     h: int
     kc: int
@@ -430,7 +430,7 @@ class DistShiftELLRing(LinearOperator):
         xb = x
         for t in range(n):  # static unroll: n is a mesh constant
             y = y + pk.shift_ell_matvec(
-                xb, self.vals[t], self.lane_meta[t], h=self.h, kc=self.kc,
+                xb, self.vals[t], self.lane_idx[t], h=self.h, kc=self.kc,
                 kg=self.kg[t], n=self.n_local, nch=nch, nch_pad=nch_pad,
                 pad=self.h, interpret=interpret)
             if t + 1 < n:

@@ -166,14 +166,14 @@ class RingPartitionedShiftELL(NamedTuple):
     (owner, step), owner ``i``'s step-``t`` slab couples to column block
     ``(i + t) % n_shards``), but each slab's local SpMV is the
     ``ops.pallas.spmv`` lane-gather kernel instead of the XLA gather:
-    ``vals[t]``/``lane_meta[t]`` have shape ``(n_shards, G_t, h(+1), 128)``
+    ``vals[t]``/``lane_idx[t]`` have shape ``(n_shards, G_t, h(+1), 128)``
     with per-step-uniform sheet counts across owners (shard_map needs
     identical shapes per device; ``pack_shift_ell(kg=...)`` forces the
     shared grid geometry).
     """
 
     vals: Tuple[np.ndarray, ...]
-    lane_meta: Tuple[np.ndarray, ...]
+    lane_idx: Tuple[np.ndarray, ...]
     diag: np.ndarray            # (n_shards, n_local) - Jacobi's input
     h: int
     kc: int
@@ -184,13 +184,16 @@ class RingPartitionedShiftELL(NamedTuple):
     n_shards: int
 
 
-def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *, h: int = 16,
+def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *,
+                            h: int | None = None,
                             kc: int = 8) -> RingPartitionedShiftELL:
     """Ring-split ``a`` and pack every (owner, step) slab to shift-ELL.
 
-    Each slab is an ``n_local x n_local`` sparse block; slabs are packed
-    independently, then repacked with the per-step maximum grid depth so
-    all owners share one kernel shape per step.
+    Each slab is an ``n_local x n_local`` sparse block; per step, the
+    grid depth is sized by the cost model (``sheets_per_block``) across
+    owners first, so every slab is packed exactly once with the shared
+    shape.  ``h=None`` auto-tunes the block height on the densest slab
+    (step 0, the own-block diagonal coupling).
     """
     from ..ops.pallas import spmv as pk
 
@@ -209,25 +212,28 @@ def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *, h: int = 16,
         np.add.at(indptr, r + 1, 1)
         return np.cumsum(indptr), c.astype(np.int32), d
 
+    if h is None:
+        ip0, ix0, _ = slab_csr(0, 0)
+        h = pk.choose_h(ip0, ix0, n_local, kc=kc,
+                        itemsize=np.asarray(a.data).dtype.itemsize)
+
     vals_steps, meta_steps, kg_steps = [], [], []
     for t in range(n_shards):
         slabs = [slab_csr(t, s) for s in range(n_shards)]
-        packed = [pk.pack_shift_ell(*slab, n_local, h=h, kc=kc)
+        kg_t = max(
+            -(-int(pk.sheets_per_block(ip, ix, n_local, h=h).max()) // kc)
+            for ip, ix, _ in slabs)
+        packed = [pk.pack_shift_ell(*slab, n_local, h=h, kc=kc, kg=kg_t)
                   for slab in slabs]
-        kg_t = max(p.kg for p in packed)
-        packed = [p if p.kg == kg_t
-                  else pk.pack_shift_ell(*slab, n_local, h=h, kc=kc,
-                                         kg=kg_t)
-                  for slab, p in zip(slabs, packed)]
         vals_steps.append(np.stack([p.vals for p in packed]))
-        meta_steps.append(np.stack([p.lane_meta for p in packed]))
+        meta_steps.append(np.stack([p.lane_idx for p in packed]))
         kg_steps.append(kg_t)
 
     diag = np.zeros(ring.n_global_padded, dtype=np.asarray(a.data).dtype)
     diag[: ring.n_global] = np.asarray(a.diagonal())
     diag[ring.n_global:] = 1.0  # unit-diagonal padding rows
     return RingPartitionedShiftELL(
-        vals=tuple(vals_steps), lane_meta=tuple(meta_steps),
+        vals=tuple(vals_steps), lane_idx=tuple(meta_steps),
         diag=diag.reshape(n_shards, n_local), h=h, kc=kc,
         kg=tuple(kg_steps), n_local=n_local,
         n_global_padded=ring.n_global_padded, n_global=ring.n_global,

@@ -32,12 +32,14 @@ class TestPacking:
         packed = pk.pack_shift_ell(np.asarray(a.indptr),
                                    np.asarray(a.indices),
                                    np.asarray(a.data), a.shape[0], h=4)
-        assert packed.lane_meta.shape == (packed.vals.shape[0],
-                                          packed.h + 1, 128)
+        assert packed.lane_idx.shape == (packed.vals.shape[0],
+                                         packed.h, 128)
+        assert packed.vals.shape[1] == packed.h + 1
         # sum of all slot values == sum of all matrix values (0-padding)
-        np.testing.assert_allclose(packed.vals.sum(),
+        slot_vals = packed.vals[:, :packed.h, :]
+        np.testing.assert_allclose(slot_vals.sum(),
                                    np.asarray(a.data).sum(), rtol=1e-12)
-        nonzero_slots = np.count_nonzero(packed.vals)
+        nonzero_slots = np.count_nonzero(slot_vals)
         assert nonzero_slots == np.count_nonzero(np.asarray(a.data))
 
     def test_padding_sheets_marked_and_regular(self, rng):
@@ -48,9 +50,9 @@ class TestPacking:
                                    kc=4)
         nb = packed.nch_pad // packed.h
         assert packed.vals.shape[0] == nb * packed.kg * packed.kc
-        ws = packed.lane_meta[:, packed.h, 0]
+        ws = packed.vals[:, packed.h, 0]
         # padding sheets carry ws = -1 and zero values
-        assert np.all(packed.vals[ws < 0] == 0)
+        assert np.all(packed.vals[ws < 0, :packed.h, :] == 0)
         # real sheet count matches the cost model
         assert int((ws >= 0).sum()) == packed.n_sheets
 
@@ -62,6 +64,19 @@ class TestPacking:
                                    np.asarray(a.indices),
                                    np.asarray(a.data), a.shape[0], h=4)
         assert packed.n_sheets == total
+
+    def test_choose_h_respects_vmem_budget(self):
+        """Near the size cap, large h pads x past the VMEM budget; the
+        auto-pick must fall back to a height that still fits (regression:
+        auto-h once chose h=128 and made conversions fail that h<=64
+        handled)."""
+        n = 2_598_544  # boundary: h<=64 fits the 10 MB f32 budget, 128 not
+        indptr = np.arange(n + 1, dtype=np.int64)
+        indices = np.arange(n, dtype=np.int32)
+        h = pk.choose_h(indptr, indices, n, itemsize=4)
+        nch = -(-n // 128)
+        nch_pad = -(-nch // h) * h
+        assert (nch_pad + 2 * h) * 128 * 4 <= pk._MAX_X_BYTES
 
     def test_poisson_sheet_count_is_bandwidth_free(self):
         """Natural-order 2D Poisson needs ~K sheets per block regardless
