@@ -113,9 +113,24 @@ def bench_all():
                 "iters_per_sec": (hi - lo) / max(th - tl, 1e-9)}
 
     results["poisson2d_1M_dia"] = iter_delta(a_csr.to_dia(), b2, 100, 1100)
-    # shift-ELL: the pallas lane-gather kernel (~180x over the csr row)
+    # shift-ELL: the pallas lane-gather kernel (~1000x over the csr row)
     results["poisson2d_1M_shiftell"] = iter_delta(
         a_csr.to_shiftell(), b2, 100, 1100)
+
+    # df64 (double-float) storage: ~f64-precision CG on f32 hardware
+    # (solver.df64; the reference's CUDA_R_64F capability, which plain
+    # f32 or x64-emulation cannot deliver on TPU)
+    from cuda_mpi_parallel_tpu.solver.df64 import cg_df64
+
+    op_df = poisson.poisson_2d_operator(n, n, dtype=jnp.float32)
+    b_np64 = np.asarray(b2, dtype=np.float64)
+    tl, _ = time_fn(lambda: cg_df64(op_df, b_np64, tol=0.0, maxiter=100),
+                    warmup=1, repeats=3, reduce="median")
+    th, _ = time_fn(lambda: cg_df64(op_df, b_np64, tol=0.0, maxiter=600),
+                    warmup=1, repeats=3, reduce="median")
+    results["poisson2d_1M_stencil_df64"] = {
+        "us_per_iter": (th - tl) / 500 * 1e6,
+        "iters_per_sec": 500 / max(th - tl, 1e-9)}
 
     # 3: preconditioned CG on 2D Poisson: time-to-tolerance across the
     # preconditioner ladder (the reference has none at all)

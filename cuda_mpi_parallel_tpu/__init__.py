@@ -29,6 +29,7 @@ from .models.operators import (
     Stencil3D,
 )
 from .solver.cg import CGCheckpoint, CGResult, cg, solve
+from .solver.df64 import DF64CGResult, cg_df64
 from .solver.status import CGStatus
 
 __version__ = "0.1.0"
@@ -38,6 +39,7 @@ __all__ = [
     "CGResult",
     "CGStatus",
     "CSRMatrix",
+    "DF64CGResult",
     "DenseOperator",
     "ELLMatrix",
     "IdentityOperator",
@@ -47,5 +49,6 @@ __all__ = [
     "Stencil2D",
     "Stencil3D",
     "cg",
+    "cg_df64",
     "solve",
 ]

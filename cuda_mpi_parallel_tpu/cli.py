@@ -73,11 +73,16 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=[None, "tpu", "cpu"],
                    help="force a JAX platform (default: auto)")
     p.add_argument("--dtype", default="auto",
-                   choices=["auto", "float32", "float64", "bfloat16"],
+                   choices=["auto", "float32", "float64", "bfloat16",
+                            "df64"],
                    help="solve dtype; auto resolves per platform: float32 "
                         "on TPU (the MXU/VPU-native width - float64 runs "
                         "in slow software emulation), float64 on CPU hosts "
-                        "(matching the all-f64 reference, CUDACG.cu:216)")
+                        "(matching the all-f64 reference, CUDACG.cu:216). "
+                        "df64 = double-float (hi,lo) f32 pairs: ~f64 "
+                        "precision on real TPU hardware (solver.df64; "
+                        "plain CG, csr/ell/matrix-free problems, single "
+                        "device)")
     p.add_argument("--matrix-free", action="store_true",
                    help="use the matrix-free stencil operator for poisson* "
                         "(default: assembled CSR)")
@@ -102,9 +107,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(gather+segment-sum), ell (padded rectangular "
                         "gather), dia (gather-free shifted FMAs for "
                         "banded matrices), shiftell (the pallas "
-                        "lane-gather kernel - ~180x faster than csr on "
-                        "1M-row Poisson, ~34x on unstructured FEM after "
-                        "--rcm)")
+                        "lane-gather kernel, f32/f64 values - ~1000x "
+                        "faster than csr on 1M-row Poisson, ~67x on "
+                        "unstructured FEM after --rcm)")
     p.add_argument("--rcm", action="store_true",
                    help="reverse Cuthill-McKee reorder CSR problems before "
                         "solving (bandwidth/locality; solution is scattered "
@@ -131,6 +136,10 @@ def _configure_backend(args) -> None:
         args.dtype = "float32" if platform == "tpu" else "float64"
     if args.dtype == "float64":
         jax.config.update("jax_enable_x64", True)
+    # df64 = (hi, lo) f32 pairs; problem data built in f32 (exact for the
+    # integer-coefficient Poisson/oracle families), solved by solver.df64
+    # at ~48-bit precision
+    args.df64 = args.dtype == "df64"
 
 
 def _build_problem(args):
@@ -139,7 +148,7 @@ def _build_problem(args):
 
     from .models import mmio, poisson, random_spd
 
-    dtype = jnp.dtype(args.dtype)
+    dtype = jnp.dtype("float32" if args.dtype == "df64" else args.dtype)
     rng = np.random.default_rng(args.seed)
     if args.problem == "oracle":
         a, b, x_exp = poisson.oracle_system(dtype=dtype)
@@ -245,7 +254,40 @@ def main(argv=None) -> int:
             raise SystemExit(f"--format {args.fmt}: {e}")
         desc += f" [{args.fmt}]"
 
+    if args.df64:
+        from .models.operators import (
+            CSRMatrix as _CSR,
+            ELLMatrix as _ELL,
+            Stencil2D as _S2,
+            Stencil3D as _S3,
+        )
+
+        bad = None
+        if args.mesh > 1:
+            bad = "--mesh > 1 (single-device solver)"
+        elif args.precond:
+            bad = f"--precond {args.precond} (plain CG, like the reference)"
+        elif args.fmt in ("dia", "shiftell"):
+            bad = f"--format {args.fmt} (csr/ell/matrix-free only)"
+        elif args.method != "cg":
+            bad = f"--method {args.method} (textbook recurrence only)"
+        elif args.check_every != 1:
+            bad = "--check-every != 1"
+        elif not isinstance(a, (_CSR, _ELL, _S2, _S3)):
+            bad = (f"{type(a).__name__} operators (dense df64 would need "
+                   f"error-free MXU accumulation)")
+        if bad:
+            raise SystemExit(f"--dtype df64 does not support {bad}")
+        desc += " [df64]"
+
     def run():
+        if args.df64:
+            from .solver.df64 import cg_df64
+
+            return cg_df64(a, np.asarray(b, dtype=np.float64),
+                           tol=args.tol, rtol=args.rtol,
+                           maxiter=args.maxiter,
+                           record_history=args.history)
         if args.mesh > 1:
             from .parallel import make_mesh, solve_distributed
             from .models.operators import CSRMatrix, Stencil2D, Stencil3D
@@ -296,6 +338,20 @@ def main(argv=None) -> int:
 
     with profile_trace(args.profile):
         elapsed, result = time_fn(run, warmup=1, repeats=1)
+
+    if args.df64:
+        # adapt DF64CGResult to the CGResult-shaped reporting surface
+        import types
+
+        hist = result.residual_history
+        result = types.SimpleNamespace(
+            x=result.x(), iterations=result.iterations,
+            residual_norm=result.residual_norm(),
+            converged=result.converged, indefinite=result.indefinite,
+            status_enum=result.status_enum,
+            residual_history=(
+                np.sqrt(np.maximum(np.asarray(hist), 0.0))
+                if hist is not None else None))
 
     x_np = np.asarray(result.x)
     if rcm_perm is not None:  # scatter back to the original ordering

@@ -1,0 +1,215 @@
+"""Double-float (df64) arithmetic: f64-equivalent values on f32 hardware.
+
+The reference runs entirely in float64 (``CUDA_R_64F``, ``CUDACG.cu:216``);
+TPUs have no native f64, and ``jax_enable_x64`` falls back to slow software
+emulation.  ``blas1.dot_compensated`` already fixes the *reductions*; this
+module fixes the *storage*: every vector is an unevaluated pair
+``(hi, lo)`` of f32 arrays with ``hi + lo`` the represented value and
+``|lo| <= ulp(hi)/2`` - the classic double-float ("double-double for
+single") representation with ~49 significand bits, built from the same
+error-free transformations (Knuth two-sum, Dekker two-prod) as the
+compensated dots.
+
+Everything here is branch-free elementwise VPU work that XLA fuses; a df64
+operation costs ~10-20 f32 flops, which on the VPU-rich TPU still beats
+x64 emulation by a wide margin and - unlike emulation - works on real
+TPU hardware today.
+
+Used by ``solver.df64.cg_df64`` for f64-parity CG trajectories (see
+``tests/test_df64.py``: iteration-count equality with the x64 solver on
+systems where plain f32 pays a +18% delayed-convergence penalty).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .blas1 import _two_prod, _two_sum
+
+DF = Tuple[jax.Array, jax.Array]  # (hi, lo)
+
+
+# -- construction / conversion ------------------------------------------------
+
+def split_f64(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side split of float64 data into an (hi, lo) f32 pair.
+
+    Works regardless of ``jax_enable_x64`` - numpy always has f64 - so
+    f64 problem data reaches full df64 precision even on a TPU host.
+    """
+    x = np.asarray(x, dtype=np.float64)
+    hi = x.astype(np.float32)
+    lo = (x - hi.astype(np.float64)).astype(np.float32)
+    return hi, lo
+
+
+def from_f32(x: jax.Array) -> DF:
+    """Promote an f32 array to df64 (exact: lo = 0)."""
+    return x, jnp.zeros_like(x)
+
+
+def to_f64(hi, lo) -> np.ndarray:
+    """Host-side recombination to float64 (numpy, works without x64)."""
+    return np.asarray(hi, dtype=np.float64) + np.asarray(lo,
+                                                         dtype=np.float64)
+
+
+def const(v: float, dtype=jnp.float32) -> DF:
+    hi, lo = split_f64(np.float64(v))
+    return jnp.asarray(hi, dtype), jnp.asarray(lo, dtype)
+
+
+# -- arithmetic ---------------------------------------------------------------
+
+def _quick_two_sum(a: jax.Array, b: jax.Array):
+    """two-sum assuming |a| >= |b| (3 flops)."""
+    s = a + b
+    return s, b - (s - a)
+
+
+def add(a: DF, b: DF) -> DF:
+    """df64 + df64, accurate (QD/Briggs ieee_add) variant.
+
+    The cheaper "sloppy" add (``e = err + (a.lo + b.lo)``) has UNBOUNDED
+    relative error under cancellation (a.hi ~ -b.hi) - and CG's residual
+    update ``r -= alpha*Ap`` is one long cancellation, which measurably
+    delayed convergence (2.3x the f64 iteration count on a cond~1e8
+    system) until this was upgraded to the two-renormalization form.
+    """
+    sh, eh = _two_sum(a[0], b[0])
+    sl, el = _two_sum(a[1], b[1])
+    eh = eh + sl
+    sh, eh = _quick_two_sum(sh, eh)
+    eh = eh + el
+    return _quick_two_sum(sh, eh)
+
+
+def neg(a: DF) -> DF:
+    return -a[0], -a[1]
+
+
+def sub(a: DF, b: DF) -> DF:
+    return add(a, neg(b))
+
+
+def mul(a: DF, b: DF) -> DF:
+    """df64 * df64 (Dekker mul; drops only the lo*lo term)."""
+    p, e = _two_prod(a[0], b[0])
+    e = e + (a[0] * b[1] + a[1] * b[0])
+    return _two_sum(p, e)
+
+
+def div(a: DF, b: DF) -> DF:
+    """df64 / df64 via one Newton correction of the f32 quotient."""
+    q0 = a[0] / b[0]
+    r = sub(a, mul((q0, jnp.zeros_like(q0)), b))
+    q1 = (r[0] + r[1]) / b[0]
+    return _two_sum(q0, q1)
+
+
+def less(a: DF, b: DF) -> jax.Array:
+    """Exact df64 comparison a < b."""
+    return jnp.logical_or(
+        a[0] < b[0], jnp.logical_and(a[0] == b[0], a[1] < b[1]))
+
+
+# -- vector ops ---------------------------------------------------------------
+
+def axpy(alpha: DF, x: DF, y: DF) -> DF:
+    """alpha * x + y with a broadcast df64 scalar alpha."""
+    return add(mul(alpha, x), y)
+
+
+def dot(x: DF, y: DF, *, axis_name: Optional[str] = None) -> DF:
+    """df64 inner product: two-prod products with the cross terms, summed
+    through a pairwise half-folding tree of full df64 adds (half-folds,
+    never strided slices - see ``blas1._sum_df`` for the TPU tiling
+    reason).
+
+    Each tree level is the accurate ``add``, NOT a plain-f32 lo lane: a
+    single-compensation lo lane loses small lo terms whenever a level's
+    two-sum error is much larger (e.g. a 1e-3 error term rounds a
+    coexisting 1e-11 lo contribution away entirely), which showed up as
+    f32-level noise in cancellation-heavy dots.
+    """
+    p, e = _two_prod(x[0], y[0])
+    e = e + (x[0] * y[1] + x[1] * y[0])
+    hi, lo = _two_sum(p, e)  # renormalize the leaves
+    while hi.shape[0] > 1:
+        m = hi.shape[0]
+        h = (m + 1) // 2
+        if m % 2:
+            hi = jnp.pad(hi, [(0, 1)])
+            lo = jnp.pad(lo, [(0, 1)])
+        hi, lo = add((hi[:h], lo[:h]), (hi[h:], lo[h:]))
+    out = hi[0], lo[0]
+    if axis_name is not None:
+        # per-device partials are df64; the cross-device reduction sums
+        # hi and lo separately (error ~ eps^2 * P, negligible for pod
+        # sizes) then renormalizes
+        out = _two_sum(lax.psum(out[0], axis_name),
+                       lax.psum(out[1], axis_name))
+    return out
+
+
+# -- matvecs ------------------------------------------------------------------
+
+def ell_matvec(vals: DF, cols: jax.Array, x: DF) -> DF:
+    """df64 SpMV over a padded ELL layout: K exact-compensated
+    multiply-adds per row (K = max nnz/row, small for PDE matrices).
+
+    Row sums accumulate through df64 adds, so - unlike a compensated
+    segment-sum - cancellation inside a row costs no precision.
+    """
+    gh = jnp.take(x[0], cols, axis=0)
+    gl = jnp.take(x[1], cols, axis=0)
+    k = cols.shape[1]
+    acc = mul((vals[0][:, 0], vals[1][:, 0]), (gh[:, 0], gl[:, 0]))
+    for j in range(1, k):
+        acc = add(acc, mul((vals[0][:, j], vals[1][:, j]),
+                           (gh[:, j], gl[:, j])))
+    return acc
+
+
+def stencil2d_matvec(x: DF, grid: Tuple[int, int], scale: DF) -> DF:
+    """df64 5-point Laplacian: (4u - N - S - W - E) * scale.
+
+    ``4*u`` is exact in f32 (power-of-two scaling), so the whole
+    unscaled stencil is four df64 adds; the scale multiply is one df64
+    mul.  Matches ``Stencil2D.matvec`` semantics (Dirichlet, row-major).
+    """
+    nx, ny = grid
+    uh = x[0].reshape(nx, ny)
+    ul = x[1].reshape(nx, ny)
+    ph = jnp.pad(uh, 1)
+    pl = jnp.pad(ul, 1)
+    acc = (4.0 * uh, 4.0 * ul)
+    for sl in ((slice(None, -2), slice(1, -1)),
+               (slice(2, None), slice(1, -1)),
+               (slice(1, -1), slice(None, -2)),
+               (slice(1, -1), slice(2, None))):
+        acc = sub(acc, (ph[sl], pl[sl]))
+    y = mul(scale, acc)
+    return y[0].reshape(-1), y[1].reshape(-1)
+
+
+def stencil3d_matvec(x: DF, grid: Tuple[int, int, int], scale: DF) -> DF:
+    """df64 7-point Laplacian: (6u - sum of 6 neighbors) * scale."""
+    nx, ny, nz = grid
+    uh = x[0].reshape(nx, ny, nz)
+    ul = x[1].reshape(nx, ny, nz)
+    ph = jnp.pad(uh, 1)
+    pl = jnp.pad(ul, 1)
+    c = slice(1, -1)
+    # 6u is NOT exact in f32 (6 = 2*3); build it as 4u + 2u, both exact
+    acc = add((4.0 * uh, 4.0 * ul), (2.0 * uh, 2.0 * ul))
+    for sl in ((slice(None, -2), c, c), (slice(2, None), c, c),
+               (c, slice(None, -2), c), (c, slice(2, None), c),
+               (c, c, slice(None, -2)), (c, c, slice(2, None))):
+        acc = sub(acc, (ph[sl], pl[sl]))
+    y = mul(scale, acc)
+    return y[0].reshape(-1), y[1].reshape(-1)

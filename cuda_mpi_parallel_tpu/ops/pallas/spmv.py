@@ -287,14 +287,17 @@ def sheets_per_block(indptr: np.ndarray, indices: np.ndarray, n: int,
     np.maximum.at(max_mult, inv, counts)
     per_block = np.zeros(nb, dtype=np.int64)
     np.add.at(per_block, uniq_bw // span, max_mult)
-    return np.maximum(per_block, 1)
+    # raw counts: empty blocks report 0 real sheets (they are padded with
+    # dummy sheets at pack time, not counted in n_sheets); kg-sizing
+    # callers clamp with max(..., 1) themselves
+    return per_block
 
 
 def sheet_count(indptr: np.ndarray, indices: np.ndarray, n: int,
                 *, h: int = 16) -> Tuple[int, float]:
     """(total real sheets, average per block) - see sheets_per_block."""
     per_block = sheets_per_block(indptr, indices, n, h=h)
-    return int(per_block.sum()), float(per_block.mean())
+    return int(per_block.sum()), float(per_block.sum() / per_block.size)
 
 
 def choose_h(indptr: np.ndarray, indices: np.ndarray, n: int, *,
@@ -320,7 +323,7 @@ def choose_h(indptr: np.ndarray, indices: np.ndarray, n: int, *,
         if (nch_pad + 2 * h) * LANES * itemsize > _MAX_X_BYTES:
             continue
         per_block = sheets_per_block(indptr, indices, n, h=h)
-        kg = -(-int(per_block.max()) // kc)
+        kg = -(-max(int(per_block.max()), 1) // kc)
         cost = per_block.size * kg * kc
         if best_cost is None or cost < best_cost:
             best_h, best_cost = h, cost

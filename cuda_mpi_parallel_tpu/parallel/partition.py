@@ -212,16 +212,18 @@ def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *,
         np.add.at(indptr, r + 1, 1)
         return np.cumsum(indptr), c.astype(np.int32), d
 
+    slab00 = slab_csr(0, 0)
     if h is None:
-        ip0, ix0, _ = slab_csr(0, 0)
-        h = pk.choose_h(ip0, ix0, n_local, kc=kc,
+        h = pk.choose_h(slab00[0], slab00[1], n_local, kc=kc,
                         itemsize=np.asarray(a.data).dtype.itemsize)
 
     vals_steps, meta_steps, kg_steps = [], [], []
     for t in range(n_shards):
-        slabs = [slab_csr(t, s) for s in range(n_shards)]
+        slabs = [slab00 if (t, s) == (0, 0) else slab_csr(t, s)
+                 for s in range(n_shards)]
         kg_t = max(
-            -(-int(pk.sheets_per_block(ip, ix, n_local, h=h).max()) // kc)
+            -(-max(int(pk.sheets_per_block(ip, ix, n_local,
+                                           h=h).max()), 1) // kc)
             for ip, ix, _ in slabs)
         packed = [pk.pack_shift_ell(*slab, n_local, h=h, kc=kc, kg=kg_t)
                   for slab in slabs]

@@ -77,6 +77,36 @@ class TestCLI:
             cli.main(["--problem", "poisson2d", "--n", "8", "--device",
                       "cpu", "--dtype", "bfloat16", "--tol", "1e-7"])
 
+    def test_dtype_df64(self, capsys):
+        """df64 reaches tolerances plain f32 cannot (rtol 1e-12)."""
+        rc = cli.main(["--problem", "poisson2d", "--n", "16", "--device",
+                       "cpu", "--dtype", "df64", "--tol", "0", "--rtol",
+                       "1e-12", "--json"])
+        rec = json.loads(capsys.readouterr().out)
+        assert rc == 0 and rec["converged"] and rec["dtype"] == "df64"
+        # ||r|| ~ 1e-11: unreachable for f32 storage (floors near 1e-6);
+        # max_abs_error stays ~1e-6 because the CLI builds b in f32
+        assert rec["residual_norm"] < 1e-9
+
+    def test_df64_rejects_unsupported(self):
+        with pytest.raises(SystemExit, match="df64"):
+            cli.main(["--problem", "poisson2d", "--n", "8", "--device",
+                      "cpu", "--dtype", "df64", "--precond", "jacobi"])
+        with pytest.raises(SystemExit, match="df64"):
+            cli.main(["--problem", "poisson2d", "--n", "8", "--device",
+                      "cpu", "--dtype", "df64", "--mesh", "2"])
+        with pytest.raises(SystemExit, match="DenseOperator"):
+            cli.main(["--problem", "random-spd", "--n", "8", "--device",
+                      "cpu", "--dtype", "df64"])
+
+    def test_shiftell_bfloat16_rejected_cleanly(self):
+        """shift-ELL metadata rides the value plane: f32/f64 only, and
+        the CLI must surface that as a clean error."""
+        with pytest.raises(SystemExit, match="float32/float64"):
+            cli.main(["--problem", "poisson2d", "--n", "16", "--device",
+                      "cpu", "--format", "shiftell", "--dtype", "bfloat16",
+                      "--tol", "1e-2"])
+
     def test_format_shiftell(self, capsys):
         rc = cli.main(["--problem", "poisson2d", "--n", "16", "--device",
                        "cpu", "--format", "shiftell", "--tol", "1e-8",

@@ -65,6 +65,17 @@ class TestPacking:
                                    np.asarray(a.data), a.shape[0], h=4)
         assert packed.n_sheets == total
 
+    def test_sheet_count_matches_pack_with_empty_blocks(self):
+        """Rows 512+ empty: the cost model must not count the dummy
+        sheets pack adds for empty blocks (regression)."""
+        n = 1024
+        indptr = np.concatenate([np.arange(513), np.full(512, 512)])
+        indices = np.arange(512, dtype=np.int32)
+        data = np.ones(512)
+        total, _ = pk.sheet_count(indptr, indices, n, h=4)
+        packed = pk.pack_shift_ell(indptr, indices, data, n, h=4)
+        assert packed.n_sheets == total
+
     def test_choose_h_respects_vmem_budget(self):
         """Near the size cap, large h pads x past the VMEM budget; the
         auto-pick must fall back to a height that still fits (regression:
