@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+from itertools import count
 
 # Estimated reference throughput (see module docstring); the reference
 # itself publishes no numbers (SURVEY SS6, BASELINE.md).
@@ -54,13 +55,18 @@ def bench_headline(device=None):
     # 32-iteration block: iterates are IDENTICAL (solver.cg docstring), but
     # the loop trips lose the per-iteration predicate serialization -
     # measured ~30% faster per iteration on v5e at this size.
-    def run(it):
-        return jax.jit(
-            lambda v: solve(op, v, tol=0.0, maxiter=it, check_every=32).x)
+    # Every call gets a fresh rhs VALUE: the tunneled runtime can serve
+    # repeated identical dispatches from a cache, which zeroes deltas.
+    ctr = count(1)
 
-    f_lo, f_hi = run(ITERS_LO), run(ITERS_HI)
-    t_lo, _ = time_fn(f_lo, b, warmup=1, repeats=5, reduce="median")
-    t_hi, _ = time_fn(f_hi, b, warmup=1, repeats=5, reduce="median")
+    def run(it):
+        bb = b * np.float32(1.0 + next(ctr) * 1e-4)
+        return solve(op, bb, tol=0.0, maxiter=it, check_every=32).x
+
+    t_lo, _ = time_fn(lambda: run(ITERS_LO), warmup=1, repeats=5,
+                      reduce="median")
+    t_hi, _ = time_fn(lambda: run(ITERS_HI), warmup=1, repeats=5,
+                      reduce="median")
     value = (ITERS_HI - ITERS_LO) / max(t_hi - t_lo, 1e-9)
     return {
         "metric": "cg_iters_per_sec_poisson2d_1M_f32",
@@ -99,23 +105,34 @@ def bench_all():
     n = HEADLINE_GRID
     a_csr = poisson.poisson_2d_csr(n, n, dtype=np.float32)
     b2 = jnp.asarray(rng.standard_normal(n * n).astype(np.float32))
-    el, res = time_fn(lambda: solve(a_csr, b2, tol=0.0, maxiter=100),
+    # keep this single call short: at ~83 ms/iter the XLA-gather kernel
+    # runs long enough to flirt with the device watchdog
+    el, res = time_fn(lambda: solve(a_csr, b2, tol=0.0, maxiter=50),
                       warmup=1, repeats=2)
-    results["poisson2d_1M_csr"] = {"iters_per_sec": 100 / el, "elapsed_s": el}
+    results["poisson2d_1M_csr"] = {"iters_per_sec": 50 / el, "elapsed_s": el}
     def iter_delta(op, rhs, lo, hi, repeats=5, **kw):
-        tl, _ = time_fn(lambda: solve(op, rhs, tol=0.0, maxiter=lo,
-                                      check_every=32, **kw),
-                        warmup=1, repeats=repeats, reduce="median")
-        th, _ = time_fn(lambda: solve(op, rhs, tol=0.0, maxiter=hi,
-                                      check_every=32, **kw),
-                        warmup=1, repeats=repeats, reduce="median")
+        # fresh rhs value per call: defeats the tunnel's identical-
+        # dispatch result cache (see bench_headline)
+        ctr = count(1)
+
+        def run(it):
+            rr = rhs * np.float32(1.0 + next(ctr) * 1e-4)
+            return solve(op, rr, tol=0.0, maxiter=it, check_every=32, **kw)
+
+        tl, _ = time_fn(lambda: run(lo), warmup=1, repeats=repeats,
+                        reduce="median")
+        th, _ = time_fn(lambda: run(hi), warmup=1, repeats=repeats,
+                        reduce="median")
         return {"us_per_iter": (th - tl) / (hi - lo) * 1e6,
                 "iters_per_sec": (hi - lo) / max(th - tl, 1e-9)}
 
-    results["poisson2d_1M_dia"] = iter_delta(a_csr.to_dia(), b2, 100, 1100)
-    # shift-ELL: the pallas lane-gather kernel (~1000x over the csr row)
+    # deltas need >~1s of differential device work: smaller gaps drown
+    # in the tunnel's +-0.1-0.2s per-dispatch jitter
+    results["poisson2d_1M_dia"] = iter_delta(a_csr.to_dia(), b2, 100, 4100,
+                                             repeats=3)
+    # shift-ELL: the pallas lane-gather kernel (~800x over the csr row)
     results["poisson2d_1M_shiftell"] = iter_delta(
-        a_csr.to_shiftell(), b2, 100, 1100)
+        a_csr.to_shiftell(), b2, 100, 4100, repeats=3)
 
     # df64 (double-float) storage: ~f64-precision CG on f32 hardware
     # (solver.df64; the reference's CUDA_R_64F capability, which plain
@@ -124,13 +141,21 @@ def bench_all():
 
     op_df = poisson.poisson_2d_operator(n, n, dtype=jnp.float32)
     b_np64 = np.asarray(b2, dtype=np.float64)
-    tl, _ = time_fn(lambda: cg_df64(op_df, b_np64, tol=0.0, maxiter=100),
-                    warmup=1, repeats=3, reduce="median")
-    th, _ = time_fn(lambda: cg_df64(op_df, b_np64, tol=0.0, maxiter=600),
-                    warmup=1, repeats=3, reduce="median")
+    ctr = count(1)
+
+    def run_df(it):
+        # fresh rhs VALUE per call: the tunneled runtime can serve
+        # repeated identical dispatches from a cache, zeroing the delta
+        return cg_df64(op_df, b_np64 * (1.0 + next(ctr) * 1e-4),
+                       tol=0.0, maxiter=it)
+
+    tl, _ = time_fn(lambda: run_df(200), warmup=1, repeats=3,
+                    reduce="median")
+    th, _ = time_fn(lambda: run_df(6200), warmup=1, repeats=3,
+                    reduce="median")
     results["poisson2d_1M_stencil_df64"] = {
-        "us_per_iter": (th - tl) / 500 * 1e6,
-        "iters_per_sec": 500 / max(th - tl, 1e-9)}
+        "us_per_iter": (th - tl) / 6000 * 1e6,
+        "iters_per_sec": 6000 / max(th - tl, 1e-9)}
 
     # 3: preconditioned CG on 2D Poisson: time-to-tolerance across the
     # preconditioner ladder (the reference has none at all)
@@ -186,12 +211,16 @@ def bench_all():
                                    backend=backend)
         except ValueError:
             continue
-        el_lo, _ = time_fn(
-            lambda a_b=a_b, b_b=b_b: solve(a_b, b_b, tol=0.0, maxiter=10),
-            warmup=1, repeats=3, reduce="median")
-        el_hi, _ = time_fn(
-            lambda a_b=a_b, b_b=b_b: solve(a_b, b_b, tol=0.0, maxiter=60),
-            warmup=1, repeats=3, reduce="median")
+        ctr_b = count(1)
+
+        def run_b(it, a_b=a_b):
+            bb = b_b * np.float32(1.0 + next(ctr_b) * 1e-4)
+            return solve(a_b, bb, tol=0.0, maxiter=it)
+
+        el_lo, _ = time_fn(lambda: run_b(10), warmup=1, repeats=3,
+                           reduce="median")
+        el_hi, _ = time_fn(lambda: run_b(60), warmup=1, repeats=3,
+                           reduce="median")
         results[f"poisson2d_16M_{backend}"] = {
             "us_per_iter": (el_hi - el_lo) / 50 * 1e6}
 
@@ -205,7 +234,7 @@ def bench_all():
     a256 = Stencil3D.create(256, 256, 256, dtype=jnp.float32)
     b256 = jnp.asarray(
         rng.standard_normal(a256.shape[0]).astype(np.float32))
-    results["poisson3d_256_stencil"] = iter_delta(a256, b256, 32, 160,
+    results["poisson3d_256_stencil"] = iter_delta(a256, b256, 32, 544,
                                                   repeats=3)
     for name, m256 in [
         ("chebyshev4",
@@ -284,7 +313,7 @@ def bench_all():
             a_fast, fmt = a_rcm, "csr"
         entry = {"n": int(a_mm.shape[0]), "nnz": int(a_mm.nnz),
                  "format": fmt, "rcm_bandwidth": int(a_rcm.bandwidth())}
-        entry.update(iter_delta(a_fast, b_mm, 16, 80, repeats=3))
+        entry.update(iter_delta(a_fast, b_mm, 20, 500, repeats=2))
         m_mm = JacobiPreconditioner.from_operator(a_fast)
         el, res = time_fn(
             lambda: solve(a_fast, b_mm, tol=0.0, rtol=1e-6, maxiter=10000,

@@ -81,8 +81,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(matching the all-f64 reference, CUDACG.cu:216). "
                         "df64 = double-float (hi,lo) f32 pairs: ~f64 "
                         "precision on real TPU hardware (solver.df64; "
-                        "plain CG, csr/ell/matrix-free problems, single "
-                        "device)")
+                        "plain or Jacobi-PCG, csr/ell/matrix-free "
+                        "problems, single device)")
     p.add_argument("--matrix-free", action="store_true",
                    help="use the matrix-free stencil operator for poisson* "
                         "(default: assembled CSR)")
@@ -265,8 +265,8 @@ def main(argv=None) -> int:
         bad = None
         if args.mesh > 1:
             bad = "--mesh > 1 (single-device solver)"
-        elif args.precond:
-            bad = f"--precond {args.precond} (plain CG, like the reference)"
+        elif args.precond not in (None, "jacobi"):
+            bad = f"--precond {args.precond} (None or jacobi only)"
         elif args.fmt in ("dia", "shiftell"):
             bad = f"--format {args.fmt} (csr/ell/matrix-free only)"
         elif args.method != "cg":
@@ -287,6 +287,7 @@ def main(argv=None) -> int:
             return cg_df64(a, np.asarray(b, dtype=np.float64),
                            tol=args.tol, rtol=args.rtol,
                            maxiter=args.maxiter,
+                           preconditioner=args.precond,
                            record_history=args.history)
         if args.mesh > 1:
             from .parallel import make_mesh, solve_distributed

@@ -11,16 +11,18 @@ cond ~1e7/1e9 to rtol 1e-10, plain f32 pays +84%/+180% iterations over
 the x64 solver while df64 lands at +7%/+15% - and unlike f32, df64
 reaches rtol 1e-12 with ~1e-9 solution error.  On the 3x3 oracle it
 reproduces the f64 trajectory exactly (3 iterations, ||r|| ~ 5e-14 on
-real TPU hardware).  Cost: ~76 us/iter on a 1M-unknown 2D Poisson
-stencil on v5e (~4x plain f32; ~13k CG iters/s at f64-class precision -
+real TPU hardware).  Cost: ~85 us/iter on a 1M-unknown 2D Poisson
+stencil on v5e (~4x plain f32; ~12k CG iters/s at f64-class precision -
 above the reference loop's estimated f64 throughput, on a chip with no
-f64 units).
+f64 units).  Measured with 6000-iteration deltas; the tunnel's
+per-dispatch jitter swamps anything shorter.
 
 Same reference-parity semantics as ``solver.cg``: absolute ``tol=1e-7``
 on ||r|| (quirk Q3), ``maxiter=2000``, x0 = 0 fast path (r0 = p0 = b,
 no initial SpMV, ``CUDACG.cu:247-259``), indefinite-direction recording
 (quirk Q1), breakdown detection on non-finite scalars (quirk Q4).
-Unpreconditioned, like the reference; textbook recurrence only.
+Textbook recurrence; plain CG (the reference's configuration) or
+Jacobi-PCG with the diagonal applied in df64 (BASELINE config #3).
 
 Operators: ``CSRMatrix``/``ELLMatrix`` (values re-split from host f64 -
 numpy always has f64, even on TPU hosts with x64 off), ``Stencil2D``/
@@ -88,18 +90,22 @@ class DF64CGResult:
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=("vals_hi", "vals_lo", "cols", "scale_hi", "scale_lo"),
+    data_fields=("vals_hi", "vals_lo", "cols", "scale_hi", "scale_lo",
+                 "diag_hi", "diag_lo"),
     meta_fields=("kind", "grid"),
 )
 @dataclasses.dataclass(frozen=True)
 class _DF64Operator:
-    """Pre-split df64 operator: ELL (vals pair + cols) or stencil."""
+    """Pre-split df64 operator: ELL (vals pair + cols) or stencil.
+    ``diag_hi/lo`` carry diag(A) for the Jacobi preconditioner."""
 
     vals_hi: jax.Array
     vals_lo: jax.Array
     cols: jax.Array
     scale_hi: jax.Array
     scale_lo: jax.Array
+    diag_hi: jax.Array
+    diag_lo: jax.Array
     kind: str
     grid: Tuple[int, ...]
 
@@ -112,18 +118,28 @@ class _DF64Operator:
         return df.stencil3d_matvec(x, self.grid, scale)
 
 
-def _prepare_operator(a) -> _DF64Operator:
+def _prepare_operator(a, jacobi: bool = False) -> _DF64Operator:
+    """Host-side split; the Jacobi diagonal (full-length for ELL, a
+    broadcastable scalar pair for constant-diagonal stencils) is built
+    only when requested - it is dead weight for plain CG."""
     zero = jnp.zeros((), jnp.float32)
     if isinstance(a, (Stencil2D, Stencil3D)):
         # re-split the scale from host f64 so non-exact scales keep
         # their low word
-        sh, sl = df.split_f64(np.float64(np.asarray(a.scale,
-                                                    dtype=np.float64)))
+        scale64 = np.float64(np.asarray(a.scale, dtype=np.float64))
+        sh, sl = df.split_f64(scale64)
         kind = "stencil2d" if isinstance(a, Stencil2D) else "stencil3d"
+        dh = dl = zero
+        if jacobi:
+            # the operator owns its diagonal definition; recover the
+            # (constant) center weight from it rather than restating it
+            center = np.float64(np.asarray(a.diagonal()[0],
+                                           dtype=np.float64))
+            dh, dl = (jnp.asarray(v) for v in df.split_f64(center))
         return _DF64Operator(
             vals_hi=zero, vals_lo=zero, cols=jnp.zeros((), jnp.int32),
             scale_hi=jnp.asarray(sh), scale_lo=jnp.asarray(sl),
-            kind=kind, grid=a.grid)
+            diag_hi=dh, diag_lo=dl, kind=kind, grid=a.grid)
     if isinstance(a, CSRMatrix):
         a = a.to_ell()
     if not isinstance(a, ELLMatrix):
@@ -132,9 +148,14 @@ def _prepare_operator(a) -> _DF64Operator:
             f"got {type(a).__name__} (dense df64 would need error-free "
             f"MXU accumulation, which the hardware cannot provide)")
     vh, vl = df.split_f64(np.asarray(a.vals, dtype=np.float64))
+    dh = dl = zero
+    if jacobi:
+        dh, dl = (jnp.asarray(v) for v in df.split_f64(
+            np.asarray(a.diagonal(), dtype=np.float64)))
     return _DF64Operator(
         vals_hi=jnp.asarray(vh), vals_lo=jnp.asarray(vl), cols=a.cols,
-        scale_hi=zero, scale_lo=zero, kind="ell", grid=())
+        scale_hi=zero, scale_lo=zero, diag_hi=dh, diag_lo=dl,
+        kind="ell", grid=())
 
 
 class _State(NamedTuple):
@@ -142,7 +163,8 @@ class _State(NamedTuple):
     x: df.DF
     r: df.DF
     p: df.DF
-    rho: df.DF            # ||r||^2 as a df64 scalar pair
+    rho: df.DF            # r . z as a df64 scalar pair (== rr w/o precond)
+    rr: df.DF             # ||r||^2 (convergence is checked on r, not z)
     indefinite: jax.Array
     finite: jax.Array
     history: jax.Array
@@ -156,15 +178,21 @@ def cg_df64(
     rtol: float = 0.0,
     maxiter: int = 2000,
     record_history: bool = False,
+    preconditioner: Optional[str] = None,
     axis_name: Optional[str] = None,
 ) -> DF64CGResult:
-    """Unpreconditioned CG with df64 storage (see module docstring).
+    """CG with df64 storage (see module docstring).
 
     ``b`` may be a float64 numpy array (full precision via host split),
-    or any f32/f64 array-like.  Jit-compatible given an already-prepared
-    operator; the host-side split happens at trace time.
+    or any f32/f64 array-like.  ``preconditioner``: ``None`` (plain CG,
+    the reference's configuration) or ``"jacobi"`` (diag(A)^-1 applied
+    in df64 - BASELINE config #3 at f64-class precision).
     """
-    op = _prepare_operator(a)
+    if preconditioner not in (None, "jacobi"):
+        raise ValueError(
+            f"cg_df64 supports preconditioner=None or 'jacobi', got "
+            f"{preconditioner!r}")
+    op = _prepare_operator(a, jacobi=preconditioner == "jacobi")
     if isinstance(b, np.ndarray) and b.dtype == np.float64:
         bh, bl = df.split_f64(b)
         b_df = (jnp.asarray(bh), jnp.asarray(bl))
@@ -178,33 +206,44 @@ def cg_df64(
 
     tol2 = df.const(float(tol) ** 2)
     rtol2 = df.const(float(rtol) ** 2)
+    jacobi = preconditioner == "jacobi"
     if axis_name is None:
         return _solve_jit(op, b_df, tol2, rtol2, maxiter=maxiter,
-                          record_history=record_history, axis_name=None)
+                          record_history=record_history, jacobi=jacobi,
+                          axis_name=None)
     return _solve(op, b_df, tol2, rtol2, maxiter=maxiter,
-                  record_history=record_history, axis_name=axis_name)
+                  record_history=record_history, jacobi=jacobi,
+                  axis_name=axis_name)
 
 
-def _solve(op, b_df, tol2, rtol2, *, maxiter, record_history, axis_name):
+def _solve(op, b_df, tol2, rtol2, *, maxiter, record_history, jacobi,
+           axis_name):
     n = b_df[0].shape[0]
     hist_len = maxiter + 1 if record_history else 0
+    d = (op.diag_hi, op.diag_lo)
     x0 = (jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32))
+    if axis_name is not None:
+        # fresh zeros are unvarying; the while_loop carry must match the
+        # body's output (device-varying) under shard_map's vma tracking
+        x0 = tuple(lax.pvary(v, (axis_name,)) for v in x0)
     r0 = b_df     # x0 = 0 fast path (CUDACG.cu:247-259)
-    p0 = b_df
-    rho0 = df.dot(r0, r0, axis_name=axis_name)
+    z0 = df.div(r0, d) if jacobi else r0
+    p0 = z0
+    rr0 = df.dot(r0, r0, axis_name=axis_name)
+    rho0 = df.dot(r0, z0, axis_name=axis_name) if jacobi else rr0
     # threshold^2 = max(tol^2, rtol^2 * ||r0||^2) as a df64 pair
-    rt = df.mul(rtol2, rho0)
+    rt = df.mul(rtol2, rr0)
     thr = (jnp.maximum(tol2[0], rt[0]),
            jnp.where(tol2[0] >= rt[0], tol2[1], rt[1]))
     history0 = jnp.zeros(hist_len, jnp.float32)
     if record_history:
-        history0 = history0.at[0].set(rho0[0])
+        history0 = history0.at[0].set(rr0[0])
 
     def cond(s: _State):
         return jnp.logical_and(
             s.k < maxiter,
             jnp.logical_and(s.finite,
-                            jnp.logical_not(df.less(s.rho, thr))))
+                            jnp.logical_not(df.less(s.rr, thr))))
 
     def body(s: _State):
         ap = op.matvec(s.p)
@@ -212,36 +251,41 @@ def _solve(op, b_df, tol2, rtol2, *, maxiter, record_history, axis_name):
         alpha = df.div(s.rho, pap)
         x = df.axpy(alpha, s.p, s.x)
         r = df.axpy(df.neg(alpha), ap, s.r)
-        rho_new = df.dot(r, r, axis_name=axis_name)
+        rr_new = df.dot(r, r, axis_name=axis_name)
+        if jacobi:
+            z = df.div(r, d)
+            rho_new = df.dot(r, z, axis_name=axis_name)
+        else:
+            z, rho_new = r, rr_new
         beta = df.div(rho_new, s.rho)
-        p = df.axpy(beta, s.p, r)
+        p = df.axpy(beta, s.p, z)
         k = s.k + 1
         history = s.history
         if record_history:
-            history = history.at[k].set(rho_new[0])
+            history = history.at[k].set(rr_new[0])
         finite = jnp.logical_and(jnp.isfinite(rho_new[0]),
                                  jnp.isfinite(pap[0]))
         return _State(
-            k=k, x=x, r=r, p=p, rho=rho_new,
+            k=k, x=x, r=r, p=p, rho=rho_new, rr=rr_new,
             indefinite=jnp.logical_or(s.indefinite, pap[0] <= 0.0),
             finite=finite, history=history)
 
     s0 = _State(k=jnp.zeros((), jnp.int32), x=x0, r=r0, p=p0, rho=rho0,
-                indefinite=jnp.zeros((), bool),
+                rr=rr0, indefinite=jnp.zeros((), bool),
                 finite=jnp.isfinite(rho0[0]),
                 history=history0)
     s = lax.while_loop(cond, body, s0)
-    converged = df.less(s.rho, thr)
+    converged = df.less(s.rr, thr)
     status = jnp.where(
         jnp.logical_not(s.finite), CGStatus.BREAKDOWN.value,
         jnp.where(converged, CGStatus.CONVERGED.value,
                   CGStatus.MAXITER.value))
     return DF64CGResult(
         x_hi=s.x[0], x_lo=s.x[1], iterations=s.k,
-        residual_norm_sq_hi=s.rho[0], residual_norm_sq_lo=s.rho[1],
+        residual_norm_sq_hi=s.rr[0], residual_norm_sq_lo=s.rr[1],
         converged=converged, status=status, indefinite=s.indefinite,
         residual_history=s.history if record_history else None)
 
 
 _solve_jit = jax.jit(_solve, static_argnames=("maxiter", "record_history",
-                                              "axis_name"))
+                                              "jacobi", "axis_name"))

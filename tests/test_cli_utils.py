@@ -88,10 +88,17 @@ class TestCLI:
         # max_abs_error stays ~1e-6 because the CLI builds b in f32
         assert rec["residual_norm"] < 1e-9
 
+    def test_df64_jacobi_supported(self, capsys):
+        rc = cli.main(["--problem", "poisson2d", "--n", "12", "--device",
+                       "cpu", "--dtype", "df64", "--precond", "jacobi",
+                       "--tol", "0", "--rtol", "1e-10", "--json"])
+        rec = json.loads(capsys.readouterr().out)
+        assert rc == 0 and rec["converged"] and rec["precond"] == "jacobi"
+
     def test_df64_rejects_unsupported(self):
         with pytest.raises(SystemExit, match="df64"):
             cli.main(["--problem", "poisson2d", "--n", "8", "--device",
-                      "cpu", "--dtype", "df64", "--precond", "jacobi"])
+                      "cpu", "--dtype", "df64", "--precond", "chebyshev"])
         with pytest.raises(SystemExit, match="df64"):
             cli.main(["--problem", "poisson2d", "--n", "8", "--device",
                       "cpu", "--dtype", "df64", "--mesh", "2"])
