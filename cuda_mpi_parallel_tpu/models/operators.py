@@ -389,8 +389,8 @@ def _pallas_interpret() -> bool:
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=("vals", "lane_idx", "diag"),
-    meta_fields=("shape", "h", "kc", "kg", "n_sheets", "nch", "nch_pad",
+    data_fields=("vals", "lane_idx", "chunk_blocks", "diag"),
+    meta_fields=("shape", "h", "kc", "n_sheets", "nch", "nch_pad",
                  "pad"),
 )
 @dataclasses.dataclass(frozen=True)
@@ -408,13 +408,13 @@ class ShiftELLMatrix(LinearOperator):
     (n <= ~2.5M f32 rows per device; shard larger systems).
     """
 
-    vals: jax.Array      # (NB*KG*KC, h+1, 128); row h = window starts
-    lane_idx: jax.Array  # (NB*KG*KC, h, 128) int16 (h%16==0) or int32
-    diag: jax.Array       # (n,) - stored; the sheet layout loses O(1) access
+    vals: jax.Array          # (n_chunks, kc, h+1, 128); row h = ws meta
+    lane_idx: jax.Array      # (n_chunks, kc, h, 128) i16 (h%16==0) or i32
+    chunk_blocks: jax.Array  # (n_chunks,) int32, non-decreasing
+    diag: jax.Array      # (n,) - stored; the sheet layout loses O(1) access
     shape: Tuple[int, int]
     h: int
     kc: int
-    kg: int
     n_sheets: int         # real sheets (cost model; arrays are padded)
     nch: int
     nch_pad: int
@@ -435,8 +435,9 @@ class ShiftELLMatrix(LinearOperator):
         return cls(
             vals=jnp.asarray(packed.vals),
             lane_idx=jnp.asarray(packed.lane_idx),
+            chunk_blocks=jnp.asarray(packed.chunk_blocks),
             diag=a.diagonal(),
-            shape=a.shape, h=packed.h, kc=packed.kc, kg=packed.kg,
+            shape=a.shape, h=packed.h, kc=packed.kc,
             n_sheets=packed.n_sheets, nch=packed.nch,
             nch_pad=packed.nch_pad, pad=packed.pad)
 
@@ -448,8 +449,8 @@ class ShiftELLMatrix(LinearOperator):
         from ..ops.pallas import spmv as pk
 
         return pk.shift_ell_matvec(
-            x, self.vals, self.lane_idx,
-            h=self.h, kc=self.kc, kg=self.kg, n=self.shape[0],
+            x, self.vals, self.lane_idx, self.chunk_blocks,
+            h=self.h, kc=self.kc, n=self.shape[0],
             nch=self.nch, nch_pad=self.nch_pad, pad=self.pad,
             interpret=_pallas_interpret())
 

@@ -38,19 +38,22 @@ columns after RCM").
 
 Performance-critical structure (measured on v5e):
 
-* Grid steps must be *fat*: one grid step per (block, KC-sheet-chunk)
-  with an unrolled KC-deep loop in the kernel.  A grid step per sheet
-  pays ~1 us/step of grid overhead - 2-3x the whole matvec.
-* No ``PrefetchScalarGridSpec``: per-sheet scalars ride in an extra
-  metadata sublane row of the ``vals`` block (``vals[k, h, 0] = ws`` as
-  a float, exact below 2^24; ``ws < 0`` = padding sheet, skipped), read
-  with static indices from VMEM.  Scalar-prefetch operands passed as jit
-  arguments measurably stall the call; keeping the metadata in the value
-  plane also lets ``lane_idx`` be int16 (half the index traffic) when
-  ``h`` is a multiple of the i16 tile height 16.
-* Sheets are padded per block to a uniform ``KG*KC`` so the grid is
-  regular; padded sheets cost DMA but no gather (skipped via
-  ``pl.when``).
+* Grid steps must be *fat*: one grid step per KC-sheet chunk with an
+  unrolled KC-deep loop in the kernel.  A grid step per sheet pays
+  ~1 us/step of grid overhead - 2-3x the whole matvec.
+* The grid is a RAGGED flat chunk list: each block's sheets pad only to
+  a multiple of KC, and a scalar-prefetched ``chunk_blocks`` array maps
+  grid steps to output blocks (revisiting-output accumulation; chunks
+  of one block are consecutive).  The earlier regular (block x kg_max)
+  grid padded every block to the fullest block's sheet count - up to
+  ~2x dead DMA on RCM-banded FEM matrices, and measured 4.7x slower
+  end-to-end at 1M rows.
+* Per-sheet scalars ride in an extra metadata sublane row of the
+  ``vals`` block (``vals[k, h, 0] = ws`` as a float, exact below 2^24;
+  ``ws < 0`` = padding sheet, skipped), read with static indices from
+  VMEM; keeping the metadata in the value plane also lets ``lane_idx``
+  be int16 (half the index traffic) when ``h`` is a multiple of the i16
+  tile height 16.
 """
 from __future__ import annotations
 
@@ -60,6 +63,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
 
@@ -72,39 +76,48 @@ _MAX_X_BYTES = 10 * 2 ** 20
 class ShiftELLData(NamedTuple):
     """Device-ready arrays + static geometry from :func:`pack_shift_ell`.
 
-    ``vals``/``lane_idx`` are regularized to ``NB * KG * KC`` sheets
-    (per-block real sheets first, then ``ws = -1`` padding).
-    ``vals[:, :h]`` are the slot values; ``vals[:, h]`` is the metadata
-    row (lane 0: window start as a float - exact below 2^24 - or -1 for
-    padding).  ``lane_idx`` is int16 when ``h`` is a multiple of 16 (the
-    i16 VMEM tile height; halves index traffic) and int32 otherwise.
+    Sheets are grouped into ragged per-block chunks of ``kc``:
+    ``vals[c, k, :h]`` are slot values of sheet ``k`` of chunk ``c``;
+    ``vals[c, k, h]`` is the metadata row (lane 0: window start as a
+    float - exact below 2^24 - or -1 for a padding sheet).
+    ``chunk_blocks[c]`` is the owning output block (non-decreasing; the
+    kernel's revisiting-output accumulation needs each block's chunks
+    consecutive).  ``lane_idx`` is int16 when ``h`` is a multiple of 16
+    (the i16 VMEM tile height; halves index traffic), int32 otherwise.
     """
 
-    vals: np.ndarray       # (NB*KG*KC, h+1, 128) dtype; 0 = empty slot
-    lane_idx: np.ndarray   # (NB*KG*KC, h, 128) int16 or int32
-    h: int                 # chunk-rows per block
-    kc: int                # sheets per grid step (kernel unroll)
-    kg: int                # grid steps per block along the sheet dim
-    n_sheets: int          # real (pre-padding) sheet count
-    n: int                 # logical matrix dimension
-    nch: int               # ceil(n / 128)
-    nch_pad: int           # nch rounded up to a multiple of h
-    pad: int               # zero chunk-rows added on each side of x
+    vals: np.ndarray          # (n_chunks, kc, h+1, 128); 0 = empty slot
+    lane_idx: np.ndarray      # (n_chunks, kc, h, 128) int16 or int32
+    chunk_blocks: np.ndarray  # (n_chunks,) int32, non-decreasing
+    h: int                    # chunk-rows per block
+    kc: int                   # sheets per grid step (kernel unroll)
+    n_chunks: int             # grid length
+    n_sheets: int             # real (pre-padding) sheet count
+    n: int                    # logical matrix dimension
+    nch: int                  # ceil(n / 128)
+    nch_pad: int              # nch rounded up to a multiple of h
+    pad: int                  # zero chunk-rows added on each side of x
 
 
 def pack_shift_ell(indptr: np.ndarray, indices: np.ndarray,
                    data: np.ndarray, n: int, *, h: int = 16,
-                   kc: int = 8, kg: int | None = None) -> ShiftELLData:
-    """Host-side packer: CSR -> shift-ELL sheets (vectorized numpy).
+                   kc: int = 8,
+                   n_chunks: int | None = None) -> ShiftELLData:
+    """Host-side packer: CSR -> ragged shift-ELL chunks (numpy).
 
     Slots bucket by ``(block, ws)``; a row contributing ``m`` nonzeros
     with the same chunk distance needs ``m`` sheet copies, so each
     block's sheet list is ``{(ws, copy) : copy < max multiplicity(ws)}``.
+    Each block's list pads only to a multiple of ``kc`` (its chunks);
+    chunks from all blocks concatenate into one flat, block-ordered grid
+    - no padding to the fullest block, which cost up to ~2x dead DMA in
+    the earlier regular-grid layout.
 
-    ``kg`` forces the grid-steps-per-block (must be >= the computed
-    minimum) so independently packed matrices can share one kernel shape
-    - the distributed ring schedule stacks one slab per (shard, step)
-    and shard_map needs uniform shapes across shards.
+    ``n_chunks`` forces the total chunk count (must be >= the computed
+    minimum; extra all-padding chunks attach to the last block) so
+    independently packed matrices can share one kernel shape - the
+    distributed ring schedule stacks one slab per (shard, step) and
+    shard_map needs uniform shapes across shards.
     """
     if h < 1 or kc < 1:
         raise ValueError(f"h and kc must be >= 1, got h={h} kc={kc}")
@@ -155,23 +168,30 @@ def pack_shift_ell(indptr: np.ndarray, indices: np.ndarray,
     g_ws = (uniq_keys // max_copy % max_ws).astype(np.int64)
     n_sheets = int(uniq_keys.size)
 
-    # regularize: kg grid steps of kc sheets per block; kg set by the
-    # fullest block.  Padding sheets carry ws = -1 (kernel skips them);
-    # blocks with no nonzeros (padded tails) get only padding sheets, so
-    # ensure kg >= 1 and make each block's first sheet initialize the
-    # output: a padding FIRST sheet must still zero the block, handled in
-    # the kernel by treating (kc_step == 0, k == 0) as init regardless.
+    # ragged chunking: each block's sheets pad only to a multiple of kc
+    # (one chunk = one grid step; the scalar-prefetched chunk_blocks
+    # array maps chunks to output blocks).  Padding sheets carry ws = -1
+    # (kernel skips them); blocks with no nonzeros (padded tails) get one
+    # all-padding chunk so every output block is still initialized.
     per_block = np.bincount(g_block, minlength=nb)
-    kg_min = max(1, -(-int(per_block.max()) // kc))
-    if kg is None:
-        kg = kg_min
-    elif kg < kg_min:
-        raise ValueError(f"kg={kg} < required minimum {kg_min}")
-    slots_per_block = kg * kc
-    g_new = slots_per_block * g_block + (
+    pb_slots = np.maximum(-(-per_block // kc), 1) * kc
+    n_chunks_min = int(pb_slots.sum()) // kc
+    if n_chunks is None:
+        n_chunks = n_chunks_min
+    elif n_chunks < n_chunks_min:
+        raise ValueError(
+            f"n_chunks={n_chunks} < required minimum {n_chunks_min}")
+    block_off = np.concatenate([[0], np.cumsum(pb_slots)[:-1]])
+    g_new = block_off[g_block] + (
         np.arange(n_sheets) - np.concatenate(
             [[0], np.cumsum(per_block)[:-1]])[g_block])
-    total = nb * slots_per_block
+    total = n_chunks * kc
+    chunk_blocks = np.repeat(np.arange(nb, dtype=np.int32),
+                             pb_slots // kc)
+    if chunk_blocks.size < n_chunks:  # forced-uniform padding (distributed)
+        chunk_blocks = np.concatenate(
+            [chunk_blocks,
+             np.full(n_chunks - chunk_blocks.size, nb - 1, np.int32)])
 
     idx_dtype = np.int16 if h % 16 == 0 else np.int32
     vals = np.zeros((total, h + 1, LANES), dtype=data.dtype)
@@ -184,24 +204,28 @@ def pack_shift_ell(indptr: np.ndarray, indices: np.ndarray,
     lane_idx[gs, i_loc, j_pos] = (cols % LANES).astype(idx_dtype)
 
     return ShiftELLData(
-        vals=vals, lane_idx=lane_idx, h=h, kc=kc, kg=kg,
+        vals=vals.reshape(n_chunks, kc, h + 1, LANES),
+        lane_idx=lane_idx.reshape(n_chunks, kc, h, LANES),
+        chunk_blocks=chunk_blocks, h=h, kc=kc, n_chunks=n_chunks,
         n_sheets=n_sheets, n=n, nch=nch, nch_pad=nch_pad, pad=pad)
 
 
 def _make_kernel(h: int, kc: int):
-    def kernel(x_ref, v_ref, l_ref, o_ref):
-        kc_step = pl.program_id(1)
+    def kernel(blk_ref, x_ref, v_ref, l_ref, o_ref):
+        g = pl.program_id(0)
+        first = jnp.logical_or(
+            g == 0, blk_ref[g] != blk_ref[jnp.maximum(g - 1, 0)])
         for k in range(kc):
             # metadata row of the value block: window start (or -1)
-            ws = v_ref[k, h, 0].astype(jnp.int32)
-            is_first = jnp.logical_and(kc_step == 0, k == 0)
+            ws = v_ref[0, k, h, 0].astype(jnp.int32)
+            is_first = jnp.logical_and(first, k == 0)
 
             @pl.when(jnp.logical_and(ws >= 0, jnp.logical_not(is_first)))
             def _():
                 vsrc = x_ref[pl.ds(ws, h), :]
-                g = jnp.take_along_axis(
-                    vsrc, l_ref[k].astype(jnp.int32), axis=1)
-                o_ref[:] = o_ref[:] + v_ref[k, :h] * g
+                gth = jnp.take_along_axis(
+                    vsrc, l_ref[0, k].astype(jnp.int32), axis=1)
+                o_ref[:] = o_ref[:] + v_ref[0, k, :h] * gth
 
             @pl.when(is_first)
             def _():
@@ -209,9 +233,9 @@ def _make_kernel(h: int, kc: int):
                 # first sheets always exist except for all-padding blocks,
                 # whose vals are zero - the multiply still yields zeros)
                 vsrc = x_ref[pl.ds(jnp.maximum(ws, 0), h), :]
-                g = jnp.take_along_axis(
-                    vsrc, l_ref[k].astype(jnp.int32), axis=1)
-                o_ref[:] = v_ref[k, :h] * g
+                gth = jnp.take_along_axis(
+                    vsrc, l_ref[0, k].astype(jnp.int32), axis=1)
+                o_ref[:] = v_ref[0, k, :h] * gth
 
     return kernel
 
@@ -220,17 +244,17 @@ def shift_ell_matvec(
     x: jax.Array,
     vals: jax.Array,
     lane_idx: jax.Array,
+    chunk_blocks: jax.Array,
     *,
     h: int,
     kc: int,
-    kg: int,
     n: int,
     nch: int,
     nch_pad: int,
     pad: int,
     interpret: bool = False,
 ) -> jax.Array:
-    """y = A @ x with A in shift-ELL form (see module docstring).
+    """y = A @ x with A in ragged shift-ELL form (see module docstring).
 
     Inside a ``jax.shard_map`` body (the distributed ring schedule) the
     enclosing shard_map must pass ``check_vma=False``: pallas outputs
@@ -243,25 +267,28 @@ def shift_ell_matvec(
             f"shift-ELL needs x VMEM-resident: {x_bytes/2**20:.1f} MB > "
             f"{_MAX_X_BYTES/2**20:.0f} MB budget (n={n}); shard the solve "
             f"over a mesh or use the csr/ell formats")
-    nb = nch_pad // h
+    n_chunks = vals.shape[0]
     total_rows = nch_pad + 2 * pad
     xp = jnp.zeros((total_rows * LANES,), x.dtype)
     xp = jax.lax.dynamic_update_slice(xp, x, (pad * LANES,))
     x2 = xp.reshape(total_rows, LANES)
 
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((total_rows, LANES), lambda g, b: (0, 0)),
+            pl.BlockSpec((1, kc, h + 1, LANES), lambda g, b: (g, 0, 0, 0)),
+            pl.BlockSpec((1, kc, h, LANES), lambda g, b: (g, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((h, LANES), lambda g, b: (b[g], 0)),
+    )
     y2 = pl.pallas_call(
         _make_kernel(h, kc),
-        grid=(nb, kg),
-        in_specs=[
-            pl.BlockSpec((total_rows, LANES), lambda i, c: (0, 0)),
-            pl.BlockSpec((kc, h + 1, LANES),
-                         lambda i, c: (i * kg + c, 0, 0)),
-            pl.BlockSpec((kc, h, LANES), lambda i, c: (i * kg + c, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((h, LANES), lambda i, c: (i, 0)),
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((nch_pad, LANES), x.dtype),
         interpret=interpret,
-    )(x2, vals, lane_idx)
+    )(chunk_blocks, x2, vals, lane_idx)
     return y2.reshape(-1)[:n]
 
 
@@ -288,8 +315,8 @@ def sheets_per_block(indptr: np.ndarray, indices: np.ndarray, n: int,
     per_block = np.zeros(nb, dtype=np.int64)
     np.add.at(per_block, uniq_bw // span, max_mult)
     # raw counts: empty blocks report 0 real sheets (they are padded with
-    # dummy sheets at pack time, not counted in n_sheets); kg-sizing
-    # callers clamp with max(..., 1) themselves
+    # dummy sheets at pack time, not counted in n_sheets); chunk-count
+    # sizing callers clamp with max(..., 1) themselves
     return per_block
 
 
@@ -308,9 +335,9 @@ def choose_h(indptr: np.ndarray, indices: np.ndarray, n: int, *,
     Measured on v5e (1M-row Poisson and FEM): per-iteration cost tracks
     the number of sheets (each is one DMA'd block + one gather issue),
     not the raw slot volume - larger h amortizes duplicate chunk
-    distances across more rows and strictly reduced sheets up to h=128
-    on both workloads (0.24 -> 0.13 ms/iter Poisson, 5.2 -> 3.0 FEM).
-    i16 lane indices need ``h % 16 == 0``; all candidates comply.
+    distances across more rows.  With the ragged chunk layout the cost
+    is the sum of per-block kc-rounded sheet counts.  i16 lane indices
+    need ``h % 16 == 0``; all candidates comply.
 
     Candidates whose padded x (``nch_pad + 2h`` chunk-rows at
     ``itemsize``) would blow the VMEM budget are skipped - larger h pads
@@ -323,8 +350,7 @@ def choose_h(indptr: np.ndarray, indices: np.ndarray, n: int, *,
         if (nch_pad + 2 * h) * LANES * itemsize > _MAX_X_BYTES:
             continue
         per_block = sheets_per_block(indptr, indices, n, h=h)
-        kg = -(-max(int(per_block.max()), 1) // kc)
-        cost = per_block.size * kg * kc
+        cost = int((np.maximum(-(-per_block // kc), 1) * kc).sum())
         if best_cost is None or cost < best_cost:
             best_h, best_cost = h, cost
     if best_h is None:

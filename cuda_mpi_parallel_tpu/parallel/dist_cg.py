@@ -327,31 +327,35 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
     """Ring schedule with pallas shift-ELL slabs (``DistShiftELLRing``)."""
     parts = part.ring_partition_shiftell(a, n_shards)
     b_dev = _shard_padded_rhs(b, parts, mesh, axis)
-    vals = _shard_tree(parts.vals, mesh, axis)  # per-step (n_shards, G, ..)
+    vals = _shard_tree(parts.vals, mesh, axis)  # per-step (n_shards, C, ..)
     meta = _shard_tree(parts.lane_idx, mesh, axis)
+    blks = _shard_tree(parts.chunk_blocks, mesh, axis)
     diag = shard_vector(jnp.asarray(parts.diag.reshape(-1)), mesh, axis)
 
     n_local = parts.n_local
-    key = ("csr-shiftell", n_local, n_shards, parts.h, parts.kc, parts.kg,
-           axis, mesh, precond, record_history, tuple(sorted(kw.items())))
+    chunk_shape = tuple(v.shape[1] for v in parts.vals)
+    key = ("csr-shiftell", n_local, n_shards, parts.h, parts.kc,
+           chunk_shape, axis, mesh, precond, record_history,
+           tuple(sorted(kw.items())))
 
     def build():
         # check_vma=False: the pallas slab kernel cannot declare varying
         # mesh axes on its outputs (see shift_ell_matvec docstring)
         @partial(jax.shard_map, mesh=mesh, check_vma=False,
-                 in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                 in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
                  out_specs=_result_specs(axis, record_history))
-        def run(b_local, vals_s, meta_s, diag_s):
+        def run(b_local, vals_s, meta_s, blk_s, diag_s):
             _TRACE_COUNT[0] += 1
             strip = partial(jax.tree.map, lambda v: v[0])
             op = DistShiftELLRing(
-                vals=strip(vals_s), lane_idx=strip(meta_s), diag=diag_s,
-                h=parts.h, kc=parts.kc, kg=parts.kg, n_local=n_local,
+                vals=strip(vals_s), lane_idx=strip(meta_s),
+                chunk_blocks=strip(blk_s), diag=diag_s,
+                h=parts.h, kc=parts.kc, n_local=n_local,
                 axis_name=axis, n_shards=n_shards)
             m = _make_precond(precond, op, axis)
             return cg(op, b_local, m=m, record_history=record_history,
                       axis_name=axis, **kw)
         return run
 
-    res = _cached_solver(key, build)(b_dev, vals, meta, diag)
+    res = _cached_solver(key, build)(b_dev, vals, meta, blks, diag)
     return _strip_row_padding(res, parts)

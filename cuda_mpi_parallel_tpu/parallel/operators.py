@@ -383,8 +383,8 @@ class DistCSRRing(LinearOperator):
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=("vals", "lane_idx", "diag"),
-    meta_fields=("h", "kc", "kg", "n_local", "axis_name", "n_shards"),
+    data_fields=("vals", "lane_idx", "chunk_blocks", "diag"),
+    meta_fields=("h", "kc", "n_local", "axis_name", "n_shards"),
 )
 @dataclasses.dataclass(frozen=True)
 class DistShiftELLRing(LinearOperator):
@@ -399,12 +399,12 @@ class DistShiftELLRing(LinearOperator):
     mesh.  Built by ``partition.ring_partition_shiftell``.
     """
 
-    vals: Tuple[jax.Array, ...]      # per step: (G_t, h+1, 128)
-    lane_idx: Tuple[jax.Array, ...]  # per step: (G_t, h, 128) i16/i32
+    vals: Tuple[jax.Array, ...]          # per step: (C_t, kc, h+1, 128)
+    lane_idx: Tuple[jax.Array, ...]      # per step: (C_t, kc, h, 128)
+    chunk_blocks: Tuple[jax.Array, ...]  # per step: (C_t,) i32
     diag: jax.Array                   # (n_local,)
     h: int
     kc: int
-    kg: Tuple[int, ...]               # per step
     n_local: int
     axis_name: str
     n_shards: int
@@ -430,9 +430,9 @@ class DistShiftELLRing(LinearOperator):
         xb = x
         for t in range(n):  # static unroll: n is a mesh constant
             y = y + pk.shift_ell_matvec(
-                xb, self.vals[t], self.lane_idx[t], h=self.h, kc=self.kc,
-                kg=self.kg[t], n=self.n_local, nch=nch, nch_pad=nch_pad,
-                pad=self.h, interpret=interpret)
+                xb, self.vals[t], self.lane_idx[t], self.chunk_blocks[t],
+                h=self.h, kc=self.kc, n=self.n_local, nch=nch,
+                nch_pad=nch_pad, pad=self.h, interpret=interpret)
             if t + 1 < n:
                 xb = lax.ppermute(xb, self.axis_name, perm=ring)
         return y

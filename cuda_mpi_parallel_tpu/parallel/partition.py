@@ -166,18 +166,18 @@ class RingPartitionedShiftELL(NamedTuple):
     (owner, step), owner ``i``'s step-``t`` slab couples to column block
     ``(i + t) % n_shards``), but each slab's local SpMV is the
     ``ops.pallas.spmv`` lane-gather kernel instead of the XLA gather:
-    ``vals[t]``/``lane_idx[t]`` have shape ``(n_shards, G_t, h(+1), 128)``
-    with per-step-uniform sheet counts across owners (shard_map needs
-    identical shapes per device; ``pack_shift_ell(kg=...)`` forces the
-    shared grid geometry).
+    ``vals[t]``/``lane_idx[t]`` have shape ``(n_shards, C_t, kc, ., 128)``
+    with per-step-uniform chunk counts across owners (shard_map needs
+    identical shapes per device; ``pack_shift_ell(n_chunks=...)`` forces
+    the shared grid geometry).
     """
 
     vals: Tuple[np.ndarray, ...]
     lane_idx: Tuple[np.ndarray, ...]
+    chunk_blocks: Tuple[np.ndarray, ...]  # per step: (n_shards, C_t) i32
     diag: np.ndarray            # (n_shards, n_local) - Jacobi's input
     h: int
     kc: int
-    kg: Tuple[int, ...]         # per step
     n_local: int
     n_global_padded: int
     n_global: int
@@ -217,26 +217,29 @@ def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *,
         h = pk.choose_h(slab00[0], slab00[1], n_local, kc=kc,
                         itemsize=np.asarray(a.data).dtype.itemsize)
 
-    vals_steps, meta_steps, kg_steps = [], [], []
+    vals_steps, meta_steps, blk_steps = [], [], []
     for t in range(n_shards):
         slabs = [slab00 if (t, s) == (0, 0) else slab_csr(t, s)
                  for s in range(n_shards)]
-        kg_t = max(
-            -(-max(int(pk.sheets_per_block(ip, ix, n_local,
-                                           h=h).max()), 1) // kc)
+        c_t = max(
+            int(np.maximum(
+                -(-pk.sheets_per_block(ip, ix, n_local, h=h) // kc),
+                1).sum())
             for ip, ix, _ in slabs)
-        packed = [pk.pack_shift_ell(*slab, n_local, h=h, kc=kc, kg=kg_t)
+        packed = [pk.pack_shift_ell(*slab, n_local, h=h, kc=kc,
+                                    n_chunks=c_t)
                   for slab in slabs]
         vals_steps.append(np.stack([p.vals for p in packed]))
         meta_steps.append(np.stack([p.lane_idx for p in packed]))
-        kg_steps.append(kg_t)
+        blk_steps.append(np.stack([p.chunk_blocks for p in packed]))
 
     diag = np.zeros(ring.n_global_padded, dtype=np.asarray(a.data).dtype)
     diag[: ring.n_global] = np.asarray(a.diagonal())
     diag[ring.n_global:] = 1.0  # unit-diagonal padding rows
     return RingPartitionedShiftELL(
         vals=tuple(vals_steps), lane_idx=tuple(meta_steps),
+        chunk_blocks=tuple(blk_steps),
         diag=diag.reshape(n_shards, n_local), h=h, kc=kc,
-        kg=tuple(kg_steps), n_local=n_local,
+        n_local=n_local,
         n_global_padded=ring.n_global_padded, n_global=ring.n_global,
         n_shards=n_shards)

@@ -225,7 +225,8 @@ def _solve(op, b_df, tol2, rtol2, *, maxiter, record_history, jacobi,
     if axis_name is not None:
         # fresh zeros are unvarying; the while_loop carry must match the
         # body's output (device-varying) under shard_map's vma tracking
-        x0 = tuple(lax.pvary(v, (axis_name,)) for v in x0)
+        x0 = tuple(lax.pcast(v, axis_name, to="varying")
+                   for v in x0)
     r0 = b_df     # x0 = 0 fast path (CUDACG.cu:247-259)
     z0 = df.div(r0, d) if jacobi else r0
     p0 = z0

@@ -20,15 +20,18 @@ class TestRingPartitionShiftELL:
         parts = part.ring_partition_shiftell(a, 4, h=2, kc=4)
         assert len(parts.vals) == 4
         for t in range(4):
-            n_owners, g, h1, lanes = parts.vals[t].shape
-            assert (n_owners, h1, lanes) == (4, parts.h + 1, 128)
-            assert parts.lane_idx[t].shape == (4, g, parts.h, 128)
+            n_owners, c, kc, h1, lanes = parts.vals[t].shape
+            assert (n_owners, kc, h1, lanes) == (4, parts.kc,
+                                                 parts.h + 1, 128)
+            assert parts.lane_idx[t].shape == (4, c, parts.kc, parts.h, 128)
+            assert parts.chunk_blocks[t].shape == (4, c)
 
     def test_slab_values_conserved(self):
         """Total stored value mass across all slabs == matrix total."""
         a = poisson.poisson_2d_csr(24, 24)
         parts = part.ring_partition_shiftell(a, 4, h=2)
-        total = sum(float(v[:, :, :parts.h, :].sum()) for v in parts.vals)
+        total = sum(float(v[:, :, :, :parts.h, :].sum())
+                    for v in parts.vals)
         # padding rows add unit diagonals for rows beyond n
         n_pad_rows = parts.n_global_padded - parts.n_global
         np.testing.assert_allclose(

@@ -32,27 +32,33 @@ class TestPacking:
         packed = pk.pack_shift_ell(np.asarray(a.indptr),
                                    np.asarray(a.indices),
                                    np.asarray(a.data), a.shape[0], h=4)
-        assert packed.lane_idx.shape == (packed.vals.shape[0],
+        assert packed.lane_idx.shape == (packed.n_chunks, packed.kc,
                                          packed.h, 128)
-        assert packed.vals.shape[1] == packed.h + 1
+        assert packed.vals.shape == (packed.n_chunks, packed.kc,
+                                     packed.h + 1, 128)
         # sum of all slot values == sum of all matrix values (0-padding)
-        slot_vals = packed.vals[:, :packed.h, :]
+        slot_vals = packed.vals[:, :, :packed.h, :]
         np.testing.assert_allclose(slot_vals.sum(),
                                    np.asarray(a.data).sum(), rtol=1e-12)
         nonzero_slots = np.count_nonzero(slot_vals)
         assert nonzero_slots == np.count_nonzero(np.asarray(a.data))
 
-    def test_padding_sheets_marked_and_regular(self, rng):
+    def test_padding_sheets_marked_and_ragged(self, rng):
         a = random_fem_2d(400, seed=3)
         packed = pk.pack_shift_ell(np.asarray(a.indptr),
                                    np.asarray(a.indices),
                                    np.asarray(a.data), a.shape[0], h=2,
                                    kc=4)
+        assert packed.vals.shape == (packed.n_chunks, packed.kc,
+                                     packed.h + 1, 128)
+        # ragged layout: chunks ordered by block, every block present
+        blocks = packed.chunk_blocks
         nb = packed.nch_pad // packed.h
-        assert packed.vals.shape[0] == nb * packed.kg * packed.kc
-        ws = packed.vals[:, packed.h, 0]
+        assert np.all(np.diff(blocks) >= 0)
+        assert set(np.unique(blocks)) == set(range(nb))
+        ws = packed.vals[:, :, packed.h, 0]
         # padding sheets carry ws = -1 and zero values
-        assert np.all(packed.vals[ws < 0, :packed.h, :] == 0)
+        assert np.all(packed.vals[ws < 0][:, :packed.h, :] == 0)
         # real sheet count matches the cost model
         assert int((ws >= 0).sum()) == packed.n_sheets
 
