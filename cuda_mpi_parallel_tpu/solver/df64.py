@@ -43,7 +43,6 @@ from jax import lax
 from ..models.operators import (
     CSRMatrix,
     ELLMatrix,
-    LinearOperator,
     Stencil2D,
     Stencil3D,
 )

@@ -6,7 +6,7 @@ CSR path is slow.  Two fast layouts replace it after RCM reordering:
 * DIA - gather-free shifted FMAs, for matrices whose RCM band is a
   handful of diagonals;
 * shift-ELL - the pallas lane-gather kernel (`ops/pallas/spmv.py`),
-  for ANY sparsity: 76 us/CG-iteration at 1M rows (~1000x over csr).
+  for ANY sparsity: ~100 us/CG-iteration at 1M rows (~800x over csr).
 
 Run: python examples/04_general_sparse.py
 """

@@ -159,6 +159,20 @@ class TestMatvecParity:
             big @ jnp.zeros(6_000_000)
 
 
+class TestRobustness:
+    def test_solve_under_debug_nans(self, rng):
+        """The kernel's skipped padding sheets gather from index 0 with
+        zero values; jax_debug_nans must see no NaN anywhere."""
+        import jax
+
+        a = random_fem_2d(500, seed=9)
+        sell = a.to_shiftell(h=4)
+        b = sell @ jnp.asarray(rng.standard_normal(500))
+        with jax.debug_nans(True):
+            r = solve(sell, b, tol=0.0, rtol=1e-8, maxiter=3000)
+        assert bool(r.converged)
+
+
 class TestCG:
     def test_cg_trajectory_matches_csr(self, rng):
         """Same matrix, same b: shift-ELL CG must converge to the same
