@@ -159,6 +159,35 @@ class TestMatvecParity:
             big @ jnp.zeros(6_000_000)
 
 
+class TestFuzz:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_sparsity_parity_vs_scipy(self, seed):
+        """Random sparsity patterns (including empty rows, a dense row,
+        and a hot column) must pack and multiply exactly."""
+        import scipy.sparse as sp
+
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(50, 700))
+        density = float(rng.uniform(0.002, 0.05))
+        m = sp.random(n, n, density=density, random_state=seed,
+                      format="lil")
+        m[0, :] = rng.standard_normal(n)        # dense row
+        m[:, n // 2] = rng.standard_normal(n)[:, None]  # hot column
+        m[n - 1, :] = 0.0                       # empty row
+        m = sp.csr_matrix(m)
+        m.eliminate_zeros()
+        from cuda_mpi_parallel_tpu.models.operators import CSRMatrix
+
+        a = CSRMatrix.from_scipy(m)
+        h = int(rng.choice([1, 2, 4, 8]))
+        sell = a.to_shiftell(h=h)
+        assert sell.n_sheets >= 1
+        x = rng.standard_normal(n)
+        want = m @ x
+        got = np.asarray(sell @ jnp.asarray(x))
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
+
+
 class TestRobustness:
     def test_solve_under_debug_nans(self, rng):
         """The kernel's skipped padding sheets gather from index 0 with
