@@ -29,7 +29,7 @@ from .models.operators import (
     Stencil3D,
 )
 from .solver.cg import CGCheckpoint, CGResult, cg, solve
-from .solver.df64 import DF64CGResult, cg_df64
+from .solver.df64 import DF64CGResult, DF64Checkpoint, cg_df64
 from .solver.status import CGStatus
 
 __version__ = "0.1.0"
@@ -40,6 +40,7 @@ __all__ = [
     "CGStatus",
     "CSRMatrix",
     "DF64CGResult",
+    "DF64Checkpoint",
     "DenseOperator",
     "ELLMatrix",
     "IdentityOperator",

@@ -2,7 +2,7 @@
 ``CUDACG.cu:269-352``)."""
 
 from .cg import CGCheckpoint, CGResult, cg, solve
-from .df64 import DF64CGResult, cg_df64
+from .df64 import DF64CGResult, DF64Checkpoint, cg_df64
 from .status import CGStatus
 
 __all__ = ["CGCheckpoint", "CGResult", "CGStatus", "DF64CGResult",

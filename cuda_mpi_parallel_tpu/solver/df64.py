@@ -52,9 +52,38 @@ from .status import CGStatus
 
 @partial(
     jax.tree_util.register_dataclass,
+    data_fields=("x_hi", "x_lo", "r_hi", "r_lo", "p_hi", "p_lo",
+                 "rho_hi", "rho_lo", "rr_hi", "rr_lo", "rr0_hi", "rr0_lo",
+                 "k", "indefinite"),
+    meta_fields=(),
+)
+@dataclasses.dataclass(frozen=True)
+class DF64Checkpoint:
+    """Complete df64 CG recurrence state: resuming continues the exact
+    trajectory (mirror of ``cg.CGCheckpoint`` for the double-float
+    solver; the rr0 pair preserves the original rtol threshold)."""
+
+    x_hi: jax.Array
+    x_lo: jax.Array
+    r_hi: jax.Array
+    r_lo: jax.Array
+    p_hi: jax.Array
+    p_lo: jax.Array
+    rho_hi: jax.Array
+    rho_lo: jax.Array
+    rr_hi: jax.Array
+    rr_lo: jax.Array
+    rr0_hi: jax.Array
+    rr0_lo: jax.Array
+    k: jax.Array
+    indefinite: jax.Array
+
+
+@partial(
+    jax.tree_util.register_dataclass,
     data_fields=("x_hi", "x_lo", "iterations", "residual_norm_sq_hi",
                  "residual_norm_sq_lo", "converged", "status", "indefinite",
-                 "residual_history"),
+                 "residual_history", "checkpoint"),
     meta_fields=(),
 )
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +103,7 @@ class DF64CGResult:
     status: jax.Array
     indefinite: jax.Array
     residual_history: Optional[jax.Array]  # (maxiter+1,) ||r||^2 hi, or None
+    checkpoint: Optional[DF64Checkpoint] = None  # set when return_checkpoint
 
     def x(self) -> np.ndarray:
         return df.to_f64(self.x_hi, self.x_lo)
@@ -179,6 +209,8 @@ def cg_df64(
     record_history: bool = False,
     preconditioner: Optional[str] = None,
     axis_name: Optional[str] = None,
+    resume_from: Optional[DF64Checkpoint] = None,
+    return_checkpoint: bool = False,
 ) -> DF64CGResult:
     """CG with df64 storage (see module docstring).
 
@@ -186,6 +218,9 @@ def cg_df64(
     or any f32/f64 array-like.  ``preconditioner``: ``None`` (plain CG,
     the reference's configuration) or ``"jacobi"`` (diag(A)^-1 applied
     in df64 - BASELINE config #3 at f64-class precision).
+    ``resume_from``/``return_checkpoint`` mirror ``solve``'s
+    checkpointing: ``maxiter`` remains the TOTAL iteration cap, and the
+    resumed run continues the exact df64 trajectory.
     """
     if preconditioner not in (None, "jacobi"):
         raise ValueError(
@@ -207,37 +242,52 @@ def cg_df64(
     rtol2 = df.const(float(rtol) ** 2)
     jacobi = preconditioner == "jacobi"
     if axis_name is None:
-        return _solve_jit(op, b_df, tol2, rtol2, maxiter=maxiter,
-                          record_history=record_history, jacobi=jacobi,
-                          axis_name=None)
-    return _solve(op, b_df, tol2, rtol2, maxiter=maxiter,
+        return _solve_jit(op, b_df, tol2, rtol2, resume_from,
+                          maxiter=maxiter, record_history=record_history,
+                          jacobi=jacobi, axis_name=None,
+                          return_checkpoint=return_checkpoint)
+    return _solve(op, b_df, tol2, rtol2, resume_from, maxiter=maxiter,
                   record_history=record_history, jacobi=jacobi,
-                  axis_name=axis_name)
+                  axis_name=axis_name, return_checkpoint=return_checkpoint)
 
 
-def _solve(op, b_df, tol2, rtol2, *, maxiter, record_history, jacobi,
-           axis_name):
+def _solve(op, b_df, tol2, rtol2, resume, *, maxiter, record_history,
+           jacobi, axis_name, return_checkpoint=False):
     n = b_df[0].shape[0]
     hist_len = maxiter + 1 if record_history else 0
     d = (op.diag_hi, op.diag_lo)
-    x0 = (jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32))
-    if axis_name is not None:
-        # fresh zeros are unvarying; the while_loop carry must match the
-        # body's output (device-varying) under shard_map's vma tracking
-        x0 = tuple(lax.pcast(v, axis_name, to="varying")
-                   for v in x0)
-    r0 = b_df     # x0 = 0 fast path (CUDACG.cu:247-259)
-    z0 = df.div(r0, d) if jacobi else r0
-    p0 = z0
-    rr0 = df.dot(r0, r0, axis_name=axis_name)
-    rho0 = df.dot(r0, z0, axis_name=axis_name) if jacobi else rr0
-    # threshold^2 = max(tol^2, rtol^2 * ||r0||^2) as a df64 pair
-    rt = df.mul(rtol2, rr0)
+    if resume is not None:
+        x0 = (resume.x_hi, resume.x_lo)
+        r0 = (resume.r_hi, resume.r_lo)
+        p0 = (resume.p_hi, resume.p_lo)
+        rho0 = (resume.rho_hi, resume.rho_lo)
+        rr0 = (resume.rr_hi, resume.rr_lo)
+        rr_base = (resume.rr0_hi, resume.rr0_lo)
+        k0 = resume.k
+        indef0 = resume.indefinite
+    else:
+        x0 = (jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32))
+        if axis_name is not None:
+            # fresh zeros are unvarying; the while_loop carry must match
+            # the body's output (device-varying) under vma tracking
+            x0 = tuple(lax.pcast(v, axis_name, to="varying")
+                       for v in x0)
+        r0 = b_df     # x0 = 0 fast path (CUDACG.cu:247-259)
+        z0 = df.div(r0, d) if jacobi else r0
+        p0 = z0
+        rr0 = df.dot(r0, r0, axis_name=axis_name)
+        rho0 = df.dot(r0, z0, axis_name=axis_name) if jacobi else rr0
+        rr_base = rr0
+        k0 = jnp.zeros((), jnp.int32)
+        indef0 = jnp.zeros((), bool)
+    # threshold^2 = max(tol^2, rtol^2 * ||r0||^2) as a df64 pair, with
+    # the ORIGINAL solve's rr0 under resume
+    rt = df.mul(rtol2, rr_base)
     thr = (jnp.maximum(tol2[0], rt[0]),
            jnp.where(tol2[0] >= rt[0], tol2[1], rt[1]))
     history0 = jnp.zeros(hist_len, jnp.float32)
     if record_history:
-        history0 = history0.at[0].set(rr0[0])
+        history0 = history0.at[k0].set(rr0[0])
 
     def cond(s: _State):
         return jnp.logical_and(
@@ -270,8 +320,8 @@ def _solve(op, b_df, tol2, rtol2, *, maxiter, record_history, jacobi,
             indefinite=jnp.logical_or(s.indefinite, pap[0] <= 0.0),
             finite=finite, history=history)
 
-    s0 = _State(k=jnp.zeros((), jnp.int32), x=x0, r=r0, p=p0, rho=rho0,
-                rr=rr0, indefinite=jnp.zeros((), bool),
+    s0 = _State(k=k0, x=x0, r=r0, p=p0, rho=rho0,
+                rr=rr0, indefinite=indef0,
                 finite=jnp.isfinite(rho0[0]),
                 history=history0)
     s = lax.while_loop(cond, body, s0)
@@ -280,12 +330,21 @@ def _solve(op, b_df, tol2, rtol2, *, maxiter, record_history, jacobi,
         jnp.logical_not(s.finite), CGStatus.BREAKDOWN.value,
         jnp.where(converged, CGStatus.CONVERGED.value,
                   CGStatus.MAXITER.value))
+    checkpoint = None
+    if return_checkpoint:
+        checkpoint = DF64Checkpoint(
+            x_hi=s.x[0], x_lo=s.x[1], r_hi=s.r[0], r_lo=s.r[1],
+            p_hi=s.p[0], p_lo=s.p[1], rho_hi=s.rho[0], rho_lo=s.rho[1],
+            rr_hi=s.rr[0], rr_lo=s.rr[1], rr0_hi=rr_base[0],
+            rr0_lo=rr_base[1], k=s.k, indefinite=s.indefinite)
     return DF64CGResult(
         x_hi=s.x[0], x_lo=s.x[1], iterations=s.k,
         residual_norm_sq_hi=s.rr[0], residual_norm_sq_lo=s.rr[1],
         converged=converged, status=status, indefinite=s.indefinite,
-        residual_history=s.history if record_history else None)
+        residual_history=s.history if record_history else None,
+        checkpoint=checkpoint)
 
 
 _solve_jit = jax.jit(_solve, static_argnames=("maxiter", "record_history",
-                                              "jacobi", "axis_name"))
+                                              "jacobi", "axis_name",
+                                              "return_checkpoint"))

@@ -255,9 +255,9 @@ class TestCGParity:
                 cols=ell.cols, scale_hi=zero, scale_lo=zero,
                 diag_hi=jnp.asarray(dh), diag_lo=jnp.asarray(dl),
                 kind="ell", grid=())
-            return sdf._solve(op, (bh_l, bl_l), tol2, rtol2, maxiter=2000,
-                              record_history=False, jacobi=False,
-                              axis_name=axis)
+            return sdf._solve(op, (bh_l, bl_l), tol2, rtol2, None,
+                              maxiter=2000, record_history=False,
+                              jacobi=False, axis_name=axis)
 
         r_dist = run(jnp.asarray(bh), jnp.asarray(bl))
 
@@ -271,6 +271,38 @@ class TestCGParity:
         np.testing.assert_allclose(
             df.to_f64(r_dist.x_hi, r_dist.x_lo), r_one.x(), rtol=1e-12,
             atol=1e-13)
+
+    def test_checkpoint_resume_exact_trajectory(self, rng):
+        """Segmented df64 solve == uninterrupted: same iteration count
+        and bitwise-identical solution pairs (mirror of the f32 solver's
+        checkpoint guarantee)."""
+        a = poisson.poisson_2d_csr(24, 24)
+        x_true = rng.standard_normal(576)
+        b = np.asarray(a @ jnp.asarray(x_true), dtype=np.float64)
+        full = cg_df64(a, b, tol=0.0, rtol=1e-10, maxiter=2000)
+        part = cg_df64(a, b, tol=0.0, rtol=1e-10, maxiter=30,
+                       return_checkpoint=True)
+        assert int(part.iterations) == 30
+        resumed = cg_df64(a, b, tol=0.0, rtol=1e-10, maxiter=2000,
+                          resume_from=part.checkpoint)
+        assert int(resumed.iterations) == int(full.iterations)
+        np.testing.assert_array_equal(np.asarray(resumed.x_hi),
+                                      np.asarray(full.x_hi))
+        np.testing.assert_array_equal(np.asarray(resumed.x_lo),
+                                      np.asarray(full.x_lo))
+
+    def test_resume_rtol_uses_original_rr0(self, rng):
+        """The rtol threshold must reference the ORIGINAL rhs norm, not
+        the (smaller) residual at the checkpoint."""
+        a = poisson.poisson_2d_csr(16, 16)
+        b = np.asarray(a @ jnp.asarray(rng.standard_normal(256)),
+                       dtype=np.float64)
+        part = cg_df64(a, b, tol=0.0, rtol=1e-8, maxiter=20,
+                       return_checkpoint=True)
+        resumed = cg_df64(a, b, tol=0.0, rtol=1e-8, maxiter=2000,
+                          resume_from=part.checkpoint)
+        full = cg_df64(a, b, tol=0.0, rtol=1e-8, maxiter=2000)
+        assert int(resumed.iterations) == int(full.iterations)
 
     def test_final_residual_reaches_f64_levels(self, rng):
         """Drive to rtol 1e-13: unreachable for f32 storage, routine for
