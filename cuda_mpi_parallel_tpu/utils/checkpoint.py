@@ -89,7 +89,50 @@ def _checkpoint_from_mapping(z, path: str,
 def load_checkpoint(path: str,
                     expect_fingerprint: str = "") -> CGCheckpoint:
     with np.load(path) as z:
+        if "kind" in z and str(z["kind"]) == "df64":
+            raise ValueError(
+                f"checkpoint {path} is a df64 checkpoint; load it with "
+                f"load_checkpoint_df64 and resume with cg_df64")
         return _checkpoint_from_mapping(z, path, expect_fingerprint)
+
+
+def save_checkpoint_df64(path: str, ckpt, fingerprint: str = "") -> None:
+    """Persist a ``DF64Checkpoint`` (atomic npz; schema mirrors
+    ``save_checkpoint`` with the double-float state pairs)."""
+    import dataclasses as _dc
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    fields = {f.name: np.asarray(getattr(ckpt, f.name))
+              for f in _dc.fields(type(ckpt))}
+    np.savez(tmp, version=_FORMAT_VERSION, fingerprint=fingerprint,
+             kind="df64", **fields)
+    os.replace(tmp + ".npz", path)
+
+
+def load_checkpoint_df64(path: str, expect_fingerprint: str = ""):
+    import dataclasses as _dc
+
+    from ..solver.df64 import DF64Checkpoint
+
+    with np.load(path) as z:
+        version = int(np.asarray(z["version"]))
+        if version != _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint {path} has format version {version}, "
+                f"expected {_FORMAT_VERSION}")
+        if "kind" not in z or str(z["kind"]) != "df64":
+            raise ValueError(
+                f"checkpoint {path} is not a df64 checkpoint; load it "
+                f"with load_checkpoint and resume with solve")
+        stored = str(z["fingerprint"]) if "fingerprint" in z else ""
+        if expect_fingerprint and stored and stored != expect_fingerprint:
+            raise ValueError(
+                f"checkpoint {path} belongs to a different problem "
+                f"(fingerprint {stored} != {expect_fingerprint}); "
+                f"refusing to resume - delete it to start fresh")
+        return DF64Checkpoint(**{
+            f.name: jnp.asarray(z[f.name])
+            for f in _dc.fields(DF64Checkpoint)})
 
 
 def _ckpt_tree(ckpt: CGCheckpoint, fingerprint: str) -> dict:

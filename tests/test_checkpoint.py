@@ -156,3 +156,51 @@ class TestDiskRoundtrip:
         assert int(res2.iterations) == int(full.iterations)
         np.testing.assert_allclose(np.asarray(res2.x), np.asarray(full.x),
                                    rtol=1e-13, atol=1e-13)
+
+
+class TestDF64DiskRoundtrip:
+    def test_save_load_resume(self, tmp_path, rng):
+        import numpy as np
+
+        from cuda_mpi_parallel_tpu import cg_df64
+        from cuda_mpi_parallel_tpu.models import poisson
+        from cuda_mpi_parallel_tpu.utils.checkpoint import (
+            load_checkpoint,
+            load_checkpoint_df64,
+            problem_fingerprint,
+            save_checkpoint_df64,
+        )
+
+        a = poisson.poisson_2d_csr(16, 16)
+        import jax.numpy as jnp
+
+        b = np.asarray(a @ jnp.asarray(rng.standard_normal(256)),
+                       dtype=np.float64)
+        part = cg_df64(a, b, tol=0.0, rtol=1e-10, maxiter=20,
+                       return_checkpoint=True)
+        fp = problem_fingerprint(a, b)
+        path = str(tmp_path / "df64.npz")
+        save_checkpoint_df64(path, part.checkpoint, fp)
+        ck = load_checkpoint_df64(path, expect_fingerprint=fp)
+        resumed = cg_df64(a, b, tol=0.0, rtol=1e-10, maxiter=2000,
+                          resume_from=ck)
+        full = cg_df64(a, b, tol=0.0, rtol=1e-10, maxiter=2000)
+        assert int(resumed.iterations) == int(full.iterations)
+        np.testing.assert_array_equal(np.asarray(resumed.x_hi),
+                                      np.asarray(full.x_hi))
+        # kind mismatch is loud in both directions
+        import pytest
+
+        with pytest.raises(ValueError, match="df64"):
+            load_checkpoint(path)
+        with pytest.raises(ValueError, match="not a df64"):
+            save_dir = str(tmp_path / "f32.npz")
+            from cuda_mpi_parallel_tpu import solve
+            from cuda_mpi_parallel_tpu.utils.checkpoint import (
+                save_checkpoint,
+            )
+
+            r32 = solve(a, jnp.asarray(b), tol=0.0, rtol=1e-8, maxiter=10,
+                        return_checkpoint=True)
+            save_checkpoint(save_dir, r32.checkpoint, fp)
+            load_checkpoint_df64(save_dir)
