@@ -354,6 +354,24 @@ def main(argv=None) -> int:
                     help="run every BASELINE config, write bench_results.json")
     args = ap.parse_args(argv)
 
+    # Watchdog: the tunneled TPU backend can wedge at connect time (seen
+    # as an indefinite hang inside backend init).  Emit a diagnosable
+    # record instead of hanging the harness forever.
+    import os
+    import signal
+
+    def _timeout(signum, frame):
+        print(json.dumps({
+            "metric": "cg_iters_per_sec_poisson2d_1M_f32", "value": 0.0,
+            "unit": "iters/s", "vs_baseline": 0.0,
+            "error": "bench watchdog: device unreachable or run exceeded "
+                     "45 min (tunnel outage?)"}))
+        sys.stdout.flush()
+        os._exit(1)
+
+    signal.signal(signal.SIGALRM, _timeout)
+    signal.alarm(2700)
+
     if args.all:
         results = bench_all()
         with open("bench_results.json", "w") as f:
