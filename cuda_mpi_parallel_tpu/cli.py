@@ -81,8 +81,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(matching the all-f64 reference, CUDACG.cu:216). "
                         "df64 = double-float (hi,lo) f32 pairs: ~f64 "
                         "precision on real TPU hardware (solver.df64; "
-                        "plain or Jacobi-PCG, csr/ell/matrix-free "
-                        "problems, single device)")
+                        "plain or Jacobi-PCG, csr/ell/shiftell/"
+                        "matrix-free problems, single device)")
     p.add_argument("--matrix-free", action="store_true",
                    help="use the matrix-free stencil operator for poisson* "
                         "(default: assembled CSR)")
@@ -237,6 +237,34 @@ def main(argv=None) -> int:
                 "--csr-comm applies to assembled-CSR problems only "
                 "(stencils use halo exchange)")
 
+    # df64 compatibility checks run BEFORE the format conversion below:
+    # a doomed combination must fail fast, not after seconds of host-side
+    # shift-ELL packing at 1M rows.
+    if args.df64:
+        from .models.operators import (
+            CSRMatrix as _CSR,
+            ELLMatrix as _ELL,
+            Stencil2D as _S2,
+            Stencil3D as _S3,
+        )
+
+        bad = None
+        if args.mesh > 1 and not isinstance(a, (_S2, _S3)):
+            bad = ("--mesh > 1 with assembled operators (distributed "
+                   "df64 is matrix-free stencil only; add --matrix-free)")
+        elif args.precond not in (None, "jacobi"):
+            bad = f"--precond {args.precond} (None or jacobi only)"
+        elif args.fmt == "dia":
+            bad = "--format dia (csr/ell/shiftell/matrix-free only)"
+        elif args.method != "cg":
+            bad = f"--method {args.method} (textbook recurrence only)"
+        elif not isinstance(a, (_CSR, _ELL, _S2, _S3)):
+            bad = (f"{type(a).__name__} operators (dense df64 would need "
+                   f"error-free MXU accumulation)")
+        if bad:
+            raise SystemExit(f"--dtype df64 does not support {bad}")
+        desc += " [df64]"
+
     if args.fmt != "csr":
         from .models.operators import CSRMatrix
 
@@ -247,48 +275,37 @@ def main(argv=None) -> int:
         if args.mesh > 1:
             raise SystemExit(f"--format {args.fmt} is single-device only "
                              f"(distributed CSR uses its own partition)")
+        # df64 + shiftell packs the double-float (hi, lo) sheet planes
+        # for the pallas df64 lane-gather kernel
+        conv = {"dia": a.to_dia, "ell": a.to_ell,
+                "shiftell": (a.to_shiftell_df64 if args.df64
+                             else a.to_shiftell)}[args.fmt]
         try:
-            a = {"dia": a.to_dia, "ell": a.to_ell,
-                 "shiftell": a.to_shiftell}[args.fmt]()
+            a = conv()
         except ValueError as e:
             raise SystemExit(f"--format {args.fmt}: {e}")
         desc += f" [{args.fmt}]"
 
-    if args.df64:
-        from .models.operators import (
-            CSRMatrix as _CSR,
-            ELLMatrix as _ELL,
-            Stencil2D as _S2,
-            Stencil3D as _S3,
-        )
-
-        bad = None
-        if args.mesh > 1:
-            bad = "--mesh > 1 (single-device solver)"
-        elif args.precond not in (None, "jacobi"):
-            bad = f"--precond {args.precond} (None or jacobi only)"
-        elif args.fmt in ("dia", "shiftell"):
-            bad = f"--format {args.fmt} (csr/ell/matrix-free only)"
-        elif args.method != "cg":
-            bad = f"--method {args.method} (textbook recurrence only)"
-        elif args.check_every != 1:
-            bad = "--check-every != 1"
-        elif not isinstance(a, (_CSR, _ELL, _S2, _S3)):
-            bad = (f"{type(a).__name__} operators (dense df64 would need "
-                   f"error-free MXU accumulation)")
-        if bad:
-            raise SystemExit(f"--dtype df64 does not support {bad}")
-        desc += " [df64]"
-
     def run():
         if args.df64:
+            if args.mesh > 1:
+                from .parallel import make_mesh, solve_distributed_df64
+
+                return solve_distributed_df64(
+                    a, np.asarray(b, dtype=np.float64),
+                    mesh=make_mesh(args.mesh), tol=args.tol,
+                    rtol=args.rtol, maxiter=args.maxiter,
+                    preconditioner=args.precond,
+                    record_history=args.history,
+                    check_every=args.check_every)
             from .solver.df64 import cg_df64
 
             return cg_df64(a, np.asarray(b, dtype=np.float64),
                            tol=args.tol, rtol=args.rtol,
                            maxiter=args.maxiter,
                            preconditioner=args.precond,
-                           record_history=args.history)
+                           record_history=args.history,
+                           check_every=args.check_every)
         if args.mesh > 1:
             from .parallel import make_mesh, solve_distributed
             from .models.operators import CSRMatrix, Stencil2D, Stencil3D
@@ -344,15 +361,14 @@ def main(argv=None) -> int:
         # adapt DF64CGResult to the CGResult-shaped reporting surface
         import types
 
-        hist = result.residual_history
         result = types.SimpleNamespace(
             x=result.x(), iterations=result.iterations,
             residual_norm=result.residual_norm(),
             converged=result.converged, indefinite=result.indefinite,
             status_enum=result.status_enum,
-            residual_history=(
-                np.sqrt(np.maximum(np.asarray(hist), 0.0))
-                if hist is not None else None))
+            # ||r|| with NaN fill - same semantics as CGResult, no
+            # adaptation needed
+            residual_history=result.residual_history)
 
     x_np = np.asarray(result.x)
     if rcm_perm is not None:  # scatter back to the original ordering

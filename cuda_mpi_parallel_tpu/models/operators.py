@@ -254,6 +254,17 @@ class CSRMatrix(LinearOperator):
         concentrates."""
         return ShiftELLMatrix.from_csr(self, h=h, kc=kc)
 
+    def to_shiftell_df64(self, h: int | None = None,
+                         kc: int = 8) -> "ShiftELLDF64Matrix":
+        """Convert to the double-float pallas shift-ELL format - f64-class
+        SpMV on assembled matrices (``solver.df64.cg_df64``; the
+        reference's ``CUDA_R_64F`` CSR configuration,
+        ``CUDACG.cu:216,288``).  Values split from this matrix's stored
+        data; pass f64 data at construction (e.g. ``mmio`` loads) for
+        full df64 matrix precision - f32-stored data is exact but carries
+        no low word."""
+        return ShiftELLDF64Matrix.from_csr(self, h=h, kc=kc)
+
     def to_ell(self, width: int | None = None) -> "ELLMatrix":
         """Convert to padded ELL (host-side; C++ fast path when built)."""
         indptr = np.asarray(self.indptr)
@@ -456,6 +467,114 @@ class ShiftELLMatrix(LinearOperator):
 
     def diagonal(self):
         return self.diag
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("vals_hi", "vals_lo", "lane_idx", "chunk_blocks",
+                 "diag_hi", "diag_lo"),
+    meta_fields=("shape", "h", "kc", "n_sheets", "nch", "nch_pad", "pad"),
+)
+@dataclasses.dataclass(frozen=True)
+class ShiftELLDF64Matrix:
+    """Double-float shift-ELL: f64-class assembled SpMV at pallas speed.
+
+    The TPU equivalent of the reference's defining configuration - f64
+    ``cusparseSpMV`` over assembled CSR (``CUDA_R_64F`` descriptor,
+    ``CUDACG.cu:216,288``) - on hardware with no f64 units.  Values and
+    vectors are unevaluated (hi, lo) f32 pairs (``ops.df64``); the
+    kernel gathers both x planes with shared lane indices and
+    accumulates through error-free transforms (``ops.pallas.spmv``
+    df64 section).  Use with ``solver.df64.cg_df64``; NOT a
+    ``LinearOperator`` - the f32 solver cannot consume the pair
+    representation (``matvec_df`` replaces ``matvec``).
+
+    Both x planes must stay VMEM-resident: half the f32 capacity,
+    n <= ~1.3M rows per device at the 10 MB v5e budget; shard larger
+    systems over a mesh.
+    """
+
+    vals_hi: jax.Array        # (n_chunks, kc, h+1, 128) f32; row h = meta
+    vals_lo: jax.Array        # (n_chunks, kc, h+1, 128) f32; row h = 0
+    lane_idx: jax.Array       # (n_chunks, kc, h, 128) i16 or i32
+    chunk_blocks: jax.Array   # (n_chunks,) int32, non-decreasing
+    diag_hi: jax.Array        # (n,) diag(A) hi (Jacobi preconditioning)
+    diag_lo: jax.Array        # (n,) diag(A) lo
+    shape: Tuple[int, int]
+    h: int
+    kc: int
+    n_sheets: int
+    nch: int
+    nch_pad: int
+    pad: int
+
+    @classmethod
+    def from_csr(cls, a: "CSRMatrix", h: int | None = None,
+                 kc: int = 8) -> "ShiftELLDF64Matrix":
+        from ..ops.pallas import spmv as pk
+
+        n = a.shape[0]
+        indptr = np.asarray(a.indptr)
+        indices = np.asarray(a.indices)
+        data64 = np.asarray(a.data, dtype=np.float64)
+        if h is None:
+            # both x planes resident: budget as one f64 plane (itemsize 8)
+            h = pk.choose_h(indptr, indices, n, kc=kc, itemsize=8)
+        packed = pk.pack_shift_ell_df64(indptr, indices, data64, n,
+                                        h=h, kc=kc)
+        # diagonal in df64: hi/lo split of the f64 diagonal
+        diag64 = np.zeros(n, dtype=np.float64)
+        rows = np.repeat(np.arange(n), np.diff(indptr))
+        on_diag = rows == indices
+        np.add.at(diag64, rows[on_diag], data64[on_diag])
+        diag_hi = diag64.astype(np.float32)
+        diag_lo = (diag64 - diag_hi.astype(np.float64)).astype(np.float32)
+        return cls(
+            vals_hi=jnp.asarray(packed.vals_hi),
+            vals_lo=jnp.asarray(packed.vals_lo),
+            lane_idx=jnp.asarray(packed.lane_idx),
+            chunk_blocks=jnp.asarray(packed.chunk_blocks),
+            diag_hi=jnp.asarray(diag_hi), diag_lo=jnp.asarray(diag_lo),
+            shape=a.shape, h=packed.h, kc=packed.kc,
+            n_sheets=packed.n_sheets, nch=packed.nch,
+            nch_pad=packed.nch_pad, pad=packed.pad)
+
+    @classmethod
+    def from_shiftell(cls, a: "ShiftELLMatrix") -> "ShiftELLDF64Matrix":
+        """Lift an f32 shift-ELL matrix to df64 (lo planes = 0): the
+        matrix values stay exactly what they were in f32, but matvec
+        products and sums accumulate in df64."""
+        return cls(
+            vals_hi=a.vals, vals_lo=jnp.zeros_like(a.vals),
+            lane_idx=a.lane_idx, chunk_blocks=a.chunk_blocks,
+            diag_hi=a.diag, diag_lo=jnp.zeros_like(a.diag),
+            shape=a.shape, h=a.h, kc=a.kc, n_sheets=a.n_sheets,
+            nch=a.nch, nch_pad=a.nch_pad, pad=a.pad)
+
+    @property
+    def nnz_dtype(self):
+        return self.vals_hi.dtype
+
+    def matvec_df(self, x):
+        """(y_hi, y_lo) = A @ (x_hi, x_lo); x is a df64 pair."""
+        from ..ops.pallas import spmv as pk
+
+        return pk.shift_ell_matvec_df64(
+            x[0], x[1], self.vals_hi, self.vals_lo, self.lane_idx,
+            self.chunk_blocks, h=self.h, kc=self.kc, n=self.shape[0],
+            nch=self.nch, nch_pad=self.nch_pad, pad=self.pad,
+            interpret=_pallas_interpret())
+
+    def diagonal_df(self):
+        return self.diag_hi, self.diag_lo
+
+    def matvec(self, x):
+        raise TypeError(
+            "ShiftELLDF64Matrix is a double-float operator: use "
+            "solver.df64.cg_df64 (matvec_df), not the f32 solve path")
+
+    def __matmul__(self, x):
+        return self.matvec(x)
 
 
 # Above ~3 VMEM's worth of grid the CG state cannot stay resident on-chip

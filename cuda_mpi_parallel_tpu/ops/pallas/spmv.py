@@ -57,6 +57,7 @@ Performance-critical structure (measured on v5e):
 """
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Tuple
 
 import jax
@@ -65,12 +66,67 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..blas1 import _two_prod, _two_sum
+
 LANES = 128
 
 # x must stay VMEM-resident; reserve room for sheet blocks, accumulator
 # and double buffering.  ~10 MB of f32 x caps n at ~2.6M rows; beyond
 # that shard over a mesh (each shard's local x is what must fit).
-_MAX_X_BYTES = 10 * 2 ** 20
+# Conservative fallback for unknown platforms; see max_x_bytes() for the
+# per-generation table and overrides.
+_MAX_X_BYTES_FALLBACK = 10 * 2 ** 20
+
+# Per-generation x budgets: a ~10/16 fraction of the ~16 MB/core VMEM of
+# the v4/v5 generations (leaves room for sheet chunks, the (h, 128)
+# accumulator and pipeline double-buffering).  Entries are matched as
+# substrings of the lowercased jax device_kind (e.g. "TPU v5 lite").
+# CPU (pallas interpret mode, used by the test suite) has no VMEM at
+# all - give it a roomy budget so interpret-mode tests can exercise any
+# size.  Unknown device kinds use the conservative fallback.
+_X_BYTES_BY_GENERATION = (
+    ("v2", 6 * 2 ** 20),      # 8 MB VMEM parts
+    ("v3", 10 * 2 ** 20),
+    ("v4", 10 * 2 ** 20),
+    ("v5", 10 * 2 ** 20),     # incl. "v5 lite" (v5e) - the calibrated part
+    ("v6", 20 * 2 ** 20),     # Trillium: larger VMEM
+    ("cpu", 256 * 2 ** 20),   # interpret mode: no VMEM constraint
+)
+
+_ENV_OVERRIDE = "CMP_SHIFTELL_X_BYTES"
+
+
+def max_x_bytes(device=None) -> int:
+    """VMEM budget (bytes) for the kernel-resident x plane(s).
+
+    Resolution order: the ``CMP_SHIFTELL_X_BYTES`` env var (explicit
+    override, bytes), then a per-generation table keyed on the device
+    kind of ``device`` (default: the default jax device), then the
+    conservative 10 MB fallback that round 2 hardcoded for v5e.  Pass
+    ``x_budget=`` to :func:`pack_shift_ell` / :func:`shift_ell_matvec`
+    / :func:`choose_h` for a per-call override.
+    """
+    env = os.environ.get(_ENV_OVERRIDE)
+    if env:
+        try:
+            budget = int(env)
+        except ValueError as e:
+            raise ValueError(
+                f"{_ENV_OVERRIDE}={env!r} is not an integer byte count"
+            ) from e
+        if budget <= 0:
+            raise ValueError(f"{_ENV_OVERRIDE} must be positive, got {budget}")
+        return budget
+    try:
+        if device is None:
+            device = jax.devices()[0]
+        kind = device.device_kind.lower()
+    except Exception:
+        return _MAX_X_BYTES_FALLBACK
+    for marker, budget in _X_BYTES_BY_GENERATION:
+        if marker in kind:
+            return budget
+    return _MAX_X_BYTES_FALLBACK
 
 
 class ShiftELLData(NamedTuple):
@@ -101,8 +157,8 @@ class ShiftELLData(NamedTuple):
 
 def pack_shift_ell(indptr: np.ndarray, indices: np.ndarray,
                    data: np.ndarray, n: int, *, h: int = 16,
-                   kc: int = 8,
-                   n_chunks: int | None = None) -> ShiftELLData:
+                   kc: int = 8, n_chunks: int | None = None,
+                   x_budget: int | None = None) -> ShiftELLData:
     """Host-side packer: CSR -> ragged shift-ELL chunks (numpy).
 
     Slots bucket by ``(block, ws)``; a row contributing ``m`` nonzeros
@@ -132,13 +188,15 @@ def pack_shift_ell(indptr: np.ndarray, indices: np.ndarray,
     nch_pad = -(-nch // h) * h
     pad = h  # window reach beyond either end of x
     nb = nch_pad // h
+    budget = max_x_bytes() if x_budget is None else x_budget
     x_bytes = (nch_pad + 2 * pad) * LANES * data.dtype.itemsize
-    if x_bytes > _MAX_X_BYTES:
+    if x_bytes > budget:
         raise ValueError(
             f"shift-ELL needs x VMEM-resident: {x_bytes/2**20:.1f} MB > "
-            f"{_MAX_X_BYTES/2**20:.0f} MB budget (n={n}, "
-            f"dtype={data.dtype}); shard the solve over a mesh or use the "
-            f"csr/ell formats")
+            f"{budget/2**20:.1f} MB budget (n={n}, dtype={data.dtype}; "
+            f"budget from {_ENV_OVERRIDE} env, x_budget= override, or the "
+            f"device-kind table in ops.pallas.spmv.max_x_bytes); shard the "
+            f"solve over a mesh or use the csr/ell formats")
 
     rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
     cols = indices.astype(np.int64)
@@ -253,6 +311,7 @@ def shift_ell_matvec(
     nch_pad: int,
     pad: int,
     interpret: bool = False,
+    x_budget: int | None = None,
 ) -> jax.Array:
     """y = A @ x with A in ragged shift-ELL form (see module docstring).
 
@@ -261,11 +320,13 @@ def shift_ell_matvec(
     cannot express their varying mesh axes through the interpret-mode
     ref discharge (dynamic_slice vma propagation rejects the mix).
     """
+    budget = max_x_bytes() if x_budget is None else x_budget
     x_bytes = (nch_pad + 2 * pad) * LANES * x.dtype.itemsize
-    if x_bytes > _MAX_X_BYTES:
+    if x_bytes > budget:
         raise ValueError(
             f"shift-ELL needs x VMEM-resident: {x_bytes/2**20:.1f} MB > "
-            f"{_MAX_X_BYTES/2**20:.0f} MB budget (n={n}); shard the solve "
+            f"{budget/2**20:.1f} MB budget (n={n}; see "
+            f"ops.pallas.spmv.max_x_bytes for overrides); shard the solve "
             f"over a mesh or use the csr/ell formats")
     n_chunks = vals.shape[0]
     total_rows = nch_pad + 2 * pad
@@ -290,6 +351,179 @@ def shift_ell_matvec(
         interpret=interpret,
     )(chunk_blocks, x2, vals, lane_idx)
     return y2.reshape(-1)[:n]
+
+
+# -- double-float (df64) variant ---------------------------------------------
+#
+# f64-class SpMV on assembled matrices at pallas speed: the reference's
+# defining configuration is CUDA_R_64F CSR SpMV (CUDACG.cu:216,288), and
+# before this kernel the only f64-class assembled path was the XLA
+# ELL-gather (~43 ms/iter at 1M rows, ~400x off the f32 shift-ELL rate).
+# Values and x are unevaluated (hi, lo) f32 pairs (ops.df64); per sheet
+# the kernel gathers BOTH x planes with the same lane indices and
+# accumulates through error-free transforms (Dekker two-prod + accurate
+# double-float add), so a row's sum carries ~49 significand bits end to
+# end - the same arithmetic as ops.df64.ell_matvec, fused into the
+# lane-gather kernel.  Cost vs the f32 kernel: 2x gather traffic
+# (hi + lo planes) + ~35 VPU flops/element of EFT arithmetic.
+
+
+class ShiftELLDF64Data(NamedTuple):
+    """Device-ready df64 sheet arrays from :func:`pack_shift_ell_df64`.
+
+    Same geometry as :class:`ShiftELLData`; values are split into f32
+    hi/lo planes.  The metadata row (window starts / -1 padding marks)
+    rides the HI plane only - chunk-row indices are < 2^24 so their f32
+    hi is exact and their lo is identically zero.
+    """
+
+    vals_hi: np.ndarray       # (n_chunks, kc, h+1, 128) f32; row h = meta
+    vals_lo: np.ndarray       # (n_chunks, kc, h+1, 128) f32; row h = 0
+    lane_idx: np.ndarray      # (n_chunks, kc, h, 128) int16 or int32
+    chunk_blocks: np.ndarray  # (n_chunks,) int32, non-decreasing
+    h: int
+    kc: int
+    n_chunks: int
+    n_sheets: int
+    n: int
+    nch: int
+    nch_pad: int
+    pad: int
+
+
+def pack_shift_ell_df64(indptr: np.ndarray, indices: np.ndarray,
+                        data: np.ndarray, n: int, *, h: int = 16,
+                        kc: int = 8, n_chunks: int | None = None,
+                        x_budget: int | None = None) -> ShiftELLDF64Data:
+    """Host-side df64 packer: CSR with float64 values -> hi/lo planes.
+
+    Reuses :func:`pack_shift_ell` on the f64 data (the VMEM budget check
+    at itemsize 8 is exactly right: the two f32 x planes occupy the same
+    bytes as one f64 plane), then splits each packed value into its
+    (hi, lo) f32 pair.  Exact values (integers, powers of two - e.g. the
+    Poisson stencil weights) split with lo = 0.
+    """
+    data64 = np.asarray(data, dtype=np.float64)
+    packed = pack_shift_ell(indptr, indices, data64, n, h=h, kc=kc,
+                            n_chunks=n_chunks, x_budget=x_budget)
+    vals_hi = packed.vals.astype(np.float32)
+    vals_lo = (packed.vals - vals_hi.astype(np.float64)).astype(np.float32)
+    return ShiftELLDF64Data(
+        vals_hi=vals_hi, vals_lo=vals_lo, lane_idx=packed.lane_idx,
+        chunk_blocks=packed.chunk_blocks, h=packed.h, kc=packed.kc,
+        n_chunks=packed.n_chunks, n_sheets=packed.n_sheets, n=packed.n,
+        nch=packed.nch, nch_pad=packed.nch_pad, pad=packed.pad)
+
+
+def _make_kernel_df64(h: int, kc: int):
+    # the accumulator add is ops.df64.add (the accurate QD ieee_add -
+    # that module records why the sloppy variant loses CG convergence);
+    # one canonical EFT add, pure elementwise jnp, pallas-safe
+    from ..df64 import add as _df_add
+
+    def kernel(blk_ref, xh_ref, xl_ref, vh_ref, vl_ref, l_ref,
+               oh_ref, ol_ref):
+        g = pl.program_id(0)
+        first = jnp.logical_or(
+            g == 0, blk_ref[g] != blk_ref[jnp.maximum(g - 1, 0)])
+
+        def sheet_product(ws, k):
+            idx = l_ref[0, k].astype(jnp.int32)
+            gh = jnp.take_along_axis(xh_ref[pl.ds(ws, h), :], idx, axis=1)
+            gl = jnp.take_along_axis(xl_ref[pl.ds(ws, h), :], idx, axis=1)
+            vh = vh_ref[0, k, :h]
+            vl = vl_ref[0, k, :h]
+            # Dekker mul of (vh, vl) * (gh, gl), dropping only lo*lo
+            p, e = _two_prod(vh, gh)
+            e = e + (vh * gl + vl * gh)
+            return _two_sum(p, e)
+
+        for k in range(kc):
+            # metadata row of the HI value block: window start (or -1)
+            ws = vh_ref[0, k, h, 0].astype(jnp.int32)
+            is_first = jnp.logical_and(first, k == 0)
+
+            @pl.when(jnp.logical_and(ws >= 0, jnp.logical_not(is_first)))
+            def _(k=k, ws=ws):
+                ph, plo = sheet_product(ws, k)
+                ah, al = _df_add((oh_ref[:], ol_ref[:]), (ph, plo))
+                oh_ref[:] = ah
+                ol_ref[:] = al
+
+            @pl.when(is_first)
+            def _(k=k, ws=ws):
+                # first sheet of the block initializes the output (an
+                # all-padding block's vals are zero - products stay zero)
+                ph, plo = sheet_product(jnp.maximum(ws, 0), k)
+                oh_ref[:] = ph
+                ol_ref[:] = plo
+
+    return kernel
+
+
+def shift_ell_matvec_df64(
+    x_hi: jax.Array,
+    x_lo: jax.Array,
+    vals_hi: jax.Array,
+    vals_lo: jax.Array,
+    lane_idx: jax.Array,
+    chunk_blocks: jax.Array,
+    *,
+    h: int,
+    kc: int,
+    n: int,
+    nch: int,
+    nch_pad: int,
+    pad: int,
+    interpret: bool = False,
+    x_budget: int | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(y_hi, y_lo) = A @ x with df64 values/vector (see module notes).
+
+    Both x planes are VMEM-resident, so the budget check counts them
+    together (equivalently: one f64 x plane's bytes).
+    """
+    budget = max_x_bytes() if x_budget is None else x_budget
+    x_bytes = 2 * (nch_pad + 2 * pad) * LANES * x_hi.dtype.itemsize
+    if x_bytes > budget:
+        raise ValueError(
+            f"df64 shift-ELL needs both x planes VMEM-resident: "
+            f"{x_bytes/2**20:.1f} MB > {budget/2**20:.1f} MB budget "
+            f"(n={n}; see ops.pallas.spmv.max_x_bytes for overrides); "
+            f"shard the solve over a mesh or use the ell format")
+    n_chunks = vals_hi.shape[0]
+    total_rows = nch_pad + 2 * pad
+
+    def pad_plane(x):
+        xp = jnp.zeros((total_rows * LANES,), x.dtype)
+        xp = jax.lax.dynamic_update_slice(xp, x, (pad * LANES,))
+        return xp.reshape(total_rows, LANES)
+
+    x2h, x2l = pad_plane(x_hi), pad_plane(x_lo)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((total_rows, LANES), lambda g, b: (0, 0)),
+            pl.BlockSpec((total_rows, LANES), lambda g, b: (0, 0)),
+            pl.BlockSpec((1, kc, h + 1, LANES), lambda g, b: (g, 0, 0, 0)),
+            pl.BlockSpec((1, kc, h + 1, LANES), lambda g, b: (g, 0, 0, 0)),
+            pl.BlockSpec((1, kc, h, LANES), lambda g, b: (g, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((h, LANES), lambda g, b: (b[g], 0)),
+            pl.BlockSpec((h, LANES), lambda g, b: (b[g], 0)),
+        ],
+    )
+    yh2, yl2 = pl.pallas_call(
+        _make_kernel_df64(h, kc),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((nch_pad, LANES), x_hi.dtype),
+                   jax.ShapeDtypeStruct((nch_pad, LANES), x_hi.dtype)],
+        interpret=interpret,
+    )(chunk_blocks, x2h, x2l, vals_hi, vals_lo, lane_idx)
+    return yh2.reshape(-1)[:n], yl2.reshape(-1)[:n]
 
 
 def sheets_per_block(indptr: np.ndarray, indices: np.ndarray, n: int,
@@ -329,7 +563,8 @@ def sheet_count(indptr: np.ndarray, indices: np.ndarray, n: int,
 
 def choose_h(indptr: np.ndarray, indices: np.ndarray, n: int, *,
              kc: int = 8, itemsize: int = 4,
-             candidates: Tuple[int, ...] = (32, 64, 128)) -> int:
+             candidates: Tuple[int, ...] = (32, 64, 128),
+             x_budget: int | None = None) -> int:
     """Pick the block height minimizing the PADDED SHEET COUNT.
 
     Measured on v5e (1M-row Poisson and FEM): per-iteration cost tracks
@@ -344,10 +579,11 @@ def choose_h(indptr: np.ndarray, indices: np.ndarray, n: int, *,
     x further, so near the size cap only the smaller heights fit.
     """
     nch = -(-n // LANES)
+    budget = max_x_bytes() if x_budget is None else x_budget
     best_h, best_cost = None, None
     for h in candidates:
         nch_pad = -(-nch // h) * h
-        if (nch_pad + 2 * h) * LANES * itemsize > _MAX_X_BYTES:
+        if (nch_pad + 2 * h) * LANES * itemsize > budget:
             continue
         per_block = sheets_per_block(indptr, indices, n, h=h)
         cost = int((np.maximum(-(-per_block // kc), 1) * kc).sum())

@@ -679,8 +679,12 @@ def _pipecg(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
 
 
 def _as_operator(a) -> LinearOperator:
-    from ..models.operators import DenseOperator
+    from ..models.operators import DenseOperator, ShiftELLDF64Matrix
 
+    if isinstance(a, ShiftELLDF64Matrix):
+        raise TypeError(
+            "ShiftELLDF64Matrix is a double-float operator: use "
+            "solver.df64.cg_df64, not the f32 solve path")
     arr = jnp.asarray(a)
     if arr.ndim != 2:
         raise ValueError(f"expected a 2-D matrix or LinearOperator, got "

@@ -99,12 +99,40 @@ class TestCLI:
         with pytest.raises(SystemExit, match="df64"):
             cli.main(["--problem", "poisson2d", "--n", "8", "--device",
                       "cpu", "--dtype", "df64", "--precond", "chebyshev"])
-        with pytest.raises(SystemExit, match="df64"):
+        # assembled operators stay single-device in df64
+        with pytest.raises(SystemExit, match="matrix-free"):
             cli.main(["--problem", "poisson2d", "--n", "8", "--device",
                       "cpu", "--dtype", "df64", "--mesh", "2"])
         with pytest.raises(SystemExit, match="DenseOperator"):
             cli.main(["--problem", "random-spd", "--n", "8", "--device",
                       "cpu", "--dtype", "df64"])
+        # dia rejected BEFORE any format conversion work (round-2 advice:
+        # fail fast, not after the doomed packing)
+        with pytest.raises(SystemExit, match="dia"):
+            cli.main(["--problem", "poisson2d", "--n", "8", "--device",
+                      "cpu", "--dtype", "df64", "--format", "dia"])
+
+    def test_df64_shiftell(self, capsys):
+        """--dtype df64 --format shiftell: the pallas double-float
+        lane-gather kernel on an assembled matrix (the reference's
+        CUDA_R_64F CSR configuration, CUDACG.cu:216,288)."""
+        rc = cli.main(["--problem", "poisson2d", "--n", "16", "--device",
+                       "cpu", "--dtype", "df64", "--format", "shiftell",
+                       "--tol", "0", "--rtol", "1e-11", "--json"])
+        rec = json.loads(capsys.readouterr().out)
+        assert rc == 0 and rec["converged"] and rec["dtype"] == "df64"
+        assert rec["residual_norm"] < 1e-8
+
+    def test_df64_mesh(self, capsys):
+        """--dtype df64 --mesh 2: distributed df64 over a slab mesh
+        (matrix-free stencil)."""
+        rc = cli.main(["--problem", "poisson2d", "--n", "16", "--device",
+                       "cpu", "--dtype", "df64", "--matrix-free",
+                       "--mesh", "2", "--tol", "0", "--rtol", "1e-10",
+                       "--json"])
+        rec = json.loads(capsys.readouterr().out)
+        assert rc == 0 and rec["converged"] and rec["mesh"] == 2
+        assert rec["residual_norm"] < 1e-7
 
     def test_shiftell_bfloat16_rejected_cleanly(self):
         """shift-ELL metadata rides the value plane: f32/f64 only, and

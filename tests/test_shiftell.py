@@ -88,12 +88,13 @@ class TestPacking:
         auto-h once chose h=128 and made conversions fail that h<=64
         handled)."""
         n = 2_598_544  # boundary: h<=64 fits the 10 MB f32 budget, 128 not
+        budget = 10 * 2 ** 20  # the v5e-class budget, pinned explicitly
         indptr = np.arange(n + 1, dtype=np.int64)
         indices = np.arange(n, dtype=np.int32)
-        h = pk.choose_h(indptr, indices, n, itemsize=4)
+        h = pk.choose_h(indptr, indices, n, itemsize=4, x_budget=budget)
         nch = -(-n // 128)
         nch_pad = -(-nch // h) * h
-        assert (nch_pad + 2 * h) * 128 * 4 <= pk._MAX_X_BYTES
+        assert (nch_pad + 2 * h) * 128 * 4 <= budget
 
     def test_poisson_sheet_count_is_bandwidth_free(self):
         """Natural-order 2D Poisson needs ~K sheets per block regardless
@@ -147,8 +148,11 @@ class TestMatvecParity:
         np.testing.assert_allclose(np.asarray(a.to_shiftell(h=2).diagonal()),
                                    np.asarray(a.diagonal()), rtol=1e-14)
 
-    def test_vmem_budget_rejected(self):
-        """Oversized systems must fail loudly, not spill VMEM."""
+    def test_vmem_budget_rejected(self, monkeypatch):
+        """Oversized systems must fail loudly, not spill VMEM.  The
+        budget is pinned to the v5e value: the CPU test environment's
+        table entry is deliberately huge (interpret mode has no VMEM)."""
+        monkeypatch.setenv(pk._ENV_OVERRIDE, str(10 * 2 ** 20))
         a = poisson.poisson_2d_csr(8, 8)
         sell = a.to_shiftell(h=2)
         import dataclasses
