@@ -197,6 +197,61 @@ def stencil2d_matvec(x: DF, grid: Tuple[int, int], scale: DF) -> DF:
     return y[0].reshape(-1), y[1].reshape(-1)
 
 
+def stencil2d_local_matvec(x: DF, lo: DF, hi: DF,
+                           grid: Tuple[int, int], scale: DF) -> DF:
+    """df64 5-point Laplacian on a LOCAL slab with neighbor halo planes.
+
+    The distributed form of :func:`stencil2d_matvec`: the partitioned
+    leading axis is extended with the ``lo``/``hi`` halo planes (one
+    ``(1, ny)`` pair per plane, delivered by ``lax.ppermute`` hi and lo
+    words together - ``parallel.df64``), the free axis keeps the
+    Dirichlet zero pad.  Identical per-element EFT arithmetic to the
+    single-device version, so 1-vs-N-device trajectories match.
+    """
+    lnx, ny = grid
+    uh = x[0].reshape(lnx, ny)
+    ul = x[1].reshape(lnx, ny)
+    eh = jnp.concatenate([lo[0].reshape(1, ny), uh,
+                          hi[0].reshape(1, ny)], axis=0)
+    el = jnp.concatenate([lo[1].reshape(1, ny), ul,
+                          hi[1].reshape(1, ny)], axis=0)
+    eh = jnp.pad(eh, ((0, 0), (1, 1)))
+    el = jnp.pad(el, ((0, 0), (1, 1)))
+    acc = (4.0 * uh, 4.0 * ul)
+    for sl in ((slice(None, -2), slice(1, -1)),
+               (slice(2, None), slice(1, -1)),
+               (slice(1, -1), slice(None, -2)),
+               (slice(1, -1), slice(2, None))):
+        acc = sub(acc, (eh[sl], el[sl]))
+    y = mul(scale, acc)
+    return y[0].reshape(-1), y[1].reshape(-1)
+
+
+def stencil3d_local_matvec(x: DF, lo: DF, hi: DF,
+                           grid: Tuple[int, int, int], scale: DF) -> DF:
+    """df64 7-point Laplacian on a local slab with halo planes (the 3D
+    sibling of :func:`stencil2d_local_matvec`; halos are ``(1, ny, nz)``
+    plane pairs)."""
+    lnx, ny, nz = grid
+    uh = x[0].reshape(lnx, ny, nz)
+    ul = x[1].reshape(lnx, ny, nz)
+    eh = jnp.concatenate([lo[0].reshape(1, ny, nz), uh,
+                          hi[0].reshape(1, ny, nz)], axis=0)
+    el = jnp.concatenate([lo[1].reshape(1, ny, nz), ul,
+                          hi[1].reshape(1, ny, nz)], axis=0)
+    eh = jnp.pad(eh, ((0, 0), (1, 1), (1, 1)))
+    el = jnp.pad(el, ((0, 0), (1, 1), (1, 1)))
+    c = slice(1, -1)
+    # 6u as 4u + 2u, both exact in f32 (see stencil3d_matvec)
+    acc = add((4.0 * uh, 4.0 * ul), (2.0 * uh, 2.0 * ul))
+    for sl in ((slice(None, -2), c, c), (slice(2, None), c, c),
+               (c, slice(None, -2), c), (c, slice(2, None), c),
+               (c, c, slice(None, -2)), (c, c, slice(2, None))):
+        acc = sub(acc, (eh[sl], el[sl]))
+    y = mul(scale, acc)
+    return y[0].reshape(-1), y[1].reshape(-1)
+
+
 def stencil3d_matvec(x: DF, grid: Tuple[int, int, int], scale: DF) -> DF:
     """df64 7-point Laplacian: (6u - sum of 6 neighbors) * scale."""
     nx, ny, nz = grid

@@ -3,6 +3,7 @@ collectives - the TPU-native communication backend the reference's repo name
 (MPI) promises but never implements (SURVEY SS5)."""
 
 from . import multihost
+from .df64 import DistStencilDF64, solve_distributed_df64
 from .dist_cg import solve_distributed
 from .halo import exchange_halo, exchange_halo_axis, neighbor_shift_perms
 from .mesh import (
@@ -37,6 +38,7 @@ __all__ = [
     "DistStencil2D",
     "DistStencil3D",
     "DistStencil3DPencil",
+    "DistStencilDF64",
     "PartitionedCSR",
     "RingPartitionedCSR",
     "exchange_halo",
@@ -50,4 +52,5 @@ __all__ = [
     "row_sharding",
     "shard_vector",
     "solve_distributed",
+    "solve_distributed_df64",
 ]
