@@ -119,11 +119,32 @@ def shard_vector_global(
     offset = jax.process_index() * per_proc
 
     def cb(index):
-        # index is the global slice for one local device; translate into
-        # this process's local slice
-        (sl,) = index
-        start = (sl.start or 0) - offset
-        stop = (sl.stop if sl.stop is not None else global_length) - offset
+        start, stop = _translate_to_local(index, offset, global_length,
+                                          local_data.shape[0])
         return local_data[start:stop]
 
     return jax.make_array_from_callback((global_length,), sharding, cb)
+
+
+def _translate_to_local(index, offset: int, global_length: int,
+                        local_length: int):
+    """Translate one device's GLOBAL row slice into this process's local
+    slice bounds.
+
+    ``index`` is the 1-tuple of slices ``make_array_from_callback`` hands
+    the callback (``None`` endpoints mean the array bounds).  The runtime
+    only requests slices for devices this process owns, which with
+    process-contiguous row blocks always fall inside
+    ``[offset, offset + local_length)`` - violations mean the mesh was
+    not built in process order and raise rather than silently feeding a
+    device the wrong rows.
+    """
+    (sl,) = index
+    start = (sl.start or 0) - offset
+    stop = (sl.stop if sl.stop is not None else global_length) - offset
+    if start < 0 or stop > local_length or stop <= start:
+        raise ValueError(
+            f"device slice [{sl.start}:{sl.stop}] is outside this "
+            f"process's rows [{offset}:{offset + local_length}] - the "
+            f"mesh's devices are not in process-contiguous order")
+    return start, stop

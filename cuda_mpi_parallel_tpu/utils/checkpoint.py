@@ -64,6 +64,33 @@ def save_checkpoint(path: str, ckpt: CGCheckpoint,
     os.replace(tmp + ".npz", path)
 
 
+def _check_fingerprint(stored: str, expect: str, path: str) -> None:
+    """Enforce the problem-identity check all load paths share.
+
+    A stored-but-different fingerprint is a hard error.  A checkpoint
+    saved WITHOUT a fingerprint cannot be verified: when the caller asked
+    for verification (non-empty ``expect``), accepting it silently would
+    defeat the exact wrong-system protection ``problem_fingerprint``
+    exists for (round-2 advice) - warn loudly instead of either silently
+    resuming or breaking legitimately fingerprint-less manual saves.
+    """
+    if not expect:
+        return
+    if stored and stored != expect:
+        raise ValueError(
+            f"checkpoint {path} belongs to a different problem "
+            f"(fingerprint {stored} != {expect}); refusing "
+            f"to resume - delete it to start fresh")
+    if not stored:
+        import warnings
+
+        warnings.warn(
+            f"checkpoint {path} was saved without a problem fingerprint; "
+            f"cannot verify it belongs to this system - resuming "
+            f"UNVERIFIED (re-save with fingerprint= to enable the check)",
+            UserWarning, stacklevel=3)
+
+
 def _checkpoint_from_mapping(z, path: str,
                              expect_fingerprint: str) -> CGCheckpoint:
     """Shared validation + deserialization for both backends (the
@@ -74,11 +101,7 @@ def _checkpoint_from_mapping(z, path: str,
             f"checkpoint {path} has format version {version}, "
             f"expected {_FORMAT_VERSION}")
     stored = str(z["fingerprint"]) if "fingerprint" in z else ""
-    if expect_fingerprint and stored and stored != expect_fingerprint:
-        raise ValueError(
-            f"checkpoint {path} belongs to a different problem "
-            f"(fingerprint {stored} != {expect_fingerprint}); refusing "
-            f"to resume - delete it to start fresh")
+    _check_fingerprint(stored, expect_fingerprint, path)
     return CGCheckpoint(
         x=jnp.asarray(z["x"]), r=jnp.asarray(z["r"]), p=jnp.asarray(z["p"]),
         rho=jnp.asarray(z["rho"]), rr=jnp.asarray(z["rr"]),
@@ -125,11 +148,7 @@ def load_checkpoint_df64(path: str, expect_fingerprint: str = ""):
                 f"checkpoint {path} is not a df64 checkpoint; load it "
                 f"with load_checkpoint and resume with solve")
         stored = str(z["fingerprint"]) if "fingerprint" in z else ""
-        if expect_fingerprint and stored and stored != expect_fingerprint:
-            raise ValueError(
-                f"checkpoint {path} belongs to a different problem "
-                f"(fingerprint {stored} != {expect_fingerprint}); "
-                f"refusing to resume - delete it to start fresh")
+        _check_fingerprint(stored, expect_fingerprint, path)
         return DF64Checkpoint(**{
             f.name: jnp.asarray(z[f.name])
             for f in _dc.fields(DF64Checkpoint)})

@@ -204,3 +204,72 @@ class TestDF64DiskRoundtrip:
                         return_checkpoint=True)
             save_checkpoint(save_dir, r32.checkpoint, fp)
             load_checkpoint_df64(save_dir)
+
+
+class TestFingerprintUnverifiable:
+    """A checkpoint saved WITHOUT a fingerprint cannot be verified: when
+    the caller asks for verification it must warn loudly, not silently
+    accept (round-2 advice item)."""
+
+    def test_npz_warns_on_empty_stored_fingerprint(self, tmp_path, rng):
+        import warnings
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from cuda_mpi_parallel_tpu import solve
+        from cuda_mpi_parallel_tpu.models import poisson
+        from cuda_mpi_parallel_tpu.utils.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        a = poisson.poisson_2d_csr(8, 8)
+        b = jnp.asarray(rng.standard_normal(64))
+        part = solve(a, b, tol=0.0, maxiter=5, return_checkpoint=True)
+        path = str(tmp_path / "nofp.npz")
+        save_checkpoint(path, part.checkpoint)  # no fingerprint
+        with pytest.warns(UserWarning, match="UNVERIFIED"):
+            load_checkpoint(path, expect_fingerprint="deadbeef")
+        # no expectation -> no warning
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            load_checkpoint(path)
+
+    def test_df64_warns_on_empty_stored_fingerprint(self, tmp_path, rng):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from cuda_mpi_parallel_tpu import cg_df64
+        from cuda_mpi_parallel_tpu.models import poisson
+        from cuda_mpi_parallel_tpu.utils.checkpoint import (
+            load_checkpoint_df64,
+            save_checkpoint_df64,
+        )
+
+        a = poisson.poisson_2d_csr(8, 8)
+        b = np.asarray(a @ jnp.asarray(rng.standard_normal(64)),
+                       dtype=np.float64)
+        part = cg_df64(a, b, tol=0.0, maxiter=5, return_checkpoint=True)
+        path = str(tmp_path / "nofp64.npz")
+        save_checkpoint_df64(path, part.checkpoint)  # no fingerprint
+        with pytest.warns(UserWarning, match="UNVERIFIED"):
+            load_checkpoint_df64(path, expect_fingerprint="deadbeef")
+
+    def test_mismatch_still_raises(self, tmp_path, rng):
+        import jax.numpy as jnp
+
+        from cuda_mpi_parallel_tpu import solve
+        from cuda_mpi_parallel_tpu.models import poisson
+        from cuda_mpi_parallel_tpu.utils.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        a = poisson.poisson_2d_csr(8, 8)
+        b = jnp.asarray(rng.standard_normal(64))
+        part = solve(a, b, tol=0.0, maxiter=5, return_checkpoint=True)
+        path = str(tmp_path / "fp.npz")
+        save_checkpoint(path, part.checkpoint, fingerprint="aaaa")
+        with pytest.raises(ValueError, match="different problem"):
+            load_checkpoint(path, expect_fingerprint="bbbb")

@@ -64,3 +64,71 @@ class TestSingleProcessDegradation:
         with pytest.raises(ValueError, match="divide evenly"):
             multihost.shard_vector_global(
                 rng.standard_normal(n_dev * 8 + 1), n_dev * 8 + 1, mesh)
+
+
+class TestMultiProcessArithmetic:
+    """The multi-process offset/slice math of ``shard_vector_global``
+    (``multihost._translate_to_local`` + its validation), exercised with
+    MOCKED process index/count - the round-2 verdict's gap: this
+    arithmetic only runs where CI has no multi-process runtime."""
+
+    def _mock(self, monkeypatch, idx, count):
+        monkeypatch.setattr(jax, "process_index", lambda: idx)
+        monkeypatch.setattr(jax, "process_count", lambda: count)
+
+    @pytest.mark.parametrize("n_proc,proc", [(2, 0), (2, 1), (4, 3)])
+    def test_device_slices_translate_to_local_ranges(self, n_proc, proc):
+        """Each of a process's devices maps to the right window of its
+        local slice, and together the windows tile it exactly."""
+        global_length, n_dev = 64, 8
+        per_dev = global_length // n_dev
+        per_proc = global_length // n_proc
+        offset = proc * per_proc
+        dev_per_proc = n_dev // n_proc
+        covered = []
+        for d in range(dev_per_proc):
+            g0 = offset + d * per_dev
+            sl = (slice(g0 if g0 else None, g0 + per_dev),)
+            start, stop = multihost._translate_to_local(
+                sl, offset, global_length, per_proc)
+            assert (start, stop) == (d * per_dev, (d + 1) * per_dev)
+            covered.append((start, stop))
+        assert covered[0][0] == 0 and covered[-1][1] == per_proc
+        assert all(covered[i][1] == covered[i + 1][0]
+                   for i in range(len(covered) - 1))
+
+    def test_none_endpoints_mean_array_bounds(self):
+        # first device of process 0 gets slice(None, k); the LAST device
+        # of the LAST process can get slice(j, None)
+        start, stop = multihost._translate_to_local(
+            (slice(None, 8),), 0, 64, 32)
+        assert (start, stop) == (0, 8)
+        start, stop = multihost._translate_to_local(
+            (slice(56, None),), 32, 64, 32)
+        assert (start, stop) == (24, 32)
+
+    def test_foreign_slice_rejected(self):
+        """A slice belonging to another process's rows must raise, not
+        silently feed wrong data."""
+        with pytest.raises(ValueError, match="process-contiguous"):
+            multihost._translate_to_local((slice(0, 8),), 32, 64, 32)
+        with pytest.raises(ValueError, match="process-contiguous"):
+            multihost._translate_to_local((slice(56, None),), 0, 64, 32)
+
+    def test_wrong_local_length_raises(self, rng, monkeypatch):
+        """With 2 mocked processes, passing the full vector (instead of
+        this process's half) is caught before any device placement."""
+        mesh = multihost.global_mesh()
+        if mesh.devices.size < 2:
+            pytest.skip("needs > 1 device")
+        self._mock(monkeypatch, 0, 2)
+        with pytest.raises(ValueError, match="expected 32"):
+            multihost.shard_vector_global(rng.standard_normal(64), 64, mesh)
+
+    def test_error_message_names_process(self, rng, monkeypatch):
+        mesh = multihost.global_mesh()
+        if mesh.devices.size < 2:
+            pytest.skip("needs > 1 device")
+        self._mock(monkeypatch, 1, 2)
+        with pytest.raises(ValueError, match="process 1 holds 10"):
+            multihost.shard_vector_global(rng.standard_normal(10), 64, mesh)
