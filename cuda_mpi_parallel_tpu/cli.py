@@ -256,8 +256,6 @@ def main(argv=None) -> int:
             bad = f"--precond {args.precond} (None or jacobi only)"
         elif args.fmt == "dia":
             bad = "--format dia (csr/ell/shiftell/matrix-free only)"
-        elif args.method != "cg":
-            bad = f"--method {args.method} (textbook recurrence only)"
         elif not isinstance(a, (_CSR, _ELL, _S2, _S3)):
             bad = (f"{type(a).__name__} operators (dense df64 would need "
                    f"error-free MXU accumulation)")
@@ -297,7 +295,7 @@ def main(argv=None) -> int:
                     rtol=args.rtol, maxiter=args.maxiter,
                     preconditioner=args.precond,
                     record_history=args.history,
-                    check_every=args.check_every)
+                    check_every=args.check_every, method=args.method)
             from .solver.df64 import cg_df64
 
             return cg_df64(a, np.asarray(b, dtype=np.float64),
@@ -305,7 +303,8 @@ def main(argv=None) -> int:
                            maxiter=args.maxiter,
                            preconditioner=args.precond,
                            record_history=args.history,
-                           check_every=args.check_every)
+                           check_every=args.check_every,
+                           method=args.method)
         if args.mesh > 1:
             from .parallel import make_mesh, solve_distributed
             from .models.operators import CSRMatrix, Stencil2D, Stencil3D

@@ -90,12 +90,28 @@ def _split_const(dtype) -> float:
 
 
 def _two_prod(a: jax.Array, b: jax.Array):
-    """Dekker two-prod: p + err == a * b exactly (no FMA needed).
+    """Two-prod via Veltkamp halves and an ADD-ONLY error chain:
+    ``p + err == a * b`` to O(eps^2 |ab|), with no FMA and no dependence
+    on compiler rounding choices.
+
+    NOT the classic Dekker form.  Dekker computes
+    ``err = ah*bh - p + ...`` against the ROUNDED product ``p = fl(ab)``
+    - and XLA:CPU duplicates a cheap ``a*b`` into the consumer fusion,
+    where the emitter contracts ``ah*bh - a*b`` into an FMA so the error
+    is computed against the UNROUNDED product: the EFT silently
+    collapses to plain-f32 accuracy (observed: a jitted df64 axpy at
+    5e-9 error instead of 1e-14; ``lax.optimization_barrier`` is
+    REMOVED by the CPU pipeline, and --xla_allow_excess_precision=false
+    does not help).  Here instead every partial product of the split
+    halves is EXACT in the working precision (12+12-bit mantissas in
+    f32), and only add-only ``two_sum``s - which contraction cannot
+    touch - carry rounding, so the compiler has nothing to break.  The
+    residual O(eps^2) term from summing the corrections is the same
+    order df64's ``mul`` already drops (its lo*lo term).
 
     Veltkamp splitting overflows when |a| > ~max_float / split_const;
     fine for solver vectors, not for extreme dynamic ranges.
     """
-    p = a * b
     c = jnp.asarray(_split_const(a.dtype), a.dtype)
     ac = a * c
     ah = ac - (ac - a)
@@ -103,8 +119,9 @@ def _two_prod(a: jax.Array, b: jax.Array):
     bc = b * c
     bh = bc - (bc - b)
     bl = b - bh
-    err = ((ah * bh - p) + ah * bl + al * bh) + al * bl
-    return p, err
+    p, e1 = _two_sum(ah * bh, al * bh)
+    p, e2 = _two_sum(p, ah * bl)
+    return p, (e1 + e2) + al * bl
 
 
 def _sum_df(v: jax.Array):

@@ -32,7 +32,11 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.operators import Stencil2D, Stencil3D
 from ..ops import df64 as df
-from ..solver.df64 import DF64CGResult, _solve as _df_solve
+from ..solver.df64 import (
+    _VARIANTS,
+    DF64CGResult,
+    _solve as _df_solve,
+)
 from .halo import exchange_halo_axis
 from .mesh import make_mesh, shard_vector
 
@@ -134,6 +138,7 @@ def solve_distributed_df64(
     preconditioner: Optional[str] = None,
     record_history: bool = False,
     check_every: int = 1,
+    method: str = "cg",
 ) -> DF64CGResult:
     """df64 CG on a slab-partitioned stencil system over a device mesh.
 
@@ -146,6 +151,10 @@ def solve_distributed_df64(
       a: global ``Stencil2D`` or ``Stencil3D`` (matrix-free only).
       b: global rhs; a float64 numpy array keeps full df64 precision.
       preconditioner: ``None`` or ``"jacobi"`` (diag applied in df64).
+      method: ``"cg"`` (textbook: two psums/iteration), ``"cg1"``
+        (inner products fused into ONE psum - half the collective
+        latency) or ``"pipecg"`` (that psum overlaps the halo-exchanged
+        matvec).
       (mesh/n_devices/tol/rtol/maxiter/record_history/check_every as in
       ``solve_distributed`` / ``cg_df64``.)
 
@@ -163,6 +172,9 @@ def solve_distributed_df64(
         raise ValueError(
             f"solve_distributed_df64 supports preconditioner=None or "
             f"'jacobi', got {preconditioner!r}")
+    if method not in ("cg", "cg1", "pipecg"):
+        raise ValueError(f"unknown method {method!r}; expected 'cg', "
+                         f"'cg1' or 'pipecg'")
     if not isinstance(a, (Stencil2D, Stencil3D)):
         raise TypeError(
             f"solve_distributed_df64 supports matrix-free Stencil2D/"
@@ -191,7 +203,7 @@ def solve_distributed_df64(
         residual_history=P() if record_history else None,
         checkpoint=None)
     key = (local.local_grid, local.kind, axis, mesh, jacobi,
-           record_history, maxiter, check_every)
+           record_history, maxiter, check_every, method)
 
     def build():
         @partial(jax.shard_map, mesh=mesh,
@@ -199,6 +211,12 @@ def solve_distributed_df64(
                  out_specs=out)
         def run(bh_l, bl_l, sh, sl, t2h, t2l, r2h, r2l):
             loc = dataclasses.replace(local, scale_hi=sh, scale_lo=sl)
+            if method != "cg":
+                return _VARIANTS[method](
+                    loc, (bh_l, bl_l), (t2h, t2l), (r2h, r2l),
+                    maxiter=maxiter, record_history=record_history,
+                    jacobi=jacobi, axis_name=axis,
+                    check_every=check_every)
             return _df_solve(loc, (bh_l, bl_l), (t2h, t2l), (r2h, r2l),
                              None, maxiter=maxiter,
                              record_history=record_history, jacobi=jacobi,

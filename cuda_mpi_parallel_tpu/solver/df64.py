@@ -222,6 +222,7 @@ def cg_df64(
     resume_from: Optional[DF64Checkpoint] = None,
     return_checkpoint: bool = False,
     check_every: int = 1,
+    method: str = "cg",
 ) -> DF64CGResult:
     """CG with df64 storage (see module docstring).
 
@@ -242,11 +243,24 @@ def cg_df64(
     up to k-1 extra iterations may run past convergence; measured ~30%
     faster per iteration on v5e in the f32 solver, and df64 - 4x
     costlier per iteration - benefits at least as much).
+    ``method``: ``"cg"`` (textbook, the reference's recurrence),
+    ``"cg1"`` (Chronopoulos-Gear - every inner product fused into ONE
+    collective) or ``"pipecg"`` (Ghysels-Vanroose - that collective
+    overlaps the matvec; periodic residual replacement bounds drift).
+    Checkpoint/resume requires ``method="cg"``.
     """
     if preconditioner not in (None, "jacobi"):
         raise ValueError(
             f"cg_df64 supports preconditioner=None or 'jacobi', got "
             f"{preconditioner!r}")
+    if method not in ("cg", "cg1", "pipecg"):
+        raise ValueError(f"unknown method {method!r}; expected 'cg', "
+                         f"'cg1' or 'pipecg'")
+    if method != "cg" and (resume_from is not None or return_checkpoint):
+        raise ValueError(
+            "checkpoint/resume requires method='cg': DF64Checkpoint "
+            "carries the standard recurrence state, not the variants' "
+            "extra vectors")
     op = _prepare_operator(a, jacobi=preconditioner == "jacobi")
     if isinstance(b, np.ndarray) and b.dtype == np.float64:
         bh, bl = df.split_f64(b)
@@ -262,6 +276,11 @@ def cg_df64(
     tol2 = df.const(float(tol) ** 2)
     rtol2 = df.const(float(rtol) ** 2)
     jacobi = preconditioner == "jacobi"
+    if method != "cg":
+        impl = (_variant_jits if axis_name is None else _VARIANTS)[method]
+        return impl(op, b_df, tol2, rtol2, maxiter=maxiter,
+                    record_history=record_history, jacobi=jacobi,
+                    axis_name=axis_name, check_every=check_every)
     if axis_name is None:
         return _solve_jit(op, b_df, tol2, rtol2, resume_from,
                           maxiter=maxiter, record_history=record_history,
@@ -322,9 +341,7 @@ def _solve(op, b_df, tol2, rtol2, resume, *, maxiter, record_history,
         indef0 = jnp.zeros((), bool)
     # threshold^2 = max(tol^2, rtol^2 * ||r0||^2) as a df64 pair, with
     # the ORIGINAL solve's rr0 under resume
-    rt = df.mul(rtol2, rr_base)
-    thr = (jnp.maximum(tol2[0], rt[0]),
-           jnp.where(tol2[0] >= rt[0], tol2[1], rt[1]))
+    thr = _threshold(tol2, rtol2, rr_base)
     history0 = jnp.full(hist_len, jnp.nan, jnp.float32)
     if record_history:
         history0 = history0.at[k0].set(
@@ -400,3 +417,242 @@ _solve_jit = jax.jit(_solve, static_argnames=("maxiter", "record_history",
                                               "jacobi", "axis_name",
                                               "return_checkpoint",
                                               "check_every"))
+
+
+# -- single-reduction / pipelined variants ------------------------------------
+#
+# The df64 analogues of solver.cg's method="cg1" (Chronopoulos-Gear:
+# every per-iteration inner product fused into ONE collective) and
+# method="pipecg" (Ghysels-Vanroose: that one collective additionally
+# overlaps the iteration's matvec).  They matter most combined with
+# distribution: textbook df64 CG pays two psums per iteration
+# (solve_distributed_df64), cg1/pipecg pay one - the same
+# latency-hiding trade as the f32 variants, at f64-class precision.
+# Same iterates as method="cg" in exact arithmetic (tests check
+# trajectory parity); same safe-div freeze semantics under check_every.
+
+
+def _threshold(tol2: df.DF, rtol2: df.DF, rr0: df.DF) -> df.DF:
+    """threshold^2 = max(tol^2, rtol^2 * ||r0||^2) as a df64 pair."""
+    rt = df.mul(rtol2, rr0)
+    return (jnp.maximum(tol2[0], rt[0]),
+            jnp.where(tol2[0] >= rt[0], tol2[1], rt[1]))
+
+
+class _CG1State(NamedTuple):
+    k: jax.Array
+    x: df.DF
+    r: df.DF
+    p: df.DF
+    s: df.DF              # A @ p, maintained by recurrence
+    gamma: df.DF          # r . u (u = M^-1 r; == ||r||^2 unpreconditioned)
+    rr: df.DF             # ||r||^2
+    alpha: df.DF          # step length for the NEXT x/r update
+    indefinite: jax.Array
+    history: jax.Array
+
+
+class _PipeState(NamedTuple):
+    k: jax.Array
+    x: df.DF
+    r: df.DF
+    u: df.DF              # M^-1 r
+    w: df.DF              # A u
+    p: df.DF
+    s: df.DF              # A p
+    q: df.DF              # M^-1 s
+    z: df.DF              # A q
+    gamma: df.DF
+    rr: df.DF
+    alpha: df.DF
+    indefinite: jax.Array
+    history: jax.Array
+
+
+def _variant_cond(maxiter, thr):
+    def cond(st):
+        unconverged = jnp.logical_not(df.less(st.rr, thr))
+        nontrivial = st.rr[0] > 0.0
+        healthy = (jnp.isfinite(st.rr[0]) & jnp.isfinite(st.gamma[0])
+                   & jnp.isfinite(st.alpha[0]) & (st.gamma[0] > 0.0))
+        return (st.k < maxiter) & unconverged & nontrivial & healthy
+    return cond
+
+
+def _variant_package(final, thr, record_history) -> DF64CGResult:
+    converged = jnp.logical_or(df.less(final.rr, thr), final.rr[0] == 0.0)
+    healthy = (jnp.isfinite(final.rr[0]) & jnp.isfinite(final.gamma[0])
+               & jnp.isfinite(final.alpha[0])
+               & jnp.logical_or(final.gamma[0] > 0.0, final.rr[0] == 0.0))
+    status = jnp.where(
+        converged, CGStatus.CONVERGED.value,
+        jnp.where(jnp.logical_not(healthy), CGStatus.BREAKDOWN.value,
+                  CGStatus.MAXITER.value))
+    return DF64CGResult(
+        x_hi=final.x[0], x_lo=final.x[1], iterations=final.k,
+        residual_norm_sq_hi=final.rr[0], residual_norm_sq_lo=final.rr[1],
+        converged=converged, status=status, indefinite=final.indefinite,
+        residual_history=final.history if record_history else None,
+        checkpoint=None)
+
+
+def _variant_init(op, b_df, jacobi, axis_name):
+    """Shared x0=0 init for cg1/pipecg: returns (mv, d, x0, r0, u0, w0,
+    rr0, gamma0, delta0, alpha0)."""
+    n = b_df[0].shape[0]
+    d = (op.diag_hi, op.diag_lo)
+    mv = op.matvec_df if hasattr(op, "matvec_df") else op.matvec
+    x0 = (jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32))
+    if axis_name is not None:
+        x0 = tuple(lax.pcast(v, axis_name, to="varying") for v in x0)
+    r0 = b_df  # x0 = 0 fast path (CUDACG.cu:247-259)
+    u0 = df.div(r0, d) if jacobi else r0
+    w0 = mv(u0)
+    if jacobi:
+        rr0, gamma0, delta0 = df.fused_dots(
+            [(r0, r0), (r0, u0), (w0, u0)], axis_name=axis_name)
+    else:
+        rr0, delta0 = df.fused_dots([(r0, r0), (w0, r0)],
+                                    axis_name=axis_name)
+        gamma0 = rr0
+    alpha0 = _safe_div(gamma0, delta0)
+    return mv, d, x0, r0, u0, w0, rr0, gamma0, delta0, alpha0
+
+
+def _history0(record_history, maxiter, rr0):
+    hist = jnp.full(maxiter + 1 if record_history else 0, jnp.nan,
+                    jnp.float32)
+    if record_history:
+        hist = hist.at[0].set(jnp.sqrt(jnp.maximum(rr0[0], 0.0)))
+    return hist
+
+
+def _solve_cg1(op, b_df, tol2, rtol2, *, maxiter, record_history, jacobi,
+               axis_name, check_every=1):
+    mv, d, x0, r0, u0, w0, rr0, gamma0, delta0, alpha0 = _variant_init(
+        op, b_df, jacobi, axis_name)
+    thr = _threshold(tol2, rtol2, rr0)
+    st0 = _CG1State(
+        k=jnp.zeros((), jnp.int32), x=x0, r=r0, p=u0, s=w0,
+        gamma=gamma0, rr=rr0, alpha=alpha0,
+        indefinite=jnp.logical_and(delta0[0] <= 0.0, rr0[0] > 0.0),
+        history=_history0(record_history, maxiter, rr0))
+
+    def step(st: _CG1State) -> _CG1State:
+        x = df.axpy(st.alpha, st.p, st.x)
+        r = df.axpy(df.neg(st.alpha), st.s, st.r)
+        u = df.div(r, d) if jacobi else r
+        w = mv(u)
+        if jacobi:
+            rr, gamma, delta = df.fused_dots(
+                [(r, r), (r, u), (w, u)], axis_name=axis_name)
+        else:
+            rr, delta = df.fused_dots([(r, r), (w, r)],
+                                      axis_name=axis_name)
+            gamma = rr
+        beta = _safe_div(gamma, st.gamma)
+        # denom == p_new . A p_new in exact arithmetic
+        denom = df.sub(delta, df.mul(beta, _safe_div(gamma, st.alpha)))
+        alpha = _safe_div(gamma, denom)
+        p = df.axpy(beta, st.p, u)
+        s = df.axpy(beta, st.s, w)
+        k = st.k + 1
+        history = st.history
+        if record_history:
+            history = history.at[k].set(
+                jnp.sqrt(jnp.maximum(rr[0], 0.0)))
+        return _CG1State(
+            k=k, x=x, r=r, p=p, s=s, gamma=gamma, rr=rr, alpha=alpha,
+            indefinite=jnp.logical_or(
+                st.indefinite,
+                jnp.logical_and(denom[0] <= 0.0, rr[0] > 0.0)),
+            history=history)
+
+    final = _blocked_while(_variant_cond(maxiter, thr), step, st0,
+                           check_every,
+                           lambda t: t.k + check_every <= maxiter)
+    return _variant_package(final, thr, record_history)
+
+
+# df64 drift behaves like f64's (slow): the long replacement cadence
+# keeps the ~3-matvec recompute negligible (see cg._replace_cadence)
+_REPLACE_CADENCE_DF64 = 512
+
+
+def _solve_pipecg(op, b_df, tol2, rtol2, *, maxiter, record_history,
+                  jacobi, axis_name, check_every=1):
+    mv, d, x0, r0, u0, w0, rr0, gamma0, delta0, alpha0 = _variant_init(
+        op, b_df, jacobi, axis_name)
+    m0 = df.div(w0, d) if jacobi else w0
+    n0 = mv(m0)
+    thr = _threshold(tol2, rtol2, rr0)
+    st0 = _PipeState(
+        k=jnp.zeros((), jnp.int32), x=x0, r=r0, u=u0, w=w0,
+        p=u0, s=w0, q=m0, z=n0,
+        gamma=gamma0, rr=rr0, alpha=alpha0,
+        indefinite=jnp.logical_and(delta0[0] <= 0.0, rr0[0] > 0.0),
+        history=_history0(record_history, maxiter, rr0))
+
+    def replace(x, p):
+        """Recompute derived vectors from definition (drift reset)."""
+        r = df.sub(b_df, mv(x))
+        u = df.div(r, d) if jacobi else r
+        w = mv(u)
+        s = mv(p)
+        q = df.div(s, d) if jacobi else s
+        z = mv(q)
+        return r, u, w, s, q, z
+
+    def step(st: _PipeState) -> _PipeState:
+        x = df.axpy(st.alpha, st.p, st.x)
+        r = df.axpy(df.neg(st.alpha), st.s, st.r)
+        u = df.axpy(df.neg(st.alpha), st.q, st.u)
+        w = df.axpy(df.neg(st.alpha), st.z, st.w)
+        k = st.k + 1
+        r, u, w, s_old, q_old, z_old = lax.cond(
+            (k % _REPLACE_CADENCE_DF64) == 0,
+            lambda: replace(x, st.p),
+            lambda: (r, u, w, st.s, st.q, st.z))
+        # the fused reduction depends only on (r, u, w); the matvec below
+        # only on w - independent, so the psum overlaps the matvec
+        if jacobi:
+            rr, gamma, delta = df.fused_dots(
+                [(r, r), (r, u), (w, u)], axis_name=axis_name)
+            mm = df.div(w, d)
+        else:
+            rr, delta = df.fused_dots([(r, r), (w, r)],
+                                      axis_name=axis_name)
+            gamma = rr
+            mm = w
+        nn = mv(mm)
+        beta = _safe_div(gamma, st.gamma)
+        denom = df.sub(delta, df.mul(beta, _safe_div(gamma, st.alpha)))
+        alpha = _safe_div(gamma, denom)
+        p = df.axpy(beta, st.p, u)
+        s = df.axpy(beta, s_old, w)
+        q = df.axpy(beta, q_old, mm)
+        z = df.axpy(beta, z_old, nn)
+        history = st.history
+        if record_history:
+            history = history.at[k].set(
+                jnp.sqrt(jnp.maximum(rr[0], 0.0)))
+        return _PipeState(
+            k=k, x=x, r=r, u=u, w=w, p=p, s=s, q=q, z=z,
+            gamma=gamma, rr=rr, alpha=alpha,
+            indefinite=jnp.logical_or(
+                st.indefinite,
+                jnp.logical_and(denom[0] <= 0.0, rr[0] > 0.0)),
+            history=history)
+
+    final = _blocked_while(_variant_cond(maxiter, thr), step, st0,
+                           check_every,
+                           lambda t: t.k + check_every <= maxiter)
+    return _variant_package(final, thr, record_history)
+
+
+_VARIANTS = {"cg1": _solve_cg1, "pipecg": _solve_pipecg}
+_variant_jits = {
+    name: jax.jit(fn, static_argnames=("maxiter", "record_history",
+                                       "jacobi", "axis_name",
+                                       "check_every"))
+    for name, fn in _VARIANTS.items()}

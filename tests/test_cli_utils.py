@@ -223,3 +223,17 @@ class TestUtils:
         assert rec["status"] == "CONVERGED"
         assert rec["iters_per_sec"] == pytest.approx(6.0)
         assert "iter " in ulog.format_history(res)
+
+
+def test_df64_variant_methods(capsys):
+    """--dtype df64 --method cg1/pipecg: the fused single-collective df64
+    recurrences reach f64-class depth through the CLI."""
+    import json as _json
+
+    for method in ("cg1", "pipecg"):
+        rc = cli.main(["--problem", "poisson2d", "--n", "16", "--device",
+                       "cpu", "--dtype", "df64", "--method", method,
+                       "--tol", "0", "--rtol", "1e-10", "--json"])
+        rec = _json.loads(capsys.readouterr().out)
+        assert rc == 0 and rec["converged"], method
+        assert rec["residual_norm"] < 1e-7

@@ -315,3 +315,134 @@ class TestCGParity:
         true_res = np.linalg.norm(
             b - np.asarray(a.to_dense(), dtype=np.float64) @ r.x())
         assert true_res / np.linalg.norm(b) < 1e-11
+
+
+class TestDF64Variants:
+    """cg1 (single-reduction) and pipecg (overlapped) df64 variants:
+    same iterates as the textbook recurrence in exact arithmetic, one
+    fused collective per iteration on a mesh (ops.df64.fused_dots)."""
+
+    def _system(self, rng, n=20):
+        op = poisson.poisson_2d_operator(n, n, dtype=jnp.float64)
+        x_true = rng.standard_normal(n * n)
+        b = np.asarray(op @ jnp.asarray(x_true), dtype=np.float64)
+        op32 = poisson.poisson_2d_operator(n, n, dtype=jnp.float32)
+        return op32, b, x_true
+
+    @pytest.mark.parametrize("method", ["cg1", "pipecg"])
+    def test_trajectory_parity_with_cg(self, rng, method):
+        op, b, _ = self._system(rng)
+        base = cg_df64(op, b, tol=0.0, maxiter=30, record_history=True)
+        var = cg_df64(op, b, tol=0.0, maxiter=30, record_history=True,
+                      method=method)
+        # identical recurrence in exact arithmetic: histories agree far
+        # beyond f32 depth (compared at the f32 storage resolution)
+        np.testing.assert_allclose(
+            np.asarray(var.residual_history),
+            np.asarray(base.residual_history), rtol=1e-4)
+
+    @pytest.mark.parametrize("method", ["cg1", "pipecg"])
+    def test_reaches_f64_depth(self, rng, method):
+        op, b, x_true = self._system(rng)
+        r = cg_df64(op, b, tol=0.0, rtol=1e-11, maxiter=5000,
+                    method=method)
+        assert bool(r.converged)
+        np.testing.assert_allclose(r.x(), x_true, atol=1e-7)
+
+    @pytest.mark.parametrize("method", ["cg1", "pipecg"])
+    def test_jacobi_and_check_every(self, rng, method):
+        op, b, x_true = self._system(rng)
+        r = cg_df64(op, b, tol=0.0, rtol=1e-10, maxiter=5000,
+                    method=method, preconditioner="jacobi",
+                    check_every=8)
+        assert bool(r.converged)
+        np.testing.assert_allclose(r.x(), x_true, atol=1e-6)
+
+    def test_oracle_cg1(self):
+        """The reference's indefinite 3x3 system through the
+        single-reduction recurrence (quirk Q1 still recorded)."""
+        a, b, x_exp = poisson.oracle_system(dtype=jnp.float64)
+        r = cg_df64(a, np.asarray(b, np.float64), tol=1e-7, method="cg1")
+        assert bool(r.converged) and bool(r.indefinite)
+        assert int(r.iterations) == 3
+        np.testing.assert_allclose(r.x(), np.asarray(x_exp), atol=1e-10)
+
+    def test_exact_solve_freeze(self, rng):
+        """A = I under check_every blocking: overrun steps freeze via
+        _safe_div in the variants too."""
+        n = 64
+        rows = np.arange(n, dtype=np.int32)
+        a = CSRMatrix.from_coo(rows, rows, np.ones(n), n,
+                               dtype=np.float64)
+        b = rng.standard_normal(n)
+        for method in ("cg1", "pipecg"):
+            r = cg_df64(a.to_ell(), b, tol=1e-12, maxiter=64,
+                        check_every=8, method=method)
+            assert bool(r.converged), method
+            np.testing.assert_allclose(r.x(), b, rtol=1e-13)
+
+    def test_checkpoint_requires_cg(self, rng):
+        op, b, _ = self._system(rng, n=8)
+        with pytest.raises(ValueError, match="method='cg'"):
+            cg_df64(op, b, method="cg1", return_checkpoint=True)
+
+    def test_fused_dots_matches_dot(self, rng):
+        a, va = _rand_df(rng, 4096)
+        b, vb = _rand_df(rng, 4096)
+        [d1, d2] = df.fused_dots([(a, b), (a, a)])
+        np.testing.assert_allclose(df.to_f64(*d1), float(va @ vb),
+                                   rtol=1e-13)
+        np.testing.assert_allclose(df.to_f64(*d2), float(va @ va),
+                                   rtol=1e-13)
+
+
+class TestCompilerEFTSafety:
+    """Regression: XLA:CPU duplicates cheap products into consumer
+    fusions and contracts them into FMAs, which broke the classic Dekker
+    two-prod (error computed against the UNROUNDED product - df64 axpy
+    degraded to 5e-9).  The add-only two_prod formulation must hold df64
+    accuracy under jit in exactly the fusion contexts that failed."""
+
+    def test_jitted_axpy_with_negated_scalar(self, rng):
+        n = 4096
+        (q, qv) = _rand_df(rng, n)
+        (u, uv) = _rand_df(rng, n)
+        ah, al = df.split_f64(np.float64(-0.037123456789))
+        alpha = (jnp.asarray(ah), jnp.asarray(al))
+        av = float(np.float64(ah) + np.float64(al))
+
+        j = jax.jit(lambda a, x, y: df.axpy(df.neg(a), x, y))(alpha, q, u)
+        err = np.max(np.abs(df.to_f64(*j) - (-av * qv + uv)))
+        assert err < 1e-12, f"df64 axpy degraded under jit: {err:.3e}"
+
+    def test_jitted_paired_axpys_share_scalar(self, rng):
+        """The pipecg shape that exposed the bug: two axpys sharing a
+        negated scalar inside ONE jit."""
+        n = 4096
+        (q, qv) = _rand_df(rng, n)
+        (u, uv) = _rand_df(rng, n)
+        (s, sv) = _rand_df(rng, n)
+        (r, rv) = _rand_df(rng, n)
+        ah, al = df.split_f64(np.float64(-0.037123456789))
+        alpha = (jnp.asarray(ah), jnp.asarray(al))
+        av = float(np.float64(ah) + np.float64(al))
+
+        def two(a, q, u, s, r):
+            return (df.axpy(df.neg(a), s, r), df.axpy(df.neg(a), q, u))
+
+        jr, ju = jax.jit(two)(alpha, q, u, s, r)
+        assert np.max(np.abs(df.to_f64(*jr) - (-av * sv + rv))) < 1e-12
+        assert np.max(np.abs(df.to_f64(*ju) - (-av * qv + uv))) < 1e-12
+
+    def test_two_prod_exactness(self, rng):
+        """p + err == a*b to O(eps^2): the add-only decomposition keeps
+        the two-prod contract the compensated dots rely on."""
+        from cuda_mpi_parallel_tpu.ops.blas1 import _two_prod
+
+        a = jnp.asarray(rng.standard_normal(10000), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(10000), jnp.float32)
+        p, e = jax.jit(_two_prod)(a, b)
+        exact = (np.asarray(a, np.float64) * np.asarray(b, np.float64))
+        got = np.asarray(p, np.float64) + np.asarray(e, np.float64)
+        rel = np.max(np.abs(got - exact) / np.maximum(np.abs(exact), 1e-30))
+        assert rel < 2.0 ** -45

@@ -143,3 +143,53 @@ class TestDistSolveDF64:
         with pytest.raises(ValueError, match="jacobi"):
             solve_distributed_df64(a, np.ones(64), mesh=make_mesh(2),
                                    preconditioner="mg")
+
+
+class TestDistVariantsDF64:
+    """Distributed cg1/pipecg: the fused single-psum recurrences over
+    the mesh - the configuration these variants exist for (one
+    collective per iteration instead of two), exercising fused_dots'
+    stacked-psum branch."""
+
+    @pytest.mark.parametrize("method", ["cg1", "pipecg"])
+    def test_matches_cg_on_mesh(self, rng, method):
+        grid = (16, 12)
+        a = Stencil2D.create(*grid, dtype=jnp.float32)
+        op64 = Stencil2D.create(*grid, dtype=jnp.float64)
+        x_true = rng.standard_normal(int(np.prod(grid)))
+        b = np.asarray(op64 @ jnp.asarray(x_true), dtype=np.float64)
+        base = solve_distributed_df64(a, b, mesh=make_mesh(8), tol=0.0,
+                                      rtol=1e-10, maxiter=2000)
+        var = solve_distributed_df64(a, b, mesh=make_mesh(8), tol=0.0,
+                                     rtol=1e-10, maxiter=2000,
+                                     method=method)
+        assert bool(var.converged)
+        assert abs(int(var.iterations) - int(base.iterations)) <= 3
+        np.testing.assert_allclose(var.x(), x_true, atol=1e-7)
+
+    def test_fused_dots_psum_branch(self, rng):
+        """fused_dots under shard_map: one stacked psum, per-pair df64
+        results matching the full-vector dots."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        mesh = make_mesh(8)
+        n = 64
+        (ah, al), va = (lambda v: (df.split_f64(v), v))(
+            rng.standard_normal(n))
+        (bh, bl), vb = (lambda v: (df.split_f64(v), v))(
+            rng.standard_normal(n))
+        a_pair = (jnp.asarray(ah), jnp.asarray(al))
+        b_pair = (jnp.asarray(bh), jnp.asarray(bl))
+
+        def body(a, b):
+            [d1, d2] = df.fused_dots([(a, b), (a, a)], axis_name="rows")
+            return d1, d2
+
+        (d1, d2) = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(P("rows"), P("rows")),
+            out_specs=(P(), P())))(a_pair, b_pair)
+        np.testing.assert_allclose(df.to_f64(*jax.tree.map(np.asarray, d1)),
+                                   float(va @ vb), rtol=1e-13)
+        np.testing.assert_allclose(df.to_f64(*jax.tree.map(np.asarray, d2)),
+                                   float(va @ va), rtol=1e-13)
