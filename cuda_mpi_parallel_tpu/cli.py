@@ -249,9 +249,12 @@ def main(argv=None) -> int:
         )
 
         bad = None
-        if args.mesh > 1 and not isinstance(a, (_S2, _S3)):
-            bad = ("--mesh > 1 with assembled operators (distributed "
-                   "df64 is matrix-free stencil only; add --matrix-free)")
+        if args.mesh > 1 and not isinstance(a, (_CSR, _S2, _S3)):
+            bad = ("--mesh > 1 with this operator (distributed df64 "
+                   "supports matrix-free stencils and assembled CSR)")
+        elif args.mesh > 1 and args.fmt != "csr":
+            bad = (f"--format {args.fmt} with --mesh > 1 (distributed "
+                   f"CSR uses the df64 ring-shiftell schedule directly)")
         elif args.precond not in (None, "jacobi"):
             bad = f"--precond {args.precond} (None or jacobi only)"
         elif args.fmt == "dia":

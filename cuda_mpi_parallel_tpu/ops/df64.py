@@ -124,20 +124,29 @@ def axpy(alpha: DF, x: DF, y: DF) -> DF:
     return add(mul(alpha, x), y)
 
 
+def _fold_df(hi: jax.Array, lo: jax.Array) -> DF:
+    """Reduce a (hi, lo) pair over its LEADING axis through the pairwise
+    half-folding tree of full df64 adds (half-folds, never strided
+    slices - see ``blas1._sum_df`` for the TPU tiling reason).  Shared
+    by the local dot tree and the cross-device reduction."""
+    while hi.shape[0] > 1:
+        m = hi.shape[0]
+        h = (m + 1) // 2
+        if m % 2:
+            pad_width = [(0, 1)] + [(0, 0)] * (hi.ndim - 1)
+            hi = jnp.pad(hi, pad_width)
+            lo = jnp.pad(lo, pad_width)
+        hi, lo = add((hi[:h], lo[:h]), (hi[h:], lo[h:]))
+    return hi[0], lo[0]
+
+
 def _dot_local(x: DF, y: DF) -> DF:
     """Per-device df64 dot partial: the pairwise half-folding tree of
     full df64 adds, no collective (see :func:`dot`)."""
     p, e = _two_prod(x[0], y[0])
     e = e + (x[0] * y[1] + x[1] * y[0])
     hi, lo = _two_sum(p, e)  # renormalize the leaves
-    while hi.shape[0] > 1:
-        m = hi.shape[0]
-        h = (m + 1) // 2
-        if m % 2:
-            hi = jnp.pad(hi, [(0, 1)])
-            lo = jnp.pad(lo, [(0, 1)])
-        hi, lo = add((hi[:h], lo[:h]), (hi[h:], lo[h:]))
-    return hi[0], lo[0]
+    return _fold_df(hi, lo)
 
 
 def _allreduce_df(hi: jax.Array, lo: jax.Array, axis_name) -> DF:
@@ -159,15 +168,7 @@ def _allreduce_df(hi: jax.Array, lo: jax.Array, axis_name) -> DF:
     buf = jnp.zeros((n_shards, 2) + hi.shape, hi.dtype)
     buf = buf.at[idx, 0].set(hi).at[idx, 1].set(lo)
     g = lax.psum(buf, axis_name)  # (P, 2, ...): exact per element
-    h, l = g[:, 0], g[:, 1]
-    while h.shape[0] > 1:
-        m = h.shape[0]
-        half = (m + 1) // 2
-        if m % 2:
-            h = jnp.concatenate([h, jnp.zeros_like(h[:1])])
-            l = jnp.concatenate([l, jnp.zeros_like(l[:1])])
-        h, l = add((h[:half], l[:half]), (h[half:], l[half:]))
-    return h[0], l[0]
+    return _fold_df(g[:, 0], g[:, 1])
 
 
 def dot(x: DF, y: DF, *, axis_name: Optional[str] = None) -> DF:

@@ -17,6 +17,7 @@ from .mesh import (
 from .operators import (
     DistCSR,
     DistCSRRing,
+    DistShiftELLDF64Ring,
     DistShiftELLRing,
     DistStencil2D,
     DistStencil3D,
@@ -34,6 +35,7 @@ __all__ = [
     "ROWS_AXIS",
     "DistCSR",
     "DistCSRRing",
+    "DistShiftELLDF64Ring",
     "DistShiftELLRing",
     "DistStencil2D",
     "DistStencil3D",

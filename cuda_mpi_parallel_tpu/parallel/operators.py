@@ -439,3 +439,67 @@ class DistShiftELLRing(LinearOperator):
 
     def diagonal(self):
         return self.diag
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("vals_hi", "vals_lo", "lane_idx", "chunk_blocks",
+                 "diag_hi", "diag_lo"),
+    meta_fields=("h", "kc", "n_local", "axis_name", "n_shards"),
+)
+@dataclasses.dataclass(frozen=True)
+class DistShiftELLDF64Ring:
+    """Ring-scheduled distributed df64 SpMV with pallas shift-ELL slabs.
+
+    The double-float sibling of ``DistShiftELLRing`` - f64-class
+    assembled SpMV over the mesh, the reference's ``CUDA_R_64F`` CSR
+    SpMV (``CUDACG.cu:216,288``) at the repo name's promised MPI tier.
+    Both (hi, lo) planes of the rotating x-block ride ONE ``ppermute``
+    (stacked), each step's local multiply is the df64 lane-gather kernel
+    (``shift_ell_matvec_df64``), and step products accumulate through
+    the accurate df64 add.  NOT a ``LinearOperator``: ``matvec_df``
+    takes/returns (hi, lo) pairs; use with ``solve_distributed_df64``.
+    Built by ``partition.ring_partition_shiftell_df64``.
+    """
+
+    vals_hi: Tuple[jax.Array, ...]       # per step: (C_t, kc, h+1, 128)
+    vals_lo: Tuple[jax.Array, ...]
+    lane_idx: Tuple[jax.Array, ...]      # per step: (C_t, kc, h, 128)
+    chunk_blocks: Tuple[jax.Array, ...]  # per step: (C_t,) i32
+    diag_hi: jax.Array                   # (n_local,)
+    diag_lo: jax.Array
+    h: int
+    kc: int
+    n_local: int
+    axis_name: str
+    n_shards: int
+
+    @property
+    def shape(self):
+        return (self.n_local, self.n_local * self.n_shards)
+
+    def matvec_df(self, x):
+        from ..models.operators import _pallas_interpret
+        from ..ops import df64 as df
+        from ..ops.pallas import spmv as pk
+
+        n = self.n_shards
+        nch = -(-self.n_local // pk.LANES)
+        nch_pad = -(-nch // self.h) * self.h
+        ring = [(j, (j - 1) % n) for j in range(n)]
+        interpret = _pallas_interpret()
+        y = (jnp.zeros_like(x[0]), jnp.zeros_like(x[1]))
+        xb = jnp.stack([x[0], x[1]])  # both planes rotate in one ppermute
+        for t in range(n):  # static unroll: n is a mesh constant
+            step = pk.shift_ell_matvec_df64(
+                xb[0], xb[1], self.vals_hi[t], self.vals_lo[t],
+                self.lane_idx[t], self.chunk_blocks[t],
+                h=self.h, kc=self.kc, n=self.n_local, nch=nch,
+                nch_pad=nch_pad, pad=self.h, interpret=interpret)
+            y = df.add(y, step)
+            if t + 1 < n:
+                xb = lax.ppermute(xb, self.axis_name, perm=ring)
+        return y
+
+    def diagonal_df(self):
+        return self.diag_hi, self.diag_lo

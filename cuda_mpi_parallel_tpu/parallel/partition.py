@@ -184,16 +184,38 @@ class RingPartitionedShiftELL(NamedTuple):
     n_shards: int
 
 
-def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *,
-                            h: int | None = None,
-                            kc: int = 8) -> RingPartitionedShiftELL:
-    """Ring-split ``a`` and pack every (owner, step) slab to shift-ELL.
+class RingPartitionedShiftELLDF64(NamedTuple):
+    """Double-float sibling of :class:`RingPartitionedShiftELL`: each
+    slab's values split into (hi, lo) f32 planes for the pallas df64
+    lane-gather kernel - f64-class assembled SpMV over the ring
+    (the reference's ``CUDA_R_64F`` CSR x the repo name's MPI tier)."""
 
-    Each slab is an ``n_local x n_local`` sparse block; per step, the
-    grid depth is sized by the cost model (``sheets_per_block``) across
-    owners first, so every slab is packed exactly once with the shared
-    shape.  ``h=None`` auto-tunes the block height on the densest slab
-    (step 0, the own-block diagonal coupling).
+    vals_hi: Tuple[np.ndarray, ...]
+    vals_lo: Tuple[np.ndarray, ...]
+    lane_idx: Tuple[np.ndarray, ...]
+    chunk_blocks: Tuple[np.ndarray, ...]
+    diag_hi: np.ndarray         # (n_shards, n_local)
+    diag_lo: np.ndarray
+    h: int
+    kc: int
+    n_local: int
+    n_global_padded: int
+    n_global: int
+    n_shards: int
+
+
+def _ring_pack_slabs(a: CSRMatrix, n_shards: int, h: int | None, kc: int,
+                     *, itemsize: int, lift, pack):
+    """Shared core of the ring shift-ELL partitioners.
+
+    Ring-splits ``a``, rebuilds each (owner, step) slab as CSR (``lift``
+    maps slab values to the packing dtype), auto-tunes ``h`` on the
+    densest slab (step 0, the own-block diagonal coupling) at
+    ``itemsize``, sizes each step's grid depth by the cost model across
+    owners, and packs every slab with ``pack`` under the shared shape
+    (shard_map needs identical shapes per device).  Returns
+    ``(ring, n_local, h, steps)`` with ``steps[t]`` the per-owner list
+    of packed slabs.
     """
     from ..ops.pallas import spmv as pk
 
@@ -201,7 +223,7 @@ def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *,
     n_local = ring.n_local
 
     def slab_csr(t, s):
-        d = ring.data[t][s]
+        d = lift(ring.data[t][s])
         c = ring.cols[t][s]
         r = ring.local_rows[t][s]
         live = d != 0
@@ -215,9 +237,9 @@ def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *,
     slab00 = slab_csr(0, 0)
     if h is None:
         h = pk.choose_h(slab00[0], slab00[1], n_local, kc=kc,
-                        itemsize=np.asarray(a.data).dtype.itemsize)
+                        itemsize=itemsize)
 
-    vals_steps, meta_steps, blk_steps = [], [], []
+    steps = []
     for t in range(n_shards):
         slabs = [slab00 if (t, s) == (0, 0) else slab_csr(t, s)
                  for s in range(n_shards)]
@@ -226,19 +248,73 @@ def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *,
                 -(-pk.sheets_per_block(ip, ix, n_local, h=h) // kc),
                 1).sum())
             for ip, ix, _ in slabs)
-        packed = [pk.pack_shift_ell(*slab, n_local, h=h, kc=kc,
-                                    n_chunks=c_t)
-                  for slab in slabs]
-        vals_steps.append(np.stack([p.vals for p in packed]))
-        meta_steps.append(np.stack([p.lane_idx for p in packed]))
-        blk_steps.append(np.stack([p.chunk_blocks for p in packed]))
+        steps.append([pack(*slab, n_local, h=h, kc=kc, n_chunks=c_t)
+                      for slab in slabs])
+    return ring, n_local, h, steps
+
+
+def ring_partition_shiftell_df64(a: CSRMatrix, n_shards: int, *,
+                                 h: int | None = None,
+                                 kc: int = 8) -> RingPartitionedShiftELLDF64:
+    """Ring-split + df64 shift-ELL packing (see ring_partition_shiftell).
+
+    Matrix values are lifted to float64 on the host before packing, so
+    f64-valued problems (possible on x64 hosts / from f64 loaders) keep
+    their low words; f32-stored data packs exactly with zero lo planes.
+    The per-plane VMEM budget is checked by the packer at f64 itemsize -
+    the two f32 x planes occupy the same bytes as one f64 plane.
+    """
+    from ..ops.pallas import spmv as pk
+
+    ring, n_local, h, steps = _ring_pack_slabs(
+        a, n_shards, h, kc, itemsize=8,
+        lift=lambda d: np.asarray(d, dtype=np.float64),
+        pack=pk.pack_shift_ell_df64)
+
+    diag64 = np.zeros(ring.n_global_padded, dtype=np.float64)
+    diag64[: ring.n_global] = np.asarray(a.diagonal(), dtype=np.float64)
+    diag64[ring.n_global:] = 1.0  # unit-diagonal padding rows
+    diag_hi = diag64.astype(np.float32)
+    diag_lo = (diag64 - diag_hi.astype(np.float64)).astype(np.float32)
+    return RingPartitionedShiftELLDF64(
+        vals_hi=tuple(np.stack([p.vals_hi for p in ps]) for ps in steps),
+        vals_lo=tuple(np.stack([p.vals_lo for p in ps]) for ps in steps),
+        lane_idx=tuple(np.stack([p.lane_idx for p in ps]) for ps in steps),
+        chunk_blocks=tuple(np.stack([p.chunk_blocks for p in ps])
+                           for ps in steps),
+        diag_hi=diag_hi.reshape(n_shards, n_local),
+        diag_lo=diag_lo.reshape(n_shards, n_local),
+        h=h, kc=kc, n_local=n_local,
+        n_global_padded=ring.n_global_padded, n_global=ring.n_global,
+        n_shards=n_shards)
+
+
+def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *,
+                            h: int | None = None,
+                            kc: int = 8) -> RingPartitionedShiftELL:
+    """Ring-split ``a`` and pack every (owner, step) slab to shift-ELL.
+
+    Each slab is an ``n_local x n_local`` sparse block; per step, the
+    grid depth is sized by the cost model (``sheets_per_block``) across
+    owners first, so every slab is packed exactly once with the shared
+    shape.  ``h=None`` auto-tunes the block height on the densest slab
+    (step 0, the own-block diagonal coupling).
+    """
+    from ..ops.pallas import spmv as pk
+
+    ring, n_local, h, steps = _ring_pack_slabs(
+        a, n_shards, h, kc,
+        itemsize=np.asarray(a.data).dtype.itemsize,
+        lift=lambda d: d, pack=pk.pack_shift_ell)
 
     diag = np.zeros(ring.n_global_padded, dtype=np.asarray(a.data).dtype)
     diag[: ring.n_global] = np.asarray(a.diagonal())
     diag[ring.n_global:] = 1.0  # unit-diagonal padding rows
     return RingPartitionedShiftELL(
-        vals=tuple(vals_steps), lane_idx=tuple(meta_steps),
-        chunk_blocks=tuple(blk_steps),
+        vals=tuple(np.stack([p.vals for p in ps]) for ps in steps),
+        lane_idx=tuple(np.stack([p.lane_idx for p in ps]) for ps in steps),
+        chunk_blocks=tuple(np.stack([p.chunk_blocks for p in ps])
+                           for ps in steps),
         diag=diag.reshape(n_shards, n_local), h=h, kc=kc,
         n_local=n_local,
         n_global_padded=ring.n_global_padded, n_global=ring.n_global,

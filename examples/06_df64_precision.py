@@ -44,3 +44,22 @@ print(f"f32  : iters={int(r32.iterations):5d} {r32.status_enum().name:9s} "
       f"max|x - x_true| = {err32:.2e}")
 print(f"df64 : iters={int(rdf.iterations):5d} {rdf.status_enum().name:9s} "
       f"max|x - x_true| = {errdf:.2e}")
+
+# round 3: the ASSEMBLED path at pallas speed - df64 shift-ELL (the
+# reference's CUDA_R_64F CSR SpMV, CUDACG.cu:216,288).  Compiled on TPU;
+# pallas interpret mode on CPU hosts, hence the smaller demo system.
+m = 48
+a_csr = poisson.poisson_2d_csr(m, m, dtype=np.float64)
+xs_true = rng.standard_normal(m * m)
+bs64 = np.asarray(a_csr.to_dense(), np.float64) @ xs_true
+rsell = cg_df64(a_csr.to_shiftell_df64(), bs64, tol=0.0, rtol=1e-11,
+                maxiter=5000)
+errs = np.abs(rsell.x() - xs_true).max()
+print(f"df64 shift-ELL ({m}x{m}): iters={int(rsell.iterations):4d} "
+      f"max|x - x_true| = {errs:.2e}")
+
+# single-reduction recurrence: every inner product in ONE collective
+rcg1 = cg_df64(op, b64, tol=0.0, rtol=1e-12, maxiter=20000, method="cg1",
+               check_every=16)
+print(f"df64 cg1 ck16  : iters={int(rcg1.iterations):5d} "
+      f"max|x - x_true| = {np.abs(rcg1.x() - x_true).max():.2e}")

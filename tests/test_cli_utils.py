@@ -99,10 +99,15 @@ class TestCLI:
         with pytest.raises(SystemExit, match="df64"):
             cli.main(["--problem", "poisson2d", "--n", "8", "--device",
                       "cpu", "--dtype", "df64", "--precond", "chebyshev"])
-        # assembled operators stay single-device in df64
-        with pytest.raises(SystemExit, match="matrix-free"):
-            cli.main(["--problem", "poisson2d", "--n", "8", "--device",
+        # dense operators have no distributed df64 route
+        with pytest.raises(SystemExit, match="df64"):
+            cli.main(["--problem", "random-spd", "--n", "8", "--device",
                       "cpu", "--dtype", "df64", "--mesh", "2"])
+        # pre-converted formats don't combine with a mesh
+        with pytest.raises(SystemExit, match="ring-shiftell"):
+            cli.main(["--problem", "poisson2d", "--n", "8", "--device",
+                      "cpu", "--dtype", "df64", "--mesh", "2",
+                      "--format", "shiftell"])
         with pytest.raises(SystemExit, match="DenseOperator"):
             cli.main(["--problem", "random-spd", "--n", "8", "--device",
                       "cpu", "--dtype", "df64"])
@@ -237,3 +242,16 @@ def test_df64_variant_methods(capsys):
         rec = _json.loads(capsys.readouterr().out)
         assert rc == 0 and rec["converged"], method
         assert rec["residual_norm"] < 1e-7
+
+
+def test_df64_mesh_csr_ring(capsys):
+    """--dtype df64 --mesh N on an assembled-CSR problem: routed through
+    the df64 ring-shiftell schedule."""
+    import json as _json
+
+    rc = cli.main(["--problem", "poisson2d", "--n", "16", "--device",
+                   "cpu", "--dtype", "df64", "--mesh", "2", "--tol", "0",
+                   "--rtol", "1e-10", "--json"])
+    rec = _json.loads(capsys.readouterr().out)
+    assert rc == 0 and rec["converged"] and rec["mesh"] == 2
+    assert rec["residual_norm"] < 1e-7
