@@ -223,6 +223,7 @@ def cg_df64(
     return_checkpoint: bool = False,
     check_every: int = 1,
     method: str = "cg",
+    iter_cap: Optional[int] = None,
 ) -> DF64CGResult:
     """CG with df64 storage (see module docstring).
 
@@ -248,6 +249,9 @@ def cg_df64(
     collective) or ``"pipecg"`` (Ghysels-Vanroose - that collective
     overlaps the matvec; periodic residual replacement bounds drift).
     Checkpoint/resume requires ``method="cg"``.
+    ``iter_cap``: TRACED early-stop bound (<= ``maxiter``); segment
+    sweeps (``solve_resumable_df64``) vary it without recompiling -
+    ``maxiter`` alone is static and would retrace per segment.
     """
     if preconditioner not in (None, "jacobi"):
         raise ValueError(
@@ -256,11 +260,12 @@ def cg_df64(
     if method not in ("cg", "cg1", "pipecg"):
         raise ValueError(f"unknown method {method!r}; expected 'cg', "
                          f"'cg1' or 'pipecg'")
-    if method != "cg" and (resume_from is not None or return_checkpoint):
+    if method != "cg" and (resume_from is not None or return_checkpoint
+                           or iter_cap is not None):
         raise ValueError(
-            "checkpoint/resume requires method='cg': DF64Checkpoint "
-            "carries the standard recurrence state, not the variants' "
-            "extra vectors")
+            "checkpoint/resume (and its iter_cap segmenting) requires "
+            "method='cg': DF64Checkpoint carries the standard recurrence "
+            "state, not the variants' extra vectors")
     op = _prepare_operator(a, jacobi=preconditioner == "jacobi")
     if isinstance(b, np.ndarray) and b.dtype == np.float64:
         bh, bl = df.split_f64(b)
@@ -281,13 +286,16 @@ def cg_df64(
         return impl(op, b_df, tol2, rtol2, maxiter=maxiter,
                     record_history=record_history, jacobi=jacobi,
                     axis_name=axis_name, check_every=check_every)
+    cap = jnp.asarray(maxiter if iter_cap is None else iter_cap,
+                      jnp.int32)
     if axis_name is None:
-        return _solve_jit(op, b_df, tol2, rtol2, resume_from,
+        return _solve_jit(op, b_df, tol2, rtol2, resume_from, cap,
                           maxiter=maxiter, record_history=record_history,
                           jacobi=jacobi, axis_name=None,
                           return_checkpoint=return_checkpoint,
                           check_every=check_every)
-    return _solve(op, b_df, tol2, rtol2, resume_from, maxiter=maxiter,
+    return _solve(op, b_df, tol2, rtol2, resume_from, cap,
+                  maxiter=maxiter,
                   record_history=record_history, jacobi=jacobi,
                   axis_name=axis_name, return_checkpoint=return_checkpoint,
                   check_every=check_every)
@@ -310,9 +318,12 @@ def _safe_div(num: df.DF, den: df.DF) -> df.DF:
             jnp.where(zero, jnp.zeros_like(q[1]), q[1]))
 
 
-def _solve(op, b_df, tol2, rtol2, resume, *, maxiter, record_history,
-           jacobi, axis_name, return_checkpoint=False, check_every=1):
+def _solve(op, b_df, tol2, rtol2, resume, cap=None, *, maxiter,
+           record_history, jacobi, axis_name, return_checkpoint=False,
+           check_every=1):
     n = b_df[0].shape[0]
+    if cap is None:
+        cap = jnp.asarray(maxiter, jnp.int32)
     hist_len = maxiter + 1 if record_history else 0
     d = (op.diag_hi, op.diag_lo)
     if resume is not None:
@@ -355,7 +366,8 @@ def _solve(op, b_df, tol2, rtol2, resume, *, maxiter, record_history,
         unconverged = jnp.logical_not(df.less(s.rr, thr))
         # rr == 0: solved exactly - further steps would only freeze
         nontrivial = s.rr[0] > 0.0
-        return (s.k < maxiter) & s.finite & unconverged & nontrivial
+        return (s.k < maxiter) & (s.k < cap) & s.finite & unconverged \
+            & nontrivial
 
     def body(s: _State):
         ap = mv(s.p)
@@ -392,7 +404,8 @@ def _solve(op, b_df, tol2, rtol2, resume, *, maxiter, record_history,
                 finite=jnp.isfinite(rho0[0]),
                 history=history0)
     s = _blocked_while(cond, body, s0, check_every,
-                       lambda t: t.k + check_every <= maxiter)
+                       lambda t: (t.k + check_every <= maxiter)
+                       & (t.k + check_every <= cap))
     converged = jnp.logical_or(df.less(s.rr, thr), s.rr[0] == 0.0)
     status = jnp.where(
         jnp.logical_not(s.finite), CGStatus.BREAKDOWN.value,

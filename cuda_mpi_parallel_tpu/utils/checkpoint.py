@@ -268,3 +268,52 @@ def solve_resumable(
                 except OSError:
                     pass
             return res
+
+
+def solve_resumable_df64(
+    a,
+    b,
+    path: str,
+    *,
+    segment_iters: int = 500,
+    tol: float = 1e-7,
+    rtol: float = 0.0,
+    maxiter: int = 2000,
+    preconditioner=None,
+    keep_checkpoint: bool = False,
+):
+    """df64 sibling of :func:`solve_resumable`: f64-class long solves
+    that survive preemption, checkpointing every ``segment_iters``.
+
+    Segments reuse ONE compiled executable: ``maxiter`` stays constant
+    (static arg sizing the solve) while the traced ``iter_cap`` advances
+    per segment.  State persists via the npz df64 checkpoint format;
+    resuming continues the exact df64 trajectory.
+    """
+    from ..solver.df64 import DF64CGResult, cg_df64  # noqa: F401
+
+    if segment_iters < 1:
+        raise ValueError(f"segment_iters must be >= 1, got {segment_iters}")
+    b64 = np.asarray(b, dtype=np.float64)
+    fp = problem_fingerprint(a, b64)
+    state = None
+    if os.path.exists(path):
+        state = load_checkpoint_df64(path, expect_fingerprint=fp)
+
+    while True:
+        done_k = int(state.k) if state is not None else 0
+        cap = min(done_k + segment_iters, maxiter)
+        res = cg_df64(a, b64, tol=tol, rtol=rtol, maxiter=maxiter,
+                      preconditioner=preconditioner, resume_from=state,
+                      return_checkpoint=True, iter_cap=cap)
+        state = res.checkpoint
+        save_checkpoint_df64(path, state, fingerprint=fp)
+        finished = bool(res.converged) or int(res.iterations) >= maxiter \
+            or res.status_enum().name == "BREAKDOWN"
+        if finished:
+            if bool(res.converged) and not keep_checkpoint:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            return res

@@ -273,3 +273,67 @@ class TestFingerprintUnverifiable:
         save_checkpoint(path, part.checkpoint, fingerprint="aaaa")
         with pytest.raises(ValueError, match="different problem"):
             load_checkpoint(path, expect_fingerprint="bbbb")
+
+
+class TestDF64Resumable:
+    def test_segmented_matches_single_run(self, tmp_path, rng):
+        """solve_resumable_df64 segments produce the exact trajectory of
+        one uninterrupted df64 solve, surviving a mid-run 'preemption'
+        (fresh call against the on-disk checkpoint)."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from cuda_mpi_parallel_tpu import cg_df64
+        from cuda_mpi_parallel_tpu.models import poisson
+        from cuda_mpi_parallel_tpu.utils.checkpoint import (
+            solve_resumable_df64,
+        )
+
+        a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+        x_true = rng.standard_normal(256)
+        b = np.asarray(a.to_dense(), np.float64) @ x_true
+        path = str(tmp_path / "df64_seg.npz")
+
+        full = cg_df64(a, b, tol=0.0, rtol=1e-10, maxiter=2000)
+        res = solve_resumable_df64(a, b, path, segment_iters=20,
+                                   tol=0.0, rtol=1e-10, maxiter=2000)
+        assert bool(res.converged)
+        assert int(res.iterations) == int(full.iterations)
+        np.testing.assert_array_equal(np.asarray(res.x_hi),
+                                      np.asarray(full.x_hi))
+        # converged run cleans its checkpoint up
+        import os
+
+        assert not os.path.exists(path)
+
+    def test_preemption_resume(self, tmp_path, rng):
+        """Kill the solve after one segment; a fresh call resumes from
+        disk and still matches the uninterrupted trajectory."""
+        import numpy as np
+
+        from cuda_mpi_parallel_tpu import cg_df64
+        from cuda_mpi_parallel_tpu.models import poisson
+        from cuda_mpi_parallel_tpu.utils.checkpoint import (
+            solve_resumable_df64,
+        )
+
+        a = poisson.poisson_2d_csr(12, 12, dtype=np.float64)
+        x_true = rng.standard_normal(144)
+        b = np.asarray(a.to_dense(), np.float64) @ x_true
+        path = str(tmp_path / "df64_pre.npz")
+
+        # "preempted" run: cap the total at one segment's worth
+        solve_resumable_df64(a, b, path, segment_iters=10, tol=0.0,
+                             rtol=1e-10, maxiter=10, keep_checkpoint=True)
+        import os
+
+        assert os.path.exists(path)
+        # fresh process-equivalent: resume to convergence
+        res = solve_resumable_df64(a, b, path, segment_iters=25, tol=0.0,
+                                   rtol=1e-10, maxiter=2000)
+        full = cg_df64(a, b, tol=0.0, rtol=1e-10, maxiter=2000)
+        assert bool(res.converged)
+        assert int(res.iterations) == int(full.iterations)
+        np.testing.assert_array_equal(np.asarray(res.x_hi),
+                                      np.asarray(full.x_hi))
