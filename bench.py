@@ -386,6 +386,34 @@ def bench_all(results) -> None:
 
     _run_section(results, "poisson2d_1M_stencil_df64", s_df64)
 
+    # df64 single-reduction recurrence (method="cg1"): halves the
+    # serialized reduction count per iteration - the df64 analogue of
+    # the f32 solver's measured check-every/fused-reduction wins
+    def s_df64_cg1():
+        from cuda_mpi_parallel_tpu.solver.df64 import cg_df64
+
+        n = HEADLINE_GRID
+        op_df = poisson.poisson_2d_operator(n, n, dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        b_np64 = rng.standard_normal(n * n)
+        ctr = count(1)
+
+        def run_df(it):
+            return cg_df64(op_df, b_np64 * (1.0 + next(ctr) * 1e-4),
+                           tol=0.0, maxiter=it, check_every=32,
+                           method="cg1")
+
+        tl, _ = time_fn(lambda: run_df(200), warmup=1, repeats=3,
+                        reduce="median")
+        th, _ = time_fn(lambda: run_df(6200), warmup=1, repeats=3,
+                        reduce="median")
+        results["poisson2d_1M_stencil_df64_cg1"] = {
+            "us_per_iter": (th - tl) / 6000 * 1e6,
+            "iters_per_sec": 6000 / max(th - tl, 1e-9),
+            "measurement": "iteration_delta"}
+
+    _run_section(results, "poisson2d_1M_stencil_df64_cg1", s_df64_cg1)
+
     # df64 x shift-ELL: f64-class CG on the ASSEMBLED 1M-row matrix via
     # the pallas double-float lane-gather kernel - the reference's
     # defining combination (CUDA_R_64F CSR SpMV, CUDACG.cu:216,288).
