@@ -163,11 +163,18 @@ def _allreduce_df(hi: jax.Array, lo: jax.Array, axis_name) -> DF:
     call - 2P values instead of 2 - and, unlike an ``all_gather``
     formulation, the vma checker can infer the result replicated.
     """
-    n_shards = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
-    buf = jnp.zeros((n_shards, 2) + hi.shape, hi.dtype)
+    names = (axis_name if isinstance(axis_name, (tuple, list))
+             else (axis_name,))
+    sizes = [lax.axis_size(nm) for nm in names]
+    total = 1
+    for s in sizes:
+        total *= s
+    idx = jnp.zeros((), jnp.int32)
+    for nm, s in zip(names, sizes):
+        idx = idx * s + lax.axis_index(nm)
+    buf = jnp.zeros((total, 2) + hi.shape, hi.dtype)
     buf = buf.at[idx, 0].set(hi).at[idx, 1].set(lo)
-    g = lax.psum(buf, axis_name)  # (P, 2, ...): exact per element
+    g = lax.psum(buf, tuple(names))  # (P, 2, ...): exact per element
     return _fold_df(g[:, 0], g[:, 1])
 
 
@@ -300,6 +307,43 @@ def stencil3d_local_matvec(x: DF, lo: DF, hi: DF,
     el = jnp.pad(el, ((0, 0), (1, 1), (1, 1)))
     c = slice(1, -1)
     # 6u as 4u + 2u, both exact in f32 (see stencil3d_matvec)
+    acc = add((4.0 * uh, 4.0 * ul), (2.0 * uh, 2.0 * ul))
+    for sl in ((slice(None, -2), c, c), (slice(2, None), c, c),
+               (c, slice(None, -2), c), (c, slice(2, None), c),
+               (c, c, slice(None, -2)), (c, c, slice(2, None))):
+        acc = sub(acc, (eh[sl], el[sl]))
+    y = mul(scale, acc)
+    return y[0].reshape(-1), y[1].reshape(-1)
+
+
+def stencil3d_pencil_matvec(x: DF, x_lo: DF, x_hi: DF, y_lo: DF,
+                            y_hi: DF, grid: Tuple[int, int, int],
+                            scale: DF) -> DF:
+    """df64 7-point Laplacian on a PENCIL block: halo plane pairs along
+    BOTH partitioned grid axes (x halos ``(1, lny, nz)``, y halos
+    ``(lnx, 1, nz)``), Dirichlet zero pad on z.  Mirrors the f32
+    ``DistStencil3DPencil.matvec`` geometry: corner cells are never read
+    by the 7-point stencil, so the y-halo planes are zero-padded at the
+    x ends to align shapes.
+    """
+    lnx, lny, nz = grid
+    uh = x[0].reshape(lnx, lny, nz)
+    ul = x[1].reshape(lnx, lny, nz)
+
+    def extend(u, xl, xh, yl, yh):
+        ue = jnp.concatenate([xl.reshape(1, lny, nz), u,
+                              xh.reshape(1, lny, nz)], axis=0)
+        pad_c = jnp.zeros((1, 1, nz), u.dtype)
+        ylp = jnp.concatenate([pad_c, yl.reshape(lnx, 1, nz), pad_c],
+                              axis=0)
+        yhp = jnp.concatenate([pad_c, yh.reshape(lnx, 1, nz), pad_c],
+                              axis=0)
+        ue = jnp.concatenate([ylp, ue, yhp], axis=1)
+        return jnp.pad(ue, ((0, 0), (0, 0), (1, 1)))
+
+    eh = extend(uh, x_lo[0], x_hi[0], y_lo[0], y_hi[0])
+    el = extend(ul, x_lo[1], x_hi[1], y_lo[1], y_hi[1])
+    c = slice(1, -1)
     acc = add((4.0 * uh, 4.0 * ul), (2.0 * uh, 2.0 * ul))
     for sl in ((slice(None, -2), c, c), (slice(2, None), c, c),
                (c, slice(None, -2), c), (c, slice(2, None), c),

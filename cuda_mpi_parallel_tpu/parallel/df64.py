@@ -122,6 +122,70 @@ class DistStencilDF64:
         return df.stencil3d_local_matvec(x, lo_df, hi_df, grid, scale)
 
 
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("scale_hi", "scale_lo"),
+    meta_fields=("local_grid", "axis_names", "shards"),
+)
+@dataclasses.dataclass(frozen=True)
+class DistStencilDF64Pencil:
+    """Pencil-decomposed df64 7-point Poisson block: TWO partitioned
+    grid axes over a 2-D mesh (the df64 sibling of
+    ``DistStencil3DPencil``).  Each partitioned axis exchanges one
+    boundary plane PAIR per matvec - two ppermute pairs total, hi and lo
+    words stacked - and inner products reduce over BOTH mesh axes
+    (``ops.df64._allreduce_df`` takes the axis-name tuple).
+    """
+
+    scale_hi: jax.Array
+    scale_lo: jax.Array
+    local_grid: Tuple[int, int, int]   # (lnx, lny, nz)
+    axis_names: Tuple[str, str]
+    shards: Tuple[int, int]
+
+    @classmethod
+    def create(cls, global_grid, shards, axis_names=("rows", "cols"),
+               scale=1.0) -> "DistStencilDF64Pencil":
+        nx, ny, nz = global_grid
+        sx, sy = shards
+        if nx % sx or ny % sy:
+            raise ValueError(
+                f"grid ({nx}, {ny}) not divisible by shards ({sx}, {sy})")
+        sh, sl = df.split_f64(np.float64(np.asarray(scale,
+                                                    dtype=np.float64)))
+        return cls(scale_hi=jnp.asarray(sh), scale_lo=jnp.asarray(sl),
+                   local_grid=(nx // sx, ny // sy, nz),
+                   axis_names=tuple(axis_names), shards=tuple(shards))
+
+    @property
+    def shape(self):
+        n = int(np.prod(self.local_grid))
+        return (n, n)
+
+    @property
+    def diag_hi(self):
+        return self._diag()[0]
+
+    @property
+    def diag_lo(self):
+        return self._diag()[1]
+
+    def _diag(self):
+        return df.mul(df.const(6.0), (self.scale_hi, self.scale_lo))
+
+    def matvec_df(self, x: df.DF) -> df.DF:
+        grid = self.local_grid
+        u2 = jnp.stack([x[0].reshape(grid), x[1].reshape(grid)])
+        x_lo2, x_hi2 = exchange_halo_axis(u2, self.axis_names[0],
+                                          self.shards[0], dim=1)
+        y_lo2, y_hi2 = exchange_halo_axis(u2, self.axis_names[1],
+                                          self.shards[1], dim=2)
+        return df.stencil3d_pencil_matvec(
+            x, (x_lo2[0], x_lo2[1]), (x_hi2[0], x_hi2[1]),
+            (y_lo2[0], y_lo2[1]), (y_hi2[0], y_hi2[1]), grid,
+            (self.scale_hi, self.scale_lo))
+
+
 #: (structure, mesh, static config) -> jitted shard_map df64 solver;
 #: mirrors dist_cg._SOLVER_CACHE (one entry per distinct configuration)
 _SOLVER_CACHE: dict = {}
@@ -170,10 +234,6 @@ def solve_distributed_df64(
     """
     if mesh is None:
         mesh = make_mesh(n_devices)
-    if len(mesh.axis_names) != 1:
-        raise ValueError(
-            "solve_distributed_df64 supports 1-D (slab) meshes only; "
-            "pencil df64 is not implemented")
     if preconditioner not in (None, "jacobi"):
         raise ValueError(
             f"solve_distributed_df64 supports preconditioner=None or "
@@ -186,13 +246,23 @@ def solve_distributed_df64(
             f"solve_distributed_df64 supports matrix-free Stencil2D/"
             f"Stencil3D and assembled CSRMatrix (df64 ring-shiftell "
             f"schedule), got {type(a).__name__}")
-    axis = mesh.axis_names[0]
-    n_shards = mesh.devices.size
-
     b64 = np.asarray(b, dtype=np.float64)
     if b64.shape != (a.shape[0],):
         raise ValueError(f"rhs shape {b64.shape} does not match operator "
                          f"shape {a.shape}")
+    if len(mesh.axis_names) == 2:
+        # pencil decomposition: two partitioned grid axes
+        if not isinstance(a, Stencil3D):
+            raise TypeError(
+                "a 2-D mesh (pencil decomposition) supports Stencil3D "
+                f"only, got {type(a).__name__}")
+        return _solve_pencil_df64(
+            a, b64, mesh, tol=tol, rtol=rtol, maxiter=maxiter,
+            jacobi=preconditioner == "jacobi",
+            record_history=record_history, check_every=check_every,
+            method=method)
+    axis = mesh.axis_names[0]
+    n_shards = mesh.devices.size
     if isinstance(a, CSRMatrix):
         return _solve_csr_shiftell_df64(
             a, b64, mesh, axis, n_shards, tol=tol, rtol=rtol,
@@ -240,6 +310,67 @@ def solve_distributed_df64(
         fn = _SOLVER_CACHE[key] = jax.jit(build())
     return fn(bh, bl, local.scale_hi, local.scale_lo,
               tol2[0], tol2[1], rtol2[0], rtol2[1])
+
+
+def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
+                       record_history, check_every, method) -> DF64CGResult:
+    """Stencil3D df64 over a 2-D mesh: x- and y-axes partitioned, two
+    halo ppermute pairs per matvec (hi/lo stacked), dots reduced over
+    BOTH mesh axes at df64 accuracy."""
+    ax_x, ax_y = mesh.axis_names
+    sx, sy = mesh.devices.shape
+    local = DistStencilDF64Pencil.create(a.grid, (sx, sy),
+                                         axis_names=(ax_x, ax_y),
+                                         scale=a.scale)
+    nx, ny, nz = a.grid
+    bh_np, bl_np = df.split_f64(b64)
+    sharding = jax.sharding.NamedSharding(mesh, P(ax_x, ax_y))
+    bh = jax.device_put(jnp.asarray(bh_np).reshape(nx, ny, nz), sharding)
+    bl = jax.device_put(jnp.asarray(bl_np).reshape(nx, ny, nz), sharding)
+    tol2 = df.const(float(tol) ** 2)
+    rtol2 = df.const(float(rtol) ** 2)
+
+    out = DF64CGResult(
+        x_hi=P(ax_x, ax_y), x_lo=P(ax_x, ax_y), iterations=P(),
+        residual_norm_sq_hi=P(), residual_norm_sq_lo=P(), converged=P(),
+        status=P(), indefinite=P(),
+        residual_history=P() if record_history else None,
+        checkpoint=None)
+    key = ("pencil-df64", local.local_grid, local.shards, (ax_x, ax_y),
+           mesh, jacobi, record_history, maxiter, check_every, method)
+
+    def build():
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(P(ax_x, ax_y), P(ax_x, ax_y),
+                           P(), P(), P(), P(), P(), P()),
+                 out_specs=out)
+        def run(bh_l, bl_l, sh, sl, t2h, t2l, r2h, r2l):
+            loc = dataclasses.replace(local, scale_hi=sh, scale_lo=sl)
+            b_df = (bh_l.reshape(-1), bl_l.reshape(-1))
+            axis = (ax_x, ax_y)
+            if method != "cg":
+                res = _VARIANTS[method](
+                    loc, b_df, (t2h, t2l), (r2h, r2l), maxiter=maxiter,
+                    record_history=record_history, jacobi=jacobi,
+                    axis_name=axis, check_every=check_every)
+            else:
+                res = _df_solve(loc, b_df, (t2h, t2l), (r2h, r2l), None,
+                                maxiter=maxiter,
+                                record_history=record_history,
+                                jacobi=jacobi, axis_name=axis,
+                                check_every=check_every)
+            return dataclasses.replace(
+                res, x_hi=res.x_hi.reshape(loc.local_grid),
+                x_lo=res.x_lo.reshape(loc.local_grid))
+        return run
+
+    fn = _SOLVER_CACHE.get(key)
+    if fn is None:
+        fn = _SOLVER_CACHE[key] = jax.jit(build())
+    res = fn(bh, bl, local.scale_hi, local.scale_lo,
+             tol2[0], tol2[1], rtol2[0], rtol2[1])
+    return dataclasses.replace(res, x_hi=res.x_hi.reshape(-1),
+                               x_lo=res.x_lo.reshape(-1))
 
 
 def _solve_csr_shiftell_df64(a, b64, mesh, axis, n_shards, *, tol, rtol,

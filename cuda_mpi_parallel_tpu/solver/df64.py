@@ -301,6 +301,17 @@ def cg_df64(
                   check_every=check_every)
 
 
+def _pcast_varying(pair, axis_name):
+    """Mark a fresh (unvarying) df64 pair device-varying over one mesh
+    axis name or a tuple of them (pencil meshes)."""
+    names = (axis_name if isinstance(axis_name, (tuple, list))
+             else (axis_name,))
+    out = pair
+    for nm in names:
+        out = tuple(lax.pcast(v, nm, to="varying") for v in out)
+    return out
+
+
 def _safe_div(num: df.DF, den: df.DF) -> df.DF:
     """df64 num / den, but a freeze (0) when both hi words are exactly 0.
 
@@ -340,8 +351,7 @@ def _solve(op, b_df, tol2, rtol2, resume, cap=None, *, maxiter,
         if axis_name is not None:
             # fresh zeros are unvarying; the while_loop carry must match
             # the body's output (device-varying) under vma tracking
-            x0 = tuple(lax.pcast(v, axis_name, to="varying")
-                       for v in x0)
+            x0 = _pcast_varying(x0, axis_name)
         r0 = b_df     # x0 = 0 fast path (CUDACG.cu:247-259)
         z0 = df.div(r0, d) if jacobi else r0
         p0 = z0
@@ -517,7 +527,7 @@ def _variant_init(op, b_df, jacobi, axis_name):
     mv = op.matvec_df if hasattr(op, "matvec_df") else op.matvec
     x0 = (jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32))
     if axis_name is not None:
-        x0 = tuple(lax.pcast(v, axis_name, to="varying") for v in x0)
+        x0 = _pcast_varying(x0, axis_name)
     r0 = b_df  # x0 = 0 fast path (CUDACG.cu:247-259)
     u0 = df.div(r0, d) if jacobi else r0
     w0 = mv(u0)
