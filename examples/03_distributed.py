@@ -38,3 +38,18 @@ if ndev >= 4 and ndev % 2 == 0:
                             tol=1e-3)
     print(f"pencil mesh=({ndev // 2},2): iters={int(res.iterations)} "
           f"converged={bool(res.converged)}")
+
+# round 3: the same meshes at f64-class precision (df64 pairs; the
+# reference's CUDA_R_64F x the MPI its name promises)
+from cuda_mpi_parallel_tpu.parallel import solve_distributed_df64
+
+res = solve_distributed_df64(op, np.asarray(b, np.float64),
+                             mesh=make_mesh(ndev), tol=0.0, rtol=1e-10)
+print(f"slab   mesh={ndev} df64: iters={int(res.iterations)} "
+      f"||r||={res.residual_norm():.2e}")
+if ndev >= 4 and ndev % 2 == 0:
+    res = solve_distributed_df64(op, np.asarray(b, np.float64),
+                                 mesh=make_mesh_2d((ndev // 2, 2)),
+                                 tol=0.0, rtol=1e-10, method="cg1")
+    print(f"pencil mesh=({ndev // 2},2) df64 cg1: "
+          f"iters={int(res.iterations)} ||r||={res.residual_norm():.2e}")
