@@ -181,6 +181,16 @@ def _prepare_operator(a, jacobi: bool = False):
             diag_hi=dh, diag_lo=dl, kind=kind, grid=a.grid)
     if isinstance(a, CSRMatrix):
         a = a.to_ell()
+    if isinstance(a, ELLMatrix) and a.shape[0] >= 200_000:
+        import warnings
+
+        warnings.warn(
+            f"df64 on an assembled csr/ell matrix routes through the XLA "
+            f"gather (~43 ms/CG-iteration at 1M rows - roughly 400x the "
+            f"pallas rate); at n={a.shape[0]} use "
+            f"CSRMatrix.to_shiftell_df64() (CLI: --format shiftell) for "
+            f"the df64 lane-gather kernel, or shard over a mesh",
+            UserWarning, stacklevel=3)
     if not isinstance(a, ELLMatrix):
         raise TypeError(
             f"cg_df64 supports CSRMatrix/ELLMatrix/Stencil2D/Stencil3D, "
