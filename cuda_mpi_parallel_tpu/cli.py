@@ -81,8 +81,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(matching the all-f64 reference, CUDACG.cu:216). "
                         "df64 = double-float (hi,lo) f32 pairs: ~f64 "
                         "precision on real TPU hardware (solver.df64; "
-                        "plain or Jacobi-PCG, csr/ell/shiftell/"
-                        "matrix-free problems, single device)")
+                        "plain, Jacobi or Chebyshev PCG; csr/ell/"
+                        "shiftell/matrix-free problems; meshes via "
+                        "--mesh)")
     p.add_argument("--matrix-free", action="store_true",
                    help="use the matrix-free stencil operator for poisson* "
                         "(default: assembled CSR)")
@@ -255,8 +256,11 @@ def main(argv=None) -> int:
         elif args.mesh > 1 and args.fmt != "csr":
             bad = (f"--format {args.fmt} with --mesh > 1 (distributed "
                    f"CSR uses the df64 ring-shiftell schedule directly)")
-        elif args.precond not in (None, "jacobi"):
-            bad = f"--precond {args.precond} (None or jacobi only)"
+        elif args.precond not in (None, "jacobi", "chebyshev"):
+            bad = (f"--precond {args.precond} (None, jacobi or "
+                   f"chebyshev only)")
+        elif args.precond == "chebyshev" and args.method != "cg":
+            bad = "--precond chebyshev with --method != cg"
         elif args.fmt == "dia":
             bad = "--format dia (csr/ell/shiftell/matrix-free only)"
         elif not isinstance(a, (_CSR, _ELL, _S2, _S3)):
@@ -297,6 +301,7 @@ def main(argv=None) -> int:
                     mesh=make_mesh(args.mesh), tol=args.tol,
                     rtol=args.rtol, maxiter=args.maxiter,
                     preconditioner=args.precond,
+                    precond_degree=args.precond_degree,
                     record_history=args.history,
                     check_every=args.check_every, method=args.method)
             from .solver.df64 import cg_df64
@@ -305,6 +310,7 @@ def main(argv=None) -> int:
                            tol=args.tol, rtol=args.rtol,
                            maxiter=args.maxiter,
                            preconditioner=args.precond,
+                           precond_degree=args.precond_degree,
                            record_history=args.history,
                            check_every=args.check_every,
                            method=args.method)

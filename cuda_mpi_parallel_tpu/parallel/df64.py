@@ -39,6 +39,7 @@ from ..solver.df64 import (
     _VARIANTS,
     DF64CGResult,
     _solve as _df_solve,
+    chebyshev_interval,
 )
 from . import partition as part
 from .halo import exchange_halo_axis
@@ -205,6 +206,7 @@ def solve_distributed_df64(
     rtol: float = 0.0,
     maxiter: int = 2000,
     preconditioner: Optional[str] = None,
+    precond_degree: int = 4,
     record_history: bool = False,
     check_every: int = 1,
     method: str = "cg",
@@ -234,10 +236,13 @@ def solve_distributed_df64(
     """
     if mesh is None:
         mesh = make_mesh(n_devices)
-    if preconditioner not in (None, "jacobi"):
+    if preconditioner not in (None, "jacobi", "chebyshev"):
         raise ValueError(
-            f"solve_distributed_df64 supports preconditioner=None or "
-            f"'jacobi', got {preconditioner!r}")
+            f"solve_distributed_df64 supports preconditioner=None, "
+            f"'jacobi' or 'chebyshev', got {preconditioner!r}")
+    if preconditioner == "chebyshev" and method != "cg":
+        raise ValueError(
+            "preconditioner='chebyshev' requires method='cg' in df64")
     if method not in ("cg", "cg1", "pipecg"):
         raise ValueError(f"unknown method {method!r}; expected 'cg', "
                          f"'cg1' or 'pipecg'")
@@ -259,6 +264,8 @@ def solve_distributed_df64(
         return _solve_pencil_df64(
             a, b64, mesh, tol=tol, rtol=rtol, maxiter=maxiter,
             jacobi=preconditioner == "jacobi",
+            cheb=(precond_degree if preconditioner == "chebyshev"
+                  else None),
             record_history=record_history, check_every=check_every,
             method=method)
     axis = mesh.axis_names[0]
@@ -267,6 +274,8 @@ def solve_distributed_df64(
         return _solve_csr_shiftell_df64(
             a, b64, mesh, axis, n_shards, tol=tol, rtol=rtol,
             maxiter=maxiter, jacobi=preconditioner == "jacobi",
+            cheb=(precond_degree if preconditioner == "chebyshev"
+                  else None),
             record_history=record_history, check_every=check_every,
             method=method)
     local = DistStencilDF64.create(a.grid, n_shards, axis_name=axis,
@@ -277,6 +286,10 @@ def solve_distributed_df64(
     tol2 = df.const(float(tol) ** 2)
     rtol2 = df.const(float(rtol) ** 2)
     jacobi = preconditioner == "jacobi"
+    cheb = precond_degree if preconditioner == "chebyshev" else None
+    # spectral interval from the GLOBAL f32 operator, host-side (an
+    # in-jit estimate on a virtual mesh exploded compile times)
+    interval = chebyshev_interval(a) if cheb is not None else None
 
     out = DF64CGResult(
         x_hi=P(axis), x_lo=P(axis), iterations=P(),
@@ -284,14 +297,15 @@ def solve_distributed_df64(
         status=P(), indefinite=P(),
         residual_history=P() if record_history else None,
         checkpoint=None)
-    key = (local.local_grid, local.kind, axis, mesh, jacobi,
+    key = (local.local_grid, local.kind, axis, mesh, jacobi, cheb,
            record_history, maxiter, check_every, method)
 
     def build():
         @partial(jax.shard_map, mesh=mesh,
-                 in_specs=(P(axis), P(axis), P(), P(), P(), P(), P(), P()),
+                 in_specs=(P(axis), P(axis), P(), P(), P(), P(), P(),
+                           P(), P()),
                  out_specs=out)
-        def run(bh_l, bl_l, sh, sl, t2h, t2l, r2h, r2l):
+        def run(bh_l, bl_l, sh, sl, t2h, t2l, r2h, r2l, interval_t):
             loc = dataclasses.replace(local, scale_hi=sh, scale_lo=sl)
             if method != "cg":
                 return _VARIANTS[method](
@@ -300,20 +314,23 @@ def solve_distributed_df64(
                     jacobi=jacobi, axis_name=axis,
                     check_every=check_every)
             return _df_solve(loc, (bh_l, bl_l), (t2h, t2l), (r2h, r2l),
-                             None, maxiter=maxiter,
+                             None, cheb_interval=interval_t,
+                             maxiter=maxiter,
                              record_history=record_history, jacobi=jacobi,
-                             axis_name=axis, check_every=check_every)
+                             axis_name=axis, check_every=check_every,
+                             chebyshev_degree=cheb)
         return run
 
     fn = _SOLVER_CACHE.get(key)
     if fn is None:
         fn = _SOLVER_CACHE[key] = jax.jit(build())
     return fn(bh, bl, local.scale_hi, local.scale_lo,
-              tol2[0], tol2[1], rtol2[0], rtol2[1])
+              tol2[0], tol2[1], rtol2[0], rtol2[1], interval)
 
 
 def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
-                       record_history, check_every, method) -> DF64CGResult:
+                       cheb, record_history, check_every,
+                       method) -> DF64CGResult:
     """Stencil3D df64 over a 2-D mesh: x- and y-axes partitioned, two
     halo ppermute pairs per matvec (hi/lo stacked), dots reduced over
     BOTH mesh axes at df64 accuracy."""
@@ -322,6 +339,7 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
     local = DistStencilDF64Pencil.create(a.grid, (sx, sy),
                                          axis_names=(ax_x, ax_y),
                                          scale=a.scale)
+    interval = chebyshev_interval(a) if cheb is not None else None
     nx, ny, nz = a.grid
     bh_np, bl_np = df.split_f64(b64)
     sharding = jax.sharding.NamedSharding(mesh, P(ax_x, ax_y))
@@ -337,14 +355,15 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
         residual_history=P() if record_history else None,
         checkpoint=None)
     key = ("pencil-df64", local.local_grid, local.shards, (ax_x, ax_y),
-           mesh, jacobi, record_history, maxiter, check_every, method)
+           mesh, jacobi, cheb, record_history, maxiter, check_every,
+           method)
 
     def build():
         @partial(jax.shard_map, mesh=mesh,
                  in_specs=(P(ax_x, ax_y), P(ax_x, ax_y),
-                           P(), P(), P(), P(), P(), P()),
+                           P(), P(), P(), P(), P(), P(), P()),
                  out_specs=out)
-        def run(bh_l, bl_l, sh, sl, t2h, t2l, r2h, r2l):
+        def run(bh_l, bl_l, sh, sl, t2h, t2l, r2h, r2l, interval_t):
             loc = dataclasses.replace(local, scale_hi=sh, scale_lo=sl)
             b_df = (bh_l.reshape(-1), bl_l.reshape(-1))
             axis = (ax_x, ax_y)
@@ -355,10 +374,12 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
                     axis_name=axis, check_every=check_every)
             else:
                 res = _df_solve(loc, b_df, (t2h, t2l), (r2h, r2l), None,
+                                cheb_interval=interval_t,
                                 maxiter=maxiter,
                                 record_history=record_history,
                                 jacobi=jacobi, axis_name=axis,
-                                check_every=check_every)
+                                check_every=check_every,
+                                chebyshev_degree=cheb)
             return dataclasses.replace(
                 res, x_hi=res.x_hi.reshape(loc.local_grid),
                 x_lo=res.x_lo.reshape(loc.local_grid))
@@ -368,14 +389,14 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
     if fn is None:
         fn = _SOLVER_CACHE[key] = jax.jit(build())
     res = fn(bh, bl, local.scale_hi, local.scale_lo,
-             tol2[0], tol2[1], rtol2[0], rtol2[1])
+             tol2[0], tol2[1], rtol2[0], rtol2[1], interval)
     return dataclasses.replace(res, x_hi=res.x_hi.reshape(-1),
                                x_lo=res.x_lo.reshape(-1))
 
 
 def _solve_csr_shiftell_df64(a, b64, mesh, axis, n_shards, *, tol, rtol,
-                             maxiter, jacobi, record_history, check_every,
-                             method) -> DF64CGResult:
+                             maxiter, jacobi, cheb, record_history,
+                             check_every, method) -> DF64CGResult:
     """General-CSR distributed df64: ring schedule with df64 shift-ELL
     slabs (``DistShiftELLDF64Ring``) - the full realization of the
     reference's defining combination, f64 assembled SpMV
@@ -399,6 +420,7 @@ def _solve_csr_shiftell_df64(a, b64, mesh, axis, n_shards, *, tol, rtol,
     dl = shard_vector(jnp.asarray(parts.diag_lo.reshape(-1)), mesh, axis)
     tol2 = df.const(float(tol) ** 2)
     rtol2 = df.const(float(rtol) ** 2)
+    interval = chebyshev_interval(a) if cheb is not None else None
     n_local = parts.n_local
 
     out = DF64CGResult(
@@ -409,18 +431,19 @@ def _solve_csr_shiftell_df64(a, b64, mesh, axis, n_shards, *, tol, rtol,
         checkpoint=None)
     chunk_shape = tuple(v.shape[1] for v in parts.vals_hi)
     key = ("csr-shiftell-df64", n_local, n_shards, parts.h, parts.kc,
-           chunk_shape, axis, mesh, jacobi, record_history, maxiter,
-           check_every, method)
+           chunk_shape, axis, mesh, jacobi, cheb, record_history,
+           maxiter, check_every, method)
 
     def build():
         # check_vma=False: the pallas slab kernel cannot declare varying
         # mesh axes on its outputs (see shift_ell_matvec docstring)
         @partial(jax.shard_map, mesh=mesh, check_vma=False,
                  in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis),
-                           P(axis), P(axis), P(axis), P(), P(), P(), P()),
+                           P(axis), P(axis), P(axis), P(), P(), P(), P(),
+                           P()),
                  out_specs=out)
         def run(bh_l, bl_l, vh_s, vl_s, meta_s, blk_s, dh_l, dl_l,
-                t2h, t2l, r2h, r2l):
+                t2h, t2l, r2h, r2l, interval_t):
             strip = partial(jax.tree.map, lambda v: v[0])
             op = DistShiftELLDF64Ring(
                 vals_hi=strip(vh_s), vals_lo=strip(vl_s),
@@ -434,16 +457,18 @@ def _solve_csr_shiftell_df64(a, b64, mesh, axis, n_shards, *, tol, rtol,
                     jacobi=jacobi, axis_name=axis,
                     check_every=check_every)
             return _df_solve(op, (bh_l, bl_l), (t2h, t2l), (r2h, r2l),
-                             None, maxiter=maxiter,
+                             None, cheb_interval=interval_t,
+                             maxiter=maxiter,
                              record_history=record_history, jacobi=jacobi,
-                             axis_name=axis, check_every=check_every)
+                             axis_name=axis, check_every=check_every,
+                             chebyshev_degree=cheb)
         return run
 
     fn = _SOLVER_CACHE.get(key)
     if fn is None:
         fn = _SOLVER_CACHE[key] = jax.jit(build())
     res = fn(bh, bl, vh, vl, meta, blks, dh, dl,
-             tol2[0], tol2[1], rtol2[0], rtol2[1])
+             tol2[0], tol2[1], rtol2[0], rtol2[1], interval)
     if parts.n_global != parts.n_global_padded:
         res = dataclasses.replace(
             res, x_hi=res.x_hi[: parts.n_global],

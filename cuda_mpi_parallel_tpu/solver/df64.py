@@ -234,6 +234,7 @@ def cg_df64(
     check_every: int = 1,
     method: str = "cg",
     iter_cap: Optional[int] = None,
+    precond_degree: int = 4,
 ) -> DF64CGResult:
     """CG with df64 storage (see module docstring).
 
@@ -244,8 +245,11 @@ def cg_df64(
     the fast path for ASSEMBLED matrices at f64-class precision (the
     reference's ``CUDA_R_64F`` CSR SpMV, ``CUDACG.cu:216,288``).
     ``preconditioner``: ``None`` (plain CG, the reference's
-    configuration) or ``"jacobi"`` (diag(A)^-1 applied in df64 -
-    BASELINE config #3 at f64-class precision).
+    configuration), ``"jacobi"`` (diag(A)^-1 applied in df64 - BASELINE
+    config #3 at f64-class precision) or ``"chebyshev"``
+    (``precond_degree``-term Chebyshev polynomial applied in df64, its
+    spectral interval from an in-jit hi-word power iteration;
+    ``method="cg"`` only).
     ``resume_from``/``return_checkpoint`` mirror ``solve``'s
     checkpointing: ``maxiter`` remains the TOTAL iteration cap, and the
     resumed run continues the exact df64 trajectory.
@@ -263,13 +267,21 @@ def cg_df64(
     sweeps (``solve_resumable_df64``) vary it without recompiling -
     ``maxiter`` alone is static and would retrace per segment.
     """
-    if preconditioner not in (None, "jacobi"):
+    if preconditioner not in (None, "jacobi", "chebyshev"):
         raise ValueError(
-            f"cg_df64 supports preconditioner=None or 'jacobi', got "
-            f"{preconditioner!r}")
+            f"cg_df64 supports preconditioner=None, 'jacobi' or "
+            f"'chebyshev', got {preconditioner!r}")
     if method not in ("cg", "cg1", "pipecg"):
         raise ValueError(f"unknown method {method!r}; expected 'cg', "
                          f"'cg1' or 'pipecg'")
+    if preconditioner == "chebyshev" and method != "cg":
+        raise ValueError(
+            "preconditioner='chebyshev' requires method='cg' in df64 "
+            "(the variants fuse their reductions around the plain or "
+            "Jacobi recurrence)")
+    if precond_degree < 1:
+        raise ValueError(f"precond_degree must be >= 1, got "
+                         f"{precond_degree}")
     if method != "cg" and (resume_from is not None or return_checkpoint
                            or iter_cap is not None):
         raise ValueError(
@@ -298,17 +310,73 @@ def cg_df64(
                     axis_name=axis_name, check_every=check_every)
     cap = jnp.asarray(maxiter if iter_cap is None else iter_cap,
                       jnp.int32)
+    cheb = precond_degree if preconditioner == "chebyshev" else None
+    interval = chebyshev_interval(a) if cheb is not None else None
     if axis_name is None:
         return _solve_jit(op, b_df, tol2, rtol2, resume_from, cap,
+                          interval,
                           maxiter=maxiter, record_history=record_history,
                           jacobi=jacobi, axis_name=None,
                           return_checkpoint=return_checkpoint,
-                          check_every=check_every)
-    return _solve(op, b_df, tol2, rtol2, resume_from, cap,
+                          check_every=check_every, chebyshev_degree=cheb)
+    return _solve(op, b_df, tol2, rtol2, resume_from, cap, interval,
                   maxiter=maxiter,
                   record_history=record_history, jacobi=jacobi,
                   axis_name=axis_name, return_checkpoint=return_checkpoint,
-                  check_every=check_every)
+                  check_every=check_every, chebyshev_degree=cheb)
+
+
+def chebyshev_interval(a, *, ratio: float = 30.0,
+                       iters: int = 30) -> Tuple[df.DF, df.DF]:
+    """(theta, delta) df64 pairs bounding A's spectrum for the Chebyshev
+    preconditioner: [lmax/ratio, lmax] with lmax from HOST-SIDE power
+    iteration (percent-level accuracy suffices; doing the estimate
+    inside the jitted distributed solve instead exploded compile times -
+    30 unrolled df64 halo-exchange matvecs on a virtual mesh).
+
+    ``a`` may be any f32 ``LinearOperator`` (the f32 power iteration of
+    ``models.precond.estimate_lmax``) or a df64 operator exposing
+    ``matvec_df`` (eager hi-word power iteration).  Deterministic, so
+    resumed or re-built solves derive the identical preconditioner.
+    """
+    if hasattr(a, "matvec_df"):
+        n = a.shape[0]
+        v = jnp.ones(n, jnp.float32)
+        v = v / jnp.sqrt(jnp.vdot(v, v))
+        zeros = jnp.zeros(n, jnp.float32)
+        for _ in range(iters):
+            w = a.matvec_df((v, zeros))[0]
+            v = w / jnp.sqrt(jnp.maximum(jnp.vdot(w, w), 1e-30))
+        w = a.matvec_df((v, zeros))[0]
+        lmax = 1.1 * float(jnp.vdot(v, w) / jnp.vdot(v, v))
+    else:
+        from ..models.precond import estimate_lmax
+
+        lmax = float(estimate_lmax(a, iters=iters))
+    lmin = lmax / ratio
+    return df.const((lmax + lmin) * 0.5), df.const((lmax - lmin) * 0.5)
+
+
+def _chebyshev_apply(mv, r: df.DF, theta: df.DF, delta: df.DF,
+                     degree: int) -> df.DF:
+    """z = p(A) r in df64: the ``degree``-term Chebyshev semi-iteration
+    for A z = r from z0 = 0 (same recurrence as the f32
+    ``models.precond.ChebyshevPreconditioner.matvec``, in double-float
+    arithmetic; ``degree - 1`` matvecs, no reductions)."""
+    sigma = df.div(theta, delta)
+    rho = df.div(df.const(1.0), sigma)
+    d = df.div(r, theta)
+    z = d
+    two = df.const(2.0)
+    for _ in range(degree - 1):
+        rho_new = df.div(df.const(1.0),
+                         df.sub(df.mul(two, sigma), rho))
+        resid = df.sub(r, mv(z))
+        d = df.add(df.mul(df.mul(rho_new, rho), d),
+                   df.mul(df.div(df.mul(two, rho_new), delta), resid))
+        z = df.add(z, d)
+        rho = rho_new
+    return z
 
 
 def _pcast_varying(pair, axis_name):
@@ -339,14 +407,30 @@ def _safe_div(num: df.DF, den: df.DF) -> df.DF:
             jnp.where(zero, jnp.zeros_like(q[1]), q[1]))
 
 
-def _solve(op, b_df, tol2, rtol2, resume, cap=None, *, maxiter,
-           record_history, jacobi, axis_name, return_checkpoint=False,
-           check_every=1):
+def _solve(op, b_df, tol2, rtol2, resume, cap=None, cheb_interval=None,
+           *, maxiter, record_history, jacobi, axis_name,
+           return_checkpoint=False, check_every=1, chebyshev_degree=None):
     n = b_df[0].shape[0]
     if cap is None:
         cap = jnp.asarray(maxiter, jnp.int32)
     hist_len = maxiter + 1 if record_history else 0
     d = (op.diag_hi, op.diag_lo)
+    # double-float operators (shift-ELL) expose matvec_df; the internal
+    # _DF64Operator dispatches through matvec
+    mv = op.matvec_df if hasattr(op, "matvec_df") else op.matvec
+
+    preconditioned = jacobi or chebyshev_degree is not None
+    if chebyshev_degree is not None:
+        theta, delta = cheb_interval
+
+        def apply_m(r):
+            return _chebyshev_apply(mv, r, theta, delta,
+                                    chebyshev_degree)
+    elif jacobi:
+        def apply_m(r):
+            return df.div(r, d)
+    else:
+        apply_m = None
     if resume is not None:
         x0 = (resume.x_hi, resume.x_lo)
         r0 = (resume.r_hi, resume.r_lo)
@@ -363,10 +447,11 @@ def _solve(op, b_df, tol2, rtol2, resume, cap=None, *, maxiter,
             # the body's output (device-varying) under vma tracking
             x0 = _pcast_varying(x0, axis_name)
         r0 = b_df     # x0 = 0 fast path (CUDACG.cu:247-259)
-        z0 = df.div(r0, d) if jacobi else r0
+        z0 = apply_m(r0) if preconditioned else r0
         p0 = z0
         rr0 = df.dot(r0, r0, axis_name=axis_name)
-        rho0 = df.dot(r0, z0, axis_name=axis_name) if jacobi else rr0
+        rho0 = (df.dot(r0, z0, axis_name=axis_name) if preconditioned
+                else rr0)
         rr_base = rr0
         k0 = jnp.zeros((), jnp.int32)
         indef0 = jnp.zeros((), bool)
@@ -377,10 +462,6 @@ def _solve(op, b_df, tol2, rtol2, resume, cap=None, *, maxiter,
     if record_history:
         history0 = history0.at[k0].set(
             jnp.sqrt(jnp.maximum(rr0[0], 0.0)))
-
-    # double-float operators (shift-ELL) expose matvec_df; the internal
-    # _DF64Operator dispatches through matvec
-    mv = op.matvec_df if hasattr(op, "matvec_df") else op.matvec
 
     def cond(s: _State):
         unconverged = jnp.logical_not(df.less(s.rr, thr))
@@ -396,8 +477,8 @@ def _solve(op, b_df, tol2, rtol2, resume, cap=None, *, maxiter,
         x = df.axpy(alpha, s.p, s.x)
         r = df.axpy(df.neg(alpha), ap, s.r)
         rr_new = df.dot(r, r, axis_name=axis_name)
-        if jacobi:
-            z = df.div(r, d)
+        if preconditioned:
+            z = apply_m(r)
             rho_new = df.dot(r, z, axis_name=axis_name)
         else:
             z, rho_new = r, rr_new
@@ -449,7 +530,8 @@ def _solve(op, b_df, tol2, rtol2, resume, cap=None, *, maxiter,
 _solve_jit = jax.jit(_solve, static_argnames=("maxiter", "record_history",
                                               "jacobi", "axis_name",
                                               "return_checkpoint",
-                                              "check_every"))
+                                              "check_every",
+                                              "chebyshev_degree"))
 
 
 # -- single-reduction / pipelined variants ------------------------------------

@@ -98,7 +98,8 @@ class TestCLI:
     def test_df64_rejects_unsupported(self):
         with pytest.raises(SystemExit, match="df64"):
             cli.main(["--problem", "poisson2d", "--n", "8", "--device",
-                      "cpu", "--dtype", "df64", "--precond", "chebyshev"])
+                      "cpu", "--dtype", "df64", "--precond", "mg",
+                      "--matrix-free"])
         # dense operators have no distributed df64 route
         with pytest.raises(SystemExit, match="df64"):
             cli.main(["--problem", "random-spd", "--n", "8", "--device",
@@ -255,3 +256,19 @@ def test_df64_mesh_csr_ring(capsys):
     rec = _json.loads(capsys.readouterr().out)
     assert rc == 0 and rec["converged"] and rec["mesh"] == 2
     assert rec["residual_norm"] < 1e-7
+
+
+def test_df64_chebyshev_cli(capsys):
+    """--dtype df64 --precond chebyshev: the polynomial preconditioner at
+    f64-class precision."""
+    import json as _json
+
+    rc = cli.main(["--problem", "poisson2d", "--n", "16", "--device",
+                   "cpu", "--dtype", "df64", "--precond", "chebyshev",
+                   "--tol", "0", "--rtol", "1e-10", "--json"])
+    rec = _json.loads(capsys.readouterr().out)
+    assert rc == 0 and rec["converged"] and rec["precond"] == "chebyshev"
+    with pytest.raises(SystemExit, match="chebyshev"):
+        cli.main(["--problem", "poisson2d", "--n", "8", "--device", "cpu",
+                  "--dtype", "df64", "--precond", "chebyshev",
+                  "--method", "cg1"])

@@ -356,3 +356,39 @@ class TestPencilDF64:
         with pytest.raises(TypeError, match="Stencil3D"):
             solve_distributed_df64(a2, np.ones(64),
                                    mesh=make_mesh_2d((4, 2)))
+
+
+class TestChebyshevDF64Dist:
+    """df64 Chebyshev over meshes: the polynomial inherits the operator's
+    communication (halo ppermutes / ring rotations), the interval comes
+    from the global operator host-side."""
+
+    def test_slab_matches_single_device(self, rng):
+        grid = (16, 8, 6)
+        a = Stencil3D.create(*grid, dtype=jnp.float32)
+        a64 = Stencil3D.create(*grid, dtype=jnp.float64)
+        x_true = rng.standard_normal(int(np.prod(grid)))
+        b = np.asarray(a64 @ jnp.asarray(x_true), dtype=np.float64)
+        single = cg_df64(a, b, tol=0.0, rtol=1e-10, maxiter=2000,
+                         preconditioner="chebyshev")
+        dist = solve_distributed_df64(a, b, mesh=make_mesh(8), tol=0.0,
+                                      rtol=1e-10, maxiter=2000,
+                                      preconditioner="chebyshev")
+        assert bool(dist.converged)
+        assert abs(int(dist.iterations) - int(single.iterations)) <= 2
+        np.testing.assert_allclose(dist.x(), x_true, atol=1e-8)
+
+    def test_ring_csr_chebyshev(self, rng):
+        from cuda_mpi_parallel_tpu.models import poisson
+
+        a = poisson.poisson_2d_csr(24, 24, dtype=np.float64)
+        x_true = rng.standard_normal(a.shape[0])
+        b = np.asarray(a.to_dense(), np.float64) @ x_true
+        plain = solve_distributed_df64(a, b, mesh=make_mesh(8), tol=0.0,
+                                       rtol=1e-10, maxiter=3000)
+        cheb = solve_distributed_df64(a, b, mesh=make_mesh(8), tol=0.0,
+                                      rtol=1e-10, maxiter=3000,
+                                      preconditioner="chebyshev")
+        assert bool(cheb.converged)
+        assert int(cheb.iterations) * 2 < int(plain.iterations)
+        np.testing.assert_allclose(cheb.x(), x_true, atol=1e-7)
