@@ -256,11 +256,14 @@ def main(argv=None) -> int:
         elif args.mesh > 1 and args.fmt != "csr":
             bad = (f"--format {args.fmt} with --mesh > 1 (distributed "
                    f"CSR uses the df64 ring-shiftell schedule directly)")
-        elif args.precond not in (None, "jacobi", "chebyshev"):
-            bad = (f"--precond {args.precond} (None, jacobi or "
-                   f"chebyshev only)")
-        elif args.precond == "chebyshev" and args.method != "cg":
-            bad = "--precond chebyshev with --method != cg"
+        elif args.precond not in (None, "jacobi", "chebyshev", "mg"):
+            bad = (f"--precond {args.precond} (None, jacobi, chebyshev "
+                   f"or mg only)")
+        elif args.precond == "mg" and not isinstance(a, (_S2, _S3)):
+            bad = ("--precond mg on a non-stencil operator (the "
+                   "geometric hierarchy needs a matrix-free grid)")
+        elif args.precond in ("chebyshev", "mg") and args.method != "cg":
+            bad = f"--precond {args.precond} with --method != cg"
         elif args.fmt == "dia":
             bad = "--format dia (csr/ell/shiftell/matrix-free only)"
         elif not isinstance(a, (_CSR, _ELL, _S2, _S3)):

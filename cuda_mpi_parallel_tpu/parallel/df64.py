@@ -222,7 +222,11 @@ def solve_distributed_df64(
       a: global ``Stencil2D``/``Stencil3D`` (matrix-free halo path) or
         ``CSRMatrix`` (assembled: df64 ring-shiftell schedule).
       b: global rhs; a float64 numpy array keeps full df64 precision.
-      preconditioner: ``None`` or ``"jacobi"`` (diag applied in df64).
+      preconditioner: ``None``, ``"jacobi"`` (diag applied in df64),
+        ``"chebyshev"`` (df64 polynomial, interval from the global f32
+        operator) or ``"mg"`` (one symmetric f32 V-cycle on the hi word
+        through the distributed multigrid hierarchy - stencils only,
+        ``method="cg"`` only).
       method: ``"cg"`` (textbook: two psums/iteration), ``"cg1"``
         (inner products fused into ONE psum - half the collective
         latency) or ``"pipecg"`` (that psum overlaps the halo-exchanged
@@ -236,13 +240,19 @@ def solve_distributed_df64(
     """
     if mesh is None:
         mesh = make_mesh(n_devices)
-    if preconditioner not in (None, "jacobi", "chebyshev"):
+    if preconditioner not in (None, "jacobi", "chebyshev", "mg"):
         raise ValueError(
             f"solve_distributed_df64 supports preconditioner=None, "
-            f"'jacobi' or 'chebyshev', got {preconditioner!r}")
-    if preconditioner == "chebyshev" and method != "cg":
+            f"'jacobi', 'chebyshev' or 'mg', got {preconditioner!r}")
+    if preconditioner in ("chebyshev", "mg") and method != "cg":
         raise ValueError(
-            "preconditioner='chebyshev' requires method='cg' in df64")
+            f"preconditioner={preconditioner!r} requires method='cg' "
+            f"in df64")
+    if preconditioner == "mg" and not isinstance(a, (Stencil2D, Stencil3D)):
+        raise ValueError(
+            "preconditioner='mg' needs a matrix-free stencil operator "
+            "(the geometric hierarchy rediscretizes the grid); assembled "
+            "CSR supports jacobi or chebyshev")
     if method not in ("cg", "cg1", "pipecg"):
         raise ValueError(f"unknown method {method!r}; expected 'cg', "
                          f"'cg1' or 'pipecg'")
@@ -266,6 +276,7 @@ def solve_distributed_df64(
             jacobi=preconditioner == "jacobi",
             cheb=(precond_degree if preconditioner == "chebyshev"
                   else None),
+            mg_flag=preconditioner == "mg",
             record_history=record_history, check_every=check_every,
             method=method)
     axis = mesh.axis_names[0]
@@ -280,6 +291,19 @@ def solve_distributed_df64(
             method=method)
     local = DistStencilDF64.create(a.grid, n_shards, axis_name=axis,
                                    scale=a.scale)
+    mg_flag = preconditioner == "mg"
+    local32 = None
+    if mg_flag:
+        # f32 sibling of the df64 local block: the V-cycle smooths the
+        # residual's HI word through the existing distributed f32 MG
+        # hierarchy (halo-exchanging transfers, gather-level coarse
+        # continuation) - mixed-precision PCG, see solver.df64.cg_df64
+        from .operators import DistStencil2D, DistStencil3D
+
+        cls32 = DistStencil2D if isinstance(a, Stencil2D) else DistStencil3D
+        local32 = cls32.create(a.grid, n_shards, axis_name=axis,
+                               scale=float(np.float64(np.asarray(a.scale))),
+                               dtype=jnp.float32)
     bh, bl = df.split_f64(b64)
     bh = shard_vector(jnp.asarray(bh), mesh, axis)
     bl = shard_vector(jnp.asarray(bl), mesh, axis)
@@ -298,7 +322,7 @@ def solve_distributed_df64(
         residual_history=P() if record_history else None,
         checkpoint=None)
     key = (local.local_grid, local.kind, axis, mesh, jacobi, cheb,
-           record_history, maxiter, check_every, method)
+           mg_flag, record_history, maxiter, check_every, method)
 
     def build():
         @partial(jax.shard_map, mesh=mesh,
@@ -307,6 +331,12 @@ def solve_distributed_df64(
                  out_specs=out)
         def run(bh_l, bl_l, sh, sl, t2h, t2l, r2h, r2l, interval_t):
             loc = dataclasses.replace(local, scale_hi=sh, scale_lo=sl)
+            mg_op = None
+            if mg_flag:
+                from ..models.multigrid import MultigridPreconditioner
+
+                mg_op = MultigridPreconditioner.from_operator(
+                    dataclasses.replace(local32, scale=sh))
             if method != "cg":
                 return _VARIANTS[method](
                     loc, (bh_l, bl_l), (t2h, t2l), (r2h, r2l),
@@ -314,7 +344,7 @@ def solve_distributed_df64(
                     jacobi=jacobi, axis_name=axis,
                     check_every=check_every)
             return _df_solve(loc, (bh_l, bl_l), (t2h, t2l), (r2h, r2l),
-                             None, cheb_interval=interval_t,
+                             None, cheb_interval=interval_t, mg=mg_op,
                              maxiter=maxiter,
                              record_history=record_history, jacobi=jacobi,
                              axis_name=axis, check_every=check_every,
@@ -330,7 +360,7 @@ def solve_distributed_df64(
 
 def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
                        cheb, record_history, check_every,
-                       method) -> DF64CGResult:
+                       method, mg_flag=False) -> DF64CGResult:
     """Stencil3D df64 over a 2-D mesh: x- and y-axes partitioned, two
     halo ppermute pairs per matvec (hi/lo stacked), dots reduced over
     BOTH mesh axes at df64 accuracy."""
@@ -339,6 +369,14 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
     local = DistStencilDF64Pencil.create(a.grid, (sx, sy),
                                          axis_names=(ax_x, ax_y),
                                          scale=a.scale)
+    local32 = None
+    if mg_flag:
+        from .operators import DistStencil3DPencil
+
+        local32 = DistStencil3DPencil.create(
+            a.grid, (sx, sy), axis_names=(ax_x, ax_y),
+            scale=float(np.float64(np.asarray(a.scale))),
+            dtype=jnp.float32)
     interval = chebyshev_interval(a) if cheb is not None else None
     nx, ny, nz = a.grid
     bh_np, bl_np = df.split_f64(b64)
@@ -355,8 +393,8 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
         residual_history=P() if record_history else None,
         checkpoint=None)
     key = ("pencil-df64", local.local_grid, local.shards, (ax_x, ax_y),
-           mesh, jacobi, cheb, record_history, maxiter, check_every,
-           method)
+           mesh, jacobi, cheb, mg_flag, record_history, maxiter,
+           check_every, method)
 
     def build():
         @partial(jax.shard_map, mesh=mesh,
@@ -367,6 +405,12 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
             loc = dataclasses.replace(local, scale_hi=sh, scale_lo=sl)
             b_df = (bh_l.reshape(-1), bl_l.reshape(-1))
             axis = (ax_x, ax_y)
+            mg_op = None
+            if mg_flag:
+                from ..models.multigrid import MultigridPreconditioner
+
+                mg_op = MultigridPreconditioner.from_operator(
+                    dataclasses.replace(local32, scale=sh))
             if method != "cg":
                 res = _VARIANTS[method](
                     loc, b_df, (t2h, t2l), (r2h, r2l), maxiter=maxiter,
@@ -374,7 +418,7 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
                     axis_name=axis, check_every=check_every)
             else:
                 res = _df_solve(loc, b_df, (t2h, t2l), (r2h, r2l), None,
-                                cheb_interval=interval_t,
+                                cheb_interval=interval_t, mg=mg_op,
                                 maxiter=maxiter,
                                 record_history=record_history,
                                 jacobi=jacobi, axis_name=axis,

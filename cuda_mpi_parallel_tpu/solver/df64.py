@@ -248,8 +248,12 @@ def cg_df64(
     configuration), ``"jacobi"`` (diag(A)^-1 applied in df64 - BASELINE
     config #3 at f64-class precision) or ``"chebyshev"``
     (``precond_degree``-term Chebyshev polynomial applied in df64, its
-    spectral interval from an in-jit hi-word power iteration;
-    ``method="cg"`` only).
+    spectral interval from a HOST-SIDE hi-word power iteration before
+    dispatch - an in-jit estimate exploded virtual-mesh compile times,
+    see ``chebyshev_interval``; ``method="cg"`` only) or ``"mg"`` (one symmetric f32 geometric
+    V-cycle on the residual's hi word - mixed-precision PCG, stencil
+    operators only, ``method="cg"`` only; grid-independent iteration
+    counts at f64-class precision).
     ``resume_from``/``return_checkpoint`` mirror ``solve``'s
     checkpointing: ``maxiter`` remains the TOTAL iteration cap, and the
     resumed run continues the exact df64 trajectory.
@@ -267,18 +271,23 @@ def cg_df64(
     sweeps (``solve_resumable_df64``) vary it without recompiling -
     ``maxiter`` alone is static and would retrace per segment.
     """
-    if preconditioner not in (None, "jacobi", "chebyshev"):
+    if preconditioner not in (None, "jacobi", "chebyshev", "mg"):
         raise ValueError(
-            f"cg_df64 supports preconditioner=None, 'jacobi' or "
-            f"'chebyshev', got {preconditioner!r}")
+            f"cg_df64 supports preconditioner=None, 'jacobi', 'chebyshev' "
+            f"or 'mg', got {preconditioner!r}")
     if method not in ("cg", "cg1", "pipecg"):
         raise ValueError(f"unknown method {method!r}; expected 'cg', "
                          f"'cg1' or 'pipecg'")
-    if preconditioner == "chebyshev" and method != "cg":
+    if preconditioner in ("chebyshev", "mg") and method != "cg":
         raise ValueError(
-            "preconditioner='chebyshev' requires method='cg' in df64 "
-            "(the variants fuse their reductions around the plain or "
-            "Jacobi recurrence)")
+            f"preconditioner={preconditioner!r} requires method='cg' in "
+            f"df64 (the variants fuse their reductions around the plain "
+            f"or Jacobi recurrence)")
+    if preconditioner == "mg" and not isinstance(a, (Stencil2D, Stencil3D)):
+        raise ValueError(
+            f"preconditioner='mg' needs a matrix-free stencil operator "
+            f"(Stencil2D/Stencil3D - the geometric hierarchy rediscretizes "
+            f"the grid), got {type(a).__name__}")
     if precond_degree < 1:
         raise ValueError(f"precond_degree must be >= 1, got "
                          f"{precond_degree}")
@@ -312,14 +321,28 @@ def cg_df64(
                       jnp.int32)
     cheb = precond_degree if preconditioner == "chebyshev" else None
     interval = chebyshev_interval(a) if cheb is not None else None
+    mg = None
+    if preconditioner == "mg":
+        # the V-cycle applies in f32 to the HI word only - the standard
+        # mixed-precision PCG arrangement (a preconditioner is just a
+        # fixed SPD operator; the attainable accuracy is set by the df64
+        # recurrence arithmetic, not by M's application precision)
+        from ..models.multigrid import MultigridPreconditioner
+
+        a32 = a
+        if a._dtype_name != "float32":
+            a32 = dataclasses.replace(
+                a, scale=a.scale.astype(jnp.float32),
+                _dtype_name="float32")
+        mg = MultigridPreconditioner.from_operator(a32)
     if axis_name is None:
         return _solve_jit(op, b_df, tol2, rtol2, resume_from, cap,
-                          interval,
+                          interval, mg,
                           maxiter=maxiter, record_history=record_history,
                           jacobi=jacobi, axis_name=None,
                           return_checkpoint=return_checkpoint,
                           check_every=check_every, chebyshev_degree=cheb)
-    return _solve(op, b_df, tol2, rtol2, resume_from, cap, interval,
+    return _solve(op, b_df, tol2, rtol2, resume_from, cap, interval, mg,
                   maxiter=maxiter,
                   record_history=record_history, jacobi=jacobi,
                   axis_name=axis_name, return_checkpoint=return_checkpoint,
@@ -341,7 +364,13 @@ def chebyshev_interval(a, *, ratio: float = 30.0,
     """
     if hasattr(a, "matvec_df"):
         n = a.shape[0]
-        v = jnp.ones(n, jnp.float32)
+        # same deterministic pseudo-random start as
+        # models.precond.estimate_lmax: an aligned start (e.g. all-ones)
+        # can be exactly orthogonal to the dominant eigenvector, which
+        # would underestimate lmax and let the Chebyshev polynomial go
+        # indefinite on the uncovered tail
+        idx = jnp.arange(n, dtype=jnp.float32)
+        v = jnp.sin(idx * 12.9898 + 78.233) + 1.5
         v = v / jnp.sqrt(jnp.vdot(v, v))
         zeros = jnp.zeros(n, jnp.float32)
         for _ in range(iters):
@@ -408,6 +437,7 @@ def _safe_div(num: df.DF, den: df.DF) -> df.DF:
 
 
 def _solve(op, b_df, tol2, rtol2, resume, cap=None, cheb_interval=None,
+           mg=None,
            *, maxiter, record_history, jacobi, axis_name,
            return_checkpoint=False, check_every=1, chebyshev_degree=None):
     n = b_df[0].shape[0]
@@ -419,8 +449,16 @@ def _solve(op, b_df, tol2, rtol2, resume, cap=None, cheb_interval=None,
     # _DF64Operator dispatches through matvec
     mv = op.matvec_df if hasattr(op, "matvec_df") else op.matvec
 
-    preconditioned = jacobi or chebyshev_degree is not None
-    if chebyshev_degree is not None:
+    preconditioned = (jacobi or chebyshev_degree is not None
+                      or mg is not None)
+    if mg is not None:
+        # f32 V-cycle on the hi word; the result enters the df64
+        # recurrence with a zero lo word (mixed-precision PCG: M need
+        # only be a fixed SPD operator, see cg_df64)
+        def apply_m(r):
+            z = mg.matvec(r[0])
+            return (z, jnp.zeros_like(z))
+    elif chebyshev_degree is not None:
         theta, delta = cheb_interval
 
         def apply_m(r):

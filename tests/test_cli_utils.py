@@ -96,10 +96,10 @@ class TestCLI:
         assert rc == 0 and rec["converged"] and rec["precond"] == "jacobi"
 
     def test_df64_rejects_unsupported(self):
+        # mg on an ASSEMBLED operator has no geometric grid to coarsen
         with pytest.raises(SystemExit, match="df64"):
             cli.main(["--problem", "poisson2d", "--n", "8", "--device",
-                      "cpu", "--dtype", "df64", "--precond", "mg",
-                      "--matrix-free"])
+                      "cpu", "--dtype", "df64", "--precond", "mg"])
         # dense operators have no distributed df64 route
         with pytest.raises(SystemExit, match="df64"):
             cli.main(["--problem", "random-spd", "--n", "8", "--device",
@@ -272,3 +272,24 @@ def test_df64_chebyshev_cli(capsys):
         cli.main(["--problem", "poisson2d", "--n", "8", "--device", "cpu",
                   "--dtype", "df64", "--precond", "chebyshev",
                   "--method", "cg1"])
+
+
+def test_df64_mg_cli(capsys):
+    """--dtype df64 --precond mg: mixed-precision multigrid PCG (f32
+    V-cycle on the hi word, df64 recurrence) - single-device and over a
+    mesh."""
+    import json as _json
+
+    rc = cli.main(["--problem", "poisson2d", "--n", "32", "--device",
+                   "cpu", "--dtype", "df64", "--precond", "mg",
+                   "--matrix-free", "--tol", "0", "--rtol", "1e-10",
+                   "--json"])
+    rec = _json.loads(capsys.readouterr().out)
+    assert rc == 0 and rec["converged"] and rec["precond"] == "mg"
+    assert rec["iterations"] < 40  # grid-independent count, not O(n)
+    rc = cli.main(["--problem", "poisson2d", "--n", "32", "--device",
+                   "cpu", "--dtype", "df64", "--precond", "mg",
+                   "--matrix-free", "--mesh", "8", "--tol", "0",
+                   "--rtol", "1e-10", "--json"])
+    rec = _json.loads(capsys.readouterr().out)
+    assert rc == 0 and rec["converged"] and rec["iterations"] < 40

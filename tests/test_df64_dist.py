@@ -142,7 +142,7 @@ class TestDistSolveDF64:
         a = Stencil2D.create(8, 8)
         with pytest.raises(ValueError, match="jacobi"):
             solve_distributed_df64(a, np.ones(64), mesh=make_mesh(2),
-                                   preconditioner="mg")
+                                   preconditioner="ssor")
 
 
 class TestDistVariantsDF64:
