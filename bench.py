@@ -49,7 +49,7 @@ from itertools import count
 BASELINE_ITERS_PER_SEC = 5000.0
 
 HEADLINE_GRID = 1024          # 1024x1024 -> N = 1,048,576 unknowns
-ITERS_LO, ITERS_HI = 100, 2100
+ITERS_LO, ITERS_HI = 100, 10100
 HEADLINE_KEY = "poisson2d_1M_stencil"
 HEADLINE_METRIC = "cg_iters_per_sec_poisson2d_1M_f32"
 RESULTS_PATH = "bench_results.json"
@@ -218,9 +218,9 @@ def bench_headline(device=None):
     import jax.numpy as jnp
     import numpy as np
 
-    from cuda_mpi_parallel_tpu import solve
+    from cuda_mpi_parallel_tpu import cg_resident, solve, supports_resident
+    from cuda_mpi_parallel_tpu.utils.timing import paired_delta_rate
     from cuda_mpi_parallel_tpu.models import poisson
-    from cuda_mpi_parallel_tpu.utils.timing import time_fn
 
     n = HEADLINE_GRID
     op = poisson.poisson_2d_operator(n, n, dtype=jnp.float32)
@@ -236,17 +236,32 @@ def bench_headline(device=None):
     # measured ~30% faster per iteration on v5e at this size.
     # Every call gets a fresh rhs VALUE: the tunneled runtime can serve
     # repeated identical dispatches from a cache, which zeroes deltas.
+    # Protocol: INTERLEAVED lo/hi pairs, median of per-pair delta rates.
+    # The tunnel's service rate drifts on a timescale of seconds, so the
+    # phase-separated protocol (all lo calls, then all hi calls) aliases
+    # that drift into the subtraction: measured 34.6-41.9k iters/s across
+    # runs whose interleaved per-pair rates were a stable 49.5-53.8k
+    # (spread ~8%, vs ~40% phase-separated).  Adjacent lo/hi calls see the
+    # same service rate and the per-pair delta cancels it; the 10k-iter
+    # delta (~190 ms differential device work) dominates residual jitter.
+    # Engine: the VMEM-resident single-kernel CG (solver.resident) - the
+    # whole solve is ONE pallas kernel, vectors pinned in VMEM, zero HBM
+    # traffic per iteration.  Measured 6.65 us/iter vs ~19 us for the
+    # general while_loop solver at this size (bench_all records both).
+    # Falls back to the general solver off-TPU (the pallas-TPU kernel
+    # needs Mosaic; interpret mode would measure nothing real).
     ctr = count(1)
+    use_resident = (jax.default_backend() == "tpu"
+                    and supports_resident(op))
 
     def run(it):
         bb = b * np.float32(1.0 + next(ctr) * 1e-4)
+        if use_resident:
+            return cg_resident(op, bb, tol=0.0, maxiter=it,
+                               check_every=32).x
         return solve(op, bb, tol=0.0, maxiter=it, check_every=32).x
 
-    t_lo, _ = time_fn(lambda: run(ITERS_LO), warmup=1, repeats=5,
-                      reduce="median")
-    t_hi, _ = time_fn(lambda: run(ITERS_HI), warmup=1, repeats=5,
-                      reduce="median")
-    value = (ITERS_HI - ITERS_LO) / max(t_hi - t_lo, 1e-9)
+    value = paired_delta_rate(run, ITERS_LO, ITERS_HI, pairs=7)
     return {
         "metric": HEADLINE_METRIC,
         "value": round(value, 1),
@@ -271,11 +286,13 @@ def bench_all(results) -> None:
     from cuda_mpi_parallel_tpu import solve
     from cuda_mpi_parallel_tpu.models import poisson, random_spd
     from cuda_mpi_parallel_tpu.parallel import make_mesh, solve_distributed
-    from cuda_mpi_parallel_tpu.utils.timing import time_fn
+    from cuda_mpi_parallel_tpu.utils.timing import paired_delta_rate, time_fn
 
     def iter_delta(op, rhs, lo, hi, repeats=5, solver=None, **kw):
         # fresh rhs value per call: defeats the tunnel's identical-
-        # dispatch result cache (see bench_headline)
+        # dispatch result cache (see bench_headline).  Interleaved lo/hi
+        # pairs cancel the tunnel's service-rate drift (paired_delta_rate
+        # docstring has the measurements behind this protocol).
         ctr = count(1)
         run_solve = solver or (
             lambda rr, it: solve(op, rr, tol=0.0, maxiter=it,
@@ -285,12 +302,9 @@ def bench_all(results) -> None:
             rr = rhs * np.float32(1.0 + next(ctr) * 1e-4)
             return run_solve(rr, it)
 
-        tl, _ = time_fn(lambda: run(lo), warmup=1, repeats=repeats,
-                        reduce="median")
-        th, _ = time_fn(lambda: run(hi), warmup=1, repeats=repeats,
-                        reduce="median")
-        return {"us_per_iter": (th - tl) / (hi - lo) * 1e6,
-                "iters_per_sec": (hi - lo) / max(th - tl, 1e-9),
+        rate = paired_delta_rate(run, lo, hi, pairs=repeats)
+        return {"us_per_iter": 1e6 / rate,
+                "iters_per_sec": rate,
                 "measurement": "iteration_delta"}
 
     # Lazily-built shared inputs (sections skip independently on resume,
@@ -328,6 +342,18 @@ def bench_all(results) -> None:
         results[HEADLINE_KEY] = bench_headline()
 
     _run_section(results, HEADLINE_KEY, s_headline)
+
+    # The general lax.while_loop solver on the same problem: what the
+    # headline measured before the VMEM-resident engine existed.  Kept as
+    # its own row so the resident kernel's win (and any regression in
+    # the general path every other operator uses) stays visible.
+    def s_whileloop():
+        op = poisson.poisson_2d_operator(HEADLINE_GRID, HEADLINE_GRID,
+                                         dtype=jnp.float32)
+        results["poisson2d_1M_stencil_whileloop"] = iter_delta(
+            op, rhs_1m(), 100, 10100, repeats=5)
+
+    _run_section(results, "poisson2d_1M_stencil_whileloop", s_whileloop)
 
     def s_csr():
         # keep this single call short: at ~83 ms/iter the XLA-gather kernel
@@ -375,13 +401,10 @@ def bench_all(results) -> None:
             return cg_df64(op_df, b_np64 * (1.0 + next(ctr) * 1e-4),
                            tol=0.0, maxiter=it, check_every=32)
 
-        tl, _ = time_fn(lambda: run_df(200), warmup=1, repeats=3,
-                        reduce="median")
-        th, _ = time_fn(lambda: run_df(6200), warmup=1, repeats=3,
-                        reduce="median")
+        rate = paired_delta_rate(run_df, 200, 6200, pairs=3)
         results["poisson2d_1M_stencil_df64"] = {
-            "us_per_iter": (th - tl) / 6000 * 1e6,
-            "iters_per_sec": 6000 / max(th - tl, 1e-9),
+            "us_per_iter": 1e6 / rate,
+            "iters_per_sec": rate,
             "measurement": "iteration_delta"}
 
     _run_section(results, "poisson2d_1M_stencil_df64", s_df64)
@@ -403,13 +426,10 @@ def bench_all(results) -> None:
                            tol=0.0, maxiter=it, check_every=32,
                            method="cg1")
 
-        tl, _ = time_fn(lambda: run_df(200), warmup=1, repeats=3,
-                        reduce="median")
-        th, _ = time_fn(lambda: run_df(6200), warmup=1, repeats=3,
-                        reduce="median")
+        rate = paired_delta_rate(run_df, 200, 6200, pairs=3)
         results["poisson2d_1M_stencil_df64_cg1"] = {
-            "us_per_iter": (th - tl) / 6000 * 1e6,
-            "iters_per_sec": 6000 / max(th - tl, 1e-9),
+            "us_per_iter": 1e6 / rate,
+            "iters_per_sec": rate,
             "measurement": "iteration_delta"}
 
     _run_section(results, "poisson2d_1M_stencil_df64_cg1", s_df64_cg1)
@@ -429,13 +449,10 @@ def bench_all(results) -> None:
             return cg_df64(a_df, b_np64 * (1.0 + next(ctr) * 1e-4),
                            tol=0.0, maxiter=it, check_every=32)
 
-        tl, _ = time_fn(lambda: run_df(100), warmup=1, repeats=3,
-                        reduce="median")
-        th, _ = time_fn(lambda: run_df(2100), warmup=1, repeats=3,
-                        reduce="median")
+        rate = paired_delta_rate(run_df, 100, 2100, pairs=3)
         results["poisson2d_1M_shiftell_df64"] = {
-            "us_per_iter": (th - tl) / 2000 * 1e6,
-            "iters_per_sec": 2000 / max(th - tl, 1e-9),
+            "us_per_iter": 1e6 / rate,
+            "iters_per_sec": rate,
             "measurement": "iteration_delta"}
 
     _run_section(results, "poisson2d_1M_shiftell_df64", s_df64_shiftell)
@@ -483,13 +500,11 @@ def bench_all(results) -> None:
                     return acc + r.x[0]
                 return lax.fori_loop(0, reps, body, jnp.zeros((), b.dtype))
 
-            t1, _ = time_fn(lambda m=m: many(b3, m, 1),
-                            warmup=1, repeats=3, reduce="median")
-            t21, _ = time_fn(lambda m=m: many(b3, m, 21),
-                             warmup=1, repeats=3, reduce="median")
+            solves_per_sec = paired_delta_rate(
+                lambda reps, m=m: many(b3, m, reps), 1, 21, pairs=3)
             res = solve(op2, b3, tol=0.0, rtol=1e-6, maxiter=5000, m=m)
             results[f"poisson2d_512_{name}_rtol1e-6"] = {
-                "time_to_tol_s": max(t21 - t1, 0.0) / 20,
+                "time_to_tol_s": 1.0 / solves_per_sec,
                 "iterations": int(res.iterations),
                 "converged": bool(res.converged),
                 "measurement": "solve_delta"}
@@ -554,13 +569,12 @@ def bench_all(results) -> None:
                     return acc + r.x[0]
                 return lax.fori_loop(0, reps, body, jnp.zeros((), b.dtype))
 
-            t1, _ = time_fn(lambda m256=m256: many256(b256, m256, 1),
-                            warmup=1, repeats=3, reduce="median")
-            t5, _ = time_fn(lambda m256=m256: many256(b256, m256, 5),
-                            warmup=1, repeats=3, reduce="median")
+            solves_per_sec = paired_delta_rate(
+                lambda reps, m256=m256: many256(b256, m256, reps),
+                1, 5, pairs=3)
             res = solve(a256, b256, tol=0.0, rtol=1e-6, maxiter=2000, m=m256)
             results[f"poisson3d_256_{name}_rtol1e-6"] = {
-                "time_to_tol_s": max(t5 - t1, 0.0) / 4,
+                "time_to_tol_s": 1.0 / solves_per_sec,
                 "iterations": int(res.iterations),
                 "converged": bool(res.converged),
                 "measurement": "solve_delta"}

@@ -30,6 +30,7 @@ from .models.operators import (
 )
 from .solver.cg import CGCheckpoint, CGResult, cg, solve
 from .solver.df64 import DF64CGResult, DF64Checkpoint, cg_df64
+from .solver.resident import cg_resident, supports_resident
 from .solver.status import CGStatus
 
 __version__ = "0.1.0"
@@ -51,5 +52,7 @@ __all__ = [
     "Stencil3D",
     "cg",
     "cg_df64",
+    "cg_resident",
     "solve",
+    "supports_resident",
 ]

@@ -92,6 +92,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stencil matvec backend for --matrix-free problems: "
                         "XLA fused adds or the pallas slab-DMA kernel "
                         "(auto picks by grid size)")
+    p.add_argument("--engine", default="auto",
+                   choices=["auto", "general", "resident"],
+                   help="solver engine: 'general' is the jitted "
+                        "lax.while_loop solver; 'resident' runs the WHOLE "
+                        "solve as one VMEM-resident pallas kernel (2D "
+                        "stencil, f32, unpreconditioned - ~2.9x faster at "
+                        "1M unknowns); 'auto' picks resident when eligible")
     p.add_argument("--method", default="cg",
                    choices=["cg", "cg1", "pipecg"],
                    help="CG recurrence: textbook (the reference's, two "
@@ -294,6 +301,15 @@ def main(argv=None) -> int:
             raise SystemExit(f"--format {args.fmt}: {e}")
         desc += f" [{args.fmt}]"
 
+    if args.engine == "resident":
+        if args.df64 or args.mesh > 1:
+            raise SystemExit("--engine resident is single-device float32 "
+                             "only (no --dtype df64, no --mesh > 1)")
+        if args.precond is not None or args.method != "cg" or args.history:
+            raise SystemExit("--engine resident supports unpreconditioned "
+                             "--method cg without --history (the one-kernel "
+                             "solve records no trace)")
+
     def run():
         if args.df64:
             if args.mesh > 1:
@@ -335,6 +351,33 @@ def main(argv=None) -> int:
                 precond_degree=args.precond_degree,
                 record_history=args.history, method=args.method,
                 check_every=args.check_every, csr_comm=args.csr_comm)
+        if args.engine in ("auto", "resident"):
+            from .models.operators import _pallas_interpret
+            from .solver.resident import cg_resident, supports_resident
+
+            # "auto" takes the resident engine only on a compiled TPU
+            # backend: off-TPU the kernel would run in pallas interpret
+            # mode, orders of magnitude slower than the jitted general
+            # solver.  An EXPLICIT --engine resident still honors the
+            # request anywhere (interpret mode off-TPU - correctness
+            # checks, not speed).
+            import jax as _jax
+
+            eligible = (supports_resident(a) and args.precond is None
+                        and args.method == "cg" and not args.history
+                        and (args.engine == "resident"
+                             or _jax.default_backend() == "tpu"))
+            if args.engine == "resident" and not eligible:
+                raise SystemExit(
+                    f"--engine resident does not support "
+                    f"{type(a).__name__} at this size (needs a float32 "
+                    f"2D stencil whose CG working set fits VMEM; try "
+                    f"--problem poisson2d --matrix-free)")
+            if eligible:
+                return cg_resident(a, b, tol=args.tol, rtol=args.rtol,
+                                   maxiter=args.maxiter,
+                                   check_every=args.check_every,
+                                   interpret=_pallas_interpret())
         from . import solve
         from .models.operators import JacobiPreconditioner
         from .models.precond import (

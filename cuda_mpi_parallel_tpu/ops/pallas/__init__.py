@@ -3,6 +3,11 @@
 ``CUDACG.cu:288,248-347`` - here written in Pallas/Mosaic, not called from a
 vendor library)."""
 
+from .resident import (
+    cg_resident_2d,
+    supports_resident_2d,
+    vmem_bytes,
+)
 from .stencil import (
     pick_block_planes_3d,
     pick_block_rows_2d,
@@ -13,6 +18,9 @@ from .stencil import (
 )
 
 __all__ = [
+    "cg_resident_2d",
+    "supports_resident_2d",
+    "vmem_bytes",
     "pick_block_planes_3d",
     "pick_block_rows_2d",
     "stencil2d_apply",
