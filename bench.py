@@ -434,6 +434,40 @@ def bench_all(results) -> None:
 
     _run_section(results, "poisson2d_1M_stencil_df64_cg1", s_df64_cg1)
 
+    # df64 x VMEM-resident: the reference's f64 precision in the
+    # framework's single-kernel execution shape (solver.resident.
+    # cg_resident_df64) - all eight hi/lo planes pinned in VMEM.
+    def s_df64_resident():
+        from cuda_mpi_parallel_tpu import (
+            cg_resident_df64,
+            supports_resident_df64,
+        )
+
+        n = HEADLINE_GRID
+        op_df = poisson.poisson_2d_operator(n, n, dtype=jnp.float32)
+        if jax.default_backend() != "tpu" or not supports_resident_df64(
+                op_df):
+            results["poisson2d_1M_stencil_df64_resident"] = {
+                "skipped": "needs a compiled TPU backend"}
+            return
+        rng = np.random.default_rng(0)
+        b_np64 = rng.standard_normal(n * n)
+        ctr = count(1)
+
+        def run_df(it):
+            return cg_resident_df64(op_df, b_np64 * (1.0 + next(ctr) * 1e-4),
+                                    tol=0.0, maxiter=it,
+                                    check_every=32).x_hi
+
+        rate = paired_delta_rate(run_df, 200, 6200, pairs=3)
+        results["poisson2d_1M_stencil_df64_resident"] = {
+            "us_per_iter": 1e6 / rate,
+            "iters_per_sec": rate,
+            "measurement": "iteration_delta"}
+
+    _run_section(results, "poisson2d_1M_stencil_df64_resident",
+                 s_df64_resident)
+
     # df64 x shift-ELL: f64-class CG on the ASSEMBLED 1M-row matrix via
     # the pallas double-float lane-gather kernel - the reference's
     # defining combination (CUDA_R_64F CSR SpMV, CUDACG.cu:216,288).
@@ -704,6 +738,12 @@ def main(argv=None) -> int:
                     help="run every BASELINE config, write bench_results.json")
     ap.add_argument("--acquire-wait", type=float, default=600.0,
                     help="max seconds to wait for the device backend")
+    ap.add_argument("--resume", action="store_true",
+                    help="seed --all from an existing bench_results.json, "
+                         "skipping sections already marked done (for "
+                         "re-running after a tunnel outage; default is a "
+                         "fresh sweep so one run never mixes results from "
+                         "different code states)")
     args = ap.parse_args(argv)
     _WATCHDOG["mode"] = "all" if args.all else "headline"
 
@@ -733,6 +773,21 @@ def main(argv=None) -> int:
 
     if args.all:
         results = _FlushingResults(RESULTS_PATH)
+        if args.resume and os.path.exists(RESULTS_PATH):
+            try:
+                with open(RESULTS_PATH) as f:
+                    prior = json.load(f)
+                # Drop stale __error markers: errored sections must re-run
+                # (the error may be fixed); only completed work resumes.
+                prior = {k: v for k, v in prior.items()
+                         if not k.endswith("__error")}
+                dict.update(results, prior)  # no per-key flush churn
+                done = [k for k in prior if k.endswith("__done")]
+                print(f"# --resume: {len(done)} sections already done",
+                      file=sys.stderr)
+            except (OSError, ValueError) as e:
+                print(f"# --resume: could not load {RESULTS_PATH}: {e}; "
+                      f"starting fresh", file=sys.stderr)
         completed = False
         for attempt in range(3):
             try:

@@ -30,7 +30,12 @@ from .models.operators import (
 )
 from .solver.cg import CGCheckpoint, CGResult, cg, solve
 from .solver.df64 import DF64CGResult, DF64Checkpoint, cg_df64
-from .solver.resident import cg_resident, supports_resident
+from .solver.resident import (
+    cg_resident,
+    cg_resident_df64,
+    supports_resident,
+    supports_resident_df64,
+)
 from .solver.status import CGStatus
 
 __version__ = "0.1.0"
@@ -53,6 +58,8 @@ __all__ = [
     "cg",
     "cg_df64",
     "cg_resident",
+    "cg_resident_df64",
     "solve",
     "supports_resident",
+    "supports_resident_df64",
 ]

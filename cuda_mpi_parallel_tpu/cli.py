@@ -23,6 +23,15 @@ import sys
 import numpy as np
 
 
+def _jax_backend_is_tpu() -> bool:
+    """True on a compiled-TPU backend (the only place pallas kernels run
+    compiled; everywhere else "auto" engine choices avoid interpret
+    mode)."""
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="cuda_mpi_parallel_tpu",
@@ -302,9 +311,9 @@ def main(argv=None) -> int:
         desc += f" [{args.fmt}]"
 
     if args.engine == "resident":
-        if args.df64 or args.mesh > 1:
-            raise SystemExit("--engine resident is single-device float32 "
-                             "only (no --dtype df64, no --mesh > 1)")
+        if args.mesh > 1:
+            raise SystemExit("--engine resident is single-device "
+                             "(no --mesh > 1)")
         if args.precond is not None or args.method != "cg" or args.history:
             raise SystemExit("--engine resident supports unpreconditioned "
                              "--method cg without --history (the one-kernel "
@@ -323,6 +332,29 @@ def main(argv=None) -> int:
                     precond_degree=args.precond_degree,
                     record_history=args.history,
                     check_every=args.check_every, method=args.method)
+            if args.engine in ("auto", "resident") and args.mesh == 1:
+                from .models.operators import _pallas_interpret
+                from .solver.resident import (
+                    cg_resident_df64,
+                    supports_resident_df64,
+                )
+
+                eligible = (supports_resident_df64(a)
+                            and args.precond is None
+                            and args.method == "cg" and not args.history
+                            and (args.engine == "resident"
+                                 or _jax_backend_is_tpu()))
+                if args.engine == "resident" and not eligible:
+                    raise SystemExit(
+                        f"--engine resident --dtype df64 does not support "
+                        f"{type(a).__name__} at this size (needs a 2D "
+                        f"stencil whose df64 working set fits VMEM)")
+                if eligible:
+                    return cg_resident_df64(
+                        a, np.asarray(b, dtype=np.float64), tol=args.tol,
+                        rtol=args.rtol, maxiter=args.maxiter,
+                        check_every=args.check_every,
+                        interpret=_pallas_interpret())
             from .solver.df64 import cg_df64
 
             return cg_df64(a, np.asarray(b, dtype=np.float64),
@@ -361,12 +393,10 @@ def main(argv=None) -> int:
             # solver.  An EXPLICIT --engine resident still honors the
             # request anywhere (interpret mode off-TPU - correctness
             # checks, not speed).
-            import jax as _jax
-
             eligible = (supports_resident(a) and args.precond is None
                         and args.method == "cg" and not args.history
                         and (args.engine == "resident"
-                             or _jax.default_backend() == "tpu"))
+                             or _jax_backend_is_tpu()))
             if args.engine == "resident" and not eligible:
                 raise SystemExit(
                     f"--engine resident does not support "

@@ -5,7 +5,9 @@ vendor library)."""
 
 from .resident import (
     cg_resident_2d,
+    cg_resident_df64_2d,
     supports_resident_2d,
+    supports_resident_df64_2d,
     vmem_bytes,
 )
 from .stencil import (
@@ -19,7 +21,9 @@ from .stencil import (
 
 __all__ = [
     "cg_resident_2d",
+    "cg_resident_df64_2d",
     "supports_resident_2d",
+    "supports_resident_df64_2d",
     "vmem_bytes",
     "pick_block_planes_3d",
     "pick_block_rows_2d",

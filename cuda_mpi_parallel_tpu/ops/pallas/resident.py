@@ -46,6 +46,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import df64 as df
+from ..blas1 import _two_prod, _two_sum
+
 _ENV_OVERRIDE = "CMP_RESIDENT_VMEM_BYTES"
 
 # Usable VMEM by TPU generation (device_kind substring -> bytes).  v2/v3
@@ -128,7 +131,7 @@ def _shift_stencil(u, scale):
 
 def _resident_kernel(nblocks, check_every,
                      params_ref, cap_ref, b_ref,
-                     x_ref, iters_ref, rr_ref, indef_ref,
+                     x_ref, iters_ref, rr_ref, indef_ref, conv_ref,
                      r_ref, p_ref, state_f, state_i):
     scale = params_ref[0]
     tol = params_ref[1]
@@ -148,8 +151,10 @@ def _resident_kernel(nblocks, check_every,
     state_i[1] = jnp.int32(0)   # indefiniteness observed (quirk Q1)
 
     def block(_, carry):
+        # isfinite mirrors the general solver's health predicate
+        # (solver/cg.py): +-inf rr is a breakdown, not "unconverged".
         @pl.when((state_f[0] > thresh2) & (state_i[0] < cap)
-                 & (state_f[0] == state_f[0]))  # NaN rr -> stop (breakdown)
+                 & jnp.isfinite(state_f[0]))
         def _():
             # Final (partial) block: never run past the traced cap - the
             # general solver's _block_fits + remainder-pass semantics
@@ -189,6 +194,10 @@ def _resident_kernel(nblocks, check_every,
     iters_ref[0] = state_i[0]
     rr_ref[0] = state_f[0]
     indef_ref[0] = state_i[1]
+    # converged, decided on the KERNEL's threshold: the wrapper cannot
+    # recompute it bit-identically (different reduction order for ||b||
+    # would let the flag contradict the actual stop decision).
+    conv_ref[0] = (state_f[0] <= thresh2).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -202,7 +211,7 @@ def _cg_resident_call(scale, tol, rtol, cap, b2d, *, nx, ny, maxiter,
         jnp.asarray(rtol, jnp.float32)])
     cap_arr = jnp.asarray(cap, jnp.int32).reshape(1)
     kernel = functools.partial(_resident_kernel, nblocks, check_every)
-    x, iters, rr, indef = pl.pallas_call(
+    x, iters, rr, indef, conv = pl.pallas_call(
         kernel,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # params [scale,tol,rtol]
@@ -214,11 +223,13 @@ def _cg_resident_call(scale, tol, rtol, cap, b2d, *, nx, ny, maxiter,
             pl.BlockSpec(memory_space=pltpu.SMEM),   # iterations
             pl.BlockSpec(memory_space=pltpu.SMEM),   # final ||r||^2
             pl.BlockSpec(memory_space=pltpu.SMEM),   # indefinite flag
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # converged flag
         ],
         out_shape=[
             jax.ShapeDtypeStruct((nx, ny), jnp.float32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
             jax.ShapeDtypeStruct((1,), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
         ],
         scratch_shapes=[
@@ -234,7 +245,7 @@ def _cg_resident_call(scale, tol, rtol, cap, b2d, *, nx, ny, maxiter,
             vmem_limit_bytes=_PLANES_BOUND * nx * ny * 4 + (1 << 20)),
         interpret=interpret,
     )(params, cap_arr, b2d)
-    return x, iters[0], rr[0], indef[0]
+    return x, iters[0], rr[0], indef[0], conv[0]
 
 
 def cg_resident_2d(scale, b2d, *, tol=0.0, rtol=0.0, maxiter=2000,
@@ -256,9 +267,10 @@ def cg_resident_2d(scale, b2d, *, tol=0.0, rtol=0.0, maxiter=2000,
       interpret: run in pallas interpret mode (CPU tests).
 
     Returns:
-      ``(x2d, iterations, rr, indefinite)`` - solution grid, block-aligned
-      iteration count (int32), final ``||r||^2`` (f32), and whether
-      ``p.Ap <= 0`` was observed (int32 0/1; quirk Q1).
+      ``(x2d, iterations, rr, indefinite, converged)`` - solution grid,
+      block-aligned iteration count (int32), final ``||r||^2`` (f32),
+      whether ``p.Ap <= 0`` was observed (int32 0/1; quirk Q1), and the
+      kernel's own convergence decision (int32 0/1).
     """
     b2d = jnp.asarray(b2d)
     if b2d.ndim != 2:
@@ -279,3 +291,246 @@ def cg_resident_2d(scale, b2d, *, tol=0.0, rtol=0.0, maxiter=2000,
     return _cg_resident_call(
         scale, tol, rtol, cap, b2d, nx=nx, ny=ny, maxiter=maxiter,
         check_every=check_every, interpret=interpret)
+
+
+# -- df64 (double-float) resident CG ------------------------------------------
+#
+# The reference's defining precision is f64 (``CUDA_R_64F``,
+# ``CUDACG.cu:216``); the framework's df64 layer (``ops/df64.py``) delivers
+# f64-class values as (hi, lo) f32 pairs on hardware with no f64 units.
+# Here the two combine: the ENTIRE df64 CG solve in one pallas kernel,
+# eight planes (b/x/r/p, hi+lo each) pinned in VMEM, the stencil and both
+# inner products evaluated in error-free-transform arithmetic on the VPU
+# with zero per-iteration HBM traffic.  The df64 ops imported from
+# ``ops.df64`` are branch-free elementwise jnp code, so they lower through
+# Mosaic unchanged - including the add-only ``_two_prod`` error chain that
+# no compiler contraction can break (see ``blas1._two_prod``).
+
+# df64 working set: 8 pinned planes + ap (2) + the dot/stencil temporaries.
+_PLANES_BOUND_DF64 = 24
+
+
+def supports_resident_df64_2d(nx: int, ny: int, device=None) -> bool:
+    """True if an (nx, ny) grid's df64 CG working set fits in VMEM."""
+    if nx % 8 != 0 or ny % 128 != 0:
+        return False
+    return _PLANES_BOUND_DF64 * nx * ny * 4 <= vmem_bytes(device)
+
+
+def _fold2d_df(hi, lo):
+    """Reduce an (m, n) df64 plane pair to a scalar pair through pairwise
+    half-folding trees of full df64 adds - the in-kernel form of
+    ``ops.df64._fold_df`` (contiguous half-folds, never strided slices;
+    axis 0 then axis 1; odd extents zero-pad by one, exact for adds)."""
+    def fold_axis(h, l, axis):
+        while h.shape[axis] > 1:
+            m = h.shape[axis]
+            half = (m + 1) // 2
+            if m % 2:
+                zh = jnp.zeros_like(
+                    h[:1] if axis == 0 else h[:, :1])
+                h = jnp.concatenate([h, zh], axis)
+                l = jnp.concatenate([l, jnp.zeros_like(zh)], axis)
+            if axis == 0:
+                a, b = (h[:half], l[:half]), (h[half:], l[half:])
+            else:
+                a, b = (h[:, :half], l[:, :half]), (h[:, half:], l[:, half:])
+            h, l = df.add(a, b)
+        return h, l
+
+    hi, lo = fold_axis(hi, lo, 0)
+    hi, lo = fold_axis(hi, lo, 1)
+    return hi[0, 0], lo[0, 0]
+
+
+def _dot_df(xh, xl, yh, yl):
+    """In-kernel df64 inner product of two plane pairs (scalar pair out):
+    two-prod leaves with the cross terms (``ops.df64._dot_local``
+    semantics), renormalized, then the half-folding add tree."""
+    p, e = _two_prod(xh, yh)
+    e = e + (xh * yl + xl * yh)
+    hi, lo = _two_sum(p, e)
+    return _fold2d_df(hi, lo)
+
+
+def _shift_stencil_df(uh, ul, scale_h, scale_l):
+    """5-point Dirichlet Laplacian on a df64 plane pair: ``4*u`` is exact
+    in f32, the four neighbor subtractions are full df64 adds, the scale
+    is one df64 mul (``ops.df64.stencil2d_matvec`` semantics with the
+    pad replaced by zero-filled shifts)."""
+    acc = (4.0 * uh, 4.0 * ul)
+    for shift in (
+        lambda u: jnp.concatenate([u[1:], jnp.zeros_like(u[:1])], 0),
+        lambda u: jnp.concatenate([jnp.zeros_like(u[:1]), u[:-1]], 0),
+        lambda u: jnp.concatenate([u[:, 1:], jnp.zeros_like(u[:, :1])], 1),
+        lambda u: jnp.concatenate([jnp.zeros_like(u[:, :1]), u[:, :-1]], 1),
+    ):
+        acc = df.sub(acc, (shift(uh), shift(ul)))
+    return df.mul((scale_h, scale_l), acc)
+
+
+def _safe_div_df(num, den):
+    """df64 num/den with the exact-solve freeze of ``solver.df64._safe_div``:
+    0/0 (both hi words exactly zero) yields 0, a genuine breakdown
+    (den = 0, num != 0) still produces inf/NaN for the health check."""
+    zero = jnp.logical_and(num[0] == 0.0, den[0] == 0.0)
+    den_safe = (jnp.where(zero, jnp.ones_like(den[0]), den[0]),
+                jnp.where(zero, jnp.zeros_like(den[1]), den[1]))
+    q = df.div(num, den_safe)
+    return (jnp.where(zero, jnp.zeros_like(q[0]), q[0]),
+            jnp.where(zero, jnp.zeros_like(q[1]), q[1]))
+
+
+def _resident_kernel_df64(nblocks, check_every,
+                          params_ref, cap_ref, bh_ref, bl_ref,
+                          xh_ref, xl_ref, iters_ref, rr_ref, indef_ref,
+                          conv_ref, rh_ref, rl_ref, ph_ref, pl_ref,
+                          state_f, state_i):
+    scale = (params_ref[0], params_ref[1])
+    tol = params_ref[2]
+    rtol = params_ref[3]
+    cap = cap_ref[0]
+
+    bh, bl = bh_ref[:], bl_ref[:]
+    xh_ref[:] = jnp.zeros_like(bh)          # explicit x0 = 0 (quirk Q6)
+    xl_ref[:] = jnp.zeros_like(bh)
+    rh_ref[:], rl_ref[:] = bh, bl           # r0 = b  (CUDACG.cu:248)
+    ph_ref[:], pl_ref[:] = bh, bl           # p0 = r0 (CUDACG.cu:255)
+    rr0 = _dot_df(bh, bl, bh, bl)
+
+    # threshold^2 = max(tol^2, rtol^2 * ||r0||^2), df64
+    # (solver.df64._threshold semantics; tol/rtol squares via two-prod)
+    tol2 = _two_prod(tol, tol)
+    rtol2 = _two_prod(rtol, rtol)
+    rt = df.mul(rtol2, rr0)
+    thr = (jnp.maximum(tol2[0], rt[0]),
+           jnp.where(tol2[0] >= rt[0], tol2[1], rt[1]))
+
+    state_f[0], state_f[1] = rr0            # ||r||^2 df64 across blocks
+    state_i[0] = jnp.int32(0)               # iterations completed
+    state_i[1] = jnp.int32(0)               # indefiniteness observed
+
+    def block(_, carry):
+        rr_blk = (state_f[0], state_f[1])
+        unconverged = jnp.logical_not(df.less(rr_blk, thr))
+        nontrivial = rr_blk[0] > 0.0
+        healthy = jnp.isfinite(rr_blk[0])
+
+        @pl.when(unconverged & nontrivial & healthy & (state_i[0] < cap))
+        def _():
+            nsteps = jnp.minimum(jnp.int32(check_every), cap - state_i[0])
+
+            def one_iter(_, rr):
+                p = (ph_ref[:], pl_ref[:])
+                ap = _shift_stencil_df(p[0], p[1], scale[0], scale[1])
+                pap = _dot_df(p[0], p[1], ap[0], ap[1])
+                state_i[1] = jnp.where(
+                    (pap[0] <= 0.0) & (rr[0] > 0.0),
+                    jnp.int32(1), state_i[1])
+                alpha = _safe_div_df(rr, pap)
+                x_new = df.axpy(alpha, p, (xh_ref[:], xl_ref[:]))
+                xh_ref[:], xl_ref[:] = x_new
+                r_new = df.axpy(df.neg(alpha), ap, (rh_ref[:], rl_ref[:]))
+                rh_ref[:], rl_ref[:] = r_new
+                rr_new = _dot_df(r_new[0], r_new[1], r_new[0], r_new[1])
+                beta = _safe_div_df(rr_new, rr)
+                p_new = df.axpy(beta, p, r_new)
+                ph_ref[:], pl_ref[:] = p_new
+                return rr_new
+
+            rr_out = lax.fori_loop(0, nsteps, one_iter, rr_blk)
+            state_f[0], state_f[1] = rr_out
+            state_i[0] = state_i[0] + nsteps
+        return carry
+
+    lax.fori_loop(0, nblocks, block, jnp.int32(0))
+
+    iters_ref[0] = state_i[0]
+    rr_ref[0] = state_f[0]
+    rr_ref[1] = state_f[1]
+    indef_ref[0] = state_i[1]
+    # converged, decided on the kernel's own df64 threshold (the wrapper
+    # cannot recompute thr without a second full dot for rr0)
+    conv = jnp.logical_or(df.less((state_f[0], state_f[1]), thr),
+                          state_f[0] == 0.0)
+    conv_ref[0] = conv.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "nx", "ny", "maxiter", "check_every", "interpret"))
+def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, cap, bh, bl, *,
+                           nx, ny, maxiter, check_every, interpret):
+    nblocks = -(-maxiter // check_every)
+    params = jnp.stack([
+        jnp.asarray(scale_h, jnp.float32),
+        jnp.asarray(scale_l, jnp.float32),
+        jnp.asarray(tol, jnp.float32),
+        jnp.asarray(rtol, jnp.float32)])
+    cap_arr = jnp.asarray(cap, jnp.int32).reshape(1)
+    kernel = functools.partial(_resident_kernel_df64, nblocks, check_every)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    xh, xl, iters, rr, indef, conv = pl.pallas_call(
+        kernel,
+        in_specs=[smem, smem, vmem, vmem],
+        out_specs=[vmem, vmem, smem, smem, smem, smem],
+        out_shape=[
+            jax.ShapeDtypeStruct((nx, ny), jnp.float32),   # x hi
+            jax.ShapeDtypeStruct((nx, ny), jnp.float32),   # x lo
+            jax.ShapeDtypeStruct((1,), jnp.int32),         # iterations
+            jax.ShapeDtypeStruct((2,), jnp.float32),       # ||r||^2 df64
+            jax.ShapeDtypeStruct((1,), jnp.int32),         # indefinite
+            jax.ShapeDtypeStruct((1,), jnp.int32),         # converged
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((nx, ny), jnp.float32),             # r hi
+            pltpu.VMEM((nx, ny), jnp.float32),             # r lo
+            pltpu.VMEM((nx, ny), jnp.float32),             # p hi
+            pltpu.VMEM((nx, ny), jnp.float32),             # p lo
+            pltpu.SMEM((2,), jnp.float32),                 # rr (hi, lo)
+            pltpu.SMEM((2,), jnp.int32),                   # k, indefinite
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_PLANES_BOUND_DF64 * nx * ny * 4 + (1 << 20)),
+        interpret=interpret,
+    )(params, cap_arr, bh, bl)
+    return xh, xl, iters[0], (rr[0], rr[1]), indef[0], conv[0]
+
+
+def cg_resident_df64_2d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
+                        check_every=32, iter_cap=None, interpret=False):
+    """df64 CG for the 5-point stencil, entirely inside one pallas kernel.
+
+    Args:
+      scale: df64 stencil scale - an ``(hi, lo)`` pair of f32 scalars.
+      b_pair: right-hand side as an ``(hi, lo)`` pair of (nx, ny) f32
+        grids (``ops.df64.split_f64`` produces one from host float64).
+      tol / rtol / maxiter / check_every / iter_cap / interpret: as
+        :func:`cg_resident_2d`; the convergence threshold is evaluated
+        in df64 (``solver.df64`` semantics).
+
+    Returns:
+      ``(x_hi, x_lo, iterations, (rr_hi, rr_lo), indefinite, converged)``
+      - ``converged`` is decided inside the kernel on its df64 threshold
+      (``max(tol^2, rtol^2 ||r0||^2)``, ``solver.df64._threshold``).
+    """
+    bh = jnp.asarray(b_pair[0], jnp.float32)
+    bl = jnp.asarray(b_pair[1], jnp.float32)
+    if bh.ndim != 2 or bh.shape != bl.shape:
+        raise ValueError(
+            f"b_pair must be two equal (nx, ny) grids, got "
+            f"{bh.shape} / {bl.shape}")
+    nx, ny = bh.shape
+    if not interpret and not supports_resident_df64_2d(nx, ny):
+        raise ValueError(
+            f"({nx}, {ny}) df64 grid does not fit the resident kernel: "
+            f"needs nx % 8 == 0, ny % 128 == 0 and "
+            f"{_PLANES_BOUND_DF64} * grid bytes <= {vmem_bytes()} "
+            f"(set {_ENV_OVERRIDE} to override the budget)")
+    if check_every < 1:
+        raise ValueError(f"check_every must be >= 1, got {check_every}")
+    check_every = min(check_every, maxiter)
+    cap = maxiter if iter_cap is None else iter_cap
+    return _cg_resident_df64_call(
+        scale[0], scale[1], tol, rtol, cap, bh, bl, nx=nx, ny=ny,
+        maxiter=maxiter, check_every=check_every, interpret=interpret)

@@ -17,18 +17,24 @@ iterates agreeing to f32 reduction-order rounding (~3e-6 relative).
 """
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..models.operators import Stencil2D
-from ..ops.pallas.resident import cg_resident_2d, supports_resident_2d
+from ..ops import df64 as df
+from ..ops.pallas.resident import (
+    cg_resident_2d,
+    cg_resident_df64_2d,
+    supports_resident_2d,
+    supports_resident_df64_2d,
+)
 from .cg import CGResult
+from .df64 import DF64CGResult
 from .status import CGStatus
 
 
-def supports_resident(a, b=None, dtype=None) -> bool:
+def supports_resident(a) -> bool:
     """True if ``cg_resident`` can run this operator (see module scope)."""
     if not isinstance(a, Stencil2D):
         return False
@@ -80,15 +86,15 @@ def cg_resident(
             f"cg_resident is float32-only (got {b2d.dtype}); df64/x64 "
             "precision routes through solver.cg / solver.df64")
 
-    x2d, iters, rr, indef = cg_resident_2d(
+    x2d, iters, rr, indef, conv = cg_resident_2d(
         a.scale, b2d, tol=tol, rtol=rtol, maxiter=maxiter,
         check_every=check_every, iter_cap=iter_cap, interpret=interpret)
 
     res_norm = jnp.sqrt(rr)
-    thresh = jnp.maximum(jnp.asarray(tol, jnp.float32),
-                         jnp.asarray(rtol, jnp.float32)
-                         * jnp.linalg.norm(b2d.reshape(-1)))
-    converged = res_norm <= thresh
+    # converged comes from INSIDE the kernel: recomputing the threshold
+    # here (different ||b|| reduction order) could contradict the
+    # kernel's actual stop decision on straddling cases.
+    converged = conv.astype(bool)
     healthy = jnp.isfinite(res_norm)
     status = jnp.where(
         ~healthy, jnp.int32(CGStatus.BREAKDOWN),
@@ -97,5 +103,86 @@ def cg_resident(
     x = x2d.reshape(-1) if flat_in else x2d
     return CGResult(
         x=x, iterations=iters, residual_norm=res_norm,
+        converged=converged, status=status,
+        indefinite=indef.astype(bool), residual_history=None)
+
+
+def supports_resident_df64(a) -> bool:
+    """True if ``cg_resident_df64`` can run this operator: a 2D stencil
+    whose df64 working set (8 pinned hi/lo planes + temporaries) fits
+    the device VMEM budget."""
+    if not isinstance(a, Stencil2D):
+        return False
+    nx, ny = a.grid
+    return supports_resident_df64_2d(nx, ny)
+
+
+def cg_resident_df64(
+    a: Stencil2D,
+    b,
+    *,
+    tol: float = 1e-7,
+    rtol: float = 0.0,
+    maxiter: int = 2000,
+    check_every: int = 32,
+    iter_cap=None,
+    interpret: bool = False,
+) -> DF64CGResult:
+    """f64-class CG (df64 storage) entirely inside one VMEM-resident kernel.
+
+    The reference's defining precision x this framework's defining
+    execution shape: ``CUDA_R_64F`` CG (``CUDACG.cu:216``) as a single
+    pallas kernel with eight hi/lo planes pinned in VMEM and all
+    arithmetic in compiler-proof error-free transforms.  Arguments and
+    trajectory semantics mirror ``solver.df64.cg_df64`` (x0 = 0, no
+    preconditioner, ``method="cg"``, no history; interpret-mode parity
+    1.1e-14 relative at fixed iteration count).
+
+    ``b`` may be float64 numpy (full precision via host split), an f32
+    array (lifted with zero lo words), or an explicit ``(hi, lo)`` pair;
+    flat ``(n,)`` or grid ``(nx, ny)`` shapes are accepted, and the
+    solution comes back flat (``DF64CGResult.x()`` recombines to f64).
+    """
+    if not isinstance(a, Stencil2D):
+        raise TypeError(
+            f"cg_resident_df64 needs a Stencil2D operator, got "
+            f"{type(a).__name__} - use solver.df64.cg_df64 for general "
+            f"operators")
+    nx, ny = a.grid
+
+    if isinstance(b, tuple):
+        bh, bl = (np.asarray(b[0], np.float32), np.asarray(b[1], np.float32))
+    else:
+        b_np = np.asarray(b)
+        if b_np.dtype == np.float64:
+            bh, bl = df.split_f64(b_np)
+        else:
+            bh = b_np.astype(np.float32)
+            bl = np.zeros_like(bh)
+    if bh.ndim == 1:
+        if bh.shape[0] != nx * ny:
+            raise ValueError(f"rhs length {bh.shape[0]} != grid {nx}x{ny}")
+        bh, bl = bh.reshape(nx, ny), bl.reshape(nx, ny)
+    elif bh.shape != (nx, ny):
+        raise ValueError(f"rhs shape {bh.shape} != grid ({nx}, {ny})")
+
+    # re-split the scale from host f64 so non-exact scales keep their
+    # low word (same as solver.df64._prepare_operator)
+    scale64 = np.float64(np.asarray(a.scale, dtype=np.float64))
+    sh, sl = df.split_f64(scale64)
+
+    xh, xl, iters, rr, indef, conv = cg_resident_df64_2d(
+        (sh, sl), (bh, bl), tol=tol, rtol=rtol, maxiter=maxiter,
+        check_every=check_every, iter_cap=iter_cap, interpret=interpret)
+
+    converged = conv.astype(bool)
+    healthy = jnp.isfinite(rr[0])
+    status = jnp.where(
+        ~healthy, jnp.int32(CGStatus.BREAKDOWN),
+        jnp.where(converged, jnp.int32(CGStatus.CONVERGED),
+                  jnp.int32(CGStatus.MAXITER)))
+    return DF64CGResult(
+        x_hi=xh.reshape(-1), x_lo=xl.reshape(-1), iterations=iters,
+        residual_norm_sq_hi=rr[0], residual_norm_sq_lo=rr[1],
         converged=converged, status=status,
         indefinite=indef.astype(bool), residual_history=None)

@@ -11,10 +11,17 @@ import pytest
 
 import jax.numpy as jnp
 
-from cuda_mpi_parallel_tpu import cg_resident, solve, supports_resident
+from cuda_mpi_parallel_tpu import (
+    cg_resident,
+    cg_resident_df64,
+    solve,
+    supports_resident,
+    supports_resident_df64,
+)
 from cuda_mpi_parallel_tpu.models import poisson
 from cuda_mpi_parallel_tpu.models.operators import Stencil2D, Stencil3D
 from cuda_mpi_parallel_tpu.ops.pallas import resident as rk
+from cuda_mpi_parallel_tpu.solver.df64 import cg_df64
 from cuda_mpi_parallel_tpu.solver.status import CGStatus
 
 
@@ -179,3 +186,83 @@ class TestGate:
         op, _ = _grid_problem()
         with pytest.raises(ValueError, match="grid"):
             cg_resident(op, jnp.zeros(17, jnp.float32), interpret=True)
+
+
+class TestDF64Resident:
+    """df64 (double-float) resident kernel: f64-class CG in one kernel.
+
+    Small grids and tight iteration budgets - interpret-mode df64 is
+    expensive (every EFT op runs individually on CPU).
+    """
+
+    def _problem(self, nx=8, ny=128, seed=0):
+        op = poisson.poisson_2d_operator(nx, ny, dtype=jnp.float32)
+        rng = np.random.default_rng(seed)
+        return op, rng.standard_normal(nx * ny)
+
+    def test_fixed_iteration_trajectory_matches_cg_df64(self):
+        op, b64 = self._problem()
+        ref = cg_df64(op, b64, tol=0.0, maxiter=24, check_every=8)
+        res = cg_resident_df64(op, b64, tol=0.0, maxiter=24,
+                               check_every=8, interpret=True)
+        assert int(res.iterations) == int(ref.iterations)
+        x_ref, x_res = ref.x(), res.x()
+        rel = np.abs(x_res - x_ref).max() / np.abs(x_ref).max()
+        assert rel < 1e-11, rel
+        # df64 recurrence residual agrees through both words
+        assert np.isclose(res.residual_norm(), ref.residual_norm(),
+                          rtol=1e-9)
+
+    def test_converges_below_f32_depth(self):
+        # the point of df64: a tolerance plain f32 cannot reach
+        nx, ny = 8, 128
+        op = poisson.poisson_2d_operator(nx, ny, dtype=jnp.float32)
+        x_true = np.zeros((nx, ny)); x_true[4, 64] = 1.0
+        from cuda_mpi_parallel_tpu.ops import df64 as df
+        bh, bl = df.split_f64(x_true.ravel())
+        # b = A x_true in df64 (via the reference df64 matvec)
+        sc = df.const(1.0)
+        ah, al = df.stencil2d_matvec(
+            (jnp.asarray(bh), jnp.asarray(bl)), (nx, ny), sc)
+        b64 = np.asarray(ah, np.float64) + np.asarray(al, np.float64)
+        res = cg_resident_df64(op, b64, tol=1e-10, maxiter=300,
+                               check_every=8, interpret=True)
+        assert bool(res.converged)
+        assert res.residual_norm() < 1e-10
+        assert np.abs(res.x() - x_true.ravel()).max() < 1e-9
+
+    def test_cap_truncation_and_status(self):
+        op, b64 = self._problem()
+        res = cg_resident_df64(op, b64, tol=1e-30, maxiter=10,
+                               check_every=8, interpret=True)
+        assert int(res.iterations) == 10
+        assert res.status_enum() is CGStatus.MAXITER
+        res2 = cg_resident_df64(op, b64, tol=0.0, maxiter=16,
+                                check_every=8, iter_cap=9, interpret=True)
+        assert int(res2.iterations) == 9
+
+    def test_gate_and_errors(self, monkeypatch):
+        op, b64 = self._problem()
+        assert supports_resident_df64(op)
+        assert not rk.supports_resident_df64_2d(10, 128)
+        monkeypatch.setenv(rk._ENV_OVERRIDE, str(1 << 20))
+        assert not rk.supports_resident_df64_2d(1024, 1024)
+        op3 = Stencil3D.create(8, 8, 128, dtype=jnp.float32)
+        assert not supports_resident_df64(op3)
+        with pytest.raises(TypeError, match="Stencil2D"):
+            cg_resident_df64(op3, np.zeros(8 * 8 * 128), interpret=True)
+        with pytest.raises(ValueError, match="grid"):
+            cg_resident_df64(op, np.zeros(17), interpret=True)
+
+    def test_f32_rhs_lifted(self):
+        op, b64 = self._problem()
+        b32 = b64.astype(np.float32)
+        r1 = cg_resident_df64(op, b32, tol=0.0, maxiter=8,
+                              check_every=8, interpret=True)
+        from cuda_mpi_parallel_tpu.ops import df64 as df
+        r2 = cg_resident_df64(op, (b32, np.zeros_like(b32)), tol=0.0,
+                              maxiter=8, check_every=8, interpret=True)
+        np.testing.assert_array_equal(np.asarray(r1.x_hi),
+                                      np.asarray(r2.x_hi))
+        np.testing.assert_array_equal(np.asarray(r1.x_lo),
+                                      np.asarray(r2.x_lo))
