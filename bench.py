@@ -543,6 +543,44 @@ def bench_all(results) -> None:
                 "converged": bool(res.converged),
                 "measurement": "solve_delta"}
 
+        # The VMEM-resident engine on the same ladder (plain + in-kernel
+        # Chebyshev): one kernel per solve, compiled-TPU only.
+        if jax.default_backend() == "tpu":
+            from cuda_mpi_parallel_tpu import cg_resident
+            from cuda_mpi_parallel_tpu.ops.pallas.resident import (
+                cg_resident_2d,
+            )
+
+            b3_2d = b3.reshape(512, 512)
+            m4 = ChebyshevPreconditioner.from_operator(op2, degree=4)
+            for name, deg, lmin, lmax, m_obj in [
+                ("resident", 0, 0.0, 1.0, None),
+                ("resident_cheb4", 4, m4.lmin, m4.lmax, m4),
+            ]:
+                @_partial(jax.jit, static_argnames=("reps", "deg"))
+                def many_r(b2, lmin_a, lmax_a, reps, deg):
+                    def body(i, acc):
+                        sc = (1.0 + i.astype(jnp.float32)
+                              * jnp.asarray(1e-6, jnp.float32))
+                        x, _, _, _, _ = cg_resident_2d(
+                            op2.scale, b2 * sc, tol=0.0, rtol=1e-6,
+                            maxiter=5000, check_every=32,
+                            precond_degree=deg, lmin=lmin_a, lmax=lmax_a)
+                        return acc + x[0, 0]
+                    return lax.fori_loop(0, reps, body,
+                                         jnp.zeros((), jnp.float32))
+
+                solves_per_sec = paired_delta_rate(
+                    lambda reps, d=deg, lo=lmin, hi=lmax:
+                    many_r(b3_2d, lo, hi, reps, d), 1, 21, pairs=3)
+                res = cg_resident(op2, b3, tol=0.0, rtol=1e-6,
+                                  maxiter=5000, check_every=32, m=m_obj)
+                results[f"poisson2d_512_{name}_rtol1e-6"] = {
+                    "time_to_tol_s": 1.0 / solves_per_sec,
+                    "iterations": int(res.iterations),
+                    "converged": bool(res.converged),
+                    "measurement": "solve_delta"}
+
     _run_section(results, "precond512", s_precond512)
 
     # 3b: HBM-bound regime (4096^2 = 16.8M unknowns, ~4x VMEM): pallas

@@ -314,10 +314,15 @@ def main(argv=None) -> int:
         if args.mesh > 1:
             raise SystemExit("--engine resident is single-device "
                              "(no --mesh > 1)")
-        if args.precond is not None or args.method != "cg" or args.history:
-            raise SystemExit("--engine resident supports unpreconditioned "
-                             "--method cg without --history (the one-kernel "
-                             "solve records no trace)")
+        if (args.precond not in (None, "chebyshev") or args.method != "cg"
+                or args.history):
+            raise SystemExit("--engine resident supports --method cg with "
+                             "--precond chebyshev or none, without "
+                             "--history (the one-kernel solve records no "
+                             "trace)")
+        if args.df64 and args.precond is not None:
+            raise SystemExit("--engine resident --dtype df64 is "
+                             "unpreconditioned only")
 
     def run():
         if args.df64:
@@ -393,7 +398,9 @@ def main(argv=None) -> int:
             # solver.  An EXPLICIT --engine resident still honors the
             # request anywhere (interpret mode off-TPU - correctness
             # checks, not speed).
-            eligible = (supports_resident(a) and args.precond is None
+            eligible = (args.precond in (None, "chebyshev")
+                        and supports_resident(
+                            a, preconditioned=args.precond == "chebyshev")
                         and args.method == "cg" and not args.history
                         and (args.engine == "resident"
                              or _jax_backend_is_tpu()))
@@ -404,10 +411,16 @@ def main(argv=None) -> int:
                     f"2D stencil whose CG working set fits VMEM; try "
                     f"--problem poisson2d --matrix-free)")
             if eligible:
+                m_res = None
+                if args.precond == "chebyshev":
+                    from .models.precond import ChebyshevPreconditioner
+
+                    m_res = ChebyshevPreconditioner.from_operator(
+                        a, degree=args.precond_degree)
                 return cg_resident(a, b, tol=args.tol, rtol=args.rtol,
                                    maxiter=args.maxiter,
                                    check_every=args.check_every,
-                                   interpret=_pallas_interpret())
+                                   m=m_res, interpret=_pallas_interpret())
         from . import solve
         from .models.operators import JacobiPreconditioner
         from .models.precond import (

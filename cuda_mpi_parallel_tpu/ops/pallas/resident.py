@@ -102,17 +102,21 @@ def vmem_bytes(device=None) -> int:
 
 
 def supports_resident_2d(nx: int, ny: int, itemsize: int = 4,
-                         device=None) -> bool:
+                         device=None, preconditioned: bool = False) -> bool:
     """True if an (nx, ny) grid's CG working set fits the resident kernel.
 
     Tiling needs ``nx % 8 == 0 and ny % 128 == 0`` (f32 (8,128) tiles);
-    capacity needs ``_PLANES_BOUND`` planes within the VMEM budget.
+    capacity needs ``_PLANES_BOUND`` planes within the VMEM budget -
+    plus the Chebyshev recurrence's two transient planes when
+    ``preconditioned`` (the gate must match the kernel's own
+    ``vmem_limit_bytes`` or it admits grids the compiler then rejects).
     """
     if nx % 8 != 0 or ny % 128 != 0:
         return False
     if itemsize != 4:
         return False  # f32 only: df64/other dtypes take the general path
-    return _PLANES_BOUND * nx * ny * itemsize <= vmem_bytes(device)
+    planes = _PLANES_BOUND + (2 if preconditioned else 0)
+    return planes * nx * ny * itemsize <= vmem_bytes(device)
 
 
 def _shift_stencil(u, scale):
@@ -129,39 +133,71 @@ def _shift_stencil(u, scale):
     return scale * (4.0 * u - up - down - left - right)
 
 
-def _resident_kernel(nblocks, check_every,
+def _resident_kernel(nblocks, check_every, degree,
                      params_ref, cap_ref, b_ref,
                      x_ref, iters_ref, rr_ref, indef_ref, conv_ref,
-                     r_ref, p_ref, state_f, state_i):
+                     health_ref, r_ref, p_ref, state_f, state_i):
     scale = params_ref[0]
     tol = params_ref[1]
     rtol = params_ref[2]
     cap = cap_ref[0]
 
+    def precond(r):
+        """degree-term Chebyshev approximation of A^-1 applied to r -
+        the in-kernel form of ``models.precond.ChebyshevPreconditioner
+        .matvec`` (Saad Alg. 12.1 semi-iteration from z0 = 0): pure VPU
+        work, ``degree - 1`` extra stencil applies, no reductions."""
+        lmin = params_ref[3]
+        lmax = params_ref[4]
+        theta = (lmax + lmin) * 0.5
+        delta = (lmax - lmin) * 0.5
+        sigma = theta / delta
+        rho_c = 1.0 / sigma
+        d = r / theta
+        z = d
+        for _ in range(degree - 1):
+            rho_n = 1.0 / (2.0 * sigma - rho_c)
+            d = (rho_n * rho_c) * d + (2.0 * rho_n / delta) * (
+                r - _shift_stencil(z, scale))
+            z = z + d
+            rho_c = rho_n
+        return z
+
     b = b_ref[:]
     x_ref[:] = jnp.zeros_like(b)            # explicit x0 = 0 (quirk Q6)
     r_ref[:] = b                            # r0 = b  (CUDACG.cu:248)
-    p_ref[:] = b                            # p0 = r0 (CUDACG.cu:255)
-    rr0 = jnp.sum(b * b)                    # rho0    (CUDACG.cu:261-266)
+    rr0 = jnp.sum(b * b)                    # CUDACG.cu:261-266
+    if degree > 0:
+        z0 = precond(b)
+        p_ref[:] = z0                       # p0 = z0 (preconditioned init)
+        rho0 = jnp.sum(b * z0)              # rho = r . z
+    else:
+        p_ref[:] = b                        # p0 = r0 (CUDACG.cu:255)
+        rho0 = rr0
     thresh = jnp.maximum(tol, rtol * jnp.sqrt(rr0))
     thresh2 = thresh * thresh
 
-    state_f[0] = rr0       # ||r||^2 carried across blocks
+    state_f[0] = rr0       # ||r||^2 carried across blocks (convergence)
+    state_f[1] = rho0      # r . z (== rr unpreconditioned)
     state_i[0] = jnp.int32(0)   # iterations completed
     state_i[1] = jnp.int32(0)   # indefiniteness observed (quirk Q1)
 
     def block(_, carry):
-        # isfinite mirrors the general solver's health predicate
-        # (solver/cg.py): +-inf rr is a breakdown, not "unconverged".
-        @pl.when((state_f[0] > thresh2) & (state_i[0] < cap)
-                 & jnp.isfinite(state_f[0]))
+        # Health mirrors the general solver's predicate (solver/cg.py):
+        # non-finite scalars are a breakdown, and rho <= 0 with r != 0 is
+        # a preconditioner breakdown (M not SPD) - stop, don't spin.
+        healthy = (jnp.isfinite(state_f[0]) & jnp.isfinite(state_f[1])
+                   & (state_f[1] > 0.0))
+
+        @pl.when((state_f[0] > thresh2) & (state_i[0] < cap) & healthy)
         def _():
             # Final (partial) block: never run past the traced cap - the
             # general solver's _block_fits + remainder-pass semantics
             # (iterations <= maxiter/iter_cap always).
             nsteps = jnp.minimum(jnp.int32(check_every), cap - state_i[0])
 
-            def one_iter(_, rr):
+            def one_iter(_, carry):
+                rr, rho = carry
                 p = p_ref[:]
                 ap = _shift_stencil(p, scale)
                 pap = jnp.sum(p * ap)
@@ -174,18 +210,28 @@ def _resident_kernel(nblocks, check_every,
                 # possible only when p == 0 i.e. r == 0) zeroes the step
                 # and leaves x/r/p untouched rather than dividing 0/0.
                 safe = pap != 0.0
-                alpha = jnp.where(safe, rr / jnp.where(safe, pap, 1.0), 0.0)
+                alpha = jnp.where(safe, rho / jnp.where(safe, pap, 1.0),
+                                  0.0)
                 x_ref[:] = x_ref[:] + alpha * p        # CUDACG.cu:314
                 r_new = r_ref[:] - alpha * ap          # CUDACG.cu:320-321
                 r_ref[:] = r_new
                 rr_new = jnp.sum(r_new * r_new)        # CUDACG.cu:328
+                if degree > 0:
+                    z_new = precond(r_new)
+                    rho_new = jnp.sum(r_new * z_new)
+                else:
+                    z_new, rho_new = r_new, rr_new
                 beta = jnp.where(safe,
-                                 rr_new / jnp.where(rr != 0.0, rr, 1.0),
+                                 rho_new / jnp.where(rho != 0.0, rho, 1.0),
                                  0.0)                  # CUDACG.cu:336-339
-                p_ref[:] = jnp.where(safe, r_new + beta * p, p)
-                return jnp.where(safe, rr_new, rr)
+                p_ref[:] = jnp.where(safe, z_new + beta * p, p)
+                return (jnp.where(safe, rr_new, rr),
+                        jnp.where(safe, rho_new, rho))
 
-            state_f[0] = lax.fori_loop(0, nsteps, one_iter, state_f[0])
+            rr_out, rho_out = lax.fori_loop(
+                0, nsteps, one_iter, (state_f[0], state_f[1]))
+            state_f[0] = rr_out
+            state_f[1] = rho_out
             state_i[0] = state_i[0] + nsteps
         return carry
 
@@ -198,20 +244,29 @@ def _resident_kernel(nblocks, check_every,
     # recompute it bit-identically (different reduction order for ||b||
     # would let the flag contradict the actual stop decision).
     conv_ref[0] = (state_f[0] <= thresh2).astype(jnp.int32)
+    # final health, the general solver's exact formula (solver/cg.py):
+    # a rho <= 0 stop with r != 0 is a preconditioner breakdown and must
+    # surface as BREAKDOWN, not MAXITER.
+    health_ref[0] = (jnp.isfinite(state_f[0]) & jnp.isfinite(state_f[1])
+                     & ((state_f[1] > 0.0) | (state_f[0] == 0.0))
+                     ).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "nx", "ny", "maxiter", "check_every", "interpret"))
-def _cg_resident_call(scale, tol, rtol, cap, b2d, *, nx, ny, maxiter,
-                      check_every, interpret):
+    "nx", "ny", "maxiter", "check_every", "degree", "interpret"))
+def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b2d, *, nx, ny,
+                      maxiter, check_every, degree, interpret):
     nblocks = -(-maxiter // check_every)
     params = jnp.stack([
         jnp.asarray(scale, jnp.float32),
         jnp.asarray(tol, jnp.float32),
-        jnp.asarray(rtol, jnp.float32)])
+        jnp.asarray(rtol, jnp.float32),
+        jnp.asarray(lmin, jnp.float32),
+        jnp.asarray(lmax, jnp.float32)])
     cap_arr = jnp.asarray(cap, jnp.int32).reshape(1)
-    kernel = functools.partial(_resident_kernel, nblocks, check_every)
-    x, iters, rr, indef, conv = pl.pallas_call(
+    kernel = functools.partial(_resident_kernel, nblocks, check_every,
+                               degree)
+    x, iters, rr, indef, conv, health = pl.pallas_call(
         kernel,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # params [scale,tol,rtol]
@@ -224,6 +279,7 @@ def _cg_resident_call(scale, tol, rtol, cap, b2d, *, nx, ny, maxiter,
             pl.BlockSpec(memory_space=pltpu.SMEM),   # final ||r||^2
             pl.BlockSpec(memory_space=pltpu.SMEM),   # indefinite flag
             pl.BlockSpec(memory_space=pltpu.SMEM),   # converged flag
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # healthy flag
         ],
         out_shape=[
             jax.ShapeDtypeStruct((nx, ny), jnp.float32),
@@ -231,25 +287,30 @@ def _cg_resident_call(scale, tol, rtol, cap, b2d, *, nx, ny, maxiter,
             jax.ShapeDtypeStruct((1,), jnp.float32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
         ],
         scratch_shapes=[
             pltpu.VMEM((nx, ny), jnp.float32),       # r
             pltpu.VMEM((nx, ny), jnp.float32),       # p
-            pltpu.SMEM((1,), jnp.float32),           # rr across blocks
+            pltpu.SMEM((2,), jnp.float32),           # rr, rho
             pltpu.SMEM((2,), jnp.int32),             # k, indefinite
         ],
         # The default scoped-vmem limit (16 MiB) is sized for streaming
         # kernels; residency is the point here, so lift it to the gated
-        # footprint bound (+1 MiB slack for Mosaic's own temporaries).
+        # footprint bound (+1 MiB slack for Mosaic's own temporaries;
+        # +2 planes for the Chebyshev recurrence's z/d transients -
+        # supports_resident_2d(preconditioned=True) gates on the same).
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_PLANES_BOUND * nx * ny * 4 + (1 << 20)),
+            vmem_limit_bytes=(_PLANES_BOUND + (2 if degree else 0))
+            * nx * ny * 4 + (1 << 20)),
         interpret=interpret,
     )(params, cap_arr, b2d)
-    return x, iters[0], rr[0], indef[0], conv[0]
+    return x, iters[0], rr[0], indef[0], conv[0], health[0]
 
 
 def cg_resident_2d(scale, b2d, *, tol=0.0, rtol=0.0, maxiter=2000,
-                   check_every=32, iter_cap=None, interpret=False):
+                   check_every=32, iter_cap=None, interpret=False,
+                   precond_degree=0, lmin=0.0, lmax=1.0):
     """Run the whole CG solve for the 5-point stencil in one pallas kernel.
 
     Args:
@@ -265,12 +326,22 @@ def cg_resident_2d(scale, b2d, *, tol=0.0, rtol=0.0, maxiter=2000,
       iter_cap: optional *traced* cap <= maxiter (segmented solves vary
         this without recompiling).
       interpret: run in pallas interpret mode (CPU tests).
+      precond_degree: 0 = unpreconditioned (the reference's
+        configuration); k >= 1 applies the k-term Chebyshev polynomial
+        preconditioner IN-KERNEL on the spectral interval
+        ``[lmin, lmax]`` (``models.precond.ChebyshevPreconditioner``
+        semantics) - ``k - 1`` extra stencil applies per iteration, all
+        VPU work on the VMEM-resident planes.
+      lmin / lmax: Chebyshev spectral interval (traced scalars; ignored
+        when ``precond_degree == 0``).
 
     Returns:
-      ``(x2d, iterations, rr, indefinite, converged)`` - solution grid,
-      block-aligned iteration count (int32), final ``||r||^2`` (f32),
-      whether ``p.Ap <= 0`` was observed (int32 0/1; quirk Q1), and the
-      kernel's own convergence decision (int32 0/1).
+      ``(x2d, iterations, rr, indefinite, converged, healthy)`` -
+      solution grid, block-aligned iteration count (int32), final
+      ``||r||^2`` (f32), whether ``p.Ap <= 0`` was observed (int32 0/1;
+      quirk Q1), the kernel's own convergence decision (int32 0/1), and
+      the general solver's health predicate at exit (int32 0/1; 0 means
+      BREAKDOWN - non-finite scalars or ``rho <= 0`` with ``r != 0``).
     """
     b2d = jnp.asarray(b2d)
     if b2d.ndim != 2:
@@ -286,11 +357,15 @@ def cg_resident_2d(scale, b2d, *, tol=0.0, rtol=0.0, maxiter=2000,
             f"(set {_ENV_OVERRIDE} to override the budget)")
     if check_every < 1:
         raise ValueError(f"check_every must be >= 1, got {check_every}")
+    if precond_degree < 0:
+        raise ValueError(
+            f"precond_degree must be >= 0, got {precond_degree}")
     check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_call(
-        scale, tol, rtol, cap, b2d, nx=nx, ny=ny, maxiter=maxiter,
-        check_every=check_every, interpret=interpret)
+        scale, tol, rtol, lmin, lmax, cap, b2d, nx=nx, ny=ny,
+        maxiter=maxiter, check_every=check_every,
+        degree=int(precond_degree), interpret=interpret)
 
 
 # -- df64 (double-float) resident CG ------------------------------------------

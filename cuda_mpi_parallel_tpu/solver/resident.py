@@ -34,14 +34,19 @@ from .df64 import DF64CGResult
 from .status import CGStatus
 
 
-def supports_resident(a) -> bool:
-    """True if ``cg_resident`` can run this operator (see module scope)."""
+def supports_resident(a, preconditioned: bool = False) -> bool:
+    """True if ``cg_resident`` can run this operator (see module scope).
+
+    ``preconditioned`` budgets the in-kernel Chebyshev recurrence's two
+    extra transient planes.
+    """
     if not isinstance(a, Stencil2D):
         return False
     if a.dtype != jnp.float32:
         return False
     nx, ny = a.grid
-    return supports_resident_2d(nx, ny, itemsize=4)
+    return supports_resident_2d(nx, ny, itemsize=4,
+                                preconditioned=preconditioned)
 
 
 def cg_resident(
@@ -53,6 +58,7 @@ def cg_resident(
     maxiter: int = 2000,
     check_every: int = 32,
     iter_cap=None,
+    m=None,
     interpret: bool = False,
 ) -> CGResult:
     """Solve ``A x = b`` entirely inside one VMEM-resident pallas kernel.
@@ -60,8 +66,12 @@ def cg_resident(
     Arguments mirror ``solver.cg`` (absolute-``tol`` reference semantics,
     quirk Q3; ``rtol`` relative option; traced ``iter_cap``); ``x0`` is
     fixed at zero (the reference's init fast path, ``CUDACG.cu:247-259``)
-    and preconditioners / residual history are unsupported - use
-    ``solver.cg`` for those.  The reported iteration count is
+    and residual history is unsupported - use ``solver.cg`` for it.
+    ``m`` accepts ``None`` or a ``ChebyshevPreconditioner`` built over
+    THIS operator: its polynomial is applied in-kernel (pure VPU work on
+    the resident planes - ``degree - 1`` extra stencil applies per
+    iteration, no extra HBM traffic), following ``solver.cg``'s
+    preconditioned recurrence.  The reported iteration count is
     ``check_every``-block aligned, exactly like ``cg(check_every=k)``.
 
     Returns a ``CGResult`` (history ``None``).
@@ -70,6 +80,37 @@ def cg_resident(
         raise TypeError(
             f"cg_resident needs a Stencil2D operator, got {type(a).__name__}"
             " - use solver.cg for general operators")
+    degree, lmin, lmax = 0, 0.0, 1.0
+    if m is not None:
+        from ..models.precond import ChebyshevPreconditioner
+
+        if not isinstance(m, ChebyshevPreconditioner):
+            raise TypeError(
+                f"cg_resident supports m=None or a ChebyshevPreconditioner "
+                f"(applied in-kernel), got {type(m).__name__} - use "
+                f"solver.cg for other preconditioners")
+        if m.a is not a:
+            # The kernel applies the polynomial with THIS operator's
+            # stencil, so m must describe the same matrix - same grid
+            # AND same scale (a same-grid, different-scale operator
+            # would silently pair a's stencil with m's foreign
+            # spectral interval).
+            same = (isinstance(m.a, Stencil2D) and m.a.grid == a.grid)
+            if same:
+                try:
+                    same = bool(jnp.all(m.a.scale == a.scale))
+                except jax.errors.TracerBoolConversionError:
+                    raise ValueError(
+                        "under jit, build the ChebyshevPreconditioner "
+                        "over the SAME operator instance passed to "
+                        "cg_resident (scale equality cannot be checked "
+                        "on traced values)") from None
+            if not same:
+                raise ValueError(
+                    "the ChebyshevPreconditioner must be built over the "
+                    "same stencil operator being solved (same grid and "
+                    "same scale)")
+        degree, lmin, lmax = m.degree, m.lmin, m.lmax
     nx, ny = a.grid
     b = jnp.asarray(b)
     flat_in = b.ndim == 1
@@ -86,16 +127,19 @@ def cg_resident(
             f"cg_resident is float32-only (got {b2d.dtype}); df64/x64 "
             "precision routes through solver.cg / solver.df64")
 
-    x2d, iters, rr, indef, conv = cg_resident_2d(
+    x2d, iters, rr, indef, conv, health = cg_resident_2d(
         a.scale, b2d, tol=tol, rtol=rtol, maxiter=maxiter,
-        check_every=check_every, iter_cap=iter_cap, interpret=interpret)
+        check_every=check_every, iter_cap=iter_cap, interpret=interpret,
+        precond_degree=degree, lmin=lmin, lmax=lmax)
 
     res_norm = jnp.sqrt(rr)
-    # converged comes from INSIDE the kernel: recomputing the threshold
-    # here (different ||b|| reduction order) could contradict the
-    # kernel's actual stop decision on straddling cases.
+    # converged/healthy come from INSIDE the kernel: recomputing the
+    # threshold here (different ||b|| reduction order) could contradict
+    # the kernel's actual stop decision, and a rho <= 0 preconditioner
+    # breakdown must surface as BREAKDOWN, not MAXITER (solver/cg.py
+    # health semantics).
     converged = conv.astype(bool)
-    healthy = jnp.isfinite(res_norm)
+    healthy = health.astype(bool)
     status = jnp.where(
         ~healthy, jnp.int32(CGStatus.BREAKDOWN),
         jnp.where(converged, jnp.int32(CGStatus.CONVERGED),

@@ -77,6 +77,88 @@ class TestParityVsGeneralSolver:
         assert np.linalg.norm(r_true) < 1e-3
 
 
+class TestChebyshevResident:
+    """In-kernel Chebyshev polynomial preconditioning."""
+
+    def test_trajectory_matches_preconditioned_cg(self):
+        from cuda_mpi_parallel_tpu.models.precond import (
+            ChebyshevPreconditioner,
+        )
+
+        op, b = _grid_problem()
+        m = ChebyshevPreconditioner.from_operator(op, degree=4)
+        ref = solve(op, jnp.asarray(b.ravel()), tol=1e-5, maxiter=500,
+                    check_every=8, m=m)
+        res = cg_resident(op, jnp.asarray(b), tol=1e-5, maxiter=500,
+                          check_every=8, m=m, interpret=True)
+        assert int(res.iterations) == int(ref.iterations)
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x).ravel(),
+                                   np.asarray(ref.x), rtol=0, atol=1e-5)
+
+    def test_cuts_iterations_vs_plain(self):
+        from cuda_mpi_parallel_tpu.models.precond import (
+            ChebyshevPreconditioner,
+        )
+
+        op, b = _grid_problem()
+        m = ChebyshevPreconditioner.from_operator(op, degree=4)
+        plain = cg_resident(op, jnp.asarray(b), tol=1e-5, maxiter=500,
+                            check_every=8, interpret=True)
+        pcg = cg_resident(op, jnp.asarray(b), tol=1e-5, maxiter=500,
+                          check_every=8, m=m, interpret=True)
+        assert int(pcg.iterations) < int(plain.iterations) // 2
+
+    def test_rejects_other_preconditioners(self):
+        from cuda_mpi_parallel_tpu.models.operators import (
+            JacobiPreconditioner,
+        )
+
+        op, b = _grid_problem()
+        mj = JacobiPreconditioner.from_operator(op)
+        with pytest.raises(TypeError, match="ChebyshevPreconditioner"):
+            cg_resident(op, jnp.asarray(b), m=mj, interpret=True)
+
+    def test_rejects_mismatched_operator(self):
+        from cuda_mpi_parallel_tpu.models.precond import (
+            ChebyshevPreconditioner,
+        )
+
+        op, b = _grid_problem()
+        other = poisson.poisson_2d_operator(8, 128, dtype=jnp.float32)
+        m = ChebyshevPreconditioner.from_operator(other, degree=4)
+        with pytest.raises(ValueError, match="same"):
+            cg_resident(op, jnp.asarray(b), m=m, interpret=True)
+
+    def test_rejects_same_grid_different_scale(self):
+        from cuda_mpi_parallel_tpu.models.precond import (
+            ChebyshevPreconditioner,
+        )
+
+        op, b = _grid_problem()
+        scaled = Stencil2D.create(16, 128, scale=4.0, dtype=jnp.float32)
+        m = ChebyshevPreconditioner.from_operator(scaled, degree=4)
+        with pytest.raises(ValueError, match="same"):
+            cg_resident(op, jnp.asarray(b), m=m, interpret=True)
+
+    def test_bad_interval_reports_breakdown(self):
+        # an interval that makes p(A) negative definite: rho0 <= 0 is a
+        # preconditioner breakdown and must surface as BREAKDOWN, not
+        # MAXITER (solver/cg.py health semantics).
+        from cuda_mpi_parallel_tpu.models.precond import (
+            ChebyshevPreconditioner,
+        )
+
+        op, b = _grid_problem()
+        m = ChebyshevPreconditioner(a=op,
+                                    lmin=jnp.float32(-2.0),
+                                    lmax=jnp.float32(-1.0), degree=2)
+        res = cg_resident(op, jnp.asarray(b), tol=1e-6, maxiter=100,
+                          check_every=4, m=m, interpret=True)
+        assert res.status_enum() is CGStatus.BREAKDOWN
+        assert not bool(res.converged)
+
+
 class TestSemantics:
     def test_maxiter_status(self):
         op, b = _grid_problem()
