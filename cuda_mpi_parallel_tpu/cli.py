@@ -390,33 +390,38 @@ def main(argv=None) -> int:
                 check_every=args.check_every, csr_comm=args.csr_comm)
         if args.engine in ("auto", "resident"):
             from .models.operators import _pallas_interpret
-            from .solver.resident import cg_resident, supports_resident
+            from .solver.resident import cg_resident, resident_eligible
 
             # "auto" takes the resident engine only on a compiled TPU
             # backend: off-TPU the kernel would run in pallas interpret
             # mode, orders of magnitude slower than the jitted general
             # solver.  An EXPLICIT --engine resident still honors the
             # request anywhere (interpret mode off-TPU - correctness
-            # checks, not speed).
+            # checks, not speed).  Eligibility itself is the shared
+            # solver.resident.resident_eligible predicate - one source
+            # of truth with solve(engine=...).
+            from .models.operators import Stencil2D as _S2res
+
+            m_res = None
+            if args.precond == "chebyshev" and isinstance(a, _S2res):
+                from .models.precond import ChebyshevPreconditioner
+
+                m_res = ChebyshevPreconditioner.from_operator(
+                    a, degree=args.precond_degree)
             eligible = (args.precond in (None, "chebyshev")
-                        and supports_resident(
-                            a, preconditioned=args.precond == "chebyshev")
-                        and args.method == "cg" and not args.history
+                        and resident_eligible(
+                            a, b, m_res, method=args.method,
+                            record_history=args.history)
                         and (args.engine == "resident"
                              or _jax_backend_is_tpu()))
             if args.engine == "resident" and not eligible:
                 raise SystemExit(
                     f"--engine resident does not support "
-                    f"{type(a).__name__} at this size (needs a float32 "
-                    f"2D stencil whose CG working set fits VMEM; try "
-                    f"--problem poisson2d --matrix-free)")
+                    f"{type(a).__name__} at this size/dtype (needs a "
+                    f"float32 2D stencil whose CG working set fits VMEM "
+                    f"and a float32 rhs; try --problem poisson2d "
+                    f"--matrix-free --dtype float32)")
             if eligible:
-                m_res = None
-                if args.precond == "chebyshev":
-                    from .models.precond import ChebyshevPreconditioner
-
-                    m_res = ChebyshevPreconditioner.from_operator(
-                        a, degree=args.precond_degree)
                 return cg_resident(a, b, tol=args.tol, rtol=args.rtol,
                                    maxiter=args.maxiter,
                                    check_every=args.check_every,

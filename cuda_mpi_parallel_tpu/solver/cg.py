@@ -721,15 +721,48 @@ def solve(
     check_every: int = 1,
     method: str = "cg",
     compensated: bool = False,
+    engine: str = "general",
 ) -> CGResult:
     """Jitted single-call entry point: compile once per (operator-structure,
     shape, maxiter) and reuse - the whole solve is one XLA executable.
 
     ``tol``/``rtol``/``iter_cap`` are passed as device scalars so sweeping
     them does not recompile.
+
+    ``engine``: ``"general"`` (default - the ``lax.while_loop`` solver,
+    every operator/feature), ``"resident"`` (the single-pallas-kernel
+    VMEM-resident engine, ``solver.resident`` - raises if the problem is
+    outside its scope), or ``"auto"`` (resident when eligible on a
+    compiled TPU backend - f32 2D stencil fitting VMEM, ``m`` ``None``
+    or Chebyshev, ``method="cg"``, default ``x0``, no history/
+    checkpointing - otherwise general).
     """
+    if engine not in ("general", "auto", "resident"):
+        raise ValueError(f"unknown engine {engine!r}; expected 'general', "
+                         f"'auto' or 'resident'")
     if not isinstance(a, LinearOperator):
         a = _as_operator(a)
+    if engine in ("auto", "resident"):
+        from ..models.operators import _pallas_interpret
+        from .resident import cg_resident, resident_eligible
+
+        eligible = (resident_eligible(
+            a, b, m, method=method, record_history=record_history,
+            x0=x0, resume_from=resume_from,
+            return_checkpoint=return_checkpoint, compensated=compensated)
+            and (engine == "resident"
+                 or jax.default_backend() == "tpu"))
+        if engine == "resident" and not eligible:
+            raise ValueError(
+                "engine='resident' needs a float32 2D stencil whose CG "
+                "working set fits VMEM, a float32 rhs, m=None or a "
+                "Chebyshev preconditioner built over this operator, "
+                "method='cg', default x0, and no history/checkpointing "
+                "- use engine='general' (or 'auto') otherwise")
+        if eligible:
+            return cg_resident(a, b, tol=tol, rtol=rtol, maxiter=maxiter,
+                               check_every=check_every, iter_cap=iter_cap,
+                               m=m, interpret=_pallas_interpret())
     b = jnp.asarray(b)
     if not jnp.issubdtype(b.dtype, jnp.floating):
         b = b.astype(jnp.result_type(float))

@@ -49,6 +49,57 @@ def supports_resident(a, preconditioned: bool = False) -> bool:
                                 preconditioned=preconditioned)
 
 
+def _chebyshev_matches(a, m) -> bool:
+    """True if ``m`` was built over (an equivalent of) operator ``a``.
+
+    The kernel pairs ``a``'s stencil with ``m``'s spectral interval, so
+    they must describe the same matrix: same grid AND same scale.  A
+    traced scale that cannot be compared returns False (callers deciding
+    eligibility then fall back to the general solver rather than guess).
+    """
+    if m.a is a:
+        return True
+    if not (isinstance(m.a, Stencil2D) and m.a.grid == a.grid):
+        return False
+    try:
+        return bool(jnp.all(m.a.scale == a.scale))
+    except jax.errors.TracerBoolConversionError:
+        return False
+
+
+def resident_eligible(a, b=None, m=None, *, method: str = "cg",
+                      record_history: bool = False, x0=None,
+                      resume_from=None, return_checkpoint: bool = False,
+                      compensated: bool = False) -> bool:
+    """Single source of truth for "can this solve run on the resident
+    engine?" - shared by ``solve(engine=...)`` and the CLI so the two
+    cannot drift.
+
+    Checks the operator (f32 2D stencil fitting VMEM, preconditioned
+    budget included), the rhs dtype (f32 - the general path casts other
+    dtypes, the kernel does not), the preconditioner (``None`` or a
+    ``ChebyshevPreconditioner`` verifiably built over ``a``), and the
+    feature set the one-kernel solve supports (``method="cg"``, default
+    ``x0``, no history / checkpointing / compensated dots).
+    """
+    from ..models.precond import ChebyshevPreconditioner
+
+    chebyshev = isinstance(m, ChebyshevPreconditioner)
+    if m is not None and not chebyshev:
+        return False
+    if chebyshev and not _chebyshev_matches(a, m):
+        return False
+    if not supports_resident(a, preconditioned=chebyshev):
+        return False
+    if (method != "cg" or record_history or x0 is not None
+            or resume_from is not None or return_checkpoint
+            or compensated):
+        return False
+    if b is not None and jnp.asarray(b).dtype != jnp.float32:
+        return False
+    return True
+
+
 def cg_resident(
     a: Stencil2D,
     b: jax.Array,

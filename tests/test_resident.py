@@ -230,6 +230,60 @@ class TestSemantics:
         np.testing.assert_array_equal(np.asarray(res.x), 0.0)
 
 
+class TestSolveEngineParam:
+    def test_solve_engine_resident_matches_general(self):
+        op, b = _grid_problem()
+        bf = jnp.asarray(b.ravel())
+        r1 = solve(op, bf, tol=1e-5, maxiter=500, check_every=8)
+        r2 = solve(op, bf, tol=1e-5, maxiter=500, check_every=8,
+                   engine="resident")
+        assert int(r1.iterations) == int(r2.iterations)
+
+    def test_solve_engine_auto_stays_general_off_tpu(self):
+        op, b = _grid_problem()
+        bf = jnp.asarray(b.ravel())
+        r1 = solve(op, bf, tol=1e-5, maxiter=500, check_every=8)
+        r3 = solve(op, bf, tol=1e-5, maxiter=500, check_every=8,
+                   engine="auto")
+        np.testing.assert_array_equal(np.asarray(r3.x), np.asarray(r1.x))
+
+    def test_solve_engine_resident_rejects_unsupported(self):
+        op, b = _grid_problem()
+        with pytest.raises(ValueError, match="resident"):
+            solve(op, jnp.asarray(b.ravel()), engine="resident",
+                  record_history=True)
+        with pytest.raises(ValueError, match="engine"):
+            solve(op, jnp.asarray(b.ravel()), engine="warp")
+
+    def test_non_f32_rhs_not_eligible(self):
+        # the general path casts int rhs; the resident gate must exclude
+        # it (engine='auto' falls back, 'resident' raises the curated
+        # message, never the kernel's raw dtype error).
+        op, b = _grid_problem()
+        b_int = jnp.ones(16 * 128, jnp.int32)
+        r = solve(op, b_int, tol=1e-5, maxiter=100, engine="auto")
+        assert jnp.issubdtype(r.x.dtype, jnp.floating)  # general path cast
+        with pytest.raises(ValueError, match="float32 rhs"):
+            solve(op, b_int, engine="resident")
+
+    def test_mismatched_chebyshev_not_eligible(self):
+        from cuda_mpi_parallel_tpu.models.precond import (
+            ChebyshevPreconditioner,
+        )
+        from cuda_mpi_parallel_tpu.solver.resident import resident_eligible
+
+        op, b = _grid_problem()
+        scaled = Stencil2D.create(16, 128, scale=4.0, dtype=jnp.float32)
+        m_bad = ChebyshevPreconditioner.from_operator(scaled, degree=4)
+        assert not resident_eligible(op, jnp.asarray(b), m_bad)
+        m_ok = ChebyshevPreconditioner.from_operator(op, degree=4)
+        assert resident_eligible(op, jnp.asarray(b), m_ok)
+        # auto with the mismatched m must run the general path, not raise
+        r = solve(op, jnp.asarray(b.ravel()), tol=1e-5, maxiter=500,
+                  m=m_bad, engine="auto")
+        assert int(r.iterations) > 0
+
+
 class TestGate:
     def test_supports_resident_stencil2d(self):
         op, _ = _grid_problem()
