@@ -390,7 +390,11 @@ def main(argv=None) -> int:
                 check_every=args.check_every, csr_comm=args.csr_comm)
         if args.engine in ("auto", "resident"):
             from .models.operators import _pallas_interpret
-            from .solver.resident import cg_resident, resident_eligible
+            from .solver.resident import (
+                cg_resident,
+                resident_eligible,
+                supports_resident,
+            )
 
             # "auto" takes the resident engine only on a compiled TPU
             # backend: off-TPU the kernel would run in pallas interpret
@@ -400,26 +404,31 @@ def main(argv=None) -> int:
             # checks, not speed).  Eligibility itself is the shared
             # solver.resident.resident_eligible predicate - one source
             # of truth with solve(engine=...).
-            from .models.operators import Stencil2D as _S2res
-
+            # Cheap gates first - the Chebyshev construction below runs
+            # a 30-matvec power iteration, so it must not be built for
+            # solves that cannot take the resident path anyway.
+            # resident_eligible stays the final authority.
+            cheap_ok = (args.precond in (None, "chebyshev")
+                        and args.method == "cg" and not args.history
+                        and (args.engine == "resident"
+                             or _jax_backend_is_tpu())
+                        and supports_resident(
+                            a, preconditioned=args.precond == "chebyshev"))
             m_res = None
-            if args.precond == "chebyshev" and isinstance(a, _S2res):
+            if cheap_ok and args.precond == "chebyshev":
                 from .models.precond import ChebyshevPreconditioner
 
                 m_res = ChebyshevPreconditioner.from_operator(
                     a, degree=args.precond_degree)
-            eligible = (args.precond in (None, "chebyshev")
-                        and resident_eligible(
-                            a, b, m_res, method=args.method,
-                            record_history=args.history)
-                        and (args.engine == "resident"
-                             or _jax_backend_is_tpu()))
+            eligible = cheap_ok and resident_eligible(
+                a, b, m_res, method=args.method,
+                record_history=args.history)
             if args.engine == "resident" and not eligible:
                 raise SystemExit(
                     f"--engine resident does not support "
                     f"{type(a).__name__} at this size/dtype (needs a "
-                    f"float32 2D stencil whose CG working set fits VMEM "
-                    f"and a float32 rhs; try --problem poisson2d "
+                    f"float32 2D/3D stencil whose CG working set fits "
+                    f"VMEM and a float32 rhs; try --problem poisson2d "
                     f"--matrix-free --dtype float32)")
             if eligible:
                 return cg_resident(a, b, tol=args.tol, rtol=args.rtol,

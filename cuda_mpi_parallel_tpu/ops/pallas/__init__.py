@@ -5,8 +5,10 @@ vendor library)."""
 
 from .resident import (
     cg_resident_2d,
+    cg_resident_3d,
     cg_resident_df64_2d,
     supports_resident_2d,
+    supports_resident_3d,
     supports_resident_df64_2d,
     vmem_bytes,
 )
@@ -21,8 +23,10 @@ from .stencil import (
 
 __all__ = [
     "cg_resident_2d",
+    "cg_resident_3d",
     "cg_resident_df64_2d",
     "supports_resident_2d",
+    "supports_resident_3d",
     "supports_resident_df64_2d",
     "vmem_bytes",
     "pick_block_planes_3d",

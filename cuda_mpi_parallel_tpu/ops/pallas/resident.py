@@ -119,6 +119,21 @@ def supports_resident_2d(nx: int, ny: int, itemsize: int = 4,
     return planes * nx * ny * itemsize <= vmem_bytes(device)
 
 
+def _axis_shifts(u, axis):
+    """The two one-step shifts of ``u`` along ``axis`` with zero fill
+    (Dirichlet boundary), as Mosaic-friendly concatenations."""
+    lo = [slice(None)] * u.ndim
+    hi = [slice(None)] * u.ndim
+    one = [slice(None)] * u.ndim
+    lo[axis] = slice(1, None)
+    hi[axis] = slice(None, -1)
+    one[axis] = slice(None, 1)
+    zero = jnp.zeros_like(u[tuple(one)])
+    fwd = jnp.concatenate([u[tuple(lo)], zero], axis)
+    bwd = jnp.concatenate([zero, u[tuple(hi)]], axis)
+    return fwd, bwd
+
+
 def _shift_stencil(u, scale):
     """5-point Dirichlet Laplacian as in-register shifted adds.
 
@@ -126,14 +141,23 @@ def _shift_stencil(u, scale):
     backend), with the ``jnp.pad`` halo replaced by zero-filled
     concatenations that Mosaic lowers to lane/sublane shifts.
     """
-    up = jnp.concatenate([u[1:], jnp.zeros_like(u[:1])], axis=0)
-    down = jnp.concatenate([jnp.zeros_like(u[:1]), u[:-1]], axis=0)
-    left = jnp.concatenate([u[:, 1:], jnp.zeros_like(u[:, :1])], axis=1)
-    right = jnp.concatenate([jnp.zeros_like(u[:, :1]), u[:, :-1]], axis=1)
+    up, down = _axis_shifts(u, 0)
+    left, right = _axis_shifts(u, 1)
     return scale * (4.0 * u - up - down - left - right)
 
 
-def _resident_kernel(nblocks, check_every, degree,
+def _shift_stencil_3d(u, scale):
+    """7-point Dirichlet Laplacian (``Stencil3D.matvec`` semantics):
+    shifts along the leading (plane) axis plus the 2D sublane/lane
+    shifts, all in-register."""
+    acc = 6.0 * u
+    for axis in (0, 1, 2):
+        fwd, bwd = _axis_shifts(u, axis)
+        acc = acc - fwd - bwd
+    return scale * acc
+
+
+def _resident_kernel(nblocks, check_every, degree, stencil_fn,
                      params_ref, cap_ref, b_ref,
                      x_ref, iters_ref, rr_ref, indef_ref, conv_ref,
                      health_ref, r_ref, p_ref, state_f, state_i):
@@ -158,7 +182,7 @@ def _resident_kernel(nblocks, check_every, degree,
         for _ in range(degree - 1):
             rho_n = 1.0 / (2.0 * sigma - rho_c)
             d = (rho_n * rho_c) * d + (2.0 * rho_n / delta) * (
-                r - _shift_stencil(z, scale))
+                r - stencil_fn(z, scale))
             z = z + d
             rho_c = rho_n
         return z
@@ -199,7 +223,7 @@ def _resident_kernel(nblocks, check_every, degree,
             def one_iter(_, carry):
                 rr, rho = carry
                 p = p_ref[:]
-                ap = _shift_stencil(p, scale)
+                ap = stencil_fn(p, scale)
                 pap = jnp.sum(p * ap)
                 # pap == 0 means an exact solve (p == 0), not
                 # indefiniteness - same guard as solver/cg.py's
@@ -253,8 +277,8 @@ def _resident_kernel(nblocks, check_every, degree,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "nx", "ny", "maxiter", "check_every", "degree", "interpret"))
-def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b2d, *, nx, ny,
+    "shape", "maxiter", "check_every", "degree", "interpret"))
+def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, *, shape,
                       maxiter, check_every, degree, interpret):
     nblocks = -(-maxiter // check_every)
     params = jnp.stack([
@@ -264,8 +288,12 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b2d, *, nx, ny,
         jnp.asarray(lmin, jnp.float32),
         jnp.asarray(lmax, jnp.float32)])
     cap_arr = jnp.asarray(cap, jnp.int32).reshape(1)
+    stencil_fn = _shift_stencil if len(shape) == 2 else _shift_stencil_3d
     kernel = functools.partial(_resident_kernel, nblocks, check_every,
-                               degree)
+                               degree, stencil_fn)
+    cells = 1
+    for s in shape:
+        cells *= s
     x, iters, rr, indef, conv, health = pl.pallas_call(
         kernel,
         in_specs=[
@@ -282,7 +310,7 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b2d, *, nx, ny,
             pl.BlockSpec(memory_space=pltpu.SMEM),   # healthy flag
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((nx, ny), jnp.float32),
+            jax.ShapeDtypeStruct(shape, jnp.float32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
             jax.ShapeDtypeStruct((1,), jnp.float32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
@@ -290,8 +318,8 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b2d, *, nx, ny,
             jax.ShapeDtypeStruct((1,), jnp.int32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((nx, ny), jnp.float32),       # r
-            pltpu.VMEM((nx, ny), jnp.float32),       # p
+            pltpu.VMEM(shape, jnp.float32),          # r
+            pltpu.VMEM(shape, jnp.float32),          # p
             pltpu.SMEM((2,), jnp.float32),           # rr, rho
             pltpu.SMEM((2,), jnp.int32),             # k, indefinite
         ],
@@ -299,12 +327,12 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b2d, *, nx, ny,
         # kernels; residency is the point here, so lift it to the gated
         # footprint bound (+1 MiB slack for Mosaic's own temporaries;
         # +2 planes for the Chebyshev recurrence's z/d transients -
-        # supports_resident_2d(preconditioned=True) gates on the same).
+        # supports_resident_*(preconditioned=True) gates on the same).
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=(_PLANES_BOUND + (2 if degree else 0))
-            * nx * ny * 4 + (1 << 20)),
+            * cells * 4 + (1 << 20)),
         interpret=interpret,
-    )(params, cap_arr, b2d)
+    )(params, cap_arr, b_grid)
     return x, iters[0], rr[0], indef[0], conv[0], health[0]
 
 
@@ -349,11 +377,13 @@ def cg_resident_2d(scale, b2d, *, tol=0.0, rtol=0.0, maxiter=2000,
     nx, ny = b2d.shape
     if b2d.dtype != jnp.float32:
         raise ValueError(f"resident CG is float32-only, got {b2d.dtype}")
-    if not interpret and not supports_resident_2d(nx, ny):
+    if not interpret and not supports_resident_2d(
+            nx, ny, preconditioned=precond_degree > 0):
         raise ValueError(
             f"({nx}, {ny}) f32 grid does not fit the resident kernel: "
             f"needs nx % 8 == 0, ny % 128 == 0 and "
-            f"{_PLANES_BOUND} * grid bytes <= {vmem_bytes()} "
+            f"{_PLANES_BOUND + (2 if precond_degree > 0 else 0)} * grid "
+            f"bytes <= {vmem_bytes()} "
             f"(set {_ENV_OVERRIDE} to override the budget)")
     if check_every < 1:
         raise ValueError(f"check_every must be >= 1, got {check_every}")
@@ -363,7 +393,56 @@ def cg_resident_2d(scale, b2d, *, tol=0.0, rtol=0.0, maxiter=2000,
     check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_call(
-        scale, tol, rtol, lmin, lmax, cap, b2d, nx=nx, ny=ny,
+        scale, tol, rtol, lmin, lmax, cap, b2d, shape=(nx, ny),
+        maxiter=maxiter, check_every=check_every,
+        degree=int(precond_degree), interpret=interpret)
+
+
+def supports_resident_3d(nx: int, ny: int, nz: int, itemsize: int = 4,
+                         device=None, preconditioned: bool = False) -> bool:
+    """True if an (nx, ny, nz) grid's CG working set fits the resident
+    kernel: ``ny % 8 == 0 and nz % 128 == 0`` (the trailing two axes
+    carry the (8, 128) f32 tiles; the leading plane axis is free) plus
+    the same plane-count capacity bound as 2D."""
+    if ny % 8 != 0 or nz % 128 != 0 or nx < 1:
+        return False
+    if itemsize != 4:
+        return False
+    planes = _PLANES_BOUND + (2 if preconditioned else 0)
+    return planes * nx * ny * nz * itemsize <= vmem_bytes(device)
+
+
+def cg_resident_3d(scale, b3d, *, tol=0.0, rtol=0.0, maxiter=2000,
+                   check_every=32, iter_cap=None, interpret=False,
+                   precond_degree=0, lmin=0.0, lmax=1.0):
+    """The 7-point-stencil (``Stencil3D``) form of :func:`cg_resident_2d`:
+    same kernel, same semantics and return contract, with the 3D
+    shifted-add Laplacian - for 3D grids small enough to pin in VMEM
+    (up to ~128^3 f32 on a 128 MiB part; BASELINE's 256^3 north star
+    stays on the general solver's HBM-streaming path)."""
+    b3d = jnp.asarray(b3d)
+    if b3d.ndim != 3:
+        raise ValueError(f"b3d must be 3-D (the grid), got {b3d.shape}")
+    nx, ny, nz = b3d.shape
+    if b3d.dtype != jnp.float32:
+        raise ValueError(f"resident CG is float32-only, got {b3d.dtype}")
+    if not interpret and not supports_resident_3d(
+            nx, ny, nz, preconditioned=precond_degree > 0):
+        raise ValueError(
+            f"({nx}, {ny}, {nz}) f32 grid does not fit the resident "
+            f"kernel: needs ny % 8 == 0, nz % 128 == 0 and "
+            f"{_PLANES_BOUND + (2 if precond_degree > 0 else 0)} * grid "
+            f"bytes <= {vmem_bytes()} "
+            f"(set {_ENV_OVERRIDE} to override the budget)")
+    if check_every < 1:
+        raise ValueError(f"check_every must be >= 1, got {check_every}")
+    if precond_degree < 0:
+        raise ValueError(
+            f"precond_degree must be >= 0, got {precond_degree}")
+    check_every = min(check_every, maxiter)
+    cap = maxiter if iter_cap is None else iter_cap
+    return _cg_resident_call(
+        scale, tol, rtol, lmin, lmax, cap, b3d, shape=(nx, ny, nz),
         maxiter=maxiter, check_every=check_every,
         degree=int(precond_degree), interpret=interpret)
 

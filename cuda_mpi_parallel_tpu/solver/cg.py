@@ -733,8 +733,8 @@ def solve(
     every operator/feature), ``"resident"`` (the single-pallas-kernel
     VMEM-resident engine, ``solver.resident`` - raises if the problem is
     outside its scope), or ``"auto"`` (resident when eligible on a
-    compiled TPU backend - f32 2D stencil fitting VMEM, ``m`` ``None``
-    or Chebyshev, ``method="cg"``, default ``x0``, no history/
+    compiled TPU backend - f32 2D/3D stencil fitting VMEM, ``m``
+    ``None`` or Chebyshev, ``method="cg"``, default ``x0``, no history/
     checkpointing - otherwise general).
     """
     if engine not in ("general", "auto", "resident"):
@@ -754,8 +754,8 @@ def solve(
                  or jax.default_backend() == "tpu"))
         if engine == "resident" and not eligible:
             raise ValueError(
-                "engine='resident' needs a float32 2D stencil whose CG "
-                "working set fits VMEM, a float32 rhs, m=None or a "
+                "engine='resident' needs a float32 2D/3D stencil whose "
+                "CG working set fits VMEM, a float32 rhs, m=None or a "
                 "Chebyshev preconditioner built over this operator, "
                 "method='cg', default x0, and no history/checkpointing "
                 "- use engine='general' (or 'auto') otherwise")

@@ -21,12 +21,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.operators import Stencil2D
+from ..models.operators import Stencil2D, Stencil3D
 from ..ops import df64 as df
 from ..ops.pallas.resident import (
     cg_resident_2d,
+    cg_resident_3d,
     cg_resident_df64_2d,
     supports_resident_2d,
+    supports_resident_3d,
     supports_resident_df64_2d,
 )
 from .cg import CGResult
@@ -40,31 +42,42 @@ def supports_resident(a, preconditioned: bool = False) -> bool:
     ``preconditioned`` budgets the in-kernel Chebyshev recurrence's two
     extra transient planes.
     """
-    if not isinstance(a, Stencil2D):
-        return False
-    if a.dtype != jnp.float32:
-        return False
-    nx, ny = a.grid
-    return supports_resident_2d(nx, ny, itemsize=4,
-                                preconditioned=preconditioned)
+    if isinstance(a, Stencil2D):
+        if a.dtype != jnp.float32:
+            return False
+        nx, ny = a.grid
+        return supports_resident_2d(nx, ny, itemsize=4,
+                                    preconditioned=preconditioned)
+    if isinstance(a, Stencil3D):
+        if a.dtype != jnp.float32:
+            return False
+        nx, ny, nz = a.grid
+        return supports_resident_3d(nx, ny, nz, itemsize=4,
+                                    preconditioned=preconditioned)
+    return False
 
 
-def _chebyshev_matches(a, m) -> bool:
-    """True if ``m`` was built over (an equivalent of) operator ``a``.
+def _chebyshev_match_status(a, m) -> str:
+    """How ``m``'s operator relates to ``a`` (a 2D/3D stencil).
 
     The kernel pairs ``a``'s stencil with ``m``'s spectral interval, so
-    they must describe the same matrix: same grid AND same scale.  A
-    traced scale that cannot be compared returns False (callers deciding
-    eligibility then fall back to the general solver rather than guess).
+    they must describe the same matrix: same grid AND same scale.
+    Returns ``"match"``, ``"mismatch"``, or ``"unverifiable"`` (traced
+    scale that cannot be compared - eligibility decisions treat it as
+    non-matching and fall back to the general solver; explicit
+    ``cg_resident`` calls raise a specific error).  Call only after
+    ``supports_resident(a)`` - the grid/scale attributes exist on
+    stencil operators only.
     """
     if m.a is a:
-        return True
-    if not (isinstance(m.a, Stencil2D) and m.a.grid == a.grid):
-        return False
+        return "match"
+    if not (isinstance(m.a, type(a)) and m.a.grid == a.grid):
+        return "mismatch"
     try:
-        return bool(jnp.all(m.a.scale == a.scale))
+        return "match" if bool(jnp.all(m.a.scale == a.scale)) \
+            else "mismatch"
     except jax.errors.TracerBoolConversionError:
-        return False
+        return "unverifiable"
 
 
 def resident_eligible(a, b=None, m=None, *, method: str = "cg",
@@ -87,9 +100,11 @@ def resident_eligible(a, b=None, m=None, *, method: str = "cg",
     chebyshev = isinstance(m, ChebyshevPreconditioner)
     if m is not None and not chebyshev:
         return False
-    if chebyshev and not _chebyshev_matches(a, m):
-        return False
+    # operator gate FIRST: _chebyshev_match_status reads grid/scale,
+    # which only stencil operators have
     if not supports_resident(a, preconditioned=chebyshev):
+        return False
+    if chebyshev and _chebyshev_match_status(a, m) != "match":
         return False
     if (method != "cg" or record_history or x0 is not None
             or resume_from is not None or return_checkpoint
@@ -127,10 +142,10 @@ def cg_resident(
 
     Returns a ``CGResult`` (history ``None``).
     """
-    if not isinstance(a, Stencil2D):
+    if not isinstance(a, (Stencil2D, Stencil3D)):
         raise TypeError(
-            f"cg_resident needs a Stencil2D operator, got {type(a).__name__}"
-            " - use solver.cg for general operators")
+            f"cg_resident needs a Stencil2D or Stencil3D operator, got "
+            f"{type(a).__name__} - use solver.cg for general operators")
     degree, lmin, lmax = 0, 0.0, 1.0
     if m is not None:
         from ..models.precond import ChebyshevPreconditioner
@@ -140,46 +155,45 @@ def cg_resident(
                 f"cg_resident supports m=None or a ChebyshevPreconditioner "
                 f"(applied in-kernel), got {type(m).__name__} - use "
                 f"solver.cg for other preconditioners")
-        if m.a is not a:
-            # The kernel applies the polynomial with THIS operator's
-            # stencil, so m must describe the same matrix - same grid
-            # AND same scale (a same-grid, different-scale operator
-            # would silently pair a's stencil with m's foreign
-            # spectral interval).
-            same = (isinstance(m.a, Stencil2D) and m.a.grid == a.grid)
-            if same:
-                try:
-                    same = bool(jnp.all(m.a.scale == a.scale))
-                except jax.errors.TracerBoolConversionError:
-                    raise ValueError(
-                        "under jit, build the ChebyshevPreconditioner "
-                        "over the SAME operator instance passed to "
-                        "cg_resident (scale equality cannot be checked "
-                        "on traced values)") from None
-            if not same:
-                raise ValueError(
-                    "the ChebyshevPreconditioner must be built over the "
-                    "same stencil operator being solved (same grid and "
-                    "same scale)")
+        # The kernel applies the polynomial with THIS operator's
+        # stencil, so m must describe the same matrix - same grid AND
+        # same scale (a same-grid, different-scale operator would
+        # silently pair a's stencil with m's foreign spectral
+        # interval).  Shared logic with resident_eligible.
+        status = _chebyshev_match_status(a, m)
+        if status == "unverifiable":
+            raise ValueError(
+                "under jit, build the ChebyshevPreconditioner over the "
+                "SAME operator instance passed to cg_resident (scale "
+                "equality cannot be checked on traced values)")
+        if status == "mismatch":
+            raise ValueError(
+                "the ChebyshevPreconditioner must be built over the "
+                "same stencil operator being solved (same grid and "
+                "same scale)")
         degree, lmin, lmax = m.degree, m.lmin, m.lmax
-    nx, ny = a.grid
+    grid = a.grid
+    n_cells = 1
+    for s in grid:
+        n_cells *= s
     b = jnp.asarray(b)
     flat_in = b.ndim == 1
     if flat_in:
-        if b.shape[0] != nx * ny:
-            raise ValueError(f"rhs length {b.shape[0]} != grid {nx}x{ny}")
-        b2d = b.reshape(nx, ny)
+        if b.shape[0] != n_cells:
+            raise ValueError(f"rhs length {b.shape[0]} != grid {grid}")
+        b_grid = b.reshape(grid)
     else:
-        if b.shape != (nx, ny):
-            raise ValueError(f"rhs shape {b.shape} != grid ({nx}, {ny})")
-        b2d = b
-    if b2d.dtype != jnp.float32:
+        if b.shape != grid:
+            raise ValueError(f"rhs shape {b.shape} != grid {grid}")
+        b_grid = b
+    if b_grid.dtype != jnp.float32:
         raise ValueError(
-            f"cg_resident is float32-only (got {b2d.dtype}); df64/x64 "
+            f"cg_resident is float32-only (got {b_grid.dtype}); df64/x64 "
             "precision routes through solver.cg / solver.df64")
 
-    x2d, iters, rr, indef, conv, health = cg_resident_2d(
-        a.scale, b2d, tol=tol, rtol=rtol, maxiter=maxiter,
+    kernel_fn = cg_resident_2d if len(grid) == 2 else cg_resident_3d
+    x2d, iters, rr, indef, conv, health = kernel_fn(
+        a.scale, b_grid, tol=tol, rtol=rtol, maxiter=maxiter,
         check_every=check_every, iter_cap=iter_cap, interpret=interpret,
         precond_degree=degree, lmin=lmin, lmax=lmax)
 

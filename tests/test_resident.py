@@ -77,6 +77,57 @@ class TestParityVsGeneralSolver:
         assert np.linalg.norm(r_true) < 1e-3
 
 
+class Test3DResident:
+    """7-point Stencil3D in the same one-kernel shape."""
+
+    def _problem(self, nx=4, ny=8, nz=128, seed=0):
+        op = Stencil3D.create(nx, ny, nz, dtype=jnp.float32)
+        rng = np.random.default_rng(seed)
+        b = rng.standard_normal(nx * ny * nz).astype(np.float32)
+        return op, b
+
+    def test_trajectory_matches_general_solver(self):
+        op, b = self._problem()
+        ref = solve(op, jnp.asarray(b), tol=1e-5, maxiter=300,
+                    check_every=8)
+        res = cg_resident(op, jnp.asarray(b), tol=1e-5, maxiter=300,
+                          check_every=8, interpret=True)
+        assert int(res.iterations) == int(ref.iterations)
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x).ravel(),
+                                   np.asarray(ref.x), rtol=0, atol=1e-5)
+
+    def test_grid_rhs_shape(self):
+        op, b = self._problem()
+        res = cg_resident(op, jnp.asarray(b.reshape(4, 8, 128)), tol=1e-5,
+                          maxiter=300, check_every=8, interpret=True)
+        assert res.x.shape == (4, 8, 128)
+
+    def test_chebyshev_3d(self):
+        from cuda_mpi_parallel_tpu.models.precond import (
+            ChebyshevPreconditioner,
+        )
+
+        op, b = self._problem()
+        m = ChebyshevPreconditioner.from_operator(op, degree=4)
+        ref = solve(op, jnp.asarray(b), tol=1e-5, maxiter=300,
+                    check_every=8, m=m)
+        res = cg_resident(op, jnp.asarray(b), tol=1e-5, maxiter=300,
+                          check_every=8, m=m, interpret=True)
+        assert int(res.iterations) == int(ref.iterations)
+
+    def test_gate_3d(self, monkeypatch):
+        op, _ = self._problem()
+        assert supports_resident(op)
+        assert not rk.supports_resident_3d(4, 10, 128)
+        assert not rk.supports_resident_3d(4, 8, 100)
+        monkeypatch.setenv(rk._ENV_OVERRIDE, str(1 << 20))
+        assert not rk.supports_resident_3d(64, 64, 128)
+        # 256^3 north star never fits a 128 MiB part
+        monkeypatch.delenv(rk._ENV_OVERRIDE)
+        assert not rk.supports_resident_3d(256, 256, 256)
+
+
 class TestChebyshevResident:
     """In-kernel Chebyshev polynomial preconditioning."""
 
@@ -289,12 +340,13 @@ class TestGate:
         op, _ = _grid_problem()
         assert supports_resident(op)
 
-    def test_rejects_stencil3d(self):
-        op3 = Stencil3D.create(8, 8, 128, dtype=jnp.float32)
-        assert not supports_resident(op3)
-        with pytest.raises(TypeError, match="Stencil2D"):
-            cg_resident(op3, jnp.zeros(8 * 8 * 128, jnp.float32),
-                        interpret=True)
+    def test_rejects_non_stencil_operator(self):
+        from cuda_mpi_parallel_tpu.models import random_spd
+
+        dense = random_spd.random_spd_dense(8, dtype=np.float32)
+        assert not supports_resident(dense)
+        with pytest.raises(TypeError, match="Stencil"):
+            cg_resident(dense, jnp.zeros(8, jnp.float32), interpret=True)
 
     def test_rejects_unaligned_grid(self):
         assert not rk.supports_resident_2d(10, 128)
