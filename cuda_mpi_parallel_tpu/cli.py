@@ -352,7 +352,7 @@ def main(argv=None) -> int:
                 if args.engine == "resident" and not eligible:
                     raise SystemExit(
                         f"--engine resident --dtype df64 does not support "
-                        f"{type(a).__name__} at this size (needs a 2D "
+                        f"{type(a).__name__} at this size (needs a 2D/3D "
                         f"stencil whose df64 working set fits VMEM)")
                 if eligible:
                     return cg_resident_df64(

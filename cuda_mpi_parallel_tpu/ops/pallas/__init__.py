@@ -7,9 +7,11 @@ from .resident import (
     cg_resident_2d,
     cg_resident_3d,
     cg_resident_df64_2d,
+    cg_resident_df64_3d,
     supports_resident_2d,
     supports_resident_3d,
     supports_resident_df64_2d,
+    supports_resident_df64_3d,
     vmem_bytes,
 )
 from .stencil import (
@@ -25,9 +27,11 @@ __all__ = [
     "cg_resident_2d",
     "cg_resident_3d",
     "cg_resident_df64_2d",
+    "cg_resident_df64_3d",
     "supports_resident_2d",
     "supports_resident_3d",
     "supports_resident_df64_2d",
+    "supports_resident_df64_3d",
     "vmem_bytes",
     "pick_block_planes_3d",
     "pick_block_rows_2d",

@@ -38,6 +38,7 @@ Larger grids belong to the HBM-streaming slab kernel
 from __future__ import annotations
 
 import functools
+import math
 import os
 
 import jax
@@ -276,6 +277,41 @@ def _resident_kernel(nblocks, check_every, degree, stencil_fn,
                      ).astype(jnp.int32)
 
 
+def _check_grid_fits(shape, *, df64: bool, preconditioned: bool,
+                     interpret: bool) -> None:
+    """Shared entry gate of the four resident wrappers: raise unless the
+    grid fits the kernel it is about to launch (tiling + the SAME plane
+    budget the kernel's ``vmem_limit_bytes`` uses)."""
+    if interpret:
+        return
+    if len(shape) == 2:
+        ok = (supports_resident_df64_2d(*shape) if df64
+              else supports_resident_2d(*shape,
+                                        preconditioned=preconditioned))
+        tiling = "nx % 8 == 0, ny % 128 == 0"
+    else:
+        ok = (supports_resident_df64_3d(*shape) if df64
+              else supports_resident_3d(*shape,
+                                        preconditioned=preconditioned))
+        tiling = "ny % 8 == 0, nz % 128 == 0"
+    if not ok:
+        planes = (_PLANES_BOUND_DF64 if df64
+                  else _PLANES_BOUND + (2 if preconditioned else 0))
+        raise ValueError(
+            f"{shape} {'df64' if df64 else 'f32'} grid does not fit the "
+            f"resident kernel: needs {tiling} and {planes} * grid bytes "
+            f"<= {vmem_bytes()} (set {_ENV_OVERRIDE} to override the "
+            f"budget)")
+
+
+def _check_loop_args(check_every: int, precond_degree: int = 0) -> None:
+    if check_every < 1:
+        raise ValueError(f"check_every must be >= 1, got {check_every}")
+    if precond_degree < 0:
+        raise ValueError(
+            f"precond_degree must be >= 0, got {precond_degree}")
+
+
 @functools.partial(jax.jit, static_argnames=(
     "shape", "maxiter", "check_every", "degree", "interpret"))
 def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, *, shape,
@@ -291,9 +327,7 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, *, shape,
     stencil_fn = _shift_stencil if len(shape) == 2 else _shift_stencil_3d
     kernel = functools.partial(_resident_kernel, nblocks, check_every,
                                degree, stencil_fn)
-    cells = 1
-    for s in shape:
-        cells *= s
+    cells = math.prod(shape)
     x, iters, rr, indef, conv, health = pl.pallas_call(
         kernel,
         in_specs=[
@@ -374,26 +408,16 @@ def cg_resident_2d(scale, b2d, *, tol=0.0, rtol=0.0, maxiter=2000,
     b2d = jnp.asarray(b2d)
     if b2d.ndim != 2:
         raise ValueError(f"b2d must be 2-D (the grid), got {b2d.shape}")
-    nx, ny = b2d.shape
     if b2d.dtype != jnp.float32:
         raise ValueError(f"resident CG is float32-only, got {b2d.dtype}")
-    if not interpret and not supports_resident_2d(
-            nx, ny, preconditioned=precond_degree > 0):
-        raise ValueError(
-            f"({nx}, {ny}) f32 grid does not fit the resident kernel: "
-            f"needs nx % 8 == 0, ny % 128 == 0 and "
-            f"{_PLANES_BOUND + (2 if precond_degree > 0 else 0)} * grid "
-            f"bytes <= {vmem_bytes()} "
-            f"(set {_ENV_OVERRIDE} to override the budget)")
-    if check_every < 1:
-        raise ValueError(f"check_every must be >= 1, got {check_every}")
-    if precond_degree < 0:
-        raise ValueError(
-            f"precond_degree must be >= 0, got {precond_degree}")
+    _check_loop_args(check_every, precond_degree)
+    _check_grid_fits(b2d.shape, df64=False,
+                     preconditioned=precond_degree > 0,
+                     interpret=interpret)
     check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_call(
-        scale, tol, rtol, lmin, lmax, cap, b2d, shape=(nx, ny),
+        scale, tol, rtol, lmin, lmax, cap, b2d, shape=b2d.shape,
         maxiter=maxiter, check_every=check_every,
         degree=int(precond_degree), interpret=interpret)
 
@@ -423,26 +447,16 @@ def cg_resident_3d(scale, b3d, *, tol=0.0, rtol=0.0, maxiter=2000,
     b3d = jnp.asarray(b3d)
     if b3d.ndim != 3:
         raise ValueError(f"b3d must be 3-D (the grid), got {b3d.shape}")
-    nx, ny, nz = b3d.shape
     if b3d.dtype != jnp.float32:
         raise ValueError(f"resident CG is float32-only, got {b3d.dtype}")
-    if not interpret and not supports_resident_3d(
-            nx, ny, nz, preconditioned=precond_degree > 0):
-        raise ValueError(
-            f"({nx}, {ny}, {nz}) f32 grid does not fit the resident "
-            f"kernel: needs ny % 8 == 0, nz % 128 == 0 and "
-            f"{_PLANES_BOUND + (2 if precond_degree > 0 else 0)} * grid "
-            f"bytes <= {vmem_bytes()} "
-            f"(set {_ENV_OVERRIDE} to override the budget)")
-    if check_every < 1:
-        raise ValueError(f"check_every must be >= 1, got {check_every}")
-    if precond_degree < 0:
-        raise ValueError(
-            f"precond_degree must be >= 0, got {precond_degree}")
+    _check_loop_args(check_every, precond_degree)
+    _check_grid_fits(b3d.shape, df64=False,
+                     preconditioned=precond_degree > 0,
+                     interpret=interpret)
     check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_call(
-        scale, tol, rtol, lmin, lmax, cap, b3d, shape=(nx, ny, nz),
+        scale, tol, rtol, lmin, lmax, cap, b3d, shape=b3d.shape,
         maxiter=maxiter, check_every=check_every,
         degree=int(precond_degree), interpret=interpret)
 
@@ -471,30 +485,34 @@ def supports_resident_df64_2d(nx: int, ny: int, device=None) -> bool:
     return _PLANES_BOUND_DF64 * nx * ny * 4 <= vmem_bytes(device)
 
 
-def _fold2d_df(hi, lo):
-    """Reduce an (m, n) df64 plane pair to a scalar pair through pairwise
-    half-folding trees of full df64 adds - the in-kernel form of
-    ``ops.df64._fold_df`` (contiguous half-folds, never strided slices;
-    axis 0 then axis 1; odd extents zero-pad by one, exact for adds)."""
+def _fold_grid_df(hi, lo):
+    """Reduce a df64 grid pair (any rank) to a scalar pair through
+    pairwise half-folding trees of full df64 adds - the in-kernel form
+    of ``ops.df64._fold_df`` (contiguous half-folds, never strided
+    slices; axis by axis; odd extents zero-pad by one, exact for
+    adds)."""
     def fold_axis(h, l, axis):
         while h.shape[axis] > 1:
             m = h.shape[axis]
             half = (m + 1) // 2
             if m % 2:
-                zh = jnp.zeros_like(
-                    h[:1] if axis == 0 else h[:, :1])
+                one = [slice(None)] * h.ndim
+                one[axis] = slice(None, 1)
+                zh = jnp.zeros_like(h[tuple(one)])
                 h = jnp.concatenate([h, zh], axis)
                 l = jnp.concatenate([l, jnp.zeros_like(zh)], axis)
-            if axis == 0:
-                a, b = (h[:half], l[:half]), (h[half:], l[half:])
-            else:
-                a, b = (h[:, :half], l[:, :half]), (h[:, half:], l[:, half:])
-            h, l = df.add(a, b)
+            top = [slice(None)] * h.ndim
+            bot = [slice(None)] * h.ndim
+            top[axis] = slice(None, half)
+            bot[axis] = slice(half, None)
+            h, l = df.add((h[tuple(top)], l[tuple(top)]),
+                          (h[tuple(bot)], l[tuple(bot)]))
         return h, l
 
-    hi, lo = fold_axis(hi, lo, 0)
-    hi, lo = fold_axis(hi, lo, 1)
-    return hi[0, 0], lo[0, 0]
+    for axis in range(hi.ndim):
+        hi, lo = fold_axis(hi, lo, axis)
+    at0 = (0,) * hi.ndim
+    return hi[at0], lo[at0]
 
 
 def _dot_df(xh, xl, yh, yl):
@@ -504,7 +522,7 @@ def _dot_df(xh, xl, yh, yl):
     p, e = _two_prod(xh, yh)
     e = e + (xh * yl + xl * yh)
     hi, lo = _two_sum(p, e)
-    return _fold2d_df(hi, lo)
+    return _fold_grid_df(hi, lo)
 
 
 def _shift_stencil_df(uh, ul, scale_h, scale_l):
@@ -513,13 +531,29 @@ def _shift_stencil_df(uh, ul, scale_h, scale_l):
     is one df64 mul (``ops.df64.stencil2d_matvec`` semantics with the
     pad replaced by zero-filled shifts)."""
     acc = (4.0 * uh, 4.0 * ul)
-    for shift in (
-        lambda u: jnp.concatenate([u[1:], jnp.zeros_like(u[:1])], 0),
-        lambda u: jnp.concatenate([jnp.zeros_like(u[:1]), u[:-1]], 0),
-        lambda u: jnp.concatenate([u[:, 1:], jnp.zeros_like(u[:, :1])], 1),
-        lambda u: jnp.concatenate([jnp.zeros_like(u[:, :1]), u[:, :-1]], 1),
-    ):
-        acc = df.sub(acc, (shift(uh), shift(ul)))
+    for axis in (0, 1):
+        for s in _axis_shifts_pair(uh, ul, axis):
+            acc = df.sub(acc, s)
+    return df.mul((scale_h, scale_l), acc)
+
+
+def _axis_shifts_pair(uh, ul, axis):
+    """``_axis_shifts`` applied to an (hi, lo) pair: the shift moves both
+    words identically (exact), so the df64 value shifts exactly."""
+    fh, bh_ = _axis_shifts(uh, axis)
+    fl, bl_ = _axis_shifts(ul, axis)
+    return (fh, fl), (bh_, bl_)
+
+
+def _shift_stencil_df_3d(uh, ul, scale_h, scale_l):
+    """7-point df64 Laplacian (``ops.df64.stencil3d_matvec`` semantics):
+    ``6*u`` is NOT exact in f32 (6 = 2*3), so it is built as the exact
+    ``4*u + 2*u`` through a full df64 add; the six neighbor
+    subtractions and the scale follow the 2D form."""
+    acc = df.add((4.0 * uh, 4.0 * ul), (2.0 * uh, 2.0 * ul))
+    for axis in (0, 1, 2):
+        for s in _axis_shifts_pair(uh, ul, axis):
+            acc = df.sub(acc, s)
     return df.mul((scale_h, scale_l), acc)
 
 
@@ -535,7 +569,7 @@ def _safe_div_df(num, den):
             jnp.where(zero, jnp.zeros_like(q[1]), q[1]))
 
 
-def _resident_kernel_df64(nblocks, check_every,
+def _resident_kernel_df64(nblocks, check_every, stencil_df_fn,
                           params_ref, cap_ref, bh_ref, bl_ref,
                           xh_ref, xl_ref, iters_ref, rr_ref, indef_ref,
                           conv_ref, rh_ref, rl_ref, ph_ref, pl_ref,
@@ -576,7 +610,7 @@ def _resident_kernel_df64(nblocks, check_every,
 
             def one_iter(_, rr):
                 p = (ph_ref[:], pl_ref[:])
-                ap = _shift_stencil_df(p[0], p[1], scale[0], scale[1])
+                ap = stencil_df_fn(p[0], p[1], scale[0], scale[1])
                 pap = _dot_df(p[0], p[1], ap[0], ap[1])
                 state_i[1] = jnp.where(
                     (pap[0] <= 0.0) & (rr[0] > 0.0),
@@ -611,9 +645,9 @@ def _resident_kernel_df64(nblocks, check_every,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "nx", "ny", "maxiter", "check_every", "interpret"))
+    "shape", "maxiter", "check_every", "interpret"))
 def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, cap, bh, bl, *,
-                           nx, ny, maxiter, check_every, interpret):
+                           shape, maxiter, check_every, interpret):
     nblocks = -(-maxiter // check_every)
     params = jnp.stack([
         jnp.asarray(scale_h, jnp.float32),
@@ -621,7 +655,11 @@ def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, cap, bh, bl, *,
         jnp.asarray(tol, jnp.float32),
         jnp.asarray(rtol, jnp.float32)])
     cap_arr = jnp.asarray(cap, jnp.int32).reshape(1)
-    kernel = functools.partial(_resident_kernel_df64, nblocks, check_every)
+    stencil_df_fn = (_shift_stencil_df if len(shape) == 2
+                     else _shift_stencil_df_3d)
+    kernel = functools.partial(_resident_kernel_df64, nblocks, check_every,
+                               stencil_df_fn)
+    cells = math.prod(shape)
     vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     xh, xl, iters, rr, indef, conv = pl.pallas_call(
@@ -629,23 +667,23 @@ def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, cap, bh, bl, *,
         in_specs=[smem, smem, vmem, vmem],
         out_specs=[vmem, vmem, smem, smem, smem, smem],
         out_shape=[
-            jax.ShapeDtypeStruct((nx, ny), jnp.float32),   # x hi
-            jax.ShapeDtypeStruct((nx, ny), jnp.float32),   # x lo
+            jax.ShapeDtypeStruct(shape, jnp.float32),      # x hi
+            jax.ShapeDtypeStruct(shape, jnp.float32),      # x lo
             jax.ShapeDtypeStruct((1,), jnp.int32),         # iterations
             jax.ShapeDtypeStruct((2,), jnp.float32),       # ||r||^2 df64
             jax.ShapeDtypeStruct((1,), jnp.int32),         # indefinite
             jax.ShapeDtypeStruct((1,), jnp.int32),         # converged
         ],
         scratch_shapes=[
-            pltpu.VMEM((nx, ny), jnp.float32),             # r hi
-            pltpu.VMEM((nx, ny), jnp.float32),             # r lo
-            pltpu.VMEM((nx, ny), jnp.float32),             # p hi
-            pltpu.VMEM((nx, ny), jnp.float32),             # p lo
+            pltpu.VMEM(shape, jnp.float32),                # r hi
+            pltpu.VMEM(shape, jnp.float32),                # r lo
+            pltpu.VMEM(shape, jnp.float32),                # p hi
+            pltpu.VMEM(shape, jnp.float32),                # p lo
             pltpu.SMEM((2,), jnp.float32),                 # rr (hi, lo)
             pltpu.SMEM((2,), jnp.int32),                   # k, indefinite
         ],
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_PLANES_BOUND_DF64 * nx * ny * 4 + (1 << 20)),
+            vmem_limit_bytes=_PLANES_BOUND_DF64 * cells * 4 + (1 << 20)),
         interpret=interpret,
     )(params, cap_arr, bh, bl)
     return xh, xl, iters[0], (rr[0], rr[1]), indef[0], conv[0]
@@ -674,17 +712,42 @@ def cg_resident_df64_2d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
         raise ValueError(
             f"b_pair must be two equal (nx, ny) grids, got "
             f"{bh.shape} / {bl.shape}")
-    nx, ny = bh.shape
-    if not interpret and not supports_resident_df64_2d(nx, ny):
-        raise ValueError(
-            f"({nx}, {ny}) df64 grid does not fit the resident kernel: "
-            f"needs nx % 8 == 0, ny % 128 == 0 and "
-            f"{_PLANES_BOUND_DF64} * grid bytes <= {vmem_bytes()} "
-            f"(set {_ENV_OVERRIDE} to override the budget)")
-    if check_every < 1:
-        raise ValueError(f"check_every must be >= 1, got {check_every}")
+    _check_loop_args(check_every)
+    _check_grid_fits(bh.shape, df64=True, preconditioned=False,
+                     interpret=interpret)
     check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_df64_call(
-        scale[0], scale[1], tol, rtol, cap, bh, bl, nx=nx, ny=ny,
+        scale[0], scale[1], tol, rtol, cap, bh, bl, shape=bh.shape,
+        maxiter=maxiter, check_every=check_every, interpret=interpret)
+
+
+def supports_resident_df64_3d(nx: int, ny: int, nz: int,
+                              device=None) -> bool:
+    """3D form of :func:`supports_resident_df64_2d`: trailing-axes
+    tiling plus the df64 plane-count bound."""
+    if ny % 8 != 0 or nz % 128 != 0 or nx < 1:
+        return False
+    return _PLANES_BOUND_DF64 * nx * ny * nz * 4 <= vmem_bytes(device)
+
+
+def cg_resident_df64_3d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
+                        check_every=32, iter_cap=None, interpret=False):
+    """The 7-point-stencil form of :func:`cg_resident_df64_2d`: same
+    kernel and return contract with the df64 3D Laplacian
+    (``ops.df64.stencil3d_matvec`` semantics - ``6*u`` built as the
+    exact ``4*u + 2*u``)."""
+    bh = jnp.asarray(b_pair[0], jnp.float32)
+    bl = jnp.asarray(b_pair[1], jnp.float32)
+    if bh.ndim != 3 or bh.shape != bl.shape:
+        raise ValueError(
+            f"b_pair must be two equal (nx, ny, nz) grids, got "
+            f"{bh.shape} / {bl.shape}")
+    _check_loop_args(check_every)
+    _check_grid_fits(bh.shape, df64=True, preconditioned=False,
+                     interpret=interpret)
+    check_every = min(check_every, maxiter)
+    cap = maxiter if iter_cap is None else iter_cap
+    return _cg_resident_df64_call(
+        scale[0], scale[1], tol, rtol, cap, bh, bl, shape=bh.shape,
         maxiter=maxiter, check_every=check_every, interpret=interpret)

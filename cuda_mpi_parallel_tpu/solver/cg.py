@@ -746,12 +746,16 @@ def solve(
         from ..models.operators import _pallas_interpret
         from .resident import cg_resident, resident_eligible
 
-        eligible = (resident_eligible(
-            a, b, m, method=method, record_history=record_history,
-            x0=x0, resume_from=resume_from,
-            return_checkpoint=return_checkpoint, compensated=compensated)
-            and (engine == "resident"
-                 or jax.default_backend() == "tpu"))
+        # Cheap backend gate first: resident_eligible's Chebyshev scale
+        # comparison is a device sync, pointless off-TPU under "auto".
+        eligible = ((engine == "resident"
+                     or jax.default_backend() == "tpu")
+                    and resident_eligible(
+                        a, b, m, method=method,
+                        record_history=record_history, x0=x0,
+                        resume_from=resume_from,
+                        return_checkpoint=return_checkpoint,
+                        compensated=compensated))
         if engine == "resident" and not eligible:
             raise ValueError(
                 "engine='resident' needs a float32 2D/3D stencil whose "

@@ -8,14 +8,18 @@ on TPU v5e at 1024x1024 f32 (BASELINE config #2): 6.65 us/iteration -
 cost ~4 HBM passes per iteration) and ~35x the derived estimate for the
 reference's host-synchronous loop (``CUDACG.cu:269-352``).
 
-Scope: matrix-free 2D 5-point stencils (``Stencil2D``), float32, x0 = 0,
-unpreconditioned ``method="cg"``, no residual history.  Everything else
-routes through ``solver.cg`` - the general path exists precisely so the
-fast path can stay narrow.  Trajectory parity with the general solver is
-exact in iteration counts (2688 == 2688 at 1M unknowns, tol 1e-4) with
-iterates agreeing to f32 reduction-order rounding (~3e-6 relative).
+Scope: matrix-free 5/7-point stencils (``Stencil2D``/``Stencil3D``,
+grids fitting VMEM), float32 (or df64 via ``cg_resident_df64``), x0 = 0,
+``method="cg"``, ``m`` ``None`` or in-kernel Chebyshev, no residual
+history.  Everything else routes through ``solver.cg`` - the general
+path exists precisely so the fast path can stay narrow.  Trajectory
+parity with the general solver is exact in iteration counts (2688 ==
+2688 at 1M unknowns, tol 1e-4) with iterates agreeing to f32
+reduction-order rounding (~3e-6 relative).
 """
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -27,9 +31,11 @@ from ..ops.pallas.resident import (
     cg_resident_2d,
     cg_resident_3d,
     cg_resident_df64_2d,
+    cg_resident_df64_3d,
     supports_resident_2d,
     supports_resident_3d,
     supports_resident_df64_2d,
+    supports_resident_df64_3d,
 )
 from .cg import CGResult
 from .df64 import DF64CGResult
@@ -88,7 +94,7 @@ def resident_eligible(a, b=None, m=None, *, method: str = "cg",
     engine?" - shared by ``solve(engine=...)`` and the CLI so the two
     cannot drift.
 
-    Checks the operator (f32 2D stencil fitting VMEM, preconditioned
+    Checks the operator (f32 2D/3D stencil fitting VMEM, preconditioned
     budget included), the rhs dtype (f32 - the general path casts other
     dtypes, the kernel does not), the preconditioner (``None`` or a
     ``ChebyshevPreconditioner`` verifiably built over ``a``), and the
@@ -173,9 +179,7 @@ def cg_resident(
                 "same scale)")
         degree, lmin, lmax = m.degree, m.lmin, m.lmax
     grid = a.grid
-    n_cells = 1
-    for s in grid:
-        n_cells *= s
+    n_cells = math.prod(grid)
     b = jnp.asarray(b)
     flat_in = b.ndim == 1
     if flat_in:
@@ -217,13 +221,16 @@ def cg_resident(
 
 
 def supports_resident_df64(a) -> bool:
-    """True if ``cg_resident_df64`` can run this operator: a 2D stencil
-    whose df64 working set (8 pinned hi/lo planes + temporaries) fits
-    the device VMEM budget."""
-    if not isinstance(a, Stencil2D):
-        return False
-    nx, ny = a.grid
-    return supports_resident_df64_2d(nx, ny)
+    """True if ``cg_resident_df64`` can run this operator: a 2D/3D
+    stencil whose df64 working set (8 pinned hi/lo planes +
+    temporaries) fits the device VMEM budget."""
+    if isinstance(a, Stencil2D):
+        nx, ny = a.grid
+        return supports_resident_df64_2d(nx, ny)
+    if isinstance(a, Stencil3D):
+        nx, ny, nz = a.grid
+        return supports_resident_df64_3d(nx, ny, nz)
+    return False
 
 
 def cg_resident_df64(
@@ -252,12 +259,13 @@ def cg_resident_df64(
     flat ``(n,)`` or grid ``(nx, ny)`` shapes are accepted, and the
     solution comes back flat (``DF64CGResult.x()`` recombines to f64).
     """
-    if not isinstance(a, Stencil2D):
+    if not isinstance(a, (Stencil2D, Stencil3D)):
         raise TypeError(
-            f"cg_resident_df64 needs a Stencil2D operator, got "
-            f"{type(a).__name__} - use solver.df64.cg_df64 for general "
-            f"operators")
-    nx, ny = a.grid
+            f"cg_resident_df64 needs a Stencil2D or Stencil3D operator, "
+            f"got {type(a).__name__} - use solver.df64.cg_df64 for "
+            f"general operators")
+    grid = a.grid
+    n_cells = math.prod(grid)
 
     if isinstance(b, tuple):
         bh, bl = (np.asarray(b[0], np.float32), np.asarray(b[1], np.float32))
@@ -269,18 +277,20 @@ def cg_resident_df64(
             bh = b_np.astype(np.float32)
             bl = np.zeros_like(bh)
     if bh.ndim == 1:
-        if bh.shape[0] != nx * ny:
-            raise ValueError(f"rhs length {bh.shape[0]} != grid {nx}x{ny}")
-        bh, bl = bh.reshape(nx, ny), bl.reshape(nx, ny)
-    elif bh.shape != (nx, ny):
-        raise ValueError(f"rhs shape {bh.shape} != grid ({nx}, {ny})")
+        if bh.shape[0] != n_cells:
+            raise ValueError(f"rhs length {bh.shape[0]} != grid {grid}")
+        bh, bl = bh.reshape(grid), bl.reshape(grid)
+    elif bh.shape != grid:
+        raise ValueError(f"rhs shape {bh.shape} != grid {grid}")
 
     # re-split the scale from host f64 so non-exact scales keep their
     # low word (same as solver.df64._prepare_operator)
     scale64 = np.float64(np.asarray(a.scale, dtype=np.float64))
     sh, sl = df.split_f64(scale64)
 
-    xh, xl, iters, rr, indef, conv = cg_resident_df64_2d(
+    kernel_fn = (cg_resident_df64_2d if len(grid) == 2
+                 else cg_resident_df64_3d)
+    xh, xl, iters, rr, indef, conv = kernel_fn(
         (sh, sl), (bh, bl), tol=tol, rtol=rtol, maxiter=maxiter,
         check_every=check_every, iter_cap=iter_cap, interpret=interpret)
 

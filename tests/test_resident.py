@@ -436,11 +436,27 @@ class TestDF64Resident:
         monkeypatch.setenv(rk._ENV_OVERRIDE, str(1 << 20))
         assert not rk.supports_resident_df64_2d(1024, 1024)
         op3 = Stencil3D.create(8, 8, 128, dtype=jnp.float32)
-        assert not supports_resident_df64(op3)
-        with pytest.raises(TypeError, match="Stencil2D"):
-            cg_resident_df64(op3, np.zeros(8 * 8 * 128), interpret=True)
+        assert supports_resident_df64(op3)
+        assert not rk.supports_resident_df64_3d(8, 10, 128)
+        from cuda_mpi_parallel_tpu.models import random_spd
+
+        dense = random_spd.random_spd_dense(8, dtype=np.float32)
+        assert not supports_resident_df64(dense)
+        with pytest.raises(TypeError, match="Stencil"):
+            cg_resident_df64(dense, np.zeros(8), interpret=True)
         with pytest.raises(ValueError, match="grid"):
             cg_resident_df64(op, np.zeros(17), interpret=True)
+
+    def test_3d_trajectory_matches_cg_df64(self):
+        op = Stencil3D.create(4, 8, 128, dtype=jnp.float32)
+        rng = np.random.default_rng(2)
+        b64 = rng.standard_normal(4 * 8 * 128)
+        ref = cg_df64(op, b64, tol=0.0, maxiter=16, check_every=8)
+        res = cg_resident_df64(op, b64, tol=0.0, maxiter=16,
+                               check_every=8, interpret=True)
+        assert int(res.iterations) == int(ref.iterations)
+        rel = np.abs(res.x() - ref.x()).max() / np.abs(ref.x()).max()
+        assert rel < 1e-11, rel
 
     def test_f32_rhs_lifted(self):
         op, b64 = self._problem()
