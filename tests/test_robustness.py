@@ -52,6 +52,24 @@ class TestDebugNans:
                           maxiter=100, m=m))
         assert bool(res.converged)
 
+    def test_resident_past_exact_convergence(self):
+        """The resident kernel's in-SMEM freeze (_safe_div analogue)
+        must hold under debug-NaNs too, including iterations running
+        past an exact solve inside a check block."""
+        from cuda_mpi_parallel_tpu import cg_resident
+
+        nx, ny = 8, 128
+        op = poisson.poisson_2d_operator(nx, ny, dtype=jnp.float32)
+        x_true = np.zeros((nx, ny), np.float32)
+        x_true[4, 64] = 1.0
+        b = jnp.asarray(np.asarray(
+            op.matvec(jnp.asarray(x_true.ravel()))).reshape(nx, ny))
+        res = self._with_debug_nans(
+            lambda: cg_resident(op, b, tol=1e-6, maxiter=200,
+                                check_every=8, interpret=True))
+        assert bool(res.converged)
+        assert np.all(np.isfinite(np.asarray(res.x)))
+
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 class TestShardCountInvariance:
