@@ -102,21 +102,30 @@ def vmem_bytes(device=None) -> int:
     return _VMEM_FALLBACK
 
 
+def _extra_planes(preconditioned: bool, warm_start: bool) -> int:
+    """Plane-count surcharges over ``_PLANES_BOUND``: the Chebyshev
+    recurrence's two transients, and the pinned x0 input of a warm
+    start.  Every gate and every kernel ``vmem_limit_bytes`` computes
+    its budget through this one function so they cannot diverge."""
+    return (2 if preconditioned else 0) + (1 if warm_start else 0)
+
+
 def supports_resident_2d(nx: int, ny: int, itemsize: int = 4,
-                         device=None, preconditioned: bool = False) -> bool:
+                         device=None, preconditioned: bool = False,
+                         warm_start: bool = False) -> bool:
     """True if an (nx, ny) grid's CG working set fits the resident kernel.
 
     Tiling needs ``nx % 8 == 0 and ny % 128 == 0`` (f32 (8,128) tiles);
     capacity needs ``_PLANES_BOUND`` planes within the VMEM budget -
-    plus the Chebyshev recurrence's two transient planes when
-    ``preconditioned`` (the gate must match the kernel's own
-    ``vmem_limit_bytes`` or it admits grids the compiler then rejects).
+    plus ``_extra_planes`` for Chebyshev/warm-start (the gate must match
+    the kernel's own ``vmem_limit_bytes`` or it admits grids the
+    compiler then rejects).
     """
     if nx % 8 != 0 or ny % 128 != 0:
         return False
     if itemsize != 4:
         return False  # f32 only: df64/other dtypes take the general path
-    planes = _PLANES_BOUND + (2 if preconditioned else 0)
+    planes = _PLANES_BOUND + _extra_planes(preconditioned, warm_start)
     return planes * nx * ny * itemsize <= vmem_bytes(device)
 
 
@@ -158,10 +167,14 @@ def _shift_stencil_3d(u, scale):
     return scale * acc
 
 
-def _resident_kernel(nblocks, check_every, degree, stencil_fn,
-                     params_ref, cap_ref, b_ref,
-                     x_ref, iters_ref, rr_ref, indef_ref, conv_ref,
-                     health_ref, r_ref, p_ref, state_f, state_i):
+def _resident_kernel(nblocks, check_every, degree, stencil_fn, has_x0,
+                     params_ref, cap_ref, *refs):
+    if has_x0:
+        (b_ref, x0_ref, x_ref, iters_ref, rr_ref, indef_ref, conv_ref,
+         health_ref, r_ref, p_ref, state_f, state_i) = refs
+    else:
+        (b_ref, x_ref, iters_ref, rr_ref, indef_ref, conv_ref,
+         health_ref, r_ref, p_ref, state_f, state_i) = refs
     scale = params_ref[0]
     tol = params_ref[1]
     rtol = params_ref[2]
@@ -189,15 +202,23 @@ def _resident_kernel(nblocks, check_every, degree, stencil_fn,
         return z
 
     b = b_ref[:]
-    x_ref[:] = jnp.zeros_like(b)            # explicit x0 = 0 (quirk Q6)
-    r_ref[:] = b                            # r0 = b  (CUDACG.cu:248)
-    rr0 = jnp.sum(b * b)                    # CUDACG.cu:261-266
-    if degree > 0:
-        z0 = precond(b)
-        p_ref[:] = z0                       # p0 = z0 (preconditioned init)
-        rho0 = jnp.sum(b * z0)              # rho = r . z
+    if has_x0:
+        # general init: r0 = b - A x0 (solver.cg's nonzero-x0 extension
+        # of the reference's copy-only x0 = 0 fast path)
+        x0 = x0_ref[:]
+        x_ref[:] = x0
+        r0 = b - stencil_fn(x0, scale)
     else:
-        p_ref[:] = b                        # p0 = r0 (CUDACG.cu:255)
+        x_ref[:] = jnp.zeros_like(b)        # explicit x0 = 0 (quirk Q6)
+        r0 = b                              # r0 = b  (CUDACG.cu:248)
+    r_ref[:] = r0
+    rr0 = jnp.sum(r0 * r0)                  # CUDACG.cu:261-266
+    if degree > 0:
+        z0 = precond(r0)
+        p_ref[:] = z0                       # p0 = z0 (preconditioned init)
+        rho0 = jnp.sum(r0 * z0)             # rho = r . z
+    else:
+        p_ref[:] = r0                       # p0 = r0 (CUDACG.cu:255)
         rho0 = rr0
     thresh = jnp.maximum(tol, rtol * jnp.sqrt(rr0))
     thresh2 = thresh * thresh
@@ -277,8 +298,27 @@ def _resident_kernel(nblocks, check_every, degree, stencil_fn,
                      ).astype(jnp.int32)
 
 
+def _coerce_x0(x0, b_grid):
+    """Validate an optional warm-start x0 against the rhs grid: exactly
+    the rhs's accepted shapes - flat ``(n,)`` or the exact grid shape -
+    so a transposed/mis-shaped x0 is rejected, not silently
+    reinterpreted."""
+    if x0 is None:
+        return None
+    x0 = jnp.asarray(x0)
+    if x0.ndim == 1 and x0.shape[0] == math.prod(b_grid.shape):
+        x0 = x0.reshape(b_grid.shape)
+    elif x0.shape != b_grid.shape:
+        raise ValueError(
+            f"x0 shape {x0.shape} matches neither the grid "
+            f"{b_grid.shape} nor its flat length")
+    if x0.dtype != jnp.float32:
+        raise ValueError(f"x0 must be float32, got {x0.dtype}")
+    return x0
+
+
 def _check_grid_fits(shape, *, df64: bool, preconditioned: bool,
-                     interpret: bool) -> None:
+                     interpret: bool, warm_start: bool = False) -> None:
     """Shared entry gate of the four resident wrappers: raise unless the
     grid fits the kernel it is about to launch (tiling + the SAME plane
     budget the kernel's ``vmem_limit_bytes`` uses)."""
@@ -287,16 +327,19 @@ def _check_grid_fits(shape, *, df64: bool, preconditioned: bool,
     if len(shape) == 2:
         ok = (supports_resident_df64_2d(*shape) if df64
               else supports_resident_2d(*shape,
-                                        preconditioned=preconditioned))
+                                        preconditioned=preconditioned,
+                                        warm_start=warm_start))
         tiling = "nx % 8 == 0, ny % 128 == 0"
     else:
         ok = (supports_resident_df64_3d(*shape) if df64
               else supports_resident_3d(*shape,
-                                        preconditioned=preconditioned))
+                                        preconditioned=preconditioned,
+                                        warm_start=warm_start))
         tiling = "ny % 8 == 0, nz % 128 == 0"
     if not ok:
         planes = (_PLANES_BOUND_DF64 if df64
-                  else _PLANES_BOUND + (2 if preconditioned else 0))
+                  else _PLANES_BOUND
+                  + _extra_planes(preconditioned, warm_start))
         raise ValueError(
             f"{shape} {'df64' if df64 else 'f32'} grid does not fit the "
             f"resident kernel: needs {tiling} and {planes} * grid bytes "
@@ -314,8 +357,8 @@ def _check_loop_args(check_every: int, precond_degree: int = 0) -> None:
 
 @functools.partial(jax.jit, static_argnames=(
     "shape", "maxiter", "check_every", "degree", "interpret"))
-def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, *, shape,
-                      maxiter, check_every, degree, interpret):
+def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
+                      *, shape, maxiter, check_every, degree, interpret):
     nblocks = -(-maxiter // check_every)
     params = jnp.stack([
         jnp.asarray(scale, jnp.float32),
@@ -325,16 +368,17 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, *, shape,
         jnp.asarray(lmax, jnp.float32)])
     cap_arr = jnp.asarray(cap, jnp.int32).reshape(1)
     stencil_fn = _shift_stencil if len(shape) == 2 else _shift_stencil_3d
+    has_x0 = x0_grid is not None
     kernel = functools.partial(_resident_kernel, nblocks, check_every,
-                               degree, stencil_fn)
+                               degree, stencil_fn, has_x0)
     cells = math.prod(shape)
+    grid_inputs = (b_grid,) if x0_grid is None else (b_grid, x0_grid)
     x, iters, rr, indef, conv, health = pl.pallas_call(
         kernel,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # params [scale,tol,rtol]
             pl.BlockSpec(memory_space=pltpu.SMEM),   # iteration cap
-            pl.BlockSpec(memory_space=pltpu.VMEM),   # b
-        ],
+        ] + [pl.BlockSpec(memory_space=pltpu.VMEM)] * len(grid_inputs),
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),   # x
             pl.BlockSpec(memory_space=pltpu.SMEM),   # iterations
@@ -363,16 +407,17 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, *, shape,
         # +2 planes for the Chebyshev recurrence's z/d transients -
         # supports_resident_*(preconditioned=True) gates on the same).
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=(_PLANES_BOUND + (2 if degree else 0))
+            vmem_limit_bytes=(_PLANES_BOUND
+                              + _extra_planes(degree > 0, has_x0))
             * cells * 4 + (1 << 20)),
         interpret=interpret,
-    )(params, cap_arr, b_grid)
+    )(params, cap_arr, *grid_inputs)
     return x, iters[0], rr[0], indef[0], conv[0], health[0]
 
 
-def cg_resident_2d(scale, b2d, *, tol=0.0, rtol=0.0, maxiter=2000,
-                   check_every=32, iter_cap=None, interpret=False,
-                   precond_degree=0, lmin=0.0, lmax=1.0):
+def cg_resident_2d(scale, b2d, *, x0=None, tol=0.0, rtol=0.0,
+                   maxiter=2000, check_every=32, iter_cap=None,
+                   interpret=False, precond_degree=0, lmin=0.0, lmax=1.0):
     """Run the whole CG solve for the 5-point stencil in one pallas kernel.
 
     Args:
@@ -411,19 +456,21 @@ def cg_resident_2d(scale, b2d, *, tol=0.0, rtol=0.0, maxiter=2000,
     if b2d.dtype != jnp.float32:
         raise ValueError(f"resident CG is float32-only, got {b2d.dtype}")
     _check_loop_args(check_every, precond_degree)
+    x0 = _coerce_x0(x0, b2d)
     _check_grid_fits(b2d.shape, df64=False,
                      preconditioned=precond_degree > 0,
-                     interpret=interpret)
+                     interpret=interpret, warm_start=x0 is not None)
     check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_call(
-        scale, tol, rtol, lmin, lmax, cap, b2d, shape=b2d.shape,
+        scale, tol, rtol, lmin, lmax, cap, b2d, x0, shape=b2d.shape,
         maxiter=maxiter, check_every=check_every,
         degree=int(precond_degree), interpret=interpret)
 
 
 def supports_resident_3d(nx: int, ny: int, nz: int, itemsize: int = 4,
-                         device=None, preconditioned: bool = False) -> bool:
+                         device=None, preconditioned: bool = False,
+                         warm_start: bool = False) -> bool:
     """True if an (nx, ny, nz) grid's CG working set fits the resident
     kernel: ``ny % 8 == 0 and nz % 128 == 0`` (the trailing two axes
     carry the (8, 128) f32 tiles; the leading plane axis is free) plus
@@ -432,13 +479,13 @@ def supports_resident_3d(nx: int, ny: int, nz: int, itemsize: int = 4,
         return False
     if itemsize != 4:
         return False
-    planes = _PLANES_BOUND + (2 if preconditioned else 0)
+    planes = _PLANES_BOUND + _extra_planes(preconditioned, warm_start)
     return planes * nx * ny * nz * itemsize <= vmem_bytes(device)
 
 
-def cg_resident_3d(scale, b3d, *, tol=0.0, rtol=0.0, maxiter=2000,
-                   check_every=32, iter_cap=None, interpret=False,
-                   precond_degree=0, lmin=0.0, lmax=1.0):
+def cg_resident_3d(scale, b3d, *, x0=None, tol=0.0, rtol=0.0,
+                   maxiter=2000, check_every=32, iter_cap=None,
+                   interpret=False, precond_degree=0, lmin=0.0, lmax=1.0):
     """The 7-point-stencil (``Stencil3D``) form of :func:`cg_resident_2d`:
     same kernel, same semantics and return contract, with the 3D
     shifted-add Laplacian - for 3D grids small enough to pin in VMEM
@@ -450,13 +497,14 @@ def cg_resident_3d(scale, b3d, *, tol=0.0, rtol=0.0, maxiter=2000,
     if b3d.dtype != jnp.float32:
         raise ValueError(f"resident CG is float32-only, got {b3d.dtype}")
     _check_loop_args(check_every, precond_degree)
+    x0 = _coerce_x0(x0, b3d)
     _check_grid_fits(b3d.shape, df64=False,
                      preconditioned=precond_degree > 0,
-                     interpret=interpret)
+                     interpret=interpret, warm_start=x0 is not None)
     check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_call(
-        scale, tol, rtol, lmin, lmax, cap, b3d, shape=b3d.shape,
+        scale, tol, rtol, lmin, lmax, cap, b3d, x0, shape=b3d.shape,
         maxiter=maxiter, check_every=check_every,
         degree=int(precond_degree), interpret=interpret)
 

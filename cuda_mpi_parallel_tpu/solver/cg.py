@@ -734,8 +734,8 @@ def solve(
     VMEM-resident engine, ``solver.resident`` - raises if the problem is
     outside its scope), or ``"auto"`` (resident when eligible on a
     compiled TPU backend - f32 2D/3D stencil fitting VMEM, ``m``
-    ``None`` or Chebyshev, ``method="cg"``, default ``x0``, no history/
-    checkpointing - otherwise general).
+    ``None`` or Chebyshev, ``method="cg"``, f32 ``x0`` or none, no
+    history/checkpointing - otherwise general).
     """
     if engine not in ("general", "auto", "resident"):
         raise ValueError(f"unknown engine {engine!r}; expected 'general', "
@@ -761,12 +761,14 @@ def solve(
                 "engine='resident' needs a float32 2D/3D stencil whose "
                 "CG working set fits VMEM, a float32 rhs, m=None or a "
                 "Chebyshev preconditioner built over this operator, "
-                "method='cg', default x0, and no history/checkpointing "
-                "- use engine='general' (or 'auto') otherwise")
+                "method='cg', f32 x0 or none, and no history/"
+                "checkpointing - use engine='general' (or 'auto') "
+                "otherwise")
         if eligible:
-            return cg_resident(a, b, tol=tol, rtol=rtol, maxiter=maxiter,
-                               check_every=check_every, iter_cap=iter_cap,
-                               m=m, interpret=_pallas_interpret())
+            return cg_resident(a, b, x0, tol=tol, rtol=rtol,
+                               maxiter=maxiter, check_every=check_every,
+                               iter_cap=iter_cap, m=m,
+                               interpret=_pallas_interpret())
     b = jnp.asarray(b)
     if not jnp.issubdtype(b.dtype, jnp.floating):
         b = b.astype(jnp.result_type(float))

@@ -42,24 +42,27 @@ from .df64 import DF64CGResult
 from .status import CGStatus
 
 
-def supports_resident(a, preconditioned: bool = False) -> bool:
+def supports_resident(a, preconditioned: bool = False,
+                      warm_start: bool = False) -> bool:
     """True if ``cg_resident`` can run this operator (see module scope).
 
     ``preconditioned`` budgets the in-kernel Chebyshev recurrence's two
-    extra transient planes.
+    extra transient planes; ``warm_start`` budgets the pinned x0 plane.
     """
     if isinstance(a, Stencil2D):
         if a.dtype != jnp.float32:
             return False
         nx, ny = a.grid
         return supports_resident_2d(nx, ny, itemsize=4,
-                                    preconditioned=preconditioned)
+                                    preconditioned=preconditioned,
+                                    warm_start=warm_start)
     if isinstance(a, Stencil3D):
         if a.dtype != jnp.float32:
             return False
         nx, ny, nz = a.grid
         return supports_resident_3d(nx, ny, nz, itemsize=4,
-                                    preconditioned=preconditioned)
+                                    preconditioned=preconditioned,
+                                    warm_start=warm_start)
     return False
 
 
@@ -98,8 +101,8 @@ def resident_eligible(a, b=None, m=None, *, method: str = "cg",
     budget included), the rhs dtype (f32 - the general path casts other
     dtypes, the kernel does not), the preconditioner (``None`` or a
     ``ChebyshevPreconditioner`` verifiably built over ``a``), and the
-    feature set the one-kernel solve supports (``method="cg"``, default
-    ``x0``, no history / checkpointing / compensated dots).
+    feature set the one-kernel solve supports (``method="cg"``, f32
+    ``x0`` or none, no history / checkpointing / compensated dots).
     """
     from ..models.precond import ChebyshevPreconditioner
 
@@ -108,13 +111,16 @@ def resident_eligible(a, b=None, m=None, *, method: str = "cg",
         return False
     # operator gate FIRST: _chebyshev_match_status reads grid/scale,
     # which only stencil operators have
-    if not supports_resident(a, preconditioned=chebyshev):
+    if not supports_resident(a, preconditioned=chebyshev,
+                             warm_start=x0 is not None):
         return False
     if chebyshev and _chebyshev_match_status(a, m) != "match":
         return False
-    if (method != "cg" or record_history or x0 is not None
+    if (method != "cg" or record_history
             or resume_from is not None or return_checkpoint
             or compensated):
+        return False
+    if x0 is not None and jnp.asarray(x0).dtype != jnp.float32:
         return False
     if b is not None and jnp.asarray(b).dtype != jnp.float32:
         return False
@@ -124,6 +130,7 @@ def resident_eligible(a, b=None, m=None, *, method: str = "cg",
 def cg_resident(
     a: Stencil2D,
     b: jax.Array,
+    x0=None,
     *,
     tol: float = 1e-7,
     rtol: float = 0.0,
@@ -136,9 +143,11 @@ def cg_resident(
     """Solve ``A x = b`` entirely inside one VMEM-resident pallas kernel.
 
     Arguments mirror ``solver.cg`` (absolute-``tol`` reference semantics,
-    quirk Q3; ``rtol`` relative option; traced ``iter_cap``); ``x0`` is
-    fixed at zero (the reference's init fast path, ``CUDACG.cu:247-259``)
-    and residual history is unsupported - use ``solver.cg`` for it.
+    quirk Q3; ``rtol`` relative option; traced ``iter_cap``).  ``x0``
+    ``None`` takes the reference's copy-only init fast path
+    (``CUDACG.cu:247-259``); a nonzero ``x0`` warm-starts with the
+    general ``r0 = b - A x0`` init (one extra in-kernel stencil apply).
+    Residual history is unsupported - use ``solver.cg`` for it.
     ``m`` accepts ``None`` or a ``ChebyshevPreconditioner`` built over
     THIS operator: its polynomial is applied in-kernel (pure VPU work on
     the resident planes - ``degree - 1`` extra stencil applies per
@@ -190,6 +199,7 @@ def cg_resident(
         if b.shape != grid:
             raise ValueError(f"rhs shape {b.shape} != grid {grid}")
         b_grid = b
+
     if b_grid.dtype != jnp.float32:
         raise ValueError(
             f"cg_resident is float32-only (got {b_grid.dtype}); df64/x64 "
@@ -197,7 +207,7 @@ def cg_resident(
 
     kernel_fn = cg_resident_2d if len(grid) == 2 else cg_resident_3d
     x2d, iters, rr, indef, conv, health = kernel_fn(
-        a.scale, b_grid, tol=tol, rtol=rtol, maxiter=maxiter,
+        a.scale, b_grid, x0=x0, tol=tol, rtol=rtol, maxiter=maxiter,
         check_every=check_every, iter_cap=iter_cap, interpret=interpret,
         precond_degree=degree, lmin=lmin, lmax=lmax)
 

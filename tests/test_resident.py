@@ -65,6 +65,35 @@ class TestParityVsGeneralSolver:
         assert (float(res.residual_norm)
                 <= 1e-4 * np.linalg.norm(b.ravel()) + 1e-12)
 
+    def test_x0_warm_start_matches_general(self):
+        from cuda_mpi_parallel_tpu.solver.cg import cg as _cg
+
+        op, b = _grid_problem()
+        rng = np.random.default_rng(5)
+        x0 = (rng.standard_normal(16 * 128) * 0.1).astype(np.float32)
+        ref = _cg(op, jnp.asarray(b.ravel()), jnp.asarray(x0), tol=1e-5,
+                  maxiter=500, check_every=8)
+        res = cg_resident(op, jnp.asarray(b), jnp.asarray(x0), tol=1e-5,
+                          maxiter=500, check_every=8, interpret=True)
+        assert int(res.iterations) == int(ref.iterations)
+        np.testing.assert_allclose(np.asarray(res.x).ravel(),
+                                   np.asarray(ref.x), rtol=0, atol=1e-5)
+        # warm start via solve(engine=) too
+        res2 = solve(op, jnp.asarray(b.ravel()), jnp.asarray(x0),
+                     tol=1e-5, maxiter=500, check_every=8,
+                     engine="resident")
+        assert int(res2.iterations) == int(ref.iterations)
+
+    def test_x0_exact_solution_converges_immediately(self):
+        op, b = _grid_problem()
+        x_true = np.asarray(
+            solve(op, jnp.asarray(b.ravel()), tol=1e-6, maxiter=1000).x)
+        res = cg_resident(op, jnp.asarray(b), jnp.asarray(x_true),
+                          tol=1e-4, maxiter=100, check_every=4,
+                          interpret=True)
+        assert bool(res.converged)
+        assert int(res.iterations) <= 4
+
     def test_scale_is_applied(self):
         nx, ny = 16, 128
         op = Stencil2D.create(nx, ny, scale=3.0, dtype=jnp.float32)
