@@ -104,10 +104,14 @@ def vmem_bytes(device=None) -> int:
 
 def _extra_planes(preconditioned: bool, warm_start: bool) -> int:
     """Plane-count surcharges over ``_PLANES_BOUND``: the Chebyshev
-    recurrence's two transients, and the pinned x0 input of a warm
-    start.  Every gate and every kernel ``vmem_limit_bytes`` computes
-    its budget through this one function so they cannot diverge."""
-    return (2 if preconditioned else 0) + (1 if warm_start else 0)
+    recurrence's two transients.  A warm start costs NO extra plane -
+    the x0 input aliases the x output buffer (``input_output_aliases``
+    in ``_cg_resident_call``; the kernel reads x0 once at init and
+    immediately overwrites it with the seeded x).  Every gate and every
+    kernel ``vmem_limit_bytes`` computes its budget through this one
+    function so they cannot diverge."""
+    del warm_start  # plane-neutral via aliasing; kept for call clarity
+    return 2 if preconditioned else 0
 
 
 def supports_resident_2d(nx: int, ny: int, itemsize: int = 4,
@@ -401,6 +405,12 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
             pltpu.SMEM((2,), jnp.float32),           # rr, rho
             pltpu.SMEM((2,), jnp.int32),             # k, indefinite
         ],
+        # The warm-start x0 input (input index 3) aliases the x output:
+        # the kernel reads x0 exactly once at init and immediately seeds
+        # x from it, so sharing the buffer is safe and keeps warm start
+        # plane-neutral (XLA inserts a copy if the caller's x0 is still
+        # live - correctness never depends on the donation landing).
+        input_output_aliases=({3: 0} if has_x0 else {}),
         # The default scoped-vmem limit (16 MiB) is sized for streaming
         # kernels; residency is the point here, so lift it to the gated
         # footprint bound (+1 MiB slack for Mosaic's own temporaries;
@@ -423,8 +433,15 @@ def cg_resident_2d(scale, b2d, *, x0=None, tol=0.0, rtol=0.0,
     Args:
       scale: stencil scale factor (traced scalar ok).
       b2d: right-hand side on the (nx, ny) grid, float32.
+      x0: optional float32 warm-start guess (flat or grid shape);
+        ``None`` = the reference's x0 = 0 fast path, otherwise the
+        general ``r0 = b - A x0`` init (one extra stencil apply; the
+        x0 buffer aliases the x output, so no extra VMEM plane).
       tol / rtol: absolute / relative tolerance on ``||r||_2`` (reference
-        quirk Q3 semantics; threshold is ``max(tol, rtol * ||b||)``).
+        quirk Q3 semantics; threshold is ``max(tol, rtol * ||r0||)``
+        with ``r0 = b`` for the default zero x0 - the general solver's
+        exact formula, which for a near-exact warm start makes an
+        ``rtol`` threshold much tighter than ``rtol * ||b||``).
       maxiter: static iteration bound (sizes the block loop).
       check_every: convergence-check block depth; iterations are reported
         at block granularity, matching ``solver.cg``'s ``check_every``
