@@ -320,9 +320,6 @@ def main(argv=None) -> int:
                              "--precond chebyshev or none, without "
                              "--history (the one-kernel solve records no "
                              "trace)")
-        if args.df64 and args.precond is not None:
-            raise SystemExit("--engine resident --dtype df64 is "
-                             "unpreconditioned only")
 
     def run():
         if args.df64:
@@ -344,8 +341,10 @@ def main(argv=None) -> int:
                     supports_resident_df64,
                 )
 
-                eligible = (supports_resident_df64(a)
-                            and args.precond is None
+                eligible = (supports_resident_df64(
+                                a,
+                                preconditioned=args.precond == "chebyshev")
+                            and args.precond in (None, "chebyshev")
                             and args.method == "cg" and not args.history
                             and (args.engine == "resident"
                                  or _jax_backend_is_tpu()))
@@ -359,6 +358,8 @@ def main(argv=None) -> int:
                         a, np.asarray(b, dtype=np.float64), tol=args.tol,
                         rtol=args.rtol, maxiter=args.maxiter,
                         check_every=args.check_every,
+                        preconditioner=args.precond,
+                        precond_degree=args.precond_degree,
                         interpret=_pallas_interpret())
             from .solver.df64 import cg_df64
 

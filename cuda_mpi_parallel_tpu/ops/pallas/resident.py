@@ -329,19 +329,24 @@ def _check_grid_fits(shape, *, df64: bool, preconditioned: bool,
     if interpret:
         return
     if len(shape) == 2:
-        ok = (supports_resident_df64_2d(*shape) if df64
+        ok = (supports_resident_df64_2d(*shape,
+                                        preconditioned=preconditioned)
+              if df64
               else supports_resident_2d(*shape,
                                         preconditioned=preconditioned,
                                         warm_start=warm_start))
         tiling = "nx % 8 == 0, ny % 128 == 0"
     else:
-        ok = (supports_resident_df64_3d(*shape) if df64
+        ok = (supports_resident_df64_3d(*shape,
+                                        preconditioned=preconditioned)
+              if df64
               else supports_resident_3d(*shape,
                                         preconditioned=preconditioned,
                                         warm_start=warm_start))
         tiling = "ny % 8 == 0, nz % 128 == 0"
     if not ok:
-        planes = (_PLANES_BOUND_DF64 if df64
+        planes = (_PLANES_BOUND_DF64 + _extra_planes_df64(preconditioned)
+                  if df64
                   else _PLANES_BOUND
                   + _extra_planes(preconditioned, warm_start))
         raise ValueError(
@@ -543,11 +548,21 @@ def cg_resident_3d(scale, b3d, *, x0=None, tol=0.0, rtol=0.0,
 _PLANES_BOUND_DF64 = 24
 
 
-def supports_resident_df64_2d(nx: int, ny: int, device=None) -> bool:
+def _extra_planes_df64(preconditioned: bool) -> int:
+    """df64 plane surcharge: the in-kernel Chebyshev recurrence carries
+    z/d as hi/lo pairs (~4 transient planes).  Gates and the kernel's
+    ``vmem_limit_bytes`` share this function (same invariant as
+    ``_extra_planes``)."""
+    return 4 if preconditioned else 0
+
+
+def supports_resident_df64_2d(nx: int, ny: int, device=None,
+                              preconditioned: bool = False) -> bool:
     """True if an (nx, ny) grid's df64 CG working set fits in VMEM."""
     if nx % 8 != 0 or ny % 128 != 0:
         return False
-    return _PLANES_BOUND_DF64 * nx * ny * 4 <= vmem_bytes(device)
+    planes = _PLANES_BOUND_DF64 + _extra_planes_df64(preconditioned)
+    return planes * nx * ny * 4 <= vmem_bytes(device)
 
 
 def _fold_grid_df(hi, lo):
@@ -634,22 +649,50 @@ def _safe_div_df(num, den):
             jnp.where(zero, jnp.zeros_like(q[1]), q[1]))
 
 
-def _resident_kernel_df64(nblocks, check_every, stencil_df_fn,
+def _resident_kernel_df64(nblocks, check_every, degree, stencil_df_fn,
                           params_ref, cap_ref, bh_ref, bl_ref,
                           xh_ref, xl_ref, iters_ref, rr_ref, indef_ref,
-                          conv_ref, rh_ref, rl_ref, ph_ref, pl_ref,
-                          state_f, state_i):
+                          conv_ref, health_ref, rh_ref, rl_ref,
+                          ph_ref, pl_ref, state_f, state_i):
     scale = (params_ref[0], params_ref[1])
     tol = params_ref[2]
     rtol = params_ref[3]
     cap = cap_ref[0]
 
+    def precond_df(r):
+        """degree-term Chebyshev approximation of A^-1 in df64 - the
+        in-kernel form of ``solver.df64._chebyshev_apply`` (same
+        semi-iteration, every scalar and plane op in double-float)."""
+        theta = (params_ref[4], params_ref[5])
+        delta = (params_ref[6], params_ref[7])
+        one = (jnp.float32(1.0), jnp.float32(0.0))
+        two = (jnp.float32(2.0), jnp.float32(0.0))
+        sigma = df.div(theta, delta)
+        rho_c = df.div(one, sigma)
+        d = df.div(r, theta)
+        z = d
+        for _ in range(degree - 1):
+            rho_n = df.div(one, df.sub(df.mul(two, sigma), rho_c))
+            ax = stencil_df_fn(z[0], z[1], scale[0], scale[1])
+            resid = df.sub(r, ax)
+            d = df.add(df.mul(df.mul(rho_n, rho_c), d),
+                       df.mul(df.div(df.mul(two, rho_n), delta), resid))
+            z = df.add(z, d)
+            rho_c = rho_n
+        return z
+
     bh, bl = bh_ref[:], bl_ref[:]
     xh_ref[:] = jnp.zeros_like(bh)          # explicit x0 = 0 (quirk Q6)
     xl_ref[:] = jnp.zeros_like(bh)
     rh_ref[:], rl_ref[:] = bh, bl           # r0 = b  (CUDACG.cu:248)
-    ph_ref[:], pl_ref[:] = bh, bl           # p0 = r0 (CUDACG.cu:255)
     rr0 = _dot_df(bh, bl, bh, bl)
+    if degree > 0:
+        z0 = precond_df((bh, bl))
+        ph_ref[:], pl_ref[:] = z0           # p0 = z0 (preconditioned)
+        rho0 = _dot_df(bh, bl, z0[0], z0[1])
+    else:
+        ph_ref[:], pl_ref[:] = bh, bl       # p0 = r0 (CUDACG.cu:255)
+        rho0 = rr0
 
     # threshold^2 = max(tol^2, rtol^2 * ||r0||^2), df64
     # (solver.df64._threshold semantics; tol/rtol squares via two-prod)
@@ -660,6 +703,7 @@ def _resident_kernel_df64(nblocks, check_every, stencil_df_fn,
            jnp.where(tol2[0] >= rt[0], tol2[1], rt[1]))
 
     state_f[0], state_f[1] = rr0            # ||r||^2 df64 across blocks
+    state_f[2], state_f[3] = rho0           # r . z df64 (== rr plain)
     state_i[0] = jnp.int32(0)               # iterations completed
     state_i[1] = jnp.int32(0)               # indefiniteness observed
 
@@ -667,32 +711,49 @@ def _resident_kernel_df64(nblocks, check_every, stencil_df_fn,
         rr_blk = (state_f[0], state_f[1])
         unconverged = jnp.logical_not(df.less(rr_blk, thr))
         nontrivial = rr_blk[0] > 0.0
-        healthy = jnp.isfinite(rr_blk[0])
+        # rho <= 0 with r != 0 is a preconditioner breakdown (M not
+        # SPD): stop, don't spin (solver.df64's cond semantics).
+        healthy = (jnp.isfinite(rr_blk[0]) & jnp.isfinite(state_f[2])
+                   & (state_f[2] > 0.0))
 
         @pl.when(unconverged & nontrivial & healthy & (state_i[0] < cap))
         def _():
             nsteps = jnp.minimum(jnp.int32(check_every), cap - state_i[0])
 
-            def one_iter(_, rr):
+            def one_iter(_, carry):
+                rr, rho = carry
                 p = (ph_ref[:], pl_ref[:])
                 ap = stencil_df_fn(p[0], p[1], scale[0], scale[1])
                 pap = _dot_df(p[0], p[1], ap[0], ap[1])
                 state_i[1] = jnp.where(
                     (pap[0] <= 0.0) & (rr[0] > 0.0),
                     jnp.int32(1), state_i[1])
-                alpha = _safe_div_df(rr, pap)
+                alpha = _safe_div_df(rho, pap)
                 x_new = df.axpy(alpha, p, (xh_ref[:], xl_ref[:]))
                 xh_ref[:], xl_ref[:] = x_new
                 r_new = df.axpy(df.neg(alpha), ap, (rh_ref[:], rl_ref[:]))
                 rh_ref[:], rl_ref[:] = r_new
                 rr_new = _dot_df(r_new[0], r_new[1], r_new[0], r_new[1])
-                beta = _safe_div_df(rr_new, rr)
-                p_new = df.axpy(beta, p, r_new)
+                if degree > 0:
+                    z_new = precond_df(r_new)
+                    rho_new = _dot_df(r_new[0], r_new[1],
+                                      z_new[0], z_new[1])
+                else:
+                    z_new, rho_new = r_new, rr_new
+                beta = _safe_div_df(rho_new, rho)
+                p_new = df.axpy(beta, p, z_new)
                 ph_ref[:], pl_ref[:] = p_new
-                return rr_new
+                frozen = pap[0] == 0.0
+                keep = lambda new, cur: (
+                    jnp.where(frozen, cur[0], new[0]),
+                    jnp.where(frozen, cur[1], new[1]))
+                return keep(rr_new, rr), keep(rho_new, rho)
 
-            rr_out = lax.fori_loop(0, nsteps, one_iter, rr_blk)
+            rr_out, rho_out = lax.fori_loop(
+                0, nsteps, one_iter,
+                ((state_f[0], state_f[1]), (state_f[2], state_f[3])))
             state_f[0], state_f[1] = rr_out
+            state_f[2], state_f[3] = rho_out
             state_i[0] = state_i[0] + nsteps
         return carry
 
@@ -707,30 +768,40 @@ def _resident_kernel_df64(nblocks, check_every, stencil_df_fn,
     conv = jnp.logical_or(df.less((state_f[0], state_f[1]), thr),
                           state_f[0] == 0.0)
     conv_ref[0] = conv.astype(jnp.int32)
+    # final health (solver.df64 semantics): non-finite scalars or a
+    # rho <= 0 preconditioner breakdown with r != 0 -> BREAKDOWN.
+    health_ref[0] = (jnp.isfinite(state_f[0]) & jnp.isfinite(state_f[2])
+                     & ((state_f[2] > 0.0) | (state_f[0] == 0.0))
+                     ).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "shape", "maxiter", "check_every", "interpret"))
-def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, cap, bh, bl, *,
-                           shape, maxiter, check_every, interpret):
+    "shape", "maxiter", "check_every", "degree", "interpret"))
+def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, theta, delta, cap,
+                           bh, bl, *, shape, maxiter, check_every, degree,
+                           interpret):
     nblocks = -(-maxiter // check_every)
     params = jnp.stack([
         jnp.asarray(scale_h, jnp.float32),
         jnp.asarray(scale_l, jnp.float32),
         jnp.asarray(tol, jnp.float32),
-        jnp.asarray(rtol, jnp.float32)])
+        jnp.asarray(rtol, jnp.float32),
+        jnp.asarray(theta[0], jnp.float32),
+        jnp.asarray(theta[1], jnp.float32),
+        jnp.asarray(delta[0], jnp.float32),
+        jnp.asarray(delta[1], jnp.float32)])
     cap_arr = jnp.asarray(cap, jnp.int32).reshape(1)
     stencil_df_fn = (_shift_stencil_df if len(shape) == 2
                      else _shift_stencil_df_3d)
     kernel = functools.partial(_resident_kernel_df64, nblocks, check_every,
-                               stencil_df_fn)
+                               degree, stencil_df_fn)
     cells = math.prod(shape)
     vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
-    xh, xl, iters, rr, indef, conv = pl.pallas_call(
+    xh, xl, iters, rr, indef, conv, health = pl.pallas_call(
         kernel,
         in_specs=[smem, smem, vmem, vmem],
-        out_specs=[vmem, vmem, smem, smem, smem, smem],
+        out_specs=[vmem, vmem, smem, smem, smem, smem, smem],
         out_shape=[
             jax.ShapeDtypeStruct(shape, jnp.float32),      # x hi
             jax.ShapeDtypeStruct(shape, jnp.float32),      # x lo
@@ -738,24 +809,30 @@ def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, cap, bh, bl, *,
             jax.ShapeDtypeStruct((2,), jnp.float32),       # ||r||^2 df64
             jax.ShapeDtypeStruct((1,), jnp.int32),         # indefinite
             jax.ShapeDtypeStruct((1,), jnp.int32),         # converged
+            jax.ShapeDtypeStruct((1,), jnp.int32),         # healthy
         ],
         scratch_shapes=[
             pltpu.VMEM(shape, jnp.float32),                # r hi
             pltpu.VMEM(shape, jnp.float32),                # r lo
             pltpu.VMEM(shape, jnp.float32),                # p hi
             pltpu.VMEM(shape, jnp.float32),                # p lo
-            pltpu.SMEM((2,), jnp.float32),                 # rr (hi, lo)
+            pltpu.SMEM((4,), jnp.float32),                 # rr, rho (df64)
             pltpu.SMEM((2,), jnp.int32),                   # k, indefinite
         ],
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=_PLANES_BOUND_DF64 * cells * 4 + (1 << 20)),
+            vmem_limit_bytes=(_PLANES_BOUND_DF64
+                              + _extra_planes_df64(degree > 0))
+            * cells * 4 + (1 << 20)),
         interpret=interpret,
     )(params, cap_arr, bh, bl)
-    return xh, xl, iters[0], (rr[0], rr[1]), indef[0], conv[0]
+    return (xh, xl, iters[0], (rr[0], rr[1]), indef[0], conv[0],
+            health[0])
 
 
 def cg_resident_df64_2d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
-                        check_every=32, iter_cap=None, interpret=False):
+                        check_every=32, iter_cap=None, interpret=False,
+                        precond_degree=0, theta=(1.0, 0.0),
+                        delta=(1.0, 0.0)):
     """df64 CG for the 5-point stencil, entirely inside one pallas kernel.
 
     Args:
@@ -766,10 +843,17 @@ def cg_resident_df64_2d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
         :func:`cg_resident_2d`; the convergence threshold is evaluated
         in df64 (``solver.df64`` semantics).
 
+    ``precond_degree`` >= 1 applies the k-term Chebyshev polynomial
+    IN-KERNEL in df64 arithmetic on the spectral interval described by
+    the df64 ``theta``/``delta`` pairs (``solver.df64._chebyshev_apply``
+    semantics; get them from ``solver.df64.chebyshev_interval``).
+
     Returns:
-      ``(x_hi, x_lo, iterations, (rr_hi, rr_lo), indefinite, converged)``
-      - ``converged`` is decided inside the kernel on its df64 threshold
-      (``max(tol^2, rtol^2 ||r0||^2)``, ``solver.df64._threshold``).
+      ``(x_hi, x_lo, iterations, (rr_hi, rr_lo), indefinite, converged,
+      healthy)`` - ``converged`` is decided inside the kernel on its
+      df64 threshold (``max(tol^2, rtol^2 ||r0||^2)``,
+      ``solver.df64._threshold``); ``healthy`` 0 means BREAKDOWN
+      (non-finite scalars or ``rho <= 0`` with ``r != 0``).
     """
     bh = jnp.asarray(b_pair[0], jnp.float32)
     bl = jnp.asarray(b_pair[1], jnp.float32)
@@ -777,27 +861,32 @@ def cg_resident_df64_2d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
         raise ValueError(
             f"b_pair must be two equal (nx, ny) grids, got "
             f"{bh.shape} / {bl.shape}")
-    _check_loop_args(check_every)
-    _check_grid_fits(bh.shape, df64=True, preconditioned=False,
+    _check_loop_args(check_every, precond_degree)
+    _check_grid_fits(bh.shape, df64=True,
+                     preconditioned=precond_degree > 0,
                      interpret=interpret)
     check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_df64_call(
-        scale[0], scale[1], tol, rtol, cap, bh, bl, shape=bh.shape,
-        maxiter=maxiter, check_every=check_every, interpret=interpret)
+        scale[0], scale[1], tol, rtol, theta, delta, cap, bh, bl,
+        shape=bh.shape, maxiter=maxiter, check_every=check_every,
+        degree=int(precond_degree), interpret=interpret)
 
 
-def supports_resident_df64_3d(nx: int, ny: int, nz: int,
-                              device=None) -> bool:
+def supports_resident_df64_3d(nx: int, ny: int, nz: int, device=None,
+                              preconditioned: bool = False) -> bool:
     """3D form of :func:`supports_resident_df64_2d`: trailing-axes
     tiling plus the df64 plane-count bound."""
     if ny % 8 != 0 or nz % 128 != 0 or nx < 1:
         return False
-    return _PLANES_BOUND_DF64 * nx * ny * nz * 4 <= vmem_bytes(device)
+    planes = _PLANES_BOUND_DF64 + _extra_planes_df64(preconditioned)
+    return planes * nx * ny * nz * 4 <= vmem_bytes(device)
 
 
 def cg_resident_df64_3d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
-                        check_every=32, iter_cap=None, interpret=False):
+                        check_every=32, iter_cap=None, interpret=False,
+                        precond_degree=0, theta=(1.0, 0.0),
+                        delta=(1.0, 0.0)):
     """The 7-point-stencil form of :func:`cg_resident_df64_2d`: same
     kernel and return contract with the df64 3D Laplacian
     (``ops.df64.stencil3d_matvec`` semantics - ``6*u`` built as the
@@ -808,11 +897,13 @@ def cg_resident_df64_3d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
         raise ValueError(
             f"b_pair must be two equal (nx, ny, nz) grids, got "
             f"{bh.shape} / {bl.shape}")
-    _check_loop_args(check_every)
-    _check_grid_fits(bh.shape, df64=True, preconditioned=False,
+    _check_loop_args(check_every, precond_degree)
+    _check_grid_fits(bh.shape, df64=True,
+                     preconditioned=precond_degree > 0,
                      interpret=interpret)
     check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_df64_call(
-        scale[0], scale[1], tol, rtol, cap, bh, bl, shape=bh.shape,
-        maxiter=maxiter, check_every=check_every, interpret=interpret)
+        scale[0], scale[1], tol, rtol, theta, delta, cap, bh, bl,
+        shape=bh.shape, maxiter=maxiter, check_every=check_every,
+        degree=int(precond_degree), interpret=interpret)

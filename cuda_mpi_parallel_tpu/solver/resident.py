@@ -230,16 +230,19 @@ def cg_resident(
         indefinite=indef.astype(bool), residual_history=None)
 
 
-def supports_resident_df64(a) -> bool:
+def supports_resident_df64(a, preconditioned: bool = False) -> bool:
     """True if ``cg_resident_df64`` can run this operator: a 2D/3D
     stencil whose df64 working set (8 pinned hi/lo planes +
-    temporaries) fits the device VMEM budget."""
+    temporaries; +4 transient planes for in-kernel Chebyshev when
+    ``preconditioned``) fits the device VMEM budget."""
     if isinstance(a, Stencil2D):
         nx, ny = a.grid
-        return supports_resident_df64_2d(nx, ny)
+        return supports_resident_df64_2d(nx, ny,
+                                         preconditioned=preconditioned)
     if isinstance(a, Stencil3D):
         nx, ny, nz = a.grid
-        return supports_resident_df64_3d(nx, ny, nz)
+        return supports_resident_df64_3d(nx, ny, nz,
+                                         preconditioned=preconditioned)
     return False
 
 
@@ -252,6 +255,8 @@ def cg_resident_df64(
     maxiter: int = 2000,
     check_every: int = 32,
     iter_cap=None,
+    preconditioner=None,
+    precond_degree: int = 4,
     interpret: bool = False,
 ) -> DF64CGResult:
     """f64-class CG (df64 storage) entirely inside one VMEM-resident kernel.
@@ -268,12 +273,30 @@ def cg_resident_df64(
     array (lifted with zero lo words), or an explicit ``(hi, lo)`` pair;
     flat ``(n,)`` or grid ``(nx, ny)`` shapes are accepted, and the
     solution comes back flat (``DF64CGResult.x()`` recombines to f64).
+
+    ``preconditioner``: ``None`` or ``"chebyshev"`` - the
+    ``precond_degree``-term polynomial applied IN-KERNEL in df64
+    arithmetic (``cg_df64``'s chebyshev semantics; spectral interval
+    from the host-side ``solver.df64.chebyshev_interval``).
     """
     if not isinstance(a, (Stencil2D, Stencil3D)):
         raise TypeError(
             f"cg_resident_df64 needs a Stencil2D or Stencil3D operator, "
             f"got {type(a).__name__} - use solver.df64.cg_df64 for "
             f"general operators")
+    if preconditioner not in (None, "chebyshev"):
+        raise ValueError(
+            f"cg_resident_df64 supports preconditioner=None or "
+            f"'chebyshev', got {preconditioner!r} - use "
+            f"solver.df64.cg_df64 for jacobi/mg")
+    degree = precond_degree if preconditioner == "chebyshev" else 0
+    theta = delta = (1.0, 0.0)
+    if degree:
+        from .df64 import chebyshev_interval
+
+        th, dl = chebyshev_interval(a)
+        theta = (float(th[0]), float(th[1]))
+        delta = (float(dl[0]), float(dl[1]))
     grid = a.grid
     n_cells = math.prod(grid)
 
@@ -300,12 +323,13 @@ def cg_resident_df64(
 
     kernel_fn = (cg_resident_df64_2d if len(grid) == 2
                  else cg_resident_df64_3d)
-    xh, xl, iters, rr, indef, conv = kernel_fn(
+    xh, xl, iters, rr, indef, conv, health = kernel_fn(
         (sh, sl), (bh, bl), tol=tol, rtol=rtol, maxiter=maxiter,
-        check_every=check_every, iter_cap=iter_cap, interpret=interpret)
+        check_every=check_every, iter_cap=iter_cap, interpret=interpret,
+        precond_degree=degree, theta=theta, delta=delta)
 
     converged = conv.astype(bool)
-    healthy = jnp.isfinite(rr[0])
+    healthy = health.astype(bool)
     status = jnp.where(
         ~healthy, jnp.int32(CGStatus.BREAKDOWN),
         jnp.where(converged, jnp.int32(CGStatus.CONVERGED),

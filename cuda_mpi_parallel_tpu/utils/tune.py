@@ -104,7 +104,7 @@ def autotune(
     # 16-iteration deltas non-positive); before giving up, retry the
     # sweep with an 8x wider gap, which raises the differential work an
     # order of magnitude above the noise floor.
-    for attempt, gap_scale in enumerate((1, 8)):
+    for gap_scale in (1, 8):
         hi = iters_lo + (iters_hi - iters_lo) * gap_scale
         for op_label, op in _candidate_ops(a):
             for method in methods:

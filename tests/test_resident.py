@@ -476,6 +476,35 @@ class TestDF64Resident:
         with pytest.raises(ValueError, match="grid"):
             cg_resident_df64(op, np.zeros(17), interpret=True)
 
+    def test_chebyshev_trajectory_matches_cg_df64(self):
+        op, b64 = self._problem()
+        ref = cg_df64(op, b64, tol=0.0, maxiter=16, check_every=8,
+                      preconditioner="chebyshev", precond_degree=3)
+        res = cg_resident_df64(op, b64, tol=0.0, maxiter=16,
+                               check_every=8, preconditioner="chebyshev",
+                               precond_degree=3, interpret=True)
+        assert int(res.iterations) == int(ref.iterations)
+        rel = np.abs(res.x() - ref.x()).max() / np.abs(ref.x()).max()
+        assert rel < 1e-10, rel
+
+    def test_chebyshev_cuts_iterations(self):
+        op, b64 = self._problem()
+        plain = cg_resident_df64(op, b64, tol=0.0, rtol=1e-8,
+                                 maxiter=300, check_every=4,
+                                 interpret=True)
+        cheb = cg_resident_df64(op, b64, tol=0.0, rtol=1e-8,
+                                maxiter=300, check_every=4,
+                                preconditioner="chebyshev",
+                                precond_degree=4, interpret=True)
+        assert bool(cheb.converged)
+        assert int(cheb.iterations) < int(plain.iterations) // 2
+
+    def test_rejects_unknown_preconditioner(self):
+        op, b64 = self._problem()
+        with pytest.raises(ValueError, match="chebyshev"):
+            cg_resident_df64(op, b64, preconditioner="jacobi",
+                             interpret=True)
+
     def test_3d_trajectory_matches_cg_df64(self):
         op = Stencil3D.create(4, 8, 128, dtype=jnp.float32)
         rng = np.random.default_rng(2)
