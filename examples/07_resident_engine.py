@@ -70,3 +70,22 @@ print(f"resident df64 (rtol 1e-10): {int(deep.iterations)} iters, "
       f"||r|| = {deep.residual_norm():.3e}  "
       f"(a depth plain f32 cannot reach)")
 assert deep.residual_norm() < 1e-9 * np.linalg.norm(b64)
+
+# -- 4. df64 + in-kernel Chebyshev: fewer iterations at the same depth --------
+deep_pcg = cg_resident_df64(op, b64, tol=0.0, rtol=1e-10, maxiter=3000,
+                            check_every=8, preconditioner="chebyshev",
+                            precond_degree=4, interpret=interpret)
+print(f"resident df64 + Chebyshev(4): {int(deep_pcg.iterations)} iters "
+      f"({int(deep.iterations) / max(int(deep_pcg.iterations), 1):.1f}x "
+      f"fewer), ||r|| = {deep_pcg.residual_norm():.3e}")
+
+# -- 5. warm start: reuse a previous solution as x0 ---------------------------
+# NOTE: use an ABSOLUTE tol when warm-starting - rtol is relative to the
+# new ||r0|| = ||b - A x0||, which a good x0 makes tiny, so an rtol
+# threshold silently becomes a much deeper target than the cold solve's.
+target = float(res.residual_norm) * 2
+warm = cg_resident(op, b, np.asarray(res.x).ravel(), tol=target,
+                   maxiter=2000, check_every=8, interpret=interpret)
+print(f"warm-started from the earlier solution: {int(warm.iterations)} "
+      f"iters to the same absolute depth (vs {int(res.iterations)} cold)")
+assert int(warm.iterations) <= 8
