@@ -212,6 +212,36 @@ class TestUtils:
         assert el > 0
         assert bool(res.converged)
 
+    def test_paired_delta_rate_counts_and_cancels_overhead(self):
+        """The interleaved-pair estimator divides the iteration gap by
+        per-pair time deltas: with a fake clock charging a fixed per-call
+        overhead plus a constant per-iteration cost, the overhead must
+        cancel exactly and the call pattern must be warmup(lo, hi) then
+        `pairs` interleaved (lo, hi) pairs."""
+        from cuda_mpi_parallel_tpu.utils import timing
+
+        calls = []
+        fake_now = [0.0]
+
+        def fake_wall():
+            return fake_now[0]
+
+        def run(it):
+            calls.append(it)
+            fake_now[0] += 0.5 + it * 1e-3   # 0.5s dispatch + 1ms/iter
+            return None
+
+        real_wall, real_block = timing.wall_seconds, timing._block
+        timing.wall_seconds = fake_wall
+        timing._block = lambda tree: None
+        try:
+            rate = timing.paired_delta_rate(run, 10, 110, pairs=3)
+        finally:
+            timing.wall_seconds = real_wall
+            timing._block = real_block
+        assert calls == [10, 110] + [10, 110] * 3
+        assert rate == pytest.approx(1000.0)  # 1ms/iter, overhead gone
+
     def test_timer_sections(self):
         t = Timer()
         with t.section("a"):
