@@ -26,7 +26,9 @@ path, ``CUDACG.cu:247-259``), no preconditioner, ``method="cg"``, and
 (up to f32 reduction-order rounding), extra iterations past convergence
 stay inside the current check block, and the reported iteration count
 lands on a block boundary.  Breakdown freezing mirrors ``_safe_div``:
-``p.Ap == 0`` (exact solve) zeroes the step and freezes the iterate.
+only the exact 0/0 (``rho == p.Ap == 0``, an exact solve) zeroes the
+step; a genuine breakdown (``p.Ap == 0`` with ``rho != 0``) divides to
+inf so the health predicate stops the solve and reports BREAKDOWN.
 
 Capacity: 5 resident planes + Mosaic's temporaries for the shift chain
 bound the footprint at ~12 plane-sizes; :func:`supports_resident_2d`
@@ -171,6 +173,19 @@ def _shift_stencil_3d(u, scale):
     return scale * acc
 
 
+def _safe_div_f32(num, den):
+    """``solver.cg._safe_div`` semantics in-kernel (not imported: solver
+    depends on this module): freeze ONLY the exact 0/0 - iterations past
+    an exact solve inside a check block have rho = p.Ap = 0, and alpha =
+    0 then fixes every vector in place; a genuine breakdown (den = 0
+    with num != 0) divides to inf ON PURPOSE so the health predicate
+    stops the next block and reports BREAKDOWN, never a silent spin to
+    MAXITER.  The df64 twin is :func:`_safe_div_df`."""
+    zero = (num == 0.0) & (den == 0.0)
+    return jnp.where(zero, 0.0,
+                     num / jnp.where(zero, jnp.ones_like(den), den))
+
+
 def _resident_kernel(nblocks, check_every, degree, stencil_fn, has_x0,
                      params_ref, cap_ref, *refs):
     if has_x0:
@@ -239,7 +254,13 @@ def _resident_kernel(nblocks, check_every, degree, stencil_fn, has_x0,
         healthy = (jnp.isfinite(state_f[0]) & jnp.isfinite(state_f[1])
                    & (state_f[1] > 0.0))
 
-        @pl.when((state_f[0] > thresh2) & (state_i[0] < cap) & healthy)
+        # Continue-condition mirrors solver/cg.py's cond EXACTLY:
+        # unconverged is rr >= thresh^2 (strict < converges, so an exact
+        # rr == thresh^2 tie keeps iterating - same boundary as
+        # _threshold_sq/_package), and rr > 0 stops an exactly-solved
+        # system (iterating further would divide 0/0).
+        @pl.when((state_f[0] >= thresh2) & (state_f[0] > 0.0)
+                 & (state_i[0] < cap) & healthy)
         def _():
             # Final (partial) block: never run past the traced cap - the
             # general solver's _block_fits + remainder-pass semantics
@@ -256,12 +277,7 @@ def _resident_kernel(nblocks, check_every, degree, stencil_fn, has_x0,
                 # (p_ap <= 0) & (rr > 0).
                 state_i[1] = jnp.where((pap <= 0.0) & (rr > 0.0),
                                        jnp.int32(1), state_i[1])
-                # _safe_div freeze: an exact solve mid-block (pap == 0,
-                # possible only when p == 0 i.e. r == 0) zeroes the step
-                # and leaves x/r/p untouched rather than dividing 0/0.
-                safe = pap != 0.0
-                alpha = jnp.where(safe, rho / jnp.where(safe, pap, 1.0),
-                                  0.0)
+                alpha = _safe_div_f32(rho, pap)
                 x_ref[:] = x_ref[:] + alpha * p        # CUDACG.cu:314
                 r_new = r_ref[:] - alpha * ap          # CUDACG.cu:320-321
                 r_ref[:] = r_new
@@ -271,12 +287,9 @@ def _resident_kernel(nblocks, check_every, degree, stencil_fn, has_x0,
                     rho_new = jnp.sum(r_new * z_new)
                 else:
                     z_new, rho_new = r_new, rr_new
-                beta = jnp.where(safe,
-                                 rho_new / jnp.where(rho != 0.0, rho, 1.0),
-                                 0.0)                  # CUDACG.cu:336-339
-                p_ref[:] = jnp.where(safe, z_new + beta * p, p)
-                return (jnp.where(safe, rr_new, rr),
-                        jnp.where(safe, rho_new, rho))
+                beta = _safe_div_f32(rho_new, rho)     # CUDACG.cu:336-339
+                p_ref[:] = z_new + beta * p
+                return rr_new, rho_new
 
             rr_out, rho_out = lax.fori_loop(
                 0, nsteps, one_iter, (state_f[0], state_f[1]))
@@ -292,8 +305,12 @@ def _resident_kernel(nblocks, check_every, degree, stencil_fn, has_x0,
     indef_ref[0] = state_i[1]
     # converged, decided on the KERNEL's threshold: the wrapper cannot
     # recompute it bit-identically (different reduction order for ||b||
-    # would let the flag contradict the actual stop decision).
-    conv_ref[0] = (state_f[0] <= thresh2).astype(jnp.int32)
+    # would let the flag contradict the actual stop decision).  Strict
+    # rr < thresh^2, plus the exact-solve rr == 0 case - _package's
+    # formula, so a rr == thresh^2 tie is NOT converged (and the
+    # continue-condition above keeps iterating on it).
+    conv_ref[0] = ((state_f[0] < thresh2)
+                   | (state_f[0] == 0.0)).astype(jnp.int32)
     # final health, the general solver's exact formula (solver/cg.py):
     # a rho <= 0 stop with r != 0 is a preconditioner breakdown and must
     # surface as BREAKDOWN, not MAXITER.
@@ -356,12 +373,23 @@ def _check_grid_fits(shape, *, df64: bool, preconditioned: bool,
             f"budget)")
 
 
-def _check_loop_args(check_every: int, precond_degree: int = 0) -> None:
+def _check_loop_args(check_every: int, maxiter: int,
+                     precond_degree: int = 0) -> int:
+    """Validate the loop arguments and return ``check_every`` clamped to
+    ``[1, max(maxiter, 1)]``: a block never overshoots ``maxiter``, and
+    ``maxiter == 0`` keeps ``check_every`` at 1 so ``nblocks`` computes
+    to 0 (a zero-iteration solve) rather than dividing by zero - the
+    general solver handles ``maxiter == 0`` gracefully and
+    ``engine="auto"`` must not differ.  Shared by all four resident
+    wrappers so the clamp cannot drift."""
     if check_every < 1:
         raise ValueError(f"check_every must be >= 1, got {check_every}")
     if precond_degree < 0:
         raise ValueError(
             f"precond_degree must be >= 0, got {precond_degree}")
+    if maxiter < 0:
+        raise ValueError(f"maxiter must be >= 0, got {maxiter}")
+    return max(1, min(check_every, maxiter))
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -477,12 +505,11 @@ def cg_resident_2d(scale, b2d, *, x0=None, tol=0.0, rtol=0.0,
         raise ValueError(f"b2d must be 2-D (the grid), got {b2d.shape}")
     if b2d.dtype != jnp.float32:
         raise ValueError(f"resident CG is float32-only, got {b2d.dtype}")
-    _check_loop_args(check_every, precond_degree)
+    check_every = _check_loop_args(check_every, maxiter, precond_degree)
     x0 = _coerce_x0(x0, b2d)
     _check_grid_fits(b2d.shape, df64=False,
                      preconditioned=precond_degree > 0,
                      interpret=interpret, warm_start=x0 is not None)
-    check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_call(
         scale, tol, rtol, lmin, lmax, cap, b2d, x0, shape=b2d.shape,
@@ -518,12 +545,11 @@ def cg_resident_3d(scale, b3d, *, x0=None, tol=0.0, rtol=0.0,
         raise ValueError(f"b3d must be 3-D (the grid), got {b3d.shape}")
     if b3d.dtype != jnp.float32:
         raise ValueError(f"resident CG is float32-only, got {b3d.dtype}")
-    _check_loop_args(check_every, precond_degree)
+    check_every = _check_loop_args(check_every, maxiter, precond_degree)
     x0 = _coerce_x0(x0, b3d)
     _check_grid_fits(b3d.shape, df64=False,
                      preconditioned=precond_degree > 0,
                      interpret=interpret, warm_start=x0 is not None)
-    check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_call(
         scale, tol, rtol, lmin, lmax, cap, b3d, x0, shape=b3d.shape,
@@ -743,11 +769,16 @@ def _resident_kernel_df64(nblocks, check_every, degree, stencil_df_fn,
                 beta = _safe_div_df(rho_new, rho)
                 p_new = df.axpy(beta, p, z_new)
                 ph_ref[:], pl_ref[:] = p_new
-                frozen = pap[0] == 0.0
-                keep = lambda new, cur: (
-                    jnp.where(frozen, cur[0], new[0]),
-                    jnp.where(frozen, cur[1], new[1]))
-                return keep(rr_new, rr), keep(rho_new, rho)
+                # No keep-mask: _safe_div_df already freezes the exact
+                # 0/0 (alpha = 0 fixes every vector in place, so rr_new
+                # recomputes bitwise-identically), and a genuine
+                # breakdown (pap = 0, rho != 0) must flow inf/nan into
+                # the CARRIED scalars so the next block's health
+                # predicate stops the solve - a pap-only mask kept them
+                # finite and delayed BREAKDOWN by a full extra block
+                # (the f32 kernel and solver.df64 stop one block after
+                # the breakdown iteration).
+                return rr_new, rho_new
 
             rr_out, rho_out = lax.fori_loop(
                 0, nsteps, one_iter,
@@ -861,11 +892,10 @@ def cg_resident_df64_2d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
         raise ValueError(
             f"b_pair must be two equal (nx, ny) grids, got "
             f"{bh.shape} / {bl.shape}")
-    _check_loop_args(check_every, precond_degree)
+    check_every = _check_loop_args(check_every, maxiter, precond_degree)
     _check_grid_fits(bh.shape, df64=True,
                      preconditioned=precond_degree > 0,
                      interpret=interpret)
-    check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_df64_call(
         scale[0], scale[1], tol, rtol, theta, delta, cap, bh, bl,
@@ -897,11 +927,10 @@ def cg_resident_df64_3d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
         raise ValueError(
             f"b_pair must be two equal (nx, ny, nz) grids, got "
             f"{bh.shape} / {bl.shape}")
-    _check_loop_args(check_every, precond_degree)
+    check_every = _check_loop_args(check_every, maxiter, precond_degree)
     _check_grid_fits(bh.shape, df64=True,
                      preconditioned=precond_degree > 0,
                      interpret=interpret)
-    check_every = min(check_every, maxiter)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_df64_call(
         scale[0], scale[1], tol, rtol, theta, delta, cap, bh, bl,

@@ -310,6 +310,78 @@ class TestSemantics:
         np.testing.assert_array_equal(np.asarray(res.x), 0.0)
 
 
+class TestAdvisorR3Regressions:
+    """Round-3 advisor findings: maxiter=0, genuine-breakdown surfacing,
+    and the exact convergence-boundary tie (all vs the general solver's
+    semantics, which are the contract)."""
+
+    def test_maxiter_zero_matches_general(self):
+        # check_every = min(check_every, 0) == 0 used to divide by zero
+        # in nblocks; must instead return a zero-iteration CGResult with
+        # the same status the general solver reports.
+        op, b = _grid_problem()
+        ref = solve(op, jnp.asarray(b.ravel()), tol=1e-7, maxiter=0)
+        res = cg_resident(op, jnp.asarray(b), tol=1e-7, maxiter=0,
+                          interpret=True)
+        assert int(res.iterations) == 0 == int(ref.iterations)
+        assert bool(res.converged) == bool(ref.converged)
+        assert res.status_enum() is ref.status_enum()
+        np.testing.assert_array_equal(np.asarray(res.x), 0.0)
+
+    def test_genuine_breakdown_is_breakdown_not_maxiter(self):
+        # A = 0 (scale 0): p.Ap == 0 with rho != 0 on the very first
+        # iteration - a genuine breakdown.  The old f32 kernel froze on
+        # pap == 0 alone and silently spun to MAXITER; _safe_div
+        # semantics let the inf surface so the health predicate reports
+        # BREAKDOWN, exactly like the general solver.
+        nx, ny = 8, 128
+        op = Stencil2D.create(nx, ny, scale=0.0, dtype=jnp.float32)
+        rng = np.random.default_rng(7)
+        b = rng.standard_normal((nx, ny)).astype(np.float32)
+        ref = solve(op, jnp.asarray(b.ravel()), tol=1e-7, maxiter=64,
+                    check_every=4)
+        res = cg_resident(op, jnp.asarray(b), tol=1e-7, maxiter=64,
+                          check_every=4, interpret=True)
+        assert ref.status_enum() is CGStatus.BREAKDOWN
+        assert res.status_enum() is CGStatus.BREAKDOWN
+        assert bool(res.indefinite)
+        # and it must stop at the first block boundary, not spin to 64
+        assert int(res.iterations) == int(ref.iterations)
+
+    def test_genuine_breakdown_df64_matches_general(self):
+        # The df64 kernel used a pap-only keep-mask that held the
+        # carried scalars finite for one extra block after a genuine
+        # breakdown; it must stop at the same block boundary as
+        # solver.df64 (carried inf/nan -> health predicate).
+        nx, ny = 8, 128
+        op = Stencil2D.create(nx, ny, scale=0.0, dtype=jnp.float32)
+        rng = np.random.default_rng(7)
+        b = rng.standard_normal(nx * ny)
+        ref = cg_df64(op, b, tol=1e-7, maxiter=64, check_every=4)
+        res = cg_resident_df64(op, b, tol=1e-7, maxiter=64,
+                               check_every=4, interpret=True)
+        assert ref.status_enum() is CGStatus.BREAKDOWN
+        assert res.status_enum() is CGStatus.BREAKDOWN
+        assert int(res.iterations) == int(ref.iterations)
+
+    def test_exact_threshold_tie_keeps_iterating(self):
+        # rr0 == thresh^2 exactly (b one-hot 3.0 => rr0 = 9.0; tol 3.0
+        # squares to exactly 9.0 in f32).  The general solver's cond is
+        # rr >= thresh_sq (continue on the tie); the kernel used strict
+        # > and stopped at zero iterations reporting converged.
+        nx, ny = 8, 128
+        op = poisson.poisson_2d_operator(nx, ny, dtype=jnp.float32)
+        b = np.zeros((nx, ny), np.float32)
+        b[4, 64] = 3.0
+        ref = solve(op, jnp.asarray(b.ravel()), tol=3.0, maxiter=64,
+                    check_every=4)
+        res = cg_resident(op, jnp.asarray(b), tol=3.0, maxiter=64,
+                          check_every=4, interpret=True)
+        assert int(ref.iterations) > 0
+        assert int(res.iterations) == int(ref.iterations)
+        assert bool(res.converged) == bool(ref.converged)
+
+
 class TestSolveEngineParam:
     def test_solve_engine_resident_matches_general(self):
         op, b = _grid_problem()
