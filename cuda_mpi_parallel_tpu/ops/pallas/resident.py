@@ -190,10 +190,10 @@ def _resident_kernel(nblocks, check_every, degree, stencil_fn, has_x0,
                      params_ref, cap_ref, *refs):
     if has_x0:
         (b_ref, x0_ref, x_ref, iters_ref, rr_ref, indef_ref, conv_ref,
-         health_ref, r_ref, p_ref, state_f, state_i) = refs
+         health_ref, hist_ref, r_ref, p_ref, state_f, state_i) = refs
     else:
         (b_ref, x_ref, iters_ref, rr_ref, indef_ref, conv_ref,
-         health_ref, r_ref, p_ref, state_f, state_i) = refs
+         health_ref, hist_ref, r_ref, p_ref, state_f, state_i) = refs
     scale = params_ref[0]
     tol = params_ref[1]
     rtol = params_ref[2]
@@ -247,7 +247,24 @@ def _resident_kernel(nblocks, check_every, degree, stencil_fn, has_x0,
     state_i[0] = jnp.int32(0)   # iterations completed
     state_i[1] = jnp.int32(0)   # indefiniteness observed (quirk Q1)
 
-    def block(_, carry):
+    # Block-granular residual trace (quirk Q7 on the flagship engine):
+    # slot 0 = ||r0||^2, slot j+1 = ||r||^2 after check block j - the
+    # value the kernel already holds in SMEM for the convergence
+    # decision, so the trace costs nothing per iteration.  Blocks that
+    # never run (converged / breakdown / cap) leave the -1.0 sentinel -
+    # NOT NaN: the trace is always emitted, and a NaN fill would trip
+    # jax_debug_nans on every default solve (the wrapper converts the
+    # sentinel to NaN only when history is requested; ||r||^2 >= 0 makes
+    # -1.0 unambiguous).
+    hist_ref[0] = rr0
+
+    def sentinel_fill(j, c):
+        hist_ref[j] = jnp.float32(-1.0)
+        return c
+
+    lax.fori_loop(1, nblocks + 1, sentinel_fill, jnp.int32(0))
+
+    def block(blk, carry):
         # Health mirrors the general solver's predicate (solver/cg.py):
         # non-finite scalars are a breakdown, and rho <= 0 with r != 0 is
         # a preconditioner breakdown (M not SPD) - stop, don't spin.
@@ -296,6 +313,7 @@ def _resident_kernel(nblocks, check_every, degree, stencil_fn, has_x0,
             state_f[0] = rr_out
             state_f[1] = rho_out
             state_i[0] = state_i[0] + nsteps
+            hist_ref[blk + 1] = rr_out
         return carry
 
     lax.fori_loop(0, nblocks, block, jnp.int32(0))
@@ -410,7 +428,7 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
                                degree, stencil_fn, has_x0)
     cells = math.prod(shape)
     grid_inputs = (b_grid,) if x0_grid is None else (b_grid, x0_grid)
-    x, iters, rr, indef, conv, health = pl.pallas_call(
+    x, iters, rr, indef, conv, health, hist = pl.pallas_call(
         kernel,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # params [scale,tol,rtol]
@@ -423,6 +441,7 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
             pl.BlockSpec(memory_space=pltpu.SMEM),   # indefinite flag
             pl.BlockSpec(memory_space=pltpu.SMEM),   # converged flag
             pl.BlockSpec(memory_space=pltpu.SMEM),   # healthy flag
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # per-block ||r||^2 trace
         ],
         out_shape=[
             jax.ShapeDtypeStruct(shape, jnp.float32),
@@ -431,6 +450,7 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
             jax.ShapeDtypeStruct((1,), jnp.int32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
             jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((nblocks + 1,), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM(shape, jnp.float32),          # r
@@ -455,7 +475,7 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
             * cells * 4 + (1 << 20)),
         interpret=interpret,
     )(params, cap_arr, *grid_inputs)
-    return x, iters[0], rr[0], indef[0], conv[0], health[0]
+    return x, iters[0], rr[0], indef[0], conv[0], health[0], hist
 
 
 def cg_resident_2d(scale, b2d, *, x0=None, tol=0.0, rtol=0.0,
@@ -493,12 +513,17 @@ def cg_resident_2d(scale, b2d, *, x0=None, tol=0.0, rtol=0.0,
         when ``precond_degree == 0``).
 
     Returns:
-      ``(x2d, iterations, rr, indefinite, converged, healthy)`` -
+      ``(x2d, iterations, rr, indefinite, converged, healthy, hist)`` -
       solution grid, block-aligned iteration count (int32), final
       ``||r||^2`` (f32), whether ``p.Ap <= 0`` was observed (int32 0/1;
-      quirk Q1), the kernel's own convergence decision (int32 0/1), and
-      the general solver's health predicate at exit (int32 0/1; 0 means
-      BREAKDOWN - non-finite scalars or ``rho <= 0`` with ``r != 0``).
+      quirk Q1), the kernel's own convergence decision (int32 0/1), the
+      general solver's health predicate at exit (int32 0/1; 0 means
+      BREAKDOWN - non-finite scalars or ``rho <= 0`` with ``r != 0``),
+      and the block-granular ``||r||^2`` trace (f32, ``nblocks + 1``
+      slots: slot 0 is ``||r0||^2``, slot j+1 the value after check
+      block j, -1.0 sentinel for blocks that never ran - the solver
+      wrapper converts to NaN) - closing quirk Q7 on this engine at
+      check-block granularity.
     """
     b2d = jnp.asarray(b2d)
     if b2d.ndim != 2:
@@ -539,7 +564,8 @@ def cg_resident_3d(scale, b3d, *, x0=None, tol=0.0, rtol=0.0,
     same kernel, same semantics and return contract, with the 3D
     shifted-add Laplacian - for 3D grids small enough to pin in VMEM
     (up to ~128^3 f32 on a 128 MiB part; BASELINE's 256^3 north star
-    stays on the general solver's HBM-streaming path)."""
+    runs on the fused-iteration streaming engine,
+    ``solver.streaming.cg_streaming`` / ``solve(engine="streaming")``)."""
     b3d = jnp.asarray(b3d)
     if b3d.ndim != 3:
         raise ValueError(f"b3d must be 3-D (the grid), got {b3d.shape}")
@@ -676,10 +702,17 @@ def _safe_div_df(num, den):
 
 
 def _resident_kernel_df64(nblocks, check_every, degree, stencil_df_fn,
-                          params_ref, cap_ref, bh_ref, bl_ref,
-                          xh_ref, xl_ref, iters_ref, rr_ref, indef_ref,
-                          conv_ref, health_ref, rh_ref, rl_ref,
-                          ph_ref, pl_ref, state_f, state_i):
+                          has_x0, params_ref, cap_ref, *refs):
+    if has_x0:
+        (bh_ref, bl_ref, x0h_ref, x0l_ref,
+         xh_ref, xl_ref, iters_ref, rr_ref, indef_ref,
+         conv_ref, health_ref, hist_ref, rh_ref, rl_ref,
+         ph_ref, pl_ref, state_f, state_i) = refs
+    else:
+        (bh_ref, bl_ref,
+         xh_ref, xl_ref, iters_ref, rr_ref, indef_ref,
+         conv_ref, health_ref, hist_ref, rh_ref, rl_ref,
+         ph_ref, pl_ref, state_f, state_i) = refs
     scale = (params_ref[0], params_ref[1])
     tol = params_ref[2]
     rtol = params_ref[3]
@@ -708,16 +741,25 @@ def _resident_kernel_df64(nblocks, check_every, degree, stencil_df_fn,
         return z
 
     bh, bl = bh_ref[:], bl_ref[:]
-    xh_ref[:] = jnp.zeros_like(bh)          # explicit x0 = 0 (quirk Q6)
-    xl_ref[:] = jnp.zeros_like(bh)
-    rh_ref[:], rl_ref[:] = bh, bl           # r0 = b  (CUDACG.cu:248)
-    rr0 = _dot_df(bh, bl, bh, bl)
-    if degree > 0:
-        z0 = precond_df((bh, bl))
-        ph_ref[:], pl_ref[:] = z0           # p0 = z0 (preconditioned)
-        rho0 = _dot_df(bh, bl, z0[0], z0[1])
+    if has_x0:
+        # general init r0 = b - A x0 in full df64 (solver.df64's
+        # nonzero-x0 extension of the reference's copy-only fast path)
+        x0 = (x0h_ref[:], x0l_ref[:])
+        xh_ref[:], xl_ref[:] = x0
+        r0 = df.sub((bh, bl), stencil_df_fn(x0[0], x0[1],
+                                            scale[0], scale[1]))
     else:
-        ph_ref[:], pl_ref[:] = bh, bl       # p0 = r0 (CUDACG.cu:255)
+        xh_ref[:] = jnp.zeros_like(bh)      # explicit x0 = 0 (quirk Q6)
+        xl_ref[:] = jnp.zeros_like(bh)
+        r0 = (bh, bl)                       # r0 = b  (CUDACG.cu:248)
+    rh_ref[:], rl_ref[:] = r0
+    rr0 = _dot_df(r0[0], r0[1], r0[0], r0[1])
+    if degree > 0:
+        z0 = precond_df(r0)
+        ph_ref[:], pl_ref[:] = z0           # p0 = z0 (preconditioned)
+        rho0 = _dot_df(r0[0], r0[1], z0[0], z0[1])
+    else:
+        ph_ref[:], pl_ref[:] = r0           # p0 = r0 (CUDACG.cu:255)
         rho0 = rr0
 
     # threshold^2 = max(tol^2, rtol^2 * ||r0||^2), df64
@@ -733,7 +775,19 @@ def _resident_kernel_df64(nblocks, check_every, degree, stencil_df_fn,
     state_i[0] = jnp.int32(0)               # iterations completed
     state_i[1] = jnp.int32(0)               # indefiniteness observed
 
-    def block(_, carry):
+    # Block-granular ||r||^2 trace, hi word only (DF64CGResult.
+    # residual_history's documented diagnostic semantics) - same layout
+    # and -1.0 never-ran sentinel as the f32 kernel (NaN would trip
+    # jax_debug_nans on every default solve).
+    hist_ref[0] = rr0[0]
+
+    def sentinel_fill(j, c):
+        hist_ref[j] = jnp.float32(-1.0)
+        return c
+
+    lax.fori_loop(1, nblocks + 1, sentinel_fill, jnp.int32(0))
+
+    def block(blk, carry):
         rr_blk = (state_f[0], state_f[1])
         unconverged = jnp.logical_not(df.less(rr_blk, thr))
         nontrivial = rr_blk[0] > 0.0
@@ -786,6 +840,7 @@ def _resident_kernel_df64(nblocks, check_every, degree, stencil_df_fn,
             state_f[0], state_f[1] = rr_out
             state_f[2], state_f[3] = rho_out
             state_i[0] = state_i[0] + nsteps
+            hist_ref[blk + 1] = rr_out[0]
         return carry
 
     lax.fori_loop(0, nblocks, block, jnp.int32(0))
@@ -809,8 +864,8 @@ def _resident_kernel_df64(nblocks, check_every, degree, stencil_df_fn,
 @functools.partial(jax.jit, static_argnames=(
     "shape", "maxiter", "check_every", "degree", "interpret"))
 def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, theta, delta, cap,
-                           bh, bl, *, shape, maxiter, check_every, degree,
-                           interpret):
+                           bh, bl, x0h, x0l, *, shape, maxiter,
+                           check_every, degree, interpret):
     nblocks = -(-maxiter // check_every)
     params = jnp.stack([
         jnp.asarray(scale_h, jnp.float32),
@@ -824,15 +879,17 @@ def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, theta, delta, cap,
     cap_arr = jnp.asarray(cap, jnp.int32).reshape(1)
     stencil_df_fn = (_shift_stencil_df if len(shape) == 2
                      else _shift_stencil_df_3d)
+    has_x0 = x0h is not None
     kernel = functools.partial(_resident_kernel_df64, nblocks, check_every,
-                               degree, stencil_df_fn)
+                               degree, stencil_df_fn, has_x0)
     cells = math.prod(shape)
     vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
-    xh, xl, iters, rr, indef, conv, health = pl.pallas_call(
+    grid_inputs = (bh, bl) if not has_x0 else (bh, bl, x0h, x0l)
+    xh, xl, iters, rr, indef, conv, health, hist = pl.pallas_call(
         kernel,
-        in_specs=[smem, smem, vmem, vmem],
-        out_specs=[vmem, vmem, smem, smem, smem, smem, smem],
+        in_specs=[smem, smem] + [vmem] * len(grid_inputs),
+        out_specs=[vmem, vmem, smem, smem, smem, smem, smem, smem],
         out_shape=[
             jax.ShapeDtypeStruct(shape, jnp.float32),      # x hi
             jax.ShapeDtypeStruct(shape, jnp.float32),      # x lo
@@ -841,6 +898,7 @@ def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, theta, delta, cap,
             jax.ShapeDtypeStruct((1,), jnp.int32),         # indefinite
             jax.ShapeDtypeStruct((1,), jnp.int32),         # converged
             jax.ShapeDtypeStruct((1,), jnp.int32),         # healthy
+            jax.ShapeDtypeStruct((nblocks + 1,), jnp.float32),  # rr trace
         ],
         scratch_shapes=[
             pltpu.VMEM(shape, jnp.float32),                # r hi
@@ -850,26 +908,61 @@ def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, theta, delta, cap,
             pltpu.SMEM((4,), jnp.float32),                 # rr, rho (df64)
             pltpu.SMEM((2,), jnp.int32),                   # k, indefinite
         ],
+        # The warm-start pair (input indices 4/5) aliases the x output
+        # pair, mirroring the f32 kernel's trick: the kernel reads x0
+        # exactly once at init and immediately seeds x from it, so a
+        # df64 warm start stays plane-neutral in the VMEM budget.
+        input_output_aliases=({4: 0, 5: 1} if has_x0 else {}),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=(_PLANES_BOUND_DF64
                               + _extra_planes_df64(degree > 0))
             * cells * 4 + (1 << 20)),
         interpret=interpret,
-    )(params, cap_arr, bh, bl)
+    )(params, cap_arr, *grid_inputs)
     return (xh, xl, iters[0], (rr[0], rr[1]), indef[0], conv[0],
-            health[0])
+            health[0], hist)
 
 
-def cg_resident_df64_2d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
-                        check_every=32, iter_cap=None, interpret=False,
-                        precond_degree=0, theta=(1.0, 0.0),
-                        delta=(1.0, 0.0)):
+def _coerce_x0_pair(x0, b_grid):
+    """Validate an optional df64 warm-start ``(hi, lo)`` pair against the
+    rhs grid (the df64 form of :func:`_coerce_x0`): flat or exact grid
+    shape, f32 words, both words the same shape."""
+    if x0 is None:
+        return None, None
+    if not (isinstance(x0, tuple) and len(x0) == 2):
+        raise ValueError(
+            "df64 x0 must be an (hi, lo) pair of f32 arrays "
+            "(ops.df64.split_f64 produces one from host float64)")
+    x0h = jnp.asarray(x0[0], jnp.float32)
+    x0l = jnp.asarray(x0[1], jnp.float32)
+    if x0h.shape != x0l.shape:
+        raise ValueError(
+            f"x0 words must share a shape, got {x0h.shape} / {x0l.shape}")
+    n = math.prod(b_grid.shape)
+    if x0h.ndim == 1 and x0h.shape[0] == n:
+        x0h, x0l = x0h.reshape(b_grid.shape), x0l.reshape(b_grid.shape)
+    elif x0h.shape != b_grid.shape:
+        raise ValueError(
+            f"x0 shape {x0h.shape} matches neither the grid "
+            f"{b_grid.shape} nor its flat length")
+    return x0h, x0l
+
+
+def cg_resident_df64_2d(scale, b_pair, *, x0=None, tol=0.0, rtol=0.0,
+                        maxiter=2000, check_every=32, iter_cap=None,
+                        interpret=False, precond_degree=0,
+                        theta=(1.0, 0.0), delta=(1.0, 0.0)):
     """df64 CG for the 5-point stencil, entirely inside one pallas kernel.
 
     Args:
       scale: df64 stencil scale - an ``(hi, lo)`` pair of f32 scalars.
       b_pair: right-hand side as an ``(hi, lo)`` pair of (nx, ny) f32
         grids (``ops.df64.split_f64`` produces one from host float64).
+      x0: optional df64 warm-start guess as an ``(hi, lo)`` pair (flat
+        or grid shape); ``None`` = the reference's x0 = 0 fast path,
+        otherwise the general ``r0 = b - A x0`` init in full df64 (one
+        extra in-kernel stencil apply; the pair aliases the x output
+        pair, so a warm start costs no extra VMEM planes).
       tol / rtol / maxiter / check_every / iter_cap / interpret: as
         :func:`cg_resident_2d`; the convergence threshold is evaluated
         in df64 (``solver.df64`` semantics).
@@ -881,10 +974,13 @@ def cg_resident_df64_2d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
 
     Returns:
       ``(x_hi, x_lo, iterations, (rr_hi, rr_lo), indefinite, converged,
-      healthy)`` - ``converged`` is decided inside the kernel on its
-      df64 threshold (``max(tol^2, rtol^2 ||r0||^2)``,
+      healthy, hist)`` - ``converged`` is decided inside the kernel on
+      its df64 threshold (``max(tol^2, rtol^2 ||r0||^2)``,
       ``solver.df64._threshold``); ``healthy`` 0 means BREAKDOWN
-      (non-finite scalars or ``rho <= 0`` with ``r != 0``).
+      (non-finite scalars or ``rho <= 0`` with ``r != 0``); ``hist`` is
+      the block-granular ``||r||^2`` trace, hi word only (slot 0 =
+      ``||r0||^2``, slot j+1 after check block j, -1.0 sentinel for
+      never-run blocks - the f32 kernel's layout).
     """
     bh = jnp.asarray(b_pair[0], jnp.float32)
     bl = jnp.asarray(b_pair[1], jnp.float32)
@@ -893,14 +989,16 @@ def cg_resident_df64_2d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
             f"b_pair must be two equal (nx, ny) grids, got "
             f"{bh.shape} / {bl.shape}")
     check_every = _check_loop_args(check_every, maxiter, precond_degree)
+    x0h, x0l = _coerce_x0_pair(x0, bh)
     _check_grid_fits(bh.shape, df64=True,
                      preconditioned=precond_degree > 0,
                      interpret=interpret)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_df64_call(
         scale[0], scale[1], tol, rtol, theta, delta, cap, bh, bl,
-        shape=bh.shape, maxiter=maxiter, check_every=check_every,
-        degree=int(precond_degree), interpret=interpret)
+        x0h, x0l, shape=bh.shape, maxiter=maxiter,
+        check_every=check_every, degree=int(precond_degree),
+        interpret=interpret)
 
 
 def supports_resident_df64_3d(nx: int, ny: int, nz: int, device=None,
@@ -913,10 +1011,10 @@ def supports_resident_df64_3d(nx: int, ny: int, nz: int, device=None,
     return planes * nx * ny * nz * 4 <= vmem_bytes(device)
 
 
-def cg_resident_df64_3d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
-                        check_every=32, iter_cap=None, interpret=False,
-                        precond_degree=0, theta=(1.0, 0.0),
-                        delta=(1.0, 0.0)):
+def cg_resident_df64_3d(scale, b_pair, *, x0=None, tol=0.0, rtol=0.0,
+                        maxiter=2000, check_every=32, iter_cap=None,
+                        interpret=False, precond_degree=0,
+                        theta=(1.0, 0.0), delta=(1.0, 0.0)):
     """The 7-point-stencil form of :func:`cg_resident_df64_2d`: same
     kernel and return contract with the df64 3D Laplacian
     (``ops.df64.stencil3d_matvec`` semantics - ``6*u`` built as the
@@ -928,11 +1026,13 @@ def cg_resident_df64_3d(scale, b_pair, *, tol=0.0, rtol=0.0, maxiter=2000,
             f"b_pair must be two equal (nx, ny, nz) grids, got "
             f"{bh.shape} / {bl.shape}")
     check_every = _check_loop_args(check_every, maxiter, precond_degree)
+    x0h, x0l = _coerce_x0_pair(x0, bh)
     _check_grid_fits(bh.shape, df64=True,
                      preconditioned=precond_degree > 0,
                      interpret=interpret)
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_df64_call(
         scale[0], scale[1], tol, rtol, theta, delta, cap, bh, bl,
-        shape=bh.shape, maxiter=maxiter, check_every=check_every,
-        degree=int(precond_degree), interpret=interpret)
+        x0h, x0l, shape=bh.shape, maxiter=maxiter,
+        check_every=check_every, degree=int(precond_degree),
+        interpret=interpret)

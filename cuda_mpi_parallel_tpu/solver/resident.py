@@ -102,7 +102,14 @@ def resident_eligible(a, b=None, m=None, *, method: str = "cg",
     dtypes, the kernel does not), the preconditioner (``None`` or a
     ``ChebyshevPreconditioner`` verifiably built over ``a``), and the
     feature set the one-kernel solve supports (``method="cg"``, f32
-    ``x0`` or none, no history / checkpointing / compensated dots).
+    ``x0`` or none, no checkpointing / compensated dots).
+
+    ``record_history=True`` is NOT eligible here on purpose: the
+    resident trace is check-block-granular while the general solver's
+    is per-iteration, and ``engine="auto"`` must never silently change
+    what a returned field means.  Callers who want the block-granular
+    trace ask for it explicitly (``cg_resident(record_history=True)``
+    or ``solve(engine="resident", record_history=True)``).
     """
     from ..models.precond import ChebyshevPreconditioner
 
@@ -138,6 +145,7 @@ def cg_resident(
     check_every: int = 32,
     iter_cap=None,
     m=None,
+    record_history: bool = False,
     interpret: bool = False,
 ) -> CGResult:
     """Solve ``A x = b`` entirely inside one VMEM-resident pallas kernel.
@@ -147,7 +155,16 @@ def cg_resident(
     ``None`` takes the reference's copy-only init fast path
     (``CUDACG.cu:247-259``); a nonzero ``x0`` warm-starts with the
     general ``r0 = b - A x0`` init (one extra in-kernel stencil apply).
-    Residual history is unsupported - use ``solver.cg`` for it.
+
+    ``record_history=True`` returns the kernel's residual trace at
+    CHECK-BLOCK granularity (quirk Q7 closed on this engine): a
+    ``(maxiter + 1,)`` array holding ``||r||`` at index 0 and at every
+    block boundary the solve actually reached (``check_every``,
+    ``2 * check_every``, ..., truncated at the cap), NaN elsewhere.
+    At those boundaries the values agree with the general solver's
+    per-iteration trace (up to f32 reduction-order rounding); for a
+    full per-iteration trace use ``solver.cg`` - ``engine="auto"``
+    keeps routing history requests there for exactly that reason.
     ``m`` accepts ``None`` or a ``ChebyshevPreconditioner`` built over
     THIS operator: its polynomial is applied in-kernel (pure VPU work on
     the resident planes - ``degree - 1`` extra stencil applies per
@@ -206,10 +223,15 @@ def cg_resident(
             "precision routes through solver.cg / solver.df64")
 
     kernel_fn = cg_resident_2d if len(grid) == 2 else cg_resident_3d
-    x2d, iters, rr, indef, conv, health = kernel_fn(
+    x2d, iters, rr, indef, conv, health, hist = kernel_fn(
         a.scale, b_grid, x0=x0, tol=tol, rtol=rtol, maxiter=maxiter,
         check_every=check_every, iter_cap=iter_cap, interpret=interpret,
         precond_degree=degree, lmin=lmin, lmax=lmax)
+
+    history = None
+    if record_history:
+        history = _expand_block_history(hist, maxiter, check_every,
+                                        iter_cap)
 
     res_norm = jnp.sqrt(rr)
     # converged/healthy come from INSIDE the kernel: recomputing the
@@ -227,7 +249,35 @@ def cg_resident(
     return CGResult(
         x=x, iterations=iters, residual_norm=res_norm,
         converged=converged, status=status,
-        indefinite=indef.astype(bool), residual_history=None)
+        indefinite=indef.astype(bool), residual_history=history)
+
+
+def _expand_block_history(hist, maxiter: int, check_every: int, iter_cap):
+    """Kernel block trace -> the general solver's ``(maxiter + 1,)``
+    ``residual_history`` layout: ``||r||`` at index 0 and at each block
+    boundary the solve reached, NaN elsewhere.  Boundary j lands at
+    ``min((j + 1) * check_every, cap)`` (the final partial block
+    truncates at the cap); never-run blocks carry NaN in the kernel
+    trace and their (duplicate, capped) indices are dropped rather than
+    allowed to overwrite a real final value."""
+    check_every = max(1, min(check_every, maxiter))
+    nblocks = -(-maxiter // check_every) if maxiter else 0
+    cap = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
+    full = jnp.full((maxiter + 1,), jnp.nan, jnp.float32)
+    full = full.at[0].set(jnp.sqrt(hist[0]))
+    if nblocks == 0:
+        return full
+    vals = hist[1:]
+    idx = jnp.minimum((jnp.arange(nblocks, dtype=jnp.int32) + 1)
+                      * jnp.int32(check_every), cap)
+    # The kernel marks never-run blocks with a -1.0 sentinel (||r||^2 is
+    # nonnegative; NaN in the always-emitted output would trip
+    # jax_debug_nans on every default solve).  Route sentinel slots out
+    # of bounds so mode="drop" discards them (several trailing blocks
+    # can share the capped index, and a sentinel must not clobber the
+    # real entry there); survivors become the NaN fill of `full`.
+    idx = jnp.where(vals < 0.0, jnp.int32(maxiter + 1), idx)
+    return full.at[idx].set(jnp.sqrt(jnp.abs(vals)), mode="drop")
 
 
 def supports_resident_df64(a, preconditioned: bool = False) -> bool:
@@ -249,6 +299,7 @@ def supports_resident_df64(a, preconditioned: bool = False) -> bool:
 def cg_resident_df64(
     a: Stencil2D,
     b,
+    x0=None,
     *,
     tol: float = 1e-7,
     rtol: float = 0.0,
@@ -257,6 +308,7 @@ def cg_resident_df64(
     iter_cap=None,
     preconditioner=None,
     precond_degree: int = 4,
+    record_history: bool = False,
     interpret: bool = False,
 ) -> DF64CGResult:
     """f64-class CG (df64 storage) entirely inside one VMEM-resident kernel.
@@ -273,11 +325,19 @@ def cg_resident_df64(
     array (lifted with zero lo words), or an explicit ``(hi, lo)`` pair;
     flat ``(n,)`` or grid ``(nx, ny)`` shapes are accepted, and the
     solution comes back flat (``DF64CGResult.x()`` recombines to f64).
+    ``x0`` takes the same forms and warm-starts the solve with the
+    general ``r0 = b - A x0`` init in full df64 (``None`` = the
+    reference's x0 = 0 fast path; the pair aliases the x output in
+    VMEM, so a warm start costs no extra planes).
 
     ``preconditioner``: ``None`` or ``"chebyshev"`` - the
     ``precond_degree``-term polynomial applied IN-KERNEL in df64
     arithmetic (``cg_df64``'s chebyshev semantics; spectral interval
     from the host-side ``solver.df64.chebyshev_interval``).
+
+    ``record_history=True`` returns the check-block-granular ``||r||``
+    trace (hi word - ``DF64CGResult.residual_history``'s documented
+    diagnostic semantics), laid out like :func:`cg_resident`'s.
     """
     if not isinstance(a, (Stencil2D, Stencil3D)):
         raise TypeError(
@@ -300,21 +360,30 @@ def cg_resident_df64(
     grid = a.grid
     n_cells = math.prod(grid)
 
-    if isinstance(b, tuple):
-        bh, bl = (np.asarray(b[0], np.float32), np.asarray(b[1], np.float32))
-    else:
-        b_np = np.asarray(b)
-        if b_np.dtype == np.float64:
-            bh, bl = df.split_f64(b_np)
+    def to_pair(v, what):
+        """host f64 (split), f32 (lifted), or explicit (hi, lo) -> a
+        grid-shaped df64 pair (the rhs coercion, shared with x0)."""
+        if isinstance(v, tuple):
+            vh = np.asarray(v[0], np.float32)
+            vl = np.asarray(v[1], np.float32)
         else:
-            bh = b_np.astype(np.float32)
-            bl = np.zeros_like(bh)
-    if bh.ndim == 1:
-        if bh.shape[0] != n_cells:
-            raise ValueError(f"rhs length {bh.shape[0]} != grid {grid}")
-        bh, bl = bh.reshape(grid), bl.reshape(grid)
-    elif bh.shape != grid:
-        raise ValueError(f"rhs shape {bh.shape} != grid {grid}")
+            v_np = np.asarray(v)
+            if v_np.dtype == np.float64:
+                vh, vl = df.split_f64(v_np)
+            else:
+                vh = v_np.astype(np.float32)
+                vl = np.zeros_like(vh)
+        if vh.ndim == 1:
+            if vh.shape[0] != n_cells:
+                raise ValueError(
+                    f"{what} length {vh.shape[0]} != grid {grid}")
+            vh, vl = vh.reshape(grid), vl.reshape(grid)
+        elif vh.shape != grid:
+            raise ValueError(f"{what} shape {vh.shape} != grid {grid}")
+        return vh, vl
+
+    bh, bl = to_pair(b, "rhs")
+    x0_pair = None if x0 is None else to_pair(x0, "x0")
 
     # re-split the scale from host f64 so non-exact scales keep their
     # low word (same as solver.df64._prepare_operator)
@@ -323,11 +392,16 @@ def cg_resident_df64(
 
     kernel_fn = (cg_resident_df64_2d if len(grid) == 2
                  else cg_resident_df64_3d)
-    xh, xl, iters, rr, indef, conv, health = kernel_fn(
-        (sh, sl), (bh, bl), tol=tol, rtol=rtol, maxiter=maxiter,
-        check_every=check_every, iter_cap=iter_cap, interpret=interpret,
-        precond_degree=degree, theta=theta, delta=delta)
+    xh, xl, iters, rr, indef, conv, health, hist = kernel_fn(
+        (sh, sl), (bh, bl), x0=x0_pair, tol=tol, rtol=rtol,
+        maxiter=maxiter, check_every=check_every, iter_cap=iter_cap,
+        interpret=interpret, precond_degree=degree, theta=theta,
+        delta=delta)
 
+    history = None
+    if record_history:
+        history = _expand_block_history(hist, maxiter, check_every,
+                                        iter_cap)
     converged = conv.astype(bool)
     healthy = health.astype(bool)
     status = jnp.where(
@@ -338,4 +412,4 @@ def cg_resident_df64(
         x_hi=xh.reshape(-1), x_lo=xl.reshape(-1), iterations=iters,
         residual_norm_sq_hi=rr[0], residual_norm_sq_lo=rr[1],
         converged=converged, status=status,
-        indefinite=indef.astype(bool), residual_history=None)
+        indefinite=indef.astype(bool), residual_history=history)

@@ -143,6 +143,11 @@ def load_checkpoint_df64(path: str, expect_fingerprint: str = ""):
             raise ValueError(
                 f"checkpoint {path} has format version {version}, "
                 f"expected {_FORMAT_VERSION}")
+        if "kind" in z and str(z["kind"]) == "df64-replay":
+            raise ValueError(
+                f"checkpoint {path} is a resident-engine replay "
+                f"checkpoint; resume it with solve_resumable_df64("
+                f"engine='resident') - or delete it to start fresh")
         if "kind" not in z or str(z["kind"]) != "df64":
             raise ValueError(
                 f"checkpoint {path} is not a df64 checkpoint; load it "
@@ -281,6 +286,8 @@ def solve_resumable_df64(
     maxiter: int = 2000,
     preconditioner=None,
     keep_checkpoint: bool = False,
+    engine: str = "general",
+    interpret: bool = False,
 ):
     """df64 sibling of :func:`solve_resumable`: f64-class long solves
     that survive preemption, checkpointing every ``segment_iters``.
@@ -289,13 +296,58 @@ def solve_resumable_df64(
     (static arg sizing the solve) while the traced ``iter_cap`` advances
     per segment.  State persists via the npz df64 checkpoint format;
     resuming continues the exact df64 trajectory.
+
+    ``engine="resident"`` runs segments on the VMEM-resident df64
+    kernel (``solver.resident.cg_resident_df64``) by REPLAY: each
+    segment re-runs the solve from iteration 0 up to the advancing
+    traced ``iter_cap`` inside one kernel launch, so the trajectory is
+    bitwise identical to an uninterrupted resident solve (same
+    executable, same inputs, deterministic recurrence; per-iteration
+    arithmetic does not depend on where block boundaries fall).  The
+    checkpoint stores only ``(k, x_hi, x_lo)`` - the kernel holds
+    r/p/rho in VMEM scratch, and the replay re-derives them - and the
+    per-segment replay cost is what the engine's ~an-order-of-magnitude
+    per-iteration advantage over the general solver buys back.
+    ``engine="auto"`` picks resident when
+    ``supports_resident_df64(a, preconditioned=...)`` holds, general
+    otherwise.  ``interpret`` runs the resident kernel in interpret
+    mode (CPU tests).
     """
     from ..solver.df64 import DF64CGResult, cg_df64  # noqa: F401
 
     if segment_iters < 1:
         raise ValueError(f"segment_iters must be >= 1, got {segment_iters}")
+    if engine not in ("general", "resident", "auto"):
+        raise ValueError(f"unknown engine {engine!r}; expected 'general', "
+                         f"'auto' or 'resident'")
     b64 = np.asarray(b, dtype=np.float64)
     fp = problem_fingerprint(a, b64)
+    if engine in ("resident", "auto"):
+        import jax
+
+        from ..solver.resident import supports_resident_df64
+
+        ok = supports_resident_df64(
+            a, preconditioned=preconditioner == "chebyshev")
+        ok = ok and preconditioner in (None, "chebyshev")
+        if engine == "auto":
+            # auto takes the resident kernel only where it runs
+            # compiled (or the caller explicitly asked for interpret
+            # mode): off-TPU, interpret-mode pallas is orders of
+            # magnitude slower than the general solver - the same rule
+            # as solve(engine="auto") in solver/cg.py.
+            ok = ok and (jax.default_backend() == "tpu" or interpret)
+        if engine == "resident" and not ok:
+            raise ValueError(
+                "engine='resident' needs a 2D/3D stencil whose df64 "
+                "working set fits VMEM and preconditioner None or "
+                "'chebyshev' - use engine='general' (or 'auto')")
+        if ok:
+            return _solve_resumable_df64_resident(
+                a, b64, path, segment_iters=segment_iters, tol=tol,
+                rtol=rtol, maxiter=maxiter, preconditioner=preconditioner,
+                keep_checkpoint=keep_checkpoint, fingerprint=fp,
+                interpret=interpret)
     state = None
     if os.path.exists(path):
         state = load_checkpoint_df64(path, expect_fingerprint=fp)
@@ -310,6 +362,76 @@ def solve_resumable_df64(
         save_checkpoint_df64(path, state, fingerprint=fp)
         finished = bool(res.converged) or int(res.iterations) >= maxiter \
             or res.status_enum().name == "BREAKDOWN"
+        if finished:
+            if bool(res.converged) and not keep_checkpoint:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            return res
+
+
+def _save_replay_ckpt(path, k, x_hi, x_lo, fingerprint):
+    """Replay-mode checkpoint: progress marker + current iterate.  The
+    resident kernel's r/p/rho live in VMEM scratch and are re-derived by
+    the replay; x is stored for inspection (it IS the current solution
+    estimate), k is what resume actually needs."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    np.savez(tmp, version=_FORMAT_VERSION, fingerprint=fingerprint,
+             kind="df64-replay", k=np.asarray(k),
+             x_hi=np.asarray(x_hi), x_lo=np.asarray(x_lo))
+    os.replace(tmp + ".npz", path)
+
+
+def _load_replay_k(path, expect_fingerprint) -> int:
+    with np.load(path) as z:
+        if "kind" not in z or str(z["kind"]) != "df64-replay":
+            raise ValueError(
+                f"checkpoint {path} is not a df64 replay checkpoint "
+                f"(engine='resident'); it belongs to the general-path "
+                f"format - resume with the engine that wrote it, or "
+                f"delete it to start fresh")
+        version = int(np.asarray(z["version"]))
+        if version != _FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint {path} has format version {version}, "
+                f"expected {_FORMAT_VERSION}")
+        stored = str(z["fingerprint"]) if "fingerprint" in z else ""
+        _check_fingerprint(stored, expect_fingerprint, path)
+        return int(np.asarray(z["k"]))
+
+
+def _solve_resumable_df64_resident(a, b64, path, *, segment_iters, tol,
+                                   rtol, maxiter, preconditioner,
+                                   keep_checkpoint, fingerprint,
+                                   interpret):
+    """Replay segmentation on the VMEM-resident df64 kernel (see
+    ``solve_resumable_df64``).  Every segment runs the SAME compiled
+    kernel with only the traced ``iter_cap`` advanced, so iterates at
+    any given iteration are bitwise identical across segmentations."""
+    from ..solver.resident import cg_resident_df64
+
+    done_k = 0
+    if os.path.exists(path):
+        done_k = _load_replay_k(path, fingerprint)
+    while True:
+        cap = min(done_k + segment_iters, maxiter)
+        res = cg_resident_df64(
+            a, b64, tol=tol, rtol=rtol, maxiter=maxiter,
+            preconditioner=preconditioner, iter_cap=cap,
+            interpret=interpret)
+        done_k = int(res.iterations)
+        _save_replay_ckpt(path, done_k, res.x_hi, res.x_lo, fingerprint)
+        finished = bool(res.converged) or done_k >= maxiter \
+            or res.status_enum().name == "BREAKDOWN"
+        # a stalled segment (iterations < cap without a finished status
+        # cannot happen: the kernel stops early only on convergence,
+        # breakdown, or the cap itself) - guard anyway so a logic bug
+        # surfaces as an error, not an infinite loop
+        if not finished and done_k < cap:
+            raise RuntimeError(
+                f"resident segment stopped at {done_k} < cap {cap} "
+                f"without converging - this is a bug")
         if finished:
             if bool(res.converged) and not keep_checkpoint:
                 try:

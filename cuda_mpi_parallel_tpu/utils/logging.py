@@ -48,13 +48,19 @@ def solve_record(result, elapsed_s: Optional[float] = None,
 
 
 def format_history(result, every: int = 1) -> str:
-    """Compact per-iteration residual trace (absent from the reference)."""
+    """Compact residual trace (absent from the reference).
+
+    NaN slots are skipped: the resident engine's trace is check-block
+    granular (values only at block boundaries, NaN between - see
+    ``cg_resident(record_history=True)``), and per-iteration traces have
+    no NaNs below ``result.iterations`` so nothing is hidden there.
+    """
     if result.residual_history is None:
         return "(history not recorded)"
     hist = np.asarray(result.residual_history)
     k = int(result.iterations)
     lines = [f"  iter {i:5d}  ||r|| = {hist[i]:.6e}"
-             for i in range(0, k + 1, every)]
+             for i in range(0, k + 1, every) if np.isfinite(hist[i])]
     return "\n".join(lines)
 
 
