@@ -37,6 +37,7 @@ from .solver.resident import (
     supports_resident_df64,
 )
 from .solver.status import CGStatus
+from .solver.streaming import cg_streaming, supports_streaming_op
 
 __version__ = "0.1.0"
 
@@ -59,7 +60,9 @@ __all__ = [
     "cg_df64",
     "cg_resident",
     "cg_resident_df64",
+    "cg_streaming",
     "solve",
     "supports_resident",
     "supports_resident_df64",
+    "supports_streaming_op",
 ]

@@ -102,12 +102,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "XLA fused adds or the pallas slab-DMA kernel "
                         "(auto picks by grid size)")
     p.add_argument("--engine", default="auto",
-                   choices=["auto", "general", "resident"],
+                   choices=["auto", "general", "resident", "streaming"],
                    help="solver engine: 'general' is the jitted "
                         "lax.while_loop solver; 'resident' runs the WHOLE "
                         "solve as one VMEM-resident pallas kernel (2D "
                         "stencil, f32, unpreconditioned - ~2.9x faster at "
-                        "1M unknowns); 'auto' picks resident when eligible")
+                        "1M unknowns); 'streaming' is the fused-iteration "
+                        "HBM-streaming engine for f32 stencils past the "
+                        "VMEM boundary (the 256^3 path, 8 plane-passes/"
+                        "iter vs the general solver's ~16); 'auto' picks "
+                        "resident, then streaming, when eligible")
     p.add_argument("--method", default="cg",
                    choices=["cg", "cg1", "pipecg"],
                    help="CG recurrence: textbook (the reference's, two "
@@ -314,12 +318,23 @@ def main(argv=None) -> int:
         if args.mesh > 1:
             raise SystemExit("--engine resident is single-device "
                              "(no --mesh > 1)")
-        if (args.precond not in (None, "chebyshev") or args.method != "cg"
-                or args.history):
+        if args.precond not in (None, "chebyshev") or args.method != "cg":
             raise SystemExit("--engine resident supports --method cg with "
-                             "--precond chebyshev or none, without "
-                             "--history (the one-kernel solve records no "
-                             "trace)")
+                             "--precond chebyshev or none (--history is "
+                             "fine: the kernel records a check-block-"
+                             "granular trace)")
+    if args.engine == "streaming":
+        if args.mesh > 1:
+            raise SystemExit("--engine streaming is single-device "
+                             "(no --mesh > 1)")
+        if args.precond is not None or args.method != "cg":
+            raise SystemExit("--engine streaming supports --method cg "
+                             "unpreconditioned (--history is fine: the "
+                             "trace is per-iteration)")
+        if args.df64:
+            raise SystemExit("--engine streaming is float32-only "
+                             "(--dtype df64 routes through the general "
+                             "or resident df64 solvers)")
 
     def run():
         if args.df64:
@@ -345,7 +360,9 @@ def main(argv=None) -> int:
                                 a,
                                 preconditioned=args.precond == "chebyshev")
                             and args.precond in (None, "chebyshev")
-                            and args.method == "cg" and not args.history
+                            and args.method == "cg"
+                            and (not args.history
+                                 or args.engine == "resident")
                             and (args.engine == "resident"
                                  or _jax_backend_is_tpu()))
                 if args.engine == "resident" and not eligible:
@@ -358,6 +375,7 @@ def main(argv=None) -> int:
                         a, np.asarray(b, dtype=np.float64), tol=args.tol,
                         rtol=args.rtol, maxiter=args.maxiter,
                         check_every=args.check_every,
+                        record_history=args.history,
                         preconditioner=args.precond,
                         precond_degree=args.precond_degree,
                         interpret=_pallas_interpret())
@@ -409,8 +427,13 @@ def main(argv=None) -> int:
             # a 30-matvec power iteration, so it must not be built for
             # solves that cannot take the resident path anyway.
             # resident_eligible stays the final authority.
+            # --history is resident-eligible only on an EXPLICIT
+            # --engine resident (block-granular trace, user opted in);
+            # auto keeps history on the general solver's per-iteration
+            # granularity - same rule as solve(engine=...).
+            history_ok = not args.history or args.engine == "resident"
             cheap_ok = (args.precond in (None, "chebyshev")
-                        and args.method == "cg" and not args.history
+                        and args.method == "cg" and history_ok
                         and (args.engine == "resident"
                              or _jax_backend_is_tpu())
                         and supports_resident(
@@ -423,7 +446,8 @@ def main(argv=None) -> int:
                     a, degree=args.precond_degree)
             eligible = cheap_ok and resident_eligible(
                 a, b, m_res, method=args.method,
-                record_history=args.history)
+                record_history=(args.history
+                                and args.engine != "resident"))
             if args.engine == "resident" and not eligible:
                 raise SystemExit(
                     f"--engine resident does not support "
@@ -435,7 +459,34 @@ def main(argv=None) -> int:
                 return cg_resident(a, b, tol=args.tol, rtol=args.rtol,
                                    maxiter=args.maxiter,
                                    check_every=args.check_every,
-                                   m=m_res, interpret=_pallas_interpret())
+                                   m=m_res, record_history=args.history,
+                                   interpret=_pallas_interpret())
+        if args.engine in ("auto", "streaming"):
+            from .models.operators import _pallas_interpret
+            from .solver.streaming import cg_streaming, streaming_eligible
+
+            # same auto-only-on-TPU rule as the resident engine; the
+            # shared streaming_eligible predicate is the authority
+            # (one source of truth with solve(engine="streaming")).
+            eligible = ((args.engine == "streaming"
+                         or _jax_backend_is_tpu())
+                        and args.precond is None
+                        and streaming_eligible(
+                            a, b, method=args.method,
+                            record_history=args.history))
+            if args.engine == "streaming" and not eligible:
+                raise SystemExit(
+                    f"--engine streaming does not support "
+                    f"{type(a).__name__} at this size/dtype (needs a "
+                    f"float32 2D/3D stencil satisfying the slab tiling "
+                    f"and a float32 rhs; try --problem poisson3d "
+                    f"--matrix-free)")
+            if eligible:
+                return cg_streaming(a, b, tol=args.tol, rtol=args.rtol,
+                                    maxiter=args.maxiter,
+                                    check_every=args.check_every,
+                                    record_history=args.history,
+                                    interpret=_pallas_interpret())
         from . import solve
         from .models.operators import JacobiPreconditioner
         from .models.precond import (

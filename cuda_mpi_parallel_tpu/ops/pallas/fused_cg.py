@@ -1,0 +1,410 @@
+"""Fused-iteration HBM-streaming CG kernels (the 256^3 north-star path).
+
+The VMEM-resident engine (``resident.py``) ends at the VMEM boundary
+(~128^3 f32).  Beyond it - BASELINE config #4's 256^3 grid, 67 MB per
+vector - the general ``lax.while_loop`` solver runs each CG iteration as
+several XLA fusions whose intermediates cross HBM at every fusion
+boundary: measured 1.344 ms/iter at 256^3 on v5e, consistent with ~16
+full plane-passes of HBM traffic per iteration against the reference's
+hot loop (``CUDACG.cu:269-352``).
+
+These kernels carry the resident engine's idea - fuse the whole
+iteration, keep intermediates on-chip - past the VMEM boundary by
+streaming double-buffered slabs (``stencil.py``'s DMA pattern) through
+TWO pallas launches per iteration, the minimum the CG data flow allows
+(each of the two inner products is a global barrier: alpha needs ALL of
+p.Ap before any x/r update, beta needs ALL of ||r||^2 before any p
+update):
+
+* **pass A** (``p`` update + matvec + first dot): reads r and p with
+  halo slabs, forms ``p_new = r + beta * p`` in VMEM (the p-update of
+  the PREVIOUS iteration, deferred so it fuses with this iteration's
+  matvec), writes ``p_new``, applies the stencil in-register, and
+  accumulates ``p_new . A p_new`` into SMEM across the sequential grid.
+  ``Ap`` is NOT written to HBM - pass B recomputes it, trading ~1 slab
+  of VPU stencil work for a full plane-pass of traffic each way.
+* **pass B** (vector updates + second dot): reads ``p_new`` with halo,
+  recomputes ``Ap``, updates ``x += alpha p_new`` and
+  ``r -= alpha Ap`` in place (blocked, pipelined, input/output
+  aliased), accumulating ``||r_new||^2``.
+
+Per-iteration HBM traffic: pass A reads r, p and writes p_new (3
+plane-passes + halo), pass B reads p_new, x, r and writes x, r (5) -
+**8 plane-passes** vs the general solver's ~16, i.e. ~0.55 GB/iter at
+256^3 against v5e's 819 GB/s => ~0.67 ms/iter floor.  The scalar
+recurrence (alpha, beta, convergence) stays in the surrounding jitted
+``lax.while_loop`` (``solver/streaming.py``) - scalars never leave the
+device, launches stay at 2/iter inside one executable.
+
+Trajectory: mathematically identical to ``solver.cg`` (same recurrence,
+x0 = 0 fast path, ``_safe_div`` semantics); inner products accumulate
+slab-by-slab in grid order, so values agree with the general solver's
+full-array dots to f32 reduction-order rounding.
+
+Interpret mode runs the same kernels on CPU for tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .stencil import (
+    _HALO,
+    _shift_left,
+    _shift_right,
+    _slab_copy,
+    _slab_copy3d,
+    _slab_wait,
+    _slab_wait3d,
+)
+
+# VMEM budget for one fused-CG launch: pass B's pipelined blocked
+# arrays (x, r in + out, double-buffered = 8 slab-heights) dominate;
+# pass A holds 4 halo slabs.  Sized against the 128 MiB parts with
+# room for Mosaic temporaries.
+_VMEM_BUDGET = 64 * 1024 * 1024
+
+
+def _stencil_slab_2d(u, scale, bm):
+    """5-point Laplacian on a (bm + 2*_HALO, ny) halo slab -> (bm, ny)
+    interior (the compute body of ``stencil._stencil2d_kernel``)."""
+    w = u[_HALO - 1:_HALO + bm + 1]
+    mid = w[1:-1]
+    up = w[:-2]
+    down = w[2:]
+    left = _shift_right(mid)
+    right = _shift_left(mid)
+    return scale * (4.0 * mid - up - down - left - right)
+
+
+def _stencil_slab_3d(u, scale):
+    """7-point Laplacian on a (bm+2, ny, nz) halo slab -> (bm, ny, nz)
+    interior (the compute body of ``stencil._stencil3d_kernel``)."""
+    mid = u[1:-1]
+    xm = u[:-2]
+    xp = u[2:]
+    ym = jnp.concatenate(
+        [jnp.zeros_like(mid[:, :1]), mid[:, :-1]], axis=1)
+    yp = jnp.concatenate(
+        [mid[:, 1:], jnp.zeros_like(mid[:, :1])], axis=1)
+    zm = _shift_right(mid)
+    zp = _shift_left(mid)
+    return scale * (6.0 * mid - xm - xp - ym - yp - zm - zp)
+
+
+def _interior(slab, bm, ndim):
+    """The bm-row/plane interior of a halo slab (2D slabs carry _HALO
+    rows each side, 3D slabs one plane each side)."""
+    if ndim == 2:
+        return slab[_HALO:_HALO + bm]
+    return slab[1:-1]
+
+
+def _halo_pm1(slab, bm, ndim):
+    """Interior plus exactly one halo row/plane each side: the region a
+    one-step stencil of the interior needs."""
+    if ndim == 2:
+        return slab[_HALO - 1:_HALO + bm + 1]
+    return slab
+
+
+def _fill_edge_halo(slab, lo_ref, hi_ref, block, bm, nx, ndim):
+    """Overwrite the one consumed boundary row/plane of an edge block's
+    slab with neighbor halo data (distributed row-partition: the global
+    Dirichlet zero-fill becomes the neighbor's boundary).  ``_slab_copy*``
+    zero-filled the edge region; only the +-1 row/plane the stencil
+    actually reads is replaced."""
+    nblocks = nx // bm
+    lo_at = _HALO - 1 if ndim == 2 else 0
+    hi_at = _HALO + bm if ndim == 2 else bm + 1
+
+    def fill_lo():
+        slab[lo_at:lo_at + 1] = lo_ref[:]
+
+    def fill_hi():
+        slab[hi_at:hi_at + 1] = hi_ref[:]
+
+    if nblocks == 1:
+        fill_lo()
+        fill_hi()
+        return
+    pl.when(block == 0)(fill_lo)
+    pl.when(block == nblocks - 1)(fill_hi)
+
+
+# -- pass A: p_new = r + beta * p; pap = p_new . A p_new ----------------------
+
+
+def _pass_a_kernel(params_ref, *refs, bm, nx, ndim, has_halo):
+    if has_halo:
+        (r_lo, r_hi, p_lo, p_hi, r_hbm, p_hbm, pnew_ref, pap_ref,
+         rslabs, pslabs, sems, acc) = refs
+    else:
+        (r_hbm, p_hbm, pnew_ref, pap_ref,
+         rslabs, pslabs, sems, acc) = refs
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    copy, wait = (_slab_copy, _slab_wait) if ndim == 2 else (
+        _slab_copy3d, _slab_wait3d)
+
+    @pl.when(i == 0)
+    def _():
+        acc[0] = jnp.float32(0.0)
+        copy(r_hbm, rslabs.at[0], sems.at[0], 0, bm, nx)
+        copy(p_hbm, pslabs.at[0], sems.at[2], 0, bm, nx)
+
+    @pl.when(i + 1 < n)
+    def _():
+        copy(r_hbm, rslabs.at[(i + 1) % 2], sems.at[(i + 1) % 2],
+             i + 1, bm, nx)
+        copy(p_hbm, pslabs.at[(i + 1) % 2], sems.at[2 + (i + 1) % 2],
+             i + 1, bm, nx)
+
+    wait(r_hbm, rslabs.at[i % 2], sems.at[i % 2], i, bm, nx)
+    wait(p_hbm, pslabs.at[i % 2], sems.at[2 + i % 2], i, bm, nx)
+    if has_halo:
+        _fill_edge_halo(rslabs.at[i % 2], r_lo, r_hi, i, bm, nx, ndim)
+        _fill_edge_halo(pslabs.at[i % 2], p_lo, p_hi, i, bm, nx, ndim)
+
+    scale = params_ref[0]
+    beta = params_ref[1]
+    # The deferred p-update: p_new on the FULL halo slab (elementwise, so
+    # the halo rows come straight from r/p's halos - no cross-slab
+    # dependency on p_new values this pass writes).
+    pnew_slab = rslabs[i % 2] + beta * pslabs[i % 2]
+    if ndim == 2:
+        ap = _stencil_slab_2d(pnew_slab, scale, bm)
+    else:
+        ap = _stencil_slab_3d(pnew_slab, scale)
+    pnew_int = _interior(pnew_slab, bm, ndim)
+    pnew_ref[:] = pnew_int
+    acc[0] += jnp.sum(pnew_int * ap)
+
+    @pl.when(i == n - 1)
+    def _():
+        pap_ref[0] = acc[0]
+
+
+# -- pass B: x += alpha p; r -= alpha Ap; rr = r.r ----------------------------
+
+
+def _pass_b_kernel(alpha_ref, *refs, bm, nx, ndim, has_halo):
+    if has_halo:
+        (pn_lo, pn_hi, pnew_hbm, x_ref, r_ref,
+         xout_ref, rout_ref, rr_ref, pslabs, sems, acc) = refs
+    else:
+        (pnew_hbm, x_ref, r_ref,
+         xout_ref, rout_ref, rr_ref, pslabs, sems, acc) = refs
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    copy, wait = (_slab_copy, _slab_wait) if ndim == 2 else (
+        _slab_copy3d, _slab_wait3d)
+
+    @pl.when(i == 0)
+    def _():
+        acc[0] = jnp.float32(0.0)
+        copy(pnew_hbm, pslabs.at[0], sems.at[0], 0, bm, nx)
+
+    @pl.when(i + 1 < n)
+    def _():
+        copy(pnew_hbm, pslabs.at[(i + 1) % 2], sems.at[(i + 1) % 2],
+             i + 1, bm, nx)
+
+    wait(pnew_hbm, pslabs.at[i % 2], sems.at[i % 2], i, bm, nx)
+    if has_halo:
+        _fill_edge_halo(pslabs.at[i % 2], pn_lo, pn_hi, i, bm, nx, ndim)
+
+    scale = alpha_ref[0]
+    alpha = alpha_ref[1]
+    slab = pslabs[i % 2]
+    if ndim == 2:
+        ap = _stencil_slab_2d(slab, scale, bm)
+    else:
+        ap = _stencil_slab_3d(slab, scale)
+    pnew_int = _interior(slab, bm, ndim)
+    xout_ref[:] = x_ref[:] + alpha * pnew_int       # CUDACG.cu:314
+    r_new = r_ref[:] - alpha * ap                   # CUDACG.cu:320-321
+    rout_ref[:] = r_new
+    acc[0] += jnp.sum(r_new * r_new)                # CUDACG.cu:328
+
+    @pl.when(i == n - 1)
+    def _():
+        rr_ref[0] = acc[0]
+
+
+def _slab_shape(bm, grid_shape):
+    if len(grid_shape) == 2:
+        return (bm + 2 * _HALO, grid_shape[1])
+    return (bm + 2, grid_shape[1], grid_shape[2])
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "interpret"))
+def fused_cg_pass_a(scale, beta, r, p, halos=None, *, bm: int,
+                    interpret: bool = False):
+    """One streamed pass: ``p_new = r + beta * p``; ``pap = p_new . A p_new``.
+
+    ``r``/``p``: full grids ((nx, ny) or (nx, ny, nz)) in HBM; returns
+    ``(p_new, pap)``.  ``beta``/``scale`` ride in SMEM so sweeps reuse
+    the executable.
+
+    ``halos``: optional ``(r_lo, r_hi, p_lo, p_hi)`` neighbor boundary
+    rows/planes (each ``(1,) + shape[1:]``) for the distributed
+    row-partition - they replace the global Dirichlet zero edge, and the
+    returned ``pap`` is then the LOCAL partial sum the caller psums.
+    """
+    shape = r.shape
+    ndim = r.ndim
+    nx = shape[0]
+    has_halo = halos is not None
+    params = jnp.stack([jnp.asarray(scale, jnp.float32),
+                        jnp.asarray(beta, jnp.float32)])
+    kernel = functools.partial(_pass_a_kernel, bm=bm, nx=nx, ndim=ndim,
+                               has_halo=has_halo)
+    block = (bm,) + shape[1:]
+    index_map = (lambda i: (i, 0)) if ndim == 2 else (lambda i: (i, 0, 0))
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    halo_inputs = tuple(halos) if has_halo else ()
+    pnew, pap = pl.pallas_call(
+        kernel,
+        grid=(nx // bm,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [vmem] * len(halo_inputs)                 # halo rows (tiny)
+        + [
+            pl.BlockSpec(memory_space=pl.ANY),      # r (manual halo DMA)
+            pl.BlockSpec(memory_space=pl.ANY),      # p (manual halo DMA)
+        ],
+        out_specs=[
+            pl.BlockSpec(block, index_map),         # p_new (pipelined)
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # pap
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape, jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2,) + _slab_shape(bm, shape), jnp.float32),  # r
+            pltpu.VMEM((2,) + _slab_shape(bm, shape), jnp.float32),  # p
+            pltpu.SemaphoreType.DMA((4,)),
+            pltpu.SMEM((1,), jnp.float32),          # pap accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_BUDGET),
+        interpret=interpret,
+    )(params, *halo_inputs, r, p)
+    return pnew, pap[0]
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "interpret"))
+def fused_cg_pass_b(scale, alpha, pnew, x, r, halos=None, *, bm: int,
+                    interpret: bool = False):
+    """One streamed pass: ``x += alpha p``, ``r -= alpha A p``,
+    ``rr = r . r`` - with ``A p`` recomputed from ``p_new``'s halo slabs
+    rather than read back from HBM.  Returns ``(x_new, r_new, rr)``;
+    the x/r inputs are donated to their outputs (in-place update).
+
+    ``halos``: optional ``(pn_lo, pn_hi)`` neighbor boundary rows/planes
+    of ``p_new`` for the distributed row-partition; ``rr`` is then the
+    local partial the caller psums.
+    """
+    shape = x.shape
+    ndim = x.ndim
+    nx = shape[0]
+    has_halo = halos is not None
+    params = jnp.stack([jnp.asarray(scale, jnp.float32),
+                        jnp.asarray(alpha, jnp.float32)])
+    kernel = functools.partial(_pass_b_kernel, bm=bm, nx=nx, ndim=ndim,
+                               has_halo=has_halo)
+    block = (bm,) + shape[1:]
+    index_map = (lambda i: (i, 0)) if ndim == 2 else (lambda i: (i, 0, 0))
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    halo_inputs = tuple(halos) if has_halo else ()
+    nh = len(halo_inputs)
+    x_new, r_new, rr = pl.pallas_call(
+        kernel,
+        grid=(nx // bm,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [vmem] * nh                               # p_new halo rows
+        + [
+            pl.BlockSpec(memory_space=pl.ANY),      # p_new (manual halo DMA)
+            pl.BlockSpec(block, index_map),         # x (pipelined)
+            pl.BlockSpec(block, index_map),         # r (pipelined)
+        ],
+        out_specs=[
+            pl.BlockSpec(block, index_map),         # x out
+            pl.BlockSpec(block, index_map),         # r out
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # rr
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape, jnp.float32),
+            jax.ShapeDtypeStruct(shape, jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2,) + _slab_shape(bm, shape), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SMEM((1,), jnp.float32),
+        ],
+        # x and r update in place: same-index blocked specs, elementwise
+        # math - the pipelined fetch of block i+1 never overlaps the
+        # writeback of block i's rows.
+        input_output_aliases={2 + nh: 0, 3 + nh: 1},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_BUDGET),
+        interpret=interpret,
+    )(params, *halo_inputs, pnew, x, r)
+    return x_new, r_new, rr[0]
+
+
+def pick_block_streaming(shape, itemsize: int = 4,
+                         budget_bytes: int = 24 * 1024 * 1024) -> int:
+    """Slab height for the fused-CG passes.
+
+    The binding constraint is pass B: two manual p_new halo slabs plus
+    four pipelined blocked buffers (x, r in + out, double-buffered = 8
+    block-heights) plus stencil temporaries (~4 slab copies before
+    Mosaic reuses).  ~14 block-heights of the row/plane size must fit
+    the budget; the largest power-of-two divisor wins (bigger slabs =
+    fewer grid steps = less DMA bookkeeping), capped at 128 rows / 8
+    planes like the plain stencil kernels' measured sweet spots.
+    """
+    nx = shape[0]
+    row_bytes = itemsize
+    for d in shape[1:]:
+        row_bytes *= d
+    halo = 2 * _HALO if len(shape) == 2 else 2
+    best = 0
+    bm = 8 if len(shape) == 2 else 1
+    while bm <= nx:
+        if nx % bm == 0 and 14 * (bm + halo) * row_bytes <= budget_bytes:
+            best = bm
+        bm *= 2
+    if not best:
+        raise ValueError(
+            f"no feasible fused-CG block for grid {shape}: one "
+            f"row/plane is {row_bytes} bytes")
+    cap = 128 if len(shape) == 2 else 8
+    return min(best, cap) if nx % cap == 0 and best >= cap else best
+
+
+def supports_streaming(shape) -> bool:
+    """Shape gate of the fused-CG kernels: the plain stencil kernels'
+    DMA tiling constraints, plus a feasible slab height."""
+    if len(shape) == 2:
+        nx, ny = shape
+        ok = nx % 8 == 0 and ny % 128 == 0
+    elif len(shape) == 3:
+        nx, ny, nz = shape
+        ok = nx % 2 == 0 and ny % 8 == 0 and nz % 128 == 0
+    else:
+        return False
+    if not ok:
+        return False
+    try:
+        pick_block_streaming(shape)
+    except ValueError:
+        return False
+    return True

@@ -4,6 +4,7 @@ collectives - the TPU-native communication backend the reference's repo name
 
 from . import multihost
 from .df64 import DistStencilDF64, solve_distributed_df64
+from .streaming import solve_distributed_streaming
 from .dist_cg import solve_distributed
 from .halo import exchange_halo, exchange_halo_axis, neighbor_shift_perms
 from .mesh import (
@@ -55,4 +56,5 @@ __all__ = [
     "shard_vector",
     "solve_distributed",
     "solve_distributed_df64",
+    "solve_distributed_streaming",
 ]

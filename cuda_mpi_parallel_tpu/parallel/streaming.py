@@ -1,0 +1,175 @@
+"""Distributed fused-iteration streaming CG: the 256^3-class kernel
+under a row-partitioned mesh.
+
+``solve_distributed_streaming`` runs the fused-CG slab kernels
+(``ops/pallas/fused_cg.py``) as the LOCAL step of a 1-D slab
+decomposition inside ``jax.shard_map``: each shard streams its own
+rows/planes through pass A / pass B, the two inner products psum their
+slab-accumulated partials over ICI, and the stencil's cross-shard
+dependencies ride ``lax.ppermute`` halo exchange - the neighbor
+boundary row/plane replaces the kernels' global Dirichlet zero edge
+(``fused_cg._fill_edge_halo``).  Per-chip HBM traffic stays at the
+single-device fused path's 8 plane-passes per iteration; the halo
+messages are one row/plane each way per array per pass, riding ICI.
+
+Trajectory: identical to the single-device fused path up to the psum's
+reduction-order rounding of the already-slab-accumulated partials;
+1-vs-N-device iteration equality is asserted in
+``tests/test_streaming.py`` and ``__graft_entry__.dryrun_multichip``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.operators import Stencil2D, Stencil3D, _pallas_interpret
+from ..ops.pallas.fused_cg import (
+    fused_cg_pass_a,
+    fused_cg_pass_b,
+    pick_block_streaming,
+    supports_streaming,
+)
+from ..solver.cg import CGResult, _safe_div, _threshold_sq
+from ..solver.status import CGStatus
+from ..solver.streaming import _blocked_while_streaming
+from .halo import exchange_halo
+from .mesh import make_mesh, shard_vector
+
+#: compiled-solver cache, same policy as ``dist_cg._SOLVER_CACHE``
+_CACHE: dict = {}
+
+
+def clear_streaming_cache() -> None:
+    _CACHE.clear()
+
+
+def solve_distributed_streaming(
+    a,
+    b,
+    *,
+    mesh: Optional[Mesh] = None,
+    n_devices: Optional[int] = None,
+    tol: float = 1e-7,
+    rtol: float = 0.0,
+    maxiter: int = 2000,
+    check_every: int = 1,
+) -> CGResult:
+    """Solve A x = b with the fused streaming kernels over a slab mesh.
+
+    ``a``: global f32 ``Stencil2D``/``Stencil3D`` whose leading grid axis
+    divides the mesh and whose per-shard slab satisfies the fused-CG
+    tiling.  Other arguments as ``solver.streaming.cg_streaming``.
+    Returns a ``CGResult`` with the global (sharded) solution.
+    """
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    if len(mesh.axis_names) != 1:
+        raise ValueError(
+            "solve_distributed_streaming supports 1-D (slab) meshes; "
+            "use solve_distributed for pencil decompositions")
+    if not isinstance(a, (Stencil2D, Stencil3D)):
+        raise TypeError(
+            f"solve_distributed_streaming needs a Stencil2D/Stencil3D, "
+            f"got {type(a).__name__}")
+    if a.dtype != jnp.float32:
+        raise ValueError(
+            f"the streaming engine is float32-only, got {a.dtype}")
+    axis = mesh.axis_names[0]
+    n_shards = mesh.devices.size
+    grid = a.grid
+    if grid[0] % n_shards:
+        raise ValueError(
+            f"leading grid axis {grid[0]} does not divide over "
+            f"{n_shards} shards")
+    local_grid = (grid[0] // n_shards,) + grid[1:]
+    if not supports_streaming(local_grid):
+        raise ValueError(
+            f"per-shard slab {local_grid} does not satisfy the fused-CG "
+            f"tiling (2D: nx % 8 == 0, ny % 128 == 0; 3D: nx % 2 == 0, "
+            f"ny % 8 == 0, nz % 128 == 0)")
+    bm = pick_block_streaming(local_grid)
+    b = shard_vector(jnp.asarray(b, jnp.float32), mesh, axis)
+    interpret = _pallas_interpret()
+
+    key = ("streaming", local_grid, n_shards, axis, mesh, maxiter,
+           check_every, bm, interpret)
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = _CACHE[key] = jax.jit(_build(
+            mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
+            interpret))
+    return fn(b, a.scale, jnp.asarray(tol, jnp.float32),
+              jnp.asarray(rtol, jnp.float32))
+
+
+def _build(mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
+           interpret):
+    out_specs = CGResult(
+        x=P(axis), iterations=P(), residual_norm=P(), converged=P(),
+        status=P(), indefinite=P(), residual_history=None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P(axis), P(), P(), P()),
+             out_specs=out_specs, check_vma=False)
+    def run(b_local, scale, tol, rtol):
+        b_grid = b_local.reshape(local_grid)
+        x = jnp.zeros(local_grid, jnp.float32)   # explicit x0 = 0 (Q6)
+        r = b_grid                               # r0 = b (CUDACG.cu:248)
+        rr0 = lax.psum(jnp.vdot(r, r), axis)
+        nrm0 = jnp.sqrt(rr0)
+        thresh_sq = _threshold_sq(tol, rtol, nrm0, jnp.float32)
+
+        state = (jnp.zeros((), jnp.int32), x, r,
+                 jnp.zeros(local_grid, jnp.float32),
+                 jnp.zeros((), jnp.float32), rr0,
+                 jnp.zeros((), jnp.bool_), jnp.zeros((), jnp.float32))
+
+        def cond(s):
+            k, _, _, _, _, rho, _, _ = s
+            return (k < maxiter) & (rho >= thresh_sq) & (rho > 0) \
+                & jnp.isfinite(rho)
+
+        def step(s):
+            k, x, r, p_prev, beta_prev, rho, indef, _ = s
+            r_lo, r_hi = exchange_halo(r, axis, n_shards)
+            p_lo, p_hi = exchange_halo(p_prev, axis, n_shards)
+            p, pap_local = fused_cg_pass_a(
+                scale, beta_prev, r, p_prev, (r_lo, r_hi, p_lo, p_hi),
+                bm=bm, interpret=interpret)
+            pap = lax.psum(pap_local, axis)
+            indef = indef | ((pap <= 0) & (rho > 0))
+            alpha = _safe_div(rho, pap)
+            # p_new's boundary rows are derivable LOCALLY from the
+            # halos already exchanged for pass A (beta is a global
+            # scalar, so the neighbor's p_new edge is exactly
+            # r_edge + beta * p_edge; zeros at the global boundary stay
+            # zeros) - no third ppermute round-trip per iteration.
+            pn_lo = r_lo + beta_prev * p_lo
+            pn_hi = r_hi + beta_prev * p_hi
+            x, r, rr_local = fused_cg_pass_b(
+                scale, alpha, p, x, r, (pn_lo, pn_hi), bm=bm,
+                interpret=interpret)
+            rr = lax.psum(rr_local, axis)
+            beta = _safe_div(rr, rho)
+            return (k + 1, x, r, p, beta, rr, indef, rr)
+
+        state = _blocked_while_streaming(cond, step, state, check_every,
+                                         maxiter, maxiter)
+        k, x, r, _, _, rho, indef, _ = state
+        healthy = jnp.isfinite(rho)
+        converged = (rho < thresh_sq) | (rho == 0)
+        status = jnp.where(
+            converged, jnp.int32(CGStatus.CONVERGED),
+            jnp.where(~healthy, jnp.int32(CGStatus.BREAKDOWN),
+                      jnp.int32(CGStatus.MAXITER)))
+        return CGResult(
+            x=x.reshape(-1), iterations=k, residual_norm=jnp.sqrt(rho),
+            converged=converged, status=status,
+            indefinite=indef, residual_history=None)
+
+    return run

@@ -732,14 +732,15 @@ def solve(
     ``engine``: ``"general"`` (default - the ``lax.while_loop`` solver,
     every operator/feature), ``"resident"`` (the single-pallas-kernel
     VMEM-resident engine, ``solver.resident`` - raises if the problem is
-    outside its scope), or ``"auto"`` (resident when eligible on a
-    compiled TPU backend - f32 2D/3D stencil fitting VMEM, ``m``
-    ``None`` or Chebyshev, ``method="cg"``, f32 ``x0`` or none, no
-    history/checkpointing - otherwise general).
+    outside its scope), ``"streaming"`` (the fused-iteration
+    HBM-streaming engine, ``solver.streaming`` - f32 stencils of ANY
+    slab-supported size, the 256^3 north-star path; raises if out of
+    scope), or ``"auto"`` (on a compiled TPU backend: resident when
+    eligible, else streaming when eligible, else general).
     """
-    if engine not in ("general", "auto", "resident"):
+    if engine not in ("general", "auto", "resident", "streaming"):
         raise ValueError(f"unknown engine {engine!r}; expected 'general', "
-                         f"'auto' or 'resident'")
+                         f"'auto', 'resident' or 'streaming'")
     if not isinstance(a, LinearOperator):
         a = _as_operator(a)
     if engine in ("auto", "resident"):
@@ -748,11 +749,18 @@ def solve(
 
         # Cheap backend gate first: resident_eligible's Chebyshev scale
         # comparison is a device sync, pointless off-TPU under "auto".
+        # Explicit engine="resident" accepts record_history (the kernel
+        # emits a check-block-granular trace); "auto" keeps routing
+        # history requests to the general solver, whose trace is
+        # per-iteration - auto must never silently change a result's
+        # meaning.
         eligible = ((engine == "resident"
                      or jax.default_backend() == "tpu")
                     and resident_eligible(
                         a, b, m, method=method,
-                        record_history=record_history, x0=x0,
+                        record_history=(record_history
+                                        and engine != "resident"),
+                        x0=x0,
                         resume_from=resume_from,
                         return_checkpoint=return_checkpoint,
                         compensated=compensated))
@@ -761,14 +769,41 @@ def solve(
                 "engine='resident' needs a float32 2D/3D stencil whose "
                 "CG working set fits VMEM, a float32 rhs, m=None or a "
                 "Chebyshev preconditioner built over this operator, "
-                "method='cg', f32 x0 or none, and no history/"
+                "method='cg', f32 x0 or none, and no "
                 "checkpointing - use engine='general' (or 'auto') "
                 "otherwise")
         if eligible:
             return cg_resident(a, b, x0, tol=tol, rtol=rtol,
                                maxiter=maxiter, check_every=check_every,
                                iter_cap=iter_cap, m=m,
+                               record_history=record_history,
                                interpret=_pallas_interpret())
+    if engine in ("auto", "streaming"):
+        from ..models.operators import _pallas_interpret
+        from .streaming import cg_streaming, streaming_eligible
+
+        eligible = ((engine == "streaming"
+                     or jax.default_backend() == "tpu")
+                    and streaming_eligible(
+                        a, b, m, method=method, x0=x0,
+                        resume_from=resume_from,
+                        return_checkpoint=return_checkpoint,
+                        compensated=compensated,
+                        record_history=record_history))
+        if engine == "streaming" and not eligible:
+            raise ValueError(
+                "engine='streaming' needs a float32 2D/3D stencil "
+                "satisfying the slab tiling (2D: nx % 8 == 0, "
+                "ny % 128 == 0; 3D: nx % 2 == 0, ny % 8 == 0, "
+                "nz % 128 == 0), a float32 rhs, m=None, method='cg', "
+                "and no checkpointing - use engine='general' (or "
+                "'auto') otherwise")
+        if eligible:
+            return cg_streaming(a, b, x0, tol=tol, rtol=rtol,
+                                maxiter=maxiter, check_every=check_every,
+                                iter_cap=iter_cap,
+                                record_history=record_history,
+                                interpret=_pallas_interpret())
     b = jnp.asarray(b)
     if not jnp.issubdtype(b.dtype, jnp.floating):
         b = b.astype(jnp.result_type(float))

@@ -1,0 +1,248 @@
+"""Solver-level entry for the fused-iteration HBM-streaming CG engine.
+
+``cg_streaming`` runs each CG iteration as TWO pallas slab-streaming
+launches (``ops/pallas/fused_cg.py``) inside one jitted
+``lax.while_loop`` - the VMEM-resident engine's fuse-everything idea
+carried past the VMEM boundary to the 256^3 north star (BASELINE
+config #4), where the general solver's XLA fusion boundaries cost ~16
+HBM plane-passes per iteration and the fused passes need 8.
+
+Semantics mirror ``solver.cg`` (x0 = 0 fast path or general
+``r0 = b - A x0``, absolute-``tol`` quirk-Q3 convergence plus ``rtol``,
+``check_every`` blocked predicate via the SAME ``_blocked_while``,
+``_safe_div`` breakdown freezing, CGStatus reporting, optional
+per-iteration residual history); iterates agree with the general solver
+to f32 reduction-order rounding (the two inner products accumulate
+slab-by-slab in grid order), with iteration counts matching at equal
+tolerances - asserted in ``tests/test_streaming.py``.
+
+Scope: matrix-free 5/7-point f32 stencils of any slab-supported size,
+``m=None``, ``method="cg"``.  Everything else stays on ``solver.cg``.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.operators import Stencil2D, Stencil3D
+from ..ops.pallas.fused_cg import (
+    fused_cg_pass_a,
+    fused_cg_pass_b,
+    pick_block_streaming,
+    supports_streaming,
+)
+from .cg import (
+    CGResult,
+    _history_init,
+    _safe_div,
+    _threshold_sq,
+)
+from .status import CGStatus
+
+
+def supports_streaming_op(a) -> bool:
+    """True if ``cg_streaming`` can run this operator: an f32
+    ``Stencil2D``/``Stencil3D`` whose grid satisfies the fused-CG
+    kernels' DMA tiling (``fused_cg.supports_streaming``)."""
+    if not isinstance(a, (Stencil2D, Stencil3D)):
+        return False
+    if a.dtype != jnp.float32:
+        return False
+    return supports_streaming(a.grid)
+
+
+def streaming_eligible(a, b=None, m=None, *, method: str = "cg",
+                       x0=None, resume_from=None,
+                       return_checkpoint: bool = False,
+                       compensated: bool = False,
+                       record_history: bool = False) -> bool:
+    """Eligibility for ``solve(engine="streaming")`` / the CLI - one
+    predicate, same contract as ``resident_eligible``.  History IS
+    supported (per-iteration, same granularity as the general solver).
+    """
+    del record_history  # supported at full granularity
+    if m is not None or method != "cg":
+        return False
+    if resume_from is not None or return_checkpoint or compensated:
+        return False
+    if not supports_streaming_op(a):
+        return False
+    if x0 is not None and jnp.asarray(x0).dtype != jnp.float32:
+        return False
+    if b is not None and jnp.asarray(b).dtype != jnp.float32:
+        return False
+    return True
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "shape", "maxiter", "check_every", "bm", "record_history",
+    "interpret"))
+def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, *, shape,
+                       maxiter, check_every, bm, record_history,
+                       interpret):
+    ndim = len(shape)
+
+    def stencil(u):
+        # init-only matvec (r0 = b - A x0); the hot loop's stencils live
+        # inside the fused passes
+        from ..ops.pallas.stencil import stencil2d_apply, stencil3d_apply
+
+        fn = stencil2d_apply if ndim == 2 else stencil3d_apply
+        return fn(u, scale, bm=bm, interpret=interpret)
+
+    if x0_grid is None:
+        x = jnp.zeros(shape, jnp.float32)     # explicit x0 = 0 (quirk Q6)
+        r = b_grid                            # r0 = b (CUDACG.cu:248)
+    else:
+        x = x0_grid
+        r = b_grid - stencil(x0_grid)
+    rr0 = jnp.vdot(r, r)
+    nrm0 = jnp.sqrt(rr0)
+    thresh_sq = _threshold_sq(tol, rtol, nrm0, jnp.float32)
+    history = _history_init(record_history, maxiter, jnp.float32,
+                            jnp.zeros((), jnp.int32), nrm0)
+
+    # state: (k, x, r, p_prev, beta_prev, rho, indefinite, history)
+    # The p-update is deferred into pass A of the NEXT iteration
+    # (p_k = r_k + beta_{k-1} p_{k-1}), so the carry holds the previous
+    # direction and its beta; iteration 0 seeds p_0 = r_0 via
+    # beta_prev = 0 against a zero p_prev.
+    state = (jnp.zeros((), jnp.int32), x, r, jnp.zeros(shape, jnp.float32),
+             jnp.zeros((), jnp.float32), rr0, jnp.zeros((), jnp.bool_),
+             history)
+
+    def cond(s):
+        k, _, _, _, _, rho, _, _ = s
+        unconverged = rho >= thresh_sq
+        nontrivial = rho > 0
+        healthy = jnp.isfinite(rho)
+        return (k < maxiter) & (k < cap) & unconverged & nontrivial \
+            & healthy
+
+    def step(s):
+        k, x, r, p_prev, beta_prev, rho, indef, hist = s
+        p, pap = fused_cg_pass_a(scale, beta_prev, r, p_prev, bm=bm,
+                                 interpret=interpret)
+        indef = indef | ((pap <= 0) & (rho > 0))     # quirk Q1 tracking
+        alpha = _safe_div(rho, pap)                  # CUDACG.cu:311
+        x, r, rr = fused_cg_pass_b(scale, alpha, p, x, r, bm=bm,
+                                   interpret=interpret)
+        beta = _safe_div(rr, rho)                    # CUDACG.cu:336-339
+        k = k + 1
+        if record_history:
+            hist = hist.at[k].set(jnp.sqrt(rr))
+        return (k, x, r, p, beta, rr, indef, hist)
+
+    state = _blocked_while_streaming(cond, step, state, check_every,
+                                     maxiter, cap)
+    k, x, r, _, _, rho, indef, hist = state
+    healthy = jnp.isfinite(rho)
+    converged = (rho < thresh_sq) | (rho == 0)
+    status = jnp.where(
+        converged, jnp.int32(CGStatus.CONVERGED),
+        jnp.where(~healthy, jnp.int32(CGStatus.BREAKDOWN),
+                  jnp.int32(CGStatus.MAXITER)))
+    return (x, k, jnp.sqrt(rho), converged, status, indef,
+            hist if record_history else None)
+
+
+def _blocked_while_streaming(cond, step, state, check_every, maxiter, cap):
+    """``solver.cg._blocked_while`` semantics for the tuple state: the
+    predicate is evaluated once per ``check_every`` block (identical
+    iterates, fewer serializing scalar reads), with a per-iteration tail
+    so the cap is never overshot."""
+    if check_every <= 1:
+        return lax.while_loop(cond, step, state)
+
+    def fits(s):
+        return (s[0] + check_every <= maxiter) & (s[0] + check_every <= cap)
+
+    def block(s):
+        return lax.fori_loop(0, check_every, lambda _, t: step(t), s)
+
+    state = lax.while_loop(lambda s: cond(s) & fits(s), block, state)
+    return lax.while_loop(cond, step, state)
+
+
+def cg_streaming(
+    a,
+    b: jax.Array,
+    x0=None,
+    *,
+    tol: float = 1e-7,
+    rtol: float = 0.0,
+    maxiter: int = 2000,
+    check_every: int = 32,
+    iter_cap=None,
+    record_history: bool = False,
+    interpret: bool = False,
+) -> CGResult:
+    """Solve ``A x = b`` with the fused-iteration HBM-streaming engine.
+
+    Arguments mirror ``solver.cg`` (absolute-``tol`` reference
+    semantics, ``rtol``, traced ``iter_cap``, ``check_every`` blocked
+    convergence checks with IDENTICAL iterates, per-iteration
+    ``record_history``).  ``a`` must be an f32 ``Stencil2D``/``Stencil3D``
+    satisfying ``supports_streaming_op``; unlike the resident engine
+    there is no VMEM capacity ceiling - this is the engine for grids
+    too large to pin (256^3 and beyond).
+
+    Returns a ``CGResult``; unlike the resident engine, the convergence
+    check runs every iteration (scalars live in the while_loop carry -
+    no extra HBM traffic), so iteration counts are NOT block-aligned:
+    they match the general solver's exactly at equal tolerances.
+    """
+    if not isinstance(a, (Stencil2D, Stencil3D)):
+        raise TypeError(
+            f"cg_streaming needs a Stencil2D or Stencil3D operator, got "
+            f"{type(a).__name__} - use solver.cg for general operators")
+    if a.dtype != jnp.float32:
+        raise ValueError(
+            f"cg_streaming is float32-only (got {a.dtype}); other dtypes "
+            "route through solver.cg / solver.df64")
+    grid = a.grid
+    if not supports_streaming(grid):
+        raise ValueError(
+            f"grid {grid} does not satisfy the fused-CG slab tiling "
+            f"(2D: nx % 8 == 0, ny % 128 == 0; 3D: nx % 2 == 0, "
+            f"ny % 8 == 0, nz % 128 == 0)")
+    if check_every < 1:
+        raise ValueError(f"check_every must be >= 1, got {check_every}")
+    n_cells = math.prod(grid)
+    b = jnp.asarray(b)
+    flat_in = b.ndim == 1
+    if flat_in:
+        if b.shape[0] != n_cells:
+            raise ValueError(f"rhs length {b.shape[0]} != grid {grid}")
+        b_grid = b.reshape(grid)
+    else:
+        if b.shape != grid:
+            raise ValueError(f"rhs shape {b.shape} != grid {grid}")
+        b_grid = b
+    if b_grid.dtype != jnp.float32:
+        raise ValueError(
+            f"cg_streaming is float32-only, got rhs {b_grid.dtype}")
+    if x0 is not None:
+        x0 = jnp.asarray(x0)
+        if x0.dtype != jnp.float32:
+            raise ValueError(f"x0 must be float32, got {x0.dtype}")
+        x0 = x0.reshape(grid) if x0.ndim == 1 else x0
+        if x0.shape != grid:
+            raise ValueError(f"x0 shape {x0.shape} != grid {grid}")
+    bm = pick_block_streaming(grid)
+    cap = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
+    x, k, nrm, converged, status, indef, hist = _cg_streaming_call(
+        a.scale, b_grid, x0, jnp.asarray(tol, jnp.float32),
+        jnp.asarray(rtol, jnp.float32), cap, shape=grid, maxiter=maxiter,
+        check_every=min(check_every, max(maxiter, 1)), bm=bm,
+        record_history=record_history, interpret=interpret)
+    return CGResult(
+        x=x.reshape(-1) if flat_in else x,
+        iterations=k, residual_norm=nrm,
+        converged=converged.astype(bool), status=status,
+        indefinite=indef.astype(bool),
+        residual_history=hist)
