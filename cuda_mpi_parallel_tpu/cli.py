@@ -113,11 +113,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "iter vs the general solver's ~16); 'auto' picks "
                         "resident, then streaming, when eligible")
     p.add_argument("--method", default="cg",
-                   choices=["cg", "cg1", "pipecg"],
-                   help="CG recurrence: textbook (the reference's, two "
-                        "reductions/iter), Chronopoulos-Gear single-"
-                        "reduction, or Ghysels-Vanroose pipelined "
-                        "(reduction overlaps the matvec)")
+                   choices=["cg", "cg1", "pipecg", "minres"],
+                   help="solver recurrence: textbook CG (the reference's, "
+                        "two reductions/iter), Chronopoulos-Gear single-"
+                        "reduction CG, Ghysels-Vanroose pipelined CG "
+                        "(reduction overlaps the matvec), or MINRES - the "
+                        "principled choice for symmetric INDEFINITE "
+                        "systems like the reference's own hardcoded "
+                        "matrix (quirk Q1; unpreconditioned)")
     p.add_argument("--check-every", type=int, default=1,
                    help="evaluate convergence every k iterations (identical "
                         "iterates; ~30%% faster per iteration at k=32 on "
@@ -323,6 +326,16 @@ def main(argv=None) -> int:
                              "--precond chebyshev or none (--history is "
                              "fine: the kernel records a check-block-"
                              "granular trace)")
+    if args.method == "minres":
+        if args.precond is not None:
+            raise SystemExit(
+                "--method minres is unpreconditioned (preconditioned "
+                "MINRES needs an SPD preconditioner and a different "
+                "inner product; use a CG method with --precond)")
+        if args.df64:
+            raise SystemExit(
+                "--method minres has no df64 recurrence yet; use "
+                "--dtype df64 with --method cg/cg1/pipecg")
     if args.engine == "streaming":
         if args.mesh > 1:
             raise SystemExit("--engine streaming is single-device "

@@ -87,11 +87,14 @@ def solve_distributed(
         on a pencil the V-cycle halo-exchanges over both mesh axes and
         its gather level all_gathers over both).  ``"bjacobi"`` is
         single-device only.
-      method: ``"cg"``, ``"cg1"`` or ``"pipecg"`` - on a mesh, ``"cg1"``
-        fuses each iteration's inner products into ONE ``psum`` (half the
-        collective latency of the textbook recurrence) and ``"pipecg"``
-        additionally overlaps that psum with the iteration's local
-        matvec+preconditioner compute (see ``solver.cg``).
+      method: ``"cg"``, ``"cg1"``, ``"pipecg"`` or ``"minres"`` - on a
+        mesh, ``"cg1"`` fuses each iteration's inner products into ONE
+        ``psum`` (half the collective latency of the textbook
+        recurrence), ``"pipecg"`` additionally overlaps that psum with
+        the iteration's local matvec+preconditioner compute, and
+        ``"minres"`` runs the symmetric-indefinite solver
+        (``solver.minres``; unpreconditioned) with its dots psum-ed
+        over the mesh (see ``solver.cg``).
       csr_comm: general-CSR communication schedule - ``"allgather"``
         (every device materializes the full x per matvec: one big
         collective, O(n) memory) or ``"ring"`` (x-blocks rotate around

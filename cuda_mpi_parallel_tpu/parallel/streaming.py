@@ -35,9 +35,8 @@ from ..ops.pallas.fused_cg import (
     pick_block_streaming,
     supports_streaming,
 )
-from ..solver.cg import CGResult, _safe_div, _threshold_sq
+from ..solver.cg import CGResult, _blocked_while, _safe_div, _threshold_sq
 from ..solver.status import CGStatus
-from ..solver.streaming import _blocked_while_streaming
 from .halo import exchange_halo
 from .mesh import make_mesh, shard_vector
 
@@ -158,8 +157,9 @@ def _build(mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
             beta = _safe_div(rr, rho)
             return (k + 1, x, r, p, beta, rr, indef, rr)
 
-        state = _blocked_while_streaming(cond, step, state, check_every,
-                                         maxiter, maxiter)
+        state = _blocked_while(
+            cond, step, state, check_every,
+            lambda s: s[0] + check_every <= maxiter)
         k, x, r, _, _, rho, indef, _ = state
         healthy = jnp.isfinite(rho)
         converged = (rho < thresh_sq) | (rho == 0)

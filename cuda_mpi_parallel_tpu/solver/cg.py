@@ -175,7 +175,11 @@ def cg(
         whose inputs are ready BEFORE the iteration's matvec+precond, so
         XLA can overlap the psum with local compute - the strongest
         latency-hiding variant on a mesh, at the cost of three extra
-        vector recurrences and mild finite-precision residual drift).
+        vector recurrences and mild finite-precision residual drift),
+        or ``"minres"`` (Paige-Saunders MINRES, ``solver.minres``: the
+        principled solver for symmetric INDEFINITE systems like the
+        reference's own hardcoded matrix, quirk Q1; unpreconditioned,
+        no checkpoint/resume).
       compensated: use double-float (two-prod / two-sum) inner products
         (``blas1.dot_compensated``) - the f32-storage answer to the
         reference's all-f64 arithmetic (``CUDA_R_64F``, ``CUDACG.cu:216``)
@@ -202,9 +206,26 @@ def cg(
                          "checkpoint carries its own iterate")
     cap = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
 
-    if method not in ("cg", "cg1", "pipecg"):
-        raise ValueError(f"unknown method {method!r}; expected 'cg', 'cg1' "
-                         f"or 'pipecg'")
+    if method not in ("cg", "cg1", "pipecg", "minres"):
+        raise ValueError(f"unknown method {method!r}; expected 'cg', 'cg1', "
+                         f"'pipecg' or 'minres'")
+    if method == "minres":
+        # the symmetric-INDEFINITE solver (quirk Q1: the reference's own
+        # system is indefinite and CG converges on it only by luck)
+        if preconditioned:
+            raise ValueError(
+                "method='minres' supports m=None (preconditioned MINRES "
+                "needs an SPD preconditioner and a different inner "
+                "product; SPD problems belong on the CG variants)")
+        if resume_from is not None or return_checkpoint or compensated:
+            raise ValueError(
+                "method='minres' does not support checkpoint/resume or "
+                "compensated dots")
+        from .minres import minres as _minres
+
+        return _minres(a, b, x0, tol=tol, rtol=rtol, maxiter=maxiter,
+                       record_history=record_history, axis_name=axis_name,
+                       iter_cap=iter_cap, check_every=check_every)
     if method != "cg":
         if resume_from is not None or return_checkpoint:
             raise ValueError(

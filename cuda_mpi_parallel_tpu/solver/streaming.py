@@ -37,6 +37,7 @@ from ..ops.pallas.fused_cg import (
 )
 from .cg import (
     CGResult,
+    _blocked_while,
     _history_init,
     _safe_div,
     _threshold_sq,
@@ -137,8 +138,10 @@ def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, *, shape,
             hist = hist.at[k].set(jnp.sqrt(rr))
         return (k, x, r, p, beta, rr, indef, hist)
 
-    state = _blocked_while_streaming(cond, step, state, check_every,
-                                     maxiter, cap)
+    state = _blocked_while(
+        cond, step, state, check_every,
+        lambda s: (s[0] + check_every <= maxiter)
+        & (s[0] + check_every <= cap))
     k, x, r, _, _, rho, indef, hist = state
     healthy = jnp.isfinite(rho)
     converged = (rho < thresh_sq) | (rho == 0)
@@ -148,24 +151,6 @@ def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, *, shape,
                   jnp.int32(CGStatus.MAXITER)))
     return (x, k, jnp.sqrt(rho), converged, status, indef,
             hist if record_history else None)
-
-
-def _blocked_while_streaming(cond, step, state, check_every, maxiter, cap):
-    """``solver.cg._blocked_while`` semantics for the tuple state: the
-    predicate is evaluated once per ``check_every`` block (identical
-    iterates, fewer serializing scalar reads), with a per-iteration tail
-    so the cap is never overshot."""
-    if check_every <= 1:
-        return lax.while_loop(cond, step, state)
-
-    def fits(s):
-        return (s[0] + check_every <= maxiter) & (s[0] + check_every <= cap)
-
-    def block(s):
-        return lax.fori_loop(0, check_every, lambda _, t: step(t), s)
-
-    state = lax.while_loop(lambda s: cond(s) & fits(s), block, state)
-    return lax.while_loop(cond, step, state)
 
 
 def cg_streaming(

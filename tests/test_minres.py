@@ -1,0 +1,181 @@
+"""MINRES (``solver/minres.py``): the symmetric-indefinite solver.
+
+The reference's own hardcoded matrix is symmetric INDEFINITE (quirk Q1,
+``CUDACG.cu:76-78``) - CG converges on it by luck.  MINRES is the
+principled algorithm; these tests check it against scipy's minres on
+random indefinite systems, the oracle, monotone residuals, blocked
+predicates, and the distributed mesh path.
+"""
+import numpy as np
+import pytest
+import scipy.sparse.linalg as spla
+
+import jax.numpy as jnp
+
+from cuda_mpi_parallel_tpu import solve
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.solver.status import CGStatus
+
+
+def _indefinite_system(n=200, n_neg=40, seed=3):
+    rng = np.random.default_rng(seed)
+    q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    eigs = np.concatenate([rng.uniform(0.5, 3.0, n - n_neg),
+                           -rng.uniform(0.2, 1.0, n_neg)])
+    a = (q * eigs) @ q.T
+    a = 0.5 * (a + a.T)
+    return a, rng.standard_normal(n)
+
+
+class TestOracle:
+    def test_oracle_three_iterations(self):
+        # the reference's indefinite 3x3 system: MINRES solves it
+        # without relying on CG's luck, and certifies indefiniteness
+        a, b, x_exp = poisson.oracle_system()
+        r = solve(a, b, method="minres", tol=1e-10, maxiter=50)
+        assert bool(r.converged)
+        assert int(r.iterations) == 3
+        assert bool(r.indefinite)  # negative Rayleigh quotient observed
+        np.testing.assert_allclose(np.asarray(r.x), np.asarray(x_exp),
+                                   atol=1e-8)
+
+    def test_oracle_blocked_past_exact_solve(self):
+        # iterations past Krylov exhaustion inside a check block must
+        # freeze, not NaN
+        a, b, _ = poisson.oracle_system()
+        r = solve(a, b, method="minres", tol=1e-12, maxiter=64,
+                  check_every=8)
+        assert bool(r.converged)
+        assert np.all(np.isfinite(np.asarray(r.x)))
+
+
+class TestIndefinite:
+    def test_matches_scipy_on_indefinite(self):
+        a, b = _indefinite_system()
+        res = solve(a, jnp.asarray(b), method="minres", tol=0.0,
+                    rtol=1e-9, maxiter=2000)
+        x_sp, info = spla.minres(a, b, rtol=1e-9, maxiter=2000)
+        assert info == 0 and bool(res.converged)
+        resid = np.linalg.norm(b - a @ np.asarray(res.x))
+        resid_sp = np.linalg.norm(b - a @ x_sp)
+        # at least scipy's quality on the TRUE residual
+        assert resid <= max(resid_sp * 2, 1e-8 * np.linalg.norm(b))
+
+    def test_monotone_residual(self):
+        a, b = _indefinite_system(seed=7)
+        res = solve(a, jnp.asarray(b), method="minres", tol=0.0,
+                    rtol=1e-9, maxiter=2000, record_history=True)
+        h = np.asarray(res.residual_history)
+        h = h[np.isfinite(h)]
+        assert np.all(np.diff(h) <= 1e-12 + 1e-7 * h[:-1])
+
+    def test_cg_vs_minres_on_spd(self):
+        # on an SPD system both converge; MINRES needs no more than a
+        # few extra iterations (same Krylov space, different optimality)
+        op = poisson.poisson_2d_operator(16, 16, dtype=jnp.float64)
+        rng = np.random.default_rng(11)
+        b = jnp.asarray(rng.standard_normal(256))
+        r_cg = solve(op, b, tol=0.0, rtol=1e-9, maxiter=600)
+        r_mr = solve(op, b, method="minres", tol=0.0, rtol=1e-9,
+                     maxiter=600)
+        assert bool(r_cg.converged) and bool(r_mr.converged)
+        assert abs(int(r_mr.iterations) - int(r_cg.iterations)) <= 5
+
+
+class TestSemantics:
+    def test_check_every_overshoots_only(self):
+        a, b = _indefinite_system(seed=5)
+        r1 = solve(a, jnp.asarray(b), method="minres", tol=0.0,
+                   rtol=1e-9, maxiter=2000, check_every=1)
+        r32 = solve(a, jnp.asarray(b), method="minres", tol=0.0,
+                    rtol=1e-9, maxiter=2000, check_every=32)
+        assert int(r32.iterations) >= int(r1.iterations)
+        assert int(r32.iterations) % 32 == 0
+        assert bool(r32.converged)
+
+    def test_maxiter_status(self):
+        a, b = _indefinite_system(seed=9)
+        r = solve(a, jnp.asarray(b), method="minres", tol=1e-30,
+                  maxiter=10)
+        assert not bool(r.converged)
+        assert r.status_enum() is CGStatus.MAXITER
+        assert int(r.iterations) == 10
+
+    def test_iter_cap_traced(self):
+        a, b = _indefinite_system(seed=13)
+        r = solve(a, jnp.asarray(b), method="minres", tol=0.0,
+                  maxiter=100, iter_cap=17)
+        assert int(r.iterations) == 17
+
+    def test_x0_warm_start(self):
+        a, b = _indefinite_system(seed=15)
+        x_sp, _ = spla.minres(a, b, rtol=1e-12, maxiter=2000)
+        warm = solve(a, jnp.asarray(b), jnp.asarray(x_sp),
+                     method="minres", tol=1e-6, maxiter=200)
+        cold = solve(a, jnp.asarray(b), method="minres", tol=1e-6,
+                     maxiter=200)
+        assert bool(warm.converged)
+        assert int(warm.iterations) < int(cold.iterations)
+
+    def test_exhaustion_consistent_singular(self):
+        # Krylov exhaustion on a CONSISTENT singular system: b entirely
+        # in the range - exhaustion collapses phibar to 0 and the
+        # least-squares solution in the subspace is the exact solution.
+        a = np.diag([1.0, 2.0, 0.0])
+        b = np.array([1.0, 2.0, 0.0])
+        r = solve(a, jnp.asarray(b), method="minres", tol=1e-10,
+                  maxiter=50)
+        assert bool(r.converged)
+        assert np.all(np.isfinite(np.asarray(r.x)))
+        np.testing.assert_allclose(np.asarray(r.x)[:2], [1.0, 1.0],
+                                   atol=1e-10)
+
+    def test_rejects_preconditioner(self):
+        from cuda_mpi_parallel_tpu.models.operators import (
+            JacobiPreconditioner,
+        )
+
+        op = poisson.poisson_2d_operator(16, 16, dtype=jnp.float64)
+        m = JacobiPreconditioner.from_operator(op)
+        with pytest.raises(ValueError, match="minres"):
+            solve(op, jnp.ones(256), method="minres", m=m)
+
+    def test_rejects_checkpointing(self):
+        op = poisson.poisson_2d_operator(16, 16, dtype=jnp.float64)
+        with pytest.raises(ValueError, match="minres"):
+            solve(op, jnp.ones(256), method="minres",
+                  return_checkpoint=True)
+
+    def test_history_endpoints(self):
+        a, b = _indefinite_system(seed=17)
+        res = solve(a, jnp.asarray(b), method="minres", tol=0.0,
+                    rtol=1e-8, maxiter=2000, record_history=True)
+        h = np.asarray(res.residual_history)
+        k = int(res.iterations)
+        assert np.isclose(h[0], np.linalg.norm(b), rtol=1e-10)
+        assert np.isclose(h[k], float(res.residual_norm), rtol=1e-10)
+        assert np.isnan(h[k + 1:]).all()
+
+
+@pytest.mark.skipif(
+    len(__import__("jax").devices()) < 8,
+    reason="needs 8 virtual devices")
+class TestDistributed:
+    def test_mesh_matches_single_device(self):
+        from cuda_mpi_parallel_tpu.parallel import (
+            make_mesh,
+            solve_distributed,
+        )
+
+        op = poisson.poisson_2d_operator(16, 16, dtype=jnp.float64)
+        rng = np.random.default_rng(1)
+        b = jnp.asarray(rng.standard_normal(256))
+        single = solve(op, b, method="minres", tol=0.0, rtol=1e-9,
+                       maxiter=600)
+        dist = solve_distributed(op, b, mesh=make_mesh(8),
+                                 method="minres", tol=0.0, rtol=1e-9,
+                                 maxiter=600)
+        assert bool(dist.converged)
+        assert int(dist.iterations) == int(single.iterations)
+        np.testing.assert_allclose(np.asarray(dist.x),
+                                   np.asarray(single.x), atol=1e-9)
