@@ -20,3 +20,10 @@ print(f"iterations = {int(res.iterations)} (reference: 3)")
 print(f"||r||      = {float(res.residual_norm):.3e}")
 print(f"status     = {res.status_enum().name}")
 print(f"indefinite = {bool(res.indefinite)}  (quirk Q1: p.Ap < 0 at iter 2)")
+
+# The matrix is symmetric INDEFINITE (quirk Q1) - CG converges on it by
+# luck.  MINRES is the principled algorithm for this matrix class:
+res_mr = solve(a, b, method="minres")
+print(f"minres     = {int(res_mr.iterations)} iters, "
+      f"||r|| = {float(res_mr.residual_norm):.3e}, "
+      f"indefinite certified = {bool(res_mr.indefinite)}")

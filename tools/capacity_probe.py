@@ -1,0 +1,67 @@
+"""On-chip VMEM capacity probe for the resident engine.
+
+The `_PLANES_BOUND = 12` gate (`ops/pallas/resident.py`) is deliberately
+pessimistic: the measured footprint at 1024^2 f32 was ~16.1 MB (~4
+planes), so grids up to ~2048^2 may compile and run resident.  This
+probe (run on REAL hardware only - each step compiles a Mosaic kernel)
+walks grid sizes upward under a raised `CMP_RESIDENT_VMEM_BYTES` and
+reports which compile + solve correctly, giving the evidence to relax
+the bound.
+
+Run: python tools/capacity_probe.py            (in a tunnel window)
+Writes one JSON line per probe to stdout; safe to ^C between probes.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# lift the gate so the KERNEL is the thing being probed, not the gate
+os.environ.setdefault("CMP_RESIDENT_VMEM_BYTES", str(512 * 1024 * 1024))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"error": "needs a compiled TPU backend"}))
+        return 1
+    from cuda_mpi_parallel_tpu import cg_resident
+    from cuda_mpi_parallel_tpu.models import poisson
+
+    rng = np.random.default_rng(0)
+    # (grid, expected_fate) - 1024^2 is the known-good headline size
+    for nx, ny in [(1024, 1024), (1280, 1280), (1448, 1408),
+                   (1536, 1536), (1792, 1792), (2048, 2048)]:
+        rec = {"grid": [nx, ny],
+               "planes_mb": round(nx * ny * 4 / 2**20, 1)}
+        try:
+            op = poisson.poisson_2d_operator(nx, ny, dtype=jnp.float32)
+            b = jnp.asarray(
+                rng.standard_normal(nx * ny).astype(np.float32))
+            t0 = time.monotonic()
+            res = cg_resident(op, b, tol=0.0, maxiter=200, check_every=32)
+            res.x.block_until_ready()
+            rec["compile_plus_run_s"] = round(time.monotonic() - t0, 1)
+            # second call = cached executable: a rough rate
+            b2 = b * np.float32(1.0001)
+            t1 = time.monotonic()
+            r2 = cg_resident(op, b2, tol=0.0, maxiter=200, check_every=32)
+            r2.x.block_until_ready()
+            el = time.monotonic() - t1
+            rec["run2_s"] = round(el, 3)
+            rec["ok"] = bool(np.isfinite(np.asarray(r2.residual_norm)))
+        except Exception as e:  # compile failure IS the measurement
+            rec["ok"] = False
+            rec["error"] = str(e)[-300:]
+        print(json.dumps(rec))
+        sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
