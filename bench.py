@@ -682,6 +682,19 @@ def bench_all(results) -> None:
             entry = iter_delta(a_b, b_b, 10, 60, repeats=3)
             results[f"poisson2d_16M_{backend}"] = entry
 
+        # the fused streaming engine in the same HBM-bound 2D regime
+        # (the 3D form is the northstar256 row)
+        if jax.default_backend() == "tpu":
+            from cuda_mpi_parallel_tpu import cg_streaming
+
+            a_s = Stencil2D.create(4096, 4096, dtype=jnp.float32)
+            entry = iter_delta(
+                a_s, b_b, 10, 60, repeats=3,
+                solver=lambda rr, it: cg_streaming(
+                    a_s, rr, tol=0.0, maxiter=it, check_every=32).x)
+            entry["engine"] = "streaming"
+            results["poisson2d_16M_streaming"] = entry
+
     _run_section(results, "hbm16m", s_hbm16m)
 
     # 4: the north star - 3D Poisson 256^3 f32 on a single chip

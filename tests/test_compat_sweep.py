@@ -45,6 +45,19 @@ def test_csr_combination_solves(csr_comm, precond, method):
     np.testing.assert_allclose(np.asarray(res.x), x_true, atol=1e-6)
 
 
+@pytest.mark.parametrize("csr_comm", ["allgather", "ring"])
+def test_csr_minres_combination_solves(csr_comm):
+    # minres is unpreconditioned by contract; sweep it across the comm
+    # schedules (same SPD system - minres must solve SPD too)
+    a, b, x_true = _system()
+    res = solve_distributed(a, b, mesh=make_mesh(8), tol=0.0, rtol=1e-9,
+                            maxiter=400, csr_comm=csr_comm,
+                            method="minres")
+    assert bool(res.converged), (
+        f"{csr_comm}/minres: ||r||={float(res.residual_norm)}")
+    np.testing.assert_allclose(np.asarray(res.x), x_true, atol=1e-6)
+
+
 def test_ring_dtype_property():
     """The ADVICE.md repro distilled: the ring operator's dtype must be
     readable (data is a per-step tuple of slabs)."""

@@ -34,7 +34,9 @@ def main() -> int:
     from cuda_mpi_parallel_tpu.models import poisson
 
     rng = np.random.default_rng(0)
-    # (grid, expected_fate) - 1024^2 is the known-good headline size
+    # 1024^2 is the known-good headline size; 1448x1408 is non-square
+    # because 1448 % 128 != 0 (the lane-tiling rule) - it probes the
+    # largest near-1448^2 footprint the tiling admits.
     for nx, ny in [(1024, 1024), (1280, 1280), (1448, 1408),
                    (1536, 1536), (1792, 1792), (2048, 2048)]:
         rec = {"grid": [nx, ny],
@@ -44,17 +46,30 @@ def main() -> int:
             b = jnp.asarray(
                 rng.standard_normal(nx * ny).astype(np.float32))
             t0 = time.monotonic()
-            res = cg_resident(op, b, tol=0.0, maxiter=200, check_every=32)
+            res = cg_resident(op, b, tol=0.0, rtol=1e-4, maxiter=2000,
+                              check_every=32)
             res.x.block_until_ready()
             rec["compile_plus_run_s"] = round(time.monotonic() - t0, 1)
-            # second call = cached executable: a rough rate
+            rec["iterations"] = int(res.iterations)
+            # CORRECTNESS, not just finiteness: the true residual via
+            # the independent XLA stencil path must agree with the
+            # kernel's convergence claim - compiling is not solving,
+            # and _PLANES_BOUND only gets relaxed on this evidence.
+            true_r = float(jnp.linalg.norm(b - op @ res.x))
+            nrm_b = float(jnp.linalg.norm(b))
+            rec["true_rel_residual"] = true_r / nrm_b
+            rec["ok"] = bool(res.converged) and true_r / nrm_b < 5e-4
+            # rough rate only - a single phase-separated call, which the
+            # repo's measurement protocol explicitly distrusts (tunnel
+            # service-rate drift); re-measure any interesting size with
+            # paired_delta_rate before quoting it anywhere.
             b2 = b * np.float32(1.0001)
             t1 = time.monotonic()
-            r2 = cg_resident(op, b2, tol=0.0, maxiter=200, check_every=32)
+            r2 = cg_resident(op, b2, tol=0.0, iter_cap=200, maxiter=2000,
+                             check_every=32)
             r2.x.block_until_ready()
-            el = time.monotonic() - t1
-            rec["run2_s"] = round(el, 3)
-            rec["ok"] = bool(np.isfinite(np.asarray(r2.residual_norm)))
+            rec["run2_200it_s_NOT_PROTOCOL_GRADE"] = round(
+                time.monotonic() - t1, 3)
         except Exception as e:  # compile failure IS the measurement
             rec["ok"] = False
             rec["error"] = str(e)[-300:]
