@@ -332,10 +332,11 @@ def main(argv=None) -> int:
                 "--method minres is unpreconditioned (preconditioned "
                 "MINRES needs an SPD preconditioner and a different "
                 "inner product; use a CG method with --precond)")
-        if args.df64:
+        if args.df64 and args.mesh > 1:
             raise SystemExit(
-                "--method minres has no df64 recurrence yet; use "
-                "--dtype df64 with --method cg/cg1/pipecg")
+                "--method minres --dtype df64 is single-device (the "
+                "distributed df64 backend carries the CG recurrences; "
+                "drop --mesh or use f32 minres on the mesh)")
     if args.engine == "streaming":
         if args.mesh > 1:
             raise SystemExit("--engine streaming is single-device "

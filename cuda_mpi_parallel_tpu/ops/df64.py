@@ -117,6 +117,25 @@ def less(a: DF, b: DF) -> jax.Array:
         a[0] < b[0], jnp.logical_and(a[0] == b[0], a[1] < b[1]))
 
 
+def sqrt(a: DF) -> DF:
+    """df64 square root: f32 estimate + one df64 Newton step.
+
+    Newton doubles the correct bits, so the ~24-bit f32 ``sqrt(hi)``
+    estimate reaches df64's ~49-bit significand in one
+    ``s = (s0 + a/s0) / 2`` correction (the halving is exact).  An
+    exactly-zero input returns exactly zero (the naive step would
+    divide by the zero estimate); negative inputs produce NaN like
+    ``jnp.sqrt``.
+    """
+    zero = a[0] == 0.0
+    s0 = jnp.sqrt(a[0])
+    s0_safe = jnp.where(zero, jnp.ones_like(s0), s0)
+    s = add((s0_safe, jnp.zeros_like(s0_safe)),
+            div(a, (s0_safe, jnp.zeros_like(s0_safe))))
+    return (jnp.where(zero, 0.0, 0.5 * s[0]),
+            jnp.where(zero, 0.0, 0.5 * s[1]))
+
+
 # -- vector ops ---------------------------------------------------------------
 
 def axpy(alpha: DF, x: DF, y: DF) -> DF:

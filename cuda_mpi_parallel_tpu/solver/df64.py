@@ -207,6 +207,21 @@ def _prepare_operator(a, jacobi: bool = False):
         kind="ell", grid=())
 
 
+
+def _coerce_rhs_df(b) -> df.DF:
+    """Right-hand side -> df64 pair: host float64 splits at full
+    precision, x64-mode device arrays split via the host, anything else
+    lifts from f32 with zero low words.  Shared by every df64 solver
+    entry (cg_df64, minres_df64) so the precision rules cannot drift."""
+    if isinstance(b, np.ndarray) and b.dtype == np.float64:
+        bh, bl = df.split_f64(b)
+        return (jnp.asarray(bh), jnp.asarray(bl))
+    b_arr = jnp.asarray(b)
+    if b_arr.dtype == jnp.float64:  # x64 mode (CPU tests)
+        bh, bl = df.split_f64(np.asarray(b_arr))
+        return (jnp.asarray(bh), jnp.asarray(bl))
+    return df.from_f32(b_arr.astype(jnp.float32))
+
 class _State(NamedTuple):
     k: jax.Array
     x: df.DF
@@ -275,9 +290,26 @@ def cg_df64(
         raise ValueError(
             f"cg_df64 supports preconditioner=None, 'jacobi', 'chebyshev' "
             f"or 'mg', got {preconditioner!r}")
-    if method not in ("cg", "cg1", "pipecg"):
+    if method not in ("cg", "cg1", "pipecg", "minres"):
         raise ValueError(f"unknown method {method!r}; expected 'cg', "
-                         f"'cg1' or 'pipecg'")
+                         f"'cg1', 'pipecg' or 'minres'")
+    if method == "minres":
+        # the symmetric-indefinite solver at f64-class precision
+        # (solver.minres.minres_df64; quirk Q1 x CUDA_R_64F)
+        if preconditioner is not None:
+            raise ValueError(
+                "method='minres' is unpreconditioned (preconditioned "
+                "MINRES needs an SPD preconditioner and a different "
+                "inner product)")
+        if resume_from is not None or return_checkpoint:
+            raise ValueError(
+                "method='minres' does not support checkpoint/resume")
+        from .minres import minres_df64
+
+        return minres_df64(a, b, tol=tol, rtol=rtol, maxiter=maxiter,
+                           record_history=record_history,
+                           axis_name=axis_name, iter_cap=iter_cap,
+                           check_every=check_every)
     if preconditioner in ("chebyshev", "mg") and method != "cg":
         raise ValueError(
             f"preconditioner={preconditioner!r} requires method='cg' in "
@@ -298,16 +330,7 @@ def cg_df64(
             "method='cg': DF64Checkpoint carries the standard recurrence "
             "state, not the variants' extra vectors")
     op = _prepare_operator(a, jacobi=preconditioner == "jacobi")
-    if isinstance(b, np.ndarray) and b.dtype == np.float64:
-        bh, bl = df.split_f64(b)
-        b_df = (jnp.asarray(bh), jnp.asarray(bl))
-    else:
-        b_arr = jnp.asarray(b)
-        if b_arr.dtype == jnp.float64:  # x64 mode (CPU tests)
-            bh, bl = df.split_f64(np.asarray(b_arr))
-            b_df = (jnp.asarray(bh), jnp.asarray(bl))
-        else:
-            b_df = df.from_f32(b_arr.astype(jnp.float32))
+    b_df = _coerce_rhs_df(b)
 
     tol2 = df.const(float(tol) ** 2)
     rtol2 = df.const(float(rtol) ** 2)

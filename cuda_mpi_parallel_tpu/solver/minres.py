@@ -184,3 +184,151 @@ def minres(
         converged=converged, status=status,
         indefinite=final["indefinite"],
         residual_history=final["history"] if record_history else None)
+
+
+# -- df64 (double-float) MINRES ------------------------------------------------
+
+
+def minres_df64(
+    a,
+    b,
+    *,
+    tol: float = 1e-7,
+    rtol: float = 0.0,
+    maxiter: int = 2000,
+    record_history: bool = False,
+    axis_name=None,
+    iter_cap=None,
+    check_every: int = 1,
+):
+    """f64-class MINRES on (hi, lo) double-float pairs.
+
+    The reference's defining precision (``CUDA_R_64F``,
+    ``CUDACG.cu:216``) x the principled algorithm for its indefinite
+    matrix class (quirk Q1): the same Paige-Saunders recurrence as
+    :func:`minres` with every vector, inner product and Givens scalar
+    in df64 arithmetic (``ops.df64``; f64-class significand on hardware
+    with no f64 units).  Operator/rhs coercion, distribution and result
+    contract mirror ``solver.df64.cg_df64`` (``DF64CGResult``; history
+    is the hi-word diagnostic trace).
+    """
+    from ..ops import df64 as df
+    from .cg import _blocked_while
+    from .df64 import DF64CGResult, _coerce_rhs_df, _prepare_operator
+
+    if check_every < 1:
+        raise ValueError(f"check_every must be >= 1, got {check_every}")
+    op = _prepare_operator(a)
+    mv = op.matvec_df if hasattr(op, "matvec_df") else op.matvec
+    b_df = _coerce_rhs_df(b)
+
+    def ddot(x, y):
+        return df.dot(x, y, axis_name=axis_name)
+
+    zero = df.const(0.0)
+    one = df.const(1.0)
+    cap = jnp.asarray(maxiter if iter_cap is None else iter_cap,
+                      jnp.int32)
+    # the df64 analogue of the f32 kernel's gamma floor
+    eps = df.const(float(jnp.finfo(jnp.float32).tiny))
+
+    def dmax(p, q):
+        keep_q = df.less(p, q)
+        return (jnp.where(keep_q, q[0], p[0]),
+                jnp.where(keep_q, q[1], p[1]))
+
+    def dwhere(c, p, q):
+        return (jnp.where(c, p[0], q[0]), jnp.where(c, p[1], q[1]))
+
+    x0 = (jnp.zeros_like(b_df[0]), jnp.zeros_like(b_df[1]))
+    r0 = b_df                       # x0 = 0 fast path (CUDACG.cu:247-259)
+    beta1 = df.sqrt(ddot(r0, r0))
+    thresh = dmax(df.const(float(tol)), df.mul(df.const(float(rtol)),
+                                               beta1))
+
+    history = jnp.zeros((0,), jnp.float32)
+    if record_history:
+        history = jnp.full((maxiter + 1,), jnp.nan,
+                           jnp.float32).at[0].set(beta1[0])
+
+    state = dict(
+        k=jnp.zeros((), jnp.int32), x=x0,
+        r1=r0, r2=r0, oldb=zero, beta=beta1,
+        dbar=zero, epsln=zero, phibar=beta1,
+        cs=df.neg(one), sn=zero,
+        w=x0, w2=x0,
+        indefinite=jnp.zeros((), jnp.bool_),
+        history=history,
+    )
+
+    def cond(s):
+        unconverged = jnp.logical_not(df.less(s["phibar"], thresh))
+        nontrivial = s["phibar"][0] > 0
+        return ((s["k"] < maxiter) & (s["k"] < cap) & unconverged
+                & nontrivial & jnp.isfinite(s["phibar"][0])
+                & (s["beta"][0] > 0))
+
+    def smul(c, v):
+        """df64 scalar * df64 vector (broadcast)."""
+        return df.mul((jnp.broadcast_to(c[0], v[0].shape),
+                       jnp.broadcast_to(c[1], v[0].shape)), v)
+
+    def step(s):
+        k = s["k"]
+        beta, oldb = s["beta"], s["oldb"]
+        beta_safe = dwhere(beta[0] == 0, one, beta)
+        v = smul(df.div(one, beta_safe), s["r2"])   # v = r2 / beta
+        y = mv(v)
+        oldb_safe = dwhere(oldb[0] == 0, one, oldb)
+        factor = dwhere(k > 0, df.div(beta, oldb_safe), zero)
+        y = df.sub(y, smul(factor, s["r1"]))
+        alfa = ddot(v, y)
+        indefinite = s["indefinite"] | (alfa[0] < 0)
+        y = df.sub(y, smul(df.div(alfa, beta_safe), s["r2"]))
+        r1, r2 = s["r2"], y
+        oldb_n = beta
+        beta_n = df.sqrt(ddot(y, y))
+        oldeps = s["epsln"]
+        delta = df.add(df.mul(s["cs"], s["dbar"]), df.mul(s["sn"], alfa))
+        gbar = df.sub(df.mul(s["sn"], s["dbar"]), df.mul(s["cs"], alfa))
+        epsln = df.mul(s["sn"], beta_n)
+        dbar = df.neg(df.mul(s["cs"], beta_n))
+        gamma = df.sqrt(df.add(df.mul(gbar, gbar),
+                               df.mul(beta_n, beta_n)))
+        gamma = dmax(gamma, eps)
+        cs = df.div(gbar, gamma)
+        sn = df.div(beta_n, gamma)
+        phi = df.mul(cs, s["phibar"])
+        phibar = df.mul(sn, s["phibar"])
+        w1, w2 = s["w2"], s["w"]
+        num = df.sub(df.sub(v, smul(oldeps, w1)), smul(delta, w2))
+        w = smul(df.div(one, gamma), num)
+        x = df.add(s["x"], smul(phi, w))
+        k = k + 1
+        history = s["history"]
+        if record_history:
+            history = history.at[k].set(phibar[0])
+        return dict(k=k, x=x, r1=r1, r2=r2, oldb=oldb_n, beta=beta_n,
+                    dbar=dbar, epsln=epsln, phibar=phibar, cs=cs, sn=sn,
+                    w=w, w2=w2, indefinite=indefinite, history=history)
+
+    def fits(s):
+        return (s["k"] + check_every <= maxiter) \
+            & (s["k"] + check_every <= cap)
+
+    final = _blocked_while(cond, step, state, check_every, fits)
+
+    phibar = final["phibar"]
+    healthy = jnp.isfinite(phibar[0])
+    converged = df.less(phibar, thresh) | (phibar[0] == 0)
+    status = jnp.where(
+        converged, jnp.int32(CGStatus.CONVERGED),
+        jnp.where(~healthy, jnp.int32(CGStatus.BREAKDOWN),
+                  jnp.int32(CGStatus.MAXITER)))
+    rr = df.mul(phibar, phibar)
+    return DF64CGResult(
+        x_hi=final["x"][0], x_lo=final["x"][1], iterations=final["k"],
+        residual_norm_sq_hi=rr[0], residual_norm_sq_lo=rr[1],
+        converged=converged, status=status,
+        indefinite=final["indefinite"],
+        residual_history=final["history"] if record_history else None)

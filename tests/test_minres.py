@@ -157,6 +157,82 @@ class TestSemantics:
         assert np.isnan(h[k + 1:]).all()
 
 
+class TestDF64Minres:
+    """f64-class MINRES on double-float pairs (``minres_df64``): the
+    reference's defining precision x the right algorithm for its
+    indefinite matrix class."""
+
+    def test_oracle(self):
+        from cuda_mpi_parallel_tpu.solver.minres import minres_df64
+
+        a, b, x_exp = poisson.oracle_system()
+        r = minres_df64(a, np.asarray(b, np.float64), tol=1e-12,
+                        maxiter=50)
+        assert bool(r.converged) and int(r.iterations) == 3
+        assert bool(r.indefinite)
+        np.testing.assert_allclose(r.x(), np.asarray(x_exp), atol=1e-10)
+
+    def test_reaches_f64_depth_and_matches_f64_trajectory(self):
+        from cuda_mpi_parallel_tpu.solver.df64 import cg_df64
+
+        op32 = poisson.poisson_2d_operator(16, 16, dtype=jnp.float32)
+        rng = np.random.default_rng(2)
+        b = rng.standard_normal(256)
+        rd = cg_df64(op32, b, tol=0.0, rtol=1e-12, maxiter=2000,
+                     method="minres")
+        assert bool(rd.converged)
+        ad = np.asarray(
+            poisson.poisson_2d_csr(16, 16, dtype=np.float64).to_dense())
+        true_rel = (np.linalg.norm(b - ad @ rd.x())
+                    / np.linalg.norm(b))
+        assert true_rel < 1e-11  # far below f32's ~1e-7 floor
+        # trajectory parity vs true-f64 minres (x64 CPU oracle)
+        rf = solve(poisson.poisson_2d_operator(16, 16, dtype=jnp.float64),
+                   jnp.asarray(b), method="minres", tol=0.0, rtol=1e-12,
+                   maxiter=2000)
+        assert abs(int(rf.iterations) - int(rd.iterations)) <= 2
+        assert np.abs(rd.x() - np.asarray(rf.x)).max() < 1e-10
+
+    def test_indefinite_df64(self):
+        from cuda_mpi_parallel_tpu.models.operators import CSRMatrix
+        from cuda_mpi_parallel_tpu.solver.df64 import cg_df64
+        import scipy.sparse as sp
+
+        a_np, b = _indefinite_system(n=96, n_neg=20, seed=21)
+        a_ell = CSRMatrix.from_scipy(sp.csr_matrix(a_np),
+                                     dtype=np.float64).to_ell()
+        rd = cg_df64(a_ell, b, tol=0.0, rtol=1e-10, maxiter=2000,
+                     method="minres")
+        assert bool(rd.converged)
+        true_rel = (np.linalg.norm(b - a_np @ rd.x())
+                    / np.linalg.norm(b))
+        assert true_rel < 1e-8
+
+    def test_rejections(self):
+        from cuda_mpi_parallel_tpu.solver.df64 import cg_df64
+
+        op32 = poisson.poisson_2d_operator(16, 16, dtype=jnp.float32)
+        with pytest.raises(ValueError, match="minres"):
+            cg_df64(op32, np.ones(256), method="minres",
+                    preconditioner="jacobi")
+        with pytest.raises(ValueError, match="minres"):
+            cg_df64(op32, np.ones(256), method="minres",
+                    return_checkpoint=True)
+
+    def test_df64_sqrt_accuracy(self):
+        from cuda_mpi_parallel_tpu.ops import df64 as df
+
+        rng = np.random.default_rng(0)
+        vals = np.abs(rng.standard_normal(1000)) \
+            * 10.0 ** rng.uniform(-20, 20, 1000)
+        h, l = df.split_f64(vals)
+        sh, sl = df.sqrt((jnp.asarray(h), jnp.asarray(l)))
+        rel = np.abs(df.to_f64(sh, sl) - np.sqrt(vals)) / np.sqrt(vals)
+        assert rel.max() < 1e-14
+        z = df.sqrt((jnp.zeros(3, jnp.float32), jnp.zeros(3, jnp.float32)))
+        assert np.all(np.asarray(z[0]) == 0)
+
+
 @pytest.mark.skipif(
     len(__import__("jax").devices()) < 8,
     reason="needs 8 virtual devices")
