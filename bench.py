@@ -437,6 +437,39 @@ def bench_all(results) -> None:
 
     _run_section(results, "poisson2d_1M_stencil_whileloop", s_whileloop)
 
+    # The resident cg1 kernel on the headline problem: the roofline's
+    # bottleneck-#2 experiment (BASELINE.md) - one evaluation point for
+    # both inner products makes the two SMEM fold trees independent,
+    # at the price of one extra pinned plane and vector update.  A/B
+    # against the plain-resident headline row.
+    def s_resident_cg1():
+        from cuda_mpi_parallel_tpu import (
+            cg_resident as _cgres,
+            supports_resident as _sup,
+        )
+
+        op = poisson.poisson_2d_operator(HEADLINE_GRID, HEADLINE_GRID,
+                                         dtype=jnp.float32)
+        if jax.default_backend() != "tpu":
+            results["poisson2d_1M_stencil_resident_cg1"] = {
+                "skipped": "needs a compiled TPU backend"}
+            return
+        if not _sup(op, cg1=True):
+            results["poisson2d_1M_stencil_resident_cg1"] = {
+                "skipped": "cg1 working set exceeds the device VMEM "
+                           "budget at this grid"}
+            return
+        entry = iter_delta(
+            op, rhs_1m(), 100, 10100, repeats=5,
+            solver=lambda rr, it: _cgres(op, rr, tol=0.0, maxiter=it,
+                                         check_every=32,
+                                         method="cg1").x)
+        entry["engine"] = "resident_cg1"
+        results["poisson2d_1M_stencil_resident_cg1"] = entry
+
+    _run_section(results, "poisson2d_1M_stencil_resident_cg1",
+                 s_resident_cg1)
+
     def s_csr():
         # keep this single call short: at ~83 ms/iter the XLA-gather kernel
         # runs long enough to flirt with the device watchdog

@@ -321,11 +321,15 @@ def main(argv=None) -> int:
         if args.mesh > 1:
             raise SystemExit("--engine resident is single-device "
                              "(no --mesh > 1)")
-        if args.precond not in (None, "chebyshev") or args.method != "cg":
-            raise SystemExit("--engine resident supports --method cg with "
-                             "--precond chebyshev or none (--history is "
-                             "fine: the kernel records a check-block-"
-                             "granular trace)")
+        if (args.precond not in (None, "chebyshev")
+                or args.method not in ("cg", "cg1")
+                or (args.method == "cg1" and args.precond is not None)):
+            raise SystemExit("--engine resident supports --method cg "
+                             "(--precond chebyshev or none) or the "
+                             "unpreconditioned --method cg1 single-"
+                             "reduction kernel (--history is fine: the "
+                             "kernel records a check-block-granular "
+                             "trace)")
     if args.method == "minres":
         if args.precond is not None:
             raise SystemExit(
@@ -447,7 +451,7 @@ def main(argv=None) -> int:
             # granularity - same rule as solve(engine=...).
             history_ok = not args.history or args.engine == "resident"
             cheap_ok = (args.precond in (None, "chebyshev")
-                        and args.method == "cg" and history_ok
+                        and args.method in ("cg", "cg1") and history_ok
                         and (args.engine == "resident"
                              or _jax_backend_is_tpu())
                         and supports_resident(
@@ -474,6 +478,7 @@ def main(argv=None) -> int:
                                    maxiter=args.maxiter,
                                    check_every=args.check_every,
                                    m=m_res, record_history=args.history,
+                                   method=args.method,
                                    interpret=_pallas_interpret())
         if args.engine in ("auto", "streaming"):
             from .models.operators import _pallas_interpret

@@ -104,21 +104,24 @@ def vmem_bytes(device=None) -> int:
     return _VMEM_FALLBACK
 
 
-def _extra_planes(preconditioned: bool, warm_start: bool) -> int:
+def _extra_planes(preconditioned: bool, warm_start: bool,
+                  cg1: bool = False) -> int:
     """Plane-count surcharges over ``_PLANES_BOUND``: the Chebyshev
-    recurrence's two transients.  A warm start costs NO extra plane -
-    the x0 input aliases the x output buffer (``input_output_aliases``
-    in ``_cg_resident_call``; the kernel reads x0 once at init and
-    immediately overwrites it with the seeded x).  Every gate and every
-    kernel ``vmem_limit_bytes`` computes its budget through this one
-    function so they cannot diverge."""
+    recurrence's two transients, and the cg1 recurrence's pinned
+    ``s = A p`` plane plus its ``w`` transient.  A warm start costs NO
+    extra plane - the x0 input aliases the x output buffer
+    (``input_output_aliases`` in ``_cg_resident_call``; the kernel
+    reads x0 once at init and immediately overwrites it with the seeded
+    x).  Every gate and every kernel ``vmem_limit_bytes`` computes its
+    budget through this one function so they cannot diverge."""
     del warm_start  # plane-neutral via aliasing; kept for call clarity
-    return 2 if preconditioned else 0
+    return (2 if preconditioned else 0) + (2 if cg1 else 0)
 
 
 def supports_resident_2d(nx: int, ny: int, itemsize: int = 4,
                          device=None, preconditioned: bool = False,
-                         warm_start: bool = False) -> bool:
+                         warm_start: bool = False,
+                         cg1: bool = False) -> bool:
     """True if an (nx, ny) grid's CG working set fits the resident kernel.
 
     Tiling needs ``nx % 8 == 0 and ny % 128 == 0`` (f32 (8,128) tiles);
@@ -131,7 +134,8 @@ def supports_resident_2d(nx: int, ny: int, itemsize: int = 4,
         return False
     if itemsize != 4:
         return False  # f32 only: df64/other dtypes take the general path
-    planes = _PLANES_BOUND + _extra_planes(preconditioned, warm_start)
+    planes = _PLANES_BOUND + _extra_planes(preconditioned, warm_start,
+                                           cg1=cg1)
     return planes * nx * ny * itemsize <= vmem_bytes(device)
 
 
@@ -357,7 +361,8 @@ def _coerce_x0(x0, b_grid):
 
 
 def _check_grid_fits(shape, *, df64: bool, preconditioned: bool,
-                     interpret: bool, warm_start: bool = False) -> None:
+                     interpret: bool, warm_start: bool = False,
+                     cg1: bool = False) -> None:
     """Shared entry gate of the four resident wrappers: raise unless the
     grid fits the kernel it is about to launch (tiling + the SAME plane
     budget the kernel's ``vmem_limit_bytes`` uses)."""
@@ -369,7 +374,7 @@ def _check_grid_fits(shape, *, df64: bool, preconditioned: bool,
               if df64
               else supports_resident_2d(*shape,
                                         preconditioned=preconditioned,
-                                        warm_start=warm_start))
+                                        warm_start=warm_start, cg1=cg1))
         tiling = "nx % 8 == 0, ny % 128 == 0"
     else:
         ok = (supports_resident_df64_3d(*shape,
@@ -377,18 +382,30 @@ def _check_grid_fits(shape, *, df64: bool, preconditioned: bool,
               if df64
               else supports_resident_3d(*shape,
                                         preconditioned=preconditioned,
-                                        warm_start=warm_start))
+                                        warm_start=warm_start, cg1=cg1))
         tiling = "ny % 8 == 0, nz % 128 == 0"
     if not ok:
         planes = (_PLANES_BOUND_DF64 + _extra_planes_df64(preconditioned)
                   if df64
                   else _PLANES_BOUND
-                  + _extra_planes(preconditioned, warm_start))
+                  + _extra_planes(preconditioned, warm_start, cg1=cg1))
         raise ValueError(
             f"{shape} {'df64' if df64 else 'f32'} grid does not fit the "
             f"resident kernel: needs {tiling} and {planes} * grid bytes "
             f"<= {vmem_bytes()} (set {_ENV_OVERRIDE} to override the "
             f"budget)")
+
+
+def _check_method(method: str, precond_degree: int) -> None:
+    if method not in ("cg", "cg1"):
+        raise ValueError(
+            f"resident method must be 'cg' or 'cg1', got {method!r}")
+    if method == "cg1" and precond_degree > 0:
+        raise ValueError(
+            "the resident cg1 kernel is unpreconditioned (the "
+            "preconditioned Chronopoulos-Gear form needs a third "
+            "reduction); use method='cg' with precond_degree, or drop "
+            "the preconditioner")
 
 
 def _check_loop_args(check_every: int, maxiter: int,
@@ -410,10 +427,118 @@ def _check_loop_args(check_every: int, maxiter: int,
     return max(1, min(check_every, maxiter))
 
 
+def _resident_kernel_cg1(nblocks, check_every, stencil_fn, has_x0,
+                         params_ref, cap_ref, *refs):
+    """Chronopoulos-Gear single-reduction CG, VMEM-resident.
+
+    Algebraically the textbook recurrence (``solver.cg._cg1`` - tests
+    assert trajectory parity), rearranged so BOTH per-iteration inner
+    products are evaluated at one point on the same pair of freshly
+    computed vectors (r, w = A r): the two SMEM fold trees become
+    INDEPENDENT and can overlap in the VPU's instruction stream, where
+    the plain kernel's trees are serialized around the vector updates
+    (the roofline's bottleneck #2, BASELINE.md).  Price: one extra
+    pinned plane (s = A p) and one extra vector update per iteration.
+    Unpreconditioned only (the preconditioned cg1 form needs a third
+    dot).
+    """
+    if has_x0:
+        (b_ref, x0_ref, x_ref, iters_ref, rr_ref, indef_ref, conv_ref,
+         health_ref, hist_ref, r_ref, p_ref, s_ref, state_f,
+         state_i) = refs
+    else:
+        (b_ref, x_ref, iters_ref, rr_ref, indef_ref, conv_ref,
+         health_ref, hist_ref, r_ref, p_ref, s_ref, state_f,
+         state_i) = refs
+    scale = params_ref[0]
+    tol = params_ref[1]
+    rtol = params_ref[2]
+    cap = cap_ref[0]
+
+    b = b_ref[:]
+    if has_x0:
+        x0 = x0_ref[:]
+        x_ref[:] = x0
+        r0 = b - stencil_fn(x0, scale)
+    else:
+        x_ref[:] = jnp.zeros_like(b)        # explicit x0 = 0 (quirk Q6)
+        r0 = b
+    r_ref[:] = r0
+    w0 = stencil_fn(r0, scale)
+    rr0 = jnp.sum(r0 * r0)
+    delta0 = jnp.sum(w0 * r0)
+    p_ref[:] = r0
+    s_ref[:] = w0
+    thresh = jnp.maximum(tol, rtol * jnp.sqrt(rr0))
+    thresh2 = thresh * thresh
+
+    state_f[0] = rr0                        # ||r||^2 (== gamma, unprecond)
+    state_f[1] = _safe_div_f32(rr0, delta0)  # alpha, one step ahead
+    state_i[0] = jnp.int32(0)
+    state_i[1] = ((delta0 <= 0.0) & (rr0 > 0.0)).astype(jnp.int32)
+
+    hist_ref[0] = rr0
+
+    def sentinel_fill(j, c):
+        hist_ref[j] = jnp.float32(-1.0)
+        return c
+
+    lax.fori_loop(1, nblocks + 1, sentinel_fill, jnp.int32(0))
+
+    def block(blk, carry):
+        healthy = (jnp.isfinite(state_f[0]) & jnp.isfinite(state_f[1]))
+
+        @pl.when((state_f[0] >= thresh2) & (state_f[0] > 0.0)
+                 & (state_i[0] < cap) & healthy)
+        def _():
+            nsteps = jnp.minimum(jnp.int32(check_every), cap - state_i[0])
+
+            def one_iter(_, carry):
+                rr, alpha = carry
+                x_ref[:] = x_ref[:] + alpha * p_ref[:]
+                r_new = r_ref[:] - alpha * s_ref[:]
+                r_ref[:] = r_new
+                w = stencil_fn(r_new, scale)
+                # the single evaluation point: both reductions on
+                # (r_new, w) - independent fold trees
+                rr_new = jnp.sum(r_new * r_new)
+                delta = jnp.sum(w * r_new)
+                beta = _safe_div_f32(rr_new, rr)
+                denom = delta - beta * _safe_div_f32(rr_new, alpha)
+                alpha_new = _safe_div_f32(rr_new, denom)
+                state_i[1] = jnp.where((denom <= 0.0) & (rr_new > 0.0),
+                                       jnp.int32(1), state_i[1])
+                p_ref[:] = r_new + beta * p_ref[:]
+                s_ref[:] = w + beta * s_ref[:]
+                return rr_new, alpha_new
+
+            rr_out, alpha_out = lax.fori_loop(
+                0, nsteps, one_iter, (state_f[0], state_f[1]))
+            state_f[0] = rr_out
+            state_f[1] = alpha_out
+            state_i[0] = state_i[0] + nsteps
+            hist_ref[blk + 1] = rr_out
+        return carry
+
+    lax.fori_loop(0, nblocks, block, jnp.int32(0))
+
+    iters_ref[0] = state_i[0]
+    rr_ref[0] = state_f[0]
+    indef_ref[0] = state_i[1]
+    conv_ref[0] = ((state_f[0] < thresh2)
+                   | (state_f[0] == 0.0)).astype(jnp.int32)
+    # _cg1's health formula (gamma == rr unpreconditioned): non-finite
+    # scalars are a breakdown; rr <= 0 cannot misreport because rr == 0
+    # is the converged exact solve.
+    health_ref[0] = (jnp.isfinite(state_f[0]) & jnp.isfinite(state_f[1])
+                     ).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=(
-    "shape", "maxiter", "check_every", "degree", "interpret"))
+    "shape", "maxiter", "check_every", "degree", "interpret", "method"))
 def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
-                      *, shape, maxiter, check_every, degree, interpret):
+                      *, shape, maxiter, check_every, degree, interpret,
+                      method="cg"):
     nblocks = -(-maxiter // check_every)
     params = jnp.stack([
         jnp.asarray(scale, jnp.float32),
@@ -424,8 +549,12 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
     cap_arr = jnp.asarray(cap, jnp.int32).reshape(1)
     stencil_fn = _shift_stencil if len(shape) == 2 else _shift_stencil_3d
     has_x0 = x0_grid is not None
-    kernel = functools.partial(_resident_kernel, nblocks, check_every,
-                               degree, stencil_fn, has_x0)
+    if method == "cg1":
+        kernel = functools.partial(_resident_kernel_cg1, nblocks,
+                                   check_every, stencil_fn, has_x0)
+    else:
+        kernel = functools.partial(_resident_kernel, nblocks, check_every,
+                                   degree, stencil_fn, has_x0)
     cells = math.prod(shape)
     grid_inputs = (b_grid,) if x0_grid is None else (b_grid, x0_grid)
     x, iters, rr, indef, conv, health, hist = pl.pallas_call(
@@ -455,7 +584,9 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
         scratch_shapes=[
             pltpu.VMEM(shape, jnp.float32),          # r
             pltpu.VMEM(shape, jnp.float32),          # p
-            pltpu.SMEM((2,), jnp.float32),           # rr, rho
+        ] + ([pltpu.VMEM(shape, jnp.float32)]        # s = A p (cg1 only)
+             if method == "cg1" else []) + [
+            pltpu.SMEM((2,), jnp.float32),           # rr, rho/alpha
             pltpu.SMEM((2,), jnp.int32),             # k, indefinite
         ],
         # The warm-start x0 input (input index 3) aliases the x output:
@@ -471,7 +602,8 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
         # supports_resident_*(preconditioned=True) gates on the same).
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=(_PLANES_BOUND
-                              + _extra_planes(degree > 0, has_x0))
+                              + _extra_planes(degree > 0, has_x0,
+                                              cg1=method == "cg1"))
             * cells * 4 + (1 << 20)),
         interpret=interpret,
     )(params, cap_arr, *grid_inputs)
@@ -480,7 +612,8 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
 
 def cg_resident_2d(scale, b2d, *, x0=None, tol=0.0, rtol=0.0,
                    maxiter=2000, check_every=32, iter_cap=None,
-                   interpret=False, precond_degree=0, lmin=0.0, lmax=1.0):
+                   interpret=False, precond_degree=0, lmin=0.0, lmax=1.0,
+                   method="cg"):
     """Run the whole CG solve for the 5-point stencil in one pallas kernel.
 
     Args:
@@ -531,20 +664,23 @@ def cg_resident_2d(scale, b2d, *, x0=None, tol=0.0, rtol=0.0,
     if b2d.dtype != jnp.float32:
         raise ValueError(f"resident CG is float32-only, got {b2d.dtype}")
     check_every = _check_loop_args(check_every, maxiter, precond_degree)
+    _check_method(method, precond_degree)
     x0 = _coerce_x0(x0, b2d)
     _check_grid_fits(b2d.shape, df64=False,
                      preconditioned=precond_degree > 0,
-                     interpret=interpret, warm_start=x0 is not None)
+                     interpret=interpret, warm_start=x0 is not None,
+                     cg1=method == "cg1")
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_call(
         scale, tol, rtol, lmin, lmax, cap, b2d, x0, shape=b2d.shape,
         maxiter=maxiter, check_every=check_every,
-        degree=int(precond_degree), interpret=interpret)
+        degree=int(precond_degree), interpret=interpret, method=method)
 
 
 def supports_resident_3d(nx: int, ny: int, nz: int, itemsize: int = 4,
                          device=None, preconditioned: bool = False,
-                         warm_start: bool = False) -> bool:
+                         warm_start: bool = False,
+                         cg1: bool = False) -> bool:
     """True if an (nx, ny, nz) grid's CG working set fits the resident
     kernel: ``ny % 8 == 0 and nz % 128 == 0`` (the trailing two axes
     carry the (8, 128) f32 tiles; the leading plane axis is free) plus
@@ -553,13 +689,15 @@ def supports_resident_3d(nx: int, ny: int, nz: int, itemsize: int = 4,
         return False
     if itemsize != 4:
         return False
-    planes = _PLANES_BOUND + _extra_planes(preconditioned, warm_start)
+    planes = _PLANES_BOUND + _extra_planes(preconditioned, warm_start,
+                                           cg1=cg1)
     return planes * nx * ny * nz * itemsize <= vmem_bytes(device)
 
 
 def cg_resident_3d(scale, b3d, *, x0=None, tol=0.0, rtol=0.0,
                    maxiter=2000, check_every=32, iter_cap=None,
-                   interpret=False, precond_degree=0, lmin=0.0, lmax=1.0):
+                   interpret=False, precond_degree=0, lmin=0.0, lmax=1.0,
+                   method="cg"):
     """The 7-point-stencil (``Stencil3D``) form of :func:`cg_resident_2d`:
     same kernel, same semantics and return contract, with the 3D
     shifted-add Laplacian - for 3D grids small enough to pin in VMEM
@@ -572,15 +710,17 @@ def cg_resident_3d(scale, b3d, *, x0=None, tol=0.0, rtol=0.0,
     if b3d.dtype != jnp.float32:
         raise ValueError(f"resident CG is float32-only, got {b3d.dtype}")
     check_every = _check_loop_args(check_every, maxiter, precond_degree)
+    _check_method(method, precond_degree)
     x0 = _coerce_x0(x0, b3d)
     _check_grid_fits(b3d.shape, df64=False,
                      preconditioned=precond_degree > 0,
-                     interpret=interpret, warm_start=x0 is not None)
+                     interpret=interpret, warm_start=x0 is not None,
+                     cg1=method == "cg1")
     cap = maxiter if iter_cap is None else iter_cap
     return _cg_resident_call(
         scale, tol, rtol, lmin, lmax, cap, b3d, x0, shape=b3d.shape,
         maxiter=maxiter, check_every=check_every,
-        degree=int(precond_degree), interpret=interpret)
+        degree=int(precond_degree), interpret=interpret, method=method)
 
 
 # -- df64 (double-float) resident CG ------------------------------------------

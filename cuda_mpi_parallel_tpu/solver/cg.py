@@ -790,14 +790,15 @@ def solve(
                 "engine='resident' needs a float32 2D/3D stencil whose "
                 "CG working set fits VMEM, a float32 rhs, m=None or a "
                 "Chebyshev preconditioner built over this operator, "
-                "method='cg', f32 x0 or none, and no "
-                "checkpointing - use engine='general' (or 'auto') "
-                "otherwise")
+                "method='cg' (or the unpreconditioned 'cg1'), f32 x0 or "
+                "none, and no checkpointing - use engine='general' (or "
+                "'auto') otherwise")
         if eligible:
             return cg_resident(a, b, x0, tol=tol, rtol=rtol,
                                maxiter=maxiter, check_every=check_every,
                                iter_cap=iter_cap, m=m,
                                record_history=record_history,
+                               method=method,
                                interpret=_pallas_interpret())
     if engine in ("auto", "streaming"):
         from ..models.operators import _pallas_interpret

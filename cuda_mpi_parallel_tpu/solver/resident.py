@@ -43,11 +43,13 @@ from .status import CGStatus
 
 
 def supports_resident(a, preconditioned: bool = False,
-                      warm_start: bool = False) -> bool:
+                      warm_start: bool = False,
+                      cg1: bool = False) -> bool:
     """True if ``cg_resident`` can run this operator (see module scope).
 
     ``preconditioned`` budgets the in-kernel Chebyshev recurrence's two
-    extra transient planes; ``warm_start`` budgets the pinned x0 plane.
+    extra transient planes; ``warm_start`` budgets the pinned x0 plane;
+    ``cg1`` the single-reduction recurrence's s/w planes.
     """
     if isinstance(a, Stencil2D):
         if a.dtype != jnp.float32:
@@ -55,14 +57,14 @@ def supports_resident(a, preconditioned: bool = False,
         nx, ny = a.grid
         return supports_resident_2d(nx, ny, itemsize=4,
                                     preconditioned=preconditioned,
-                                    warm_start=warm_start)
+                                    warm_start=warm_start, cg1=cg1)
     if isinstance(a, Stencil3D):
         if a.dtype != jnp.float32:
             return False
         nx, ny, nz = a.grid
         return supports_resident_3d(nx, ny, nz, itemsize=4,
                                     preconditioned=preconditioned,
-                                    warm_start=warm_start)
+                                    warm_start=warm_start, cg1=cg1)
     return False
 
 
@@ -116,14 +118,19 @@ def resident_eligible(a, b=None, m=None, *, method: str = "cg",
     chebyshev = isinstance(m, ChebyshevPreconditioner)
     if m is not None and not chebyshev:
         return False
+    if method not in ("cg", "cg1"):
+        return False
+    if method == "cg1" and m is not None:
+        return False  # the in-kernel cg1 form is unpreconditioned
     # operator gate FIRST: _chebyshev_match_status reads grid/scale,
     # which only stencil operators have
     if not supports_resident(a, preconditioned=chebyshev,
-                             warm_start=x0 is not None):
+                             warm_start=x0 is not None,
+                             cg1=method == "cg1"):
         return False
     if chebyshev and _chebyshev_match_status(a, m) != "match":
         return False
-    if (method != "cg" or record_history
+    if (record_history
             or resume_from is not None or return_checkpoint
             or compensated):
         return False
@@ -146,6 +153,7 @@ def cg_resident(
     iter_cap=None,
     m=None,
     record_history: bool = False,
+    method: str = "cg",
     interpret: bool = False,
 ) -> CGResult:
     """Solve ``A x = b`` entirely inside one VMEM-resident pallas kernel.
@@ -222,11 +230,16 @@ def cg_resident(
             f"cg_resident is float32-only (got {b_grid.dtype}); df64/x64 "
             "precision routes through solver.cg / solver.df64")
 
+    if method == "cg1" and m is not None:
+        raise ValueError(
+            "cg_resident method='cg1' is unpreconditioned (the "
+            "preconditioned Chronopoulos-Gear form needs a third "
+            "reduction)")
     kernel_fn = cg_resident_2d if len(grid) == 2 else cg_resident_3d
     x2d, iters, rr, indef, conv, health, hist = kernel_fn(
         a.scale, b_grid, x0=x0, tol=tol, rtol=rtol, maxiter=maxiter,
         check_every=check_every, iter_cap=iter_cap, interpret=interpret,
-        precond_degree=degree, lmin=lmin, lmax=lmax)
+        precond_degree=degree, lmin=lmin, lmax=lmax, method=method)
 
     history = None
     if record_history:

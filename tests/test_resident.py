@@ -382,6 +382,80 @@ class TestAdvisorR3Regressions:
         assert bool(res.converged) == bool(ref.converged)
 
 
+class TestResidentCG1:
+    """The in-kernel Chronopoulos-Gear single-reduction recurrence
+    (roofline bottleneck-#2 experiment): algebraically the textbook
+    iterates, both inner products at one evaluation point."""
+
+    def test_iteration_parity_vs_general_cg1(self):
+        op, b = _grid_problem()
+        ref = solve(op, jnp.asarray(b.ravel()), tol=1e-5, maxiter=500,
+                    check_every=8, method="cg1")
+        res = cg_resident(op, jnp.asarray(b), tol=1e-5, maxiter=500,
+                          check_every=8, method="cg1", interpret=True)
+        assert int(res.iterations) == int(ref.iterations)
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x).ravel(),
+                                   np.asarray(ref.x), atol=2e-4)
+
+    def test_matches_plain_resident_trajectory(self):
+        op, b = _grid_problem()
+        plain = cg_resident(op, jnp.asarray(b), tol=1e-5, maxiter=500,
+                            check_every=8, interpret=True)
+        cg1 = cg_resident(op, jnp.asarray(b), tol=1e-5, maxiter=500,
+                          check_every=8, method="cg1", interpret=True)
+        # same algebra: equal block-aligned counts (at most one block
+        # apart from rounding)
+        assert abs(int(plain.iterations) - int(cg1.iterations)) <= 8
+
+    def test_3d_and_warm_start_and_history(self):
+        op3 = poisson.poisson_3d_operator(8, 8, 128, dtype=jnp.float32)
+        rng = np.random.default_rng(4)
+        x_true = rng.standard_normal(8 * 8 * 128).astype(np.float32)
+        b3 = op3 @ jnp.asarray(x_true)
+        warm = cg_resident(op3, b3, x0=x_true * np.float32(1 + 1e-3),
+                           tol=1e-4, maxiter=300, check_every=8,
+                           method="cg1", record_history=True,
+                           interpret=True)
+        cold = cg_resident(op3, b3, tol=1e-4, maxiter=300, check_every=8,
+                           method="cg1", interpret=True)
+        assert bool(warm.converged)
+        assert int(warm.iterations) < int(cold.iterations)
+        h = np.asarray(warm.residual_history)
+        assert np.isfinite(h[0]) and np.isfinite(h[int(warm.iterations)])
+
+    def test_breakdown_parity(self):
+        op = Stencil2D.create(8, 128, scale=0.0, dtype=jnp.float32)
+        rng = np.random.default_rng(7)
+        b = jnp.asarray(rng.standard_normal(8 * 128).astype(np.float32))
+        res = cg_resident(op, b.reshape(8, 128), tol=1e-7, maxiter=64,
+                          check_every=4, method="cg1", interpret=True)
+        assert res.status_enum() is CGStatus.BREAKDOWN
+
+    def test_rejections_and_gate(self):
+        op, b = _grid_problem()
+        from cuda_mpi_parallel_tpu.models.precond import (
+            ChebyshevPreconditioner,
+        )
+        from cuda_mpi_parallel_tpu.solver.resident import (
+            resident_eligible,
+        )
+
+        m4 = ChebyshevPreconditioner.from_operator(op, degree=4)
+        with pytest.raises(ValueError, match="cg1"):
+            cg_resident(op, jnp.asarray(b), m=m4, method="cg1",
+                        interpret=True)
+        with pytest.raises(ValueError, match="method"):
+            cg_resident(op, jnp.asarray(b), method="pipecg",
+                        interpret=True)
+        assert resident_eligible(op, method="cg1")
+        assert not resident_eligible(op, m=m4, method="cg1")
+        assert not resident_eligible(op, method="pipecg")
+        # the cg1 gate budgets the extra s/w planes
+        assert rk._extra_planes(False, False, cg1=True) \
+            == rk._extra_planes(False, False) + 2
+
+
 class TestResidentHistory:
     """Quirk Q7 closed on the flagship engine: the kernel's SMEM
     ``||r||^2`` trace surfaces as a check-block-granular
