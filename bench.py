@@ -804,6 +804,37 @@ def bench_all(results) -> None:
 
     _run_section(results, "northstar256", s_northstar)
 
+    # f64-class at the north-star scale: the df64 fused passes (16
+    # plane-passes/iter vs the general df64 solver's ~32).  Its own
+    # section so --resume bookkeeping (skip-if-done, error-isolation)
+    # applies independently of the f32 northstar rows.
+    def s_northstar_df64():
+        from cuda_mpi_parallel_tpu import cg_streaming_df64
+        from cuda_mpi_parallel_tpu.models.operators import Stencil3D
+
+        if jax.default_backend() != "tpu":
+            results["poisson3d_256_streaming_df64"] = {
+                "skipped": "needs a compiled TPU backend"}
+            return
+        a256d = Stencil3D.create(256, 256, 256, dtype=jnp.float32)
+        rng64 = np.random.default_rng(9)
+        b64 = rng64.standard_normal(a256d.shape[0])
+        ctr64 = count(1)
+
+        def run_df(it):
+            return cg_streaming_df64(
+                a256d, b64 * (1.0 + next(ctr64) * 1e-4), tol=0.0,
+                maxiter=it, check_every=32).x_hi
+
+        rate = paired_delta_rate(run_df, 16, 272, pairs=3)
+        results["poisson3d_256_streaming_df64"] = {
+            "us_per_iter": 1e6 / rate,
+            "iters_per_sec": rate,
+            "engine": "streaming_df64",
+            "measurement": "iteration_delta"}
+
+    _run_section(results, "northstar256_df64", s_northstar_df64)
+
     # 4b: distributed 3D Poisson over all local devices (N scaled to fit).
     # Iteration-delta through solve_distributed (the round-2 row ran a
     # single call and reported the dispatch floor); with one local device

@@ -37,7 +37,12 @@ from .solver.resident import (
     supports_resident_df64,
 )
 from .solver.status import CGStatus
-from .solver.streaming import cg_streaming, supports_streaming_op
+from .solver.streaming import (
+    cg_streaming,
+    cg_streaming_df64,
+    supports_streaming_df64,
+    supports_streaming_op,
+)
 
 __version__ = "0.1.0"
 
@@ -61,8 +66,10 @@ __all__ = [
     "cg_resident",
     "cg_resident_df64",
     "cg_streaming",
+    "cg_streaming_df64",
     "solve",
     "supports_resident",
     "supports_resident_df64",
+    "supports_streaming_df64",
     "supports_streaming_op",
 ]

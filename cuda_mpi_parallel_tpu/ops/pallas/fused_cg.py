@@ -408,3 +408,296 @@ def supports_streaming(shape) -> bool:
     except ValueError:
         return False
     return True
+
+
+# -- df64 (double-float) fused streaming passes --------------------------------
+#
+# The reference's defining precision (CUDA_R_64F, CUDACG.cu:216) at the
+# north-star scale: the same two-pass fused iteration with every plane an
+# (hi, lo) f32 pair and every product/accumulation in error-free-transform
+# arithmetic (ops.df64 - branch-free elementwise jnp code that lowers
+# through Mosaic unchanged, proven by the resident df64 kernel).  HBM
+# traffic doubles (two words per value): 16 plane-passes per iteration
+# vs the general df64 solver's ~32 at the same fusion boundaries.
+
+from .. import df64 as _df  # noqa: E402  (section-local import, see above)
+from .resident import _dot_df as _dot_df_grid  # noqa: E402
+
+
+def _stencil_slab_df(u, scale, bm, ndim):
+    """df64 Laplacian on an (hi, lo) halo-slab pair -> interior pair.
+
+    2D: ``4*u`` is exact in f32; 3D: ``6*u`` built as the exact
+    ``4*u + 2*u`` (``ops.df64.stencil*_matvec`` semantics).  Vertical
+    neighbors come from the slab's halo rows/planes; lane/sublane
+    shifts move both words identically (exact).
+    """
+    uh, ul = u
+    if ndim == 2:
+        wh = uh[_HALO - 1:_HALO + bm + 1]
+        wl = ul[_HALO - 1:_HALO + bm + 1]
+        acc = (4.0 * wh[1:-1], 4.0 * wl[1:-1])
+        for nb in ((wh[:-2], wl[:-2]), (wh[2:], wl[2:]),
+                   (_shift_right(wh[1:-1]), _shift_right(wl[1:-1])),
+                   (_shift_left(wh[1:-1]), _shift_left(wl[1:-1]))):
+            acc = _df.sub(acc, nb)
+    else:
+        mid_h, mid_l = uh[1:-1], ul[1:-1]
+        acc = _df.add((4.0 * mid_h, 4.0 * mid_l),
+                      (2.0 * mid_h, 2.0 * mid_l))
+        ylo = (jnp.concatenate([jnp.zeros_like(mid_h[:, :1]),
+                                mid_h[:, :-1]], axis=1),
+               jnp.concatenate([jnp.zeros_like(mid_l[:, :1]),
+                                mid_l[:, :-1]], axis=1))
+        yhi = (jnp.concatenate([mid_h[:, 1:],
+                                jnp.zeros_like(mid_h[:, :1])], axis=1),
+               jnp.concatenate([mid_l[:, 1:],
+                                jnp.zeros_like(mid_l[:, :1])], axis=1))
+        for nb in ((uh[:-2], ul[:-2]), (uh[2:], ul[2:]), ylo, yhi,
+                   (_shift_right(mid_h), _shift_right(mid_l)),
+                   (_shift_left(mid_h), _shift_left(mid_l))):
+            acc = _df.sub(acc, nb)
+    return _df.mul(scale, acc)
+
+
+def _interior_pair(slab, bm, ndim):
+    return (_interior(slab[0], bm, ndim), _interior(slab[1], bm, ndim))
+
+
+def _pass_a_kernel_df64(params_ref, *refs, bm, nx, ndim, has_halo):
+    if has_halo:
+        (rh_lo, rh_hi, rl_lo, rl_hi, ph_lo, ph_hi, pl_lo, pl_hi,
+         rh_hbm, rl_hbm, ph_hbm, pl_hbm,
+         pnh_ref, pnl_ref, pap_ref,
+         rh_slabs, rl_slabs, ph_slabs, pl_slabs, sems, acc) = refs
+    else:
+        (rh_hbm, rl_hbm, ph_hbm, pl_hbm,
+         pnh_ref, pnl_ref, pap_ref,
+         rh_slabs, rl_slabs, ph_slabs, pl_slabs, sems, acc) = refs
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    copy, wait = (_slab_copy, _slab_wait) if ndim == 2 else (
+        _slab_copy3d, _slab_wait3d)
+    arrays = ((rh_hbm, rh_slabs, 0), (rl_hbm, rl_slabs, 1),
+              (ph_hbm, ph_slabs, 2), (pl_hbm, pl_slabs, 3))
+
+    @pl.when(i == 0)
+    def _():
+        acc[0] = jnp.float32(0.0)
+        acc[1] = jnp.float32(0.0)
+        for hbm, slabs, si in arrays:
+            copy(hbm, slabs.at[0], sems.at[2 * si], 0, bm, nx)
+
+    @pl.when(i + 1 < n)
+    def _():
+        for hbm, slabs, si in arrays:
+            copy(hbm, slabs.at[(i + 1) % 2], sems.at[2 * si + (i + 1) % 2],
+                 i + 1, bm, nx)
+
+    for hbm, slabs, si in arrays:
+        wait(hbm, slabs.at[i % 2], sems.at[2 * si + i % 2], i, bm, nx)
+    if has_halo:
+        halos = ((rh_slabs, rh_lo, rh_hi), (rl_slabs, rl_lo, rl_hi),
+                 (ph_slabs, ph_lo, ph_hi), (pl_slabs, pl_lo, pl_hi))
+        for slabs, lo_ref, hi_ref in halos:
+            _fill_edge_halo(slabs.at[i % 2], lo_ref, hi_ref, i, bm, nx,
+                            ndim)
+
+    scale = (params_ref[0], params_ref[1])
+    beta = (params_ref[2], params_ref[3])
+    r_slab = (rh_slabs[i % 2], rl_slabs[i % 2])
+    p_slab = (ph_slabs[i % 2], pl_slabs[i % 2])
+    # deferred p-update on the FULL halo slab (elementwise in df64)
+    bh = jnp.broadcast_to(beta[0], r_slab[0].shape)
+    bl = jnp.broadcast_to(beta[1], r_slab[0].shape)
+    pnew_slab = _df.add(r_slab, _df.mul((bh, bl), p_slab))
+    ap = _stencil_slab_df(pnew_slab, scale, bm, ndim)
+    pnew_int = _interior_pair(pnew_slab, bm, ndim)
+    pnh_ref[:], pnl_ref[:] = pnew_int
+    part = _dot_df_grid(pnew_int[0], pnew_int[1], ap[0], ap[1])
+    s = _df.add((acc[0], acc[1]), part)
+    acc[0], acc[1] = s
+
+    @pl.when(i == n - 1)
+    def _():
+        pap_ref[0] = acc[0]
+        pap_ref[1] = acc[1]
+
+
+def _pass_b_kernel_df64(params_ref, *refs, bm, nx, ndim, has_halo):
+    if has_halo:
+        (pnh_lo, pnh_hi, pnl_lo, pnl_hi,
+         pnh_hbm, pnl_hbm, xh_ref, xl_ref, rh_ref, rl_ref,
+         xho_ref, xlo_ref, rho_ref, rlo_ref, rr_ref,
+         ph_slabs, pl_slabs, sems, acc) = refs
+    else:
+        (pnh_hbm, pnl_hbm, xh_ref, xl_ref, rh_ref, rl_ref,
+         xho_ref, xlo_ref, rho_ref, rlo_ref, rr_ref,
+         ph_slabs, pl_slabs, sems, acc) = refs
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    copy, wait = (_slab_copy, _slab_wait) if ndim == 2 else (
+        _slab_copy3d, _slab_wait3d)
+    arrays = ((pnh_hbm, ph_slabs, 0), (pnl_hbm, pl_slabs, 1))
+
+    @pl.when(i == 0)
+    def _():
+        acc[0] = jnp.float32(0.0)
+        acc[1] = jnp.float32(0.0)
+        for hbm, slabs, si in arrays:
+            copy(hbm, slabs.at[0], sems.at[2 * si], 0, bm, nx)
+
+    @pl.when(i + 1 < n)
+    def _():
+        for hbm, slabs, si in arrays:
+            copy(hbm, slabs.at[(i + 1) % 2], sems.at[2 * si + (i + 1) % 2],
+                 i + 1, bm, nx)
+
+    for hbm, slabs, si in arrays:
+        wait(hbm, slabs.at[i % 2], sems.at[2 * si + i % 2], i, bm, nx)
+    if has_halo:
+        for slabs, lo_ref, hi_ref in ((ph_slabs, pnh_lo, pnh_hi),
+                                      (pl_slabs, pnl_lo, pnl_hi)):
+            _fill_edge_halo(slabs.at[i % 2], lo_ref, hi_ref, i, bm, nx,
+                            ndim)
+
+    scale = (params_ref[0], params_ref[1])
+    alpha = (params_ref[2], params_ref[3])
+    slab = (ph_slabs[i % 2], pl_slabs[i % 2])
+    ap = _stencil_slab_df(slab, scale, bm, ndim)
+    pnew_int = _interior_pair(slab, bm, ndim)
+    ah = jnp.broadcast_to(alpha[0], pnew_int[0].shape)
+    al = jnp.broadcast_to(alpha[1], pnew_int[0].shape)
+    x_new = _df.add((xh_ref[:], xl_ref[:]),
+                    _df.mul((ah, al), pnew_int))
+    xho_ref[:], xlo_ref[:] = x_new
+    r_new = _df.sub((rh_ref[:], rl_ref[:]), _df.mul((ah, al), ap))
+    rho_ref[:], rlo_ref[:] = r_new
+    part = _dot_df_grid(r_new[0], r_new[1], r_new[0], r_new[1])
+    s = _df.add((acc[0], acc[1]), part)
+    acc[0], acc[1] = s
+
+    @pl.when(i == n - 1)
+    def _():
+        rr_ref[0] = acc[0]
+        rr_ref[1] = acc[1]
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "interpret"))
+def fused_cg_pass_a_df64(scale, beta, r, p, halos=None, *, bm: int,
+                         interpret: bool = False):
+    """df64 pass A: ``p_new = r + beta p``; ``pap = p_new . A p_new``.
+
+    ``scale``/``beta``: df64 scalar pairs; ``r``/``p``: (hi, lo) grid
+    pairs; ``halos``: optional (r_lo, r_hi, p_lo, p_hi) each as an
+    (hi, lo) pair of boundary rows.  Returns ``(p_new_pair, pap_pair)``.
+    """
+    shape = r[0].shape
+    ndim = r[0].ndim
+    nx = shape[0]
+    has_halo = halos is not None
+    params = jnp.stack([jnp.asarray(scale[0], jnp.float32),
+                        jnp.asarray(scale[1], jnp.float32),
+                        jnp.asarray(beta[0], jnp.float32),
+                        jnp.asarray(beta[1], jnp.float32)])
+    kernel = functools.partial(_pass_a_kernel_df64, bm=bm, nx=nx,
+                               ndim=ndim, has_halo=has_halo)
+    block = (bm,) + shape[1:]
+    index_map = (lambda i: (i, 0)) if ndim == 2 else (lambda i: (i, 0, 0))
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    halo_inputs = ()
+    if has_halo:
+        (r_lo, r_hi, p_lo, p_hi) = halos
+        halo_inputs = (r_lo[0], r_hi[0], r_lo[1], r_hi[1],
+                       p_lo[0], p_hi[0], p_lo[1], p_hi[1])
+    slab = _slab_shape(bm, shape)
+    pnh, pnl, pap = pl.pallas_call(
+        kernel,
+        grid=(nx // bm,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [vmem] * len(halo_inputs)
+        + [pl.BlockSpec(memory_space=pl.ANY)] * 4,   # r/p hi+lo
+        out_specs=[
+            pl.BlockSpec(block, index_map),          # p_new hi
+            pl.BlockSpec(block, index_map),          # p_new lo
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # pap (df64 pair)
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape, jnp.float32),
+            jax.ShapeDtypeStruct(shape, jnp.float32),
+            jax.ShapeDtypeStruct((2,), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2,) + slab, jnp.float32),    # r hi
+            pltpu.VMEM((2,) + slab, jnp.float32),    # r lo
+            pltpu.VMEM((2,) + slab, jnp.float32),    # p hi
+            pltpu.VMEM((2,) + slab, jnp.float32),    # p lo
+            pltpu.SemaphoreType.DMA((8,)),
+            pltpu.SMEM((2,), jnp.float32),           # pap df64 accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_BUDGET),
+        interpret=interpret,
+    )(params, *halo_inputs, r[0], r[1], p[0], p[1])
+    return (pnh, pnl), (pap[0], pap[1])
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "interpret"))
+def fused_cg_pass_b_df64(scale, alpha, pnew, x, r, halos=None, *, bm: int,
+                         interpret: bool = False):
+    """df64 pass B: ``x += alpha p``, ``r -= alpha A p``, ``rr = r.r``;
+    Ap recomputed from ``p_new``'s halo slabs; x/r pairs donated
+    in place.  Returns ``(x_pair, r_pair, rr_pair)``."""
+    shape = x[0].shape
+    ndim = x[0].ndim
+    nx = shape[0]
+    has_halo = halos is not None
+    params = jnp.stack([jnp.asarray(scale[0], jnp.float32),
+                        jnp.asarray(scale[1], jnp.float32),
+                        jnp.asarray(alpha[0], jnp.float32),
+                        jnp.asarray(alpha[1], jnp.float32)])
+    kernel = functools.partial(_pass_b_kernel_df64, bm=bm, nx=nx,
+                               ndim=ndim, has_halo=has_halo)
+    block = (bm,) + shape[1:]
+    index_map = (lambda i: (i, 0)) if ndim == 2 else (lambda i: (i, 0, 0))
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    halo_inputs = ()
+    if has_halo:
+        (pn_lo, pn_hi) = halos
+        halo_inputs = (pn_lo[0], pn_hi[0], pn_lo[1], pn_hi[1])
+    nh = len(halo_inputs)
+    slab = _slab_shape(bm, shape)
+    xh, xl, rh, rl, rr = pl.pallas_call(
+        kernel,
+        grid=(nx // bm,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [vmem] * nh
+        + [pl.BlockSpec(memory_space=pl.ANY)] * 2    # p_new hi+lo
+        + [pl.BlockSpec(block, index_map)] * 4,      # x/r hi+lo
+        out_specs=[
+            pl.BlockSpec(block, index_map),          # x hi out
+            pl.BlockSpec(block, index_map),          # x lo out
+            pl.BlockSpec(block, index_map),          # r hi out
+            pl.BlockSpec(block, index_map),          # r lo out
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # rr (df64 pair)
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape, jnp.float32),
+            jax.ShapeDtypeStruct(shape, jnp.float32),
+            jax.ShapeDtypeStruct(shape, jnp.float32),
+            jax.ShapeDtypeStruct(shape, jnp.float32),
+            jax.ShapeDtypeStruct((2,), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2,) + slab, jnp.float32),    # p_new hi
+            pltpu.VMEM((2,) + slab, jnp.float32),    # p_new lo
+            pltpu.SemaphoreType.DMA((4,)),
+            pltpu.SMEM((2,), jnp.float32),
+        ],
+        input_output_aliases={3 + nh: 0, 4 + nh: 1, 5 + nh: 2,
+                              6 + nh: 3},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_BUDGET),
+        interpret=interpret,
+    )(params, *halo_inputs, pnew[0], pnew[1], x[0], x[1], r[0], r[1])
+    return (xh, xl), (rh, rl), (rr[0], rr[1])

@@ -4,7 +4,10 @@ collectives - the TPU-native communication backend the reference's repo name
 
 from . import multihost
 from .df64 import DistStencilDF64, solve_distributed_df64
-from .streaming import solve_distributed_streaming
+from .streaming import (
+    solve_distributed_streaming,
+    solve_distributed_streaming_df64,
+)
 from .dist_cg import solve_distributed
 from .halo import exchange_halo, exchange_halo_axis, neighbor_shift_perms
 from .mesh import (
@@ -57,4 +60,5 @@ __all__ = [
     "solve_distributed",
     "solve_distributed_df64",
     "solve_distributed_streaming",
+    "solve_distributed_streaming_df64",
 ]

@@ -173,3 +173,163 @@ def _build(mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
             indefinite=indef, residual_history=None)
 
     return run
+
+
+def solve_distributed_streaming_df64(
+    a,
+    b,
+    *,
+    mesh: Optional[Mesh] = None,
+    n_devices: Optional[int] = None,
+    tol: float = 1e-7,
+    rtol: float = 0.0,
+    maxiter: int = 2000,
+    check_every: int = 1,
+):
+    """f64-class distributed fused streaming CG over a slab mesh.
+
+    The df64 twin of :func:`solve_distributed_streaming`: the df64
+    fused passes (``fused_cg_pass_{a,b}_df64``) as the per-shard local
+    step, hi/lo halo rows riding ppermute into the kernels' edge slabs,
+    the slab-accumulated df64 dot partials reduced EXACTLY over the
+    mesh (``ops.df64._allreduce_df`` - one collective, no f32 rounding
+    of the hi-sum).  Returns a ``DF64CGResult`` with the global sharded
+    solution pair.
+    """
+    import numpy as np
+
+    from ..ops import df64 as df
+    from ..solver.df64 import DF64CGResult, _coerce_rhs_df
+    from ..solver.status import CGStatus as _St
+
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    if len(mesh.axis_names) != 1:
+        raise ValueError(
+            "solve_distributed_streaming_df64 supports 1-D (slab) meshes")
+    if not isinstance(a, (Stencil2D, Stencil3D)):
+        raise TypeError(
+            f"solve_distributed_streaming_df64 needs a Stencil2D/"
+            f"Stencil3D, got {type(a).__name__}")
+    axis = mesh.axis_names[0]
+    n_shards = mesh.devices.size
+    grid = a.grid
+    if grid[0] % n_shards:
+        raise ValueError(
+            f"leading grid axis {grid[0]} does not divide over "
+            f"{n_shards} shards")
+    local_grid = (grid[0] // n_shards,) + grid[1:]
+    if not supports_streaming(local_grid):
+        raise ValueError(
+            f"per-shard slab {local_grid} does not satisfy the fused-CG "
+            f"tiling")
+    bm = pick_block_streaming(local_grid)
+    b_df = _coerce_rhs_df(b)
+    bh = shard_vector(b_df[0].reshape(-1), mesh, axis)
+    bl = shard_vector(b_df[1].reshape(-1), mesh, axis)
+    scale64 = np.float64(np.asarray(a.scale, dtype=np.float64))
+    sh, sl = df.split_f64(scale64)
+    interpret = _pallas_interpret()
+
+    key = ("streaming_df64", local_grid, n_shards, axis, mesh, maxiter,
+           check_every, bm, interpret)
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = _CACHE[key] = jax.jit(_build_df64(
+            mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
+            interpret))
+    xh, xl, iters, rr_hi, rr_lo, indef, conv, health = fn(
+        bh, bl, jnp.asarray(sh), jnp.asarray(sl),
+        jnp.asarray(float(tol) ** 2, jnp.float32),
+        jnp.asarray(float(rtol) ** 2, jnp.float32))
+    status = jnp.where(
+        conv, jnp.int32(_St.CONVERGED),
+        jnp.where(~health, jnp.int32(_St.BREAKDOWN),
+                  jnp.int32(_St.MAXITER)))
+    return DF64CGResult(
+        x_hi=xh, x_lo=xl, iterations=iters,
+        residual_norm_sq_hi=rr_hi, residual_norm_sq_lo=rr_lo,
+        converged=conv, status=status, indefinite=indef,
+        residual_history=None)
+
+
+def _build_df64(mesh, axis, n_shards, local_grid, maxiter, check_every,
+                bm, interpret):
+    from ..ops import df64 as df
+    from ..ops.pallas.fused_cg import (
+        fused_cg_pass_a_df64,
+        fused_cg_pass_b_df64,
+    )
+    from ..ops.pallas.resident import _safe_div_df
+    from ..solver.df64 import _threshold
+
+    out_specs = (P(axis), P(axis), P(), P(), P(), P(), P(), P())
+
+    def exchange_pair(u):
+        lo_h, hi_h = exchange_halo(u[0], axis, n_shards)
+        lo_l, hi_l = exchange_halo(u[1], axis, n_shards)
+        return ((lo_h, lo_l), (hi_h, hi_l))
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(P(axis), P(axis), P(), P(), P(), P()),
+             out_specs=out_specs, check_vma=False)
+    def run(bh_local, bl_local, scale_h, scale_l, tol2_s, rtol2_s):
+        scale = (scale_h, scale_l)
+        r = (bh_local.reshape(local_grid), bl_local.reshape(local_grid))
+        x = (jnp.zeros(local_grid, jnp.float32),
+             jnp.zeros(local_grid, jnp.float32))
+        local_rr = df._dot_local((r[0].reshape(-1), r[1].reshape(-1)),
+                                 (r[0].reshape(-1), r[1].reshape(-1)))
+        rr0 = df._allreduce_df(local_rr[0], local_rr[1], axis)
+        tol2 = (tol2_s, jnp.zeros((), jnp.float32))
+        rtol2 = (rtol2_s, jnp.zeros((), jnp.float32))
+        thr = _threshold(tol2, rtol2, rr0)
+        zerop = (jnp.zeros(local_grid, jnp.float32),
+                 jnp.zeros(local_grid, jnp.float32))
+        zeros = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+
+        state = (jnp.zeros((), jnp.int32), x, r, zerop, zeros, rr0,
+                 jnp.zeros((), jnp.bool_))
+
+        def cond(s):
+            k, _, _, _, _, rho, _ = s
+            unconverged = jnp.logical_not(df.less(rho, thr))
+            return (k < maxiter) & unconverged & (rho[0] > 0) \
+                & jnp.isfinite(rho[0])
+
+        def step(s):
+            k, x, r, p_prev, beta_prev, rho, indef = s
+            r_lo, r_hi = exchange_pair(r)
+            p_lo, p_hi = exchange_pair(p_prev)
+            p, pap_local = fused_cg_pass_a_df64(
+                scale, beta_prev, r, p_prev, (r_lo, r_hi, p_lo, p_hi),
+                bm=bm, interpret=interpret)
+            pap = df._allreduce_df(pap_local[0], pap_local[1], axis)
+            indef = indef | ((pap[0] <= 0) & (rho[0] > 0))
+            alpha = _safe_div_df(rho, pap)
+            # p_new's boundary rows derive LOCALLY from the exchanged
+            # halos (beta is a global df64 scalar), no third round-trip
+            bb = beta_prev
+            pn_lo = df.add(r_lo, df.mul(
+                (jnp.broadcast_to(bb[0], p_lo[0].shape),
+                 jnp.broadcast_to(bb[1], p_lo[0].shape)), p_lo))
+            pn_hi = df.add(r_hi, df.mul(
+                (jnp.broadcast_to(bb[0], p_hi[0].shape),
+                 jnp.broadcast_to(bb[1], p_hi[0].shape)), p_hi))
+            x, r, rr_local = fused_cg_pass_b_df64(
+                scale, alpha, p, x, r, (pn_lo, pn_hi), bm=bm,
+                interpret=interpret)
+            rr = df._allreduce_df(rr_local[0], rr_local[1], axis)
+            beta = _safe_div_df(rr, rho)
+            return (k + 1, x, r, p, beta, rr, indef)
+
+        state = _blocked_while(
+            cond, step, state, check_every,
+            lambda s: s[0] + check_every <= maxiter)
+        k, x, r, _, _, rho, indef = state
+        healthy = jnp.isfinite(rho[0])
+        converged = df.less(rho, thr) | (rho[0] == 0)
+        return (x[0].reshape(-1), x[1].reshape(-1), k, rho[0], rho[1],
+                indef, converged, healthy)
+
+    return run

@@ -231,3 +231,146 @@ def cg_streaming(
         converged=converged.astype(bool), status=status,
         indefinite=indef.astype(bool),
         residual_history=hist)
+
+
+# -- df64 (double-float) streaming solver --------------------------------------
+
+
+def supports_streaming_df64(a) -> bool:
+    """True if ``cg_streaming_df64`` can run this operator: an
+    ``Stencil2D``/``Stencil3D`` (any stored dtype - the solve re-splits
+    the scale from host f64) whose grid satisfies the fused-CG slab
+    tiling."""
+    if not isinstance(a, (Stencil2D, Stencil3D)):
+        return False
+    return supports_streaming(a.grid)
+
+
+def cg_streaming_df64(
+    a,
+    b,
+    *,
+    tol: float = 1e-7,
+    rtol: float = 0.0,
+    maxiter: int = 2000,
+    check_every: int = 1,
+    iter_cap=None,
+    interpret: bool = False,
+):
+    """f64-class fused-iteration streaming CG (df64 storage).
+
+    The reference's defining precision (``CUDA_R_64F``,
+    ``CUDACG.cu:216``) at the north-star scale: the same two-pass fused
+    iteration as :func:`cg_streaming` with every plane an (hi, lo) pair
+    and every product/accumulation in error-free transforms
+    (``ops/pallas/fused_cg.fused_cg_pass_{a,b}_df64``) - 16 HBM
+    plane-passes per iteration vs the general df64 solver's ~32.
+    Arguments and the rhs coercion mirror ``solver.df64.cg_df64``
+    (threshold ``max(tol^2, rtol^2 ||r0||^2)`` evaluated in df64);
+    returns a ``DF64CGResult``.
+    """
+    import numpy as np
+
+    from ..ops import df64 as df
+    from ..ops.pallas.fused_cg import (
+        fused_cg_pass_a_df64,
+        fused_cg_pass_b_df64,
+    )
+    from ..ops.pallas.resident import _safe_div_df
+    from .df64 import DF64CGResult, _coerce_rhs_df
+
+    if not isinstance(a, (Stencil2D, Stencil3D)):
+        raise TypeError(
+            f"cg_streaming_df64 needs a Stencil2D or Stencil3D operator, "
+            f"got {type(a).__name__} - use solver.df64.cg_df64 for "
+            f"general operators")
+    grid = a.grid
+    if not supports_streaming(grid):
+        raise ValueError(
+            f"grid {grid} does not satisfy the fused-CG slab tiling "
+            f"(2D: nx % 8 == 0, ny % 128 == 0; 3D: nx % 2 == 0, "
+            f"ny % 8 == 0, nz % 128 == 0)")
+    if check_every < 1:
+        raise ValueError(f"check_every must be >= 1, got {check_every}")
+    n_cells = math.prod(grid)
+    b_df = _coerce_rhs_df(b)
+    if b_df[0].ndim == 1:
+        if b_df[0].shape[0] != n_cells:
+            raise ValueError(
+                f"rhs length {b_df[0].shape[0]} != grid {grid}")
+        b_df = (b_df[0].reshape(grid), b_df[1].reshape(grid))
+    elif b_df[0].shape != grid:
+        raise ValueError(f"rhs shape {b_df[0].shape} != grid {grid}")
+    # re-split the scale from host f64 (solver.df64._prepare_operator)
+    scale64 = np.float64(np.asarray(a.scale, dtype=np.float64))
+    sh, sl = df.split_f64(scale64)
+    scale = (jnp.asarray(sh), jnp.asarray(sl))
+    bm = pick_block_streaming(grid)
+    cap = jnp.asarray(maxiter if iter_cap is None else iter_cap,
+                      jnp.int32)
+    tol2 = df.const(float(tol) ** 2)
+    rtol2 = df.const(float(rtol) ** 2)
+
+    xh, xl, iters, rr_pair, indef, conv, health = _cg_streaming_df64_call(
+        scale, b_df, tol2, rtol2, cap, shape=grid, maxiter=maxiter,
+        check_every=min(check_every, max(maxiter, 1)), bm=bm,
+        interpret=interpret, safe_div=_safe_div_df,
+        pass_a=fused_cg_pass_a_df64, pass_b=fused_cg_pass_b_df64)
+    status = jnp.where(
+        conv, jnp.int32(CGStatus.CONVERGED),
+        jnp.where(~health, jnp.int32(CGStatus.BREAKDOWN),
+                  jnp.int32(CGStatus.MAXITER)))
+    return DF64CGResult(
+        x_hi=xh.reshape(-1), x_lo=xl.reshape(-1), iterations=iters,
+        residual_norm_sq_hi=rr_pair[0], residual_norm_sq_lo=rr_pair[1],
+        converged=conv, status=status, indefinite=indef,
+        residual_history=None)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "shape", "maxiter", "check_every", "bm", "interpret", "safe_div",
+    "pass_a", "pass_b"))
+def _cg_streaming_df64_call(scale, b_df, tol2, rtol2, cap, *, shape,
+                            maxiter, check_every, bm, interpret,
+                            safe_div, pass_a, pass_b):
+    from ..ops import df64 as df
+    from .df64 import _threshold
+
+    x = (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+    r = b_df                              # x0 = 0 (CUDACG.cu:248)
+    # df.dot folds flat vectors to a scalar pair (grid shapes would
+    # leave a lane axis); init-only, so the reshape is free
+    rr0 = df.dot((r[0].reshape(-1), r[1].reshape(-1)),
+                 (r[0].reshape(-1), r[1].reshape(-1)))
+    thr = _threshold(tol2, rtol2, rr0)
+    zerop = (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+    zeros = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+
+    state = (jnp.zeros((), jnp.int32), x, r, zerop, zeros, rr0,
+             jnp.zeros((), jnp.bool_))
+
+    def cond(s):
+        k, _, _, _, _, rho, _ = s
+        unconverged = jnp.logical_not(df.less(rho, thr))
+        return (k < maxiter) & (k < cap) & unconverged & (rho[0] > 0) \
+            & jnp.isfinite(rho[0])
+
+    def step(s):
+        k, x, r, p_prev, beta_prev, rho, indef = s
+        p, pap = pass_a(scale, beta_prev, r, p_prev, bm=bm,
+                        interpret=interpret)
+        indef = indef | ((pap[0] <= 0) & (rho[0] > 0))
+        alpha = safe_div(rho, pap)
+        x, r, rr = pass_b(scale, alpha, p, x, r, bm=bm,
+                          interpret=interpret)
+        beta = safe_div(rr, rho)
+        return (k + 1, x, r, p, beta, rr, indef)
+
+    state = _blocked_while(
+        cond, step, state, check_every,
+        lambda s: (s[0] + check_every <= maxiter)
+        & (s[0] + check_every <= cap))
+    k, x, r, _, _, rho, indef = state
+    healthy = jnp.isfinite(rho[0])
+    converged = df.less(rho, thr) | (rho[0] == 0)
+    return (x[0], x[1], k, rho, indef, converged, healthy)
