@@ -757,28 +757,62 @@ def supports_resident_df64_2d(nx: int, ny: int, device=None,
     return planes * nx * ny * 4 <= vmem_bytes(device)
 
 
+#: df64 fold-tree radix (env ``CMP_DF64_FOLD_RADIX``, default 2).  The
+#: roofline's bottleneck-#2 experiment (a): a radix-r level combines r
+#: contiguous chunks through a PAIRWISE tree (depth ceil(log2 r)), so
+#: the dependent-add depth stays ~log2(m) at any radix - what radix 4
+#: actually halves is the number of slice/pad/concatenate ROUNDS
+#: (e.g. 13 -> 7 on an 8192-lane axis), isolating whether that
+#: bookkeeping, not the adds, is what the trees pay for.  Read at
+#: TRACE time: set the env var before the first kernel build to A/B on
+#: hardware without code changes.  The replay-resumable df64 path
+#: records the radix in its checkpoints (the summation order changes
+#: bitwise results, so a cross-radix resume must fail loudly).
+_FOLD_RADIX_ENV = "CMP_DF64_FOLD_RADIX"
+
+
+def _fold_radix() -> int:
+    radix = int(os.environ.get(_FOLD_RADIX_ENV, "2"))
+    if radix < 2:
+        raise ValueError(f"{_FOLD_RADIX_ENV} must be >= 2, got {radix}")
+    return radix
+
+
 def _fold_grid_df(hi, lo):
     """Reduce a df64 grid pair (any rank) to a scalar pair through
-    pairwise half-folding trees of full df64 adds - the in-kernel form
-    of ``ops.df64._fold_df`` (contiguous half-folds, never strided
-    slices; axis by axis; odd extents zero-pad by one, exact for
+    radix-``_fold_radix()`` folding trees of full df64 adds - the
+    in-kernel form of ``ops.df64._fold_df`` (contiguous chunk slices,
+    never strided; axis by axis; ragged extents zero-pad, exact for
     adds)."""
+    radix = _fold_radix()
+
     def fold_axis(h, l, axis):
         while h.shape[axis] > 1:
             m = h.shape[axis]
-            half = (m + 1) // 2
-            if m % 2:
-                one = [slice(None)] * h.ndim
-                one[axis] = slice(None, 1)
-                zh = jnp.zeros_like(h[tuple(one)])
+            r = min(radix, m)
+            chunk = -(-m // r)
+            pad = chunk * r - m
+            if pad:
+                padding = [slice(None)] * h.ndim
+                padding[axis] = slice(None, pad)
+                zh = jnp.zeros_like(h[tuple(padding)])
                 h = jnp.concatenate([h, zh], axis)
                 l = jnp.concatenate([l, jnp.zeros_like(zh)], axis)
-            top = [slice(None)] * h.ndim
-            bot = [slice(None)] * h.ndim
-            top[axis] = slice(None, half)
-            bot[axis] = slice(half, None)
-            h, l = df.add((h[tuple(top)], l[tuple(top)]),
-                          (h[tuple(bot)], l[tuple(bot)]))
+            parts = []
+            for j in range(r):
+                sl = [slice(None)] * h.ndim
+                sl[axis] = slice(j * chunk, (j + 1) * chunk)
+                parts.append((h[tuple(sl)], l[tuple(sl)]))
+            # pairwise within the level: a linear accumulator chain
+            # would lengthen the dependent-add critical path (r-1 per
+            # level) and invert the latency experiment this lever runs
+            while len(parts) > 1:
+                nxt = [df.add(parts[j], parts[j + 1])
+                       for j in range(0, len(parts) - 1, 2)]
+                if len(parts) % 2:
+                    nxt.append(parts[-1])
+                parts = nxt
+            h, l = parts[0]
         return h, l
 
     for axis in range(hi.ndim):

@@ -375,10 +375,16 @@ def _save_replay_ckpt(path, k, x_hi, x_lo, fingerprint):
     """Replay-mode checkpoint: progress marker + current iterate.  The
     resident kernel's r/p/rho live in VMEM scratch and are re-derived by
     the replay; x is stored for inspection (it IS the current solution
-    estimate), k is what resume actually needs."""
+    estimate), k is what resume actually needs.  The df64 fold radix is
+    recorded too: replay's bitwise guarantee depends on the summation
+    order, so resuming under a different CMP_DF64_FOLD_RADIX must fail
+    loudly, not silently change the trajectory."""
+    from ..ops.pallas.resident import _fold_radix
+
     tmp = f"{path}.tmp.{os.getpid()}"
     np.savez(tmp, version=_FORMAT_VERSION, fingerprint=fingerprint,
              kind="df64-replay", k=np.asarray(k),
+             fold_radix=np.asarray(_fold_radix()),
              x_hi=np.asarray(x_hi), x_lo=np.asarray(x_lo))
     os.replace(tmp + ".npz", path)
 
@@ -398,6 +404,17 @@ def _load_replay_k(path, expect_fingerprint) -> int:
                 f"expected {_FORMAT_VERSION}")
         stored = str(z["fingerprint"]) if "fingerprint" in z else ""
         _check_fingerprint(stored, expect_fingerprint, path)
+        from ..ops.pallas.resident import _fold_radix
+
+        saved_radix = (int(np.asarray(z["fold_radix"]))
+                       if "fold_radix" in z else 2)
+        if saved_radix != _fold_radix():
+            raise ValueError(
+                f"checkpoint {path} was written with df64 fold radix "
+                f"{saved_radix} but this process runs radix "
+                f"{_fold_radix()} (CMP_DF64_FOLD_RADIX): the replay's "
+                f"bitwise guarantee depends on the summation order - "
+                f"set the matching radix or delete the checkpoint")
         return int(np.asarray(z["k"]))
 
 
