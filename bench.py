@@ -20,8 +20,14 @@ backend through a subprocess-probe retry loop with exponential backoff
 before touching jax in-process, (b) flushes ``bench_results.json`` after
 every completed section so a late failure keeps everything already
 measured, (c) classifies failures (``device_unreachable`` vs
-``code_error``) in the emitted record, and (d) on a mid-run backend loss
-re-acquires the device and resumes, skipping completed sections.
+``code_error``) in the emitted record, (d) on a mid-run backend loss
+re-acquires the device and resumes, skipping completed sections, and
+(e) keeps stdout's TAIL always holding a parseable record - a
+provisional failure record at startup, refreshed after every failed
+probe, plus a SIGTERM handler - because the round-4 driver killed the
+bench from outside (~30 min, rc 124) while it was still waiting out an
+outage and the round recorded nothing.  Defaults are sized to that
+external budget; long waits are explicit (``--acquire-wait 3600``).
 
 Usage::
 
@@ -36,6 +42,7 @@ import datetime
 import glob
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -49,6 +56,12 @@ from itertools import count
 # overhead for its 2 blocking D2H syncs and per-iteration cudaMalloc
 # (CUDACG.cu:269-352), with a sensitivity range of ~3300-8300 iters/s.
 BASELINE_ITERS_PER_SEC = 5000.0
+
+# Default backend-acquire window, seconds.  Single source of truth for
+# the acquire_backend default AND the --acquire-wait argparse default:
+# it must fit (plus the watchdog margin) inside the driver's observed
+# ~30-min external kill budget (BENCH_r04.json: rc 124 ~29 min in).
+DEFAULT_ACQUIRE_WAIT = 600.0
 
 HEADLINE_GRID = 1024          # 1024x1024 -> N = 1,048,576 unknowns
 ITERS_LO, ITERS_HI = 100, 10100
@@ -113,7 +126,8 @@ def _probe_backend_once(timeout: float = 180.0):
     return proc.returncode == 0, out[-500:]
 
 
-def acquire_backend(max_wait: float = 3600.0) -> None:
+def acquire_backend(max_wait: float = DEFAULT_ACQUIRE_WAIT,
+                    on_fail=None) -> None:
     """Block until the device backend is usable; raise ``_BackendLost``.
 
     Probes in a subprocess with exponential backoff (5s doubling to 60s,
@@ -123,13 +137,19 @@ def acquire_backend(max_wait: float = 3600.0) -> None:
     probe the main process's own backend is verified too (clearing a
     cached failed init if needed).
 
-    The default wait is an hour: the observed outage mode of the
-    tunneled backend is multi-HOUR, not a blip (rounds 2 and 3 both hit
-    it; the round-3 capture gave up after 755s against an outage that
-    outlasted it, and the round recorded value 0.0).  An hour of
-    patience costs nothing when the device is up (first probe succeeds
-    in seconds) and is the difference between a round with numbers and
-    a round without when it is flaky.
+    The default wait is 10 minutes - sized to fit INSIDE the driver's
+    observed external kill budget (~30 min: BENCH_r04.json rc 124 after
+    ~29 min).  Round 4 learned the hard way that bench.py does not
+    control its own lifetime: its hour-long acquire window was still
+    waiting when the driver killed it from outside, and no record was
+    printed.  Waiting out a multi-hour outage is the INTERACTIVE
+    runbook's job (``--acquire-wait 3600``); the default path's job is
+    to always leave a parseable record before anyone kills it.
+
+    ``on_fail(attempt, elapsed, last_info)`` is invoked after every
+    failed probe - main() uses it to refresh the provisional failure
+    record on stdout so even a SIGKILL mid-wait leaves the driver's
+    tail with a record.
     """
     t0 = time.monotonic()
     delay = 5.0
@@ -137,7 +157,12 @@ def acquire_backend(max_wait: float = 3600.0) -> None:
     attempt = 0
     while True:
         attempt += 1
-        ok, info = _probe_backend_once()
+        # Cap the probe timeout by the remaining budget: a 180s probe
+        # hang must not overshoot max_wait by minutes (the budget check
+        # below only accounts for the SLEEPS, not probe duration).
+        remaining = max_wait - (time.monotonic() - t0)
+        ok, info = _probe_backend_once(
+            timeout=min(180.0, max(15.0, remaining)))
         if ok:
             try:
                 import jax
@@ -158,6 +183,8 @@ def acquire_backend(max_wait: float = 3600.0) -> None:
         else:
             last_info = info
         elapsed = time.monotonic() - t0
+        if on_fail is not None:
+            on_fail(attempt, elapsed, last_info)
         if elapsed + delay > max_wait:
             raise _BackendLost(
                 f"device unreachable after {elapsed:.0f}s / {attempt} "
@@ -200,8 +227,15 @@ def _last_known_good() -> dict | None:
     trace of what the repo had already measured.  An outage round now
     degrades to provenance-marked stale numbers instead of to nothing.
     """
+    # Sort snapshots by their PARSED round number, newest first - a raw
+    # reverse-lexicographic sort would rank r99 above r100 once rounds
+    # reach three digits and point provenance at a stale round.
+    def _round_num(path: str) -> int:
+        m = re.search(r"_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
     candidates = [RESULTS_PATH] + sorted(
-        glob.glob("bench_results_r*.json"), reverse=True)
+        glob.glob("bench_results_r*.json"), key=_round_num, reverse=True)
     first_with_sections = None
     for path in candidates:
         try:
@@ -960,21 +994,62 @@ def _failure_record(kind: str, msg: str) -> dict:
     return rec
 
 
-def main(argv=None) -> int:
+def _emit_provisional(kind: str, msg: str) -> None:
+    """Print a provisional failure record to STDOUT and flush.
+
+    Round 4's lesson: bench.py does not control its own lifetime.  The
+    driver killed it from OUTSIDE (rc 124 ~29 min in) while it was still
+    inside its acquire loop, and because every record-emitting path was
+    an exit path of bench.py itself, nothing was printed and the round
+    recorded nothing.  The fix is to keep stdout's tail ALWAYS holding a
+    parseable record: one at startup, refreshed after every failed
+    probe.  Any later real result (or final failure record) is printed
+    after these, so a consumer that parses the LAST record line sees
+    provisional data only when the process was killed mid-wait - exactly
+    the case the provisional record exists for.  Descendant of the
+    reference's dead ``cpuSecond`` timer (``CUDACG.cu:35-39``): a timing
+    harness that never reports was the reference's bug, not a
+    capability.
+    """
+    rec = _failure_record(kind, msg)
+    rec["provisional"] = True
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    """Separate from main() so tests can assert the DRIVER-path defaults
+    (main always passes args.acquire_wait, so the function-signature
+    default alone guards nothing)."""
     ap = argparse.ArgumentParser()
     ap.add_argument("--all", action="store_true",
                     help="run every BASELINE config, write bench_results.json")
-    ap.add_argument("--acquire-wait", type=float, default=3600.0,
-                    help="max seconds to wait for the device backend "
-                         "(default 1h: the observed outage mode is "
-                         "multi-hour, not a blip)")
+    ap.add_argument("--acquire-wait", type=float,
+                    default=DEFAULT_ACQUIRE_WAIT,
+                    help="max seconds to wait for the device backend. "
+                         "The default (10 min) fits inside the driver's "
+                         "observed ~30-min external kill budget so "
+                         "bench.py's own failure paths always fire "
+                         "first; pass 3600 for interactive runs that "
+                         "should wait out a multi-hour outage")
+    ap.add_argument("--watchdog", type=float, default=None,
+                    help="override the SIGALRM watchdog budget in "
+                         "seconds (default: acquire-wait + 900 for the "
+                         "headline, 4*acquire-wait + 2700 for --all; "
+                         "re-acquire windows are clamped to the "
+                         "remaining budget so the alarm never fires "
+                         "mid-legitimate-wait)")
     ap.add_argument("--resume", action="store_true",
                     help="seed --all from an existing bench_results.json, "
                          "skipping sections already marked done (for "
                          "re-running after a tunnel outage; default is a "
                          "fresh sweep so one run never mixes results from "
                          "different code states)")
-    args = ap.parse_args(argv)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
     _WATCHDOG["mode"] = "all" if args.all else "headline"
 
     # Watchdog: the tunneled TPU backend can wedge at connect time or
@@ -982,30 +1057,82 @@ def main(argv=None) -> int:
     # section in flight - instead of hanging the harness forever.
     import signal
 
-    # Budget: every acquire window the run may legitimately enter - the
-    # initial acquire plus one re-acquire per mid-run backend loss (the
-    # --all path retries bench_all 3 times, so up to 4 waits total) -
-    # plus 45 min of measurement.  The watchdog must not fire while
-    # acquire_backend is still legitimately waiting out an outage: with
-    # the old fixed 2700s alarm, raising --acquire-wait past ~40 min
-    # would have turned every long wait into a watchdog kill.
-    watchdog_s = int(4 * args.acquire_wait + 2700)
+    # Budget: the headline path is one acquire window plus ~15 min of
+    # measurement (the measurement itself is ~2 min on-chip); --all may
+    # legitimately enter up to 4 acquire windows (initial + one
+    # re-acquire per mid-run backend loss, 3 retries) plus 45 min of
+    # measurement.  Round 4's formula scaled only UP (hour-long waits ->
+    # 4.75 h watchdog) and the driver's external ~30-min kill always won,
+    # so no record was printed; at the new defaults the headline watchdog
+    # is 25 min - it fires BEFORE the external kill and emits the record
+    # itself.
+    if args.watchdog is not None:
+        watchdog_s = int(args.watchdog)
+    elif args.all:
+        watchdog_s = int(4 * args.acquire_wait + 2700)
+    else:
+        watchdog_s = int(args.acquire_wait + 900)
 
-    def _timeout(signum, frame):
-        rec = _failure_record(
-            "watchdog_timeout",
-            f"bench watchdog: run exceeded {watchdog_s}s (device wedged "
-            f"or tunnel outage)")
+    def _signal_record(kind: str, msg: str) -> None:
+        # Leading newline: the signal may interrupt a provisional-record
+        # print() mid-line; without it this record would be concatenated
+        # onto the partial line and the tail would hold invalid JSON.
+        rec = _failure_record(kind, msg)
         rec["current_section"] = _WATCHDOG["current_section"]
-        print(json.dumps(rec))
+        print("\n" + json.dumps(rec))
         sys.stdout.flush()
         os._exit(1)
 
+    def _timeout(signum, frame):
+        _signal_record(
+            "watchdog_timeout",
+            f"bench watchdog: run exceeded {watchdog_s}s (device wedged "
+            f"or tunnel outage)")
+
+    def _terminated(signum, frame):
+        # The driver's `timeout` kill is SIGTERM (rc 124) - catch it and
+        # leave a final record instead of dying silently mid-wait.
+        _signal_record(
+            "terminated",
+            f"bench received signal {signum} (external kill, e.g. the "
+            f"driver's timeout) before completing")
+
     signal.signal(signal.SIGALRM, _timeout)
+    signal.signal(signal.SIGTERM, _terminated)
     signal.alarm(watchdog_s)
+    run_t0 = time.monotonic()
+
+    def _reacquire_wait() -> float:
+        # A mid-run re-acquire must finish (success or _BackendLost ->
+        # record -> exit 1) BEFORE the SIGALRM: clamp its window to the
+        # remaining watchdog budget minus a margin, else a recoverable
+        # run dies as a value-0.0 watchdog record mid-legitimate-wait.
+        # Floor of 15s (not more): when almost no budget remains the
+        # window must stay SHORT so acquire raises device_unreachable
+        # (probe timeouts are capped by the window) before the alarm -
+        # a 60s floor could outlive the remaining budget and die as a
+        # less-classified watchdog_timeout instead.
+        remaining = watchdog_s - (time.monotonic() - run_t0)
+        return max(15.0, min(args.acquire_wait, remaining - 180.0))
+
+    # Stdout's tail must hold a parseable record from the very first
+    # moment: a SIGKILL (which no handler can catch) at ANY later point
+    # then still leaves the driver a record with last_known_good
+    # provenance.  Refreshed after every failed probe below; superseded
+    # by the real result line when the run completes.
+    _emit_provisional(
+        "provisional_startup",
+        "run started; no measurement yet (this line is superseded by a "
+        "later record unless the process was killed externally)")
+
+    def _probe_failed(attempt, elapsed, last_info):
+        _emit_provisional(
+            "provisional_outage",
+            f"device unreachable so far: probe {attempt} failed after "
+            f"{elapsed:.0f}s; last error: {last_info[-200:]}")
 
     try:
-        acquire_backend(max_wait=args.acquire_wait)
+        acquire_backend(max_wait=args.acquire_wait, on_fail=_probe_failed)
     except _BackendLost as e:
         print(json.dumps(_failure_record("device_unreachable", str(e))))
         return 1
@@ -1041,7 +1168,8 @@ def main(argv=None) -> int:
                       f"{e}", file=sys.stderr)
                 last_loss = str(e)
                 try:
-                    acquire_backend(max_wait=args.acquire_wait)
+                    acquire_backend(max_wait=_reacquire_wait(),
+                                    on_fail=_probe_failed)
                 except _BackendLost as e2:
                     rec = _failure_record("device_unreachable", str(e2))
                     rec["partial_results"] = sorted(results.keys())
@@ -1081,7 +1209,8 @@ def main(argv=None) -> int:
                 return 1
             # one re-acquire + retry for a mid-run transient
             try:
-                acquire_backend(max_wait=args.acquire_wait)
+                acquire_backend(max_wait=_reacquire_wait(),
+                                on_fail=_probe_failed)
                 headline = bench_headline()
             except Exception as e2:
                 print(json.dumps(_failure_record(
