@@ -386,8 +386,51 @@ def bench_headline(device=None):
     }
 
 
-def bench_all(results) -> None:
+# The order --all RUNS sections in - most valuable first, so a short or
+# flaky hardware window lands the headline and the north-star verdicts
+# before any slow low-value row.  Round 4's lesson: the single most
+# important unmeasured row (northstar256, the >=1.8x streaming verdict)
+# sat 15th in source order behind the ~92 ms/iter CSR section and five
+# df64 sweeps; after three consecutive outage rounds, ordering is not a
+# nicety.  Sections are SKIP-IF-DONE, so --resume + this order always
+# extends coverage from the top.  A registered section missing from
+# this list runs after all listed ones (and a test flags it).
+SECTION_PRIORITY = [
+    HEADLINE_KEY,                          # the 148.5k headline row
+    "northstar256",                        # streaming >=1.8x verdict (3D)
+    "northstar256_df64",                   # df64 streaming at 256^3
+    "poisson2d_1M_stencil_resident_cg1",   # roofline A/B vs headline
+    "poisson2d_1M_stencil_whileloop",      # the general-solver baseline
+    "hbm16m",                              # 2D streaming + slab kernels
+    "precond512",                          # time-to-tol ladder
+    "poisson2d_1M_stencil_df64_resident",
+    "poisson2d_1M_stencil_df64",
+    "poisson2d_1M_stencil_df64_cg1",
+    "poisson2d_1M_shiftell",
+    "poisson2d_1M_shiftell_df64",
+    "poisson2d_1M_dia",
+    "dense_spd_1024",
+    "distributed",
+    "unstructured",
+    "poisson2d_1M_csr",                    # ~92 ms/iter gather: last
+]
+
+
+def _ordered_registry(registry):
+    """Sort ``(name, thunk)`` pairs by SECTION_PRIORITY (unknown names
+    after all listed ones, alphabetically for determinism)."""
+    order = {n: i for i, n in enumerate(SECTION_PRIORITY)}
+    return sorted(registry,
+                  key=lambda kv: (order.get(kv[0], len(SECTION_PRIORITY)),
+                                  kv[0]))
+
+
+def bench_all(results, sections=None) -> None:
     """All BASELINE configs -> ``results`` (flushed per section).
+
+    Sections run in SECTION_PRIORITY order (headline and north-star
+    verdicts first), optionally restricted to ``sections`` (an iterable
+    of section names; unknown names raise with the available list).
 
     Every timing row is an iteration-count delta (``iteration_delta``) or
     a repeated-solves-in-one-jit delta (``solve_delta``) unless it carries
@@ -427,6 +470,10 @@ def bench_all(results) -> None:
     # so each section must not depend on a previous one having run).
     shared = {}
 
+    # (name, thunk) pairs registered in SOURCE order, run in
+    # SECTION_PRIORITY order at the end of this function.
+    registry = []
+
     def get_csr_1m():
         if "a_csr" not in shared:
             shared["a_csr"] = poisson.poisson_2d_csr(
@@ -449,7 +496,7 @@ def bench_all(results) -> None:
         results["dense_spd_1024"] = iter_delta(op, b, 1000, 101000,
                                                repeats=3)
 
-    _run_section(results, "dense_spd_1024", s_dense)
+    registry.append(("dense_spd_1024", s_dense))
 
     # 2: sparse 2D Poisson N=1M (the headline, matrix-free) + assembled
     # formats.  DIA (gather-free shifted FMAs) is the TPU-native assembled
@@ -457,7 +504,7 @@ def bench_all(results) -> None:
     def s_headline():
         results[HEADLINE_KEY] = bench_headline()
 
-    _run_section(results, HEADLINE_KEY, s_headline)
+    registry.append((HEADLINE_KEY, s_headline))
 
     # The general lax.while_loop solver on the same problem: what the
     # headline measured before the VMEM-resident engine existed.  Kept as
@@ -469,7 +516,7 @@ def bench_all(results) -> None:
         results["poisson2d_1M_stencil_whileloop"] = iter_delta(
             op, rhs_1m(), 100, 10100, repeats=5)
 
-    _run_section(results, "poisson2d_1M_stencil_whileloop", s_whileloop)
+    registry.append(("poisson2d_1M_stencil_whileloop", s_whileloop))
 
     # The resident cg1 kernel on the headline problem: the roofline's
     # bottleneck-#2 experiment (BASELINE.md) - one evaluation point for
@@ -501,8 +548,8 @@ def bench_all(results) -> None:
         entry["engine"] = "resident_cg1"
         results["poisson2d_1M_stencil_resident_cg1"] = entry
 
-    _run_section(results, "poisson2d_1M_stencil_resident_cg1",
-                 s_resident_cg1)
+    registry.append(("poisson2d_1M_stencil_resident_cg1",
+                     s_resident_cg1))
 
     def s_csr():
         # keep this single call short: at ~83 ms/iter the XLA-gather kernel
@@ -516,7 +563,7 @@ def bench_all(results) -> None:
                                        "note": "~83ms/iter swamps the "
                                                "dispatch floor"}
 
-    _run_section(results, "poisson2d_1M_csr", s_csr)
+    registry.append(("poisson2d_1M_csr", s_csr))
 
     # deltas need >~1s of differential device work: smaller gaps drown
     # in the tunnel's +-0.1-0.2s per-dispatch jitter
@@ -524,13 +571,13 @@ def bench_all(results) -> None:
         results["poisson2d_1M_dia"] = iter_delta(
             get_csr_1m().to_dia(), rhs_1m(), 100, 4100, repeats=3)
 
-    _run_section(results, "poisson2d_1M_dia", s_dia)
+    registry.append(("poisson2d_1M_dia", s_dia))
 
     def s_shiftell():
         results["poisson2d_1M_shiftell"] = iter_delta(
             get_csr_1m().to_shiftell(), rhs_1m(), 100, 4100, repeats=3)
 
-    _run_section(results, "poisson2d_1M_shiftell", s_shiftell)
+    registry.append(("poisson2d_1M_shiftell", s_shiftell))
 
     # df64 (double-float) storage: ~f64-precision CG on f32 hardware
     # (solver.df64; the reference's CUDA_R_64F capability, which plain
@@ -556,7 +603,7 @@ def bench_all(results) -> None:
             "iters_per_sec": rate,
             "measurement": "iteration_delta"}
 
-    _run_section(results, "poisson2d_1M_stencil_df64", s_df64)
+    registry.append(("poisson2d_1M_stencil_df64", s_df64))
 
     # df64 single-reduction recurrence (method="cg1"): halves the
     # serialized reduction count per iteration - the df64 analogue of
@@ -581,7 +628,7 @@ def bench_all(results) -> None:
             "iters_per_sec": rate,
             "measurement": "iteration_delta"}
 
-    _run_section(results, "poisson2d_1M_stencil_df64_cg1", s_df64_cg1)
+    registry.append(("poisson2d_1M_stencil_df64_cg1", s_df64_cg1))
 
     # df64 x VMEM-resident: the reference's f64 precision in the
     # framework's single-kernel execution shape (solver.resident.
@@ -614,8 +661,8 @@ def bench_all(results) -> None:
             "iters_per_sec": rate,
             "measurement": "iteration_delta"}
 
-    _run_section(results, "poisson2d_1M_stencil_df64_resident",
-                 s_df64_resident)
+    registry.append(("poisson2d_1M_stencil_df64_resident",
+                     s_df64_resident))
 
     # df64 x shift-ELL: f64-class CG on the ASSEMBLED 1M-row matrix via
     # the pallas double-float lane-gather kernel - the reference's
@@ -638,7 +685,7 @@ def bench_all(results) -> None:
             "iters_per_sec": rate,
             "measurement": "iteration_delta"}
 
-    _run_section(results, "poisson2d_1M_shiftell_df64", s_df64_shiftell)
+    registry.append(("poisson2d_1M_shiftell_df64", s_df64_shiftell))
 
     # 3: preconditioned CG on 2D Poisson: time-to-tolerance across the
     # preconditioner ladder (the reference has none at all)
@@ -731,7 +778,7 @@ def bench_all(results) -> None:
                     "converged": bool(res.converged),
                     "measurement": "solve_delta"}
 
-    _run_section(results, "precond512", s_precond512)
+    registry.append(("precond512", s_precond512))
 
     # 3b: HBM-bound regime (4096^2 = 16.8M unknowns, ~4x VMEM): pallas
     # slab-DMA kernel vs XLA fused stencil, full CG iteration cost.
@@ -762,7 +809,7 @@ def bench_all(results) -> None:
             entry["engine"] = "streaming"
             results["poisson2d_16M_streaming"] = entry
 
-    _run_section(results, "hbm16m", s_hbm16m)
+    registry.append(("hbm16m", s_hbm16m))
 
     # 4: the north star - 3D Poisson 256^3 f32 on a single chip
     # (BASELINE config #4's problem; 16.8M unknowns, 67 MB/vector).
@@ -836,7 +883,7 @@ def bench_all(results) -> None:
                 "converged": bool(res.converged),
                 "measurement": "solve_delta"}
 
-    _run_section(results, "northstar256", s_northstar)
+    registry.append(("northstar256", s_northstar))
 
     # f64-class at the north-star scale: the df64 fused passes (16
     # plane-passes/iter vs the general df64 solver's ~32).  Its own
@@ -867,7 +914,7 @@ def bench_all(results) -> None:
             "engine": "streaming_df64",
             "measurement": "iteration_delta"}
 
-    _run_section(results, "northstar256_df64", s_northstar_df64)
+    registry.append(("northstar256_df64", s_northstar_df64))
 
     # 4b: distributed 3D Poisson over all local devices (N scaled to fit).
     # Iteration-delta through solve_distributed (the round-2 row ran a
@@ -915,7 +962,7 @@ def bench_all(results) -> None:
             entry["n_devices"] = ndev
             results[f"poisson3d_pencil_{sx}x{sy}"] = entry
 
-    _run_section(results, "distributed", s_dist)
+    registry.append(("distributed", s_dist))
 
     # 5: unstructured SPD set (BASELINE config #5).  Real SuiteSparse
     # .mtx files in ./matrices take precedence (zero-egress image: drop
@@ -976,7 +1023,19 @@ def bench_all(results) -> None:
             results["fem2d_1M_standin_ell"] = iter_delta(a_ell, b_f, 4, 12,
                                                          repeats=2)
 
-    _run_section(results, "unstructured", s_unstructured)
+    registry.append(("unstructured", s_unstructured))
+
+    known = {name for name, _ in registry}
+    if sections:
+        unknown = set(sections) - known
+        if unknown:
+            raise ValueError(
+                f"unknown sections: {sorted(unknown)}; "
+                f"available: {sorted(known)}")
+    for name, thunk in _ordered_registry(registry):
+        if sections and name not in sections:
+            continue
+        _run_section(results, name, thunk)
 
 
 def _failure_record(kind: str, msg: str) -> dict:
@@ -1039,6 +1098,12 @@ def _build_parser() -> argparse.ArgumentParser:
                          "re-acquire windows are clamped to the "
                          "remaining budget so the alarm never fires "
                          "mid-legitimate-wait)")
+    ap.add_argument("--sections", type=str, default=None,
+                    help="comma-separated section names to run (implies "
+                         "--all); e.g. --sections "
+                         f"{HEADLINE_KEY},northstar256 to land the "
+                         "headline and the streaming verdict first in a "
+                         "short hardware window")
     ap.add_argument("--resume", action="store_true",
                     help="seed --all from an existing bench_results.json, "
                          "skipping sections already marked done (for "
@@ -1050,6 +1115,23 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
+    sections = None
+    if args.sections:
+        sections = {s.strip() for s in args.sections.split(",") if s.strip()}
+        if not sections:
+            # an all-separator value must not silently promote to the
+            # FULL sweep - the opposite of what the flag is for
+            print("error: --sections parsed to an empty set",
+                  file=sys.stderr)
+            return 2
+        args.all = True  # --sections is a restricted --all sweep
+        # Fail fast on a typo - BEFORE the acquire window, not 10 min
+        # into it.  SECTION_PRIORITY == the registry (test-enforced).
+        unknown = sections - set(SECTION_PRIORITY)
+        if unknown:
+            print(f"error: unknown sections {sorted(unknown)}; available: "
+                  f"{SECTION_PRIORITY}", file=sys.stderr)
+            return 2
     _WATCHDOG["mode"] = "all" if args.all else "headline"
 
     # Watchdog: the tunneled TPU backend can wedge at connect time or
@@ -1157,10 +1239,11 @@ def main(argv=None) -> int:
                 print(f"# --resume: could not load {RESULTS_PATH}: {e}; "
                       f"starting fresh", file=sys.stderr)
         results["__meta__"] = {"git_rev": _git_rev(), "utc": _utc_now()}
+        seeded_done = {k for k in results if k.endswith("__done")}
         completed = False
         for attempt in range(3):
             try:
-                bench_all(results)
+                bench_all(results, sections=sections)
                 completed = True
                 break
             except _BackendLost as e:
@@ -1191,6 +1274,26 @@ def main(argv=None) -> int:
             print(json.dumps(rec))
             return 1
         headline = results.get(HEADLINE_KEY)
+        if headline is None and sections and HEADLINE_KEY not in sections:
+            # A deliberately restricted sweep that excludes the headline
+            # is not a failure: report what ran, with last-known-good
+            # provenance.  metric/value must NOT mimic a fresh headline
+            # measurement - a consumer keying on rc 0 + value would
+            # record 0.0 for a run that succeeded.
+            rec = _failure_record(
+                "headline_not_in_sections",
+                f"restricted --sections sweep completed without the "
+                f"headline section ({sorted(sections)})")
+            rec["metric"] = "restricted_sweep_no_headline"
+            rec["value"] = None
+            rec["vs_baseline"] = None
+            # only the sections THIS run executed (a --resume seed's
+            # __done markers are prior provenance, not this run's)
+            rec["sections_run"] = sorted(
+                k[:-len("__done")] for k in results
+                if k.endswith("__done") and k not in seeded_done)
+            print(json.dumps(rec))
+            return 0
         if headline is None:
             err = results.get(f"{HEADLINE_KEY}__error", {})
             rec = _failure_record(

@@ -235,3 +235,45 @@ class TestExternalKillRehearsal:
         rec = self._last_record(out)
         assert rec["error_kind"] == "terminated"
         assert rec["last_known_good"]["headline_value"] == 148519.5
+
+
+class TestSectionPriority:
+    """Round-4 weak #6: a short hardware window must land the headline
+    and north-star rows before any slow low-value section."""
+
+    def _collect_order(self, monkeypatch, sections=None):
+        ran = []
+        monkeypatch.setattr(
+            bench, "_run_section",
+            lambda results, name, thunk: ran.append(name))
+        bench.bench_all({}, sections=sections)
+        return ran
+
+    def test_all_registered_sections_are_prioritized(self, monkeypatch):
+        ran = self._collect_order(monkeypatch)
+        assert set(ran) == set(bench.SECTION_PRIORITY), (
+            "every registered section must appear in SECTION_PRIORITY "
+            "(new sections need an explicit priority slot)")
+
+    def test_headline_then_northstars_first_csr_last(self, monkeypatch):
+        ran = self._collect_order(monkeypatch)
+        assert ran[0] == bench.HEADLINE_KEY
+        assert ran[1] == "northstar256"
+        assert ran[2] == "northstar256_df64"
+        assert ran[3] == "poisson2d_1M_stencil_resident_cg1"
+        assert ran[-1] == "poisson2d_1M_csr"
+
+    def test_sections_filter(self, monkeypatch):
+        ran = self._collect_order(
+            monkeypatch, sections={"northstar256", bench.HEADLINE_KEY})
+        assert ran == [bench.HEADLINE_KEY, "northstar256"]
+
+    def test_unknown_section_raises_with_available(self, monkeypatch):
+        with pytest.raises(ValueError, match="unknown sections"):
+            self._collect_order(monkeypatch, sections={"nope"})
+
+    def test_cli_sections_implies_all(self):
+        args = bench._build_parser().parse_args(
+            ["--sections", "northstar256"])
+        assert args.sections == "northstar256"
+        assert not args.all  # main() promotes it; parser leaves it
