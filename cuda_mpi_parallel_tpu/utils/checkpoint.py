@@ -24,7 +24,18 @@ import numpy as np
 
 from ..solver.cg import CGCheckpoint, CGResult, solve
 
-_FORMAT_VERSION = 1
+# Bumped 1 -> 2 when the fingerprint scheme changed to cover operator
+# coefficients (round-4 advice): a version-1 checkpoint's fingerprint is
+# not comparable, so loading it must fail with the accurate "format
+# version" error rather than a spurious "different problem".
+_FORMAT_VERSION = 2
+
+# Operator dataclass fields EXCLUDED from problem identity:
+#   backend - selects a kernel (xla vs pallas), not a linear system; the
+#             same checkpoint must resume under either.
+#   rows    - derived from indptr at construction (CSRMatrix.from_arrays);
+#             hashing it adds bytes, never identity.
+_FP_EXCLUDE_FIELDS = frozenset({"backend", "rows"})
 
 
 def problem_fingerprint(a, b) -> str:
@@ -33,13 +44,43 @@ def problem_fingerprint(a, b) -> str:
     On resume the recurrence never re-reads b (r comes from the state), so
     resuming against the wrong problem would silently 'converge' to the old
     system's solution - the fingerprint turns that into a loud error.
+
+    The operator contributes its FULL mathematical identity, not just
+    type and shape (round-4 advice: two same-type/same-shape operators
+    with different coefficients - a rescaled stencil, a CSR matrix with
+    different values - must not collide).  The scheme is explicit and
+    stable: array-valued dataclass fields hash by name/dtype/shape/bytes
+    and static fields by repr, in sorted field order - never via
+    ``str(treedef)``, whose formatting is a JAX internal that can change
+    across releases.  Execution-strategy fields (``_FP_EXCLUDE_FIELDS``)
+    are excluded: the same system must resume whichever kernel computes
+    it.
     """
+    import dataclasses
     import hashlib
 
     h = hashlib.sha256()
     h.update(np.ascontiguousarray(np.asarray(b)).tobytes())
-    ident = f"{type(a).__name__}:{a.shape}"
-    h.update(ident.encode())
+    h.update(f"fpv2:{type(a).__name__}:{a.shape};".encode())
+    if dataclasses.is_dataclass(a):
+        fields = sorted(dataclasses.fields(a), key=lambda f: f.name)
+        for f in fields:
+            if f.name in _FP_EXCLUDE_FIELDS:
+                continue
+            v = getattr(a, f.name)
+            if isinstance(v, (jnp.ndarray, np.ndarray)):
+                arr = np.asarray(v)
+                h.update(f"{f.name}:{arr.dtype}:{arr.shape}:".encode())
+                h.update(np.ascontiguousarray(arr).tobytes())
+            else:
+                h.update(f"{f.name}={v!r};".encode())
+    else:  # non-dataclass operator: hash its pytree leaves
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(a):
+            arr = np.asarray(leaf)
+            h.update(f"{arr.dtype}:{arr.shape}:".encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
     return h.hexdigest()[:16]
 
 

@@ -621,3 +621,24 @@ class TestDistributedDF64Streaming:
         with pytest.raises(ValueError, match="divide"):
             solve_distributed_streaming_df64(
                 op, np.ones(18 * 128), mesh=make_mesh(4))
+
+
+class TestDefaultCheckEvery:
+    """Round-4 advice (low): cg_streaming's default check_every must
+    match solve()'s (1) so direct callers at defaults get the exact
+    iteration counts the docstring promises."""
+
+    def test_default_is_one(self):
+        import inspect
+
+        sig = inspect.signature(cg_streaming)
+        assert sig.parameters["check_every"].default == 1
+
+    def test_default_counts_match_solve_defaults(self):
+        op = Stencil2D.create(16, 128, dtype=jnp.float32)
+        rng = np.random.default_rng(3)
+        b = jnp.asarray(
+            rng.standard_normal(op.shape[0]).astype(np.float32))
+        ref = solve(op, b, tol=1e-4, maxiter=300)
+        res = cg_streaming(op, b, tol=1e-4, maxiter=300, interpret=True)
+        assert int(res.iterations) == int(ref.iterations)
