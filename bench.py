@@ -1181,7 +1181,8 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGALRM, _timeout)
     signal.signal(signal.SIGTERM, _terminated)
-    signal.alarm(watchdog_s)
+    if watchdog_s > 0:  # --watchdog 0 disables the alarm entirely
+        signal.alarm(watchdog_s)
     run_t0 = time.monotonic()
 
     def _reacquire_wait() -> float:
@@ -1194,6 +1195,8 @@ def main(argv=None) -> int:
         # (probe timeouts are capped by the window) before the alarm -
         # a 60s floor could outlive the remaining budget and die as a
         # less-classified watchdog_timeout instead.
+        if watchdog_s <= 0:  # no alarm -> nothing to clamp against
+            return args.acquire_wait
         remaining = watchdog_s - (time.monotonic() - run_t0)
         return max(15.0, min(args.acquire_wait, remaining - 180.0))
 

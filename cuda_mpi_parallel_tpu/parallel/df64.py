@@ -229,8 +229,11 @@ def solve_distributed_df64(
         ``method="cg"`` only).
       method: ``"cg"`` (textbook: two psums/iteration), ``"cg1"``
         (inner products fused into ONE psum - half the collective
-        latency) or ``"pipecg"`` (that psum overlaps the halo-exchanged
-        matvec).
+        latency), ``"pipecg"`` (that psum overlaps the halo-exchanged
+        matvec) or ``"minres"`` (the principled solver for symmetric
+        INDEFINITE systems, quirk Q1 - ``solver.minres.minres_df64``
+        with its df64 dots psum-ed over the mesh; unpreconditioned,
+        slab stencils only).
       (mesh/n_devices/tol/rtol/maxiter/record_history/check_every as in
       ``solve_distributed`` / ``cg_df64``.)
 
@@ -253,9 +256,25 @@ def solve_distributed_df64(
             "preconditioner='mg' needs a matrix-free stencil operator "
             "(the geometric hierarchy rediscretizes the grid); assembled "
             "CSR supports jacobi or chebyshev")
-    if method not in ("cg", "cg1", "pipecg"):
+    if method not in ("cg", "cg1", "pipecg", "minres"):
         raise ValueError(f"unknown method {method!r}; expected 'cg', "
-                         f"'cg1' or 'pipecg'")
+                         f"'cg1', 'pipecg' or 'minres'")
+    if method == "minres":
+        # the principled solver for symmetric-INDEFINITE systems (quirk
+        # Q1) in the distributed df64 tier; unpreconditioned, matrix-free
+        # slab stencils only (mirrors solver.df64's minres gating)
+        if preconditioner is not None:
+            raise ValueError(
+                "method='minres' is unpreconditioned in df64 "
+                "(preconditioned MINRES needs an SPD M; use method='cg')")
+        if not isinstance(a, (Stencil2D, Stencil3D)):
+            raise TypeError(
+                "distributed df64 minres supports matrix-free Stencil2D/"
+                f"Stencil3D slabs, got {type(a).__name__}")
+        if len(mesh.axis_names) == 2:
+            raise ValueError(
+                "distributed df64 minres supports 1-D (slab) meshes; "
+                "pencil decomposition is cg-family only")
     if not isinstance(a, (CSRMatrix, Stencil2D, Stencil3D)):
         raise TypeError(
             f"solve_distributed_df64 supports matrix-free Stencil2D/"
@@ -322,7 +341,10 @@ def solve_distributed_df64(
         residual_history=P() if record_history else None,
         checkpoint=None)
     key = (local.local_grid, local.kind, axis, mesh, jacobi, cheb,
-           mg_flag, record_history, maxiter, check_every, method)
+           mg_flag, record_history, maxiter, check_every, method,
+           # minres bakes tol/rtol into its trace as df consts (the cg
+           # family takes them traced, so they stay out of the key)
+           (float(tol), float(rtol)) if method == "minres" else None)
 
     def build():
         @partial(jax.shard_map, mesh=mesh,
@@ -337,6 +359,13 @@ def solve_distributed_df64(
 
                 mg_op = MultigridPreconditioner.from_operator(
                     dataclasses.replace(local32, scale=sh))
+            if method == "minres":
+                from ..solver.minres import minres_df64
+
+                return minres_df64(
+                    loc, (bh_l, bl_l), tol=tol, rtol=rtol,
+                    maxiter=maxiter, record_history=record_history,
+                    axis_name=axis, check_every=check_every)
             if method != "cg":
                 return _VARIANTS[method](
                     loc, (bh_l, bl_l), (t2h, t2l), (r2h, r2l),

@@ -209,10 +209,22 @@ def _prepare_operator(a, jacobi: bool = False):
 
 
 def _coerce_rhs_df(b) -> df.DF:
-    """Right-hand side -> df64 pair: host float64 splits at full
-    precision, x64-mode device arrays split via the host, anything else
-    lifts from f32 with zero low words.  Shared by every df64 solver
-    entry (cg_df64, minres_df64) so the precision rules cannot drift."""
+    """Right-hand side -> df64 pair: an already-split (hi, lo) pair of
+    equal-shape f32 vectors passes through (the distributed tier
+    pre-splits on host and calls solver entries inside shard_map), host
+    float64 splits at full precision, x64-mode device arrays split via
+    the host, anything else lifts from f32 with zero low words.  Shared
+    by every df64 solver entry (cg_df64, minres_df64) so the precision
+    rules cannot drift.  The pair rule is deliberately strict - f32
+    dtype, matching non-scalar shapes - so a plain 2-element numeric
+    tuple like ``(1.0, 2.0)`` still coerces as a length-2 VECTOR, not a
+    scalar hi/lo pair."""
+    if (isinstance(b, tuple) and len(b) == 2
+            and all(isinstance(v, (np.ndarray, jnp.ndarray)) for v in b)):
+        hi, lo = (jnp.asarray(v) for v in b)
+        if (hi.dtype == jnp.float32 and lo.dtype == jnp.float32
+                and hi.shape == lo.shape and hi.ndim >= 1):
+            return (hi, lo)
     if isinstance(b, np.ndarray) and b.dtype == np.float64:
         bh, bl = df.split_f64(b)
         return (jnp.asarray(bh), jnp.asarray(bl))

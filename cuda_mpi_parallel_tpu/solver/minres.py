@@ -218,7 +218,10 @@ def minres_df64(
 
     if check_every < 1:
         raise ValueError(f"check_every must be >= 1, got {check_every}")
-    op = _prepare_operator(a)
+    # An operator already exposing matvec_df (ShiftELLDF64Matrix, or a
+    # mesh-local DistStencilDF64 inside shard_map) is used directly -
+    # _prepare_operator handles the host types that need lifting.
+    op = a if hasattr(a, "matvec_df") else _prepare_operator(a)
     mv = op.matvec_df if hasattr(op, "matvec_df") else op.matvec
     b_df = _coerce_rhs_df(b)
 

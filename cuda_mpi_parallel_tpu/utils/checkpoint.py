@@ -74,11 +74,18 @@ def problem_fingerprint(a, b) -> str:
                 h.update(np.ascontiguousarray(arr).tobytes())
             else:
                 h.update(f"{f.name}={v!r};".encode())
-    else:  # non-dataclass operator: hash its pytree leaves
+    else:  # non-dataclass operator: hash its numeric pytree leaves
         import jax
 
         for leaf in jax.tree_util.tree_leaves(a):
             arr = np.asarray(leaf)
+            if arr.dtype == object:
+                # an unregistered custom operator flattens to itself;
+                # np.asarray would yield raw pointer bytes - different
+                # every process, which would spuriously reject every
+                # post-restart resume.  Skip: identity degrades to
+                # type+shape+rhs (the v1 semantics) for such operators.
+                continue
             h.update(f"{arr.dtype}:{arr.shape}:".encode())
             h.update(np.ascontiguousarray(arr).tobytes())
     return h.hexdigest()[:16]
