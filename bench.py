@@ -325,6 +325,33 @@ def _run_section(results, name: str, thunk) -> None:
         _WATCHDOG["current_section"] = None
 
 
+def _device_df64_pairs(b_np64, k: int):
+    """``k`` device-resident df64 ``(hi, lo)`` rhs pairs from scaled
+    variants of a host f64 vector.
+
+    The df64 sections must not pay a per-call host->device rhs transfer:
+    on the tunneled chip that costs seconds of jitter per call and can
+    swallow the iteration delta entirely (round 5 measured the 256^3
+    df64 row at a nonsense 2.6e11 iters/s from exactly this).  Splitting
+    on host keeps full f64 precision; ``block_until_ready`` ensures the
+    transfers complete before timing starts.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from cuda_mpi_parallel_tpu.ops import df64 as df
+
+    pairs = []
+    for i in range(k):
+        bh, bl = df.split_f64(b_np64 * (1.0 + i * 1e-4))
+        pairs.append((jax.device_put(jnp.asarray(bh)),
+                      jax.device_put(jnp.asarray(bl))))
+    for bh, bl in pairs:
+        bh.block_until_ready()
+        bl.block_until_ready()
+    return pairs
+
+
 def bench_headline(device=None):
     import jax
     import jax.numpy as jnp
@@ -648,10 +675,17 @@ def bench_all(results, sections=None) -> None:
             return
         rng = np.random.default_rng(0)
         b_np64 = rng.standard_normal(n * n)
-        ctr = count(1)
+        # Pre-split rhs variants to DEVICE-resident (hi, lo) pairs: the
+        # per-call host->device transfer of an 8 MB f64 rhs rides the
+        # tunnel (~seconds of jitter), and round 5 measured it drowning
+        # the iteration delta.  Distinct variants keep the distinct-rhs
+        # hygiene of the other sections without per-call transfers.
+        pairs_dev = _device_df64_pairs(b_np64, 8)
+        ctr = count(0)
 
         def run_df(it):
-            return cg_resident_df64(op_df, b_np64 * (1.0 + next(ctr) * 1e-4),
+            return cg_resident_df64(op_df,
+                                    pairs_dev[next(ctr) % len(pairs_dev)],
                                     tol=0.0, maxiter=it,
                                     check_every=32).x_hi
 
@@ -900,14 +934,20 @@ def bench_all(results, sections=None) -> None:
         a256d = Stencil3D.create(256, 256, 256, dtype=jnp.float32)
         rng64 = np.random.default_rng(9)
         b64 = rng64.standard_normal(a256d.shape[0])
-        ctr64 = count(1)
+        # Round-5 lesson: per-call coercion shipped a 134 MB f64 rhs over
+        # the tunnel every call (~5 s), and the 256-iteration delta
+        # drowned in that jitter (the r05 sweep's first pass recorded a
+        # nonsense 2.6e11 iters/s from a <=0 median delta).  Pre-split
+        # device-resident pairs + a ~1k-iteration spread fix both.
+        pairs_dev = _device_df64_pairs(b64, 4)
+        ctr64 = count(0)
 
         def run_df(it):
             return cg_streaming_df64(
-                a256d, b64 * (1.0 + next(ctr64) * 1e-4), tol=0.0,
+                a256d, pairs_dev[next(ctr64) % len(pairs_dev)], tol=0.0,
                 maxiter=it, check_every=32).x_hi
 
-        rate = paired_delta_rate(run_df, 16, 272, pairs=3)
+        rate = paired_delta_rate(run_df, 16, 1040, pairs=3)
         results["poisson3d_256_streaming_df64"] = {
             "us_per_iter": 1e6 / rate,
             "iters_per_sec": rate,

@@ -172,10 +172,15 @@ def _pass_a_kernel(params_ref, *refs, bm, nx, ndim, has_halo):
 
     scale = params_ref[0]
     beta = params_ref[1]
+    theta = params_ref[2]
     # The deferred p-update: p_new on the FULL halo slab (elementwise, so
     # the halo rows come straight from r/p's halos - no cross-slab
-    # dependency on p_new values this pass writes).
-    pnew_slab = rslabs[i % 2] + beta * pslabs[i % 2]
+    # dependency on p_new values this pass writes).  The v-input is
+    # divided by theta IN-SLAB: 1.0 for the unpreconditioned path (x/1.0
+    # is exact, so the trajectory is untouched) or the Chebyshev interval
+    # center for the degree-1 polynomial (z = r/theta fused into the
+    # p-update - the whole degree-1 preconditioner costs zero passes).
+    pnew_slab = rslabs[i % 2] / theta + beta * pslabs[i % 2]
     if ndim == 2:
         ap = _stencil_slab_2d(pnew_slab, scale, bm)
     else:
@@ -192,13 +197,17 @@ def _pass_a_kernel(params_ref, *refs, bm, nx, ndim, has_halo):
 # -- pass B: x += alpha p; r -= alpha Ap; rr = r.r ----------------------------
 
 
-def _pass_b_kernel(alpha_ref, *refs, bm, nx, ndim, has_halo):
+def _pass_b_kernel(alpha_ref, *refs, bm, nx, ndim, has_halo, with_rz):
     if has_halo:
         (pn_lo, pn_hi, pnew_hbm, x_ref, r_ref,
-         xout_ref, rout_ref, rr_ref, pslabs, sems, acc) = refs
+         xout_ref, rout_ref, rr_ref, *rest) = refs
     else:
         (pnew_hbm, x_ref, r_ref,
-         xout_ref, rout_ref, rr_ref, pslabs, sems, acc) = refs
+         xout_ref, rout_ref, rr_ref, *rest) = refs
+    if with_rz:
+        rz_ref, pslabs, sems, acc = rest
+    else:
+        pslabs, sems, acc = rest
     i = pl.program_id(0)
     n = pl.num_programs(0)
     copy, wait = (_slab_copy, _slab_wait) if ndim == 2 else (
@@ -207,6 +216,8 @@ def _pass_b_kernel(alpha_ref, *refs, bm, nx, ndim, has_halo):
     @pl.when(i == 0)
     def _():
         acc[0] = jnp.float32(0.0)
+        if with_rz:
+            acc[1] = jnp.float32(0.0)
         copy(pnew_hbm, pslabs.at[0], sems.at[0], 0, bm, nx)
 
     @pl.when(i + 1 < n)
@@ -230,10 +241,18 @@ def _pass_b_kernel(alpha_ref, *refs, bm, nx, ndim, has_halo):
     r_new = r_ref[:] - alpha * ap                   # CUDACG.cu:320-321
     rout_ref[:] = r_new
     acc[0] += jnp.sum(r_new * r_new)                # CUDACG.cu:328
+    if with_rz:
+        # degree-1 Chebyshev rho = r . (r/theta), elementwise like the
+        # general solver's dot(r, m @ r) - NOT rr/theta, whose single
+        # scalar division rounds differently
+        theta = alpha_ref[2]
+        acc[1] += jnp.sum(r_new * (r_new / theta))
 
     @pl.when(i == n - 1)
     def _():
         rr_ref[0] = acc[0]
+        if with_rz:
+            rz_ref[0] = acc[1]
 
 
 def _slab_shape(bm, grid_shape):
@@ -244,12 +263,20 @@ def _slab_shape(bm, grid_shape):
 
 @functools.partial(jax.jit, static_argnames=("bm", "interpret"))
 def fused_cg_pass_a(scale, beta, r, p, halos=None, *, bm: int,
-                    interpret: bool = False):
-    """One streamed pass: ``p_new = r + beta * p``; ``pap = p_new . A p_new``.
+                    interpret: bool = False, theta=None):
+    """One streamed pass: ``p_new = r/theta + beta * p``;
+    ``pap = p_new . A p_new``.
 
     ``r``/``p``: full grids ((nx, ny) or (nx, ny, nz)) in HBM; returns
-    ``(p_new, pap)``.  ``beta``/``scale`` ride in SMEM so sweeps reuse
-    the executable.
+    ``(p_new, pap)``.  ``beta``/``scale``/``theta`` ride in SMEM so
+    sweeps reuse the executable.
+
+    ``theta``: optional traced divisor for the r-term (default 1.0 -
+    exact, leaves the unpreconditioned trajectory bit-identical).  The
+    degree-1 Chebyshev preconditioner is ``z = r/theta``; folding the
+    division here makes that polynomial cost zero extra passes.  For
+    degree >= 2 the caller passes the cheb output ``z`` as ``r`` and
+    leaves ``theta`` at 1.
 
     ``halos``: optional ``(r_lo, r_hi, p_lo, p_hi)`` neighbor boundary
     rows/planes (each ``(1,) + shape[1:]``) for the distributed
@@ -261,7 +288,9 @@ def fused_cg_pass_a(scale, beta, r, p, halos=None, *, bm: int,
     nx = shape[0]
     has_halo = halos is not None
     params = jnp.stack([jnp.asarray(scale, jnp.float32),
-                        jnp.asarray(beta, jnp.float32)])
+                        jnp.asarray(beta, jnp.float32),
+                        jnp.asarray(1.0 if theta is None else theta,
+                                    jnp.float32)])
     kernel = functools.partial(_pass_a_kernel, bm=bm, nx=nx, ndim=ndim,
                                has_halo=has_halo)
     block = (bm,) + shape[1:]
@@ -298,32 +327,43 @@ def fused_cg_pass_a(scale, beta, r, p, halos=None, *, bm: int,
     return pnew, pap[0]
 
 
-@functools.partial(jax.jit, static_argnames=("bm", "interpret"))
+@functools.partial(jax.jit, static_argnames=("bm", "interpret", "with_rz"))
 def fused_cg_pass_b(scale, alpha, pnew, x, r, halos=None, *, bm: int,
-                    interpret: bool = False):
+                    interpret: bool = False, theta=None,
+                    with_rz: bool = False):
     """One streamed pass: ``x += alpha p``, ``r -= alpha A p``,
     ``rr = r . r`` - with ``A p`` recomputed from ``p_new``'s halo slabs
     rather than read back from HBM.  Returns ``(x_new, r_new, rr)``;
     the x/r inputs are donated to their outputs (in-place update).
 
+    ``with_rz=True`` additionally accumulates
+    ``rz = r_new . (r_new / theta)`` - the degree-1 Chebyshev
+    ``rho = r . M^-1 r`` fused into the pass for free (the r_new values
+    are already in registers) - and returns ``(x_new, r_new, rr, rz)``.
+
     ``halos``: optional ``(pn_lo, pn_hi)`` neighbor boundary rows/planes
-    of ``p_new`` for the distributed row-partition; ``rr`` is then the
-    local partial the caller psums.
+    of ``p_new`` for the distributed row-partition; ``rr`` (and ``rz``)
+    are then the local partials the caller psums.
     """
     shape = x.shape
     ndim = x.ndim
     nx = shape[0]
     has_halo = halos is not None
     params = jnp.stack([jnp.asarray(scale, jnp.float32),
-                        jnp.asarray(alpha, jnp.float32)])
+                        jnp.asarray(alpha, jnp.float32),
+                        jnp.asarray(1.0 if theta is None else theta,
+                                    jnp.float32)])
     kernel = functools.partial(_pass_b_kernel, bm=bm, nx=nx, ndim=ndim,
-                               has_halo=has_halo)
+                               has_halo=has_halo, with_rz=with_rz)
     block = (bm,) + shape[1:]
     index_map = (lambda i: (i, 0)) if ndim == 2 else (lambda i: (i, 0, 0))
     vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
     halo_inputs = tuple(halos) if has_halo else ()
     nh = len(halo_inputs)
-    x_new, r_new, rr = pl.pallas_call(
+    rz_outs = ([pl.BlockSpec(memory_space=pltpu.SMEM)] if with_rz else [])
+    rz_shapes = ([jax.ShapeDtypeStruct((1,), jnp.float32)] if with_rz
+                 else [])
+    out = pl.pallas_call(
         kernel,
         grid=(nx // bm,),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
@@ -337,16 +377,16 @@ def fused_cg_pass_b(scale, alpha, pnew, x, r, halos=None, *, bm: int,
             pl.BlockSpec(block, index_map),         # x out
             pl.BlockSpec(block, index_map),         # r out
             pl.BlockSpec(memory_space=pltpu.SMEM),  # rr
-        ],
+        ] + rz_outs,
         out_shape=[
             jax.ShapeDtypeStruct(shape, jnp.float32),
             jax.ShapeDtypeStruct(shape, jnp.float32),
             jax.ShapeDtypeStruct((1,), jnp.float32),
-        ],
+        ] + rz_shapes,
         scratch_shapes=[
             pltpu.VMEM((2,) + _slab_shape(bm, shape), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SMEM((1,), jnp.float32),
+            pltpu.SMEM((2,), jnp.float32),
         ],
         # x and r update in place: same-index blocked specs, elementwise
         # math - the pipelined fetch of block i+1 never overlaps the
@@ -356,7 +396,150 @@ def fused_cg_pass_b(scale, alpha, pnew, x, r, halos=None, *, bm: int,
             vmem_limit_bytes=_VMEM_BUDGET),
         interpret=interpret,
     )(params, *halo_inputs, pnew, x, r)
+    if with_rz:
+        x_new, r_new, rr, rz = out
+        return x_new, r_new, rr[0], rz[0]
+    x_new, r_new, rr = out
     return x_new, r_new, rr[0]
+
+
+# -- fused Chebyshev step (streamed polynomial preconditioning) ---------------
+#
+# One step of the three-term Chebyshev semi-iteration
+# (models.precond.ChebyshevPreconditioner.matvec, Saad Alg. 12.1) as a
+# single slab-streamed launch:
+#
+#     d_new = c1 * d + c2 * (r - A z)        (c1 = rho_new*rho,
+#     z_new = z + d_new                       c2 = 2*rho_new/delta)
+#
+# The matvec's operand z streams through manual halo-slab DMA (the
+# pass-A pattern); r and d are elementwise and ride the pipelined
+# blocked specs.  ``first=True`` fuses the polynomial's init
+# (d0 = z0 = r/theta) into the step: the ONLY halo-DMA'd input is then
+# r itself, and z0 is formed in-slab - 3 plane-passes instead of 5.
+# ``last=True`` accumulates ``rho = r . z_new`` into SMEM across the
+# grid, so the PCG reduction costs no extra pass.  A degree-k
+# application is (k-1) launches: first -> middle* -> last (a degree-2
+# application is one first+last launch); degree 1 never reaches these
+# kernels (z = r/theta folds into pass A/B via their theta params).
+
+
+def _cheb_step_kernel(params_ref, *refs, bm, nx, ndim, first, last):
+    if first:
+        (v_hbm, zout_ref, dout_ref, *rest) = refs
+    else:
+        (v_hbm, r_ref, d_ref, zout_ref, dout_ref, *rest) = refs
+    if last:
+        rz_ref, slabs, sems, acc = rest
+    else:
+        slabs, sems, acc = rest
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    copy, wait = (_slab_copy, _slab_wait) if ndim == 2 else (
+        _slab_copy3d, _slab_wait3d)
+
+    @pl.when(i == 0)
+    def _():
+        if last:
+            acc[0] = jnp.float32(0.0)
+        copy(v_hbm, slabs.at[0], sems.at[0], 0, bm, nx)
+
+    @pl.when(i + 1 < n)
+    def _():
+        copy(v_hbm, slabs.at[(i + 1) % 2], sems.at[(i + 1) % 2],
+             i + 1, bm, nx)
+
+    wait(v_hbm, slabs.at[i % 2], sems.at[i % 2], i, bm, nx)
+
+    scale = params_ref[0]
+    theta = params_ref[1]
+    c1 = params_ref[2]
+    c2 = params_ref[3]
+    if first:
+        # v is r: z0 = r/theta formed on the FULL halo slab (elementwise,
+        # so z0's halo rows are exactly the neighboring z0 values) and
+        # d0 = z0 - the polynomial's init fused into its first step.
+        r_slab = slabs[i % 2]
+        z_slab = r_slab / theta
+        r_int = _interior(r_slab, bm, ndim)
+        d_int = _interior(z_slab, bm, ndim)
+    else:
+        z_slab = slabs[i % 2]
+        r_int = r_ref[:]
+        d_int = d_ref[:]
+    if ndim == 2:
+        az = _stencil_slab_2d(z_slab, scale, bm)
+    else:
+        az = _stencil_slab_3d(z_slab, scale)
+    z_int = _interior(z_slab, bm, ndim)
+    d_new = c1 * d_int + c2 * (r_int - az)
+    z_new = z_int + d_new
+    zout_ref[:] = z_new
+    dout_ref[:] = d_new
+    if last:
+        acc[0] += jnp.sum(r_int * z_new)
+
+        @pl.when(i == n - 1)
+        def _():
+            rz_ref[0] = acc[0]
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "first", "last",
+                                             "interpret"))
+def fused_cheb_step(scale, theta, c1, c2, v, r=None, d=None, *, bm: int,
+                    first: bool, last: bool, interpret: bool = False):
+    """One streamed Chebyshev semi-iteration step.
+
+    ``v`` is the halo-DMA'd operand: the residual ``r`` itself when
+    ``first`` (z0 = v/theta is formed in-slab and d0 = z0), else the
+    current polynomial iterate ``z`` (with ``r``/``d`` as pipelined
+    elementwise inputs).  Returns ``(z_new, d_new)``, plus
+    ``rho = r . z_new`` when ``last`` (the PCG reduction fused into the
+    final step).  All scalars are traced SMEM params - a degree-k sweep
+    reuses (k-1) executables across iterations.
+    """
+    shape = v.shape
+    ndim = v.ndim
+    nx = shape[0]
+    params = jnp.stack([jnp.asarray(scale, jnp.float32),
+                        jnp.asarray(theta, jnp.float32),
+                        jnp.asarray(c1, jnp.float32),
+                        jnp.asarray(c2, jnp.float32)])
+    kernel = functools.partial(_cheb_step_kernel, bm=bm, nx=nx, ndim=ndim,
+                               first=first, last=last)
+    block = (bm,) + shape[1:]
+    index_map = (lambda i: (i, 0)) if ndim == 2 else (lambda i: (i, 0, 0))
+    elt_inputs = () if first else (r, d)
+    rz_outs = ([pl.BlockSpec(memory_space=pltpu.SMEM)] if last else [])
+    rz_shapes = ([jax.ShapeDtypeStruct((1,), jnp.float32)] if last else [])
+    out = pl.pallas_call(
+        kernel,
+        grid=(nx // bm,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+        + [pl.BlockSpec(memory_space=pl.ANY)]       # v (manual halo DMA)
+        + [pl.BlockSpec(block, index_map)] * len(elt_inputs),
+        out_specs=[
+            pl.BlockSpec(block, index_map),         # z out
+            pl.BlockSpec(block, index_map),         # d out
+        ] + rz_outs,
+        out_shape=[
+            jax.ShapeDtypeStruct(shape, jnp.float32),
+            jax.ShapeDtypeStruct(shape, jnp.float32),
+        ] + rz_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((2,) + _slab_shape(bm, shape), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SMEM((1,), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_BUDGET),
+        interpret=interpret,
+    )(params, v, *elt_inputs)
+    if last:
+        z_new, d_new, rz = out
+        return z_new, d_new, rz[0]
+    z_new, d_new = out
+    return z_new, d_new
 
 
 def pick_block_streaming(shape, itemsize: int = 4,

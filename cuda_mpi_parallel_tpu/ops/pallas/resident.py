@@ -737,7 +737,11 @@ def cg_resident_3d(scale, b3d, *, x0=None, tol=0.0, rtol=0.0,
 # no compiler contraction can break (see ``blas1._two_prod``).
 
 # df64 working set: 8 pinned planes + ap (2) + the dot/stencil temporaries.
-_PLANES_BOUND_DF64 = 24
+# Measured on v5e (round 5): Mosaic's actual scoped allocation at 1024^2 is
+# 104.30M = 26.1 planes - a 24-plane limit made the compiler reject a grid
+# the gate had admitted.  27 is the measured footprint plus headroom; the
+# chip accepts the resulting 108 MiB scoped limit (128 MiB VMEM part).
+_PLANES_BOUND_DF64 = 27
 
 
 def _extra_planes_df64(preconditioned: bool) -> int:

@@ -817,13 +817,14 @@ def solve(
                 "engine='streaming' needs a float32 2D/3D stencil "
                 "satisfying the slab tiling (2D: nx % 8 == 0, "
                 "ny % 128 == 0; 3D: nx % 2 == 0, ny % 8 == 0, "
-                "nz % 128 == 0), a float32 rhs, m=None, method='cg', "
+                "nz % 128 == 0), a float32 rhs, m=None or a Chebyshev "
+                "preconditioner built over this operator, method='cg', "
                 "and no checkpointing - use engine='general' (or "
                 "'auto') otherwise")
         if eligible:
             return cg_streaming(a, b, x0, tol=tol, rtol=rtol,
                                 maxiter=maxiter, check_every=check_every,
-                                iter_cap=iter_cap,
+                                iter_cap=iter_cap, m=m,
                                 record_history=record_history,
                                 interpret=_pallas_interpret())
     b = jnp.asarray(b)

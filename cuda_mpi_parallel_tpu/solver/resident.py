@@ -375,10 +375,15 @@ def cg_resident_df64(
 
     def to_pair(v, what):
         """host f64 (split), f32 (lifted), or explicit (hi, lo) -> a
-        grid-shaped df64 pair (the rhs coercion, shared with x0)."""
+        grid-shaped df64 pair (the rhs coercion, shared with x0).  An
+        explicit pair of DEVICE f32 arrays passes through without a
+        host round-trip (``_coerce_rhs_df``'s rule): ``np.asarray`` on
+        a device array is a blocking D2H copy, and callers pre-split on
+        device precisely to keep per-call transfers off the timed path."""
         if isinstance(v, tuple):
-            vh = np.asarray(v[0], np.float32)
-            vl = np.asarray(v[1], np.float32)
+            vh, vl = (w if (isinstance(w, jnp.ndarray)
+                            and w.dtype == jnp.float32)
+                      else np.asarray(w, np.float32) for w in v)
         else:
             v_np = np.asarray(v)
             if v_np.dtype == np.float64:

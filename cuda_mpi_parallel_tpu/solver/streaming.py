@@ -32,6 +32,7 @@ from ..models.operators import Stencil2D, Stencil3D
 from ..ops.pallas.fused_cg import (
     fused_cg_pass_a,
     fused_cg_pass_b,
+    fused_cheb_step,
     pick_block_streaming,
     supports_streaming,
 )
@@ -64,9 +65,23 @@ def streaming_eligible(a, b=None, m=None, *, method: str = "cg",
     """Eligibility for ``solve(engine="streaming")`` / the CLI - one
     predicate, same contract as ``resident_eligible``.  History IS
     supported (per-iteration, same granularity as the general solver).
+    ``m`` may be ``None`` or a ``ChebyshevPreconditioner`` verifiably
+    built over ``a`` (same contract as the resident engine: the fused
+    cheb steps apply THIS operator's stencil, so a foreign interval
+    would silently precondition with the wrong polynomial).
     """
     del record_history  # supported at full granularity
-    if m is not None or method != "cg":
+    if m is not None:
+        from ..models.precond import ChebyshevPreconditioner
+        from .resident import _chebyshev_match_status
+
+        if not isinstance(m, ChebyshevPreconditioner):
+            return False
+        if not isinstance(a, (Stencil2D, Stencil3D)):
+            return False
+        if _chebyshev_match_status(a, m) != "match":
+            return False
+    if method != "cg":
         return False
     if resume_from is not None or return_checkpoint or compensated:
         return False
@@ -81,11 +96,12 @@ def streaming_eligible(a, b=None, m=None, *, method: str = "cg",
 
 @functools.partial(jax.jit, static_argnames=(
     "shape", "maxiter", "check_every", "bm", "record_history",
-    "interpret"))
-def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, *, shape,
-                       maxiter, check_every, bm, record_history,
-                       interpret):
+    "interpret", "degree"))
+def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, lmin, lmax,
+                       *, shape, maxiter, check_every, bm, record_history,
+                       interpret, degree):
     ndim = len(shape)
+    preconditioned = degree > 0
 
     def stencil(u):
         # init-only matvec (r0 = b - A x0); the hot loop's stencils live
@@ -94,6 +110,35 @@ def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, *, shape,
 
         fn = stencil2d_apply if ndim == 2 else stencil3d_apply
         return fn(u, scale, bm=bm, interpret=interpret)
+
+    # Chebyshev interval scalars (models.precond.ChebyshevPreconditioner
+    # .matvec): traced, so lmin/lmax sweeps reuse the executable.
+    theta = (lmax + lmin) / 2 if preconditioned else None
+    if degree >= 2:
+        delta = (lmax - lmin) / 2
+        sigma = theta / delta
+
+        def cheb_apply(r_grid):
+            """z = P(A) r via (degree - 1) fused slab-streamed steps;
+            the last step also accumulates rho = r . z (slab order)."""
+            rho_c = 1.0 / sigma
+            z = d = None
+            rz = None
+            for j in range(degree - 1):
+                rho_new = 1.0 / (2.0 * sigma - rho_c)
+                c1 = rho_new * rho_c
+                c2 = 2.0 * rho_new / delta
+                first = j == 0
+                out = fused_cheb_step(
+                    scale, theta, c1, c2, r_grid if first else z,
+                    None if first else r_grid, None if first else d,
+                    bm=bm, first=first, last=j == degree - 2,
+                    interpret=interpret)
+                z, d = out[0], out[1]
+                if j == degree - 2:
+                    rz = out[2]
+                rho_c = rho_new
+            return z, rz
 
     if x0_grid is None:
         x = jnp.zeros(shape, jnp.float32)     # explicit x0 = 0 (quirk Q6)
@@ -107,49 +152,86 @@ def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, *, shape,
     history = _history_init(record_history, maxiter, jnp.float32,
                             jnp.zeros((), jnp.int32), nrm0)
 
-    # state: (k, x, r, p_prev, beta_prev, rho, indefinite, history)
-    # The p-update is deferred into pass A of the NEXT iteration
-    # (p_k = r_k + beta_{k-1} p_{k-1}), so the carry holds the previous
-    # direction and its beta; iteration 0 seeds p_0 = r_0 via
-    # beta_prev = 0 against a zero p_prev.
-    state = (jnp.zeros((), jnp.int32), x, r, jnp.zeros(shape, jnp.float32),
-             jnp.zeros((), jnp.float32), rr0, jnp.zeros((), jnp.bool_),
-             history)
+    if degree >= 2:
+        z0, rho0 = cheb_apply(r)
+    elif degree == 1:
+        # z = r/theta: the polynomial folds into the passes (pass A
+        # divides by theta, pass B accumulates rho); init in plain XLA
+        z0, rho0 = None, jnp.vdot(r, r / theta)
+    else:
+        z0, rho0 = None, rr0
+
+    # state: (k, x, r, [z,] p_prev, beta_prev, rho, rr, indefinite,
+    # history).  The p-update is deferred into pass A of the NEXT
+    # iteration (p_k = z_k + beta_{k-1} p_{k-1}), so the carry holds the
+    # previous direction and its beta; iteration 0 seeds p_0 = z_0 via
+    # beta_prev = 0 against a zero p_prev.  z rides the carry only for
+    # degree >= 2 (separate cheb launches); degree 1 derives it in-pass.
+    zs = (z0,) if degree >= 2 else ()
+    state = (jnp.zeros((), jnp.int32), x, r, *zs,
+             jnp.zeros(shape, jnp.float32),
+             jnp.zeros((), jnp.float32), rho0, rr0,
+             jnp.zeros((), jnp.bool_), history)
+    nz = len(zs)
 
     def cond(s):
-        k, _, _, _, _, rho, _, _ = s
-        unconverged = rho >= thresh_sq
-        nontrivial = rho > 0
-        healthy = jnp.isfinite(rho)
+        # layout: k(0) x(1) r(2) [z(3)] p_prev beta_prev rho rr indef hist
+        k, rho, rr = s[0], s[5 + nz], s[6 + nz]
+        unconverged = rr >= thresh_sq
+        nontrivial = rr > 0
+        # rho = r . M^-1 r <= 0 with r != 0 is a preconditioner
+        # breakdown (solver.cg's health predicate); unpreconditioned
+        # rho == rr so the extra terms are free
+        healthy = jnp.isfinite(rr) & jnp.isfinite(rho) & (rho > 0)
         return (k < maxiter) & (k < cap) & unconverged & nontrivial \
             & healthy
 
     def step(s):
-        k, x, r, p_prev, beta_prev, rho, indef, hist = s
-        p, pap = fused_cg_pass_a(scale, beta_prev, r, p_prev, bm=bm,
-                                 interpret=interpret)
-        indef = indef | ((pap <= 0) & (rho > 0))     # quirk Q1 tracking
+        if degree >= 2:
+            k, x, r, z, p_prev, beta_prev, rho, rr, indef, hist = s
+            v = z
+        else:
+            k, x, r, p_prev, beta_prev, rho, rr, indef, hist = s
+            v = r
+        p, pap = fused_cg_pass_a(scale, beta_prev, v, p_prev, bm=bm,
+                                 interpret=interpret,
+                                 theta=theta if degree == 1 else None)
+        indef = indef | ((pap <= 0) & (rr > 0))      # quirk Q1 tracking
         alpha = _safe_div(rho, pap)                  # CUDACG.cu:311
-        x, r, rr = fused_cg_pass_b(scale, alpha, p, x, r, bm=bm,
-                                   interpret=interpret)
-        beta = _safe_div(rr, rho)                    # CUDACG.cu:336-339
+        if degree == 1:
+            x, r, rr, rho_new = fused_cg_pass_b(
+                scale, alpha, p, x, r, bm=bm, interpret=interpret,
+                theta=theta, with_rz=True)
+        else:
+            x, r, rr = fused_cg_pass_b(scale, alpha, p, x, r, bm=bm,
+                                       interpret=interpret)
+            if degree >= 2:
+                z, rho_new = cheb_apply(r)
+            else:
+                rho_new = rr
+        beta = _safe_div(rho_new, rho)               # CUDACG.cu:336-339
         k = k + 1
         if record_history:
             hist = hist.at[k].set(jnp.sqrt(rr))
-        return (k, x, r, p, beta, rr, indef, hist)
+        if degree >= 2:
+            return (k, x, r, z, p, beta, rho_new, rr, indef, hist)
+        return (k, x, r, p, beta, rho_new, rr, indef, hist)
 
     state = _blocked_while(
         cond, step, state, check_every,
         lambda s: (s[0] + check_every <= maxiter)
         & (s[0] + check_every <= cap))
-    k, x, r, _, _, rho, indef, hist = state
-    healthy = jnp.isfinite(rho)
-    converged = (rho < thresh_sq) | (rho == 0)
+    k, x = state[0], state[1]
+    rho, rr, indef, hist = (state[5 + nz], state[6 + nz], state[7 + nz],
+                            state[8 + nz])
+    healthy = jnp.isfinite(rr) & jnp.isfinite(rho) \
+        & ((rho > 0) | (rr == 0))
+    converged = (rr < thresh_sq) | (rr == 0)
     status = jnp.where(
         converged, jnp.int32(CGStatus.CONVERGED),
         jnp.where(~healthy, jnp.int32(CGStatus.BREAKDOWN),
                   jnp.int32(CGStatus.MAXITER)))
-    return (x, k, jnp.sqrt(rho), converged, status, indef,
+    return (x, k, jnp.sqrt(rr), converged, status, indef,
             hist if record_history else None)
 
 
@@ -163,6 +245,7 @@ def cg_streaming(
     maxiter: int = 2000,
     check_every: int = 1,
     iter_cap=None,
+    m=None,
     record_history: bool = False,
     interpret: bool = False,
 ) -> CGResult:
@@ -175,6 +258,18 @@ def cg_streaming(
     satisfying ``supports_streaming_op``; unlike the resident engine
     there is no VMEM capacity ceiling - this is the engine for grids
     too large to pin (256^3 and beyond).
+
+    ``m`` accepts ``None`` or a ``ChebyshevPreconditioner`` built over
+    THIS operator (the resident engine's contract): the polynomial is
+    applied by fused slab-streamed cheb steps following ``solver.cg``'s
+    preconditioned recurrence.  Plane-pass cost per iteration on top of
+    the unpreconditioned 8: degree 1 adds ZERO (z = r/theta folds into
+    pass A's theta divisor and pass B's fused rho accumulation);
+    degree k >= 2 adds 3 (first step: r halo-read + z/d writes) plus
+    5 per additional step (z halo-read, r/d reads, z/d writes), with
+    the PCG reduction rho = r . z fused into the last step - e.g.
+    degree 4 runs 8 + 3 + 5 + 5 = 21 passes vs the general cheb-CG's
+    ~16 + 3 * (k - 1) fusion-boundary passes plus its separate dot.
 
     Returns a ``CGResult``.  The default ``check_every=1`` matches
     ``solve()`` (round-4 advice: the old default of 32 made direct
@@ -223,13 +318,40 @@ def cg_streaming(
         x0 = x0.reshape(grid) if x0.ndim == 1 else x0
         if x0.shape != grid:
             raise ValueError(f"x0 shape {x0.shape} != grid {grid}")
+    degree, lmin, lmax = 0, None, None
+    if m is not None:
+        from ..models.precond import ChebyshevPreconditioner
+        from .resident import _chebyshev_match_status
+
+        if not isinstance(m, ChebyshevPreconditioner):
+            raise TypeError(
+                f"cg_streaming supports m=None or a "
+                f"ChebyshevPreconditioner (applied by fused streamed "
+                f"steps), got {type(m).__name__} - use solver.cg for "
+                f"other preconditioners")
+        status = _chebyshev_match_status(a, m)
+        if status == "unverifiable":
+            raise ValueError(
+                "under jit, build the ChebyshevPreconditioner over the "
+                "SAME operator instance passed to cg_streaming (scale "
+                "equality cannot be checked on traced values)")
+        if status == "mismatch":
+            raise ValueError(
+                "the ChebyshevPreconditioner must be built over the "
+                "same stencil operator being solved (same grid and "
+                "same scale)")
+        degree = int(m.degree)
+        lmin = jnp.asarray(m.lmin, jnp.float32)
+        lmax = jnp.asarray(m.lmax, jnp.float32)
     bm = pick_block_streaming(grid)
     cap = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
     x, k, nrm, converged, status, indef, hist = _cg_streaming_call(
         a.scale, b_grid, x0, jnp.asarray(tol, jnp.float32),
-        jnp.asarray(rtol, jnp.float32), cap, shape=grid, maxiter=maxiter,
+        jnp.asarray(rtol, jnp.float32), cap, lmin, lmax, shape=grid,
+        maxiter=maxiter,
         check_every=min(check_every, max(maxiter, 1)), bm=bm,
-        record_history=record_history, interpret=interpret)
+        record_history=record_history, interpret=interpret,
+        degree=degree)
     return CGResult(
         x=x.reshape(-1) if flat_in else x,
         iterations=k, residual_norm=nrm,

@@ -226,13 +226,17 @@ class TestExternalKillRehearsal:
     def test_sigterm_mid_acquire_emits_final_record(self, tmp_path,
                                                     repo_root):
         import signal
-        import time as _time
         proc = self._spawn(tmp_path, repo_root)
-        _time.sleep(3.0)  # into the first backoff sleep
+        # Wait for the provisional startup record: it prints AFTER the
+        # SIGTERM handler is installed, so it is the deterministic
+        # "handler is live" signal (a fixed sleep raced interpreter
+        # startup under load and the default handler won, rc -15).
+        first = proc.stdout.readline()
+        assert first.startswith("{"), f"unexpected first line: {first!r}"
         proc.send_signal(signal.SIGTERM)
         out, _ = proc.communicate(timeout=30)
         assert proc.returncode == 1
-        rec = self._last_record(out)
+        rec = self._last_record(first + out)
         assert rec["error_kind"] == "terminated"
         assert rec["last_known_good"]["headline_value"] == 148519.5
 

@@ -642,3 +642,107 @@ class TestDefaultCheckEvery:
         ref = solve(op, b, tol=1e-4, maxiter=300)
         res = cg_streaming(op, b, tol=1e-4, maxiter=300, interpret=True)
         assert int(res.iterations) == int(ref.iterations)
+
+
+class TestChebyshevStreaming:
+    """Streamed Chebyshev preconditioning (round-4 verdict item 4): the
+    past-VMEM engine competing on time-to-tolerance, not just iters/s.
+
+    Degree 1 folds into the existing passes (pass A's theta divisor +
+    pass B's fused rho accumulation - zero extra plane-passes); degree
+    k >= 2 runs (k - 1) ``fused_cheb_step`` launches per iteration with
+    the PCG reduction fused into the last.  The parity bar is the
+    engine's own: iteration counts EQUAL to the general cheb-CG at
+    equal tolerances (interpret mode matched bit-exactly at review
+    time, but only count equality plus f32-level x agreement is
+    contractual).
+    """
+
+    def _cheb(self, op, degree):
+        from cuda_mpi_parallel_tpu.models.precond import (
+            ChebyshevPreconditioner,
+        )
+
+        return ChebyshevPreconditioner.from_operator(op, degree=degree)
+
+    @pytest.mark.parametrize("degree", [1, 2, 4])
+    def test_2d_parity_vs_general(self, degree):
+        op, b = _problem_2d(16, 128)
+        m = self._cheb(op, degree)
+        ref = solve(op, b, tol=1e-4, maxiter=400, m=m)
+        res = cg_streaming(op, b, tol=1e-4, maxiter=400, m=m,
+                           interpret=True)
+        assert bool(res.converged)
+        assert int(res.iterations) == int(ref.iterations)
+        if degree >= 2:
+            # degree 1 is a pure Richardson scaling (z = r/theta): same
+            # search directions, no count reduction expected
+            assert int(res.iterations) < int(
+                solve(op, b, tol=1e-4, maxiter=400).iterations)
+        np.testing.assert_allclose(np.asarray(res.x), np.asarray(ref.x),
+                                   rtol=0, atol=1e-5)
+
+    def test_3d_parity_vs_general(self):
+        op = poisson.poisson_3d_operator(4, 8, 128, dtype=jnp.float32)
+        rng = np.random.default_rng(1)
+        b = rng.standard_normal(op.shape[0]).astype(np.float32)
+        m = self._cheb(op, 4)
+        ref = solve(op, b, tol=1e-4, maxiter=400, m=m)
+        res = cg_streaming(op, b, tol=1e-4, maxiter=400, m=m,
+                           interpret=True)
+        assert bool(res.converged)
+        assert int(res.iterations) == int(ref.iterations)
+        np.testing.assert_allclose(np.asarray(res.x), np.asarray(ref.x),
+                                   rtol=0, atol=1e-5)
+
+    def test_warm_start_and_history(self):
+        op, b = _problem_2d(16, 128)
+        m = self._cheb(op, 2)
+        rng = np.random.default_rng(7)
+        x0 = rng.standard_normal(op.shape[0]).astype(np.float32)
+        ref = solve(op, b, x0=x0, tol=1e-4, maxiter=400, m=m,
+                    record_history=True)
+        res = cg_streaming(op, b, x0=x0, tol=1e-4, maxiter=400, m=m,
+                           record_history=True, interpret=True)
+        assert bool(res.converged)
+        assert int(res.iterations) == int(ref.iterations)
+        k = int(res.iterations)
+        hist = np.asarray(res.residual_history)
+        # per-iteration trace: slot k holds the final ||r||
+        np.testing.assert_allclose(hist[k], float(res.residual_norm),
+                                   rtol=1e-6)
+        ref_hist = np.asarray(ref.residual_history)
+        np.testing.assert_allclose(hist[:k + 1], ref_hist[:k + 1],
+                                   rtol=1e-4)
+
+    def test_eligibility_and_routing(self):
+        from cuda_mpi_parallel_tpu.models.operators import (
+            JacobiPreconditioner,
+        )
+
+        op, b = _problem_2d(16, 128)
+        m = self._cheb(op, 4)
+        assert streaming_eligible(op, b, m)
+        # a cheb built over a DIFFERENT operator must not be eligible
+        other = poisson.poisson_2d_operator(8, 128, dtype=jnp.float32)
+        assert not streaming_eligible(op, b, self._cheb(other, 4))
+        # non-chebyshev preconditioners stay on the general engine
+        mj = JacobiPreconditioner.from_operator(op)
+        assert not streaming_eligible(op, b, mj)
+        with pytest.raises(TypeError, match="Chebyshev"):
+            cg_streaming(op, b, m=mj, interpret=True)
+        with pytest.raises(ValueError, match="same stencil"):
+            cg_streaming(op, b, m=self._cheb(other, 4), interpret=True)
+        # engine="streaming" routes a matching cheb through the engine
+        res = solve(op, b, tol=1e-4, maxiter=400, m=m, engine="streaming")
+        ref = solve(op, b, tol=1e-4, maxiter=400, m=m)
+        assert int(res.iterations) == int(ref.iterations)
+
+    def test_unpreconditioned_trajectory_untouched(self):
+        # theta defaults to an exact 1.0 divide: the m=None path must
+        # stay BITWISE identical to the pre-theta kernels' trajectory,
+        # represented here by the general solver's count at equal tol
+        op, b = _problem_2d(16, 128)
+        ref = solve(op, b, tol=1e-4, maxiter=400)
+        res = cg_streaming(op, b, tol=1e-4, maxiter=400, interpret=True)
+        assert int(res.iterations) == int(ref.iterations)
