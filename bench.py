@@ -426,7 +426,9 @@ SECTION_PRIORITY = [
     HEADLINE_KEY,                          # the 148.5k headline row
     "northstar256",                        # streaming >=1.8x verdict (3D)
     "northstar256_df64",                   # df64 streaming at 256^3
+    "northstar256_cheb_streaming",         # streamed cheb4 time-to-tol
     "poisson2d_1M_stencil_resident_cg1",   # roofline A/B vs headline
+    "poisson2d_4M_stencil_resident",       # largest probe-admitted grid
     "poisson2d_1M_stencil_whileloop",      # the general-solver baseline
     "hbm16m",                              # 2D streaming + slab kernels
     "precond512",                          # time-to-tol ladder
@@ -577,6 +579,37 @@ def bench_all(results, sections=None) -> None:
 
     registry.append(("poisson2d_1M_stencil_resident_cg1",
                      s_resident_cg1))
+
+    # The largest resident 2D grid the round-5 capacity probe admitted
+    # (tools/capacity_probe_r05.json): 2048^2 = 4.2M rows fully pinned
+    # in VMEM.  Grids in (1448^2, 2048^2] previously routed to the ~3x
+    # slower engines under the pessimistic 12-plane gate.
+    def s_resident_2048():
+        from cuda_mpi_parallel_tpu import (
+            cg_resident as _cgres,
+            supports_resident as _sup,
+        )
+
+        op = poisson.poisson_2d_operator(2048, 2048, dtype=jnp.float32)
+        if jax.default_backend() != "tpu":
+            results["poisson2d_4M_stencil_resident"] = {
+                "skipped": "needs a compiled TPU backend"}
+            return
+        if not _sup(op):
+            results["poisson2d_4M_stencil_resident"] = {
+                "skipped": "working set exceeds the device VMEM budget"}
+            return
+        rng = np.random.default_rng(11)
+        b = jnp.asarray(rng.standard_normal(2048 * 2048)
+                        .astype(np.float32))
+        entry = iter_delta(
+            op, b, 100, 10100, repeats=5,
+            solver=lambda rr, it: _cgres(op, rr, tol=0.0, maxiter=it,
+                                         check_every=32).x)
+        entry["engine"] = "resident"
+        results["poisson2d_4M_stencil_resident"] = entry
+
+    registry.append(("poisson2d_4M_stencil_resident", s_resident_2048))
 
     def s_csr():
         # keep this single call short: at ~83 ms/iter the XLA-gather kernel
@@ -919,6 +952,47 @@ def bench_all(results, sections=None) -> None:
 
     registry.append(("northstar256", s_northstar))
 
+    # Streamed Chebyshev at the north-star scale (round-5: the past-VMEM
+    # engine competing on time-to-tolerance, not just iters/s).  Degree 4
+    # costs 21 plane-passes/iter (8 + 3 + 5 + 5) vs the general cheb-CG's
+    # ~16 XLA fusion-boundary passes PER CHEB TERM; the win is the ~4x
+    # iteration reduction carried at streaming-engine per-pass cost.
+    def s_northstar_cheb_streaming():
+        from cuda_mpi_parallel_tpu import cg_streaming
+        from cuda_mpi_parallel_tpu.models.operators import Stencil3D
+        from cuda_mpi_parallel_tpu.models.precond import (
+            ChebyshevPreconditioner,
+        )
+
+        if jax.default_backend() != "tpu":
+            results["poisson3d_256_cheb4_streaming"] = {
+                "skipped": "needs a compiled TPU backend"}
+            return
+        rng = np.random.default_rng(5)
+        a256 = Stencil3D.create(256, 256, 256, dtype=jnp.float32)
+        b256 = jnp.asarray(
+            rng.standard_normal(a256.shape[0]).astype(np.float32))
+        m = ChebyshevPreconditioner.from_operator(a256, degree=4)
+        entry = iter_delta(
+            a256, b256, 16, 272, repeats=3,
+            solver=lambda rr, it: cg_streaming(
+                a256, rr, tol=0.0, maxiter=it, check_every=32, m=m).x)
+        entry["engine"] = "streaming_cheb4"
+        res_s = cg_streaming(a256, b256, tol=0.0, rtol=1e-6,
+                             maxiter=2000, check_every=32, m=m)
+        res_g = solve(a256, b256, tol=0.0, rtol=1e-6, maxiter=2000,
+                      check_every=32, m=m)
+        entry["iterations_cheb_streaming_vs_general"] = [
+            int(res_s.iterations), int(res_g.iterations)]
+        # derived, not a wall-clock solve_delta: iteration-delta rate x
+        # measured iterations-to-rtol-1e-6 (components recorded above)
+        entry["time_to_tol_s_derived"] = (
+            entry["us_per_iter"] * int(res_s.iterations) * 1e-6)
+        results["poisson3d_256_cheb4_streaming"] = entry
+
+    registry.append(("northstar256_cheb_streaming",
+                     s_northstar_cheb_streaming))
+
     # f64-class at the north-star scale: the df64 fused passes (16
     # plane-passes/iter vs the general df64 solver's ~32).  Its own
     # section so --resume bookkeeping (skip-if-done, error-isolation)
@@ -939,7 +1013,10 @@ def bench_all(results, sections=None) -> None:
         # drowned in that jitter (the r05 sweep's first pass recorded a
         # nonsense 2.6e11 iters/s from a <=0 median delta).  Pre-split
         # device-resident pairs + a ~1k-iteration spread fix both.
-        pairs_dev = _device_df64_pairs(b64, 4)
+        # 8 pairs, one per call paired_delta_rate makes (2 warmup +
+        # 2*pairs timed): fewer would replay identical dispatches, which
+        # the tunnel serves from a result cache, zeroing those deltas
+        pairs_dev = _device_df64_pairs(b64, 8)
         ctr64 = count(0)
 
         def run_df(it):

@@ -345,9 +345,10 @@ def main(argv=None) -> int:
         if args.mesh > 1:
             raise SystemExit("--engine streaming is single-device "
                              "(no --mesh > 1)")
-        if args.precond is not None or args.method != "cg":
+        if args.precond not in (None, "chebyshev") or args.method != "cg":
             raise SystemExit("--engine streaming supports --method cg "
-                             "unpreconditioned (--history is fine: the "
+                             "with --precond chebyshev or none "
+                             "(--history is fine: the "
                              "trace is per-iteration)")
         if args.df64:
             raise SystemExit("--engine streaming is float32-only "
@@ -487,23 +488,37 @@ def main(argv=None) -> int:
             # same auto-only-on-TPU rule as the resident engine; the
             # shared streaming_eligible predicate is the authority
             # (one source of truth with solve(engine="streaming")).
-            eligible = ((args.engine == "streaming"
-                         or _jax_backend_is_tpu())
-                        and args.precond is None
-                        and streaming_eligible(
-                            a, b, method=args.method,
-                            record_history=args.history))
+            # Chebyshev rides the engine's fused cheb steps (round 5);
+            # cheap gates first so the 30-matvec power iteration only
+            # runs for solves that can actually take this path.
+            from .solver.streaming import supports_streaming_op
+
+            cheap_s = ((args.engine == "streaming"
+                        or _jax_backend_is_tpu())
+                       and args.precond in (None, "chebyshev")
+                       and args.method == "cg"
+                       and supports_streaming_op(a))
+            m_st = None
+            if cheap_s and args.precond == "chebyshev":
+                from .models.precond import ChebyshevPreconditioner
+
+                m_st = ChebyshevPreconditioner.from_operator(
+                    a, degree=args.precond_degree)
+            eligible = cheap_s and streaming_eligible(
+                a, b, m_st, method=args.method,
+                record_history=args.history)
             if args.engine == "streaming" and not eligible:
                 raise SystemExit(
                     f"--engine streaming does not support "
                     f"{type(a).__name__} at this size/dtype (needs a "
-                    f"float32 2D/3D stencil satisfying the slab tiling "
-                    f"and a float32 rhs; try --problem poisson3d "
-                    f"--matrix-free)")
+                    f"float32 2D/3D stencil satisfying the slab tiling, "
+                    f"a float32 rhs, and --precond none or chebyshev; "
+                    f"try --problem poisson3d --matrix-free)")
             if eligible:
                 return cg_streaming(a, b, tol=args.tol, rtol=args.rtol,
                                     maxiter=args.maxiter,
                                     check_every=args.check_every,
+                                    m=m_st,
                                     record_history=args.history,
                                     interpret=_pallas_interpret())
         from . import solve

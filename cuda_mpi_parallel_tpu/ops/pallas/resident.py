@@ -68,11 +68,19 @@ _VMEM_BY_GENERATION = (
 )
 _VMEM_FALLBACK = 128 * 1024 * 1024
 
-# Peak resident planes: 5 pinned (b, x, r, p, Ap) + up to ~7 transient
-# (four shift copies, r_new, elementwise products feeding the two
-# reductions) before Mosaic reuses anything.  Deliberately pessimistic -
-# the gate must never admit a grid the compiler then fails to allocate.
-_PLANES_BOUND = 12
+# Peak resident planes: 5 pinned (b, x, r, p, Ap) plus Mosaic transients.
+# The round-5 on-chip probe (tools/capacity_probe_r05.json) compiled and
+# ran the kernel on a 128 MiB v5e at every grid in the ladder up to
+# 2048^2 f32 AND at boundary grids within 1% of this bound's admissible
+# ceiling of 4.79M cells - 2048x2304, 2056x2304, and (290, 128, 128) 3D
+# (4.75M cells) all compile and solve correctly - consistent with the
+# ~4-plane direct measurement at 1024^2 (HW_WINDOW item 2).  So the
+# ENTIRE range a 7-plane gate admits is evidence-backed (footprint
+# grows monotonically with cells; the extremes passed), preserving the
+# invariant that the gate never admits a grid the compiler then fails
+# to allocate.  The old value of 12 was modeled, not measured, and
+# routed every grid past 1448^2 to ~3x slower engines.
+_PLANES_BOUND = 7
 
 
 def vmem_bytes(device=None) -> int:

@@ -4,6 +4,7 @@ collectives - the TPU-native communication backend the reference's repo name
 
 from . import multihost
 from .df64 import DistStencilDF64, solve_distributed_df64
+from .resident import solve_distributed_resident
 from .streaming import (
     solve_distributed_streaming,
     solve_distributed_streaming_df64,
@@ -59,6 +60,7 @@ __all__ = [
     "shard_vector",
     "solve_distributed",
     "solve_distributed_df64",
+    "solve_distributed_resident",
     "solve_distributed_streaming",
     "solve_distributed_streaming_df64",
 ]

@@ -375,22 +375,15 @@ def cg_resident_df64(
 
     def to_pair(v, what):
         """host f64 (split), f32 (lifted), or explicit (hi, lo) -> a
-        grid-shaped df64 pair (the rhs coercion, shared with x0).  An
-        explicit pair of DEVICE f32 arrays passes through without a
-        host round-trip (``_coerce_rhs_df``'s rule): ``np.asarray`` on
-        a device array is a blocking D2H copy, and callers pre-split on
-        device precisely to keep per-call transfers off the timed path."""
-        if isinstance(v, tuple):
-            vh, vl = (w if (isinstance(w, jnp.ndarray)
-                            and w.dtype == jnp.float32)
-                      else np.asarray(w, np.float32) for w in v)
-        else:
-            v_np = np.asarray(v)
-            if v_np.dtype == np.float64:
-                vh, vl = df.split_f64(v_np)
-            else:
-                vh = v_np.astype(np.float32)
-                vl = np.zeros_like(vh)
+        grid-shaped df64 pair.  Delegates the dtype/pair rules to
+        ``solver.df64._coerce_rhs_df`` (ONE definition of "explicit
+        device pair passes through without a host round-trip"; a second
+        copy here had already drifted to weaker validation) and adds
+        only the grid-shape handling the resident kernel needs."""
+        from .df64 import _coerce_rhs_df
+
+        vh, vl = _coerce_rhs_df(
+            tuple(v) if isinstance(v, (tuple, list)) else v)
         if vh.ndim == 1:
             if vh.shape[0] != n_cells:
                 raise ValueError(

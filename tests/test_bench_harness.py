@@ -231,7 +231,20 @@ class TestExternalKillRehearsal:
         # SIGTERM handler is installed, so it is the deterministic
         # "handler is live" signal (a fixed sleep raced interpreter
         # startup under load and the default handler won, rc -15).
-        first = proc.stdout.readline()
+        # Bounded: a wedged child must fail the test, not hang CI.
+        import threading
+
+        lines = []
+        reader = threading.Thread(
+            target=lambda: lines.append(proc.stdout.readline()),
+            daemon=True)
+        reader.start()
+        reader.join(timeout=120)
+        if not lines:
+            proc.kill()
+            proc.communicate(timeout=30)
+            pytest.fail("child produced no startup record within 120s")
+        first = lines[0]
         assert first.startswith("{"), f"unexpected first line: {first!r}"
         proc.send_signal(signal.SIGTERM)
         out, _ = proc.communicate(timeout=30)
@@ -264,7 +277,9 @@ class TestSectionPriority:
         assert ran[0] == bench.HEADLINE_KEY
         assert ran[1] == "northstar256"
         assert ran[2] == "northstar256_df64"
-        assert ran[3] == "poisson2d_1M_stencil_resident_cg1"
+        assert ran[3] == "northstar256_cheb_streaming"
+        assert ran[4] == "poisson2d_1M_stencil_resident_cg1"
+        assert ran[5] == "poisson2d_4M_stencil_resident"
         assert ran[-1] == "poisson2d_1M_csr"
 
     def test_sections_filter(self, monkeypatch):

@@ -626,6 +626,15 @@ class TestGate:
         assert not rk.supports_resident_2d(1024, 1024)
         assert rk.supports_resident_2d(8, 128)
 
+    def test_probe_relaxed_bound_admits_2048(self):
+        # round-5 capacity probe (tools/capacity_probe_r05.json): the
+        # kernel compiles and runs at 2048^2 f32 on a 128 MiB part, so
+        # the gate must admit it (the old 12-plane bound routed every
+        # grid past 1448^2 to slower engines)
+        assert rk.supports_resident_2d(2048, 2048)
+        # and the bound stays a bound: 2304^2 needs 7 * 21.2 MB > 128 MiB
+        assert not rk.supports_resident_2d(2304, 2304)
+
     def test_env_override_validation(self, monkeypatch):
         monkeypatch.setenv(rk._ENV_OVERRIDE, "not-a-number")
         with pytest.raises(ValueError, match="integer byte count"):

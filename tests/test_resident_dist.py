@@ -1,0 +1,137 @@
+"""Distributed VMEM-resident CG (``ops/pallas/resident_dist.py`` +
+``parallel/resident.py``): the flagship engine's multi-chip form.
+
+Round-4 verdict item 3's done-criterion and beyond: N-device
+TPU-interpret runs (the simulator models remote DMAs, semaphores and
+happens-before ordering) with iteration parity against the
+single-device resident kernel, plus a race-detector pass.  The
+COMPILED form was verified on a real v5e in its 1-shard degenerate
+(round 5): bitwise-identical x and iteration count vs ``cg_resident``
+at 1024^2, with the self-RDMA ring active.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cuda_mpi_parallel_tpu import cg_resident
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.parallel import make_mesh
+from cuda_mpi_parallel_tpu.parallel.resident import (
+    solve_distributed_resident,
+)
+
+
+def _single(op, b, **kw):
+    return cg_resident(op, b, interpret=True, **kw)
+
+
+class TestParity2D:
+    def _problem(self, nx=32, ny=128, seed=0):
+        op = poisson.poisson_2d_operator(nx, ny, dtype=jnp.float32)
+        rng = np.random.default_rng(seed)
+        return op, rng.standard_normal(nx * ny).astype(np.float32)
+
+    @pytest.mark.parametrize("n_shards", [2, 4])
+    def test_iteration_parity_vs_single_kernel(self, n_shards):
+        op, b = self._problem()
+        single = _single(op, b, tol=1e-3, maxiter=300, check_every=8)
+        dist = solve_distributed_resident(
+            op, b, mesh=make_mesh(n_shards), tol=1e-3, maxiter=300,
+            check_every=8)
+        assert bool(dist.converged)
+        assert int(dist.iterations) == int(single.iterations)
+        # dots: per-shard partials summed in fixed order vs the single
+        # kernel's full-slab reduction - f32 reduction-order rounding
+        assert np.abs(np.asarray(dist.x)
+                      - np.asarray(single.x)).max() < 1e-4
+
+    def test_race_detector_clean(self):
+        # the simulator's happens-before checker over the kernel's
+        # remote DMAs and semaphores: the no-barrier single-buffer
+        # design must be provably race-free, not just numerically lucky
+        from jax._src.pallas.mosaic.interpret import (
+            interpret_pallas_call as ipc,
+        )
+
+        op, b = self._problem(16, 128)
+        dist = solve_distributed_resident(
+            op, b, mesh=make_mesh(2), tol=1e-3, maxiter=100,
+            check_every=8, detect_races=True)
+        assert bool(dist.converged)
+        assert not ipc.races.races_found
+
+    def test_solution_correct(self):
+        op = poisson.poisson_2d_operator(32, 128, dtype=jnp.float32)
+        rng = np.random.default_rng(3)
+        x_true = rng.standard_normal(op.shape[0]).astype(np.float32)
+        b = np.asarray(op @ jnp.asarray(x_true))
+        dist = solve_distributed_resident(
+            op, b, mesh=make_mesh(4), tol=0.0, rtol=1e-5, maxiter=2000,
+            check_every=16)
+        assert bool(dist.converged)
+        assert np.abs(np.asarray(dist.x) - x_true).max() < 1e-2
+
+
+class TestParity3D:
+    def test_iteration_parity_4dev(self):
+        op = poisson.poisson_3d_operator(8, 8, 128, dtype=jnp.float32)
+        rng = np.random.default_rng(1)
+        b = rng.standard_normal(op.shape[0]).astype(np.float32)
+        single = _single(op, b, tol=1e-3, maxiter=300, check_every=8)
+        dist = solve_distributed_resident(
+            op, b, mesh=make_mesh(4), tol=1e-3, maxiter=300,
+            check_every=8)
+        assert bool(dist.converged)
+        assert int(dist.iterations) == int(single.iterations)
+        assert np.abs(np.asarray(dist.x)
+                      - np.asarray(single.x)).max() < 1e-4
+
+    def test_single_plane_shards(self):
+        # per-shard nx == 1: the corr-row special case (both neighbor
+        # corrections land on the same plane)
+        op = poisson.poisson_3d_operator(8, 8, 128, dtype=jnp.float32)
+        rng = np.random.default_rng(2)
+        b = rng.standard_normal(op.shape[0]).astype(np.float32)
+        single = _single(op, b, tol=1e-3, maxiter=300, check_every=8)
+        dist = solve_distributed_resident(
+            op, b, mesh=make_mesh(8), tol=1e-3, maxiter=300,
+            check_every=8)
+        assert bool(dist.converged)
+        assert int(dist.iterations) == int(single.iterations)
+
+
+class TestGateAndErrors:
+    def test_rejections(self):
+        op = poisson.poisson_2d_operator(32, 128, dtype=jnp.float32)
+        b = np.ones(32 * 128, np.float32)
+        # per-shard nx % 8 != 0 (2D sublane tiling)
+        with pytest.raises(ValueError, match="resident gate"):
+            solve_distributed_resident(op, b, mesh=make_mesh(8))
+        # non-dividing leading axis
+        op2 = poisson.poisson_2d_operator(20, 128, dtype=jnp.float32)
+        b2 = np.ones(20 * 128, np.float32)
+        with pytest.raises(ValueError, match="divide"):
+            solve_distributed_resident(op2, b2, mesh=make_mesh(8))
+        # non-stencil operator
+        a_csr = poisson.poisson_2d_csr(16, 16, dtype=np.float32)
+        with pytest.raises(TypeError, match="Stencil"):
+            solve_distributed_resident(a_csr, np.ones(256, np.float32),
+                                       mesh=make_mesh(2))
+        # f64 operator
+        op64 = poisson.poisson_2d_operator(32, 128, dtype=jnp.float64)
+        with pytest.raises(ValueError, match="float32"):
+            solve_distributed_resident(op64, b, mesh=make_mesh(2))
+
+    def test_maxiter_status(self):
+        from cuda_mpi_parallel_tpu.solver.status import CGStatus
+
+        op = poisson.poisson_2d_operator(16, 128, dtype=jnp.float32)
+        rng = np.random.default_rng(5)
+        b = rng.standard_normal(op.shape[0]).astype(np.float32)
+        dist = solve_distributed_resident(
+            op, b, mesh=make_mesh(2), tol=1e-30, maxiter=8,
+            check_every=8)
+        assert not bool(dist.converged)
+        assert int(dist.iterations) == 8
+        assert int(dist.status) == int(CGStatus.MAXITER)
