@@ -318,9 +318,11 @@ def main(argv=None) -> int:
         desc += f" [{args.fmt}]"
 
     if args.engine == "resident":
-        if args.mesh > 1:
-            raise SystemExit("--engine resident is single-device "
-                             "(no --mesh > 1)")
+        if args.mesh > 1 and (args.precond is not None
+                              or args.method != "cg" or args.df64):
+            raise SystemExit("--engine resident with --mesh > 1 runs the "
+                             "distributed one-kernel-per-chip solve: "
+                             "unpreconditioned f32 --method cg only")
         if (args.precond not in (None, "chebyshev")
                 or args.method not in ("cg", "cg1")
                 or (args.method == "cg1" and args.precond is not None)):
@@ -342,9 +344,12 @@ def main(argv=None) -> int:
                 "distributed df64 backend carries the CG recurrences; "
                 "drop --mesh or use f32 minres on the mesh)")
     if args.engine == "streaming":
-        if args.mesh > 1:
-            raise SystemExit("--engine streaming is single-device "
-                             "(no --mesh > 1)")
+        if args.mesh > 1 and (args.precond is not None
+                              or args.method != "cg"):
+            raise SystemExit("--engine streaming with --mesh > 1 runs "
+                             "the distributed fused-slab solve: "
+                             "unpreconditioned --method cg only (the "
+                             "streamed Chebyshev path is single-device)")
         if args.precond not in (None, "chebyshev") or args.method != "cg":
             raise SystemExit("--engine streaming supports --method cg "
                              "with --precond chebyshev or none "
@@ -415,6 +420,31 @@ def main(argv=None) -> int:
             if not isinstance(a, (CSRMatrix, Stencil2D, Stencil3D)):
                 raise SystemExit(
                     "--mesh > 1 supports CSR and stencil problems only")
+            if args.engine == "resident":
+                # the one-kernel-per-chip distributed resident solve
+                # (in-kernel RDMA halos + allreduces); scope enforced
+                # by the engine gate above
+                from .parallel import solve_distributed_resident
+
+                try:
+                    return solve_distributed_resident(
+                        a, b, mesh=make_mesh(args.mesh), tol=args.tol,
+                        rtol=args.rtol, maxiter=args.maxiter,
+                        check_every=args.check_every)
+                except (TypeError, ValueError) as e:
+                    raise SystemExit(f"--engine resident --mesh "
+                                     f"{args.mesh}: {e}")
+            if args.engine == "streaming":
+                from .parallel import solve_distributed_streaming
+
+                try:
+                    return solve_distributed_streaming(
+                        a, b, mesh=make_mesh(args.mesh), tol=args.tol,
+                        rtol=args.rtol, maxiter=args.maxiter,
+                        check_every=args.check_every)
+                except (TypeError, ValueError) as e:
+                    raise SystemExit(f"--engine streaming --mesh "
+                                     f"{args.mesh}: {e}")
             if args.precond == "bjacobi":
                 raise SystemExit(
                     "--precond bjacobi is single-device only (use jacobi "

@@ -543,39 +543,53 @@ def fused_cheb_step(scale, theta, c1, c2, v, r=None, d=None, *, bm: int,
 
 
 def pick_block_streaming(shape, itemsize: int = 4,
-                         budget_bytes: int = 24 * 1024 * 1024) -> int:
+                         budget_bytes: int | None = None) -> int:
     """Slab height for the fused-CG passes.
 
     The binding constraint is pass B: two manual p_new halo slabs plus
     four pipelined blocked buffers (x, r in + out, double-buffered = 8
-    block-heights) plus stencil temporaries (~4 slab copies before
-    Mosaic reuses).  ~14 block-heights of the row/plane size must fit
-    the budget; the largest power-of-two divisor wins (bigger slabs =
-    fewer grid steps = less DMA bookkeeping), capped at 128 rows / 8
-    planes like the plain stencil kernels' measured sweet spots.
+    block-heights) plus stencil temporaries.  2D keeps the original
+    conservative model (~14 block-heights within 24 MB - bench-validated
+    at 1M and 16M rows).  3D uses the round-5 MEASURED model: Mosaic's
+    actual scoped allocation at 256^3 is ~9.5 block-heights (bm=32
+    needed 81 MB, bm=16 fits the 64 MiB kernel limit and RUNS), so ~10
+    heights within ``_VMEM_BUDGET`` - the old 14-in-24MB model picked
+    bm=4 at 256^3 where bm=16 is 9% faster (788 -> 716 us/iter, the
+    difference between 1.59x and 1.79x over the general engine).  The
+    largest power-of-two divisor wins (bigger slabs = fewer grid steps
+    = less DMA bookkeeping), capped at 128 rows / 16 planes.
     """
     nx = shape[0]
     row_bytes = itemsize
     for d in shape[1:]:
         row_bytes *= d
-    halo = 2 * _HALO if len(shape) == 2 else 2
+    if len(shape) == 2:
+        halo, heights, cap = 2 * _HALO, 14, 128
+        budget = 24 * 1024 * 1024 if budget_bytes is None else budget_bytes
+    else:
+        halo, heights, cap = 2, 10, 16
+        budget = _VMEM_BUDGET if budget_bytes is None else budget_bytes
     best = 0
     bm = 8 if len(shape) == 2 else 1
     while bm <= nx:
-        if nx % bm == 0 and 14 * (bm + halo) * row_bytes <= budget_bytes:
+        if nx % bm == 0 and heights * (bm + halo) * row_bytes <= budget:
             best = bm
         bm *= 2
     if not best:
         raise ValueError(
             f"no feasible fused-CG block for grid {shape}: one "
             f"row/plane is {row_bytes} bytes")
-    cap = 128 if len(shape) == 2 else 8
     return min(best, cap) if nx % cap == 0 and best >= cap else best
 
 
-def supports_streaming(shape) -> bool:
+def supports_streaming(shape, itemsize: int = 4) -> bool:
     """Shape gate of the fused-CG kernels: the plain stencil kernels'
-    DMA tiling constraints, plus a feasible slab height."""
+    DMA tiling constraints, plus a feasible slab height.
+
+    ``itemsize`` must match what the solve path passes to
+    ``pick_block_streaming`` (8 for the df64 paths - hi/lo pairs double
+    the slabs), or the gate can approve a shape the picker then rejects.
+    """
     if len(shape) == 2:
         nx, ny = shape
         ok = nx % 8 == 0 and ny % 128 == 0
@@ -587,7 +601,7 @@ def supports_streaming(shape) -> bool:
     if not ok:
         return False
     try:
-        pick_block_streaming(shape)
+        pick_block_streaming(shape, itemsize=itemsize)
     except ValueError:
         return False
     return True

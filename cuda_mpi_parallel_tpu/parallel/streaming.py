@@ -219,11 +219,14 @@ def solve_distributed_streaming_df64(
             f"leading grid axis {grid[0]} does not divide over "
             f"{n_shards} shards")
     local_grid = (grid[0] // n_shards,) + grid[1:]
-    if not supports_streaming(local_grid):
+    if not supports_streaming(local_grid, itemsize=8):
         raise ValueError(
             f"per-shard slab {local_grid} does not satisfy the fused-CG "
             f"tiling")
-    bm = pick_block_streaming(local_grid)
+    # itemsize=8: every df64 plane is an (hi, lo) f32 pair, so the
+    # kernels hold twice the slabs per block-height - round 5's bm=16
+    # 3D picker OOM'd Mosaic's scoped VMEM when modeled at 4 bytes
+    bm = pick_block_streaming(local_grid, itemsize=8)
     b_df = _coerce_rhs_df(b)
     bh = shard_vector(b_df[0].reshape(-1), mesh, axis)
     bl = shard_vector(b_df[1].reshape(-1), mesh, axis)

@@ -370,7 +370,7 @@ def supports_streaming_df64(a) -> bool:
     tiling."""
     if not isinstance(a, (Stencil2D, Stencil3D)):
         return False
-    return supports_streaming(a.grid)
+    return supports_streaming(a.grid, itemsize=8)
 
 
 def cg_streaming_df64(
@@ -412,7 +412,7 @@ def cg_streaming_df64(
             f"got {type(a).__name__} - use solver.df64.cg_df64 for "
             f"general operators")
     grid = a.grid
-    if not supports_streaming(grid):
+    if not supports_streaming(grid, itemsize=8):
         raise ValueError(
             f"grid {grid} does not satisfy the fused-CG slab tiling "
             f"(2D: nx % 8 == 0, ny % 128 == 0; 3D: nx % 2 == 0, "
@@ -432,7 +432,10 @@ def cg_streaming_df64(
     scale64 = np.float64(np.asarray(a.scale, dtype=np.float64))
     sh, sl = df.split_f64(scale64)
     scale = (jnp.asarray(sh), jnp.asarray(sl))
-    bm = pick_block_streaming(grid)
+    # itemsize=8: every df64 plane is an (hi, lo) f32 pair, so the
+    # kernels hold twice the slabs per block-height - round 5's bm=16
+    # 3D picker OOM'd Mosaic's scoped VMEM when modeled at 4 bytes
+    bm = pick_block_streaming(grid, itemsize=8)
     cap = jnp.asarray(maxiter if iter_cap is None else iter_cap,
                       jnp.int32)
     tol2 = df.const(float(tol) ** 2)

@@ -398,7 +398,9 @@ class TestDF64Streaming:
         p64 = rng.standard_normal(g3)
         x64 = rng.standard_normal(g3)
         beta64, alpha64 = np.float64(0.37), np.float64(0.11)
-        bm = pick_block_streaming(g3)
+        # itemsize=8: the bm the PRODUCTION df64 call sites compute
+        # (hi/lo pairs double the slabs per block-height)
+        bm = pick_block_streaming(g3, itemsize=8)
         pn, pap = fused_cg_pass_a_df64(
             scale, pair(np.asarray(beta64)), pair(r64), pair(p64),
             bm=bm, interpret=True)
