@@ -605,14 +605,20 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
         input_output_aliases=({3: 0} if has_x0 else {}),
         # The default scoped-vmem limit (16 MiB) is sized for streaming
         # kernels; residency is the point here, so lift it to the gated
-        # footprint bound (+1 MiB slack for Mosaic's own temporaries;
-        # +2 planes for the Chebyshev recurrence's z/d transients -
-        # supports_resident_*(preconditioned=True) gates on the same).
+        # footprint bound plus an 8 MiB fixed margin: Mosaic carries
+        # SIZE-INDEPENDENT temporaries that a 1 MiB margin did not
+        # cover once the plane bound dropped to the measured 7 (round
+        # 5: 512^2 cheb allocated 11.81M against a 10M limit - ~2.8 MB
+        # of overhead at a grid where planes are only 1 MB).  The
+        # margin only loosens the compiler's self-check; the capacity
+        # GATE stays planes * cells * 4 <= vmem_bytes(), and every
+        # gate-admitted grid is probe-verified to actually fit
+        # (tools/capacity_probe_r05.json).
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=(_PLANES_BOUND
                               + _extra_planes(degree > 0, has_x0,
                                               cg1=method == "cg1"))
-            * cells * 4 + (1 << 20)),
+            * cells * 4 + (8 << 20)),
         interpret=interpret,
     )(params, cap_arr, *grid_inputs)
     return x, iters[0], rr[0], indef[0], conv[0], health[0], hist
@@ -1102,7 +1108,7 @@ def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, theta, delta, cap,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=(_PLANES_BOUND_DF64
                               + _extra_planes_df64(degree > 0))
-            * cells * 4 + (1 << 20)),
+            * cells * 4 + (8 << 20)),  # same fixed margin as the f32 kernel
         interpret=interpret,
     )(params, cap_arr, *grid_inputs)
     return (xh, xl, iters[0], (rr[0], rr[1]), indef[0], conv[0],
