@@ -115,15 +115,24 @@ def vmem_bytes(device=None) -> int:
 def _extra_planes(preconditioned: bool, warm_start: bool,
                   cg1: bool = False) -> int:
     """Plane-count surcharges over ``_PLANES_BOUND``: the Chebyshev
-    recurrence's two transients, and the cg1 recurrence's pinned
+    recurrence's transients, and the cg1 recurrence's pinned
     ``s = A p`` plane plus its ``w`` transient.  A warm start costs NO
     extra plane - the x0 input aliases the x output buffer
     (``input_output_aliases`` in ``_cg_resident_call``; the kernel
     reads x0 once at init and immediately overwrites it with the seeded
     x).  Every gate and every kernel ``vmem_limit_bytes`` computes its
-    budget through this one function so they cannot diverge."""
+    budget through this one function so they cannot diverge.
+
+    The Chebyshev surcharge is a MEASURED 6, not the modeled 2: at
+    1024^2 f32 Mosaic's scoped allocation for the cheb kernel is
+    52.92 MB = ~12.6 plane-equivalents (round 5, on-chip) - the
+    z/d recurrence keeps more transients live across the in-loop
+    stencils than the two the hand-count predicted.  7 + 6 = 13
+    covers the measured footprint with margin; the cheb boundary
+    grids the resulting gate admits are probe-verified like the
+    unpreconditioned ones (tools/capacity_probe_r05.json)."""
     del warm_start  # plane-neutral via aliasing; kept for call clarity
-    return (2 if preconditioned else 0) + (2 if cg1 else 0)
+    return (6 if preconditioned else 0) + (2 if cg1 else 0)
 
 
 def supports_resident_2d(nx: int, ny: int, itemsize: int = 4,

@@ -75,12 +75,15 @@ def _remote_row_copy(src_ref, dst_ref, send_sem, recv_sem, target):
 
 
 def _resident_dist_kernel(nblocks, check_every, n_shards, axis_name,
-                          local_shape, params_ref, cap_ref,
+                          local_shape, degree, params_ref, cap_ref,
                           b_ref, x_ref, iters_ref, rr_ref, indef_ref,
                           conv_ref, health_ref,
                           r_ref, p_ref, halo_ref, pap_buf, rr_buf,
                           state_f, state_i,
-                          halo_send, halo_recv, dot_send, dot_recv):
+                          halo_send, halo_recv, dot_send, dot_recv,
+                          *cheb_refs):
+    if degree > 0:
+        z_ref, zhalo_ref, rho_buf, zhalo_send, zhalo_recv = cheb_refs
     scale = params_ref[0]
     tol = params_ref[1]
     rtol = params_ref[2]
@@ -105,45 +108,54 @@ def _resident_dist_kernel(nblocks, check_every, n_shards, axis_name,
     # already tile-aligned.
     hb = 8 if ndim == 2 else 1
 
-    def exchange_halo(v_ref):
+    def exchange_halo(v_ref, buf=None, base=0, send=None, recv=None,
+                      sem0=0):
         """Edge block/plane of ``v_ref`` -> neighbor halo buffers.
 
         Periodic ring (SPMD-symmetric: every device runs both DMAs, so
         ``.wait()`` pairs each send with the matching incoming copy);
         ``halo_rows`` masks the wrap-around data to zero on the
-        global-boundary shards.  halo slot [0:hb] = block ABOVE the
-        slab (from ``left``), [hb:2hb] = block BELOW (from ``right``).
+        global-boundary shards.  Slot [base : base+hb] = block ABOVE
+        the slab (from ``left``), [base+hb : base+2hb] = block BELOW
+        (from ``right``); ``sem0`` selects the semaphore pair (the
+        cheb z-exchange double-buffers by step parity).
         """
+        buf = halo_ref if buf is None else buf
+        send = halo_send if send is None else send
+        recv = halo_recv if recv is None else recv
         down = _remote_row_copy(v_ref.at[pl.ds(nxl - hb, hb)],
-                                halo_ref.at[pl.ds(0, hb)],
-                                halo_send.at[0], halo_recv.at[0], right)
+                                buf.at[pl.ds(base, hb)],
+                                send.at[sem0], recv.at[sem0], right)
         up = _remote_row_copy(v_ref.at[pl.ds(0, hb)],
-                              halo_ref.at[pl.ds(hb, hb)],
-                              halo_send.at[1], halo_recv.at[1], left)
+                              buf.at[pl.ds(base + hb, hb)],
+                              send.at[sem0 + 1], recv.at[sem0 + 1], left)
         down.start()
         up.start()
         down.wait()
         up.wait()
 
-    def halo_rows():
+    def halo_rows(buf, base):
         zero = jnp.zeros(row_shape, jnp.float32)
-        above_blk = halo_ref[pl.ds(0, hb)]
-        below_blk = halo_ref[pl.ds(hb, hb)]
+        above_blk = buf[pl.ds(base, hb)]
+        below_blk = buf[pl.ds(base + hb, hb)]
         above = jnp.where(is_first, zero, above_blk[hb - 1:hb])
         below = jnp.where(is_last, zero, below_blk[0:1])
         return above, below
 
-    def stencil_with_halo(v):
+    def stencil_with_halo(v, buf=None, base=0):
         """Local Dirichlet stencil + the neighbor-row corrections.
 
         The zero-fill stencil treats the slab edges as the global
         boundary; the missing neighbor terms are exactly
         ``-scale * halo`` added to the edge rows (zeros on the true
         global boundary, so edge shards reproduce Dirichlet exactly).
+        ``buf``/``base`` select which halo buffer slot the neighbor
+        data sits in (the p exchange's single buffer, or the cheb
+        z-exchange's parity slot).
         """
         stencil = _shift_stencil if ndim == 2 else _shift_stencil_3d
         av = stencil(v, scale)
-        above, below = halo_rows()
+        above, below = halo_rows(halo_ref if buf is None else buf, base)
         # Mosaic has no scatter-add lowering for .at[row].add: build the
         # edge correction as a concatenated full-slab array instead (the
         # interior is zeros; XLA/Mosaic fold the pattern into the adds).
@@ -183,27 +195,82 @@ def _resident_dist_kernel(nblocks, check_every, n_shards, axis_name,
             dma.wait()
         return jnp.sum(buf[:, 0:1])
 
+    def precond(r):
+        """degree-term Chebyshev approximation of A^-1 applied to r -
+        the distributed form of the single-device kernel's in-kernel
+        polynomial (resident._resident_kernel's precond).  Every cheb
+        step applies the stencil to a FRESH z, so each step runs its
+        own halo exchange; steps double-buffer the z-halo slots by
+        step parity (consecutive steps use different slots, and a
+        device cannot issue its step-(j+2) exchange before its own
+        step-(j+1) halo wait, which transitively requires every
+        neighbor to have consumed its step-j slot - so two slots
+        suffice without a barrier; the iteration-boundary reuse is
+        ordered by the surrounding allreduces).
+        """
+        lmin = params_ref[3]
+        lmax = params_ref[4]
+        theta = (lmax + lmin) * 0.5
+        delta = (lmax - lmin) * 0.5
+        sigma = theta / delta
+        rho_c = 1.0 / sigma
+        d = r / theta
+        z = d
+        for j in range(degree - 1):
+            par = j % 2
+            z_ref[:] = z
+            exchange_halo(z_ref, buf=zhalo_ref, base=par * 2 * hb,
+                          send=zhalo_send, recv=zhalo_recv,
+                          sem0=par * 2)
+            az = stencil_with_halo(z, buf=zhalo_ref, base=par * 2 * hb)
+            rho_n = 1.0 / (2.0 * sigma - rho_c)
+            d = (rho_n * rho_c) * d + (2.0 * rho_n / delta) * (r - az)
+            z = z + d
+            rho_c = rho_n
+        return z
+
     b = b_ref[:]
     x_ref[:] = jnp.zeros_like(b)            # explicit x0 = 0 (quirk Q6)
     r_ref[:] = b                            # r0 = b (CUDACG.cu:248)
-    p_ref[:] = b                            # p0 = r0 (CUDACG.cu:255)
     rr0 = allreduce(jnp.sum(b * b), rr_buf, dot_send, dot_recv)
+    if degree > 0:
+        z0 = precond(b)
+        p_ref[:] = z0                       # p0 = z0 (preconditioned)
+        # rho = r . z gets its OWN exchange buffer.  Reusing pap_buf is
+        # a RACE for n >= 3 (caught by the happens-before detector): a
+        # NON-neighbor q can pass rho-AR(k) - which only needs this
+        # device's row SENT, not read - then run its p-exchange with
+        # its own neighbors and push its pap(k+1) row here while this
+        # device is still reading rho(k) rows.  With three buffers in a
+        # (pap, rr, rho) cycle, every read is protected: the writer of
+        # a buffer's next value must first complete two other
+        # allreduces whose rows this device only sends AFTER its read.
+        rho0 = allreduce(jnp.sum(b * z0), rho_buf, dot_send, dot_recv)
+    else:
+        p_ref[:] = b                        # p0 = r0 (CUDACG.cu:255)
+        rho0 = rr0
     thresh = jnp.maximum(tol, rtol * jnp.sqrt(rr0))
     thresh2 = thresh * thresh
 
     state_f[0] = rr0
+    state_f[1] = rho0
     state_i[0] = jnp.int32(0)               # iterations completed
     state_i[1] = jnp.int32(0)               # indefiniteness (quirk Q1)
 
     def block(blk, carry):
-        healthy = jnp.isfinite(state_f[0])
+        # health mirrors the single-device kernel: non-finite scalars
+        # are a breakdown, and rho <= 0 with r != 0 is a preconditioner
+        # breakdown (M not SPD) - stop, don't spin
+        healthy = (jnp.isfinite(state_f[0]) & jnp.isfinite(state_f[1])
+                   & (state_f[1] > 0.0))
 
         @pl.when((state_f[0] >= thresh2) & (state_f[0] > 0.0)
                  & (state_i[0] < cap) & healthy)
         def _():
             nsteps = jnp.minimum(jnp.int32(check_every), cap - state_i[0])
 
-            def one_iter(_, rr):
+            def one_iter(_, carry):
+                rr, rho = carry
                 p = p_ref[:]
                 exchange_halo(p_ref)
                 ap = stencil_with_halo(p)
@@ -211,18 +278,26 @@ def _resident_dist_kernel(nblocks, check_every, n_shards, axis_name,
                                 dot_send, dot_recv)
                 state_i[1] = jnp.where((pap <= 0.0) & (rr > 0.0),
                                        jnp.int32(1), state_i[1])
-                alpha = _safe_div_f32(rr, pap)
+                alpha = _safe_div_f32(rho, pap)
                 x_ref[:] = x_ref[:] + alpha * p        # CUDACG.cu:314
                 r_new = r_ref[:] - alpha * ap          # CUDACG.cu:320-321
                 r_ref[:] = r_new
                 rr_new = allreduce(jnp.sum(r_new * r_new), rr_buf,
                                    dot_send, dot_recv)
-                beta = _safe_div_f32(rr_new, rr)       # CUDACG.cu:336-339
-                p_ref[:] = r_new + beta * p
-                return rr_new
+                if degree > 0:
+                    z_new = precond(r_new)
+                    rho_new = allreduce(jnp.sum(r_new * z_new), rho_buf,
+                                        dot_send, dot_recv)
+                else:
+                    z_new, rho_new = r_new, rr_new
+                beta = _safe_div_f32(rho_new, rho)     # CUDACG.cu:336-339
+                p_ref[:] = z_new + beta * p
+                return rr_new, rho_new
 
-            rr_out = lax.fori_loop(0, nsteps, one_iter, state_f[0])
+            rr_out, rho_out = lax.fori_loop(
+                0, nsteps, one_iter, (state_f[0], state_f[1]))
             state_f[0] = rr_out
+            state_f[1] = rho_out
             state_i[0] = state_i[0] + nsteps
         return carry
 
@@ -233,38 +308,59 @@ def _resident_dist_kernel(nblocks, check_every, n_shards, axis_name,
     indef_ref[0] = state_i[1]
     conv_ref[0] = ((state_f[0] < thresh2)
                    | (state_f[0] == 0.0)).astype(jnp.int32)
-    health_ref[0] = jnp.isfinite(state_f[0]).astype(jnp.int32)
+    health_ref[0] = (jnp.isfinite(state_f[0]) & jnp.isfinite(state_f[1])
+                     & ((state_f[1] > 0.0) | (state_f[0] == 0.0))
+                     ).astype(jnp.int32)
 
 
-def supports_resident_dist(local_shape, device=None) -> bool:
+def supports_resident_dist(local_shape, device=None,
+                           preconditioned: bool = False) -> bool:
     """Capacity/tiling gate for one shard's slab (the single-device
     resident gate on the LOCAL shape, plus one extra halo row-pair and
-    the dot-exchange buffers - negligible next to the planes)."""
+    the dot-exchange buffers - negligible next to the planes;
+    ``preconditioned`` adds the z plane + cheb transients, same
+    surcharge as the single-device gate)."""
     if len(local_shape) == 2:
-        return supports_resident_2d(*local_shape, device=device)
+        return supports_resident_2d(*local_shape, device=device,
+                                    preconditioned=preconditioned)
     if len(local_shape) == 3:
-        return supports_resident_3d(*local_shape, device=device)
+        return supports_resident_3d(*local_shape, device=device,
+                                    preconditioned=preconditioned)
     return False
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("local_shape", "n_shards", "axis_name", "maxiter",
-                     "check_every", "interpret", "detect_races"))
-def cg_resident_dist_local(scale, tol, rtol, cap, b_local, *, local_shape,
+                     "check_every", "interpret", "detect_races",
+                     "degree"))
+def cg_resident_dist_local(scale, tol, rtol, cap, b_local, lmin=None,
+                           lmax=None, *, local_shape,
                            n_shards, axis_name, maxiter, check_every,
-                           interpret=False, detect_races=False):
+                           interpret=False, detect_races=False,
+                           degree=0):
     """The per-shard pallas call (must run inside ``jax.shard_map`` over
     a 1-D mesh whose axis is ``axis_name``).  Returns the local x slab
-    plus the (replicated-by-construction) solve scalars."""
+    plus the (replicated-by-construction) solve scalars.
+
+    ``degree`` > 0 applies the degree-term in-kernel Chebyshev
+    polynomial on the spectral interval [``lmin``, ``lmax``] (traced
+    scalars) - each cheb step runs its own parity-double-buffered halo
+    exchange; no extra allreduces beyond the per-iteration
+    ``rho = r . z``.
+    """
     nblocks = -(-maxiter // check_every)
     params = jnp.stack([jnp.asarray(scale, jnp.float32),
                         jnp.asarray(tol, jnp.float32),
-                        jnp.asarray(rtol, jnp.float32)])
+                        jnp.asarray(rtol, jnp.float32),
+                        jnp.asarray(0.0 if lmin is None else lmin,
+                                    jnp.float32),
+                        jnp.asarray(1.0 if lmax is None else lmax,
+                                    jnp.float32)])
     cap_arr = jnp.asarray(cap, jnp.int32).reshape(1)
     kernel = functools.partial(_resident_dist_kernel, nblocks,
                                check_every, n_shards, axis_name,
-                               local_shape)
+                               local_shape, degree)
     vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     if interpret:
@@ -315,11 +411,19 @@ def cg_resident_dist_local(scale, tol, rtol, cap, b_local, *, local_shape,
             pltpu.SemaphoreType.DMA((2,)),                    # halo recv
             pltpu.SemaphoreType.DMA((max(n_shards - 1, 1),)),  # dot send
             pltpu.SemaphoreType.DMA((max(n_shards - 1, 1),)),  # dot recv
-        ],
+        ] + ([
+            pltpu.VMEM(local_shape, jnp.float32),             # z (cheb)
+            pltpu.VMEM((32 if len(local_shape) == 2 else 4,)
+                       + local_shape[1:], jnp.float32),  # z halo x parity
+            pltpu.VMEM((n_shards, _DOT_LANES), jnp.float32),  # rho rows
+            pltpu.SemaphoreType.DMA((4,)),                    # z send
+            pltpu.SemaphoreType.DMA((4,)),                    # z recv
+        ] if degree > 0 else []),
         # no collective_id: the kernel uses no barrier semaphore (the
         # per-iteration allreduces are the synchronization points)
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=10 * math.prod(local_shape) * 4 + (1 << 22)),
+            vmem_limit_bytes=(13 if degree > 0 else 10)
+            * math.prod(local_shape) * 4 + (8 << 20)),
         interpret=interpret_mode,
     )(params, cap_arr, b_local)
     return x, iters[0], rr[0], indef[0], conv[0], health[0]

@@ -52,6 +52,7 @@ def solve_distributed_resident(
     maxiter: int = 2000,
     check_every: int = 32,
     iter_cap=None,
+    m=None,
     detect_races: bool = False,
 ) -> CGResult:
     """Solve ``A x = b`` with one VMEM-resident kernel launch per chip.
@@ -59,10 +60,14 @@ def solve_distributed_resident(
     ``a``: global f32 ``Stencil2D``/``Stencil3D`` whose leading grid
     axis divides the mesh and whose PER-SHARD slab passes the resident
     capacity gate (each chip pins its slab's working set in VMEM).
-    Unpreconditioned ``method="cg"``, x0 = 0 - the prototype scope;
-    other solves route through ``solve_distributed`` /
-    ``solve_distributed_streaming``.  Returns a ``CGResult`` with the
-    global (sharded) solution.
+    ``method="cg"``, x0 = 0; ``m`` accepts ``None`` or a
+    ``ChebyshevPreconditioner`` built over THIS operator (the
+    single-device resident contract): the polynomial runs IN-KERNEL
+    per shard, each cheb step exchanging z-halos over remote DMA -
+    degree-1 extra stencil applies + exchanges and ONE extra allreduce
+    (rho = r . z) per iteration.  Other solves route through
+    ``solve_distributed`` / ``solve_distributed_streaming``.  Returns
+    a ``CGResult`` with the global (sharded) solution.
     """
     if mesh is None:
         mesh = make_mesh(n_devices)
@@ -84,7 +89,32 @@ def solve_distributed_resident(
             f"leading grid axis {grid[0]} does not divide over "
             f"{n_shards} shards")
     local_shape = (grid[0] // n_shards,) + grid[1:]
-    if not supports_resident_dist(local_shape):
+    degree = 0
+    lmin = lmax = jnp.zeros((), jnp.float32)
+    if m is not None:
+        from ..models.precond import ChebyshevPreconditioner
+        from ..solver.resident import _chebyshev_match_status
+
+        if not isinstance(m, ChebyshevPreconditioner):
+            raise TypeError(
+                f"solve_distributed_resident supports m=None or a "
+                f"ChebyshevPreconditioner (applied in-kernel), got "
+                f"{type(m).__name__}")
+        status = _chebyshev_match_status(a, m)
+        if status == "unverifiable":
+            raise ValueError(
+                "under jit, build the ChebyshevPreconditioner over the "
+                "SAME operator instance passed to "
+                "solve_distributed_resident")
+        if status == "mismatch":
+            raise ValueError(
+                "the ChebyshevPreconditioner must be built over the "
+                "same stencil operator being solved (same grid and "
+                "same scale)")
+        degree = int(m.degree)
+        lmin = jnp.asarray(m.lmin, jnp.float32)
+        lmax = jnp.asarray(m.lmax, jnp.float32)
+    if not supports_resident_dist(local_shape, preconditioned=degree > 0):
         raise ValueError(
             f"per-shard slab {local_shape} fails the resident gate "
             f"(tiling: 2D nx % 8 == 0 and ny % 128 == 0, 3D ny % 8 == 0 "
@@ -95,33 +125,35 @@ def solve_distributed_resident(
     interpret = _pallas_interpret()
 
     key = ("resident_dist", local_shape, n_shards, axis, mesh, maxiter,
-           check_every, interpret, detect_races)
+           check_every, interpret, detect_races, degree)
     fn = _CACHE.get(key)
     if fn is None:
         fn = _CACHE[key] = jax.jit(_build(
             mesh, axis, n_shards, local_shape, maxiter, check_every,
-            interpret, detect_races))
+            interpret, detect_races, degree))
     cap = maxiter if iter_cap is None else iter_cap
     return fn(b, a.scale, jnp.asarray(tol, jnp.float32),
-              jnp.asarray(rtol, jnp.float32), jnp.asarray(cap, jnp.int32))
+              jnp.asarray(rtol, jnp.float32), jnp.asarray(cap, jnp.int32),
+              lmin, lmax)
 
 
 def _build(mesh, axis, n_shards, local_shape, maxiter, check_every,
-           interpret, detect_races=False):
+           interpret, detect_races=False, degree=0):
     out_specs = CGResult(
         x=P(axis), iterations=P(), residual_norm=P(), converged=P(),
         status=P(), indefinite=P(), residual_history=None)
 
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(P(axis), P(), P(), P(), P()),
+             in_specs=(P(axis), P(), P(), P(), P(), P(), P()),
              out_specs=out_specs, check_vma=False)
-    def run(b_local, scale, tol, rtol, cap):
+    def run(b_local, scale, tol, rtol, cap, lmin, lmax):
         b_grid = b_local.reshape(local_shape)
         x, iters, rr, indef, conv, health = cg_resident_dist_local(
-            scale, tol, rtol, cap, b_grid, local_shape=local_shape,
+            scale, tol, rtol, cap, b_grid, lmin, lmax,
+            local_shape=local_shape,
             n_shards=n_shards, axis_name=axis, maxiter=maxiter,
             check_every=check_every, interpret=interpret,
-            detect_races=detect_races)
+            detect_races=detect_races, degree=degree)
         healthy = health > 0
         converged = conv > 0
         status = jnp.where(

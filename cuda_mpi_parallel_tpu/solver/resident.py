@@ -47,9 +47,11 @@ def supports_resident(a, preconditioned: bool = False,
                       cg1: bool = False) -> bool:
     """True if ``cg_resident`` can run this operator (see module scope).
 
-    ``preconditioned`` budgets the in-kernel Chebyshev recurrence's two
-    extra transient planes; ``warm_start`` budgets the pinned x0 plane;
-    ``cg1`` the single-reduction recurrence's s/w planes.
+    ``preconditioned`` budgets the in-kernel Chebyshev recurrence's
+    extra planes (a MEASURED 6-plane surcharge - see ``_extra_planes``;
+    13 planes total with the base bound); ``warm_start`` budgets the
+    pinned x0 plane; ``cg1`` the single-reduction recurrence's s/w
+    planes.
     """
     if isinstance(a, Stencil2D):
         if a.dtype != jnp.float32:
