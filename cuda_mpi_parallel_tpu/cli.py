@@ -318,11 +318,12 @@ def main(argv=None) -> int:
         desc += f" [{args.fmt}]"
 
     if args.engine == "resident":
-        if args.mesh > 1 and (args.precond is not None
+        if args.mesh > 1 and (args.precond not in (None, "chebyshev")
                               or args.method != "cg" or args.df64):
             raise SystemExit("--engine resident with --mesh > 1 runs the "
                              "distributed one-kernel-per-chip solve: "
-                             "unpreconditioned f32 --method cg only")
+                             "f32 --method cg with --precond chebyshev "
+                             "or none")
         if (args.precond not in (None, "chebyshev")
                 or args.method not in ("cg", "cg1")
                 or (args.method == "cg1" and args.precond is not None)):
@@ -422,15 +423,21 @@ def main(argv=None) -> int:
                     "--mesh > 1 supports CSR and stencil problems only")
             if args.engine == "resident":
                 # the one-kernel-per-chip distributed resident solve
-                # (in-kernel RDMA halos + allreduces); scope enforced
-                # by the engine gate above
+                # (in-kernel RDMA halos + allreduces, in-kernel
+                # Chebyshev); scope enforced by the engine gate above
                 from .parallel import solve_distributed_resident
 
+                m_dr = None
+                if args.precond == "chebyshev":
+                    from .models.precond import ChebyshevPreconditioner
+
+                    m_dr = ChebyshevPreconditioner.from_operator(
+                        a, degree=args.precond_degree)
                 try:
                     return solve_distributed_resident(
                         a, b, mesh=make_mesh(args.mesh), tol=args.tol,
                         rtol=args.rtol, maxiter=args.maxiter,
-                        check_every=args.check_every)
+                        check_every=args.check_every, m=m_dr)
                 except (TypeError, ValueError) as e:
                     raise SystemExit(f"--engine resident --mesh "
                                      f"{args.mesh}: {e}")
