@@ -768,11 +768,19 @@ _PLANES_BOUND_DF64 = 27
 
 
 def _extra_planes_df64(preconditioned: bool) -> int:
-    """df64 plane surcharge: the in-kernel Chebyshev recurrence carries
-    z/d as hi/lo pairs (~4 transient planes).  Gates and the kernel's
+    """df64 plane surcharge for the in-kernel Chebyshev recurrence.
+
+    MEASURED 14, not the hand-modeled 4: at 512^2 Mosaic's scoped
+    allocation for the df64 cheb kernel is 44.69 MB = ~41.7
+    plane-equivalents (round 5, on-chip) - the EFT z/d hi/lo recurrence
+    keeps far more transients live across the in-loop df64 stencils
+    than the pair-count suggests.  27 + 14 = 41 covers it; the gate
+    ceiling this implies (~800k cells on a 128 MiB part) is
+    probe-verified at its boundary like the f32 gates
+    (tools/capacity_probe_r05.json).  Gates and the kernel's
     ``vmem_limit_bytes`` share this function (same invariant as
     ``_extra_planes``)."""
-    return 4 if preconditioned else 0
+    return 14 if preconditioned else 0
 
 
 def supports_resident_df64_2d(nx: int, ny: int, device=None,
