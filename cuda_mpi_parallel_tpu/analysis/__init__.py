@@ -1,0 +1,90 @@
+"""graftlint: static analysis for Pallas kernels and collectives.
+
+The defect classes that only fail on real chips - Mosaic sublane-tiling
+violations, VMEM-budget overruns, collective-axis mismatches,
+unbalanced DMA start/wait pairs, host syncs inside traced loops - are
+statically decidable on this codebase's idioms.  This package decides
+them before a capacity probe burns hardware time:
+
+===== ================== ========================================
+id    name               catches
+===== ================== ========================================
+GL101 mosaic-tiling      sub-8-row dim-0 DMA slices at dynamic
+                         offsets (the round-5 allreduce bug)
+GL102 vmem-budget        vmem_limit_bytes not provably within the
+                         physical VMEM ceiling; scratch > limit
+GL103 collective-safety  literal psum/ppermute axes not declared
+                         by any mesh; duplicate ppermute dest/src
+GL104 dma-pairing        .start() without .wait() (named or
+                         module-balanced anonymous descriptors);
+                         remote copies without send+recv sems
+GL105 host-sync          float()/bool()/.item()/np coercions in
+                         lax loop and branch bodies
+===== ================== ========================================
+
+Usage::
+
+    python -m cuda_mpi_parallel_tpu.analysis cuda_mpi_parallel_tpu/
+    python -m cuda_mpi_parallel_tpu.cli lint cuda_mpi_parallel_tpu/
+
+    from cuda_mpi_parallel_tpu.analysis import lint_paths
+    diags = lint_paths(["cuda_mpi_parallel_tpu"])
+
+Suppressions: ``# graftlint: disable=mosaic-tiling`` on (or one line
+above) the offending line; ``disable=all``; file-wide
+``# graftlint: disable-file=RULE``.  See README "graftlint".
+
+This top-level module is importable WITHOUT jax (pure-ast linting);
+the jaxpr- and runtime-level checks live in ``analysis.jaxpr`` and
+``analysis.runtime`` and import jax lazily (``check_races`` et al are
+also reachable from here via module ``__getattr__``).
+"""
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    Diagnostic,
+    REGISTRY,
+    Rule,
+    Severity,
+    all_rules,
+    resolve_rules,
+)
+from .engine import (  # noqa: F401
+    lint_file,
+    lint_paths,
+    lint_source,
+    max_severity,
+)
+# Importing the rule modules populates the registry.
+from . import (  # noqa: F401
+    rules_collective,
+    rules_dma,
+    rules_hostsync,
+    rules_tiling,
+    rules_vmem,
+)
+
+_LAZY_RUNTIME = {"check_races", "reset_races", "RaceReport",
+                 "RaceDetectorUnavailable"}
+_LAZY_JAXPR = {"collective_axes", "check_collective_axes"}
+
+
+def __getattr__(name: str):
+    """Lazy bridge to the jax-importing halves of the package."""
+    if name in _LAZY_RUNTIME:
+        from . import runtime
+
+        return getattr(runtime, name)
+    if name in _LAZY_JAXPR:
+        from . import jaxpr
+
+        return getattr(jaxpr, name)
+    raise AttributeError(name)
+
+
+__all__ = [
+    "Diagnostic", "REGISTRY", "Rule", "Severity", "all_rules",
+    "resolve_rules", "lint_file", "lint_paths", "lint_source",
+    "max_severity",
+    *sorted(_LAZY_RUNTIME), *sorted(_LAZY_JAXPR),
+]

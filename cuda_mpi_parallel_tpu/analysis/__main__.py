@@ -1,0 +1,76 @@
+"""graftlint CLI: ``python -m cuda_mpi_parallel_tpu.analysis [paths]``.
+
+Also mounted as the ``lint`` subcommand of the package CLI
+(``python -m cuda_mpi_parallel_tpu.cli lint ...``) and driven by
+``tools/lint.sh`` as the pre-hardware gate.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .core import Severity, all_rules
+from .engine import lint_paths, max_severity
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cuda_mpi_parallel_tpu.analysis",
+        description=("graftlint: static analysis for Pallas/Mosaic "
+                     "tiling, VMEM budgets, collective safety, DMA "
+                     "pairing and host-sync bugs"))
+    p.add_argument("paths", nargs="*", default=["cuda_mpi_parallel_tpu"],
+                   help="files or directories to lint (default: the "
+                        "package)")
+    p.add_argument("--select", default=None, metavar="RULES",
+                   help="comma-separated rule ids/names to run "
+                        "(default: all)")
+    p.add_argument("--ignore", default=None, metavar="RULES",
+                   help="comma-separated rule ids/names to skip")
+    p.add_argument("--fail-on", default="warning",
+                   choices=["info", "warning", "error"],
+                   help="exit nonzero when any diagnostic at or above "
+                        "this severity is found (default: warning)")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON array instead of text lines")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def _split(spec: Optional[str]) -> Optional[List[str]]:
+    if not spec:
+        return None
+    return [t for t in (s.strip() for s in spec.split(",")) if t]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name:<18} "
+                  f"{rule.severity.name.lower():<7} {rule.description}")
+        return 0
+    try:
+        diags = lint_paths(args.paths, select=_split(args.select),
+                           ignore=_split(args.ignore))
+    except (FileNotFoundError, ValueError) as e:
+        print(f"graftlint: error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps([d.to_json() for d in diags], indent=2))
+    else:
+        for d in diags:
+            print(d.format())
+        if diags:
+            print(f"graftlint: {len(diags)} finding(s)")
+    worst = max_severity(diags)
+    if worst is not None and worst >= Severity.parse(args.fail_on):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,314 @@
+"""graftlint core: diagnostics, the rule registry, and suppressions.
+
+The static-analysis counterpart of the capacity probe: the defect
+classes that killed (or nearly killed) round-5 hardware runs - Mosaic
+sublane-tiling violations, VMEM-budget overruns, collective-axis
+mismatches, unbalanced DMA start/wait pairs, host-sync stalls inside
+traced loops - are all *statically decidable* on this codebase's
+idioms.  graftlint decides them at review time instead of at
+kernel-launch time on a real chip.
+
+Architecture: one :class:`Rule` per defect class, registered in
+``REGISTRY`` by both a stable id (``GL101``) and a human name
+(``mosaic-tiling``).  ``engine.lint_paths`` parses each file once into
+a :class:`LintContext` (AST + module-constant environment + pallas
+import detection) and hands it to every selected rule; rules yield
+:class:`Diagnostic` records which the engine filters through the
+per-file :class:`SuppressionIndex` (``# graftlint: disable=RULE``).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import enum
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Set
+
+
+class Severity(enum.IntEnum):
+    """Ordered so ``--fail-on`` thresholds compare directly."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    @classmethod
+    def parse(cls, s: str) -> "Severity":
+        try:
+            return cls[s.upper()]
+        except KeyError:
+            raise ValueError(
+                f"unknown severity {s!r} (expected one of "
+                f"{[m.name.lower() for m in cls]})") from None
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Diagnostic:
+    """One finding, anchored to a file/line like a compiler error."""
+
+    path: str
+    line: int
+    col: int
+    rule_id: str
+    rule_name: str
+    severity: Severity
+    message: str
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.severity.name.lower()} {self.rule_id} "
+                f"[{self.rule_name}] {self.message}")
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["severity"] = self.severity.name.lower()
+        return d
+
+
+# --------------------------------------------------------------------------
+# Suppressions
+# --------------------------------------------------------------------------
+
+#: ``# graftlint: disable=RULE[,RULE...]`` - suppresses the named rules on
+#: the comment's own line AND the immediately following line (so a comment
+#: placed above a multi-line expression covers the anchor line below it).
+#: ``disable=all`` suppresses every rule; ``disable-file=RULE`` anywhere in
+#: the file suppresses the rule file-wide.
+_DISABLE_RE = re.compile(
+    r"#\s*graftlint:\s*disable\s*=\s*([A-Za-z0-9_\-]+(?:\s*,\s*"
+    r"[A-Za-z0-9_\-]+)*)")
+_DISABLE_FILE_RE = re.compile(
+    r"#\s*graftlint:\s*disable-file\s*=\s*([A-Za-z0-9_\-]+(?:\s*,\s*"
+    r"[A-Za-z0-9_\-]+)*)")
+
+
+def _tokens(spec: str) -> Set[str]:
+    return {t.strip().lower() for t in spec.split(",") if t.strip()}
+
+
+class SuppressionIndex:
+    """Per-file map of suppressed (line, rule) pairs parsed from comments."""
+
+    def __init__(self, source: str):
+        self.file_level: Set[str] = set()
+        self.by_line: Dict[int, Set[str]] = {}
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            m = _DISABLE_FILE_RE.search(text)
+            if m:
+                self.file_level |= _tokens(m.group(1))
+                continue
+            m = _DISABLE_RE.search(text)
+            if m:
+                toks = _tokens(m.group(1))
+                self.by_line.setdefault(lineno, set()).update(toks)
+                # only a STANDALONE comment reaches down to the next
+                # line; a trailing comment scopes to its own code line
+                if text.lstrip().startswith("#"):
+                    self.by_line.setdefault(
+                        lineno + 1, set()).update(toks)
+
+    def suppressed(self, line: int, rule: "Rule") -> bool:
+        keys = {"all", rule.id.lower(), rule.name.lower()}
+        if self.file_level & keys:
+            return True
+        return bool(self.by_line.get(line, set()) & keys)
+
+
+# --------------------------------------------------------------------------
+# AST helpers shared by every rule
+# --------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``pltpu.make_async_remote_copy`` for an Attribute chain, ``psum``
+    for a bare Name; None for anything dynamic (subscripts, calls)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_final_name(call: ast.Call) -> Optional[str]:
+    """Last dotted segment of a call's callee (``make_async_copy`` for
+    ``pltpu.make_async_copy(...)``), or None if dynamic."""
+    name = dotted_name(call.func)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def const_int(node: ast.AST, env: Optional[Dict[str, int]] = None
+              ) -> Optional[int]:
+    """Fold ``node`` to an int using module-level constants in ``env``.
+
+    Supports the arithmetic this codebase writes in shape/offset
+    expressions (+, -, *, //, %, **, <<, >>, unary +/-); returns None
+    for anything not statically known.
+    """
+    env = env or {}
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, int) \
+            and not isinstance(node.value, bool) else None
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.UnaryOp):
+        v = const_int(node.operand, env)
+        if v is None:
+            return None
+        if isinstance(node.op, ast.USub):
+            return -v
+        if isinstance(node.op, ast.UAdd):
+            return v
+        return None
+    if isinstance(node, ast.BinOp):
+        lhs = const_int(node.left, env)
+        rhs = const_int(node.right, env)
+        if lhs is None or rhs is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return lhs + rhs
+            if isinstance(node.op, ast.Sub):
+                return lhs - rhs
+            if isinstance(node.op, ast.Mult):
+                return lhs * rhs
+            if isinstance(node.op, ast.FloorDiv):
+                return lhs // rhs
+            if isinstance(node.op, ast.Mod):
+                return lhs % rhs
+            if isinstance(node.op, ast.Pow):
+                return lhs ** rhs if abs(rhs) < 64 else None
+            if isinstance(node.op, ast.LShift):
+                return lhs << rhs if 0 <= rhs < 64 else None
+            if isinstance(node.op, ast.RShift):
+                return lhs >> rhs if 0 <= rhs < 64 else None
+        except (ZeroDivisionError, ValueError, OverflowError):
+            return None
+    return None
+
+
+def module_const_env(tree: ast.Module) -> Dict[str, int]:
+    """Module-level ``NAME = <int expr>`` bindings, folded iteratively so
+    later constants may reference earlier ones (``_HALO = 8`` style)."""
+    env: Dict[str, int] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            v = const_int(stmt.value, env)
+            if v is not None:
+                env[stmt.targets[0].id] = v
+    return env
+
+
+def imports_pallas(tree: ast.Module) -> bool:
+    """True if the module imports jax.experimental.pallas (directly or
+    ``from jax.experimental import pallas``) - the scope gate for the
+    kernel-level rules, so pure-XLA modules never pay their walk."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any("pallas" in a.name for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if "pallas" in mod:
+                return True
+            if any("pallas" in a.name for a in node.names):
+                return True
+    return False
+
+
+class LintContext:
+    """Everything a rule needs about one file, parsed once."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.suppressions = SuppressionIndex(source)
+        self.consts = module_const_env(tree)
+        self.has_pallas = imports_pallas(tree)
+        #: every function def in the file, nested included, each exactly
+        #: once (rules that need per-scope accounting iterate this)
+        self.function_nodes = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        #: name -> def for by-name resolution (nested defs shadow by
+        #: name; last def wins, like runtime rebinding)
+        self.functions: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in self.function_nodes}
+
+
+# --------------------------------------------------------------------------
+# Rules
+# --------------------------------------------------------------------------
+
+class Rule:
+    """One statically-decidable defect class.
+
+    Subclasses set ``id``/``name``/``severity``/``description`` and
+    implement :meth:`check` as a generator of Diagnostics (use
+    :meth:`diag` to build them so id/severity stay consistent).
+    """
+
+    id: str = "GL000"
+    name: str = "unnamed"
+    severity: Severity = Severity.ERROR
+    description: str = ""
+
+    def check(self, ctx: LintContext) -> Iterator[Diagnostic]:
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+    def diag(self, ctx: LintContext, node: ast.AST, message: str,
+             severity: Optional[Severity] = None) -> Diagnostic:
+        return Diagnostic(
+            path=ctx.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            rule_id=self.id, rule_name=self.name,
+            severity=self.severity if severity is None else severity,
+            message=message)
+
+
+REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and index by id and name."""
+    rule = cls()
+    for key in (rule.id.lower(), rule.name.lower()):
+        if key in REGISTRY:
+            raise ValueError(f"duplicate rule key {key!r}")
+        REGISTRY[key] = rule
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    seen: Dict[str, Rule] = {}
+    for rule in REGISTRY.values():
+        seen.setdefault(rule.id, rule)
+    return sorted(seen.values(), key=lambda r: r.id)
+
+
+def resolve_rules(select: Optional[Iterable[str]] = None,
+                  ignore: Optional[Iterable[str]] = None) -> List[Rule]:
+    """Rule set for a run: ``select`` limits to the named rules (ids or
+    names), ``ignore`` drops from whatever is selected."""
+    def lookup(token: str) -> Rule:
+        rule = REGISTRY.get(token.strip().lower())
+        if rule is None:
+            known = ", ".join(sorted({r.id for r in REGISTRY.values()}))
+            raise ValueError(f"unknown rule {token!r} (known: {known})")
+        return rule
+
+    rules = ([lookup(t) for t in select] if select else all_rules())
+    if ignore:
+        dropped = {lookup(t).id for t in ignore}
+        rules = [r for r in rules if r.id not in dropped]
+    # de-dup, stable order
+    out: Dict[str, Rule] = {}
+    for r in rules:
+        out.setdefault(r.id, r)
+    return list(out.values())

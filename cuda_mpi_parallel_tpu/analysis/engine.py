@@ -1,0 +1,93 @@
+"""graftlint engine: walk files, run rules, filter suppressions.
+
+Pure stdlib (ast + re): linting the package must not import jax, so it
+runs in any environment - CI boxes without accelerators, pre-commit
+hooks, the container that only has the toolchain.  The jaxpr-level and
+runtime checks (``analysis.jaxpr``, ``analysis.runtime``) import jax
+lazily and are deliberately NOT reachable from this module.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Sequence
+
+from .core import (
+    Diagnostic,
+    LintContext,
+    Rule,
+    Severity,
+    resolve_rules,
+)
+
+#: Directory basenames never descended into.
+EXCLUDED_DIRS = {"__pycache__", ".git", ".venv", "venv", "node_modules",
+                 "build", "dist", ".eggs"}
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted list of .py files."""
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in EXCLUDED_DIRS
+                                 and not d.startswith("."))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+        else:
+            raise FileNotFoundError(path)
+    return out
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Iterable[Rule]] = None
+                ) -> List[Diagnostic]:
+    """Lint one source string (the unit tests' entry point)."""
+    rules = list(rules) if rules is not None else resolve_rules()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic(
+            path=path, line=e.lineno or 1, col=(e.offset or 0) + 1,
+            rule_id="GL000", rule_name="syntax-error",
+            severity=Severity.ERROR,
+            message=f"file does not parse: {e.msg}")]
+    ctx = LintContext(path, source, tree)
+    diags: List[Diagnostic] = []
+    for rule in rules:
+        for d in rule.check(ctx):
+            if not ctx.suppressions.suppressed(d.line, rule):
+                diags.append(d)
+    return sorted(diags)
+
+
+def lint_file(path: str, rules: Optional[Iterable[Rule]] = None
+              ) -> List[Diagnostic]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, path=path, rules=rules)
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Iterable[str]] = None,
+               ignore: Optional[Iterable[str]] = None
+               ) -> List[Diagnostic]:
+    """Lint files/trees; the ``python -m cuda_mpi_parallel_tpu.analysis``
+    entry point under the CLI flags."""
+    rules = resolve_rules(select=select, ignore=ignore)
+    diags: List[Diagnostic] = []
+    for path in iter_python_files(paths):
+        diags.extend(lint_file(path, rules=rules))
+    return sorted(diags)
+
+
+def max_severity(diags: Iterable[Diagnostic]) -> Optional[Severity]:
+    worst = None
+    for d in diags:
+        if worst is None or d.severity > worst:
+            worst = d.severity
+    return worst

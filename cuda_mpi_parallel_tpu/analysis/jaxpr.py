@@ -1,0 +1,70 @@
+"""Jaxpr-level collective checks: what the AST cannot see.
+
+The AST rules (``rules_collective``) can only check axis names written
+as literals; this codebase threads them dynamically
+(``mesh.axis_names[0]`` -> ``shard_map`` -> solver kwargs), so the
+authoritative check happens after tracing, where every ``psum``/
+``ppermute`` equation carries its resolved axis names as primitive
+params.  ``collective_axes`` walks a (closed) jaxpr - including every
+sub-jaxpr of ``while``/``cond``/``scan``/``pjit``/custom-call
+equations - and returns the axis names actually used;
+``check_collective_axes`` diffs them against a mesh's declared axes.
+
+Imports jax lazily so ``analysis`` stays importable (and lintable)
+without an accelerator stack.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Set
+
+#: Primitive params that carry collective axis names, by param key.
+_AXIS_PARAM_KEYS = ("axes", "axis_name", "axis_index_groups_axis")
+
+
+def _axis_names_of_eqn(eqn) -> Set[str]:
+    names: Set[str] = set()
+    for key in _AXIS_PARAM_KEYS:
+        val = eqn.params.get(key)
+        if val is None:
+            continue
+        if isinstance(val, str):
+            names.add(val)
+        elif isinstance(val, (tuple, list)):
+            names.update(v for v in val if isinstance(v, str))
+    return names
+
+
+def _subjaxprs(params: dict):
+    """Every jaxpr-valued (or jaxpr-containing) primitive param."""
+    import jax.core as jcore
+
+    for val in params.values():
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for v in vals:
+            if isinstance(v, jcore.ClosedJaxpr):
+                yield v.jaxpr
+            elif isinstance(v, jcore.Jaxpr):
+                yield v
+
+
+def collective_axes(jaxpr) -> Set[str]:
+    """Axis names used by any collective in ``jaxpr`` (closed or open),
+    recursively through control-flow and call sub-jaxprs."""
+    import jax.core as jcore
+
+    if isinstance(jaxpr, jcore.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    names: Set[str] = set()
+    for eqn in jaxpr.eqns:
+        names |= _axis_names_of_eqn(eqn)
+        for sub in _subjaxprs(eqn.params):
+            names |= collective_axes(sub)
+    return names
+
+
+def check_collective_axes(jaxpr, mesh_axes: Iterable[str]) -> List[str]:
+    """Axis names ``jaxpr`` reduces/permutes over that ``mesh_axes``
+    does not declare (empty list = safe).  ``mesh_axes`` accepts a
+    ``jax.sharding.Mesh`` or any iterable of names."""
+    declared = set(getattr(mesh_axes, "axis_names", mesh_axes))
+    return sorted(collective_axes(jaxpr) - declared)

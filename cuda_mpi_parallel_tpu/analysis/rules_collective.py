@@ -1,0 +1,188 @@
+"""GL103 collective-safety: axis names and permutation well-formedness.
+
+A ``lax.psum``/``lax.ppermute`` over an axis name no enclosing mesh
+defines is a trace-time error on hardware meshes and - worse - a
+silently *wrong answer* when the typo'd name happens to match a
+different axis of a 2-D mesh (pencil decompositions: summing over
+"rows" when the partials are split over "cols" double-counts).  A
+ppermute whose permutation list sends two sources to one destination
+is undefined (last-writer-wins on real ICI, nondeterministic in the
+simulator).
+
+Static scope: axis names in this codebase are mostly *dynamic*
+(``mesh.axis_names[0]`` threaded through ``shard_map``), which is
+unverifiable and therefore trusted.  The rule checks what IS written
+down:
+
+* a **string-literal** axis passed to a collective must appear among
+  the file's declared axis names - collected from ``Mesh(...,
+  (names,))``/``axis_names=...`` tuples, any ``axis_name="..."``
+  keyword or function default, and module constants whose name
+  mentions AXIS.  Files that declare no axis literal at all are
+  skipped (a library function taking the caller's axis cannot be
+  checked).
+* a **literal** ``perm=[(s, d), ...]`` list must have unique sources
+  and unique destinations; comprehension-built rings are trusted.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from .core import (
+    Diagnostic,
+    LintContext,
+    Rule,
+    call_final_name,
+    register,
+)
+
+#: Collectives whose 2nd positional arg (or ``axis_name=``) is the axis.
+COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_gather", "all_to_all", "psum_scatter", "axis_index",
+    "axis_size",
+}
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def declared_axes(ctx: LintContext) -> Set[str]:
+    """Every axis name the file declares (see module docstring)."""
+    axes: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        # axis_name="rows" / axis_names=("rows", "cols") keywords
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis_names"):
+                    axes |= _axis_literals(kw.value)
+            # Mesh(devices, ("rows",)) - 2nd positional arg
+            if call_final_name(node) == "Mesh" and len(node.args) >= 2:
+                axes |= _axis_literals(node.args[1])
+        # def f(..., axis_name="rows"): declares a default axis
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            names = [a.arg for a in args.args][-len(args.defaults):] \
+                if args.defaults else []
+            for argname, default in zip(names, args.defaults):
+                if "axis" in argname:
+                    axes |= _axis_literals(default)
+            for kwarg, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is not None and "axis" in kwarg.arg:
+                    axes |= _axis_literals(default)
+        # ROWS_AXIS = "rows" style module constants
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and "axis" in node.targets[0].id.lower():
+            axes |= _axis_literals(node.value)
+    return axes
+
+
+def _axis_literals(node: ast.AST) -> Set[str]:
+    """String literals in a name / tuple-of-names expression."""
+    out: Set[str] = set()
+    s = _str_const(node)
+    if s is not None:
+        out.add(s)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            s = _str_const(elt)
+            if s is not None:
+                out.add(s)
+    return out
+
+
+#: Collectives whose axis rides in the FIRST positional slot (the rest
+#: take (operand, axis_name, ...)).
+_AXIS_FIRST = {"axis_index", "axis_size"}
+
+
+def _collective_axis_arg(call: ast.Call,
+                         final: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            return kw.value
+    pos = 0 if final in _AXIS_FIRST else 1
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _literal_perm(call: ast.Call) -> Optional[List[Tuple[ast.AST, int, int]]]:
+    """``perm=[(0, 1), ...]`` as (node, src, dst) triples, or None when
+    the permutation is not a literal list of int pairs."""
+    perm_node = None
+    for kw in call.keywords:
+        if kw.arg == "perm":
+            perm_node = kw.value
+    if perm_node is None and len(call.args) >= 3:
+        perm_node = call.args[2]
+    if not isinstance(perm_node, (ast.List, ast.Tuple)):
+        return None
+    out = []
+    for elt in perm_node.elts:
+        if not (isinstance(elt, (ast.Tuple, ast.List))
+                and len(elt.elts) == 2):
+            return None
+        pair = []
+        for x in elt.elts:
+            if isinstance(x, ast.Constant) and isinstance(x.value, int):
+                pair.append(x.value)
+            else:
+                return None
+        out.append((elt, pair[0], pair[1]))
+    return out
+
+
+@register
+class CollectiveSafetyRule(Rule):
+    id = "GL103"
+    name = "collective-safety"
+    description = ("literal collective axis names must match a declared "
+                   "mesh axis; literal ppermute permutations must have "
+                   "unique sources and destinations")
+
+    def check(self, ctx: LintContext) -> Iterator[Diagnostic]:
+        axes = declared_axes(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            final = call_final_name(node)
+            if final not in COLLECTIVES:
+                continue
+            if axes:  # only checkable when the file declares axes
+                axis_arg = _collective_axis_arg(node, final)
+                if axis_arg is not None:
+                    for lit in sorted(_axis_literals(axis_arg)):
+                        if lit not in axes:
+                            yield self.diag(
+                                ctx, axis_arg,
+                                f"{final} over axis {lit!r}, but this "
+                                f"file only declares mesh axes "
+                                f"{sorted(axes)} - a mismatched name "
+                                f"fails at trace time (or silently "
+                                f"reduces over the wrong mesh axis)")
+            if final in ("ppermute", "pshuffle"):
+                perm = _literal_perm(node)
+                if perm is None:
+                    continue
+                seen_src: dict = {}
+                seen_dst: dict = {}
+                for elt, src, dst in perm:
+                    if src in seen_src:
+                        yield self.diag(
+                            ctx, elt,
+                            f"ppermute permutation lists source {src} "
+                            f"twice - each device can send at most once")
+                    if dst in seen_dst:
+                        yield self.diag(
+                            ctx, elt,
+                            f"ppermute permutation lists destination "
+                            f"{dst} twice - two sources racing into one "
+                            f"destination buffer is undefined")
+                    seen_src.setdefault(src, elt)
+                    seen_dst.setdefault(dst, elt)

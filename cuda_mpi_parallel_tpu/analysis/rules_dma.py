@@ -1,0 +1,186 @@
+"""GL104 dma-pairing: every DMA ``.start()`` needs a matching ``.wait()``.
+
+An unwaited async copy is a use-after-free in kernel time: the
+destination ref is read (or the source reused) while the transfer may
+still be in flight, and on real chips the semaphore the start
+incremented is never decremented - the NEXT kernel launch inherits a
+nonzero semaphore and deadlocks or corrupts.  The interpret-mode
+simulator only catches this when the reordering happens to bite during
+the simulated schedule; the pairing is decidable from the source.
+
+Two pairing disciplines exist in this codebase, both checked:
+
+* **named descriptors** (``resident_dist.py``): ``dma = make_async_*
+  (...)`` then ``dma.start()`` / ``dma.wait()``.  Within the enclosing
+  function, every name bound to a descriptor must have both a start
+  and a wait reachable by name - including through list indirection
+  (``dmas.append(dma)`` + ``for dma in dmas: dma.wait()``).
+* **anonymous re-materialized descriptors** (``stencil.py``):
+  ``make_async_copy(...).start()`` in one helper and an identically
+  shaped ``make_async_copy(...).wait()`` in a sibling helper.  Pairing
+  is cross-function by construction, so the rule checks the MODULE
+  balance: total anonymous starts must equal total anonymous waits.
+
+Plus a shape check on remote copies: ``make_async_remote_copy`` must
+be given distinct send and receive semaphores (>= 4 positional args or
+both ``send_sem``/``recv_sem`` keywords) - a single shared semaphore
+cannot balance across shards (the sender increments it locally, the
+receiver's copy increments it remotely: the count drifts by the
+send/recv asymmetry and the wait blocks forever on the slow side).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Set
+
+from .core import (
+    Diagnostic,
+    LintContext,
+    Rule,
+    call_final_name,
+    register,
+)
+from .rules_tiling import dma_callee_names
+
+
+def _method_target(call: ast.Call):
+    """For ``X.start()`` return ("start", X-node); else (None, None)."""
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in ("start", "wait"):
+        return call.func.attr, call.func.value
+    return None, None
+
+
+class _FunctionDMA(ast.NodeVisitor):
+    """Per-function start/wait accounting (does not descend into nested
+    function defs: each def is analyzed as its own scope)."""
+
+    def __init__(self, callees: Set[str]):
+        self.callees = callees
+        self.assigned: Dict[str, ast.AST] = {}   # name -> def site
+        self.started: Set[str] = set()
+        self.waited: Set[str] = set()
+        self.anon_starts: list = []
+        self.anon_waits: list = []
+        self.appends: Dict[str, Set[str]] = {}   # list name -> elt names
+        self.loop_aliases: Dict[str, str] = {}   # loop var -> list name
+        self._depth = 0
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        if self._depth == 0:
+            self._depth += 1
+            self.generic_visit(node)
+            self._depth -= 1
+        # nested defs: separate scope, skipped here
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):  # noqa: N802
+        if isinstance(node.value, ast.Call) \
+                and call_final_name(node.value) in self.callees:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.assigned[tgt.id] = node
+        self.generic_visit(node)
+
+    def visit_For(self, node):  # noqa: N802
+        if isinstance(node.target, ast.Name) \
+                and isinstance(node.iter, ast.Name):
+            self.loop_aliases[node.target.id] = node.iter.id
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802
+        method, target = _method_target(node)
+        if method is not None:
+            if isinstance(target, ast.Name):
+                (self.started if method == "start"
+                 else self.waited).add(target.id)
+            elif isinstance(target, ast.Call) \
+                    and call_final_name(target) in self.callees:
+                (self.anon_starts if method == "start"
+                 else self.anon_waits).append(node)
+        # dmas.append(dma): list indirection
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "append" \
+                and isinstance(node.func.value, ast.Name) \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Name):
+            self.appends.setdefault(
+                node.func.value.id, set()).add(node.args[0].id)
+        self.generic_visit(node)
+
+    def resolve(self):
+        """Credit start/wait seen on a list's loop variable to every
+        descriptor name appended to that list."""
+        for var, lst in self.loop_aliases.items():
+            elts = self.appends.get(lst, set())
+            if var in self.started:
+                self.started |= elts
+            if var in self.waited:
+                self.waited |= elts
+
+
+@register
+class DmaPairingRule(Rule):
+    id = "GL104"
+    name = "dma-pairing"
+    description = ("every make_async_* .start() must have a matching "
+                   ".wait(); remote copies need distinct send/recv "
+                   "semaphores")
+
+    def check(self, ctx: LintContext) -> Iterator[Diagnostic]:
+        if not ctx.has_pallas:
+            return
+        callees = dma_callee_names(ctx)
+        anon_starts = anon_waits = 0
+        first_anon = None
+        for fnode in ctx.function_nodes:
+            acct = _FunctionDMA(callees)
+            acct.visit(fnode)
+            acct.resolve()
+            for name, site in sorted(acct.assigned.items()):
+                started = name in acct.started
+                waited = name in acct.waited
+                if started and not waited:
+                    yield self.diag(
+                        ctx, site,
+                        f"DMA descriptor {name!r} is started but never "
+                        f"waited in {fnode.name!r}: the transfer may "
+                        f"still be in flight when its buffers are "
+                        f"reused, and its semaphore never rebalances")
+                elif waited and not started:
+                    yield self.diag(
+                        ctx, site,
+                        f"DMA descriptor {name!r} is waited but never "
+                        f"started in {fnode.name!r}: the wait blocks "
+                        f"forever (or consumes another copy's "
+                        f"semaphore increment)")
+            anon_starts += len(acct.anon_starts)
+            anon_waits += len(acct.anon_waits)
+            if first_anon is None and acct.anon_starts:
+                first_anon = acct.anon_starts[0]
+        if anon_starts != anon_waits:
+            anchor = first_anon if first_anon is not None else ctx.tree
+            yield self.diag(
+                ctx, anchor if hasattr(anchor, "lineno") else ctx.tree,
+                f"module issues {anon_starts} anonymous DMA .start() "
+                f"call(s) but {anon_waits} .wait() call(s): "
+                f"re-materialized descriptors must balance module-wide "
+                f"(the stencil.py copy/wait-helper discipline)")
+        # remote copies must carry distinct send/recv semaphores
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_final_name(node)
+                    == "make_async_remote_copy"):
+                continue
+            kwnames = {kw.arg for kw in node.keywords}
+            sem_kw = {"send_sem", "recv_sem"} & kwnames
+            if len(node.args) >= 4 or len(sem_kw) == 2 \
+                    or len(node.args) == 3 and sem_kw:
+                continue
+            yield self.diag(
+                ctx, node,
+                "make_async_remote_copy without distinct send and recv "
+                "semaphores: a shared semaphore cannot balance across "
+                "shards (local start-increments race the remote "
+                "completion-increments)")

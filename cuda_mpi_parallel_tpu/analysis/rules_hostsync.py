@@ -1,0 +1,165 @@
+"""GL105 host-sync: no host coercion of traced values inside traced loops.
+
+``float(x)`` / ``bool(x)`` / ``x.item()`` / ``np.asarray(x)`` on a
+traced value inside a ``lax.while_loop``/``fori_loop``/``scan``/
+``cond`` body either raises a ConcretizationTypeError at trace time
+or - the insidious form, when the value happens to be concrete during
+tracing - silently bakes one iteration's value into the compiled loop.
+Either way the intent was a device value and the effect is a host
+sync (or a frozen constant).  The solver hot loops in ``solver/`` and
+``parallel/`` keep every convergence predicate on device for exactly
+this reason (the reference's host-side ``while`` with a cudaMemcpy'd
+scalar per iteration is the anti-pattern, SURVEY "convergence").
+
+Detection: functions passed as loop/branch bodies to ``lax.while_loop``
+/ ``lax.fori_loop`` / ``lax.scan`` / ``lax.cond`` / ``lax.switch``
+(by name, lambda, or ``functools.partial(f, ...)``), plus ``pl.when``-
+decorated kernel sub-blocks, are *traced bodies*.  Inside them - and
+inside defs nested in them - the rule flags:
+
+* builtin coercions ``float``/``int``/``bool``/``complex`` whose
+  argument is not a compile-time constant,
+* ``.item()`` / ``.tolist()`` method calls,
+* ``np.asarray`` / ``np.array`` / ``numpy.*`` coercions.
+
+Host-level code (result wrappers, problem builders, jitted functions'
+static-arg handling) is NOT in scope: only bodies the tracer is
+guaranteed to trace symbolically are checked, which keeps the rule
+zero-noise on the rest of the codebase.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from .core import (
+    Diagnostic,
+    LintContext,
+    Rule,
+    Severity,
+    call_final_name,
+    const_int,
+    register,
+)
+
+#: lax HOFs -> positional indices of their traced body arguments.
+#: Position-aware on purpose: treating EVERY argument as a potential
+#: body flags host functions that merely share a name with an init
+#: value, and the builtin ``map`` collides with ``lax.map`` - neither
+#: ambiguity survives an explicit position table.
+TRACED_HOFS = {
+    "while_loop": (0, 1),          # cond_fun, body_fun
+    "fori_loop": (2,),             # body_fun
+    "scan": (0,),                  # f
+    "cond": (1, 2),                # true_fun, false_fun
+    "switch": (1,),                # branches (a list)
+    "associative_scan": (0,),      # fn
+}
+
+#: Keyword spellings of the same body arguments.
+_BODY_KWARGS = {"cond_fun", "body_fun", "f", "true_fun", "false_fun",
+                "fn", "branches"}
+
+_COERCIONS = {"float", "int", "bool", "complex"}
+_NP_COERCIONS = {"asarray", "array"}
+_NP_MODULES = {"np", "numpy", "onp"}
+_METHOD_SYNCS = {"item", "tolist"}
+
+
+def _body_args(call: ast.Call, final: str) -> List[ast.AST]:
+    """The body-function arguments of a traced HOF call, by the
+    position table (plus keyword spellings): a lambda, a function
+    name, a ``functools.partial(f, ...)``, or a list of those
+    (``switch`` branches)."""
+    candidates: List[ast.AST] = [
+        call.args[i] for i in TRACED_HOFS[final]
+        if i < len(call.args)]
+    candidates += [kw.value for kw in call.keywords
+                   if kw.arg in _BODY_KWARGS]
+    out: List[ast.AST] = []
+    for arg in candidates:
+        if isinstance(arg, (ast.Lambda, ast.Name)):
+            out.append(arg)
+        elif isinstance(arg, ast.Call) \
+                and call_final_name(arg) == "partial" and arg.args:
+            out.append(arg.args[0])
+        elif isinstance(arg, (ast.List, ast.Tuple)):
+            out.extend(e for e in arg.elts
+                       if isinstance(e, (ast.Lambda, ast.Name)))
+    return out
+
+
+def traced_bodies(ctx: LintContext) -> List[ast.AST]:
+    """FunctionDef / Lambda nodes the tracer traces symbolically."""
+    bodies: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def add(node: Optional[ast.AST]):
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        bodies.append(node)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            final = call_final_name(node)
+            if final in TRACED_HOFS:
+                for body in _body_args(node, final):
+                    if isinstance(body, ast.Lambda):
+                        add(body)
+                    elif isinstance(body, ast.Name):
+                        add(ctx.functions.get(body.id))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # @pl.when(...)-decorated kernel sub-blocks are traced
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) \
+                        and call_final_name(dec) == "when":
+                    add(node)
+    return bodies
+
+
+@register
+class HostSyncRule(Rule):
+    id = "GL105"
+    name = "host-sync"
+    #: warning, not error: unlike the other four (hard compile/runtime
+    #: failures on hardware), a host sync is a performance/correctness
+    #: HAZARD - trace-time-concrete values make it legal-but-frozen -
+    #: so the rule advises; --fail-on warning (the default) still gates
+    severity = Severity.WARNING
+    description = ("no float()/bool()/.item()/np coercion of traced "
+                   "values inside lax loop and branch bodies")
+
+    def check(self, ctx: LintContext) -> Iterator[Diagnostic]:
+        for body in traced_bodies(ctx):
+            for node in ast.walk(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                final = call_final_name(node)
+                # float(x) on a non-constant argument
+                if final in _COERCIONS and isinstance(node.func, ast.Name) \
+                        and len(node.args) == 1 \
+                        and const_int(node.args[0], ctx.consts) is None \
+                        and not isinstance(node.args[0], ast.Constant):
+                    yield self.diag(
+                        ctx, node,
+                        f"{final}() inside a traced loop/branch body "
+                        f"forces a host sync (or freezes a traced value "
+                        f"to one iteration's constant); keep the "
+                        f"predicate on device with jnp/lax ops")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _METHOD_SYNCS:
+                    yield self.diag(
+                        ctx, node,
+                        f".{node.func.attr}() inside a traced "
+                        f"loop/branch body synchronizes with the host "
+                        f"every iteration")
+                elif final in _NP_COERCIONS \
+                        and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in _NP_MODULES:
+                    yield self.diag(
+                        ctx, node,
+                        f"{node.func.value.id}.{final}() materializes a "
+                        f"traced value on host inside a traced body; "
+                        f"use jnp.asarray (or keep the data on device)")

@@ -1,0 +1,120 @@
+"""GL101 mosaic-tiling: dim-0 DMA slices that violate (8, 128) tiling.
+
+The round-5 advisor finding this rule encodes
+(``ops/pallas/resident_dist.py`` allreduce): Mosaic rejects a dim-0
+slice of a 2D VMEM ref whose sublane extent/offset is not aligned to
+the (8, 128) f32 tile - a 1-row RDMA at a dynamic row offset compiles
+nowhere on real hardware, yet passes every interpret-mode test because
+the simulator does not enforce tiling.  The halo path of that same
+kernel was redesigned around the constraint (full 8-row edge blocks);
+the scalar-allreduce path was not, and only static analysis can see
+the difference before a chip does.
+
+What fires (on ``pl.ds``/``pl.dslice`` used as the dim-0 index of a
+ref handed to ``make_async_copy``/``make_async_remote_copy`` or a
+local wrapper around them):
+
+* a statically-known sublane size that is not a multiple of 8, at an
+  offset that is not statically known (the 1-row-RDMA-at-``my_id``
+  class), and
+* a statically-known offset that is not a multiple of 8 when the size
+  IS a known multiple of 8 (a misaligned block start).
+
+What deliberately does NOT fire: slices whose size cannot be folded to
+a constant (the shared 2D/3D halo helpers parametrize it), and known
+sub-8 sizes at known 8-aligned offsets (single-plane copies of 3D refs
+are tile-legal - rank is not statically visible, so the benefit of the
+doubt goes to the aligned case).  Suppress a vetted site with
+``# graftlint: disable=mosaic-tiling``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from .core import (
+    Diagnostic,
+    LintContext,
+    Rule,
+    call_final_name,
+    const_int,
+    register,
+)
+
+#: Callee final names that produce DMA descriptors.
+DMA_MAKERS = {"make_async_copy", "make_async_remote_copy"}
+
+_DS_NAMES = {"ds", "dslice"}
+
+
+def dma_callee_names(ctx: LintContext) -> Set[str]:
+    """DMA makers plus local wrappers whose body calls a maker (e.g.
+    ``_remote_row_copy`` in resident_dist.py)."""
+    names = set(DMA_MAKERS)
+    for fname, fnode in ctx.functions.items():
+        for node in ast.walk(fnode):
+            if isinstance(node, ast.Call) \
+                    and call_final_name(node) in DMA_MAKERS:
+                names.add(fname)
+                break
+    return names
+
+
+def _ds_calls_in_dim0(arg: ast.AST):
+    """Yield ``pl.ds(...)`` calls used as the dim-0 index of any
+    subscript inside ``arg`` (covers ``ref.at[pl.ds(...)]``,
+    ``ref.at[pl.ds(...), :]`` and plain ``ref[pl.ds(...)]``)."""
+    for node in ast.walk(arg):
+        if not isinstance(node, ast.Subscript):
+            continue
+        index = node.slice
+        if isinstance(index, ast.Tuple) and index.elts:
+            index = index.elts[0]
+        if isinstance(index, ast.Call) \
+                and call_final_name(index) in _DS_NAMES:
+            yield index
+
+
+@register
+class MosaicTilingRule(Rule):
+    id = "GL101"
+    name = "mosaic-tiling"
+    description = ("dim-0 DMA slices of VMEM refs must be provably "
+                   "(8, .)-sublane-aligned for Mosaic")
+
+    def check(self, ctx: LintContext) -> Iterator[Diagnostic]:
+        if not ctx.has_pallas:
+            return
+        callees = dma_callee_names(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_final_name(node) in callees):
+                continue
+            for ds in _ds_calls_in_dim0(node):
+                if len(ds.args) < 2:
+                    continue
+                off_node, size_node = ds.args[0], ds.args[1]
+                size = const_int(size_node, ctx.consts)
+                off = const_int(off_node, ctx.consts)
+                if size is None:
+                    continue  # parametrized block height: not decidable
+                if size % 8 != 0 and off is None:
+                    yield self.diag(
+                        ctx, ds,
+                        f"{size}-row dim-0 DMA slice at a dynamic "
+                        f"offset: Mosaic requires (8, 128)-tile-aligned "
+                        f"sublane slices of 2D VMEM refs (transfer a "
+                        f"full 8-row block at an 8-aligned offset, as "
+                        f"the halo path does)")
+                elif size % 8 != 0 and off is not None and off % 8 != 0:
+                    yield self.diag(
+                        ctx, ds,
+                        f"{size}-row dim-0 DMA slice at offset {off}: "
+                        f"neither the sublane size nor the offset is a "
+                        f"multiple of 8")
+                elif size % 8 == 0 and off is not None and off % 8 != 0:
+                    yield self.diag(
+                        ctx, ds,
+                        f"dim-0 DMA block of {size} rows starts at "
+                        f"misaligned offset {off} (must be a multiple "
+                        f"of 8 for the (8, 128) sublane tiling)")

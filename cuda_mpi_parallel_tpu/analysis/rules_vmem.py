@@ -1,0 +1,169 @@
+"""GL102 vmem-budget: pallas kernels must fit the physical VMEM ceiling.
+
+The round-5 advisor finding this rule encodes
+(``ops/pallas/resident_dist.py:434``): a ``vmem_limit_bytes`` computed
+as ``planes * cells * itemsize + margin`` can exceed physical VMEM at
+gate-boundary slab sizes - the compiler then rejects (or worse, the
+probe never covered) exactly the largest grids the capacity gate
+admits.  Interpret-mode tests cannot see this; the limit expression is
+right there in the source.
+
+Two checks per ``pl.pallas_call``:
+
+* **provable ceiling**: the ``vmem_limit_bytes`` expression must be
+  statically bounded by the device ceiling - either a constant below
+  ``DEVICE_VMEM_BYTES`` (128 MiB, the v4/v5/v6 figure the codebase's
+  own ``vmem_bytes`` table uses) or an expression clamped through
+  ``min(..., vmem_bytes(...))`` (any callee whose final name is in
+  ``CLAMP_FNS`` counts).  Unclamped symbolic expressions fire.
+* **scratch sum**: when every ``pltpu.VMEM((...), dtype)`` scratch
+  entry folds to constant dims AND the limit folds to a constant, the
+  summed scratch bytes must not exceed the declared limit.
+
+Kernels with no ``compiler_params`` are skipped (the compiler's own
+default is conservative); parametrized scratch shapes are skipped for
+the sum check (the shape-symbolic budget lives in the clamp check).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .core import (
+    Diagnostic,
+    LintContext,
+    Rule,
+    call_final_name,
+    const_int,
+    register,
+)
+
+#: Physical per-core VMEM ceiling assumed when no device is consulted:
+#: the 128 MiB v4+ figure from ``ops.pallas.resident._VMEM_BY_GENERATION``.
+DEVICE_VMEM_BYTES = 128 * 1024 * 1024
+
+#: Callee final names accepted as a device-ceiling clamp inside min().
+CLAMP_FNS = {"vmem_bytes", "max_x_bytes"}
+
+_ITEMSIZE = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool_": 1, "bool": 1,
+}
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _vmem_limit_expr(call: ast.Call) -> Optional[ast.AST]:
+    """The ``vmem_limit_bytes`` expression of a pallas_call, if set."""
+    params = _kwarg(call, "compiler_params")
+    if not isinstance(params, ast.Call):
+        return None
+    return _kwarg(params, "vmem_limit_bytes")
+
+
+def _is_clamped(expr: ast.AST) -> bool:
+    """True if ``expr`` is ``min(...)`` with a device-budget call (or a
+    sub-ceiling constant) among its arguments."""
+    if not (isinstance(expr, ast.Call)
+            and call_final_name(expr) == "min"):
+        return False
+    for arg in expr.args:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Call) \
+                    and call_final_name(node) in CLAMP_FNS:
+                return True
+        folded = const_int(arg)
+        if folded is not None and folded <= DEVICE_VMEM_BYTES:
+            return True
+    return False
+
+
+def _scratch_bytes(call: ast.Call, ctx: LintContext) -> Optional[int]:
+    """Sum of all ``pltpu.VMEM(shape, dtype)`` scratch entries, or None
+    when any entry's dims/dtype cannot be folded statically."""
+    scratch = _kwarg(call, "scratch_shapes")
+    if scratch is None:
+        return 0
+    if not isinstance(scratch, (ast.List, ast.Tuple)):
+        return None
+    total = 0
+    for entry in scratch.elts:
+        if not isinstance(entry, ast.Call):
+            return None
+        final = call_final_name(entry)
+        if final != "VMEM":
+            continue  # SMEM / semaphores are not VMEM planes
+        if len(entry.args) < 2:
+            return None
+        shape, dtype = entry.args[0], entry.args[1]
+        if not isinstance(shape, (ast.Tuple, ast.List)):
+            return None
+        dims = [const_int(d, ctx.consts) for d in shape.elts]
+        if any(d is None for d in dims):
+            return None
+        dtype_name = (dotted_last(dtype) or "")
+        itemsize = _ITEMSIZE.get(dtype_name)
+        if itemsize is None:
+            return None
+        n = 1
+        for d in dims:
+            n *= d
+        total += n * itemsize
+    return total
+
+
+def dotted_last(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+@register
+class VmemBudgetRule(Rule):
+    id = "GL102"
+    name = "vmem-budget"
+    description = ("pallas_call vmem_limit_bytes must be provably within "
+                   "the physical device VMEM ceiling, and declared "
+                   "scratch must fit the declared limit")
+
+    def check(self, ctx: LintContext) -> Iterator[Diagnostic]:
+        if not ctx.has_pallas:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_final_name(node) == "pallas_call"):
+                continue
+            limit_expr = _vmem_limit_expr(node)
+            if limit_expr is None:
+                continue
+            limit = const_int(limit_expr, ctx.consts)
+            if limit is not None:
+                if limit > DEVICE_VMEM_BYTES:
+                    yield self.diag(
+                        ctx, limit_expr,
+                        f"vmem_limit_bytes={limit} exceeds the "
+                        f"{DEVICE_VMEM_BYTES >> 20} MiB physical VMEM "
+                        f"ceiling")
+                else:
+                    sb = _scratch_bytes(node, ctx)
+                    if sb is not None and sb > limit:
+                        yield self.diag(
+                            ctx, limit_expr,
+                            f"declared VMEM scratch totals {sb} bytes "
+                            f"but vmem_limit_bytes is only {limit}")
+            elif not _is_clamped(limit_expr):
+                yield self.diag(
+                    ctx, limit_expr,
+                    "shape-dependent vmem_limit_bytes is not clamped to "
+                    "the device ceiling: at gate-boundary shapes the "
+                    "computed limit can exceed physical VMEM; wrap it "
+                    "in min(..., vmem_bytes(device))")

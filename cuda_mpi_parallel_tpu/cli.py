@@ -14,6 +14,12 @@ Examples::
     python -m cuda_mpi_parallel_tpu.cli --problem poisson3d --n 64 --mesh 4
     python -m cuda_mpi_parallel_tpu.cli --problem mm --file thermal2.mtx \
         --precond jacobi --json
+    python -m cuda_mpi_parallel_tpu.cli lint cuda_mpi_parallel_tpu/
+
+The ``lint`` subcommand mounts the graftlint static-analysis suite
+(``cuda_mpi_parallel_tpu.analysis``): Mosaic tiling, VMEM budgets,
+collective safety, DMA pairing, host-sync - the pre-hardware gate for
+new kernels.
 """
 from __future__ import annotations
 
@@ -211,6 +217,14 @@ def _build_problem(args):
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "lint":
+        # graftlint rides the package CLI as a subcommand; the solver
+        # flags below don't apply to it, so dispatch before parsing.
+        from .analysis.__main__ import main as lint_main
+
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.precond_degree < 1:
         raise SystemExit(
@@ -317,6 +331,18 @@ def main(argv=None) -> int:
             raise SystemExit(f"--format {args.fmt}: {e}")
         desc += f" [{args.fmt}]"
 
+    # The distributed resident/streaming engines record no residual
+    # trace (one kernel launch per chip - there is no per-iteration
+    # host visibility to build one from); reject rather than silently
+    # dropping the flag (ADVICE.md round 5).
+    if args.history and args.mesh > 1 \
+            and args.engine in ("resident", "streaming"):
+        raise SystemExit(
+            f"--history is unavailable with --engine {args.engine} "
+            f"--mesh {args.mesh}: the distributed one-kernel-per-chip "
+            f"solves keep every iteration on device and record no "
+            f"residual trace. Drop --history, or use --engine general "
+            f"for a traced distributed solve.")
     if args.engine == "resident":
         if args.mesh > 1 and (args.precond not in (None, "chebyshev")
                               or args.method != "cg" or args.df64):

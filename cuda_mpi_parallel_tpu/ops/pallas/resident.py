@@ -128,9 +128,13 @@ def _extra_planes(preconditioned: bool, warm_start: bool,
     52.92 MB = ~12.6 plane-equivalents (round 5, on-chip) - the
     z/d recurrence keeps more transients live across the in-loop
     stencils than the two the hand-count predicted.  7 + 6 = 13
-    covers the measured footprint with margin; the cheb boundary
-    grids the resulting gate admits are probe-verified like the
-    unpreconditioned ones (tools/capacity_probe_r05.json)."""
+    covers the measured footprint with margin.  Probe coverage of the
+    resulting cheb gate is NOT the within-1% coverage of the
+    unpreconditioned ladder: the probe's largest cheb grid (1600x1536
+    = 2.46M cells, tools/capacity_probe_r05.json) sits ~5% below the
+    13-plane ceiling (~2.58M cells), so the top few percent of
+    admitted grids extrapolate from the measured footprint rather
+    than an on-chip compile."""
     del warm_start  # plane-neutral via aliasing; kept for call clarity
     return (6 if preconditioned else 0) + (2 if cg1 else 0)
 
@@ -624,10 +628,15 @@ def _cg_resident_call(scale, tol, rtol, lmin, lmax, cap, b_grid, x0_grid,
         # gate-admitted grid is probe-verified to actually fit
         # (tools/capacity_probe_r05.json).
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=(_PLANES_BOUND
-                              + _extra_planes(degree > 0, has_x0,
-                                              cg1=method == "cg1"))
-            * cells * 4 + (8 << 20)),
+            # clamped to the physical part: at gate-boundary grids the
+            # planes-plus-margin figure can poke past the ceiling, and
+            # the ceiling is the real cap anyway (graftlint GL102)
+            vmem_limit_bytes=min(
+                (_PLANES_BOUND
+                 + _extra_planes(degree > 0, has_x0,
+                                 cg1=method == "cg1"))
+                * cells * 4 + (8 << 20),
+                vmem_bytes())),
         interpret=interpret,
     )(params, cap_arr, *grid_inputs)
     return x, iters[0], rr[0], indef[0], conv[0], health[0], hist
@@ -774,12 +783,14 @@ def _extra_planes_df64(preconditioned: bool) -> int:
     allocation for the df64 cheb kernel is 44.69 MB = ~41.7
     plane-equivalents (round 5, on-chip) - the EFT z/d hi/lo recurrence
     keeps far more transients live across the in-loop df64 stencils
-    than the pair-count suggests.  27 + 14 = 41 covers it; the gate
-    ceiling this implies (~800k cells on a 128 MiB part) is
-    probe-verified at its boundary like the f32 gates
-    (tools/capacity_probe_r05.json).  Gates and the kernel's
-    ``vmem_limit_bytes`` share this function (same invariant as
-    ``_extra_planes``)."""
+    than the pair-count suggests.  27 + 14 = 41 covers it; the
+    largest df64-cheb grid the probe compiled on-chip (768x1024 =
+    786k cells, tools/capacity_probe_r05.json) sits ~4% below the
+    ~818k-cell gate ceiling a 128 MiB part implies, so - unlike the
+    within-1% f32 unpreconditioned ladder - the last few percent of
+    admitted grids are extrapolated, not probe-verified.  Gates and
+    the kernel's ``vmem_limit_bytes`` share this function (same
+    invariant as ``_extra_planes``)."""
     return 14 if preconditioned else 0
 
 
@@ -1123,9 +1134,12 @@ def _cg_resident_df64_call(scale_h, scale_l, tol, rtol, theta, delta, cap,
         # df64 warm start stays plane-neutral in the VMEM budget.
         input_output_aliases=({4: 0, 5: 1} if has_x0 else {}),
         compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=(_PLANES_BOUND_DF64
-                              + _extra_planes_df64(degree > 0))
-            * cells * 4 + (8 << 20)),  # same fixed margin as the f32 kernel
+            # same fixed margin as the f32 kernel, same physical clamp
+            vmem_limit_bytes=min(
+                (_PLANES_BOUND_DF64
+                 + _extra_planes_df64(degree > 0))
+                * cells * 4 + (8 << 20),
+                vmem_bytes())),
         interpret=interpret,
     )(params, cap_arr, *grid_inputs)
     return (xh, xl, iters[0], (rr[0], rr[1]), indef[0], conv[0],

@@ -20,14 +20,43 @@ import jax
 from jax import lax
 
 
+def validate_permutation(perm):
+    """Reject ppermute permutation lists with duplicate sources or
+    destinations - undefined on hardware (two sources racing into one
+    destination buffer is last-writer-wins over ICI, the contested-slot
+    class of the round-5 rho-buffer race).
+
+    The runtime twin of graftlint's collective-safety rule: GL103 can
+    only decide *literal* ``perm=[...]`` lists, so every schedule this
+    package builds at trace time (the neighbor chains below, the ring
+    rotations in ``parallel.operators``) routes through this check.
+    Returns ``perm`` unchanged, so builders can wrap in place.
+    """
+    perm = list(perm)
+    srcs = [s for s, _ in perm]
+    dsts = [d for _, d in perm]
+    if len(set(srcs)) != len(srcs):
+        raise ValueError(
+            f"ppermute permutation lists a source twice (each device "
+            f"can send at most once): {perm}")
+    if len(set(dsts)) != len(dsts):
+        raise ValueError(
+            f"ppermute permutation lists a destination twice (two "
+            f"sources racing into one destination is undefined): "
+            f"{perm}")
+    return perm
+
+
 def neighbor_shift_perms(n_shards: int):
     """(forward, backward) permutation lists for a 1-D non-periodic chain.
 
     forward: shard i -> i+1 (so a device *receives* its lower neighbor's
     boundary); backward: shard i -> i-1.  Edge devices receive zeros.
     """
-    fwd = [(i, i + 1) for i in range(n_shards - 1)]
-    bwd = [(i, i - 1) for i in range(1, n_shards)]
+    fwd = validate_permutation(
+        (i, i + 1) for i in range(n_shards - 1))
+    bwd = validate_permutation(
+        (i, i - 1) for i in range(1, n_shards))
     return fwd, bwd
 
 

@@ -30,7 +30,7 @@ from jax import lax
 
 from ..models.operators import LinearOperator
 from ..ops import spmv
-from .halo import exchange_halo, exchange_halo_axis
+from .halo import exchange_halo, exchange_halo_axis, validate_permutation
 
 
 @partial(
@@ -363,7 +363,8 @@ class DistCSRRing(LinearOperator):
         # receive from the next shard: after one shift, shard i holds
         # block i+1; at step t it holds block (i + t) % n, matching the
         # pre-arranged slab order
-        ring = [(j, (j - 1) % n) for j in range(n)]
+        ring = validate_permutation(
+            (j, (j - 1) % n) for j in range(n))
         y = jnp.zeros_like(x)
         xb = x
         for t in range(n):  # static unroll: n is a mesh constant
@@ -424,7 +425,8 @@ class DistShiftELLRing(LinearOperator):
         n = self.n_shards
         nch = -(-self.n_local // pk.LANES)
         nch_pad = -(-nch // self.h) * self.h
-        ring = [(j, (j - 1) % n) for j in range(n)]
+        ring = validate_permutation(
+            (j, (j - 1) % n) for j in range(n))
         interpret = _pallas_interpret()
         y = jnp.zeros_like(x)
         xb = x
@@ -486,7 +488,8 @@ class DistShiftELLDF64Ring:
         n = self.n_shards
         nch = -(-self.n_local // pk.LANES)
         nch_pad = -(-nch // self.h) * self.h
-        ring = [(j, (j - 1) % n) for j in range(n)]
+        ring = validate_permutation(
+            (j, (j - 1) % n) for j in range(n))
         interpret = _pallas_interpret()
         y = (jnp.zeros_like(x[0]), jnp.zeros_like(x[1]))
         xb = jnp.stack([x[0], x[1]])  # both planes rotate in one ppermute

@@ -1,0 +1,40 @@
+"""Known-bad GL103 collective-safety patterns.
+
+A psum over an axis no mesh in this file declares (a typo'd name
+fails at trace time on hardware - or, on a 2-D mesh, silently reduces
+over the WRONG axis), and a ppermute permutation sending two sources
+into one destination (last-writer-wins on ICI, nondeterministic in
+the simulator - the same contested-slot class as the round-5
+rho-buffer race).
+"""
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+ROWS_AXIS = "rows"
+
+
+def make_row_mesh(devices):
+    return Mesh(np.asarray(devices), ("rows",))
+
+
+def mistyped_reduce(x):
+    return lax.psum(x, "cols")  # gl-expect: collective-safety
+
+
+def mistyped_axis_index():
+    # axis_index carries its axis FIRST positionally - a typo here
+    # silently computes the wrong shard id
+    return lax.axis_index("rowz")  # gl-expect: collective-safety
+
+
+def contested_ring(x):
+    return lax.ppermute(
+        x, "rows",
+        perm=[(0, 1), (1, 1), (2, 0)])  # gl-expect: collective-safety
+
+
+def double_sender(x):
+    return lax.ppermute(
+        x, "rows",
+        perm=[(0, 1), (0, 2)])  # gl-expect: collective-safety

@@ -1,0 +1,27 @@
+"""Known-bad GL104 dma-pairing patterns.
+
+A started-never-waited named descriptor (buffer reuse while the copy
+is in flight + a semaphore that never rebalances), a module whose
+anonymous start/wait counts don't balance, and a remote copy driven
+through one shared semaphore.
+"""
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def leaky_kernel(x_hbm, y_ref, sem):
+    dma = pltpu.make_async_copy(x_hbm, y_ref, sem)  # gl-expect: dma-pairing
+    dma.start()
+    return y_ref[0:8]  # read while the copy may still be in flight
+
+
+def fire_and_forget(src, dst, send, recv, tgt):
+    pltpu.make_async_remote_copy(  # gl-expect: dma-pairing
+        src, dst, send, recv, device_id=tgt,
+        device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+
+
+def shared_sem_remote(src, dst, sem, tgt):
+    dma = pltpu.make_async_remote_copy(src, dst, sem, device_id=tgt)  # gl-expect: dma-pairing
+    dma.start()
+    dma.wait()

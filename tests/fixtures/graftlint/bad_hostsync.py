@@ -1,0 +1,42 @@
+"""Known-bad GL105 host-sync patterns.
+
+The reference's anti-pattern (host-side ``while`` on a device scalar,
+one transfer per iteration) expressed the ways it actually sneaks
+into jax code: builtin coercions, ``.item()`` and numpy
+materialization inside ``lax`` loop/branch bodies.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def solve(matvec, b, tol, maxiter):
+    def cond(state):
+        x, r, k = state
+        return bool(jnp.vdot(r, r) > tol) and k < maxiter  # gl-expect: host-sync
+
+    def body(state):
+        x, r, k = state
+        alpha = float(jnp.vdot(r, r))  # gl-expect: host-sync
+        trace = np.asarray(r)  # gl-expect: host-sync
+        del trace
+        return x + alpha * r, r - alpha * matvec(r), k + 1
+
+    return lax.while_loop(cond, body, (b, b, 0))
+
+
+def count_steps(r0, thresh):
+    def step(i, acc):
+        err = acc.sum().item()  # gl-expect: host-sync
+        return acc * 0.5 + err
+
+    return lax.fori_loop(0, 10, step, r0)
+
+
+def branchy(pred, x):
+    return lax.cond(
+        pred,
+        lambda v: v * int(v.sum()),  # gl-expect: host-sync
+        lambda v: v,
+        x)

@@ -1,0 +1,51 @@
+"""Known-bad GL101 mosaic-tiling patterns.
+
+``allreduce_push`` reconstructs the round-5 ``resident_dist.py``
+allreduce finding verbatim: a 1-row RDMA of a (n_shards, 128) VMEM
+buffer at dynamic row offset ``my_id`` - rows 1..7 are unaligned under
+the (8, 128) f32 sublane tiling, so Mosaic rejects the slice on real
+chips while interpret mode happily runs it.
+"""
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _remote_row_copy(src, dst, send, recv, target):
+    return pltpu.make_async_remote_copy(
+        src, dst, send, recv, device_id=target,
+        device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def allreduce_push(buf, send_sems, recv_sems, n_shards, axis_name):
+    my_id = lax.axis_index(axis_name)
+    dmas = []
+    for step in range(1, n_shards):
+        tgt = lax.rem(my_id + jnp.int32(step), jnp.int32(n_shards))
+        dma = _remote_row_copy(
+            buf.at[pl.ds(my_id, 1)],  # gl-expect: mosaic-tiling
+            buf.at[pl.ds(my_id, 1)],  # gl-expect: mosaic-tiling
+            send_sems.at[step - 1], recv_sems.at[step - 1], tgt)
+        dma.start()
+        dmas.append(dma)
+    for dma in dmas:
+        dma.wait()
+
+
+def misaligned_block_start(x_ref, out_ref, sem):
+    pltpu.make_async_copy(
+        x_ref.at[pl.ds(4, 8)],  # gl-expect: mosaic-tiling
+        out_ref.at[pl.ds(0, 8)], sem).start()
+    pltpu.make_async_copy(
+        x_ref.at[pl.ds(4, 8)],  # gl-expect: mosaic-tiling
+        out_ref.at[pl.ds(0, 8)], sem).wait()
+
+
+def odd_everything(x_ref, out_ref, sem):
+    pltpu.make_async_copy(
+        x_ref.at[pl.ds(3, 5)],  # gl-expect: mosaic-tiling
+        out_ref.at[pl.ds(0, 8)], sem).start()
+    pltpu.make_async_copy(
+        x_ref.at[pl.ds(3, 5)],  # gl-expect: mosaic-tiling
+        out_ref.at[pl.ds(0, 8)], sem).wait()

@@ -1,0 +1,46 @@
+"""Known-bad GL102 vmem-budget patterns.
+
+``launch_unclamped`` is the ``resident_dist.py:434`` finding: a
+shape-dependent ``vmem_limit_bytes`` with no device-ceiling clamp -
+at gate-boundary slab sizes the computed limit exceeds physical VMEM.
+The other two are the statically-decidable literal forms.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def launch_unclamped(kernel, local_shape, degree):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(local_shape, jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=(13 if degree > 0 else 10)  # gl-expect: vmem-budget
+            * math.prod(local_shape) * 4 + (8 << 20)),
+    )()
+
+
+def launch_over_ceiling(kernel):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=256 * 1024 * 1024),  # gl-expect: vmem-budget
+    )()
+
+
+def launch_scratch_overrun(kernel):
+    # 4096 * 4096 * 4 = 64 MiB of declared scratch vs a 32 MiB limit
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((4096, 4096), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=32 * 1024 * 1024),  # gl-expect: vmem-budget
+    )()

@@ -1,0 +1,39 @@
+"""Known-good GL103 patterns: declared axes, well-formed rings,
+dynamic axis names threaded from the mesh (trusted - the codebase's
+own idiom)."""
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+ROWS_AXIS = "rows"
+COLS_AXIS = "cols"
+
+
+def make_mesh_2d(devices, shape):
+    return Mesh(np.asarray(devices).reshape(shape), ("rows", "cols"))
+
+
+def row_reduce(x):
+    return lax.psum(x, "rows")
+
+
+def both_axis_reduce(x):
+    return lax.psum(x, ("rows", "cols"))
+
+
+def neighbor_shift(x, n_shards):
+    fwd = [(i, i + 1) for i in range(n_shards - 1)]
+    return lax.ppermute(x, "rows", perm=fwd)
+
+
+def unique_ring(x):
+    return lax.ppermute(x, "cols", perm=[(0, 1), (1, 2), (2, 0)])
+
+
+def my_shard_id():
+    return lax.axis_index("rows")
+
+
+def dynamic_axis_reduce(x, mesh):
+    # axis names resolved at run time are trusted (unverifiable here)
+    return lax.psum(x, mesh.axis_names[0])

@@ -1,0 +1,45 @@
+"""Known-good GL104 patterns: every pairing discipline the codebase
+uses - named descriptors, list indirection, and the stencil.py-style
+split copy/wait helpers whose anonymous descriptors balance
+module-wide."""
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def paired_named(x_hbm, y_ref, sem):
+    dma = pltpu.make_async_copy(x_hbm, y_ref, sem)
+    dma.start()
+    dma.wait()
+    return y_ref[0:8]
+
+
+def paired_through_list(srcs, dsts, sems, n):
+    dmas = []
+    for i in range(n):
+        dma = pltpu.make_async_copy(srcs.at[i], dsts.at[i], sems.at[i])
+        dma.start()
+        dmas.append(dma)
+    for dma in dmas:
+        dma.wait()
+
+
+def slab_copy(x_hbm, slab_buf, sem, bm):
+    """stencil.py discipline: the start half of a split pair."""
+    pltpu.make_async_copy(
+        x_hbm.at[pl.ds(0, bm)],
+        slab_buf.at[pl.ds(8, bm)], sem).start()
+
+
+def slab_wait(x_hbm, slab_buf, sem, bm):
+    """...and the identically-shaped wait half, in a sibling helper."""
+    pltpu.make_async_copy(
+        x_hbm.at[pl.ds(0, bm)],
+        slab_buf.at[pl.ds(8, bm)], sem).wait()
+
+
+def remote_with_both_sems(src, dst, send, recv, tgt):
+    dma = pltpu.make_async_remote_copy(
+        src, dst, send, recv, device_id=tgt,
+        device_id_type=pltpu.DeviceIdType.LOGICAL)
+    dma.start()
+    dma.wait()

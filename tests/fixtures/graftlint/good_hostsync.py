@@ -1,0 +1,54 @@
+"""Known-good GL105 patterns: predicates on device, host coercions
+only at host level (result wrappers / problem setup), constant
+coercions that cannot sync."""
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def solve(matvec, b, tol2, maxiter):
+    def cond(state):
+        x, r, k = state
+        return (jnp.vdot(r, r) > tol2) & (k < maxiter)
+
+    def body(state):
+        x, r, k = state
+        ap = matvec(r)
+        alpha = jnp.vdot(r, r) / jnp.vdot(r, ap)
+        return x + alpha * r, r - alpha * ap, k + 1
+
+    return lax.while_loop(cond, body, (b, b, jnp.int32(0)))
+
+
+def host_wrapper(matvec, b, tol, maxiter):
+    """Host level: float()/np.asarray of a FINISHED result is fine."""
+    x, r, k = solve(matvec, b, float(tol) ** 2, int(maxiter))
+    return np.asarray(x), float(jnp.vdot(r, r)), int(k)
+
+
+def constant_fold_in_body(r0):
+    def step(i, acc):
+        return acc * float(0.5) + int(2)  # constants: no traced value
+
+    return lax.fori_loop(0, 10, step, r0)
+
+
+def _fmt(v):
+    return float(v)
+
+
+def format_rows(rows):
+    """Host-level builtin map() must not be confused with lax.map:
+    _fmt is plain host code, its float() is fine."""
+    return list(map(_fmt, rows))
+
+
+def init_shares_a_function_name(r0, helper):
+    # only the BODY position (args[2]) is traced; an init value that
+    # happens to be named like a module function is not a body
+    return lax.fori_loop(0, 3, lambda i, v: v * 0.5, helper)
+
+
+def helper(x):
+    return float(np.asarray(x).sum())

@@ -1,0 +1,47 @@
+"""Known-good GL101 patterns: the halo-path discipline.
+
+Full 8-row edge blocks at 8-aligned (or parametrized) offsets - the
+redesign ``resident_dist.py``'s halo exchange adopted after Mosaic
+rejected single-row slices, plus the 8-row-slot form of the scalar
+exchange the advisor recommends.
+"""
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _remote_row_copy(src, dst, send, recv, target):
+    return pltpu.make_async_remote_copy(
+        src, dst, send, recv, device_id=target,
+        device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def exchange_halo(v_ref, buf, send, recv, left, right, nxl):
+    down = _remote_row_copy(v_ref.at[pl.ds(nxl - 8, 8)],
+                            buf.at[pl.ds(0, 8)],
+                            send.at[0], recv.at[0], right)
+    up = _remote_row_copy(v_ref.at[pl.ds(0, 8)],
+                          buf.at[pl.ds(8, 8)],
+                          send.at[1], recv.at[1], left)
+    down.start()
+    up.start()
+    down.wait()
+    up.wait()
+
+
+def aligned_slot_push(buf, send_sems, recv_sems, n_shards, axis_name):
+    """The 8-row-aligned scalar-exchange slot (buffer (8 * n_shards,
+    128), slot my_id * 8): what the round-5 allreduce should become."""
+    my_id = lax.axis_index(axis_name)
+    dmas = []
+    for step in range(1, n_shards):
+        tgt = lax.rem(my_id + jnp.int32(step), jnp.int32(n_shards))
+        dma = _remote_row_copy(
+            buf.at[pl.ds(my_id * 8, 8)],
+            buf.at[pl.ds(my_id * 8, 8)],
+            send_sems.at[step - 1], recv_sems.at[step - 1], tgt)
+        dma.start()
+        dmas.append(dma)
+    for dma in dmas:
+        dma.wait()

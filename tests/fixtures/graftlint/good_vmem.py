@@ -1,0 +1,47 @@
+"""Known-good GL102 patterns: clamped or provably-fitting budgets."""
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cuda_mpi_parallel_tpu.ops.pallas.resident import vmem_bytes
+
+_VMEM_BUDGET = 64 * 1024 * 1024
+
+
+def launch_clamped(kernel, local_shape, degree):
+    """The satellite fix: shape-dependent limit clamped to the part."""
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(local_shape, jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=min(
+                (13 if degree > 0 else 10)
+                * math.prod(local_shape) * 4 + (8 << 20),
+                vmem_bytes())),
+    )()
+
+
+def launch_constant_budget(kernel):
+    """fused_cg.py's discipline: a constant below the 128 MiB part,
+    with the declared scratch fitting inside it."""
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((1024, 1024), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_BUDGET),
+    )()
+
+
+def launch_default_budget(kernel):
+    """No compiler_params at all: the compiler default is conservative."""
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )()

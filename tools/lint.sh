@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# The pre-hardware gate: graftlint over the package, then the tier-1
+# test suite (ROADMAP.md).  New multi-chip kernels must pass BOTH
+# before a capacity probe burns chip time.
+#
+# Usage:  tools/lint.sh [--lint-only]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== graftlint (cuda_mpi_parallel_tpu.analysis) =="
+python -m cuda_mpi_parallel_tpu.analysis cuda_mpi_parallel_tpu
+echo "graftlint: clean"
+
+if [[ "${1:-}" == "--lint-only" ]]; then
+    exit 0
+fi
+
+echo "== tier-1 tests (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+exit "$rc"
