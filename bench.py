@@ -1436,6 +1436,17 @@ def main(argv=None) -> int:
             rec["partial_results"] = sorted(results.keys())
             print(json.dumps(rec))
             return 1
+        # Embed the process metrics registry (solve counts/outcomes,
+        # engine selections, dist-cache hit rate, jaxpr-derived comm
+        # gauges) so every results file carries its own observability
+        # context.  Telemetry must never sink a bench run.
+        try:
+            from cuda_mpi_parallel_tpu.telemetry.registry import REGISTRY
+
+            results["__metrics__"] = REGISTRY.snapshot()
+        except Exception as e:
+            print(f"# metrics snapshot failed: {e}", file=sys.stderr)
+
         headline = results.get(HEADLINE_KEY)
         if headline is None and sections and HEADLINE_KEY not in sections:
             # A deliberately restricted sweep that excludes the headline

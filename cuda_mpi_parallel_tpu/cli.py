@@ -150,8 +150,36 @@ def build_parser() -> argparse.ArgumentParser:
                    help="emit a single JSON record instead of text")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace to DIR")
+    p.add_argument("--trace-events", default=None, metavar="PATH",
+                   dest="trace_events",
+                   help="append the solve's telemetry event stream "
+                        "(solve_start/engine_selected/comm_cost/"
+                        "solve_end, one JSON object per line) to PATH "
+                        "- see README 'Observability' for the schema")
+    p.add_argument("--metrics", action="store_true",
+                   help="report the process metrics registry after the "
+                        "solve (Prometheus text; embedded as a "
+                        "'metrics' object with --json); with --mesh > 1 "
+                        "this includes the jaxpr-derived per-iteration "
+                        "psum/ppermute/halo-byte gauges")
     p.add_argument("--seed", type=int, default=0)
     return p
+
+
+def _ensure_virtual_devices(mesh: int) -> None:
+    """``--mesh N`` on a CPU host: force N virtual XLA host devices so
+    mesh runs work without a pod (the tests' conftest mechanism, made a
+    first-class CLI behavior).  No-op when XLA_FLAGS already forces a
+    count, or once a backend exists (then ``make_mesh`` reports the
+    shortfall as before).  The flag only affects the HOST platform, so
+    TPU hosts are unaffected."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        return
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={mesh}").strip()
 
 
 def _configure_backend(args) -> None:
@@ -226,6 +254,17 @@ def main(argv=None) -> int:
 
         return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
+    if args.mesh > 1 and args.device != "tpu":
+        # must run BEFORE the first backend touch (jax reads XLA_FLAGS
+        # at client creation)
+        _ensure_virtual_devices(args.mesh)
+    if args.trace_events or args.metrics:
+        from . import telemetry
+
+        if args.trace_events:
+            telemetry.configure(args.trace_events)
+        if args.metrics:
+            telemetry.force_active(True)
     if args.precond_degree < 1:
         raise SystemExit(
             f"--precond-degree must be >= 1, got {args.precond_degree}")
@@ -249,7 +288,7 @@ def main(argv=None) -> int:
     import jax
 
     from .utils import logging as ulog
-    from .utils.timing import profile_trace, time_fn
+    from .utils.timing import time_fn
 
     a, b, x_expected, desc = _build_problem(args)
 
@@ -614,21 +653,74 @@ def main(argv=None) -> int:
                      record_history=args.history, method=args.method,
                      check_every=args.check_every)
 
-    with profile_trace(args.profile):
-        elapsed, result = time_fn(run, warmup=1, repeats=1)
+    from .telemetry import events as tevents
+    from .telemetry import session as tsession
 
-    if args.df64:
-        # adapt DF64CGResult to the CGResult-shaped reporting surface
-        import types
+    if args.mesh > 1:
+        # the comm account below must come from THIS solve: other
+        # distributed engines bypass dist_cg's cache, so a stale value
+        # from an earlier solve in this process must not leak in
+        from .parallel.dist_cg import reset_last_comm_cost
 
-        result = types.SimpleNamespace(
-            x=result.x(), iterations=result.iterations,
-            residual_norm=result.residual_norm(),
-            converged=result.converged, indefinite=result.indefinite,
-            status_enum=result.status_enum,
-            # ||r|| with NaN fill - same semantics as CGResult, no
-            # adaptation needed
-            residual_history=result.residual_history)
+        reset_last_comm_cost()
+
+    # time_fn dispatches twice (compile warmup + timed); both really
+    # happen, so both emit - the warmup's events labeled phase=warmup
+    # for consumers that count per-solve selections or cache hits
+    dispatches = [0]
+    run_inner = run
+
+    def run():  # noqa: F811 - deliberate wrap of the closure above
+        dispatches[0] += 1
+        if dispatches[0] == 1:
+            with tevents.scoped(phase="warmup"):
+                return run_inner()
+        return run_inner()
+
+    with tsession.observe_solve(
+            desc, engine=args.engine, check_every=args.check_every,
+            profile_dir=args.profile, problem=args.problem,
+            method=args.method, dtype=args.dtype,
+            mesh=args.mesh) as obs:
+        with obs.section("solve"):
+            elapsed, result = time_fn(run, warmup=1, repeats=1)
+
+        if args.df64:
+            # adapt DF64CGResult to the CGResult-shaped reporting surface
+            import types
+
+            result = types.SimpleNamespace(
+                x=result.x(), iterations=result.iterations,
+                residual_norm=result.residual_norm(),
+                converged=result.converged, indefinite=result.indefinite,
+                status_enum=result.status_enum,
+                # ||r|| with NaN fill - same semantics as CGResult, no
+                # adaptation needed
+                residual_history=result.residual_history)
+
+        # per-solve communication account: jaxpr-derived per-iteration
+        # collective counts x the measured iteration count (the volume
+        # that governs distributed SpMV scaling - see telemetry.cost)
+        comm = None
+        if args.mesh > 1:
+            from .parallel.dist_cg import last_comm_cost
+
+            info = last_comm_cost()
+            if info is not None:
+                sc, ctx = info
+                totals = sc.totals(int(result.iterations))
+                comm = {
+                    "psum": totals.psum,
+                    "ppermute": totals.ppermute,
+                    "all_gather": totals.all_gather,
+                    "comm_bytes": totals.comm_bytes,
+                    "per_iteration": sc.per_iteration.to_json(),
+                    "setup": sc.setup.to_json(),
+                    "kind": ctx.get("kind"),
+                    "n_shards": ctx.get("n_shards"),
+                }
+        obs.finish(result, elapsed_s=elapsed,
+                   **({"comm": comm} if comm is not None else {}))
 
     x_np = np.asarray(result.x)
     if rcm_perm is not None:  # scatter back to the original ordering
@@ -644,6 +736,12 @@ def main(argv=None) -> int:
     if x_expected is not None:
         err = float(np.max(np.abs(x_np - np.asarray(x_expected))))
         record["max_abs_error"] = err
+    if comm is not None:
+        record["comm"] = comm
+    if args.metrics and args.json:
+        from .telemetry.registry import REGISTRY
+
+        record["metrics"] = REGISTRY.snapshot()
 
     if args.json:
         ulog.emit_json(record)
@@ -664,9 +762,21 @@ def main(argv=None) -> int:
         if a.shape[0] <= 10:
             for v in x_np:
                 print(f"{v:f}")
+        if comm is not None:
+            print(f"comm    : {comm['psum']} psum, "
+                  f"{comm['ppermute']} ppermute, "
+                  f"{comm['all_gather']} all_gather, "
+                  f"{comm['comm_bytes']} payload bytes "
+                  f"(per-device; {comm['per_iteration']['comm_bytes']} "
+                  f"bytes/iter)")
         if args.history:
             print(ulog.format_history(
                 result, every=max(1, int(result.iterations) // 20)))
+        if args.metrics:
+            from .telemetry.registry import REGISTRY
+
+            print("--- metrics (prometheus text) ---")
+            print(REGISTRY.to_prometheus(), end="")
     return 0 if bool(result.converged) else 1
 
 

@@ -182,9 +182,11 @@ def _allreduce_df(hi: jax.Array, lo: jax.Array, axis_name) -> DF:
     call - 2P values instead of 2 - and, unlike an ``all_gather``
     formulation, the vma checker can infer the result replicated.
     """
+    from ..utils.compat import axis_size
+
     names = (axis_name if isinstance(axis_name, (tuple, list))
              else (axis_name,))
-    sizes = [lax.axis_size(nm) for nm in names]
+    sizes = [axis_size(nm) for nm in names]
     total = 1
     for s in sizes:
         total *= s

@@ -29,6 +29,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ...utils.compat import shape_dtype_struct
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -211,8 +213,7 @@ def stencil2d_apply(x2d: jax.Array, scale, *, bm: int = 256,
     scale_arr = jnp.asarray(scale, x2d.dtype).reshape(1, 1)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((nx, ny), x2d.dtype,
-                                       **({"vma": vma} if vma else {})),
+        out_shape=shape_dtype_struct((nx, ny), x2d.dtype, vma=vma),
         grid=(nx // bm,),
         in_specs=[pl.BlockSpec((1, 1), lambda i: (0, 0),
                                memory_space=pltpu.SMEM),
@@ -337,8 +338,7 @@ def stencil3d_apply(x3d: jax.Array, scale, *, bm: int = 32,
     scale_arr = jnp.asarray(scale, x3d.dtype).reshape(1, 1)
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((nx, ny, nz), x3d.dtype,
-                                       **({"vma": vma} if vma else {})),
+        out_shape=shape_dtype_struct((nx, ny, nz), x3d.dtype, vma=vma),
         grid=(nx // bm,),
         in_specs=[pl.BlockSpec((1, 1), lambda i: (0, 0),
                                memory_space=pltpu.SMEM),

@@ -43,6 +43,7 @@ from ..solver.df64 import (
 )
 from . import partition as part
 from .halo import exchange_halo_axis
+from ..utils.compat import shard_map
 from .mesh import make_mesh, shard_vector
 from .operators import DistShiftELLDF64Ring
 
@@ -347,7 +348,7 @@ def solve_distributed_df64(
            (float(tol), float(rtol)) if method == "minres" else None)
 
     def build():
-        @partial(jax.shard_map, mesh=mesh,
+        @partial(shard_map, mesh=mesh,
                  in_specs=(P(axis), P(axis), P(), P(), P(), P(), P(),
                            P(), P()),
                  out_specs=out)
@@ -426,7 +427,7 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
            check_every, method)
 
     def build():
-        @partial(jax.shard_map, mesh=mesh,
+        @partial(shard_map, mesh=mesh,
                  in_specs=(P(ax_x, ax_y), P(ax_x, ax_y),
                            P(), P(), P(), P(), P(), P(), P()),
                  out_specs=out)
@@ -510,7 +511,7 @@ def _solve_csr_shiftell_df64(a, b64, mesh, axis, n_shards, *, tol, rtol,
     def build():
         # check_vma=False: the pallas slab kernel cannot declare varying
         # mesh axes on its outputs (see shift_ell_matvec docstring)
-        @partial(jax.shard_map, mesh=mesh, check_vma=False,
+        @partial(shard_map, mesh=mesh, check_vma=False,
                  in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis),
                            P(axis), P(axis), P(axis), P(), P(), P(), P(),
                            P()),

@@ -39,6 +39,7 @@ from ..models.multigrid import MultigridPreconditioner
 from ..models.precond import ChebyshevPreconditioner
 from ..solver.cg import CGResult, cg
 from . import partition as part
+from ..utils.compat import shard_map
 from .mesh import make_mesh, shard_vector
 from .operators import (
     DistCSR,
@@ -127,6 +128,14 @@ def solve_distributed(
               check_every=check_every, compensated=compensated)
     precond = (preconditioner, precond_degree)
 
+    def note():
+        # after ALL validation, immediately before a dispatch - an
+        # engine_selected event means the solve actually runs
+        from ..solver.cg import _note_engine
+
+        _note_engine("distributed", method, check_every,
+                     n_shards=int(mesh.devices.size))
+
     if len(mesh.axis_names) == 2:
         # pencil decomposition: two partitioned grid axes
         if not isinstance(a, Stencil3D):
@@ -137,6 +146,7 @@ def solve_distributed(
             raise ValueError(
                 "the pencil path has no pallas matvec; re-create the "
                 "operator with backend='xla' for a 2-D mesh")
+        note()
         return _solve_pencil(a, b, mesh, precond, record_history, kw)
 
     axis = mesh.axis_names[0]
@@ -145,9 +155,11 @@ def solve_distributed(
         raise ValueError("preconditioner='mg' needs a stencil operator "
                          "(geometric multigrid has no CSR hierarchy)")
     if isinstance(a, (Stencil2D, Stencil3D)):
+        note()
         return _solve_stencil(a, b, mesh, axis, n_shards, precond,
                               record_history, kw)
     if isinstance(a, CSRMatrix):
+        note()
         return _solve_csr(a, b, mesh, axis, n_shards, precond,
                           record_history, kw, csr_comm=csr_comm)
     raise TypeError(f"solve_distributed supports CSRMatrix/Stencil2D/"
@@ -164,6 +176,16 @@ def solve_distributed(
 #: mesh, config) - a handful in any real process.
 _SOLVER_CACHE: dict = {}
 
+#: per-key jaxpr-derived communication cost (telemetry.cost.SolveCost),
+#: computed at build time only when telemetry is active - an extra
+#: abstract trace of the solve body, never an extra compile or run
+_COST_CACHE: dict = {}
+
+#: (SolveCost, context dict) of the most recent solve dispatched through
+#: the cache - how the CLI attaches per-solve comm totals to its report
+#: without re-deriving the cache key
+_LAST_COMM_COST = [None]
+
 #: incremented every time a cached solver body is TRACED (the body runs as
 #: Python only during tracing) - lets tests assert zero-retrace on public
 #: surface instead of poking jit internals
@@ -172,12 +194,106 @@ _TRACE_COUNT = [0]
 
 def clear_solver_cache() -> None:
     _SOLVER_CACHE.clear()
+    _COST_CACHE.clear()
+    _LAST_COMM_COST[0] = None
 
 
-def _cached_solver(key, build):
+def last_comm_cost():
+    """``(telemetry.cost.SolveCost, context)`` of the most recent
+    distributed solve, or ``None`` (no solve yet, or telemetry was
+    inactive so the cost walk was skipped).
+
+    Consumers attributing the cost to a specific solve must call
+    :func:`reset_last_comm_cost` before dispatching it: other
+    distributed engines (df64 / resident / streaming) do not route
+    through this cache, so without the reset a stale value from an
+    earlier ``solve_distributed`` would be misattributed (the CLI does
+    this before every run)."""
+    return _LAST_COMM_COST[0]
+
+
+def reset_last_comm_cost() -> None:
+    _LAST_COMM_COST[0] = None
+
+
+def _key_id(key) -> str:
+    """Short stable digest of a cache key for event payloads (the key
+    itself holds Mesh objects and is not JSON)."""
+    import hashlib
+
+    return hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+
+
+def _cache_metrics():
+    from ..telemetry.registry import REGISTRY
+
+    # phase label: the CLI's compile-warmup dispatch consults the cache
+    # too; without the split, one CLI solve reads as a 50% hit rate
+    return (
+        REGISTRY.counter("dist_solver_cache_hits_total",
+                         "distributed compiled-solver cache hits",
+                         labelnames=("phase",)),
+        REGISTRY.counter("dist_solver_cache_misses_total",
+                         "distributed compiled-solver cache misses "
+                         "(each one is a trace + compile)",
+                         labelnames=("phase",)),
+    )
+
+
+def _cached_solver(key, build, cost_ctx=None, cost_args=None):
+    """Fetch-or-build the jitted solver; feed telemetry on the way.
+
+    Cache consultation always updates the hit/miss counters (cheap host
+    increments).  When an event sink is active AND the call site passed
+    example args, the solve body is additionally traced ONCE per cache
+    key (``jax.make_jaxpr`` - abstract evaluation only, no compile) to
+    derive the per-iteration psum/ppermute/halo-byte account
+    (``telemetry.cost``); the result is cached beside the solver and a
+    ``comm_cost`` event is emitted per solve so every trace file is
+    self-contained.  The compiled hot loop is untouched either way.
+    """
+    from .. import telemetry
+
     fn = _SOLVER_CACHE.get(key)
+    hit = fn is not None
+    hits, misses = _cache_metrics()
+    (hits if hit else misses).inc(phase=telemetry.events.scope_phase())
+    telemetry.events.emit("dist_cache_hit" if hit else "dist_cache_miss",
+                          key=_key_id(key), kind=key[0])
     if fn is None:
         fn = _SOLVER_CACHE[key] = jax.jit(build())
+    if cost_args is not None and telemetry.active():
+        solve_cost = _COST_CACHE.get(key)
+        if solve_cost is None:
+            from ..telemetry.cost import trace_solve_cost
+
+            trips = (cost_ctx or {}).get("check_every", 1)
+            solve_cost = _COST_CACHE[key] = trace_solve_cost(
+                build(), *cost_args, iterations_per_trip=trips)
+        _LAST_COMM_COST[0] = (solve_cost, dict(cost_ctx or {}))
+        per = solve_cost.per_iteration
+        from ..telemetry.registry import REGISTRY
+
+        for gname, gval in (
+                ("dist_comm_psum_per_iteration", per.psum),
+                ("dist_comm_ppermute_per_iteration", per.ppermute),
+                ("dist_comm_all_gather_per_iteration", per.all_gather),
+                ("dist_comm_bytes_per_iteration", per.comm_bytes)):
+            REGISTRY.gauge(
+                gname, "jaxpr-derived per-iteration communication of "
+                "the most recently built distributed solve",
+                labelnames=("kind",)).set(
+                    gval, kind=str((cost_ctx or {}).get("kind", "?")))
+        telemetry.events.emit(
+            "comm_cost",
+            key=_key_id(key),
+            psum_per_iteration=per.psum,
+            ppermute_per_iteration=per.ppermute,
+            all_gather_per_iteration=per.all_gather,
+            dots_per_iteration=per.dots,
+            comm_bytes_per_iteration=per.comm_bytes,
+            setup=solve_cost.setup.to_json(),
+            **(cost_ctx or {}))
     return fn
 
 
@@ -224,7 +340,7 @@ def _solve_pencil(a, b, mesh, precond, record_history, kw) -> CGResult:
            tuple(sorted(kw.items())))
 
     def build():
-        @partial(jax.shard_map, mesh=mesh, in_specs=(P(ax_x, ax_y), P()),
+        @partial(shard_map, mesh=mesh, in_specs=(P(ax_x, ax_y), P()),
                  out_specs=out)
         def run(b_local, scale):
             _TRACE_COUNT[0] += 1
@@ -237,7 +353,10 @@ def _solve_pencil(a, b, mesh, precond, record_history, kw) -> CGResult:
                 res, x=res.x.reshape(loc.local_grid))
         return run
 
-    res = _cached_solver(key, build)(b3, local.scale)
+    ctx = dict(kind="pencil", check_every=kw["check_every"],
+               method=kw["method"], n_shards=int(sx * sy))
+    res = _cached_solver(key, build, ctx, (b3, local.scale))(
+        b3, local.scale)
     return dataclasses.replace(res, x=res.x.reshape(-1))
 
 
@@ -258,7 +377,7 @@ def _solve_stencil(a, b, mesh, axis, n_shards, precond, record_history,
            record_history, tuple(sorted(kw.items())))
 
     def build():
-        @partial(jax.shard_map, mesh=mesh, in_specs=(P(axis), P()),
+        @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()),
                  out_specs=_result_specs(axis, record_history))
         def run(b_local, scale):
             _TRACE_COUNT[0] += 1
@@ -268,7 +387,10 @@ def _solve_stencil(a, b, mesh, axis, n_shards, precond, record_history,
                       axis_name=axis, **kw)
         return run
 
-    return _cached_solver(key, build)(b, local.scale)
+    ctx = dict(kind="stencil", check_every=kw["check_every"],
+               method=kw["method"], n_shards=n_shards)
+    return _cached_solver(key, build, ctx, (b, local.scale))(
+        b, local.scale)
 
 
 def _shard_tree(tree, mesh, axis):
@@ -306,7 +428,7 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
            record_history, tuple(sorted(kw.items())))
 
     def build():
-        @partial(jax.shard_map, mesh=mesh,
+        @partial(shard_map, mesh=mesh,
                  in_specs=(P(axis), P(axis), P(axis), P(axis)),
                  out_specs=_result_specs(axis, record_history))
         def run(b_local, data_s, cols_s, rows_s):
@@ -321,7 +443,11 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
                       axis_name=axis, **kw)
         return run
 
-    res = _cached_solver(key, build)(b_dev, data, cols, rows)
+    ctx = dict(kind="csr", check_every=kw["check_every"],
+               method=kw["method"], n_shards=n_shards)
+    res = _cached_solver(key, build, ctx,
+                         (b_dev, data, cols, rows))(
+        b_dev, data, cols, rows)
     return _strip_row_padding(res, parts)
 
 
@@ -344,7 +470,7 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
     def build():
         # check_vma=False: the pallas slab kernel cannot declare varying
         # mesh axes on its outputs (see shift_ell_matvec docstring)
-        @partial(jax.shard_map, mesh=mesh, check_vma=False,
+        @partial(shard_map, mesh=mesh, check_vma=False,
                  in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
                  out_specs=_result_specs(axis, record_history))
         def run(b_local, vals_s, meta_s, blk_s, diag_s):
@@ -360,5 +486,9 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
                       axis_name=axis, **kw)
         return run
 
-    res = _cached_solver(key, build)(b_dev, vals, meta, blks, diag)
+    ctx = dict(kind="csr-shiftell", check_every=kw["check_every"],
+               method=kw["method"], n_shards=n_shards)
+    res = _cached_solver(key, build, ctx,
+                         (b_dev, vals, meta, blks, diag))(
+        b_dev, vals, meta, blks, diag)
     return _strip_row_padding(res, parts)

@@ -32,6 +32,7 @@ from ..ops.pallas.resident_dist import (
 )
 from ..solver.cg import CGResult
 from ..solver.status import CGStatus
+from ..utils.compat import shard_map
 from .mesh import make_mesh, shard_vector
 
 _CACHE: dict = {}
@@ -124,6 +125,10 @@ def solve_distributed_resident(
     b = shard_vector(jnp.asarray(b, jnp.float32), mesh, axis)
     interpret = _pallas_interpret()
 
+    from ..solver.cg import _note_engine
+
+    _note_engine("distributed-resident", "cg", check_every,
+                 n_shards=n_shards)
     key = ("resident_dist", local_shape, n_shards, axis, mesh, maxiter,
            check_every, interpret, detect_races, degree)
     fn = _CACHE.get(key)
@@ -143,7 +148,7 @@ def _build(mesh, axis, n_shards, local_shape, maxiter, check_every,
         x=P(axis), iterations=P(), residual_norm=P(), converged=P(),
         status=P(), indefinite=P(), residual_history=None)
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(P(axis), P(), P(), P(), P(), P(), P()),
              out_specs=out_specs, check_vma=False)
     def run(b_local, scale, tol, rtol, cap, lmin, lmax):

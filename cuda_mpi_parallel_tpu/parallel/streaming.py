@@ -38,6 +38,7 @@ from ..ops.pallas.fused_cg import (
 from ..solver.cg import CGResult, _blocked_while, _safe_div, _threshold_sq
 from ..solver.status import CGStatus
 from .halo import exchange_halo
+from ..utils.compat import shard_map
 from .mesh import make_mesh, shard_vector
 
 #: compiled-solver cache, same policy as ``dist_cg._SOLVER_CACHE``
@@ -96,6 +97,10 @@ def solve_distributed_streaming(
     b = shard_vector(jnp.asarray(b, jnp.float32), mesh, axis)
     interpret = _pallas_interpret()
 
+    from ..solver.cg import _note_engine
+
+    _note_engine("distributed-streaming", "cg", check_every,
+                 n_shards=n_shards)
     key = ("streaming", local_grid, n_shards, axis, mesh, maxiter,
            check_every, bm, interpret)
     fn = _CACHE.get(key)
@@ -113,7 +118,7 @@ def _build(mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
         x=P(axis), iterations=P(), residual_norm=P(), converged=P(),
         status=P(), indefinite=P(), residual_history=None)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(P(axis), P(), P(), P()),
+    @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(), P(), P()),
              out_specs=out_specs, check_vma=False)
     def run(b_local, scale, tol, rtol):
         b_grid = b_local.reshape(local_grid)
@@ -273,7 +278,7 @@ def _build_df64(mesh, axis, n_shards, local_grid, maxiter, check_every,
         lo_l, hi_l = exchange_halo(u[1], axis, n_shards)
         return ((lo_h, lo_l), (hi_h, hi_l))
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map, mesh=mesh,
              in_specs=(P(axis), P(axis), P(), P(), P(), P()),
              out_specs=out_specs, check_vma=False)
     def run(bh_local, bl_local, scale_h, scale_l, tol2_s, rtol2_s):

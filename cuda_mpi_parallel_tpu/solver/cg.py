@@ -54,6 +54,41 @@ from ..ops import blas1
 from .status import CGStatus
 
 
+def _note_engine(engine: str, method: str, check_every: int,
+                 **extra) -> None:
+    """Telemetry: record which engine actually runs the solve.  Host-side
+    only (an event + a counter); never touches device values, so the
+    traced/compiled solve is identical with telemetry on or off.
+    ``extra`` rides on the event (not the metric labels - cardinality
+    stays bounded)."""
+    from ..telemetry import events as _tev
+    from ..telemetry.registry import REGISTRY
+
+    REGISTRY.counter(
+        "solver_engine_selected_total",
+        "dispatches, by engine/method/phase (phase='warmup' = the "
+        "CLI's compile dispatch; filter phase='solve' for per-solve "
+        "counts)",
+        labelnames=("engine", "method", "phase")).inc(
+            engine=engine, method=method, phase=_tev.scope_phase())
+    _tev.emit("engine_selected", engine=engine, method=method,
+              check_every=check_every, **extra)
+
+
+def _note_rejected(engine: str, reason: str) -> None:
+    """Telemetry: a fast path was considered and declined (or an explicit
+    engine request failed its eligibility gate)."""
+    from ..telemetry import events as _tev
+    from ..telemetry.registry import REGISTRY
+
+    REGISTRY.counter(
+        "solver_engine_rejected_total",
+        "fast-path eligibility rejections, by engine and phase",
+        labelnames=("engine", "phase")).inc(
+            engine=engine, phase=_tev.scope_phase())
+    _tev.emit("eligibility_rejected", engine=engine, reason=reason)
+
+
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=("x", "r", "p", "rho", "rr", "nrm0", "k", "indefinite"),
@@ -786,6 +821,8 @@ def solve(
                         return_checkpoint=return_checkpoint,
                         compensated=compensated))
         if engine == "resident" and not eligible:
+            _note_rejected("resident", "explicit engine='resident' "
+                           "failed the eligibility gate")
             raise ValueError(
                 "engine='resident' needs a float32 2D/3D stencil whose "
                 "CG working set fits VMEM, a float32 rhs, m=None or a "
@@ -800,6 +837,9 @@ def solve(
                                record_history=record_history,
                                method=method,
                                interpret=_pallas_interpret())
+        if engine == "auto":
+            _note_rejected("resident", "auto: resident_eligible "
+                           "returned False")
     if engine in ("auto", "streaming"):
         from ..models.operators import _pallas_interpret
         from .streaming import cg_streaming, streaming_eligible
@@ -813,6 +853,8 @@ def solve(
                         compensated=compensated,
                         record_history=record_history))
         if engine == "streaming" and not eligible:
+            _note_rejected("streaming", "explicit engine='streaming' "
+                           "failed the eligibility gate")
             raise ValueError(
                 "engine='streaming' needs a float32 2D/3D stencil "
                 "satisfying the slab tiling (2D: nx % 8 == 0, "
@@ -827,12 +869,16 @@ def solve(
                                 iter_cap=iter_cap, m=m,
                                 record_history=record_history,
                                 interpret=_pallas_interpret())
+        if engine == "auto":
+            _note_rejected("streaming", "auto: streaming_eligible "
+                           "returned False")
     b = jnp.asarray(b)
     if not jnp.issubdtype(b.dtype, jnp.floating):
         b = b.astype(jnp.result_type(float))
     tol_a = jnp.asarray(tol, b.dtype)
     rtol_a = jnp.asarray(rtol, b.dtype)
     cap_a = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
+    _note_engine("general", method, check_every)
     return _solve_jit(a, b, x0, tol_a, rtol_a, maxiter, m, record_history,
                       None, resume_from, return_checkpoint, cap_a,
                       check_every, method, compensated)

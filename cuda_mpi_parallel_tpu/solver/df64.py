@@ -445,12 +445,15 @@ def _chebyshev_apply(mv, r: df.DF, theta: df.DF, delta: df.DF,
 
 def _pcast_varying(pair, axis_name):
     """Mark a fresh (unvarying) df64 pair device-varying over one mesh
-    axis name or a tuple of them (pencil meshes)."""
+    axis name or a tuple of them (pencil meshes).  The identity on jax
+    versions without VMA tracking (``utils.compat.pcast_varying``)."""
+    from ..utils.compat import pcast_varying
+
     names = (axis_name if isinstance(axis_name, (tuple, list))
              else (axis_name,))
     out = pair
     for nm in names:
-        out = tuple(lax.pcast(v, nm, to="varying") for v in out)
+        out = tuple(pcast_varying(v, nm) for v in out)
     return out
 
 

@@ -237,6 +237,9 @@ def cg_resident(
             "cg_resident method='cg1' is unpreconditioned (the "
             "preconditioned Chronopoulos-Gear form needs a third "
             "reduction)")
+    from .cg import _note_engine
+
+    _note_engine("resident", method, check_every)
     kernel_fn = cg_resident_2d if len(grid) == 2 else cg_resident_3d
     x2d, iters, rr, indef, conv, health, hist = kernel_fn(
         a.scale, b_grid, x0=x0, tol=tol, rtol=rtol, maxiter=maxiter,
@@ -403,6 +406,9 @@ def cg_resident_df64(
     scale64 = np.float64(np.asarray(a.scale, dtype=np.float64))
     sh, sl = df.split_f64(scale64)
 
+    from .cg import _note_engine
+
+    _note_engine("resident-df64", "cg", check_every)
     kernel_fn = (cg_resident_df64_2d if len(grid) == 2
                  else cg_resident_df64_3d)
     xh, xl, iters, rr, indef, conv, health, hist = kernel_fn(

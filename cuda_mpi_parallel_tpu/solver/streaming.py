@@ -343,6 +343,9 @@ def cg_streaming(
         degree = int(m.degree)
         lmin = jnp.asarray(m.lmin, jnp.float32)
         lmax = jnp.asarray(m.lmax, jnp.float32)
+    from .cg import _note_engine
+
+    _note_engine("streaming", "cg", check_every)
     bm = pick_block_streaming(grid)
     cap = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
     x, k, nrm, converged, status, indef, hist = _cg_streaming_call(
@@ -435,6 +438,9 @@ def cg_streaming_df64(
     # itemsize=8: every df64 plane is an (hi, lo) f32 pair, so the
     # kernels hold twice the slabs per block-height - round 5's bm=16
     # 3D picker OOM'd Mosaic's scoped VMEM when modeled at 4 bytes
+    from .cg import _note_engine
+
+    _note_engine("streaming-df64", "cg", check_every)
     bm = pick_block_streaming(grid, itemsize=8)
     cap = jnp.asarray(maxiter if iter_cap is None else iter_cap,
                       jnp.int32)

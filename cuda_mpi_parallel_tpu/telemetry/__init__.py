@@ -1,0 +1,72 @@
+"""solve-trace: the framework's observability subsystem.
+
+The reference's entire observability story is a ``printf`` of the
+solution vector (``CUDACG.cu:361-365``, SURVEY quirk Q7) - no iteration
+count, no timing, no communication accounting.  This package closes
+that gap with four composable parts:
+
+* :mod:`.registry` - a process-wide metrics registry (counters, gauges,
+  histograms with labels; JSON and Prometheus-text exposition);
+* :mod:`.events` - a JSONL solve-trace emitter with typed events
+  (``solve_start``, ``engine_selected``, ``eligibility_rejected``,
+  ``dist_cache_hit``/``dist_cache_miss``, ``check_block``,
+  ``comm_cost``, ``solve_end``) carrying monotonic timestamps and a
+  solve id;
+* :mod:`.cost` - jaxpr-derived op accounting: walk a traced solve to
+  count SpMV/dot/psum/ppermute per loop trip and derive halo bytes
+  from the collective payload avals, so per-solve communication volume
+  is STATIC per iteration x ``CGResult.iterations`` - the compiled hot
+  loop is never perturbed and never forced to sync (graftlint GL105
+  clean by construction);
+* :mod:`.session` - ``observe_solve(...)``, a context manager that
+  composes ``utils.timing.Timer`` phase sections with ``jax.profiler``
+  traces and the event stream.
+
+Everything is opt-in: with no event sink configured and metrics
+untouched, every instrumentation hook in the solver/parallel layers is
+a cheap host-side no-op, and the traced computation is bit-identical
+either way (asserted by tests/test_cost_accounting.py).
+"""
+from __future__ import annotations
+
+from . import cost, events, registry, session
+from .events import EventStream, configure, emit, validate_event
+from .registry import REGISTRY, MetricsRegistry
+from .session import observe_solve
+
+
+#: set by force_active(): opts into the build-time cost accounting even
+#: with no event sink (the CLI's --metrics does this - comm gauges are
+#: useful without a trace file)
+_FORCED = [False]
+
+
+def force_active(on: bool = True) -> None:
+    """Opt into telemetry-driven derived work (the build-time jaxpr cost
+    walk) without configuring an event sink.  Metrics counters always
+    run; this flag only gates the extras that cost something."""
+    _FORCED[0] = bool(on)
+
+
+def active() -> bool:
+    """True when any telemetry consumer is attached (an event sink is
+    configured, or ``force_active`` was called).  Instrumentation sites
+    use this to skip work - e.g. the build-time jaxpr cost walk in
+    ``parallel.dist_cg`` - that only exists to feed telemetry."""
+    return _FORCED[0] or events.active()
+
+
+__all__ = [
+    "EventStream",
+    "MetricsRegistry",
+    "REGISTRY",
+    "active",
+    "configure",
+    "cost",
+    "emit",
+    "events",
+    "observe_solve",
+    "registry",
+    "session",
+    "validate_event",
+]

@@ -1,0 +1,328 @@
+"""jaxpr-derived op and communication accounting for solves.
+
+Node-aware SpMV (PAPERS: arXiv 1612.08060) and GPGPU-cluster SpMV
+scaling (arXiv 1112.5588) both show that communication VOLUME - not
+flop count - governs distributed SpMV performance.  This module makes
+that volume a first-class, *measured-from-the-program* quantity: walk
+the traced solve's jaxpr, count the primitives that matter (``psum``,
+``ppermute``, ``all_gather``, ``dot_general``) per loop trip, and sum
+each collective's payload bytes from its input avals (a halo
+``ppermute`` carries exactly one boundary plane of
+``parallel/halo.exchange_halo``, so payload bytes ARE halo bytes).
+
+The accounting is STATIC: a CG iteration issues the same collectives
+every trip, so per-solve totals are ``per_iteration x
+CGResult.iterations + setup``.  Nothing is ever inserted into the
+compiled hot loop - no device-side counters, no host syncs - which is
+what keeps the instrumented and uninstrumented jaxprs bit-identical
+(asserted by tests) and graftlint GL105 clean by construction.
+
+Terminology: a *loop trip* is one execution of a ``lax.while_loop``
+body.  With ``check_every=1`` (the default) one trip is one CG
+iteration; with ``check_every=k`` the main loop's trip is a k-iteration
+block (``solver.cg._blocked_while``) and callers pass
+``iterations_per_trip=k`` to normalize.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import Counter as _Counter
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+import jax
+
+__all__ = [
+    "COLLECTIVE_PRIMITIVES",
+    "OpCounts",
+    "SolveCost",
+    "analytic_solve_ops",
+    "jaxpr_solve_cost",
+    "stencil_halo_bytes_per_iteration",
+    "trace_solve_cost",
+]
+
+#: primitive names whose payload moves over the interconnect
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class OpCounts:
+    """Primitive counts plus collective payload bytes for one region."""
+
+    ops: Mapping[str, int]
+    comm_bytes: int = 0
+
+    def get(self, name: str) -> int:
+        return int(self.ops.get(name, 0))
+
+    @property
+    def psum(self) -> int:
+        return self.get("psum")
+
+    @property
+    def ppermute(self) -> int:
+        return self.get("ppermute")
+
+    @property
+    def all_gather(self) -> int:
+        return self.get("all_gather")
+
+    @property
+    def dots(self) -> int:
+        return self.get("dot_general")
+
+    @property
+    def collectives(self) -> int:
+        return sum(v for k, v in self.ops.items()
+                   if k in COLLECTIVE_PRIMITIVES)
+
+    def scaled(self, factor: float) -> "OpCounts":
+        """Counts scaled by ``factor`` (e.g. 1/check_every); exact
+        integer results stay ints."""
+        def scale(v):
+            s = v * factor
+            return int(s) if float(s).is_integer() else s
+
+        return OpCounts(
+            ops={k: scale(v) for k, v in self.ops.items()},
+            comm_bytes=scale(self.comm_bytes))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"ops": dict(sorted(self.ops.items())),
+                "comm_bytes": self.comm_bytes}
+
+
+@dataclasses.dataclass(frozen=True)
+class SolveCost:
+    """The cost decomposition of one traced solve.
+
+    ``per_iteration`` is the main loop's per-trip counts normalized by
+    ``iterations_per_trip``; ``setup`` is everything outside loop
+    bodies (init matvec/reductions, result assembly); ``loops`` holds
+    the raw per-trip counts of every ``while`` encountered, outermost
+    first (the main solve loop, then e.g. the ``check_every`` tail
+    loop).
+    """
+
+    setup: OpCounts
+    per_iteration: OpCounts
+    loops: Tuple[OpCounts, ...]
+
+    def totals(self, iterations: int) -> OpCounts:
+        """Whole-solve counts for a solve that ran ``iterations``
+        iterations: ``setup + iterations * per_iteration``."""
+        ops = _Counter({k: int(v) for k, v in self.setup.ops.items()})
+        for k, v in self.per_iteration.ops.items():
+            ops[k] += v * iterations
+        return OpCounts(
+            ops=dict(ops),
+            comm_bytes=self.setup.comm_bytes
+            + self.per_iteration.comm_bytes * iterations)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"setup": self.setup.to_json(),
+                "per_iteration": self.per_iteration.to_json(),
+                "n_loops": len(self.loops)}
+
+
+def _inner_jaxpr(j):
+    """ClosedJaxpr | Jaxpr -> the core Jaxpr."""
+    return j.jaxpr if hasattr(j, "jaxpr") else j
+
+
+def _aval_bytes(var) -> int:
+    aval = var.aval
+    shape = getattr(aval, "shape", ())
+    dtype = getattr(aval, "dtype", None)
+    itemsize = dtype.itemsize if dtype is not None else 0
+    return int(math.prod(shape)) * int(itemsize)
+
+
+def _payload_bytes(eqn) -> int:
+    """Bytes a collective moves per device: the sum of its input avals
+    (for ``ppermute`` on a halo plane this is exactly the
+    ``parallel/halo.exchange_halo`` boundary-slab size)."""
+    return sum(_aval_bytes(v) for v in eqn.invars
+               if hasattr(v, "aval"))
+
+
+def _param_jaxprs(params: Mapping[str, Any]):
+    """Every jaxpr-like value in an eqn's params (pjit/shard_map/
+    custom_jvp/remat/... - anything not special-cased by the walker)."""
+    for value in params.values():
+        for item in (value if isinstance(value, (tuple, list)) else
+                     (value,)):
+            if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                yield _inner_jaxpr(item)
+
+
+def _merge_scaled(dst: _Counter, bytes_box: List[int], src: _Counter,
+                  src_bytes: int, mult: int) -> None:
+    for k, v in src.items():
+        dst[k] += v * mult
+    bytes_box[0] += src_bytes * mult
+
+
+def _walk(jaxpr, counts: _Counter, bytes_box: List[int],
+          loops: Optional[List[OpCounts]], mult: int) -> None:
+    """Accumulate primitive counts and collective payload bytes.
+
+    ``loops`` records the per-trip counts of each TOP-LEVEL ``while``
+    (outermost region only - a nested while's one-trip counts are
+    already folded into its parent's trip, so recording it again would
+    double-account it in setup subtraction); pass ``None`` to disable
+    recording in nested regions.  Loop-carrying wrappers that are not
+    themselves loops (``pjit``, ``shard_map``, ``custom_*``) keep
+    recording enabled, so the main solve loop is found through any
+    stack of them.
+    """
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "while":
+            body = _inner_jaxpr(eqn.params["body_jaxpr"])
+            cond = _inner_jaxpr(eqn.params["cond_jaxpr"])
+            trip_counts: _Counter = _Counter()
+            trip_bytes = [0]
+            _walk(body, trip_counts, trip_bytes, None, 1)
+            _walk(cond, trip_counts, trip_bytes, None, 1)
+            if loops is not None:
+                loops.append(OpCounts(ops=dict(trip_counts),
+                                      comm_bytes=trip_bytes[0]))
+            # Trip count is dynamic (that is the point of a while); the
+            # TOTALS account one trip, and callers scale by the actual
+            # iteration count via SolveCost.totals().
+            _merge_scaled(counts, bytes_box, trip_counts, trip_bytes[0],
+                          mult)
+        elif name == "scan":
+            length = int(eqn.params.get("length", 1))
+            inner = _inner_jaxpr(eqn.params["jaxpr"])
+            inner_counts: _Counter = _Counter()
+            inner_bytes = [0]
+            _walk(inner, inner_counts, inner_bytes, None, 1)
+            # static trip count: totals are exact
+            _merge_scaled(counts, bytes_box, inner_counts,
+                          inner_bytes[0], mult * length)
+        elif name == "cond":
+            # branches may differ (e.g. pipecg's periodic residual
+            # replacement); account the WORST branch per op - a
+            # conservative upper bound for communication budgeting.
+            branch_counts: List[Tuple[_Counter, int]] = []
+            for branch in eqn.params["branches"]:
+                c: _Counter = _Counter()
+                bb = [0]
+                _walk(_inner_jaxpr(branch), c, bb, None, 1)
+                branch_counts.append((c, bb[0]))
+            worst: _Counter = _Counter()
+            for c, _ in branch_counts:
+                for k, v in c.items():
+                    worst[k] = max(worst[k], v)
+            worst_bytes = max((bb for _, bb in branch_counts), default=0)
+            _merge_scaled(counts, bytes_box, worst, worst_bytes, mult)
+        else:
+            counts[name] += mult
+            if name in COLLECTIVE_PRIMITIVES:
+                bytes_box[0] += _payload_bytes(eqn) * mult
+            for sub in _param_jaxprs(eqn.params):
+                _walk(sub, counts, bytes_box, loops, mult)
+
+
+def jaxpr_solve_cost(closed_jaxpr, *,
+                     iterations_per_trip: int = 1) -> SolveCost:
+    """Decompose a traced solve's jaxpr into setup + per-iteration costs.
+
+    ``closed_jaxpr`` is the output of ``jax.make_jaxpr(solve_fn)(args)``
+    - typically a ``shard_map``-wrapped CG body whose loop contains the
+    psum/ppermute collectives of interest.  ``iterations_per_trip``
+    normalizes blocked loops (``check_every=k`` -> k).
+    """
+    if iterations_per_trip < 1:
+        raise ValueError(
+            f"iterations_per_trip must be >= 1, got {iterations_per_trip}")
+    totals: _Counter = _Counter()
+    total_bytes = [0]
+    loops: List[OpCounts] = []
+    _walk(_inner_jaxpr(closed_jaxpr), totals, total_bytes, loops, 1)
+
+    if loops:
+        main = loops[0]
+        per_iter = main.scaled(1.0 / iterations_per_trip) \
+            if iterations_per_trip > 1 else main
+        # setup = totals minus the ONE trip the walker merged for each
+        # top-level loop (``loops`` holds exactly those)
+        setup_ops = _Counter(totals)
+        for loop in loops:
+            for k, v in loop.ops.items():
+                setup_ops[k] -= v
+        setup = OpCounts(
+            ops={k: v for k, v in setup_ops.items() if v},
+            comm_bytes=total_bytes[0] - sum(l.comm_bytes for l in loops))
+    else:
+        main = OpCounts(ops={})
+        per_iter = main
+        setup = OpCounts(ops=dict(totals), comm_bytes=total_bytes[0])
+    return SolveCost(setup=setup, per_iteration=per_iter,
+                     loops=tuple(loops))
+
+
+def trace_solve_cost(fn: Callable, *args,
+                     iterations_per_trip: int = 1,
+                     **kwargs) -> SolveCost:
+    """Trace ``fn(*args, **kwargs)`` (no execution, no compile) and
+    return its :class:`SolveCost`.  The trace is the same abstract
+    evaluation jit performs, so the accounted program IS the program
+    that runs."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return jaxpr_solve_cost(closed, iterations_per_trip=iterations_per_trip)
+
+
+def stencil_halo_bytes_per_iteration(grid: Tuple[int, ...],
+                                     itemsize: int,
+                                     matvecs_per_iteration: int = 1) -> int:
+    """Analytic per-device halo traffic of a slab-partitioned stencil.
+
+    One matvec exchanges one boundary plane with each neighbor
+    (``parallel/halo.exchange_halo``: one forward + one backward
+    ``ppermute``, payload ``grid[1:]`` each).  This is the
+    cross-check for the jaxpr-derived ``comm_bytes`` - tests assert
+    the two agree exactly.
+    """
+    plane = int(math.prod(grid[1:])) if len(grid) > 1 else 1
+    return 2 * plane * itemsize * matvecs_per_iteration
+
+
+#: Analytic per-iteration op model of the solver recurrences, straight
+#: from the implementations in ``solver/cg.py`` (and the reference's
+#: loop for "cg": 1 SpMV ``CUDACG.cu:295``, 2 reductions ``:304,328``,
+#: 3 vector updates ``:314,320,342-347``).  ``axpy`` counts xpby/axpy
+#: class fused vector updates.
+_METHOD_OPS = {
+    # method -> (spmv, dots, axpy) per iteration, unpreconditioned
+    "cg": (1, 2, 3),
+    "cg1": (1, 2, 4),      # dots fused into ONE reduction (s = A p axpy)
+    "pipecg": (1, 2, 6),   # one fused reduction; s/q/z recurrences
+    "minres": (1, 2, 5),   # Lanczos + two Givens updates
+}
+
+
+def analytic_solve_ops(method: str = "cg",
+                       preconditioned: bool = False,
+                       precond_matvecs: int = 0) -> Dict[str, int]:
+    """Per-iteration SpMV/dot/axpy model for a solver recurrence.
+
+    ``preconditioned`` adds the extra ``r . z`` inner product and one
+    preconditioner application per iteration; ``precond_matvecs`` is
+    the application's own matvec count (e.g. ``degree - 1`` for a
+    Chebyshev polynomial), folded into ``spmv``.
+    """
+    if method not in _METHOD_OPS:
+        raise ValueError(f"unknown method {method!r}; expected one of "
+                         f"{sorted(_METHOD_OPS)}")
+    spmv, dots, axpy = _METHOD_OPS[method]
+    if preconditioned:
+        dots += 1
+        spmv += precond_matvecs
+    return {"spmv": spmv, "dot": dots, "axpy": axpy}

@@ -1,0 +1,256 @@
+"""Structured solve-trace events: one JSON object per line (JSONL).
+
+Every solve can emit a typed trace of what the framework decided and
+measured on its behalf - which engine ran, why a fast path was
+rejected, whether the distributed solver cache hit, what the
+communication cost model says, and how the solve ended.  The reference
+records none of this (its only output is the solution vector,
+``CUDACG.cu:361-365``); a serving deployment cannot be debugged without
+it.
+
+Design rules:
+
+* **Opt-in and free when off.**  ``emit()`` with no sink configured is
+  a dict-build away from a no-op; no file handle, no formatting.
+* **Host-side only.**  Events carry host scalars.  Emission never
+  reads a device value, so instrumentation can never force a transfer
+  into (or a sync after) a solve - results are read only by consumers
+  that already synced (``session.observe_solve``'s epilogue, the CLI's
+  post-``time_fn`` reporting).
+* **Strict JSON.**  Payloads pass through ``utils.logging.sanitize``
+  (non-finite floats -> ``null``) and are serialized with
+  ``allow_nan=False``, so a trace file is always parseable by strict
+  readers (jq/BigQuery) - the same bug class fixed in
+  ``utils.logging.emit_json``.
+
+Event schema (``EVENT_SCHEMA``): each event has ``event`` (type name),
+``t`` (monotonic seconds, ``time.perf_counter`` - durations between
+events are meaningful, absolute values are not), ``solve_id`` (opaque
+string tying one solve's events together; ``None`` outside a solve
+scope), plus per-type required fields listed below.  Unknown extra
+fields are allowed - the schema floor is what consumers may rely on.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import io
+import itertools
+import json
+import threading
+import time
+from typing import Any, Dict, IO, Iterator, Optional, Union
+
+from ..utils.logging import sanitize
+
+__all__ = [
+    "EVENT_SCHEMA",
+    "EventStream",
+    "active",
+    "configure",
+    "current_solve_id",
+    "emit",
+    "new_solve_id",
+    "scoped",
+    "solve_scope",
+    "validate_event",
+]
+
+#: event type -> field names REQUIRED beyond the common envelope
+#: (event, t, solve_id).  Extra fields are always permitted.
+EVENT_SCHEMA: Dict[str, tuple] = {
+    # a solve was requested: problem/config description
+    "solve_start": ("label",),
+    # which engine/method actually runs the solve
+    "engine_selected": ("engine", "method"),
+    # a fast path was considered and declined (engine= the declined one)
+    "eligibility_rejected": ("engine", "reason"),
+    # the distributed compiled-solver cache was consulted
+    "dist_cache_hit": ("key",),
+    "dist_cache_miss": ("key",),
+    # one convergence-check block boundary (post-solve, from the
+    # recorded residual history - NOT emitted from inside the hot loop)
+    "check_block": ("iteration",),
+    # jaxpr-derived communication cost of the compiled solve body
+    "comm_cost": ("psum_per_iteration", "ppermute_per_iteration",
+                  "comm_bytes_per_iteration"),
+    # the solve finished (converged or not) and was synced
+    "solve_end": ("status", "iterations", "residual_norm"),
+}
+
+_COUNTER = itertools.count(1)
+_SOLVE_ID: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "cuda_mpi_parallel_tpu_solve_id", default=None)
+_SCOPE_FIELDS: contextvars.ContextVar[Dict[str, Any]] = \
+    contextvars.ContextVar("cuda_mpi_parallel_tpu_event_fields",
+                           default={})
+
+
+@contextlib.contextmanager
+def scoped(**fields: Any) -> Iterator[None]:
+    """Attach ``fields`` to every event emitted inside the block.
+
+    The honest answer to double-dispatch: a CLI solve runs once for
+    compile warmup and once timed, and BOTH dispatches really happen -
+    so both emit, but the warmup's events carry ``phase="warmup"`` and
+    consumers filter rather than miscount.  Explicit emit() fields win
+    over scope fields on collision.
+    """
+    merged = dict(_SCOPE_FIELDS.get())
+    merged.update(fields)
+    token = _SCOPE_FIELDS.set(merged)
+    try:
+        yield
+    finally:
+        _SCOPE_FIELDS.reset(token)
+
+
+def scope_phase() -> str:
+    """The current emission scope's phase ("solve" unless inside
+    ``scoped(phase=...)``).  Metric-updating instrumentation uses this
+    as a label so dispatch counters can be split the same way the
+    event stream is (e.g. the CLI's compile-warmup dispatch)."""
+    return str(_SCOPE_FIELDS.get().get("phase", "solve"))
+
+
+def new_solve_id() -> str:
+    """Process-unique opaque id: monotonic counter + coarse timestamp."""
+    return f"s{next(_COUNTER):06d}-{int(time.time())}"
+
+
+def current_solve_id() -> Optional[str]:
+    return _SOLVE_ID.get()
+
+
+@contextlib.contextmanager
+def solve_scope(solve_id: Optional[str] = None) -> Iterator[str]:
+    """Bind a solve id so every ``emit`` inside the block carries it."""
+    sid = solve_id if solve_id is not None else new_solve_id()
+    token = _SOLVE_ID.set(sid)
+    try:
+        yield sid
+    finally:
+        _SOLVE_ID.reset(token)
+
+
+class EventStream:
+    """A JSONL sink.  ``path_or_stream`` is a filesystem path (opened
+    append, line-buffered flushes) or any ``.write()``-able object."""
+
+    def __init__(self, path_or_stream: Union[str, IO[str]]):
+        if isinstance(path_or_stream, (str, bytes)):
+            self._fh: IO[str] = open(path_or_stream, "a", encoding="utf-8")
+            self._owns = True
+        else:
+            self._fh = path_or_stream
+            self._owns = False
+        self._lock = threading.Lock()
+
+    def emit(self, event_type: str, **fields: Any) -> Dict[str, Any]:
+        record = _build_event(event_type, fields)
+        line = json.dumps(sanitize(record), allow_nan=False,
+                          sort_keys=True)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        return record
+
+    def close(self) -> None:
+        if self._owns:
+            self._fh.close()
+
+    def __enter__(self) -> "EventStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _build_event(event_type: str, fields: Dict[str, Any]) -> Dict[str, Any]:
+    if event_type not in EVENT_SCHEMA:
+        raise ValueError(
+            f"unknown event type {event_type!r}; known: "
+            f"{sorted(EVENT_SCHEMA)}")
+    record = {"event": event_type, "t": time.perf_counter(),
+              "solve_id": current_solve_id()}
+    record.update(_SCOPE_FIELDS.get())
+    record.update(fields)
+    missing = [f for f in EVENT_SCHEMA[event_type] if f not in record]
+    if missing:
+        raise ValueError(
+            f"event {event_type!r} missing required fields: {missing}")
+    return record
+
+
+def validate_event(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Check one parsed JSONL record against the schema; returns it.
+
+    Raises ``ValueError`` on an unknown type, a missing envelope or
+    required field, or a payload that is not strict JSON (tested by
+    re-serializing with ``allow_nan=False``).
+    """
+    if not isinstance(record, dict):
+        raise ValueError(f"event record must be an object, got "
+                         f"{type(record).__name__}")
+    etype = record.get("event")
+    if etype not in EVENT_SCHEMA:
+        raise ValueError(f"unknown event type {etype!r}")
+    for field in ("t", "solve_id") + EVENT_SCHEMA[etype]:
+        if field not in record:
+            raise ValueError(f"event {etype!r} missing field {field!r}")
+    if not isinstance(record["t"], (int, float)):
+        raise ValueError(f"event timestamp must be numeric, got "
+                         f"{record['t']!r}")
+    json.dumps(record, allow_nan=False)   # strict-JSON payload check
+    return record
+
+
+# ---------------------------------------------------------------------------
+# module-level default sink (what instrumentation sites talk to)
+
+_SINK: Optional[EventStream] = None
+
+
+def configure(path_or_stream: Union[str, IO[str], None]) -> None:
+    """Install (or with ``None`` remove) the process-default event sink.
+
+    Instrumented call sites all emit through this module-level sink, so
+    one ``configure("trace.jsonl")`` - or the CLI's
+    ``--trace-events PATH`` - traces every solve in the process.
+    """
+    global _SINK
+    if _SINK is not None:
+        _SINK.close()
+        _SINK = None
+    if path_or_stream is not None:
+        _SINK = EventStream(path_or_stream)
+
+
+def active() -> bool:
+    """True when a default sink is installed."""
+    return _SINK is not None
+
+
+def emit(event_type: str, **fields: Any) -> Optional[Dict[str, Any]]:
+    """Emit to the default sink; a cheap no-op when none is configured.
+
+    Returns the emitted record (or ``None`` when inactive) so call
+    sites can reuse the payload.
+    """
+    if _SINK is None:
+        return None
+    return _SINK.emit(event_type, **fields)
+
+
+@contextlib.contextmanager
+def capture() -> Iterator[io.StringIO]:
+    """Route the default sink into an in-memory buffer for the block
+    (tests; restores the previous sink on exit)."""
+    global _SINK
+    prev = _SINK
+    buf = io.StringIO()
+    _SINK = EventStream(buf)
+    try:
+        yield buf
+    finally:
+        _SINK = prev

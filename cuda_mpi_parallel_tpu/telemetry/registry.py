@@ -1,0 +1,289 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+A deliberately small, dependency-free subset of the Prometheus client
+model - enough for the north star ("serves heavy traffic") without
+pulling a client library the container does not ship.  Metrics are
+host-side Python state only: incrementing a counter never touches a
+device value, so instrumentation can never force a sync into a solve
+(graftlint GL105).
+
+Exposition formats:
+
+* ``REGISTRY.snapshot()`` - a JSON-serializable dict (embedded in
+  ``bench_results.json`` and the CLI's ``--metrics`` output);
+* ``REGISTRY.to_prometheus()`` - the Prometheus text format, one
+  ``name{labels} value`` line per child, for scrape endpoints.
+
+Thread-safe: one process-wide lock guards child creation and updates
+(solves may be issued from serving threads).
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY"]
+
+#: default histogram buckets (seconds-flavored, matching solve times
+#: from sub-ms resident kernels to multi-minute 256^3 streaming runs)
+DEFAULT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0, 60.0, 300.0)
+
+
+def _label_key(labelnames: Sequence[str], labels: Dict[str, str]) -> Tuple:
+    if set(labels) != set(labelnames):
+        raise ValueError(
+            f"metric labels {sorted(labels)} != declared {sorted(labelnames)}")
+    return tuple(str(labels[name]) for name in labelnames)
+
+
+def _format_labels(labelnames: Sequence[str], key: Tuple,
+                   extra: Optional[Tuple[str, str]] = None) -> str:
+    pairs = list(zip(labelnames, key))
+    if extra is not None:
+        pairs.append(extra)
+    if not pairs:
+        return ""
+    body = ",".join(
+        '{}="{}"'.format(n, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for n, v in pairs)
+    return "{" + body + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (), *, lock=None):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock if lock is not None else threading.Lock()
+        self._children: Dict[Tuple, float] = {}
+
+    def _update(self, labels: Dict[str, str], fn) -> None:
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._children[key] = fn(self._children.get(key))
+
+    def value(self, **labels: str) -> float:
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            return self._children.get(key, 0.0)
+
+    def snapshot(self):
+        with self._lock:
+            return [
+                {"labels": dict(zip(self.labelnames, key)), "value": val}
+                for key, val in sorted(self._children.items())
+            ]
+
+    def prometheus_lines(self) -> List[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        with self._lock:
+            for key, val in sorted(self._children.items()):
+                lines.append(
+                    f"{self.name}{_format_labels(self.labelnames, key)} "
+                    f"{_format_value(val)}")
+        return lines
+
+
+def _format_value(v: float) -> str:
+    # Prometheus text format supports the NaN/+Inf/-Inf literals; a
+    # non-finite observation must render, not poison every later scrape
+    # (int(nan) raises).
+    if math.isnan(v):
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    as_int = int(v)
+    return str(as_int) if v == as_int else repr(float(v))
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (resets only with the process)."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError(f"counters only go up (amount={amount})")
+        self._update(labels, lambda old: (old or 0.0) + amount)
+
+
+class Gauge(_Metric):
+    """A value that can go up and down (or be set outright)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        self._update(labels, lambda old: float(value))
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        self._update(labels, lambda old: (old or 0.0) + amount)
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations <= its upper bound; ``+Inf`` is implicit)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS, *, lock=None):
+        super().__init__(name, help, labelnames, lock=lock)
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bounds
+        # children: key -> [bucket_counts..., count, sum]
+        self._children: Dict[Tuple, List[float]] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = _label_key(self.labelnames, labels)
+        value = float(value)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = \
+                    [0.0] * (len(self.buckets) + 2)
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    child[i] += 1
+            child[-2] += 1
+            child[-1] += value
+
+    def value(self, **labels: str):
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                return {"count": 0, "sum": 0.0}
+            return {"count": int(child[-2]), "sum": child[-1]}
+
+    def snapshot(self):
+        with self._lock:
+            out = []
+            for key, child in sorted(self._children.items()):
+                out.append({
+                    "labels": dict(zip(self.labelnames, key)),
+                    "buckets": {
+                        _format_value(b): int(child[i])
+                        for i, b in enumerate(self.buckets)},
+                    "count": int(child[-2]),
+                    "sum": child[-1],
+                })
+            return out
+
+    def prometheus_lines(self) -> List[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} histogram")
+        with self._lock:
+            for key, child in sorted(self._children.items()):
+                for i, bound in enumerate(self.buckets):
+                    lab = _format_labels(self.labelnames, key,
+                                         ("le", _format_value(bound)))
+                    lines.append(f"{self.name}_bucket{lab} {int(child[i])}")
+                lab = _format_labels(self.labelnames, key, ("le", "+Inf"))
+                lines.append(f"{self.name}_bucket{lab} {int(child[-2])}")
+                lab = _format_labels(self.labelnames, key)
+                lines.append(f"{self.name}_count{lab} {int(child[-2])}")
+                lines.append(
+                    f"{self.name}_sum{lab} {_format_value(child[-1])}")
+        return lines
+
+
+class MetricsRegistry:
+    """Named home for every metric in the process.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create: a second
+    registration with the same name returns the SAME child (so
+    instrument sites need no import-order coordination), but a name
+    collision across metric kinds or label sets is a programming error
+    and raises.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], **kwargs) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if type(existing) is not cls \
+                        or existing.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}"
+                        f"{existing.labelnames}, cannot re-register as "
+                        f"{cls.__name__}{tuple(labelnames)}")
+                return existing
+            metric = cls(name, help, labelnames, lock=self._lock, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        h = self._get_or_create(Histogram, name, help, labelnames,
+                                buckets=buckets)
+        # same loud-collision policy as kind/labelnames: silently
+        # landing observations in someone else's buckets is invisible
+        want = tuple(sorted(float(b) for b in buckets))
+        if h.buckets != want:
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{h.buckets}, cannot re-register with {want}")
+        return h
+
+    def metrics(self) -> Iterable[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-serializable view of every metric's current state."""
+        return {
+            m.name: {"kind": m.kind, "help": m.help,
+                     "series": m.snapshot()}
+            for m in sorted(self.metrics(), key=lambda m: m.name)
+        }
+
+    def to_json(self, **dumps_kwargs) -> str:
+        return json.dumps(self.snapshot(), allow_nan=False, **dumps_kwargs)
+
+    def to_prometheus(self) -> str:
+        lines: List[str] = []
+        for m in sorted(self.metrics(), key=lambda m: m.name):
+            lines.extend(m.prometheus_lines())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        """Drop every metric (tests; a process never needs this)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+#: The process-wide default registry every instrumentation site uses.
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,117 @@
+"""jax version compatibility shims.
+
+The framework targets the current jax API surface, but must keep running
+on the older runtimes real deployments pin (the motivating case: jax
+0.4.37, which ships ``shard_map`` only under ``jax.experimental`` and
+spells the replication check ``check_rep`` instead of ``check_vma``).
+Every multi-chip entry point routes through :func:`shard_map` below so
+the version split lives in exactly one place.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["axis_size", "has_shard_map", "pcast_varying",
+           "shape_dtype_struct", "shard_map"]
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` tolerating the ``vma`` kwarg.
+
+    Modern jax carries varying-mesh-axes on out-shapes (pallas calls
+    inside ``shard_map`` declare their outputs varying this way); older
+    constructors reject the kwarg, and there VMA simply is not tracked
+    - dropping it is the correct degradation (the fallback
+    ``shard_map`` runs with the replication check off anyway).
+    """
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` with fallbacks for older jax.
+
+    Pre-``lax.axis_size`` versions expose the bound size through
+    ``jax.core.axis_frame`` (returns the int directly on 0.4.x).  Both
+    forms are STATIC ints - callers use the result as an array shape
+    (``ops/df64._allreduce_df``), so a traced stand-in like the classic
+    ``psum(1)`` idiom can never satisfy them; fail loudly instead.
+    """
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    try:
+        frame = jax.core.axis_frame(axis_name)
+        return int(getattr(frame, "size", frame))
+    except (AttributeError, NameError, TypeError) as e:
+        raise NotImplementedError(
+            f"no static axis-size API on this jax version (need "
+            f"lax.axis_size or jax.core.axis_frame) for axis "
+            f"{axis_name!r}") from e
+
+
+def pcast_varying(x, axis_name):
+    """``lax.pcast(x, axis_name, to="varying")`` where it exists.
+
+    Modern jax tracks varying-mesh-axes (VMA) types inside
+    ``shard_map`` and requires fresh unvarying values to be cast before
+    mixing with varying ones.  Older jax has no VMA tracking at all
+    (and the fallback ``shard_map`` disables the replication check), so
+    the cast is correctly the identity there.
+    """
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    return x
+
+
+def has_shard_map() -> bool:
+    """True when some spelling of ``shard_map`` is importable."""
+    if hasattr(jax, "shard_map"):
+        return True
+    try:
+        from jax.experimental.shard_map import shard_map as _  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs,
+              check_vma: bool = True, **kwargs: Any):
+    """``jax.shard_map`` with a fallback to ``jax.experimental.shard_map``.
+
+    Supports the decorator-factory form (``@shard_map(mesh=...,
+    in_specs=..., out_specs=...)`` with ``f`` omitted), like modern
+    ``jax.shard_map``.
+
+    Mirrors the modern keyword surface used in this package (``mesh``,
+    ``in_specs``, ``out_specs``, ``check_vma``).  On older jax the
+    replication check is ALWAYS disabled (``check_rep=False``): the old
+    checker predates replication rules for ``lax.while_loop`` - the body
+    of every solver here - and raises ``NotImplementedError`` on them,
+    while the check itself is pure static validation with no runtime
+    semantics.  Modern jax keeps the caller's ``check_vma`` as-is.
+    """
+    if f is None:
+        def bind(fn):
+            return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma,
+                             **kwargs)
+        return bind
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma,
+                             **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False, **kwargs)

@@ -10,12 +10,35 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import sys
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 LOGGER_NAME = "cuda_mpi_parallel_tpu"
+
+
+def sanitize(obj: Any) -> Any:
+    """Make ``obj`` strictly-JSON serializable: non-finite floats become
+    ``null`` and numpy scalars become Python scalars.
+
+    ``json.dumps`` happily emits the ``NaN``/``Infinity`` literals, which
+    are NOT JSON - ``json.loads`` in permissive Python accepts them, but
+    jq, browsers, BigQuery and every strict parser reject the record.  A
+    BREAKDOWN solve carries a non-finite ``residual_norm`` by definition
+    (solver quirk Q4 handling), so solve records hit this in practice.
+    Recurses through dicts/lists/tuples; leaves other types alone.
+    """
+    if isinstance(obj, dict):
+        return {k: sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [sanitize(v) for v in obj]
+    if isinstance(obj, np.generic):     # numpy scalar -> python scalar
+        obj = obj.item()
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    return obj
 
 
 def get_logger(level: int = logging.INFO) -> logging.Logger:
@@ -59,12 +82,24 @@ def format_history(result, every: int = 1) -> str:
         return "(history not recorded)"
     hist = np.asarray(result.residual_history)
     k = int(result.iterations)
+    idx = list(range(0, k + 1, every))
+    # Always include the final entry: when ``every`` does not divide k
+    # the stride stops short and the CONVERGED residual - the line the
+    # trace exists for - used to vanish silently.  For block-granular
+    # traces (resident engine) the last finite slot <= k stands in.
+    last_finite = next((i for i in range(k, -1, -1)
+                        if np.isfinite(hist[i])), None)
+    if last_finite is not None and last_finite not in idx:
+        idx.append(last_finite)
     lines = [f"  iter {i:5d}  ||r|| = {hist[i]:.6e}"
-             for i in range(0, k + 1, every) if np.isfinite(hist[i])]
+             for i in idx if np.isfinite(hist[i])]
     return "\n".join(lines)
 
 
 def emit_json(record: Dict[str, Any], stream=None) -> None:
     stream = sys.stdout if stream is None else stream
-    stream.write(json.dumps(record) + "\n")
+    # allow_nan=False makes any future non-finite leak a loud error
+    # instead of silently invalid JSON; sanitize() maps the legitimate
+    # ones (BREAKDOWN residuals) to null first.
+    stream.write(json.dumps(sanitize(record), allow_nan=False) + "\n")
     stream.flush()

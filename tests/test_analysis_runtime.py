@@ -17,6 +17,8 @@ simulator - but check_races must then RAISE, never report a false
 import numpy as np
 import pytest
 
+from cuda_mpi_parallel_tpu.utils.compat import shard_map
+
 from cuda_mpi_parallel_tpu.analysis.runtime import (
     RaceDetectorUnavailable,
     RaceReport,
@@ -101,7 +103,7 @@ def _row_push(n_shards: int, contested: bool, detect_races: bool = True):
             dma.wait()
         out_ref[:] = jnp.sum(buf[:], axis=0, keepdims=True)
 
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P(axis),),
+    @functools.partial(shard_map, mesh=mesh, in_specs=(P(axis),),
                        out_specs=P(axis), check_vma=False)
     def run(x_local):
         return pl.pallas_call(

@@ -1,0 +1,319 @@
+"""telemetry.cost: jaxpr-derived op/communication accounting.
+
+The load-bearing properties:
+
+* the per-iteration psum/ppermute/halo-byte counts derived from the
+  traced solve match the ANALYTIC expectation for the stencil and CSR
+  communication schedules (arXiv 1612.08060 / 1112.5588: volume, not
+  flops, governs distributed SpMV - so the counts must be right);
+* the accounting NEVER perturbs the compiled solve: the jaxpr of a
+  jitted solve is bit-identical with telemetry enabled and disabled;
+* the distributed solver cache emits hit/miss + comm_cost events whose
+  totals reconcile with the measured iteration count.
+"""
+import dataclasses
+import json
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from cuda_mpi_parallel_tpu import telemetry
+from cuda_mpi_parallel_tpu.models.operators import Stencil2D
+from cuda_mpi_parallel_tpu.solver.cg import cg
+from cuda_mpi_parallel_tpu.telemetry import cost, events
+from cuda_mpi_parallel_tpu.utils import compat
+
+needs_mesh = pytest.mark.skipif(
+    not compat.has_shard_map() or len(jax.devices()) < 4,
+    reason="needs shard_map and >= 4 (virtual) devices")
+
+
+class TestWalker:
+    def test_single_device_cg_counts(self):
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones(256)
+        sc = cost.trace_solve_cost(lambda v: cg(a, v, maxiter=50), b)
+        assert len(sc.loops) == 1
+        # textbook recurrence: two inner products per iteration
+        # (cublasDdot/cublasDnrm2, CUDACG.cu:304,328), one at init
+        assert sc.per_iteration.dots == 2
+        assert sc.setup.dots == 1
+        # single device: no collectives anywhere
+        assert sc.per_iteration.collectives == 0
+        assert sc.per_iteration.comm_bytes == 0
+
+    def test_check_every_normalizes_per_iteration(self):
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones(256)
+        sc = cost.trace_solve_cost(
+            lambda v: cg(a, v, maxiter=48, check_every=4), b,
+            iterations_per_trip=4)
+        # blocked main loop + per-iteration tail loop
+        assert len(sc.loops) == 2
+        assert sc.loops[0].dots == 8      # 4-iteration block trip
+        assert sc.per_iteration.dots == 2  # normalized
+        assert sc.loops[1].dots == 2       # tail trips one iteration
+
+    def test_totals_formula(self):
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones(256)
+        sc = cost.trace_solve_cost(lambda v: cg(a, v, maxiter=50), b)
+        t = sc.totals(30)
+        assert t.dots == sc.setup.dots + 30 * sc.per_iteration.dots
+
+    def test_scan_multiplies_statically(self):
+        def f(x):
+            return jax.lax.scan(lambda c, _: (c @ c.T, None), x,
+                                None, length=5)[0]
+
+        sc = cost.trace_solve_cost(f, jnp.ones((4, 4)))
+        assert sc.setup.dots == 5
+        assert len(sc.loops) == 0
+
+    def test_cond_takes_worst_branch(self):
+        def f(x, flag):
+            return jax.lax.cond(flag,
+                                lambda v: (v @ v.T) @ (v @ v.T),
+                                lambda v: v + 1.0, x)
+
+        sc = cost.trace_solve_cost(f, jnp.ones((3, 3)),
+                                   jnp.asarray(True))
+        assert sc.setup.dots == 3   # the expensive branch
+
+    def test_analytic_op_model(self):
+        assert cost.analytic_solve_ops("cg") == \
+            {"spmv": 1, "dot": 2, "axpy": 3}
+        pre = cost.analytic_solve_ops("cg", preconditioned=True,
+                                      precond_matvecs=3)
+        assert pre["dot"] == 3 and pre["spmv"] == 4
+        with pytest.raises(ValueError, match="unknown method"):
+            cost.analytic_solve_ops("sor")
+
+    def test_halo_bytes_helper(self):
+        # two boundary planes per matvec, each grid[1:] x itemsize
+        assert cost.stencil_halo_bytes_per_iteration((16, 64), 8) \
+            == 2 * 64 * 8
+        assert cost.stencil_halo_bytes_per_iteration((8, 4, 6), 4,
+                                                     matvecs_per_iteration=2) \
+            == 2 * 24 * 4 * 2
+
+
+@needs_mesh
+class TestDistributedCounts:
+    def _trace(self, method="cg", ny=64):
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+        from cuda_mpi_parallel_tpu.parallel.operators import DistStencil2D
+
+        mesh = make_mesh(4)
+        local = DistStencil2D.create((64, ny), 4, dtype=jnp.float64)
+        b = jnp.ones(64 * ny)
+
+        @partial(compat.shard_map, mesh=mesh,
+                 in_specs=(P("rows"), P()), out_specs=P("rows"))
+        def run(b_local, scale):
+            loc = dataclasses.replace(local, scale=scale)
+            return cg(loc, b_local, axis_name="rows", maxiter=100,
+                      method=method).x
+
+        return cost.trace_solve_cost(run, b, local.scale), local
+
+    def test_stencil_cg_matches_analytic(self):
+        sc, local = self._trace()
+        per = sc.per_iteration
+        # textbook CG on a slab stencil: 2 psum (p.Ap, r.r) and one
+        # halo exchange (2 ppermutes) per iteration; 1 init psum
+        assert per.psum == 2
+        assert per.ppermute == 2
+        assert per.all_gather == 0
+        assert sc.setup.psum == 1
+        assert sc.setup.ppermute == 0
+        itemsize = jnp.dtype(local.dtype).itemsize
+        halo = cost.stencil_halo_bytes_per_iteration(
+            local.local_grid, itemsize)
+        assert per.comm_bytes == halo + 2 * itemsize  # + 2 scalar psums
+
+    def test_cg1_single_fused_reduction(self):
+        sc, _ = self._trace(method="cg1")
+        # the distributed raison d'etre of cg1: ONE fused psum per
+        # iteration (stacked dots), vs the textbook two
+        assert sc.per_iteration.psum == 1
+        assert sc.per_iteration.ppermute == 2
+
+
+class TestZeroPerturbation:
+    """Acceptance: the jaxpr of a jitted solve is identical with
+    telemetry enabled and disabled."""
+
+    def _jaxpr_single(self):
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones(256)
+        return str(jax.make_jaxpr(lambda v: cg(a, v, maxiter=25))(b))
+
+    def test_single_device_jaxpr_identical(self):
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        base = self._jaxpr_single()
+        try:
+            with events.capture():
+                telemetry.force_active(True)
+                events.emit("solve_start", label="perturbation probe")
+                instrumented = self._jaxpr_single()
+        finally:
+            telemetry.force_active(False)
+        assert instrumented == base
+
+    @needs_mesh
+    def test_distributed_jaxpr_identical(self):
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+        from cuda_mpi_parallel_tpu.parallel.operators import DistStencil2D
+
+        mesh = make_mesh(4)
+        local = DistStencil2D.create((16, 16), 4, dtype=jnp.float64)
+        b = jnp.ones(256)
+
+        def trace():
+            @partial(compat.shard_map, mesh=mesh,
+                     in_specs=(P("rows"), P()), out_specs=P("rows"))
+            def run(b_local, scale):
+                loc = dataclasses.replace(local, scale=scale)
+                return cg(loc, b_local, axis_name="rows", maxiter=25).x
+
+            return str(jax.make_jaxpr(run)(b, local.scale))
+
+        telemetry.configure(None)
+        base = trace()
+        try:
+            with events.capture():
+                telemetry.force_active(True)
+                instrumented = trace()
+        finally:
+            telemetry.force_active(False)
+        assert instrumented == base
+
+
+@needs_mesh
+class TestSolveDistributedIntegration:
+    def test_cache_events_and_comm_cost_reconcile(self):
+        from cuda_mpi_parallel_tpu.parallel import dist_cg, make_mesh
+
+        dist_cg.clear_solver_cache()
+        a = Stencil2D.create(16, 12, dtype=jnp.float64)
+        b = jnp.asarray(
+            np.random.default_rng(11).standard_normal(192))
+        mesh = make_mesh(4)
+        try:
+            with events.capture() as buf:
+                res1 = dist_cg.solve_distributed(a, b, mesh=mesh,
+                                                 tol=1e-10, maxiter=400)
+                res2 = dist_cg.solve_distributed(a, b, mesh=mesh,
+                                                 tol=1e-10, maxiter=400)
+            info = dist_cg.last_comm_cost()
+        finally:
+            dist_cg.clear_solver_cache()
+        assert bool(res1.converged) and bool(res2.converged)
+        lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        for line in lines:
+            events.validate_event(line)
+        kinds = [l["event"] for l in lines]
+        assert kinds.count("dist_cache_miss") == 1
+        assert kinds.count("dist_cache_hit") == 1
+        assert kinds.index("dist_cache_miss") \
+            < kinds.index("dist_cache_hit")
+        costs = [l for l in lines if l["event"] == "comm_cost"]
+        assert len(costs) == 2          # one per solve, cached walk
+        assert costs[0]["psum_per_iteration"] == 2
+        assert costs[0]["ppermute_per_iteration"] == 2
+        # reconcile with the measured iteration count
+        assert info is not None
+        sc, ctx = info
+        k = int(res2.iterations)
+        assert sc.totals(k).psum == 2 * k + 1
+        assert sc.totals(k).ppermute == 2 * k
+        assert ctx["kind"] == "stencil" and ctx["n_shards"] == 4
+
+    def test_cost_walk_skipped_when_inactive(self):
+        from cuda_mpi_parallel_tpu.parallel import dist_cg, make_mesh
+
+        dist_cg.clear_solver_cache()
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        a = Stencil2D.create(16, 12, dtype=jnp.float64)
+        b = jnp.ones(192)
+        try:
+            dist_cg.solve_distributed(a, b, mesh=make_mesh(4),
+                                      maxiter=50)
+            assert dist_cg.last_comm_cost() is None
+            assert dist_cg._COST_CACHE == {}
+        finally:
+            dist_cg.clear_solver_cache()
+
+
+@needs_mesh
+class TestCLIAcceptance:
+    """The ISSUE acceptance command: ``--problem poisson2d --n 64
+    --mesh 4 --trace-events PATH --metrics`` emits schema-valid JSONL
+    whose per-solve psum/ppermute counts match the analytic
+    expectation."""
+
+    def _run(self, tmp_path, capsys, *extra):
+        from cuda_mpi_parallel_tpu import cli
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+
+        trace = tmp_path / "trace.jsonl"
+        dist_cg.clear_solver_cache()
+        try:
+            rc = cli.main(["--problem", "poisson2d", "--n", "64",
+                           "--mesh", "4", "--trace-events", str(trace),
+                           "--metrics", "--json", *extra])
+        finally:
+            telemetry.configure(None)
+            telemetry.force_active(False)
+            dist_cg.clear_solver_cache()
+        assert rc == 0
+        rec = json.loads(capsys.readouterr().out)
+        lines = [json.loads(ln)
+                 for ln in trace.read_text().splitlines()]
+        assert lines, "trace file must not be empty"
+        for line in lines:
+            events.validate_event(line)     # schema-valid JSONL
+        return rec, lines
+
+    def test_stencil_path_counts_match_analytic(self, tmp_path, capsys):
+        rec, lines = self._run(tmp_path, capsys, "--matrix-free")
+        k = rec["iterations"]
+        comm = rec["comm"]
+        assert comm["kind"] == "stencil"
+        # analytic: 2 psums/iter + 1 init psum; 2 halo ppermutes/iter
+        assert comm["psum"] == 2 * k + 1
+        assert comm["ppermute"] == 2 * k
+        assert comm["all_gather"] == 0
+        per = comm["per_iteration"]
+        itemsize = 8 if rec["dtype"] == "float64" else 4
+        halo = cost.stencil_halo_bytes_per_iteration((16, 64), itemsize)
+        assert per["comm_bytes"] == halo + 2 * itemsize
+        ends = [l for l in lines if l["event"] == "solve_end"]
+        assert ends and ends[-1]["iterations"] == k
+        assert ends[-1]["comm"]["psum"] == 2 * k + 1
+        costs = [l for l in lines if l["event"] == "comm_cost"]
+        assert costs and costs[0]["psum_per_iteration"] == 2
+        assert costs[0]["ppermute_per_iteration"] == 2
+        # metrics embedded in the --json record
+        gauges = rec["metrics"]["dist_comm_psum_per_iteration"]
+        assert gauges["series"][0]["value"] == 2
+
+    def test_csr_allgather_path_counts(self, tmp_path, capsys):
+        # the command WITHOUT --matrix-free assembles CSR: the
+        # all-gather schedule moves x (one all_gather/iter), no halos
+        rec, lines = self._run(tmp_path, capsys)
+        k = rec["iterations"]
+        comm = rec["comm"]
+        assert comm["kind"] == "csr"
+        assert comm["psum"] == 2 * k + 1
+        assert comm["ppermute"] == 0
+        assert comm["all_gather"] == k
+        kinds = [l["event"] for l in lines]
+        assert "dist_cache_miss" in kinds and "solve_end" in kinds

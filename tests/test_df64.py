@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from cuda_mpi_parallel_tpu.utils.compat import shard_map
+
 from cuda_mpi_parallel_tpu import cg_df64, solve
 from cuda_mpi_parallel_tpu.models import poisson
 from cuda_mpi_parallel_tpu.models.operators import CSRMatrix
@@ -243,7 +245,7 @@ class TestCGParity:
         mesh = make_mesh(n_shards)
         axis = mesh.axis_names[0]
 
-        @partial(jax.shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
+        @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)),
                  out_specs=sdf.DF64CGResult(
                      x_hi=P(axis), x_lo=P(axis), iterations=P(),
                      residual_norm_sq_hi=P(), residual_norm_sq_lo=P(),

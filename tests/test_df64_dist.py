@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from cuda_mpi_parallel_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from cuda_mpi_parallel_tpu import cg_df64
@@ -22,8 +24,15 @@ from cuda_mpi_parallel_tpu.parallel.df64 import (
     solve_distributed_df64,
 )
 
-pytestmark = pytest.mark.skipif(
-    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+pytestmark = [
+    pytest.mark.skipif(len(jax.devices()) < 8,
+                       reason="needs 8 (virtual) devices"),
+    # df64 pair-arithmetic shard_map solves take minutes of XLA:CPU
+    # compile+run per test on a small host - far past the tier-1 870s
+    # budget (ROADMAP.md).  They run in the untimed full suite
+    # (pytest tests/ without -m 'not slow').
+    pytest.mark.slow,
+]
 
 
 class TestDistMatvecDF64:
@@ -44,7 +53,7 @@ class TestDistMatvecDF64:
             lambda p: fn(p, grid, df.const(scale)))((xh, xl))
 
         local = DistStencilDF64.create(grid, 8, scale=scale)
-        got_h, got_l = jax.jit(jax.shard_map(
+        got_h, got_l = jax.jit(shard_map(
             lambda p: local.matvec_df(p), mesh=mesh,
             in_specs=(P("rows"),), out_specs=(P("rows"), P("rows"))))(
                 (xh, xl))
@@ -186,7 +195,7 @@ class TestDistVariantsDF64:
             [d1, d2] = df.fused_dots([(a, b), (a, a)], axis_name="rows")
             return d1, d2
 
-        (d1, d2) = jax.jit(jax.shard_map(
+        (d1, d2) = jax.jit(shard_map(
             body, mesh=mesh, in_specs=(P("rows"), P("rows")),
             out_specs=(P(), P())))(a_pair, b_pair)
         np.testing.assert_allclose(df.to_f64(*jax.tree.map(np.asarray, d1)),
@@ -230,7 +239,7 @@ class TestRingShiftELLDF64:
             return op.matvec_df(xp)
 
         sh = lambda t: jax.tree.map(jnp.asarray, t)
-        got_h, got_l = jax.jit(jax.shard_map(
+        got_h, got_l = jax.jit(shard_map(
             body, mesh=mesh, check_vma=False,
             in_specs=(P("rows"), P("rows"), P("rows"), P("rows"),
                       P("rows"), P("rows"), P("rows")),
@@ -317,7 +326,7 @@ class TestPencilDF64:
             lg = local.local_grid
             return yh.reshape(lg), yl.reshape(lg)
 
-        got_h, got_l = jax.jit(jax.shard_map(
+        got_h, got_l = jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=(P(None, "rows", "cols"),),
             out_specs=(P("rows", "cols"), P("rows", "cols"))))(xg)

@@ -139,6 +139,9 @@ class TestDF64MGSingleDevice:
 
 @pytest.mark.skipif(len(jax.devices()) < 8,
                     reason="needs 8 (virtual) devices")
+@pytest.mark.slow
+# distributed df64 multigrid: minutes of XLA:CPU compile on a small
+# host - past the tier-1 870s budget; runs in the untimed full suite
 class TestDF64MGDistributed:
     def test_slab_iteration_parity_2d(self, rng):
         """8-device mg-df64 == 1-device mg-df64 in iteration count (the

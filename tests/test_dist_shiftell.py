@@ -45,6 +45,11 @@ class TestRingPartitionShiftELL:
                                    rtol=1e-12)
 
 
+# The ring-shiftell pallas-in-interpret shard_map solves cost ~3 min of
+# XLA:CPU work on a small host - past the tier-1 870s budget; they run
+# in the untimed full suite.  The partition tests above are pure-host
+# and stay in the tier-1 gate.
+@pytest.mark.slow
 class TestSolveRingShiftELL:
     def test_trajectory_matches_single_device(self, rng):
         a = poisson.poisson_2d_csr(24, 24)

@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from cuda_mpi_parallel_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from cuda_mpi_parallel_tpu import solve
@@ -36,7 +38,7 @@ class TestHalo:
             lo, hi = exchange_halo(u_local, "rows", 8)
             return lo, hi
 
-        lo, hi = jax.jit(jax.shard_map(
+        lo, hi = jax.jit(shard_map(
             body, mesh=mesh, in_specs=P("rows"),
             out_specs=(P("rows"), P("rows"))))(u)
         lo = np.asarray(lo).reshape(8, 3)
@@ -62,7 +64,7 @@ class TestDistStencilSpMV:
 
         local = DistStencil3D.create((nx, ny, nz), 8, scale=1.7,
                                      dtype=jnp.float64)
-        got = jax.jit(jax.shard_map(
+        got = jax.jit(shard_map(
             lambda v: local @ v, mesh=mesh, in_specs=P("rows"),
             out_specs=P("rows")))(x)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
@@ -190,7 +192,7 @@ class TestDistributedVariants:
         b = jnp.asarray(np.random.default_rng(7).standard_normal(256))
 
         def counts(method):
-            @partial(jax.shard_map, mesh=mesh, in_specs=P2("rows"),
+            @partial(shard_map, mesh=mesh, in_specs=P2("rows"),
                      out_specs=P2("rows"))
             def run(b_local):
                 return cg(local, b_local, tol=1e-10, maxiter=50,

@@ -1,0 +1,260 @@
+"""telemetry.registry / telemetry.events / telemetry.session units.
+
+The observability contract: metrics and events are host-side Python
+state, strict-JSON serializable, opt-in, and schema-checked - the
+structured replacement for the reference's printf of the solution
+vector (CUDACG.cu:361-365, SURVEY quirk Q7).
+"""
+import json
+
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu.solver.status import CGStatus
+from cuda_mpi_parallel_tpu.telemetry import events, session
+from cuda_mpi_parallel_tpu.telemetry.registry import (
+    REGISTRY,
+    MetricsRegistry,
+)
+
+
+class TestRegistry:
+    def test_counter_accumulates_per_labelset(self):
+        r = MetricsRegistry()
+        c = r.counter("req_total", "requests", ("engine",))
+        c.inc(engine="resident")
+        c.inc(2, engine="resident")
+        c.inc(engine="general")
+        assert c.value(engine="resident") == 3
+        assert c.value(engine="general") == 1
+        assert c.value(engine="never") == 0
+
+    def test_counter_rejects_negative_and_bad_labels(self):
+        r = MetricsRegistry()
+        c = r.counter("x_total", labelnames=("a",))
+        with pytest.raises(ValueError, match="only go up"):
+            c.inc(-1, a="v")
+        with pytest.raises(ValueError, match="labels"):
+            c.inc(b="v")
+
+    def test_get_or_create_same_metric_kind_conflict_raises(self):
+        r = MetricsRegistry()
+        first = r.counter("m", "h", ("l",))
+        assert r.counter("m", "h", ("l",)) is first
+        with pytest.raises(ValueError, match="already registered"):
+            r.gauge("m", "h", ("l",))
+        with pytest.raises(ValueError, match="already registered"):
+            r.counter("m", "h", ("other",))
+
+    def test_gauge_set_inc_dec(self):
+        r = MetricsRegistry()
+        g = r.gauge("depth")
+        g.set(5)
+        g.inc(2)
+        g.dec()
+        assert g.value() == 6
+
+    def test_histogram_buckets_cumulative(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 50.0):
+            h.observe(v)
+        snap = h.snapshot()[0]
+        assert snap["buckets"] == {"0.1": 1, "1": 3, "10": 3}
+        assert snap["count"] == 4
+        assert snap["sum"] == pytest.approx(51.05)
+
+    def test_prometheus_text_format(self):
+        r = MetricsRegistry()
+        r.counter("a_total", "things", ("k",)).inc(3, k="v1")
+        r.histogram("b_seconds", buckets=(1.0,)).observe(0.5)
+        text = r.to_prometheus()
+        assert '# TYPE a_total counter' in text
+        assert 'a_total{k="v1"} 3' in text
+        assert 'b_seconds_bucket{le="1"} 1' in text
+        assert 'b_seconds_bucket{le="+Inf"} 1' in text
+        assert 'b_seconds_count 1' in text
+
+    def test_prometheus_nonfinite_values_render(self):
+        # Prometheus text supports NaN/+Inf/-Inf literals; one bad
+        # gauge value must not poison every later scrape
+        r = MetricsRegistry()
+        r.gauge("g_nan").set(float("nan"))
+        r.gauge("g_ninf").set(float("-inf"))
+        text = r.to_prometheus()
+        assert "g_nan NaN" in text
+        assert "g_ninf -Inf" in text
+
+    def test_histogram_bucket_mismatch_raises(self):
+        r = MetricsRegistry()
+        r.histogram("h_seconds", buckets=(0.1, 1.0))
+        with pytest.raises(ValueError, match="buckets"):
+            r.histogram("h_seconds", buckets=(0.5, 5.0))
+
+    def test_snapshot_is_strict_json(self):
+        r = MetricsRegistry()
+        r.counter("c_total", labelnames=("x",)).inc(x="y")
+        r.gauge("g").set(1.5)
+        r.histogram("h").observe(2.0)
+        parsed = json.loads(r.to_json())
+        assert parsed["c_total"]["kind"] == "counter"
+        assert parsed["g"]["series"][0]["value"] == 1.5
+
+    def test_process_registry_exists(self):
+        # the default registry is the shared instrument target
+        c = REGISTRY.counter("test_events_metrics_probe_total")
+        c.inc()
+        assert c.value() >= 1
+
+
+class TestEvents:
+    def test_emit_without_sink_is_noop(self):
+        events.configure(None)
+        assert not events.active()
+        assert events.emit("solve_start", label="x") is None
+
+    def test_capture_and_schema_roundtrip(self):
+        with events.capture() as buf:
+            with events.solve_scope() as sid:
+                events.emit("solve_start", label="t", extra_field=1)
+                events.emit("engine_selected", engine="general",
+                            method="cg")
+            events.emit("solve_start", label="outside-scope")
+        lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        assert [l["event"] for l in lines] == [
+            "solve_start", "engine_selected", "solve_start"]
+        for line in lines:
+            events.validate_event(line)
+        assert lines[0]["solve_id"] == sid == lines[1]["solve_id"]
+        assert lines[2]["solve_id"] is None
+        assert lines[0]["extra_field"] == 1
+        # monotonic timestamps within the stream
+        assert lines[0]["t"] <= lines[1]["t"] <= lines[2]["t"]
+
+    def test_unknown_type_and_missing_fields_raise(self):
+        with events.capture():
+            with pytest.raises(ValueError, match="unknown event type"):
+                events.emit("not_a_type", x=1)
+            with pytest.raises(ValueError, match="missing required"):
+                events.emit("engine_selected", engine="general")
+
+    def test_nonfinite_floats_sanitized_to_null(self):
+        with events.capture() as buf:
+            events.emit("solve_end", status="BREAKDOWN", iterations=7,
+                        residual_norm=float("nan"),
+                        nested={"inf": float("inf")})
+        line = buf.getvalue().strip()
+        rec = json.loads(line)
+        assert rec["residual_norm"] is None
+        assert rec["nested"]["inf"] is None
+        assert "NaN" not in line and "Infinity" not in line
+
+    def test_validate_event_rejects_bad_records(self):
+        with pytest.raises(ValueError):
+            events.validate_event({"event": "nope", "t": 0.0})
+        with pytest.raises(ValueError):
+            events.validate_event({"event": "solve_start", "t": "late"})
+        with pytest.raises(ValueError):
+            events.validate_event(
+                {"event": "solve_end", "t": 0.0, "status": "X",
+                 "iterations": 1})  # missing residual_norm
+        events.validate_event(
+            {"event": "solve_start", "t": 0.0, "label": "ok",
+             "solve_id": None})
+
+
+def _fake_result(iterations=8, residual=1e-9, history=None,
+                 status=CGStatus.CONVERGED):
+    class R:
+        pass
+
+    r = R()
+    r.iterations = iterations
+    r.residual_norm = residual
+    r.converged = status == CGStatus.CONVERGED
+    r.indefinite = False
+    r.residual_history = history
+    r.status_enum = lambda: status
+    return r
+
+
+class TestObserveSolve:
+    def test_full_cycle_events_and_metrics(self):
+        counters = session.solve_metrics()
+        before = counters["solves"].value(engine="unit-test",
+                                          status="CONVERGED")
+        with events.capture() as buf:
+            with session.observe_solve("unit solve", engine="unit-test",
+                                       problem="fake") as obs:
+                with obs.section("build"):
+                    pass
+                obs.finish(_fake_result(), elapsed_s=0.25)
+        lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        for line in lines:
+            events.validate_event(line)
+        kinds = [l["event"] for l in lines]
+        assert kinds[0] == "solve_start" and kinds[-1] == "solve_end"
+        assert "check_block" in kinds
+        end = lines[-1]
+        assert end["status"] == "CONVERGED" and end["iterations"] == 8
+        assert end["solve_id"] == lines[0]["solve_id"]
+        assert "build" in end["sections"]
+        after = counters["solves"].value(engine="unit-test",
+                                         status="CONVERGED")
+        assert after == before + 1
+
+    def test_check_block_events_from_history(self):
+        hist = np.full(101, np.nan)
+        boundaries = [0, 4, 8, 12, 14]
+        for i in boundaries:
+            hist[i] = 1.0 / (i + 1)
+        with events.capture() as buf:
+            with session.observe_solve("blocked", engine="general",
+                                       check_every=4) as obs:
+                obs.finish(_fake_result(iterations=14, history=hist))
+        blocks = [json.loads(ln) for ln in buf.getvalue().splitlines()
+                  if json.loads(ln)["event"] == "check_block"]
+        assert [b["iteration"] for b in blocks] == [4, 8, 12, 14]
+        # the final (converged) boundary is present and flagged
+        assert blocks[-1]["final"] is True
+        assert blocks[-1]["residual_norm"] == pytest.approx(1.0 / 15)
+
+    def test_check_block_event_count_capped(self):
+        hist = np.arange(2001.0) + 1.0
+        with events.capture() as buf:
+            with session.observe_solve("long", check_every=1) as obs:
+                obs.finish(_fake_result(iterations=2000, history=hist))
+        blocks = [json.loads(ln) for ln in buf.getvalue().splitlines()
+                  if json.loads(ln)["event"] == "check_block"]
+        assert 0 < len(blocks) <= session.MAX_CHECK_BLOCK_EVENTS + 1
+        assert blocks[-1]["iteration"] == 2000
+
+    def test_unfinished_scope_emits_solve_end(self):
+        with events.capture() as buf:
+            with session.observe_solve("abandoned"):
+                pass
+        lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        assert lines[-1]["event"] == "solve_end"
+        assert lines[-1]["status"] == "unobserved"
+
+    def test_exception_still_closes_the_trace(self):
+        """No dangling solve_start on the error path: the exception
+        propagates AND the scope emits a status='error' solve_end."""
+        with events.capture() as buf:
+            with pytest.raises(RuntimeError, match="boom"):
+                with session.observe_solve("exploding"):
+                    raise RuntimeError("boom")
+        lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        assert lines[-1]["event"] == "solve_end"
+        assert lines[-1]["status"] == "error"
+        assert lines[-1]["error"] == "RuntimeError"
+        assert lines[-1]["solve_id"] == lines[0]["solve_id"]
+
+    def test_scoped_fields_ride_on_events(self):
+        with events.capture() as buf:
+            with events.scoped(phase="warmup"):
+                events.emit("solve_start", label="w")
+            events.emit("solve_start", label="t")
+        lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        assert lines[0]["phase"] == "warmup"
+        assert "phase" not in lines[1]

@@ -263,12 +263,11 @@ class TestCLIHistoryRejection:
                       engine, "--history"])
 
     def test_general_engine_keeps_history(self, capsys):
-        import jax
-
         from cuda_mpi_parallel_tpu import cli
+        from cuda_mpi_parallel_tpu.utils.compat import has_shard_map
 
-        if not hasattr(jax, "shard_map"):
-            pytest.skip("this jax has no jax.shard_map (distributed "
+        if not has_shard_map():
+            pytest.skip("no shard_map spelling available (distributed "
                         "paths unavailable)")
         rc = cli.main(["--problem", "poisson2d", "--n", "16", "--device",
                        "cpu", "--mesh", "2", "--matrix-free", "--engine",

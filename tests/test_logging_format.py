@@ -1,0 +1,117 @@
+"""utils.logging regression tests: strict-JSON records and complete
+residual traces.
+
+Two observability bugs fixed in the telemetry PR:
+
+* ``solve_record``/``emit_json`` produced the non-JSON ``NaN`` /
+  ``Infinity`` literals whenever a BREAKDOWN solve carried a non-finite
+  ``residual_norm`` (quirk-Q4 solves do, by definition);
+* ``format_history(every=k)`` silently dropped the final converged
+  iteration whenever k did not divide ``result.iterations``.
+"""
+import io
+import json
+import math
+
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu.solver.status import CGStatus
+from cuda_mpi_parallel_tpu.utils import logging as ulog
+
+
+def _result(iterations=7, residual=1e-8, status=CGStatus.CONVERGED,
+            history=None, indefinite=False):
+    class R:
+        pass
+
+    r = R()
+    r.iterations = iterations
+    r.residual_norm = residual
+    r.converged = status == CGStatus.CONVERGED
+    r.indefinite = indefinite
+    r.residual_history = history
+    r.status_enum = lambda: status
+    return r
+
+
+class TestSanitize:
+    def test_nonfinite_floats_become_null(self):
+        rec = ulog.sanitize({"a": float("nan"), "b": float("inf"),
+                             "c": [1.0, float("-inf")], "d": "NaN-str",
+                             "e": 2})
+        assert rec["a"] is None and rec["b"] is None
+        assert rec["c"] == [1.0, None]
+        assert rec["d"] == "NaN-str" and rec["e"] == 2
+
+    def test_numpy_scalars_unwrapped(self):
+        rec = ulog.sanitize({"f": np.float64("nan"),
+                             "i": np.int32(3),
+                             "ok": np.float32(1.5)})
+        assert rec["f"] is None
+        assert rec["i"] == 3 and isinstance(rec["i"], int)
+        assert rec["ok"] == 1.5
+
+
+class TestEmitJsonBreakdown:
+    def test_breakdown_record_is_valid_json(self):
+        """Regression: a NaN residual used to serialize as the literal
+        ``NaN``, which strict JSON parsers reject."""
+        res = _result(iterations=12, residual=float("nan"),
+                      status=CGStatus.BREAKDOWN)
+        rec = ulog.solve_record(res, elapsed_s=0.5, problem="breakdown")
+        buf = io.StringIO()
+        ulog.emit_json(rec, stream=buf)
+        line = buf.getvalue()
+        assert "NaN" not in line and "Infinity" not in line
+        parsed = json.loads(line)
+        assert parsed["status"] == "BREAKDOWN"
+        assert parsed["residual_norm"] is None
+        assert parsed["iterations"] == 12
+
+    def test_finite_record_roundtrips_unchanged(self):
+        res = _result()
+        rec = ulog.solve_record(res, elapsed_s=2.0, extra="kept")
+        buf = io.StringIO()
+        ulog.emit_json(rec, stream=buf)
+        parsed = json.loads(buf.getvalue())
+        assert parsed["residual_norm"] == pytest.approx(1e-8)
+        assert parsed["iters_per_sec"] == pytest.approx(3.5)
+        assert parsed["extra"] == "kept"
+
+
+class TestFormatHistory:
+    def _hist(self, k, maxiter=32):
+        h = np.full(maxiter + 1, np.nan)
+        h[: k + 1] = np.logspace(0, -k, k + 1)
+        return h
+
+    def test_every_divides_keeps_last(self):
+        res = _result(iterations=6, history=self._hist(6))
+        out = ulog.format_history(res, every=3)
+        assert "iter     6" in out
+
+    def test_final_entry_always_printed(self):
+        """Regression: every=k with k not dividing iterations dropped
+        the converged iteration's line entirely."""
+        res = _result(iterations=7, history=self._hist(7))
+        out = ulog.format_history(res, every=3)
+        lines = out.splitlines()
+        assert any("iter     7" in ln for ln in lines)
+        # stride entries still present, in order, no duplicates
+        iters = [int(ln.split()[1]) for ln in lines]
+        assert iters == [0, 3, 6, 7]
+
+    def test_block_granular_trace_falls_back_to_last_finite(self):
+        # resident-engine style trace: values only at block boundaries
+        h = np.full(33, np.nan)
+        h[0], h[8], h[16] = 1.0, 0.1, 0.01
+        res = _result(iterations=20, history=h)
+        out = ulog.format_history(res, every=16)
+        iters = [int(ln.split()[1]) for ln in out.splitlines()]
+        # 20 is NaN in the trace; the last finite entry (16) must close
+        # the trace instead of vanishing
+        assert iters == [0, 16]
+
+    def test_no_history(self):
+        assert "not recorded" in ulog.format_history(_result())

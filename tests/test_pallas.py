@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from cuda_mpi_parallel_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from cuda_mpi_parallel_tpu import Stencil2D, Stencil3D, solve
@@ -147,7 +149,7 @@ class TestDistributedPallas:
         want = Stencil3D.create(nx, ny, nz, dtype=jnp.float32) @ x
         local = DistStencil3D.create((nx, ny, nz), 8, dtype=jnp.float32,
                                      backend="pallas")
-        got = jax.jit(jax.shard_map(
+        got = jax.jit(shard_map(
             lambda v: local @ v, mesh=mesh, in_specs=P("rows"),
             out_specs=P("rows")))(x)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),

@@ -10,6 +10,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from cuda_mpi_parallel_tpu.utils.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from cuda_mpi_parallel_tpu import solve
@@ -45,7 +47,7 @@ class TestPencilMatvec:
                             NamedSharding(mesh, P("rows", "cols")))
 
         @jax.jit
-        @jax.shard_map(mesh=mesh, in_specs=P("rows", "cols"),
+        @shard_map(mesh=mesh, in_specs=P("rows", "cols"),
                        out_specs=P("rows", "cols"))
         def apply(u):
             return (local @ u.reshape(-1)).reshape(local.local_grid)

@@ -10,6 +10,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+from cuda_mpi_parallel_tpu.utils.compat import shard_map
 import scipy.sparse as sp
 from jax.sharding import PartitionSpec as P
 
@@ -53,7 +55,7 @@ def _ring_matvec(a, x, n_shards=8):
     rows = _shard_tree(parts.local_rows, mesh)
 
     @jax.jit
-    @jax.shard_map(mesh=mesh, in_specs=(P("rows"),) * 4,
+    @shard_map(mesh=mesh, in_specs=(P("rows"),) * 4,
                    out_specs=P("rows"))
     def apply(x_l, d, c, r):
         strip = lambda t: jax.tree.map(lambda v: v[0], t)  # noqa: E731
@@ -78,7 +80,7 @@ def _allgather_matvec(a, x, n_shards=8):
     rows = _shard_tree(parts.local_rows, mesh)
 
     @jax.jit
-    @jax.shard_map(mesh=mesh, in_specs=(P("rows"),) * 4,
+    @shard_map(mesh=mesh, in_specs=(P("rows"),) * 4,
                    out_specs=P("rows"))
     def apply(x_l, d, c, r):
         op = DistCSR(data=d[0], cols=c[0], local_rows=r[0],

@@ -1,0 +1,126 @@
+"""utils.timing coverage (previously untested).
+
+A deterministic fake clock drives every protocol: monkeypatching
+``timing.wall_seconds`` makes ``time_fn``'s best/median reductions and
+``paired_delta_rate``'s interleaved-pair rate exact, checkable numbers
+instead of wall-clock noise.
+"""
+import jax.numpy as jnp
+import pytest
+
+from cuda_mpi_parallel_tpu.utils import timing
+
+
+class FakeClock:
+    """Monotonic fake clock; work advances it explicitly."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    c = FakeClock()
+    monkeypatch.setattr(timing, "wall_seconds", c)
+    return c
+
+
+class TestTimer:
+    def test_section_records_named_durations(self, clock):
+        t = timing.Timer()
+        with t.section("build"):
+            clock.advance(0.5)
+        with t.section("solve"):
+            clock.advance(1.25)
+        assert t.sections == [("build", 0.5), ("solve", 1.25)]
+
+    def test_section_with_sync_blocks_device_work(self, clock):
+        # sync= an actual device array exercises the _block barrier path
+        t = timing.Timer()
+        x = jnp.arange(8.0)
+        with t.section("device", sync=x * 2):
+            clock.advance(0.25)
+        (name, sec), = t.sections
+        assert name == "device" and sec >= 0.25
+
+    def test_section_records_even_on_exception(self, clock):
+        t = timing.Timer()
+        with pytest.raises(RuntimeError):
+            with t.section("boom"):
+                clock.advance(0.1)
+                raise RuntimeError("x")
+        assert t.sections == [("boom", 0.1)]
+
+    def test_report_formats_all_sections(self, clock):
+        t = timing.Timer()
+        with t.section("alpha"):
+            clock.advance(0.001)
+        report = t.report()
+        assert "alpha" in report and "ms" in report
+
+
+class TestTimeFn:
+    def test_warmup_excluded_and_best_reduction(self, clock):
+        durations = iter([10.0, 5.0, 1.0, 3.0])  # warmup, then repeats
+        calls = []
+
+        def fn():
+            calls.append(1)
+            clock.advance(next(durations))
+            return 42
+
+        sec, result = timing.time_fn(fn, warmup=1, repeats=3,
+                                     reduce="best")
+        assert result == 42
+        assert len(calls) == 4            # 1 warmup + 3 timed
+        assert sec == 1.0                 # best-of excludes the warmup
+
+    def test_median_reduction(self, clock):
+        durations = iter([9.0, 2.0, 8.0, 4.0])
+
+        def fn():
+            clock.advance(next(durations))
+
+        sec, _ = timing.time_fn(fn, warmup=1, repeats=3, reduce="median")
+        assert sec == 4.0
+
+    def test_invalid_reduce_raises(self, clock):
+        with pytest.raises(ValueError, match="unknown reduce mode"):
+            timing.time_fn(lambda: None, warmup=1, repeats=1,
+                           reduce="mean")
+
+
+class TestPairedDeltaRate:
+    def test_exact_rate_on_linear_workload(self, clock):
+        # run(it) costs overhead + it / rate: the pairing cancels the
+        # overhead exactly, so the measured rate is exact
+        rate_true = 50_000.0
+        overhead = 0.030
+
+        def run(it):
+            clock.advance(overhead + it / rate_true)
+            return None
+
+        got = timing.paired_delta_rate(run, 100, 10100, pairs=5)
+        assert got == pytest.approx(rate_true, rel=1e-9)
+
+    def test_robust_to_one_jitter_spike(self, clock):
+        rate_true = 10_000.0
+        spikes = {3}                      # pair index with a jitter hit
+        calls = [0]
+
+        def run(it):
+            pair = calls[0] // 2 - 1      # after the 2 warmup calls
+            calls[0] += 1
+            extra = 0.5 if (pair in spikes and it > 100) else 0.0
+            clock.advance(0.01 + it / rate_true + extra)
+
+        got = timing.paired_delta_rate(run, 100, 1100, pairs=7)
+        # median over pairs discards the spiked pair
+        assert got == pytest.approx(rate_true, rel=1e-9)

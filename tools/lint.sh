@@ -11,6 +11,16 @@ echo "== graftlint (cuda_mpi_parallel_tpu.analysis) =="
 python -m cuda_mpi_parallel_tpu.analysis cuda_mpi_parallel_tpu
 echo "graftlint: clean"
 
+# Telemetry must NEVER force a device sync inside a solve loop: hold
+# the telemetry package to GL105 (host-sync) explicitly, failing on any
+# finding.  (The package-wide run above already includes telemetry/ for
+# all rules; this names the observability contract and keeps it from
+# being relaxed by a future --ignore.)
+echo "== graftlint telemetry/ (GL105 host-sync, zero findings) =="
+python -m cuda_mpi_parallel_tpu.analysis --select GL105 --fail-on info \
+    cuda_mpi_parallel_tpu/telemetry
+echo "telemetry: GL105 clean"
+
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
