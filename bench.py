@@ -352,6 +352,50 @@ def _device_df64_pairs(b_np64, k: int):
     return pairs
 
 
+def _flight_config(maxiter: int, stride: int = 1):
+    from cuda_mpi_parallel_tpu.telemetry.flight import FlightConfig
+
+    return FlightConfig.for_solve(maxiter, stride=stride)
+
+
+def _flight_summary(res) -> dict | None:
+    """Convergence-behavior columns from a flight-recorded result: the
+    recorder summary (residual decay rate) plus the solve-health verdict
+    (classification, Ritz kappa estimate at stride 1).  These are what
+    tools/bench_compare.py gates on beyond raw throughput - a solver
+    change that keeps iters/s but stagnates earlier now shows up in
+    bench_results.json.  ``None`` when the result carries no recorder
+    buffer (engine without flight support)."""
+    from cuda_mpi_parallel_tpu.telemetry.flight import FlightRecord
+    from cuda_mpi_parallel_tpu.telemetry.health import assess_solve_health
+    from cuda_mpi_parallel_tpu.utils.logging import sanitize
+
+    buf = getattr(res, "flight", None)
+    if buf is None:
+        return None
+    rec = FlightRecord.from_buffer(buf)
+    health = assess_solve_health(
+        rec, converged=bool(res.converged), status=int(res.status),
+        iterations=int(res.iterations))
+    out = rec.summary()
+    out["kappa_estimate"] = health.kappa_estimate
+    out["classification"] = health.classification.name
+    # sanitize (non-finite -> null, numpy scalars -> python): raw
+    # json.dump would emit non-JSON NaN literals into bench_results.json
+    return sanitize(out)
+
+
+def _convergence_entry(res) -> dict:
+    """``iterations``/``converged`` (+ flight summary when recorded) -
+    the per-section convergence record bench_compare gates on."""
+    entry = {"iterations": int(res.iterations),
+             "converged": bool(res.converged)}
+    flight = _flight_summary(res)
+    if flight is not None:
+        entry["flight"] = flight
+    return entry
+
+
 def bench_headline(device=None):
     import jax
     import jax.numpy as jnp
@@ -401,7 +445,16 @@ def bench_headline(device=None):
         return solve(op, bb, tol=0.0, maxiter=it, check_every=32).x
 
     value = paired_delta_rate(run, ITERS_LO, ITERS_HI, pairs=7)
-    return {
+    # One flight-recorded convergence solve alongside the throughput
+    # delta: the headline row carries iterations-to-tolerance and the
+    # solve-health verdict so bench_compare can gate on convergence
+    # behavior, not just iters/s.  Always the general engine - the
+    # convergence trajectory is engine-independent (trajectory-parity
+    # tests), and only the general solver carries the per-iteration
+    # recorder everywhere this runs.
+    probe = solve(op, b, tol=0.0, rtol=1e-6, maxiter=2000,
+                  check_every=32, flight=_flight_config(2000))
+    entry = {
         "metric": HEADLINE_METRIC,
         "value": round(value, 1),
         "unit": "iters/s",
@@ -411,6 +464,8 @@ def bench_headline(device=None):
         # historical comparisons of this row.
         "engine": "resident" if use_resident else "general_whileloop",
     }
+    entry.update(_convergence_entry(probe))
+    return entry
 
 
 # The order --all RUNS sections in - most valuable first, so a short or
@@ -842,12 +897,12 @@ def bench_all(results, sections=None) -> None:
 
             solves_per_sec = paired_delta_rate(
                 lambda reps, m=m: many(b3, m, reps), 1, 21, pairs=3)
-            res = solve(op2, b3, tol=0.0, rtol=1e-6, maxiter=5000, m=m)
-            results[f"poisson2d_512_{name}_rtol1e-6"] = {
-                "time_to_tol_s": 1.0 / solves_per_sec,
-                "iterations": int(res.iterations),
-                "converged": bool(res.converged),
-                "measurement": "solve_delta"}
+            res = solve(op2, b3, tol=0.0, rtol=1e-6, maxiter=5000, m=m,
+                        flight=_flight_config(5000))
+            entry = {"time_to_tol_s": 1.0 / solves_per_sec,
+                     "measurement": "solve_delta"}
+            entry.update(_convergence_entry(res))
+            results[f"poisson2d_512_{name}_rtol1e-6"] = entry
 
         # The VMEM-resident engine on the same ladder (plain + in-kernel
         # Chebyshev): one kernel per solve, compiled-TPU only.
@@ -962,11 +1017,13 @@ def bench_all(results, sections=None) -> None:
             # trajectory parity: same iteration count as the general
             # solver at the same tolerance (VERDICT item-2 bar)
             res_s = cg_streaming(a256, b256, tol=0.0, rtol=1e-6,
-                                 maxiter=1500, check_every=32)
+                                 maxiter=1500, check_every=32,
+                                 flight=_flight_config(1500))
             res_g = solve(a256, b256, tol=0.0, rtol=1e-6, maxiter=1500,
                           check_every=32)
             entry["iterations_streaming_vs_general"] = [
                 int(res_s.iterations), int(res_g.iterations)]
+            entry.update(_convergence_entry(res_s))
             results["poisson3d_256_streaming"] = entry
         for name, m256 in [
             ("chebyshev4",
@@ -986,12 +1043,12 @@ def bench_all(results, sections=None) -> None:
             solves_per_sec = paired_delta_rate(
                 lambda reps, m256=m256: many256(b256, m256, reps),
                 1, 5, pairs=3)
-            res = solve(a256, b256, tol=0.0, rtol=1e-6, maxiter=2000, m=m256)
-            results[f"poisson3d_256_{name}_rtol1e-6"] = {
-                "time_to_tol_s": 1.0 / solves_per_sec,
-                "iterations": int(res.iterations),
-                "converged": bool(res.converged),
-                "measurement": "solve_delta"}
+            res = solve(a256, b256, tol=0.0, rtol=1e-6, maxiter=2000,
+                        m=m256, flight=_flight_config(2000))
+            entry = {"time_to_tol_s": 1.0 / solves_per_sec,
+                     "measurement": "solve_delta"}
+            entry.update(_convergence_entry(res))
+            results[f"poisson3d_256_{name}_rtol1e-6"] = entry
 
     registry.append(("northstar256", s_northstar))
 
@@ -1022,11 +1079,13 @@ def bench_all(results, sections=None) -> None:
                 a256, rr, tol=0.0, maxiter=it, check_every=32, m=m).x)
         entry["engine"] = "streaming_cheb4"
         res_s = cg_streaming(a256, b256, tol=0.0, rtol=1e-6,
-                             maxiter=2000, check_every=32, m=m)
+                             maxiter=2000, check_every=32, m=m,
+                             flight=_flight_config(2000))
         res_g = solve(a256, b256, tol=0.0, rtol=1e-6, maxiter=2000,
                       check_every=32, m=m)
         entry["iterations_cheb_streaming_vs_general"] = [
             int(res_s.iterations), int(res_g.iterations)]
+        entry.update(_convergence_entry(res_s))
         # derived, not a wall-clock solve_delta: iteration-delta rate x
         # measured iterations-to-rtol-1e-6 (components recorded above)
         entry["time_to_tol_s_derived"] = (
@@ -1155,11 +1214,11 @@ def bench_all(results, sections=None) -> None:
             m_mm = JacobiPreconditioner.from_operator(a_fast)
             el, res = time_fn(
                 lambda: solve(a_fast, b_mm, tol=0.0, rtol=1e-6,
-                              maxiter=10000, m=m_mm),
+                              maxiter=10000, m=m_mm,
+                              flight=_flight_config(10000)),
                 warmup=1, repeats=2)
-            entry.update({"time_to_tol_s": el,
-                          "iterations": int(res.iterations),
-                          "converged": bool(res.converged)})
+            entry.update({"time_to_tol_s": el})
+            entry.update(_convergence_entry(res))
             results[key] = entry
 
         mtx_files = sorted(glob.glob("matrices/*.mtx"))

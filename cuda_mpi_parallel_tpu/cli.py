@@ -146,6 +146,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "back to the original ordering)")
     p.add_argument("--history", action="store_true",
                    help="print per-iteration residual trace")
+    p.add_argument("--flight-record", nargs="?", const=1, default=None,
+                   type=int, metavar="STRIDE", dest="flight_record",
+                   help="carry the convergence flight recorder in the "
+                        "solve loop: a fixed-size ring buffer of "
+                        "(iteration, ||r||^2, alpha, beta) rows sampled "
+                        "every STRIDE iterations (default 1), fetched "
+                        "once post-solve - zero host round-trips in the "
+                        "hot loop.  Enables solve-health diagnostics "
+                        "(stagnation/divergence classification, Ritz "
+                        "condition estimate) and makes --history work "
+                        "with --mesh > 1 and the resident/streaming "
+                        "engines (psum'd residuals; block-granular on "
+                        "resident)")
+    p.add_argument("--flight-heartbeat", type=int, default=0, metavar="K",
+                   dest="flight_heartbeat",
+                   help="with --flight-record: post a sampled in-flight "
+                        "heartbeat (iteration, ||r||^2) to the host "
+                        "every K iterations via an unordered "
+                        "jax.debug.callback - progress visibility for "
+                        "long solves.  0 (default) compiles the loop "
+                        "with NO callback at all; single-device "
+                        "general/streaming engines only")
     p.add_argument("--json", action="store_true",
                    help="emit a single JSON record instead of text")
     p.add_argument("--profile", default=None, metavar="DIR",
@@ -268,6 +290,26 @@ def main(argv=None) -> int:
     if args.precond_degree < 1:
         raise SystemExit(
             f"--precond-degree must be >= 1, got {args.precond_degree}")
+    if args.flight_record is not None and args.flight_record < 1:
+        raise SystemExit(f"--flight-record stride must be >= 1, got "
+                         f"{args.flight_record}")
+    if args.flight_heartbeat < 0:
+        raise SystemExit(f"--flight-heartbeat must be >= 0, got "
+                         f"{args.flight_heartbeat}")
+    if args.flight_heartbeat and args.flight_record is None:
+        raise SystemExit("--flight-heartbeat requires --flight-record "
+                         "(the heartbeat samples the recorder's scalars)")
+    if args.flight_heartbeat and (args.mesh > 1
+                                  or args.engine == "resident"):
+        # never silently drop a flag (ADVICE.md round 5): shard_map'd
+        # solves suppress the callback (one per shard per sample would
+        # multiply the stream) and the resident kernels never carry it
+        raise SystemExit(
+            "--flight-heartbeat is single-device general/streaming "
+            "only: shard_map'd solves suppress the in-loop callback "
+            "and the resident one-kernel engines never carry one. "
+            "Drop --flight-heartbeat (the flight record itself still "
+            "works), or use --mesh 1 with the general engine.")
     if args.block_size < 1:
         raise SystemExit(f"--block-size must be >= 1, got {args.block_size}")
     if args.backend != "auto" and not args.matrix_free:
@@ -370,18 +412,45 @@ def main(argv=None) -> int:
             raise SystemExit(f"--format {args.fmt}: {e}")
         desc += f" [{args.fmt}]"
 
-    # The distributed resident/streaming engines record no residual
-    # trace (one kernel launch per chip - there is no per-iteration
-    # host visibility to build one from); reject rather than silently
-    # dropping the flag (ADVICE.md round 5).
+    # The convergence flight recorder (telemetry.flight): a fixed-size
+    # stride-decimated ring of (iteration, ||r||^2, alpha, beta) rows
+    # carried in the solve loop, fetched once post-solve.
+    flight_cfg = None
+    if args.flight_record is not None:
+        if args.method == "minres":
+            raise SystemExit(
+                "--flight-record does not support --method minres (its "
+                "Lanczos recurrence has no CG alpha/beta scalars to "
+                "record; use --history for its per-iteration trace)")
+        if args.df64 and args.method != "cg":
+            raise SystemExit(
+                f"--flight-record with --dtype df64 supports --method "
+                f"cg only (got --method {args.method}); use --history "
+                f"for the variants' dense trace")
+        from .telemetry.flight import FlightConfig
+
+        flight_cfg = FlightConfig.for_solve(
+            args.maxiter, stride=args.flight_record,
+            heartbeat=args.flight_heartbeat)
+
+    # The distributed resident/streaming engines keep every iteration
+    # on device; without the flight recorder there is no per-iteration
+    # host visibility to build a --history trace from.  With
+    # --flight-record the trace rides the recorder (psum'd residuals -
+    # per-iteration on streaming, check-block granular on resident), so
+    # the refusal only applies to the bare flag (ADVICE.md round 5:
+    # never silently drop it).
     if args.history and args.mesh > 1 \
-            and args.engine in ("resident", "streaming"):
+            and args.engine in ("resident", "streaming") \
+            and flight_cfg is None:
         raise SystemExit(
-            f"--history is unavailable with --engine {args.engine} "
-            f"--mesh {args.mesh}: the distributed one-kernel-per-chip "
-            f"solves keep every iteration on device and record no "
-            f"residual trace. Drop --history, or use --engine general "
-            f"for a traced distributed solve.")
+            f"--history with --engine {args.engine} --mesh {args.mesh} "
+            f"needs the convergence flight recorder: the distributed "
+            f"one-kernel-per-chip solves keep every iteration on device "
+            f"and record no dense residual trace. Add --flight-record "
+            f"[STRIDE] to carry the on-device ring buffer (the "
+            f"decimated trace prints through it), or use --engine "
+            f"general for a dense traced distributed solve.")
     if args.engine == "resident":
         if args.mesh > 1 and (args.precond not in (None, "chebyshev")
                               or args.method != "cg" or args.df64):
@@ -395,9 +464,9 @@ def main(argv=None) -> int:
             raise SystemExit("--engine resident supports --method cg "
                              "(--precond chebyshev or none) or the "
                              "unpreconditioned --method cg1 single-"
-                             "reduction kernel (--history is fine: the "
-                             "kernel records a check-block-granular "
-                             "trace)")
+                             "reduction kernel (--history and "
+                             "--flight-record are fine: both ride the "
+                             "kernel's check-block-granular trace)")
     if args.method == "minres":
         if args.precond is not None:
             raise SystemExit(
@@ -419,8 +488,8 @@ def main(argv=None) -> int:
         if args.precond not in (None, "chebyshev") or args.method != "cg":
             raise SystemExit("--engine streaming supports --method cg "
                              "with --precond chebyshev or none "
-                             "(--history is fine: the "
-                             "trace is per-iteration)")
+                             "(--history and --flight-record are fine: "
+                             "the trace is per-iteration)")
         if args.df64:
             raise SystemExit("--engine streaming is float32-only "
                              "(--dtype df64 routes through the general "
@@ -438,7 +507,8 @@ def main(argv=None) -> int:
                     preconditioner=args.precond,
                     precond_degree=args.precond_degree,
                     record_history=args.history,
-                    check_every=args.check_every, method=args.method)
+                    check_every=args.check_every, method=args.method,
+                    flight=flight_cfg)
             if args.engine in ("auto", "resident") and args.mesh == 1:
                 from .models.operators import _pallas_interpret
                 from .solver.resident import (
@@ -446,12 +516,18 @@ def main(argv=None) -> int:
                     supports_resident_df64,
                 )
 
+                # auto + --flight-record keeps the per-iteration general
+                # df64 recorder; an explicit --engine resident records
+                # at the kernel's check-block granularity (the block
+                # trace adapts into the recorder layout post-solve)
                 eligible = (supports_resident_df64(
                                 a,
                                 preconditioned=args.precond == "chebyshev")
                             and args.precond in (None, "chebyshev")
                             and args.method == "cg"
                             and (not args.history
+                                 or args.engine == "resident")
+                            and (flight_cfg is None
                                  or args.engine == "resident")
                             and (args.engine == "resident"
                                  or _jax_backend_is_tpu()))
@@ -465,7 +541,8 @@ def main(argv=None) -> int:
                         a, np.asarray(b, dtype=np.float64), tol=args.tol,
                         rtol=args.rtol, maxiter=args.maxiter,
                         check_every=args.check_every,
-                        record_history=args.history,
+                        record_history=(args.history
+                                        or flight_cfg is not None),
                         preconditioner=args.precond,
                         precond_degree=args.precond_degree,
                         interpret=_pallas_interpret())
@@ -478,7 +555,7 @@ def main(argv=None) -> int:
                            precond_degree=args.precond_degree,
                            record_history=args.history,
                            check_every=args.check_every,
-                           method=args.method)
+                           method=args.method, flight=flight_cfg)
         if args.mesh > 1:
             from .parallel import make_mesh, solve_distributed
             from .models.operators import CSRMatrix, Stencil2D, Stencil3D
@@ -502,7 +579,8 @@ def main(argv=None) -> int:
                     return solve_distributed_resident(
                         a, b, mesh=make_mesh(args.mesh), tol=args.tol,
                         rtol=args.rtol, maxiter=args.maxiter,
-                        check_every=args.check_every, m=m_dr)
+                        check_every=args.check_every, m=m_dr,
+                        record_history=args.history, flight=flight_cfg)
                 except (TypeError, ValueError) as e:
                     raise SystemExit(f"--engine resident --mesh "
                                      f"{args.mesh}: {e}")
@@ -513,7 +591,7 @@ def main(argv=None) -> int:
                     return solve_distributed_streaming(
                         a, b, mesh=make_mesh(args.mesh), tol=args.tol,
                         rtol=args.rtol, maxiter=args.maxiter,
-                        check_every=args.check_every)
+                        check_every=args.check_every, flight=flight_cfg)
                 except (TypeError, ValueError) as e:
                     raise SystemExit(f"--engine streaming --mesh "
                                      f"{args.mesh}: {e}")
@@ -527,7 +605,8 @@ def main(argv=None) -> int:
                 preconditioner=args.precond,
                 precond_degree=args.precond_degree,
                 record_history=args.history, method=args.method,
-                check_every=args.check_every, csr_comm=args.csr_comm)
+                check_every=args.check_every, csr_comm=args.csr_comm,
+                flight=flight_cfg)
         if args.engine in ("auto", "resident"):
             from .models.operators import _pallas_interpret
             from .solver.resident import (
@@ -553,8 +632,14 @@ def main(argv=None) -> int:
             # auto keeps history on the general solver's per-iteration
             # granularity - same rule as solve(engine=...).
             history_ok = not args.history or args.engine == "resident"
+            # same rule for the flight recorder: the kernel trace is
+            # check-block granular, so auto keeps a requested recorder
+            # on the general solver's per-iteration granularity; an
+            # explicit --engine resident adapts the block trace
+            flight_ok = flight_cfg is None or args.engine == "resident"
             cheap_ok = (args.precond in (None, "chebyshev")
                         and args.method in ("cg", "cg1") and history_ok
+                        and flight_ok
                         and (args.engine == "resident"
                              or _jax_backend_is_tpu())
                         and supports_resident(
@@ -580,7 +665,10 @@ def main(argv=None) -> int:
                 return cg_resident(a, b, tol=args.tol, rtol=args.rtol,
                                    maxiter=args.maxiter,
                                    check_every=args.check_every,
-                                   m=m_res, record_history=args.history,
+                                   m=m_res,
+                                   record_history=(
+                                       args.history
+                                       or flight_cfg is not None),
                                    method=args.method,
                                    interpret=_pallas_interpret())
         if args.engine in ("auto", "streaming"):
@@ -622,6 +710,7 @@ def main(argv=None) -> int:
                                     check_every=args.check_every,
                                     m=m_st,
                                     record_history=args.history,
+                                    flight=flight_cfg,
                                     interpret=_pallas_interpret())
         from . import solve
         from .models.operators import JacobiPreconditioner
@@ -651,7 +740,7 @@ def main(argv=None) -> int:
         return solve(a, b, tol=args.tol, rtol=args.rtol,
                      maxiter=args.maxiter, m=m,
                      record_history=args.history, method=args.method,
-                     check_every=args.check_every)
+                     check_every=args.check_every, flight=flight_cfg)
 
     from .telemetry import events as tevents
     from .telemetry import session as tsession
@@ -693,10 +782,12 @@ def main(argv=None) -> int:
                 x=result.x(), iterations=result.iterations,
                 residual_norm=result.residual_norm(),
                 converged=result.converged, indefinite=result.indefinite,
+                status=result.status,
                 status_enum=result.status_enum,
                 # ||r|| with NaN fill - same semantics as CGResult, no
                 # adaptation needed
-                residual_history=result.residual_history)
+                residual_history=result.residual_history,
+                flight=result.flight)
 
         # per-solve communication account: jaxpr-derived per-iteration
         # collective counts x the measured iteration count (the volume
@@ -719,7 +810,43 @@ def main(argv=None) -> int:
                     "kind": ctx.get("kind"),
                     "n_shards": ctx.get("n_shards"),
                 }
-        obs.finish(result, elapsed_s=elapsed,
+        # The flight record: ONE host fetch of the solve-carried ring
+        # buffer (the solve is complete and synced by now), then the
+        # solve-health verdict computed host-side from the recorded
+        # trace (telemetry.health) - classification + decay rates +
+        # Ritz condition estimate, emitted as a solve_health event and
+        # gauges by obs.finish.
+        flight_rec = None
+        health = None
+        if flight_cfg is not None:
+            from .telemetry.flight import FlightRecord
+            from .telemetry.health import assess_solve_health
+
+            fbuf = getattr(result, "flight", None)
+            if fbuf is not None:
+                # ring buffers record at the configured stride; the
+                # distributed resident engine's fbuf is its adapted
+                # block trace (check_every-granular) - pass the known
+                # stride rather than letting a 2-row trace infer it
+                # from a cap-clamped final diff
+                stride_hint = (max(1, args.check_every)
+                               if args.engine == "resident"
+                               else flight_cfg.stride)
+                flight_rec = FlightRecord.from_buffer(
+                    fbuf, stride=stride_hint)
+            elif result.residual_history is not None:
+                # engines whose recorder is the adapted dense/block
+                # trace (single-device resident: record_history was
+                # forced on above, check-block granular)
+                flight_rec = FlightRecord.from_history(
+                    result.residual_history,
+                    stride=max(1, args.check_every))
+            if flight_rec is not None and len(flight_rec):
+                health = assess_solve_health(
+                    flight_rec, converged=bool(result.converged),
+                    status=int(result.status),
+                    iterations=int(result.iterations))
+        obs.finish(result, elapsed_s=elapsed, health=health,
                    **({"comm": comm} if comm is not None else {}))
 
     x_np = np.asarray(result.x)
@@ -738,6 +865,10 @@ def main(argv=None) -> int:
         record["max_abs_error"] = err
     if comm is not None:
         record["comm"] = comm
+    if flight_rec is not None:
+        record["flight"] = flight_rec.summary()
+    if health is not None:
+        record["health"] = health.to_json()
     if args.metrics and args.json:
         from .telemetry.registry import REGISTRY
 
@@ -769,9 +900,33 @@ def main(argv=None) -> int:
                   f"{comm['comm_bytes']} payload bytes "
                   f"(per-device; {comm['per_iteration']['comm_bytes']} "
                   f"bytes/iter)")
+        if health is not None:
+            print(f"health  : {health.classification.name}: "
+                  f"{health.message}")
         if args.history:
-            print(ulog.format_history(
-                result, every=max(1, int(result.iterations) // 20)))
+            hist_src = result
+            every = max(1, int(result.iterations) // 20)
+            dense_missing = result.residual_history is None
+            if flight_rec is not None \
+                    and (dense_missing or args.engine == "resident"):
+                # engines with no dense trace print the recorder's
+                # stride-decimated one through the same formatter, and
+                # the resident engines' dense-layout trace is
+                # check-block granular (finite only at multiples of
+                # check_every): either way the print stride must be a
+                # multiple of the recorder's or the sampled indices
+                # land on NaN (unrecorded) rows and the trace collapses
+                # to almost nothing
+                s = max(1, int(flight_rec.stride))
+                every = max(s, every // s * s)
+                if dense_missing:
+                    import types as _types
+
+                    hist_src = _types.SimpleNamespace(
+                        residual_history=flight_rec.to_history(
+                            args.maxiter),
+                        iterations=result.iterations)
+            print(ulog.format_history(hist_src, every=every))
         if args.metrics:
             from .telemetry.registry import REGISTRY
 

@@ -78,7 +78,7 @@ def _remote_row_copy(src_ref, dst_ref, send_sem, recv_sem, target):
 def _resident_dist_kernel(nblocks, check_every, n_shards, axis_name,
                           local_shape, degree, params_ref, cap_ref,
                           b_ref, x_ref, iters_ref, rr_ref, indef_ref,
-                          conv_ref, health_ref,
+                          conv_ref, health_ref, hist_ref,
                           r_ref, p_ref, halo_ref, pap_buf, rr_buf,
                           state_f, state_i,
                           halo_send, halo_recv, dot_send, dot_recv,
@@ -275,6 +275,22 @@ def _resident_dist_kernel(nblocks, check_every, n_shards, axis_name,
     state_i[0] = jnp.int32(0)               # iterations completed
     state_i[1] = jnp.int32(0)               # indefiniteness (quirk Q1)
 
+    # Block-granular residual trace, mirroring the single-device kernel
+    # (ops/pallas/resident.py): slot 0 = ||r0||^2, slot j+1 = ||r||^2
+    # after check block j - the scalar the kernel already holds (and
+    # allreduced to bit-identical values on every shard) for the
+    # convergence decision, so the trace costs nothing per iteration
+    # and is replicated by construction.  Never-run blocks keep the
+    # -1.0 sentinel (||r||^2 >= 0 makes it unambiguous; a NaN fill
+    # would trip jax_debug_nans on every default solve).
+    hist_ref[0] = rr0
+
+    def sentinel_fill(j, c):
+        hist_ref[j] = jnp.float32(-1.0)
+        return c
+
+    lax.fori_loop(1, nblocks + 1, sentinel_fill, jnp.int32(0))
+
     def block(blk, carry):
         # health mirrors the single-device kernel: non-finite scalars
         # are a breakdown, and rho <= 0 with r != 0 is a preconditioner
@@ -317,6 +333,7 @@ def _resident_dist_kernel(nblocks, check_every, n_shards, axis_name,
             state_f[0] = rr_out
             state_f[1] = rho_out
             state_i[0] = state_i[0] + nsteps
+            hist_ref[blk + 1] = rr_out
         return carry
 
     lax.fori_loop(0, nblocks, block, jnp.int32(0))
@@ -359,7 +376,10 @@ def cg_resident_dist_local(scale, tol, rtol, cap, b_local, lmin=None,
                            degree=0):
     """The per-shard pallas call (must run inside ``jax.shard_map`` over
     a 1-D mesh whose axis is ``axis_name``).  Returns the local x slab
-    plus the (replicated-by-construction) solve scalars.
+    plus the (replicated-by-construction) solve scalars and the
+    block-granular ``||r||^2`` trace (``(nblocks + 1,)``, -1.0
+    sentinels for never-run blocks - same layout as the single-device
+    kernel's).
 
     ``degree`` > 0 applies the degree-term in-kernel Chebyshev
     polynomial on the spectral interval [``lmin``, ``lmax``] (traced
@@ -404,10 +424,10 @@ def cg_resident_dist_local(scale, tol, rtol, cap, b_local, lmin=None,
             detect_races=detect_races)
     else:
         interpret_mode = False
-    x, iters, rr, indef, conv, health = pl.pallas_call(
+    x, iters, rr, indef, conv, health, hist = pl.pallas_call(
         kernel,
         in_specs=[smem, smem, vmem],
-        out_specs=[vmem, smem, smem, smem, smem, smem],
+        out_specs=[vmem, smem, smem, smem, smem, smem, smem],
         out_shape=[
             jax.ShapeDtypeStruct(local_shape, jnp.float32),   # x slab
             jax.ShapeDtypeStruct((1,), jnp.int32),            # iterations
@@ -415,6 +435,7 @@ def cg_resident_dist_local(scale, tol, rtol, cap, b_local, lmin=None,
             jax.ShapeDtypeStruct((1,), jnp.int32),            # indefinite
             jax.ShapeDtypeStruct((1,), jnp.int32),            # converged
             jax.ShapeDtypeStruct((1,), jnp.int32),            # healthy
+            jax.ShapeDtypeStruct((nblocks + 1,), jnp.float32),  # trace
         ],
         scratch_shapes=[
             pltpu.VMEM(local_shape, jnp.float32),             # r
@@ -451,4 +472,4 @@ def cg_resident_dist_local(scale, tol, rtol, cap, b_local, lmin=None,
                 vmem_bytes())),
         interpret=interpret_mode,
     )(params, cap_arr, b_local)
-    return x, iters[0], rr[0], indef[0], conv[0], health[0]
+    return x, iters[0], rr[0], indef[0], conv[0], health[0], hist

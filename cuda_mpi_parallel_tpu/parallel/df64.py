@@ -211,6 +211,7 @@ def solve_distributed_df64(
     record_history: bool = False,
     check_every: int = 1,
     method: str = "cg",
+    flight=None,
 ) -> DF64CGResult:
     """df64 CG on a slab-partitioned stencil system over a device mesh.
 
@@ -235,6 +236,12 @@ def solve_distributed_df64(
         INDEFINITE systems, quirk Q1 - ``solver.minres.minres_df64``
         with its df64 dots psum-ed over the mesh; unpreconditioned,
         slab stencils only).
+      flight: optional ``telemetry.flight.FlightConfig`` - carry the
+        convergence flight recorder inside the shard_map'd df64 solve
+        (``method="cg"`` only, mirroring ``cg_df64``).  The recorded
+        scalars are the psum'd global HI words, so the returned buffer
+        is replicated across shards; ``None`` leaves the cached
+        executable bit-identical to a recorder-free build.
       (mesh/n_devices/tol/rtol/maxiter/record_history/check_every as in
       ``solve_distributed`` / ``cg_df64``.)
 
@@ -260,6 +267,15 @@ def solve_distributed_df64(
     if method not in ("cg", "cg1", "pipecg", "minres"):
         raise ValueError(f"unknown method {method!r}; expected 'cg', "
                          f"'cg1', 'pipecg' or 'minres'")
+    if flight is not None and method != "cg":
+        # same gate as cg_df64: the recorder rides the textbook
+        # recurrence only
+        raise ValueError(
+            f"solve_distributed_df64 carries the flight recorder on "
+            f"method='cg' only (got method={method!r}); use "
+            f"record_history for the variants' dense trace")
+    if flight is not None:
+        flight = flight.without_heartbeat()
     if method == "minres":
         # the principled solver for symmetric-INDEFINITE systems (quirk
         # Q1) in the distributed df64 tier; unpreconditioned, matrix-free
@@ -298,7 +314,7 @@ def solve_distributed_df64(
                   else None),
             mg_flag=preconditioner == "mg",
             record_history=record_history, check_every=check_every,
-            method=method)
+            method=method, flight=flight)
     axis = mesh.axis_names[0]
     n_shards = mesh.devices.size
     if isinstance(a, CSRMatrix):
@@ -308,7 +324,7 @@ def solve_distributed_df64(
             cheb=(precond_degree if preconditioner == "chebyshev"
                   else None),
             record_history=record_history, check_every=check_every,
-            method=method)
+            method=method, flight=flight)
     local = DistStencilDF64.create(a.grid, n_shards, axis_name=axis,
                                    scale=a.scale)
     mg_flag = preconditioner == "mg"
@@ -340,9 +356,10 @@ def solve_distributed_df64(
         residual_norm_sq_hi=P(), residual_norm_sq_lo=P(), converged=P(),
         status=P(), indefinite=P(),
         residual_history=P() if record_history else None,
-        checkpoint=None)
+        checkpoint=None,
+        flight=P() if flight is not None else None)
     key = (local.local_grid, local.kind, axis, mesh, jacobi, cheb,
-           mg_flag, record_history, maxiter, check_every, method,
+           mg_flag, record_history, maxiter, check_every, method, flight,
            # minres bakes tol/rtol into its trace as df consts (the cg
            # family takes them traced, so they stay out of the key)
            (float(tol), float(rtol)) if method == "minres" else None)
@@ -378,7 +395,7 @@ def solve_distributed_df64(
                              maxiter=maxiter,
                              record_history=record_history, jacobi=jacobi,
                              axis_name=axis, check_every=check_every,
-                             chebyshev_degree=cheb)
+                             chebyshev_degree=cheb, flight=flight)
         return run
 
     fn = _SOLVER_CACHE.get(key)
@@ -390,7 +407,7 @@ def solve_distributed_df64(
 
 def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
                        cheb, record_history, check_every,
-                       method, mg_flag=False) -> DF64CGResult:
+                       method, mg_flag=False, flight=None) -> DF64CGResult:
     """Stencil3D df64 over a 2-D mesh: x- and y-axes partitioned, two
     halo ppermute pairs per matvec (hi/lo stacked), dots reduced over
     BOTH mesh axes at df64 accuracy."""
@@ -421,10 +438,11 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
         residual_norm_sq_hi=P(), residual_norm_sq_lo=P(), converged=P(),
         status=P(), indefinite=P(),
         residual_history=P() if record_history else None,
-        checkpoint=None)
+        checkpoint=None,
+        flight=P() if flight is not None else None)
     key = ("pencil-df64", local.local_grid, local.shards, (ax_x, ax_y),
            mesh, jacobi, cheb, mg_flag, record_history, maxiter,
-           check_every, method)
+           check_every, method, flight)
 
     def build():
         @partial(shard_map, mesh=mesh,
@@ -453,7 +471,7 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
                                 record_history=record_history,
                                 jacobi=jacobi, axis_name=axis,
                                 check_every=check_every,
-                                chebyshev_degree=cheb)
+                                chebyshev_degree=cheb, flight=flight)
             return dataclasses.replace(
                 res, x_hi=res.x_hi.reshape(loc.local_grid),
                 x_lo=res.x_lo.reshape(loc.local_grid))
@@ -470,7 +488,8 @@ def _solve_pencil_df64(a, b64, mesh, *, tol, rtol, maxiter, jacobi,
 
 def _solve_csr_shiftell_df64(a, b64, mesh, axis, n_shards, *, tol, rtol,
                              maxiter, jacobi, cheb, record_history,
-                             check_every, method) -> DF64CGResult:
+                             check_every, method,
+                             flight=None) -> DF64CGResult:
     """General-CSR distributed df64: ring schedule with df64 shift-ELL
     slabs (``DistShiftELLDF64Ring``) - the full realization of the
     reference's defining combination, f64 assembled SpMV
@@ -502,11 +521,12 @@ def _solve_csr_shiftell_df64(a, b64, mesh, axis, n_shards, *, tol, rtol,
         residual_norm_sq_hi=P(), residual_norm_sq_lo=P(), converged=P(),
         status=P(), indefinite=P(),
         residual_history=P() if record_history else None,
-        checkpoint=None)
+        checkpoint=None,
+        flight=P() if flight is not None else None)
     chunk_shape = tuple(v.shape[1] for v in parts.vals_hi)
     key = ("csr-shiftell-df64", n_local, n_shards, parts.h, parts.kc,
            chunk_shape, axis, mesh, jacobi, cheb, record_history,
-           maxiter, check_every, method)
+           maxiter, check_every, method, flight)
 
     def build():
         # check_vma=False: the pallas slab kernel cannot declare varying
@@ -535,7 +555,7 @@ def _solve_csr_shiftell_df64(a, b64, mesh, axis, n_shards, *, tol, rtol,
                              maxiter=maxiter,
                              record_history=record_history, jacobi=jacobi,
                              axis_name=axis, check_every=check_every,
-                             chebyshev_degree=cheb)
+                             chebyshev_degree=cheb, flight=flight)
         return run
 
     fn = _SOLVER_CACHE.get(key)

@@ -67,6 +67,7 @@ def solve_distributed(
     check_every: int = 1,
     compensated: bool = False,
     csr_comm: str = "allgather",
+    flight=None,
 ) -> CGResult:
     """Solve the global system A x = b row-partitioned over a device mesh.
 
@@ -102,6 +103,13 @@ def solve_distributed(
         the mesh via ``lax.ppermute`` in n_shards steps: O(n/P) memory,
         compute overlaps communication - the ring-attention schedule
         applied to SpMV).  Ignored for stencil operators.
+      flight: optional ``telemetry.flight.FlightConfig`` - carry the
+        convergence flight recorder inside the shard_map'd solve.  The
+        recorded ``||r||^2``/alpha/beta are the PSUM'D global scalars
+        (the loop already holds them replicated), so the returned
+        buffer is identical on every shard and costs no extra
+        collective; ``None`` leaves the cached executable bit-identical
+        to a recorder-free build (the config is part of the cache key).
       (tol/rtol/maxiter/record_history/check_every/compensated as in
       ``solver.cg``.)
 
@@ -124,8 +132,11 @@ def solve_distributed(
                          f"shape {b.shape}")
     if csr_comm not in ("allgather", "ring", "ring-shiftell"):
         raise ValueError(f"unknown csr_comm: {csr_comm!r}")
+    if flight is not None:
+        flight = flight.without_heartbeat()
     kw = dict(tol=tol, rtol=rtol, maxiter=maxiter, method=method,
-              check_every=check_every, compensated=compensated)
+              check_every=check_every, compensated=compensated,
+              flight=flight)
     precond = (preconditioner, precond_degree)
 
     def note():
@@ -134,7 +145,9 @@ def solve_distributed(
         from ..solver.cg import _note_engine
 
         _note_engine("distributed", method, check_every,
-                     n_shards=int(mesh.devices.size))
+                     n_shards=int(mesh.devices.size),
+                     **({"flight_stride": flight.stride}
+                        if flight is not None else {}))
 
     if len(mesh.axis_names) == 2:
         # pencil decomposition: two partitioned grid axes
@@ -312,12 +325,15 @@ def _make_precond(precond, local, axis):
     return None
 
 
-def _result_specs(axis: str, record_history: bool) -> CGResult:
-    """out_specs pytree: x row-sharded, every scalar replicated."""
+def _result_specs(axis: str, record_history: bool,
+                  flight=None) -> CGResult:
+    """out_specs pytree: x row-sharded, every scalar replicated (the
+    flight buffer records psum'd scalars, so it is replicated too)."""
     return CGResult(
         x=P(axis), iterations=P(), residual_norm=P(), converged=P(),
         status=P(), indefinite=P(),
         residual_history=P() if record_history else None,
+        flight=P() if flight is not None else None,
     )
 
 
@@ -333,8 +349,9 @@ def _solve_pencil(a, b, mesh, precond, record_history, kw) -> CGResult:
     b3 = jax.device_put(jnp.asarray(b, a.dtype).reshape(nx, ny, nz),
                         jax.sharding.NamedSharding(mesh, P(ax_x, ax_y)))
 
-    out = dataclasses.replace(_result_specs(None, record_history),
-                              x=P(ax_x, ax_y))
+    out = dataclasses.replace(
+        _result_specs(None, record_history, kw.get("flight")),
+        x=P(ax_x, ax_y))
     key = ("pencil", local.local_grid, local.shards, local._dtype_name,
            (ax_x, ax_y), mesh, precond, record_history,
            tuple(sorted(kw.items())))
@@ -378,7 +395,8 @@ def _solve_stencil(a, b, mesh, axis, n_shards, precond, record_history,
 
     def build():
         @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()),
-                 out_specs=_result_specs(axis, record_history))
+                 out_specs=_result_specs(axis, record_history,
+                                          kw.get("flight")))
         def run(b_local, scale):
             _TRACE_COUNT[0] += 1
             loc = dataclasses.replace(local, scale=scale)
@@ -430,7 +448,8 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
     def build():
         @partial(shard_map, mesh=mesh,
                  in_specs=(P(axis), P(axis), P(axis), P(axis)),
-                 out_specs=_result_specs(axis, record_history))
+                 out_specs=_result_specs(axis, record_history,
+                                          kw.get("flight")))
         def run(b_local, data_s, cols_s, rows_s):
             _TRACE_COUNT[0] += 1
             strip = partial(jax.tree.map, lambda v: v[0])
@@ -472,7 +491,8 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
         # mesh axes on its outputs (see shift_ell_matvec docstring)
         @partial(shard_map, mesh=mesh, check_vma=False,
                  in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
-                 out_specs=_result_specs(axis, record_history))
+                 out_specs=_result_specs(axis, record_history,
+                                          kw.get("flight")))
         def run(b_local, vals_s, meta_s, blk_s, diag_s):
             _TRACE_COUNT[0] += 1
             strip = partial(jax.tree.map, lambda v: v[0])

@@ -18,6 +18,7 @@ tolerances is asserted in ``tests/test_resident_dist.py``).
 """
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 from typing import Optional
 
@@ -54,6 +55,8 @@ def solve_distributed_resident(
     check_every: int = 32,
     iter_cap=None,
     m=None,
+    record_history: bool = False,
+    flight=None,
     detect_races: bool = False,
 ) -> CGResult:
     """Solve ``A x = b`` with one VMEM-resident kernel launch per chip.
@@ -69,6 +72,16 @@ def solve_distributed_resident(
     (rho = r . z) per iteration.  Other solves route through
     ``solve_distributed`` / ``solve_distributed_streaming``.  Returns
     a ``CGResult`` with the global (sharded) solution.
+
+    ``record_history=True`` returns the CHECK-BLOCK-granular ``||r||``
+    trace (the in-kernel SMEM trace every shard holds bit-identically
+    for its convergence decision - ``cg_resident``'s documented
+    granularity; fetched once post-solve, the hot loop is untouched).
+    ``flight`` (a ``telemetry.flight.FlightConfig``) returns the same
+    trace adapted into ``result.flight``'s standard recorder layout
+    (alpha/beta NaN - the kernel's recurrence scalars never leave the
+    chip); its stride/capacity are ignored, the kernel's granularity
+    IS ``check_every``.
     """
     if mesh is None:
         mesh = make_mesh(n_devices)
@@ -127,8 +140,12 @@ def solve_distributed_resident(
 
     from ..solver.cg import _note_engine
 
+    # the resident kernel's recorder granularity IS check_every (block
+    # trace), whatever stride the config asked for
     _note_engine("distributed-resident", "cg", check_every,
-                 n_shards=n_shards)
+                 n_shards=n_shards,
+                 **({"flight_stride": check_every}
+                    if flight is not None else {}))
     key = ("resident_dist", local_shape, n_shards, axis, mesh, maxiter,
            check_every, interpret, detect_races, degree)
     fn = _CACHE.get(key)
@@ -137,23 +154,45 @@ def solve_distributed_resident(
             mesh, axis, n_shards, local_shape, maxiter, check_every,
             interpret, detect_races, degree))
     cap = maxiter if iter_cap is None else iter_cap
-    return fn(b, a.scale, jnp.asarray(tol, jnp.float32),
-              jnp.asarray(rtol, jnp.float32), jnp.asarray(cap, jnp.int32),
-              lmin, lmax)
+    res = fn(b, a.scale, jnp.asarray(tol, jnp.float32),
+             jnp.asarray(rtol, jnp.float32), jnp.asarray(cap, jnp.int32),
+             lmin, lmax)
+    # residual_history carries the RAW in-kernel block trace out of the
+    # shard_map (replicated ||r||^2 slots with -1 sentinels); adapt it
+    # post-solve to what the caller asked for - both adaptations are a
+    # handful of host/XLA ops on a (nblocks + 1,) array, after the one
+    # kernel launch completed
+    raw = res.residual_history
+    history = None
+    fbuf = None
+    if record_history:
+        from ..solver.resident import _expand_block_history
+
+        history = _expand_block_history(raw, maxiter, check_every,
+                                        iter_cap)
+    if flight is not None:
+        from ..telemetry.flight import buffer_from_block_history
+
+        fbuf = buffer_from_block_history(raw, check_every, cap=int(cap))
+    return dataclasses.replace(res, residual_history=history,
+                               flight=fbuf)
 
 
 def _build(mesh, axis, n_shards, local_shape, maxiter, check_every,
            interpret, detect_races=False, degree=0):
+    # residual_history slot carries the kernel's raw block trace
+    # (replicated by construction - the allreduced scalar is
+    # bit-identical on every shard); the entry adapts it post-solve
     out_specs = CGResult(
         x=P(axis), iterations=P(), residual_norm=P(), converged=P(),
-        status=P(), indefinite=P(), residual_history=None)
+        status=P(), indefinite=P(), residual_history=P())
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(axis), P(), P(), P(), P(), P(), P()),
              out_specs=out_specs, check_vma=False)
     def run(b_local, scale, tol, rtol, cap, lmin, lmax):
         b_grid = b_local.reshape(local_shape)
-        x, iters, rr, indef, conv, health = cg_resident_dist_local(
+        x, iters, rr, indef, conv, health, hist = cg_resident_dist_local(
             scale, tol, rtol, cap, b_grid, lmin, lmax,
             local_shape=local_shape,
             n_shards=n_shards, axis_name=axis, maxiter=maxiter,
@@ -169,6 +208,6 @@ def _build(mesh, axis, n_shards, local_shape, maxiter, check_every,
             x=x.reshape(-1), iterations=iters,
             residual_norm=jnp.sqrt(rr),
             converged=converged, status=status,
-            indefinite=indef > 0, residual_history=None)
+            indefinite=indef > 0, residual_history=hist)
 
     return run

@@ -59,13 +59,18 @@ def solve_distributed_streaming(
     rtol: float = 0.0,
     maxiter: int = 2000,
     check_every: int = 1,
+    flight=None,
 ) -> CGResult:
     """Solve A x = b with the fused streaming kernels over a slab mesh.
 
     ``a``: global f32 ``Stencil2D``/``Stencil3D`` whose leading grid axis
     divides the mesh and whose per-shard slab satisfies the fused-CG
-    tiling.  Other arguments as ``solver.streaming.cg_streaming``.
-    Returns a ``CGResult`` with the global (sharded) solution.
+    tiling.  Other arguments as ``solver.streaming.cg_streaming``;
+    ``flight`` carries the convergence flight recorder in the
+    shard_map'd while_loop (the recorded scalars are the psum'd global
+    values, so the buffer is replicated - this is the per-iteration
+    visibility the one-kernel engines cannot give).  Returns a
+    ``CGResult`` with the global (sharded) solution.
     """
     if mesh is None:
         mesh = make_mesh(n_devices)
@@ -99,24 +104,29 @@ def solve_distributed_streaming(
 
     from ..solver.cg import _note_engine
 
+    if flight is not None:
+        flight = flight.without_heartbeat()
     _note_engine("distributed-streaming", "cg", check_every,
-                 n_shards=n_shards)
+                 n_shards=n_shards,
+                 **({"flight_stride": flight.stride}
+                    if flight is not None else {}))
     key = ("streaming", local_grid, n_shards, axis, mesh, maxiter,
-           check_every, bm, interpret)
+           check_every, bm, interpret, flight)
     fn = _CACHE.get(key)
     if fn is None:
         fn = _CACHE[key] = jax.jit(_build(
             mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
-            interpret))
+            interpret, flight))
     return fn(b, a.scale, jnp.asarray(tol, jnp.float32),
               jnp.asarray(rtol, jnp.float32))
 
 
 def _build(mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
-           interpret):
+           interpret, flight=None):
     out_specs = CGResult(
         x=P(axis), iterations=P(), residual_norm=P(), converged=P(),
-        status=P(), indefinite=P(), residual_history=None)
+        status=P(), indefinite=P(), residual_history=None,
+        flight=P() if flight is not None else None)
 
     @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(), P(), P()),
              out_specs=out_specs, check_vma=False)
@@ -138,7 +148,7 @@ def _build(mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
             return (k < maxiter) & (rho >= thresh_sq) & (rho > 0) \
                 & jnp.isfinite(rho)
 
-        def step(s):
+        def step_ab(s):
             k, x, r, p_prev, beta_prev, rho, indef, _ = s
             r_lo, r_hi = exchange_halo(r, axis, n_shards)
             p_lo, p_hi = exchange_halo(p_prev, axis, n_shards)
@@ -160,12 +170,30 @@ def _build(mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
                 interpret=interpret)
             rr = lax.psum(rr_local, axis)
             beta = _safe_div(rr, rho)
-            return (k + 1, x, r, p, beta, rr, indef, rr)
+            return (k + 1, x, r, p, beta, rr, indef, rr), \
+                k + 1, rr, alpha, beta
 
-        state = _blocked_while(
-            cond, step, state, check_every,
-            lambda s: s[0] + check_every <= maxiter)
-        k, x, r, _, _, rho, indef, _ = state
+        def step(s):
+            return step_ab(s)[0]
+
+        def fits(s):
+            return s[0] + check_every <= maxiter
+
+        if flight is None:
+            state_f = _blocked_while(cond, step, state, check_every,
+                                     fits)
+            fbuf = None
+        else:
+            from ..solver.cg import _flight_while
+
+            # the recorded scalars are the psum'd globals, identical
+            # on every shard; no heartbeat inside shard_map (one
+            # callback per shard would multiply the stream)
+            state_f, fbuf = _flight_while(
+                cond, step_ab, state, check_every, fits, flight,
+                dtype=jnp.float32, k0=jnp.zeros((), jnp.int32),
+                rr0=rr0, heartbeat_ok=False)
+        k, x, r, _, _, rho, indef, _ = state_f
         healthy = jnp.isfinite(rho)
         converged = (rho < thresh_sq) | (rho == 0)
         status = jnp.where(
@@ -175,7 +203,7 @@ def _build(mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
         return CGResult(
             x=x.reshape(-1), iterations=k, residual_norm=jnp.sqrt(rho),
             converged=converged, status=status,
-            indefinite=indef, residual_history=None)
+            indefinite=indef, residual_history=None, flight=fbuf)
 
     return run
 

@@ -117,7 +117,7 @@ class CGCheckpoint:
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=("x", "iterations", "residual_norm", "converged", "status",
-                 "indefinite", "residual_history", "checkpoint"),
+                 "indefinite", "residual_history", "checkpoint", "flight"),
     meta_fields=(),
 )
 @dataclasses.dataclass(frozen=True)
@@ -132,6 +132,9 @@ class CGResult:
     indefinite: jax.Array       # bool: p.Ap <= 0 was observed (quirk Q1)
     residual_history: Optional[jax.Array]  # (maxiter+1,) ||r|| trace or None
     checkpoint: Optional[CGCheckpoint] = None  # set when return_checkpoint
+    #: flight-recorder ring buffer (capacity, 4) when a FlightConfig was
+    #: passed; decode with telemetry.flight.FlightRecord.from_buffer
+    flight: Optional[jax.Array] = None
 
     def status_enum(self) -> CGStatus:
         return CGStatus(int(self.status))
@@ -165,6 +168,7 @@ def cg(
     check_every: int = 1,
     method: str = "cg",
     compensated: bool = False,
+    flight=None,
 ) -> CGResult:
     """Solve A x = b by (preconditioned) conjugate gradients.
 
@@ -219,6 +223,16 @@ def cg(
         (``blas1.dot_compensated``) - the f32-storage answer to the
         reference's all-f64 arithmetic (``CUDA_R_64F``, ``CUDACG.cu:216``)
         on hardware with no native f64.
+      flight: optional ``telemetry.flight.FlightConfig`` - carry the
+        convergence flight recorder (a fixed-size, stride-decimated
+        ring of ``(iteration, ||r||^2, alpha, beta)`` rows) in the
+        loop state and return it as ``result.flight``.  ``None`` (the
+        default) leaves the solve code path - and hence the traced
+        jaxpr - UNTOUCHED.  Under ``axis_name`` the recorded scalars
+        are the already-psum'd global values, so the buffer is
+        replicated across shards.  Works with every ``method`` here
+        (cg/cg1/pipecg); ``minres`` has its own recurrence and no
+        recorder yet.
 
     The function is pure and traceable: call it under ``jit`` (or use
     ``solve()`` which jits for you).
@@ -247,6 +261,11 @@ def cg(
     if method == "minres":
         # the symmetric-INDEFINITE solver (quirk Q1: the reference's own
         # system is indefinite and CG converges on it only by luck)
+        if flight is not None:
+            raise ValueError(
+                "method='minres' does not carry the flight recorder "
+                "(its Lanczos recurrence has no CG alpha/beta; use "
+                "record_history for its per-iteration trace)")
         if preconditioned:
             raise ValueError(
                 "method='minres' supports m=None (preconditioned MINRES "
@@ -271,7 +290,8 @@ def cg(
         return impl(a, b, x0, m=m, preconditioned=preconditioned,
                     tol=tol, rtol=rtol, maxiter=maxiter, cap=cap,
                     record_history=record_history, axis_name=axis_name,
-                    check_every=check_every, compensated=compensated)
+                    check_every=check_every, compensated=compensated,
+                    flight=flight)
 
     dot = partial(blas1.dot_compensated if compensated else blas1.dot,
                   axis_name=axis_name)
@@ -327,7 +347,10 @@ def cg(
         return (s.k < maxiter) & (s.k < cap) & unconverged & nontrivial \
             & healthy
 
-    def step(s: _CGState) -> _CGState:
+    def step_ab(s: _CGState):
+        """One CG step; also returns the step's recording scalars
+        ``(k, rr, alpha, beta)`` for the flight recorder (unused - and
+        traced away - when the recorder is off)."""
         ap = a @ s.p
         p_ap = dot(s.p, ap)                       # cublasDdot :304 -> psum
         alpha = _safe_div(s.rho, p_ap)            # host arithmetic :311 -> device
@@ -351,10 +374,20 @@ def cg(
             # p.Ap = 0, which is not evidence of indefiniteness)
             indefinite=s.indefinite | ((p_ap <= 0) & (s.rr > 0)),
             history=history,
-        )
+        ), k, rr, alpha, beta
 
-    final = _blocked_while(cond, step, state, check_every,
-                           _block_fits(maxiter, cap, check_every))
+    def step(s: _CGState) -> _CGState:
+        return step_ab(s)[0]
+
+    fits = _block_fits(maxiter, cap, check_every)
+    if flight is None:
+        final = _blocked_while(cond, step, state, check_every, fits)
+        fbuf = None
+    else:
+        final, fbuf = _flight_while(
+            cond, step_ab, state, check_every, fits, flight,
+            dtype=b.dtype, k0=k0, rr0=rr0,
+            heartbeat_ok=axis_name is None)
 
     checkpoint = None
     if return_checkpoint:
@@ -363,7 +396,8 @@ def cg(
             nrm0=nrm0, k=final.k, indefinite=final.indefinite)
     healthy = jnp.isfinite(final.rr) & jnp.isfinite(final.rho) \
         & ((final.rho > 0) | (final.rr == 0))
-    return _package(final, healthy, thresh_sq, record_history, checkpoint)
+    return _package(final, healthy, thresh_sq, record_history, checkpoint,
+                    flight_buf=fbuf)
 
 
 def _blocked_while(cond, step, state, check_every: int, block_fits=None):
@@ -411,6 +445,46 @@ def _block_fits(maxiter: int, cap: jax.Array, check_every: int):
     return fits
 
 
+def _flight_while(cond, step_ab, state, check_every: int, fits, flight,
+                  *, dtype, k0, rr0, heartbeat_ok: bool = True):
+    """``_blocked_while`` with the flight-recorder ring buffer threaded
+    through the loop carry.
+
+    ``step_ab(s)`` must return ``(new_state, k, rr, alpha, beta)`` -
+    the step plus its recording scalars.  The buffer write is one
+    masked dynamic-slice update per iteration; everything else about
+    the loop (predicates, blocking, tail pass) is EXACTLY
+    ``_blocked_while``, so iterates are identical with the recorder on
+    or off.  Returns ``(final_state, final_buffer)``.
+
+    ``heartbeat_ok=False`` suppresses the optional ``jax.debug``
+    heartbeat even when ``flight.heartbeat > 0`` (shard_map bodies -
+    one callback per shard per sample would multiply the stream).
+    """
+    from ..telemetry.flight import (
+        flight_init,
+        flight_record,
+        maybe_heartbeat,
+    )
+
+    buf0 = flight_init(flight, dtype, k0, rr0)
+
+    def fcond(fs):
+        return cond(fs[0])
+
+    def fstep(fs):
+        s, buf = fs
+        s2, k, rr, alpha, beta = step_ab(s)
+        buf = flight_record(buf, flight, k, rr, alpha, beta)
+        if heartbeat_ok:
+            maybe_heartbeat(flight, k, rr)
+        return s2, buf
+
+    ffits = None if fits is None else (lambda fs: fits(fs[0]))
+    return _blocked_while(fcond, fstep, (state, buf0), check_every,
+                          ffits)
+
+
 def _threshold_sq(tol, rtol, nrm0: jax.Array, dtype) -> jax.Array:
     """Squared convergence threshold: max(tol, rtol*||r0||)^2 (quirk Q3:
     absolute by default, matching ``CUDACG.cu:333``)."""
@@ -427,7 +501,8 @@ def _history_init(record_history: bool, maxiter: int, dtype, k0, nrm0):
 
 
 def _package(final, healthy: jax.Array, thresh_sq: jax.Array,
-             record_history: bool, checkpoint) -> CGResult:
+             record_history: bool, checkpoint,
+             flight_buf=None) -> CGResult:
     """Shared epilogue: convergence/breakdown status + CGResult assembly
     (everything the reference never reported, quirks Q4/Q7)."""
     nrm = jnp.sqrt(final.rr)
@@ -447,6 +522,7 @@ def _package(final, healthy: jax.Array, thresh_sq: jax.Array,
         indefinite=final.indefinite,
         residual_history=final.history if record_history else None,
         checkpoint=checkpoint,
+        flight=flight_buf,
     )
 
 
@@ -516,7 +592,8 @@ class _CG1State(NamedTuple):
 
 
 def _cg1(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
-         record_history, axis_name, check_every, compensated) -> CGResult:
+         record_history, axis_name, check_every, compensated,
+         flight=None) -> CGResult:
     """Chronopoulos-Gear single-reduction CG.
 
     Algebraically the textbook recurrence (same alpha_k / beta_k in exact
@@ -554,7 +631,12 @@ def _cg1(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
 
     cond = _variant_cond(maxiter, cap, thresh_sq)
 
-    def step(st: _CG1State) -> _CG1State:
+    def step_ab(st: _CG1State):
+        # recording scalars: st.alpha is THIS step's step length (the
+        # Chronopoulos-Gear carry holds alpha one step ahead), beta is
+        # this step's rho_k/rho_{k-1} - the same (alpha_k, beta_k)
+        # pairing as the textbook recurrence, so the CG-Lanczos
+        # reconstruction in telemetry.health applies unchanged
         x = blas1.axpy(st.alpha, st.p, st.x)
         r = blas1.axpy(-st.alpha, st.s, st.r)
         u = m @ r if preconditioned else r
@@ -579,14 +661,25 @@ def _cg1(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
             # rr > 0 excludes frozen post-exact-solve steps (see _CGState)
             indefinite=st.indefinite | ((denom <= 0) & (rr > 0)),
             history=history,
-        )
+        ), k, rr, st.alpha, beta
 
-    final = _blocked_while(cond, step, state, check_every,
-                           _block_fits(maxiter, cap, check_every))
+    def step(st: _CG1State) -> _CG1State:
+        return step_ab(st)[0]
+
+    fits = _block_fits(maxiter, cap, check_every)
+    if flight is None:
+        final = _blocked_while(cond, step, state, check_every, fits)
+        fbuf = None
+    else:
+        final, fbuf = _flight_while(
+            cond, step_ab, state, check_every, fits, flight,
+            dtype=b.dtype, k0=k0, rr0=rr0,
+            heartbeat_ok=axis_name is None)
 
     healthy = jnp.isfinite(final.rr) & jnp.isfinite(final.gamma) \
         & jnp.isfinite(final.alpha) & ((final.gamma > 0) | (final.rr == 0))
-    return _package(final, healthy, thresh_sq, record_history, None)
+    return _package(final, healthy, thresh_sq, record_history, None,
+                    flight_buf=fbuf)
 
 
 def _replace_cadence(dtype) -> int:
@@ -622,7 +715,8 @@ class _PipeCGState(NamedTuple):
 
 
 def _pipecg(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
-            record_history, axis_name, check_every, compensated) -> CGResult:
+            record_history, axis_name, check_every, compensated,
+            flight=None) -> CGResult:
     """Ghysels-Vanroose pipelined CG (same iterates as ``"cg"`` in exact
     arithmetic; tests check trajectory parity).
 
@@ -685,7 +779,9 @@ def _pipecg(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
         z = a @ q
         return r, u, w, s, q, z
 
-    def step(st: _PipeCGState) -> _PipeCGState:
+    def step_ab(st: _PipeCGState):
+        # recording scalars mirror _cg1: st.alpha is this step's step
+        # length, beta this step's gamma ratio
         x = blas1.axpy(st.alpha, st.p, st.x)
         r = blas1.axpy(-st.alpha, st.s, st.r)
         u = blas1.axpy(-st.alpha, st.q, st.u)
@@ -724,14 +820,25 @@ def _pipecg(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
             gamma=gamma, rr=rr, alpha=alpha,
             indefinite=st.indefinite | ((denom <= 0) & (rr > 0)),
             history=history,
-        )
+        ), k, rr, st.alpha, beta
 
-    final = _blocked_while(cond, step, state, check_every,
-                           _block_fits(maxiter, cap, check_every))
+    def step(st: _PipeCGState) -> _PipeCGState:
+        return step_ab(st)[0]
+
+    fits = _block_fits(maxiter, cap, check_every)
+    if flight is None:
+        final = _blocked_while(cond, step, state, check_every, fits)
+        fbuf = None
+    else:
+        final, fbuf = _flight_while(
+            cond, step_ab, state, check_every, fits, flight,
+            dtype=b.dtype, k0=k0, rr0=rr0,
+            heartbeat_ok=axis_name is None)
 
     healthy = jnp.isfinite(final.rr) & jnp.isfinite(final.gamma) \
         & jnp.isfinite(final.alpha) & ((final.gamma > 0) | (final.rr == 0))
-    return _package(final, healthy, thresh_sq, record_history, None)
+    return _package(final, healthy, thresh_sq, record_history, None,
+                    flight_buf=fbuf)
 
 
 def _as_operator(a) -> LinearOperator:
@@ -750,15 +857,15 @@ def _as_operator(a) -> LinearOperator:
 
 @partial(jax.jit, static_argnames=("maxiter", "record_history", "axis_name",
                                    "return_checkpoint", "check_every",
-                                   "method", "compensated"))
+                                   "method", "compensated", "flight"))
 def _solve_jit(a, b, x0, tol, rtol, maxiter, m, record_history, axis_name,
                resume_from, return_checkpoint, iter_cap, check_every,
-               method, compensated):
+               method, compensated, flight):
     return cg(a, b, x0, tol=tol, rtol=rtol, maxiter=maxiter, m=m,
               record_history=record_history, axis_name=axis_name,
               resume_from=resume_from, return_checkpoint=return_checkpoint,
               iter_cap=iter_cap, check_every=check_every, method=method,
-              compensated=compensated)
+              compensated=compensated, flight=flight)
 
 
 def solve(
@@ -778,12 +885,22 @@ def solve(
     method: str = "cg",
     compensated: bool = False,
     engine: str = "general",
+    flight=None,
 ) -> CGResult:
     """Jitted single-call entry point: compile once per (operator-structure,
     shape, maxiter) and reuse - the whole solve is one XLA executable.
 
     ``tol``/``rtol``/``iter_cap`` are passed as device scalars so sweeping
     them does not recompile.
+
+    ``flight``: optional ``telemetry.flight.FlightConfig`` (see ``cg``).
+    Carried by the general and streaming engines; the VMEM-resident
+    engine records at check-block granularity only (its in-kernel SMEM
+    trace), so ``engine="auto"`` skips the resident path when a
+    recorder is requested - same never-silently-change-granularity rule
+    as ``record_history`` - and an explicit ``engine="resident"`` with
+    ``flight`` raises (use ``cg_resident(record_history=True)`` +
+    ``FlightRecord.from_history`` for the block-granular record).
 
     ``engine``: ``"general"`` (default - the ``lax.while_loop`` solver,
     every operator/feature), ``"resident"`` (the single-pallas-kernel
@@ -812,6 +929,7 @@ def solve(
         # meaning.
         eligible = ((engine == "resident"
                      or jax.default_backend() == "tpu")
+                    and flight is None
                     and resident_eligible(
                         a, b, m, method=method,
                         record_history=(record_history
@@ -820,6 +938,18 @@ def solve(
                         resume_from=resume_from,
                         return_checkpoint=return_checkpoint,
                         compensated=compensated))
+        if engine == "resident" and flight is not None:
+            _note_rejected("resident", "flight recorder requested "
+                           "(per-iteration; the kernel trace is "
+                           "check-block granular)")
+            raise ValueError(
+                "engine='resident' does not carry the per-iteration "
+                "flight recorder (the one-kernel solve keeps its "
+                "scalars in SMEM); use cg_resident(record_history="
+                "True) + telemetry.flight.FlightRecord.from_history "
+                "for the check-block-granular record, or "
+                "engine='general'/'streaming' for a stride-decimated "
+                "per-iteration one")
         if engine == "resident" and not eligible:
             _note_rejected("resident", "explicit engine='resident' "
                            "failed the eligibility gate")
@@ -868,6 +998,7 @@ def solve(
                                 maxiter=maxiter, check_every=check_every,
                                 iter_cap=iter_cap, m=m,
                                 record_history=record_history,
+                                flight=flight,
                                 interpret=_pallas_interpret())
         if engine == "auto":
             _note_rejected("streaming", "auto: streaming_eligible "
@@ -878,7 +1009,9 @@ def solve(
     tol_a = jnp.asarray(tol, b.dtype)
     rtol_a = jnp.asarray(rtol, b.dtype)
     cap_a = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
-    _note_engine("general", method, check_every)
+    _note_engine("general", method, check_every,
+                 **({"flight_stride": flight.stride}
+                    if flight is not None else {}))
     return _solve_jit(a, b, x0, tol_a, rtol_a, maxiter, m, record_history,
                       None, resume_from, return_checkpoint, cap_a,
-                      check_every, method, compensated)
+                      check_every, method, compensated, flight)

@@ -86,7 +86,7 @@ class DF64Checkpoint:
     jax.tree_util.register_dataclass,
     data_fields=("x_hi", "x_lo", "iterations", "residual_norm_sq_hi",
                  "residual_norm_sq_lo", "converged", "status", "indefinite",
-                 "residual_history", "checkpoint"),
+                 "residual_history", "checkpoint", "flight"),
     meta_fields=(),
 )
 @dataclasses.dataclass(frozen=True)
@@ -109,6 +109,10 @@ class DF64CGResult:
     # past the final iterate - same semantics as CGResult (hi word only;
     # the trace is diagnostic, full df64 depth lives in the scalars)
     checkpoint: Optional[DF64Checkpoint] = None  # set when return_checkpoint
+    #: flight-recorder ring buffer (capacity, 4) f32 when a FlightConfig
+    #: was passed (hi words of rr/alpha/beta - diagnostic precision,
+    #: like residual_history); decode with FlightRecord.from_buffer
+    flight: Optional[jax.Array] = None
 
     def x(self) -> np.ndarray:
         return df.to_f64(self.x_hi, self.x_lo)
@@ -262,6 +266,7 @@ def cg_df64(
     method: str = "cg",
     iter_cap: Optional[int] = None,
     precond_degree: int = 4,
+    flight=None,
 ) -> DF64CGResult:
     """CG with df64 storage (see module docstring).
 
@@ -297,6 +302,12 @@ def cg_df64(
     ``iter_cap``: TRACED early-stop bound (<= ``maxiter``); segment
     sweeps (``solve_resumable_df64``) vary it without recompiling -
     ``maxiter`` alone is static and would retrace per segment.
+    ``flight``: optional ``telemetry.flight.FlightConfig`` - carry the
+    convergence flight recorder in the loop state (``solver.cg``
+    semantics; rows hold the HI words of ``||r||^2``/alpha/beta, f32
+    diagnostic precision like ``residual_history``).  ``method="cg"``
+    only - the fused-reduction variants keep their recorder on the
+    ``solver.cg`` side of the trade for now.
     """
     if preconditioner not in (None, "jacobi", "chebyshev", "mg"):
         raise ValueError(
@@ -305,6 +316,11 @@ def cg_df64(
     if method not in ("cg", "cg1", "pipecg", "minres"):
         raise ValueError(f"unknown method {method!r}; expected 'cg', "
                          f"'cg1', 'pipecg' or 'minres'")
+    if flight is not None and method != "cg":
+        raise ValueError(
+            f"cg_df64 carries the flight recorder on method='cg' only "
+            f"(got method={method!r}); use record_history for the "
+            f"variants' dense trace")
     if method == "minres":
         # the symmetric-indefinite solver at f64-class precision
         # (solver.minres.minres_df64; quirk Q1 x CUDA_R_64F)
@@ -376,12 +392,14 @@ def cg_df64(
                           maxiter=maxiter, record_history=record_history,
                           jacobi=jacobi, axis_name=None,
                           return_checkpoint=return_checkpoint,
-                          check_every=check_every, chebyshev_degree=cheb)
+                          check_every=check_every, chebyshev_degree=cheb,
+                          flight=flight)
     return _solve(op, b_df, tol2, rtol2, resume_from, cap, interval, mg,
                   maxiter=maxiter,
                   record_history=record_history, jacobi=jacobi,
                   axis_name=axis_name, return_checkpoint=return_checkpoint,
-                  check_every=check_every, chebyshev_degree=cheb)
+                  check_every=check_every, chebyshev_degree=cheb,
+                  flight=flight)
 
 
 def chebyshev_interval(a, *, ratio: float = 30.0,
@@ -477,7 +495,8 @@ def _safe_div(num: df.DF, den: df.DF) -> df.DF:
 def _solve(op, b_df, tol2, rtol2, resume, cap=None, cheb_interval=None,
            mg=None,
            *, maxiter, record_history, jacobi, axis_name,
-           return_checkpoint=False, check_every=1, chebyshev_degree=None):
+           return_checkpoint=False, check_every=1, chebyshev_degree=None,
+           flight=None):
     n = b_df[0].shape[0]
     if cap is None:
         cap = jnp.asarray(maxiter, jnp.int32)
@@ -546,7 +565,7 @@ def _solve(op, b_df, tol2, rtol2, resume, cap=None, cheb_interval=None,
         return (s.k < maxiter) & (s.k < cap) & s.finite & unconverged \
             & nontrivial
 
-    def body(s: _State):
+    def body_ab(s: _State):
         ap = mv(s.p)
         pap = df.dot(s.p, ap, axis_name=axis_name)
         alpha = _safe_div(s.rho, pap)
@@ -574,15 +593,33 @@ def _solve(op, b_df, tol2, rtol2, resume, cap=None, cheb_interval=None,
             indefinite=jnp.logical_or(
                 s.indefinite,
                 jnp.logical_and(pap[0] <= 0.0, s.rr[0] > 0.0)),
-            finite=finite, history=history)
+            finite=finite, history=history), \
+            k, rr_new[0], alpha[0], beta[0]
+
+    def body(s: _State):
+        return body_ab(s)[0]
+
+    def fits(t):
+        return (t.k + check_every <= maxiter) \
+            & (t.k + check_every <= cap)
 
     s0 = _State(k=k0, x=x0, r=r0, p=p0, rho=rho0,
                 rr=rr0, indefinite=indef0,
                 finite=jnp.isfinite(rho0[0]),
                 history=history0)
-    s = _blocked_while(cond, body, s0, check_every,
-                       lambda t: (t.k + check_every <= maxiter)
-                       & (t.k + check_every <= cap))
+    if flight is None:
+        s = _blocked_while(cond, body, s0, check_every, fits)
+        fbuf = None
+    else:
+        from .cg import _flight_while
+
+        # rows carry the HI words (f32 diagnostic precision, like the
+        # residual_history trace); under axis_name the dots are already
+        # globally reduced, so the buffer is replicated across shards
+        s, fbuf = _flight_while(
+            cond, body_ab, s0, check_every, fits, flight,
+            dtype=jnp.float32, k0=k0, rr0=rr0[0],
+            heartbeat_ok=axis_name is None)
     converged = jnp.logical_or(df.less(s.rr, thr), s.rr[0] == 0.0)
     status = jnp.where(
         jnp.logical_not(s.finite), CGStatus.BREAKDOWN.value,
@@ -600,14 +637,15 @@ def _solve(op, b_df, tol2, rtol2, resume, cap=None, cheb_interval=None,
         residual_norm_sq_hi=s.rr[0], residual_norm_sq_lo=s.rr[1],
         converged=converged, status=status, indefinite=s.indefinite,
         residual_history=s.history if record_history else None,
-        checkpoint=checkpoint)
+        checkpoint=checkpoint, flight=fbuf)
 
 
 _solve_jit = jax.jit(_solve, static_argnames=("maxiter", "record_history",
                                               "jacobi", "axis_name",
                                               "return_checkpoint",
                                               "check_every",
-                                              "chebyshev_degree"))
+                                              "chebyshev_degree",
+                                              "flight"))
 
 
 # -- single-reduction / pipelined variants ------------------------------------

@@ -13,15 +13,32 @@ import enum
 
 
 class CGStatus(enum.IntEnum):
-    """Outcome of a CG solve (device-scalar friendly int codes)."""
+    """Outcome of a CG solve (device-scalar friendly int codes).
+
+    Codes 0-2 are produced ON DEVICE by the solvers.  Codes 3-4 are
+    HOST-SIDE refinements of MAXITER produced by the flight-recorder
+    health diagnostics (``telemetry.health.classify_trace``): the
+    solver cannot distinguish "budget too small" from "stalled" or
+    "moving away" without the recorded trace, and the refinement must
+    never perturb the compiled loop - so it lives off-device.
+    """
 
     CONVERGED = 0     # ||r|| dropped below the tolerance
     MAXITER = 1       # iteration budget exhausted (reference: silent "Success")
     BREAKDOWN = 2     # non-finite recurrence scalar (e.g. p.Ap == 0 division)
+    STAGNATED = 3     # trace verdict: residual decay flatlined above tol
+    DIVERGED = 4      # trace verdict: residual grew away from its minimum
 
     def describe(self) -> str:
         return {
             CGStatus.CONVERGED: "converged",
             CGStatus.MAXITER: "maximum iterations reached without convergence",
             CGStatus.BREAKDOWN: "numerical breakdown (non-finite scalar)",
+            CGStatus.STAGNATED: (
+                "stagnated: residual decay flatlined above the "
+                "tolerance (attainable-accuracy floor or lost "
+                "orthogonality; see the solve_health event)"),
+            CGStatus.DIVERGED: (
+                "diverged: residual grew away from its recorded "
+                "minimum (indefinite operator or preconditioner)"),
         }[self]

@@ -96,10 +96,10 @@ def streaming_eligible(a, b=None, m=None, *, method: str = "cg",
 
 @functools.partial(jax.jit, static_argnames=(
     "shape", "maxiter", "check_every", "bm", "record_history",
-    "interpret", "degree"))
+    "interpret", "degree", "flight"))
 def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, lmin, lmax,
                        *, shape, maxiter, check_every, bm, record_history,
-                       interpret, degree):
+                       interpret, degree, flight=None):
     ndim = len(shape)
     preconditioned = degree > 0
 
@@ -186,7 +186,7 @@ def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, lmin, lmax,
         return (k < maxiter) & (k < cap) & unconverged & nontrivial \
             & healthy
 
-    def step(s):
+    def step_ab(s):
         if degree >= 2:
             k, x, r, z, p_prev, beta_prev, rho, rr, indef, hist = s
             v = z
@@ -214,13 +214,27 @@ def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, lmin, lmax,
         if record_history:
             hist = hist.at[k].set(jnp.sqrt(rr))
         if degree >= 2:
-            return (k, x, r, z, p, beta, rho_new, rr, indef, hist)
-        return (k, x, r, p, beta, rho_new, rr, indef, hist)
+            out = (k, x, r, z, p, beta, rho_new, rr, indef, hist)
+        else:
+            out = (k, x, r, p, beta, rho_new, rr, indef, hist)
+        return out, k, rr, alpha, beta
 
-    state = _blocked_while(
-        cond, step, state, check_every,
-        lambda s: (s[0] + check_every <= maxiter)
-        & (s[0] + check_every <= cap))
+    def step(s):
+        return step_ab(s)[0]
+
+    def fits(s):
+        return (s[0] + check_every <= maxiter) \
+            & (s[0] + check_every <= cap)
+
+    if flight is None:
+        state = _blocked_while(cond, step, state, check_every, fits)
+        fbuf = None
+    else:
+        from .cg import _flight_while
+
+        state, fbuf = _flight_while(
+            cond, step_ab, state, check_every, fits, flight,
+            dtype=jnp.float32, k0=jnp.zeros((), jnp.int32), rr0=rr0)
     k, x = state[0], state[1]
     rho, rr, indef, hist = (state[5 + nz], state[6 + nz], state[7 + nz],
                             state[8 + nz])
@@ -232,7 +246,7 @@ def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, lmin, lmax,
         jnp.where(~healthy, jnp.int32(CGStatus.BREAKDOWN),
                   jnp.int32(CGStatus.MAXITER)))
     return (x, k, jnp.sqrt(rr), converged, status, indef,
-            hist if record_history else None)
+            hist if record_history else None, fbuf)
 
 
 def cg_streaming(
@@ -247,6 +261,7 @@ def cg_streaming(
     iter_cap=None,
     m=None,
     record_history: bool = False,
+    flight=None,
     interpret: bool = False,
 ) -> CGResult:
     """Solve ``A x = b`` with the fused-iteration HBM-streaming engine.
@@ -280,6 +295,12 @@ def cg_streaming(
     scalars live in the while_loop carry), but ``check_every=32`` still
     drops the per-trip predicate serialization - use it for throughput
     runs, as ``bench.py`` does.
+
+    ``flight``: optional ``telemetry.flight.FlightConfig`` - carry the
+    per-iteration convergence flight recorder in the while_loop state
+    (``solver.cg`` semantics: ``None`` leaves the traced solve
+    bit-identical; the scalars recorded are the slab-accumulated
+    global values the loop already holds).
     """
     if not isinstance(a, (Stencil2D, Stencil3D)):
         raise TypeError(
@@ -345,22 +366,25 @@ def cg_streaming(
         lmax = jnp.asarray(m.lmax, jnp.float32)
     from .cg import _note_engine
 
-    _note_engine("streaming", "cg", check_every)
+    _note_engine("streaming", "cg", check_every,
+                 **({"flight_stride": flight.stride}
+                    if flight is not None else {}))
     bm = pick_block_streaming(grid)
     cap = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
-    x, k, nrm, converged, status, indef, hist = _cg_streaming_call(
+    x, k, nrm, converged, status, indef, hist, fbuf = _cg_streaming_call(
         a.scale, b_grid, x0, jnp.asarray(tol, jnp.float32),
         jnp.asarray(rtol, jnp.float32), cap, lmin, lmax, shape=grid,
         maxiter=maxiter,
         check_every=min(check_every, max(maxiter, 1)), bm=bm,
         record_history=record_history, interpret=interpret,
-        degree=degree)
+        degree=degree, flight=flight)
     return CGResult(
         x=x.reshape(-1) if flat_in else x,
         iterations=k, residual_norm=nrm,
         converged=converged.astype(bool), status=status,
         indefinite=indef.astype(bool),
-        residual_history=hist)
+        residual_history=hist,
+        flight=fbuf)
 
 
 # -- df64 (double-float) streaming solver --------------------------------------

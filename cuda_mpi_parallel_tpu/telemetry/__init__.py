@@ -20,7 +20,15 @@ that gap with four composable parts:
   clean by construction);
 * :mod:`.session` - ``observe_solve(...)``, a context manager that
   composes ``utils.timing.Timer`` phase sections with ``jax.profiler``
-  traces and the event stream.
+  traces and the event stream;
+* :mod:`.flight` - the convergence flight recorder: a fixed-size,
+  stride-decimated device-side ring buffer of ``(iteration, ||r||^2,
+  alpha, beta)`` carried in the solvers' ``lax.while_loop`` state and
+  fetched once post-solve (zero host round-trips in the hot loop);
+* :mod:`.health` - solve-health diagnostics over the flight record:
+  CG-Lanczos Ritz/condition estimates and stagnation / plateau /
+  divergence classification, emitted as ``solve_health`` events and
+  decay-rate / kappa gauges.
 
 Everything is opt-in: with no event sink configured and metrics
 untouched, every instrumentation hook in the solver/parallel layers is
@@ -29,8 +37,10 @@ either way (asserted by tests/test_cost_accounting.py).
 """
 from __future__ import annotations
 
-from . import cost, events, registry, session
+from . import cost, events, flight, health, registry, session
 from .events import EventStream, configure, emit, validate_event
+from .flight import FlightConfig, FlightRecord
+from .health import SolveHealth, assess_solve_health
 from .registry import REGISTRY, MetricsRegistry
 from .session import observe_solve
 
@@ -58,13 +68,19 @@ def active() -> bool:
 
 __all__ = [
     "EventStream",
+    "FlightConfig",
+    "FlightRecord",
     "MetricsRegistry",
     "REGISTRY",
+    "SolveHealth",
     "active",
+    "assess_solve_health",
     "configure",
     "cost",
     "emit",
     "events",
+    "flight",
+    "health",
     "observe_solve",
     "registry",
     "session",

@@ -37,6 +37,7 @@ import contextvars
 import io
 import itertools
 import json
+import sys
 import threading
 import time
 from typing import Any, Dict, IO, Iterator, Optional, Union
@@ -47,6 +48,7 @@ __all__ = [
     "EVENT_SCHEMA",
     "EventStream",
     "active",
+    "ambient_scope",
     "configure",
     "current_solve_id",
     "emit",
@@ -74,6 +76,12 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     # jaxpr-derived communication cost of the compiled solve body
     "comm_cost": ("psum_per_iteration", "ppermute_per_iteration",
                   "comm_bytes_per_iteration"),
+    # sampled in-flight heartbeat (FlightConfig.heartbeat > 0 only;
+    # posted from the hot loop via an unordered jax.debug.callback)
+    "flight_heartbeat": ("iteration",),
+    # flight-recorder health verdict (telemetry.health): trace
+    # classification + decay rates + Ritz condition estimate
+    "solve_health": ("classification", "converged", "iterations"),
     # the solve finished (converged or not) and was synced
     "solve_end": ("status", "iterations", "residual_norm"),
 }
@@ -84,6 +92,50 @@ _SOLVE_ID: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
 _SCOPE_FIELDS: contextvars.ContextVar[Dict[str, Any]] = \
     contextvars.ContextVar("cuda_mpi_parallel_tpu_event_fields",
                            default={})
+
+#: Thread-visible mirror of the contextvar scope.  jax delivers
+#: ``debug.callback``s (the flight recorder's heartbeat) on its own
+#: runtime thread where the contextvars above are empty; emitting there
+#: would lose the solve_id/phase correlation.  Closing over the scope at
+#: trace time is no better - jit caching would bake the FIRST solve's id
+#: into the compiled callback.  So the scope managers keep this plain
+#: snapshot current at dispatch time and callbacks read it via
+#: ``ambient_scope()``.  Single in-flight solve per process assumed
+#: (true of the CLI/bench/tests; concurrent solves would interleave).
+_AMBIENT: Dict[str, Any] = {}
+
+
+def _sync_ambient() -> None:
+    snap: Dict[str, Any] = {}
+    sid = _SOLVE_ID.get()
+    if sid is not None:
+        snap["solve_id"] = sid
+    snap.update(_SCOPE_FIELDS.get())
+    global _AMBIENT
+    _AMBIENT = snap
+
+
+def ambient_scope() -> Dict[str, Any]:
+    """The current solve scope (solve_id + ``scoped`` fields) as seen
+    from ANY thread - what host-side callbacks pass to ``emit`` so
+    their events stay correlated with the solve that is in flight."""
+    return dict(_AMBIENT)
+
+
+def _drain_callbacks() -> None:
+    """Flush jax's pending host callbacks (the flight recorder's
+    heartbeat) before a scope is torn down: delivery is asynchronous,
+    so without this barrier a solve's trailing heartbeats could be
+    stamped with the NEXT scope's fields (or none).  Runs at scope
+    exit - post-solve, outside any hot loop - and is a no-op when jax
+    was never imported or has nothing in flight."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return
+    try:
+        jax_mod.effects_barrier()
+    except Exception:
+        pass  # ancient jax without effects_barrier: best-effort only
 
 
 @contextlib.contextmanager
@@ -99,10 +151,13 @@ def scoped(**fields: Any) -> Iterator[None]:
     merged = dict(_SCOPE_FIELDS.get())
     merged.update(fields)
     token = _SCOPE_FIELDS.set(merged)
+    _sync_ambient()
     try:
         yield
     finally:
+        _drain_callbacks()
         _SCOPE_FIELDS.reset(token)
+        _sync_ambient()
 
 
 def scope_phase() -> str:
@@ -127,10 +182,13 @@ def solve_scope(solve_id: Optional[str] = None) -> Iterator[str]:
     """Bind a solve id so every ``emit`` inside the block carries it."""
     sid = solve_id if solve_id is not None else new_solve_id()
     token = _SOLVE_ID.set(sid)
+    _sync_ambient()
     try:
         yield sid
     finally:
+        _drain_callbacks()
         _SOLVE_ID.reset(token)
+        _sync_ambient()
 
 
 class EventStream:

@@ -1,0 +1,339 @@
+"""Convergence flight recorder: in-loop telemetry with zero host syncs.
+
+The reference checks convergence every iteration but reports nothing
+(``CUDACG.cu:333,365`` - "Success" unconditionally, SURVEY Q4/Q7).
+The general solver's ``record_history`` closes that gap for dense
+per-iteration traces, but it allocates ``maxiter + 1`` slots and only
+records ``||r||`` - and the distributed one-kernel engines have no
+history at all, so exactly the pod-scale solves the ROADMAP cares
+about were flying blind.
+
+The flight recorder is the fixed-cost answer: a **fixed-size,
+stride-decimated ring buffer** of ``(iteration, ||r||^2, alpha, beta)``
+rows carried in the ``lax.while_loop`` state of every recorder-capable
+engine.  Properties the design guarantees:
+
+* **Zero host round-trips.**  Rows are written with on-device masked
+  ring updates; the buffer is fetched ONCE post-solve, by a consumer
+  that already synced (the CLI / ``FlightRecord.from_buffer``).  The
+  hot loop never sees a callback, transfer, or sync (graftlint GL105
+  clean by construction).
+* **Bit-identical when off.**  With ``flight=None`` the solver code
+  path is UNTOUCHED - the buffer never enters the loop state, so the
+  traced jaxpr is bit-identical to a build without the recorder
+  (extends the telemetry-off proof in tests/test_cost_accounting.py).
+* **Bounded cost when on.**  One ``(capacity, 4)`` array in the carry
+  and one masked row write per iteration, independent of ``maxiter``
+  and stride; distributed solves record the already-psum'd scalars,
+  so the rows are replicated and no extra collective is issued.
+
+On top of the record, :mod:`.health` reconstructs the CG-Lanczos
+tridiagonal from the alpha/beta columns to estimate the extreme Ritz
+values and condition number, and classifies stagnation / plateau /
+divergence - see ``health.assess_solve_health``.
+
+The VMEM-resident engines (single kernel per chip) cannot carry an XLA
+ring buffer, but their kernels already maintain a check-block-granular
+``||r||^2`` trace in SMEM for the convergence decision; that trace is
+also fetched exactly once post-solve and adapts into the same
+``FlightRecord`` surface via :func:`buffer_from_block_history`
+(alpha/beta columns NaN - the kernel's scalars never leave the chip).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "COLUMNS",
+    "FlightConfig",
+    "FlightRecord",
+    "buffer_from_block_history",
+    "flight_init",
+    "flight_record",
+    "maybe_heartbeat",
+]
+
+#: Column layout of one recorder row.
+COLUMNS = ("iteration", "residual_sq", "alpha", "beta")
+
+#: Default ring capacity: 1024 rows x 4 f32 = 16 KiB of loop state.
+DEFAULT_CAPACITY = 1024
+
+#: Hard cap on ``FlightConfig.for_solve``-derived capacities: 4096 rows
+#: keep the carried buffer at 64 KiB and the host-side spectral window
+#: (health.py) cheap.
+CAPACITY_LIMIT = 4096
+
+
+@dataclasses.dataclass(frozen=True)
+class FlightConfig:
+    """Static recorder configuration (hashable - rides jit static args
+    and compiled-solver cache keys).
+
+    ``capacity``: ring rows; once ``capacity * stride`` iterations have
+    run, the oldest rows are overwritten (the record keeps the LAST
+    ``capacity`` sampled iterations).
+    ``stride``: decimation - record every ``stride``-th iteration.
+    ``heartbeat``: iterations between sampled host heartbeats
+    (``jax.debug.callback`` -> a ``flight_heartbeat`` event); 0 (the
+    default) compiles the hot loop with NO callback at all.
+    """
+
+    capacity: int = DEFAULT_CAPACITY
+    stride: int = 1
+    heartbeat: int = 0
+
+    def __post_init__(self):
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {self.capacity}")
+        if self.stride < 1:
+            raise ValueError(f"stride must be >= 1, got {self.stride}")
+        if self.heartbeat < 0:
+            raise ValueError(
+                f"heartbeat must be >= 0 (0 = off), got {self.heartbeat}")
+
+    @classmethod
+    def for_solve(cls, maxiter: int, stride: int = 1, heartbeat: int = 0,
+                  limit: int = CAPACITY_LIMIT) -> "FlightConfig":
+        """Capacity sized so a ``maxiter``-iteration solve at ``stride``
+        never wraps (bounded by ``limit``): lossless up to
+        ``limit * stride`` iterations, last-window beyond."""
+        capacity = max(1, min(maxiter // max(stride, 1) + 1, limit))
+        return cls(capacity=capacity, stride=stride, heartbeat=heartbeat)
+
+    def without_heartbeat(self) -> "FlightConfig":
+        """This config with the heartbeat stripped.  shard_map'd loops
+        suppress the heartbeat (one callback per shard per sample would
+        multiply the stream); distributed entry points normalize through
+        this so their compiled-solver caches never fork on a field that
+        cannot affect the executable."""
+        if not self.heartbeat:
+            return self
+        return dataclasses.replace(self, heartbeat=0)
+
+
+def flight_init(cfg: FlightConfig, dtype, k0, rr0):
+    """Fresh device ring buffer with the solve's initial state recorded
+    (iteration ``k0``, residual ``rr0``, alpha/beta NaN - no step has
+    run yet).  Unwritten rows are NaN."""
+    import jax.numpy as jnp
+
+    buf = jnp.full((cfg.capacity, len(COLUMNS)), jnp.nan, dtype)
+    nan = jnp.asarray(jnp.nan, dtype)
+    return flight_record(buf, cfg, k0, rr0, nan, nan)
+
+
+def flight_record(buf, cfg: FlightConfig, k, rr, alpha, beta):
+    """One masked ring write: when ``k % stride == 0``, row
+    ``(k // stride) % capacity`` becomes ``(k, rr, alpha, beta)``;
+    otherwise the buffer passes through unchanged.  Pure device ops
+    (dynamic slice read + write of one 4-wide row) - no sync, no
+    callback, loop-carry friendly."""
+    import jax.numpy as jnp
+
+    dtype = buf.dtype
+    k = jnp.asarray(k)
+    write = (k % cfg.stride) == 0
+    slot = (k // cfg.stride) % cfg.capacity
+    row = jnp.stack([
+        k.astype(dtype),
+        jnp.asarray(rr).astype(dtype),
+        jnp.asarray(alpha).astype(dtype),
+        jnp.asarray(beta).astype(dtype),
+    ])
+    return buf.at[slot].set(jnp.where(write, row, buf[slot]))
+
+
+def _heartbeat_host(k, rr) -> None:
+    """Host side of the sampled heartbeat (runs under
+    ``jax.debug.callback``; values arrive as tiny host arrays - reading
+    them here is NOT a device sync inside the loop, the runtime
+    delivers them asynchronously).  This executes on jax's callback
+    thread, where the event module's contextvars are empty - the
+    solve_id/phase correlation comes from ``events.ambient_scope()``
+    (the dispatch-time snapshot) instead."""
+    from . import events
+    from .registry import REGISTRY
+
+    iteration = int(np.asarray(k))
+    residual_sq = float(np.asarray(rr))
+    REGISTRY.gauge(
+        "solve_heartbeat_iteration",
+        "most recent in-flight heartbeat iteration (sampled; only "
+        "emitted when FlightConfig.heartbeat > 0)").set(iteration)
+    if events.active():
+        events.emit("flight_heartbeat", iteration=iteration,
+                    residual_sq=residual_sq, **events.ambient_scope())
+
+
+def maybe_heartbeat(cfg: FlightConfig, k, rr) -> None:
+    """Sampled in-flight heartbeat for long solves.
+
+    STATIC no-op when ``cfg.heartbeat == 0`` (the default): the traced
+    loop body contains no callback at all, so the compiled solve is
+    untouched.  When enabled, every ``heartbeat``-th iteration posts
+    ``(k, ||r||^2)`` to the host via ``jax.debug.callback`` (unordered,
+    loop-safe - the device never blocks on delivery) and emits a
+    ``flight_heartbeat`` event when a sink is configured.
+    """
+    if not cfg.heartbeat:
+        return
+    import jax
+    from jax import lax
+
+    lax.cond(
+        (k % cfg.heartbeat) == 0,
+        lambda: jax.debug.callback(_heartbeat_host, k, rr),
+        lambda: None)
+
+
+def buffer_from_block_history(block_rr, check_every: int,
+                              cap: Optional[int] = None) -> np.ndarray:
+    """Adapt a resident kernel's block trace to the recorder layout.
+
+    ``block_rr``: the ``(nblocks + 1,)`` ``||r||^2`` trace the resident
+    kernels keep in SMEM (slot 0 = initial, slot j = after block j,
+    ``-1.0`` sentinel for never-run blocks).  Returns a standard
+    ``(rows, 4)`` flight buffer: iteration ``min(j * check_every,
+    cap)``, the block residual, NaN alpha/beta (the kernel's recurrence
+    scalars never leave the chip).  Host-side numpy - called once
+    post-solve on the already-fetched trace.
+    """
+    arr = np.asarray(block_rr, dtype=np.float64).reshape(-1)
+    n = arr.shape[0]
+    its = np.arange(n, dtype=np.float64) * float(check_every)
+    if cap is not None:
+        its = np.minimum(its, float(cap))
+    buf = np.full((n, len(COLUMNS)), np.nan)
+    valid = arr >= 0.0  # ||r||^2 >= 0; -1.0 is the never-ran sentinel
+    buf[valid, 0] = its[valid]
+    buf[valid, 1] = arr[valid]
+    return buf
+
+
+@dataclasses.dataclass(frozen=True)
+class FlightRecord:
+    """Host-side view of a fetched flight buffer: rows sorted by
+    iteration, unwritten (NaN) slots dropped, duplicates (ring slots
+    that share a capped iteration) resolved to the last write."""
+
+    iterations: np.ndarray   # (m,) int64, strictly increasing
+    residual_sq: np.ndarray  # (m,) float64
+    alphas: np.ndarray       # (m,) float64 (NaN where not recorded)
+    betas: np.ndarray        # (m,) float64
+    stride: int = 1
+
+    @classmethod
+    def from_buffer(cls, buf, stride: Optional[int] = None
+                    ) -> "FlightRecord":
+        """The post-solve fetch: ONE host conversion of the device ring
+        buffer (the solve itself is already complete and synced)."""
+        arr = np.asarray(buf, dtype=np.float64).reshape(-1, len(COLUMNS))
+        mask = np.isfinite(arr[:, 0])
+        rows = arr[mask]
+        # stable sort + keep-last dedupe: a capped final block can land
+        # on an iteration an earlier ring pass also wrote
+        order = np.argsort(rows[:, 0], kind="stable")
+        rows = rows[order]
+        if rows.shape[0]:
+            keep = np.append(rows[1:, 0] != rows[:-1, 0], True)
+            rows = rows[keep]
+        its = rows[:, 0].astype(np.int64)
+        if stride is None:
+            # infer from the LEADING diffs: the final row may be
+            # cap-clamped (a resident block trace whose last block hit
+            # iter_cap mid-block), so the last diff can be a remainder
+            # smaller than the true granularity
+            diffs = np.diff(its)
+            if diffs.size > 1:
+                stride = int(diffs[:-1].min())
+            elif diffs.size == 1:
+                stride = int(diffs[0])
+            else:
+                stride = 1
+        return cls(iterations=its, residual_sq=rows[:, 1],
+                   alphas=rows[:, 2], betas=rows[:, 3],
+                   stride=max(int(stride), 1))
+
+    @classmethod
+    def from_history(cls, history, stride: Optional[int] = None
+                     ) -> "FlightRecord":
+        """Adapt a ``residual_history`` array (``||r||`` at finite
+        indices, NaN elsewhere - the dense general-solver trace or the
+        resident engines' expanded block trace) into a record with NaN
+        alpha/beta columns."""
+        hist = np.asarray(history, dtype=np.float64).reshape(-1)
+        idx = np.nonzero(np.isfinite(hist))[0]
+        buf = np.full((idx.shape[0], len(COLUMNS)), np.nan)
+        buf[:, 0] = idx
+        buf[:, 1] = hist[idx] ** 2
+        return cls.from_buffer(buf, stride=stride)
+
+    def __len__(self) -> int:
+        return int(self.iterations.shape[0])
+
+    @property
+    def residuals(self) -> np.ndarray:
+        """``||r||`` per recorded iteration (sqrt of the stored
+        ``||r||^2``)."""
+        return np.sqrt(np.maximum(self.residual_sq, 0.0))
+
+    def to_history(self, maxiter: int, dtype=np.float64) -> np.ndarray:
+        """Expand into the solvers' ``(maxiter + 1,)``
+        ``residual_history`` layout: ``||r||`` at recorded iterations,
+        NaN elsewhere - how ``--history`` prints a decimated trace for
+        engines with no dense history."""
+        hist = np.full(maxiter + 1, np.nan, dtype=dtype)
+        keep = self.iterations <= maxiter
+        hist[self.iterations[keep]] = self.residuals[keep].astype(dtype)
+        return hist
+
+    def decay_rate(self, tail: Optional[int] = None) -> Optional[float]:
+        """Least-squares slope of ``log10 ||r||`` per iteration over the
+        (optionally last-``tail``-rows of the) record; negative means
+        converging, ~0 means flatlined.  ``None`` with < 2 usable
+        points (zero/non-finite residuals are excluded)."""
+        its = self.iterations.astype(np.float64)
+        res = self.residuals
+        if tail is not None and tail < its.shape[0]:
+            its, res = its[-tail:], res[-tail:]
+        ok = np.isfinite(res) & (res > 0.0)
+        if int(ok.sum()) < 2 or its[ok][-1] == its[ok][0]:
+            return None
+        slope = np.polyfit(its[ok], np.log10(res[ok]), 1)[0]
+        return float(slope)
+
+    def summary(self) -> dict:
+        """Compact JSON-ready digest (what bench.py embeds per
+        section)."""
+        out = {
+            "n_records": len(self),
+            "stride": int(self.stride),
+            "first_iteration": (int(self.iterations[0]) if len(self)
+                                else None),
+            "last_iteration": (int(self.iterations[-1]) if len(self)
+                               else None),
+            "decay_rate": self.decay_rate(),
+        }
+        if len(self):
+            res = self.residuals
+            ok = np.isfinite(res)
+            out["residual_first"] = float(res[0]) if ok[0] else None
+            out["residual_last"] = float(res[-1]) if ok[-1] else None
+            out["residual_min"] = (float(res[ok].min()) if ok.any()
+                                   else None)
+        return out
+
+    def to_json(self) -> dict:
+        """Full record as strict-JSON-ready lists (non-finite values
+        are the consumer's to sanitize - ``utils.logging.sanitize``)."""
+        return {
+            "stride": int(self.stride),
+            "iterations": [int(v) for v in self.iterations],
+            "residual_sq": list(self.residual_sq),
+            "alpha": list(self.alphas),
+            "beta": list(self.betas),
+        }

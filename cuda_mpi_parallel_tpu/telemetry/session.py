@@ -36,6 +36,11 @@ __all__ = ["SolveObservation", "observe_solve", "solve_metrics"]
 MAX_CHECK_BLOCK_EVENTS = 32
 
 
+#: per-solve iteration histogram buckets (iteration-flavored, spanning
+#: the 3-iteration oracle to capped 256^3 marathons)
+ITERATION_BUCKETS = (1, 3, 10, 30, 100, 300, 1000, 3000, 10000, 30000)
+
+
 def solve_metrics():
     """The registry metrics every observed solve feeds (get-or-create,
     so import order never matters)."""
@@ -46,6 +51,10 @@ def solve_metrics():
         "iterations": REGISTRY.counter(
             "solve_iterations_total", "CG iterations run, by engine",
             labelnames=("engine",)),
+        "iters_hist": REGISTRY.histogram(
+            "solve_iterations_per_solve",
+            "iterations per observed solve, by engine",
+            labelnames=("engine",), buckets=ITERATION_BUCKETS),
         "seconds": REGISTRY.histogram(
             "solve_seconds", "observed wall time per solve",
             labelnames=("engine",)),
@@ -71,11 +80,16 @@ class SolveObservation:
         return self.timer.section(name, sync=sync)
 
     def finish(self, result, elapsed_s: Optional[float] = None,
-               **extra: Any) -> Dict[str, Any]:
+               health=None, **extra: Any) -> Dict[str, Any]:
         """Record the solve's outcome.  ``result`` is a ``CGResult``
         (or the df64 adapter) whose scalars the CALLER has already
         synced - reading them here is a host conversion, not a new
-        device round-trip.  Returns the ``solve_end`` payload."""
+        device round-trip.  ``health`` is an optional
+        ``telemetry.health.SolveHealth`` (computed by the caller from
+        the post-solve flight record); when given, the verdict is
+        emitted as a ``solve_health`` event + gauges inside this
+        solve's scope and embedded in the ``solve_end`` payload.
+        Returns the ``solve_end`` payload."""
         self.result = result
         self.elapsed_s = elapsed_s
         iterations = int(result.iterations)
@@ -83,9 +97,15 @@ class SolveObservation:
         metrics = solve_metrics()
         metrics["solves"].inc(engine=self.engine, status=status)
         metrics["iterations"].inc(iterations, engine=self.engine)
+        metrics["iters_hist"].observe(iterations, engine=self.engine)
         if elapsed_s is not None:
             metrics["seconds"].observe(elapsed_s, engine=self.engine)
 
+        if health is not None:
+            from .health import emit_solve_health
+
+            extra = dict(extra, health=emit_solve_health(
+                health, engine=self.engine))
         self._emit_check_blocks(result, iterations)
         payload: Dict[str, Any] = dict(
             status=status,

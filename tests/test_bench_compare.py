@@ -1,0 +1,168 @@
+"""tools/bench_compare.py: the bench regression gate, on synthetic
+records (no device, no bench run - pure JSON plumbing)."""
+import importlib.util
+import io
+import json
+import pathlib
+import sys
+
+import pytest
+
+_TOOL = pathlib.Path(__file__).resolve().parents[1] / "tools" \
+    / "bench_compare.py"
+spec = importlib.util.spec_from_file_location("bench_compare", _TOOL)
+bench_compare = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench_compare)
+
+HK = bench_compare.HEADLINE_KEY
+
+
+def _write(tmp_path, name, data):
+    path = tmp_path / name
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def _sweep(headline=148519.5, tts=2.0, iters=500, converged=True,
+           decay=-0.05, classification="CONVERGED"):
+    return {
+        HK: {"metric": "cg_iters_per_sec_poisson2d_1M_f32",
+             "value": headline, "unit": "iters/s",
+             "iterations": 1462, "converged": True},
+        f"{HK}__done": {"section_s": 1.0},
+        "__meta__": {"git_rev": "abc"},
+        "poisson2d_512_none_rtol1e-6": {
+            "time_to_tol_s": tts, "iterations": iters,
+            "converged": converged,
+            "flight": {"decay_rate": decay, "kappa_estimate": 441.0,
+                       "classification": classification},
+        },
+    }
+
+
+class TestLoadSections:
+    def test_sweep_shape_skips_bookkeeping(self, tmp_path):
+        sections = bench_compare.load_sections(
+            _write(tmp_path, "a.json", _sweep()))
+        assert set(sections) == {HK, "poisson2d_512_none_rtol1e-6"}
+
+    def test_flat_headline_record_normalizes(self, tmp_path):
+        rec = {"metric": "cg_iters_per_sec_poisson2d_1M_f32",
+               "value": 100.0, "vs_baseline": 0.02}
+        sections = bench_compare.load_sections(
+            _write(tmp_path, "b.json", rec))
+        assert set(sections) == {HK}
+        assert sections[HK]["value"] == 100.0
+
+    def test_empty_file_raises(self, tmp_path):
+        with pytest.raises(ValueError):
+            bench_compare.load_sections(
+                _write(tmp_path, "c.json", {"__meta__": {}}))
+
+
+class TestCompareGate:
+    def _run(self, tmp_path, old, new, threshold=0.10):
+        out = io.StringIO()
+        rc = bench_compare.compare(
+            bench_compare.load_sections(_write(tmp_path, "old.json", old)),
+            bench_compare.load_sections(_write(tmp_path, "new.json", new)),
+            threshold, out=out)
+        return rc, out.getvalue()
+
+    def test_identical_passes(self, tmp_path):
+        rc, out = self._run(tmp_path, _sweep(), _sweep())
+        assert rc == 0
+        assert "no gated regressions" in out
+
+    def test_small_headline_dip_passes(self, tmp_path):
+        rc, _ = self._run(tmp_path, _sweep(headline=100000.0),
+                          _sweep(headline=95000.0))
+        assert rc == 0
+
+    def test_headline_regression_fails(self, tmp_path):
+        rc, out = self._run(tmp_path, _sweep(headline=100000.0),
+                            _sweep(headline=85000.0))
+        assert rc == 1
+        assert "REGRESSIONS" in out
+        assert f"{HK}.value" in out
+
+    def test_headline_improvement_passes(self, tmp_path):
+        rc, _ = self._run(tmp_path, _sweep(headline=100000.0),
+                          _sweep(headline=150000.0))
+        assert rc == 0
+
+    def test_time_to_tol_regression_fails(self, tmp_path):
+        rc, out = self._run(tmp_path, _sweep(tts=2.0), _sweep(tts=2.5))
+        assert rc == 1
+        assert "time_to_tol_s" in out
+
+    def test_iteration_count_regression_fails(self, tmp_path):
+        # more iterations to the same tolerance = convergence regression
+        rc, out = self._run(tmp_path, _sweep(iters=500),
+                            _sweep(iters=700))
+        assert rc == 1
+        assert "iterations" in out
+
+    def test_converged_flip_fails(self, tmp_path):
+        rc, out = self._run(tmp_path, _sweep(converged=True),
+                            _sweep(converged=False,
+                                   classification="STAGNATED"))
+        assert rc == 1
+        assert "converged true -> false" in out
+
+    def test_health_classification_flip_fails(self, tmp_path):
+        rc, out = self._run(tmp_path, _sweep(classification="CONVERGED"),
+                            _sweep(classification="STAGNATED"))
+        assert rc == 1
+        assert "STAGNATED" in out
+
+    def test_threshold_is_configurable(self, tmp_path):
+        old, new = _sweep(headline=100000.0), _sweep(headline=95000.0)
+        rc, _ = self._run(tmp_path, old, new, threshold=0.02)
+        assert rc == 1
+
+    def test_disjoint_sections_reported_not_failed(self, tmp_path):
+        old = {"only_old": {"iters_per_sec": 1.0}}
+        new = {"only_new": {"iters_per_sec": 2.0}}
+        rc, out = self._run(tmp_path, old, new)
+        assert rc == 0
+        assert "only in OLD: only_old" in out
+        assert "only in NEW: only_new" in out
+
+    def test_flight_decay_reported_in_table(self, tmp_path):
+        rc, out = self._run(tmp_path, _sweep(decay=-0.05),
+                            _sweep(decay=-0.01))
+        # reported (not gated): decay_rate rides the table only
+        assert "flight.decay_rate" in out
+        assert rc == 0
+
+
+class TestMainCli:
+    def test_main_regression_exit_codes(self, tmp_path, capsys):
+        old = _write(tmp_path, "o.json", _sweep(headline=100000.0))
+        new = _write(tmp_path, "n.json", _sweep(headline=50000.0))
+        assert bench_compare.main([old, new]) == 1
+        assert bench_compare.main([old, old]) == 0
+
+    def test_main_unreadable_is_2(self, tmp_path):
+        old = _write(tmp_path, "o.json", _sweep())
+        assert bench_compare.main([old, str(tmp_path / "nope.json")]) == 2
+
+    def test_main_bad_threshold_is_2(self, tmp_path):
+        old = _write(tmp_path, "o.json", _sweep())
+        assert bench_compare.main(["--threshold", "0", old, old]) == 2
+
+
+def test_headline_key_matches_bench():
+    # bench_compare cannot import bench.py (it must run without jax), so
+    # its HEADLINE_KEY is a copy; if bench.py ever renames the headline
+    # section the gate would silently stop matching anything.  Pull the
+    # constant out of bench.py's AST (no import, no side effects).
+    import ast
+
+    tree = ast.parse((_TOOL.parents[1] / "bench.py").read_text())
+    vals = [n.value.value for n in ast.walk(tree)
+            if isinstance(n, ast.Assign)
+            and any(isinstance(t, ast.Name) and t.id == "HEADLINE_KEY"
+                    for t in n.targets)]
+    assert vals == [bench_compare.HEADLINE_KEY]

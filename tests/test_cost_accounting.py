@@ -166,6 +166,66 @@ class TestZeroPerturbation:
             telemetry.force_active(False)
         assert instrumented == base
 
+    def test_flight_off_jaxpr_identical(self):
+        """The recorder-off proof: ``flight=None`` (the default) leaves
+        the traced solve BIT-IDENTICAL to a call that never mentions the
+        recorder - the ring buffer must not enter the loop state, and no
+        recorder op may survive tracing.  With a config, the jaxpr must
+        genuinely differ (the buffer IS carried)."""
+        from cuda_mpi_parallel_tpu.telemetry.flight import FlightConfig
+
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones(256)
+        base = str(jax.make_jaxpr(lambda v: cg(a, v, maxiter=25))(b))
+        off = str(jax.make_jaxpr(
+            lambda v: cg(a, v, maxiter=25, flight=None))(b))
+        assert off == base
+        # and with telemetry active on top (the PR-2 proof composed
+        # with the recorder-off path)
+        telemetry.configure(None)
+        try:
+            with events.capture():
+                telemetry.force_active(True)
+                active = str(jax.make_jaxpr(
+                    lambda v: cg(a, v, maxiter=25, flight=None))(b))
+        finally:
+            telemetry.force_active(False)
+        assert active == base
+        cfg = FlightConfig(capacity=7, stride=1)
+        on = str(jax.make_jaxpr(
+            lambda v: cg(a, v, maxiter=25, flight=cfg))(b))
+        assert on != base
+        assert "7,4" in on.replace(" ", "")   # the (capacity, 4) ring
+        assert "7,4" not in base.replace(" ", "")
+
+    @needs_mesh
+    def test_flight_off_distributed_jaxpr_identical(self):
+        """Same proof under shard_map: the recorder-off distributed
+        solve traces to the identical jaxpr, recorder-on carries the
+        replicated ring buffer."""
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+        from cuda_mpi_parallel_tpu.parallel.operators import DistStencil2D
+        from cuda_mpi_parallel_tpu.telemetry.flight import FlightConfig
+
+        mesh = make_mesh(4)
+        local = DistStencil2D.create((16, 16), 4, dtype=jnp.float64)
+        b = jnp.ones(256)
+
+        def trace(flight_kw):
+            @partial(compat.shard_map, mesh=mesh,
+                     in_specs=(P("rows"), P()), out_specs=P("rows"))
+            def run(b_local, scale):
+                loc = dataclasses.replace(local, scale=scale)
+                return cg(loc, b_local, axis_name="rows", maxiter=25,
+                          **flight_kw).x
+
+            return str(jax.make_jaxpr(run)(b, local.scale))
+
+        base = trace({})
+        assert trace({"flight": None}) == base
+        on = trace({"flight": FlightConfig(capacity=7, stride=1)})
+        assert on != base
+
     @needs_mesh
     def test_distributed_jaxpr_identical(self):
         from cuda_mpi_parallel_tpu.parallel import make_mesh

@@ -234,3 +234,95 @@ class TestDistributedVariants:
         assert bool(res.converged)
         np.testing.assert_allclose(np.asarray(a @ res.x), np.asarray(b),
                                    atol=2e-3)
+
+
+class TestFlightRecorderDistributed:
+    """The convergence flight recorder under shard_map: the recorded
+    scalars are the psum'd globals, so the fetched record must be the
+    single trace of the GLOBAL solve - monotone, decimated, and
+    matching the dense distributed history at the sampled iterations.
+    """
+
+    def _system(self, n=24):
+        a = Stencil2D.create(n, n, dtype=jnp.float32)
+        rng = np.random.default_rng(11)
+        b = np.asarray(rng.standard_normal(n * n), dtype=np.float32)
+        return a, b
+
+    def test_mesh4_flight_monotone_decimated(self):
+        from cuda_mpi_parallel_tpu.telemetry.flight import (
+            FlightConfig,
+            FlightRecord,
+        )
+
+        a, b = self._system()
+        res = solve_distributed(
+            a, b, mesh=make_mesh(4), tol=1e-5, maxiter=400,
+            record_history=True,
+            flight=FlightConfig.for_solve(400, stride=3))
+        rec = FlightRecord.from_buffer(res.flight)
+        assert rec.stride == 3
+        assert len(rec) >= 4
+        assert np.all(np.diff(rec.iterations) == 3)   # monotone, gapless
+        assert np.all(rec.iterations % 3 == 0)
+        # the decimated rows ARE the dense distributed trace sampled:
+        # the loop's psum'd rr feeds both
+        hist = np.asarray(res.residual_history)
+        assert np.array_equal(rec.residuals.astype(np.float32),
+                              hist[rec.iterations].astype(np.float32))
+
+    def test_mesh4_stride1_matches_single_device_trajectory(self):
+        from cuda_mpi_parallel_tpu.telemetry.flight import (
+            FlightConfig,
+            FlightRecord,
+        )
+
+        a, b = self._system()
+        cfg = FlightConfig.for_solve(400, stride=1)
+        res_d = solve_distributed(a, b, mesh=make_mesh(4), tol=1e-5,
+                                  maxiter=400, flight=cfg)
+        res_s = solve(a, jnp.asarray(b), tol=1e-5, maxiter=400,
+                      flight=cfg)
+        rec_d = FlightRecord.from_buffer(res_d.flight)
+        rec_s = FlightRecord.from_buffer(res_s.flight)
+        assert rec_d.iterations[-1] == rec_s.iterations[-1]
+        # same algorithm: trajectories agree to psum-tree rounding
+        np.testing.assert_allclose(rec_d.residuals, rec_s.residuals,
+                                   rtol=2e-3)
+
+    def test_mesh4_cli_flight_record_history(self, tmp_path, capsys):
+        """ISSUE acceptance: with --flight-record, --history works
+        under --mesh 4 and the solve_health verdict rides the record;
+        the printed decimated trace is monotone."""
+        import json as _json
+
+        from cuda_mpi_parallel_tpu import cli
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+        from cuda_mpi_parallel_tpu.telemetry import (
+            configure as _tconf,
+            force_active as _tforce,
+        )
+
+        trace = tmp_path / "flight_trace.jsonl"
+        dist_cg.clear_solver_cache()
+        try:
+            rc = cli.main(["--problem", "poisson2d", "--n", "32",
+                           "--matrix-free", "--mesh", "4",
+                           "--tol", "1e-5", "--flight-record", "2",
+                           "--history", "--json",
+                           "--trace-events", str(trace)])
+        finally:
+            _tconf(None)
+            _tforce(False)
+            dist_cg.clear_solver_cache()
+        assert rc == 0
+        rec = _json.loads(capsys.readouterr().out)
+        assert rec["converged"] is True
+        assert rec["flight"]["stride"] == 2
+        assert rec["flight"]["n_records"] >= 4
+        assert rec["health"]["classification"] == "CONVERGED"
+        lines = [_json.loads(ln)
+                 for ln in trace.read_text().splitlines()]
+        sel = [ln for ln in lines if ln["event"] == "engine_selected"]
+        assert sel and all(ln["flight_stride"] == 2 for ln in sel)
+        assert any(ln["event"] == "solve_health" for ln in lines)

@@ -258,3 +258,447 @@ class TestObserveSolve:
         lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
         assert lines[0]["phase"] == "warmup"
         assert "phase" not in lines[1]
+
+
+class TestFlightRecorder:
+    """telemetry.flight: the in-loop convergence recorder.
+
+    Load-bearing properties: a stride-1 record reproduces the dense
+    ``record_history`` trace BIT-FOR-BIT (same rr scalars, correctly
+    rounded sqrt), decimation and the ring wrap keep exactly the
+    documented rows, and the recorder-off path is proven untouched in
+    tests/test_cost_accounting.py::TestZeroPerturbation.
+    """
+
+    def _poisson(self, n=24):
+        import jax.numpy as jnp
+
+        from cuda_mpi_parallel_tpu.models.operators import Stencil2D
+
+        a = Stencil2D.create(n, n, dtype=jnp.float32)
+        rng = np.random.default_rng(7)
+        b = jnp.asarray(rng.standard_normal(n * n).astype(np.float32))
+        return a, b
+
+    def test_config_validation(self):
+        from cuda_mpi_parallel_tpu.telemetry.flight import FlightConfig
+
+        with pytest.raises(ValueError, match="capacity"):
+            FlightConfig(capacity=0)
+        with pytest.raises(ValueError, match="stride"):
+            FlightConfig(stride=0)
+        with pytest.raises(ValueError, match="heartbeat"):
+            FlightConfig(heartbeat=-1)
+        cfg = FlightConfig.for_solve(100, stride=4)
+        assert cfg.capacity == 26 and cfg.stride == 4
+        # capacity is capped (the carried buffer stays bounded)
+        assert FlightConfig.for_solve(10 ** 9).capacity == 4096
+
+    def test_stride1_matches_dense_history_bit_for_bit(self):
+        from cuda_mpi_parallel_tpu.solver.cg import solve
+        from cuda_mpi_parallel_tpu.telemetry.flight import (
+            FlightConfig,
+            FlightRecord,
+        )
+
+        a, b = self._poisson()
+        res = solve(a, b, tol=1e-5, maxiter=400, record_history=True,
+                    flight=FlightConfig.for_solve(400, stride=1))
+        assert bool(res.converged)
+        rec = FlightRecord.from_buffer(res.flight, stride=1)
+        hist = np.asarray(res.residual_history)
+        dense = hist[np.isfinite(hist)].astype(np.float32)
+        k = int(res.iterations)
+        assert len(rec) == dense.shape[0] == k + 1
+        assert np.array_equal(rec.iterations, np.arange(k + 1))
+        # BIT-FOR-BIT: both sides are sqrt of the identical rr scalar
+        # (f64 sqrt of an f32 value rounds to the f32 sqrt exactly)
+        assert np.array_equal(rec.residuals.astype(np.float32), dense)
+
+    def test_stride1_matches_dense_history_cg1_pipecg(self):
+        from cuda_mpi_parallel_tpu.solver.cg import solve
+        from cuda_mpi_parallel_tpu.telemetry.flight import (
+            FlightConfig,
+            FlightRecord,
+        )
+
+        a, b = self._poisson()
+        for method in ("cg1", "pipecg"):
+            res = solve(a, b, tol=1e-5, maxiter=400, method=method,
+                        record_history=True,
+                        flight=FlightConfig.for_solve(400, stride=1))
+            rec = FlightRecord.from_buffer(res.flight, stride=1)
+            hist = np.asarray(res.residual_history)
+            dense = hist[np.isfinite(hist)].astype(np.float32)
+            assert np.array_equal(rec.residuals.astype(np.float32),
+                                  dense), method
+
+    def test_decimation_records_every_nth(self):
+        from cuda_mpi_parallel_tpu.solver.cg import solve
+        from cuda_mpi_parallel_tpu.telemetry.flight import (
+            FlightConfig,
+            FlightRecord,
+        )
+
+        a, b = self._poisson()
+        res = solve(a, b, tol=1e-5, maxiter=400, record_history=True,
+                    flight=FlightConfig.for_solve(400, stride=8))
+        rec = FlightRecord.from_buffer(res.flight)
+        assert rec.stride == 8
+        assert np.all(rec.iterations % 8 == 0)
+        assert np.all(np.diff(rec.iterations) == 8)  # monotone, gapless
+        # decimated rows equal the dense trace at the sampled indices
+        hist = np.asarray(res.residual_history)
+        assert np.array_equal(rec.residuals.astype(np.float32),
+                              hist[rec.iterations].astype(np.float32))
+
+    def test_ring_wrap_keeps_last_window(self):
+        from cuda_mpi_parallel_tpu.solver.cg import solve
+        from cuda_mpi_parallel_tpu.telemetry.flight import (
+            FlightConfig,
+            FlightRecord,
+        )
+
+        a, b = self._poisson()
+        # 16-row ring on a ~hundreds-iteration solve: must keep the
+        # LAST 16 sampled iterations, consecutively
+        res = solve(a, b, tol=1e-5, maxiter=400,
+                    flight=FlightConfig(capacity=16, stride=1))
+        k = int(res.iterations)
+        rec = FlightRecord.from_buffer(res.flight, stride=1)
+        assert len(rec) == 16
+        assert rec.iterations[-1] == k
+        assert np.array_equal(rec.iterations,
+                              np.arange(k - 15, k + 1))
+
+    def test_alpha_beta_columns_recorded(self):
+        from cuda_mpi_parallel_tpu.solver.cg import solve
+        from cuda_mpi_parallel_tpu.telemetry.flight import (
+            FlightConfig,
+            FlightRecord,
+        )
+
+        a, b = self._poisson()
+        res = solve(a, b, tol=1e-5, maxiter=400,
+                    flight=FlightConfig.for_solve(400))
+        rec = FlightRecord.from_buffer(res.flight)
+        # row 0 is the initial state (no step ran): NaN alpha/beta;
+        # every later row holds the step's positive SPD scalars
+        assert np.isnan(rec.alphas[0]) and np.isnan(rec.betas[0])
+        assert np.all(rec.alphas[1:] > 0)
+        assert np.all(rec.betas[1:] >= 0)
+
+    def test_from_history_and_to_history_roundtrip(self):
+        from cuda_mpi_parallel_tpu.telemetry.flight import FlightRecord
+
+        hist = np.full(33, np.nan)
+        its = np.array([0, 8, 16, 24, 32])
+        hist[its] = [1.0, 0.5, 0.25, 0.125, 0.0625]
+        rec = FlightRecord.from_history(hist)
+        assert np.array_equal(rec.iterations, its)
+        assert rec.stride == 8
+        back = rec.to_history(32)
+        assert np.array_equal(np.isfinite(back), np.isfinite(hist))
+        np.testing.assert_allclose(back[its], hist[its], rtol=1e-12)
+
+    def test_summary_and_decay_rate(self):
+        from cuda_mpi_parallel_tpu.telemetry.flight import FlightRecord
+
+        # exactly one decade per 10 iterations -> decay_rate = -0.1
+        its = np.arange(0, 101, 10)
+        hist = np.full(101, np.nan)
+        hist[its] = 10.0 ** (-its / 10.0)
+        rec = FlightRecord.from_history(hist)
+        assert rec.decay_rate() == pytest.approx(-0.1, rel=1e-9)
+        s = rec.summary()
+        assert s["n_records"] == 11 and s["stride"] == 10
+        assert s["last_iteration"] == 100
+        assert s["decay_rate"] == pytest.approx(-0.1, rel=1e-9)
+
+    def test_engine_selected_carries_flight_stride(self):
+        from cuda_mpi_parallel_tpu.solver.cg import solve
+        from cuda_mpi_parallel_tpu.telemetry.flight import FlightConfig
+
+        a, b = self._poisson()
+        with events.capture() as buf:
+            solve(a, b, tol=1e-5, maxiter=50,
+                  flight=FlightConfig.for_solve(50, stride=3))
+            solve(a, b, tol=1e-5, maxiter=50)
+        lines = [json.loads(ln) for ln in buf.getvalue().splitlines()
+                 if json.loads(ln)["event"] == "engine_selected"]
+        assert lines[0]["flight_stride"] == 3
+        assert "flight_stride" not in lines[-1]
+
+    def test_heartbeat_off_means_no_callback_in_jaxpr(self):
+        import jax
+        import jax.numpy as jnp
+
+        from cuda_mpi_parallel_tpu.models.operators import Stencil2D
+        from cuda_mpi_parallel_tpu.solver.cg import cg
+        from cuda_mpi_parallel_tpu.telemetry.flight import FlightConfig
+
+        a = Stencil2D.create(16, 16, dtype=jnp.float32)
+        b = jnp.ones(256, jnp.float32)
+        off = str(jax.make_jaxpr(lambda v: cg(
+            a, v, maxiter=25, flight=FlightConfig(capacity=8)))(b))
+        on = str(jax.make_jaxpr(lambda v: cg(
+            a, v, maxiter=25,
+            flight=FlightConfig(capacity=8, heartbeat=5)))(b))
+        assert "callback" not in off      # GL105: hot loop untouched
+        assert "callback" in on           # opt-in sampled heartbeat
+
+    def test_heartbeat_emits_sampled_events(self):
+        from cuda_mpi_parallel_tpu.solver.cg import solve
+        from cuda_mpi_parallel_tpu.telemetry.flight import FlightConfig
+
+        import jax
+
+        a, b = self._poisson()
+        with events.capture() as buf:
+            res = solve(a, b, tol=1e-5, maxiter=400,
+                        flight=FlightConfig.for_solve(
+                            400, heartbeat=50))
+            np.asarray(res.x)
+            jax.effects_barrier()         # callbacks delivered
+        beats = [json.loads(ln) for ln in buf.getvalue().splitlines()
+                 if json.loads(ln)["event"] == "flight_heartbeat"]
+        assert beats, "heartbeat events must arrive"
+        assert all(b["iteration"] % 50 == 0 for b in beats)
+
+    def test_heartbeat_carries_solve_scope(self):
+        """Heartbeats run on jax's callback thread where the event
+        contextvars are empty: the dispatch-time ambient snapshot must
+        keep them correlated with the in-flight solve (solve_id AND
+        scoped fields like the CLI's phase="warmup")."""
+        from cuda_mpi_parallel_tpu.solver.cg import solve
+        from cuda_mpi_parallel_tpu.telemetry.flight import FlightConfig
+
+        import jax
+
+        a, b = self._poisson()
+        with events.capture() as buf, \
+                events.solve_scope("hb-probe"), \
+                events.scoped(phase="warmup"):
+            res = solve(a, b, tol=1e-5, maxiter=400,
+                        flight=FlightConfig.for_solve(
+                            400, heartbeat=50))
+            np.asarray(res.x)
+            jax.effects_barrier()         # callbacks delivered
+        beats = [json.loads(ln) for ln in buf.getvalue().splitlines()
+                 if json.loads(ln)["event"] == "flight_heartbeat"]
+        assert beats
+        assert all(b["solve_id"] == "hb-probe" for b in beats)
+        assert all(b.get("phase") == "warmup" for b in beats)
+
+
+class TestSolveHealth:
+    """telemetry.health: the trace classification + spectral estimate
+    that turn 'MAXITER' into a diagnosis (the reference printed
+    'Success' unconditionally, CUDACG.cu:365)."""
+
+    def _record(self, residuals, its=None):
+        from cuda_mpi_parallel_tpu.telemetry.flight import FlightRecord
+
+        residuals = np.asarray(residuals, dtype=np.float64)
+        if its is None:
+            its = np.arange(residuals.shape[0])
+        buf = np.full((residuals.shape[0], 4), np.nan)
+        buf[:, 0] = its
+        buf[:, 1] = residuals ** 2
+        return FlightRecord.from_buffer(buf, stride=1)
+
+    def test_new_status_codes_describe(self):
+        assert "stagnated" in CGStatus.STAGNATED.describe()
+        assert "diverged" in CGStatus.DIVERGED.describe()
+        # device-produced codes unchanged
+        assert CGStatus.CONVERGED == 0 and CGStatus.MAXITER == 1 \
+            and CGStatus.BREAKDOWN == 2
+
+    def test_condition_estimate_known_spectrum(self):
+        """Diagonal operator with eigenvalues linspace(1, 100): the CG
+        Lanczos tridiagonal's extreme Ritz values must recover
+        kappa = 100 from the recorded alpha/beta (inner bound)."""
+        import jax.numpy as jnp
+
+        from cuda_mpi_parallel_tpu.solver.cg import solve
+        from cuda_mpi_parallel_tpu.telemetry.flight import (
+            FlightConfig,
+            FlightRecord,
+        )
+        from cuda_mpi_parallel_tpu.telemetry.health import (
+            estimate_condition,
+        )
+
+        eigs = np.linspace(1.0, 100.0, 40)
+        a = jnp.asarray(np.diag(eigs))
+        rng = np.random.default_rng(3)
+        b = jnp.asarray(rng.standard_normal(40))
+        res = solve(a, b, tol=1e-12, maxiter=80,
+                    flight=FlightConfig.for_solve(80))
+        rec = FlightRecord.from_buffer(res.flight)
+        lmin, lmax, kappa = estimate_condition(rec)
+        assert lmin >= 1.0 - 1e-6 and lmax <= 100.0 + 1e-6  # inner
+        assert kappa == pytest.approx(100.0, rel=0.05)
+
+    def test_condition_estimate_pipecg_rounding_floor(self):
+        """pipecg driven past its accuracy floor records a run of
+        negative trailing alphas; the estimate must truncate to the
+        clean leading rows (which define a valid tridiagonal) instead
+        of declining outright."""
+        import jax.numpy as jnp
+
+        from cuda_mpi_parallel_tpu.solver.cg import solve
+        from cuda_mpi_parallel_tpu.telemetry.flight import (
+            FlightConfig,
+            FlightRecord,
+        )
+        from cuda_mpi_parallel_tpu.telemetry.health import (
+            estimate_condition,
+        )
+
+        eigs = np.linspace(1.0, 100.0, 40)
+        a = jnp.asarray(np.diag(eigs))
+        rng = np.random.default_rng(3)
+        b = jnp.asarray(rng.standard_normal(40))
+        res = solve(a, b, tol=1e-12, maxiter=80, method="pipecg",
+                    flight=FlightConfig.for_solve(80))
+        rec = FlightRecord.from_buffer(res.flight)
+        _, _, kappa = estimate_condition(rec)
+        assert kappa == pytest.approx(100.0, rel=0.05)
+
+    def test_condition_estimate_needs_stride1_alpha_beta(self):
+        from cuda_mpi_parallel_tpu.telemetry.health import (
+            estimate_condition,
+        )
+
+        # NaN alpha/beta columns (a from_history record) cannot support
+        # the tridiagonal: the estimate must decline, not guess
+        rec = self._record(10.0 ** -np.arange(20.0))
+        assert estimate_condition(rec) == (None, None, None)
+
+    def test_classify_converged_wins(self):
+        from cuda_mpi_parallel_tpu.telemetry.health import classify_trace
+
+        rec = self._record([1.0, 0.1, 0.01, 0.001])
+        cls, _, _, msg = classify_trace(rec, converged=True)
+        assert cls == CGStatus.CONVERGED and msg == "converged"
+
+    def test_classify_stagnation(self):
+        from cuda_mpi_parallel_tpu.telemetry.health import classify_trace
+
+        # decays two decades then flatlines for 60 iterations
+        res = np.concatenate([10.0 ** -np.arange(0, 2, 0.1),
+                              np.full(60, 1e-2)])
+        res *= 1.0 + 1e-4 * np.sin(np.arange(res.shape[0]))  # noise
+        cls, rate, plateau, msg = classify_trace(rec := self._record(res),
+                                                 converged=False)
+        assert cls == CGStatus.STAGNATED
+        assert abs(rate) < 1e-3
+        assert "flatlined" in msg
+
+    def test_classify_divergence(self):
+        from cuda_mpi_parallel_tpu.telemetry.health import classify_trace
+
+        res = np.concatenate([10.0 ** -np.arange(0, 3, 0.5),
+                              10.0 ** np.arange(-3, 1, 0.5)])
+        cls, _, plateau, msg = classify_trace(self._record(res),
+                                              converged=False)
+        assert cls == CGStatus.DIVERGED
+        assert "grew" in msg
+
+    def test_classify_maxiter_still_converging(self):
+        from cuda_mpi_parallel_tpu.telemetry.health import classify_trace
+
+        res = 10.0 ** (-0.05 * np.arange(100.0))  # healthy steady decay
+        cls, rate, _, msg = classify_trace(self._record(res),
+                                           converged=False)
+        assert cls == CGStatus.MAXITER
+        assert rate == pytest.approx(-0.05, rel=1e-6)
+        assert "still converging" in msg
+
+    def test_stagnating_f32_solve_yields_noncconverged_health(self):
+        """ISSUE acceptance: a stagnating system (f32 attainable-
+        accuracy floor, kappa ~ 1e8) yields a solve_health event with a
+        non-CONVERGED classification through the PR-2 stack."""
+        import jax.numpy as jnp
+
+        from cuda_mpi_parallel_tpu.solver.cg import solve
+        from cuda_mpi_parallel_tpu.telemetry import session
+        from cuda_mpi_parallel_tpu.telemetry.flight import (
+            FlightConfig,
+            FlightRecord,
+        )
+        from cuda_mpi_parallel_tpu.telemetry.health import (
+            assess_solve_health,
+        )
+
+        eigs = np.logspace(0, -8, 48)            # kappa = 1e8 in f32
+        a = jnp.asarray(np.diag(eigs).astype(np.float32))
+        b = jnp.ones(48, jnp.float32)
+        res = solve(a, b, tol=1e-12, maxiter=400,
+                    flight=FlightConfig.for_solve(400))
+        assert not bool(res.converged)           # the floor is real
+        rec = FlightRecord.from_buffer(res.flight)
+        health = assess_solve_health(
+            rec, converged=bool(res.converged), status=int(res.status),
+            iterations=int(res.iterations))
+        assert health.classification in (CGStatus.STAGNATED,
+                                         CGStatus.DIVERGED)
+        assert health.classification != CGStatus.CONVERGED
+        with events.capture() as buf:
+            with session.observe_solve("stagnation probe",
+                                       engine="general") as obs:
+                obs.finish(res, elapsed_s=0.1, health=health)
+        lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        hl = [ln for ln in lines if ln["event"] == "solve_health"]
+        assert len(hl) == 1
+        assert hl[0]["classification"] == health.classification.name
+        assert hl[0]["converged"] is False
+        events.validate_event(hl[0])
+        # the verdict rides the solve_end payload too
+        end = [ln for ln in lines if ln["event"] == "solve_end"][-1]
+        assert end["health"]["classification"] == \
+            health.classification.name
+
+    def test_emit_solve_health_sets_gauges(self):
+        from cuda_mpi_parallel_tpu.telemetry.health import (
+            assess_solve_health,
+            emit_solve_health,
+        )
+
+        rec = self._record(10.0 ** (-0.05 * np.arange(100.0)))
+        health = assess_solve_health(rec, converged=False)
+        emit_solve_health(health, engine="general")
+        snap = REGISTRY.snapshot()
+        series = snap["solve_residual_decay_rate"]["series"]
+        mine = [s for s in series
+                if s["labels"].get("engine") == "general"]
+        assert mine and mine[0]["value"] == pytest.approx(-0.05,
+                                                          rel=1e-6)
+
+    def test_healthy_solve_health_in_iteration_histogram(self):
+        """observe_solve feeds the per-solve iteration histogram (the
+        PR-3 metrics satellite)."""
+        from cuda_mpi_parallel_tpu.telemetry.session import solve_metrics
+
+        class R:
+            iterations = 37
+            converged = True
+            residual_norm = 1e-8
+
+            @staticmethod
+            def status_enum():
+                return CGStatus.CONVERGED
+
+            residual_history = None
+
+        before = REGISTRY.snapshot().get(
+            "solve_iterations_per_solve", {"series": []})
+        with events.capture():
+            with session.observe_solve("hist probe",
+                                       engine="general") as obs:
+                obs.finish(R())
+        snap = REGISTRY.snapshot()["solve_iterations_per_solve"]
+        series = [s for s in snap["series"]
+                  if s["labels"].get("engine") == "general"]
+        assert series and series[0]["count"] >= 1

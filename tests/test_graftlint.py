@@ -249,18 +249,22 @@ class TestCLIEntry:
 
 
 class TestCLIHistoryRejection:
-    """Satellite (ADVICE.md round 5): --history with --mesh > 1 and the
-    resident/streaming engines was silently dropped; now rejected like
-    every other unsupported flag combination."""
+    """Satellite (ADVICE.md round 5, revised by the flight recorder):
+    --history with --mesh > 1 and the resident/streaming engines was
+    silently dropped, then dead-ended; the bare flag is still rejected
+    (never silently dropped), but the error now points at
+    --flight-record, which carries the trace through the recorder."""
 
     @pytest.mark.parametrize("engine", ["resident", "streaming"])
-    def test_rejected(self, engine):
+    def test_bare_history_points_at_flight_record(self, engine):
         from cuda_mpi_parallel_tpu import cli
 
-        with pytest.raises(SystemExit, match="--history is unavailable"):
+        with pytest.raises(SystemExit,
+                           match="flight-record") as excinfo:
             cli.main(["--problem", "poisson2d", "--n", "32", "--device",
                       "cpu", "--matrix-free", "--mesh", "2", "--engine",
                       engine, "--history"])
+        assert "--history" in str(excinfo.value)
 
     def test_general_engine_keeps_history(self, capsys):
         from cuda_mpi_parallel_tpu import cli

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Diff two bench result files and gate on regressions.
+
+The bench trajectory was unguarded: ``bench.py`` flushes
+``bench_results.json`` (and the driver snapshots ``BENCH_rNN.json``),
+but nothing ever COMPARED two of them - a 30% headline regression or a
+section whose solve stopped converging would ride into the record
+unremarked.  This tool is the gate::
+
+    python tools/bench_compare.py OLD.json NEW.json
+    python tools/bench_compare.py --threshold 0.05 OLD.json NEW.json
+
+It accepts both shapes the repo produces:
+
+* ``bench_results.json`` sweeps - a mapping of section name to entry
+  (``__``-prefixed bookkeeping and ``*__done``/``*__error`` markers are
+  skipped);
+* single headline records (``BENCH_rNN.json`` / ``bench.py``'s stdout
+  line) - ``{"metric": ..., "value": ...}``, treated as a one-section
+  file keyed by the headline section name.
+
+For every section present in BOTH files it prints a per-metric delta
+table over the known numeric metrics (throughput, latency,
+time-to-tolerance, iteration counts, and the flight-recorder
+convergence columns ``decay_rate``/``kappa_estimate``).  Exit status:
+
+* ``1`` if the HEADLINE metric regressed by more than ``--threshold``
+  (default 10%), or any shared section's ``converged`` flipped
+  true -> false, or any shared lower-is-better metric listed in
+  ``GATED_METRICS`` regressed past the threshold;
+* ``2`` on unreadable/shapeless input;
+* ``0`` otherwise (including "nothing comparable" - an empty
+  intersection is reported, not failed: early trajectories legitimately
+  share no sections).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# The single gate metric of the repo (bench.py headline): higher-better
+# iters/s on the 1M-unknown 2D Poisson stencil solve.
+HEADLINE_KEY = "poisson2d_1M_stencil"
+
+#: metric name -> True when higher is better.  Anything not listed is
+#: reported in the table but never gates.
+METRIC_DIRECTION = {
+    "value": True,
+    "iters_per_sec": True,
+    "vs_baseline": True,
+    "us_per_iter": False,
+    "time_to_tol_s": False,
+    "time_to_tol_s_derived": False,
+    "elapsed_s": False,
+    "iterations": False,
+    # flight-recorder convergence columns: decay_rate is log10||r|| per
+    # iteration (MORE NEGATIVE is better -> lower-is-better);
+    # kappa_estimate is a conditioning ESTIMATE, reported but ungated
+    # (it tracks the problem, not the code).
+    "decay_rate": False,
+    "flight.decay_rate": False,
+    "kappa_estimate": None,
+    "flight.kappa_estimate": None,
+}
+
+#: metrics (besides the headline) whose per-section regression past the
+#: threshold fails the gate.  Deliberately the wall-clock/convergence
+#: ones - a slower solve or one needing more iterations to tolerance is
+#: a real regression even when the headline row survived.
+GATED_METRICS = ("time_to_tol_s", "iterations")
+
+
+def load_sections(path: str) -> dict:
+    """Normalize one results file into ``{section: {metric: value}}``."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a JSON object at top level")
+    if "metric" in data and "value" in data:
+        # single headline record (BENCH_rNN.json / bench.py stdout)
+        return {HEADLINE_KEY: data}
+    sections = {k: v for k, v in data.items()
+                if isinstance(v, dict)
+                and not k.startswith("__")
+                and not k.endswith("__done")
+                and not k.endswith("__error")}
+    if not sections:
+        raise ValueError(f"{path}: no bench sections found (empty sweep?)")
+    return sections
+
+
+def _metrics(entry: dict) -> dict:
+    """Flatten one section entry to its comparable numeric metrics
+    (one level of nesting for the ``flight`` summary)."""
+    out = {}
+    for key, val in entry.items():
+        if key == "flight" and isinstance(val, dict):
+            for fk, fv in val.items():
+                if fk in ("decay_rate", "kappa_estimate") \
+                        and isinstance(fv, (int, float)):
+                    out[f"flight.{fk}"] = float(fv)
+            continue
+        if key in METRIC_DIRECTION and isinstance(val, (int, float)) \
+                and not isinstance(val, bool):
+            out[key] = float(val)
+    return out
+
+
+def _fmt(v: float) -> str:
+    return f"{v:.6g}"
+
+
+def compare(old: dict, new: dict, threshold: float,
+            out=sys.stdout) -> int:
+    """Print the delta table; return the exit status (0 ok / 1 gate)."""
+    shared = [k for k in old if k in new]
+    only_old = sorted(set(old) - set(new))
+    only_new = sorted(set(new) - set(old))
+    failures = []
+
+    rows = []
+    for section in shared:
+        m_old, m_new = _metrics(old[section]), _metrics(new[section])
+        for name in (k for k in m_old if k in m_new):
+            a, b = m_old[name], m_new[name]
+            delta = None if a == 0 else (b - a) / abs(a)
+            rows.append((section, name, a, b, delta))
+            higher_better = METRIC_DIRECTION.get(
+                name, METRIC_DIRECTION.get(name.split(".", 1)[-1]))
+            if higher_better is None or delta is None:
+                continue
+            regressed = (delta < -threshold if higher_better
+                         else delta > threshold)
+            gate = (section == HEADLINE_KEY and name == "value") \
+                or name in GATED_METRICS
+            if regressed and gate:
+                failures.append(
+                    f"{section}.{name}: {_fmt(a)} -> {_fmt(b)} "
+                    f"({delta:+.1%}, threshold {threshold:.0%})")
+        # convergence flip: a section that stopped converging is a
+        # regression no throughput number can buy back
+        if old[section].get("converged") is True \
+                and new[section].get("converged") is False:
+            failures.append(f"{section}: converged true -> false")
+        cls_old = (old[section].get("flight") or {}).get("classification")
+        cls_new = (new[section].get("flight") or {}).get("classification")
+        if cls_old == "CONVERGED" and cls_new not in (None, "CONVERGED"):
+            failures.append(f"{section}: solve health CONVERGED -> "
+                            f"{cls_new}")
+
+    if rows:
+        w_sec = max(len(r[0]) for r in rows)
+        w_met = max(len(r[1]) for r in rows)
+        print(f"{'section':<{w_sec}}  {'metric':<{w_met}}  "
+              f"{'old':>12}  {'new':>12}  {'delta':>8}", file=out)
+        for section, name, a, b, delta in rows:
+            d = "n/a" if delta is None else f"{delta:+.1%}"
+            print(f"{section:<{w_sec}}  {name:<{w_met}}  "
+                  f"{_fmt(a):>12}  {_fmt(b):>12}  {d:>8}", file=out)
+    else:
+        print("no comparable metrics in shared sections", file=out)
+    if only_old:
+        print(f"only in OLD: {', '.join(only_old)}", file=out)
+    if only_new:
+        print(f"only in NEW: {', '.join(only_new)}", file=out)
+
+    if failures:
+        print("\nREGRESSIONS:", file=out)
+        for f in failures:
+            print(f"  {f}", file=out)
+        return 1
+    print("\nno gated regressions", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two bench_results/BENCH_*.json files and exit "
+                    "nonzero on a gated regression")
+    ap.add_argument("old", help="baseline results file")
+    ap.add_argument("new", help="candidate results file")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative regression that fails the gate "
+                         "(default 0.10 = 10%%)")
+    args = ap.parse_args(argv)
+    if not 0.0 < args.threshold < 10.0:
+        print(f"error: implausible --threshold {args.threshold}",
+              file=sys.stderr)
+        return 2
+    try:
+        old = load_sections(args.old)
+        new = load_sections(args.new)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return compare(old, new, args.threshold)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,17 @@ python -m cuda_mpi_parallel_tpu.analysis --select GL105 --fail-on info \
     cuda_mpi_parallel_tpu/telemetry
 echo "telemetry: GL105 clean"
 
+# The flight recorder lives INSIDE the solvers' hot loops - it is the
+# one telemetry component where a host sync would be catastrophic, so
+# its modules are named explicitly (the directory gate above would
+# also catch them, but this line keeps the contract visible and
+# survives any future --ignore on the directory run).
+echo "== graftlint flight recorder (GL105, zero findings) =="
+python -m cuda_mpi_parallel_tpu.analysis --select GL105 --fail-on info \
+    cuda_mpi_parallel_tpu/telemetry/flight.py \
+    cuda_mpi_parallel_tpu/telemetry/health.py
+echo "flight recorder: GL105 clean"
+
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
@@ -28,9 +39,25 @@ fi
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
-    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -m 'not slow' --durations=25 --continue-on-collection-errors \
+    -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)"
+
+# Duration audit: any test in the tier-1 (not-slow) selection that runs
+# longer than 120 s belongs behind pytest.mark.slow - unmarked, it eats
+# the 870 s budget and silently shrinks DOTS_PASSED for every later
+# test (the PR-2 lesson: df64-dist tests at minutes each dropped the
+# gate from 302 to 185 passes).  Parsed from the --durations report.
+echo "== tier-1 duration audit (unmarked test > 120 s fails) =="
+overlong=$(grep -aE '^[0-9]+\.[0-9]+s (call|setup|teardown)' /tmp/_t1.log \
+    | awk '$1 + 0 > 120 { print }' || true)
+if [[ -n "$overlong" ]]; then
+    echo "duration audit FAILED - mark these pytest.mark.slow:"
+    echo "$overlong"
+    exit 1
+fi
+echo "duration audit: clean"
 exit "$rc"
