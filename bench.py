@@ -385,6 +385,55 @@ def _flight_summary(res) -> dict | None:
     return sanitize(out)
 
 
+def _efficiency_entry(op, entry, method="cg", itemsize=4):
+    """Roofline columns for a throughput row: achieved-vs-peak
+    efficiency %, arithmetic intensity and the bound classification
+    (telemetry.roofline), computed from the row's measured per-
+    iteration rate against the backend machine model.  Consumed by
+    tools/bench_compare.py (reported, never gated - efficiency tracks
+    tunnel weather as much as code).  Telemetry must never sink a
+    bench run: any failure lands as an ``error`` note in the row."""
+    try:
+        from cuda_mpi_parallel_tpu.telemetry import roofline as _roof
+        from cuda_mpi_parallel_tpu.utils.logging import sanitize
+
+        rate = entry.get("iters_per_sec") or entry.get("value")
+        if not rate or float(rate) <= 0:
+            return entry
+        r = _roof.analyze(
+            n=int(op.shape[0]), nnz=_roof.operator_nnz(op),
+            itemsize=itemsize, iterations=1,
+            elapsed_s=1.0 / float(rate), method=method)
+        entry["roofline"] = sanitize({
+            "efficiency_pct": round(r.efficiency_pct, 2),
+            "bound": r.bound,
+            "arithmetic_intensity": round(r.arithmetic_intensity, 4),
+            "model": r.model.name,
+            "model_source": r.model.source,
+        })
+    except Exception as e:  # pragma: no cover - defensive
+        entry["roofline"] = {"error": str(e)[-200:]}
+    return entry
+
+
+def _imbalance_entry(entry, local_grid, n_shards, itemsize=4,
+                     points=7, kind="stencil3d"):
+    """Static per-shard skew columns for a distributed row
+    (telemetry.shardscope): the max/mean stall factors a psum-
+    synchronized loop pays.  Same never-sink-the-run contract as
+    ``_efficiency_entry``."""
+    try:
+        from cuda_mpi_parallel_tpu.telemetry import shardscope as _ss
+        from cuda_mpi_parallel_tpu.utils.logging import sanitize
+
+        rep = _ss.report_stencil(local_grid, n_shards, itemsize,
+                                 points=points, kind=kind)
+        entry["imbalance"] = sanitize(rep.imbalance())
+    except Exception as e:  # pragma: no cover - defensive
+        entry["imbalance"] = {"error": str(e)[-200:]}
+    return entry
+
+
 def _convergence_entry(res) -> dict:
     """``iterations``/``converged`` (+ flight summary when recorded) -
     the per-section convergence record bench_compare gates on."""
@@ -465,7 +514,7 @@ def bench_headline(device=None):
         "engine": "resident" if use_resident else "general_whileloop",
     }
     entry.update(_convergence_entry(probe))
-    return entry
+    return _efficiency_entry(op, entry)
 
 
 # The order --all RUNS sections in - most valuable first, so a short or
@@ -598,8 +647,8 @@ def bench_all(results, sections=None) -> None:
     def s_whileloop():
         op = poisson.poisson_2d_operator(HEADLINE_GRID, HEADLINE_GRID,
                                          dtype=jnp.float32)
-        results["poisson2d_1M_stencil_whileloop"] = iter_delta(
-            op, rhs_1m(), 100, 10100, repeats=5)
+        results["poisson2d_1M_stencil_whileloop"] = _efficiency_entry(
+            op, iter_delta(op, rhs_1m(), 100, 10100, repeats=5))
 
     registry.append(("poisson2d_1M_stencil_whileloop", s_whileloop))
 
@@ -999,8 +1048,8 @@ def bench_all(results, sections=None) -> None:
         a256 = Stencil3D.create(256, 256, 256, dtype=jnp.float32)
         b256 = jnp.asarray(
             rng.standard_normal(a256.shape[0]).astype(np.float32))
-        results["poisson3d_256_stencil"] = iter_delta(a256, b256, 32, 544,
-                                                      repeats=3)
+        results["poisson3d_256_stencil"] = _efficiency_entry(
+            a256, iter_delta(a256, b256, 32, 544, repeats=3))
 
         # The fused-iteration HBM-streaming engine on the same problem:
         # 8 plane-passes/iter vs the general solver's ~16 (the round-4
@@ -1161,6 +1210,9 @@ def bench_all(results, sections=None) -> None:
                 entry["note"] = ("single-device degenerate path: "
                                  "collectives are no-ops; not a "
                                  "multi-chip scaling measurement")
+            _efficiency_entry(a3, entry)
+            _imbalance_entry(entry, (grid[0] // ndev, grid[1], grid[2]),
+                             ndev)
             results[f"poisson3d_{grid[0]}x{grid[1]}x{grid[2]}"
                     f"_mesh{ndev}"] = entry
         if ndev >= 4 and ndev % 2 == 0:

@@ -178,6 +178,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "(solve_start/engine_selected/comm_cost/"
                         "solve_end, one JSON object per line) to PATH "
                         "- see README 'Observability' for the schema")
+    p.add_argument("--report", nargs="?", const="-", default=None,
+                   metavar="PATH", dest="report",
+                   help="after the solve, emit the unified solve report "
+                        "(telemetry.report): status/timing, the "
+                        "per-shard rows/nnz/halo-bytes table with "
+                        "imbalance factors (--mesh > 1), the roofline "
+                        "efficiency verdict, communication totals and "
+                        "solve health.  PATH writes the text report to "
+                        "a file; bare --report (or '-') prints it; "
+                        "with --json the report also rides the record "
+                        "as 'solve_report'")
+    p.add_argument("--trace-perfetto", default=None, metavar="PATH",
+                   dest="trace_perfetto",
+                   help="write a Chrome-trace/Perfetto JSON timeline of "
+                        "the solve to PATH (chrome://tracing or "
+                        "ui.perfetto.dev loads it): one track per "
+                        "shard drawing halo/spmv/reduction phases "
+                        "from the static shard accounting scaled to "
+                        "measured wall time, one track for host timer "
+                        "sections, one residual counter track when "
+                        "--flight-record is on")
     p.add_argument("--metrics", action="store_true",
                    help="report the process metrics registry after the "
                         "solve (Prometheus text; embedded as a "
@@ -280,12 +301,16 @@ def main(argv=None) -> int:
         # must run BEFORE the first backend touch (jax reads XLA_FLAGS
         # at client creation)
         _ensure_virtual_devices(args.mesh)
-    if args.trace_events or args.metrics:
+    if args.trace_events or args.metrics or args.report is not None \
+            or args.trace_perfetto:
         from . import telemetry
 
         if args.trace_events:
             telemetry.configure(args.trace_events)
-        if args.metrics:
+        if args.metrics or args.report is not None \
+                or args.trace_perfetto:
+            # the report/timeline consume the build-time cost walk and
+            # the partition-time shard accounting - opt into both
             telemetry.force_active(True)
     if args.precond_degree < 1:
         raise SystemExit(
@@ -750,8 +775,10 @@ def main(argv=None) -> int:
         # distributed engines bypass dist_cg's cache, so a stale value
         # from an earlier solve in this process must not leak in
         from .parallel.dist_cg import reset_last_comm_cost
+        from .telemetry.shardscope import reset_last_shard_report
 
         reset_last_comm_cost()
+        reset_last_shard_report()
 
     # time_fn dispatches twice (compile warmup + timed); both really
     # happen, so both emit - the warmup's events labeled phase=warmup
@@ -770,7 +797,8 @@ def main(argv=None) -> int:
             desc, engine=args.engine, check_every=args.check_every,
             profile_dir=args.profile, problem=args.problem,
             method=args.method, dtype=args.dtype,
-            mesh=args.mesh) as obs:
+            mesh=args.mesh,
+            device=jax.devices()[0].platform) as obs:
         with obs.section("solve"):
             elapsed, result = time_fn(run, warmup=1, repeats=1)
 
@@ -874,6 +902,52 @@ def main(argv=None) -> int:
 
         record["metrics"] = REGISTRY.snapshot()
 
+    # The unified solve report + Perfetto timeline (telemetry.report):
+    # all host-side fusion of already-synced aggregates - the solve
+    # itself is untouched (TestZeroPerturbation covers this path).
+    solve_report = None
+    if args.report is not None or args.trace_perfetto:
+        from .telemetry import report as treport
+        from .telemetry import roofline as troofline
+        from .telemetry.shardscope import last_shard_report
+
+        shard_rep = last_shard_report() if args.mesh > 1 else None
+        comm_bpi = (comm["per_iteration"]["comm_bytes"]
+                    if comm is not None else 0.0)
+        itemsize = {"float64": 8, "df64": 8, "bfloat16": 2}.get(
+            args.dtype, 4)
+        roof = troofline.analyze(
+            n=int(a.shape[0]), nnz=troofline.operator_nnz(a),
+            itemsize=itemsize, iterations=int(result.iterations),
+            elapsed_s=float(elapsed), method=args.method,
+            preconditioned=args.precond is not None,
+            precond_matvecs=(args.precond_degree - 1
+                             if args.precond == "chebyshev" else 0),
+            comm_bytes_per_iteration=comm_bpi)
+        solve_report = treport.SolveReport(
+            record=record, shard=shard_rep, roofline=roof,
+            flight_summary=record.get("flight"),
+            health=record.get("health"),
+            comm=comm, sections=tuple(obs.timer.sections))
+        if args.report is not None and args.report != "-":
+            with open(args.report, "w", encoding="utf-8") as f:
+                f.write(solve_report.to_text())
+        if args.json and args.report is not None:
+            record["solve_report"] = solve_report.to_json()
+        if args.trace_perfetto:
+            hist = None
+            if flight_rec is not None:
+                hist = flight_rec.to_history(args.maxiter)
+            elif result.residual_history is not None:
+                hist = result.residual_history
+            trace = treport.perfetto_trace(
+                iterations=int(result.iterations),
+                elapsed_s=float(elapsed), shard=shard_rep,
+                n_shards=args.mesh,
+                sections=tuple(obs.timer.sections),
+                flight_history=hist, label=desc)
+            treport.write_perfetto(args.trace_perfetto, trace)
+
     if args.json:
         ulog.emit_json(record)
     else:
@@ -932,6 +1006,9 @@ def main(argv=None) -> int:
 
             print("--- metrics (prometheus text) ---")
             print(REGISTRY.to_prometheus(), end="")
+        if solve_report is not None and args.report == "-":
+            print()
+            print(solve_report.to_text(), end="")
     return 0 if bool(result.converged) else 1
 
 

@@ -327,6 +327,14 @@ def solve_distributed_df64(
             method=method, flight=flight)
     local = DistStencilDF64.create(a.grid, n_shards, axis_name=axis,
                                    scale=a.scale)
+    # per-shard accounting (telemetry.shardscope): df64 halos carry the
+    # stacked (hi, lo) planes - 8 bytes per boundary point
+    from .dist_cg import _note_shards
+
+    two_d = isinstance(a, Stencil2D)
+    _note_shards(lambda ss: ss.report_stencil(
+        local.local_grid, n_shards, 8, points=5 if two_d else 7,
+        kind="stencil2d-df64" if two_d else "stencil3d-df64"))
     mg_flag = preconditioner == "mg"
     local32 = None
     if mg_flag:
@@ -496,6 +504,9 @@ def _solve_csr_shiftell_df64(a, b64, mesh, axis, n_shards, *, tol, rtol,
     (``CUDA_R_64F``, ``CUDACG.cu:216,288``) over the repo name's
     promised multi-device tier."""
     parts = part.ring_partition_shiftell_df64(a, n_shards)
+    from .dist_cg import _note_shards
+
+    _note_shards(lambda ss: ss.shard_report(a, parts))
     b_pad = part.pad_vector(b64, parts.n_global_padded)
     bh_np, bl_np = df.split_f64(b_pad)
     bh = shard_vector(jnp.asarray(bh_np), mesh, axis)

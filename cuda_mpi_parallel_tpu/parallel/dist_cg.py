@@ -310,6 +310,21 @@ def _cached_solver(key, build, cost_ctx=None, cost_args=None):
     return fn
 
 
+def _note_shards(build_report) -> None:
+    """Per-shard partition accounting (telemetry.shardscope), computed
+    only when a telemetry consumer is attached - the partition path of
+    an untelemetered solve is untouched.  ``build_report`` is a
+    callable taking the shardscope module and returning the
+    ShardReport (the accounting itself is host numpy over the
+    just-built partition arrays)."""
+    from .. import telemetry
+
+    if not telemetry.active():
+        return
+    telemetry.shardscope.note_report(
+        build_report(telemetry.shardscope))
+
+
 def _make_precond(precond, local, axis):
     """Build the preconditioner INSIDE the shard_map body: reductions in
     the spectral estimate and applications psum over ``axis`` (a mesh
@@ -387,6 +402,11 @@ def _solve_stencil(a, b, mesh, axis, n_shards, precond, record_history,
         local = DistStencil3D.create(a.grid, n_shards, axis_name=axis,
                                      scale=a.scale, dtype=a.dtype,
                                      backend=a.backend)
+    two_d = isinstance(a, Stencil2D)
+    _note_shards(lambda ss: ss.report_stencil(
+        local.local_grid, n_shards, jnp.dtype(a.dtype).itemsize,
+        points=5 if two_d else 7,
+        kind="stencil2d" if two_d else "stencil3d"))
 
     b = shard_vector(jnp.asarray(b, a.dtype), mesh, axis)
     key = ("stencil", type(local).__name__, local.local_grid,
@@ -436,6 +456,7 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
     ring = csr_comm == "ring"
     parts = (part.ring_partition_csr(a, n_shards) if ring
              else part.partition_csr(a, n_shards))
+    _note_shards(lambda ss: ss.shard_report(a, parts))
     b_dev = _shard_padded_rhs(b, parts, mesh, axis)
     data = _shard_tree(parts.data, mesh, axis)  # array, or per-step tuple
     cols = _shard_tree(parts.cols, mesh, axis)
@@ -474,6 +495,7 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
                         record_history, kw) -> CGResult:
     """Ring schedule with pallas shift-ELL slabs (``DistShiftELLRing``)."""
     parts = part.ring_partition_shiftell(a, n_shards)
+    _note_shards(lambda ss: ss.shard_report(a, parts))
     b_dev = _shard_padded_rhs(b, parts, mesh, axis)
     vals = _shard_tree(parts.vals, mesh, axis)  # per-step (n_shards, C, ..)
     meta = _shard_tree(parts.lane_idx, mesh, axis)

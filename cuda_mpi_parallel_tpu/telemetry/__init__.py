@@ -28,7 +28,18 @@ that gap with four composable parts:
 * :mod:`.health` - solve-health diagnostics over the flight record:
   CG-Lanczos Ritz/condition estimates and stagnation / plateau /
   divergence classification, emitted as ``solve_health`` events and
-  decay-rate / kappa gauges.
+  decay-rate / kappa gauges;
+* :mod:`.shardscope` - static per-shard load/imbalance accounting
+  computed at partition time (rows, nnz, padding overhead, halo bytes
+  per neighbor), emitted as ``shard_profile`` events and
+  ``shard="k"``-labeled gauges;
+* :mod:`.roofline` - the analytic machine model (table-sourced TPU
+  numbers, self-calibrated CPU) joined with measured wall time:
+  achieved-vs-peak efficiency %, arithmetic intensity, memory- vs
+  comm-bound classification;
+* :mod:`.report` - the fusion layer: one human-readable solve report
+  (text + JSON) over all of the above, and the Chrome-trace/Perfetto
+  timeline exporter (one track per shard, one for host phases).
 
 Everything is opt-in: with no event sink configured and metrics
 untouched, every instrumentation hook in the solver/parallel layers is
@@ -37,12 +48,25 @@ either way (asserted by tests/test_cost_accounting.py).
 """
 from __future__ import annotations
 
-from . import cost, events, flight, health, registry, session
+from . import (
+    cost,
+    events,
+    flight,
+    health,
+    registry,
+    report,
+    roofline,
+    session,
+    shardscope,
+)
 from .events import EventStream, configure, emit, validate_event
 from .flight import FlightConfig, FlightRecord
 from .health import SolveHealth, assess_solve_health
 from .registry import REGISTRY, MetricsRegistry
+from .report import SolveReport, perfetto_trace, validate_perfetto
+from .roofline import MachineModel, RooflineReport
 from .session import observe_solve
+from .shardscope import ShardReport, shard_report
 
 
 #: set by force_active(): opts into the build-time cost accounting even
@@ -70,9 +94,13 @@ __all__ = [
     "EventStream",
     "FlightConfig",
     "FlightRecord",
+    "MachineModel",
     "MetricsRegistry",
     "REGISTRY",
+    "RooflineReport",
+    "ShardReport",
     "SolveHealth",
+    "SolveReport",
     "active",
     "assess_solve_health",
     "configure",
@@ -82,7 +110,13 @@ __all__ = [
     "flight",
     "health",
     "observe_solve",
+    "perfetto_trace",
     "registry",
+    "report",
+    "roofline",
     "session",
+    "shard_report",
+    "shardscope",
     "validate_event",
+    "validate_perfetto",
 ]

@@ -53,6 +53,7 @@ __all__ = [
     "current_solve_id",
     "emit",
     "new_solve_id",
+    "read_events",
     "scoped",
     "solve_scope",
     "validate_event",
@@ -76,6 +77,10 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     # jaxpr-derived communication cost of the compiled solve body
     "comm_cost": ("psum_per_iteration", "ppermute_per_iteration",
                   "comm_bytes_per_iteration"),
+    # static per-shard load/communication accounting computed at
+    # partition time (telemetry.shardscope.ShardReport.to_json payload)
+    "shard_profile": ("kind", "n_shards", "rows", "nnz",
+                      "halo_send_bytes"),
     # sampled in-flight heartbeat (FlightConfig.heartbeat > 0 only;
     # posted from the hot loop via an unordered jax.debug.callback)
     "flight_heartbeat": ("iteration",),
@@ -261,6 +266,32 @@ def validate_event(record: Dict[str, Any]) -> Dict[str, Any]:
                          f"{record['t']!r}")
     json.dumps(record, allow_nan=False)   # strict-JSON payload check
     return record
+
+
+def read_events(path: str) -> list:
+    """Parse and schema-validate a solve-trace JSONL file.
+
+    The single reader every consumer of ``--trace-events`` output goes
+    through (tools/solve_report.py, tools/validate_trace.py), so "which
+    traces are acceptable" has one definition.  Blank lines are
+    skipped; any other violation raises ``ValueError`` naming
+    ``path:lineno``.  An event-free file is an error - for a trace
+    consumer there is nothing to do, and for the CI gate silence means
+    the instrumentation broke.
+    """
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(validate_event(json.loads(line)))
+            except (ValueError, json.JSONDecodeError) as e:
+                raise ValueError(f"{path}:{lineno}: {e}") from e
+    if not out:
+        raise ValueError(f"{path}: no events")
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -45,8 +45,16 @@ def _format_labels(labelnames: Sequence[str], key: Tuple,
         pairs.append(extra)
     if not pairs:
         return ""
+    # Exposition-format label escaping: backslash FIRST (later rules
+    # insert backslashes), then double-quote and newline - the three
+    # characters the Prometheus text format requires escaped inside
+    # label values.  An unescaped newline splits the sample line in
+    # two and poisons the whole scrape.
     body = ",".join(
-        '{}="{}"'.format(n, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        '{}="{}"'.format(
+            n,
+            str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
         for n, v in pairs)
     return "{" + body + "}"
 

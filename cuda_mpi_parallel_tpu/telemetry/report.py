@@ -1,0 +1,343 @@
+"""The unified solve report + Perfetto timeline exporter.
+
+PRs 2-4 produce four telemetry streams for one solve - the JSONL event
+trace, the flight record / health verdict, the per-shard profile
+(:mod:`.shardscope`) and the roofline join (:mod:`.roofline`).  This
+module fuses them into the two artifacts a human actually opens:
+
+* :class:`SolveReport` - one text (or JSON) report answering "what
+  ran, how fast, which shard is the straggler, how far from the
+  hardware" in a screenful;
+* :func:`perfetto_trace` - a Chrome-trace/Perfetto JSON timeline
+  (``chrome://tracing`` / https://ui.perfetto.dev load it directly):
+  one track per shard drawing the halo / spmv / reduction phases of
+  each iteration **from the static schedule** (per-shard durations
+  proportional to the shard's accounted work, the whole iteration slot
+  scaled to the measured per-iteration wall time - so the straggler
+  shard visibly fills its slot while balanced shards show reduction
+  wait), plus one track for the host-side ``Timer`` sections and a
+  residual counter track from the flight record.
+
+The timeline is a *model rendering* of measured aggregates, not a
+device profile (that is ``--profile``'s ``jax.profiler`` job); its
+value is that it exists for every backend - including CPU CI - and
+shows skew at a glance.  :func:`validate_perfetto` is the structural
+contract both the tests and ``tools/validate_trace.py`` enforce:
+loadable event array, ``ph``/``ts``/``pid``/``tid`` on every event,
+monotone ``ts`` per track.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import sanitize
+
+__all__ = [
+    "SolveReport",
+    "perfetto_trace",
+    "validate_perfetto",
+    "write_perfetto",
+]
+
+#: iterations drawn in the timeline: enough to see the steady-state
+#: pattern, bounded so a 30k-iteration solve does not emit a 100 MB
+#: trace.  When a solve runs longer, the drawn window is the FIRST
+#: ``MAX_DRAWN_ITERATIONS`` and the truncation is recorded in the
+#: trace metadata (no silent caps).
+MAX_DRAWN_ITERATIONS = 64
+
+_HOST_PID = 0
+_SHARD_PID = 1
+_COUNTER_PID = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class SolveReport:
+    """Everything known about one finished solve, fused.
+
+    All fields are optional except the record: the report renders
+    whatever subset exists (a single-device solve has no shard
+    profile; an engine without the recorder has no flight section).
+    """
+
+    record: Dict[str, Any]                  # utils.logging.solve_record
+    shard: Optional[object] = None          # shardscope.ShardReport
+    roofline: Optional[object] = None       # roofline.RooflineReport
+    flight_summary: Optional[dict] = None   # FlightRecord.summary()
+    health: Optional[dict] = None           # SolveHealth.to_json()
+    comm: Optional[dict] = None             # CLI per-solve comm account
+    sections: Sequence[Tuple[str, float]] = ()
+
+    def to_json(self) -> dict:
+        out: Dict[str, Any] = {"record": dict(self.record)}
+        if self.shard is not None:
+            out["shard_profile"] = self.shard.to_json()
+        if self.roofline is not None:
+            out["roofline"] = self.roofline.to_json()
+        if self.flight_summary is not None:
+            out["flight"] = dict(self.flight_summary)
+        if self.health is not None:
+            out["health"] = dict(self.health)
+        if self.comm is not None:
+            out["comm"] = dict(self.comm)
+        if self.sections:
+            out["sections"] = {name: s for name, s in self.sections}
+        return sanitize(out)
+
+    def to_text(self) -> str:
+        rec = self.record
+        lines: List[str] = []
+        lines.append(f"== solve report: {rec.get('problem', '?')} ==")
+        rnorm = rec.get("residual_norm")
+        rnorm_s = f"{rnorm:.6e}" if isinstance(rnorm, (int, float)) \
+            else "n/a"
+        lines.append(
+            f"status {rec.get('status', '?')}  "
+            f"iterations {rec.get('iterations', '?')}  "
+            f"||r|| {rnorm_s}")
+        if rec.get("elapsed_s") is not None:
+            lines.append(
+                f"time {rec['elapsed_s'] * 1e3:.3f} ms  "
+                f"({rec.get('iters_per_sec', 0.0):.1f} iters/s)  "
+                f"device {rec.get('device', '?')} "
+                f"mesh={rec.get('mesh', 1)} dtype={rec.get('dtype', '?')}")
+        if self.shard is not None:
+            lines.append("")
+            lines.append(f"-- per-shard profile ({self.shard.kind}) --")
+            lines.append(self.shard.table())
+        if self.comm is not None:
+            lines.append("")
+            lines.append(
+                f"-- communication (jaxpr-derived, per device) --")
+            lines.append(
+                f"{self.comm.get('psum', 0)} psum, "
+                f"{self.comm.get('ppermute', 0)} ppermute, "
+                f"{self.comm.get('all_gather', 0)} all_gather, "
+                f"{self.comm.get('comm_bytes', 0)} payload bytes total")
+            if self.comm.get("note"):
+                lines.append(f"({self.comm['note']})")
+        if self.roofline is not None:
+            r = self.roofline
+            lines.append("")
+            lines.append(f"-- roofline ({r.model.name}, {r.model.source}) "
+                         f"--")
+            lines.append(
+                f"per-iteration model: {r.flops_per_iteration:.3g} flops, "
+                f"{r.mem_bytes_per_iteration:.3g} mem B, "
+                f"{r.comm_bytes_per_iteration:.3g} comm B "
+                f"(intensity {r.arithmetic_intensity:.3f} flop/B)")
+            lines.append(
+                f"bound terms: mem {r.t_mem_s * 1e6:.3g} us, compute "
+                f"{r.t_flop_s * 1e6:.3g} us, comm "
+                f"{r.t_comm_s * 1e6:.3g} us -> {r.bound}-bound")
+            lines.append(
+                f"efficiency: {r.efficiency_pct:.1f}% of roofline "
+                f"({r.model_s_per_iteration * 1e6:.3g} us model vs "
+                f"{r.measured_s_per_iteration * 1e6:.3g} us measured "
+                f"per iteration)")
+        if self.health is not None:
+            lines.append("")
+            lines.append(f"-- solve health --")
+            lines.append(
+                f"{self.health.get('classification', '?')}: "
+                f"{self.health.get('message', '')}")
+        if self.flight_summary is not None:
+            f = self.flight_summary
+            lines.append(
+                f"flight: {f.get('n_records')} records @ stride "
+                f"{f.get('stride')}, decay rate {f.get('decay_rate')}")
+        if self.sections:
+            lines.append("")
+            lines.append("-- host timer sections --")
+            for name, sec in self.sections:
+                lines.append(f"  {name:>12}: {sec * 1e3:9.3f} ms")
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome-trace export
+
+def _meta(pid: int, tid: int, name: str, value: str) -> dict:
+    # metadata events carry ts=0 so the structural contract (every
+    # event has ph/ts/pid/tid) holds for them too
+    return {"ph": "M", "ts": 0, "pid": pid, "tid": tid, "name": name,
+            "args": {"name": value}}
+
+
+def _x(pid: int, tid: int, name: str, ts: float, dur: float,
+       **args: Any) -> dict:
+    ev = {"ph": "X", "ts": round(float(ts), 3),
+          "dur": round(max(float(dur), 0.001), 3),
+          "pid": pid, "tid": tid, "name": name}
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _shard_phase_weights(shard, k: int) -> Tuple[float, float, float]:
+    """Model 'seconds' (arbitrary units) of one iteration's halo /
+    spmv / reduction phases on shard ``k``, from the static per-shard
+    accounting: halo time ~ payload bytes, spmv time ~ live entries
+    plus padding slots (padding multiplies like real work), reduction
+    a small fixed cost.  Only RATIOS matter - the iteration slot is
+    rescaled to measured wall time."""
+    halo = float(shard.halo_send_bytes[k] + shard.halo_recv_bytes[k])
+    spmv = float(shard.slots[k]) * 12.0   # ~bytes per slot (val+idx)
+    red = 0.02 * float(shard.slots.max()) * 12.0 + 1.0
+    return halo, spmv, red
+
+
+def perfetto_trace(*, iterations: int, elapsed_s: float,
+                   shard=None, n_shards: Optional[int] = None,
+                   sections: Sequence[Tuple[str, float]] = (),
+                   flight_history: Optional[np.ndarray] = None,
+                   label: str = "solve") -> dict:
+    """Build the Chrome-trace JSON dict (see module docstring).
+
+    ``iterations``/``elapsed_s``: the measured solve.  ``shard``: a
+    ``shardscope.ShardReport`` (its per-shard work sizes the phase
+    durations); without one, ``n_shards`` uniform tracks are drawn.
+    ``sections``: host ``Timer.sections``.  ``flight_history``: a
+    ``(maxiter + 1,)`` ||r|| array (``FlightRecord.to_history``) drawn
+    as a counter track.  Timestamps are microseconds (the trace-event
+    convention).
+    """
+    events: List[dict] = []
+    events.append(_meta(_HOST_PID, 0, "process_name", "host"))
+    events.append(_meta(_SHARD_PID, 0, "process_name",
+                        f"shards ({label})"))
+
+    # host timer sections, laid back-to-back (the Timer records
+    # durations, not start stamps; ordering is the recording order)
+    t = 0.0
+    for name, sec in sections:
+        dur = max(float(sec), 0.0) * 1e6
+        events.append(_x(_HOST_PID, 0, name, t, dur))
+        t += dur
+
+    shards = shard.n_shards if shard is not None else (n_shards or 1)
+    its = max(int(iterations), 1)
+    drawn = min(its, MAX_DRAWN_ITERATIONS)
+    iter_us = max(float(elapsed_s), 1e-9) * 1e6 / its
+
+    weights = []
+    for k in range(shards):
+        if shard is not None:
+            weights.append(_shard_phase_weights(shard, k))
+        else:
+            weights.append((1.0, 8.0, 1.0))
+    totals = [sum(w) for w in weights]
+    scale = iter_us / max(max(totals), 1e-30)
+
+    for k in range(shards):
+        events.append(_meta(_SHARD_PID, k, "thread_name", f"shard {k}"))
+        halo_us, spmv_us, red_us = (w * scale for w in weights[k])
+        for i in range(drawn):
+            base = i * iter_us
+            ts = base
+            if halo_us > 0:
+                events.append(_x(_SHARD_PID, k, "halo", ts, halo_us,
+                                 iteration=i))
+                ts += halo_us
+            events.append(_x(_SHARD_PID, k, "spmv", ts, spmv_us,
+                             iteration=i))
+            ts += spmv_us
+            # the psum barrier: every shard's iteration ends together,
+            # so a balanced shard's "reduction" includes its wait on
+            # the straggler - that wedge IS the imbalance cost
+            events.append(_x(_SHARD_PID, k, "reduction", ts,
+                             max(base + iter_us - ts, red_us),
+                             iteration=i))
+
+    if flight_history is not None:
+        hist = np.asarray(flight_history, dtype=np.float64).reshape(-1)
+        events.append(_meta(_COUNTER_PID, 0, "process_name",
+                            "residual (flight record)"))
+        idx = np.nonzero(np.isfinite(hist))[0]
+        for i in idx:
+            # same truncation as the shard tracks: a 30k-iteration
+            # dense history must not blow the documented size cap
+            if i > drawn:
+                break
+            events.append({
+                "ph": "C", "ts": round(float(i) * iter_us, 3),
+                "pid": _COUNTER_PID, "tid": 0, "name": "log10_residual",
+                "args": {"log10_residual":
+                         float(np.log10(max(hist[i], 1e-300)))}})
+
+    trace = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "label": label,
+            "iterations": int(iterations),
+            "drawn_iterations": int(drawn),
+            "elapsed_s": float(elapsed_s),
+            "truncated": bool(its > drawn),
+            "note": "static-schedule model timeline (shardscope), not "
+                    "a device profile; per-shard phase durations are "
+                    "proportional to accounted work",
+        },
+    }
+    return sanitize(trace)
+
+
+def write_perfetto(path: str, trace: dict) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(trace, f, allow_nan=False)
+
+
+def validate_perfetto(trace) -> dict:
+    """Structural contract of an exported timeline; returns the trace.
+
+    Raises ``ValueError`` unless: ``traceEvents`` is a non-empty list
+    (a bare top-level list is also accepted - Chrome does); every
+    event carries ``ph``/``ts``/``pid``/``tid``; per ``(pid, tid)``
+    track the non-metadata timestamps are monotone non-decreasing; and
+    at least one complete (``ph == "X"``) event exists.
+    """
+    if isinstance(trace, list):
+        events = trace
+    elif isinstance(trace, dict):
+        events = trace.get("traceEvents")
+    else:
+        raise ValueError(f"perfetto trace must be an object or array, "
+                         f"got {type(trace).__name__}")
+    if not isinstance(events, list) or not events:
+        raise ValueError("perfetto trace has no traceEvents array (or "
+                         "it is empty)")
+    tracks: Dict[Tuple[Any, Any], float] = {}
+    saw_complete = False
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"traceEvents[{i}] is not an object")
+        for field in ("ph", "ts", "pid", "tid"):
+            if field not in ev:
+                raise ValueError(
+                    f"traceEvents[{i}] missing required key {field!r}")
+        if not isinstance(ev["ts"], (int, float)):
+            raise ValueError(f"traceEvents[{i}] ts is not numeric")
+        if ev["ph"] == "M":
+            continue
+        if ev["ph"] == "X":
+            saw_complete = True
+            if "dur" not in ev or not isinstance(ev["dur"], (int, float)):
+                raise ValueError(
+                    f"traceEvents[{i}] complete event missing numeric "
+                    f"'dur'")
+        key = (ev["pid"], ev["tid"])
+        prev = tracks.get(key)
+        if prev is not None and ev["ts"] < prev:
+            raise ValueError(
+                f"traceEvents[{i}] timestamp {ev['ts']} goes backwards "
+                f"on track pid={ev['pid']} tid={ev['tid']} (prev "
+                f"{prev})")
+        tracks[key] = ev["ts"]
+    if not saw_complete:
+        raise ValueError("perfetto trace contains no complete (ph='X') "
+                         "events")
+    return trace

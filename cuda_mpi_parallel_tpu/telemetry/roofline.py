@@ -1,0 +1,260 @@
+"""Analytic roofline model: achieved vs attainable, per solve.
+
+The flight recorder says how the *iterates* behaved; :mod:`.cost` says
+what the compiled program *does* per iteration.  This module closes the
+last gap - how fast the hardware could have done it.  A CG iteration
+is streaming-bound almost everywhere (BASELINE.md's whole derivation
+of the reference estimate is bytes/iteration at HBM bandwidth), so the
+classic roofline (Williams et al., CACM 2009) applies directly:
+
+* a **machine model** - peak memory bytes/s, peak FLOP/s, and (for
+  meshes) network bytes/s.  TPU-class numbers come from a static table
+  (documented approximations of v5e-class parts); CPU hosts are
+  **self-calibrated** with a tiny one-shot benchmark (a streaming
+  triad for bytes/s, a small matmul for FLOP/s - a table would be
+  meaningless across the zoo of CI hosts this repo tests on);
+* a **traffic model** - FLOPs and memory bytes per iteration from the
+  solver recurrence (``cost.analytic_solve_ops``: spmv/dot/axpy
+  counts) and the operator's nnz, plus per-iteration communication
+  payload bytes from the jaxpr-derived :class:`~.cost.SolveCost`;
+* the **join** - measured wall time from ``observe_solve``'s sections
+  against the model's per-iteration time bound, giving achieved-vs-
+  peak efficiency %, arithmetic intensity, and a bound classification
+  (memory- / compute- / communication-bound: whichever term dominates
+  the model time).
+
+Everything is host arithmetic on already-synced scalars - the solve is
+never touched (same contract as the rest of the telemetry stack).
+Efficiency can legitimately exceed 100% when the model is pessimistic
+for a given shape (e.g. a VMEM-resident solve that never streams HBM);
+the number is a *ruler*, not a grade.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+from .cost import analytic_solve_ops
+
+__all__ = [
+    "MachineModel",
+    "RooflineReport",
+    "analyze",
+    "machine_model",
+    "operator_nnz",
+    "solve_traffic",
+]
+
+#: Documented approximations for TPU-class parts (the container's
+#: target): v5e-class HBM ~819 GB/s, f32 vector/matrix mix ~2e13
+#: FLOP/s sustained, ICI ~4.5e10 B/s per link.  Good to the factor the
+#: roofline needs (the bound classification and tens-of-percent
+#: efficiency), not a datasheet.
+_TPU_MODEL = dict(name="tpu-v5e-class", mem_bytes_per_s=8.19e11,
+                  flops_per_s=2.0e13, net_bytes_per_s=4.5e10,
+                  source="table")
+
+#: Conservative fallback when the backend is unknown and calibration
+#: is disabled - close to a modest server core.
+_GENERIC_MODEL = dict(name="generic", mem_bytes_per_s=1.0e10,
+                      flops_per_s=5.0e9, net_bytes_per_s=1.0e9,
+                      source="table")
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineModel:
+    """Peak rates the roofline measures against."""
+
+    name: str
+    mem_bytes_per_s: float
+    flops_per_s: float
+    net_bytes_per_s: Optional[float] = None
+    source: str = "table"          # "table" | "calibrated"
+
+    @property
+    def ridge_flops_per_byte(self) -> float:
+        """Arithmetic intensity where compute overtakes memory."""
+        return self.flops_per_s / self.mem_bytes_per_s
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _calibrate_cpu() -> MachineModel:
+    """One-shot CPU self-benchmark: a streaming triad (3 arrays x 8 MB,
+    well past L2 on anything this runs on) for bytes/s and a small f64
+    matmul for FLOP/s.  Best-of-3, ~tens of ms total - cheap enough to
+    run once per process, honest enough to rank against (a static table
+    would be fiction across CI hosts)."""
+    n = 2_000_000
+    a = np.ones(n, dtype=np.float32)
+    b = np.ones(n, dtype=np.float32)
+    out = np.empty(n, dtype=np.float32)
+    tri_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.multiply(a, 1.5, out=out)
+        out += b
+        tri_times.append(time.perf_counter() - t0)
+    # triad traffic: read a, read b, write out (write-allocate ignored)
+    mem_bps = 3 * n * 4 / max(min(tri_times), 1e-9)
+
+    m = 384
+    x = np.ones((m, m))
+    mm_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        x @ x
+        mm_times.append(time.perf_counter() - t0)
+    flops = 2 * m ** 3 / max(min(mm_times), 1e-9)
+    # network peak on a CPU "mesh" (virtual XLA host devices) is a
+    # memcpy: model it as the measured stream bandwidth
+    return MachineModel(name="cpu-calibrated", mem_bytes_per_s=mem_bps,
+                        flops_per_s=flops, net_bytes_per_s=mem_bps,
+                        source="calibrated")
+
+
+_CACHED_CPU: list = [None]
+
+
+def machine_model(backend: Optional[str] = None) -> MachineModel:
+    """The machine model for ``backend`` (default: jax's default
+    backend).  CPU models are calibrated once per process and cached."""
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    if backend == "tpu":
+        return MachineModel(**_TPU_MODEL)
+    if backend == "cpu":
+        if _CACHED_CPU[0] is None:
+            _CACHED_CPU[0] = _calibrate_cpu()
+        return _CACHED_CPU[0]
+    return MachineModel(**_GENERIC_MODEL)
+
+
+def operator_nnz(a) -> int:
+    """Live matrix entries of an operator, for the traffic model.
+
+    Assembled formats expose ``nnz``; matrix-free stencils count their
+    stencil points per row; anything else is modeled dense."""
+    nnz = getattr(a, "nnz", None)
+    if nnz is not None and not callable(nnz):
+        return int(nnz)
+    name = type(a).__name__
+    n = int(a.shape[0])
+    if "Stencil3D" in name or "3d" in name.lower():
+        return 7 * n
+    if "Stencil2D" in name:
+        return 5 * n
+    if hasattr(a, "local_grid"):   # distributed stencils
+        return (7 if len(a.local_grid) == 3 else 5) * n
+    return n * int(a.shape[1]) if len(a.shape) > 1 else n
+
+
+def solve_traffic(n: int, nnz: int, itemsize: int, *,
+                  method: str = "cg", preconditioned: bool = False,
+                  precond_matvecs: int = 0) -> dict:
+    """Per-iteration FLOPs and memory bytes of a solver recurrence.
+
+    Built on ``cost.analytic_solve_ops``'s per-iteration op counts with
+    the standard per-op traffic: an SpMV is ``2 nnz`` FLOPs moving the
+    matrix (value + column index per entry) plus the two vectors; a dot
+    is ``2 n`` FLOPs over two read vectors; an axpy-class fused update
+    is ``2 n`` FLOPs over two reads and one write.  A model, not a
+    measurement - the jaxpr account (:mod:`.cost`) stays the source of
+    truth for *communication*; this is the arithmetic/memory side the
+    jaxpr cannot price."""
+    ops = analytic_solve_ops(method, preconditioned=preconditioned,
+                             precond_matvecs=precond_matvecs)
+    spmv_bytes = nnz * (itemsize + 4) + 2 * n * itemsize
+    dot_bytes = 2 * n * itemsize
+    axpy_bytes = 3 * n * itemsize
+    flops = (ops["spmv"] * 2 * nnz
+             + ops["dot"] * 2 * n
+             + ops["axpy"] * 2 * n)
+    mem_bytes = (ops["spmv"] * spmv_bytes
+                 + ops["dot"] * dot_bytes
+                 + ops["axpy"] * axpy_bytes)
+    return {"flops": float(flops), "mem_bytes": float(mem_bytes),
+            "ops": ops}
+
+
+@dataclasses.dataclass(frozen=True)
+class RooflineReport:
+    """One solve's roofline verdict (JSON-ready)."""
+
+    model: MachineModel
+    iterations: int
+    measured_s: float
+    flops_per_iteration: float
+    mem_bytes_per_iteration: float
+    comm_bytes_per_iteration: float
+    arithmetic_intensity: float      # FLOP per memory byte
+    t_mem_s: float                   # model per-iteration terms
+    t_flop_s: float
+    t_comm_s: float
+    model_s_per_iteration: float     # max of the three terms
+    measured_s_per_iteration: float
+    efficiency_pct: float            # model bound / measured, x100
+    bound: str                       # memory | compute | communication
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["model"] = self.model.to_json()
+        return out
+
+    def describe(self) -> str:
+        gbps = (self.mem_bytes_per_iteration
+                / max(self.measured_s_per_iteration, 1e-30)) / 1e9
+        return (f"{self.efficiency_pct:.1f}% of the "
+                f"{self.bound}-bound roofline on {self.model.name} "
+                f"({gbps:.2f} GB/s achieved vs "
+                f"{self.model.mem_bytes_per_s / 1e9:.2f} peak; "
+                f"arithmetic intensity "
+                f"{self.arithmetic_intensity:.3f} flop/B)")
+
+
+def analyze(*, n: int, nnz: int, itemsize: int, iterations: int,
+            elapsed_s: float, method: str = "cg",
+            preconditioned: bool = False, precond_matvecs: int = 0,
+            comm_bytes_per_iteration: float = 0.0,
+            model: Optional[MachineModel] = None,
+            backend: Optional[str] = None) -> RooflineReport:
+    """Join the analytic traffic model with a measured solve.
+
+    ``elapsed_s`` is the measured wall time of ``iterations``
+    iterations (``observe_solve``'s solve section / ``time_fn``);
+    ``comm_bytes_per_iteration`` comes from the jaxpr-derived
+    ``SolveCost.per_iteration.comm_bytes`` on meshes (0 on one
+    device).  Pass ``model`` explicitly for deterministic tests."""
+    if model is None:
+        model = machine_model(backend)
+    traffic = solve_traffic(n, nnz, itemsize, method=method,
+                            preconditioned=preconditioned,
+                            precond_matvecs=precond_matvecs)
+    flops, mem_bytes = traffic["flops"], traffic["mem_bytes"]
+    t_mem = mem_bytes / model.mem_bytes_per_s
+    t_flop = flops / model.flops_per_s
+    net = model.net_bytes_per_s or model.mem_bytes_per_s
+    t_comm = float(comm_bytes_per_iteration) / net
+    terms = {"memory": t_mem, "compute": t_flop, "communication": t_comm}
+    bound = max(terms, key=terms.get)
+    model_iter = max(terms.values())
+    its = max(int(iterations), 1)
+    measured_iter = max(float(elapsed_s), 1e-30) / its
+    return RooflineReport(
+        model=model, iterations=int(iterations),
+        measured_s=float(elapsed_s),
+        flops_per_iteration=flops,
+        mem_bytes_per_iteration=mem_bytes,
+        comm_bytes_per_iteration=float(comm_bytes_per_iteration),
+        arithmetic_intensity=flops / max(mem_bytes, 1e-30),
+        t_mem_s=t_mem, t_flop_s=t_flop, t_comm_s=t_comm,
+        model_s_per_iteration=model_iter,
+        measured_s_per_iteration=measured_iter,
+        efficiency_pct=100.0 * model_iter / measured_iter,
+        bound=bound)

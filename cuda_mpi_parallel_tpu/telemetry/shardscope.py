@@ -1,0 +1,399 @@
+"""Per-shard load and communication accounting at partition time.
+
+The node-aware SpMV literature (PAPERS: arXiv 1612.08060, 1112.5588)
+is unanimous about what kills row-partitioned solvers at scale: not
+total work but *skew* - one shard with fatter rows or a heavier halo
+stalls every ``psum`` for the whole mesh, every iteration.  PRs 2-3
+made the repo's telemetry per-*solve* (aggregate collective counts,
+flight-recorded convergence); this module makes it per-*shard*.
+
+Everything here is **static and host-side**: the numbers are computed
+from the partition layout the moment it is built (``numpy`` over the
+same arrays the partitioner just produced), never from device state -
+so the accounting can never perturb a compiled solve (the jaxpr-
+identity proof in tests/test_cost_accounting.py covers this layer
+too).  A :class:`ShardReport` answers, per shard ``k``:
+
+* how many real (unpadded) rows and live matrix entries it owns;
+* how many entry *slots* it was allocated (uniform-shape padding -
+  XLA needs identical local shapes, unlike ragged MPI ranks - plus
+  the shift-ELL packers' sheet geometry), i.e. wasted multiply work;
+* how many bytes it sends/receives per matvec, to which neighbor
+  (ring ``ppermute`` schedules are neighbor-resolved; ``all_gather``
+  is attributed to the mesh at large).
+
+Byte semantics match :mod:`.cost`: **payload bytes per device per
+matvec** - what the collective's input avals carry, not wire-level
+algorithm bytes (an all_gather's ring implementation may move more).
+
+Imbalance is summarized two ways, following the SpMV-skew papers:
+``max/mean`` (the stall factor: a psum waits for the heaviest shard)
+and the Gini coefficient (how concentrated the load is overall).
+
+Emission: :func:`note_report` publishes a ``shard_profile`` event and
+per-shard labeled gauges (``shard="k"``) when telemetry is active, and
+always parks the report in a module slot for the CLI's ``--report``
+(mirroring ``dist_cg.last_comm_cost``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "ShardReport",
+    "gini",
+    "last_shard_report",
+    "max_over_mean",
+    "note_report",
+    "report_partition_csr",
+    "report_ring_csr",
+    "report_ring_shiftell",
+    "report_stencil",
+    "reset_last_shard_report",
+    "shard_report",
+]
+
+
+def max_over_mean(values) -> float:
+    """The stall factor of a per-shard quantity: ``max / mean``.
+
+    1.0 is perfect balance; a psum-synchronized loop runs at the speed
+    of the max shard, so this factor IS the slowdown versus a
+    perfectly rebalanced partition.  Zero-mean (empty) inputs report
+    1.0 - nothing to stall on."""
+    arr = np.asarray(values, dtype=np.float64)
+    if arr.size == 0:
+        return 1.0
+    mean = float(arr.mean())
+    if mean == 0.0:
+        return 1.0
+    return float(arr.max()) / mean
+
+
+def gini(values) -> float:
+    """Gini coefficient of a nonnegative per-shard quantity.
+
+    0 = perfectly even, ->1 = all load on one shard.  The standard
+    mean-absolute-difference form, O(P^2) - P is a device count, never
+    large."""
+    arr = np.asarray(values, dtype=np.float64)
+    if arr.size == 0:
+        return 0.0
+    mean = float(arr.mean())
+    if mean == 0.0:
+        return 0.0
+    diff_sum = float(np.abs(arr[:, None] - arr[None, :]).sum())
+    return diff_sum / (2.0 * arr.size * arr.size * mean)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardReport:
+    """Static per-shard accounting of one partitioned operator.
+
+    ``halo_send_bytes``/``halo_recv_bytes`` are per matvec per shard
+    (payload semantics, see module docstring); multiply by the
+    method's matvecs/iteration and the solve's iteration count for
+    whole-solve volume.  ``neighbors[k]`` lists ``(peer, bytes)``
+    sends - ``peer`` is a shard index, or ``-1`` for an unattributed
+    collective (all_gather).
+    """
+
+    kind: str                     # partition family (csr-allgather, ...)
+    n_shards: int
+    n_global: int
+    n_global_padded: int
+    n_local: int                  # padded rows per shard
+    rows: np.ndarray              # (P,) real rows owned
+    nnz: np.ndarray               # (P,) live matrix entries owned
+    slots: np.ndarray             # (P,) allocated entry slots
+    halo_send_bytes: np.ndarray   # (P,) bytes sent per matvec
+    halo_recv_bytes: np.ndarray   # (P,) bytes received per matvec
+    neighbors: Tuple[Tuple[Tuple[int, int], ...], ...]
+
+    # ---- derived -----------------------------------------------------
+    def padding_overhead(self) -> np.ndarray:
+        """Per-shard wasted-slot fraction: ``(slots - nnz) / slots``.
+
+        The fraction of allocated multiply work that is padding (zero
+        entries plus synthetic unit-diagonal padding rows).  0.0 when a
+        shard has no slots at all."""
+        slots = self.slots.astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            frac = (slots - self.nnz) / slots
+        return np.where(slots > 0, frac, 0.0)
+
+    def imbalance(self) -> dict:
+        """The skew digest: max/mean + Gini for each load axis."""
+        return {
+            "rows_max_over_mean": max_over_mean(self.rows),
+            "nnz_max_over_mean": max_over_mean(self.nnz),
+            "nnz_gini": gini(self.nnz),
+            "halo_send_max_over_mean": max_over_mean(self.halo_send_bytes),
+            "halo_send_gini": gini(self.halo_send_bytes),
+            "padding_overhead_total": float(
+                (self.slots.sum() - self.nnz.sum())
+                / max(int(self.slots.sum()), 1)),
+        }
+
+    def to_json(self) -> dict:
+        return {
+            "kind": self.kind,
+            "n_shards": self.n_shards,
+            "n_global": self.n_global,
+            "n_global_padded": self.n_global_padded,
+            "n_local": self.n_local,
+            "rows": [int(v) for v in self.rows],
+            "nnz": [int(v) for v in self.nnz],
+            "slots": [int(v) for v in self.slots],
+            "halo_send_bytes": [int(v) for v in self.halo_send_bytes],
+            "halo_recv_bytes": [int(v) for v in self.halo_recv_bytes],
+            "padding_overhead": [round(float(v), 6)
+                                 for v in self.padding_overhead()],
+            "neighbors": [[[int(p), int(b)] for p, b in ns]
+                          for ns in self.neighbors],
+            "imbalance": self.imbalance(),
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "ShardReport":
+        """Rebuild from :meth:`to_json` output (what a ``shard_profile``
+        event carries - tools/solve_report.py's input)."""
+        return cls(
+            kind=str(data["kind"]), n_shards=int(data["n_shards"]),
+            n_global=int(data["n_global"]),
+            n_global_padded=int(data["n_global_padded"]),
+            n_local=int(data["n_local"]),
+            rows=np.asarray(data["rows"], dtype=np.int64),
+            nnz=np.asarray(data["nnz"], dtype=np.int64),
+            slots=np.asarray(data["slots"], dtype=np.int64),
+            halo_send_bytes=np.asarray(data["halo_send_bytes"],
+                                       dtype=np.int64),
+            halo_recv_bytes=np.asarray(data["halo_recv_bytes"],
+                                       dtype=np.int64),
+            neighbors=tuple(tuple((int(p), int(b)) for p, b in ns)
+                            for ns in data.get("neighbors", [])),
+        )
+
+    def table(self) -> str:
+        """The per-shard text table the CLI report embeds."""
+        head = (f"{'shard':>5}  {'rows':>9}  {'nnz':>11}  {'pad%':>6}  "
+                f"{'halo out B/mv':>13}  {'halo in B/mv':>12}")
+        pad = self.padding_overhead() * 100.0
+        lines = [head]
+        for k in range(self.n_shards):
+            lines.append(
+                f"{k:>5}  {int(self.rows[k]):>9}  {int(self.nnz[k]):>11}  "
+                f"{pad[k]:>6.1f}  {int(self.halo_send_bytes[k]):>13}  "
+                f"{int(self.halo_recv_bytes[k]):>12}")
+        imb = self.imbalance()
+        lines.append(
+            f"imbalance: nnz max/mean {imb['nnz_max_over_mean']:.3f} "
+            f"(gini {imb['nnz_gini']:.3f}), halo max/mean "
+            f"{imb['halo_send_max_over_mean']:.3f}, padding overhead "
+            f"{imb['padding_overhead_total'] * 100:.1f}%")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# builders (one per partition family)
+
+def _real_rows(n: int, n_local: int, n_shards: int) -> np.ndarray:
+    lo = np.arange(n_shards, dtype=np.int64) * n_local
+    hi = np.minimum(lo + n_local, n)
+    return np.maximum(hi - lo, 0)
+
+
+def _csr_shard_nnz(a, n_local: int, n_shards: int) -> np.ndarray:
+    """Exact live entries per row block, from the global indptr (the
+    partitioners' padded arrays cannot distinguish a real unit diagonal
+    from a synthetic padding-row one; the source matrix can)."""
+    indptr = np.asarray(a.indptr).astype(np.int64)
+    n = a.shape[0]
+    out = np.zeros(n_shards, dtype=np.int64)
+    for s in range(n_shards):
+        lo, hi = s * n_local, min((s + 1) * n_local, n)
+        if hi > lo:
+            out[s] = indptr[hi] - indptr[lo]
+    return out
+
+
+def _ring_halo(n_shards: int, payload: int):
+    """Ring x-rotation traffic: ``n_shards - 1`` ppermute steps per
+    matvec, each carrying ``payload`` bytes; shard ``k`` sends to
+    ``(k - 1) % P`` and receives from ``(k + 1) % P`` (the schedule in
+    ``parallel.operators.DistCSRRing``)."""
+    total = (n_shards - 1) * payload
+    send = np.full(n_shards, total, dtype=np.int64)
+    recv = send.copy()
+    neighbors = tuple(
+        (((k - 1) % n_shards, total),) if n_shards > 1 else ()
+        for k in range(n_shards))
+    return send, recv, neighbors
+
+
+def report_partition_csr(a, parts) -> ShardReport:
+    """Accounting for ``partition.partition_csr`` output (the
+    ``all_gather`` ``DistCSR`` schedule)."""
+    n_shards, n_local = parts.n_shards, parts.n_local
+    itemsize = np.asarray(parts.data).dtype.itemsize
+    nnz = _csr_shard_nnz(a, n_local, n_shards)
+    slots = np.full(n_shards, parts.data.shape[1], dtype=np.int64)
+    # all_gather payload: each shard contributes its own x block and
+    # receives every other shard's (payload semantics - see module doc)
+    send = np.full(n_shards, n_local * itemsize, dtype=np.int64)
+    recv = np.full(n_shards, (n_shards - 1) * n_local * itemsize,
+                   dtype=np.int64)
+    neighbors = tuple(((-1, int(send[k])),) if n_shards > 1 else ()
+                      for k in range(n_shards))
+    return ShardReport(
+        kind="csr-allgather", n_shards=n_shards, n_global=parts.n_global,
+        n_global_padded=parts.n_global_padded, n_local=n_local,
+        rows=_real_rows(parts.n_global, n_local, n_shards), nnz=nnz,
+        slots=slots, halo_send_bytes=send, halo_recv_bytes=recv,
+        neighbors=neighbors)
+
+
+def report_ring_csr(a, parts) -> ShardReport:
+    """Accounting for ``partition.ring_partition_csr`` output (the
+    ``ppermute`` x-rotation ``DistCSRRing`` schedule)."""
+    n_shards, n_local = parts.n_shards, parts.n_local
+    itemsize = np.asarray(parts.data[0]).dtype.itemsize
+    nnz = _csr_shard_nnz(a, n_local, n_shards)
+    slots = np.full(n_shards,
+                    sum(d.shape[1] for d in parts.data), dtype=np.int64)
+    send, recv, neighbors = _ring_halo(n_shards, n_local * itemsize)
+    return ShardReport(
+        kind="csr-ring", n_shards=n_shards, n_global=parts.n_global,
+        n_global_padded=parts.n_global_padded, n_local=n_local,
+        rows=_real_rows(parts.n_global, n_local, n_shards), nnz=nnz,
+        slots=slots, halo_send_bytes=send, halo_recv_bytes=recv,
+        neighbors=neighbors)
+
+
+def report_ring_shiftell(a, parts) -> ShardReport:
+    """Accounting for ``partition.ring_partition_shiftell`` (f32/f64)
+    AND ``ring_partition_shiftell_df64`` output.
+
+    Slot counts are the packed sheet geometry per shard: each step's
+    value planes hold ``C_t * kc * (h + 1) * 128`` slots (identical
+    across owners per step - shard_map's uniform-shape constraint).
+    The df64 packer rotates BOTH x planes in one stacked ppermute, so
+    its per-step payload doubles."""
+    n_shards, n_local = parts.n_shards, parts.n_local
+    df64 = hasattr(parts, "vals_hi")
+    vals = parts.vals_hi if df64 else parts.vals
+    per_shard_slots = sum(
+        int(np.prod(v.shape[1:])) for v in vals)
+    nnz = _csr_shard_nnz(a, n_local, n_shards)
+    slots = np.full(n_shards, per_shard_slots, dtype=np.int64)
+    payload = n_local * (8 if df64 else np.asarray(vals[0]).dtype.itemsize)
+    send, recv, neighbors = _ring_halo(n_shards, payload)
+    return ShardReport(
+        kind="ring-shiftell-df64" if df64 else "ring-shiftell",
+        n_shards=n_shards, n_global=parts.n_global,
+        n_global_padded=parts.n_global_padded, n_local=n_local,
+        rows=_real_rows(parts.n_global, n_local, n_shards), nnz=nnz,
+        slots=slots, halo_send_bytes=send, halo_recv_bytes=recv,
+        neighbors=neighbors)
+
+
+def report_stencil(local_grid, n_shards: int, itemsize: int,
+                   points: int, kind: str) -> ShardReport:
+    """Accounting for a slab-partitioned matrix-free stencil.
+
+    Rows and (implicit) entries are uniform by construction; the per-
+    shard variation is the halo - interior shards exchange one boundary
+    plane with BOTH neighbors, edge shards with one (``lax.ppermute``'s
+    fill-with-zeros edge is the Dirichlet boundary,
+    ``parallel.halo.exchange_halo``)."""
+    n_rows = int(np.prod(local_grid))
+    plane = int(np.prod(local_grid[1:])) if len(local_grid) > 1 else 1
+    plane_bytes = plane * itemsize
+    rows = np.full(n_shards, n_rows, dtype=np.int64)
+    nnz = np.full(n_shards, points * n_rows, dtype=np.int64)
+    send = np.zeros(n_shards, dtype=np.int64)
+    neighbors = []
+    for k in range(n_shards):
+        ns = []
+        if k + 1 < n_shards:   # forward shift: k's last plane -> k+1
+            ns.append((k + 1, plane_bytes))
+        if k > 0:              # backward shift: k's first plane -> k-1
+            ns.append((k - 1, plane_bytes))
+        send[k] = sum(b for _, b in ns)
+        neighbors.append(tuple(ns))
+    # the shift pairs are symmetric: bytes received == bytes sent
+    return ShardReport(
+        kind=kind, n_shards=n_shards,
+        n_global=n_rows * n_shards, n_global_padded=n_rows * n_shards,
+        n_local=n_rows, rows=rows, nnz=nnz, slots=nnz.copy(),
+        halo_send_bytes=send, halo_recv_bytes=send.copy(),
+        neighbors=tuple(neighbors))
+
+
+def shard_report(a, parts) -> ShardReport:
+    """Dispatch on the partition family (the four partitioner output
+    types in ``parallel.partition``)."""
+    from ..parallel import partition as part
+
+    if isinstance(parts, part.PartitionedCSR):
+        return report_partition_csr(a, parts)
+    if isinstance(parts, part.RingPartitionedCSR):
+        return report_ring_csr(a, parts)
+    if isinstance(parts, (part.RingPartitionedShiftELL,
+                          part.RingPartitionedShiftELLDF64)):
+        return report_ring_shiftell(a, parts)
+    raise TypeError(f"no shard accounting for {type(parts).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# emission + the CLI's pickup slot
+
+#: the most recent report noted by a partition site (None before any) -
+#: the CLI's --report reads this, same pattern as dist_cg._LAST_COMM_COST
+_LAST: list = [None]
+
+
+def last_shard_report() -> Optional[ShardReport]:
+    return _LAST[0]
+
+
+def reset_last_shard_report() -> None:
+    _LAST[0] = None
+
+
+def note_report(report: ShardReport) -> ShardReport:
+    """Publish a freshly computed report: park it for the CLI, and when
+    telemetry is active emit a ``shard_profile`` event plus per-shard
+    labeled gauges.  Host-side only; call sites gate the (cheap, but
+    not free) report computation itself on ``telemetry.active()``."""
+    from .. import telemetry
+    from .registry import REGISTRY
+
+    _LAST[0] = report
+    if not telemetry.active():
+        return report
+    imb = report.imbalance()
+    telemetry.events.emit("shard_profile", **report.to_json())
+    for gname, help_, values in (
+            ("shard_rows", "real rows owned per shard", report.rows),
+            ("shard_nnz", "live matrix entries per shard", report.nnz),
+            ("shard_halo_send_bytes",
+             "halo payload bytes sent per matvec per shard",
+             report.halo_send_bytes)):
+        g = REGISTRY.gauge(gname, help_, labelnames=("kind", "shard"))
+        for k in range(report.n_shards):
+            g.set(float(values[k]), kind=report.kind, shard=str(k))
+    REGISTRY.gauge(
+        "shard_nnz_imbalance",
+        "per-partition nnz max/mean stall factor",
+        labelnames=("kind",)).set(imb["nnz_max_over_mean"],
+                                  kind=report.kind)
+    REGISTRY.gauge(
+        "shard_halo_imbalance",
+        "per-partition halo-send max/mean stall factor",
+        labelnames=("kind",)).set(imb["halo_send_max_over_mean"],
+                                  kind=report.kind)
+    return report

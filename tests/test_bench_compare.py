@@ -166,3 +166,62 @@ def test_headline_key_matches_bench():
             and any(isinstance(t, ast.Name) and t.id == "HEADLINE_KEY"
                     for t in n.targets)]
     assert vals == [bench_compare.HEADLINE_KEY]
+
+
+class TestOldFormatDegradation:
+    """PR-4 satellite: a pre-PR-3 row (no ``flight``/``iterations``
+    columns, e.g. bench_results_r03.json) must degrade to "n/a" cells
+    plus a warning - never a KeyError traceback."""
+
+    def _run(self, tmp_path, old, new):
+        out = io.StringIO()
+        rc = bench_compare.compare(
+            bench_compare.load_sections(_write(tmp_path, "old.json", old)),
+            bench_compare.load_sections(_write(tmp_path, "new.json", new)),
+            0.10, out=out)
+        return rc, out.getvalue()
+
+    def test_old_row_missing_flight_and_iterations(self, tmp_path):
+        old = {"sec": {"iters_per_sec": 100.0, "us_per_iter": 10.0}}
+        new = {"sec": {"iters_per_sec": 101.0, "us_per_iter": 9.9,
+                       "iterations": 50, "converged": True,
+                       "flight": {"decay_rate": -0.05,
+                                  "kappa_estimate": 12.0}}}
+        rc, out = self._run(tmp_path, old, new)
+        assert rc == 0
+        # n/a cells for the columns the old format lacks, not a drop
+        assert "iterations" in out and "n/a" in out
+        assert "flight.decay_rate" in out
+        assert "warning" in out and "old-format" in out
+        # the symmetric direction (new row lost a metric) also warns
+        rc2, out2 = self._run(tmp_path, new, old)
+        assert rc2 == 0
+        assert "NEW row lacks" in out2
+
+    def test_real_pre_pr3_snapshot_never_raises(self):
+        """The actual committed old-format file: bench_results_r03.json
+        predates the flight/iterations columns entirely."""
+        root = _TOOL.parents[1]
+        old_p = root / "bench_results_r03.json"
+        new_p = root / "bench_results_r05.json"
+        if not (old_p.exists() and new_p.exists()):
+            pytest.skip("round snapshots not present")
+        out = io.StringIO()
+        rc = bench_compare.compare(
+            bench_compare.load_sections(str(old_p)),
+            bench_compare.load_sections(str(new_p)), 0.10, out=out)
+        assert rc in (0, 1)  # a gate verdict, never a traceback
+        assert "section" in out.getvalue()
+
+    def test_roofline_column_reported_not_gated(self, tmp_path):
+        old = {"sec": {"iters_per_sec": 100.0,
+                       "roofline": {"efficiency_pct": 80.0}}}
+        new = {"sec": {"iters_per_sec": 100.0,
+                       "roofline": {"efficiency_pct": 8.0}}}
+        rc, out = self._run(tmp_path, old, new)
+        assert rc == 0  # a 10x efficiency drop reports but never gates
+        assert "roofline.efficiency_pct" in out
+
+    def test_non_dict_entry_contributes_nothing(self):
+        assert bench_compare._metrics("not a dict") == {}
+        assert bench_compare._metrics({"flight": "old-string-form"}) == {}

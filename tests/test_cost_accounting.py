@@ -198,6 +198,48 @@ class TestZeroPerturbation:
         assert "7,4" in on.replace(" ", "")   # the (capacity, 4) ring
         assert "7,4" not in base.replace(" ", "")
 
+    def test_report_pipeline_jaxpr_identical(self):
+        """PR-4 acceptance: the --report/--trace-perfetto machinery is
+        post-solve host fusion - running the ENTIRE shardscope +
+        roofline + report + Perfetto pipeline (with telemetry forced
+        active, so the partition hooks fire) leaves a traced solve
+        bit-identical to one traced before any of it ran."""
+        from cuda_mpi_parallel_tpu.models import poisson
+        from cuda_mpi_parallel_tpu.parallel import partition as part
+        from cuda_mpi_parallel_tpu.telemetry import (
+            report as treport,
+            roofline as troofline,
+            shardscope as tshard,
+        )
+
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        base = self._jaxpr_single()
+        try:
+            with events.capture():
+                telemetry.force_active(True)
+                a_csr = poisson.poisson_2d_csr(16, 16)
+                rep = tshard.note_report(tshard.shard_report(
+                    a_csr, part.partition_csr(a_csr, 4)))
+                roof = troofline.analyze(
+                    n=256, nnz=int(a_csr.nnz), itemsize=4,
+                    iterations=25, elapsed_s=0.01,
+                    model=troofline.MachineModel(
+                        name="t", mem_bytes_per_s=1e9,
+                        flops_per_s=1e9, source="table"))
+                sr = treport.SolveReport(
+                    record={"problem": "probe", "status": "CONVERGED",
+                            "iterations": 25, "residual_norm": 1e-9},
+                    shard=rep, roofline=roof)
+                sr.to_text()
+                treport.validate_perfetto(treport.perfetto_trace(
+                    iterations=25, elapsed_s=0.01, shard=rep))
+                instrumented = self._jaxpr_single()
+        finally:
+            telemetry.force_active(False)
+            tshard.reset_last_shard_report()
+        assert instrumented == base
+
     @needs_mesh
     def test_flight_off_distributed_jaxpr_identical(self):
         """Same proof under shard_map: the recorder-off distributed

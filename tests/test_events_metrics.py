@@ -85,6 +85,22 @@ class TestRegistry:
         assert "g_nan NaN" in text
         assert "g_ninf -Inf" in text
 
+    def test_prometheus_label_value_escaping(self):
+        # Exposition-format spec: backslash, double-quote and newline
+        # must be escaped inside label values.  The PR-4 regression
+        # case: a label carrying '"' and '\n' must stay ONE sample
+        # line with escaped characters, not split the scrape.
+        r = MetricsRegistry()
+        r.gauge("esc", "escaping probe", ("label",)).set(
+            1.0, label='a"b\nc\\d')
+        text = r.to_prometheus()
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("esc{")]
+        assert line == ['esc{label="a\\"b\\nc\\\\d"} 1']
+        # every sample stays on its own line (the raw newline would
+        # have produced a dangling 'c\\d"} 1' fragment line)
+        assert not any(ln.startswith("c") for ln in text.splitlines())
+
     def test_histogram_bucket_mismatch_raises(self):
         r = MetricsRegistry()
         r.histogram("h_seconds", buckets=(0.1, 1.0))

@@ -1,0 +1,220 @@
+"""telemetry.shardscope: static per-shard load/imbalance accounting.
+
+Every number here is hand-computed from a deliberately skewed matrix -
+the accounting layer must report exactly the skew the partition has,
+or imbalance-driven decisions (ROADMAP: repartitioning) inherit the
+error.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import telemetry
+from cuda_mpi_parallel_tpu.models.operators import CSRMatrix
+from cuda_mpi_parallel_tpu.parallel import partition as part
+from cuda_mpi_parallel_tpu.telemetry import events
+from cuda_mpi_parallel_tpu.telemetry import shardscope as ss
+
+
+def skewed_csr(n=8, fat_row=0, dtype=np.float32):
+    """n x n CSR: one dense row (n entries), every other row a bare
+    unit diagonal - maximal row skew with trivially known counts."""
+    rows, cols, vals = [], [], []
+    for i in range(n):
+        if i == fat_row:
+            for j in range(n):
+                rows.append(i)
+                cols.append(j)
+                vals.append(2.0 if i == j else 0.5)
+        else:
+            rows.append(i)
+            cols.append(i)
+            vals.append(2.0)
+    return CSRMatrix.from_coo(np.array(rows), np.array(cols),
+                              np.array(vals, dtype=dtype), n, dtype=dtype)
+
+
+class TestImbalanceMath:
+    def test_max_over_mean(self):
+        assert ss.max_over_mean([4, 4, 4, 4]) == 1.0
+        assert ss.max_over_mean([11, 4]) == pytest.approx(11 / 7.5)
+        assert ss.max_over_mean([]) == 1.0
+        assert ss.max_over_mean([0, 0]) == 1.0
+
+    def test_gini_uniform_and_concentrated(self):
+        assert ss.gini([5, 5, 5, 5]) == 0.0
+        # all load on one of P shards -> (P - 1) / P
+        assert ss.gini([12, 0, 0, 0]) == pytest.approx(0.75)
+        # hand: [11, 4] -> sum|xi-xj| = 14, / (2 * 4 * 7.5)
+        assert ss.gini([11, 4]) == pytest.approx(14 / 60)
+
+
+class TestPartitionCSRReport:
+    def test_skewed_counts_hand_computed(self):
+        # 8 rows over 2 shards: shard 0 owns the fat row (8 entries)
+        # plus 3 diagonals = 11 nnz; shard 1 owns 4 diagonals.
+        a = skewed_csr(8)
+        parts = part.partition_csr(a, 2)
+        rep = ss.report_partition_csr(a, parts)
+        assert rep.kind == "csr-allgather"
+        assert rep.n_local == 4 and rep.n_global == 8
+        np.testing.assert_array_equal(rep.rows, [4, 4])
+        np.testing.assert_array_equal(rep.nnz, [11, 4])
+        # both shards are padded to the max entry count (11)
+        np.testing.assert_array_equal(rep.slots, [11, 11])
+        pad = rep.padding_overhead()
+        assert pad[0] == 0.0
+        assert pad[1] == pytest.approx(7 / 11)
+        imb = rep.imbalance()
+        assert imb["nnz_max_over_mean"] == pytest.approx(11 / 7.5)
+        assert imb["nnz_gini"] == pytest.approx(14 / 60)
+        assert imb["rows_max_over_mean"] == 1.0
+        assert imb["padding_overhead_total"] == pytest.approx(7 / 22)
+
+    def test_allgather_halo_payload(self):
+        # payload semantics: each shard contributes its n_local block
+        # (f32) and receives the other P-1 blocks
+        a = skewed_csr(8)
+        rep = ss.report_partition_csr(a, part.partition_csr(a, 2))
+        np.testing.assert_array_equal(rep.halo_send_bytes, [16, 16])
+        np.testing.assert_array_equal(rep.halo_recv_bytes, [16, 16])
+        a4 = skewed_csr(16)
+        rep4 = ss.report_partition_csr(a4, part.partition_csr(a4, 4))
+        np.testing.assert_array_equal(rep4.halo_send_bytes, [16] * 4)
+        np.testing.assert_array_equal(rep4.halo_recv_bytes, [48] * 4)
+
+    def test_padding_rows_counted_as_overhead_not_nnz(self):
+        # n=5 over 2 shards: n_local=3, shard 1 owns rows 3,4 plus one
+        # synthetic unit-diagonal padding row.  Real nnz must exclude
+        # the synthetic entry; slots must include it.
+        a = skewed_csr(5)
+        parts = part.partition_csr(a, 2)
+        rep = ss.report_partition_csr(a, parts)
+        np.testing.assert_array_equal(rep.rows, [3, 2])
+        np.testing.assert_array_equal(rep.nnz, [7, 2])  # 5+1+1, 1+1
+        # shard 1's count: 2 real + 1 padding-diag = 3 -> m = max(7, 3)
+        np.testing.assert_array_equal(rep.slots, [7, 7])
+
+
+class TestRingReports:
+    def test_ring_csr_neighbors_and_slots(self):
+        a = skewed_csr(16)
+        parts = part.ring_partition_csr(a, 4)
+        rep = ss.report_ring_csr(a, parts)
+        assert rep.kind == "csr-ring"
+        np.testing.assert_array_equal(rep.nnz, [19, 4, 4, 4])
+        # x-block rotation: P-1 ppermute steps x n_local f32 payload
+        np.testing.assert_array_equal(rep.halo_send_bytes, [48] * 4)
+        np.testing.assert_array_equal(rep.halo_recv_bytes, [48] * 4)
+        # shard k sends to (k - 1) % P
+        assert rep.neighbors[0] == ((3, 48),)
+        assert rep.neighbors[2] == ((1, 48),)
+        # slots: per-step max padded across owners, summed over steps
+        expected_slots = sum(d.shape[1] for d in parts.data)
+        np.testing.assert_array_equal(rep.slots, [expected_slots] * 4)
+        assert int(rep.slots[0]) >= int(rep.nnz.max())
+
+    def test_ring_shiftell_hand_checked(self):
+        """The satellite case: a row-skewed unstructured CSR through
+        ring_partition_shiftell - nnz/halo from first principles, slot
+        geometry from the packed sheet shapes."""
+        a = skewed_csr(512, fat_row=3)
+        parts = part.ring_partition_shiftell(a, 4, h=2, kc=4)
+        rep = ss.report_ring_shiftell(a, parts)
+        assert rep.kind == "ring-shiftell"
+        assert rep.n_local == 128
+        # shard 0 holds the fat row: 512 + 127 diagonals; others 128
+        np.testing.assert_array_equal(rep.nnz, [639, 128, 128, 128])
+        assert rep.imbalance()["nnz_max_over_mean"] == pytest.approx(
+            639 / (1023 / 4))
+        # ring payload: 3 steps x 128 rows x 4 B
+        np.testing.assert_array_equal(rep.halo_send_bytes, [1536] * 4)
+        # slot geometry == the packed value planes (C_t * kc * (h+1) * 128)
+        expected = sum(int(np.prod(v.shape[1:])) for v in parts.vals)
+        np.testing.assert_array_equal(rep.slots, [expected] * 4)
+        # padding overhead is real here: sheet packing rounds up
+        assert (rep.padding_overhead() > 0).all()
+
+    def test_ring_shiftell_df64_doubles_payload(self):
+        a = skewed_csr(512, fat_row=3)
+        parts = part.ring_partition_shiftell_df64(a, 4, h=2, kc=4)
+        rep = ss.report_ring_shiftell(a, parts)
+        assert rep.kind == "ring-shiftell-df64"
+        # both (hi, lo) f32 planes rotate in ONE stacked ppermute
+        np.testing.assert_array_equal(rep.halo_send_bytes, [3072] * 4)
+        np.testing.assert_array_equal(rep.nnz, [639, 128, 128, 128])
+
+    def test_dispatch(self):
+        a = skewed_csr(16)
+        assert ss.shard_report(
+            a, part.partition_csr(a, 2)).kind == "csr-allgather"
+        assert ss.shard_report(
+            a, part.ring_partition_csr(a, 2)).kind == "csr-ring"
+        with pytest.raises(TypeError, match="no shard accounting"):
+            ss.shard_report(a, object())
+
+
+class TestStencilReport:
+    def test_edge_vs_interior_halo(self):
+        rep = ss.report_stencil((8, 16), 4, 4, points=5, kind="stencil2d")
+        plane = 16 * 4
+        np.testing.assert_array_equal(
+            rep.halo_send_bytes, [plane, 2 * plane, 2 * plane, plane])
+        np.testing.assert_array_equal(rep.halo_recv_bytes,
+                                      rep.halo_send_bytes)
+        assert rep.neighbors[0] == ((1, plane),)
+        assert rep.neighbors[1] == ((2, plane), (0, plane))
+        np.testing.assert_array_equal(rep.rows, [128] * 4)
+        np.testing.assert_array_equal(rep.nnz, [640] * 4)
+        imb = rep.imbalance()
+        assert imb["halo_send_max_over_mean"] == pytest.approx(4 / 3)
+        assert imb["nnz_max_over_mean"] == 1.0
+
+
+class TestEmission:
+    def test_note_report_event_and_gauges(self):
+        from cuda_mpi_parallel_tpu.telemetry.registry import REGISTRY
+
+        a = skewed_csr(8)
+        rep = ss.report_partition_csr(a, part.partition_csr(a, 2))
+        ss.reset_last_shard_report()
+        try:
+            with events.capture() as buf:
+                telemetry.force_active(True)
+                ss.note_report(rep)
+            lines = [json.loads(ln) for ln in
+                     buf.getvalue().strip().splitlines()]
+            profs = [ev for ev in lines if ev["event"] == "shard_profile"]
+            assert len(profs) == 1
+            events.validate_event(profs[0])
+            assert profs[0]["kind"] == "csr-allgather"
+            assert profs[0]["nnz"] == [11, 4]
+            # the event payload round-trips to an identical report
+            rt = ss.ShardReport.from_json(profs[0])
+            np.testing.assert_array_equal(rt.nnz, rep.nnz)
+            np.testing.assert_array_equal(rt.halo_send_bytes,
+                                          rep.halo_send_bytes)
+            assert ss.last_shard_report() is rep
+            g = REGISTRY.gauge("shard_nnz",
+                               labelnames=("kind", "shard"))
+            assert g.value(kind="csr-allgather", shard="0") == 11.0
+            assert g.value(kind="csr-allgather", shard="1") == 4.0
+            imb = REGISTRY.gauge("shard_nnz_imbalance",
+                                 labelnames=("kind",))
+            assert imb.value(kind="csr-allgather") == pytest.approx(
+                11 / 7.5)
+        finally:
+            telemetry.force_active(False)
+            ss.reset_last_shard_report()
+
+    def test_inactive_still_parks_report(self):
+        a = skewed_csr(8)
+        rep = ss.report_partition_csr(a, part.partition_csr(a, 2))
+        ss.reset_last_shard_report()
+        telemetry.force_active(False)
+        events.configure(None)
+        ss.note_report(rep)
+        assert ss.last_shard_report() is rep
+        ss.reset_last_shard_report()
+        assert ss.last_shard_report() is None

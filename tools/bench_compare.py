@@ -62,6 +62,13 @@ METRIC_DIRECTION = {
     "flight.decay_rate": False,
     "kappa_estimate": None,
     "flight.kappa_estimate": None,
+    # roofline columns (PR 4): achieved-vs-peak efficiency is reported
+    # but never gates - it tracks tunnel weather and machine-model
+    # calibration as much as code.
+    "efficiency_pct": None,
+    "roofline.efficiency_pct": None,
+    "arithmetic_intensity": None,
+    "roofline.arithmetic_intensity": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -90,16 +97,28 @@ def load_sections(path: str) -> dict:
     return sections
 
 
+#: nested dicts flattened one level into dotted metric names
+_NESTED = {
+    "flight": ("decay_rate", "kappa_estimate"),
+    "roofline": ("efficiency_pct", "arithmetic_intensity"),
+}
+
+
 def _metrics(entry: dict) -> dict:
     """Flatten one section entry to its comparable numeric metrics
-    (one level of nesting for the ``flight`` summary)."""
+    (one level of nesting for the ``flight``/``roofline`` summaries).
+    Tolerant of any row shape: a pre-PR-3 entry simply contributes
+    fewer metrics (the caller renders the gap as "n/a")."""
     out = {}
+    if not isinstance(entry, dict):
+        return out
     for key, val in entry.items():
-        if key == "flight" and isinstance(val, dict):
-            for fk, fv in val.items():
-                if fk in ("decay_rate", "kappa_estimate") \
-                        and isinstance(fv, (int, float)):
-                    out[f"flight.{fk}"] = float(fv)
+        if key in _NESTED and isinstance(val, dict):
+            for fk in _NESTED[key]:
+                fv = val.get(fk)
+                if isinstance(fv, (int, float)) \
+                        and not isinstance(fv, bool):
+                    out[f"{key}.{fk}"] = float(fv)
             continue
         if key in METRIC_DIRECTION and isinstance(val, (int, float)) \
                 and not isinstance(val, bool):
@@ -120,10 +139,31 @@ def compare(old: dict, new: dict, threshold: float,
     failures = []
 
     rows = []
+    warnings = []
     for section in shared:
         m_old, m_new = _metrics(old[section]), _metrics(new[section])
-        for name in (k for k in m_old if k in m_new):
-            a, b = m_old[name], m_new[name]
+        # union, not intersection: a metric one side lacks (an old-
+        # format row predating the flight/iterations columns, e.g.
+        # bench_results_r03.json) renders as an "n/a" cell and a
+        # warning - a silent drop reads as "nothing changed", and a
+        # KeyError traceback is how this tool used to greet history
+        missing_old = sorted(k for k in m_new if k not in m_old)
+        if missing_old:
+            warnings.append(
+                f"{section}: OLD row predates metric(s) "
+                f"{', '.join(missing_old)} (old-format file); shown "
+                f"as n/a, not compared")
+        missing_new = sorted(k for k in m_old if k not in m_new)
+        if missing_new:
+            warnings.append(
+                f"{section}: NEW row lacks metric(s) "
+                f"{', '.join(missing_new)}; shown as n/a, not "
+                f"compared")
+        for name in sorted(set(m_old) | set(m_new)):
+            a, b = m_old.get(name), m_new.get(name)
+            if a is None or b is None:
+                rows.append((section, name, a, b, None))
+                continue
             delta = None if a == 0 else (b - a) / abs(a)
             rows.append((section, name, a, b, delta))
             higher_better = METRIC_DIRECTION.get(
@@ -150,20 +190,24 @@ def compare(old: dict, new: dict, threshold: float,
                             f"{cls_new}")
 
     if rows:
-        w_sec = max(len(r[0]) for r in rows)
-        w_met = max(len(r[1]) for r in rows)
+        w_sec = max(len("section"), max(len(r[0]) for r in rows))
+        w_met = max(len("metric"), max(len(r[1]) for r in rows))
         print(f"{'section':<{w_sec}}  {'metric':<{w_met}}  "
               f"{'old':>12}  {'new':>12}  {'delta':>8}", file=out)
         for section, name, a, b, delta in rows:
             d = "n/a" if delta is None else f"{delta:+.1%}"
+            fa = "n/a" if a is None else _fmt(a)
+            fb = "n/a" if b is None else _fmt(b)
             print(f"{section:<{w_sec}}  {name:<{w_met}}  "
-                  f"{_fmt(a):>12}  {_fmt(b):>12}  {d:>8}", file=out)
+                  f"{fa:>12}  {fb:>12}  {d:>8}", file=out)
     else:
         print("no comparable metrics in shared sections", file=out)
     if only_old:
         print(f"only in OLD: {', '.join(only_old)}", file=out)
     if only_new:
         print(f"only in NEW: {', '.join(only_new)}", file=out)
+    for w in warnings:
+        print(f"warning: {w}", file=out)
 
     if failures:
         print("\nREGRESSIONS:", file=out)

@@ -36,6 +36,30 @@ if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
 
+# Observability contract gate: one small mesh-4 CLI solve with the full
+# reporting surface on, then schema-validate EVERY emitted event line
+# (telemetry.events.validate_event) and structurally validate the
+# Perfetto timeline (ph/ts/pid/tid on every event, monotone ts per
+# track) plus the report's required sections.  This is the end-to-end
+# proof that the event stream, shard profile, roofline and timeline
+# exporters still compose - unit tests cover each piece, this covers
+# the seam.
+echo "== solve-report gate (mesh-4 CLI: event schema + Perfetto) =="
+scratch=$(mktemp -d)
+trap 'rm -rf "$scratch"' EXIT
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli \
+    --problem poisson2d --n 16 --mesh 4 --device cpu \
+    --tol 1e-6 --maxiter 200 \
+    --trace-events "$scratch/events.jsonl" \
+    --report "$scratch/report.txt" \
+    --trace-perfetto "$scratch/trace.json" > /dev/null
+python tools/validate_trace.py "$scratch/events.jsonl" \
+    "$scratch/trace.json"
+grep -q "imbalance" "$scratch/report.txt"
+grep -q "roofline" "$scratch/report.txt"
+grep -q "efficiency" "$scratch/report.txt"
+echo "solve-report gate: clean"
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Render a solve report (and optionally a Perfetto timeline) from a
+solve-trace events JSONL file.
+
+The in-process path is the CLI's ``--report`` / ``--trace-perfetto``
+(it has the live objects); this tool is the OFFLINE path - point it at
+the file ``--trace-events PATH`` appended to and get the same fused
+report back, hours later, on another machine::
+
+    python tools/solve_report.py trace.jsonl
+    python tools/solve_report.py trace.jsonl --solve-id s000002-...
+    python tools/solve_report.py trace.jsonl --perfetto trace.json
+    python tools/solve_report.py trace.jsonl --json
+
+It groups events by ``solve_id``, picks the LAST solve that reached
+``solve_end`` with a non-warmup phase (``--solve-id`` overrides), and
+fuses whatever that solve emitted: ``solve_start``/``solve_end``
+(status, iterations, wall time), ``comm_cost`` (per-iteration
+collectives), ``shard_profile`` (the per-shard table), and
+``solve_health``.  Events the solve never emitted simply leave their
+section out - an old trace file from PR 2 still renders.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")  # repo-root invocation, like tools/bench_compare
+
+from cuda_mpi_parallel_tpu.telemetry import events as tevents  # noqa: E402
+from cuda_mpi_parallel_tpu.telemetry import report as treport  # noqa: E402
+from cuda_mpi_parallel_tpu.telemetry import (  # noqa: E402
+    shardscope,
+)
+
+
+def pick_solve(evs, solve_id=None):
+    """Events of the requested (or last completed, non-warmup) solve."""
+    if solve_id is None:
+        for ev in reversed(evs):
+            if ev["event"] == "solve_end" and ev.get("solve_id") \
+                    and ev.get("phase") != "warmup":
+                solve_id = ev["solve_id"]
+                break
+        if solve_id is None:
+            raise ValueError("no completed solve (solve_end) in trace")
+    picked = [ev for ev in evs if ev.get("solve_id") == solve_id
+              and ev.get("phase") != "warmup"]
+    if not picked:
+        raise ValueError(f"no events for solve_id {solve_id!r}")
+    return solve_id, picked
+
+
+def _last(evs, etype):
+    for ev in reversed(evs):
+        if ev["event"] == etype:
+            return ev
+    return None
+
+
+def build_report(evs) -> treport.SolveReport:
+    start = _last(evs, "solve_start") or {}
+    end = _last(evs, "solve_end") or {}
+    record = {
+        "problem": end.get("label") or start.get("label", "?"),
+        "status": end.get("status", "?"),
+        "iterations": end.get("iterations", 0),
+        "residual_norm": end.get("residual_norm"),
+        "elapsed_s": end.get("elapsed_s"),
+        "device": start.get("device", "?"),
+        "mesh": start.get("mesh", 1),
+        "dtype": start.get("dtype", "?"),
+        "engine": end.get("engine") or start.get("engine", "?"),
+    }
+    if record["elapsed_s"] and record["iterations"]:
+        record["iters_per_sec"] = (record["iterations"]
+                                   / record["elapsed_s"])
+    shard = None
+    prof = _last(evs, "shard_profile")
+    if prof is not None:
+        shard = shardscope.ShardReport.from_json(prof)
+    comm = None
+    cc = _last(evs, "comm_cost")
+    if cc is not None:
+        its = int(record["iterations"] or 0)
+        # the comm_cost event carries only the while-body per-iteration
+        # rates; the one-time setup collectives (SolveCost.setup) are
+        # not in the event stream, so these totals run a few ops short
+        # of the CLI's inline report - say so rather than silently
+        # disagreeing with it
+        comm = {
+            "psum": cc["psum_per_iteration"] * its,
+            "ppermute": cc["ppermute_per_iteration"] * its,
+            "all_gather": cc.get("all_gather_per_iteration", 0) * its,
+            "comm_bytes": cc["comm_bytes_per_iteration"] * its,
+            "note": "iteration-phase collectives only - one-time "
+                    "setup ops are not in the event stream",
+        }
+    health = _last(evs, "solve_health")
+    if health is not None:
+        # drop the event envelope so the offline report's health JSON
+        # has the same shape as the CLI's inline SolveHealth.to_json()
+        health = {k: v for k, v in health.items()
+                  if k not in ("event", "t", "solve_id", "phase")}
+    sections = tuple((end.get("sections") or {}).items())
+    return treport.SolveReport(record=record, shard=shard, comm=comm,
+                               health=health, sections=sections)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a solve report from a --trace-events JSONL "
+                    "file")
+    ap.add_argument("trace", help="events JSONL path (--trace-events)")
+    ap.add_argument("--solve-id", default=None,
+                    help="render this solve (default: last completed)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the fused report as JSON instead of text")
+    ap.add_argument("--perfetto", default=None, metavar="PATH",
+                    help="additionally export the Perfetto timeline "
+                         "JSON to PATH")
+    args = ap.parse_args(argv)
+    try:
+        evs = tevents.read_events(args.trace)
+        solve_id, picked = pick_solve(evs, args.solve_id)
+        rep = build_report(picked)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        out = rep.to_json()
+        out["solve_id"] = solve_id
+        print(json.dumps(out, allow_nan=False, sort_keys=True))
+    else:
+        print(f"solve_id: {solve_id}")
+        print(rep.to_text(), end="")
+    if args.perfetto:
+        elapsed = rep.record.get("elapsed_s") or 0.0
+        trace = treport.perfetto_trace(
+            iterations=int(rep.record.get("iterations") or 0),
+            elapsed_s=float(elapsed), shard=rep.shard,
+            n_shards=rep.shard.n_shards if rep.shard else 1,
+            sections=rep.sections,
+            label=str(rep.record.get("problem", "solve")))
+        treport.validate_perfetto(trace)
+        treport.write_perfetto(args.perfetto, trace)
+        print(f"# perfetto timeline -> {args.perfetto}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

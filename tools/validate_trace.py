@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""CI gate: validate a solve-trace JSONL and/or a Perfetto timeline.
+
+Used by ``tools/lint.sh`` after its mesh-4 CLI solve::
+
+    python tools/validate_trace.py events.jsonl trace.json
+    python tools/validate_trace.py events.jsonl
+    python tools/validate_trace.py --perfetto-only trace.json
+
+Every JSONL line must parse as strict JSON and pass
+``telemetry.events.validate_event`` (known type, envelope + required
+fields); the Perfetto file must pass
+``telemetry.report.validate_perfetto`` (loadable event array,
+``ph``/``ts``/``pid``/``tid`` on every event, monotone ``ts`` per
+track).  Exit 0 on success, 1 on any violation (with the offending
+line/event named).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")  # repo-root invocation, like tools/bench_compare
+
+from cuda_mpi_parallel_tpu.telemetry.events import (  # noqa: E402
+    read_events,
+)
+from cuda_mpi_parallel_tpu.telemetry.report import (  # noqa: E402
+    validate_perfetto,
+)
+
+
+def check_events(path: str) -> int:
+    """Validate every line; returns the event count."""
+    return len(read_events(path))
+
+
+def check_perfetto(path: str) -> int:
+    """Validate the timeline structurally; returns the event count."""
+    with open(path, encoding="utf-8") as f:
+        try:
+            trace = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}: not valid JSON: {e}") from e
+    try:
+        validate_perfetto(trace)
+    except ValueError as e:
+        raise ValueError(f"{path}: {e}") from e
+    events = trace if isinstance(trace, list) else trace["traceEvents"]
+    return len(events)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate a solve-trace JSONL (+ optional Perfetto "
+                    "timeline) for CI")
+    ap.add_argument("events", nargs="?", default=None,
+                    help="events JSONL path")
+    ap.add_argument("perfetto", nargs="?", default=None,
+                    help="Perfetto/Chrome-trace JSON path")
+    ap.add_argument("--perfetto-only", default=None, metavar="PATH",
+                    help="validate only this timeline file")
+    args = ap.parse_args(argv)
+    if args.perfetto_only is None and args.events is None:
+        ap.error("nothing to validate")
+    try:
+        if args.events is not None:
+            n = check_events(args.events)
+            print(f"{args.events}: {n} events, all schema-valid")
+        target = args.perfetto_only or args.perfetto
+        if target is not None:
+            n = check_perfetto(target)
+            print(f"{target}: {n} trace events, structure valid")
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
