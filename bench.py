@@ -434,6 +434,41 @@ def _imbalance_entry(entry, local_grid, n_shards, itemsize=4,
     return entry
 
 
+def _planner_entry(entry, a, n_shards=4, key="planner"):
+    """Static partition-planner columns (balance.plan_partition): the
+    even-split vs planned nnz stall factor this operator would pay at
+    ``n_shards``, the chosen (reorder x split) lane, and planning wall
+    time.  Static accounting only - no distributed solve runs here.
+    ``a`` may be a zero-arg factory so operator CONSTRUCTION failures
+    are covered by the same never-sink-the-run contract as
+    ``_efficiency_entry``."""
+    try:
+        import time as _time
+
+        from cuda_mpi_parallel_tpu.balance import plan_partition
+        from cuda_mpi_parallel_tpu.utils.logging import sanitize
+
+        if callable(a):
+            a = a()
+        t0 = _time.perf_counter()
+        plan = plan_partition(a, n_shards)
+        el = _time.perf_counter() - t0
+        imb = plan.report.imbalance()
+        entry[key] = sanitize({
+            "n_shards": n_shards,
+            "label": plan.label,
+            "nnz_imbalance_even": round(
+                plan.baseline_imbalance["nnz_max_over_mean"], 4),
+            "nnz_imbalance_planned": round(imb["nnz_max_over_mean"], 4),
+            "padding_overhead_planned": round(
+                imb["padding_overhead_total"], 4),
+            "plan_time_s": round(el, 4),
+        })
+    except Exception as e:  # pragma: no cover - defensive
+        entry[key] = {"error": str(e)[-200:]}
+    return entry
+
+
 def _convergence_entry(res) -> dict:
     """``iterations``/``converged`` (+ flight summary when recorded) -
     the per-section convergence record bench_compare gates on."""
@@ -1213,6 +1248,22 @@ def bench_all(results, sections=None) -> None:
             _efficiency_entry(a3, entry)
             _imbalance_entry(entry, (grid[0] // ndev, grid[1], grid[2]),
                              ndev)
+            # planner columns for the distributed row: the stencil slab
+            # above is uniform by construction, so the planner's value
+            # shows on a representative unstructured CSR at THIS mesh
+            # size (static planning only; no extra solve).  The matrix
+            # is built INSIDE the helper's try: a qhull/memory failure
+            # must not sink the timing entry measured above.
+            def _plan_matrix():
+                from cuda_mpi_parallel_tpu.models.fem import random_fem_2d
+
+                return random_fem_2d(100_000, seed=5, dtype=np.float32)
+
+            _planner_entry(entry, _plan_matrix, n_shards=ndev)
+            if isinstance(entry.get("planner"), dict) \
+                    and "error" not in entry["planner"]:
+                entry["planner"]["note"] = (
+                    "static plan of a 100k random-FEM CSR at this mesh")
             results[f"poisson3d_{grid[0]}x{grid[1]}x{grid[2]}"
                     f"_mesh{ndev}"] = entry
         if ndev >= 4 and ndev % 2 == 0:
@@ -1262,6 +1313,7 @@ def bench_all(results, sections=None) -> None:
                 a_fast, fmt = a_rcm, "csr"
             entry = {"n": int(a_mm.shape[0]), "nnz": int(a_mm.nnz),
                      "format": fmt, "rcm_bandwidth": int(a_rcm.bandwidth())}
+            _planner_entry(entry, a_mm, n_shards=4)
             entry.update(iter_delta(a_fast, b_mm, 20, 500, repeats=2))
             m_mm = JacobiPreconditioner.from_operator(a_fast)
             el, res = time_fn(

@@ -43,6 +43,7 @@ from .solver.streaming import (
     supports_streaming_df64,
     supports_streaming_op,
 )
+from .balance import PartitionPlan, plan_partition
 
 __version__ = "0.1.0"
 
@@ -58,10 +59,12 @@ __all__ = [
     "IdentityOperator",
     "JacobiPreconditioner",
     "LinearOperator",
+    "PartitionPlan",
     "ShiftELLMatrix",
     "Stencil2D",
     "Stencil3D",
     "cg",
+    "plan_partition",
     "cg_df64",
     "cg_resident",
     "cg_resident_df64",

@@ -1,0 +1,346 @@
+"""Partition planning: choose (reorder x split) by predicted stall cost.
+
+``telemetry.shardscope`` can *measure* per-shard nnz/halo skew the
+moment a partition is built; this module closes the loop by choosing
+the partition FROM that measurement before anything is built.  A
+:func:`plan_partition` call enumerates candidate plans - a symmetric
+SPD-preserving reordering (none / RCM / greedy nnz-aware, see
+``.reorder``) crossed with a contiguous row split (even / balanced-nnz,
+see ``.nnz_split``) - scores each candidate with shardscope's static
+accounting (``report_for_ranges``) joined to the roofline communication
+model (``telemetry.roofline.MachineModel``), and returns the minimizer
+as a :class:`PartitionPlan`.
+
+The default score is the modeled per-iteration SHARD-STALL time of the
+shipped distributed schedules.  Under ``shard_map`` every shard is
+padded to identical shapes, so nnz skew does not make one device late -
+it inflates the UNIFORM padded slot count every device multiplies
+through (that is how the ``nnz_max_over_mean`` stall factor is paid
+here), while the ring/allgather x-rotation moves a fixed payload
+proportional to the padded local row count:
+
+    score =   slots_max * (itemsize + 4) * G / mem_bw    (padded work)
+            + (P - 1) * n_local * itemsize / net_bw      (x rotation)
+            + 0.25 * max_k coupling_bytes_k / net_bw     (locality)
+
+``G`` (:data:`GATHER_SLOWDOWN`) prices sparse-gather work against the
+streaming bandwidth the machine model quotes: the per-entry x gather
+is random access, measured 1-2 orders slower per element than a
+streamed read on the repo's own benches (``ops.pallas.spmv``
+docstring: shift-ELL beats the CSR gather ~20-1000x); 8 is a
+deliberately conservative charge.
+
+Balancing nnz shrinks the first term; keeping shards row-compact (the
+``row_cap_factor`` cap) bounds the second; a bandwidth-reducing
+reorder shrinks the third.  Coupling is deliberately down-weighted:
+the shipped allgather/ring schedules move their fixed payload however
+the entries couple, so locality is a secondary effect here (gather
+spread in the local SpMV, and what a future gather-based halo exchange
+would pay directly), not a per-iteration wire cost.  The machine model defaults to the
+static TPU-class table so planning is deterministic across hosts; pass
+``model=telemetry.roofline.machine_model()`` to rank against the
+calibrated local machine instead.
+
+Everything is host-side numpy over the CSR structure arrays - no
+device state, no tracing; a plan is pure layout metadata that the
+``parallel`` partitioners consume (``row_ranges=``) and the solvers
+invert on the way out (``permutation``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import nnz_split, reorder as reorder_mod
+
+__all__ = [
+    "GREEDY_REORDER_LIMIT",
+    "PartitionPlan",
+    "plan_partition",
+]
+
+#: rows above which the O(nnz log n) Python-heap greedy ordering is
+#: dropped from the candidate set (RCM's native path stays; planning a
+#: multi-million-row system should not spend minutes in heapq)
+GREEDY_REORDER_LIMIT = 200_000
+
+#: the planner's deterministic reference machine (the roofline TPU
+#: table): only the mem/net RATIO matters for ranking candidates, and a
+#: calibrated-per-host model would make plans host-dependent
+_REFERENCE_MODEL = dict(mem_bytes_per_s=8.19e11, net_bytes_per_s=4.5e10)
+
+#: effective slowdown of per-slot gather work vs the streaming
+#: bandwidth the machine model quotes (module docstring)
+GATHER_SLOWDOWN = 8.0
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class PartitionPlan:
+    """One chosen partition layout: how to reorder, where to cut.
+
+    ``row_ranges`` and ``report`` describe the matrix AFTER
+    ``permutation`` is applied (``perm[new] = old``, the
+    ``CSRMatrix.permuted`` convention); ``permutation is None`` means
+    the original ordering.  ``report`` is the PREDICTED ShardReport
+    (coupling-based halo semantics, ``report_for_ranges``); the
+    schedule-specific measured report is emitted by the partitioner at
+    solve time and the two ride one ``partition_plan`` telemetry event.
+    """
+
+    n_shards: int
+    row_ranges: Tuple[Tuple[int, int], ...]
+    permutation: Optional[np.ndarray]   # perm[new] = old, or None
+    reorder: str                        # "none" | "rcm" | "greedy"
+    split: str                          # "even" | "nnz"
+    objective: str
+    score: float
+    report: Optional[object] = None     # predicted ShardReport
+    #: the even-split imbalance digest of the UNpermuted matrix - the
+    #: baseline the plan is beating, for reports and benches
+    baseline_imbalance: Optional[dict] = None
+
+    @property
+    def label(self) -> str:
+        return f"{self.reorder}+{self.split}"
+
+    def fingerprint(self) -> str:
+        """Short stable digest of the layout (ranges + permutation):
+        the solver-cache key component and event correlation id."""
+        h = hashlib.sha1()
+        h.update(repr((self.n_shards, self.row_ranges)).encode())
+        if self.permutation is not None:
+            h.update(np.ascontiguousarray(
+                self.permutation, dtype=np.int64).tobytes())
+        return h.hexdigest()[:12]
+
+    def inverse_permutation(self) -> Optional[np.ndarray]:
+        if self.permutation is None:
+            return None
+        return reorder_mod.inverse_permutation(self.permutation)
+
+    @property
+    def n_global(self) -> int:
+        return int(self.row_ranges[-1][1]) if self.row_ranges else 0
+
+    def validate_for(self, a) -> None:
+        n = int(a.shape[0])
+        if self.n_global != n:
+            raise ValueError(
+                f"plan covers {self.n_global} rows but the operator has "
+                f"{n} (plan fingerprints are per-matrix layouts)")
+        if self.permutation is not None:
+            # full bijection check, not just length: a corrupt saved
+            # plan must be rejected HERE (downstream gathers clamp
+            # out-of-range indices and would return a silently wrong x)
+            if self.permutation.shape[0] != n or not np.array_equal(
+                    np.sort(self.permutation), np.arange(n)):
+                raise ValueError(
+                    f"plan permutation is not a permutation of "
+                    f"range({n})")
+
+    def is_trivial(self) -> bool:
+        """True when the plan IS the legacy layout: no permutation and
+        the even row split.  ``resolve_plan`` collapses trivial plans
+        to ``None`` so an auto-planned solve of an already-balanced
+        system shares the unplanned executable (same cache key, same
+        jaxpr) instead of compiling a byte-identical twin."""
+        return self.permutation is None and self.row_ranges \
+            == nnz_split.even_ranges(self.n_global, self.n_shards)
+
+    def describe(self) -> str:
+        pred = ""
+        if self.report is not None and self.baseline_imbalance:
+            pred = (f", nnz max/mean "
+                    f"{self.baseline_imbalance['nnz_max_over_mean']:.2f}"
+                    f" -> "
+                    f"{self.report.imbalance()['nnz_max_over_mean']:.2f}")
+        return (f"{self.label} over {self.n_shards} shards "
+                f"({self.fingerprint()}{pred})")
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "n_shards": self.n_shards,
+            "row_ranges": [[int(lo), int(hi)]
+                           for lo, hi in self.row_ranges],
+            "permutation": (None if self.permutation is None
+                            else [int(v) for v in self.permutation]),
+            "reorder": self.reorder,
+            "split": self.split,
+            "objective": self.objective,
+            "score": float(self.score),
+            "fingerprint": self.fingerprint(),
+            "predicted": (None if self.report is None
+                          else self.report.to_json()),
+            "baseline_imbalance": self.baseline_imbalance,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "PartitionPlan":
+        from ..telemetry.shardscope import ShardReport
+
+        perm = data.get("permutation")
+        pred = data.get("predicted")
+        return cls(
+            n_shards=int(data["n_shards"]),
+            row_ranges=tuple((int(lo), int(hi))
+                             for lo, hi in data["row_ranges"]),
+            permutation=(None if perm is None
+                         else np.asarray(perm, dtype=np.int64)),
+            reorder=str(data.get("reorder", "?")),
+            split=str(data.get("split", "?")),
+            objective=str(data.get("objective", "auto")),
+            score=float(data.get("score", 0.0)),
+            report=(None if pred is None
+                    else ShardReport.from_json(pred)),
+            baseline_imbalance=data.get("baseline_imbalance"),
+        )
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_json(), f)
+
+    @classmethod
+    def load(cls, path: str) -> "PartitionPlan":
+        with open(path, "r", encoding="utf-8") as f:
+            return cls.from_json(json.load(f))
+
+
+def _score(report, objective: str, itemsize: int,
+           mem_bps: float, net_bps: float) -> float:
+    """Rank a candidate layout; lower is better (seconds for 'time')."""
+    if objective == "nnz":
+        from ..telemetry.shardscope import max_over_mean
+
+        return float(max_over_mean(report.nnz))
+    if objective == "halo":
+        return float(report.halo_send_bytes.max()
+                     + report.halo_recv_bytes.max())
+    # "time": modeled per-iteration stall seconds (module docstring)
+    slot_term = (float(report.slots.max()) * (itemsize + 4)
+                 * GATHER_SLOWDOWN / mem_bps)
+    payload_term = ((report.n_shards - 1) * report.n_local
+                    * itemsize / net_bps)
+    coupling = (report.halo_send_bytes
+                + report.halo_recv_bytes).astype(np.float64)
+    coupling_term = 0.25 * float(coupling.max()) / net_bps \
+        if coupling.size else 0.0
+    return slot_term + payload_term + coupling_term
+
+
+def plan_partition(a, n_shards: int, *, objective: str = "auto",
+                   reorders: Optional[Sequence[str]] = None,
+                   splits: Sequence[str] = ("even", "nnz"),
+                   row_cap_factor: float = 1.25,
+                   itemsize: Optional[int] = None,
+                   model=None) -> PartitionPlan:
+    """Enumerate (reorder x split) candidates and return the minimizer.
+
+    Args:
+      a: the global assembled ``CSRMatrix`` (SPD; symmetric pattern).
+      n_shards: mesh size the partition targets.
+      objective: ``"auto"``/``"time"`` (modeled per-iteration stall
+        seconds - the default), ``"nnz"`` (pure nnz max/mean stall
+        factor) or ``"halo"`` (peak coupling bytes).
+      reorders: candidate orderings; default ``("none", "rcm",
+        "greedy")`` with greedy dropped past
+        :data:`GREEDY_REORDER_LIMIT` rows.
+      splits: candidate row splits (``"even"``, ``"nnz"``).
+      row_cap_factor: balanced-nnz splits cap real rows per shard at
+        ``ceil(n/P) * factor`` so one shard of very light rows cannot
+        inflate everyone's padded local size (see
+        ``nnz_split.balanced_nnz_ranges``).
+      itemsize: value bytes for halo/slot pricing (default: the
+        matrix dtype's).
+      model: a ``telemetry.roofline.MachineModel`` to price the time
+        objective against; default is the static TPU-class reference
+        table so plans are host-deterministic.
+
+    Returns:
+      The best :class:`PartitionPlan`; candidates are tried simplest
+      first (none+even leads), so on a balanced structured system the
+      planner returns the legacy layout and the solve proceeds exactly
+      as an unplanned one would.
+    """
+    if objective == "auto":
+        objective = "time"
+    if objective not in ("time", "nnz", "halo"):
+        raise ValueError(f"unknown plan objective {objective!r}")
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    from ..telemetry import shardscope
+
+    n = int(a.shape[0])
+    if itemsize is None:
+        itemsize = int(np.asarray(a.data).dtype.itemsize)
+    mem_bps = _REFERENCE_MODEL["mem_bytes_per_s"]
+    net_bps = _REFERENCE_MODEL["net_bytes_per_s"]
+    if model is not None:
+        mem_bps = float(model.mem_bytes_per_s)
+        net_bps = float(model.net_bytes_per_s or net_bps)
+    if reorders is None:
+        reorders = ("none", "rcm", "greedy")
+        if n > GREEDY_REORDER_LIMIT:
+            reorders = ("none", "rcm")
+    row_cap = max(1, int(-(-n // n_shards) * row_cap_factor)) \
+        if row_cap_factor else None
+
+    baseline = shardscope.report_for_ranges(
+        a, nnz_split.even_ranges(n, n_shards), itemsize=itemsize,
+        plan="none+even")
+    baseline_imb = baseline.imbalance()
+
+    best = None
+    for rname in reorders:
+        if rname == "none":
+            perm, ap = None, a
+        elif rname == "rcm":
+            perm = reorder_mod.rcm_reorder(a)
+            ap = a.permuted(perm)
+        elif rname == "greedy":
+            perm = reorder_mod.greedy_nnz_reorder(a)
+            ap = a.permuted(perm)
+        else:
+            raise ValueError(f"unknown reorder {rname!r}")
+        indptr = np.asarray(ap.indptr)
+        for sname in splits:
+            if sname == "even":
+                ranges = nnz_split.even_ranges(n, n_shards)
+            elif sname == "nnz":
+                ranges = nnz_split.balanced_nnz_ranges(
+                    indptr, n_shards, max_local_rows=row_cap)
+            else:
+                raise ValueError(f"unknown split {sname!r}")
+            if rname == "none" and sname == "even":
+                rep = baseline  # same inputs; the O(nnz) walk is paid once
+            else:
+                rep = shardscope.report_for_ranges(
+                    ap, ranges, itemsize=itemsize,
+                    plan=f"{rname}+{sname}")
+            score = _score(rep, objective, itemsize, mem_bps, net_bps)
+            cand = PartitionPlan(
+                n_shards=n_shards, row_ranges=ranges, permutation=perm,
+                reorder=rname, split=sname, objective=objective,
+                score=score, report=rep,
+                baseline_imbalance=baseline_imb)
+            if best is None:
+                best = cand   # none+even: the trivial baseline lane
+                trivial_score = score
+                continue
+            # hysteresis: a non-trivial lane must beat the TRIVIAL
+            # layout by > 2% (permutation/variable-row churn for a
+            # model-noise-sized gain is a net loss), and strictly beat
+            # the best so far - candidate order runs simplest first,
+            # so ties stay with the simpler layout
+            if score < trivial_score * 0.98 \
+                    and score < best.score * (1 - 1e-9):
+                best = cand
+    if best is None:
+        raise ValueError(
+            "plan_partition needs at least one (reorder, split) "
+            "candidate; got empty reorders/splits")
+    return best

@@ -144,6 +144,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reverse Cuthill-McKee reorder CSR problems before "
                         "solving (bandwidth/locality; solution is scattered "
                         "back to the original ordering)")
+    p.add_argument("--plan", default="even", metavar="auto|even|FILE",
+                   help="imbalance-aware partition planning for "
+                        "assembled-CSR problems with --mesh > 1 "
+                        "(balance.plan_partition): 'auto' enumerates "
+                        "(reorder x split) candidates and applies the "
+                        "minimizer - balanced-nnz contiguous row ranges "
+                        "plus an SPD-preserving symmetric reorder, "
+                        "scattered back on output; 'even' (default) is "
+                        "the legacy uniform row split; FILE loads a "
+                        "saved PartitionPlan JSON.  The applied plan "
+                        "and its predicted-vs-measured imbalance ride "
+                        "the solve record, --report and the "
+                        "partition_plan telemetry event")
     p.add_argument("--history", action="store_true",
                    help="print per-iteration residual trace")
     p.add_argument("--flight-record", nargs="?", const=1, default=None,
@@ -381,6 +394,45 @@ def main(argv=None) -> int:
                 "--csr-comm applies to assembled-CSR problems only "
                 "(stencils use halo exchange)")
 
+    # Imbalance-aware partition planning (balance): resolved HERE, not
+    # inside the solver, so the chosen lane can ride the description,
+    # the record and the report.  Composes with --rcm (the plan sees,
+    # and its candidate reorders permute, the post-RCM matrix).
+    plan_obj = None
+    if args.plan != "even":
+        from .models.operators import CSRMatrix
+
+        if args.mesh <= 1:
+            raise SystemExit("--plan needs --mesh > 1 (partition "
+                             "planning rebalances a device mesh)")
+        if not isinstance(a, CSRMatrix):
+            raise SystemExit(
+                "--plan applies to assembled-CSR problems only "
+                "(stencil slabs are uniform by construction)")
+        if args.engine in ("resident", "streaming"):
+            raise SystemExit(
+                f"--plan with --engine {args.engine} is unsupported: "
+                f"the distributed one-kernel engines use their own "
+                f"stencil partitioners (use --engine general/auto)")
+        from .balance import PartitionPlan, plan_partition
+
+        if args.plan == "auto":
+            plan_obj = plan_partition(a, args.mesh)
+        else:
+            try:
+                plan_obj = PartitionPlan.load(args.plan)
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                raise SystemExit(f"--plan {args.plan}: {e}")
+        try:
+            if plan_obj.n_shards != args.mesh:
+                raise ValueError(
+                    f"plan targets {plan_obj.n_shards} shards but "
+                    f"--mesh is {args.mesh}")
+            plan_obj.validate_for(a)
+        except ValueError as e:
+            raise SystemExit(f"--plan {args.plan}: {e}")
+        desc += f" [plan: {plan_obj.label}]"
+
     # df64 compatibility checks run BEFORE the format conversion below:
     # a doomed combination must fail fast, not after seconds of host-side
     # shift-ELL packing at 1M rows.
@@ -533,7 +585,7 @@ def main(argv=None) -> int:
                     precond_degree=args.precond_degree,
                     record_history=args.history,
                     check_every=args.check_every, method=args.method,
-                    flight=flight_cfg)
+                    flight=flight_cfg, plan=plan_obj)
             if args.engine in ("auto", "resident") and args.mesh == 1:
                 from .models.operators import _pallas_interpret
                 from .solver.resident import (
@@ -631,7 +683,7 @@ def main(argv=None) -> int:
                 precond_degree=args.precond_degree,
                 record_history=args.history, method=args.method,
                 check_every=args.check_every, csr_comm=args.csr_comm,
-                flight=flight_cfg)
+                flight=flight_cfg, plan=plan_obj)
         if args.engine in ("auto", "resident"):
             from .models.operators import _pallas_interpret
             from .solver.resident import (
@@ -893,6 +945,28 @@ def main(argv=None) -> int:
         record["max_abs_error"] = err
     if comm is not None:
         record["comm"] = comm
+    if plan_obj is not None:
+        plan_entry = {
+            "label": plan_obj.label,
+            "reorder": plan_obj.reorder,
+            "split": plan_obj.split,
+            "objective": plan_obj.objective,
+            "fingerprint": plan_obj.fingerprint(),
+            "score": float(plan_obj.score),
+        }
+        if plan_obj.baseline_imbalance:
+            plan_entry["even_imbalance"] = plan_obj.baseline_imbalance
+        if plan_obj.report is not None:
+            plan_entry["predicted_imbalance"] = \
+                plan_obj.report.imbalance()
+        from .telemetry.shardscope import last_shard_report as _lsr
+
+        shard_rep_now = _lsr()
+        if shard_rep_now is not None:
+            # the schedule-specific accounting of the partition that
+            # actually ran (only computed when telemetry is active)
+            plan_entry["measured_imbalance"] = shard_rep_now.imbalance()
+        record["plan"] = ulog.sanitize(plan_entry)
     if flight_rec is not None:
         record["flight"] = flight_rec.summary()
     if health is not None:
@@ -974,6 +1048,18 @@ def main(argv=None) -> int:
                   f"{comm['comm_bytes']} payload bytes "
                   f"(per-device; {comm['per_iteration']['comm_bytes']} "
                   f"bytes/iter)")
+        if plan_obj is not None:
+            pe = record["plan"]
+            imb = pe.get("measured_imbalance") \
+                or pe.get("predicted_imbalance") or {}
+            even = pe.get("even_imbalance") or {}
+            detail = ""
+            if imb and even:
+                detail = (f" (nnz max/mean "
+                          f"{even['nnz_max_over_mean']:.2f} -> "
+                          f"{imb['nnz_max_over_mean']:.2f})")
+            print(f"plan    : {pe['label']} [{pe['fingerprint']}]"
+                  f"{detail}")
         if health is not None:
             print(f"health  : {health.classification.name}: "
                   f"{health.message}")

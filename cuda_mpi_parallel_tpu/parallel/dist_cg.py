@@ -68,6 +68,7 @@ def solve_distributed(
     compensated: bool = False,
     csr_comm: str = "allgather",
     flight=None,
+    plan=None,
 ) -> CGResult:
     """Solve the global system A x = b row-partitioned over a device mesh.
 
@@ -110,6 +111,16 @@ def solve_distributed(
         buffer is identical on every shard and costs no extra
         collective; ``None`` leaves the cached executable bit-identical
         to a recorder-free build (the config is part of the cache key).
+      plan: imbalance-aware partition planning for assembled ``CSRMatrix``
+        problems (``balance``): ``"auto"`` runs ``balance.plan_partition``
+        on the operator; a ``balance.PartitionPlan`` applies a
+        precomputed layout; ``None`` (the default) keeps the legacy even
+        row split - proven jaxpr-bit-identical to a call that never
+        mentions planning.  A plan's symmetric permutation is applied
+        host-side before partitioning and inverted on the returned x,
+        so the caller's ordering is preserved; the plan fingerprint
+        joins the compiled-solver cache key.  Stencil operators are
+        uniform by construction and reject ``plan``.
       (tol/rtol/maxiter/record_history/check_every/compensated as in
       ``solver.cg``.)
 
@@ -132,6 +143,11 @@ def solve_distributed(
                          f"shape {b.shape}")
     if csr_comm not in ("allgather", "ring", "ring-shiftell"):
         raise ValueError(f"unknown csr_comm: {csr_comm!r}")
+    if plan is not None and not isinstance(a, CSRMatrix):
+        raise ValueError(
+            f"plan= applies to assembled CSRMatrix problems; "
+            f"{type(a).__name__} slabs are uniform by construction "
+            f"(nothing to rebalance)")
     if flight is not None:
         flight = flight.without_heartbeat()
     kw = dict(tol=tol, rtol=rtol, maxiter=maxiter, method=method,
@@ -172,9 +188,11 @@ def solve_distributed(
         return _solve_stencil(a, b, mesh, axis, n_shards, precond,
                               record_history, kw)
     if isinstance(a, CSRMatrix):
+        plan = resolve_plan(plan, a, n_shards)
         note()
         return _solve_csr(a, b, mesh, axis, n_shards, precond,
-                          record_history, kw, csr_comm=csr_comm)
+                          record_history, kw, csr_comm=csr_comm,
+                          plan=plan)
     raise TypeError(f"solve_distributed supports CSRMatrix/Stencil2D/"
                     f"Stencil3D, got {type(a).__name__}")
 
@@ -325,6 +343,73 @@ def _note_shards(build_report) -> None:
         build_report(telemetry.shardscope))
 
 
+def resolve_plan(plan, a, n_shards):
+    """Normalize the ``plan=`` argument of the CSR entry points:
+    ``None`` passes through (the even split), ``"auto"`` runs the
+    planner, a ``balance.PartitionPlan`` is validated against the
+    operator and mesh.  Shared by ``solve_distributed`` and
+    ``solve_distributed_df64``."""
+    if plan is None:
+        return None
+    from ..balance import PartitionPlan, plan_partition
+
+    if isinstance(plan, str):
+        if plan != "auto":
+            raise ValueError(
+                f"plan must be None, 'auto' or a balance.PartitionPlan, "
+                f"got {plan!r}")
+        plan = plan_partition(a, n_shards)
+    elif not isinstance(plan, PartitionPlan):
+        raise TypeError(
+            f"plan must be None, 'auto' or a balance.PartitionPlan, "
+            f"got {type(plan).__name__}")
+    if plan.n_shards != n_shards:
+        raise ValueError(
+            f"plan targets {plan.n_shards} shards but the mesh has "
+            f"{n_shards}")
+    plan.validate_for(a)
+    if plan.is_trivial():
+        # no permutation + even ranges IS the unplanned layout: take
+        # the plan=None path so the solve shares the legacy executable
+        # instead of compiling a byte-identical twin under a new key
+        return None
+    return plan
+
+
+def _apply_plan_permutation(a, b, plan):
+    """Host-side symmetric reorder of the global system: ``P A P^T``
+    and ``b[perm]`` (``CSRMatrix.permuted`` semantics).  The inverse
+    rides ``_unpad_result`` so callers always get x in THEIR row
+    ordering."""
+    if plan is None or plan.permutation is None:
+        return a, b
+    perm = plan.permutation
+    return a.permuted(perm), np.asarray(b)[perm]
+
+
+def _note_partition(a, parts, plan) -> None:
+    """The planned-partition sibling of ``_note_shards``: park/emit the
+    measured schedule-specific ShardReport labeled with the plan lane,
+    plus a ``partition_plan`` event joining the planner's PREDICTED
+    imbalance (coupling-halo semantics, ``report_for_ranges``) to the
+    MEASURED one - the closed feedback loop in one event."""
+    from .. import telemetry
+
+    if not telemetry.active():
+        return
+    label = plan.label if plan is not None else None
+    rep = telemetry.shardscope.shard_report(a, parts, plan=label)
+    telemetry.shardscope.note_report(rep)
+    if plan is not None:
+        telemetry.events.emit(
+            "partition_plan", reorder=plan.reorder, split=plan.split,
+            n_shards=plan.n_shards, fingerprint=plan.fingerprint(),
+            objective=plan.objective, score=float(plan.score),
+            predicted=(plan.report.imbalance()
+                       if plan.report is not None else None),
+            measured=rep.imbalance())
+
+
 def _make_precond(precond, local, axis):
     """Build the preconditioner INSIDE the shard_map body: reductions in
     the spectral estimate and applications psum over ``axis`` (a mesh
@@ -438,7 +523,11 @@ def _shard_tree(tree, mesh, axis):
 
 
 def _shard_padded_rhs(b, parts, mesh, axis):
-    b_pad = part.pad_vector(np.asarray(b), parts.n_global_padded)
+    if parts.row_ranges is not None:
+        b_pad = part.pad_vector_ranges(np.asarray(b), parts.row_ranges,
+                                       parts.n_local)
+    else:
+        b_pad = part.pad_vector(np.asarray(b), parts.n_global_padded)
     return shard_vector(jnp.asarray(b_pad), mesh, axis)
 
 
@@ -448,15 +537,34 @@ def _strip_row_padding(res: CGResult, parts) -> CGResult:
     return res
 
 
+def _plan_unpad_indices(parts, plan) -> np.ndarray:
+    """Composed padded-x -> original-x gather for a planned solve:
+    ``gather_indices`` undoes the variable-row padding (yielding the
+    PERMUTED ordering), then the plan's inverse permutation restores
+    the caller's row order - one fused gather."""
+    idx = part.gather_indices(parts.row_ranges, parts.n_local)
+    inv = plan.inverse_permutation() if plan is not None else None
+    return idx if inv is None else idx[inv]
+
+
+def _unpad_result(res: CGResult, parts, plan) -> CGResult:
+    if parts.row_ranges is None:
+        return _strip_row_padding(res, parts)
+    idx = _plan_unpad_indices(parts, plan)
+    return dataclasses.replace(res, x=res.x[jnp.asarray(idx)])
+
+
 def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
-               kw, csr_comm: str = "allgather") -> CGResult:
+               kw, csr_comm: str = "allgather", plan=None) -> CGResult:
     if csr_comm == "ring-shiftell":
         return _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
-                                   record_history, kw)
+                                   record_history, kw, plan=plan)
     ring = csr_comm == "ring"
-    parts = (part.ring_partition_csr(a, n_shards) if ring
-             else part.partition_csr(a, n_shards))
-    _note_shards(lambda ss: ss.shard_report(a, parts))
+    a, b = _apply_plan_permutation(a, b, plan)
+    ranges = plan.row_ranges if plan is not None else None
+    parts = (part.ring_partition_csr(a, n_shards, ranges) if ring
+             else part.partition_csr(a, n_shards, ranges))
+    _note_partition(a, parts, plan)
     b_dev = _shard_padded_rhs(b, parts, mesh, axis)
     data = _shard_tree(parts.data, mesh, axis)  # array, or per-step tuple
     cols = _shard_tree(parts.cols, mesh, axis)
@@ -464,7 +572,8 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
 
     n_local = parts.n_local
     key = ("csr", ring, n_local, n_shards, axis, mesh, precond,
-           record_history, tuple(sorted(kw.items())))
+           record_history, tuple(sorted(kw.items())),
+           plan.fingerprint() if plan is not None else None)
 
     def build():
         @partial(shard_map, mesh=mesh,
@@ -484,18 +593,22 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
         return run
 
     ctx = dict(kind="csr", check_every=kw["check_every"],
-               method=kw["method"], n_shards=n_shards)
+               method=kw["method"], n_shards=n_shards,
+               **({"plan": plan.label} if plan is not None else {}))
     res = _cached_solver(key, build, ctx,
                          (b_dev, data, cols, rows))(
         b_dev, data, cols, rows)
-    return _strip_row_padding(res, parts)
+    return _unpad_result(res, parts, plan)
 
 
 def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
-                        record_history, kw) -> CGResult:
+                        record_history, kw, plan=None) -> CGResult:
     """Ring schedule with pallas shift-ELL slabs (``DistShiftELLRing``)."""
-    parts = part.ring_partition_shiftell(a, n_shards)
-    _note_shards(lambda ss: ss.shard_report(a, parts))
+    a, b = _apply_plan_permutation(a, b, plan)
+    parts = part.ring_partition_shiftell(
+        a, n_shards,
+        row_ranges=plan.row_ranges if plan is not None else None)
+    _note_partition(a, parts, plan)
     b_dev = _shard_padded_rhs(b, parts, mesh, axis)
     vals = _shard_tree(parts.vals, mesh, axis)  # per-step (n_shards, C, ..)
     meta = _shard_tree(parts.lane_idx, mesh, axis)
@@ -506,7 +619,8 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
     chunk_shape = tuple(v.shape[1] for v in parts.vals)
     key = ("csr-shiftell", n_local, n_shards, parts.h, parts.kc,
            chunk_shape, axis, mesh, precond, record_history,
-           tuple(sorted(kw.items())))
+           tuple(sorted(kw.items())),
+           plan.fingerprint() if plan is not None else None)
 
     def build():
         # check_vma=False: the pallas slab kernel cannot declare varying
@@ -529,8 +643,9 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
         return run
 
     ctx = dict(kind="csr-shiftell", check_every=kw["check_every"],
-               method=kw["method"], n_shards=n_shards)
+               method=kw["method"], n_shards=n_shards,
+               **({"plan": plan.label} if plan is not None else {}))
     res = _cached_solver(key, build, ctx,
                          (b_dev, vals, meta, blks, diag))(
         b_dev, vals, meta, blks, diag)
-    return _strip_row_padding(res, parts)
+    return _unpad_result(res, parts, plan)

@@ -10,14 +10,27 @@ zero diagonal.
 All of this runs host-side in numpy, once, before the solve - layout work is
 setup cost, exactly like the reference's H2D staging (``CUDACG.cu:119-186``),
 not per-iteration work.
+
+Plan-driven splits (``balance.plan_partition``): every partitioner takes an
+optional ``row_ranges`` - one contiguous ``(lo, hi)`` row range per shard,
+with VARIABLE real row counts.  ``shard_map`` still needs uniform local
+shapes, so all shards pad to the max real row count with the same
+unit-diagonal rows the even split uses for its tail; column ids are remapped
+into the padded global layout (shard ``s``'s row ``r`` lives at padded id
+``s * n_local + (r - lo_s)``, see :func:`gather_indices`).  ``row_ranges=None``
+takes exactly the legacy even-split code path - byte-identical output, so an
+unplanned solve compiles the identical jaxpr it always has.
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from ..models.operators import CSRMatrix
+
+#: one contiguous (lo, hi) row range per shard (balance.nnz_split)
+RowRanges = Tuple[Tuple[int, int], ...]
 
 
 class PartitionedCSR(NamedTuple):
@@ -26,7 +39,10 @@ class PartitionedCSR(NamedTuple):
     ``data``/``cols``/``local_rows`` have shape ``(n_shards, max_local_nnz)``;
     padding entries have ``data == 0`` and in-range indices.  ``cols`` are
     *global* column ids (the distributed matvec gathers from an all-gathered
-    x); ``local_rows`` are local row ids in ``[0, n_local)``.
+    x); ``local_rows`` are local row ids in ``[0, n_local)``.  For a
+    plan-driven split ``row_ranges`` records the variable real-row layout
+    (``cols`` are then PADDED-global ids, ``gather_indices`` maps back);
+    ``None`` marks the legacy even split.
     """
 
     data: np.ndarray
@@ -36,14 +52,59 @@ class PartitionedCSR(NamedTuple):
     n_global_padded: int
     n_global: int
     n_shards: int
+    row_ranges: Optional[RowRanges] = None
 
 
 def padded_size(n: int, n_shards: int) -> int:
     return ((n + n_shards - 1) // n_shards) * n_shards
 
 
-def partition_csr(a: CSRMatrix, n_shards: int) -> PartitionedCSR:
-    """Split a global CSR matrix into ``n_shards`` row blocks."""
+def check_ranges(row_ranges, n: int, n_shards: int) -> RowRanges:
+    """Validate a plan's contiguous cover of ``[0, n)`` (one range per
+    shard) - delegates to ``balance.nnz_split`` so planner and
+    partitioners agree on what a legal split is."""
+    from ..balance.nnz_split import validate_ranges
+
+    return validate_ranges(row_ranges, n, n_shards)
+
+
+def gather_indices(row_ranges: RowRanges, n_local: int) -> np.ndarray:
+    """``g`` with ``g[r]`` = padded global id of original row ``r``:
+    shard ``s``'s rows ``[lo, hi)`` land at ``s * n_local + [0, hi-lo)``.
+    ``x_original = x_padded[g]`` recovers a solution, ``b_padded[g] =
+    b`` scatters a right-hand side (:func:`pad_vector_ranges`)."""
+    n = int(row_ranges[-1][1]) if row_ranges else 0
+    g = np.empty(n, dtype=np.int64)
+    for s, (lo, hi) in enumerate(row_ranges):
+        g[lo:hi] = s * n_local + np.arange(hi - lo, dtype=np.int64)
+    return g
+
+
+def _ranges_layout(a, n_shards: int, row_ranges: RowRanges):
+    """Shared geometry of a plan-driven split: ``(ranges, n_local,
+    n_pad, gmap)`` with ``n_local`` the max real row count (every shard
+    pads to it) and ``gmap`` the original-row -> padded-id map.  The
+    CALLER's shard count is validated against the ranges - a plan for
+    the wrong mesh must fail here, not as a far-away shape error."""
+    ranges = check_ranges(row_ranges, a.shape[0], n_shards)
+    n_local = max(max(hi - lo for lo, hi in ranges), 1)
+    return ranges, n_local, n_local * n_shards, \
+        gather_indices(ranges, n_local)
+
+
+def partition_csr(a: CSRMatrix, n_shards: int,
+                  row_ranges: Optional[RowRanges] = None
+                  ) -> PartitionedCSR:
+    """Split a global CSR matrix into ``n_shards`` row blocks.
+
+    ``row_ranges`` (a partition plan's contiguous variable-row split)
+    reshapes the layout: shard ``s`` owns rows ``[lo_s, hi_s)`` padded
+    to the max real row count, and ``cols`` are remapped into the
+    padded global ordering.  ``None`` is the legacy even split,
+    byte-identical to what this function always produced.
+    """
+    if row_ranges is not None:
+        return _partition_csr_ranges(a, n_shards, row_ranges)
     n = a.shape[0]
     n_pad = padded_size(n, n_shards)
     n_local = n_pad // n_shards
@@ -86,9 +147,61 @@ def partition_csr(a: CSRMatrix, n_shards: int) -> PartitionedCSR:
     )
 
 
+def _partition_csr_ranges(a: CSRMatrix, n_shards: int,
+                          row_ranges: RowRanges) -> PartitionedCSR:
+    """The plan-driven sibling of the even split above: variable real
+    rows per shard under one common padded slot count.  Column ids are
+    remapped through ``gather_indices`` so the all-gathered x (whose
+    layout IS the concatenation of padded shard blocks) lines up;
+    padding rows keep the unit diagonal at their own padded id."""
+    n = a.shape[0]
+    ranges, n_local, n_pad, gmap = _ranges_layout(a, n_shards, row_ranges)
+    data = np.asarray(a.data)
+    indices = np.asarray(a.indices)
+    indptr = np.asarray(a.indptr).astype(np.int64)
+
+    counts = np.array(
+        [int(indptr[hi] - indptr[lo]) + (n_local - (hi - lo))
+         for lo, hi in ranges], dtype=np.int64)
+    m = int(counts.max()) if n_shards else 1
+
+    out_data = np.zeros((n_shards, m), dtype=data.dtype)
+    out_cols = np.zeros((n_shards, m), dtype=np.int32)
+    out_rows = np.zeros((n_shards, m), dtype=np.int32)
+    entry_rows = np.repeat(np.arange(n), np.diff(indptr))
+    for s, (lo, hi) in enumerate(ranges):
+        k = 0
+        if hi > lo:
+            e0, e1 = indptr[lo], indptr[hi]
+            k = int(e1 - e0)
+            out_data[s, :k] = data[e0:e1]
+            out_cols[s, :k] = gmap[indices[e0:e1]]
+            out_rows[s, :k] = entry_rows[e0:e1] - lo
+        for r_local in range(hi - lo, n_local):
+            out_data[s, k] = 1.0
+            out_cols[s, k] = s * n_local + r_local
+            out_rows[s, k] = r_local
+            k += 1
+    return PartitionedCSR(
+        data=out_data, cols=out_cols, local_rows=out_rows,
+        n_local=n_local, n_global_padded=n_pad, n_global=n,
+        n_shards=n_shards, row_ranges=ranges,
+    )
+
+
 def pad_vector(b: np.ndarray, n_padded: int) -> np.ndarray:
     out = np.zeros(n_padded, dtype=b.dtype)
     out[: b.shape[0]] = b
+    return out
+
+
+def pad_vector_ranges(b: np.ndarray, row_ranges: RowRanges,
+                      n_local: int) -> np.ndarray:
+    """Scatter a global vector into the padded variable-row layout
+    (shard blocks of ``n_local``, real rows first, zeros after)."""
+    n_pad = n_local * len(row_ranges)
+    out = np.zeros(n_pad, dtype=b.dtype)
+    out[gather_indices(row_ranges, n_local)] = b
     return out
 
 
@@ -114,16 +227,22 @@ class RingPartitionedCSR(NamedTuple):
     n_global_padded: int
     n_global: int
     n_shards: int
+    row_ranges: Optional[RowRanges] = None
 
 
-def ring_partition_csr(a: CSRMatrix, n_shards: int) -> RingPartitionedCSR:
+def ring_partition_csr(a: CSRMatrix, n_shards: int,
+                       row_ranges: Optional[RowRanges] = None
+                       ) -> RingPartitionedCSR:
     """Split a global CSR matrix for the ring SpMV schedule.
 
     Starts from ``partition_csr``'s row blocks, then splits each owner's
     entries by column block, padding uniformly across owners per step
     (shapes must match across devices; they may differ between steps).
+    A plan's ``row_ranges`` passes straight through: the remapped
+    padded-global ``cols`` tile into ``n_local``-sized column blocks by
+    construction, so the ring's block arithmetic is unchanged.
     """
-    rows_part = partition_csr(a, n_shards)
+    rows_part = partition_csr(a, n_shards, row_ranges)
     n_local = rows_part.n_local
     slabs = []
     for s in range(n_shards):
@@ -157,6 +276,7 @@ def ring_partition_csr(a: CSRMatrix, n_shards: int) -> RingPartitionedCSR:
         data=tuple(data), cols=tuple(cols), local_rows=tuple(lrows),
         n_local=n_local, n_global_padded=rows_part.n_global_padded,
         n_global=rows_part.n_global, n_shards=n_shards,
+        row_ranges=rows_part.row_ranges,
     )
 
 class RingPartitionedShiftELL(NamedTuple):
@@ -182,6 +302,7 @@ class RingPartitionedShiftELL(NamedTuple):
     n_global_padded: int
     n_global: int
     n_shards: int
+    row_ranges: Optional[RowRanges] = None
 
 
 class RingPartitionedShiftELLDF64(NamedTuple):
@@ -202,10 +323,11 @@ class RingPartitionedShiftELLDF64(NamedTuple):
     n_global_padded: int
     n_global: int
     n_shards: int
+    row_ranges: Optional[RowRanges] = None
 
 
 def _ring_pack_slabs(a: CSRMatrix, n_shards: int, h: int | None, kc: int,
-                     *, itemsize: int, lift, pack):
+                     *, itemsize: int, lift, pack, row_ranges=None):
     """Shared core of the ring shift-ELL partitioners.
 
     Ring-splits ``a``, rebuilds each (owner, step) slab as CSR (``lift``
@@ -219,7 +341,7 @@ def _ring_pack_slabs(a: CSRMatrix, n_shards: int, h: int | None, kc: int,
     """
     from ..ops.pallas import spmv as pk
 
-    ring = ring_partition_csr(a, n_shards)
+    ring = ring_partition_csr(a, n_shards, row_ranges)
     n_local = ring.n_local
 
     def slab_csr(t, s):
@@ -253,9 +375,26 @@ def _ring_pack_slabs(a: CSRMatrix, n_shards: int, h: int | None, kc: int,
     return ring, n_local, h, steps
 
 
+def _padded_diag(a: CSRMatrix, ring, dtype) -> np.ndarray:
+    """The padded global diagonal (Jacobi's input): scattered through
+    the variable-row layout when the split is plan-driven, appended
+    unit entries on the even split's tail otherwise.  Padding rows are
+    unit-diagonal either way."""
+    if ring.row_ranges is not None:
+        diag = np.ones(ring.n_global_padded, dtype=dtype)
+        diag[gather_indices(ring.row_ranges, ring.n_local)] = \
+            np.asarray(a.diagonal(), dtype=dtype)
+        return diag
+    diag = np.zeros(ring.n_global_padded, dtype=dtype)
+    diag[: ring.n_global] = np.asarray(a.diagonal(), dtype=dtype)
+    diag[ring.n_global:] = 1.0  # unit-diagonal padding rows
+    return diag
+
+
 def ring_partition_shiftell_df64(a: CSRMatrix, n_shards: int, *,
-                                 h: int | None = None,
-                                 kc: int = 8) -> RingPartitionedShiftELLDF64:
+                                 h: int | None = None, kc: int = 8,
+                                 row_ranges: Optional[RowRanges] = None
+                                 ) -> RingPartitionedShiftELLDF64:
     """Ring-split + df64 shift-ELL packing (see ring_partition_shiftell).
 
     Matrix values are lifted to float64 on the host before packing, so
@@ -269,11 +408,9 @@ def ring_partition_shiftell_df64(a: CSRMatrix, n_shards: int, *,
     ring, n_local, h, steps = _ring_pack_slabs(
         a, n_shards, h, kc, itemsize=8,
         lift=lambda d: np.asarray(d, dtype=np.float64),
-        pack=pk.pack_shift_ell_df64)
+        pack=pk.pack_shift_ell_df64, row_ranges=row_ranges)
 
-    diag64 = np.zeros(ring.n_global_padded, dtype=np.float64)
-    diag64[: ring.n_global] = np.asarray(a.diagonal(), dtype=np.float64)
-    diag64[ring.n_global:] = 1.0  # unit-diagonal padding rows
+    diag64 = _padded_diag(a, ring, np.float64)
     diag_hi = diag64.astype(np.float32)
     diag_lo = (diag64 - diag_hi.astype(np.float64)).astype(np.float32)
     return RingPartitionedShiftELLDF64(
@@ -286,12 +423,13 @@ def ring_partition_shiftell_df64(a: CSRMatrix, n_shards: int, *,
         diag_lo=diag_lo.reshape(n_shards, n_local),
         h=h, kc=kc, n_local=n_local,
         n_global_padded=ring.n_global_padded, n_global=ring.n_global,
-        n_shards=n_shards)
+        n_shards=n_shards, row_ranges=ring.row_ranges)
 
 
 def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *,
-                            h: int | None = None,
-                            kc: int = 8) -> RingPartitionedShiftELL:
+                            h: int | None = None, kc: int = 8,
+                            row_ranges: Optional[RowRanges] = None
+                            ) -> RingPartitionedShiftELL:
     """Ring-split ``a`` and pack every (owner, step) slab to shift-ELL.
 
     Each slab is an ``n_local x n_local`` sparse block; per step, the
@@ -305,11 +443,9 @@ def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *,
     ring, n_local, h, steps = _ring_pack_slabs(
         a, n_shards, h, kc,
         itemsize=np.asarray(a.data).dtype.itemsize,
-        lift=lambda d: d, pack=pk.pack_shift_ell)
+        lift=lambda d: d, pack=pk.pack_shift_ell, row_ranges=row_ranges)
 
-    diag = np.zeros(ring.n_global_padded, dtype=np.asarray(a.data).dtype)
-    diag[: ring.n_global] = np.asarray(a.diagonal())
-    diag[ring.n_global:] = 1.0  # unit-diagonal padding rows
+    diag = _padded_diag(a, ring, np.asarray(a.data).dtype)
     return RingPartitionedShiftELL(
         vals=tuple(np.stack([p.vals for p in ps]) for ps in steps),
         lane_idx=tuple(np.stack([p.lane_idx for p in ps]) for ps in steps),
@@ -318,4 +454,4 @@ def ring_partition_shiftell(a: CSRMatrix, n_shards: int, *,
         diag=diag.reshape(n_shards, n_local), h=h, kc=kc,
         n_local=n_local,
         n_global_padded=ring.n_global_padded, n_global=ring.n_global,
-        n_shards=n_shards)
+        n_shards=n_shards, row_ranges=ring.row_ranges)

@@ -81,6 +81,12 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     # partition time (telemetry.shardscope.ShardReport.to_json payload)
     "shard_profile": ("kind", "n_shards", "rows", "nnz",
                       "halo_send_bytes"),
+    # an imbalance-aware partition plan (balance.PartitionPlan) was
+    # applied to a distributed solve: the chosen reorder/split lane plus
+    # the planner's predicted imbalance digest joined to the measured
+    # one of the partition actually built - the shardscope feedback
+    # loop, closed, in one event
+    "partition_plan": ("reorder", "split", "n_shards", "measured"),
     # sampled in-flight heartbeat (FlightConfig.heartbeat > 0 only;
     # posted from the hot loop via an unordered jax.debug.callback)
     "flight_heartbeat": ("iteration",),

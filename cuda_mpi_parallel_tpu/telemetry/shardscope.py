@@ -48,6 +48,7 @@ __all__ = [
     "last_shard_report",
     "max_over_mean",
     "note_report",
+    "report_for_ranges",
     "report_partition_csr",
     "report_ring_csr",
     "report_ring_shiftell",
@@ -112,6 +113,10 @@ class ShardReport:
     halo_send_bytes: np.ndarray   # (P,) bytes sent per matvec
     halo_recv_bytes: np.ndarray   # (P,) bytes received per matvec
     neighbors: Tuple[Tuple[Tuple[int, int], ...], ...]
+    #: which partition plan produced this layout ("even" = the legacy
+    #: uniform row split; planned partitions label reports with their
+    #: reorder+split lane, e.g. "rcm+nnz")
+    plan: str = "even"
 
     # ---- derived -----------------------------------------------------
     def padding_overhead(self) -> np.ndarray:
@@ -141,6 +146,7 @@ class ShardReport:
     def to_json(self) -> dict:
         return {
             "kind": self.kind,
+            "plan": self.plan,
             "n_shards": self.n_shards,
             "n_global": self.n_global,
             "n_global_padded": self.n_global_padded,
@@ -175,6 +181,7 @@ class ShardReport:
                                        dtype=np.int64),
             neighbors=tuple(tuple((int(p), int(b)) for p, b in ns)
                             for ns in data.get("neighbors", [])),
+            plan=str(data.get("plan", "even")),
         )
 
     def table(self) -> str:
@@ -193,31 +200,46 @@ class ShardReport:
             f"imbalance: nnz max/mean {imb['nnz_max_over_mean']:.3f} "
             f"(gini {imb['nnz_gini']:.3f}), halo max/mean "
             f"{imb['halo_send_max_over_mean']:.3f}, padding overhead "
-            f"{imb['padding_overhead_total'] * 100:.1f}%")
+            f"{imb['padding_overhead_total'] * 100:.1f}% "
+            f"[plan: {self.plan}]")
         return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------
 # builders (one per partition family)
 
-def _real_rows(n: int, n_local: int, n_shards: int) -> np.ndarray:
-    lo = np.arange(n_shards, dtype=np.int64) * n_local
-    hi = np.minimum(lo + n_local, n)
-    return np.maximum(hi - lo, 0)
+def _row_ranges(n: int, n_local: int, n_shards: int,
+                row_ranges=None) -> Tuple[Tuple[int, int], ...]:
+    """The contiguous row ranges of a partition: the planner's explicit
+    ranges when present, else the legacy even split they generalize."""
+    if row_ranges is not None:
+        return tuple((int(lo), int(hi)) for lo, hi in row_ranges)
+    return tuple((min(s * n_local, n), min((s + 1) * n_local, n))
+                 for s in range(n_shards))
 
 
-def _csr_shard_nnz(a, n_local: int, n_shards: int) -> np.ndarray:
+def _real_rows(n: int, n_local: int, n_shards: int,
+               row_ranges=None) -> np.ndarray:
+    ranges = _row_ranges(n, n_local, n_shards, row_ranges)
+    return np.array([hi - lo for lo, hi in ranges], dtype=np.int64)
+
+
+def _csr_shard_nnz(a, n_local: int, n_shards: int,
+                   row_ranges=None) -> np.ndarray:
     """Exact live entries per row block, from the global indptr (the
     partitioners' padded arrays cannot distinguish a real unit diagonal
     from a synthetic padding-row one; the source matrix can)."""
     indptr = np.asarray(a.indptr).astype(np.int64)
-    n = a.shape[0]
-    out = np.zeros(n_shards, dtype=np.int64)
-    for s in range(n_shards):
-        lo, hi = s * n_local, min((s + 1) * n_local, n)
-        if hi > lo:
-            out[s] = indptr[hi] - indptr[lo]
-    return out
+    ranges = _row_ranges(a.shape[0], n_local, n_shards, row_ranges)
+    return np.array([int(indptr[hi] - indptr[lo]) if hi > lo else 0
+                     for lo, hi in ranges], dtype=np.int64)
+
+
+def _plan_label(parts, plan) -> str:
+    if plan is not None:
+        return str(plan)
+    return "planned" if getattr(parts, "row_ranges", None) is not None \
+        else "even"
 
 
 def _ring_halo(n_shards: int, payload: int):
@@ -234,12 +256,13 @@ def _ring_halo(n_shards: int, payload: int):
     return send, recv, neighbors
 
 
-def report_partition_csr(a, parts) -> ShardReport:
+def report_partition_csr(a, parts, plan=None) -> ShardReport:
     """Accounting for ``partition.partition_csr`` output (the
     ``all_gather`` ``DistCSR`` schedule)."""
     n_shards, n_local = parts.n_shards, parts.n_local
+    ranges = getattr(parts, "row_ranges", None)
     itemsize = np.asarray(parts.data).dtype.itemsize
-    nnz = _csr_shard_nnz(a, n_local, n_shards)
+    nnz = _csr_shard_nnz(a, n_local, n_shards, ranges)
     slots = np.full(n_shards, parts.data.shape[1], dtype=np.int64)
     # all_gather payload: each shard contributes its own x block and
     # receives every other shard's (payload semantics - see module doc)
@@ -251,29 +274,32 @@ def report_partition_csr(a, parts) -> ShardReport:
     return ShardReport(
         kind="csr-allgather", n_shards=n_shards, n_global=parts.n_global,
         n_global_padded=parts.n_global_padded, n_local=n_local,
-        rows=_real_rows(parts.n_global, n_local, n_shards), nnz=nnz,
+        rows=_real_rows(parts.n_global, n_local, n_shards, ranges),
+        nnz=nnz,
         slots=slots, halo_send_bytes=send, halo_recv_bytes=recv,
-        neighbors=neighbors)
+        neighbors=neighbors, plan=_plan_label(parts, plan))
 
 
-def report_ring_csr(a, parts) -> ShardReport:
+def report_ring_csr(a, parts, plan=None) -> ShardReport:
     """Accounting for ``partition.ring_partition_csr`` output (the
     ``ppermute`` x-rotation ``DistCSRRing`` schedule)."""
     n_shards, n_local = parts.n_shards, parts.n_local
+    ranges = getattr(parts, "row_ranges", None)
     itemsize = np.asarray(parts.data[0]).dtype.itemsize
-    nnz = _csr_shard_nnz(a, n_local, n_shards)
+    nnz = _csr_shard_nnz(a, n_local, n_shards, ranges)
     slots = np.full(n_shards,
                     sum(d.shape[1] for d in parts.data), dtype=np.int64)
     send, recv, neighbors = _ring_halo(n_shards, n_local * itemsize)
     return ShardReport(
         kind="csr-ring", n_shards=n_shards, n_global=parts.n_global,
         n_global_padded=parts.n_global_padded, n_local=n_local,
-        rows=_real_rows(parts.n_global, n_local, n_shards), nnz=nnz,
+        rows=_real_rows(parts.n_global, n_local, n_shards, ranges),
+        nnz=nnz,
         slots=slots, halo_send_bytes=send, halo_recv_bytes=recv,
-        neighbors=neighbors)
+        neighbors=neighbors, plan=_plan_label(parts, plan))
 
 
-def report_ring_shiftell(a, parts) -> ShardReport:
+def report_ring_shiftell(a, parts, plan=None) -> ShardReport:
     """Accounting for ``partition.ring_partition_shiftell`` (f32/f64)
     AND ``ring_partition_shiftell_df64`` output.
 
@@ -283,11 +309,12 @@ def report_ring_shiftell(a, parts) -> ShardReport:
     The df64 packer rotates BOTH x planes in one stacked ppermute, so
     its per-step payload doubles."""
     n_shards, n_local = parts.n_shards, parts.n_local
+    ranges = getattr(parts, "row_ranges", None)
     df64 = hasattr(parts, "vals_hi")
     vals = parts.vals_hi if df64 else parts.vals
     per_shard_slots = sum(
         int(np.prod(v.shape[1:])) for v in vals)
-    nnz = _csr_shard_nnz(a, n_local, n_shards)
+    nnz = _csr_shard_nnz(a, n_local, n_shards, ranges)
     slots = np.full(n_shards, per_shard_slots, dtype=np.int64)
     payload = n_local * (8 if df64 else np.asarray(vals[0]).dtype.itemsize)
     send, recv, neighbors = _ring_halo(n_shards, payload)
@@ -295,9 +322,10 @@ def report_ring_shiftell(a, parts) -> ShardReport:
         kind="ring-shiftell-df64" if df64 else "ring-shiftell",
         n_shards=n_shards, n_global=parts.n_global,
         n_global_padded=parts.n_global_padded, n_local=n_local,
-        rows=_real_rows(parts.n_global, n_local, n_shards), nnz=nnz,
+        rows=_real_rows(parts.n_global, n_local, n_shards, ranges),
+        nnz=nnz,
         slots=slots, halo_send_bytes=send, halo_recv_bytes=recv,
-        neighbors=neighbors)
+        neighbors=neighbors, plan=_plan_label(parts, plan))
 
 
 def report_stencil(local_grid, n_shards: int, itemsize: int,
@@ -333,19 +361,96 @@ def report_stencil(local_grid, n_shards: int, itemsize: int,
         neighbors=tuple(neighbors))
 
 
-def shard_report(a, parts) -> ShardReport:
+def shard_report(a, parts, plan=None) -> ShardReport:
     """Dispatch on the partition family (the four partitioner output
     types in ``parallel.partition``)."""
     from ..parallel import partition as part
 
     if isinstance(parts, part.PartitionedCSR):
-        return report_partition_csr(a, parts)
+        return report_partition_csr(a, parts, plan=plan)
     if isinstance(parts, part.RingPartitionedCSR):
-        return report_ring_csr(a, parts)
+        return report_ring_csr(a, parts, plan=plan)
     if isinstance(parts, (part.RingPartitionedShiftELL,
                           part.RingPartitionedShiftELLDF64)):
-        return report_ring_shiftell(a, parts)
+        return report_ring_shiftell(a, parts, plan=plan)
     raise TypeError(f"no shard accounting for {type(parts).__name__}")
+
+
+def report_for_ranges(a, row_ranges, *, itemsize=None,
+                      plan: str = "ranges") -> ShardReport:
+    """Static accounting for an ARBITRARY contiguous row split of a CSR
+    matrix - the shared code path between the partition planner
+    (scoring candidate splits before any partition is built) and the
+    post-hoc profiler (re-reporting a split that already ran).
+
+    Differences from the schedule-specific builders above:
+
+    * ``slots`` is what ``partition.partition_csr`` WOULD allocate for
+      these ranges: every shard padded to the max of (nnz + padding
+      rows) - the uniform-shape cost of the split, before any packer
+      geometry;
+    * halo bytes are COUPLING-based, not schedule-based: shard ``k``
+      receives one x entry per *distinct* off-range column its rows
+      reference and sends one per distinct local row referenced by
+      another shard's rows.  The allgather/ring schedules move a fixed
+      payload regardless of sparsity; the coupling volume is the part a
+      reordering can actually shrink, which is what the planner needs
+      to rank candidate permutations (a gather-based halo exchange
+      would move exactly these bytes).
+
+    ``neighbors[k]`` lists ``(peer, bytes)`` sends per matvec.
+    """
+    indptr = np.asarray(a.indptr).astype(np.int64)
+    indices = np.asarray(a.indices).astype(np.int64)
+    n = int(a.shape[0])
+    n_shards = len(row_ranges)
+    ranges = tuple((int(lo), int(hi)) for lo, hi in row_ranges)
+    if itemsize is None:
+        itemsize = np.asarray(a.data).dtype.itemsize
+    rows = np.array([hi - lo for lo, hi in ranges], dtype=np.int64)
+    nnz = _csr_shard_nnz(a, 0, n_shards, ranges)
+    n_local = max(int(rows.max()) if n_shards else 0, 1)
+    counts = nnz + (n_local - rows)  # padding rows carry a unit diagonal
+    slots = np.full(n_shards, int(counts.max()) if n_shards else 0,
+                    dtype=np.int64)
+
+    # shard id of every row (and so of every column, SPD => square)
+    starts = np.array([lo for lo, _ in ranges] + [n], dtype=np.int64)
+    shard_of = np.repeat(np.arange(n_shards, dtype=np.int64),
+                         np.diff(starts))
+    entry_rows = np.repeat(np.arange(n, dtype=np.int64),
+                           np.diff(indptr))
+    row_shard = shard_of[entry_rows]
+    col_shard = shard_of[indices]
+    off = row_shard != col_shard
+    send = np.zeros(n_shards, dtype=np.int64)
+    recv = np.zeros(n_shards, dtype=np.int64)
+    pair_counts: dict = {}
+    if off.any():
+        # distinct (referencing shard, column) pairs: one x entry each
+        # (all vectorized - the planner calls this per candidate lane,
+        # and a 1M-row FEM matrix has millions of cross-shard pairs)
+        keys = row_shard[off] * np.int64(n) + indices[off]
+        uniq = np.unique(keys)
+        u_reader = uniq // n          # the shard that needs the entry
+        u_owner = shard_of[uniq % n]  # the shard that owns the column
+        np.add.at(recv, u_reader, itemsize)
+        np.add.at(send, u_owner, itemsize)
+        pair_keys, counts = np.unique(
+            u_owner * np.int64(n_shards) + u_reader, return_counts=True)
+        pair_counts = {
+            (int(k // n_shards), int(k % n_shards)): int(c) * itemsize
+            for k, c in zip(pair_keys, counts)}
+    neighbors = tuple(
+        tuple(sorted((peer, b) for (owner, peer), b in pair_counts.items()
+                     if owner == k))
+        for k in range(n_shards))
+    return ShardReport(
+        kind="ranges", n_shards=n_shards, n_global=n,
+        n_global_padded=n_local * n_shards, n_local=n_local,
+        rows=rows, nnz=nnz, slots=slots,
+        halo_send_bytes=send, halo_recv_bytes=recv,
+        neighbors=neighbors, plan=plan)
 
 
 # ---------------------------------------------------------------------------

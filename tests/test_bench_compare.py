@@ -136,6 +136,34 @@ class TestCompareGate:
         assert "flight.decay_rate" in out
         assert rc == 0
 
+    def test_planner_columns_reported_never_gated(self, tmp_path):
+        """PR-5: the partition-planner columns ride the table but a
+        'worse' imbalance never fails the gate (they track the bench
+        problem's structure, not the code), and an OLD file without
+        them degrades to n/a, not a KeyError."""
+        planner = {"n_shards": 4, "label": "rcm+nnz",
+                   "nnz_imbalance_even": 2.8,
+                   "nnz_imbalance_planned": 1.1,
+                   "plan_time_s": 0.4}
+        worse = dict(planner, nnz_imbalance_planned=2.5,
+                     plan_time_s=9.0)
+        old = _sweep()
+        new = _sweep()
+        old["unstructured_fem"] = {"iters_per_sec": 100.0,
+                                   "planner": planner}
+        new["unstructured_fem"] = {"iters_per_sec": 100.0,
+                                   "planner": worse}
+        rc, out = self._run(tmp_path, old, new)
+        assert rc == 0            # reported, never gated
+        assert "planner.nnz_imbalance_planned" in out
+        assert "planner.plan_time_s" in out
+        # old file predates the planner entirely -> n/a cells + warning
+        del old["unstructured_fem"]["planner"]
+        rc, out = self._run(tmp_path, old, new)
+        assert rc == 0
+        assert "n/a" in out
+        assert "planner.nnz_imbalance_planned" in out
+
 
 class TestMainCli:
     def test_main_regression_exit_codes(self, tmp_path, capsys):

@@ -241,6 +241,73 @@ class TestZeroPerturbation:
         assert instrumented == base
 
     @needs_mesh
+    def test_plan_none_distributed_csr_jaxpr_identical(self):
+        """PR-5 acceptance: ``plan=None`` leaves the distributed CSR
+        solve bit-identical to the pre-planner even split.  Two layers:
+        the partition arrays built with ``row_ranges=None`` are
+        byte-identical to the legacy call, and the very solve body
+        ``dist_cg`` builds over them traces to the identical jaxpr; a
+        planned variable-row split must genuinely CHANGE the jaxpr
+        (the padded local size moves).  On the public surface,
+        ``solve_distributed(plan=None)`` lands on the same compiled
+        executable as a call that never mentions planning (one trace
+        total)."""
+        from cuda_mpi_parallel_tpu.models import poisson
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            make_mesh,
+            solve_distributed,
+        )
+        from cuda_mpi_parallel_tpu.parallel import partition as part
+        from cuda_mpi_parallel_tpu.parallel.operators import DistCSR
+
+        a = poisson.poisson_2d_csr(8, 8)   # n=64 over 4 shards
+        mesh = make_mesh(4)
+
+        def trace(parts):
+            b = jnp.zeros(parts.n_global_padded)
+            data = jnp.asarray(parts.data)
+            cols = jnp.asarray(parts.cols)
+            rows = jnp.asarray(parts.local_rows)
+
+            @partial(compat.shard_map, mesh=mesh,
+                     in_specs=(P("rows"), P("rows"), P("rows"),
+                               P("rows")),
+                     out_specs=P("rows"))
+            def run(b_local, d, c, r):
+                strip = partial(jax.tree.map, lambda v: v[0])
+                op = DistCSR(data=strip(d), cols=strip(c),
+                             local_rows=strip(r),
+                             n_local=parts.n_local,
+                             axis_name="rows", n_shards=4)
+                return cg(op, b_local, axis_name="rows", maxiter=25).x
+
+            return str(jax.make_jaxpr(run)(b, data, cols, rows))
+
+        legacy = part.partition_csr(a, 4)
+        explicit = part.partition_csr(a, 4, row_ranges=None)
+        for f in ("data", "cols", "local_rows"):
+            assert np.array_equal(getattr(legacy, f),
+                                  getattr(explicit, f))
+        base = trace(legacy)
+        assert trace(explicit) == base
+        planned = part.partition_csr(
+            a, 4, row_ranges=((0, 20), (20, 40), (40, 60), (60, 64)))
+        assert planned.n_local != legacy.n_local
+        assert trace(planned) != base
+
+        dist_cg.clear_solver_cache()
+        try:
+            b = np.ones(64)
+            before = dist_cg._TRACE_COUNT[0]
+            solve_distributed(a, b, mesh=mesh, tol=0.0, maxiter=25)
+            solve_distributed(a, b, mesh=mesh, tol=0.0, maxiter=25,
+                              plan=None)
+            assert dist_cg._TRACE_COUNT[0] == before + 1
+        finally:
+            dist_cg.clear_solver_cache()
+
+    @needs_mesh
     def test_flight_off_distributed_jaxpr_identical(self):
         """Same proof under shard_map: the recorder-off distributed
         solve traces to the identical jaxpr, recorder-on carries the

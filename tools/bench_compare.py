@@ -69,6 +69,13 @@ METRIC_DIRECTION = {
     "roofline.efficiency_pct": None,
     "arithmetic_intensity": None,
     "roofline.arithmetic_intensity": None,
+    # partition-planner columns (PR 5): predicted stall factors of the
+    # even vs planned split (balance.plan_partition).  Reported, never
+    # gated - they track the bench problem's structure, not the code;
+    # old result files simply lack them (rendered n/a).
+    "planner.nnz_imbalance_even": None,
+    "planner.nnz_imbalance_planned": None,
+    "planner.plan_time_s": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -101,6 +108,8 @@ def load_sections(path: str) -> dict:
 _NESTED = {
     "flight": ("decay_rate", "kappa_estimate"),
     "roofline": ("efficiency_pct", "arithmetic_intensity"),
+    "planner": ("nnz_imbalance_even", "nnz_imbalance_planned",
+                "plan_time_s"),
 }
 
 

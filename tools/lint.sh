@@ -32,6 +32,14 @@ python -m cuda_mpi_parallel_tpu.analysis --select GL105 --fail-on info \
     cuda_mpi_parallel_tpu/telemetry/health.py
 echo "flight recorder: GL105 clean"
 
+# The partition planner is pure host-side layout work - it must never
+# grow a device sync (GL105) or any other finding.  The package-wide
+# run above covers balance/ for all rules; this names the contract.
+echo "== graftlint balance/ (GL105 host-sync, zero findings) =="
+python -m cuda_mpi_parallel_tpu.analysis --select GL105 --fail-on info \
+    cuda_mpi_parallel_tpu/balance
+echo "balance: GL105 clean"
+
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
@@ -59,6 +67,40 @@ grep -q "imbalance" "$scratch/report.txt"
 grep -q "roofline" "$scratch/report.txt"
 grep -q "efficiency" "$scratch/report.txt"
 echo "solve-report gate: clean"
+
+# Planner gate: the balance/ subsystem must actually beat the even
+# split where it claims to - the committed skewed unstructured SPD
+# fixture at mesh 4.  Two CLI solves (legacy even split, then
+# --plan auto), then compare the measured per-shard nnz stall factor
+# each report carries.  End-to-end: MatrixMarket parse -> planner ->
+# plan-driven partition -> distributed solve -> shardscope report.
+echo "== planner gate (mesh-4 CLI: --plan auto beats --plan even) =="
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --device cpu --tol 1e-8 --maxiter 500 \
+    --plan even --report "$scratch/plan_even.txt" > /dev/null
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --device cpu --tol 1e-8 --maxiter 500 \
+    --plan auto --report "$scratch/plan_auto.txt" > /dev/null
+python - "$scratch/plan_even.txt" "$scratch/plan_auto.txt" <<'PY'
+import re
+import sys
+
+
+def imbalance(path):
+    with open(path, encoding="utf-8") as f:
+        m = re.search(r"nnz max/mean ([0-9.]+)", f.read())
+    assert m, f"{path}: no shard-profile imbalance line"
+    return float(m.group(1))
+
+
+even, auto = imbalance(sys.argv[1]), imbalance(sys.argv[2])
+assert auto < even, \
+    f"--plan auto imbalance {auto} does not beat --plan even {even}"
+print(f"planner gate: nnz max/mean {even} (even) -> {auto} (auto)")
+PY
+echo "planner gate: clean"
 
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
