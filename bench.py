@@ -469,6 +469,50 @@ def _planner_entry(entry, a, n_shards=4, key="planner"):
     return entry
 
 
+def _replan_entry(entry, n_shards, key="replan"):
+    """Runtime-calibration / replan columns for the distributed row
+    (parallel.solve_sequence on the committed skewed fixture): the
+    kept-vs-switched decision, the calibrated model's predicted gain,
+    and the final solve's predicted-vs-measured drift %.  Two small
+    real distributed solves (240 rows) - measured, not static - under
+    the same never-sink-the-run contract as ``_efficiency_entry``.
+    Calibrations are NOT persisted (a 240-row toy must not steer this
+    host's cached machine model)."""
+    try:
+        import numpy as _np
+
+        from cuda_mpi_parallel_tpu.models import mmio
+        from cuda_mpi_parallel_tpu.parallel import (
+            make_mesh,
+            solve_sequence,
+        )
+        from cuda_mpi_parallel_tpu.utils.logging import sanitize
+
+        a = mmio.load_matrix_market("tests/fixtures/skewed_spd_240.mtx")
+        b = _np.random.default_rng(9).standard_normal(240)
+        seq = solve_sequence(a, b, mesh=make_mesh(n_shards), repeats=2,
+                             replan=True, tol=1e-8, maxiter=500,
+                             persist_calibration=False)
+        s = seq.summary()
+        dec = (s["decisions"] or [{}])[0]
+        entry[key] = sanitize({
+            "n_shards": n_shards,
+            "decision": dec.get("decision"),
+            "predicted_gain_pct": round(
+                float(dec.get("predicted_gain_pct", 0.0)), 2),
+            "drift_pct": round(float(s["drift"]["drift_pct"]), 2),
+            "model": s["calibration"]["model"]["name"],
+            "gather_slowdown": round(float(
+                s["calibration"]["model"]["gather_slowdown"]), 3),
+            "confident": bool(s["calibration"]["confident"]),
+            "note": "2-solve replan sequence on the committed "
+                    "skewed 240-row fixture",
+        })
+    except Exception as e:  # pragma: no cover - defensive
+        entry[key] = {"error": str(e)[-200:]}
+    return entry
+
+
 def _convergence_entry(res) -> dict:
     """``iterations``/``converged`` (+ flight summary when recorded) -
     the per-section convergence record bench_compare gates on."""
@@ -1264,6 +1308,10 @@ def bench_all(results, sections=None) -> None:
                     and "error" not in entry["planner"]:
                 entry["planner"]["note"] = (
                     "static plan of a 100k random-FEM CSR at this mesh")
+            # replan gain column: a measured 2-solve calibrate+replan
+            # sequence (needs a real mesh to rebalance)
+            if ndev >= 2:
+                _replan_entry(entry, n_shards=ndev)
             results[f"poisson3d_{grid[0]}x{grid[1]}x{grid[2]}"
                     f"_mesh{ndev}"] = entry
         if ndev >= 4 and ndev % 2 == 0:

@@ -27,7 +27,13 @@ permutation; ``plan=None`` is bit-identical to the legacy even split.
 All host-side numpy - a plan never touches device state.
 """
 from .nnz_split import balanced_nnz_ranges, even_ranges, validate_ranges
-from .plan import GREEDY_REORDER_LIMIT, PartitionPlan, plan_partition
+from .plan import (
+    GREEDY_REORDER_LIMIT,
+    PartitionPlan,
+    plan_partition,
+    reference_model,
+    score_report,
+)
 from .reorder import (
     greedy_nnz_reorder,
     inverse_permutation,
@@ -43,5 +49,7 @@ __all__ = [
     "inverse_permutation",
     "plan_partition",
     "rcm_reorder",
+    "reference_model",
+    "score_report",
     "validate_ranges",
 ]

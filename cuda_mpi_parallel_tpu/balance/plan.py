@@ -23,12 +23,13 @@ proportional to the padded local row count:
             + (P - 1) * n_local * itemsize / net_bw      (x rotation)
             + 0.25 * max_k coupling_bytes_k / net_bw     (locality)
 
-``G`` (:data:`GATHER_SLOWDOWN`) prices sparse-gather work against the
-streaming bandwidth the machine model quotes: the per-entry x gather
-is random access, measured 1-2 orders slower per element than a
+``G`` (``model.gather_slowdown``) prices sparse-gather work against
+the streaming bandwidth the machine model quotes: the per-entry x
+gather is random access, measured 1-2 orders slower per element than a
 streamed read on the repo's own benches (``ops.pallas.spmv``
-docstring: shift-ELL beats the CSR gather ~20-1000x); 8 is a
-deliberately conservative charge.
+docstring: shift-ELL beats the CSR gather ~20-1000x); the table
+default of 8 (:data:`GATHER_SLOWDOWN`) is a deliberately conservative
+charge.
 
 Balancing nnz shrinks the first term; keeping shards row-compact (the
 ``row_cap_factor`` cap) bounds the second; a bandwidth-reducing
@@ -36,10 +37,14 @@ reorder shrinks the third.  Coupling is deliberately down-weighted:
 the shipped allgather/ring schedules move their fixed payload however
 the entries couple, so locality is a secondary effect here (gather
 spread in the local SpMV, and what a future gather-based halo exchange
-would pay directly), not a per-iteration wire cost.  The machine model defaults to the
-static TPU-class table so planning is deterministic across hosts; pass
-``model=telemetry.roofline.machine_model()`` to rank against the
-calibrated local machine instead.
+would pay directly), not a per-iteration wire cost.  All three machine
+parameters (mem bandwidth, net bandwidth, gather slowdown) live on ONE
+``telemetry.roofline.MachineModel`` shared with the roofline and the
+runtime calibrator; the default is the deterministic TPU-class
+reference table (:func:`reference_model`) so plans stay
+host-independent, and a runtime-calibrated model
+(``telemetry.calibrate``) is used only when explicitly passed via
+``model=`` (``dist_cg.resolve_plan`` does this for sequences).
 
 Everything is host-side numpy over the CSR structure arrays - no
 device state, no tracing; a plan is pure layout metadata that the
@@ -61,6 +66,8 @@ __all__ = [
     "GREEDY_REORDER_LIMIT",
     "PartitionPlan",
     "plan_partition",
+    "reference_model",
+    "score_report",
 ]
 
 #: rows above which the O(nnz log n) Python-heap greedy ordering is
@@ -68,14 +75,39 @@ __all__ = [
 #: multi-million-row system should not spend minutes in heapq)
 GREEDY_REORDER_LIMIT = 200_000
 
-#: the planner's deterministic reference machine (the roofline TPU
-#: table): only the mem/net RATIO matters for ranking candidates, and a
-#: calibrated-per-host model would make plans host-dependent
-_REFERENCE_MODEL = dict(mem_bytes_per_s=8.19e11, net_bytes_per_s=4.5e10)
+_REFERENCE = [None]
 
-#: effective slowdown of per-slot gather work vs the streaming
-#: bandwidth the machine model quotes (module docstring)
-GATHER_SLOWDOWN = 8.0
+
+def __getattr__(name):
+    # GATHER_SLOWDOWN is a lazy alias of the ONE shared definition
+    # (telemetry.roofline.DEFAULT_GATHER_SLOWDOWN, also the
+    # MachineModel field default) - duplicating the literal here let
+    # the two layers this PR unified drift apart; lazy so importing
+    # balance/ alone stays light (roofline pulls the telemetry stack)
+    if name == "GATHER_SLOWDOWN":
+        from ..telemetry.roofline import DEFAULT_GATHER_SLOWDOWN
+
+        return DEFAULT_GATHER_SLOWDOWN
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def reference_model():
+    """The planner's deterministic reference machine: the roofline
+    TPU-class table plus the conservative gather-slowdown default, as
+    one shared ``telemetry.roofline.MachineModel``.  Only the ratios
+    matter for ranking candidates, and defaulting to a calibrated
+    per-host model would make plans host-dependent - so this is the
+    default, and calibrated models are opt-in via ``model=``."""
+    if _REFERENCE[0] is None:
+        from ..telemetry.roofline import MachineModel
+
+        # gather_slowdown deliberately omitted: the MachineModel field
+        # default IS the shared table value
+        _REFERENCE[0] = MachineModel(
+            name="reference-tpu-v5e", mem_bytes_per_s=8.19e11,
+            flops_per_s=2.0e13, net_bytes_per_s=4.5e10,
+            source="table")
+    return _REFERENCE[0]
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -102,6 +134,10 @@ class PartitionPlan:
     #: the even-split imbalance digest of the UNpermuted matrix - the
     #: baseline the plan is beating, for reports and benches
     baseline_imbalance: Optional[dict] = None
+    #: name of the MachineModel whose parameters priced ``score`` -
+    #: "reference-tpu-v5e" unless a calibrated model was passed; the
+    #: proof hook for "solve k+1 ran on a runtime-corrected plan"
+    scored_by: str = "reference-tpu-v5e"
 
     @property
     def label(self) -> str:
@@ -177,6 +213,7 @@ class PartitionPlan:
             "predicted": (None if self.report is None
                           else self.report.to_json()),
             "baseline_imbalance": self.baseline_imbalance,
+            "scored_by": self.scored_by,
         }
 
     @classmethod
@@ -198,6 +235,7 @@ class PartitionPlan:
             report=(None if pred is None
                     else ShardReport.from_json(pred)),
             baseline_imbalance=data.get("baseline_imbalance"),
+            scored_by=str(data.get("scored_by", "reference-tpu-v5e")),
         )
 
     def save(self, path: str) -> None:
@@ -210,9 +248,17 @@ class PartitionPlan:
             return cls.from_json(json.load(f))
 
 
-def _score(report, objective: str, itemsize: int,
-           mem_bps: float, net_bps: float) -> float:
-    """Rank a candidate layout; lower is better (seconds for 'time')."""
+def score_report(report, *, objective: str = "time", itemsize: int = 8,
+                 model=None) -> float:
+    """Rank a candidate layout; lower is better (seconds for 'time').
+
+    ``report`` is a coupling-semantics ``ShardReport``
+    (``shardscope.report_for_ranges``); ``model`` a
+    ``telemetry.roofline.MachineModel`` supplying the mem/net
+    bandwidths and gather slowdown (default: :func:`reference_model`).
+    Public because the drift tracker (``telemetry.calibrate``) and the
+    replan loop (``dist_cg.solve_sequence``) re-price already-built
+    layouts with the same terms the planner used to choose them."""
     if objective == "nnz":
         from ..telemetry.shardscope import max_over_mean
 
@@ -220,9 +266,18 @@ def _score(report, objective: str, itemsize: int,
     if objective == "halo":
         return float(report.halo_send_bytes.max()
                      + report.halo_recv_bytes.max())
+    if model is None:
+        model = reference_model()
+    from ..telemetry.roofline import DEFAULT_GATHER_SLOWDOWN
+
+    mem_bps = float(model.mem_bytes_per_s)
+    net_bps = float(model.net_bytes_per_s
+                    or reference_model().net_bytes_per_s)
+    gather = float(getattr(model, "gather_slowdown",
+                           DEFAULT_GATHER_SLOWDOWN))
     # "time": modeled per-iteration stall seconds (module docstring)
     slot_term = (float(report.slots.max()) * (itemsize + 4)
-                 * GATHER_SLOWDOWN / mem_bps)
+                 * gather / mem_bps)
     payload_term = ((report.n_shards - 1) * report.n_local
                     * itemsize / net_bps)
     coupling = (report.halo_send_bytes
@@ -257,8 +312,12 @@ def plan_partition(a, n_shards: int, *, objective: str = "auto",
       itemsize: value bytes for halo/slot pricing (default: the
         matrix dtype's).
       model: a ``telemetry.roofline.MachineModel`` to price the time
-        objective against; default is the static TPU-class reference
-        table so plans are host-deterministic.
+        objective against (mem/net bandwidth AND gather slowdown);
+        default is the static TPU-class reference table
+        (:func:`reference_model`) so plans are host-deterministic.
+        Pass a ``telemetry.calibrate`` runtime-fitted model to rank
+        against measured behavior - the plan's ``scored_by`` records
+        which model chose it.
 
     Returns:
       The best :class:`PartitionPlan`; candidates are tried simplest
@@ -277,11 +336,8 @@ def plan_partition(a, n_shards: int, *, objective: str = "auto",
     n = int(a.shape[0])
     if itemsize is None:
         itemsize = int(np.asarray(a.data).dtype.itemsize)
-    mem_bps = _REFERENCE_MODEL["mem_bytes_per_s"]
-    net_bps = _REFERENCE_MODEL["net_bytes_per_s"]
-    if model is not None:
-        mem_bps = float(model.mem_bytes_per_s)
-        net_bps = float(model.net_bytes_per_s or net_bps)
+    if model is None:
+        model = reference_model()
     if reorders is None:
         reorders = ("none", "rcm", "greedy")
         if n > GREEDY_REORDER_LIMIT:
@@ -321,12 +377,14 @@ def plan_partition(a, n_shards: int, *, objective: str = "auto",
                 rep = shardscope.report_for_ranges(
                     ap, ranges, itemsize=itemsize,
                     plan=f"{rname}+{sname}")
-            score = _score(rep, objective, itemsize, mem_bps, net_bps)
+            score = score_report(rep, objective=objective,
+                                 itemsize=itemsize, model=model)
             cand = PartitionPlan(
                 n_shards=n_shards, row_ranges=ranges, permutation=perm,
                 reorder=rname, split=sname, objective=objective,
                 score=score, report=rep,
-                baseline_imbalance=baseline_imb)
+                baseline_imbalance=baseline_imb,
+                scored_by=str(model.name))
             if best is None:
                 best = cand   # none+even: the trivial baseline lane
                 trivial_score = score

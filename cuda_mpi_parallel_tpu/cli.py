@@ -157,6 +157,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "and its predicted-vs-measured imbalance ride "
                         "the solve record, --report and the "
                         "partition_plan telemetry event")
+    p.add_argument("--repeat", type=int, default=1, metavar="N",
+                   help="solve the same system N times through the "
+                        "sequence API (parallel.solve_sequence; "
+                        "assembled-CSR problems with --mesh > 1, "
+                        "general engine): each solve is timed and "
+                        "calibrates the runtime machine model "
+                        "(telemetry.calibrate - measured gather "
+                        "slowdown and net bandwidth, persisted in the "
+                        "on-disk cache), and predicted-vs-measured "
+                        "drift is tracked per solve.  The reported "
+                        "record/timing is the FINAL solve's")
+    p.add_argument("--replan", action="store_true",
+                   help="with --repeat N >= 2: re-plan solve k+1 on "
+                        "the machine model calibrated from solves "
+                        "1..k, so the second solve already runs on a "
+                        "runtime-corrected partition plan.  The "
+                        "kept/switched decision and predicted gain "
+                        "ride the 'replan' telemetry event and the "
+                        "report's calibration section.  Composes with "
+                        "--plan (the first solve's layout)")
     p.add_argument("--history", action="store_true",
                    help="print per-iteration residual trace")
     p.add_argument("--flight-record", nargs="?", const=1, default=None,
@@ -399,6 +419,7 @@ def main(argv=None) -> int:
     # the record and the report.  Composes with --rcm (the plan sees,
     # and its candidate reorders permute, the post-RCM matrix).
     plan_obj = None
+    plan_model = None   # the MachineModel that priced plan_obj, if any
     if args.plan != "even":
         from .models.operators import CSRMatrix
 
@@ -417,7 +438,13 @@ def main(argv=None) -> int:
         from .balance import PartitionPlan, plan_partition
 
         if args.plan == "auto":
-            plan_obj = plan_partition(a, args.mesh)
+            # same model preference as the API path (resolve_plan): a
+            # fresh + confident on-disk calibration for this backend/
+            # host prices the plan; absent one, the reference table
+            from .telemetry import calibrate as _tcal
+
+            plan_model = _tcal.preferred_model()
+            plan_obj = plan_partition(a, args.mesh, model=plan_model)
         else:
             try:
                 plan_obj = PartitionPlan.load(args.plan)
@@ -432,6 +459,44 @@ def main(argv=None) -> int:
         except ValueError as e:
             raise SystemExit(f"--plan {args.plan}: {e}")
         desc += f" [plan: {plan_obj.label}]"
+
+    # Solve sequences (--repeat/--replan): the runtime-calibration +
+    # replan loop rides the general distributed CSR path only - the
+    # one with a partition to re-plan.
+    if args.repeat < 1:
+        raise SystemExit(f"--repeat must be >= 1, got {args.repeat}")
+    if args.replan and args.repeat < 2:
+        raise SystemExit("--replan needs --repeat >= 2 (solve k+1 "
+                         "re-plans on the model calibrated from solve "
+                         "k; a single solve has no later solve to "
+                         "correct)")
+    if args.repeat > 1:
+        from .models.operators import CSRMatrix
+
+        if args.mesh <= 1:
+            raise SystemExit("--repeat needs --mesh > 1 (the sequence "
+                             "API calibrates and re-plans a "
+                             "distributed partition)")
+        if not isinstance(a, CSRMatrix):
+            raise SystemExit("--repeat applies to assembled-CSR "
+                             "problems only (stencil slabs are uniform "
+                             "by construction - nothing to replan)")
+        if args.engine in ("resident", "streaming"):
+            raise SystemExit(f"--repeat with --engine {args.engine} is "
+                             f"unsupported: the one-kernel engines use "
+                             f"their own partitioners (use --engine "
+                             f"general/auto)")
+        if args.dtype == "df64":
+            raise SystemExit("--repeat does not support --dtype df64 "
+                             "(the sequence API rides the f32/f64 "
+                             "general distributed path)")
+        if args.precond == "bjacobi":
+            # the single-solve path refuses this inside run(); the
+            # sequence path dispatches solve_distributed directly, so
+            # restate the refusal here rather than leak a traceback
+            raise SystemExit(
+                "--precond bjacobi is single-device only (use jacobi "
+                "or chebyshev with --mesh)")
 
     # df64 compatibility checks run BEFORE the format conversion below:
     # a doomed combination must fail fast, not after seconds of host-side
@@ -845,6 +910,7 @@ def main(argv=None) -> int:
                 return run_inner()
         return run_inner()
 
+    seq = None
     with tsession.observe_solve(
             desc, engine=args.engine, check_every=args.check_every,
             profile_dir=args.profile, problem=args.problem,
@@ -852,7 +918,30 @@ def main(argv=None) -> int:
             mesh=args.mesh,
             device=jax.devices()[0].platform) as obs:
         with obs.section("solve"):
-            elapsed, result = time_fn(run, warmup=1, repeats=1)
+            if args.repeat > 1:
+                # the calibrate-and-replan sequence loop: each solve is
+                # warmup+timed inside solve_sequence (same protocol as
+                # the time_fn below); the reported record/timing is the
+                # FINAL solve's - the one running on the most-corrected
+                # plan
+                from .parallel import make_mesh, solve_sequence
+
+                seq = solve_sequence(
+                    a, b, mesh=make_mesh(args.mesh),
+                    repeats=args.repeat, replan=args.replan,
+                    plan=plan_obj, tol=args.tol, rtol=args.rtol,
+                    maxiter=args.maxiter,
+                    preconditioner=args.precond,
+                    precond_degree=args.precond_degree,
+                    record_history=args.history, method=args.method,
+                    check_every=args.check_every,
+                    csr_comm=args.csr_comm, flight=flight_cfg)
+                elapsed, result = seq.final.elapsed_s, seq.final.result
+                # downstream reporting (record/report/plan line) shows
+                # the plan the final solve actually ran on
+                plan_obj = seq.final.plan or plan_obj
+            else:
+                elapsed, result = time_fn(run, warmup=1, repeats=1)
 
         if args.df64:
             # adapt DF64CGResult to the CGResult-shaped reporting surface
@@ -967,6 +1056,41 @@ def main(argv=None) -> int:
             # actually ran (only computed when telemetry is active)
             plan_entry["measured_imbalance"] = shard_rep_now.imbalance()
         record["plan"] = ulog.sanitize(plan_entry)
+    # Runtime calibration & drift (telemetry.calibrate): the sequence
+    # summary when --repeat ran; a single planned distributed solve
+    # still gets its predicted-vs-measured drift tracked against the
+    # model that scored its plan.  Host-side fusion only - the solve is
+    # already complete and synced.
+    calib_entry = None
+    if seq is not None:
+        calib_entry = ulog.sanitize(seq.summary())
+    elif args.mesh > 1 and plan_obj is not None \
+            and plan_obj.report is not None:
+        from .balance.plan import reference_model
+        from .telemetry import calibrate as tcal
+
+        drift_item = {"float64": 8, "df64": 8, "bfloat16": 2}.get(
+            args.dtype, 4)
+        # price drift with the model that SCORED the plan (the drift
+        # contract): a FILE-loaded plan records its scorer by name, so
+        # recover it from the calibration cache when it is this host's
+        # calibrated model; otherwise the reference table is the
+        # honest fallback and DriftReport.model says so
+        drift_model = plan_model
+        if drift_model is None \
+                and plan_obj.scored_by != "reference-tpu-v5e":
+            pref = tcal.preferred_model()
+            if pref is not None and pref.name == plan_obj.scored_by:
+                drift_model = pref
+        dr = tcal.note_drift(
+            tcal.drift_report(plan_obj.report, int(result.iterations),
+                              float(elapsed), itemsize=drift_item,
+                              model=drift_model or reference_model(),
+                              plan=plan_obj),
+            report=plan_obj.report, plan=plan_obj)
+        calib_entry = ulog.sanitize({"drift": dr.to_json()})
+    if calib_entry is not None:
+        record["calibration"] = calib_entry
     if flight_rec is not None:
         record["flight"] = flight_rec.summary()
     if health is not None:
@@ -1002,7 +1126,8 @@ def main(argv=None) -> int:
             record=record, shard=shard_rep, roofline=roof,
             flight_summary=record.get("flight"),
             health=record.get("health"),
-            comm=comm, sections=tuple(obs.timer.sections))
+            comm=comm, calibration=calib_entry,
+            sections=tuple(obs.timer.sections))
         if args.report is not None and args.report != "-":
             with open(args.report, "w", encoding="utf-8") as f:
                 f.write(solve_report.to_text())
@@ -1060,6 +1185,9 @@ def main(argv=None) -> int:
                           f"{imb['nnz_max_over_mean']:.2f})")
             print(f"plan    : {pe['label']} [{pe['fingerprint']}]"
                   f"{detail}")
+        if seq is not None:
+            for line in seq.describe_lines():
+                print(line)
         if health is not None:
             print(f"health  : {health.classification.name}: "
                   f"{health.message}")

@@ -9,7 +9,7 @@ from .streaming import (
     solve_distributed_streaming,
     solve_distributed_streaming_df64,
 )
-from .dist_cg import solve_distributed
+from .dist_cg import SequenceResult, solve_distributed, solve_sequence
 from .halo import exchange_halo, exchange_halo_axis, neighbor_shift_perms
 from .mesh import (
     COLS_AXIS,
@@ -48,6 +48,7 @@ __all__ = [
     "DistStencilDF64",
     "PartitionedCSR",
     "RingPartitionedCSR",
+    "SequenceResult",
     "exchange_halo",
     "exchange_halo_axis",
     "make_mesh",
@@ -63,4 +64,5 @@ __all__ = [
     "solve_distributed_resident",
     "solve_distributed_streaming",
     "solve_distributed_streaming_df64",
+    "solve_sequence",
 ]

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -343,12 +343,19 @@ def _note_shards(build_report) -> None:
         build_report(telemetry.shardscope))
 
 
-def resolve_plan(plan, a, n_shards):
+def resolve_plan(plan, a, n_shards, *, model=None):
     """Normalize the ``plan=`` argument of the CSR entry points:
     ``None`` passes through (the even split), ``"auto"`` runs the
     planner, a ``balance.PartitionPlan`` is validated against the
     operator and mesh.  Shared by ``solve_distributed`` and
-    ``solve_distributed_df64``."""
+    ``solve_distributed_df64``.
+
+    ``model`` prices ``"auto"`` planning: when ``None``, a fresh +
+    confident runtime calibration for this backend/host
+    (``telemetry.calibrate.preferred_model``) is preferred if one
+    exists on disk, else the deterministic reference table - so a
+    process that never calibrated plans exactly as before, and one
+    that did gets runtime-corrected plans for free."""
     if plan is None:
         return None
     from ..balance import PartitionPlan, plan_partition
@@ -358,7 +365,11 @@ def resolve_plan(plan, a, n_shards):
             raise ValueError(
                 f"plan must be None, 'auto' or a balance.PartitionPlan, "
                 f"got {plan!r}")
-        plan = plan_partition(a, n_shards)
+        if model is None:
+            from ..telemetry import calibrate
+
+            model = calibrate.preferred_model()
+        plan = plan_partition(a, n_shards, model=model)
     elif not isinstance(plan, PartitionPlan):
         raise TypeError(
             f"plan must be None, 'auto' or a balance.PartitionPlan, "
@@ -649,3 +660,291 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
                          (b_dev, vals, meta, blks, diag))(
         b_dev, vals, meta, blks, diag)
     return _unpad_result(res, parts, plan)
+
+
+# ---------------------------------------------------------------------------
+# solve sequences: calibrate from solve k, replan solve k+1
+#
+# Time-stepping and service workloads solve the same operator hundreds
+# of times; the planner's reference machine model is a guess until the
+# first solve lands.  solve_sequence closes ROADMAP item 4's loop: each
+# solve is timed, the measured per-iteration wall time fits the free
+# parameters of the planner's own cost model (telemetry.calibrate), and
+# the NEXT solve re-plans on the calibrated model - so the second solve
+# of a sequence already runs on a runtime-corrected plan.  Every
+# decision is observable: a `replan` event records kept-vs-switched
+# with the predicted gain, the extended `partition_plan` event carries
+# the model's drift %, and the calibration itself lands in the
+# measured-artifact disk cache for future processes.
+
+
+@dataclasses.dataclass(frozen=True)
+class SequenceEntry:
+    """One solve of a :func:`solve_sequence` run."""
+
+    index: int
+    result: CGResult
+    elapsed_s: float
+    plan: Optional[object]        # the PartitionPlan that ran (None=even)
+    fit: object                   # telemetry.calibrate.CalibrationFit
+    drift: object                 # telemetry.calibrate.DriftReport
+    replan: Optional[dict] = None  # decision made AFTER this solve
+
+    @property
+    def s_per_iteration(self) -> float:
+        return self.elapsed_s / max(int(self.result.iterations), 1)
+
+    def to_json(self) -> dict:
+        out = {
+            "index": self.index,
+            "iterations": int(self.result.iterations),
+            "converged": bool(self.result.converged),
+            "elapsed_s": float(self.elapsed_s),
+            "s_per_iteration": self.s_per_iteration,
+            "plan": (self.plan.label if self.plan is not None
+                     else "even"),
+            "scored_by": (self.plan.scored_by if self.plan is not None
+                          else None),
+            "fingerprint": (self.plan.fingerprint()
+                            if self.plan is not None else None),
+            "drift": self.drift.to_json(),
+        }
+        if self.replan is not None:
+            out["replan"] = dict(self.replan)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SequenceResult:
+    """Everything a :func:`solve_sequence` run measured and decided."""
+
+    entries: Tuple = ()
+
+    @property
+    def final(self) -> SequenceEntry:
+        return self.entries[-1]
+
+    @property
+    def result(self) -> CGResult:
+        return self.final.result
+
+    def summary(self) -> dict:
+        """JSON-ready digest: per-solve timings/plans/drift, the final
+        calibration, and every replan decision - what the CLI embeds as
+        the record's ``sequence`` and the report's calibration
+        section."""
+        decisions = [e.replan for e in self.entries
+                     if e.replan is not None]
+        return {
+            "repeats": len(self.entries),
+            "solves": [e.to_json() for e in self.entries],
+            "calibration": self.final.fit.to_json(),
+            "drift": self.final.drift.to_json(),
+            "decisions": decisions,
+        }
+
+    def describe_lines(self):
+        """Human lines for the CLI's text output."""
+        lines = []
+        for e in self.entries:
+            plan_s = e.plan.label if e.plan is not None else "even"
+            by = (f" [{e.plan.scored_by}]" if e.plan is not None
+                  else "")
+            lines.append(
+                f"solve {e.index + 1} : {int(e.result.iterations)} "
+                f"iters, {e.elapsed_s * 1e3:.3f} ms "
+                f"({e.s_per_iteration * 1e6:.3g} us/iter), plan "
+                f"{plan_s}{by}")
+            lines.append(f"  drift : {e.drift.describe()}")
+            if e.replan is not None:
+                r = e.replan
+                lines.append(
+                    f"  replan: {r['decision']} for solve "
+                    f"{r['solve_index'] + 1} (predicted gain "
+                    f"{r['predicted_gain_pct']:+.1f}% on {r['model']})")
+        lines.append(
+            f"calibration: {self.final.fit.describe()}")
+        return lines
+
+
+def _layout_key(plan, n: int, n_shards: int):
+    """Hashable identity of the layout a plan produces (even split for
+    ``None``) - two plans with equal keys share partition arrays and
+    the compiled solver, so switching between them is free."""
+    from ..balance.nnz_split import even_ranges
+
+    if plan is None:
+        return (even_ranges(n, n_shards), None)
+    perm = plan.permutation
+    return (plan.row_ranges,
+            None if perm is None else tuple(int(v) for v in perm))
+
+
+def _sequence_report(a, plan, n_shards: int, itemsize: int):
+    """The coupling-semantics ShardReport of the layout that ran - the
+    same accounting the planner scores, so predicted and measured price
+    identical terms.  Reuses the plan's predicted report when present
+    (same inputs, O(nnz) walk already paid)."""
+    from ..balance.nnz_split import even_ranges
+    from ..telemetry import shardscope
+
+    if plan is not None and plan.report is not None:
+        return plan.report
+    if plan is None:
+        return shardscope.report_for_ranges(
+            a, even_ranges(int(a.shape[0]), n_shards),
+            itemsize=itemsize, plan="none+even")
+    ap = a.permuted(plan.permutation) if plan.permutation is not None \
+        else a
+    return shardscope.report_for_ranges(
+        ap, plan.row_ranges, itemsize=itemsize, plan=plan.label)
+
+
+def solve_sequence(
+    a,
+    b,
+    *,
+    mesh: Optional[Mesh] = None,
+    n_devices: Optional[int] = None,
+    repeats: int = 2,
+    replan: bool = True,
+    plan=None,
+    calibration_cache=None,
+    persist_calibration: bool = True,
+    **kw,
+) -> SequenceResult:
+    """Solve the same system ``repeats`` times, calibrating the machine
+    model from each solve and (with ``replan=True``) re-planning the
+    next solve on it.
+
+    Args:
+      a: global assembled ``CSRMatrix`` (the planned distributed path;
+        stencil slabs are uniform by construction and have nothing to
+        replan).
+      b: global right-hand side, identical across the sequence.
+      repeats: sequence length (>= 1).
+      replan: re-plan solve k+1 on the model calibrated from solves
+        1..k.  The decision is hysteretic (a different layout must beat
+        the incumbent's calibrated score by > 2%, matching the
+        planner's own threshold) and always recorded as a ``replan``
+        event; a same-layout replan re-scores the incumbent under the
+        calibrated model without recompiling (equal fingerprint, same
+        solver-cache entry).
+      plan: the FIRST solve's plan (``None`` = even split, ``"auto"``,
+        or a ``balance.PartitionPlan``) - later solves are governed by
+        ``replan``.
+      calibration_cache: ``utils.tune.JsonCache`` override (tests);
+        ``persist_calibration=False`` keeps fits in-process only.
+      **kw: forwarded to :func:`solve_distributed` (tol/maxiter/
+        method/csr_comm/flight/...).
+
+    Each solve is dispatched twice (compile warmup + timed, the CLI's
+    own protocol) so the calibration never ingests compile time; warmup
+    events carry ``phase="warmup"``.  Returns a :class:`SequenceResult`
+    whose ``entries[k]`` hold the per-solve result, plan, calibration
+    fit, drift report and replan decision.
+    """
+    from .. import telemetry
+    from ..balance import plan_partition
+    from ..balance.plan import reference_model, score_report
+    from ..telemetry import calibrate as tcal
+    from ..telemetry.registry import REGISTRY
+    from ..utils.timing import time_fn
+
+    if not isinstance(a, CSRMatrix):
+        raise ValueError(
+            f"solve_sequence replans assembled CSRMatrix problems; "
+            f"{type(a).__name__} slabs are uniform by construction")
+    if repeats < 1:
+        raise ValueError(f"repeats must be >= 1, got {repeats}")
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    n_shards = int(mesh.devices.size)
+    n = int(a.shape[0])
+    itemsize = int(np.asarray(a.data).dtype.itemsize)
+
+    # one cache consultation, against the CALLER's cache: passing the
+    # resolved model explicitly keeps resolve_plan's own default-cache
+    # lookup out of the picture, so calibration_cache= isolates reads
+    # as well as writes
+    scoring_model = tcal.preferred_model(cache=calibration_cache)
+    if scoring_model is None:
+        scoring_model = reference_model()
+    current = resolve_plan(plan, a, n_shards, model=scoring_model)
+
+    observations = []
+    entries = []
+    for k in range(repeats):
+        plan_k = current
+        calls = [0]
+
+        def once():
+            calls[0] += 1
+            if calls[0] == 1:
+                with telemetry.events.scoped(phase="warmup"):
+                    return solve_distributed(a, b, mesh=mesh,
+                                             plan=plan_k, **kw)
+            return solve_distributed(a, b, mesh=mesh, plan=plan_k, **kw)
+
+        elapsed, res = time_fn(once, warmup=1, repeats=1)
+        iterations = max(int(res.iterations), 1)
+
+        report = _sequence_report(a, plan_k, n_shards, itemsize)
+        observations.append(tcal.observation_for(
+            report, iterations, elapsed, itemsize=itemsize,
+            label=f"solve{k}"))
+        fit = tcal.fit_machine_model(observations)
+        tcal.note_calibration(fit)
+        if persist_calibration:
+            tcal.store_calibration(fit, cache=calibration_cache)
+        drift = tcal.note_drift(
+            tcal.drift_report(report, iterations, elapsed,
+                              itemsize=itemsize, model=scoring_model,
+                              plan=plan_k),
+            report=report, plan=plan_k, n_shards=n_shards)
+
+        decision = None
+        if replan and k + 1 < repeats:
+            cand = plan_partition(a, n_shards, model=fit.model,
+                                  itemsize=itemsize)
+            incumbent_score = score_report(report, itemsize=itemsize,
+                                           model=fit.model)
+            gain_pct = 100.0 * (incumbent_score - cand.score) \
+                / max(incumbent_score, 1e-300)
+            same = _layout_key(cand, n, n_shards) \
+                == _layout_key(plan_k, n, n_shards)
+            if same or cand.score < incumbent_score * 0.98:
+                # adopt the calibrated-scored plan: same layout means a
+                # free re-score (equal fingerprint, cached solver);
+                # a different layout must clear the 2% hysteresis
+                next_plan = resolve_plan(cand, a, n_shards)
+                switched = not same
+            else:
+                next_plan = plan_k
+                switched = False
+            decision = {
+                "solve_index": k + 1,
+                "decision": "switched" if switched else "kept",
+                "predicted_gain_pct": float(gain_pct),
+                "model": fit.model.name,
+                "confident": fit.confident,
+                "from": (plan_k.fingerprint() if plan_k is not None
+                         else "even"),
+                "to": (next_plan.fingerprint()
+                       if next_plan is not None else "even"),
+            }
+            if telemetry.events.active():
+                telemetry.events.emit("replan", **decision)
+            REGISTRY.gauge(
+                "replan_predicted_gain_pct",
+                "predicted per-iteration stall-time gain of the most "
+                "recent replan decision (calibrated model)",
+                labelnames=("decision",)).set(
+                    float(gain_pct), decision=decision["decision"])
+            current = next_plan
+            scoring_model = fit.model
+
+        entries.append(SequenceEntry(
+            index=k, result=res, elapsed_s=float(elapsed), plan=plan_k,
+            fit=fit, drift=drift, replan=decision))
+    return SequenceResult(entries=tuple(entries))

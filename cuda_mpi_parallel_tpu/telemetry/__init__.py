@@ -39,7 +39,13 @@ that gap with four composable parts:
   comm-bound classification;
 * :mod:`.report` - the fusion layer: one human-readable solve report
   (text + JSON) over all of the above, and the Chrome-trace/Perfetto
-  timeline exporter (one track per shard, one for host phases).
+  timeline exporter (one track per shard, one for host phases);
+* :mod:`.calibrate` - the runtime-measured machine model: fit the
+  planner/roofline cost parameters (gather slowdown, net bandwidth)
+  from observed solves, track predicted-vs-measured drift as gauges
+  and extended ``partition_plan`` events, and persist calibrations in
+  the on-disk measured-artifact cache so ``solve_sequence`` replans
+  solve k+1 on the model calibrated from solve k.
 
 Everything is opt-in: with no event sink configured and metrics
 untouched, every instrumentation hook in the solver/parallel layers is
@@ -49,6 +55,7 @@ either way (asserted by tests/test_cost_accounting.py).
 from __future__ import annotations
 
 from . import (
+    calibrate,
     cost,
     events,
     flight,
@@ -59,6 +66,7 @@ from . import (
     session,
     shardscope,
 )
+from .calibrate import CalibrationFit, DriftReport
 from .events import EventStream, configure, emit, validate_event
 from .flight import FlightConfig, FlightRecord
 from .health import SolveHealth, assess_solve_health
@@ -91,6 +99,8 @@ def active() -> bool:
 
 
 __all__ = [
+    "CalibrationFit",
+    "DriftReport",
     "EventStream",
     "FlightConfig",
     "FlightRecord",
@@ -103,6 +113,7 @@ __all__ = [
     "SolveReport",
     "active",
     "assess_solve_health",
+    "calibrate",
     "configure",
     "cost",
     "emit",

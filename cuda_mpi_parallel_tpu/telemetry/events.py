@@ -85,8 +85,16 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     # applied to a distributed solve: the chosen reorder/split lane plus
     # the planner's predicted imbalance digest joined to the measured
     # one of the partition actually built - the shardscope feedback
-    # loop, closed, in one event
+    # loop, closed, in one event.  A second, EXTENDED emission with
+    # stage="drift" (telemetry.calibrate.note_drift) follows a measured
+    # solve and additionally carries drift_pct /
+    # predicted_s_per_iteration / measured_s_per_iteration - the
+    # model-error % of the plan's cost prediction
     "partition_plan": ("reorder", "split", "n_shards", "measured"),
+    # a sequence replan decision (dist_cg.solve_sequence): whether
+    # solve k+1 kept or switched its partition plan based on the model
+    # calibrated from solve k, with the predicted gain of the choice
+    "replan": ("solve_index", "decision"),
     # sampled in-flight heartbeat (FlightConfig.heartbeat > 0 only;
     # posted from the hot loop via an unordered jax.debug.callback)
     "flight_heartbeat": ("iteration",),

@@ -70,6 +70,10 @@ class SolveReport:
     flight_summary: Optional[dict] = None   # FlightRecord.summary()
     health: Optional[dict] = None           # SolveHealth.to_json()
     comm: Optional[dict] = None             # CLI per-solve comm account
+    #: runtime calibration & drift (telemetry.calibrate): either a
+    #: SequenceResult.summary() (--repeat runs) or a bare
+    #: {"drift": DriftReport.to_json()} for a single planned solve
+    calibration: Optional[dict] = None
     sections: Sequence[Tuple[str, float]] = ()
 
     def to_json(self) -> dict:
@@ -84,6 +88,8 @@ class SolveReport:
             out["health"] = dict(self.health)
         if self.comm is not None:
             out["comm"] = dict(self.comm)
+        if self.calibration is not None:
+            out["calibration"] = dict(self.calibration)
         if self.sections:
             out["sections"] = {name: s for name, s in self.sections}
         return sanitize(out)
@@ -122,9 +128,12 @@ class SolveReport:
                 lines.append(f"({self.comm['note']})")
         if self.roofline is not None:
             r = self.roofline
+            age = getattr(r, "model_age_s", None)
+            age_s = f", measured {age / 3600:.1f}h ago" \
+                if age is not None else ""
             lines.append("")
-            lines.append(f"-- roofline ({r.model.name}, {r.model.source}) "
-                         f"--")
+            lines.append(f"-- roofline ({r.model.name}, {r.model.source}"
+                         f"{age_s}) --")
             lines.append(
                 f"per-iteration model: {r.flops_per_iteration:.3g} flops, "
                 f"{r.mem_bytes_per_iteration:.3g} mem B, "
@@ -139,6 +148,10 @@ class SolveReport:
                 f"({r.model_s_per_iteration * 1e6:.3g} us model vs "
                 f"{r.measured_s_per_iteration * 1e6:.3g} us measured "
                 f"per iteration)")
+        if self.calibration is not None:
+            lines.append("")
+            lines.append("-- calibration & drift --")
+            lines.extend(_calibration_lines(self.calibration))
         if self.health is not None:
             lines.append("")
             lines.append(f"-- solve health --")
@@ -156,6 +169,49 @@ class SolveReport:
             for name, sec in self.sections:
                 lines.append(f"  {name:>12}: {sec * 1e3:9.3f} ms")
         return "\n".join(lines) + "\n"
+
+
+def _calibration_lines(calib: Dict[str, Any]) -> List[str]:
+    """Render the calibration/drift payload (tolerant of both shapes:
+    a SequenceResult.summary() or a bare single-solve drift dict)."""
+    lines: List[str] = []
+    fit = calib.get("calibration")
+    if isinstance(fit, dict) and isinstance(fit.get("model"), dict):
+        m = fit["model"]
+        net = m.get("net_bytes_per_s") or 0.0
+        lines.append(
+            f"model {m.get('name', '?')}: gather slowdown "
+            f"x{m.get('gather_slowdown', 0.0):.2f}, net "
+            f"{net / 1e9:.3f} GB/s, fit {fit.get('method', '?')} "
+            f"(residual {fit.get('residual_rel', 0.0) * 100:.1f}%, "
+            f"{'confident' if fit.get('confident') else 'LOW CONFIDENCE'}"
+            f", {fit.get('n_observations', 0)} obs)")
+    drift = calib.get("drift")
+    if isinstance(drift, dict):
+        lines.append(
+            f"drift: model error {drift.get('drift_pct', 0.0):+.1f}% "
+            f"(predicted "
+            f"{drift.get('predicted_s_per_iteration', 0.0) * 1e6:.3g} "
+            f"us/iter vs measured "
+            f"{drift.get('measured_s_per_iteration', 0.0) * 1e6:.3g}, "
+            f"model {drift.get('model', '?')}, plan "
+            f"{drift.get('plan', '?')})")
+    for dec in calib.get("decisions") or ():
+        lines.append(
+            f"replan: {dec.get('decision', '?')} for solve "
+            f"{dec.get('solve_index', 0) + 1} (predicted gain "
+            f"{dec.get('predicted_gain_pct', 0.0):+.1f}% on "
+            f"{dec.get('model', '?')})")
+    for s in calib.get("solves") or ():
+        lines.append(
+            f"solve {s.get('index', 0) + 1}: "
+            f"{s.get('iterations', '?')} iters, "
+            f"{s.get('elapsed_s', 0.0) * 1e3:.3f} ms, plan "
+            f"{s.get('plan', '?')}"
+            + (f" [{s['scored_by']}]" if s.get("scored_by") else ""))
+    if not lines:
+        lines.append("(no calibration data)")
+    return lines
 
 
 # ---------------------------------------------------------------------------

@@ -40,6 +40,8 @@ import numpy as np
 from .cost import analytic_solve_ops
 
 __all__ = [
+    "CPU_MODEL_MAX_AGE_S",
+    "DEFAULT_GATHER_SLOWDOWN",
     "MachineModel",
     "RooflineReport",
     "analyze",
@@ -47,6 +49,16 @@ __all__ = [
     "operator_nnz",
     "solve_traffic",
 ]
+
+#: Effective slowdown of per-slot sparse-gather work versus the
+#: streaming bandwidth a machine model quotes (the per-entry x gather
+#: is random access, 1-2 orders slower per element than a streamed
+#: read on the repo's own benches).  8 is the deliberately conservative
+#: table default; ``telemetry.calibrate`` replaces it with a measured
+#: value.  Lives on :class:`MachineModel` so the planner
+#: (``balance.plan``), this roofline and the calibrator share ONE
+#: parameter set.
+DEFAULT_GATHER_SLOWDOWN = 8.0
 
 #: Documented approximations for TPU-class parts (the container's
 #: target): v5e-class HBM ~819 GB/s, f32 vector/matrix mix ~2e13
@@ -63,24 +75,57 @@ _GENERIC_MODEL = dict(name="generic", mem_bytes_per_s=1.0e10,
                       flops_per_s=5.0e9, net_bytes_per_s=1.0e9,
                       source="table")
 
+#: Disk-cached CPU self-calibrations older than this are re-measured
+#: (a week: host hardware does not drift, but kernels/libraries do).
+CPU_MODEL_MAX_AGE_S = 7 * 24 * 3600.0
+
 
 @dataclasses.dataclass(frozen=True)
 class MachineModel:
-    """Peak rates the roofline measures against."""
+    """Peak rates the roofline measures against.
+
+    ``gather_slowdown`` prices per-slot sparse-gather work against the
+    streaming ``mem_bytes_per_s`` (see :data:`DEFAULT_GATHER_SLOWDOWN`);
+    ``created_at`` is the unix stamp of a measured (calibrated) model -
+    ``None`` for timeless table entries - so reports can say how old
+    the numbers that priced them are.
+    """
 
     name: str
     mem_bytes_per_s: float
     flops_per_s: float
     net_bytes_per_s: Optional[float] = None
     source: str = "table"          # "table" | "calibrated"
+    gather_slowdown: float = DEFAULT_GATHER_SLOWDOWN
+    created_at: Optional[float] = None
 
     @property
     def ridge_flops_per_byte(self) -> float:
         """Arithmetic intensity where compute overtakes memory."""
         return self.flops_per_s / self.mem_bytes_per_s
 
+    @property
+    def age_s(self) -> Optional[float]:
+        """Seconds since this model was measured (None for tables)."""
+        if self.created_at is None:
+            return None
+        return max(time.time() - self.created_at, 0.0)
+
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, data: dict) -> "MachineModel":
+        if not isinstance(data, dict):
+            # a truncated/hand-edited cache entry whose payload is JSON
+            # but not an object must surface as the TypeError the cache
+            # readers already treat as a miss, not an AttributeError
+            # that escapes them and breaks every later solve
+            raise TypeError(
+                f"machine model JSON must be an object, got "
+                f"{type(data).__name__}")
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in fields})
 
 
 def _calibrate_cpu() -> MachineModel:
@@ -120,9 +165,41 @@ def _calibrate_cpu() -> MachineModel:
 _CACHED_CPU: list = [None]
 
 
-def machine_model(backend: Optional[str] = None) -> MachineModel:
+def _cpu_model(cache=None) -> MachineModel:
+    """The CPU model, via the measured-artifact disk cache: a fresh
+    (< :data:`CPU_MODEL_MAX_AGE_S`) entry for this host is reused
+    across processes; otherwise the one-shot self-benchmark runs and
+    its result is stored (best-effort - an unwritable cache dir only
+    means re-measuring next process)."""
+    from ..utils.tune import JsonCache, host_fingerprint
+
+    if cache is None:
+        cache = JsonCache()
+    key = f"machine-model-cpu-{host_fingerprint()}"
+    entry = cache.get(key, max_age_s=CPU_MODEL_MAX_AGE_S)
+    if entry is not None:
+        try:
+            model = MachineModel.from_json(entry["payload"])
+            if model.mem_bytes_per_s > 0 and model.flops_per_s > 0:
+                return model
+        except (TypeError, KeyError):
+            pass  # malformed/old-format entry: re-measure
+    model = dataclasses.replace(_calibrate_cpu(), created_at=time.time())
+    try:
+        cache.put(key, model.to_json(), created_at=model.created_at)
+    except (OSError, ValueError):
+        pass
+    return model
+
+
+def machine_model(backend: Optional[str] = None, *,
+                  cache=None) -> MachineModel:
     """The machine model for ``backend`` (default: jax's default
-    backend).  CPU models are calibrated once per process and cached."""
+    backend).  CPU models are self-calibrated at most once per process
+    AND persisted in the ``utils.tune.JsonCache`` disk cache (keyed by
+    host fingerprint, week-stale), so repeat processes on the same host
+    reuse one measurement; ``cache`` overrides the cache location
+    (tests)."""
     if backend is None:
         import jax
 
@@ -130,8 +207,10 @@ def machine_model(backend: Optional[str] = None) -> MachineModel:
     if backend == "tpu":
         return MachineModel(**_TPU_MODEL)
     if backend == "cpu":
+        if cache is not None:
+            return _cpu_model(cache)
         if _CACHED_CPU[0] is None:
-            _CACHED_CPU[0] = _calibrate_cpu()
+            _CACHED_CPU[0] = _cpu_model()
         return _CACHED_CPU[0]
     return MachineModel(**_GENERIC_MODEL)
 
@@ -201,6 +280,11 @@ class RooflineReport:
     measured_s_per_iteration: float
     efficiency_pct: float            # model bound / measured, x100
     bound: str                       # memory | compute | communication
+    #: provenance of the pricing model: its ``source`` mirrored up so
+    #: report JSON says which model priced it without digging into
+    #: ``model``, and the model's age at analysis time (None = table)
+    model_source: str = "table"
+    model_age_s: Optional[float] = None
 
     def to_json(self) -> dict:
         out = dataclasses.asdict(self)
@@ -257,4 +341,5 @@ def analyze(*, n: int, nnz: int, itemsize: int, iterations: int,
         model_s_per_iteration=model_iter,
         measured_s_per_iteration=measured_iter,
         efficiency_pct=100.0 * model_iter / measured_iter,
-        bound=bound)
+        bound=bound, model_source=model.source,
+        model_age_s=model.age_s)

@@ -1,4 +1,4 @@
-"""Empirical configuration autotuner.
+"""Empirical configuration autotuner + the measured-artifact disk cache.
 
 The framework exposes performance knobs whose best setting is
 hardware/problem dependent: ``check_every`` (predicate cadence),
@@ -9,13 +9,117 @@ The reference has no equivalent - its one configuration is hardcoded
 per-iteration cost on the actual device with the actual operator
 (iteration-count deltas, so the ~0.5 s tunneled-dispatch floor cancels)
 and returns the fastest configuration as ready-to-splat solver kwargs.
+
+:class:`JsonCache` is the on-disk home for everything *measured* on
+this host that is worth keeping across processes: the roofline's
+CPU-calibrated machine model and ``telemetry.calibrate``'s runtime-
+fitted models live here (keyed by backend + :func:`host_fingerprint`),
+and future autotune winners (ROADMAP item 3) belong here too.  Entries
+carry a ``created_at`` stamp and readers pass a staleness bound - a
+measurement from last month's kernel is treated as absent, never
+silently trusted.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+import hashlib
+import json
+import os
+import re
+import tempfile
+import time
+from typing import Any, Dict, Optional, Tuple
 
 from .timing import time_fn
+
+#: environment override for the cache directory (tests and CI point
+#: this at a scratch dir so measured artifacts never leak across runs)
+CACHE_DIR_ENV = "CUDA_MPI_PARALLEL_TPU_CACHE_DIR"
+
+
+def default_cache_dir() -> str:
+    env = os.environ.get(CACHE_DIR_ENV)
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "cuda_mpi_parallel_tpu")
+
+
+def host_fingerprint() -> str:
+    """Short stable digest of THIS host (node name, arch, core count):
+    the cache key component that keeps one machine's measured bandwidths
+    from pricing another machine's plans."""
+    import platform
+
+    raw = f"{platform.node()}|{platform.machine()}|{os.cpu_count()}"
+    return hashlib.sha1(raw.encode()).hexdigest()[:12]
+
+
+class JsonCache:
+    """Tiny key -> JSON-payload disk cache with creation stamps.
+
+    One file per key under ``directory`` (default:
+    ``$CUDA_MPI_PARALLEL_TPU_CACHE_DIR`` or
+    ``~/.cache/cuda_mpi_parallel_tpu``).  Writes are atomic
+    (tmp + rename) so a crashed writer can never leave a half-entry;
+    reads treat a corrupt or stale file as a miss, never an error -
+    cache failure must degrade to "measure again", not break a solve.
+    """
+
+    def __init__(self, directory: Optional[str] = None):
+        self.directory = directory or default_cache_dir()
+
+    def path(self, key: str) -> str:
+        safe = re.sub(r"[^A-Za-z0-9._-]", "_", key)
+        return os.path.join(self.directory, f"{safe}.json")
+
+    def get(self, key: str,
+            max_age_s: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """The envelope ``{"created_at": unix_s, "payload": {...}}`` for
+        ``key``, or ``None`` when missing, unparseable, malformed, or
+        older than ``max_age_s``."""
+        try:
+            with open(self.path(key), encoding="utf-8") as f:
+                entry = json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError):
+            return None
+        if not isinstance(entry, dict) \
+                or not isinstance(entry.get("created_at"), (int, float)) \
+                or "payload" not in entry:
+            return None
+        if max_age_s is not None \
+                and time.time() - entry["created_at"] > max_age_s:
+            return None
+        return entry
+
+    def put(self, key: str, payload: Any,
+            created_at: Optional[float] = None) -> str:
+        """Atomically write ``payload`` under ``key``; returns the entry
+        path.  Raises ``OSError`` on an unwritable directory - callers
+        that can live without persistence catch it."""
+        os.makedirs(self.directory, exist_ok=True)
+        path = self.path(key)
+        entry = {"created_at": (time.time() if created_at is None
+                                else float(created_at)),
+                 "payload": payload}
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(entry, f, allow_nan=False)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self.path(key))
+        except OSError:
+            pass
 
 
 @dataclasses.dataclass(frozen=True)

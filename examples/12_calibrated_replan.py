@@ -1,0 +1,72 @@
+"""Runtime calibration and replanning across a solve sequence.
+
+The partition planner prices layouts with a fixed reference machine
+model - a table gather-slowdown of 8, a table net bandwidth.  Real
+workloads solve the same operator hundreds of times, so the FIRST
+solve's measured wall time can fit those parameters and the SECOND
+solve can already run on a runtime-corrected plan.  This example runs
+a 2-solve sequence on the committed skewed fixture: solve 1 runs the
+even split under the reference model, its timing calibrates an
+effective gather slowdown + net bandwidth (telemetry.calibrate), the
+replan decision is made on the calibrated model, and solve 2 runs on
+the plan that model chose - with the model's own error (drift)
+printed for both solves.
+
+On a multi-chip host this spans real devices; on CPU set
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+(or just run tests/, whose conftest does it for you).
+Run: python examples/12_calibrated_replan.py
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# keep this demo's 240-row calibration out of the host's real
+# measured-model cache (a production sequence would persist it so the
+# NEXT process plans calibrated from its first solve)
+os.environ.setdefault("CUDA_MPI_PARALLEL_TPU_CACHE_DIR",
+                      tempfile.mkdtemp(prefix="cmpt-example-"))
+
+import jax
+import numpy as np
+
+from cuda_mpi_parallel_tpu.models import mmio
+from cuda_mpi_parallel_tpu.parallel import make_mesh, solve_sequence
+from cuda_mpi_parallel_tpu.telemetry import calibrate
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "..", "tests",
+                       "fixtures", "skewed_spd_240.mtx")
+
+ndev = min(4, len(jax.devices()))
+a = mmio.load_matrix_market(FIXTURE)
+rng = np.random.default_rng(0)
+b = rng.standard_normal(a.shape[0])
+
+print(f"system: n={a.shape[0]}, nnz={a.nnz}, mesh={ndev}")
+print("solve 1 runs the even split scored by the REFERENCE model;")
+print("solve 2 re-plans on the model calibrated from solve 1.\n")
+
+seq = solve_sequence(a, b, mesh=make_mesh(ndev), repeats=2,
+                     replan=True, tol=1e-10, maxiter=2000)
+for line in seq.describe_lines():
+    print(line)
+
+fit = seq.final.fit
+print(f"\nmeasured gather slowdown: x{fit.model.gather_slowdown:.1f} "
+      f"(the table guessed x8.0)")
+print(f"solve-2 plan scored by  : {seq.final.plan.scored_by}"
+      if seq.final.plan is not None else "solve-2 kept the even split")
+
+# the calibration is on disk now: a fresh process on this host would
+# prefer it for any plan='auto' solve (when the fit is confident)
+preferred = calibrate.preferred_model()
+print(f"preferred model on disk : "
+      f"{preferred.name if preferred is not None else None} "
+      f"(confident fit: {fit.confident})")
+
+drift1 = seq.entries[0].drift.drift_pct
+drift2 = seq.entries[1].drift.drift_pct
+print(f"\nmodel error (drift)     : {drift1:+.0f}% under the reference "
+      f"model -> {drift2:+.0f}% under the calibrated one")

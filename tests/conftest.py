@@ -9,8 +9,17 @@ float64 is enabled so the reference's f64 semantics (``CUDA_R_64F``,
 Environment must be set before jax is imported, hence the module-top code.
 """
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Measured-artifact disk cache (utils.tune.JsonCache): FORCE it to a
+# per-session scratch dir - never setdefault - so (a) tests never read
+# any real calibrated machine models (a leftover confident calibration,
+# including one in a developer-exported cache dir, would silently
+# change every plan="auto" lane) and (b) calibrations written by tests
+# never leak out of the session.
+os.environ["CUDA_MPI_PARALLEL_TPU_CACHE_DIR"] = \
+    tempfile.mkdtemp(prefix="cmpt-test-cache-")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

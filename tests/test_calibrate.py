@@ -1,0 +1,576 @@
+"""calibra: runtime-measured machine model, drift tracking, replanning.
+
+The calibrator's claims are quantitative, so the tests are numeric:
+the least-squares fit must RECOVER hand-chosen bandwidths from
+synthetic timings, the disk cache must honor staleness, drift must be
+the exact predicted-vs-measured ratio, the mesh-4 sequence on the
+committed skewed fixture must run solve 2 on a plan scored by the
+solve-1-calibrated model with the ``replan`` event fired, and with
+calibration off the traced solve must be jaxpr-bit-identical to
+pre-calibra behavior (ISSUE 6 acceptance).
+"""
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from cuda_mpi_parallel_tpu import solve, telemetry
+from cuda_mpi_parallel_tpu.balance import (
+    plan_partition,
+    reference_model,
+    score_report,
+)
+from cuda_mpi_parallel_tpu.models import mmio
+from cuda_mpi_parallel_tpu.telemetry import calibrate as cal
+from cuda_mpi_parallel_tpu.telemetry import events
+from cuda_mpi_parallel_tpu.telemetry import roofline as roof
+from cuda_mpi_parallel_tpu.telemetry import shardscope as ss
+from cuda_mpi_parallel_tpu.telemetry.registry import REGISTRY
+from cuda_mpi_parallel_tpu.utils import compat
+from cuda_mpi_parallel_tpu.utils.tune import JsonCache, host_fingerprint
+
+needs_mesh = pytest.mark.skipif(
+    not compat.has_shard_map() or len(jax.devices()) < 4,
+    reason="needs shard_map and >= 4 (virtual) devices")
+
+FIXTURE = "tests/fixtures/skewed_spd_240.mtx"
+
+BASE = roof.MachineModel(
+    name="unit-base", mem_bytes_per_s=8.0e11, flops_per_s=2.0e13,
+    net_bytes_per_s=4.5e10, source="table", gather_slowdown=8.0)
+
+
+def synthetic_obs(gather_bw, net_bw, gather_bytes, net_bytes,
+                  iterations=100, label=""):
+    """An observation whose per-iteration time is EXACTLY the model at
+    the given bandwidths - what a noiseless measurement would see."""
+    t_iter = gather_bytes / gather_bw + net_bytes / net_bw
+    return cal.PhaseObservation(
+        iterations=iterations, elapsed_s=t_iter * iterations,
+        gather_bytes_per_iteration=gather_bytes,
+        net_bytes_per_iteration=net_bytes, label=label)
+
+
+class TestFit:
+    def test_two_observations_recover_known_bandwidths(self):
+        gather_bw, net_bw = 2.0e10, 5.0e9
+        obs = [synthetic_obs(gather_bw, net_bw, 1e6, 1e5),
+               synthetic_obs(gather_bw, net_bw, 4e6, 2e5)]
+        fit = cal.fit_machine_model(obs, base=BASE, backend="unit")
+        assert fit.method == "lstsq2"
+        assert fit.model.net_bytes_per_s == pytest.approx(net_bw,
+                                                          rel=1e-6)
+        # gather_slowdown = stream_bw / fitted gather_bw
+        assert fit.model.gather_slowdown == pytest.approx(
+            BASE.mem_bytes_per_s / gather_bw, rel=1e-6)
+        assert fit.residual_rel == pytest.approx(0.0, abs=1e-9)
+        assert fit.confident
+        assert fit.model.source == "calibrated"
+        assert fit.model.created_at is not None
+        assert fit.backend == "unit"
+
+    def test_single_observation_pins_net_at_base(self):
+        gather_bw = 1.0e10
+        obs = [synthetic_obs(gather_bw, BASE.net_bytes_per_s, 2e6, 3e5)]
+        fit = cal.fit_machine_model(obs, base=BASE, backend="unit")
+        assert fit.method == "fixed-net"
+        assert fit.model.net_bytes_per_s == pytest.approx(
+            BASE.net_bytes_per_s)
+        assert fit.model.gather_slowdown == pytest.approx(
+            BASE.mem_bytes_per_s / gather_bw, rel=1e-6)
+        assert fit.confident  # 100 iterations, exact fit
+
+    def test_too_few_iterations_not_confident(self):
+        obs = [synthetic_obs(1e10, BASE.net_bytes_per_s, 2e6, 3e5,
+                             iterations=3)]
+        fit = cal.fit_machine_model(obs, base=BASE, backend="unit")
+        assert fit.total_iterations == 3 \
+            < cal.MIN_CALIBRATION_ITERATIONS
+        assert not fit.confident
+
+    def test_inexplicable_data_falls_back_proportional(self):
+        # measured time SMALLER than the net term alone at base
+        # bandwidth: no positive gather bandwidth explains it
+        t_net_alone = 3e5 / BASE.net_bytes_per_s
+        obs = [cal.PhaseObservation(
+            iterations=100, elapsed_s=0.1 * t_net_alone * 100,
+            gather_bytes_per_iteration=2e6,
+            net_bytes_per_iteration=3e5)]
+        fit = cal.fit_machine_model(obs, base=BASE, backend="unit")
+        assert fit.method == "proportional"
+        assert not fit.confident
+        assert fit.model.gather_slowdown > 0
+        assert (fit.model.net_bytes_per_s or 0) > 0
+
+    def test_noisy_fit_reports_residual(self):
+        gather_bw = 1.0e10
+        clean = synthetic_obs(gather_bw, BASE.net_bytes_per_s, 2e6, 3e5)
+        noisy = cal.PhaseObservation(
+            iterations=100, elapsed_s=clean.elapsed_s * 3.0,
+            gather_bytes_per_iteration=2e6,
+            net_bytes_per_iteration=3e5)
+        fit = cal.fit_machine_model([clean, noisy], base=BASE,
+                                    backend="unit")
+        assert fit.residual_rel > cal.CONFIDENT_RESIDUAL
+        assert not fit.confident
+
+    def test_empty_observations_raise(self):
+        with pytest.raises(ValueError, match="observation"):
+            cal.fit_machine_model([], base=BASE, backend="unit")
+
+    def test_observation_validation(self):
+        with pytest.raises(ValueError):
+            cal.PhaseObservation(0, 1.0, 1.0, 1.0)
+        with pytest.raises(ValueError):
+            cal.PhaseObservation(10, 0.0, 1.0, 1.0)
+
+    def test_fit_json_roundtrip(self):
+        obs = [synthetic_obs(1e10, 5e9, 1e6, 1e5),
+               synthetic_obs(1e10, 5e9, 4e6, 2e5)]
+        fit = cal.fit_machine_model(obs, base=BASE, backend="unit")
+        back = cal.CalibrationFit.from_json(
+            json.loads(json.dumps(fit.to_json())))
+        assert back.model.gather_slowdown == pytest.approx(
+            fit.model.gather_slowdown)
+        assert back.confident == fit.confident
+        assert back.method == fit.method
+        assert "gather" in fit.describe()
+
+
+class TestObservationFor:
+    def test_bytes_match_planner_terms(self):
+        rep = ss.ShardReport.from_json({
+            "kind": "ranges", "n_shards": 4, "n_global": 16,
+            "n_global_padded": 16, "n_local": 4,
+            "rows": [4, 4, 4, 4], "nnz": [19, 4, 4, 4],
+            "slots": [19, 19, 19, 19],
+            "halo_send_bytes": [16, 16, 16, 16],
+            "halo_recv_bytes": [48, 48, 48, 48],
+            "neighbors": [[[-1, 16]]] * 4,
+        })
+        obs = cal.observation_for(rep, 10, 0.5, itemsize=8)
+        assert obs.gather_bytes_per_iteration == 19 * (8 + 4)
+        # fixed x-rotation payload + down-weighted peak coupling
+        assert obs.net_bytes_per_iteration == pytest.approx(
+            (4 - 1) * 4 * 8 + 0.25 * (16 + 48))
+        assert obs.s_per_iteration == pytest.approx(0.05)
+        # the jaxpr-derived payload, when known, replaces the analytic
+        # x-rotation term
+        obs2 = cal.observation_for(rep, 10, 0.5, itemsize=8,
+                                   comm_bytes_per_iteration=1000.0)
+        assert obs2.net_bytes_per_iteration == pytest.approx(
+            1000.0 + 0.25 * (16 + 48))
+
+
+class TestJsonCache:
+    def test_roundtrip(self, tmp_path):
+        c = JsonCache(str(tmp_path))
+        c.put("some key/with:odd chars", {"x": 1.5})
+        entry = c.get("some key/with:odd chars")
+        assert entry["payload"] == {"x": 1.5}
+        assert entry["created_at"] == pytest.approx(time.time(), abs=60)
+
+    def test_staleness(self, tmp_path):
+        c = JsonCache(str(tmp_path))
+        c.put("k", {"v": 1}, created_at=time.time() - 100.0)
+        assert c.get("k") is not None
+        assert c.get("k", max_age_s=50.0) is None
+        assert c.get("k", max_age_s=1000.0) is not None
+
+    def test_corrupt_and_missing_are_misses(self, tmp_path):
+        c = JsonCache(str(tmp_path))
+        assert c.get("absent") is None
+        with open(c.path("bad"), "w") as f:
+            f.write("{not json")
+        assert c.get("bad") is None
+        with open(c.path("shapeless"), "w") as f:
+            json.dump({"no": "envelope"}, f)
+        assert c.get("shapeless") is None
+
+    def test_delete(self, tmp_path):
+        c = JsonCache(str(tmp_path))
+        c.put("k", {"v": 1})
+        c.delete("k")
+        assert c.get("k") is None
+        c.delete("k")  # idempotent
+
+    def test_host_fingerprint_stable(self):
+        assert host_fingerprint() == host_fingerprint()
+        assert len(host_fingerprint()) == 12
+
+
+class TestPersistence:
+    def _fit(self, confident=True):
+        iters = 100 if confident else 2
+        obs = [synthetic_obs(1e10, 5e9, 1e6, 1e5, iterations=iters),
+               synthetic_obs(1e10, 5e9, 4e6, 2e5, iterations=iters)]
+        return cal.fit_machine_model(obs, base=BASE, backend="cpu")
+
+    def test_store_load_roundtrip(self, tmp_path):
+        c = JsonCache(str(tmp_path))
+        fit = self._fit()
+        assert cal.store_calibration(fit, cache=c) is not None
+        back = cal.load_calibration("cpu", cache=c)
+        assert back is not None
+        assert back.model.gather_slowdown == pytest.approx(
+            fit.model.gather_slowdown)
+
+    def test_preferred_model_requires_confidence(self, tmp_path):
+        c = JsonCache(str(tmp_path))
+        assert cal.preferred_model("cpu", cache=c) is None
+        unconfident = self._fit(confident=False)
+        assert not unconfident.confident
+        cal.store_calibration(unconfident, cache=c)
+        assert cal.preferred_model("cpu", cache=c) is None
+        cal.store_calibration(self._fit(), cache=c)
+        m = cal.preferred_model("cpu", cache=c)
+        assert m is not None and m.source == "calibrated"
+
+    def test_auto_plan_prefers_stored_calibration(self, tmp_path,
+                                                  monkeypatch):
+        """A confident calibration in the (env-pointed) default cache
+        steers plan='auto' - the documented preference, exercised
+        through resolve_plan exactly as solve_distributed hits it."""
+        from cuda_mpi_parallel_tpu.parallel.dist_cg import resolve_plan
+
+        monkeypatch.setenv("CUDA_MPI_PARALLEL_TPU_CACHE_DIR",
+                           str(tmp_path))
+        fit = self._fit()
+        assert cal.store_calibration(fit) is not None
+        a = mmio.load_matrix_market(FIXTURE)
+        plan = resolve_plan("auto", a, 4)
+        assert plan.scored_by == fit.model.name
+        assert plan.scored_by.startswith("calibrated-")
+
+    def test_preferred_model_honors_staleness(self, tmp_path):
+        c = JsonCache(str(tmp_path))
+        fit = self._fit()
+        stale_model = roof.MachineModel(
+            **{**fit.model.to_json(),
+               "created_at": time.time() - 2 * cal.CALIBRATION_MAX_AGE_S})
+        import dataclasses
+
+        stale = dataclasses.replace(fit, model=stale_model)
+        cal.store_calibration(stale, cache=c)
+        assert cal.preferred_model("cpu", cache=c) is None
+
+
+class TestDrift:
+    def _report(self):
+        return ss.ShardReport.from_json({
+            "kind": "ranges", "n_shards": 4, "n_global": 16,
+            "n_global_padded": 16, "n_local": 4,
+            "rows": [4, 4, 4, 4], "nnz": [19, 4, 4, 4],
+            "slots": [19, 19, 19, 19],
+            "halo_send_bytes": [16, 16, 16, 16],
+            "halo_recv_bytes": [48, 48, 48, 48],
+            "neighbors": [[[-1, 16]]] * 4,
+        })
+
+    def test_drift_is_exact_ratio(self):
+        rep = self._report()
+        predicted = score_report(rep, itemsize=8, model=BASE)
+        iters = 10
+        dr = cal.drift_report(rep, iters, predicted * iters * 3.0,
+                              itemsize=8, model=BASE)
+        assert dr.predicted_s_per_iteration == pytest.approx(predicted)
+        assert dr.measured_s_per_iteration == pytest.approx(
+            predicted * 3.0)
+        assert dr.drift_pct == pytest.approx(200.0)
+        assert dr.model == "unit-base"
+        assert "model error" in dr.describe()
+
+    def test_note_drift_emits_extended_event_and_gauges(self):
+        rep = self._report()
+        dr = cal.drift_report(rep, 10, 0.1, itemsize=8, model=BASE)
+        with events.capture() as buf:
+            cal.note_drift(dr, report=rep)
+        lines = [json.loads(ln)
+                 for ln in buf.getvalue().strip().splitlines()]
+        assert len(lines) == 1
+        ev = events.validate_event(lines[0])
+        assert ev["event"] == "partition_plan"
+        assert ev["stage"] == "drift"
+        assert ev["reorder"] == "none" and ev["split"] == "even"
+        assert ev["n_shards"] == 4
+        assert ev["drift_pct"] == pytest.approx(dr.drift_pct)
+        assert ev["predicted_s_per_iteration"] == pytest.approx(
+            dr.predicted_s_per_iteration)
+        assert REGISTRY.gauge(
+            "plan_drift_pct", "", labelnames=("plan",)).value(
+                plan="even") == pytest.approx(dr.drift_pct)
+
+    def test_score_report_uses_model_gather_slowdown(self):
+        rep = self._report()
+        fast_gather = roof.MachineModel(
+            name="fast", mem_bytes_per_s=BASE.mem_bytes_per_s,
+            flops_per_s=BASE.flops_per_s,
+            net_bytes_per_s=BASE.net_bytes_per_s,
+            gather_slowdown=1.0)
+        # halving the slowdown must strictly shrink the slot term
+        assert score_report(rep, itemsize=8, model=fast_gather) \
+            < score_report(rep, itemsize=8, model=BASE)
+
+
+class TestRooflineDiskCache:
+    def test_cpu_model_round_trips_through_disk(self, tmp_path,
+                                                monkeypatch):
+        c = JsonCache(str(tmp_path))
+        m1 = roof.machine_model("cpu", cache=c)
+        assert m1.source == "calibrated"
+        assert m1.created_at is not None
+
+        def boom():  # a second call must NOT re-measure
+            raise AssertionError("recalibrated despite fresh cache")
+
+        monkeypatch.setattr(roof, "_calibrate_cpu", boom)
+        m2 = roof.machine_model("cpu", cache=c)
+        assert m2.created_at == pytest.approx(m1.created_at)
+        assert m2.mem_bytes_per_s == pytest.approx(m1.mem_bytes_per_s)
+
+    def test_stale_disk_model_is_remeasured(self, tmp_path):
+        c = JsonCache(str(tmp_path))
+        old = roof.MachineModel(
+            name="cpu-calibrated", mem_bytes_per_s=1.0,
+            flops_per_s=1.0, net_bytes_per_s=1.0, source="calibrated",
+            created_at=time.time() - 2 * roof.CPU_MODEL_MAX_AGE_S)
+        c.put(f"machine-model-cpu-{host_fingerprint()}", old.to_json(),
+              created_at=old.created_at)
+        fresh = roof.machine_model("cpu", cache=c)
+        assert fresh.mem_bytes_per_s > 1.0
+
+    def test_report_carries_model_age(self):
+        aged = roof.MachineModel(
+            name="t", mem_bytes_per_s=1e9, flops_per_s=1e9,
+            source="calibrated", created_at=time.time() - 3600.0)
+        r = roof.analyze(n=10, nnz=30, itemsize=4, iterations=2,
+                         elapsed_s=0.1, model=aged)
+        assert r.model_source == "calibrated"
+        assert r.model_age_s == pytest.approx(3600.0, abs=60.0)
+        assert r.to_json()["model_age_s"] == r.model_age_s
+        table = roof.analyze(n=10, nnz=30, itemsize=4, iterations=2,
+                             elapsed_s=0.1, model=BASE)
+        assert table.model_age_s is None
+
+
+@needs_mesh
+class TestSequence:
+    def setup_method(self):
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+
+        dist_cg.clear_solver_cache()
+
+    def test_replan_sequence_on_skewed_fixture(self, tmp_path):
+        """ISSUE 6 acceptance: on the skewed fixture at mesh 4,
+        solve 2 of a --repeat 2 --replan sequence runs on a plan scored
+        by the solve-1-calibrated model; the replan event records the
+        decision; the drift-extended partition_plan events validate;
+        and every solve still matches the single-device solution."""
+        from cuda_mpi_parallel_tpu.parallel import (
+            make_mesh,
+            solve_sequence,
+        )
+
+        a = mmio.load_matrix_market(FIXTURE)
+        rng = np.random.default_rng(3)
+        b = rng.standard_normal(240)
+        ref = solve(a, jnp.asarray(b), tol=1e-10, maxiter=2000)
+        assert bool(ref.converged)
+
+        cache = JsonCache(str(tmp_path))
+        with events.capture() as buf:
+            seq = solve_sequence(a, b, mesh=make_mesh(4), repeats=2,
+                                 replan=True, tol=1e-10, maxiter=2000,
+                                 calibration_cache=cache)
+        assert len(seq.entries) == 2
+        for entry in seq.entries:
+            assert bool(entry.result.converged)
+            np.testing.assert_allclose(np.asarray(entry.result.x),
+                                       np.asarray(ref.x), atol=1e-7)
+        # solve 1 ran the even split (plan=None default); solve 2 must
+        # run on a runtime-corrected plan scored by the calibrated model
+        assert seq.entries[0].plan is None
+        plan2 = seq.entries[1].plan
+        assert plan2 is not None
+        assert plan2.scored_by == seq.entries[0].fit.model.name
+        assert plan2.scored_by.startswith("calibrated-")
+
+        lines = [json.loads(ln)
+                 for ln in buf.getvalue().strip().splitlines()]
+        for ev in lines:
+            events.validate_event(ev)
+        replans = [e for e in lines if e["event"] == "replan"]
+        assert len(replans) == 1
+        assert replans[0]["decision"] == "switched"
+        assert replans[0]["solve_index"] == 1
+        assert replans[0]["predicted_gain_pct"] > 0
+        drifts = [e for e in lines if e["event"] == "partition_plan"
+                  and e.get("stage") == "drift"]
+        assert len(drifts) == 2  # one per solve
+        # the calibration was persisted and is preferred for later
+        # auto planning on this backend/host (when confident)
+        fit = seq.final.fit
+        stored = cal.load_calibration(cache=cache)
+        assert stored is not None
+        if fit.confident:
+            assert cal.preferred_model(cache=cache) is not None
+        summary = seq.summary()
+        assert summary["repeats"] == 2
+        assert summary["decisions"][0]["decision"] == "switched"
+        assert any("replan" in ln for ln in seq.describe_lines())
+
+    def test_sequence_rejects_stencils_and_bad_repeats(self):
+        from cuda_mpi_parallel_tpu.models import poisson
+        from cuda_mpi_parallel_tpu.parallel import (
+            make_mesh,
+            solve_sequence,
+        )
+
+        stencil = poisson.poisson_2d_operator(16, 16)
+        with pytest.raises(ValueError, match="CSRMatrix"):
+            solve_sequence(stencil, np.ones(256), mesh=make_mesh(4))
+        a = mmio.load_matrix_market(FIXTURE)
+        with pytest.raises(ValueError, match="repeats"):
+            solve_sequence(a, np.ones(240), mesh=make_mesh(4),
+                           repeats=0)
+
+    def test_cli_repeat_replan_json_record(self, tmp_path, capsys,
+                                           monkeypatch):
+        from cuda_mpi_parallel_tpu import cli
+        from cuda_mpi_parallel_tpu.telemetry import (
+            shardscope as tshard,
+        )
+
+        # the CLI path persists to the DEFAULT cache: point it at this
+        # test's own dir so the confident toy calibration can never
+        # steer a later test's plan="auto" lane (the session scratch
+        # cache is shared across the whole suite)
+        monkeypatch.setenv("CUDA_MPI_PARALLEL_TPU_CACHE_DIR",
+                           str(tmp_path))
+        try:
+            rc = cli.main(["--problem", "mm", "--file", FIXTURE,
+                           "--mesh", "4", "--device", "cpu",
+                           "--tol", "1e-8", "--maxiter", "500",
+                           "--repeat", "2", "--replan", "--json"])
+        finally:
+            telemetry.force_active(False)
+            tshard.reset_last_shard_report()
+        assert rc == 0
+        record = json.loads(capsys.readouterr().out.strip())
+        calib = record["calibration"]
+        assert calib["repeats"] == 2
+        assert calib["decisions"][0]["decision"] in ("kept", "switched")
+        assert "drift_pct" in calib["drift"]
+        assert calib["solves"][1]["scored_by"].startswith("calibrated-")
+        # the final solve's plan rides the record as usual
+        assert record["plan"]["label"] != "even" \
+            or calib["decisions"][0]["decision"] == "kept"
+
+    def test_cli_repeat_refusals(self):
+        from cuda_mpi_parallel_tpu import cli
+
+        with pytest.raises(SystemExit, match="mesh"):
+            cli.main(["--problem", "mm", "--file", FIXTURE,
+                      "--repeat", "2"])
+        with pytest.raises(SystemExit, match="repeat"):
+            cli.main(["--problem", "mm", "--file", FIXTURE,
+                      "--mesh", "4", "--replan"])
+        with pytest.raises(SystemExit, match="CSR"):
+            cli.main(["--problem", "poisson2d", "--n", "16",
+                      "--matrix-free", "--mesh", "4",
+                      "--repeat", "2"])
+
+
+class TestZeroPerturbation:
+    """Calibration/replan OFF is jaxpr-bit-identical (ISSUE 6)."""
+
+    @needs_mesh
+    def test_calibration_machinery_leaves_solve_jaxpr_identical(self):
+        """Run the ENTIRE calibra pipeline (fit, persist, preferred-
+        model lookup, drift + gauges + events) between two traces of
+        the same distributed CSR solve body: the jaxpr must not move a
+        bit - everything here is post-solve host arithmetic."""
+        from cuda_mpi_parallel_tpu.models import poisson
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+        from cuda_mpi_parallel_tpu.parallel import partition as part
+        from cuda_mpi_parallel_tpu.parallel.operators import DistCSR
+        from cuda_mpi_parallel_tpu.solver.cg import cg
+
+        a = poisson.poisson_2d_csr(8, 8)
+        mesh = make_mesh(4)
+
+        def trace():
+            parts = part.partition_csr(a, 4)
+            b = jnp.zeros(parts.n_global_padded)
+            data = jnp.asarray(parts.data)
+            cols = jnp.asarray(parts.cols)
+            rows = jnp.asarray(parts.local_rows)
+
+            @partial(compat.shard_map, mesh=mesh,
+                     in_specs=(P("rows"), P("rows"), P("rows"),
+                               P("rows")),
+                     out_specs=P("rows"))
+            def run(b_local, d, c, r):
+                strip = partial(jax.tree.map, lambda v: v[0])
+                op = DistCSR(data=strip(d), cols=strip(c),
+                             local_rows=strip(r),
+                             n_local=parts.n_local,
+                             axis_name="rows", n_shards=4)
+                return cg(op, b_local, axis_name="rows", maxiter=25).x
+
+            return str(jax.make_jaxpr(run)(b, data, cols, rows))
+
+        base = trace()
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            cache = JsonCache(d)
+            obs = [synthetic_obs(1e10, 5e9, 1e6, 1e5),
+                   synthetic_obs(1e10, 5e9, 4e6, 2e5)]
+            fit = cal.fit_machine_model(obs, base=BASE, backend="cpu")
+            cal.note_calibration(fit)
+            cal.store_calibration(fit, cache=cache)
+            assert cal.preferred_model("cpu", cache=cache) is not None
+            rep = ss.report_for_ranges(
+                a, (((0, 16)), (16, 32), (32, 48), (48, 64)),
+                itemsize=8)
+            with events.capture():
+                cal.note_drift(cal.drift_report(rep, 25, 0.1,
+                                                itemsize=8),
+                               report=rep)
+        assert trace() == base
+
+    def test_resolve_plan_auto_unchanged_without_calibration(self,
+                                                             tmp_path,
+                                                             monkeypatch):
+        """With no calibration on disk, plan='auto' resolves to the
+        SAME reference-scored plan as a direct plan_partition call -
+        the pre-calibra behavior, bit for bit (same layout fingerprint,
+        same reference scorer)."""
+        from cuda_mpi_parallel_tpu.parallel.dist_cg import resolve_plan
+
+        monkeypatch.setenv("CUDA_MPI_PARALLEL_TPU_CACHE_DIR",
+                           str(tmp_path / "empty"))
+        a = mmio.load_matrix_market(FIXTURE)
+        direct = plan_partition(a, 4)
+        resolved = resolve_plan("auto", a, 4)
+        assert direct.scored_by == "reference-tpu-v5e"
+        assert resolved.scored_by == "reference-tpu-v5e"
+        assert resolved.fingerprint() == direct.fingerprint()
+        assert resolved.score == pytest.approx(direct.score)
+
+    def test_reference_model_matches_legacy_constants(self):
+        """The promoted MachineModel fields keep the PR-5 table values:
+        plans stay host-independent by default."""
+        from cuda_mpi_parallel_tpu.balance.plan import GATHER_SLOWDOWN
+
+        ref = reference_model()
+        assert ref.mem_bytes_per_s == pytest.approx(8.19e11)
+        assert ref.net_bytes_per_s == pytest.approx(4.5e10)
+        assert ref.gather_slowdown == GATHER_SLOWDOWN == 8.0
+        assert ref.source == "table"

@@ -76,6 +76,16 @@ METRIC_DIRECTION = {
     "planner.nnz_imbalance_even": None,
     "planner.nnz_imbalance_planned": None,
     "planner.plan_time_s": None,
+    # runtime-calibration / replan columns (PR 6,
+    # telemetry.calibrate + solve_sequence): the calibrated model's
+    # predicted replan gain, the measured gather slowdown, and the
+    # model-error (drift) % of the final sequence solve.  Reported,
+    # never gated - drift tracks host/tunnel weather as much as code,
+    # and pre-PR-6 files simply lack them (rendered n/a).
+    "replan.predicted_gain_pct": None,
+    "replan.drift_pct": None,
+    "replan.gather_slowdown": None,
+    "drift_pct": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -110,6 +120,7 @@ _NESTED = {
     "roofline": ("efficiency_pct", "arithmetic_intensity"),
     "planner": ("nnz_imbalance_even", "nnz_imbalance_planned",
                 "plan_time_s"),
+    "replan": ("predicted_gain_pct", "drift_pct", "gather_slowdown"),
 }
 
 

@@ -55,6 +55,11 @@ fi
 echo "== solve-report gate (mesh-4 CLI: event schema + Perfetto) =="
 scratch=$(mktemp -d)
 trap 'rm -rf "$scratch"' EXIT
+# Every CLI gate below pins the measured-artifact cache to the scratch
+# dir: --plan auto (and the roofline's CPU model) reads this host's
+# calibration cache since PR 6, and a leftover confident calibration
+# would make these assertions host-state-dependent.
+export CUDA_MPI_PARALLEL_TPU_CACHE_DIR="$scratch/cache"
 JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli \
     --problem poisson2d --n 16 --mesh 4 --device cpu \
     --tol 1e-6 --maxiter 200 \
@@ -101,6 +106,45 @@ assert auto < even, \
 print(f"planner gate: nnz max/mean {even} (even) -> {auto} (auto)")
 PY
 echo "planner gate: clean"
+
+# Calibra gate: the runtime-calibration + replan loop end-to-end on
+# the same skewed fixture - a mesh-4 CLI sequence (--repeat 2 --replan)
+# must emit a schema-valid `replan` event (the kept/switched decision)
+# and the drift-extended `partition_plan` event (predicted-vs-measured
+# model error), and the report must carry the calibration/drift
+# section.  The calibration cache is pointed at the scratch dir so the
+# gate never reads or writes this host's real measured-model cache.
+echo "== calibra gate (mesh-4 CLI: --repeat 2 --replan) =="
+JAX_PLATFORMS=cpu CUDA_MPI_PARALLEL_TPU_CACHE_DIR="$scratch/cache" \
+    python -m cuda_mpi_parallel_tpu.cli \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --device cpu --tol 1e-8 --maxiter 500 \
+    --repeat 2 --replan \
+    --trace-events "$scratch/replan_events.jsonl" \
+    --report "$scratch/replan_report.txt" > /dev/null
+python tools/validate_trace.py "$scratch/replan_events.jsonl"
+python - "$scratch/replan_events.jsonl" <<'PY'
+import json
+import sys
+
+events = [json.loads(line) for line in open(sys.argv[1])
+          if line.strip()]
+replans = [e for e in events if e["event"] == "replan"]
+assert replans, "no replan event emitted"
+assert all(e["decision"] in ("kept", "switched") for e in replans), \
+    f"bad replan decision: {replans}"
+drifts = [e for e in events
+          if e["event"] == "partition_plan" and "drift_pct" in e]
+assert drifts, "no drift-extended partition_plan event emitted"
+for e in drifts:
+    assert "predicted_s_per_iteration" in e \
+        and "measured_s_per_iteration" in e, f"drift event truncated: {e}"
+print(f"calibra gate: {len(replans)} replan + {len(drifts)} drift "
+      f"events, decision={replans[0]['decision']}")
+PY
+grep -qi "calibration" "$scratch/replan_report.txt"
+grep -qi "drift" "$scratch/replan_report.txt"
+echo "calibra gate: clean"
 
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log

@@ -103,9 +103,31 @@ def build_report(evs) -> treport.SolveReport:
         # has the same shape as the CLI's inline SolveHealth.to_json()
         health = {k: v for k, v in health.items()
                   if k not in ("event", "t", "solve_id", "phase")}
+    # calibration & drift (PR 6): the drift-extended partition_plan
+    # emission (stage="drift") and any replan decisions of this solve
+    calibration = None
+    drift_ev = next((ev for ev in reversed(evs)
+                     if ev["event"] == "partition_plan"
+                     and ev.get("stage") == "drift"), None)
+    replans = [ev for ev in evs if ev["event"] == "replan"]
+    if drift_ev is not None or replans:
+        calibration = {}
+        if drift_ev is not None:
+            calibration["drift"] = {
+                k: drift_ev.get(k)
+                for k in ("drift_pct", "predicted_s_per_iteration",
+                          "measured_s_per_iteration", "model")}
+            calibration["drift"]["plan"] = \
+                f"{drift_ev.get('reorder')}+{drift_ev.get('split')}"
+        if replans:
+            calibration["decisions"] = [
+                {k: ev.get(k) for k in ("solve_index", "decision",
+                                        "predicted_gain_pct", "model")}
+                for ev in replans]
     sections = tuple((end.get("sections") or {}).items())
     return treport.SolveReport(record=record, shard=shard, comm=comm,
-                               health=health, sections=sections)
+                               health=health, calibration=calibration,
+                               sections=sections)
 
 
 def main(argv=None) -> int:
