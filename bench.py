@@ -513,6 +513,68 @@ def _replan_entry(entry, n_shards, key="replan"):
     return entry
 
 
+def _exchange_entry(entry, n_shards, key="exchange"):
+    """Gather-vs-allgather halo-exchange columns for the distributed
+    row (parallel.exchange): two small measured mesh solves of the
+    committed skewed fixture, one per wire, reporting iters/s and the
+    jaxpr-derived per-iteration WIRE bytes of each plus the gather
+    schedule's padding fraction.  Also surfaces the bench_compare
+    nested columns ``comm.wire_bytes_per_iter`` /
+    ``halo.padding_fraction``.  Same never-sink-the-run contract as
+    ``_efficiency_entry``."""
+    try:
+        import numpy as _np
+
+        from cuda_mpi_parallel_tpu import telemetry
+        from cuda_mpi_parallel_tpu.models import mmio
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            make_mesh,
+            solve_distributed,
+        )
+        from cuda_mpi_parallel_tpu.utils.logging import sanitize
+        from cuda_mpi_parallel_tpu.utils.timing import time_fn
+
+        a = mmio.load_matrix_market("tests/fixtures/skewed_spd_240.mtx")
+        b = _np.random.default_rng(11).standard_normal(240)
+        mesh = make_mesh(n_shards)
+        out = {"n_shards": n_shards,
+               "note": "gather vs allgather halo wire on the committed "
+                       "skewed 240-row fixture"}
+        pad_frac = None
+        for mode in ("allgather", "gather"):
+            dist_cg.reset_last_comm_cost()
+            telemetry.force_active(True)
+            try:
+                el, res = time_fn(
+                    lambda: solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                              maxiter=500,
+                                              exchange=mode),
+                    warmup=1, repeats=1)
+            finally:
+                telemetry.force_active(False)
+            its = max(int(res.iterations), 1)
+            out[f"{mode}_iters_per_sec"] = round(its / el, 1)
+            info = dist_cg.last_comm_cost()
+            if info is not None:
+                sc, ctx = info
+                out[f"{mode}_wire_bytes_per_iter"] = \
+                    sc.per_iteration.wire_bytes
+                if mode == "gather":
+                    pad_frac = ctx.get("halo_padding_fraction")
+        if pad_frac is not None:
+            out["padding_fraction"] = pad_frac
+        entry[key] = sanitize(out)
+        if out.get("gather_wire_bytes_per_iter") is not None:
+            entry["comm"] = {
+                "wire_bytes_per_iter": out["gather_wire_bytes_per_iter"]}
+        if pad_frac is not None:
+            entry["halo"] = {"padding_fraction": pad_frac}
+    except Exception as e:  # pragma: no cover - defensive
+        entry[key] = {"error": str(e)[-200:]}
+    return entry
+
+
 def _convergence_entry(res) -> dict:
     """``iterations``/``converged`` (+ flight summary when recorded) -
     the per-section convergence record bench_compare gates on."""
@@ -1312,6 +1374,9 @@ def bench_all(results, sections=None) -> None:
             # sequence (needs a real mesh to rebalance)
             if ndev >= 2:
                 _replan_entry(entry, n_shards=ndev)
+                # gather-vs-allgather exchange row: the halo wire win
+                # (and its padding cost) measured on the same fixture
+                _exchange_entry(entry, n_shards=ndev)
             results[f"poisson3d_{grid[0]}x{grid[1]}x{grid[2]}"
                     f"_mesh{ndev}"] = entry
         if ndev >= 4 and ndev % 2 == 0:

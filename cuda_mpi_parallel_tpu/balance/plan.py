@@ -1,4 +1,5 @@
-"""Partition planning: choose (reorder x split) by predicted stall cost.
+"""Partition planning: choose (reorder x split x exchange) by predicted
+stall cost.
 
 ``telemetry.shardscope`` can *measure* per-shard nnz/halo skew the
 moment a partition is built; this module closes the loop by choosing
@@ -6,22 +7,25 @@ the partition FROM that measurement before anything is built.  A
 :func:`plan_partition` call enumerates candidate plans - a symmetric
 SPD-preserving reordering (none / RCM / greedy nnz-aware, see
 ``.reorder``) crossed with a contiguous row split (even / balanced-nnz,
-see ``.nnz_split``) - scores each candidate with shardscope's static
-accounting (``report_for_ranges``) joined to the roofline communication
-model (``telemetry.roofline.MachineModel``), and returns the minimizer
-as a :class:`PartitionPlan`.
+see ``.nnz_split``) crossed with a halo-exchange lane (allgather /
+gather, see ``parallel.exchange``) - scores each candidate with
+shardscope's static accounting (``report_for_ranges``) joined to the
+roofline communication model (``telemetry.roofline.MachineModel``),
+and returns the minimizer as a :class:`PartitionPlan`.
 
 The default score is the modeled per-iteration SHARD-STALL time of the
 shipped distributed schedules.  Under ``shard_map`` every shard is
 padded to identical shapes, so nnz skew does not make one device late -
 it inflates the UNIFORM padded slot count every device multiplies
 through (that is how the ``nnz_max_over_mean`` stall factor is paid
-here), while the ring/allgather x-rotation moves a fixed payload
-proportional to the padded local row count:
+here), plus the wire term of the candidate's exchange lane:
 
     score =   slots_max * (itemsize + 4) * G / mem_bw    (padded work)
-            + (P - 1) * n_local * itemsize / net_bw      (x rotation)
-            + 0.25 * max_k coupling_bytes_k / net_bw     (locality)
+            + wire_bytes(exchange) / net_bw              (halo wire)
+
+    wire_bytes(allgather | ring) = (P - 1) * n_local * itemsize
+    wire_bytes(gather)           = padded coupled-entry rounds
+                                   (shardscope.gather_wire_bytes)
 
 ``G`` (``model.gather_slowdown``) prices sparse-gather work against
 the streaming bandwidth the machine model quotes: the per-entry x
@@ -32,13 +36,13 @@ default of 8 (:data:`GATHER_SLOWDOWN`) is a deliberately conservative
 charge.
 
 Balancing nnz shrinks the first term; keeping shards row-compact (the
-``row_cap_factor`` cap) bounds the second; a bandwidth-reducing
-reorder shrinks the third.  Coupling is deliberately down-weighted:
-the shipped allgather/ring schedules move their fixed payload however
-the entries couple, so locality is a secondary effect here (gather
-spread in the local SpMV, and what a future gather-based halo exchange
-would pay directly), not a per-iteration wire cost.  All three machine
-parameters (mem bandwidth, net bandwidth, gather slowdown) live on ONE
+``row_cap_factor`` cap) bounds the allgather wire; a bandwidth-
+reducing reorder shrinks the gather wire.  Since PR 7 the coupled
+halo is priced at FULL weight on the gather lane - the wire honors it
+now (``parallel.exchange`` ships exactly the coupled entries), so the
+historical one-quarter down-weight fudge is gone: each lane is charged the
+bytes its schedule actually moves.  All three machine parameters (mem
+bandwidth, net bandwidth, gather slowdown) live on ONE
 ``telemetry.roofline.MachineModel`` shared with the roofline and the
 runtime calibrator; the default is the deterministic TPU-class
 reference table (:func:`reference_model`) so plans stay
@@ -68,6 +72,7 @@ __all__ = [
     "plan_partition",
     "reference_model",
     "score_report",
+    "wire_bytes_for",
 ]
 
 #: rows above which the O(nnz log n) Python-heap greedy ordering is
@@ -130,6 +135,12 @@ class PartitionPlan:
     split: str                          # "even" | "nnz"
     objective: str
     score: float
+    #: the halo-exchange lane this plan was scored for: "allgather"
+    #: (the legacy fixed collective - also what a pre-exchange saved
+    #: plan loads as), "gather" (packed coupled-entry ppermute rounds,
+    #: parallel.exchange) or "ring" (full x-block rotation).  The
+    #: solve honors it unless the caller pins exchange= explicitly.
+    exchange: str = "allgather"
     report: Optional[object] = None     # predicted ShardReport
     #: the even-split imbalance digest of the UNpermuted matrix - the
     #: baseline the plan is beating, for reports and benches
@@ -141,16 +152,26 @@ class PartitionPlan:
 
     @property
     def label(self) -> str:
-        return f"{self.reorder}+{self.split}"
+        # the legacy allgather lane keeps the historical two-part label
+        # (dashboards and gauge series keyed on it stay continuous);
+        # other lanes name their wire
+        if self.exchange == "allgather":
+            return f"{self.reorder}+{self.split}"
+        return f"{self.reorder}+{self.split}+{self.exchange}"
 
     def fingerprint(self) -> str:
-        """Short stable digest of the layout (ranges + permutation):
-        the solver-cache key component and event correlation id."""
+        """Short stable digest of the layout (ranges + permutation +
+        exchange lane): the solver-cache key component and event
+        correlation id.  The legacy allgather lane hashes exactly as
+        before this field existed, so saved pre-exchange plans keep
+        their recorded fingerprints."""
         h = hashlib.sha1()
         h.update(repr((self.n_shards, self.row_ranges)).encode())
         if self.permutation is not None:
             h.update(np.ascontiguousarray(
                 self.permutation, dtype=np.int64).tobytes())
+        if self.exchange != "allgather":
+            h.update(f"exchange={self.exchange}".encode())
         return h.hexdigest()[:12]
 
     def inverse_permutation(self) -> Optional[np.ndarray]:
@@ -179,12 +200,16 @@ class PartitionPlan:
                     f"range({n})")
 
     def is_trivial(self) -> bool:
-        """True when the plan IS the legacy layout: no permutation and
-        the even row split.  ``resolve_plan`` collapses trivial plans
-        to ``None`` so an auto-planned solve of an already-balanced
-        system shares the unplanned executable (same cache key, same
-        jaxpr) instead of compiling a byte-identical twin."""
-        return self.permutation is None and self.row_ranges \
+        """True when the plan IS the legacy layout: no permutation,
+        the even row split, and a fixed-payload wire (allgather/ring -
+        what the unplanned schedules run anyway).  ``resolve_plan``
+        collapses trivial plans to ``None`` so an auto-planned solve
+        of an already-balanced system shares the unplanned executable
+        (same cache key, same jaxpr) instead of compiling a
+        byte-identical twin.  A gather-lane plan is never trivial: its
+        wire differs from the legacy schedule even on even ranges."""
+        return self.permutation is None and self.exchange != "gather" \
+            and self.row_ranges \
             == nnz_split.even_ranges(self.n_global, self.n_shards)
 
     def describe(self) -> str:
@@ -207,6 +232,7 @@ class PartitionPlan:
                             else [int(v) for v in self.permutation]),
             "reorder": self.reorder,
             "split": self.split,
+            "exchange": self.exchange,
             "objective": self.objective,
             "score": float(self.score),
             "fingerprint": self.fingerprint(),
@@ -230,6 +256,9 @@ class PartitionPlan:
                          else np.asarray(perm, dtype=np.int64)),
             reorder=str(data.get("reorder", "?")),
             split=str(data.get("split", "?")),
+            # pre-exchange saved plans were scored for (and ran) the
+            # allgather wire - load them as exactly that
+            exchange=str(data.get("exchange", "allgather")),
             objective=str(data.get("objective", "auto")),
             score=float(data.get("score", 0.0)),
             report=(None if pred is None
@@ -248,17 +277,42 @@ class PartitionPlan:
             return cls.from_json(json.load(f))
 
 
+def wire_bytes_for(report, exchange: str, itemsize: int) -> float:
+    """Per-device per-matvec interconnect bytes of ``exchange`` on the
+    layout ``report`` describes (coupling semantics,
+    ``shardscope.report_for_ranges``).
+
+    The fixed lanes (allgather / ring) land ``(P - 1) * n_local``
+    entries on every device however the entries couple; the gather
+    lane ships the coupled-entry rounds padded per-round to the max
+    over shards (``shardscope.gather_wire_bytes`` - FULL weight, no
+    down-weighting: since ``parallel.exchange`` the wire honors the
+    coupling, so the planner charges exactly what is sent)."""
+    if exchange == "gather":
+        from ..telemetry.shardscope import gather_wire_bytes
+
+        return float(gather_wire_bytes(report))
+    from ..parallel.exchange import allgather_wire_bytes
+
+    # one definition of the dense wire, shared with choose_exchange's
+    # auto rule - refining the all_gather pricing updates both at once
+    return float(allgather_wire_bytes(report.n_shards, report.n_local,
+                                      itemsize))
+
+
 def score_report(report, *, objective: str = "time", itemsize: int = 8,
-                 model=None) -> float:
+                 model=None, exchange: str = "allgather") -> float:
     """Rank a candidate layout; lower is better (seconds for 'time').
 
     ``report`` is a coupling-semantics ``ShardReport``
     (``shardscope.report_for_ranges``); ``model`` a
     ``telemetry.roofline.MachineModel`` supplying the mem/net
-    bandwidths and gather slowdown (default: :func:`reference_model`).
-    Public because the drift tracker (``telemetry.calibrate``) and the
-    replan loop (``dist_cg.solve_sequence``) re-price already-built
-    layouts with the same terms the planner used to choose them."""
+    bandwidths and gather slowdown (default: :func:`reference_model`);
+    ``exchange`` the halo wire the candidate would run (its bytes are
+    priced via :func:`wire_bytes_for`).  Public because the drift
+    tracker (``telemetry.calibrate``) and the replan loop
+    (``dist_cg.solve_sequence``) re-price already-built layouts with
+    the same terms the planner used to choose them."""
     if objective == "nnz":
         from ..telemetry.shardscope import max_over_mean
 
@@ -278,22 +332,19 @@ def score_report(report, *, objective: str = "time", itemsize: int = 8,
     # "time": modeled per-iteration stall seconds (module docstring)
     slot_term = (float(report.slots.max()) * (itemsize + 4)
                  * gather / mem_bps)
-    payload_term = ((report.n_shards - 1) * report.n_local
-                    * itemsize / net_bps)
-    coupling = (report.halo_send_bytes
-                + report.halo_recv_bytes).astype(np.float64)
-    coupling_term = 0.25 * float(coupling.max()) / net_bps \
-        if coupling.size else 0.0
-    return slot_term + payload_term + coupling_term
+    wire_term = wire_bytes_for(report, exchange, itemsize) / net_bps
+    return slot_term + wire_term
 
 
 def plan_partition(a, n_shards: int, *, objective: str = "auto",
                    reorders: Optional[Sequence[str]] = None,
                    splits: Sequence[str] = ("even", "nnz"),
+                   exchange: str = "auto",
                    row_cap_factor: float = 1.25,
                    itemsize: Optional[int] = None,
                    model=None) -> PartitionPlan:
-    """Enumerate (reorder x split) candidates and return the minimizer.
+    """Enumerate (reorder x split x exchange) candidates; return the
+    minimizer.
 
     Args:
       a: the global assembled ``CSRMatrix`` (SPD; symmetric pattern).
@@ -305,6 +356,13 @@ def plan_partition(a, n_shards: int, *, objective: str = "auto",
         "greedy")`` with greedy dropped past
         :data:`GREEDY_REORDER_LIMIT` rows.
       splits: candidate row splits (``"even"``, ``"nnz"``).
+      exchange: halo-wire lanes to search - ``"auto"`` (the default)
+        scores every (reorder, split) under BOTH the legacy allgather
+        wire and the coupled-entry gather wire
+        (``parallel.exchange``), full weight each, and lets the
+        cheaper lane win; ``"allgather"``/``"gather"``/``"ring"`` pin
+        one lane (ring prices like allgather: the rotation lands the
+        same fixed payload).
       row_cap_factor: balanced-nnz splits cap real rows per shard at
         ``ceil(n/P) * factor`` so one shard of very light rows cannot
         inflate everyone's padded local size (see
@@ -329,8 +387,18 @@ def plan_partition(a, n_shards: int, *, objective: str = "auto",
         objective = "time"
     if objective not in ("time", "nnz", "halo"):
         raise ValueError(f"unknown plan objective {objective!r}")
+    if exchange not in ("auto", "allgather", "gather", "ring"):
+        raise ValueError(f"unknown plan exchange {exchange!r}")
     if n_shards < 1:
         raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    # nnz/halo objectives rank layouts, not wires: score once per
+    # (reorder, split) on the pinned lane (or the legacy default)
+    if exchange != "auto":
+        lanes = (exchange,)
+    elif objective == "time":
+        lanes = ("allgather", "gather")
+    else:
+        lanes = ("allgather",)
     from ..telemetry import shardscope
 
     n = int(a.shape[0])
@@ -377,26 +445,43 @@ def plan_partition(a, n_shards: int, *, objective: str = "auto",
                 rep = shardscope.report_for_ranges(
                     ap, ranges, itemsize=itemsize,
                     plan=f"{rname}+{sname}")
-            score = score_report(rep, objective=objective,
-                                 itemsize=itemsize, model=model)
-            cand = PartitionPlan(
-                n_shards=n_shards, row_ranges=ranges, permutation=perm,
-                reorder=rname, split=sname, objective=objective,
-                score=score, report=rep,
-                baseline_imbalance=baseline_imb,
-                scored_by=str(model.name))
-            if best is None:
-                best = cand   # none+even: the trivial baseline lane
-                trivial_score = score
-                continue
-            # hysteresis: a non-trivial lane must beat the TRIVIAL
-            # layout by > 2% (permutation/variable-row churn for a
-            # model-noise-sized gain is a net loss), and strictly beat
-            # the best so far - candidate order runs simplest first,
-            # so ties stay with the simpler layout
-            if score < trivial_score * 0.98 \
-                    and score < best.score * (1 - 1e-9):
-                best = cand
+            trivial_layout = rname == "none" and sname == "even"
+            for lane in lanes:
+                score = score_report(rep, objective=objective,
+                                     itemsize=itemsize, model=model,
+                                     exchange=lane)
+                cand = PartitionPlan(
+                    n_shards=n_shards, row_ranges=ranges,
+                    permutation=perm,
+                    reorder=rname, split=sname, objective=objective,
+                    score=score, exchange=lane, report=rep,
+                    baseline_imbalance=baseline_imb,
+                    scored_by=str(model.name))
+                if best is None:
+                    best = cand               # none+even on the FIRST
+                    legacy_score = score      # lane: the legacy lane
+                    layout_floor = score
+                    continue
+                # Two-layer hysteresis (candidate order runs simplest
+                # first: trivial layout leads, allgather lane before
+                # gather, so ties always stay with the simpler choice):
+                if trivial_layout:
+                    # a wire upgrade on the legacy LAYOUT carries no
+                    # permutation/variable-row churn but still compiles
+                    # a new executable - it must clear the same > 2%
+                    # bar vs the legacy lane
+                    if score < legacy_score * 0.98 \
+                            and score < best.score * (1 - 1e-9):
+                        best = cand
+                    layout_floor = min(layout_floor, score)
+                    continue
+                # a LAYOUT deviation must beat the best trivial-layout
+                # lane by > 2%: reordering to collect a wire win the
+                # trivial layout already gets for free is pure churn
+                # for a model-noise-sized gain
+                if score < layout_floor * 0.98 \
+                        and score < best.score * (1 - 1e-9):
+                    best = cand
     if best is None:
         raise ValueError(
             "plan_partition needs at least one (reorder, split) "

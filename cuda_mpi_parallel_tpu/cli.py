@@ -84,6 +84,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "compute); or ring-shiftell (same ring with the "
                         "pallas shift-ELL slab kernel for each local "
                         "multiply)")
+    p.add_argument("--exchange", default=None,
+                   choices=["auto", "gather", "allgather", "ring"],
+                   help="distributed general-CSR halo wire "
+                        "(parallel.exchange): 'gather' ships only the "
+                        "coupled x entries as packed per-neighbor "
+                        "ppermute rounds (node-aware SpMV - strictly "
+                        "fewer wire bytes whenever coupling is sparse; "
+                        "padding to the max neighbor is reported in "
+                        "the comm record); 'allgather' forces the "
+                        "legacy full-x collective; 'ring' is "
+                        "--csr-comm ring; 'auto' lets the partition "
+                        "plan (or, unplanned, the coupled-volume rule) "
+                        "decide, falling back to allgather when "
+                        "coupling approaches O(n).  Default: the "
+                        "legacy --csr-comm lane, except that a --plan "
+                        "auto plan scored for the gather wire runs it")
     p.add_argument("--device", default=None,
                    choices=[None, "tpu", "cpu"],
                    help="force a JAX platform (default: auto)")
@@ -414,6 +430,33 @@ def main(argv=None) -> int:
                 "--csr-comm applies to assembled-CSR problems only "
                 "(stencils use halo exchange)")
 
+    if args.exchange is not None:
+        from .models.operators import CSRMatrix
+
+        if args.mesh <= 1:
+            raise SystemExit("--exchange needs --mesh > 1 (the halo "
+                             "wire of a distributed CSR solve)")
+        if not isinstance(a, CSRMatrix):
+            raise SystemExit(
+                "--exchange applies to assembled-CSR problems only "
+                "(stencil slabs exchange plane halos already)")
+        if args.df64:
+            raise SystemExit(
+                "--exchange does not support --dtype df64 (the "
+                "distributed df64 path is the ring-shiftell schedule)")
+        if args.engine in ("resident", "streaming"):
+            raise SystemExit(
+                f"--exchange with --engine {args.engine} is "
+                f"unsupported: the one-kernel engines use their own "
+                f"stencil partitioners (use --engine general/auto)")
+        if args.exchange in ("gather", "allgather") \
+                and args.csr_comm != "allgather":
+            raise SystemExit(
+                f"--exchange {args.exchange} conflicts with --csr-comm "
+                f"{args.csr_comm} (the ring schedules rotate full "
+                f"x-blocks; drop one of the two flags)")
+        desc += f" [exchange: {args.exchange}]"
+
     # Imbalance-aware partition planning (balance): resolved HERE, not
     # inside the solver, so the chosen lane can ride the description,
     # the record and the report.  Composes with --rcm (the plan sees,
@@ -440,11 +483,18 @@ def main(argv=None) -> int:
         if args.plan == "auto":
             # same model preference as the API path (resolve_plan): a
             # fresh + confident on-disk calibration for this backend/
-            # host prices the plan; absent one, the reference table
+            # host prices the plan; absent one, the reference table.
+            # The exchange lane the planner searches/pins mirrors the
+            # solve's (dist_cg._plan_exchange_hint), so a --exchange
+            # pin never gets a plan scored for a different wire.
+            from .parallel.dist_cg import _plan_exchange_hint
             from .telemetry import calibrate as _tcal
 
             plan_model = _tcal.preferred_model()
-            plan_obj = plan_partition(a, args.mesh, model=plan_model)
+            plan_obj = plan_partition(
+                a, args.mesh, model=plan_model,
+                exchange=_plan_exchange_hint(args.csr_comm,
+                                             args.exchange))
         else:
             try:
                 plan_obj = PartitionPlan.load(args.plan)
@@ -455,6 +505,14 @@ def main(argv=None) -> int:
                 raise ValueError(
                     f"plan targets {plan_obj.n_shards} shards but "
                     f"--mesh is {args.mesh}")
+            if plan_obj.exchange == "gather" \
+                    and (args.csr_comm in ("ring", "ring-shiftell")
+                         or args.exchange == "ring"):
+                raise ValueError(
+                    f"plan was scored for the gather halo exchange "
+                    f"but the requested ring schedule rotates full "
+                    f"x-blocks (re-plan for the ring wire, or drop "
+                    f"the ring flag)")
             plan_obj.validate_for(a)
         except ValueError as e:
             raise SystemExit(f"--plan {args.plan}: {e}")
@@ -748,7 +806,8 @@ def main(argv=None) -> int:
                 precond_degree=args.precond_degree,
                 record_history=args.history, method=args.method,
                 check_every=args.check_every, csr_comm=args.csr_comm,
-                flight=flight_cfg, plan=plan_obj)
+                flight=flight_cfg, plan=plan_obj,
+                exchange=args.exchange)
         if args.engine in ("auto", "resident"):
             from .models.operators import _pallas_interpret
             from .solver.resident import (
@@ -935,7 +994,8 @@ def main(argv=None) -> int:
                     precond_degree=args.precond_degree,
                     record_history=args.history, method=args.method,
                     check_every=args.check_every,
-                    csr_comm=args.csr_comm, flight=flight_cfg)
+                    csr_comm=args.csr_comm, flight=flight_cfg,
+                    exchange=args.exchange)
                 elapsed, result = seq.final.elapsed_s, seq.final.result
                 # downstream reporting (record/report/plan line) shows
                 # the plan the final solve actually ran on
@@ -974,11 +1034,17 @@ def main(argv=None) -> int:
                     "ppermute": totals.ppermute,
                     "all_gather": totals.all_gather,
                     "comm_bytes": totals.comm_bytes,
+                    "wire_bytes": totals.wire_bytes,
                     "per_iteration": sc.per_iteration.to_json(),
                     "setup": sc.setup.to_json(),
                     "kind": ctx.get("kind"),
                     "n_shards": ctx.get("n_shards"),
                 }
+                if ctx.get("exchange") is not None:
+                    comm["exchange"] = ctx["exchange"]
+                if ctx.get("halo_padding_fraction") is not None:
+                    comm["halo_padding_fraction"] = \
+                        ctx["halo_padding_fraction"]
         # The flight record: ONE host fetch of the solve-carried ring
         # buffer (the solve is complete and synced by now), then the
         # solve-health verdict computed host-side from the recorded
@@ -1039,6 +1105,7 @@ def main(argv=None) -> int:
             "label": plan_obj.label,
             "reorder": plan_obj.reorder,
             "split": plan_obj.split,
+            "exchange": plan_obj.exchange,
             "objective": plan_obj.objective,
             "fingerprint": plan_obj.fingerprint(),
             "score": float(plan_obj.score),
@@ -1110,8 +1177,12 @@ def main(argv=None) -> int:
         from .telemetry.shardscope import last_shard_report
 
         shard_rep = last_shard_report() if args.mesh > 1 else None
-        comm_bpi = (comm["per_iteration"]["comm_bytes"]
-                    if comm is not None else 0.0)
+        # the roofline's communication term prices the real
+        # interconnect bytes (wire semantics - an all_gather lands
+        # (P-1) blocks per device, not its input aval)
+        comm_bpi = (comm["per_iteration"].get(
+            "wire_bytes", comm["per_iteration"]["comm_bytes"])
+            if comm is not None else 0.0)
         itemsize = {"float64": 8, "df64": 8, "bfloat16": 2}.get(
             args.dtype, 4)
         roof = troofline.analyze(
@@ -1167,12 +1238,20 @@ def main(argv=None) -> int:
             for v in x_np:
                 print(f"{v:f}")
         if comm is not None:
+            ex_note = ""
+            if comm.get("exchange"):
+                ex_note = f", exchange={comm['exchange']}"
+                pad_frac = comm.get("halo_padding_fraction")
+                if pad_frac is not None:
+                    ex_note += f" (halo padding {pad_frac * 100:.1f}%)"
             print(f"comm    : {comm['psum']} psum, "
                   f"{comm['ppermute']} ppermute, "
                   f"{comm['all_gather']} all_gather, "
                   f"{comm['comm_bytes']} payload bytes "
                   f"(per-device; {comm['per_iteration']['comm_bytes']} "
-                  f"bytes/iter)")
+                  f"payload + "
+                  f"{comm['per_iteration'].get('wire_bytes', 0)} wire "
+                  f"bytes/iter{ex_note})")
         if plan_obj is not None:
             pe = record["plan"]
             imb = pe.get("measured_imbalance") \

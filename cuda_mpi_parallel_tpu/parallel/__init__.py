@@ -10,7 +10,14 @@ from .streaming import (
     solve_distributed_streaming_df64,
 )
 from .dist_cg import SequenceResult, solve_distributed, solve_sequence
-from .halo import exchange_halo, exchange_halo_axis, neighbor_shift_perms
+from .exchange import GatherSchedule, build_gather_schedule
+from .halo import (
+    exchange_halo,
+    exchange_halo_axis,
+    neighbor_shift_perms,
+    rotation_perm,
+    validate_permutation,
+)
 from .mesh import (
     COLS_AXIS,
     ROWS_AXIS,
@@ -21,6 +28,7 @@ from .mesh import (
 )
 from .operators import (
     DistCSR,
+    DistCSRGather,
     DistCSRRing,
     DistShiftELLDF64Ring,
     DistShiftELLRing,
@@ -39,6 +47,7 @@ __all__ = [
     "COLS_AXIS",
     "ROWS_AXIS",
     "DistCSR",
+    "DistCSRGather",
     "DistCSRRing",
     "DistShiftELLDF64Ring",
     "DistShiftELLRing",
@@ -46,9 +55,11 @@ __all__ = [
     "DistStencil3D",
     "DistStencil3DPencil",
     "DistStencilDF64",
+    "GatherSchedule",
     "PartitionedCSR",
     "RingPartitionedCSR",
     "SequenceResult",
+    "build_gather_schedule",
     "exchange_halo",
     "exchange_halo_axis",
     "make_mesh",
@@ -57,8 +68,10 @@ __all__ = [
     "neighbor_shift_perms",
     "partition_csr",
     "ring_partition_csr",
+    "rotation_perm",
     "row_sharding",
     "shard_vector",
+    "validate_permutation",
     "solve_distributed",
     "solve_distributed_df64",
     "solve_distributed_resident",

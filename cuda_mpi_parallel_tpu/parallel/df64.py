@@ -340,7 +340,10 @@ def solve_distributed_df64(
                   else None),
             record_history=record_history, check_every=check_every,
             method=method, flight=flight,
-            plan=resolve_plan(plan, a, n_shards))
+            # the df64 distributed CSR path is the ring-shiftell
+            # schedule: pin the planner to ring pricing (a gather
+            # exchange has no df64 kernel lane yet)
+            plan=resolve_plan(plan, a, n_shards, exchange="ring"))
     local = DistStencilDF64.create(a.grid, n_shards, axis_name=axis,
                                    scale=a.scale)
     # per-shard accounting (telemetry.shardscope): df64 halos carry the

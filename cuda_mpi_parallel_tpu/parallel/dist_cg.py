@@ -43,6 +43,7 @@ from ..utils.compat import shard_map
 from .mesh import make_mesh, shard_vector
 from .operators import (
     DistCSR,
+    DistCSRGather,
     DistCSRRing,
     DistShiftELLRing,
     DistStencil2D,
@@ -69,6 +70,7 @@ def solve_distributed(
     csr_comm: str = "allgather",
     flight=None,
     plan=None,
+    exchange=None,
 ) -> CGResult:
     """Solve the global system A x = b row-partitioned over a device mesh.
 
@@ -121,6 +123,21 @@ def solve_distributed(
         so the caller's ordering is preserved; the plan fingerprint
         joins the compiled-solver cache key.  Stencil operators are
         uniform by construction and reject ``plan``.
+      exchange: the general-CSR halo wire (``parallel.exchange``) -
+        ``"gather"`` ships only the coupled x entries as packed
+        per-neighbor ``lax.ppermute`` rounds (padded to the max over
+        shards; empty rounds dropped), ``"allgather"`` forces the
+        legacy full-x collective (bit-identical to pre-exchange
+        behavior, even under a gather-scored plan), ``"ring"`` is a
+        synonym for ``csr_comm="ring"``, and ``"auto"`` lets the
+        partition plan decide (its ``exchange`` lane joined the
+        planner's search) or, unplanned, applies the coupled-volume
+        rule (``exchange.AUTO_WIRE_FRACTION`` - dense coupling falls
+        back to allgather).  ``None`` (default) keeps the legacy
+        ``csr_comm`` lane, except that a plan carrying
+        ``exchange="gather"`` is honored - the planner priced that
+        wire, so the solve runs it.  Stencil operators exchange plane
+        halos already and reject ``exchange``.
       (tol/rtol/maxiter/record_history/check_every/compensated as in
       ``solver.cg``.)
 
@@ -143,6 +160,26 @@ def solve_distributed(
                          f"shape {b.shape}")
     if csr_comm not in ("allgather", "ring", "ring-shiftell"):
         raise ValueError(f"unknown csr_comm: {csr_comm!r}")
+    if exchange not in (None, "auto", "gather", "allgather", "ring"):
+        raise ValueError(
+            f"unknown exchange: {exchange!r} (expected 'auto', "
+            f"'gather', 'allgather', 'ring' or None)")
+    if exchange is not None and not isinstance(a, CSRMatrix):
+        raise ValueError(
+            f"exchange= applies to assembled CSRMatrix problems; "
+            f"{type(a).__name__} slabs exchange plane halos already")
+    if exchange == "ring":
+        if csr_comm == "ring-shiftell":
+            raise ValueError(
+                "exchange='ring' conflicts with csr_comm='ring-shiftell'"
+                " (pick one schedule)")
+        csr_comm, exchange = "ring", None
+    elif exchange in ("gather", "allgather") \
+            and csr_comm in ("ring", "ring-shiftell"):
+        raise ValueError(
+            f"exchange={exchange!r} conflicts with csr_comm="
+            f"{csr_comm!r}: the ring schedules rotate full x-blocks "
+            f"(use csr_comm='allgather' with exchange=, or drop one)")
     if plan is not None and not isinstance(a, CSRMatrix):
         raise ValueError(
             f"plan= applies to assembled CSRMatrix problems; "
@@ -188,11 +225,13 @@ def solve_distributed(
         return _solve_stencil(a, b, mesh, axis, n_shards, precond,
                               record_history, kw)
     if isinstance(a, CSRMatrix):
-        plan = resolve_plan(plan, a, n_shards)
+        plan = resolve_plan(plan, a, n_shards,
+                            exchange=_plan_exchange_hint(csr_comm,
+                                                         exchange))
         note()
         return _solve_csr(a, b, mesh, axis, n_shards, precond,
                           record_history, kw, csr_comm=csr_comm,
-                          plan=plan)
+                          plan=plan, exchange=exchange)
     raise TypeError(f"solve_distributed supports CSRMatrix/Stencil2D/"
                     f"Stencil3D, got {type(a).__name__}")
 
@@ -309,7 +348,8 @@ def _cached_solver(key, build, cost_ctx=None, cost_args=None):
                 ("dist_comm_psum_per_iteration", per.psum),
                 ("dist_comm_ppermute_per_iteration", per.ppermute),
                 ("dist_comm_all_gather_per_iteration", per.all_gather),
-                ("dist_comm_bytes_per_iteration", per.comm_bytes)):
+                ("dist_comm_bytes_per_iteration", per.comm_bytes),
+                ("dist_comm_wire_bytes_per_iteration", per.wire_bytes)):
             REGISTRY.gauge(
                 gname, "jaxpr-derived per-iteration communication of "
                 "the most recently built distributed solve",
@@ -323,6 +363,7 @@ def _cached_solver(key, build, cost_ctx=None, cost_args=None):
             all_gather_per_iteration=per.all_gather,
             dots_per_iteration=per.dots,
             comm_bytes_per_iteration=per.comm_bytes,
+            wire_bytes_per_iteration=per.wire_bytes,
             setup=solve_cost.setup.to_json(),
             **(cost_ctx or {}))
     return fn
@@ -343,7 +384,34 @@ def _note_shards(build_report) -> None:
         build_report(telemetry.shardscope))
 
 
-def resolve_plan(plan, a, n_shards, *, model=None):
+def _plan_exchange_hint(csr_comm: str, exchange) -> str:
+    """The exchange lane ``plan_partition`` should search/pin for a
+    solve: the ring schedules price their fixed rotation (whether
+    requested as ``csr_comm=`` or ``exchange="ring"``), an explicit
+    ``exchange=`` pins its lane, and ``None``/``"auto"`` leave the
+    planner free to choose (allgather vs gather joins the search)."""
+    if csr_comm in ("ring", "ring-shiftell") or exchange == "ring":
+        return "ring"
+    if exchange in ("gather", "allgather"):
+        return exchange
+    return "auto"
+
+
+def _resolve_exchange_mode(exchange, plan) -> str:
+    """The partition-time exchange mode of the allgather-family CSR
+    lane: an explicit ``exchange=`` always wins; otherwise the plan's
+    scored lane runs (the planner priced that wire); an unplanned
+    ``"auto"`` defers to the partition's coupled-volume rule; and bare
+    ``None`` without a plan is the legacy allgather, bit-identical."""
+    if exchange in ("gather", "allgather"):
+        return exchange
+    if plan is not None:
+        lane = getattr(plan, "exchange", "allgather")
+        return lane if lane in ("gather", "auto") else "allgather"
+    return "auto" if exchange == "auto" else "allgather"
+
+
+def resolve_plan(plan, a, n_shards, *, model=None, exchange="auto"):
     """Normalize the ``plan=`` argument of the CSR entry points:
     ``None`` passes through (the even split), ``"auto"`` runs the
     planner, a ``balance.PartitionPlan`` is validated against the
@@ -355,7 +423,10 @@ def resolve_plan(plan, a, n_shards, *, model=None):
     (``telemetry.calibrate.preferred_model``) is preferred if one
     exists on disk, else the deterministic reference table - so a
     process that never calibrated plans exactly as before, and one
-    that did gets runtime-corrected plans for free."""
+    that did gets runtime-corrected plans for free.  ``exchange`` is
+    the halo-wire lane hint forwarded to ``plan_partition`` (pin
+    ``"allgather"``/``"gather"``/``"ring"``, or ``"auto"`` to let the
+    lane join the (reorder x split) search)."""
     if plan is None:
         return None
     from ..balance import PartitionPlan, plan_partition
@@ -369,7 +440,8 @@ def resolve_plan(plan, a, n_shards, *, model=None):
             from ..telemetry import calibrate
 
             model = calibrate.preferred_model()
-        plan = plan_partition(a, n_shards, model=model)
+        plan = plan_partition(a, n_shards, model=model,
+                              exchange=exchange)
     elif not isinstance(plan, PartitionPlan):
         raise TypeError(
             f"plan must be None, 'auto' or a balance.PartitionPlan, "
@@ -378,6 +450,16 @@ def resolve_plan(plan, a, n_shards, *, model=None):
         raise ValueError(
             f"plan targets {plan.n_shards} shards but the mesh has "
             f"{n_shards}")
+    if exchange == "ring" and getattr(plan, "exchange",
+                                      "allgather") == "gather":
+        # the ring schedules rotate full x-blocks and would silently
+        # drop the plan's scored wire - the same conflict an explicit
+        # exchange='gather' + csr_comm='ring' raises (a run must never
+        # be labeled/priced for a wire it did not move)
+        raise ValueError(
+            "this plan was scored for the gather halo exchange, but "
+            "the requested ring schedule rotates full x-blocks; "
+            "re-plan with exchange='ring' (or drop csr_comm='ring')")
     plan.validate_for(a)
     if plan.is_trivial():
         # no permutation + even ranges IS the unplanned layout: take
@@ -414,6 +496,7 @@ def _note_partition(a, parts, plan) -> None:
     if plan is not None:
         telemetry.events.emit(
             "partition_plan", reorder=plan.reorder, split=plan.split,
+            exchange=getattr(plan, "exchange", "allgather"),
             n_shards=plan.n_shards, fingerprint=plan.fingerprint(),
             objective=plan.objective, score=float(plan.score),
             predicted=(plan.report.imbalance()
@@ -566,15 +649,22 @@ def _unpad_result(res: CGResult, parts, plan) -> CGResult:
 
 
 def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
-               kw, csr_comm: str = "allgather", plan=None) -> CGResult:
+               kw, csr_comm: str = "allgather", plan=None,
+               exchange=None) -> CGResult:
     if csr_comm == "ring-shiftell":
         return _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
                                    record_history, kw, plan=plan)
     ring = csr_comm == "ring"
     a, b = _apply_plan_permutation(a, b, plan)
     ranges = plan.row_ranges if plan is not None else None
-    parts = (part.ring_partition_csr(a, n_shards, ranges) if ring
-             else part.partition_csr(a, n_shards, ranges))
+    if ring:
+        parts = part.ring_partition_csr(a, n_shards, ranges)
+        resolved = "ring"
+    else:
+        parts = part.partition_csr(
+            a, n_shards, ranges,
+            exchange=_resolve_exchange_mode(exchange, plan))
+        resolved = "gather" if parts.halo is not None else "allgather"
     _note_partition(a, parts, plan)
     b_dev = _shard_padded_rhs(b, parts, mesh, axis)
     data = _shard_tree(parts.data, mesh, axis)  # array, or per-step tuple
@@ -582,33 +672,57 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
     rows = _shard_tree(parts.local_rows, mesh, axis)
 
     n_local = parts.n_local
-    key = ("csr", ring, n_local, n_shards, axis, mesh, precond,
-           record_history, tuple(sorted(kw.items())),
+    sched = parts.halo if not ring else None
+    gather = sched is not None
+    # gather layouts key on their round geometry too: the same matrix
+    # under a different plan's coupling compiles a different schedule
+    geometry = tuple((r.shift, r.m) for r in sched.rounds) \
+        if gather else None
+    key = ("csr", ring, resolved, geometry, n_local, n_shards, axis,
+           mesh, precond, record_history, tuple(sorted(kw.items())),
            plan.fingerprint() if plan is not None else None)
+    send = tuple(_shard_tree(r.send_idx, mesh, axis)
+                 for r in sched.rounds) if gather else ()
+    shifts = tuple(r.shift for r in sched.rounds) if gather else ()
 
     def build():
+        n_args = 5 if gather else 4
+
         @partial(shard_map, mesh=mesh,
-                 in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                 in_specs=(P(axis),) * n_args,
                  out_specs=_result_specs(axis, record_history,
                                           kw.get("flight")))
-        def run(b_local, data_s, cols_s, rows_s):
+        def run(b_local, data_s, cols_s, rows_s, send_s=()):
             _TRACE_COUNT[0] += 1
             strip = partial(jax.tree.map, lambda v: v[0])
-            op_cls = DistCSRRing if ring else DistCSR
-            op = op_cls(data=strip(data_s), cols=strip(cols_s),
-                        local_rows=strip(rows_s), n_local=n_local,
-                        axis_name=axis, n_shards=n_shards)
+            if gather:
+                op = DistCSRGather(
+                    data=strip(data_s), cols=strip(cols_s),
+                    local_rows=strip(rows_s), send_idx=strip(send_s),
+                    shifts=shifts, n_local=n_local, axis_name=axis,
+                    n_shards=n_shards)
+            else:
+                op_cls = DistCSRRing if ring else DistCSR
+                op = op_cls(data=strip(data_s), cols=strip(cols_s),
+                            local_rows=strip(rows_s), n_local=n_local,
+                            axis_name=axis, n_shards=n_shards)
             m = _make_precond(precond, op, axis)
             return cg(op, b_local, m=m, record_history=record_history,
                       axis_name=axis, **kw)
         return run
 
-    ctx = dict(kind="csr", check_every=kw["check_every"],
+    ctx = dict(kind="csr-gather" if gather else "csr",
+               check_every=kw["check_every"],
                method=kw["method"], n_shards=n_shards,
+               exchange=resolved,
                **({"plan": plan.label} if plan is not None else {}))
-    res = _cached_solver(key, build, ctx,
-                         (b_dev, data, cols, rows))(
-        b_dev, data, cols, rows)
+    if gather:
+        itemsize = np.asarray(parts.data).dtype.itemsize
+        ctx["halo_padding_fraction"] = round(sched.padding_fraction(), 6)
+        ctx["halo_wire_bytes_per_matvec"] = \
+            sched.wire_bytes_per_matvec(itemsize)
+    args = (b_dev, data, cols, rows) + ((send,) if gather else ())
+    res = _cached_solver(key, build, ctx, args)(*args)
     return _unpad_result(res, parts, plan)
 
 
@@ -767,17 +881,26 @@ class SequenceResult:
         return lines
 
 
-def _layout_key(plan, n: int, n_shards: int):
+def _layout_key(plan, n: int, n_shards: int,
+                unplanned_exchange: str = "allgather"):
     """Hashable identity of the layout a plan produces (even split for
     ``None``) - two plans with equal keys share partition arrays and
-    the compiled solver, so switching between them is free."""
+    the compiled solver, so switching between them is free.  The
+    exchange lane is part of the identity: the same ranges under
+    gather vs allgather compile different wires.  For ``plan=None``
+    the caller names the lane the unplanned solve actually ran
+    (``unplanned_exchange`` - an ``exchange="auto"`` solve may have
+    taken the gather wire), so an even+gather replan candidate
+    compares EQUAL to the identical running layout instead of
+    triggering a pointless switch."""
     from ..balance.nnz_split import even_ranges
 
     if plan is None:
-        return (even_ranges(n, n_shards), None)
+        return (even_ranges(n, n_shards), None, unplanned_exchange)
     perm = plan.permutation
     return (plan.row_ranges,
-            None if perm is None else tuple(int(v) for v in perm))
+            None if perm is None else tuple(int(v) for v in perm),
+            getattr(plan, "exchange", "allgather"))
 
 
 def _sequence_report(a, plan, n_shards: int, itemsize: int):
@@ -836,7 +959,11 @@ def solve_sequence(
       calibration_cache: ``utils.tune.JsonCache`` override (tests);
         ``persist_calibration=False`` keeps fits in-process only.
       **kw: forwarded to :func:`solve_distributed` (tol/maxiter/
-        method/csr_comm/flight/...).
+        method/csr_comm/flight/exchange/...).  A pinned
+        ``exchange=``/``csr_comm=`` also pins the lane the sequence
+        prices and replans within; left free, each replan searches the
+        exchange lane alongside (reorder x split) and every
+        observation prices the wire its solve actually ran.
 
     Each solve is dispatched twice (compile warmup + timed, the CLI's
     own protocol) so the calibration never ingests compile time; warmup
@@ -870,7 +997,35 @@ def solve_sequence(
     scoring_model = tcal.preferred_model(cache=calibration_cache)
     if scoring_model is None:
         scoring_model = reference_model()
-    current = resolve_plan(plan, a, n_shards, model=scoring_model)
+    # the exchange lane the sequence prices and replans within: pinned
+    # by the caller's csr_comm/exchange kwargs, else free ("auto" -
+    # the lane joins each replan's search)
+    lane_hint = _plan_exchange_hint(kw.get("csr_comm", "allgather"),
+                                    kw.get("exchange"))
+    current = resolve_plan(plan, a, n_shards, model=scoring_model,
+                           exchange=lane_hint)
+
+    def _ran_exchange(plan_k, report) -> str:
+        """The wire lane solve ``k`` actually ran - what its
+        observation and incumbent score must price.  For an unplanned
+        ``exchange="auto"`` solve this mirrors the partitioner's
+        coupled-volume rule against the SAME coupling report (the two
+        wire derivations are equal - tests assert it), so the
+        calibration never prices a wire the solve did not move."""
+        if lane_hint != "auto":
+            return lane_hint
+        if plan_k is not None:
+            lane = getattr(plan_k, "exchange", "allgather")
+            return lane if lane == "gather" else "allgather"
+        if kw.get("exchange") == "auto":
+            from ..telemetry.shardscope import gather_wire_bytes
+            from .exchange import accepts_gather
+
+            if accepts_gather(gather_wire_bytes(report),
+                              report.n_shards, report.n_local,
+                              itemsize):
+                return "gather"
+        return "allgather"
 
     observations = []
     entries = []
@@ -890,9 +1045,10 @@ def solve_sequence(
         iterations = max(int(res.iterations), 1)
 
         report = _sequence_report(a, plan_k, n_shards, itemsize)
+        lane_k = _ran_exchange(plan_k, report)
         observations.append(tcal.observation_for(
             report, iterations, elapsed, itemsize=itemsize,
-            label=f"solve{k}"))
+            exchange=lane_k, label=f"solve{k}"))
         fit = tcal.fit_machine_model(observations)
         tcal.note_calibration(fit)
         if persist_calibration:
@@ -900,19 +1056,22 @@ def solve_sequence(
         drift = tcal.note_drift(
             tcal.drift_report(report, iterations, elapsed,
                               itemsize=itemsize, model=scoring_model,
-                              plan=plan_k),
+                              plan=plan_k, exchange=lane_k),
             report=report, plan=plan_k, n_shards=n_shards)
 
         decision = None
         if replan and k + 1 < repeats:
             cand = plan_partition(a, n_shards, model=fit.model,
-                                  itemsize=itemsize)
+                                  itemsize=itemsize,
+                                  exchange=lane_hint)
             incumbent_score = score_report(report, itemsize=itemsize,
-                                           model=fit.model)
+                                           model=fit.model,
+                                           exchange=lane_k)
             gain_pct = 100.0 * (incumbent_score - cand.score) \
                 / max(incumbent_score, 1e-300)
             same = _layout_key(cand, n, n_shards) \
-                == _layout_key(plan_k, n, n_shards)
+                == _layout_key(plan_k, n, n_shards,
+                               unplanned_exchange=lane_k)
             if same or cand.score < incumbent_score * 0.98:
                 # adopt the calibrated-scored plan: same layout means a
                 # free re-score (equal fingerprint, cached solver);

@@ -20,7 +20,7 @@ import jax
 from jax import lax
 
 
-def validate_permutation(perm):
+def validate_permutation(perm, n_shards=None):
     """Reject ppermute permutation lists with duplicate sources or
     destinations - undefined on hardware (two sources racing into one
     destination buffer is last-writer-wins over ICI, the contested-slot
@@ -29,8 +29,13 @@ def validate_permutation(perm):
     The runtime twin of graftlint's collective-safety rule: GL103 can
     only decide *literal* ``perm=[...]`` lists, so every schedule this
     package builds at trace time (the neighbor chains below, the ring
-    rotations in ``parallel.operators``) routes through this check.
-    Returns ``perm`` unchanged, so builders can wrap in place.
+    rotations in ``parallel.operators``, and every gather-exchange
+    round ``parallel.exchange`` compiles) routes through this check.
+    Passing ``n_shards`` additionally bounds every source and
+    destination to ``[0, n_shards)`` - an out-of-range device id in a
+    ppermute permutation is dropped silently by some backends and a
+    hard trace error on others, so a schedule builder must never emit
+    one.  Returns ``perm`` unchanged, so builders can wrap in place.
     """
     perm = list(perm)
     srcs = [s for s, _ in perm]
@@ -44,7 +49,35 @@ def validate_permutation(perm):
             f"ppermute permutation lists a destination twice (two "
             f"sources racing into one destination is undefined): "
             f"{perm}")
+    if n_shards is not None:
+        bad = [(s, d) for s, d in perm
+               if not (0 <= s < n_shards and 0 <= d < n_shards)]
+        if bad:
+            raise ValueError(
+                f"ppermute permutation references device ids outside "
+                f"[0, {n_shards}): {bad}")
     return perm
+
+
+def rotation_perm(n_shards: int, shift: int):
+    """The validated ring rotation ``j -> (j + shift) % n_shards``.
+
+    The one permutation family every packed schedule in this package
+    uses (the ring x-rotation at shift 1, the gather-exchange rounds of
+    ``parallel.exchange`` at every coupled shift): each device sends
+    exactly once and receives exactly once, so the duplicate-source/
+    destination hazard is impossible by construction - and still
+    checked, because this routes through :func:`validate_permutation`
+    with the bounds enabled.
+    """
+    if not 1 <= shift < n_shards:
+        raise ValueError(
+            f"rotation shift must be in [1, n_shards); got shift="
+            f"{shift} with n_shards={n_shards} (shift 0 is a self-send "
+            f"carrying no halo)")
+    return validate_permutation(
+        ((j, (j + shift) % n_shards) for j in range(n_shards)),
+        n_shards=n_shards)
 
 
 def neighbor_shift_perms(n_shards: int):

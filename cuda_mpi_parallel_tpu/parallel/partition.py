@@ -53,6 +53,12 @@ class PartitionedCSR(NamedTuple):
     n_global: int
     n_shards: int
     row_ranges: Optional[RowRanges] = None
+    #: compiled gather halo schedule (parallel.exchange) when the
+    #: partition was built with ``exchange="gather"`` (or "auto"
+    #: accepted it); ``cols`` are then EXTENDED-LOCAL ids into
+    #: ``[local block | per-round halo slabs]``.  ``None`` = the
+    #: allgather layout, byte-identical to pre-exchange output.
+    halo: Optional[object] = None
 
 
 def padded_size(n: int, n_shards: int) -> int:
@@ -92,9 +98,49 @@ def _ranges_layout(a, n_shards: int, row_ranges: RowRanges):
         gather_indices(ranges, n_local)
 
 
+def _attach_gather_schedule(parts: "PartitionedCSR",
+                            exchange: str) -> "PartitionedCSR":
+    """Compile the gather halo schedule onto a freshly built partition
+    (``parallel.exchange``): cols remapped to the extended-local
+    layout, schedule attached as ``halo``.  ``exchange="auto"`` keeps
+    the allgather layout untouched when the coupled volume is too
+    dense to win - probed with the counts-only wire scan, so the
+    decline path (dense coupling, exactly where the scan is largest)
+    never materializes send indices or remaps a column."""
+    from . import exchange as ex
+
+    sets = None
+    if exchange == "auto":
+        itemsize = np.asarray(parts.data).dtype.itemsize
+        sets = ex._coupled_sets(np.asarray(parts.data),
+                                np.asarray(parts.cols),
+                                parts.n_local, parts.n_shards)
+        wire = sum(m for _, _, m
+                   in ex._round_sizes(sets[0], parts.n_shards)) \
+            * itemsize
+        if not ex.accepts_gather(wire, parts.n_shards, parts.n_local,
+                                 itemsize):
+            return parts
+    sched, new_cols = ex.build_gather_schedule(
+        parts.data, parts.cols, parts.n_local, parts.n_shards,
+        precomputed=sets)
+    return parts._replace(cols=new_cols, halo=sched)
+
+
+def check_exchange(exchange: str, allowed, where: str) -> str:
+    """Validate an ``exchange=`` argument against one partitioner's
+    lanes - a typo'd mode must fail at the call site, not as a silent
+    allgather fallback."""
+    if exchange not in allowed:
+        raise ValueError(
+            f"unknown exchange {exchange!r} for {where}; expected one "
+            f"of {sorted(allowed)}")
+    return exchange
+
+
 def partition_csr(a: CSRMatrix, n_shards: int,
-                  row_ranges: Optional[RowRanges] = None
-                  ) -> PartitionedCSR:
+                  row_ranges: Optional[RowRanges] = None,
+                  exchange: str = "allgather") -> PartitionedCSR:
     """Split a global CSR matrix into ``n_shards`` row blocks.
 
     ``row_ranges`` (a partition plan's contiguous variable-row split)
@@ -102,9 +148,23 @@ def partition_csr(a: CSRMatrix, n_shards: int,
     to the max real row count, and ``cols`` are remapped into the
     padded global ordering.  ``None`` is the legacy even split,
     byte-identical to what this function always produced.
+
+    ``exchange`` selects the halo wire the partition is laid out for:
+    ``"allgather"`` (default, byte-identical legacy output - global
+    column ids, the ``DistCSR`` all-gather matvec), ``"gather"``
+    (compile the packed coupled-entry schedule of
+    ``parallel.exchange`` and remap ``cols`` into the extended-local
+    layout; the schedule rides the ``halo`` field), or ``"auto"``
+    (build the schedule, keep it only when its padded wire undercuts
+    the dense payload - see ``exchange.AUTO_WIRE_FRACTION``).
     """
+    check_exchange(exchange, ("allgather", "gather", "auto"),
+                   "partition_csr")
     if row_ranges is not None:
-        return _partition_csr_ranges(a, n_shards, row_ranges)
+        parts = _partition_csr_ranges(a, n_shards, row_ranges)
+        if exchange != "allgather":
+            parts = _attach_gather_schedule(parts, exchange)
+        return parts
     n = a.shape[0]
     n_pad = padded_size(n, n_shards)
     n_local = n_pad // n_shards
@@ -140,11 +200,14 @@ def partition_csr(a: CSRMatrix, n_shards: int,
             out_cols[s, k] = r  # global id of the padding row
             out_rows[s, k] = r - lo
             k += 1
-    return PartitionedCSR(
+    parts = PartitionedCSR(
         data=out_data, cols=out_cols, local_rows=out_rows,
         n_local=n_local, n_global_padded=n_pad, n_global=n,
         n_shards=n_shards,
     )
+    if exchange != "allgather":
+        parts = _attach_gather_schedule(parts, exchange)
+    return parts
 
 
 def _partition_csr_ranges(a: CSRMatrix, n_shards: int,
@@ -231,8 +294,8 @@ class RingPartitionedCSR(NamedTuple):
 
 
 def ring_partition_csr(a: CSRMatrix, n_shards: int,
-                       row_ranges: Optional[RowRanges] = None
-                       ) -> RingPartitionedCSR:
+                       row_ranges: Optional[RowRanges] = None,
+                       exchange: str = "ring") -> RingPartitionedCSR:
     """Split a global CSR matrix for the ring SpMV schedule.
 
     Starts from ``partition_csr``'s row blocks, then splits each owner's
@@ -241,7 +304,15 @@ def ring_partition_csr(a: CSRMatrix, n_shards: int,
     A plan's ``row_ranges`` passes straight through: the remapped
     padded-global ``cols`` tile into ``n_local``-sized column blocks by
     construction, so the ring's block arithmetic is unchanged.
+
+    ``exchange`` is validated for interface uniformity with
+    ``partition_csr``: the ring layout IS its exchange (full x-block
+    rotation), so only ``"ring"`` (or ``"auto"``, which resolves to
+    it) is legal here - a gather-exchange layout comes from
+    ``partition_csr(exchange="gather")``.
     """
+    check_exchange(exchange, ("ring", "auto"), "ring_partition_csr "
+                   "(gather/allgather layouts come from partition_csr)")
     rows_part = partition_csr(a, n_shards, row_ranges)
     n_local = rows_part.n_local
     slabs = []

@@ -89,10 +89,11 @@ class PhaseObservation:
 
     ``gather_bytes_per_iteration`` is the padded slot work the model's
     memory term prices (``slots_max * (itemsize + 4)``);
-    ``net_bytes_per_iteration`` the wire-priced bytes (fixed x-rotation
-    payload plus the down-weighted coupling term) - both computed by
-    :func:`observation_for` from a ``ShardReport`` so predicted and
-    measured always price the same terms.
+    ``net_bytes_per_iteration`` the wire-priced bytes of the exchange
+    lane that actually ran (fixed x-rotation payload, or the packed
+    coupled-entry rounds - ``balance.plan.wire_bytes_for``) - both
+    computed by :func:`observation_for` from a ``ShardReport`` so
+    predicted and measured always price the same terms.
     """
 
     iterations: int
@@ -120,27 +121,30 @@ class PhaseObservation:
 def observation_for(report, iterations: int, elapsed_s: float, *,
                     itemsize: int,
                     comm_bytes_per_iteration: Optional[float] = None,
+                    exchange: str = "allgather",
                     label: str = "") -> PhaseObservation:
     """Build the observation for one solve from its static accounting.
 
     ``report`` is the coupling-semantics ``ShardReport`` of the layout
     that ran (``shardscope.report_for_ranges`` / the plan's predicted
     report) - the same report ``balance.plan.score_report`` prices, so
-    the fit corrects exactly the model that planned.  When the
-    jaxpr-derived per-iteration payload is known
+    the fit corrects exactly the model that planned.  ``exchange``
+    names the halo wire the solve ran; its per-iteration bytes come
+    from ``balance.plan.wire_bytes_for`` (the planner's own term -
+    fixed payload for allgather/ring, the full-weight packed coupled
+    rounds for gather; the historical 0.25 coupling fudge is gone on
+    both sides at once, so predicted and measured stay one model).
+    When the jaxpr-derived per-iteration wire is known
     (``dist_cg.last_comm_cost``), pass it as
-    ``comm_bytes_per_iteration`` to replace the analytic x-rotation
-    payload term.
+    ``comm_bytes_per_iteration`` to replace the analytic term.
     """
+    from ..balance.plan import wire_bytes_for
+
     gather = float(report.slots.max()) * (itemsize + 4)
     if comm_bytes_per_iteration is not None:
-        payload = float(comm_bytes_per_iteration)
+        net = float(comm_bytes_per_iteration)
     else:
-        payload = float((report.n_shards - 1) * report.n_local * itemsize)
-    coupling = (np.asarray(report.halo_send_bytes, dtype=np.float64)
-                + np.asarray(report.halo_recv_bytes, dtype=np.float64))
-    net = payload + (0.25 * float(coupling.max()) if coupling.size
-                     else 0.0)
+        net = wire_bytes_for(report, exchange, itemsize)
     return PhaseObservation(
         iterations=int(iterations), elapsed_s=float(elapsed_s),
         gather_bytes_per_iteration=gather,
@@ -397,17 +401,25 @@ class DriftReport:
 
 def drift_report(report, iterations: int, elapsed_s: float, *,
                  itemsize: int, model: Optional[MachineModel] = None,
-                 plan=None) -> DriftReport:
+                 plan=None, exchange: Optional[str] = None
+                 ) -> DriftReport:
     """Predicted-vs-measured stall-time drift for one solve.
 
     ``report``/``itemsize`` describe the layout that ran (coupling
     semantics); ``model`` is the machine model that PRICED it (the one
     that chose the plan - reference unless a calibrated model was
     passed), so drift measures that model's error, not the best
-    possible model's."""
+    possible model's.  ``exchange`` names the halo wire the solve ran
+    (default: the plan's scored lane, or allgather) - the drift
+    contract extends to the wire: prediction prices the same exchange
+    that moved the bytes."""
     from ..balance.plan import score_report
 
-    predicted = score_report(report, itemsize=itemsize, model=model)
+    if exchange is None:
+        exchange = getattr(plan, "exchange", "allgather") \
+            if plan is not None else "allgather"
+    predicted = score_report(report, itemsize=itemsize, model=model,
+                             exchange=exchange)
     measured = float(elapsed_s) / max(int(iterations), 1)
     drift = 100.0 * (measured - predicted) / max(predicted, 1e-300)
     if model is None:
@@ -450,8 +462,10 @@ def note_drift(drift: DriftReport, *, report=None,
                                   plan=drift.plan)
     if telemetry.events.active():
         reorder, split = "none", "even"
+        exchange = "allgather"
         if plan is not None:
             reorder, split = plan.reorder, plan.split
+            exchange = getattr(plan, "exchange", "allgather")
         shards = n_shards
         if shards is None:
             shards = (plan.n_shards if plan is not None
@@ -460,7 +474,8 @@ def note_drift(drift: DriftReport, *, report=None,
                         else None)
         telemetry.events.emit(
             "partition_plan", stage="drift", reorder=reorder,
-            split=split, n_shards=int(shards), measured=measured_imb,
+            split=split, exchange=exchange, n_shards=int(shards),
+            measured=measured_imb,
             drift_pct=drift.drift_pct,
             predicted_s_per_iteration=drift.predicted_s_per_iteration,
             measured_s_per_iteration=drift.measured_s_per_iteration,

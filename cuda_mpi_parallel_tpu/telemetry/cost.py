@@ -34,6 +34,7 @@ import jax
 
 __all__ = [
     "COLLECTIVE_PRIMITIVES",
+    "EXCHANGE_PRIMITIVES",
     "OpCounts",
     "SolveCost",
     "analytic_solve_ops",
@@ -48,13 +49,42 @@ COLLECTIVE_PRIMITIVES = frozenset({
     "all_to_all", "reduce_scatter",
 })
 
+#: the DATA-MOVEMENT subset: collectives that relocate x/halo payloads
+#: between devices (what an ``exchange=`` lane controls), as opposed to
+#: the scalar reductions of the CG recurrence.  Only these contribute
+#: to ``wire_bytes``.
+EXCHANGE_PRIMITIVES = frozenset({
+    "ppermute", "pshuffle", "all_gather", "all_to_all",
+    "reduce_scatter",
+})
+
 
 @dataclasses.dataclass(frozen=True)
 class OpCounts:
-    """Primitive counts plus collective payload bytes for one region."""
+    """Primitive counts plus collective byte accounts for one region.
+
+    Two byte semantics ride together:
+
+    * ``comm_bytes`` - PAYLOAD bytes: the sum of each collective's
+      input avals (the historical account; for a halo ``ppermute``
+      this is exactly the boundary-slab size).
+    * ``wire_bytes`` - per-device INTERCONNECT bytes of the
+      data-movement collectives (:data:`EXCHANGE_PRIMITIVES`): what
+      actually crosses links per device.  An ``all_gather`` is charged
+      ``output - input`` bytes (the ring implementation lands
+      ``(P-1) * n_local`` remote entries on every device - its input
+      aval undercounts the wire ``P-1``-fold); a ``reduce_scatter``
+      the mirror ``input - output``; a ``ppermute`` its payload (sent
+      exactly once).  Scalar reductions (psum/pmax/pmin) are excluded:
+      their O(bytes) allreduce wire stays visible in ``comm_bytes``,
+      and keeping them out makes ``wire_bytes`` exactly the halo
+      volume the exchange schedule promises - the number the gather
+      lane's acceptance compares (shardscope-predicted == measured).
+    """
 
     ops: Mapping[str, int]
     comm_bytes: int = 0
+    wire_bytes: int = 0
 
     def get(self, name: str) -> int:
         return int(self.ops.get(name, 0))
@@ -89,11 +119,13 @@ class OpCounts:
 
         return OpCounts(
             ops={k: scale(v) for k, v in self.ops.items()},
-            comm_bytes=scale(self.comm_bytes))
+            comm_bytes=scale(self.comm_bytes),
+            wire_bytes=scale(self.wire_bytes))
 
     def to_json(self) -> Dict[str, Any]:
         return {"ops": dict(sorted(self.ops.items())),
-                "comm_bytes": self.comm_bytes}
+                "comm_bytes": self.comm_bytes,
+                "wire_bytes": self.wire_bytes}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -121,7 +153,9 @@ class SolveCost:
         return OpCounts(
             ops=dict(ops),
             comm_bytes=self.setup.comm_bytes
-            + self.per_iteration.comm_bytes * iterations)
+            + self.per_iteration.comm_bytes * iterations,
+            wire_bytes=self.setup.wire_bytes
+            + self.per_iteration.wire_bytes * iterations)
 
     def to_json(self) -> Dict[str, Any]:
         return {"setup": self.setup.to_json(),
@@ -150,6 +184,21 @@ def _payload_bytes(eqn) -> int:
                if hasattr(v, "aval"))
 
 
+def _wire_bytes(eqn) -> int:
+    """Per-device interconnect bytes of a data-movement collective
+    (see ``OpCounts.wire_bytes``); 0 for anything else."""
+    name = eqn.primitive.name
+    if name not in EXCHANGE_PRIMITIVES:
+        return 0
+    inb = _payload_bytes(eqn)
+    if name in ("all_gather", "reduce_scatter"):
+        outb = sum(_aval_bytes(v) for v in eqn.outvars
+                   if hasattr(v, "aval"))
+        return max(outb - inb, 0) if name == "all_gather" \
+            else max(inb - outb, 0)
+    return inb
+
+
 def _param_jaxprs(params: Mapping[str, Any]):
     """Every jaxpr-like value in an eqn's params (pjit/shard_map/
     custom_jvp/remat/... - anything not special-cased by the walker)."""
@@ -161,15 +210,17 @@ def _param_jaxprs(params: Mapping[str, Any]):
 
 
 def _merge_scaled(dst: _Counter, bytes_box: List[int], src: _Counter,
-                  src_bytes: int, mult: int) -> None:
+                  src_bytes, mult: int) -> None:
     for k, v in src.items():
         dst[k] += v * mult
-    bytes_box[0] += src_bytes * mult
+    bytes_box[0] += src_bytes[0] * mult
+    bytes_box[1] += src_bytes[1] * mult
 
 
 def _walk(jaxpr, counts: _Counter, bytes_box: List[int],
           loops: Optional[List[OpCounts]], mult: int) -> None:
-    """Accumulate primitive counts and collective payload bytes.
+    """Accumulate primitive counts and collective payload/wire bytes
+    (``bytes_box`` is the two-slot ``[comm, wire]`` accumulator).
 
     ``loops`` records the per-trip counts of each TOP-LEVEL ``while``
     (outermost region only - a nested while's one-trip counts are
@@ -186,46 +237,50 @@ def _walk(jaxpr, counts: _Counter, bytes_box: List[int],
             body = _inner_jaxpr(eqn.params["body_jaxpr"])
             cond = _inner_jaxpr(eqn.params["cond_jaxpr"])
             trip_counts: _Counter = _Counter()
-            trip_bytes = [0]
+            trip_bytes = [0, 0]
             _walk(body, trip_counts, trip_bytes, None, 1)
             _walk(cond, trip_counts, trip_bytes, None, 1)
             if loops is not None:
                 loops.append(OpCounts(ops=dict(trip_counts),
-                                      comm_bytes=trip_bytes[0]))
+                                      comm_bytes=trip_bytes[0],
+                                      wire_bytes=trip_bytes[1]))
             # Trip count is dynamic (that is the point of a while); the
             # TOTALS account one trip, and callers scale by the actual
             # iteration count via SolveCost.totals().
-            _merge_scaled(counts, bytes_box, trip_counts, trip_bytes[0],
+            _merge_scaled(counts, bytes_box, trip_counts, trip_bytes,
                           mult)
         elif name == "scan":
             length = int(eqn.params.get("length", 1))
             inner = _inner_jaxpr(eqn.params["jaxpr"])
             inner_counts: _Counter = _Counter()
-            inner_bytes = [0]
+            inner_bytes = [0, 0]
             _walk(inner, inner_counts, inner_bytes, None, 1)
             # static trip count: totals are exact
             _merge_scaled(counts, bytes_box, inner_counts,
-                          inner_bytes[0], mult * length)
+                          inner_bytes, mult * length)
         elif name == "cond":
             # branches may differ (e.g. pipecg's periodic residual
             # replacement); account the WORST branch per op - a
             # conservative upper bound for communication budgeting.
-            branch_counts: List[Tuple[_Counter, int]] = []
+            branch_counts: List[Tuple[_Counter, List[int]]] = []
             for branch in eqn.params["branches"]:
                 c: _Counter = _Counter()
-                bb = [0]
+                bb = [0, 0]
                 _walk(_inner_jaxpr(branch), c, bb, None, 1)
-                branch_counts.append((c, bb[0]))
+                branch_counts.append((c, bb))
             worst: _Counter = _Counter()
             for c, _ in branch_counts:
                 for k, v in c.items():
                     worst[k] = max(worst[k], v)
-            worst_bytes = max((bb for _, bb in branch_counts), default=0)
+            worst_bytes = [
+                max((bb[i] for _, bb in branch_counts), default=0)
+                for i in (0, 1)]
             _merge_scaled(counts, bytes_box, worst, worst_bytes, mult)
         else:
             counts[name] += mult
             if name in COLLECTIVE_PRIMITIVES:
                 bytes_box[0] += _payload_bytes(eqn) * mult
+                bytes_box[1] += _wire_bytes(eqn) * mult
             for sub in _param_jaxprs(eqn.params):
                 _walk(sub, counts, bytes_box, loops, mult)
 
@@ -243,7 +298,7 @@ def jaxpr_solve_cost(closed_jaxpr, *,
         raise ValueError(
             f"iterations_per_trip must be >= 1, got {iterations_per_trip}")
     totals: _Counter = _Counter()
-    total_bytes = [0]
+    total_bytes = [0, 0]
     loops: List[OpCounts] = []
     _walk(_inner_jaxpr(closed_jaxpr), totals, total_bytes, loops, 1)
 
@@ -259,11 +314,13 @@ def jaxpr_solve_cost(closed_jaxpr, *,
                 setup_ops[k] -= v
         setup = OpCounts(
             ops={k: v for k, v in setup_ops.items() if v},
-            comm_bytes=total_bytes[0] - sum(l.comm_bytes for l in loops))
+            comm_bytes=total_bytes[0] - sum(l.comm_bytes for l in loops),
+            wire_bytes=total_bytes[1] - sum(l.wire_bytes for l in loops))
     else:
         main = OpCounts(ops={})
         per_iter = main
-        setup = OpCounts(ops=dict(totals), comm_bytes=total_bytes[0])
+        setup = OpCounts(ops=dict(totals), comm_bytes=total_bytes[0],
+                         wire_bytes=total_bytes[1])
     return SolveCost(setup=setup, per_iteration=per_iter,
                      loops=tuple(loops))
 

@@ -124,6 +124,15 @@ class SolveReport:
                 f"{self.comm.get('ppermute', 0)} ppermute, "
                 f"{self.comm.get('all_gather', 0)} all_gather, "
                 f"{self.comm.get('comm_bytes', 0)} payload bytes total")
+            if self.comm.get("wire_bytes") is not None:
+                ex = self.comm.get("exchange")
+                pad = self.comm.get("halo_padding_fraction")
+                lines.append(
+                    f"wire: {self.comm['wire_bytes']} interconnect "
+                    f"bytes total"
+                    + (f", exchange={ex}" if ex else "")
+                    + (f", halo padding {pad * 100:.1f}%"
+                       if pad is not None else ""))
             if self.comm.get("note"):
                 lines.append(f"({self.comm['note']})")
         if self.roofline is not None:

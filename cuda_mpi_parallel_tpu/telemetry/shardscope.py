@@ -44,11 +44,13 @@ import numpy as np
 
 __all__ = [
     "ShardReport",
+    "gather_wire_bytes",
     "gini",
     "last_shard_report",
     "max_over_mean",
     "note_report",
     "report_for_ranges",
+    "report_gather_csr",
     "report_partition_csr",
     "report_ring_csr",
     "report_ring_shiftell",
@@ -256,9 +258,78 @@ def _ring_halo(n_shards: int, payload: int):
     return send, recv, neighbors
 
 
+def gather_wire_bytes(report: "ShardReport") -> int:
+    """Per-device per-matvec interconnect bytes of the gather halo
+    exchange (``parallel.exchange``) on the layout ``report``
+    describes - REQUIRES coupling semantics (``report_for_ranges``),
+    whose ``neighbors`` list the distinct coupled-entry bytes per
+    (owner, reader) pair.
+
+    The schedule packs pair ``j -> (j + r) % P`` into rotation round
+    ``r`` and pads each round to the max over senders (``shard_map``
+    needs one static shape per collective), so the wire is
+    ``sum_r max_j bytes(j -> (j + r) % P)`` - exactly what
+    ``exchange.GatherSchedule.wire_bytes_per_matvec`` reports for the
+    built schedule, here computable from the report alone (what the
+    planner scores before anything is built).  Rounds with no coupled
+    pair contribute zero (they are dropped from the wire entirely).
+    """
+    p = report.n_shards
+    if p <= 1:
+        return 0
+    pair = {}
+    for k, ns in enumerate(report.neighbors):
+        for peer, b in ns:
+            if peer >= 0:
+                pair[(k, peer)] = int(b)
+    total = 0
+    for shift in range(1, p):
+        total += max(pair.get((k, (k + shift) % p), 0)
+                     for k in range(p))
+    return total
+
+
+def report_gather_csr(a, parts, plan=None) -> ShardReport:
+    """Accounting for ``partition.partition_csr(exchange='gather')``
+    output (the ``DistCSRGather`` packed-ppermute schedule).
+
+    Unlike every fixed-payload schedule, the wire here IS the coupled
+    halo: per round ``r`` shard ``k`` sends its padded slab
+    (``m_r * itemsize`` bytes, the round's max live count over
+    senders) to ``(k + r) % P`` and receives the same from
+    ``(k - r) % P`` - so sends and receives are uniform across shards
+    and ``neighbors`` resolves per rotation peer.  These are the REAL
+    per-matvec wire bytes (padding included: padded slots ride the
+    links too), matching the jaxpr-derived ``wire_bytes`` account of
+    ``telemetry.cost`` exactly."""
+    sched = parts.halo
+    n_shards, n_local = parts.n_shards, parts.n_local
+    ranges = getattr(parts, "row_ranges", None)
+    itemsize = np.asarray(parts.data).dtype.itemsize
+    nnz = _csr_shard_nnz(a, n_local, n_shards, ranges)
+    slots = np.full(n_shards, parts.data.shape[1], dtype=np.int64)
+    per_device = sched.wire_bytes_per_matvec(itemsize)
+    send = np.full(n_shards, per_device, dtype=np.int64)
+    recv = send.copy()
+    neighbors = tuple(
+        tuple(((k + r.shift) % n_shards, r.m * itemsize)
+              for r in sched.rounds)
+        for k in range(n_shards))
+    return ShardReport(
+        kind="csr-gather", n_shards=n_shards, n_global=parts.n_global,
+        n_global_padded=parts.n_global_padded, n_local=n_local,
+        rows=_real_rows(parts.n_global, n_local, n_shards, ranges),
+        nnz=nnz,
+        slots=slots, halo_send_bytes=send, halo_recv_bytes=recv,
+        neighbors=neighbors, plan=_plan_label(parts, plan))
+
+
 def report_partition_csr(a, parts, plan=None) -> ShardReport:
     """Accounting for ``partition.partition_csr`` output (the
-    ``all_gather`` ``DistCSR`` schedule)."""
+    ``all_gather`` ``DistCSR`` schedule; gather-exchange partitions
+    dispatch to :func:`report_gather_csr`)."""
+    if getattr(parts, "halo", None) is not None:
+        return report_gather_csr(a, parts, plan=plan)
     n_shards, n_local = parts.n_shards, parts.n_local
     ranges = getattr(parts, "row_ranges", None)
     itemsize = np.asarray(parts.data).dtype.itemsize

@@ -206,14 +206,21 @@ class TestPlanPartition:
 
     def test_balanced_structured_system_keeps_simplest_lane(self):
         """A uniform Poisson band is already balanced: the planner must
-        return the trivial lane (no permutation, even ranges), so a
-        planned solve of a healthy system degenerates to the legacy
-        layout."""
+        keep the trivial LAYOUT (no permutation, even ranges) - since
+        the exchange lane joined the search it may still upgrade the
+        WIRE (the band's coupling is tiny, so the gather halo beats the
+        fixed allgather payload), but reordering a healthy system for a
+        wire win the trivial layout gets for free would be churn."""
         a = poisson.poisson_2d_csr(16, 16)
         plan = plan_partition(a, 4)
         assert plan.reorder == "none" and plan.split == "even"
         assert plan.permutation is None
         assert plan.row_ranges == even_ranges(256, 4)
+        # the band couples only adjacent shards: the gather wire wins
+        assert plan.exchange == "gather"
+        # pinning the legacy wire recovers the fully trivial plan
+        pinned = plan_partition(a, 4, exchange="allgather")
+        assert pinned.is_trivial()
 
     def test_unknown_objective_and_shards_rejected(self):
         a = skewed_block_csr()
@@ -269,14 +276,19 @@ class TestPlanPartition:
 
     def test_trivial_plan_collapses_to_none(self):
         """A plan that IS the legacy layout (no permutation, even
-        ranges) resolves to None, so an auto-planned solve of a
-        balanced system shares the unplanned executable."""
+        ranges, fixed-payload wire) resolves to None, so an
+        auto-planned solve of a balanced system shares the unplanned
+        executable.  A gather-lane plan never collapses: even on even
+        ranges its wire differs from the legacy schedule."""
         from cuda_mpi_parallel_tpu.parallel.dist_cg import resolve_plan
 
         a = poisson.poisson_2d_csr(16, 16)
-        plan = plan_partition(a, 4)
+        plan = plan_partition(a, 4, exchange="allgather")
         assert plan.is_trivial()
         assert resolve_plan(plan, a, 4) is None
+        gather = plan_partition(a, 4, exchange="gather")
+        assert not gather.is_trivial()
+        assert resolve_plan(gather, a, 4) is gather
         skewed = plan_partition(skewed_block_csr(64, 16), 4,
                                 objective="nnz")
         assert not skewed.is_trivial()

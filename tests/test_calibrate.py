@@ -155,16 +155,42 @@ class TestObservationFor:
         })
         obs = cal.observation_for(rep, 10, 0.5, itemsize=8)
         assert obs.gather_bytes_per_iteration == 19 * (8 + 4)
-        # fixed x-rotation payload + down-weighted peak coupling
+        # allgather lane: the fixed x-rotation payload, FULL stop - the
+        # historical 0.25 coupling fudge is gone (the wire either
+        # ignores coupling entirely, or honors it exactly via the
+        # gather lane below)
         assert obs.net_bytes_per_iteration == pytest.approx(
-            (4 - 1) * 4 * 8 + 0.25 * (16 + 48))
+            (4 - 1) * 4 * 8)
         assert obs.s_per_iteration == pytest.approx(0.05)
-        # the jaxpr-derived payload, when known, replaces the analytic
-        # x-rotation term
+        # the jaxpr-derived wire, when known, replaces the analytic term
         obs2 = cal.observation_for(rep, 10, 0.5, itemsize=8,
                                    comm_bytes_per_iteration=1000.0)
-        assert obs2.net_bytes_per_iteration == pytest.approx(
-            1000.0 + 0.25 * (16 + 48))
+        assert obs2.net_bytes_per_iteration == pytest.approx(1000.0)
+
+    def test_gather_lane_prices_coupled_wire(self):
+        """exchange='gather' observations price the packed coupled
+        rounds (balance.plan.wire_bytes_for == shardscope.
+        gather_wire_bytes), full weight - the same term score_report
+        charges, so predicted and measured stay one model."""
+        from cuda_mpi_parallel_tpu.balance.plan import wire_bytes_for
+
+        rep = ss.ShardReport.from_json({
+            "kind": "ranges", "n_shards": 4, "n_global": 16,
+            "n_global_padded": 16, "n_local": 4,
+            "rows": [4, 4, 4, 4], "nnz": [19, 4, 4, 4],
+            "slots": [19, 19, 19, 19],
+            "halo_send_bytes": [16, 16, 16, 16],
+            "halo_recv_bytes": [48, 48, 48, 48],
+            # shard k sends 16 B to its forward neighbor only: rounds
+            # shift=1 (max 16 B) and nothing else -> wire = 16 B
+            "neighbors": [[[(k + 1) % 4, 16]] for k in range(4)],
+        })
+        obs = cal.observation_for(rep, 10, 0.5, itemsize=8,
+                                  exchange="gather")
+        assert obs.net_bytes_per_iteration == pytest.approx(
+            ss.gather_wire_bytes(rep))
+        assert obs.net_bytes_per_iteration == pytest.approx(
+            wire_bytes_for(rep, "gather", 8)) == 16.0
 
 
 class TestJsonCache:

@@ -1,0 +1,637 @@
+"""parallel/exchange: the sparse gather halo schedule.
+
+The gather exchange's claims are all checkable numbers: the compiled
+schedule's per-round send sets must equal hand-computed coupled-entry
+sets, the remapped columns must reconstruct the exact matvec, a
+mesh-4 gather solve must BIT-match the allgather solve (same entries
+summed in the same order), the jaxpr-derived wire bytes must equal the
+shardscope-predicted coupled bytes (the 0.25 disagreement is gone),
+and ``exchange="allgather"`` must leave the solve jaxpr bit-identical
+to pre-exchange behavior.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import solve, telemetry
+from cuda_mpi_parallel_tpu.balance import plan_partition
+from cuda_mpi_parallel_tpu.balance.nnz_split import even_ranges
+from cuda_mpi_parallel_tpu.balance.plan import (
+    PartitionPlan,
+    reference_model,
+    score_report,
+    wire_bytes_for,
+)
+from cuda_mpi_parallel_tpu.models import mmio, poisson
+from cuda_mpi_parallel_tpu.models.operators import CSRMatrix
+from cuda_mpi_parallel_tpu.parallel import partition as part
+from cuda_mpi_parallel_tpu.parallel import exchange as ex
+from cuda_mpi_parallel_tpu.parallel.halo import (
+    rotation_perm,
+    validate_permutation,
+)
+from cuda_mpi_parallel_tpu.telemetry import events
+from cuda_mpi_parallel_tpu.telemetry import shardscope as ss
+from cuda_mpi_parallel_tpu.utils import compat
+
+needs_mesh = pytest.mark.skipif(
+    not compat.has_shard_map() or len(jax.devices()) < 4,
+    reason="needs shard_map and >= 4 (virtual) devices")
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "skewed_spd_240.mtx")
+
+
+def block_tridiag_csr(n=16, n_shards=4, dtype=np.float64):
+    """SPD matrix coupling each row to its neighbors +-1 (a 1D
+    Laplacian band): with ``n_local = n / P`` each shard couples to
+    its chain neighbors through EXACTLY ONE entry per side - the
+    hand-computable minimal halo."""
+    rows, cols, vals = [], [], []
+    for i in range(n):
+        rows.append(i)
+        cols.append(i)
+        vals.append(4.0)
+        for j in (i - 1, i + 1):
+            if 0 <= j < n:
+                rows.append(i)
+                cols.append(j)
+                vals.append(-1.0)
+    return CSRMatrix.from_coo(np.array(rows), np.array(cols),
+                              np.array(vals, dtype=dtype), n,
+                              dtype=dtype)
+
+
+class TestValidatePermutation:
+    def test_bounds_checked_with_n_shards(self):
+        validate_permutation([(0, 1), (1, 0)], n_shards=2)
+        with pytest.raises(ValueError, match="outside"):
+            validate_permutation([(0, 2)], n_shards=2)
+        with pytest.raises(ValueError, match="outside"):
+            validate_permutation([(-1, 0)], n_shards=2)
+        # without the bound the legacy duplicate checks still apply
+        with pytest.raises(ValueError, match="source twice"):
+            validate_permutation([(0, 1), (0, 2)])
+
+    def test_rotation_perm_is_validated_rotation(self):
+        perm = rotation_perm(4, 1)
+        assert perm == [(0, 1), (1, 2), (2, 3), (3, 0)]
+        assert rotation_perm(4, 3) == [(0, 3), (1, 0), (2, 1), (3, 2)]
+        with pytest.raises(ValueError, match="shift"):
+            rotation_perm(4, 0)   # self-send carries no halo
+        with pytest.raises(ValueError, match="shift"):
+            rotation_perm(4, 4)
+
+
+class TestGatherSchedule:
+    def test_hand_computed_band_schedule(self):
+        """16-row tridiagonal band over 4 shards: shard s needs exactly
+        one entry from each chain neighbor - round shift=1 ships index
+        0 of every block, shift=3 ships index n_local-1, shift=2 is
+        empty and must be DROPPED from the wire."""
+        a = block_tridiag_csr(16, 4)
+        parts = part.partition_csr(a, 4)
+        sched, cols = ex.build_gather_schedule(
+            parts.data, parts.cols, parts.n_local, 4)
+        assert sched.n_local == 4
+        assert [r.shift for r in sched.rounds] == [1, 3]
+        by_shift = {r.shift: r for r in sched.rounds}
+        # shift=1: j sends to j+1, which needs j's LAST row (global
+        # boundary j*4+3 -> local offset 3); shard 3's send is unused
+        # padding (its receiver is shard 0 via wraparound - no coupling)
+        r1 = by_shift[1]
+        assert r1.m == 1
+        assert [int(c) for c in r1.counts] == [1, 1, 1, 0]
+        assert [int(v) for v in r1.send_idx[:3, 0]] == [3, 3, 3]
+        # shift=3: j sends to j-1, which needs j's FIRST row (offset 0)
+        r3 = by_shift[3]
+        assert r3.m == 1
+        assert [int(c) for c in r3.counts] == [0, 1, 1, 1]
+        assert [int(v) for v in r3.send_idx[1:, 0]] == [0, 0, 0]
+        # 6 real coupled pairs, 8 shipped slots -> 25% padding
+        assert sched.coupled_entries == 6
+        assert sched.halo_width == 2
+        assert sched.padding_fraction() == pytest.approx(1 - 6 / 8)
+
+    def test_remapped_matvec_reconstructs_exactly(self):
+        """Host-side reconstruction of the extended-x layout: for every
+        shard, gathering x_ext[new_cols] must equal x_full[old_cols]
+        entry for entry - the bit-identity argument."""
+        a = mmio.load_matrix_market(FIXTURE)
+        n_shards = 4
+        parts_ag = part.partition_csr(a, n_shards)
+        parts_g = part.partition_csr(a, n_shards, exchange="gather")
+        sched = parts_g.halo
+        rng = np.random.default_rng(7)
+        x_pad = rng.standard_normal(parts_ag.n_global_padded)
+        n_local = parts_ag.n_local
+        blocks = x_pad.reshape(n_shards, n_local)
+        for s in range(n_shards):
+            x_ext = [blocks[s]]
+            for r in sched.rounds:
+                recv_from = (s - r.shift) % n_shards
+                x_ext.append(blocks[recv_from][r.send_idx[recv_from]])
+            x_ext = np.concatenate(x_ext)
+            live = parts_ag.data[s] != 0
+            np.testing.assert_array_equal(
+                x_ext[parts_g.cols[s]][live],
+                x_pad[parts_ag.cols[s]][live])
+
+    def test_dead_slots_stay_in_range(self):
+        a = mmio.load_matrix_market(FIXTURE)
+        parts = part.partition_csr(a, 4, exchange="gather")
+        width = parts.n_local + parts.halo.halo_width
+        assert int(parts.cols.max()) < width
+        assert int(parts.cols.min()) >= 0
+        dead = parts.data == 0
+        assert np.all(parts.cols[dead] == 0)
+
+    def test_wire_matches_coupling_report(self):
+        """The built schedule's padded wire equals what the planner
+        predicts from the coupling report alone
+        (shardscope.gather_wire_bytes) - one number, two derivations."""
+        a = mmio.load_matrix_market(FIXTURE)
+        itemsize = np.asarray(a.data).dtype.itemsize
+        for n_shards in (2, 3, 4):
+            parts = part.partition_csr(a, n_shards, exchange="gather")
+            rep = ss.report_for_ranges(
+                a, even_ranges(a.shape[0], n_shards), itemsize=itemsize)
+            assert parts.halo.wire_bytes_per_matvec(itemsize) \
+                == ss.gather_wire_bytes(rep) \
+                == wire_bytes_for(rep, "gather", itemsize)
+
+    def test_auto_rule(self):
+        """auto keeps gather on sparse coupling, declines on dense."""
+        a = mmio.load_matrix_market(FIXTURE)
+        sparse_parts = part.partition_csr(a, 4, exchange="auto")
+        assert sparse_parts.halo is not None  # 580 < 0.9 * 720
+        # a fully coupled 8x8 system: every shard reads every block
+        rows, cols = np.divmod(np.arange(64), 8)
+        vals = np.where(rows == cols, 8.0, -0.1)
+        dense = CSRMatrix.from_coo(rows, cols, vals, 8)
+        dense_parts = part.partition_csr(dense, 4, exchange="auto")
+        assert dense_parts.halo is None     # falls back to allgather
+        # byte-identical to the never-asked layout
+        legacy = part.partition_csr(dense, 4)
+        np.testing.assert_array_equal(dense_parts.cols, legacy.cols)
+
+    def test_partitioner_exchange_validation(self):
+        a = block_tridiag_csr(16, 4)
+        with pytest.raises(ValueError, match="exchange"):
+            part.partition_csr(a, 4, exchange="telepathy")
+        with pytest.raises(ValueError, match="partition_csr"):
+            part.ring_partition_csr(a, 4, exchange="gather")
+        # auto resolves to the ring's native lane
+        ring = part.ring_partition_csr(a, 4, exchange="auto")
+        assert ring.n_shards == 4
+
+
+class TestPlannerExchangeLane:
+    def test_gather_lane_scored_full_weight(self):
+        """score_report charges the gather lane the FULL padded coupled
+        wire and the allgather lane the full fixed payload - no 0.25
+        anywhere (the acceptance: the down-weight constant is gone)."""
+        a = mmio.load_matrix_market(FIXTURE)
+        itemsize = np.asarray(a.data).dtype.itemsize
+        rep = ss.report_for_ranges(a, even_ranges(240, 4),
+                                   itemsize=itemsize)
+        model = reference_model()
+        slot_term = (float(rep.slots.max()) * (itemsize + 4)
+                     * model.gather_slowdown / model.mem_bytes_per_s)
+        ag = score_report(rep, itemsize=itemsize, exchange="allgather")
+        g = score_report(rep, itemsize=itemsize, exchange="gather")
+        assert ag == pytest.approx(
+            slot_term + 3 * rep.n_local * itemsize
+            / model.net_bytes_per_s)
+        assert g == pytest.approx(
+            slot_term + ss.gather_wire_bytes(rep)
+            / model.net_bytes_per_s)
+        # the constant itself is gone from the module source
+        import inspect
+
+        import cuda_mpi_parallel_tpu.balance.plan as plan_mod
+
+        source = inspect.getsource(plan_mod)
+        assert "0.25" not in source, \
+            "the coupling down-weight constant must stay deleted"
+
+    def test_exchange_joins_search_and_fingerprint(self):
+        a = mmio.load_matrix_market(FIXTURE)
+        auto = plan_partition(a, 4)
+        assert auto.exchange == "gather"   # sparse coupling: gather wins
+        pinned = plan_partition(a, 4, exchange="allgather")
+        assert pinned.exchange == "allgather"
+        # same layout, different lane -> different fingerprint (the
+        # solver-cache key component); allgather hashes as pre-exchange
+        same_layout = PartitionPlan.from_json(
+            dict(auto.to_json(), exchange="allgather"))
+        assert same_layout.fingerprint() != auto.fingerprint()
+        with pytest.raises(ValueError, match="exchange"):
+            plan_partition(a, 4, exchange="warp")
+
+    def test_plan_hint_recognizes_every_pin(self):
+        """The lane the planner scores must be the lane the solve runs
+        - including exchange='ring', which solve_distributed rewrites
+        into csr_comm but the CLI's plan resolution consults directly."""
+        from cuda_mpi_parallel_tpu.parallel.dist_cg import (
+            _plan_exchange_hint,
+        )
+
+        assert _plan_exchange_hint("allgather", "ring") == "ring"
+        assert _plan_exchange_hint("ring", None) == "ring"
+        assert _plan_exchange_hint("ring-shiftell", "auto") == "ring"
+        assert _plan_exchange_hint("allgather", "gather") == "gather"
+        assert _plan_exchange_hint("allgather", "allgather") \
+            == "allgather"
+        assert _plan_exchange_hint("allgather", None) == "auto"
+        assert _plan_exchange_hint("allgather", "auto") == "auto"
+        a = mmio.load_matrix_market(FIXTURE)
+        ring_plan = plan_partition(a, 4, exchange="ring")
+        assert ring_plan.exchange == "ring"
+
+    def test_wire_bytes_for_shares_dense_definition(self):
+        """One definition of the dense wire: the planner's fixed-lane
+        pricing and the auto rule's threshold must come from the same
+        function (parallel.exchange.allgather_wire_bytes)."""
+        a = mmio.load_matrix_market(FIXTURE)
+        itemsize = np.asarray(a.data).dtype.itemsize
+        rep = ss.report_for_ranges(a, even_ranges(240, 4),
+                                   itemsize=itemsize)
+        for lane in ("allgather", "ring"):
+            assert wire_bytes_for(rep, lane, itemsize) \
+                == ex.allgather_wire_bytes(rep.n_shards, rep.n_local,
+                                           itemsize)
+
+    def test_plan_json_roundtrip_carries_exchange(self, tmp_path):
+        a = mmio.load_matrix_market(FIXTURE)
+        plan = plan_partition(a, 4)
+        path = tmp_path / "plan.json"
+        plan.save(str(path))
+        back = PartitionPlan.load(str(path))
+        assert back.exchange == plan.exchange == "gather"
+        assert back.fingerprint() == plan.fingerprint()
+        assert back.label == plan.label
+        # a pre-exchange plan file (no field) loads as allgather
+        legacy = json.loads(path.read_text())
+        legacy.pop("exchange")
+        old = PartitionPlan.from_json(legacy)
+        assert old.exchange == "allgather"
+
+
+@needs_mesh
+class TestGatherSolve:
+    def setup_method(self):
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+
+        dist_cg.clear_solver_cache()
+
+    def _fixture(self):
+        return mmio.load_matrix_market(FIXTURE)
+
+    def test_mesh4_bitmatch_and_wire_acceptance(self):
+        """The ISSUE acceptance: on the skewed fixture at mesh 4 the
+        gather exchange moves STRICTLY fewer wire bytes per iteration
+        than allgather (measured via comm_cost events), and the
+        solution bit-matches the allgather solve (same entries, same
+        order) and matches the single-device solve."""
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            make_mesh,
+            solve_distributed,
+        )
+
+        a = self._fixture()
+        rng = np.random.default_rng(3)
+        b = rng.standard_normal(240)
+        mesh = make_mesh(4)
+        ref = solve(a, jnp.asarray(b), tol=1e-10, maxiter=2000)
+
+        wire = {}
+        res = {}
+        events_by_mode = {}
+        try:
+            telemetry.force_active(True)
+            for mode in ("allgather", "gather"):
+                dist_cg.reset_last_comm_cost()
+                with events.capture() as buf:
+                    res[mode] = solve_distributed(
+                        a, b, mesh=mesh, tol=1e-10, maxiter=2000,
+                        exchange=mode)
+                cost, ctx = dist_cg.last_comm_cost()
+                wire[mode] = cost.per_iteration.wire_bytes
+                lines = [json.loads(ln) for ln
+                         in buf.getvalue().strip().splitlines()]
+                for ev in lines:
+                    events.validate_event(ev)
+                events_by_mode[mode] = lines
+        finally:
+            telemetry.force_active(False)
+            ss.reset_last_shard_report()
+
+        assert bool(res["gather"].converged)
+        # bit-match: identical floats, not just allclose
+        np.testing.assert_array_equal(np.asarray(res["gather"].x),
+                                      np.asarray(res["allgather"].x))
+        np.testing.assert_allclose(np.asarray(res["gather"].x),
+                                   np.asarray(ref.x), atol=1e-7)
+        # strictly fewer wire bytes, visible in the emitted events too
+        assert wire["gather"] < wire["allgather"]
+        cost_evs = [e for e in events_by_mode["gather"]
+                    if e["event"] == "comm_cost"]
+        assert cost_evs and cost_evs[0]["wire_bytes_per_iteration"] \
+            == wire["gather"]
+        assert cost_evs[0]["exchange"] == "gather"
+        assert 0.0 <= cost_evs[0]["halo_padding_fraction"] < 1.0
+
+    def test_comm_cost_equals_shardscope_prediction(self):
+        """The emitted wire bytes equal the shardscope-predicted
+        coupled bytes exactly - no more 0.25 disagreement between what
+        the planner counts and what the wire moves."""
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            make_mesh,
+            solve_distributed,
+        )
+
+        a = self._fixture()
+        itemsize = np.asarray(a.data).dtype.itemsize
+        b = np.random.default_rng(0).standard_normal(240)
+        predicted = ss.gather_wire_bytes(
+            ss.report_for_ranges(a, even_ranges(240, 4),
+                                 itemsize=itemsize))
+        try:
+            telemetry.force_active(True)
+            dist_cg.reset_last_comm_cost()
+            solve_distributed(a, b, mesh=make_mesh(4), tol=1e-8,
+                              maxiter=500, exchange="gather")
+            cost, ctx = dist_cg.last_comm_cost()
+        finally:
+            telemetry.force_active(False)
+            ss.reset_last_shard_report()
+        # one matvec per cg iteration: wire/iter IS the matvec wire
+        assert cost.per_iteration.wire_bytes == predicted
+        assert ctx["halo_wire_bytes_per_matvec"] == predicted
+
+    def test_planned_gather_solve_matches_reference(self):
+        """plan='auto' now returns a gather-lane plan on the fixture;
+        the planned+permuted+gather solve must still come back in the
+        caller's row ordering."""
+        from cuda_mpi_parallel_tpu.parallel import (
+            make_mesh,
+            solve_distributed,
+        )
+
+        a = self._fixture()
+        rng = np.random.default_rng(5)
+        x_true = rng.standard_normal(240)
+        b = np.asarray(a @ jnp.asarray(x_true))
+        plan = plan_partition(a, 4)
+        assert plan.exchange == "gather"
+        res = solve_distributed(a, b, mesh=make_mesh(4), tol=1e-10,
+                                maxiter=2000, plan=plan)
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x), x_true, atol=1e-6)
+
+    def test_explicit_allgather_overrides_gather_plan(self):
+        """exchange='allgather' forces the legacy wire even under a
+        gather-scored plan (the zero-perturbation escape hatch)."""
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            make_mesh,
+            solve_distributed,
+        )
+
+        a = self._fixture()
+        b = np.random.default_rng(1).standard_normal(240)
+        plan = plan_partition(a, 4)
+        assert plan.exchange == "gather"
+        try:
+            telemetry.force_active(True)
+            dist_cg.reset_last_comm_cost()
+            solve_distributed(a, b, mesh=make_mesh(4), tol=1e-8,
+                              maxiter=500, plan=plan,
+                              exchange="allgather")
+            _, ctx = dist_cg.last_comm_cost()
+        finally:
+            telemetry.force_active(False)
+            ss.reset_last_shard_report()
+        assert ctx["exchange"] == "allgather"
+        assert ctx["kind"] == "csr"
+
+    def test_exchange_rejections(self):
+        from cuda_mpi_parallel_tpu.parallel import (
+            make_mesh,
+            solve_distributed,
+        )
+
+        mesh = make_mesh(4)
+        a = self._fixture()
+        with pytest.raises(ValueError, match="unknown exchange"):
+            solve_distributed(a, np.ones(240), mesh=mesh,
+                              exchange="smoke-signals")
+        with pytest.raises(ValueError, match="conflicts"):
+            solve_distributed(a, np.ones(240), mesh=mesh,
+                              csr_comm="ring", exchange="gather")
+        with pytest.raises(ValueError, match="conflicts"):
+            solve_distributed(a, np.ones(240), mesh=mesh,
+                              csr_comm="ring-shiftell", exchange="ring")
+        stencil = poisson.poisson_2d_operator(16, 16)
+        with pytest.raises(ValueError, match="exchange"):
+            solve_distributed(stencil, np.ones(256), mesh=mesh,
+                              exchange="gather")
+
+    def test_ring_lane_plans_for_ring_wire(self):
+        """csr_comm='ring' + plan='auto' pins the planner to the ring
+        wire (the lane the solve actually runs); an EXPLICIT
+        gather-scored plan on a ring schedule is rejected - the ring
+        would silently drop the wire the plan was priced for, and the
+        record must never claim a wire the solve did not move."""
+        from cuda_mpi_parallel_tpu.parallel import (
+            make_mesh,
+            solve_distributed,
+        )
+
+        a = self._fixture()
+        rng = np.random.default_rng(5)
+        x_true = rng.standard_normal(240)
+        b = np.asarray(a @ jnp.asarray(x_true))
+        res = solve_distributed(a, b, mesh=make_mesh(4), tol=1e-10,
+                                maxiter=2000, csr_comm="ring",
+                                plan="auto")
+        assert bool(res.converged)
+        np.testing.assert_allclose(np.asarray(res.x), x_true, atol=1e-6)
+        gather_plan = plan_partition(a, 4, exchange="gather")
+        with pytest.raises(ValueError, match="ring"):
+            solve_distributed(a, b, mesh=make_mesh(4),
+                              csr_comm="ring", plan=gather_plan)
+
+    def test_gather_report_rides_partition(self):
+        """The measured shard report of a gather partition is the
+        csr-gather accounting: uniform padded wire per shard, rotation
+        peers resolved."""
+        from cuda_mpi_parallel_tpu.parallel import (
+            make_mesh,
+            solve_distributed,
+        )
+
+        a = self._fixture()
+        itemsize = np.asarray(a.data).dtype.itemsize
+        b = np.random.default_rng(2).standard_normal(240)
+        try:
+            telemetry.force_active(True)
+            ss.reset_last_shard_report()
+            solve_distributed(a, b, mesh=make_mesh(4), tol=1e-8,
+                              maxiter=500, exchange="gather")
+            rep = ss.last_shard_report()
+        finally:
+            telemetry.force_active(False)
+            ss.reset_last_shard_report()
+        assert rep is not None and rep.kind == "csr-gather"
+        predicted = ss.gather_wire_bytes(
+            ss.report_for_ranges(a, even_ranges(240, 4),
+                                 itemsize=itemsize))
+        assert int(rep.halo_send_bytes[0]) == predicted
+        assert all(int(v) == predicted for v in rep.halo_send_bytes)
+        # every shard's neighbor list names its rotation peers
+        for k, ns in enumerate(rep.neighbors):
+            assert all(0 <= peer < 4 and peer != k for peer, _ in ns)
+
+
+@needs_mesh
+class TestZeroPerturbation:
+    """exchange='allgather' (what auto falls back to, and the explicit
+    escape hatch) must leave the solve jaxpr bit-identical to pre-PR
+    behavior - partition arrays byte-identical, traced solve body
+    unchanged."""
+
+    def test_partition_allgather_byte_identical(self):
+        a = mmio.load_matrix_market(FIXTURE)
+        legacy = part.partition_csr(a, 4)
+        explicit = part.partition_csr(a, 4, exchange="allgather")
+        assert explicit.halo is None
+        for lhs, rhs in zip(legacy[:3], explicit[:3]):
+            np.testing.assert_array_equal(lhs, rhs)
+        assert legacy[3:] == explicit[3:]
+
+    def test_solve_jaxpr_bit_identical(self):
+        from cuda_mpi_parallel_tpu.parallel import dist_cg, make_mesh
+
+        a = mmio.load_matrix_market(FIXTURE)
+        b = np.random.default_rng(0).standard_normal(240)
+        mesh = make_mesh(4)
+
+        def traced_jaxpr(**kw):
+            dist_cg.clear_solver_cache()
+            captured = {}
+            orig = dist_cg._cached_solver
+
+            def wrapper(key, build, cost_ctx=None, cost_args=None):
+                # every CSR dispatch passes its example args: trace the
+                # exact solve body the cache would compile
+                captured["jaxpr"] = jax.make_jaxpr(build())(*cost_args)
+                return orig(key, build, cost_ctx, cost_args)
+
+            dist_cg._cached_solver = wrapper
+            try:
+                dist_cg.solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                          maxiter=500, **kw)
+            finally:
+                ss.reset_last_shard_report()
+                dist_cg._cached_solver = orig
+                dist_cg.clear_solver_cache()
+            return str(captured["jaxpr"])
+
+        legacy = traced_jaxpr()
+        explicit = traced_jaxpr(exchange="allgather")
+        assert legacy == explicit
+        # the gather lane genuinely changes the program
+        gather = traced_jaxpr(exchange="gather")
+        assert gather != legacy
+
+    def test_auto_decline_is_legacy_path(self):
+        """A dense-coupling system under exchange='auto' runs the
+        identical allgather partition (halo None, cols untouched)."""
+        rows, cols = np.divmod(np.arange(64), 8)
+        vals = np.where(rows == cols, 8.0, -0.1)
+        dense = CSRMatrix.from_coo(rows, cols, vals, 8)
+        auto = part.partition_csr(dense, 4, exchange="auto")
+        legacy = part.partition_csr(dense, 4)
+        assert auto.halo is None
+        np.testing.assert_array_equal(auto.cols, legacy.cols)
+
+
+@needs_mesh
+class TestExchangeCLI:
+    def _clean(self):
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        dist_cg.clear_solver_cache()
+        ss.reset_last_shard_report()
+
+    def test_mesh4_exchange_gather_record(self, capsys):
+        from cuda_mpi_parallel_tpu import cli
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+
+        dist_cg.clear_solver_cache()
+        try:
+            # --metrics forces telemetry active, so the jaxpr cost walk
+            # (and with it the comm record) runs
+            rc = cli.main(["--problem", "mm", "--file", FIXTURE,
+                           "--mesh", "4", "--device", "cpu",
+                           "--tol", "1e-8", "--maxiter", "500",
+                           "--exchange", "gather", "--metrics",
+                           "--json"])
+        finally:
+            self._clean()
+        assert rc == 0
+        rec = json.loads(capsys.readouterr().out)
+        assert rec["comm"]["exchange"] == "gather"
+        assert rec["comm"]["kind"] == "csr-gather"
+        assert 0.0 <= rec["comm"]["halo_padding_fraction"] < 1.0
+        wire_pi = rec["comm"]["per_iteration"]["wire_bytes"]
+        a = mmio.load_matrix_market(FIXTURE)
+        itemsize = np.asarray(a.data).dtype.itemsize
+        assert wire_pi == ss.gather_wire_bytes(
+            ss.report_for_ranges(a, even_ranges(240, 4),
+                                 itemsize=itemsize))
+
+    def test_gather_plan_file_ring_refusal(self, tmp_path):
+        """A saved gather-scored plan must be refused cleanly (the
+        --plan SystemExit, not a traceback) for BOTH spellings of the
+        ring schedule."""
+        from cuda_mpi_parallel_tpu import cli
+
+        a = mmio.load_matrix_market(FIXTURE)
+        path = tmp_path / "gather_plan.json"
+        plan_partition(a, 4, exchange="gather").save(str(path))
+        for ring_flags in (["--csr-comm", "ring"],
+                           ["--exchange", "ring"]):
+            with pytest.raises(SystemExit, match="ring"):
+                cli.main(["--problem", "mm", "--file", FIXTURE,
+                          "--mesh", "4", "--device", "cpu",
+                          "--plan", str(path)] + ring_flags)
+
+    def test_refusals(self):
+        from cuda_mpi_parallel_tpu import cli
+
+        with pytest.raises(SystemExit, match="mesh"):
+            cli.main(["--problem", "mm", "--file", FIXTURE,
+                      "--exchange", "gather"])
+        with pytest.raises(SystemExit, match="assembled-CSR"):
+            cli.main(["--problem", "poisson2d", "--n", "8",
+                      "--matrix-free", "--mesh", "4", "--device", "cpu",
+                      "--exchange", "gather"])
+        with pytest.raises(SystemExit, match="conflicts"):
+            cli.main(["--problem", "mm", "--file", FIXTURE,
+                      "--mesh", "4", "--device", "cpu",
+                      "--csr-comm", "ring", "--exchange", "gather"])
+        with pytest.raises(SystemExit, match="df64"):
+            cli.main(["--problem", "mm", "--file", FIXTURE,
+                      "--mesh", "4", "--device", "cpu",
+                      "--dtype", "df64", "--exchange", "gather"])

@@ -86,6 +86,19 @@ METRIC_DIRECTION = {
     "replan.drift_pct": None,
     "replan.gather_slowdown": None,
     "drift_pct": None,
+    # gather-exchange columns (PR 7, parallel.exchange): the measured
+    # per-iteration interconnect bytes of each halo wire and the
+    # gather schedule's pad-to-max-neighbor fraction.  Reported, never
+    # gated - wire bytes track the bench problem's coupling structure
+    # and mesh size, not the code; pre-PR-7 files simply lack them
+    # (rendered n/a).
+    "comm.wire_bytes_per_iter": None,
+    "halo.padding_fraction": None,
+    "exchange.allgather_wire_bytes_per_iter": None,
+    "exchange.gather_wire_bytes_per_iter": None,
+    "exchange.allgather_iters_per_sec": None,
+    "exchange.gather_iters_per_sec": None,
+    "exchange.padding_fraction": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -121,6 +134,12 @@ _NESTED = {
     "planner": ("nnz_imbalance_even", "nnz_imbalance_planned",
                 "plan_time_s"),
     "replan": ("predicted_gain_pct", "drift_pct", "gather_slowdown"),
+    "comm": ("wire_bytes_per_iter",),
+    "halo": ("padding_fraction",),
+    "exchange": ("allgather_wire_bytes_per_iter",
+                 "gather_wire_bytes_per_iter",
+                 "allgather_iters_per_sec", "gather_iters_per_sec",
+                 "padding_fraction"),
 }
 
 

@@ -146,6 +146,67 @@ grep -qi "calibration" "$scratch/replan_report.txt"
 grep -qi "drift" "$scratch/replan_report.txt"
 echo "calibra gate: clean"
 
+# Gather-exchange gate: the sparse gather halo wire must actually beat
+# the allgather payload where it claims to - the committed skewed
+# fixture at mesh 4.  Two CLI solves of the IDENTICAL system (same
+# seed, same rhs): the legacy allgather wire, then --exchange gather.
+# Every event line of both traces is schema-validated; the comm_cost
+# events' jaxpr-derived wire bytes must be STRICTLY lower on the
+# gather run; and the solutions must match - the gather matvec sums
+# the same entries in the same order, so iterations and the final
+# residual are bit-identical (the jaxpr-level proof lives in
+# tests/test_exchange.py::TestZeroPerturbation).
+echo "== gather-exchange gate (mesh-4 CLI: --exchange gather) =="
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --device cpu --tol 1e-8 --maxiter 500 --json \
+    --trace-events "$scratch/ex_allgather.jsonl" \
+    > "$scratch/ex_allgather.json"
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --device cpu --tol 1e-8 --maxiter 500 --json \
+    --exchange gather \
+    --trace-events "$scratch/ex_gather.jsonl" \
+    > "$scratch/ex_gather.json"
+python tools/validate_trace.py "$scratch/ex_allgather.jsonl"
+python tools/validate_trace.py "$scratch/ex_gather.jsonl"
+python - "$scratch" <<'PY'
+import json
+import sys
+
+scratch = sys.argv[1]
+
+
+def record(name):
+    with open(f"{scratch}/{name}.json") as f:
+        return json.load(f)
+
+
+def wire(name):
+    events = [json.loads(ln) for ln in open(f"{scratch}/{name}.jsonl")
+              if ln.strip()]
+    costs = [e for e in events if e["event"] == "comm_cost"]
+    assert costs, f"{name}: no comm_cost event"
+    return max(e["wire_bytes_per_iteration"] for e in costs)
+
+
+allgather, gather = wire("ex_allgather"), wire("ex_gather")
+assert gather < allgather, \
+    f"gather wire {gather} B/iter is not below allgather {allgather}"
+ra, rg = record("ex_allgather"), record("ex_gather")
+assert ra["converged"] and rg["converged"], (ra, rg)
+assert ra["iterations"] == rg["iterations"], \
+    f"iteration counts differ: {ra['iterations']} vs {rg['iterations']}"
+assert abs(ra["residual_norm"] - rg["residual_norm"]) \
+    <= 1e-12 * max(abs(ra["residual_norm"]), 1e-300), \
+    f"residuals differ: {ra['residual_norm']} vs {rg['residual_norm']}"
+assert rg["comm"]["exchange"] == "gather", rg["comm"]
+print(f"gather-exchange gate: wire {allgather} -> {gather} B/iter "
+      f"({100.0 * (1 - gather / allgather):.1f}% less), solutions "
+      f"match at {ra['iterations']} iters")
+PY
+echo "gather-exchange gate: clean"
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -97,6 +97,14 @@ def build_report(evs) -> treport.SolveReport:
             "note": "iteration-phase collectives only - one-time "
                     "setup ops are not in the event stream",
         }
+        # wire semantics + exchange lane (PR 7) - n/a-safe on pre-PR-7
+        # trace files, which simply lack these fields
+        if cc.get("wire_bytes_per_iteration") is not None:
+            comm["wire_bytes"] = cc["wire_bytes_per_iteration"] * its
+        if cc.get("exchange") is not None:
+            comm["exchange"] = cc["exchange"]
+        if cc.get("halo_padding_fraction") is not None:
+            comm["halo_padding_fraction"] = cc["halo_padding_fraction"]
     health = _last(evs, "solve_health")
     if health is not None:
         # drop the event envelope so the offline report's health JSON
@@ -117,8 +125,10 @@ def build_report(evs) -> treport.SolveReport:
                 k: drift_ev.get(k)
                 for k in ("drift_pct", "predicted_s_per_iteration",
                           "measured_s_per_iteration", "model")}
-            calibration["drift"]["plan"] = \
+            lane = drift_ev.get("exchange")
+            calibration["drift"]["plan"] = (
                 f"{drift_ev.get('reorder')}+{drift_ev.get('split')}"
+                + (f"+{lane}" if lane and lane != "allgather" else ""))
         if replans:
             calibration["decisions"] = [
                 {k: ev.get(k) for k in ("solve_index", "decision",
