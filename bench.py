@@ -575,6 +575,66 @@ def _exchange_entry(entry, n_shards, key="exchange"):
     return entry
 
 
+def _many_rhs_wire_entry(entry, n_shards, key="many_wire"):
+    """Per-solve halo-wire columns of a batched mesh solve
+    (parallel.solve_distributed_many on the committed skewed fixture):
+    a k=8 block-CG solve's whole-solve interconnect bytes against 8x a
+    single-RHS solve's - the per-solve wire amortization the batched
+    tier exists for.  Same never-sink-the-run contract as
+    ``_efficiency_entry``."""
+    try:
+        import numpy as _np
+
+        from cuda_mpi_parallel_tpu import telemetry
+        from cuda_mpi_parallel_tpu.models import mmio
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            make_mesh,
+            solve_distributed,
+            solve_distributed_many,
+        )
+        from cuda_mpi_parallel_tpu.utils.logging import sanitize
+
+        a = mmio.load_matrix_market("tests/fixtures/skewed_spd_240.mtx")
+        rng = _np.random.default_rng(13)
+        import jax.numpy as _jnp
+
+        x_true = rng.standard_normal((240, 8))
+        b = _np.asarray(a.matmat(_jnp.asarray(x_true)))
+        mesh = make_mesh(n_shards)
+        telemetry.force_active(True)
+        try:
+            dist_cg.reset_last_comm_cost()
+            res = solve_distributed_many(a, b, mesh=mesh, tol=1e-8,
+                                         maxiter=500, method="block",
+                                         exchange="gather")
+            sc_many, _ = dist_cg.last_comm_cost()
+            dist_cg.reset_last_comm_cost()
+            one = solve_distributed(a, b[:, 0], mesh=mesh, tol=1e-8,
+                                    maxiter=500, exchange="gather")
+            sc_one, _ = dist_cg.last_comm_cost()
+        finally:
+            telemetry.force_active(False)
+        wire_many = sc_many.totals(
+            int(_np.asarray(res.iterations).max())).wire_bytes
+        wire_seq = 8 * sc_one.totals(int(one.iterations)).wire_bytes
+        entry[key] = sanitize({
+            "n_shards": n_shards,
+            "n_rhs": 8,
+            "wire_bytes_per_solve_batched": int(wire_many),
+            "wire_bytes_per_solve_sequential8": int(wire_seq),
+            "wire_amortization_x": round(wire_seq / max(wire_many, 1),
+                                         3),
+            "block_iterations": int(_np.asarray(res.iterations).max()),
+            "single_iterations": int(one.iterations),
+            "note": "k=8 block-CG vs 8 single-RHS gather solves on "
+                    "the committed skewed 240-row fixture",
+        })
+    except Exception as e:  # pragma: no cover - defensive
+        entry[key] = {"error": str(e)[-200:]}
+    return entry
+
+
 def _convergence_entry(res) -> dict:
     """``iterations``/``converged`` (+ flight summary when recorded) -
     the per-section convergence record bench_compare gates on."""
@@ -686,6 +746,7 @@ SECTION_PRIORITY = [
     "headline_variance",
     "dense_spd_1024",
     "distributed",
+    "many_rhs",                            # batched-RHS amortization
     "unstructured",
     "poisson2d_1M_csr",                    # ~92 ms/iter gather: last
 ]
@@ -1460,6 +1521,86 @@ def bench_all(results, sections=None) -> None:
                                                          repeats=2)
 
     registry.append(("unstructured", s_unstructured))
+
+    # 6: many-RHS batching (solver.many, PR 8).  SpMV is memory-bound,
+    # so extra RHS columns riding one matrix sweep are nearly free
+    # FLOPs (arXiv 2204.00900): the row measures aggregate
+    # (RHS x iterations)/s at k = 1/8/32 against a sequential loop of
+    # single-RHS solves on the same columns, block-CG's iteration win
+    # over the masked independent recurrences, and (>= 2 devices) the
+    # per-solve halo wire bytes of a batched mesh solve on the
+    # committed skewed fixture.  Whole-solve walls (the batched loop's
+    # value IS amortizing fixed per-iteration costs, which an
+    # iteration-delta would cancel away).
+    def s_many_rhs():
+        from cuda_mpi_parallel_tpu.solver import solve_many
+
+        grid = 128                     # 16384 unknowns
+        a2 = poisson.poisson_2d_csr(grid, grid, dtype=np.float32)
+        n = int(a2.shape[0])
+        rng = np.random.default_rng(12)
+        tol = 1e-3
+        entry = {"n": n, "tol": tol, "measurement": "solve_wall",
+                 "note": "aggregate lane-iterations per second; "
+                         "sequential baseline re-solves the same "
+                         "columns one at a time"}
+
+        def stack(k):
+            x_true = rng.standard_normal((n, k)).astype(np.float32)
+            return jnp.asarray(np.asarray(
+                a2.matmat(jnp.asarray(x_true))))
+
+        b8 = None
+        for k in (1, 8, 32):
+            bk = stack(k)
+            if k == 8:
+                b8 = bk
+            el, res = time_fn(
+                lambda bk=bk: solve_many(a2, bk, tol=tol, maxiter=600,
+                                         check_every=8),
+                warmup=1, repeats=2)
+            iters = np.asarray(res.iterations)
+            entry[f"rhs_iters_per_sec_k{k}"] = round(
+                float(iters.sum()) / el, 1)
+            entry[f"converged_k{k}"] = bool(
+                np.asarray(res.converged).all())
+            if k == 8:
+                entry["batched_iterations_k8"] = int(iters.max())
+
+        # sequential-loop baseline: the SAME 8 columns, one solve each
+        seq_s = 0.0
+        seq_iters = 0
+        for j in range(8):
+            bj = b8[:, j]
+            el, res = time_fn(
+                lambda bj=bj: solve(a2, bj, tol=tol, maxiter=600,
+                                    check_every=8),
+                warmup=1, repeats=2)
+            seq_s += el
+            seq_iters += int(res.iterations)
+        entry["sequential_rhs_iters_per_sec_k8"] = round(
+            seq_iters / max(seq_s, 1e-30), 1)
+        entry["amortization_x_k8"] = round(
+            entry["rhs_iters_per_sec_k8"]
+            / max(entry["sequential_rhs_iters_per_sec_k8"], 1e-30), 2)
+
+        # block-CG: the coupled Krylov space's iteration win (same
+        # check cadence as the batched rows - the throughput delta
+        # must measure the recurrences, not a mismatched check rate)
+        el, resb = time_fn(
+            lambda: solve_many(a2, b8, tol=tol, maxiter=600,
+                               method="block", check_every=8),
+            warmup=1, repeats=2)
+        entry["block_iterations_k8"] = int(
+            np.asarray(resb.iterations).max())
+        entry["block_rhs_iters_per_sec_k8"] = round(
+            float(np.asarray(resb.iterations).sum()) / el, 1)
+        if len(jax.devices()) >= 2:
+            _many_rhs_wire_entry(entry,
+                                 n_shards=min(len(jax.devices()), 4))
+        results["many_rhs"] = entry
+
+    registry.append(("many_rhs", s_many_rhs))
 
     known = {name for name, _ in registry}
     if sections:

@@ -193,6 +193,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "ride the 'replan' telemetry event and the "
                         "report's calibration section.  Composes with "
                         "--plan (the first solve's layout)")
+    p.add_argument("--rhs", type=int, default=1, metavar="K",
+                   help="solve K right-hand sides as one column-stacked "
+                        "batch (solver.many): one matrix sweep and one "
+                        "halo exchange per iteration serve every "
+                        "column, so the memory-bound SpMV cost "
+                        "amortizes across the batch.  The K systems "
+                        "share the operator; B is built as A @ X_true "
+                        "for a seeded random X_true, so max_abs_error "
+                        "is reported per lane.  Single device or "
+                        "--mesh > 1 (assembled CSR, general engine, "
+                        "--precond none/jacobi); paths that cannot "
+                        "batch (resident/streaming engines, df64, "
+                        "ring schedules, shiftell format, minres/cg1/"
+                        "pipecg, --history, --repeat) refuse rather "
+                        "than silently solving one column")
+    p.add_argument("--rhs-method", default=None,
+                   choices=["batched", "block"], dest="rhs_method",
+                   help="batched: K masked independent CG recurrences "
+                        "in one loop (each lane bit-matches its "
+                        "single-RHS solve at --check-every 1; lanes "
+                        "freeze at their own tolerance); block: true "
+                        "block-CG (O'Leary) - "
+                        "a coupled K-dim Krylov space converges in "
+                        "measurably fewer iterations, with Gram "
+                        "breakdown falling back to the batched "
+                        "recurrence automatically")
     p.add_argument("--history", action="store_true",
                    help="print per-iteration residual trace")
     p.add_argument("--flight-record", nargs="?", const=1, default=None,
@@ -556,6 +582,88 @@ def main(argv=None) -> int:
                 "--precond bjacobi is single-device only (use jacobi "
                 "or chebyshev with --mesh)")
 
+    # Many-RHS batching (--rhs K): the refusal matrix.  Every path that
+    # cannot carry a column stack refuses LOUDLY here - silently
+    # solving column 0 of a K-column request would be a wrong answer
+    # with a green exit code (same never-silently-drop rule as
+    # --history/--flight-record/--replan).
+    if args.rhs < 1:
+        raise SystemExit(f"--rhs must be >= 1, got {args.rhs}")
+    if args.rhs_method is not None and args.rhs <= 1:
+        raise SystemExit(
+            f"--rhs-method {args.rhs_method} needs --rhs K > 1 (it "
+            f"selects the batched recurrence; a single RHS runs the "
+            f"ordinary --method solver)")
+    if args.rhs > 1:
+        args.rhs_method = args.rhs_method or "batched"
+        from .models.operators import CSRMatrix
+
+        if args.df64:
+            raise SystemExit(
+                "--rhs does not support --dtype df64 (the double-float "
+                "solvers carry (hi, lo) pair recurrences with no "
+                "batched tier yet; solve the columns sequentially)")
+        if args.method != "cg":
+            raise SystemExit(
+                f"--rhs batches the textbook CG recurrence only; "
+                f"--method {args.method} has no batched variant. "
+                f"Pick the batched recurrence with --rhs-method "
+                f"batched|block instead")
+        if args.engine in ("resident", "streaming"):
+            raise SystemExit(
+                f"--rhs with --engine {args.engine} is unsupported: "
+                f"the one-kernel engines hold a single x resident per "
+                f"chip (use --engine general/auto)")
+        if args.history:
+            raise SystemExit(
+                "--history with --rhs is unsupported (K dense traces); "
+                "use --flight-record for the per-lane ring-buffer "
+                "trace")
+        if args.repeat > 1:
+            raise SystemExit(
+                "--repeat with --rhs is unsupported (the calibrate-"
+                "and-replan sequence API is single-RHS)")
+        if args.csr_comm != "allgather" or args.exchange == "ring":
+            raise SystemExit(
+                "--rhs needs the allgather/gather halo wires (the "
+                "ring schedules rotate single x-blocks; drop "
+                "--csr-comm ring / --exchange ring)")
+        if args.fmt == "shiftell":
+            raise SystemExit(
+                "--rhs with --format shiftell is unsupported (the "
+                "pallas lane-gather kernel consumes one x plane; use "
+                "--format csr/ell/dia)")
+        if args.flight_record is not None and args.rhs_method == "block":
+            raise SystemExit(
+                "--flight-record with --rhs-method block is "
+                "unsupported (block-CG's recurrence scalars are KxK "
+                "matrices, not per-lane pairs; use --rhs-method "
+                "batched)")
+        if args.flight_heartbeat:
+            raise SystemExit(
+                "--flight-heartbeat with --rhs is unsupported (the "
+                "batched loop carries no in-loop callback; the "
+                "per-lane flight record itself works - drop the "
+                "heartbeat)")
+        if args.mesh > 1:
+            if not isinstance(a, CSRMatrix):
+                raise SystemExit(
+                    "--rhs with --mesh > 1 supports assembled-CSR "
+                    "problems only (stencil slabs batch on a single "
+                    "device; drop --matrix-free or --mesh)")
+            if args.precond not in (None, "jacobi"):
+                raise SystemExit(
+                    f"--rhs with --mesh > 1 supports --precond jacobi "
+                    f"or none (got {args.precond}: its application is "
+                    f"single-vector on a mesh)")
+        elif args.precond == "bjacobi" and args.rhs_method == "block":
+            # bjacobi's dense block solve vmaps fine lane-wise, but
+            # block-CG couples lanes through the Gram solve - keep the
+            # tested surface: batched only
+            raise SystemExit(
+                "--precond bjacobi with --rhs-method block is "
+                "unsupported (use --rhs-method batched)")
+
     # df64 compatibility checks run BEFORE the format conversion below:
     # a doomed combination must fail fast, not after seconds of host-side
     # shift-ELL packing at 1M rows.
@@ -695,7 +803,70 @@ def main(argv=None) -> int:
                              "(--dtype df64 routes through the general "
                              "or resident df64 solvers)")
 
+    def _build_precond():
+        """The single-device preconditioner for the general solvers
+        (shared by the single-RHS general path and the many-RHS
+        batched path - both apply M through the same operator
+        interface)."""
+        from .models.operators import JacobiPreconditioner
+        from .models.precond import (
+            BlockJacobiPreconditioner,
+            ChebyshevPreconditioner,
+        )
+
+        if args.precond == "jacobi":
+            return JacobiPreconditioner.from_operator(a)
+        if args.precond == "chebyshev":
+            return ChebyshevPreconditioner.from_operator(
+                a, degree=args.precond_degree)
+        if args.precond == "bjacobi":
+            return BlockJacobiPreconditioner.from_operator(
+                a, block_size=args.block_size)
+        if args.precond == "mg":
+            from .models.multigrid import MultigridPreconditioner
+            from .models.operators import Stencil2D, Stencil3D
+
+            if not isinstance(a, (Stencil2D, Stencil3D)):
+                raise SystemExit(
+                    "--precond mg needs a stencil operator: use a "
+                    "poisson* problem with --matrix-free")
+            return MultigridPreconditioner.from_operator(a)
+        return None
+
+    # The many-RHS system: K columns sharing the (final, post-rcm/
+    # format) operator.  B = A @ X_true for a seeded X_true, so every
+    # lane has a known solution and the record carries per-lane
+    # max_abs_error (the lint gate's acceptance check).  Errors are
+    # permutation-invariant (max over entries), so --rcm composes.
+    if args.rhs > 1:
+        import jax.numpy as _jnp
+
+        rhs_rng = np.random.default_rng(args.seed + 202406)
+        b_np = np.asarray(b)
+        x_expected = rhs_rng.standard_normal(
+            (int(a.shape[0]), args.rhs)).astype(b_np.dtype)
+        b = np.asarray(a.matmat(_jnp.asarray(x_expected)))
+        desc += f" [rhs: {args.rhs} x {args.rhs_method}]"
+
     def run():
+        if args.rhs > 1:
+            if args.mesh > 1:
+                from .parallel import make_mesh, solve_distributed_many
+
+                return solve_distributed_many(
+                    a, b, mesh=make_mesh(args.mesh), tol=args.tol,
+                    rtol=args.rtol, maxiter=args.maxiter,
+                    preconditioner=args.precond,
+                    method=args.rhs_method,
+                    check_every=args.check_every, flight=flight_cfg,
+                    plan=plan_obj, exchange=args.exchange)
+            from .solver import solve_many
+
+            return solve_many(a, b, tol=args.tol, rtol=args.rtol,
+                              maxiter=args.maxiter, m=_build_precond(),
+                              method=args.rhs_method,
+                              check_every=args.check_every,
+                              flight=flight_cfg)
         if args.df64:
             if args.mesh > 1:
                 from .parallel import make_mesh, solve_distributed_df64
@@ -914,32 +1085,9 @@ def main(argv=None) -> int:
                                     flight=flight_cfg,
                                     interpret=_pallas_interpret())
         from . import solve
-        from .models.operators import JacobiPreconditioner
-        from .models.precond import (
-            BlockJacobiPreconditioner,
-            ChebyshevPreconditioner,
-        )
 
-        m = None
-        if args.precond == "jacobi":
-            m = JacobiPreconditioner.from_operator(a)
-        elif args.precond == "chebyshev":
-            m = ChebyshevPreconditioner.from_operator(
-                a, degree=args.precond_degree)
-        elif args.precond == "bjacobi":
-            m = BlockJacobiPreconditioner.from_operator(
-                a, block_size=args.block_size)
-        elif args.precond == "mg":
-            from .models.multigrid import MultigridPreconditioner
-            from .models.operators import Stencil2D, Stencil3D
-
-            if not isinstance(a, (Stencil2D, Stencil3D)):
-                raise SystemExit(
-                    "--precond mg needs a stencil operator: use a poisson* "
-                    "problem with --matrix-free")
-            m = MultigridPreconditioner.from_operator(a)
         return solve(a, b, tol=args.tol, rtol=args.rtol,
-                     maxiter=args.maxiter, m=m,
+                     maxiter=args.maxiter, m=_build_precond(),
                      record_history=args.history, method=args.method,
                      check_every=args.check_every, flight=flight_cfg)
 
@@ -1018,6 +1166,33 @@ def main(argv=None) -> int:
                 residual_history=result.residual_history,
                 flight=result.flight)
 
+        # Many-RHS solves: keep the CGBatchResult for per-lane
+        # reporting and adapt an aggregate facade (worst lane) so the
+        # scalar reporting surface below - record, events, report -
+        # works unchanged.  iterations = the max lane (the loop ran
+        # that many), status = the worst lane's code.
+        many_result = None
+        if args.rhs > 1:
+            import types as _types
+
+            from .solver.status import CGStatus as _CGS
+
+            many_result = result
+            _iters = np.asarray(result.iterations)
+            _stat = np.asarray(result.status)
+            worst = int(_stat.max())
+            result = _types.SimpleNamespace(
+                x=result.x,
+                iterations=int(_iters.max()),
+                residual_norm=float(
+                    np.asarray(result.residual_norm).max()),
+                converged=bool(np.asarray(result.converged).all()),
+                status=worst,
+                status_enum=lambda w=worst: _CGS(w),
+                indefinite=bool(np.asarray(result.indefinite).any()),
+                residual_history=None,
+                flight=many_result.flight)
+
         # per-solve communication account: jaxpr-derived per-iteration
         # collective counts x the measured iteration count (the volume
         # that governs distributed SpMV scaling - see telemetry.cost)
@@ -1053,7 +1228,27 @@ def main(argv=None) -> int:
         # gauges by obs.finish.
         flight_rec = None
         health = None
-        if flight_cfg is not None:
+        lane_records = None
+        lane_healths = None
+        if flight_cfg is not None and many_result is not None:
+            from .telemetry.flight import lanes_from_buffer
+            from .telemetry.health import assess_lanes
+
+            if many_result.flight is not None:
+                lane_records = lanes_from_buffer(
+                    many_result.flight, args.rhs,
+                    stride=flight_cfg.stride)
+                lane_healths = assess_lanes(
+                    lane_records, converged=many_result.converged,
+                    statuses=many_result.status,
+                    iterations=many_result.iterations)
+                # the aggregate surface (report/--history/perfetto)
+                # follows the slowest lane - the one that governed the
+                # loop's runtime
+                slow = int(np.asarray(many_result.iterations).argmax())
+                flight_rec = lane_records[slow]
+                health = lane_healths[slow]
+        elif flight_cfg is not None:
             from .telemetry.flight import FlightRecord
             from .telemetry.health import assess_solve_health
 
@@ -1096,8 +1291,40 @@ def main(argv=None) -> int:
         device=jax.devices()[0].platform,
         precond=args.precond or "none")
     if x_expected is not None:
-        err = float(np.max(np.abs(x_np - np.asarray(x_expected))))
+        # many-RHS X_true was generated against the FINAL (post-rcm)
+        # operator, so compare the un-scattered solution stack
+        ref_x = np.asarray(result.x) if args.rhs > 1 else x_np
+        err = float(np.max(np.abs(ref_x - np.asarray(x_expected))))
         record["max_abs_error"] = err
+    if many_result is not None:
+        # per-lane story: each column is a solve of its own, and the
+        # record says so (the lint gate asserts per-lane errors)
+        lanes = {
+            "iterations": [int(v) for v in
+                           np.asarray(many_result.iterations)],
+            "residual_norm": [float(v) for v in
+                              np.asarray(many_result.residual_norm)],
+            "converged": [bool(v) for v in
+                          np.asarray(many_result.converged)],
+            "status": [s.name for s in many_result.status_enums()],
+        }
+        if x_expected is not None:
+            diff = np.abs(np.asarray(many_result.x)
+                          - np.asarray(x_expected))
+            lanes["max_abs_error"] = [float(v)
+                                      for v in diff.max(axis=0)]
+        if lane_healths is not None:
+            lanes["health"] = [h.classification.name
+                               for h in lane_healths]
+        record["n_rhs"] = args.rhs
+        record["rhs_method"] = args.rhs_method
+        if many_result.fallback is not None:
+            record["rhs_fallback"] = bool(many_result.fallback)
+        # aggregate useful work: converged lane-iterations per second -
+        # the amortization number the bench row tracks
+        record["rhs_iters_per_sec"] = \
+            float(sum(lanes["iterations"])) / max(elapsed, 1e-30)
+        record["lanes"] = ulog.sanitize(lanes)
     if comm is not None:
         record["comm"] = comm
     if plan_obj is not None:
@@ -1188,11 +1415,13 @@ def main(argv=None) -> int:
         roof = troofline.analyze(
             n=int(a.shape[0]), nnz=troofline.operator_nnz(a),
             itemsize=itemsize, iterations=int(result.iterations),
-            elapsed_s=float(elapsed), method=args.method,
+            elapsed_s=float(elapsed),
+            method=args.rhs_method if args.rhs > 1 else args.method,
             preconditioned=args.precond is not None,
             precond_matvecs=(args.precond_degree - 1
                              if args.precond == "chebyshev" else 0),
-            comm_bytes_per_iteration=comm_bpi)
+            comm_bytes_per_iteration=comm_bpi,
+            n_rhs=args.rhs)
         solve_report = treport.SolveReport(
             record=record, shard=shard_rep, roofline=roof,
             flight_summary=record.get("flight"),
@@ -1230,11 +1459,19 @@ def main(argv=None) -> int:
         print(f"||r||   : {record['residual_norm']:.6e}")
         print(f"time    : {elapsed * 1e3:.3f} ms "
               f"({record['iters_per_sec']:.1f} iters/s)")
+        if many_result is not None:
+            lanes = record["lanes"]
+            print(f"rhs     : {args.rhs} lanes ({args.rhs_method}"
+                  f"{', fell back to batched' if record.get('rhs_fallback') else ''}), "
+                  f"{record['rhs_iters_per_sec']:.1f} aggregate "
+                  f"lane-iters/s")
+            print(f"  lane iters  : {lanes['iterations']}")
+            print(f"  lane status : {lanes['status']}")
         if "max_abs_error" in record:
             print(f"max err : {record['max_abs_error']:.3e}")
         # The reference prints the full solution vector (CUDACG.cu:361-364);
         # keep that behavior for small systems.
-        if a.shape[0] <= 10:
+        if a.shape[0] <= 10 and args.rhs == 1:
             for v in x_np:
                 print(f"{v:f}")
         if comm is not None:

@@ -53,6 +53,15 @@ class LinearOperator:
     def matvec(self, x: jax.Array) -> jax.Array:
         raise NotImplementedError
 
+    def matmat(self, x: jax.Array) -> jax.Array:
+        """Apply to a column stack ``(n, k)`` -> ``(n, k)`` (the
+        many-RHS path, ``solver.many``).  The default vmaps
+        :meth:`matvec` over columns - correct for any pure operator;
+        formats where one batched sweep beats ``k`` gathers (CSR/ELL/
+        DIA/dense, the distributed CSR operators) override it with a
+        true SpMM so the matrix is read ONCE for all columns."""
+        return jax.vmap(self.matvec, in_axes=1, out_axes=1)(x)
+
     def __matmul__(self, x: jax.Array) -> jax.Array:
         return self.matvec(x)
 
@@ -86,6 +95,9 @@ class DenseOperator(LinearOperator):
 
     def matvec(self, x):
         return spmv.dense_matvec(self.a, x)
+
+    def matmat(self, x):
+        return self.a @ x  # one MXU matmul serves every column
 
     def diagonal(self):
         return jnp.diagonal(self.a)
@@ -173,6 +185,10 @@ class CSRMatrix(LinearOperator):
 
     def matvec(self, x):
         return spmv.csr_matvec(self.data, self.indices, self.rows, x,
+                               self.shape[0])
+
+    def matmat(self, x):
+        return spmv.csr_matmat(self.data, self.indices, self.rows, x,
                                self.shape[0])
 
     def diagonal(self):
@@ -325,6 +341,9 @@ class ELLMatrix(LinearOperator):
     def matvec(self, x):
         return spmv.ell_matvec(self.vals, self.cols, x)
 
+    def matmat(self, x):
+        return spmv.ell_matmat(self.vals, self.cols, x)
+
     def diagonal(self):
         row_ids = jnp.arange(self.shape[0], dtype=self.cols.dtype)[:, None]
         return jnp.sum(jnp.where(self.cols == row_ids, self.vals, 0), axis=1)
@@ -386,6 +405,9 @@ class DIAMatrix(LinearOperator):
 
     def matvec(self, x):
         return spmv.dia_matvec(self.bands, self.offsets, x)
+
+    def matmat(self, x):
+        return spmv.dia_matmat(self.bands, self.offsets, x)
 
     def diagonal(self):
         if 0 in self.offsets:
@@ -764,6 +786,9 @@ class JacobiPreconditioner(LinearOperator):
     def matvec(self, x):
         return self.inv_diag * x
 
+    def matmat(self, x):
+        return self.inv_diag[:, None] * x
+
     def diagonal(self):
         return self.inv_diag
 
@@ -789,6 +814,9 @@ class IdentityOperator(LinearOperator):
         return jnp.dtype(self._dtype_name)
 
     def matvec(self, x):
+        return x
+
+    def matmat(self, x):
         return x
 
     def diagonal(self):

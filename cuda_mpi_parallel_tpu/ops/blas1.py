@@ -38,6 +38,38 @@ def dot(x: jax.Array, y: jax.Array, *, axis_name: Optional[str] = None) -> jax.A
     return local
 
 
+def dot_many(x: jax.Array, y: jax.Array, *,
+             axis_name: Optional[str] = None) -> jax.Array:
+    """Per-column inner products of two ``(n, k)`` stacks -> ``(k,)``.
+
+    The many-RHS sibling of :func:`dot`: column ``j`` of the result is
+    bit-identical to ``dot(x[:, j], y[:, j])`` (the einsum contraction
+    reduces each column in the same order as ``jnp.vdot`` - asserted by
+    tests), which is what lets the masked batched CG reproduce the
+    single-RHS solver's iterates exactly at ``k = 1``.  Distributed,
+    all ``k`` reductions ride ONE ``psum`` - the per-iteration
+    collective count of a batched solve equals the single-RHS solve's.
+    """
+    local = jnp.einsum("nk,nk->k", x, y)
+    if axis_name is not None:
+        local = lax.psum(local, axis_name)
+    return local
+
+
+def gram(x: jax.Array, y: jax.Array, *,
+         axis_name: Optional[str] = None) -> jax.Array:
+    """``x^T y`` of two ``(n, k)`` stacks -> ``(k, k)``.
+
+    The block-CG building block: one MXU-friendly small dense matmul
+    per iteration instead of ``k^2`` vector dots, psum-ed as ONE
+    ``k x k`` collective on a mesh.
+    """
+    local = x.T @ y
+    if axis_name is not None:
+        local = lax.psum(local, axis_name)
+    return local
+
+
 def norm2_sq(x: jax.Array, *, axis_name: Optional[str] = None) -> jax.Array:
     """Squared 2-norm ||x||^2 (what the CG recurrence actually consumes).
 
@@ -137,12 +169,13 @@ def _sum_df(v: jax.Array):
     """
     hi = v
     lo = jnp.zeros_like(v)
+    pad = [(0, 1)] + [(0, 0)] * (v.ndim - 1)  # fold axis 0; (n, k) rides
     while hi.shape[0] > 1:
         m = hi.shape[0]
         h = (m + 1) // 2
         if m % 2:
-            hi = jnp.pad(hi, [(0, 1)])
-            lo = jnp.pad(lo, [(0, 1)])
+            hi = jnp.pad(hi, pad)
+            lo = jnp.pad(lo, pad)
         s, e = _two_sum(hi[:h], hi[h:])
         hi = s
         lo = lo[:h] + lo[h:] + e
@@ -169,10 +202,31 @@ def dot_compensated(
 
 
 def _dot_df_local(x: jax.Array, y: jax.Array):
-    """Local (hi, lo) double-float partials of x . y (no reduction)."""
+    """Local (hi, lo) double-float partials of x . y (no reduction).
+    Accepts ``(n,)`` vectors or ``(n, k)`` column stacks (per-column
+    partials, shape ``(k,)``) - the products/corrections are
+    elementwise and the tree reduction folds axis 0 only."""
     p, e = _two_prod(x, y)
     hi, lo = _sum_df(p)
-    return hi, lo + jnp.sum(e)
+    return hi, lo + jnp.sum(e, axis=0)
+
+
+def dot_many_compensated(
+    x: jax.Array, y: jax.Array, *, axis_name: Optional[str] = None
+) -> jax.Array:
+    """Per-column compensated dots of ``(n, k)`` stacks -> ``(k,)``.
+
+    The double-float lane of :func:`dot_many`: column ``j`` equals
+    ``dot_compensated(x[:, j], y[:, j])`` (same two-prod / two-sum tree
+    per column - the error-free transforms are elementwise, so stacking
+    columns changes nothing about each column's arithmetic).  All
+    ``2 k`` (hi, lo) partials ride ONE psum on a mesh.
+    """
+    hi, lo = _dot_df_local(x, y)
+    if axis_name is not None:
+        hl = lax.psum(jnp.stack([hi, lo]), axis_name)  # ONE collective
+        hi, lo = hl[0], hl[1]
+    return hi + lo
 
 
 def fused_dots_compensated(pairs, *, axis_name: Optional[str] = None):
@@ -201,3 +255,17 @@ def xpby(x: jax.Array, beta: jax.Array, y: jax.Array) -> jax.Array:
     ``cublasDaxpy`` ``:347``); XLA fuses this into a single elementwise pass.
     """
     return x + beta * y
+
+
+def axpy_many(alpha: jax.Array, x: jax.Array, y: jax.Array) -> jax.Array:
+    """``y + alpha * x`` over ``(n, k)`` stacks with per-lane ``alpha``
+    ``(k,)``.  Column ``j`` is bit-identical to
+    ``axpy(alpha[j], x[:, j], y[:, j])`` (a broadcast elementwise
+    multiply-add - no reduction to reorder)."""
+    return y + alpha[None, :] * x
+
+
+def xpby_many(x: jax.Array, beta: jax.Array, y: jax.Array) -> jax.Array:
+    """``x + beta * y`` over ``(n, k)`` stacks with per-lane ``beta``
+    ``(k,)`` - the batched CG direction update, one fused pass."""
+    return x + beta[None, :] * y

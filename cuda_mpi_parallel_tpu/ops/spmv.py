@@ -57,6 +57,27 @@ def csr_matvec(
     )
 
 
+def csr_matmat(
+    data: jax.Array,
+    indices: jax.Array,
+    rows: jax.Array,
+    x: jax.Array,
+    n_rows: int,
+) -> jax.Array:
+    """Y = A @ X for CSR A and a column stack X of shape ``(n, k)``.
+
+    The many-RHS SpMM: ONE sweep of the matrix entries (the memory-
+    bound cost - arXiv 2204.00900: SpMV throughput IS sustained stream
+    bandwidth) serves all ``k`` columns, so each extra column costs
+    only the extra vector traffic.  Column ``j`` of the result is
+    bit-identical to ``csr_matvec(..., x[:, j], ...)`` - the gathered
+    rows and the segment sums are columnwise independent.
+    """
+    return jax.ops.segment_sum(
+        data[:, None] * jnp.take(x, indices, axis=0), rows,
+        num_segments=n_rows)
+
+
 def ell_matvec(vals: jax.Array, cols: jax.Array, x: jax.Array) -> jax.Array:
     """y = A @ x for A in padded ELL form.
 
@@ -65,6 +86,13 @@ def ell_matvec(vals: jax.Array, cols: jax.Array, x: jax.Array) -> jax.Array:
     row-sum is exact without masking.
     """
     return jnp.sum(vals * jnp.take(x, cols, axis=0), axis=1)
+
+
+def ell_matmat(vals: jax.Array, cols: jax.Array, x: jax.Array) -> jax.Array:
+    """Y = A @ X for padded-ELL A and ``(n, k)`` X: one rectangular
+    gather serves all columns (``jnp.take`` with the ``(n_rows, w)``
+    index array yields ``(n_rows, w, k)``), row-summed per column."""
+    return jnp.sum(vals[..., None] * jnp.take(x, cols, axis=0), axis=1)
 
 
 def dense_matvec(a: jax.Array, x: jax.Array) -> jax.Array:
@@ -95,6 +123,27 @@ def dia_matvec(bands: jax.Array, offsets, x: jax.Array) -> jax.Array:
         else:
             xs = jnp.concatenate([jnp.full((-k,), zero), x[:k]])
         y = y + bands[d] * xs
+    return y
+
+
+def dia_matmat(bands: jax.Array, offsets, x: jax.Array) -> jax.Array:
+    """Y = A @ X for DIA A and ``(n, k)`` X: the same statically-shifted
+    FMAs as :func:`dia_matvec`, with each band broadcast across the
+    RHS columns - one pass over the bands serves all ``k``."""
+    zero_row = jnp.zeros((1,) + x.shape[1:], x.dtype)
+
+    def shifted(k):
+        if k == 0:
+            return x
+        if k > 0:
+            return jnp.concatenate(
+                [x[k:], jnp.broadcast_to(zero_row, (k,) + x.shape[1:])])
+        return jnp.concatenate(
+            [jnp.broadcast_to(zero_row, (-k,) + x.shape[1:]), x[:k]])
+
+    y = jnp.zeros_like(x)
+    for d, k in enumerate(offsets):
+        y = y + bands[d][:, None] * shifted(k)
     return y
 
 

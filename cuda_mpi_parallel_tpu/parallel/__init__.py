@@ -9,7 +9,12 @@ from .streaming import (
     solve_distributed_streaming,
     solve_distributed_streaming_df64,
 )
-from .dist_cg import SequenceResult, solve_distributed, solve_sequence
+from .dist_cg import (
+    SequenceResult,
+    solve_distributed,
+    solve_distributed_many,
+    solve_sequence,
+)
 from .exchange import GatherSchedule, build_gather_schedule
 from .halo import (
     exchange_halo,
@@ -74,6 +79,7 @@ __all__ = [
     "validate_permutation",
     "solve_distributed",
     "solve_distributed_df64",
+    "solve_distributed_many",
     "solve_distributed_resident",
     "solve_distributed_streaming",
     "solve_distributed_streaming_df64",

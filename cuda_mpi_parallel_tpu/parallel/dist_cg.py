@@ -617,11 +617,16 @@ def _shard_tree(tree, mesh, axis):
 
 
 def _shard_padded_rhs(b, parts, mesh, axis):
+    """Pad a global RHS - a vector ``(n,)`` or a many-RHS column stack
+    ``(n, k)`` - into the partition's padded row layout and shard it
+    over axis 0 (``part.pad_vector``/``pad_vector_ranges`` are the one
+    definition of that layout; both handle trailing dims)."""
+    b = np.asarray(b)
     if parts.row_ranges is not None:
-        b_pad = part.pad_vector_ranges(np.asarray(b), parts.row_ranges,
+        b_pad = part.pad_vector_ranges(b, parts.row_ranges,
                                        parts.n_local)
     else:
-        b_pad = part.pad_vector(np.asarray(b), parts.n_global_padded)
+        b_pad = part.pad_vector(b, parts.n_global_padded)
     return shard_vector(jnp.asarray(b_pad), mesh, axis)
 
 
@@ -777,7 +782,206 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
 
 
 # ---------------------------------------------------------------------------
-# solve sequences: calibrate from solve k, replan solve k+1
+# many-RHS distributed solves: one halo exchange serving every column
+#
+# Production traffic is thousands of concurrent medium systems sharing
+# operators (ROADMAP item 1); solving k of them as a column stack
+# amortizes BOTH memory-bound costs of a distributed CG iteration: the
+# matrix HBM sweep (one SpMM) and the halo wire (one all_gather /
+# gather-round set carrying an (n_local, k) stack - extended-x becomes
+# extended-X, schedule unchanged).  The per-iteration COLLECTIVE COUNT
+# of a k-lane solve equals the single-RHS solve's - comm_cost events
+# prove it - so per-exchange latency divides by k.
+
+
+def _result_specs_many(axis: str, flight=None,
+                       fallback: bool = False) -> "CGBatchResult":
+    """out_specs for a shard_map'd cg_many: the solution stack row-
+    sharded, every per-lane array replicated (their reductions were
+    psum'd)."""
+    from ..solver.many import CGBatchResult
+
+    return CGBatchResult(
+        x=P(axis), iterations=P(), residual_norm=P(), converged=P(),
+        status=P(), indefinite=P(),
+        flight=P() if flight is not None else None,
+        fallback=P() if fallback else None)
+
+
+def solve_distributed_many(
+    a,
+    b,
+    *,
+    mesh: Optional[Mesh] = None,
+    n_devices: Optional[int] = None,
+    tol=1e-7,
+    rtol=0.0,
+    maxiter: int = 2000,
+    preconditioner: Optional[str] = None,
+    method: str = "batched",
+    check_every: int = 1,
+    compensated: bool = False,
+    flight=None,
+    plan=None,
+    exchange=None,
+):
+    """Solve ``A X = B`` for a column stack ``B (n, k)`` over a mesh.
+
+    The many-RHS sibling of :func:`solve_distributed`: the shard_map
+    body is ``solver.many.cg_many`` (masked batched or block CG), the
+    operator is the same ``DistCSR``/``DistCSRGather`` partition, and
+    each iteration ships ALL ``k`` columns through one halo exchange.
+    Lanes of a ``method="batched"`` solve are bit-identical to the
+    corresponding single-RHS distributed solves (tests assert it).
+
+    Scope (everything else refuses loudly rather than silently solving
+    column 0): assembled ``CSRMatrix`` operators on a 1-D mesh, the
+    allgather/gather exchange lanes (the ring schedules rotate single
+    x-blocks), ``preconditioner`` ``None`` or ``"jacobi"``, methods
+    ``"batched"``/``"block"``.  ``plan=`` composes exactly as in
+    :func:`solve_distributed` (the plan's permutation applies to the
+    ROWS of ``B``; its exchange lane is honored).  ``flight`` carries
+    the batched per-lane recorder (``method="batched"`` only).
+
+    Returns a ``solver.many.CGBatchResult`` whose ``x`` is the global
+    ``(n, k)`` solution stack.
+    """
+    from ..solver.many import MANY_METHODS, cg_many
+
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    if len(mesh.axis_names) != 1:
+        raise ValueError(
+            "solve_distributed_many runs on a 1-D mesh (the pencil "
+            "decomposition is stencil-only, and stencils are "
+            "single-RHS here)")
+    if not isinstance(a, CSRMatrix):
+        raise TypeError(
+            f"solve_distributed_many supports assembled CSRMatrix "
+            f"problems; {type(a).__name__} operators are single-RHS "
+            f"on a mesh (use solve_distributed per column)")
+    if method not in MANY_METHODS:
+        raise ValueError(f"unknown method {method!r}; expected one of "
+                         f"{MANY_METHODS}")
+    if preconditioner not in (None, "jacobi"):
+        raise ValueError(
+            f"solve_distributed_many supports preconditioner None or "
+            f"'jacobi' (got {preconditioner!r}); the chebyshev/mg "
+            f"applications are single-vector on a mesh")
+    if exchange not in (None, "auto", "gather", "allgather"):
+        raise ValueError(
+            f"unknown exchange: {exchange!r} (expected 'auto', "
+            f"'gather', 'allgather' or None; the ring schedules "
+            f"rotate single x-blocks and do not batch)")
+    b = jnp.asarray(b)
+    if b.ndim != 2:
+        raise ValueError(
+            f"solve_distributed_many solves a column stack: b must be "
+            f"(n, k), got shape {b.shape}")
+    if a.shape[1] != b.shape[0]:
+        raise ValueError(f"operator shape {a.shape} does not match rhs "
+                         f"stack shape {b.shape}")
+    if flight is not None:
+        if method != "batched":
+            raise ValueError(
+                "the batched flight recorder needs method='batched' "
+                "(block-CG's recurrence scalars are k x k matrices)")
+        flight = flight.without_heartbeat()
+    n_rhs = int(b.shape[1])
+    axis = mesh.axis_names[0]
+    n_shards = mesh.devices.size
+
+    plan = resolve_plan(plan, a, n_shards,
+                        exchange=_plan_exchange_hint("allgather",
+                                                     exchange))
+    from ..solver.cg import _note_engine
+
+    _note_engine("distributed-many", method, check_every,
+                 n_shards=int(n_shards), n_rhs=n_rhs,
+                 **({"flight_stride": flight.stride}
+                    if flight is not None else {}))
+
+    a, b = _apply_plan_permutation(a, b, plan)
+    ranges = plan.row_ranges if plan is not None else None
+    parts = part.partition_csr(
+        a, n_shards, ranges,
+        exchange=_resolve_exchange_mode(exchange, plan))
+    resolved = "gather" if parts.halo is not None else "allgather"
+    _note_partition(a, parts, plan)
+    b_dev = _shard_padded_rhs(b, parts, mesh, axis)
+    data = _shard_tree(parts.data, mesh, axis)
+    cols = _shard_tree(parts.cols, mesh, axis)
+    rows = _shard_tree(parts.local_rows, mesh, axis)
+
+    n_local = parts.n_local
+    sched = parts.halo
+    gather = sched is not None
+    geometry = tuple((r.shift, r.m) for r in sched.rounds) \
+        if gather else None
+    key = ("csr-many", method, n_rhs, resolved, geometry, n_local,
+           n_shards, axis, mesh, preconditioner, check_every,
+           compensated, flight, maxiter,
+           plan.fingerprint() if plan is not None else None)
+    send = tuple(_shard_tree(r.send_idx, mesh, axis)
+                 for r in sched.rounds) if gather else ()
+    shifts = tuple(r.shift for r in sched.rounds) if gather else ()
+    tol_dev = jnp.asarray(tol, b.dtype)
+    rtol_dev = jnp.asarray(rtol, b.dtype)
+
+    def build():
+        specs = (P(axis),) * 4 + (P(), P()) \
+            + ((P(axis),) if gather else ())
+
+        @partial(shard_map, mesh=mesh, in_specs=specs,
+                 out_specs=_result_specs_many(
+                     axis, flight, fallback=method == "block"))
+        def run(b_local, data_s, cols_s, rows_s, tol_s, rtol_s,
+                send_s=()):
+            _TRACE_COUNT[0] += 1
+            strip = partial(jax.tree.map, lambda v: v[0])
+            if gather:
+                op = DistCSRGather(
+                    data=strip(data_s), cols=strip(cols_s),
+                    local_rows=strip(rows_s), send_idx=strip(send_s),
+                    shifts=shifts, n_local=n_local, axis_name=axis,
+                    n_shards=n_shards)
+            else:
+                op = DistCSR(data=strip(data_s), cols=strip(cols_s),
+                             local_rows=strip(rows_s), n_local=n_local,
+                             axis_name=axis, n_shards=n_shards)
+            m = _make_precond((preconditioner, 0), op, axis)
+            return cg_many(op, b_local, tol=tol_s, rtol=rtol_s,
+                           maxiter=maxiter, m=m, axis_name=axis,
+                           check_every=check_every, method=method,
+                           compensated=compensated, flight=flight)
+        return run
+
+    ctx = dict(kind="csr-gather-many" if gather else "csr-many",
+               check_every=check_every, method=method,
+               n_shards=int(n_shards), n_rhs=n_rhs, exchange=resolved,
+               **({"plan": plan.label} if plan is not None else {}))
+    if gather:
+        itemsize = np.asarray(parts.data).dtype.itemsize
+        ctx["halo_padding_fraction"] = round(sched.padding_fraction(), 6)
+        # the per-round slabs now carry k columns each: the padded
+        # per-matvec wire scales by n_rhs, amortized per solve by 1/k
+        ctx["halo_wire_bytes_per_matvec"] = \
+            sched.wire_bytes_per_matvec(itemsize) * n_rhs
+    args = (b_dev, data, cols, rows, tol_dev, rtol_dev) \
+        + ((send,) if gather else ())
+    res = _cached_solver(key, build, ctx, args)(*args)
+    return _unpad_result_many(res, parts, plan)
+
+
+def _unpad_result_many(res, parts, plan):
+    """``_unpad_result`` over a solution STACK (rows of ``x`` are
+    gathered; the per-lane arrays pass through)."""
+    if parts.row_ranges is None:
+        if parts.n_global != parts.n_global_padded:
+            res = dataclasses.replace(res, x=res.x[: parts.n_global])
+        return res
+    idx = _plan_unpad_indices(parts, plan)
+    return dataclasses.replace(res, x=res.x[jnp.asarray(idx)])
 #
 # Time-stepping and service workloads solve the same operator hundreds
 # of times; the planner's reference machine model is a guess until the

@@ -253,17 +253,20 @@ def _partition_csr_ranges(a: CSRMatrix, n_shards: int,
 
 
 def pad_vector(b: np.ndarray, n_padded: int) -> np.ndarray:
-    out = np.zeros(n_padded, dtype=b.dtype)
+    """Zero-pad the leading (row) axis to ``n_padded``; trailing axes
+    - a many-RHS ``(n, k)`` column stack - ride along."""
+    out = np.zeros((n_padded,) + b.shape[1:], dtype=b.dtype)
     out[: b.shape[0]] = b
     return out
 
 
 def pad_vector_ranges(b: np.ndarray, row_ranges: RowRanges,
                       n_local: int) -> np.ndarray:
-    """Scatter a global vector into the padded variable-row layout
-    (shard blocks of ``n_local``, real rows first, zeros after)."""
+    """Scatter a global vector (or ``(n, k)`` stack - rows scatter,
+    columns ride) into the padded variable-row layout (shard blocks of
+    ``n_local``, real rows first, zeros after)."""
     n_pad = n_local * len(row_ranges)
-    out = np.zeros(n_pad, dtype=b.dtype)
+    out = np.zeros((n_pad,) + b.shape[1:], dtype=b.dtype)
     out[gather_indices(row_ranges, n_local)] = b
     return out
 

@@ -1015,3 +1015,10 @@ def solve(
     return _solve_jit(a, b, x0, tol_a, rtol_a, maxiter, m, record_history,
                       None, resume_from, return_checkpoint, cap_a,
                       check_every, method, compensated, flight)
+
+
+# The many-RHS tier (masked batched CG + block-CG) lives in .many; it
+# builds on this module's helpers, so the import must come after they
+# are defined.  Re-exported here because solve_many is this module's
+# column-stacked sibling of solve().
+from .many import CGBatchResult, cg_many, solve_many  # noqa: E402,F401

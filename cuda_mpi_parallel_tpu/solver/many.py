@@ -1,0 +1,506 @@
+"""Many-RHS solver tier: masked batched CG and true block-CG.
+
+Production traffic is thousands of concurrent medium systems, not one
+giant solve (ROADMAP item 1), and SpMV is memory-bound - its throughput
+is sustained stream bandwidth (arXiv 2204.00900) - so every extra RHS
+column riding one matrix sweep is nearly free FLOPs.  This module
+solves ``A X = B`` for a column stack ``B`` of shape ``(n, k)`` with
+ONE matrix sweep (``LinearOperator.matmat`` - an SpMM) and ONE fused
+reduction (``blas1.dot_many`` - a k-wide psum on a mesh) per iteration,
+in two flavors:
+
+* **masked batched CG** (``method="batched"``): ``k`` textbook CG
+  recurrences run in lockstep through one ``lax.while_loop``; alpha/
+  beta/rr are per-lane ``(k,)`` vectors and a convergence mask freezes
+  finished lanes in the carry (a ``jnp.where`` select per update - no
+  early-exit serialization, no NaN leakage from frozen lanes).  The
+  loop runs until the LAST live lane meets its tolerance.  Lanes are
+  arithmetically independent: at ``check_every=1`` lane ``j``'s
+  iterates are bit-identical to a single-RHS ``cg`` solve of column
+  ``j`` (tests assert exact equality at ``k = 1`` and per-lane), so
+  batching never changes an answer - it only amortizes the matrix
+  sweep and the collective latency across lanes.  Under
+  ``check_every > 1`` the single-RHS solver runs up to k-1 UNMASKED
+  extra steps past convergence inside a block while a batched lane
+  freezes exactly at its convergence step - the batched iterate is
+  the check_every=1 answer, the single-RHS one drifts below it.
+* **true block-CG** (``method="block"``, O'Leary 1980): the search
+  directions span a k-dimensional block Krylov space coupled through a
+  ``k x k`` Gram solve per iteration (Cholesky on the MXU-friendly
+  small dense block).  Every lane taps every lane's subspace, so
+  convergence takes measurably fewer iterations than the independent
+  recurrences - the s-step/block communication-avoiding win of arXiv
+  1612.08060 - at the price of two small Gram factorizations per
+  iteration.  Rank collapse (converged/duplicate columns make the Gram
+  singular - Cholesky yields NaN) is detected IN the loop: the state
+  freezes one step before poisoning, the loop exits, and a masked
+  batched continuation (same trace, zero host round-trips) finishes
+  the unconverged lanes from the frozen iterate.
+
+Both run under ``jit``/``shard_map`` exactly like ``solver.cg``; the
+distributed entry (``parallel.solve_distributed_many``) ships all ``k``
+columns through ONE halo exchange per iteration.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.operators import IdentityOperator, LinearOperator
+from ..ops import blas1
+from .cg import (
+    CGResult,
+    _as_operator,
+    _blocked_while,
+    _note_engine,
+    _safe_div,
+)
+from .status import CGStatus
+
+__all__ = ["CGBatchResult", "cg_many", "solve_many"]
+
+#: batched-solver recurrences accepted by :func:`cg_many`
+MANY_METHODS = ("batched", "block")
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("x", "iterations", "residual_norm", "converged",
+                 "status", "indefinite", "flight", "fallback"),
+    meta_fields=(),
+)
+@dataclasses.dataclass(frozen=True)
+class CGBatchResult:
+    """Per-lane outcome of a many-RHS solve.
+
+    Every field after ``x`` is a ``(k,)`` per-lane array - each column
+    gets the full ``CGResult`` story (status/iterations/residual), not
+    a batch-wide summary; :meth:`lane` slices out a standard
+    ``CGResult`` view of one column.
+    """
+
+    x: jax.Array               # (n, k) solution stack
+    iterations: jax.Array      # (k,) per-lane iterations to freeze
+    residual_norm: jax.Array   # (k,) final ||r_j||_2
+    converged: jax.Array       # (k,) bool
+    status: jax.Array          # (k,) CGStatus int codes
+    indefinite: jax.Array      # (k,) bool: lane saw p.Ap <= 0
+    #: batched flight buffer (capacity, 1 + 3k) when a FlightConfig was
+    #: passed; decode with telemetry.flight.lanes_from_buffer
+    flight: Optional[jax.Array] = None
+    #: block-CG only: True when the Gram solve broke down and the
+    #: masked-batched continuation finished the solve (None = batched)
+    fallback: Optional[jax.Array] = None
+
+    @property
+    def n_rhs(self) -> int:
+        return int(self.x.shape[1])
+
+    def lane(self, j: int) -> CGResult:
+        """A single column's result as a standard ``CGResult`` (the
+        flight buffer does not slice device-side - use
+        ``telemetry.flight.lanes_from_buffer`` on ``self.flight``)."""
+        return CGResult(
+            x=self.x[:, j], iterations=self.iterations[j],
+            residual_norm=self.residual_norm[j],
+            converged=self.converged[j], status=self.status[j],
+            indefinite=self.indefinite[j], residual_history=None)
+
+    def status_enums(self):
+        import numpy as np
+
+        return [CGStatus(int(s)) for s in np.asarray(self.status)]
+
+
+class _ManyState(NamedTuple):
+    k: jax.Array            # global loop iteration (scalar)
+    x: jax.Array            # (n, k)
+    r: jax.Array            # (n, k)
+    p: jax.Array            # (n, k)
+    rho: jax.Array          # (k,) r . z per lane
+    rr: jax.Array           # (k,) ||r||^2 per lane
+    iters: jax.Array        # (k,) per-lane iterations (frozen with lane)
+    indefinite: jax.Array   # (k,) bool
+
+
+class _BlockState(NamedTuple):
+    k: jax.Array
+    x: jax.Array            # (n, k)
+    r: jax.Array            # (n, k)
+    p: jax.Array            # (n, k)
+    gamma: jax.Array        # (k, k) Gram R^T Z
+    rr: jax.Array           # (k,) per-lane ||r||^2
+    iters: jax.Array        # (k,)
+    indefinite: jax.Array   # (k,)
+    broke: jax.Array        # () bool: Gram solve went non-finite
+
+
+def _threshold_sq_many(tol, rtol, nrm0: jax.Array, dtype) -> jax.Array:
+    """Per-lane squared threshold ``max(tol, rtol * ||r0_j||)^2``;
+    ``tol``/``rtol`` may be scalars or ``(k,)`` per-lane arrays (mixed
+    tolerances - each lane freezes on its own bar)."""
+    threshold = jnp.maximum(
+        jnp.broadcast_to(jnp.asarray(tol, dtype), nrm0.shape),
+        jnp.asarray(rtol, dtype) * nrm0)
+    return threshold * threshold
+
+
+def _active_lanes(rr, rho, thresh_sq):
+    """The per-lane liveness mask: unconverged, nontrivial (rr > 0 -
+    an exactly-solved lane would divide 0/0) and healthy (finite
+    scalars, SPD rho) - the same three clauses as ``cg``'s predicate,
+    per lane."""
+    unconverged = rr >= thresh_sq
+    nontrivial = rr > 0
+    healthy = jnp.isfinite(rr) & jnp.isfinite(rho) & (rho > 0)
+    return unconverged & nontrivial & healthy
+
+
+def _select_lanes(mask, new, old):
+    """Per-lane select of an ``(n, k)`` stack update: frozen lanes keep
+    their column bit-for-bit (a select, so NaN garbage computed for a
+    frozen lane never propagates)."""
+    return jnp.where(mask[None, :], new, old)
+
+
+def _init_xr_many(a, b, x0):
+    if x0 is None:
+        return jnp.zeros_like(b), b   # r0 = B - A@0 = B: copy-only init
+    x = jnp.asarray(x0, b.dtype)
+    return x, b - a.matmat(x)
+
+
+def _package_many(final, thresh_sq, flight_buf=None,
+                  fallback=None) -> CGBatchResult:
+    """Per-lane epilogue: the same status derivation as ``cg``'s
+    ``_package``, vectorized over lanes."""
+    nrm = jnp.sqrt(final.rr)
+    converged = (final.rr < thresh_sq) | (final.rr == 0)
+    healthy = jnp.isfinite(final.rr) & jnp.isfinite(final.rho) \
+        & ((final.rho > 0) | (final.rr == 0))
+    status = jnp.where(
+        converged,
+        jnp.int32(CGStatus.CONVERGED),
+        jnp.where(~healthy, jnp.int32(CGStatus.BREAKDOWN),
+                  jnp.int32(CGStatus.MAXITER)))
+    return CGBatchResult(
+        x=final.x, iterations=final.iters, residual_norm=nrm,
+        converged=converged, status=status, indefinite=final.indefinite,
+        flight=flight_buf, fallback=fallback)
+
+
+def cg_many(
+    a,
+    b: jax.Array,
+    x0: Optional[jax.Array] = None,
+    *,
+    tol=1e-7,
+    rtol=0.0,
+    maxiter: int = 2000,
+    m: Optional[LinearOperator] = None,
+    axis_name: Optional[str] = None,
+    iter_cap=None,
+    check_every: int = 1,
+    method: str = "batched",
+    compensated: bool = False,
+    flight=None,
+) -> CGBatchResult:
+    """Solve ``A X = B`` for all columns of ``B`` in one loop.
+
+    Args:
+      a: SPD ``LinearOperator`` (or raw 2-D array).  Applied via
+        ``matmat`` - one matrix sweep per iteration serves every lane.
+      b: right-hand-side column stack, shape ``(n, k)``.
+      x0: optional initial stack ``(n, k)``; ``None`` = zeros (the
+        copy-only init fast path, per lane).
+      tol/rtol: scalars or per-lane ``(k,)`` arrays - mixed tolerances
+        freeze each lane on its own bar.
+      m: optional preconditioner (applied via ``matmat``).
+      axis_name: mesh axis for row-partitioned execution; the per-lane
+        reductions ride ONE ``lax.psum`` per evaluation point.
+      method: ``"batched"`` (masked independent recurrences - lane
+        ``j`` bit-matches a single-RHS solve of column ``j`` at
+        ``check_every=1``; see the module docstring for the
+        ``check_every > 1`` freeze-at-convergence difference) or
+        ``"block"`` (O'Leary block-CG: coupled k-dim Krylov space,
+        fewer iterations, Gram-breakdown falls back to the batched
+        recurrence inside the same trace).
+      compensated: double-float per-lane inner products
+        (``blas1.dot_many_compensated``); ``"batched"`` only.
+      flight: optional ``telemetry.flight.FlightConfig`` - carry the
+        batched flight recorder (per-lane ``||r||^2``/alpha/beta rows,
+        ``(capacity, 1 + 3k)``) in the loop state; ``"batched"`` only
+        (block-CG's recurrence scalars are ``k x k`` matrices, not
+        per-lane pairs).  ``None`` leaves the traced jaxpr untouched.
+      (maxiter/iter_cap/check_every as in ``solver.cg``.)
+
+    Returns a :class:`CGBatchResult` with per-lane status/iterations/
+    residual.  Pure and traceable - call under ``jit`` (or use
+    :func:`solve_many`).
+    """
+    if not isinstance(a, LinearOperator):
+        a = _as_operator(a)
+    b = jnp.asarray(b)
+    if b.ndim != 2:
+        raise ValueError(
+            f"cg_many solves a column stack: b must be (n, k), got "
+            f"shape {b.shape} (use solver.cg for a single RHS)")
+    if not jnp.issubdtype(b.dtype, jnp.floating):
+        b = b.astype(jnp.result_type(float))
+    if axis_name is None and a.shape[1] != b.shape[0]:
+        raise ValueError(f"operator shape {a.shape} does not match rhs "
+                         f"stack shape {b.shape}")
+    if method not in MANY_METHODS:
+        raise ValueError(f"unknown method {method!r}; expected one of "
+                         f"{MANY_METHODS}")
+    if flight is not None and method != "batched":
+        raise ValueError(
+            "the batched flight recorder records per-lane (rr, alpha, "
+            "beta) scalars; block-CG's recurrence coefficients are "
+            "k x k matrices - use method='batched' with flight, or "
+            "drop the recorder")
+    if compensated and method != "batched":
+        raise ValueError("compensated dots ride the per-lane batched "
+                         "recurrence only")
+    preconditioned = m is not None
+    if m is None:
+        m = IdentityOperator(dim=b.shape[0],
+                             _dtype_name=jnp.dtype(b.dtype).name)
+    cap = jnp.asarray(maxiter if iter_cap is None else iter_cap,
+                      jnp.int32)
+
+    dot_many = partial(
+        blas1.dot_many_compensated if compensated else blas1.dot_many,
+        axis_name=axis_name)
+
+    x, r = _init_xr_many(a, b, x0)
+    rr0 = dot_many(r, r)
+    if preconditioned:
+        z = m.matmat(r)
+        rho0 = dot_many(r, z)
+    else:
+        z, rho0 = r, rr0
+    nrm0 = jnp.sqrt(rr0)
+    thresh_sq = _threshold_sq_many(tol, rtol, nrm0, b.dtype)
+    k0 = jnp.zeros((), jnp.int32)
+    iters0 = jnp.zeros(b.shape[1], jnp.int32)
+    indef0 = jnp.zeros(b.shape[1], jnp.bool_)
+
+    if method == "block":
+        gamma0 = blas1.gram(r, z, axis_name=axis_name)
+        bstate = _BlockState(
+            k=k0, x=x, r=r, p=z, gamma=gamma0, rr=rr0,
+            iters=iters0, indefinite=indef0,
+            broke=jnp.zeros((), jnp.bool_))
+        final, fell_back = _run_block(
+            a, b, m, preconditioned, bstate, thresh_sq, maxiter, cap,
+            check_every, dot_many, axis_name)
+        return _package_many(final, thresh_sq, fallback=fell_back)
+
+    state = _ManyState(
+        k=k0, x=x, r=r, p=z, rho=rho0, rr=rr0,
+        iters=iters0, indefinite=indef0)
+    final, fbuf = _run_batched(a, m, preconditioned, state, thresh_sq,
+                               maxiter, cap, check_every, dot_many,
+                               flight, b.dtype)
+    return _package_many(final, thresh_sq, flight_buf=fbuf)
+
+
+def _batched_step_fn(a, m, preconditioned, thresh_sq, dot_many):
+    """One masked batched CG step.  Returns ``(new_state, k, rr,
+    alpha, beta)`` - the step plus its per-lane recording scalars (the
+    flight recorder's row; traced away when the recorder is off)."""
+    def step_ab(s: _ManyState):
+        act = _active_lanes(s.rr, s.rho, thresh_sq)
+        ap = a.matmat(s.p)                       # ONE sweep, all lanes
+        p_ap = dot_many(s.p, ap)
+        alpha = _safe_div(s.rho, p_ap)           # (k,) elementwise
+        x = _select_lanes(act, blas1.axpy_many(alpha, s.p, s.x), s.x)
+        r = _select_lanes(act, blas1.axpy_many(-alpha, ap, s.r), s.r)
+        rr_new = dot_many(r, r)
+        rr = jnp.where(act, rr_new, s.rr)
+        if preconditioned:
+            z = m.matmat(r)
+            rho_new = dot_many(r, z)
+        else:
+            z, rho_new = r, rr_new
+        beta = _safe_div(rho_new, s.rho)
+        rho = jnp.where(act, rho_new, s.rho)
+        p = _select_lanes(act, blas1.xpby_many(z, beta, s.p), s.p)
+        k = s.k + 1
+        return _ManyState(
+            k=k, x=x, r=r, p=p, rho=rho, rr=rr,
+            iters=s.iters + act.astype(jnp.int32),
+            # s.rr > 0 excludes frozen lanes (p = 0 gives p.Ap = 0,
+            # not evidence of indefiniteness) - same rule as cg
+            indefinite=s.indefinite | ((p_ap <= 0) & (s.rr > 0) & act),
+        ), k, rr, jnp.where(act, alpha, jnp.nan), \
+            jnp.where(act, beta, jnp.nan)
+    return step_ab
+
+
+def _run_batched(a, m, preconditioned, state, thresh_sq, maxiter, cap,
+                 check_every, dot_many, flight, dtype):
+    """The masked batched while loop (+ optional flight recorder)."""
+    step_ab = _batched_step_fn(a, m, preconditioned, thresh_sq,
+                               dot_many)
+
+    def cond(s: _ManyState) -> jax.Array:
+        act = _active_lanes(s.rr, s.rho, thresh_sq)
+        return (s.k < maxiter) & (s.k < cap) & jnp.any(act)
+
+    def step(s: _ManyState) -> _ManyState:
+        return step_ab(s)[0]
+
+    def fits(s):
+        return (s.k + check_every <= maxiter) \
+            & (s.k + check_every <= cap)
+
+    if flight is None:
+        return _blocked_while(cond, step, state, check_every, fits), \
+            None
+
+    from ..telemetry.flight import flight_init_many, flight_record_many
+
+    buf0 = flight_init_many(flight, dtype, state.k, state.rr)
+
+    def fcond(fs):
+        return cond(fs[0])
+
+    def fstep(fs):
+        s, buf = fs
+        s2, k, rr, alpha, beta = step_ab(s)
+        buf = flight_record_many(buf, flight, k, rr, alpha, beta)
+        return s2, buf
+
+    final, buf = _blocked_while(fcond, fstep, (state, buf0),
+                                check_every, lambda fs: fits(fs[0]))
+    return final, buf
+
+
+def _run_block(a, b, m, preconditioned, bstate, thresh_sq, maxiter,
+               cap, check_every, dot_many, axis_name):
+    """The block-CG loop plus its in-trace masked-batched continuation.
+
+    The block loop freezes (``broke``) one step before a singular Gram
+    factor would poison the iterate; the continuation below re-seeds
+    the independent recurrences from the frozen ``(x, r)`` (a steepest-
+    descent restart: p = z = M r) and runs the SAME masked batched loop
+    as ``method="batched"`` under the remaining iteration budget.  When
+    nothing broke - the common case - every lane is converged (or the
+    budget is gone) and the continuation's predicate is false on entry:
+    zero extra iterations, zero extra exchanges.
+    """
+    gram = partial(blas1.gram, axis_name=axis_name)
+
+    def cond(s: _BlockState) -> jax.Array:
+        live = (s.rr >= thresh_sq) & (s.rr > 0) & jnp.isfinite(s.rr)
+        return (s.k < maxiter) & (s.k < cap) & ~s.broke & jnp.any(live)
+
+    def step(s: _BlockState) -> _BlockState:
+        live = (s.rr >= thresh_sq) & (s.rr > 0)
+        q = a.matmat(s.p)                     # ONE sweep, all lanes
+        w = gram(s.p, q)                      # P^T A P  (k, k)
+        lw = jnp.linalg.cholesky(w)           # NaN when not SPD
+        alpha = jax.scipy.linalg.cho_solve((lw, True), s.gamma)
+        x = s.x + s.p @ alpha
+        r = s.r - q @ alpha
+        z = m.matmat(r) if preconditioned else r
+        gamma_new = gram(r, z)
+        lg = jnp.linalg.cholesky(s.gamma)
+        beta = jax.scipy.linalg.cho_solve((lg, True), gamma_new)
+        p = z + s.p @ beta
+        rr = dot_many(r, r)
+        ok = jnp.all(jnp.isfinite(alpha)) & jnp.all(jnp.isfinite(beta)) \
+            & jnp.all(jnp.isfinite(rr))
+        # a rank-collapsed Gram (converged or linearly dependent
+        # columns) must freeze the PRE-step state: the NaN factors
+        # above already contaminated every candidate array
+        sel = lambda new, old: jnp.where(ok, new, old)
+        return _BlockState(
+            k=jnp.where(ok, s.k + 1, s.k),
+            x=sel(x, s.x), r=sel(r, s.r), p=sel(p, s.p),
+            gamma=sel(gamma_new, s.gamma), rr=sel(rr, s.rr),
+            iters=s.iters + (ok & live).astype(jnp.int32),
+            # diag(P^T A P) <= 0 on a live lane is the block analogue
+            # of cg's p.Ap <= 0 indefiniteness probe
+            indefinite=s.indefinite
+            | (ok & live & (jnp.diagonal(w) <= 0)),
+            broke=s.broke | ~ok)
+
+    def fits(s):
+        return (s.k + check_every <= maxiter) \
+            & (s.k + check_every <= cap)
+
+    final = _blocked_while(cond, step, bstate, check_every, fits)
+
+    # masked-batched continuation from the frozen state (runs 0
+    # iterations unless the Gram broke down with live lanes left)
+    z = m.matmat(final.r) if preconditioned else final.r
+    rho = dot_many(final.r, z) if preconditioned \
+        else dot_many(final.r, final.r)
+    mstate = _ManyState(
+        k=final.k, x=final.x, r=final.r, p=z, rho=rho, rr=final.rr,
+        iters=final.iters, indefinite=final.indefinite)
+    mfinal, _ = _run_batched(a, m, preconditioned, mstate, thresh_sq,
+                             maxiter, cap, check_every, dot_many,
+                             None, b.dtype)
+    fell_back = final.broke & (mfinal.iters > final.iters).any()
+    return mfinal, fell_back
+
+
+@partial(jax.jit, static_argnames=("maxiter", "check_every", "method",
+                                   "compensated", "flight"))
+def _solve_many_jit(a, b, x0, tol, rtol, maxiter, m, iter_cap,
+                    check_every, method, compensated, flight):
+    return cg_many(a, b, x0, tol=tol, rtol=rtol, maxiter=maxiter, m=m,
+                   iter_cap=iter_cap, check_every=check_every,
+                   method=method, compensated=compensated,
+                   flight=flight)
+
+
+def solve_many(
+    a,
+    b: jax.Array,
+    x0: Optional[jax.Array] = None,
+    *,
+    tol=1e-7,
+    rtol=0.0,
+    maxiter: int = 2000,
+    m: Optional[LinearOperator] = None,
+    iter_cap: Optional[int] = None,
+    check_every: int = 1,
+    method: str = "batched",
+    compensated: bool = False,
+    flight=None,
+) -> CGBatchResult:
+    """Jitted single-call many-RHS entry point (the ``solve()`` of the
+    batched tier): compile once per (operator structure, shapes,
+    maxiter, method) and reuse.  ``tol``/``rtol``/``iter_cap`` are
+    device values (scalars or per-lane arrays) so sweeping them never
+    recompiles.  Single-device; the distributed entry is
+    ``parallel.solve_distributed_many``.
+    """
+    if not isinstance(a, LinearOperator):
+        a = _as_operator(a)
+    b = jnp.asarray(b)
+    if b.ndim != 2:
+        raise ValueError(
+            f"solve_many solves a column stack: b must be (n, k), got "
+            f"shape {b.shape} (use solve() for a single RHS)")
+    if not jnp.issubdtype(b.dtype, jnp.floating):
+        b = b.astype(jnp.result_type(float))
+    tol_a = jnp.asarray(tol, b.dtype)
+    rtol_a = jnp.asarray(rtol, b.dtype)
+    cap_a = jnp.asarray(maxiter if iter_cap is None else iter_cap,
+                        jnp.int32)
+    _note_engine("many", method, check_every, n_rhs=int(b.shape[1]),
+                 **({"flight_stride": flight.stride}
+                    if flight is not None else {}))
+    return _solve_many_jit(a, b, x0, tol_a, rtol_a, maxiter, m, cap_a,
+                           check_every, method, compensated, flight)

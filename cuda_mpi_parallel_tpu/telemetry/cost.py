@@ -362,24 +362,40 @@ _METHOD_OPS = {
     "cg1": (1, 2, 4),      # dots fused into ONE reduction (s = A p axpy)
     "pipecg": (1, 2, 6),   # one fused reduction; s/q/z recurrences
     "minres": (1, 2, 5),   # Lanczos + two Givens updates
+    # many-RHS tier (solver.many): same recurrence shape as "cg" per
+    # lane, but ONE SpMM/exchange serves every lane; block adds the
+    # k x k Gram solve (ignored here - O(k^3) host-scale flops against
+    # O(nnz k) sweeps)
+    "batched": (1, 2, 3),
+    "block": (1, 3, 3),    # P^T A P, R^T Z and the per-lane ||r||^2
 }
 
 
 def analytic_solve_ops(method: str = "cg",
                        preconditioned: bool = False,
-                       precond_matvecs: int = 0) -> Dict[str, int]:
+                       precond_matvecs: int = 0,
+                       n_rhs: int = 1) -> Dict[str, int]:
     """Per-iteration SpMV/dot/axpy model for a solver recurrence.
 
     ``preconditioned`` adds the extra ``r . z`` inner product and one
     preconditioner application per iteration; ``precond_matvecs`` is
     the application's own matvec count (e.g. ``degree - 1`` for a
     Chebyshev polynomial), folded into ``spmv``.
+
+    ``n_rhs`` is the batched-solve lane count (``solver.many``): the
+    ``spmv`` count stays the number of MATRIX SWEEPS per iteration
+    (one SpMM serves every lane - the whole point of the tier), while
+    ``dot``/``axpy`` count per-lane vector reductions/updates and so
+    scale by ``n_rhs``.  The dict stays homogeneous op counts (no
+    metadata keys) so generic consumers can sum/iterate it.
     """
     if method not in _METHOD_OPS:
         raise ValueError(f"unknown method {method!r}; expected one of "
                          f"{sorted(_METHOD_OPS)}")
+    if n_rhs < 1:
+        raise ValueError(f"n_rhs must be >= 1, got {n_rhs}")
     spmv, dots, axpy = _METHOD_OPS[method]
     if preconditioned:
         dots += 1
         spmv += precond_matvecs
-    return {"spmv": spmv, "dot": dots, "axpy": axpy}
+    return {"spmv": spmv, "dot": dots * n_rhs, "axpy": axpy * n_rhs}

@@ -52,7 +52,11 @@ __all__ = [
     "FlightRecord",
     "buffer_from_block_history",
     "flight_init",
+    "flight_init_many",
     "flight_record",
+    "flight_record_many",
+    "lanes_from_buffer",
+    "many_columns",
     "maybe_heartbeat",
 ]
 
@@ -188,6 +192,75 @@ def maybe_heartbeat(cfg: FlightConfig, k, rr) -> None:
         (k % cfg.heartbeat) == 0,
         lambda: jax.debug.callback(_heartbeat_host, k, rr),
         lambda: None)
+
+
+# ---------------------------------------------------------------------------
+# Many-RHS (batched) recorder: one ring buffer carrying every lane
+#
+# A masked batched CG (solver.many) runs k solves through one loop; its
+# recorder rows are ``(iteration, rr_0..rr_{k-1}, alpha_0..alpha_{k-1},
+# beta_0..beta_{k-1})`` - per-lane ||r||^2 and recurrence scalars in ONE
+# (capacity, 1 + 3k) carry, written with the same masked ring update as
+# the single-RHS buffer.  ``lanes_from_buffer`` slices the fetched
+# buffer back into k standard FlightRecords, so health classification
+# and --history work per lane with zero new downstream machinery.
+
+
+def many_columns(n_rhs: int) -> int:
+    """Row width of a batched flight buffer: iteration + 3 per-lane
+    scalar columns (rr, alpha, beta)."""
+    if n_rhs < 1:
+        raise ValueError(f"n_rhs must be >= 1, got {n_rhs}")
+    return 1 + 3 * n_rhs
+
+
+def flight_init_many(cfg: FlightConfig, dtype, k0, rr0):
+    """Fresh batched ring buffer (``rr0`` is the per-lane ``(k,)``
+    initial residual; alpha/beta lanes NaN - no step has run)."""
+    import jax.numpy as jnp
+
+    n_rhs = int(rr0.shape[0])
+    buf = jnp.full((cfg.capacity, many_columns(n_rhs)), jnp.nan, dtype)
+    nan = jnp.full((n_rhs,), jnp.nan, dtype)
+    return flight_record_many(buf, cfg, k0, rr0, nan, nan)
+
+
+def flight_record_many(buf, cfg: FlightConfig, k, rr, alpha, beta):
+    """One masked ring write of a batched row (``rr``/``alpha``/``beta``
+    are ``(k,)`` per-lane scalars) - same write cadence and slot rule
+    as :func:`flight_record`."""
+    import jax.numpy as jnp
+
+    dtype = buf.dtype
+    k = jnp.asarray(k)
+    write = (k % cfg.stride) == 0
+    slot = (k // cfg.stride) % cfg.capacity
+    row = jnp.concatenate([
+        k.astype(dtype)[None],
+        jnp.asarray(rr).astype(dtype),
+        jnp.asarray(alpha).astype(dtype),
+        jnp.asarray(beta).astype(dtype),
+    ])
+    return buf.at[slot].set(jnp.where(write, row, buf[slot]))
+
+
+def lanes_from_buffer(buf, n_rhs: int, stride: Optional[int] = None):
+    """Slice a fetched batched buffer into ``n_rhs`` standard
+    :class:`FlightRecord` views (lane ``j``: iteration, ``rr_j``,
+    ``alpha_j``, ``beta_j``).  Host-side numpy, once, post-solve."""
+    arr = np.asarray(buf, dtype=np.float64)
+    expect = many_columns(n_rhs)
+    if arr.ndim != 2 or arr.shape[1] != expect:
+        raise ValueError(
+            f"batched flight buffer must be (capacity, {expect}) for "
+            f"n_rhs={n_rhs}, got {arr.shape}")
+    records = []
+    for j in range(n_rhs):
+        lane = np.stack([arr[:, 0], arr[:, 1 + j],
+                         arr[:, 1 + n_rhs + j],
+                         arr[:, 1 + 2 * n_rhs + j]], axis=1)
+        records.append(FlightRecord.from_buffer(lane, stride=stride))
+    return records
 
 
 def buffer_from_block_history(block_rr, check_every: int,

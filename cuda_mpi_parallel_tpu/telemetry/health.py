@@ -48,6 +48,7 @@ from .flight import FlightRecord
 
 __all__ = [
     "SolveHealth",
+    "assess_lanes",
     "assess_solve_health",
     "classify_trace",
     "emit_solve_health",
@@ -230,6 +231,25 @@ def assess_solve_health(record: FlightRecord, *, converged: bool,
         residual_last=r_last,
         message=message,
     )
+
+
+def assess_lanes(records, *, converged, statuses, iterations):
+    """Per-lane verdicts of a batched (many-RHS) solve.
+
+    ``records`` are the per-lane :class:`~.flight.FlightRecord` views
+    (``flight.lanes_from_buffer``); ``converged``/``statuses``/
+    ``iterations`` are the per-lane arrays of a
+    ``solver.many.CGBatchResult``.  Each lane is classified exactly
+    like a single-RHS solve - a lane that flatlined above ITS tolerance
+    reads STAGNATED even while its neighbors converged.
+    """
+    out = []
+    for j, rec in enumerate(records):
+        out.append(assess_solve_health(
+            rec, converged=bool(np.asarray(converged)[j]),
+            status=int(np.asarray(statuses)[j]),
+            iterations=int(np.asarray(iterations)[j])))
+    return out
 
 
 def emit_solve_health(health: SolveHealth,

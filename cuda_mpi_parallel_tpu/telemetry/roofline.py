@@ -236,7 +236,7 @@ def operator_nnz(a) -> int:
 
 def solve_traffic(n: int, nnz: int, itemsize: int, *,
                   method: str = "cg", preconditioned: bool = False,
-                  precond_matvecs: int = 0) -> dict:
+                  precond_matvecs: int = 0, n_rhs: int = 1) -> dict:
     """Per-iteration FLOPs and memory bytes of a solver recurrence.
 
     Built on ``cost.analytic_solve_ops``'s per-iteration op counts with
@@ -246,19 +246,31 @@ def solve_traffic(n: int, nnz: int, itemsize: int, *,
     is ``2 n`` FLOPs over two reads and one write.  A model, not a
     measurement - the jaxpr account (:mod:`.cost`) stays the source of
     truth for *communication*; this is the arithmetic/memory side the
-    jaxpr cannot price."""
+    jaxpr cannot price.
+
+    ``n_rhs > 1`` models the batched tier (``solver.many``): each
+    matrix sweep's ``nnz * (itemsize + 4)`` bytes are paid ONCE and
+    amortized over all lanes, while every per-lane vector term (the
+    SpMM's in/out stacks, dots, axpys) scales by ``n_rhs`` - exactly
+    the arXiv 2204.00900 argument for why extra RHS columns are nearly
+    free on a memory-bound SpMV.  ``mem_bytes_per_rhs`` reports the
+    amortized per-lane traffic."""
     ops = analytic_solve_ops(method, preconditioned=preconditioned,
-                             precond_matvecs=precond_matvecs)
-    spmv_bytes = nnz * (itemsize + 4) + 2 * n * itemsize
+                             precond_matvecs=precond_matvecs,
+                             n_rhs=n_rhs)
+    # one matrix sweep per spmv, n_rhs vector stacks riding it
+    spmv_bytes = nnz * (itemsize + 4) + 2 * n * itemsize * n_rhs
+    spmv_flops = 2 * nnz * n_rhs
     dot_bytes = 2 * n * itemsize
     axpy_bytes = 3 * n * itemsize
-    flops = (ops["spmv"] * 2 * nnz
+    flops = (ops["spmv"] * spmv_flops
              + ops["dot"] * 2 * n
              + ops["axpy"] * 2 * n)
     mem_bytes = (ops["spmv"] * spmv_bytes
                  + ops["dot"] * dot_bytes
                  + ops["axpy"] * axpy_bytes)
     return {"flops": float(flops), "mem_bytes": float(mem_bytes),
+            "mem_bytes_per_rhs": float(mem_bytes) / n_rhs,
             "ops": ops}
 
 
@@ -285,6 +297,15 @@ class RooflineReport:
     #: ``model``, and the model's age at analysis time (None = table)
     model_source: str = "table"
     model_age_s: Optional[float] = None
+    #: batched-solve lane count; per-iteration traffic above is the
+    #: WHOLE batch's, amortized per-lane traffic is mem/n_rhs
+    n_rhs: int = 1
+
+    @property
+    def mem_bytes_per_iteration_per_rhs(self) -> float:
+        """Amortized per-lane memory traffic: what one RHS pays when
+        the matrix sweep is shared across the batch."""
+        return self.mem_bytes_per_iteration / max(self.n_rhs, 1)
 
     def to_json(self) -> dict:
         out = dataclasses.asdict(self)
@@ -307,7 +328,8 @@ def analyze(*, n: int, nnz: int, itemsize: int, iterations: int,
             preconditioned: bool = False, precond_matvecs: int = 0,
             comm_bytes_per_iteration: float = 0.0,
             model: Optional[MachineModel] = None,
-            backend: Optional[str] = None) -> RooflineReport:
+            backend: Optional[str] = None,
+            n_rhs: int = 1) -> RooflineReport:
     """Join the analytic traffic model with a measured solve.
 
     ``elapsed_s`` is the measured wall time of ``iterations``
@@ -319,7 +341,8 @@ def analyze(*, n: int, nnz: int, itemsize: int, iterations: int,
         model = machine_model(backend)
     traffic = solve_traffic(n, nnz, itemsize, method=method,
                             preconditioned=preconditioned,
-                            precond_matvecs=precond_matvecs)
+                            precond_matvecs=precond_matvecs,
+                            n_rhs=n_rhs)
     flops, mem_bytes = traffic["flops"], traffic["mem_bytes"]
     t_mem = mem_bytes / model.mem_bytes_per_s
     t_flop = flops / model.flops_per_s
@@ -342,4 +365,4 @@ def analyze(*, n: int, nnz: int, itemsize: int, iterations: int,
         measured_s_per_iteration=measured_iter,
         efficiency_pct=100.0 * model_iter / measured_iter,
         bound=bound, model_source=model.source,
-        model_age_s=model.age_s)
+        model_age_s=model.age_s, n_rhs=int(n_rhs))

@@ -1,0 +1,109 @@
+"""Many-RHS batching: one matrix sweep (and one halo exchange) serves
+every right-hand side.
+
+SpMV is memory-bound - its throughput is sustained stream bandwidth -
+so a CG iteration's cost barely moves when k RHS columns ride one
+SpMM.  This example solves the same Poisson-2D operator for k = 8
+right-hand sides three ways and prints the measured amortization:
+
+1. a SEQUENTIAL loop of 8 single-RHS ``solve()`` calls (the baseline
+   a service without the batched tier would run);
+2. MASKED BATCHED CG (``solve_many``): 8 independent recurrences in
+   one ``lax.while_loop``, per-lane convergence masks - every lane's
+   answer is bit-identical to its single-RHS solve;
+3. TRUE BLOCK-CG (``solve_many(method="block")``): one coupled
+   k-dimensional Krylov space - fewer iterations, same exchanges per
+   iteration - with automatic masked-batched fallback on Gram
+   breakdown (demonstrated with a duplicated column).
+
+Run: python examples/14_many_rhs.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_mpi_parallel_tpu import solve
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.solver import solve_many
+
+GRID = 96          # 9216 unknowns - quick on CPU, real enough to time
+K = 8
+TOL = 1e-8
+
+
+def timed(fn):
+    jax.block_until_ready(fn().x)     # warmup (compile)
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(out.x)
+    return time.perf_counter() - t0, out
+
+
+def main():
+    a = poisson.poisson_2d_csr(GRID, GRID, dtype=np.float64)
+    rng = np.random.default_rng(14)
+    n = a.shape[0]
+    x_true = rng.standard_normal((n, K))
+    b = np.array(a.matmat(jnp.asarray(x_true)))
+
+    print(f"Poisson-2D {GRID}x{GRID} (n={n}), k={K} right-hand sides, "
+          f"tol={TOL:g}\n")
+
+    # 1) the sequential loop
+    def sequential():
+        results = [solve(a, b[:, j], tol=TOL, maxiter=800)
+                   for j in range(K)]
+        jax.block_until_ready(results[-1].x)
+        return results
+
+    sequential()                      # warmup all K compiles (one shape)
+    t0 = time.perf_counter()
+    seq = sequential()
+    t_seq = time.perf_counter() - t0
+    it_seq = sum(int(r.iterations) for r in seq)
+    print(f"sequential loop : {t_seq * 1e3:8.1f} ms, {it_seq} total "
+          f"iterations, {it_seq / t_seq:,.0f} lane-iters/s")
+
+    # 2) masked batched CG
+    t_bat, bat = timed(lambda: solve_many(a, b, tol=TOL, maxiter=800))
+    it_bat = int(np.asarray(bat.iterations).sum())
+    print(f"masked batched  : {t_bat * 1e3:8.1f} ms, {it_bat} total "
+          f"iterations, {it_bat / t_bat:,.0f} lane-iters/s "
+          f"({t_seq / t_bat:.1f}x faster than the loop)")
+    for j in (0, K - 1):
+        same = np.array_equal(np.asarray(seq[j].x),
+                              np.asarray(bat.x[:, j]))
+        print(f"  lane {j}: bit-identical to its single solve: {same}")
+
+    # 3) true block-CG: the coupled Krylov space
+    t_blk, blk = timed(lambda: solve_many(a, b, tol=TOL, maxiter=800,
+                                          method="block"))
+    print(f"block-CG        : {t_blk * 1e3:8.1f} ms, "
+          f"{int(np.asarray(blk.iterations).max())} iterations to the "
+          f"last lane (masked batched took "
+          f"{int(np.asarray(bat.iterations).max())}); fallback: "
+          f"{bool(blk.fallback)}")
+    err = float(np.max(np.abs(np.asarray(blk.x) - x_true)))
+    print(f"  max |x - x_true| over all lanes: {err:.2e}")
+
+    # Gram breakdown -> masked-batched fallback, no abort
+    b_dup = b.copy()
+    b_dup[:, 1] = b_dup[:, 0]
+    dup = solve_many(a, b_dup, tol=TOL, maxiter=800, method="block")
+    print(f"\nduplicate-column stack: Gram rank collapses at step one; "
+          f"fallback={bool(dup.fallback)}, all lanes converged: "
+          f"{bool(np.asarray(dup.converged).all())}")
+
+    print(f"\namortization: {t_seq / t_bat:.2f}x (batched) / "
+          f"{t_seq / t_blk:.2f}x (block) over the sequential loop - "
+          f"one matrix sweep per iteration serves all {K} columns.")
+
+
+if __name__ == "__main__":
+    main()

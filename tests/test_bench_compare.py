@@ -165,6 +165,40 @@ class TestCompareGate:
         assert "planner.nnz_imbalance_planned" in out
 
 
+    def test_many_rhs_columns_reported_never_gated(self, tmp_path):
+        """PR-8: the many-RHS batching columns ride the table but a
+        'worse' amortization or iteration count never fails the gate
+        (throughput tracks host weather, iteration counts the bench
+        problem), and an OLD file without the section degrades to
+        'only in NEW', not a KeyError."""
+        row = {"rhs_iters_per_sec_k8": 600.0,
+               "sequential_rhs_iters_per_sec_k8": 120.0,
+               "amortization_x_k8": 5.0,
+               "batched_iterations_k8": 211,
+               "block_iterations_k8": 145,
+               "many_wire": {"wire_bytes_per_solve_batched": 167040,
+                             "wire_bytes_per_solve_sequential8": 236640,
+                             "wire_amortization_x": 1.42}}
+        worse = dict(row, rhs_iters_per_sec_k8=60.0,
+                     amortization_x_k8=0.5, block_iterations_k8=500,
+                     many_wire=dict(row["many_wire"],
+                                    wire_amortization_x=0.7))
+        old = _sweep()
+        new = _sweep()
+        old["many_rhs"] = row
+        new["many_rhs"] = worse
+        rc, out = self._run(tmp_path, old, new)
+        assert rc == 0            # reported, never gated
+        assert "rhs_iters_per_sec_k8" in out
+        assert "amortization_x_k8" in out
+        assert "many_wire.wire_amortization_x" in out
+        # old file predates the section entirely -> reported as new
+        del old["many_rhs"]
+        rc, out = self._run(tmp_path, old, new)
+        assert rc == 0
+        assert "only in NEW: many_rhs" in out
+
+
 class TestMainCli:
     def test_main_regression_exit_codes(self, tmp_path, capsys):
         old = _write(tmp_path, "o.json", _sweep(headline=100000.0))

@@ -93,6 +93,15 @@ class TestWalker:
         with pytest.raises(ValueError, match="unknown method"):
             cost.analytic_solve_ops("sor")
 
+    def test_analytic_op_model_many_rhs(self):
+        # one matrix sweep serves all lanes; dots/axpys are per-lane
+        many = cost.analytic_solve_ops("batched", n_rhs=8)
+        assert many == {"spmv": 1, "dot": 16, "axpy": 24}
+        blk = cost.analytic_solve_ops("block", n_rhs=4)
+        assert blk["spmv"] == 1 and blk["dot"] == 12
+        with pytest.raises(ValueError, match="n_rhs"):
+            cost.analytic_solve_ops("batched", n_rhs=0)
+
     def test_halo_bytes_helper(self):
         # two boundary planes per matvec, each grid[1:] x itemsize
         assert cost.stencil_halo_bytes_per_iteration((16, 64), 8) \
@@ -239,6 +248,88 @@ class TestZeroPerturbation:
             telemetry.force_active(False)
             tshard.reset_last_shard_report()
         assert instrumented == base
+
+    def test_batched_solve_jaxpr_identical(self):
+        """PR-8 acceptance: telemetry-off batched (many-RHS) solves are
+        jaxpr-proven free of telemetry residue - the traced cg_many is
+        bit-identical with telemetry on and off, for both the masked
+        batched and block recurrences, and flight=None leaves the
+        batched loop state untouched."""
+        from cuda_mpi_parallel_tpu.solver.many import cg_many
+        from cuda_mpi_parallel_tpu.telemetry.flight import FlightConfig
+
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones((256, 4))
+
+        def traced(method, flight=None):
+            return str(jax.make_jaxpr(
+                lambda v: cg_many(a, v, maxiter=25, method=method,
+                                  flight=flight))(b))
+
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        base_batched = traced("batched")
+        base_block = traced("block")
+        try:
+            with events.capture():
+                telemetry.force_active(True)
+                events.emit("solve_start", label="batched probe")
+                assert traced("batched") == base_batched
+                assert traced("block") == base_block
+        finally:
+            telemetry.force_active(False)
+        # flight=None must not carry the (capacity, 1+3k) ring
+        assert traced("batched", flight=None) == base_batched
+        cfg = FlightConfig(capacity=9, stride=1)
+        on = traced("batched", flight=cfg)
+        assert on != base_batched
+        assert "9,13" in on.replace(" ", "")    # 1 + 3*4 lane columns
+        assert "9,13" not in base_batched.replace(" ", "")
+
+    @needs_mesh
+    def test_batched_distributed_jaxpr_identical(self):
+        """The distributed many-RHS solve body traces identically with
+        telemetry on and off (the comm walk is an extra abstract trace
+        on the side, never an insertion)."""
+        from cuda_mpi_parallel_tpu.models import poisson
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            make_mesh,
+            solve_distributed_many,
+        )
+        from cuda_mpi_parallel_tpu.telemetry import shardscope as tshard
+
+        a = poisson.poisson_2d_csr(8, 8)
+        b = np.random.default_rng(0).standard_normal((64, 3))
+        mesh = make_mesh(4)
+
+        def traced_jaxpr(active):
+            dist_cg.clear_solver_cache()
+            captured = {}
+            orig = dist_cg._cached_solver
+
+            def wrapper(key, build, cost_ctx=None, cost_args=None):
+                captured["jaxpr"] = jax.make_jaxpr(build())(*cost_args)
+                return orig(key, build, cost_ctx, cost_args)
+
+            dist_cg._cached_solver = wrapper
+            try:
+                if active:
+                    with events.capture():
+                        telemetry.force_active(True)
+                        solve_distributed_many(a, b, mesh=mesh,
+                                               tol=1e-8, maxiter=200)
+                else:
+                    solve_distributed_many(a, b, mesh=mesh, tol=1e-8,
+                                           maxiter=200)
+            finally:
+                telemetry.force_active(False)
+                tshard.reset_last_shard_report()
+                dist_cg._cached_solver = orig
+                dist_cg.clear_solver_cache()
+            return str(captured["jaxpr"])
+
+        assert traced_jaxpr(False) == traced_jaxpr(True)
 
     @needs_mesh
     def test_plan_none_distributed_csr_jaxpr_identical(self):

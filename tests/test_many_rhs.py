@@ -1,0 +1,615 @@
+"""Many-RHS solver tier (solver.many + parallel.solve_distributed_many).
+
+The tier's claims are all checkable numbers: batched BLAS-1 columns
+must be BIT-identical to the single-RHS ops on those columns, a k=1
+masked batched solve must reproduce ``solve()``'s iterates bit-for-bit,
+per-lane convergence masks must freeze each lane exactly where its own
+single-RHS solve would stop, block-CG must converge in measurably
+fewer iterations than the independent recurrences (and fall back to
+them on Gram breakdown without aborting), and a mesh-4 batched solve
+must ship ONE halo exchange per iteration serving all k columns -
+asserted against the jaxpr-derived comm account.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import solve, telemetry
+from cuda_mpi_parallel_tpu.models import mmio, poisson
+from cuda_mpi_parallel_tpu.models.operators import (
+    CSRMatrix,
+    JacobiPreconditioner,
+    Stencil2D,
+)
+from cuda_mpi_parallel_tpu.ops import blas1
+from cuda_mpi_parallel_tpu.solver import CGStatus, solve_many
+from cuda_mpi_parallel_tpu.solver.many import cg_many
+from cuda_mpi_parallel_tpu.telemetry import events
+from cuda_mpi_parallel_tpu.telemetry.flight import (
+    FlightConfig,
+    lanes_from_buffer,
+)
+from cuda_mpi_parallel_tpu.telemetry.health import assess_lanes
+from cuda_mpi_parallel_tpu.utils import compat
+
+needs_mesh = pytest.mark.skipif(
+    not compat.has_shard_map() or len(jax.devices()) < 4,
+    reason="needs shard_map and >= 4 (virtual) devices")
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "skewed_spd_240.mtx")
+
+
+def _stack_system(a, k, seed=3, dtype=None):
+    """(X_true, B) with B = A @ X_true, per-lane known solutions."""
+    rng = np.random.default_rng(seed)
+    n = int(a.shape[0])
+    x_true = rng.standard_normal((n, k))
+    if dtype is not None:
+        x_true = x_true.astype(dtype)
+    b = np.array(a.matmat(jnp.asarray(x_true)))  # writable host copy
+    return x_true, b
+
+
+class TestBlas1Many:
+    """Satellite: column j of every batched op equals the single-RHS
+    op on column j - bit-for-bit, f32 and df64 (compensated) lanes."""
+
+    def _stacks(self, dtype, n=1037, k=5):
+        rng = np.random.default_rng(11)
+        x = rng.standard_normal((n, k)).astype(dtype)
+        y = rng.standard_normal((n, k)).astype(dtype)
+        return jnp.asarray(x), jnp.asarray(y)
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_dot_many_column_bitwise(self, dtype):
+        x, y = self._stacks(dtype)
+        batched = np.asarray(jax.jit(blas1.dot_many)(x, y))
+        for j in range(x.shape[1]):
+            single = jax.jit(blas1.dot)(x[:, j], y[:, j])
+            assert batched[j] == float(single)
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_dot_many_compensated_column_bitwise(self, dtype):
+        x, y = self._stacks(dtype)
+        batched = np.asarray(jax.jit(blas1.dot_many_compensated)(x, y))
+        for j in range(x.shape[1]):
+            single = jax.jit(blas1.dot_compensated)(x[:, j], y[:, j])
+            assert batched[j] == float(single)
+
+    def test_dot_many_compensated_beats_plain_f32(self):
+        # the df64 lane's reason to exist: a sign-cancelling f32 dot
+        rng = np.random.default_rng(5)
+        big = rng.standard_normal(4096) * 1e4
+        x = np.stack([big, big], axis=1).astype(np.float32)
+        y = np.stack([big, -big], axis=1).astype(np.float32)
+        y[1::2, 1] = big[1::2].astype(np.float32)  # partial cancel
+        exact = np.einsum("nk,nk->k", x.astype(np.float64),
+                          y.astype(np.float64))
+        comp = np.asarray(blas1.dot_many_compensated(
+            jnp.asarray(x), jnp.asarray(y))).astype(np.float64)
+        plain = np.asarray(blas1.dot_many(
+            jnp.asarray(x), jnp.asarray(y))).astype(np.float64)
+        err_comp = np.abs(comp - exact)
+        err_plain = np.abs(plain - exact)
+        assert err_comp[1] <= err_plain[1]
+        assert err_comp[1] <= 4 * np.abs(exact[1]) * 2 ** -24 \
+            + 1e-30
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_axpy_xpby_many_column_bitwise(self, dtype):
+        x, y = self._stacks(dtype)
+        alpha = jnp.asarray(
+            np.asarray([0.37, -1.25, 3.0, 1e-3, -7.5], dtype))
+        ax = np.asarray(jax.jit(blas1.axpy_many)(alpha, x, y))
+        xb = np.asarray(jax.jit(blas1.xpby_many)(x, alpha, y))
+        for j in range(x.shape[1]):
+            np.testing.assert_array_equal(
+                ax[:, j],
+                np.asarray(jax.jit(blas1.axpy)(alpha[j], x[:, j],
+                                               y[:, j])))
+            np.testing.assert_array_equal(
+                xb[:, j],
+                np.asarray(jax.jit(blas1.xpby)(x[:, j], alpha[j],
+                                               y[:, j])))
+
+    def test_axpy_many_hand_checked(self):
+        x = jnp.asarray([[1.0, 10.0], [2.0, 20.0]])
+        y = jnp.asarray([[100.0, 1000.0], [200.0, 2000.0]])
+        out = np.asarray(blas1.axpy_many(jnp.asarray([2.0, -1.0]),
+                                         x, y))
+        np.testing.assert_array_equal(
+            out, [[102.0, 990.0], [204.0, 1980.0]])
+
+    def test_gram_matches_dense(self):
+        x, y = self._stacks(np.float64, n=64, k=3)
+        g = np.asarray(blas1.gram(x, y))
+        np.testing.assert_allclose(g, np.asarray(x).T @ np.asarray(y),
+                                   rtol=1e-13)
+
+
+class TestMatmatParity:
+    """SpMM formats: column j of matmat == matvec of column j."""
+
+    @pytest.mark.parametrize("convert", [
+        lambda a: a,                      # CSR
+        lambda a: a.to_ell(),             # padded ELL
+        lambda a: a.to_dia(),             # gather-free DIA
+    ])
+    def test_assembled_formats_bitwise(self, convert):
+        a = convert(poisson.poisson_2d_csr(12, 12, dtype=np.float64))
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((a.shape[0], 4)))
+        batched = np.asarray(jax.jit(a.matmat)(x))
+        for j in range(4):
+            np.testing.assert_array_equal(
+                batched[:, j], np.asarray(jax.jit(a.matvec)(x[:, j])))
+
+    def test_default_vmap_matmat_stencil(self):
+        a = Stencil2D.create(8, 8, dtype=jnp.float64)
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((64, 3)))
+        batched = np.asarray(a.matmat(x))
+        for j in range(3):
+            np.testing.assert_allclose(
+                batched[:, j], np.asarray(a.matvec(x[:, j])),
+                rtol=1e-14)
+
+
+class TestMaskedBatched:
+    def test_k1_bitwise_matches_solve(self):
+        """ISSUE acceptance: k=1 masked-batched == solve() bit-for-bit
+        (iterates, count, residual)."""
+        a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+        _, b = _stack_system(a, 1)
+        single = solve(a, b[:, 0], tol=1e-10, maxiter=500)
+        many = solve_many(a, b, tol=1e-10, maxiter=500)
+        np.testing.assert_array_equal(np.asarray(single.x),
+                                      np.asarray(many.x[:, 0]))
+        assert int(single.iterations) == int(many.iterations[0])
+        # the scalar ||r||^2 reduce may fuse differently inside the
+        # batched loop (same summation order, different FMA
+        # contraction) - ulp-level only, the ITERATES are exact
+        np.testing.assert_allclose(float(many.residual_norm[0]),
+                                   float(single.residual_norm),
+                                   rtol=1e-12)
+        assert bool(many.converged[0])
+
+    def test_k1_bitwise_matches_solve_f32(self):
+        a = poisson.poisson_2d_csr(16, 16, dtype=np.float32)
+        _, b = _stack_system(a, 1, dtype=np.float32)
+        single = solve(a, b[:, 0], tol=1e-4, maxiter=500)
+        many = solve_many(a, b, tol=1e-4, maxiter=500)
+        np.testing.assert_array_equal(np.asarray(single.x),
+                                      np.asarray(many.x[:, 0]))
+        assert int(single.iterations) == int(many.iterations[0])
+
+    def test_k1_bitwise_matches_solve_jacobi(self):
+        a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+        m = JacobiPreconditioner.from_operator(a)
+        _, b = _stack_system(a, 1)
+        single = solve(a, b[:, 0], tol=1e-10, maxiter=500, m=m)
+        many = solve_many(a, b, tol=1e-10, maxiter=500, m=m)
+        np.testing.assert_array_equal(np.asarray(single.x),
+                                      np.asarray(many.x[:, 0]))
+        assert int(single.iterations) == int(many.iterations[0])
+
+    def test_lanes_bitwise_match_singles(self):
+        """Each lane of a k=6 batch freezes exactly where - and with
+        exactly the bits - its own single-RHS solve stops.  Batching
+        changes nothing about any answer."""
+        a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+        _, b = _stack_system(a, 6)
+        many = solve_many(a, b, tol=1e-10, maxiter=500)
+        for j in range(6):
+            single = solve(a, b[:, j], tol=1e-10, maxiter=500)
+            np.testing.assert_array_equal(np.asarray(single.x),
+                                          np.asarray(many.x[:, j]))
+            assert int(single.iterations) == int(many.iterations[j])
+
+    def test_zero_rhs_lane_converges_at_iteration_zero(self):
+        """A b=0 column is solved exactly by x0=0: its lane must
+        freeze at 0 iterations, CONVERGED, while its neighbors run."""
+        a = poisson.poisson_2d_csr(12, 12, dtype=np.float64)
+        _, b = _stack_system(a, 3)
+        b[:, 1] = 0.0
+        res = solve_many(a, b, tol=1e-10, maxiter=500)
+        iters = np.asarray(res.iterations)
+        assert iters[1] == 0
+        assert iters[0] > 0 and iters[2] > 0
+        assert np.asarray(res.converged).all()
+        assert np.asarray(res.status)[1] == int(CGStatus.CONVERGED)
+        np.testing.assert_array_equal(np.asarray(res.x[:, 1]),
+                                      np.zeros(a.shape[0]))
+
+    def test_mixed_tolerances_freeze_per_lane(self):
+        """Per-lane tol arrays: each lane stops on ITS bar, and the
+        frozen lane bit-matches a single solve at that same bar."""
+        a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+        _, b = _stack_system(a, 3)
+        tols = np.asarray([1e-4, 1e-8, 1e-11])
+        res = solve_many(a, b, tol=tols, maxiter=500)
+        iters = np.asarray(res.iterations)
+        assert iters[0] < iters[1] < iters[2]
+        assert np.asarray(res.converged).all()
+        rn = np.asarray(res.residual_norm)
+        assert (rn < tols).all()
+        for j, t in enumerate(tols):
+            single = solve(a, b[:, j], tol=float(t), maxiter=500)
+            np.testing.assert_array_equal(np.asarray(single.x),
+                                          np.asarray(res.x[:, j]))
+            assert int(single.iterations) == int(iters[j])
+
+    def test_stagnating_lane_classified_while_others_converge(self):
+        """ISSUE acceptance: one lane hits a non-CONVERGED trace
+        verdict (STAGNATED/DIVERGED - the f32 attainable floor on a
+        kappa=1e8 system) while a lane whose RHS lives in the
+        well-conditioned subspace converges - per-lane CGStatus
+        asserted through the per-lane flight records."""
+        eigs = np.logspace(0, -8, 48)
+        a = jnp.asarray(np.diag(eigs).astype(np.float32))
+        b = np.zeros((48, 2), np.float32)
+        b[:, 0] = 1.0                  # touches the 1e-8 eigenvalues
+        b[:4, 1] = 1.0                 # large-eigenvalue subspace only
+        res = solve_many(a, b, tol=np.asarray([1e-12, 1e-5],
+                                              np.float32),
+                         maxiter=400, flight=FlightConfig.for_solve(400))
+        conv = np.asarray(res.converged)
+        assert not conv[0] and conv[1]
+        assert np.asarray(res.status)[0] == int(CGStatus.MAXITER)
+        recs = lanes_from_buffer(res.flight, 2)
+        healths = assess_lanes(recs, converged=res.converged,
+                               statuses=res.status,
+                               iterations=res.iterations)
+        assert healths[0].classification in (CGStatus.STAGNATED,
+                                             CGStatus.DIVERGED)
+        assert healths[1].classification == CGStatus.CONVERGED
+
+    def test_flight_lane_records_match_single_rhs_recorder(self):
+        """The batched recorder's per-lane rows carry the same
+        (rr, alpha, beta) scalars the single-RHS recorder writes."""
+        a = poisson.poisson_2d_csr(12, 12, dtype=np.float64)
+        _, b = _stack_system(a, 2)
+        cfg = FlightConfig.for_solve(300)
+        many = solve_many(a, b, tol=1e-9, maxiter=300, flight=cfg)
+        recs = lanes_from_buffer(many.flight, 2, stride=cfg.stride)
+        for j in range(2):
+            from cuda_mpi_parallel_tpu.telemetry.flight import (
+                FlightRecord,
+            )
+
+            single = solve(a, b[:, j], tol=1e-9, maxiter=300,
+                           flight=cfg)
+            srec = FlightRecord.from_buffer(single.flight,
+                                            stride=cfg.stride)
+            m = len(srec)
+            np.testing.assert_array_equal(recs[j].iterations[:m],
+                                          srec.iterations)
+            np.testing.assert_array_equal(recs[j].residual_sq[:m],
+                                          srec.residual_sq)
+            np.testing.assert_array_equal(recs[j].alphas[1:m],
+                                          srec.alphas[1:])
+
+    def test_check_every_converges_identically_frozen(self):
+        a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+        x_true, b = _stack_system(a, 4)
+        res = solve_many(a, b, tol=1e-10, maxiter=500, check_every=8)
+        assert np.asarray(res.converged).all()
+        assert np.max(np.abs(np.asarray(res.x) - x_true)) < 1e-7
+
+    def test_compensated_batched_runs(self):
+        a = poisson.poisson_2d_csr(12, 12, dtype=np.float32)
+        x_true, b = _stack_system(a, 3, dtype=np.float32)
+        res = solve_many(a, b, tol=1e-4, maxiter=500, compensated=True)
+        assert np.asarray(res.converged).all()
+
+    def test_shape_and_method_validation(self):
+        a = poisson.poisson_2d_csr(8, 8, dtype=np.float64)
+        b1 = np.ones(64)
+        with pytest.raises(ValueError, match="column stack"):
+            solve_many(a, b1)
+        with pytest.raises(ValueError, match="unknown method"):
+            solve_many(a, np.ones((64, 2)), method="minres")
+        with pytest.raises(ValueError, match="batched flight"):
+            cg_many(a, jnp.ones((64, 2)), method="block",
+                    flight=FlightConfig(capacity=8))
+
+
+class TestBlockCG:
+    def test_fewer_iterations_than_batched(self):
+        """ISSUE acceptance: on a well-conditioned SPD system the
+        coupled k-dim Krylov space converges in measurably fewer
+        iterations than the independent masked recurrences."""
+        a = poisson.poisson_2d_csr(24, 24, dtype=np.float64)
+        x_true, b = _stack_system(a, 8)
+        batched = solve_many(a, b, tol=1e-9, maxiter=800)
+        block = solve_many(a, b, tol=1e-9, maxiter=800, method="block")
+        assert np.asarray(block.converged).all()
+        assert not bool(block.fallback)
+        it_block = int(np.asarray(block.iterations).max())
+        it_batched = int(np.asarray(batched.iterations).max())
+        assert it_block < it_batched
+        assert np.max(np.abs(np.asarray(block.x) - x_true)) < 1e-6
+
+    def test_gram_breakdown_falls_back_without_aborting(self):
+        """ISSUE acceptance: duplicate RHS columns collapse the Gram
+        rank at step one; the solve must finish (masked-batched
+        continuation) instead of aborting, and flag the fallback."""
+        a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+        x_true, b = _stack_system(a, 4)
+        b[:, 1] = b[:, 0]                      # exact rank collapse
+        x_true[:, 1] = x_true[:, 0]
+        res = solve_many(a, b, tol=1e-9, maxiter=800, method="block")
+        assert bool(res.fallback)
+        assert np.asarray(res.converged).all()
+        assert np.max(np.abs(np.asarray(res.x) - x_true)) < 1e-6
+        # identical lanes got identical answers
+        np.testing.assert_array_equal(np.asarray(res.x[:, 0]),
+                                      np.asarray(res.x[:, 1]))
+
+    def test_block_with_jacobi(self):
+        a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+        m = JacobiPreconditioner.from_operator(a)
+        x_true, b = _stack_system(a, 4)
+        res = solve_many(a, b, tol=1e-9, maxiter=800, method="block",
+                         m=m)
+        assert np.asarray(res.converged).all()
+        assert np.max(np.abs(np.asarray(res.x) - x_true)) < 1e-6
+
+
+@needs_mesh
+class TestDistributedMany:
+    def setup_method(self):
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        dist_cg.clear_solver_cache()
+
+    teardown_method = setup_method
+
+    def _mesh(self):
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+
+        return make_mesh(4)
+
+    def test_one_exchange_serves_all_columns(self):
+        """ISSUE acceptance: the comm account of a k=8 batched solve
+        shows ONE all_gather per iteration (same collective count as a
+        single-RHS solve) whose wire carries all 8 columns, and each
+        lane bit-matches its single-RHS distributed solve."""
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            solve_distributed,
+            solve_distributed_many,
+        )
+
+        a = mmio.load_matrix_market(FIXTURE)
+        _, b = _stack_system(a, 8, seed=5)
+        mesh = self._mesh()
+        telemetry.force_active(True)
+        try:
+            dist_cg.reset_last_comm_cost()
+            many = solve_distributed_many(a, b, mesh=mesh, tol=1e-9,
+                                          maxiter=500)
+            sc_many, ctx_many = dist_cg.last_comm_cost()
+            dist_cg.reset_last_comm_cost()
+            single = solve_distributed(a, b[:, 0], mesh=mesh, tol=1e-9,
+                                       maxiter=500)
+            sc_one, _ = dist_cg.last_comm_cost()
+        finally:
+            telemetry.force_active(False)
+        assert ctx_many["n_rhs"] == 8
+        assert sc_many.per_iteration.all_gather \
+            == sc_one.per_iteration.all_gather == 1
+        assert sc_many.per_iteration.psum == sc_one.per_iteration.psum
+        assert sc_many.per_iteration.wire_bytes \
+            == 8 * sc_one.per_iteration.wire_bytes
+        np.testing.assert_array_equal(np.asarray(single.x),
+                                      np.asarray(many.x[:, 0]))
+        assert int(single.iterations) == int(many.iterations[0])
+
+    def test_gather_exchange_bitwise_and_wire(self):
+        """extended-x becomes extended-X: the gather rounds carry all
+        columns, the schedule (and solution bits) unchanged."""
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            solve_distributed_many,
+        )
+
+        a = mmio.load_matrix_market(FIXTURE)
+        _, b = _stack_system(a, 4, seed=5)
+        mesh = self._mesh()
+        telemetry.force_active(True)
+        try:
+            allg = solve_distributed_many(a, b, mesh=mesh, tol=1e-9,
+                                          maxiter=500,
+                                          exchange="allgather")
+            dist_cg.reset_last_comm_cost()
+            gath = solve_distributed_many(a, b, mesh=mesh, tol=1e-9,
+                                          maxiter=500,
+                                          exchange="gather")
+            sc, ctx = dist_cg.last_comm_cost()
+        finally:
+            telemetry.force_active(False)
+        np.testing.assert_array_equal(np.asarray(allg.x),
+                                      np.asarray(gath.x))
+        assert ctx["exchange"] == "gather"
+        # skewed fixture at mesh 4: 1160 coupled-wire B/iter per lane
+        assert sc.per_iteration.wire_bytes == 4 * 1160
+        assert ctx["halo_wire_bytes_per_matvec"] == 4 * 1160
+
+    def test_block_wire_per_solve_beats_sequential(self):
+        """ISSUE acceptance: k=8 block-CG's whole-solve wire bytes land
+        strictly below 8x a single-RHS solve's (fewer iterations, same
+        per-lane wire)."""
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            solve_distributed,
+            solve_distributed_many,
+        )
+
+        a = mmio.load_matrix_market(FIXTURE)
+        x_true, b = _stack_system(a, 8, seed=5)
+        mesh = self._mesh()
+        telemetry.force_active(True)
+        try:
+            dist_cg.reset_last_comm_cost()
+            blk = solve_distributed_many(a, b, mesh=mesh, tol=1e-9,
+                                         maxiter=500, method="block",
+                                         exchange="gather")
+            sc_blk, _ = dist_cg.last_comm_cost()
+            dist_cg.reset_last_comm_cost()
+            single = solve_distributed(a, b[:, 0], mesh=mesh, tol=1e-9,
+                                       maxiter=500, exchange="gather")
+            sc_one, _ = dist_cg.last_comm_cost()
+        finally:
+            telemetry.force_active(False)
+        assert np.asarray(blk.converged).all()
+        wire_blk = sc_blk.totals(
+            int(np.asarray(blk.iterations).max())).wire_bytes
+        wire_seq = 8 * sc_one.totals(int(single.iterations)).wire_bytes
+        assert wire_blk < wire_seq
+        assert np.max(np.abs(np.asarray(blk.x) - x_true)) < 1e-6
+
+    def test_plan_auto_composes(self):
+        from cuda_mpi_parallel_tpu.parallel import solve_distributed_many
+
+        a = mmio.load_matrix_market(FIXTURE)
+        x_true, b = _stack_system(a, 3, seed=5)
+        res = solve_distributed_many(a, b, mesh=self._mesh(), tol=1e-9,
+                                     maxiter=500, plan="auto")
+        assert np.asarray(res.converged).all()
+        assert np.max(np.abs(np.asarray(res.x) - x_true)) < 1e-6
+
+    def test_jacobi_lanes_match_singles(self):
+        from cuda_mpi_parallel_tpu.parallel import (
+            solve_distributed,
+            solve_distributed_many,
+        )
+
+        a = mmio.load_matrix_market(FIXTURE)
+        _, b = _stack_system(a, 3, seed=5)
+        mesh = self._mesh()
+        many = solve_distributed_many(a, b, mesh=mesh, tol=1e-9,
+                                      maxiter=500,
+                                      preconditioner="jacobi")
+        single = solve_distributed(a, b[:, 1], mesh=mesh, tol=1e-9,
+                                   maxiter=500,
+                                   preconditioner="jacobi")
+        assert int(single.iterations) == int(many.iterations[1])
+        np.testing.assert_allclose(np.asarray(single.x),
+                                   np.asarray(many.x[:, 1]),
+                                   rtol=0, atol=1e-12)
+
+    def test_refusals(self):
+        from cuda_mpi_parallel_tpu.parallel import solve_distributed_many
+
+        a = mmio.load_matrix_market(FIXTURE)
+        mesh = self._mesh()
+        s = Stencil2D.create(16, 16, dtype=jnp.float64)
+        with pytest.raises(TypeError, match="CSRMatrix"):
+            solve_distributed_many(s, np.ones((256, 2)), mesh=mesh)
+        with pytest.raises(ValueError, match="column stack"):
+            solve_distributed_many(a, np.ones(240), mesh=mesh)
+        with pytest.raises(ValueError, match="jacobi"):
+            solve_distributed_many(a, np.ones((240, 2)), mesh=mesh,
+                                   preconditioner="chebyshev")
+        with pytest.raises(ValueError, match="ring"):
+            solve_distributed_many(a, np.ones((240, 2)), mesh=mesh,
+                                   exchange="ring")
+        with pytest.raises(ValueError, match="batched flight"):
+            solve_distributed_many(
+                a, np.ones((240, 2)), mesh=mesh, method="block",
+                flight=FlightConfig(capacity=8))
+
+
+@needs_mesh
+class TestManyRhsCLI:
+    def _clean(self):
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+        from cuda_mpi_parallel_tpu.telemetry.shardscope import (
+            reset_last_shard_report,
+        )
+
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        dist_cg.clear_solver_cache()
+        reset_last_shard_report()
+
+    def test_mesh4_rhs_record(self, capsys):
+        from cuda_mpi_parallel_tpu import cli
+
+        try:
+            rc = cli.main(["--problem", "mm", "--file", FIXTURE,
+                           "--dtype", "float64", "--mesh", "4",
+                           "--rhs", "4", "--rhs-method", "block",
+                           "--exchange", "gather", "--tol", "1e-8",
+                           "--metrics", "--json"])
+        finally:
+            self._clean()
+        assert rc == 0
+        rec = json.loads(capsys.readouterr().out)
+        assert rec["n_rhs"] == 4
+        assert rec["rhs_method"] == "block"
+        assert rec["converged"] is True
+        assert rec["rhs_fallback"] is False
+        lanes = rec["lanes"]
+        assert len(lanes["iterations"]) == 4
+        assert all(s == "CONVERGED" for s in lanes["status"])
+        assert all(e < 1e-5 for e in lanes["max_abs_error"])
+        assert rec["comm"]["exchange"] == "gather"
+        assert rec["comm"]["n_shards"] == 4
+        assert rec["rhs_iters_per_sec"] > 0
+
+    def test_single_device_rhs_flight_record(self, capsys):
+        from cuda_mpi_parallel_tpu import cli
+
+        try:
+            rc = cli.main(["--problem", "poisson2d", "--n", "16",
+                           "--dtype", "float64", "--rhs", "3",
+                           "--flight-record", "--tol", "1e-9",
+                           "--json"])
+        finally:
+            self._clean()
+        assert rc == 0
+        rec = json.loads(capsys.readouterr().out)
+        assert rec["n_rhs"] == 3
+        assert rec["lanes"]["health"] == ["CONVERGED"] * 3
+        assert rec["flight"]["n_records"] > 2
+
+    def test_refusal_matrix(self):
+        from cuda_mpi_parallel_tpu import cli
+
+        cases = [
+            (["--rhs-method", "block"], "needs --rhs"),
+            (["--rhs", "2", "--engine", "resident"], "resident"),
+            (["--rhs", "2", "--engine", "streaming"], "streaming"),
+            (["--rhs", "2", "--dtype", "df64"], "df64"),
+            (["--rhs", "2", "--history"], "flight-record"),
+            (["--rhs", "2", "--method", "cg1"], "batched"),
+            (["--rhs", "2", "--mesh", "4", "--csr-comm",
+              "ring-shiftell"], "ring"),
+            (["--rhs", "2", "--format", "shiftell"], "shiftell"),
+            (["--rhs", "2", "--rhs-method", "block",
+              "--flight-record"], "block"),
+            (["--rhs", "2", "--flight-record", "--flight-heartbeat",
+              "50"], "heartbeat"),
+            (["--rhs", "2", "--mesh", "4", "--repeat", "2"],
+             "repeat"),
+            (["--rhs", "2", "--mesh", "4", "--precond", "chebyshev"],
+             "jacobi or none"),
+        ]
+        base = ["--problem", "poisson2d", "--n", "8",
+                "--dtype", "float64"]
+        for extra, needle in cases:
+            with pytest.raises(SystemExit, match=needle):
+                cli.main(base + extra)
+        # stencil operators refuse on a mesh (no batched halo path)
+        with pytest.raises(SystemExit, match="matrix-free"):
+            cli.main(base + ["--rhs", "2", "--mesh", "4",
+                             "--matrix-free"])

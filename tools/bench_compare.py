@@ -99,6 +99,23 @@ METRIC_DIRECTION = {
     "exchange.allgather_iters_per_sec": None,
     "exchange.gather_iters_per_sec": None,
     "exchange.padding_fraction": None,
+    # many-RHS columns (PR 8, solver.many): aggregate lane-iterations
+    # per second at k = 1/8/32, the sequential-loop baseline, block-CG
+    # vs masked-batched iteration counts, and the per-solve wire
+    # amortization of a batched mesh solve.  Reported, never gated -
+    # throughput tracks host weather, iteration counts track the bench
+    # problem; pre-PR-8 files simply lack them (rendered n/a).
+    "rhs_iters_per_sec_k1": None,
+    "rhs_iters_per_sec_k8": None,
+    "rhs_iters_per_sec_k32": None,
+    "sequential_rhs_iters_per_sec_k8": None,
+    "amortization_x_k8": None,
+    "batched_iterations_k8": None,
+    "block_iterations_k8": None,
+    "block_rhs_iters_per_sec_k8": None,
+    "many_wire.wire_bytes_per_solve_batched": None,
+    "many_wire.wire_bytes_per_solve_sequential8": None,
+    "many_wire.wire_amortization_x": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -140,6 +157,9 @@ _NESTED = {
                  "gather_wire_bytes_per_iter",
                  "allgather_iters_per_sec", "gather_iters_per_sec",
                  "padding_fraction"),
+    "many_wire": ("wire_bytes_per_solve_batched",
+                  "wire_bytes_per_solve_sequential8",
+                  "wire_amortization_x"),
 }
 
 
