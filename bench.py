@@ -747,6 +747,7 @@ SECTION_PRIORITY = [
     "dense_spd_1024",
     "distributed",
     "many_rhs",                            # batched-RHS amortization
+    "serve",                               # solver-service replay
     "unstructured",
     "poisson2d_1M_csr",                    # ~92 ms/iter gather: last
 ]
@@ -1601,6 +1602,80 @@ def bench_all(results, sections=None) -> None:
         results["many_rhs"] = entry
 
     registry.append(("many_rhs", s_many_rhs))
+
+    # 7: the microbatching solver service (serve/, ROADMAP 1b): an
+    # offered-load Poisson-arrival replay against one registered
+    # operator, k up to 32.  Whole-replay walls - the service's value
+    # IS converting an arrival process into batched sweeps, which a
+    # per-solve measurement cannot see.  Reported: aggregate solved-
+    # RHS/s, p50/p95 latency, occupancy, and the same workload through
+    # a max_batch=1 service (the sequential dispatch baseline) - the
+    # >= 2x service-vs-sequential acceptance rides the speedup column.
+    def s_serve():
+        from cuda_mpi_parallel_tpu.serve import (
+            ServiceConfig,
+            SolverService,
+            rhs_for,
+            synthetic_poisson,
+        )
+
+        grid = 128                 # 16384 unknowns, same as many_rhs
+        a2 = poisson.poisson_2d_csr(grid, grid, dtype=np.float32)
+        tol = 1e-3
+        workload = synthetic_poisson(64, 4000.0, seed=10)
+        prepared = [(r, rhs_for(a2, r.seed, dtype=np.float32)[0])
+                    for r in workload]
+
+        def replay(max_batch):
+            svc = SolverService(ServiceConfig(
+                max_batch=max_batch, max_wait_s=0.002,
+                queue_limit=512, maxiter=600, check_every=8))
+            try:
+                h = svc.register(a2)
+                t0 = time.perf_counter()
+                futs = []
+                for r, b in prepared:
+                    delay = (t0 + r.t) - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    futs.append(svc.submit(h, b, tol=tol))
+                svc.drain()
+                window = time.perf_counter() - t0
+                solved = sum(1 for f in futs
+                             if f.result().converged)
+                stats = svc.stats()
+            finally:
+                svc.close()
+            return solved / max(window, 1e-9), stats, solved
+
+        rate_b, stats_b, solved_b = replay(32)
+        rate_1, stats_1, solved_1 = replay(1)
+        lat = stats_b["latency"]
+        entry = {
+            "n": int(a2.shape[0]), "tol": tol,
+            "measurement": "replay_wall", "requests": len(workload),
+            "converged": solved_b == len(workload)
+            and solved_1 == len(workload),
+            "note": "64-request Poisson replay @4000/s, max_batch 32 "
+                    "vs the same workload at max_batch 1",
+            "serve": {
+                "solved_rhs_per_sec": round(rate_b, 1),
+                "unbatched_rhs_per_sec": round(rate_1, 1),
+                "speedup_vs_unbatched": round(
+                    rate_b / max(rate_1, 1e-9), 2),
+                "p50_latency_s": lat["p50_s"],
+                "p95_latency_s": lat["p95_s"],
+                "p99_latency_s": lat["p99_s"],
+                "occupancy_mean": round(stats_b["occupancy_mean"], 3),
+                "padding_fraction": round(
+                    stats_b["padding_fraction"], 3),
+                "batches": stats_b["batches"],
+                "timeouts": stats_b["timeouts"],
+            },
+        }
+        results["serve"] = entry
+
+    registry.append(("serve", s_serve))
 
     known = {name for name, _ in registry}
     if sections:

@@ -15,11 +15,15 @@ Examples::
     python -m cuda_mpi_parallel_tpu.cli --problem mm --file thermal2.mtx \
         --precond jacobi --json
     python -m cuda_mpi_parallel_tpu.cli lint cuda_mpi_parallel_tpu/
+    python -m cuda_mpi_parallel_tpu.cli serve --problem poisson2d \
+        --n 32 --requests 32 --rate 2000 --max-batch 8
 
 The ``lint`` subcommand mounts the graftlint static-analysis suite
 (``cuda_mpi_parallel_tpu.analysis``): Mosaic tiling, VMEM budgets,
 collective safety, DMA pairing, host-sync - the pre-hardware gate for
-new kernels.
+new kernels.  The ``serve`` subcommand replays a workload through the
+microbatching solver service (``cuda_mpi_parallel_tpu.serve``) and
+prints its throughput/latency/occupancy report.
 """
 from __future__ import annotations
 
@@ -371,6 +375,12 @@ def main(argv=None) -> int:
         from .analysis.__main__ import main as lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # the microbatching solver service's workload replay (serve.cli)
+        # - its own flag surface, so dispatch before parsing too
+        from .serve.cli import main as serve_main
+
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.mesh > 1 and args.device != "tpu":
         # must run BEFORE the first backend touch (jax reads XLA_FLAGS

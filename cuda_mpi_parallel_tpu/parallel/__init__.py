@@ -10,6 +10,7 @@ from .streaming import (
     solve_distributed_streaming_df64,
 )
 from .dist_cg import (
+    ManyRHSDispatcher,
     SequenceResult,
     solve_distributed,
     solve_distributed_many,
@@ -61,6 +62,7 @@ __all__ = [
     "DistStencil3DPencil",
     "DistStencilDF64",
     "GatherSchedule",
+    "ManyRHSDispatcher",
     "PartitionedCSR",
     "RingPartitionedCSR",
     "SequenceResult",

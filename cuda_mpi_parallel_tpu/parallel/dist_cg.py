@@ -20,7 +20,9 @@ assert trajectory equality between them).
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
+import threading
 from functools import partial
 from typing import Optional, Tuple
 
@@ -242,9 +244,43 @@ def solve_distributed(
 #: full retrace+compile each time.  Array leaves (b, operator data, the
 #: stencil scale) are ARGUMENTS of the cached function, so jit's own
 #: signature cache handles shape/dtype changes; everything static lives in
-#: the key.  Unbounded, but one entry per distinct (operator structure,
-#: mesh, config) - a handful in any real process.
-_SOLVER_CACHE: dict = {}
+#: the key.  LRU-bounded (DIST_CACHE_CAP_ENV, default
+#: DEFAULT_DIST_CACHE_CAP): a long-running solver service registering
+#: many operators must not leak compiled traces - least-recently-HIT
+#: entries are dropped with a ``dist_cache_evict`` event, and a later
+#: identical solve simply re-traces (a miss, never an error).
+#: Mutations go through _CACHE_LOCK: the solver service's worker
+#: thread dispatches through this cache while registrations warm new
+#: operators from the caller thread, and the LRU's multi-step ops
+#: (get + move_to_end, insert + evict) are not GIL-atomic the way the
+#: old plain-dict get/set were.
+_SOLVER_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+
+_CACHE_LOCK = threading.Lock()
+
+#: env override for the compiled-solver LRU capacity (entries, >= 1)
+DIST_CACHE_CAP_ENV = "CUDA_MPI_PARALLEL_TPU_DIST_CACHE_CAP"
+DEFAULT_DIST_CACHE_CAP = 64
+
+
+def _dist_cache_cap() -> int:
+    """The LRU capacity, re-read per consultation so a service can be
+    re-tuned by env without a restart (and tests can shrink it)."""
+    import os
+
+    raw = os.environ.get(DIST_CACHE_CAP_ENV)
+    if not raw:
+        return DEFAULT_DIST_CACHE_CAP
+    try:
+        cap = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{DIST_CACHE_CAP_ENV}={raw!r} is not an integer")
+    if cap < 1:
+        raise ValueError(
+            f"{DIST_CACHE_CAP_ENV} must be >= 1, got {cap} (the cache "
+            f"must hold at least the in-flight solver)")
+    return cap
 
 #: per-key jaxpr-derived communication cost (telemetry.cost.SolveCost),
 #: computed at build time only when telemetry is active - an extra
@@ -263,8 +299,9 @@ _TRACE_COUNT = [0]
 
 
 def clear_solver_cache() -> None:
-    _SOLVER_CACHE.clear()
-    _COST_CACHE.clear()
+    with _CACHE_LOCK:
+        _SOLVER_CACHE.clear()
+        _COST_CACHE.clear()
     _LAST_COMM_COST[0] = None
 
 
@@ -324,14 +361,40 @@ def _cached_solver(key, build, cost_ctx=None, cost_args=None):
     """
     from .. import telemetry
 
-    fn = _SOLVER_CACHE.get(key)
+    with _CACHE_LOCK:
+        fn = _SOLVER_CACHE.get(key)
+        if fn is not None:
+            _SOLVER_CACHE.move_to_end(key)   # most-recently-hit
     hit = fn is not None
     hits, misses = _cache_metrics()
     (hits if hit else misses).inc(phase=telemetry.events.scope_phase())
     telemetry.events.emit("dist_cache_hit" if hit else "dist_cache_miss",
                           key=_key_id(key), kind=key[0])
     if fn is None:
-        fn = _SOLVER_CACHE[key] = jax.jit(build())
+        built = jax.jit(build())     # trace setup outside the lock
+        cap = _dist_cache_cap()
+        evictions = []
+        with _CACHE_LOCK:
+            fn = _SOLVER_CACHE.get(key)   # a racing builder may have won
+            if fn is None:
+                fn = _SOLVER_CACHE[key] = built
+            while len(_SOLVER_CACHE) > cap:
+                # least-recently-HIT first; the eviction is loud -
+                # event + counter - because a service whose working
+                # set exceeds the cap re-compiles every solve
+                evicted, _ = _SOLVER_CACHE.popitem(last=False)
+                _COST_CACHE.pop(evicted, None)
+                evictions.append(evicted)
+        for evicted in evictions:
+            from ..telemetry.registry import REGISTRY
+
+            REGISTRY.counter(
+                "dist_solver_cache_evictions_total",
+                "compiled distributed solvers dropped by the LRU cap "
+                f"({DIST_CACHE_CAP_ENV})").inc()
+            telemetry.events.emit("dist_cache_evict",
+                                  key=_key_id(evicted), kind=evicted[0],
+                                  cap=cap)
     if cost_args is not None and telemetry.active():
         solve_cost = _COST_CACHE.get(key)
         if solve_cost is None:
@@ -808,6 +871,204 @@ def _result_specs_many(axis: str, flight=None,
         fallback=P() if fallback else None)
 
 
+class ManyRHSDispatcher:
+    """Partition-once, dispatch-many: the static half of
+    :func:`solve_distributed_many` resolved ONCE.
+
+    A serving workload dispatches hundreds of batches against one
+    operator; re-validating the plan, re-applying the row permutation
+    and re-running ``partition_csr`` (all O(nnz) host work) per batch
+    would dominate the dispatch path that the compiled-solver cache
+    exists to make cheap.  Constructing a dispatcher pays that setup
+    exactly once - plan resolution, symmetric permutation, partition,
+    gather-schedule compilation, device sharding of the matrix arrays -
+    and :meth:`solve` then only pads/shards ``b`` and consults the
+    solver cache.  ``solve_distributed_many`` is a thin
+    construct-and-solve wrapper, so one-shot callers are unchanged;
+    the solver service holds one dispatcher per registered handle.
+    """
+
+    def __init__(self, a, *, mesh: Optional[Mesh] = None,
+                 n_devices: Optional[int] = None, maxiter: int = 2000,
+                 preconditioner: Optional[str] = None,
+                 method: str = "batched", check_every: int = 1,
+                 compensated: bool = False, flight=None, plan=None,
+                 exchange=None):
+        from ..solver.many import MANY_METHODS
+
+        if mesh is None:
+            mesh = make_mesh(n_devices)
+        if len(mesh.axis_names) != 1:
+            raise ValueError(
+                "solve_distributed_many runs on a 1-D mesh (the pencil "
+                "decomposition is stencil-only, and stencils are "
+                "single-RHS here)")
+        if not isinstance(a, CSRMatrix):
+            raise TypeError(
+                f"solve_distributed_many supports assembled CSRMatrix "
+                f"problems; {type(a).__name__} operators are "
+                f"single-RHS on a mesh (use solve_distributed per "
+                f"column)")
+        if method not in MANY_METHODS:
+            raise ValueError(f"unknown method {method!r}; expected one "
+                             f"of {MANY_METHODS}")
+        if preconditioner not in (None, "jacobi"):
+            raise ValueError(
+                f"solve_distributed_many supports preconditioner None "
+                f"or 'jacobi' (got {preconditioner!r}); the "
+                f"chebyshev/mg applications are single-vector on a "
+                f"mesh")
+        if exchange not in (None, "auto", "gather", "allgather"):
+            raise ValueError(
+                f"unknown exchange: {exchange!r} (expected 'auto', "
+                f"'gather', 'allgather' or None; the ring schedules "
+                f"rotate single x-blocks and do not batch)")
+        if flight is not None:
+            if method != "batched":
+                raise ValueError(
+                    "the batched flight recorder needs "
+                    "method='batched' (block-CG's recurrence scalars "
+                    "are k x k matrices)")
+            flight = flight.without_heartbeat()
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.n_shards = int(mesh.devices.size)
+        self.n = int(a.shape[0])
+        self.maxiter = int(maxiter)
+        self.preconditioner = preconditioner
+        self.method = method
+        self.check_every = int(check_every)
+        self.compensated = bool(compensated)
+        self.flight = flight
+        self.plan = resolve_plan(
+            plan, a, self.n_shards,
+            exchange=_plan_exchange_hint("allgather", exchange))
+        self._perm = (self.plan.permutation
+                      if self.plan is not None else None)
+        ap = a.permuted(self._perm) if self._perm is not None else a
+        ranges = (self.plan.row_ranges
+                  if self.plan is not None else None)
+        self.parts = part.partition_csr(
+            ap, self.n_shards, ranges,
+            exchange=_resolve_exchange_mode(exchange, self.plan))
+        self.resolved_exchange = ("gather"
+                                  if self.parts.halo is not None
+                                  else "allgather")
+        _note_partition(ap, self.parts, self.plan)
+        self._data = _shard_tree(self.parts.data, mesh, self.axis)
+        self._cols = _shard_tree(self.parts.cols, mesh, self.axis)
+        self._rows = _shard_tree(self.parts.local_rows, mesh,
+                                 self.axis)
+        sched = self.parts.halo
+        self._gather = sched is not None
+        self._send = tuple(_shard_tree(r.send_idx, mesh, self.axis)
+                           for r in sched.rounds) if self._gather \
+            else ()
+        self._shifts = tuple(r.shift for r in sched.rounds) \
+            if self._gather else ()
+        geometry = tuple((r.shift, r.m) for r in sched.rounds) \
+            if self._gather else None
+        # everything but n_rhs: the per-bucket key appends it in solve
+        self._key_base = (
+            "csr-many", method, self.resolved_exchange, geometry,
+            self.parts.n_local, self.n_shards, self.axis, mesh,
+            preconditioner, self.check_every, self.compensated,
+            flight, self.maxiter,
+            self.plan.fingerprint() if self.plan is not None else None)
+
+    def solve(self, b, *, tol=1e-7, rtol=0.0):
+        """One batched solve of ``A X = B`` on the prepared partition
+        (``B (n, k)``; see :func:`solve_distributed_many` for the
+        result contract)."""
+        from ..solver.cg import _note_engine
+        from ..solver.many import cg_many
+
+        # host-side validation/permutation works on the numpy view
+        # directly: a jnp.asarray here would commit b to device only
+        # to copy it straight back for the row permutation (this is
+        # the per-dispatch hot path the dispatcher exists to thin)
+        b_np = np.asarray(b)
+        if b_np.ndim != 2:
+            raise ValueError(
+                f"solve_distributed_many solves a column stack: b "
+                f"must be (n, k), got shape {b_np.shape}")
+        if self.n != b_np.shape[0]:
+            raise ValueError(
+                f"operator has {self.n} rows, rhs stack has shape "
+                f"{b_np.shape}")
+        if not np.issubdtype(b_np.dtype, np.floating):
+            b_np = b_np.astype(np.result_type(float))
+        n_rhs = int(b_np.shape[1])
+        _note_engine("distributed-many", self.method, self.check_every,
+                     n_shards=self.n_shards, n_rhs=n_rhs,
+                     **({"flight_stride": self.flight.stride}
+                        if self.flight is not None else {}))
+        if self._perm is not None:
+            b_np = b_np[self._perm]
+        b_dev = _shard_padded_rhs(b_np, self.parts, self.mesh,
+                                  self.axis)
+        tol_dev = jnp.asarray(tol, b_np.dtype)
+        rtol_dev = jnp.asarray(rtol, b_np.dtype)
+        mesh, axis, gather = self.mesh, self.axis, self._gather
+        n_local, n_shards = self.parts.n_local, self.n_shards
+        shifts, flight, method = self._shifts, self.flight, self.method
+        preconditioner = self.preconditioner
+        maxiter, check_every = self.maxiter, self.check_every
+        compensated = self.compensated
+        key = self._key_base + (n_rhs,)
+
+        def build():
+            specs = (P(axis),) * 4 + (P(), P()) \
+                + ((P(axis),) if gather else ())
+
+            @partial(shard_map, mesh=mesh, in_specs=specs,
+                     out_specs=_result_specs_many(
+                         axis, flight, fallback=method == "block"))
+            def run(b_local, data_s, cols_s, rows_s, tol_s, rtol_s,
+                    send_s=()):
+                _TRACE_COUNT[0] += 1
+                strip = partial(jax.tree.map, lambda v: v[0])
+                if gather:
+                    op = DistCSRGather(
+                        data=strip(data_s), cols=strip(cols_s),
+                        local_rows=strip(rows_s),
+                        send_idx=strip(send_s), shifts=shifts,
+                        n_local=n_local, axis_name=axis,
+                        n_shards=n_shards)
+                else:
+                    op = DistCSR(data=strip(data_s),
+                                 cols=strip(cols_s),
+                                 local_rows=strip(rows_s),
+                                 n_local=n_local, axis_name=axis,
+                                 n_shards=n_shards)
+                m = _make_precond((preconditioner, 0), op, axis)
+                return cg_many(op, b_local, tol=tol_s, rtol=rtol_s,
+                               maxiter=maxiter, m=m, axis_name=axis,
+                               check_every=check_every, method=method,
+                               compensated=compensated, flight=flight)
+            return run
+
+        ctx = dict(kind="csr-gather-many" if gather else "csr-many",
+                   check_every=check_every, method=method,
+                   n_shards=n_shards, n_rhs=n_rhs,
+                   exchange=self.resolved_exchange,
+                   **({"plan": self.plan.label}
+                      if self.plan is not None else {}))
+        if gather:
+            sched = self.parts.halo
+            itemsize = np.asarray(self.parts.data).dtype.itemsize
+            ctx["halo_padding_fraction"] = \
+                round(sched.padding_fraction(), 6)
+            # the per-round slabs carry k columns each: the padded
+            # per-matvec wire scales by n_rhs, amortized per solve 1/k
+            ctx["halo_wire_bytes_per_matvec"] = \
+                sched.wire_bytes_per_matvec(itemsize) * n_rhs
+        args = (b_dev, self._data, self._cols, self._rows, tol_dev,
+                rtol_dev) + ((self._send,) if gather else ())
+        res = _cached_solver(key, build, ctx, args)(*args)
+        return _unpad_result_many(res, self.parts, self.plan)
+
+
 def solve_distributed_many(
     a,
     b,
@@ -844,133 +1105,17 @@ def solve_distributed_many(
     the batched per-lane recorder (``method="batched"`` only).
 
     Returns a ``solver.many.CGBatchResult`` whose ``x`` is the global
-    ``(n, k)`` solution stack.
+    ``(n, k)`` solution stack.  Repeat callers solving many batches
+    against one operator should construct a
+    :class:`ManyRHSDispatcher` once instead - this wrapper re-runs the
+    host-side partition work per call.
     """
-    from ..solver.many import MANY_METHODS, cg_many
-
-    if mesh is None:
-        mesh = make_mesh(n_devices)
-    if len(mesh.axis_names) != 1:
-        raise ValueError(
-            "solve_distributed_many runs on a 1-D mesh (the pencil "
-            "decomposition is stencil-only, and stencils are "
-            "single-RHS here)")
-    if not isinstance(a, CSRMatrix):
-        raise TypeError(
-            f"solve_distributed_many supports assembled CSRMatrix "
-            f"problems; {type(a).__name__} operators are single-RHS "
-            f"on a mesh (use solve_distributed per column)")
-    if method not in MANY_METHODS:
-        raise ValueError(f"unknown method {method!r}; expected one of "
-                         f"{MANY_METHODS}")
-    if preconditioner not in (None, "jacobi"):
-        raise ValueError(
-            f"solve_distributed_many supports preconditioner None or "
-            f"'jacobi' (got {preconditioner!r}); the chebyshev/mg "
-            f"applications are single-vector on a mesh")
-    if exchange not in (None, "auto", "gather", "allgather"):
-        raise ValueError(
-            f"unknown exchange: {exchange!r} (expected 'auto', "
-            f"'gather', 'allgather' or None; the ring schedules "
-            f"rotate single x-blocks and do not batch)")
-    b = jnp.asarray(b)
-    if b.ndim != 2:
-        raise ValueError(
-            f"solve_distributed_many solves a column stack: b must be "
-            f"(n, k), got shape {b.shape}")
-    if a.shape[1] != b.shape[0]:
-        raise ValueError(f"operator shape {a.shape} does not match rhs "
-                         f"stack shape {b.shape}")
-    if flight is not None:
-        if method != "batched":
-            raise ValueError(
-                "the batched flight recorder needs method='batched' "
-                "(block-CG's recurrence scalars are k x k matrices)")
-        flight = flight.without_heartbeat()
-    n_rhs = int(b.shape[1])
-    axis = mesh.axis_names[0]
-    n_shards = mesh.devices.size
-
-    plan = resolve_plan(plan, a, n_shards,
-                        exchange=_plan_exchange_hint("allgather",
-                                                     exchange))
-    from ..solver.cg import _note_engine
-
-    _note_engine("distributed-many", method, check_every,
-                 n_shards=int(n_shards), n_rhs=n_rhs,
-                 **({"flight_stride": flight.stride}
-                    if flight is not None else {}))
-
-    a, b = _apply_plan_permutation(a, b, plan)
-    ranges = plan.row_ranges if plan is not None else None
-    parts = part.partition_csr(
-        a, n_shards, ranges,
-        exchange=_resolve_exchange_mode(exchange, plan))
-    resolved = "gather" if parts.halo is not None else "allgather"
-    _note_partition(a, parts, plan)
-    b_dev = _shard_padded_rhs(b, parts, mesh, axis)
-    data = _shard_tree(parts.data, mesh, axis)
-    cols = _shard_tree(parts.cols, mesh, axis)
-    rows = _shard_tree(parts.local_rows, mesh, axis)
-
-    n_local = parts.n_local
-    sched = parts.halo
-    gather = sched is not None
-    geometry = tuple((r.shift, r.m) for r in sched.rounds) \
-        if gather else None
-    key = ("csr-many", method, n_rhs, resolved, geometry, n_local,
-           n_shards, axis, mesh, preconditioner, check_every,
-           compensated, flight, maxiter,
-           plan.fingerprint() if plan is not None else None)
-    send = tuple(_shard_tree(r.send_idx, mesh, axis)
-                 for r in sched.rounds) if gather else ()
-    shifts = tuple(r.shift for r in sched.rounds) if gather else ()
-    tol_dev = jnp.asarray(tol, b.dtype)
-    rtol_dev = jnp.asarray(rtol, b.dtype)
-
-    def build():
-        specs = (P(axis),) * 4 + (P(), P()) \
-            + ((P(axis),) if gather else ())
-
-        @partial(shard_map, mesh=mesh, in_specs=specs,
-                 out_specs=_result_specs_many(
-                     axis, flight, fallback=method == "block"))
-        def run(b_local, data_s, cols_s, rows_s, tol_s, rtol_s,
-                send_s=()):
-            _TRACE_COUNT[0] += 1
-            strip = partial(jax.tree.map, lambda v: v[0])
-            if gather:
-                op = DistCSRGather(
-                    data=strip(data_s), cols=strip(cols_s),
-                    local_rows=strip(rows_s), send_idx=strip(send_s),
-                    shifts=shifts, n_local=n_local, axis_name=axis,
-                    n_shards=n_shards)
-            else:
-                op = DistCSR(data=strip(data_s), cols=strip(cols_s),
-                             local_rows=strip(rows_s), n_local=n_local,
-                             axis_name=axis, n_shards=n_shards)
-            m = _make_precond((preconditioner, 0), op, axis)
-            return cg_many(op, b_local, tol=tol_s, rtol=rtol_s,
-                           maxiter=maxiter, m=m, axis_name=axis,
-                           check_every=check_every, method=method,
-                           compensated=compensated, flight=flight)
-        return run
-
-    ctx = dict(kind="csr-gather-many" if gather else "csr-many",
-               check_every=check_every, method=method,
-               n_shards=int(n_shards), n_rhs=n_rhs, exchange=resolved,
-               **({"plan": plan.label} if plan is not None else {}))
-    if gather:
-        itemsize = np.asarray(parts.data).dtype.itemsize
-        ctx["halo_padding_fraction"] = round(sched.padding_fraction(), 6)
-        # the per-round slabs now carry k columns each: the padded
-        # per-matvec wire scales by n_rhs, amortized per solve by 1/k
-        ctx["halo_wire_bytes_per_matvec"] = \
-            sched.wire_bytes_per_matvec(itemsize) * n_rhs
-    args = (b_dev, data, cols, rows, tol_dev, rtol_dev) \
-        + ((send,) if gather else ())
-    res = _cached_solver(key, build, ctx, args)(*args)
-    return _unpad_result_many(res, parts, plan)
+    return ManyRHSDispatcher(
+        a, mesh=mesh, n_devices=n_devices, maxiter=maxiter,
+        preconditioner=preconditioner, method=method,
+        check_every=check_every, compensated=compensated,
+        flight=flight, plan=plan, exchange=exchange,
+    ).solve(b, tol=tol, rtol=rtol)
 
 
 def _unpad_result_many(res, parts, plan):

@@ -1,0 +1,54 @@
+"""serve: the microbatching solver service (ROADMAP item 1b).
+
+The request-queue front end of the many-RHS tier: register an operator
+once (partition + plan + per-bucket trace warmup), then submit repeat
+``(matrix-fingerprint, b)`` traffic and let the microbatch policy
+coalesce it onto ``solve_many`` / ``solve_distributed_many`` - one
+matrix sweep and one halo exchange per iteration serving every queued
+column.  See :mod:`.service` for the service itself, :mod:`.queue`
+for the batching policy, and :mod:`.workload` for replayable
+arrival-time workloads (the ``cli.py serve`` surface).
+"""
+from __future__ import annotations
+
+from .queue import (
+    Batch,
+    MicroBatchQueue,
+    QueueFull,
+    bucket_for,
+    bucket_sizes,
+    tol_class,
+)
+from .service import (
+    OperatorHandle,
+    RequestResult,
+    ServiceClosed,
+    ServiceConfig,
+    SolverService,
+)
+from .workload import (
+    WorkloadRequest,
+    load_workload,
+    rhs_for,
+    save_workload,
+    synthetic_poisson,
+)
+
+__all__ = [
+    "Batch",
+    "MicroBatchQueue",
+    "OperatorHandle",
+    "QueueFull",
+    "RequestResult",
+    "ServiceClosed",
+    "ServiceConfig",
+    "SolverService",
+    "WorkloadRequest",
+    "bucket_for",
+    "bucket_sizes",
+    "load_workload",
+    "rhs_for",
+    "save_workload",
+    "synthetic_poisson",
+    "tol_class",
+]

@@ -1,0 +1,227 @@
+"""Microbatching policy: per-key FIFO queues cut into lane buckets.
+
+This module is the pure, host-only half of the solver service - no
+jax, no threads, no wall clock of its own.  Every method takes ``now``
+explicitly, so the policy is deterministic under a fake clock (the
+test harness) and the service's worker thread is just a driver that
+feeds it real time.
+
+Policy (ROADMAP item 1b):
+
+* requests queue per ``(handle, dtype, tol-class)`` - only columns
+  that can ride ONE compiled batched solve share a queue;
+* a queue dispatches when it holds ``max_batch`` requests (reason
+  ``"full"``) OR when its oldest request has waited ``max_wait_s``
+  (reason ``"max_wait"``) - the classic latency/occupancy knob pair;
+* a cut batch is padded up to the smallest LANE BUCKET that fits
+  (powers of two up to ``max_batch``, :func:`bucket_sizes`), so the
+  set of compiled batch shapes is bounded and every post-warmup
+  dispatch is a solver-cache hit by construction.  Pad lanes carry
+  ``b = 0`` and freeze at iteration 0 (``solver.many.stack_columns``);
+* per-request deadlines: an expired request is failed LOUDLY with a
+  typed TIMEOUT result at the next pump, never silently dropped and
+  never dispatched into a solve whose answer nobody wants;
+* backpressure: the total pending count is bounded
+  (``queue_limit``) - :meth:`MicroBatchQueue.push` raises
+  :class:`QueueFull` rather than buffering unboundedly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import OrderedDict, deque
+from typing import Deque, List, Optional, Tuple
+
+__all__ = [
+    "Batch",
+    "MicroBatchQueue",
+    "QueueFull",
+    "QueuedRequest",
+    "bucket_for",
+    "bucket_sizes",
+    "tol_class",
+]
+
+
+class QueueFull(RuntimeError):
+    """The service's bounded queue is at ``queue_limit`` - the caller
+    must shed load (retry later / reject upstream), not buffer more."""
+
+
+def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
+    """The compiled lane buckets: powers of two up to ``max_batch``,
+    plus ``max_batch`` itself when it is not one.  Bounded and known
+    at registration time, so a service can warm every shape it will
+    ever dispatch."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    sizes: List[int] = []
+    k = 1
+    while k < max_batch:
+        sizes.append(k)
+        k *= 2
+    sizes.append(max_batch)
+    return tuple(sizes)
+
+
+def bucket_for(n_requests: int, max_batch: int) -> int:
+    """The smallest lane bucket holding ``n_requests`` columns."""
+    if n_requests < 1:
+        raise ValueError(f"a batch needs >= 1 request, got {n_requests}")
+    for k in bucket_sizes(max_batch):
+        if k >= n_requests:
+            return k
+    raise ValueError(
+        f"{n_requests} requests exceed max_batch={max_batch}")
+
+
+def tol_class(tol: float) -> str:
+    """The decade class of an absolute tolerance - the queue-key
+    component that keeps wildly different convergence bars out of one
+    batch.  Correctness never depends on it: each lane always solves
+    to its OWN ``tol`` (per-lane tolerance arrays), the class only
+    groups requests whose iteration counts will be comparable, so a
+    loose request is not held hostage by a tight lane."""
+    if tol <= 0.0:
+        return "exact"
+    return f"1e{int(math.floor(math.log10(tol) + 0.5))}"
+
+
+@dataclasses.dataclass
+class QueuedRequest:
+    """One pending right-hand side (host arrays + bookkeeping only)."""
+
+    request_id: str
+    handle_key: str
+    b: object                      # 1-D numpy array
+    dtype: str                     # numpy dtype name of b
+    tol: float
+    enqueue_t: float               # service-clock seconds
+    deadline_t: Optional[float]    # absolute service-clock, or None
+    future: object                 # concurrent.futures.Future
+    handle: object = None          # serve.service.OperatorHandle
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_t is not None and now >= self.deadline_t
+
+
+@dataclasses.dataclass
+class Batch:
+    """A cut microbatch, ready to dispatch onto one batched solve."""
+
+    key: Tuple[str, str, str]      # (handle_key, dtype, tol_class)
+    requests: List[QueuedRequest]
+    bucket: int                    # padded lane count (compiled shape)
+    reason: str                    # "full" | "max_wait" | "drain"
+
+    @property
+    def occupancy(self) -> float:
+        return len(self.requests) / self.bucket
+
+    @property
+    def padding_fraction(self) -> float:
+        return (self.bucket - len(self.requests)) / self.bucket
+
+
+class MicroBatchQueue:
+    """The dispatch policy over per-``(handle, dtype, tol-class)``
+    FIFOs.  Not thread-safe on its own - the service serializes access
+    under its lock."""
+
+    def __init__(self, max_batch: int = 8, max_wait_s: float = 0.002,
+                 queue_limit: int = 256):
+        if max_wait_s < 0:
+            raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
+        if queue_limit < 1:
+            raise ValueError(
+                f"queue_limit must be >= 1, got {queue_limit}")
+        self.max_batch = int(max_batch)
+        self.buckets = bucket_sizes(self.max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.queue_limit = int(queue_limit)
+        self._queues: "OrderedDict[Tuple, Deque[QueuedRequest]]" = \
+            OrderedDict()
+        self._depth = 0
+
+    def depth(self) -> int:
+        """Total pending requests across every queue."""
+        return self._depth
+
+    def key_for(self, req: QueuedRequest) -> Tuple[str, str, str]:
+        return (req.handle_key, req.dtype, tol_class(req.tol))
+
+    def push(self, req: QueuedRequest) -> int:
+        """Enqueue; returns the new total depth.  Raises
+        :class:`QueueFull` at ``queue_limit`` (backpressure is the
+        caller's signal to shed load)."""
+        if self._depth >= self.queue_limit:
+            raise QueueFull(
+                f"solver service queue is full ({self._depth} pending, "
+                f"limit {self.queue_limit}); shed load or raise "
+                f"queue_limit")
+        self._queues.setdefault(self.key_for(req), deque()).append(req)
+        self._depth += 1
+        return self._depth
+
+    def pop_ready(self, now: float, drain: bool = False
+                  ) -> Tuple[List[Batch], List[QueuedRequest]]:
+        """Cut everything the policy says is dispatchable at ``now``.
+
+        Returns ``(batches, timeouts)``: full batches first (oldest
+        queue first), then max-wait expiries (with ``drain=True``,
+        every remaining request regardless of age).  ``timeouts`` are
+        the expired-deadline requests removed from the queues - the
+        caller owes each a typed TIMEOUT result.
+        """
+        batches: List[Batch] = []
+        timeouts: List[QueuedRequest] = []
+        for key in list(self._queues):
+            q = self._queues[key]
+            # expired deadlines leave the queue first: they must not
+            # occupy a lane (their answer is already too late) and
+            # must not hold the max_wait clock of younger requests
+            live = deque()
+            for req in q:
+                (timeouts if req.expired(now) else live).append(req)
+            self._depth -= len(q) - len(live)
+            q = self._queues[key] = live
+            while len(q) >= self.max_batch:
+                cut = [q.popleft() for _ in range(self.max_batch)]
+                self._depth -= len(cut)
+                batches.append(Batch(key=key, requests=cut,
+                                     bucket=self.max_batch,
+                                     reason="full"))
+            if q and (drain
+                      or now - q[0].enqueue_t >= self.max_wait_s):
+                cut = list(q)
+                q.clear()
+                self._depth -= len(cut)
+                batches.append(Batch(
+                    key=key, requests=cut,
+                    bucket=bucket_for(len(cut), self.max_batch),
+                    reason="drain" if drain else "max_wait"))
+            if not q:
+                del self._queues[key]
+        return batches, timeouts
+
+    def next_wake(self, now: float) -> Optional[float]:
+        """The earliest absolute time any policy clause can fire (a
+        max-wait expiry, a request deadline, or NOW when a queue is
+        already full), or ``None`` when the queues are empty.  The
+        worker thread sleeps exactly until this - the full-queue
+        clause matters because a submit's notify is lost while the
+        worker is mid-solve (not waiting): without it, a queue that
+        filled during the solve would sleep out max_wait before its
+        "dispatch on full" batch went."""
+        wake: Optional[float] = None
+        for q in self._queues.values():
+            if not q:
+                continue
+            if len(q) >= self.max_batch:
+                return now
+            candidates = [q[0].enqueue_t + self.max_wait_s]
+            candidates += [r.deadline_t for r in q
+                           if r.deadline_t is not None]
+            t = min(candidates)
+            wake = t if wake is None else min(wake, t)
+        return wake

@@ -1,0 +1,772 @@
+"""The in-process solver service: register once, submit many.
+
+``SolverService`` is the request-queue front end of the many-RHS tier
+(ROADMAP item 1b): production traffic is repeat ``(matrix, b)``
+requests against a small set of operators, and every RHS column that
+rides an already-paid matrix sweep is nearly free (SpMV throughput is
+sustained stream bandwidth, arXiv 2204.00900).  The service converts
+arrival patterns into those batches:
+
+* :meth:`SolverService.register` takes the operator ONCE - partitions,
+  plans (``plan="auto"`` runs the balance planner a single time) and
+  warms the compiled trace for every lane bucket - and returns an
+  :class:`OperatorHandle` keyed by the matrix fingerprint.  Repeat
+  traffic on the handle never re-plans and, after warmup, never
+  re-traces (the ``dist_cg`` solver cache keyed on the plan
+  fingerprint + bucket shape serves every dispatch).
+* :meth:`SolverService.submit` enqueues one right-hand side and
+  returns a ``concurrent.futures.Future`` resolving to a typed
+  :class:`RequestResult`.  The microbatch policy (``serve.queue``)
+  cuts per-``(handle, dtype, tol-class)`` batches on ``max_batch``
+  full or ``max_wait_s`` elapsed, pads to the compiled lane bucket,
+  and dispatches onto ``solver.solve_many`` /
+  ``parallel.solve_distributed_many``.
+* Failures are isolated per lane: a STAGNATED/DIVERGED/MAXITER lane
+  fails only its own request (``CGBatchResult`` carries per-lane
+  status).  Deadlines surface as typed TIMEOUT results, never as
+  worker exceptions.  Backpressure is a bounded queue
+  (``serve.queue.QueueFull``).
+
+Observability from day one: ``request_enqueued`` / ``batch_dispatch``
+/ ``request_done`` events (the batch's events share the underlying
+solve's ``solve_id``), queue-depth / occupancy / padding gauges, and
+request-latency histograms with p50/p95/p99 export
+(``telemetry.registry``).
+
+Clocking: with the default config the service runs a worker thread on
+the monotonic clock.  Passing ``ServiceConfig(clock=...)`` switches to
+MANUAL mode - no thread, the policy advances only on :meth:`pump` -
+which is how the tests drive every timing branch deterministically
+with a fake clock.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..solver.status import CGStatus
+from .queue import (
+    Batch,
+    MicroBatchQueue,
+    QueuedRequest,
+    QueueFull,
+    bucket_sizes,
+    tol_class,
+)
+
+__all__ = [
+    "OperatorHandle",
+    "QueueFull",
+    "RequestResult",
+    "ServiceClosed",
+    "ServiceConfig",
+    "SolverService",
+]
+
+#: request-latency histogram bounds: service traffic is sub-ms queueing
+#: plus ms-scale batched solves, far below the solver-wide defaults
+LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 10.0, 60.0)
+
+
+class ServiceClosed(RuntimeError):
+    """submit() after close(): the service no longer accepts work."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceConfig:
+    """Policy knobs of one :class:`SolverService`.
+
+    ``clock=None`` (default) runs a worker thread on
+    ``time.monotonic``; any callable switches the service to manual
+    mode (no thread - tests drive :meth:`SolverService.pump` with a
+    fake clock, so max_wait/deadline branches are deterministic).
+    """
+
+    max_batch: int = 8
+    max_wait_s: float = 0.002
+    queue_limit: int = 256
+    maxiter: int = 2000
+    check_every: int = 1
+    warm: bool = True
+    clock: Optional[Callable[[], float]] = None
+    #: per-batch dispatch log retained for reports (ring, drop-oldest)
+    keep_batch_log: int = 1024
+    #: exact latency samples retained for stats() percentiles (ring,
+    #: drop-oldest - a long-running service must not grow one float
+    #: per request forever; the registry histogram keeps the full
+    #: cumulative story for scrapes)
+    keep_latency_samples: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestResult:
+    """The typed terminal outcome of one submitted right-hand side.
+
+    ``status`` is a ``CGStatus`` name (per-lane, so one failing lane
+    never contaminates its batchmates), ``"TIMEOUT"`` for a deadline
+    expiry (the request was never dispatched), or ``"ERROR"`` when the
+    batch's engine call itself raised (still a typed RESULT - a future
+    never raises, so ``fut.result()`` loops survive any failure mode;
+    the exception text rides the ``request_done`` event).  ``solve_s``
+    is the batch's wall time - shared by every lane that rode it;
+    ``latency_s = wait_s + solve_s`` is what the service's latency
+    histogram records.
+    """
+
+    request_id: str
+    status: str
+    converged: bool
+    timed_out: bool
+    x: Optional[np.ndarray]
+    iterations: int
+    residual_norm: float
+    wait_s: float
+    solve_s: float
+    latency_s: float
+    bucket: int
+    occupancy: float
+    solve_id: Optional[str]
+
+    @property
+    def ok(self) -> bool:
+        return self.converged and not self.timed_out
+
+
+@dataclasses.dataclass
+class OperatorHandle:
+    """One registered operator: everything a dispatch needs, resolved
+    once at registration (plan, preconditioner, exchange lane, lane
+    buckets, and - on a mesh - the partition-once
+    ``parallel.ManyRHSDispatcher``).  ``key`` - matrix fingerprint +
+    config digest - is the queue key; two registrations of the same
+    matrix under the same config return the SAME handle."""
+
+    key: str
+    fingerprint: str
+    a: object
+    n: int
+    dtype_name: str
+    mesh: Optional[object]
+    plan: Optional[object]
+    exchange: Optional[str]
+    precond: Optional[str]
+    precond_obj: Optional[object]
+    method: str
+    maxiter: int
+    check_every: int
+    buckets: Tuple[int, ...]
+    #: mesh handles only: the prepared partition + sharded matrix
+    #: arrays, so a dispatch's host work is padding/sharding b
+    dispatcher: Optional[object] = None
+    #: every lane bucket's trace has been compiled (register warmup);
+    #: a deferred-warm handle flips this when a later register() (or
+    #: explicit warm) pays the compiles
+    warmed: bool = False
+
+    @property
+    def distributed(self) -> bool:
+        return self.mesh is not None
+
+
+def _matrix_fingerprint(a) -> str:
+    """Stable digest of an operator's mathematical IDENTITY - the
+    handle key component that makes repeat traffic on the same matrix
+    land on the same compiled state, whatever kernel backend built the
+    operator object.  One hashing scheme repo-wide: the checkpoint
+    module's (explicit field walk, never ``str(treedef)``)."""
+    from ..utils.checkpoint import operator_fingerprint
+
+    return operator_fingerprint(a)[:12]
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile of an already-sorted list (exact, for
+    the service's own report; the registry histogram's interpolated
+    readout serves scrapes)."""
+    if not sorted_vals:
+        return None
+    idx = max(0, int(np.ceil(q * len(sorted_vals))) - 1)
+    return float(sorted_vals[idx])
+
+
+class SolverService:
+    """See the module docstring.  One service hosts many operators;
+    each batch dispatch serves exactly one handle."""
+
+    def __init__(self, config: Optional[ServiceConfig] = None):
+        self.config = config or ServiceConfig()
+        self._clock = self.config.clock or time.monotonic
+        self._manual = self.config.clock is not None
+        self._queue = MicroBatchQueue(
+            max_batch=self.config.max_batch,
+            max_wait_s=self.config.max_wait_s,
+            queue_limit=self.config.queue_limit)
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._handles: Dict[str, OperatorHandle] = {}
+        self._ids = itertools.count(1)
+        self._closed = False
+        self._stop = False
+        # host-side tallies behind the metrics (exact, for stats())
+        self._submitted = 0
+        self._completed = 0
+        self._timeouts = 0
+        self._errors = 0
+        self._converged = 0
+        self._n_batches = 0
+        self._lane_total = 0
+        self._padded_lanes = 0
+        self._occupancy_sum = 0.0
+        self._bucket_counts: Dict[int, int] = {}
+        self._latencies: deque = deque(
+            maxlen=self.config.keep_latency_samples)
+        self._batch_log: deque = deque(maxlen=self.config.keep_batch_log)
+        # one dispatcher at a time: the worker thread and a caller-side
+        # drain() must not interleave two engine calls
+        self._dispatch_lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        if not self._manual:
+            self._worker = threading.Thread(
+                target=self._worker_loop,
+                name="cuda-mpi-parallel-tpu-serve", daemon=True)
+            self._worker.start()
+
+    # -- registration ----------------------------------------------------
+
+    def register(self, a, *, mesh=None, n_devices: Optional[int] = None,
+                 plan=None, exchange: Optional[str] = None,
+                 precond: Optional[str] = None, method: str = "batched",
+                 maxiter: Optional[int] = None,
+                 check_every: Optional[int] = None,
+                 warm: Optional[bool] = None) -> OperatorHandle:
+        """Register an operator: resolve the plan, build the
+        preconditioner, and (by default) warm the compiled trace of
+        EVERY lane bucket so later traffic only ever hits caches.
+
+        Single-device (``mesh=None``, ``n_devices=None``) accepts any
+        ``LinearOperator``; a mesh accepts assembled ``CSRMatrix``
+        problems on a 1-D mesh with ``precond`` ``None``/``"jacobi"``
+        (the scope of ``solve_distributed_many`` - anything else
+        refuses here, at registration, not per request).  Re-registering
+        the same matrix under the same config returns the same handle
+        without re-warming.
+        """
+        from ..models.operators import LinearOperator
+        from ..solver.cg import _as_operator
+        from ..solver.many import MANY_METHODS
+
+        if method not in MANY_METHODS:
+            raise ValueError(f"unknown method {method!r}; expected one "
+                             f"of {MANY_METHODS}")
+        if precond not in (None, "jacobi"):
+            raise ValueError(
+                f"the solver service supports precond None or 'jacobi' "
+                f"(got {precond!r}); heavier preconditioners are "
+                f"single-vector per application and do not batch")
+        if not isinstance(a, LinearOperator):
+            a = _as_operator(a)
+        if mesh is None and n_devices is not None:
+            from ..parallel.mesh import make_mesh
+
+            mesh = make_mesh(n_devices)
+        if mesh is not None:
+            from jax.sharding import Mesh
+
+            if not isinstance(mesh, Mesh):
+                raise TypeError(f"mesh must be a jax.sharding.Mesh, "
+                                f"got {type(mesh).__name__}")
+        else:
+            if exchange is not None:
+                raise ValueError("exchange= needs a mesh (it is the "
+                                 "distributed halo wire)")
+            if plan is not None:
+                raise ValueError("plan= needs a mesh (partition "
+                                 "planning rebalances a device mesh)")
+
+        # dedup BEFORE any O(nnz) construction: the key hashes the
+        # REQUESTED plan spec ("auto"/None/a plan's fingerprint), so a
+        # re-register of the same matrix under the same config returns
+        # the existing handle without re-planning or re-partitioning
+        fingerprint = _matrix_fingerprint(a)
+        plan_spec = plan.fingerprint() \
+            if callable(getattr(plan, "fingerprint", None)) \
+            else repr(plan)
+        cfg = hashlib.sha1(repr((
+            None if mesh is None else tuple(mesh.devices.shape),
+            plan_spec, exchange, precond, method,
+            maxiter or self.config.maxiter,
+            check_every or self.config.check_every,
+            self.config.max_batch)).encode()).hexdigest()[:8]
+        key = f"{fingerprint}:{cfg}"
+        want_warm = self.config.warm if warm is None else warm
+        with self._lock:
+            existing = self._handles.get(key)
+        if existing is not None:
+            # dedup must not silently skip a warmup the caller asked
+            # for: a handle first registered warm=False gets its
+            # buckets compiled by the first warm=True re-register
+            # (otherwise live traffic would pay the compiles and trip
+            # the zero-post-warmup-miss monitoring)
+            if want_warm and not existing.warmed:
+                self._warm(existing)
+                existing.warmed = True
+            return existing
+
+        dispatcher = None
+        if mesh is not None:
+            from ..parallel.dist_cg import ManyRHSDispatcher
+
+            # the partition-once half of solve_distributed_many:
+            # validates the mesh/operator/exchange combination, resolves
+            # the plan (plan="auto" runs the planner HERE, exactly
+            # once), permutes + partitions + shards the matrix arrays
+            dispatcher = ManyRHSDispatcher(
+                a, mesh=mesh,
+                maxiter=int(maxiter or self.config.maxiter),
+                preconditioner=precond, method=method,
+                check_every=int(check_every or self.config.check_every),
+                plan=plan, exchange=exchange)
+            plan = dispatcher.plan
+        precond_obj = None
+        if precond == "jacobi" and mesh is None:
+            from ..models.operators import JacobiPreconditioner
+
+            precond_obj = JacobiPreconditioner.from_operator(a)
+        dtype_name = np.dtype(a.dtype).name
+        if not np.issubdtype(np.dtype(dtype_name), np.floating):
+            dtype_name = np.dtype(np.result_type(float)).name
+        handle = OperatorHandle(
+            key=key, fingerprint=fingerprint, a=a, n=int(a.shape[0]),
+            dtype_name=dtype_name, mesh=mesh, plan=plan,
+            exchange=exchange, precond=precond,
+            precond_obj=precond_obj, method=method,
+            maxiter=int(maxiter or self.config.maxiter),
+            check_every=int(check_every or self.config.check_every),
+            buckets=bucket_sizes(self.config.max_batch),
+            dispatcher=dispatcher)
+        with self._lock:
+            self._handles[key] = handle
+            n_handles = len(self._handles)
+        from ..telemetry.registry import REGISTRY
+
+        REGISTRY.gauge("serve_registered_operators",
+                       "operators registered with the solver "
+                       "service").set(n_handles)
+        if want_warm:
+            self._warm(handle)
+            handle.warmed = True
+        return handle
+
+    def _warm(self, handle: OperatorHandle) -> None:
+        """Compile every lane bucket ONCE, before traffic: a zero-RHS
+        stack freezes every lane at iteration 0 (``stack_columns``
+        docstring), so each warmup pays the trace + compile and almost
+        nothing else.  Warmup events carry ``phase="warmup"`` - the
+        zero-retrace acceptance counts cache misses OUTSIDE this
+        scope."""
+        from ..telemetry import events
+
+        for k in handle.buckets:
+            b0 = np.zeros((handle.n, k),
+                          dtype=np.dtype(handle.dtype_name))
+            tol0 = np.full((k,), 1e-7,
+                           dtype=np.dtype(handle.dtype_name))
+            with events.scoped(phase="warmup"):
+                with events.solve_scope():
+                    res = self._engine(handle, b0, tol0)
+            np.asarray(res.x)   # block: the compile is really done
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, handle: OperatorHandle, b, *, tol: float = 1e-7,
+               deadline_s: Optional[float] = None) -> Future:
+        """Enqueue one right-hand side; returns a Future resolving to
+        a :class:`RequestResult`.  ``b`` is coerced to the handle's
+        compiled dtype (the service trades that copy for a bounded
+        compiled-shape set).  ``deadline_s`` is relative to now; an
+        expired request resolves to a typed TIMEOUT result.  Raises
+        :class:`ServiceClosed` after close() and
+        :class:`serve.queue.QueueFull` at the backpressure bound.
+        """
+        if handle.key not in self._handles:
+            raise ValueError("unknown handle (register the operator "
+                             "with THIS service first)")
+        b = np.asarray(b)
+        if b.ndim != 1 or b.shape[0] != handle.n:
+            raise ValueError(
+                f"b must be 1-D of length {handle.n}, got shape "
+                f"{b.shape} (submit one RHS per request - batching is "
+                f"the service's job)")
+        b = np.ascontiguousarray(b, dtype=np.dtype(handle.dtype_name))
+        tol = float(tol)
+        now = self._clock()
+        req = QueuedRequest(
+            request_id=f"q{next(self._ids):06d}",
+            handle_key=handle.key, b=b, dtype=handle.dtype_name,
+            tol=tol, enqueue_t=now,
+            deadline_t=(now + float(deadline_s)
+                        if deadline_s is not None else None),
+            future=Future(), handle=handle)
+        with self._cond:
+            if self._closed:
+                raise ServiceClosed(
+                    "solver service is closed (no new submissions)")
+            depth = self._queue.push(req)      # raises QueueFull
+            self._submitted += 1
+            self._cond.notify_all()
+        from ..telemetry import events
+        from ..telemetry.registry import REGISTRY
+
+        REGISTRY.counter("serve_requests_total",
+                         "requests submitted to the solver service",
+                         labelnames=("handle",)).inc(handle=handle.key)
+        REGISTRY.gauge("serve_queue_depth",
+                       "requests pending in the solver service "
+                       "queues").set(depth)
+        events.emit("request_enqueued", request_id=req.request_id,
+                    handle=handle.key, queue_depth=depth,
+                    tol_class=tol_class(tol))
+        return req.future
+
+    # -- dispatch --------------------------------------------------------
+
+    def pump(self, now: Optional[float] = None) -> int:
+        """Advance the policy once at ``now`` (manual-clock mode; the
+        worker thread calls the same step on real time).  Returns the
+        number of batches dispatched."""
+        return self._step(self._clock() if now is None else now)
+
+    def _step(self, now: float, drain: bool = False) -> int:
+        with self._dispatch_lock:
+            return self._step_locked(now, drain)
+
+    def _step_locked(self, now: float, drain: bool = False) -> int:
+        """One policy pass; caller holds ``_dispatch_lock`` (a popped
+        batch is in flight exactly while that lock is held - which is
+        what lets drain() prove quiescence by acquiring it)."""
+        with self._lock:
+            batches, timeouts = self._queue.pop_ready(now, drain)
+            depth = self._queue.depth()
+        from ..telemetry.registry import REGISTRY
+
+        REGISTRY.gauge("serve_queue_depth",
+                       "requests pending in the solver service "
+                       "queues").set(depth)
+        for req in timeouts:
+            self._finish_timeout(req, now)
+        for batch in batches:
+            self._run_batch(batch)
+        return len(batches)
+
+    def _finish_timeout(self, req: QueuedRequest, now: float) -> None:
+        from ..telemetry import events
+        from ..telemetry.registry import REGISTRY
+
+        wait = now - req.enqueue_t
+        result = RequestResult(
+            request_id=req.request_id, status="TIMEOUT",
+            converged=False, timed_out=True, x=None, iterations=0,
+            residual_norm=float("nan"), wait_s=float(wait), solve_s=0.0,
+            latency_s=float(wait), bucket=0, occupancy=0.0,
+            solve_id=None)
+        with self._lock:
+            self._timeouts += 1
+        REGISTRY.counter("serve_timeouts_total",
+                         "requests that expired their deadline in "
+                         "queue (typed TIMEOUT results)",
+                         labelnames=("handle",)).inc(
+                             handle=req.handle_key)
+        events.emit("request_done", request_id=req.request_id,
+                    status="TIMEOUT", wait_s=float(wait),
+                    handle=req.handle_key)
+        if not req.future.done():
+            req.future.set_result(result)
+
+    def _engine(self, handle: OperatorHandle, b_stack: np.ndarray,
+                tols: np.ndarray):
+        """One batched solve of the handle's operator (the compiled
+        hot path every dispatch and warmup shares).  Mesh handles ride
+        the handle's prepared dispatcher - no per-batch plan/partition
+        host work."""
+        if handle.distributed:
+            return handle.dispatcher.solve(b_stack, tol=tols)
+        from ..solver.many import solve_many
+
+        return solve_many(handle.a, b_stack, tol=tols,
+                          maxiter=handle.maxiter, m=handle.precond_obj,
+                          method=handle.method,
+                          check_every=handle.check_every)
+
+    def _run_batch(self, batch: Batch) -> None:
+        from ..solver.many import stack_columns
+        from ..telemetry import events
+        from ..telemetry.registry import REGISTRY
+
+        # wait_s baseline is taken HERE, not at pop time: several
+        # batches popped by one step run sequentially, and batch N's
+        # queue wait honestly includes batches 1..N-1's solve walls
+        # (head-of-line blocking is real latency; under a fake clock
+        # the two timestamps coincide and tests stay deterministic)
+        now = self._clock()
+        reqs = batch.requests
+        handle: OperatorHandle = reqs[0].handle
+        m, k = len(reqs), batch.bucket
+        b_stack = stack_columns([r.b for r in reqs], k,
+                                dtype=np.dtype(handle.dtype_name))
+        tols = np.full((k,), reqs[0].tol,
+                       dtype=np.dtype(handle.dtype_name))
+        tols[:m] = [r.tol for r in reqs]
+        t0 = time.perf_counter()
+        with events.solve_scope() as solve_id:
+            events.emit("batch_dispatch", handle=handle.key, bucket=k,
+                        n_requests=m, reason=batch.reason,
+                        occupancy=round(batch.occupancy, 6))
+            try:
+                res = self._engine(handle, b_stack, tols)
+                x = np.asarray(res.x)          # sync: the solve is done
+                iters = np.asarray(res.iterations)
+                rnorm = np.asarray(res.residual_norm)
+                conv = np.asarray(res.converged)
+                stat = np.asarray(res.status)
+            except Exception as exc:
+                # the typed-terminal-result contract holds for engine
+                # failures too: every lane of the batch resolves to a
+                # status="ERROR" RequestResult (a raised future would
+                # blow up any caller looping fut.result() - the CLI
+                # replay included) and the worker survives
+                solve_s = time.perf_counter() - t0
+                with self._lock:
+                    # the failed dispatch still WAS a dispatch: batch
+                    # bookkeeping stays consistent with the
+                    # batch_dispatch event already emitted (during an
+                    # incident stats()/batch_log must not disagree
+                    # with the event stream)
+                    self._errors += m
+                    self._n_batches += 1
+                    self._lane_total += k
+                    self._padded_lanes += k - m
+                    self._occupancy_sum += batch.occupancy
+                    self._bucket_counts[k] = \
+                        self._bucket_counts.get(k, 0) + 1
+                    self._batch_log.append({
+                        "handle": handle.key, "bucket": k,
+                        "n_requests": m, "reason": batch.reason,
+                        "solve_s": float(solve_s),
+                        "solve_id": solve_id,
+                        "error": repr(exc)[-200:],
+                        "request_ids": [r.request_id for r in reqs]})
+                REGISTRY.counter("serve_batches_total",
+                                 "microbatches dispatched",
+                                 labelnames=("handle", "reason")).inc(
+                                     handle=handle.key,
+                                     reason=batch.reason)
+                for r in reqs:
+                    wait = float(now - r.enqueue_t)
+                    events.emit("request_done",
+                                request_id=r.request_id, status="ERROR",
+                                wait_s=wait, handle=handle.key,
+                                error=repr(exc)[-200:])
+                    REGISTRY.counter(
+                        "serve_requests_done_total",
+                        "requests finished by the solver service",
+                        labelnames=("handle", "status")).inc(
+                            handle=handle.key, status="ERROR")
+                    if not r.future.done():
+                        r.future.set_result(RequestResult(
+                            request_id=r.request_id, status="ERROR",
+                            converged=False, timed_out=False, x=None,
+                            iterations=0,
+                            residual_norm=float("nan"), wait_s=wait,
+                            solve_s=float(solve_s),
+                            latency_s=wait + float(solve_s), bucket=k,
+                            occupancy=batch.occupancy,
+                            solve_id=solve_id))
+                return
+            solve_s = time.perf_counter() - t0
+            results = []
+            for j, r in enumerate(reqs):
+                status = CGStatus(int(stat[j])).name
+                wait = float(now - r.enqueue_t)
+                latency = wait + solve_s
+                result = RequestResult(
+                    request_id=r.request_id, status=status,
+                    converged=bool(conv[j]), timed_out=False,
+                    # a copy, not a view: x[:, j] would pin the whole
+                    # (n, k) batch solution for the result's lifetime
+                    x=np.ascontiguousarray(x[:, j]),
+                    iterations=int(iters[j]),
+                    residual_norm=float(rnorm[j]), wait_s=wait,
+                    solve_s=float(solve_s), latency_s=float(latency),
+                    bucket=k, occupancy=batch.occupancy,
+                    solve_id=solve_id)
+                results.append(result)
+                events.emit("request_done", request_id=r.request_id,
+                            status=status, wait_s=wait,
+                            solve_s=float(solve_s),
+                            latency_s=float(latency),
+                            iterations=int(iters[j]),
+                            converged=bool(conv[j]), handle=handle.key)
+                REGISTRY.counter(
+                    "serve_requests_done_total",
+                    "requests finished by the solver service",
+                    labelnames=("handle", "status")).inc(
+                        handle=handle.key, status=status)
+                REGISTRY.histogram(
+                    "serve_request_latency_seconds",
+                    "submit-to-result latency (queue wait + batched "
+                    "solve wall)", labelnames=("handle",),
+                    buckets=LATENCY_BUCKETS).observe(
+                        latency, handle=handle.key)
+        REGISTRY.counter("serve_batches_total",
+                         "microbatches dispatched",
+                         labelnames=("handle", "reason")).inc(
+                             handle=handle.key, reason=batch.reason)
+        REGISTRY.gauge("serve_batch_occupancy",
+                       "requests/bucket of the most recent dispatched "
+                       "batch", labelnames=("handle",)).set(
+                           batch.occupancy, handle=handle.key)
+        REGISTRY.gauge("serve_batch_padding_fraction",
+                       "padded (wasted) lane fraction of the most "
+                       "recent dispatched batch",
+                       labelnames=("handle",)).set(
+                           batch.padding_fraction, handle=handle.key)
+        REGISTRY.counter("serve_lanes_total",
+                         "solver lanes dispatched (incl. padding)",
+                         labelnames=("handle",)).inc(k,
+                                                     handle=handle.key)
+        if k > m:
+            REGISTRY.counter("serve_padded_lanes_total",
+                             "zero-RHS pad lanes dispatched "
+                             "(bucket - occupancy waste)",
+                             labelnames=("handle",)).inc(
+                                 k - m, handle=handle.key)
+        with self._lock:
+            self._n_batches += 1
+            self._lane_total += k
+            self._padded_lanes += k - m
+            self._occupancy_sum += batch.occupancy
+            self._bucket_counts[k] = self._bucket_counts.get(k, 0) + 1
+            for result in results:
+                self._completed += 1
+                if result.converged:
+                    self._converged += 1
+                self._latencies.append(result.latency_s)
+            self._batch_log.append({
+                "handle": handle.key, "bucket": k, "n_requests": m,
+                "reason": batch.reason, "solve_s": float(solve_s),
+                "solve_id": solve_id,
+                "request_ids": [r.request_id for r in reqs]})
+        for r, result in zip(reqs, results):
+            if not r.future.done():
+                r.future.set_result(result)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def drain(self) -> None:
+        """Flush every pending request NOW (partial batches dispatch
+        immediately with reason="drain"); returns when the queues are
+        empty AND no batch is in flight.  The service stays open.
+
+        Quiescence proof: a batch is in flight exactly while
+        ``_dispatch_lock`` is held (``_step``), so holding the lock
+        with empty queues means every submitted request has resolved -
+        a caller timing a replay window after drain() includes the
+        last batch's solve wall."""
+        while True:
+            with self._dispatch_lock:
+                with self._lock:
+                    if self._queue.depth() == 0:
+                        return
+                self._step_locked(self._clock(), drain=True)
+
+    def close(self) -> None:
+        """Stop accepting work, drain what is queued, stop the worker.
+        Idempotent; submits after close raise :class:`ServiceClosed`."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self.drain()
+        if self._worker is not None:
+            with self._cond:
+                self._stop = True
+                self._cond.notify_all()
+            self._worker.join(timeout=5.0)
+            self._worker = None
+
+    def __enter__(self) -> "SolverService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                now = self._clock()
+                wake = self._queue.next_wake(now)
+                if wake is None:
+                    self._cond.wait()
+                elif wake > now:
+                    self._cond.wait(timeout=wake - now)
+            if self._stop:
+                return
+            self._step(self._clock())
+
+    # -- reporting -------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._queue.depth()
+
+    def batch_log(self) -> List[dict]:
+        with self._lock:
+            return list(self._batch_log)
+
+    def stats(self) -> dict:
+        """JSON-ready service summary: request/batch counts, occupancy
+        and padding means, bucket usage, and EXACT latency percentiles
+        over the last ``keep_latency_samples`` completions (the
+        registry histogram additionally exports interpolated
+        p50/p95/p99 over the full history for scrapes)."""
+        with self._lock:
+            lat = sorted(self._latencies)
+            n_batches = self._n_batches
+            out = {
+                "submitted": self._submitted,
+                "completed": self._completed,
+                "converged": self._converged,
+                "timeouts": self._timeouts,
+                "errors": self._errors,
+                "queue_depth": self._queue.depth(),
+                "batches": n_batches,
+                "lanes_dispatched": self._lane_total,
+                "padded_lanes": self._padded_lanes,
+                "padding_fraction": (
+                    self._padded_lanes / self._lane_total
+                    if self._lane_total else 0.0),
+                "occupancy_mean": (
+                    self._occupancy_sum / n_batches if n_batches
+                    else 0.0),
+                "bucket_counts": {str(k): v for k, v in
+                                  sorted(self._bucket_counts.items())},
+            }
+        out["latency"] = {
+            "count": len(lat),
+            "mean_s": float(np.mean(lat)) if lat else None,
+            "max_s": float(lat[-1]) if lat else None,
+            "p50_s": _percentile(lat, 0.50),
+            "p95_s": _percentile(lat, 0.95),
+            "p99_s": _percentile(lat, 0.99),
+        }
+        return out

@@ -1,0 +1,119 @@
+"""Replayable service workloads: arrival times + RHS seeds.
+
+A workload is the serving tier's test signal - a list of
+``(arrival_t, seed)`` pairs, optionally with per-request tolerance and
+deadline overrides.  Seeds, not vectors: request ``i``'s right-hand
+side is ``A @ x_true(seed_i)`` built against the registered operator
+(:func:`rhs_for`), so every request has a KNOWN solution and a replay
+can verify per-request accuracy, while the workload file itself stays
+a few hundred bytes regardless of the matrix size.
+
+Files are strict JSON (``{"version": 1, "requests": [...]}``);
+:func:`synthetic_poisson` generates the standard open-loop benchmark
+arrival process (exponential gaps at a target rate).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "WorkloadRequest",
+    "load_workload",
+    "rhs_for",
+    "save_workload",
+    "synthetic_poisson",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadRequest:
+    """One replayed arrival: offset seconds from replay start + the
+    RHS seed; ``tol``/``deadline_s`` of ``None`` take the replay's
+    defaults."""
+
+    t: float
+    seed: int
+    tol: Optional[float] = None
+    deadline_s: Optional[float] = None
+
+    def to_json(self) -> dict:
+        out = {"t": float(self.t), "seed": int(self.seed)}
+        if self.tol is not None:
+            out["tol"] = float(self.tol)
+        if self.deadline_s is not None:
+            out["deadline_s"] = float(self.deadline_s)
+        return out
+
+    @classmethod
+    def from_json(cls, data: dict) -> "WorkloadRequest":
+        if not isinstance(data, dict):
+            raise ValueError(
+                f"workload request must be an object, got "
+                f"{type(data).__name__}")
+        for field in ("t", "seed"):
+            if field not in data:
+                raise ValueError(
+                    f"workload request missing field {field!r}")
+        return cls(t=float(data["t"]), seed=int(data["seed"]),
+                   tol=(float(data["tol"]) if data.get("tol")
+                        is not None else None),
+                   deadline_s=(float(data["deadline_s"])
+                               if data.get("deadline_s") is not None
+                               else None))
+
+
+def synthetic_poisson(n_requests: int, rate_hz: float, seed: int = 0,
+                      tol: Optional[float] = None,
+                      deadline_s: Optional[float] = None
+                      ) -> List[WorkloadRequest]:
+    """Open-loop Poisson arrivals: ``n_requests`` with exponential
+    inter-arrival gaps at ``rate_hz`` (the first request arrives at
+    t=0 so a replay never idles before its own start)."""
+    if n_requests < 1:
+        raise ValueError(f"n_requests must be >= 1, got {n_requests}")
+    if rate_hz <= 0:
+        raise ValueError(f"rate_hz must be > 0, got {rate_hz}")
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_hz, n_requests)
+    gaps[0] = 0.0
+    times = np.cumsum(gaps)
+    return [WorkloadRequest(t=float(t), seed=int(seed * 1_000_003 + i),
+                            tol=tol, deadline_s=deadline_s)
+            for i, t in enumerate(times)]
+
+
+def save_workload(path: str,
+                  requests: Sequence[WorkloadRequest]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1,
+                   "requests": [r.to_json() for r in requests]},
+                  f, allow_nan=False, indent=1)
+        f.write("\n")
+
+
+def load_workload(path: str) -> List[WorkloadRequest]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("version") != 1:
+        raise ValueError(f"{path}: not a version-1 workload file")
+    reqs = data.get("requests")
+    if not isinstance(reqs, list) or not reqs:
+        raise ValueError(f"{path}: empty workload")
+    return [WorkloadRequest.from_json(r) for r in reqs]
+
+
+def rhs_for(a, seed: int, dtype=None) -> Tuple[np.ndarray, np.ndarray]:
+    """``(b, x_true)`` for one request: ``x_true`` is the seed's
+    standard-normal vector, ``b = A @ x_true`` - so the replay can
+    check every answer against a known solution."""
+    import jax.numpy as jnp
+
+    n = int(a.shape[0])
+    dt = np.dtype(dtype if dtype is not None else a.dtype)
+    x_true = np.random.default_rng(seed).standard_normal(n).astype(dt)
+    b = np.asarray(a @ jnp.asarray(x_true))
+    return b, x_true

@@ -3,9 +3,9 @@
 
 from .cg import CGCheckpoint, CGResult, cg, solve
 from .df64 import DF64CGResult, DF64Checkpoint, cg_df64
-from .many import CGBatchResult, cg_many, solve_many
+from .many import CGBatchResult, cg_many, solve_many, stack_columns
 from .status import CGStatus
 
 __all__ = ["CGBatchResult", "CGCheckpoint", "CGResult", "CGStatus",
            "DF64CGResult", "cg", "cg_df64", "cg_many", "solve",
-           "solve_many"]
+           "solve_many", "stack_columns"]

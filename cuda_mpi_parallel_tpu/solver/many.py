@@ -62,10 +62,46 @@ from .cg import (
 )
 from .status import CGStatus
 
-__all__ = ["CGBatchResult", "cg_many", "solve_many"]
+__all__ = ["CGBatchResult", "cg_many", "solve_many", "stack_columns"]
 
 #: batched-solver recurrences accepted by :func:`cg_many`
 MANY_METHODS = ("batched", "block")
+
+
+def stack_columns(columns, k: int, dtype=None):
+    """Stack 1-D right-hand sides into a zero-padded ``(n, k)`` batch.
+
+    The serving tier's bucket-padding primitive: a microbatch of ``m``
+    requests dispatches on the smallest compiled lane bucket ``k >= m``
+    and the ``k - m`` pad lanes carry ``b = 0`` - a zero-RHS lane has
+    ``||r0|| = 0``, so both recurrences freeze it at iteration 0
+    (``_active_lanes``'s ``rr > 0`` clause; tests assert the 0-iter
+    freeze) and a padded dispatch costs the same sweeps as a full one,
+    never extra iterations.  ``dtype=None`` takes the common numpy
+    result type of the columns.
+    """
+    import numpy as np
+
+    if k < 1:
+        raise ValueError(f"bucket size must be >= 1, got {k}")
+    cols = [np.asarray(c) for c in columns]
+    if not cols:
+        raise ValueError("stack_columns needs at least one column")
+    if len(cols) > k:
+        raise ValueError(
+            f"{len(cols)} columns do not fit a k={k} bucket")
+    n = cols[0].shape[0]
+    for c in cols:
+        if c.ndim != 1 or c.shape[0] != n:
+            raise ValueError(
+                f"columns must be 1-D of one length, got shapes "
+                f"{[c.shape for c in cols]}")
+    if dtype is None:
+        dtype = np.result_type(*cols)
+    out = np.zeros((n, k), dtype=dtype)
+    for j, c in enumerate(cols):
+        out[:, j] = c
+    return out
 
 
 @partial(

@@ -95,6 +95,19 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     # solve k+1 kept or switched its partition plan based on the model
     # calibrated from solve k, with the predicted gain of the choice
     "replan": ("solve_index", "decision"),
+    # a compiled distributed solver was evicted from the bounded LRU
+    # cache (parallel.dist_cg; a long-running service on many
+    # operators must not leak traces) - key is the evicted entry's
+    # digest, the same id its dist_cache_hit/miss events carried
+    "dist_cache_evict": ("key",),
+    # solver-service request lifecycle (serve.SolverService): a request
+    # entered its microbatch queue; a batch was cut and dispatched onto
+    # solve_many / solve_distributed_many (the batch's events share the
+    # dispatch's solve_id - the request->solve linkage); a request left
+    # the service with a typed terminal status (CONVERGED/.../TIMEOUT)
+    "request_enqueued": ("request_id", "handle", "queue_depth"),
+    "batch_dispatch": ("handle", "bucket", "n_requests", "reason"),
+    "request_done": ("request_id", "status", "wait_s"),
     # sampled in-flight heartbeat (FlightConfig.heartbeat > 0 only;
     # posted from the hot loop via an unordered jax.debug.callback)
     "flight_heartbeat": ("iteration",),

@@ -24,11 +24,17 @@ import math
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY"]
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "PERCENTILES", "REGISTRY"]
 
 #: default histogram buckets (seconds-flavored, matching solve times
 #: from sub-ms resident kernels to multi-minute 256^3 streaming runs)
 DEFAULT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0, 60.0, 300.0)
+
+#: the percentile readout every histogram exposes (JSON ``percentiles``
+#: and ``{name}_p50/_p95/_p99`` Prometheus gauges) - the latency
+#: summary the solver service's SLO reporting consumes
+PERCENTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
 
 
 def _label_key(labelnames: Sequence[str], labels: Dict[str, str]) -> Tuple:
@@ -179,6 +185,41 @@ class Histogram(_Metric):
                 return {"count": 0, "sum": 0.0}
             return {"count": int(child[-2]), "sum": child[-1]}
 
+    def _quantile_locked(self, child, q: float) -> Optional[float]:
+        """``histogram_quantile`` semantics over the cumulative bucket
+        counts: find the bucket the q-th observation landed in and
+        interpolate linearly inside it (lower bound of the first
+        bucket is 0).  Observations past the last finite bound clamp
+        to that bound - the honest answer a bucketed histogram can
+        give.  ``None`` for an empty child."""
+        total = child[-2]
+        if total <= 0:
+            return None
+        target = q * total
+        prev = 0.0
+        for i, bound in enumerate(self.buckets):
+            if child[i] >= target:
+                lower = 0.0 if i == 0 else self.buckets[i - 1]
+                within = child[i] - prev
+                if within <= 0:
+                    return bound
+                return lower + (bound - lower) * (target - prev) / within
+            prev = child[i]
+        return self.buckets[-1]
+
+    def quantile(self, q: float, **labels: str) -> Optional[float]:
+        """The q-th latency quantile (0 < q < 1) of one child, derived
+        from the cumulative buckets; ``None`` when nothing was
+        observed.  Used by the solver service's p50/p95/p99 readout."""
+        if not 0.0 < q < 1.0:
+            raise ValueError(f"quantile must be in (0, 1), got {q}")
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                return None
+            return self._quantile_locked(child, q)
+
     def snapshot(self):
         with self._lock:
             out = []
@@ -190,6 +231,9 @@ class Histogram(_Metric):
                         for i, b in enumerate(self.buckets)},
                     "count": int(child[-2]),
                     "sum": child[-1],
+                    "percentiles": {
+                        name: self._quantile_locked(child, q)
+                        for name, q in PERCENTILES},
                 })
             return out
 
@@ -210,6 +254,19 @@ class Histogram(_Metric):
                 lines.append(f"{self.name}_count{lab} {int(child[-2])}")
                 lines.append(
                     f"{self.name}_sum{lab} {_format_value(child[-1])}")
+            # bucket-derived percentile gauges: scrape consumers get
+            # p50/p95/p99 without running histogram_quantile themselves
+            # (and the CLI's --metrics text is readable as-is).  Gauge-
+            # typed companions, never part of the histogram series.
+            for pname, q in PERCENTILES:
+                lines.append(f"# TYPE {self.name}_{pname} gauge")
+                for key, child in sorted(self._children.items()):
+                    v = self._quantile_locked(child, q)
+                    if v is None:
+                        continue
+                    lab = _format_labels(self.labelnames, key)
+                    lines.append(
+                        f"{self.name}_{pname}{lab} {_format_value(v)}")
         return lines
 
 

@@ -74,6 +74,9 @@ class SolveReport:
     #: SequenceResult.summary() (--repeat runs) or a bare
     #: {"drift": DriftReport.to_json()} for a single planned solve
     calibration: Optional[dict] = None
+    #: solver-service replay summary (serve.SolverService.stats()):
+    #: request/batch counts, occupancy, padding, latency percentiles
+    service: Optional[dict] = None
     sections: Sequence[Tuple[str, float]] = ()
 
     def to_json(self) -> dict:
@@ -90,6 +93,8 @@ class SolveReport:
             out["comm"] = dict(self.comm)
         if self.calibration is not None:
             out["calibration"] = dict(self.calibration)
+        if self.service is not None:
+            out["service"] = dict(self.service)
         if self.sections:
             out["sections"] = {name: s for name, s in self.sections}
         return sanitize(out)
@@ -161,6 +166,10 @@ class SolveReport:
             lines.append("")
             lines.append("-- calibration & drift --")
             lines.extend(_calibration_lines(self.calibration))
+        if self.service is not None:
+            lines.append("")
+            lines.append("-- solver service --")
+            lines.extend(service_lines(self.service))
         if self.health is not None:
             lines.append("")
             lines.append(f"-- solve health --")
@@ -178,6 +187,52 @@ class SolveReport:
             for name, sec in self.sections:
                 lines.append(f"  {name:>12}: {sec * 1e3:9.3f} ms")
         return "\n".join(lines) + "\n"
+
+
+def service_lines(stats: Dict[str, Any]) -> List[str]:
+    """Render a solver-service replay summary
+    (``serve.SolverService.stats()``): request disposition, batch
+    occupancy/padding, bucket usage and the latency percentiles - the
+    queue-side story the per-solve sections above cannot tell."""
+    def ms(v) -> str:
+        return f"{v * 1e3:.3f} ms" if isinstance(v, (int, float)) \
+            else "n/a"
+
+    lines = [
+        f"requests: {stats.get('submitted', 0)} submitted, "
+        f"{stats.get('completed', 0)} completed "
+        f"({stats.get('converged', 0)} converged, "
+        f"{stats.get('timeouts', 0)} timeout, "
+        f"{stats.get('errors', 0)} error)"
+        + (f", {stats['rejected']} rejected (backpressure)"
+           if stats.get("rejected") else "")
+        + f", queue depth {stats.get('queue_depth', 0)}",
+        f"batches : {stats.get('batches', 0)} dispatched, occupancy "
+        f"mean {stats.get('occupancy_mean', 0.0):.2f}, padding "
+        f"{stats.get('padding_fraction', 0.0) * 100:.1f}% "
+        f"({stats.get('padded_lanes', 0)}/"
+        f"{stats.get('lanes_dispatched', 0)} lanes)",
+    ]
+    buckets = stats.get("bucket_counts") or {}
+    if buckets:
+        lines.append("buckets : " + ", ".join(
+            f"k={k}: {v}" for k, v in sorted(
+                buckets.items(), key=lambda kv: int(kv[0]))))
+    lat = stats.get("latency") or {}
+    lines.append(
+        f"latency : p50 {ms(lat.get('p50_s'))}  "
+        f"p95 {ms(lat.get('p95_s'))}  p99 {ms(lat.get('p99_s'))}  "
+        f"(max {ms(lat.get('max_s'))})")
+    if stats.get("solved_rhs_per_sec") is not None:
+        lines.append(
+            f"throughput: {stats['solved_rhs_per_sec']:.1f} solved "
+            f"RHS/s over {stats.get('replay_window_s', 0.0):.3f} s "
+            f"replay window")
+    if stats.get("dist_cache_misses_postwarm") is not None:
+        lines.append(
+            f"zero-retrace: dist_cache_miss after warmup = "
+            f"{int(stats['dist_cache_misses_postwarm'])}")
+    return lines
 
 
 def _calibration_lines(calib: Dict[str, Any]) -> List[str]:

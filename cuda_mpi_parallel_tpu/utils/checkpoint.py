@@ -38,29 +38,19 @@ _FORMAT_VERSION = 2
 _FP_EXCLUDE_FIELDS = frozenset({"backend", "rows"})
 
 
-def problem_fingerprint(a, b) -> str:
-    """Identify the (operator, rhs) a checkpoint belongs to.
-
-    On resume the recurrence never re-reads b (r comes from the state), so
-    resuming against the wrong problem would silently 'converge' to the old
-    system's solution - the fingerprint turns that into a loud error.
-
-    The operator contributes its FULL mathematical identity, not just
-    type and shape (round-4 advice: two same-type/same-shape operators
-    with different coefficients - a rescaled stencil, a CSR matrix with
-    different values - must not collide).  The scheme is explicit and
-    stable: array-valued dataclass fields hash by name/dtype/shape/bytes
-    and static fields by repr, in sorted field order - never via
+def _update_operator_hash(h, a) -> None:
+    """Feed an operator's FULL mathematical identity into ``h`` (round-4
+    advice: two same-type/same-shape operators with different
+    coefficients - a rescaled stencil, a CSR matrix with different
+    values - must not collide).  The scheme is explicit and stable:
+    array-valued dataclass fields hash by name/dtype/shape/bytes and
+    static fields by repr, in sorted field order - never via
     ``str(treedef)``, whose formatting is a JAX internal that can change
     across releases.  Execution-strategy fields (``_FP_EXCLUDE_FIELDS``)
-    are excluded: the same system must resume whichever kernel computes
-    it.
-    """
+    are excluded: the same system is the same system whichever kernel
+    computes it."""
     import dataclasses
-    import hashlib
 
-    h = hashlib.sha256()
-    h.update(np.ascontiguousarray(np.asarray(b)).tobytes())
     h.update(f"fpv2:{type(a).__name__}:{a.shape};".encode())
     if dataclasses.is_dataclass(a):
         fields = sorted(dataclasses.fields(a), key=lambda f: f.name)
@@ -84,10 +74,39 @@ def problem_fingerprint(a, b) -> str:
                 # np.asarray would yield raw pointer bytes - different
                 # every process, which would spuriously reject every
                 # post-restart resume.  Skip: identity degrades to
-                # type+shape+rhs (the v1 semantics) for such operators.
+                # type+shape(+rhs) for such operators.
                 continue
             h.update(f"{arr.dtype}:{arr.shape}:".encode())
             h.update(np.ascontiguousarray(arr).tobytes())
+
+
+def operator_fingerprint(a) -> str:
+    """Digest of one operator's mathematical identity (no rhs) - the
+    solver service's handle key component (repeat traffic on the same
+    matrix must land on the same compiled state, whatever kernel
+    backend built it)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    _update_operator_hash(h, a)
+    return h.hexdigest()[:16]
+
+
+def problem_fingerprint(a, b) -> str:
+    """Identify the (operator, rhs) a checkpoint belongs to.
+
+    On resume the recurrence never re-reads b (r comes from the state), so
+    resuming against the wrong problem would silently 'converge' to the old
+    system's solution - the fingerprint turns that into a loud error.
+    Hashing scheme: see :func:`_update_operator_hash` (byte-identical to
+    the pre-extraction inline version - saved checkpoints keep their
+    recorded fingerprints).
+    """
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(np.asarray(b)).tobytes())
+    _update_operator_hash(h, a)
     return h.hexdigest()[:16]
 
 

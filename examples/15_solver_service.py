@@ -1,0 +1,111 @@
+"""The solver service: bursty traffic in, block solves out.
+
+Registers ONE fixture operator with the microbatching solver service
+(``cuda_mpi_parallel_tpu.serve``), replays a bursty workload of
+single-RHS requests against it, and prints:
+
+1. the occupancy / latency report - how the queue coalesced arrivals
+   into padded lane buckets;
+2. the zero-retrace proof - the per-bucket warmup at registration is
+   the ONLY time the solve is traced/compiled; every later dispatch
+   is a cache hit (counted via the jit-signature caches);
+3. the throughput win vs a max_batch=1 service on the SAME workload
+   (what dispatch-per-request serving would do).
+
+Run: python examples/15_solver_service.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.serve import (
+    ServiceConfig,
+    SolverService,
+    rhs_for,
+    synthetic_poisson,
+)
+from cuda_mpi_parallel_tpu.telemetry.report import service_lines
+
+GRID = 64            # 4096 unknowns - quick on CPU, real enough to time
+REQUESTS = 48
+RATE_HZ = 1500.0     # bursty open-loop Poisson arrivals
+TOL = 1e-8
+
+
+def replay(a, workload, prepared, max_batch):
+    svc = SolverService(ServiceConfig(
+        max_batch=max_batch, max_wait_s=0.003, maxiter=800))
+    try:
+        handle = svc.register(a)     # plan + per-bucket warmup, ONCE
+        t0 = time.perf_counter()
+        futures = []
+        for req, b in prepared:
+            delay = (t0 + req.t) - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            futures.append(svc.submit(handle, b, tol=TOL))
+        svc.drain()
+        window = time.perf_counter() - t0
+        results = [f.result() for f in futures]
+        stats = svc.stats()
+    finally:
+        svc.close()
+    solved = sum(1 for r in results if r.converged)
+    stats["solved_rhs_per_sec"] = solved / window
+    stats["replay_window_s"] = window
+    return results, stats
+
+
+def trace_count():
+    """Total traced calls of the single-device batched solver - the
+    retrace probe (jit re-traces exactly when a new (shape, static)
+    signature appears)."""
+    from cuda_mpi_parallel_tpu.solver.many import _solve_many_jit
+
+    info = _solve_many_jit._cache_size()
+    return info
+
+
+def main():
+    a = poisson.poisson_2d_csr(GRID, GRID, dtype=np.float64)
+    workload = synthetic_poisson(REQUESTS, RATE_HZ, seed=15)
+    prepared = [(r, rhs_for(a, r.seed)[0]) for r in workload]
+    print(f"Poisson-2D {GRID}x{GRID} (n={a.shape[0]}), "
+          f"{REQUESTS} requests @ ~{RATE_HZ:.0f}/s, tol={TOL:g}\n")
+
+    print("-- microbatched service (max_batch=8) --")
+    results, stats = replay(a, workload, prepared, max_batch=8)
+    compiled_after_replay = trace_count()
+    for line in service_lines(stats):
+        print(line)
+    worst = max(
+        float(np.max(np.abs(r.x - rhs_for(a, req.seed)[1])))
+        for (req, _), r in zip(prepared, results))
+    print(f"accuracy: max request error {worst:.3e}")
+
+    # zero-retrace proof: replay the same workload again - the
+    # compiled-signature count must not move (every bucket was warmed
+    # at registration; repeat traffic only ever hits caches)
+    _, stats2 = replay(a, workload, prepared, max_batch=8)
+    print(f"zero-retrace: compiled signatures {compiled_after_replay} "
+          f"after replay 1 -> {trace_count()} after replay 2 "
+          f"(second replay compiled nothing new)")
+
+    print("\n-- the same workload, max_batch=1 (no batching) --")
+    _, stats1 = replay(a, workload, prepared, max_batch=1)
+    for line in service_lines(stats1):
+        print(line)
+
+    speedup = stats["solved_rhs_per_sec"] / stats1["solved_rhs_per_sec"]
+    print(f"\nbatched dispatch: {stats['solved_rhs_per_sec']:.1f} vs "
+          f"{stats1['solved_rhs_per_sec']:.1f} solved RHS/s unbatched "
+          f"-> {speedup:.1f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,60 @@ def test_different_config_gets_new_entry_same_scale_does_not():
     assert len(dist_cg._SOLVER_CACHE) == 2
 
 
+def test_lru_cap_evicts_oldest_with_event(monkeypatch):
+    """The bounded cache (PR 10): a long-running service on many
+    operators must not leak compiled traces.  With the cap at 2, a
+    third distinct config evicts the least-recently-hit entry, emits
+    a dist_cache_evict event, counts it, and a re-solve of the
+    evicted config is a (loud) miss, never an error."""
+    import json
+
+    from cuda_mpi_parallel_tpu.telemetry import events
+    from cuda_mpi_parallel_tpu.telemetry.registry import REGISTRY
+
+    monkeypatch.setenv(dist_cg.DIST_CACHE_CAP_ENV, "2")
+    a = Stencil2D.create(16, 16, dtype=jnp.float64)
+    b = jnp.ones(a.shape[0])
+    mesh = make_mesh(8)
+    evict_counter = REGISTRY.counter("dist_solver_cache_evictions_total")
+    before = evict_counter.value()
+    with events.capture() as buf:
+        # three distinct static configs -> three cache keys
+        solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=200)
+        solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=201)
+        # touch the first entry so IT is the most recent...
+        solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=200)
+        solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=202)
+    assert len(dist_cg._SOLVER_CACHE) == 2
+    assert evict_counter.value() == before + 1
+    recs = [json.loads(ln) for ln in buf.getvalue().splitlines()
+            if ln.strip()]
+    evicts = [r for r in recs if r["event"] == "dist_cache_evict"]
+    assert len(evicts) == 1 and evicts[0]["cap"] == 2
+    # ...and the evicted one is maxiter=201 (least recently hit): its
+    # miss/evict key ids match, and re-solving it is a fresh miss
+    misses = [r for r in recs if r["event"] == "dist_cache_miss"]
+    assert evicts[0]["key"] == misses[1]["key"]
+    with events.capture() as buf2:
+        r2 = solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=201)
+    recs2 = [json.loads(ln) for ln in buf2.getvalue().splitlines()
+             if ln.strip()]
+    assert any(r["event"] == "dist_cache_miss" for r in recs2)
+    assert bool(r2.converged)
+    assert len(dist_cg._SOLVER_CACHE) == 2
+
+
+def test_cap_env_validation(monkeypatch):
+    monkeypatch.setenv(dist_cg.DIST_CACHE_CAP_ENV, "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        dist_cg._dist_cache_cap()
+    monkeypatch.setenv(dist_cg.DIST_CACHE_CAP_ENV, "abc")
+    with pytest.raises(ValueError, match="not an integer"):
+        dist_cg._dist_cache_cap()
+    monkeypatch.delenv(dist_cg.DIST_CACHE_CAP_ENV)
+    assert dist_cg._dist_cache_cap() == dist_cg.DEFAULT_DIST_CACHE_CAP
+
+
 def test_scale_is_data_not_baked_in():
     """The cached solver must honor a changed stencil scale (it is passed
     as an argument, not closed over)."""

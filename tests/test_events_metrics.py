@@ -107,6 +107,57 @@ class TestRegistry:
         with pytest.raises(ValueError, match="buckets"):
             r.histogram("h_seconds", buckets=(0.5, 5.0))
 
+    def test_histogram_quantile_math_hand_built(self):
+        """Regression pin for the percentile readout: hand-built
+        cumulative buckets with known exact histogram_quantile
+        answers (linear interpolation inside the landing bucket,
+        lower bound 0 for the first)."""
+        r = MetricsRegistry()
+        h = r.histogram("q_seconds", buckets=(1.0, 2.0, 4.0))
+        for _ in range(50):
+            h.observe(0.5)            # bucket <= 1: 50
+        for _ in range(50):
+            h.observe(3.0)            # bucket <= 4: 100
+        # p50: target 50 lands exactly on bucket 1's cumulative 50 ->
+        # 0 + 1 * (50 - 0)/50 = 1.0
+        assert h.quantile(0.50) == pytest.approx(1.0)
+        # p95: target 95 lands in (2, 4] (prev cumulative 50, 50
+        # inside) -> 2 + 2 * (95 - 50)/50 = 3.8
+        assert h.quantile(0.95) == pytest.approx(3.8)
+        # p25: halfway into the first bucket -> 0 + 1 * 25/50 = 0.5
+        assert h.quantile(0.25) == pytest.approx(0.5)
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    def test_histogram_quantile_inf_bucket_clamps(self):
+        r = MetricsRegistry()
+        h = r.histogram("clamp_seconds", buckets=(1.0, 2.0))
+        h.observe(100.0)              # beyond every finite bound
+        # the honest bucketed answer: the highest finite bound
+        assert h.quantile(0.99) == 2.0
+        assert h.quantile(0.99, ) is not None
+        empty = r.histogram("empty_seconds", buckets=(1.0,))
+        assert empty.quantile(0.5) is None
+
+    def test_histogram_percentiles_in_snapshot_and_prometheus(self):
+        r = MetricsRegistry()
+        h = r.histogram("lat_seconds", "latency", ("handle",),
+                        buckets=(1.0, 2.0, 4.0))
+        for _ in range(50):
+            h.observe(0.5, handle="h1")
+        for _ in range(50):
+            h.observe(3.0, handle="h1")
+        snap = h.snapshot()[0]
+        assert snap["percentiles"]["p50"] == pytest.approx(1.0)
+        assert snap["percentiles"]["p95"] == pytest.approx(3.8)
+        # snapshot stays strict JSON with the percentiles attached
+        json.loads(r.to_json())
+        text = r.to_prometheus()
+        assert '# TYPE lat_seconds_p50 gauge' in text
+        assert 'lat_seconds_p50{handle="h1"} 1' in text
+        assert 'lat_seconds_p95{handle="h1"} 3.8' in text
+        assert 'lat_seconds_p99{handle="h1"}' in text
+
     def test_snapshot_is_strict_json(self):
         r = MetricsRegistry()
         r.counter("c_total", labelnames=("x",)).inc(x="y")

@@ -1,0 +1,635 @@
+"""The microbatching solver service (cuda_mpi_parallel_tpu.serve).
+
+Policy tests drive the service in MANUAL mode with a fake clock - no
+worker thread, time advances only when the test says so - so every
+timing branch (max_wait vs max_batch ordering, deadline expiry) is
+deterministic.  The end-to-end tests prove the service is a pure
+batcher: replayed answers BIT-match direct ``solve_many`` /
+``solve_distributed_many`` calls on the same padded buckets, and
+post-warmup traffic triggers zero new traces.
+"""
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu.models.operators import CSRMatrix
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.serve import (
+    MicroBatchQueue,
+    QueueFull,
+    ServiceClosed,
+    ServiceConfig,
+    SolverService,
+    WorkloadRequest,
+    bucket_for,
+    bucket_sizes,
+    load_workload,
+    rhs_for,
+    save_workload,
+    synthetic_poisson,
+    tol_class,
+)
+from cuda_mpi_parallel_tpu.serve.queue import QueuedRequest
+from cuda_mpi_parallel_tpu.solver.many import stack_columns
+from cuda_mpi_parallel_tpu.telemetry import events
+
+
+class FakeClock:
+    """The test harness's clock: starts at 0, advances on demand."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def manual_service(**kw) -> "tuple[SolverService, FakeClock]":
+    clock = FakeClock()
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_s", 0.010)
+    kw.setdefault("maxiter", 500)
+    svc = SolverService(ServiceConfig(clock=clock, **kw))
+    return svc, clock
+
+
+def poisson_csr(n=12, dtype=np.float64):
+    return poisson.poisson_2d_csr(n, n, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# bucket / tol-class math
+
+
+class TestBucketMath:
+    def test_bucket_sizes_powers_of_two_plus_cap(self):
+        assert bucket_sizes(1) == (1,)
+        assert bucket_sizes(2) == (1, 2)
+        assert bucket_sizes(8) == (1, 2, 4, 8)
+        assert bucket_sizes(6) == (1, 2, 4, 6)
+        assert bucket_sizes(32) == (1, 2, 4, 8, 16, 32)
+        with pytest.raises(ValueError):
+            bucket_sizes(0)
+
+    def test_bucket_for_smallest_fit(self):
+        assert bucket_for(1, 8) == 1
+        assert bucket_for(2, 8) == 2
+        assert bucket_for(3, 8) == 4
+        assert bucket_for(5, 8) == 8
+        assert bucket_for(5, 6) == 6
+        with pytest.raises(ValueError):
+            bucket_for(9, 8)
+        with pytest.raises(ValueError):
+            bucket_for(0, 8)
+
+    def test_tol_class_decades(self):
+        assert tol_class(1e-7) == tol_class(2e-7)
+        assert tol_class(1e-7) != tol_class(1e-3)
+        assert tol_class(0.0) == "exact"
+
+    def test_stack_columns_pads_with_zeros(self):
+        cols = [np.ones(5), 2 * np.ones(5), 3 * np.ones(5)]
+        out = stack_columns(cols, 4)
+        assert out.shape == (5, 4)
+        np.testing.assert_array_equal(out[:, 2], 3.0)
+        np.testing.assert_array_equal(out[:, 3], 0.0)
+        with pytest.raises(ValueError):
+            stack_columns(cols, 2)     # 3 columns cannot fit k=2
+        with pytest.raises(ValueError):
+            stack_columns([], 2)
+
+    def test_zero_pad_lane_freezes_at_iteration_zero(self):
+        """The padding contract: a zero-RHS lane costs 0 iterations."""
+        from cuda_mpi_parallel_tpu.solver import solve_many
+
+        a = poisson_csr(8)
+        rng = np.random.default_rng(3)
+        b = stack_columns([rng.standard_normal(a.shape[0])], 4)
+        res = solve_many(a, b, tol=1e-9, maxiter=400)
+        iters = np.asarray(res.iterations)
+        assert iters[0] > 0
+        np.testing.assert_array_equal(iters[1:], 0)
+        assert bool(np.asarray(res.converged).all())
+
+
+# ---------------------------------------------------------------------------
+# queue policy (pure, no service)
+
+
+def _req(i, t, tol=1e-7, deadline_t=None, handle="h", dtype="float64"):
+    from concurrent.futures import Future
+
+    return QueuedRequest(request_id=f"r{i}", handle_key=handle,
+                         b=np.zeros(3), dtype=dtype, tol=tol,
+                         enqueue_t=t, deadline_t=deadline_t,
+                         future=Future())
+
+
+class TestMicroBatchQueue:
+    def test_full_bucket_dispatches_immediately(self):
+        q = MicroBatchQueue(max_batch=4, max_wait_s=1.0)
+        for i in range(4):
+            q.push(_req(i, t=0.0))
+        batches, timeouts = q.pop_ready(now=0.0)
+        assert not timeouts
+        assert len(batches) == 1 and batches[0].reason == "full"
+        assert batches[0].bucket == 4 and q.depth() == 0
+
+    def test_partial_waits_for_max_wait_then_buckets_up(self):
+        q = MicroBatchQueue(max_batch=4, max_wait_s=0.010)
+        for i in range(3):
+            q.push(_req(i, t=0.0))
+        assert q.pop_ready(now=0.005) == ([], [])   # young: hold
+        batches, _ = q.pop_ready(now=0.010)
+        assert len(batches) == 1
+        b = batches[0]
+        assert b.reason == "max_wait" and b.bucket == 4
+        assert len(b.requests) == 3
+        assert b.occupancy == 0.75 and b.padding_fraction == 0.25
+
+    def test_full_cut_leaves_remainder_on_its_own_clock(self):
+        """5 pending at max_batch=4: the full cut goes now, the
+        leftover waits for ITS max_wait (dispatch ordering)."""
+        q = MicroBatchQueue(max_batch=4, max_wait_s=0.010)
+        for i in range(4):
+            q.push(_req(i, t=0.0))
+        q.push(_req(4, t=0.008))
+        batches, _ = q.pop_ready(now=0.008)
+        assert [b.reason for b in batches] == ["full"]
+        assert q.depth() == 1
+        assert q.pop_ready(now=0.012) == ([], [])   # 4 ms old: hold
+        batches, _ = q.pop_ready(now=0.019)
+        assert [b.reason for b in batches] == ["max_wait"]
+        assert batches[0].bucket == 1
+
+    def test_keys_partition_by_handle_dtype_and_tol_class(self):
+        q = MicroBatchQueue(max_batch=4, max_wait_s=0.0)
+        q.push(_req(0, 0.0, tol=1e-7))
+        q.push(_req(1, 0.0, tol=1e-3))
+        q.push(_req(2, 0.0, tol=1.5e-7))
+        q.push(_req(3, 0.0, tol=1e-7, handle="other"))
+        batches, _ = q.pop_ready(now=0.0)
+        got = sorted((b.key[0], tuple(r.request_id for r in b.requests))
+                     for b in batches)
+        assert got == [("h", ("r0", "r2")), ("h", ("r1",)),
+                       ("other", ("r3",))]
+
+    def test_expired_deadlines_leave_first_and_never_dispatch(self):
+        q = MicroBatchQueue(max_batch=2, max_wait_s=10.0)
+        q.push(_req(0, 0.0, deadline_t=0.005))
+        q.push(_req(1, 0.0))
+        batches, timeouts = q.pop_ready(now=0.006)
+        assert [r.request_id for r in timeouts] == ["r0"]
+        assert not batches and q.depth() == 1
+
+    def test_next_wake_is_min_of_max_wait_and_deadline(self):
+        q = MicroBatchQueue(max_batch=4, max_wait_s=0.010)
+        assert q.next_wake(0.0) is None
+        q.push(_req(0, 0.0))
+        assert q.next_wake(0.0) == pytest.approx(0.010)
+        q.push(_req(1, 0.001, deadline_t=0.004))
+        assert q.next_wake(0.002) == pytest.approx(0.004)
+
+    def test_drain_flushes_regardless_of_age(self):
+        q = MicroBatchQueue(max_batch=4, max_wait_s=10.0)
+        q.push(_req(0, 0.0))
+        batches, _ = q.pop_ready(now=0.0, drain=True)
+        assert [b.reason for b in batches] == ["drain"]
+
+    def test_queue_limit_backpressure(self):
+        q = MicroBatchQueue(max_batch=4, max_wait_s=1.0, queue_limit=2)
+        q.push(_req(0, 0.0))
+        q.push(_req(1, 0.0))
+        with pytest.raises(QueueFull):
+            q.push(_req(2, 0.0))
+
+
+# ---------------------------------------------------------------------------
+# service semantics (manual mode, fake clock)
+
+
+class TestServicePolicy:
+    def test_max_wait_vs_max_batch_ordering(self):
+        svc, clock = manual_service()
+        a = poisson_csr()
+        h = svc.register(a)
+        rng = np.random.default_rng(0)
+        bs = [np.asarray(a @ rng.standard_normal(a.shape[0]))
+              for _ in range(5)]
+        futs = [svc.submit(h, b, tol=1e-8) for b in bs[:3]]
+        assert svc.pump() == 0           # 3 < max_batch, 0 ms old
+        futs.append(svc.submit(h, bs[3], tol=1e-8))
+        assert svc.pump() == 1           # 4th filled the bucket: now
+        assert all(f.result().status == "CONVERGED" for f in futs)
+        assert futs[0].result().bucket == 4
+        f5 = svc.submit(h, bs[4], tol=1e-8)
+        assert svc.pump() == 0           # partial again: held
+        clock.advance(0.010)
+        assert svc.pump() == 1           # max_wait elapsed
+        assert f5.result().bucket == 1
+        svc.close()
+
+    def test_deadline_timeout_is_a_typed_result_not_an_exception(self):
+        svc, clock = manual_service()
+        a = poisson_csr()
+        h = svc.register(a)
+        fut = svc.submit(h, np.ones(a.shape[0]), tol=1e-8,
+                         deadline_s=0.004)
+        clock.advance(0.005)
+        assert svc.pump() == 0
+        res = fut.result(timeout=1)      # resolves, no exception
+        assert res.timed_out and res.status == "TIMEOUT"
+        assert res.x is None and not res.converged
+        assert svc.stats()["timeouts"] == 1
+        svc.close()
+
+    def test_per_lane_failure_isolation(self):
+        """One batch, one hopeless lane: diag(1..32) gives b=e_1 a
+        1-iteration solve while b=ones needs 32 Krylov dimensions -
+        at maxiter=5 the second lane fails ALONE with a typed
+        MAXITER result."""
+        svc, clock = manual_service(max_batch=2, maxiter=5)
+        n = 32
+        a = CSRMatrix.from_dense(np.diag(np.arange(1.0, n + 1)))
+        h = svc.register(a, maxiter=5)
+        e1 = np.zeros(n)
+        e1[1] = 1.0
+        f_easy = svc.submit(h, e1, tol=1e-10)
+        f_hard = svc.submit(h, np.ones(n), tol=1e-10)
+        assert svc.pump() == 1
+        easy, hard = f_easy.result(), f_hard.result()
+        assert easy.status == "CONVERGED" and easy.converged
+        np.testing.assert_allclose(easy.x, e1 / 2.0, atol=1e-12)
+        assert hard.status == "MAXITER" and not hard.converged
+        assert hard.iterations == 5
+        assert easy.solve_id == hard.solve_id   # same batch
+        svc.close()
+
+    def test_engine_error_is_a_typed_result_and_worker_survives(self):
+        """An engine exception resolves every lane to a typed
+        status='ERROR' result (a raised future would blow up any
+        fut.result() loop), and the service keeps serving."""
+        svc, clock = manual_service(max_batch=2)
+        a = poisson_csr()
+        h = svc.register(a)
+        orig_engine = svc._engine
+        svc._engine = lambda *args, **kw: (_ for _ in ()).throw(
+            RuntimeError("boom"))
+        f1 = svc.submit(h, np.ones(a.shape[0]), tol=1e-8)
+        f2 = svc.submit(h, np.ones(a.shape[0]), tol=1e-8)
+        assert svc.pump() == 1
+        for f in (f1, f2):
+            res = f.result(timeout=1)          # resolves, never raises
+            assert res.status == "ERROR"
+            assert not res.converged and not res.timed_out
+            assert res.x is None
+        assert svc.stats()["errors"] == 2
+        svc._engine = orig_engine              # service lives on
+        f3 = svc.submit(h, np.ones(a.shape[0]), tol=1e-8)
+        svc.drain()
+        assert f3.result().status == "CONVERGED"
+        svc.close()
+
+    def test_drain_and_close_semantics(self):
+        svc, clock = manual_service()
+        a = poisson_csr()
+        h = svc.register(a)
+        rng = np.random.default_rng(1)
+        futs = [svc.submit(h, np.asarray(a @ rng.standard_normal(
+            a.shape[0])), tol=1e-8) for _ in range(2)]
+        assert svc.pump() == 0           # young partial batch: held
+        svc.drain()                      # flushes regardless of age
+        assert all(f.result().converged for f in futs)
+        assert svc.queue_depth() == 0
+        svc.close()
+        svc.close()                      # idempotent
+        with pytest.raises(ServiceClosed):
+            svc.submit(h, np.ones(a.shape[0]))
+
+    def test_backpressure_bounded_queue(self):
+        svc, clock = manual_service(queue_limit=2, max_batch=8)
+        a = poisson_csr()
+        h = svc.register(a)
+        svc.submit(h, np.ones(a.shape[0]))
+        svc.submit(h, np.ones(a.shape[0]))
+        with pytest.raises(QueueFull):
+            svc.submit(h, np.ones(a.shape[0]))
+        svc.drain()
+        svc.close()
+
+    def test_register_is_idempotent_and_validates(self):
+        svc, _ = manual_service()
+        a = poisson_csr()
+        h1 = svc.register(a)
+        h2 = svc.register(a)
+        assert h1 is h2
+        with pytest.raises(ValueError):
+            svc.register(a, precond="chebyshev")
+        with pytest.raises(ValueError):
+            svc.register(a, method="nope")
+        with pytest.raises(ValueError):
+            svc.register(a, exchange="gather")   # needs a mesh
+        with pytest.raises(ValueError):
+            svc.submit(h1, np.ones(3))           # wrong length
+        svc.close()
+
+    def test_reregister_warms_a_deferred_handle(self):
+        """register(warm=False) then register() must pay the warmup on
+        the second call - the dedup early-return must not leave live
+        traffic compiling inside request latency."""
+        svc, _ = manual_service()
+        a = poisson_csr()
+        warms = []
+        orig = svc._warm
+        svc._warm = lambda h: (warms.append(h.key), orig(h))[1]
+        h1 = svc.register(a, warm=False)
+        assert warms == [] and not h1.warmed
+        h2 = svc.register(a)
+        assert h2 is h1 and warms == [h1.key] and h1.warmed
+        svc.register(a)                  # already warmed: no re-warm
+        assert warms == [h1.key]
+        svc.close()
+
+    def test_submit_unknown_handle_refuses(self):
+        svc, _ = manual_service(warm=False)
+        other, _ = manual_service(warm=False)
+        a = poisson_csr()
+        h = other.register(a, warm=False)
+        with pytest.raises(ValueError):
+            svc.submit(h, np.ones(a.shape[0]))
+        svc.close()
+        other.close()
+
+
+# ---------------------------------------------------------------------------
+# observability
+
+
+class TestServiceObservability:
+    def test_events_schema_valid_and_solve_id_linked(self):
+        svc, clock = manual_service()
+        a = poisson_csr()
+        with events.capture() as buf:
+            h = svc.register(a)
+            rng = np.random.default_rng(2)
+            futs = [svc.submit(h, np.asarray(
+                a @ rng.standard_normal(a.shape[0])), tol=1e-8)
+                for _ in range(4)]
+            svc.pump()
+        [f.result() for f in futs]
+        recs = [json.loads(ln) for ln in
+                buf.getvalue().splitlines() if ln.strip()]
+        for rec in recs:
+            events.validate_event(rec)
+        enq = [r for r in recs if r["event"] == "request_enqueued"]
+        disp = [r for r in recs if r["event"] == "batch_dispatch"
+                and r.get("phase") != "warmup"]
+        done = [r for r in recs if r["event"] == "request_done"]
+        assert len(enq) == 4 and len(done) == 4
+        assert len(disp) == 1
+        assert disp[0]["n_requests"] == 4 and disp[0]["bucket"] == 4
+        # linkage: the dispatch, its engine selection and every
+        # request_done share ONE solve_id
+        sid = disp[0]["solve_id"]
+        assert sid is not None
+        engines = [r for r in recs if r["event"] == "engine_selected"
+                   and r["solve_id"] == sid]
+        assert engines and engines[0]["engine"] == "many"
+        assert all(r["solve_id"] == sid for r in done)
+        assert {r["status"] for r in done} == {"CONVERGED"}
+        svc.close()
+
+    def test_metrics_gauges_and_latency_percentiles(self):
+        from cuda_mpi_parallel_tpu.telemetry.registry import REGISTRY
+
+        svc, clock = manual_service()
+        a = poisson_csr()
+        h = svc.register(a)
+        rng = np.random.default_rng(4)
+        futs = [svc.submit(h, np.asarray(
+            a @ rng.standard_normal(a.shape[0])), tol=1e-8)
+            for _ in range(3)]
+        clock.advance(0.020)
+        svc.pump()
+        [f.result() for f in futs]
+        occ = REGISTRY.gauge("serve_batch_occupancy",
+                             labelnames=("handle",))
+        assert occ.value(handle=h.key) == 0.75
+        pad = REGISTRY.gauge("serve_batch_padding_fraction",
+                             labelnames=("handle",))
+        assert pad.value(handle=h.key) == 0.25
+        from cuda_mpi_parallel_tpu.serve.service import LATENCY_BUCKETS
+
+        hist = REGISTRY.histogram(
+            "serve_request_latency_seconds", labelnames=("handle",),
+            buckets=LATENCY_BUCKETS)
+        assert hist.value(handle=h.key)["count"] >= 3
+        assert hist.quantile(0.95, handle=h.key) is not None
+        stats = svc.stats()
+        assert stats["latency"]["p50_s"] is not None
+        assert stats["latency"]["p95_s"] >= stats["latency"]["p50_s"]
+        assert stats["occupancy_mean"] == 0.75
+        assert stats["bucket_counts"] == {"4": 1}
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# workload files
+
+
+class TestWorkload:
+    def test_synthetic_poisson_shape_and_determinism(self):
+        w1 = synthetic_poisson(16, 1000.0, seed=5)
+        w2 = synthetic_poisson(16, 1000.0, seed=5)
+        assert w1 == w2
+        assert w1[0].t == 0.0
+        assert all(b.t >= a.t for a, b in zip(w1, w1[1:]))
+        assert len({r.seed for r in w1}) == 16
+
+    def test_roundtrip_and_validation(self, tmp_path):
+        path = str(tmp_path / "wl.json")
+        reqs = [WorkloadRequest(t=0.0, seed=1),
+                WorkloadRequest(t=0.5, seed=2, tol=1e-5,
+                                deadline_s=0.25)]
+        save_workload(path, reqs)
+        assert load_workload(path) == reqs
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump({"version": 2}, f)
+        with pytest.raises(ValueError):
+            load_workload(bad)
+
+    def test_rhs_for_known_solution(self):
+        a = poisson_csr(8)
+        b, x_true = rhs_for(a, seed=7)
+        np.testing.assert_allclose(
+            b, np.asarray(a.to_dense() @ x_true), atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the service is a pure batcher
+
+
+class TestEndToEnd:
+    def test_replay_bit_matches_direct_solve_many(self):
+        """3 requests pad to a k=4 bucket; the dispatched answer must
+        BIT-match a direct solve_many call on the same padded stack
+        (the service adds queueing, never arithmetic)."""
+        from cuda_mpi_parallel_tpu.solver import solve_many
+
+        svc, clock = manual_service()
+        a = poisson_csr(10)
+        h = svc.register(a)
+        rng = np.random.default_rng(6)
+        cols = [np.asarray(a @ rng.standard_normal(a.shape[0]))
+                for _ in range(3)]
+        tol = 1e-9
+        futs = [svc.submit(h, c, tol=tol) for c in cols]
+        clock.advance(0.010)
+        assert svc.pump() == 1
+        results = [f.result() for f in futs]
+        b_direct = stack_columns(cols, 4, dtype=np.float64)
+        tols = np.full((4,), tol)
+        direct = solve_many(a, b_direct, tol=tols,
+                            maxiter=svc.config.maxiter)
+        dx = np.asarray(direct.x)
+        for j, res in enumerate(results):
+            assert np.array_equal(res.x, dx[:, j])
+            assert res.iterations == int(direct.iterations[j])
+        svc.close()
+
+    @pytest.mark.skipif(len(jax.devices()) < 4,
+                        reason="needs 4 (virtual) devices")
+    def test_mesh4_replay_bit_matches_and_never_retraces(self):
+        """Mesh-4 end-to-end: a replayed bursty workload's answers
+        bit-match direct solve_distributed_many calls on the same
+        buckets, and the second identical bucket triggers ZERO new
+        traces (the dist_cg solver cache serves it)."""
+        from cuda_mpi_parallel_tpu.models import mmio
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            make_mesh,
+            solve_distributed_many,
+        )
+
+        dist_cg.clear_solver_cache()
+        a = mmio.load_matrix_market(
+            "tests/fixtures/skewed_spd_240.mtx", dtype=np.float64)
+        mesh = make_mesh(4)
+        svc, clock = manual_service(max_batch=4, maxiter=500)
+        h = svc.register(a, mesh=mesh)
+        tol = 1e-8
+        rng = np.random.default_rng(8)
+        cols = [np.asarray(a @ rng.standard_normal(a.shape[0]))
+                for _ in range(8)]
+        # burst 1: full bucket; burst 2: same bucket shape again
+        futs1 = [svc.submit(h, c, tol=tol) for c in cols[:4]]
+        assert svc.pump() == 1
+        traces_after_first = dist_cg._TRACE_COUNT[0]
+        futs2 = [svc.submit(h, c, tol=tol) for c in cols[4:]]
+        assert svc.pump() == 1
+        assert dist_cg._TRACE_COUNT[0] == traces_after_first, \
+            "second identical bucket re-traced the solver"
+        results = [f.result() for f in futs1 + futs2]
+        assert all(r.status == "CONVERGED" for r in results)
+        for burst, offset in ((cols[:4], 0), (cols[4:], 4)):
+            direct = solve_distributed_many(
+                a, stack_columns(burst, 4, dtype=np.float64),
+                mesh=mesh, tol=np.full((4,), tol), maxiter=500)
+            dx = np.asarray(direct.x)
+            for j in range(4):
+                assert np.array_equal(results[offset + j].x, dx[:, j])
+        svc.close()
+
+    @pytest.mark.skipif(len(jax.devices()) < 4,
+                        reason="needs 4 (virtual) devices")
+    def test_mesh_register_partitions_once(self, monkeypatch):
+        """The dispatch hot path never re-runs the O(nnz) host setup:
+        register() partitions once (ManyRHSDispatcher); every later
+        batch only pads/shards b."""
+        from cuda_mpi_parallel_tpu.models import mmio
+        from cuda_mpi_parallel_tpu.parallel import dist_cg, make_mesh
+
+        calls = [0]
+        orig = dist_cg.part.partition_csr
+
+        def counting(*a, **kw):
+            calls[0] += 1
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(dist_cg.part, "partition_csr", counting)
+        a = mmio.load_matrix_market(
+            "tests/fixtures/skewed_spd_240.mtx", dtype=np.float64)
+        svc, clock = manual_service(max_batch=2, maxiter=500)
+        h = svc.register(a, mesh=make_mesh(4))
+        assert calls[0] == 1
+        rng = np.random.default_rng(12)
+        futs = [svc.submit(h, np.asarray(
+            a @ rng.standard_normal(a.shape[0])), tol=1e-8)
+            for _ in range(4)]
+        svc.drain()
+        assert all(f.result().converged for f in futs)
+        assert calls[0] == 1, "a dispatch re-partitioned the operator"
+        svc.close()
+
+    @pytest.mark.skipif(len(jax.devices()) < 4,
+                        reason="needs 4 (virtual) devices")
+    def test_mesh4_zero_cache_misses_after_warmup(self):
+        """The zero-retrace acceptance at the metrics level: after
+        register()'s per-bucket warmup, a whole replayed workload adds
+        ZERO dist_cache_miss (phase='solve') counts."""
+        from cuda_mpi_parallel_tpu.models import mmio
+        from cuda_mpi_parallel_tpu.parallel import dist_cg, make_mesh
+        from cuda_mpi_parallel_tpu.telemetry.registry import REGISTRY
+
+        dist_cg.clear_solver_cache()
+        a = mmio.load_matrix_market(
+            "tests/fixtures/skewed_spd_240.mtx", dtype=np.float64)
+        svc, clock = manual_service(max_batch=4, maxiter=500)
+        h = svc.register(a, mesh=make_mesh(4))
+        misses = REGISTRY.counter("dist_solver_cache_misses_total",
+                                  labelnames=("phase",))
+        before = misses.value(phase="solve")
+        rng = np.random.default_rng(9)
+        futs = []
+        for i in range(10):
+            futs.append(svc.submit(
+                h, np.asarray(a @ rng.standard_normal(a.shape[0])),
+                tol=1e-8))
+            clock.advance(0.011)
+            svc.pump()            # mixed bucket sizes: 1s and stragglers
+        svc.drain()
+        assert all(f.result().converged for f in futs)
+        assert misses.value(phase="solve") == before, \
+            "post-warmup service traffic missed the solver cache"
+        svc.close()
+
+
+class TestThreadedMode:
+    def test_threaded_service_end_to_end(self):
+        """The real-clock worker thread: submit a burst, futures
+        resolve without any pump() calls."""
+        svc = SolverService(ServiceConfig(
+            max_batch=4, max_wait_s=0.005, maxiter=500))
+        try:
+            a = poisson_csr()
+            h = svc.register(a)
+            rng = np.random.default_rng(11)
+            futs = [svc.submit(h, np.asarray(
+                a @ rng.standard_normal(a.shape[0])), tol=1e-8)
+                for _ in range(6)]
+            results = [f.result(timeout=30) for f in futs]
+            assert all(r.converged for r in results)
+            # at least one batch coalesced >= 2 requests (exact
+            # bucketing depends on thread scheduling - submits race
+            # the worker's max_wait clock)
+            assert max(r.bucket for r in results) >= 2
+            assert svc.stats()["completed"] == 6
+        finally:
+            svc.close()

@@ -116,6 +116,20 @@ METRIC_DIRECTION = {
     "many_wire.wire_bytes_per_solve_batched": None,
     "many_wire.wire_bytes_per_solve_sequential8": None,
     "many_wire.wire_amortization_x": None,
+    # solver-service columns (PR 10, serve/): offered-load replay
+    # throughput, latency percentiles, batch occupancy and the
+    # service-vs-max_batch=1 speedup.  Reported, never gated - replay
+    # walls track host scheduling weather as much as code; pre-PR-10
+    # files simply lack them (rendered n/a).
+    "serve.solved_rhs_per_sec": None,
+    "serve.unbatched_rhs_per_sec": None,
+    "serve.speedup_vs_unbatched": None,
+    "serve.p50_latency_s": None,
+    "serve.p95_latency_s": None,
+    "serve.p99_latency_s": None,
+    "serve.occupancy_mean": None,
+    "serve.padding_fraction": None,
+    "serve.timeouts": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -160,6 +174,10 @@ _NESTED = {
     "many_wire": ("wire_bytes_per_solve_batched",
                   "wire_bytes_per_solve_sequential8",
                   "wire_amortization_x"),
+    "serve": ("solved_rhs_per_sec", "unbatched_rhs_per_sec",
+              "speedup_vs_unbatched", "p50_latency_s", "p95_latency_s",
+              "p99_latency_s", "occupancy_mean", "padding_fraction",
+              "timeouts"),
 }
 
 

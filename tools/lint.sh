@@ -276,6 +276,61 @@ print(f"many-RHS gate: {batched['iterations']} block iters vs "
 PY
 echo "many-RHS gate: clean"
 
+# Solver-service gate: a mesh-4 CLI `serve` replay of 32 Poisson-
+# arrival requests against one registered operator, with the full
+# event stream on.  Asserts (a) every event line is schema-valid,
+# (b) every non-timeout request CONVERGED and its answer matched the
+# known per-seed solution, (c) at least one dispatched batch coalesced
+# >= 2 requests (the microbatcher actually batched), and (d) ZERO
+# dist_cache_miss events outside the registration warmup - post-warmup
+# traffic runs entirely on the compiled-solver cache (the service's
+# zero-retrace acceptance).
+echo "== serve gate (mesh-4 CLI serve: replay batches, zero retrace) =="
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli serve \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --requests 32 --rate 2000 --max-batch 8 --tol 1e-8 --maxiter 500 \
+    --seed 3 --json \
+    --trace-events "$scratch/serve_events.jsonl" \
+    > "$scratch/serve.json"
+python tools/validate_trace.py "$scratch/serve_events.jsonl"
+python - "$scratch" <<'PY'
+import json
+import sys
+
+scratch = sys.argv[1]
+with open(f"{scratch}/serve.json") as f:
+    rec = json.load(f)
+events = [json.loads(ln)
+          for ln in open(f"{scratch}/serve_events.jsonl")
+          if ln.strip()]
+assert rec["stats"]["rejected"] == 0, rec["stats"]
+live = [r for r in rec["requests"]
+        if not r["timed_out"] and r["status"] != "REJECTED"]
+assert live, "no completed requests"
+assert all(r["status"] == "CONVERGED" for r in live), \
+    [r["status"] for r in rec["requests"]]
+assert all(r["max_abs_error"] < 1e-5 for r in live), \
+    max(r["max_abs_error"] for r in live)
+dispatches = [e for e in events if e["event"] == "batch_dispatch"
+              and e.get("phase") != "warmup"]
+assert dispatches, "no batch_dispatch events"
+best = max(e["n_requests"] for e in dispatches)
+assert best >= 2, f"no batch coalesced >= 2 requests (best {best})"
+misses = [e for e in events if e["event"] == "dist_cache_miss"
+          and e.get("phase") != "warmup"]
+assert not misses, \
+    f"{len(misses)} post-warmup dist_cache_miss events (retrace!)"
+stats = rec["stats"]
+assert stats["dist_cache_misses_postwarm"] == 0, stats
+print(f"serve gate: {stats['completed']} requests in "
+      f"{stats['batches']} batches (best occupancy {best} lanes, "
+      f"mean {stats['occupancy_mean']:.2f}), p95 "
+      f"{stats['latency']['p95_s'] * 1e3:.1f} ms, "
+      f"{stats['solved_rhs_per_sec']:.1f} solved RHS/s, "
+      f"0 post-warmup cache misses")
+PY
+echo "serve gate: clean"
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
