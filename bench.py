@@ -575,6 +575,48 @@ def _exchange_entry(entry, n_shards, key="exchange"):
     return entry
 
 
+def _phase_entry(entry, n_shards, key="phase"):
+    """Measured phase-profile columns for the distributed row
+    (telemetry.phasetrace on the committed skewed fixture, gather
+    lane): per-phase seconds-per-iteration shares, the measured
+    per-shard SpMV stall factor, per-link wire bandwidths and the
+    explained-fraction residual check.  Real measured mesh dispatches
+    (240 rows) under the same never-sink-the-run contract as
+    ``_efficiency_entry``; reported by bench_compare, never gated."""
+    try:
+        from cuda_mpi_parallel_tpu.models import mmio
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+        from cuda_mpi_parallel_tpu.telemetry import phasetrace
+        from cuda_mpi_parallel_tpu.utils.logging import sanitize
+
+        a = mmio.load_matrix_market("tests/fixtures/skewed_spd_240.mtx")
+        p = phasetrace.profile_distributed(
+            a, mesh=make_mesh(n_shards), exchange="gather")
+        total = max(p.critical_path_s(), 1e-30)
+        entry[key] = sanitize({
+            "n_shards": n_shards,
+            "exchange": p.exchange,
+            "halo_s_per_iter": p.halo_s,
+            "spmv_s_per_iter": p.spmv_mesh_s,
+            "reduction_s_per_iter":
+                p.reduction_s * p.reductions_per_iteration,
+            "halo_share": round(p.halo_s / total, 4),
+            "spmv_share": round(p.spmv_mesh_s / total, 4),
+            "reduction_share": round(
+                p.reduction_s * p.reductions_per_iteration / total, 4),
+            "spmv_stall_factor": round(p.stall_factors()["spmv"], 4),
+            "explained_fraction": round(p.explained_fraction(), 4),
+            "link_bytes_per_s": {
+                str(link["shift"]): round(link["bytes_per_s"], 1)
+                for link in p.links},
+            "note": "measured phase profile of the committed skewed "
+                    "240-row fixture, gather lane",
+        })
+    except Exception as e:  # pragma: no cover - defensive
+        entry[key] = {"error": str(e)[-200:]}
+    return entry
+
+
 def _many_rhs_wire_entry(entry, n_shards, key="many_wire"):
     """Per-solve halo-wire columns of a batched mesh solve
     (parallel.solve_distributed_many on the committed skewed fixture):
@@ -1439,6 +1481,9 @@ def bench_all(results, sections=None) -> None:
                 # gather-vs-allgather exchange row: the halo wire win
                 # (and its padding cost) measured on the same fixture
                 _exchange_entry(entry, n_shards=ndev)
+                # measured phase profile: per-phase s/iter shares,
+                # spmv stall factor, per-link bandwidths, explained %
+                _phase_entry(entry, n_shards=ndev)
             results[f"poisson3d_{grid[0]}x{grid[1]}x{grid[2]}"
                     f"_mesh{ndev}"] = entry
         if ndev >= 4 and ndev % 2 == 0:

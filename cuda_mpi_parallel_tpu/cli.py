@@ -223,6 +223,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "measurably fewer iterations, with Gram "
                         "breakdown falling back to the batched "
                         "recurrence automatically")
+    p.add_argument("--phase-profile", nargs="?", const=0, default=None,
+                   type=int, metavar="R", dest="phase_profile",
+                   help="after a distributed solve, measure its phase "
+                        "profile (telemetry.phasetrace): phase-"
+                        "isolated step functions built from the "
+                        "partitioned operator's own building blocks - "
+                        "the halo exchange alone (each gather round "
+                        "individually -> per-link bandwidths), the "
+                        "local CSR SpMV alone (per shard -> measured "
+                        "stall factor), the dot+psum reduction alone - "
+                        "each timed over R chained reps (default "
+                        "phasetrace.DEFAULT_REPEATS) under the real "
+                        "mesh.  Feeds MEASURED Perfetto spans "
+                        "(--trace-perfetto span_source=measured), a "
+                        "phase_profile event, the report's phase "
+                        "section, and a phase-resolved calibration "
+                        "that reaches the lstsq2 confident tier from "
+                        "this ONE solve (no --repeat needed).  "
+                        "Assembled-CSR problems with --mesh > 1, "
+                        "general engine")
     p.add_argument("--history", action="store_true",
                    help="print per-iteration residual trace")
     p.add_argument("--flight-record", nargs="?", const=1, default=None,
@@ -591,6 +611,49 @@ def main(argv=None) -> int:
             raise SystemExit(
                 "--precond bjacobi is single-device only (use jacobi "
                 "or chebyshev with --mesh)")
+
+    # Phase profiling (--phase-profile): the measured per-shard
+    # per-phase timing runs on the general distributed CSR lanes only
+    # (they are the operators whose building blocks the profiler
+    # isolates).  Same never-silently-drop rule as every other flag.
+    if args.phase_profile is not None:
+        from .models.operators import CSRMatrix
+
+        if args.phase_profile < 0:
+            raise SystemExit(f"--phase-profile reps must be >= 0, got "
+                             f"{args.phase_profile} (0/bare flag = the "
+                             f"default rep count)")
+        if args.mesh <= 1:
+            raise SystemExit("--phase-profile needs --mesh > 1 (it "
+                             "times the distributed halo/spmv/"
+                             "reduction phases)")
+        if not isinstance(a, CSRMatrix):
+            raise SystemExit(
+                "--phase-profile applies to assembled-CSR problems "
+                "(the partitioned-operator lanes); stencil slabs fuse "
+                "their phases in one kernel")
+        if args.engine in ("resident", "streaming"):
+            raise SystemExit(
+                f"--phase-profile with --engine {args.engine} is "
+                f"unsupported: the one-kernel engines fuse their "
+                f"phases on device (use --engine general/auto)")
+        if args.df64:
+            raise SystemExit(
+                "--phase-profile does not support --dtype df64 (the "
+                "distributed df64 path is the fused ring-shiftell "
+                "schedule)")
+        if args.csr_comm == "ring-shiftell":
+            raise SystemExit(
+                "--phase-profile does not support --csr-comm "
+                "ring-shiftell (the pallas slab kernel fuses its "
+                "phases; use the csr ring lane)")
+        if args.rhs > 1:
+            raise SystemExit(
+                "--phase-profile with --rhs is unsupported (the "
+                "profiler times single-vector phases, which cannot be "
+                "honestly compared against a k-column solve's "
+                "per-iteration wall; profile a single-RHS solve of "
+                "the same system)")
 
     # Many-RHS batching (--rhs K): the refusal matrix.  Every path that
     # cannot carry a column stack refuses LOUDLY here - silently
@@ -1289,6 +1352,39 @@ def main(argv=None) -> int:
         obs.finish(result, elapsed_s=elapsed, health=health,
                    **({"comm": comm} if comm is not None else {}))
 
+        # Measured phase profiling (telemetry.phasetrace): its OWN
+        # dispatches against the same partition the solve ran - the
+        # solve's compiled body is untouched (jaxpr-identity proven in
+        # tests/test_phasetrace.py).  Runs inside the solve's event
+        # scope so the phase_profile event shares this solve_id (the
+        # offline tools/solve_report.py fuses it back by that id).
+        # One profiled solve yields the phase-resolved observations
+        # that reach the lstsq2 confident calibration tier without
+        # --repeat; the fit (with per-link wire bandwidths when the
+        # gather lane ran) is persisted for future plans exactly like
+        # a --repeat calibration.
+        phase_profile_obj = None
+        phase_fit = None
+        if args.phase_profile is not None:
+            from .parallel import make_mesh as _make_mesh
+            from .telemetry import calibrate as _tcal2
+            from .telemetry import phasetrace as _pt
+
+            reps = args.phase_profile or _pt.DEFAULT_REPEATS
+            with obs.section("phase-profile"):
+                phase_profile_obj = _pt.profile_distributed(
+                    a, mesh=_make_mesh(args.mesh), plan=plan_obj,
+                    csr_comm=args.csr_comm, exchange=args.exchange,
+                    repeats=reps,
+                    solve_iterations=int(result.iterations),
+                    solve_elapsed_s=float(elapsed))
+                _pt.note_profile(phase_profile_obj)
+            phase_fit = _tcal2.fit_machine_model(
+                _tcal2.observations_from_profile(phase_profile_obj),
+                per_link=phase_profile_obj.links)
+            _tcal2.note_calibration(phase_fit)
+            _tcal2.store_calibration(phase_fit)
+
     x_np = np.asarray(result.x)
     if rcm_perm is not None:  # scatter back to the original ordering
         x_orig = np.empty_like(x_np)
@@ -1395,6 +1491,11 @@ def main(argv=None) -> int:
         calib_entry = ulog.sanitize({"drift": dr.to_json()})
     if calib_entry is not None:
         record["calibration"] = calib_entry
+    if phase_profile_obj is not None:
+        record["phase_profile"] = ulog.sanitize({
+            **phase_profile_obj.to_json(),
+            "calibration": phase_fit.to_json(),
+        })
     if flight_rec is not None:
         record["flight"] = flight_rec.summary()
     if health is not None:
@@ -1437,6 +1538,7 @@ def main(argv=None) -> int:
             flight_summary=record.get("flight"),
             health=record.get("health"),
             comm=comm, calibration=calib_entry,
+            phase=record.get("phase_profile"),
             sections=tuple(obs.timer.sections))
         if args.report is not None and args.report != "-":
             with open(args.report, "w", encoding="utf-8") as f:
@@ -1454,7 +1556,8 @@ def main(argv=None) -> int:
                 elapsed_s=float(elapsed), shard=shard_rep,
                 n_shards=args.mesh,
                 sections=tuple(obs.timer.sections),
-                flight_history=hist, label=desc)
+                flight_history=hist,
+                phase_profile=phase_profile_obj, label=desc)
             treport.write_perfetto(args.trace_perfetto, trace)
 
     if args.json:
@@ -1514,6 +1617,12 @@ def main(argv=None) -> int:
         if seq is not None:
             for line in seq.describe_lines():
                 print(line)
+        if phase_profile_obj is not None:
+            from .telemetry.report import phase_lines as _phase_lines
+
+            for line in _phase_lines(record["phase_profile"]):
+                print(f"phase   : {line}")
+            print(f"phase   : calibration {phase_fit.describe()}")
         if health is not None:
             print(f"health  : {health.classification.name}: "
                   f"{health.message}")

@@ -113,6 +113,15 @@ class GatherSchedule:
     def wire_bytes_per_matvec(self, itemsize: int) -> int:
         return self.wire_entries_per_device() * int(itemsize)
 
+    def round_wire_bytes(self, itemsize: int) -> Tuple[int, ...]:
+        """Per-round padded bytes each device ships (== receives) -
+        one entry per live round, in round order.  Sums to
+        :meth:`wire_bytes_per_matvec`; the phase profiler
+        (``telemetry.phasetrace``) divides each round's measured wall
+        seconds by its entry here to fit a per-link bandwidth, which
+        only separates links when the payloads differ."""
+        return tuple(r.m * int(itemsize) for r in self.rounds)
+
     def padding_fraction(self) -> float:
         """Fraction of shipped entries that are pad-to-max filler.
 

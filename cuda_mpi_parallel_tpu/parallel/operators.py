@@ -308,16 +308,28 @@ class DistCSR(LinearOperator):
     def dtype(self):
         return self.data.dtype
 
+    def gather_x(self, x):
+        """The halo-exchange phase alone: materialize the full x (or an
+        ``(n, k)`` stack) on every device with one ``all_gather``.  The
+        building block ``telemetry.phasetrace`` times in isolation -
+        matvec/matmat compose it with :meth:`local_matvec`, so the
+        profiled phase IS the solve's wire, not a reimplementation."""
+        return lax.all_gather(x, self.axis_name, axis=0, tiled=True)
+
+    def local_matvec(self, x_full):
+        """The local-SpMV phase alone: this shard's CSR block against an
+        already-gathered full x."""
+        return spmv.csr_matvec(self.data, self.cols, self.local_rows,
+                               x_full, self.n_local)
+
     def matvec(self, x):
-        x_full = lax.all_gather(x, self.axis_name, tiled=True)
-        return spmv.csr_matvec(self.data, self.cols, self.local_rows, x_full,
-                               self.n_local)
+        return self.local_matvec(self.gather_x(x))
 
     def matmat(self, x):
         # ONE all_gather carries all k columns: the batched solve's
         # per-iteration collective count equals the single-RHS solve's,
         # so exchange latency amortizes over the whole lane stack
-        x_full = lax.all_gather(x, self.axis_name, axis=0, tiled=True)
+        x_full = self.gather_x(x)
         return spmv.csr_matmat(self.data, self.cols, self.local_rows,
                                x_full, self.n_local)
 
@@ -370,27 +382,40 @@ class DistCSRGather(LinearOperator):
     def dtype(self):
         return self.data.dtype
 
-    def matvec(self, x):
+    def exchange_round(self, x, i: int):
+        """Round ``i`` of the compiled halo schedule, alone: gather this
+        shard's coupled entries for rotation peer ``shifts[i]`` and ship
+        them with one ``ppermute``.  The per-round building block
+        ``telemetry.phasetrace`` times individually (per-neighbor-round
+        wire seconds -> per-link bandwidth); the matvec runs exactly
+        these rounds, so profiled and solved wires are one code path."""
+        perm = rotation_perm(self.n_shards, self.shifts[i])
+        return lax.ppermute(jnp.take(x, self.send_idx[i], axis=0),
+                            self.axis_name, perm=perm)
+
+    def extend_x(self, x):
+        """The whole halo-exchange phase: run every round and build the
+        extended-x layout ``[local block | round recvs...]``.  Works for
+        a vector or an ``(n_local, k)`` stack - each round's ppermute
+        then carries an ``(m_r, k)`` slab (extended-x becomes
+        extended-X, schedule and padding accounting unchanged)."""
         parts = [x]
-        for shift, idx in zip(self.shifts, self.send_idx):
-            perm = rotation_perm(self.n_shards, shift)
-            parts.append(lax.ppermute(jnp.take(x, idx, axis=0),
-                                      self.axis_name, perm=perm))
-        x_ext = jnp.concatenate(parts) if len(parts) > 1 else x
+        for i in range(len(self.shifts)):
+            parts.append(self.exchange_round(x, i))
+        return jnp.concatenate(parts, axis=0) if len(parts) > 1 else x
+
+    def local_matvec(self, x_ext):
+        """The local-SpMV phase alone, over an already-extended x."""
         return spmv.csr_matvec(self.data, self.cols, self.local_rows,
                                x_ext, self.n_local)
 
+    def matvec(self, x):
+        return self.local_matvec(self.extend_x(x))
+
     def matmat(self, x):
         # the same compiled rounds, each ppermute carrying an
-        # (m_r, k) slab: extended-x becomes extended-X, the schedule -
-        # and its padding accounting - is unchanged, and the per-round
-        # wire serves every lane at once
-        parts = [x]
-        for shift, idx in zip(self.shifts, self.send_idx):
-            perm = rotation_perm(self.n_shards, shift)
-            parts.append(lax.ppermute(jnp.take(x, idx, axis=0),
-                                      self.axis_name, perm=perm))
-        x_ext = jnp.concatenate(parts, axis=0) if len(parts) > 1 else x
+        # (m_r, k) slab: the per-round wire serves every lane at once
+        x_ext = self.extend_x(x)
         return spmv.csr_matmat(self.data, self.cols, self.local_rows,
                                x_ext, self.n_local)
 
@@ -446,20 +471,30 @@ class DistCSRRing(LinearOperator):
     def dtype(self):
         return self.data[0].dtype  # data is a per-step tuple of slabs
 
+    def rotate(self, xb):
+        """One ring rotation of the resident x-block, alone: the halo
+        building block ``telemetry.phasetrace`` times per step (the
+        ring's fixed ``n_local``-entry wire).  After one shift shard
+        ``i`` holds block ``i + 1`` - at step ``t`` it holds block
+        ``(i + t) % n``, matching the pre-arranged slab order."""
+        ring = validate_permutation(
+            (j, (j - 1) % self.n_shards) for j in range(self.n_shards))
+        return lax.ppermute(xb, self.axis_name, perm=ring)
+
+    def step_matvec(self, t: int, xb):
+        """Step ``t``'s local slab multiply, alone (the SpMV phase of
+        one ring step, against whichever block is resident)."""
+        return spmv.csr_matvec(self.data[t], self.cols[t],
+                               self.local_rows[t], xb, self.n_local)
+
     def matvec(self, x):
         n = self.n_shards
-        # receive from the next shard: after one shift, shard i holds
-        # block i+1; at step t it holds block (i + t) % n, matching the
-        # pre-arranged slab order
-        ring = validate_permutation(
-            (j, (j - 1) % n) for j in range(n))
         y = jnp.zeros_like(x)
         xb = x
         for t in range(n):  # static unroll: n is a mesh constant
-            y = y + spmv.csr_matvec(self.data[t], self.cols[t],
-                                    self.local_rows[t], xb, self.n_local)
+            y = y + self.step_matvec(t, xb)
             if t + 1 < n:
-                xb = lax.ppermute(xb, self.axis_name, perm=ring)
+                xb = self.rotate(xb)
         return y
 
     def diagonal(self):

@@ -94,6 +94,15 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "runs balance.plan_partition ONCE at "
                         "registration, 'even' (default) keeps the "
                         "uniform split")
+    p.add_argument("--phase-profile", nargs="?", const=0, default=None,
+                   type=int, metavar="R", dest="phase_profile",
+                   help="measure the registered operator's phase "
+                        "profile at warmup (telemetry.phasetrace: "
+                        "halo / per-shard spmv / reduction walls, R "
+                        "chained reps per phase - default "
+                        "phasetrace.DEFAULT_REPEATS) and report it; "
+                        "needs --mesh > 1.  Profiling runs once at "
+                        "registration, never inside request latency")
     p.add_argument("--trace-events", default=None, metavar="PATH",
                    dest="trace_events",
                    help="append the service + solve event stream "
@@ -146,6 +155,15 @@ def main(argv=None) -> int:
                          f"{args.max_wait_ms}")
     if args.mesh <= 1 and args.exchange is not None:
         raise SystemExit("--exchange needs --mesh > 1")
+    if args.phase_profile is not None:
+        if args.mesh <= 1:
+            raise SystemExit("--phase-profile needs --mesh > 1 (the "
+                             "profiler times the distributed halo/"
+                             "spmv/reduction phases)")
+        if args.phase_profile < 0:
+            raise SystemExit(f"--phase-profile reps must be >= 0, got "
+                             f"{args.phase_profile} (0/bare flag = the "
+                             f"default rep count)")
     if args.mesh <= 1 and args.plan != "even":
         raise SystemExit("--plan needs --mesh > 1")
     if args.plan not in ("even", "auto"):
@@ -195,11 +213,21 @@ def main(argv=None) -> int:
         from ..parallel import make_mesh
 
         mesh = make_mesh(args.mesh)
+    profile_reps = 0
+    if args.phase_profile is not None:
+        from ..telemetry.phasetrace import DEFAULT_REPEATS
+
+        profile_reps = args.phase_profile or DEFAULT_REPEATS
+        if args.trace_events is None:
+            # the profile event/gauges are the point of profiling a
+            # registration; without a sink the gauges still need the
+            # derived-work opt-in
+            telemetry.force_active(True)
     handle = service.register(
         a, mesh=mesh,
         plan="auto" if args.plan == "auto" else None,
         exchange=args.exchange, precond=precond,
-        method=args.method)
+        method=args.method, phase_profile=profile_reps)
 
     # pre-build every request's (b, x_true) so the replay loop does
     # nothing but sleep and submit - RHS construction must not distort
@@ -305,6 +333,8 @@ def main(argv=None) -> int:
         "max_abs_error": worst_err,
         "converged_all": all_ok,
         "batches": service.batch_log(),
+        **({"phase_profile": handle.phase_profile.to_json()}
+           if handle.phase_profile is not None else {}),
     })
     if args.metrics and args.json:
         record["metrics"] = REGISTRY.snapshot()
@@ -313,6 +343,10 @@ def main(argv=None) -> int:
                    f"(mesh={args.mesh}, {args.dtype}) ==\n"
                    + "\n".join(treport.service_lines(stats)) + "\n"
                    + f"accuracy: max request error {worst_err:.3e}\n")
+    if handle.phase_profile is not None:
+        report_text += ("-- phase profile (measured at warmup) --\n"
+                        + "\n".join(treport.phase_lines(
+                            handle.phase_profile.to_json())) + "\n")
     if args.report is not None and args.report != "-":
         with open(args.report, "w", encoding="utf-8") as f:
             f.write(report_text)

@@ -171,6 +171,11 @@ class OperatorHandle:
     #: a deferred-warm handle flips this when a later register() (or
     #: explicit warm) pays the compiles
     warmed: bool = False
+    #: measured phase profile of the handle's partition
+    #: (telemetry.phasetrace.PhaseProfile), taken at registration when
+    #: register(phase_profile=R) asked for one - rides the handle so
+    #: reports/CLI can render it without re-measuring
+    phase_profile: Optional[object] = None
 
     @property
     def distributed(self) -> bool:
@@ -229,6 +234,14 @@ class SolverService:
         self._bucket_counts: Dict[int, int] = {}
         self._latencies: deque = deque(
             maxlen=self.config.keep_latency_samples)
+        # the wait-vs-solve split of the same completions: queueing
+        # delay and batched solve wall answer different tuning
+        # questions (max_wait/max_batch vs operator/bucket), so
+        # stats() reports their percentiles separately
+        self._waits: deque = deque(
+            maxlen=self.config.keep_latency_samples)
+        self._solves: deque = deque(
+            maxlen=self.config.keep_latency_samples)
         self._batch_log: deque = deque(maxlen=self.config.keep_batch_log)
         # one dispatcher at a time: the worker thread and a caller-side
         # drain() must not interleave two engine calls
@@ -247,7 +260,8 @@ class SolverService:
                  precond: Optional[str] = None, method: str = "batched",
                  maxiter: Optional[int] = None,
                  check_every: Optional[int] = None,
-                 warm: Optional[bool] = None) -> OperatorHandle:
+                 warm: Optional[bool] = None,
+                 phase_profile: int = 0) -> OperatorHandle:
         """Register an operator: resolve the plan, build the
         preconditioner, and (by default) warm the compiled trace of
         EVERY lane bucket so later traffic only ever hits caches.
@@ -259,6 +273,14 @@ class SolverService:
         refuses here, at registration, not per request).  Re-registering
         the same matrix under the same config returns the same handle
         without re-warming.
+
+        ``phase_profile=R > 0`` (mesh handles only) additionally runs
+        the measured phase profiler (``telemetry.phasetrace``, ``R``
+        chained reps per phase) against the handle's own partition at
+        registration - alongside warmup, never inside request latency -
+        and parks the :class:`~..telemetry.phasetrace.PhaseProfile` on
+        ``handle.phase_profile`` (also emitted as a ``phase_profile``
+        event + gauges).
         """
         from ..models.operators import LinearOperator
         from ..solver.cg import _as_operator
@@ -291,6 +313,13 @@ class SolverService:
             if plan is not None:
                 raise ValueError("plan= needs a mesh (partition "
                                  "planning rebalances a device mesh)")
+            if phase_profile:
+                raise ValueError(
+                    "phase_profile= needs a mesh (the profiler times "
+                    "the distributed halo/spmv/reduction phases)")
+        if phase_profile < 0:
+            raise ValueError(
+                f"phase_profile must be >= 0, got {phase_profile}")
 
         # dedup BEFORE any O(nnz) construction: the key hashes the
         # REQUESTED plan spec ("auto"/None/a plan's fingerprint), so a
@@ -319,6 +348,11 @@ class SolverService:
             if want_warm and not existing.warmed:
                 self._warm(existing)
                 existing.warmed = True
+            # same rule for a requested phase profile: measure it on
+            # the dedup hit if the handle does not carry one yet
+            if phase_profile and existing.phase_profile is None:
+                existing.phase_profile = self._phase_profile(
+                    existing, int(phase_profile))
             return existing
 
         dispatcher = None
@@ -364,7 +398,23 @@ class SolverService:
         if want_warm:
             self._warm(handle)
             handle.warmed = True
+        if phase_profile:
+            handle.phase_profile = self._phase_profile(
+                handle, int(phase_profile))
         return handle
+
+    def _phase_profile(self, handle: OperatorHandle, repeats: int):
+        """Measure the handle's phase profile on its OWN partition (the
+        dispatcher's parts - the arrays every later dispatch runs).
+        Registration-time only: the profiler's dispatches must never
+        ride inside request latency."""
+        from ..telemetry import phasetrace
+
+        profile = phasetrace.profile_partition(
+            handle.dispatcher.parts, handle.mesh, repeats=repeats,
+            plan=(handle.plan.label if handle.plan is not None
+                  else "even"))
+        return phasetrace.note_profile(profile)
 
     def _warm(self, handle: OperatorHandle) -> None:
         """Compile every lane bucket ONCE, before traffic: a zero-RHS
@@ -480,6 +530,9 @@ class SolverService:
             solve_id=None)
         with self._lock:
             self._timeouts += 1
+            # a deadline expiry is pure queue wait - it belongs in the
+            # wait distribution (there is no solve wall to record)
+            self._waits.append(float(wait))
         REGISTRY.counter("serve_timeouts_total",
                          "requests that expired their deadline in "
                          "queue (typed TIMEOUT results)",
@@ -660,6 +713,8 @@ class SolverService:
                 if result.converged:
                     self._converged += 1
                 self._latencies.append(result.latency_s)
+                self._waits.append(result.wait_s)
+                self._solves.append(result.solve_s)
             self._batch_log.append({
                 "handle": handle.key, "bucket": k, "n_requests": m,
                 "reason": batch.reason, "solve_s": float(solve_s),
@@ -738,9 +793,15 @@ class SolverService:
         and padding means, bucket usage, and EXACT latency percentiles
         over the last ``keep_latency_samples`` completions (the
         registry histogram additionally exports interpolated
-        p50/p95/p99 over the full history for scrapes)."""
+        p50/p95/p99 over the full history for scrapes).  ``latency``
+        is end-to-end; ``wait`` and ``solve`` split the same window
+        into queueing delay vs batched solve wall (wait additionally
+        includes deadline-expired requests - their whole latency IS
+        queue wait)."""
         with self._lock:
             lat = sorted(self._latencies)
+            waits = sorted(self._waits)
+            solves = sorted(self._solves)
             n_batches = self._n_batches
             out = {
                 "submitted": self._submitted,
@@ -769,4 +830,13 @@ class SolverService:
             "p95_s": _percentile(lat, 0.95),
             "p99_s": _percentile(lat, 0.99),
         }
+        for key, vals in (("wait", waits), ("solve", solves)):
+            out[key] = {
+                "count": len(vals),
+                "mean_s": float(np.mean(vals)) if vals else None,
+                "max_s": float(vals[-1]) if vals else None,
+                "p50_s": _percentile(vals, 0.50),
+                "p95_s": _percentile(vals, 0.95),
+                "p99_s": _percentile(vals, 0.99),
+            }
         return out

@@ -40,6 +40,12 @@ that gap with four composable parts:
 * :mod:`.report` - the fusion layer: one human-readable solve report
   (text + JSON) over all of the above, and the Chrome-trace/Perfetto
   timeline exporter (one track per shard, one for host phases);
+* :mod:`.phasetrace` - the measured phase profiler: phase-isolated
+  step functions compiled from a partitioned operator's own building
+  blocks (halo exchange alone - per round, local SpMV alone - per
+  shard, dot+psum reduction alone), timed under the real mesh; feeds
+  measured Perfetto spans, per-link wire bandwidths and the
+  phase-resolved calibration observations;
 * :mod:`.calibrate` - the runtime-measured machine model: fit the
   planner/roofline cost parameters (gather slowdown, net bandwidth)
   from observed solves, track predicted-vs-measured drift as gauges
@@ -60,12 +66,14 @@ from . import (
     events,
     flight,
     health,
+    phasetrace,
     registry,
     report,
     roofline,
     session,
     shardscope,
 )
+from .phasetrace import PhaseProfile
 from .calibrate import CalibrationFit, DriftReport
 from .events import EventStream, configure, emit, validate_event
 from .flight import FlightConfig, FlightRecord
@@ -106,6 +114,7 @@ __all__ = [
     "FlightRecord",
     "MachineModel",
     "MetricsRegistry",
+    "PhaseProfile",
     "REGISTRY",
     "RooflineReport",
     "ShardReport",
@@ -122,6 +131,7 @@ __all__ = [
     "health",
     "observe_solve",
     "perfetto_trace",
+    "phasetrace",
     "registry",
     "report",
     "roofline",

@@ -59,11 +59,13 @@ __all__ = [
     "PhaseObservation",
     "cache_key",
     "drift_report",
+    "fit_link_bandwidths",
     "fit_machine_model",
     "load_calibration",
     "note_calibration",
     "note_drift",
     "observation_for",
+    "observations_from_profile",
     "preferred_model",
     "store_calibration",
 ]
@@ -151,6 +153,73 @@ def observation_for(report, iterations: int, elapsed_s: float, *,
         net_bytes_per_iteration=net, label=label)
 
 
+def observations_from_profile(profile, *, label: str = "phase"
+                              ) -> List[PhaseObservation]:
+    """Phase-resolved observations from ONE measured phase profile
+    (``telemetry.phasetrace.PhaseProfile``) - the constructor that
+    makes the ``lstsq2`` confident tier routine without ``--repeat``.
+
+    A whole-solve wall time collapses the gather and wire terms into
+    one number, so a single solve could historically only reach the
+    degraded ``fixed-net`` tier.  A phase profile measured the two
+    terms SEPARATELY, so it decomposes into two observations whose
+    byte ratios are orthogonal by construction:
+
+    * the SpMV phase (the mesh-measured phase wall, priced at the
+      planner's ``slots.max()`` slot coordinate - the same
+      wall-per-coordinate convention the whole-solve fit uses) with
+      zero wire bytes - determines the effective gather bandwidth;
+    * the halo phase (every exchange round, the lane's real padded
+      wire bytes) with zero gather bytes - determines net bytes/s.
+
+    Each observation carries ``iterations=profile.repeats`` (the
+    measured repetitions), so the fit's iteration floor
+    (:data:`MIN_CALIBRATION_ITERATIONS`) is met by any profile with
+    ``repeats >= 4``.  The reduction phase is deliberately dropped:
+    the planner's cost model has no latency term, and folding barrier
+    time into a bandwidth would corrupt both estimates.
+    """
+    reps = int(profile.repeats)
+    spmv_s = float(profile.spmv_mesh_s)
+    obs = [PhaseObservation(
+        iterations=reps, elapsed_s=spmv_s * reps,
+        gather_bytes_per_iteration=float(profile.gather_bytes),
+        net_bytes_per_iteration=0.0, label=f"{label}:spmv")]
+    if profile.halo_s > 0.0 and profile.wire_bytes > 0:
+        obs.append(PhaseObservation(
+            iterations=reps, elapsed_s=float(profile.halo_s) * reps,
+            gather_bytes_per_iteration=0.0,
+            net_bytes_per_iteration=float(profile.wire_bytes),
+            label=f"{label}:halo"))
+    return obs
+
+
+def fit_link_bandwidths(rounds) -> List[dict]:
+    """Per-link bandwidth estimates from individually timed exchange
+    rounds: ``rounds`` is an iterable of ``(shift, bytes, seconds)``
+    (or dicts with those keys) - one entry per ``ppermute`` round, as
+    ``telemetry.phasetrace`` measures them.  Each round is one ring
+    rotation (shard ``j`` -> ``(j + shift) % P``), timed alone, so its
+    bandwidth is directly ``bytes / seconds`` - exact recovery, no
+    least squares (tests feed synthetic timings and get the chosen
+    bandwidths back bit-exactly).  Links only separate when round
+    payloads differ; uniform payloads still yield honest (equal)
+    estimates."""
+    out = []
+    for r in rounds:
+        if isinstance(r, dict):
+            shift, nbytes, secs = r["shift"], r["bytes"], r["seconds"]
+        else:
+            shift, nbytes, secs = r
+        out.append({
+            "shift": int(shift),
+            "bytes": int(nbytes),
+            "seconds": float(secs),
+            "bytes_per_s": float(nbytes) / max(float(secs), 1e-300),
+        })
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class CalibrationFit:
     """Outcome of fitting the machine model to observed solves."""
@@ -216,7 +285,8 @@ def _solve_2x2(a, b, y):
 
 def fit_machine_model(observations: Sequence[PhaseObservation], *,
                       base: Optional[MachineModel] = None,
-                      backend: Optional[str] = None) -> CalibrationFit:
+                      backend: Optional[str] = None,
+                      per_link=None) -> CalibrationFit:
     """Fit the planner's cost model to observed per-iteration times.
 
     Model: ``t_iter = gather_bytes / gather_bw + net_bytes / net_bw``
@@ -236,6 +306,14 @@ def fit_machine_model(observations: Sequence[PhaseObservation], *,
     The returned model keeps the base model's streaming
     ``mem_bytes_per_s`` and ``flops_per_s`` (a CG solve cannot measure
     a matmul) and reports ``gather_slowdown = stream_bw / gather_bw``.
+
+    ``per_link`` optionally attaches per-link wire bandwidths (the
+    :func:`fit_link_bandwidths` output, or raw ``(shift, bytes/s)``
+    pairs) to the fitted model's ``per_link`` field - they ride the
+    calibration cache and every ``drift_report``/``solve_sequence``
+    replan that adopts the model, without changing the aggregate
+    ``net_bytes_per_s`` the planner prices today (two-tier wire
+    pricing is ROADMAP item 4's consumer).
     """
     obs = list(observations)
     if not obs:
@@ -293,6 +371,12 @@ def fit_machine_model(observations: Sequence[PhaseObservation], *,
 
     host = host_fingerprint()
     gather_slowdown = max(base.mem_bytes_per_s / gather_bw, 1e-3)
+    links = None
+    if per_link:
+        links = tuple(
+            (int(e["shift"]), float(e["bytes_per_s"]))
+            if isinstance(e, dict) else (int(e[0]), float(e[1]))
+            for e in per_link)
     model = MachineModel(
         name=f"calibrated-{backend}-{host}",
         mem_bytes_per_s=base.mem_bytes_per_s,
@@ -300,7 +384,8 @@ def fit_machine_model(observations: Sequence[PhaseObservation], *,
         net_bytes_per_s=net_bw,
         source="calibrated",
         gather_slowdown=gather_slowdown,
-        created_at=time.time())
+        created_at=time.time(),
+        per_link=links)
     confident = (method != "proportional"
                  and total_iters >= MIN_CALIBRATION_ITERATIONS
                  and residual <= CONFIDENT_RESIDUAL)
@@ -509,4 +594,11 @@ def note_calibration(fit: CalibrationFit) -> CalibrationFit:
              "steer plans", 1.0 if fit.confident else 0.0)):
         REGISTRY.gauge(gname, help_, labelnames=("backend",)).set(
             val, backend=fit.backend)
+    for shift, bps in (m.per_link or ()):
+        REGISTRY.gauge(
+            "calibration_link_bytes_per_s",
+            "measured per-link halo-wire bandwidth (phase profiler; "
+            "ring-rotation shift identifies the link)",
+            labelnames=("backend", "shift")).set(
+                bps, backend=fit.backend, shift=str(shift))
     return fit

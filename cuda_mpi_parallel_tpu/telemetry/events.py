@@ -91,6 +91,13 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     # predicted_s_per_iteration / measured_s_per_iteration - the
     # model-error % of the plan's cost prediction
     "partition_plan": ("reorder", "split", "n_shards", "measured"),
+    # measured per-shard per-phase timing of a partitioned operator
+    # (telemetry.phasetrace.PhaseProfile.to_json payload): phase
+    # seconds (halo/spmv/reduction + the composed step), per-shard
+    # spmv seconds, per-link wire bandwidths ("links"), and the
+    # explained-fraction residual check
+    "phase_profile": ("n_shards", "exchange", "phases",
+                      "explained_fraction"),
     # a sequence replan decision (dist_cg.solve_sequence): whether
     # solve k+1 kept or switched its partition plan based on the model
     # calibrated from solve k, with the predicted gain of the choice

@@ -1,0 +1,686 @@
+"""phasetrace: measured per-shard per-phase timing of distributed solves.
+
+Until now every timing signal was ONE wall time per solve:
+``calibrate.fit_machine_model`` fit two bandwidths from whole-solve
+observations (a single solve could only reach the degraded ``fixed-net``
+tier), and the Perfetto timeline in :mod:`.report` rendered a *model* of
+the iteration from static shard accounting, honestly labeled "not a
+device profile".  This module replaces both with measurement.
+
+Given a live partitioned operator (any of the ``DistCSR`` /
+``DistCSRGather`` / ``DistCSRRing`` lanes of ``parallel.dist_cg``), the
+profiler compiles **phase-isolated step functions from the operator's
+own building blocks** - the methods the real matvec composes, so the
+profiled phase IS the solve's code path, never a reimplementation:
+
+* **halo** - the exchange alone: ``DistCSR.gather_x`` (one
+  ``all_gather``), every ``DistCSRGather.exchange_round`` (and each
+  round *individually*, yielding per-neighbor-round wire seconds and a
+  fitted per-link bytes/s where round payloads differ), or the ring's
+  ``rotate`` chain;
+* **spmv** - the local CSR multiply alone, timed PER SHARD on that
+  shard's own arrays (the straggler is measured, not modeled);
+* **reduction** - one dot + ``psum``, the iteration's barrier.
+
+Each phase runs ``repeats`` chained repetitions inside one compiled
+``fori_loop`` (a data dependency threads every trip, so XLA can neither
+hoist nor CSE the collective out of the loop), under the real mesh for
+the communication phases.  A composed **step** function - matvec plus
+two dot+psum reductions plus the CG axpys, the iteration core - is
+timed the same way and anchors the residual check: the profile reports
+what fraction of the measured iteration wall the phase sum explains
+(:meth:`PhaseProfile.explained_fraction`), so an unexplained phase is a
+loud number, not a silent gap.
+
+Consumers:
+
+* ``calibrate.observations_from_profile`` turns one profile into >= 2
+  independent observations (orthogonal byte ratios by construction), so
+  the ``lstsq2`` confident calibration tier is routine from a single
+  profiled solve;
+* ``report.perfetto_trace(phase_profile=...)`` draws MEASURED per-shard
+  spans (``span_source: "measured"``);
+* :func:`note_profile` emits the ``phase_profile`` event plus per-phase
+  / per-shard / per-link gauges;
+* the CLI's ``--phase-profile [R]``, ``serve`` registration
+  (``phase_profile=R``), and ``bench.py``'s ``_phase_entry`` ride all
+  of the above.
+
+Profiling runs its own dispatches AFTER a solve - it never touches the
+solve's compiled body (the zero-perturbation proof lives in
+tests/test_phasetrace.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "DEFAULT_REPEATS",
+    "PhaseProfile",
+    "note_profile",
+    "profile_distributed",
+    "profile_partition",
+]
+
+#: chained repetitions per compiled phase loop: enough to amortize
+#: dispatch into the per-rep number, and (at the default) comfortably
+#: past calibrate.MIN_CALIBRATION_ITERATIONS so a single profile can
+#: back a confident fit
+DEFAULT_REPEATS = 16
+
+#: phase sum below this fraction of the measured step wall marks the
+#: profile unexplained (a phase the profiler does not isolate is
+#: dominating the iteration).  The lint gate enforces
+#: ``FLOOR <= explained <= 2 - FLOOR`` - over-explanation past the
+#: mirrored bound means the phases double-count work the composed
+#: step overlaps.
+EXPLAINED_FRACTION_FLOOR = 0.7
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseProfile:
+    """Measured per-shard per-phase seconds of one partitioned operator.
+
+    All times are seconds per repetition (= per matvec / per phase
+    application).  ``spmv_s`` is per shard; the communication phases
+    are whole-mesh walls (a collective synchronizes every shard, so a
+    per-shard split of its wall would be fiction - the per-shard story
+    of the wire lives in ``links``, one timed entry per exchange
+    round).  ``step_s`` is the measured iteration core (matvec +
+    ``reductions_per_iteration`` dot+psum barriers + the CG axpys) -
+    the wall the phase sum is checked against.
+    """
+
+    kind: str                     # csr | csr-gather | csr-ring
+    exchange: str                 # allgather | gather | ring
+    n_shards: int
+    n_local: int
+    itemsize: int
+    repeats: int
+    spmv_s: np.ndarray            # (P,) per-shard local SpMV seconds
+    #: the SpMV phase's whole-MESH wall (every shard multiplying, no
+    #: collective) - what the iteration actually pays for the phase
+    #: under this executor.  On real parallel hardware this approaches
+    #: ``max(spmv_s)``; on CPU hosts with virtual devices the runtime
+    #: serializes shard programs and it approaches ``sum(spmv_s)`` -
+    #: measuring it keeps the explained-fraction check honest on both.
+    spmv_mesh_s: float
+    halo_s: float                 # whole exchange, seconds per matvec
+    reduction_s: float            # one dot + psum
+    step_s: float                 # measured iteration core
+    #: per exchange round: shift, per-device padded bytes, measured
+    #: seconds, bytes/s (calibrate.fit_link_bandwidths output)
+    links: Tuple[dict, ...] = ()
+    #: planner slot-term coordinate: ``slots_max * (itemsize + 4)``
+    gather_bytes: int = 0
+    #: per-device wire bytes per matvec of the lane that ran
+    wire_bytes: int = 0
+    reductions_per_iteration: int = 2
+    solve_iterations: Optional[int] = None
+    solve_elapsed_s: Optional[float] = None
+    plan: str = "even"
+
+    # ---- derived -----------------------------------------------------
+    def phase_seconds(self, shard: int) -> Tuple[float, float, float]:
+        """(halo, spmv, reduction) seconds of one iteration on
+        ``shard`` - reduction counted ``reductions_per_iteration``
+        times, the way the iteration pays it."""
+        return (float(self.halo_s), float(self.spmv_s[shard]),
+                float(self.reduction_s * self.reductions_per_iteration))
+
+    def critical_path_s(self) -> float:
+        """Phase sum of one iteration: halo + the mesh-measured SpMV
+        wall + the iteration's reduction barriers.  Every term is a
+        whole-mesh wall measured under the same executor, so the sum
+        is commensurable with ``step_s`` (and with a real solve's
+        per-iteration wall)."""
+        return (float(self.halo_s) + float(self.spmv_mesh_s)
+                + float(self.reduction_s * self.reductions_per_iteration))
+
+    def stall_factors(self) -> dict:
+        """Measured max/mean per phase.  The communication phases are
+        1.0 by construction (padded-uniform payloads, one wall); the
+        SpMV factor is the real measured straggler penalty."""
+        from .shardscope import max_over_mean
+
+        return {
+            "halo": 1.0,
+            "spmv": max_over_mean(self.spmv_s),
+            "reduction": 1.0,
+        }
+
+    def explained_fraction(self) -> float:
+        """Fraction of the measured iteration core (``step_s``) the
+        phase critical path explains - the residual check.  Values
+        near 1.0 mean the three phases ARE the iteration; a low value
+        means an unprofiled cost dominates."""
+        return self.critical_path_s() / max(float(self.step_s), 1e-300)
+
+    @property
+    def solve_s_per_iteration(self) -> Optional[float]:
+        if not self.solve_iterations or self.solve_elapsed_s is None:
+            return None
+        return float(self.solve_elapsed_s) / max(
+            int(self.solve_iterations), 1)
+
+    def explained_fraction_vs_solve(self) -> Optional[float]:
+        """The same residual check against the ACTUAL solve's measured
+        per-iteration wall (when the caller provided it) - the solve
+        additionally pays while-loop plumbing and convergence checks,
+        so this is <= the step-based fraction in practice."""
+        spi = self.solve_s_per_iteration
+        if spi is None:
+            return None
+        return self.critical_path_s() / max(spi, 1e-300)
+
+    def to_json(self) -> dict:
+        out = {
+            "kind": self.kind,
+            "exchange": self.exchange,
+            "plan": self.plan,
+            "n_shards": int(self.n_shards),
+            "n_local": int(self.n_local),
+            "itemsize": int(self.itemsize),
+            "repeats": int(self.repeats),
+            "phases": {
+                "halo_s": float(self.halo_s),
+                "spmv_s": float(self.spmv_mesh_s),
+                "spmv_shard_max_s": float(np.max(self.spmv_s)),
+                "spmv_shard_mean_s": float(np.mean(self.spmv_s)),
+                "reduction_s": float(self.reduction_s),
+            },
+            "spmv_s": [float(v) for v in self.spmv_s],
+            "step_s": float(self.step_s),
+            "links": [dict(e) for e in self.links],
+            "gather_bytes": int(self.gather_bytes),
+            "wire_bytes": int(self.wire_bytes),
+            "reductions_per_iteration": int(
+                self.reductions_per_iteration),
+            "stall_factors": self.stall_factors(),
+            "explained_fraction": round(self.explained_fraction(), 6),
+        }
+        if self.solve_s_per_iteration is not None:
+            out["solve_s_per_iteration"] = self.solve_s_per_iteration
+            out["explained_fraction_vs_solve"] = round(
+                self.explained_fraction_vs_solve(), 6)
+        return out
+
+    def describe_lines(self) -> List[str]:
+        """Human lines for the report's "-- phase profile --" section
+        (also rendered by ``report.phase_lines`` from the JSON form)."""
+        from .report import phase_lines
+
+        return phase_lines(self.to_json())
+
+
+# ---------------------------------------------------------------------------
+# measurement machinery
+
+def _chain(s, probe, tiny):
+    """Thread a data dependency from ``probe`` (this trip's phase
+    output) into the next trip's input without changing ``s``
+    meaningfully: adds ``probe's first element * tiny`` (tiny is the
+    dtype's smallest normal - a nonzero constant XLA cannot fold away,
+    so the chained loop really runs every collective every trip)."""
+    return s + probe.reshape(-1)[0] * tiny
+
+
+def _time_loop(fn, *args, repeats: int, outer: int = 2):
+    """Best-of-``outer`` wall seconds of one compiled ``repeats``-trip
+    loop, divided by ``repeats`` (compile excluded via warmup)."""
+    import jax
+
+    from ..utils.timing import time_fn
+
+    jitted = jax.jit(fn)
+
+    def run():
+        return jax.block_until_ready(jitted(*args))
+
+    elapsed, _ = time_fn(run, warmup=1, repeats=outer, reduce="best")
+    return elapsed / max(int(repeats), 1)
+
+
+def _mesh_phase(mesh, axis, arrays, body_of_op, make_op, repeats: int,
+                extra_state=None):
+    """Time a mesh phase: ``body_of_op(op)`` returns the fori body
+    ``(i, state) -> state`` given the per-shard operator built from the
+    stripped ``arrays`` (the same construct-inside-shard_map pattern as
+    ``dist_cg._solve_csr``)."""
+    from functools import partial
+
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+
+    x0, shards = arrays[0], arrays[1:]
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis),) * len(arrays), out_specs=P(axis))
+    def run(x_local, *shard_args):
+        strip = partial(jax.tree.map, lambda v: v[0])
+        op = make_op(tuple(strip(sa) for sa in shard_args))
+        body = body_of_op(op)
+        state = x_local if extra_state is None else extra_state(x_local)
+        out = lax.fori_loop(0, repeats, body, state)
+        return out[0] if isinstance(out, tuple) else out
+
+    return _time_loop(run, x0, *shards, repeats=repeats)
+
+
+def profile_partition(parts, mesh, *, repeats: int = DEFAULT_REPEATS,
+                      solve_iterations: Optional[int] = None,
+                      solve_elapsed_s: Optional[float] = None,
+                      plan: str = "even") -> PhaseProfile:
+    """Measure the phase profile of an already-built partition.
+
+    ``parts`` is ``partition.partition_csr`` output (allgather or
+    gather lane - ``parts.halo`` decides) or
+    ``partition.ring_partition_csr`` output (the ring lane, detected by
+    its per-step tuple slabs); ``mesh`` the 1-D device mesh the solve
+    runs on.  Host-side setup is numpy; the timed bodies are the
+    operator building blocks under ``shard_map``, plus per-shard
+    single-device SpMV timings.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import events
+    from .calibrate import fit_link_bandwidths
+    from ..parallel.dist_cg import _shard_tree
+    from ..parallel.exchange import allgather_wire_bytes
+    from ..parallel.mesh import shard_vector
+    from ..parallel.operators import DistCSR, DistCSRGather, DistCSRRing
+
+    if repeats < 1:
+        raise ValueError(f"repeats must be >= 1, got {repeats}")
+    if len(mesh.axis_names) != 1:
+        raise ValueError("phase profiling runs on a 1-D mesh (pencil "
+                         "meshes are stencil-only)")
+    axis = mesh.axis_names[0]
+    n_shards = int(mesh.devices.size)
+    if n_shards != int(parts.n_shards):
+        raise ValueError(f"partition targets {parts.n_shards} shards "
+                         f"but the mesh has {n_shards}")
+    if n_shards < 2:
+        raise ValueError("phase profiling needs a mesh with >= 2 "
+                         "devices (a 1-shard 'exchange' has no wire)")
+    ring = isinstance(parts.data, tuple)
+    n_local = int(parts.n_local)
+    dtype = np.asarray(parts.data[0] if ring else parts.data).dtype
+    itemsize = int(dtype.itemsize)
+    tiny = jnp.asarray(np.finfo(dtype).tiny, dtype)
+    reductions = 2   # the CG iteration's two dot+psum barriers
+
+    x_pad = np.ones(parts.n_global_padded, dtype=dtype)
+    x_dev = shard_vector(jnp.asarray(x_pad), mesh, axis)
+
+    with events.scoped(phase="phase-profile"):
+        if ring:
+            profile = _profile_ring(
+                parts, mesh, axis, x_dev, tiny, repeats, n_shards,
+                n_local, itemsize, _shard_tree, DistCSRRing,
+                allgather_wire_bytes, jnp, lax)
+        else:
+            profile = _profile_allgather_family(
+                parts, mesh, axis, x_dev, tiny, repeats, n_shards,
+                n_local, itemsize, _shard_tree, DistCSR, DistCSRGather,
+                allgather_wire_bytes, jnp, lax)
+    kind, exchange, spmv_s, spmv_mesh_s, halo_s, reduction_s, step_s, \
+        rounds, gather_bytes, wire_bytes = profile
+    return PhaseProfile(
+        kind=kind, exchange=exchange, n_shards=n_shards,
+        n_local=n_local, itemsize=itemsize, repeats=int(repeats),
+        spmv_s=np.asarray(spmv_s, dtype=np.float64),
+        spmv_mesh_s=float(spmv_mesh_s),
+        halo_s=float(halo_s), reduction_s=float(reduction_s),
+        step_s=float(step_s),
+        links=tuple(fit_link_bandwidths(rounds)),
+        gather_bytes=int(gather_bytes), wire_bytes=int(wire_bytes),
+        reductions_per_iteration=reductions,
+        solve_iterations=solve_iterations,
+        solve_elapsed_s=solve_elapsed_s, plan=str(plan))
+
+
+def _step_body(op, axis, tiny, jnp, lax):
+    """The iteration-core body: one matvec, two dot+psum barriers, the
+    CG axpys - bounded synthetic coefficients so ``repeats`` trips stay
+    finite whatever the operator's spectrum."""
+    def body(i, s):
+        p, r = s
+        q = op.matvec(p)
+        denom = lax.psum(jnp.vdot(p, q), axis)
+        alpha = 1.0 / (jnp.abs(denom) + 1.0)
+        r2 = r - alpha * q
+        rr = lax.psum(jnp.vdot(r2, r2), axis)
+        beta = rr / (rr + 1.0)
+        return (r2 + beta * p, r2)
+    return body
+
+
+def _reduction_body(axis, tiny, jnp, lax):
+    def body(i, s):
+        rr = lax.psum(jnp.vdot(s, s), axis)
+        return _chain(s, rr.reshape(1), tiny)
+    return body
+
+
+def _spmv_seconds(per_shard_args, x_ext_size, n_local, dtype, repeats,
+                  jnp, lax, tiny):
+    """Per-shard local-SpMV seconds, each shard's arrays timed alone on
+    one device (the measured straggler; the psum barrier in the real
+    loop makes the max of these everyone's wait)."""
+    from ..ops import spmv as spmv_ops
+
+    out = []
+    for data_k, cols_k, rows_k in per_shard_args:
+        d = jnp.asarray(data_k)
+        c = jnp.asarray(cols_k)
+        r = jnp.asarray(rows_k)
+        x0 = jnp.ones((x_ext_size,), dtype=dtype)
+
+        def run(xe, d=d, c=c, r=r):
+            def body(i, s):
+                y = spmv_ops.csr_matvec(d, c, r, s, n_local)
+                return _chain(s, y, tiny)
+            return lax.fori_loop(0, repeats, body, xe)
+
+        out.append(_time_loop(run, x0, repeats=repeats))
+    return np.asarray(out, dtype=np.float64)
+
+
+def _profile_allgather_family(parts, mesh, axis, x_dev, tiny, repeats,
+                              n_shards, n_local, itemsize, _shard_tree,
+                              DistCSR, DistCSRGather,
+                              allgather_wire_bytes, jnp, lax):
+    sched = parts.halo
+    gather = sched is not None
+    data = _shard_tree(parts.data, mesh, axis)
+    cols = _shard_tree(parts.cols, mesh, axis)
+    rows = _shard_tree(parts.local_rows, mesh, axis)
+    send = tuple(_shard_tree(r.send_idx, mesh, axis)
+                 for r in sched.rounds) if gather else ()
+    shifts = tuple(r.shift for r in sched.rounds) if gather else ()
+
+    if gather:
+        def make_op(stripped):
+            d, c, r, *s = stripped
+            return DistCSRGather(
+                data=d, cols=c, local_rows=r, send_idx=tuple(s),
+                shifts=shifts, n_local=n_local, axis_name=axis,
+                n_shards=n_shards)
+        arrays = (x_dev, data, cols, rows) + send
+    else:
+        def make_op(stripped):
+            d, c, r = stripped
+            return DistCSR(data=d, cols=c, local_rows=r,
+                           n_local=n_local, axis_name=axis,
+                           n_shards=n_shards)
+        arrays = (x_dev, data, cols, rows)
+
+    def halo_body(op):
+        if gather:
+            def body(i, s):
+                ext = op.extend_x(s)
+                # chain through the RECEIVED slab (ext[n_local:]), not
+                # the local block: slice-of-concat at offset 0 would
+                # simplify back to s and let XLA drop the ppermutes
+                return _chain(s, ext[n_local:], tiny)
+        else:
+            def body(i, s):
+                return _chain(s, op.gather_x(s), tiny)
+        return body
+
+    dtype = np.asarray(parts.data).dtype
+    x_ext_size = (n_local + sched.halo_width) if gather \
+        else parts.n_global_padded
+
+    def spmv_mesh_body(op):
+        # no collective: every shard multiplies against a constant
+        # extended x (nudged by the chained state so XLA cannot hoist
+        # the multiply out of the loop)
+        def body(i, s):
+            xc = jnp.ones((x_ext_size,), dtype) + s[0] * tiny
+            return _chain(s, op.local_matvec(xc), tiny)
+        return body
+
+    halo_s = _mesh_phase(mesh, axis, arrays, halo_body, make_op,
+                         repeats)
+    spmv_mesh_s = _mesh_phase(mesh, axis, arrays, spmv_mesh_body,
+                              make_op, repeats)
+    reduction_s = _mesh_phase(
+        mesh, axis, arrays, lambda op: _reduction_body(axis, tiny, jnp,
+                                                       lax),
+        make_op, repeats)
+    step_s = _mesh_phase(
+        mesh, axis, arrays,
+        lambda op: _step_body(op, axis, tiny, jnp, lax), make_op,
+        repeats, extra_state=lambda x: (x, x))
+
+    rounds = []
+    if gather:
+        round_bytes = sched.round_wire_bytes(itemsize)
+        for i in range(len(shifts)):
+            def round_body(op, i=i):
+                def body(j, s):
+                    return _chain(s, op.exchange_round(s, i), tiny)
+                return body
+            secs = _mesh_phase(mesh, axis, arrays, round_body, make_op,
+                               repeats)
+            rounds.append((shifts[i], round_bytes[i], secs))
+        wire_bytes = sched.wire_bytes_per_matvec(itemsize)
+    else:
+        wire_bytes = allgather_wire_bytes(n_shards, n_local, itemsize)
+
+    per_shard = [(parts.data[k], parts.cols[k], parts.local_rows[k])
+                 for k in range(n_shards)]
+    spmv_s = _spmv_seconds(per_shard, x_ext_size, n_local, dtype,
+                           repeats, jnp, lax, tiny)
+    slots_max = int(parts.data.shape[1])
+    gather_bytes = slots_max * (itemsize + 4)
+    kind = "csr-gather" if gather else "csr"
+    exchange = "gather" if gather else "allgather"
+    return (kind, exchange, spmv_s, spmv_mesh_s, halo_s, reduction_s,
+            step_s, rounds, gather_bytes, wire_bytes)
+
+
+def _profile_ring(parts, mesh, axis, x_dev, tiny, repeats, n_shards,
+                  n_local, itemsize, _shard_tree, DistCSRRing,
+                  allgather_wire_bytes, jnp, lax):
+    data = _shard_tree(parts.data, mesh, axis)
+    cols = _shard_tree(parts.cols, mesh, axis)
+    rows = _shard_tree(parts.local_rows, mesh, axis)
+
+    def make_op(stripped):
+        n = len(parts.data)
+        return DistCSRRing(
+            data=tuple(stripped[:n]), cols=tuple(stripped[n:2 * n]),
+            local_rows=tuple(stripped[2 * n:]), n_local=n_local,
+            axis_name=axis, n_shards=n_shards)
+
+    arrays = (x_dev,) + data + cols + rows
+
+    def halo_body(op):
+        def body(i, s):
+            for _ in range(n_shards - 1):
+                s = op.rotate(s)
+            return s
+        return body
+
+    def one_rotation_body(op):
+        def body(i, s):
+            return op.rotate(s)
+        return body
+
+    dtype = np.asarray(parts.data[0]).dtype
+
+    def spmv_mesh_body(op):
+        # every step slab multiplied against a constant resident block
+        # (no rotation - the SpMV phase alone)
+        def body(i, s):
+            xc = jnp.ones((n_local,), dtype) + s[0] * tiny
+            y = None
+            for t in range(n_shards):
+                yt = op.step_matvec(t, xc)
+                y = yt if y is None else y + yt
+            return _chain(s, y, tiny)
+        return body
+
+    halo_s = _mesh_phase(mesh, axis, arrays, halo_body, make_op,
+                         repeats)
+    spmv_mesh_s = _mesh_phase(mesh, axis, arrays, spmv_mesh_body,
+                              make_op, repeats)
+    rotation_s = _mesh_phase(mesh, axis, arrays, one_rotation_body,
+                             make_op, repeats)
+    reduction_s = _mesh_phase(
+        mesh, axis, arrays, lambda op: _reduction_body(axis, tiny, jnp,
+                                                       lax),
+        make_op, repeats)
+    step_s = _mesh_phase(
+        mesh, axis, arrays,
+        lambda op: _step_body(op, axis, tiny, jnp, lax), make_op,
+        repeats, extra_state=lambda x: (x, x))
+    # one shard's ring spmv = its slab multiplies across all steps
+    from ..ops import spmv as spmv_ops
+
+    spmv = []
+    for k in range(n_shards):
+        slabs = [(jnp.asarray(parts.data[t][k]),
+                  jnp.asarray(parts.cols[t][k]),
+                  jnp.asarray(parts.local_rows[t][k]))
+                 for t in range(len(parts.data))]
+        x0 = jnp.ones((n_local,), dtype=dtype)
+
+        def run(xb, slabs=slabs):
+            def body(i, s):
+                y = None
+                for d, c, r in slabs:
+                    yt = spmv_ops.csr_matvec(d, c, r, s, n_local)
+                    y = yt if y is None else y + yt
+                return _chain(s, y, tiny)
+            return lax.fori_loop(0, repeats, body, xb)
+
+        spmv.append(_time_loop(run, x0, repeats=repeats))
+    spmv_s = np.asarray(spmv, dtype=np.float64)
+
+    # every rotation ships the same fixed n_local block - links cannot
+    # separate, but the one measured rotation is still an honest wire
+    rounds = [(1, n_local * itemsize, rotation_s)]
+    wire_bytes = allgather_wire_bytes(n_shards, n_local, itemsize)
+    # the ring's per-shard multiply walks every step slab: the slot
+    # coordinate is the summed per-step slot widths
+    gather_bytes = (sum(int(parts.data[t].shape[1])
+                        for t in range(len(parts.data)))
+                    * (itemsize + 4))
+    return ("csr-ring", "ring", spmv_s, spmv_mesh_s, halo_s,
+            reduction_s, step_s, rounds, gather_bytes, wire_bytes)
+
+
+def profile_distributed(a, *, mesh=None, n_devices: Optional[int] = None,
+                        plan=None, csr_comm: str = "allgather",
+                        exchange=None,
+                        repeats: int = DEFAULT_REPEATS,
+                        solve_iterations: Optional[int] = None,
+                        solve_elapsed_s: Optional[float] = None
+                        ) -> PhaseProfile:
+    """Profile the partition a ``solve_distributed(a, ...)`` call with
+    the same arguments would run: resolve the plan, apply its
+    permutation, build the identical partition (same helpers as
+    ``dist_cg._solve_csr``), and measure (:func:`profile_partition`).
+    This re-pays the O(nnz) host partition work a just-finished solve
+    already did - acceptable for a post-solve profiling pass (the
+    phase compiles dominate it); a caller holding the live partition
+    (the solver service's dispatcher) should call
+    :func:`profile_partition` directly instead.
+
+    ``solve_iterations``/``solve_elapsed_s`` optionally anchor the
+    profile to an actual measured solve of this system, enabling
+    :meth:`PhaseProfile.explained_fraction_vs_solve`.
+    """
+    from ..models.operators import CSRMatrix
+    from ..parallel import partition as part
+    from ..parallel.dist_cg import (
+        _apply_plan_permutation,
+        _plan_exchange_hint,
+        _resolve_exchange_mode,
+        resolve_plan,
+    )
+    from ..parallel.mesh import make_mesh
+
+    if not isinstance(a, CSRMatrix):
+        raise ValueError(
+            f"phase profiling supports assembled CSRMatrix problems "
+            f"(the partitioned-operator lanes); got "
+            f"{type(a).__name__}")
+    if csr_comm == "ring-shiftell":
+        raise ValueError(
+            "phase profiling does not support csr_comm='ring-shiftell' "
+            "(the pallas slab kernel fuses its phases; use the csr "
+            "ring lane)")
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    n_shards = int(mesh.devices.size)
+    plan = resolve_plan(plan, a, n_shards,
+                        exchange=_plan_exchange_hint(csr_comm, exchange))
+    ap, _ = _apply_plan_permutation(a, np.zeros(a.shape[0]), plan)
+    ranges = plan.row_ranges if plan is not None else None
+    if csr_comm == "ring" or exchange == "ring":
+        parts = part.ring_partition_csr(ap, n_shards, ranges)
+    else:
+        parts = part.partition_csr(
+            ap, n_shards, ranges,
+            exchange=_resolve_exchange_mode(exchange, plan))
+    return profile_partition(
+        parts, mesh, repeats=repeats,
+        solve_iterations=solve_iterations,
+        solve_elapsed_s=solve_elapsed_s,
+        plan=plan.label if plan is not None else "even")
+
+
+def note_profile(profile: PhaseProfile) -> PhaseProfile:
+    """Publish a profile: the ``phase_profile`` event (when a sink is
+    active) plus per-phase / per-shard / per-link registry gauges -
+    the measured siblings of the static ``shard_profile`` emission."""
+    from . import events
+    from .registry import REGISTRY
+
+    payload = profile.to_json()
+    events.emit("phase_profile", **payload)
+    for phase, secs in (("halo", profile.halo_s),
+                        ("spmv", profile.spmv_mesh_s),
+                        ("reduction", profile.reduction_s),
+                        ("step", profile.step_s)):
+        REGISTRY.gauge(
+            "phase_seconds",
+            "measured whole-mesh seconds per application of one solve "
+            "phase (step = the composed iteration core)",
+            labelnames=("phase",)).set(float(secs), phase=phase)
+    for phase, factor in profile.stall_factors().items():
+        REGISTRY.gauge(
+            "phase_stall_factor",
+            "measured max/mean across shards per phase (the psum-"
+            "barrier straggler penalty)",
+            labelnames=("phase",)).set(float(factor), phase=phase)
+    for k, secs in enumerate(profile.spmv_s):
+        REGISTRY.gauge(
+            "phase_spmv_seconds",
+            "measured per-shard local-SpMV seconds per matvec",
+            labelnames=("shard",)).set(float(secs), shard=str(k))
+    for link in profile.links:
+        REGISTRY.gauge(
+            "phase_link_bytes_per_s",
+            "measured per-link halo-wire bandwidth (one exchange "
+            "round, timed alone)",
+            labelnames=("shift",)).set(
+                float(link["bytes_per_s"]), shift=str(link["shift"]))
+    REGISTRY.gauge(
+        "phase_explained_fraction",
+        "fraction of the measured iteration core explained by the "
+        "phase critical path (halo + slowest spmv + reductions)").set(
+            profile.explained_fraction())
+    return profile

@@ -11,20 +11,22 @@ module fuses them into the two artifacts a human actually opens:
 * :func:`perfetto_trace` - a Chrome-trace/Perfetto JSON timeline
   (``chrome://tracing`` / https://ui.perfetto.dev load it directly):
   one track per shard drawing the halo / spmv / reduction phases of
-  each iteration **from the static schedule** (per-shard durations
-  proportional to the shard's accounted work, the whole iteration slot
-  scaled to the measured per-iteration wall time - so the straggler
-  shard visibly fills its slot while balanced shards show reduction
-  wait), plus one track for the host-side ``Timer`` sections and a
-  residual counter track from the flight record.
+  each iteration, plus one track for the host-side ``Timer`` sections
+  and a residual counter track from the flight record.  The per-shard
+  spans come from one of two sources, named in the trace metadata's
+  ``span_source`` field: ``"measured"`` when a
+  ``telemetry.phasetrace.PhaseProfile`` was passed (real per-shard
+  per-phase walls - the straggler is measured, and any unexplained
+  iteration time shows as an honest gap before the next iteration),
+  or ``"modeled"`` (the static-schedule fallback: per-shard durations
+  proportional to accounted work, the iteration slot scaled to the
+  measured per-iteration wall time).
 
-The timeline is a *model rendering* of measured aggregates, not a
-device profile (that is ``--profile``'s ``jax.profiler`` job); its
-value is that it exists for every backend - including CPU CI - and
-shows skew at a glance.  :func:`validate_perfetto` is the structural
-contract both the tests and ``tools/validate_trace.py`` enforce:
-loadable event array, ``ph``/``ts``/``pid``/``tid`` on every event,
-monotone ``ts`` per track.
+:func:`validate_perfetto` is the structural contract both the tests
+and ``tools/validate_trace.py`` enforce: loadable event array,
+``ph``/``ts``/``pid``/``tid`` on every event, monotone ``ts`` per
+track (the tool additionally requires the ``span_source`` metadata
+field on every trace this repo exports).
 """
 from __future__ import annotations
 
@@ -39,6 +41,8 @@ from ..utils.logging import sanitize
 __all__ = [
     "SolveReport",
     "perfetto_trace",
+    "phase_lines",
+    "service_lines",
     "validate_perfetto",
     "write_perfetto",
 ]
@@ -77,6 +81,9 @@ class SolveReport:
     #: solver-service replay summary (serve.SolverService.stats()):
     #: request/batch counts, occupancy, padding, latency percentiles
     service: Optional[dict] = None
+    #: measured phase profile (telemetry.phasetrace
+    #: PhaseProfile.to_json() payload, or the phase_profile event)
+    phase: Optional[dict] = None
     sections: Sequence[Tuple[str, float]] = ()
 
     def to_json(self) -> dict:
@@ -95,6 +102,8 @@ class SolveReport:
             out["calibration"] = dict(self.calibration)
         if self.service is not None:
             out["service"] = dict(self.service)
+        if self.phase is not None:
+            out["phase_profile"] = dict(self.phase)
         if self.sections:
             out["sections"] = {name: s for name, s in self.sections}
         return sanitize(out)
@@ -162,6 +171,10 @@ class SolveReport:
                 f"({r.model_s_per_iteration * 1e6:.3g} us model vs "
                 f"{r.measured_s_per_iteration * 1e6:.3g} us measured "
                 f"per iteration)")
+        if self.phase is not None:
+            lines.append("")
+            lines.append("-- phase profile (measured) --")
+            lines.extend(phase_lines(self.phase))
         if self.calibration is not None:
             lines.append("")
             lines.append("-- calibration & drift --")
@@ -223,6 +236,16 @@ def service_lines(stats: Dict[str, Any]) -> List[str]:
         f"latency : p50 {ms(lat.get('p50_s'))}  "
         f"p95 {ms(lat.get('p95_s'))}  p99 {ms(lat.get('p99_s'))}  "
         f"(max {ms(lat.get('max_s'))})")
+    # wait-vs-solve split (queueing delay vs batched solve wall): the
+    # two levers are different - wait is tuned with max_wait/max_batch,
+    # solve with the operator/bucket - so the report separates them
+    for key, label in (("wait", "wait    "), ("solve", "solve   ")):
+        sub = stats.get(key)
+        if sub:
+            lines.append(
+                f"{label}: p50 {ms(sub.get('p50_s'))}  "
+                f"p95 {ms(sub.get('p95_s'))}  "
+                f"p99 {ms(sub.get('p99_s'))}")
     if stats.get("solved_rhs_per_sec") is not None:
         lines.append(
             f"throughput: {stats['solved_rhs_per_sec']:.1f} solved "
@@ -232,6 +255,53 @@ def service_lines(stats: Dict[str, Any]) -> List[str]:
         lines.append(
             f"zero-retrace: dist_cache_miss after warmup = "
             f"{int(stats['dist_cache_misses_postwarm'])}")
+    return lines
+
+
+def phase_lines(phase: Dict[str, Any]) -> List[str]:
+    """Render a measured phase profile (``telemetry.phasetrace``
+    ``PhaseProfile.to_json()`` payload, or the ``phase_profile`` event
+    - same shape): per-phase walls, the per-shard SpMV row with its
+    measured stall factor, per-link wire bandwidths, and the
+    explained-fraction residual check."""
+    def us(v) -> str:
+        return f"{float(v) * 1e6:.1f} us" if isinstance(v, (int, float)) \
+            else "n/a"
+
+    ph = phase.get("phases") or {}
+    stall = phase.get("stall_factors") or {}
+    reds = int(phase.get("reductions_per_iteration", 2))
+    lines = [
+        f"exchange {phase.get('exchange', '?')} on "
+        f"{phase.get('n_shards', '?')} shards, "
+        f"{phase.get('repeats', '?')} chained reps/phase "
+        f"[plan: {phase.get('plan', 'even')}]",
+        f"halo {us(ph.get('halo_s'))} + spmv {us(ph.get('spmv_s'))} + "
+        f"{reds} x reduction {us(ph.get('reduction_s'))} vs measured "
+        f"iteration core {us(phase.get('step_s'))}",
+    ]
+    spmv = phase.get("spmv_s")
+    if spmv:
+        lines.append(
+            "per-shard spmv: ["
+            + ", ".join(f"{float(v) * 1e6:.1f}" for v in spmv)
+            + f"] us, stall factor {float(stall.get('spmv', 1.0)):.3f}")
+    for link in phase.get("links") or ():
+        lines.append(
+            f"link shift {link.get('shift')}: {link.get('bytes')} "
+            f"B/round @ "
+            f"{float(link.get('bytes_per_s', 0.0)) / 1e6:.2f} MB/s")
+    ef = phase.get("explained_fraction")
+    if ef is not None:
+        lines.append(f"explained: phase sum = {float(ef) * 100:.1f}% "
+                     f"of the measured iteration core")
+    efs = phase.get("explained_fraction_vs_solve")
+    if efs is not None:
+        lines.append(
+            f"           {float(efs) * 100:.1f}% of the solve's "
+            f"measured per-iteration wall "
+            f"({float(phase.get('solve_s_per_iteration', 0.0)) * 1e6:.1f}"
+            f" us/iter)")
     return lines
 
 
@@ -315,17 +385,27 @@ def perfetto_trace(*, iterations: int, elapsed_s: float,
                    shard=None, n_shards: Optional[int] = None,
                    sections: Sequence[Tuple[str, float]] = (),
                    flight_history: Optional[np.ndarray] = None,
+                   phase_profile=None,
                    label: str = "solve") -> dict:
     """Build the Chrome-trace JSON dict (see module docstring).
 
     ``iterations``/``elapsed_s``: the measured solve.  ``shard``: a
-    ``shardscope.ShardReport`` (its per-shard work sizes the phase
-    durations); without one, ``n_shards`` uniform tracks are drawn.
-    ``sections``: host ``Timer.sections``.  ``flight_history``: a
-    ``(maxiter + 1,)`` ||r|| array (``FlightRecord.to_history``) drawn
-    as a counter track.  Timestamps are microseconds (the trace-event
-    convention).
+    ``shardscope.ShardReport`` (its per-shard work sizes the modeled
+    phase durations); without one, ``n_shards`` uniform tracks are
+    drawn.  ``phase_profile``: a ``telemetry.phasetrace.PhaseProfile``
+    (or its ``to_json()`` dict) - when given, the per-shard spans are
+    the MEASURED per-phase walls and the metadata carries
+    ``span_source: "measured"``; otherwise the static-schedule model
+    renders them (``span_source: "modeled"``).  ``sections``: host
+    ``Timer.sections``.  ``flight_history``: a ``(maxiter + 1,)``
+    ||r|| array (``FlightRecord.to_history``) drawn as a counter
+    track.  Timestamps are microseconds (the trace-event convention).
     """
+    prof = None
+    if phase_profile is not None:
+        prof = phase_profile.to_json() \
+            if hasattr(phase_profile, "to_json") else dict(phase_profile)
+
     events: List[dict] = []
     events.append(_meta(_HOST_PID, 0, "process_name", "host"))
     events.append(_meta(_SHARD_PID, 0, "process_name",
@@ -340,10 +420,61 @@ def perfetto_trace(*, iterations: int, elapsed_s: float,
         t += dur
 
     shards = shard.n_shards if shard is not None else (n_shards or 1)
+    if prof is not None:
+        shards = int(prof["n_shards"])
     its = max(int(iterations), 1)
     drawn = min(its, MAX_DRAWN_ITERATIONS)
     iter_us = max(float(elapsed_s), 1e-9) * 1e6 / its
 
+    if prof is not None:
+        iter_us = _measured_shard_tracks(events, prof, iter_us, drawn)
+    else:
+        _modeled_shard_tracks(events, shard, shards, iter_us, drawn)
+
+    if flight_history is not None:
+        hist = np.asarray(flight_history, dtype=np.float64).reshape(-1)
+        events.append(_meta(_COUNTER_PID, 0, "process_name",
+                            "residual (flight record)"))
+        idx = np.nonzero(np.isfinite(hist))[0]
+        for i in idx:
+            # same truncation as the shard tracks: a 30k-iteration
+            # dense history must not blow the documented size cap
+            if i > drawn:
+                break
+            events.append({
+                "ph": "C", "ts": round(float(i) * iter_us, 3),
+                "pid": _COUNTER_PID, "tid": 0, "name": "log10_residual",
+                "args": {"log10_residual":
+                         float(np.log10(max(hist[i], 1e-300)))}})
+
+    metadata = {
+        "label": label,
+        "iterations": int(iterations),
+        "drawn_iterations": int(drawn),
+        "elapsed_s": float(elapsed_s),
+        "truncated": bool(its > drawn),
+        # the structured successor of the old free-text "not a device
+        # profile" note: every exported timeline says which renderer
+        # produced its per-shard spans, and tools/validate_trace.py
+        # requires the field
+        "span_source": "measured" if prof is not None else "modeled",
+    }
+    if prof is not None:
+        metadata["explained_fraction"] = prof.get("explained_fraction")
+        metadata["phase_exchange"] = prof.get("exchange")
+    trace = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": metadata,
+    }
+    return sanitize(trace)
+
+
+def _modeled_shard_tracks(events, shard, shards: int, iter_us: float,
+                          drawn: int) -> None:
+    """The static-schedule fallback renderer: per-shard durations
+    proportional to accounted work, iteration slot scaled to the
+    measured per-iteration wall."""
     weights = []
     for k in range(shards):
         if shard is not None:
@@ -373,37 +504,42 @@ def perfetto_trace(*, iterations: int, elapsed_s: float,
                              max(base + iter_us - ts, red_us),
                              iteration=i))
 
-    if flight_history is not None:
-        hist = np.asarray(flight_history, dtype=np.float64).reshape(-1)
-        events.append(_meta(_COUNTER_PID, 0, "process_name",
-                            "residual (flight record)"))
-        idx = np.nonzero(np.isfinite(hist))[0]
-        for i in idx:
-            # same truncation as the shard tracks: a 30k-iteration
-            # dense history must not blow the documented size cap
-            if i > drawn:
-                break
-            events.append({
-                "ph": "C", "ts": round(float(i) * iter_us, 3),
-                "pid": _COUNTER_PID, "tid": 0, "name": "log10_residual",
-                "args": {"log10_residual":
-                         float(np.log10(max(hist[i], 1e-300)))}})
 
-    trace = {
-        "traceEvents": events,
-        "displayTimeUnit": "ms",
-        "metadata": {
-            "label": label,
-            "iterations": int(iterations),
-            "drawn_iterations": int(drawn),
-            "elapsed_s": float(elapsed_s),
-            "truncated": bool(its > drawn),
-            "note": "static-schedule model timeline (shardscope), not "
-                    "a device profile; per-shard phase durations are "
-                    "proportional to accounted work",
-        },
-    }
-    return sanitize(trace)
+def _measured_shard_tracks(events, prof: dict, iter_us: float,
+                           drawn: int) -> float:
+    """The measured renderer: spans are the phase profiler's walls.
+    Each shard's iteration draws halo (whole-mesh wall), its own
+    measured SpMV seconds, and the reduction barriers; time the spans
+    do not cover is left as a visible gap - unexplained iteration cost
+    is a gap, never a stretched span.  Returns the iteration slot
+    actually used (grown if the measured spans exceed the solve's
+    per-iteration wall, so track timestamps stay monotone)."""
+    ph = prof.get("phases") or {}
+    reds = int(prof.get("reductions_per_iteration", 2))
+    halo_us = float(ph.get("halo_s", 0.0)) * 1e6
+    red_us = float(ph.get("reduction_s", 0.0)) * 1e6 * reds
+    spmv_us = [float(v) * 1e6 for v in prof.get("spmv_s") or ()]
+    n = int(prof["n_shards"])
+    if len(spmv_us) < n:
+        spmv_us += [0.0] * (n - len(spmv_us))
+    span_max = max(halo_us + s + red_us for s in spmv_us)
+    slot = max(iter_us, span_max)
+    for k in range(n):
+        events.append(_meta(_SHARD_PID, k, "thread_name", f"shard {k}"))
+        for i in range(drawn):
+            ts = i * slot
+            if halo_us > 0:
+                events.append(_x(_SHARD_PID, k, "halo", ts, halo_us,
+                                 iteration=i, span_source="measured"))
+                ts += halo_us
+            events.append(_x(_SHARD_PID, k, "spmv", ts, spmv_us[k],
+                             iteration=i, span_source="measured"))
+            ts += spmv_us[k]
+            if red_us > 0:
+                events.append(_x(_SHARD_PID, k, "reduction", ts,
+                                 red_us, iteration=i,
+                                 span_source="measured"))
+    return slot
 
 
 def write_perfetto(path: str, trace: dict) -> None:

@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -98,6 +98,13 @@ class MachineModel:
     source: str = "table"          # "table" | "calibrated"
     gather_slowdown: float = DEFAULT_GATHER_SLOWDOWN
     created_at: Optional[float] = None
+    #: optional per-link wire bandwidths measured by the phase profiler
+    #: (``telemetry.phasetrace``): ``((ring shift, bytes/s), ...)``, one
+    #: entry per profiled exchange round.  ``net_bytes_per_s`` stays the
+    #: aggregate the planner prices today; the per-link entries are the
+    #: measurement substrate for two-tier wire pricing (ROADMAP item 4)
+    #: and ride the calibration cache so future processes see them.
+    per_link: Optional[Tuple[Tuple[int, float], ...]] = None
 
     @property
     def ridge_flops_per_byte(self) -> float:
@@ -125,7 +132,13 @@ class MachineModel:
                 f"machine model JSON must be an object, got "
                 f"{type(data).__name__}")
         fields = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in data.items() if k in fields})
+        kwargs = {k: v for k, v in data.items() if k in fields}
+        if kwargs.get("per_link") is not None:
+            # JSON round-trips the tuple-of-pairs as nested lists;
+            # restore the hashless-but-frozen tuple form
+            kwargs["per_link"] = tuple(
+                (int(s), float(b)) for s, b in kwargs["per_link"])
+        return cls(**kwargs)
 
 
 def _calibrate_cpu() -> MachineModel:

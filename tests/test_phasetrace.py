@@ -1,0 +1,458 @@
+"""phasetrace: measured per-shard per-phase timing (ISSUE 11).
+
+The profiler's claims are quantitative, so the tests are numeric: the
+per-link fit must RECOVER hand-chosen bandwidths exactly from
+synthetic round timings; one profiled solve's phase observations must
+reach the ``lstsq2`` CONFIDENT calibration tier (a single whole-solve
+observation only reaches ``fixed-net``); the measured Perfetto
+timeline must carry ``span_source="measured"`` and validate
+structurally; the ``phase_profile`` event must be schema-valid with
+per-neighbor bandwidths; and with profiling off (or after a full
+profile run) the distributed solve body must be jaxpr-bit-identical.
+"""
+import json
+from functools import partial
+
+import importlib.util
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from cuda_mpi_parallel_tpu import telemetry
+from cuda_mpi_parallel_tpu.models import mmio, poisson
+from cuda_mpi_parallel_tpu.telemetry import calibrate as cal
+from cuda_mpi_parallel_tpu.telemetry import events
+from cuda_mpi_parallel_tpu.telemetry import phasetrace as pt
+from cuda_mpi_parallel_tpu.telemetry import report as treport
+from cuda_mpi_parallel_tpu.telemetry import roofline as roof
+from cuda_mpi_parallel_tpu.utils import compat
+
+needs_mesh = pytest.mark.skipif(
+    not compat.has_shard_map() or len(jax.devices()) < 4,
+    reason="needs shard_map and >= 4 (virtual) devices")
+
+FIXTURE = "tests/fixtures/skewed_spd_240.mtx"
+
+BASE = roof.MachineModel(
+    name="unit-base", mem_bytes_per_s=8.0e11, flops_per_s=2.0e13,
+    net_bytes_per_s=4.5e10, source="table", gather_slowdown=8.0)
+
+
+def synthetic_profile(*, spmv_mesh_s=2e-4, halo_s=5e-5,
+                      reduction_s=2e-5, step_s=2.8e-4,
+                      gather_bytes=40_000, wire_bytes=1160,
+                      links=(), repeats=16):
+    """A PhaseProfile with hand-chosen walls (no measurement)."""
+    return pt.PhaseProfile(
+        kind="csr-gather", exchange="gather", n_shards=4, n_local=60,
+        itemsize=8, repeats=repeats,
+        spmv_s=np.array([1.9e-4, 2.0e-4, 1.7e-4, 1.8e-4]),
+        spmv_mesh_s=spmv_mesh_s, halo_s=halo_s,
+        reduction_s=reduction_s, step_s=step_s,
+        links=tuple(cal.fit_link_bandwidths(links)),
+        gather_bytes=gather_bytes, wire_bytes=wire_bytes)
+
+
+@pytest.fixture(scope="module")
+def fixture_profile():
+    """ONE measured gather-lane profile of the committed skewed
+    fixture at mesh 4, shared by every test that needs real timings
+    (profiling compiles ~10 small programs - pay it once)."""
+    if not compat.has_shard_map() or len(jax.devices()) < 4:
+        pytest.skip("needs shard_map and >= 4 (virtual) devices")
+    a = mmio.load_matrix_market(FIXTURE)
+    from cuda_mpi_parallel_tpu.parallel import make_mesh
+
+    return pt.profile_distributed(
+        a, mesh=make_mesh(4), exchange="gather", repeats=4,
+        solve_iterations=50, solve_elapsed_s=0.05)
+
+
+class TestLinkFit:
+    """Per-link bandwidth fitting: exact recovery from synthetic
+    timings (ISSUE 11 satellite)."""
+
+    def test_recovers_two_hand_chosen_bandwidths_exactly(self):
+        bw1, bw2 = 2.5e9, 7.5e8
+        rounds = [(1, 1000, 1000 / bw1), (2, 600, 600 / bw2)]
+        fitted = cal.fit_link_bandwidths(rounds)
+        assert fitted[0]["shift"] == 1 and fitted[1]["shift"] == 2
+        assert fitted[0]["bytes_per_s"] == pytest.approx(bw1, rel=1e-12)
+        assert fitted[1]["bytes_per_s"] == pytest.approx(bw2, rel=1e-12)
+
+    def test_accepts_dict_rounds_and_rides_the_model(self):
+        rounds = [{"shift": 3, "bytes": 352, "seconds": 1e-5}]
+        fitted = cal.fit_link_bandwidths(rounds)
+        assert fitted[0]["bytes_per_s"] == pytest.approx(3.52e7)
+        fit = cal.fit_machine_model(
+            cal.observations_from_profile(synthetic_profile()),
+            base=BASE, backend="cpu", per_link=fitted)
+        assert fit.model.per_link == ((3, pytest.approx(3.52e7)),)
+        # JSON round-trip preserves the per-link tuples
+        back = roof.MachineModel.from_json(
+            json.loads(json.dumps(fit.model.to_json())))
+        assert back.per_link == fit.model.per_link
+
+    def test_round_wire_bytes_sums_to_matvec_wire(self):
+        from cuda_mpi_parallel_tpu.parallel import partition as part
+
+        a = mmio.load_matrix_market(FIXTURE)
+        parts = part.partition_csr(a, 4, exchange="gather")
+        sched = parts.halo
+        per_round = sched.round_wire_bytes(8)
+        assert sum(per_round) == sched.wire_bytes_per_matvec(8)
+        assert len(per_round) == len(sched.rounds)
+        assert all(b > 0 for b in per_round)
+
+
+class TestPhaseObservations:
+    """One profiled solve -> >= 2 observations -> lstsq2 confident
+    (ISSUE 11 satellite + acceptance (b))."""
+
+    def test_two_orthogonal_observations(self):
+        prof = synthetic_profile()
+        obs = cal.observations_from_profile(prof)
+        assert len(obs) == 2
+        spmv, halo = obs
+        assert spmv.gather_bytes_per_iteration > 0
+        assert spmv.net_bytes_per_iteration == 0.0
+        assert halo.gather_bytes_per_iteration == 0.0
+        assert halo.net_bytes_per_iteration == prof.wire_bytes
+        assert spmv.iterations == halo.iterations == prof.repeats
+
+    def test_fit_recovers_hand_chosen_bandwidths_exactly(self):
+        # phase walls chosen so the model is exact: spmv wall =
+        # gather_bytes / gather_bw, halo wall = wire_bytes / net_bw
+        gather_bw, net_bw = 4.0e10, 2.0e9
+        prof = synthetic_profile(
+            spmv_mesh_s=40_000 / gather_bw, halo_s=1160 / net_bw)
+        fit = cal.fit_machine_model(
+            cal.observations_from_profile(prof), base=BASE,
+            backend="cpu")
+        assert fit.method == "lstsq2"
+        assert fit.confident
+        assert fit.residual_rel < 1e-9
+        assert fit.model.net_bytes_per_s == pytest.approx(net_bw,
+                                                          rel=1e-9)
+        assert fit.model.gather_slowdown == pytest.approx(
+            BASE.mem_bytes_per_s / gather_bw, rel=1e-9)
+
+    def test_single_wall_time_observation_cannot_reach_lstsq2(self):
+        """The baseline this subsystem removes: ONE whole-solve
+        observation is rank-deficient, so the fit falls back."""
+        obs = cal.PhaseObservation(
+            iterations=100, elapsed_s=0.01,
+            gather_bytes_per_iteration=40_000.0,
+            net_bytes_per_iteration=1160.0)
+        fit = cal.fit_machine_model([obs], base=BASE, backend="cpu")
+        assert fit.method != "lstsq2"
+
+    def test_repeats_floor_gates_confidence(self):
+        prof = synthetic_profile(repeats=2)   # 2 + 2 < 8 iterations
+        fit = cal.fit_machine_model(
+            cal.observations_from_profile(prof), base=BASE,
+            backend="cpu")
+        assert not fit.confident
+
+
+class TestProfileMeasured:
+    """Real measured profile of the skewed fixture at mesh 4."""
+
+    def test_phases_positive_and_per_shard(self, fixture_profile):
+        p = fixture_profile
+        assert p.kind == "csr-gather" and p.exchange == "gather"
+        assert p.spmv_s.shape == (4,)
+        assert (p.spmv_s > 0).all()
+        assert p.spmv_mesh_s > 0 and p.halo_s > 0
+        assert p.reduction_s > 0 and p.step_s > 0
+        assert p.stall_factors()["spmv"] >= 1.0
+        # the phase decomposition must explain a sane fraction of the
+        # measured iteration core (the lint gate pins 0.7..1.3 on the
+        # gate host; the unit bound is loose for noisy CI runners)
+        assert 0.2 < p.explained_fraction() < 3.0
+        assert p.explained_fraction_vs_solve() is not None
+
+    def test_links_match_schedule_rounds(self, fixture_profile):
+        from cuda_mpi_parallel_tpu.parallel import partition as part
+
+        a = mmio.load_matrix_market(FIXTURE)
+        sched = part.partition_csr(a, 4, exchange="gather").halo
+        links = fixture_profile.links
+        assert len(links) == len(sched.rounds) >= 2
+        per_round = sched.round_wire_bytes(8)
+        for link, rnd, b in zip(links, sched.rounds, per_round):
+            assert link["shift"] == rnd.shift
+            assert link["bytes"] == b
+            assert link["bytes_per_s"] > 0
+
+    def test_one_measured_profile_reaches_confident_lstsq2(
+            self, fixture_profile):
+        fit = cal.fit_machine_model(
+            cal.observations_from_profile(fixture_profile),
+            per_link=fixture_profile.links)
+        assert fit.method == "lstsq2"
+        assert fit.confident
+        assert fit.model.per_link is not None
+        assert len(fit.model.per_link) == len(fixture_profile.links)
+
+    def test_to_json_shape_and_event_schema(self, fixture_profile):
+        payload = fixture_profile.to_json()
+        for key in ("phases", "spmv_s", "links", "stall_factors",
+                    "explained_fraction", "wire_bytes",
+                    "gather_bytes"):
+            assert key in payload
+        with events.capture() as buf:
+            pt.note_profile(fixture_profile)
+        lines = [json.loads(ln) for ln in
+                 buf.getvalue().strip().splitlines()]
+        assert len(lines) == 1
+        ev = events.validate_event(lines[0])
+        assert ev["event"] == "phase_profile"
+        assert ev["exchange"] == "gather"
+        assert ev["links"][0]["bytes_per_s"] > 0
+        # gauges landed too
+        from cuda_mpi_parallel_tpu.telemetry.registry import REGISTRY
+
+        assert REGISTRY.gauge("phase_seconds",
+                              labelnames=("phase",)).value(
+                                  phase="spmv") > 0
+        assert REGISTRY.gauge("phase_link_bytes_per_s",
+                              labelnames=("shift",)).value(
+                                  shift="1") > 0
+
+    def test_report_phase_section(self, fixture_profile):
+        rep = treport.SolveReport(
+            record={"problem": "t", "status": "CONVERGED",
+                    "iterations": 5, "residual_norm": 0.0},
+            phase=fixture_profile.to_json())
+        text = rep.to_text()
+        assert "-- phase profile (measured) --" in text
+        assert "per-shard spmv" in text
+        assert "link shift" in text
+        assert "explained" in text
+        assert "phase_profile" in rep.to_json()
+
+
+class TestRingAndAllgatherLanes:
+    """The profiler covers every general-CSR lane."""
+
+    @needs_mesh
+    def test_allgather_profile_has_no_links(self):
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+
+        a = poisson.poisson_2d_csr(8, 8)
+        p = pt.profile_distributed(a, mesh=make_mesh(4),
+                                   exchange="allgather", repeats=2)
+        assert p.exchange == "allgather"
+        assert p.links == ()
+        assert p.halo_s > 0 and p.spmv_mesh_s > 0
+
+    @needs_mesh
+    def test_ring_profile_measures_one_rotation_link(self):
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+
+        a = poisson.poisson_2d_csr(8, 8)
+        p = pt.profile_distributed(a, mesh=make_mesh(4),
+                                   csr_comm="ring", repeats=2)
+        assert p.exchange == "ring"
+        assert len(p.links) == 1
+        assert p.links[0]["shift"] == 1
+        assert p.links[0]["bytes"] == p.n_local * p.itemsize
+
+    @needs_mesh
+    def test_refusals(self):
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+
+        a = mmio.load_matrix_market(FIXTURE)
+        with pytest.raises(ValueError, match="ring-shiftell"):
+            pt.profile_distributed(a, mesh=make_mesh(4),
+                                   csr_comm="ring-shiftell")
+        stencil = poisson.poisson_2d_operator(16, 16)
+        with pytest.raises(ValueError, match="CSRMatrix"):
+            pt.profile_distributed(stencil, mesh=make_mesh(4))
+        with pytest.raises(ValueError, match="repeats"):
+            pt.profile_distributed(a, mesh=make_mesh(4), repeats=0)
+
+
+class TestPerfettoMeasured:
+    """Measured spans + the structured span_source field."""
+
+    def test_measured_spans_and_metadata(self, fixture_profile):
+        trace = treport.perfetto_trace(
+            iterations=10, elapsed_s=0.01,
+            phase_profile=fixture_profile, label="t")
+        treport.validate_perfetto(trace)
+        assert trace["metadata"]["span_source"] == "measured"
+        assert trace["metadata"]["explained_fraction"] is not None
+        spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"
+                 and (e.get("args") or {}).get("span_source")
+                 == "measured"]
+        # 4 shards x 10 iterations x (halo, spmv, reduction)
+        assert len(spans) == 4 * 10 * 3
+        names = {e["name"] for e in spans}
+        assert names == {"halo", "spmv", "reduction"}
+
+    def test_modeled_fallback_labeled(self):
+        trace = treport.perfetto_trace(iterations=5, elapsed_s=0.01,
+                                       n_shards=4)
+        treport.validate_perfetto(trace)
+        assert trace["metadata"]["span_source"] == "modeled"
+        assert "note" not in trace["metadata"]
+
+    def test_accepts_json_payload_too(self, fixture_profile):
+        trace = treport.perfetto_trace(
+            iterations=3, elapsed_s=0.01,
+            phase_profile=fixture_profile.to_json())
+        assert trace["metadata"]["span_source"] == "measured"
+        treport.validate_perfetto(trace)
+
+
+class TestValidateTraceTool:
+    """tools/validate_trace.py requires span_source (satellite)."""
+
+    @pytest.fixture()
+    def tool(self):
+        path = pathlib.Path(__file__).resolve().parents[1] \
+            / "tools" / "validate_trace.py"
+        spec = importlib.util.spec_from_file_location(
+            "validate_trace_tool", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _write(self, tmp_path, trace):
+        p = tmp_path / "trace.json"
+        p.write_text(json.dumps(trace))
+        return str(p)
+
+    def test_rejects_missing_span_source(self, tool, tmp_path):
+        trace = treport.perfetto_trace(iterations=2, elapsed_s=0.01,
+                                       n_shards=2)
+        del trace["metadata"]["span_source"]
+        with pytest.raises(ValueError, match="span_source"):
+            tool.check_perfetto(self._write(tmp_path, trace))
+
+    def test_rejects_bare_array(self, tool, tmp_path):
+        trace = treport.perfetto_trace(iterations=2, elapsed_s=0.01,
+                                       n_shards=2)
+        with pytest.raises(ValueError, match="metadata"):
+            tool.check_perfetto(self._write(tmp_path,
+                                            trace["traceEvents"]))
+
+    def test_accepts_both_sources(self, tool, tmp_path,
+                                  fixture_profile):
+        modeled = treport.perfetto_trace(iterations=2, elapsed_s=0.01,
+                                         n_shards=2)
+        assert tool.check_perfetto(self._write(tmp_path, modeled)) > 0
+        measured = treport.perfetto_trace(
+            iterations=2, elapsed_s=0.01,
+            phase_profile=fixture_profile)
+        assert tool.check_perfetto(self._write(tmp_path, measured)) > 0
+
+
+class TestCli:
+    """--phase-profile end to end + the refusal matrix."""
+
+    def test_cli_phase_profile_record(self, tmp_path, capsys,
+                                      monkeypatch):
+        from cuda_mpi_parallel_tpu import cli
+        from cuda_mpi_parallel_tpu.telemetry import (
+            shardscope as tshard,
+        )
+
+        monkeypatch.setenv("CUDA_MPI_PARALLEL_TPU_CACHE_DIR",
+                           str(tmp_path))
+        try:
+            rc = cli.main(["--problem", "mm", "--file", FIXTURE,
+                           "--mesh", "4", "--device", "cpu",
+                           "--tol", "1e-8", "--maxiter", "500",
+                           "--exchange", "gather",
+                           "--phase-profile", "4", "--json"])
+        finally:
+            telemetry.force_active(False)
+            tshard.reset_last_shard_report()
+        assert rc == 0
+        record = json.loads(capsys.readouterr().out.strip())
+        pp = record["phase_profile"]
+        assert pp["exchange"] == "gather"
+        assert pp["repeats"] == 4
+        assert len(pp["links"]) >= 2
+        assert all(link["bytes_per_s"] > 0 for link in pp["links"])
+        # acceptance (b): lstsq2 + confident from this ONE solve
+        fit = pp["calibration"]
+        assert fit["method"] == "lstsq2"
+        assert fit["confident"] is True
+        assert fit["model"]["per_link"]
+        assert pp["solve_s_per_iteration"] > 0
+
+    def test_cli_refusals(self):
+        from cuda_mpi_parallel_tpu import cli
+
+        with pytest.raises(SystemExit, match="mesh"):
+            cli.main(["--problem", "mm", "--file", FIXTURE,
+                      "--phase-profile"])
+        with pytest.raises(SystemExit, match="CSR"):
+            cli.main(["--problem", "poisson2d", "--n", "16",
+                      "--matrix-free", "--mesh", "4",
+                      "--phase-profile"])
+        with pytest.raises(SystemExit, match="ring-shiftell"):
+            cli.main(["--problem", "mm", "--file", FIXTURE,
+                      "--mesh", "4", "--csr-comm", "ring-shiftell",
+                      "--phase-profile"])
+        with pytest.raises(SystemExit, match="df64"):
+            cli.main(["--problem", "mm", "--file", FIXTURE,
+                      "--mesh", "4", "--dtype", "df64",
+                      "--phase-profile"])
+        with pytest.raises(SystemExit, match=">= 0"):
+            cli.main(["--problem", "mm", "--file", FIXTURE,
+                      "--mesh", "4", "--phase-profile", "-1"])
+        with pytest.raises(SystemExit, match="rhs"):
+            cli.main(["--problem", "mm", "--file", FIXTURE,
+                      "--mesh", "4", "--rhs", "8",
+                      "--phase-profile"])
+
+
+class TestZeroPerturbation:
+    """Profiling runs its own dispatches - the solve body never moves
+    a bit (ISSUE 11 acceptance)."""
+
+    @needs_mesh
+    def test_phase_profiling_leaves_solve_jaxpr_identical(self):
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+        from cuda_mpi_parallel_tpu.parallel import partition as part
+        from cuda_mpi_parallel_tpu.parallel.operators import DistCSR
+        from cuda_mpi_parallel_tpu.solver.cg import cg
+
+        a = poisson.poisson_2d_csr(8, 8)
+        mesh = make_mesh(4)
+
+        def trace():
+            parts = part.partition_csr(a, 4)
+            b = jnp.zeros(parts.n_global_padded)
+            data = jnp.asarray(parts.data)
+            cols = jnp.asarray(parts.cols)
+            rows = jnp.asarray(parts.local_rows)
+
+            @partial(compat.shard_map, mesh=mesh,
+                     in_specs=(P("rows"),) * 4, out_specs=P("rows"))
+            def run(b_local, d, c, r):
+                strip = partial(jax.tree.map, lambda v: v[0])
+                op = DistCSR(data=strip(d), cols=strip(c),
+                             local_rows=strip(r),
+                             n_local=parts.n_local,
+                             axis_name="rows", n_shards=4)
+                return cg(op, b_local, axis_name="rows", maxiter=25).x
+            return str(jax.make_jaxpr(run)(b, data, cols, rows))
+
+        base = trace()
+        # the FULL profiling pipeline: measure, publish, fit, persist
+        prof = pt.profile_distributed(a, mesh=mesh, repeats=2)
+        pt.note_profile(prof)
+        fit = cal.fit_machine_model(
+            cal.observations_from_profile(prof), per_link=prof.links)
+        cal.note_calibration(fit)
+        assert trace() == base

@@ -438,6 +438,57 @@ class TestServiceObservability:
         assert stats["bucket_counts"] == {"4": 1}
         svc.close()
 
+    def test_wait_vs_solve_latency_split(self):
+        """stats() reports queue wait and batched solve wall as
+        SEPARATE percentile families (ISSUE 11 satellite), and the
+        report renderer prints both lines."""
+        from cuda_mpi_parallel_tpu.telemetry.report import (
+            service_lines,
+        )
+
+        svc, clock = manual_service()
+        a = poisson_csr()
+        h = svc.register(a)
+        rng = np.random.default_rng(5)
+        futs = [svc.submit(h, np.asarray(
+            a @ rng.standard_normal(a.shape[0])), tol=1e-8)
+            for _ in range(3)]
+        clock.advance(0.020)   # requests wait 20 ms on the fake clock
+        svc.pump()
+        results = [f.result() for f in futs]
+        stats = svc.stats()
+        for key in ("wait", "solve"):
+            sub = stats[key]
+            assert sub["count"] == 3
+            for q in ("p50_s", "p95_s", "p99_s"):
+                assert sub[q] is not None
+        # the fake clock pins wait at exactly 20 ms for every request;
+        # solve wall is real time (perf_counter) and must be recorded
+        assert stats["wait"]["p50_s"] == pytest.approx(0.020)
+        assert stats["solve"]["p50_s"] > 0.0
+        # latency = wait + solve per request, so the split is a true
+        # decomposition of the end-to-end story
+        r = results[0]
+        assert r.latency_s == pytest.approx(r.wait_s + r.solve_s)
+        lines = "\n".join(service_lines(stats))
+        assert "wait" in lines and "solve" in lines
+        svc.close()
+
+    def test_timeout_wait_lands_in_wait_distribution(self):
+        svc, clock = manual_service()
+        a = poisson_csr()
+        h = svc.register(a)
+        fut = svc.submit(h, np.ones(a.shape[0]), tol=1e-8,
+                         deadline_s=0.001)
+        clock.advance(0.005)   # expire it before any dispatch
+        svc.pump()
+        assert fut.result().timed_out
+        stats = svc.stats()
+        assert stats["wait"]["count"] == 1
+        assert stats["wait"]["p50_s"] == pytest.approx(0.005)
+        assert stats["solve"]["count"] == 0
+        svc.close()
+
 
 # ---------------------------------------------------------------------------
 # workload files

@@ -130,6 +130,20 @@ METRIC_DIRECTION = {
     "serve.occupancy_mean": None,
     "serve.padding_fraction": None,
     "serve.timeouts": None,
+    # measured phase-profile columns (PR 11, telemetry.phasetrace):
+    # per-phase seconds-per-iteration shares, the measured per-shard
+    # SpMV stall factor, and the explained-fraction residual of the
+    # phase decomposition.  Reported, never gated - phase walls track
+    # host scheduling weather as much as code; pre-PR-11 files simply
+    # lack them (rendered n/a).
+    "phase.halo_s_per_iter": None,
+    "phase.spmv_s_per_iter": None,
+    "phase.reduction_s_per_iter": None,
+    "phase.halo_share": None,
+    "phase.spmv_share": None,
+    "phase.reduction_share": None,
+    "phase.spmv_stall_factor": None,
+    "phase.explained_fraction": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -178,6 +192,10 @@ _NESTED = {
               "speedup_vs_unbatched", "p50_latency_s", "p95_latency_s",
               "p99_latency_s", "occupancy_mean", "padding_fraction",
               "timeouts"),
+    "phase": ("halo_s_per_iter", "spmv_s_per_iter",
+              "reduction_s_per_iter", "halo_share", "spmv_share",
+              "reduction_share", "spmv_stall_factor",
+              "explained_fraction"),
 }
 
 

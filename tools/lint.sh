@@ -331,6 +331,87 @@ print(f"serve gate: {stats['completed']} requests in "
 PY
 echo "serve gate: clean"
 
+# Phasetrace gate: measured per-shard per-phase timing end-to-end on
+# the committed skewed fixture - one mesh-4 CLI solve with
+# --phase-profile must produce (a) a MEASURED Perfetto timeline
+# (metadata span_source="measured" - validate_trace.py now requires
+# the field on every exported timeline), (b) a schema-valid
+# phase_profile event carrying per-neighbor (per-link) bandwidth
+# estimates for the gather rounds, (c) a phase-resolved CalibrationFit
+# reaching the lstsq2 CONFIDENT tier from this single solve (baseline:
+# one wall-time observation only reaches fixed-net), and (d) a phase
+# sum explaining the measured per-iteration wall within 30%.
+echo "== phasetrace gate (mesh-4 CLI: --phase-profile measured) =="
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --device cpu --tol 1e-8 --maxiter 500 --json \
+    --exchange gather --phase-profile \
+    --trace-events "$scratch/phase_events.jsonl" \
+    --trace-perfetto "$scratch/phase_trace.json" \
+    > "$scratch/phase.json"
+python tools/validate_trace.py "$scratch/phase_events.jsonl" \
+    "$scratch/phase_trace.json"
+# JAX_PLATFORMS pinned: this checker imports the package (for the
+# profiler's own explained-fraction tolerance constant), and a bare
+# jax import must not try to reach a TPU tunnel
+JAX_PLATFORMS=cpu python - "$scratch" <<'PY'
+import json
+import sys
+
+scratch = sys.argv[1]
+with open(f"{scratch}/phase.json") as f:
+    rec = json.load(f)
+with open(f"{scratch}/phase_trace.json") as f:
+    trace = json.load(f)
+events = [json.loads(ln)
+          for ln in open(f"{scratch}/phase_events.jsonl")
+          if ln.strip()]
+
+# (a) measured Perfetto spans
+meta = trace["metadata"]
+assert meta["span_source"] == "measured", meta
+spans = [e for e in trace["traceEvents"]
+         if e.get("ph") == "X"
+         and (e.get("args") or {}).get("span_source") == "measured"]
+assert spans, "no measured per-shard spans in the timeline"
+
+# (b) phase_profile event with per-link bandwidths
+profs = [e for e in events if e["event"] == "phase_profile"]
+assert profs, "no phase_profile event emitted"
+links = profs[-1].get("links") or []
+assert links, "phase_profile event carries no per-link entries"
+assert all(l["bytes_per_s"] > 0 for l in links), links
+assert len(links) >= 2, \
+    f"gather lane should time >= 2 rounds on the fixture: {links}"
+
+# (c) lstsq2 confident calibration from ONE profiled solve
+pp = rec["phase_profile"]
+fit = pp["calibration"]
+assert fit["method"] == "lstsq2", fit
+assert fit["confident"] is True, fit
+assert fit["model"]["per_link"], fit["model"]
+assert len(pp["links"]) == len(links), (pp["links"], links)
+
+# (d) the phase sum explains the measured iteration wall within the
+# profiler's own stated tolerance (one constant, no drifting copies)
+from cuda_mpi_parallel_tpu.telemetry.phasetrace import (
+    EXPLAINED_FRACTION_FLOOR as FLOOR,
+)
+
+ef = pp["explained_fraction"]
+assert FLOOR <= ef <= 2.0 - FLOOR, \
+    f"phase sum explains {ef * 100:.1f}% of the measured iteration " \
+    f"wall (need {FLOOR * 100:.0f}-{(2.0 - FLOOR) * 100:.0f}%)"
+shares = pp["phases"]
+print(f"phasetrace gate: halo {shares['halo_s'] * 1e6:.1f}us + spmv "
+      f"{shares['spmv_s'] * 1e6:.1f}us + 2x reduction "
+      f"{shares['reduction_s'] * 1e6:.1f}us = "
+      f"{ef * 100:.1f}% of the measured iteration; "
+      f"{len(links)} links fitted; calibration {fit['method']} "
+      f"(confident), {len(spans)} measured spans")
+PY
+echo "phasetrace gate: clean"
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

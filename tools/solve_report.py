@@ -105,6 +105,13 @@ def build_report(evs) -> treport.SolveReport:
             comm["exchange"] = cc["exchange"]
         if cc.get("halo_padding_fraction") is not None:
             comm["halo_padding_fraction"] = cc["halo_padding_fraction"]
+    # measured phase profile (telemetry.phasetrace): the phase_profile
+    # event carries PhaseProfile.to_json() verbatim - render its phase
+    # columns offline, and reuse it for measured Perfetto spans
+    phase = _last(evs, "phase_profile")
+    if phase is not None:
+        phase = {k: v for k, v in phase.items()
+                 if k not in ("event", "t", "solve_id", "phase")}
     health = _last(evs, "solve_health")
     if health is not None:
         # drop the event envelope so the offline report's health JSON
@@ -137,7 +144,7 @@ def build_report(evs) -> treport.SolveReport:
     sections = tuple((end.get("sections") or {}).items())
     return treport.SolveReport(record=record, shard=shard, comm=comm,
                                health=health, calibration=calibration,
-                               sections=sections)
+                               phase=phase, sections=sections)
 
 
 def main(argv=None) -> int:
@@ -174,6 +181,9 @@ def main(argv=None) -> int:
             elapsed_s=float(elapsed), shard=rep.shard,
             n_shards=rep.shard.n_shards if rep.shard else 1,
             sections=rep.sections,
+            # a recorded phase_profile event upgrades the offline
+            # timeline to measured spans, hours later, on any machine
+            phase_profile=rep.phase,
             label=str(rep.record.get("problem", "solve")))
         treport.validate_perfetto(trace)
         treport.write_perfetto(args.perfetto, trace)

@@ -37,7 +37,16 @@ def check_events(path: str) -> int:
 
 
 def check_perfetto(path: str) -> int:
-    """Validate the timeline structurally; returns the event count."""
+    """Validate the timeline structurally; returns the event count.
+
+    Beyond the library's structural contract
+    (``telemetry.report.validate_perfetto``), every timeline THIS repo
+    exports must declare how its per-shard spans were produced: the
+    metadata ``span_source`` field, ``"measured"`` (phase-profiler
+    walls) or ``"modeled"`` (static-schedule rendering).  A bare
+    top-level event array cannot carry metadata and is rejected here -
+    the exporters always write the object form.
+    """
     with open(path, encoding="utf-8") as f:
         try:
             trace = json.load(f)
@@ -47,8 +56,18 @@ def check_perfetto(path: str) -> int:
         validate_perfetto(trace)
     except ValueError as e:
         raise ValueError(f"{path}: {e}") from e
-    events = trace if isinstance(trace, list) else trace["traceEvents"]
-    return len(events)
+    if not isinstance(trace, dict):
+        raise ValueError(
+            f"{path}: bare event array carries no metadata - exported "
+            f"timelines must be the object form with a span_source "
+            f"field")
+    source = (trace.get("metadata") or {}).get("span_source")
+    if source not in ("measured", "modeled"):
+        raise ValueError(
+            f"{path}: metadata.span_source must be 'measured' or "
+            f"'modeled', got {source!r} (every exported timeline "
+            f"declares its span renderer)")
+    return len(trace["traceEvents"])
 
 
 def main(argv=None) -> int:
