@@ -790,6 +790,7 @@ SECTION_PRIORITY = [
     "distributed",
     "many_rhs",                            # batched-RHS amortization
     "serve",                               # solver-service replay
+    "robust",                              # chaos guard + recovery
     "unstructured",
     "poisson2d_1M_csr",                    # ~92 ms/iter gather: last
 ]
@@ -1721,6 +1722,70 @@ def bench_all(results, sections=None) -> None:
         results["serve"] = entry
 
     registry.append(("serve", s_serve))
+
+    # 8: robustness (robust/): the breakdown guard + chaos recovery.
+    # (a) armed-vs-clean overhead: a FaultPlan that never fires still
+    # adds its lax.cond selects to the loop - that delta is the whole
+    # in-loop price of the injection machinery (the guard itself rides
+    # the existing health predicate, which predates this row and is
+    # always on).  (b) an injected mesh-4 halo fault: detection
+    # latency in iterations and wall time-to-recover vs the clean
+    # solve.  Reported by bench_compare, never gated (overheads track
+    # host scheduling weather).
+    def s_robust():
+        from cuda_mpi_parallel_tpu.models import mmio
+        from cuda_mpi_parallel_tpu.robust import (
+            FaultPlan,
+            solve_with_recovery,
+        )
+
+        a4 = mmio.load_matrix_market(
+            "tests/fixtures/skewed_spd_240.mtx")
+        b4 = np.random.default_rng(17).standard_normal(240)
+        mesh4 = make_mesh(4)
+
+        el_c, res_c = time_fn(
+            lambda: solve_distributed(a4, b4, mesh=mesh4, tol=1e-8,
+                                      maxiter=500),
+            warmup=1, repeats=3)
+        armed = FaultPlan(site="spmv", iteration=10 ** 8)
+        el_a, res_a = time_fn(
+            lambda: solve_distributed(a4, b4, mesh=mesh4, tol=1e-8,
+                                      maxiter=500, inject=armed),
+            warmup=1, repeats=3)
+        el_r, rr = time_fn(
+            lambda: solve_with_recovery(
+                a4, b4, mesh=mesh4, tol=1e-8, maxiter=500,
+                inject=FaultPlan(site="halo", iteration=10)),
+            warmup=1, repeats=1)
+        its = max(int(res_c.iterations), 1)
+        entry = {
+            "n": int(a4.shape[0]), "tol": 1e-8,
+            "measurement": "solve_wall",
+            "iterations": its,
+            "converged": bool(res_c.converged)
+            and bool(res_a.converged) and rr.recovered,
+            "note": "mesh-4 skewed fixture: armed-but-silent "
+                    "FaultPlan overhead + injected halo-fault "
+                    "detection/recovery",
+            "robust": {
+                "guarded_iters_per_sec": round(its / el_c, 1),
+                "armed_iters_per_sec": round(
+                    max(int(res_a.iterations), 1) / el_a, 1),
+                "armed_overhead_pct": round(
+                    100.0 * (el_a / max(el_c, 1e-30) - 1.0), 2),
+                "detection_latency_iters":
+                    int(rr.faults[0]["iteration"]) - 10
+                    if rr.faults else None,
+                "time_to_recover_s": round(float(el_r), 6),
+                "recovery_overhead_pct": round(
+                    100.0 * (el_r / max(el_c, 1e-30) - 1.0), 2),
+                "restarts": rr.restarts,
+            },
+        }
+        results["robust"] = entry
+
+    registry.append(("robust", s_robust))
 
     known = {name for name, _ in registry}
     if sections:

@@ -243,6 +243,41 @@ def build_parser() -> argparse.ArgumentParser:
                         "this ONE solve (no --repeat needed).  "
                         "Assembled-CSR problems with --mesh > 1, "
                         "general engine")
+    p.add_argument("--inject", default=None, metavar="SITE:ITER[:SHARD]",
+                   help="deterministic chaos injection (robust."
+                        "FaultPlan): corrupt the halo payload, the "
+                        "local SpMV output or the reduction scalar at "
+                        "a 0-based solver iteration, in-trace via "
+                        "lax.cond inside the compiled while_loop "
+                        "(e.g. halo:10, spmv:25:2).  The solve exits "
+                        "with a typed BREAKDOWN within --check-every "
+                        "iterations of the fault; add --recover to "
+                        "self-heal.  method=cg, general engine; halo "
+                        "site needs --mesh > 1 (it corrupts the "
+                        "distributed exchange)")
+    p.add_argument("--recover", nargs="?", const=2, default=None,
+                   type=int, metavar="N",
+                   help="self-healing solve (robust."
+                        "solve_with_recovery): on a typed BREAKDOWN, "
+                        "restart CG from the last finite iterate up "
+                        "to N times (bare flag: 2), emitting "
+                        "solve_fault/solve_recovery events.  A "
+                        "transient --inject fault disarms on restart; "
+                        "the recovered solution matches the "
+                        "fault-free solve")
+    p.add_argument("--no-validate", action="store_true",
+                   dest="no_validate",
+                   help="skip the host-side pre-solve finiteness "
+                        "check of b and the matrix data (robust."
+                        "validate; the check is on by default and "
+                        "rejects NaN/Inf inputs loudly instead of "
+                        "spinning a poisoned recurrence)")
+    p.add_argument("--save-x", default=None, metavar="PATH",
+                   dest="save_x",
+                   help="np.save the solution vector (or (n, k) "
+                        "stack with --rhs) to PATH after the solve - "
+                        "how the chaos gate compares a recovered run "
+                        "against the fault-free one")
     p.add_argument("--history", action="store_true",
                    help="print per-iteration residual trace")
     p.add_argument("--flight-record", nargs="?", const=1, default=None,
@@ -737,6 +772,106 @@ def main(argv=None) -> int:
                 "--precond bjacobi with --rhs-method block is "
                 "unsupported (use --rhs-method batched)")
 
+    # Chaos injection / recovery (--inject / --recover): the robust/
+    # harness rides the general textbook-CG lanes.  Same
+    # never-silently-drop rule as every other flag: any path that
+    # cannot carry the fault (or the restart loop) refuses loudly.
+    fault_plan = None
+    recover_policy = None
+    if args.inject is not None:
+        from .models.operators import CSRMatrix
+        from .robust import FaultPlan
+
+        try:
+            fault_plan = FaultPlan.parse(args.inject)
+        except ValueError as e:
+            raise SystemExit(f"--inject {args.inject}: {e}")
+        if args.method != "cg":
+            raise SystemExit(
+                f"--inject rides --method cg only (got "
+                f"{args.method}): the chaos harness drills the "
+                f"textbook recurrence")
+        if args.df64:
+            raise SystemExit("--inject does not support --dtype df64 "
+                             "(the double-float recurrences carry no "
+                             "injection sites yet)")
+        if args.engine in ("resident", "streaming"):
+            raise SystemExit(
+                f"--inject with --engine {args.engine} is "
+                f"unsupported: the one-kernel engines carry no "
+                f"injection sites (use --engine general/auto)")
+        if args.repeat > 1:
+            raise SystemExit("--inject with --repeat is unsupported "
+                             "(a poisoned solve must not feed the "
+                             "calibration loop)")
+        if args.csr_comm != "allgather" or args.exchange == "ring":
+            raise SystemExit(
+                "--inject needs the allgather/gather halo wires "
+                "(the ring schedules carry no injection hook; drop "
+                "--csr-comm ring / --exchange ring)")
+        if args.rhs > 1 and (args.rhs_method or "batched") == "block":
+            raise SystemExit(
+                "--inject with --rhs-method block is unsupported "
+                "(block-CG's Gram-collapse fallback would mask the "
+                "fault; use --rhs-method batched)")
+        if fault_plan.site == "halo" and args.mesh <= 1:
+            raise SystemExit(
+                "--inject halo:... needs --mesh > 1 (it corrupts the "
+                "distributed halo exchange payload; single-device "
+                "solves have no wire - use spmv: or reduction:)")
+        if fault_plan.shard >= max(args.mesh, 1):
+            raise SystemExit(
+                f"--inject targets shard {fault_plan.shard} but "
+                f"--mesh is {args.mesh}")
+        if args.mesh > 1 and not isinstance(a, CSRMatrix):
+            raise SystemExit(
+                "--inject with --mesh > 1 supports assembled-CSR "
+                "problems only (stencil slabs carry no injection "
+                "hook; drop --matrix-free)")
+        desc += f" [inject: {args.inject}]"
+    if args.recover is not None:
+        from .robust import RecoveryPolicy
+
+        if args.recover < 0:
+            raise SystemExit(f"--recover must be >= 0, got "
+                             f"{args.recover}")
+        if args.method != "cg":
+            raise SystemExit(f"--recover rides --method cg only "
+                             f"(got {args.method})")
+        if args.df64 or args.engine in ("resident", "streaming") \
+                or args.repeat > 1:
+            raise SystemExit(
+                "--recover is unsupported with --dtype df64, "
+                "--engine resident/streaming and --repeat (the "
+                "restart loop re-dispatches the general cg path)")
+        if args.rhs > 1:
+            raise SystemExit(
+                "--recover with --rhs is unsupported (the restart "
+                "loop is single-RHS; the serve retry policy is the "
+                "many-RHS recovery lane)")
+        if args.mesh > 1 and (args.csr_comm != "allgather"
+                              or args.exchange == "ring"):
+            raise SystemExit(
+                "--recover needs the allgather/gather halo wires on "
+                "a mesh (drop --csr-comm ring/ring-shiftell / "
+                "--exchange ring): a restart seeded from the last "
+                "finite iterate re-dispatches with x0, which the "
+                "ring schedules do not carry")
+        recover_policy = RecoveryPolicy(max_restarts=args.recover)
+        desc += f" [recover: {args.recover}]"
+
+    # Loud pre-solve validation (robust.validate): reject non-finite
+    # b/matrix data HERE, before any partitioning or compile - a NaN
+    # input would otherwise spin the recurrence to its first health
+    # check and report a BREAKDOWN that was knowable for free.
+    if not args.no_validate:
+        from .robust.validate import check_finite_problem
+
+        try:
+            check_finite_problem(a, b)
+        except ValueError as e:
+            raise SystemExit(str(e))
+
     # df64 compatibility checks run BEFORE the format conversion below:
     # a doomed combination must fail fast, not after seconds of host-side
     # shift-ELL packing at 1M rows.
@@ -921,7 +1056,38 @@ def main(argv=None) -> int:
         b = np.asarray(a.matmat(_jnp.asarray(x_expected)))
         desc += f" [rhs: {args.rhs} x {args.rhs_method}]"
 
+    recovery_box = [None]   # RecoveredResult of the last --recover run
+
     def run():
+        if recover_policy is not None:
+            from .robust import solve_with_recovery
+
+            if args.mesh > 1:
+                from .parallel import make_mesh
+
+                rr = solve_with_recovery(
+                    a, b, mesh=make_mesh(args.mesh),
+                    policy=recover_policy, inject=fault_plan,
+                    tol=args.tol, rtol=args.rtol,
+                    maxiter=args.maxiter,
+                    validate=False,   # CLI validated once pre-dispatch
+                    preconditioner=args.precond,
+                    precond_degree=args.precond_degree,
+                    record_history=args.history, method=args.method,
+                    check_every=args.check_every,
+                    csr_comm=args.csr_comm, flight=flight_cfg,
+                    plan=plan_obj, exchange=args.exchange)
+            else:
+                rr = solve_with_recovery(
+                    a, b, policy=recover_policy, inject=fault_plan,
+                    tol=args.tol, rtol=args.rtol,
+                    maxiter=args.maxiter,
+                    validate=False,   # CLI validated once pre-dispatch
+                    m=_build_precond(),
+                    record_history=args.history,
+                    check_every=args.check_every)
+            recovery_box[0] = rr
+            return rr.result
         if args.rhs > 1:
             if args.mesh > 1:
                 from .parallel import make_mesh, solve_distributed_many
@@ -932,14 +1098,15 @@ def main(argv=None) -> int:
                     preconditioner=args.precond,
                     method=args.rhs_method,
                     check_every=args.check_every, flight=flight_cfg,
-                    plan=plan_obj, exchange=args.exchange)
+                    plan=plan_obj, exchange=args.exchange,
+                    inject=fault_plan)
             from .solver import solve_many
 
             return solve_many(a, b, tol=args.tol, rtol=args.rtol,
                               maxiter=args.maxiter, m=_build_precond(),
                               method=args.rhs_method,
                               check_every=args.check_every,
-                              flight=flight_cfg)
+                              flight=flight_cfg, fault=fault_plan)
         if args.df64:
             if args.mesh > 1:
                 from .parallel import make_mesh, solve_distributed_df64
@@ -1051,7 +1218,12 @@ def main(argv=None) -> int:
                 record_history=args.history, method=args.method,
                 check_every=args.check_every, csr_comm=args.csr_comm,
                 flight=flight_cfg, plan=plan_obj,
-                exchange=args.exchange)
+                exchange=args.exchange, inject=fault_plan,
+                # the CLI already ran the O(nnz) finiteness scan once,
+                # pre-dispatch (or the user opted out): re-scanning
+                # inside every warmup/timed/repeat dispatch would only
+                # distort the timings
+                validate=False)
         if args.engine in ("auto", "resident"):
             from .models.operators import _pallas_interpret
             from .solver.resident import (
@@ -1085,6 +1257,7 @@ def main(argv=None) -> int:
             cheap_ok = (args.precond in (None, "chebyshev")
                         and args.method in ("cg", "cg1") and history_ok
                         and flight_ok
+                        and fault_plan is None
                         and (args.engine == "resident"
                              or _jax_backend_is_tpu())
                         and supports_resident(
@@ -1132,6 +1305,7 @@ def main(argv=None) -> int:
                         or _jax_backend_is_tpu())
                        and args.precond in (None, "chebyshev")
                        and args.method == "cg"
+                       and fault_plan is None
                        and supports_streaming_op(a))
             m_st = None
             if cheap_s and args.precond == "chebyshev":
@@ -1162,7 +1336,8 @@ def main(argv=None) -> int:
         return solve(a, b, tol=args.tol, rtol=args.rtol,
                      maxiter=args.maxiter, m=_build_precond(),
                      record_history=args.history, method=args.method,
-                     check_every=args.check_every, flight=flight_cfg)
+                     check_every=args.check_every, flight=flight_cfg,
+                     fault=fault_plan)
 
     from .telemetry import events as tevents
     from .telemetry import session as tsession
@@ -1216,7 +1391,10 @@ def main(argv=None) -> int:
                     record_history=args.history, method=args.method,
                     check_every=args.check_every,
                     csr_comm=args.csr_comm, flight=flight_cfg,
-                    exchange=args.exchange)
+                    exchange=args.exchange,
+                    # validated once pre-dispatch; a per-repeat O(nnz)
+                    # host scan would distort the timed sequence
+                    validate=False)
                 elapsed, result = seq.final.elapsed_s, seq.final.result
                 # downstream reporting (record/report/plan line) shows
                 # the plan the final solve actually ran on
@@ -1402,6 +1580,13 @@ def main(argv=None) -> int:
         ref_x = np.asarray(result.x) if args.rhs > 1 else x_np
         err = float(np.max(np.abs(ref_x - np.asarray(x_expected))))
         record["max_abs_error"] = err
+    if fault_plan is not None:
+        record["fault"] = fault_plan.to_json()
+    if recovery_box[0] is not None:
+        record["recovery"] = recovery_box[0].to_json()
+    if args.save_x:
+        np.save(args.save_x,
+                np.asarray(result.x) if args.rhs > 1 else x_np)
     if many_result is not None:
         # per-lane story: each column is a solve of its own, and the
         # record says so (the lint gate asserts per-lane errors)
@@ -1582,6 +1767,14 @@ def main(argv=None) -> int:
             print(f"  lane status : {lanes['status']}")
         if "max_abs_error" in record:
             print(f"max err : {record['max_abs_error']:.3e}")
+        if fault_plan is not None:
+            print(f"fault   : {fault_plan.describe()}")
+        if recovery_box[0] is not None:
+            rr = recovery_box[0]
+            print(f"recover : {rr.attempts} attempt(s), "
+                  f"{rr.restarts} restart(s), "
+                  f"{'recovered' if rr.recovered else 'NOT recovered'}"
+                  f" ({len(rr.faults)} fault(s) detected)")
         # The reference prints the full solution vector (CUDACG.cu:361-364);
         # keep that behavior for small systems.
         if a.shape[0] <= 10 and args.rhs == 1:

@@ -39,7 +39,7 @@ from ..models.operators import (
 )
 from ..models.multigrid import MultigridPreconditioner
 from ..models.precond import ChebyshevPreconditioner
-from ..solver.cg import CGResult, cg
+from ..solver.cg import CGCheckpoint, CGResult, cg
 from . import partition as part
 from ..utils.compat import shard_map
 from .mesh import make_mesh, shard_vector
@@ -73,6 +73,12 @@ def solve_distributed(
     flight=None,
     plan=None,
     exchange=None,
+    x0=None,
+    resume_from: Optional[CGCheckpoint] = None,
+    return_checkpoint: bool = False,
+    iter_cap: Optional[int] = None,
+    inject=None,
+    validate: bool = True,
 ) -> CGResult:
     """Solve the global system A x = b row-partitioned over a device mesh.
 
@@ -140,6 +146,31 @@ def solve_distributed(
         ``exchange="gather"`` is honored - the planner priced that
         wire, so the solve runs it.  Stencil operators exchange plane
         halos already and reject ``exchange``.
+      x0: optional global initial guess (length n, caller's row
+        ordering - the plan permutation is applied host-side exactly
+        like ``b``'s); ``None`` keeps the copy-only zero init.  CSR
+        allgather/gather lanes only (the recovery layer's warm-restart
+        seed).
+      resume_from / return_checkpoint / iter_cap: distributed
+        checkpoint/resume (``solver.cg.CGCheckpoint`` semantics - the
+        resumed trajectory is bit-exact).  The checkpoint's vector
+        leaves live in the PADDED, plan-permuted row layout of this
+        exact partition; persist them with
+        ``utils.checkpoint.solve_resumable_distributed``, whose
+        fingerprint covers the plan/exchange/mesh so a resume under a
+        different layout fails loudly.  CSR allgather/gather lanes
+        with ``method="cg"`` only.
+      inject: optional ``robust.FaultPlan`` - deterministic chaos
+        injection into the compiled solve (halo payload / local SpMV
+        output / reduction scalar at a chosen iteration and shard; see
+        ``robust.inject``).  CSR allgather/gather lanes with
+        ``method="cg"`` only.  ``None`` leaves the traced jaxpr
+        bit-identical to a call that never mentions injection.
+      validate: host-side pre-solve finiteness check of ``b`` and the
+        operator's coefficient arrays (``robust.validate``) - a
+        non-finite input raises ``ValueError`` instead of spinning a
+        poisoned recurrence to its first health check.  ``False``
+        opts out (chaos staging).
       (tol/rtol/maxiter/record_history/check_every/compensated as in
       ``solver.cg``.)
 
@@ -187,6 +218,42 @@ def solve_distributed(
             f"plan= applies to assembled CSRMatrix problems; "
             f"{type(a).__name__} slabs are uniform by construction "
             f"(nothing to rebalance)")
+    if validate:
+        from ..robust.validate import check_finite_problem
+
+        check_finite_problem(a, b)
+        if x0 is not None:
+            from ..robust.validate import check_finite_rhs
+
+            check_finite_rhs(x0, what="x0")
+    resumable = (x0 is not None or resume_from is not None
+                 or return_checkpoint or iter_cap is not None)
+    if inject is not None or resumable:
+        feature = ("inject (fault injection)" if inject is not None
+                   else "checkpoint/resume (x0/resume_from/"
+                        "return_checkpoint/iter_cap)")
+        if not isinstance(a, CSRMatrix) or csr_comm != "allgather" \
+                or exchange == "ring":
+            raise ValueError(
+                f"{feature} rides the assembled-CSR allgather/gather "
+                f"lanes only (got {type(a).__name__}, csr_comm="
+                f"{csr_comm!r}, exchange={exchange!r}): the ring/"
+                f"shiftell schedules and stencil slabs carry neither "
+                f"the injection sites nor the checkpointable "
+                f"recurrence state")
+        if method != "cg":
+            raise ValueError(
+                f"{feature} requires method='cg' (got {method!r})")
+    if inject is not None:
+        from ..robust.inject import FaultPlan
+
+        if not isinstance(inject, FaultPlan):
+            raise TypeError(f"inject must be a robust.FaultPlan, got "
+                            f"{type(inject).__name__}")
+        if inject.shard >= int(mesh.devices.size):
+            raise ValueError(
+                f"inject targets shard {inject.shard} but the mesh "
+                f"has {int(mesh.devices.size)}")
     if flight is not None:
         flight = flight.without_heartbeat()
     kw = dict(tol=tol, rtol=rtol, maxiter=maxiter, method=method,
@@ -230,10 +297,15 @@ def solve_distributed(
         plan = resolve_plan(plan, a, n_shards,
                             exchange=_plan_exchange_hint(csr_comm,
                                                          exchange))
+        if inject is not None:
+            kw["fault"] = inject
         note()
         return _solve_csr(a, b, mesh, axis, n_shards, precond,
                           record_history, kw, csr_comm=csr_comm,
-                          plan=plan, exchange=exchange)
+                          plan=plan, exchange=exchange, x0=x0,
+                          resume_from=resume_from,
+                          return_checkpoint=return_checkpoint,
+                          iter_cap=iter_cap)
     raise TypeError(f"solve_distributed supports CSRMatrix/Stencil2D/"
                     f"Stencil3D, got {type(a).__name__}")
 
@@ -716,14 +788,26 @@ def _unpad_result(res: CGResult, parts, plan) -> CGResult:
     return dataclasses.replace(res, x=res.x[jnp.asarray(idx)])
 
 
+def _ckpt_specs(axis: str) -> CGCheckpoint:
+    """shard_map specs of a distributed ``CGCheckpoint``: recurrence
+    vectors row-sharded, scalars replicated (they were psum'd)."""
+    return CGCheckpoint(x=P(axis), r=P(axis), p=P(axis), rho=P(),
+                        rr=P(), nrm0=P(), k=P(), indefinite=P())
+
+
 def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
                kw, csr_comm: str = "allgather", plan=None,
-               exchange=None) -> CGResult:
+               exchange=None, x0=None, resume_from=None,
+               return_checkpoint: bool = False,
+               iter_cap=None) -> CGResult:
     if csr_comm == "ring-shiftell":
         return _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
                                    record_history, kw, plan=plan)
     ring = csr_comm == "ring"
     a, b = _apply_plan_permutation(a, b, plan)
+    if x0 is not None and plan is not None \
+            and plan.permutation is not None:
+        x0 = np.asarray(x0)[plan.permutation]
     ranges = plan.row_ranges if plan is not None else None
     if ring:
         parts = part.ring_partition_csr(a, n_shards, ranges)
@@ -742,6 +826,10 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
     n_local = parts.n_local
     sched = parts.halo if not ring else None
     gather = sched is not None
+    has_x0 = x0 is not None
+    has_resume = resume_from is not None
+    has_cap = iter_cap is not None
+    resumable = has_x0 or has_resume or return_checkpoint or has_cap
     # gather layouts key on their round geometry too: the same matrix
     # under a different plan's coupling compiles a different schedule
     geometry = tuple((r.shift, r.m) for r in sched.rounds) \
@@ -749,20 +837,88 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
     key = ("csr", ring, resolved, geometry, n_local, n_shards, axis,
            mesh, precond, record_history, tuple(sorted(kw.items())),
            plan.fingerprint() if plan is not None else None)
+    if resumable:
+        # the extended build below has a different signature/out tree;
+        # an un-extended call keeps its pre-extension key (and hence
+        # its compiled executable) byte-for-byte
+        key = key + (("resumable", has_x0, has_resume,
+                      return_checkpoint, has_cap),)
     send = tuple(_shard_tree(r.send_idx, mesh, axis)
                  for r in sched.rounds) if gather else ()
     shifts = tuple(r.shift for r in sched.rounds) if gather else ()
 
+    extras = ()
+    if has_x0:
+        extras = extras + (_shard_padded_rhs(x0, parts, mesh, axis),)
+    if has_resume:
+        if int(np.asarray(resume_from.x).shape[0]) \
+                != parts.n_global_padded:
+            raise ValueError(
+                f"resume_from checkpoint has {np.asarray(resume_from.x).shape[0]} "
+                f"rows but this partition's padded layout has "
+                f"{parts.n_global_padded}: the checkpoint belongs to a "
+                f"different plan/mesh layout (resume under the layout "
+                f"that wrote it - utils.checkpoint."
+                f"solve_resumable_distributed fingerprints this)")
+        extras = extras + (CGCheckpoint(
+            x=shard_vector(jnp.asarray(resume_from.x), mesh, axis),
+            r=shard_vector(jnp.asarray(resume_from.r), mesh, axis),
+            p=shard_vector(jnp.asarray(resume_from.p), mesh, axis),
+            rho=jnp.asarray(resume_from.rho),
+            rr=jnp.asarray(resume_from.rr),
+            nrm0=jnp.asarray(resume_from.nrm0),
+            k=jnp.asarray(resume_from.k),
+            indefinite=jnp.asarray(resume_from.indefinite)),)
+    if has_cap:
+        extras = extras + (jnp.asarray(int(iter_cap), jnp.int32),)
+
     def build():
         n_args = 5 if gather else 4
 
-        @partial(shard_map, mesh=mesh,
-                 in_specs=(P(axis),) * n_args,
-                 out_specs=_result_specs(axis, record_history,
-                                          kw.get("flight")))
-        def run(b_local, data_s, cols_s, rows_s, send_s=()):
+        if not resumable:
+            @partial(shard_map, mesh=mesh,
+                     in_specs=(P(axis),) * n_args,
+                     out_specs=_result_specs(axis, record_history,
+                                              kw.get("flight")))
+            def run(b_local, data_s, cols_s, rows_s, send_s=()):
+                _TRACE_COUNT[0] += 1
+                strip = partial(jax.tree.map, lambda v: v[0])
+                if gather:
+                    op = DistCSRGather(
+                        data=strip(data_s), cols=strip(cols_s),
+                        local_rows=strip(rows_s), send_idx=strip(send_s),
+                        shifts=shifts, n_local=n_local, axis_name=axis,
+                        n_shards=n_shards)
+                else:
+                    op_cls = DistCSRRing if ring else DistCSR
+                    op = op_cls(data=strip(data_s), cols=strip(cols_s),
+                                local_rows=strip(rows_s), n_local=n_local,
+                                axis_name=axis, n_shards=n_shards)
+                m = _make_precond(precond, op, axis)
+                return cg(op, b_local, m=m, record_history=record_history,
+                          axis_name=axis, **kw)
+            return run
+
+        in_specs = (P(axis),) * n_args
+        if has_x0:
+            in_specs = in_specs + (P(axis),)
+        if has_resume:
+            in_specs = in_specs + (_ckpt_specs(axis),)
+        if has_cap:
+            in_specs = in_specs + (P(),)
+        out = _result_specs(axis, record_history, kw.get("flight"))
+        if return_checkpoint:
+            out = dataclasses.replace(out, checkpoint=_ckpt_specs(axis))
+
+        @partial(shard_map, mesh=mesh, in_specs=in_specs, out_specs=out)
+        def run_resumable(b_local, data_s, cols_s, rows_s, *rest):
             _TRACE_COUNT[0] += 1
             strip = partial(jax.tree.map, lambda v: v[0])
+            rest = list(rest)
+            send_s = rest.pop(0) if gather else ()
+            x0_l = rest.pop(0) if has_x0 else None
+            ck_l = rest.pop(0) if has_resume else None
+            cap_l = rest.pop(0) if has_cap else None
             if gather:
                 op = DistCSRGather(
                     data=strip(data_s), cols=strip(cols_s),
@@ -770,14 +926,16 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
                     shifts=shifts, n_local=n_local, axis_name=axis,
                     n_shards=n_shards)
             else:
-                op_cls = DistCSRRing if ring else DistCSR
-                op = op_cls(data=strip(data_s), cols=strip(cols_s),
-                            local_rows=strip(rows_s), n_local=n_local,
-                            axis_name=axis, n_shards=n_shards)
+                op = DistCSR(data=strip(data_s), cols=strip(cols_s),
+                             local_rows=strip(rows_s), n_local=n_local,
+                             axis_name=axis, n_shards=n_shards)
             m = _make_precond(precond, op, axis)
-            return cg(op, b_local, m=m, record_history=record_history,
-                      axis_name=axis, **kw)
-        return run
+            return cg(op, b_local, x0_l, m=m,
+                      record_history=record_history, axis_name=axis,
+                      resume_from=ck_l,
+                      return_checkpoint=return_checkpoint,
+                      iter_cap=cap_l, **kw)
+        return run_resumable
 
     ctx = dict(kind="csr-gather" if gather else "csr",
                check_every=kw["check_every"],
@@ -789,7 +947,8 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
         ctx["halo_padding_fraction"] = round(sched.padding_fraction(), 6)
         ctx["halo_wire_bytes_per_matvec"] = \
             sched.wire_bytes_per_matvec(itemsize)
-    args = (b_dev, data, cols, rows) + ((send,) if gather else ())
+    args = (b_dev, data, cols, rows) + ((send,) if gather else ()) \
+        + extras
     res = _cached_solver(key, build, ctx, args)(*args)
     return _unpad_result(res, parts, plan)
 
@@ -893,7 +1052,7 @@ class ManyRHSDispatcher:
                  preconditioner: Optional[str] = None,
                  method: str = "batched", check_every: int = 1,
                  compensated: bool = False, flight=None, plan=None,
-                 exchange=None):
+                 exchange=None, inject=None):
         from ..solver.many import MANY_METHODS
 
         if mesh is None:
@@ -930,6 +1089,22 @@ class ManyRHSDispatcher:
                     "method='batched' (block-CG's recurrence scalars "
                     "are k x k matrices)")
             flight = flight.without_heartbeat()
+        if inject is not None:
+            from ..robust.inject import FaultPlan
+
+            if not isinstance(inject, FaultPlan):
+                raise TypeError(f"inject must be a robust.FaultPlan, "
+                                f"got {type(inject).__name__}")
+            if method != "batched":
+                raise ValueError(
+                    "inject (fault injection) needs method='batched' "
+                    "(block-CG's Gram-collapse fallback would mask an "
+                    "armed fault as a rank event)")
+            if inject.shard >= int(mesh.devices.size):
+                raise ValueError(
+                    f"inject targets shard {inject.shard} but the "
+                    f"mesh has {int(mesh.devices.size)}")
+        self.inject = inject
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.n_shards = int(mesh.devices.size)
@@ -974,7 +1149,8 @@ class ManyRHSDispatcher:
             self.parts.n_local, self.n_shards, self.axis, mesh,
             preconditioner, self.check_every, self.compensated,
             flight, self.maxiter,
-            self.plan.fingerprint() if self.plan is not None else None)
+            self.plan.fingerprint() if self.plan is not None else None,
+        ) + ((inject,) if inject is not None else ())
 
     def solve(self, b, *, tol=1e-7, rtol=0.0):
         """One batched solve of ``A X = B`` on the prepared partition
@@ -1015,6 +1191,7 @@ class ManyRHSDispatcher:
         preconditioner = self.preconditioner
         maxiter, check_every = self.maxiter, self.check_every
         compensated = self.compensated
+        fault = self.inject
         key = self._key_base + (n_rhs,)
 
         def build():
@@ -1045,7 +1222,8 @@ class ManyRHSDispatcher:
                 return cg_many(op, b_local, tol=tol_s, rtol=rtol_s,
                                maxiter=maxiter, m=m, axis_name=axis,
                                check_every=check_every, method=method,
-                               compensated=compensated, flight=flight)
+                               compensated=compensated, flight=flight,
+                               fault=fault)
             return run
 
         ctx = dict(kind="csr-gather-many" if gather else "csr-many",
@@ -1085,6 +1263,7 @@ def solve_distributed_many(
     flight=None,
     plan=None,
     exchange=None,
+    inject=None,
 ):
     """Solve ``A X = B`` for a column stack ``B (n, k)`` over a mesh.
 
@@ -1114,7 +1293,7 @@ def solve_distributed_many(
         a, mesh=mesh, n_devices=n_devices, maxiter=maxiter,
         preconditioner=preconditioner, method=method,
         check_every=check_every, compensated=compensated,
-        flight=flight, plan=plan, exchange=exchange,
+        flight=flight, plan=plan, exchange=exchange, inject=inject,
     ).solve(b, tol=tol, rtol=rtol)
 
 

@@ -1,0 +1,53 @@
+"""Deterministic chaos harness + self-healing solves.
+
+The reference aborts the process on any error and keeps solver state
+only in device memory (``CUDACG.cu``, SURVEY SS5); a service on a
+multi-host mesh needs the opposite contract: *inject any fault this
+harness can spell, and the solve either recovers to the fault-free
+answer or fails typed and loud - never silently wrong*.
+
+Three pieces:
+
+* :mod:`.inject` - a static, hashable :class:`FaultPlan` that arms the
+  compiled solve to corrupt, at a chosen iteration and shard, the halo
+  payload, the local SpMV output or the reduction scalar (all in-trace
+  via ``lax.cond``), plus the host-level :class:`Preemption` hook that
+  kills a resumable segment between checkpoints.
+* detection - the solvers' while-loop health predicate
+  (``isfinite(rr) & isfinite(rho) & rho > 0``) already exits a poisoned
+  recurrence with ``CGStatus.BREAKDOWN`` within ``check_every``
+  iterations; the telemetry layer turns that into ``solve_fault``
+  events and the ``solve_breakdowns_total`` counter.
+* :mod:`.recover` - :class:`RecoveryPolicy` /
+  :func:`solve_with_recovery`: bounded restarts from the last finite
+  iterate (optionally snapshotting a checkpoint every N iterations so
+  the restart seed is a pre-fault iterate, not zero), wired over both
+  the single-device and the distributed CSR solve paths.
+* :mod:`.validate` - loud host-side pre-solve rejection of non-finite
+  inputs (the cheapest fault to catch is the one that never enters the
+  compiled loop).
+"""
+from .inject import (  # noqa: F401
+    FAULT_SITES,
+    FaultPlan,
+    PreemptedError,
+    Preemption,
+)
+from .recover import (  # noqa: F401
+    RecoveredResult,
+    RecoveryPolicy,
+    solve_with_recovery,
+)
+from .validate import check_finite_problem, check_finite_rhs  # noqa: F401
+
+__all__ = [
+    "FAULT_SITES",
+    "FaultPlan",
+    "PreemptedError",
+    "Preemption",
+    "RecoveredResult",
+    "RecoveryPolicy",
+    "check_finite_problem",
+    "check_finite_rhs",
+    "solve_with_recovery",
+]

@@ -1,0 +1,318 @@
+"""Deterministic in-trace fault injection.
+
+A :class:`FaultPlan` is a STATIC, hashable description of one fault:
+which solver recurrence site to corrupt (``halo`` payload, local
+``spmv`` output, or the ``reduction`` scalar), at which 0-based solver
+iteration, on which shard, with which non-finite value.  Because the
+plan is static it rides jit static arguments and the distributed
+solver-cache key exactly like a ``FlightConfig``; the fault itself
+fires *inside* the compiled ``lax.while_loop`` via ``lax.cond`` on the
+loop's iteration counter - no host round-trip, no interpret mode, the
+same executable a production solve would run plus one armed select.
+
+``fault=None`` (everywhere) is the contract: the solver code path -
+and hence the traced jaxpr - is untouched (proven bit-identical in
+``tests/test_robust.py``).
+
+Shard semantics:
+
+* ``halo``/``spmv`` faults are shard-local (``lax.axis_index`` gates
+  the corruption), modeling one chip's bad wire or bad HBM read; the
+  poison still reaches every shard through the next psum'd reduction,
+  so the loop predicate exits coherently on all shards.
+* ``reduction`` faults poison the already-psum'd scalar on every shard
+  at once - physically, one shard's NaN contribution to an allreduce
+  IS everyone's NaN.  A shard-targeted poison of a replicated scalar
+  would desynchronize the while-loop trip counts across the mesh
+  (collective mismatch), so ``shard`` is recorded for the event but
+  the corruption is global by construction.
+
+The host-level "preemption" mode lives here too: :class:`Preemption`
+kills a resumable solve between segment checkpoints
+(:func:`utils.checkpoint.solve_resumable_distributed` calls the hook
+after each save), so the restart/resume drill is deterministic.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "FAULT_SITES",
+    "FAULT_VALUES",
+    "FaultPlan",
+    "PreemptedError",
+    "Preemption",
+]
+
+#: recurrence sites a plan can corrupt
+FAULT_SITES = ("halo", "spmv", "reduction")
+
+#: spellable non-finite values (stored as strings so a FaultPlan stays
+#: hashable AND equal to its twin - a float NaN field would make two
+#: identical plans compare unequal and retrace every dispatch)
+FAULT_VALUES = ("nan", "inf", "-inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One deterministic fault, armed into a compiled solve.
+
+    Fields are all static scalars: the plan is hashable (jit static
+    argument, solver-cache key component) and its :meth:`fingerprint`
+    is stable across processes.
+
+    ``site``: ``"halo"`` corrupts the halo payload the target shard
+    *received* (every gathered/extended entry beyond its local block -
+    a corrupt message, deterministic regardless of which entries the
+    shard's rows reference); ``"spmv"`` corrupts entry ``index`` of
+    the target shard's local SpMV output; ``"reduction"`` corrupts
+    the psum'd recurrence scalar
+    ``p . Ap`` (see the module docstring for why that one is global).
+    ``iteration`` is the 0-based solver step whose matvec/reduction is
+    corrupted (a resumed solve counts from its checkpoint, so the
+    index is absolute).  ``lane`` targets one column of a many-RHS
+    ``reduction`` fault (ignored by the array sites, which poison a
+    row of the whole stack).  ``sticky=True`` models a permanent
+    fault: :meth:`after_restart` keeps it armed, so recovery exhausts
+    its restart budget and fails typed; the default models a
+    transient - the restarted solve runs clean.
+    """
+
+    site: str
+    iteration: int
+    shard: int = 0
+    index: int = 0
+    value: str = "nan"
+    lane: int = 0
+    sticky: bool = False
+
+    def __post_init__(self):
+        if self.site not in FAULT_SITES:
+            raise ValueError(f"unknown fault site {self.site!r}; "
+                             f"expected one of {FAULT_SITES}")
+        if self.iteration < 0:
+            raise ValueError(f"fault iteration must be >= 0, got "
+                             f"{self.iteration}")
+        if self.shard < 0:
+            raise ValueError(f"fault shard must be >= 0, got "
+                             f"{self.shard}")
+        if self.index < 0 or self.lane < 0:
+            raise ValueError("fault index/lane must be >= 0")
+        if self.value not in FAULT_VALUES:
+            raise ValueError(f"unknown fault value {self.value!r}; "
+                             f"expected one of {FAULT_VALUES}")
+
+    # -- identity ------------------------------------------------------
+
+    def fingerprint(self) -> str:
+        """Stable short digest (event payloads, cache keys)."""
+        spec = (f"fault:{self.site}:{self.iteration}:{self.shard}:"
+                f"{self.index}:{self.value}:{self.lane}:{self.sticky}")
+        return hashlib.sha1(spec.encode()).hexdigest()[:12]
+
+    def describe(self) -> str:
+        return (f"{self.value} into {self.site} at iteration "
+                f"{self.iteration} on shard {self.shard}"
+                f"{' (sticky)' if self.sticky else ''}")
+
+    def to_json(self) -> dict:
+        return {
+            "site": self.site, "iteration": self.iteration,
+            "shard": self.shard, "index": self.index,
+            "value": self.value, "lane": self.lane,
+            "sticky": self.sticky,
+            "fingerprint": self.fingerprint(),
+        }
+
+    @classmethod
+    def parse(cls, spec: str, **overrides) -> "FaultPlan":
+        """Parse the CLI spelling ``SITE:ITER[:SHARD]`` (e.g.
+        ``halo:10`` or ``spmv:25:2``)."""
+        parts = spec.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"fault spec {spec!r} must be SITE:ITER[:SHARD] "
+                f"(e.g. halo:10, spmv:25:2); sites: "
+                f"{', '.join(FAULT_SITES)}")
+        site = parts[0]
+        try:
+            iteration = int(parts[1])
+            shard = int(parts[2]) if len(parts) == 3 else 0
+        except ValueError:
+            raise ValueError(
+                f"fault spec {spec!r}: iteration/shard must be "
+                f"integers")
+        return cls(site=site, iteration=iteration, shard=shard,
+                   **overrides)
+
+    def after_restart(self):
+        """The plan a recovery restart runs under: a transient fault is
+        gone (``None`` - the clean re-solve), a sticky one persists."""
+        return self if self.sticky else None
+
+    # -- in-trace machinery -------------------------------------------
+
+    def fault_value(self, dtype):
+        return jnp.asarray(float(self.value), dtype)
+
+    def fires(self, k, axis_name=None):
+        """Traced bool: this step, on the target shard.  ``k`` is the
+        solver's 0-based step counter (the loop-carry ``s.k``)."""
+        hit = k == jnp.asarray(self.iteration, k.dtype)
+        if axis_name is not None:
+            hit = hit & (lax.axis_index(axis_name) == self.shard)
+        return hit
+
+    def _poison_row(self, x, idx: int, fire):
+        """``x`` with row ``idx`` (a scalar entry for a vector, the
+        whole row of an ``(n, k)`` stack) set to the fault value when
+        ``fire`` - a ``lax.cond`` so the write exists only on the
+        firing trip."""
+        bad = self.fault_value(x.dtype)
+
+        def poisoned(v):
+            if v.ndim == 1:
+                return v.at[idx].set(bad)
+            return v.at[idx, :].set(bad)
+
+        return lax.cond(fire, poisoned, lambda v: v, x)
+
+    def apply_matvec(self, a, p, k, axis_name=None):
+        """``a @ p`` (or ``a.matmat(p)`` for a stack) with this plan's
+        halo/spmv fault armed at step ``k``.  ``reduction`` plans
+        leave the matvec untouched (see :meth:`poison_reduction`)."""
+        stack = p.ndim == 2
+        apply = (lambda v: a.matmat(v)) if stack else (lambda v: a @ v)
+        if self.site == "reduction":
+            return apply(p)
+        if self.site == "spmv":
+            y = apply(p)
+            idx = self.index % y.shape[0]
+            return self._poison_row(y, idx, self.fires(k, axis_name))
+        # site == "halo": corrupt the payload the exchange delivered -
+        # the WHOLE received message, not one slot (a single poisoned
+        # entry the target shard's rows happen not to reference would
+        # be a fault that silently does nothing; a corrupt message is
+        # the deterministic model) - then run the unchanged local
+        # multiply over it: one code path with the real solve's wire,
+        # poisoned post-receive.
+        fire = self.fires(k, axis_name)
+        bad = self.fault_value(p.dtype)
+        if hasattr(a, "extend_x"):     # DistCSRGather: packed rounds
+            x_ext = a.extend_x(p)
+            n_halo = x_ext.shape[0] - a.n_local
+            if n_halo <= 0:
+                raise ValueError(
+                    "halo fault: the gather schedule ships no halo "
+                    "entries to corrupt (fully decoupled shards)")
+            n_local = a.n_local
+            x_ext = lax.cond(
+                fire,
+                lambda v: (v.at[n_local:].set(bad) if v.ndim == 1
+                           else v.at[n_local:, :].set(bad)),
+                lambda v: v, x_ext)
+            if stack:
+                from ..ops import spmv as _spmv
+
+                return _spmv.csr_matmat(a.data, a.cols, a.local_rows,
+                                        x_ext, a.n_local)
+            return a.local_matvec(x_ext)
+        if hasattr(a, "gather_x"):     # DistCSR: allgathered full x
+            x_full = a.gather_x(p)
+            n = x_full.shape[0]
+            if a.n_shards > 1:
+                # everything OUTSIDE the target shard's own block is
+                # payload some neighbor shipped
+                rows = jnp.arange(n)
+                halo_mask = (rows < self.shard * a.n_local) \
+                    | (rows >= (self.shard + 1) * a.n_local)
+            else:
+                # mesh 1: the whole gather IS the exchange output
+                halo_mask = jnp.ones((n,), bool)
+            if stack:
+                halo_mask = halo_mask[:, None]
+            x_full = lax.cond(
+                fire,
+                lambda v: jnp.where(halo_mask, bad, v),
+                lambda v: v, x_full)
+            if stack:
+                from ..ops import spmv as _spmv
+
+                return _spmv.csr_matmat(a.data, a.cols, a.local_rows,
+                                        x_full, a.n_local)
+            return a.local_matvec(x_full)
+        raise ValueError(
+            f"halo fault needs a distributed gather/allgather operator "
+            f"(DistCSR/DistCSRGather); {type(a).__name__} has no halo "
+            f"exchange to corrupt - use site='spmv' or 'reduction'")
+
+    def poison_reduction(self, v, k):
+        """The ``reduction`` site: corrupt the psum'd scalar (or lane
+        ``self.lane`` of a ``(k,)`` per-lane vector) at step ``k``.
+        Applied identically on every shard - see the module docstring
+        for why the shard gate must NOT apply here."""
+        if self.site != "reduction":
+            return v
+        fire = self.fires(k)
+        bad = self.fault_value(v.dtype)
+        if v.ndim == 0:
+            return lax.cond(fire, lambda s: bad, lambda s: s, v)
+        lane = self.lane % v.shape[0]
+        return lax.cond(fire, lambda s: s.at[lane].set(bad),
+                        lambda s: s, v)
+
+    def validate_for_operator(self, a, n_shards: int = 1) -> None:
+        """Host-side pre-trace checks with readable errors (the traced
+        failure modes above would otherwise surface mid-trace)."""
+        if self.shard >= max(n_shards, 1):
+            raise ValueError(
+                f"fault targets shard {self.shard} but the mesh has "
+                f"{n_shards} shard(s)")
+        if self.site == "halo" and not (hasattr(a, "extend_x")
+                                        or hasattr(a, "gather_x")):
+            raise ValueError(
+                f"halo fault needs a distributed gather/allgather "
+                f"operator; {type(a).__name__} has no halo exchange "
+                f"(use site='spmv' or 'reduction', or solve "
+                f"distributed)")
+
+
+jax.tree_util.register_static(FaultPlan)
+
+
+class PreemptedError(RuntimeError):
+    """A resumable solve was killed between segments (the chaos
+    harness's host-level preemption).  State is already on disk - a
+    later call with the same path resumes the exact trajectory."""
+
+
+@dataclasses.dataclass
+class Preemption:
+    """Host-level preemption hook for segmented resumable solves.
+
+    ``solve_resumable_distributed(..., preempt=Preemption(n))`` raises
+    :class:`PreemptedError` after ``n`` completed (saved) segments -
+    the deterministic stand-in for a worker being killed mid-run.  The
+    checkpoint of every completed segment is on disk, so the drill is:
+    catch the error, call again, and the resumed trajectory bit-matches
+    the uninterrupted run (asserted in ``tests/test_robust.py``).
+    """
+
+    after_segments: int = 1
+
+    def __post_init__(self):
+        if self.after_segments < 1:
+            raise ValueError(
+                f"after_segments must be >= 1, got {self.after_segments}")
+
+    def __call__(self, completed_segments: int) -> None:
+        if completed_segments >= self.after_segments:
+            raise PreemptedError(
+                f"preempted after {completed_segments} segment(s) "
+                f"(chaos harness); the last checkpoint is saved - "
+                f"call again to resume")

@@ -1,0 +1,61 @@
+"""Loud pre-solve validation of problem data.
+
+A NaN/Inf in ``b`` or the matrix values would spin the compiled
+recurrence to its first health check and surface as a BREAKDOWN - a
+correct but wasteful outcome for a fault that was visible before the
+solve ever dispatched.  These checks are HOST-side ``np.isfinite``
+reductions over the host view of the data (never in-trace - the
+compiled solve is untouched), run once per entry-point call:
+``cli.py`` run paths, ``serve.SolverService.submit``, and
+``parallel.solve_distributed`` (opt-out via ``validate=False`` /
+``--no-validate`` for callers that stage intentionally-poisoned
+systems, e.g. the chaos tests themselves).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["check_finite_problem", "check_finite_rhs"]
+
+
+def _count_nonfinite(arr) -> int:
+    arr = np.asarray(arr)
+    if not np.issubdtype(arr.dtype, np.floating):
+        return 0
+    return int(arr.size - np.count_nonzero(np.isfinite(arr)))
+
+
+def check_finite_rhs(b, *, what: str = "b") -> None:
+    """Raise ``ValueError`` when the right-hand side carries any
+    non-finite entry (one host reduction over the host view)."""
+    bad = _count_nonfinite(b)
+    if bad:
+        raise ValueError(
+            f"{what} carries {bad} non-finite entr"
+            f"{'y' if bad == 1 else 'ies'} (NaN/Inf): the solve would "
+            f"spin a poisoned recurrence to its first health check and "
+            f"report BREAKDOWN. Fix the input, or pass validate=False "
+            f"(--no-validate) to stage the fault deliberately.")
+
+
+def check_finite_problem(a, b=None) -> None:
+    """Validate the operator's coefficient arrays (and optionally the
+    rhs).  Covers the assembled formats' value arrays and the stencil
+    scale; matrix-free operators without coefficient arrays pass
+    (there is nothing host-visible to check)."""
+    if b is not None:
+        check_finite_rhs(b)
+    for name in ("data", "vals", "scale", "diag"):
+        v = getattr(a, name, None)
+        if v is None:
+            continue
+        leaves = v if isinstance(v, (tuple, list)) else (v,)
+        for leaf in leaves:
+            bad = _count_nonfinite(leaf)
+            if bad:
+                raise ValueError(
+                    f"operator {type(a).__name__}.{name} carries {bad} "
+                    f"non-finite entr{'y' if bad == 1 else 'ies'} "
+                    f"(NaN/Inf): refusing to solve a poisoned system. "
+                    f"Fix the matrix, or pass validate=False "
+                    f"(--no-validate) to stage the fault deliberately.")

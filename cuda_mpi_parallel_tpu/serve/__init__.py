@@ -22,6 +22,7 @@ from .queue import (
 from .service import (
     OperatorHandle,
     RequestResult,
+    RetryPolicy,
     ServiceClosed,
     ServiceConfig,
     SolverService,
@@ -40,6 +41,7 @@ __all__ = [
     "OperatorHandle",
     "QueueFull",
     "RequestResult",
+    "RetryPolicy",
     "ServiceClosed",
     "ServiceConfig",
     "SolverService",

@@ -100,9 +100,21 @@ class QueuedRequest:
     deadline_t: Optional[float]    # absolute service-clock, or None
     future: object                 # concurrent.futures.Future
     handle: object = None          # serve.service.OperatorHandle
+    #: retry bookkeeping (serve retry policy): dispatch attempts so
+    #: far, and the backoff gate - a request with ``ready_t`` in the
+    #: future is parked (not cut into a batch, not driving the
+    #: max_wait clock) until the clock reaches it
+    attempts: int = 0
+    ready_t: Optional[float] = None
+    #: tolerance-class degradation marked this request (queue-pressure
+    #: load shedding); surfaced on its RequestResult
+    degraded: bool = False
 
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now >= self.deadline_t
+
+    def ready(self, now: float) -> bool:
+        return self.ready_t is None or self.ready_t <= now
 
 
 @dataclasses.dataclass
@@ -184,22 +196,29 @@ class MicroBatchQueue:
             for req in q:
                 (timeouts if req.expired(now) else live).append(req)
             self._depth -= len(q) - len(live)
-            q = self._queues[key] = live
-            while len(q) >= self.max_batch:
-                cut = [q.popleft() for _ in range(self.max_batch)]
+            # backoff-parked retries are not dispatchable yet and do
+            # not drive the max_wait clock; a drain flushes them too
+            # (their backoff is advisory, close() must terminate)
+            ready = deque(r for r in live
+                          if drain or r.ready(now))
+            delayed = [r for r in live
+                       if not (drain or r.ready(now))]
+            while len(ready) >= self.max_batch:
+                cut = [ready.popleft() for _ in range(self.max_batch)]
                 self._depth -= len(cut)
                 batches.append(Batch(key=key, requests=cut,
                                      bucket=self.max_batch,
                                      reason="full"))
-            if q and (drain
-                      or now - q[0].enqueue_t >= self.max_wait_s):
-                cut = list(q)
-                q.clear()
+            if ready and (drain
+                          or now - ready[0].enqueue_t >= self.max_wait_s):
+                cut = list(ready)
+                ready.clear()
                 self._depth -= len(cut)
                 batches.append(Batch(
                     key=key, requests=cut,
                     bucket=bucket_for(len(cut), self.max_batch),
                     reason="drain" if drain else "max_wait"))
+            q = self._queues[key] = deque(list(ready) + delayed)
             if not q:
                 del self._queues[key]
         return batches, timeouts
@@ -217,11 +236,18 @@ class MicroBatchQueue:
         for q in self._queues.values():
             if not q:
                 continue
-            if len(q) >= self.max_batch:
+            ready = [r for r in q if r.ready(now)]
+            if len(ready) >= self.max_batch:
                 return now
-            candidates = [q[0].enqueue_t + self.max_wait_s]
-            candidates += [r.deadline_t for r in q
-                           if r.deadline_t is not None]
+            candidates = [r.deadline_t for r in q
+                          if r.deadline_t is not None]
+            if ready:
+                candidates.append(ready[0].enqueue_t + self.max_wait_s)
+            # a backoff-parked retry becomes actionable at its ready_t
+            candidates += [r.ready_t for r in q
+                           if r.ready_t is not None and not r.ready(now)]
+            if not candidates:
+                continue
             t = min(candidates)
             wake = t if wake is None else min(wake, t)
         return wake

@@ -66,6 +66,7 @@ __all__ = [
     "OperatorHandle",
     "QueueFull",
     "RequestResult",
+    "RetryPolicy",
     "ServiceClosed",
     "ServiceConfig",
     "SolverService",
@@ -79,6 +80,53 @@ LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
 
 class ServiceClosed(RuntimeError):
     """submit() after close(): the service no longer accepts work."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded re-enqueue of failed requests (robustness PR).
+
+    A lane that ends ``ERROR`` (the engine's fault) or ``BREAKDOWN``
+    (the problem's fault - possibly a transient data corruption) is
+    RE-ENQUEUED, not re-solved inline: it goes back through the
+    microbatch queue with ``attempts + 1`` and a ``ready_t`` backoff
+    gate of ``backoff_s * 2**(attempts - 1)`` seconds, so a retry
+    storm cannot monopolize the dispatcher and retried lanes coalesce
+    into fresh batches like any other traffic.  After ``max_retries``
+    the original typed status stands - loud, never silent.
+    """
+
+    max_retries: int = 2
+    backoff_s: float = 0.0
+    statuses: Tuple[str, ...] = ("ERROR", "BREAKDOWN")
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got "
+                             f"{self.max_retries}")
+        if self.backoff_s < 0:
+            raise ValueError(f"backoff_s must be >= 0, got "
+                             f"{self.backoff_s}")
+
+    def backoff_for(self, attempts: int) -> float:
+        """Exponential backoff before dispatch attempt ``attempts + 1``
+        (``attempts`` >= 1 completed)."""
+        return self.backoff_s * (2.0 ** max(attempts - 1, 0))
+
+
+@dataclasses.dataclass
+class _Breaker:
+    """Per-handle circuit-breaker state (see ServiceConfig)."""
+
+    state: str = "closed"           # closed | open | half_open
+    consecutive_failures: int = 0
+    opened_t: float = 0.0
+    probing: bool = False           # half_open: one probe in flight
+    probe_id: Optional[str] = None  # the probe request's id (so a
+    #                                 probe that never dispatches -
+    #                                 deadline expiry, push failure -
+    #                                 releases the slot instead of
+    #                                 wedging the handle)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,6 +146,26 @@ class ServiceConfig:
     check_every: int = 1
     warm: bool = True
     clock: Optional[Callable[[], float]] = None
+    #: bounded retry of ERROR/BREAKDOWN lanes (None = off): failed
+    #: requests re-enqueue with exponential backoff, never re-solve
+    #: inline
+    retry: Optional[RetryPolicy] = None
+    #: per-handle circuit breaker: this many CONSECUTIVE failed
+    #: dispatches (every live lane ERROR/BREAKDOWN) opens the breaker
+    #: - submits on the handle resolve immediately to typed REFUSED
+    #: results until ``breaker_cooldown_s`` elapses, then ONE half-open
+    #: probe is admitted (success closes, failure re-opens).  0 = off.
+    breaker_threshold: int = 0
+    breaker_cooldown_s: float = 1.0
+    #: tolerance-class degradation under queue pressure: at total
+    #: queue depth >= this, an incoming request's tolerance is relaxed
+    #: one decade (tol * 10) and the result is marked ``degraded`` -
+    #: the load-shedding step BEFORE backpressure rejects outright.
+    #: 0 = off.
+    degrade_depth: int = 0
+    #: host-side finiteness check of every submitted b (robust
+    #: pre-solve validation; False opts out for chaos staging)
+    validate: bool = True
     #: per-batch dispatch log retained for reports (ring, drop-oldest)
     keep_batch_log: int = 1024
     #: exact latency samples retained for stats() percentiles (ring,
@@ -113,13 +181,25 @@ class RequestResult:
 
     ``status`` is a ``CGStatus`` name (per-lane, so one failing lane
     never contaminates its batchmates), ``"TIMEOUT"`` for a deadline
-    expiry (the request was never dispatched), or ``"ERROR"`` when the
+    expiry (the request was never dispatched), ``"REFUSED"`` when the
+    handle's circuit breaker was open, or ``"ERROR"`` when the
     batch's engine call itself raised (still a typed RESULT - a future
     never raises, so ``fut.result()`` loops survive any failure mode;
-    the exception text rides the ``request_done`` event).  ``solve_s``
-    is the batch's wall time - shared by every lane that rode it;
-    ``latency_s = wait_s + solve_s`` is what the service's latency
-    histogram records.
+    the exception text rides the ``request_done`` event).
+
+    ``"BREAKDOWN"`` is deliberately distinct from ``"ERROR"``: a
+    breakdown is the *problem's* fault (non-finite recurrence - bad
+    data, a poisoned halo payload, a non-SPD preconditioner; see
+    ``CGStatus.BREAKDOWN.describe()``), an ERROR is the *engine's*
+    (the dispatch itself raised).  :attr:`failure_kind` names the
+    class; the retry policy treats both as retryable, the circuit
+    breaker counts both.
+
+    ``solve_s`` is the batch's wall time - shared by every lane that
+    rode it; ``latency_s = wait_s + solve_s`` is what the service's
+    latency histogram records.  ``attempts`` counts completed dispatch
+    attempts (> 1 = the retry policy re-enqueued it); ``degraded``
+    marks a tolerance relaxed under queue pressure.
     """
 
     request_id: str
@@ -135,10 +215,28 @@ class RequestResult:
     bucket: int
     occupancy: float
     solve_id: Optional[str]
+    attempts: int = 1
+    degraded: bool = False
 
     @property
     def ok(self) -> bool:
         return self.converged and not self.timed_out
+
+    @property
+    def failure_kind(self) -> Optional[str]:
+        """``"problem"`` (BREAKDOWN - the system's fault), ``"engine"``
+        (ERROR - the dispatch raised), ``"deadline"`` (TIMEOUT),
+        ``"breaker"`` (REFUSED), ``"budget"``/``"convergence"`` for
+        MAXITER/STAGNATED/DIVERGED, or ``None`` when converged."""
+        return {
+            "BREAKDOWN": "problem",
+            "ERROR": "engine",
+            "TIMEOUT": "deadline",
+            "REFUSED": "breaker",
+            "MAXITER": "budget",
+            "STAGNATED": "convergence",
+            "DIVERGED": "convergence",
+        }.get(self.status)
 
 
 @dataclasses.dataclass
@@ -176,6 +274,10 @@ class OperatorHandle:
     #: register(phase_profile=R) asked for one - rides the handle so
     #: reports/CLI can render it without re-measuring
     phase_profile: Optional[object] = None
+    #: armed chaos fault (robust.FaultPlan) baked into every dispatch
+    #: of this handle - the test harness's "poisoned handle" (drives
+    #: the retry/breaker drills deterministically)
+    inject: Optional[object] = None
 
     @property
     def distributed(self) -> bool:
@@ -232,6 +334,10 @@ class SolverService:
         self._padded_lanes = 0
         self._occupancy_sum = 0.0
         self._bucket_counts: Dict[int, int] = {}
+        self._retries = 0
+        self._refused = 0
+        self._degraded = 0
+        self._breakers: Dict[str, _Breaker] = {}
         self._latencies: deque = deque(
             maxlen=self.config.keep_latency_samples)
         # the wait-vs-solve split of the same completions: queueing
@@ -261,7 +367,8 @@ class SolverService:
                  maxiter: Optional[int] = None,
                  check_every: Optional[int] = None,
                  warm: Optional[bool] = None,
-                 phase_profile: int = 0) -> OperatorHandle:
+                 phase_profile: int = 0,
+                 inject=None) -> OperatorHandle:
         """Register an operator: resolve the plan, build the
         preconditioner, and (by default) warm the compiled trace of
         EVERY lane bucket so later traffic only ever hits caches.
@@ -273,6 +380,12 @@ class SolverService:
         refuses here, at registration, not per request).  Re-registering
         the same matrix under the same config returns the same handle
         without re-warming.
+
+        ``inject`` arms a ``robust.FaultPlan`` into every dispatch of
+        the handle (the chaos harness's "poisoned handle" - what the
+        retry/breaker drills register).  The fault fires in-trace at
+        its configured iteration; ``None`` leaves the compiled solve
+        untouched.
 
         ``phase_profile=R > 0`` (mesh handles only) additionally runs
         the measured phase profiler (``telemetry.phasetrace``, ``R``
@@ -334,7 +447,9 @@ class SolverService:
             plan_spec, exchange, precond, method,
             maxiter or self.config.maxiter,
             check_every or self.config.check_every,
-            self.config.max_batch)).encode()).hexdigest()[:8]
+            self.config.max_batch,
+            inject.fingerprint() if inject is not None else None,
+        )).encode()).hexdigest()[:8]
         key = f"{fingerprint}:{cfg}"
         want_warm = self.config.warm if warm is None else warm
         with self._lock:
@@ -368,7 +483,7 @@ class SolverService:
                 maxiter=int(maxiter or self.config.maxiter),
                 preconditioner=precond, method=method,
                 check_every=int(check_every or self.config.check_every),
-                plan=plan, exchange=exchange)
+                plan=plan, exchange=exchange, inject=inject)
             plan = dispatcher.plan
         precond_obj = None
         if precond == "jacobi" and mesh is None:
@@ -386,7 +501,7 @@ class SolverService:
             maxiter=int(maxiter or self.config.maxiter),
             check_every=int(check_every or self.config.check_every),
             buckets=bucket_sizes(self.config.max_batch),
-            dispatcher=dispatcher)
+            dispatcher=dispatcher, inject=inject)
         with self._lock:
             self._handles[key] = handle
             n_handles = len(self._handles)
@@ -456,23 +571,54 @@ class SolverService:
                 f"b must be 1-D of length {handle.n}, got shape "
                 f"{b.shape} (submit one RHS per request - batching is "
                 f"the service's job)")
+        if self.config.validate:
+            from ..robust.validate import check_finite_rhs
+
+            check_finite_rhs(b, what="submitted b")
         b = np.ascontiguousarray(b, dtype=np.dtype(handle.dtype_name))
         tol = float(tol)
         now = self._clock()
+        # closed beats everything: a REFUSED future from an open
+        # breaker must not mask the documented ServiceClosed contract
+        # (and must not burn the half-open probe slot on a submission
+        # that can never dispatch)
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed(
+                    "solver service is closed (no new submissions)")
+        rid = f"q{next(self._ids):06d}"
+        if self._breaker_refuses(handle.key, now, rid):
+            return self._refuse(rid, handle, now)
+        degraded = False
+        if self.config.degrade_depth > 0 \
+                and self.queue_depth() >= self.config.degrade_depth:
+            # load-shedding step BEFORE backpressure: relax the
+            # tolerance one decade so the queue drains faster; the
+            # result says so (degraded=True), nothing is silent
+            tol, degraded = tol * 10.0, True
         req = QueuedRequest(
-            request_id=f"q{next(self._ids):06d}",
+            request_id=rid,
             handle_key=handle.key, b=b, dtype=handle.dtype_name,
             tol=tol, enqueue_t=now,
             deadline_t=(now + float(deadline_s)
                         if deadline_s is not None else None),
-            future=Future(), handle=handle)
-        with self._cond:
-            if self._closed:
-                raise ServiceClosed(
-                    "solver service is closed (no new submissions)")
-            depth = self._queue.push(req)      # raises QueueFull
-            self._submitted += 1
-            self._cond.notify_all()
+            future=Future(), handle=handle, degraded=degraded)
+        try:
+            with self._cond:
+                if self._closed:
+                    raise ServiceClosed(
+                        "solver service is closed (no new "
+                        "submissions)")
+                depth = self._queue.push(req)      # raises QueueFull
+                self._submitted += 1
+                if degraded:
+                    self._degraded += 1
+                self._cond.notify_all()
+        except (QueueFull, ServiceClosed):
+            # a probe that never made it into the queue releases its
+            # slot - otherwise the handle would refuse forever
+            self._breaker_release_probe(handle.key, rid)
+            raise
         from ..telemetry import events
         from ..telemetry.registry import REGISTRY
 
@@ -482,10 +628,166 @@ class SolverService:
         REGISTRY.gauge("serve_queue_depth",
                        "requests pending in the solver service "
                        "queues").set(depth)
+        if degraded:
+            REGISTRY.counter(
+                "serve_degraded_total",
+                "requests whose tolerance class was relaxed under "
+                "queue pressure (load shedding)",
+                labelnames=("handle",)).inc(handle=handle.key)
         events.emit("request_enqueued", request_id=req.request_id,
                     handle=handle.key, queue_depth=depth,
-                    tol_class=tol_class(tol))
+                    tol_class=tol_class(tol), degraded=degraded)
         return req.future
+
+    # -- circuit breaker -------------------------------------------------
+
+    def _breaker_refuses(self, key: str, now: float,
+                         rid: str) -> bool:
+        """True when the handle's breaker refuses this submit.  An
+        open breaker past its cooldown transitions to half_open and
+        admits exactly ONE probe (recorded by request id so a probe
+        that never dispatches can release the slot); further submits
+        while the probe is in flight are refused."""
+        if self.config.breaker_threshold <= 0:
+            return False
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is None or br.state == "closed":
+                return False
+            if br.state == "open":
+                if now < br.opened_t + self.config.breaker_cooldown_s:
+                    return True
+                br.state = "half_open"
+                br.probing = False
+                br.probe_id = None
+                self._note_breaker(key, br)
+            # half_open: one probe at a time
+            if br.probing:
+                return True
+            br.probing = True
+            br.probe_id = rid
+            return False
+
+    def _breaker_release_probe(self, key: str, rid: str) -> None:
+        """The half-open probe request left WITHOUT a dispatch
+        (deadline expiry in queue, or its push failed): free the
+        probe slot so the next submit can probe - the breaker stays
+        half_open, no outcome was observed."""
+        if self.config.breaker_threshold <= 0:
+            return
+        with self._lock:
+            br = self._breakers.get(key)
+            if br is not None and br.probing and br.probe_id == rid:
+                br.probing = False
+                br.probe_id = None
+
+    def _breaker_note_outcome(self, key: str, ok: bool,
+                              now: float) -> None:
+        """Record a dispatch outcome for the handle's breaker: a
+        failed batch (every live lane ERROR/BREAKDOWN) counts toward
+        the consecutive-failure threshold; any success closes."""
+        if self.config.breaker_threshold <= 0:
+            return
+        with self._lock:
+            br = self._breakers.setdefault(key, _Breaker())
+            if ok:
+                changed = br.state != "closed" \
+                    or br.consecutive_failures
+                br.state = "closed"
+                br.consecutive_failures = 0
+                br.probing = False
+                br.probe_id = None
+                if changed:
+                    self._note_breaker(key, br)
+                return
+            br.consecutive_failures += 1
+            if br.state == "half_open" \
+                    or br.consecutive_failures \
+                    >= self.config.breaker_threshold:
+                br.state = "open"
+                br.opened_t = now
+                br.probing = False
+                br.probe_id = None
+                self._note_breaker(key, br)
+
+    def _note_breaker(self, key: str, br: _Breaker) -> None:
+        """Emit the transition (caller holds the lock; host-side
+        only)."""
+        from ..telemetry import events
+        from ..telemetry.registry import REGISTRY
+
+        REGISTRY.gauge(
+            "serve_breaker_state",
+            "per-handle circuit-breaker state (0 closed, 1 half-open, "
+            "2 open)", labelnames=("handle",)).set(
+                {"closed": 0, "half_open": 1, "open": 2}[br.state],
+                handle=key)
+        events.emit("breaker_transition", handle=key, state=br.state,
+                    consecutive_failures=br.consecutive_failures)
+
+    def breaker_state(self, handle: OperatorHandle) -> str:
+        with self._lock:
+            br = self._breakers.get(handle.key)
+            return br.state if br is not None else "closed"
+
+    def _refuse(self, rid: str, handle: OperatorHandle,
+                now: float) -> Future:
+        """Typed REFUSED result for an open breaker - resolved
+        immediately, never queued."""
+        from ..telemetry import events
+        from ..telemetry.registry import REGISTRY
+
+        with self._lock:
+            self._refused += 1
+        REGISTRY.counter(
+            "serve_refused_total",
+            "requests refused by an open per-handle circuit breaker",
+            labelnames=("handle",)).inc(handle=handle.key)
+        events.emit("request_done", request_id=rid, status="REFUSED",
+                    wait_s=0.0, handle=handle.key)
+        fut: Future = Future()
+        fut.set_result(RequestResult(
+            request_id=rid, status="REFUSED", converged=False,
+            timed_out=False, x=None, iterations=0,
+            residual_norm=float("nan"), wait_s=0.0, solve_s=0.0,
+            latency_s=0.0, bucket=0, occupancy=0.0, solve_id=None,
+            attempts=0))
+        return fut
+
+    def _requeue(self, req: QueuedRequest, status: str,
+                 now: float) -> bool:
+        """Re-enqueue a failed request under the retry policy; returns
+        False (caller resolves the typed failure instead) when the
+        queue is full."""
+        from ..telemetry import events
+        from ..telemetry.registry import REGISTRY
+
+        retry = self.config.retry
+        prev = (req.attempts, req.ready_t, req.enqueue_t)
+        req.attempts += 1
+        req.ready_t = now + retry.backoff_for(req.attempts)
+        req.enqueue_t = now
+        try:
+            with self._cond:
+                self._queue.push(req)
+                self._retries += 1
+                self._cond.notify_all()
+        except QueueFull:
+            # the retry is abandoned: undo the bookkeeping so the
+            # resolved result reports the dispatches that actually
+            # completed, not a phantom one
+            req.attempts, req.ready_t, req.enqueue_t = prev
+            return False
+        REGISTRY.counter(
+            "serve_retries_total",
+            "failed requests re-enqueued by the retry policy",
+            labelnames=("handle", "status")).inc(
+                handle=req.handle_key, status=status)
+        events.emit("request_retry", request_id=req.request_id,
+                    attempt=req.attempts, status=status,
+                    handle=req.handle_key,
+                    ready_in_s=round(float(req.ready_t - now), 6))
+        return True
 
     # -- dispatch --------------------------------------------------------
 
@@ -521,13 +823,17 @@ class SolverService:
         from ..telemetry import events
         from ..telemetry.registry import REGISTRY
 
+        # an expired half-open PROBE never dispatched: release the
+        # slot so the handle is not wedged refusing forever
+        self._breaker_release_probe(req.handle_key, req.request_id)
         wait = now - req.enqueue_t
         result = RequestResult(
             request_id=req.request_id, status="TIMEOUT",
             converged=False, timed_out=True, x=None, iterations=0,
             residual_norm=float("nan"), wait_s=float(wait), solve_s=0.0,
             latency_s=float(wait), bucket=0, occupancy=0.0,
-            solve_id=None)
+            solve_id=None, attempts=req.attempts,
+            degraded=req.degraded)
         with self._lock:
             self._timeouts += 1
             # a deadline expiry is pure queue wait - it belongs in the
@@ -557,7 +863,8 @@ class SolverService:
         return solve_many(handle.a, b_stack, tol=tols,
                           maxiter=handle.maxiter, m=handle.precond_obj,
                           method=handle.method,
-                          check_every=handle.check_every)
+                          check_every=handle.check_every,
+                          fault=handle.inject)
 
     def _run_batch(self, batch: Batch) -> None:
         from ..solver.many import stack_columns
@@ -622,8 +929,16 @@ class SolverService:
                                  labelnames=("handle", "reason")).inc(
                                      handle=handle.key,
                                      reason=batch.reason)
+                retry_p = self.config.retry
                 for r in reqs:
                     wait = float(now - r.enqueue_t)
+                    if retry_p is not None \
+                            and "ERROR" in retry_p.statuses \
+                            and r.attempts < retry_p.max_retries \
+                            and not r.future.done() \
+                            and self._requeue(r, "ERROR",
+                                              self._clock()):
+                        continue
                     events.emit("request_done",
                                 request_id=r.request_id, status="ERROR",
                                 wait_s=wait, handle=handle.key,
@@ -642,14 +957,39 @@ class SolverService:
                             solve_s=float(solve_s),
                             latency_s=wait + float(solve_s), bucket=k,
                             occupancy=batch.occupancy,
-                            solve_id=solve_id))
+                            solve_id=solve_id,
+                            attempts=r.attempts + 1,
+                            degraded=r.degraded))
+                self._breaker_note_outcome(handle.key, False,
+                                           self._clock())
                 return
             solve_s = time.perf_counter() - t0
             results = []
+            retry_p = self.config.retry
+            lane_statuses = []
             for j, r in enumerate(reqs):
                 status = CGStatus(int(stat[j])).name
+                lane_statuses.append(status)
                 wait = float(now - r.enqueue_t)
                 latency = wait + solve_s
+                if status == "BREAKDOWN":
+                    # the problem's fault, typed and loud: the shared
+                    # solve_fault event + counter, from the lane that
+                    # actually broke
+                    from ..telemetry.session import note_breakdown
+
+                    site = (handle.inject.site
+                            if handle.inject is not None else "unknown")
+                    note_breakdown(site, int(iters[j]),
+                                   request_id=r.request_id,
+                                   handle=handle.key)
+                if retry_p is not None and status in retry_p.statuses \
+                        and r.attempts < retry_p.max_retries \
+                        and not r.future.done() \
+                        and self._requeue(r, status, self._clock()):
+                    # re-enqueued, not re-solved inline: the lane goes
+                    # back through the microbatch queue with backoff
+                    continue
                 result = RequestResult(
                     request_id=r.request_id, status=status,
                     converged=bool(conv[j]), timed_out=False,
@@ -660,8 +1000,9 @@ class SolverService:
                     residual_norm=float(rnorm[j]), wait_s=wait,
                     solve_s=float(solve_s), latency_s=float(latency),
                     bucket=k, occupancy=batch.occupancy,
-                    solve_id=solve_id)
-                results.append(result)
+                    solve_id=solve_id, attempts=r.attempts + 1,
+                    degraded=r.degraded)
+                results.append((r, result))
                 events.emit("request_done", request_id=r.request_id,
                             status=status, wait_s=wait,
                             solve_s=float(solve_s),
@@ -708,7 +1049,7 @@ class SolverService:
             self._padded_lanes += k - m
             self._occupancy_sum += batch.occupancy
             self._bucket_counts[k] = self._bucket_counts.get(k, 0) + 1
-            for result in results:
+            for _, result in results:
                 self._completed += 1
                 if result.converged:
                     self._converged += 1
@@ -720,7 +1061,14 @@ class SolverService:
                 "reason": batch.reason, "solve_s": float(solve_s),
                 "solve_id": solve_id,
                 "request_ids": [r.request_id for r in reqs]})
-        for r, result in zip(reqs, results):
+        # breaker: a dispatch where every live lane failed with an
+        # ERROR/BREAKDOWN counts toward the consecutive-failure
+        # threshold; anything else closes the breaker
+        failed = bool(lane_statuses) and all(
+            s in ("ERROR", "BREAKDOWN") for s in lane_statuses)
+        self._breaker_note_outcome(handle.key, not failed,
+                                   self._clock())
+        for r, result in results:
             if not r.future.done():
                 r.future.set_result(result)
 
@@ -821,6 +1169,12 @@ class SolverService:
                     else 0.0),
                 "bucket_counts": {str(k): v for k, v in
                                   sorted(self._bucket_counts.items())},
+                "retries": self._retries,
+                "refused": self._refused,
+                "degraded": self._degraded,
+                "breakers": {key: br.state
+                             for key, br in self._breakers.items()
+                             if br.state != "closed"},
             }
         out["latency"] = {
             "count": len(lat),

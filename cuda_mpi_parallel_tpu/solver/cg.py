@@ -169,6 +169,7 @@ def cg(
     method: str = "cg",
     compensated: bool = False,
     flight=None,
+    fault=None,
 ) -> CGResult:
     """Solve A x = b by (preconditioned) conjugate gradients.
 
@@ -233,6 +234,15 @@ def cg(
         replicated across shards.  Works with every ``method`` here
         (cg/cg1/pipecg); ``minres`` has its own recurrence and no
         recorder yet.
+      fault: optional ``robust.FaultPlan`` - deterministic chaos
+        injection: corrupt the halo payload, the local SpMV output or
+        the reduction scalar at a chosen iteration/shard, in-trace via
+        ``lax.cond`` (the fault fires inside the compiled while_loop;
+        the health predicate then exits with ``CGStatus.BREAKDOWN``
+        within ``check_every`` iterations).  ``None`` (the default)
+        leaves the traced jaxpr bit-identical to a call that never
+        mentions injection.  ``method="cg"`` only - the chaos harness
+        drills the textbook recurrence.
 
     The function is pure and traceable: call it under ``jit`` (or use
     ``solve()`` which jits for you).
@@ -258,6 +268,14 @@ def cg(
     if method not in ("cg", "cg1", "pipecg", "minres"):
         raise ValueError(f"unknown method {method!r}; expected 'cg', 'cg1', "
                          f"'pipecg' or 'minres'")
+    if fault is not None:
+        if method != "cg":
+            raise ValueError(
+                f"fault injection (robust.FaultPlan) rides "
+                f"method='cg' only (got {method!r}): the chaos "
+                f"harness drills the textbook recurrence")
+        fault.validate_for_operator(a, n_shards=1 if axis_name is None
+                                    else getattr(a, "n_shards", 1))
     if method == "minres":
         # the symmetric-INDEFINITE solver (quirk Q1: the reference's own
         # system is indefinite and CG converges on it only by luck)
@@ -350,9 +368,17 @@ def cg(
     def step_ab(s: _CGState):
         """One CG step; also returns the step's recording scalars
         ``(k, rr, alpha, beta)`` for the flight recorder (unused - and
-        traced away - when the recorder is off)."""
-        ap = a @ s.p
+        traced away - when the recorder is off).  With a ``fault``
+        armed, the matvec/reduction is routed through the injection
+        helpers - a ``lax.cond`` on ``s.k`` that corrupts the chosen
+        site exactly once; ``fault=None`` takes the untouched path."""
+        if fault is None:
+            ap = a @ s.p
+        else:
+            ap = fault.apply_matvec(a, s.p, s.k, axis_name)
         p_ap = dot(s.p, ap)                       # cublasDdot :304 -> psum
+        if fault is not None:
+            p_ap = fault.poison_reduction(p_ap, s.k)
         alpha = _safe_div(s.rho, p_ap)            # host arithmetic :311 -> device
         x = blas1.axpy(alpha, s.p, s.x)           # :314
         r = blas1.axpy(-alpha, ap, s.r)           # :320-321
@@ -857,15 +883,16 @@ def _as_operator(a) -> LinearOperator:
 
 @partial(jax.jit, static_argnames=("maxiter", "record_history", "axis_name",
                                    "return_checkpoint", "check_every",
-                                   "method", "compensated", "flight"))
+                                   "method", "compensated", "flight",
+                                   "fault"))
 def _solve_jit(a, b, x0, tol, rtol, maxiter, m, record_history, axis_name,
                resume_from, return_checkpoint, iter_cap, check_every,
-               method, compensated, flight):
+               method, compensated, flight, fault=None):
     return cg(a, b, x0, tol=tol, rtol=rtol, maxiter=maxiter, m=m,
               record_history=record_history, axis_name=axis_name,
               resume_from=resume_from, return_checkpoint=return_checkpoint,
               iter_cap=iter_cap, check_every=check_every, method=method,
-              compensated=compensated, flight=flight)
+              compensated=compensated, flight=flight, fault=fault)
 
 
 def solve(
@@ -886,6 +913,7 @@ def solve(
     compensated: bool = False,
     engine: str = "general",
     flight=None,
+    fault=None,
 ) -> CGResult:
     """Jitted single-call entry point: compile once per (operator-structure,
     shape, maxiter) and reuse - the whole solve is one XLA executable.
@@ -927,9 +955,18 @@ def solve(
         # history requests to the general solver, whose trace is
         # per-iteration - auto must never silently change a result's
         # meaning.
+        if engine == "resident" and fault is not None:
+            _note_rejected("resident", "fault injection requested "
+                           "(the one-kernel engine carries no "
+                           "injection sites)")
+            raise ValueError(
+                "engine='resident' does not support fault injection "
+                "(robust.FaultPlan arms the general recurrence); use "
+                "engine='general'")
         eligible = ((engine == "resident"
                      or jax.default_backend() == "tpu")
                     and flight is None
+                    and fault is None
                     and resident_eligible(
                         a, b, m, method=method,
                         record_history=(record_history
@@ -974,8 +1011,17 @@ def solve(
         from ..models.operators import _pallas_interpret
         from .streaming import cg_streaming, streaming_eligible
 
+        if engine == "streaming" and fault is not None:
+            _note_rejected("streaming", "fault injection requested "
+                           "(the fused-slab engine carries no "
+                           "injection sites)")
+            raise ValueError(
+                "engine='streaming' does not support fault injection "
+                "(robust.FaultPlan arms the general recurrence); use "
+                "engine='general'")
         eligible = ((engine == "streaming"
                      or jax.default_backend() == "tpu")
+                    and fault is None
                     and streaming_eligible(
                         a, b, m, method=method, x0=x0,
                         resume_from=resume_from,
@@ -1011,10 +1057,13 @@ def solve(
     cap_a = jnp.asarray(maxiter if iter_cap is None else iter_cap, jnp.int32)
     _note_engine("general", method, check_every,
                  **({"flight_stride": flight.stride}
-                    if flight is not None else {}))
+                    if flight is not None else {}),
+                 **({"fault": fault.fingerprint()}
+                    if fault is not None else {}))
     return _solve_jit(a, b, x0, tol_a, rtol_a, maxiter, m, record_history,
                       None, resume_from, return_checkpoint, cap_a,
-                      check_every, method, compensated, flight)
+                      check_every, method, compensated, flight,
+                      fault=fault)
 
 
 # The many-RHS tier (masked batched CG + block-CG) lives in .many; it

@@ -245,6 +245,7 @@ def cg_many(
     method: str = "batched",
     compensated: bool = False,
     flight=None,
+    fault=None,
 ) -> CGBatchResult:
     """Solve ``A X = B`` for all columns of ``B`` in one loop.
 
@@ -273,6 +274,14 @@ def cg_many(
         ``(capacity, 1 + 3k)``) in the loop state; ``"batched"`` only
         (block-CG's recurrence scalars are ``k x k`` matrices, not
         per-lane pairs).  ``None`` leaves the traced jaxpr untouched.
+      fault: optional ``robust.FaultPlan`` (``method="batched"``
+        only - block-CG's Gram-collapse fallback would mask an armed
+        fault as a rank event).  Array sites (halo/spmv) poison one
+        ROW of the stack - every lane breaks down together; the
+        ``reduction`` site poisons lane ``fault.lane``'s scalar only,
+        so the chaos matrix can prove per-lane failure isolation (the
+        poisoned lane exits BREAKDOWN while its batchmates converge).
+        ``None`` leaves the traced jaxpr untouched.
       (maxiter/iter_cap/check_every as in ``solver.cg``.)
 
     Returns a :class:`CGBatchResult` with per-lane status/iterations/
@@ -303,6 +312,16 @@ def cg_many(
     if compensated and method != "batched":
         raise ValueError("compensated dots ride the per-lane batched "
                          "recurrence only")
+    if fault is not None:
+        if method != "batched":
+            raise ValueError(
+                "fault injection (robust.FaultPlan) rides "
+                "method='batched' only: block-CG's in-trace "
+                "Gram-collapse fallback would mask an armed fault as "
+                "a rank event instead of a typed BREAKDOWN")
+        fault.validate_for_operator(
+            a, n_shards=1 if axis_name is None
+            else getattr(a, "n_shards", 1))
     preconditioned = m is not None
     if m is None:
         m = IdentityOperator(dim=b.shape[0],
@@ -343,18 +362,27 @@ def cg_many(
         iters=iters0, indefinite=indef0)
     final, fbuf = _run_batched(a, m, preconditioned, state, thresh_sq,
                                maxiter, cap, check_every, dot_many,
-                               flight, b.dtype)
+                               flight, b.dtype, fault=fault,
+                               axis_name=axis_name)
     return _package_many(final, thresh_sq, flight_buf=fbuf)
 
 
-def _batched_step_fn(a, m, preconditioned, thresh_sq, dot_many):
+def _batched_step_fn(a, m, preconditioned, thresh_sq, dot_many,
+                     fault=None, axis_name=None):
     """One masked batched CG step.  Returns ``(new_state, k, rr,
     alpha, beta)`` - the step plus its per-lane recording scalars (the
-    flight recorder's row; traced away when the recorder is off)."""
+    flight recorder's row; traced away when the recorder is off).
+    ``fault`` arms the chaos-injection sites exactly as in ``cg``'s
+    step (``fault=None`` is the untouched path)."""
     def step_ab(s: _ManyState):
         act = _active_lanes(s.rr, s.rho, thresh_sq)
-        ap = a.matmat(s.p)                       # ONE sweep, all lanes
+        if fault is None:
+            ap = a.matmat(s.p)                   # ONE sweep, all lanes
+        else:
+            ap = fault.apply_matvec(a, s.p, s.k, axis_name)
         p_ap = dot_many(s.p, ap)
+        if fault is not None:
+            p_ap = fault.poison_reduction(p_ap, s.k)
         alpha = _safe_div(s.rho, p_ap)           # (k,) elementwise
         x = _select_lanes(act, blas1.axpy_many(alpha, s.p, s.x), s.x)
         r = _select_lanes(act, blas1.axpy_many(-alpha, ap, s.r), s.r)
@@ -381,10 +409,12 @@ def _batched_step_fn(a, m, preconditioned, thresh_sq, dot_many):
 
 
 def _run_batched(a, m, preconditioned, state, thresh_sq, maxiter, cap,
-                 check_every, dot_many, flight, dtype):
+                 check_every, dot_many, flight, dtype, fault=None,
+                 axis_name=None):
     """The masked batched while loop (+ optional flight recorder)."""
     step_ab = _batched_step_fn(a, m, preconditioned, thresh_sq,
-                               dot_many)
+                               dot_many, fault=fault,
+                               axis_name=axis_name)
 
     def cond(s: _ManyState) -> jax.Array:
         act = _active_lanes(s.rr, s.rho, thresh_sq)
@@ -491,13 +521,14 @@ def _run_block(a, b, m, preconditioned, bstate, thresh_sq, maxiter,
 
 
 @partial(jax.jit, static_argnames=("maxiter", "check_every", "method",
-                                   "compensated", "flight"))
+                                   "compensated", "flight", "fault"))
 def _solve_many_jit(a, b, x0, tol, rtol, maxiter, m, iter_cap,
-                    check_every, method, compensated, flight):
+                    check_every, method, compensated, flight,
+                    fault=None):
     return cg_many(a, b, x0, tol=tol, rtol=rtol, maxiter=maxiter, m=m,
                    iter_cap=iter_cap, check_every=check_every,
                    method=method, compensated=compensated,
-                   flight=flight)
+                   flight=flight, fault=fault)
 
 
 def solve_many(
@@ -514,6 +545,7 @@ def solve_many(
     method: str = "batched",
     compensated: bool = False,
     flight=None,
+    fault=None,
 ) -> CGBatchResult:
     """Jitted single-call many-RHS entry point (the ``solve()`` of the
     batched tier): compile once per (operator structure, shapes,
@@ -537,6 +569,9 @@ def solve_many(
                         jnp.int32)
     _note_engine("many", method, check_every, n_rhs=int(b.shape[1]),
                  **({"flight_stride": flight.stride}
-                    if flight is not None else {}))
+                    if flight is not None else {}),
+                 **({"fault": fault.fingerprint()}
+                    if fault is not None else {}))
     return _solve_many_jit(a, b, x0, tol_a, rtol_a, maxiter, m, cap_a,
-                           check_every, method, compensated, flight)
+                           check_every, method, compensated, flight,
+                           fault=fault)

@@ -33,7 +33,16 @@ class CGStatus(enum.IntEnum):
         return {
             CGStatus.CONVERGED: "converged",
             CGStatus.MAXITER: "maximum iterations reached without convergence",
-            CGStatus.BREAKDOWN: "numerical breakdown (non-finite scalar)",
+            CGStatus.BREAKDOWN: (
+                "numerical breakdown: a non-finite recurrence scalar "
+                "(NaN/Inf in ||r||^2 or p.Ap - corrupted input data, "
+                "a poisoned halo payload, or overflow) or a non-SPD "
+                "preconditioner (r.Mr <= 0 with r != 0).  This is the "
+                "PROBLEM's fault, not the engine's: the solve exited "
+                "typed within one check_every block of the poisoned "
+                "step (result.iterations names it); see the "
+                "solve_fault event, and robust.solve_with_recovery "
+                "for bounded restart"),
             CGStatus.STAGNATED: (
                 "stagnated: residual decay flatlined above the "
                 "tolerance (attainable-accuracy floor or lost "

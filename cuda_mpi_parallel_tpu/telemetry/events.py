@@ -121,6 +121,21 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     # flight-recorder health verdict (telemetry.health): trace
     # classification + decay rates + Ritz condition estimate
     "solve_health": ("classification", "converged", "iterations"),
+    # a solve exited with a typed BREAKDOWN (robust/): site names the
+    # faulted recurrence site when a chaos FaultPlan was armed
+    # ("unknown" for organically detected breakdowns), iterations the
+    # step the health predicate caught it at
+    "solve_fault": ("site", "status", "iterations"),
+    # a recovery action after a breakdown (robust.solve_with_recovery):
+    # action is "restart" (re-seeded re-dispatch), "recovered" (final
+    # solve converged after >= 1 restart) or "exhausted" (budget spent,
+    # typed BREAKDOWN returned)
+    "solve_recovery": ("attempt", "action"),
+    # serve retry/breaker lifecycle: a failed (ERROR/BREAKDOWN) request
+    # was re-enqueued with backoff; a handle's circuit breaker changed
+    # state (closed/open/half_open)
+    "request_retry": ("request_id", "attempt", "status"),
+    "breaker_transition": ("handle", "state"),
     # the solve finished (converged or not) and was synced
     "solve_end": ("status", "iterations", "residual_norm"),
 }

@@ -231,6 +231,18 @@ def service_lines(stats: Dict[str, Any]) -> List[str]:
         lines.append("buckets : " + ", ".join(
             f"k={k}: {v}" for k, v in sorted(
                 buckets.items(), key=lambda kv: int(kv[0]))))
+    # the self-healing story (retry policy / circuit breaker /
+    # tolerance degradation), only when any of it actually fired
+    if stats.get("retries") or stats.get("refused") \
+            or stats.get("degraded") or stats.get("breakers"):
+        open_b = stats.get("breakers") or {}
+        lines.append(
+            f"robust  : {stats.get('retries', 0)} retried, "
+            f"{stats.get('refused', 0)} refused (breaker), "
+            f"{stats.get('degraded', 0)} tolerance-degraded"
+            + (f"; breakers not closed: "
+               f"{', '.join(f'{k}={v}' for k, v in sorted(open_b.items()))}"
+               if open_b else ""))
     lat = stats.get("latency") or {}
     lines.append(
         f"latency : p50 {ms(lat.get('p50_s'))}  "

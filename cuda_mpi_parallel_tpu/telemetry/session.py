@@ -61,6 +61,22 @@ def solve_metrics():
     }
 
 
+def note_breakdown(site: str, iterations: int,
+                   status: str = "BREAKDOWN", **fields: Any) -> None:
+    """One typed breakdown -> ``solve_fault`` event +
+    ``solve_breakdowns_total`` counter.  The SINGLE definition every
+    emission site shares (observe_solve's epilogue, the recovery
+    layer, the serve dispatcher) - three hand-spelled copies of the
+    counter would silently fork its help text on the next edit."""
+    REGISTRY.counter(
+        "solve_breakdowns_total",
+        "solves that exited with a typed BREAKDOWN (non-finite "
+        "recurrence or non-SPD preconditioner)",
+        labelnames=("site",)).inc(site=site)
+    events.emit("solve_fault", site=site, status=status,
+                iterations=iterations, **fields)
+
+
 class SolveObservation:
     """Handle yielded by :func:`observe_solve`."""
 
@@ -119,6 +135,12 @@ class SolveObservation:
         )
         if elapsed_s is not None:
             payload["elapsed_s"] = float(elapsed_s)
+        if status == "BREAKDOWN":
+            # typed fault detection lands in telemetry even when no
+            # recovery wrapper ran (site is unknown here - the solver
+            # only knows the recurrence went non-finite; an armed
+            # FaultPlan's site rides the recovery layer's emission)
+            note_breakdown("unknown", iterations, engine=self.engine)
         events.emit("solve_end", **payload)
         self._finished = True
         return payload

@@ -17,6 +17,7 @@ right choice for multi-host N=256^3 runs where no host holds the vectors).
 from __future__ import annotations
 
 import os
+from dataclasses import fields as dataclasses_fields
 from typing import Optional
 
 import jax.numpy as jnp
@@ -36,6 +37,12 @@ _FORMAT_VERSION = 2
 #   rows    - derived from indptr at construction (CSRMatrix.from_arrays);
 #             hashing it adds bytes, never identity.
 _FP_EXCLUDE_FIELDS = frozenset({"backend", "rows"})
+
+
+class CheckpointMismatch(ValueError):
+    """A checkpoint belongs to a different problem or layout
+    (fingerprint mismatch).  Typed so recovery/serving layers can
+    branch on it; still a ``ValueError`` for every existing caller."""
 
 
 def _update_operator_hash(h, a) -> None:
@@ -144,7 +151,7 @@ def _check_fingerprint(stored: str, expect: str, path: str) -> None:
     if not expect:
         return
     if stored and stored != expect:
-        raise ValueError(
+        raise CheckpointMismatch(
             f"checkpoint {path} belongs to a different problem "
             f"(fingerprint {stored} != {expect}); refusing "
             f"to resume - delete it to start fresh")
@@ -324,10 +331,14 @@ def solve_resumable(
         res = solve(a, b, tol=tol, rtol=rtol, maxiter=maxiter, m=m,
                     resume_from=state, return_checkpoint=True,
                     iter_cap=cap)
+        if res.status_enum().name == "BREAKDOWN":
+            # never overwrite the last good checkpoint with the
+            # breakdown segment's non-finite recurrence state - the
+            # pre-fault progress on disk is what a retry resumes from
+            return res
         state = res.checkpoint
         save(path, state, fingerprint=fp)
-        finished = bool(res.converged) or int(res.iterations) >= maxiter \
-            or res.status_enum().name == "BREAKDOWN"
+        finished = bool(res.converged) or int(res.iterations) >= maxiter
         if finished:
             if bool(res.converged) and not keep_checkpoint:
                 import shutil
@@ -340,6 +351,142 @@ def solve_resumable(
                 except OSError:
                     pass
             return res
+
+
+def distributed_fingerprint(a, b, *, n_shards: int, plan=None,
+                            exchange=None,
+                            csr_comm: str = "allgather") -> str:
+    """Identify the (problem, layout) a DISTRIBUTED checkpoint belongs
+    to.  A distributed ``CGCheckpoint``'s vector leaves live in the
+    padded, plan-permuted row layout of one exact partition - resuming
+    it under a different mesh size, partition plan or exchange lane
+    would scatter the recurrence vectors to the wrong rows and
+    silently converge to garbage.  This fingerprint folds the layout
+    identity (shard count, plan fingerprint, exchange/comm lane) into
+    the problem fingerprint so that mismatch fails loudly
+    (:class:`CheckpointMismatch`)."""
+    import hashlib
+
+    lane = plan.fingerprint() if plan is not None else "even"
+    spec = (f"{problem_fingerprint(a, b)};shards={n_shards};"
+            f"plan={lane};exchange={exchange};comm={csr_comm}")
+    return hashlib.sha256(spec.encode()).hexdigest()[:16]
+
+
+def solve_resumable_distributed(
+    a,
+    b,
+    path: str,
+    *,
+    mesh=None,
+    n_devices: Optional[int] = None,
+    segment_iters: int = 500,
+    tol: float = 1e-7,
+    rtol: float = 0.0,
+    maxiter: int = 2000,
+    preconditioner: Optional[str] = None,
+    plan=None,
+    exchange=None,
+    keep_checkpoint: bool = False,
+    backend: str = "npz",
+    preempt=None,
+    **kw,
+) -> CGResult:
+    """Distributed sibling of :func:`solve_resumable`: a mesh solve in
+    segments, persisting the full per-shard recurrence state after
+    each, so a preempted N=256^3-class run resumes the *exact* iterate
+    trajectory (p and rho restored, not restarted).
+
+    Scope mirrors ``solve_distributed``'s checkpoint lane: assembled
+    ``CSRMatrix`` on the allgather/gather exchange, ``method="cg"``.
+    The checkpoint fingerprint covers the problem AND the layout
+    (mesh size, resolved partition plan, exchange lane) - resuming
+    under a mismatched layout raises :class:`CheckpointMismatch`
+    instead of silently scattering state to the wrong rows.  The plan
+    is resolved ONCE here so every segment shares one layout (and one
+    compiled executable: ``maxiter`` is static, only the traced
+    ``iter_cap`` advances).
+
+    ``backend="orbax"`` persists the checkpoint tree through orbax
+    (sharded arrays written shard-by-shard - the multi-host lane);
+    ``"npz"`` gathers to one host file.
+
+    ``preempt``: optional host hook (e.g. ``robust.Preemption``)
+    called with the number of completed segments after each save -
+    raising :class:`robust.PreemptedError` there simulates a killed
+    worker with its state safely on disk; a later identical call
+    resumes.  ``**kw`` forwards to ``solve_distributed``
+    (check_every/flight/...).
+    """
+    from ..parallel.dist_cg import (
+        _plan_exchange_hint,
+        resolve_plan,
+        solve_distributed,
+    )
+    from ..parallel.mesh import make_mesh
+
+    if segment_iters < 1:
+        raise ValueError(f"segment_iters must be >= 1, got {segment_iters}")
+    if backend not in ("npz", "orbax"):
+        raise ValueError(f"unknown checkpoint backend: {backend!r}")
+    save = save_checkpoint_orbax if backend == "orbax" else save_checkpoint
+    load = load_checkpoint_orbax if backend == "orbax" else load_checkpoint
+    if mesh is None:
+        mesh = make_mesh(n_devices)
+    n_shards = int(mesh.devices.size)
+    plan_resolved = resolve_plan(
+        plan, a, n_shards,
+        exchange=_plan_exchange_hint("allgather", exchange))
+    fp = distributed_fingerprint(a, b, n_shards=n_shards,
+                                 plan=plan_resolved, exchange=exchange)
+    state: Optional[CGCheckpoint] = None
+    if os.path.exists(path):
+        on_disk = "orbax" if os.path.isdir(path) else "npz"
+        if on_disk != backend:
+            raise ValueError(
+                f"checkpoint at {path} is in {on_disk} format but "
+                f"backend={backend!r} was requested; pass "
+                f"backend={on_disk!r} to resume it (or delete it)")
+        state = load(path, expect_fingerprint=fp)
+
+    segments = 0
+    while True:
+        done_k = int(state.k) if state is not None else 0
+        cap = min(done_k + segment_iters, maxiter)
+        res = solve_distributed(
+            a, b, mesh=mesh, tol=tol, rtol=rtol, maxiter=maxiter,
+            preconditioner=preconditioner, plan=plan_resolved,
+            exchange=exchange, resume_from=state,
+            return_checkpoint=True, iter_cap=cap, **kw)
+        if res.status_enum().name == "BREAKDOWN":
+            # do NOT save: the breakdown segment's recurrence state is
+            # non-finite, and overwriting the last good checkpoint
+            # with it would make every later resume break down
+            # immediately - the pre-fault progress on disk is exactly
+            # what a recovery layer restarts from
+            return res
+        state = res.checkpoint
+        # gather to host arrays once; both backends consume numpy
+        state = CGCheckpoint(**{
+            f.name: np.asarray(getattr(state, f.name))
+            for f in dataclasses_fields(CGCheckpoint)})
+        save(path, state, fingerprint=fp)
+        segments += 1
+        finished = bool(res.converged) or int(res.iterations) >= maxiter
+        if finished:
+            if bool(res.converged) and not keep_checkpoint:
+                import shutil
+
+                try:
+                    if os.path.isdir(path):
+                        shutil.rmtree(path)
+                    else:
+                        os.remove(path)
+                except OSError:
+                    pass
+            return res
+        if preempt is not None:
+            preempt(segments)
 
 
 def solve_resumable_df64(
@@ -425,10 +572,13 @@ def solve_resumable_df64(
         res = cg_df64(a, b64, tol=tol, rtol=rtol, maxiter=maxiter,
                       preconditioner=preconditioner, resume_from=state,
                       return_checkpoint=True, iter_cap=cap)
+        if res.status_enum().name == "BREAKDOWN":
+            # see solve_resumable: the poisoned segment state must
+            # not clobber the last good checkpoint
+            return res
         state = res.checkpoint
         save_checkpoint_df64(path, state, fingerprint=fp)
-        finished = bool(res.converged) or int(res.iterations) >= maxiter \
-            or res.status_enum().name == "BREAKDOWN"
+        finished = bool(res.converged) or int(res.iterations) >= maxiter
         if finished:
             if bool(res.converged) and not keep_checkpoint:
                 try:
@@ -504,10 +654,14 @@ def _solve_resumable_df64_resident(a, b64, path, *, segment_iters, tol,
             a, b64, tol=tol, rtol=rtol, maxiter=maxiter,
             preconditioner=preconditioner, iter_cap=cap,
             interpret=interpret)
+        if res.status_enum().name == "BREAKDOWN":
+            # consistent with the other resumable loops: keep the last
+            # good checkpoint (the replay would deterministically
+            # reproduce the breakdown anyway - the fault is the data's)
+            return res
         done_k = int(res.iterations)
         _save_replay_ckpt(path, done_k, res.x_hi, res.x_lo, fingerprint)
-        finished = bool(res.converged) or done_k >= maxiter \
-            or res.status_enum().name == "BREAKDOWN"
+        finished = bool(res.converged) or done_k >= maxiter
         # a stalled segment (iterations < cap without a finished status
         # cannot happen: the kernel stops early only on convergence,
         # breakdown, or the cap itself) - guard anyway so a logic bug

@@ -1,0 +1,109 @@
+"""Chaos drill: inject a halo NaN, watch the solve self-heal.
+
+Three acts on the committed skewed SPD fixture (240 rows, mesh 4):
+
+1. **Inject**: a ``robust.FaultPlan`` arms the compiled distributed
+   solve to corrupt the halo payload shard 2 receives at iteration 10
+   (in-trace ``lax.cond`` - the production executable plus one armed
+   select).  The while-loop health predicate catches the poisoned
+   recurrence within one check block and exits with a typed
+   ``CGStatus.BREAKDOWN`` - never a silent wrong answer.
+2. **Recover**: ``robust.solve_with_recovery`` detects the breakdown,
+   emits ``solve_fault``/``solve_recovery`` events, disarms the
+   transient fault, and restarts from the last finite iterate; the
+   recovered solution matches the fault-free solve.
+3. **Serve**: a poisoned handle (sticky fault baked into every
+   dispatch) drives the service's per-handle circuit breaker: two
+   consecutive failed dispatches open it, submits refuse with typed
+   REFUSED results, and the post-cooldown half-open probe re-opens it
+   when the handle is still bad.
+
+Run:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+      python examples/17_chaos_recovery.py
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from cuda_mpi_parallel_tpu.models import mmio
+from cuda_mpi_parallel_tpu.parallel import make_mesh, solve_distributed
+from cuda_mpi_parallel_tpu.robust import FaultPlan, solve_with_recovery
+from cuda_mpi_parallel_tpu.solver.status import CGStatus
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "..", "tests",
+                       "fixtures", "skewed_spd_240.mtx")
+
+
+def main():
+    a = mmio.load_matrix_market(FIXTURE)
+    b = np.random.default_rng(0).standard_normal(a.shape[0])
+    mesh = make_mesh(4)
+
+    clean = solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=500)
+    print(f"fault-free : {CGStatus(int(clean.status)).name} in "
+          f"{int(clean.iterations)} iterations")
+
+    # -- act 1: typed detection ---------------------------------------
+    fault = FaultPlan(site="halo", iteration=10, shard=2)
+    print(f"\ninjecting  : {fault.describe()}")
+    broken = solve_distributed(a, b, mesh=mesh, tol=1e-8, maxiter=500,
+                               inject=fault)
+    print(f"detected   : {CGStatus(int(broken.status)).name} at "
+          f"iteration {int(broken.iterations)} "
+          f"(latency {int(broken.iterations) - fault.iteration} "
+          f"iteration past the fault)")
+
+    # -- act 2: self-healing ------------------------------------------
+    rr = solve_with_recovery(a, b, mesh=mesh, tol=1e-8, maxiter=500,
+                             inject=fault)
+    err = float(np.max(np.abs(np.asarray(rr.result.x)
+                              - np.asarray(clean.x))))
+    print(f"recovered  : {rr.restarts} restart(s) -> "
+          f"{CGStatus(int(rr.result.status)).name}, max |dx| vs "
+          f"fault-free = {err:.2e}")
+
+    # -- act 3: the serve circuit breaker -----------------------------
+    from cuda_mpi_parallel_tpu.serve import ServiceConfig, SolverService
+
+    t = [0.0]
+    svc = SolverService(ServiceConfig(
+        clock=lambda: t[0], max_batch=1, max_wait_s=0.0,
+        breaker_threshold=2, breaker_cooldown_s=5.0))
+    try:
+        poisoned = svc.register(
+            a, inject=FaultPlan(site="reduction", iteration=1,
+                                sticky=True))
+        print("\nserve      : poisoned handle registered "
+              "(sticky reduction fault)")
+        for i in range(2):
+            fut = svc.submit(poisoned, b)
+            svc.pump()
+            print(f"  dispatch {i + 1}: "
+                  f"{fut.result(timeout=30).status}")
+        print(f"  breaker  : {svc.breaker_state(poisoned)}")
+        refused = svc.submit(poisoned, b).result(timeout=30)
+        print(f"  submit   : {refused.status} "
+              f"(failure_kind={refused.failure_kind})")
+        t[0] = 6.0   # cooldown elapsed: one half-open probe admitted
+        probe = svc.submit(poisoned, b)
+        print(f"  cooldown : breaker {svc.breaker_state(poisoned)}, "
+              f"probe admitted")
+        svc.pump()
+        print(f"  probe    : {probe.result(timeout=30).status} -> "
+              f"breaker {svc.breaker_state(poisoned)}")
+    finally:
+        svc.close()
+
+
+if __name__ == "__main__":
+    main()

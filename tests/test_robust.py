@@ -1,0 +1,734 @@
+"""robust/: deterministic fault injection + self-healing solves.
+
+The acceptance story is "inject any fault the harness can spell; the
+solve either recovers to the fault-free answer or fails typed and
+loud, never silently wrong":
+
+* the chaos matrix - every injection site (halo round, local SpMV,
+  reduction scalar) x mesh {1, 4} is DETECTED within ``check_every``
+  iterations (typed BREAKDOWN whose iteration count names the poisoned
+  step) and the recovered solution matches the fault-free solve;
+* with no ``FaultPlan`` the solve body jaxpr is proven bit-identical
+  to a call that never mentions injection (TestZeroPerturbation);
+* a distributed ``solve_resumable`` segment killed mid-run resumes
+  from its checkpoint with the exact iterate trajectory, and a resume
+  under a mismatched plan/exchange fingerprint fails with a loud typed
+  error;
+* the serve layer retries ERROR/BREAKDOWN lanes with backoff, opens a
+  per-handle circuit breaker on consecutive failures (typed REFUSED
+  results, half-open probe), and degrades tolerance under queue
+  pressure.
+"""
+import os
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu.models import mmio
+from cuda_mpi_parallel_tpu.models.poisson import poisson_2d_csr
+from cuda_mpi_parallel_tpu.parallel import (
+    make_mesh,
+    solve_distributed,
+)
+from cuda_mpi_parallel_tpu.robust import (
+    FaultPlan,
+    PreemptedError,
+    Preemption,
+    RecoveryPolicy,
+    check_finite_rhs,
+    solve_with_recovery,
+)
+from cuda_mpi_parallel_tpu.solver import solve, solve_many
+from cuda_mpi_parallel_tpu.solver.cg import cg
+from cuda_mpi_parallel_tpu.solver.status import CGStatus
+from cuda_mpi_parallel_tpu.telemetry import events
+from cuda_mpi_parallel_tpu.utils import compat
+from cuda_mpi_parallel_tpu.utils.checkpoint import (
+    CheckpointMismatch,
+    solve_resumable_distributed,
+)
+
+needs_mesh = pytest.mark.skipif(
+    not compat.has_shard_map() or len(jax.devices()) < 4,
+    reason="needs shard_map and >= 4 (virtual) devices")
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "skewed_spd_240.mtx")
+
+
+@pytest.fixture(scope="module")
+def fixture_problem():
+    a = mmio.load_matrix_market(FIXTURE)
+    b = np.random.default_rng(0).standard_normal(240)
+    return a, b
+
+
+def _status(res) -> str:
+    return CGStatus(int(res.status)).name
+
+
+class TestFaultPlan:
+    def test_parse(self):
+        p = FaultPlan.parse("halo:10")
+        assert (p.site, p.iteration, p.shard) == ("halo", 10, 0)
+        p = FaultPlan.parse("spmv:25:2")
+        assert (p.site, p.iteration, p.shard) == ("spmv", 25, 2)
+
+    @pytest.mark.parametrize("bad", ["halo", "nope:3", "halo:x",
+                                     "halo:1:2:3", "spmv:-1"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+    def test_static_hashable_identity(self):
+        """Two identical plans must be EQUAL and hash-equal (they ride
+        jit static args and solver-cache keys; a NaN-valued float
+        field would break this - hence the string-spelled value)."""
+        a = FaultPlan(site="halo", iteration=10, shard=1)
+        b = FaultPlan(site="halo", iteration=10, shard=1)
+        assert a == b and hash(a) == hash(b)
+        assert a.fingerprint() == b.fingerprint()
+        assert a.fingerprint() != FaultPlan(
+            site="halo", iteration=11, shard=1).fingerprint()
+
+    def test_after_restart(self):
+        assert FaultPlan(site="spmv", iteration=3).after_restart() \
+            is None
+        sticky = FaultPlan(site="spmv", iteration=3, sticky=True)
+        assert sticky.after_restart() is sticky
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="site"):
+            FaultPlan(site="wire", iteration=1)
+        with pytest.raises(ValueError, match="value"):
+            FaultPlan(site="halo", iteration=1, value="7.0")
+        with pytest.raises(ValueError):
+            FaultPlan(site="halo", iteration=-1)
+
+
+@needs_mesh
+class TestChaosMatrix:
+    """Every injection site x mesh {1, 4}: typed BREAKDOWN within
+    check_every of the poisoned step, and recovery reaches the
+    fault-free answer."""
+
+    @pytest.mark.parametrize("site", ["halo", "spmv", "reduction"])
+    @pytest.mark.parametrize("n_shards", [1, 4])
+    def test_detected_and_recovered(self, site, n_shards,
+                                    fixture_problem):
+        a, b = fixture_problem
+        mesh = make_mesh(n_shards)
+        shard = 0 if n_shards == 1 else 2
+        plan = FaultPlan(site=site, iteration=10, shard=shard)
+        clean = solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                  maxiter=500)
+        assert _status(clean) == "CONVERGED"
+
+        broken = solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                   maxiter=500, inject=plan)
+        assert _status(broken) == "BREAKDOWN"
+        # detection within one check_every(=1) block of the poisoned
+        # step (the step that computes iteration 11 is the faulted one)
+        assert 10 <= int(broken.iterations) <= 11
+
+        rr = solve_with_recovery(a, b, mesh=mesh, tol=1e-8,
+                                 maxiter=500, inject=plan)
+        assert rr.recovered and rr.restarts == 1
+        assert _status(rr.result) == "CONVERGED"
+        err = float(np.max(np.abs(np.asarray(rr.result.x)
+                                  - np.asarray(clean.x))))
+        assert err < 1e-5
+
+    def test_detection_within_check_every_block(self, fixture_problem):
+        a, b = fixture_problem
+        res = solve_distributed(
+            a, b, mesh=make_mesh(4), tol=1e-8, maxiter=500,
+            check_every=8,
+            inject=FaultPlan(site="spmv", iteration=10))
+        assert _status(res) == "BREAKDOWN"
+        assert int(res.iterations) - 10 <= 8 + 1
+
+    def test_gather_lane_halo_fault(self, fixture_problem):
+        """The packed-round gather exchange carries the same halo
+        injection site (the extended-x region is the received
+        payload)."""
+        a, b = fixture_problem
+        res = solve_distributed(
+            a, b, mesh=make_mesh(4), tol=1e-8, maxiter=500,
+            exchange="gather",
+            inject=FaultPlan(site="halo", iteration=10, shard=1))
+        assert _status(res) == "BREAKDOWN"
+        assert 10 <= int(res.iterations) <= 11
+
+    def test_ring_lane_refuses(self, fixture_problem):
+        a, b = fixture_problem
+        with pytest.raises(ValueError, match="allgather/gather"):
+            solve_distributed(a, b, mesh=make_mesh(4), csr_comm="ring",
+                              inject=FaultPlan(site="spmv",
+                                               iteration=5))
+
+
+class TestSingleDevice:
+    def test_spmv_and_reduction_breakdown(self):
+        a = poisson_2d_csr(8, 8)
+        b = np.asarray(
+            a @ np.random.default_rng(1).standard_normal(64))
+        for site in ("spmv", "reduction"):
+            res = solve(a, b, tol=1e-9, maxiter=200,
+                        fault=FaultPlan(site=site, iteration=3))
+            assert _status(res) == "BREAKDOWN"
+            assert 3 <= int(res.iterations) <= 4
+
+    def test_halo_refuses_without_exchange(self):
+        a = poisson_2d_csr(8, 8)
+        with pytest.raises(ValueError, match="halo"):
+            solve(a, np.ones(64),
+                  fault=FaultPlan(site="halo", iteration=3))
+
+    def test_variant_methods_refuse(self):
+        a = poisson_2d_csr(8, 8)
+        for method in ("cg1", "pipecg", "minres"):
+            with pytest.raises(ValueError, match="method='cg'"):
+                solve(a, np.ones(64), method=method,
+                      fault=FaultPlan(site="spmv", iteration=3))
+
+    def test_single_device_recovery(self):
+        a = poisson_2d_csr(8, 8)
+        rng = np.random.default_rng(2)
+        x_true = rng.standard_normal(64)
+        b = np.asarray(a @ x_true)
+        clean = solve(a, b, tol=1e-10, maxiter=200)
+        rr = solve_with_recovery(
+            a, b, tol=1e-10, maxiter=200,
+            inject=FaultPlan(site="reduction", iteration=5))
+        assert rr.recovered
+        np.testing.assert_allclose(np.asarray(rr.result.x),
+                                   np.asarray(clean.x), atol=1e-8)
+
+
+class TestManyRHSLaneIsolation:
+    def test_reduction_fault_breaks_only_its_lane(self):
+        """The chaos proof that per-lane failure isolation is real: a
+        poisoned reduction scalar on lane 2 exits THAT lane with a
+        typed BREAKDOWN while its batchmates converge."""
+        a = poisson_2d_csr(8, 8)
+        rng = np.random.default_rng(3)
+        x_true = rng.standard_normal((64, 4))
+        b = np.asarray(a.matmat(x_true))
+        res = solve_many(a, b, tol=1e-9, maxiter=200,
+                         fault=FaultPlan(site="reduction", iteration=5,
+                                         lane=2))
+        statuses = [s.name for s in res.status_enums()]
+        assert statuses[2] == "BREAKDOWN"
+        assert [s for i, s in enumerate(statuses) if i != 2] \
+            == ["CONVERGED"] * 3
+        # the poisoned lane froze at its breakdown step; the others
+        # ran to convergence
+        iters = np.asarray(res.iterations)
+        assert int(iters[2]) <= 6 < int(iters[0])
+
+    def test_block_method_refuses(self):
+        a = poisson_2d_csr(8, 8)
+        with pytest.raises(ValueError, match="batched"):
+            solve_many(a, np.ones((64, 2)), method="block",
+                       fault=FaultPlan(site="spmv", iteration=5))
+
+
+class TestRecoveryPolicy:
+    def test_sticky_fault_exhausts_budget_typed(self):
+        a = poisson_2d_csr(8, 8)
+        b = np.asarray(
+            a @ np.random.default_rng(4).standard_normal(64))
+        rr = solve_with_recovery(
+            a, b, tol=1e-9, maxiter=200,
+            policy=RecoveryPolicy(max_restarts=2),
+            inject=FaultPlan(site="spmv", iteration=3, sticky=True))
+        assert not rr.recovered
+        assert rr.restarts == 2 and rr.attempts == 3
+        assert _status(rr.result) == "BREAKDOWN"
+        assert len(rr.faults) == 3
+
+    def test_zero_restarts_detect_only(self):
+        a = poisson_2d_csr(8, 8)
+        b = np.ones(64)
+        rr = solve_with_recovery(
+            a, b, tol=1e-9, maxiter=200,
+            policy=RecoveryPolicy(max_restarts=0),
+            inject=FaultPlan(site="spmv", iteration=3))
+        assert not rr.recovered and rr.attempts == 1
+        assert _status(rr.result) == "BREAKDOWN"
+
+    def test_snapshot_every_restarts_from_finite_iterate(self):
+        """With segment snapshots, a late fault restarts from a finite
+        PRE-fault iterate (not zero) and still lands on the fault-free
+        answer."""
+        a = poisson_2d_csr(8, 8)
+        b = np.asarray(
+            a @ np.random.default_rng(5).standard_normal(64))
+        clean = solve(a, b, tol=1e-10, maxiter=200)
+        seen = []
+        with events.capture() as buf:
+            rr = solve_with_recovery(
+                a, b, tol=1e-10, maxiter=200,
+                policy=RecoveryPolicy(max_restarts=1,
+                                      snapshot_every=10),
+                inject=FaultPlan(site="spmv", iteration=25))
+        import json
+
+        seen = [json.loads(ln) for ln in
+                buf.getvalue().splitlines() if ln.strip()]
+        assert rr.recovered
+        restarts = [e for e in seen if e["event"] == "solve_recovery"
+                    and e["action"] == "restart"]
+        assert restarts and restarts[0]["seed"] \
+            == "last_finite_segment"
+        np.testing.assert_allclose(np.asarray(rr.result.x),
+                                   np.asarray(clean.x), atol=1e-8)
+
+    def test_events_and_counters(self):
+        from cuda_mpi_parallel_tpu.telemetry.registry import REGISTRY
+
+        a = poisson_2d_csr(8, 8)
+        b = np.ones(64)
+        with events.capture() as buf:
+            solve_with_recovery(
+                a, b, tol=1e-9, maxiter=200,
+                inject=FaultPlan(site="reduction", iteration=3))
+        import json
+
+        recs = [json.loads(ln) for ln in
+                buf.getvalue().splitlines() if ln.strip()]
+        faults = [events.validate_event(e) for e in recs
+                  if e["event"] == "solve_fault"]
+        recovs = [events.validate_event(e) for e in recs
+                  if e["event"] == "solve_recovery"]
+        assert faults and faults[0]["site"] == "reduction"
+        assert {e["action"] for e in recovs} \
+            == {"restart", "recovered"}
+        snap = REGISTRY.snapshot()
+        assert "solve_breakdowns_total" in snap
+        assert "solve_recoveries_total" in snap
+
+
+@needs_mesh
+class TestPreemptionDrill:
+    """Kill a distributed resumable segment; resume; the final
+    trajectory bit-matches the uninterrupted run (p and rho restored,
+    not restarted)."""
+
+    def test_resume_bitwise_trajectory(self, fixture_problem,
+                                       tmp_path):
+        a, b = fixture_problem
+        mesh = make_mesh(4)
+        full_path = str(tmp_path / "full.npz")
+        full = solve_resumable_distributed(
+            a, b, full_path, mesh=mesh, segment_iters=20, tol=1e-8,
+            maxiter=500)
+        assert bool(full.converged)
+
+        ck = str(tmp_path / "preempted.npz")
+        with pytest.raises(PreemptedError):
+            solve_resumable_distributed(
+                a, b, ck, mesh=mesh, segment_iters=20, tol=1e-8,
+                maxiter=500, preempt=Preemption(after_segments=1))
+        assert os.path.exists(ck)
+        resumed = solve_resumable_distributed(
+            a, b, ck, mesh=mesh, segment_iters=20, tol=1e-8,
+            maxiter=500)
+        assert bool(resumed.converged)
+        assert int(resumed.iterations) == int(full.iterations)
+        # bit-match: resuming restored the exact recurrence state
+        assert np.array_equal(np.asarray(resumed.x),
+                              np.asarray(full.x))
+
+    def test_mismatched_layout_fails_typed(self, fixture_problem,
+                                           tmp_path):
+        a, b = fixture_problem
+        mesh = make_mesh(4)
+        ck = str(tmp_path / "layout.npz")
+        with pytest.raises(PreemptedError):
+            solve_resumable_distributed(
+                a, b, ck, mesh=mesh, segment_iters=20, tol=1e-8,
+                maxiter=500, preempt=Preemption(after_segments=1))
+        # a different exchange lane is a different layout fingerprint
+        with pytest.raises(CheckpointMismatch):
+            solve_resumable_distributed(
+                a, b, ck, mesh=mesh, segment_iters=20, tol=1e-8,
+                maxiter=500, exchange="gather")
+        # ... and a different mesh size too
+        with pytest.raises(CheckpointMismatch):
+            solve_resumable_distributed(
+                a, b, ck, mesh=make_mesh(2), segment_iters=20,
+                tol=1e-8, maxiter=500)
+
+    def test_breakdown_segment_preserves_last_good_checkpoint(
+            self, fixture_problem, tmp_path):
+        """A breakdown mid-segment must NOT overwrite the last good
+        checkpoint with non-finite state: the pre-fault progress on
+        disk is exactly what recovery restarts from."""
+        from cuda_mpi_parallel_tpu.utils.checkpoint import (
+            load_checkpoint,
+        )
+
+        a, b = fixture_problem
+        mesh = make_mesh(4)
+        ck = str(tmp_path / "broke.npz")
+        res = solve_resumable_distributed(
+            a, b, ck, mesh=mesh, segment_iters=20, tol=1e-8,
+            maxiter=500,
+            inject=FaultPlan(site="spmv", iteration=30, sticky=True))
+        assert _status(res) == "BREAKDOWN"
+        # the file still holds segment 1's FINITE state (k=20)
+        saved = load_checkpoint(ck)
+        assert int(saved.k) == 20
+        assert np.isfinite(np.asarray(saved.x)).all()
+        # a clean re-run resumes from it and converges
+        clean = solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                  maxiter=500)
+        resumed = solve_resumable_distributed(
+            a, b, ck, mesh=mesh, segment_iters=20, tol=1e-8,
+            maxiter=500)
+        assert bool(resumed.converged)
+        np.testing.assert_allclose(np.asarray(resumed.x),
+                                   np.asarray(clean.x), atol=1e-6)
+
+    def test_segments_share_one_executable(self, fixture_problem,
+                                           tmp_path):
+        """Every segment re-dispatches the SAME compiled solver (only
+        the traced iter_cap advances): the body traces once."""
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+
+        a, b = fixture_problem
+        dist_cg.clear_solver_cache()
+        before = dist_cg._TRACE_COUNT[0]
+        solve_resumable_distributed(
+            a, b, str(tmp_path / "one.npz"), mesh=make_mesh(4),
+            segment_iters=10, tol=1e-8, maxiter=500)
+        traces = dist_cg._TRACE_COUNT[0] - before
+        # one trace for the no-resume first segment, one for the
+        # resumed-segment signature; later segments reuse both
+        assert traces <= 2
+
+
+class TestValidation:
+    def test_check_finite_rhs(self):
+        check_finite_rhs(np.ones(4))
+        with pytest.raises(ValueError, match="non-finite"):
+            check_finite_rhs(np.array([1.0, np.nan]))
+        with pytest.raises(ValueError, match="non-finite"):
+            check_finite_rhs(np.array([1.0, np.inf]))
+
+    @needs_mesh
+    def test_solve_distributed_rejects_nan_b(self, fixture_problem):
+        a, _ = fixture_problem
+        bad = np.ones(240)
+        bad[7] = np.nan
+        with pytest.raises(ValueError, match="non-finite"):
+            solve_distributed(a, bad, mesh=make_mesh(4))
+
+    @needs_mesh
+    def test_opt_out_reaches_typed_breakdown(self, fixture_problem):
+        """validate=False stages the poisoned system deliberately; the
+        in-loop guard still exits typed (never silently wrong)."""
+        a, _ = fixture_problem
+        bad = np.ones(240)
+        bad[7] = np.nan
+        res = solve_distributed(a, bad, mesh=make_mesh(4), tol=1e-8,
+                                maxiter=50, validate=False)
+        assert _status(res) == "BREAKDOWN"
+        assert int(res.iterations) <= 1
+
+    def test_poisoned_matrix_rejected(self):
+        from cuda_mpi_parallel_tpu.robust.validate import (
+            check_finite_problem,
+        )
+
+        a = poisson_2d_csr(8, 8)
+        bad = type(a).from_arrays(
+            np.where(np.arange(a.data.shape[0]) == 3, np.nan,
+                     np.asarray(a.data)),
+            np.asarray(a.indices), np.asarray(a.indptr))
+        with pytest.raises(ValueError, match="non-finite"):
+            check_finite_problem(bad, np.ones(64))
+
+
+class TestServeRobustness:
+    """Fake-clock service drills: retry/backoff, circuit breaker
+    open -> refuse -> half-open probe, and tolerance degradation."""
+
+    def _service(self, **cfg_kw):
+        from cuda_mpi_parallel_tpu.serve import (
+            ServiceConfig,
+            SolverService,
+        )
+
+        t = [0.0]
+        cfg = ServiceConfig(clock=lambda: t[0], **cfg_kw)
+        return SolverService(cfg), t
+
+    def _problem(self):
+        a = poisson_2d_csr(8, 8)
+        b = np.asarray(
+            a @ np.random.default_rng(7).standard_normal(64))
+        return a, b
+
+    def test_retry_recovers_transient_engine_error(self):
+        from cuda_mpi_parallel_tpu.serve import RetryPolicy
+
+        a, b = self._problem()
+        svc, t = self._service(
+            max_batch=2, max_wait_s=0.0,
+            retry=RetryPolicy(max_retries=2, backoff_s=1.0))
+        try:
+            h = svc.register(a)
+            fails = [1]
+            orig = svc._engine
+
+            def flaky(handle, b_stack, tols):
+                if fails[0] > 0:
+                    fails[0] -= 1
+                    raise RuntimeError("transient blowup")
+                return orig(handle, b_stack, tols)
+
+            svc._engine = flaky
+            fut = svc.submit(h, b, tol=1e-9)
+            svc.pump()              # fails -> re-enqueued with backoff
+            assert not fut.done()
+            svc.pump()              # backoff gate holds it
+            assert not fut.done()
+            t[0] = 1.5
+            svc.pump()              # retry dispatches and succeeds
+            res = fut.result(timeout=5)
+            assert res.status == "CONVERGED" and res.attempts == 2
+            assert svc.stats()["retries"] == 1
+        finally:
+            svc._engine = orig
+            svc.close()
+
+    def test_breakdown_retried_and_typed_distinct_from_error(self):
+        from cuda_mpi_parallel_tpu.serve import RetryPolicy
+
+        a, b = self._problem()
+        svc, t = self._service(
+            max_batch=2, max_wait_s=0.0,
+            retry=RetryPolicy(max_retries=1, backoff_s=0.0))
+        try:
+            h = svc.register(a, inject=FaultPlan(
+                site="spmv", iteration=2, sticky=True))
+            fut = svc.submit(h, b, tol=1e-9)
+            svc.pump()
+            svc.pump()              # the retry fails the same way
+            res = fut.result(timeout=5)
+            assert res.status == "BREAKDOWN"
+            assert res.attempts == 2
+            assert res.failure_kind == "problem"   # not "engine"
+        finally:
+            svc.close()
+
+    def test_breaker_opens_refuses_and_half_open_probes(self):
+        a, b = self._problem()
+        svc, t = self._service(max_batch=1, max_wait_s=0.0,
+                               breaker_threshold=2,
+                               breaker_cooldown_s=5.0)
+        try:
+            h = svc.register(a, inject=FaultPlan(
+                site="reduction", iteration=1, sticky=True))
+            with events.capture() as buf:
+                for _ in range(2):
+                    f = svc.submit(h, b)
+                    svc.pump()
+                    assert f.result(timeout=5).status == "BREAKDOWN"
+                assert svc.breaker_state(h) == "open"
+                refused = svc.submit(h, b).result(timeout=5)
+                assert refused.status == "REFUSED"
+                assert refused.failure_kind == "breaker"
+                t[0] = 6.0          # past cooldown: one probe admitted
+                probe = svc.submit(h, b)
+                assert svc.breaker_state(h) == "half_open"
+                second = svc.submit(h, b).result(timeout=5)
+                assert second.status == "REFUSED"
+                svc.pump()
+                assert probe.result(timeout=5).status == "BREAKDOWN"
+                assert svc.breaker_state(h) == "open"  # probe failed
+            import json
+
+            recs = [json.loads(ln) for ln in
+                    buf.getvalue().splitlines() if ln.strip()]
+            states = [e["state"] for e in recs
+                      if e["event"] == "breaker_transition"]
+            assert states == ["open", "half_open", "open"]
+            assert svc.stats()["refused"] == 2
+        finally:
+            svc.close()
+
+    def test_breaker_closes_on_successful_probe(self):
+        a, b = self._problem()
+        svc, t = self._service(max_batch=1, max_wait_s=0.0,
+                               breaker_threshold=1,
+                               breaker_cooldown_s=5.0)
+        try:
+            h = svc.register(a)
+            orig = svc._engine
+            fails = [1]
+
+            def flaky(handle, b_stack, tols):
+                if fails[0] > 0:
+                    fails[0] -= 1
+                    raise RuntimeError("boom")
+                return orig(handle, b_stack, tols)
+
+            svc._engine = flaky
+            f = svc.submit(h, b)
+            svc.pump()
+            assert f.result(timeout=5).status == "ERROR"
+            assert svc.breaker_state(h) == "open"
+            t[0] = 6.0
+            probe = svc.submit(h, b)
+            svc.pump()
+            assert probe.result(timeout=5).status == "CONVERGED"
+            assert svc.breaker_state(h) == "closed"
+        finally:
+            svc._engine = orig
+            svc.close()
+
+    def test_probe_timeout_releases_breaker_slot(self):
+        """A half-open probe that expires its deadline in queue never
+        dispatched: the probe slot must free so the NEXT submit can
+        probe (a wedged handle would refuse forever)."""
+        a, b = self._problem()
+        svc, t = self._service(max_batch=1, max_wait_s=100.0,
+                               breaker_threshold=1,
+                               breaker_cooldown_s=5.0)
+        try:
+            h = svc.register(a, inject=FaultPlan(
+                site="reduction", iteration=1, sticky=True))
+            f = svc.submit(h, b)
+            svc.pump()
+            assert f.result(timeout=5).status == "BREAKDOWN"
+            assert svc.breaker_state(h) == "open"
+            t[0] = 6.0
+            probe = svc.submit(h, b, deadline_s=1.0)
+            assert svc.breaker_state(h) == "half_open"
+            t[0] = 8.0          # deadline expired before any dispatch
+            svc.pump()
+            assert probe.result(timeout=5).status == "TIMEOUT"
+            # the slot is free: a new submit is admitted as the probe
+            # (queued), not REFUSED
+            probe2 = svc.submit(h, b)
+            svc.pump()
+            assert probe2.result(timeout=5).status == "BREAKDOWN"
+        finally:
+            svc.close()
+
+    def test_degrades_tolerance_under_pressure(self):
+        a, b = self._problem()
+        svc, t = self._service(max_batch=8, max_wait_s=100.0,
+                               degrade_depth=2)
+        try:
+            h = svc.register(a)
+            f1 = svc.submit(h, b, tol=1e-9)
+            f2 = svc.submit(h, b, tol=1e-9)
+            f3 = svc.submit(h, b, tol=1e-9)   # depth >= 2: degraded
+            svc._step(svc._clock(), drain=True)
+            assert not f1.result(5).degraded
+            assert not f2.result(5).degraded
+            r3 = f3.result(5)
+            assert r3.degraded and r3.status == "CONVERGED"
+            assert svc.stats()["degraded"] == 1
+        finally:
+            svc.close()
+
+    def test_submit_rejects_nan_b(self):
+        a, b = self._problem()
+        svc, t = self._service(max_batch=2)
+        try:
+            h = svc.register(a)
+            bad = b.copy()
+            bad[3] = np.nan
+            with pytest.raises(ValueError, match="non-finite"):
+                svc.submit(h, bad)
+        finally:
+            svc.close()
+
+
+class TestZeroPerturbation:
+    """``fault=None`` / ``inject=None`` (the defaults) must leave
+    every solve body jaxpr BIT-identical to a call that never mentions
+    the chaos harness."""
+
+    def test_cg_fault_none_jaxpr_identical(self):
+        a = poisson_2d_csr(8, 8)
+        b = np.ones(64)
+        base = str(jax.make_jaxpr(lambda v: cg(a, v, maxiter=25))(b))
+        off = str(jax.make_jaxpr(
+            lambda v: cg(a, v, maxiter=25, fault=None))(b))
+        assert off == base
+        armed = str(jax.make_jaxpr(
+            lambda v: cg(a, v, maxiter=25,
+                         fault=FaultPlan(site="spmv", iteration=5)))(b))
+        assert armed != base
+
+    def test_cg_many_fault_none_jaxpr_identical(self):
+        from cuda_mpi_parallel_tpu.solver.many import cg_many
+
+        a = poisson_2d_csr(8, 8)
+        b = np.ones((64, 3))
+        base = str(jax.make_jaxpr(
+            lambda v: cg_many(a, v, maxiter=25))(b))
+        off = str(jax.make_jaxpr(
+            lambda v: cg_many(a, v, maxiter=25, fault=None))(b))
+        assert off == base
+        armed = str(jax.make_jaxpr(
+            lambda v: cg_many(a, v, maxiter=25,
+                              fault=FaultPlan(site="reduction",
+                                              iteration=5)))(b))
+        assert armed != base
+
+    @needs_mesh
+    def test_distributed_solve_body_jaxpr_identical(self,
+                                                    fixture_problem):
+        """inject=None and the resumable machinery OFF leave the
+        traced distributed solve body bit-identical to pre-PR (the
+        same capture mechanism as test_exchange's zero-perturbation
+        proof)."""
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+        from cuda_mpi_parallel_tpu.telemetry import (
+            shardscope as ss,
+        )
+
+        a, b = fixture_problem
+        mesh = make_mesh(4)
+
+        def traced_jaxpr(**kw):
+            dist_cg.clear_solver_cache()
+            captured = {}
+            orig = dist_cg._cached_solver
+
+            def wrapper(key, build, cost_ctx=None, cost_args=None):
+                captured["jaxpr"] = jax.make_jaxpr(build())(*cost_args)
+                return orig(key, build, cost_ctx, cost_args)
+
+            dist_cg._cached_solver = wrapper
+            try:
+                dist_cg.solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                          maxiter=500, **kw)
+            finally:
+                ss.reset_last_shard_report()
+                dist_cg._cached_solver = orig
+                dist_cg.clear_solver_cache()
+            return str(captured["jaxpr"])
+
+        legacy = traced_jaxpr()
+        explicit_off = traced_jaxpr(inject=None)
+        assert explicit_off == legacy
+        validated = traced_jaxpr(validate=True)
+        assert validated == legacy
+        armed = traced_jaxpr(
+            inject=FaultPlan(site="spmv", iteration=10))
+        assert armed != legacy
+        # the resumable lane genuinely changes the program too (extra
+        # in/outputs), under its own cache key
+        capped = traced_jaxpr(iter_cap=50)
+        assert capped != legacy

@@ -144,6 +144,17 @@ METRIC_DIRECTION = {
     "phase.reduction_share": None,
     "phase.spmv_stall_factor": None,
     "phase.explained_fraction": None,
+    # robustness columns (robust/): the armed-FaultPlan in-loop
+    # overhead, breakdown detection latency, and wall time/overhead of
+    # an injected-fault recovery on the mesh-4 fixture.  Reported,
+    # never gated - overheads track host scheduling weather, and
+    # pre-robustness files simply lack them (rendered n/a).
+    "robust.guarded_iters_per_sec": None,
+    "robust.armed_iters_per_sec": None,
+    "robust.armed_overhead_pct": None,
+    "robust.detection_latency_iters": None,
+    "robust.time_to_recover_s": None,
+    "robust.recovery_overhead_pct": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -196,6 +207,9 @@ _NESTED = {
               "reduction_s_per_iter", "halo_share", "spmv_share",
               "reduction_share", "spmv_stall_factor",
               "explained_fraction"),
+    "robust": ("guarded_iters_per_sec", "armed_iters_per_sec",
+               "armed_overhead_pct", "detection_latency_iters",
+               "time_to_recover_s", "recovery_overhead_pct"),
 }
 
 

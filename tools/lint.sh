@@ -412,6 +412,73 @@ print(f"phasetrace gate: halo {shares['halo_s'] * 1e6:.1f}us + spmv "
 PY
 echo "phasetrace gate: clean"
 
+# Chaos gate: deterministic fault injection + self-healing end-to-end
+# on the committed skewed fixture - a mesh-4 CLI solve with a NaN
+# injected into the halo payload at iteration 10 (--inject halo:10)
+# and bounded-restart recovery (--recover) must (a) emit schema-valid
+# solve_fault + solve_recovery events, (b) finish CONVERGED with the
+# recovery record saying so, and (c) produce a solution within 1e-5 of
+# the fault-free run's (saved via --save-x).  The no-FaultPlan
+# jaxpr-bit-identity proof lives in tests/test_robust.py.
+echo "== chaos gate (mesh-4 CLI: --inject halo:10 --recover) =="
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --device cpu --tol 1e-8 --maxiter 500 --json \
+    --save-x "$scratch/chaos_clean.npy" \
+    > "$scratch/chaos_clean.json"
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --device cpu --tol 1e-8 --maxiter 500 --json \
+    --inject halo:10 --recover \
+    --save-x "$scratch/chaos_rec.npy" \
+    --trace-events "$scratch/chaos_events.jsonl" \
+    > "$scratch/chaos_rec.json"
+python tools/validate_trace.py "$scratch/chaos_events.jsonl"
+python - "$scratch" <<'PY'
+import json
+import sys
+
+import numpy as np
+
+scratch = sys.argv[1]
+with open(f"{scratch}/chaos_rec.json") as f:
+    rec = json.load(f)
+with open(f"{scratch}/chaos_clean.json") as f:
+    clean = json.load(f)
+events = [json.loads(ln)
+          for ln in open(f"{scratch}/chaos_events.jsonl")
+          if ln.strip()]
+
+assert clean["status"] == "CONVERGED", clean["status"]
+assert rec["status"] == "CONVERGED", \
+    f"injected run did not recover: {rec['status']}"
+assert rec["fault"]["site"] == "halo", rec["fault"]
+recovery = rec["recovery"]
+assert recovery["recovered"] and recovery["restarts"] >= 1, recovery
+assert recovery["faults"], recovery
+# detection latency: the fault fired at iteration 10, the health
+# predicate must catch it within one check_every(=1) block
+det = recovery["faults"][0]["iteration"]
+assert 10 <= det <= 11, f"breakdown detected at {det}, injected at 10"
+
+faults = [e for e in events if e["event"] == "solve_fault"]
+recovs = [e for e in events if e["event"] == "solve_recovery"]
+assert faults, "no solve_fault event emitted"
+assert any(e["site"] == "halo" for e in faults), faults
+assert any(e["action"] == "restart" for e in recovs), recovs
+assert any(e["action"] == "recovered" for e in recovs), recovs
+
+x_clean = np.load(f"{scratch}/chaos_clean.npy")
+x_rec = np.load(f"{scratch}/chaos_rec.npy")
+err = float(np.max(np.abs(x_clean - x_rec)))
+assert err < 1e-5, f"recovered solution off by {err}"
+print(f"chaos gate: fault at iter 10 detected at iter {det}, "
+      f"{recovery['restarts']} restart(s), recovered solution within "
+      f"{err:.1e} of the fault-free run; {len(faults)} solve_fault + "
+      f"{len(recovs)} solve_recovery events schema-valid")
+PY
+echo "chaos gate: clean"
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
