@@ -790,6 +790,7 @@ SECTION_PRIORITY = [
     "distributed",
     "many_rhs",                            # batched-RHS amortization
     "serve",                               # solver-service replay
+    "recycle",                             # Krylov-recycling iters/solve
     "robust",                              # chaos guard + recovery
     "unstructured",
     "poisson2d_1M_csr",                    # ~92 ms/iter gather: last
@@ -1786,6 +1787,74 @@ def bench_all(results, sections=None) -> None:
         results["robust"] = entry
 
     registry.append(("robust", s_robust))
+
+    # 9: Krylov recycling (solver.recycle, ROADMAP item 2): the
+    # iters/solve trajectory of a replayed repeat-traffic workload -
+    # fresh right-hand sides against one operator, solve 1 harvests,
+    # later solves deflate and keep accumulating - on the committed
+    # skewed fixture AND a Poisson operator, plus the harvest's host
+    # overhead as a fraction of the solve wall.  Reported by
+    # bench_compare, never gated here (the lint gate's recycle replay
+    # asserts the strict final<first drop); never-sink-the-run.
+    def s_recycle():
+        from cuda_mpi_parallel_tpu.models import mmio
+        from cuda_mpi_parallel_tpu.solver.recycle import (
+            recycled_sequence,
+        )
+
+        def trajectory(a_op, tol, repeats=6, k=8):
+            n = int(a_op.shape[0])
+            rng = np.random.default_rng(23)
+            rhs = [rng.standard_normal(n).astype(np.float32)
+                   for _ in range(repeats)]
+            seq = recycled_sequence(
+                a_op, rhs[0], repeats=repeats, k=k, maxiter=2000,
+                tol=tol, rhs_for=lambda i: rhs[i])
+            return seq.summary()
+
+        # f32 (bench runs without x64) - tolerances at the f32
+        # attainable-accuracy bar of each operator
+        a_skew = mmio.load_matrix_market(
+            "tests/fixtures/skewed_spd_240.mtx")
+        skew = trajectory(a_skew, tol=1e-5, k=12)
+        a_poi = poisson.poisson_2d_csr(32, 32, dtype=np.float32)
+        poi = trajectory(a_poi, tol=1e-4, k=8)
+        entry = {
+            "n": int(a_skew.shape[0]),
+            "tol": 1e-5,
+            "measurement": "iterations_per_solve",
+            "iterations": skew["final_solve_iterations"],
+            "converged": all(sv["converged"] for sv in skew["solves"])
+            and all(sv["converged"] for sv in poi["solves"]),
+            "note": "fresh-RHS repeat traffic; solve 1 harvests, "
+                    "later solves deflate (skewed fixture mesh-free "
+                    "single-device + 32^2 Poisson)",
+            "recycle": {
+                "first_solve_iters_skewed":
+                    skew["first_solve_iterations"],
+                "final_solve_iters_skewed":
+                    skew["final_solve_iterations"],
+                "iters_trajectory_skewed": skew["iterations"],
+                "first_solve_iters_poisson":
+                    poi["first_solve_iterations"],
+                "final_solve_iters_poisson":
+                    poi["final_solve_iterations"],
+                "iters_trajectory_poisson": poi["iterations"],
+                "iters_saved_pct_skewed": round(
+                    100.0 * skew["iters_saved"]
+                    / max(skew["first_solve_iterations"], 1), 2),
+                "iters_saved_pct_poisson": round(
+                    100.0 * poi["iters_saved"]
+                    / max(poi["first_solve_iterations"], 1), 2),
+                "harvest_overhead_pct_skewed":
+                    skew["harvest_overhead_pct"],
+                "harvest_overhead_pct_poisson":
+                    poi["harvest_overhead_pct"],
+            },
+        }
+        results["recycle"] = entry
+
+    registry.append(("recycle", s_recycle))
 
     known = {name for name, _ in registry}
     if sections:

@@ -197,6 +197,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "ride the 'replan' telemetry event and the "
                         "report's calibration section.  Composes with "
                         "--plan (the first solve's layout)")
+    p.add_argument("--recycle", nargs="?", const=0, default=None,
+                   type=int, metavar="K",
+                   help="Krylov-subspace recycling across --repeat "
+                        "solves (solver.recycle): solve 1 carries the "
+                        "basis ring + stride-1 flight recorder and "
+                        "harvests a K-dimensional Ritz space (bare "
+                        "flag: K=8); solves 2..N deflate with it and "
+                        "keep accumulating, so measured iters/solve "
+                        "falls every solve.  Needs --repeat >= 2 and "
+                        "--mesh > 1 on an assembled-CSR problem; "
+                        "conflicts with --replan (the space is pinned "
+                        "to one partition layout)")
     p.add_argument("--rhs", type=int, default=1, metavar="K",
                    help="solve K right-hand sides as one column-stacked "
                         "batch (solver.many): one matrix sweep and one "
@@ -646,6 +658,53 @@ def main(argv=None) -> int:
             raise SystemExit(
                 "--precond bjacobi is single-device only (use jacobi "
                 "or chebyshev with --mesh)")
+
+    # Krylov recycling (--recycle): the repeat-solve deflation loop.
+    # Same never-silently-drop rule as every other flag: any path that
+    # cannot carry the basis ring or the deflated recurrence refuses
+    # loudly here.
+    if args.recycle is not None:
+        if args.recycle < 0:
+            raise SystemExit(f"--recycle K must be >= 0, got "
+                             f"{args.recycle} (0/bare flag = the "
+                             f"default space dimension)")
+        if args.repeat < 2:
+            raise SystemExit(
+                "--recycle needs --repeat >= 2 (solve 1 harvests the "
+                "space a later solve deflates with; a single solve "
+                "has nothing to recycle into)")
+        if args.replan:
+            raise SystemExit(
+                "--recycle with --replan is unsupported (the "
+                "harvested space lives in one partition layout; a "
+                "replan that switched layouts would invalidate it "
+                "mid-sequence)")
+        if args.method != "cg":
+            raise SystemExit(
+                f"--recycle rides --method cg only (got "
+                f"{args.method}): the deflation projects the textbook "
+                f"direction recurrence")
+        if args.rhs > 1:
+            raise SystemExit(
+                "--recycle with --rhs is unsupported on the CLI (the "
+                "serve subcommand's --recycle is the many-RHS "
+                "recycling lane)")
+        if args.inject is not None or args.recover is not None:
+            raise SystemExit(
+                "--recycle with --inject/--recover is unsupported (a "
+                "poisoned solve must not seed the recycled space)")
+        if args.csr_comm in ("ring", "ring-shiftell") \
+                or args.exchange == "ring":
+            raise SystemExit(
+                "--recycle needs the allgather/gather halo wires "
+                "(the ring schedules carry neither the sharded "
+                "projection operands nor the basis ring)")
+        if args.flight_record is not None and args.flight_record != 1:
+            raise SystemExit(
+                f"--recycle needs a stride-1 flight record (got "
+                f"--flight-record {args.flight_record}): the harvest "
+                f"assembles the Lanczos tridiagonal from consecutive "
+                f"alpha/beta rows")
 
     # Phase profiling (--phase-profile): the measured per-shard
     # per-phase timing runs on the general distributed CSR lanes only
@@ -1366,6 +1425,7 @@ def main(argv=None) -> int:
         return run_inner()
 
     seq = None
+    rseq = None
     with tsession.observe_solve(
             desc, engine=args.engine, check_every=args.check_every,
             profile_dir=args.profile, problem=args.problem,
@@ -1373,7 +1433,30 @@ def main(argv=None) -> int:
             mesh=args.mesh,
             device=jax.devices()[0].platform) as obs:
         with obs.section("solve"):
-            if args.repeat > 1:
+            if args.recycle is not None:
+                # the Krylov-recycling sequence: solve 1 harvests,
+                # solves 2..N deflate and keep accumulating; the
+                # reported record/timing is the FINAL (most-deflated)
+                # solve's
+                from .parallel import make_mesh as _mm
+                from .solver.recycle import DEFAULT_K, recycled_sequence
+
+                rseq = recycled_sequence(
+                    a, b, mesh=_mm(args.mesh), repeats=args.repeat,
+                    k=args.recycle or DEFAULT_K,
+                    maxiter=args.maxiter, tol=args.tol,
+                    rtol=args.rtol, preconditioner=args.precond,
+                    precond_degree=args.precond_degree,
+                    record_history=args.history,
+                    check_every=args.check_every,
+                    csr_comm=args.csr_comm, exchange=args.exchange,
+                    plan=plan_obj,
+                    # validated once pre-dispatch (same rule as the
+                    # calibrate sequence)
+                    validate=False)
+                elapsed = rseq.entries[-1].elapsed_s
+                result = rseq.result
+            elif args.repeat > 1:
                 # the calibrate-and-replan sequence loop: each solve is
                 # warmup+timed inside solve_sequence (same protocol as
                 # the time_fn below); the reported record/timing is the
@@ -1646,6 +1729,8 @@ def main(argv=None) -> int:
     # still gets its predicted-vs-measured drift tracked against the
     # model that scored its plan.  Host-side fusion only - the solve is
     # already complete and synced.
+    if rseq is not None:
+        record["recycle"] = ulog.sanitize(rseq.summary())
     calib_entry = None
     if seq is not None:
         calib_entry = ulog.sanitize(seq.summary())
@@ -1810,6 +1895,9 @@ def main(argv=None) -> int:
         if seq is not None:
             for line in seq.describe_lines():
                 print(line)
+        if rseq is not None:
+            for line in rseq.describe_lines():
+                print(f"recycle : {line}")
         if phase_profile_obj is not None:
             from .telemetry.report import phase_lines as _phase_lines
 

@@ -79,6 +79,8 @@ def solve_distributed(
     iter_cap: Optional[int] = None,
     inject=None,
     validate: bool = True,
+    deflate=None,
+    basis=None,
 ) -> CGResult:
     """Solve the global system A x = b row-partitioned over a device mesh.
 
@@ -171,6 +173,22 @@ def solve_distributed(
         non-finite input raises ``ValueError`` instead of spinning a
         poisoned recurrence to its first health check.  ``False``
         opts out (chaos staging).
+      deflate: optional ``solver.recycle.RecycleSpace`` - Krylov-
+        recycling deflation.  The space lives in the CALLER's global
+        row ordering; this entry point applies the plan permutation
+        and row padding to ``W``/``AW`` exactly as it does to ``b``
+        and shards them over the mesh, so the in-loop projections are
+        local matmuls plus the ONE fused psum the deflated ``cg`` lane
+        issues (per-iteration collective count unchanged).  A space
+        harvested from a different operator raises a typed
+        ``RecycleMismatch`` - never a silent wrong-space deflation.
+        CSR allgather/gather lanes with ``method="cg"`` only.
+      basis: optional ``solver.recycle.BasisConfig`` - carry the
+        recycling harvest ring (requires a stride-1 ``flight``); the
+        returned ``result.basis`` vectors are unpadded/unpermuted back
+        to the caller's row ordering like ``x``, so
+        ``recycle.harvest_space(a, result)`` works on the GLOBAL
+        operator.  Same lane scope as ``deflate``.
       (tol/rtol/maxiter/record_history/check_every/compensated as in
       ``solver.cg``.)
 
@@ -226,6 +244,47 @@ def solve_distributed(
             from ..robust.validate import check_finite_rhs
 
             check_finite_rhs(x0, what="x0")
+    if deflate is not None or basis is not None:
+        from ..solver.recycle import BasisConfig, RecycleSpace, check_space
+
+        feature = "deflate= (Krylov recycling)" if deflate is not None \
+            else "basis= (the recycling harvest ring)"
+        if not isinstance(a, CSRMatrix) or csr_comm != "allgather" \
+                or exchange == "ring":
+            raise ValueError(
+                f"{feature} rides the assembled-CSR allgather/gather "
+                f"lanes only (got {type(a).__name__}, csr_comm="
+                f"{csr_comm!r}, exchange={exchange!r}): the ring/"
+                f"shiftell schedules and stencil slabs carry neither "
+                f"the sharded projection operands nor the basis ring)")
+        if method != "cg":
+            raise ValueError(
+                f"{feature} requires method='cg' (got {method!r})")
+        if inject is not None:
+            raise ValueError(
+                f"{feature} with fault injection is unsupported (the "
+                f"chaos harness drills the undeflated recurrence)")
+        if x0 is not None or resume_from is not None \
+                or return_checkpoint or iter_cap is not None:
+            raise ValueError(
+                f"{feature} does not compose with checkpoint/resume "
+                f"(x0/resume_from/return_checkpoint/iter_cap)")
+        if deflate is not None:
+            if not isinstance(deflate, RecycleSpace):
+                raise TypeError(
+                    f"deflate must be a solver.recycle.RecycleSpace, "
+                    f"got {type(deflate).__name__}")
+            check_space(deflate, a)     # typed RecycleMismatch
+        if basis is not None:
+            if not isinstance(basis, BasisConfig):
+                raise TypeError(
+                    f"basis must be a solver.recycle.BasisConfig, "
+                    f"got {type(basis).__name__}")
+            if flight is None:
+                raise ValueError(
+                    "basis= needs flight= (a stride-1 FlightConfig): "
+                    "the harvest combines the ring with the "
+                    "recorder's alpha/beta tridiagonal)")
     resumable = (x0 is not None or resume_from is not None
                  or return_checkpoint or iter_cap is not None)
     if inject is not None or resumable:
@@ -299,13 +358,15 @@ def solve_distributed(
                                                          exchange))
         if inject is not None:
             kw["fault"] = inject
+        if basis is not None:
+            kw["basis"] = basis
         note()
         return _solve_csr(a, b, mesh, axis, n_shards, precond,
                           record_history, kw, csr_comm=csr_comm,
                           plan=plan, exchange=exchange, x0=x0,
                           resume_from=resume_from,
                           return_checkpoint=return_checkpoint,
-                          iter_cap=iter_cap)
+                          iter_cap=iter_cap, deflate=deflate)
     raise TypeError(f"solve_distributed supports CSRMatrix/Stencil2D/"
                     f"Stencil3D, got {type(a).__name__}")
 
@@ -368,6 +429,26 @@ _LAST_COMM_COST = [None]
 #: Python only during tracing) - lets tests assert zero-retrace on public
 #: surface instead of poking jit internals
 _TRACE_COUNT = [0]
+
+
+#: callables invoked (outside the cache lock) with each evicted cache
+#: key: consumers holding state that RIDES a compiled solver - the
+#: serve tier's per-handle RecycleSpace - drop it when the solver goes
+#: (ROADMAP item 2: the space "rides the existing LRU solver cache,
+#: evicted together")
+_EVICT_LISTENERS: list = []
+
+
+def add_evict_listener(fn) -> None:
+    """Register ``fn(key)`` to be called for every LRU eviction."""
+    _EVICT_LISTENERS.append(fn)
+
+
+def remove_evict_listener(fn) -> None:
+    try:
+        _EVICT_LISTENERS.remove(fn)
+    except ValueError:
+        pass
 
 
 def clear_solver_cache() -> None:
@@ -467,6 +548,8 @@ def _cached_solver(key, build, cost_ctx=None, cost_args=None):
             telemetry.events.emit("dist_cache_evict",
                                   key=_key_id(evicted), kind=evicted[0],
                                   cap=cap)
+            for listener in list(_EVICT_LISTENERS):
+                listener(evicted)
     if cost_args is not None and telemetry.active():
         solve_cost = _COST_CACHE.get(key)
         if solve_cost is None:
@@ -655,14 +738,18 @@ def _make_precond(precond, local, axis):
 
 
 def _result_specs(axis: str, record_history: bool,
-                  flight=None) -> CGResult:
+                  flight=None, basis=None) -> CGResult:
     """out_specs pytree: x row-sharded, every scalar replicated (the
-    flight buffer records psum'd scalars, so it is replicated too)."""
+    flight buffer records psum'd scalars, so it is replicated too; the
+    recycling basis ring's iteration column is replicated while its
+    vector rows are sharded on their SECOND axis - each shard holds
+    its local rows of every recorded residual)."""
     return CGResult(
         x=P(axis), iterations=P(), residual_norm=P(), converged=P(),
         status=P(), indefinite=P(),
         residual_history=P() if record_history else None,
         flight=P() if flight is not None else None,
+        basis=(P(), P(None, axis)) if basis is not None else None,
     )
 
 
@@ -768,6 +855,10 @@ def _shard_padded_rhs(b, parts, mesh, axis):
 def _strip_row_padding(res: CGResult, parts) -> CGResult:
     if parts.n_global != parts.n_global_padded:
         res = dataclasses.replace(res, x=res.x[: parts.n_global])
+        if res.basis is not None:
+            its, vecs = res.basis
+            res = dataclasses.replace(
+                res, basis=(its, vecs[:, : parts.n_global]))
     return res
 
 
@@ -785,7 +876,12 @@ def _unpad_result(res: CGResult, parts, plan) -> CGResult:
     if parts.row_ranges is None:
         return _strip_row_padding(res, parts)
     idx = _plan_unpad_indices(parts, plan)
-    return dataclasses.replace(res, x=res.x[jnp.asarray(idx)])
+    res = dataclasses.replace(res, x=res.x[jnp.asarray(idx)])
+    if res.basis is not None:
+        its, vecs = res.basis
+        res = dataclasses.replace(
+            res, basis=(its, vecs[:, jnp.asarray(idx)]))
+    return res
 
 
 def _ckpt_specs(axis: str) -> CGCheckpoint:
@@ -795,11 +891,27 @@ def _ckpt_specs(axis: str) -> CGCheckpoint:
                         rr=P(), nrm0=P(), k=P(), indefinite=P())
 
 
+def _prepare_deflate(space, parts, plan, mesh, axis):
+    """Device-side operands of a deflated distributed solve: the
+    space's ``W``/``AW`` pushed through the SAME permute/pad/shard
+    pipeline as ``b`` (one definition of the padded row layout), the
+    Cholesky factor replicated.  Padding rows multiply zero rows of
+    ``W`` - inert in every projection."""
+    w = np.asarray(space.w)
+    aw = np.asarray(space.aw)
+    if plan is not None and plan.permutation is not None:
+        w = w[plan.permutation]
+        aw = aw[plan.permutation]
+    return (_shard_padded_rhs(w, parts, mesh, axis),
+            _shard_padded_rhs(aw, parts, mesh, axis),
+            jnp.asarray(space.chol))
+
+
 def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
                kw, csr_comm: str = "allgather", plan=None,
                exchange=None, x0=None, resume_from=None,
                return_checkpoint: bool = False,
-               iter_cap=None) -> CGResult:
+               iter_cap=None, deflate=None) -> CGResult:
     if csr_comm == "ring-shiftell":
         return _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
                                    record_history, kw, plan=plan)
@@ -837,6 +949,10 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
     key = ("csr", ring, resolved, geometry, n_local, n_shards, axis,
            mesh, precond, record_history, tuple(sorted(kw.items())),
            plan.fingerprint() if plan is not None else None)
+    if deflate is not None:
+        # the executable depends on the space's SHAPE only - a
+        # refreshed same-k space reuses the compiled deflated solver
+        key = key + (("deflate", int(deflate.k)),)
     if resumable:
         # the extended build below has a different signature/out tree;
         # an un-extended call keeps its pre-extension key (and hence
@@ -872,17 +988,37 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
     if has_cap:
         extras = extras + (jnp.asarray(int(iter_cap), jnp.int32),)
 
+    if deflate is not None:
+        w_sh, aw_sh, chol_rep = _prepare_deflate(deflate, parts, plan,
+                                                 mesh, axis)
+        space_k, space_n = int(deflate.k), int(deflate.n)
+        space_layout = deflate.layout
+
     def build():
         n_args = 5 if gather else 4
 
         if not resumable:
+            dspecs = (P(axis), P(axis), P()) if deflate is not None \
+                else ()
+
             @partial(shard_map, mesh=mesh,
-                     in_specs=(P(axis),) * n_args,
+                     in_specs=(P(axis),) * n_args + dspecs,
                      out_specs=_result_specs(axis, record_history,
-                                              kw.get("flight")))
-            def run(b_local, data_s, cols_s, rows_s, send_s=()):
+                                              kw.get("flight"),
+                                              kw.get("basis")))
+            def run(b_local, data_s, cols_s, rows_s, *rest):
                 _TRACE_COUNT[0] += 1
                 strip = partial(jax.tree.map, lambda v: v[0])
+                rest = list(rest)
+                send_s = rest.pop(0) if gather else ()
+                space = None
+                if deflate is not None:
+                    from ..solver.recycle import RecycleSpace
+
+                    w_l, aw_l, chol_l = rest
+                    space = RecycleSpace(
+                        w=w_l, aw=aw_l, chol=chol_l, n=space_n,
+                        k=space_k, layout=space_layout)
                 if gather:
                     op = DistCSRGather(
                         data=strip(data_s), cols=strip(cols_s),
@@ -896,7 +1032,7 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
                                 axis_name=axis, n_shards=n_shards)
                 m = _make_precond(precond, op, axis)
                 return cg(op, b_local, m=m, record_history=record_history,
-                          axis_name=axis, **kw)
+                          axis_name=axis, deflate=space, **kw)
             return run
 
         in_specs = (P(axis),) * n_args
@@ -947,7 +1083,10 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
         ctx["halo_padding_fraction"] = round(sched.padding_fraction(), 6)
         ctx["halo_wire_bytes_per_matvec"] = \
             sched.wire_bytes_per_matvec(itemsize)
+    if deflate is not None:
+        ctx["deflate_k"] = int(deflate.k)
     args = (b_dev, data, cols, rows) + ((send,) if gather else ()) \
+        + ((w_sh, aw_sh, chol_rep) if deflate is not None else ()) \
         + extras
     res = _cached_solver(key, build, ctx, args)(*args)
     return _unpad_result(res, parts, plan)
@@ -1017,17 +1156,20 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
 
 
 def _result_specs_many(axis: str, flight=None,
-                       fallback: bool = False) -> "CGBatchResult":
+                       fallback: bool = False,
+                       basis=None) -> "CGBatchResult":
     """out_specs for a shard_map'd cg_many: the solution stack row-
     sharded, every per-lane array replicated (their reductions were
-    psum'd)."""
+    psum'd; the basis ring's vector rows are sharded on their second
+    axis, like the single-RHS specs)."""
     from ..solver.many import CGBatchResult
 
     return CGBatchResult(
         x=P(axis), iterations=P(), residual_norm=P(), converged=P(),
         status=P(), indefinite=P(),
         flight=P() if flight is not None else None,
-        fallback=P() if fallback else None)
+        fallback=P() if fallback else None,
+        basis=(P(), P(None, axis)) if basis is not None else None)
 
 
 class ManyRHSDispatcher:
@@ -1129,6 +1271,15 @@ class ManyRHSDispatcher:
         self.resolved_exchange = ("gather"
                                   if self.parts.halo is not None
                                   else "allgather")
+        # Krylov recycling: the operator's layout token (computed
+        # lazily on the first deflated/harvest dispatch) and a
+        # single-slot cache of the last space's permuted/padded/
+        # sharded operands - the serve tier refreshes one space per
+        # handle, so one slot amortizes every dispatch between
+        # refreshes
+        self._space_layout_token = None
+        self._deflate_slot = (None, None)
+        self._a_for_layout = a
         _note_partition(ap, self.parts, self.plan)
         self._data = _shard_tree(self.parts.data, mesh, self.axis)
         self._cols = _shard_tree(self.parts.cols, mesh, self.axis)
@@ -1152,10 +1303,51 @@ class ManyRHSDispatcher:
             self.plan.fingerprint() if self.plan is not None else None,
         ) + ((inject,) if inject is not None else ())
 
-    def solve(self, b, *, tol=1e-7, rtol=0.0):
+    def space_layout_token(self) -> str:
+        """The ``recycle.space_layout`` token of the operator this
+        dispatcher was built for (cached - the fingerprint walk is
+        O(nnz))."""
+        if self._space_layout_token is None:
+            from ..solver.recycle import space_layout
+
+            self._space_layout_token = space_layout(self._a_for_layout)
+        return self._space_layout_token
+
+    def _deflate_operands(self, space):
+        """Permute/pad/shard a RecycleSpace's operands for this
+        partition (single-slot cached per space object)."""
+        cached_space, operands = self._deflate_slot
+        if cached_space is space:
+            return operands
+        if space.layout != self.space_layout_token():
+            from ..solver.recycle import RecycleMismatch
+
+            raise RecycleMismatch(
+                f"RecycleSpace layout {space.layout!r} does not match "
+                f"this dispatcher's operator "
+                f"({self.space_layout_token()!r}): harvest a space "
+                f"from THIS operator (never a wrong-space deflation)")
+        operands = _prepare_deflate(space, self.parts, self.plan,
+                                    self.mesh, self.axis)
+        self._deflate_slot = (space, operands)
+        return operands
+
+    def solve(self, b, *, tol=1e-7, rtol=0.0, deflate=None,
+              basis=None, flight=None):
         """One batched solve of ``A X = B`` on the prepared partition
         (``B (n, k)``; see :func:`solve_distributed_many` for the
-        result contract)."""
+        result contract).
+
+        ``deflate``/``basis`` are the Krylov-recycling lanes
+        (``solver.recycle``): a ``RecycleSpace`` deflates this
+        dispatch (operands prepared once per space and cached), a
+        ``BasisConfig`` carries the harvest ring.  ``flight``
+        OVERRIDES the construction-time recorder for this dispatch
+        only (how the serve tier turns recorders on for its harvest
+        dispatches without rebuilding the partition) - the override
+        joins the solver-cache key, so recorder-on and recorder-off
+        dispatches keep distinct compiled solvers.
+        """
         from ..solver.cg import _note_engine
         from ..solver.many import cg_many
 
@@ -1175,10 +1367,49 @@ class ManyRHSDispatcher:
         if not np.issubdtype(b_np.dtype, np.floating):
             b_np = b_np.astype(np.result_type(float))
         n_rhs = int(b_np.shape[1])
+        flight_override = flight is not None
+        eff_flight = (flight.without_heartbeat() if flight_override
+                      else self.flight)
+        if basis is not None:
+            from ..solver.recycle import BasisConfig
+
+            if not isinstance(basis, BasisConfig):
+                raise TypeError(
+                    f"basis must be a solver.recycle.BasisConfig, got "
+                    f"{type(basis).__name__}")
+            if self.method != "batched":
+                raise ValueError(
+                    "basis= (the recycling harvest ring) needs "
+                    "method='batched'")
+            if eff_flight is None:
+                raise ValueError(
+                    "basis= needs a flight recorder (construct the "
+                    "dispatcher with flight=, or pass flight= to this "
+                    "dispatch)")
+        if deflate is not None:
+            if self.method != "batched":
+                raise ValueError(
+                    "deflate= (Krylov recycling) needs "
+                    "method='batched' (block-CG deflates rank "
+                    "collapse in-lane)")
+            if self.inject is not None:
+                raise ValueError(
+                    "deflate= on a fault-injected dispatcher is "
+                    "unsupported (the chaos harness drills the "
+                    "undeflated recurrence)")
+            from ..solver.recycle import RecycleSpace
+
+            if not isinstance(deflate, RecycleSpace):
+                raise TypeError(
+                    f"deflate must be a solver.recycle.RecycleSpace, "
+                    f"got {type(deflate).__name__}")
+            w_sh, aw_sh, chol_rep = self._deflate_operands(deflate)
         _note_engine("distributed-many", self.method, self.check_every,
                      n_shards=self.n_shards, n_rhs=n_rhs,
-                     **({"flight_stride": self.flight.stride}
-                        if self.flight is not None else {}))
+                     **({"flight_stride": eff_flight.stride}
+                        if eff_flight is not None else {}),
+                     **({"deflate_k": deflate.k}
+                        if deflate is not None else {}))
         if self._perm is not None:
             b_np = b_np[self._perm]
         b_dev = _shard_padded_rhs(b_np, self.parts, self.mesh,
@@ -1187,24 +1418,47 @@ class ManyRHSDispatcher:
         rtol_dev = jnp.asarray(rtol, b_np.dtype)
         mesh, axis, gather = self.mesh, self.axis, self._gather
         n_local, n_shards = self.parts.n_local, self.n_shards
-        shifts, flight, method = self._shifts, self.flight, self.method
+        shifts, flight, method = self._shifts, eff_flight, self.method
         preconditioner = self.preconditioner
         maxiter, check_every = self.maxiter, self.check_every
         compensated = self.compensated
         fault = self.inject
         key = self._key_base + (n_rhs,)
+        if flight_override:
+            key = key + (("flight_override", flight),)
+        if basis is not None:
+            key = key + (("basis", basis),)
+        if deflate is not None:
+            key = key + (("deflate", int(deflate.k)),)
+            space_k, space_n = int(deflate.k), int(deflate.n)
+            space_layout_tok = deflate.layout
+
+        deflated = deflate is not None
+        basis_cfg = basis
 
         def build():
             specs = (P(axis),) * 4 + (P(), P()) \
-                + ((P(axis),) if gather else ())
+                + ((P(axis),) if gather else ()) \
+                + ((P(axis), P(axis), P()) if deflated else ())
 
             @partial(shard_map, mesh=mesh, in_specs=specs,
                      out_specs=_result_specs_many(
-                         axis, flight, fallback=method == "block"))
+                         axis, flight, fallback=method == "block",
+                         basis=basis_cfg))
             def run(b_local, data_s, cols_s, rows_s, tol_s, rtol_s,
-                    send_s=()):
+                    *rest):
                 _TRACE_COUNT[0] += 1
                 strip = partial(jax.tree.map, lambda v: v[0])
+                rest = list(rest)
+                send_s = rest.pop(0) if gather else ()
+                space = None
+                if deflated:
+                    from ..solver.recycle import RecycleSpace
+
+                    w_l, aw_l, chol_l = rest
+                    space = RecycleSpace(
+                        w=w_l, aw=aw_l, chol=chol_l, n=space_n,
+                        k=space_k, layout=space_layout_tok)
                 if gather:
                     op = DistCSRGather(
                         data=strip(data_s), cols=strip(cols_s),
@@ -1223,7 +1477,8 @@ class ManyRHSDispatcher:
                                maxiter=maxiter, m=m, axis_name=axis,
                                check_every=check_every, method=method,
                                compensated=compensated, flight=flight,
-                               fault=fault)
+                               fault=fault, deflate=space,
+                               basis=basis_cfg)
             return run
 
         ctx = dict(kind="csr-gather-many" if gather else "csr-many",
@@ -1241,8 +1496,11 @@ class ManyRHSDispatcher:
             # per-matvec wire scales by n_rhs, amortized per solve 1/k
             ctx["halo_wire_bytes_per_matvec"] = \
                 sched.wire_bytes_per_matvec(itemsize) * n_rhs
+        if deflated:
+            ctx["deflate_k"] = int(deflate.k)
         args = (b_dev, self._data, self._cols, self._rows, tol_dev,
-                rtol_dev) + ((self._send,) if gather else ())
+                rtol_dev) + ((self._send,) if gather else ()) \
+            + ((w_sh, aw_sh, chol_rep) if deflated else ())
         res = _cached_solver(key, build, ctx, args)(*args)
         return _unpad_result_many(res, self.parts, self.plan)
 
@@ -1299,13 +1557,23 @@ def solve_distributed_many(
 
 def _unpad_result_many(res, parts, plan):
     """``_unpad_result`` over a solution STACK (rows of ``x`` are
-    gathered; the per-lane arrays pass through)."""
+    gathered; the per-lane arrays pass through; the basis ring's
+    vector rows follow ``x``'s gather back to the caller's order)."""
     if parts.row_ranges is None:
         if parts.n_global != parts.n_global_padded:
             res = dataclasses.replace(res, x=res.x[: parts.n_global])
+            if res.basis is not None:
+                its, vecs = res.basis
+                res = dataclasses.replace(
+                    res, basis=(its, vecs[:, : parts.n_global]))
         return res
     idx = _plan_unpad_indices(parts, plan)
-    return dataclasses.replace(res, x=res.x[jnp.asarray(idx)])
+    res = dataclasses.replace(res, x=res.x[jnp.asarray(idx)])
+    if res.basis is not None:
+        its, vecs = res.basis
+        res = dataclasses.replace(
+            res, basis=(its, vecs[:, jnp.asarray(idx)]))
+    return res
 #
 # Time-stepping and service workloads solve the same operator hundreds
 # of times; the planner's reference machine model is a guess until the

@@ -189,7 +189,7 @@ def _build(mesh, axis, n_shards, local_grid, maxiter, check_every, bm,
             # the recorded scalars are the psum'd globals, identical
             # on every shard; no heartbeat inside shard_map (one
             # callback per shard would multiply the stream)
-            state_f, fbuf = _flight_while(
+            state_f, fbuf, _ = _flight_while(
                 cond, step_ab, state, check_every, fits, flight,
                 dtype=jnp.float32, k0=jnp.zeros((), jnp.int32),
                 rr0=rr0, heartbeat_ok=False)

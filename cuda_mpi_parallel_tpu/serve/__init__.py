@@ -21,6 +21,7 @@ from .queue import (
 )
 from .service import (
     OperatorHandle,
+    RecyclePolicy,
     RequestResult,
     RetryPolicy,
     ServiceClosed,
@@ -40,6 +41,7 @@ __all__ = [
     "MicroBatchQueue",
     "OperatorHandle",
     "QueueFull",
+    "RecyclePolicy",
     "RequestResult",
     "RetryPolicy",
     "ServiceClosed",

@@ -94,6 +94,14 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "runs balance.plan_partition ONCE at "
                         "registration, 'even' (default) keeps the "
                         "uniform split")
+    p.add_argument("--recycle", nargs="?", const=0, default=None,
+                   type=int, metavar="K",
+                   help="Krylov-subspace recycling (solver.recycle): "
+                        "harvest a K-dimensional Ritz space from early "
+                        "dispatches of each handle and deflate later "
+                        "ones - repeat traffic gets measurably faster "
+                        "every solve (bare flag: K=8).  Needs --method "
+                        "batched")
     p.add_argument("--phase-profile", nargs="?", const=0, default=None,
                    type=int, metavar="R", dest="phase_profile",
                    help="measure the registered operator's phase "
@@ -164,6 +172,16 @@ def main(argv=None) -> int:
             raise SystemExit(f"--phase-profile reps must be >= 0, got "
                              f"{args.phase_profile} (0/bare flag = the "
                              f"default rep count)")
+    if args.recycle is not None:
+        if args.recycle < 0:
+            raise SystemExit(f"--recycle K must be >= 0, got "
+                             f"{args.recycle} (0/bare flag = the "
+                             f"default space dimension)")
+        if args.method != "batched":
+            raise SystemExit(
+                "--recycle needs --method batched (block-CG deflates "
+                "rank collapse in-lane and carries no per-lane "
+                "Lanczos harvest)")
     if args.mesh <= 1 and args.plan != "even":
         raise SystemExit("--plan needs --mesh > 1")
     if args.plan not in ("even", "auto"):
@@ -203,11 +221,17 @@ def main(argv=None) -> int:
         wl.save_workload(args.save_workload, requests)
 
     precond = None if args.precond == "none" else args.precond
+    recycle_policy = None
+    if args.recycle is not None:
+        from .service import RecyclePolicy
+        from ..solver.recycle import DEFAULT_K
+
+        recycle_policy = RecyclePolicy(k=args.recycle or DEFAULT_K)
     service = SolverService(ServiceConfig(
         max_batch=args.max_batch,
         max_wait_s=args.max_wait_ms / 1e3,
         queue_limit=args.queue_limit, maxiter=args.maxiter,
-        check_every=args.check_every))
+        check_every=args.check_every, recycle=recycle_policy))
     mesh = None
     if args.mesh > 1:
         from ..parallel import make_mesh
@@ -329,6 +353,8 @@ def main(argv=None) -> int:
                      if handle.dispatcher is not None else None),
         "exchange_requested": args.exchange,
         "stats": stats,
+        **({"recycle": stats.get("recycle")}
+           if args.recycle is not None else {}),
         "requests": per_request,
         "max_abs_error": worst_err,
         "converged_all": all_ok,
@@ -343,6 +369,15 @@ def main(argv=None) -> int:
                    f"(mesh={args.mesh}, {args.dtype}) ==\n"
                    + "\n".join(treport.service_lines(stats)) + "\n"
                    + f"accuracy: max request error {worst_err:.3e}\n")
+    rstats = stats.get("recycle")
+    if rstats is not None:
+        first = rstats.get("first_solve_iterations")
+        last = rstats.get("last_solve_iterations")
+        report_text += (
+            f"recycle : {rstats['harvests']} harvest(s), "
+            f"{rstats['applied']} deflated dispatch(es), iters/solve "
+            f"{first if first is not None else '?'} -> "
+            f"{last if last is not None else '?'}\n")
     if handle.phase_profile is not None:
         report_text += ("-- phase profile (measured at warmup) --\n"
                         + "\n".join(treport.phase_lines(

@@ -65,6 +65,7 @@ from .queue import (
 __all__ = [
     "OperatorHandle",
     "QueueFull",
+    "RecyclePolicy",
     "RequestResult",
     "RetryPolicy",
     "ServiceClosed",
@@ -112,6 +113,51 @@ class RetryPolicy:
         """Exponential backoff before dispatch attempt ``attempts + 1``
         (``attempts`` >= 1 completed)."""
         return self.backoff_s * (2.0 ** max(attempts - 1, 0))
+
+
+@dataclasses.dataclass(frozen=True)
+class RecyclePolicy:
+    """Per-handle Krylov-subspace recycling (``solver.recycle``,
+    ROADMAP item 2): harvest approximate extreme Ritz vectors from
+    early live dispatches and deflate them from later ones, so repeat
+    traffic on a handle gets measurably faster the longer the service
+    runs.
+
+    The schedule: the first live dispatch runs with the basis ring +
+    stride-1 flight recorder and seeds the handle's ``RecycleSpace``;
+    subsequent dispatches deflate with it AND keep harvesting
+    (accumulating Rayleigh-Ritz refinement) until ``patience``
+    consecutive harvests stop improving the mean live-lane iteration
+    count by ``min_improvement`` - then the recorders drop off and
+    dispatches run the pure deflated lane (``refresh_every > 0``
+    re-opens one harvest round every that-many deflated dispatches).
+    A lane that BREAKS DOWN under deflation drops the space
+    defensively.  The space is also dropped when the dist_cg LRU
+    evicts the handle's compiled solvers (it rides the cache).
+    """
+
+    k: int = 8
+    #: basis-ring rows; None sizes to the handle's maxiter (bounded by
+    #: recycle.BASIS_CAPACITY_LIMIT)
+    capacity: Optional[int] = None
+    #: an accumulation round must cut mean live-lane iterations by at
+    #: least this to count as improving
+    min_improvement: float = 0.5
+    #: consecutive non-improving harvests before the recorders drop
+    patience: int = 2
+    #: 0 = never re-open harvesting once frozen; N > 0 = one harvest
+    #: round every N deflated dispatches (drift refresh)
+    refresh_every: int = 0
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"k must be >= 1, got {self.k}")
+        if self.patience < 1:
+            raise ValueError(
+                f"patience must be >= 1, got {self.patience}")
+        if self.refresh_every < 0:
+            raise ValueError(
+                f"refresh_every must be >= 0, got {self.refresh_every}")
 
 
 @dataclasses.dataclass
@@ -166,6 +212,10 @@ class ServiceConfig:
     #: host-side finiteness check of every submitted b (robust
     #: pre-solve validation; False opts out for chaos staging)
     validate: bool = True
+    #: Krylov-subspace recycling of repeat traffic (None = off): a
+    #: per-handle RecycleSpace harvested from early dispatches and
+    #: deflated from later ones (solver.recycle)
+    recycle: Optional[RecyclePolicy] = None
     #: per-batch dispatch log retained for reports (ring, drop-oldest)
     keep_batch_log: int = 1024
     #: exact latency samples retained for stats() percentiles (ring,
@@ -278,6 +328,20 @@ class OperatorHandle:
     #: of this handle - the test harness's "poisoned handle" (drives
     #: the retry/breaker drills deterministically)
     inject: Optional[object] = None
+    #: Krylov recycling state (ServiceConfig.recycle): the harvested
+    #: solver.recycle.RecycleSpace consulted by later dispatches, its
+    #: HarvestInfo, and the quality-schedule counters
+    recycle_space: Optional[object] = None
+    recycle_info: Optional[object] = None
+    #: mean live-lane iterations of the handle's FIRST harvest-source
+    #: dispatch (the undeflated baseline iters-saved is measured
+    #: against)
+    recycle_baseline_iters: Optional[float] = None
+    recycle_best_iters: Optional[float] = None
+    recycle_stale: int = 0
+    recycle_frozen: bool = False
+    recycle_deflated_since_harvest: int = 0
+    recycle_harvests: int = 0
 
     @property
     def distributed(self) -> bool:
@@ -349,6 +413,20 @@ class SolverService:
         self._solves: deque = deque(
             maxlen=self.config.keep_latency_samples)
         self._batch_log: deque = deque(maxlen=self.config.keep_batch_log)
+        # Krylov recycling bookkeeping (ServiceConfig.recycle)
+        self._recycle_harvests = 0
+        self._recycle_applied = 0
+        self._recycle_dropped = 0
+        self._recycle_first_iters: Optional[float] = None
+        self._recycle_last_iters: Optional[float] = None
+        self._evict_listener = None
+        if self.config.recycle is not None:
+            from ..parallel import dist_cg
+
+            # the per-handle space rides the compiled-solver LRU: when
+            # a handle's solvers are evicted, its space goes with them
+            self._evict_listener = self._on_solver_evicted
+            dist_cg.add_evict_listener(self._evict_listener)
         # one dispatcher at a time: the worker thread and a caller-side
         # drain() must not interleave two engine calls
         self._dispatch_lock = threading.Lock()
@@ -402,6 +480,24 @@ class SolverService:
         if method not in MANY_METHODS:
             raise ValueError(f"unknown method {method!r}; expected one "
                              f"of {MANY_METHODS}")
+        if self.config.recycle is not None:
+            # refuse at REGISTRATION, not silently per dispatch: the
+            # recycling schedule rides the batched recurrence's basis
+            # ring/deflation lane, and a poisoned handle must not
+            # harvest a poisoned spectrum
+            if method != "batched":
+                raise ValueError(
+                    "ServiceConfig.recycle needs method='batched' "
+                    "handles (block-CG deflates rank collapse in-lane "
+                    "and carries no per-lane Lanczos harvest); "
+                    "register with method='batched' or drop the "
+                    "recycle policy")
+            if inject is not None:
+                raise ValueError(
+                    "ServiceConfig.recycle with inject= is "
+                    "unsupported (a chaos-poisoned handle must not "
+                    "harvest - and deflation would mask the armed "
+                    "fault)")
         if precond not in (None, "jacobi"):
             raise ValueError(
                 f"the solver service supports precond None or 'jacobi' "
@@ -851,20 +947,160 @@ class SolverService:
             req.future.set_result(result)
 
     def _engine(self, handle: OperatorHandle, b_stack: np.ndarray,
-                tols: np.ndarray):
+                tols: np.ndarray, deflate=None, basis=None,
+                flight=None):
         """One batched solve of the handle's operator (the compiled
         hot path every dispatch and warmup shares).  Mesh handles ride
         the handle's prepared dispatcher - no per-batch plan/partition
-        host work."""
+        host work.  ``deflate``/``basis``/``flight`` are the recycling
+        lanes (:class:`RecyclePolicy`); warmup passes none of them."""
         if handle.distributed:
-            return handle.dispatcher.solve(b_stack, tol=tols)
+            return handle.dispatcher.solve(b_stack, tol=tols,
+                                           deflate=deflate,
+                                           basis=basis, flight=flight)
         from ..solver.many import solve_many
 
         return solve_many(handle.a, b_stack, tol=tols,
                           maxiter=handle.maxiter, m=handle.precond_obj,
                           method=handle.method,
                           check_every=handle.check_every,
-                          fault=handle.inject)
+                          fault=handle.inject, deflate=deflate,
+                          basis=basis, flight=flight)
+
+    # -- Krylov recycling (ServiceConfig.recycle) ------------------------
+
+    def _on_solver_evicted(self, key) -> None:
+        """dist_cg LRU eviction: a handle whose compiled solvers were
+        dropped loses its RecycleSpace too (the space rides the cache;
+        a later dispatch re-traces AND re-harvests, loudly)."""
+        with self._lock:
+            handles = list(self._handles.values())
+        for h in handles:
+            disp = h.dispatcher
+            if disp is None or h.recycle_space is None:
+                continue
+            kb = disp._key_base
+            if isinstance(key, tuple) and len(key) >= len(kb) \
+                    and tuple(key[: len(kb)]) == kb:
+                self._drop_recycle_space(h)
+
+    def _drop_recycle_space(self, handle: OperatorHandle) -> None:
+        """Drop a handle's RecycleSpace and reset its schedule (shared
+        by the LRU-eviction listener and the defensive
+        BREAKDOWN-under-deflation path) - a later dispatch re-harvests
+        from scratch, loudly counted."""
+        from ..telemetry.registry import REGISTRY
+
+        with self._lock:
+            handle.recycle_space = None
+            handle.recycle_info = None
+            handle.recycle_frozen = False
+            handle.recycle_stale = 0
+            handle.recycle_deflated_since_harvest = 0
+            self._recycle_dropped += 1
+        REGISTRY.counter(
+            "serve_recycle_spaces_dropped_total",
+            "per-handle RecycleSpaces dropped (LRU eviction of the "
+            "handle's compiled solvers, or a defensive drop after "
+            "BREAKDOWN under deflation)").inc()
+
+    def _recycle_lane(self, handle: OperatorHandle):
+        """``(deflate, basis, flight)`` for the next live dispatch of
+        this handle under the quality schedule (see RecyclePolicy)."""
+        policy = self.config.recycle
+        if policy is None or handle.method != "batched":
+            return None, None, None
+        harvesting = not handle.recycle_frozen
+        if handle.recycle_frozen and policy.refresh_every > 0 \
+                and handle.recycle_deflated_since_harvest \
+                >= policy.refresh_every:
+            harvesting = True          # scheduled drift refresh
+        if not harvesting:
+            return handle.recycle_space, None, None
+        from ..solver.recycle import BasisConfig
+        from ..telemetry.flight import FlightConfig
+
+        cap = policy.capacity
+        basis = (BasisConfig(capacity=cap) if cap is not None
+                 else BasisConfig.for_solve(handle.maxiter))
+        flight = FlightConfig.for_solve(handle.maxiter, stride=1)
+        return handle.recycle_space, basis, flight
+
+    def _recycle_after(self, handle: OperatorHandle, res, n_live: int,
+                      deflate, basis) -> None:
+        """Post-dispatch half of the schedule: harvest/accumulate,
+        track the improvement, emit the events/gauges."""
+        from ..solver import recycle as rec
+
+        policy = self.config.recycle
+        iters = np.asarray(res.iterations)[:n_live]
+        statuses = np.asarray(res.status)
+        mean_iters = float(iters.mean()) if iters.size else 0.0
+        with self._lock:
+            if self._recycle_first_iters is None and basis is not None:
+                self._recycle_first_iters = mean_iters
+            self._recycle_last_iters = mean_iters
+        if deflate is not None:
+            with self._lock:
+                self._recycle_applied += 1
+                handle.recycle_deflated_since_harvest += 1
+            rec.note_applied(deflate.k, int(round(mean_iters)),
+                             handle.recycle_baseline_iters,
+                             handle=handle.key)
+            from ..solver.status import CGStatus as _St
+
+            if any(int(sv) == int(_St.BREAKDOWN)
+                   for sv in statuses[:n_live]):
+                # defensive: a deflated lane must never be the thing
+                # that breaks a solve - drop the space, loudly
+                self._drop_recycle_space(handle)
+                return
+        if basis is None:
+            return
+        if handle.recycle_baseline_iters is None:
+            handle.recycle_baseline_iters = mean_iters
+        try:
+            space, info = rec.harvest_space(
+                handle.a, res, k=policy.k,
+                prev=handle.recycle_space,
+                n_rhs=int(np.asarray(res.x).shape[1]), note=False)
+        except rec.HarvestError:
+            from ..telemetry.registry import REGISTRY
+
+            REGISTRY.counter(
+                "serve_recycle_harvest_failures_total",
+                "harvests the recycling schedule attempted that the "
+                "record could not support").inc()
+            with self._lock:
+                handle.recycle_stale += 1
+                # a FAILED refresh round still closes the round: the
+                # counter resets so the next refresh waits another
+                # refresh_every dispatches instead of re-paying the
+                # recorders + harvest on every batch forever
+                handle.recycle_deflated_since_harvest = 0
+                if handle.recycle_stale >= policy.patience:
+                    handle.recycle_frozen = True
+            return
+        rec.note_harvest(info, handle=handle.key)
+        with self._lock:
+            handle.recycle_space = space
+            handle.recycle_info = info
+            handle.recycle_harvests += 1
+            handle.recycle_deflated_since_harvest = 0
+            self._recycle_harvests += 1
+            best = handle.recycle_best_iters
+            if best is None \
+                    or mean_iters <= best - policy.min_improvement:
+                handle.recycle_best_iters = mean_iters \
+                    if best is None else min(best, mean_iters)
+                handle.recycle_stale = 0
+                handle.recycle_frozen = False
+            else:
+                handle.recycle_stale += 1
+                if handle.recycle_stale >= policy.patience:
+                    # quality plateau: drop the recorders, keep the
+                    # space - pure deflated dispatches from here
+                    handle.recycle_frozen = True
 
     def _run_batch(self, batch: Batch) -> None:
         from ..solver.many import stack_columns
@@ -885,18 +1121,31 @@ class SolverService:
         tols = np.full((k,), reqs[0].tol,
                        dtype=np.dtype(handle.dtype_name))
         tols[:m] = [r.tol for r in reqs]
+        r_deflate, r_basis, r_flight = self._recycle_lane(handle)
         t0 = time.perf_counter()
         with events.solve_scope() as solve_id:
             events.emit("batch_dispatch", handle=handle.key, bucket=k,
                         n_requests=m, reason=batch.reason,
-                        occupancy=round(batch.occupancy, 6))
+                        occupancy=round(batch.occupancy, 6),
+                        **({"deflate_k": r_deflate.k}
+                           if r_deflate is not None else {}))
             try:
-                res = self._engine(handle, b_stack, tols)
+                # recycle kwargs only when the lane is live: the plain
+                # dispatch keeps the pre-recycling 3-arg call (test
+                # harnesses wrap _engine with that signature)
+                recycle_kw = {}
+                if r_deflate is not None or r_basis is not None:
+                    recycle_kw = dict(deflate=r_deflate, basis=r_basis,
+                                      flight=r_flight)
+                res = self._engine(handle, b_stack, tols, **recycle_kw)
                 x = np.asarray(res.x)          # sync: the solve is done
                 iters = np.asarray(res.iterations)
                 rnorm = np.asarray(res.residual_norm)
                 conv = np.asarray(res.converged)
                 stat = np.asarray(res.status)
+                if self.config.recycle is not None:
+                    self._recycle_after(handle, res, m, r_deflate,
+                                        r_basis)
             except Exception as exc:
                 # the typed-terminal-result contract holds for engine
                 # failures too: every lane of the batch resolves to a
@@ -1098,6 +1347,11 @@ class SolverService:
             self._closed = True
             self._cond.notify_all()
         self.drain()
+        if self._evict_listener is not None:
+            from ..parallel import dist_cg
+
+            dist_cg.remove_evict_listener(self._evict_listener)
+            self._evict_listener = None
         if self._worker is not None:
             with self._cond:
                 self._stop = True
@@ -1176,6 +1430,27 @@ class SolverService:
                              for key, br in self._breakers.items()
                              if br.state != "closed"},
             }
+            if self.config.recycle is not None:
+                out["recycle"] = {
+                    "harvests": self._recycle_harvests,
+                    "applied": self._recycle_applied,
+                    "dropped": self._recycle_dropped,
+                    "first_solve_iterations": self._recycle_first_iters,
+                    "last_solve_iterations": self._recycle_last_iters,
+                    "spaces": {
+                        h.key: {
+                            "k": (h.recycle_space.k
+                                  if h.recycle_space is not None
+                                  else None),
+                            "harvests": h.recycle_harvests,
+                            "frozen": h.recycle_frozen,
+                            "baseline_iterations":
+                                h.recycle_baseline_iters,
+                        }
+                        for h in self._handles.values()
+                        if h.recycle_harvests
+                        or h.recycle_space is not None},
+                }
         out["latency"] = {
             "count": len(lat),
             "mean_s": float(np.mean(lat)) if lat else None,

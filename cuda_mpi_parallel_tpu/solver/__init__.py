@@ -4,8 +4,18 @@
 from .cg import CGCheckpoint, CGResult, cg, solve
 from .df64 import DF64CGResult, DF64Checkpoint, cg_df64
 from .many import CGBatchResult, cg_many, solve_many, stack_columns
+from .recycle import (
+    BasisConfig,
+    HarvestError,
+    RecycleMismatch,
+    RecycleSpace,
+    harvest_space,
+    recycled_sequence,
+)
 from .status import CGStatus
 
-__all__ = ["CGBatchResult", "CGCheckpoint", "CGResult", "CGStatus",
-           "DF64CGResult", "cg", "cg_df64", "cg_many", "solve",
+__all__ = ["BasisConfig", "CGBatchResult", "CGCheckpoint", "CGResult",
+           "CGStatus", "DF64CGResult", "HarvestError",
+           "RecycleMismatch", "RecycleSpace", "cg", "cg_df64",
+           "cg_many", "harvest_space", "recycled_sequence", "solve",
            "solve_many", "stack_columns"]

@@ -117,7 +117,8 @@ class CGCheckpoint:
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=("x", "iterations", "residual_norm", "converged", "status",
-                 "indefinite", "residual_history", "checkpoint", "flight"),
+                 "indefinite", "residual_history", "checkpoint", "flight",
+                 "basis"),
     meta_fields=(),
 )
 @dataclasses.dataclass(frozen=True)
@@ -135,6 +136,9 @@ class CGResult:
     #: flight-recorder ring buffer (capacity, 4) when a FlightConfig was
     #: passed; decode with telemetry.flight.FlightRecord.from_buffer
     flight: Optional[jax.Array] = None
+    #: Krylov-recycling basis ring ``(iterations, vectors)`` when a
+    #: recycle.BasisConfig was passed; feed to recycle.harvest_space
+    basis: Optional[tuple] = None
 
     def status_enum(self) -> CGStatus:
         return CGStatus(int(self.status))
@@ -170,6 +174,8 @@ def cg(
     compensated: bool = False,
     flight=None,
     fault=None,
+    deflate=None,
+    basis=None,
 ) -> CGResult:
     """Solve A x = b by (preconditioned) conjugate gradients.
 
@@ -243,6 +249,24 @@ def cg(
         leaves the traced jaxpr bit-identical to a call that never
         mentions injection.  ``method="cg"`` only - the chaos harness
         drills the textbook recurrence.
+      deflate: optional ``recycle.RecycleSpace`` - Krylov-recycling
+        deflation (``solver.recycle``): at entry the recycled space's
+        component of the error is solved exactly
+        (``x0 += W (W^T A W)^{-1} W^T r0``) and every new search
+        direction is projected against ``A W``, so the effective
+        spectrum CG sees excludes the harvested extreme Ritz values.
+        Under ``axis_name`` the per-iteration ``(k,)``-wide
+        ``(AW)^T z`` reduction FUSES into the residual-norm psum - the
+        per-iteration collective count is unchanged.  ``None`` (the
+        default) leaves the traced jaxpr bit-identical.
+        ``method="cg"`` only; refuses compensated/checkpoint/fault
+        composition (the deflated recurrence is its own lane).
+      basis: optional ``recycle.BasisConfig`` - carry the Krylov-
+        recycling basis ring (last ``capacity`` normalized residuals)
+        in the loop state and return it as ``result.basis`` for
+        ``recycle.harvest_space``.  Requires ``flight`` (the harvest
+        needs the alpha/beta tridiagonal too); ``method="cg"`` only;
+        ``None`` compiles to nothing.
 
     The function is pure and traceable: call it under ``jit`` (or use
     ``solve()`` which jits for you).
@@ -276,6 +300,48 @@ def cg(
                 f"harness drills the textbook recurrence")
         fault.validate_for_operator(a, n_shards=1 if axis_name is None
                                     else getattr(a, "n_shards", 1))
+    if deflate is not None:
+        from .recycle import RecycleSpace
+
+        if not isinstance(deflate, RecycleSpace):
+            raise TypeError(
+                f"deflate must be a solver.recycle.RecycleSpace, got "
+                f"{type(deflate).__name__}")
+        if method != "cg":
+            raise ValueError(
+                f"deflate= (Krylov recycling) rides method='cg' only "
+                f"(got {method!r}): the projection assumes the "
+                f"textbook direction recurrence")
+        if compensated or resume_from is not None or return_checkpoint:
+            raise ValueError(
+                "deflate= does not compose with compensated dots or "
+                "checkpoint/resume (the deflated recurrence carries "
+                "extra projection state the CGCheckpoint does not)")
+        if fault is not None:
+            raise ValueError(
+                "deflate= with fault injection is unsupported (the "
+                "chaos harness drills the undeflated textbook "
+                "recurrence)")
+    if basis is not None:
+        from .recycle import BasisConfig
+
+        if not isinstance(basis, BasisConfig):
+            raise TypeError(
+                f"basis must be a solver.recycle.BasisConfig, got "
+                f"{type(basis).__name__}")
+        if method != "cg":
+            raise ValueError(
+                f"basis= (the recycling harvest ring) rides "
+                f"method='cg' only (got {method!r})")
+        if flight is None:
+            raise ValueError(
+                "basis= needs flight= (a stride-1 FlightConfig): the "
+                "harvest combines the basis ring with the flight "
+                "recorder's alpha/beta tridiagonal")
+        if resume_from is not None:
+            raise ValueError(
+                "basis= with resume_from is unsupported (a resumed "
+                "ring would window a spliced trajectory)")
     if method == "minres":
         # the symmetric-INDEFINITE solver (quirk Q1: the reference's own
         # system is indefinite and CG converges on it only by luck)
@@ -327,6 +393,13 @@ def cg(
         else:
             x = jnp.asarray(x0, b.dtype)
             r = b - a @ x
+        if deflate is not None:
+            # Galerkin entry correction: solve the recycled space's
+            # component of the error exactly, so r0 starts orthogonal
+            # to W (one extra k-wide psum, at entry only)
+            from .recycle import entry_project
+
+            x, r = entry_project(deflate, x, r, axis_name)
 
         # Unpreconditioned: z == r, so rho == rr and one reduction (one psum
         # over ICI in the distributed case) suffices per iteration.
@@ -336,7 +409,12 @@ def cg(
             rho0 = dot(r, z)
         else:
             z, rho0 = r, rr0
-        p0 = z
+        if deflate is None:
+            p0 = z
+        else:
+            from .recycle import project_direction
+
+            p0 = project_direction(deflate, z, axis_name)
         nrm0 = jnp.sqrt(rr0)
         k0 = jnp.zeros((), jnp.int32)
         indef0 = jnp.zeros((), jnp.bool_)
@@ -382,14 +460,37 @@ def cg(
         alpha = _safe_div(s.rho, p_ap)            # host arithmetic :311 -> device
         x = blas1.axpy(alpha, s.p, s.x)           # :314
         r = blas1.axpy(-alpha, ap, s.r)           # :320-321
-        rr = dot(r, r)                            # cublasDnrm2 :328 -> psum
-        if preconditioned:
-            z = m @ r
-            rho = dot(r, z)
+        if deflate is None:
+            rr = dot(r, r)                        # cublasDnrm2 :328 -> psum
+            if preconditioned:
+                z = m @ r
+                rho = dot(r, z)
+            else:
+                z, rho = r, rr
+            beta = _safe_div(rho, s.rho)          # :336-339
+            p = blas1.xpby(z, beta, s.p)          # Dscal :342 + Daxpy :347
         else:
-            z, rho = r, rr
-        beta = _safe_div(rho, s.rho)              # :336-339
-        p = blas1.xpby(z, beta, s.p)              # Dscal :342 + Daxpy :347, fused
+            # deflated lane: the (k,)-wide (AW)^T z projection
+            # reduction FUSES into the residual-norm psum, so the
+            # per-iteration collective COUNT matches the undeflated
+            # solve (and the preconditioned lane's rr/rho pair shares
+            # the same fused collective)
+            from .recycle import chol_solve
+
+            z = m @ r if preconditioned else r
+            parts = [jnp.vdot(r, r)]
+            if preconditioned:
+                parts.append(jnp.vdot(r, z))
+            fused = jnp.concatenate([jnp.stack(parts),
+                                     deflate.aw.T @ z])
+            if axis_name is not None:
+                fused = lax.psum(fused, axis_name)
+            rr = fused[0]
+            rho = fused[1] if preconditioned else rr
+            wz = fused[-deflate.k:]
+            beta = _safe_div(rho, s.rho)
+            p = blas1.xpby(z, beta, s.p) \
+                - deflate.w @ chol_solve(deflate.chol, wz)
         k = s.k + 1
         history = s.history
         if record_history:
@@ -408,12 +509,13 @@ def cg(
     fits = _block_fits(maxiter, cap, check_every)
     if flight is None:
         final = _blocked_while(cond, step, state, check_every, fits)
-        fbuf = None
+        fbuf = bbuf = None
     else:
-        final, fbuf = _flight_while(
+        final, fbuf, bbuf = _flight_while(
             cond, step_ab, state, check_every, fits, flight,
             dtype=b.dtype, k0=k0, rr0=rr0,
-            heartbeat_ok=axis_name is None)
+            heartbeat_ok=axis_name is None,
+            basis=basis, r0=state.r)
 
     checkpoint = None
     if return_checkpoint:
@@ -423,7 +525,7 @@ def cg(
     healthy = jnp.isfinite(final.rr) & jnp.isfinite(final.rho) \
         & ((final.rho > 0) | (final.rr == 0))
     return _package(final, healthy, thresh_sq, record_history, checkpoint,
-                    flight_buf=fbuf)
+                    flight_buf=fbuf, basis_buf=bbuf)
 
 
 def _blocked_while(cond, step, state, check_every: int, block_fits=None):
@@ -472,7 +574,8 @@ def _block_fits(maxiter: int, cap: jax.Array, check_every: int):
 
 
 def _flight_while(cond, step_ab, state, check_every: int, fits, flight,
-                  *, dtype, k0, rr0, heartbeat_ok: bool = True):
+                  *, dtype, k0, rr0, heartbeat_ok: bool = True,
+                  basis=None, r0=None):
     """``_blocked_while`` with the flight-recorder ring buffer threaded
     through the loop carry.
 
@@ -481,7 +584,12 @@ def _flight_while(cond, step_ab, state, check_every: int, fits, flight,
     masked dynamic-slice update per iteration; everything else about
     the loop (predicates, blocking, tail pass) is EXACTLY
     ``_blocked_while``, so iterates are identical with the recorder on
-    or off.  Returns ``(final_state, final_buffer)``.
+    or off.  Returns ``(final_state, final_buffer, final_basis)``
+    (``final_basis`` is ``None`` unless a ``recycle.BasisConfig`` was
+    passed - the Krylov-recycling harvest ring records the new
+    state's normalized residual ``s2.r / sqrt(rr)`` beside the flight
+    row, same masked-ring-write discipline, nothing in the carry when
+    off).
 
     ``heartbeat_ok=False`` suppresses the optional ``jax.debug``
     heartbeat even when ``flight.heartbeat > 0`` (shard_map bodies -
@@ -495,20 +603,42 @@ def _flight_while(cond, step_ab, state, check_every: int, fits, flight,
 
     buf0 = flight_init(flight, dtype, k0, rr0)
 
-    def fcond(fs):
+    if basis is None:
+        def fcond(fs):
+            return cond(fs[0])
+
+        def fstep(fs):
+            s, buf = fs
+            s2, k, rr, alpha, beta = step_ab(s)
+            buf = flight_record(buf, flight, k, rr, alpha, beta)
+            if heartbeat_ok:
+                maybe_heartbeat(flight, k, rr)
+            return s2, buf
+
+        ffits = None if fits is None else (lambda fs: fits(fs[0]))
+        final, fbuf = _blocked_while(fcond, fstep, (state, buf0),
+                                     check_every, ffits)
+        return final, fbuf, None
+
+    from .recycle import basis_init, basis_record
+
+    bbuf0 = basis_init(basis, dtype, k0, r0, rr0)
+
+    def bcond(fs):
         return cond(fs[0])
 
-    def fstep(fs):
-        s, buf = fs
+    def bstep(fs):
+        s, buf, bbuf = fs
         s2, k, rr, alpha, beta = step_ab(s)
         buf = flight_record(buf, flight, k, rr, alpha, beta)
+        bbuf = basis_record(bbuf, basis, k, s2.r, rr)
         if heartbeat_ok:
             maybe_heartbeat(flight, k, rr)
-        return s2, buf
+        return s2, buf, bbuf
 
-    ffits = None if fits is None else (lambda fs: fits(fs[0]))
-    return _blocked_while(fcond, fstep, (state, buf0), check_every,
-                          ffits)
+    bfits = None if fits is None else (lambda fs: fits(fs[0]))
+    return _blocked_while(bcond, bstep, (state, buf0, bbuf0),
+                          check_every, bfits)
 
 
 def _threshold_sq(tol, rtol, nrm0: jax.Array, dtype) -> jax.Array:
@@ -528,7 +658,7 @@ def _history_init(record_history: bool, maxiter: int, dtype, k0, nrm0):
 
 def _package(final, healthy: jax.Array, thresh_sq: jax.Array,
              record_history: bool, checkpoint,
-             flight_buf=None) -> CGResult:
+             flight_buf=None, basis_buf=None) -> CGResult:
     """Shared epilogue: convergence/breakdown status + CGResult assembly
     (everything the reference never reported, quirks Q4/Q7)."""
     nrm = jnp.sqrt(final.rr)
@@ -549,6 +679,7 @@ def _package(final, healthy: jax.Array, thresh_sq: jax.Array,
         residual_history=final.history if record_history else None,
         checkpoint=checkpoint,
         flight=flight_buf,
+        basis=basis_buf,
     )
 
 
@@ -697,7 +828,7 @@ def _cg1(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
         final = _blocked_while(cond, step, state, check_every, fits)
         fbuf = None
     else:
-        final, fbuf = _flight_while(
+        final, fbuf, _ = _flight_while(
             cond, step_ab, state, check_every, fits, flight,
             dtype=b.dtype, k0=k0, rr0=rr0,
             heartbeat_ok=axis_name is None)
@@ -856,7 +987,7 @@ def _pipecg(a, b, x0, *, m, preconditioned, tol, rtol, maxiter, cap,
         final = _blocked_while(cond, step, state, check_every, fits)
         fbuf = None
     else:
-        final, fbuf = _flight_while(
+        final, fbuf, _ = _flight_while(
             cond, step_ab, state, check_every, fits, flight,
             dtype=b.dtype, k0=k0, rr0=rr0,
             heartbeat_ok=axis_name is None)
@@ -884,15 +1015,17 @@ def _as_operator(a) -> LinearOperator:
 @partial(jax.jit, static_argnames=("maxiter", "record_history", "axis_name",
                                    "return_checkpoint", "check_every",
                                    "method", "compensated", "flight",
-                                   "fault"))
+                                   "fault", "basis"))
 def _solve_jit(a, b, x0, tol, rtol, maxiter, m, record_history, axis_name,
                resume_from, return_checkpoint, iter_cap, check_every,
-               method, compensated, flight, fault=None):
+               method, compensated, flight, fault=None, deflate=None,
+               basis=None):
     return cg(a, b, x0, tol=tol, rtol=rtol, maxiter=maxiter, m=m,
               record_history=record_history, axis_name=axis_name,
               resume_from=resume_from, return_checkpoint=return_checkpoint,
               iter_cap=iter_cap, check_every=check_every, method=method,
-              compensated=compensated, flight=flight, fault=fault)
+              compensated=compensated, flight=flight, fault=fault,
+              deflate=deflate, basis=basis)
 
 
 def solve(
@@ -914,6 +1047,8 @@ def solve(
     engine: str = "general",
     flight=None,
     fault=None,
+    deflate=None,
+    basis=None,
 ) -> CGResult:
     """Jitted single-call entry point: compile once per (operator-structure,
     shape, maxiter) and reuse - the whole solve is one XLA executable.
@@ -944,6 +1079,24 @@ def solve(
                          f"'auto', 'resident' or 'streaming'")
     if not isinstance(a, LinearOperator):
         a = _as_operator(a)
+    if deflate is not None or basis is not None:
+        # Krylov recycling rides the general while_loop recurrence
+        # (the one carrying the projections / the basis ring); the
+        # one-kernel engines refuse, auto skips them.
+        feature = "deflate= (Krylov recycling)" if deflate is not None \
+            else "basis= (the recycling harvest ring)"
+        if engine in ("resident", "streaming"):
+            _note_rejected(engine, f"{feature} requested (the "
+                           "one-kernel engines carry neither the "
+                           "projection nor the basis ring)")
+            raise ValueError(
+                f"engine={engine!r} does not support {feature}; use "
+                f"engine='general' (or 'auto', which keeps recycling "
+                f"solves on the general engine)")
+        if deflate is not None:
+            from .recycle import check_space
+
+            check_space(deflate, a)     # typed RecycleMismatch
     if engine in ("auto", "resident"):
         from ..models.operators import _pallas_interpret
         from .resident import cg_resident, resident_eligible
@@ -967,6 +1120,7 @@ def solve(
                      or jax.default_backend() == "tpu")
                     and flight is None
                     and fault is None
+                    and deflate is None and basis is None
                     and resident_eligible(
                         a, b, m, method=method,
                         record_history=(record_history
@@ -1022,6 +1176,7 @@ def solve(
         eligible = ((engine == "streaming"
                      or jax.default_backend() == "tpu")
                     and fault is None
+                    and deflate is None and basis is None
                     and streaming_eligible(
                         a, b, m, method=method, x0=x0,
                         resume_from=resume_from,
@@ -1059,11 +1214,13 @@ def solve(
                  **({"flight_stride": flight.stride}
                     if flight is not None else {}),
                  **({"fault": fault.fingerprint()}
-                    if fault is not None else {}))
+                    if fault is not None else {}),
+                 **({"deflate_k": deflate.k}
+                    if deflate is not None else {}))
     return _solve_jit(a, b, x0, tol_a, rtol_a, maxiter, m, record_history,
                       None, resume_from, return_checkpoint, cap_a,
                       check_every, method, compensated, flight,
-                      fault=fault)
+                      fault=fault, deflate=deflate, basis=basis)
 
 
 # The many-RHS tier (masked batched CG + block-CG) lives in .many; it

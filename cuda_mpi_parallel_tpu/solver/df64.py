@@ -616,7 +616,7 @@ def _solve(op, b_df, tol2, rtol2, resume, cap=None, cheb_interval=None,
         # rows carry the HI words (f32 diagnostic precision, like the
         # residual_history trace); under axis_name the dots are already
         # globally reduced, so the buffer is replicated across shards
-        s, fbuf = _flight_while(
+        s, fbuf, _ = _flight_while(
             cond, body_ab, s0, check_every, fits, flight,
             dtype=jnp.float32, k0=k0, rr0=rr0[0],
             heartbeat_ok=axis_name is None)

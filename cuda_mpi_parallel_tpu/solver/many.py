@@ -107,7 +107,7 @@ def stack_columns(columns, k: int, dtype=None):
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=("x", "iterations", "residual_norm", "converged",
-                 "status", "indefinite", "flight", "fallback"),
+                 "status", "indefinite", "flight", "fallback", "basis"),
     meta_fields=(),
 )
 @dataclasses.dataclass(frozen=True)
@@ -129,9 +129,14 @@ class CGBatchResult:
     #: batched flight buffer (capacity, 1 + 3k) when a FlightConfig was
     #: passed; decode with telemetry.flight.lanes_from_buffer
     flight: Optional[jax.Array] = None
-    #: block-CG only: True when the Gram solve broke down and the
-    #: masked-batched continuation finished the solve (None = batched)
+    #: block-CG only: True when the Gram solve broke down PAST the
+    #: in-lane rank deflation and the masked-batched continuation
+    #: finished the solve (None = batched)
     fallback: Optional[jax.Array] = None
+    #: Krylov-recycling basis ring ``(iterations, vectors)`` when a
+    #: recycle.BasisConfig was passed (records one lane's normalized
+    #: residuals); feed to recycle.harvest_space(n_rhs=..., lane=...)
+    basis: Optional[tuple] = None
 
     @property
     def n_rhs(self) -> int:
@@ -212,7 +217,7 @@ def _init_xr_many(a, b, x0):
 
 
 def _package_many(final, thresh_sq, flight_buf=None,
-                  fallback=None) -> CGBatchResult:
+                  fallback=None, basis_buf=None) -> CGBatchResult:
     """Per-lane epilogue: the same status derivation as ``cg``'s
     ``_package``, vectorized over lanes."""
     nrm = jnp.sqrt(final.rr)
@@ -227,7 +232,7 @@ def _package_many(final, thresh_sq, flight_buf=None,
     return CGBatchResult(
         x=final.x, iterations=final.iters, residual_norm=nrm,
         converged=converged, status=status, indefinite=final.indefinite,
-        flight=flight_buf, fallback=fallback)
+        flight=flight_buf, fallback=fallback, basis=basis_buf)
 
 
 def cg_many(
@@ -246,6 +251,8 @@ def cg_many(
     compensated: bool = False,
     flight=None,
     fault=None,
+    deflate=None,
+    basis=None,
 ) -> CGBatchResult:
     """Solve ``A X = B`` for all columns of ``B`` in one loop.
 
@@ -282,6 +289,18 @@ def cg_many(
         so the chaos matrix can prove per-lane failure isolation (the
         poisoned lane exits BREAKDOWN while its batchmates converge).
         ``None`` leaves the traced jaxpr untouched.
+      deflate: optional ``recycle.RecycleSpace`` - Krylov-recycling
+        deflation of every lane (``solver.recycle``): the entry
+        Galerkin correction and the per-iteration direction projection
+        apply column-wise, and the ``(k_defl, k_rhs)`` projection
+        reduction FUSES into the per-lane residual psum (per-iteration
+        collective count unchanged).  ``method="batched"`` only
+        (block-CG carries its own in-lane rank deflation).  ``None``
+        leaves the traced jaxpr untouched.
+      basis: optional ``recycle.BasisConfig`` - carry the recycling
+        basis ring recording lane ``basis.lane``'s normalized
+        residuals; requires ``flight`` (stride-1) and
+        ``method="batched"``.  ``None`` compiles to nothing.
       (maxiter/iter_cap/check_every as in ``solver.cg``.)
 
     Returns a :class:`CGBatchResult` with per-lane status/iterations/
@@ -322,6 +341,44 @@ def cg_many(
         fault.validate_for_operator(
             a, n_shards=1 if axis_name is None
             else getattr(a, "n_shards", 1))
+    if deflate is not None:
+        from .recycle import RecycleSpace
+
+        if not isinstance(deflate, RecycleSpace):
+            raise TypeError(
+                f"deflate must be a solver.recycle.RecycleSpace, got "
+                f"{type(deflate).__name__}")
+        if method != "batched":
+            raise ValueError(
+                "deflate= (Krylov recycling) rides method='batched' "
+                "only: block-CG deflates rank collapse in-lane "
+                "through its own Gram pseudo-inverse")
+        if compensated or fault is not None:
+            raise ValueError(
+                "deflate= does not compose with compensated dots or "
+                "fault injection (the deflated recurrence is its own "
+                "lane)")
+    if basis is not None:
+        from .recycle import BasisConfig
+
+        if not isinstance(basis, BasisConfig):
+            raise TypeError(
+                f"basis must be a solver.recycle.BasisConfig, got "
+                f"{type(basis).__name__}")
+        if method != "batched":
+            raise ValueError(
+                "basis= (the recycling harvest ring) rides "
+                "method='batched' only (block-CG's recurrence scalars "
+                "are k x k matrices, not a lane's Lanczos process)")
+        if flight is None:
+            raise ValueError(
+                "basis= needs flight= (a stride-1 FlightConfig): the "
+                "harvest combines the basis ring with the recorder's "
+                "alpha/beta tridiagonal")
+        if basis.lane >= b.shape[1]:
+            raise ValueError(
+                f"basis.lane={basis.lane} out of range for a "
+                f"{b.shape[1]}-column stack")
     preconditioned = m is not None
     if m is None:
         m = IdentityOperator(dim=b.shape[0],
@@ -334,6 +391,13 @@ def cg_many(
         axis_name=axis_name)
 
     x, r = _init_xr_many(a, b, x0)
+    if deflate is not None:
+        # Galerkin entry correction, column-wise: every lane's r0
+        # starts orthogonal to the recycled space (one (k_defl x
+        # k_rhs)-wide psum at entry on a mesh)
+        from .recycle import entry_project
+
+        x, r = entry_project(deflate, x, r, axis_name)
     rr0 = dot_many(r, r)
     if preconditioned:
         z = m.matmat(r)
@@ -357,23 +421,35 @@ def cg_many(
             check_every, dot_many, axis_name)
         return _package_many(final, thresh_sq, fallback=fell_back)
 
+    if deflate is None:
+        p0 = z
+    else:
+        from .recycle import project_direction
+
+        p0 = project_direction(deflate, z, axis_name)
     state = _ManyState(
-        k=k0, x=x, r=r, p=z, rho=rho0, rr=rr0,
+        k=k0, x=x, r=r, p=p0, rho=rho0, rr=rr0,
         iters=iters0, indefinite=indef0)
-    final, fbuf = _run_batched(a, m, preconditioned, state, thresh_sq,
-                               maxiter, cap, check_every, dot_many,
-                               flight, b.dtype, fault=fault,
-                               axis_name=axis_name)
-    return _package_many(final, thresh_sq, flight_buf=fbuf)
+    final, fbuf, bbuf = _run_batched(a, m, preconditioned, state,
+                                     thresh_sq, maxiter, cap,
+                                     check_every, dot_many, flight,
+                                     b.dtype, fault=fault,
+                                     axis_name=axis_name,
+                                     deflate=deflate, basis=basis)
+    return _package_many(final, thresh_sq, flight_buf=fbuf,
+                         basis_buf=bbuf)
 
 
 def _batched_step_fn(a, m, preconditioned, thresh_sq, dot_many,
-                     fault=None, axis_name=None):
+                     fault=None, axis_name=None, deflate=None):
     """One masked batched CG step.  Returns ``(new_state, k, rr,
     alpha, beta)`` - the step plus its per-lane recording scalars (the
     flight recorder's row; traced away when the recorder is off).
     ``fault`` arms the chaos-injection sites exactly as in ``cg``'s
-    step (``fault=None`` is the untouched path)."""
+    step (``fault=None`` is the untouched path); ``deflate`` routes
+    the direction update through the recycling projector with its
+    ``(k_defl, k_rhs)`` reduction fused into the residual psum
+    (``deflate=None`` is the untouched path)."""
     def step_ab(s: _ManyState):
         act = _active_lanes(s.rr, s.rho, thresh_sq)
         if fault is None:
@@ -386,16 +462,45 @@ def _batched_step_fn(a, m, preconditioned, thresh_sq, dot_many,
         alpha = _safe_div(s.rho, p_ap)           # (k,) elementwise
         x = _select_lanes(act, blas1.axpy_many(alpha, s.p, s.x), s.x)
         r = _select_lanes(act, blas1.axpy_many(-alpha, ap, s.r), s.r)
-        rr_new = dot_many(r, r)
-        rr = jnp.where(act, rr_new, s.rr)
-        if preconditioned:
-            z = m.matmat(r)
-            rho_new = dot_many(r, z)
+        if deflate is None:
+            rr_new = dot_many(r, r)
+            rr = jnp.where(act, rr_new, s.rr)
+            if preconditioned:
+                z = m.matmat(r)
+                rho_new = dot_many(r, z)
+            else:
+                z, rho_new = r, rr_new
+            beta = _safe_div(rho_new, s.rho)
+            rho = jnp.where(act, rho_new, s.rho)
+            p = _select_lanes(act, blas1.xpby_many(z, beta, s.p), s.p)
         else:
-            z, rho_new = r, rr_new
-        beta = _safe_div(rho_new, s.rho)
-        rho = jnp.where(act, rho_new, s.rho)
-        p = _select_lanes(act, blas1.xpby_many(z, beta, s.p), s.p)
+            # deflated lane: per-lane rr/rho and the (k_defl, k_rhs)
+            # projection matrix ride ONE fused psum - the
+            # per-iteration collective count matches the undeflated
+            # batched solve
+            from .recycle import chol_solve
+
+            n_rhs = s.rr.shape[0]
+            z = m.matmat(r) if preconditioned else r
+            parts = [jnp.einsum("nk,nk->k", r, r)]
+            if preconditioned:
+                parts.append(jnp.einsum("nk,nk->k", r, z))
+            wz_l = deflate.aw.T @ z              # (k_defl, k_rhs)
+            fused = jnp.concatenate(parts + [wz_l.reshape(-1)])
+            if axis_name is not None:
+                from jax import lax
+
+                fused = lax.psum(fused, axis_name)
+            rr_new = fused[:n_rhs]
+            rho_new = fused[n_rhs:2 * n_rhs] if preconditioned \
+                else rr_new
+            wz = fused[-deflate.k * n_rhs:].reshape(deflate.k, n_rhs)
+            rr = jnp.where(act, rr_new, s.rr)
+            beta = _safe_div(rho_new, s.rho)
+            rho = jnp.where(act, rho_new, s.rho)
+            p_new = blas1.xpby_many(z, beta, s.p) \
+                - deflate.w @ chol_solve(deflate.chol, wz)
+            p = _select_lanes(act, p_new, s.p)
         k = s.k + 1
         return _ManyState(
             k=k, x=x, r=r, p=p, rho=rho, rr=rr,
@@ -410,11 +515,13 @@ def _batched_step_fn(a, m, preconditioned, thresh_sq, dot_many,
 
 def _run_batched(a, m, preconditioned, state, thresh_sq, maxiter, cap,
                  check_every, dot_many, flight, dtype, fault=None,
-                 axis_name=None):
-    """The masked batched while loop (+ optional flight recorder)."""
+                 axis_name=None, deflate=None, basis=None):
+    """The masked batched while loop (+ optional flight recorder and
+    recycling basis ring).  Returns ``(final, flight_buf,
+    basis_buf)``."""
     step_ab = _batched_step_fn(a, m, preconditioned, thresh_sq,
                                dot_many, fault=fault,
-                               axis_name=axis_name)
+                               axis_name=axis_name, deflate=deflate)
 
     def cond(s: _ManyState) -> jax.Array:
         act = _active_lanes(s.rr, s.rho, thresh_sq)
@@ -429,38 +536,111 @@ def _run_batched(a, m, preconditioned, state, thresh_sq, maxiter, cap,
 
     if flight is None:
         return _blocked_while(cond, step, state, check_every, fits), \
-            None
+            None, None
 
     from ..telemetry.flight import flight_init_many, flight_record_many
 
     buf0 = flight_init_many(flight, dtype, state.k, state.rr)
 
-    def fcond(fs):
+    if basis is None:
+        def fcond(fs):
+            return cond(fs[0])
+
+        def fstep(fs):
+            s, buf = fs
+            s2, k, rr, alpha, beta = step_ab(s)
+            buf = flight_record_many(buf, flight, k, rr, alpha, beta)
+            return s2, buf
+
+        final, buf = _blocked_while(fcond, fstep, (state, buf0),
+                                    check_every,
+                                    lambda fs: fits(fs[0]))
+        return final, buf, None
+
+    from .recycle import basis_init_many, basis_record_many
+
+    bbuf0 = basis_init_many(basis, dtype, state.k, state.r, state.rr)
+
+    def bcond(fs):
         return cond(fs[0])
 
-    def fstep(fs):
-        s, buf = fs
+    def bstep(fs):
+        s, buf, bbuf = fs
         s2, k, rr, alpha, beta = step_ab(s)
         buf = flight_record_many(buf, flight, k, rr, alpha, beta)
-        return s2, buf
+        # the recorded lane writes only while it is LIVE (step_ab
+        # masks frozen lanes' alpha to NaN): a lane that converged
+        # early must not wrap the ring with its frozen residual while
+        # slower batchmates finish - that would evict exactly the
+        # rows the harvest needs (serve batches converge unevenly)
+        bbuf = basis_record_many(bbuf, basis, k, s2.r, rr,
+                                 active=jnp.isfinite(alpha[basis.lane]))
+        return s2, buf, bbuf
 
-    final, buf = _blocked_while(fcond, fstep, (state, buf0),
-                                check_every, lambda fs: fits(fs[0]))
-    return final, buf
+    final, buf, bbuf = _blocked_while(bcond, bstep,
+                                      (state, buf0, bbuf0),
+                                      check_every,
+                                      lambda fs: fits(fs[0]))
+    return final, buf, bbuf
+
+
+def _gram_rank_deflated_solve(gram_mat, rhs):
+    """Eigenvalue pseudo-inverse Gram solve: the block lane's IN-LANE
+    rank-collapse deflation (ROADMAP item 2 / the PR-8-named
+    follow-up).  Eigendecompose the (symmetrized) Gram, invert only
+    the directions above ``GRAM_DEFLATE_RTOL * lambda_max``, and zero
+    the collapsed ones - the converged/duplicate direction simply
+    drops out of the block step instead of poisoning the factor, and
+    the remaining lanes keep their coupled Krylov space.  O(k^3) on a
+    k x k block, but it runs ONLY inside the rank-collapse branch of
+    ``lax.cond`` - the healthy path stays on Cholesky."""
+    sym = 0.5 * (gram_mat + gram_mat.T)
+    lam, q = jnp.linalg.eigh(sym)
+    lmax = jnp.max(jnp.abs(lam))
+    good = lam > GRAM_DEFLATE_RTOL * lmax
+    inv = jnp.where(good, 1.0 / jnp.where(good, lam, 1.0), 0.0)
+    return q @ (inv[:, None] * (q.T @ rhs))
+
+
+#: relative eigenvalue floor below which a Gram direction reads as
+#: collapsed (converged/duplicate column) and is deflated in-lane
+GRAM_DEFLATE_RTOL = 1e-10
+
+
+def _gram_solve(gram_mat, rhs):
+    """``gram_mat^{-1} rhs`` with in-lane rank deflation: the Cholesky
+    fast path when the factor is finite (the common, full-rank case -
+    bit-identical to the pre-deflation block step), else the
+    eigenvalue pseudo-inverse that deflates the collapsed direction
+    (``lax.cond`` - one branch executes).  Returns ``(solution,
+    collapsed)``; a non-finite SOLUTION even after deflation is the
+    terminal tier's signal (the masked-batched continuation)."""
+    lw = jnp.linalg.cholesky(gram_mat)
+    chol = jax.scipy.linalg.cho_solve((lw, True), rhs)
+    ok = jnp.all(jnp.isfinite(chol))
+    sol = lax.cond(ok, lambda: chol,
+                   lambda: _gram_rank_deflated_solve(gram_mat, rhs))
+    return sol, ~ok
 
 
 def _run_block(a, b, m, preconditioned, bstate, thresh_sq, maxiter,
                cap, check_every, dot_many, axis_name):
     """The block-CG loop plus its in-trace masked-batched continuation.
 
-    The block loop freezes (``broke``) one step before a singular Gram
-    factor would poison the iterate; the continuation below re-seeds
-    the independent recurrences from the frozen ``(x, r)`` (a steepest-
-    descent restart: p = z = M r) and runs the SAME masked batched loop
-    as ``method="batched"`` under the remaining iteration budget.  When
-    nothing broke - the common case - every lane is converged (or the
-    budget is gone) and the continuation's predicate is false on entry:
-    zero extra iterations, zero extra exchanges.
+    Gram rank collapse (converged or linearly dependent columns) is
+    first deflated IN-LANE: the collapsed direction is dropped from
+    the Gram solves by the eigenvalue pseudo-inverse
+    (:func:`_gram_solve`) and the block iteration continues - no
+    restart, no lost Krylov space.  Only when even the deflated solve
+    goes non-finite (a genuinely poisoned state) does the TERMINAL
+    tier fire: the loop freezes (``broke``) one step before the NaN
+    would poison the iterate, and the continuation below re-seeds the
+    independent recurrences from the frozen ``(x, r)`` (a steepest-
+    descent restart: p = z = M r) and runs the SAME masked batched
+    loop as ``method="batched"`` under the remaining iteration budget.
+    When nothing broke - the common case - every lane is converged (or
+    the budget is gone) and the continuation's predicate is false on
+    entry: zero extra iterations, zero extra exchanges.
     """
     gram = partial(blas1.gram, axis_name=axis_name)
 
@@ -472,21 +652,19 @@ def _run_block(a, b, m, preconditioned, bstate, thresh_sq, maxiter,
         live = (s.rr >= thresh_sq) & (s.rr > 0)
         q = a.matmat(s.p)                     # ONE sweep, all lanes
         w = gram(s.p, q)                      # P^T A P  (k, k)
-        lw = jnp.linalg.cholesky(w)           # NaN when not SPD
-        alpha = jax.scipy.linalg.cho_solve((lw, True), s.gamma)
+        alpha, _ = _gram_solve(w, s.gamma)
         x = s.x + s.p @ alpha
         r = s.r - q @ alpha
         z = m.matmat(r) if preconditioned else r
         gamma_new = gram(r, z)
-        lg = jnp.linalg.cholesky(s.gamma)
-        beta = jax.scipy.linalg.cho_solve((lg, True), gamma_new)
+        beta, _ = _gram_solve(s.gamma, gamma_new)
         p = z + s.p @ beta
         rr = dot_many(r, r)
         ok = jnp.all(jnp.isfinite(alpha)) & jnp.all(jnp.isfinite(beta)) \
             & jnp.all(jnp.isfinite(rr))
-        # a rank-collapsed Gram (converged or linearly dependent
-        # columns) must freeze the PRE-step state: the NaN factors
-        # above already contaminated every candidate array
+        # non-finite PAST the in-lane deflation must freeze the
+        # PRE-step state: the NaN factors above already contaminated
+        # every candidate array (the terminal fallback tier)
         sel = lambda new, old: jnp.where(ok, new, old)
         return _BlockState(
             k=jnp.where(ok, s.k + 1, s.k),
@@ -513,22 +691,24 @@ def _run_block(a, b, m, preconditioned, bstate, thresh_sq, maxiter,
     mstate = _ManyState(
         k=final.k, x=final.x, r=final.r, p=z, rho=rho, rr=final.rr,
         iters=final.iters, indefinite=final.indefinite)
-    mfinal, _ = _run_batched(a, m, preconditioned, mstate, thresh_sq,
-                             maxiter, cap, check_every, dot_many,
-                             None, b.dtype)
+    mfinal, _, _ = _run_batched(a, m, preconditioned, mstate,
+                                thresh_sq, maxiter, cap, check_every,
+                                dot_many, None, b.dtype)
     fell_back = final.broke & (mfinal.iters > final.iters).any()
     return mfinal, fell_back
 
 
 @partial(jax.jit, static_argnames=("maxiter", "check_every", "method",
-                                   "compensated", "flight", "fault"))
+                                   "compensated", "flight", "fault",
+                                   "basis"))
 def _solve_many_jit(a, b, x0, tol, rtol, maxiter, m, iter_cap,
                     check_every, method, compensated, flight,
-                    fault=None):
+                    fault=None, deflate=None, basis=None):
     return cg_many(a, b, x0, tol=tol, rtol=rtol, maxiter=maxiter, m=m,
                    iter_cap=iter_cap, check_every=check_every,
                    method=method, compensated=compensated,
-                   flight=flight, fault=fault)
+                   flight=flight, fault=fault, deflate=deflate,
+                   basis=basis)
 
 
 def solve_many(
@@ -546,6 +726,8 @@ def solve_many(
     compensated: bool = False,
     flight=None,
     fault=None,
+    deflate=None,
+    basis=None,
 ) -> CGBatchResult:
     """Jitted single-call many-RHS entry point (the ``solve()`` of the
     batched tier): compile once per (operator structure, shapes,
@@ -567,11 +749,17 @@ def solve_many(
     rtol_a = jnp.asarray(rtol, b.dtype)
     cap_a = jnp.asarray(maxiter if iter_cap is None else iter_cap,
                         jnp.int32)
+    if deflate is not None:
+        from .recycle import check_space
+
+        check_space(deflate, a)         # typed RecycleMismatch
     _note_engine("many", method, check_every, n_rhs=int(b.shape[1]),
                  **({"flight_stride": flight.stride}
                     if flight is not None else {}),
                  **({"fault": fault.fingerprint()}
-                    if fault is not None else {}))
+                    if fault is not None else {}),
+                 **({"deflate_k": deflate.k}
+                    if deflate is not None else {}))
     return _solve_many_jit(a, b, x0, tol_a, rtol_a, maxiter, m, cap_a,
                            check_every, method, compensated, flight,
-                           fault=fault)
+                           fault=fault, deflate=deflate, basis=basis)

@@ -1,0 +1,739 @@
+"""Krylov subspace recycling: deflated CG for repeat traffic.
+
+The serving tier solves the SAME operator thousands of times with
+fresh right-hand sides (``serve/``, ROADMAP item 2) - the textbook
+setting where recycling pays: every CG solve is a Lanczos process in
+disguise, so the spectral information it bought (approximate extreme
+eigenpairs) can be harvested after the solve and DEFLATED from the
+next one, and the service gets measurably faster the longer it runs
+(prototype on the committed skewed fixture: 48 -> 46 -> 45 -> 44 -> 43
+iterations over five solves; 24^2 Poisson: 83 -> 67 -> 56 -> 55,
+against an exact-eigenvector deflation floor of 54).
+
+Three pieces, each riding machinery earlier PRs built:
+
+* **Harvest** (:func:`harvest_space`).  The solve carries a small
+  fixed-size **basis ring** (:class:`BasisConfig` - the flight ring's
+  sibling: last ``capacity`` normalized residuals, one masked ring
+  write per iteration, compiled to NOTHING when off) and the flight
+  recorder's alpha/beta columns define the CG-Lanczos tridiagonal
+  (``telemetry.health.lanczos_tridiagonal`` - the EXACT
+  ``V_w^T A V_w`` of the ring's window, stride-1 enforced loudly).
+  Eigenvectors of that small tridiagonal are Ritz-vector
+  COEFFICIENTS; combined with the ring they give n-dimensional
+  approximate extreme eigenvectors of A.  Harvests ACCUMULATE: passing
+  the previous :class:`RecycleSpace` Rayleigh-Ritz-compresses
+  ``[W_old | W_window]`` back to ``k`` columns, so repeat solves
+  refine the space toward the true extreme invariant subspace
+  (GCRO-DR's recycling loop, adapted to CG).
+* **Deflated-CG lane** (``cg``/``cg_many`` ``deflate=``).  The
+  standard SPD deflation: at entry ``x0 += W (W^T A W)^{-1} W^T r0``
+  (a Galerkin solve in the recycled space - the residual starts
+  A-orthogonal to W), and every iteration's new direction is projected
+  against ``A W``.  Distributed, the per-iteration ``(k,)``-wide
+  ``(AW)^T z`` reduction FUSES into the residual-norm psum, so the
+  per-iteration collective COUNT is unchanged (comm_cost-asserted).
+  ``deflate=None`` leaves the traced jaxpr bit-identical.
+* **Serve integration** (``serve.RecyclePolicy``): a per-handle
+  ``RecycleSpace`` keyed by the handle fingerprint, harvested from
+  early live dispatches, refreshed on a quality schedule, consulted
+  automatically with zero API change, and dropped together with the
+  handle's compiled solvers when the dist_cg LRU evicts them.
+
+Scope: ``method="cg"`` / ``method="batched"`` recurrences on the
+assembled-CSR allgather/gather lanes (plus every single-device
+``LinearOperator``).  The ring and the projections cost
+``O(capacity * n)`` carry and ``O(n k)`` work per iteration - sized
+for the service's "thousands of medium systems", not the 256^3
+streaming north star (the one-kernel engines refuse the recorder).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import numpy as np
+
+__all__ = [
+    "BASIS_CAPACITY_LIMIT",
+    "BasisConfig",
+    "DEFAULT_K",
+    "HarvestError",
+    "HarvestInfo",
+    "RecycleMismatch",
+    "RecycleSpace",
+    "basis_init",
+    "basis_init_many",
+    "basis_record",
+    "basis_record_many",
+    "check_space",
+    "harvest_space",
+    "recycled_sequence",
+    "space_layout",
+]
+
+#: default recycled-space dimension (columns of W)
+DEFAULT_K = 8
+
+#: hard cap on basis-ring capacity: the ring rides the solve carry at
+#: ``capacity * n`` elements, so 128 rows keep a 1M-row f32 solve's
+#: recorder under 512 MB and a serve-scale (10^3..10^5 rows) one at
+#: tens of MB.  Solves longer than the capacity wrap and harvest from
+#: the trailing window only (weaker, still convergent - accumulation
+#: across solves recovers the lost modes).
+BASIS_CAPACITY_LIMIT = 128
+
+
+class RecycleMismatch(ValueError):
+    """A :class:`RecycleSpace` was offered to a solve it does not fit:
+    different operator fingerprint or row count.  Typed so callers
+    (the serve tier, tests) can refuse wrong-space deflation without
+    string matching - a wrong space would not corrupt the ANSWER (the
+    projection is algebraically valid for any full-rank W) but it
+    would silently waste every projection and could stall
+    convergence."""
+
+
+class HarvestError(ValueError):
+    """The basis ring / flight record cannot support a harvest (solve
+    too short, decimated record, non-SPD Gram)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BasisConfig:
+    """Static basis-ring configuration (hashable - rides jit static
+    args and compiled-solver cache keys, exactly like
+    ``FlightConfig``).
+
+    ``capacity``: ring rows of normalized residuals kept in the solve
+    carry; once ``capacity * stride`` iterations have run, the oldest
+    rows are overwritten (trailing window).
+    ``stride``: decimation, flight-ring style.  The ring records at
+    any stride, but :func:`harvest_space` REFUSES stride != 1 - the
+    Lanczos tridiagonal couples consecutive iterations (see
+    ``telemetry.health.lanczos_tridiagonal``).
+    ``lane``: which column of a batched (many-RHS) solve the ring
+    records (the harvest's Lanczos process must be ONE lane's).
+    """
+
+    capacity: int = 32
+    stride: int = 1
+    lane: int = 0
+
+    def __post_init__(self):
+        if self.capacity < 2:
+            raise ValueError(
+                f"capacity must be >= 2, got {self.capacity}")
+        if self.capacity > BASIS_CAPACITY_LIMIT:
+            raise ValueError(
+                f"capacity {self.capacity} exceeds "
+                f"BASIS_CAPACITY_LIMIT={BASIS_CAPACITY_LIMIT} (the "
+                f"ring rides the solve carry at capacity * n elements)")
+        if self.stride < 1:
+            raise ValueError(f"stride must be >= 1, got {self.stride}")
+        if self.lane < 0:
+            raise ValueError(f"lane must be >= 0, got {self.lane}")
+
+    @classmethod
+    def for_solve(cls, maxiter: int, lane: int = 0,
+                  limit: int = BASIS_CAPACITY_LIMIT) -> "BasisConfig":
+        """Capacity sized so a ``maxiter``-iteration solve never wraps
+        (bounded by ``limit``) - the same rule as
+        ``FlightConfig.for_solve``."""
+        return cls(capacity=max(2, min(maxiter + 1, limit)), lane=lane)
+
+
+# ---------------------------------------------------------------------------
+# the in-loop ring: (iterations, vectors) carried in the solve state
+
+
+def basis_init(cfg: BasisConfig, dtype, k0, r, rr):
+    """Fresh basis ring with the initial residual recorded.  The
+    buffer is a ``(its, vecs)`` pair: ``its (capacity,) int32`` slot
+    iterations (-1 = never written) and ``vecs (capacity, n)`` rows of
+    ``r / ||r||`` (zeros where unwritten - a zero row is inert in
+    every downstream matmul, unlike NaN)."""
+    import jax.numpy as jnp
+
+    its = jnp.full((cfg.capacity,), -1, jnp.int32)
+    vecs = jnp.zeros((cfg.capacity,) + r.shape, dtype)
+    return basis_record((its, vecs), cfg, k0, r, rr)
+
+
+def basis_record(buf, cfg: BasisConfig, k, r, rr, active=None):
+    """One masked ring write of the normalized residual - the flight
+    ring's write rule (``k % stride == 0`` -> slot
+    ``(k // stride) % capacity``), pure device ops, loop-carry
+    friendly.  ``rr`` is the (psum'd, global) ``||r||^2`` so the
+    stored row is the unit GLOBAL residual's local shard.  ``active``
+    (a traced bool) additionally gates the write - a batched solve's
+    recorded lane stops writing once it FREEZES, so its frozen
+    residual can never wrap the ring and evict the real rows while
+    slower batchmates keep iterating."""
+    import jax.numpy as jnp
+
+    its, vecs = buf
+    k = jnp.asarray(k)
+    write = (k % cfg.stride) == 0
+    if active is not None:
+        write = write & active
+    slot = (k // cfg.stride) % cfg.capacity
+    inv = jnp.where(rr > 0, 1.0 / jnp.sqrt(rr), 0.0).astype(vecs.dtype)
+    row = r.astype(vecs.dtype) * inv
+    its = its.at[slot].set(jnp.where(write, k.astype(jnp.int32),
+                                     its[slot]))
+    vecs = vecs.at[slot].set(jnp.where(write, row, vecs[slot]))
+    return its, vecs
+
+
+def basis_init_many(cfg: BasisConfig, dtype, k0, r, rr):
+    """Batched-solve ring init: records lane ``cfg.lane`` of the
+    ``(n, k_rhs)`` residual stack (``rr`` per-lane ``(k_rhs,)``)."""
+    return basis_init(cfg, dtype, k0, r[:, cfg.lane], rr[cfg.lane])
+
+
+def basis_record_many(buf, cfg: BasisConfig, k, r, rr, active=None):
+    return basis_record(buf, cfg, k, r[:, cfg.lane], rr[cfg.lane],
+                        active=active)
+
+
+# ---------------------------------------------------------------------------
+# the recycled space
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=("w", "aw", "chol"),
+    meta_fields=("n", "k", "layout"),
+)
+@dataclasses.dataclass(frozen=True)
+class RecycleSpace:
+    """A harvested deflation space: ``W`` (n x k, orthonormal columns,
+    row-partitioned exactly like ``x`` in distributed solves), the
+    precomputed ``A W``, and the Cholesky factor of ``W^T A W`` -
+    everything the deflated lane's projections consume, with no solve
+    of the small system ever re-factorized in the hot loop.
+
+    Registered as a pytree whose META is only the STABLE identity
+    ``(n, k, layout)``: a refreshed space with the same shape/layout
+    reuses the compiled deflated solver (no retrace per harvest).
+    Quality/age live on the companion :class:`HarvestInfo` instead.
+    """
+
+    w: object            # (n, k) orthonormal Ritz basis
+    aw: object           # (n, k) = A @ W
+    chol: object         # (k, k) lower Cholesky of W^T A W
+    n: int
+    k: int
+    layout: str          # operator fingerprint + row count
+
+    def fingerprint(self) -> str:
+        return f"{self.layout}:k{self.k}"
+
+
+@dataclasses.dataclass(frozen=True)
+class HarvestInfo:
+    """One harvest's quality digest (host-side; JSON-ready)."""
+
+    k: int
+    window: int                 # tridiagonal rows the harvest used
+    iterations: int             # source solve's iteration count
+    ritz: tuple                 # kept Ritz values, ascending
+    quality: tuple              # ||A w - theta w|| / |theta| per pair
+    accumulated: bool           # previous space was folded in
+
+    def to_json(self) -> dict:
+        return {
+            "k": self.k, "window": self.window,
+            "iterations": self.iterations,
+            "ritz_min": float(self.ritz[0]) if self.ritz else None,
+            "ritz_max": float(self.ritz[-1]) if self.ritz else None,
+            "quality_max": (float(max(self.quality))
+                            if self.quality else None),
+            "accumulated": self.accumulated,
+        }
+
+
+def _as_linear_operator(a):
+    from ..models.operators import LinearOperator
+
+    if isinstance(a, LinearOperator):
+        return a
+    from .cg import _as_operator
+
+    return _as_operator(a)
+
+
+#: id-keyed weakref memo of layout tokens: the fingerprint walk is
+#: O(nnz) host work, and a deflated dispatch path (solve/solve_many
+#: per batch) must not re-hash the whole matrix every call - the memo
+#: makes repeat checks on a LIVE operator object O(1).  Dead entries
+#: are pruned opportunistically; a fresh operator object (new id)
+#: simply recomputes.
+_LAYOUT_MEMO: dict = {}
+
+
+def space_layout(a) -> str:
+    """The layout token a space is checked against: the operator's
+    mathematical fingerprint (``utils.checkpoint.operator_fingerprint``
+    - the serve handle's scheme) plus the row count.  Spaces are
+    harvested and stored in the CALLER's global row ordering, so the
+    same token serves single-device and every distributed lane (the
+    dispatch path applies its own plan permutation/padding to W just
+    like it does to b).  Memoized per live operator object (the walk
+    is O(nnz); repeat dispatches on one operator pay it once)."""
+    import weakref
+
+    from ..utils.checkpoint import operator_fingerprint
+
+    a = _as_linear_operator(a)
+    hit = _LAYOUT_MEMO.get(id(a))
+    if hit is not None and hit[0]() is a:
+        return hit[1]
+    token = f"{operator_fingerprint(a)[:12]}:{int(a.shape[0])}"
+    try:
+        ref = weakref.ref(a)
+    except TypeError:
+        return token
+    if len(_LAYOUT_MEMO) > 256:
+        for key in [k for k, (r, _) in _LAYOUT_MEMO.items()
+                    if r() is None]:
+            _LAYOUT_MEMO.pop(key, None)
+    _LAYOUT_MEMO[id(a)] = (ref, token)
+    return token
+
+
+def check_space(space, a) -> None:
+    """Typed refusal (never a wrong-space deflation): the space must
+    have been harvested from THIS operator."""
+    if not isinstance(space, RecycleSpace):
+        raise TypeError(
+            f"deflate must be a solver.recycle.RecycleSpace, got "
+            f"{type(space).__name__}")
+    expected = space_layout(a)
+    if space.layout != expected:
+        raise RecycleMismatch(
+            f"RecycleSpace layout {space.layout!r} does not match this "
+            f"operator ({expected!r}): the space was harvested from a "
+            f"different matrix (or row count) and deflating with it "
+            f"would silently waste every projection. Harvest a space "
+            f"from THIS operator (solver.recycle.harvest_space).")
+
+
+# ---------------------------------------------------------------------------
+# harvest: basis ring + tridiagonal -> RecycleSpace
+
+
+def _decode_basis(basis) -> tuple:
+    """Host view of a fetched ring: ``(iterations (m,), vectors
+    (m, n))`` sorted by iteration, unwritten slots dropped."""
+    its, vecs = basis
+    its = np.asarray(its)
+    vecs = np.asarray(vecs, dtype=np.float64)
+    # a broken-down solve writes non-finite rows (NaN residuals) -
+    # drop them here so the harvest fails TYPED (too-small window ->
+    # HarvestError) instead of feeding NaN into the SVD
+    ok = (its >= 0) & np.isfinite(vecs).all(axis=1)
+    its, vecs = its[ok], vecs[ok]
+    order = np.argsort(its, kind="stable")
+    return its[order].astype(np.int64), vecs[order]
+
+
+def harvest_space(
+    a,
+    result,
+    *,
+    k: int = DEFAULT_K,
+    prev: Optional[RecycleSpace] = None,
+    lane: int = 0,
+    n_rhs: Optional[int] = None,
+    note: bool = True,
+) -> tuple:
+    """Combine a solve's basis ring with its flight record into a
+    :class:`RecycleSpace`; returns ``(space, HarvestInfo)``.
+
+    Args:
+      a: the operator the solve ran (the global object - harvesting
+        pays one ``matmat`` of an ``(n, <= 2k)`` stack to form ``A W``
+        and the Gram factor).
+      result: a ``CGResult`` / ``CGBatchResult`` carrying ``.basis``
+        (the ring - solve with ``basis=BasisConfig(...)``) and
+        ``.flight`` (stride-1 recorder - solve with
+        ``flight=FlightConfig(stride=1)``).
+      k: recycled-space dimension (smallest-Ritz-value pairs kept; the
+        small end of the spectrum is what throttles CG).
+      prev: accumulate - Rayleigh-Ritz-compress ``[prev.W | window
+        Ritz vectors]`` back to ``k`` columns.  Repeat harvests
+        converge the space toward the true extreme invariant subspace
+        even when each solve's ring only windows its tail.
+      lane/n_rhs: batched solves - which lane the ring recorded and
+        the stack width (decodes the batched flight buffer).
+
+    Raises :class:`HarvestError` when the record cannot support the
+    reconstruction (and, via ``telemetry.health``, a loud stride-1
+    refusal for decimated rings - never silent junk Ritz values).
+    """
+    import jax.numpy as jnp
+
+    from ..telemetry import health
+    from ..telemetry.flight import FlightRecord, lanes_from_buffer
+
+    a = _as_linear_operator(a)
+    if getattr(result, "basis", None) is None:
+        raise HarvestError(
+            "the solve carried no basis ring: pass "
+            "basis=BasisConfig(...) (and flight=FlightConfig(stride=1)"
+            ") to the solve that should be harvested")
+    if getattr(result, "flight", None) is None:
+        raise HarvestError(
+            "the solve carried no flight recorder: the harvest needs "
+            "the alpha/beta tridiagonal - pass "
+            "flight=FlightConfig(stride=1)")
+    if n_rhs is not None and n_rhs > 1:
+        record = lanes_from_buffer(result.flight, n_rhs)[lane]
+    else:
+        record = FlightRecord.from_buffer(result.flight)
+    try:
+        diag, off, res_its = health.lanczos_tridiagonal(record)
+    except ValueError as e:
+        raise HarvestError(str(e)) from e
+
+    bits, bvecs = _decode_basis(result.basis)
+    # intersect: tridiagonal rows whose residual vector the ring kept
+    pos = {int(t): i for i, t in enumerate(bits)}
+    keep = np.array([int(t) in pos for t in res_its])
+    if int(keep.sum()) < 2:
+        raise HarvestError(
+            f"basis ring (iterations {bits[0] if bits.size else '-'}"
+            f"..{bits[-1] if bits.size else '-'}) and tridiagonal rows "
+            f"({res_its[0]}..{res_its[-1]}) share < 2 iterations - "
+            f"ring capacity too small for this solve?")
+    # the shared window must stay consecutive for the tridiagonal to
+    # remain a principal submatrix: take the trailing consecutive run
+    kept_idx = np.nonzero(keep)[0]
+    brk = np.nonzero(np.diff(kept_idx) != 1)[0]
+    first = kept_idx[int(brk[-1]) + 1] if brk.size else kept_idx[0]
+    sel = np.arange(first, kept_idx[-1] + 1)
+    w_dim = sel.shape[0]
+    if w_dim < 2:
+        raise HarvestError("usable consecutive window < 2 rows")
+    t_w = np.diag(diag[sel])
+    if w_dim > 1:
+        o = off[sel[:-1]]
+        t_w += np.diag(o, 1) + np.diag(o, -1)
+    try:
+        lam, coeff = np.linalg.eigh(t_w)
+    except np.linalg.LinAlgError as e:
+        raise HarvestError(f"tridiagonal eigendecomposition failed: "
+                           f"{e}") from e
+    kd = int(min(k, w_dim))
+    idx = np.argsort(lam)[:kd]
+    # Lanczos vectors alternate sign against the stored residuals:
+    # v_t = (-1)^t r_t/||r_t||; only the RELATIVE alternation matters
+    # (a global sign scales whole columns)
+    rows = np.array([pos[int(t)] for t in res_its[sel]])
+    signs = ((-1.0) ** np.arange(w_dim))[:, None]
+    w_window = bvecs[rows].T @ (signs * coeff[:, idx])
+
+    basis = w_window if prev is None \
+        else np.hstack([np.asarray(prev.w, dtype=np.float64), w_window])
+    # orthonormalize by SVD (rank-revealing: an accumulated harvest
+    # overlaps the previous space, and QR's R would be near-singular)
+    try:
+        u, s, _ = np.linalg.svd(basis, full_matrices=False)
+    except np.linalg.LinAlgError as e:
+        # a typed refusal, never an escaping LinAlgError: the serve
+        # schedule and recycled_sequence catch HarvestError and carry
+        # on undeflated
+        raise HarvestError(f"basis orthonormalization failed: "
+                           f"{e}") from e
+    good = s > max(1e-8 * float(s[0]), 1e-30)
+    q = u[:, good]
+    if q.shape[1] < 1:
+        raise HarvestError("harvested basis is numerically rank-0")
+    dtype = np.asarray(result.x).dtype
+    aq = np.asarray(a.matmat(jnp.asarray(q, dtype)), dtype=np.float64)
+    g = q.T @ aq
+    g = 0.5 * (g + g.T)
+    try:
+        mu, z = np.linalg.eigh(g)
+    except np.linalg.LinAlgError as e:
+        raise HarvestError(f"Rayleigh-Ritz eigendecomposition "
+                           f"failed: {e}") from e
+    if not np.all(np.isfinite(mu)):
+        raise HarvestError("Rayleigh-Ritz projection is non-finite "
+                           "(non-finite basis vectors?)")
+    kd = int(min(k, q.shape[1]))
+    order = np.argsort(mu)[:kd]
+    while kd >= 1:
+        zsel = z[:, order[:kd]]
+        g_w = zsel.T @ g @ zsel
+        g_w = 0.5 * (g_w + g_w.T)
+        try:
+            chol = np.linalg.cholesky(g_w)
+            break
+        except np.linalg.LinAlgError:
+            kd -= 1          # drop the worst-conditioned direction
+    else:
+        raise HarvestError(
+            "W^T A W is not positive definite at any k (non-SPD "
+            "operator, or a poisoned trace)")
+    zsel = z[:, order[:kd]]
+    w_final = q @ zsel
+    aw_final = aq @ zsel
+    ritz = mu[order[:kd]]
+    quality = tuple(
+        float(np.linalg.norm(aw_final[:, i] - ritz[i] * w_final[:, i])
+              / max(abs(float(ritz[i])), 1e-300))
+        for i in range(kd))
+
+    space = RecycleSpace(
+        w=jnp.asarray(w_final, dtype),
+        aw=jnp.asarray(aw_final, dtype),
+        chol=jnp.asarray(chol, dtype),
+        n=int(a.shape[0]), k=kd, layout=space_layout(a))
+    info = HarvestInfo(
+        k=kd, window=w_dim,
+        iterations=int(record.iterations[-1]) if len(record) else 0,
+        ritz=tuple(float(v) for v in ritz),
+        quality=quality, accumulated=prev is not None)
+    if note:
+        note_harvest(info)
+    return space, info
+
+
+def note_harvest(info: HarvestInfo, **extra) -> None:
+    """Route one harvest through the observability stack: the
+    ``recycle_harvest`` event plus the space-quality gauges."""
+    from ..telemetry import events
+    from ..telemetry.registry import REGISTRY
+
+    REGISTRY.counter(
+        "recycle_harvests_total",
+        "RecycleSpace harvests (Ritz extraction from a solve's basis "
+        "ring + flight record)").inc()
+    REGISTRY.gauge(
+        "recycle_space_k",
+        "columns of the most recently harvested RecycleSpace").set(
+            info.k)
+    if info.ritz:
+        REGISTRY.gauge(
+            "recycle_ritz_min",
+            "smallest kept Ritz value of the most recent harvest").set(
+                float(info.ritz[0]))
+    events.emit("recycle_harvest", **info.to_json(), **extra)
+
+
+def note_applied(k: int, iterations: int, baseline: Optional[float],
+                 **extra) -> None:
+    """The deflation-consumer side: a solve ran with a recycled space;
+    record the measured iterations against the undeflated baseline
+    (the iters-saved gauge the ROADMAP acceptance names)."""
+    from ..telemetry import events
+    from ..telemetry.registry import REGISTRY
+
+    saved = None if baseline is None else float(baseline) - iterations
+    if saved is not None:
+        REGISTRY.gauge(
+            "recycle_iters_saved",
+            "iterations saved by the most recent deflated solve vs "
+            "the handle's undeflated baseline").set(saved)
+    events.emit("recycle_applied", k=k, iterations=int(iterations),
+                **({"baseline_iterations": float(baseline),
+                    "iters_saved": saved}
+                   if baseline is not None else {}),
+                **extra)
+
+
+# ---------------------------------------------------------------------------
+# the repeat-solve driver (CLI --recycle; also the example's loop)
+
+
+@dataclasses.dataclass(frozen=True)
+class RecycleEntry:
+    """One solve of a :func:`recycled_sequence` run."""
+
+    index: int
+    result: object
+    elapsed_s: float
+    harvest_s: float
+    deflated: bool
+    info: Optional[HarvestInfo]
+
+    def to_json(self) -> dict:
+        return {
+            "index": self.index,
+            "iterations": int(self.result.iterations),
+            "converged": bool(self.result.converged),
+            "elapsed_s": float(self.elapsed_s),
+            "harvest_s": float(self.harvest_s),
+            "deflated": self.deflated,
+            **({"harvest": self.info.to_json()}
+               if self.info is not None else {}),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class RecycleSequenceResult:
+    entries: tuple = ()
+
+    @property
+    def result(self):
+        return self.entries[-1].result
+
+    def iterations(self):
+        return [int(e.result.iterations) for e in self.entries]
+
+    def summary(self) -> dict:
+        its = self.iterations()
+        solve_wall = sum(e.elapsed_s for e in self.entries)
+        harvest_wall = sum(e.harvest_s for e in self.entries)
+        last = self.entries[-1]
+        return {
+            "repeats": len(self.entries),
+            "iterations": its,
+            "first_solve_iterations": its[0],
+            "final_solve_iterations": its[-1],
+            "iters_saved": its[0] - its[-1],
+            "harvest_overhead_pct": round(
+                100.0 * harvest_wall / max(solve_wall, 1e-30), 3),
+            "k": last.info.k if last.info is not None else None,
+            "solves": [e.to_json() for e in self.entries],
+        }
+
+    def describe_lines(self):
+        lines = []
+        for e in self.entries:
+            tag = "deflated" if e.deflated else "harvest source"
+            h = (f", harvest {e.harvest_s * 1e3:.1f} ms "
+                 f"(k={e.info.k}, ritz_min {e.info.ritz[0]:.3g})"
+                 if e.info is not None else "")
+            lines.append(
+                f"solve {e.index + 1} : "
+                f"{int(e.result.iterations)} iters, "
+                f"{e.elapsed_s * 1e3:.3f} ms [{tag}]{h}")
+        its = self.iterations()
+        lines.append(f"recycling : {its[0]} -> {its[-1]} iters/solve "
+                     f"({its[0] - its[-1]} saved)")
+        return lines
+
+
+def recycled_sequence(
+    a,
+    b,
+    *,
+    repeats: int = 2,
+    k: int = DEFAULT_K,
+    capacity: Optional[int] = None,
+    mesh=None,
+    maxiter: int = 2000,
+    rhs_for=None,
+    **kw,
+) -> RecycleSequenceResult:
+    """Solve the same operator ``repeats`` times, harvesting after
+    every solve and deflating the next - the measured
+    iters/solve-falls-every-solve loop (CLI ``--recycle``, bench's
+    ``recycle`` section, ``examples/18_recycling.py``).
+
+    ``rhs_for(i)`` supplies solve ``i``'s right-hand side (repeat
+    traffic); ``None`` reuses ``b``.  ``mesh`` routes through
+    ``parallel.solve_distributed``; ``None`` runs the single-device
+    ``solver.solve``.  Each solve is dispatched twice (compile warmup
+    + timed, the CLI's protocol) so the timings never ingest compile
+    wall.  ``**kw`` forwards to the solve entry point.
+    """
+    from ..telemetry import events
+    from ..telemetry.flight import FlightConfig
+    from ..utils.timing import time_fn
+
+    if repeats < 1:
+        raise ValueError(f"repeats must be >= 1, got {repeats}")
+    cfg = BasisConfig.for_solve(maxiter) if capacity is None \
+        else BasisConfig(capacity=capacity)
+    flight = FlightConfig.for_solve(maxiter, stride=1)
+
+    def dispatch(b_i, space, basis_cfg):
+        if mesh is not None:
+            from ..parallel import solve_distributed
+
+            return solve_distributed(a, b_i, mesh=mesh,
+                                     maxiter=maxiter, flight=flight,
+                                     basis=basis_cfg, deflate=space,
+                                     **kw)
+        from .cg import solve
+
+        return solve(a, b_i, maxiter=maxiter, flight=flight,
+                     basis=basis_cfg, deflate=space, **kw)
+
+    import time as _time
+
+    space = None
+    info = None
+    entries = []
+    for i in range(repeats):
+        b_i = b if rhs_for is None else rhs_for(i)
+        calls = [0]
+
+        def once():
+            calls[0] += 1
+            if calls[0] == 1:
+                with events.scoped(phase="warmup"):
+                    return dispatch(b_i, space, cfg)
+            return dispatch(b_i, space, cfg)
+
+        elapsed, res = time_fn(once, warmup=1, repeats=1)
+        deflated = space is not None
+        if deflated:
+            note_applied(space.k, int(res.iterations),
+                         float(entries[0].result.iterations))
+        t0 = _time.perf_counter()
+        try:
+            space, info = harvest_space(a, res, k=k, prev=space)
+        except HarvestError:
+            info = None          # keep the previous space (if any)
+        harvest_s = _time.perf_counter() - t0
+        entries.append(RecycleEntry(
+            index=i, result=res, elapsed_s=float(elapsed),
+            harvest_s=float(harvest_s), deflated=deflated, info=info))
+    return RecycleSequenceResult(entries=tuple(entries))
+
+
+# ---------------------------------------------------------------------------
+# the deflated lane's device-side projections (consumed by cg/cg_many)
+
+
+def chol_solve(l, rhs):
+    """``(W^T A W)^{-1} rhs`` via the space's precomputed Cholesky
+    factor (``rhs`` a ``(k,)`` vector or ``(k, m)`` stack)."""
+    import jax
+
+    return jax.scipy.linalg.cho_solve((l, True), rhs)
+
+
+def entry_project(space: RecycleSpace, x, r, axis_name):
+    """Galerkin entry correction: ``x += W (W^T A W)^{-1} W^T r`` -
+    after it, ``W^T r = 0`` (the recycled space's component of the
+    error is solved exactly, before the first iteration).  Works for
+    ``(n,)`` vectors and ``(n, k_rhs)`` stacks.  One ``(k,)``- (or
+    ``(k, k_rhs)``-) wide psum at entry on a mesh."""
+    from jax import lax
+
+    wtr = space.w.T @ r
+    if axis_name is not None:
+        wtr = lax.psum(wtr, axis_name)
+    c = chol_solve(space.chol, wtr)
+    return x + space.w @ c, r - space.aw @ c
+
+
+def project_direction(space: RecycleSpace, z, axis_name):
+    """A-orthogonalize a candidate direction against the space:
+    ``z - W (W^T A W)^{-1} (A W)^T z`` (the deflation projector's
+    action; ``A`` symmetric, so ``(AW)^T z = W^T A z``)."""
+    from jax import lax
+
+    wz = space.aw.T @ z
+    if axis_name is not None:
+        wz = lax.psum(wz, axis_name)
+    return z - space.w @ chol_solve(space.chol, wz)

@@ -232,7 +232,7 @@ def _cg_streaming_call(scale, b_grid, x0_grid, tol, rtol, cap, lmin, lmax,
     else:
         from .cg import _flight_while
 
-        state, fbuf = _flight_while(
+        state, fbuf, _ = _flight_while(
             cond, step_ab, state, check_every, fits, flight,
             dtype=jnp.float32, k0=jnp.zeros((), jnp.int32), rr0=rr0)
     k, x = state[0], state[1]

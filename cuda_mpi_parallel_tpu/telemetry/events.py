@@ -136,6 +136,13 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     # state (closed/open/half_open)
     "request_retry": ("request_id", "attempt", "status"),
     "breaker_transition": ("handle", "state"),
+    # Krylov recycling (solver.recycle): a RecycleSpace was harvested
+    # from a solve's basis ring + flight tridiagonal (k columns kept,
+    # window = tridiagonal rows used, iterations = source solve's);
+    # a solve consulted a recycled space (iters_saved vs the
+    # undeflated baseline rides when the consumer knows one)
+    "recycle_harvest": ("k", "window", "iterations"),
+    "recycle_applied": ("k", "iterations"),
     # the solve finished (converged or not) and was synced
     "solve_end": ("status", "iterations", "residual_norm"),
 }

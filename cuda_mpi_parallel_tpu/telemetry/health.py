@@ -53,6 +53,7 @@ __all__ = [
     "classify_trace",
     "emit_solve_health",
     "estimate_condition",
+    "lanczos_tridiagonal",
     "ritz_values",
 ]
 
@@ -97,6 +98,93 @@ class SolveHealth:
         out = dataclasses.asdict(self)
         out["classification"] = self.classification.name
         return out
+
+
+def lanczos_tridiagonal(record: FlightRecord,
+                        window: int = SPECTRAL_WINDOW):
+    """``(diag, off, residual_iterations)`` - the exact principal
+    submatrix of the CG-Lanczos tridiagonal over the record's trailing
+    consecutive run, aligned to the RESIDUAL indices the Lanczos basis
+    vectors carry.
+
+    This is the Krylov-recycling harvest's half of the spectral story
+    (``solver.recycle``): row ``i`` of the returned tridiagonal is the
+    Rayleigh-quotient row of the normalized residual at iteration
+    ``residual_iterations[i]``, so ``V_w^T A V_w`` for a basis-ring
+    window ``V_w`` of those residuals is EXACTLY this matrix -
+    eigenvectors of it are Ritz-vector coefficients, not just Ritz
+    values.  Unlike :func:`ritz_values` (a diagnostic inner bound that
+    tolerates a truncated first row), every entry here carries its full
+    cross term, which is why the first recorded step of the run is
+    consumed as a coefficient source but not given a row.
+
+    Raises ``ValueError`` - loudly, never junk - when the record
+    cannot support the reconstruction:
+
+    * **stride-decimated records** (``record.stride != 1``): the
+      tridiagonal couples CONSECUTIVE iterations; decimated alpha/beta
+      rows would assemble a matrix whose eigenpairs belong to no
+      operator.  Re-record with ``--flight-record 1`` / a stride-1
+      ``FlightConfig`` (the stride-1 requirement is also stated in the
+      README's "Krylov recycling" section).
+    * records with fewer than 3 usable consecutive rows (nothing to
+      window), or whose alpha/beta columns are NaN (resident block
+      traces record no recurrence scalars).
+    """
+    if record.stride != 1:
+        raise ValueError(
+            f"Lanczos/Ritz harvesting needs a stride-1 flight record "
+            f"(consecutive alpha/beta rows assemble the tridiagonal); "
+            f"this record is stride-{record.stride} decimated and "
+            f"would silently produce junk Ritz values. Re-record at "
+            f"stride 1 (--flight-record 1 / FlightConfig(stride=1)).")
+    if len(record) < 3:
+        raise ValueError(
+            f"Lanczos/Ritz harvesting needs >= 3 recorded iterations, "
+            f"got {len(record)} (solve too short, or the ring was "
+            f"overwritten)")
+    its = record.iterations
+    breaks = np.nonzero(np.diff(its) != 1)[0]
+    start = int(breaks[-1]) + 1 if breaks.size else 0
+    its = its[start:]
+    alphas = record.alphas[start:]
+    betas = record.betas[start:]
+    ok = np.isfinite(alphas) & np.isfinite(betas)
+    its, alphas, betas = its[ok], alphas[ok], betas[ok]
+    bad = np.nonzero((alphas <= 0.0) | (betas < 0.0))[0]
+    if bad.size:
+        its = its[:bad[0]]
+        alphas, betas = alphas[:bad[0]], betas[:bad[0]]
+    if alphas.shape[0] > window:
+        its = its[-window:]
+        alphas, betas = alphas[-window:], betas[-window:]
+    m = alphas.shape[0]
+    if m < 2:
+        raise ValueError(
+            "Lanczos/Ritz harvesting found < 2 usable consecutive "
+            "alpha/beta rows (NaN columns - a resident block trace? - "
+            "or non-SPD scalars truncated the run)")
+    # row i describes the residual BEFORE the step recorded at its[i]:
+    # alpha/beta recorded at iteration j are the textbook alpha_{j-1}/
+    # beta_{j-1}, so residual index t = j - 1.  diag(t) = 1/alpha_t +
+    # beta_{t-1}/alpha_{t-1}; the previous-step term for row 0 comes
+    # from the run's FIRST recorded row (consumed, not given a row)
+    # unless the run starts at the solve's first step (t = 0, no
+    # previous term exists).
+    if int(its[0]) == 1:
+        res_its = its - 1
+        diag = 1.0 / alphas
+        diag[1:] += betas[:-1] / alphas[:-1]
+        off = np.sqrt(np.maximum(betas[:-1], 0.0)) / alphas[:-1]
+    else:
+        res_its = its[1:] - 1
+        diag = 1.0 / alphas[1:] + betas[:-1] / alphas[:-1]
+        off = np.sqrt(np.maximum(betas[1:-1], 0.0)) / alphas[1:-1]
+    if diag.shape[0] < 2:
+        raise ValueError(
+            "Lanczos/Ritz harvesting found < 2 tridiagonal rows after "
+            "aligning to residual indices (solve too short)")
+    return diag, off, res_its.astype(np.int64)
 
 
 def ritz_values(record: FlightRecord,

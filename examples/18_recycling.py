@@ -1,0 +1,84 @@
+"""Krylov recycling: repeat traffic gets faster every solve.
+
+A serving workload solves the SAME operator again and again with
+fresh right-hand sides.  Every CG solve is a Lanczos process in
+disguise - it *pays* for spectral information and then throws it
+away.  ``solver.recycle`` keeps it: the solve carries a small basis
+ring of normalized residuals, the flight recorder carries the
+CG-Lanczos tridiagonal, and ``harvest_space`` combines them into a
+``RecycleSpace`` (approximate extreme Ritz vectors W, A W, and the
+Cholesky factor of W^T A W) that later solves DEFLATE - the recycled
+part of the spectrum simply stops costing iterations.  Harvests
+accumulate across solves, so the space converges toward the true
+extreme invariant subspace and iters/solve keeps falling.
+
+This example replays a 6-solve fresh-RHS workload against the
+committed skewed fixture and a 2-D Poisson operator, printing the
+measured iterations-per-solve trajectory (solve 1 = the harvest
+source, solves 2+ deflated), the harvest overhead, and the final
+Ritz values against the operator's true extreme eigenvalues.
+
+Run: python examples/18_recycling.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from cuda_mpi_parallel_tpu.models import mmio, poisson
+from cuda_mpi_parallel_tpu.solver.recycle import recycled_sequence
+
+REPEATS = 6
+TOL = 1e-8
+
+
+def replay(name, a, k):
+    n = int(a.shape[0])
+    rng = np.random.default_rng(7)
+    rhs = [rng.standard_normal(n) for _ in range(REPEATS)]
+    seq = recycled_sequence(a, rhs[0], repeats=REPEATS, k=k,
+                            maxiter=2000, tol=TOL,
+                            rhs_for=lambda i: rhs[i])
+    print(f"== {name} (n={n}, k={k}, tol={TOL:g}) ==")
+    for line in seq.describe_lines():
+        print(f"  {line}")
+    summary = seq.summary()
+    print(f"  harvest overhead: {summary['harvest_overhead_pct']:.1f}% "
+          f"of solve wall (host Ritz extraction - amortizes over the "
+          f"workload and freezes once the space settles)")
+    info = seq.entries[-1].info
+    if info is not None:
+        print(f"  final space: k={info.k}, ritz "
+              f"[{info.ritz[0]:.4g} .. {info.ritz[-1]:.4g}], "
+              f"worst pair quality {max(info.quality):.2e}")
+    if n <= 1024:
+        lam = np.sort(np.linalg.eigvalsh(np.asarray(a.to_dense(),
+                                                    dtype=np.float64)))
+        print(f"  true smallest eigenvalues: "
+              f"{np.round(lam[:4], 4).tolist()}")
+    print()
+    return summary
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    a_skew = mmio.load_matrix_market(
+        os.path.join(os.path.dirname(__file__), "..",
+                     "tests/fixtures/skewed_spd_240.mtx"))
+    s1 = replay("skewed_spd_240 fixture", a_skew, k=12)
+    a_poi = poisson.poisson_2d_csr(24, 24, dtype=np.float64)
+    s2 = replay("Poisson 24x24", a_poi, k=8)
+
+    for name, s in (("skewed", s1), ("poisson", s2)):
+        assert s["final_solve_iterations"] < s["first_solve_iterations"], name
+    print("recycling verdict: iters/solve fell on both operators - "
+          "the longer the workload, the cheaper each solve")
+
+
+if __name__ == "__main__":
+    main()

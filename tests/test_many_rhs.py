@@ -335,21 +335,50 @@ class TestBlockCG:
         assert it_block < it_batched
         assert np.max(np.abs(np.asarray(block.x) - x_true)) < 1e-6
 
-    def test_gram_breakdown_falls_back_without_aborting(self):
-        """ISSUE acceptance: duplicate RHS columns collapse the Gram
-        rank at step one; the solve must finish (masked-batched
-        continuation) instead of aborting, and flag the fallback."""
+    def test_gram_collapse_deflates_in_lane(self):
+        """ISSUE 13 satellite: duplicate RHS columns collapse the Gram
+        rank at step one; the eigenvalue pseudo-inverse deflates the
+        collapsed direction IN-LANE (no restart, fallback stays False)
+        and the block Krylov space keeps converging every lane."""
         a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
         x_true, b = _stack_system(a, 4)
         b[:, 1] = b[:, 0]                      # exact rank collapse
         x_true[:, 1] = x_true[:, 0]
         res = solve_many(a, b, tol=1e-9, maxiter=800, method="block")
-        assert bool(res.fallback)
+        assert not bool(res.fallback)          # deflated, not restarted
         assert np.asarray(res.converged).all()
         assert np.max(np.abs(np.asarray(res.x) - x_true)) < 1e-6
         # identical lanes got identical answers
         np.testing.assert_array_equal(np.asarray(res.x[:, 0]),
                                       np.asarray(res.x[:, 1]))
+        # and the collapse cost no iteration-count regression vs the
+        # distinct-column solve of the same operator
+        _, b_distinct = _stack_system(a, 4)
+        distinct = solve_many(a, b_distinct, tol=1e-9, maxiter=800,
+                              method="block")
+        assert int(np.asarray(res.iterations).max()) \
+            <= int(np.asarray(distinct.iterations).max()) + 8
+
+    def test_gram_breakdown_terminal_fallback_survives(self, monkeypatch):
+        """Regression (ISSUE 13 satellite): when even the in-lane
+        deflation cannot produce a finite Gram solve, the TERMINAL
+        tier - freeze one step before poisoning + masked-batched
+        continuation - still finishes the solve and flags the
+        fallback (the pre-deflation contract)."""
+        from cuda_mpi_parallel_tpu.solver import many as many_mod
+        from cuda_mpi_parallel_tpu.solver.many import cg_many
+
+        def broken_gram_solve(gram_mat, rhs):
+            nan = jnp.full_like(rhs, jnp.nan)
+            return nan, jnp.asarray(True)
+
+        monkeypatch.setattr(many_mod, "_gram_solve", broken_gram_solve)
+        a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+        x_true, b = _stack_system(a, 4)
+        res = cg_many(a, b, tol=1e-9, maxiter=800, method="block")
+        assert bool(res.fallback)              # terminal tier fired
+        assert np.asarray(res.converged).all()
+        assert np.max(np.abs(np.asarray(res.x) - x_true)) < 1e-6
 
     def test_block_with_jacobi(self):
         a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)

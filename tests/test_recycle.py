@@ -1,0 +1,647 @@
+"""Krylov subspace recycling (solver.recycle, ISSUE 13).
+
+Covers the harvest math (windowed Lanczos-Ritz extraction against a
+known spectrum), the deflated-CG lane (single-device, batched and
+distributed - answers match undeflated solves to tolerance, iterations
+strictly fall across a replayed repeat-traffic sequence, the
+per-iteration collective count is unchanged), the RecycleSpace cache
+lifecycle (typed wrong-space refusal, LRU-eviction drop in the serve
+tier), the stride-1 harvest refusal, and the deflate=None /
+basis=None jaxpr bit-identity proofs.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import telemetry
+from cuda_mpi_parallel_tpu.models import mmio, poisson
+from cuda_mpi_parallel_tpu.models.operators import CSRMatrix, Stencil2D
+from cuda_mpi_parallel_tpu.solver import recycle as rec
+from cuda_mpi_parallel_tpu.solver import solve, solve_many
+from cuda_mpi_parallel_tpu.solver.cg import cg
+from cuda_mpi_parallel_tpu.solver.many import cg_many
+from cuda_mpi_parallel_tpu.telemetry import events, health
+from cuda_mpi_parallel_tpu.telemetry.flight import (
+    FlightConfig,
+    FlightRecord,
+    lanes_from_buffer,
+)
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 4, reason="needs >= 4 (virtual) devices")
+
+FIXTURE = "tests/fixtures/skewed_spd_240.mtx"
+
+
+def _fixture():
+    return mmio.load_matrix_market(FIXTURE, dtype=jnp.float64)
+
+
+def _solve_kwargs(maxiter=500):
+    return dict(tol=1e-8, maxiter=maxiter,
+                flight=FlightConfig.for_solve(maxiter, stride=1),
+                basis=rec.BasisConfig.for_solve(maxiter))
+
+
+class TestBasisConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="capacity"):
+            rec.BasisConfig(capacity=1)
+        with pytest.raises(ValueError, match="BASIS_CAPACITY_LIMIT"):
+            rec.BasisConfig(capacity=rec.BASIS_CAPACITY_LIMIT + 1)
+        with pytest.raises(ValueError, match="stride"):
+            rec.BasisConfig(capacity=8, stride=0)
+        with pytest.raises(ValueError, match="lane"):
+            rec.BasisConfig(capacity=8, lane=-1)
+
+    def test_for_solve_caps(self):
+        cfg = rec.BasisConfig.for_solve(10)
+        assert cfg.capacity == 11
+        cfg = rec.BasisConfig.for_solve(10_000)
+        assert cfg.capacity == rec.BASIS_CAPACITY_LIMIT
+
+    def test_hashable_static(self):
+        assert hash(rec.BasisConfig(capacity=8)) \
+            == hash(rec.BasisConfig(capacity=8))
+
+
+class TestHarvest:
+    def test_known_spectrum_recovery(self, rng):
+        """Harvested Ritz values of a diagonal operator converge to
+        its smallest eigenvalues, and the kept pairs' residual
+        quality is small."""
+        diag = np.linspace(1.0, 50.0, 64)
+        a = jnp.diag(jnp.asarray(diag))
+        b = rng.standard_normal(64)
+        res = solve(a, b, **_solve_kwargs(200))
+        assert bool(res.converged)
+        space, info = rec.harvest_space(a, res, k=4, note=False)
+        assert space.k == 4
+        np.testing.assert_allclose(np.asarray(info.ritz),
+                                   diag[:4], rtol=1e-4)
+        assert max(info.quality) < 1e-2
+        # W spans the small-eigenvalue eigenvectors: A W ~ W diag(ritz)
+        w = np.asarray(space.w)
+        aw = np.asarray(space.aw)
+        assert np.linalg.norm(aw - w * np.asarray(info.ritz)) < 1e-2
+
+    def test_harvest_requires_basis_and_flight(self, rng):
+        a = _fixture()
+        b = rng.standard_normal(240)
+        bare = solve(a, b, tol=1e-8, maxiter=500)
+        with pytest.raises(rec.HarvestError, match="basis"):
+            rec.harvest_space(a, bare, k=4)
+        flight_only = solve(a, b, tol=1e-8, maxiter=500,
+                            flight=FlightConfig.for_solve(500))
+        with pytest.raises(rec.HarvestError, match="basis"):
+            rec.harvest_space(a, flight_only, k=4)
+
+    def test_stride_decimated_record_refuses(self, rng):
+        """ISSUE 13 satellite: harvesting from a stride-decimated
+        flight ring refuses LOUDLY - stride-1 requirement named in the
+        error - instead of silently producing junk Ritz values."""
+        a = _fixture()
+        b = rng.standard_normal(240)
+        res = solve(a, b, tol=1e-8, maxiter=500,
+                    flight=FlightConfig(capacity=128, stride=4),
+                    basis=rec.BasisConfig(capacity=64, stride=4))
+        with pytest.raises(rec.HarvestError, match="stride-4"):
+            rec.harvest_space(a, res, k=4)
+
+    def test_lanczos_tridiagonal_stride_refusal_names_stride1(self):
+        record = FlightRecord(
+            iterations=np.arange(0, 20, 2),
+            residual_sq=np.ones(10), alphas=np.ones(10),
+            betas=np.ones(10), stride=2)
+        with pytest.raises(ValueError, match="stride 1"):
+            health.lanczos_tridiagonal(record)
+
+    def test_lanczos_tridiagonal_matches_full_t(self, rng):
+        """The windowed tridiagonal is the EXACT principal submatrix:
+        on an unwrapped record its eigenvalues match ritz_values'."""
+        a = _fixture()
+        b = rng.standard_normal(240)
+        res = solve(a, b, tol=1e-8, maxiter=500,
+                    flight=FlightConfig.for_solve(500, stride=1))
+        record = FlightRecord.from_buffer(res.flight)
+        diag, off, its = health.lanczos_tridiagonal(record)
+        t = np.diag(diag) + np.diag(off, 1) + np.diag(off, -1)
+        lam = np.linalg.eigvalsh(t)
+        ritz = health.ritz_values(record)
+        np.testing.assert_allclose(np.sort(lam), np.sort(ritz),
+                                   rtol=1e-10)
+        assert its[0] == 0 and np.all(np.diff(its) == 1)
+
+    def test_harvest_emits_event_and_gauges(self, rng):
+        a = _fixture()
+        b = rng.standard_normal(240)
+        res = solve(a, b, **_solve_kwargs())
+        with events.capture() as buf:
+            telemetry.force_active(True)
+            try:
+                _, info = rec.harvest_space(a, res, k=6)
+            finally:
+                telemetry.force_active(False)
+        lines = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+        harvests = [e for e in lines if e["event"] == "recycle_harvest"]
+        assert len(harvests) == 1
+        assert harvests[0]["k"] == info.k
+        assert harvests[0]["window"] == info.window
+        from cuda_mpi_parallel_tpu.telemetry.registry import REGISTRY
+
+        assert REGISTRY.gauge("recycle_space_k").value() == info.k
+
+
+class TestDeflatedSolve:
+    def test_deflated_matches_undeflated_to_tolerance(self, rng):
+        """ISSUE 13 satellite: a deflated solve's solution matches the
+        undeflated one to tolerance on the committed skewed fixture -
+        and takes strictly fewer iterations."""
+        a = _fixture()
+        b1 = rng.standard_normal(240)
+        src = solve(a, b1, **_solve_kwargs())
+        space, _ = rec.harvest_space(a, src, k=8, note=False)
+        b2 = rng.standard_normal(240)
+        plain = solve(a, b2, tol=1e-8, maxiter=500)
+        defl = solve(a, b2, tol=1e-8, maxiter=500, deflate=space)
+        assert bool(defl.converged)
+        assert np.max(np.abs(np.asarray(defl.x) - np.asarray(plain.x))) \
+            < 1e-6
+        assert int(defl.iterations) < int(plain.iterations)
+
+    def test_sequence_iterations_strictly_fall(self, rng):
+        """ISSUE 13 acceptance: measured iters/solve strictly
+        decreases across a replayed fresh-RHS workload (accumulated
+        harvests), with per-solve health verdicts CONVERGED."""
+        a = _fixture()
+        rhs = [rng.standard_normal(240) for _ in range(5)]
+        seq = rec.recycled_sequence(a, rhs[0], repeats=5, k=12,
+                                    maxiter=500, tol=1e-8,
+                                    rhs_for=lambda i: rhs[i])
+        its = seq.iterations()
+        assert its[-1] < its[0]
+        # monotone non-increasing up to 1-iteration jitter
+        assert all(b <= a_ + 1 for a_, b in zip(its, its[1:]))
+        for e in seq.entries:
+            assert bool(e.result.converged)
+            record = FlightRecord.from_buffer(e.result.flight)
+            verdict = health.assess_solve_health(
+                record, converged=bool(e.result.converged))
+            assert verdict.classification.name == "CONVERGED"
+        summary = seq.summary()
+        assert summary["final_solve_iterations"] \
+            < summary["first_solve_iterations"]
+        assert summary["harvest_overhead_pct"] >= 0.0
+
+    def test_preconditioned_deflation(self, rng):
+        from cuda_mpi_parallel_tpu.models.operators import (
+            JacobiPreconditioner,
+        )
+
+        a = _fixture()
+        m = JacobiPreconditioner.from_operator(a)
+        b1 = rng.standard_normal(240)
+        src = solve(a, b1, m=m, **_solve_kwargs())
+        space, _ = rec.harvest_space(a, src, k=8, note=False)
+        b2 = rng.standard_normal(240)
+        plain = solve(a, b2, tol=1e-8, maxiter=500, m=m)
+        defl = solve(a, b2, tol=1e-8, maxiter=500, m=m, deflate=space)
+        assert bool(defl.converged)
+        assert int(defl.iterations) <= int(plain.iterations)
+        assert np.max(np.abs(np.asarray(defl.x) - np.asarray(plain.x))) \
+            < 1e-6
+
+    def test_batched_deflation_and_lane_health(self, rng):
+        """Batched lanes deflate column-wise; per-lane health verdicts
+        prove deflation never breaks convergence (ISSUE acceptance)."""
+        a = poisson.poisson_2d_csr(24, 24, dtype=np.float64)
+        n = 576
+        x_true = rng.standard_normal((n, 4))
+        b = np.asarray(a.matmat(jnp.asarray(x_true)))
+        kw = dict(tol=1e-8, maxiter=800,
+                  flight=FlightConfig.for_solve(800, stride=1),
+                  basis=rec.BasisConfig.for_solve(800))
+        src = solve_many(a, b, **kw)
+        space, _ = rec.harvest_space(a, src, k=8, n_rhs=4, note=False)
+        x2 = rng.standard_normal((n, 4))
+        b2 = np.asarray(a.matmat(jnp.asarray(x2)))
+        plain = solve_many(a, b2, tol=1e-8, maxiter=800)
+        defl = solve_many(a, b2, tol=1e-8, maxiter=800, deflate=space,
+                          flight=FlightConfig.for_solve(800, stride=1))
+        assert np.asarray(defl.converged).all()
+        assert np.max(np.abs(np.asarray(defl.x) - x2)) < 1e-6
+        assert (np.asarray(defl.iterations)
+                < np.asarray(plain.iterations)).all()
+        lanes = lanes_from_buffer(defl.flight, 4)
+        verdicts = health.assess_lanes(
+            lanes, converged=defl.converged, statuses=defl.status,
+            iterations=defl.iterations)
+        assert all(v.classification.name == "CONVERGED"
+                   for v in verdicts)
+
+    def test_wrong_space_typed_refusal(self, rng):
+        """ISSUE 13 satellite: a fingerprint/layout mismatch raises a
+        typed RecycleMismatch - never a wrong-space deflation."""
+        a = _fixture()
+        src = solve(a, rng.standard_normal(240), **_solve_kwargs())
+        space, _ = rec.harvest_space(a, src, k=4, note=False)
+        other = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+        with pytest.raises(rec.RecycleMismatch):
+            solve(other, np.ones(256), deflate=space)
+        with pytest.raises(rec.RecycleMismatch):
+            solve_many(other, np.ones((256, 2)), deflate=space)
+        # same-shape different matrix still refuses (fingerprint, not
+        # just row count)
+        a2 = CSRMatrix.from_dense(2.0 * np.asarray(a.to_dense()))
+        with pytest.raises(rec.RecycleMismatch):
+            solve(a2, np.ones(240), deflate=space)
+
+    def test_refusal_matrix(self, rng):
+        a = _fixture()
+        b = rng.standard_normal(240)
+        src = solve(a, b, **_solve_kwargs())
+        space, _ = rec.harvest_space(a, src, k=4, note=False)
+        with pytest.raises(ValueError, match="method='cg'"):
+            cg(a, b, method="cg1", deflate=space)
+        with pytest.raises(ValueError, match="compensated"):
+            cg(a, b, deflate=space, compensated=True)
+        with pytest.raises(ValueError, match="flight"):
+            cg(a, b, basis=rec.BasisConfig(capacity=8))
+        with pytest.raises(TypeError, match="RecycleSpace"):
+            cg(a, b, deflate="nope")
+        with pytest.raises(ValueError, match="engine"):
+            solve(a, b, engine="streaming", deflate=space)
+        with pytest.raises(ValueError, match="batched"):
+            solve_many(a, np.ones((240, 2)), method="block",
+                       deflate=space)
+
+
+class TestZeroPerturbation:
+    """deflate=None / basis=None leave the traced jaxpr BIT-identical
+    (the recycling lanes compile to nothing when off)."""
+
+    def test_cg_deflate_off_jaxpr_identical(self):
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones(256)
+        base = str(jax.make_jaxpr(lambda v: cg(a, v, maxiter=25))(b))
+        off = str(jax.make_jaxpr(
+            lambda v: cg(a, v, maxiter=25, deflate=None,
+                         basis=None))(b))
+        assert off == base
+        # and with a space, the jaxpr genuinely differs
+        diag = jnp.diag(jnp.arange(1.0, 257.0))
+        res = solve(diag, jnp.ones(256), **_solve_kwargs(300))
+        space, _ = rec.harvest_space(diag, res, k=4, note=False)
+        on = str(jax.make_jaxpr(
+            lambda v: cg(a, v, maxiter=25, deflate=space))(b))
+        assert on != base
+
+    def test_cg_basis_off_jaxpr_identical(self):
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones(256)
+        fl = FlightConfig(capacity=7, stride=1)
+        base = str(jax.make_jaxpr(
+            lambda v: cg(a, v, maxiter=25, flight=fl))(b))
+        off = str(jax.make_jaxpr(
+            lambda v: cg(a, v, maxiter=25, flight=fl, basis=None))(b))
+        assert off == base
+        cfg = rec.BasisConfig(capacity=5)
+        on = str(jax.make_jaxpr(
+            lambda v: cg(a, v, maxiter=25, flight=fl, basis=cfg))(b))
+        assert on != base
+        assert "5,256" in on.replace(" ", "")   # the (capacity, n) ring
+        assert "5,256" not in base.replace(" ", "")
+
+    def test_cg_many_deflate_off_jaxpr_identical(self):
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones((256, 3))
+        base = str(jax.make_jaxpr(
+            lambda v: cg_many(a, v, maxiter=25))(b))
+        off = str(jax.make_jaxpr(
+            lambda v: cg_many(a, v, maxiter=25, deflate=None,
+                              basis=None))(b))
+        assert off == base
+
+    @needs_mesh
+    def test_distributed_deflate_off_jaxpr_identical(self):
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            make_mesh,
+            solve_distributed,
+        )
+
+        a = poisson.poisson_2d_csr(8, 8)
+        b = np.ones(64)
+        mesh = make_mesh(4)
+
+        def traced_jaxpr(**kw):
+            dist_cg.clear_solver_cache()
+            captured = {}
+            orig = dist_cg._cached_solver
+
+            def wrapper(key, build, cost_ctx=None, cost_args=None):
+                captured["jaxpr"] = jax.make_jaxpr(build())(*cost_args)
+                return orig(key, build, cost_ctx, cost_args)
+
+            dist_cg._cached_solver = wrapper
+            try:
+                solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                  maxiter=200, **kw)
+            finally:
+                dist_cg._cached_solver = orig
+                dist_cg.clear_solver_cache()
+            return str(captured["jaxpr"])
+
+        assert traced_jaxpr() \
+            == traced_jaxpr(deflate=None, basis=None)
+
+
+@needs_mesh
+class TestDistributedRecycle:
+    def setup_method(self):
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        dist_cg.clear_solver_cache()
+
+    teardown_method = setup_method
+
+    def _mesh(self):
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+
+        return make_mesh(4)
+
+    def test_distributed_deflated_matches_and_saves_iters(self, rng):
+        from cuda_mpi_parallel_tpu.parallel import solve_distributed
+
+        a = _fixture()
+        mesh = self._mesh()
+        b1 = rng.standard_normal(240)
+        src = solve_distributed(a, b1, mesh=mesh, **_solve_kwargs())
+        space, _ = rec.harvest_space(a, src, k=8, note=False)
+        b2 = rng.standard_normal(240)
+        plain = solve_distributed(a, b2, mesh=mesh, tol=1e-8,
+                                  maxiter=500)
+        defl = solve_distributed(a, b2, mesh=mesh, tol=1e-8,
+                                 maxiter=500, deflate=space)
+        assert bool(defl.converged)
+        assert int(defl.iterations) < int(plain.iterations)
+        assert np.max(np.abs(np.asarray(defl.x) - np.asarray(plain.x))) \
+            < 1e-6
+
+    def test_collective_count_unchanged(self, rng):
+        """ISSUE 13 acceptance: the deflated distributed solve issues
+        the SAME number of psums per iteration as the undeflated one -
+        the (k,)-wide projection reduction fused into the residual
+        psum (jaxpr-derived comm_cost proof)."""
+        from cuda_mpi_parallel_tpu.parallel import (
+            dist_cg,
+            solve_distributed,
+        )
+
+        a = _fixture()
+        mesh = self._mesh()
+        b = rng.standard_normal(240)
+        src = solve_distributed(a, b, mesh=mesh, **_solve_kwargs())
+        space, _ = rec.harvest_space(a, src, k=8, note=False)
+
+        def psums(**kw):
+            with events.capture():
+                telemetry.force_active(True)
+                try:
+                    dist_cg.reset_last_comm_cost()
+                    solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                      maxiter=500, **kw)
+                    sc, ctx = dist_cg.last_comm_cost()
+                finally:
+                    telemetry.force_active(False)
+            return sc.per_iteration.psum
+
+        assert psums(deflate=space) == psums()
+
+    def test_plan_and_gather_compose(self, rng):
+        from cuda_mpi_parallel_tpu.parallel import solve_distributed
+
+        a = _fixture()
+        mesh = self._mesh()
+        b1 = rng.standard_normal(240)
+        src = solve_distributed(a, b1, mesh=mesh, plan="auto",
+                                exchange="gather", **_solve_kwargs())
+        space, _ = rec.harvest_space(a, src, k=8, note=False)
+        b2 = rng.standard_normal(240)
+        plain = solve_distributed(a, b2, mesh=mesh, tol=1e-8,
+                                  maxiter=500)
+        defl = solve_distributed(a, b2, mesh=mesh, tol=1e-8,
+                                 maxiter=500, deflate=space,
+                                 plan="auto", exchange="gather")
+        assert bool(defl.converged)
+        assert np.max(np.abs(np.asarray(defl.x) - np.asarray(plain.x))) \
+            < 1e-6
+
+    def test_distributed_refusals(self, rng):
+        from cuda_mpi_parallel_tpu.parallel import solve_distributed
+        from cuda_mpi_parallel_tpu.robust import FaultPlan
+
+        a = _fixture()
+        mesh = self._mesh()
+        b = rng.standard_normal(240)
+        src = solve(a, b, **_solve_kwargs())
+        space, _ = rec.harvest_space(a, src, k=4, note=False)
+        with pytest.raises(ValueError, match="allgather/gather"):
+            solve_distributed(a, b, mesh=mesh, deflate=space,
+                              csr_comm="ring")
+        with pytest.raises(ValueError, match="method='cg'"):
+            solve_distributed(a, b, mesh=mesh, deflate=space,
+                              method="cg1")
+        with pytest.raises(ValueError, match="fault"):
+            solve_distributed(a, b, mesh=mesh, deflate=space,
+                              inject=FaultPlan(site="spmv",
+                                               iteration=10))
+        with pytest.raises(ValueError, match="checkpoint"):
+            solve_distributed(a, b, mesh=mesh, deflate=space,
+                              return_checkpoint=True)
+        with pytest.raises(ValueError, match="flight"):
+            solve_distributed(a, b, mesh=mesh,
+                              basis=rec.BasisConfig(capacity=8))
+
+    def test_dispatcher_mismatch_refusal(self, rng):
+        from cuda_mpi_parallel_tpu.parallel.dist_cg import (
+            ManyRHSDispatcher,
+        )
+
+        a = _fixture()
+        src = solve(a, rng.standard_normal(240), **_solve_kwargs())
+        space, _ = rec.harvest_space(a, src, k=4, note=False)
+        other = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+        disp = ManyRHSDispatcher(other, mesh=self._mesh(), maxiter=200)
+        with pytest.raises(rec.RecycleMismatch):
+            disp.solve(np.ones((256, 2)), deflate=space)
+
+
+@needs_mesh
+class TestServeRecycle:
+    def setup_method(self):
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        dist_cg.clear_solver_cache()
+
+    teardown_method = setup_method
+
+    def _service(self, **cfg):
+        from cuda_mpi_parallel_tpu.serve import (
+            ServiceConfig,
+            SolverService,
+        )
+        from cuda_mpi_parallel_tpu.serve.service import RecyclePolicy
+
+        clock = [0.0]
+        svc = SolverService(ServiceConfig(
+            max_batch=4, max_wait_s=0.01, maxiter=500,
+            clock=lambda: clock[0],
+            recycle=RecyclePolicy(k=12, **cfg)))
+        return svc, clock
+
+    def _drive(self, svc, clock, handle, a, dispatches, seed0=0):
+        from cuda_mpi_parallel_tpu.serve import workload as wl
+
+        means = []
+        for i in range(dispatches):
+            futs = []
+            for j in range(4):
+                b, x_true = wl.rhs_for(a, seed=seed0 + i * 10 + j,
+                                       dtype=np.float64)
+                futs.append((svc.submit(handle, b, tol=1e-8), x_true))
+            clock[0] += 1.0
+            svc.pump()
+            for fut, x_true in futs:
+                r = fut.result()
+                assert r.status == "CONVERGED", r.status
+                assert np.max(np.abs(r.x - x_true)) < 1e-6
+            means.append(np.mean([f.result().iterations
+                                  for f, _ in futs]))
+        return means
+
+    def test_service_gets_faster_every_solve(self):
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+
+        a = _fixture()
+        svc, clock = self._service()
+        try:
+            h = svc.register(a, mesh=make_mesh(4), exchange="gather")
+            means = self._drive(svc, clock, h, a, 5)
+        finally:
+            svc.close()
+        assert means[-1] < means[0]
+        stats = svc.stats()["recycle"]
+        assert stats["harvests"] >= 1
+        assert stats["applied"] >= 1
+        assert stats["last_solve_iterations"] \
+            < stats["first_solve_iterations"]
+        assert h.recycle_space is not None
+        assert h.recycle_space.k == 12
+
+    def test_quality_schedule_freezes(self):
+        """Once harvests stop improving the mean iteration count, the
+        recorders drop off (frozen) and dispatches keep deflating."""
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+
+        a = _fixture()
+        svc, clock = self._service(patience=1, min_improvement=100.0)
+        try:
+            h = svc.register(a, mesh=make_mesh(4))
+            # harvest on dispatch 1; dispatch 2's harvest cannot clear
+            # the absurd min_improvement -> frozen
+            self._drive(svc, clock, h, a, 3)
+            assert h.recycle_frozen
+            assert h.recycle_space is not None
+            frozen_harvests = h.recycle_harvests
+            self._drive(svc, clock, h, a, 2, seed0=500)
+            assert h.recycle_harvests == frozen_harvests
+        finally:
+            svc.close()
+
+    def test_lru_eviction_drops_space(self, monkeypatch):
+        """ISSUE 13 satellite: evicting the handle's compiled solvers
+        from the dist_cg LRU drops its RecycleSpace too."""
+        from cuda_mpi_parallel_tpu.parallel import dist_cg, make_mesh
+
+        monkeypatch.setenv(dist_cg.DIST_CACHE_CAP_ENV, "2")
+        a = _fixture()
+        svc, clock = self._service()
+        try:
+            mesh = make_mesh(4)
+            h = svc.register(a, mesh=mesh, warm=False)
+            self._drive(svc, clock, h, a, 2)
+            assert h.recycle_space is not None
+            # churn the tiny cache with other operators' solves until
+            # the handle's entries are gone
+            from cuda_mpi_parallel_tpu.parallel import solve_distributed
+
+            for grid in (8, 10, 12):
+                p = poisson.poisson_2d_csr(grid, grid,
+                                           dtype=np.float64)
+                solve_distributed(p, np.ones(grid * grid), mesh=mesh,
+                                  tol=1e-6, maxiter=50)
+            assert h.recycle_space is None
+            assert svc.stats()["recycle"]["dropped"] >= 1
+        finally:
+            svc.close()
+
+    def test_register_refusals(self):
+        from cuda_mpi_parallel_tpu.parallel import make_mesh
+        from cuda_mpi_parallel_tpu.robust import FaultPlan
+
+        a = _fixture()
+        svc, _ = self._service()
+        try:
+            with pytest.raises(ValueError, match="batched"):
+                svc.register(a, mesh=make_mesh(4), method="block")
+            with pytest.raises(ValueError, match="inject"):
+                svc.register(a, mesh=make_mesh(4),
+                             inject=FaultPlan(site="spmv",
+                                              iteration=10))
+        finally:
+            svc.close()
+
+
+@needs_mesh
+class TestRecycleCLI:
+    def test_cli_recycle_record(self, capsys):
+        from cuda_mpi_parallel_tpu.cli import main
+
+        rc = main(["--problem", "mm", "--file", FIXTURE,
+                   "--mesh", "4", "--device", "cpu",
+                   "--tol", "1e-8", "--maxiter", "500",
+                   "--repeat", "3", "--recycle", "12", "--json"])
+        assert rc == 0
+        record = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])
+        r = record["recycle"]
+        assert r["repeats"] == 3
+        assert r["final_solve_iterations"] \
+            < r["first_solve_iterations"]
+        assert r["k"] == 12
+        assert record["status"] == "CONVERGED"
+
+    @pytest.mark.parametrize("argv,msg", [
+        (["--recycle"], "--repeat"),
+        (["--repeat", "2", "--recycle", "--replan"], "--replan"),
+        (["--repeat", "2", "--recycle", "--method", "cg1"],
+         "--method cg"),
+        (["--repeat", "2", "--recycle", "--csr-comm", "ring"],
+         "allgather/gather"),
+        (["--repeat", "2", "--recycle", "--flight-record", "4"],
+         "stride-1"),
+    ])
+    def test_cli_recycle_refusals(self, argv, msg):
+        from cuda_mpi_parallel_tpu.cli import main
+
+        with pytest.raises(SystemExit, match=msg):
+            main(["--problem", "mm", "--file", FIXTURE,
+                  "--mesh", "4", "--device", "cpu"] + argv)

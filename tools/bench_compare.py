@@ -155,6 +155,21 @@ METRIC_DIRECTION = {
     "robust.detection_latency_iters": None,
     "robust.time_to_recover_s": None,
     "robust.recovery_overhead_pct": None,
+    # Krylov-recycling columns (solver.recycle): iters/solve of the
+    # first vs final solve of a replayed fresh-RHS workload on the
+    # skewed fixture and a Poisson operator, the saved fraction, and
+    # the harvest's host overhead vs solve wall.  Reported, never
+    # gated - iteration counts track the bench problem's spectrum and
+    # the harvest overhead tracks host weather; pre-recycling files
+    # simply lack them (rendered n/a).
+    "recycle.first_solve_iters_skewed": None,
+    "recycle.final_solve_iters_skewed": None,
+    "recycle.iters_saved_pct_skewed": None,
+    "recycle.first_solve_iters_poisson": None,
+    "recycle.final_solve_iters_poisson": None,
+    "recycle.iters_saved_pct_poisson": None,
+    "recycle.harvest_overhead_pct_skewed": None,
+    "recycle.harvest_overhead_pct_poisson": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -210,6 +225,11 @@ _NESTED = {
     "robust": ("guarded_iters_per_sec", "armed_iters_per_sec",
                "armed_overhead_pct", "detection_latency_iters",
                "time_to_recover_s", "recovery_overhead_pct"),
+    "recycle": ("first_solve_iters_skewed", "final_solve_iters_skewed",
+                "iters_saved_pct_skewed", "first_solve_iters_poisson",
+                "final_solve_iters_poisson", "iters_saved_pct_poisson",
+                "harvest_overhead_pct_skewed",
+                "harvest_overhead_pct_poisson"),
 }
 
 

@@ -331,6 +331,59 @@ print(f"serve gate: {stats['completed']} requests in "
 PY
 echo "serve gate: clean"
 
+# Recycle gate: Krylov-subspace recycling end-to-end on the committed
+# skewed fixture - a mesh-4 CLI `serve` replay with --recycle must
+# (a) emit a schema-valid event stream including recycle_harvest +
+# recycle_applied events, (b) solve every request CONVERGED with
+# max_abs_error < 1e-5 (deflation never breaks convergence), and
+# (c) show the final solve's iteration count STRICTLY below the first
+# solve's - the service measurably speeds up within one replay.
+echo "== recycle gate (mesh-4 CLI serve --recycle: iters/solve falls) =="
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli serve \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --requests 24 --rate 2000 --max-batch 4 --tol 1e-8 --maxiter 500 \
+    --seed 5 --recycle 12 --json \
+    --trace-events "$scratch/recycle_events.jsonl" \
+    > "$scratch/recycle.json"
+python tools/validate_trace.py "$scratch/recycle_events.jsonl"
+python - "$scratch" <<'PY'
+import json
+import sys
+
+scratch = sys.argv[1]
+with open(f"{scratch}/recycle.json") as f:
+    rec = json.load(f)
+events = [json.loads(ln)
+          for ln in open(f"{scratch}/recycle_events.jsonl")
+          if ln.strip()]
+
+live = [r for r in rec["requests"]
+        if not r["timed_out"] and r["status"] != "REJECTED"]
+assert live, "no completed requests"
+assert all(r["status"] == "CONVERGED" for r in live), \
+    [r["status"] for r in rec["requests"]]
+assert all(r["max_abs_error"] < 1e-5 for r in live), \
+    max(r["max_abs_error"] for r in live)
+
+harvests = [e for e in events if e["event"] == "recycle_harvest"]
+applied = [e for e in events if e["event"] == "recycle_applied"]
+assert harvests, "no recycle_harvest event emitted"
+assert applied, "no recycle_applied event emitted"
+
+r = rec["recycle"]
+assert r["harvests"] >= 1, r
+first, last = r["first_solve_iterations"], r["last_solve_iterations"]
+assert first is not None and last is not None, r
+assert last < first, \
+    f"final-solve iterations {last} not strictly below first-solve " \
+    f"{first} - recycling bought nothing"
+print(f"recycle gate: {len(live)} requests CONVERGED, "
+      f"{r['harvests']} harvest(s) / {r['applied']} deflated "
+      f"dispatch(es), iters/solve {first} -> {last} "
+      f"({len(harvests)}+{len(applied)} recycle events schema-valid)")
+PY
+echo "recycle gate: clean"
+
 # Phasetrace gate: measured per-shard per-phase timing end-to-end on
 # the committed skewed fixture - one mesh-4 CLI solve with
 # --phase-profile must produce (a) a MEASURED Perfetto timeline
