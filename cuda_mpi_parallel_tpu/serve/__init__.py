@@ -6,11 +6,22 @@ once (partition + plan + per-bucket trace warmup), then submit repeat
 coalesce it onto ``solve_many`` / ``solve_distributed_many`` - one
 matrix sweep and one halo exchange per iteration serving every queued
 column.  See :mod:`.service` for the service itself, :mod:`.queue`
-for the batching policy, and :mod:`.workload` for replayable
-arrival-time workloads (the ``cli.py serve`` surface).
+for the batching policy, :mod:`.admission` for per-tenant token-bucket
+admission control and the shed-before-collapse ladder, :mod:`.sched`
+for SLO classes and the weighted-fair (deficit-round-robin)
+dispatcher, and :mod:`.workload` for replayable arrival-time workloads
+(the ``cli.py serve`` surface) plus the open-loop saturation harness.
 """
 from __future__ import annotations
 
+from .admission import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionDecision,
+    ShedConfig,
+    ShedLadder,
+    TokenBucket,
+)
 from .queue import (
     Batch,
     MicroBatchQueue,
@@ -18,6 +29,13 @@ from .queue import (
     bucket_for,
     bucket_sizes,
     tol_class,
+)
+from .sched import (
+    DEFAULT_CLASSES,
+    BatchCostModel,
+    SLOClass,
+    SchedConfig,
+    WeightedFairScheduler,
 )
 from .service import (
     OperatorHandle,
@@ -29,30 +47,47 @@ from .service import (
     SolverService,
 )
 from .workload import (
+    ReplaySummary,
     WorkloadRequest,
     load_workload,
+    replay_workload,
     rhs_for,
     save_workload,
     synthetic_poisson,
+    synthetic_tenant_mix,
 )
 
 __all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "AdmissionDecision",
     "Batch",
+    "BatchCostModel",
+    "DEFAULT_CLASSES",
     "MicroBatchQueue",
     "OperatorHandle",
     "QueueFull",
     "RecyclePolicy",
+    "ReplaySummary",
     "RequestResult",
     "RetryPolicy",
+    "SLOClass",
+    "SchedConfig",
     "ServiceClosed",
     "ServiceConfig",
+    "ShedConfig",
+    "ShedLadder",
     "SolverService",
+    "TokenBucket",
+    "WeightedFairScheduler",
     "WorkloadRequest",
     "bucket_for",
     "bucket_sizes",
     "load_workload",
+    "replay_workload",
     "rhs_for",
     "save_workload",
     "synthetic_poisson",
+    "synthetic_tenant_mix",
     "tol_class",
 ]

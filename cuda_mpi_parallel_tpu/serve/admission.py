@@ -1,0 +1,245 @@
+"""Admission control + the shed-before-collapse ladder.
+
+The PR 10 service had exactly one overload answer: the bounded queue's
+``QueueFull`` exception, thrown when the damage was already done - the
+queue it protects was full of work that would now time out en masse.
+This module is the front door that keeps it from getting there:
+
+* :class:`AdmissionController` - per-tenant token buckets (rate +
+  burst), refilled on the SERVICE clock (``ServiceConfig.clock``), so
+  every refill/exhaustion branch is drivable by the fake-clock tests.
+  A rejected submit resolves to a typed ``ADMISSION_REJECTED`` result
+  carrying a ``retry_after_s`` hint - never an exception, never a
+  silent drop.
+
+* :class:`ShedLadder` - the explicit degradation ladder over measured
+  queue pressure.  Rungs, in order, each a strictly milder failure
+  than letting accepted work time out:
+
+  1. **degrade** - incoming ``degrade_ok`` classes get their tolerance
+     widened one decade (the PR 12 ``degrade_depth`` behavior,
+     generalized per class; the result says ``degraded=True``);
+  2. **defer** - ``defer_ok`` classes (``bulk``) stop dispatching;
+     their queues hold while ``gold``/``silver`` drain inside SLO;
+  3. **reject** - non-``gold`` submits are refused at admission with a
+     ``retry_after_s`` estimated from the measured service rate.
+
+  Thresholds are queue depths: explicit (`degrade_depth` etc., the
+  deterministic test surface) or - with ``auto=True`` - derived from
+  the measured capacity estimate (the solved-RHS/s EWMA the service
+  keeps, seeded from the phasetrace profile when one was taken at
+  registration): a rung fires when the backlog is worth more than
+  ``horizon_s`` x capacity x its multiplier of queued work.  Downward
+  transitions are hysteretic (``exit_fraction``) so the ladder does
+  not flap at a threshold.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "AdmissionDecision",
+    "ShedConfig",
+    "ShedLadder",
+    "TokenBucket",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenBucket:
+    """Rate + burst of one tenant's admission budget.  ``rate`` is
+    requests/second of sustained admission; ``burst`` the bucket
+    capacity (momentary excursions above the rate)."""
+
+    rate: float
+    burst: float
+
+    def __post_init__(self):
+        if self.rate <= 0:
+            raise ValueError(f"rate must be > 0, got {self.rate}")
+        if self.burst < 1:
+            raise ValueError(f"burst must be >= 1, got {self.burst}")
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionConfig:
+    """Per-tenant token-bucket table.  ``default`` applies to tenants
+    without their own row; ``None`` leaves unlisted tenants unmetered
+    (the queue bound still backstops them)."""
+
+    default: Optional[TokenBucket] = None
+    tenants: Tuple[Tuple[str, TokenBucket], ...] = ()
+
+    def bucket_for(self, tenant: str) -> Optional[TokenBucket]:
+        return dict(self.tenants).get(tenant, self.default)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionDecision:
+    """The typed outcome of one admission check."""
+
+    admitted: bool
+    tenant: str
+    tokens: float                   # remaining AFTER this decision
+    retry_after_s: Optional[float] = None
+    reason: Optional[str] = None    # "tokens" | "shed" on rejection
+
+
+class AdmissionController:
+    """Continuous-refill token buckets on an injected clock.
+
+    Not thread-safe on its own - the service calls it under its lock.
+    State per tenant is ``(tokens, last_refill_t)``; refill is
+    ``min(burst, tokens + dt * rate)`` so a quiet tenant banks at most
+    one burst.
+    """
+
+    def __init__(self, config: AdmissionConfig):
+        self.config = config
+        # built once: bucket_for runs on every submit
+        self._buckets: Dict[str, TokenBucket] = dict(config.tenants)
+        self._state: Dict[str, Tuple[float, float]] = {}
+
+    def _bucket_for(self, tenant: str) -> Optional[TokenBucket]:
+        return self._buckets.get(tenant, self.config.default)
+
+    def _refill(self, tenant: str, bucket: TokenBucket,
+                now: float) -> float:
+        tokens, last = self._state.get(tenant, (float(bucket.burst),
+                                                now))
+        tokens = min(float(bucket.burst),
+                     tokens + max(now - last, 0.0) * bucket.rate)
+        self._state[tenant] = (tokens, now)
+        return tokens
+
+    def tokens(self, tenant: str, now: float) -> Optional[float]:
+        """Current balance (refilled to ``now``); None = unmetered."""
+        bucket = self._bucket_for(tenant)
+        if bucket is None:
+            return None
+        return self._refill(tenant, bucket, now)
+
+    def admit(self, tenant: str, now: float) -> AdmissionDecision:
+        bucket = self._bucket_for(tenant)
+        if bucket is None:
+            return AdmissionDecision(admitted=True, tenant=tenant,
+                                     tokens=math.inf)
+        tokens = self._refill(tenant, bucket, now)
+        if tokens >= 1.0:
+            self._state[tenant] = (tokens - 1.0, now)
+            return AdmissionDecision(admitted=True, tenant=tenant,
+                                     tokens=tokens - 1.0)
+        return AdmissionDecision(
+            admitted=False, tenant=tenant, tokens=tokens,
+            retry_after_s=(1.0 - tokens) / bucket.rate,
+            reason="tokens")
+
+
+@dataclasses.dataclass(frozen=True)
+class ShedConfig:
+    """Ladder thresholds as queue depths (see module docstring).
+
+    A rung with depth 0 is OFF unless ``auto=True``, in which case its
+    depth derives from the measured capacity estimate:
+    ``degrade = ceil(capacity * horizon_s)``, ``defer = 2x``,
+    ``reject = 4x`` (explicit nonzero depths always win over the
+    derivation).  With no capacity measured yet the auto rungs stay
+    off - the ladder never fires on a guess.
+    """
+
+    degrade_depth: int = 0
+    defer_depth: int = 0
+    reject_depth: int = 0
+    auto: bool = False
+    horizon_s: float = 0.25
+    #: a level exits when depth falls to <= enter_threshold x this
+    exit_fraction: float = 0.5
+
+    def __post_init__(self):
+        for name in ("degrade_depth", "defer_depth", "reject_depth"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0, got "
+                                 f"{getattr(self, name)}")
+        if not 0.0 < self.exit_fraction <= 1.0:
+            raise ValueError(f"exit_fraction must be in (0, 1], got "
+                             f"{self.exit_fraction}")
+        if self.horizon_s <= 0:
+            raise ValueError(f"horizon_s must be > 0, got "
+                             f"{self.horizon_s}")
+        # a rung that fires earlier than the one below it would make
+        # the ladder fire out of order - refuse at construction
+        depths = [d for d in (self.degrade_depth, self.defer_depth,
+                              self.reject_depth) if d > 0]
+        if depths != sorted(depths):
+            raise ValueError(
+                f"ladder depths must be non-decreasing "
+                f"(degrade <= defer <= reject), got "
+                f"{self.degrade_depth}/{self.defer_depth}/"
+                f"{self.reject_depth}")
+
+    def thresholds(self, capacity_rhs_per_s: Optional[float]
+                   ) -> Tuple[Optional[int], Optional[int],
+                              Optional[int]]:
+        """(degrade, defer, reject) depths; None = rung off."""
+        out = []
+        auto_base = None
+        if self.auto and capacity_rhs_per_s is not None \
+                and capacity_rhs_per_s > 0:
+            auto_base = max(1, int(math.ceil(
+                capacity_rhs_per_s * self.horizon_s)))
+        for depth, mult in ((self.degrade_depth, 1),
+                            (self.defer_depth, 2),
+                            (self.reject_depth, 4)):
+            if depth > 0:
+                out.append(depth)
+            elif auto_base is not None:
+                out.append(auto_base * mult)
+            else:
+                out.append(None)
+        return tuple(out)
+
+
+class ShedLadder:
+    """Current ladder level with hysteresis; the service owns one and
+    calls :meth:`evaluate` under its lock on every submit and pass."""
+
+    #: level -> name (level 0 is healthy)
+    LEVELS = ("ok", "degrade", "defer", "reject")
+
+    def __init__(self, config: ShedConfig):
+        self.config = config
+        self.level = 0
+        self.transitions = 0
+
+    def evaluate(self, depth: int,
+                 capacity_rhs_per_s: Optional[float] = None) -> bool:
+        """Re-derive the level from ``depth``; True when it changed."""
+        thresholds = self.config.thresholds(capacity_rhs_per_s)
+        target = 0
+        for lvl, thr in enumerate(thresholds, start=1):
+            if thr is not None and depth >= thr:
+                target = lvl
+        if target < self.level:
+            # hysteretic descent: only drop below a held level once
+            # the depth clears its entry threshold by exit_fraction
+            held = self.level
+            while held > target:
+                thr = thresholds[held - 1]
+                if thr is not None and depth > \
+                        thr * self.config.exit_fraction:
+                    break
+                held -= 1
+            target = max(target, held)
+        if target != self.level:
+            self.level = target
+            self.transitions += 1
+            return True
+        return False
+
+    @property
+    def name(self) -> str:
+        return self.LEVELS[self.level]

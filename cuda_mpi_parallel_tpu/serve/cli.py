@@ -80,6 +80,30 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--deadline", type=float, default=None, metavar="S",
                    help="per-request deadline in seconds (expired "
                         "requests get typed TIMEOUT results)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="dispatch workers sharing the compiled-solver "
+                        "cache (0 = auto-size from the calibrated "
+                        "machine model)")
+    p.add_argument("--slo-class", default="silver", dest="slo_class",
+                   choices=["gold", "silver", "bulk"],
+                   help="SLO class for requests the workload does not "
+                        "tag (weighted-fair dispatch at 8:4:1; gold "
+                        "is never degraded or deferred)")
+    p.add_argument("--admit-rate", type=float, default=None,
+                   dest="admit_rate", metavar="R",
+                   help="per-tenant token-bucket admission rate, "
+                        "requests/s (over-rate submits resolve to "
+                        "typed ADMISSION_REJECTED results with a "
+                        "retry_after_s hint)")
+    p.add_argument("--admit-burst", type=float, default=None,
+                   dest="admit_burst", metavar="B",
+                   help="token-bucket burst size (default: 2x the "
+                        "admission rate)")
+    p.add_argument("--shed", default=None, metavar="D1,D2,D3",
+                   help="shed-ladder queue depths "
+                        "degrade,defer,reject (0 disables a rung); "
+                        "'auto' derives them from the measured "
+                        "capacity estimate")
     p.add_argument("--precond", default="none",
                    choices=["none", "jacobi"],
                    help="batched-tier preconditioner")
@@ -227,11 +251,45 @@ def main(argv=None) -> int:
         from ..solver.recycle import DEFAULT_K
 
         recycle_policy = RecyclePolicy(k=args.recycle or DEFAULT_K)
+    admission = None
+    if args.admit_rate is not None:
+        from .admission import AdmissionConfig, TokenBucket
+
+        if args.admit_rate <= 0:
+            raise SystemExit(f"--admit-rate must be > 0, got "
+                             f"{args.admit_rate}")
+        burst = args.admit_burst if args.admit_burst is not None \
+            else max(2.0 * args.admit_rate, 1.0)
+        admission = AdmissionConfig(
+            default=TokenBucket(rate=args.admit_rate, burst=burst))
+    elif args.admit_burst is not None:
+        raise SystemExit("--admit-burst needs --admit-rate")
+    shed = None
+    if args.shed is not None:
+        from .admission import ShedConfig
+
+        if args.shed == "auto":
+            shed = ShedConfig(auto=True)
+        else:
+            try:
+                d1, d2, d3 = (int(v) for v in args.shed.split(","))
+            except ValueError:
+                raise SystemExit(
+                    f"--shed expects D1,D2,D3 depths or 'auto', got "
+                    f"{args.shed!r}")
+            try:
+                shed = ShedConfig(degrade_depth=d1, defer_depth=d2,
+                                  reject_depth=d3)
+            except ValueError as e:
+                raise SystemExit(f"--shed: {e}")
+    if args.workers < 0:
+        raise SystemExit(f"--workers must be >= 0, got {args.workers}")
     service = SolverService(ServiceConfig(
         max_batch=args.max_batch,
         max_wait_s=args.max_wait_ms / 1e3,
         queue_limit=args.queue_limit, maxiter=args.maxiter,
-        check_every=args.check_every, recycle=recycle_policy))
+        check_every=args.check_every, recycle=recycle_policy,
+        admission=admission, shed=shed, workers=args.workers))
     mesh = None
     if args.mesh > 1:
         from ..parallel import make_mesh
@@ -275,7 +333,9 @@ def main(argv=None) -> int:
                 handle, b,
                 tol=r.tol if r.tol is not None else args.tol,
                 deadline_s=(r.deadline_s if r.deadline_s is not None
-                            else args.deadline)))
+                            else args.deadline),
+                tenant=r.tenant or "default",
+                slo_class=r.slo_class or args.slo_class))
         except QueueFull:
             # backpressure: the offered load beat the queue bound -
             # count the shed request and keep replaying (an aborted
@@ -311,7 +371,10 @@ def main(argv=None) -> int:
             "wait_s": res.wait_s, "solve_s": res.solve_s,
             "latency_s": res.latency_s, "bucket": res.bucket,
             "occupancy": res.occupancy, "solve_id": res.solve_id,
+            "tenant": res.tenant, "slo_class": res.slo_class,
         }
+        if res.retry_after_s is not None:
+            entry["retry_after_s"] = res.retry_after_s
         if res.x is not None:
             err = float(np.max(np.abs(res.x - x_true)))
             entry["max_abs_error"] = err
@@ -343,6 +406,14 @@ def main(argv=None) -> int:
         "handle": handle.key,
         "max_batch": args.max_batch,
         "max_wait_s": args.max_wait_ms / 1e3,
+        "workers": args.workers,
+        "slo_class_default": args.slo_class,
+        "admission": ({"rate": args.admit_rate,
+                       "burst": (args.admit_burst
+                                 if args.admit_burst is not None
+                                 else max(2.0 * args.admit_rate, 1.0))}
+                      if args.admit_rate is not None else None),
+        "shed": args.shed,
         "method": args.method,
         "precond": args.precond,
         "plan": (handle.plan.label if handle.plan is not None

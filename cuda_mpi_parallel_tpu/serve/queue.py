@@ -3,16 +3,24 @@
 This module is the pure, host-only half of the solver service - no
 jax, no threads, no wall clock of its own.  Every method takes ``now``
 explicitly, so the policy is deterministic under a fake clock (the
-test harness) and the service's worker thread is just a driver that
-feeds it real time.
+test harness) and the service's worker threads are just drivers that
+feed it real time.
 
 Policy (ROADMAP item 1b):
 
-* requests queue per ``(handle, dtype, tol-class)`` - only columns
-  that can ride ONE compiled batched solve share a queue;
+* requests queue per ``(handle, tenant, slo-class, dtype, tol-class)``
+  - only columns that can ride ONE compiled batched solve share a
+  queue, and a batch never mixes tenants or SLO classes (the
+  weighted-fair dispatcher's flow is the key's first three fields);
 * a queue dispatches when it holds ``max_batch`` requests (reason
   ``"full"``) OR when its oldest request has waited ``max_wait_s``
   (reason ``"max_wait"``) - the classic latency/occupancy knob pair;
+* WHICH dispatchable queue goes next is the scheduler's call:
+  :meth:`MicroBatchQueue.pop_next` asks the deficit-round-robin
+  scheduler (``serve.sched``) to pick a flow by weight and priced
+  solve cost; the legacy PR 10 order (oldest queue first, each queue
+  drained fully - :meth:`pop_ready`) remains as the ``fair=False``
+  reference and the drain path's workhorse;
 * a cut batch is padded up to the smallest LANE BUCKET that fits
   (powers of two up to ``max_batch``, :func:`bucket_sizes`), so the
   set of compiled batch shapes is bounded and every post-warmup
@@ -20,17 +28,21 @@ Policy (ROADMAP item 1b):
   ``b = 0`` and freeze at iteration 0 (``solver.many.stack_columns``);
 * per-request deadlines: an expired request is failed LOUDLY with a
   typed TIMEOUT result at the next pump, never silently dropped and
-  never dispatched into a solve whose answer nobody wants;
+  never dispatched into a solve whose answer nobody wants
+  (:meth:`take_expired` sweeps them - deferred queues included, a
+  shed ladder must never hide an expiry);
 * backpressure: the total pending count is bounded
   (``queue_limit``) - :meth:`MicroBatchQueue.push` raises
-  :class:`QueueFull` rather than buffering unboundedly.
+  :class:`QueueFull` rather than buffering unboundedly.  Admission
+  control (``serve.admission``) is the polite front door BEFORE this
+  hard bound.
 """
 from __future__ import annotations
 
 import dataclasses
 import math
 from collections import OrderedDict, deque
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, FrozenSet, List, Optional, Tuple
 
 __all__ = [
     "Batch",
@@ -109,6 +121,12 @@ class QueuedRequest:
     #: tolerance-class degradation marked this request (queue-pressure
     #: load shedding); surfaced on its RequestResult
     degraded: bool = False
+    #: multi-tenant scheduling (serve.admission / serve.sched): the
+    #: submitting tenant and the SLO class its latency is accounted
+    #: against - together with the handle they name the weighted-fair
+    #: dispatcher's flow
+    tenant: str = "default"
+    slo_class: str = "silver"
 
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now >= self.deadline_t
@@ -121,7 +139,8 @@ class QueuedRequest:
 class Batch:
     """A cut microbatch, ready to dispatch onto one batched solve."""
 
-    key: Tuple[str, str, str]      # (handle_key, dtype, tol_class)
+    #: (handle_key, tenant, slo_class, dtype, tol_class)
+    key: Tuple[str, str, str, str, str]
     requests: List[QueuedRequest]
     bucket: int                    # padded lane count (compiled shape)
     reason: str                    # "full" | "max_wait" | "drain"
@@ -134,14 +153,30 @@ class Batch:
     def padding_fraction(self) -> float:
         return (self.bucket - len(self.requests)) / self.bucket
 
+    @property
+    def tenant(self) -> str:
+        return self.key[1]
+
+    @property
+    def slo_class(self) -> str:
+        return self.key[2]
+
+    @property
+    def flow(self) -> Tuple[str, str, str]:
+        return self.key[:3]
+
 
 class MicroBatchQueue:
-    """The dispatch policy over per-``(handle, dtype, tol-class)``
-    FIFOs.  Not thread-safe on its own - the service serializes access
-    under its lock."""
+    """The dispatch policy over per-``(handle, tenant, slo-class,
+    dtype, tol-class)`` FIFOs.  Not thread-safe on its own - the
+    service serializes access under its lock.
+
+    ``sched`` is an optional ``serve.sched.WeightedFairScheduler``
+    consulted by :meth:`pop_next`; without one, pop order is the
+    legacy insertion order."""
 
     def __init__(self, max_batch: int = 8, max_wait_s: float = 0.002,
-                 queue_limit: int = 256):
+                 queue_limit: int = 256, sched=None, cost_fn=None):
         if max_wait_s < 0:
             raise ValueError(f"max_wait_s must be >= 0, got {max_wait_s}")
         if queue_limit < 1:
@@ -151,16 +186,46 @@ class MicroBatchQueue:
         self.buckets = bucket_sizes(self.max_batch)
         self.max_wait_s = float(max_wait_s)
         self.queue_limit = int(queue_limit)
+        self.sched = sched
+        #: prices one dispatch of a queue's handle for the scheduler
+        #: (seconds estimate; only relative values matter) - default
+        #: uniform
+        self.cost_fn = cost_fn or (lambda handle: 1.0)
         self._queues: "OrderedDict[Tuple, Deque[QueuedRequest]]" = \
             OrderedDict()
         self._depth = 0
+        # incremental per-tenant / per-class pending counts: submit-
+        # path gauges and the defer-release check read these instead
+        # of scanning every flow's queue
+        self._tenant_depth: Dict[str, int] = {}
+        self._class_depth: Dict[str, int] = {}
+
+    def _count(self, req: QueuedRequest, delta: int) -> None:
+        for table, key in ((self._tenant_depth, req.tenant),
+                           (self._class_depth, req.slo_class)):
+            n = table.get(key, 0) + delta
+            if n:
+                table[key] = n
+            else:
+                table.pop(key, None)
+        self._depth += delta
 
     def depth(self) -> int:
         """Total pending requests across every queue."""
         return self._depth
 
-    def key_for(self, req: QueuedRequest) -> Tuple[str, str, str]:
-        return (req.handle_key, req.dtype, tol_class(req.tol))
+    def depth_by_tenant(self) -> Dict[str, int]:
+        """Pending requests per tenant (the per-tenant depth gauge)."""
+        return dict(self._tenant_depth)
+
+    def depth_by_class(self) -> Dict[str, int]:
+        """Pending requests per SLO class (the defer-release check)."""
+        return dict(self._class_depth)
+
+    def key_for(self, req: QueuedRequest
+                ) -> Tuple[str, str, str, str, str]:
+        return (req.handle_key, req.tenant, req.slo_class, req.dtype,
+                tol_class(req.tol))
 
     def push(self, req: QueuedRequest) -> int:
         """Enqueue; returns the new total depth.  Raises
@@ -172,17 +237,129 @@ class MicroBatchQueue:
                 f"limit {self.queue_limit}); shed load or raise "
                 f"queue_limit")
         self._queues.setdefault(self.key_for(req), deque()).append(req)
-        self._depth += 1
+        self._count(req, +1)
         return self._depth
+
+    # -- expiry sweep ----------------------------------------------------
+
+    def take_expired(self, now: float) -> List[QueuedRequest]:
+        """Remove and return every expired-deadline request.  Runs
+        over EVERY queue - deferred classes included: the shed ladder
+        may hold a queue's dispatches, never its expiries (the caller
+        owes each removed request a typed TIMEOUT result)."""
+        out: List[QueuedRequest] = []
+        for key in list(self._queues):
+            q = self._queues[key]
+            live: Deque[QueuedRequest] = deque()
+            for req in q:
+                if req.expired(now):
+                    out.append(req)
+                    self._count(req, -1)
+                else:
+                    live.append(req)
+            if live:
+                self._queues[key] = live
+            else:
+                del self._queues[key]
+        return out
+
+    # -- dispatchability -------------------------------------------------
+
+    def _dispatchable(self, now: float, drain: bool,
+                      defer: FrozenSet[str]
+                      ) -> "OrderedDict[Tuple, str]":
+        """Queues the policy would cut a batch from right now
+        (key -> reason), in queue-insertion order.  ``defer`` names
+        SLO classes the shed ladder is holding (ignored on drain -
+        close() must terminate)."""
+        out: "OrderedDict[Tuple, str]" = OrderedDict()
+        for key, q in self._queues.items():
+            if not drain and key[2] in defer:
+                continue
+            ready = [r for r in q if not r.expired(now)
+                     and (drain or r.ready(now))]
+            if not ready:
+                continue
+            if len(ready) >= self.max_batch:
+                out[key] = "full"
+            elif drain:
+                out[key] = "drain"
+            elif now - ready[0].enqueue_t >= self.max_wait_s:
+                out[key] = "max_wait"
+        return out
+
+    def deferred_ready(self, now: float, defer: FrozenSet[str]
+                       ) -> List[Tuple]:
+        """Queues that WOULD dispatch right now but for the shed
+        ladder's defer rung - what the service's ``sched_dispatch``
+        decision="defer" events report."""
+        if not defer:
+            return []
+        held = self._dispatchable(now, False, frozenset())
+        live = self._dispatchable(now, False, defer)
+        return [k for k in held if k not in live]
+
+    def _cut(self, key: Tuple, now: float, reason: str) -> Batch:
+        """Cut one batch from ``key``'s queue: the first (oldest)
+        dispatchable requests in order, capped at ``max_batch``.
+        Expired/parked requests keep their positions for the sweeps
+        that own them."""
+        drain = reason == "drain"
+        q = self._queues[key]
+        cut: List[QueuedRequest] = []
+        rest: Deque[QueuedRequest] = deque()
+        for r in q:
+            if len(cut) < self.max_batch and not r.expired(now) \
+                    and (drain or r.ready(now)):
+                cut.append(r)
+                self._count(r, -1)
+            else:
+                rest.append(r)
+        if rest:
+            self._queues[key] = rest
+        else:
+            del self._queues[key]
+        return Batch(key=key, requests=cut,
+                     bucket=bucket_for(len(cut), self.max_batch),
+                     reason=reason)
+
+    def pop_next(self, now: float, drain: bool = False,
+                 defer: FrozenSet[str] = frozenset()
+                 ) -> Optional[Batch]:
+        """Cut the ONE batch the scheduler says goes next (or ``None``
+        when nothing is dispatchable at ``now``).  The dispatch loop
+        calls this repeatedly - each worker takes one batch at a time,
+        so deficit-round-robin interleaves flows even within a single
+        policy pass."""
+        cands = self._dispatchable(now, drain, defer)
+        if not cands:
+            return None
+        if self.sched is None:
+            key = next(iter(cands))        # legacy insertion order
+        else:
+            # group candidate keys by flow (first key per flow wins -
+            # insertion order within a flow, the PR 10 behavior)
+            flows: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+            costs: Dict[Tuple, float] = {}
+            for key in cands:
+                flow = key[:3]
+                if flow not in flows:
+                    flows[flow] = key
+                    head = self._queues[key][0]
+                    costs[flow] = float(self.cost_fn(head.handle))
+            key = flows[self.sched.pick(costs)]
+        return self._cut(key, now, cands[key])
+
+    # -- legacy pop (PR 10 order; drain + fair=False reference) ----------
 
     def pop_ready(self, now: float, drain: bool = False
                   ) -> Tuple[List[Batch], List[QueuedRequest]]:
-        """Cut everything the policy says is dispatchable at ``now``.
+        """Cut everything the policy says is dispatchable at ``now``
+        in the PR 10 order: oldest queue first, each queue's full
+        batches then its aged partial.
 
-        Returns ``(batches, timeouts)``: full batches first (oldest
-        queue first), then max-wait expiries (with ``drain=True``,
-        every remaining request regardless of age).  ``timeouts`` are
-        the expired-deadline requests removed from the queues - the
+        Returns ``(batches, timeouts)``; ``timeouts`` are the
+        expired-deadline requests removed from the queues - the
         caller owes each a typed TIMEOUT result.
         """
         batches: List[Batch] = []
@@ -194,8 +371,11 @@ class MicroBatchQueue:
             # must not hold the max_wait clock of younger requests
             live = deque()
             for req in q:
-                (timeouts if req.expired(now) else live).append(req)
-            self._depth -= len(q) - len(live)
+                if req.expired(now):
+                    timeouts.append(req)
+                    self._count(req, -1)
+                else:
+                    live.append(req)
             # backoff-parked retries are not dispatchable yet and do
             # not drive the max_wait clock; a drain flushes them too
             # (their backoff is advisory, close() must terminate)
@@ -205,7 +385,8 @@ class MicroBatchQueue:
                        if not (drain or r.ready(now))]
             while len(ready) >= self.max_batch:
                 cut = [ready.popleft() for _ in range(self.max_batch)]
-                self._depth -= len(cut)
+                for r in cut:
+                    self._count(r, -1)
                 batches.append(Batch(key=key, requests=cut,
                                      bucket=self.max_batch,
                                      reason="full"))
@@ -213,7 +394,8 @@ class MicroBatchQueue:
                           or now - ready[0].enqueue_t >= self.max_wait_s):
                 cut = list(ready)
                 ready.clear()
-                self._depth -= len(cut)
+                for r in cut:
+                    self._count(r, -1)
                 batches.append(Batch(
                     key=key, requests=cut,
                     bucket=bucket_for(len(cut), self.max_batch),
@@ -223,31 +405,45 @@ class MicroBatchQueue:
                 del self._queues[key]
         return batches, timeouts
 
-    def next_wake(self, now: float) -> Optional[float]:
+    def next_wake(self, now: float,
+                  defer: FrozenSet[str] = frozenset()
+                  ) -> Optional[float]:
         """The earliest absolute time any policy clause can fire (a
-        max-wait expiry, a request deadline, or NOW when a queue is
-        already full), or ``None`` when the queues are empty.  The
-        worker thread sleeps exactly until this - the full-queue
-        clause matters because a submit's notify is lost while the
-        worker is mid-solve (not waiting): without it, a queue that
-        filled during the solve would sleep out max_wait before its
-        "dispatch on full" batch went."""
+        max-wait expiry, a request deadline, a backoff-parked retry's
+        ``ready_t``, or NOW when a queue is already full), or ``None``
+        when the queues are empty.  The worker threads sleep exactly
+        until this - the full-queue clause matters because a submit's
+        notify is lost while a worker is mid-solve (not waiting):
+        without it, a queue that filled during the solve would sleep
+        out max_wait before its "dispatch on full" batch went.
+
+        ``defer`` names the SLO classes the shed ladder is holding:
+        their queues contribute deadlines (a deferred expiry must
+        still be swept into its typed TIMEOUT on time) and parked
+        ``ready_t``s, but not dispatch wakes - a held queue cannot
+        dispatch, so waking for its max_wait would be a busy-loop."""
         wake: Optional[float] = None
-        for q in self._queues.values():
+
+        def consider(t: Optional[float]):
+            nonlocal wake
+            if t is not None:
+                wake = t if wake is None else min(wake, t)
+
+        for key, q in self._queues.items():
             if not q:
                 continue
+            deferred = key[2] in defer
             ready = [r for r in q if r.ready(now)]
-            if len(ready) >= self.max_batch:
+            if not deferred and len(ready) >= self.max_batch:
                 return now
-            candidates = [r.deadline_t for r in q
-                          if r.deadline_t is not None]
-            if ready:
-                candidates.append(ready[0].enqueue_t + self.max_wait_s)
-            # a backoff-parked retry becomes actionable at its ready_t
-            candidates += [r.ready_t for r in q
-                           if r.ready_t is not None and not r.ready(now)]
-            if not candidates:
-                continue
-            t = min(candidates)
-            wake = t if wake is None else min(wake, t)
+            for r in q:
+                consider(r.deadline_t)
+                # a backoff-parked retry becomes actionable at ready_t
+                # (the PR 12 fold this module's regression test pins:
+                # without it an idle worker oversleeps the backoff
+                # until the next unrelated submit)
+                if r.ready_t is not None and not r.ready(now):
+                    consider(r.ready_t)
+            if ready and not deferred:
+                consider(ready[0].enqueue_t + self.max_wait_s)
         return wake

@@ -1,0 +1,286 @@
+"""SLO classes + weighted-fair dispatch (deficit round-robin).
+
+The PR 10 service popped its microbatch queues oldest-queue-first:
+with one tenant that is exactly fair, but one hot tenant submitting
+faster than the mesh solves starves every other flow - its queue is
+always the oldest, so it is always next.  This module replaces that
+pop with the classic deficit-round-robin scheduler (Shreedhar &
+Varghese) over ``(handle, tenant, slo-class)`` FLOWS:
+
+* every flow accumulates *solve-cost credits* each round in proportion
+  to its weight (``SLOClass.weight`` x optional per-tenant weight);
+* a flow dispatches when its deficit covers the priced cost of its
+  next batch, and pays that cost down;
+* an idle flow's deficit resets (no banking: a tenant cannot hoard
+  credits while quiet and then burst past everyone).
+
+Costs are *priced*, not guessed uniform: :class:`BatchCostModel`
+seeds each handle's per-dispatch cost from the calibrated machine
+model (``telemetry.calibrate.preferred_model`` - the measured
+mem-bandwidth sweep cost of one operator application) and then
+replaces the seed with the measured EWMA of the handle's real batch
+solve walls, so a heavy operator's dispatches drain proportionally
+more credit than a cheap one's.  Only *relative* cost matters to DRR.
+
+Starvation bound (asserted in tests): with weights ``w_i`` and batch
+costs ``<= quantum``, a backlogged flow dispatches at least once per
+``ceil(w_max / w_i) + 1`` scheduler rotations - a 10:1 hot tenant
+cannot push a 1-req/s tenant's dispatch beyond that bound.
+
+The all-off configuration (one tenant, one class, one handle - a
+single flow) degenerates to the PR 10 order exactly: one flow is
+always next, and within a flow queues drain in insertion order
+(``tests/test_serve_sched.py::TestLegacyCompat`` proves the replay is
+bit-for-bit).  ``SchedConfig(fair=False)`` keeps the literal PR 10
+pop as the reference implementation.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Tuple
+
+__all__ = [
+    "DEFAULT_CLASSES",
+    "BatchCostModel",
+    "SLOClass",
+    "SchedConfig",
+    "WeightedFairScheduler",
+    "class_table",
+]
+
+#: fallback expected iterations for a never-measured handle: only the
+#: RELATIVE cost across handles matters to DRR, so a fixed placeholder
+#: (replaced by the measured EWMA after the first dispatch) is honest
+DEFAULT_COST_ITERS = 50
+
+#: per-value bytes of one CSR sweep (8 B value + 4 B column index) -
+#: the same (itemsize + 4) term balance.plan.score_report prices with
+_SWEEP_BYTES_PER_NNZ = 12.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOClass:
+    """One service-level class: a dispatch weight plus the latency bar
+    its traffic is accounted against.
+
+    ``target_latency_s`` is the in-SLO accounting bound (the
+    saturation bench's "goodput" is completions within it); it is NOT
+    an enforced deadline unless ``deadline_s`` is set, in which case
+    submits of this class that arrive without an explicit deadline get
+    it - mapping the class onto the existing deadline/TIMEOUT
+    machinery instead of inventing a second expiry path.
+
+    ``degrade_ok`` marks the class eligible for the shed ladder's
+    first rung (tolerance widened one decade under pressure);
+    ``defer_ok`` for the second (dispatch deferred while the ladder
+    holds); ``reject_exempt`` shields it from the third (still
+    admitted - subject to its token bucket and the hard queue bound -
+    while every other class is turned away).  ``gold`` is
+    none-of-the-first-two and exempt from the third: its contract is
+    that accepted work runs at full accuracy inside its latency
+    bound, and overload is answered by shedding the classes below it.
+    """
+
+    name: str
+    weight: float = 1.0
+    target_latency_s: Optional[float] = None
+    deadline_s: Optional[float] = None
+    degrade_ok: bool = True
+    defer_ok: bool = False
+    reject_exempt: bool = False
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"class weight must be > 0, got "
+                             f"{self.weight}")
+
+
+#: the standard three-tier table.  Latency targets are accounting
+#: bounds only (no default deadlines - a plain ServiceConfig must not
+#: start expiring traffic that PR 10 accepted); weights are the 8:4:1
+#: dispatch shares the fairness tests assert.
+DEFAULT_CLASSES: Tuple[SLOClass, ...] = (
+    SLOClass("gold", weight=8.0, target_latency_s=0.25,
+             degrade_ok=False, defer_ok=False, reject_exempt=True),
+    SLOClass("silver", weight=4.0, target_latency_s=1.0,
+             degrade_ok=True, defer_ok=False),
+    SLOClass("bulk", weight=1.0, target_latency_s=None,
+             degrade_ok=True, defer_ok=True),
+)
+
+
+def class_table(classes: Tuple[SLOClass, ...]) -> Dict[str, SLOClass]:
+    """Name -> class mapping with duplicate-name validation."""
+    out: Dict[str, SLOClass] = {}
+    for cls in classes:
+        if cls.name in out:
+            raise ValueError(f"duplicate SLO class {cls.name!r}")
+        out[cls.name] = cls
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedConfig:
+    """Dispatch-policy knobs of the weighted-fair scheduler.
+
+    ``fair=False`` keeps the literal PR 10 oldest-queue-first pop (the
+    bit-for-bit reference the compat test replays against).
+    ``tenant_weights`` multiplies a tenant's flows' class weights
+    (unlisted tenants weigh 1.0).
+    """
+
+    classes: Tuple[SLOClass, ...] = DEFAULT_CLASSES
+    fair: bool = True
+    tenant_weights: Tuple[Tuple[str, float], ...] = ()
+
+    def __post_init__(self):
+        class_table(self.classes)          # validates
+        for tenant, w in self.tenant_weights:
+            if w <= 0:
+                raise ValueError(f"tenant weight must be > 0, got "
+                                 f"{w} for {tenant!r}")
+
+    def weight_for(self, tenant: str, slo_class: str) -> float:
+        cls = class_table(self.classes).get(slo_class)
+        base = cls.weight if cls is not None else 1.0
+        return base * dict(self.tenant_weights).get(tenant, 1.0)
+
+
+class BatchCostModel:
+    """Per-handle dispatch cost in (estimated) seconds.
+
+    The seed is the calibrated machine model's price of one operator
+    sweep - ``nnz x (itemsize + 4) / mem_bytes_per_s`` per iteration,
+    the same memory-stream term ``balance.plan.score_report`` uses -
+    times a fixed placeholder iteration count; with no confident
+    calibration on disk the seed is the same expression against the
+    reference table model, so relative costs across handles stay
+    meaningful either way.  After a handle's first dispatch the seed
+    is dead: :meth:`observe` tracks the EWMA of its measured batch
+    solve walls, which is what DRR actually drains credits against.
+    """
+
+    def __init__(self, alpha: float = 0.3):
+        self._alpha = float(alpha)
+        self._measured: Dict[str, float] = {}
+        self._seeds: Dict[str, float] = {}
+        self._model = None
+        self._model_loaded = False
+
+    def _mem_bytes_per_s(self) -> float:
+        if not self._model_loaded:
+            self._model_loaded = True
+            try:
+                from ..telemetry.calibrate import preferred_model
+
+                self._model = preferred_model()
+            except Exception:
+                self._model = None
+        if self._model is not None:
+            return float(self._model.mem_bytes_per_s)
+        from ..balance.plan import reference_model
+
+        return float(reference_model().mem_bytes_per_s)
+
+    def price(self, handle) -> float:
+        """Estimated seconds of one dispatch of ``handle`` (any
+        bucket: the batched sweep is operator-dominated, so bucket
+        size does not change the relative story DRR needs)."""
+        measured = self._measured.get(handle.key)
+        if measured is not None:
+            return measured
+        seed = self._seeds.get(handle.key)
+        if seed is None:
+            nnz = getattr(handle.a, "nnz", None)
+            if nnz is None:
+                nnz = 8 * handle.n       # dense-ish row fallback
+            per_iter = float(nnz) * _SWEEP_BYTES_PER_NNZ \
+                / max(self._mem_bytes_per_s(), 1.0)
+            seed = per_iter * min(int(handle.maxiter),
+                                  DEFAULT_COST_ITERS)
+            self._seeds[handle.key] = max(seed, 1e-9)
+        return self._seeds[handle.key]
+
+    def observe(self, handle, solve_s: float) -> None:
+        if solve_s <= 0:
+            return
+        prev = self._measured.get(handle.key)
+        self._measured[handle.key] = float(solve_s) if prev is None \
+            else (1 - self._alpha) * prev + self._alpha * float(solve_s)
+
+
+class WeightedFairScheduler:
+    """Deficit round-robin over flows; see the module docstring.
+
+    Not thread-safe on its own - the service calls :meth:`pick` under
+    its queue lock.  Deterministic: the chosen flow is a pure function
+    of the pick-call history (registration order breaks ties), which
+    is what lets the fake-clock tests assert exact dispatch orders.
+    """
+
+    def __init__(self, config: Optional[SchedConfig] = None):
+        self.config = config or SchedConfig()
+        # weight tables built ONCE: _weight runs on every pointer
+        # rotation of every dispatch, under the service lock
+        self._class_weight: Dict[str, float] = {
+            cls.name: cls.weight for cls in self.config.classes}
+        self._tenant_weight: Dict[str, float] = \
+            dict(self.config.tenant_weights)
+        self._order: List[Tuple] = []       # registration order
+        self._deficit: Dict[Tuple, float] = {}
+        self._cursor = 0
+        #: the flow the pointer is currently serving: its round grant
+        #: was already paid, so repeat picks keep draining the deficit
+        #: instead of re-granting (one grant per pointer ARRIVAL is
+        #: what makes the weight shares real)
+        self._serving: Optional[Tuple] = None
+
+    def _weight(self, flow: Tuple) -> float:
+        # flow = (handle_key, tenant, slo_class)
+        return self._class_weight.get(flow[2], 1.0) \
+            * self._tenant_weight.get(flow[1], 1.0)
+
+    def pick(self, candidates: Mapping[Tuple, float]) -> Tuple:
+        """Choose the next flow to dispatch.  ``candidates`` maps each
+        currently-dispatchable flow to the priced cost of its next
+        batch; flows absent from it lose their banked deficit (the
+        classic DRR empty-queue reset)."""
+        if not candidates:
+            raise ValueError("pick() needs >= 1 candidate flow")
+        for flow in [f for f in self._order if f not in candidates]:
+            idx = self._order.index(flow)
+            self._order.remove(flow)
+            del self._deficit[flow]
+            if idx < self._cursor:
+                self._cursor -= 1
+            if flow == self._serving:
+                self._serving = None
+        for flow in candidates:
+            if flow not in self._deficit:
+                self._order.append(flow)
+                self._deficit[flow] = 0.0
+        if self._cursor >= len(self._order):
+            self._cursor = 0
+        max_w = max(self._weight(f) for f in self._order)
+        quantum = max(candidates.values())
+        # every full rotation grows each deficit by a weight-
+        # proportional quantum share >= quantum * w_min / w_max and no
+        # cost exceeds quantum, so the loop terminates within
+        # ceil(w_max / w_min) + 1 rotations
+        while True:
+            flow = self._order[self._cursor]
+            if flow != self._serving:
+                # the pointer just arrived: pay this round's grant
+                self._deficit[flow] += \
+                    quantum * self._weight(flow) / max_w
+                self._serving = flow
+            if self._deficit[flow] >= candidates[flow]:
+                self._deficit[flow] -= candidates[flow]
+                return flow
+            # grant exhausted: the turn ends, the pointer moves on
+            self._serving = None
+            self._cursor = (self._cursor + 1) % len(self._order)
+
+    def deficits(self) -> Dict[Tuple, float]:
+        """Snapshot for stats()/debugging."""
+        return dict(self._deficit)

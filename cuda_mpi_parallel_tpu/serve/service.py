@@ -26,6 +26,15 @@ arrival patterns into those batches:
   status).  Deadlines surface as typed TIMEOUT results, never as
   worker exceptions.  Backpressure is a bounded queue
   (``serve.queue.QueueFull``).
+* Multi-tenant overload protection (this PR): ``submit()`` takes
+  ``tenant``/``slo_class`` tags; per-tenant token buckets
+  (``serve.admission``) and the shed-before-collapse ladder (degrade
+  tolerance -> defer ``bulk`` -> reject with ``retry_after_s``)
+  answer sustained overload with typed ``ADMISSION_REJECTED`` results
+  instead of a timeout storm, while the weighted-fair
+  deficit-round-robin dispatcher (``serve.sched``) keeps a hot tenant
+  from starving everyone else.  ``workers=N`` runs N dispatch threads
+  over the one LRU'd compiled-solver cache.
 
 Observability from day one: ``request_enqueued`` / ``batch_dispatch``
 / ``request_done`` events (the batch's events share the underlying
@@ -53,6 +62,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..solver.status import CGStatus
+from .admission import (
+    AdmissionConfig,
+    AdmissionController,
+    ShedConfig,
+    ShedLadder,
+)
 from .queue import (
     Batch,
     MicroBatchQueue,
@@ -60,6 +75,12 @@ from .queue import (
     QueueFull,
     bucket_sizes,
     tol_class,
+)
+from .sched import (
+    BatchCostModel,
+    SchedConfig,
+    WeightedFairScheduler,
+    class_table,
 )
 
 __all__ = [
@@ -216,6 +237,31 @@ class ServiceConfig:
     #: per-handle RecycleSpace harvested from early dispatches and
     #: deflated from later ones (solver.recycle)
     recycle: Optional[RecyclePolicy] = None
+    #: multi-tenant scheduling (serve.sched): SLO-class table +
+    #: weighted-fair (deficit-round-robin) dispatch across
+    #: (handle, tenant, class) flows.  None = the default SchedConfig
+    #: (fair dispatch, gold/silver/bulk at 8:4:1);
+    #: SchedConfig(fair=False) keeps the literal PR 10
+    #: oldest-queue-first pop as the bit-for-bit reference.
+    sched: Optional[SchedConfig] = None
+    #: per-tenant token-bucket admission control (serve.admission):
+    #: None = every tenant unmetered.  A rejected submit resolves to a
+    #: typed ADMISSION_REJECTED result with a retry_after_s hint -
+    #: never an exception
+    admission: Optional[AdmissionConfig] = None
+    #: the shed-before-collapse ladder (serve.admission.ShedConfig):
+    #: degrade tolerance -> defer bulk -> reject at admission, driven
+    #: by queue depth vs the measured capacity estimate.  None keeps
+    #: only the legacy ``degrade_depth`` rung below
+    shed: Optional[ShedConfig] = None
+    #: dispatch workers in threaded mode (manual/fake-clock mode stays
+    #: single-stepped by pump()).  1 = the PR 10 single worker;
+    #: N > 1 = N workers sharing the one LRU'd compiled-solver cache;
+    #: 0 = auto-size from the calibrated machine model
+    #: (``calibrate.preferred_model``: a confidently-calibrated host
+    #: is trusted to overlap one extra dispatcher, an uncalibrated
+    #: one stays serialized)
+    workers: int = 1
     #: per-batch dispatch log retained for reports (ring, drop-oldest)
     keep_batch_log: int = 1024
     #: exact latency samples retained for stats() percentiles (ring,
@@ -267,6 +313,12 @@ class RequestResult:
     solve_id: Optional[str]
     attempts: int = 1
     degraded: bool = False
+    #: multi-tenant scheduling: the submitting tenant and SLO class
+    tenant: str = "default"
+    slo_class: str = "silver"
+    #: ADMISSION_REJECTED only: when the admission controller suggests
+    #: retrying (token-bucket refill / estimated backlog drain time)
+    retry_after_s: Optional[float] = None
 
     @property
     def ok(self) -> bool:
@@ -276,13 +328,16 @@ class RequestResult:
     def failure_kind(self) -> Optional[str]:
         """``"problem"`` (BREAKDOWN - the system's fault), ``"engine"``
         (ERROR - the dispatch raised), ``"deadline"`` (TIMEOUT),
-        ``"breaker"`` (REFUSED), ``"budget"``/``"convergence"`` for
-        MAXITER/STAGNATED/DIVERGED, or ``None`` when converged."""
+        ``"breaker"`` (REFUSED), ``"admission"``
+        (ADMISSION_REJECTED - the tenant's rate or the shed ladder),
+        ``"budget"``/``"convergence"`` for MAXITER/STAGNATED/DIVERGED,
+        or ``None`` when converged."""
         return {
             "BREAKDOWN": "problem",
             "ERROR": "engine",
             "TIMEOUT": "deadline",
             "REFUSED": "breaker",
+            "ADMISSION_REJECTED": "admission",
             "MAXITER": "budget",
             "STAGNATED": "convergence",
             "DIVERGED": "convergence",
@@ -377,10 +432,36 @@ class SolverService:
         self.config = config or ServiceConfig()
         self._clock = self.config.clock or time.monotonic
         self._manual = self.config.clock is not None
+        # multi-tenant scheduling: the SLO-class table, the priced
+        # cost model, and (unless fair=False keeps the PR 10 pop) the
+        # deficit-round-robin scheduler the queue consults
+        self._sched_cfg = self.config.sched or SchedConfig()
+        self._classes = class_table(self._sched_cfg.classes)
+        self._cost_model = BatchCostModel()
+        sched = WeightedFairScheduler(self._sched_cfg) \
+            if self._sched_cfg.fair else None
         self._queue = MicroBatchQueue(
             max_batch=self.config.max_batch,
             max_wait_s=self.config.max_wait_s,
-            queue_limit=self.config.queue_limit)
+            queue_limit=self.config.queue_limit,
+            sched=sched, cost_fn=self._cost_model.price)
+        # admission + shed ladder (serve.admission).  A bare legacy
+        # degrade_depth maps onto the ladder's first rung, so PR 12
+        # configs keep their exact behavior
+        self._admission = AdmissionController(self.config.admission) \
+            if self.config.admission is not None else None
+        shed_cfg = self.config.shed
+        if shed_cfg is None:
+            shed_cfg = ShedConfig(
+                degrade_depth=max(int(self.config.degrade_depth), 0))
+        elif self.config.degrade_depth > 0 \
+                and shed_cfg.degrade_depth == 0 and not shed_cfg.auto:
+            raise ValueError(
+                "both ServiceConfig.shed and the legacy degrade_depth "
+                "are set but the ShedConfig's degrade rung is off - "
+                "put the depth in ShedConfig.degrade_depth (one knob, "
+                "no silent precedence)")
+        self._shed = ShedLadder(shed_cfg)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._handles: Dict[str, OperatorHandle] = {}
@@ -401,6 +482,22 @@ class SolverService:
         self._retries = 0
         self._refused = 0
         self._degraded = 0
+        self._admission_rejected = 0
+        self._deferred = 0
+        # per-tenant / per-SLO-class tallies (exact, for stats())
+        self._tenant_stats: Dict[str, Dict[str, int]] = {}
+        self._class_stats: Dict[str, Dict[str, int]] = {}
+        self._class_latencies: Dict[str, deque] = {}
+        # measured capacity estimate: EWMA of solved RHS/s over
+        # dispatched batches (live lanes / solve wall), seeded at
+        # registration from the phase profile when one was taken -
+        # what the shed ladder's auto thresholds price against
+        self._rate_ewma: Optional[float] = None
+        self._rate_seed: Optional[float] = None
+        # defer-note throttle: one sched_dispatch decision="defer"
+        # event per held flow per ladder episode (reset on level
+        # change), so a long hold does not flood the trace
+        self._defer_noted: set = set()
         self._breakers: Dict[str, _Breaker] = {}
         self._latencies: deque = deque(
             maxlen=self.config.keep_latency_samples)
@@ -427,15 +524,48 @@ class SolverService:
             # a handle's solvers are evicted, its space goes with them
             self._evict_listener = self._on_solver_evicted
             dist_cg.add_evict_listener(self._evict_listener)
-        # one dispatcher at a time: the worker thread and a caller-side
-        # drain() must not interleave two engine calls
+        # single-dispatcher serialization (manual pumps, drain, and
+        # the workers == 1 thread): one engine call at a time.  A
+        # multi-worker pool (workers > 1) deliberately skips this lock
+        # - concurrent dispatch onto the shared compiled-solver cache
+        # is the point - and quiescence is proven by the in-flight
+        # counter instead
         self._dispatch_lock = threading.Lock()
-        self._worker: Optional[threading.Thread] = None
+        self._inflight = 0
+        self._n_workers = self._resolve_workers()
+        if self.config.recycle is not None and self._n_workers > 1:
+            raise ValueError(
+                "ServiceConfig.recycle with workers > 1 is "
+                "unsupported: the per-handle harvest schedule is a "
+                "serial accumulation (concurrent dispatches would "
+                "race the basis ring); run recycling on one worker")
+        self._workers: List[threading.Thread] = []
         if not self._manual:
-            self._worker = threading.Thread(
-                target=self._worker_loop,
-                name="cuda-mpi-parallel-tpu-serve", daemon=True)
-            self._worker.start()
+            for i in range(self._n_workers):
+                t = threading.Thread(
+                    target=self._worker_loop,
+                    name=f"cuda-mpi-parallel-tpu-serve-{i}",
+                    daemon=True)
+                t.start()
+                self._workers.append(t)
+
+    def _resolve_workers(self) -> int:
+        """``config.workers``, with 0 = auto-size from the calibrated
+        machine model: a host whose calibration cache holds a
+        confident measured fit gets one extra dispatcher to overlap
+        host-side batch prep with the device solve; an uncalibrated
+        host stays at the PR 10 single worker (no guessing)."""
+        workers = int(self.config.workers)
+        if workers < 0:
+            raise ValueError(f"workers must be >= 0, got {workers}")
+        if workers > 0:
+            return workers
+        try:
+            from ..telemetry.calibrate import preferred_model
+
+            return 2 if preferred_model() is not None else 1
+        except Exception:
+            return 1
 
     # -- registration ----------------------------------------------------
 
@@ -564,6 +694,7 @@ class SolverService:
             if phase_profile and existing.phase_profile is None:
                 existing.phase_profile = self._phase_profile(
                     existing, int(phase_profile))
+                self._seed_capacity(existing)
             return existing
 
         dispatcher = None
@@ -612,7 +743,24 @@ class SolverService:
         if phase_profile:
             handle.phase_profile = self._phase_profile(
                 handle, int(phase_profile))
+            self._seed_capacity(handle)
         return handle
+
+    def _seed_capacity(self, handle: OperatorHandle) -> None:
+        """Seed the shed ladder's capacity estimate from the measured
+        phase profile: a full bucket over a worst-case solve
+        (``step_s`` x maxiter) - deliberately pessimistic, and dead
+        the moment the first real dispatch lands in the EWMA."""
+        profile = handle.phase_profile
+        if profile is None:
+            return
+        step_s = float(getattr(profile, "step_s", 0.0))
+        if step_s <= 0:
+            return
+        seed = self.config.max_batch / (step_s * max(handle.maxiter, 1))
+        with self._lock:
+            self._rate_seed = seed if self._rate_seed is None \
+                else min(self._rate_seed, seed)
 
     def _phase_profile(self, handle: OperatorHandle, repeats: int):
         """Measure the handle's phase profile on its OWN partition (the
@@ -649,18 +797,32 @@ class SolverService:
     # -- submission ------------------------------------------------------
 
     def submit(self, handle: OperatorHandle, b, *, tol: float = 1e-7,
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               tenant: str = "default",
+               slo_class: str = "silver") -> Future:
         """Enqueue one right-hand side; returns a Future resolving to
         a :class:`RequestResult`.  ``b`` is coerced to the handle's
         compiled dtype (the service trades that copy for a bounded
-        compiled-shape set).  ``deadline_s`` is relative to now; an
-        expired request resolves to a typed TIMEOUT result.  Raises
-        :class:`ServiceClosed` after close() and
-        :class:`serve.queue.QueueFull` at the backpressure bound.
+        compiled-shape set).  ``deadline_s`` is relative to now (a
+        ``None`` takes the SLO class's default, if it declares one);
+        an expired request resolves to a typed TIMEOUT result.
+
+        ``tenant``/``slo_class`` tag the request for admission control
+        and weighted-fair dispatch: a tenant past its token-bucket
+        rate - or any non-gold submit while the shed ladder's reject
+        rung holds - resolves immediately to a typed
+        ``ADMISSION_REJECTED`` result with a ``retry_after_s`` hint.
+        Raises :class:`ServiceClosed` after close() and
+        :class:`serve.queue.QueueFull` at the hard backpressure bound.
         """
         if handle.key not in self._handles:
             raise ValueError("unknown handle (register the operator "
                              "with THIS service first)")
+        cls = self._classes.get(slo_class)
+        if cls is None:
+            raise ValueError(
+                f"unknown SLO class {slo_class!r}; this service knows "
+                f"{sorted(self._classes)}")
         b = np.asarray(b)
         if b.ndim != 1 or b.shape[0] != handle.n:
             raise ValueError(
@@ -673,6 +835,8 @@ class SolverService:
             check_finite_rhs(b, what="submitted b")
         b = np.ascontiguousarray(b, dtype=np.dtype(handle.dtype_name))
         tol = float(tol)
+        if deadline_s is None:
+            deadline_s = cls.deadline_s
         now = self._clock()
         # closed beats everything: a REFUSED future from an open
         # breaker must not mask the documented ServiceClosed contract
@@ -684,12 +848,34 @@ class SolverService:
                     "solver service is closed (no new submissions)")
         rid = f"q{next(self._ids):06d}"
         if self._breaker_refuses(handle.key, now, rid):
-            return self._refuse(rid, handle, now)
+            return self._refuse(rid, handle, now, tenant, slo_class)
+        # the shed ladder, in order: reject (non-exempt classes
+        # refused at the door with a retry hint) beats admission
+        # metering beats degrade - every rung strictly milder than
+        # letting accepted work time out
+        level = self._evaluate_shed(now)
+        if level >= 3 and not cls.reject_exempt:
+            return self._admission_reject(
+                rid, handle, tenant, slo_class,
+                retry_after_s=self._drain_eta(), reason="shed",
+                tokens=None)
+        if self._admission is not None:
+            with self._lock:
+                decision = self._admission.admit(tenant, now)
+            self._note_tokens(tenant, decision.tokens)
+            if not decision.admitted:
+                return self._admission_reject(
+                    rid, handle, tenant, slo_class,
+                    retry_after_s=decision.retry_after_s,
+                    reason=decision.reason, tokens=decision.tokens)
         degraded = False
-        if self.config.degrade_depth > 0 \
-                and self.queue_depth() >= self.config.degrade_depth:
-            # load-shedding step BEFORE backpressure: relax the
-            # tolerance one decade so the queue drains faster; the
+        degrade_rung_on = self._shed.config.thresholds(
+            self._capacity())[0] is not None
+        if level >= 1 and cls.degrade_ok and degrade_rung_on:
+            # the ladder's first rung (PR 12's degrade_depth,
+            # generalized per class), cumulative with the rungs above
+            # it but never fired when the operator disabled it: relax
+            # the tolerance one decade so the queue drains faster; the
             # result says so (degraded=True), nothing is silent
             tol, degraded = tol * 10.0, True
         req = QueuedRequest(
@@ -698,7 +884,8 @@ class SolverService:
             tol=tol, enqueue_t=now,
             deadline_t=(now + float(deadline_s)
                         if deadline_s is not None else None),
-            future=Future(), handle=handle, degraded=degraded)
+            future=Future(), handle=handle, degraded=degraded,
+            tenant=tenant, slo_class=slo_class)
         try:
             with self._cond:
                 if self._closed:
@@ -706,9 +893,13 @@ class SolverService:
                         "solver service is closed (no new "
                         "submissions)")
                 depth = self._queue.push(req)      # raises QueueFull
+                tenant_depth = \
+                    self._queue.depth_by_tenant().get(tenant, 0)
                 self._submitted += 1
                 if degraded:
                     self._degraded += 1
+                self._tenant_tally(tenant)["submitted"] += 1
+                self._class_tally(slo_class)["submitted"] += 1
                 self._cond.notify_all()
         except (QueueFull, ServiceClosed):
             # a probe that never made it into the queue releases its
@@ -724,6 +915,10 @@ class SolverService:
         REGISTRY.gauge("serve_queue_depth",
                        "requests pending in the solver service "
                        "queues").set(depth)
+        REGISTRY.gauge(
+            "serve_tenant_queue_depth",
+            "requests pending per tenant",
+            labelnames=("tenant",)).set(tenant_depth, tenant=tenant)
         if degraded:
             REGISTRY.counter(
                 "serve_degraded_total",
@@ -732,8 +927,170 @@ class SolverService:
                 labelnames=("handle",)).inc(handle=handle.key)
         events.emit("request_enqueued", request_id=req.request_id,
                     handle=handle.key, queue_depth=depth,
-                    tol_class=tol_class(tol), degraded=degraded)
+                    tol_class=tol_class(tol), degraded=degraded,
+                    tenant=tenant, slo_class=slo_class)
         return req.future
+
+    # -- multi-tenant bookkeeping / shed ladder --------------------------
+
+    def _tenant_tally(self, tenant: str) -> Dict[str, int]:
+        """Caller holds the lock."""
+        return self._tenant_stats.setdefault(
+            tenant, {"submitted": 0, "completed": 0, "rejected": 0,
+                     "timeouts": 0})
+
+    def _class_tally(self, slo_class: str) -> Dict[str, int]:
+        """Caller holds the lock."""
+        return self._class_stats.setdefault(
+            slo_class, {"submitted": 0, "completed": 0, "rejected": 0,
+                        "timeouts": 0, "in_slo": 0})
+
+    def _capacity(self) -> Optional[float]:
+        """Measured solved-RHS/s estimate: the dispatch EWMA once any
+        batch has run, else the pessimistic phase-profile seed taken
+        at registration (max_batch lanes / (measured step x maxiter)),
+        else None - the auto shed rungs stay off until the service has
+        MEASURED something."""
+        return self._rate_ewma if self._rate_ewma is not None \
+            else self._rate_seed
+
+    def _drain_eta(self) -> float:
+        """retry_after_s hint for a shed rejection: the measured time
+        to drain the current backlog (depth / capacity), floored at
+        one max_wait so the hint is never zero."""
+        with self._lock:
+            depth = self._queue.depth()
+        cap = self._capacity()
+        floor = max(self.config.max_wait_s, 1e-3)
+        if cap is None or cap <= 0:
+            return 4 * floor
+        return max(depth / cap, floor)
+
+    def _evaluate_shed(self, now: float) -> int:
+        """Re-derive the ladder level from the current queue depth;
+        emits the ``shed`` transition event + gauge on change and
+        resets the defer-note throttle.  Returns the level."""
+        with self._lock:
+            depth = self._queue.depth()
+            changed = self._shed.evaluate(depth, self._capacity())
+            level = self._shed.level
+            name = self._shed.name
+            if changed:
+                self._defer_noted.clear()
+        if changed:
+            from ..telemetry import events
+            from ..telemetry.registry import REGISTRY
+
+            REGISTRY.gauge(
+                "serve_shed_level",
+                "shed-ladder level (0 ok, 1 degrade, 2 defer, "
+                "3 reject)").set(level)
+            events.emit("shed", level=level, queue_depth=depth,
+                        name=name,
+                        capacity_rhs_per_s=self._capacity())
+        return level
+
+    def _defer_classes(self) -> frozenset:
+        """SLO classes the ladder's defer rung names (level >= 2)."""
+        if self._shed.level < 2:
+            return frozenset()
+        return frozenset(name for name, cls in self._classes.items()
+                         if cls.defer_ok)
+
+    def _active_defer(self) -> frozenset:
+        """The defer set that actually applies right now.  Deferral is
+        a RELATIVE priority - bulk yields capacity to gold/silver -
+        never an absolute hold: when nothing non-deferred is queued or
+        in flight, holding the backlog would serve nobody and (with no
+        deadlines to expire) wedge it forever, since the ladder can
+        only descend when depth falls and depth can only fall by
+        dispatching.  Caller need not hold the lock (the RLock makes
+        the depth reads safe either way)."""
+        defer = self._defer_classes()
+        if not defer:
+            return defer
+        with self._lock:
+            if self._inflight:
+                return defer
+            depths = self._queue.depth_by_class()
+            if any(n for cls, n in depths.items() if cls not in defer):
+                return defer
+        return frozenset()
+
+    def _note_defers(self, now: float) -> None:
+        """Emit one ``sched_dispatch`` decision="defer" event per held
+        flow per ladder episode (throttled via ``_defer_noted``)."""
+        defer = self._active_defer()
+        if not defer:
+            return
+        with self._lock:
+            held = [k for k in self._queue.deferred_ready(now, defer)
+                    if k not in self._defer_noted]
+            self._defer_noted.update(held)
+            self._deferred += len(held)
+        if not held:
+            return
+        from ..telemetry import events
+        from ..telemetry.registry import REGISTRY
+
+        for key in held:
+            REGISTRY.counter(
+                "serve_deferred_total",
+                "dispatch-ready queues held by the shed ladder's "
+                "defer rung", labelnames=("slo_class",)).inc(
+                    slo_class=key[2])
+            events.emit("sched_dispatch", tenant=key[1],
+                        slo_class=key[2], decision="defer",
+                        handle=key[0], shed_level=self._shed.level)
+
+    def _note_tokens(self, tenant: str, tokens: float) -> None:
+        from ..telemetry.registry import REGISTRY
+
+        if tokens == float("inf"):
+            return
+        REGISTRY.gauge(
+            "serve_tenant_tokens",
+            "admission token-bucket balance per tenant",
+            labelnames=("tenant",)).set(float(tokens), tenant=tenant)
+
+    def _admission_reject(self, rid: str, handle: OperatorHandle,
+                          tenant: str, slo_class: str, *,
+                          retry_after_s: Optional[float],
+                          reason: Optional[str],
+                          tokens: Optional[float]) -> Future:
+        """Typed ADMISSION_REJECTED result - resolved immediately,
+        never queued, never an exception (the polite refusal BEFORE
+        the hard QueueFull bound)."""
+        from ..telemetry import events
+        from ..telemetry.registry import REGISTRY
+
+        with self._lock:
+            self._admission_rejected += 1
+            self._tenant_tally(tenant)["rejected"] += 1
+            self._class_tally(slo_class)["rejected"] += 1
+        REGISTRY.counter(
+            "serve_admission_rejected_total",
+            "requests refused by admission control (token bucket or "
+            "shed ladder)", labelnames=("tenant", "reason")).inc(
+                tenant=tenant, reason=reason or "unknown")
+        events.emit("admission", request_id=rid, tenant=tenant,
+                    slo_class=slo_class, decision="rejected",
+                    reason=reason,
+                    retry_after_s=(round(float(retry_after_s), 6)
+                                   if retry_after_s is not None
+                                   else None),
+                    tokens=(round(float(tokens), 6)
+                            if tokens is not None else None),
+                    handle=handle.key)
+        fut: Future = Future()
+        fut.set_result(RequestResult(
+            request_id=rid, status="ADMISSION_REJECTED",
+            converged=False, timed_out=False, x=None, iterations=0,
+            residual_norm=float("nan"), wait_s=0.0, solve_s=0.0,
+            latency_s=0.0, bucket=0, occupancy=0.0, solve_id=None,
+            attempts=0, tenant=tenant, slo_class=slo_class,
+            retry_after_s=retry_after_s))
+        return fut
 
     # -- circuit breaker -------------------------------------------------
 
@@ -826,8 +1183,9 @@ class SolverService:
             br = self._breakers.get(handle.key)
             return br.state if br is not None else "closed"
 
-    def _refuse(self, rid: str, handle: OperatorHandle,
-                now: float) -> Future:
+    def _refuse(self, rid: str, handle: OperatorHandle, now: float,
+                tenant: str = "default",
+                slo_class: str = "silver") -> Future:
         """Typed REFUSED result for an open breaker - resolved
         immediately, never queued."""
         from ..telemetry import events
@@ -840,14 +1198,15 @@ class SolverService:
             "requests refused by an open per-handle circuit breaker",
             labelnames=("handle",)).inc(handle=handle.key)
         events.emit("request_done", request_id=rid, status="REFUSED",
-                    wait_s=0.0, handle=handle.key)
+                    wait_s=0.0, handle=handle.key, tenant=tenant,
+                    slo_class=slo_class)
         fut: Future = Future()
         fut.set_result(RequestResult(
             request_id=rid, status="REFUSED", converged=False,
             timed_out=False, x=None, iterations=0,
             residual_norm=float("nan"), wait_s=0.0, solve_s=0.0,
             latency_s=0.0, bucket=0, occupancy=0.0, solve_id=None,
-            attempts=0))
+            attempts=0, tenant=tenant, slo_class=slo_class))
         return fut
 
     def _requeue(self, req: QueuedRequest, status: str,
@@ -889,20 +1248,28 @@ class SolverService:
 
     def pump(self, now: Optional[float] = None) -> int:
         """Advance the policy once at ``now`` (manual-clock mode; the
-        worker thread calls the same step on real time).  Returns the
+        worker threads call the same step on real time).  Returns the
         number of batches dispatched."""
         return self._step(self._clock() if now is None else now)
 
     def _step(self, now: float, drain: bool = False) -> int:
+        if self._n_workers > 1 and not self._manual:
+            # multi-worker pool: concurrent passes are the point;
+            # quiescence rides the in-flight counter, not this lock
+            return self._step_locked(now, drain)
         with self._dispatch_lock:
             return self._step_locked(now, drain)
 
     def _step_locked(self, now: float, drain: bool = False) -> int:
-        """One policy pass; caller holds ``_dispatch_lock`` (a popped
-        batch is in flight exactly while that lock is held - which is
-        what lets drain() prove quiescence by acquiring it)."""
+        """One policy pass: sweep expired deadlines, note shed-held
+        flows, then dispatch scheduler-chosen batches one at a time
+        until nothing is dispatchable at ``now``.  In single-worker /
+        manual mode the caller holds ``_dispatch_lock``; in a
+        multi-worker pool several passes run concurrently, each pop
+        atomically claiming one batch (``_inflight`` counts the
+        claims, which is what drain() proves quiescence with)."""
         with self._lock:
-            batches, timeouts = self._queue.pop_ready(now, drain)
+            timeouts = self._queue.take_expired(now)
             depth = self._queue.depth()
         from ..telemetry.registry import REGISTRY
 
@@ -911,9 +1278,37 @@ class SolverService:
                        "queues").set(depth)
         for req in timeouts:
             self._finish_timeout(req, now)
-        for batch in batches:
-            self._run_batch(batch)
-        return len(batches)
+        self._evaluate_shed(now)
+        self._note_defers(now)
+        dispatched = 0
+        while True:
+            defer = self._active_defer()
+            with self._cond:
+                batch = self._queue.pop_next(now, drain=drain,
+                                             defer=defer)
+                if batch is not None:
+                    self._inflight += 1
+            if batch is None:
+                break
+            try:
+                self._run_batch(batch)
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    self._cond.notify_all()
+            dispatched += 1
+            # real-clock passes advance time batch-by-batch: a request
+            # that arrived (or aged past max_wait) while the previous
+            # batch solved competes in THIS pass - the weighted-fair
+            # pick must see it, or a long backlog pass would starve
+            # newcomers exactly the way DRR exists to prevent.  Manual
+            # mode keeps the frozen `now` (fake-clock determinism)
+            if not self._manual:
+                now = self._clock()
+            # dispatching drained the queue: the ladder may step DOWN
+            # mid-pass, releasing deferred flows for this same pass
+            self._evaluate_shed(now)
+        return dispatched
 
     def _finish_timeout(self, req: QueuedRequest, now: float) -> None:
         from ..telemetry import events
@@ -929,9 +1324,12 @@ class SolverService:
             residual_norm=float("nan"), wait_s=float(wait), solve_s=0.0,
             latency_s=float(wait), bucket=0, occupancy=0.0,
             solve_id=None, attempts=req.attempts,
-            degraded=req.degraded)
+            degraded=req.degraded, tenant=req.tenant,
+            slo_class=req.slo_class)
         with self._lock:
             self._timeouts += 1
+            self._tenant_tally(req.tenant)["timeouts"] += 1
+            self._class_tally(req.slo_class)["timeouts"] += 1
             # a deadline expiry is pure queue wait - it belongs in the
             # wait distribution (there is no solve wall to record)
             self._waits.append(float(wait))
@@ -942,7 +1340,8 @@ class SolverService:
                              handle=req.handle_key)
         events.emit("request_done", request_id=req.request_id,
                     status="TIMEOUT", wait_s=float(wait),
-                    handle=req.handle_key)
+                    handle=req.handle_key, tenant=req.tenant,
+                    slo_class=req.slo_class)
         if not req.future.done():
             req.future.set_result(result)
 
@@ -1116,6 +1515,14 @@ class SolverService:
         reqs = batch.requests
         handle: OperatorHandle = reqs[0].handle
         m, k = len(reqs), batch.bucket
+        if self._queue.sched is not None:
+            # the weighted-fair pick, priced: what the starvation-
+            # bound analysis audits after the fact
+            events.emit("sched_dispatch", tenant=batch.tenant,
+                        slo_class=batch.slo_class,
+                        decision="dispatch", handle=handle.key,
+                        cost=round(self._cost_model.price(handle), 9),
+                        reason=batch.reason, n_requests=m)
         b_stack = stack_columns([r.b for r in reqs], k,
                                 dtype=np.dtype(handle.dtype_name))
         tols = np.full((k,), reqs[0].tol,
@@ -1191,7 +1598,9 @@ class SolverService:
                     events.emit("request_done",
                                 request_id=r.request_id, status="ERROR",
                                 wait_s=wait, handle=handle.key,
-                                error=repr(exc)[-200:])
+                                error=repr(exc)[-200:],
+                                tenant=r.tenant,
+                                slo_class=r.slo_class)
                     REGISTRY.counter(
                         "serve_requests_done_total",
                         "requests finished by the solver service",
@@ -1208,7 +1617,8 @@ class SolverService:
                             occupancy=batch.occupancy,
                             solve_id=solve_id,
                             attempts=r.attempts + 1,
-                            degraded=r.degraded))
+                            degraded=r.degraded, tenant=r.tenant,
+                            slo_class=r.slo_class))
                 self._breaker_note_outcome(handle.key, False,
                                            self._clock())
                 return
@@ -1250,14 +1660,16 @@ class SolverService:
                     solve_s=float(solve_s), latency_s=float(latency),
                     bucket=k, occupancy=batch.occupancy,
                     solve_id=solve_id, attempts=r.attempts + 1,
-                    degraded=r.degraded)
+                    degraded=r.degraded, tenant=r.tenant,
+                    slo_class=r.slo_class)
                 results.append((r, result))
                 events.emit("request_done", request_id=r.request_id,
                             status=status, wait_s=wait,
                             solve_s=float(solve_s),
                             latency_s=float(latency),
                             iterations=int(iters[j]),
-                            converged=bool(conv[j]), handle=handle.key)
+                            converged=bool(conv[j]), handle=handle.key,
+                            tenant=r.tenant, slo_class=r.slo_class)
                 REGISTRY.counter(
                     "serve_requests_done_total",
                     "requests finished by the solver service",
@@ -1298,6 +1710,19 @@ class SolverService:
             self._padded_lanes += k - m
             self._occupancy_sum += batch.occupancy
             self._bucket_counts[k] = self._bucket_counts.get(k, 0) + 1
+            # the measured capacity estimate the shed ladder prices
+            # against, and the scheduler's cost-model feedback.  The
+            # per-batch sample (lanes / its own solve wall) is scaled
+            # by the worker count: batches overlap across the pool, so
+            # the service drains ~N batches per batch-wall.  Exact
+            # under the saturation the ladder cares about (an idle
+            # pool overestimates, which only RAISES auto thresholds -
+            # shedding never fires early on the scaling)
+            self._cost_model.observe(handle, float(solve_s))
+            if solve_s > 0:
+                rate = self._n_workers * m / float(solve_s)
+                self._rate_ewma = rate if self._rate_ewma is None \
+                    else 0.7 * self._rate_ewma + 0.3 * rate
             for _, result in results:
                 self._completed += 1
                 if result.converged:
@@ -1305,6 +1730,19 @@ class SolverService:
                 self._latencies.append(result.latency_s)
                 self._waits.append(result.wait_s)
                 self._solves.append(result.solve_s)
+                self._tenant_tally(result.tenant)["completed"] += 1
+                ctally = self._class_tally(result.slo_class)
+                ctally["completed"] += 1
+                cls = self._classes.get(result.slo_class)
+                target = cls.target_latency_s if cls is not None \
+                    else None
+                if result.converged and (target is None
+                                         or result.latency_s <= target):
+                    ctally["in_slo"] += 1
+                self._class_latencies.setdefault(
+                    result.slo_class,
+                    deque(maxlen=self.config.keep_latency_samples)
+                ).append(result.latency_s)
             self._batch_log.append({
                 "handle": handle.key, "bucket": k, "n_requests": m,
                 "reason": batch.reason, "solve_s": float(solve_s),
@@ -1325,24 +1763,30 @@ class SolverService:
 
     def drain(self) -> None:
         """Flush every pending request NOW (partial batches dispatch
-        immediately with reason="drain"); returns when the queues are
-        empty AND no batch is in flight.  The service stays open.
+        immediately with reason="drain", deferred classes included);
+        returns when the queues are empty AND no batch is in flight.
+        The service stays open.
 
-        Quiescence proof: a batch is in flight exactly while
-        ``_dispatch_lock`` is held (``_step``), so holding the lock
-        with empty queues means every submitted request has resolved -
-        a caller timing a replay window after drain() includes the
-        last batch's solve wall."""
+        Quiescence proof: every dispatch - manual, worker, or drain -
+        increments ``_inflight`` atomically with its pop and
+        decrements it (with a notify) when the batch resolves, so
+        ``depth == 0 and _inflight == 0`` under the lock means every
+        submitted request has resolved - a caller timing a replay
+        window after drain() includes the last batch's solve wall."""
         while True:
-            with self._dispatch_lock:
-                with self._lock:
-                    if self._queue.depth() == 0:
-                        return
-                self._step_locked(self._clock(), drain=True)
+            self._step(self._clock(), drain=True)
+            with self._cond:
+                if self._queue.depth() == 0 and self._inflight == 0:
+                    return
+                if self._inflight:
+                    # another worker owns the last batches: wait for
+                    # their notify instead of spinning on pop_next
+                    self._cond.wait(timeout=0.05)
 
     def close(self) -> None:
-        """Stop accepting work, drain what is queued, stop the worker.
-        Idempotent; submits after close raise :class:`ServiceClosed`."""
+        """Stop accepting work, drain what is queued, stop the worker
+        pool.  Idempotent; submits after close raise
+        :class:`ServiceClosed`."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
@@ -1352,12 +1796,13 @@ class SolverService:
 
             dist_cg.remove_evict_listener(self._evict_listener)
             self._evict_listener = None
-        if self._worker is not None:
+        if self._workers:
             with self._cond:
                 self._stop = True
                 self._cond.notify_all()
-            self._worker.join(timeout=5.0)
-            self._worker = None
+            for t in self._workers:
+                t.join(timeout=5.0)
+            self._workers = []
 
     def __enter__(self) -> "SolverService":
         return self
@@ -1371,7 +1816,15 @@ class SolverService:
                 if self._stop:
                     return
                 now = self._clock()
-                wake = self._queue.next_wake(now)
+                # re-derive the ladder level from the current depth
+                # before sleeping: a pass that just drained the queue
+                # may have dropped the level, releasing deferred flows
+                # whose max_wait must now drive the wake (the RLock
+                # makes the re-entrant evaluate safe; a transition
+                # still emits its shed event)
+                self._evaluate_shed(now)
+                wake = self._queue.next_wake(
+                    now, defer=self._active_defer())
                 if wake is None:
                     self._cond.wait()
                 elif wake > now:
@@ -1430,6 +1883,44 @@ class SolverService:
                              for key, br in self._breakers.items()
                              if br.state != "closed"},
             }
+            # multi-tenant / overload story: per-tenant disposition +
+            # live depth, per-class SLO accounting, and the shed
+            # ladder's state - only when any of it is non-trivial, so
+            # a plain single-tenant stats() keeps its PR 10 shape
+            tenant_depth = self._queue.depth_by_tenant()
+            if self._tenant_stats and (
+                    len(self._tenant_stats) > 1
+                    or set(self._tenant_stats) != {"default"}
+                    or self._admission_rejected):
+                out["tenants"] = {
+                    t: {**tally, "depth": tenant_depth.get(t, 0)}
+                    for t, tally in sorted(self._tenant_stats.items())}
+            if self._class_stats and (
+                    len(self._class_stats) > 1
+                    or set(self._class_stats) != {"silver"}):
+                classes = {}
+                for name, tally in sorted(self._class_stats.items()):
+                    cls = self._classes.get(name)
+                    lats = sorted(self._class_latencies.get(name, ()))
+                    classes[name] = {
+                        **tally,
+                        "target_latency_s": (cls.target_latency_s
+                                             if cls is not None
+                                             else None),
+                        "p50_s": _percentile(lats, 0.50),
+                        "p99_s": _percentile(lats, 0.99),
+                    }
+                out["classes"] = classes
+            if self._shed.transitions or self._admission_rejected \
+                    or self._deferred:
+                out["shed"] = {
+                    "level": self._shed.level,
+                    "name": self._shed.name,
+                    "transitions": self._shed.transitions,
+                    "deferred_flows": self._deferred,
+                    "admission_rejected": self._admission_rejected,
+                    "capacity_rhs_per_s": self._capacity(),
+                }
             if self.config.recycle is not None:
                 out["recycle"] = {
                     "harvests": self._recycle_harvests,

@@ -16,16 +16,19 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 __all__ = [
+    "ReplaySummary",
     "WorkloadRequest",
     "load_workload",
+    "replay_workload",
     "rhs_for",
     "save_workload",
     "synthetic_poisson",
+    "synthetic_tenant_mix",
 ]
 
 
@@ -33,12 +36,16 @@ __all__ = [
 class WorkloadRequest:
     """One replayed arrival: offset seconds from replay start + the
     RHS seed; ``tol``/``deadline_s`` of ``None`` take the replay's
-    defaults."""
+    defaults.  ``tenant``/``slo_class`` of ``None`` default at replay
+    time (one tenant, ``silver``) - a pre-multi-tenant workload file
+    replays byte-identically."""
 
     t: float
     seed: int
     tol: Optional[float] = None
     deadline_s: Optional[float] = None
+    tenant: Optional[str] = None
+    slo_class: Optional[str] = None
 
     def to_json(self) -> dict:
         out = {"t": float(self.t), "seed": int(self.seed)}
@@ -46,6 +53,10 @@ class WorkloadRequest:
             out["tol"] = float(self.tol)
         if self.deadline_s is not None:
             out["deadline_s"] = float(self.deadline_s)
+        if self.tenant is not None:
+            out["tenant"] = str(self.tenant)
+        if self.slo_class is not None:
+            out["slo_class"] = str(self.slo_class)
         return out
 
     @classmethod
@@ -63,12 +74,19 @@ class WorkloadRequest:
                         is not None else None),
                    deadline_s=(float(data["deadline_s"])
                                if data.get("deadline_s") is not None
-                               else None))
+                               else None),
+                   tenant=(str(data["tenant"])
+                           if data.get("tenant") is not None else None),
+                   slo_class=(str(data["slo_class"])
+                              if data.get("slo_class") is not None
+                              else None))
 
 
 def synthetic_poisson(n_requests: int, rate_hz: float, seed: int = 0,
                       tol: Optional[float] = None,
-                      deadline_s: Optional[float] = None
+                      deadline_s: Optional[float] = None,
+                      tenant: Optional[str] = None,
+                      slo_class: Optional[str] = None
                       ) -> List[WorkloadRequest]:
     """Open-loop Poisson arrivals: ``n_requests`` with exponential
     inter-arrival gaps at ``rate_hz`` (the first request arrives at
@@ -82,8 +100,36 @@ def synthetic_poisson(n_requests: int, rate_hz: float, seed: int = 0,
     gaps[0] = 0.0
     times = np.cumsum(gaps)
     return [WorkloadRequest(t=float(t), seed=int(seed * 1_000_003 + i),
-                            tol=tol, deadline_s=deadline_s)
+                            tol=tol, deadline_s=deadline_s,
+                            tenant=tenant, slo_class=slo_class)
             for i, t in enumerate(times)]
+
+
+def synthetic_tenant_mix(n_requests: int, rate_hz: float,
+                         tenants: Sequence[Tuple[str, float, str]],
+                         seed: int = 0,
+                         tol: Optional[float] = None,
+                         deadline_s: Optional[float] = None
+                         ) -> List[WorkloadRequest]:
+    """Open-loop Poisson arrivals tagged by a tenant mix: ``tenants``
+    is ``(name, share, slo_class)`` rows (shares need not sum to 1 -
+    they are normalized), each arrival sampled independently.
+    Deterministic in ``seed`` - the saturation scenarios the overload
+    bench and gate replay are committable files, not dice rolls."""
+    if not tenants:
+        raise ValueError("tenants must name >= 1 (name, share, class)")
+    shares = np.asarray([float(s) for _, s, _ in tenants])
+    if (shares <= 0).any():
+        raise ValueError(f"tenant shares must be > 0, got "
+                         f"{shares.tolist()}")
+    base = synthetic_poisson(n_requests, rate_hz, seed=seed, tol=tol,
+                             deadline_s=deadline_s)
+    rng = np.random.default_rng(seed + 0x7E4A47)
+    picks = rng.choice(len(tenants), size=n_requests,
+                       p=shares / shares.sum())
+    return [dataclasses.replace(r, tenant=str(tenants[int(i)][0]),
+                                slo_class=str(tenants[int(i)][2]))
+            for r, i in zip(base, picks)]
 
 
 def save_workload(path: str,
@@ -104,6 +150,124 @@ def load_workload(path: str) -> List[WorkloadRequest]:
     if not isinstance(reqs, list) or not reqs:
         raise ValueError(f"{path}: empty workload")
     return [WorkloadRequest.from_json(r) for r in reqs]
+
+
+@dataclasses.dataclass
+class ReplaySummary:
+    """Per-class disposition of one open-loop replay (the saturation
+    harness's unit of measurement).  ``goodput_rhs_per_sec`` counts
+    only in-SLO completions: converged AND inside the class's
+    ``target_latency_s`` (classes without a target count on
+    convergence alone)."""
+
+    window_s: float
+    offered: int
+    solved: int
+    in_slo: int
+    timeouts: int
+    rejected: int                   # ADMISSION_REJECTED + QueueFull
+    errors: int
+    degraded: int
+    goodput_rhs_per_sec: float
+    #: per-class: {"offered", "in_slo", "timeouts", "rejected",
+    #: "p99_latency_s"}
+    by_class: Dict[str, Dict[str, object]]
+    results: list                   # resolved RequestResults (or None
+    #                                 for QueueFull sheds)
+
+
+def replay_workload(service, handle, requests, prepared_b,
+                    *, tol: float = 1e-7,
+                    deadline_s: Optional[float] = None,
+                    classes=None) -> ReplaySummary:
+    """Open-loop replay: submit ``requests[i]`` with RHS
+    ``prepared_b[i]`` at its arrival offset on the REAL clock, drain,
+    and classify every outcome per SLO class.  The saturation bench,
+    the overload example and the tests share this loop so "goodput"
+    means one thing repo-wide.  ``classes`` maps class name ->
+    ``SLOClass`` for the in-SLO bar (default: the service's table).
+    Open-loop means arrivals never wait for results - offered load is
+    the independent variable, which is what makes a past-capacity ramp
+    meaningful (closed-loop replay self-throttles and cannot overload
+    anything)."""
+    import time
+
+    from .queue import QueueFull
+
+    if classes is None:
+        classes = getattr(service, "_classes", {})
+    t0 = time.monotonic()
+    futures = []
+    for r, b in zip(requests, prepared_b):
+        delay = (t0 + r.t) - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            futures.append(service.submit(
+                handle, b,
+                tol=r.tol if r.tol is not None else tol,
+                deadline_s=(r.deadline_s if r.deadline_s is not None
+                            else deadline_s),
+                tenant=r.tenant or "default",
+                slo_class=r.slo_class or "silver"))
+        except QueueFull:
+            futures.append(None)     # hard backpressure shed
+    service.drain()
+    window_s = time.monotonic() - t0
+
+    by_class: Dict[str, Dict[str, object]] = {}
+    lats: Dict[str, list] = {}
+
+    def tally(name):
+        return by_class.setdefault(
+            name, {"offered": 0, "in_slo": 0, "timeouts": 0,
+                   "rejected": 0, "p99_latency_s": None})
+
+    solved = in_slo = timeouts = rejected = errors = degraded = 0
+    results = []
+    for r, fut in zip(requests, futures):
+        name = r.slo_class or "silver"
+        row = tally(name)
+        row["offered"] += 1
+        if fut is None:
+            rejected += 1
+            row["rejected"] += 1
+            results.append(None)
+            continue
+        res = fut.result()
+        results.append(res)
+        if res.status == "ADMISSION_REJECTED":
+            rejected += 1
+            row["rejected"] += 1
+            continue
+        if res.timed_out:
+            timeouts += 1
+            row["timeouts"] += 1
+            continue
+        if res.status == "ERROR":
+            errors += 1
+            continue
+        if res.degraded:
+            degraded += 1
+        if res.converged:
+            solved += 1
+            lats.setdefault(name, []).append(res.latency_s)
+            cls = classes.get(name)
+            target = getattr(cls, "target_latency_s", None)
+            if target is None or res.latency_s <= target:
+                in_slo += 1
+                row["in_slo"] += 1
+    for name, vals in lats.items():
+        vals.sort()
+        idx = max(0, int(np.ceil(0.99 * len(vals))) - 1)
+        by_class[name]["p99_latency_s"] = float(vals[idx])
+    return ReplaySummary(
+        window_s=window_s, offered=len(requests), solved=solved,
+        in_slo=in_slo, timeouts=timeouts,
+        rejected=rejected, errors=errors,
+        degraded=degraded,
+        goodput_rhs_per_sec=in_slo / max(window_s, 1e-9),
+        by_class=by_class, results=results)
 
 
 def rhs_for(a, seed: int, dtype=None) -> Tuple[np.ndarray, np.ndarray]:

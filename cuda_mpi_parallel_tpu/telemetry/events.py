@@ -136,6 +136,18 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     # state (closed/open/half_open)
     "request_retry": ("request_id", "attempt", "status"),
     "breaker_transition": ("handle", "state"),
+    # multi-tenant overload protection (serve.admission/serve.sched):
+    # a submit was REFUSED at the door (token bucket exhausted, or the
+    # shed ladder's reject rung - reason says which; retry_after_s is
+    # the typed hint the caller gets); the weighted-fair dispatcher
+    # picked a flow ("dispatch", with the priced cost) or held a
+    # dispatch-ready flow under the defer rung ("defer", throttled to
+    # one event per flow per ladder episode); the shed ladder changed
+    # level (0 ok / 1 degrade / 2 defer / 3 reject, with the queue
+    # depth that drove it)
+    "admission": ("request_id", "tenant", "slo_class", "decision"),
+    "sched_dispatch": ("tenant", "slo_class", "decision"),
+    "shed": ("level", "queue_depth"),
     # Krylov recycling (solver.recycle): a RecycleSpace was harvested
     # from a solve's basis ring + flight tridiagonal (k columns kept,
     # window = tridiagonal rows used, iterations = source solve's);

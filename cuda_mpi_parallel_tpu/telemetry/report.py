@@ -243,6 +243,36 @@ def service_lines(stats: Dict[str, Any]) -> List[str]:
             + (f"; breakers not closed: "
                f"{', '.join(f'{k}={v}' for k, v in sorted(open_b.items()))}"
                if open_b else ""))
+    # the overload story (shed ladder / admission / per-tenant and
+    # per-class disposition), only when the service actually has one
+    shed = stats.get("shed")
+    if shed:
+        cap = shed.get("capacity_rhs_per_s")
+        lines.append(
+            f"shed    : level {shed.get('level', 0)} "
+            f"({shed.get('name', 'ok')}), "
+            f"{shed.get('transitions', 0)} transition(s), "
+            f"{shed.get('deferred_flows', 0)} deferred flow(s), "
+            f"{shed.get('admission_rejected', 0)} admission-rejected"
+            + (f"; capacity ~{cap:.1f} RHS/s"
+               if isinstance(cap, (int, float)) else ""))
+    for tenant, row in sorted((stats.get("tenants") or {}).items()):
+        lines.append(
+            f"tenant  : {tenant}: {row.get('submitted', 0)} submitted, "
+            f"{row.get('completed', 0)} completed, "
+            f"{row.get('rejected', 0)} rejected, "
+            f"{row.get('timeouts', 0)} timeout, "
+            f"depth {row.get('depth', 0)}")
+    for name, row in sorted((stats.get("classes") or {}).items()):
+        target = row.get("target_latency_s")
+        lines.append(
+            f"class   : {name}: {row.get('submitted', 0)} submitted, "
+            f"{row.get('in_slo', 0)}/{row.get('completed', 0)} in SLO"
+            + (f" (target {ms(target)})"
+               if isinstance(target, (int, float)) else "")
+            + f", {row.get('timeouts', 0)} timeout, "
+            f"{row.get('rejected', 0)} rejected, p99 "
+            f"{ms(row.get('p99_s'))}")
     lat = stats.get("latency") or {}
     lines.append(
         f"latency : p50 {ms(lat.get('p50_s'))}  "

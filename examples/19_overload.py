@@ -1,0 +1,155 @@
+"""Overload-safe serving: ramp past capacity, shed before collapse.
+
+Drives the multi-tenant solver service (``serve.admission`` +
+``serve.sched``) through an open-loop saturation ramp and shows:
+
+1. the shed ladder firing IN ORDER as offered load passes capacity -
+   tolerance degraded first, ``bulk`` dispatch deferred second,
+   admission rejection (with a ``retry_after_s`` hint) last, and
+   accepted ``gold`` work never timing out;
+2. goodput degrading smoothly instead of collapsing: in-SLO
+   solved-RHS/s at 0.5x / 1x / 2x the measured capacity;
+3. the starving-tenant rescue: a 10:1 hot ``bulk`` tenant beside a
+   1-request ``gold`` tenant - weighted-fair (deficit-round-robin)
+   dispatch bounds the cold tenant's wait where PR 10's
+   oldest-queue-first pop would have parked it behind the whole hot
+   backlog.
+
+Run: python examples/19_overload.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.serve import (
+    AdmissionConfig,
+    SchedConfig,
+    ServiceConfig,
+    ShedConfig,
+    SolverService,
+    TokenBucket,
+    replay_workload,
+    rhs_for,
+    synthetic_tenant_mix,
+)
+from cuda_mpi_parallel_tpu.telemetry.report import service_lines
+
+GRID = 48            # 2304 unknowns - quick on CPU, real enough to time
+TOL = 1e-6
+TENANTS = (("hot-farm", 10.0, "bulk"),      # the flooder
+           ("web", 4.0, "silver"),
+           ("checkout", 1.0, "gold"))
+
+
+def build_service(capacity_hint=None):
+    """Full protection stack: per-tenant buckets (the hot farm capped
+    hardest), weighted-fair dispatch, auto shed ladder, 2 workers."""
+    admission = None
+    if capacity_hint:
+        admission = AdmissionConfig(
+            default=TokenBucket(rate=capacity_hint,
+                                burst=max(capacity_hint, 8.0)),
+            tenants=(("hot-farm",
+                      TokenBucket(rate=max(0.6 * capacity_hint, 1.0),
+                                  burst=max(0.6 * capacity_hint,
+                                            8.0))),))
+    return SolverService(ServiceConfig(
+        max_batch=8, max_wait_s=0.002, queue_limit=256, maxiter=600,
+        check_every=8, workers=2, admission=admission,
+        shed=ShedConfig(auto=True)))
+
+
+def run(a, rate, seed, capacity_hint=None, n=48):
+    svc = build_service(capacity_hint)
+    try:
+        h = svc.register(a)
+        reqs = synthetic_tenant_mix(n, rate, TENANTS, seed=seed)
+        bs = [rhs_for(a, r.seed, dtype=np.float32)[0] for r in reqs]
+        summary = replay_workload(svc, h, reqs, bs, tol=TOL)
+        stats = svc.stats()
+    finally:
+        svc.close()
+    return summary, stats
+
+
+def main():
+    a = poisson.poisson_2d_csr(GRID, GRID, dtype=np.float32)
+
+    # -- measure raw capacity with one unmetered burst ----------------
+    print("== probe: burst replay measures raw capacity ==")
+    probe, _ = run(a, rate=1e6, seed=1, n=32)
+    capacity = probe.solved / max(probe.window_s, 1e-9)
+    print(f"drained {probe.solved} RHS in {probe.window_s:.3f} s "
+          f"-> capacity ~{capacity:.0f} RHS/s\n")
+
+    # -- the ramp: 0.5x, 1x, 2x through the protection stack ----------
+    print("== saturation ramp (goodput = in-SLO solved RHS/s) ==")
+    print(f"{'offered':>10} {'goodput':>9} {'in-SLO':>7} {'degr':>5} "
+          f"{'defer':>6} {'rejected':>9} {'gold-TO':>8}")
+    rows = {}
+    for i, mult in enumerate((0.5, 1.0, 2.0)):
+        rate = max(mult * capacity, 1.0)
+        s, stats = run(a, rate=rate, seed=10 + i,
+                       capacity_hint=capacity)
+        shed = stats.get("shed") or {}
+        gold = s.by_class.get("gold", {})
+        rows[mult] = s
+        print(f"{rate:>8.0f}/s {s.goodput_rhs_per_sec:>9.1f} "
+              f"{s.in_slo:>4}/{s.offered:<3} {s.degraded:>5} "
+              f"{shed.get('deferred_flows', 0):>6} {s.rejected:>9} "
+              f"{gold.get('timeouts', 0):>8}")
+        assert gold.get("timeouts", 0) == 0, \
+            "accepted gold work must never time out"
+    g1 = rows[1.0].goodput_rhs_per_sec
+    g2 = rows[2.0].goodput_rhs_per_sec
+    print(f"\ngoodput retention at 2x overload: "
+          f"{100.0 * g2 / max(g1, 1e-9):.0f}% of the 1x goodput "
+          f"(>= 80% = degrades instead of collapsing; > 100% means "
+          f"deeper queues batched better)\n")
+
+    # -- starving-tenant rescue ---------------------------------------
+    print("== starving-tenant rescue (10:1 hot bulk vs 1 gold) ==")
+    for fair, label in ((False, "PR 10 oldest-queue-first"),
+                        (True, "weighted-fair DRR")):
+        svc = SolverService(ServiceConfig(
+            max_batch=4, max_wait_s=0.002, maxiter=600,
+            check_every=8, sched=SchedConfig(fair=fair)))
+        try:
+            h = svc.register(a)
+            rng = np.random.default_rng(99)
+            hot_b = [np.asarray(
+                a @ rng.standard_normal(a.shape[0]).astype(np.float32))
+                for _ in range(24)]
+            cold_b = np.asarray(
+                a @ rng.standard_normal(a.shape[0]).astype(np.float32))
+            hot = [svc.submit(h, b, tol=TOL, tenant="hot-farm",
+                              slo_class="bulk") for b in hot_b]
+            t0 = time.perf_counter()
+            cold = svc.submit(h, cold_b, tol=TOL, tenant="checkout",
+                              slo_class="gold")
+            cold_res = cold.result(timeout=60)
+            cold_wall = time.perf_counter() - t0
+            svc.drain()
+            assert cold_res.converged
+            assert all(f.result(timeout=60).status for f in hot)
+        finally:
+            svc.close()
+        print(f"  {label:<28}: gold answered in "
+              f"{cold_wall * 1e3:7.1f} ms behind a 24-request hot "
+              f"backlog")
+
+    print("\n== service report (2x run) ==")
+    # re-run 2x briefly for a report snapshot with the full stack
+    s, stats = run(a, rate=max(2.0 * capacity, 2.0), seed=42,
+                   capacity_hint=capacity, n=32)
+    for line in service_lines(stats):
+        print(f"  {line}")
+
+
+if __name__ == "__main__":
+    main()

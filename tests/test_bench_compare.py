@@ -165,6 +165,42 @@ class TestCompareGate:
         assert "planner.nnz_imbalance_planned" in out
 
 
+    def test_serve_overload_retention_gates(self, tmp_path):
+        """The overload bench's goodput retention at 2x GATES
+        (higher-better): a service that starts collapsing under
+        overload fails the compare; the other serve_overload.*
+        columns are reported only, and an OLD file without the
+        section degrades to 'only in NEW', not a KeyError."""
+        row = {"serve_overload": {
+            "probe_capacity_rhs_per_sec": 400.0,
+            "max_sustained_rhs_per_sec": 350.0,
+            "goodput_retention_2x": 0.92,
+            "gold_p99_s": 0.11, "gold_timeouts_2x": 0,
+            "rejected_2x": 20, "degraded_2x": 9, "timeouts_2x": 1,
+            "shed_transitions_2x": 4, "workers": 2}}
+        collapsed = {"serve_overload": dict(
+            row["serve_overload"], goodput_retention_2x=0.40,
+            gold_p99_s=2.0, rejected_2x=60)}
+        old, new = _sweep(), _sweep()
+        old["serve_overload"] = row
+        new["serve_overload"] = collapsed
+        rc, out = self._run(tmp_path, old, new)
+        assert rc == 1            # retention regressed past threshold
+        assert "serve_overload.goodput_retention_2x" in out
+        assert "REGRESSIONS" in out
+        # a worse gold p99 / rejection count alone never gates
+        mild = {"serve_overload": dict(
+            row["serve_overload"], gold_p99_s=5.0, rejected_2x=999)}
+        new["serve_overload"] = mild
+        rc, out = self._run(tmp_path, old, new)
+        assert rc == 0
+        assert "serve_overload.gold_p99_s" in out
+        # old file predates the section entirely -> n/a-safe
+        del old["serve_overload"]
+        rc, out = self._run(tmp_path, old, new)
+        assert rc == 0
+        assert "only in NEW: serve_overload" in out
+
     def test_many_rhs_columns_reported_never_gated(self, tmp_path):
         """PR-8: the many-RHS batching columns ride the table but a
         'worse' amortization or iteration count never fails the gate

@@ -1,0 +1,799 @@
+"""Multi-tenant serving: admission control, weighted-fair dispatch,
+and the shed-before-collapse ladder (serve.admission / serve.sched).
+
+Policy tests drive the service in MANUAL mode with a fake clock (no
+worker thread, time advances only when the test says so), so every
+token-refill, ladder-transition and scheduler branch is deterministic.
+The threaded tests at the bottom cover what a fake clock cannot: lost
+wakeups, multi-worker dispatch, and drain()/close() under submitter
+concurrency.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.serve import (
+    AdmissionConfig,
+    AdmissionController,
+    MicroBatchQueue,
+    QueueFull,
+    RecyclePolicy,
+    RetryPolicy,
+    SchedConfig,
+    ServiceConfig,
+    ShedConfig,
+    ShedLadder,
+    SLOClass,
+    SolverService,
+    TokenBucket,
+    WeightedFairScheduler,
+    WorkloadRequest,
+    load_workload,
+    save_workload,
+    synthetic_poisson,
+    synthetic_tenant_mix,
+)
+from cuda_mpi_parallel_tpu.serve.queue import QueuedRequest
+from cuda_mpi_parallel_tpu.telemetry import events
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def manual_service(**kw):
+    clock = FakeClock()
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_s", 0.010)
+    kw.setdefault("maxiter", 500)
+    svc = SolverService(ServiceConfig(clock=clock, **kw))
+    return svc, clock
+
+
+def poisson_csr(n=10, dtype=np.float64):
+    return poisson.poisson_2d_csr(n, n, dtype=dtype)
+
+
+def rhs_batch(a, count, seed=0):
+    rng = np.random.default_rng(seed)
+    return [np.asarray(a @ rng.standard_normal(a.shape[0]))
+            for _ in range(count)]
+
+
+# ---------------------------------------------------------------------------
+# token buckets (pure, fake times)
+
+
+class TestTokenBucket:
+    def test_burst_then_rate_then_refill(self):
+        ctl = AdmissionController(AdmissionConfig(
+            default=TokenBucket(rate=10.0, burst=2)))
+        d1 = ctl.admit("t", 0.0)
+        d2 = ctl.admit("t", 0.0)
+        assert d1.admitted and d2.admitted
+        d3 = ctl.admit("t", 0.0)
+        assert not d3.admitted and d3.reason == "tokens"
+        # empty bucket refills at 1 token / 0.1 s
+        assert d3.retry_after_s == pytest.approx(0.1)
+        assert ctl.admit("t", 0.05).admitted is False
+        assert ctl.admit("t", 0.101).admitted is True
+
+    def test_burst_caps_banked_tokens(self):
+        ctl = AdmissionController(AdmissionConfig(
+            default=TokenBucket(rate=100.0, burst=3)))
+        # a long-idle tenant banks at most `burst`
+        assert ctl.tokens("t", 100.0) == pytest.approx(3.0)
+        for _ in range(3):
+            assert ctl.admit("t", 100.0).admitted
+        assert not ctl.admit("t", 100.0).admitted
+
+    def test_per_tenant_isolation_and_unmetered_default(self):
+        ctl = AdmissionController(AdmissionConfig(
+            default=None,
+            tenants=(("hot", TokenBucket(rate=1.0, burst=1)),)))
+        assert ctl.admit("hot", 0.0).admitted
+        assert not ctl.admit("hot", 0.0).admitted
+        # unlisted tenants are unmetered when default is None
+        for _ in range(50):
+            assert ctl.admit("other", 0.0).admitted
+        assert ctl.tokens("other", 0.0) is None
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TokenBucket(rate=0.0, burst=1)
+        with pytest.raises(ValueError):
+            TokenBucket(rate=1.0, burst=0)
+
+
+# ---------------------------------------------------------------------------
+# deficit round-robin (pure)
+
+
+class TestWeightedFairScheduler:
+    def _flow(self, tenant, cls="silver", handle="h"):
+        return (handle, tenant, cls)
+
+    def test_equal_weights_round_robin(self):
+        sched = WeightedFairScheduler(SchedConfig())
+        a, b = self._flow("a"), self._flow("b")
+        cands = {a: 1.0, b: 1.0}
+        picks = [sched.pick(cands) for _ in range(6)]
+        assert picks == [a, b, a, b, a, b]
+
+    def test_weight_ratio_is_dispatch_share(self):
+        sched = WeightedFairScheduler(SchedConfig())
+        gold = self._flow("t", "gold")
+        bulk = self._flow("t", "bulk")
+        cands = {bulk: 1.0, gold: 1.0}     # bulk registered first
+        picks = [sched.pick(cands) for _ in range(90)]
+        n_gold = sum(1 for p in picks if p == gold)
+        n_bulk = len(picks) - n_gold
+        # 8:1 weights -> 8:1 dispatches (exact over whole rotations)
+        assert n_gold / n_bulk == pytest.approx(8.0, rel=0.15)
+
+    def test_starvation_bound(self):
+        """A backlogged min-weight flow dispatches at least once per
+        ceil(w_max / w_min) + 1 rotations - the class bound the
+        10:1-hot-tenant acceptance rides on."""
+        sched = WeightedFairScheduler(SchedConfig())
+        gold = self._flow("hot", "gold")
+        bulk = self._flow("cold", "bulk")
+        cands = {gold: 1.0, bulk: 1.0}
+        gap, worst = 0, 0
+        for _ in range(200):
+            if sched.pick(cands) == bulk:
+                worst, gap = max(worst, gap), 0
+            else:
+                gap += 1
+        assert worst <= 9, f"bulk starved for {worst} consecutive picks"
+
+    def test_idle_flow_deficit_resets(self):
+        """A flow absent from the candidates loses its banked credit -
+        a quiet tenant cannot hoard and then burst past everyone."""
+        sched = WeightedFairScheduler(SchedConfig())
+        a, b = self._flow("a"), self._flow("b")
+        sched.pick({a: 1.0})
+        sched.pick({a: 1.0})
+        # b was never a candidate: joining now starts from zero
+        assert sched.pick({a: 1.0, b: 1.0}) in (a, b)
+        assert all(v < 2.0 for v in sched.deficits().values())
+
+    def test_tenant_weight_multiplier(self):
+        sched = WeightedFairScheduler(SchedConfig(
+            tenant_weights=(("vip", 4.0),)))
+        vip = self._flow("vip")
+        std = self._flow("std")
+        picks = [sched.pick({std: 1.0, vip: 1.0}) for _ in range(50)]
+        n_vip = sum(1 for p in picks if p == vip)
+        assert n_vip / (len(picks) - n_vip) == pytest.approx(4.0,
+                                                            rel=0.2)
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SchedConfig(classes=(SLOClass("a"), SLOClass("a")))
+        with pytest.raises(ValueError):
+            SchedConfig(tenant_weights=(("t", 0.0),))
+        with pytest.raises(ValueError):
+            SLOClass("x", weight=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# shed ladder (pure)
+
+
+class TestShedLadderUnit:
+    def test_transitions_and_hysteresis(self):
+        ladder = ShedLadder(ShedConfig(degrade_depth=4, defer_depth=8,
+                                       reject_depth=12))
+        assert not ladder.evaluate(3)
+        assert ladder.evaluate(4) and ladder.level == 1
+        assert ladder.evaluate(9) and ladder.level == 2
+        assert ladder.evaluate(30) and ladder.level == 3
+        # descent is hysteretic: a held level only drops once depth
+        # clears exit_fraction x its ENTRY threshold (12 * 0.5 = 6)
+        assert not ladder.evaluate(7)          # 7 > 6: still reject
+        assert ladder.evaluate(6) and ladder.level == 2
+        assert not ladder.evaluate(5)          # 5 > 8 * 0.5: held
+        assert ladder.evaluate(4) and ladder.level == 1
+        assert ladder.evaluate(1) and ladder.level == 0
+        assert ladder.transitions == 6
+
+    def test_disabled_rungs(self):
+        ladder = ShedLadder(ShedConfig(degrade_depth=2))
+        ladder.evaluate(100)
+        assert ladder.level == 1               # defer/reject off
+
+    def test_auto_thresholds_from_capacity(self):
+        cfg = ShedConfig(auto=True, horizon_s=0.25)
+        assert cfg.thresholds(None) == (None, None, None)
+        assert cfg.thresholds(40.0) == (10, 20, 40)
+        # explicit depth wins over the derivation
+        cfg2 = ShedConfig(degrade_depth=3, auto=True, horizon_s=0.25)
+        assert cfg2.thresholds(40.0) == (3, 20, 40)
+
+    def test_misordered_depths_refused(self):
+        with pytest.raises(ValueError):
+            ShedConfig(degrade_depth=8, defer_depth=4)
+
+
+# ---------------------------------------------------------------------------
+# admission at the service level (fake clock)
+
+
+class TestServiceAdmission:
+    def test_typed_rejection_with_retry_hint(self):
+        svc, clock = manual_service(admission=AdmissionConfig(
+            default=TokenBucket(rate=10.0, burst=2)))
+        a = poisson_csr()
+        h = svc.register(a)
+        bs = rhs_batch(a, 3, seed=1)
+        with events.capture() as buf:
+            f1 = svc.submit(h, bs[0], tol=1e-8)
+            f2 = svc.submit(h, bs[1], tol=1e-8)
+            f3 = svc.submit(h, bs[2], tol=1e-8)
+            res = f3.result(timeout=1)     # resolved immediately
+            assert res.status == "ADMISSION_REJECTED"
+            assert res.failure_kind == "admission"
+            assert res.retry_after_s == pytest.approx(0.1)
+            assert res.x is None and not res.converged
+            # refill on the service clock: the same tenant is welcome
+            # again after 1/rate seconds
+            clock.advance(0.101)
+            f4 = svc.submit(h, bs[2], tol=1e-8)
+            svc.drain()
+            assert f1.result().converged and f2.result().converged
+            assert f4.result().converged
+        recs = [json.loads(ln) for ln in buf.getvalue().splitlines()
+                if ln.strip()]
+        for rec in recs:
+            events.validate_event(rec)
+        rej = [r for r in recs if r["event"] == "admission"]
+        assert len(rej) == 1 and rej[0]["decision"] == "rejected"
+        assert rej[0]["reason"] == "tokens"
+        stats = svc.stats()
+        assert stats["shed"]["admission_rejected"] == 1
+        assert stats["tenants"]["default"]["rejected"] == 1
+        svc.close()
+
+    def test_per_tenant_buckets_do_not_interfere(self):
+        svc, clock = manual_service(admission=AdmissionConfig(
+            tenants=(("hot", TokenBucket(rate=1.0, burst=1)),)))
+        a = poisson_csr()
+        h = svc.register(a)
+        bs = rhs_batch(a, 4, seed=2)
+        assert svc.submit(h, bs[0], tenant="hot") is not None
+        r = svc.submit(h, bs[1], tenant="hot").result()
+        assert r.status == "ADMISSION_REJECTED" and r.tenant == "hot"
+        # the unmetered tenant is untouched by hot's exhaustion
+        f = svc.submit(h, bs[2], tenant="quiet")
+        svc.drain()
+        assert f.result().converged
+        svc.close()
+
+    def test_unknown_slo_class_refused(self):
+        svc, _ = manual_service()
+        a = poisson_csr()
+        h = svc.register(a)
+        with pytest.raises(ValueError, match="SLO class"):
+            svc.submit(h, np.ones(a.shape[0]), slo_class="platinum")
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# shed ladder at the service level (fake clock)
+
+
+class TestServiceShedLadder:
+    def test_defer_holds_bulk_until_pressure_clears(self):
+        """Level 2 holds an aged bulk queue while silver drains; the
+        ladder's descent mid-pass releases it - and a drain() flushes
+        it regardless (close() must terminate)."""
+        # degrade rung OFF so the silver requests keep one tol class
+        # (degradation would split them across two queues); this test
+        # is about the defer rung alone
+        svc, clock = manual_service(
+            shed=ShedConfig(defer_depth=3, reject_depth=50))
+        a = poisson_csr()
+        h = svc.register(a)
+        bs = rhs_batch(a, 6, seed=3)
+        with events.capture() as buf:
+            fb = svc.submit(h, bs[0], tol=1e-8, slo_class="bulk")
+            clock.advance(0.005)
+            fs = [svc.submit(h, b, tol=1e-8) for b in bs[1:4]]
+            # t=0.011: bulk is aged past max_wait but depth 4 >= 3
+            # holds it; silver (3 < max_batch) is still young ->
+            # NOTHING dispatches
+            clock.advance(0.006)
+            assert svc.pump() == 0
+            assert svc.queue_depth() == 4
+            assert not fb.done()
+            # t=0.016: silver aged -> dispatches; depth falls, the
+            # ladder descends mid-pass and releases bulk IN THE SAME
+            # pump
+            clock.advance(0.005)
+            assert svc.pump() == 2
+        assert fb.result(timeout=1).converged
+        assert all(f.result().converged for f in fs)
+        recs = [json.loads(ln) for ln in buf.getvalue().splitlines()
+                if ln.strip()]
+        defer = [r for r in recs if r["event"] == "sched_dispatch"
+                 and r["decision"] == "defer"]
+        assert defer and defer[0]["slo_class"] == "bulk"
+        # dispatch order: silver first (bulk was held), bulk second
+        disp = [r for r in recs if r["event"] == "batch_dispatch"
+                and r.get("phase") != "warmup"]
+        assert len(disp) == 2
+        log = svc.batch_log()
+        assert len(log[0]["request_ids"]) == 3      # the silver batch
+        assert fb.result().request_id in log[1]["request_ids"]
+        svc.close()
+
+    def test_ladder_orders_degrade_defer_reject(self):
+        """The ordering contract on one fake clock: tolerance widens
+        first, bulk defers second, rejection is last - and gold is
+        admitted at every level, undegraded."""
+        svc, clock = manual_service(
+            shed=ShedConfig(degrade_depth=2, defer_depth=4,
+                            reject_depth=6))
+        a = poisson_csr()
+        h = svc.register(a)
+        bs = rhs_batch(a, 10, seed=4)
+        f0 = svc.submit(h, bs[0], tol=1e-8)            # depth 0
+        f1 = svc.submit(h, bs[1], tol=1e-8)            # depth 1
+        f2 = svc.submit(h, bs[2], tol=1e-8)            # depth 2: degrade
+        f3 = svc.submit(h, bs[3], tol=1e-8, slo_class="bulk")
+        f4 = svc.submit(h, bs[4], tol=1e-8)            # depth 4: defer on
+        f5 = svc.submit(h, bs[5], tol=1e-8)
+        r6 = svc.submit(h, bs[6], tol=1e-8).result()   # depth 6: reject
+        assert r6.status == "ADMISSION_REJECTED"
+        assert r6.retry_after_s and r6.retry_after_s > 0
+        gold = svc.submit(h, bs[7], tol=1e-8, slo_class="gold")
+        clock.advance(0.011)
+        svc.pump()
+        svc.drain()
+        assert not f0.result().degraded and not f1.result().degraded
+        assert f2.result().degraded and f4.result().degraded
+        assert f3.result().degraded          # bulk degrades too
+        gr = gold.result()
+        assert gr.converged and not gr.degraded
+        assert f5.result().converged
+        stats = svc.stats()
+        assert stats["shed"]["level"] == 0   # descended after drain
+        assert stats["classes"]["gold"]["in_slo"] == 1
+        svc.close()
+
+    def test_all_bulk_backlog_is_never_wedged(self):
+        """Deferral is a RELATIVE priority: with nothing non-deferred
+        queued or in flight, holding an all-bulk backlog would serve
+        nobody and - with no deadlines to expire - wedge it forever
+        (depth can only fall by dispatching, and the ladder can only
+        descend when depth falls).  The hold must release."""
+        svc, clock = manual_service(
+            shed=ShedConfig(defer_depth=2, reject_depth=50))
+        a = poisson_csr()
+        h = svc.register(a)
+        futs = [svc.submit(h, b, tol=1e-8, slo_class="bulk")
+                for b in rhs_batch(a, 3, seed=15)]
+        clock.advance(0.011)
+        assert svc.pump() >= 1, "all-bulk backlog wedged by defer rung"
+        assert all(f.result(timeout=1).converged for f in futs)
+        svc.close()
+
+    def test_all_bulk_backlog_resolves_threaded(self):
+        """The same invariant end-to-end on the real-clock worker: an
+        all-bulk backlog past the defer depth resolves without any
+        follow-up submit to nudge the worker."""
+        a = poisson_csr(8)
+        svc = SolverService(ServiceConfig(
+            max_batch=2, max_wait_s=0.005, maxiter=300,
+            shed=ShedConfig(defer_depth=1, reject_depth=50)))
+        try:
+            h = svc.register(a)
+            futs = [svc.submit(h, b, tol=1e-6, slo_class="bulk")
+                    for b in rhs_batch(a, 3, seed=16)]
+            results = [f.result(timeout=20) for f in futs]
+            assert all(r.converged for r in results)
+        finally:
+            svc.close()
+
+    def test_custom_class_table_reject_exemption(self):
+        """The reject rung keys off SLOClass.reject_exempt, not the
+        literal name 'gold' - a custom class table keeps its top tier
+        admitted at level 3."""
+        classes = (SLOClass("platinum", weight=16.0, degrade_ok=False,
+                            defer_ok=False, reject_exempt=True),
+                   SLOClass("economy", weight=1.0, degrade_ok=True,
+                            defer_ok=True))
+        svc, clock = manual_service(
+            sched=SchedConfig(classes=classes),
+            shed=ShedConfig(reject_depth=2))
+        a = poisson_csr()
+        h = svc.register(a)
+        bs = rhs_batch(a, 4, seed=17)
+        svc.submit(h, bs[0], tol=1e-8, slo_class="economy")
+        svc.submit(h, bs[1], tol=1e-8, slo_class="economy")
+        # depth 2 = reject level: economy refused, platinum admitted
+        rej = svc.submit(h, bs[2], tol=1e-8, slo_class="economy")
+        assert rej.result().status == "ADMISSION_REJECTED"
+        plat = svc.submit(h, bs[3], tol=1e-8, slo_class="platinum")
+        clock.advance(0.011)
+        svc.pump()
+        svc.drain()
+        assert plat.result(timeout=1).converged
+        svc.close()
+
+    def test_legacy_degrade_depth_maps_to_ladder(self):
+        """PR 12's ServiceConfig(degrade_depth=N) is the ladder's
+        first rung - same observable behavior, no second code path."""
+        svc, clock = manual_service(degrade_depth=2, max_batch=8,
+                                    max_wait_s=100.0)
+        a = poisson_csr()
+        h = svc.register(a)
+        bs = rhs_batch(a, 3, seed=5)
+        f1 = svc.submit(h, bs[0], tol=1e-9)
+        f2 = svc.submit(h, bs[1], tol=1e-9)
+        f3 = svc.submit(h, bs[2], tol=1e-9)
+        svc._step(svc._clock(), drain=True)
+        assert not f1.result(5).degraded
+        assert not f2.result(5).degraded
+        assert f3.result(5).degraded
+        assert svc.stats()["degraded"] == 1
+        svc.close()
+
+    def test_conflicting_shed_and_degrade_depth_refused(self):
+        with pytest.raises(ValueError, match="degrade"):
+            SolverService(ServiceConfig(
+                clock=FakeClock(), degrade_depth=3,
+                shed=ShedConfig(defer_depth=8)))
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair dispatch at the service level (fake clock)
+
+
+class TestServiceFairness:
+    def test_hot_tenant_cannot_starve_cold_tenant(self):
+        """10:1 offered load: the cold tenant's lone request is
+        dispatched second (one hot batch ahead at equal weights),
+        not behind the hot tenant's whole backlog - the starvation
+        bound the DRR scheduler guarantees."""
+        svc, clock = manual_service()
+        a = poisson_csr()
+        h = svc.register(a)
+        hot = rhs_batch(a, 10, seed=6)
+        cold = rhs_batch(a, 1, seed=7)
+        hot_futs = [svc.submit(h, b, tol=1e-8, tenant="hot")
+                    for b in hot]
+        cold_fut = svc.submit(h, cold[0], tol=1e-8, tenant="cold")
+        clock.advance(0.011)
+        svc.pump()
+        svc.drain()
+        assert cold_fut.result().converged
+        assert all(f.result().converged for f in hot_futs)
+        log = svc.batch_log()
+        cold_rid = cold_fut.result().request_id
+        cold_pos = next(i for i, b in enumerate(log)
+                        if cold_rid in b["request_ids"])
+        assert cold_pos <= 1, \
+            f"cold tenant's batch dispatched {cold_pos + 1}th of " \
+            f"{len(log)} - starved behind the hot backlog"
+        svc.close()
+
+    def test_gold_class_preempts_bulk_backlog(self):
+        """Class weights: a full gold batch dispatches before a bulk
+        backlog that arrived FIRST."""
+        svc, clock = manual_service()
+        a = poisson_csr()
+        h = svc.register(a)
+        bulk = [svc.submit(h, b, tol=1e-8, slo_class="bulk")
+                for b in rhs_batch(a, 8, seed=8)]
+        gold = [svc.submit(h, b, tol=1e-8, slo_class="gold")
+                for b in rhs_batch(a, 4, seed=9)]
+        clock.advance(0.011)
+        svc.pump()
+        [f.result() for f in bulk + gold]
+        log = svc.batch_log()
+        gold_rid = gold[0].result().request_id
+        assert gold_rid in log[0]["request_ids"], \
+            "gold batch did not dispatch first"
+        svc.close()
+
+    def test_all_off_matches_legacy_pop_bit_for_bit(self):
+        """The acceptance compat proof: one tenant, no admission, no
+        shed - the weighted-fair service replays a mixed-tol workload
+        with IDENTICAL batch composition, dispatch order, and
+        bit-identical solutions to the PR 10 pop
+        (SchedConfig(fair=False))."""
+        a = poisson_csr(10)
+        workload = synthetic_poisson(12, 3000.0, seed=11)
+        rng = np.random.default_rng(12)
+        bs = [np.asarray(a @ rng.standard_normal(a.shape[0]))
+              for _ in workload]
+        tols = [1e-8 if i % 3 else 1e-5 for i in range(len(workload))]
+
+        def replay(fair):
+            svc, clock = manual_service(
+                sched=SchedConfig(fair=fair))
+            h = svc.register(a)
+            futs = []
+            for r, b, tol in zip(workload, bs, tols):
+                clock.t = r.t
+                futs.append(svc.submit(h, b, tol=tol))
+                svc.pump()
+            clock.advance(0.011)
+            svc.pump()
+            svc.drain()
+            results = [f.result() for f in futs]
+            log = [(e["bucket"], e["n_requests"],
+                    tuple(e["request_ids"])) for e in svc.batch_log()]
+            svc.close()
+            return results, log
+
+        fair_res, fair_log = replay(True)
+        legacy_res, legacy_log = replay(False)
+        assert fair_log == legacy_log
+        for rf, rl in zip(fair_res, legacy_res):
+            assert rf.status == rl.status == "CONVERGED"
+            assert rf.iterations == rl.iterations
+            assert np.array_equal(rf.x, rl.x)
+
+
+# ---------------------------------------------------------------------------
+# workload files: tenant/slo_class fields
+
+
+class TestWorkloadTenants:
+    def test_roundtrip_with_tenant_fields(self, tmp_path):
+        path = str(tmp_path / "wl.json")
+        reqs = [WorkloadRequest(t=0.0, seed=1),
+                WorkloadRequest(t=0.5, seed=2, tol=1e-5,
+                                deadline_s=0.25, tenant="hot",
+                                slo_class="bulk")]
+        save_workload(path, reqs)
+        assert load_workload(path) == reqs
+        # the untagged request stays None -> replay defaults apply
+        assert load_workload(path)[0].tenant is None
+
+    def test_pre_multitenant_file_still_loads(self, tmp_path):
+        path = str(tmp_path / "old.json")
+        with open(path, "w") as f:
+            json.dump({"version": 1,
+                       "requests": [{"t": 0.0, "seed": 3}]}, f)
+        (req,) = load_workload(path)
+        assert req.tenant is None and req.slo_class is None
+
+    def test_tenant_mix_deterministic_and_shared(self):
+        tenants = (("hot", 10.0, "bulk"), ("web", 4.0, "silver"),
+                   ("pay", 1.0, "gold"))
+        w1 = synthetic_tenant_mix(64, 1000.0, tenants, seed=5)
+        w2 = synthetic_tenant_mix(64, 1000.0, tenants, seed=5)
+        assert w1 == w2                      # replay determinism
+        names = {r.tenant for r in w1}
+        assert names <= {"hot", "web", "pay"}
+        hot = sum(1 for r in w1 if r.tenant == "hot")
+        assert hot > len(w1) // 2            # 10/15 share dominates
+        assert all(r.slo_class == "bulk" for r in w1
+                   if r.tenant == "hot")
+        with pytest.raises(ValueError):
+            synthetic_tenant_mix(4, 100.0, ())
+        with pytest.raises(ValueError):
+            synthetic_tenant_mix(4, 100.0, (("t", 0.0, "silver"),))
+
+    def test_synthetic_poisson_tags_passthrough(self):
+        w = synthetic_poisson(4, 100.0, seed=1, tenant="t",
+                              slo_class="gold")
+        assert all(r.tenant == "t" and r.slo_class == "gold"
+                   for r in w)
+
+
+# ---------------------------------------------------------------------------
+# parked-retry wake regression (the PR 12 next_wake fold, pinned)
+
+
+class TestParkedRetryWake:
+    def _req(self, i, t, ready_t=None, deadline_t=None):
+        from concurrent.futures import Future
+
+        return QueuedRequest(request_id=f"r{i}", handle_key="h",
+                             b=np.zeros(3), dtype="float64", tol=1e-7,
+                             enqueue_t=t, deadline_t=deadline_t,
+                             future=Future(), ready_t=ready_t)
+
+    def test_parked_ready_t_drives_next_wake(self):
+        """A queue holding ONLY a backoff-parked retry must wake at
+        its ready_t - not sleep forever until the next unrelated
+        submit (the oversleep this regression test pins)."""
+        q = MicroBatchQueue(max_batch=4, max_wait_s=0.010)
+        q.push(self._req(0, t=0.0, ready_t=5.0))
+        assert q.next_wake(1.0) == pytest.approx(5.0)
+        # a deadline earlier than the backoff still wins
+        q.push(self._req(1, t=0.0, ready_t=5.0, deadline_t=2.0))
+        assert q.next_wake(1.0) == pytest.approx(2.0)
+
+    def test_deferred_queue_still_wakes_for_deadline_and_ready_t(self):
+        q = MicroBatchQueue(max_batch=4, max_wait_s=0.010)
+        req = self._req(0, t=0.0, ready_t=5.0, deadline_t=2.0)
+        req.slo_class = "bulk"
+        q.push(req)
+        # held by the shed ladder: no max_wait wake, but the deadline
+        # sweep and the parked retry must still fire on time
+        assert q.next_wake(1.0, defer=frozenset({"bulk"})) \
+            == pytest.approx(2.0)
+        aged = self._req(1, t=0.0)
+        aged.slo_class = "bulk"
+        q2 = MicroBatchQueue(max_batch=4, max_wait_s=0.010)
+        q2.push(aged)
+        assert q2.next_wake(1.0) == pytest.approx(0.010)
+        assert q2.next_wake(1.0, defer=frozenset({"bulk"})) is None
+
+    def test_threaded_worker_wakes_for_retry_backoff(self):
+        """End-to-end: an idle real-clock worker resolves a parked
+        retry within its backoff window, with no follow-up submit to
+        nudge it."""
+        import time
+
+        a = poisson_csr(8)
+        svc = SolverService(ServiceConfig(
+            max_batch=2, max_wait_s=0.005,
+            retry=RetryPolicy(max_retries=1, backoff_s=0.15)))
+        try:
+            h = svc.register(a)
+            orig, calls = svc._engine, [0]
+
+            def flaky(*args, **kw):
+                calls[0] += 1
+                if calls[0] == 1:
+                    raise RuntimeError("boom")
+                return orig(*args, **kw)
+
+            svc._engine = flaky
+            b = np.asarray(a @ np.random.default_rng(0)
+                           .standard_normal(a.shape[0]))
+            t0 = time.monotonic()
+            res = svc.submit(h, b, tol=1e-8).result(timeout=10)
+            elapsed = time.monotonic() - t0
+            assert res.status == "CONVERGED" and res.attempts == 2
+            assert 0.15 <= elapsed < 5.0, \
+                f"retry resolved after {elapsed:.3f}s (backoff 0.15s)"
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-worker pool + threaded concurrency stress
+
+
+class TestMultiWorker:
+    def test_two_workers_end_to_end(self):
+        svc = SolverService(ServiceConfig(
+            max_batch=2, max_wait_s=0.002, maxiter=500, workers=2))
+        try:
+            a = poisson_csr()
+            h = svc.register(a)
+            rng = np.random.default_rng(13)
+            futs = [svc.submit(h, np.asarray(
+                a @ rng.standard_normal(a.shape[0])), tol=1e-8)
+                for _ in range(8)]
+            results = [f.result(timeout=30) for f in futs]
+            assert all(r.converged for r in results)
+            assert svc.stats()["completed"] == 8
+        finally:
+            svc.close()
+
+    def test_recycle_refuses_worker_pool(self):
+        with pytest.raises(ValueError, match="workers"):
+            SolverService(ServiceConfig(workers=2,
+                                        recycle=RecyclePolicy()))
+
+    def test_negative_workers_refused(self):
+        with pytest.raises(ValueError, match="workers"):
+            SolverService(ServiceConfig(clock=FakeClock(), workers=-1))
+
+
+class TestThreadedStress:
+    def test_concurrent_submitters_small_queue_all_typed(self):
+        """4 submitter threads against a tiny queue_limit + admission
+        metering: every future resolves to a TYPED result, nothing
+        deadlocks, and the books balance (no lost wakeups, no lost
+        requests)."""
+        a = poisson_csr(8)
+        svc = SolverService(ServiceConfig(
+            max_batch=4, max_wait_s=0.001, queue_limit=8, maxiter=300,
+            workers=2,
+            admission=AdmissionConfig(
+                default=TokenBucket(rate=2000.0, burst=40)),
+            shed=ShedConfig(degrade_depth=4, defer_depth=6,
+                            reject_depth=8)))
+        per_thread, n_threads = 15, 4
+        outcomes, queue_full = [], [0]
+        lock = threading.Lock()
+        try:
+            h = svc.register(a)
+            b = np.asarray(a @ np.random.default_rng(1)
+                           .standard_normal(a.shape[0]))
+
+            def submitter(tid):
+                classes = ("gold", "silver", "bulk")
+                for i in range(per_thread):
+                    try:
+                        fut = svc.submit(
+                            h, b, tol=1e-6, tenant=f"t{tid}",
+                            slo_class=classes[i % 3])
+                    except QueueFull:
+                        with lock:
+                            queue_full[0] += 1
+                        continue
+                    res = fut.result(timeout=30)
+                    with lock:
+                        outcomes.append(res.status)
+
+            threads = [threading.Thread(target=submitter, args=(i,))
+                       for i in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads), \
+                "submitter thread wedged (lost wakeup or deadlock)"
+            svc.drain()
+            assert svc.queue_depth() == 0
+        finally:
+            svc.close()                     # close() must not deadlock
+        assert len(outcomes) + queue_full[0] \
+            == per_thread * n_threads
+        assert outcomes, "no request ever resolved"
+        allowed = {"CONVERGED", "MAXITER", "ADMISSION_REJECTED"}
+        assert set(outcomes) <= allowed, set(outcomes)
+        # the stress must actually solve things, not just shed
+        assert outcomes.count("CONVERGED") >= per_thread
+
+
+# ---------------------------------------------------------------------------
+# stats + report surface
+
+
+class TestOverloadObservability:
+    def test_stats_and_report_lines(self):
+        from cuda_mpi_parallel_tpu.telemetry.report import service_lines
+
+        svc, clock = manual_service(
+            admission=AdmissionConfig(
+                default=TokenBucket(rate=10.0, burst=3)),
+            shed=ShedConfig(degrade_depth=2, defer_depth=50,
+                            reject_depth=60))
+        a = poisson_csr()
+        h = svc.register(a)
+        bs = rhs_batch(a, 4, seed=14)
+        futs = [svc.submit(h, bs[i], tol=1e-8,
+                           tenant=("hot" if i < 3 else "cold"),
+                           slo_class=("gold" if i == 3 else "silver"))
+                for i in range(3)]
+        futs.append(svc.submit(h, bs[3], tol=1e-8, tenant="cold",
+                               slo_class="gold"))
+        rej = svc.submit(h, bs[0], tol=1e-8, tenant="hot")
+        assert rej.result().status == "ADMISSION_REJECTED"
+        clock.advance(0.011)
+        svc.pump()
+        svc.drain()
+        [f.result() for f in futs]
+        stats = svc.stats()
+        assert stats["tenants"]["hot"]["submitted"] == 3
+        assert stats["tenants"]["hot"]["rejected"] == 1
+        assert stats["tenants"]["cold"]["completed"] == 1
+        assert stats["classes"]["gold"]["in_slo"] == 1
+        assert stats["classes"]["gold"]["p99_s"] is not None
+        assert stats["shed"]["admission_rejected"] == 1
+        text = "\n".join(service_lines(stats))
+        assert "tenant" in text and "class" in text and "shed" in text
+        svc.close()

@@ -130,6 +130,25 @@ METRIC_DIRECTION = {
     "serve.occupancy_mean": None,
     "serve.padding_fraction": None,
     "serve.timeouts": None,
+    # overload-serving columns (serve.admission + serve.sched): the
+    # saturation ramp's measured capacity, max sustained in-SLO
+    # goodput, and the 2x-overload goodput retention.  RETENTION
+    # GATES (higher-better, listed in GATED_METRICS): it is the one
+    # dimensionless number that says the service degrades instead of
+    # collapsing, and it divides out host weather (both runs ride the
+    # same host).  The rest are reported, never gated - absolute
+    # rates track host scheduling weather; pre-overload files simply
+    # lack them (rendered n/a).
+    "serve_overload.probe_capacity_rhs_per_sec": None,
+    "serve_overload.max_sustained_rhs_per_sec": None,
+    "serve_overload.goodput_retention_2x": True,
+    "serve_overload.gold_p99_s": None,
+    "serve_overload.gold_timeouts_2x": None,
+    "serve_overload.rejected_2x": None,
+    "serve_overload.degraded_2x": None,
+    "serve_overload.timeouts_2x": None,
+    "serve_overload.shed_transitions_2x": None,
+    "serve_overload.workers": None,
     # measured phase-profile columns (PR 11, telemetry.phasetrace):
     # per-phase seconds-per-iteration shares, the measured per-shard
     # SpMV stall factor, and the explained-fraction residual of the
@@ -175,8 +194,12 @@ METRIC_DIRECTION = {
 #: metrics (besides the headline) whose per-section regression past the
 #: threshold fails the gate.  Deliberately the wall-clock/convergence
 #: ones - a slower solve or one needing more iterations to tolerance is
-#: a real regression even when the headline row survived.
-GATED_METRICS = ("time_to_tol_s", "iterations")
+#: a real regression even when the headline row survived - plus the
+#: overload bench's goodput retention at 2x (dimensionless, host-
+#: weather-divided: a service that starts collapsing under overload is
+#: a regression no throughput number can buy back).
+GATED_METRICS = ("time_to_tol_s", "iterations",
+                 "serve_overload.goodput_retention_2x")
 
 
 def load_sections(path: str) -> dict:
@@ -218,6 +241,12 @@ _NESTED = {
               "speedup_vs_unbatched", "p50_latency_s", "p95_latency_s",
               "p99_latency_s", "occupancy_mean", "padding_fraction",
               "timeouts"),
+    "serve_overload": ("probe_capacity_rhs_per_sec",
+                       "max_sustained_rhs_per_sec",
+                       "goodput_retention_2x", "gold_p99_s",
+                       "gold_timeouts_2x", "rejected_2x",
+                       "degraded_2x", "timeouts_2x",
+                       "shed_transitions_2x", "workers"),
     "phase": ("halo_s_per_iter", "spmv_s_per_iter",
               "reduction_s_per_iter", "halo_share", "spmv_share",
               "reduction_share", "spmv_stall_factor",

@@ -384,6 +384,21 @@ print(f"recycle gate: {len(live)} requests CONVERGED, "
 PY
 echo "recycle gate: clean"
 
+# Overload gate: the shed-before-collapse ladder end-to-end on a
+# deterministic fake clock (tools/overload_drill.py) - a scripted
+# ~2x-reject-depth overload must fire the ladder IN ORDER (degraded
+# results before any deferral, deferrals before any admission
+# rejection), never time out an accepted gold request, and walk the
+# shed levels 1 -> 2 -> 3 without skipping a rung; then every emitted
+# event (admission / sched_dispatch / shed included) must be
+# schema-valid.  The weighted-fair starvation bound and the legacy
+# bit-for-bit compat proof live in tests/test_serve_sched.py.
+echo "== overload gate (fake-clock shed ladder fires in order) =="
+JAX_PLATFORMS=cpu python tools/overload_drill.py \
+    "$scratch/overload_events.jsonl"
+python tools/validate_trace.py "$scratch/overload_events.jsonl"
+echo "overload gate: clean"
+
 # Phasetrace gate: measured per-shard per-phase timing end-to-end on
 # the committed skewed fixture - one mesh-4 CLI solve with
 # --phase-profile must produce (a) a MEASURED Perfetto timeline

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Deterministic shed-ladder drill (the tools/lint.sh overload gate).
+
+Drives a manual-clock (fake time) SolverService through a scripted
+overload at ~2x the reject-rung depth and asserts the
+shed-before-collapse ordering contract from the event stream alone:
+
+1. degraded results appear BEFORE any deferral (the ladder widens
+   tolerance first),
+2. deferrals appear BEFORE any admission rejection (bulk is held
+   before anyone is turned away),
+3. ZERO accepted-then-TIMEOUT requests for the ``gold`` class (the
+   ladder's whole point: overload is answered by shedding the classes
+   below gold, never by letting accepted gold work rot in queue),
+4. the ladder's level transitions are an ascending 1 -> 2 -> 3 walk
+   on the way up (no rung skipped silently on first engagement).
+
+Every decision is fake-clock + queue-depth driven, so the drill is
+bit-deterministic; the solves themselves run for real and must all
+come back typed.  The emitted trace lands in the JSONL file named by
+argv[1] - tools/lint.sh schema-validates it with validate_trace.py
+afterwards, so every new event type (admission / sched_dispatch /
+shed) is proven schema-valid in the same run.
+
+Usage: python tools/overload_drill.py EVENTS_OUT.jsonl
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")  # repo-root invocation, like validate_trace
+
+from cuda_mpi_parallel_tpu.models import poisson  # noqa: E402
+from cuda_mpi_parallel_tpu.serve import (  # noqa: E402
+    AdmissionConfig,
+    ServiceConfig,
+    ShedConfig,
+    SolverService,
+    TokenBucket,
+)
+from cuda_mpi_parallel_tpu import telemetry  # noqa: E402
+
+DEGRADE_DEPTH, DEFER_DEPTH, REJECT_DEPTH = 4, 8, 12
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    events_path = sys.argv[1]
+    telemetry.configure(events_path)
+
+    clock = FakeClock()
+    a = poisson.poisson_2d_csr(12, 12, dtype=np.float64)
+    svc = SolverService(ServiceConfig(
+        clock=clock, max_batch=4, max_wait_s=0.01, queue_limit=64,
+        maxiter=500,
+        # the bucket is generous on purpose: the drill's rejections
+        # must come from the ladder's reject rung, not token exhaustion
+        admission=AdmissionConfig(
+            default=TokenBucket(rate=500.0, burst=200)),
+        shed=ShedConfig(degrade_depth=DEGRADE_DEPTH,
+                        defer_depth=DEFER_DEPTH,
+                        reject_depth=REJECT_DEPTH)))
+    h = svc.register(a)
+    rng = np.random.default_rng(7)
+    mk_b = lambda: np.asarray(a @ rng.standard_normal(a.shape[0]))  # noqa: E731
+
+    futs, gold_futs = [], []
+
+    def submit(n, slo_class, tenant="hot", deadline_s=None):
+        for _ in range(n):
+            f = svc.submit(h, mk_b(), tol=1e-8, tenant=tenant,
+                           slo_class=slo_class, deadline_s=deadline_s)
+            futs.append(f)
+            if slo_class == "gold":
+                gold_futs.append(f)
+
+    # phase A (t=0): silver past the degrade rung - submits 5 and 6
+    # arrive at depth >= 4 and come back degraded
+    submit(6, "silver")
+    # phase B (t=0): bulk past the defer rung (depth 6..9)
+    submit(4, "bulk")
+    # first pump after max_wait: the pass notes the held bulk flow
+    # (sched_dispatch decision="defer") BEFORE dispatching, then
+    # drains - the ladder steps back down as depth falls
+    clock.t = 0.011
+    svc.pump()
+    # phase C (t=0.02): flood to the reject rung and past it - 13
+    # non-gold admits climb depth 0..12, the next bulk submit is
+    # turned away with a retry_after_s hint
+    clock.t = 0.02
+    submit(9, "silver")
+    submit(4, "bulk", tenant="batch-farm")
+    rejected = svc.submit(h, mk_b(), tol=1e-8, tenant="batch-farm",
+                          slo_class="bulk")
+    futs.append(rejected)
+    # gold is still welcome at reject level (and must never TIMEOUT)
+    submit(2, "gold", tenant="tenant-b", deadline_s=0.5)
+    clock.t = 0.04
+    svc.pump()
+    svc.drain()
+    svc.close()
+    telemetry.configure(None)          # flush/close the sink
+
+    # ---- assertions, from the trace + the typed results -------------
+    results = [f.result(timeout=30) for f in futs]
+    failures = []
+
+    rej = rejected.result()
+    if rej.status != "ADMISSION_REJECTED":
+        failures.append(f"expected ADMISSION_REJECTED at depth >= "
+                        f"{REJECT_DEPTH}, got {rej.status}")
+    elif not (rej.retry_after_s and rej.retry_after_s > 0):
+        failures.append(f"rejection carries no retry_after_s hint: "
+                        f"{rej.retry_after_s}")
+    untyped = [r for r in results if not r.status]
+    if untyped:
+        failures.append(f"{len(untyped)} futures without typed status")
+    gold = [f.result() for f in gold_futs]
+    gold_timeouts = [r for r in gold if r.status == "TIMEOUT"]
+    if gold_timeouts:
+        failures.append(f"{len(gold_timeouts)} accepted gold requests "
+                        f"timed out - the ladder's core contract")
+    if not all(r.status == "CONVERGED" for r in gold):
+        failures.append(f"gold statuses: {[r.status for r in gold]}")
+    if any(r.degraded for r in gold):
+        failures.append("a gold request was tolerance-degraded")
+
+    with open(events_path, encoding="utf-8") as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    first = {}
+    for i, e in enumerate(lines):
+        kind = None
+        if e["event"] == "request_enqueued" and e.get("degraded"):
+            kind = "degrade"
+        elif e["event"] == "sched_dispatch" \
+                and e.get("decision") == "defer":
+            kind = "defer"
+        elif e["event"] == "admission" \
+                and e.get("decision") == "rejected":
+            kind = "reject"
+        if kind is not None and kind not in first:
+            first[kind] = i
+    for kind in ("degrade", "defer", "reject"):
+        if kind not in first:
+            failures.append(f"ladder rung {kind!r} never fired")
+    if len(first) == 3 and not (
+            first["degrade"] < first["defer"] < first["reject"]):
+        failures.append(f"ladder fired out of order: {first}")
+    gold_to = [e for e in lines if e["event"] == "request_done"
+               and e.get("status") == "TIMEOUT"
+               and e.get("slo_class") == "gold"]
+    if gold_to:
+        failures.append(f"{len(gold_to)} gold TIMEOUT events in trace")
+    ups = []
+    for e in lines:
+        if e["event"] == "shed" and e["level"] > (ups[-1] if ups
+                                                  else 0):
+            ups.append(e["level"])
+        if len(ups) == 3:
+            break
+    if ups[:3] != [1, 2, 3]:
+        failures.append(f"ascending shed walk is {ups}, want [1, 2, 3]")
+
+    if failures:
+        for msg in failures:
+            print(f"overload drill FAILED: {msg}", file=sys.stderr)
+        return 1
+    n_def = sum(1 for e in lines if e["event"] == "sched_dispatch"
+                and e.get("decision") == "defer")
+    n_rej = sum(1 for e in lines if e["event"] == "admission"
+                and e.get("decision") == "rejected")
+    n_deg = sum(1 for r in results if r.degraded)
+    print(f"overload drill: ladder fired in order "
+          f"(degrade@{first['degrade']} < defer@{first['defer']} < "
+          f"reject@{first['reject']} by trace line), "
+          f"{n_deg} degraded / {n_def} defer event(s) / {n_rej} "
+          f"rejection(s), retry_after {rej.retry_after_s:.3f}s, "
+          f"{len(gold)} gold CONVERGED with 0 timeouts, "
+          f"{len(lines)} events")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
