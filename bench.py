@@ -1873,6 +1873,52 @@ def bench_all(results, sections=None) -> None:
                 a4, b4, mesh=mesh4, tol=1e-8, maxiter=500,
                 inject=FaultPlan(site="halo", iteration=10)),
             warmup=1, repeats=1)
+
+        # (c) elastic migration: a mesh-4 resumable solve preempted
+        # after segment 1, resumed on mesh 2 via checkpoint migration
+        # (robust.elastic) - time-to-recover is the resumed run's wall
+        # to convergence, migration overhead the interrupted+migrated
+        # total vs the uninterrupted resumable solve.  Walls include
+        # the new mesh's compile, which is honest: that IS what a
+        # topology change costs a live service.
+        import tempfile as _tf
+
+        from cuda_mpi_parallel_tpu.robust import (
+            PreemptedError,
+            Preemption,
+        )
+        from cuda_mpi_parallel_tpu.utils.checkpoint import (
+            solve_resumable_distributed,
+        )
+
+        eldir = _tf.mkdtemp(prefix="bench-elastic-")
+        try:
+            ck_full = os.path.join(eldir, "full.npz")
+            ck_el = os.path.join(eldir, "el.npz")
+            t0 = time.perf_counter()
+            res_full = solve_resumable_distributed(
+                a4, b4, ck_full, mesh=mesh4, segment_iters=25,
+                tol=1e-8, maxiter=500)
+            el_full = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            try:
+                solve_resumable_distributed(
+                    a4, b4, ck_el, mesh=mesh4, segment_iters=25,
+                    tol=1e-8, maxiter=500,
+                    preempt=Preemption(after_segments=1))
+            except PreemptedError:
+                pass
+            el_interrupted = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            res_el = solve_resumable_distributed(
+                a4, b4, ck_el, mesh=make_mesh(2), segment_iters=25,
+                tol=1e-8, maxiter=500, elastic=True)
+            el_recover = time.perf_counter() - t0
+        finally:
+            import shutil as _sh
+
+            _sh.rmtree(eldir, ignore_errors=True)
+
         its = max(int(res_c.iterations), 1)
         entry = {
             "n": int(a4.shape[0]), "tol": 1e-8,
@@ -1896,6 +1942,17 @@ def bench_all(results, sections=None) -> None:
                 "recovery_overhead_pct": round(
                     100.0 * (el_r / max(el_c, 1e-30) - 1.0), 2),
                 "restarts": rr.restarts,
+            },
+            "elastic": {
+                "time_to_recover_s": round(float(el_recover), 6),
+                "migration_overhead_pct": round(
+                    100.0 * ((el_interrupted + el_recover)
+                             / max(el_full, 1e-30) - 1.0), 2),
+                "resume_mesh": 2,
+                "converged": bool(res_full.converged)
+                and bool(res_el.converged),
+                "max_abs_dx": float(np.max(np.abs(
+                    np.asarray(res_el.x) - np.asarray(res_full.x)))),
             },
         }
         results["robust"] = entry

@@ -267,6 +267,41 @@ class PartitionPlan:
             scored_by=str(data.get("scored_by", "reference-tpu-v5e")),
         )
 
+    def layout_json(self) -> dict:
+        """MINIMAL layout identity - exactly what a distributed
+        checkpoint must record to be migratable to a different mesh
+        shape later (``robust.elastic``): the row ranges, the
+        permutation, the exchange lane and the fingerprint.  No
+        predicted report, no score - a checkpoint's npz should not
+        carry a planner diagnostic payload."""
+        return {
+            "n_shards": int(self.n_shards),
+            "row_ranges": [[int(lo), int(hi)]
+                           for lo, hi in self.row_ranges],
+            "permutation": (None if self.permutation is None
+                            else [int(v) for v in self.permutation]),
+            "exchange": self.exchange,
+            "fingerprint": self.fingerprint(),
+            "label": self.label,
+        }
+
+    @classmethod
+    def from_layout_json(cls, data: dict) -> "PartitionPlan":
+        """Rebuild a plan from its :meth:`layout_json` - enough to lift
+        a checkpoint's padded plan-permuted state back to global row
+        order (reorder/split/score are unknown and labeled so)."""
+        perm = data.get("permutation")
+        return cls(
+            n_shards=int(data["n_shards"]),
+            row_ranges=tuple((int(lo), int(hi))
+                             for lo, hi in data["row_ranges"]),
+            permutation=(None if perm is None
+                         else np.asarray(perm, dtype=np.int64)),
+            reorder="saved", split="saved", objective="saved",
+            score=0.0,
+            exchange=str(data.get("exchange", "allgather")),
+        )
+
     def save(self, path: str) -> None:
         with open(path, "w", encoding="utf-8") as f:
             json.dump(self.to_json(), f)

@@ -277,6 +277,52 @@ def build_parser() -> argparse.ArgumentParser:
                         "transient --inject fault disarms on restart; "
                         "the recovered solution matches the "
                         "fault-free solve")
+    p.add_argument("--checkpoint", default=None, metavar="PATH",
+                   help="run the distributed solve in resumable "
+                        "segments, persisting the full per-shard CG "
+                        "recurrence state (with layout metadata) to "
+                        "PATH after each (utils.checkpoint."
+                        "solve_resumable_distributed).  If PATH "
+                        "exists the solve RESUMES from it - the exact "
+                        "trajectory on the same mesh, or an elastic "
+                        "migration with --elastic.  Assembled-CSR "
+                        "--mesh > 1, method=cg, general engine")
+    p.add_argument("--segment-iters", type=int, default=100,
+                   dest="segment_iters", metavar="N",
+                   help="iterations per checkpointed segment "
+                        "(--checkpoint; default 100)")
+    p.add_argument("--elastic", action="store_true",
+                   help="allow the checkpointed solve to survive "
+                        "TOPOLOGY change (robust.elastic): a "
+                        "checkpoint written at a different mesh size/"
+                        "plan/exchange is auto-migrated to this run's "
+                        "layout (solve_migration event, residual-"
+                        "continuity seam contract), and in-run "
+                        "watchdog/shard_loss triggers answer with "
+                        "checkpoint-now-and-migrate")
+    p.add_argument("--watchdog", nargs="?", const=2.0, default=None,
+                   type=float, metavar="THRESHOLD",
+                   help="straggler watchdog (robust.watchdog): "
+                        "profile the partition's measured per-shard "
+                        "SpMV / per-link bandwidth between segments "
+                        "(telemetry.phasetrace) and emit typed "
+                        "shard_degraded events past THRESHOLD x the "
+                        "EWMA baseline (bare flag: 2.0); with "
+                        "--elastic a degraded shard triggers "
+                        "checkpoint-now-and-migrate off its mesh")
+    p.add_argument("--keep-last", type=int, default=1,
+                   dest="keep_last", metavar="K",
+                   help="retain the K most recent checkpoint "
+                        "snapshots (PATH, PATH.prev1, ...); a torn/"
+                        "corrupt newest file falls back to the "
+                        "previous snapshot instead of failing the "
+                        "resume (--checkpoint; default 1)")
+    p.add_argument("--preempt-after", type=int, default=None,
+                   dest="preempt_after", metavar="K",
+                   help="chaos drill: kill the checkpointed solve "
+                        "after K completed segments (robust."
+                        "Preemption) - state is on disk, exit code 3; "
+                        "a later identical invocation resumes")
     p.add_argument("--no-validate", action="store_true",
                    dest="no_validate",
                    help="skip the host-side pre-solve finiteness "
@@ -919,6 +965,85 @@ def main(argv=None) -> int:
         recover_policy = RecoveryPolicy(max_restarts=args.recover)
         desc += f" [recover: {args.recover}]"
 
+    # Elastic checkpointed solves (--checkpoint): the resumable
+    # distributed lane with layout metadata, retention, and (with
+    # --elastic) cross-mesh migration.  Same never-silently-drop rule:
+    # every path that cannot carry the segment loop refuses loudly.
+    if args.checkpoint is not None:
+        from .models.operators import CSRMatrix
+
+        if args.mesh <= 1:
+            raise SystemExit("--checkpoint needs --mesh > 1 (the "
+                             "resumable lane persists the per-shard "
+                             "distributed recurrence state; single-"
+                             "device resumable solves ride the "
+                             "utils.checkpoint.solve_resumable API)")
+        if not isinstance(a, CSRMatrix):
+            raise SystemExit(
+                "--checkpoint supports assembled-CSR problems only "
+                "(stencil slabs carry no checkpointable distributed "
+                "recurrence yet; drop --matrix-free)")
+        if args.method != "cg":
+            raise SystemExit(f"--checkpoint rides --method cg only "
+                             f"(got {args.method})")
+        if args.df64 or args.engine in ("resident", "streaming"):
+            raise SystemExit(
+                "--checkpoint is unsupported with --dtype df64 and "
+                "--engine resident/streaming (the segment loop "
+                "re-dispatches the general distributed cg path)")
+        if args.csr_comm != "allgather" or args.exchange == "ring":
+            raise SystemExit(
+                "--checkpoint needs the allgather/gather halo wires "
+                "(the ring schedules carry no checkpointable state; "
+                "drop --csr-comm ring / --exchange ring)")
+        if args.rhs > 1 or args.repeat > 1 or args.recover is not None \
+                or args.recycle is not None:
+            raise SystemExit(
+                "--checkpoint is unsupported with --rhs/--repeat/"
+                "--recover/--recycle (the segment loop is a "
+                "single-RHS resumable solve; serve retries and the "
+                "calibration sequence are separate lanes)")
+        if args.history or args.flight_record is not None:
+            raise SystemExit(
+                "--checkpoint with --history/--flight-record is "
+                "unsupported (the recorder would cover only the "
+                "final segment and silently misreport the solve)")
+        if args.segment_iters < 1:
+            raise SystemExit(f"--segment-iters must be >= 1, got "
+                             f"{args.segment_iters}")
+        if args.keep_last < 1:
+            raise SystemExit(f"--keep-last must be >= 1, got "
+                             f"{args.keep_last}")
+        if args.preempt_after is not None and args.preempt_after < 1:
+            raise SystemExit(f"--preempt-after must be >= 1, got "
+                             f"{args.preempt_after}")
+        desc += " [checkpoint]" + (" [elastic]" if args.elastic else "")
+    else:
+        for flag, name in ((args.elastic, "--elastic"),
+                           (args.watchdog is not None, "--watchdog"),
+                           (args.keep_last > 1, "--keep-last"),
+                           (args.preempt_after is not None,
+                            "--preempt-after")):
+            if flag:
+                raise SystemExit(f"{name} needs --checkpoint PATH "
+                                 f"(it governs the resumable segment "
+                                 f"loop)")
+    if fault_plan is not None and fault_plan.site in (
+            "shard_slow", "shard_loss"):
+        if args.checkpoint is None:
+            raise SystemExit(
+                f"--inject {fault_plan.site}:... is a host-level "
+                f"elastic drill - it needs --checkpoint PATH (and "
+                f"--elastic to migrate)")
+        if fault_plan.site == "shard_slow" and args.watchdog is None:
+            raise SystemExit(
+                "--inject shard_slow:... drills the straggler "
+                "watchdog - add --watchdog [THRESHOLD]")
+        if fault_plan.site == "shard_loss" and not args.elastic:
+            raise SystemExit(
+                "--inject shard_loss:... needs --elastic (a lost "
+                "shard can only be survived by migrating off it)")
+
     # Loud pre-solve validation (robust.validate): reject non-finite
     # b/matrix data HERE, before any partitioning or compile - a NaN
     # input would otherwise spin the recurrence to its first health
@@ -1433,7 +1558,65 @@ def main(argv=None) -> int:
             mesh=args.mesh,
             device=jax.devices()[0].platform) as obs:
         with obs.section("solve"):
-            if args.recycle is not None:
+            if args.checkpoint is not None:
+                # the elastic resumable lane: dispatched ONCE (a
+                # warmup re-dispatch would run the whole segmented
+                # solve twice and delete the checkpoint under the
+                # timed run), timed wall-clock around the loop
+                import time as _time
+
+                from .parallel import make_mesh as _mm
+                from .robust import (
+                    PreemptedError,
+                    Preemption,
+                    StragglerWatchdog,
+                )
+                from .utils.checkpoint import (
+                    solve_resumable_distributed,
+                )
+
+                wd = None
+                if args.watchdog is not None:
+                    if args.watchdog <= 1.0:
+                        raise SystemExit(
+                            f"--watchdog THRESHOLD must be > 1 (a "
+                            f"ratio), got {args.watchdog}")
+                    wd = StragglerWatchdog(threshold=args.watchdog)
+                t0 = _time.perf_counter()
+                try:
+                    result = solve_resumable_distributed(
+                        a, b, args.checkpoint, mesh=_mm(args.mesh),
+                        segment_iters=args.segment_iters,
+                        tol=args.tol, rtol=args.rtol,
+                        maxiter=args.maxiter,
+                        preconditioner=args.precond,
+                        plan=plan_obj, exchange=args.exchange,
+                        elastic=args.elastic,
+                        keep_last=args.keep_last, watchdog=wd,
+                        inject=fault_plan,
+                        check_every=args.check_every,
+                        preempt=(Preemption(args.preempt_after)
+                                 if args.preempt_after is not None
+                                 else None),
+                        # validated once pre-dispatch (or the user
+                        # opted out) - same rule as every other lane
+                        validate=False)
+                except PreemptedError as e:
+                    # the drill's expected exit: state is on disk,
+                    # code 3 so scripts can branch on "resume me"
+                    if args.json:
+                        ulog.emit_json({
+                            "status": "PREEMPTED",
+                            "checkpoint": args.checkpoint,
+                            "elastic": bool(args.elastic),
+                            "detail": str(e)})
+                    else:
+                        print(f"status  : PREEMPTED ({e})")
+                        print(f"resume  : re-run with --checkpoint "
+                              f"{args.checkpoint}")
+                    raise SystemExit(3)
+                elapsed = _time.perf_counter() - t0
+            elif args.recycle is not None:
                 # the Krylov-recycling sequence: solve 1 harvests,
                 # solves 2..N deflate and keep accumulating; the
                 # reported record/timing is the FINAL (most-deflated)
@@ -1667,6 +1850,23 @@ def main(argv=None) -> int:
         record["fault"] = fault_plan.to_json()
     if recovery_box[0] is not None:
         record["recovery"] = recovery_box[0].to_json()
+    if args.checkpoint is not None:
+        from .telemetry.registry import REGISTRY as _REG
+
+        mig_counter = _REG.snapshot().get("solve_migrations_total")
+        migrations = 0
+        if mig_counter:
+            migrations = int(sum(
+                s.get("value", 0)
+                for s in mig_counter.get("series", [])))
+        record["checkpoint"] = {
+            "path": args.checkpoint,
+            "segment_iters": args.segment_iters,
+            "elastic": bool(args.elastic),
+            "keep_last": args.keep_last,
+            "watchdog_threshold": args.watchdog,
+            "migrations": migrations,
+        }
     if args.save_x:
         np.save(args.save_x,
                 np.asarray(result.x) if args.rhs > 1 else x_np)
@@ -1860,6 +2060,12 @@ def main(argv=None) -> int:
                   f"{rr.restarts} restart(s), "
                   f"{'recovered' if rr.recovered else 'NOT recovered'}"
                   f" ({len(rr.faults)} fault(s) detected)")
+        if args.checkpoint is not None:
+            ckr = record["checkpoint"]
+            print(f"elastic : checkpoint {ckr['path']} "
+                  f"(segment {ckr['segment_iters']} iters, keep_last "
+                  f"{ckr['keep_last']}, {ckr['migrations']} "
+                  f"migration(s) this process)")
         # The reference prints the full solution vector (CUDACG.cu:361-364);
         # keep that behavior for small systems.
         if a.shape[0] <= 10 and args.rhs == 1:

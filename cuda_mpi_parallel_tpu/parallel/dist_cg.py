@@ -309,6 +309,13 @@ def solve_distributed(
         if not isinstance(inject, FaultPlan):
             raise TypeError(f"inject must be a robust.FaultPlan, got "
                             f"{type(inject).__name__}")
+        if inject.host_level:
+            raise ValueError(
+                f"inject site {inject.site!r} is a host-level elastic "
+                f"drill consumed by utils.checkpoint."
+                f"solve_resumable_distributed (shard_slow drives the "
+                f"watchdog, shard_loss the migration); it cannot be "
+                f"armed into a compiled solve")
         if inject.shard >= int(mesh.devices.size):
             raise ValueError(
                 f"inject targets shard {inject.shard} but the mesh "
@@ -1237,6 +1244,12 @@ class ManyRHSDispatcher:
             if not isinstance(inject, FaultPlan):
                 raise TypeError(f"inject must be a robust.FaultPlan, "
                                 f"got {type(inject).__name__}")
+            if inject.host_level:
+                raise ValueError(
+                    f"inject site {inject.site!r} is a host-level "
+                    f"elastic drill (solve_resumable_distributed / "
+                    f"robust.watchdog); it cannot be armed into a "
+                    f"compiled many-RHS solve")
             if method != "batched":
                 raise ValueError(
                     "inject (fault injection) needs method='batched' "

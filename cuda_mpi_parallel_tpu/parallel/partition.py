@@ -86,6 +86,28 @@ def gather_indices(row_ranges: RowRanges, n_local: int) -> np.ndarray:
     return g
 
 
+def ranges_n_local(row_ranges: RowRanges) -> int:
+    """Padded per-shard slot count of a variable-row split: the max
+    real row count over shards (every shard pads to it) - THE
+    definition every consumer of a planned layout shares (partitioners
+    here, ``pad_vector_ranges`` callers, and the elastic checkpoint
+    migration that re-derives a saved layout's geometry)."""
+    return max(max(hi - lo for lo, hi in row_ranges), 1)
+
+
+def layout_gather_indices(n: int, n_shards: int,
+                          row_ranges: Optional[RowRanges] = None
+                          ) -> np.ndarray:
+    """``g`` with ``x_original = x_padded[g]`` for EITHER layout: the
+    plan-driven variable-row split (``gather_indices``) or the legacy
+    even split, where real rows keep their ids and only the tail is
+    padding.  The single padded->global map the elastic checkpoint
+    migration lifts recurrence vectors through."""
+    if row_ranges is not None:
+        return gather_indices(row_ranges, ranges_n_local(row_ranges))
+    return np.arange(n, dtype=np.int64)
+
+
 def _ranges_layout(a, n_shards: int, row_ranges: RowRanges):
     """Shared geometry of a plan-driven split: ``(ranges, n_local,
     n_pad, gmap)`` with ``n_local`` the max real row count (every shard
@@ -93,7 +115,7 @@ def _ranges_layout(a, n_shards: int, row_ranges: RowRanges):
     CALLER's shard count is validated against the ranges - a plan for
     the wrong mesh must fail here, not as a far-away shape error."""
     ranges = check_ranges(row_ranges, a.shape[0], n_shards)
-    n_local = max(max(hi - lo for lo, hi in ranges), 1)
+    n_local = ranges_n_local(ranges)
     return ranges, n_local, n_local * n_shards, \
         gather_indices(ranges, n_local)
 

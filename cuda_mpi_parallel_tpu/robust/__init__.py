@@ -26,12 +26,28 @@ Three pieces:
 * :mod:`.validate` - loud host-side pre-solve rejection of non-finite
   inputs (the cheapest fault to catch is the one that never enters the
   compiled loop).
+* :mod:`.elastic` + :mod:`.watchdog` - survival under TOPOLOGY change:
+  :func:`migrate_checkpoint` re-lays a distributed checkpoint out for
+  a different mesh shape (residual-continuity seam contract), and the
+  :class:`StragglerWatchdog` turns phasetrace's measured per-shard /
+  per-link timings into typed ``shard_degraded`` triggers that
+  ``solve_resumable_distributed(elastic=True)`` answers with
+  checkpoint-now-and-migrate.  Drilled by the host-level
+  ``shard_slow``/``shard_loss`` fault sites.
 """
+from .elastic import (  # noqa: F401
+    MigrationResult,
+    MigrationSeamError,
+    lift_checkpoint,
+    migrate_checkpoint,
+)
 from .inject import (  # noqa: F401
     FAULT_SITES,
+    HOST_FAULT_SITES,
     FaultPlan,
     PreemptedError,
     Preemption,
+    ShardLostError,
 )
 from .recover import (  # noqa: F401
     RecoveredResult,
@@ -39,15 +55,24 @@ from .recover import (  # noqa: F401
     solve_with_recovery,
 )
 from .validate import check_finite_problem, check_finite_rhs  # noqa: F401
+from .watchdog import Degradation, StragglerWatchdog  # noqa: F401
 
 __all__ = [
     "FAULT_SITES",
+    "HOST_FAULT_SITES",
+    "Degradation",
     "FaultPlan",
+    "MigrationResult",
+    "MigrationSeamError",
     "PreemptedError",
     "Preemption",
     "RecoveredResult",
     "RecoveryPolicy",
+    "ShardLostError",
+    "StragglerWatchdog",
     "check_finite_problem",
     "check_finite_rhs",
+    "lift_checkpoint",
+    "migrate_checkpoint",
     "solve_with_recovery",
 ]

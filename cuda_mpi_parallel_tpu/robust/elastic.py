@@ -1,0 +1,238 @@
+"""Elastic checkpoint migration: resume a distributed solve on a mesh
+shape it was not checkpointed under.
+
+A distributed ``CGCheckpoint``'s vector leaves (x, r, p) live in the
+PADDED, plan-permuted row layout of one exact partition - which is why
+PR 12's resume refuses any mesh/plan/exchange change with a typed
+``CheckpointMismatch``.  On preemptible pods that refusal strands
+checkpoints: the replacement topology is rarely the one you lost
+(multi-node SpMV work treats node count and link tiers as variables of
+the run, arXiv 1612.08060).  This module turns the refusal into a
+migration path:
+
+* :func:`lift_checkpoint` gathers every vector leaf back to GLOBAL row
+  order - the composed padding-strip o inverse-permutation gather
+  ``dist_cg`` already applies to a returned ``x``, applied to the full
+  recurrence state.
+* :func:`migrate_checkpoint` lifts, re-plans for the new shard count
+  (``plan="auto"`` prices the new layout with the calibrated machine
+  model when one exists), and re-partitions every leaf through the
+  existing ``partition.pad_vector_ranges`` pipeline.  The recurrence
+  SCALARS (rho, rr, nrm0, k) are permutation-invariant inner products
+  and pass through untouched - mathematically the migrated state IS
+  the old state, re-laid-out.
+
+The asserted contract is residual continuity across the seam: a
+bitwise match is impossible (psum order changes with the mesh), so the
+migration recomputes ``||r||`` of the lifted state host-side and
+requires it within ``seam_rtol`` of the checkpointed ``sqrt(rr)`` -
+the first post-migration residual the resumed solve continues from.
+A seam outside tolerance means the state (or the recorded layout) is
+corrupt, and the migration fails typed instead of resuming garbage.
+
+Consumed by ``utils.checkpoint.solve_resumable_distributed(
+elastic=True)`` - both at load time (a checkpoint whose recorded
+layout differs from the requested mesh auto-migrates) and in-run (the
+``robust.watchdog`` straggler trigger / a ``shard_loss`` drill
+checkpoint-now-and-migrate).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "MigrationResult",
+    "MigrationSeamError",
+    "lift_checkpoint",
+    "migrate_checkpoint",
+]
+
+#: default residual-continuity tolerance across the migration seam:
+#: the lifted ``||r||`` (exact permutation + zero-padding of the saved
+#: vector) vs the checkpointed psum'd ``sqrt(rr)`` differ only by
+#: reduction order - well under 1e-5 for f32 states, 1e-12 for f64
+DEFAULT_SEAM_RTOL = 1e-5
+
+
+class MigrationSeamError(RuntimeError):
+    """The migrated state's recomputed ``||r||`` disagrees with the
+    checkpointed one past ``seam_rtol``: the saved vectors and the
+    recorded layout do not describe the same state - resuming would
+    silently converge to garbage, so the migration refuses."""
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrationResult:
+    """One migrated checkpoint plus its seam diagnostics.
+
+    ``checkpoint`` holds host-numpy leaves in the NEW padded
+    plan-permuted layout (what ``solve_distributed(resume_from=...)``
+    on the new mesh consumes); ``plan`` is the resolved new
+    ``balance.PartitionPlan`` (``None`` = even split).  ``r_norm`` is
+    the recomputed global residual norm, ``checkpoint_r_norm`` the
+    ``sqrt(rr)`` it must be continuous with, ``seam_rel_err`` their
+    relative disagreement - the asserted elastic contract.
+    """
+
+    checkpoint: object
+    plan: Optional[object]
+    n_shards_from: int
+    n_shards_to: int
+    k: int
+    r_norm: float
+    checkpoint_r_norm: float
+    seam_rel_err: float
+
+    def to_json(self) -> dict:
+        return {
+            "n_shards_from": self.n_shards_from,
+            "n_shards_to": self.n_shards_to,
+            "k": self.k,
+            "plan": (self.plan.label if self.plan is not None
+                     else "even"),
+            "plan_fingerprint": (self.plan.fingerprint()
+                                 if self.plan is not None else None),
+            "r_norm": self.r_norm,
+            "checkpoint_r_norm": self.checkpoint_r_norm,
+            "seam_rel_err": self.seam_rel_err,
+        }
+
+    def describe(self) -> str:
+        plan_s = self.plan.label if self.plan is not None else "even"
+        return (f"mesh {self.n_shards_from} -> {self.n_shards_to} at "
+                f"k={self.k} (plan {plan_s}, ||r|| {self.r_norm:.6e}, "
+                f"seam rel err {self.seam_rel_err:.2e})")
+
+
+#: the checkpoint's vector leaves (global row layout); scalars pass
+#: through a migration untouched
+_VECTOR_LEAVES = ("x", "r", "p")
+_SCALAR_LEAVES = ("rho", "rr", "nrm0", "k", "indefinite")
+
+
+def _lift_indices(n: int, n_shards: int, plan) -> np.ndarray:
+    """Composed padded-state -> global-order gather: the variable-row
+    padding strip (``partition.layout_gather_indices``) yields the
+    PERMUTED ordering, then the plan's inverse permutation restores
+    the caller's row order - the same composition ``dist_cg`` applies
+    to a returned ``x``."""
+    from ..parallel import partition as part
+
+    ranges = plan.row_ranges if plan is not None else None
+    idx = part.layout_gather_indices(n, n_shards, ranges)
+    inv = plan.inverse_permutation() if plan is not None else None
+    return idx if inv is None else idx[inv]
+
+
+def _padded_rows(n: int, n_shards: int, plan) -> int:
+    from ..parallel import partition as part
+
+    if plan is not None:
+        return part.ranges_n_local(plan.row_ranges) * n_shards
+    return part.padded_size(n, n_shards)
+
+
+def lift_checkpoint(ckpt, n: int, *, n_shards: int, plan=None):
+    """A distributed checkpoint's recurrence state in GLOBAL row order
+    (host numpy): every vector leaf gathered through the saved
+    layout's composed inverse, every scalar passed through.  The
+    mesh-shape-free half of a migration - also useful on its own for
+    inspecting a checkpoint in the caller's row ordering."""
+    from ..solver.cg import CGCheckpoint
+
+    x = np.asarray(ckpt.x)
+    expect = _padded_rows(n, n_shards, plan)
+    if x.shape[0] != expect:
+        raise ValueError(
+            f"checkpoint has {x.shape[0]} padded rows but the "
+            f"declared layout (n={n}, {n_shards} shards, plan="
+            f"{plan.label if plan is not None else 'even'}) pads to "
+            f"{expect}: the checkpoint was written under a different "
+            f"layout than the one recorded")
+    idx = _lift_indices(n, n_shards, plan)
+    leaves = {name: np.asarray(getattr(ckpt, name))[idx]
+              for name in _VECTOR_LEAVES}
+    leaves.update({name: np.asarray(getattr(ckpt, name))
+                   for name in _SCALAR_LEAVES})
+    return CGCheckpoint(**leaves)
+
+
+def migrate_checkpoint(ckpt, n_shards_new: int, *, a,
+                       n_shards_old: int, plan_old=None,
+                       plan="auto", exchange=None, model=None,
+                       seam_rtol: float = DEFAULT_SEAM_RTOL
+                       ) -> MigrationResult:
+    """Re-lay a distributed ``CGCheckpoint`` out for a new mesh shape.
+
+    Args:
+      ckpt: the saved checkpoint (host arrays, padded plan-permuted
+        layout of the OLD partition).
+      n_shards_new: target shard count.
+      a: the global operator (needed to re-plan; its row count defines
+        the global layout).
+      n_shards_old / plan_old: the layout the checkpoint was written
+        under (``solve_resumable_distributed`` records both in the
+        checkpoint's layout metadata; ``plan_old=None`` = even split).
+      plan: the NEW layout - ``"auto"`` re-runs the balance planner
+        for ``n_shards_new`` priced by ``model`` (default: the
+        calibrated machine model when a fresh confident one exists on
+        disk, else the reference table), ``None`` keeps the even
+        split, or an explicit ``balance.PartitionPlan``.
+      exchange: the halo-wire lane the resumed solve will run
+        (forwarded to the planner's lane hint exactly as
+        ``solve_distributed`` does).
+      seam_rtol: residual-continuity tolerance (see module docstring).
+
+    Returns a :class:`MigrationResult`; raises
+    :class:`MigrationSeamError` when the lifted state's recomputed
+    ``||r||`` disagrees with the checkpointed one.
+    """
+    from ..parallel import partition as part
+    from ..parallel.dist_cg import _plan_exchange_hint, resolve_plan
+    from ..solver.cg import CGCheckpoint
+
+    if n_shards_new < 1:
+        raise ValueError(
+            f"n_shards_new must be >= 1, got {n_shards_new}")
+    n = int(a.shape[0])
+    lifted = lift_checkpoint(ckpt, n, n_shards=n_shards_old,
+                             plan=plan_old)
+
+    # the asserted elastic contract: the state the new mesh resumes
+    # from must carry the residual the old mesh checkpointed
+    r_norm = float(np.linalg.norm(np.asarray(lifted.r, np.float64)))
+    ck_norm = float(np.sqrt(max(float(np.asarray(ckpt.rr)), 0.0)))
+    seam = abs(r_norm - ck_norm) / max(ck_norm, 1e-300)
+    if not np.isfinite(r_norm) or seam > seam_rtol:
+        raise MigrationSeamError(
+            f"migration seam broken: lifted ||r|| = {r_norm:.9e} vs "
+            f"checkpointed sqrt(rr) = {ck_norm:.9e} (rel err "
+            f"{seam:.3e} > {seam_rtol:g}): the saved vectors and the "
+            f"recorded layout do not describe the same state")
+
+    plan_new = resolve_plan(
+        plan, a, n_shards_new, model=model,
+        exchange=_plan_exchange_hint("allgather", exchange))
+    perm = plan_new.permutation if plan_new is not None else None
+    ranges = plan_new.row_ranges if plan_new is not None else None
+
+    def repad(v: np.ndarray) -> np.ndarray:
+        if perm is not None:
+            v = v[perm]
+        if ranges is not None:
+            return part.pad_vector_ranges(
+                v, ranges, part.ranges_n_local(ranges))
+        return part.pad_vector(v, part.padded_size(n, n_shards_new))
+
+    leaves = {name: repad(np.asarray(getattr(lifted, name)))
+              for name in _VECTOR_LEAVES}
+    leaves.update({name: np.asarray(getattr(lifted, name))
+                   for name in _SCALAR_LEAVES})
+    return MigrationResult(
+        checkpoint=CGCheckpoint(**leaves), plan=plan_new,
+        n_shards_from=int(n_shards_old), n_shards_to=int(n_shards_new),
+        k=int(np.asarray(ckpt.k)), r_norm=r_norm,
+        checkpoint_r_norm=ck_norm, seam_rel_err=float(seam))

@@ -44,13 +44,33 @@ from jax import lax
 __all__ = [
     "FAULT_SITES",
     "FAULT_VALUES",
+    "HOST_FAULT_SITES",
+    "SHARD_SLOW_FACTOR",
     "FaultPlan",
     "PreemptedError",
     "Preemption",
+    "ShardLostError",
 ]
 
+#: in-trace recurrence sites a plan can corrupt (compiled via lax.cond)
+TRACE_FAULT_SITES = ("halo", "spmv", "reduction")
+
+#: host-level elastic-drill sites (robust.elastic / robust.watchdog):
+#: they never enter a compiled solve - "shard_slow" deterministically
+#: inflates one shard's MEASURED phase timing so the straggler
+#: watchdog's full detection path runs against doctored-but-real
+#: profile data, and "shard_loss" declares one shard lost at a segment
+#: boundary so the elastic loop migrates off it.  For both,
+#: ``iteration`` counts completed SEGMENTS (1-based), not solver steps.
+HOST_FAULT_SITES = ("shard_slow", "shard_loss")
+
 #: recurrence sites a plan can corrupt
-FAULT_SITES = ("halo", "spmv", "reduction")
+FAULT_SITES = TRACE_FAULT_SITES + HOST_FAULT_SITES
+
+#: deterministic slowdown a "shard_slow" drill applies to the target
+#: shard's measured per-matvec SpMV seconds - far past any sane
+#: watchdog threshold, far below anything a healthy profile shows
+SHARD_SLOW_FACTOR = 8.0
 
 #: spellable non-finite values (stored as strings so a FaultPlan stays
 #: hashable AND equal to its twin - a float NaN field would make two
@@ -75,7 +95,10 @@ class FaultPlan:
     ``p . Ap`` (see the module docstring for why that one is global).
     ``iteration`` is the 0-based solver step whose matvec/reduction is
     corrupted (a resumed solve counts from its checkpoint, so the
-    index is absolute).  ``lane`` targets one column of a many-RHS
+    index is absolute).  The host-level elastic-drill sites
+    (``shard_slow``/``shard_loss``, :data:`HOST_FAULT_SITES`) reuse
+    the field as a completed-SEGMENT count instead - they fire at
+    checkpoint boundaries of a resumable solve, never inside a trace.  ``lane`` targets one column of a many-RHS
     ``reduction`` fault (ignored by the array sites, which poison a
     row of the whole stack).  ``sticky=True`` models a permanent
     fault: :meth:`after_restart` keeps it armed, so recovery exhausts
@@ -155,6 +178,46 @@ class FaultPlan:
         gone (``None`` - the clean re-solve), a sticky one persists."""
         return self if self.sticky else None
 
+    # -- host-level elastic-drill sites -------------------------------
+
+    @property
+    def host_level(self) -> bool:
+        """True for the elastic-drill sites (``shard_slow`` /
+        ``shard_loss``), which are consumed by the host-side resumable
+        loop and must never be armed into a compiled solve."""
+        return self.site in HOST_FAULT_SITES
+
+    def fires_segment(self, completed_segments: int) -> bool:
+        """Host-level trigger: this drill fires once ``iteration``
+        segments have completed (1-based; ``iteration=0`` fires at the
+        first boundary)."""
+        return self.host_level \
+            and completed_segments >= max(self.iteration, 1)
+
+    def doctor_profile(self, profile, completed_segments: int):
+        """The ``shard_slow`` drill: the measured
+        ``telemetry.phasetrace.PhaseProfile`` with the target shard's
+        per-matvec SpMV seconds deterministically inflated by
+        ``SHARD_SLOW_FACTOR`` (mesh wall adjusted by the same delta).
+        The watchdog then runs its REAL detection path against the
+        doctored measurement - no stubbed verdicts.  Any other site
+        (or an unfired segment gate) returns the profile untouched."""
+        if self.site != "shard_slow" \
+                or not self.fires_segment(completed_segments):
+            return profile
+        import dataclasses as _dc
+
+        import numpy as np
+
+        spmv = np.array(profile.spmv_s, dtype=float)
+        if self.shard >= spmv.shape[0]:
+            return profile
+        delta = spmv[self.shard] * (SHARD_SLOW_FACTOR - 1.0)
+        spmv[self.shard] += delta
+        return _dc.replace(
+            profile, spmv_s=spmv,
+            spmv_mesh_s=float(profile.spmv_mesh_s) + float(delta))
+
     # -- in-trace machinery -------------------------------------------
 
     def fault_value(self, dtype):
@@ -186,6 +249,12 @@ class FaultPlan:
         """``a @ p`` (or ``a.matmat(p)`` for a stack) with this plan's
         halo/spmv fault armed at step ``k``.  ``reduction`` plans
         leave the matvec untouched (see :meth:`poison_reduction`)."""
+        if self.host_level:
+            raise ValueError(
+                f"fault site {self.site!r} is a host-level elastic "
+                f"drill (consumed by utils.checkpoint."
+                f"solve_resumable_distributed / robust.watchdog); it "
+                f"cannot be armed into a compiled solve")
         stack = p.ndim == 2
         apply = (lambda v: a.matmat(v)) if stack else (lambda v: a @ v)
         if self.site == "reduction":
@@ -269,6 +338,12 @@ class FaultPlan:
     def validate_for_operator(self, a, n_shards: int = 1) -> None:
         """Host-side pre-trace checks with readable errors (the traced
         failure modes above would otherwise surface mid-trace)."""
+        if self.host_level:
+            raise ValueError(
+                f"fault site {self.site!r} is a host-level elastic "
+                f"drill: arm it on solve_resumable_distributed("
+                f"elastic=True) (shard_slow additionally needs a "
+                f"watchdog=), not on a direct solve")
         if self.shard >= max(n_shards, 1):
             raise ValueError(
                 f"fault targets shard {self.shard} but the mesh has "
@@ -289,6 +364,14 @@ class PreemptedError(RuntimeError):
     """A resumable solve was killed between segments (the chaos
     harness's host-level preemption).  State is already on disk - a
     later call with the same path resumes the exact trajectory."""
+
+
+class ShardLostError(RuntimeError):
+    """A ``shard_loss`` drill was armed on a NON-elastic resumable
+    solve: losing a shard can only be survived by migrating off it,
+    which the loop refuses to do without ``elastic=True`` - typed so
+    orchestration layers can branch on "re-run elastic" specifically
+    rather than on a generic configuration error."""
 
 
 @dataclasses.dataclass

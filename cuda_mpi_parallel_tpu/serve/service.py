@@ -482,6 +482,7 @@ class SolverService:
         self._retries = 0
         self._refused = 0
         self._degraded = 0
+        self._migrations = 0
         self._admission_rejected = 0
         self._deferred = 0
         # per-tenant / per-SLO-class tallies (exact, for stats())
@@ -793,6 +794,100 @@ class SolverService:
                 with events.solve_scope():
                     res = self._engine(handle, b0, tol0)
             np.asarray(res.x)   # block: the compile is really done
+
+    def migrate(self, handle: OperatorHandle, *, mesh=None,
+                n_devices: Optional[int] = None) -> OperatorHandle:
+        """Move a LIVE mesh handle onto a new mesh shape - the serving
+        half of elastic solves (a host reclaim shrank the pod, or the
+        watchdog flagged a shard).
+
+        The new ``parallel.ManyRHSDispatcher`` is built and every lane
+        bucket re-warmed OFF the request path (warmup-scoped events,
+        exactly like registration) before the handle is swapped, so
+        live traffic never pays a compile.  Queued requests are
+        PRESERVED - they reference the handle, not the dispatcher, and
+        dispatch on the new mesh after the swap (zero drops); a batch
+        already in flight finishes on the dispatcher it started with
+        (the swap serializes behind the dispatch lock in single-worker
+        mode).  The handle's ``RecycleSpace`` is dropped defensively -
+        a space harvested under the old layout deflates the same
+        GLOBAL vectors, but the conservative contract is re-harvest on
+        the new mesh rather than trust the seam.  ``plan="auto"``
+        handles re-plan for the new shard count (calibrated machine
+        model when one exists); even-split handles stay even.
+
+        Emits a ``handle_migrated`` event; the handle object (and its
+        key) is unchanged, so held references keep working.
+        """
+        from jax.sharding import Mesh
+
+        from ..parallel.dist_cg import ManyRHSDispatcher
+        from ..parallel.mesh import make_mesh
+        from ..telemetry import events
+        from ..telemetry.registry import REGISTRY
+
+        with self._lock:
+            if self._handles.get(handle.key) is not handle:
+                raise ValueError(
+                    "unknown handle (register the operator with THIS "
+                    "service first)")
+        if not handle.distributed:
+            raise ValueError(
+                "migrate() moves MESH handles between mesh shapes; "
+                "this handle is single-device (re-register with "
+                "mesh=/n_devices= instead)")
+        if mesh is None:
+            if n_devices is None:
+                raise ValueError("migrate() needs mesh= or n_devices=")
+            mesh = make_mesh(n_devices)
+        if not isinstance(mesh, Mesh):
+            raise TypeError(f"mesh must be a jax.sharding.Mesh, got "
+                            f"{type(mesh).__name__}")
+        n_from = int(handle.mesh.devices.size)
+        n_to = int(mesh.devices.size)
+
+        # build + warm the new dispatcher entirely off the request
+        # path: queued traffic keeps dispatching on the old mesh until
+        # the swap below
+        dispatcher = ManyRHSDispatcher(
+            handle.a, mesh=mesh, maxiter=handle.maxiter,
+            preconditioner=handle.precond, method=handle.method,
+            check_every=handle.check_every,
+            plan=("auto" if handle.plan is not None else None),
+            exchange=handle.exchange, inject=handle.inject)
+        for k in handle.buckets:
+            b0 = np.zeros((handle.n, k),
+                          dtype=np.dtype(handle.dtype_name))
+            tol0 = np.full((k,), 1e-7,
+                           dtype=np.dtype(handle.dtype_name))
+            with events.scoped(phase="warmup"):
+                with events.solve_scope():
+                    res = dispatcher.solve(b0, tol=tol0)
+            np.asarray(res.x)   # block: the compile is really done
+
+        # the swap: behind the dispatch lock so a single-worker batch
+        # in flight finishes on the dispatcher it started with; queued
+        # requests reference the HANDLE and ride the new mesh from the
+        # next pop (zero drops)
+        with self._dispatch_lock:
+            with self._lock:
+                handle.mesh = mesh
+                handle.dispatcher = dispatcher
+                handle.plan = dispatcher.plan
+                self._migrations += 1
+        if handle.recycle_space is not None:
+            # defensive: re-harvest on the new layout rather than
+            # trust a space across the seam
+            self._drop_recycle_space(handle)
+        REGISTRY.counter(
+            "serve_handles_migrated_total",
+            "live operator handles migrated to a new mesh shape",
+            labelnames=("handle",)).inc(handle=handle.key)
+        events.emit("handle_migrated", handle=handle.key,
+                    n_shards_from=n_from, n_shards_to=n_to,
+                    plan=(handle.plan.label if handle.plan is not None
+                          else "even"))
+        return handle
 
     # -- submission ------------------------------------------------------
 
@@ -1879,6 +1974,7 @@ class SolverService:
                 "retries": self._retries,
                 "refused": self._refused,
                 "degraded": self._degraded,
+                "migrations": self._migrations,
                 "breakers": {key: br.state
                              for key, br in self._breakers.items()
                              if br.state != "closed"},

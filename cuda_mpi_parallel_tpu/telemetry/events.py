@@ -155,6 +155,18 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     # undeflated baseline rides when the consumer knows one)
     "recycle_harvest": ("k", "window", "iterations"),
     "recycle_applied": ("k", "iterations"),
+    # elastic solves (robust.elastic / robust.watchdog): the straggler
+    # watchdog found one shard's measured phase timing (or one link's
+    # measured bandwidth) degraded past its threshold vs the
+    # calibration-cache EWMA baseline; a checkpoint was migrated to a
+    # different mesh shape (reason: "resume_mesh_change" for a
+    # cross-run elastic resume, "shard_degraded"/"shard_loss" for the
+    # in-run checkpoint-now-and-migrate triggers); a live serve handle
+    # was migrated onto a new mesh (queued requests preserved, buckets
+    # re-warmed off the request path)
+    "shard_degraded": ("shard", "phase", "ratio"),
+    "solve_migration": ("n_shards_from", "n_shards_to", "reason"),
+    "handle_migrated": ("handle", "n_shards_from", "n_shards_to"),
     # the solve finished (converged or not) and was synced
     "solve_end": ("status", "iterations", "residual_norm"),
 }

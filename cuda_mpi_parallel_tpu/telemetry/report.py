@@ -234,12 +234,15 @@ def service_lines(stats: Dict[str, Any]) -> List[str]:
     # the self-healing story (retry policy / circuit breaker /
     # tolerance degradation), only when any of it actually fired
     if stats.get("retries") or stats.get("refused") \
-            or stats.get("degraded") or stats.get("breakers"):
+            or stats.get("degraded") or stats.get("breakers") \
+            or stats.get("migrations"):
         open_b = stats.get("breakers") or {}
         lines.append(
             f"robust  : {stats.get('retries', 0)} retried, "
             f"{stats.get('refused', 0)} refused (breaker), "
             f"{stats.get('degraded', 0)} tolerance-degraded"
+            + (f", {stats['migrations']} handle(s) migrated"
+               if stats.get("migrations") else "")
             + (f"; breakers not closed: "
                f"{', '.join(f'{k}={v}' for k, v in sorted(open_b.items()))}"
                if open_b else ""))

@@ -42,7 +42,31 @@ _FP_EXCLUDE_FIELDS = frozenset({"backend", "rows"})
 class CheckpointMismatch(ValueError):
     """A checkpoint belongs to a different problem or layout
     (fingerprint mismatch).  Typed so recovery/serving layers can
-    branch on it; still a ``ValueError`` for every existing caller."""
+    branch on it; still a ``ValueError`` for every existing caller.
+
+    ``migratable`` splits the refusal (elastic solves): ``True`` means
+    the PROBLEM matches and only the layout (mesh shape / partition
+    plan / exchange lane) differs - exactly what
+    ``solve_resumable_distributed(elastic=True)`` auto-migrates via
+    ``robust.elastic.migrate_checkpoint``; ``False`` (the default)
+    means the operator/rhs fingerprint itself differs - no migration
+    can make a checkpoint of a DIFFERENT system resumable.
+    ``stored_layout`` carries the checkpoint's recorded layout
+    metadata when it was available."""
+
+    def __init__(self, message: str, *, migratable: bool = False,
+                 stored_layout: Optional[dict] = None):
+        super().__init__(message)
+        self.migratable = migratable
+        self.stored_layout = stored_layout
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint file exists but cannot be read (truncated zip,
+    missing members, torn write).  Typed so the resumable loops can
+    fall back to the previous retained snapshot (``keep_last``)
+    instead of dying on the newest file - corruption must degrade to
+    "resume from the one before", never to an unhandled traceback."""
 
 
 def _update_operator_hash(h, a) -> None:
@@ -117,12 +141,44 @@ def problem_fingerprint(a, b) -> str:
     return h.hexdigest()[:16]
 
 
+def _atomic_savez(path: str, **fields) -> None:
+    """Write an npz atomically: a ``tempfile.mkstemp`` sibling in the
+    target directory, then ``os.replace`` - the same pattern as
+    ``utils.tune.JsonCache.put``.  A preemption mid-write can never
+    leave a truncated file at ``path`` (readers see the old snapshot
+    or the new one, nothing in between), the unique temp name cannot
+    collide with a concurrent writer the way the old pid-suffixed name
+    could after a pid reuse, and a failed write cleans its temp up
+    instead of littering the checkpoint directory."""
+    import tempfile
+
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp.npz")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **fields)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def save_checkpoint(path: str, ckpt: CGCheckpoint,
-                    fingerprint: str = "") -> None:
-    """Persist a CG checkpoint (atomically: write temp + rename)."""
-    tmp = f"{path}.tmp.{os.getpid()}"
-    np.savez(
-        tmp,
+                    fingerprint: str = "",
+                    layout: Optional[dict] = None) -> None:
+    """Persist a CG checkpoint (atomically: write temp + rename).
+
+    ``layout``: optional JSON-able layout metadata (the distributed
+    resumable loop records problem fingerprint + mesh shape +
+    partition plan + exchange lane) - what makes the checkpoint
+    MIGRATABLE to a different mesh shape later
+    (``robust.elastic.migrate_checkpoint``)."""
+    import json
+
+    fields = dict(
         version=_FORMAT_VERSION,
         fingerprint=fingerprint,
         x=np.asarray(ckpt.x),
@@ -134,8 +190,9 @@ def save_checkpoint(path: str, ckpt: CGCheckpoint,
         k=np.asarray(ckpt.k),
         indefinite=np.asarray(ckpt.indefinite),
     )
-    # np.savez appends .npz to the temp name
-    os.replace(tmp + ".npz", path)
+    if layout is not None:
+        fields["layout"] = json.dumps(layout)
+    _atomic_savez(path, **fields)
 
 
 def _check_fingerprint(stored: str, expect: str, path: str) -> None:
@@ -183,14 +240,43 @@ def _checkpoint_from_mapping(z, path: str,
         indefinite=jnp.asarray(z["indefinite"]))
 
 
+def _load_npz_arrays(path: str) -> dict:
+    """Materialize every member of a checkpoint npz as host arrays.
+
+    Corruption is TYPED here: a truncated zip (torn write without the
+    atomic rename), an unreadable member or a missing file body raises
+    :class:`CheckpointCorrupt` so resumable loops can fall back to the
+    previous retained snapshot.  A missing file stays
+    ``FileNotFoundError`` (absent, not corrupt)."""
+    import zipfile
+    import zlib
+
+    try:
+        with np.load(path) as z:
+            return {k: np.asarray(z[k]) for k in z.files}
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, zlib.error, EOFError, OSError,
+            ValueError, KeyError) as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is unreadable ({type(e).__name__}: "
+            f"{e}); it was likely torn by a crash mid-write - resume "
+            f"from the previous retained snapshot (keep_last) or "
+            f"delete it to start fresh") from e
+
+
 def load_checkpoint(path: str,
                     expect_fingerprint: str = "") -> CGCheckpoint:
-    with np.load(path) as z:
-        if "kind" in z and str(z["kind"]) == "df64":
-            raise ValueError(
-                f"checkpoint {path} is a df64 checkpoint; load it with "
-                f"load_checkpoint_df64 and resume with cg_df64")
-        return _checkpoint_from_mapping(z, path, expect_fingerprint)
+    z = _load_npz_arrays(path)
+    if "kind" in z and str(z["kind"]) == "df64":
+        raise ValueError(
+            f"checkpoint {path} is a df64 checkpoint; load it with "
+            f"load_checkpoint_df64 and resume with cg_df64")
+    if "version" not in z or "x" not in z:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is missing required members "
+            f"(version/x): not a CG checkpoint, or torn mid-write")
+    return _checkpoint_from_mapping(z, path, expect_fingerprint)
 
 
 def save_checkpoint_df64(path: str, ckpt, fingerprint: str = "") -> None:
@@ -198,12 +284,10 @@ def save_checkpoint_df64(path: str, ckpt, fingerprint: str = "") -> None:
     ``save_checkpoint`` with the double-float state pairs)."""
     import dataclasses as _dc
 
-    tmp = f"{path}.tmp.{os.getpid()}"
     fields = {f.name: np.asarray(getattr(ckpt, f.name))
               for f in _dc.fields(type(ckpt))}
-    np.savez(tmp, version=_FORMAT_VERSION, fingerprint=fingerprint,
-             kind="df64", **fields)
-    os.replace(tmp + ".npz", path)
+    _atomic_savez(path, version=_FORMAT_VERSION,
+                  fingerprint=fingerprint, kind="df64", **fields)
 
 
 def load_checkpoint_df64(path: str, expect_fingerprint: str = ""):
@@ -353,6 +437,60 @@ def solve_resumable(
             return res
 
 
+def _snapshot_paths(path: str, keep_last: int) -> list:
+    """The retention chain, newest first: ``path`` then
+    ``path.prev1`` .. ``path.prev{keep_last-1}``."""
+    return [path] + [f"{path}.prev{i}" for i in range(1, keep_last)]
+
+
+def _rotate_snapshots(path: str, keep_last: int) -> None:
+    """Shift the retention chain one slot (newest -> .prev1 -> ...)
+    before a new save, so the last ``keep_last`` snapshots survive
+    even a newest file torn by a crash that beat the atomic rename's
+    guarantees (e.g. filesystem loss)."""
+    if keep_last <= 1:
+        return
+    chain = _snapshot_paths(path, keep_last)
+    for i in range(len(chain) - 2, -1, -1):
+        if os.path.exists(chain[i]):
+            os.replace(chain[i], chain[i + 1])
+
+
+def _remove_snapshots(path: str, keep_last: int) -> None:
+    for p in _snapshot_paths(path, keep_last):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+
+def _read_distributed_snapshot(path: str):
+    """``(checkpoint, stored_fingerprint, layout|None)`` of one
+    distributed npz snapshot, WITHOUT a fingerprint check (the
+    resumable loop decides migratable-vs-fatal itself).  Raises
+    :class:`CheckpointCorrupt` for torn/unreadable files."""
+    import json
+
+    z = _load_npz_arrays(path)
+    if "version" not in z or "x" not in z:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is missing required members "
+            f"(version/x): not a CG checkpoint, or torn mid-write")
+    stored = str(z["fingerprint"]) if "fingerprint" in z else ""
+    layout = None
+    if "layout" in z:
+        try:
+            layout = json.loads(str(z["layout"]))
+        except json.JSONDecodeError as e:
+            raise CheckpointCorrupt(
+                f"checkpoint {path} has unparseable layout metadata "
+                f"({e}); torn mid-write - fall back or delete") from e
+        if not isinstance(layout, dict):
+            raise CheckpointCorrupt(
+                f"checkpoint {path} layout metadata is not an object")
+    return _checkpoint_from_mapping(z, path, ""), stored, layout
+
+
 def distributed_fingerprint(a, b, *, n_shards: int, plan=None,
                             exchange=None,
                             csr_comm: str = "allgather") -> str:
@@ -390,6 +528,9 @@ def solve_resumable_distributed(
     keep_checkpoint: bool = False,
     backend: str = "npz",
     preempt=None,
+    elastic: bool = False,
+    keep_last: int = 1,
+    watchdog=None,
     **kw,
 ) -> CGResult:
     """Distributed sibling of :func:`solve_resumable`: a mesh solve in
@@ -400,23 +541,51 @@ def solve_resumable_distributed(
     Scope mirrors ``solve_distributed``'s checkpoint lane: assembled
     ``CSRMatrix`` on the allgather/gather exchange, ``method="cg"``.
     The checkpoint fingerprint covers the problem AND the layout
-    (mesh size, resolved partition plan, exchange lane) - resuming
-    under a mismatched layout raises :class:`CheckpointMismatch`
-    instead of silently scattering state to the wrong rows.  The plan
-    is resolved ONCE here so every segment shares one layout (and one
+    (mesh size, resolved partition plan, exchange lane); the npz lane
+    additionally records the layout ITSELF (mesh shape, plan ranges +
+    permutation, exchange lane) as metadata.  Resuming under a
+    mismatched layout raises :class:`CheckpointMismatch` - with
+    ``migratable=True`` when only the layout differs, ``False`` when
+    the operator/rhs fingerprint itself does.  The plan is resolved
+    ONCE per mesh so every segment shares one layout (and one
     compiled executable: ``maxiter`` is static, only the traced
     ``iter_cap`` advances).
 
+    ``elastic=True`` turns the migratable refusal into a migration
+    (``robust.elastic.migrate_checkpoint``): a checkpoint written at a
+    different shard count / plan / exchange lane is lifted to global
+    row order, re-planned for THIS mesh (``plan="auto"`` prices the
+    new layout with the calibrated machine model) and resumed -
+    residual continuity across the seam is the asserted contract
+    (``solve_migration`` event).  In-run, elastic mode also answers
+    two triggers with checkpoint-now-and-migrate: a
+    ``robust.StragglerWatchdog`` finding (``watchdog=`` profiles the
+    partition every ``check_every_segments`` via phasetrace and
+    compares per-shard SpMV / per-link bandwidth against the
+    calibration-cache EWMA - typed ``shard_degraded`` events) and the
+    host-level ``shard_loss`` drill site.  Both drop the affected
+    shard count and continue on the smaller mesh.
+
+    ``keep_last=K`` (npz lane) retains the K most recent snapshots
+    (``path``, ``path.prev1``, ...); a torn/unreadable newest file is
+    a typed :class:`CheckpointCorrupt` and resume falls back to the
+    previous snapshot, loudly (``solve_recovery`` event,
+    ``action="checkpoint_fallback"``).
+
     ``backend="orbax"`` persists the checkpoint tree through orbax
     (sharded arrays written shard-by-shard - the multi-host lane);
-    ``"npz"`` gathers to one host file.
+    ``"npz"`` gathers to one host file.  The elastic/watchdog/
+    retention features ride the npz lane (orbax records no layout
+    metadata yet).
 
     ``preempt``: optional host hook (e.g. ``robust.Preemption``)
     called with the number of completed segments after each save -
     raising :class:`robust.PreemptedError` there simulates a killed
     worker with its state safely on disk; a later identical call
     resumes.  ``**kw`` forwards to ``solve_distributed``
-    (check_every/flight/...).
+    (check_every/flight/...), except that an ``inject=`` whose site is
+    host-level (``shard_slow``/``shard_loss``) is consumed HERE - it
+    drives the watchdog/migration drills and never enters a trace.
     """
     from ..parallel.dist_cg import (
         _plan_exchange_hint,
@@ -429,25 +598,169 @@ def solve_resumable_distributed(
         raise ValueError(f"segment_iters must be >= 1, got {segment_iters}")
     if backend not in ("npz", "orbax"):
         raise ValueError(f"unknown checkpoint backend: {backend!r}")
-    save = save_checkpoint_orbax if backend == "orbax" else save_checkpoint
-    load = load_checkpoint_orbax if backend == "orbax" else load_checkpoint
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    if backend == "orbax" and (elastic or watchdog is not None
+                               or keep_last > 1):
+        raise ValueError(
+            "elastic=/watchdog=/keep_last>1 ride the npz checkpoint "
+            "lane (the orbax tree records no layout metadata yet)")
+    # host-level chaos sites (shard_slow / shard_loss) are consumed by
+    # THIS loop - an in-trace FaultPlan passes through to the solve
+    host_fault = None
+    inj = kw.get("inject")
+    if inj is not None and getattr(inj, "host_level", False):
+        host_fault = kw.pop("inject")
+        if host_fault.site == "shard_slow" and watchdog is None:
+            raise ValueError(
+                "inject site 'shard_slow' drills the straggler "
+                "watchdog - pass watchdog=robust.StragglerWatchdog()")
+        if host_fault.site == "shard_loss" and not elastic:
+            from ..robust.inject import ShardLostError
+
+            raise ShardLostError(
+                "inject site 'shard_loss' needs elastic=True (a lost "
+                "shard can only be survived by migrating off it)")
     if mesh is None:
         mesh = make_mesh(n_devices)
     n_shards = int(mesh.devices.size)
+    if host_fault is not None:
+        if n_shards <= 1:
+            raise ValueError(
+                f"inject site {host_fault.site!r} needs a mesh of "
+                f">= 2 shards (there is nothing to migrate off at 1)")
+        if host_fault.shard >= n_shards:
+            raise ValueError(
+                f"inject targets shard {host_fault.shard} but the "
+                f"mesh has {n_shards}")
+    plan_spec = plan
     plan_resolved = resolve_plan(
         plan, a, n_shards,
         exchange=_plan_exchange_hint("allgather", exchange))
+    problem_fp = problem_fingerprint(a, b)
     fp = distributed_fingerprint(a, b, n_shards=n_shards,
                                  plan=plan_resolved, exchange=exchange)
+
+    if backend == "orbax":
+        return _solve_resumable_distributed_orbax(
+            a, b, path, mesh=mesh, segment_iters=segment_iters,
+            tol=tol, rtol=rtol, maxiter=maxiter,
+            preconditioner=preconditioner, plan_resolved=plan_resolved,
+            exchange=exchange, keep_checkpoint=keep_checkpoint,
+            preempt=preempt, fp=fp, kw=kw)
+
+    def layout_meta() -> dict:
+        return {
+            "problem": problem_fp,
+            "n_shards": n_shards,
+            "exchange": exchange,
+            "comm": "allgather",
+            "plan": (plan_resolved.layout_json()
+                     if plan_resolved is not None else None),
+        }
+
+    def save_state(st: CGCheckpoint) -> None:
+        _rotate_snapshots(path, keep_last)
+        save_checkpoint(path, st, fingerprint=fp, layout=layout_meta())
+
+    def note_migration(mig, reason: str, **extra) -> None:
+        from ..telemetry import events
+        from ..telemetry.registry import REGISTRY
+
+        REGISTRY.counter(
+            "solve_migrations_total",
+            "distributed checkpoints migrated to a new mesh shape "
+            "(robust.elastic)", labelnames=("reason",)).inc(
+                reason=reason)
+        events.emit("solve_migration", reason=reason, **mig.to_json(),
+                    **extra)
+
+    if os.path.isdir(path):
+        raise ValueError(
+            f"checkpoint at {path} is in orbax format but "
+            f"backend='npz' was requested; pass backend='orbax' to "
+            f"resume it (or delete it)")
+
     state: Optional[CGCheckpoint] = None
-    if os.path.exists(path):
-        on_disk = "orbax" if os.path.isdir(path) else "npz"
-        if on_disk != backend:
-            raise ValueError(
-                f"checkpoint at {path} is in {on_disk} format but "
-                f"backend={backend!r} was requested; pass "
-                f"backend={on_disk!r} to resume it (or delete it)")
-        state = load(path, expect_fingerprint=fp)
+    first_corrupt: Optional[CheckpointCorrupt] = None
+    corrupt_paths: list = []
+    for idx, p in enumerate(_snapshot_paths(path, keep_last)):
+        if not os.path.exists(p):
+            continue
+        try:
+            raw, stored_fp, layout = _read_distributed_snapshot(p)
+        except CheckpointCorrupt as e:
+            if first_corrupt is None:
+                first_corrupt = e
+            corrupt_paths.append(p)
+            continue
+        if layout is not None and layout.get("problem") != problem_fp:
+            raise CheckpointMismatch(
+                f"checkpoint {p} belongs to a DIFFERENT problem "
+                f"(operator/rhs fingerprint {layout.get('problem')} "
+                f"!= {problem_fp}); no migration can make a "
+                f"checkpoint of another system resumable - delete it "
+                f"to start fresh", migratable=False,
+                stored_layout=layout)
+        if stored_fp == fp:
+            state = raw
+        elif layout is not None:
+            if not elastic:
+                raise CheckpointMismatch(
+                    f"checkpoint {p} was written under a different "
+                    f"layout (mesh {layout.get('n_shards')} -> "
+                    f"{n_shards} shards); the problem matches, so it "
+                    f"IS migratable - pass elastic=True to "
+                    f"auto-migrate and resume", migratable=True,
+                    stored_layout=layout)
+            from ..balance.plan import PartitionPlan
+            from ..robust import elastic as rel
+
+            plan_old = (PartitionPlan.from_layout_json(layout["plan"])
+                        if layout.get("plan") else None)
+            mig = rel.migrate_checkpoint(
+                raw, n_shards, a=a,
+                n_shards_old=int(layout["n_shards"]),
+                plan_old=plan_old, plan=plan_resolved,
+                exchange=exchange)
+            plan_resolved = mig.plan
+            fp = distributed_fingerprint(
+                a, b, n_shards=n_shards, plan=plan_resolved,
+                exchange=exchange)
+            state = mig.checkpoint
+            note_migration(mig, "resume_mesh_change", path=p)
+            save_state(state)   # the migrated state is checkpointed
+        else:
+            # legacy pre-elastic checkpoint (no layout metadata):
+            # the PR 12 combined-fingerprint refusal, unchanged
+            _check_fingerprint(stored_fp, fp, p)
+            state = raw
+        if idx > 0:
+            from ..telemetry import events
+            from ..telemetry.registry import REGISTRY
+
+            # remove the corrupt newer snapshots NOW: the first save
+            # below rotates the chain, and a known-corrupt file left
+            # at `path` would be rotated OVER the good snapshot we
+            # just resumed from - a preemption in that window would
+            # then lose every recoverable state
+            for bad in corrupt_paths:
+                try:
+                    os.remove(bad)
+                except OSError:
+                    pass
+            REGISTRY.counter(
+                "checkpoint_fallbacks_total",
+                "resumes that skipped corrupt newer checkpoints and "
+                "fell back to an older retained snapshot").inc()
+            events.emit("solve_recovery", attempt=0,
+                        action="checkpoint_fallback", path=p,
+                        skipped=len(corrupt_paths))
+        break
+    else:
+        if first_corrupt is not None:
+            # every retained snapshot was unreadable: typed, loud
+            raise first_corrupt
 
     segments = 0
     while True:
@@ -466,11 +779,107 @@ def solve_resumable_distributed(
             # what a recovery layer restarts from
             return res
         state = res.checkpoint
-        # gather to host arrays once; both backends consume numpy
+        # gather to host arrays once; the save consumes numpy
         state = CGCheckpoint(**{
             f.name: np.asarray(getattr(state, f.name))
             for f in dataclasses_fields(CGCheckpoint)})
-        save(path, state, fingerprint=fp)
+        save_state(state)
+        segments += 1
+        finished = bool(res.converged) or int(res.iterations) >= maxiter
+        if finished:
+            if bool(res.converged) and not keep_checkpoint:
+                _remove_snapshots(path, keep_last)
+            return res
+
+        # -- elastic triggers: run AFTER the save (the state on disk
+        # is what a migration re-lays-out) and BEFORE the preempt hook
+        # (a drill that both degrades and preempts must emit its
+        # shard_degraded findings before the kill)
+        migrate_to = None
+        reason = None
+        extra: dict = {}
+        if watchdog is not None and n_shards > 1 \
+                and segments % watchdog.check_every_segments == 0:
+            from ..telemetry import phasetrace
+
+            profile = phasetrace.profile_distributed(
+                a, mesh=mesh, plan=plan_resolved, exchange=exchange,
+                repeats=watchdog.profile_repeats)
+            if host_fault is not None:
+                profile = host_fault.doctor_profile(profile, segments)
+            findings = watchdog.observe(profile)
+            drop = watchdog.degraded_shards(findings)
+            if drop and elastic and n_shards - len(drop) >= 1:
+                migrate_to = n_shards - len(drop)
+                reason = "shard_degraded"
+                extra = {"degraded_shards": list(drop)}
+        if migrate_to is None and host_fault is not None \
+                and host_fault.site == "shard_loss" \
+                and host_fault.fires_segment(segments):
+            migrate_to = n_shards - 1
+            reason = "shard_loss"
+            extra = {"lost_shard": host_fault.shard}
+        if migrate_to is not None:
+            from ..robust import elastic as rel
+
+            mig = rel.migrate_checkpoint(
+                state, migrate_to, a=a, n_shards_old=n_shards,
+                plan_old=plan_resolved,
+                # an explicit old-mesh plan cannot target the new one;
+                # re-plan (calibrated model) unless the caller asked
+                # for the even split all along
+                plan=("auto" if plan_spec is not None else None),
+                exchange=exchange)
+            mesh = make_mesh(migrate_to)
+            n_shards = migrate_to
+            plan_resolved = mig.plan
+            fp = distributed_fingerprint(
+                a, b, n_shards=n_shards, plan=plan_resolved,
+                exchange=exchange)
+            state = mig.checkpoint
+            note_migration(mig, reason, **extra)
+            save_state(state)   # checkpoint-now-and-migrate
+            host_fault = None   # the affected shard is off the mesh
+        if preempt is not None:
+            preempt(segments)
+
+
+def _solve_resumable_distributed_orbax(a, b, path, *, mesh,
+                                       segment_iters, tol, rtol,
+                                       maxiter, preconditioner,
+                                       plan_resolved, exchange,
+                                       keep_checkpoint, preempt, fp,
+                                       kw) -> CGResult:
+    """The orbax lane of :func:`solve_resumable_distributed` - the
+    pre-elastic segment loop, byte-for-byte behavior (no layout
+    metadata, no retention, no migration)."""
+    from ..parallel.dist_cg import solve_distributed
+
+    if os.path.exists(path) and not os.path.isdir(path):
+        raise ValueError(
+            f"checkpoint at {path} is in npz format but "
+            f"backend='orbax' was requested; pass backend='npz' to "
+            f"resume it (or delete it)")
+    state: Optional[CGCheckpoint] = None
+    if os.path.exists(path):
+        state = load_checkpoint_orbax(path, expect_fingerprint=fp)
+
+    segments = 0
+    while True:
+        done_k = int(state.k) if state is not None else 0
+        cap = min(done_k + segment_iters, maxiter)
+        res = solve_distributed(
+            a, b, mesh=mesh, tol=tol, rtol=rtol, maxiter=maxiter,
+            preconditioner=preconditioner, plan=plan_resolved,
+            exchange=exchange, resume_from=state,
+            return_checkpoint=True, iter_cap=cap, **kw)
+        if res.status_enum().name == "BREAKDOWN":
+            return res
+        state = res.checkpoint
+        state = CGCheckpoint(**{
+            f.name: np.asarray(getattr(state, f.name))
+            for f in dataclasses_fields(CGCheckpoint)})
+        save_checkpoint_orbax(path, state, fingerprint=fp)
         segments += 1
         finished = bool(res.converged) or int(res.iterations) >= maxiter
         if finished:
@@ -478,10 +887,7 @@ def solve_resumable_distributed(
                 import shutil
 
                 try:
-                    if os.path.isdir(path):
-                        shutil.rmtree(path)
-                    else:
-                        os.remove(path)
+                    shutil.rmtree(path)
                 except OSError:
                     pass
             return res
@@ -598,12 +1004,11 @@ def _save_replay_ckpt(path, k, x_hi, x_lo, fingerprint):
     loudly, not silently change the trajectory."""
     from ..ops.pallas.resident import _fold_radix
 
-    tmp = f"{path}.tmp.{os.getpid()}"
-    np.savez(tmp, version=_FORMAT_VERSION, fingerprint=fingerprint,
-             kind="df64-replay", k=np.asarray(k),
-             fold_radix=np.asarray(_fold_radix()),
-             x_hi=np.asarray(x_hi), x_lo=np.asarray(x_lo))
-    os.replace(tmp + ".npz", path)
+    _atomic_savez(path, version=_FORMAT_VERSION,
+                  fingerprint=fingerprint,
+                  kind="df64-replay", k=np.asarray(k),
+                  fold_radix=np.asarray(_fold_radix()),
+                  x_hi=np.asarray(x_hi), x_lo=np.asarray(x_lo))
 
 
 def _load_replay_k(path, expect_fingerprint) -> int:

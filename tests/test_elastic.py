@@ -1,0 +1,618 @@
+"""Elastic solves: checkpoint migration across mesh shapes, corrupt-
+checkpoint survival, and straggler-triggered self-healing.
+
+The acceptance story (ISSUE 15):
+
+* a mesh-4 checkpoint resumed on mesh 2 (and 2->4) converges with
+  final x within 1e-5 of the uninterrupted run, on BOTH exchange lanes
+  and under plan=None/auto/explicit - with residual continuity across
+  the migration seam (the first post-migration ``||r||`` is the
+  checkpointed one);
+* ``CheckpointMismatch`` splits migratable (layout differs) from fatal
+  (operator/rhs fingerprint differs);
+* a torn-write newest checkpoint is a typed ``CheckpointCorrupt`` and
+  resume falls back to the previous retained snapshot (``keep_last``);
+* the ``shard_slow`` drill makes the straggler watchdog emit typed
+  ``shard_degraded`` events from its REAL detection path and the
+  elastic loop migrate off the slow shard's mesh; ``shard_loss``
+  migrates without a watchdog;
+* ``SolverService.migrate`` preserves queued requests (zero drops)
+  with zero post-rewarm cache misses;
+* the elastic=False / no-watchdog path dispatches the exact same
+  compiled solver as before (zero extra traces, bitwise-equal x) -
+  the TestZeroPerturbation discipline.
+"""
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu.models import mmio
+from cuda_mpi_parallel_tpu.parallel import make_mesh, solve_distributed
+from cuda_mpi_parallel_tpu.robust import (
+    FaultPlan,
+    MigrationSeamError,
+    PreemptedError,
+    Preemption,
+    StragglerWatchdog,
+    lift_checkpoint,
+    migrate_checkpoint,
+)
+from cuda_mpi_parallel_tpu.solver.status import CGStatus
+from cuda_mpi_parallel_tpu.telemetry import events
+from cuda_mpi_parallel_tpu.telemetry.phasetrace import PhaseProfile
+from cuda_mpi_parallel_tpu.utils import compat
+from cuda_mpi_parallel_tpu.utils.checkpoint import (
+    CheckpointCorrupt,
+    CheckpointMismatch,
+    load_checkpoint,
+    solve_resumable_distributed,
+)
+
+needs_mesh = pytest.mark.skipif(
+    not compat.has_shard_map() or len(jax.devices()) < 4,
+    reason="needs shard_map and >= 4 (virtual) devices")
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "skewed_spd_240.mtx")
+
+
+@pytest.fixture(scope="module")
+def fixture_problem():
+    a = mmio.load_matrix_market(FIXTURE)
+    b = np.random.default_rng(0).standard_normal(240)
+    return a, b
+
+
+def _preempted_checkpoint(a, b, path, *, n_shards, segments=1,
+                          **kw):
+    """Run a resumable solve killed after ``segments`` segments."""
+    with pytest.raises(PreemptedError):
+        solve_resumable_distributed(
+            a, b, path, mesh=make_mesh(n_shards), segment_iters=20,
+            tol=1e-8, maxiter=500,
+            preempt=Preemption(after_segments=segments), **kw)
+    assert os.path.exists(path)
+
+
+def _captured(buf):
+    recs = [json.loads(ln) for ln in buf.getvalue().splitlines()
+            if ln.strip()]
+    for r in recs:
+        events.validate_event(r)
+    return recs
+
+
+@needs_mesh
+class TestMigrateCheckpoint:
+    """The pure migration math, no solve loop."""
+
+    def test_lift_matches_seam_and_roundtrips(self, fixture_problem,
+                                              tmp_path):
+        a, b = fixture_problem
+        ck_path = str(tmp_path / "m.npz")
+        _preempted_checkpoint(a, b, ck_path, n_shards=4)
+        ck = load_checkpoint(ck_path)
+        lifted = lift_checkpoint(ck, 240, n_shards=4, plan=None)
+        # residual continuity: the lifted r carries the psum'd norm
+        r_norm = float(np.linalg.norm(np.asarray(lifted.r)))
+        assert r_norm == pytest.approx(
+            float(np.sqrt(np.asarray(ck.rr))), rel=1e-10)
+        # 4 -> 2 (even): repadding then lifting again is the identity
+        mig = migrate_checkpoint(ck, 2, a=a, n_shards_old=4,
+                                 plan_old=None, plan=None)
+        back = lift_checkpoint(mig.checkpoint, 240, n_shards=2,
+                               plan=None)
+        for leaf in ("x", "r", "p"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(back, leaf)),
+                np.asarray(getattr(lifted, leaf)))
+        # scalars pass through bitwise
+        for leaf in ("rho", "rr", "nrm0", "k", "indefinite"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(mig.checkpoint, leaf)),
+                np.asarray(getattr(ck, leaf)))
+        assert mig.seam_rel_err < 1e-10
+        assert (mig.n_shards_from, mig.n_shards_to) == (4, 2)
+
+    def test_broken_seam_refuses(self, fixture_problem, tmp_path):
+        a, b = fixture_problem
+        ck_path = str(tmp_path / "seam.npz")
+        _preempted_checkpoint(a, b, ck_path, n_shards=4)
+        ck = load_checkpoint(ck_path)
+        bad = dataclasses.replace(
+            ck, r=np.asarray(ck.r) * 3.0)   # norm no longer matches rr
+        with pytest.raises(MigrationSeamError, match="seam"):
+            migrate_checkpoint(bad, 2, a=a, n_shards_old=4,
+                               plan_old=None, plan=None)
+
+    def test_wrong_declared_layout_refuses(self, fixture_problem,
+                                           tmp_path):
+        a, b = fixture_problem
+        ck_path = str(tmp_path / "lay.npz")
+        _preempted_checkpoint(a, b, ck_path, n_shards=4)
+        ck = load_checkpoint(ck_path)
+        with pytest.raises(ValueError, match="padded rows"):
+            lift_checkpoint(ck, 240, n_shards=7, plan=None)
+
+
+@needs_mesh
+class TestElasticResume:
+    """Kill on one mesh, resume on another: converges to the
+    uninterrupted answer, residual-continuous across the seam."""
+
+    @pytest.mark.parametrize(
+        "n_from,n_to,exchange,plan",
+        [(4, 2, None, None),
+         (4, 2, "gather", "auto"),
+         (2, 4, None, "auto"),
+         (2, 4, "gather", None)])
+    def test_mesh_roundtrip(self, fixture_problem, tmp_path,
+                            n_from, n_to, exchange, plan):
+        a, b = fixture_problem
+        clean = solve_distributed(a, b, mesh=make_mesh(n_from),
+                                  tol=1e-8, maxiter=500,
+                                  exchange=exchange, plan=plan)
+        assert bool(clean.converged)
+        ck = str(tmp_path / f"el_{n_from}_{n_to}.npz")
+        _preempted_checkpoint(a, b, ck, n_shards=n_from,
+                              exchange=exchange, plan=plan)
+        with events.capture() as buf:
+            res = solve_resumable_distributed(
+                a, b, ck, mesh=make_mesh(n_to), segment_iters=20,
+                tol=1e-8, maxiter=500, exchange=exchange, plan=plan,
+                elastic=True)
+        assert bool(res.converged)
+        # final x within 1e-5 of the uninterrupted run (bitwise is
+        # impossible - psum order changed with the mesh)
+        err = float(np.max(np.abs(np.asarray(res.x)
+                                  - np.asarray(clean.x))))
+        assert err < 1e-5, err
+        # the asserted seam contract: first post-migration ||r|| IS
+        # the checkpointed one (the solve_migration event carries the
+        # recomputed norm and its relative disagreement)
+        migs = [e for e in _captured(buf)
+                if e["event"] == "solve_migration"]
+        assert len(migs) == 1
+        m = migs[0]
+        assert (m["n_shards_from"], m["n_shards_to"]) == (n_from, n_to)
+        assert m["reason"] == "resume_mesh_change"
+        assert m["seam_rel_err"] < 1e-8
+        assert m["r_norm"] == pytest.approx(m["checkpoint_r_norm"],
+                                            rel=1e-8)
+
+    def test_explicit_plan_resume(self, fixture_problem, tmp_path):
+        from cuda_mpi_parallel_tpu.balance import plan_partition
+
+        a, b = fixture_problem
+        clean = solve_distributed(a, b, mesh=make_mesh(4), tol=1e-8,
+                                  maxiter=500)
+        ck = str(tmp_path / "el_plan.npz")
+        _preempted_checkpoint(a, b, ck, n_shards=4)
+        plan2 = plan_partition(a, 2)
+        res = solve_resumable_distributed(
+            a, b, ck, mesh=make_mesh(2), segment_iters=20, tol=1e-8,
+            maxiter=500, plan=plan2, elastic=True)
+        assert bool(res.converged)
+        err = float(np.max(np.abs(np.asarray(res.x)
+                                  - np.asarray(clean.x))))
+        assert err < 1e-5, err
+
+    def test_mismatch_matrix(self, fixture_problem, tmp_path):
+        """Migratable (layout differs) vs fatal (problem differs)."""
+        a, b = fixture_problem
+        ck = str(tmp_path / "mm.npz")
+        _preempted_checkpoint(a, b, ck, n_shards=4)
+        # layout-only difference without elastic: migratable=True
+        with pytest.raises(CheckpointMismatch) as ei:
+            solve_resumable_distributed(
+                a, b, ck, mesh=make_mesh(2), segment_iters=20,
+                tol=1e-8, maxiter=500)
+        assert ei.value.migratable
+        assert ei.value.stored_layout["n_shards"] == 4
+        # exchange-lane difference is migratable too
+        with pytest.raises(CheckpointMismatch) as ei:
+            solve_resumable_distributed(
+                a, b, ck, mesh=make_mesh(4), segment_iters=20,
+                tol=1e-8, maxiter=500, exchange="gather")
+        assert ei.value.migratable
+        # a DIFFERENT problem is fatal - elastic cannot save it
+        b2 = b + 1.0
+        with pytest.raises(CheckpointMismatch) as ei:
+            solve_resumable_distributed(
+                a, b2, ck, mesh=make_mesh(4), segment_iters=20,
+                tol=1e-8, maxiter=500, elastic=True)
+        assert not ei.value.migratable
+
+    def test_same_layout_elastic_resume_is_bitwise(
+            self, fixture_problem, tmp_path):
+        """elastic=True with NO layout change must not migrate: the
+        resumed trajectory stays bit-exact (the PR 12 contract)."""
+        a, b = fixture_problem
+        full = solve_resumable_distributed(
+            a, b, str(tmp_path / "f.npz"), mesh=make_mesh(4),
+            segment_iters=20, tol=1e-8, maxiter=500)
+        ck = str(tmp_path / "same.npz")
+        _preempted_checkpoint(a, b, ck, n_shards=4)
+        with events.capture() as buf:
+            res = solve_resumable_distributed(
+                a, b, ck, mesh=make_mesh(4), segment_iters=20,
+                tol=1e-8, maxiter=500, elastic=True)
+        assert not [e for e in _captured(buf)
+                    if e["event"] == "solve_migration"]
+        assert np.array_equal(np.asarray(res.x), np.asarray(full.x))
+
+
+@needs_mesh
+class TestCorruptCheckpoint:
+    def test_torn_write_is_typed(self, fixture_problem, tmp_path):
+        a, b = fixture_problem
+        ck = str(tmp_path / "torn.npz")
+        _preempted_checkpoint(a, b, ck, n_shards=4)
+        blob = open(ck, "rb").read()
+        with open(ck, "wb") as f:
+            f.write(blob[: len(blob) // 3])   # torn mid-write
+        with pytest.raises(CheckpointCorrupt, match="unreadable"):
+            load_checkpoint(ck)
+
+    def test_fallback_to_previous_snapshot(self, fixture_problem,
+                                           tmp_path):
+        """keep_last=2: a torn newest file falls back to .prev1 and
+        the resume still bit-matches the uninterrupted run (the
+        fallback snapshot is an exact earlier trajectory point)."""
+        a, b = fixture_problem
+        full = solve_resumable_distributed(
+            a, b, str(tmp_path / "full.npz"), mesh=make_mesh(4),
+            segment_iters=20, tol=1e-8, maxiter=500)
+        ck = str(tmp_path / "fb.npz")
+        _preempted_checkpoint(a, b, ck, n_shards=4, segments=2,
+                              keep_last=2)
+        assert os.path.exists(ck + ".prev1")
+        blob = open(ck, "rb").read()
+        with open(ck, "wb") as f:
+            f.write(blob[: len(blob) // 3])
+        with events.capture() as buf:
+            res = solve_resumable_distributed(
+                a, b, ck, mesh=make_mesh(4), segment_iters=20,
+                tol=1e-8, maxiter=500, keep_last=2)
+        falls = [e for e in _captured(buf)
+                 if e["event"] == "solve_recovery"
+                 and e["action"] == "checkpoint_fallback"]
+        assert len(falls) == 1 and falls[0]["skipped"] == 1
+        assert bool(res.converged)
+        assert np.array_equal(np.asarray(res.x), np.asarray(full.x))
+
+    def test_fallback_never_rotates_corrupt_over_good(
+            self, fixture_problem, tmp_path):
+        """The corrupt newest snapshot is REMOVED during the fallback,
+        so the first post-resume rotation can never shift it over the
+        good snapshot (a preemption in that window would otherwise
+        lose every recoverable state)."""
+        a, b = fixture_problem
+        ck = str(tmp_path / "rot.npz")
+        _preempted_checkpoint(a, b, ck, n_shards=4, segments=2,
+                              keep_last=2)
+        blob = open(ck, "rb").read()
+        with open(ck, "wb") as f:
+            f.write(blob[: len(blob) // 3])
+        with pytest.raises(PreemptedError):
+            solve_resumable_distributed(
+                a, b, ck, mesh=make_mesh(4), segment_iters=20,
+                tol=1e-8, maxiter=500, keep_last=2,
+                preempt=Preemption(after_segments=1))
+        # after the fallback resume's first save, BOTH retained
+        # snapshots are readable - the torn file is gone for good
+        load_checkpoint(ck)
+        load_checkpoint(ck + ".prev1")
+
+    def test_every_snapshot_corrupt_raises(self, fixture_problem,
+                                           tmp_path):
+        a, b = fixture_problem
+        ck = str(tmp_path / "allbad.npz")
+        _preempted_checkpoint(a, b, ck, n_shards=4, segments=2,
+                              keep_last=2)
+        for p in (ck, ck + ".prev1"):
+            with open(p, "wb") as f:
+                f.write(b"not a zip at all")
+        with pytest.raises(CheckpointCorrupt):
+            solve_resumable_distributed(
+                a, b, ck, mesh=make_mesh(4), segment_iters=20,
+                tol=1e-8, maxiter=500, keep_last=2)
+
+    def test_converged_run_removes_all_snapshots(self, fixture_problem,
+                                                 tmp_path):
+        a, b = fixture_problem
+        ck = str(tmp_path / "done.npz")
+        res = solve_resumable_distributed(
+            a, b, ck, mesh=make_mesh(4), segment_iters=20, tol=1e-8,
+            maxiter=500, keep_last=3)
+        assert bool(res.converged)
+        assert not os.path.exists(ck)
+        assert not os.path.exists(ck + ".prev1")
+
+
+def _profile(spmv, links=(), n_shards=None):
+    spmv = np.asarray(spmv, dtype=float)
+    n = int(n_shards or spmv.shape[0])
+    return PhaseProfile(
+        kind="csr", exchange="allgather", n_shards=n,
+        n_local=60, itemsize=8, repeats=4, spmv_s=spmv,
+        spmv_mesh_s=float(spmv.sum()), halo_s=1e-5,
+        reduction_s=1e-6, step_s=float(spmv.sum()) + 2e-5,
+        links=tuple(links))
+
+
+class TestWatchdog:
+    def test_peer_baseline_detects_first_profile(self):
+        wd = StragglerWatchdog(persist=False)
+        with events.capture() as buf:
+            found = wd.observe(_profile([1e-4, 8e-4, 1e-4, 1e-4]))
+        assert [d.shard for d in found] == [1]
+        assert found[0].phase == "spmv"
+        assert found[0].ratio == pytest.approx(8.0, rel=1e-6)
+        degs = [e for e in _captured(buf)
+                if e["event"] == "shard_degraded"]
+        assert len(degs) == 1 and degs[0]["shard"] == 1
+
+    def test_two_shard_straggler_detects(self):
+        """The peer baseline excludes the shard under test: on a
+        2-shard mesh the straggler's only peer IS the healthy shard,
+        so the very first profile detects (a median over both would
+        hide it forever and poison the EWMA)."""
+        wd = StragglerWatchdog(persist=False)
+        found = wd.observe(_profile([1e-4, 8e-4]))
+        assert [d.shard for d in found] == [1]
+        assert found[0].ratio == pytest.approx(8.0, rel=1e-6)
+        # the degraded reading never became its own baseline
+        assert "2:1" not in wd._spmv
+
+    def test_healthy_observations_fold_into_ewma(self):
+        wd = StragglerWatchdog(persist=False, alpha=0.5)
+        assert wd.observe(_profile([1e-4] * 4)) == []
+        assert wd.observe(_profile([2e-4] * 4)) == []
+        # EWMA moved halfway; a 2.1x-of-baseline shard now fires
+        assert wd._spmv["4:0"] == pytest.approx(1.5e-4)
+        found = wd.observe(_profile([3.2e-4, 1.5e-4, 1.5e-4, 1.5e-4]))
+        assert [d.shard for d in found] == [0]
+        # the degraded shard's own baseline did NOT absorb the spike
+        assert wd._spmv["4:0"] == pytest.approx(1.5e-4)
+
+    def test_link_degradation_needs_history(self):
+        wd = StragglerWatchdog(persist=False)
+        link = {"shift": 1, "bytes_per_s": 1e9}
+        assert wd.observe(_profile([1e-4] * 4, links=[link])) == []
+        slow = {"shift": 1, "bytes_per_s": 1e8}   # 10x slower
+        found = wd.observe(_profile([1e-4] * 4, links=[slow]))
+        assert [(d.phase, d.shard) for d in found] == [("link", 1)]
+
+    def test_threshold_validation(self):
+        with pytest.raises(ValueError, match="ratio"):
+            StragglerWatchdog(threshold=0.5)
+
+    def test_shard_slow_doctor(self):
+        plan = FaultPlan.parse("shard_slow:1:2")
+        prof = _profile([1e-4] * 4)
+        doc = plan.doctor_profile(prof, 1)
+        assert doc.spmv_s[2] == pytest.approx(8e-4)
+        assert doc.spmv_s[0] == pytest.approx(1e-4)
+        # segment gate: nothing before segment 1
+        assert plan.doctor_profile(prof, 0) is prof
+
+
+@needs_mesh
+class TestElasticDrills:
+    def test_shard_slow_watchdog_migration(self, fixture_problem,
+                                           tmp_path):
+        """The acceptance drill: watchdog emits shard_degraded from
+        the doctored-but-real measured profile, the elastic loop
+        migrates off the slow shard's mesh, the solve completes to
+        the fault-free answer."""
+        a, b = fixture_problem
+        clean = solve_distributed(a, b, mesh=make_mesh(4), tol=1e-8,
+                                  maxiter=500)
+        wd = StragglerWatchdog(profile_repeats=2, persist=False)
+        with events.capture() as buf:
+            res = solve_resumable_distributed(
+                a, b, str(tmp_path / "slow.npz"), mesh=make_mesh(4),
+                segment_iters=15, tol=1e-8, maxiter=500, elastic=True,
+                watchdog=wd, inject=FaultPlan.parse("shard_slow:1:1"))
+        assert bool(res.converged)
+        recs = _captured(buf)
+        degs = [e for e in recs if e["event"] == "shard_degraded"]
+        migs = [e for e in recs if e["event"] == "solve_migration"]
+        assert degs and degs[0]["shard"] == 1
+        assert migs and migs[0]["reason"] == "shard_degraded"
+        assert migs[0]["n_shards_to"] == 3   # without the slow shard
+        err = float(np.max(np.abs(np.asarray(res.x)
+                                  - np.asarray(clean.x))))
+        assert err < 1e-5, err
+
+    def test_shard_loss_migration(self, fixture_problem, tmp_path):
+        a, b = fixture_problem
+        clean = solve_distributed(a, b, mesh=make_mesh(4), tol=1e-8,
+                                  maxiter=500)
+        with events.capture() as buf:
+            res = solve_resumable_distributed(
+                a, b, str(tmp_path / "loss.npz"), mesh=make_mesh(4),
+                segment_iters=15, tol=1e-8, maxiter=500, elastic=True,
+                inject=FaultPlan.parse("shard_loss:1:2"))
+        assert bool(res.converged)
+        migs = [e for e in _captured(buf)
+                if e["event"] == "solve_migration"]
+        assert migs and migs[0]["reason"] == "shard_loss"
+        assert migs[0]["lost_shard"] == 2
+        err = float(np.max(np.abs(np.asarray(res.x)
+                                  - np.asarray(clean.x))))
+        assert err < 1e-5, err
+
+    def test_host_site_refusals(self, fixture_problem, tmp_path):
+        a, b = fixture_problem
+        # shard_slow without a watchdog
+        with pytest.raises(ValueError, match="watchdog"):
+            solve_resumable_distributed(
+                a, b, str(tmp_path / "r1.npz"), mesh=make_mesh(4),
+                segment_iters=15, tol=1e-8, maxiter=500, elastic=True,
+                inject=FaultPlan.parse("shard_slow:1:1"))
+        # shard_loss without elastic: the TYPED refusal orchestration
+        # layers branch on ("re-run elastic")
+        from cuda_mpi_parallel_tpu.robust import ShardLostError
+
+        with pytest.raises(ShardLostError, match="elastic"):
+            solve_resumable_distributed(
+                a, b, str(tmp_path / "r2.npz"), mesh=make_mesh(4),
+                segment_iters=15, tol=1e-8, maxiter=500,
+                inject=FaultPlan.parse("shard_loss:1:1"))
+        # host sites never enter a compiled solve
+        with pytest.raises(ValueError, match="host-level"):
+            solve_distributed(a, b, mesh=make_mesh(4), tol=1e-8,
+                              maxiter=500,
+                              inject=FaultPlan.parse("shard_slow:1:1"))
+
+    def test_orbax_lane_refuses_elastic(self, fixture_problem,
+                                        tmp_path):
+        a, b = fixture_problem
+        with pytest.raises(ValueError, match="npz"):
+            solve_resumable_distributed(
+                a, b, str(tmp_path / "o"), mesh=make_mesh(4),
+                backend="orbax", elastic=True)
+
+
+@needs_mesh
+class TestServeMigrate:
+    def _misses(self):
+        from cuda_mpi_parallel_tpu.telemetry.registry import REGISTRY
+
+        snap = REGISTRY.snapshot().get("dist_solver_cache_misses_total")
+        if not snap:
+            return 0.0
+        return sum(s["value"] for s in snap["series"]
+                   if s["labels"].get("phase") == "solve")
+
+    def test_live_migration_preserves_queue(self, fixture_problem):
+        """Queued requests survive a live 4 -> 2 migration with zero
+        drops and zero post-rewarm cache misses."""
+        from cuda_mpi_parallel_tpu.serve import (
+            ServiceConfig,
+            SolverService,
+        )
+
+        a, _ = fixture_problem
+        rng = np.random.default_rng(11)
+        clk = [0.0]
+        svc = SolverService(ServiceConfig(max_batch=4,
+                                          clock=lambda: clk[0]))
+        try:
+            with events.capture() as buf:
+                h = svc.register(a, mesh=make_mesh(4))
+                xs = [rng.standard_normal(240) for _ in range(5)]
+                futs = [svc.submit(
+                    h, np.asarray(a @ jax.numpy.asarray(x)), tol=1e-9)
+                    for x in xs]
+                svc.migrate(h, n_devices=2)
+                before = self._misses()
+                clk[0] += 1.0
+                svc.pump()
+                assert self._misses() == before   # zero post-rewarm
+                results = [f.result(timeout=10) for f in futs]
+            assert [r.status for r in results] == ["CONVERGED"] * 5
+            for r, x in zip(results, xs):
+                assert float(np.max(np.abs(r.x - x))) < 1e-5
+            migs = [e for e in _captured(buf)
+                    if e["event"] == "handle_migrated"]
+            assert len(migs) == 1
+            assert (migs[0]["n_shards_from"],
+                    migs[0]["n_shards_to"]) == (4, 2)
+            assert int(h.mesh.devices.size) == 2
+            assert svc.stats()["migrations"] == 1
+        finally:
+            svc.close()
+
+    def test_migrate_drops_recycle_space(self, fixture_problem):
+        """A space harvested under the old layout must not survive
+        the seam: migrate drops it defensively (re-harvest on the new
+        mesh is the conservative contract)."""
+        from cuda_mpi_parallel_tpu.serve import (
+            ServiceConfig,
+            SolverService,
+        )
+
+        a, _ = fixture_problem
+        clk = [0.0]
+        svc = SolverService(ServiceConfig(max_batch=2,
+                                          clock=lambda: clk[0]))
+        try:
+            h = svc.register(a, mesh=make_mesh(4))
+            h.recycle_space = object()   # stand-in harvested space
+            h.recycle_harvests = 1
+            svc.migrate(h, n_devices=2)
+            assert h.recycle_space is None       # dropped defensively
+            assert svc.stats()["migrations"] == 1
+        finally:
+            svc.close()
+
+    def test_migrate_refusals(self, fixture_problem):
+        from cuda_mpi_parallel_tpu.serve import (
+            ServiceConfig,
+            SolverService,
+        )
+
+        a, _ = fixture_problem
+        clk = [0.0]
+        svc = SolverService(ServiceConfig(clock=lambda: clk[0]))
+        try:
+            h1 = svc.register(a)                      # single-device
+            with pytest.raises(ValueError, match="single-device"):
+                svc.migrate(h1, n_devices=2)
+            h2 = svc.register(a, mesh=make_mesh(2))
+            with pytest.raises(ValueError, match="mesh="):
+                svc.migrate(h2)
+            other = SolverService(ServiceConfig(clock=lambda: clk[0]))
+            try:
+                with pytest.raises(ValueError, match="unknown handle"):
+                    other.migrate(h2, n_devices=4)
+            finally:
+                other.close()
+        finally:
+            svc.close()
+
+
+@needs_mesh
+class TestZeroPerturbation:
+    """The discipline every subsystem upholds: feature off == feature
+    never mentioned."""
+
+    def test_elastic_flag_off_same_executable(self, fixture_problem,
+                                              tmp_path):
+        """elastic=True with no layout change dispatches the SAME
+        compiled solver entries as the pre-elastic loop (zero extra
+        traces) and bit-matches its x."""
+        from cuda_mpi_parallel_tpu.parallel import dist_cg
+
+        a, b = fixture_problem
+        base = solve_resumable_distributed(
+            a, b, str(tmp_path / "z0.npz"), mesh=make_mesh(4),
+            segment_iters=20, tol=1e-8, maxiter=500)
+        before = dist_cg._TRACE_COUNT[0]
+        res = solve_resumable_distributed(
+            a, b, str(tmp_path / "z1.npz"), mesh=make_mesh(4),
+            segment_iters=20, tol=1e-8, maxiter=500, elastic=True,
+            keep_last=2)
+        assert dist_cg._TRACE_COUNT[0] == before   # cache hits only
+        assert np.array_equal(np.asarray(res.x), np.asarray(base.x))
+
+    def test_host_sites_rejected_by_trace_lanes(self, fixture_problem):
+        from cuda_mpi_parallel_tpu.parallel.dist_cg import (
+            ManyRHSDispatcher,
+        )
+
+        a, b = fixture_problem
+        plan = FaultPlan.parse("shard_loss:1:0")
+        with pytest.raises(ValueError, match="host-level"):
+            solve_distributed(a, b, mesh=make_mesh(4), inject=plan)
+        with pytest.raises(ValueError, match="host-level"):
+            ManyRHSDispatcher(a, mesh=make_mesh(4), inject=plan)
+        with pytest.raises(ValueError, match="host-level"):
+            plan.apply_matvec(None, np.ones(4), 0)

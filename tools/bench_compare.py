@@ -174,6 +174,15 @@ METRIC_DIRECTION = {
     "robust.detection_latency_iters": None,
     "robust.time_to_recover_s": None,
     "robust.recovery_overhead_pct": None,
+    # elastic-migration columns (robust.elastic): wall to recover a
+    # preempted mesh-4 resumable solve by migrating its checkpoint to
+    # mesh 2, and the interrupted+migrated total vs the uninterrupted
+    # resumable solve.  Reported, never gated - both walls include
+    # compile and track host scheduling weather; pre-elastic files
+    # simply lack them (rendered n/a).
+    "elastic.time_to_recover_s": None,
+    "elastic.migration_overhead_pct": None,
+    "elastic.max_abs_dx": None,
     # Krylov-recycling columns (solver.recycle): iters/solve of the
     # first vs final solve of a replayed fresh-RHS workload on the
     # skewed fixture and a Poisson operator, the saved fraction, and
@@ -254,6 +263,8 @@ _NESTED = {
     "robust": ("guarded_iters_per_sec", "armed_iters_per_sec",
                "armed_overhead_pct", "detection_latency_iters",
                "time_to_recover_s", "recovery_overhead_pct"),
+    "elastic": ("time_to_recover_s", "migration_overhead_pct",
+                "max_abs_dx"),
     "recycle": ("first_solve_iters_skewed", "final_solve_iters_skewed",
                 "iters_saved_pct_skewed", "first_solve_iters_poisson",
                 "final_solve_iters_poisson", "iters_saved_pct_poisson",
