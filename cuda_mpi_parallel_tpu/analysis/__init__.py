@@ -20,6 +20,15 @@ GL104 dma-pairing        .start() without .wait() (named or
                          remote copies without send+recv sems
 GL105 host-sync          float()/bool()/.item()/np coercions in
                          lax loop and branch bodies
+GL106 cache-key          compiled-solver build closures consuming
+                         a static the cache key never references
+GL107 lock-discipline    jit/solve/partition/event-I/O under the
+                         dispatch or solver-cache lock; lock order
+                         inversions (Condition aliases resolved)
+GL108 event-schema       emit() of an event type not in
+                         EVENT_SCHEMA, or missing required fields
+GL109 stale-suppression  disable comments whose rule no longer
+                         fires there (warning tier)
 ===== ================== ========================================
 
 Usage::
@@ -57,16 +66,28 @@ from .engine import (  # noqa: F401
 )
 # Importing the rule modules populates the registry.
 from . import (  # noqa: F401
+    rules_cachekey,
     rules_collective,
     rules_dma,
+    rules_events,
     rules_hostsync,
+    rules_locks,
+    rules_suppress,
     rules_tiling,
     rules_vmem,
 )
 
 _LAZY_RUNTIME = {"check_races", "reset_races", "RaceReport",
                  "RaceDetectorUnavailable"}
-_LAZY_JAXPR = {"collective_axes", "check_collective_axes"}
+_LAZY_JAXPR = {"collective_axes", "check_collective_axes",
+               "mesh_collective_findings"}
+_LAZY_SPMD = {"SpmdReport", "SpmdViolation", "CollectiveBudgetError",
+              "BudgetReport", "replication_findings", "verify_spmd",
+              "collective_budget", "verify_collective_budget"}
+_LAZY_CACHEKEY = {"CacheKeyAuditError", "DispatchProbe",
+                  "KeyAuditReport", "record_dispatch", "probe_dispatch",
+                  "audit_dispatches", "audit_solve_distributed",
+                  "audit_many_rhs"}
 
 
 def __getattr__(name: str):
@@ -79,6 +100,14 @@ def __getattr__(name: str):
         from . import jaxpr
 
         return getattr(jaxpr, name)
+    if name in _LAZY_SPMD:
+        from . import spmd
+
+        return getattr(spmd, name)
+    if name in _LAZY_CACHEKEY:
+        from . import cachekey
+
+        return getattr(cachekey, name)
     raise AttributeError(name)
 
 
@@ -87,4 +116,5 @@ __all__ = [
     "resolve_rules", "lint_file", "lint_paths", "lint_source",
     "max_severity",
     *sorted(_LAZY_RUNTIME), *sorted(_LAZY_JAXPR),
+    *sorted(_LAZY_SPMD), *sorted(_LAZY_CACHEKEY),
 ]

@@ -35,9 +35,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "this severity is found (default: warning)")
     p.add_argument("--json", action="store_true",
                    help="emit one JSON array instead of text lines")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="a prior --json report; only findings NOT in "
+                        "it count (gate on 'no new findings' while "
+                        "old debt is paid down incrementally)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
+
+
+def _baseline_keys(path: str):
+    """Fingerprints of a prior run's findings: (path, rule_id,
+    message) - line numbers excluded on purpose, so unrelated edits
+    shifting a known finding down the file do not resurface it as
+    "new".  Multiset semantics: N baselined copies forgive N live
+    ones, and the N+1st is new."""
+    from collections import Counter
+
+    with open(path, "r", encoding="utf-8") as f:
+        records = json.load(f)
+    if not isinstance(records, list):
+        raise ValueError(f"{path}: expected a JSON array (a --json "
+                         f"report), got {type(records).__name__}")
+    return Counter((r.get("path"), r.get("rule_id"), r.get("message"))
+                   for r in records)
 
 
 def _split(spec: Optional[str]) -> Optional[List[str]]:
@@ -59,6 +80,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (FileNotFoundError, ValueError) as e:
         print(f"graftlint: error: {e}", file=sys.stderr)
         return 2
+    if args.baseline:
+        try:
+            known = _baseline_keys(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"graftlint: error: bad baseline: {e}",
+                  file=sys.stderr)
+            return 2
+        kept = []
+        for d in diags:
+            key = (d.path, d.rule_id, d.message)
+            if known.get(key, 0) > 0:
+                known[key] -= 1
+            else:
+                kept.append(d)
+        diags = kept
     if args.json:
         print(json.dumps([d.to_json() for d in diags], indent=2))
     else:

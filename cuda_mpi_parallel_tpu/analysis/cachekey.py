@@ -1,0 +1,310 @@
+"""Differential cache-key soundness audit for the distributed solver
+cache (graftverify).
+
+The bug class: ``parallel.dist_cg`` memoizes compiled solvers by a
+static-configuration key.  Thread a NEW static argument into the solve
+body but forget to add it to the key, and two different programs share
+one cache slot - the second caller silently runs the first caller's
+compiled solver.  Every PR since 7 patched an instance of this by
+hand (flight, fault, deflate, resumable, basis).
+
+The audit is *differential*, so it needs no list of what the key
+"should" contain: perturb one static argument at a time, trace the
+solve body both ways (``jax.make_jaxpr`` - abstract evaluation only,
+never a compile or a device run), and assert
+
+    traced jaxpr changed  =>  cache key changed.
+
+The contrapositive is the bug: same key, different jaxpr.  The
+reverse direction (key changed, jaxpr identical) is merely an
+over-keyed entry - a wasted compile, recorded in the report but never
+a finding.
+
+Dispatches are intercepted at ``dist_cg._cached_solver`` - the single
+choke point every lane (csr, shiftell, stencil, pencil, many-RHS)
+funnels through - so the audited key and the audited program are
+exactly the shipped ones.  The static AST twin is graftlint rule
+GL106 (``rules_cachekey``): a ``build`` closure consuming a static
+local the key expression never references.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+__all__ = [
+    "CacheKeyAuditError",
+    "DispatchProbe",
+    "KeyAuditCase",
+    "KeyAuditReport",
+    "audit_dispatches",
+    "audit_many_rhs",
+    "audit_solve_distributed",
+    "probe_dispatch",
+    "record_dispatch",
+]
+
+
+class CacheKeyAuditError(AssertionError):
+    """A static perturbation changed the traced jaxpr but not the
+    solver-cache key (the silently-wrong-solver-reuse class)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchProbe:
+    """One intercepted dispatch: the key it would cache under, a
+    digest of the jaxpr it would compile, and the build/args pair so
+    jaxpr-level checks (``analysis.spmd``) can re-trace the same
+    body."""
+
+    key: tuple
+    jaxpr_digest: str
+    build: Callable
+    args: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyAuditCase:
+    """One perturbation's outcome."""
+
+    name: str
+    key_changed: bool
+    jaxpr_changed: bool
+
+    @property
+    def unsound(self) -> bool:
+        return self.jaxpr_changed and not self.key_changed
+
+    @property
+    def over_keyed(self) -> bool:
+        return self.key_changed and not self.jaxpr_changed
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyAuditReport:
+    """All perturbation outcomes; ``ok`` iff no case is unsound."""
+
+    cases: Tuple[KeyAuditCase, ...]
+
+    @property
+    def ok(self) -> bool:
+        return not self.unsound
+
+    @property
+    def unsound(self) -> Tuple[KeyAuditCase, ...]:
+        return tuple(c for c in self.cases if c.unsound)
+
+    def describe(self) -> str:
+        return "\n".join(
+            f"  {c.name}: key_changed={c.key_changed} "
+            f"jaxpr_changed={c.jaxpr_changed}"
+            f"{' UNSOUND' if c.unsound else ''}"
+            for c in self.cases)
+
+
+class _ProbeDone(Exception):
+    """Control-flow sentinel: the dispatch was recorded; abort before
+    any compile or execution."""
+
+    def __init__(self, probe: DispatchProbe):
+        self.probe = probe
+
+
+@contextlib.contextmanager
+def record_dispatch():
+    """Patch ``dist_cg._cached_solver`` with a recorder: the next
+    dispatch through the solver cache traces its build (no compile)
+    and raises :class:`_ProbeDone` carrying the
+    :class:`DispatchProbe`.  Use :func:`probe_dispatch` unless you
+    need the raw mechanism."""
+    import jax
+
+    from ..parallel import dist_cg
+
+    def recorder(key, build, cost_ctx=None, cost_args=None):
+        if cost_args is None:
+            raise RuntimeError(
+                "dispatch reached _cached_solver without example "
+                "args; the cache-key audit cannot trace it")
+        closed = jax.make_jaxpr(build())(*cost_args)
+        digest = hashlib.sha1(str(closed).encode()).hexdigest()
+        raise _ProbeDone(DispatchProbe(
+            key=key, jaxpr_digest=digest, build=build,
+            args=tuple(cost_args)))
+
+    original = dist_cg._cached_solver
+    dist_cg._cached_solver = recorder
+    try:
+        yield
+    finally:
+        dist_cg._cached_solver = original
+
+
+def probe_dispatch(dispatch: Callable[[], object]) -> DispatchProbe:
+    """Run ``dispatch`` (a zero-arg callable that issues exactly one
+    solve through the distributed solver cache) under the recorder and
+    return its :class:`DispatchProbe`.  The solve itself never
+    compiles or runs."""
+    with record_dispatch():
+        try:
+            dispatch()
+        except _ProbeDone as done:
+            return done.probe
+    raise RuntimeError(
+        "dispatch completed without consulting the distributed solver "
+        "cache: the cache-key audit covers solve_distributed/"
+        "ManyRHSDispatcher lanes only")
+
+
+def audit_dispatches(base: Callable[[], object],
+                     perturbations: Mapping[str, Callable[[], object]],
+                     *, check: bool = True) -> KeyAuditReport:
+    """Differential audit: probe ``base``, probe each perturbation,
+    and flag every case whose jaxpr moved while its key did not.
+
+    ``base`` is re-probed first to prove digest determinism (an
+    unstable digest would let every case pass vacuously).  With
+    ``check`` (default) an unsound case raises
+    :class:`CacheKeyAuditError`; pass ``check=False`` to get the
+    report regardless.
+    """
+    ref = probe_dispatch(base)
+    again = probe_dispatch(base)
+    if ref.key != again.key or ref.jaxpr_digest != again.jaxpr_digest:
+        raise RuntimeError(
+            "base dispatch is not deterministic under re-trace (key or "
+            "jaxpr digest moved with no perturbation); the audit "
+            "cannot distinguish signal from noise")
+    cases: List[KeyAuditCase] = []
+    for name, dispatch in perturbations.items():
+        probe = probe_dispatch(dispatch)
+        cases.append(KeyAuditCase(
+            name=name,
+            key_changed=probe.key != ref.key,
+            jaxpr_changed=probe.jaxpr_digest != ref.jaxpr_digest))
+    report = KeyAuditReport(cases=tuple(cases))
+    if check and not report.ok:
+        bad = ", ".join(c.name for c in report.unsound)
+        raise CacheKeyAuditError(
+            f"cache key misses static argument(s): perturbing "
+            f"[{bad}] changed the traced jaxpr but NOT the solver-"
+            f"cache key (a second caller would silently reuse the "
+            f"wrong compiled solver)\n{report.describe()}")
+    return report
+
+
+# --------------------------------------------------------------------------
+# shipped-surface audits
+# --------------------------------------------------------------------------
+
+def _synthetic_space(a, k: int = 4):
+    """A layout-valid RecycleSpace without running a harvest: random
+    orthonormal ``W``, exact ``AW``/Cholesky.  Spectral quality is
+    irrelevant here - the audit only traces, never solves."""
+    import numpy as np
+
+    from ..solver.recycle import RecycleSpace, space_layout
+
+    n = int(a.shape[0])
+    rng = np.random.default_rng(7)
+    w, _ = np.linalg.qr(rng.standard_normal((n, k)))
+    aw = np.stack([np.asarray(a.matvec(w[:, j])) for j in range(k)],
+                  axis=1)
+    chol = np.linalg.cholesky(w.T @ aw)
+    return RecycleSpace(w=w, aw=aw, chol=chol, n=n, k=k,
+                        layout=space_layout(a))
+
+
+def default_solve_perturbations(a, b, mesh) -> Dict[str, Callable]:
+    """One dispatch thunk per static argument of
+    :func:`parallel.solve_distributed`: plan fingerprint, exchange
+    lane, fault plan, deflate-k, flight config, resumable lane, plus
+    the solver statics (method/check_every/preconditioner/
+    record_history/maxiter)."""
+    from ..balance import plan_partition
+    from ..parallel import solve_distributed
+    from ..robust.inject import FaultPlan
+    from ..telemetry.flight import FlightConfig
+
+    n_shards = int(mesh.devices.size)
+
+    def dispatch(**overrides):
+        kw = dict(mesh=mesh, tol=1e-8, maxiter=300)
+        kw.update(overrides)
+        return lambda: solve_distributed(a, b, **kw)
+
+    space = _synthetic_space(a)
+    return {
+        "method": dispatch(method="pipecg"),
+        "check_every": dispatch(check_every=4),
+        "preconditioner": dispatch(preconditioner="jacobi"),
+        "record_history": dispatch(record_history=True),
+        "maxiter": dispatch(maxiter=77),
+        "exchange": dispatch(exchange="gather"),
+        "plan_fingerprint": dispatch(
+            plan=plan_partition(a, n_shards, objective="nnz")),
+        "flight": dispatch(flight=FlightConfig(capacity=8)),
+        "fault": dispatch(inject=FaultPlan(site="reduction",
+                                           iteration=2)),
+        "deflate_k": dispatch(deflate=space),
+        "resumable": dispatch(iter_cap=5),
+    }
+
+
+def audit_solve_distributed(a, b, mesh, *,
+                            perturbations: Optional[Mapping] = None,
+                            check: bool = True) -> KeyAuditReport:
+    """Audit ``solve_distributed``'s cache key over its static
+    arguments (CSR allgather baseline).  Trace-only: no compile, no
+    device execution."""
+    from ..parallel import solve_distributed
+
+    base = lambda: solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                     maxiter=300)
+    perturbations = (dict(perturbations) if perturbations is not None
+                     else default_solve_perturbations(a, b, mesh))
+    return audit_dispatches(base, perturbations, check=check)
+
+
+def audit_many_rhs(a, b_stack, mesh, *,
+                   check: bool = True) -> KeyAuditReport:
+    """Audit ``ManyRHSDispatcher``'s key (constructor statics AND the
+    per-dispatch suffix lanes: n_rhs bucket, flight override,
+    deflate-k)."""
+    from ..parallel.dist_cg import ManyRHSDispatcher
+    from ..robust.inject import FaultPlan
+    from ..telemetry.flight import FlightConfig
+
+    def disp(**ctor):
+        d = ManyRHSDispatcher(a, mesh=mesh, **ctor)
+        return d
+
+    def solve_with(d, **kw):
+        return lambda: d.solve(b_stack, **kw)
+
+    import numpy as np
+
+    base_d = disp()
+    space = _synthetic_space(a)
+    # the n_rhs case perturbs the BUCKET: one extra column
+    wide = np.concatenate(
+        [np.asarray(b_stack), np.asarray(b_stack)[:, :1]], axis=1)
+    perturbations = {
+        "method": solve_with(disp(method="block")),
+        "preconditioner": solve_with(disp(preconditioner="jacobi")),
+        "check_every": solve_with(disp(check_every=4)),
+        "compensated": solve_with(disp(compensated=True)),
+        "maxiter": solve_with(disp(maxiter=77)),
+        "exchange": solve_with(disp(exchange="gather")),
+        "flight": solve_with(disp(flight=FlightConfig(capacity=8))),
+        "fault": solve_with(disp(inject=FaultPlan(site="reduction",
+                                                  iteration=2))),
+        "n_rhs": (lambda: base_d.solve(wide)),
+        "flight_override": solve_with(
+            base_d, flight=FlightConfig(capacity=16)),
+        "deflate_k": solve_with(base_d, deflate=space),
+    }
+    return audit_dispatches(solve_with(base_d), perturbations,
+                            check=check)

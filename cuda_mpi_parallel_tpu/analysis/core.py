@@ -86,32 +86,114 @@ def _tokens(spec: str) -> Set[str]:
     return {t.strip().lower() for t in spec.split(",") if t.strip()}
 
 
+def _comment_lines(source: str):
+    """``(lineno, comment_text, standalone)`` for every REAL comment.
+
+    Tokenized, not regex-over-lines: a docstring QUOTING the disable
+    syntax (this package's own docs do) must neither suppress nor
+    count as a stale suppression.  Falls back to the raw line scan on
+    tokenize failure so a weird-but-parseable file still honors its
+    disables.
+    """
+    import io
+    import tokenize
+
+    try:
+        toks = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            if "#" in text:
+                yield (lineno, text[text.index("#"):],
+                       text.lstrip().startswith("#"))
+        return
+    for tok in toks:
+        if tok.type == tokenize.COMMENT:
+            standalone = tok.line[:tok.start[1]].strip() == ""
+            yield tok.start[0], tok.string, standalone
+
+
+@dataclasses.dataclass
+class SuppressionComment:
+    """One ``# graftlint: disable[-file]=...`` comment, tracked so the
+    engine can report tokens that never matched a finding (GL109
+    stale-suppression: a disable must not outlive its bug)."""
+
+    lineno: int
+    tokens: Set[str]
+    file_level: bool
+    used: Set[str] = dataclasses.field(default_factory=set)
+
+    def stale_tokens(self) -> Set[str]:
+        return self.tokens - self.used
+
+
 class SuppressionIndex:
-    """Per-file map of suppressed (line, rule) pairs parsed from comments."""
+    """Per-file map of suppressed (line, rule) pairs parsed from comments.
+
+    ``suppressed`` both answers AND records which comment tokens did
+    the suppressing; after a lint pass, :meth:`stale` reports the
+    comments whose tokens never matched anything.
+    """
 
     def __init__(self, source: str):
+        self.comments: List[SuppressionComment] = []
         self.file_level: Set[str] = set()
-        self.by_line: Dict[int, Set[str]] = {}
-        for lineno, text in enumerate(source.splitlines(), start=1):
-            m = _DISABLE_FILE_RE.search(text)
+        self.by_line: Dict[int, List[SuppressionComment]] = {}
+        # anchored at the comment's start: a comment QUOTING the
+        # disable syntax ("#: ``# graftlint: disable=...``") is
+        # documentation, not a suppression
+        for lineno, text, standalone in _comment_lines(source):
+            m = _DISABLE_FILE_RE.match(text)
             if m:
-                self.file_level |= _tokens(m.group(1))
+                comment = SuppressionComment(
+                    lineno, _tokens(m.group(1)), file_level=True)
+                self.comments.append(comment)
+                self.file_level |= comment.tokens
                 continue
-            m = _DISABLE_RE.search(text)
+            m = _DISABLE_RE.match(text)
             if m:
-                toks = _tokens(m.group(1))
-                self.by_line.setdefault(lineno, set()).update(toks)
+                comment = SuppressionComment(
+                    lineno, _tokens(m.group(1)), file_level=False)
+                self.comments.append(comment)
+                self.by_line.setdefault(lineno, []).append(comment)
                 # only a STANDALONE comment reaches down to the next
                 # line; a trailing comment scopes to its own code line
-                if text.lstrip().startswith("#"):
+                if standalone:
                     self.by_line.setdefault(
-                        lineno + 1, set()).update(toks)
+                        lineno + 1, []).append(comment)
 
     def suppressed(self, line: int, rule: "Rule") -> bool:
         keys = {"all", rule.id.lower(), rule.name.lower()}
-        if self.file_level & keys:
-            return True
-        return bool(self.by_line.get(line, set()) & keys)
+        hit = False
+        for comment in self.comments:
+            if comment.file_level and comment.tokens & keys:
+                comment.used |= comment.tokens & keys
+                hit = True
+        for comment in self.by_line.get(line, ()):
+            if comment.tokens & keys:
+                comment.used |= comment.tokens & keys
+                hit = True
+        return hit
+
+    def stale(self, checked_keys: Set[str], *, all_checked: bool
+              ) -> Iterator[tuple]:
+        """``(lineno, token)`` for every suppression token that did
+        not suppress anything this run.
+
+        Only tokens the run could have vindicated are reported: a
+        token names a rule that actually ran (``checked_keys``), or is
+        ``all`` under a full-registry run (``all_checked``), or names
+        no registered rule at all (a typo'd suppression protects
+        nothing and is always stale).
+        """
+        for comment in self.comments:
+            for token in sorted(comment.stale_tokens()):
+                if token == "all":
+                    if all_checked:
+                        yield comment.lineno, token
+                elif token in checked_keys or token not in REGISTRY:
+                    yield comment.lineno, token
 
 
 # --------------------------------------------------------------------------

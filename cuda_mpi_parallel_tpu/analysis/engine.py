@@ -62,7 +62,33 @@ def lint_source(source: str, path: str = "<string>",
         for d in rule.check(ctx):
             if not ctx.suppressions.suppressed(d.line, rule):
                 diags.append(d)
+    diags.extend(_stale_suppressions(ctx, rules))
     return sorted(diags)
+
+
+def _stale_suppressions(ctx: LintContext,
+                        rules: List[Rule]) -> List[Diagnostic]:
+    """GL109 post-pass: after every rule has run (and marked the
+    suppressions it hit), report the disable tokens left unused.  Runs
+    only when GL109 itself is in the rule set, and its findings go
+    through the suppression filter like any other rule's."""
+    from .core import all_rules
+    from .rules_suppress import StaleSuppressionRule
+
+    stale_rule = next(
+        (r for r in rules if isinstance(r, StaleSuppressionRule)), None)
+    if stale_rule is None:
+        return []
+    checked = {key for r in rules if not isinstance(r, StaleSuppressionRule)
+               for key in (r.id.lower(), r.name.lower())}
+    all_checked = {r.id for r in all_rules()} <= {r.id for r in rules}
+    out: List[Diagnostic] = []
+    for lineno, token in ctx.suppressions.stale(
+            checked, all_checked=all_checked):
+        d = stale_rule.stale_diag(ctx, lineno, token)
+        if not ctx.suppressions.suppressed(d.line, stale_rule):
+            out.append(d)
+    return out
 
 
 def lint_file(path: str, rules: Optional[Iterable[Rule]] = None

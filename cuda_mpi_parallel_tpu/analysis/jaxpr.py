@@ -68,3 +68,57 @@ def check_collective_axes(jaxpr, mesh_axes: Iterable[str]) -> List[str]:
     ``jax.sharding.Mesh`` or any iterable of names."""
     declared = set(getattr(mesh_axes, "axis_names", mesh_axes))
     return sorted(collective_axes(jaxpr) - declared)
+
+
+def _permutation_endpoints(jaxpr):
+    """``(axis_name, perm, eqn)`` for every ppermute/pshuffle in the
+    jaxpr, recursively (perm as written in the primitive params)."""
+    import jax.core as jcore
+
+    if isinstance(jaxpr, jcore.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in ("ppermute", "pshuffle"):
+            axis = eqn.params.get("axis_name")
+            if isinstance(axis, (tuple, list)):
+                axis = axis[0] if axis else None
+            perm = eqn.params.get("perm")
+            if axis is not None and perm is not None:
+                yield axis, perm, eqn
+        for sub in _subjaxprs(eqn.params):
+            yield from _permutation_endpoints(sub)
+
+
+def mesh_collective_findings(jaxpr, mesh) -> List[tuple]:
+    """Validate a traced program's collectives against the ACTUAL mesh
+    geometry, not just a name list: (a) every collective axis name
+    must be declared by ``mesh``, and (b) every ``ppermute``
+    permutation endpoint must lie in ``[0, mesh.shape[axis])`` - a
+    schedule built for a larger mesh (the elastic-migration seam)
+    references shards the mesh does not have and deadlocks on chip.
+
+    Returns ``(kind, message)`` pairs; empty list = safe.  ``mesh``
+    is a ``jax.sharding.Mesh`` (anything with ``axis_names`` and
+    ``shape``).
+    """
+    findings: List[tuple] = []
+    for name in check_collective_axes(jaxpr, mesh):
+        findings.append((
+            "undeclared-axis",
+            f"collective reduces/permutes over axis {name!r} but the "
+            f"mesh declares only "
+            f"{tuple(getattr(mesh, 'axis_names', mesh))}"))
+    sizes = dict(getattr(mesh, "shape", {}) or {})
+    for axis, perm, _eqn in _permutation_endpoints(jaxpr):
+        size = sizes.get(axis)
+        if size is None:
+            continue
+        bad = sorted({i for pair in perm for i in pair
+                      if not 0 <= int(i) < int(size)})
+        if bad:
+            findings.append((
+                "permutation-out-of-range",
+                f"ppermute over axis {axis!r} (size {size}) references "
+                f"shard indices {bad}: the schedule was built for a "
+                f"different mesh shape"))
+    return findings

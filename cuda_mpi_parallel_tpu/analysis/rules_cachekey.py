@@ -1,0 +1,225 @@
+"""GL106 cache-key: every static a solver build closes over must feed
+its cache key.
+
+``dist_cg._cached_solver`` memoizes compiled solvers by a static-
+configuration key.  The build closure it receives bakes its free
+variables into the traced program; any such static the key expression
+never references splits into the "same key, different jaxpr" class -
+the second caller silently reuses the first caller's compiled solver.
+Every PR since 7 patched one of these by hand (flight, fault, deflate,
+resumable, basis).
+
+Detection, per ``_cached_solver(key, build, ...)`` call site:
+
+* **key names** - every name loaded by the key argument or by any
+  assignment (in the enclosing function) to the key variable,
+  closed transitively: backward (names feeding a key name's own
+  assignment join) and forward (a local assigned FROM a key-derived
+  expression is key-derived - how ``gather = resolved == "gather"``
+  inherits soundness from the keyed ``resolved``).  ``self`` in the
+  key (the many-RHS ``_key_base`` path) approves attribute statics.
+* **build frees** - names the build closure loads but does not bind
+  (params, locals, comprehension targets and nested defs excluded),
+  minus module-level bindings and builtins: the statics the trace
+  actually consumes.
+
+Any build free variable outside the key closure is flagged.  The
+dynamic twin is ``analysis.cachekey`` (the differential perturbation
+audit); this rule catches the omission at review time with no tracer.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Dict, Iterator, List, Optional, Set
+
+from .core import (
+    Diagnostic,
+    LintContext,
+    Rule,
+    Severity,
+    call_final_name,
+    register,
+)
+
+_CACHED_SOLVER = "_cached_solver"
+
+_BUILTINS = frozenset(dir(builtins))
+
+
+def _loaded_names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _assign_targets(node: ast.AST) -> Iterator[str]:
+    """Plain names bound by an assignment statement (tuple unpacking
+    included)."""
+    targets: List[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                yield n.id
+
+
+def _module_bindings(tree: ast.Module) -> Set[str]:
+    """Names bound at module level: imports, defs, classes, assigns."""
+    names: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for alias in stmt.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(stmt.name)
+        else:
+            names.update(_assign_targets(stmt))
+    return names
+
+
+def _bound_in(fn: ast.AST) -> Set[str]:
+    """Every name the function subtree binds somewhere: its own and
+    nested params, assignment/loop/with/except/comprehension targets,
+    imports, and nested def/class names.  Over-approximate on purpose -
+    a name bound in a nested scope is that scope's problem, not a
+    closed-over static."""
+    bound: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                bound.add(arg.arg)
+            if not isinstance(node, ast.Lambda):
+                bound.add(node.name)
+        elif isinstance(node, ast.ClassDef):
+            bound.add(node.name)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            bound.update(_assign_targets(node))
+        elif isinstance(node, ast.For):
+            bound.update(n.id for n in ast.walk(node.target)
+                         if isinstance(n, ast.Name))
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            bound.update(n.id for n in ast.walk(node.optional_vars)
+                         if isinstance(n, ast.Name))
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            bound.update(n.id for n in ast.walk(node.target)
+                         if isinstance(n, ast.Name))
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                bound.add((alias.asname or alias.name).split(".")[0])
+    return bound
+
+
+def _code_bindings(fn: ast.AST) -> Set[str]:
+    """Names bound in ``fn`` by imports and nested def/class statements:
+    code objects, not configuration statics, so a build closure using
+    them (``from ..solver.many import cg_many`` at function level is
+    this codebase's lazy-import idiom) is not a cache-key hole."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+    return names
+
+
+def _enclosing_function(ctx: LintContext,
+                        call: ast.Call) -> Optional[ast.AST]:
+    """Innermost function def containing ``call``."""
+    best: Optional[ast.AST] = None
+    for fn in ctx.function_nodes:
+        if any(n is call for n in ast.walk(fn)):
+            if best is None or any(n is fn for n in ast.walk(best)):
+                best = fn
+    return best
+
+
+def _key_closure(fn: ast.AST, key_arg: ast.AST) -> Set[str]:
+    """Names approved as key-feeding, to fixpoint (see module doc)."""
+    assigns: List[ast.Assign] = [
+        n for n in ast.walk(fn)
+        if isinstance(n, (ast.Assign, ast.AugAssign))]
+    approved = _loaded_names(key_arg)
+    changed = True
+    while changed:
+        changed = False
+        for node in assigns:
+            targets = set(_assign_targets(node))
+            value = node.value
+            loads = _loaded_names(value)
+            # backward: an assignment TO an approved name approves
+            # everything that fed it
+            if targets & approved and not loads <= approved:
+                approved |= loads
+                changed = True
+            # forward: a local derived FROM approved names is approved
+            if loads & approved and not targets <= approved:
+                approved |= targets
+                changed = True
+    return approved
+
+
+def _resolve_build(ctx: LintContext, fn: ast.AST,
+                   build_arg: ast.AST) -> Optional[ast.AST]:
+    """The build callable's AST: a lambda inline, or a local ``def``
+    resolved by name within the enclosing function."""
+    if isinstance(build_arg, ast.Lambda):
+        return build_arg
+    if isinstance(build_arg, ast.Name):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == build_arg.id:
+                return node
+    return None
+
+
+@register
+class CacheKeyRule(Rule):
+    id = "GL106"
+    name = "cache-key"
+    severity = Severity.ERROR
+    description = ("every static a compiled-solver build closure "
+                   "consumes must be referenced by its cache key")
+
+    def check(self, ctx: LintContext) -> Iterator[Diagnostic]:
+        if _CACHED_SOLVER not in ctx.source:
+            return
+        module_names = _module_bindings(ctx.tree) | _BUILTINS
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call) \
+                    or call_final_name(call) != _CACHED_SOLVER \
+                    or len(call.args) < 2:
+                continue
+            fn = _enclosing_function(ctx, call)
+            if fn is None:
+                continue
+            build = _resolve_build(ctx, fn, call.args[1])
+            if build is None:
+                continue
+            approved = _key_closure(fn, call.args[0])
+            bound = _bound_in(build) | _code_bindings(fn)
+            frees = sorted(
+                name for name in _loaded_names(build)
+                if name not in bound and name not in module_names
+                and name not in approved)
+            for name in frees:
+                yield self.diag(
+                    ctx, call,
+                    f"build closure consumes static {name!r} but the "
+                    f"cache key never references it: two configs "
+                    f"differing only in {name!r} share one cache slot "
+                    f"and the second silently reuses the first's "
+                    f"compiled solver (add it to cache_key_parts, or "
+                    f"pass it as a traced argument)")

@@ -1,0 +1,139 @@
+"""GL108 event-schema: every emit() names a schema'd event type with
+its required fields spelled as literal keyword keys.
+
+``telemetry.events.emit`` validates at runtime - but only when a sink
+is configured.  With tracing off (the default, and the whole point of
+"opt-in and free when off") a misspelled event type or a dropped
+required field is a silent no-op in production and a crash the first
+time someone turns ``--trace-events`` on.  This rule is the static
+twin of ``validate_event``: it reads ``EVENT_SCHEMA`` out of
+``telemetry/events.py`` (AST only - linting must not import jax, and
+events.py imports the package) and checks every emit call site at
+review time.
+
+Checked: any call whose final name is ``emit`` and whose first
+positional argument is a string literal (or a conditional expression
+over string literals - the ``"dist_cache_hit" if hit else
+"dist_cache_miss"`` idiom).  Calls passing a dynamic event type
+(``_SINK.emit(event_type, ...)`` forwarding) are runtime-validated
+territory and skipped.  A ``**payload`` splat makes the field floor
+unknowable statically, so splatted sites get the membership check
+only.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .core import (
+    Diagnostic,
+    LintContext,
+    Rule,
+    Severity,
+    call_final_name,
+    register,
+)
+
+_SCHEMA_CACHE: Dict[str, Optional[Dict[str, Tuple[str, ...]]]] = {}
+
+
+def _schema_path() -> str:
+    return os.path.normpath(os.path.join(
+        os.path.dirname(__file__), "..", "telemetry", "events.py"))
+
+
+def load_event_schema(path: Optional[str] = None
+                      ) -> Optional[Dict[str, Tuple[str, ...]]]:
+    """Parse ``EVENT_SCHEMA`` out of events.py without importing it.
+
+    Returns None (rule disarms) if the file or the literal is missing -
+    fixtures and external trees without a telemetry package should not
+    crash the linter.
+    """
+    path = path or _schema_path()
+    if path in _SCHEMA_CACHE:
+        return _SCHEMA_CACHE[path]
+    schema: Optional[Dict[str, Tuple[str, ...]]] = None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        tree = None
+    if tree is not None:
+        for node in ast.walk(tree):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, ast.AnnAssign):
+                target = node.target
+            if not (isinstance(target, ast.Name)
+                    and target.id == "EVENT_SCHEMA"
+                    and isinstance(getattr(node, "value", None), ast.Dict)):
+                continue
+            try:
+                raw = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                continue
+            schema = {str(k): tuple(str(f) for f in v)
+                      for k, v in raw.items()}
+            break
+    _SCHEMA_CACHE[path] = schema
+    return schema
+
+
+def _literal_event_types(arg: ast.AST) -> Optional[List[str]]:
+    """The statically-known event type(s) of an emit first argument:
+    a string constant, or an IfExp whose branches are both literal."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.IfExp):
+        body = _literal_event_types(arg.body)
+        orelse = _literal_event_types(arg.orelse)
+        if body is not None and orelse is not None:
+            return body + orelse
+    return None
+
+
+@register
+class EventSchemaRule(Rule):
+    id = "GL108"
+    name = "event-schema"
+    severity = Severity.ERROR
+    description = ("every events.emit() names an EVENT_SCHEMA type and "
+                   "spells its required fields as literal keywords")
+
+    def check(self, ctx: LintContext) -> Iterator[Diagnostic]:
+        if "emit(" not in ctx.source:
+            return
+        schema = load_event_schema()
+        if schema is None:
+            return
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call) \
+                    or call_final_name(call) != "emit" \
+                    or not call.args:
+                continue
+            types = _literal_event_types(call.args[0])
+            if types is None:
+                continue  # dynamic forwarding; runtime validates
+            for etype in types:
+                if etype not in schema:
+                    yield self.diag(
+                        ctx, call,
+                        f"emit of unknown event type {etype!r}: not in "
+                        f"EVENT_SCHEMA, so the first traced run raises "
+                        f"(and every untraced run silently drops it); "
+                        f"add the type to telemetry/events.py or fix "
+                        f"the spelling")
+                    continue
+                if any(kw.arg is None for kw in call.keywords):
+                    continue  # **payload: floor unknowable statically
+                given = {kw.arg for kw in call.keywords}
+                missing = [f for f in schema[etype] if f not in given]
+                if missing:
+                    yield self.diag(
+                        ctx, call,
+                        f"emit({etype!r}) is missing required "
+                        f"field(s) {missing}: validate_event rejects "
+                        f"the record the first time tracing is on")

@@ -1,0 +1,183 @@
+"""GL107 lock-discipline: no blocking work under dispatch/cache locks,
+and one global lock acquisition order.
+
+The serve tier and the solver cache share two short-critical-section
+locks: ``SolverService._dispatch_lock`` (batch cutting) and
+``dist_cg._CACHE_LOCK`` (the compiled-solver LRU).  The discipline
+both were reviewed into (the LRU-eviction race fixed by PR 10's
+fourth review pass):
+
+* **No blocking work while holding either.**  A jit/trace, a solve, a
+  partition, or event-file I/O under one of these locks turns a
+  microseconds critical section into a seconds-long convoy - every
+  enqueue and every cache probe in the process stalls behind one
+  compile.  ``_cached_solver`` deliberately traces OUTSIDE the lock
+  and re-checks on insert; this rule keeps it (and the dispatch path)
+  that way.
+* **Consistent acquisition order.**  Nested ``with lock_a: with
+  lock_b:`` in one order somewhere and the reverse elsewhere is the
+  textbook deadlock; ``threading.Condition(self._lock)`` aliases are
+  resolved to their underlying lock first so ``_cond``/``_lock``
+  nestings do not false-positive.
+
+Scope is lexical: only ``with``-statement bodies are walked (nested
+``def``s are skipped - they run later, possibly lock-free), so helper
+methods CALLED under a lock are the caller's responsibility.  That
+keeps the rule zero-noise and makes its verdict local to the file.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import (
+    Diagnostic,
+    LintContext,
+    Rule,
+    Severity,
+    call_final_name,
+    dotted_name,
+    register,
+)
+
+#: Locks whose critical sections must stay free of blocking work.
+GUARDED_LOCKS = ("_dispatch_lock", "_CACHE_LOCK")
+
+#: Call names that compile, trace, solve, partition, or touch the
+#: event sink - each worth milliseconds-to-seconds, never to be paid
+#: while holding a dispatch/cache lock.
+BLOCKING_CALLS = frozenset({
+    # trace/compile
+    "jit", "make_jaxpr", "lower", "compile", "eval_shape",
+    # solve entry points
+    "solve", "solve_many", "cg_many", "solve_distributed",
+    "solve_distributed_many", "solve_with_recovery", "solve_sequence",
+    "warm",
+    # O(nnz) host-side partition work
+    "partition_csr", "ring_partition_csr", "ring_partition_shiftell",
+    "plan_partition", "resolve_plan",
+    # telemetry I/O (event-file writes; jaxpr cost walks re-trace)
+    "emit", "read_events", "trace_solve_cost",
+})
+
+
+def _lock_name(item: ast.withitem) -> Optional[str]:
+    """Dotted name of a with-item's context manager if it looks like a
+    lock (``self._lock``, ``_CACHE_LOCK``, ``handle.lock``): the final
+    segment must contain "lock" or "cond" (case-insensitive)."""
+    expr = item.context_expr
+    # with lock.acquire_timeout(...) style: look through a call
+    if isinstance(expr, ast.Call):
+        return None
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    final = name.rsplit(".", 1)[-1].lower()
+    if "lock" in final or "cond" in final:
+        return name
+    return None
+
+
+def _condition_aliases(tree: ast.Module) -> Dict[str, str]:
+    """``self._cond = threading.Condition(self._lock)`` ->
+    ``{"self._cond": "self._lock"}``: a Condition waits/notifies on
+    its underlying lock, so nesting them is reentry, not ordering."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target = dotted_name(node.targets[0])
+        value = node.value
+        if target is None or not isinstance(value, ast.Call):
+            continue
+        if call_final_name(value) == "Condition" and value.args:
+            underlying = dotted_name(value.args[0])
+            if underlying is not None:
+                aliases[target] = underlying
+    return aliases
+
+
+def _is_guarded(name: str) -> bool:
+    final = name.rsplit(".", 1)[-1]
+    return final in GUARDED_LOCKS
+
+
+class _LockWalker:
+    """One pass per file: collects blocking-calls-under-guarded-lock
+    and every ordered (outer, inner) lock nesting."""
+
+    def __init__(self, aliases: Dict[str, str]):
+        self.aliases = aliases
+        self.blocking: List[Tuple[ast.Call, str, str]] = []
+        #: (outer, inner) -> first With node witnessing that order
+        self.orders: Dict[Tuple[str, str], ast.With] = {}
+
+    def _canon(self, name: str) -> str:
+        return self.aliases.get(name, name)
+
+    def walk(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and held:
+            return  # nested defs execute later, not under this lock
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = [self._canon(n) for n in
+                        (_lock_name(i) for i in node.items)
+                        if n is not None]
+            for inner in acquired:
+                for outer in held:
+                    if outer != inner:
+                        self.orders.setdefault((outer, inner), node)
+            inner_held = held + tuple(a for a in acquired
+                                      if a not in held)
+            for child in node.body:
+                self.walk(child, inner_held)
+            return
+        if isinstance(node, ast.Call) \
+                and any(_is_guarded(h) for h in held):
+            final = call_final_name(node)
+            if final in BLOCKING_CALLS:
+                guard = next(h for h in held if _is_guarded(h))
+                self.blocking.append((node, final, guard))
+        for child in ast.iter_child_nodes(node):
+            self.walk(child, held)
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "GL107"
+    name = "lock-discipline"
+    severity = Severity.ERROR
+    description = ("no jit/trace/solve/partition/event-I/O while "
+                   "holding a dispatch or solver-cache lock, and one "
+                   "global lock acquisition order")
+
+    def check(self, ctx: LintContext) -> Iterator[Diagnostic]:
+        walker = _LockWalker(_condition_aliases(ctx.tree))
+        walker.walk(ctx.tree, ())
+        for call, final, guard in walker.blocking:
+            yield self.diag(
+                ctx, call,
+                f"{final}() while holding {guard.rsplit('.', 1)[-1]}: "
+                f"blocking work under a dispatch/cache lock convoys "
+                f"every other enqueue/probe in the process behind it; "
+                f"hoist it out (trace outside, double-check on insert)")
+        reported: Set[frozenset] = set()
+        for (outer, inner), node in sorted(
+                walker.orders.items(),
+                key=lambda kv: kv[1].lineno):
+            pair = frozenset((outer, inner))
+            if (inner, outer) in walker.orders and pair not in reported:
+                reported.add(pair)
+                other = walker.orders[(inner, outer)]
+                entries = sorted(
+                    [((outer, inner), node), ((inner, outer), other)],
+                    key=lambda e: e[1].lineno)
+                (o1, i1), first = entries[0]
+                (o2, i2), second = entries[1]
+                yield self.diag(
+                    ctx, second,
+                    f"lock order inversion: {o2.rsplit('.', 1)[-1]} "
+                    f"-> {i2.rsplit('.', 1)[-1]} here but "
+                    f"{o1.rsplit('.', 1)[-1]} -> "
+                    f"{i1.rsplit('.', 1)[-1]} at line {first.lineno}; "
+                    f"two threads interleaving these deadlock")

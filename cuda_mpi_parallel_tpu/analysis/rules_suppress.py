@@ -1,0 +1,59 @@
+"""GL109 stale-suppression: a disable comment must not outlive its bug.
+
+A ``# graftlint: disable=RULE`` earns its keep only while the rule
+would otherwise fire on that line.  Once the underlying code is fixed
+(or drifts), the comment silently becomes a standing exemption: the
+next REAL instance of the bug lands on the same line unseen.  PR 1's
+``mosaic-tiling`` suppressions in ``ops/pallas/resident_dist.py`` are
+the motivating case - each carries a rationale and a revisit
+condition, and this rule is what makes "revisit" enforceable.
+
+Mechanics live in the engine, not here: suppression matching happens
+while OTHER rules run (``SuppressionIndex.suppressed`` records which
+tokens vindicated themselves), so the check is a post-pass over the
+leftover tokens.  ``engine.lint_source`` synthesizes the diagnostics
+after the rule loop; this class exists so GL109 has a catalog row, a
+severity, and select/ignore/suppression handling like any other rule
+(yes - a stale-suppression finding can itself be suppressed, with
+rationale, like anything else).
+
+A token is only reported when this run could have vindicated it: its
+rule actually ran (a ``--select GL102`` run says nothing about a
+``mosaic-tiling`` comment), ``all`` tokens need a full-registry run,
+and tokens naming no registered rule (typos) are always stale.
+Warning tier: a stale suppression is debt, not an active defect.
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+from .core import Diagnostic, LintContext, Rule, Severity, register
+
+
+@register
+class StaleSuppressionRule(Rule):
+    id = "GL109"
+    name = "stale-suppression"
+    severity = Severity.WARNING
+    description = ("a graftlint disable comment whose rule no longer "
+                   "fires there is itself reported")
+
+    def check(self, ctx: LintContext) -> Iterator[Diagnostic]:
+        # Synthesized by engine.lint_source after every other rule has
+        # had the chance to mark the file's suppressions used.
+        return iter(())
+
+    def stale_diag(self, ctx: LintContext, lineno: int,
+                   token: str) -> Diagnostic:
+        class _Anchor:
+            pass
+
+        anchor = _Anchor()
+        anchor.lineno = lineno
+        anchor.col_offset = 0
+        return self.diag(
+            ctx, anchor,
+            f"suppression {token!r} no longer suppresses anything "
+            f"here: the finding it silenced is gone (or the token is "
+            f"misspelled) - delete the comment before it hides the "
+            f"next real instance")

@@ -1,0 +1,461 @@
+"""SPMD contract verification on traced solve bodies (graftverify).
+
+Three whole-trace contracts, each the static form of a bug class this
+codebase has already paid for once:
+
+1. **Replication consistency** - every value feeding a ``while_loop``
+   predicate or a ``cond`` branch selector must be *replicated* across
+   the mesh: psum/pmax/pmin/all_gather-derived or trace-constant,
+   never shard-varying.  A shard-varying predicate desynchronizes the
+   loop trip counts across the mesh (collective mismatch, hang) - the
+   class ``robust/inject.py`` documents for ``reduction`` faults and
+   the reason its shard-gated poisons are only ever applied to values
+   that pass through a psum before reaching control flow.
+
+2. **Mesh-validated collectives** - every collective axis name in the
+   trace must be declared by the actual mesh, and every ``ppermute``
+   permutation endpoint must lie inside the mesh axis it rotates over
+   (``analysis.jaxpr.check_collective_axes`` extended with the real
+   mesh geometry).
+
+3. **Collective budget** - a solve variant (deflated, recycled,
+   flight-on, fault-armed) must issue exactly its baseline lane's
+   per-iteration psum/ppermute/all_gather counts.  PR 13's fused
+   deflation promised this in prose and every test hand-counted it;
+   :func:`verify_collective_budget` is the one named API.
+
+The dataflow walker reuses ``telemetry/cost.py``'s while-body
+traversal shape (while/scan/cond/pjit/shard_map descent) but tracks a
+*varying set* of jaxpr vars instead of op counts: ``shard_map``
+``in_names`` seed varying-ness, collectives that replicate
+(psum/pmax/pmin/all_gather) clear it, ``axis_index`` introduces it,
+everything else propagates it through eqn outputs.  Loop carries
+iterate to a fixpoint, so a value that becomes varying on trip two is
+still caught.
+
+Imports jax lazily (module import is cheap and jax-free); entry
+points trace, never compile or execute.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "BudgetReport",
+    "CollectiveBudgetError",
+    "SpmdFinding",
+    "SpmdReport",
+    "SpmdViolation",
+    "collective_budget",
+    "replication_findings",
+    "verify_collective_budget",
+    "verify_spmd",
+]
+
+#: collectives whose OUTPUT is identical on every shard of the reduced
+#: axis - the edges that launder shard-varying data back to replicated
+REPLICATING_PRIMITIVES = frozenset({
+    "psum", "pmax", "pmin", "all_gather",
+})
+
+#: primitives that INTRODUCE shard-varying values out of nothing
+VARYING_SOURCES = frozenset({
+    "axis_index",
+})
+
+
+class SpmdViolation(ValueError):
+    """A traced solve violates an SPMD contract (see ``findings``)."""
+
+    def __init__(self, findings: Sequence["SpmdFinding"]):
+        self.findings = tuple(findings)
+        lines = "\n".join(f"  - {f.describe()}" for f in self.findings)
+        super().__init__(
+            f"{len(self.findings)} SPMD contract violation(s):\n{lines}")
+
+
+@dataclasses.dataclass(frozen=True)
+class SpmdFinding:
+    """One replication/axis violation, anchored by a jaxpr path."""
+
+    kind: str        # "shard-varying-predicate" | "undeclared-axis" |
+                     # "permutation-out-of-range"
+    where: str       # jaxpr path, e.g. "shard_map/while[0]/cond"
+    message: str
+
+    def describe(self) -> str:
+        return f"[{self.kind}] {self.where}: {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class SpmdReport:
+    """Outcome of :func:`verify_spmd` (``findings`` empty = green)."""
+
+    findings: Tuple[SpmdFinding, ...]
+    axes_used: Tuple[str, ...]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+# --------------------------------------------------------------------------
+# replication-consistency walker
+# --------------------------------------------------------------------------
+
+def _inner(j):
+    return j.jaxpr if hasattr(j, "jaxpr") else j
+
+
+def _is_varying(v, varying) -> bool:
+    """Literals are trace constants; Vars consult the varying set."""
+    return not hasattr(v, "val") and id(v) in varying
+
+
+def _seed(sub, eqn_invars, varying, sub_varying) -> None:
+    """Positional invar mapping from an eqn into its sub-jaxpr."""
+    for outer, inner in zip(eqn_invars, sub.invars):
+        if _is_varying(outer, varying):
+            sub_varying.add(id(inner))
+
+
+def _eval_region(jaxpr, varying, findings, where) -> None:
+    """One forward pass over ``jaxpr``'s eqns, mutating ``varying``
+    (a set of ``id(Var)``) and appending findings."""
+    for i, eqn in enumerate(jaxpr.eqns):
+        name = eqn.primitive.name
+        if name == "while":
+            _eval_while(eqn, varying, findings, f"{where}/while[{i}]")
+        elif name == "cond":
+            _eval_cond(eqn, varying, findings, f"{where}/cond[{i}]")
+        elif name == "scan":
+            _eval_scan(eqn, varying, findings, f"{where}/scan[{i}]")
+        elif "shard_map" in name:
+            _eval_shard_map(eqn, varying, findings,
+                            f"{where}/shard_map[{i}]")
+        elif name in REPLICATING_PRIMITIVES:
+            # replicated across the reduced axis regardless of inputs
+            continue
+        elif name in VARYING_SOURCES:
+            for out in eqn.outvars:
+                varying.add(id(out))
+        else:
+            sub_jaxprs = _call_jaxprs(eqn)
+            if sub_jaxprs:
+                for sub in sub_jaxprs:
+                    sub = _inner(sub)
+                    sub_varying = set()
+                    if len(sub.invars) == len(eqn.invars):
+                        _seed(sub, eqn.invars, varying, sub_varying)
+                    elif any(_is_varying(v, varying)
+                             for v in eqn.invars):
+                        # unknown arg mapping: conservatively varying
+                        sub_varying.update(id(v) for v in sub.invars)
+                    _eval_region(sub, sub_varying, findings,
+                                 f"{where}/{name}[{i}]")
+                    for outer, inner in zip(eqn.outvars, sub.outvars):
+                        if _is_varying(inner, sub_varying):
+                            varying.add(id(outer))
+            elif any(_is_varying(v, varying) for v in eqn.invars):
+                for out in eqn.outvars:
+                    varying.add(id(out))
+
+
+def _call_jaxprs(eqn) -> list:
+    """Sub-jaxprs of call-like primitives (pjit/custom_*/remat/...)."""
+    out = []
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        val = eqn.params.get(key)
+        if val is not None and (hasattr(val, "eqns")
+                                or hasattr(val, "jaxpr")):
+            out.append(val)
+    return out
+
+
+def _eval_while(eqn, varying, findings, where) -> None:
+    cond_j = _inner(eqn.params["cond_jaxpr"])
+    body_j = _inner(eqn.params["body_jaxpr"])
+    cn = int(eqn.params["cond_nconsts"])
+    bn = int(eqn.params["body_nconsts"])
+    cond_consts = eqn.invars[:cn]
+    body_consts = eqn.invars[cn:cn + bn]
+    carry = eqn.invars[cn + bn:]
+    carry_var = [_is_varying(v, varying) for v in carry]
+
+    # fixpoint over the carry: a trip can turn a carried value varying
+    for _ in range(len(carry) + 1):
+        body_varying = set()
+        for outer, inner in zip(body_consts, body_j.invars[:bn]):
+            if _is_varying(outer, varying):
+                body_varying.add(id(inner))
+        for flag, inner in zip(carry_var, body_j.invars[bn:]):
+            if flag:
+                body_varying.add(id(inner))
+        _eval_region(body_j, body_varying, [], f"{where}/body")
+        new = [cv or _is_varying(ov, body_varying)
+               for cv, ov in zip(carry_var, body_j.outvars)]
+        if new == carry_var:
+            break
+        carry_var = new
+
+    # nested control flow inside the body reports its own findings
+    # with the final (fixpoint) carry classification
+    body_varying = set()
+    for outer, inner in zip(body_consts, body_j.invars[:bn]):
+        if _is_varying(outer, varying):
+            body_varying.add(id(inner))
+    for flag, inner in zip(carry_var, body_j.invars[bn:]):
+        if flag:
+            body_varying.add(id(inner))
+    _eval_region(body_j, body_varying, findings, f"{where}/body")
+
+    cond_varying = set()
+    for outer, inner in zip(cond_consts, cond_j.invars[:cn]):
+        if _is_varying(outer, varying):
+            cond_varying.add(id(inner))
+    for flag, inner in zip(carry_var, cond_j.invars[cn:]):
+        if flag:
+            cond_varying.add(id(inner))
+    _eval_region(cond_j, cond_varying, findings, f"{where}/cond")
+    pred = cond_j.outvars[0]
+    if _is_varying(pred, cond_varying):
+        findings.append(SpmdFinding(
+            kind="shard-varying-predicate",
+            where=f"{where}/cond",
+            message="while_loop predicate derives from a "
+                    "shard-varying value (not psum-derived, not "
+                    "trace-constant): trip counts can desynchronize "
+                    "across the mesh"))
+    for flag, out in zip(carry_var, eqn.outvars):
+        if flag:
+            varying.add(id(out))
+
+
+def _eval_cond(eqn, varying, findings, where) -> None:
+    pred = eqn.invars[0]
+    if _is_varying(pred, varying):
+        findings.append(SpmdFinding(
+            kind="shard-varying-predicate",
+            where=where,
+            message="cond branch selector derives from a "
+                    "shard-varying value: shards can take different "
+                    "branches and issue mismatched collectives"))
+    operands = eqn.invars[1:]
+    out_var = [False] * len(eqn.outvars)
+    for branch in eqn.params["branches"]:
+        bj = _inner(branch)
+        b_varying = set()
+        _seed(bj, operands, varying, b_varying)
+        _eval_region(bj, b_varying, findings, f"{where}/branch")
+        for k, ov in enumerate(bj.outvars):
+            if _is_varying(ov, b_varying):
+                out_var[k] = True
+    for flag, out in zip(out_var, eqn.outvars):
+        if flag:
+            varying.add(id(out))
+
+
+def _eval_scan(eqn, varying, findings, where) -> None:
+    sub = _inner(eqn.params["jaxpr"])
+    nc = int(eqn.params["num_consts"])
+    ncar = int(eqn.params["num_carry"])
+    consts = eqn.invars[:nc]
+    carry = eqn.invars[nc:nc + ncar]
+    xs = eqn.invars[nc + ncar:]
+    carry_var = [_is_varying(v, varying) for v in carry]
+    for _ in range(len(carry) + 1):
+        s_varying = set()
+        for outer, inner in zip(consts, sub.invars[:nc]):
+            if _is_varying(outer, varying):
+                s_varying.add(id(inner))
+        for flag, inner in zip(carry_var, sub.invars[nc:nc + ncar]):
+            if flag:
+                s_varying.add(id(inner))
+        for outer, inner in zip(xs, sub.invars[nc + ncar:]):
+            if _is_varying(outer, varying):
+                s_varying.add(id(inner))
+        _eval_region(sub, s_varying, [], f"{where}/body")
+        new = [cv or _is_varying(ov, s_varying)
+               for cv, ov in zip(carry_var, sub.outvars[:ncar])]
+        if new == carry_var:
+            break
+        carry_var = new
+    s_varying = set()
+    for outer, inner in zip(consts, sub.invars[:nc]):
+        if _is_varying(outer, varying):
+            s_varying.add(id(inner))
+    for flag, inner in zip(carry_var, sub.invars[nc:nc + ncar]):
+        if flag:
+            s_varying.add(id(inner))
+    for outer, inner in zip(xs, sub.invars[nc + ncar:]):
+        if _is_varying(outer, varying):
+            s_varying.add(id(inner))
+    _eval_region(sub, s_varying, findings, f"{where}/body")
+    for k, out in enumerate(eqn.outvars):
+        if k < ncar:
+            if carry_var[k]:
+                varying.add(id(out))
+        elif _is_varying(sub.outvars[k], s_varying):
+            varying.add(id(out))
+
+
+def _eval_shard_map(eqn, varying, findings, where) -> None:
+    """The seeding point: ``in_names`` says which inputs are sharded
+    over a mesh axis (varying) vs replicated (empty names dict)."""
+    sub = _inner(eqn.params["jaxpr"])
+    in_names = eqn.params.get("in_names", ())
+    sub_varying = set()
+    for k, inner in enumerate(sub.invars):
+        names = in_names[k] if k < len(in_names) else {0: ("?",)}
+        sharded = bool(names)
+        outer_var = (k < len(eqn.invars)
+                     and _is_varying(eqn.invars[k], varying))
+        if sharded or outer_var:
+            sub_varying.add(id(inner))
+    _eval_region(sub, sub_varying, findings, where)
+    out_names = eqn.params.get("out_names", ())
+    for k, out in enumerate(eqn.outvars):
+        names = out_names[k] if k < len(out_names) else {}
+        if names and k < len(sub.outvars) \
+                and _is_varying(sub.outvars[k], sub_varying):
+            varying.add(id(out))
+
+
+def replication_findings(jaxpr) -> List[SpmdFinding]:
+    """Replication-consistency findings of a (closed) jaxpr: every
+    ``while`` predicate / ``cond`` selector that derives from a
+    shard-varying value.  Values are shard-varying when seeded by
+    ``shard_map`` ``in_names`` or produced by ``axis_index``, and
+    laundered back to replicated only by psum/pmax/pmin/all_gather."""
+    j = _inner(jaxpr)
+    findings: List[SpmdFinding] = []
+    _eval_region(j, set(), findings, "jaxpr")
+    return findings
+
+
+# --------------------------------------------------------------------------
+# whole-trace verification
+# --------------------------------------------------------------------------
+
+def verify_spmd(fn: Callable, *args, mesh=None, **kwargs) -> SpmdReport:
+    """Trace ``fn(*args, **kwargs)`` (abstract eval only - no compile,
+    no run) and verify the SPMD contracts: replication-consistent
+    control flow, and (when ``mesh`` is given) collective axes/
+    permutations validated against the actual mesh geometry.
+
+    Returns an :class:`SpmdReport`; raises :class:`SpmdViolation` on
+    any finding.
+    """
+    import jax
+
+    from .jaxpr import collective_axes, mesh_collective_findings
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    findings = replication_findings(closed)
+    if mesh is not None:
+        findings.extend(
+            SpmdFinding(kind=kind, where="jaxpr", message=msg)
+            for kind, msg in mesh_collective_findings(closed, mesh))
+    report = SpmdReport(findings=tuple(findings),
+                        axes_used=tuple(sorted(collective_axes(closed))))
+    if findings:
+        raise SpmdViolation(findings)
+    return report
+
+
+# --------------------------------------------------------------------------
+# collective budget
+# --------------------------------------------------------------------------
+
+#: the per-iteration collective inventory a lane variant must preserve
+BUDGET_OPS = ("psum", "ppermute", "all_gather")
+
+
+class CollectiveBudgetError(AssertionError):
+    """A solve variant's per-iteration collective counts differ from
+    its baseline lane's."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BudgetReport:
+    """Per-iteration collective inventory of variant vs baseline."""
+
+    variant: "object"     # telemetry.cost.OpCounts
+    baseline: "object"    # telemetry.cost.OpCounts
+    ops: Tuple[str, ...]
+
+    def deltas(self) -> dict:
+        return {op: self.variant.get(op) - self.baseline.get(op)
+                for op in self.ops}
+
+    @property
+    def ok(self) -> bool:
+        return all(d == 0 for d in self.deltas().values())
+
+
+def collective_budget(fn):
+    """Per-iteration cost of one distributed dispatch.
+
+    ``fn`` is either a zero-arg callable that dispatches a solve
+    through ``parallel.dist_cg``'s compiled-solver cache (the cost is
+    captured from ``dist_cg.last_comm_cost`` under forced telemetry -
+    an extra abstract trace at most, never an extra compile), or an
+    already-derived ``telemetry.cost.SolveCost``.
+    """
+    from ..telemetry.cost import SolveCost
+
+    if isinstance(fn, SolveCost):
+        return fn
+    if not callable(fn):
+        raise TypeError(
+            f"expected a zero-arg dispatch callable or a SolveCost, "
+            f"got {type(fn).__name__}")
+    from .. import telemetry
+    from ..parallel import dist_cg
+
+    prev = telemetry._FORCED[0]
+    telemetry.force_active(True)
+    try:
+        dist_cg.reset_last_comm_cost()
+        fn()
+        got = dist_cg.last_comm_cost()
+    finally:
+        telemetry.force_active(prev)
+    if got is None:
+        raise ValueError(
+            "dispatch did not route through the distributed solver "
+            "cache (no comm cost captured): collective_budget measures "
+            "solve_distributed/ManyRHSDispatcher dispatches")
+    return got[0]
+
+
+def verify_collective_budget(fn_variant, fn_baseline, *,
+                             ops: Iterable[str] = BUDGET_OPS,
+                             what: Optional[str] = None) -> BudgetReport:
+    """Assert a lane variant keeps its baseline's per-iteration
+    collective counts.
+
+    The named form of the contract PR 13 asserted by hand per test:
+    the deflated (``deflate=``), recycled, flight-on and fault-armed
+    lanes each issue exactly the baseline lane's per-iteration
+    psum/ppermute/all_gather inventory (extra projection work rides
+    existing reductions, never adds one).  Both arguments take a
+    zero-arg dispatch callable or a precomputed
+    ``telemetry.cost.SolveCost``.  Returns the :class:`BudgetReport`;
+    raises :class:`CollectiveBudgetError` listing every op whose count
+    drifted.
+    """
+    ops = tuple(ops)
+    variant = collective_budget(fn_variant).per_iteration
+    baseline = collective_budget(fn_baseline).per_iteration
+    report = BudgetReport(variant=variant, baseline=baseline, ops=ops)
+    if not report.ok:
+        label = f" ({what})" if what else ""
+        drift = ", ".join(
+            f"{op}: variant={report.variant.get(op)} "
+            f"baseline={report.baseline.get(op)}"
+            for op, d in report.deltas().items() if d != 0)
+        raise CollectiveBudgetError(
+            f"per-iteration collective budget violated{label}: {drift}")
+    return report

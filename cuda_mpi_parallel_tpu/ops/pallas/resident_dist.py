@@ -199,6 +199,12 @@ def _resident_dist_kernel(nblocks, check_every, n_shards, axis_name,
         # the 8-row-slot redesign (buffer (8*n_shards, 128), row
         # my_id*8) is compile-verified on >= 2 real chips; graftlint's
         # mosaic-tiling rule exists to keep NEW code off this pattern.
+        # Re-audited 2026-08-06 (graftverify, ISSUE 16): the 8-row-slot
+        # redesign has STILL not landed - no hardware time has been
+        # spent on this kernel since round 5, so the suppression and
+        # its revisit condition stand unchanged.  GL109 now watches
+        # these two disables: if the slicing below is ever fixed, the
+        # then-stale comments fail the lint gate instead of lingering.
         dmas = []
         for step in range(1, n_shards):
             tgt = lax.rem(my_id + jnp.int32(step), ns)

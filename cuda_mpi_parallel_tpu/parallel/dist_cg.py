@@ -483,6 +483,34 @@ def reset_last_comm_cost() -> None:
     _LAST_COMM_COST[0] = None
 
 
+def cache_key_parts(kind: str, **parts):
+    """Canonical compiled-solver cache key: ``(kind, ("field", value),
+    ...)`` with fields sorted by name and ``None``-valued fields
+    DROPPED.
+
+    Every static input that changes the traced jaxpr MUST appear as a
+    named part - that is the soundness contract ``analysis.cachekey``
+    audits differentially (perturb a static, assert the key moves with
+    the trace) and graftlint GL106 checks statically (a ``build``
+    closure consuming a static the key never references).  Naming the
+    parts is what makes both audits possible: a positional tuple can
+    omit a field invisibly, a named part cannot.
+
+    Dropping ``None`` parts keeps lane-absence semantics: a solve that
+    never threads a lane (no deflate, no resumable extras) keeps the
+    exact key it had before the lane existed, so its compiled
+    executable survives lane additions.  Optional per-dispatch suffix
+    parts are appended by the call sites as the same ``("field",
+    value)`` pairs (``key + (("deflate", k),)``), preserving the
+    prefix-match contract the serve tier's eviction listener relies on
+    (``ManyRHSDispatcher._key_base`` is a strict prefix of every
+    per-dispatch key).
+    """
+    return (kind,) + tuple(
+        (name, value) for name, value in sorted(parts.items())
+        if value is not None)
+
+
 def _key_id(key) -> str:
     """Short stable digest of a cache key for event payloads (the key
     itself holds Mesh objects and is not JSON)."""
@@ -775,9 +803,11 @@ def _solve_pencil(a, b, mesh, precond, record_history, kw) -> CGResult:
     out = dataclasses.replace(
         _result_specs(None, record_history, kw.get("flight")),
         x=P(ax_x, ax_y))
-    key = ("pencil", local.local_grid, local.shards, local._dtype_name,
-           (ax_x, ax_y), mesh, precond, record_history,
-           tuple(sorted(kw.items())))
+    key = cache_key_parts(
+        "pencil", local_grid=local.local_grid, shards=local.shards,
+        dtype=local._dtype_name, axes=(ax_x, ax_y), mesh=mesh,
+        precond=precond, record_history=record_history,
+        solver_kw=tuple(sorted(kw.items())))
 
     def build():
         @partial(shard_map, mesh=mesh, in_specs=(P(ax_x, ax_y), P()),
@@ -817,9 +847,12 @@ def _solve_stencil(a, b, mesh, axis, n_shards, precond, record_history,
         kind="stencil2d" if two_d else "stencil3d"))
 
     b = shard_vector(jnp.asarray(b, a.dtype), mesh, axis)
-    key = ("stencil", type(local).__name__, local.local_grid,
-           local.backend, local._dtype_name, axis, mesh, precond,
-           record_history, tuple(sorted(kw.items())))
+    key = cache_key_parts(
+        "stencil", operator=type(local).__name__,
+        local_grid=local.local_grid, backend=local.backend,
+        dtype=local._dtype_name, axis=axis, mesh=mesh, precond=precond,
+        record_history=record_history,
+        solver_kw=tuple(sorted(kw.items())))
 
     def build():
         @partial(shard_map, mesh=mesh, in_specs=(P(axis), P()),
@@ -953,9 +986,12 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
     # under a different plan's coupling compiles a different schedule
     geometry = tuple((r.shift, r.m) for r in sched.rounds) \
         if gather else None
-    key = ("csr", ring, resolved, geometry, n_local, n_shards, axis,
-           mesh, precond, record_history, tuple(sorted(kw.items())),
-           plan.fingerprint() if plan is not None else None)
+    key = cache_key_parts(
+        "csr", ring=ring, exchange=resolved, geometry=geometry,
+        n_local=n_local, n_shards=n_shards, axis=axis, mesh=mesh,
+        precond=precond, record_history=record_history,
+        solver_kw=tuple(sorted(kw.items())),
+        plan=plan.fingerprint() if plan is not None else None)
     if deflate is not None:
         # the executable depends on the space's SHAPE only - a
         # refreshed same-k space reuses the compiled deflated solver
@@ -964,8 +1000,8 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
         # the extended build below has a different signature/out tree;
         # an un-extended call keeps its pre-extension key (and hence
         # its compiled executable) byte-for-byte
-        key = key + (("resumable", has_x0, has_resume,
-                      return_checkpoint, has_cap),)
+        key = key + (("resumable", (has_x0, has_resume,
+                                    return_checkpoint, has_cap)),)
     send = tuple(_shard_tree(r.send_idx, mesh, axis)
                  for r in sched.rounds) if gather else ()
     shifts = tuple(r.shift for r in sched.rounds) if gather else ()
@@ -1115,10 +1151,12 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
 
     n_local = parts.n_local
     chunk_shape = tuple(v.shape[1] for v in parts.vals)
-    key = ("csr-shiftell", n_local, n_shards, parts.h, parts.kc,
-           chunk_shape, axis, mesh, precond, record_history,
-           tuple(sorted(kw.items())),
-           plan.fingerprint() if plan is not None else None)
+    key = cache_key_parts(
+        "csr-shiftell", n_local=n_local, n_shards=n_shards,
+        h=parts.h, kc=parts.kc, chunk_shape=chunk_shape, axis=axis,
+        mesh=mesh, precond=precond, record_history=record_history,
+        solver_kw=tuple(sorted(kw.items())),
+        plan=plan.fingerprint() if plan is not None else None)
 
     def build():
         # check_vma=False: the pallas slab kernel cannot declare varying
@@ -1308,13 +1346,20 @@ class ManyRHSDispatcher:
         geometry = tuple((r.shift, r.m) for r in sched.rounds) \
             if self._gather else None
         # everything but n_rhs: the per-bucket key appends it in solve
-        self._key_base = (
-            "csr-many", method, self.resolved_exchange, geometry,
-            self.parts.n_local, self.n_shards, self.axis, mesh,
-            preconditioner, self.check_every, self.compensated,
-            flight, self.maxiter,
-            self.plan.fingerprint() if self.plan is not None else None,
-        ) + ((inject,) if inject is not None else ())
+        # (cache_key_parts drops None-valued lanes, so _key_base stays
+        # a strict PREFIX of every dispatch key - what the serve
+        # tier's eviction listener prefix-matches on)
+        self._key_base = cache_key_parts(
+            "csr-many", method=method,
+            exchange=self.resolved_exchange, geometry=geometry,
+            n_local=self.parts.n_local, n_shards=self.n_shards,
+            axis=self.axis, mesh=mesh, precond=preconditioner,
+            check_every=self.check_every,
+            compensated=self.compensated, flight=flight,
+            maxiter=self.maxiter,
+            plan=(self.plan.fingerprint()
+                  if self.plan is not None else None),
+            fault=inject)
 
     def space_layout_token(self) -> str:
         """The ``recycle.space_layout`` token of the operator this
@@ -1436,7 +1481,7 @@ class ManyRHSDispatcher:
         maxiter, check_every = self.maxiter, self.check_every
         compensated = self.compensated
         fault = self.inject
-        key = self._key_base + (n_rhs,)
+        key = self._key_base + (("n_rhs", n_rhs),)
         if flight_override:
             key = key + (("flight_override", flight),)
         if basis is not None:

@@ -1,0 +1,47 @@
+"""Known-bad: compiled-solver cache keys missing a static the build
+closure consumes (GL106 cache-key).
+
+The seeded hole mirrors the real bug class: ``flight`` configures the
+traced program (its stride is baked into the compiled loop) but the
+key tuple never mentions it, so a flight-on caller after a flight-off
+caller silently gets the flight-off compiled solver from the cache.
+"""
+
+_SOLVER_CACHE = {}
+
+
+def _cached_solver(key, build):
+    fn = _SOLVER_CACHE.get(key)
+    if fn is None:
+        fn = _SOLVER_CACHE[key] = build()
+    return fn
+
+
+def solve_toy(local_grid, axis, precond, flight):
+    key = ("toy", local_grid, axis, precond)
+
+    def build():
+        def run(x):
+            stride = flight.stride if flight is not None else 0
+            return x * local_grid + precond + stride
+
+        return run
+
+    return _cached_solver(key, build)  # gl-expect: cache-key
+
+
+def solve_two_holes(n_local, method, check_every, fault):
+    # two statics missing from one key: still one marked line (the
+    # dispatch site), but the rule names each omission
+    key = ("toy2", n_local)
+
+    def build():
+        def run(x):
+            y = x + check_every
+            if fault is not None:
+                y = y + fault.iteration
+            return y
+
+        return run
+
+    return _cached_solver(key, build)  # gl-expect: cache-key

@@ -1,0 +1,16 @@
+"""Known-bad: event emissions that runtime validation only catches
+once a trace sink is configured (GL108 event-schema).
+
+With tracing off, ``emit`` returns before validating - so a
+misspelled type or a dropped required field ships silently and
+crashes the first ``--trace-events`` run."""
+from cuda_mpi_parallel_tpu.telemetry import events
+
+
+def report(key, hit, n):
+    events.emit("dist_cache_hitt", key=key)  # gl-expect: event-schema
+    events.emit("dist_cache_hit")  # gl-expect: event-schema
+    events.emit(  # gl-expect: event-schema
+        "batch_dispatch", handle="h", bucket=n)
+    events.emit(("solve_start"  # gl-expect: event-schema
+                 if hit else "solve_stat"), label="x")

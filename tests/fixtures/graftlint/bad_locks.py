@@ -1,0 +1,49 @@
+"""Known-bad: blocking work under dispatch/cache locks and a lock
+order inversion (GL107 lock-discipline).
+
+Each seeded violation is a de-anonymized version of a race the serve
+layer was reviewed OUT of: tracing inside the solver-cache lock (the
+LRU-eviction convoy), dispatching a solve while holding the batch
+lock, event-file I/O in a critical section, and the two-path
+dispatch/state lock inversion."""
+import threading
+
+import jax
+
+_CACHE_LOCK = threading.Lock()
+_SOLVER_CACHE = {}
+
+
+def cached_solver_traced_under_lock(key, build):
+    with _CACHE_LOCK:
+        fn = _SOLVER_CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(build())  # gl-expect: lock-discipline
+            _SOLVER_CACHE[key] = fn
+        return fn
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._dispatch_lock = threading.Lock()
+
+    def step(self, batch):
+        with self._dispatch_lock:
+            res = solve_distributed_many(  # gl-expect: lock-discipline
+                batch.a, batch.b)
+            events.emit("batch_dispatch",  # gl-expect: lock-discipline
+                        handle=batch.handle, bucket=len(batch.b),
+                        n_requests=len(batch.b), reason="full")
+        return res
+
+    def migrate(self, handle):
+        with self._dispatch_lock:
+            with self._lock:
+                self._handles[handle.key] = handle
+
+    def snapshot(self):
+        with self._lock:
+            with self._dispatch_lock:  # gl-expect: lock-discipline
+                return dict(self._handles)

@@ -1,0 +1,26 @@
+"""Known-bad: suppressions that outlived their bugs (GL109
+stale-suppression).
+
+The first disable once silenced a real mosaic-tiling finding; the
+slicing was fixed but the comment stayed - a standing exemption on
+that line.  The second names a rule that never existed (a typo'd
+token protects nothing).  Both are flagged at the comment, so the
+cleanup is mechanical."""
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROW_SLOT = 8
+
+
+def healthy_copy(buf, send, recv, tgt):
+    # graftlint: disable=mosaic-tiling  # gl-expect: stale-suppression
+    dma = pltpu.make_async_remote_copy(
+        buf.at[pl.ds(0, ROW_SLOT)],
+        buf.at[pl.ds(0, ROW_SLOT)],
+        send, recv, device_id=tgt)
+    dma.start()
+    dma.wait()
+
+
+def typo(x):
+    return x + 1  # graftlint: disable=mosiac-tiling  # gl-expect: stale-suppression

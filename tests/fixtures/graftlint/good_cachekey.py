@@ -1,0 +1,79 @@
+"""Known-good twin of bad_cachekey: every static the build closes
+over reaches the cache key - directly, through a derived local
+(``gather`` inherits soundness from the keyed ``resolved``), through a
+keyed ``self._key_base`` prefix, or through a conditional suffix
+append (the deflate/resumable lane idiom)."""
+
+_SOLVER_CACHE = {}
+
+
+def _cached_solver(key, build):
+    fn = _SOLVER_CACHE.get(key)
+    if fn is None:
+        fn = _SOLVER_CACHE[key] = build()
+    return fn
+
+
+def cache_key_parts(kind, **parts):
+    return (kind,) + tuple(sorted(
+        (n, v) for n, v in parts.items() if v is not None))
+
+
+def solve_toy(local_grid, axis, precond, flight):
+    key = cache_key_parts("toy", local_grid=local_grid, axis=axis,
+                          precond=precond, flight=flight)
+
+    def build():
+        def run(x):
+            stride = flight.stride if flight is not None else 0
+            return x * local_grid + precond + stride
+
+        return run
+
+    return _cached_solver(key, build)
+
+
+def solve_derived(exchange, n_local, deflate):
+    # forward derivation: ``gather`` is computed FROM the keyed
+    # ``resolved``, so the build consuming it is covered
+    resolved = "gather" if exchange in (None, "auto") else exchange
+    key = cache_key_parts("toy", resolved=resolved, n_local=n_local)
+    if deflate is not None:
+        key = key + (("deflate", int(deflate.k)),)
+        space_k = int(deflate.k)
+
+    def build():
+        from math import sqrt
+
+        def run(x):
+            y = x * sqrt(n_local)
+            if resolved == "gather":
+                y = y + 1
+            if deflate is not None:
+                y = y + space_k
+            return y
+
+        return run
+
+    return _cached_solver(key, build)
+
+
+class Dispatcher:
+    def __init__(self, method, check_every):
+        self._key_base = cache_key_parts(
+            "many", method=method, check_every=check_every)
+        self.method = method
+        self.check_every = check_every
+
+    def solve(self, b):
+        n_rhs = int(b.shape[1])
+        key = self._key_base + (("n_rhs", n_rhs),)
+        method, check_every = self.method, self.check_every
+
+        def build():
+            def run(x):
+                return x + check_every + (1 if method == "block" else 0)
+
+            return run
+
+        return _cached_solver(key, build)(b)

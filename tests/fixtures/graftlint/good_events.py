@@ -1,0 +1,18 @@
+"""Known-good twin of bad_events: schema'd types with their required
+floor spelled as literal keywords, the conditional-type idiom over
+two valid names, splatted payloads (membership-checked only), and
+dynamic forwarding (runtime validation's territory)."""
+from cuda_mpi_parallel_tpu.telemetry import events
+
+
+def report(key, hit, payload, event_type):
+    events.emit("dist_cache_hit" if hit else "dist_cache_miss",
+                key=key)
+    events.emit("batch_dispatch", handle="h", bucket=4, n_requests=3,
+                reason="full")
+    events.emit("solve_start", label="poisson2d", extra="fine")
+    # **payload makes the field floor unknowable statically: the
+    # membership check still guards the type name
+    events.emit("shard_profile", **payload)
+    # dynamic type: forwarded wrappers re-validate at runtime
+    events.emit(event_type, **payload)

@@ -1,0 +1,59 @@
+"""Known-good twin of bad_locks: the shipped discipline.
+
+Trace OUTSIDE the cache lock with a double-checked insert, dispatch
+and emit after the critical section, only attribute swaps under the
+nested dispatch->state locks, and every nesting in ONE global order
+(Condition(self._lock) nests with its own lock - an alias, not an
+ordering edge)."""
+import threading
+
+import jax
+
+_CACHE_LOCK = threading.Lock()
+_SOLVER_CACHE = {}
+
+
+def cached_solver(key, build):
+    with _CACHE_LOCK:
+        fn = _SOLVER_CACHE.get(key)
+    if fn is not None:
+        return fn
+    fn = jax.jit(build())  # traced with the lock RELEASED
+    with _CACHE_LOCK:
+        cur = _SOLVER_CACHE.get(key)
+        if cur is None:
+            _SOLVER_CACHE[key] = cur = fn
+    events.emit("dist_cache_miss", key=str(key))
+    return cur
+
+
+class Service:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._dispatch_lock = threading.Lock()
+
+    def step(self, batch):
+        with self._dispatch_lock:
+            plan = self._pop_ready(batch)
+        res = solve_distributed_many(plan.a, plan.b)
+        events.emit("batch_dispatch", handle=plan.handle,
+                    bucket=len(plan.b), n_requests=len(plan.b),
+                    reason="full")
+        return res
+
+    def migrate(self, handle):
+        with self._dispatch_lock:
+            with self._lock:
+                self._handles[handle.key] = handle
+
+    def publish(self, handle):
+        # same global order as migrate: dispatch -> state
+        with self._dispatch_lock:
+            with self._lock:
+                self._latest = handle.key
+
+    def wait_idle(self):
+        with self._cond:
+            with self._lock:  # reentry via the Condition alias
+                return len(self._handles)

@@ -1,0 +1,18 @@
+"""Known-good twin of bad_stale: a load-bearing suppression.
+
+The 1-row RDMA below genuinely trips mosaic-tiling (it is the round-5
+pattern), and the disable comments still suppress it - so GL109 stays
+silent: the tokens vindicated themselves this run."""
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def legacy_allreduce_row(buf, send, recv, my_id, tgt):
+    # KNOWN hazard, suppressed with a revisit condition (see
+    # ops/pallas/resident_dist.py for the real instance + rationale)
+    dma = pltpu.make_async_remote_copy(
+        buf.at[pl.ds(my_id, 1)],  # graftlint: disable=mosaic-tiling
+        buf.at[pl.ds(my_id, 1)],  # graftlint: disable=mosaic-tiling
+        send, recv, device_id=tgt)
+    dma.start()
+    dma.wait()

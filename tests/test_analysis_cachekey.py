@@ -1,0 +1,203 @@
+"""graftverify cache-key soundness audit (analysis.cachekey, ISSUE 16).
+
+The differential contract: perturbing a static argument that changes
+the traced solve body MUST change the solver-cache key - same key +
+different jaxpr means a second caller silently reuses the wrong
+compiled solver.  Tested three ways: (1) toy ``_cached_solver``
+dispatches with a DELIBERATELY unsound key (a static kwarg omitted)
+are caught by name via :class:`CacheKeyAuditError`, and the sound /
+over-keyed twins classify correctly; (2) the audit's own guard rails -
+base-determinism re-probe, no-cache-consult and missing-example-args
+errors, recorder restoration; (3) the shipped surfaces -
+``solve_distributed`` across every static lane and
+``ManyRHSDispatcher`` constructor + per-dispatch suffix lanes - audit
+green on a mesh-4 CSR system, trace-only (no compile, no device run).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu.analysis import (
+    CacheKeyAuditError,
+    audit_dispatches,
+    audit_many_rhs,
+    audit_solve_distributed,
+    probe_dispatch,
+    record_dispatch,
+)
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.parallel import dist_cg, make_mesh
+from cuda_mpi_parallel_tpu.utils import compat
+
+needs_mesh = pytest.mark.skipif(
+    not compat.has_shard_map() or len(jax.devices()) < 4,
+    reason="needs shard_map and >= 4 (virtual) devices")
+
+
+def _toy_dispatch(key, scale):
+    """A dispatch through the real ``dist_cg._cached_solver`` choke
+    point whose build bakes the static ``scale`` into the trace.  The
+    caller decides whether ``scale`` makes it into the key - the audit
+    must notice when it does not."""
+    def build():
+        return lambda x: x * scale
+
+    return lambda: dist_cg._cached_solver(
+        key, build, None, (jnp.ones(4),))
+
+
+class TestToySeededViolations:
+    """ISSUE satellite: a static kwarg omitted from a toy cache key is
+    caught by name."""
+
+    def test_omitted_static_caught_by_name(self):
+        base = _toy_dispatch(("toy",), scale=2.0)
+        # scale changed the program; the key did not - unsound
+        broken = {"scale_omitted": _toy_dispatch(("toy",), scale=3.0)}
+        with pytest.raises(CacheKeyAuditError) as exc:
+            audit_dispatches(base, broken)
+        msg = str(exc.value)
+        assert "scale_omitted" in msg
+        assert "wrong compiled solver" in msg
+
+    def test_sound_key_is_green(self):
+        base = _toy_dispatch(("toy", ("scale", 2.0)), scale=2.0)
+        report = audit_dispatches(base, {
+            "scale": _toy_dispatch(("toy", ("scale", 3.0)), scale=3.0),
+        })
+        assert report.ok
+        case, = report.cases
+        assert case.key_changed and case.jaxpr_changed
+        assert not case.unsound and not case.over_keyed
+
+    def test_over_keyed_recorded_not_flagged(self):
+        """Key moved, program identical: a wasted compile slot, never a
+        correctness finding."""
+        base = _toy_dispatch(("toy", ("pad", 0)), scale=2.0)
+        report = audit_dispatches(base, {
+            "pad": _toy_dispatch(("toy", ("pad", 1)), scale=2.0),
+        })
+        assert report.ok
+        case, = report.cases
+        assert case.over_keyed and not case.unsound
+
+    def test_check_false_returns_report(self):
+        base = _toy_dispatch(("toy",), scale=2.0)
+        report = audit_dispatches(
+            base, {"scale_omitted": _toy_dispatch(("toy",), scale=3.0)},
+            check=False)
+        assert not report.ok
+        assert [c.name for c in report.unsound] == ["scale_omitted"]
+        assert "UNSOUND" in report.describe()
+
+
+class TestAuditGuardRails:
+    def test_base_nondeterminism_rejected(self):
+        """An unstable base key would let every case pass vacuously;
+        the re-probe refuses to audit against noise."""
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            return dist_cg._cached_solver(
+                ("toy", ("nonce", calls[0])),
+                lambda: (lambda x: x * 2.0), None, (jnp.ones(4),))
+
+        with pytest.raises(RuntimeError, match="not deterministic"):
+            audit_dispatches(flaky, {})
+
+    def test_dispatch_must_consult_the_cache(self):
+        with pytest.raises(RuntimeError, match="without consulting"):
+            probe_dispatch(lambda: None)
+
+    def test_dispatch_must_carry_example_args(self):
+        """A ``_cached_solver`` call without cost_args cannot be traced
+        differentially - loud refusal, not a silent pass."""
+        with pytest.raises(RuntimeError, match="example args"):
+            probe_dispatch(lambda: dist_cg._cached_solver(
+                ("toy",), lambda: (lambda x: x), None, None))
+
+    def test_recorder_always_restored(self):
+        original = dist_cg._cached_solver
+        probe_dispatch(_toy_dispatch(("toy",), scale=2.0))
+        assert dist_cg._cached_solver is original
+        with pytest.raises(RuntimeError):
+            with record_dispatch():
+                raise RuntimeError("caller explodes mid-audit")
+        assert dist_cg._cached_solver is original
+
+    def test_probe_never_compiles(self):
+        """The probe aborts at the cache boundary: the key it reports
+        is exactly what would have been cached, and nothing was."""
+        before = dict(dist_cg._SOLVER_CACHE) \
+            if hasattr(dist_cg, "_SOLVER_CACHE") else None
+        probe = probe_dispatch(
+            _toy_dispatch(("toy", ("scale", 2.0)), scale=2.0))
+        assert probe.key == ("toy", ("scale", 2.0))
+        assert len(probe.jaxpr_digest) == 40  # sha1 hex
+        assert probe.args[0].shape == (4,)
+        if before is not None:
+            assert dict(dist_cg._SOLVER_CACHE) == before
+
+
+@needs_mesh
+class TestShippedSurfaces:
+    """The shipped keys are sound: every static lane of both dispatch
+    surfaces moves the key whenever it moves the program."""
+
+    def _system(self):
+        a = poisson.poisson_2d_csr(10, 10)
+        rng = np.random.default_rng(2)
+        return a, rng.standard_normal(int(a.shape[0]))
+
+    def test_solve_distributed_key_sound(self):
+        a, b = self._system()
+        report = audit_solve_distributed(a, b, make_mesh(4))
+        assert report.ok
+        names = {c.name for c in report.cases}
+        assert {"method", "check_every", "preconditioner", "maxiter",
+                "exchange", "plan_fingerprint", "flight", "fault",
+                "deflate_k", "resumable"} <= names
+        # every shipped perturbation is load-bearing: it changes the
+        # program AND the key (none vacuous, none over-keyed)
+        assert all(c.key_changed and c.jaxpr_changed
+                   for c in report.cases), report.describe()
+
+    def test_many_rhs_key_sound(self):
+        a, b = self._system()
+        b_stack = np.stack([b, 2 * b, 3 * b, 4 * b], axis=1)
+        report = audit_many_rhs(a, b_stack, make_mesh(4))
+        assert report.ok
+        names = {c.name for c in report.cases}
+        assert {"method", "compensated", "n_rhs", "flight_override",
+                "deflate_k"} <= names
+        assert all(c.key_changed and c.jaxpr_changed
+                   for c in report.cases), report.describe()
+
+    def test_seeded_regression_on_real_surface(self):
+        """Simulate the historical bug on the real lane: a perturbation
+        the caller KNOWS changes the program, dispatched so the key
+        stays at baseline.  The differential audit - with no list of
+        what the key should contain - still catches it."""
+        from cuda_mpi_parallel_tpu.parallel import solve_distributed
+
+        a, b = self._system()
+        mesh = make_mesh(4)
+        base = lambda: solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                         maxiter=300)
+        ref = probe_dispatch(base)
+
+        def impostor():
+            # trace the jacobi-preconditioned body, then dispatch it
+            # under the BASELINE key - the pre-PR-16 failure shape
+            probe = probe_dispatch(
+                lambda: solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                          maxiter=300,
+                                          preconditioner="jacobi"))
+            return dist_cg._cached_solver(ref.key, probe.build, None,
+                                          probe.args)
+
+        with pytest.raises(CacheKeyAuditError) as exc:
+            audit_dispatches(base, {"preconditioner_unkeyed": impostor})
+        assert "preconditioner_unkeyed" in str(exc.value)

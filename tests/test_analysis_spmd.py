@@ -1,0 +1,367 @@
+"""graftverify SPMD contracts (analysis.spmd + analysis.jaxpr, ISSUE 16).
+
+Three whole-trace contracts, each tested against a seeded violation AND
+the healthy twin: (1) replication consistency - a ``while_loop``
+predicate or ``cond`` selector fed by a shard-varying value (a local
+residual norm whose psum was dropped, an ``axis_index`` leak) is caught
+by name as ``shard-varying-predicate``, while the psum-laundered and
+trace-constant forms verify green; (2) mesh-validated collectives -
+undeclared axis names and ``ppermute`` endpoints outside the actual
+mesh (the elastic-migration seam: a ring schedule built for mesh-4
+replayed on mesh-2) are caught; (3) the collective budget - the named
+:func:`verify_collective_budget` API holds on an identical lane and
+raises :class:`CollectiveBudgetError` on a lane that genuinely changes
+the per-iteration inventory (ring vs allgather exchange).
+
+The shipped mesh-4 CSR lanes (allgather/gather/ring exchange, deflated,
+fault-armed) are verified green end-to-end by tracing the EXACT build
+the solver cache would compile, captured via the cache-key audit's
+dispatch probe - trace-only, no compile, no device run.
+"""
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu.analysis import (
+    CollectiveBudgetError,
+    SpmdViolation,
+    collective_budget,
+    mesh_collective_findings,
+    replication_findings,
+    verify_collective_budget,
+    verify_spmd,
+)
+from cuda_mpi_parallel_tpu.analysis.cachekey import (
+    _synthetic_space,
+    probe_dispatch,
+)
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.parallel import make_mesh, solve_distributed
+from cuda_mpi_parallel_tpu.robust.inject import FaultPlan
+from cuda_mpi_parallel_tpu.utils import compat
+
+needs_mesh = pytest.mark.skipif(
+    not compat.has_shard_map() or len(jax.devices()) < 4,
+    reason="needs shard_map and >= 4 (virtual) devices")
+
+P = jax.sharding.PartitionSpec
+
+AXIS = "rows"
+
+
+def _sharded(fn, mesh, out_specs=None):
+    """shard_map over the 1-D rows mesh, replication check off (the
+    seeded-bad bodies are exactly what the checker would reject)."""
+    return compat.shard_map(fn, mesh=mesh, in_specs=P(AXIS),
+                            out_specs=(out_specs if out_specs is not None
+                                       else P(AXIS)),
+                            check_vma=False)
+
+
+def _fake_mesh(axes):
+    """Anything with ``axis_names`` and ``shape`` validates - the
+    elastic seam replays a traced schedule against a DIFFERENT mesh."""
+    return types.SimpleNamespace(axis_names=tuple(n for n, _ in axes),
+                                 shape=dict(axes))
+
+
+class TestReplicationWalker:
+    """Seeded-broken control flow caught by name; healthy twins green."""
+
+    @needs_mesh
+    def test_dropped_psum_while_predicate_caught(self):
+        """The canonical bug: a CG-style loop whose convergence check
+        reads the LOCAL partial residual norm - the psum was dropped -
+        so each shard decides its own trip count."""
+        mesh = make_mesh(4)
+
+        def local(r):
+            def cond(carry):
+                _, rr = carry
+                return jnp.sum(rr * rr) > 1e-6  # local partial: varying
+
+            def body(carry):
+                i, rr = carry
+                return i + 1, rr * 0.5
+
+            _, out = jax.lax.while_loop(cond, body, (0, r))
+            return out
+
+        fn = _sharded(local, mesh)
+        with pytest.raises(SpmdViolation) as exc:
+            verify_spmd(fn, jnp.ones(16), mesh=mesh)
+        kinds = {f.kind for f in exc.value.findings}
+        assert kinds == {"shard-varying-predicate"}
+        assert any("while" in f.where for f in exc.value.findings)
+        assert "desynchronize" in str(exc.value)
+
+    @needs_mesh
+    def test_psum_laundering_is_green(self):
+        """Same loop with the psum restored: the predicate derives from
+        a replicated value, so the contract verifies green."""
+        mesh = make_mesh(4)
+
+        def local(r):
+            def cond(carry):
+                _, rr = carry
+                return jax.lax.psum(jnp.sum(rr * rr), AXIS) > 1e-6
+
+            def body(carry):
+                i, rr = carry
+                return i + 1, rr * 0.5
+
+            _, out = jax.lax.while_loop(cond, body, (0, r))
+            return out
+
+        report = verify_spmd(_sharded(local, mesh), jnp.ones(16),
+                             mesh=mesh)
+        assert report.ok
+        assert report.axes_used == (AXIS,)
+
+    @needs_mesh
+    def test_trace_constant_counter_is_green(self):
+        """A fixed trip count is replicated by construction even when
+        the body churns shard-varying data."""
+        mesh = make_mesh(4)
+
+        def local(r):
+            def cond(carry):
+                i, _ = carry
+                return i < 7
+
+            def body(carry):
+                i, rr = carry
+                return i + 1, rr * 0.5
+
+            _, out = jax.lax.while_loop(cond, body, (0, r))
+            return out
+
+        assert verify_spmd(_sharded(local, mesh), jnp.ones(16),
+                           mesh=mesh).ok
+
+    @needs_mesh
+    def test_axis_index_leak_caught(self):
+        """``axis_index`` introduces varying-ness out of nothing: a
+        shard-id-gated loop bound desynchronizes even with fully
+        replicated data inputs."""
+        mesh = make_mesh(4)
+
+        def local(r):
+            me = jax.lax.axis_index(AXIS)
+
+            def cond(carry):
+                i, _ = carry
+                return i < me + 3  # shard-id-dependent trip count
+
+            def body(carry):
+                i, rr = carry
+                return i + 1, rr + 1.0
+
+            _, out = jax.lax.while_loop(cond, body, (0, r))
+            return out
+
+        with pytest.raises(SpmdViolation) as exc:
+            verify_spmd(_sharded(local, mesh), jnp.ones(16), mesh=mesh)
+        assert {f.kind for f in exc.value.findings} \
+            == {"shard-varying-predicate"}
+
+    @needs_mesh
+    def test_shard_gated_cond_selector_caught(self):
+        """A ``cond`` whose branch selector is a local sum: shards take
+        different branches and issue mismatched collectives."""
+        mesh = make_mesh(4)
+
+        def local(r):
+            return jax.lax.cond(jnp.sum(r) > 0.0,
+                                lambda x: x * 2.0,
+                                lambda x: x * 0.5, r)
+
+        with pytest.raises(SpmdViolation) as exc:
+            verify_spmd(_sharded(local, mesh), jnp.ones(16), mesh=mesh)
+        f, = exc.value.findings
+        assert f.kind == "shard-varying-predicate"
+        assert "cond" in f.where
+        assert "branch" in f.message
+
+    @needs_mesh
+    def test_replication_findings_on_raw_jaxpr(self):
+        """The walker is usable on an already-traced jaxpr (what the
+        gate script does with probed builds)."""
+        mesh = make_mesh(4)
+
+        def local(r):
+            def cond(carry):
+                _, rr = carry
+                return jnp.sum(rr * rr) > 1e-6
+
+            def body(carry):
+                i, rr = carry
+                return i + 1, rr * 0.5
+
+            _, out = jax.lax.while_loop(cond, body, (0, r))
+            return out
+
+        closed = jax.make_jaxpr(_sharded(local, mesh))(jnp.ones(16))
+        findings = replication_findings(closed)
+        assert findings
+        assert findings[0].kind == "shard-varying-predicate"
+        assert findings[0].describe().startswith(
+            "[shard-varying-predicate]")
+
+
+class TestMeshValidation:
+    """Collectives checked against the ACTUAL mesh geometry."""
+
+    @needs_mesh
+    def test_undeclared_axis_caught(self):
+        mesh = make_mesh(4)
+
+        def local(r):
+            return jax.lax.psum(r, AXIS)
+
+        closed = jax.make_jaxpr(_sharded(local, mesh, out_specs=P()))(
+            jnp.ones(16))
+        findings = mesh_collective_findings(
+            closed, _fake_mesh([("shards", 4)]))
+        assert [k for k, _ in findings] == ["undeclared-axis"]
+        assert "'rows'" in findings[0][1]
+
+    @needs_mesh
+    def test_permutation_out_of_range_caught(self):
+        """The elastic-migration seam: a ring schedule traced for
+        mesh-4 references shards 2 and 3, which a shrunken mesh-2 does
+        not have - a deadlock on chip, a finding here."""
+        mesh = make_mesh(4)
+        perm = [(i, (i + 1) % 4) for i in range(4)]
+
+        def local(r):
+            return jax.lax.ppermute(r, AXIS, perm)
+
+        closed = jax.make_jaxpr(_sharded(local, mesh))(jnp.ones(16))
+        assert mesh_collective_findings(closed, mesh) == []
+        findings = mesh_collective_findings(
+            closed, _fake_mesh([(AXIS, 2)]))
+        assert [k for k, _ in findings] == ["permutation-out-of-range"]
+        assert "[2, 3]" in findings[0][1]
+
+    @needs_mesh
+    def test_verify_spmd_applies_mesh_checks(self):
+        """``verify_spmd(..., mesh=)`` folds geometry findings into the
+        same report/exception as the replication walk."""
+        mesh = make_mesh(4)
+        perm = [(i, (i + 1) % 4) for i in range(4)]
+
+        def local(r):
+            return jax.lax.ppermute(r, AXIS, perm)
+
+        fn = _sharded(local, mesh)
+        assert verify_spmd(fn, jnp.ones(16), mesh=mesh).ok
+        with pytest.raises(SpmdViolation) as exc:
+            verify_spmd(fn, jnp.ones(16), mesh=_fake_mesh([(AXIS, 2)]))
+        assert {f.kind for f in exc.value.findings} \
+            == {"permutation-out-of-range"}
+
+
+@needs_mesh
+class TestShippedLanes:
+    """The exact solver bodies the cache would compile verify green:
+    the probe intercepts ``_cached_solver`` and hands back the build/
+    args pair, which ``verify_spmd`` re-traces (never compiles)."""
+
+    def _system(self):
+        a = poisson.poisson_2d_csr(10, 10)
+        rng = np.random.default_rng(0)
+        return a, rng.standard_normal(int(a.shape[0]))
+
+    @pytest.mark.parametrize("lane,overrides", [
+        ("allgather", {}),
+        ("gather", {"exchange": "gather"}),
+        ("ring", {"exchange": "ring"}),
+        ("deflated", {"deflate": "SPACE"}),
+        ("fault-armed", {"inject": FaultPlan(site="reduction",
+                                             iteration=2)}),
+    ])
+    def test_lane_is_spmd_clean(self, lane, overrides):
+        a, b = self._system()
+        mesh = make_mesh(4)
+        kw = dict(overrides)
+        if kw.get("deflate") == "SPACE":
+            kw["deflate"] = _synthetic_space(a)
+        probe = probe_dispatch(
+            lambda: solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                      maxiter=200, **kw))
+        report = verify_spmd(probe.build(), *probe.args, mesh=mesh)
+        assert report.ok
+        # non-vacuous: the trace really contains mesh collectives
+        assert AXIS in report.axes_used
+
+
+class TestCollectiveBudget:
+    """The named per-iteration budget API (contract of PR 13; the
+    deflated-vs-baseline instance is machine-checked at fixture scale
+    in test_recycle.py / test_many_rhs.py)."""
+
+    def _system(self):
+        a = poisson.poisson_2d_csr(8, 8)
+        rng = np.random.default_rng(1)
+        return a, rng.standard_normal(int(a.shape[0]))
+
+    def test_rejects_non_dispatch(self):
+        with pytest.raises(TypeError, match="zero-arg dispatch"):
+            collective_budget(42)
+
+    def test_rejects_dispatch_that_skips_the_cache(self):
+        with pytest.raises(ValueError, match="did not route"):
+            collective_budget(lambda: None)
+
+    @needs_mesh
+    def test_identical_lane_is_green(self):
+        a, b = self._system()
+        mesh = make_mesh(4)
+
+        def dispatch():
+            return solve_distributed(a, b, mesh=mesh, tol=1e-6,
+                                     maxiter=60)
+
+        report = verify_collective_budget(dispatch, dispatch)
+        assert report.ok
+        assert report.deltas() == {"psum": 0, "ppermute": 0,
+                                   "all_gather": 0}
+
+    @needs_mesh
+    def test_budget_drift_caught(self):
+        """A variant that genuinely changes the inventory - the ring
+        exchange trades the all_gather for per-iteration ppermutes -
+        raises with the drifted ops and the caller's label."""
+        a, b = self._system()
+        mesh = make_mesh(4)
+
+        def baseline():
+            return solve_distributed(a, b, mesh=mesh, tol=1e-6,
+                                     maxiter=60)
+
+        def ring():
+            return solve_distributed(a, b, mesh=mesh, tol=1e-6,
+                                     maxiter=60, exchange="ring")
+
+        with pytest.raises(CollectiveBudgetError) as exc:
+            verify_collective_budget(ring, baseline,
+                                     what="seeded ring-vs-allgather")
+        msg = str(exc.value)
+        assert "seeded ring-vs-allgather" in msg
+        assert "ppermute" in msg or "all_gather" in msg
+
+    @needs_mesh
+    def test_solvecost_passthrough(self):
+        """Precomputed ``SolveCost`` objects short-circuit the dispatch
+        (the form test_many_rhs uses to also assert wire bytes)."""
+        a, b = self._system()
+        mesh = make_mesh(4)
+        sc = collective_budget(
+            lambda: solve_distributed(a, b, mesh=mesh, tol=1e-6,
+                                      maxiter=60))
+        assert collective_budget(sc) is sc
+        assert verify_collective_budget(sc, sc).ok

@@ -59,9 +59,11 @@ class TestFixtures:
     nowhere in its known-good twin."""
 
     BAD = ["bad_tiling", "bad_vmem", "bad_collective", "bad_dma",
-           "bad_hostsync"]
+           "bad_hostsync", "bad_cachekey", "bad_locks", "bad_events",
+           "bad_stale"]
     GOOD = ["good_tiling", "good_vmem", "good_collective", "good_dma",
-            "good_hostsync"]
+            "good_hostsync", "good_cachekey", "good_locks",
+            "good_events", "good_stale"]
 
     @pytest.mark.parametrize("name", BAD)
     def test_bad_fixture_fires_exactly(self, name):
@@ -76,7 +78,7 @@ class TestFixtures:
         assert actual_findings(path) == set()
 
     def test_every_rule_has_a_firing_fixture(self):
-        """The 5-rule catalog is fully exercised: every registered rule
+        """The rule catalog is fully exercised: every registered rule
         appears in at least one bad fixture's expectations."""
         covered = set()
         for name in self.BAD:
@@ -146,8 +148,27 @@ class TestSuppressions:
             "    # graftlint: disable=mosaic-tiling\n"
             "    dma = pltpu.make_async_remote_copy(")
         # the comment's next line is the call line, not the pl.ds
-        # lines - so both still fire; move it onto the pl.ds line
-        assert len(lint_source(src, path="t.py")) == 2
+        # lines - so both still fire, AND the disable that covered
+        # nothing is now itself reported stale (GL109)
+        diags = lint_source(src, path="t.py")
+        assert len([d for d in diags
+                    if d.rule_name == "mosaic-tiling"]) == 2
+        assert len([d for d in diags
+                    if d.rule_name == "stale-suppression"]) == 1
+
+    def test_stale_not_reported_on_partial_run(self):
+        """A --select run that never checks a comment's rule says
+        nothing about that comment: no GL109."""
+        src = self.SRC.format(
+            c1="  # graftlint: disable=mosaic-tiling", c2="")
+        fixed = src.replace("pl.ds(my_id, 1)", "pl.ds(0, 8)")
+        full = lint_source(fixed, path="t.py")
+        assert {d.rule_name for d in full} == {"stale-suppression"}
+        partial = lint_source(
+            fixed, path="t.py",
+            rules=resolve_rules(select=["vmem-budget",
+                                        "stale-suppression"]))
+        assert partial == []
 
     def test_file_level_suppression(self):
         src = "# graftlint: disable-file=mosaic-tiling\n" \
@@ -163,17 +184,25 @@ class TestRegistry:
     def test_catalog(self):
         rules = all_rules()
         assert [r.id for r in rules] == ["GL101", "GL102", "GL103",
-                                         "GL104", "GL105"]
+                                         "GL104", "GL105", "GL106",
+                                         "GL107", "GL108", "GL109"]
         assert {r.name for r in rules} == {
             "mosaic-tiling", "vmem-budget", "collective-safety",
-            "dma-pairing", "host-sync"}
+            "dma-pairing", "host-sync", "cache-key",
+            "lock-discipline", "event-schema", "stale-suppression"}
         # addressable by id and by name
         assert REGISTRY["gl101"] is REGISTRY["mosaic-tiling"]
-        # per-rule severity: hardware-fatal classes are errors, the
-        # host-sync hazard advises at warning (still gates by default)
+        assert REGISTRY["gl106"] is REGISTRY["cache-key"]
+        # per-rule severity: hardware-fatal and silent-wrong-result
+        # classes are errors; host-sync and stale-suppression advise
+        # at warning (still gate by default)
         sev = {r.id: r.severity for r in rules}
         assert sev["GL101"] == Severity.ERROR
         assert sev["GL105"] == Severity.WARNING
+        assert sev["GL106"] == Severity.ERROR
+        assert sev["GL107"] == Severity.ERROR
+        assert sev["GL108"] == Severity.ERROR
+        assert sev["GL109"] == Severity.WARNING
 
     def test_lazy_reexports(self):
         from cuda_mpi_parallel_tpu import analysis
@@ -189,7 +218,8 @@ class TestRegistry:
         assert [r.id for r in only] == ["GL101", "GL102"]
         rest = resolve_rules(ignore=["host-sync"])
         assert [r.id for r in rest] == ["GL101", "GL102", "GL103",
-                                        "GL104"]
+                                        "GL104", "GL106", "GL107",
+                                        "GL108", "GL109"]
 
     def test_severity_ordering(self):
         assert Severity.parse("error") > Severity.parse("warning")
@@ -228,8 +258,34 @@ class TestCLIEntry:
     def test_list_rules(self, capsys):
         assert lint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
-        for rid in ("GL101", "GL102", "GL103", "GL104", "GL105"):
+        for rid in ("GL101", "GL102", "GL103", "GL104", "GL105",
+                    "GL106", "GL107", "GL108", "GL109"):
             assert rid in out
+
+    def test_baseline_gates_only_new_findings(self, tmp_path, capsys):
+        """--baseline makes the gate 'no NEW findings': a prior --json
+        report forgives its own findings but nothing else."""
+        import json
+
+        bad = os.path.join(FIXTURES, "bad_vmem.py")
+        assert lint_main(["--json", bad]) == 1
+        base = tmp_path / "base.json"
+        base.write_text(capsys.readouterr().out)
+        # same findings, baselined away -> clean exit, empty output
+        assert lint_main([bad, "--baseline", str(base)]) == 0
+        assert capsys.readouterr().out == ""
+        # a different file's findings are NOT forgiven
+        other = os.path.join(FIXTURES, "bad_tiling.py")
+        assert lint_main([other, "--baseline", str(base)]) == 1
+        assert "GL101" in capsys.readouterr().out
+
+    def test_bad_baseline_errors(self, tmp_path, capsys):
+        p = tmp_path / "nonsense.json"
+        p.write_text("{\"not\": \"a list\"}")
+        rc = lint_main([os.path.join(FIXTURES, "bad_vmem.py"),
+                        "--baseline", str(p)])
+        assert rc == 2
+        assert "baseline" in capsys.readouterr().err
 
     def test_missing_path_errors(self, capsys):
         assert lint_main(["no/such/path.py"]) == 2

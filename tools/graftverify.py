@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""graftverify gate: whole-trace SPMD contracts + cache-key soundness.
+
+The static half of the pre-hardware gate is graftlint (pure AST, run
+separately by tools/lint.sh); this script is the TRACE half.  It never
+compiles and never executes a solve - every check works on
+``jax.make_jaxpr`` output captured at the ``dist_cg._cached_solver``
+choke point:
+
+1. **SPMD verifier** (``analysis.spmd.verify_spmd``) - the exact solve
+   bodies the solver cache would compile for the mesh-4 CSR lanes
+   (allgather / gather / ring exchange, deflated, fault-armed) must be
+   replication-consistent (no shard-varying ``while`` predicate or
+   ``cond`` selector) and their collectives/permutation endpoints must
+   match the actual mesh geometry.
+
+2. **Cache-key audit** (``analysis.cachekey``) - perturbing any static
+   argument of ``solve_distributed`` or ``ManyRHSDispatcher`` that
+   changes the traced program must change the solver-cache key (same
+   key + different jaxpr = a second caller silently reuses the wrong
+   compiled solver).
+
+Runs on CPU with 4 virtual devices; exit 0 = both contracts hold.
+"""
+import os
+
+# env must be set before jax is imported (conftest.py discipline)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        (_flags + " --xla_force_host_platform_device_count=4").strip()
+
+import sys  # noqa: E402
+
+sys.path.insert(0, ".")  # repo-root invocation, like overload_drill
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    if jax.device_count() < 4:
+        print(f"graftverify: need >= 4 devices, have {jax.device_count()}",
+              file=sys.stderr)
+        return 2
+
+    import numpy as np
+
+    from cuda_mpi_parallel_tpu.analysis import (
+        CacheKeyAuditError,
+        SpmdViolation,
+        audit_many_rhs,
+        audit_solve_distributed,
+        probe_dispatch,
+        verify_spmd,
+    )
+    from cuda_mpi_parallel_tpu.analysis.cachekey import _synthetic_space
+    from cuda_mpi_parallel_tpu.models import poisson
+    from cuda_mpi_parallel_tpu.parallel import make_mesh, solve_distributed
+    from cuda_mpi_parallel_tpu.robust.inject import FaultPlan
+
+    a = poisson.poisson_2d_csr(12, 12)
+    rng = np.random.default_rng(0)
+    b = rng.standard_normal(int(a.shape[0]))
+    mesh = make_mesh(4)
+    failures = 0
+
+    print("== SPMD verifier (mesh-4 CSR lanes, trace-only) ==")
+    lanes = [
+        ("allgather", {}),
+        ("gather", {"exchange": "gather"}),
+        ("ring", {"exchange": "ring"}),
+        ("deflated", {"deflate": _synthetic_space(a)}),
+        ("fault-armed", {"inject": FaultPlan(site="reduction",
+                                             iteration=2)}),
+    ]
+    for name, kw in lanes:
+        probe = probe_dispatch(
+            lambda: solve_distributed(a, b, mesh=mesh, tol=1e-8,
+                                      maxiter=200, **kw))
+        try:
+            report = verify_spmd(probe.build(), *probe.args, mesh=mesh)
+        except SpmdViolation as exc:
+            print(f"  {name}: FAIL\n{exc}", file=sys.stderr)
+            failures += 1
+        else:
+            print(f"  {name}: clean (axes {', '.join(report.axes_used)})")
+
+    print("== cache-key soundness audit (differential, trace-only) ==")
+    try:
+        report = audit_solve_distributed(a, b, mesh)
+    except CacheKeyAuditError as exc:
+        print(f"  solve_distributed: FAIL\n{exc}", file=sys.stderr)
+        failures += 1
+    else:
+        print(f"  solve_distributed: {len(report.cases)} static lanes "
+              f"sound")
+    b_stack = np.stack([b, 2 * b, 3 * b, 4 * b], axis=1)
+    try:
+        report = audit_many_rhs(a, b_stack, mesh)
+    except CacheKeyAuditError as exc:
+        print(f"  ManyRHSDispatcher: FAIL\n{exc}", file=sys.stderr)
+        failures += 1
+    else:
+        print(f"  ManyRHSDispatcher: {len(report.cases)} static lanes "
+              f"sound")
+
+    if failures:
+        print(f"graftverify: {failures} contract(s) violated",
+              file=sys.stderr)
+        return 1
+    print("graftverify: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,19 @@ python -m cuda_mpi_parallel_tpu.analysis --select GL105 --fail-on info \
     cuda_mpi_parallel_tpu/balance
 echo "balance: GL105 clean"
 
+# graftverify gate: the TRACE half of the static gate (ISSUE 16).
+# The package-wide graftlint run above already holds the shipped code
+# to the new GL106-GL109 rules; this adds the whole-trace contracts -
+# the SPMD verifier must be green on the exact mesh-4 CSR solve bodies
+# the solver cache would compile (allgather/gather/ring exchange,
+# deflated, fault-armed), and the differential cache-key audit must
+# prove every static lane of solve_distributed/ManyRHSDispatcher moves
+# the cache key whenever it moves the traced program.  Trace-only:
+# jax.make_jaxpr, never a compile or a device run, so it stays in the
+# cheap (--lint-only) phase.
+echo "== graftverify (SPMD contracts + cache-key audit, mesh-4) =="
+JAX_PLATFORMS=cpu python tools/graftverify.py
+
 if [[ "${1:-}" == "--lint-only" ]]; then
     exit 0
 fi
