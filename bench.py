@@ -1659,13 +1659,22 @@ def bench_all(results, sections=None) -> None:
     # RHS/s, p50/p95 latency, occupancy, and the same workload through
     # a max_batch=1 service (the sequential dispatch baseline) - the
     # >= 2x service-vs-sequential acceptance rides the speedup column.
+    # A third replay runs the same workload with the request
+    # observatory on (causal span tracing + metered usage to a scratch
+    # JSONL) and reports the tracing overhead % - the cost of knowing
+    # what every request did.
     def s_serve():
+        import tempfile
+
+        from cuda_mpi_parallel_tpu import telemetry
         from cuda_mpi_parallel_tpu.serve import (
             ServiceConfig,
             SolverService,
             rhs_for,
             synthetic_poisson,
         )
+        from cuda_mpi_parallel_tpu.telemetry import events as tevents
+        from cuda_mpi_parallel_tpu.telemetry import tracing
 
         grid = 128                 # 16384 unknowns, same as many_rhs
         a2 = poisson.poisson_2d_csr(grid, grid, dtype=np.float32)
@@ -1674,10 +1683,13 @@ def bench_all(results, sections=None) -> None:
         prepared = [(r, rhs_for(a2, r.seed, dtype=np.float32)[0])
                     for r in workload]
 
-        def replay(max_batch):
+        def replay(max_batch, trace_path=None):
+            if trace_path is not None:
+                telemetry.configure(trace_path)
             svc = SolverService(ServiceConfig(
                 max_batch=max_batch, max_wait_s=0.002,
-                queue_limit=512, maxiter=600, check_every=8))
+                queue_limit=512, maxiter=600, check_every=8,
+                usage=trace_path is not None))
             try:
                 h = svc.register(a2)
                 t0 = time.perf_counter()
@@ -1694,10 +1706,19 @@ def bench_all(results, sections=None) -> None:
                 stats = svc.stats()
             finally:
                 svc.close()
+                if trace_path is not None:
+                    telemetry.configure(None)
             return solved / max(window, 1e-9), stats, solved
 
         rate_b, stats_b, solved_b = replay(32)
         rate_1, stats_1, solved_1 = replay(1)
+        with tempfile.TemporaryDirectory() as td:
+            trace_path = os.path.join(td, "serve_trace.jsonl")
+            rate_t, stats_t, solved_t = replay(32,
+                                               trace_path=trace_path)
+            n_spans = len(tracing.span_events(
+                tevents.read_events(trace_path)))
+        usage_totals = stats_t["usage"]["totals"]
         lat = stats_b["latency"]
         entry = {
             "n": int(a2.shape[0]), "tol": tol,
@@ -1719,6 +1740,21 @@ def bench_all(results, sections=None) -> None:
                     stats_b["padding_fraction"], 3),
                 "batches": stats_b["batches"],
                 "timeouts": stats_b["timeouts"],
+            },
+            "trace": {
+                "overhead_pct": round(
+                    (1.0 - rate_t / max(rate_b, 1e-9)) * 100.0, 1),
+                "traced_rhs_per_sec": round(rate_t, 1),
+                "spans_per_request": round(
+                    n_spans / max(len(workload), 1), 2),
+            },
+            "usage": {
+                "device_seconds": round(
+                    usage_totals["device_seconds"], 6),
+                "wire_bytes": usage_totals["wire_bytes"],
+                "device_seconds_per_request": round(
+                    usage_totals["device_seconds"]
+                    / max(usage_totals["requests"], 1), 6),
             },
         }
         results["serve"] = entry

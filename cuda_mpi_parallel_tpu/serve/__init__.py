@@ -46,6 +46,7 @@ from .service import (
     ServiceConfig,
     SolverService,
 )
+from .usage import UsageLedger
 from .workload import (
     ReplaySummary,
     WorkloadRequest,
@@ -79,6 +80,7 @@ __all__ = [
     "ShedLadder",
     "SolverService",
     "TokenBucket",
+    "UsageLedger",
     "WeightedFairScheduler",
     "WorkloadRequest",
     "bucket_for",

@@ -140,6 +140,12 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="append the service + solve event stream "
                         "(request_enqueued/batch_dispatch/"
                         "request_done/...) to PATH")
+    p.add_argument("--usage", default=None, metavar="PATH",
+                   help="meter per-tenant usage (device-seconds, wire "
+                        "bytes, batch iterations; serve.usage) and "
+                        "export the ledger as JSONL to PATH after the "
+                        "replay (tools/usage_report.py renders and "
+                        "cross-checks it)")
     p.add_argument("--metrics", action="store_true",
                    help="print the metrics registry (Prometheus text, "
                         "incl. serve_* gauges and latency "
@@ -289,7 +295,8 @@ def main(argv=None) -> int:
         max_wait_s=args.max_wait_ms / 1e3,
         queue_limit=args.queue_limit, maxiter=args.maxiter,
         check_every=args.check_every, recycle=recycle_policy,
-        admission=admission, shed=shed, workers=args.workers))
+        admission=admission, shed=shed, workers=args.workers,
+        usage=args.usage is not None))
     mesh = None
     if args.mesh > 1:
         from ..parallel import make_mesh
@@ -384,6 +391,8 @@ def main(argv=None) -> int:
         per_request.append(entry)
 
     stats = service.stats()
+    if args.usage is not None:
+        service.usage_ledger().export_jsonl(args.usage)
     solved = sum(1 for e in per_request
                  if e["converged"] and not e["timed_out"])
     stats["solved_rhs_per_sec"] = solved / max(window_s, 1e-9)
@@ -440,6 +449,15 @@ def main(argv=None) -> int:
                    f"(mesh={args.mesh}, {args.dtype}) ==\n"
                    + "\n".join(treport.service_lines(stats)) + "\n"
                    + f"accuracy: max request error {worst_err:.3e}\n")
+    ustats = stats.get("usage")
+    if ustats is not None:
+        tot = ustats["totals"]
+        report_text += (
+            f"usage   : {tot['batches']} batch(es), "
+            f"{tot['device_seconds']:.6f} device-s, "
+            f"{tot['wire_bytes']:.3e} wire bytes, reconcile "
+            f"{ustats['reconcile_max_rel_err']:.2e} "
+            f"-> {args.usage}\n")
     rstats = stats.get("recycle")
     if rstats is not None:
         first = rstats.get("first_solve_iterations")

@@ -127,6 +127,10 @@ class QueuedRequest:
     #: dispatcher's flow
     tenant: str = "default"
     slo_class: str = "silver"
+    #: causal request trace (telemetry.tracing.RequestTrace) minted by
+    #: submit() when an event sink is live; None otherwise - the
+    #: tracing-off path carries no trace state at all
+    trace: object = None
 
     def expired(self, now: float) -> bool:
         return self.deadline_t is not None and now >= self.deadline_t
@@ -221,6 +225,19 @@ class MicroBatchQueue:
     def depth_by_class(self) -> Dict[str, int]:
         """Pending requests per SLO class (the defer-release check)."""
         return dict(self._class_depth)
+
+    def pending_requests(self, handle_key: Optional[str] = None
+                         ) -> List[QueuedRequest]:
+        """Every queued request (optionally one handle's), in queue
+        order.  Caller holds the service lock; used by migrate() to
+        stamp ``migration`` spans into the traces of the requests the
+        mesh swap affects."""
+        out: List[QueuedRequest] = []
+        for key, q in self._queues.items():
+            if handle_key is not None and key[0] != handle_key:
+                continue
+            out.extend(q)
+        return out
 
     def key_for(self, req: QueuedRequest
                 ) -> Tuple[str, str, str, str, str]:

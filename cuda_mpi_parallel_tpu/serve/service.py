@@ -262,6 +262,21 @@ class ServiceConfig:
     #: is trusted to overlap one extra dispatcher, an uncalibrated
     #: one stays serialized)
     workers: int = 1
+    #: rolling-window SLO burn-rate accounting per (tenant, slo_class)
+    #: (telemetry.slo.SLOConfig; None = off).  Observe-only: every
+    #: terminal outcome - completion, TIMEOUT, REFUSED,
+    #: ADMISSION_REJECTED - lands in the tracker on the SERVICE clock
+    #: (fake-clock drivable), gauges + typed ``slo_burn`` events ride
+    #: the registry/event stream, and ``SLOTracker.burn_rate`` is the
+    #: documented hook a future shed-ladder rung may consume
+    slo: Optional[object] = None
+    #: metered per-tenant usage attribution (serve.usage.UsageLedger;
+    #: False = off): every dispatched batch's device-seconds, batch
+    #: iterations and wire bytes are apportioned across the lanes that
+    #: shared it, with the per-tenant sums reconciling against the
+    #: batch totals (the billing substrate the network front end
+    #: needs).  Host-side post-solve bookkeeping only
+    usage: bool = False
     #: per-batch dispatch log retained for reports (ring, drop-oldest)
     keep_batch_log: int = 1024
     #: exact latency samples retained for stats() percentiles (ring,
@@ -500,6 +515,24 @@ class SolverService:
         # change), so a long hold does not flood the trace
         self._defer_noted: set = set()
         self._breakers: Dict[str, _Breaker] = {}
+        # request observatory: rolling SLO burn accounting and the
+        # per-tenant usage ledger (both None/off by default - the
+        # observe paths below stay untouched)
+        self._slo = None
+        if self.config.slo is not None:
+            from ..telemetry.slo import SLOConfig, SLOTracker
+
+            if not isinstance(self.config.slo, SLOConfig):
+                raise TypeError(
+                    f"ServiceConfig.slo must be a telemetry.slo."
+                    f"SLOConfig, got "
+                    f"{type(self.config.slo).__name__}")
+            self._slo = SLOTracker(self.config.slo)
+        self._usage = None
+        if self.config.usage:
+            from .usage import UsageLedger
+
+            self._usage = UsageLedger()
         self._latencies: deque = deque(
             maxlen=self.config.keep_latency_samples)
         # the wait-vs-solve split of the same completions: queueing
@@ -875,6 +908,18 @@ class SolverService:
                 handle.dispatcher = dispatcher
                 handle.plan = dispatcher.plan
                 self._migrations += 1
+                affected = self._queue.pending_requests(handle.key)
+        # the mesh swap is a causal fact of every queued request's
+        # life: their next dispatch runs on the new layout, so each
+        # live trace gets a migration span (chained, so the following
+        # queue_wait/solve spans hang off it)
+        t_migrated = self._clock()
+        for req in affected:
+            if req.trace is not None:
+                req.trace.span("migration", start_s=t_migrated,
+                               duration_s=0.0, handle=handle.key,
+                               n_shards_from=n_from,
+                               n_shards_to=n_to)
         if handle.recycle_space is not None:
             # defensive: re-harvest on the new layout rather than
             # trust a space across the seam
@@ -942,8 +987,22 @@ class SolverService:
                 raise ServiceClosed(
                     "solver service is closed (no new submissions)")
         rid = f"q{next(self._ids):06d}"
+        from ..telemetry import events
+
+        # the causal trace root: minted only when an event sink is
+        # live, so the tracing-off submit path carries no trace state
+        # at all (the jaxpr-bit-identity proof rides on this)
+        trace = None
+        if events.active():
+            from ..telemetry.tracing import RequestTrace
+
+            trace = RequestTrace(rid)
+            trace.span("submit", start_s=now, duration_s=0.0,
+                       root=True, handle=handle.key, tenant=tenant,
+                       slo_class=slo_class)
         if self._breaker_refuses(handle.key, now, rid):
-            return self._refuse(rid, handle, now, tenant, slo_class)
+            return self._refuse(rid, handle, now, tenant, slo_class,
+                                trace=trace)
         # the shed ladder, in order: reject (non-exempt classes
         # refused at the door with a retry hint) beats admission
         # metering beats degrade - every rung strictly milder than
@@ -953,7 +1012,7 @@ class SolverService:
             return self._admission_reject(
                 rid, handle, tenant, slo_class,
                 retry_after_s=self._drain_eta(), reason="shed",
-                tokens=None)
+                tokens=None, trace=trace)
         if self._admission is not None:
             with self._lock:
                 decision = self._admission.admit(tenant, now)
@@ -962,7 +1021,8 @@ class SolverService:
                 return self._admission_reject(
                     rid, handle, tenant, slo_class,
                     retry_after_s=decision.retry_after_s,
-                    reason=decision.reason, tokens=decision.tokens)
+                    reason=decision.reason, tokens=decision.tokens,
+                    trace=trace)
         degraded = False
         degrade_rung_on = self._shed.config.thresholds(
             self._capacity())[0] is not None
@@ -973,6 +1033,10 @@ class SolverService:
             # the tolerance one decade so the queue drains faster; the
             # result says so (degraded=True), nothing is silent
             tol, degraded = tol * 10.0, True
+        if trace is not None:
+            trace.span("admission", start_s=now, duration_s=0.0,
+                       decision="accepted", degraded=degraded,
+                       shed_level=level)
         req = QueuedRequest(
             request_id=rid,
             handle_key=handle.key, b=b, dtype=handle.dtype_name,
@@ -980,7 +1044,7 @@ class SolverService:
             deadline_t=(now + float(deadline_s)
                         if deadline_s is not None else None),
             future=Future(), handle=handle, degraded=degraded,
-            tenant=tenant, slo_class=slo_class)
+            tenant=tenant, slo_class=slo_class, trace=trace)
         try:
             with self._cond:
                 if self._closed:
@@ -1152,7 +1216,8 @@ class SolverService:
                           tenant: str, slo_class: str, *,
                           retry_after_s: Optional[float],
                           reason: Optional[str],
-                          tokens: Optional[float]) -> Future:
+                          tokens: Optional[float],
+                          trace=None) -> Future:
         """Typed ADMISSION_REJECTED result - resolved immediately,
         never queued, never an exception (the polite refusal BEFORE
         the hard QueueFull bound)."""
@@ -1163,6 +1228,16 @@ class SolverService:
             self._admission_rejected += 1
             self._tenant_tally(tenant)["rejected"] += 1
             self._class_tally(slo_class)["rejected"] += 1
+        now = self._clock()
+        if trace is not None:
+            trace.span("admission", start_s=now, duration_s=0.0,
+                       decision="rejected", reason=reason)
+            trace.span("result", start_s=now, duration_s=0.0,
+                       status="ADMISSION_REJECTED")
+        if self._slo is not None:
+            # a turned-away request is a broken promise from the
+            # caller's seat: it burns error budget
+            self._slo.observe(tenant, slo_class, now, False)
         REGISTRY.counter(
             "serve_admission_rejected_total",
             "requests refused by admission control (token bucket or "
@@ -1280,7 +1355,7 @@ class SolverService:
 
     def _refuse(self, rid: str, handle: OperatorHandle, now: float,
                 tenant: str = "default",
-                slo_class: str = "silver") -> Future:
+                slo_class: str = "silver", trace=None) -> Future:
         """Typed REFUSED result for an open breaker - resolved
         immediately, never queued."""
         from ..telemetry import events
@@ -1288,6 +1363,13 @@ class SolverService:
 
         with self._lock:
             self._refused += 1
+        if trace is not None:
+            trace.span("admission", start_s=now, duration_s=0.0,
+                       decision="refused", reason="breaker_open")
+            trace.span("result", start_s=now, duration_s=0.0,
+                       status="REFUSED")
+        if self._slo is not None:
+            self._slo.observe(tenant, slo_class, now, False)
         REGISTRY.counter(
             "serve_refused_total",
             "requests refused by an open per-handle circuit breaker",
@@ -1337,6 +1419,12 @@ class SolverService:
                     attempt=req.attempts, status=status,
                     handle=req.handle_key,
                     ready_in_s=round(float(req.ready_t - now), 6))
+        if req.trace is not None:
+            # child of the failed attempt's solve span (the current
+            # head); the next attempt's queue_wait chains off it
+            req.trace.span("retry", start_s=now,
+                           duration_s=float(req.ready_t - now),
+                           attempt=req.attempts, status=status)
         return True
 
     # -- dispatch --------------------------------------------------------
@@ -1437,6 +1525,14 @@ class SolverService:
                     status="TIMEOUT", wait_s=float(wait),
                     handle=req.handle_key, tenant=req.tenant,
                     slo_class=req.slo_class)
+        if req.trace is not None:
+            req.trace.span("queue_wait", start_s=req.enqueue_t,
+                           duration_s=float(wait),
+                           attempt=req.attempts + 1)
+            req.trace.span("result", start_s=now, duration_s=0.0,
+                           status="TIMEOUT")
+        if self._slo is not None:
+            self._slo.observe(req.tenant, req.slo_class, now, False)
         if not req.future.done():
             req.future.set_result(result)
 
@@ -1618,12 +1714,33 @@ class SolverService:
                         decision="dispatch", handle=handle.key,
                         cost=round(self._cost_model.price(handle), 9),
                         reason=batch.reason, n_requests=m)
+        for r in reqs:
+            if r.trace is not None:
+                # the attempt's queue residency ends HERE; sched is
+                # the dispatch decision that ended it
+                r.trace.span("queue_wait", start_s=r.enqueue_t,
+                             duration_s=float(now - r.enqueue_t),
+                             attempt=r.attempts + 1)
+                r.trace.span("sched", start_s=now, duration_s=0.0,
+                             decision="dispatch", reason=batch.reason,
+                             bucket=k)
         b_stack = stack_columns([r.b for r in reqs], k,
                                 dtype=np.dtype(handle.dtype_name))
         tols = np.full((k,), reqs[0].tol,
                        dtype=np.dtype(handle.dtype_name))
         tols[:m] = [r.tol for r in reqs]
         r_deflate, r_basis, r_flight = self._recycle_lane(handle)
+        # wire-byte attribution rides dist_cg's LAST-built cost note,
+        # which is a process-global: only a serialized dispatcher
+        # (manual pumps or the single worker) can attribute it to THIS
+        # batch.  A concurrent pool meters device-seconds/iterations
+        # and reports wire as 0 rather than guessing
+        meter_wire = (self._usage is not None and handle.distributed
+                      and (self._manual or self._n_workers == 1))
+        if meter_wire:
+            from ..parallel import dist_cg
+
+            dist_cg.reset_last_comm_cost()
         t0 = time.perf_counter()
         with events.solve_scope() as solve_id:
             events.emit("batch_dispatch", handle=handle.key, bucket=k,
@@ -1680,9 +1797,34 @@ class SolverService:
                                  labelnames=("handle", "reason")).inc(
                                      handle=handle.key,
                                      reason=batch.reason)
+                if self._usage is not None:
+                    # the failed dispatch burned real device-seconds
+                    # and somebody caused it: metered, iterations and
+                    # wire unknown (0)
+                    self._usage.note_batch(
+                        solve_id=solve_id, handle=handle.key,
+                        solve_s=float(solve_s),
+                        mesh_size=(int(handle.mesh.devices.size)
+                                   if handle.distributed else 1),
+                        batch_iterations=0,
+                        wire_bytes_per_iteration=0.0,
+                        lanes=[{"request_id": r.request_id,
+                                "tenant": r.tenant,
+                                "slo_class": r.slo_class,
+                                "iterations": 0,
+                                "trace_id": (r.trace.trace_id
+                                             if r.trace is not None
+                                             else None)}
+                               for r in reqs])
                 retry_p = self.config.retry
                 for r in reqs:
                     wait = float(now - r.enqueue_t)
+                    if r.trace is not None:
+                        r.trace.span("solve", start_s=now,
+                                     duration_s=float(solve_s),
+                                     solve_id=solve_id, bucket=k,
+                                     status="ERROR",
+                                     error=repr(exc)[-200:])
                     if retry_p is not None \
                             and "ERROR" in retry_p.statuses \
                             and r.attempts < retry_p.max_retries \
@@ -1690,6 +1832,13 @@ class SolverService:
                             and self._requeue(r, "ERROR",
                                               self._clock()):
                         continue
+                    if r.trace is not None:
+                        r.trace.span("result",
+                                     start_s=now + float(solve_s),
+                                     duration_s=0.0, status="ERROR")
+                    if self._slo is not None:
+                        self._slo.observe(r.tenant, r.slo_class,
+                                          self._clock(), False)
                     events.emit("request_done",
                                 request_id=r.request_id, status="ERROR",
                                 wait_s=wait, handle=handle.key,
@@ -1718,6 +1867,34 @@ class SolverService:
                                            self._clock())
                 return
             solve_s = time.perf_counter() - t0
+            if self._usage is not None:
+                mesh_size = (int(handle.mesh.devices.size)
+                             if handle.distributed else 1)
+                wire_per_iter = 0.0
+                if meter_wire:
+                    from ..parallel import dist_cg
+
+                    last = dist_cg.last_comm_cost()
+                    if last is not None:
+                        # per-device interconnect bytes x mesh size =
+                        # total wire volume per iteration
+                        wire_per_iter = float(
+                            last[0].per_iteration.wire_bytes
+                        ) * mesh_size
+                self._usage.note_batch(
+                    solve_id=solve_id, handle=handle.key,
+                    solve_s=float(solve_s), mesh_size=mesh_size,
+                    batch_iterations=max(
+                        int(iters[j]) for j in range(m)),
+                    wire_bytes_per_iteration=wire_per_iter,
+                    lanes=[{"request_id": r.request_id,
+                            "tenant": r.tenant,
+                            "slo_class": r.slo_class,
+                            "iterations": int(iters[j]),
+                            "trace_id": (r.trace.trace_id
+                                         if r.trace is not None
+                                         else None)}
+                           for j, r in enumerate(reqs)])
             results = []
             retry_p = self.config.retry
             lane_statuses = []
@@ -1726,6 +1903,13 @@ class SolverService:
                 lane_statuses.append(status)
                 wait = float(now - r.enqueue_t)
                 latency = wait + solve_s
+                if r.trace is not None:
+                    r.trace.span("solve", start_s=now,
+                                 duration_s=float(solve_s),
+                                 solve_id=solve_id, bucket=k,
+                                 occupancy=round(batch.occupancy, 6),
+                                 iterations=int(iters[j]),
+                                 status=status)
                 if status == "BREAKDOWN":
                     # the problem's fault, typed and loud: the shared
                     # solve_fault event + counter, from the lane that
@@ -1765,6 +1949,11 @@ class SolverService:
                             iterations=int(iters[j]),
                             converged=bool(conv[j]), handle=handle.key,
                             tenant=r.tenant, slo_class=r.slo_class)
+                if r.trace is not None:
+                    r.trace.span("result",
+                                 start_s=now + float(solve_s),
+                                 duration_s=0.0, status=status,
+                                 converged=bool(conv[j]))
                 REGISTRY.counter(
                     "serve_requests_done_total",
                     "requests finished by the solver service",
@@ -1818,6 +2007,7 @@ class SolverService:
                 rate = self._n_workers * m / float(solve_s)
                 self._rate_ewma = rate if self._rate_ewma is None \
                     else 0.7 * self._rate_ewma + 0.3 * rate
+            slo_obs = []
             for _, result in results:
                 self._completed += 1
                 if result.converged:
@@ -1831,9 +2021,12 @@ class SolverService:
                 cls = self._classes.get(result.slo_class)
                 target = cls.target_latency_s if cls is not None \
                     else None
-                if result.converged and (target is None
-                                         or result.latency_s <= target):
+                in_slo = result.converged and (
+                    target is None or result.latency_s <= target)
+                if in_slo:
                     ctally["in_slo"] += 1
+                slo_obs.append((result.tenant, result.slo_class,
+                                in_slo))
                 self._class_latencies.setdefault(
                     result.slo_class,
                     deque(maxlen=self.config.keep_latency_samples)
@@ -1843,6 +2036,13 @@ class SolverService:
                 "reason": batch.reason, "solve_s": float(solve_s),
                 "solve_id": solve_id,
                 "request_ids": [r.request_id for r in reqs]})
+        if self._slo is not None:
+            # the SAME in-SLO verdict the class tally just recorded,
+            # observed on the service clock (fake-clock drill rides
+            # this determinism)
+            t_done = self._clock()
+            for tenant, slo_class, in_slo in slo_obs:
+                self._slo.observe(tenant, slo_class, t_done, in_slo)
         # breaker: a dispatch where every live lane failed with an
         # ERROR/BREAKDOWN counts toward the consecutive-failure
         # threshold; anything else closes the breaker
@@ -1937,6 +2137,18 @@ class SolverService:
     def batch_log(self) -> List[dict]:
         with self._lock:
             return list(self._batch_log)
+
+    def usage_ledger(self):
+        """The per-tenant :class:`serve.usage.UsageLedger` (``None``
+        unless ``ServiceConfig(usage=True)``)."""
+        return self._usage
+
+    def slo_tracker(self):
+        """The :class:`telemetry.slo.SLOTracker` (``None`` unless
+        ``ServiceConfig(slo=...)``).  Its ``burn_rate()`` is the
+        documented hook external policy (a future shed rung, an
+        autoscaler) may poll."""
+        return self._slo
 
     def stats(self) -> dict:
         """JSON-ready service summary: request/batch counts, occupancy
@@ -2038,6 +2250,11 @@ class SolverService:
                         if h.recycle_harvests
                         or h.recycle_space is not None},
                 }
+        # request observatory (own locks - outside the service lock)
+        if self._slo is not None:
+            out["slo"] = self._slo.snapshot(self._clock())
+        if self._usage is not None:
+            out["usage"] = self._usage.snapshot()
         out["latency"] = {
             "count": len(lat),
             "mean_s": float(np.mean(lat)) if lat else None,

@@ -1,0 +1,233 @@
+"""Metered per-tenant usage attribution for the solver service.
+
+The serve tier batches many tenants' requests into one solve - which
+is the whole value, and also why nobody can answer "what did tenant X
+cost us this hour?".  This module meters each dispatched batch and
+apportions it across the lanes that shared it:
+
+* **device-seconds** = solve wall x mesh size (a 4-shard mesh burns
+  four device-seconds per wall second whether or not every lane
+  needed them);
+* **batch iterations** = the iterations the batch actually ran (the
+  max over live lanes - batched CG runs every column until the last
+  one is done, so a lane occupies its column for the full sweep);
+* **wire bytes** = the solve's measured per-iteration communication
+  volume (``dist_cg.last_comm_cost``'s jaxpr-derived totals) x batch
+  iterations.
+
+Apportionment is an equal split across the live lanes with the
+remainder assigned to the last lane, so the accounting identity holds
+to float round-off: summed per-tenant device-seconds and wire bytes
+reconcile with the batch-level totals (``reconcile()``, gated at
+1e-9 in tools/lint.sh).  Equal split is the honest cost model here -
+a lane that converged early still occupied its batch column for the
+whole sweep, and padding lanes are overhead amortized over the real
+requests that caused the batch.
+
+Host-side bookkeeping only (plain Python floats, post-solve): with
+``ServiceConfig(usage=False)`` (the default) no ledger exists and the
+solve body is jaxpr-bit-identical - same contract as tracing.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..telemetry import events
+from ..utils.logging import sanitize
+
+__all__ = ["UsageLedger"]
+
+
+class UsageLedger:
+    """Thread-safe per-tenant usage meter; one per SolverService.
+
+    ``note_batch`` is called once per dispatched batch from the
+    service's post-solve bookkeeping (success AND error paths - a
+    failed batch burned real device-seconds and somebody caused it).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._batches: List[Dict[str, Any]] = []
+        self._requests: List[Dict[str, Any]] = []
+
+    # -- metering ------------------------------------------------------
+
+    def note_batch(self, *, solve_id: Optional[str], handle: str,
+                   solve_s: float, mesh_size: int,
+                   batch_iterations: int,
+                   wire_bytes_per_iteration: float,
+                   lanes: Sequence[Dict[str, Any]]) -> None:
+        """Meter one dispatched batch and apportion it across lanes.
+
+        ``lanes`` carries one dict per LIVE request in the batch
+        (padding columns excluded): ``request_id``, ``tenant``,
+        ``slo_class``, ``iterations`` (that lane's own count),
+        ``trace_id`` (None untraced).  Totals are computed here so the
+        caller cannot hand in an inconsistent split.
+        """
+        m = len(lanes)
+        if m == 0:
+            return
+        device_seconds = float(solve_s) * max(int(mesh_size), 1)
+        wire_bytes = float(wire_bytes_per_iteration) \
+            * max(int(batch_iterations), 0)
+        shares = _apportion(device_seconds, m)
+        wire_shares = _apportion(wire_bytes, m)
+        iter_shares = _apportion(float(batch_iterations), m)
+        batch_rec = {
+            "solve_id": solve_id, "handle": handle,
+            "n_requests": m,
+            "solve_s": float(solve_s),
+            "mesh_size": max(int(mesh_size), 1),
+            "batch_iterations": int(batch_iterations),
+            "device_seconds": device_seconds,
+            "wire_bytes": wire_bytes,
+        }
+        request_recs = []
+        per_tenant_shares: Dict[str, float] = {}
+        for j, lane in enumerate(lanes):
+            tenant = str(lane.get("tenant", "default"))
+            rec = {
+                "request_id": lane.get("request_id"),
+                "tenant": tenant,
+                "slo_class": str(lane.get("slo_class", "silver")),
+                "solve_id": solve_id,
+                "handle": handle,
+                "trace_id": lane.get("trace_id"),
+                "iterations": int(lane.get("iterations", 0)),
+                "batch_iterations_share": iter_shares[j],
+                "device_seconds": shares[j],
+                "wire_bytes": wire_shares[j],
+                "batch_n_requests": m,
+            }
+            request_recs.append(rec)
+            per_tenant_shares[tenant] = \
+                per_tenant_shares.get(tenant, 0.0) + shares[j]
+        with self._lock:
+            self._batches.append(batch_rec)
+            self._requests.extend(request_recs)
+        events.emit(
+            "usage", solve_id=solve_id, handle=handle, n_requests=m,
+            device_seconds=device_seconds, wire_bytes=wire_bytes,
+            batch_iterations=int(batch_iterations),
+            mesh_size=batch_rec["mesh_size"],
+            per_tenant_device_seconds={
+                t: round(v, 9) for t, v in
+                sorted(per_tenant_shares.items())})
+
+    # -- readout -------------------------------------------------------
+
+    def per_tenant(self) -> Dict[str, Dict[str, float]]:
+        """Accumulated usage keyed by tenant (fsum'd, so the identity
+        against :meth:`batch_totals` holds to double round-off)."""
+        with self._lock:
+            requests = list(self._requests)
+        acc: Dict[str, Dict[str, List[float]]] = {}
+        for rec in requests:
+            t = acc.setdefault(rec["tenant"], {
+                "requests": [], "device_seconds": [],
+                "wire_bytes": [], "batch_iterations_share": []})
+            t["requests"].append(1.0)
+            t["device_seconds"].append(rec["device_seconds"])
+            t["wire_bytes"].append(rec["wire_bytes"])
+            t["batch_iterations_share"].append(
+                rec["batch_iterations_share"])
+        return {
+            tenant: {
+                "requests": int(math.fsum(v["requests"])),
+                "device_seconds": math.fsum(v["device_seconds"]),
+                "wire_bytes": math.fsum(v["wire_bytes"]),
+                "batch_iterations_share": math.fsum(
+                    v["batch_iterations_share"]),
+            }
+            for tenant, v in sorted(acc.items())
+        }
+
+    def batch_totals(self) -> Dict[str, float]:
+        """Ground truth the per-tenant sums must reconcile against."""
+        with self._lock:
+            batches = list(self._batches)
+        return {
+            "batches": len(batches),
+            "requests": int(math.fsum(b["n_requests"]
+                                      for b in batches)),
+            "device_seconds": math.fsum(b["device_seconds"]
+                                        for b in batches),
+            "wire_bytes": math.fsum(b["wire_bytes"] for b in batches),
+            "batch_iterations": int(math.fsum(b["batch_iterations"]
+                                              for b in batches)),
+        }
+
+    def reconcile(self) -> float:
+        """Max relative mismatch between summed per-tenant usage and
+        the batch-level totals, over device-seconds and wire bytes.
+        The accounting identity: this is ~1e-16 territory, gated at
+        1e-9 by tools/lint.sh."""
+        tenants = self.per_tenant()
+        totals = self.batch_totals()
+        worst = 0.0
+        for field in ("device_seconds", "wire_bytes"):
+            total = totals[field]
+            summed = math.fsum(v[field] for v in tenants.values())
+            scale = max(abs(total), 1.0)
+            worst = max(worst, abs(summed - total) / scale)
+        return worst
+
+    def records(self) -> List[Dict[str, Any]]:
+        """The per-request usage records (copies)."""
+        with self._lock:
+            return [dict(r) for r in self._requests]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The stats() section: totals + per-tenant roll-up + the
+        reconciliation residual."""
+        return {
+            "totals": self.batch_totals(),
+            "per_tenant": self.per_tenant(),
+            "reconcile_max_rel_err": self.reconcile(),
+        }
+
+    def export_jsonl(self, path: str) -> int:
+        """Write the ledger as strict JSONL: one ``kind="request"``
+        line per metered request, one ``kind="batch"`` line per batch,
+        and a final ``kind="summary"`` roll-up (what
+        ``tools/usage_report.py`` re-derives and cross-checks).
+        Returns the number of lines written.
+        """
+        with self._lock:
+            requests = [dict(r) for r in self._requests]
+            batches = [dict(b) for b in self._batches]
+        lines = 0
+        with open(path, "w", encoding="utf-8") as f:
+            for rec in requests:
+                f.write(json.dumps(sanitize({"kind": "request", **rec}),
+                                   allow_nan=False, sort_keys=True)
+                        + "\n")
+                lines += 1
+            for rec in batches:
+                f.write(json.dumps(sanitize({"kind": "batch", **rec}),
+                                   allow_nan=False, sort_keys=True)
+                        + "\n")
+                lines += 1
+            summary = {"kind": "summary",
+                       "totals": self.batch_totals(),
+                       "per_tenant": self.per_tenant(),
+                       "reconcile_max_rel_err": self.reconcile()}
+            f.write(json.dumps(sanitize(summary), allow_nan=False,
+                               sort_keys=True) + "\n")
+            lines += 1
+        return lines
+
+
+def _apportion(total: float, m: int) -> List[float]:
+    """Equal split of ``total`` over ``m`` lanes, remainder to the
+    last lane so ``fsum(shares) == total`` to double round-off."""
+    if m == 1:
+        return [float(total)]
+    share = float(total) / m
+    head = [share] * (m - 1)
+    return head + [float(total) - math.fsum(head)]

@@ -72,6 +72,8 @@ from . import (
     roofline,
     session,
     shardscope,
+    slo,
+    tracing,
 )
 from .phasetrace import PhaseProfile
 from .calibrate import CalibrationFit, DriftReport
@@ -83,6 +85,8 @@ from .report import SolveReport, perfetto_trace, validate_perfetto
 from .roofline import MachineModel, RooflineReport
 from .session import observe_solve
 from .shardscope import ShardReport, shard_report
+from .slo import SLOConfig, SLOTracker, SLOWindow
+from .tracing import RequestTrace
 
 
 #: set by force_active(): opts into the build-time cost accounting even
@@ -116,7 +120,11 @@ __all__ = [
     "MetricsRegistry",
     "PhaseProfile",
     "REGISTRY",
+    "RequestTrace",
     "RooflineReport",
+    "SLOConfig",
+    "SLOTracker",
+    "SLOWindow",
     "ShardReport",
     "SolveHealth",
     "SolveReport",
@@ -138,6 +146,8 @@ __all__ = [
     "session",
     "shard_report",
     "shardscope",
+    "slo",
+    "tracing",
     "validate_event",
     "validate_perfetto",
 ]

@@ -37,6 +37,7 @@ import contextvars
 import io
 import itertools
 import json
+import os
 import sys
 import threading
 import time
@@ -167,6 +168,21 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     "shard_degraded": ("shard", "phase", "ratio"),
     "solve_migration": ("n_shards_from", "n_shards_to", "reason"),
     "handle_migrated": ("handle", "n_shards_from", "n_shards_to"),
+    # request observatory (telemetry.tracing / telemetry.slo /
+    # serve.usage): one causal span of a request's life in the serve
+    # tier (name in {submit, admission, queue_wait, sched, solve,
+    # retry, migration, result}; parent_span_id None only for the
+    # root submit span; traceparent is the W3C-shaped context string
+    # a future HTTP/gRPC shim injects/extracts unchanged); a rolling
+    # SLO error-budget window tripped its burn-rate threshold for one
+    # (tenant, slo_class, window); one dispatched batch's metered
+    # usage totals with the per-tenant apportionment that must
+    # reconcile with them
+    "span": ("trace_id", "span_id", "parent_span_id", "name",
+             "request_id", "start_s", "duration_s"),
+    "slo_burn": ("tenant", "slo_class", "window", "burn_rate"),
+    "usage": ("n_requests", "device_seconds", "wire_bytes",
+              "batch_iterations"),
     # the solve finished (converged or not) and was synced
     "solve_end": ("status", "iterations", "residual_norm"),
 }
@@ -278,15 +294,30 @@ def solve_scope(solve_id: Optional[str] = None) -> Iterator[str]:
 
 class EventStream:
     """A JSONL sink.  ``path_or_stream`` is a filesystem path (opened
-    append, line-buffered flushes) or any ``.write()``-able object."""
+    append, line-buffered flushes) or any ``.write()``-able object.
 
-    def __init__(self, path_or_stream: Union[str, IO[str]]):
+    ``rotate_bytes``: size-based rotation for long-running sinks (a
+    serve process on ``--trace-events`` must never fill the disk).
+    After any write that leaves the file at or past the threshold the
+    file is atomically renamed to ``PATH.1`` (``os.replace`` - the
+    same one-predecessor pattern as checkpoint ``keep_last``) and a
+    fresh ``PATH`` is opened, so at most ~2x ``rotate_bytes`` is ever
+    on disk.  Path sinks only; ignored for stream objects, which have
+    no name to rename.
+    """
+
+    def __init__(self, path_or_stream: Union[str, IO[str]],
+                 rotate_bytes: Optional[int] = None):
         if isinstance(path_or_stream, (str, bytes)):
+            self._path: Optional[str] = os.fspath(path_or_stream)
             self._fh: IO[str] = open(path_or_stream, "a", encoding="utf-8")
             self._owns = True
         else:
+            self._path = None
             self._fh = path_or_stream
             self._owns = False
+        self._rotate_bytes = (int(rotate_bytes)
+                              if rotate_bytes and self._path else None)
         self._lock = threading.Lock()
 
     def emit(self, event_type: str, **fields: Any) -> Dict[str, Any]:
@@ -296,7 +327,17 @@ class EventStream:
         with self._lock:
             self._fh.write(line + "\n")
             self._fh.flush()
+            if (self._rotate_bytes is not None
+                    and self._fh.tell() >= self._rotate_bytes):
+                self._rotate_locked()
         return record
+
+    def _rotate_locked(self) -> None:
+        """Rename the full file to ``.1`` and reopen fresh (lock held)."""
+        assert self._path is not None
+        self._fh.close()
+        os.replace(self._path, self._path + ".1")
+        self._fh = open(self._path, "a", encoding="utf-8")
 
     def close(self) -> None:
         if self._owns:
@@ -380,19 +421,23 @@ def read_events(path: str) -> list:
 _SINK: Optional[EventStream] = None
 
 
-def configure(path_or_stream: Union[str, IO[str], None]) -> None:
+def configure(path_or_stream: Union[str, IO[str], None],
+              rotate_bytes: Optional[int] = None) -> None:
     """Install (or with ``None`` remove) the process-default event sink.
 
     Instrumented call sites all emit through this module-level sink, so
     one ``configure("trace.jsonl")`` - or the CLI's
     ``--trace-events PATH`` - traces every solve in the process.
+    ``rotate_bytes`` passes through to :class:`EventStream` (path
+    sinks only): long-running serve processes rotate to ``PATH.1``
+    instead of growing without bound.
     """
     global _SINK
     if _SINK is not None:
         _SINK.close()
         _SINK = None
     if path_or_stream is not None:
-        _SINK = EventStream(path_or_stream)
+        _SINK = EventStream(path_or_stream, rotate_bytes=rotate_bytes)
 
 
 def active() -> bool:

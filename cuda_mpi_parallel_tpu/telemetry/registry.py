@@ -24,8 +24,8 @@ import math
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "PERCENTILES", "REGISTRY"]
+__all__ = ["Counter", "Gauge", "Histogram", "MAX_LABEL_SETS",
+           "MetricsRegistry", "PERCENTILES", "REGISTRY"]
 
 #: default histogram buckets (seconds-flavored, matching solve times
 #: from sub-ms resident kernels to multi-minute 256^3 streaming runs)
@@ -35,6 +35,18 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0, 60.0, 300.0)
 #: and ``{name}_p50/_p95/_p99`` Prometheus gauges) - the latency
 #: summary the solver service's SLO reporting consumes
 PERCENTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+#: per-metric label-cardinality cap.  Per-tenant labels made series
+#: count caller-controlled: an adversarial (or merely enthusiastic)
+#: tenant id stream must not grow exposition without bound.  Once a
+#: metric holds this many DISTINCT label sets, updates for new sets
+#: collapse into one ``__other__`` bucket (every label position set to
+#: ``"__other__"``) and the metric's overflow counter increments -
+#: aggregate mass is preserved, per-series attribution is dropped,
+#: memory stays bounded.  Existing series keep updating normally.
+#: Read at update time (not bound at construction) so tests can
+#: monkeypatch a tiny cap.
+MAX_LABEL_SETS = 256
 
 
 def _label_key(labelnames: Sequence[str], labels: Dict[str, str]) -> Tuple:
@@ -75,10 +87,33 @@ class _Metric:
         self.labelnames = tuple(labelnames)
         self._lock = lock if lock is not None else threading.Lock()
         self._children: Dict[Tuple, float] = {}
+        self._label_overflow = 0
+
+    def _bounded_key(self, key: Tuple) -> Tuple:
+        """Route a NEW label set past ``MAX_LABEL_SETS`` into the
+        ``__other__`` bucket (lock held).  Known sets and unlabeled
+        metrics pass through untouched; the overflow bucket itself is
+        not counted against the cap."""
+        if not self.labelnames or key in self._children:
+            return key
+        other = ("__other__",) * len(self.labelnames)
+        distinct = len(self._children) - (other in self._children)
+        if distinct >= MAX_LABEL_SETS:
+            self._label_overflow += 1
+            return other
+        return key
+
+    @property
+    def label_overflow(self) -> int:
+        """How many updates landed in ``__other__`` because the metric
+        was at its label-cardinality cap."""
+        with self._lock:
+            return self._label_overflow
 
     def _update(self, labels: Dict[str, str], fn) -> None:
         key = _label_key(self.labelnames, labels)
         with self._lock:
+            key = self._bounded_key(key)
             self._children[key] = fn(self._children.get(key))
 
     def value(self, **labels: str) -> float:
@@ -93,6 +128,16 @@ class _Metric:
                 for key, val in sorted(self._children.items())
             ]
 
+    def _overflow_lines(self) -> List[str]:
+        """The ``{name}_label_overflow`` companion counter (emitted
+        only once the cap engaged - a quiet metric stays quiet)."""
+        with self._lock:
+            n = self._label_overflow
+        if n <= 0:
+            return []
+        return [f"# TYPE {self.name}_label_overflow counter",
+                f"{self.name}_label_overflow {n}"]
+
     def prometheus_lines(self) -> List[str]:
         lines = []
         if self.help:
@@ -103,6 +148,7 @@ class _Metric:
                 lines.append(
                     f"{self.name}{_format_labels(self.labelnames, key)} "
                     f"{_format_value(val)}")
+        lines.extend(self._overflow_lines())
         return lines
 
 
@@ -167,6 +213,7 @@ class Histogram(_Metric):
         key = _label_key(self.labelnames, labels)
         value = float(value)
         with self._lock:
+            key = self._bounded_key(key)
             child = self._children.get(key)
             if child is None:
                 child = self._children[key] = \
@@ -267,6 +314,7 @@ class Histogram(_Metric):
                     lab = _format_labels(self.labelnames, key)
                     lines.append(
                         f"{self.name}_{pname}{lab} {_format_value(v)}")
+        lines.extend(self._overflow_lines())
         return lines
 
 
@@ -329,11 +377,15 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, dict]:
         """JSON-serializable view of every metric's current state."""
-        return {
-            m.name: {"kind": m.kind, "help": m.help,
+        out: Dict[str, dict] = {}
+        for m in sorted(self.metrics(), key=lambda m: m.name):
+            entry = {"kind": m.kind, "help": m.help,
                      "series": m.snapshot()}
-            for m in sorted(self.metrics(), key=lambda m: m.name)
-        }
+            overflow = m.label_overflow
+            if overflow:
+                entry["label_overflow"] = overflow
+            out[m.name] = entry
+        return out
 
     def to_json(self, **dumps_kwargs) -> str:
         return json.dumps(self.snapshot(), allow_nan=False, **dumps_kwargs)

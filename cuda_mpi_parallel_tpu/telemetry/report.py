@@ -57,6 +57,7 @@ MAX_DRAWN_ITERATIONS = 64
 _HOST_PID = 0
 _SHARD_PID = 1
 _COUNTER_PID = 2
+_REQUEST_PID = 3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -431,6 +432,7 @@ def perfetto_trace(*, iterations: int, elapsed_s: float,
                    sections: Sequence[Tuple[str, float]] = (),
                    flight_history: Optional[np.ndarray] = None,
                    phase_profile=None,
+                   request_spans: Sequence[dict] = (),
                    label: str = "solve") -> dict:
     """Build the Chrome-trace JSON dict (see module docstring).
 
@@ -444,7 +446,12 @@ def perfetto_trace(*, iterations: int, elapsed_s: float,
     renders them (``span_source: "modeled"``).  ``sections``: host
     ``Timer.sections``.  ``flight_history``: a ``(maxiter + 1,)``
     ||r|| array (``FlightRecord.to_history``) drawn as a counter
-    track.  Timestamps are microseconds (the trace-event convention).
+    track.  ``request_spans``: ``"span"`` event records from a traced
+    serve replay (``telemetry.tracing.span_events``) - drawn as a
+    fourth process ("requests"), one thread per trace with the request
+    id as the thread name, so per-request causal chains sit on the
+    same timeline as the solve phases.  Timestamps are microseconds
+    (the trace-event convention).
     """
     prof = None
     if phase_profile is not None:
@@ -475,6 +482,9 @@ def perfetto_trace(*, iterations: int, elapsed_s: float,
         iter_us = _measured_shard_tracks(events, prof, iter_us, drawn)
     else:
         _modeled_shard_tracks(events, shard, shards, iter_us, drawn)
+
+    if request_spans:
+        _request_tracks(events, request_spans)
 
     if flight_history is not None:
         hist = np.asarray(flight_history, dtype=np.float64).reshape(-1)
@@ -507,6 +517,10 @@ def perfetto_trace(*, iterations: int, elapsed_s: float,
     if prof is not None:
         metadata["explained_fraction"] = prof.get("explained_fraction")
         metadata["phase_exchange"] = prof.get("exchange")
+    if request_spans:
+        metadata["n_request_traces"] = len(
+            {s.get("trace_id") for s in request_spans
+             if isinstance(s, dict) and s.get("trace_id")})
     trace = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -585,6 +599,41 @@ def _measured_shard_tracks(events, prof: dict, iter_us: float,
                                  red_us, iteration=i,
                                  span_source="measured"))
     return slot
+
+
+def _request_tracks(events, request_spans: Sequence[dict]) -> None:
+    """The per-request track family: one thread per trace_id under the
+    "requests" process, every span an X event.  Span timestamps are
+    service-clock seconds; they are rebased to the earliest span so
+    the family starts at t=0 like the solve tracks, and emitted in
+    (ts, dur) order per track to satisfy ``validate_perfetto``'s
+    monotonicity contract."""
+    spans = [s for s in request_spans
+             if isinstance(s, dict) and s.get("trace_id")]
+    if not spans:
+        return
+    events.append(_meta(_REQUEST_PID, 0, "process_name", "requests"))
+    t0 = min(float(s.get("start_s", 0.0)) for s in spans)
+    by_trace: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(str(s["trace_id"]), []).append(s)
+    for tid, (trace_id, group) in enumerate(sorted(by_trace.items())):
+        rid = next((s.get("request_id") for s in group
+                    if s.get("request_id")), trace_id[:8])
+        events.append(_meta(_REQUEST_PID, tid, "thread_name", str(rid)))
+        group.sort(key=lambda s: (float(s.get("start_s", 0.0)),
+                                  float(s.get("duration_s", 0.0))))
+        for s in group:
+            args = {"trace_id": trace_id,
+                    "span_id": s.get("span_id")}
+            for key in ("status", "decision", "solve_id", "attempt",
+                        "reason", "tenant", "slo_class"):
+                if s.get(key) is not None:
+                    args[key] = s[key]
+            events.append(_x(
+                _REQUEST_PID, tid, str(s.get("name", "span")),
+                (float(s.get("start_s", 0.0)) - t0) * 1e6,
+                float(s.get("duration_s", 0.0)) * 1e6, **args))
 
 
 def write_perfetto(path: str, trace: dict) -> None:

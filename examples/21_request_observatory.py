@@ -1,0 +1,148 @@
+"""Request observatory: causal traces, SLO burn rates, metered usage.
+
+Three acts on a mesh-4 Poisson operator under a fake service clock:
+
+1. **Trace a mixed outcome workload**: three tenants submit against a
+   tight admission bucket; some requests converge, one is turned away
+   at admission.  Every request leaves a causal span chain
+   (``submit -> admission -> queue_wait -> sched -> solve -> result``)
+   on the event stream, each span carrying a W3C ``traceparent`` and
+   the ``solve`` span carrying the REAL ``solve_id`` of its batch
+   dispatch - the join key into the solve-level telemetry.  The
+   forest is rebuilt from the JSONL alone and rendered; the asserted
+   contract is ZERO orphan spans.
+2. **Burn the error budget**: the rejected tenant's flow trips the
+   fast-window SLO burn tracker (budget 1%, threshold 2x) and emits
+   an edge-triggered ``slo_burn`` event - deterministic on the fake
+   clock, because burn rates are computed on service time, not wall
+   time.
+3. **Reconcile the meter**: the usage ledger apportions each batch's
+   device-seconds / iterations / wire bytes across its lanes; the
+   per-tenant roll-up is re-summed against the batch totals and must
+   agree to float round-off (< 1e-9 relative).
+
+Run:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+      python examples/21_request_observatory.py
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.parallel import make_mesh
+from cuda_mpi_parallel_tpu.serve import (
+    AdmissionConfig,
+    ServiceConfig,
+    SolverService,
+    TokenBucket,
+)
+from cuda_mpi_parallel_tpu.telemetry import events, tracing
+from cuda_mpi_parallel_tpu.telemetry.slo import SLOConfig, SLOWindow
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def main() -> int:
+    clock = FakeClock()
+    a = poisson.poisson_2d_csr(16, 16, dtype=np.float64)
+    rng = np.random.default_rng(0)
+    mk_b = lambda: np.asarray(a @ rng.standard_normal(a.shape[0]))  # noqa: E731
+
+    with events.capture() as buf:
+        svc = SolverService(ServiceConfig(
+            clock=clock, max_batch=4, max_wait_s=0.01, maxiter=500,
+            usage=True,
+            # per-tenant buckets: 2 tokens each, no meaningful refill,
+            # so tenant "lab"'s third request is turned away
+            admission=AdmissionConfig(
+                default=TokenBucket(rate=0.001, burst=2)),
+            # "lab" sees 3 samples total (2 good + the rejection), so
+            # the sample floor must sit at 3 for the trip to arm
+            slo=SLOConfig(windows=(SLOWindow("fast", 5.0, 2.0),),
+                          budget=0.01, min_samples=3)))
+        h = svc.register(a, mesh=make_mesh(4))
+
+        print("== act 1: traced mixed-outcome workload ==")
+        futs = []
+        for i in range(6):
+            futs.append(svc.submit(
+                h, mk_b(), tol=1e-8,
+                tenant=["acme", "bulkco", "lab"][i % 3]))
+        rejected = svc.submit(h, mk_b(), tol=1e-8, tenant="lab")
+        clock.t = 0.011
+        svc.pump()
+        results = [f.result(timeout=60) for f in futs]
+        rej = rejected.result(timeout=60)
+        assert all(r.converged for r in results)
+        assert rej.status == "ADMISSION_REJECTED", rej.status
+        stats = svc.stats()
+        svc.close()
+
+    recs = [json.loads(ln) for ln in buf.getvalue().splitlines()
+            if ln.strip()]
+    spans = tracing.span_events(recs)
+    orphans = tracing.orphan_spans(recs)
+    forest = tracing.build_forest(recs)
+    print(f"  {len(spans)} spans in {len(forest)} traces, "
+          f"{len(orphans)} orphans")
+    assert len(forest) == 7 and not orphans
+    dispatch_ids = {e["solve_id"] for e in recs
+                    if e["event"] == "batch_dispatch"}
+    solve_ids = {s["solve_id"] for s in spans if s["name"] == "solve"}
+    assert solve_ids <= dispatch_ids
+    print(f"  solve spans join batch telemetry: "
+          f"{sorted(solve_ids)} <= {sorted(dispatch_ids)}")
+    # render one converged trace and the rejected one
+    rej_tid = next(s["trace_id"] for s in spans
+                   if s.get("status") == "ADMISSION_REJECTED")
+    ok_tid = next(s["trace_id"] for s in spans
+                  if s.get("status") == "CONVERGED")
+    for tid, tag in ((ok_tid, "converged"), (rej_tid, "rejected")):
+        print(f"  -- {tag} request --")
+        for line in tracing.render_tree(recs, tid).splitlines():
+            print(f"  {line}")
+
+    print("== act 2: SLO burn on the rejected flow ==")
+    burns = [e for e in recs if e["event"] == "slo_burn"]
+    assert burns, "expected the rejection to trip the fast window"
+    for b in burns:
+        print(f"  slo_burn tenant={b['tenant']} window={b['window']} "
+              f"burn_rate={b['burn_rate']:.1f}x budget "
+              f"at t_service={b['t_service']}")
+
+    print("== act 3: usage ledger reconciliation ==")
+    usage = stats["usage"]
+    err = usage["reconcile_max_rel_err"]
+    print(f"  totals: {usage['totals']['requests']} requests, "
+          f"{usage['totals']['device_seconds']:.4f} device-s, "
+          f"{usage['totals']['wire_bytes']:.0f} wire bytes")
+    for tenant, row in sorted(usage["per_tenant"].items()):
+        print(f"  {tenant:8s} {row['requests']:2d} req "
+              f"{row['device_seconds']:.4f} device-s "
+              f"{row['wire_bytes']:10.0f} wire B")
+    print(f"  reconcile max rel err: {err:.3e}")
+    assert err < 1e-9
+
+    print("request observatory example OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

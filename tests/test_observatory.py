@@ -1,0 +1,849 @@
+"""Request observatory tests: causal tracing, SLO burn accounting,
+metered usage (telemetry.tracing / telemetry.slo / serve.usage).
+
+The acceptance surface of the observatory PR:
+
+* trace completeness - EVERY terminal path of the service (success,
+  ERROR-retry, TIMEOUT, breaker REFUSED, ADMISSION_REJECTED, mesh
+  migration) produces a span chain reachable from its ``submit``
+  root, with zero orphans, on both the manual fake-clock harness and
+  a threaded mesh-4 replay;
+* ``solve`` spans carry the real ``solve_id`` of their batch
+  dispatch, joining the request view to the solve-level telemetry;
+* SLO burn-rate trips are edge-triggered and bit-deterministic on
+  the fake clock;
+* the usage ledger's per-tenant shares reconcile with its batch
+  totals to float round-off (gated 1e-9);
+* zero perturbation - with tracing + SLO + usage all active the
+  traced solve's jaxpr is bit-identical, and a traced replay's batch
+  log matches an untraced one bit-for-bit;
+* the registry's label-cardinality cap and the event sink's size
+  rotation (satellites) hold under abuse.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import telemetry
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.parallel import make_mesh
+from cuda_mpi_parallel_tpu.serve import (
+    AdmissionConfig,
+    RetryPolicy,
+    ServiceConfig,
+    SolverService,
+    TokenBucket,
+    UsageLedger,
+)
+from cuda_mpi_parallel_tpu.telemetry import events, registry, tracing
+from cuda_mpi_parallel_tpu.telemetry.slo import (
+    SLOConfig,
+    SLOTracker,
+    SLOWindow,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def manual_service(**kw):
+    clock = FakeClock()
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_s", 0.010)
+    kw.setdefault("maxiter", 500)
+    svc = SolverService(ServiceConfig(clock=clock, **kw))
+    return svc, clock
+
+
+def poisson_csr(n=12, dtype=np.float64):
+    return poisson.poisson_2d_csr(n, n, dtype=dtype)
+
+
+def _captured(buf):
+    return [json.loads(ln) for ln in buf.getvalue().splitlines()
+            if ln.strip()]
+
+
+def _rhs(a, rng):
+    return np.asarray(a @ rng.standard_normal(a.shape[0]))
+
+
+# ---------------------------------------------------------------------------
+# W3C trace-context plumbing
+
+
+class TestTraceparent:
+    def test_round_trip(self):
+        tid, sid = tracing.new_trace_id(), tracing.new_span_id()
+        assert len(tid) == 32 and len(sid) == 16
+        header = tracing.format_traceparent(tid, sid)
+        assert tracing.parse_traceparent(header) == (tid, sid)
+
+    def test_ids_unique_and_hex(self):
+        tids = {tracing.new_trace_id() for _ in range(64)}
+        assert len(tids) == 64
+        assert all(not t.strip("0123456789abcdef") for t in tids)
+
+    @pytest.mark.parametrize("bad", [
+        "",
+        "00-abc-def-01",
+        "01-" + "a" * 32 + "-" + "b" * 16 + "-01",   # wrong version
+        "00-" + "A" * 32 + "-" + "b" * 16 + "-01",   # uppercase hex
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",   # all-zero id
+        "00-" + "a" * 32 + "-" + "b" * 16,           # missing flags
+    ])
+    def test_malformed_rejected(self, bad):
+        with pytest.raises(ValueError):
+            tracing.parse_traceparent(bad)
+
+    def test_span_events_carry_traceparent(self):
+        with events.capture() as buf:
+            tr = tracing.RequestTrace("r-1")
+            tr.span("submit", start_s=0.0, duration_s=0.0, root=True)
+            tr.span("result", start_s=1.0, duration_s=0.0,
+                    status="CONVERGED")
+        recs = _captured(buf)
+        assert all(
+            tracing.parse_traceparent(r["traceparent"])
+            == (r["trace_id"], r["span_id"]) for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# forest analysis primitives
+
+
+class TestSpanForest:
+    def _chain(self):
+        with events.capture() as buf:
+            tr = tracing.RequestTrace("r-1")
+            tr.span("submit", start_s=0.0, duration_s=0.0, root=True)
+            tr.span("admission", start_s=0.0, duration_s=0.0,
+                    decision="accepted")
+            tr.span("queue_wait", start_s=0.0, duration_s=0.5)
+            tr.span("result", start_s=0.5, duration_s=0.0,
+                    status="CONVERGED")
+        return _captured(buf)
+
+    def test_complete_chain_has_no_orphans(self):
+        recs = self._chain()
+        assert tracing.orphan_spans(recs) == []
+        forest = tracing.build_forest(recs)
+        assert len(forest) == 1
+        (tree,) = forest.values()
+        assert tree["root"]["name"] == "submit"
+        assert len(tree["spans"]) == 4
+
+    def test_missing_root_orphans_whole_trace(self):
+        recs = [r for r in self._chain() if r["name"] != "submit"]
+        assert len(tracing.orphan_spans(recs)) == 3
+
+    def test_dangling_parent_is_orphan(self):
+        recs = self._chain()
+        recs[2]["parent_span_id"] = "f" * 16    # nonexistent parent
+        orphans = tracing.orphan_spans(recs)
+        # queue_wait and everything chained under it fall off the tree
+        assert {o["name"] for o in orphans} == {"queue_wait", "result"}
+
+    def test_render_tree_relative_times(self):
+        recs = self._chain()
+        out = tracing.render_tree(recs, recs[0]["trace_id"])
+        assert "submit" in out and "result" in out
+        assert "[status=CONVERGED]" in out
+
+    def test_unknown_span_name_rejected(self):
+        tr = tracing.RequestTrace("r-1")
+        with pytest.raises(ValueError, match="unknown span name"):
+            tr.span("teleport", start_s=0.0, duration_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# trace completeness: every terminal path of the service
+
+
+class TestTraceCompleteness:
+    def test_success_path_full_chain(self):
+        svc, clock = manual_service()
+        a = poisson_csr()
+        rng = np.random.default_rng(2)
+        with events.capture() as buf:
+            h = svc.register(a)
+            futs = [svc.submit(h, _rhs(a, rng), tol=1e-8)
+                    for _ in range(3)]
+            clock.advance(0.011)
+            svc.pump()
+        try:
+            assert all(f.result(timeout=10).converged for f in futs)
+        finally:
+            svc.close()
+        recs = _captured(buf)
+        assert tracing.orphan_spans(recs) == []
+        forest = tracing.build_forest(recs)
+        assert len(forest) == 3
+        dispatch_ids = {e["solve_id"] for e in recs
+                        if e["event"] == "batch_dispatch"}
+        for tree in forest.values():
+            names = [s["name"] for s in sorted(
+                tree["spans"].values(),
+                key=lambda s: (s["start_s"], s["span_id"]))]
+            assert names[0] == "submit" and names[-1] == "result"
+            assert set(names) == {"submit", "admission", "queue_wait",
+                                  "sched", "solve", "result"}
+            solve = next(s for s in tree["spans"].values()
+                         if s["name"] == "solve")
+            assert solve["solve_id"] in dispatch_ids
+            result = next(s for s in tree["spans"].values()
+                          if s["name"] == "result")
+            assert result["status"] == "CONVERGED"
+
+    def test_timeout_path_terminal_span(self):
+        svc, clock = manual_service()
+        a = poisson_csr()
+        rng = np.random.default_rng(3)
+        with events.capture() as buf:
+            h = svc.register(a)
+            fut = svc.submit(h, _rhs(a, rng), tol=1e-8,
+                             deadline_s=0.001)
+            clock.advance(0.011)
+            svc.pump()
+        try:
+            assert fut.result(timeout=10).status == "TIMEOUT"
+        finally:
+            svc.close()
+        recs = _captured(buf)
+        assert tracing.orphan_spans(recs) == []
+        results = [s for s in tracing.span_events(recs)
+                   if s["name"] == "result"]
+        assert [s["status"] for s in results] == ["TIMEOUT"]
+        waits = [s for s in tracing.span_events(recs)
+                 if s["name"] == "queue_wait"]
+        assert waits and waits[0]["duration_s"] == pytest.approx(0.011)
+
+    def test_admission_rejected_path(self):
+        svc, clock = manual_service(
+            admission=AdmissionConfig(
+                default=TokenBucket(rate=0.001, burst=1)))
+        a = poisson_csr()
+        rng = np.random.default_rng(4)
+        with events.capture() as buf:
+            h = svc.register(a)
+            ok = svc.submit(h, _rhs(a, rng), tol=1e-8)
+            rejected = svc.submit(h, _rhs(a, rng), tol=1e-8)
+            clock.advance(0.011)
+            svc.pump()
+        try:
+            assert ok.result(timeout=10).converged
+            assert rejected.result(timeout=10).status \
+                == "ADMISSION_REJECTED"
+        finally:
+            svc.close()
+        recs = _captured(buf)
+        assert tracing.orphan_spans(recs) == []
+        forest = tracing.build_forest(recs)
+        rej_tree = next(
+            t for t in forest.values()
+            if any(s["name"] == "result"
+                   and s["status"] == "ADMISSION_REJECTED"
+                   for s in t["spans"].values()))
+        admission = next(s for s in rej_tree["spans"].values()
+                         if s["name"] == "admission")
+        assert admission["decision"] == "rejected"
+        # the rejected request never reached the queue or a solve
+        assert {s["name"] for s in rej_tree["spans"].values()} \
+            == {"submit", "admission", "result"}
+
+    def test_refused_breaker_path(self):
+        svc, clock = manual_service(max_batch=1, max_wait_s=0.0,
+                                    breaker_threshold=1,
+                                    breaker_cooldown_s=5.0)
+        a = poisson_csr(8)
+        rng = np.random.default_rng(5)
+
+        def explode(*args, **kw):
+            raise RuntimeError("engine down")
+
+        with events.capture() as buf:
+            h = svc.register(a)
+            svc._engine = explode
+            failed = svc.submit(h, _rhs(a, rng), tol=1e-8)
+            svc.pump()
+            refused = svc.submit(h, _rhs(a, rng), tol=1e-8)
+        try:
+            assert failed.result(timeout=10).status == "ERROR"
+            assert refused.result(timeout=10).status == "REFUSED"
+        finally:
+            svc.close()
+        recs = _captured(buf)
+        assert tracing.orphan_spans(recs) == []
+        forest = tracing.build_forest(recs)
+        statuses = sorted(
+            s["status"] for t in forest.values()
+            for s in t["spans"].values() if s["name"] == "result")
+        assert statuses == ["ERROR", "REFUSED"]
+        ref_tree = next(
+            t for t in forest.values()
+            if any(s.get("status") == "REFUSED"
+                   for s in t["spans"].values()))
+        admission = next(s for s in ref_tree["spans"].values()
+                         if s["name"] == "admission")
+        assert admission["decision"] == "refused"
+        assert admission["reason"] == "breaker_open"
+
+    def test_retry_chains_attempts_in_one_trace(self):
+        svc, clock = manual_service(
+            max_batch=1, max_wait_s=0.0,
+            retry=RetryPolicy(max_retries=1, backoff_s=0.5))
+        a = poisson_csr(8)
+        rng = np.random.default_rng(6)
+        with events.capture() as buf:
+            h = svc.register(a)
+            orig, calls = svc._engine, [0]
+
+            def flaky(*args, **kw):
+                calls[0] += 1
+                if calls[0] == 1:
+                    raise RuntimeError("transient")
+                return orig(*args, **kw)
+
+            svc._engine = flaky
+            fut = svc.submit(h, _rhs(a, rng), tol=1e-8)
+            svc.pump()                   # attempt 1 fails, parks retry
+            clock.advance(0.6)
+            svc.pump()                   # attempt 2 converges
+        try:
+            res = fut.result(timeout=10)
+            assert res.status == "CONVERGED" and res.attempts == 2
+        finally:
+            svc.close()
+        recs = _captured(buf)
+        assert tracing.orphan_spans(recs) == []
+        forest = tracing.build_forest(recs)
+        assert len(forest) == 1          # both attempts share ONE trace
+        (tree,) = forest.values()
+        names = [s["name"] for s in tree["spans"].values()]
+        assert names.count("solve") == 2
+        assert names.count("retry") == 1
+        assert names.count("result") == 1
+        solves = sorted((s for s in tree["spans"].values()
+                         if s["name"] == "solve"),
+                        key=lambda s: s["start_s"])
+        assert solves[0]["status"] == "ERROR"
+        assert solves[1]["status"] == "CONVERGED"
+
+    def test_migration_span_joins_queued_traces(self):
+        a = poisson_csr(16)    # 240-ish rows not needed; mesh divides
+        svc, clock = manual_service()
+        rng = np.random.default_rng(7)
+        with events.capture() as buf:
+            h = svc.register(a, mesh=make_mesh(4))
+            futs = [svc.submit(h, _rhs(a, rng), tol=1e-8)
+                    for _ in range(3)]
+            svc.migrate(h, n_devices=2)
+            clock.advance(1.0)
+            svc.pump()
+        try:
+            assert all(f.result(timeout=30).converged for f in futs)
+        finally:
+            svc.close()
+        recs = _captured(buf)
+        assert tracing.orphan_spans(recs) == []
+        forest = tracing.build_forest(recs)
+        assert len(forest) == 3
+        for tree in forest.values():
+            mig = [s for s in tree["spans"].values()
+                   if s["name"] == "migration"]
+            assert len(mig) == 1
+            assert (mig[0]["n_shards_from"],
+                    mig[0]["n_shards_to"]) == (4, 2)
+
+    @pytest.mark.parametrize("mesh_n", [4])
+    def test_threaded_mesh_replay_every_done_traced(self, mesh_n,
+                                                    tmp_path):
+        """Real-clock threaded worker on a mesh-4 operator: every
+        request_done event's request has a terminal result span and
+        the forest has zero orphans - completeness under concurrency,
+        not just under the manual pump."""
+        path = str(tmp_path / "events.jsonl")
+        telemetry.configure(path)
+        a = poisson_csr(16)
+        rng = np.random.default_rng(8)
+        try:
+            svc = SolverService(ServiceConfig(
+                max_batch=4, max_wait_s=0.002, maxiter=500,
+                usage=True))
+            try:
+                h = svc.register(a, mesh=make_mesh(mesh_n))
+                futs = [svc.submit(h, _rhs(a, rng), tol=1e-8,
+                                   tenant=f"t{i % 3}")
+                        for i in range(12)]
+                results = [f.result(timeout=60) for f in futs]
+                ledger = svc.usage_ledger()
+                assert ledger is not None
+                assert ledger.reconcile() < 1e-9
+            finally:
+                svc.close()
+        finally:
+            telemetry.configure(None)
+        assert all(r.converged for r in results)
+        recs = events.read_events(path)
+        assert tracing.orphan_spans(recs) == []
+        spans = tracing.span_events(recs)
+        result_rids = {s["request_id"] for s in spans
+                       if s["name"] == "result"}
+        done_rids = {e["request_id"] for e in recs
+                     if e["event"] == "request_done"}
+        assert done_rids and done_rids <= result_rids
+        # solve spans join the batch telemetry by solve_id
+        dispatch_ids = {e["solve_id"] for e in recs
+                        if e["event"] == "batch_dispatch"}
+        assert {s["solve_id"] for s in spans
+                if s["name"] == "solve"} <= dispatch_ids
+
+
+# ---------------------------------------------------------------------------
+# SLO burn accounting
+
+
+class TestSLOBurn:
+    def _config(self, **kw):
+        kw.setdefault("windows", (SLOWindow("fast", 10.0, 2.0),))
+        kw.setdefault("budget", 0.1)
+        kw.setdefault("min_samples", 4)
+        return SLOConfig(**kw)
+
+    def test_burn_trips_edge_triggered_and_rearms(self):
+        tracker = SLOTracker(self._config())
+        with events.capture() as buf:
+            for i in range(4):
+                tracker.observe("acme", "gold", float(i) * 0.1, True)
+            # 4 good, then bad ones: at 4g/1b bad_ratio=0.2, burn=2.0
+            tracker.observe("acme", "gold", 0.5, False)
+            tracker.observe("acme", "gold", 0.6, False)   # still tripped
+        burns = [r for r in _captured(buf) if r["event"] == "slo_burn"]
+        assert len(burns) == 1            # edge-triggered, not repeated
+        assert burns[0]["tenant"] == "acme"
+        assert burns[0]["window"] == "fast"
+        assert burns[0]["burn_rate"] >= 2.0
+        # window rolls past the bad samples -> re-arms -> trips again
+        with events.capture() as buf2:
+            for i in range(8):
+                tracker.observe("acme", "gold", 20.0 + i * 0.1, True)
+            for i in range(3):
+                tracker.observe("acme", "gold", 21.0 + i * 0.1, False)
+        burns2 = [r for r in _captured(buf2)
+                  if r["event"] == "slo_burn"]
+        assert len(burns2) == 1
+
+    def test_min_samples_floor_suppresses_cold_start(self):
+        tracker = SLOTracker(self._config(min_samples=8))
+        with events.capture() as buf:
+            tracker.observe("acme", "gold", 0.0, False)
+            tracker.observe("acme", "gold", 0.1, False)
+        assert [r for r in _captured(buf)
+                if r["event"] == "slo_burn"] == []
+        assert tracker.burn_rate("acme", "gold", 0.2) == 0.0
+
+    def test_burn_rate_hook_and_unknown_flow(self):
+        tracker = SLOTracker(self._config())
+        for i in range(4):
+            tracker.observe("acme", "gold", float(i) * 0.01,
+                            i % 2 == 0)   # 2 good / 2 bad
+        assert tracker.burn_rate("acme", "gold", 0.05) \
+            == pytest.approx(0.5 / 0.1)
+        assert tracker.burn_rate("ghost", "gold", 0.05) == 0.0
+        with pytest.raises(ValueError, match="unknown SLO window"):
+            tracker.burn_rate("acme", "gold", 0.05, window="nope")
+
+    def test_fake_clock_service_burn_deterministic(self):
+        """The same scripted workload trips the same burn at the same
+        service time, twice - rejections burn the rejected flow's
+        budget and the trip count is exactly reproducible."""
+
+        def run():
+            svc, clock = manual_service(
+                slo=SLOConfig(windows=(SLOWindow("fast", 5.0, 2.0),),
+                              budget=0.1, min_samples=2),
+                admission=AdmissionConfig(
+                    default=TokenBucket(rate=0.001, burst=2)))
+            a = poisson_csr()
+            rng = np.random.default_rng(9)
+            with events.capture() as buf:
+                h = svc.register(a)
+                futs = [svc.submit(h, _rhs(a, rng), tol=1e-8,
+                                   tenant="hot")
+                        for _ in range(2)]
+                rejected = [svc.submit(h, _rhs(a, rng), tol=1e-8,
+                                       tenant="hot")
+                            for _ in range(2)]
+                clock.advance(0.011)
+                svc.pump()
+            try:
+                [f.result(timeout=10) for f in futs + rejected]
+            finally:
+                svc.close()
+            return [
+                (r["tenant"], r["slo_class"], r["window"],
+                 r["burn_rate"], r["t_service"])
+                for r in _captured(buf) if r["event"] == "slo_burn"]
+
+        first, second = run(), run()
+        assert first and first == second
+
+    def test_stats_section_present(self):
+        svc, clock = manual_service(slo=SLOConfig(min_samples=1))
+        a = poisson_csr()
+        rng = np.random.default_rng(10)
+        try:
+            h = svc.register(a)
+            fut = svc.submit(h, _rhs(a, rng), tol=1e-8)
+            clock.advance(0.011)
+            svc.pump()
+            assert fut.result(timeout=10).converged
+            snap = svc.stats()["slo"]
+            assert snap["budget"] == pytest.approx(0.01)
+            (flow,) = snap["flows"].values()
+            assert flow["fast"]["n"] == 1
+            assert flow["fast"]["tripped"] is False
+        finally:
+            svc.close()
+
+    def test_invalid_configs_rejected(self):
+        with pytest.raises(ValueError, match="at least one window"):
+            SLOConfig(windows=())
+        with pytest.raises(ValueError, match="budget"):
+            SLOConfig(budget=0.0)
+        with pytest.raises(ValueError, match="seconds"):
+            SLOWindow("w", 0.0, 1.0)
+        with pytest.raises(TypeError):
+            SolverService(ServiceConfig(slo=object()))
+
+
+# ---------------------------------------------------------------------------
+# metered usage
+
+
+class TestUsageLedger:
+    def test_apportionment_reconciles_exactly(self):
+        ledger = UsageLedger()
+        rng = np.random.default_rng(11)
+        for i in range(50):
+            m = int(rng.integers(1, 7))
+            ledger.note_batch(
+                solve_id=f"s{i}", handle="h", solve_s=float(
+                    rng.uniform(1e-4, 0.3)),
+                mesh_size=int(rng.integers(1, 5)),
+                batch_iterations=int(rng.integers(1, 400)),
+                wire_bytes_per_iteration=float(
+                    rng.uniform(0.0, 1e6)),
+                lanes=[{"request_id": f"r{i}-{j}",
+                        "tenant": f"t{int(rng.integers(0, 5))}",
+                        "slo_class": "silver", "iterations": 10,
+                        "trace_id": None} for j in range(m)])
+        assert ledger.reconcile() < 1e-9
+        totals = ledger.batch_totals()
+        per_tenant = ledger.per_tenant()
+        assert totals["requests"] == sum(
+            v["requests"] for v in per_tenant.values())
+
+    def test_empty_batch_ignored(self):
+        ledger = UsageLedger()
+        ledger.note_batch(solve_id="s0", handle="h", solve_s=1.0,
+                          mesh_size=4, batch_iterations=10,
+                          wire_bytes_per_iteration=100.0, lanes=[])
+        assert ledger.batch_totals()["batches"] == 0
+
+    def test_device_seconds_scale_with_mesh(self):
+        ledger = UsageLedger()
+        ledger.note_batch(solve_id="s0", handle="h", solve_s=0.5,
+                          mesh_size=4, batch_iterations=10,
+                          wire_bytes_per_iteration=8.0,
+                          lanes=[{"request_id": "r0", "tenant": "a",
+                                  "slo_class": "gold",
+                                  "iterations": 10,
+                                  "trace_id": None}])
+        totals = ledger.batch_totals()
+        assert totals["device_seconds"] == pytest.approx(2.0)
+        assert totals["wire_bytes"] == pytest.approx(80.0)
+
+    def test_export_round_trips_through_usage_report(self, tmp_path):
+        ledger = UsageLedger()
+        for i in range(3):
+            ledger.note_batch(
+                solve_id=f"s{i}", handle="h", solve_s=0.1,
+                mesh_size=2, batch_iterations=20,
+                wire_bytes_per_iteration=64.0,
+                lanes=[{"request_id": f"r{i}-{j}",
+                        "tenant": ["acme", "bulkco"][j % 2],
+                        "slo_class": "silver", "iterations": 20,
+                        "trace_id": None} for j in range(3)])
+        path = str(tmp_path / "usage.jsonl")
+        n = ledger.export_jsonl(path)
+        assert n == 9 + 3 + 1          # requests + batches + summary
+        import subprocess
+        import sys
+        out = subprocess.run(
+            [sys.executable, "tools/usage_report.py", path, "--json"],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        assert out.returncode == 0, out.stderr
+        rec = json.loads(out.stdout)
+        assert rec["ok"] is True
+        assert rec["per_tenant"]["acme"]["requests"] == 6
+        assert rec["per_tenant"]["bulkco"]["requests"] == 3
+
+    def test_usage_report_rejects_tampered_ledger(self, tmp_path):
+        ledger = UsageLedger()
+        ledger.note_batch(
+            solve_id="s0", handle="h", solve_s=0.1, mesh_size=2,
+            batch_iterations=20, wire_bytes_per_iteration=64.0,
+            lanes=[{"request_id": "r0", "tenant": "acme",
+                    "slo_class": "silver", "iterations": 20,
+                    "trace_id": None}])
+        path = str(tmp_path / "usage.jsonl")
+        ledger.export_jsonl(path)
+        lines = open(path).read().splitlines()
+        doctored = []
+        for ln in lines:
+            rec = json.loads(ln)
+            if rec["kind"] == "request":
+                rec["device_seconds"] *= 2.0   # cook the books
+            doctored.append(json.dumps(rec))
+        with open(path, "w") as f:
+            f.write("\n".join(doctored) + "\n")
+        import subprocess
+        import sys
+        out = subprocess.run(
+            [sys.executable, "tools/usage_report.py", path],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        assert out.returncode == 1
+        assert "reconcile" in out.stderr
+
+    def test_service_meters_batches_and_emits_usage_events(self):
+        svc, clock = manual_service(usage=True)
+        a = poisson_csr()
+        rng = np.random.default_rng(12)
+        with events.capture() as buf:
+            h = svc.register(a)
+            futs = [svc.submit(h, _rhs(a, rng), tol=1e-8,
+                               tenant=["acme", "bulkco"][i % 2])
+                    for i in range(4)]
+            clock.advance(0.011)
+            svc.pump()
+        try:
+            assert all(f.result(timeout=10).converged for f in futs)
+            snap = svc.stats()["usage"]
+        finally:
+            svc.close()
+        assert snap["totals"]["requests"] == 4
+        assert snap["reconcile_max_rel_err"] < 1e-9
+        assert set(snap["per_tenant"]) == {"acme", "bulkco"}
+        usages = [r for r in _captured(buf) if r["event"] == "usage"]
+        assert usages                       # one per metered batch
+        assert sum(u["n_requests"] for u in usages) == 4
+        assert all(u["device_seconds"] > 0.0 for u in usages)
+
+    def test_usage_off_is_free(self):
+        svc, _ = manual_service()
+        try:
+            assert svc.usage_ledger() is None
+            assert "usage" not in svc.stats()
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# zero perturbation: the observatory must not touch the computation
+
+
+class TestZeroPerturbation:
+    def test_solver_jaxpr_identical_with_observatory_active(self):
+        """The traced solve is bit-identical with tracing + SLO +
+        usage all live (everything is host-side post-solve work)."""
+        from cuda_mpi_parallel_tpu.solver import cg
+        from cuda_mpi_parallel_tpu.models.operators import Stencil2D
+
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones(256)
+
+        def jaxpr():
+            return str(jax.make_jaxpr(
+                lambda v: cg(a, v, maxiter=25))(b))
+
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        base = jaxpr()
+        try:
+            with events.capture():
+                telemetry.force_active(True)
+                tr = tracing.RequestTrace("probe")
+                tr.span("submit", start_s=0.0, duration_s=0.0,
+                        root=True)
+                tracker = SLOTracker(SLOConfig(min_samples=1))
+                tracker.observe("t", "gold", 0.0, True)
+                ledger = UsageLedger()
+                ledger.note_batch(
+                    solve_id="s", handle="h", solve_s=0.1,
+                    mesh_size=1, batch_iterations=1,
+                    wire_bytes_per_iteration=0.0,
+                    lanes=[{"request_id": "r", "tenant": "t",
+                            "slo_class": "gold", "iterations": 1,
+                            "trace_id": tr.trace_id}])
+                instrumented = jaxpr()
+        finally:
+            telemetry.force_active(False)
+        assert instrumented == base
+
+    def test_batch_log_bit_identical_traced_vs_untraced(self):
+        """The same fake-clock workload produces the same batch log -
+        same solve outcomes, iterations, residuals - whether or not
+        the observatory watched it."""
+
+        def run(traced):
+            svc, clock = manual_service(
+                usage=traced,
+                slo=SLOConfig(min_samples=1) if traced else None)
+            a = poisson_csr()
+            rng = np.random.default_rng(13)
+            try:
+                if traced:
+                    with events.capture():
+                        h = svc.register(a)
+                        futs = [svc.submit(h, _rhs(a, rng), tol=1e-8)
+                                for _ in range(4)]
+                        clock.advance(0.011)
+                        svc.pump()
+                        results = [f.result(timeout=10) for f in futs]
+                else:
+                    h = svc.register(a)
+                    futs = [svc.submit(h, _rhs(a, rng), tol=1e-8)
+                            for _ in range(4)]
+                    clock.advance(0.011)
+                    svc.pump()
+                    results = [f.result(timeout=10) for f in futs]
+                log = svc.batch_log()
+            finally:
+                svc.close()
+            outcomes = [(r.status, r.iterations,
+                         float(r.residual_norm),
+                         r.x.tobytes() if r.x is not None else None)
+                        for r in results]
+            # solve_id is per-run entropy and solve_s is real wall
+            # time - both vary run to run with or without tracing
+            slim = [{k: v for k, v in b.items()
+                     if k not in ("solve_id", "solve_s")}
+                    for b in log]
+            return outcomes, slim
+
+        assert run(traced=False) == run(traced=True)
+
+
+# ---------------------------------------------------------------------------
+# satellites: registry cardinality cap + event sink rotation
+
+
+class TestLabelCardinalityCap:
+    def test_ten_thousand_tenants_bounded(self, monkeypatch):
+        monkeypatch.setattr(registry, "MAX_LABEL_SETS", 32)
+        reg = registry.MetricsRegistry()
+        c = reg.counter("tenant_requests_total", "per-tenant",
+                        labelnames=("tenant",))
+        for i in range(10_000):
+            c.inc(1.0, tenant=f"tenant-{i}")
+        series = c.snapshot()
+        # 32 real series + the __other__ bucket, never 10k
+        assert len(series) <= 33
+        assert c.label_overflow == 10_000 - 32
+        assert c.value(tenant="__other__") == 10_000 - 32
+        # aggregate mass preserved
+        assert sum(s["value"] for s in series) == 10_000
+        text = reg.to_prometheus()
+        assert "tenant_requests_total_label_overflow" in text
+        assert text.count('tenant="tenant-') <= 32
+
+    def test_existing_series_keep_updating_past_cap(self, monkeypatch):
+        monkeypatch.setattr(registry, "MAX_LABEL_SETS", 2)
+        reg = registry.MetricsRegistry()
+        g = reg.gauge("tenant_depth", "", labelnames=("tenant",))
+        g.set(1.0, tenant="a")
+        g.set(2.0, tenant="b")
+        g.set(9.0, tenant="c")           # new set past cap -> __other__
+        g.set(5.0, tenant="a")           # existing set still addressable
+        assert g.value(tenant="a") == 5.0
+        assert g.value(tenant="__other__") == 9.0
+        assert g.label_overflow == 1
+
+    def test_histogram_capped_too(self, monkeypatch):
+        monkeypatch.setattr(registry, "MAX_LABEL_SETS", 2)
+        reg = registry.MetricsRegistry()
+        hist = reg.histogram("lat", "", labelnames=("tenant",))
+        for i in range(10):
+            hist.observe(0.01, tenant=f"t{i}")
+        assert hist.label_overflow == 8
+        snap = reg.snapshot()["lat"]
+        assert snap["label_overflow"] == 8
+
+
+class TestEventRotation:
+    def test_rotate_at_size_threshold(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        telemetry.configure(path, rotate_bytes=2000)
+        try:
+            for i in range(100):
+                events.emit("solve_start", label=f"solve-{i}",
+                            padding="x" * 50)
+        finally:
+            telemetry.configure(None)
+        rotated = path + ".1"
+        assert os.path.exists(rotated)
+        # the live file is bounded: rotation fires right after the
+        # write that crosses the threshold
+        assert os.path.getsize(path) < 2000 + 200
+        assert os.path.getsize(rotated) < 2000 + 200
+        # single-slot rotation: old generations are dropped, but the
+        # retained tail is a torn-line-free contiguous suffix
+        all_lines = (open(rotated).read().splitlines()
+                     + open(path).read().splitlines())
+        recs = [json.loads(ln) for ln in all_lines if ln.strip()]
+        labels = [r["label"] for r in recs]
+        n = len(labels)
+        assert 0 < n < 100
+        assert labels == [f"solve-{i}" for i in range(100 - n, 100)]
+
+    def test_no_rotation_without_opt_in(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        telemetry.configure(path)
+        try:
+            for i in range(50):
+                events.emit("solve_start", label=f"s{i}",
+                            padding="y" * 100)
+        finally:
+            telemetry.configure(None)
+        assert not os.path.exists(path + ".1")
+        assert len(events.read_events(path)) == 50
+
+    def test_stream_sink_ignores_rotation(self):
+        import io
+        buf = io.StringIO()
+        stream = events.EventStream(buf, rotate_bytes=100)
+        for i in range(20):
+            stream.emit("solve_start", label=f"s{i}")
+        recs = [json.loads(ln) for ln in
+                buf.getvalue().splitlines() if ln.strip()]
+        assert len(recs) == 20

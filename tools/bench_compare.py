@@ -198,6 +198,19 @@ METRIC_DIRECTION = {
     "recycle.iters_saved_pct_poisson": None,
     "recycle.harvest_overhead_pct_skewed": None,
     "recycle.harvest_overhead_pct_poisson": None,
+    # request-observatory columns (telemetry.tracing + serve.usage):
+    # the tracing-on overhead % of a serve replay, span volume, and
+    # the metered per-batch usage totals of the traced replay.
+    # Reported, never gated - the overhead rides replay walls (host
+    # scheduling weather) and the usage totals track the bench
+    # workload, not the code; pre-observatory files simply lack them
+    # (rendered n/a).
+    "trace.overhead_pct": None,
+    "trace.spans_per_request": None,
+    "trace.traced_rhs_per_sec": None,
+    "usage.device_seconds": None,
+    "usage.wire_bytes": None,
+    "usage.device_seconds_per_request": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the

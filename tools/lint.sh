@@ -402,15 +402,73 @@ echo "recycle gate: clean"
 # ~2x-reject-depth overload must fire the ladder IN ORDER (degraded
 # results before any deferral, deferrals before any admission
 # rejection), never time out an accepted gold request, and walk the
-# shed levels 1 -> 2 -> 3 without skipping a rung; then every emitted
-# event (admission / sched_dispatch / shed included) must be
-# schema-valid.  The weighted-fair starvation bound and the legacy
+# shed levels 1 -> 2 -> 3 without skipping a rung; the SLO burn-rate
+# tracker must trip at least one deterministic slo_burn on the fake
+# clock; then every emitted event (admission / sched_dispatch / shed /
+# span / slo_burn included) must be schema-valid with a fully-parented
+# span forest.  The weighted-fair starvation bound and the legacy
 # bit-for-bit compat proof live in tests/test_serve_sched.py.
 echo "== overload gate (fake-clock shed ladder fires in order) =="
 JAX_PLATFORMS=cpu python tools/overload_drill.py \
     "$scratch/overload_events.jsonl"
-python tools/validate_trace.py "$scratch/overload_events.jsonl"
+python tools/validate_trace.py "$scratch/overload_events.jsonl" \
+    --require-spans
 echo "overload gate: clean"
+
+# Observatory gate: causal tracing + metered usage end-to-end on the
+# committed skewed fixture - a traced mesh-4 CLI serve replay with
+# --usage must produce (a) a schema-valid event stream whose span
+# forest has one submit root per trace and ZERO orphans
+# (validate_trace.py --require-spans), (b) a result span for EVERY
+# request_done event - the trace-completeness contract: no request
+# finishes untraced, (c) a usage ledger whose per-tenant shares
+# reconcile with the batch totals within 1e-9, independently
+# re-derived by tools/usage_report.py from the raw export.
+echo "== observatory gate (mesh-4 serve: span forest + usage ledger) =="
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli serve \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --requests 24 --rate 2000 --max-batch 8 --tol 1e-8 --maxiter 500 \
+    --seed 11 --json \
+    --trace-events "$scratch/obs_events.jsonl" \
+    --usage "$scratch/obs_usage.jsonl" \
+    > "$scratch/obs.json"
+python tools/validate_trace.py "$scratch/obs_events.jsonl" \
+    --require-spans
+python tools/usage_report.py "$scratch/obs_usage.jsonl"
+JAX_PLATFORMS=cpu python - "$scratch" <<'PY'
+import json
+import sys
+
+scratch = sys.argv[1]
+events = [json.loads(ln)
+          for ln in open(f"{scratch}/obs_events.jsonl")
+          if ln.strip()]
+
+from cuda_mpi_parallel_tpu.telemetry import tracing
+
+spans = tracing.span_events(events)
+dones = [e for e in events if e["event"] == "request_done"]
+assert dones, "no request_done events"
+result_rids = {s["request_id"] for s in spans if s["name"] == "result"}
+undone = [e["request_id"] for e in dones
+          if e["request_id"] not in result_rids]
+assert not undone, \
+    f"{len(undone)} request_done without a terminal result span: " \
+    f"{undone[:4]}"
+solve_ids = {e["solve_id"] for e in events
+             if e["event"] == "batch_dispatch"}
+span_solves = {s["solve_id"] for s in spans if s["name"] == "solve"}
+assert span_solves <= solve_ids, \
+    f"solve spans name unknown solve_ids: {span_solves - solve_ids}"
+usages = [e for e in events if e["event"] == "usage"]
+assert usages, "no usage events in the stream"
+forest = tracing.build_forest(events)
+print(f"observatory gate: {len(spans)} spans in {len(forest)} traces "
+      f"cover {len(dones)} request_done events, {len(span_solves)} "
+      f"solve(s) joined to batch telemetry, {len(usages)} usage "
+      f"events")
+PY
+echo "observatory gate: clean"
 
 # Phasetrace gate: measured per-shard per-phase timing end-to-end on
 # the committed skewed fixture - one mesh-4 CLI solve with

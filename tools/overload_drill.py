@@ -13,7 +13,10 @@ shed-before-collapse ordering contract from the event stream alone:
    ladder's whole point: overload is answered by shedding the classes
    below gold, never by letting accepted gold work rot in queue),
 4. the ladder's level transitions are an ascending 1 -> 2 -> 3 walk
-   on the way up (no rung skipped silently on first engagement).
+   on the way up (no rung skipped silently on first engagement),
+5. the SLO burn-rate tracker fires at least one ``slo_burn`` event:
+   the overload's rejection burns the error budget of the flow it
+   turned away, and on the fake clock the trip is bit-deterministic.
 
 Every decision is fake-clock + queue-depth driven, so the drill is
 bit-deterministic; the solves themselves run for real and must all
@@ -42,6 +45,10 @@ from cuda_mpi_parallel_tpu.serve import (  # noqa: E402
     TokenBucket,
 )
 from cuda_mpi_parallel_tpu import telemetry  # noqa: E402
+from cuda_mpi_parallel_tpu.telemetry.slo import (  # noqa: E402
+    SLOConfig,
+    SLOWindow,
+)
 
 DEGRADE_DEPTH, DEFER_DEPTH, REJECT_DEPTH = 4, 8, 12
 
@@ -72,7 +79,11 @@ def main() -> int:
             default=TokenBucket(rate=500.0, burst=200)),
         shed=ShedConfig(degrade_depth=DEGRADE_DEPTH,
                         defer_depth=DEFER_DEPTH,
-                        reject_depth=REJECT_DEPTH)))
+                        reject_depth=REJECT_DEPTH),
+        # a tight window + low sample floor so the single scripted
+        # rejection trips a deterministic slo_burn on the fake clock
+        slo=SLOConfig(windows=(SLOWindow("fast", 5.0, 2.0),),
+                      budget=0.01, min_samples=4)))
     h = svc.register(a)
     rng = np.random.default_rng(7)
     mk_b = lambda: np.asarray(a @ rng.standard_normal(a.shape[0]))  # noqa: E731
@@ -173,6 +184,10 @@ def main() -> int:
             break
     if ups[:3] != [1, 2, 3]:
         failures.append(f"ascending shed walk is {ups}, want [1, 2, 3]")
+    burns = [e for e in lines if e["event"] == "slo_burn"]
+    if not burns:
+        failures.append("no slo_burn event: the rejection's budget "
+                        "burn never tripped the fast-window threshold")
 
     if failures:
         for msg in failures:
@@ -189,7 +204,9 @@ def main() -> int:
           f"{n_deg} degraded / {n_def} defer event(s) / {n_rej} "
           f"rejection(s), retry_after {rej.retry_after_s:.3f}s, "
           f"{len(gold)} gold CONVERGED with 0 timeouts, "
-          f"{len(lines)} events")
+          f"{len(burns)} slo_burn trip(s) "
+          f"(worst burn rate {max(b['burn_rate'] for b in burns):.1f}x "
+          f"budget), {len(lines)} events")
     return 0
 
 

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Render and cross-check a metered-usage ledger export.
+
+Reads the JSONL that ``cli serve --usage PATH`` (or
+``UsageLedger.export_jsonl``) wrote - ``kind="request"`` lines,
+``kind="batch"`` lines, and a final ``kind="summary"`` - re-derives
+the per-tenant roll-up from the raw request lines, and verifies the
+accounting identity independently of the exporter:
+
+* summed per-tenant device-seconds / wire bytes == batch totals
+  (relative mismatch gated at 1e-9, same bar as the library's
+  ``UsageLedger.reconcile``);
+* the re-derived roll-up matches the file's own summary line.
+
+Used by ``tools/lint.sh`` after its traced mesh-4 serve replay::
+
+    python tools/usage_report.py usage.jsonl
+    python tools/usage_report.py usage.jsonl --json
+
+Exit 0 when the ledger reconciles, 1 on any mismatch or malformed
+line.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+RECONCILE_GATE = 1e-9
+
+
+def load_ledger(path):
+    """Parse the export into (requests, batches, summary)."""
+    requests, batches, summary = [], [], None
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i}: not valid JSON: {e}")
+            kind = rec.get("kind")
+            if kind == "request":
+                requests.append(rec)
+            elif kind == "batch":
+                batches.append(rec)
+            elif kind == "summary":
+                if summary is not None:
+                    raise ValueError(f"{path}:{i}: duplicate summary "
+                                     f"line")
+                summary = rec
+            else:
+                raise ValueError(f"{path}:{i}: unknown kind "
+                                 f"{kind!r} (expected request/batch/"
+                                 f"summary)")
+    if summary is None:
+        raise ValueError(f"{path}: no summary line (truncated "
+                         f"export?)")
+    return requests, batches, summary
+
+
+def roll_up(requests):
+    """Re-derive the per-tenant totals from raw request lines."""
+    acc = {}
+    for rec in requests:
+        t = acc.setdefault(str(rec.get("tenant", "default")), {
+            "requests": 0, "device_seconds": [], "wire_bytes": [],
+            "batch_iterations_share": []})
+        t["requests"] += 1
+        t["device_seconds"].append(float(rec["device_seconds"]))
+        t["wire_bytes"].append(float(rec["wire_bytes"]))
+        t["batch_iterations_share"].append(
+            float(rec.get("batch_iterations_share", 0.0)))
+    return {
+        tenant: {
+            "requests": v["requests"],
+            "device_seconds": math.fsum(v["device_seconds"]),
+            "wire_bytes": math.fsum(v["wire_bytes"]),
+            "batch_iterations_share": math.fsum(
+                v["batch_iterations_share"]),
+        }
+        for tenant, v in sorted(acc.items())
+    }
+
+
+def reconcile(per_tenant, batches):
+    """Max relative mismatch of summed shares vs batch totals."""
+    worst = 0.0
+    for field in ("device_seconds", "wire_bytes"):
+        total = math.fsum(float(b[field]) for b in batches)
+        summed = math.fsum(v[field] for v in per_tenant.values())
+        worst = max(worst,
+                    abs(summed - total) / max(abs(total), 1.0))
+    return worst
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render + cross-check a serve usage ledger export")
+    ap.add_argument("ledger", help="usage JSONL path (cli serve "
+                                   "--usage output)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON record instead of the table")
+    args = ap.parse_args(argv)
+    try:
+        requests, batches, summary = load_ledger(args.ledger)
+        per_tenant = roll_up(requests)
+        residual = reconcile(per_tenant, batches)
+        problems = []
+        if residual > RECONCILE_GATE:
+            problems.append(
+                f"per-tenant shares do not reconcile with batch "
+                f"totals: max rel err {residual:.3e} > "
+                f"{RECONCILE_GATE:.0e}")
+        filed = summary.get("per_tenant") or {}
+        if sorted(filed) != sorted(per_tenant):
+            problems.append(
+                f"summary tenants {sorted(filed)} != re-derived "
+                f"{sorted(per_tenant)}")
+        else:
+            for tenant, mine in per_tenant.items():
+                theirs = filed[tenant]
+                for field in ("requests", "device_seconds",
+                              "wire_bytes"):
+                    a, b = float(mine[field]), float(theirs[field])
+                    if abs(a - b) > RECONCILE_GATE * max(abs(a), 1.0):
+                        problems.append(
+                            f"summary disagrees for {tenant}.{field}: "
+                            f"file {b!r} vs re-derived {a!r}")
+        if problems:
+            raise ValueError("; ".join(problems))
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    totals = {
+        "batches": len(batches),
+        "requests": len(requests),
+        "device_seconds": math.fsum(float(b["device_seconds"])
+                                    for b in batches),
+        "wire_bytes": math.fsum(float(b["wire_bytes"])
+                                for b in batches),
+    }
+    if args.json:
+        print(json.dumps({
+            "ledger": args.ledger, "totals": totals,
+            "per_tenant": per_tenant,
+            "reconcile_max_rel_err": residual, "ok": True},
+            sort_keys=True))
+        return 0
+    print(f"usage ledger {args.ledger}: {totals['batches']} "
+          f"batch(es), {totals['requests']} request(s)")
+    print(f"{'tenant':<16} {'requests':>8} {'device-s':>14} "
+          f"{'wire bytes':>14} {'iter share':>12}")
+    for tenant, v in per_tenant.items():
+        print(f"{tenant:<16} {v['requests']:>8d} "
+              f"{v['device_seconds']:>14.6f} "
+              f"{v['wire_bytes']:>14.3e} "
+              f"{v['batch_iterations_share']:>12.1f}")
+    print(f"{'TOTAL':<16} {totals['requests']:>8d} "
+          f"{totals['device_seconds']:>14.6f} "
+          f"{totals['wire_bytes']:>14.3e}")
+    print(f"reconcile: max rel err {residual:.3e} "
+          f"(gate {RECONCILE_GATE:.0e}) - OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

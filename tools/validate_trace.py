@@ -12,17 +12,24 @@ Every JSONL line must parse as strict JSON and pass
 fields); the Perfetto file must pass
 ``telemetry.report.validate_perfetto`` (loadable event array,
 ``ph``/``ts``/``pid``/``tid`` on every event, monotone ``ts`` per
-track).  Exit 0 on success, 1 on any violation (with the offending
-line/event named).
+track).  When the JSONL carries request ``span`` events (a traced
+serve replay) the span forest is checked too: well-formed W3C ids,
+known span names, one root per trace, and ZERO orphans - every span
+must be reachable from its trace's ``submit`` root
+(``--require-spans`` makes an empty forest an error, the serve lint
+gate's mode).  Exit 0 on success, 1 on any violation (with the
+offending line/event named).
 """
 from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 
 sys.path.insert(0, ".")  # repo-root invocation, like tools/bench_compare
 
+from cuda_mpi_parallel_tpu.telemetry import tracing  # noqa: E402
 from cuda_mpi_parallel_tpu.telemetry.events import (  # noqa: E402
     read_events,
 )
@@ -30,10 +37,61 @@ from cuda_mpi_parallel_tpu.telemetry.report import (  # noqa: E402
     validate_perfetto,
 )
 
+_TRACE_ID = re.compile(r"^[0-9a-f]{32}$")
+_SPAN_ID = re.compile(r"^[0-9a-f]{16}$")
+
 
 def check_events(path: str) -> int:
     """Validate every line; returns the event count."""
     return len(read_events(path))
+
+
+def check_spans(path: str, require: bool = False) -> tuple:
+    """Validate the request-span forest in an events JSONL.
+
+    Returns ``(n_spans, n_traces)``.  Checks each span's id formats
+    (32-hex trace id, 16-hex span id, parent 16-hex or null) and name
+    against ``tracing.SPAN_NAMES``, then the forest property: exactly
+    one root span per trace and no span unreachable from its root.
+    """
+    records = read_events(path)
+    spans = tracing.span_events(records)
+    if not spans:
+        if require:
+            raise ValueError(
+                f"{path}: no request span events (traced serve replay "
+                f"expected to emit a span forest)")
+        return 0, 0
+    for i, s in enumerate(spans):
+        where = f"{path}: span[{i}] ({s.get('span_id')!r})"
+        if not _TRACE_ID.match(str(s.get("trace_id", ""))):
+            raise ValueError(f"{where}: malformed trace_id "
+                             f"{s.get('trace_id')!r}")
+        if not _SPAN_ID.match(str(s.get("span_id", ""))):
+            raise ValueError(f"{where}: malformed span_id")
+        parent = s.get("parent_span_id")
+        if parent is not None and not _SPAN_ID.match(str(parent)):
+            raise ValueError(f"{where}: malformed parent_span_id "
+                             f"{parent!r}")
+        if s.get("name") not in tracing.SPAN_NAMES:
+            raise ValueError(f"{where}: unknown span name "
+                             f"{s.get('name')!r}")
+    forest = tracing.build_forest(records)
+    for trace_id, tree in sorted(forest.items()):
+        roots = [s for s in tree["spans"].values()
+                 if s.get("parent_span_id") is None]
+        if len(roots) != 1:
+            raise ValueError(
+                f"{path}: trace {trace_id} has {len(roots)} root "
+                f"spans (exactly one 'submit' root expected)")
+    orphans = tracing.orphan_spans(records)
+    if orphans:
+        o = orphans[0]
+        raise ValueError(
+            f"{path}: {len(orphans)} orphan span(s) - e.g. "
+            f"{o.get('name')!r} span {o.get('span_id')} in trace "
+            f"{o.get('trace_id')} is unreachable from its root")
+    return len(spans), len(forest)
 
 
 def check_perfetto(path: str) -> int:
@@ -80,13 +138,25 @@ def main(argv=None) -> int:
                     help="Perfetto/Chrome-trace JSON path")
     ap.add_argument("--perfetto-only", default=None, metavar="PATH",
                     help="validate only this timeline file")
+    ap.add_argument("--require-spans", action="store_true",
+                    dest="require_spans",
+                    help="fail unless the events JSONL carries a "
+                         "non-empty, fully-parented request span "
+                         "forest (the serve lint gate's mode)")
     args = ap.parse_args(argv)
     if args.perfetto_only is None and args.events is None:
         ap.error("nothing to validate")
+    if args.require_spans and args.events is None:
+        ap.error("--require-spans needs an events JSONL")
     try:
         if args.events is not None:
             n = check_events(args.events)
             print(f"{args.events}: {n} events, all schema-valid")
+            n_spans, n_traces = check_spans(
+                args.events, require=args.require_spans)
+            if n_spans:
+                print(f"{args.events}: {n_spans} spans in {n_traces} "
+                      f"traces, one root each, zero orphans")
         target = args.perfetto_only or args.perfetto
         if target is not None:
             n = check_perfetto(target)
