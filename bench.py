@@ -575,6 +575,90 @@ def _exchange_entry(entry, n_shards, key="exchange"):
     return entry
 
 
+def _memory_entry(entry, n_shards, key="mem"):
+    """Device-memory observatory columns for the distributed row
+    (telemetry.memscope on the committed skewed fixture): the
+    predicted worst-shard persistent bytes, the measured device-array
+    twin (asserted equal to the model inside the dispatch), the
+    jaxpr-liveness transient peak and the headroom % against the
+    detected device memory.  One small measured mesh solve (240 rows)
+    under the same never-sink-the-run contract as
+    ``_efficiency_entry``; reported by bench_compare, never gated."""
+    try:
+        import numpy as _np
+
+        from cuda_mpi_parallel_tpu import telemetry
+        from cuda_mpi_parallel_tpu.models import mmio
+        from cuda_mpi_parallel_tpu.parallel import (
+            make_mesh,
+            solve_distributed,
+        )
+        from cuda_mpi_parallel_tpu.telemetry import memscope
+        from cuda_mpi_parallel_tpu.utils.logging import sanitize
+
+        a = mmio.load_matrix_market("tests/fixtures/skewed_spd_240.mtx")
+        b = _np.random.default_rng(13).standard_normal(240)
+        memscope.reset_last_memory_profile()
+        telemetry.force_active(True)
+        try:
+            solve_distributed(a, b, mesh=make_mesh(n_shards), tol=1e-8,
+                              maxiter=500)
+        finally:
+            telemetry.force_active(False)
+        prof = memscope.last_memory_profile()
+        if prof is None:
+            entry[key] = {"error": "no memory profile recorded"}
+            return entry
+        fp = prof["footprint"]
+        out = {
+            "n_shards": n_shards,
+            "persistent_bytes_worst": int(fp.persistent_bytes.max()),
+            "matrix_bytes_worst": int(fp.matrix_bytes.max()),
+            "measured_matrix_bytes": (
+                int(prof["measured_bytes"])
+                if prof.get("measured_bytes") is not None else None),
+            "jaxpr_peak_bytes": fp.jaxpr_peak_bytes,
+            "peak_bytes": int(fp.peak_bytes),
+            "classification": fp.classification,
+            "headroom_pct": (round(fp.headroom_frac * 100, 2)
+                             if fp.headroom_frac is not None else None),
+            "note": "memscope account of one mesh solve of the "
+                    "committed skewed 240-row fixture",
+        }
+        if prof.get("device_peak_bytes") is not None:
+            out["device_peak_bytes"] = int(prof["device_peak_bytes"])
+        entry[key] = sanitize(out)
+    except Exception as e:  # pragma: no cover - defensive
+        entry[key] = {"error": str(e)[-200:]}
+    return entry
+
+
+def _memory_headline_entry(entry, n, itemsize=4, key="mem"):
+    """Device-memory columns for the single-device headline row: the
+    modeled CG working set (telemetry.memscope's solver model at one
+    shard) and the allocator's measured peak when the backend exposes
+    ``memory_stats``.  Free of charge - no extra solve runs.  Same
+    never-sink-the-run contract; reported by bench_compare, never
+    gated."""
+    try:
+        from cuda_mpi_parallel_tpu.telemetry import memscope
+        from cuda_mpi_parallel_tpu.utils.logging import sanitize
+
+        out = {
+            "model_working_set_bytes": memscope.solver_bytes_per_shard(
+                n_local=n, n_shards=1, itemsize=itemsize),
+            "note": "modeled single-device CG working set (matrix-free "
+                    "stencil pins no matrix bytes)",
+        }
+        peak = memscope.device_memory_peak()
+        if peak is not None:
+            out["device_peak_bytes"] = int(peak)
+        entry[key] = sanitize(out)
+    except Exception as e:  # pragma: no cover - defensive
+        entry[key] = {"error": str(e)[-200:]}
+    return entry
+
+
 def _phase_entry(entry, n_shards, key="phase"):
     """Measured phase-profile columns for the distributed row
     (telemetry.phasetrace on the committed skewed fixture, gather
@@ -757,6 +841,7 @@ def bench_headline(device=None):
         "engine": "resident" if use_resident else "general_whileloop",
     }
     entry.update(_convergence_entry(probe))
+    _memory_headline_entry(entry, n * n)
     return _efficiency_entry(op, entry)
 
 
@@ -1461,6 +1546,10 @@ def bench_all(results, sections=None) -> None:
             _efficiency_entry(a3, entry)
             _imbalance_entry(entry, (grid[0] // ndev, grid[1], grid[2]),
                              ndev)
+            # memscope columns: predicted/measured per-shard bytes of a
+            # small real CSR mesh solve at THIS mesh size (the stencil
+            # slab above is matrix-free and pins no partition arrays)
+            _memory_entry(entry, n_shards=ndev)
             # planner columns for the distributed row: the stencil slab
             # above is uniform by construction, so the planner's value
             # shows on a representative unstructured CSR at THIS mesh

@@ -111,7 +111,7 @@ def reference_model():
         _REFERENCE[0] = MachineModel(
             name="reference-tpu-v5e", mem_bytes_per_s=8.19e11,
             flops_per_s=2.0e13, net_bytes_per_s=4.5e10,
-            source="table")
+            hbm_bytes=16.0 * 2 ** 30, source="table")
     return _REFERENCE[0]
 
 
@@ -377,7 +377,8 @@ def plan_partition(a, n_shards: int, *, objective: str = "auto",
                    exchange: str = "auto",
                    row_cap_factor: float = 1.25,
                    itemsize: Optional[int] = None,
-                   model=None) -> PartitionPlan:
+                   model=None,
+                   hbm_budget: Optional[float] = None) -> PartitionPlan:
     """Enumerate (reorder x split x exchange) candidates; return the
     minimizer.
 
@@ -411,6 +412,16 @@ def plan_partition(a, n_shards: int, *, objective: str = "auto",
         Pass a ``telemetry.calibrate`` runtime-fitted model to rank
         against measured behavior - the plan's ``scored_by`` records
         which model chose it.
+      hbm_budget: per-device HBM bytes the chosen partition must fit
+        in (``telemetry.memscope`` accounting: worst-shard pinned
+        partition bytes + the modeled solver working set).  Candidates
+        that overflow are dropped from the search; when EVERY layout
+        overflows at ``n_shards``, the planner doubles the mesh until
+        one fits (a tight budget drives the shard count up) and the
+        returned plan's ``n_shards`` records the grown size.  When no
+        mesh up to ``n`` rows fits, raises
+        :class:`telemetry.memscope.MemoryBudgetError` naming the
+        bytes.  ``None`` (default) skips the gate entirely.
 
     Returns:
       The best :class:`PartitionPlan`; candidates are tried simplest
@@ -453,6 +464,26 @@ def plan_partition(a, n_shards: int, *, objective: str = "auto",
         plan="none+even")
     baseline_imb = baseline.imbalance()
 
+    def _fits_budget(rep, lane) -> bool:
+        # worst-shard persistent bytes (exact slot accounting from the
+        # predicted report + the modeled solver working set) vs the
+        # per-device budget; the gather lane's extended-x buffer holds
+        # the halo rows the report predicts
+        if hbm_budget is None:
+            return True
+        from ..telemetry import memscope
+
+        halo_w = 0
+        if lane == "gather":
+            halo_w = int(np.ceil(
+                float(np.asarray(rep.halo_recv_bytes).max()) / itemsize))
+        solver = memscope.solver_bytes_per_shard(
+            n_local=rep.n_local, n_shards=n_shards, itemsize=itemsize,
+            exchange=lane, halo_width=halo_w)
+        worst = int(np.asarray(rep.persistent_bytes).max()) + solver
+        return worst <= hbm_budget
+
+    over_budget = 0
     best = None
     for rname in reorders:
         if rname == "none":
@@ -482,6 +513,9 @@ def plan_partition(a, n_shards: int, *, objective: str = "auto",
                     plan=f"{rname}+{sname}")
             trivial_layout = rname == "none" and sname == "even"
             for lane in lanes:
+                if not _fits_budget(rep, lane):
+                    over_budget += 1
+                    continue
                 score = score_report(rep, objective=objective,
                                      itemsize=itemsize, model=model,
                                      exchange=lane)
@@ -518,6 +552,31 @@ def plan_partition(a, n_shards: int, *, objective: str = "auto",
                         and score < best.score * (1 - 1e-9):
                     best = cand
     if best is None:
+        if over_budget:
+            # every layout overflows this mesh: grow it (doubling keeps
+            # pod-slice shapes) until one fits, or refuse with the
+            # memscope accounting once shards would outnumber rows
+            if n_shards * 2 <= n:
+                return plan_partition(
+                    a, n_shards * 2, objective=objective,
+                    reorders=reorders, splits=splits,
+                    exchange=exchange, row_cap_factor=row_cap_factor,
+                    itemsize=itemsize, model=model,
+                    hbm_budget=hbm_budget)
+            from ..telemetry import memscope
+
+            required = int(np.asarray(
+                baseline.persistent_bytes).max()) \
+                + memscope.solver_bytes_per_shard(
+                    n_local=baseline.n_local, n_shards=n_shards,
+                    itemsize=itemsize, exchange="allgather")
+            raise memscope.MemoryBudgetError(
+                f"no partition of this {n}-row system fits "
+                f"hbm_budget={int(hbm_budget)} bytes at any mesh size "
+                f"up to {n_shards} shards (worst-shard persistent "
+                f"bytes {required} at {n_shards} shards)",
+                required_bytes=required,
+                budget_bytes=int(hbm_budget), n_shards=n_shards)
         raise ValueError(
             "plan_partition needs at least one (reorder, split) "
             "candidate; got empty reorders/splits")

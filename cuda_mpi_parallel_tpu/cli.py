@@ -381,6 +381,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "a file; bare --report (or '-') prints it; "
                         "with --json the report also rides the record "
                         "as 'solve_report'")
+    p.add_argument("--memory-report", action="store_true",
+                   dest="memory_report",
+                   help="after a --mesh > 1 solve, print the memscope "
+                        "device-memory account: per-shard persistent "
+                        "bytes (exact - asserted equal to the device "
+                        "arrays actually held), the jaxpr-liveness "
+                        "transient peak, and the FITS/TIGHT/OVERFLOW "
+                        "verdict against the device HBM size; with "
+                        "--json the payload rides the record as "
+                        "'memory', and --report includes the same "
+                        "section")
     p.add_argument("--trace-perfetto", default=None, metavar="PATH",
                    dest="trace_perfetto",
                    help="write a Chrome-trace/Perfetto JSON timeline of "
@@ -500,13 +511,13 @@ def main(argv=None) -> int:
         # at client creation)
         _ensure_virtual_devices(args.mesh)
     if args.trace_events or args.metrics or args.report is not None \
-            or args.trace_perfetto:
+            or args.trace_perfetto or args.memory_report:
         from . import telemetry
 
         if args.trace_events:
             telemetry.configure(args.trace_events)
         if args.metrics or args.report is not None \
-                or args.trace_perfetto:
+                or args.trace_perfetto or args.memory_report:
             # the report/timeline consume the build-time cost walk and
             # the partition-time shard accounting - opt into both
             telemetry.force_active(True)
@@ -1531,10 +1542,12 @@ def main(argv=None) -> int:
         # distributed engines bypass dist_cg's cache, so a stale value
         # from an earlier solve in this process must not leak in
         from .parallel.dist_cg import reset_last_comm_cost
+        from .telemetry.memscope import reset_last_memory_profile
         from .telemetry.shardscope import reset_last_shard_report
 
         reset_last_comm_cost()
         reset_last_shard_report()
+        reset_last_memory_profile()
 
     # time_fn dispatches twice (compile warmup + timed); both really
     # happen, so both emit - the warmup's events labeled phase=warmup
@@ -1978,6 +1991,22 @@ def main(argv=None) -> int:
     # The unified solve report + Perfetto timeline (telemetry.report):
     # all host-side fusion of already-synced aggregates - the solve
     # itself is untouched (TestZeroPerturbation covers this path).
+    mem_payload = None
+    if args.memory_report or args.report is not None \
+            or args.trace_perfetto:
+        from .telemetry.memscope import last_memory_profile
+
+        mem_prof = last_memory_profile()
+        if mem_prof is not None:
+            mem_payload = dict(mem_prof["footprint"].to_json())
+            if mem_prof.get("measured_bytes") is not None:
+                mem_payload["measured_bytes"] = \
+                    int(mem_prof["measured_bytes"])
+            if mem_prof.get("device_peak_bytes") is not None:
+                mem_payload["device_peak_bytes"] = \
+                    int(mem_prof["device_peak_bytes"])
+    if args.memory_report and args.json:
+        record["memory"] = mem_payload
     solve_report = None
     if args.report is not None or args.trace_perfetto:
         from .telemetry import report as treport
@@ -2009,6 +2038,7 @@ def main(argv=None) -> int:
             health=record.get("health"),
             comm=comm, calibration=calib_entry,
             phase=record.get("phase_profile"),
+            memory=mem_payload,
             sections=tuple(obs.timer.sections))
         if args.report is not None and args.report != "-":
             with open(args.report, "w", encoding="utf-8") as f:
@@ -2110,6 +2140,15 @@ def main(argv=None) -> int:
             for line in _phase_lines(record["phase_profile"]):
                 print(f"phase   : {line}")
             print(f"phase   : calibration {phase_fit.describe()}")
+        if args.memory_report:
+            if mem_payload is not None:
+                from .telemetry.report import memory_lines as _mem_lines
+
+                for line in _mem_lines(mem_payload):
+                    print(f"memory  : {line}")
+            else:
+                print("memory  : no distributed memory profile (the "
+                      "memscope account needs --mesh > 1)")
         if health is not None:
             print(f"health  : {health.classification.name}: "
                   f"{health.message}")

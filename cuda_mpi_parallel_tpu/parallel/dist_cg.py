@@ -427,6 +427,11 @@ def _dist_cache_cap() -> int:
 #: abstract trace of the solve body, never an extra compile or run
 _COST_CACHE: dict = {}
 
+#: per-key jaxpr-liveness transient peak (telemetry.memscope
+#: solve_peak_bytes over the SAME abstract trace the cost walk uses) -
+#: per-shard bytes, fed into the MemoryFootprint noted at dispatch
+_PEAK_CACHE: dict = {}
+
 #: (SolveCost, context dict) of the most recent solve dispatched through
 #: the cache - how the CLI attaches per-solve comm totals to its report
 #: without re-deriving the cache key
@@ -462,6 +467,7 @@ def clear_solver_cache() -> None:
     with _CACHE_LOCK:
         _SOLVER_CACHE.clear()
         _COST_CACHE.clear()
+        _PEAK_CACHE.clear()
     _LAST_COMM_COST[0] = None
 
 
@@ -572,6 +578,7 @@ def _cached_solver(key, build, cost_ctx=None, cost_args=None):
                 # set exceeds the cap re-compiles every solve
                 evicted, _ = _SOLVER_CACHE.popitem(last=False)
                 _COST_CACHE.pop(evicted, None)
+                _PEAK_CACHE.pop(evicted, None)
                 evictions.append(evicted)
         for evicted in evictions:
             from ..telemetry.registry import REGISTRY
@@ -588,11 +595,16 @@ def _cached_solver(key, build, cost_ctx=None, cost_args=None):
     if cost_args is not None and telemetry.active():
         solve_cost = _COST_CACHE.get(key)
         if solve_cost is None:
-            from ..telemetry.cost import trace_solve_cost
+            from ..telemetry.cost import jaxpr_solve_cost
+            from ..telemetry.memscope import solve_peak_bytes
 
             trips = (cost_ctx or {}).get("check_every", 1)
-            solve_cost = _COST_CACHE[key] = trace_solve_cost(
-                build(), *cost_args, iterations_per_trip=trips)
+            # one abstract trace feeds both ledgers: the comm-cost walk
+            # and memscope's per-shard liveness peak
+            closed = jax.make_jaxpr(build())(*cost_args)
+            solve_cost = _COST_CACHE[key] = jaxpr_solve_cost(
+                closed, iterations_per_trip=trips)
+            _PEAK_CACHE[key] = solve_peak_bytes(closed)
         _LAST_COMM_COST[0] = (solve_cost, dict(cost_ctx or {}))
         per = solve_cost.per_iteration
         from ..telemetry.registry import REGISTRY
@@ -635,6 +647,30 @@ def _note_shards(build_report) -> None:
         return
     telemetry.shardscope.note_report(
         build_report(telemetry.shardscope))
+
+
+def _note_memory(parts, arrays, key=None, *, n_rhs=1, flight=None,
+                 basis=None) -> None:
+    """Per-shard HBM footprint accounting (telemetry.memscope), computed
+    only when a telemetry consumer is attached.  ``arrays`` is the tree
+    of just-sharded device arrays the dispatch pins for its lifetime;
+    their summed global ``.nbytes`` is asserted equal to the model's
+    matrix bytes inside ``note_footprint`` - the exact-match contract
+    that keeps the static model honest.  ``key`` fetches the
+    jaxpr-liveness transient peak the build trace parked in
+    ``_PEAK_CACHE`` (present only after a telemetered build)."""
+    from .. import telemetry
+
+    if not telemetry.active():
+        return
+    ms = telemetry.memscope
+    fp = ms.footprint_for_partition(
+        parts, n_rhs=n_rhs,
+        flight_capacity=flight.capacity if flight is not None else 0,
+        basis_m=basis.capacity if basis is not None else 0,
+        jaxpr_peak=_PEAK_CACHE.get(key))
+    ms.note_footprint(fp, measured_bytes=ms.live_device_bytes(arrays),
+                      device_peak=ms.device_memory_peak())
 
 
 def _plan_exchange_hint(csr_comm: str, exchange) -> str:
@@ -1131,7 +1167,10 @@ def _solve_csr(a, b, mesh, axis, n_shards, precond, record_history,
     args = (b_dev, data, cols, rows) + ((send,) if gather else ()) \
         + ((w_sh, aw_sh, chol_rep) if deflate is not None else ()) \
         + extras
-    res = _cached_solver(key, build, ctx, args)(*args)
+    fn = _cached_solver(key, build, ctx, args)
+    _note_memory(parts, (data, cols, rows, send), key,
+                 flight=kw.get("flight"), basis=kw.get("basis"))
+    res = fn(*args)
     return _unpad_result(res, parts, plan)
 
 
@@ -1181,9 +1220,10 @@ def _solve_csr_shiftell(a, b, mesh, axis, n_shards, precond,
     ctx = dict(kind="csr-shiftell", check_every=kw["check_every"],
                method=kw["method"], n_shards=n_shards,
                **({"plan": plan.label} if plan is not None else {}))
-    res = _cached_solver(key, build, ctx,
-                         (b_dev, vals, meta, blks, diag))(
-        b_dev, vals, meta, blks, diag)
+    fn = _cached_solver(key, build, ctx, (b_dev, vals, meta, blks, diag))
+    _note_memory(parts, (vals, meta, blks, diag), key,
+                 flight=kw.get("flight"))
+    res = fn(b_dev, vals, meta, blks, diag)
     return _unpad_result(res, parts, plan)
 
 
@@ -1360,6 +1400,27 @@ class ManyRHSDispatcher:
             plan=(self.plan.fingerprint()
                   if self.plan is not None else None),
             fault=inject)
+
+    def live_device_arrays(self):
+        """The device arrays this dispatcher pins for its lifetime (the
+        sharded partition: slot values/columns/rows plus gather send
+        maps) - the measured twin of
+        ``telemetry.memscope.matrix_bytes_per_shard(self.parts)``;
+        their summed global ``.nbytes`` equals the model exactly."""
+        return (self._data, self._cols, self._rows, self._send)
+
+    def memory_footprint(self, *, n_rhs: int = 1, hbm_bytes="auto",
+                         model=None):
+        """This dispatcher's :class:`telemetry.memscope.MemoryFootprint`
+        at dispatch width ``n_rhs`` (pinned partition bytes + modeled
+        per-solve working set; no trace, no compile)."""
+        from ..telemetry import memscope
+
+        return memscope.footprint_for_partition(
+            self.parts, n_rhs=n_rhs,
+            flight_capacity=(self.flight.capacity
+                             if self.flight is not None else 0),
+            hbm_bytes=hbm_bytes, model=model)
 
     def space_layout_token(self) -> str:
         """The ``recycle.space_layout`` token of the operator this
@@ -1559,7 +1620,10 @@ class ManyRHSDispatcher:
         args = (b_dev, self._data, self._cols, self._rows, tol_dev,
                 rtol_dev) + ((self._send,) if gather else ()) \
             + ((w_sh, aw_sh, chol_rep) if deflated else ())
-        res = _cached_solver(key, build, ctx, args)(*args)
+        fn = _cached_solver(key, build, ctx, args)
+        _note_memory(self.parts, self.live_device_arrays(), key,
+                     n_rhs=n_rhs, flight=eff_flight, basis=basis)
+        res = fn(*args)
         return _unpad_result_many(res, self.parts, self.plan)
 
 

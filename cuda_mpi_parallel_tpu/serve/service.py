@@ -277,6 +277,14 @@ class ServiceConfig:
     #: batch totals (the billing substrate the network front end
     #: needs).  Host-side post-solve bookkeeping only
     usage: bool = False
+    #: per-device HBM bytes a registered mesh handle's WORST bucket
+    #: (``max_batch`` lanes wide) must fit in, by the
+    #: ``telemetry.memscope`` static model (None = no gate).  An
+    #: over-budget register raises ``MemoryBudgetError`` BEFORE any
+    #: partition or compile, naming the bytes and the smallest mesh
+    #: that would fit - capacity refusal belongs at registration, not
+    #: as a device OOM under live traffic
+    hbm_budget: Optional[float] = None
     #: per-batch dispatch log retained for reports (ring, drop-oldest)
     keep_batch_log: int = 1024
     #: exact latency samples retained for stats() percentiles (ring,
@@ -735,6 +743,7 @@ class SolverService:
         if mesh is not None:
             from ..parallel.dist_cg import ManyRHSDispatcher
 
+            self._check_memory_budget(a, mesh, exchange)
             # the partition-once half of solve_distributed_many:
             # validates the mesh/operator/exchange combination, resolves
             # the plan (plan="auto" runs the planner HERE, exactly
@@ -779,6 +788,49 @@ class SolverService:
                 handle, int(phase_profile))
             self._seed_capacity(handle)
         return handle
+
+    def _check_memory_budget(self, a, mesh, exchange) -> None:
+        """Predict the registering handle's per-device footprint at its
+        WIDEST bucket (``max_batch`` lanes) and refuse OVERFLOW before
+        any partition work or compile (``ServiceConfig.hbm_budget``;
+        None = no gate, but the prediction is still parked/emitted as a
+        ``memory_profile`` event for observability).
+
+        The model prices the allgather extended-x buffer - the upper
+        bound of every batched exchange lane (a gather schedule's halo
+        slab is never wider than the full remote block) - so a FITS
+        verdict here holds for whichever lane the planner picks."""
+        from ..telemetry import memscope
+
+        indptr = getattr(a, "indptr", None)
+        if indptr is None:
+            return     # matrix-free operators never reach the mesh path
+        budget = self.config.hbm_budget
+        n = int(a.shape[0])
+        n_shards = int(mesh.devices.size)
+        itemsize = int(np.asarray(a.data).dtype.itemsize)
+        k = int(self.config.max_batch)
+        fp = memscope.predict_footprint(
+            n=n, n_shards=n_shards, indptr=np.asarray(indptr),
+            itemsize=itemsize, n_rhs=k, exchange="allgather",
+            hbm_bytes=budget if budget is not None else "auto")
+        if budget is not None and fp.classification == "OVERFLOW":
+            fit = memscope.smallest_fitting_mesh(
+                n=n, budget_bytes=budget, indptr=np.asarray(indptr),
+                itemsize=itemsize, n_rhs=k, exchange="allgather",
+                start=n_shards)
+            hint = (f"; the smallest mesh that fits is {fit} shards"
+                    if fit is not None else
+                    "; no mesh size fits (the k-wide vector stack "
+                    "alone exceeds the budget - lower max_batch)")
+            raise memscope.MemoryBudgetError(
+                f"registering this {n}-row operator on {n_shards} "
+                f"shard(s) needs {int(fp.peak_bytes)} bytes/device at "
+                f"max_batch={k} but hbm_budget is {int(budget)}{hint}",
+                required_bytes=int(fp.peak_bytes),
+                budget_bytes=int(budget), n_shards=n_shards,
+                smallest_fitting_mesh=fit)
+        memscope.note_footprint(fp)
 
     def _seed_capacity(self, handle: OperatorHandle) -> None:
         """Seed the shed ladder's capacity estimate from the measured

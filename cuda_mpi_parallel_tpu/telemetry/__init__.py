@@ -33,6 +33,12 @@ that gap with four composable parts:
   computed at partition time (rows, nnz, padding overhead, halo bytes
   per neighbor), emitted as ``shard_profile`` events and
   ``shard="k"``-labeled gauges;
+* :mod:`.memscope` - the device-memory observatory: per-shard HBM
+  footprint accounting (exact partition bytes + modeled solver working
+  set + jaxpr-liveness transient peak), FITS/TIGHT/OVERFLOW
+  classification against ``MachineModel.hbm_bytes``, and the typed
+  ``MemoryBudgetError`` the planner and serve tier refuse over-budget
+  work with before any compile;
 * :mod:`.roofline` - the analytic machine model (table-sourced TPU
   numbers, self-calibrated CPU) joined with measured wall time:
   achieved-vs-peak efficiency %, arithmetic intensity, memory- vs
@@ -66,6 +72,7 @@ from . import (
     events,
     flight,
     health,
+    memscope,
     phasetrace,
     registry,
     report,
@@ -80,6 +87,7 @@ from .calibrate import CalibrationFit, DriftReport
 from .events import EventStream, configure, emit, validate_event
 from .flight import FlightConfig, FlightRecord
 from .health import SolveHealth, assess_solve_health
+from .memscope import MemoryBudgetError, MemoryFootprint
 from .registry import REGISTRY, MetricsRegistry
 from .report import SolveReport, perfetto_trace, validate_perfetto
 from .roofline import MachineModel, RooflineReport
@@ -117,6 +125,8 @@ __all__ = [
     "FlightConfig",
     "FlightRecord",
     "MachineModel",
+    "MemoryBudgetError",
+    "MemoryFootprint",
     "MetricsRegistry",
     "PhaseProfile",
     "REGISTRY",
@@ -137,6 +147,7 @@ __all__ = [
     "events",
     "flight",
     "health",
+    "memscope",
     "observe_solve",
     "perfetto_trace",
     "phasetrace",

@@ -183,6 +183,15 @@ EVENT_SCHEMA: Dict[str, tuple] = {
     "slo_burn": ("tenant", "slo_class", "window", "burn_rate"),
     "usage": ("n_requests", "device_seconds", "wire_bytes",
               "batch_iterations"),
+    # device-memory footprint of a partitioned solve
+    # (telemetry.memscope.MemoryFootprint.to_json payload, plus the
+    # measured live-array twin and backend allocator peak when known):
+    # per-shard persistent bytes (exact matrix + modeled solver working
+    # set), the jaxpr-liveness transient peak, and the FITS / TIGHT /
+    # OVERFLOW / unknown classification against MachineModel.hbm_bytes
+    "memory_profile": ("kind", "n_shards", "n_rhs", "matrix_bytes",
+                       "persistent_bytes", "peak_bytes",
+                       "classification"),
     # the solve finished (converged or not) and was synced
     "solve_end": ("status", "iterations", "residual_norm"),
 }

@@ -1,0 +1,769 @@
+"""Device-memory observatory: per-shard HBM footprint accounting.
+
+Every other axis of the machine already has a ruler here - shardscope
+counts slots and halo payloads, :mod:`.cost` derives wire bytes from
+the traced program, roofline prices traffic against peak bandwidth -
+but nothing could say how many bytes a solve actually *pins* per
+device.  The PIM SpMV lesson (PAPERS: arXiv 2204.00900 - throughput is
+sustained stream bandwidth over the RESIDENT bytes) and the
+cluster-storage accounting of arXiv 1112.5588 both start from the
+primitive this module supplies: an honest bytes-per-device model.
+
+Three views of the same footprint, kept deliberately separate:
+
+* **matrix bytes** (:func:`matrix_bytes_per_shard`) - the device
+  arrays a partition actually holds for the life of a dispatcher:
+  CSR/ELL slot planes at their real padded ``slots`` x itemsize,
+  int32 column/row index planes, gather ``send_idx`` slabs, shift-ELL
+  value/lane/chunk planes and the Jacobi diagonal (df64 doubles the
+  value planes into (hi, lo)).  Computed from array SHAPES alone, so
+  it is asserted to equal the summed ``.nbytes`` of the live device
+  arrays EXACTLY (:func:`live_device_bytes` is the measured twin -
+  same numbers, two derivations).
+* **solver bytes** (:func:`solver_bytes_per_shard`) - the modeled
+  solve-lifetime working set: b/x/r/p/Ap many-RHS k-wide stacks, the
+  extended-x exchange buffer (full ``n_global_padded`` for allgather,
+  ``n_local + halo_width`` for a gather schedule - sized from the
+  ``GatherSchedule`` rounds, one rotating block for the ring),
+  flight-recorder and recycling-basis rings, df64 (hi, lo) doubling.
+* **transient peak** (:func:`jaxpr_peak_bytes`) - the high-water mark
+  of the traced solve body from a liveness walk over its eqns
+  (cost.py-style recursion into while/scan/cond/pjit): every output
+  aval lives from its defining eqn to its last use, the peak over
+  program points is reported, so the allgather's ``(P * n_local, k)``
+  temporary is charged, not hidden.
+
+``persistent = matrix + solver`` is what a registered operator costs
+per chip while serving; ``peak`` bounds the solve-time spike.  Fit
+classification against :class:`~.roofline.MachineModel.hbm_bytes`
+(TPU table value, ``CUDA_MPI_PARALLEL_TPU_HBM_BYTES`` override) is
+FITS / TIGHT (> ``TIGHT_FRACTION``) / OVERFLOW - or ``"unknown"``
+when the model has no capacity number, which REPORTS and never
+refuses.  :class:`MemoryBudgetError` is the typed refusal the planner
+(``balance.plan_partition(hbm_budget=)``) and the serve tier's
+``register()`` raise BEFORE any compile, naming the bytes and the
+smallest mesh that fits.
+
+Everything is host-side arithmetic over shapes the partitioners
+already produced; the compiled solve is never perturbed (the jaxpr
+bit-identity proof of tests/test_cost_accounting.py extends to this
+layer, asserted by tests/test_memscope.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "HBM_BYTES_ENV",
+    "TIGHT_FRACTION",
+    "MemoryBudgetError",
+    "MemoryFootprint",
+    "classify",
+    "csr_slot_bytes",
+    "device_memory_peak",
+    "footprint_for_partition",
+    "hbm_bytes_for",
+    "jaxpr_peak_bytes",
+    "last_memory_profile",
+    "live_device_bytes",
+    "matrix_bytes_per_shard",
+    "note_footprint",
+    "predict_footprint",
+    "reset_last_memory_profile",
+    "smallest_fitting_mesh",
+    "solve_peak_bytes",
+    "solver_bytes_per_shard",
+]
+
+#: environment override for the per-device HBM capacity (bytes) -
+#: wins over any machine model's table/calibrated value
+HBM_BYTES_ENV = "CUDA_MPI_PARALLEL_TPU_HBM_BYTES"
+
+#: occupancy above this fraction of capacity classifies TIGHT: enough
+#: headroom questions (fragmentation, XLA scratch, donation timing)
+#: live in the last fifth that "fits on paper" stops being a promise
+TIGHT_FRACTION = 0.8
+
+
+class MemoryBudgetError(RuntimeError):
+    """A partition/registration whose footprint cannot fit the budget.
+
+    Raised BEFORE any device allocation or compile, so an over-budget
+    operator fails at plan/registration time with numbers attached -
+    never as an opaque OOM inside request latency.  ``required_bytes``
+    is the worst-shard persistent footprint of the best (smallest)
+    candidate considered, ``budget_bytes`` the per-device budget it
+    exceeded, and ``smallest_fitting_mesh`` the first power-of-two
+    shard count whose predicted footprint fits (``None`` when none
+    does within the search bound).
+    """
+
+    def __init__(self, message: str, *, required_bytes: int,
+                 budget_bytes: float, n_shards: int,
+                 smallest_fitting_mesh: Optional[int] = None):
+        super().__init__(message)
+        self.required_bytes = int(required_bytes)
+        self.budget_bytes = float(budget_bytes)
+        self.n_shards = int(n_shards)
+        self.smallest_fitting_mesh = smallest_fitting_mesh
+
+
+def classify(peak_bytes: float,
+             hbm_bytes: Optional[float]) -> str:
+    """FITS / TIGHT / OVERFLOW against a per-device capacity, or
+    ``"unknown"`` when no capacity is known (unknown REPORTS, never
+    refuses - a pre-PR calibration file without ``hbm_bytes`` must not
+    start failing registrations)."""
+    if hbm_bytes is None or hbm_bytes <= 0:
+        return "unknown"
+    if peak_bytes > hbm_bytes:
+        return "OVERFLOW"
+    if peak_bytes > TIGHT_FRACTION * hbm_bytes:
+        return "TIGHT"
+    return "FITS"
+
+
+def hbm_bytes_for(model=None, backend: Optional[str] = None
+                  ) -> Optional[float]:
+    """The per-device HBM capacity to classify against: the
+    :data:`HBM_BYTES_ENV` override when set, else ``model.hbm_bytes``
+    (the model defaults to ``roofline.machine_model(backend)``).
+    ``None`` = unknown."""
+    env = os.environ.get(HBM_BYTES_ENV)
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            raise ValueError(
+                f"{HBM_BYTES_ENV} must be a number of bytes, got "
+                f"{env!r}")
+    if model is None:
+        from .roofline import machine_model
+
+        model = machine_model(backend)
+    return getattr(model, "hbm_bytes", None)
+
+
+# ---------------------------------------------------------------------------
+# the static model: matrix bytes (exact) + solver working set (modeled)
+
+def _prod(shape) -> int:
+    return int(math.prod(int(s) for s in shape))
+
+
+def csr_slot_bytes(slots, itemsize: int):
+    """Device bytes of ``slots`` CSR entry slots: one data value plus
+    the int32 column and int32 local-row planes per slot - THE
+    per-slot cost shared by the exact partition accounting below, the
+    pre-build prediction, and ``shardscope``'s predicted
+    ``persistent_bytes``.  Vectorizes over numpy ``slots``."""
+    return slots * (int(itemsize) + 4 + 4)
+
+
+def matrix_bytes_per_shard(parts) -> np.ndarray:
+    """Per-shard device bytes of the arrays a partition pins for the
+    life of a dispatcher - THE byte definition shared by the footprint
+    model, ``shardscope.ShardReport.persistent_bytes`` and the
+    dist_cg measured twin.
+
+    Computed from array shapes and dtypes alone (never data), summing
+    exactly what ``parallel.dist_cg`` ships to devices per family:
+
+    * CSR (allgather/gather): ``data`` + int32 ``cols`` +
+      int32 ``local_rows`` slot planes, plus the gather schedule's
+      int32 ``send_idx`` slab per round;
+    * ring CSR: the same three planes per ring step;
+    * shift-ELL (f32/f64 and df64): value planes (df64: hi + lo),
+      ``lane_idx``, ``chunk_blocks`` per step, plus the Jacobi
+      diagonal plane(s).
+
+    Uniform-shape padding makes every shard's share identical - the
+    returned ``(n_shards,)`` vector is constant, kept per-shard so the
+    report/gauge surface matches shardscope's.
+    """
+    from ..parallel import partition as part
+
+    p = int(parts.n_shards)
+    if isinstance(parts, part.PartitionedCSR):
+        per = sum(np.asarray(x).dtype.itemsize * _prod(x.shape[1:])
+                  for x in (parts.data, parts.cols, parts.local_rows))
+        if parts.halo is not None:
+            per += sum(
+                np.asarray(r.send_idx).dtype.itemsize * r.m
+                for r in parts.halo.rounds)
+        return np.full(p, per, dtype=np.int64)
+    if isinstance(parts, part.RingPartitionedCSR):
+        per = sum(
+            np.asarray(x).dtype.itemsize * _prod(x.shape[1:])
+            for tup in (parts.data, parts.cols, parts.local_rows)
+            for x in tup)
+        return np.full(p, per, dtype=np.int64)
+    if isinstance(parts, (part.RingPartitionedShiftELL,
+                          part.RingPartitionedShiftELLDF64)):
+        df64 = hasattr(parts, "vals_hi")
+        planes = ((parts.vals_hi, parts.vals_lo) if df64
+                  else (parts.vals,))
+        per = sum(
+            np.asarray(x).dtype.itemsize * _prod(x.shape[1:])
+            for tup in planes + (parts.lane_idx, parts.chunk_blocks)
+            for x in tup)
+        diags = ((parts.diag_hi, parts.diag_lo) if df64
+                 else (parts.diag,))
+        per += sum(np.asarray(d).dtype.itemsize * _prod(d.shape[1:])
+                   for d in diags)
+        return np.full(p, per, dtype=np.int64)
+    raise TypeError(f"no memory accounting for {type(parts).__name__}")
+
+
+def solver_bytes_per_shard(*, n_local: int, n_shards: int,
+                           itemsize: int, n_rhs: int = 1,
+                           exchange: str = "allgather",
+                           halo_width: int = 0, df64: bool = False,
+                           flight_capacity: int = 0,
+                           basis_m: int = 0) -> int:
+    """Modeled per-shard bytes of the solve-lifetime working set.
+
+    The recurrence carries b, x, r, p and the Ap product - five
+    ``(n_local, n_rhs)`` stacks - plus the exchange's extended-x
+    buffer: the full ``(n_shards * n_local, n_rhs)`` gathered stack
+    for allgather, ``(n_local + halo_width, n_rhs)`` for a compiled
+    gather schedule (``halo_width = GatherSchedule.halo_width``), and
+    one extra rotating ``(n_local, n_rhs)`` block for the ring
+    schedules.  ``df64`` doubles every vector entry into (hi, lo)
+    planes.  ``flight_capacity`` rows of the (replicated) flight ring
+    carry ``1 + 3 * n_rhs`` recorded columns each (``4`` single-RHS);
+    ``basis_m`` recycling-basis vectors hold their local rows per
+    shard.
+    """
+    vec = int(itemsize) * (2 if df64 else 1)
+    k = max(int(n_rhs), 1)
+    per = 5 * n_local * k * vec
+    if exchange == "allgather":
+        per += n_shards * n_local * k * vec
+    elif exchange == "gather":
+        per += (n_local + int(halo_width)) * k * vec
+    elif exchange in ("ring", "ring-shiftell"):
+        per += 2 * n_local * k * vec
+    else:
+        raise ValueError(f"unknown exchange {exchange!r}")
+    if flight_capacity:
+        cols = 4 if k == 1 else 1 + 3 * k
+        per += int(flight_capacity) * cols * vec
+    if basis_m:
+        per += int(basis_m) * n_local * vec
+    return int(per)
+
+
+def _exchange_of(parts) -> Tuple[str, int]:
+    """(exchange lane, gather halo width) of a built partition."""
+    from ..parallel import partition as part
+
+    if isinstance(parts, part.PartitionedCSR):
+        if parts.halo is not None:
+            return "gather", int(parts.halo.halo_width)
+        return "allgather", 0
+    if isinstance(parts, part.RingPartitionedCSR):
+        return "ring", 0
+    return "ring-shiftell", 0
+
+
+def _kind_of(parts) -> str:
+    from ..parallel import partition as part
+
+    if isinstance(parts, part.PartitionedCSR):
+        return ("csr-gather" if parts.halo is not None
+                else "csr-allgather")
+    if isinstance(parts, part.RingPartitionedCSR):
+        return "csr-ring"
+    return ("ring-shiftell-df64" if hasattr(parts, "vals_hi")
+            else "ring-shiftell")
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryFootprint:
+    """One partitioned solve's per-device memory account (JSON-ready).
+
+    ``matrix_bytes`` is exact (shape-derived, measured-twin asserted);
+    ``solver_bytes`` is the modeled working set;
+    ``jaxpr_peak_bytes`` the liveness-walked transient high water of
+    the traced shard program when a trace was available (it counts the
+    program's inputs too, so it bounds matrix + working set + temps).
+    ``hbm_bytes`` is the capacity classified against (``None`` =
+    unknown).
+    """
+
+    kind: str
+    n_shards: int
+    n_rhs: int
+    itemsize: int
+    matrix_bytes: np.ndarray          # (P,) exact pinned bytes
+    solver_bytes: np.ndarray          # (P,) modeled working set
+    jaxpr_peak_bytes: Optional[int] = None
+    hbm_bytes: Optional[float] = None
+
+    @property
+    def persistent_bytes(self) -> np.ndarray:
+        """(P,) matrix + solver working set: what one registered,
+        actively solving operator costs per chip."""
+        return self.matrix_bytes + self.solver_bytes
+
+    @property
+    def peak_bytes(self) -> int:
+        """Worst-shard high water: the jaxpr-walked peak when traced
+        (it subsumes the persistent set), else the persistent model."""
+        persistent = int(self.persistent_bytes.max()) \
+            if self.n_shards else 0
+        if self.jaxpr_peak_bytes is None:
+            return persistent
+        return max(int(self.jaxpr_peak_bytes), persistent)
+
+    @property
+    def classification(self) -> str:
+        return classify(self.peak_bytes, self.hbm_bytes)
+
+    @property
+    def headroom_frac(self) -> Optional[float]:
+        """Fraction of capacity left above the peak (negative =
+        overflow); ``None`` when capacity is unknown."""
+        if self.hbm_bytes is None or self.hbm_bytes <= 0:
+            return None
+        return 1.0 - self.peak_bytes / float(self.hbm_bytes)
+
+    def to_json(self) -> dict:
+        head = self.headroom_frac
+        return {
+            "kind": self.kind,
+            "n_shards": self.n_shards,
+            "n_rhs": self.n_rhs,
+            "itemsize": self.itemsize,
+            "matrix_bytes": [int(v) for v in self.matrix_bytes],
+            "solver_bytes": [int(v) for v in self.solver_bytes],
+            "persistent_bytes": [int(v) for v in self.persistent_bytes],
+            "jaxpr_peak_bytes": (None if self.jaxpr_peak_bytes is None
+                                 else int(self.jaxpr_peak_bytes)),
+            "peak_bytes": int(self.peak_bytes),
+            "hbm_bytes": (None if self.hbm_bytes is None
+                          else float(self.hbm_bytes)),
+            "headroom_frac": (None if head is None
+                              else round(float(head), 6)),
+            "classification": self.classification,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "MemoryFootprint":
+        return cls(
+            kind=str(data["kind"]), n_shards=int(data["n_shards"]),
+            n_rhs=int(data["n_rhs"]), itemsize=int(data["itemsize"]),
+            matrix_bytes=np.asarray(data["matrix_bytes"],
+                                    dtype=np.int64),
+            solver_bytes=np.asarray(data["solver_bytes"],
+                                    dtype=np.int64),
+            jaxpr_peak_bytes=(None
+                              if data.get("jaxpr_peak_bytes") is None
+                              else int(data["jaxpr_peak_bytes"])),
+            hbm_bytes=(None if data.get("hbm_bytes") is None
+                       else float(data["hbm_bytes"])))
+
+    def describe(self) -> str:
+        """The one-line footprint digest the CLI report embeds."""
+        per = int(self.persistent_bytes.max()) if self.n_shards else 0
+        parts = [f"{_fmt_bytes(per)}/shard persistent "
+                 f"({_fmt_bytes(int(self.matrix_bytes.max()))} matrix "
+                 f"+ {_fmt_bytes(int(self.solver_bytes.max()))} "
+                 f"solver, k={self.n_rhs})",
+                 f"peak {_fmt_bytes(self.peak_bytes)}"]
+        if self.hbm_bytes is not None and self.hbm_bytes > 0:
+            head = self.headroom_frac
+            parts.append(
+                f"{self.classification} on "
+                f"{_fmt_bytes(self.hbm_bytes)} HBM "
+                f"(headroom {head * 100:.1f}%)")
+        else:
+            parts.append("capacity unknown")
+        return "; ".join(parts)
+
+
+def _fmt_bytes(b: float) -> str:
+    b = float(b)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(b) < 1024.0 or unit == "GiB":
+            return (f"{b:.0f} {unit}" if unit == "B"
+                    else f"{b:.2f} {unit}")
+        b /= 1024.0
+    return f"{b:.2f} GiB"
+
+
+def footprint_for_partition(parts, *, n_rhs: int = 1,
+                            flight_capacity: int = 0,
+                            basis_m: int = 0,
+                            jaxpr_peak: Optional[int] = None,
+                            hbm_bytes: Optional[float] = "auto",
+                            model=None) -> MemoryFootprint:
+    """The footprint of a BUILT partition: exact matrix bytes from the
+    arrays' own shapes, modeled solver working set for ``n_rhs``
+    lanes.  ``hbm_bytes="auto"`` resolves capacity via
+    :func:`hbm_bytes_for` (env override, then ``model``/backend
+    table); pass ``None`` to classify as unknown or a number to pin
+    it."""
+    exchange, halo_width = _exchange_of(parts)
+    df64 = hasattr(parts, "vals_hi")
+    if df64:
+        itemsize = 4           # (hi, lo) f32 planes; df64 doubles below
+    elif hasattr(parts, "vals"):
+        itemsize = np.asarray(parts.vals[0]).dtype.itemsize
+    elif isinstance(parts.data, tuple):
+        itemsize = np.asarray(parts.data[0]).dtype.itemsize
+    else:
+        itemsize = np.asarray(parts.data).dtype.itemsize
+    if hbm_bytes == "auto":
+        hbm_bytes = hbm_bytes_for(model)
+    matrix = matrix_bytes_per_shard(parts)
+    solver = solver_bytes_per_shard(
+        n_local=int(parts.n_local), n_shards=int(parts.n_shards),
+        itemsize=int(itemsize), n_rhs=n_rhs, exchange=exchange,
+        halo_width=halo_width, df64=df64,
+        flight_capacity=flight_capacity, basis_m=basis_m)
+    return MemoryFootprint(
+        kind=_kind_of(parts), n_shards=int(parts.n_shards),
+        n_rhs=int(n_rhs), itemsize=int(itemsize),
+        matrix_bytes=matrix,
+        solver_bytes=np.full(int(parts.n_shards), solver,
+                             dtype=np.int64),
+        jaxpr_peak_bytes=jaxpr_peak, hbm_bytes=hbm_bytes)
+
+
+# ---------------------------------------------------------------------------
+# the pre-build prediction (planner gate, serve refusal, hbm_plan)
+
+def predict_slots(n: int, n_shards: int, *, nnz: Optional[int] = None,
+                  indptr=None, row_ranges=None) -> Tuple[int, int]:
+    """``(n_local, slots)`` of the CSR partition that WOULD be built:
+    the exact ``partition_csr`` slot count when ``indptr`` is given
+    (max over shards of live entries + unit-diagonal padding rows),
+    else the uniform-nnz estimate ``ceil(nnz / P)`` + padding (what a
+    synthetic sweep like tools/hbm_plan.py prices)."""
+    from .shardscope import _row_ranges as even_ranges
+
+    if row_ranges is not None:
+        from ..parallel.partition import ranges_n_local
+
+        ranges = tuple((int(lo), int(hi)) for lo, hi in row_ranges)
+        n_local = ranges_n_local(ranges)
+    else:
+        n_local = -(-int(n) // int(n_shards))
+        ranges = even_ranges(int(n), n_local, int(n_shards))
+    if indptr is not None:
+        ip = np.asarray(indptr).astype(np.int64)
+        counts = [int(ip[hi] - ip[lo]) + (n_local - (hi - lo))
+                  for lo, hi in ranges]
+        return n_local, max(max(counts), 1)
+    if nnz is None:
+        raise ValueError("predict_slots needs nnz= or indptr=")
+    # uniform-nnz estimate: each shard holds ~nnz/P live entries; the
+    # tail shard additionally pads its missing rows with unit diagonals
+    tail_real = int(n) - (int(n_shards) - 1) * n_local
+    pad_rows = max(n_local - max(tail_real, 0), 0)
+    return n_local, max(-(-int(nnz) // int(n_shards)) + pad_rows, 1)
+
+
+def predict_footprint(*, n: int, n_shards: int,
+                      nnz: Optional[int] = None, indptr=None,
+                      row_ranges=None, itemsize: int = 4,
+                      n_rhs: int = 1, exchange: str = "allgather",
+                      halo_width: int = 0, df64: bool = False,
+                      flight_capacity: int = 0, basis_m: int = 0,
+                      hbm_bytes: Optional[float] = "auto",
+                      model=None) -> MemoryFootprint:
+    """Geometry-only footprint of the CSR partition that WOULD be
+    built - no partition arrays, no device work.  This is what
+    ``balance.plan_partition(hbm_budget=)`` gates candidates on,
+    what ``serve.register()`` refuses OVERFLOW with before any
+    compile, and what tools/hbm_plan.py sweeps.
+
+    ``indptr`` gives the exact even-split (or ``row_ranges``) slot
+    count; ``nnz`` alone prices the uniform split a synthetic sweep
+    assumes.  The gather lane's ``halo_width``/send slabs are unknown
+    before the schedule is compiled, so predictions price the
+    allgather layout unless the caller passes a measured
+    ``halo_width`` - a conservative (upper-bound) extended-x charge.
+    """
+    n_local, slots = predict_slots(int(n), int(n_shards), nnz=nnz,
+                                   indptr=indptr,
+                                   row_ranges=row_ranges)
+    if hbm_bytes == "auto":
+        hbm_bytes = hbm_bytes_for(model)
+    mat_itemsize = int(itemsize) * (2 if df64 else 1)
+    per_matrix = int(csr_slot_bytes(slots, mat_itemsize))
+    solver = solver_bytes_per_shard(
+        n_local=n_local, n_shards=int(n_shards),
+        itemsize=int(itemsize), n_rhs=n_rhs, exchange=exchange,
+        halo_width=halo_width, df64=df64,
+        flight_capacity=flight_capacity, basis_m=basis_m)
+    p = int(n_shards)
+    return MemoryFootprint(
+        kind=f"predicted-csr-{exchange}", n_shards=p,
+        n_rhs=int(n_rhs), itemsize=int(itemsize),
+        matrix_bytes=np.full(p, per_matrix, dtype=np.int64),
+        solver_bytes=np.full(p, solver, dtype=np.int64),
+        jaxpr_peak_bytes=None, hbm_bytes=hbm_bytes)
+
+
+def smallest_fitting_mesh(*, n: int, budget_bytes: float,
+                          nnz: Optional[int] = None, indptr=None,
+                          itemsize: int = 4, n_rhs: int = 1,
+                          exchange: str = "allgather",
+                          df64: bool = False,
+                          flight_capacity: int = 0,
+                          start: int = 1,
+                          max_shards: int = 65536) -> Optional[int]:
+    """The smallest power-of-two shard count >= ``start`` whose
+    predicted worst-shard persistent footprint fits ``budget_bytes``
+    (``None`` when none does by ``max_shards`` - e.g. an allgather
+    extended-x that never shrinks with P)."""
+    p = 1
+    while p < start:
+        p *= 2
+    while p <= max_shards:
+        fp = predict_footprint(
+            n=n, n_shards=p, nnz=nnz, indptr=indptr,
+            itemsize=itemsize, n_rhs=n_rhs, exchange=exchange,
+            df64=df64, flight_capacity=flight_capacity,
+            hbm_bytes=None)
+        if int(fp.persistent_bytes.max()) <= budget_bytes:
+            return p
+        p *= 2
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the measured twin
+
+def live_device_bytes(tree) -> int:
+    """Summed ``.nbytes`` over every array leaf of ``tree`` (a sharded
+    jax ``Array``'s ``nbytes`` is GLOBAL - all shards together)."""
+    import jax
+
+    return int(sum(int(v.nbytes) for v in jax.tree.leaves(tree)
+                   if hasattr(v, "nbytes")))
+
+
+def device_memory_peak() -> Optional[int]:
+    """Backend-reported peak bytes in use on device 0, when the
+    backend exposes ``memory_stats()`` (TPU/GPU do, CPU does not) -
+    the allocator-level cross-check of the static model.  ``None``
+    when unavailable."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    for key in ("peak_bytes_in_use", "bytes_in_use"):
+        if key in stats:
+            return int(stats[key])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the jaxpr liveness walker (transient high water)
+
+def _is_literal(v) -> bool:
+    return hasattr(v, "val")     # core.Literal carries its value
+
+
+def _aval_bytes(v) -> int:
+    aval = getattr(v, "aval", None)
+    shape = getattr(aval, "shape", ())
+    dtype = getattr(aval, "dtype", None)
+    itemsize = dtype.itemsize if dtype is not None else 0
+    return _prod(shape) * int(itemsize)
+
+
+def _inner(j):
+    return j.jaxpr if hasattr(j, "jaxpr") else j
+
+
+def _eqn_inner_jaxprs(eqn):
+    name = eqn.primitive.name
+    if name == "while":
+        return [_inner(eqn.params["body_jaxpr"]),
+                _inner(eqn.params["cond_jaxpr"])]
+    if name == "scan":
+        return [_inner(eqn.params["jaxpr"])]
+    if name == "cond":
+        return [_inner(b) for b in eqn.params["branches"]]
+    out = []
+    for value in eqn.params.values():
+        for item in (value if isinstance(value, (tuple, list))
+                     else (value,)):
+            if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                out.append(_inner(item))
+    return out
+
+
+def _entry_bytes(jaxpr) -> int:
+    return sum(_aval_bytes(v)
+               for v in tuple(jaxpr.invars) + tuple(jaxpr.constvars))
+
+
+def jaxpr_peak_bytes(jaxpr) -> int:
+    """Liveness-walked high-water bytes of one jaxpr.
+
+    Classic last-use liveness over the eqn list: inputs/consts are
+    live from entry, every output aval lives from its defining eqn to
+    its last reading eqn (jaxpr outvars to the end), and at each
+    program point the inputs and outputs of the executing eqn coexist
+    (XLA cannot free an operand before the op retires).  An eqn with
+    inner jaxprs (while/scan/cond/pjit/shard_map/custom_*) charges its
+    OWN recursive peak beyond its operands as a transient at that
+    point - so an ``all_gather``'s ``(P * n_local, k)`` output, alive
+    only inside the matvec, raises the peak without ever appearing in
+    the persistent model.  The walk is abstract (shapes only): the
+    traced program is never executed, same contract as
+    :mod:`.cost`.
+    """
+    j = _inner(jaxpr)
+    eqns = list(j.eqns)
+    end = len(eqns)
+    last_use: dict = {}
+    for v in j.outvars:
+        if not _is_literal(v):
+            last_use[v] = end
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if not _is_literal(v) and last_use.get(v, -1) < end:
+                last_use[v] = max(last_use.get(v, -1), i)
+    alive: dict = {}
+    for v in tuple(j.invars) + tuple(j.constvars):
+        alive[v] = _aval_bytes(v)
+    live = sum(alive.values())
+    peak = live
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            if v not in alive:
+                alive[v] = _aval_bytes(v)
+                live += alive[v]
+        extra = 0
+        for sub in _eqn_inner_jaxprs(eqn):
+            extra = max(extra,
+                        jaxpr_peak_bytes(sub) - _entry_bytes(sub))
+        peak = max(peak, live + max(extra, 0))
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if not _is_literal(v) and last_use.get(v, end) <= i \
+                    and v in alive:
+                live -= alive.pop(v)
+    return int(peak)
+
+
+def solve_peak_bytes(closed_jaxpr) -> int:
+    """Per-SHARD transient high water of a traced distributed solve:
+    when the program is one ``shard_map`` region (possibly under pjit
+    wrappers), walk the region's BODY - its avals are the per-shard
+    block shapes, so the result is bytes per device.  Anything else
+    falls back to the whole-program walk."""
+    j = _inner(closed_jaxpr)
+    seen = 0
+    while seen < 8:                  # descend through pjit wrappers
+        eqns = [e for e in j.eqns]
+        if len(eqns) != 1:
+            break
+        eqn = eqns[0]
+        name = eqn.primitive.name
+        if name == "shard_map":
+            return jaxpr_peak_bytes(_inner(eqn.params["jaxpr"]))
+        inner = _eqn_inner_jaxprs(eqn)
+        if name in ("pjit", "jit", "custom_jvp_call",
+                    "custom_vjp_call") and len(inner) >= 1:
+            j = inner[0]
+            seen += 1
+            continue
+        break
+    return jaxpr_peak_bytes(j)
+
+
+# ---------------------------------------------------------------------------
+# emission + the CLI's pickup slot
+
+#: the most recent (footprint, measured dict) noted by a solve path -
+#: the CLI's --memory-report reads this, same pattern as
+#: shardscope._LAST / dist_cg._LAST_COMM_COST
+_LAST: list = [None]
+
+
+def last_memory_profile() -> Optional[dict]:
+    """``{"footprint": MemoryFootprint, ...}`` of the most recent
+    distributed solve (``measured_bytes`` rides along when the solve
+    path measured its live arrays), or ``None``.  Reset before
+    dispatching the solve being attributed
+    (:func:`reset_last_memory_profile`), like every other last-slot."""
+    return _LAST[0]
+
+
+def reset_last_memory_profile() -> None:
+    _LAST[0] = None
+
+
+def note_footprint(footprint: MemoryFootprint, *,
+                   measured_bytes: Optional[int] = None,
+                   device_peak: Optional[int] = None) -> MemoryFootprint:
+    """Publish a freshly computed footprint: park it for the CLI and,
+    when telemetry is active, emit a ``memory_profile`` event plus
+    ``hbm_bytes_persistent/peak/headroom`` gauges.  ``measured_bytes``
+    is the live-array twin (summed global ``.nbytes``); when present
+    it is asserted against the matrix model EXACTLY - same numbers,
+    two derivations - so drift between the model and what dist_cg
+    actually ships fails loudly at the instrumentation site."""
+    from .. import telemetry
+    from .registry import REGISTRY
+
+    if measured_bytes is not None:
+        predicted = int(footprint.matrix_bytes.sum())
+        if int(measured_bytes) != predicted:
+            raise AssertionError(
+                f"memscope model drift: partition arrays measure "
+                f"{int(measured_bytes)} bytes on device but the "
+                f"static model says {predicted} "
+                f"({footprint.kind}, P={footprint.n_shards})")
+    _LAST[0] = {
+        "footprint": footprint,
+        "measured_bytes": (None if measured_bytes is None
+                           else int(measured_bytes)),
+        "device_peak_bytes": (None if device_peak is None
+                              else int(device_peak)),
+    }
+    if not telemetry.active():
+        return footprint
+    payload = footprint.to_json()
+    payload["measured_bytes"] = (None if measured_bytes is None
+                                 else int(measured_bytes))
+    payload["device_peak_bytes"] = (None if device_peak is None
+                                    else int(device_peak))
+    telemetry.events.emit("memory_profile", **payload)
+    persistent = footprint.persistent_bytes
+    g_p = REGISTRY.gauge("hbm_bytes_persistent",
+                         "modeled persistent device bytes per shard "
+                         "(matrix + solver working set)",
+                         labelnames=("kind", "shard"))
+    for k in range(footprint.n_shards):
+        g_p.set(float(persistent[k]), kind=footprint.kind,
+                shard=str(k))
+    REGISTRY.gauge("hbm_bytes_peak",
+                   "worst-shard modeled high-water bytes of the most "
+                   "recent distributed solve",
+                   labelnames=("kind",)).set(
+        float(footprint.peak_bytes), kind=footprint.kind)
+    head = footprint.headroom_frac
+    if head is not None:
+        REGISTRY.gauge("hbm_headroom_frac",
+                       "fraction of device HBM left above the "
+                       "modeled peak (negative = overflow)",
+                       labelnames=("kind",)).set(
+            float(head), kind=footprint.kind)
+    return footprint

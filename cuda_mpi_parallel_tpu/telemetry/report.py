@@ -40,6 +40,7 @@ from ..utils.logging import sanitize
 
 __all__ = [
     "SolveReport",
+    "memory_lines",
     "perfetto_trace",
     "phase_lines",
     "service_lines",
@@ -85,6 +86,10 @@ class SolveReport:
     #: measured phase profile (telemetry.phasetrace
     #: PhaseProfile.to_json() payload, or the phase_profile event)
     phase: Optional[dict] = None
+    #: device-memory observatory (telemetry.memscope): the
+    #: MemoryFootprint.to_json() payload plus ``measured_bytes`` /
+    #: ``device_peak_bytes`` when the dispatch measured its twin
+    memory: Optional[dict] = None
     sections: Sequence[Tuple[str, float]] = ()
 
     def to_json(self) -> dict:
@@ -105,6 +110,8 @@ class SolveReport:
             out["service"] = dict(self.service)
         if self.phase is not None:
             out["phase_profile"] = dict(self.phase)
+        if self.memory is not None:
+            out["memory"] = dict(self.memory)
         if self.sections:
             out["sections"] = {name: s for name, s in self.sections}
         return sanitize(out)
@@ -176,6 +183,10 @@ class SolveReport:
             lines.append("")
             lines.append("-- phase profile (measured) --")
             lines.extend(phase_lines(self.phase))
+        if self.memory is not None:
+            lines.append("")
+            lines.append("-- memory (per-shard HBM accounting) --")
+            lines.extend(memory_lines(self.memory))
         if self.calibration is not None:
             lines.append("")
             lines.append("-- calibration & drift --")
@@ -201,6 +212,50 @@ class SolveReport:
             for name, sec in self.sections:
                 lines.append(f"  {name:>12}: {sec * 1e3:9.3f} ms")
         return "\n".join(lines) + "\n"
+
+
+def memory_lines(mem: Dict[str, Any]) -> List[str]:
+    """Render a memscope memory profile (the ``memory_profile`` event
+    payload / ``MemoryFootprint.to_json()`` plus the measured twin):
+    worst-shard persistent split matrix/solver, the transient peak vs
+    the device HBM, and the measured device-array bytes that anchor
+    the model."""
+    def fmt(v) -> str:
+        if not isinstance(v, (int, float)):
+            return "n/a"
+        for unit, scale in (("GiB", 2 ** 30), ("MiB", 2 ** 20),
+                            ("KiB", 2 ** 10)):
+            if abs(v) >= scale:
+                return f"{v / scale:.2f} {unit}"
+        return f"{int(v)} B"
+
+    pers = mem.get("persistent_bytes") or []
+    lines = [
+        f"{mem.get('kind', '?')} x {mem.get('n_shards', '?')} shards, "
+        f"k={mem.get('n_rhs', 1)}: persistent "
+        f"{fmt(max(pers) if pers else None)}/shard worst "
+        f"(matrix {fmt(max(mem.get('matrix_bytes') or [0]))}, "
+        f"solver {fmt(max(mem.get('solver_bytes') or [0]))})",
+    ]
+    line = f"peak {fmt(mem.get('peak_bytes'))}/shard"
+    if mem.get("jaxpr_peak_bytes") is not None:
+        line += f" (jaxpr transient {fmt(mem['jaxpr_peak_bytes'])})"
+    cls = mem.get("classification", "unknown")
+    if mem.get("hbm_bytes"):
+        hr = mem.get("headroom_frac")
+        line += f" vs {fmt(mem['hbm_bytes'])} HBM -> {cls}"
+        if isinstance(hr, (int, float)):
+            line += f" ({hr * 100:.1f}% headroom)"
+    else:
+        line += f" -> {cls} (device HBM size unknown)"
+    lines.append(line)
+    if mem.get("measured_bytes") is not None:
+        line = (f"measured: {fmt(mem['measured_bytes'])} device arrays "
+                f"held (== model, asserted)")
+        if mem.get("device_peak_bytes") is not None:
+            line += f", allocator peak {fmt(mem['device_peak_bytes'])}"
+        lines.append(line)
+    return lines
 
 
 def service_lines(stats: Dict[str, Any]) -> List[str]:

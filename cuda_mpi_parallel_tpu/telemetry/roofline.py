@@ -32,6 +32,7 @@ the number is a *ruler*, not a grade.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Optional, Tuple
 
@@ -67,10 +68,12 @@ DEFAULT_GATHER_SLOWDOWN = 8.0
 #: efficiency), not a datasheet.
 _TPU_MODEL = dict(name="tpu-v5e-class", mem_bytes_per_s=8.19e11,
                   flops_per_s=2.0e13, net_bytes_per_s=4.5e10,
-                  source="table")
+                  hbm_bytes=16.0 * 2 ** 30, source="table")
 
 #: Conservative fallback when the backend is unknown and calibration
-#: is disabled - close to a modest server core.
+#: is disabled - close to a modest server core.  No ``hbm_bytes``:
+#: an unknown device's capacity stays unknown (memscope classifies
+#: "unknown" and never refuses on it).
 _GENERIC_MODEL = dict(name="generic", mem_bytes_per_s=1.0e10,
                       flops_per_s=5.0e9, net_bytes_per_s=1.0e9,
                       source="table")
@@ -98,6 +101,13 @@ class MachineModel:
     source: str = "table"          # "table" | "calibrated"
     gather_slowdown: float = DEFAULT_GATHER_SLOWDOWN
     created_at: Optional[float] = None
+    #: per-device memory CAPACITY in bytes (HBM on accelerators,
+    #: available host RAM for the CPU self-calibration) - what
+    #: ``telemetry.memscope`` classifies footprints against.  ``None``
+    #: = unknown (pre-PR calibration cache entries load as None via
+    #: the field-filtered ``from_json``): memscope then reports
+    #: "unknown" and never refuses.
+    hbm_bytes: Optional[float] = None
     #: optional per-link wire bandwidths measured by the phase profiler
     #: (``telemetry.phasetrace``): ``((ring shift, bytes/s), ...)``, one
     #: entry per profiled exchange round.  ``net_bytes_per_s`` stays the
@@ -172,7 +182,22 @@ def _calibrate_cpu() -> MachineModel:
     # memcpy: model it as the measured stream bandwidth
     return MachineModel(name="cpu-calibrated", mem_bytes_per_s=mem_bps,
                         flops_per_s=flops, net_bytes_per_s=mem_bps,
-                        source="calibrated")
+                        source="calibrated",
+                        hbm_bytes=_host_ram_bytes())
+
+
+def _host_ram_bytes() -> Optional[float]:
+    """Physical host RAM in bytes - the CPU backend's "device
+    capacity" for memscope's fit classification (stdlib only;
+    ``None`` where the sysconf keys are missing, e.g. non-POSIX)."""
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES")
+        page = os.sysconf("SC_PAGE_SIZE")
+    except (AttributeError, ValueError, OSError):
+        return None
+    if pages <= 0 or page <= 0:
+        return None
+    return float(pages) * float(page)
 
 
 _CACHED_CPU: list = [None]

@@ -119,6 +119,13 @@ class ShardReport:
     #: uniform row split; planned partitions label reports with their
     #: reorder+split lane, e.g. "rcm+nnz")
     plan: str = "even"
+    #: (P,) per-shard device bytes the partition pins for the life of
+    #: a dispatcher - ``telemetry.memscope``'s numbers (ONE shared
+    #: definition: ``matrix_bytes_per_shard`` for built partitions,
+    #: ``csr_slot_bytes(slots)`` for the planner's predicted report),
+    #: so shard_profile events carry bytes alongside nnz/slots.
+    #: ``None`` for reports rebuilt from pre-memscope event files.
+    persistent_bytes: Optional[np.ndarray] = None
 
     # ---- derived -----------------------------------------------------
     def padding_overhead(self) -> np.ndarray:
@@ -163,6 +170,9 @@ class ShardReport:
             "neighbors": [[[int(p), int(b)] for p, b in ns]
                           for ns in self.neighbors],
             "imbalance": self.imbalance(),
+            "persistent_bytes": (
+                None if self.persistent_bytes is None
+                else [int(v) for v in self.persistent_bytes]),
         }
 
     @classmethod
@@ -184,6 +194,10 @@ class ShardReport:
             neighbors=tuple(tuple((int(p), int(b)) for p, b in ns)
                             for ns in data.get("neighbors", [])),
             plan=str(data.get("plan", "even")),
+            persistent_bytes=(
+                None if data.get("persistent_bytes") is None
+                else np.asarray(data["persistent_bytes"],
+                                dtype=np.int64)),
         )
 
     def table(self) -> str:
@@ -235,6 +249,14 @@ def _csr_shard_nnz(a, n_local: int, n_shards: int,
     ranges = _row_ranges(a.shape[0], n_local, n_shards, row_ranges)
     return np.array([int(indptr[hi] - indptr[lo]) if hi > lo else 0
                      for lo, hi in ranges], dtype=np.int64)
+
+
+def _partition_persistent_bytes(parts) -> np.ndarray:
+    """memscope's exact pinned-bytes account of a built partition -
+    imported lazily (memscope also consumes this module)."""
+    from .memscope import matrix_bytes_per_shard
+
+    return matrix_bytes_per_shard(parts)
 
 
 def _plan_label(parts, plan) -> str:
@@ -321,7 +343,8 @@ def report_gather_csr(a, parts, plan=None) -> ShardReport:
         rows=_real_rows(parts.n_global, n_local, n_shards, ranges),
         nnz=nnz,
         slots=slots, halo_send_bytes=send, halo_recv_bytes=recv,
-        neighbors=neighbors, plan=_plan_label(parts, plan))
+        neighbors=neighbors, plan=_plan_label(parts, plan),
+        persistent_bytes=_partition_persistent_bytes(parts))
 
 
 def report_partition_csr(a, parts, plan=None) -> ShardReport:
@@ -348,7 +371,8 @@ def report_partition_csr(a, parts, plan=None) -> ShardReport:
         rows=_real_rows(parts.n_global, n_local, n_shards, ranges),
         nnz=nnz,
         slots=slots, halo_send_bytes=send, halo_recv_bytes=recv,
-        neighbors=neighbors, plan=_plan_label(parts, plan))
+        neighbors=neighbors, plan=_plan_label(parts, plan),
+        persistent_bytes=_partition_persistent_bytes(parts))
 
 
 def report_ring_csr(a, parts, plan=None) -> ShardReport:
@@ -367,7 +391,8 @@ def report_ring_csr(a, parts, plan=None) -> ShardReport:
         rows=_real_rows(parts.n_global, n_local, n_shards, ranges),
         nnz=nnz,
         slots=slots, halo_send_bytes=send, halo_recv_bytes=recv,
-        neighbors=neighbors, plan=_plan_label(parts, plan))
+        neighbors=neighbors, plan=_plan_label(parts, plan),
+        persistent_bytes=_partition_persistent_bytes(parts))
 
 
 def report_ring_shiftell(a, parts, plan=None) -> ShardReport:
@@ -396,7 +421,8 @@ def report_ring_shiftell(a, parts, plan=None) -> ShardReport:
         rows=_real_rows(parts.n_global, n_local, n_shards, ranges),
         nnz=nnz,
         slots=slots, halo_send_bytes=send, halo_recv_bytes=recv,
-        neighbors=neighbors, plan=_plan_label(parts, plan))
+        neighbors=neighbors, plan=_plan_label(parts, plan),
+        persistent_bytes=_partition_persistent_bytes(parts))
 
 
 def report_stencil(local_grid, n_shards: int, itemsize: int,
@@ -516,12 +542,16 @@ def report_for_ranges(a, row_ranges, *, itemsize=None,
         tuple(sorted((peer, b) for (owner, peer), b in pair_counts.items()
                      if owner == k))
         for k in range(n_shards))
+    from .memscope import csr_slot_bytes
+
     return ShardReport(
         kind="ranges", n_shards=n_shards, n_global=n,
         n_global_padded=n_local * n_shards, n_local=n_local,
         rows=rows, nnz=nnz, slots=slots,
         halo_send_bytes=send, halo_recv_bytes=recv,
-        neighbors=neighbors, plan=plan)
+        neighbors=neighbors, plan=plan,
+        persistent_bytes=csr_slot_bytes(slots, itemsize).astype(
+            np.int64))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,133 @@
+"""Memory observatory: per-shard HBM accounting, fit prediction, and
+the over-budget refusal.
+
+Three acts:
+
+1. **Measure a real solve**: a mesh-4 distributed solve with telemetry
+   active computes the static per-shard footprint (exact pinned
+   partition bytes + the modeled solver working set + the
+   jaxpr-liveness transient peak) and asserts it BYTE-EXACT against
+   the dispatcher-held device arrays' summed global ``.nbytes`` - the
+   same numbers from two independent derivations.
+2. **Price the 256^3 target without touching a device**:
+   ``predict_footprint`` prices the pod-scale 3-D Poisson system
+   (16.8M unknowns) from geometry alone and
+   ``smallest_fitting_mesh`` names the minimum pod slice per lane -
+   including the cautionary allgather k=256 lane whose extended-x
+   block never shrinks with the mesh.
+3. **Refuse before compiling**: a serve registration whose widest
+   batch bucket would overflow ``ServiceConfig.hbm_budget`` raises
+   ``MemoryBudgetError`` BEFORE any partition or compile work, naming
+   the smallest mesh that would fit; lifting the budget registers the
+   same operator with a FITS memory profile.
+
+Run:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+      python examples/22_memscope.py
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from cuda_mpi_parallel_tpu import telemetry
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.parallel import make_mesh, solve_distributed
+from cuda_mpi_parallel_tpu.serve import ServiceConfig, SolverService
+from cuda_mpi_parallel_tpu.telemetry import events, memscope
+
+
+def fmt(v):
+    for unit, scale in (("GiB", 2 ** 30), ("MiB", 2 ** 20),
+                        ("KiB", 2 ** 10)):
+        if v >= scale:
+            return f"{v / scale:.2f} {unit}"
+    return f"{int(v)} B"
+
+
+# -- act 1: the measured twin on a real mesh-4 solve --------------------
+print("== act 1: byte-exact footprint of a mesh-4 solve ==")
+a = poisson.poisson_2d_csr(24, 24, dtype=np.float32)
+b = np.random.default_rng(0).standard_normal(a.shape[0])
+mesh = make_mesh(4)
+memscope.reset_last_memory_profile()
+try:
+    with events.capture():
+        telemetry.force_active(True)
+        res = solve_distributed(a, b, mesh=mesh, tol=1e-6, maxiter=500)
+finally:
+    telemetry.force_active(False)
+prof = memscope.last_memory_profile()
+fp = prof["footprint"]
+assert prof["measured_bytes"] == int(fp.matrix_bytes.sum()), \
+    "static model disagrees with the device arrays"
+print(f"  {fp.kind} x {fp.n_shards} shards: "
+      f"{fmt(int(fp.persistent_bytes.max()))}/shard persistent "
+      f"({fmt(int(fp.matrix_bytes.max()))} matrix + "
+      f"{fmt(int(fp.solver_bytes.max()))} solver), "
+      f"transient peak {fmt(fp.peak_bytes)} -> {fp.classification}")
+print(f"  measured on device: {fmt(prof['measured_bytes'])} "
+      f"== model, asserted ({int(res.iterations)} iterations)")
+
+# -- act 2: the 256^3 feasibility table, zero device work ---------------
+print("\n== act 2: pricing the 256^3 Poisson target (16.8M rows) ==")
+n = 256 ** 3
+nnz = n + 6 * 256 * 256 * 255         # 7-point stencil, exact
+hbm = 16.0 * 2 ** 30
+for label, kw in (
+        ("f32 k=1 ring     ", dict(exchange="ring")),
+        ("df64 k=1 ring    ", dict(exchange="ring", df64=True)),
+        ("f32 k=32 ring    ", dict(exchange="ring", n_rhs=32)),
+        ("f32 k=256 allgath", dict(exchange="allgather", n_rhs=256))):
+    for p in (1, 2, 8):
+        pred = memscope.predict_footprint(
+            n=n, n_shards=p, nnz=nnz, itemsize=4, hbm_bytes=hbm, **kw)
+        print(f"  {label} P={p:>3}: "
+              f"{fmt(int(pred.persistent_bytes.max())):>11}/shard "
+              f"-> {pred.classification}")
+    fit = memscope.smallest_fitting_mesh(
+        n=n, budget_bytes=hbm, nnz=nnz, itemsize=4,
+        n_rhs=kw.get("n_rhs", 1), exchange=kw["exchange"],
+        df64=kw.get("df64", False))
+    print(f"  {label} minimum pod slice: "
+          f"{fit if fit is not None else 'never fits'}")
+
+# -- act 3: serve refuses an over-budget registration -------------------
+print("\n== act 3: over-budget registration refused pre-compile ==")
+wide = memscope.predict_footprint(
+    n=a.shape[0], n_shards=4, indptr=np.asarray(a.indptr), itemsize=4,
+    n_rhs=8, exchange="allgather", hbm_bytes=None)
+budget = float(int(wide.peak_bytes) - 1)   # one byte short, on purpose
+svc = SolverService(ServiceConfig(clock=lambda: 0.0, max_batch=8,
+                                  hbm_budget=budget))
+try:
+    try:
+        svc.register(a, mesh=mesh)
+        raise SystemExit("refusal did not fire")
+    except memscope.MemoryBudgetError as e:
+        print(f"  refused: needs {fmt(e.required_bytes)}/device, "
+              f"budget {fmt(e.budget_bytes)}; smallest fitting mesh "
+              f"{e.smallest_fitting_mesh} shards")
+finally:
+    svc.close()
+svc = SolverService(ServiceConfig(clock=lambda: 0.0, max_batch=8,
+                                  hbm_budget=hbm))
+try:
+    memscope.reset_last_memory_profile()
+    svc.register(a, mesh=mesh, warm=False)
+    fp = memscope.last_memory_profile()["footprint"]
+    print(f"  budget lifted to {fmt(hbm)}: registered, "
+          f"{fp.classification} with "
+          f"{fp.headroom_frac * 100:.1f}% headroom")
+finally:
+    svc.close()
+
+print("\nall contracts held")

@@ -211,6 +211,21 @@ METRIC_DIRECTION = {
     "usage.device_seconds": None,
     "usage.wire_bytes": None,
     "usage.device_seconds_per_request": None,
+    # device-memory observatory columns (telemetry.memscope): predicted
+    # worst-shard persistent bytes (with its measured device-array
+    # twin), the jaxpr-liveness transient peak, headroom % against the
+    # detected device memory, and the headline row's modeled working
+    # set / allocator peak.  Reported, never gated - footprints track
+    # the bench problem's geometry and the host's memory size, not the
+    # code; pre-memscope files simply lack them (rendered n/a).
+    "mem.persistent_bytes_worst": None,
+    "mem.matrix_bytes_worst": None,
+    "mem.measured_matrix_bytes": None,
+    "mem.jaxpr_peak_bytes": None,
+    "mem.peak_bytes": None,
+    "mem.headroom_pct": None,
+    "mem.device_peak_bytes": None,
+    "mem.model_working_set_bytes": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -283,6 +298,10 @@ _NESTED = {
                 "final_solve_iters_poisson", "iters_saved_pct_poisson",
                 "harvest_overhead_pct_skewed",
                 "harvest_overhead_pct_poisson"),
+    "mem": ("persistent_bytes_worst", "matrix_bytes_worst",
+            "measured_matrix_bytes", "jaxpr_peak_bytes", "peak_bytes",
+            "headroom_pct", "device_peak_bytes",
+            "model_working_set_bytes"),
 }
 
 

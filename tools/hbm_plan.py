@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""The 256^3 HBM feasibility report: model-only memory planning.
+
+ROADMAP item 7 asks when the N=256^3 Poisson solve (16.8M unknowns)
+stops fitting one device and what pod slice it needs.  This tool
+answers with ZERO device work: ``telemetry.memscope.predict_footprint``
+prices every (grid, mesh, lane) combination from geometry alone - the
+same per-shard accounting the dispatch-time measured twin asserts
+byte-exact against device arrays - and classifies each against the
+device HBM budget (the planner's reference TPU model, 16 GiB, unless
+``--hbm-gib`` overrides).
+
+Lanes swept (the ones whose footprints SCALE differently):
+
+* ``f32 k=1``  - the BASELINE configuration (ring exchange: the
+  extended-x buffer shrinks with the mesh);
+* ``f32 k=1 allgather`` - the legacy lane whose extended-x block is
+  the FULL vector on every shard (it never shrinks with the mesh: the
+  lane that forces sharding to help nothing);
+* ``df64 k=1`` - double-double storage (every value plane doubled);
+* ``f32 k=32`` - the serve tier's widest bucket (the 5-stack working
+  set scales by k: the lane where vectors, not the matrix, overflow).
+
+Usage::
+
+    python tools/hbm_plan.py                 # full 64^3/128^3/256^3 sweep
+    python tools/hbm_plan.py --n 64          # smoke (lint gate)
+    python tools/hbm_plan.py --hbm-gib 8     # smaller device
+    python tools/hbm_plan.py --json          # machine-readable
+
+Exit status 0 always (this is a report, not a gate); unfittable lanes
+print ``never fits`` with the reason.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from cuda_mpi_parallel_tpu.telemetry import memscope  # noqa: E402
+
+
+def poisson3d_nnz(n: int) -> int:
+    """Exact nonzero count of the 7-point N^3 Poisson operator: one
+    diagonal per row plus two off-diagonals per interior face in each
+    of the three dimensions."""
+    return n ** 3 + 6 * n * n * (n - 1)
+
+
+#: (label, dict of predict_footprint overrides) - the swept lanes
+LANES = (
+    ("f32 k=1 ring", dict(itemsize=4, n_rhs=1, exchange="ring")),
+    ("f32 k=1 allgather", dict(itemsize=4, n_rhs=1,
+                               exchange="allgather")),
+    ("df64 k=1 ring", dict(itemsize=4, n_rhs=1, exchange="ring",
+                           df64=True)),
+    ("f32 k=32 ring", dict(itemsize=4, n_rhs=32, exchange="ring")),
+    # the cautionary lane: allgather's extended-X block is n x k on
+    # EVERY shard regardless of mesh size, so once n*k*itemsize alone
+    # exceeds the budget, no pod slice ever fits - the sweep prints
+    # "never fits" instead of a mesh size
+    ("f32 k=256 allgather", dict(itemsize=4, n_rhs=256,
+                                 exchange="allgather")),
+)
+
+
+def fmt_bytes(v) -> str:
+    if v is None:
+        return "n/a"
+    for unit, scale in (("GiB", 2 ** 30), ("MiB", 2 ** 20),
+                        ("KiB", 2 ** 10)):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f} {unit}"
+    return f"{int(v)} B"
+
+
+def sweep(grids, meshes, hbm_bytes):
+    """One row per (grid, lane, mesh): worst-shard persistent bytes +
+    verdict, plus the smallest fitting mesh per (grid, lane)."""
+    rows = []
+    minimums = []
+    for n in grids:
+        n_rows = n ** 3
+        nnz = poisson3d_nnz(n)
+        for label, kw in LANES:
+            for p in meshes:
+                if p > n_rows:
+                    continue
+                fp = memscope.predict_footprint(
+                    n=n_rows, n_shards=p, nnz=nnz,
+                    hbm_bytes=hbm_bytes, **kw)
+                worst = int(fp.persistent_bytes.max())
+                rows.append({
+                    "grid": f"{n}^3", "n": n_rows, "lane": label,
+                    "n_shards": p, "worst_shard_bytes": worst,
+                    "classification": fp.classification,
+                    "headroom_frac": fp.headroom_frac,
+                })
+            fit = memscope.smallest_fitting_mesh(
+                n=n_rows, budget_bytes=hbm_bytes, nnz=nnz,
+                itemsize=kw["itemsize"], n_rhs=kw["n_rhs"],
+                exchange=kw["exchange"], df64=kw.get("df64", False))
+            minimums.append({
+                "grid": f"{n}^3", "lane": label,
+                "min_shards": fit,
+            })
+    return rows, minimums
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="memscope model-only HBM feasibility sweep for "
+                    "3-D Poisson grids")
+    ap.add_argument("--n", type=int, action="append", default=None,
+                    metavar="N",
+                    help="grid edge(s) to sweep (N^3 unknowns); "
+                         "repeatable; default 64 128 256")
+    ap.add_argument("--mesh", type=int, action="append", default=None,
+                    metavar="P",
+                    help="mesh size(s); repeatable; default "
+                         "1 2 4 ... 256")
+    ap.add_argument("--hbm-gib", type=float, default=None,
+                    help="device HBM budget in GiB (default: the "
+                         "planner's reference TPU model, 16)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the sweep as JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    grids = args.n or [64, 128, 256]
+    meshes = args.mesh or [2 ** k for k in range(9)]
+    if args.hbm_gib is not None:
+        hbm = args.hbm_gib * 2 ** 30
+    else:
+        from cuda_mpi_parallel_tpu.balance.plan import reference_model
+
+        hbm = reference_model().hbm_bytes
+    rows, minimums = sweep(grids, meshes, hbm)
+
+    if args.json:
+        print(json.dumps({"hbm_bytes": hbm, "rows": rows,
+                          "minimum_mesh": minimums}, indent=2))
+        return 0
+
+    print(f"device HBM budget: {fmt_bytes(hbm)} "
+          f"(memscope static model; persistent = exact partition "
+          f"slots + modeled solver working set)")
+    print()
+    print(f"{'grid':>6} {'lane':<18} {'shards':>6} "
+          f"{'worst shard':>12} {'verdict':<8} {'headroom':>8}")
+    for r in rows:
+        hr = (f"{r['headroom_frac'] * 100:.1f}%"
+              if r["headroom_frac"] is not None else "n/a")
+        print(f"{r['grid']:>6} {r['lane']:<18} {r['n_shards']:>6} "
+              f"{fmt_bytes(r['worst_shard_bytes']):>12} "
+              f"{r['classification']:<8} {hr:>8}")
+    print()
+    print("minimum pod slice per lane:")
+    for m in minimums:
+        fit = m["min_shards"]
+        verdict = f"{fit} shard(s)" if fit is not None else \
+            "never fits (a per-shard term does not shrink with the " \
+            "mesh: shrink k or the budget target)"
+        print(f"  {m['grid']:>6} {m['lane']:<18} -> {verdict}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,6 +120,68 @@ print(f"planner gate: nnz max/mean {even} (even) -> {auto} (auto)")
 PY
 echo "planner gate: clean"
 
+# Memscope gate: the device-memory observatory end-to-end on the same
+# committed skewed fixture - one mesh-4 CLI solve with --memory-report
+# must (a) emit a schema-valid memory_profile event, (b) carry a
+# memory payload in --json whose MEASURED dispatcher-held device bytes
+# equal the static model's summed per-shard partition bytes EXACTLY
+# (the byte-exact contract the dispatch hook itself asserts), with the
+# per-shard persistent bytes reconciling as matrix + solver working
+# set and a jaxpr-derived transient peak present, and (c) render the
+# report's memory section.  Then the model-only feasibility sweep
+# (tools/hbm_plan.py, the ROADMAP item 7 answer at 256^3) must price a
+# 64^3 smoke grid and name a finite minimum mesh for the baseline lane
+# - zero device work, pure geometry.
+echo "== memscope gate (mesh-4 CLI: --memory-report byte-exact) =="
+JAX_PLATFORMS=cpu python -m cuda_mpi_parallel_tpu.cli \
+    --problem mm --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
+    --device cpu --tol 1e-8 --maxiter 500 --json \
+    --memory-report \
+    --trace-events "$scratch/mem_events.jsonl" \
+    --report "$scratch/mem_report.txt" \
+    > "$scratch/mem.json"
+python tools/validate_trace.py "$scratch/mem_events.jsonl"
+python - "$scratch" <<'PY'
+import json
+import sys
+
+scratch = sys.argv[1]
+with open(f"{scratch}/mem.json") as f:
+    rec = json.load(f)
+events = [json.loads(ln)
+          for ln in open(f"{scratch}/mem_events.jsonl")
+          if ln.strip()]
+
+mem = rec["memory"]
+assert mem is not None, "no memory payload in the --json record"
+assert mem["n_shards"] == 4, mem["n_shards"]
+# the byte-exact contract: the dispatcher-held device arrays measure
+# exactly what the static partition model predicted
+assert mem["measured_bytes"] == sum(mem["matrix_bytes"]), \
+    (mem["measured_bytes"], mem["matrix_bytes"])
+# per-shard persistent = pinned matrix slots + modeled solver stacks
+assert mem["persistent_bytes"] == [
+    m + s for m, s in zip(mem["matrix_bytes"], mem["solver_bytes"])], \
+    mem
+assert mem["classification"] in ("FITS", "TIGHT", "OVERFLOW"), mem
+assert mem["jaxpr_peak_bytes"], \
+    "no jaxpr-derived transient peak in the memory payload"
+
+profs = [e for e in events if e["event"] == "memory_profile"]
+assert profs, "no memory_profile event emitted"
+prof = profs[-1]
+assert prof["measured_bytes"] == mem["measured_bytes"], prof
+assert prof["classification"] == mem["classification"], prof
+print(f"memscope gate: {mem['kind']} x {mem['n_shards']} shards, "
+      f"measured {mem['measured_bytes']} B == model (exact), "
+      f"peak {mem['peak_bytes']} B -> {mem['classification']}")
+PY
+grep -q "memory (per-shard HBM accounting)" "$scratch/mem_report.txt"
+python tools/hbm_plan.py --n 64 > "$scratch/hbm_plan.txt"
+grep -q "minimum pod slice per lane" "$scratch/hbm_plan.txt"
+grep -qE "64\^3 f32 k=1 ring +-> [0-9]+ shard" "$scratch/hbm_plan.txt"
+echo "memscope gate: clean"
+
 # Calibra gate: the runtime-calibration + replan loop end-to-end on
 # the same skewed fixture - a mesh-4 CLI sequence (--repeat 2 --replan)
 # must emit a schema-valid `replan` event (the kept/switched decision)
