@@ -1751,9 +1751,15 @@ def bench_all(results, sections=None) -> None:
     # A third replay runs the same workload with the request
     # observatory on (causal span tracing + metered usage to a scratch
     # JSONL) and reports the tracing overhead % - the cost of knowing
-    # what every request did.
+    # what every request did.  A fourth replay serves the ops plane
+    # (serve.ops) on an ephemeral port with a scraper thread hammering
+    # /metrics + /readyz throughout, and reports the scrape overhead %
+    # (wall only: scrapes are host-side reads, the answers are bitwise
+    # identical - tests/test_ops_plane.py).
     def s_serve():
         import tempfile
+        import threading
+        import urllib.request
 
         from cuda_mpi_parallel_tpu import telemetry
         from cuda_mpi_parallel_tpu.serve import (
@@ -1772,13 +1778,32 @@ def bench_all(results, sections=None) -> None:
         prepared = [(r, rhs_for(a2, r.seed, dtype=np.float32)[0])
                     for r in workload]
 
-        def replay(max_batch, trace_path=None):
+        def replay(max_batch, trace_path=None, ops=False):
             if trace_path is not None:
                 telemetry.configure(trace_path)
             svc = SolverService(ServiceConfig(
                 max_batch=max_batch, max_wait_s=0.002,
                 queue_limit=512, maxiter=600, check_every=8,
-                usage=trace_path is not None))
+                usage=trace_path is not None,
+                ops_port=0 if ops else None))
+            stop = threading.Event()
+            scraper = None
+            if ops:
+                base = svc.ops_server().url
+
+                def hammer():
+                    # 20 Hz scrape rounds - an aggressive Prometheus
+                    # interval, not a CPU-stealing busy loop
+                    while not stop.wait(0.05):
+                        for path in ("/metrics", "/readyz"):
+                            try:
+                                urllib.request.urlopen(
+                                    base + path, timeout=2).read()
+                            except Exception:  # noqa: BLE001
+                                pass  # 503 readyz is a verdict
+
+                scraper = threading.Thread(target=hammer, daemon=True)
+                scraper.start()
             try:
                 h = svc.register(a2)
                 t0 = time.perf_counter()
@@ -1794,6 +1819,9 @@ def bench_all(results, sections=None) -> None:
                              if f.result().converged)
                 stats = svc.stats()
             finally:
+                stop.set()
+                if scraper is not None:
+                    scraper.join(timeout=2.0)
                 svc.close()
                 if trace_path is not None:
                     telemetry.configure(None)
@@ -1801,6 +1829,7 @@ def bench_all(results, sections=None) -> None:
 
         rate_b, stats_b, solved_b = replay(32)
         rate_1, stats_1, solved_1 = replay(1)
+        rate_o, _, solved_o = replay(32, ops=True)
         with tempfile.TemporaryDirectory() as td:
             trace_path = os.path.join(td, "serve_trace.jsonl")
             rate_t, stats_t, solved_t = replay(32,
@@ -1844,6 +1873,12 @@ def bench_all(results, sections=None) -> None:
                 "device_seconds_per_request": round(
                     usage_totals["device_seconds"]
                     / max(usage_totals["requests"], 1), 6),
+            },
+            "ops": {
+                "scrape_overhead_pct": round(
+                    (1.0 - rate_o / max(rate_b, 1e-9)) * 100.0, 1),
+                "scraped_rhs_per_sec": round(rate_o, 1),
+                "scraped_solved": solved_o,
             },
         }
         results["serve"] = entry

@@ -2177,10 +2177,12 @@ def main(argv=None) -> int:
                         iterations=result.iterations)
             print(ulog.format_history(hist_src, every=every))
         if args.metrics:
-            from .telemetry.registry import REGISTRY
+            # THE ops-plane formatter (serve.ops.prometheus_exposition):
+            # the one-shot dump is byte-identical to a /metrics scrape
+            from .serve.ops import prometheus_exposition
 
             print("--- metrics (prometheus text) ---")
-            print(REGISTRY.to_prometheus(), end="")
+            print(prometheus_exposition(), end="")
         if solve_report is not None and args.report == "-":
             print()
             print(solve_report.to_text(), end="")

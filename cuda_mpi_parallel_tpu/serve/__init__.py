@@ -30,6 +30,11 @@ from .queue import (
     bucket_sizes,
     tol_class,
 )
+from .ops import (
+    PROMETHEUS_CONTENT_TYPE,
+    OpsServer,
+    prometheus_exposition,
+)
 from .sched import (
     DEFAULT_CLASSES,
     BatchCostModel,
@@ -67,6 +72,8 @@ __all__ = [
     "DEFAULT_CLASSES",
     "MicroBatchQueue",
     "OperatorHandle",
+    "OpsServer",
+    "PROMETHEUS_CONTENT_TYPE",
     "QueueFull",
     "RecyclePolicy",
     "ReplaySummary",
@@ -86,6 +93,7 @@ __all__ = [
     "bucket_for",
     "bucket_sizes",
     "load_workload",
+    "prometheus_exposition",
     "replay_workload",
     "rhs_for",
     "save_workload",

@@ -150,6 +150,17 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="print the metrics registry (Prometheus text, "
                         "incl. serve_* gauges and latency "
                         "percentiles) after the replay")
+    p.add_argument("--ops-port", type=int, default=None,
+                   dest="ops_port", metavar="PORT",
+                   help="serve the read-only HTTP ops plane "
+                        "(/metrics, /healthz, /readyz, /stats, "
+                        "/usage, /traces/<id>, /events) on PORT for "
+                        "the duration of the replay (0 = ephemeral; "
+                        "the bound URL is announced on stderr)")
+    p.add_argument("--ops-token", default=None, dest="ops_token",
+                   metavar="TOKEN",
+                   help="static bearer token gating every ops route "
+                        "(401 without it)")
     p.add_argument("--json", action="store_true",
                    help="emit one JSON record instead of text")
     p.add_argument("--report", nargs="?", const="-", default=None,
@@ -296,7 +307,13 @@ def main(argv=None) -> int:
         queue_limit=args.queue_limit, maxiter=args.maxiter,
         check_every=args.check_every, recycle=recycle_policy,
         admission=admission, shed=shed, workers=args.workers,
-        usage=args.usage is not None))
+        usage=args.usage is not None,
+        ops_port=args.ops_port, ops_token=args.ops_token))
+    if service.ops_server() is not None:
+        # stderr: --json owns stdout, and scrapers need the bound
+        # port BEFORE the replay finishes (0 = ephemeral)
+        print(f"ops plane: {service.ops_server().url}",
+              file=sys.stderr, flush=True)
     mesh = None
     if args.mesh > 1:
         from ..parallel import make_mesh
@@ -484,8 +501,12 @@ def main(argv=None) -> int:
     else:
         print(report_text, end="")
         if args.metrics:
+            # THE ops-plane formatter (serve.ops.prometheus_exposition):
+            # the one-shot dump is byte-identical to a /metrics scrape
+            from .ops import prometheus_exposition
+
             print("--- metrics (prometheus text) ---")
-            print(REGISTRY.to_prometheus(), end="")
+            print(prometheus_exposition(), end="")
     return 0 if all_ok else 1
 
 

@@ -1,0 +1,399 @@
+"""The network-facing ops plane: a read-only HTTP observatory.
+
+Every observatory grown so far - the metrics registry, the events
+stream, request traces, SLO burn accounting, the usage ledger - is
+reachable only from Python inside the serving process.  ROADMAP item 1
+asks for metered usage EXPORT and item 2's replica router needs a
+machine-readable health signal per replica; both are network
+questions.  This module answers them with the stdlib only
+(`http.server.ThreadingHTTPServer` - no new dependencies):
+
+======================  ==============================================
+``GET /metrics``        Prometheus text exposition of the global
+                        registry (``text/plain; version=0.0.4``) -
+                        byte-identical to the CLI's ``--metrics``
+                        one-shot dump (one formatter:
+                        :func:`prometheus_exposition`).
+``GET /snapshot``       ``MetricsRegistry.snapshot()`` as JSON - the
+                        machine-readable form ``telemetry.fleet``
+                        merges (bucket bounds included; no parsing
+                        Prometheus text back into numbers).
+``GET /healthz``        process liveness (200 while the server runs).
+``GET /readyz``         routing-grade readiness: 200 only when the
+                        service is accepting AND no breaker is open
+                        AND the shed ladder is at level 0 AND no SLO
+                        flow burns over threshold; otherwise 503 with
+                        a typed JSON verdict naming every failing
+                        gate (:meth:`SolverService.readiness`).
+``GET /stats``          the full ``stats()`` JSON.
+``GET /usage``          the usage ledger snapshot (404 when metering
+                        is off) - the metered-export half of ROADMAP
+                        item 1.
+``GET /traces/<id>``    the rendered causal span tree of one trace,
+                        served from a bounded in-process span store
+                        fed by the event bus - never by tailing files.
+``GET /events``         recent events as JSON; ``?follow=1`` upgrades
+                        to Server-Sent Events off a dedicated
+                        ``telemetry.events.subscribe()`` ring.
+======================  ==============================================
+
+**Zero perturbation.**  Every endpoint above reads host-side state
+(registry counters, stats tallies, event dicts) under the same locks
+the service already takes per batch; nothing here touches a jax value
+or forces a device sync, so a concurrent scrape leaves the solve
+stream bitwise identical (test- and lint-gate-asserted).
+
+**Read-only.**  No POST, no mutation: the plane observes the service,
+it never drives it.  Tenant tags are currently on trust, so the
+optional static bearer ``token`` gates every route (401 without it) -
+transport auth, not authorization policy.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Set
+from urllib.parse import parse_qs, urlparse
+
+from ..telemetry import events
+from ..telemetry.registry import REGISTRY
+from ..telemetry.tracing import build_forest, render_tree
+
+__all__ = ["OpsServer", "PROMETHEUS_CONTENT_TYPE",
+           "prometheus_exposition"]
+
+#: the Prometheus text exposition format version this plane speaks
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_JSON_CONTENT_TYPE = "application/json; charset=utf-8"
+
+
+def prometheus_exposition(registry=None) -> str:
+    """THE Prometheus text formatter - ``/metrics`` scrapes and the
+    CLI's ``--metrics`` one-shot dump both call this, so the two are
+    byte-identical by construction (one formatter, no drift)."""
+    reg = REGISTRY if registry is None else registry
+    return reg.to_prometheus()
+
+
+class OpsServer:
+    """One service's ops plane: a daemon ``ThreadingHTTPServer`` plus
+    a pump thread that drains a subscriber ring into the bounded span
+    store / recent-event ring the ``/traces`` and ``/events``
+    endpoints serve from.
+
+    ``port=0`` binds an ephemeral port (tests); :attr:`port` reports
+    the bound one.  Start via :meth:`SolverService.serve_ops` or
+    ``ServiceConfig(ops_port=...)`` rather than constructing directly.
+    """
+
+    def __init__(self, service, *, port: int = 0,
+                 host: str = "127.0.0.1",
+                 token: Optional[str] = None,
+                 span_store: int = 4096,
+                 event_ring: int = 1024):
+        self.service = service
+        self._host = str(host)
+        self._want_port = int(port)
+        self._token = token if token is None else str(token)
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=int(span_store))
+        self._recent: deque = deque(maxlen=int(event_ring))
+        self._sub: Optional[events.Subscription] = None
+        self._sse_subs: Set[events.Subscription] = set()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._threads: List[threading.Thread] = []
+        self._stopping = False
+        self._started_mono = 0.0
+        self._scrapes = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "OpsServer":
+        if self._httpd is not None:
+            raise RuntimeError("OpsServer already started")
+        handler = type("_BoundOpsHandler", (_OpsHandler,),
+                       {"ops": self})
+        httpd = ThreadingHTTPServer((self._host, self._want_port),
+                                    handler)
+        httpd.daemon_threads = True
+        self._httpd = httpd
+        self._started_mono = time.monotonic()
+        # the event pump: one bounded ring off the in-process bus
+        # (drop-oldest; never blocks the emitter), drained into the
+        # span store - /traces never tails a file
+        self._sub = events.subscribe(maxlen=4096)
+        pump = threading.Thread(target=self._pump_loop,
+                                name="cuda-mpi-parallel-tpu-ops-pump",
+                                daemon=True)
+        serve = threading.Thread(target=httpd.serve_forever,
+                                 name="cuda-mpi-parallel-tpu-ops-http",
+                                 daemon=True)
+        pump.start()
+        serve.start()
+        self._threads = [pump, serve]
+        return self
+
+    def stop(self) -> None:
+        """Shut the plane down: stop accepting, close every live SSE
+        ring, unsubscribe the pump.  Idempotent."""
+        if self._httpd is None:
+            return
+        self._stopping = True
+        if self._sub is not None:
+            events.unsubscribe(self._sub)
+        with self._lock:
+            followers = list(self._sse_subs)
+        for sub in followers:
+            events.unsubscribe(sub)
+        self._httpd.shutdown()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._httpd.server_close()
+        self._httpd = None
+        self._threads = []
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("OpsServer not started")
+        return int(self._httpd.server_address[1])
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def scrape_count(self) -> int:
+        """Requests served so far (any route) - the overhead bench's
+        denominator."""
+        with self._lock:
+            return self._scrapes
+
+    # -- event pump ----------------------------------------------------
+
+    def _pump_loop(self) -> None:
+        sub = self._sub
+        while not self._stopping:
+            rec = sub.pop(timeout=0.25)
+            if rec is None:
+                if sub.closed:
+                    return
+                continue
+            with self._lock:
+                self._recent.append(rec)
+                if rec.get("event") == "span":
+                    self._spans.append(rec)
+
+    def span_records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._spans)
+
+    def recent_events(self, n: Optional[int] = None
+                      ) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self._recent)
+        return out if n is None else out[-int(n):]
+
+    def _note_scrape(self) -> None:
+        with self._lock:
+            self._scrapes += 1
+
+    def _sse_attach(self, sub: events.Subscription) -> None:
+        with self._lock:
+            self._sse_subs.add(sub)
+
+    def _sse_detach(self, sub: events.Subscription) -> None:
+        with self._lock:
+            self._sse_subs.discard(sub)
+        events.unsubscribe(sub)
+
+
+class _OpsHandler(BaseHTTPRequestHandler):
+    """Route table of one :class:`OpsServer` (bound via a subclass
+    holding ``ops``)."""
+
+    ops: OpsServer = None  # type: ignore[assignment]
+    protocol_version = "HTTP/1.1"
+    server_version = "cuda-mpi-parallel-tpu-ops"
+
+    # the stdlib handler logs every request to stderr; an ops plane
+    # scraped every few seconds must not spam the service's console
+    def log_message(self, format: str, *args: Any) -> None:
+        pass
+
+    # -- response helpers ---------------------------------------------
+
+    def _send(self, code: int, body: bytes, content_type: str,
+              extra: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Cache-Control", "no-store")
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, payload: Any,
+                   extra: Optional[Dict[str, str]] = None) -> None:
+        body = (json.dumps(payload, sort_keys=True, allow_nan=False)
+                + "\n").encode("utf-8")
+        self._send(code, body, _JSON_CONTENT_TYPE, extra)
+
+    def _send_error_json(self, code: int, error: str,
+                         **fields: Any) -> None:
+        self._send_json(code, {"error": error, "status_code": code,
+                               **fields})
+
+    # -- auth ----------------------------------------------------------
+
+    def _authorized(self) -> bool:
+        token = self.ops._token
+        if token is None:
+            return True
+        got = self.headers.get("Authorization", "")
+        if got == f"Bearer {token}":
+            return True
+        self._send_json(
+            401, {"error": "unauthorized", "status_code": 401,
+                  "detail": "this ops plane requires a static bearer "
+                            "token: Authorization: Bearer <token>"},
+            extra={"WWW-Authenticate": "Bearer"})
+        return False
+
+    # -- routes --------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802  (stdlib handler API)
+        try:
+            if not self._authorized():
+                return
+            self.ops._note_scrape()
+            parsed = urlparse(self.path)
+            path = parsed.path.rstrip("/") or "/"
+            query = parse_qs(parsed.query)
+            if path == "/metrics":
+                self._get_metrics()
+            elif path == "/snapshot":
+                self._send_json(200, REGISTRY.snapshot())
+            elif path == "/healthz":
+                self._get_healthz()
+            elif path == "/readyz":
+                self._get_readyz()
+            elif path == "/stats":
+                self._send_json(200, self.ops.service.stats())
+            elif path == "/usage":
+                self._get_usage()
+            elif path.startswith("/traces/"):
+                self._get_trace(path[len("/traces/"):])
+            elif path == "/events":
+                self._get_events(query)
+            else:
+                self._send_error_json(
+                    404, "not found", path=path,
+                    routes=["/metrics", "/snapshot", "/healthz",
+                            "/readyz", "/stats", "/usage",
+                            "/traces/<trace_id>", "/events"])
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def do_HEAD(self) -> None:  # noqa: N802
+        # HEAD is read-only too; answer liveness probes cheaply
+        if self.ops._token is None or self.headers.get(
+                "Authorization") == f"Bearer {self.ops._token}":
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        else:
+            self.send_response(401)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    def _get_metrics(self) -> None:
+        text = prometheus_exposition()
+        self._send(200, text.encode("utf-8"), PROMETHEUS_CONTENT_TYPE)
+
+    def _get_healthz(self) -> None:
+        self._send_json(200, {
+            "ok": True,
+            "uptime_s": round(
+                time.monotonic() - self.ops._started_mono, 3),
+            "requests_served": self.ops.scrape_count(),
+        })
+
+    def _get_readyz(self) -> None:
+        verdict = self.ops.service.readiness()
+        self._send_json(200 if verdict["ready"] else 503, verdict)
+
+    def _get_usage(self) -> None:
+        ledger = self.ops.service.usage_ledger()
+        if ledger is None:
+            self._send_error_json(
+                404, "usage metering disabled",
+                detail="start the service with "
+                       "ServiceConfig(usage=True) to meter per-tenant "
+                       "usage")
+            return
+        self._send_json(200, ledger.snapshot())
+
+    def _get_trace(self, trace_id: str) -> None:
+        records = self.ops.span_records()
+        if trace_id not in build_forest(records):
+            self._send_error_json(
+                404, "unknown trace", trace_id=trace_id,
+                detail="no spans for this trace in the bounded span "
+                       "store (expired, or the id is wrong)")
+            return
+        text = render_tree(records, trace_id) + "\n"
+        self._send(200, text.encode("utf-8"),
+                   "text/plain; charset=utf-8")
+
+    def _get_events(self, query: Dict[str, List[str]]) -> None:
+        follow = query.get("follow", ["0"])[0] not in ("", "0",
+                                                       "false")
+        if not follow:
+            n = query.get("n", [None])[0]
+            payload = self.ops.recent_events(
+                None if n is None else int(n))
+            self._send_json(200, {"events": payload,
+                                  "n": len(payload)})
+            return
+        # SSE: a dedicated bounded ring per follower (drop-oldest;
+        # the emitter never blocks on a slow client)
+        limit = query.get("limit", [None])[0]
+        remaining = None if limit is None else max(int(limit), 0)
+        sub = events.subscribe(maxlen=1024)
+        self.ops._sse_attach(sub)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-store")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            while not self.ops._stopping:
+                if remaining is not None and remaining <= 0:
+                    break
+                rec = sub.pop(timeout=0.5)
+                if rec is None:
+                    if sub.closed:
+                        break
+                    # comment keepalive: flushes the pipe so a gone
+                    # client surfaces as BrokenPipeError promptly
+                    self.wfile.write(b": keepalive\n\n")
+                    self.wfile.flush()
+                    continue
+                data = json.dumps(rec, sort_keys=True,
+                                  allow_nan=False)
+                self.wfile.write(
+                    f"event: {rec.get('event', 'event')}\n"
+                    f"data: {data}\n\n".encode("utf-8"))
+                self.wfile.flush()
+                if remaining is not None:
+                    remaining -= 1
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            self.ops._sse_detach(sub)
+            self.close_connection = True

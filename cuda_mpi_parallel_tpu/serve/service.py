@@ -292,6 +292,17 @@ class ServiceConfig:
     #: per request forever; the registry histogram keeps the full
     #: cumulative story for scrapes)
     keep_latency_samples: int = 8192
+    #: network ops plane (serve.ops; None = off): bind a read-only
+    #: stdlib HTTP observatory on this port at construction -
+    #: /metrics, /healthz, /readyz, /stats, /usage, /traces/<id>,
+    #: /events (SSE).  0 = ephemeral port (tests read it off
+    #: ``service.ops_server().port``).  Host-side reads only: a
+    #: concurrent scrape never perturbs the solve stream
+    ops_port: Optional[int] = None
+    ops_host: str = "127.0.0.1"
+    #: optional static bearer token gating every ops route (401
+    #: without ``Authorization: Bearer <token>``)
+    ops_token: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -590,6 +601,13 @@ class SolverService:
                     daemon=True)
                 t.start()
                 self._workers.append(t)
+        # the network ops plane (serve.ops) - read-only HTTP
+        # observatory, torn down by close()
+        self._ops_server = None
+        if self.config.ops_port is not None:
+            self.serve_ops(self.config.ops_port,
+                           host=self.config.ops_host,
+                           token=self.config.ops_token)
 
     def _resolve_workers(self) -> int:
         """``config.workers``, with 0 = auto-size from the calibrated
@@ -2150,6 +2168,11 @@ class SolverService:
             for t in self._workers:
                 t.join(timeout=5.0)
             self._workers = []
+        # the ops plane outlives the drain (a scrape during shutdown
+        # sees status "closed", not a connection refusal), then stops
+        ops, self._ops_server = self._ops_server, None
+        if ops is not None:
+            ops.stop()
 
     def __enter__(self) -> "SolverService":
         return self
@@ -2201,6 +2224,86 @@ class SolverService:
         documented hook external policy (a future shed rung, an
         autoscaler) may poll."""
         return self._slo
+
+    # -- the network ops plane (serve.ops) -------------------------------
+
+    def serve_ops(self, port: int, *, host: Optional[str] = None,
+                  token: Optional[str] = None):
+        """Start the read-only HTTP ops plane on ``port`` (0 =
+        ephemeral) and return the :class:`serve.ops.OpsServer`.
+
+        One plane per service: a second call raises (two servers
+        scraping one registry would double-count nothing but confuse
+        everything).  ``ServiceConfig(ops_port=...)`` calls this at
+        construction; :meth:`close` tears it down.
+        """
+        from .ops import OpsServer
+
+        with self._lock:
+            if self._ops_server is not None:
+                raise RuntimeError(
+                    "ops plane already running on port "
+                    f"{self._ops_server.port}; one OpsServer per "
+                    "service")
+            server = OpsServer(
+                self, port=int(port),
+                host=host if host is not None else self.config.ops_host,
+                token=token if token is not None
+                else self.config.ops_token)
+            self._ops_server = server
+        server.start()
+        return server
+
+    def ops_server(self):
+        """The running :class:`serve.ops.OpsServer` (``None`` when the
+        plane is off)."""
+        return self._ops_server
+
+    def readiness(self) -> dict:
+        """The routing-grade readiness verdict ``GET /readyz`` serves.
+
+        READ-ONLY by contract: reads ``_closed``, the breaker states,
+        the shed ladder's current level and the SLO tracker's burn
+        windows under the service lock - it never re-evaluates the
+        ladder (that mutates state and emits events; the dispatch path
+        owns it).  Four gates, each with an ``ok`` verdict and enough
+        detail for a router to explain its decision:
+
+        * ``accepting`` - the service has not been closed;
+        * ``breakers``  - no per-handle circuit breaker is open
+          (half-open probes count as recovering, not failing);
+        * ``shed``      - the shed ladder sits at level 0;
+        * ``slo_burn``  - no (flow, window) burns over its threshold.
+
+        ``ready`` is the conjunction; ``failing`` names every gate
+        that voted no, so a 503 body is actionable without scraping
+        anything else.
+        """
+        now = self._clock()
+        with self._lock:
+            closed = self._closed
+            open_breakers = sorted(
+                key for key, br in self._breakers.items()
+                if br.state == "open")
+            shed_level = self._shed.level
+            shed_name = self._shed.name
+        burning = self._slo.burning(now) if self._slo is not None \
+            else []
+        gates = {
+            "accepting": {"ok": not closed},
+            "breakers": {"ok": not open_breakers,
+                         "open": open_breakers},
+            "shed": {"ok": shed_level == 0, "level": shed_level,
+                     "name": shed_name},
+            "slo_burn": {"ok": not burning, "burning": burning},
+        }
+        failing = [name for name in ("accepting", "breakers", "shed",
+                                     "slo_burn")
+                   if not gates[name]["ok"]]
+        status = "closed" if closed else (
+            "degraded" if failing else "ready")
+        return {"ready": not failing, "status": status,
+                "gates": gates, "failing": failing, "t": now}
 
     def stats(self) -> dict:
         """JSON-ready service summary: request/batch counts, occupancy

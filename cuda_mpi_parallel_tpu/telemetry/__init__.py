@@ -70,6 +70,7 @@ from . import (
     calibrate,
     cost,
     events,
+    fleet,
     flight,
     health,
     memscope,
@@ -145,6 +146,7 @@ __all__ = [
     "cost",
     "emit",
     "events",
+    "fleet",
     "flight",
     "health",
     "memscope",

@@ -10,8 +10,12 @@ it.
 
 Design rules:
 
-* **Opt-in and free when off.**  ``emit()`` with no sink configured is
-  a dict-build away from a no-op; no file handle, no formatting.
+* **Opt-in and free when off.**  ``emit()`` with no sink configured
+  and no subscriber attached is a dict-build away from a no-op; no
+  file handle, no formatting.  Consumers are a JSONL sink
+  (:func:`configure`) and/or bounded in-process subscriber rings
+  (:func:`subscribe` - the ops plane's live event bus; drop-oldest,
+  never blocking the emitter).
 * **Host-side only.**  Events carry host scalars.  Emission never
   reads a device value, so instrumentation can never force a transfer
   into (or a sync after) a solve - results are read only by consumers
@@ -41,13 +45,15 @@ import os
 import sys
 import threading
 import time
-from typing import Any, Dict, IO, Iterator, Optional, Union
+from collections import deque
+from typing import Any, Dict, IO, Iterator, Optional, Tuple, Union
 
 from ..utils.logging import sanitize
 
 __all__ = [
     "EVENT_SCHEMA",
     "EventStream",
+    "Subscription",
     "active",
     "ambient_scope",
     "configure",
@@ -57,6 +63,8 @@ __all__ = [
     "read_events",
     "scoped",
     "solve_scope",
+    "subscribe",
+    "unsubscribe",
     "validate_event",
 ]
 
@@ -425,6 +433,111 @@ def read_events(path: str) -> list:
 
 
 # ---------------------------------------------------------------------------
+# in-process subscribers (the ops plane's live event bus)
+
+class Subscription:
+    """A bounded in-process event ring one consumer drains.
+
+    The emitter side (:func:`emit`, any thread, possibly mid-solve
+    epilogue) NEVER blocks on a subscriber: ``_offer`` is O(1) under
+    the subscription's own lock, and when the ring is full the OLDEST
+    event is dropped and counted - in :attr:`dropped` and in the
+    process-wide ``events_dropped_total`` counter - so a stalled
+    consumer (a slow SSE client, a wedged scraper) can never apply
+    backpressure to the serving path.  Consumers drain with
+    :meth:`pop` (blocking, timeout) or :meth:`drain` (everything
+    buffered, non-blocking).
+    """
+
+    def __init__(self, maxlen: int = 1024):
+        if maxlen < 1:
+            raise ValueError(f"subscription maxlen must be >= 1, got "
+                             f"{maxlen}")
+        self.maxlen = int(maxlen)
+        self._ring: deque = deque()
+        self._cond = threading.Condition()
+        self.dropped = 0
+        self.closed = False
+
+    def _offer(self, record: Dict[str, Any]) -> None:
+        """Emitter side: append without ever blocking (drop-oldest)."""
+        dropped = False
+        with self._cond:
+            if self.closed:
+                return
+            if len(self._ring) >= self.maxlen:
+                self._ring.popleft()
+                self.dropped += 1
+                dropped = True
+            self._ring.append(record)
+            self._cond.notify_all()
+        if dropped:
+            # registry import deferred: events must stay importable
+            # without pulling the metrics module at module-import time
+            from .registry import REGISTRY
+
+            REGISTRY.counter(
+                "events_dropped_total",
+                "events dropped by full in-process subscriber rings "
+                "(bounded bus, never blocks the emitter)").inc()
+
+    def pop(self, timeout: Optional[float] = None
+            ) -> Optional[Dict[str, Any]]:
+        """Oldest buffered event, waiting up to ``timeout`` seconds
+        (``None`` = wait forever).  ``None`` on timeout or once the
+        subscription is closed and drained."""
+        with self._cond:
+            while not self._ring:
+                if self.closed:
+                    return None
+                if not self._cond.wait(timeout=timeout):
+                    return None
+            return self._ring.popleft()
+
+    def drain(self) -> list:
+        """Everything buffered right now (non-blocking, FIFO)."""
+        with self._cond:
+            out = list(self._ring)
+            self._ring.clear()
+            return out
+
+    def close(self) -> None:
+        """Detach: stops receiving and wakes any blocked ``pop``."""
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+
+
+_SUBS_LOCK = threading.Lock()
+_SUBS: Tuple["Subscription", ...] = ()
+
+
+def subscribe(maxlen: int = 1024) -> Subscription:
+    """Attach a bounded in-process subscriber to the event stream.
+
+    Subscribers receive every event :func:`emit` produces - sink or no
+    sink - as sanitized strict-JSON-ready dicts.  A live subscriber
+    makes :func:`active` true, so derived instrumentation (spans, the
+    jaxpr cost walk) runs for it exactly as it would for a file sink;
+    the solve body itself stays bit-identical (everything here is
+    host-side, proved by ``tests/test_ops_plane.py``).
+    """
+    global _SUBS
+    sub = Subscription(maxlen=maxlen)
+    with _SUBS_LOCK:
+        _SUBS = _SUBS + (sub,)
+    return sub
+
+
+def unsubscribe(sub: Subscription) -> None:
+    """Detach and close a subscription (idempotent)."""
+    global _SUBS
+    with _SUBS_LOCK:
+        _SUBS = tuple(s for s in _SUBS if s is not sub)
+    sub.close()
+
+
+# ---------------------------------------------------------------------------
 # module-level default sink (what instrumentation sites talk to)
 
 _SINK: Optional[EventStream] = None
@@ -450,19 +563,33 @@ def configure(path_or_stream: Union[str, IO[str], None],
 
 
 def active() -> bool:
-    """True when a default sink is installed."""
-    return _SINK is not None
+    """True when anyone is listening: a default sink is installed or
+    at least one in-process subscriber is attached."""
+    return _SINK is not None or bool(_SUBS)
 
 
 def emit(event_type: str, **fields: Any) -> Optional[Dict[str, Any]]:
-    """Emit to the default sink; a cheap no-op when none is configured.
+    """Emit to the default sink and every attached subscriber; a cheap
+    no-op when nobody is listening.
 
     Returns the emitted record (or ``None`` when inactive) so call
-    sites can reuse the payload.
+    sites can reuse the payload.  Subscribers receive the SANITIZED
+    record (non-finite floats -> ``None``) - exactly what the JSONL
+    sink would have serialized, so SSE consumers and file readers see
+    one payload shape.
     """
-    if _SINK is None:
+    sink, subs = _SINK, _SUBS
+    if sink is None and not subs:
         return None
-    return _SINK.emit(event_type, **fields)
+    if sink is not None:
+        record = sink.emit(event_type, **fields)
+    else:
+        record = _build_event(event_type, fields)
+    if subs:
+        clean = sanitize(record)
+        for sub in subs:
+            sub._offer(clean)
+    return record
 
 
 @contextlib.contextmanager

@@ -25,7 +25,8 @@ import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MAX_LABEL_SETS",
-           "MetricsRegistry", "PERCENTILES", "REGISTRY"]
+           "MetricsRegistry", "PERCENTILES", "REGISTRY",
+           "quantile_from_buckets"]
 
 #: default histogram buckets (seconds-flavored, matching solve times
 #: from sub-ms resident kernels to multi-minute 256^3 streaming runs)
@@ -192,6 +193,36 @@ class Gauge(_Metric):
         self.inc(-amount, **labels)
 
 
+def quantile_from_buckets(bounds: Sequence[float],
+                          cumulative_counts: Sequence[float],
+                          total: float, q: float) -> Optional[float]:
+    """``histogram_quantile`` semantics over cumulative bucket counts:
+    find the bucket the q-th observation landed in and interpolate
+    linearly inside it (lower bound of the first bucket is 0).
+    Observations past the last finite bound clamp to that bound - the
+    honest answer a bucketed histogram can give.  ``None`` when
+    nothing was observed.
+
+    THE one quantile definition: :class:`Histogram` readouts and the
+    fleet-merge aggregation (``telemetry.fleet``) both call this, so a
+    merged histogram's p99 is exactly the p99 this registry would
+    report for the union stream.
+    """
+    if total <= 0:
+        return None
+    target = q * total
+    prev = 0.0
+    for i, bound in enumerate(bounds):
+        if cumulative_counts[i] >= target:
+            lower = 0.0 if i == 0 else bounds[i - 1]
+            within = cumulative_counts[i] - prev
+            if within <= 0:
+                return bound
+            return lower + (bound - lower) * (target - prev) / within
+        prev = cumulative_counts[i]
+    return bounds[-1]
+
+
 class Histogram(_Metric):
     """Cumulative-bucket histogram (Prometheus semantics: each bucket
     counts observations <= its upper bound; ``+Inf`` is implicit)."""
@@ -233,26 +264,8 @@ class Histogram(_Metric):
             return {"count": int(child[-2]), "sum": child[-1]}
 
     def _quantile_locked(self, child, q: float) -> Optional[float]:
-        """``histogram_quantile`` semantics over the cumulative bucket
-        counts: find the bucket the q-th observation landed in and
-        interpolate linearly inside it (lower bound of the first
-        bucket is 0).  Observations past the last finite bound clamp
-        to that bound - the honest answer a bucketed histogram can
-        give.  ``None`` for an empty child."""
-        total = child[-2]
-        if total <= 0:
-            return None
-        target = q * total
-        prev = 0.0
-        for i, bound in enumerate(self.buckets):
-            if child[i] >= target:
-                lower = 0.0 if i == 0 else self.buckets[i - 1]
-                within = child[i] - prev
-                if within <= 0:
-                    return bound
-                return lower + (bound - lower) * (target - prev) / within
-            prev = child[i]
-        return self.buckets[-1]
+        return quantile_from_buckets(self.buckets, child[:-2],
+                                     child[-2], q)
 
     def quantile(self, q: float, **labels: str) -> Optional[float]:
         """The q-th latency quantile (0 < q < 1) of one child, derived
@@ -381,6 +394,13 @@ class MetricsRegistry:
         for m in sorted(self.metrics(), key=lambda m: m.name):
             entry = {"kind": m.kind, "help": m.help,
                      "series": m.snapshot()}
+            if isinstance(m, Histogram):
+                # the bucket EDGES, explicit: a fleet merge
+                # (telemetry.fleet) sums bucket counts bucket-wise and
+                # must never re-derive the bounds from formatted keys
+                entry["bucket_bounds"] = [float(b) for b in m.buckets]
+            if m.labelnames:
+                entry["labelnames"] = list(m.labelnames)
             overflow = m.label_overflow
             if overflow:
                 entry["label_overflow"] = overflow

@@ -37,7 +37,7 @@ from __future__ import annotations
 import threading
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from . import events
 from .registry import REGISTRY
@@ -233,6 +233,32 @@ class SLOTracker:
                 return 0.0
             burn, _, _ = self._window_burn_locked(flow, float(now), win)
             return burn
+
+    def burning(self, now: float) -> List[Dict[str, Any]]:
+        """Every (flow, window) currently burning over its threshold.
+
+        The readiness gate's view of this tracker: read-only (no
+        events, no trip-latch mutation - :meth:`observe` owns those),
+        computed at the caller's clock so a fake-clock ops test can
+        drive it deterministically.  Empty list = no flow is burning.
+        """
+        now = float(now)
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for (tenant, slo_class), flow in sorted(self._flows.items()):
+                for window in self.config.windows:
+                    burn, _, n = self._window_burn_locked(
+                        flow, now, window)
+                    if burn > window.burn_threshold:
+                        out.append({
+                            "tenant": tenant,
+                            "slo_class": slo_class,
+                            "window": window.name,
+                            "burn_rate": round(burn, 4),
+                            "burn_threshold": window.burn_threshold,
+                            "n": n,
+                        })
+        return out
 
     # -- reporting -----------------------------------------------------
 

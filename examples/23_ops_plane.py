@@ -1,0 +1,174 @@
+"""Ops plane: scrape a live service, watch /readyz flip, merge a fleet.
+
+Four acts against mesh-4 Poisson services, all over real loopback
+HTTP (the stdlib ops plane, `serve.ops` - no new dependencies):
+
+1. **Scrape mid-replay**: start a service with
+   ``ServiceConfig(ops_port=0)`` (0 = ephemeral port), submit a
+   workload, and curl ``/metrics`` (Prometheus text exposition
+   v0.0.4), ``/readyz`` (the typed readiness verdict), ``/stats`` and
+   ``/usage`` WHILE requests are in flight.  Scrapes are host-side
+   reads: the solve stream is bitwise identical with or without them
+   (tests/test_ops_plane.py asserts this; here we just watch).
+2. **Causal tree over HTTP**: pull one request's rendered span tree
+   from ``/traces/<trace_id>`` - the span store is fed by the NEW
+   in-process event subscriber bus (`telemetry.events.subscribe`),
+   never by tailing files.
+3. **Kill a lane, watch /readyz flip**: a second service carries a
+   sticky reduction-site `FaultPlan`; two breakdowns open its circuit
+   breaker, and the very next ``/readyz`` answers 503 with
+   ``failing: ["breakers"]`` - the machine-readable signal ROADMAP
+   item 2's replica router routes on.
+4. **Fleet-merge two replicas**: `telemetry.fleet.merge_snapshots`
+   over both services' ``/snapshot`` payloads - counters summed
+   exactly, histogram buckets summed bucket-wise (quantiles stay
+   correct), gauges kept per-replica under a ``replica`` label.
+
+Run:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+      python examples/23_ops_plane.py
+"""
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.parallel import make_mesh
+from cuda_mpi_parallel_tpu.robust import FaultPlan
+from cuda_mpi_parallel_tpu.serve import ServiceConfig, SolverService
+from cuda_mpi_parallel_tpu.telemetry import fleet
+
+
+def get(url, *, as_json=True):
+    """GET url; 4xx/5xx responses are verdicts, not exceptions."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            status, body = r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        status, body = e.code, e.read().decode()
+    return status, json.loads(body) if as_json else body
+
+
+def main():
+    rng = np.random.default_rng(0)
+    a = poisson.poisson_2d_csr(16, 16)
+
+    # ---- act 1: scrape a live replay --------------------------------
+    print("=" * 64)
+    print("act 1: concurrent scrapes of a live mesh-4 service")
+    print("=" * 64)
+    svc = SolverService(ServiceConfig(
+        max_batch=4, max_wait_s=0.002, usage=True, ops_port=0))
+    base = svc.ops_server().url
+    print(f"ops plane listening on {base}")
+    h = svc.register(a, mesh=make_mesh(4))
+    futs = [svc.submit(h, np.asarray(a @ rng.standard_normal(256)),
+                       tenant="acme")
+            for _ in range(12)]
+    # scrape WHILE the replay is in flight
+    status, verdict = get(base + "/readyz")
+    print(f"\nmid-replay GET /readyz -> {status} "
+          f"status={verdict['status']} failing={verdict['failing']}")
+    _, metrics = get(base + "/metrics", as_json=False)
+    head = [ln for ln in metrics.splitlines()
+            if ln.startswith(("# TYPE serve_requests",
+                              "serve_requests"))]
+    print("mid-replay GET /metrics (serve_requests_* lines):")
+    for ln in head[:4]:
+        print(f"  {ln}")
+    results = [f.result(timeout=60) for f in futs]
+    assert all(r.converged for r in results)
+    _, stats = get(base + "/stats")
+    print(f"\nafter replay: /stats completed={stats['completed']} "
+          f"batches={stats['batches']}")
+    _, usage = get(base + "/usage")
+    print(f"/usage totals: {usage['totals']['batches']} batches, "
+          f"{usage['totals']['device_seconds']:.4f} device-s, "
+          f"tenants={sorted(usage['per_tenant'])}")
+
+    # ---- act 2: one request's causal tree over HTTP -----------------
+    print()
+    print("=" * 64)
+    print("act 2: GET /traces/<trace_id> (fed by the subscriber bus)")
+    print("=" * 64)
+    spans = svc.ops_server().span_records()
+    trace_id = spans[-1]["trace_id"]
+    _, tree = get(f"{base}/traces/{trace_id}", as_json=False)
+    print(f"GET /traces/{trace_id[:16]}... ->")
+    print(tree)
+
+    # ---- act 3: kill a lane, watch /readyz flip ---------------------
+    print("=" * 64)
+    print("act 3: breaker opens -> /readyz flips to 503")
+    print("=" * 64)
+    faulty = SolverService(ServiceConfig(
+        max_batch=1, max_wait_s=0.002, breaker_threshold=2,
+        breaker_cooldown_s=60.0, ops_port=0))
+    fbase = faulty.ops_server().url
+    fh = faulty.register(a, mesh=make_mesh(4), inject=FaultPlan(
+        site="reduction", iteration=1, sticky=True))
+    status, verdict = get(fbase + "/readyz")
+    print(f"before faults: GET /readyz -> {status} "
+          f"({verdict['status']})")
+    for _ in range(2):
+        r = faulty.submit(fh, np.asarray(
+            a @ rng.standard_normal(256))).result(timeout=60)
+        print(f"  poisoned dispatch -> {r.status}")
+    status, verdict = get(fbase + "/readyz")
+    print(f"after 2 breakdowns: GET /readyz -> {status} "
+          f"status={verdict['status']} failing={verdict['failing']}")
+    print(f"  open breakers: {verdict['gates']['breakers']['open']}")
+    assert status == 503 and verdict["failing"] == ["breakers"]
+
+    # ---- act 4: fleet-merge the two replicas ------------------------
+    print()
+    print("=" * 64)
+    print("act 4: fleet view over both replicas' /snapshot")
+    print("=" * 64)
+    _, snap_a = get(base + "/snapshot")
+    _, snap_b = get(fbase + "/snapshot")
+    # NOTE: in-process replicas share one global registry, so this
+    # demonstrates the ALGEBRA; across real processes each snapshot is
+    # distinct (tools/fleet_scrape.py is the multi-process driver)
+    merged = fleet.merge_snapshots({"replica-a": snap_a,
+                                    "replica-b": snap_b})
+    reqs = merged["serve_requests_total"]["series"]
+    print("merged serve_requests_total:")
+    for s in reqs:
+        print(f"  {s['labels']} = {s['value']}")
+    lat = merged.get("serve_request_latency_seconds")
+    if lat is not None:
+        p = lat["series"][0]["percentiles"]
+        print(f"merged latency percentiles (union-stream exact): "
+              f"p50={p['p50']:.4g}s p99={p['p99']:.4g}s")
+    depth = merged.get("serve_queue_depth")
+    if depth is not None:
+        print("per-replica queue depth gauges:")
+        for s in depth["series"]:
+            print(f"  replica={s['labels'].get('replica')} -> "
+                  f"{s['value']}")
+
+    svc.close()
+    faulty.close()
+    print("\nboth planes torn down with their services; "
+          "scrapes now refuse:")
+    try:
+        urllib.request.urlopen(base + "/healthz", timeout=2)
+    except Exception as e:
+        print(f"  GET /healthz -> {type(e).__name__}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,252 @@
+"""Fleet aggregation tests: telemetry.fleet's snapshot-merge algebra
+and tools/fleet_scrape.py against live ops planes.
+
+The exactness contracts of the cross-replica half of ISSUE 19:
+
+* merged counters equal the per-replica sums EXACTLY (same float
+  additions a single registry would have performed);
+* merged histogram quantiles equal the quantiles the registry itself
+  reports for the union observation stream (the regression the
+  ``snapshot()`` ``bucket_bounds`` satellite exists for);
+* gauges never sum - each replica's series survives under a
+  ``replica`` label;
+* the merge is pure, associative, and refuses to guess: kind
+  mismatches, bucket-bound mismatches, and pre-fleet snapshots
+  (no ``bucket_bounds``) raise instead of silently mixing;
+* two live replicas scraped over HTTP: exact counter sums end to end,
+  and the readiness table flips a replica to NOT-ready on the very
+  next scrape after its breaker opens.
+"""
+from __future__ import annotations
+
+import copy
+import importlib.util
+import pathlib
+
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu.serve.service import (
+    ServiceConfig,
+    SolverService,
+    _Breaker,
+)
+from cuda_mpi_parallel_tpu.telemetry import fleet
+from cuda_mpi_parallel_tpu.telemetry.registry import (
+    MetricsRegistry,
+    quantile_from_buckets,
+)
+
+_TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load_fleet_scrape():
+    spec = importlib.util.spec_from_file_location(
+        "fleet_scrape", _TOOLS / "fleet_scrape.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+BUCKETS = (0.1, 0.5, 1.0, 2.0, 5.0, 10.0)
+
+
+def _replica(seed: int, n: int) -> tuple:
+    """(registry, observations) for one synthetic replica."""
+    rng = np.random.default_rng(seed)
+    reg = MetricsRegistry()
+    xs = [float(x) for x in rng.uniform(0.0, 8.0, size=n)]
+    h = reg.histogram("latency_seconds", "x", buckets=BUCKETS)
+    for x in xs:
+        h.observe(x)
+    reg.counter("requests_total", "n").inc(float(n))
+    reg.counter("by_tenant_total", "n",
+                labelnames=("tenant",)).inc(
+        float(seed + 1), tenant="acme")
+    reg.gauge("queue_depth", "d").set(float(seed * 10))
+    return reg, xs
+
+
+class TestMergeAlgebra:
+    def test_counters_sum_exactly(self):
+        r1, _ = _replica(0, 100)
+        r2, _ = _replica(1, 250)
+        merged = fleet.merge_snapshots(
+            {"a": r1.snapshot(), "b": r2.snapshot()})
+        assert merged["requests_total"]["series"][0]["value"] \
+            == 350.0
+        # labeled counters merge per label set
+        (series,) = merged["by_tenant_total"]["series"]
+        assert series["labels"] == {"tenant": "acme"}
+        assert series["value"] == 1.0 + 2.0
+
+    def test_merged_p99_equals_union_stream_p99(self):
+        """THE regression the bucket_bounds satellite exists for:
+        quantiles of the merged view are exactly what one registry
+        would have reported seeing every observation."""
+        r1, xs1 = _replica(0, 200)
+        r2, xs2 = _replica(1, 300)
+        merged = fleet.merge_snapshots(
+            {"a": r1.snapshot(), "b": r2.snapshot()})
+        union = MetricsRegistry()
+        h = union.histogram("latency_seconds", "x", buckets=BUCKETS)
+        for x in xs1 + xs2:
+            h.observe(x)
+        want = union.snapshot()["latency_seconds"]["series"][0]
+        got = merged["latency_seconds"]["series"][0]
+        assert got["percentiles"] == want["percentiles"]
+        assert got["buckets"] == want["buckets"]
+        assert got["count"] == want["count"]
+        assert got["sum"] == pytest.approx(want["sum"])
+
+    def test_gauges_keep_replica_identity(self):
+        r1, _ = _replica(0, 10)
+        r2, _ = _replica(2, 10)
+        merged = fleet.merge_snapshots(
+            {"west": r1.snapshot(), "east": r2.snapshot()})
+        series = {s["labels"]["replica"]: s["value"]
+                  for s in merged["queue_depth"]["series"]}
+        assert series == {"west": 0.0, "east": 20.0}
+        assert "replica" in merged["queue_depth"]["labelnames"]
+
+    def test_merge_is_pure(self):
+        snap = _replica(0, 50)[0].snapshot()
+        frozen = copy.deepcopy(snap)
+        fleet.merge_snapshots({"a": snap, "b": frozen})
+        assert snap == frozen  # inputs never mutated
+
+    def test_merge_is_associative(self):
+        lifted = [fleet.lift(_replica(s, 40 + s)[0].snapshot(),
+                             f"r{s}") for s in range(3)]
+        la, lb, lc = lifted
+        left = fleet.merge_two(fleet.merge_two(la, lb), lc)
+        right = fleet.merge_two(la, fleet.merge_two(lb, lc))
+        assert left == right
+
+    def test_fleet_of_fleets(self):
+        """An aggregate of aggregates equals the flat merge: scrape
+        aggregators, then aggregate the aggregators."""
+        snaps = {f"r{s}": _replica(s, 30 + 7 * s)[0].snapshot()
+                 for s in range(4)}
+        flat = fleet.merge_snapshots(snaps)
+        west = fleet.merge_snapshots(
+            {k: snaps[k] for k in ("r0", "r1")})
+        east = fleet.merge_snapshots(
+            {k: snaps[k] for k in ("r2", "r3")})
+        rollup = fleet.merge_two(west, east)
+        assert rollup == flat
+
+    def test_empty_and_disjoint(self):
+        assert fleet.merge_snapshots({}) == {}
+        r1 = MetricsRegistry()
+        r1.counter("only_here_total", "n").inc(3)
+        r2 = MetricsRegistry()
+        r2.counter("only_there_total", "n").inc(4)
+        merged = fleet.merge_snapshots(
+            {"a": r1.snapshot(), "b": r2.snapshot()})
+        assert merged["only_here_total"]["series"][0]["value"] == 3.0
+        assert merged["only_there_total"]["series"][0]["value"] == 4.0
+
+    def test_kind_mismatch_refused(self):
+        r1 = MetricsRegistry()
+        r1.counter("thing", "n").inc()
+        r2 = MetricsRegistry()
+        r2.gauge("thing", "n").set(1)
+        with pytest.raises(ValueError, match="kind"):
+            fleet.merge_snapshots(
+                {"a": r1.snapshot(), "b": r2.snapshot()})
+
+    def test_bucket_bounds_mismatch_refused(self):
+        r1 = MetricsRegistry()
+        r1.histogram("h", "x", buckets=(1.0, 2.0)).observe(1.5)
+        r2 = MetricsRegistry()
+        r2.histogram("h", "x", buckets=(1.0, 4.0)).observe(1.5)
+        with pytest.raises(ValueError, match="bucket bounds"):
+            fleet.merge_snapshots(
+                {"a": r1.snapshot(), "b": r2.snapshot()})
+
+    def test_pre_fleet_snapshot_refused(self):
+        """A snapshot without serialized bucket_bounds (the pre-ISSUE-19
+        format) is refused, never guessed at."""
+        r1 = MetricsRegistry()
+        r1.histogram("h", "x", buckets=(1.0, 2.0)).observe(1.5)
+        old = r1.snapshot()
+        for entry in old.values():
+            entry.pop("bucket_bounds", None)
+        with pytest.raises(ValueError, match="bucket_bounds"):
+            fleet.merge_snapshots({"a": old, "b": r1.snapshot()})
+
+    def test_gauge_duplicate_series_refused(self):
+        snap = _replica(0, 10)[0].snapshot()
+        lifted = fleet.lift(snap, "same")
+        with pytest.raises(ValueError, match="duplicate"):
+            fleet.merge_two(lifted, lifted)
+
+    def test_lift_idempotent_on_labeled_gauges(self):
+        snap = _replica(0, 10)[0].snapshot()
+        once = fleet.lift(snap, "r1")
+        twice = fleet.lift(once, "r2")  # replica label already there
+        assert once == twice
+
+
+class TestQuantileFromBuckets:
+    def test_interpolation_matches_histogram_readout(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", "x", buckets=BUCKETS)
+        rng = np.random.default_rng(7)
+        for x in rng.uniform(0, 12, size=500):
+            h.observe(float(x))
+        series = reg.snapshot()["h"]["series"][0]
+        cum = [series["buckets"][k] for k in series["buckets"]]
+        bounds = reg.snapshot()["h"]["bucket_bounds"]
+        for pname, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+            assert quantile_from_buckets(
+                bounds, cum, series["count"], q) \
+                == series["percentiles"][pname]
+
+    def test_empty_histogram(self):
+        assert quantile_from_buckets([1.0, 2.0], [0, 0], 0, 0.99) \
+            is None
+
+
+class TestLiveFleetScrape:
+    def test_two_replicas_exact_sums_and_readiness_flip(self):
+        """Two live ops planes: merged counters re-sum exactly over
+        HTTP, and the very next scrape after a breaker opens shows
+        that replica NOT-ready with the breakers gate named."""
+        fs = _load_fleet_scrape()
+        s1 = SolverService(ServiceConfig(ops_port=0))
+        s2 = SolverService(ServiceConfig(ops_port=0))
+        try:
+            urls = [s1.ops_server().url, s2.ops_server().url]
+            replicas, merged = fs.scrape_once(urls)
+            assert all(r["reachable"] and r["ready"]
+                       for r in replicas)
+            assert fs.check_merge(replicas, merged) == []
+            table = fs.readiness_table(replicas)
+            assert table.count("ready") >= 2
+
+            # open a breaker on replica 2 - the NEXT scrape flips it
+            s2._breakers["poisson:w1"] = _Breaker(state="open")
+            replicas, merged = fs.scrape_once(urls)
+            by_url = {r["url"]: r for r in replicas}
+            assert by_url[urls[0]]["ready"]
+            assert not by_url[urls[1]]["ready"]
+            assert by_url[urls[1]]["status"] == "degraded"
+            assert by_url[urls[1]]["failing"] == ["breakers"]
+            table = fs.readiness_table(replicas)
+            assert "NO" in table and "breakers" in table
+        finally:
+            s1.close()
+            s2.close()
+
+    def test_unreachable_replica_not_ready(self):
+        fs = _load_fleet_scrape()
+        s1 = SolverService(ServiceConfig(ops_port=0))
+        try:
+            url = s1.ops_server().url
+        finally:
+            s1.close()
+        replicas, merged = fs.scrape_once([url])
+        assert not replicas[0]["reachable"]
+        assert replicas[0]["failing"] == ["unreachable"]

@@ -1,0 +1,533 @@
+"""Ops plane tests: the HTTP observatory (serve.ops), the in-process
+event subscriber bus (telemetry.events.subscribe), and the readiness
+policy (SolverService.readiness).
+
+The acceptance surface of the obsplane PR (ISSUE 19):
+
+* the subscriber bus is bounded, drop-oldest, never blocks the
+  emitter, and counts its drops in ``events_dropped_total``;
+* an attached subscriber (and a whole running ops server with
+  concurrent scrapes) leaves the solve body jaxpr bit-identical and
+  the batch log bitwise - the zero-perturbation contract;
+* /readyz implements the exact policy matrix accepting/closed x
+  breaker open/closed x shed level 0-3 x SLO burn over/under -> one
+  (status code, failing-gate list) verdict per cell, fake-clock
+  driven;
+* the bearer token gates every route (401 without, 200 with), unknown
+  paths 404 with a typed body, and /metrics speaks Prometheus text
+  exposition v0.0.4 byte-identically to the CLI's one-shot dump.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import telemetry
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.serve import ops as serve_ops
+from cuda_mpi_parallel_tpu.serve.service import (
+    ServiceConfig,
+    SolverService,
+    _Breaker,
+)
+from cuda_mpi_parallel_tpu.telemetry import events
+from cuda_mpi_parallel_tpu.telemetry.registry import REGISTRY
+from cuda_mpi_parallel_tpu.telemetry.slo import SLOConfig, SLOWindow
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def manual_service(**kw):
+    clock = FakeClock()
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_s", 0.010)
+    kw.setdefault("maxiter", 500)
+    svc = SolverService(ServiceConfig(clock=clock, **kw))
+    return svc, clock
+
+
+def poisson_csr(n=12, dtype=np.float64):
+    return poisson.poisson_2d_csr(n, n, dtype=dtype)
+
+
+def _rhs(a, rng):
+    return np.asarray(a @ rng.standard_normal(a.shape[0]))
+
+
+def http_get(url, token=None, timeout=10.0):
+    """(status, content_type, body_str) - 4xx/5xx are verdicts here,
+    not exceptions."""
+    req = urllib.request.Request(url)
+    if token is not None:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.headers.get("Content-Type"), \
+                r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type"), \
+            e.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# the in-process subscriber bus
+
+
+class TestSubscriberBus:
+    def test_subscriber_receives_sanitized_events(self):
+        sub = events.subscribe()
+        try:
+            events.emit("flight_heartbeat", iteration=42,
+                        arr=np.float64(1.5))
+            rec = sub.pop(timeout=2.0)
+            assert rec["event"] == "flight_heartbeat"
+            assert rec["iteration"] == 42
+            # numpy scalars were sanitized to plain JSON types
+            assert type(rec["arr"]) is float
+            json.dumps(rec, allow_nan=False)
+        finally:
+            events.unsubscribe(sub)
+
+    def test_subscription_makes_events_active(self):
+        assert not events.active()
+        sub = events.subscribe()
+        try:
+            assert events.active()
+        finally:
+            events.unsubscribe(sub)
+        assert not events.active()
+
+    def test_ring_bounded_drop_oldest_counts_drops(self):
+        before = REGISTRY.counter(
+            "events_dropped_total", "").value()
+        sub = events.subscribe(maxlen=4)
+        try:
+            for i in range(10):
+                events.emit("flight_heartbeat", iteration=i)
+            got = sub.drain()
+            # drop-OLDEST: the last 4 survive
+            assert [r["iteration"] for r in got] == [6, 7, 8, 9]
+            assert sub.dropped == 6
+            after = REGISTRY.counter("events_dropped_total",
+                                     "").value()
+            assert after - before == 6
+        finally:
+            events.unsubscribe(sub)
+
+    def test_emit_returns_record_and_never_blocks(self):
+        sub = events.subscribe(maxlen=1)
+        try:
+            # a full ring never blocks the emitter (would hang here)
+            for i in range(1000):
+                rec = events.emit("flight_heartbeat", iteration=i)
+                assert rec is not None
+        finally:
+            events.unsubscribe(sub)
+
+    def test_pop_timeout_and_closed_drain(self):
+        sub = events.subscribe(maxlen=8)
+        try:
+            assert sub.pop(timeout=0.01) is None
+            events.emit("flight_heartbeat", iteration=1)
+            events.unsubscribe(sub)
+            # closed-but-buffered still drains...
+            assert sub.pop(timeout=0.01)["iteration"] == 1
+            # ...then closed-and-drained returns None immediately
+            assert sub.pop(timeout=0.01) is None
+        finally:
+            events.unsubscribe(sub)  # idempotent
+
+    def test_unsubscribe_idempotent(self):
+        sub = events.subscribe()
+        events.unsubscribe(sub)
+        events.unsubscribe(sub)
+        assert sub.closed
+
+    def test_two_subscribers_both_receive(self):
+        s1, s2 = events.subscribe(), events.subscribe()
+        try:
+            events.emit("flight_heartbeat", iteration=7)
+            assert s1.pop(timeout=2.0)["iteration"] == 7
+            assert s2.pop(timeout=2.0)["iteration"] == 7
+        finally:
+            events.unsubscribe(s1)
+            events.unsubscribe(s2)
+
+    def test_bad_maxlen_rejected(self):
+        with pytest.raises(ValueError):
+            events.Subscription(maxlen=0)
+
+
+# ---------------------------------------------------------------------------
+# zero perturbation: subscribers and scrapes never touch the solve
+
+
+class TestZeroPerturbation:
+    def test_solver_jaxpr_identical_with_subscriber_attached(self):
+        from cuda_mpi_parallel_tpu.models.operators import Stencil2D
+        from cuda_mpi_parallel_tpu.solver import cg
+
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones(256)
+
+        def jaxpr():
+            return str(jax.make_jaxpr(
+                lambda v: cg(a, v, maxiter=25))(b))
+
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        base = jaxpr()
+        sub = events.subscribe()
+        try:
+            assert events.active()
+            instrumented = jaxpr()
+        finally:
+            events.unsubscribe(sub)
+        assert instrumented == base
+
+    def test_batch_log_bitwise_with_concurrent_scrapes(self):
+        """The same fake-clock workload produces bitwise-identical
+        solutions and batch log whether or not an ops server runs -
+        WITH live concurrent /metrics + /readyz + /stats scrapes
+        hammering it mid-replay (the ISSUE 19 acceptance contract)."""
+
+        def run(with_ops):
+            svc, clock = manual_service(
+                usage=with_ops,
+                ops_port=0 if with_ops else None)
+            a = poisson_csr()
+            rng = np.random.default_rng(13)
+            stop = threading.Event()
+            scraper = None
+            scrapes = {"n": 0}
+            try:
+                if with_ops:
+                    base = svc.ops_server().url
+
+                    def hammer():
+                        while not stop.is_set():
+                            for route in ("/metrics", "/readyz",
+                                          "/stats", "/usage"):
+                                st, _, _ = http_get(base + route)
+                                assert st in (200, 503)
+                                scrapes["n"] += 1
+
+                    scraper = threading.Thread(target=hammer,
+                                               daemon=True)
+                    scraper.start()
+                h = svc.register(a)
+                results = []
+                for _ in range(3):
+                    futs = [svc.submit(h, _rhs(a, rng), tol=1e-8)
+                            for _ in range(4)]
+                    clock.advance(0.011)
+                    svc.pump()
+                    results += [f.result(timeout=30) for f in futs]
+                log = svc.batch_log()
+            finally:
+                stop.set()
+                if scraper is not None:
+                    scraper.join(timeout=10.0)
+                svc.close()
+            if with_ops:
+                assert scrapes["n"] > 0  # the hammer really ran
+            outcomes = [(r.status, r.iterations,
+                         float(r.residual_norm),
+                         r.x.tobytes() if r.x is not None else None)
+                        for r in results]
+            slim = [{k: v for k, v in b.items()
+                     if k not in ("solve_id", "solve_s")}
+                    for b in log]
+            return outcomes, slim
+
+        assert run(with_ops=False) == run(with_ops=True)
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+
+
+class TestOpsEndpoints:
+    @pytest.fixture()
+    def served(self):
+        svc, clock = manual_service(usage=True)
+        server = svc.serve_ops(0)
+        yield svc, clock, server.url
+        svc.close()
+
+    def test_metrics_exposition_and_content_type(self, served):
+        svc, clock, base = served
+        st, ct, body = http_get(base + "/metrics")
+        assert st == 200
+        assert ct == serve_ops.PROMETHEUS_CONTENT_TYPE
+        assert ct.startswith("text/plain; version=0.0.4")
+        # byte-identical to the one formatter the CLI dump uses
+        assert body == serve_ops.prometheus_exposition()
+
+    def test_healthz(self, served):
+        _, _, base = served
+        st, _, body = http_get(base + "/healthz")
+        assert st == 200
+        assert json.loads(body)["ok"] is True
+
+    def test_stats_roundtrip(self, served):
+        svc, _, base = served
+        st, _, body = http_get(base + "/stats")
+        assert st == 200
+        assert json.loads(body).keys() == svc.stats().keys()
+
+    def test_snapshot_carries_bucket_bounds(self, served):
+        _, _, base = served
+        REGISTRY.histogram("ops_probe_seconds", "probe",
+                           buckets=(0.1, 1.0)).observe(0.5)
+        st, _, body = http_get(base + "/snapshot")
+        snap = json.loads(body)
+        assert st == 200
+        assert snap["ops_probe_seconds"]["bucket_bounds"] == [0.1, 1.0]
+
+    def test_usage_on_and_off(self, served):
+        _, _, base = served
+        st, _, body = http_get(base + "/usage")
+        assert st == 200
+        assert set(json.loads(body)) >= {"totals", "per_tenant"}
+        svc2, _ = manual_service()  # usage off
+        try:
+            base2 = svc2.serve_ops(0).url
+            st, _, body = http_get(base2 + "/usage")
+            assert st == 404
+            assert json.loads(body)["error"] == \
+                "usage metering disabled"
+        finally:
+            svc2.close()
+
+    def test_traces_render_and_404(self, served):
+        svc, clock, base = served
+        a = poisson_csr(8)
+        h = svc.register(a)
+        rng = np.random.default_rng(3)
+        fut = svc.submit(h, _rhs(a, rng))
+        clock.advance(0.011)
+        svc.pump()
+        assert fut.result(timeout=30).converged
+        # the pump thread drains the bus asynchronously; wait for it
+        tid = None
+        for _ in range(100):
+            spans = svc.ops_server().span_records()
+            if spans:
+                tid = spans[0]["trace_id"]
+                break
+            import time
+            time.sleep(0.05)
+        assert tid, "span store never filled from the event bus"
+        st, ct, body = http_get(base + f"/traces/{tid}")
+        assert st == 200 and ct.startswith("text/plain")
+        assert "submit" in body and "solve" in body
+        st, _, body = http_get(base + "/traces/" + "f" * 32)
+        assert st == 404
+        assert json.loads(body)["error"] == "unknown trace"
+
+    def test_events_recent_and_sse_follow(self, served):
+        svc, _, base = served
+        for i in range(3):
+            events.emit("flight_heartbeat", iteration=i)
+        # recent ring (the pump drains asynchronously)
+        got = []
+        for _ in range(100):
+            st, _, body = http_get(base + "/events?n=10")
+            got = [e for e in json.loads(body)["events"]
+                   if e.get("event") == "flight_heartbeat"]
+            if len(got) >= 3:
+                break
+            import time
+            time.sleep(0.05)
+        assert [e["iteration"] for e in got[-3:]] == [0, 1, 2]
+        # SSE: emit from a side thread while the follower blocks
+        t = threading.Timer(
+            0.3, lambda: [events.emit("flight_heartbeat",
+                          iteration=99)])
+        t.start()
+        st, ct, body = http_get(base + "/events?follow=1&limit=1",
+                                timeout=30.0)
+        t.join()
+        assert st == 200 and ct.startswith("text/event-stream")
+        datas = [ln for ln in body.splitlines()
+                 if ln.startswith("data: ")]
+        assert len(datas) == 1
+        assert json.loads(datas[0][len("data: "):])["iteration"] \
+            == 99
+
+    def test_unknown_path_404_typed(self, served):
+        _, _, base = served
+        for path in ("/nope", "/metrics/extra", "/traces"):
+            st, _, body = http_get(base + path)
+            assert st == 404, path
+            payload = json.loads(body)
+            assert payload["error"] in ("not found", "unknown trace")
+            if payload["error"] == "not found":
+                assert "/readyz" in payload["routes"]
+
+    def test_double_serve_ops_refused(self, served):
+        svc, _, _ = served
+        with pytest.raises(RuntimeError, match="already running"):
+            svc.serve_ops(0)
+
+    def test_close_tears_down_plane(self):
+        svc, _ = manual_service()
+        url = svc.serve_ops(0).url
+        assert http_get(url + "/healthz")[0] == 200
+        svc.close()
+        with pytest.raises(Exception):
+            urllib.request.urlopen(url + "/healthz", timeout=2)
+
+    def test_ops_port_config_autostarts(self):
+        svc, _ = manual_service(ops_port=0)
+        try:
+            assert svc.ops_server() is not None
+            assert http_get(svc.ops_server().url + "/healthz")[0] \
+                == 200
+        finally:
+            svc.close()
+
+
+class TestAuth:
+    def test_token_gates_every_route(self):
+        svc, _ = manual_service(usage=True)
+        try:
+            base = svc.serve_ops(0, token="sekrit").url
+            for route in ("/metrics", "/healthz", "/readyz", "/stats",
+                          "/usage", "/events", "/snapshot",
+                          "/traces/" + "a" * 32):
+                st, _, body = http_get(base + route)
+                assert st == 401, route
+                assert json.loads(body)["error"] == "unauthorized"
+            st, _, _ = http_get(base + "/metrics", token="sekrit")
+            assert st == 200
+            st, _, _ = http_get(base + "/metrics", token="wrong")
+            assert st == 401
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# the readiness policy matrix
+
+
+#: one SLO window whose burn threshold 1.0 trips on any failure rate
+#: above the 1% budget (min_samples=4 keeps the matrix cheap)
+_SLO = SLOConfig(windows=(SLOWindow("fast", 60.0, 1.0),),
+                 budget=0.01, min_samples=4)
+
+
+def _force(svc, clock, *, closed, breaker_open, shed_level,
+           slo_over):
+    """Drive one service into one matrix cell (test-only forcing:
+    readiness is read-only, so each knob is set on the state it
+    reads)."""
+    if closed:
+        svc._closed = True
+    if breaker_open:
+        svc._breakers["poisson:w1"] = _Breaker(
+            state="open", opened_t=clock())
+    svc._shed.level = shed_level
+    tracker = svc.slo_tracker()
+    for i in range(4):
+        tracker.observe("acme", "gold", clock(), not slo_over)
+
+
+class TestReadinessMatrix:
+    @pytest.mark.parametrize("closed", [False, True])
+    @pytest.mark.parametrize("breaker_open", [False, True])
+    @pytest.mark.parametrize("shed_level", [0, 1, 2, 3])
+    @pytest.mark.parametrize("slo_over", [False, True])
+    def test_cell(self, closed, breaker_open, shed_level, slo_over):
+        svc, clock = manual_service(slo=_SLO)
+        try:
+            _force(svc, clock, closed=closed,
+                   breaker_open=breaker_open, shed_level=shed_level,
+                   slo_over=slo_over)
+            expected_failing = [
+                name for name, bad in (
+                    ("accepting", closed),
+                    ("breakers", breaker_open),
+                    ("shed", shed_level > 0),
+                    ("slo_burn", slo_over)) if bad]
+            verdict = svc.readiness()
+            assert verdict["failing"] == expected_failing
+            assert verdict["ready"] is (not expected_failing)
+            assert verdict["status"] == (
+                "closed" if closed else
+                "degraded" if expected_failing else "ready")
+            # the gate detail names the culprit
+            if breaker_open:
+                assert verdict["gates"]["breakers"]["open"] == \
+                    ["poisson:w1"]
+            if shed_level:
+                assert verdict["gates"]["shed"]["level"] == shed_level
+            if slo_over:
+                burning = verdict["gates"]["slo_burn"]["burning"]
+                assert burning[0]["tenant"] == "acme"
+                assert burning[0]["burn_rate"] > 1.0
+        finally:
+            svc._closed = False  # let close() drain normally
+            svc.close()
+
+    def test_http_status_codes_match_verdict(self):
+        """The wire contract on top of the matrix: 200 iff ready,
+        503 with the same JSON verdict otherwise."""
+        svc, clock = manual_service(slo=_SLO)
+        try:
+            base = svc.serve_ops(0).url
+            st, _, body = http_get(base + "/readyz")
+            assert st == 200 and json.loads(body)["ready"]
+            _force(svc, clock, closed=False, breaker_open=True,
+                   shed_level=2, slo_over=True)
+            st, _, body = http_get(base + "/readyz")
+            verdict = json.loads(body)
+            assert st == 503
+            assert verdict["failing"] == ["breakers", "shed",
+                                          "slo_burn"]
+            assert verdict == svc.readiness() | {"t": verdict["t"]}
+        finally:
+            svc.close()
+
+    def test_readyz_schema(self):
+        """The fields ISSUE 19's router contract names, exactly."""
+        svc, _ = manual_service(slo=_SLO)
+        try:
+            verdict = svc.readiness()
+            assert set(verdict) == {"ready", "status", "gates",
+                                    "failing", "t"}
+            assert set(verdict["gates"]) == {"accepting", "breakers",
+                                             "shed", "slo_burn"}
+            for gate in verdict["gates"].values():
+                assert isinstance(gate["ok"], bool)
+        finally:
+            svc.close()
+
+    def test_readiness_without_slo_tracker(self):
+        """No SLO tracker configured -> the slo_burn gate passes
+        vacuously (no data = no alarm)."""
+        svc, _ = manual_service()
+        try:
+            verdict = svc.readiness()
+            assert verdict["ready"]
+            assert verdict["gates"]["slo_burn"]["ok"]
+        finally:
+            svc.close()

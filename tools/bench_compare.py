@@ -226,6 +226,13 @@ METRIC_DIRECTION = {
     "mem.headroom_pct": None,
     "mem.device_peak_bytes": None,
     "mem.model_working_set_bytes": None,
+    # ops-plane column (serve.ops): serve-replay wall overhead % with
+    # a scraper hammering /metrics + /readyz during the replay vs the
+    # same workload unscraped.  Reported, never gated - it rides host
+    # scheduling weather (the contract that scrapes change no ANSWER
+    # is the ops lint gate's job, not a wall-clock diff's); pre-ops
+    # files simply lack it (rendered n/a).
+    "ops.scrape_overhead_pct": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -302,6 +309,7 @@ _NESTED = {
             "measured_matrix_bytes", "jaxpr_peak_bytes", "peak_bytes",
             "headroom_pct", "device_peak_bytes",
             "model_working_set_bytes"),
+    "ops": ("scrape_overhead_pct",),
 }
 
 

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Scrape M live ops planes and emit one fleet view.
+
+The cross-process half of the ops plane (ISSUE 19 / ROADMAP item 2):
+each replica's ``/snapshot`` is one registry snapshot and its
+``/readyz`` is one routing verdict; this tool merges the snapshots
+through ``telemetry.fleet`` (counters summed exactly, histogram
+buckets summed bucket-wise so quantiles stay correct, gauges kept
+per-replica) and prints the aggregate plus a per-replica readiness
+table::
+
+    python tools/fleet_scrape.py http://127.0.0.1:9100 \\
+        http://127.0.0.1:9101 --token sekrit
+
+    replica                   ready  status     failing gates
+    http://127.0.0.1:9100     yes    ready      -
+    http://127.0.0.1:9101     NO     degraded   breakers
+
+``--json`` dumps ``{"merged": ..., "replicas": ...}`` for machine
+consumers; ``--watch SECONDS`` rescrapes forever (the readiness table
+flips a replica within one interval of its breaker opening);
+``--check`` re-verifies the merge algebra against the live scrape
+(every merged counter equals the sum of the per-replica counters,
+exactly) and exits 1 on any violation or unreachable replica - the
+lint-gate mode.
+
+Read-only, stdlib-only (urllib), and safe to run against a serving
+fleet: scrapes are host-side reads on the replica side.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, ".")  # repo-root invocation, like tools/bench_compare
+
+from cuda_mpi_parallel_tpu.telemetry import fleet  # noqa: E402
+
+
+def _get_json(url: str, token=None, timeout: float = 5.0):
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        # a 503 /readyz is a VERDICT, not a transport failure
+        try:
+            return e.code, json.loads(e.read().decode("utf-8"))
+        except Exception:
+            return e.code, None
+
+
+def scrape_replica(base: str, token=None, timeout: float = 5.0) -> dict:
+    """One replica's ``/snapshot`` + ``/readyz``, with transport
+    errors folded into a NOT-ready verdict (an unreachable replica is
+    not ready - that is the router's whole question)."""
+    base = base.rstrip("/")
+    out = {"url": base, "reachable": True, "snapshot": None,
+           "readyz": None, "ready": False, "status": "unreachable",
+           "failing": ["unreachable"]}
+    try:
+        st, snap = _get_json(base + "/snapshot", token, timeout)
+        if st != 200 or not isinstance(snap, dict):
+            raise urllib.error.URLError(f"/snapshot -> HTTP {st}")
+        st, verdict = _get_json(base + "/readyz", token, timeout)
+        if verdict is None or "ready" not in verdict:
+            raise urllib.error.URLError(f"/readyz -> HTTP {st}")
+    except Exception as e:  # noqa: BLE001 - fold ANY failure to not-ready
+        out["reachable"] = False
+        out["error"] = str(e)
+        return out
+    out.update(snapshot=snap, readyz=verdict,
+               ready=bool(verdict["ready"]),
+               status=str(verdict.get("status", "?")),
+               failing=list(verdict.get("failing", [])))
+    return out
+
+
+def readiness_table(replicas) -> str:
+    width = max([len(r["url"]) for r in replicas] + [len("replica")])
+    lines = [f"{'replica':<{width}}  ready  status       failing gates"]
+    for r in replicas:
+        failing = ", ".join(r["failing"]) if r["failing"] else "-"
+        lines.append(f"{r['url']:<{width}}  "
+                     f"{'yes' if r['ready'] else 'NO ':<5}  "
+                     f"{r['status']:<11}  {failing}")
+    return "\n".join(lines)
+
+
+def check_merge(replicas, merged) -> list:
+    """Re-verify the merge against the scrape it came from: every
+    merged counter value must equal the float sum of the per-replica
+    series, exactly (same additions a single registry would have
+    done).  Returns a list of violation strings (empty = pass)."""
+    bad = []
+    for name, entry in merged.items():
+        if entry.get("kind") != "counter":
+            continue
+        for series in entry["series"]:
+            key = tuple(sorted(series["labels"].items()))
+            total = 0.0
+            for r in replicas:
+                for s in r["snapshot"].get(name, {}).get("series", ()):
+                    if tuple(sorted(s["labels"].items())) == key:
+                        total += s["value"]
+            if total != series["value"]:
+                bad.append(f"counter {name}{dict(series['labels'])}: "
+                           f"merged {series['value']!r} != per-replica "
+                           f"sum {total!r}")
+    return bad
+
+
+def scrape_once(urls, token=None, timeout: float = 5.0):
+    replicas = [scrape_replica(u, token, timeout) for u in urls]
+    live = {r["url"]: r["snapshot"] for r in replicas
+            if r["reachable"]}
+    merged = fleet.merge_snapshots(live)
+    return replicas, merged
+
+
+def _summarize(merged) -> str:
+    kinds = {"counter": 0, "gauge": 0, "histogram": 0}
+    for entry in merged.values():
+        kinds[entry.get("kind", "?")] = kinds.get(
+            entry.get("kind", "?"), 0) + 1
+    return (f"merged {len(merged)} metrics "
+            f"({kinds.get('counter', 0)} counters, "
+            f"{kinds.get('gauge', 0)} gauges, "
+            f"{kinds.get('histogram', 0)} histograms)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge N ops-plane snapshots into one fleet view")
+    ap.add_argument("urls", nargs="+",
+                    help="replica ops-plane base URLs "
+                         "(http://host:port)")
+    ap.add_argument("--token", default=None,
+                    help="static bearer token (all replicas)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true",
+                    help="dump {'merged', 'replicas'} JSON instead of "
+                         "the human tables")
+    ap.add_argument("--watch", type=float, default=None, metavar="S",
+                    help="rescrape every S seconds until interrupted")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless every replica is reachable "
+                         "AND every merged counter re-sums exactly "
+                         "(lint-gate mode)")
+    args = ap.parse_args(argv)
+
+    while True:
+        replicas, merged = scrape_once(args.urls, args.token,
+                                       args.timeout)
+        if args.json:
+            print(json.dumps(
+                {"merged": merged,
+                 "replicas": [{k: v for k, v in r.items()
+                               if k != "snapshot"}
+                              for r in replicas]},
+                sort_keys=True))
+        else:
+            print(readiness_table(replicas))
+            print(_summarize(merged))
+        rc = 0
+        if args.check:
+            unreachable = [r["url"] for r in replicas
+                           if not r["reachable"]]
+            for u in unreachable:
+                print(f"CHECK FAIL: replica {u} unreachable",
+                      file=sys.stderr)
+            violations = check_merge(
+                [r for r in replicas if r["reachable"]], merged)
+            for v in violations:
+                print(f"CHECK FAIL: {v}", file=sys.stderr)
+            rc = 1 if (unreachable or violations) else 0
+            if rc == 0 and not args.json:
+                print("check: every merged counter re-sums exactly")
+        if args.watch is None:
+            return rc
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -776,6 +776,214 @@ print(f"elastic gate: {len(degs)} shard_degraded + {len(migs)} "
 PY
 echo "elastic gate: clean"
 
+# Ops gate: the network-facing ops plane scraped DURING a live mesh-4
+# replay must (a) answer concurrent /metrics + /snapshot + /readyz
+# scrapes with valid Prometheus text and a schema-valid typed verdict,
+# (b) enforce its bearer token (401 without it, mid-replay), and
+# (c) perturb NOTHING: the same saved workload replayed with and
+# without --ops-port produces exactly equal per-request outcomes
+# (status, iterations, residual norm, error) - sound regardless of
+# batch-composition jitter because lanes are bitwise independent of
+# their co-batched neighbors (test_many_rhs).  The strict fake-clock
+# bitwise batch-log proof lives in
+# tests/test_ops_plane.py::TestZeroPerturbation.
+echo "== ops gate (mesh-4 CLI serve --ops-port: live scrapes, zero perturbation) =="
+JAX_PLATFORMS=cpu python - "$scratch" <<'PY'
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+scratch = sys.argv[1]
+env = dict(os.environ, JAX_PLATFORMS="cpu")
+base = [sys.executable, "-m", "cuda_mpi_parallel_tpu.cli", "serve",
+        "--problem", "mm", "--file", "tests/fixtures/skewed_spd_240.mtx",
+        "--mesh", "4", "--max-batch", "8", "--tol", "1e-8",
+        "--maxiter", "500", "--json"]
+
+# reference replay: synthesize + save the workload, NO ops plane
+ref = subprocess.run(
+    base + ["--requests", "24", "--rate", "200", "--seed", "5",
+            "--save-workload", f"{scratch}/ops_wl.json"],
+    env=env, capture_output=True, text=True)
+assert ref.returncode == 0, ref.stderr[-2000:]
+off = json.loads(ref.stdout)
+
+# ops replay: the SAME saved workload, plane on an ephemeral port
+proc = subprocess.Popen(
+    base + ["--workload", f"{scratch}/ops_wl.json",
+            "--ops-port", "0", "--ops-token", "lintgate", "--metrics"],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+url = None
+stderr_tail = []
+
+
+def _drain():
+    global url
+    for ln in proc.stderr:
+        stderr_tail.append(ln)
+        m = re.search(r"ops plane: (http://\S+)", ln)
+        if m and url is None:
+            url = m.group(1)
+
+
+threading.Thread(target=_drain, daemon=True).start()
+deadline = time.monotonic() + 120
+while url is None and time.monotonic() < deadline \
+        and proc.poll() is None:
+    time.sleep(0.05)
+assert url, "ops plane URL never announced on stderr:\n" \
+    + "".join(stderr_tail)[-2000:]
+
+
+def get(path, token="lintgate"):
+    req = urllib.request.Request(url + path)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return (r.status, r.headers.get("Content-Type", ""),
+                    r.read().decode())
+    except urllib.error.HTTPError as e:
+        return (e.code, e.headers.get("Content-Type", ""),
+                e.read().decode())
+
+
+# auth enforced while the replay is live
+assert get("/metrics", token=None)[0] == 401
+assert get("/metrics", token="wrong")[0] == 401
+st, _, body = get("/usage")
+assert st == 404 and "usage metering disabled" in body, (st, body)
+
+rounds = 0
+last_metrics = last_snapshot = last_readyz = None
+while proc.poll() is None:
+    try:
+        s1, ct, text = get("/metrics")
+        s2, _, snap = get("/snapshot")
+        s3, _, ready = get("/readyz")
+    except (urllib.error.URLError, OSError):
+        break  # plane tore down with the service at replay end
+    if s1 == s2 == 200 and s3 in (200, 503):
+        assert ct == "text/plain; version=0.0.4; charset=utf-8", ct
+        last_metrics, last_snapshot, last_readyz = text, snap, ready
+        rounds += 1
+    time.sleep(0.1)
+out, _ = proc.communicate(timeout=300)
+assert proc.returncode == 0, "".join(stderr_tail)[-2000:]
+assert rounds >= 3, f"only {rounds} successful scrape rounds mid-replay"
+
+# typed readiness verdict: exact schema
+verdict = json.loads(last_readyz)
+assert set(verdict) == {"ready", "status", "gates", "failing", "t"}, \
+    sorted(verdict)
+assert set(verdict["gates"]) \
+    == {"accepting", "breakers", "shed", "slo_burn"}
+assert verdict["status"] in ("ready", "degraded", "closed")
+assert isinstance(verdict["failing"], list)
+
+# every scraped metric family resolves to a registry snapshot entry
+snap = json.loads(last_snapshot)
+names = set()
+for ln in last_metrics.splitlines():
+    if ln and not ln.startswith("#"):
+        names.add(re.match(r"[A-Za-z_:][A-Za-z0-9_:]*", ln).group(0))
+unknown = [n for n in sorted(names)
+           if n not in snap
+           and not any(n.endswith(suf) and n[:-len(suf)] in snap
+                       for suf in ("_bucket", "_sum", "_count",
+                                   "_p50", "_p95", "_p99"))]
+assert not unknown, f"scraped families missing from snapshot: {unknown}"
+
+# zero perturbation: identical per-request outcomes, plane on vs off
+on = json.loads(out)
+
+
+def outcomes(rec):
+    return sorted(
+        (r["seed"], r["status"], r.get("iterations"),
+         r.get("residual_norm"), r.get("max_abs_error"))
+        for r in rec["requests"])
+
+
+assert outcomes(on) == outcomes(off), \
+    "ops plane perturbed the solve stream"
+assert on["converged_all"] and off["converged_all"]
+print(f"ops gate: {rounds} scrape rounds mid-replay "
+      f"({len(names)} metric families), readyz '{verdict['status']}', "
+      f"401 without token, {len(on['requests'])} request outcomes "
+      f"identical with the plane on vs off")
+PY
+echo "ops gate: clean"
+
+# Fleet gate: two serve replicas in SEPARATE processes (each its own
+# registry, its own ops plane on an ephemeral port), scraped mid-
+# replay by tools/fleet_scrape.py --check, which re-sums every merged
+# counter against the per-replica scrapes and exits non-zero on any
+# mismatch or unreachable replica.
+echo "== fleet gate (2-replica fleet_scrape --check) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def launch(seed):
+    return subprocess.Popen(
+        [sys.executable, "-m", "cuda_mpi_parallel_tpu.cli", "serve",
+         "--problem", "poisson2d", "--n", "16", "--mesh", "1",
+         "--requests", "32", "--rate", "30", "--seed", str(seed),
+         "--tol", "1e-8", "--maxiter", "500",
+         "--ops-port", "0", "--json"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+
+
+procs = [launch(11), launch(12)]
+urls = [None, None]
+
+
+def drain(i):
+    for ln in procs[i].stderr:
+        m = re.search(r"ops plane: (http://\S+)", ln)
+        if m and urls[i] is None:
+            urls[i] = m.group(1)
+
+
+for i in range(2):
+    threading.Thread(target=drain, args=(i,), daemon=True).start()
+deadline = time.monotonic() + 120
+while not all(urls) and time.monotonic() < deadline \
+        and all(p.poll() is None for p in procs):
+    time.sleep(0.05)
+assert all(urls), f"ops plane URLs never announced: {urls}"
+
+check = subprocess.run(
+    [sys.executable, "tools/fleet_scrape.py", urls[0], urls[1],
+     "--check", "--json"], env=env, capture_output=True, text=True)
+for p in procs:
+    p.wait(timeout=300)
+assert check.returncode == 0, \
+    check.stdout[-2000:] + check.stderr[-2000:]
+view = json.loads(check.stdout)
+assert all(r["reachable"] for r in view["replicas"]), view["replicas"]
+print(f"fleet gate: scraped {len(view['replicas'])} live replicas, "
+      f"merged {len(view['merged'])} metrics, every counter re-summed "
+      f"exactly (fleet_scrape --check rc 0)")
+PY
+echo "fleet gate: clean"
+
 echo "== tier-1 tests (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
