@@ -1755,7 +1755,10 @@ def bench_all(results, sections=None) -> None:
     # (serve.ops) on an ephemeral port with a scraper thread hammering
     # /metrics + /readyz throughout, and reports the scrape overhead %
     # (wall only: scrapes are host-side reads, the answers are bitwise
-    # identical - tests/test_ops_plane.py).
+    # identical - tests/test_ops_plane.py).  A fifth replay drives the
+    # same workload THROUGH the loopback network data plane (serve.net:
+    # bearer auth + the wire codec in both directions) and reports the
+    # networked RPS and the wire overhead % vs in-process submit.
     def s_serve():
         import tempfile
         import threading
@@ -1827,9 +1830,44 @@ def bench_all(results, sections=None) -> None:
                     telemetry.configure(None)
             return solved / max(window, 1e-9), stats, solved
 
+        # fifth replay: the same workload THROUGH the network data
+        # plane (serve.net loopback, bearer auth, wire codec both
+        # ways) - the wire overhead % is the price of the RPC surface
+        # vs in-process submit on the same service config
+        def replay_net(max_batch):
+            from cuda_mpi_parallel_tpu.serve import TokenKeyring
+            from cuda_mpi_parallel_tpu.serve.client import NetClient
+
+            svc = SolverService(ServiceConfig(
+                max_batch=max_batch, max_wait_s=0.002,
+                queue_limit=512, maxiter=600, check_every=8,
+                net_port=0,
+                net_keyring=TokenKeyring.single("bench", "default")))
+            try:
+                h = svc.register(a2)
+                cli = NetClient(svc.net_server().url, "bench",
+                                timeout_s=120)
+                t0 = time.perf_counter()
+                outs = []
+                for r, b in prepared:
+                    delay = (t0 + r.t) - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+                    outs.append(cli.submit(h.key, b, tol=tol,
+                                           retry=False))
+                finals = [cli.result(o) if isinstance(o, str) else o
+                          for o in outs]
+                window = time.perf_counter() - t0
+                solved = sum(1 for res in finals
+                             if res is not None and res.converged)
+            finally:
+                svc.close()
+            return solved / max(window, 1e-9), solved
+
         rate_b, stats_b, solved_b = replay(32)
         rate_1, stats_1, solved_1 = replay(1)
         rate_o, _, solved_o = replay(32, ops=True)
+        rate_n, solved_n = replay_net(32)
         with tempfile.TemporaryDirectory() as td:
             trace_path = os.path.join(td, "serve_trace.jsonl")
             rate_t, stats_t, solved_t = replay(32,
@@ -1879,6 +1917,12 @@ def bench_all(results, sections=None) -> None:
                     (1.0 - rate_o / max(rate_b, 1e-9)) * 100.0, 1),
                 "scraped_rhs_per_sec": round(rate_o, 1),
                 "scraped_solved": solved_o,
+            },
+            "net": {
+                "networked_rhs_per_sec": round(rate_n, 1),
+                "wire_overhead_pct": round(
+                    (1.0 - rate_n / max(rate_b, 1e-9)) * 100.0, 1),
+                "networked_solved": solved_n,
             },
         }
         results["serve"] = entry

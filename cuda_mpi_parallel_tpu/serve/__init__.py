@@ -11,6 +11,12 @@ admission control and the shed-before-collapse ladder, :mod:`.sched`
 for SLO classes and the weighted-fair (deficit-round-robin)
 dispatcher, and :mod:`.workload` for replayable arrival-time workloads
 (the ``cli.py serve`` surface) plus the open-loop saturation harness.
+
+The network tier (ROADMAP item 1's front end): :mod:`.wire` is the
+versioned bit-exact wire format, :mod:`.auth` the bearer-token ->
+tenant keyring, :mod:`.net` the authenticated HTTP data plane over a
+running service, :mod:`.client` the stdlib client (and over-the-wire
+workload replay), and :mod:`.ops` the read-only observatory plane.
 """
 from __future__ import annotations
 
@@ -22,6 +28,15 @@ from .admission import (
     ShedLadder,
     TokenBucket,
 )
+from .auth import (
+    AuthError,
+    TenantIdentity,
+    TokenKeyring,
+    bearer_ok,
+    constant_time_eq,
+)
+from .client import NetClient, NetError
+from .net import NetServer
 from .queue import (
     Batch,
     MicroBatchQueue,
@@ -52,6 +67,16 @@ from .service import (
     SolverService,
 )
 from .usage import UsageLedger
+from .wire import (
+    WIRE_VERSION,
+    WireError,
+    decode_array,
+    encode_array,
+    result_envelope,
+    result_from_json,
+    status_to_http,
+    submit_envelope,
+)
 from .workload import (
     ReplaySummary,
     WorkloadRequest,
@@ -59,6 +84,7 @@ from .workload import (
     replay_workload,
     rhs_for,
     save_workload,
+    summarize_replay,
     synthetic_poisson,
     synthetic_tenant_mix,
 )
@@ -67,10 +93,14 @@ __all__ = [
     "AdmissionConfig",
     "AdmissionController",
     "AdmissionDecision",
+    "AuthError",
     "Batch",
     "BatchCostModel",
     "DEFAULT_CLASSES",
     "MicroBatchQueue",
+    "NetClient",
+    "NetError",
+    "NetServer",
     "OperatorHandle",
     "OpsServer",
     "PROMETHEUS_CONTENT_TYPE",
@@ -86,17 +116,30 @@ __all__ = [
     "ShedConfig",
     "ShedLadder",
     "SolverService",
+    "TenantIdentity",
     "TokenBucket",
+    "TokenKeyring",
     "UsageLedger",
+    "WIRE_VERSION",
     "WeightedFairScheduler",
+    "WireError",
     "WorkloadRequest",
+    "bearer_ok",
     "bucket_for",
     "bucket_sizes",
+    "constant_time_eq",
+    "decode_array",
+    "encode_array",
     "load_workload",
     "prometheus_exposition",
     "replay_workload",
+    "result_envelope",
+    "result_from_json",
     "rhs_for",
     "save_workload",
+    "status_to_http",
+    "submit_envelope",
+    "summarize_replay",
     "synthetic_poisson",
     "synthetic_tenant_mix",
     "tol_class",

@@ -1,0 +1,263 @@
+"""Bearer-token authentication for the serve tier's network planes.
+
+The in-process ``SolverService`` takes its ``tenant``/``slo_class``
+tags on trust - fine between Python callers in one process, a spoofing
+hole the moment a network shim forwards them.  This module closes it:
+
+* :class:`TokenKeyring` maps bearer token -> :class:`TenantIdentity`
+  SERVER-side, so the tenant the admission controller and the SLO /
+  usage accounting key on is **derived from the credential**, never
+  claimed by the request body.  A request body that *does* claim a
+  tenant is cross-checked: a mismatch is a typed 403
+  (:class:`AuthError`), and it never reaches admission - a spoofed tag
+  must not even consume a token-bucket token.
+* :func:`constant_time_eq` / :func:`bearer_ok` are THE repo-wide
+  credential comparisons (``hmac.compare_digest``) - the data plane
+  (``serve.net``) and the read-only ops plane (``serve.ops``) both
+  route through them, so there is exactly one comparison definition
+  and no timing-leaky ``==`` on a secret anywhere.
+
+Transport note: this is bearer-token authentication over whatever
+transport the deployment provides; run it behind TLS termination in
+anything but loopback testing.  Tokens never appear in logs, events,
+or error bodies - identities are named by tenant, not by secret.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hmac
+import json
+from typing import Dict, Iterable, Optional, Tuple
+
+__all__ = [
+    "AuthError",
+    "TenantIdentity",
+    "TokenKeyring",
+    "bearer_ok",
+    "constant_time_eq",
+]
+
+
+def constant_time_eq(a: str, b: str) -> bool:
+    """Credential comparison without a timing side channel - THE one
+    definition (``hmac.compare_digest`` over utf-8 bytes) every
+    network-plane auth check in this repo uses."""
+    return hmac.compare_digest(str(a).encode("utf-8"),
+                               str(b).encode("utf-8"))
+
+
+def bearer_ok(header_value: Optional[str], token: str) -> bool:
+    """Does an ``Authorization`` header value carry exactly
+    ``Bearer <token>``?  Constant-time on the credential part; a
+    missing header or a non-Bearer scheme is simply False."""
+    if not header_value:
+        return False
+    return constant_time_eq(str(header_value), f"Bearer {token}")
+
+
+class AuthError(Exception):
+    """A typed authentication/authorization refusal.
+
+    ``status`` is the HTTP status the network plane maps it to
+    (401 = no/unknown credential, 403 = a valid credential asking for
+    someone else's identity), ``code`` a machine-readable reason the
+    JSON body carries.  Never contains a token.
+    """
+
+    def __init__(self, message: str, *, status: int, code: str):
+        super().__init__(message)
+        self.status = int(status)
+        self.code = str(code)
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantIdentity:
+    """What a resolved bearer token IS: the tenant every tag derives
+    from, plus an optional SLO-class allowlist (``None`` = any class
+    the service's table knows)."""
+
+    tenant: str
+    slo_classes: Optional[Tuple[str, ...]] = None
+
+    def allows_class(self, slo_class: str) -> bool:
+        return self.slo_classes is None \
+            or slo_class in self.slo_classes
+
+    def to_json(self) -> dict:
+        out = {"tenant": self.tenant}
+        if self.slo_classes is not None:
+            out["slo_classes"] = list(self.slo_classes)
+        return out
+
+
+class TokenKeyring:
+    """token -> :class:`TenantIdentity`, resolved in constant time.
+
+    :meth:`resolve` walks EVERY entry and compares via
+    :func:`constant_time_eq` (no dict-lookup short circuit, no early
+    exit on the first mismatched byte), so response timing does not
+    leak which tokens exist.  Tokens must be non-empty and unique;
+    multiple tokens may map to one tenant (key rotation).
+    """
+
+    def __init__(self, entries: Optional[Dict[str, TenantIdentity]]
+                 = None):
+        self._entries: Dict[str, TenantIdentity] = {}
+        for token, identity in (entries or {}).items():
+            self.add(token, identity)
+
+    def add(self, token: str, identity) -> "TokenKeyring":
+        token = str(token)
+        if not token:
+            raise ValueError("empty bearer token")
+        if token in self._entries:
+            raise ValueError("duplicate bearer token in keyring")
+        if isinstance(identity, str):
+            identity = TenantIdentity(tenant=identity)
+        if not isinstance(identity, TenantIdentity):
+            raise TypeError(f"identity must be a TenantIdentity or "
+                            f"tenant name, got "
+                            f"{type(identity).__name__}")
+        if not identity.tenant:
+            raise ValueError("empty tenant name")
+        self._entries[token] = identity
+        return self
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def tenants(self) -> Tuple[str, ...]:
+        """The distinct tenants this keyring can authenticate (sorted;
+        safe to log - no tokens)."""
+        return tuple(sorted({i.tenant for i in
+                             self._entries.values()}))
+
+    def resolve(self, token: str) -> Optional[TenantIdentity]:
+        """The identity of ``token``, or ``None`` - after comparing
+        against every entry regardless of where (or whether) it
+        matched."""
+        token = str(token)
+        found = None
+        for known, identity in self._entries.items():
+            if constant_time_eq(token, known):
+                found = identity
+        return found
+
+    def authenticate(self,
+                     authorization: Optional[str]) -> TenantIdentity:
+        """Resolve an ``Authorization`` header to an identity or raise
+        a typed 401 :class:`AuthError` (missing header, non-Bearer
+        scheme, unknown token - deliberately one indistinguishable
+        refusal)."""
+        if not authorization:
+            raise AuthError(
+                "this data plane requires a bearer token: "
+                "Authorization: Bearer <token>",
+                status=401, code="unauthenticated")
+        parts = str(authorization).split(" ", 1)
+        if len(parts) != 2 or parts[0] != "Bearer" or not parts[1]:
+            raise AuthError(
+                "malformed Authorization header (expected "
+                "'Bearer <token>')", status=401, code="unauthenticated")
+        identity = self.resolve(parts[1])
+        if identity is None:
+            raise AuthError("unknown bearer token",
+                            status=401, code="unauthenticated")
+        return identity
+
+    def authorize(self, identity: TenantIdentity, *,
+                  claimed_tenant: Optional[str],
+                  slo_class: Optional[str]) -> None:
+        """The anti-spoofing cross-check: a request body claiming a
+        tenant other than the credential's, or an SLO class outside
+        the identity's allowlist, is a typed 403 - it never reaches
+        admission, so a spoofed tag cannot even burn a token-bucket
+        token or touch the SLO tracker."""
+        if claimed_tenant is not None \
+                and str(claimed_tenant) != identity.tenant:
+            raise AuthError(
+                f"request claims tenant {claimed_tenant!r} but the "
+                f"bearer token authenticates tenant "
+                f"{identity.tenant!r} - tenant tags are derived from "
+                f"the credential, not the body",
+                status=403, code="tenant_mismatch")
+        if slo_class is not None \
+                and not identity.allows_class(str(slo_class)):
+            raise AuthError(
+                f"tenant {identity.tenant!r} is not entitled to SLO "
+                f"class {slo_class!r} (allowed: "
+                f"{sorted(identity.slo_classes or ())})",
+                status=403, code="slo_class_forbidden")
+
+    # -- construction helpers -------------------------------------------
+
+    @classmethod
+    def single(cls, token: str, tenant: str,
+               slo_classes: Optional[Iterable[str]] = None
+               ) -> "TokenKeyring":
+        """One-token keyring (tests, single-tenant deployments)."""
+        classes = tuple(slo_classes) if slo_classes is not None \
+            else None
+        return cls({token: TenantIdentity(tenant=tenant,
+                                          slo_classes=classes)})
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "TokenKeyring":
+        """Parse the CLI spelling ``token:tenant[:class[+class...]]``
+        with entries comma-separated, e.g.
+        ``tokA:acme,tokB:beta:bulk+silver``."""
+        ring = cls()
+        for i, entry in enumerate(str(spec).split(",")):
+            entry = entry.strip()
+            if not entry:
+                continue
+            parts = entry.split(":")
+            if len(parts) not in (2, 3) or not parts[0] \
+                    or not parts[1]:
+                raise ValueError(
+                    f"token spec entry {i} must be "
+                    f"'token:tenant[:class+class...]', got {entry!r}")
+            classes = tuple(parts[2].split("+")) if len(parts) == 3 \
+                else None
+            ring.add(parts[0], TenantIdentity(tenant=parts[1],
+                                              slo_classes=classes))
+        if not len(ring):
+            raise ValueError("token spec names no tokens")
+        return ring
+
+    @classmethod
+    def from_file(cls, path: str) -> "TokenKeyring":
+        """Load a JSON keyring file::
+
+            {"version": 1,
+             "tokens": [{"token": "...", "tenant": "acme",
+                         "slo_classes": ["gold", "silver"]}, ...]}
+
+        ``slo_classes`` omitted = any class.
+        """
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, dict) or data.get("version") != 1:
+            raise ValueError(f"{path}: not a version-1 keyring file")
+        rows = data.get("tokens")
+        if not isinstance(rows, list) or not rows:
+            raise ValueError(f"{path}: empty keyring")
+        ring = cls()
+        for i, row in enumerate(rows):
+            if not isinstance(row, dict) or "token" not in row \
+                    or "tenant" not in row:
+                raise ValueError(
+                    f"{path}: tokens[{i}] must be an object with "
+                    f"'token' and 'tenant'")
+            classes = row.get("slo_classes")
+            if classes is not None and (
+                    not isinstance(classes, list)
+                    or not all(isinstance(c, str) for c in classes)):
+                raise ValueError(
+                    f"{path}: tokens[{i}].slo_classes must be a list "
+                    f"of class names")
+            ring.add(str(row["token"]), TenantIdentity(
+                tenant=str(row["tenant"]),
+                slo_classes=tuple(classes) if classes is not None
+                else None))
+        return ring

@@ -15,6 +15,14 @@ Examples::
     python -m cuda_mpi_parallel_tpu.cli serve --problem mm \
         --file tests/fixtures/skewed_spd_240.mtx --mesh 4 \
         --requests 32 --rate 2000 --trace-events trace.jsonl --json
+
+``--listen`` turns the process into the network data plane instead
+(serve.net): requests arrive over HTTP as ``serve.wire`` envelopes,
+authenticated against a bearer-token keyring whose entries DERIVE the
+tenant tags::
+
+    python -m cuda_mpi_parallel_tpu.cli serve --problem poisson2d \
+        --n 32 --listen --net-port 8780 --net-tokens tok1:acme
 """
 from __future__ import annotations
 
@@ -161,6 +169,38 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    metavar="TOKEN",
                    help="static bearer token gating every ops route "
                         "(401 without it)")
+    p.add_argument("--listen", action="store_true",
+                   help="serve the authenticated network data plane "
+                        "(serve.net: POST /v1/submit, /v1/solve, "
+                        "GET /v1/result/<id>, /v1/stream SSE, "
+                        "/v1/handles) over the registered operator "
+                        "instead of replaying a workload locally; "
+                        "runs until SIGTERM/SIGINT or "
+                        "--listen-duration.  Requires --net-tokens or "
+                        "--net-keyring")
+    p.add_argument("--net-port", type=int, default=0, dest="net_port",
+                   metavar="PORT",
+                   help="data-plane port (--listen; 0 = ephemeral; "
+                        "the bound URL is announced on stderr)")
+    p.add_argument("--net-host", default="127.0.0.1", dest="net_host",
+                   metavar="HOST", help="data-plane bind host")
+    p.add_argument("--net-tokens", default=None, dest="net_tokens",
+                   metavar="SPEC",
+                   help="inline bearer keyring: "
+                        "'token:tenant[:class+class...]' entries, "
+                        "comma-separated (serve.auth.TokenKeyring."
+                        "from_spec).  Tenant tags are DERIVED from "
+                        "these tokens - a submit claiming another "
+                        "tenant is a typed 403")
+    p.add_argument("--net-keyring", default=None, dest="net_keyring",
+                   metavar="PATH",
+                   help="JSON keyring file (serve.auth.TokenKeyring."
+                        "from_file) - the non-inline spelling of "
+                        "--net-tokens")
+    p.add_argument("--listen-duration", type=float, default=None,
+                   dest="listen_duration", metavar="S",
+                   help="exit the data plane after S seconds "
+                        "(default: run until SIGTERM/SIGINT)")
     p.add_argument("--json", action="store_true",
                    help="emit one JSON record instead of text")
     p.add_argument("--report", nargs="?", const="-", default=None,
@@ -228,6 +268,29 @@ def main(argv=None) -> int:
     if args.plan not in ("even", "auto"):
         raise SystemExit(f"--plan must be 'even' or 'auto', got "
                          f"{args.plan!r}")
+    keyring = None
+    if args.listen:
+        from .auth import TokenKeyring
+
+        if args.net_tokens and args.net_keyring:
+            raise SystemExit("--net-tokens and --net-keyring are "
+                             "mutually exclusive")
+        try:
+            if args.net_tokens:
+                keyring = TokenKeyring.from_spec(args.net_tokens)
+            elif args.net_keyring:
+                keyring = TokenKeyring.from_file(args.net_keyring)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"keyring: {e}")
+        if keyring is None:
+            raise SystemExit(
+                "--listen requires --net-tokens or --net-keyring "
+                "(an unauthenticated data plane would take tenant "
+                "tags on trust)")
+    elif args.net_tokens or args.net_keyring \
+            or args.listen_duration is not None:
+        raise SystemExit("--net-tokens/--net-keyring/"
+                         "--listen-duration need --listen")
 
     from .. import telemetry
 
@@ -334,6 +397,48 @@ def main(argv=None) -> int:
         plan="auto" if args.plan == "auto" else None,
         exchange=args.exchange, precond=precond,
         method=args.method, phase_profile=profile_reps)
+
+    if args.listen:
+        # --listen: the process IS the server.  The plane starts only
+        # after registration (a client never sees an empty handle
+        # list), the bound URL is announced on stderr (--json owns
+        # stdout), and SIGTERM/SIGINT/--listen-duration shuts down
+        # gracefully: stop accepting, drain in-flight work, exit 0.
+        import signal
+        import threading
+
+        net = service.serve_net(args.net_port, host=args.net_host,
+                                keyring=keyring)
+        print(f"data plane: {net.url}", file=sys.stderr, flush=True)
+        stop = threading.Event()
+
+        def _graceful(signum, frame):
+            stop.set()
+
+        old_term = signal.signal(signal.SIGTERM, _graceful)
+        old_int = signal.signal(signal.SIGINT, _graceful)
+        try:
+            stop.wait(timeout=args.listen_duration)
+        finally:
+            signal.signal(signal.SIGTERM, old_term)
+            signal.signal(signal.SIGINT, old_int)
+        served = net.request_count()
+        service.close()
+        if args.usage is not None:
+            service.usage_ledger().export_jsonl(args.usage)
+        if args.json:
+            emit_json(sanitize({
+                "mode": "serve-listen", "problem": desc,
+                "n": int(a.shape[0]), "mesh": args.mesh,
+                "dtype": args.dtype, "handle": handle.key,
+                "tenants": list(keyring.tenants()),
+                "http_requests": served,
+                "stats": service.stats(),
+            }))
+        else:
+            print(f"data plane served {served} HTTP request(s)",
+                  file=sys.stderr, flush=True)
+        return 0
 
     # pre-build every request's (b, x_true) so the replay loop does
     # nothing but sleep and submit - RHS construction must not distort

@@ -1,0 +1,329 @@
+"""Stdlib client for the serve-tier network data plane.
+
+``urllib.request`` only - the client side of ``serve.net`` with the
+same zero-dependency rule as the server.  :class:`NetClient` speaks
+the ``serve.wire`` envelopes, maps the plane's honest backpressure
+back into typed results, and retries 429 by HONORING the server's
+``Retry-After`` (capped exponential backoff only when the server did
+not say; a client that ignores the hint re-creates the thundering
+herd that admission control exists to break up).
+
+``sleep`` is injectable so tests can record the backoff schedule with
+a fake instead of actually waiting.
+
+:meth:`NetClient.replay_workload` is the end-to-end correctness
+instrument: it replays a saved workload OVER THE WIRE and classifies
+the outcomes through the same ``serve.workload.summarize_replay`` the
+in-process replay uses, so a loopback replay's per-request
+``(status, iterations, max_abs_error)`` can be compared exactly
+against the no-network replay of the same file.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import wire
+
+__all__ = ["NetClient", "NetError"]
+
+
+class NetError(Exception):
+    """A typed client-side failure: transport trouble, an error
+    envelope the retry policy cannot absorb, or retries exhausted.
+    ``status`` is the last HTTP status (0 = no response at all)."""
+
+    def __init__(self, message: str, *, status: int = 0,
+                 code: str = "net_error"):
+        super().__init__(message)
+        self.status = int(status)
+        self.code = str(code)
+
+
+class _Response:
+    """One decoded HTTP exchange (status + parsed JSON body +
+    headers), whether urllib surfaced it as a return or an
+    HTTPError."""
+
+    __slots__ = ("status", "body", "headers")
+
+    def __init__(self, status: int, body, headers):
+        self.status = int(status)
+        self.body = body
+        self.headers = headers
+
+    def retry_after_s(self) -> Optional[float]:
+        val = self.headers.get("Retry-After") if self.headers \
+            else None
+        if val is None:
+            return None
+        try:
+            return max(float(val), 0.0)
+        except (TypeError, ValueError):
+            return None
+
+
+class NetClient:
+    """A connection to one data plane: base URL + the caller's bearer
+    token.  Thread-compatible (no shared mutable state beyond config);
+    every method raises :class:`NetError` on transport failure and
+    returns typed values otherwise.
+    """
+
+    def __init__(self, base_url: str, token: str, *,
+                 timeout_s: float = 60.0,
+                 max_retries: int = 5,
+                 backoff_s: float = 0.05,
+                 max_backoff_s: float = 2.0,
+                 sleep=time.sleep):
+        self.base_url = str(base_url).rstrip("/")
+        self._token = str(token)
+        self.timeout_s = float(timeout_s)
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self._sleep = sleep
+
+    # -- transport -----------------------------------------------------
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[dict] = None,
+                 timeout_s: Optional[float] = None) -> _Response:
+        data = None
+        headers = {"Authorization": f"Bearer {self._token}",
+                   "Accept": "application/json"}
+        if payload is not None:
+            data = json.dumps(payload, allow_nan=False
+                              ).encode("utf-8")
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(
+            self.base_url + path, data=data, headers=headers,
+            method=method)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout_s if timeout_s is not None
+                    else self.timeout_s) as resp:
+                return _Response(resp.status, self._decode(resp),
+                                 resp.headers)
+        except urllib.error.HTTPError as e:
+            # non-2xx: still a typed envelope, not an exception - the
+            # caller decides what the status means
+            return _Response(e.code, self._decode(e), e.headers)
+        except urllib.error.URLError as e:
+            raise NetError(f"cannot reach {self.base_url}: "
+                           f"{e.reason}", code="unreachable")
+
+    @staticmethod
+    def _decode(resp):
+        raw = resp.read()
+        if not raw:
+            return None
+        try:
+            return json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return None
+
+    def _backoff(self, attempt: int,
+                 hint: Optional[float]) -> float:
+        """Server hint verbatim when given; otherwise capped
+        exponential."""
+        if hint is not None:
+            return hint
+        return min(self.backoff_s * (2.0 ** attempt),
+                   self.max_backoff_s)
+
+    @staticmethod
+    def _error_of(resp: _Response) -> str:
+        body = resp.body if isinstance(resp.body, dict) else {}
+        return str(body.get("error", f"HTTP {resp.status}"))
+
+    # -- the API -------------------------------------------------------
+
+    def handles(self) -> List[dict]:
+        """The operators this plane serves (``GET /v1/handles``)."""
+        resp = self._request("GET", "/v1/handles")
+        if resp.status != 200 or not isinstance(resp.body, dict):
+            raise NetError(self._error_of(resp), status=resp.status,
+                           code="handles_failed")
+        return list(resp.body.get("handles", ()))
+
+    def submit(self, handle_key: str, b: np.ndarray, *,
+               tol: float = 1e-7,
+               deadline_s: Optional[float] = None,
+               slo_class: Optional[str] = None,
+               tenant: Optional[str] = None,
+               retry: bool = True) -> Union[str, "object"]:
+        """``POST /v1/submit``: a pending request's net id (str), or
+        the terminal ``RequestResult`` when the service answered at
+        the door (admission 429 / breaker 503 / a synchronously
+        resolved request).
+
+        429 with ``retry=True`` sleeps per ``Retry-After`` and
+        retries up to ``max_retries`` times; the LAST rejection comes
+        back as its typed ``ADMISSION_REJECTED`` result rather than
+        raising - the same contract as the in-process future.  A 503
+        whose body is a typed result envelope (breaker ``REFUSED``)
+        is returned as that result; a 503 error envelope (queue full,
+        service closed) raises :class:`NetError` with the server's
+        ``code`` - the wire spelling of the exceptions
+        ``service.submit()`` raises in-process.
+        """
+        payload = wire.submit_envelope(
+            handle_key, b, tol=tol, deadline_s=deadline_s,
+            tenant=tenant, slo_class=slo_class)
+        attempts = 0
+        while True:
+            resp = self._request("POST", "/v1/submit", payload)
+            body = resp.body if isinstance(resp.body, dict) else {}
+            if resp.status == 202 and body.get("kind") == "pending":
+                return str(body["request_id"])
+            if body.get("kind") == "result":
+                result = wire.result_from_json(body)
+                if resp.status == 429 and retry \
+                        and attempts < self.max_retries:
+                    self._sleep(self._backoff(
+                        attempts,
+                        resp.retry_after_s()
+                        if resp.retry_after_s() is not None
+                        else result.retry_after_s))
+                    attempts += 1
+                    continue
+                return result
+            raise NetError(self._error_of(resp), status=resp.status,
+                           code=str(body.get("code", "submit_failed")))
+
+    def result(self, request_id: str, *,
+               timeout_s: Optional[float] = None,
+               poll_s: float = 30.0):
+        """Long-poll ``GET /v1/result/<id>`` until terminal; raises
+        :class:`NetError` on 404/403 or when ``timeout_s`` elapses
+        (``None`` = wait forever)."""
+        deadline = (time.monotonic() + float(timeout_s)
+                    if timeout_s is not None else None)
+        while True:
+            wait = float(poll_s)
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise NetError(
+                        f"result {request_id} still pending after "
+                        f"{timeout_s}s", code="poll_timeout")
+                wait = min(wait, left)
+            resp = self._request(
+                "GET",
+                f"/v1/result/{request_id}?timeout_s={wait:.3f}",
+                timeout_s=max(self.timeout_s, wait + 10.0))
+            body = resp.body if isinstance(resp.body, dict) else {}
+            if body.get("kind") == "result":
+                return wire.result_from_json(body)
+            if resp.status == 202:
+                continue
+            raise NetError(self._error_of(resp), status=resp.status,
+                           code=str(body.get("code", "result_failed")))
+
+    def solve(self, handle_key: str, b: np.ndarray, *,
+              tol: float = 1e-7,
+              deadline_s: Optional[float] = None,
+              slo_class: Optional[str] = None,
+              timeout_s: Optional[float] = None):
+        """Synchronous convenience: submit (with 429 backoff) and wait
+        for the terminal ``RequestResult``."""
+        out = self.submit(handle_key, b, tol=tol,
+                          deadline_s=deadline_s, slo_class=slo_class)
+        if isinstance(out, str):
+            return self.result(out, timeout_s=timeout_s)
+        return out
+
+    def stream(self, ids: Optional[Sequence[str]] = None,
+               timeout_s: Optional[float] = None) -> Iterator[object]:
+        """``GET /v1/stream``: yield terminal ``RequestResult``s for
+        this client's tenant as the server pushes them (bounded by
+        ``ids`` when given - the iterator ends once all are seen)."""
+        path = "/v1/stream"
+        if ids:
+            path += "?ids=" + ",".join(str(i) for i in ids)
+        req = urllib.request.Request(
+            self.base_url + path,
+            headers={"Authorization": f"Bearer {self._token}",
+                     "Accept": "text/event-stream"})
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=timeout_s if timeout_s is not None
+                else self.timeout_s)
+        except urllib.error.HTTPError as e:
+            body = self._decode(e)
+            raise NetError(
+                str((body or {}).get("error", f"HTTP {e.code}")),
+                status=e.code, code="stream_failed")
+        except urllib.error.URLError as e:
+            raise NetError(f"cannot reach {self.base_url}: "
+                           f"{e.reason}", code="unreachable")
+        want = {str(i) for i in ids} if ids else None
+        seen = set()
+        with resp:
+            data_lines: List[str] = []
+            for raw in resp:
+                line = raw.decode("utf-8").rstrip("\n").rstrip("\r")
+                if line.startswith(":"):
+                    continue                      # keepalive
+                if line.startswith("data:"):
+                    data_lines.append(line[5:].lstrip())
+                    continue
+                if line == "" and data_lines:
+                    env = json.loads("\n".join(data_lines))
+                    data_lines = []
+                    result = wire.result_from_json(env)
+                    yield result
+                    seen.add(env["request_id"])
+                    if want is not None and seen >= want:
+                        return
+
+    # -- the end-to-end instrument -------------------------------------
+
+    def replay_workload(self, handle_key: str, requests,
+                        prepared_b, *, tol: float = 1e-7,
+                        deadline_s: Optional[float] = None,
+                        classes=None):
+        """Open-loop replay of a saved workload OVER THE WIRE,
+        classified by the same ``serve.workload.summarize_replay`` the
+        in-process replay uses.
+
+        Submits each request at its arrival offset on the real clock
+        (NO 429 retry - an admission rejection is an outcome to count,
+        exactly as in-process), then collects every pending result.
+        A 503 queue-full maps to a ``None`` entry, the in-process
+        spelling of a hard backpressure shed.  Returns the same
+        ``ReplaySummary`` shape, so `(status, iterations)` tuples are
+        directly comparable."""
+        from .workload import summarize_replay
+
+        t0 = time.monotonic()
+        outcomes: List[object] = []    # str net_id | result | None
+        for r, b in zip(requests, prepared_b):
+            delay = (t0 + r.t) - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                outcomes.append(self.submit(
+                    handle_key, b,
+                    tol=r.tol if r.tol is not None else tol,
+                    deadline_s=(r.deadline_s
+                                if r.deadline_s is not None
+                                else deadline_s),
+                    slo_class=r.slo_class,
+                    retry=False))
+            except NetError as e:
+                if e.code == "queue_full":
+                    outcomes.append(None)     # hard backpressure shed
+                else:
+                    raise
+        results = [self.result(o) if isinstance(o, str) else o
+                   for o in outcomes]
+        window_s = time.monotonic() - t0
+        return summarize_replay(requests, results, window_s,
+                                classes=classes)

@@ -44,9 +44,12 @@ or forces a device sync, so a concurrent scrape leaves the solve
 stream bitwise identical (test- and lint-gate-asserted).
 
 **Read-only.**  No POST, no mutation: the plane observes the service,
-it never drives it.  Tenant tags are currently on trust, so the
-optional static bearer ``token`` gates every route (401 without it) -
-transport auth, not authorization policy.
+it never drives it.  The optional static bearer ``token`` gates every
+route (401 without it) - transport auth, not authorization policy; the
+write side lives in ``serve.net``, whose keyring derives tenant
+identity from the credential.  Both planes compare credentials through
+the one ``serve.auth`` helper (``hmac.compare_digest`` - no
+timing-leaky ``==`` on a secret).
 """
 from __future__ import annotations
 
@@ -61,6 +64,7 @@ from urllib.parse import parse_qs, urlparse
 from ..telemetry import events
 from ..telemetry.registry import REGISTRY
 from ..telemetry.tracing import build_forest, render_tree
+from .auth import bearer_ok
 
 __all__ = ["OpsServer", "PROMETHEUS_CONTENT_TYPE",
            "prometheus_exposition"]
@@ -255,7 +259,7 @@ class _OpsHandler(BaseHTTPRequestHandler):
         if token is None:
             return True
         got = self.headers.get("Authorization", "")
-        if got == f"Bearer {token}":
+        if bearer_ok(got, token):
             return True
         self._send_json(
             401, {"error": "unauthorized", "status_code": 401,
@@ -301,8 +305,8 @@ class _OpsHandler(BaseHTTPRequestHandler):
 
     def do_HEAD(self) -> None:  # noqa: N802
         # HEAD is read-only too; answer liveness probes cheaply
-        if self.ops._token is None or self.headers.get(
-                "Authorization") == f"Bearer {self.ops._token}":
+        if self.ops._token is None or bearer_ok(
+                self.headers.get("Authorization"), self.ops._token):
             self.send_response(200)
             self.send_header("Content-Length", "0")
             self.end_headers()

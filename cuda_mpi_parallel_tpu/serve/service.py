@@ -304,6 +304,20 @@ class ServiceConfig:
     #: without ``Authorization: Bearer <token>``)
     ops_token: Optional[str] = None
 
+    #: the network DATA plane (serve.net): POST /v1/submit,
+    #: POST /v1/solve, GET /v1/result/<id>, GET /v1/stream (SSE),
+    #: GET /v1/handles.  0 = ephemeral port (tests read it off
+    #: ``service.net_server().port``).  Requires ``net_keyring``:
+    #: every submit authenticates a bearer token whose keyring entry
+    #: DERIVES the tenant tag - the body can cross-check but never
+    #: claim someone else's (serve.auth)
+    net_port: Optional[int] = None
+    net_host: str = "127.0.0.1"
+    #: serve.auth.TokenKeyring mapping bearer token -> TenantIdentity;
+    #: mandatory when the data plane is on (an unauthenticated data
+    #: plane would reopen the tenant-spoofing hole this closes)
+    net_keyring: Optional[object] = None
+
 
 @dataclasses.dataclass(frozen=True)
 class RequestResult:
@@ -608,6 +622,13 @@ class SolverService:
             self.serve_ops(self.config.ops_port,
                            host=self.config.ops_host,
                            token=self.config.ops_token)
+        # the network data plane (serve.net) - authenticated
+        # submit/result RPC, torn down by close()
+        self._net_server = None
+        if self.config.net_port is not None:
+            self.serve_net(self.config.net_port,
+                           host=self.config.net_host,
+                           keyring=self.config.net_keyring)
 
     def _resolve_workers(self) -> int:
         """``config.workers``, with 0 = auto-size from the calibrated
@@ -1009,7 +1030,8 @@ class SolverService:
     def submit(self, handle: OperatorHandle, b, *, tol: float = 1e-7,
                deadline_s: Optional[float] = None,
                tenant: str = "default",
-               slo_class: str = "silver") -> Future:
+               slo_class: str = "silver",
+               net_hop: Optional[dict] = None) -> Future:
         """Enqueue one right-hand side; returns a Future resolving to
         a :class:`RequestResult`.  ``b`` is coerced to the handle's
         compiled dtype (the service trades that copy for a bounded
@@ -1024,6 +1046,11 @@ class SolverService:
         ``ADMISSION_REJECTED`` result with a ``retry_after_s`` hint.
         Raises :class:`ServiceClosed` after close() and
         :class:`serve.queue.QueueFull` at the hard backpressure bound.
+
+        ``net_hop`` (data plane only): timing/size fields of the HTTP
+        hop that carried this submit; when tracing is live they become
+        a ``"net"`` span under the request's root, so causal trees
+        show the wire cost ahead of admission.
         """
         if handle.key not in self._handles:
             raise ValueError("unknown handle (register the operator "
@@ -1070,6 +1097,16 @@ class SolverService:
             trace.span("submit", start_s=now, duration_s=0.0,
                        root=True, handle=handle.key, tenant=tenant,
                        slo_class=slo_class)
+            if net_hop:
+                # the transport hop that carried this submit
+                # (serve.net): receive+parse timing and wire size,
+                # parented to the root so the causal tree shows the
+                # network cost ahead of admission
+                hop = dict(net_hop)
+                hop_dur = float(hop.pop("duration_s", 0.0))
+                hop_start = float(hop.pop("start_s", now - hop_dur))
+                trace.span("net", start_s=hop_start,
+                           duration_s=hop_dur, **hop)
         if self._breaker_refuses(handle.key, now, rid):
             return self._refuse(rid, handle, now, tenant, slo_class,
                                 trace=trace)
@@ -2168,8 +2205,13 @@ class SolverService:
             for t in self._workers:
                 t.join(timeout=5.0)
             self._workers = []
-        # the ops plane outlives the drain (a scrape during shutdown
-        # sees status "closed", not a connection refusal), then stops
+        # the data plane stops FIRST (no new submissions can arrive
+        # once the service refuses them), the ops plane outlives the
+        # drain (a scrape during shutdown sees status "closed", not a
+        # connection refusal), then stops
+        net, self._net_server = self._net_server, None
+        if net is not None:
+            net.stop()
         ops, self._ops_server = self._ops_server, None
         if ops is not None:
             ops.stop()
@@ -2258,6 +2300,50 @@ class SolverService:
         """The running :class:`serve.ops.OpsServer` (``None`` when the
         plane is off)."""
         return self._ops_server
+
+    # -- the network data plane (serve.net) -------------------------------
+
+    def serve_net(self, port: int, *, host: Optional[str] = None,
+                  keyring=None):
+        """Start the authenticated HTTP data plane on ``port`` (0 =
+        ephemeral) and return the :class:`serve.net.NetServer`.
+
+        ``keyring`` (a :class:`serve.auth.TokenKeyring`) is mandatory:
+        the whole point of the plane is that tenant tags are derived
+        from credentials, so an unauthenticated data plane is a
+        configuration error, not a default.  One plane per service;
+        ``ServiceConfig(net_port=..., net_keyring=...)`` calls this at
+        construction, :meth:`close` tears it down.
+        """
+        from .net import NetServer
+
+        if keyring is None:
+            keyring = self.config.net_keyring
+        with self._lock:
+            if self._net_server is not None:
+                raise RuntimeError(
+                    "data plane already running on port "
+                    f"{self._net_server.port}; one NetServer per "
+                    "service")
+            server = NetServer(
+                self, port=int(port),
+                host=host if host is not None
+                else self.config.net_host,
+                keyring=keyring)
+            self._net_server = server
+        server.start()
+        return server
+
+    def net_server(self):
+        """The running :class:`serve.net.NetServer` (``None`` when the
+        data plane is off)."""
+        return self._net_server
+
+    def handles(self) -> Dict[str, OperatorHandle]:
+        """Snapshot of the registered operators by handle key (the
+        data plane's ``GET /v1/handles`` discoverability source)."""
+        with self._lock:
+            return dict(self._handles)
 
     def readiness(self) -> dict:
         """The routing-grade readiness verdict ``GET /readyz`` serves.

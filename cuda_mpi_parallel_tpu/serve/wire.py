@@ -1,0 +1,326 @@
+"""Versioned wire format for the serve-tier network data plane.
+
+Everything that crosses the socket is a typed JSON envelope with a
+``"wire"`` version field; vectors ride inside it as base64 of their
+raw **little-endian** bytes plus ``dtype``/``shape``, so a round trip
+is **bit-exact** - the decoded array reproduces every byte of the
+original, including NaN payloads and signed zeros.  That is what makes
+the data plane's correctness contract checkable: a loopback network
+replay must produce per-request ``(status, iterations,
+max_abs_error)`` exactly equal to the in-process replay, which only
+means anything if the transport itself never perturbs a bit.
+
+Layered deliberately below ``serve.net``/``serve.client``: this module
+knows numpy and JSON, nothing about HTTP or sockets, so both ends (and
+tests) share one codec definition.
+
+Status -> HTTP mapping (:func:`status_to_http`) keeps backpressure
+honest instead of collapsing everything to 500:
+
+========================  ====  =======================================
+terminal status           HTTP  notes
+========================  ====  =======================================
+``ADMISSION_REJECTED``    429   ``Retry-After`` from ``retry_after_s``
+``REFUSED`` (breaker)     503   also ``QueueFull`` / closed service
+``ERROR`` (engine)        500   still a typed result body, never a
+                                raw traceback
+everything else           200   ``CONVERGED``/``MAXITER``/``TIMEOUT``
+                                /... - the solve RAN; the body's
+                                ``status`` is the verdict
+========================  ====  =======================================
+"""
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+import math
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "WIRE_VERSION",
+    "WireError",
+    "decode_array",
+    "encode_array",
+    "parse_submit",
+    "result_envelope",
+    "result_from_json",
+    "status_to_http",
+    "submit_envelope",
+]
+
+#: bump on any incompatible envelope change; both ends check it
+WIRE_VERSION = 1
+
+#: dtypes the plane accepts - the solver tier is f32/f64 real CG
+_ALLOWED_DTYPES = ("float32", "float64")
+
+
+class WireError(Exception):
+    """A malformed envelope (the network plane maps it to HTTP 400).
+    ``code`` is a machine-readable reason for the JSON error body."""
+
+    def __init__(self, message: str, *, code: str = "bad_request"):
+        super().__init__(message)
+        self.code = str(code)
+
+
+# ---------------------------------------------------------------------------
+# bit-exact vector codec
+# ---------------------------------------------------------------------------
+
+def encode_array(arr: np.ndarray) -> dict:
+    """``{"dtype", "shape", "data"}`` with ``data`` = base64 of the
+    array's raw bytes in little-endian order.  Byte-reinterpreting
+    (never value-converting), so NaN payloads and signed zeros
+    survive."""
+    arr = np.asarray(arr)
+    if arr.dtype.name not in _ALLOWED_DTYPES:
+        raise WireError(
+            f"cannot encode dtype {arr.dtype.name!r} "
+            f"(wire allows {_ALLOWED_DTYPES})", code="bad_dtype")
+    le = arr.astype(arr.dtype.newbyteorder("<"), copy=False)
+    return {
+        "dtype": arr.dtype.name,
+        "shape": [int(d) for d in arr.shape],
+        "data": base64.b64encode(np.ascontiguousarray(le).tobytes()
+                                 ).decode("ascii"),
+    }
+
+
+def decode_array(obj: Any) -> np.ndarray:
+    """Inverse of :func:`encode_array`; returns a native-endian array
+    whose bytes (reinterpreted LE) equal exactly what was encoded.
+    Raises :class:`WireError` on any malformation - wrong dtype name,
+    byte count that disagrees with dtype*shape, bad base64."""
+    if not isinstance(obj, dict):
+        raise WireError("vector payload must be an object with "
+                        "dtype/shape/data", code="bad_vector")
+    dtype_name = obj.get("dtype")
+    if dtype_name not in _ALLOWED_DTYPES:
+        raise WireError(f"vector dtype must be one of "
+                        f"{_ALLOWED_DTYPES}, got {dtype_name!r}",
+                        code="bad_dtype")
+    shape = obj.get("shape")
+    if not isinstance(shape, list) \
+            or not all(isinstance(d, int) and d >= 0 for d in shape):
+        raise WireError("vector shape must be a list of non-negative "
+                        "ints", code="bad_vector")
+    try:
+        raw = base64.b64decode(obj.get("data", ""), validate=True)
+    except (binascii.Error, TypeError, ValueError) as e:
+        raise WireError(f"vector data is not valid base64: {e}",
+                        code="bad_vector")
+    le_dtype = np.dtype(dtype_name).newbyteorder("<")
+    count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if len(raw) != count * le_dtype.itemsize:
+        raise WireError(
+            f"vector byte count {len(raw)} does not match "
+            f"dtype {dtype_name} x shape {shape}", code="bad_vector")
+    flat = np.frombuffer(raw, dtype=le_dtype)
+    return flat.astype(np.dtype(dtype_name), copy=True
+                       ).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# submit envelope
+# ---------------------------------------------------------------------------
+
+def submit_envelope(handle_key: str, b: np.ndarray, *,
+                    tol: float = 1e-7,
+                    deadline_s: Optional[float] = None,
+                    tenant: Optional[str] = None,
+                    slo_class: Optional[str] = None,
+                    tag: Optional[str] = None) -> dict:
+    """Client side: the ``POST /v1/submit`` body.  ``tenant`` is
+    OPTIONAL and only ever a cross-check - the server derives the real
+    tenant from the bearer token (a mismatch is a 403, see
+    ``serve.auth``)."""
+    env: dict = {
+        "wire": WIRE_VERSION,
+        "handle": str(handle_key),
+        "b": encode_array(b),
+        "tol": float(tol),
+    }
+    if deadline_s is not None:
+        env["deadline_s"] = float(deadline_s)
+    if tenant is not None:
+        env["tenant"] = str(tenant)
+    if slo_class is not None:
+        env["slo_class"] = str(slo_class)
+    if tag is not None:
+        env["tag"] = str(tag)
+    return env
+
+
+def parse_submit(body: bytes) -> dict:
+    """Server side: validate a submit body into
+    ``{handle, b, tol, deadline_s, tenant, slo_class, tag}`` (absent
+    optionals -> None).  Any malformation is a typed
+    :class:`WireError`, which the plane maps to 400 - never a
+    traceback."""
+    try:
+        env = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"submit body is not valid JSON: {e}",
+                        code="bad_json")
+    if not isinstance(env, dict):
+        raise WireError("submit body must be a JSON object",
+                        code="bad_request")
+    if env.get("wire") != WIRE_VERSION:
+        raise WireError(
+            f"unsupported wire version {env.get('wire')!r} "
+            f"(this server speaks {WIRE_VERSION})",
+            code="bad_wire_version")
+    handle = env.get("handle")
+    if not isinstance(handle, str) or not handle:
+        raise WireError("submit requires a 'handle' key naming a "
+                        "registered operator", code="bad_handle")
+    b = decode_array(env.get("b"))
+    if b.ndim != 1:
+        raise WireError(f"right-hand side must be a 1-D vector, got "
+                        f"shape {list(b.shape)}", code="bad_vector")
+    tol = env.get("tol", 1e-7)
+    if not isinstance(tol, (int, float)) or not (float(tol) > 0.0):
+        raise WireError(f"tol must be a positive number, got {tol!r}",
+                        code="bad_request")
+    deadline_s = env.get("deadline_s")
+    if deadline_s is not None and (
+            not isinstance(deadline_s, (int, float))
+            or not (float(deadline_s) > 0.0)):
+        raise WireError(f"deadline_s must be a positive number, got "
+                        f"{deadline_s!r}", code="bad_request")
+    out = {
+        "handle": handle,
+        "b": b,
+        "tol": float(tol),
+        "deadline_s": float(deadline_s) if deadline_s is not None
+        else None,
+    }
+    for key in ("tenant", "slo_class", "tag"):
+        val = env.get(key)
+        if val is not None and not isinstance(val, str):
+            raise WireError(f"{key} must be a string, got {val!r}",
+                            code="bad_request")
+        out[key] = val
+    return out
+
+
+# ---------------------------------------------------------------------------
+# result envelope
+# ---------------------------------------------------------------------------
+
+def status_to_http(status: str) -> Tuple[int, Optional[str]]:
+    """``(http_status, retry_semantics)`` for a terminal result status.
+    ``retry_semantics`` is ``"retry_after"`` when the response should
+    carry a ``Retry-After`` header sourced from the result's
+    ``retry_after_s``."""
+    if status == "ADMISSION_REJECTED":
+        return 429, "retry_after"
+    if status == "REFUSED":
+        return 503, None
+    if status == "ERROR":
+        return 500, None
+    return 200, None
+
+
+def _finite_or_none(v) -> Optional[float]:
+    v = float(v)
+    return v if math.isfinite(v) else None
+
+
+def result_envelope(result, *, request_id: Optional[str] = None,
+                    include_x: bool = True) -> dict:
+    """A terminal ``RequestResult`` as its wire envelope.  ``x`` rides
+    bit-exact via :func:`encode_array` (or ``None`` for refusals);
+    ``request_id`` - the plane's public id - may differ from the
+    service-internal ``result.request_id``, which is preserved as
+    ``service_request_id`` so wire results join against traces and
+    usage exports."""
+    env = {
+        "wire": WIRE_VERSION,
+        "kind": "result",
+        "request_id": str(request_id if request_id is not None
+                          else result.request_id),
+        "service_request_id": result.request_id,
+        "status": result.status,
+        "converged": bool(result.converged),
+        "timed_out": bool(result.timed_out),
+        "iterations": int(result.iterations),
+        # JSON has no spelling for NaN/inf (and the plane encodes with
+        # allow_nan=False); a rejected result's residual_norm is NaN,
+        # so non-finite scalars ride as null and decode back to NaN
+        "residual_norm": _finite_or_none(result.residual_norm),
+        "wait_s": float(result.wait_s),
+        "solve_s": float(result.solve_s),
+        "latency_s": float(result.latency_s),
+        "bucket": int(result.bucket),
+        "occupancy": float(result.occupancy),
+        "solve_id": result.solve_id,
+        "attempts": int(result.attempts),
+        "degraded": bool(result.degraded),
+        "tenant": result.tenant,
+        "slo_class": result.slo_class,
+        "retry_after_s": (float(result.retry_after_s)
+                          if result.retry_after_s is not None
+                          else None),
+        "x": (encode_array(result.x)
+              if include_x and result.x is not None else None),
+    }
+    return env
+
+
+def result_from_json(env: Any) -> "Any":
+    """Client side: a result envelope back into a ``RequestResult``
+    (imported lazily - the codec stays importable without the service
+    tier).  The reconstructed ``x`` is bit-exact."""
+    from .service import RequestResult
+    if not isinstance(env, dict) or env.get("kind") != "result":
+        raise WireError("not a result envelope", code="bad_result")
+    if env.get("wire") != WIRE_VERSION:
+        raise WireError(
+            f"unsupported wire version {env.get('wire')!r} "
+            f"(this client speaks {WIRE_VERSION})",
+            code="bad_wire_version")
+    try:
+        x = decode_array(env["x"]) if env.get("x") is not None \
+            else None
+        return RequestResult(
+            request_id=str(env["request_id"]),
+            status=str(env["status"]),
+            converged=bool(env["converged"]),
+            timed_out=bool(env["timed_out"]),
+            x=x,
+            iterations=int(env["iterations"]),
+            residual_norm=(float(env["residual_norm"])
+                           if env.get("residual_norm") is not None
+                           else float("nan")),
+            wait_s=float(env["wait_s"]),
+            solve_s=float(env["solve_s"]),
+            latency_s=float(env["latency_s"]),
+            bucket=int(env["bucket"]),
+            occupancy=float(env["occupancy"]),
+            solve_id=env.get("solve_id"),
+            attempts=int(env.get("attempts", 1)),
+            degraded=bool(env.get("degraded", False)),
+            tenant=str(env.get("tenant", "default")),
+            slo_class=str(env.get("slo_class", "silver")),
+            retry_after_s=(float(env["retry_after_s"])
+                           if env.get("retry_after_s") is not None
+                           else None),
+        )
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"malformed result envelope: {e}",
+                        code="bad_result")
+
+
+def error_envelope(message: str, *, code: str) -> dict:
+    """The uniform JSON error body every non-2xx data-plane response
+    carries - typed, token-free, never a traceback."""
+    return {"wire": WIRE_VERSION, "kind": "error", "code": str(code),
+            "error": str(message)}
+
+
+__all__.append("error_envelope")

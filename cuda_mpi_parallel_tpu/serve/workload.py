@@ -27,6 +27,7 @@ __all__ = [
     "replay_workload",
     "rhs_for",
     "save_workload",
+    "summarize_replay",
     "synthetic_poisson",
     "synthetic_tenant_mix",
 ]
@@ -214,7 +215,27 @@ def replay_workload(service, handle, requests, prepared_b,
             futures.append(None)     # hard backpressure shed
     service.drain()
     window_s = time.monotonic() - t0
+    results = [fut.result() if fut is not None else None
+               for fut in futures]
+    return summarize_replay(requests, results, window_s,
+                            classes=classes)
 
+
+def summarize_replay(requests, results, window_s: float,
+                     *, classes=None) -> ReplaySummary:
+    """Classify one replay's resolved outcomes (``None`` entries =
+    hard backpressure sheds that never produced a result) into a
+    :class:`ReplaySummary`.
+
+    THE one classification definition: the in-process
+    :func:`replay_workload` and the network client's
+    ``NetClient.replay_workload`` both call this, which is what makes
+    "a loopback network replay produces the same ReplaySummary"
+    checkable - the two paths can only differ in the per-request
+    results they feed in, never in how outcomes are counted.
+    """
+    if classes is None:
+        classes = {}
     by_class: Dict[str, Dict[str, object]] = {}
     lats: Dict[str, list] = {}
 
@@ -224,18 +245,14 @@ def replay_workload(service, handle, requests, prepared_b,
                    "rejected": 0, "p99_latency_s": None})
 
     solved = in_slo = timeouts = rejected = errors = degraded = 0
-    results = []
-    for r, fut in zip(requests, futures):
+    for r, res in zip(requests, results):
         name = r.slo_class or "silver"
         row = tally(name)
         row["offered"] += 1
-        if fut is None:
+        if res is None:
             rejected += 1
             row["rejected"] += 1
-            results.append(None)
             continue
-        res = fut.result()
-        results.append(res)
         if res.status == "ADMISSION_REJECTED":
             rejected += 1
             row["rejected"] += 1
@@ -267,7 +284,7 @@ def replay_workload(service, handle, requests, prepared_b,
         rejected=rejected, errors=errors,
         degraded=degraded,
         goodput_rhs_per_sec=in_slo / max(window_s, 1e-9),
-        by_class=by_class, results=results)
+        by_class=by_class, results=list(results))
 
 
 def rhs_for(a, seed: int, dtype=None) -> Tuple[np.ndarray, np.ndarray]:

@@ -51,8 +51,10 @@ __all__ = [
 ]
 
 #: the typed span vocabulary - validate_trace.py rejects anything else
-SPAN_NAMES = ("submit", "admission", "queue_wait", "sched", "solve",
-              "retry", "migration", "result")
+#: ("net" = the data-plane hop that carried a submit over HTTP:
+#: serve.net hands its receive/parse timing to submit(net_hop=...))
+SPAN_NAMES = ("submit", "net", "admission", "queue_wait", "sched",
+              "solve", "retry", "migration", "result")
 
 # id generation: W3C trace-context wants 16 random bytes / 8 random
 # bytes rendered lowercase-hex.  A per-process random prefix (from

@@ -1,0 +1,753 @@
+"""The network data plane (serve.net / serve.wire / serve.auth /
+serve.client) - ISSUE 20 acceptance surface.
+
+* wire round trips are BIT-exact (f32/f64, empty/odd lengths, NaN
+  payload bits survive);
+* auth matrix: unauthenticated 401 before anything, spoofed tenant a
+  typed 403 that never reaches admission or the SLO tracker, ops
+  plane still 401/200-gated through the ONE shared comparison helper
+  (no plain ``==`` on a bearer token anywhere);
+* backpressure is honest: ADMISSION_REJECTED -> 429 carrying
+  ``Retry-After``, which the client backoff HONORS; QueueFull -> 503;
+* a threaded loopback mesh-4 replay of a workload produces
+  per-request ``(status, iterations, x-bytes)`` exactly equal to the
+  in-process replay of the same workload;
+* the solve jaxpr is bit-identical while the plane is live.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_mpi_parallel_tpu import telemetry
+from cuda_mpi_parallel_tpu.models import poisson
+from cuda_mpi_parallel_tpu.serve import auth as serve_auth
+from cuda_mpi_parallel_tpu.serve import wire
+from cuda_mpi_parallel_tpu.serve.admission import (
+    AdmissionConfig,
+    TokenBucket,
+)
+from cuda_mpi_parallel_tpu.serve.client import NetClient, NetError
+from cuda_mpi_parallel_tpu.serve.service import (
+    ServiceConfig,
+    SolverService,
+)
+from cuda_mpi_parallel_tpu.serve.workload import (
+    WorkloadRequest,
+    replay_workload,
+    rhs_for,
+    summarize_replay,
+)
+from cuda_mpi_parallel_tpu.telemetry import events
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def poisson_csr(n=12, dtype=np.float64):
+    return poisson.poisson_2d_csr(n, n, dtype=dtype)
+
+
+def _ring(**tenants):
+    """tokA="acme", ... -> TokenKeyring"""
+    ring = serve_auth.TokenKeyring()
+    for token, ident in tenants.items():
+        ring.add(token, ident)
+    return ring
+
+
+def http_json(url, method="GET", token=None, payload=None,
+              timeout=15.0):
+    """(status, headers, parsed-body) with 4xx/5xx as verdicts."""
+    data = None
+    headers = {}
+    if token is not None:
+        headers["Authorization"] = f"Bearer {token}"
+    if payload is not None:
+        data = json.dumps(payload).encode("utf-8")
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=headers,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.headers, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers, json.loads(e.read() or b"{}")
+
+
+# ---------------------------------------------------------------------------
+# wire format
+
+
+class TestWireCodec:
+    @pytest.mark.parametrize("dtype", ["float32", "float64"])
+    @pytest.mark.parametrize("n", [0, 1, 3, 7, 17, 240])
+    def test_round_trip_bit_exact(self, dtype, n):
+        rng = np.random.default_rng(n + 1)
+        a = rng.standard_normal(n).astype(dtype)
+        b = wire.decode_array(wire.encode_array(a))
+        assert b.dtype == a.dtype and b.shape == a.shape
+        assert b.tobytes() == a.tobytes()
+
+    def test_nan_payload_and_signed_zero_survive(self):
+        a = np.array([np.nan, -0.0, np.inf, -np.inf, 1e-308],
+                     dtype=np.float64)
+        # give the NaN a non-default payload: bit-reinterpret
+        a_bits = a.view(np.uint64).copy()
+        a_bits[0] |= 0xDEAD
+        a = a_bits.view(np.float64)
+        b = wire.decode_array(wire.encode_array(a))
+        assert b.tobytes() == a.tobytes()
+
+    def test_rejects_wrong_dtype(self):
+        with pytest.raises(wire.WireError):
+            wire.encode_array(np.arange(4, dtype=np.int32))
+        env = wire.encode_array(np.ones(4))
+        env["dtype"] = "int32"
+        with pytest.raises(wire.WireError):
+            wire.decode_array(env)
+
+    def test_rejects_byte_count_mismatch_and_bad_base64(self):
+        env = wire.encode_array(np.ones(4))
+        env["shape"] = [5]
+        with pytest.raises(wire.WireError):
+            wire.decode_array(env)
+        env = wire.encode_array(np.ones(4))
+        env["data"] = "!!!not-base64!!!"
+        with pytest.raises(wire.WireError):
+            wire.decode_array(env)
+
+    def test_submit_envelope_round_trip(self):
+        b = np.random.default_rng(0).standard_normal(17)
+        env = wire.submit_envelope("h1", b, tol=1e-9, deadline_s=2.0,
+                                   slo_class="gold")
+        req = wire.parse_submit(json.dumps(env).encode("utf-8"))
+        assert req["handle"] == "h1"
+        assert req["tol"] == 1e-9 and req["deadline_s"] == 2.0
+        assert req["slo_class"] == "gold" and req["tenant"] is None
+        assert req["b"].tobytes() == b.tobytes()
+
+    @pytest.mark.parametrize("mutate", [
+        lambda e: e.__setitem__("wire", 99),
+        lambda e: e.pop("handle"),
+        lambda e: e.__setitem__("tol", -1.0),
+        lambda e: e.__setitem__("deadline_s", 0.0),
+        lambda e: e.__setitem__("tenant", 7),
+    ])
+    def test_parse_submit_rejects_malformed(self, mutate):
+        env = wire.submit_envelope("h1", np.ones(4))
+        mutate(env)
+        with pytest.raises(wire.WireError):
+            wire.parse_submit(json.dumps(env).encode("utf-8"))
+
+    def test_parse_submit_rejects_non_json_and_2d(self):
+        with pytest.raises(wire.WireError):
+            wire.parse_submit(b"\xff\x00 not json")
+        env = wire.submit_envelope("h1", np.ones(4))
+        env["b"] = wire.encode_array(np.ones((2, 2)))
+        with pytest.raises(wire.WireError):
+            wire.parse_submit(json.dumps(env).encode("utf-8"))
+
+    def test_status_to_http_table(self):
+        assert wire.status_to_http("ADMISSION_REJECTED") == \
+            (429, "retry_after")
+        assert wire.status_to_http("REFUSED") == (503, None)
+        assert wire.status_to_http("ERROR") == (500, None)
+        for status in ("CONVERGED", "MAXITER", "TIMEOUT",
+                       "STAGNATED", "BREAKDOWN"):
+            assert wire.status_to_http(status) == (200, None)
+
+    def test_result_envelope_round_trip(self):
+        from cuda_mpi_parallel_tpu.serve.service import RequestResult
+
+        x = np.random.default_rng(1).standard_normal(9)
+        res = RequestResult(
+            request_id="q000001", status="CONVERGED", converged=True,
+            timed_out=False, x=x, iterations=12,
+            residual_norm=1.5e-9, wait_s=0.001, solve_s=0.02,
+            latency_s=0.021, bucket=4, occupancy=0.75,
+            solve_id="s1", attempts=2, degraded=True,
+            tenant="acme", slo_class="gold", retry_after_s=None)
+        env = wire.result_envelope(res, request_id="n000004")
+        back = wire.result_from_json(json.loads(
+            json.dumps(env, allow_nan=False)))
+        assert back.request_id == "n000004"
+        assert env["service_request_id"] == "q000001"
+        assert back.x.tobytes() == x.tobytes()
+        for field in ("status", "converged", "timed_out",
+                      "iterations", "residual_norm", "wait_s",
+                      "solve_s", "latency_s", "bucket", "occupancy",
+                      "solve_id", "attempts", "degraded", "tenant",
+                      "slo_class", "retry_after_s"):
+            assert getattr(back, field) == getattr(res, field), field
+
+
+# ---------------------------------------------------------------------------
+# auth
+
+
+class TestAuth:
+    def test_constant_time_eq_and_bearer_ok(self):
+        assert serve_auth.constant_time_eq("tok", "tok")
+        assert not serve_auth.constant_time_eq("tok", "tok2")
+        assert serve_auth.bearer_ok("Bearer tok", "tok")
+        assert not serve_auth.bearer_ok("Bearer nope", "tok")
+        assert not serve_auth.bearer_ok(None, "tok")
+        assert not serve_auth.bearer_ok("Basic tok", "tok")
+
+    def test_keyring_resolve_authenticate(self):
+        ring = _ring(tokA="acme", tokB="beta")
+        assert ring.resolve("tokA").tenant == "acme"
+        assert ring.resolve("missing") is None
+        assert ring.authenticate("Bearer tokB").tenant == "beta"
+        for bad in (None, "", "tokA", "Basic tokA",
+                    "Bearer missing", "Bearer "):
+            with pytest.raises(serve_auth.AuthError) as ei:
+                ring.authenticate(bad)
+            assert ei.value.status == 401
+
+    def test_authorize_spoof_and_class(self):
+        ring = serve_auth.TokenKeyring().add(
+            "tokB", serve_auth.TenantIdentity(
+                "beta", slo_classes=("bulk", "silver")))
+        ident = ring.authenticate("Bearer tokB")
+        ring.authorize(ident, claimed_tenant="beta",
+                       slo_class="bulk")
+        ring.authorize(ident, claimed_tenant=None, slo_class="silver")
+        with pytest.raises(serve_auth.AuthError) as ei:
+            ring.authorize(ident, claimed_tenant="acme",
+                           slo_class="bulk")
+        assert ei.value.status == 403
+        assert ei.value.code == "tenant_mismatch"
+        with pytest.raises(serve_auth.AuthError) as ei:
+            ring.authorize(ident, claimed_tenant=None,
+                           slo_class="gold")
+        assert ei.value.status == 403
+        assert ei.value.code == "slo_class_forbidden"
+
+    def test_from_spec_and_from_file(self, tmp_path):
+        ring = serve_auth.TokenKeyring.from_spec(
+            "tokA:acme,tokB:beta:bulk+silver")
+        assert ring.resolve("tokB").slo_classes == ("bulk", "silver")
+        assert ring.tenants() == ("acme", "beta")
+        path = tmp_path / "keyring.json"
+        path.write_text(json.dumps({
+            "version": 1,
+            "tokens": [{"token": "t1", "tenant": "acme"},
+                       {"token": "t2", "tenant": "beta",
+                        "slo_classes": ["bulk"]}]}))
+        ring2 = serve_auth.TokenKeyring.from_file(str(path))
+        assert ring2.resolve("t2").slo_classes == ("bulk",)
+        for bad in ("", "justatoken", "a:b:c:d"):
+            with pytest.raises(ValueError):
+                serve_auth.TokenKeyring.from_spec(bad)
+
+    def test_one_comparison_definition_repo_wide(self):
+        """Regression for the ISSUE 20 bugfix: the ops plane's two
+        bearer checks used plain ``==``; both must now route through
+        serve.auth (hmac.compare_digest), and no network-plane module
+        may compare a bearer header with ``==`` again."""
+        import inspect
+
+        from cuda_mpi_parallel_tpu.serve import net as serve_net
+        from cuda_mpi_parallel_tpu.serve import ops as serve_ops
+
+        ops_src = inspect.getsource(serve_ops)
+        assert '== f"Bearer' not in ops_src
+        assert 'f"Bearer {token}" ==' not in ops_src
+        assert "bearer_ok" in ops_src
+        net_src = inspect.getsource(serve_net)
+        assert '== f"Bearer' not in net_src
+        # and the one definition really is compare_digest
+        import hmac as _hmac
+
+        assert serve_auth.constant_time_eq.__code__.co_names[0] in \
+            ("hmac", "str")
+        assert _hmac.compare_digest(b"x", b"x")
+
+    def test_ops_plane_token_matrix_still_holds(self):
+        """The ops plane's 401/200 behavior is unchanged by the
+        compare_digest switch."""
+        svc = SolverService(ServiceConfig(
+            clock=FakeClock(), max_batch=2, ops_port=0,
+            ops_token="sekrit"))
+        try:
+            base = svc.ops_server().url
+            st, _, _ = http_json(base + "/healthz")
+            assert st == 401
+            st, _, _ = http_json(base + "/healthz", token="wrong")
+            assert st == 401
+            st, _, _ = http_json(base + "/healthz", token="sekrit")
+            assert st == 200
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# the live plane: auth matrix, backpressure, streaming
+
+
+@pytest.fixture()
+def plane():
+    """A live loopback data plane over a small Poisson operator,
+    with tokens for two tenants (tokB's beta restricted to
+    bulk+silver)."""
+    ring = serve_auth.TokenKeyring()
+    ring.add("tokA", "acme")
+    ring.add("tokB", serve_auth.TenantIdentity(
+        "beta", slo_classes=("bulk", "silver")))
+    svc = SolverService(ServiceConfig(max_batch=4, maxiter=600,
+                                      net_port=0, net_keyring=ring))
+    a = poisson_csr()
+    h = svc.register(a, method="batched", precond=None)
+    try:
+        yield svc, h, a, svc.net_server()
+    finally:
+        svc.close()
+
+
+class TestNetPlane:
+    def test_handles_solve_and_derived_tenant(self, plane):
+        svc, h, a, net = plane
+        cli = NetClient(net.url, "tokA")
+        handles = cli.handles()
+        assert [row["key"] for row in handles] == [h.key]
+        assert handles[0]["n"] == h.n
+        b, x_true = rhs_for(a, seed=11)
+        res = cli.solve(h.key, b, tol=1e-9)
+        assert res.status == "CONVERGED" and res.converged
+        assert float(np.max(np.abs(res.x - x_true))) < 1e-5
+        # the tenant tag is DERIVED from the token, never defaulted
+        assert res.tenant == "acme"
+
+    def test_unauthenticated_never_reaches_admission(self, plane):
+        svc, h, a, net = plane
+        b, _ = rhs_for(a, seed=1)
+        submitted_before = svc.stats()["submitted"]
+        env = wire.submit_envelope(h.key, b)
+        for token in (None, "wrong"):
+            st, headers, body = http_json(
+                net.url + "/v1/submit", method="POST", token=token,
+                payload=env)
+            assert st == 401
+            assert body["kind"] == "error"
+            assert headers.get("WWW-Authenticate") == "Bearer"
+        assert svc.stats()["submitted"] == submitted_before
+
+    def test_spoofed_tenant_typed_403_before_admission(self, plane):
+        svc, h, a, net = plane
+        b, _ = rhs_for(a, seed=2)
+        submitted_before = svc.stats()["submitted"]
+        env = wire.submit_envelope(h.key, b, tenant="beta")
+        st, _, body = http_json(net.url + "/v1/submit",
+                                method="POST", token="tokA",
+                                payload=env)
+        assert st == 403
+        assert body["kind"] == "error"
+        assert body["code"] == "tenant_mismatch"
+        # the spoof consumed NOTHING: no submit, no tenant tally,
+        # no SLO flow
+        stats = svc.stats()
+        assert stats["submitted"] == submitted_before
+        assert "beta" not in stats.get("tenants", {})
+        # and the client surfaces it as the same typed error
+        cli = NetClient(net.url, "tokA")
+        with pytest.raises(NetError) as ei:
+            cli.submit(h.key, b, tenant="beta")
+        assert ei.value.status == 403
+        assert ei.value.code == "tenant_mismatch"
+
+    def test_forbidden_slo_class_403(self, plane):
+        svc, h, a, net = plane
+        b, _ = rhs_for(a, seed=3)
+        st, _, body = http_json(
+            net.url + "/v1/submit", method="POST", token="tokB",
+            payload=wire.submit_envelope(h.key, b, slo_class="gold"))
+        assert st == 403 and body["code"] == "slo_class_forbidden"
+        # an allowed class for the same identity goes through
+        cli = NetClient(net.url, "tokB")
+        res = cli.solve(h.key, b, slo_class="bulk")
+        assert res.converged and res.tenant == "beta"
+        assert res.slo_class == "bulk"
+
+    def test_malformed_body_400_unknown_handle_404(self, plane):
+        svc, h, a, net = plane
+        st, _, body = http_json(net.url + "/v1/submit",
+                                method="POST", token="tokA",
+                                payload={"wire": 99})
+        assert st == 400 and body["kind"] == "error"
+        b, _ = rhs_for(a, seed=4)
+        st, _, body = http_json(
+            net.url + "/v1/submit", method="POST", token="tokA",
+            payload=wire.submit_envelope("nope", b))
+        assert st == 404 and body["code"] == "unknown_handle"
+        st, _, body = http_json(net.url + "/v1/nowhere",
+                                method="POST", token="tokA",
+                                payload={})
+        assert st == 404
+
+    def test_result_ownership_and_unknown_404(self, plane):
+        svc, h, a, net = plane
+        cliA = NetClient(net.url, "tokA")
+        b, _ = rhs_for(a, seed=5)
+        out = cliA.submit(h.key, b)
+        rid = out if isinstance(out, str) else out.request_id
+        resA = cliA.result(rid, timeout_s=60)
+        assert resA.converged
+        # another tenant may not read it
+        st, _, body = http_json(net.url + f"/v1/result/{rid}",
+                                token="tokB")
+        assert st == 403 and body["code"] == "tenant_mismatch"
+        # unknown id is a typed 404
+        st, _, body = http_json(net.url + "/v1/result/n999999",
+                                token="tokA")
+        assert st == 404 and body["code"] == "unknown_request"
+
+    def test_sse_stream_delivers_terminal_results(self, plane):
+        svc, h, a, net = plane
+        cli = NetClient(net.url, "tokA")
+        b, x_true = rhs_for(a, seed=6)
+        out = cli.submit(h.key, b, tol=1e-9)
+        rid = out if isinstance(out, str) else out.request_id
+        got = list(cli.stream(ids=[rid], timeout_s=60))
+        assert len(got) == 1
+        assert got[0].request_id == rid and got[0].converged
+        assert float(np.max(np.abs(got[0].x - x_true))) < 1e-5
+
+    def test_double_serve_net_refused_and_close_tears_down(self):
+        ring = serve_auth.TokenKeyring().add("t", "acme")
+        svc = SolverService(ServiceConfig(
+            max_batch=2, net_port=0, net_keyring=ring))
+        url = svc.net_server().url
+        with pytest.raises(RuntimeError):
+            svc.serve_net(0, keyring=ring)
+        svc.close()
+        assert svc.net_server() is None
+        with pytest.raises(
+                (urllib.error.URLError, ConnectionError, OSError)):
+            urllib.request.urlopen(url + "/v1/handles", timeout=2.0)
+
+    def test_keyring_required(self):
+        svc = SolverService(ServiceConfig(max_batch=2))
+        try:
+            with pytest.raises(ValueError):
+                svc.serve_net(0)
+        finally:
+            svc.close()
+
+
+class TestBackpressure:
+    def test_admission_reject_is_429_with_retry_after(self):
+        ring = serve_auth.TokenKeyring().add("tokA", "acme")
+        clock = FakeClock()
+        svc = SolverService(ServiceConfig(
+            clock=clock, max_batch=2, net_port=0, net_keyring=ring,
+            admission=AdmissionConfig(
+                default=TokenBucket(rate=0.01, burst=1.0))))
+        try:
+            a = poisson_csr(6)
+            h = svc.register(a, method="batched", precond=None)
+            b, _ = rhs_for(a, seed=7)
+            env = wire.submit_envelope(h.key, b)
+            st1, _, body1 = http_json(svc.net_server().url
+                                      + "/v1/submit", method="POST",
+                                      token="tokA", payload=env)
+            assert st1 == 202 and body1["kind"] == "pending"
+            st2, headers2, body2 = http_json(
+                svc.net_server().url + "/v1/submit", method="POST",
+                token="tokA", payload=env)
+            assert st2 == 429
+            assert body2["kind"] == "result"
+            assert body2["status"] == "ADMISSION_REJECTED"
+            assert body2["retry_after_s"] is not None
+            ra = headers2.get("Retry-After")
+            assert ra is not None and int(ra) >= 1
+            # drain the accepted one so close() does not hang on it
+            clock.advance(0.011)
+            svc.pump()
+        finally:
+            svc.close()
+
+    def test_client_backoff_honors_retry_after(self):
+        ring = serve_auth.TokenKeyring().add("tokA", "acme")
+        clock = FakeClock()
+        svc = SolverService(ServiceConfig(
+            clock=clock, max_batch=2, net_port=0, net_keyring=ring,
+            admission=AdmissionConfig(
+                default=TokenBucket(rate=0.01, burst=1.0))))
+        try:
+            a = poisson_csr(6)
+            h = svc.register(a, method="batched", precond=None)
+            b, _ = rhs_for(a, seed=8)
+            slept = []
+            cli = NetClient(svc.net_server().url, "tokA",
+                            max_retries=2, sleep=slept.append)
+            first = cli.submit(h.key, b)      # burns the one token
+            res = cli.submit(h.key, b)        # 429 -> retry -> 429...
+            assert res.status == "ADMISSION_REJECTED"
+            assert len(slept) == 2            # max_retries backoffs
+            # every recorded sleep honors the server's hint: the
+            # Retry-After ceil of retry_after_s, never the default
+            # exponential schedule
+            assert all(s >= 1.0 for s in slept), slept
+            assert isinstance(first, str)
+            clock.advance(0.011)
+            svc.pump()
+        finally:
+            svc.close()
+
+    def test_queue_full_is_503_typed(self):
+        ring = serve_auth.TokenKeyring().add("tokA", "acme")
+        clock = FakeClock()
+        svc = SolverService(ServiceConfig(
+            clock=clock, max_batch=1, queue_limit=1, net_port=0,
+            net_keyring=ring))
+        try:
+            a = poisson_csr(6)
+            h = svc.register(a, method="batched", precond=None)
+            b, _ = rhs_for(a, seed=9)
+            env = wire.submit_envelope(h.key, b)
+            url = svc.net_server().url + "/v1/submit"
+            st1, _, _ = http_json(url, method="POST", token="tokA",
+                                  payload=env)
+            assert st1 == 202
+            st2, _, body2 = http_json(url, method="POST",
+                                      token="tokA", payload=env)
+            assert st2 == 503
+            assert body2["kind"] == "error"
+            assert body2["code"] == "queue_full"
+            clock.advance(0.011)
+            svc.pump()
+        finally:
+            svc.close()
+
+    def test_closed_service_is_503(self):
+        ring = serve_auth.TokenKeyring().add("tokA", "acme")
+        svc = SolverService(ServiceConfig(
+            max_batch=2, net_port=0, net_keyring=ring))
+        a = poisson_csr(6)
+        h = svc.register(a, method="batched", precond=None)
+        url = svc.net_server().url
+        b, _ = rhs_for(a, seed=10)
+        svc.close()   # stops the plane too; hit the service directly
+        from cuda_mpi_parallel_tpu.serve.net import NetServer
+
+        net = NetServer(svc, port=0, keyring=ring)
+        net.start()
+        try:
+            st, _, body = http_json(
+                net.url + "/v1/submit", method="POST", token="tokA",
+                payload=wire.submit_envelope(h.key, b))
+            assert st == 503 and body["code"] == "service_closed"
+        finally:
+            net.stop()
+
+
+# ---------------------------------------------------------------------------
+# the tentpole contract: loopback replay == in-process replay
+
+
+def _mesh_service(ring=None):
+    from cuda_mpi_parallel_tpu.parallel import make_mesh
+
+    # max_batch=1: every request is its own batch, so BATCH
+    # COMPOSITION is deterministic across the two replays - the
+    # repo's bit-identity contract holds within a lane bucket, and
+    # open-loop arrival jitter must not move a request between
+    # buckets when the acceptance is exact byte equality
+    svc = SolverService(ServiceConfig(
+        max_batch=1, max_wait_s=0.004, maxiter=800,
+        net_port=0 if ring is not None else None,
+        net_keyring=ring))
+    a = poisson_csr(10)
+    h = svc.register(a, mesh=make_mesh(4), method="batched",
+                     precond=None)
+    return svc, h, a
+
+
+def _workload(a, n=12):
+    reqs = [WorkloadRequest(t=i * 0.004, seed=1000 + 7 * i)
+            for i in range(n)]
+    prepared = [rhs_for(a, r.seed)[0] for r in reqs]
+    truths = [rhs_for(a, r.seed)[1] for r in reqs]
+    return reqs, prepared, truths
+
+
+class TestLoopbackReplayParity:
+    def test_mesh4_network_replay_equals_in_process(self):
+        """ISSUE 20 acceptance: the same saved workload, replayed
+        once in-process and once over the loopback wire, produces
+        per-request (status, iterations, x-bytes) EXACTLY equal.
+        Single-request batches (max_batch=1) pin the composition;
+        the lane-identity contract (precond=None, batched) covers
+        the rest."""
+        # in-process reference
+        svc1, h1, a = _mesh_service(ring=None)
+        reqs, prepared, truths = _workload(a)
+        try:
+            ref = replay_workload(svc1, h1, reqs, prepared,
+                                  tol=1e-8)
+        finally:
+            svc1.close()
+        # over the wire
+        ring = serve_auth.TokenKeyring().add("tok", "default")
+        svc2, h2, _ = _mesh_service(ring=ring)
+        try:
+            cli = NetClient(svc2.net_server().url, "tok")
+            net = cli.replay_workload(h2.key, reqs, prepared,
+                                      tol=1e-8)
+        finally:
+            svc2.close()
+        assert h1.key == h2.key     # same operator, same config
+        ref_rows = [(r.status, r.iterations, r.x.tobytes())
+                    for r in ref.results]
+        net_rows = [(r.status, r.iterations, r.x.tobytes())
+                    for r in net.results]
+        assert ref_rows == net_rows
+        assert all(row[0] == "CONVERGED" for row in ref_rows)
+        # max_abs_error against the known solutions matches exactly
+        # (same bytes -> same error, but assert the user-visible
+        # number too)
+        for ref_res, net_res, x_true in zip(ref.results, net.results,
+                                            truths):
+            ref_err = float(np.max(np.abs(ref_res.x - x_true)))
+            net_err = float(np.max(np.abs(net_res.x - x_true)))
+            assert ref_err == net_err < 1e-5
+        # and the summaries classify identically
+        assert (ref.offered, ref.solved, ref.timeouts, ref.rejected,
+                ref.errors) == (net.offered, net.solved, net.timeouts,
+                                net.rejected, net.errors)
+
+    def test_summarize_replay_is_the_shared_definition(self):
+        """Both replay paths classify through summarize_replay - a
+        synthetic results list counts the same via either entry."""
+        from cuda_mpi_parallel_tpu.serve.service import RequestResult
+
+        def res(status, converged, timed_out=False, degraded=False,
+                latency=0.01):
+            return RequestResult(
+                request_id="q", status=status, converged=converged,
+                timed_out=timed_out, x=None, iterations=1,
+                residual_norm=0.0, wait_s=0.0, solve_s=latency,
+                latency_s=latency, bucket=1, occupancy=1.0,
+                solve_id=None, degraded=degraded)
+
+        reqs = [WorkloadRequest(t=0.0, seed=i) for i in range(5)]
+        results = [res("CONVERGED", True),
+                   res("ADMISSION_REJECTED", False),
+                   res("TIMEOUT", False, timed_out=True),
+                   res("ERROR", False),
+                   None]
+        s = summarize_replay(reqs, results, 1.0)
+        assert (s.offered, s.solved, s.timeouts, s.rejected,
+                s.errors) == (5, 1, 1, 2, 1)
+
+
+# ---------------------------------------------------------------------------
+# zero perturbation with the plane live
+
+
+class TestZeroPerturbationNet:
+    def test_solver_jaxpr_identical_with_plane_live(self):
+        from cuda_mpi_parallel_tpu.models.operators import Stencil2D
+        from cuda_mpi_parallel_tpu.solver import cg
+
+        a = Stencil2D.create(16, 16, dtype=jnp.float64)
+        b = jnp.ones(256)
+
+        def jaxpr():
+            return str(jax.make_jaxpr(
+                lambda v: cg(a, v, maxiter=25))(b))
+
+        telemetry.configure(None)
+        telemetry.force_active(False)
+        base = jaxpr()
+        ring = serve_auth.TokenKeyring().add("tok", "acme")
+        svc = SolverService(ServiceConfig(
+            max_batch=2, net_port=0, net_keyring=ring))
+        try:
+            op = poisson_csr(8)
+            h = svc.register(op, method="batched", precond=None)
+            cli = NetClient(svc.net_server().url, "tok")
+            rhs, _ = rhs_for(op, seed=12)
+            res = cli.solve(h.key, rhs, tol=1e-9)
+            assert res.converged
+            live = jaxpr()
+        finally:
+            svc.close()
+        assert live == base
+
+
+# ---------------------------------------------------------------------------
+# the net span
+
+
+class TestNetSpan:
+    def test_wire_submit_emits_net_span_under_root(self):
+        from cuda_mpi_parallel_tpu.telemetry.tracing import SPAN_NAMES
+
+        assert "net" in SPAN_NAMES
+        ring = serve_auth.TokenKeyring().add("tok", "acme")
+        svc = SolverService(ServiceConfig(
+            max_batch=2, net_port=0, net_keyring=ring))
+        sub = events.subscribe(maxlen=4096)
+        try:
+            a = poisson_csr(8)
+            h = svc.register(a, method="batched", precond=None)
+            cli = NetClient(svc.net_server().url, "tok")
+            b, _ = rhs_for(a, seed=13)
+            res = cli.solve(h.key, b, tol=1e-8)
+            assert res.converged
+            spans = []
+            while True:
+                rec = sub.pop(timeout=0.5)
+                if rec is None:
+                    break
+                if rec.get("event") == "span":
+                    spans.append(rec)
+        finally:
+            events.unsubscribe(sub)
+            svc.close()
+        net_spans = [s for s in spans if s["name"] == "net"]
+        assert len(net_spans) == 1
+        net_span = net_spans[0]
+        assert net_span["route"] == "/v1/submit"
+        assert net_span["bytes_in"] > 0
+        root = [s for s in spans if s["name"] == "submit"
+                and s["request_id"] == net_span["request_id"]]
+        assert len(root) == 1
+        assert net_span["parent_span_id"] == root[0]["span_id"]
+        # in-process submits carry NO net span
+        svc2 = SolverService(ServiceConfig(max_batch=2))
+        sub2 = events.subscribe(maxlen=4096)
+        try:
+            h2 = svc2.register(a, method="batched", precond=None)
+            fut = svc2.submit(h2, b, tol=1e-8)
+            assert fut.result(timeout=60).converged
+            names = set()
+            while True:
+                rec = sub2.pop(timeout=0.5)
+                if rec is None:
+                    break
+                if rec.get("event") == "span":
+                    names.add(rec["name"])
+        finally:
+            events.unsubscribe(sub2)
+            svc2.close()
+        assert "net" not in names and "submit" in names

@@ -233,6 +233,16 @@ METRIC_DIRECTION = {
     # is the ops lint gate's job, not a wall-clock diff's); pre-ops
     # files simply lack it (rendered n/a).
     "ops.scrape_overhead_pct": None,
+    # data-plane columns (serve.net): the serve replay driven THROUGH
+    # the loopback network plane (bearer auth + wire codec both ways)
+    # vs in-process submit on the same service config.  Reported,
+    # never gated - loopback RPC walls ride host scheduling weather
+    # (the contract that wire answers are bit-exact is the net lint
+    # gate's job, not a wall-clock diff's); pre-net files simply lack
+    # them (rendered n/a).
+    "net.networked_rhs_per_sec": None,
+    "net.wire_overhead_pct": None,
+    "net.networked_solved": None,
 }
 
 #: metrics (besides the headline) whose per-section regression past the
@@ -310,6 +320,8 @@ _NESTED = {
             "headroom_pct", "device_peak_bytes",
             "model_working_set_bytes"),
     "ops": ("scrape_overhead_pct",),
+    "net": ("networked_rhs_per_sec", "wire_overhead_pct",
+            "networked_solved"),
 }
 
 

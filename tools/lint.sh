@@ -921,6 +921,165 @@ print(f"ops gate: {rounds} scrape rounds mid-replay "
 PY
 echo "ops gate: clean"
 
+# Net gate: the authenticated data plane (cli serve --listen) must
+# carry a mesh-4 replay of the committed skewed fixture's workload
+# with ZERO drift: every live request CONVERGED with
+# max_abs_error < 1e-5, and the per-request outcomes
+# (status, iterations, residual_norm, max_abs_error) EXACTLY equal
+# the no-network replay of the same saved workload.  --max-batch 1
+# pins batch composition (every request its own bucket-1 batch), so
+# exact equality is sound despite network arrival jitter - the
+# bitwise lane contract covers batchmates within a bucket, not a
+# request that jitter moves BETWEEN buckets.  Auth is exercised live:
+# one spoofed-tenant submit (token B claiming tenant A) must come
+# back a typed 403 without reaching admission (the spoofed tenant
+# never appears in the server's stats), and an unauthenticated
+# submit a 401.  The emitted event stream must stay schema-valid and
+# carry one "net" hop span per wire request.
+echo "== net gate (mesh-4 CLI serve --listen: loopback replay, auth, zero drift) =="
+JAX_PLATFORMS=cpu python - "$scratch" <<'PY'
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+scratch = sys.argv[1]
+env = dict(os.environ, JAX_PLATFORMS="cpu")
+base = [sys.executable, "-m", "cuda_mpi_parallel_tpu.cli", "serve",
+        "--problem", "mm", "--file", "tests/fixtures/skewed_spd_240.mtx",
+        "--mesh", "4", "--max-batch", "1", "--tol", "1e-8",
+        "--maxiter", "500", "--json"]
+
+# reference replay: synthesize + save the workload, NO network
+ref = subprocess.run(
+    base + ["--requests", "16", "--rate", "200", "--seed", "7",
+            "--save-workload", f"{scratch}/net_wl.json"],
+    env=env, capture_output=True, text=True)
+assert ref.returncode == 0, ref.stderr[-2000:]
+off = {r["seed"]: (r["status"], r["iterations"], r["residual_norm"],
+                   r["max_abs_error"])
+       for r in json.loads(ref.stdout)["requests"]}
+
+# the same operator behind a live data plane
+proc = subprocess.Popen(
+    base + ["--listen", "--net-tokens", "lintgate:default,spoof:beta",
+            "--listen-duration", "600",
+            "--trace-events", f"{scratch}/net_events.jsonl"],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+url = None
+stderr_tail = []
+
+
+def _drain():
+    global url
+    for ln in proc.stderr:
+        stderr_tail.append(ln)
+        m = re.search(r"data plane: (http://\S+)", ln)
+        if m and url is None:
+            url = m.group(1)
+
+
+threading.Thread(target=_drain, daemon=True).start()
+deadline = time.monotonic() + 120
+while url is None and time.monotonic() < deadline \
+        and proc.poll() is None:
+    time.sleep(0.05)
+assert url, "data plane URL never announced on stderr:\n" \
+    + "".join(stderr_tail)[-2000:]
+
+sys.path.insert(0, os.getcwd())
+import jax                                                  # noqa: E402
+
+# the reference replay solved in float64 (cli --dtype auto on CPU);
+# the RHS this gate rebuilds must be the SAME bytes
+jax.config.update("jax_enable_x64", True)
+from cuda_mpi_parallel_tpu.models import mmio               # noqa: E402
+from cuda_mpi_parallel_tpu.serve import workload as wl      # noqa: E402
+from cuda_mpi_parallel_tpu.serve.client import (            # noqa: E402
+    NetClient,
+    NetError,
+)
+
+a = mmio.load_matrix_market("tests/fixtures/skewed_spd_240.mtx",
+                            dtype="float64")
+requests = wl.load_workload(f"{scratch}/net_wl.json")
+cli = NetClient(url, "lintgate", timeout_s=120)
+
+# auth, live: unauthenticated 401; spoofed tenant typed 403
+b0, _ = wl.rhs_for(a, requests[0].seed)
+try:
+    NetClient(url, "wrong").solve("x", b0)
+    raise AssertionError("unauthenticated submit was accepted")
+except NetError as e:
+    assert e.status == 401, (e.status, e.code)
+handle_key = cli.handles()[0]["key"]
+try:
+    NetClient(url, "spoof").submit(handle_key, b0, tenant="default")
+    raise AssertionError("spoofed-tenant submit was accepted")
+except NetError as e:
+    assert e.status == 403 and e.code == "tenant_mismatch", \
+        (e.status, e.code)
+
+# the wire replay: same workload, same tolerances, open loop
+net_rows = {}
+outcomes = []
+for r in requests:
+    b, x_true = wl.rhs_for(a, r.seed)
+    res = cli.solve(handle_key, b, tol=1e-8, timeout_s=300)
+    err = float(np.max(np.abs(np.asarray(res.x) - x_true)))
+    net_rows[r.seed] = (res.status, res.iterations,
+                        res.residual_norm, err)
+    outcomes.append(res)
+
+proc.send_signal(signal.SIGTERM)
+out, _ = proc.communicate(timeout=300)
+assert proc.returncode == 0, "".join(stderr_tail)[-2000:]
+rec = json.loads(out)
+assert rec["mode"] == "serve-listen", rec.get("mode")
+
+# zero drift: per-request outcomes exactly equal to the no-network
+# replay, everything live CONVERGED under the error bar
+assert set(net_rows) == set(off)
+assert all(row[0] == "CONVERGED" for row in net_rows.values()), \
+    {s: r[0] for s, r in net_rows.items() if r[0] != "CONVERGED"}
+assert all(row[3] < 1e-5 for row in net_rows.values()), \
+    max(r[3] for r in net_rows.values())
+drift = {s: (off[s], net_rows[s]) for s in off if off[s] != net_rows[s]}
+assert not drift, f"network replay drifted from in-process: {drift}"
+
+# the spoofed tenant never reached admission: no trace of it in the
+# server's accounting
+tenants = rec["stats"].get("tenants", {})
+assert "beta" not in tenants, tenants
+
+# event stream: schema-valid, one net hop span per wire request
+events = [json.loads(ln)
+          for ln in open(f"{scratch}/net_events.jsonl")
+          if ln.strip()]
+from cuda_mpi_parallel_tpu.telemetry.events import validate_event  # noqa: E402
+for e in events:
+    validate_event(e)          # raises on any schema violation
+net_spans = [e for e in events
+             if e.get("event") == "span" and e.get("name") == "net"]
+assert len(net_spans) == len(requests), \
+    f"{len(net_spans)} net spans for {len(requests)} wire requests"
+assert all(e.get("route") == "/v1/submit" and e.get("bytes_in", 0) > 0
+           for e in net_spans)
+
+print(f"net gate: {len(requests)} wire requests, outcomes identical "
+      f"to the no-network replay, spoof 403 + unauthenticated 401 "
+      f"live, {len(net_spans)} net spans schema-valid, "
+      f"{rec['http_requests']} HTTP requests served")
+PY
+python tools/validate_trace.py "$scratch/net_events.jsonl"
+echo "net gate: clean"
+
 # Fleet gate: two serve replicas in SEPARATE processes (each its own
 # registry, its own ops plane on an ephemeral port), scraped mid-
 # replay by tools/fleet_scrape.py --check, which re-sums every merged

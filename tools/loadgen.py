@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Open-loop network load generator for the serve-tier data plane.
+
+Replays a saved workload file (``serve.workload``) against a REMOTE
+``serve.net`` endpoint - the drill tool for ROADMAP item 2's
+two-replica acceptance, and the network twin of
+``cli.py serve --workload``:
+
+* arrivals fire at their recorded offsets on the real clock (open
+  loop: offered load is the independent variable - arrivals never
+  wait for results, so a past-capacity drill actually overloads);
+* right-hand sides are rebuilt locally from each request's seed
+  against the same operator the server registered (``rhs_for``:
+  ``b = A @ x_true(seed)``), so every answer is verified against a
+  known solution without shipping vectors in the workload file;
+* each tenant tag in the workload submits through its own bearer
+  token (``--tokens token:tenant,...``) - the server DERIVES tenant
+  identity from the credential, so a drill cannot spoof its way past
+  admission any more than a real client can;
+* outcomes are classified by ``serve.workload.summarize_replay`` -
+  the same definition the in-process replay and the bench use, so
+  "goodput" means one thing repo-wide.
+
+Examples::
+
+    python tools/loadgen.py --url http://127.0.0.1:8780 \
+        --workload drill.json --problem poisson2d --n 32 \
+        --tokens tok1:acme,tok2:beta --json
+
+    python tools/loadgen.py --url http://replica-0:8780 \
+        --workload saturation.json --problem mm \
+        --file tests/fixtures/skewed_spd_240.mtx --time-scale 0.5
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, __import__("os").path.dirname(
+        __import__("os").path.dirname(
+            __import__("os").path.abspath(__file__))))
+
+from cuda_mpi_parallel_tpu.serve.client import NetClient, NetError  # noqa: E402
+from cuda_mpi_parallel_tpu.serve.sched import DEFAULT_CLASSES, class_table  # noqa: E402
+from cuda_mpi_parallel_tpu.serve import workload as wl  # noqa: E402
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="loadgen",
+        description="open-loop network load generator for the "
+                    "serve.net data plane")
+    p.add_argument("--url", required=True,
+                   help="data-plane base URL, e.g. "
+                        "http://127.0.0.1:8780")
+    p.add_argument("--workload", required=True, metavar="PATH",
+                   help="saved workload file (serve.workload JSON)")
+    p.add_argument("--tokens", required=True, metavar="SPEC",
+                   help="bearer tokens by tenant: 'token:tenant' "
+                        "entries, comma-separated; requests tagged "
+                        "with a tenant submit through its token, "
+                        "untagged requests through the FIRST entry")
+    p.add_argument("--problem", default="poisson2d",
+                   choices=["poisson2d", "mm"],
+                   help="operator family the server registered (for "
+                        "local RHS construction)")
+    p.add_argument("--n", type=int, default=32,
+                   help="grid extent per axis (poisson2d)")
+    p.add_argument("--file", default=None,
+                   help="Matrix Market path (--problem mm)")
+    p.add_argument("--dtype", default="float64",
+                   choices=["float32", "float64"])
+    p.add_argument("--handle", default=None, metavar="KEY",
+                   help="handle key to submit against (default: the "
+                        "plane's only handle, via GET /v1/handles)")
+    p.add_argument("--tol", type=float, default=1e-7)
+    p.add_argument("--deadline", type=float, default=None,
+                   metavar="S",
+                   help="per-request deadline for requests the "
+                        "workload does not tag")
+    p.add_argument("--time-scale", type=float, default=1.0,
+                   dest="time_scale", metavar="F",
+                   help="multiply every arrival offset by F "
+                        "(0.5 = drill at twice the recorded rate)")
+    p.add_argument("--timeout", type=float, default=300.0,
+                   help="per-result collection timeout, seconds")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON record instead of text")
+    return p
+
+
+def _parse_tokens(spec: str):
+    """'token:tenant,...' -> ordered {tenant: token}."""
+    out = {}
+    for i, entry in enumerate(str(spec).split(",")):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) != 2 or not parts[0] or not parts[1]:
+            raise SystemExit(f"--tokens entry {i} must be "
+                             f"'token:tenant', got {entry!r}")
+        out[parts[1]] = parts[0]
+    if not out:
+        raise SystemExit("--tokens names no tokens")
+    return out
+
+
+def _build_operator(args):
+    from cuda_mpi_parallel_tpu.models import mmio, poisson
+
+    if args.problem == "mm":
+        if not args.file:
+            raise SystemExit("--problem mm requires --file")
+        return mmio.load_matrix_market(args.file, dtype=args.dtype)
+    return poisson.poisson_2d_csr(args.n, args.n, dtype=args.dtype)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.time_scale <= 0:
+        raise SystemExit(f"--time-scale must be > 0, got "
+                         f"{args.time_scale}")
+    tokens = _parse_tokens(args.tokens)
+    default_tenant = next(iter(tokens))
+    requests = wl.load_workload(args.workload)
+    a = _build_operator(args)
+
+    clients = {tenant: NetClient(args.url, token)
+               for tenant, token in tokens.items()}
+    for r in requests:
+        if r.tenant is not None and r.tenant not in clients:
+            raise SystemExit(
+                f"workload tags tenant {r.tenant!r} but --tokens "
+                f"names only {sorted(clients)}")
+
+    first = clients[default_tenant]
+    handle_key = args.handle
+    if handle_key is None:
+        handles = first.handles()
+        if len(handles) != 1:
+            raise SystemExit(
+                f"plane serves {len(handles)} handle(s); pick one "
+                f"with --handle "
+                f"({[h['key'] for h in handles]})")
+        handle_key = handles[0]["key"]
+
+    # pre-build every RHS so the arrival loop does nothing but sleep
+    # and submit (same rule as the in-process replay)
+    prepared = [wl.rhs_for(a, r.seed, dtype=np.dtype(args.dtype))[0]
+                for r in requests]
+
+    t0 = time.monotonic()
+    outcomes = []                    # str net_id | RequestResult | None
+    owners = []                      # which client collects it
+    for r, b in zip(requests, prepared):
+        delay = (t0 + r.t * args.time_scale) - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        cli = clients[r.tenant or default_tenant]
+        owners.append(cli)
+        try:
+            outcomes.append(cli.submit(
+                handle_key, b,
+                tol=r.tol if r.tol is not None else args.tol,
+                deadline_s=(r.deadline_s if r.deadline_s is not None
+                            else args.deadline),
+                slo_class=r.slo_class,
+                retry=False))        # a rejection is an OUTCOME here
+        except NetError as e:
+            if e.code == "queue_full":
+                outcomes.append(None)   # hard backpressure shed
+            else:
+                raise SystemExit(f"submit failed: {e} "
+                                 f"(HTTP {e.status})")
+    results = []
+    for cli, out in zip(owners, outcomes):
+        if isinstance(out, str):
+            results.append(cli.result(out, timeout_s=args.timeout))
+        else:
+            results.append(out)
+    window_s = time.monotonic() - t0
+
+    summary = wl.summarize_replay(
+        requests, results, window_s,
+        classes=class_table(DEFAULT_CLASSES))
+
+    by_tenant = {}
+    for r, res in zip(requests, results):
+        row = by_tenant.setdefault(
+            r.tenant or default_tenant,
+            {"offered": 0, "solved": 0, "rejected": 0})
+        row["offered"] += 1
+        if res is None or res.status == "ADMISSION_REJECTED":
+            row["rejected"] += 1
+        elif res.converged and not res.timed_out:
+            row["solved"] += 1
+
+    record = {
+        "mode": "loadgen",
+        "url": args.url,
+        "workload": args.workload,
+        "handle": handle_key,
+        "time_scale": args.time_scale,
+        "window_s": summary.window_s,
+        "offered": summary.offered,
+        "solved": summary.solved,
+        "in_slo": summary.in_slo,
+        "timeouts": summary.timeouts,
+        "rejected": summary.rejected,
+        "errors": summary.errors,
+        "degraded": summary.degraded,
+        "goodput_rhs_per_sec": summary.goodput_rhs_per_sec,
+        "by_class": summary.by_class,
+        "by_tenant": by_tenant,
+    }
+    if args.json:
+        json.dump(record, f := sys.stdout, sort_keys=True)
+        f.write("\n")
+    else:
+        print(f"== loadgen: {args.workload} -> {args.url} ==")
+        print(f"offered {summary.offered} in {summary.window_s:.3f}s "
+              f"| solved {summary.solved} | in-SLO {summary.in_slo} "
+              f"| goodput {summary.goodput_rhs_per_sec:.1f} rhs/s")
+        print(f"timeouts {summary.timeouts} | rejected "
+              f"{summary.rejected} | errors {summary.errors} | "
+              f"degraded {summary.degraded}")
+        for name in sorted(summary.by_class):
+            row = summary.by_class[name]
+            p99 = row["p99_latency_s"]
+            print(f"  class {name:<8} offered {row['offered']:>4} "
+                  f"in-SLO {row['in_slo']:>4} "
+                  f"timeouts {row['timeouts']:>4} "
+                  f"rejected {row['rejected']:>4} "
+                  f"p99 {p99 * 1e3:.1f} ms" if p99 is not None else
+                  f"  class {name:<8} offered {row['offered']:>4} "
+                  f"in-SLO {row['in_slo']:>4} "
+                  f"timeouts {row['timeouts']:>4} "
+                  f"rejected {row['rejected']:>4} p99 n/a")
+        for tenant in sorted(by_tenant):
+            row = by_tenant[tenant]
+            print(f"  tenant {tenant:<8} offered {row['offered']:>4} "
+                  f"solved {row['solved']:>4} "
+                  f"rejected {row['rejected']:>4}")
+    # a drill is green when everything offered either solved or was
+    # HONESTLY shed; silent loss (errors) is the failure
+    return 0 if summary.errors == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
